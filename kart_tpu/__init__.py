@@ -1,0 +1,26 @@
+"""kart_tpu — TPU-native distributed version control for geospatial datasets.
+
+A ground-up rebuild of the capabilities of Kart (koordinates/kart, reference
+mounted at /root/reference): git-backed feature storage using the Datasets V3
+format, spatial-database working copies, diff/merge/conflict resolution, and
+spatially-filtered partial clones — with the row-level diff/merge/spatial-filter
+hot paths re-expressed as vectorized JAX/XLA/Pallas kernels over columnar
+feature blocks instead of per-feature Python loops.
+
+Package layout:
+  core/      object store (git-compatible CAS), refs, repo, structure
+  models/    dataset model (Datasets V3), schema/legend, path encoding
+  ops/       TPU compute: columnar blocks, diff kernels, bbox/envelope kernels
+  parallel/  device-mesh sharding, collective exchange, sampled estimation
+  diff/      diff data model, orchestration, writers, estimation
+  merge/     three-way merge engine, merge index, conflict model
+  workingcopy/  GPKG (sqlite3) and server-DB working copies
+  spatial_filter/  filter spec, envelope index
+  cli/       the `kart` command surface (click)
+  utils/     shared helpers
+"""
+
+__version__ = "0.1.0"
+
+# The reference implementation this framework is capability-matched against.
+REFERENCE_VERSION = "0.10.8"

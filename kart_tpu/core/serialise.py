@@ -1,0 +1,93 @@
+"""Wire-format serialisation, compatible with the reference's Datasets V3 format
+(reference: kart/serialise_util.py).
+
+Feature blobs are msgpack, with geometry values carried as msgpack extension
+type ``G`` (0x47) wrapping StandardGeoPackageBinary bytes. Hashes are truncated
+sha256 (160 bits, same width as git SHA-1 ids).
+"""
+
+import base64
+import hashlib
+import json
+import struct
+
+import msgpack
+
+GEOMETRY_EXT_CODE = 0x47  # ord("G"), reference: kart/serialise_util.py:15
+
+
+def _pack_hook(obj):
+    # Local import: geometry imports nothing from here, but keep the module
+    # graph lazy so `serialise` stays importable standalone.
+    from kart_tpu.geometry import Geometry
+
+    if isinstance(obj, Geometry):
+        return msgpack.ExtType(GEOMETRY_EXT_CODE, bytes(obj))
+    if isinstance(obj, tuple):
+        return list(obj)
+    return obj
+
+
+def _unpack_ext_hook(code, data):
+    if code == GEOMETRY_EXT_CODE:
+        from kart_tpu.geometry import Geometry
+
+        return Geometry.of(data)
+    return msgpack.ExtType(code, data)
+
+
+def msg_pack(value) -> bytes:
+    """Any value -> canonical msgpack bytes (bit-identical to the reference)."""
+    return msgpack.packb(
+        value, use_bin_type=True, strict_types=True, default=_pack_hook
+    )
+
+
+def msg_unpack(data):
+    """msgpack bytes / memoryview -> value."""
+    return msgpack.unpackb(data, raw=False, ext_hook=_unpack_ext_hook)
+
+
+def json_pack(value) -> bytes:
+    return json.dumps(value).encode("utf8")
+
+
+def json_unpack(data):
+    return json.loads(data)
+
+
+def ensure_bytes(data) -> bytes:
+    return data.encode("utf8") if isinstance(data, str) else data
+
+
+def ensure_text(data) -> str:
+    return data.decode("utf8") if isinstance(data, bytes) else data
+
+
+def sha256_of(*parts):
+    h = hashlib.sha256()
+    for p in parts:
+        h.update(ensure_bytes(p))
+    return h
+
+
+def hexhash(*parts) -> str:
+    """Truncated (160-bit) hex sha256, e.g. legend ids. reference: serialise_util.py:88."""
+    return sha256_of(*parts).hexdigest()[:40]
+
+
+def b64hash(*parts) -> str:
+    """Truncated (160-bit) urlsafe-base64 sha256. reference: serialise_util.py:82."""
+    return base64.urlsafe_b64encode(sha256_of(*parts).digest()[:20]).decode("ascii")
+
+
+def uint32hash(*parts) -> int:
+    return struct.unpack(">I", sha256_of(*parts).digest()[:4])[0]
+
+
+def b64encode_str(data: bytes) -> str:
+    return base64.urlsafe_b64encode(data).decode("ascii")
+
+
+def b64decode_str(text: str) -> bytes:
+    return base64.urlsafe_b64decode(text)

@@ -1,0 +1,567 @@
+"""CRS handling (reference: kart/crs_util.py, kart/wkt_lexer.py).
+
+The reference delegates to OSR/PROJ. This rebuild is PROJ-free: a small WKT
+parser extracts authority identifiers and projection parameters, and the
+transforms needed by the spatial-filter / envelope-index hot paths (geographic
+<-> Transverse Mercator / Web Mercator on a WGS84/GRS80 ellipsoid) are
+implemented directly over numpy arrays — which makes batch envelope
+reprojection a single vectorized call instead of a per-feature OSR round trip.
+Datum shifts are not applied (modern datums are within ~1m of WGS84, and the
+envelope index pads by a buffer anyway — see kart_tpu/spatial_filter/index.py).
+"""
+
+import math
+import re
+
+import numpy as np
+
+
+class CrsError(ValueError):
+    pass
+
+
+# ---------------------------------------------------------------------------
+# WKT node parsing — WKT1 and WKT2 both have the shape NAME[arg, arg, ...]
+# ---------------------------------------------------------------------------
+
+
+class WktNode:
+    __slots__ = ("keyword", "args")
+
+    def __init__(self, keyword, args):
+        self.keyword = keyword
+        self.args = args
+
+    def find(self, *keywords, recursive=True):
+        """First descendant node with one of the given keywords (case-insensitive)."""
+        kws = {k.upper() for k in keywords}
+        for a in self.args:
+            if isinstance(a, WktNode):
+                if a.keyword.upper() in kws:
+                    return a
+                if recursive:
+                    found = a.find(*keywords)
+                    if found is not None:
+                        return found
+        return None
+
+    def find_all(self, *keywords):
+        kws = {k.upper() for k in keywords}
+        out = []
+        for a in self.args:
+            if isinstance(a, WktNode):
+                if a.keyword.upper() in kws:
+                    out.append(a)
+                out.extend(a.find_all(*keywords))
+        return out
+
+    def str_args(self):
+        return [a for a in self.args if isinstance(a, str)]
+
+    def num_args(self):
+        return [a for a in self.args if isinstance(a, (int, float))]
+
+    def __repr__(self):
+        return f"WktNode({self.keyword}, {self.args!r})"
+
+
+_WKT_TOKENS = re.compile(
+    r"""\s*(
+        "(?:[^"]|"")*"          # quoted string
+      | [A-Za-z_][A-Za-z0-9_]*  # keyword
+      | [-+]?[0-9]*\.?[0-9]+(?:[eE][-+]?[0-9]+)?  # number
+      | [\[\](),]
+    )""",
+    re.VERBOSE,
+)
+
+
+def parse_wkt_crs(wkt):
+    """WKT string -> WktNode tree. Accepts WKT1 and WKT2 ('[' or '(')."""
+    tokens = _WKT_TOKENS.findall(wkt)
+    if not tokens:
+        raise CrsError("Empty CRS definition")
+    node, pos = _parse_node(tokens, 0)
+    return node
+
+
+def _parse_node(tokens, pos):
+    keyword = tokens[pos]
+    pos += 1
+    if pos >= len(tokens) or tokens[pos] not in "[(":
+        return keyword, pos
+    pos += 1
+    args = []
+    while tokens[pos] not in ")]":
+        tok = tokens[pos]
+        if tok == ",":
+            pos += 1
+            continue
+        if tok.startswith('"'):
+            args.append(tok[1:-1].replace('""', '"'))
+            pos += 1
+        elif re.fullmatch(r"[-+]?[0-9]*\.?[0-9]+(?:[eE][-+]?[0-9]+)?", tok):
+            num = float(tok)
+            args.append(int(num) if num == int(num) and "." not in tok else num)
+            pos += 1
+        else:
+            child, pos = _parse_node(tokens, pos)
+            if isinstance(child, WktNode):
+                args.append(child)
+            else:
+                args.append(child)  # bare keyword (e.g. AXIS direction NORTH)
+    return WktNode(keyword, args), pos + 1
+
+
+def _write_node(node, indent=0, pretty=True):
+    if not isinstance(node, WktNode):
+        if isinstance(node, str):
+            escaped = node.replace('"', '""')
+            return f'"{escaped}"'
+        if isinstance(node, float) and node == int(node):
+            return str(node)
+        return repr(node) if isinstance(node, float) else str(node)
+    parts = [_write_node(a, indent + 1, pretty) for a in node.args]
+    if pretty and any(isinstance(a, WktNode) for a in node.args):
+        pad = "    " * (indent + 1)
+        inner = (",\n" + pad).join(parts)
+        return f"{node.keyword}[\n{pad}{inner}]"
+    return f"{node.keyword}[{', '.join(parts)}]"
+
+
+def normalise_wkt(wkt):
+    """Canonical whitespace/indentation form (reference: crs_util.py uses a
+    pygments lexer for the same purpose)."""
+    if not wkt or not wkt.strip():
+        return wkt
+    try:
+        return _write_node(parse_wkt_crs(wkt)) + "\n"
+    except Exception:
+        return wkt
+
+
+# ---------------------------------------------------------------------------
+# Authority identifiers & naming
+# ---------------------------------------------------------------------------
+
+
+def get_authority(wkt_or_node):
+    """-> (authority_name, code) from the outermost AUTHORITY/ID node, or
+    (None, None)."""
+    node = (
+        wkt_or_node
+        if isinstance(wkt_or_node, WktNode)
+        else parse_wkt_crs(wkt_or_node)
+    )
+    # The *last* top-level AUTHORITY node identifies the whole CRS in WKT1;
+    # nested ones identify datums/units. Search direct children first.
+    direct = [
+        a
+        for a in node.args
+        if isinstance(a, WktNode) and a.keyword.upper() in ("AUTHORITY", "ID")
+    ]
+    found = direct[-1] if direct else node.find("AUTHORITY", "ID")
+    if found is None:
+        return None, None
+    sargs = found.str_args() + [str(a) for a in found.num_args()]
+    if len(sargs) >= 2:
+        return sargs[0], sargs[1]
+    return None, None
+
+
+# Reserved code range for CRS with no real authority id
+# (reference: crs_util.py:151-153).
+MIN_CUSTOM_ID = 200000
+MAX_CUSTOM_ID = 209199
+_CUSTOM_RANGE = MAX_CUSTOM_ID - MIN_CUSTOM_ID + 1
+
+
+def _generate_identifier_int(crs):
+    """Stable custom code in [MIN_CUSTOM_ID, MAX_CUSTOM_ID], hashed from the
+    normalised WKT so whitespace variants agree (reference: crs_util.py:156-176)."""
+    from kart_tpu.core.serialise import uint32hash
+
+    text = crs if isinstance(crs, str) else _write_node(crs)
+    return MIN_CUSTOM_ID + uint32hash(normalise_wkt(text)) % _CUSTOM_RANGE
+
+
+def get_identifier_str(crs):
+    """Authority string like ``EPSG:4326``, or ``CUSTOM:<code>`` for CRS
+    without an authority. The custom code matches get_identifier_int
+    (reference: crs_util.py:102-110)."""
+    auth, code = get_authority(crs)
+    if auth and code:
+        return f"{auth}:{code}"
+    return f"CUSTOM:{_generate_identifier_int(crs)}"
+
+
+def get_identifier_int(crs):
+    """Integer id for srs_id fields: the authority code when known, else the
+    same stable custom code as get_identifier_str."""
+    auth, code = get_authority(crs)
+    if code is not None and str(code).isdigit():
+        return int(code)
+    return _generate_identifier_int(crs)
+
+
+def parse_name(crs):
+    node = crs if isinstance(crs, WktNode) else parse_wkt_crs(crs)
+    sargs = node.str_args()
+    return sargs[0] if sargs else None
+
+
+def parse_subcrs_name(wkt, keyword):
+    node = parse_wkt_crs(wkt).find(keyword)
+    if node is None:
+        return None
+    sargs = node.str_args()
+    return sargs[0] if sargs else None
+
+
+# ---------------------------------------------------------------------------
+# Well-known CRS definitions (no PROJ database available)
+# ---------------------------------------------------------------------------
+
+WGS84_WKT = (
+    'GEOGCS["WGS 84",DATUM["WGS_1984",SPHEROID["WGS 84",6378137,298.257223563,'
+    'AUTHORITY["EPSG","7030"]],AUTHORITY["EPSG","6326"]],'
+    'PRIMEM["Greenwich",0,AUTHORITY["EPSG","8901"]],'
+    'UNIT["degree",0.0174532925199433,AUTHORITY["EPSG","9122"]],'
+    'AUTHORITY["EPSG","4326"]]'
+)
+
+WEB_MERCATOR_WKT = (
+    'PROJCS["WGS 84 / Pseudo-Mercator",GEOGCS["WGS 84",DATUM["WGS_1984",'
+    'SPHEROID["WGS 84",6378137,298.257223563,AUTHORITY["EPSG","7030"]],'
+    'AUTHORITY["EPSG","6326"]],PRIMEM["Greenwich",0,AUTHORITY["EPSG","8901"]],'
+    'UNIT["degree",0.0174532925199433,AUTHORITY["EPSG","9122"]],'
+    'AUTHORITY["EPSG","4326"]],PROJECTION["Mercator_1SP"],'
+    'PARAMETER["central_meridian",0],PARAMETER["scale_factor",1],'
+    'PARAMETER["false_easting",0],PARAMETER["false_northing",0],'
+    'UNIT["metre",1,AUTHORITY["EPSG","9001"]],AUTHORITY["EPSG","3857"]]'
+)
+
+NZTM_WKT = (
+    'PROJCS["NZGD2000 / New Zealand Transverse Mercator 2000",'
+    'GEOGCS["NZGD2000",DATUM["New_Zealand_Geodetic_Datum_2000",'
+    'SPHEROID["GRS 1980",6378137,298.257222101,AUTHORITY["EPSG","7019"]],'
+    'AUTHORITY["EPSG","6167"]],PRIMEM["Greenwich",0,AUTHORITY["EPSG","8901"]],'
+    'UNIT["degree",0.0174532925199433,AUTHORITY["EPSG","9122"]],'
+    'AUTHORITY["EPSG","4167"]],PROJECTION["Transverse_Mercator"],'
+    'PARAMETER["latitude_of_origin",0],PARAMETER["central_meridian",173],'
+    'PARAMETER["scale_factor",0.9996],PARAMETER["false_easting",1600000],'
+    'PARAMETER["false_northing",10000000],UNIT["metre",1,'
+    'AUTHORITY["EPSG","9001"]],AUTHORITY["EPSG","2193"]]'
+)
+
+NZGD2000_WKT = (
+    'GEOGCS["NZGD2000",DATUM["New_Zealand_Geodetic_Datum_2000",'
+    'SPHEROID["GRS 1980",6378137,298.257222101,AUTHORITY["EPSG","7019"]],'
+    'AUTHORITY["EPSG","6167"]],PRIMEM["Greenwich",0,AUTHORITY["EPSG","8901"]],'
+    'UNIT["degree",0.0174532925199433,AUTHORITY["EPSG","9122"]],'
+    'AUTHORITY["EPSG","4167"]]'
+)
+
+_WELL_KNOWN = {
+    4326: WGS84_WKT,
+    3857: WEB_MERCATOR_WKT,
+    2193: NZTM_WKT,
+    4167: NZGD2000_WKT,
+}
+
+
+def make_crs(user_input):
+    """User input (WKT, 'EPSG:n') -> CRS object (reference: crs_util.py:17-32)."""
+    if isinstance(user_input, CRS):
+        return user_input
+    text = user_input.strip()
+    m = re.fullmatch(r"(?i)EPSG:(\d+)", text)
+    if m:
+        code = int(m.group(1))
+        # UTM zones: EPSG 326xx (N) / 327xx (S)
+        if code in _WELL_KNOWN:
+            return CRS(_WELL_KNOWN[code])
+        if 32601 <= code <= 32660 or 32701 <= code <= 32760:
+            return CRS(_utm_wkt(code))
+        raise CrsError(
+            f"EPSG:{code} is not in the built-in CRS registry; "
+            f"supply the full WKT definition instead"
+        )
+    return CRS(text)
+
+
+def _utm_wkt(epsg):
+    zone = epsg % 100
+    south = epsg // 100 == 327
+    cm = -183 + 6 * zone
+    fn = 10000000 if south else 0
+    hemi = "S" if south else "N"
+    return (
+        f'PROJCS["WGS 84 / UTM zone {zone}{hemi}",{WGS84_WKT},'
+        f'PROJECTION["Transverse_Mercator"],'
+        f'PARAMETER["latitude_of_origin",0],PARAMETER["central_meridian",{cm}],'
+        f'PARAMETER["scale_factor",0.9996],PARAMETER["false_easting",500000],'
+        f'PARAMETER["false_northing",{fn}],UNIT["metre",1],'
+        f'AUTHORITY["EPSG","{epsg}"]]'
+    )
+
+
+class CRS:
+    """A parsed CRS: enough structure to identify it and to run the built-in
+    transforms. Unknown projections parse fine but refuse to transform."""
+
+    def __init__(self, wkt):
+        self.wkt = wkt
+        self.node = parse_wkt_crs(wkt)
+        kw = self.node.keyword.upper()
+        self.is_geographic = kw in ("GEOGCS", "GEOGCRS", "GEODCRS")
+        self.is_projected = kw in ("PROJCS", "PROJCRS")
+        self.name = parse_name(self.node)
+        self.authority, self.code = get_authority(self.node)
+
+        sph = self.node.find("SPHEROID", "ELLIPSOID")
+        if sph is not None:
+            nums = sph.num_args()
+            self.semi_major = float(nums[0]) if nums else 6378137.0
+            inv_f = float(nums[1]) if len(nums) > 1 else 298.257223563
+            self.inv_flattening = inv_f
+        else:
+            self.semi_major, self.inv_flattening = 6378137.0, 298.257223563
+
+        self.projection = None
+        self.params = {}
+        if self.is_projected:
+            proj = self.node.find("PROJECTION")
+            if proj is not None:
+                sargs = proj.str_args()
+                self.projection = sargs[0] if sargs else None
+            for p in self.node.find_all("PARAMETER"):
+                sargs = p.str_args()
+                nums = p.num_args()
+                if sargs and nums:
+                    self.params[sargs[0].lower()] = float(nums[0])
+
+    @property
+    def identifier_str(self):
+        return get_identifier_str(self.node)
+
+    @property
+    def identifier_int(self):
+        return get_identifier_int(self.node)
+
+    def __eq__(self, other):
+        return isinstance(other, CRS) and normalise_wkt(self.wkt) == normalise_wkt(
+            other.wkt
+        )
+
+    def __hash__(self):
+        return hash(normalise_wkt(self.wkt))
+
+    def __repr__(self):
+        return f"CRS({self.identifier_str} {self.name!r})"
+
+
+# ---------------------------------------------------------------------------
+# Transforms (vectorized numpy)
+# ---------------------------------------------------------------------------
+
+
+def _tm_constants(a, inv_f):
+    f = 1.0 / inv_f
+    e2 = f * (2 - f)
+    n = f / (2 - f)
+    # series coefficients for the Krueger transverse mercator (order n^4)
+    A = a / (1 + n) * (1 + n**2 / 4 + n**4 / 64)
+    alpha = np.array(
+        [
+            n / 2 - 2 * n**2 / 3 + 5 * n**3 / 16 + 41 * n**4 / 180,
+            13 * n**2 / 48 - 3 * n**3 / 5 + 557 * n**4 / 1440,
+            61 * n**3 / 240 - 103 * n**4 / 140,
+            49561 * n**4 / 161280,
+        ]
+    )
+    beta = np.array(
+        [
+            n / 2 - 2 * n**2 / 3 - 37 * n**3 / 96 + 1 * n**4 / 360,
+            1 * n**2 / 48 + 1 * n**3 / 15 - 437 * n**4 / 1440,
+            17 * n**3 / 480 - 37 * n**4 / 840,
+            4397 * n**4 / 161280,
+        ]
+    )
+    delta = np.array(
+        [
+            2 * n - 2 * n**2 / 3 - 2 * n**3 + 116 * n**4 / 45,
+            7 * n**2 / 3 - 8 * n**3 / 5 - 227 * n**4 / 45,
+            56 * n**3 / 15 - 136 * n**4 / 35,
+            4279 * n**4 / 630,
+        ]
+    )
+    return e2, A, alpha, beta, delta
+
+
+def _tm_forward(crs, lon_deg, lat_deg):
+    a, inv_f = crs.semi_major, crs.inv_flattening
+    e2, A, alpha, _, _ = _tm_constants(a, inv_f)
+    e = math.sqrt(e2)
+    k0 = crs.params.get("scale_factor", 1.0)
+    lat0 = math.radians(crs.params.get("latitude_of_origin", 0.0))
+    lon0 = math.radians(crs.params.get("central_meridian", 0.0))
+    fe = crs.params.get("false_easting", 0.0)
+    fn = crs.params.get("false_northing", 0.0)
+
+    lon = np.radians(np.asarray(lon_deg, dtype=np.float64))
+    lat = np.radians(np.asarray(lat_deg, dtype=np.float64))
+
+    # conformal latitude
+    t = np.sinh(
+        np.arctanh(np.sin(lat)) - e * np.arctanh(e * np.sin(lat))
+    )
+    xi_p = np.arctan2(t, np.cos(lon - lon0))
+    eta_p = np.arctanh(np.sin(lon - lon0) / np.sqrt(1 + t**2))
+
+    j = np.arange(1, 5)
+    xi = xi_p + np.sum(
+        alpha[None, :]
+        * np.sin(2 * j[None, :] * xi_p[..., None])
+        * np.cosh(2 * j[None, :] * eta_p[..., None]),
+        axis=-1,
+    )
+    eta = eta_p + np.sum(
+        alpha[None, :]
+        * np.cos(2 * j[None, :] * xi_p[..., None])
+        * np.sinh(2 * j[None, :] * eta_p[..., None]),
+        axis=-1,
+    )
+
+    # meridian distance from equator to lat0
+    if lat0 != 0.0:
+        t0 = math.sinh(
+            math.atanh(math.sin(lat0)) - e * math.atanh(e * math.sin(lat0))
+        )
+        xi0 = math.atan2(t0, 1.0)
+        m0 = A * (
+            xi0
+            + float(np.sum(alpha * np.sin(2 * np.arange(1, 5) * xi0)))
+        )
+    else:
+        m0 = 0.0
+
+    x = fe + k0 * A * eta
+    y = fn + k0 * (A * xi - m0)
+    return x, y
+
+
+def _tm_inverse(crs, x, y):
+    a, inv_f = crs.semi_major, crs.inv_flattening
+    e2, A, alpha, beta, delta = _tm_constants(a, inv_f)
+    e = math.sqrt(e2)
+    k0 = crs.params.get("scale_factor", 1.0)
+    lat0 = math.radians(crs.params.get("latitude_of_origin", 0.0))
+    lon0 = math.radians(crs.params.get("central_meridian", 0.0))
+    fe = crs.params.get("false_easting", 0.0)
+    fn = crs.params.get("false_northing", 0.0)
+
+    if lat0 != 0.0:
+        t0 = math.sinh(
+            math.atanh(math.sin(lat0)) - e * math.atanh(e * math.sin(lat0))
+        )
+        xi0 = math.atan2(t0, 1.0)
+        m0 = A * (xi0 + float(np.sum(alpha * np.sin(2 * np.arange(1, 5) * xi0))))
+    else:
+        m0 = 0.0
+
+    x = np.asarray(x, dtype=np.float64)
+    y = np.asarray(y, dtype=np.float64)
+    xi = (y - fn + k0 * m0) / (k0 * A)
+    eta = (x - fe) / (k0 * A)
+
+    j = np.arange(1, 5)
+    xi_p = xi - np.sum(
+        beta[None, :]
+        * np.sin(2 * j[None, :] * xi[..., None])
+        * np.cosh(2 * j[None, :] * eta[..., None]),
+        axis=-1,
+    )
+    eta_p = eta - np.sum(
+        beta[None, :]
+        * np.cos(2 * j[None, :] * xi[..., None])
+        * np.sinh(2 * j[None, :] * eta[..., None]),
+        axis=-1,
+    )
+    chi = np.arcsin(np.sin(xi_p) / np.cosh(eta_p))
+    lat = chi + np.sum(
+        delta[None, :] * np.sin(2 * j[None, :] * chi[..., None]), axis=-1
+    )
+    lon = lon0 + np.arctan2(np.sinh(eta_p), np.cos(xi_p))
+    return np.degrees(lon), np.degrees(lat)
+
+
+def _webmerc_forward(crs, lon_deg, lat_deg):
+    a = crs.semi_major
+    lon = np.radians(np.asarray(lon_deg, dtype=np.float64))
+    lat = np.radians(np.clip(np.asarray(lat_deg, dtype=np.float64), -89.9999, 89.9999))
+    return a * lon, a * np.log(np.tan(np.pi / 4 + lat / 2))
+
+
+def _webmerc_inverse(crs, x, y):
+    a = crs.semi_major
+    lon = np.degrees(np.asarray(x, dtype=np.float64) / a)
+    lat = np.degrees(2 * np.arctan(np.exp(np.asarray(y, dtype=np.float64) / a)) - np.pi / 2)
+    return lon, lat
+
+
+_PROJ_IMPLS = {
+    "transverse_mercator": (_tm_forward, _tm_inverse),
+    "mercator_1sp": (_webmerc_forward, _webmerc_inverse),
+    "popular_visualisation_pseudo_mercator": (_webmerc_forward, _webmerc_inverse),
+}
+
+
+class Transform:
+    """Vectorized coordinate transform between two CRS (datum shifts ignored)."""
+
+    def __init__(self, src, dst):
+        self.src = make_crs(src) if not isinstance(src, CRS) else src
+        self.dst = make_crs(dst) if not isinstance(dst, CRS) else dst
+        self.is_identity = normalise_wkt(self.src.wkt) == normalise_wkt(self.dst.wkt)
+
+    def _impl(self, crs):
+        if crs.is_geographic:
+            return None
+        name = (crs.projection or "").lower()
+        impl = _PROJ_IMPLS.get(name)
+        if impl is None:
+            raise CrsError(
+                f"Projection {crs.projection!r} is not supported by the built-in "
+                f"transform engine"
+            )
+        return impl
+
+    def transform(self, xs, ys):
+        """(xs, ys) arrays in src CRS -> (xs, ys) in dst CRS."""
+        xs = np.asarray(xs, dtype=np.float64)
+        ys = np.asarray(ys, dtype=np.float64)
+        if self.is_identity:
+            return xs, ys
+        src_impl = self._impl(self.src)
+        dst_impl = self._impl(self.dst)
+        if src_impl is not None:
+            xs, ys = src_impl[1](self.src, xs, ys)  # -> lon/lat
+        if dst_impl is not None:
+            xs, ys = dst_impl[0](self.dst, xs, ys)  # lon/lat -> projected
+        return xs, ys
+
+    def transform_envelope(self, env, densify=5):
+        """(min-x, max-x, min-y, max-y) -> transformed envelope, densifying
+        each edge so curvature is captured (reference:
+        spatial_filter/index.py transforms envelopes the same way)."""
+        x0, x1, y0, y1 = env
+        t = np.linspace(0.0, 1.0, densify)
+        xs = np.concatenate(
+            [x0 + (x1 - x0) * t, np.full(densify, x1), x1 + (x0 - x1) * t, np.full(densify, x0)]
+        )
+        ys = np.concatenate(
+            [np.full(densify, y0), y0 + (y1 - y0) * t, np.full(densify, y1), y1 + (y0 - y1) * t]
+        )
+        tx, ty = self.transform(xs, ys)
+        return (float(tx.min()), float(tx.max()), float(ty.min()), float(ty.max()))

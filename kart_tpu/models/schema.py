@@ -1,0 +1,523 @@
+"""Table schemas, column metadata and legends (reference: kart/schema.py).
+
+``meta/schema.json`` holds an ordered JSON list of column dicts
+(``{id, name, dataType, primaryKeyIndex?, ...extra}``). A *legend* is the
+minimal header needed to decode a stored row: two tuples of column ids (pk
+columns / non-pk columns); feature blobs name their legend by its truncated
+sha256 so that old rows stay readable after schema changes.
+"""
+
+import hashlib
+import re
+import uuid
+from dataclasses import dataclass, field
+
+from kart_tpu.core.serialise import (
+    hexhash,
+    json_pack,
+    json_unpack,
+    msg_pack,
+    msg_unpack,
+    sha256_of,
+)
+from kart_tpu.geometry import Geometry
+
+ALL_DATA_TYPES = frozenset(
+    {
+        "boolean",
+        "blob",
+        "date",
+        "float",
+        "geometry",
+        "integer",
+        "interval",
+        "numeric",
+        "text",
+        "time",
+        "timestamp",
+    }
+)
+
+# Python types a stored (msgpack) value may legitimately have, per data type.
+_STORED_PY_TYPES = {
+    "boolean": (bool,),
+    "blob": (bytes,),
+    "date": (str,),
+    "float": (float, int),
+    "geometry": (Geometry,),
+    "integer": (int,),
+    "interval": (str,),
+    "numeric": (str,),
+    "text": (str,),
+    "time": (str,),
+    "timestamp": (str,),
+}
+
+
+class Legend:
+    """Decoder header for stored rows: (pk column ids, non-pk column ids).
+    Serialised as msgpack of the two tuples; identified by truncated-sha256
+    (reference: kart/schema.py:19-102)."""
+
+    __slots__ = ("_pk_columns", "_non_pk_columns")
+
+    def __init__(self, pk_columns, non_pk_columns):
+        self._pk_columns = tuple(pk_columns)
+        self._non_pk_columns = tuple(non_pk_columns)
+
+    @property
+    def pk_columns(self):
+        return self._pk_columns
+
+    @property
+    def non_pk_columns(self):
+        return self._non_pk_columns
+
+    @classmethod
+    def loads(cls, data):
+        pk_cols, non_pk_cols = msg_unpack(data)
+        return cls(pk_cols, non_pk_cols)
+
+    def dumps(self):
+        return msg_pack((self._pk_columns, self._non_pk_columns))
+
+    def hexhash(self):
+        return hexhash(self.dumps())
+
+    def to_raw_dict(self, pk_values, non_pk_values):
+        assert len(pk_values) == len(self._pk_columns)
+        assert len(non_pk_values) == len(self._non_pk_columns)
+        out = dict(zip(self._pk_columns, pk_values))
+        out.update(zip(self._non_pk_columns, non_pk_values))
+        return out
+
+    def to_value_tuples(self, raw_dict):
+        return (
+            tuple(raw_dict[c] for c in self._pk_columns),
+            tuple(raw_dict[c] for c in self._non_pk_columns),
+        )
+
+    def __eq__(self, other):
+        return (
+            isinstance(other, Legend)
+            and self._pk_columns == other._pk_columns
+            and self._non_pk_columns == other._non_pk_columns
+        )
+
+    def __hash__(self):
+        return hash((self._pk_columns, self._non_pk_columns))
+
+
+@dataclass(frozen=True)
+class ColumnSchema:
+    """One column: stable id (survives rename/reorder), name, data type,
+    pk position (None for non-pk), and type-specific extras."""
+
+    id: str
+    name: str
+    data_type: str
+    pk_index: object = None
+    extra_type_info: dict = field(default_factory=dict)
+
+    def __post_init__(self):
+        assert self.data_type in ALL_DATA_TYPES, self.data_type
+
+    @staticmethod
+    def new_id():
+        return str(uuid.uuid4())
+
+    @staticmethod
+    def deterministic_id(*parts):
+        return str(uuid.UUID(bytes=sha256_of(*parts).digest()[:16]))
+
+    @classmethod
+    def from_dict(cls, d):
+        d = dict(d)
+        return cls(
+            id=d.pop("id"),
+            name=d.pop("name"),
+            data_type=d.pop("dataType"),
+            pk_index=d.pop("primaryKeyIndex", None),
+            extra_type_info={k: v for k, v in d.items() if v is not None},
+        )
+
+    def to_dict(self):
+        out = {"id": self.id, "name": self.name, "dataType": self.data_type}
+        if self.pk_index is not None:
+            out["primaryKeyIndex"] = self.pk_index
+        out.update((k, v) for k, v in self.extra_type_info.items() if v is not None)
+        return out
+
+    def with_id(self, new_id):
+        return ColumnSchema(
+            new_id, self.name, self.data_type, self.pk_index, dict(self.extra_type_info)
+        )
+
+    def __hash__(self):
+        return hash(
+            (
+                self.id,
+                self.name,
+                self.data_type,
+                self.pk_index,
+                frozenset(self.extra_type_info.items()),
+            )
+        )
+
+
+def _pk_ordering(col):
+    return col.pk_index if col.pk_index is not None else float("inf")
+
+
+class Schema:
+    """Immutable ordered list of ColumnSchemas (reference: kart/schema.py:201)."""
+
+    def __init__(self, columns):
+        self._columns = tuple(columns)
+        self._legend = self._build_legend()
+        # The legend hash names every feature blob this schema writes — cache
+        # it once here rather than re-hashing per feature in the import loop.
+        self._legend_hash = self._legend.hexhash()
+        self._pk_columns = tuple(
+            c
+            for c in sorted(self._columns, key=_pk_ordering)
+            if c.pk_index is not None
+        )
+
+    def _build_legend(self):
+        pk_ids, non_pk_ids = [], []
+        for i, col in enumerate(sorted(self._columns, key=_pk_ordering)):
+            if col.pk_index is not None:
+                if i != col.pk_index:
+                    raise ValueError(
+                        f"Expected contiguous primaryKeyIndex {i} but found {col.pk_index}"
+                    )
+                pk_ids.append(col.id)
+            else:
+                non_pk_ids.append(col.id)
+        return Legend(pk_ids, non_pk_ids)
+
+    # -- basic accessors ---------------------------------------------------
+
+    @property
+    def columns(self):
+        return self._columns
+
+    @property
+    def column_names(self):
+        return [c.name for c in self._columns]
+
+    @property
+    def legend(self):
+        return self._legend
+
+    @property
+    def pk_columns(self):
+        return self._pk_columns
+
+    @property
+    def non_pk_columns(self):
+        return tuple(c for c in self._columns if c.pk_index is None)
+
+    @property
+    def geometry_columns(self):
+        return tuple(c for c in self._columns if c.data_type == "geometry")
+
+    @property
+    def has_geometry(self):
+        return bool(self.geometry_columns)
+
+    @property
+    def first_geometry_column(self):
+        cols = self.geometry_columns
+        return cols[0] if cols else None
+
+    def __iter__(self):
+        return iter(self._columns)
+
+    def __len__(self):
+        return len(self._columns)
+
+    def __getitem__(self, key):
+        if isinstance(key, str):
+            for c in self._columns:
+                if c.id == key:
+                    return c
+            raise KeyError(f"No such column: {key}")
+        return self._columns[key]
+
+    def get_by_name(self, name):
+        for c in self._columns:
+            if c.name == name:
+                return c
+        return None
+
+    def __contains__(self, col_id):
+        return any(c.id == col_id for c in self._columns)
+
+    def __eq__(self, other):
+        return isinstance(other, Schema) and self._columns == other._columns
+
+    def __hash__(self):
+        return hash(self._columns)
+
+    def __repr__(self):
+        cols = ",\n  ".join(repr(c) for c in self._columns)
+        return f"Schema([\n  {cols}\n])"
+
+    # -- (de)serialisation -------------------------------------------------
+
+    @classmethod
+    def from_column_dicts(cls, column_dicts):
+        return cls([ColumnSchema.from_dict(d) for d in column_dicts])
+
+    @classmethod
+    def loads(cls, data):
+        return cls.from_column_dicts(json_unpack(data))
+
+    def to_column_dicts(self):
+        return [c.to_dict() for c in self._columns]
+
+    def dumps(self):
+        return json_pack(self.to_column_dicts())
+
+    @classmethod
+    def normalise_column_dicts(cls, column_dicts):
+        return cls.from_column_dicts(column_dicts).to_column_dicts()
+
+    # -- row conversion ----------------------------------------------------
+
+    def feature_from_raw_dict(self, raw_dict):
+        """column-id-keyed dict -> column-name-keyed dict (schema order)."""
+        return {c.name: raw_dict.get(c.id) for c in self._columns}
+
+    def feature_to_raw_dict(self, feature):
+        """name-keyed dict or schema-ordered sequence -> column-id-keyed dict."""
+        if isinstance(feature, dict) or hasattr(feature, "keys"):
+            return {c.id: feature[c.name] for c in self._columns}
+        assert len(feature) == len(self._columns)
+        return {c.id: v for c, v in zip(self._columns, feature)}
+
+    @property
+    def legend_hash(self):
+        return self._legend_hash
+
+    def encode_feature_blob(self, feature):
+        """Feature -> stored blob bytes ``msgpack([legend-hexhash, non-pk-values])``
+        (reference: kart/dataset3.py:42-69; pk values live in the blob path)."""
+        raw = self.feature_to_raw_dict(feature)
+        pk_values, non_pk_values = self._legend.to_value_tuples(raw)
+        return pk_values, msg_pack([self._legend_hash, non_pk_values])
+
+    def encode_feature(self, feature, without_pk=False):
+        """Self-contained binary form (used for content-hashing a feature,
+        e.g. rename detection). reference: kart/schema.py:314-328."""
+        raw = self.feature_to_raw_dict(feature)
+        pk_values, non_pk_values = self._legend.to_value_tuples(raw)
+        legend_hash = self._legend_hash
+        data = (
+            [legend_hash, non_pk_values]
+            if without_pk
+            else [legend_hash, pk_values, non_pk_values]
+        )
+        return msg_pack(data)
+
+    def hash_feature(self, feature, without_pk=False):
+        """git-style blob hash of the encoded feature."""
+        data = self.encode_feature(feature, without_pk=without_pk)
+        h = hashlib.sha1(b"blob %d\x00" % len(data))
+        h.update(data)
+        return h.hexdigest()
+
+    def sanitise_pks(self, pk_values):
+        """Coerce user-supplied pk text to typed values; always a tuple."""
+        if not isinstance(pk_values, (list, tuple)):
+            pk_values = [pk_values]
+        pk_values = list(pk_values)
+        for i, (value, col) in enumerate(zip(pk_values, self._pk_columns)):
+            if isinstance(value, str):
+                if col.data_type == "integer":
+                    pk_values[i] = int(value)
+                elif col.data_type == "float":
+                    pk_values[i] = float(value)
+        return tuple(pk_values)
+
+    # -- schema comparison / alignment -------------------------------------
+
+    def is_pk_compatible(self, other):
+        """False when a schema change forces every feature onto a new path."""
+        return self._legend.pk_columns == other.legend.pk_columns
+
+    def diff_types(self, new_schema):
+        """Classify column changes between self and new_schema
+        (reference: kart/schema.py:451-495)."""
+        old_ids_list = [c.id for c in self]
+        new_ids_list = [c.id for c in new_schema]
+        old_ids, new_ids = set(old_ids_list), set(new_ids_list)
+
+        result = {
+            "inserts": new_ids - old_ids,
+            "deletes": old_ids - new_ids,
+            "position_updates": set(),
+            "name_updates": set(),
+            "type_updates": set(),
+            "pk_updates": set(),
+        }
+        for new_index, new_col in enumerate(new_schema):
+            if new_col.id not in old_ids:
+                continue
+            old_col = self[new_col.id]
+            if old_ids_list.index(new_col.id) != new_index:
+                result["position_updates"].add(new_col.id)
+            if old_col.name != new_col.name:
+                result["name_updates"].add(new_col.id)
+            if (
+                old_col.data_type != new_col.data_type
+                or old_col.extra_type_info != new_col.extra_type_info
+            ):
+                result["type_updates"].add(new_col.id)
+            if old_col.pk_index != new_col.pk_index:
+                result["pk_updates"].add(new_col.id)
+        return result
+
+    def diff_type_counts(self, new_schema):
+        return {k: len(v) for k, v in self.diff_types(new_schema).items()}
+
+    def align_to_self(self, new_schema, roundtrip_ctx=None):
+        """Copy our column ids onto matching columns of a schema that came back
+        from a working-copy DB (which doesn't preserve ids). Matching is
+        heuristic: same name+compatible type, then same position+compatible
+        type (reference: kart/schema.py:386-449)."""
+        ctx = roundtrip_ctx or DefaultRoundtripContext
+        old_cols = self.to_column_dicts()
+        new_cols = new_schema.to_column_dicts()
+        aligned_old, aligned_new = set(), set()
+
+        def try_align(oi, ni):
+            if oi is None or ni is None or oi in aligned_old or ni in aligned_new:
+                return
+            old_d, new_d = old_cols[oi], new_cols[ni]
+            if old_d.get("primaryKeyIndex") != new_d.get("primaryKeyIndex"):
+                return
+            if ctx.try_align_schema_col(old_d, new_d):
+                new_d["id"] = old_d["id"]
+                aligned_old.add(oi)
+                aligned_new.add(ni)
+
+        by_name = {d["name"]: i for i, d in enumerate(old_cols)}
+        for ni, new_d in enumerate(new_cols):
+            try_align(by_name.get(new_d["name"]), ni)
+        for i in range(min(len(old_cols), len(new_cols))):
+            try_align(i, i)
+        return Schema.from_column_dicts(new_cols)
+
+    # -- feature validation -------------------------------------------------
+
+    def validate_feature(self, feature, col_violations=None):
+        """True when every value fits its column type. When ``col_violations``
+        (a dict) is given, record one example violation per column name
+        (reference: kart/schema.py:513-543)."""
+        if col_violations is None:
+            return all(
+                self.find_column_violation(c, feature.get(c.name)) is None
+                for c in self._columns
+            )
+        ok = not col_violations
+        for col in self._columns:
+            if col.name in col_violations:
+                ok = False
+                continue
+            violation = self.find_column_violation(col, feature.get(col.name))
+            if violation is not None:
+                col_violations[col.name] = violation
+                ok = False
+        return ok
+
+    def find_column_violation(self, col, value):
+        if value is None:
+            return None
+        if type(value) not in _STORED_PY_TYPES[col.data_type]:
+            return (
+                f"In column '{col.name}' value {value!r} doesn't match schema type "
+                f"{col.data_type}"
+            )
+        checker = getattr(self, f"_check_{col.data_type}", None)
+        return checker(col, value) if checker else None
+
+    @staticmethod
+    def _check_integer(col, value):
+        size = col.extra_type_info.get("size")
+        if not size:
+            return None
+        bits = (value + 1).bit_length() + 1 if value < 0 else value.bit_length() + 1
+        if bits > size:
+            bound = 2 ** (size - 1)
+            return (
+                f"In column '{col.name}' value {value!r} does not fit into an "
+                f"int{size}: {-bound} to {bound - 1}"
+            )
+
+    @staticmethod
+    def _check_text(col, value):
+        length = col.extra_type_info.get("length")
+        if length and len(value) > length:
+            shown = value if len(value) <= 100 else value[:40] + "....." + value[-40:]
+            return (
+                f"In column '{col.name}' value {shown!r} exceeds limit of "
+                f"{length} characters"
+            )
+
+    @staticmethod
+    def _check_blob(col, value):
+        length = col.extra_type_info.get("length")
+        if length and len(value) > length:
+            shown = value if len(value) <= 100 else value[:40] + b"....." + value[-40:]
+            return (
+                f"In column '{col.name}' value {shown!r} exceeds limit of "
+                f"{length} bytes"
+            )
+
+    @staticmethod
+    def _check_date(col, value):
+        if not re.fullmatch(r"\d{4}-\d{2}-\d{2}", value):
+            return (
+                f"In column '{col.name}' value {value!r} is not an ISO 8601 date "
+                f"ie YYYY-MM-DD"
+            )
+
+    @staticmethod
+    def _check_time(col, value):
+        if not re.fullmatch(r"\d{2}:\d{2}:\d{2}(\.\d+)?Z?", value):
+            return (
+                f"In column '{col.name}' value {value!r} is not an ISO 8601 time "
+                f"ie hh:mm:ss.ssss"
+            )
+
+    @staticmethod
+    def _check_timestamp(col, value):
+        if not re.fullmatch(r"\d{4}-\d{2}-\d{2}T\d{2}:\d{2}:\d{2}(\.\d+)?Z?", value):
+            return (
+                f"In column '{col.name}' value {value!r} is not an ISO 8601 UTC "
+                f"datetime ie YYYY-MM-DDThh:mm:ss.ssss"
+            )
+
+    _INTERVAL_RE = re.compile(
+        r"P(\d+Y)?(\d+M)?(\d+W)?(\d+D)?(T(\d+H)?(\d+M)?(\d+(\.\d+)?S)?)?"
+    )
+
+    @classmethod
+    def _check_interval(cls, col, value):
+        if not cls._INTERVAL_RE.fullmatch(value):
+            return (
+                f"In column '{col.name}' value {value!r} is not an ISO 8601 "
+                f"duration ie PxYxMxDTxHxMxS"
+            )
+
+
+class DefaultRoundtripContext:
+    """Column-alignment policy when no lossy storage roundtrip is involved:
+    columns can only be 'the same' if their data type is unchanged."""
+
+    @classmethod
+    def try_align_schema_col(cls, old_col_dict, new_col_dict):
+        return new_col_dict["dataType"] == old_col_dict["dataType"]

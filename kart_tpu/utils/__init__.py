@@ -1,0 +1,53 @@
+"""Small shared helpers (reference: kart/utils.py)."""
+
+import functools
+import itertools
+
+
+def chunked(iterable, size):
+    """Yield successive lists of up to `size` items from `iterable`."""
+    it = iter(iterable)
+    while True:
+        block = list(itertools.islice(it, size))
+        if not block:
+            return
+        yield block
+
+
+def materialised(generator_fn_or_type):
+    """Decorator: call the generator function and materialise it into the given
+    container type (default list). Usage:
+
+        @materialised          # -> list
+        @materialised(dict)    # -> dict
+    """
+    if isinstance(generator_fn_or_type, type):
+        container = generator_fn_or_type
+
+        def deco(fn):
+            @functools.wraps(fn)
+            def wrapper(*args, **kwargs):
+                return container(fn(*args, **kwargs))
+
+            return wrapper
+
+        return deco
+
+    fn = generator_fn_or_type
+
+    @functools.wraps(fn)
+    def wrapper(*args, **kwargs):
+        return list(fn(*args, **kwargs))
+
+    return wrapper
+
+
+def classproperty(fn):
+    class _ClassProperty:
+        def __init__(self, getter):
+            self.getter = getter
+
+        def __get__(self, obj, owner):
+            return self.getter(owner)
+
+    return _ClassProperty(fn)

@@ -1,0 +1,34 @@
+"""Test configuration.
+
+Tests run on a virtual 8-device CPU mesh (multi-chip TPU hardware is not
+available in CI): XLA_FLAGS must be set before jax initialises. The TPU
+kernels are written to be platform-polymorphic, and the CPU path is
+bit-compatible with the device path, so known-answer tests validate both
+(reference test strategy: SURVEY.md §4).
+"""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+import pathlib
+import shutil
+
+import pytest
+
+
+@pytest.fixture
+def tmp_repo_path(tmp_path):
+    return tmp_path / "repo"
+
+
+@pytest.fixture
+def cli_runner():
+    from click.testing import CliRunner
+
+    return CliRunner()

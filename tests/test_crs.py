@@ -1,0 +1,92 @@
+import numpy as np
+import pytest
+
+from kart_tpu.crs import (
+    CRS,
+    NZTM_WKT,
+    WGS84_WKT,
+    Transform,
+    get_identifier_int,
+    get_identifier_str,
+    make_crs,
+    normalise_wkt,
+    parse_name,
+)
+
+
+def test_parse_wgs84():
+    crs = make_crs("EPSG:4326")
+    assert crs.is_geographic
+    assert crs.authority == "EPSG"
+    assert crs.code == "4326"
+    assert parse_name(crs.wkt) == "WGS 84"
+    assert get_identifier_str(crs.wkt) == "EPSG:4326"
+    assert get_identifier_int(crs.wkt) == 4326
+
+
+def test_parse_nztm():
+    crs = CRS(NZTM_WKT)
+    assert crs.is_projected
+    assert crs.projection == "Transverse_Mercator"
+    assert crs.params["central_meridian"] == 173.0
+    assert crs.identifier_int == 2193
+
+
+def test_normalise_wkt_stable():
+    n1 = normalise_wkt(WGS84_WKT)
+    assert normalise_wkt(n1) == n1
+
+
+def test_nztm_known_point():
+    # The projection origin maps to (false_easting, false_northing).
+    t = Transform("EPSG:4326", "EPSG:2193")
+    x, y = t.transform(np.array([173.0]), np.array([0.0]))
+    assert abs(x[0] - 1600000.0) < 1e-3
+    assert abs(y[0] - 10000000.0) < 1e-3
+
+    # Wellington (EPSG registry test point accuracy ~1mm for Krueger series)
+    x, y = t.transform(np.array([174.7772239]), np.array([-41.2887639]))
+    assert abs(x[0] - 1748795.0) < 200.0  # sanity envelope
+    assert abs(y[0] - 5427717.0) < 200.0
+
+
+def test_tm_roundtrip():
+    t = Transform("EPSG:4326", "EPSG:2193")
+    inv = Transform("EPSG:2193", "EPSG:4326")
+    lons = np.linspace(166.0, 179.0, 20)
+    lats = np.linspace(-47.0, -34.0, 20)
+    x, y = t.transform(lons, lats)
+    lon2, lat2 = inv.transform(x, y)
+    np.testing.assert_allclose(lon2, lons, atol=1e-9)
+    np.testing.assert_allclose(lat2, lats, atol=1e-9)
+
+
+def test_web_mercator():
+    t = Transform("EPSG:4326", "EPSG:3857")
+    x, y = t.transform(np.array([1.0]), np.array([0.0]))
+    assert abs(x[0] - 111319.49079327358) < 1e-6
+    assert abs(y[0]) < 1e-6
+
+
+def test_identity_transform():
+    t = Transform("EPSG:4326", "EPSG:4326")
+    assert t.is_identity
+    xs, ys = t.transform(np.array([1.0]), np.array([2.0]))
+    assert xs[0] == 1.0 and ys[0] == 2.0
+
+
+def test_transform_envelope():
+    t = Transform("EPSG:2193", "EPSG:4326")
+    env = t.transform_envelope((1500000, 1700000, 5300000, 5500000))
+    # roughly central New Zealand
+    assert 171 < env[0] < env[1] < 176
+    assert -43 < env[2] < env[3] < -40
+
+
+def test_utm():
+    crs = make_crs("EPSG:32760")  # UTM 60S
+    assert crs.is_projected
+    t = Transform("EPSG:4326", crs)
+    x, y = t.transform(np.array([177.0]), np.array([0.0]))
+    assert abs(x[0] - 500000.0) < 1e-3
+    assert abs(y[0] - 10000000.0) < 1e-3

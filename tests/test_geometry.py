@@ -1,0 +1,122 @@
+import math
+
+import pytest
+
+from kart_tpu.geometry import (
+    Geometry,
+    GeometryError,
+    geojson_to_geometry,
+    geom_envelope,
+    normalise_gpkg_geom,
+    parse_wkt,
+    write_wkt,
+)
+
+
+def test_point_roundtrip():
+    g = Geometry.from_wkt("POINT (1.5 -2.25)")
+    assert g.geometry_type_name == "Point"
+    assert not g.is_empty
+    assert g.envelope_kind == 0  # points don't get envelopes
+    assert g.to_wkt() == "POINT (1.5 -2.25)"
+    assert g.envelope() == (1.5, 1.5, -2.25, -2.25)
+
+
+def test_linestring_envelope_header():
+    g = Geometry.from_wkt("LINESTRING (0 0, 10 5, -3 2)")
+    assert g.envelope_kind == 1  # XY envelope stored
+    assert g.envelope() == (-3.0, 10.0, 0.0, 5.0)
+    assert g.to_wkt() == "LINESTRING (0 0,10 5,-3 2)"
+
+
+def test_polygon_roundtrip():
+    wkt = "POLYGON ((0 0,4 0,4 4,0 4,0 0),(1 1,2 1,2 2,1 2,1 1))"
+    g = Geometry.from_wkt(wkt)
+    assert g.to_wkt() == wkt
+    assert g.envelope() == (0.0, 4.0, 0.0, 4.0)
+
+
+def test_multi_types_roundtrip():
+    for wkt in [
+        "MULTIPOINT ((1 2),(3 4))",
+        "MULTILINESTRING ((0 0,1 1),(2 2,3 3))",
+        "MULTIPOLYGON (((0 0,1 0,1 1,0 0)),((5 5,6 5,6 6,5 5)))",
+        "GEOMETRYCOLLECTION (POINT (1 2),LINESTRING (0 0,1 1))",
+    ]:
+        g = Geometry.from_wkt(wkt)
+        assert g.to_wkt() == wkt, wkt
+
+
+def test_z_geometry():
+    g = Geometry.from_wkt("LINESTRING Z (0 0 1, 2 3 4)")
+    assert g.has_z
+    assert not g.has_m
+    assert g.envelope_kind == 2  # XYZ
+    assert g.envelope(only_xy=False) == (0.0, 2.0, 0.0, 3.0, 1.0, 4.0)
+    assert g.to_wkt() == "LINESTRING Z (0 0 1,2 3 4)"
+
+
+def test_empty_geometry():
+    g = Geometry.from_wkt("POLYGON EMPTY")
+    assert g.is_empty
+    assert g.envelope() is None
+    assert g.to_wkt() == "POLYGON EMPTY"
+
+
+def test_wkb_roundtrip():
+    g = Geometry.from_wkt("LINESTRING (0 0, 1 2)")
+    wkb = g.to_wkb()
+    g2 = Geometry.from_wkb(wkb)
+    assert bytes(g) == bytes(g2)
+
+
+def test_hex_wkb():
+    g = Geometry.from_wkt("POINT (1 2)")
+    hex_wkb = g.to_hex_wkb()
+    assert hex_wkb.startswith("0101000000")
+    assert bytes(Geometry.from_hex_wkb(hex_wkb)) == bytes(g)
+
+
+def test_normalised_idempotent():
+    g = Geometry.from_wkt("LINESTRING (0 0, 1 1)")
+    assert g.normalised() is g
+
+
+def test_normalise_fixes_srs_id():
+    g = Geometry.from_wkt("POINT (1 2)", crs_id=4326)
+    assert g.crs_id == 4326
+    n = g.normalised()
+    assert n.crs_id == 0
+    assert n.to_wkt() == g.to_wkt()
+    assert g.with_crs_id(4326) == g
+
+
+def test_geojson_roundtrip():
+    g = Geometry.from_wkt("POLYGON ((0 0,4 0,4 4,0 0))")
+    gj = g.to_geojson()
+    assert gj["type"] == "Polygon"
+    g2 = geojson_to_geometry(gj)
+    assert g2.to_wkt() == g.to_wkt()
+
+
+def test_geometry_of_none():
+    assert Geometry.of(None) is None
+    assert Geometry.of(b"") is None
+    assert geom_envelope(None) is None
+
+
+def test_from_string_validation():
+    with pytest.raises(GeometryError):
+        Geometry.from_string("POLYGON EMPTY")
+    g = Geometry.from_string("POINT (1 2)")
+    assert g.geometry_type_name == "Point"
+    with pytest.raises(GeometryError):
+        Geometry.from_string("POINT (1 2)", allowed_types=[3])  # POLYGON only
+
+
+def test_ewkb():
+    g = Geometry.from_wkt("POINT (1 2)", crs_id=4326)
+    he = g.to_hex_ewkb()
+    g2 = Geometry.from_hex_ewkb(he)
+    assert g2.crs_id == 4326
+    assert g2.to_wkt() == "POINT (1 2)"

@@ -1,0 +1,75 @@
+import numpy as np
+import pytest
+
+from kart_tpu.core.serialise import b64encode_str, msg_pack
+from kart_tpu.models.paths import PathEncoder
+
+
+def test_int_encoder_known_answers():
+    enc = PathEncoder.INT_PK_ENCODER
+    # pk=1 -> tree index (1//64) % 64**4 = 0 -> A/A/A/A ; filename = b64(msgpack([1]))
+    assert enc.encode_pks_to_path([1]) == "A/A/A/A/" + b64encode_str(msg_pack([1]))
+    assert b64encode_str(msg_pack([1])) == "kQE="
+    # pk=64 -> tree index 1 -> A/A/A/B
+    assert enc.encode_pks_to_path([64]).startswith("A/A/A/B/")
+    # pk=64*64 -> index 64 -> A/A/B/A
+    assert enc.encode_pks_to_path([64 * 64]).startswith("A/A/B/A/")
+
+
+def test_int_encoder_roundtrip_scalar():
+    enc = PathEncoder.INT_PK_ENCODER
+    for pk in [0, 1, 63, 64, 127, 255, 256, 65535, 65536, 2**31, -1, -32, -33, -128, -129, -65536]:
+        path = enc.encode_pks_to_path([pk])
+        assert enc.decode_path_to_pks(path) == (pk,)
+
+
+def test_int_encoder_batch_matches_scalar():
+    enc = PathEncoder.INT_PK_ENCODER
+    rng = np.random.default_rng(0)
+    pks = np.concatenate(
+        [
+            rng.integers(0, 100, 50),
+            rng.integers(0, 2**16, 50),
+            rng.integers(0, 2**40, 50),
+            rng.integers(-(2**20), 0, 50),
+            np.array([0, 1, 63, 64, 127, 128, 255, 256, 65535, 65536]),
+        ]
+    ).astype(np.int64)
+    batch = enc.encode_paths_batch(pks)
+    scalar = [enc.encode_pks_to_path([int(pk)]) for pk in pks]
+    assert batch == scalar
+
+    decoded = enc.decode_paths_batch(batch)
+    np.testing.assert_array_equal(decoded, pks)
+
+
+def test_hash_encoder_shape():
+    enc = PathEncoder.GENERAL_ENCODER
+    path = enc.encode_pks_to_path(["some-string-pk"])
+    parts = path.split("/")
+    assert len(parts) == 5  # 4 tree levels + filename
+    assert all(len(p) == 1 for p in parts[:4])
+    assert enc.decode_path_to_pks(path) == ("some-string-pk",)
+
+
+def test_legacy_encoder_shape():
+    enc = PathEncoder.LEGACY_ENCODER
+    path = enc.encode_pks_to_path([123])
+    parts = path.split("/")
+    assert len(parts) == 3  # 2 tree levels (hex pairs) + filename
+    assert all(len(p) == 2 for p in parts[:2])
+    assert enc.decode_path_to_pks(path) == (123,)
+
+
+def test_encoder_registry_roundtrip():
+    d = PathEncoder.INT_PK_ENCODER.to_dict()
+    assert d == {"scheme": "int", "branches": 64, "levels": 4, "encoding": "base64"}
+    assert PathEncoder.get(**d) == PathEncoder.INT_PK_ENCODER
+
+
+def test_tree_names_order():
+    names = list(PathEncoder.INT_PK_ENCODER.tree_names())
+    assert names[0] == "A"
+    assert names[26] == "a"
+    assert names[-1] == "_"
+    assert len(names) == 64

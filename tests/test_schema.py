@@ -1,0 +1,116 @@
+import pytest
+
+from kart_tpu.core.serialise import msg_unpack
+from kart_tpu.models.schema import ColumnSchema, Legend, Schema
+
+POINTS_COLS = [
+    {
+        "id": "c1",
+        "name": "fid",
+        "dataType": "integer",
+        "primaryKeyIndex": 0,
+        "size": 64,
+    },
+    {"id": "c2", "name": "geom", "dataType": "geometry", "geometryType": "POINT"},
+    {"id": "c3", "name": "name", "dataType": "text", "length": 100},
+    {"id": "c4", "name": "rating", "dataType": "float"},
+]
+
+
+@pytest.fixture
+def schema():
+    return Schema.from_column_dicts(POINTS_COLS)
+
+
+def test_schema_roundtrip(schema):
+    assert schema.to_column_dicts() == POINTS_COLS
+    assert Schema.loads(schema.dumps()) == schema
+
+
+def test_legend(schema):
+    legend = schema.legend
+    assert legend.pk_columns == ("c1",)
+    assert legend.non_pk_columns == ("c2", "c3", "c4")
+    assert Legend.loads(legend.dumps()) == legend
+    assert len(legend.hexhash()) == 40
+
+
+def test_feature_conversion(schema):
+    feature = {"fid": 7, "geom": None, "name": "x", "rating": 1.5}
+    raw = schema.feature_to_raw_dict(feature)
+    assert raw == {"c1": 7, "c2": None, "c3": "x", "c4": 1.5}
+    assert schema.feature_from_raw_dict(raw) == feature
+
+
+def test_encode_feature_blob(schema):
+    feature = {"fid": 7, "geom": None, "name": "x", "rating": 1.5}
+    pk_values, blob = schema.encode_feature_blob(feature)
+    assert pk_values == (7,)
+    legend_hash, non_pk_values = msg_unpack(blob)
+    assert legend_hash == schema.legend.hexhash()
+    assert non_pk_values == [None, "x", 1.5]
+
+
+def test_hash_feature_stable(schema):
+    feature = {"fid": 7, "geom": None, "name": "x", "rating": 1.5}
+    h1 = schema.hash_feature(feature)
+    h2 = schema.hash_feature(dict(reversed(list(feature.items()))))
+    assert h1 == h2
+    assert schema.hash_feature(feature, without_pk=True) != h1
+
+
+def test_validation(schema):
+    ok = {"fid": 1, "geom": None, "name": "ok", "rating": 0.5}
+    assert schema.validate_feature(ok)
+    bad = {"fid": 1, "geom": None, "name": 123, "rating": 0.5}
+    violations = {}
+    assert not schema.validate_feature(bad, violations)
+    assert "name" in violations
+
+
+def test_validation_text_length(schema):
+    bad = {"fid": 1, "geom": None, "name": "x" * 101, "rating": None}
+    assert not schema.validate_feature(bad)
+
+
+def test_validation_int_size(schema):
+    s = Schema.from_column_dicts(
+        [
+            {"id": "a", "name": "pk", "dataType": "integer", "primaryKeyIndex": 0},
+            {"id": "b", "name": "n", "dataType": "integer", "size": 16},
+        ]
+    )
+    assert s.validate_feature({"pk": 1, "n": 32767})
+    assert not s.validate_feature({"pk": 1, "n": 32768})
+
+
+def test_diff_types(schema):
+    new_cols = [dict(d) for d in POINTS_COLS]
+    new_cols[2]["name"] = "title"  # rename c3
+    new_cols.append({"id": "c5", "name": "extra", "dataType": "integer"})
+    new_schema = Schema.from_column_dicts(new_cols)
+    d = schema.diff_types(new_schema)
+    assert d["inserts"] == {"c5"}
+    assert d["name_updates"] == {"c3"}
+    assert d["deletes"] == set()
+
+
+def test_align_to_self(schema):
+    # same columns, fresh ids (as if roundtripped through a WC database)
+    roundtripped = [dict(d) for d in POINTS_COLS]
+    for d in roundtripped:
+        d["id"] = "wc-" + d["id"]
+    aligned = schema.align_to_self(Schema.from_column_dicts(roundtripped))
+    assert [c.id for c in aligned] == ["c1", "c2", "c3", "c4"]
+
+
+def test_sanitise_pks(schema):
+    assert schema.sanitise_pks("7") == (7,)
+    assert schema.sanitise_pks([7]) == (7,)
+
+
+def test_pk_ordering_validation():
+    with pytest.raises(ValueError):
+        Schema.from_column_dicts(
+            [{"id": "a", "name": "x", "dataType": "integer", "primaryKeyIndex": 1}]
+        )

@@ -1,0 +1,40 @@
+import base64
+import hashlib
+
+from kart_tpu.core import serialise
+from kart_tpu.geometry import Geometry
+
+
+def test_msgpack_roundtrip_basic():
+    value = {"a": 1, "b": [1, 2.5, None, True, "x", b"raw"]}
+    assert serialise.msg_unpack(serialise.msg_pack(value)) == value
+
+
+def test_msgpack_tuple_becomes_list():
+    assert serialise.msg_unpack(serialise.msg_pack((1, 2))) == [1, 2]
+
+
+def test_msgpack_geometry_extension():
+    g = Geometry.from_wkt("POINT (1 2)")
+    packed = serialise.msg_pack([g])
+    # extension code G = 0x47
+    assert b"\x47" in packed or packed.find(bytes([0xC7])) >= 0
+    out = serialise.msg_unpack(packed)
+    assert isinstance(out[0], Geometry)
+    assert bytes(out[0]) == bytes(g)
+
+
+def test_hexhash_is_truncated_sha256():
+    assert serialise.hexhash(b"abc") == hashlib.sha256(b"abc").hexdigest()[:40]
+    # str and bytes hash identically
+    assert serialise.hexhash("abc") == serialise.hexhash(b"abc")
+
+
+def test_b64hash_width():
+    h = serialise.b64hash(b"abc")
+    assert len(base64.urlsafe_b64decode(h)) == 20
+
+
+def test_uint32hash():
+    v = serialise.uint32hash(b"abc")
+    assert 0 <= v < 2**32
