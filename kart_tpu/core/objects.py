@@ -1,0 +1,197 @@
+"""Git-compatible object model: blob / tree / commit / tag.
+
+The object store speaks git's exact wire format (sha1 of
+``b"<type> <len>\\0" + content``, canonical tree entry ordering), so
+repositories written by kart_tpu are bit-compatible with git's object model
+and reference repos serve as byte-level test oracles. The reference gets this
+from a forked libgit2 (SURVEY.md §2.2); here it is a small pure-Python layer
+(hot batch paths move to C++/numpy later) beneath the columnar engine — the
+TPU diff path works on (pk, oid) arrays and rarely materialises these objects.
+"""
+
+import hashlib
+import re
+import time
+from dataclasses import dataclass
+
+MODE_BLOB = 0o100644
+MODE_BLOB_EXEC = 0o100755
+MODE_TREE = 0o040000
+MODE_LINK = 0o120000
+MODE_COMMIT = 0o160000  # submodule, unused but parseable
+
+EMPTY_TREE_OID = "4b825dc642cb6eb9a060e54bf8d69288fbee4904"
+
+
+class ObjectFormatError(ValueError):
+    pass
+
+
+def hash_object(obj_type: str, data: bytes) -> str:
+    """-> 40-hex sha1 oid, exactly as git computes it."""
+    h = hashlib.sha1(b"%s %d\x00" % (obj_type.encode(), len(data)))
+    h.update(data)
+    return h.hexdigest()
+
+
+def hash_blob(data: bytes) -> str:
+    return hash_object("blob", data)
+
+
+# ---------------------------------------------------------------------------
+# Trees
+# ---------------------------------------------------------------------------
+
+
+@dataclass(frozen=True)
+class TreeEntry:
+    name: str
+    mode: int
+    oid: str
+
+    @property
+    def is_tree(self):
+        return self.mode == MODE_TREE
+
+    @property
+    def type_str(self):
+        return "tree" if self.is_tree else "blob"
+
+
+def tree_sort_key(entry: TreeEntry):
+    """git's canonical tree ordering: names compare as if trees end in '/'."""
+    return entry.name + ("/" if entry.is_tree else "")
+
+
+def serialise_tree(entries) -> bytes:
+    """Iterable of TreeEntry -> canonical tree object content."""
+    out = bytearray()
+    for e in sorted(entries, key=tree_sort_key):
+        out += b"%o %s\x00" % (e.mode, e.name.encode("utf8"))
+        out += bytes.fromhex(e.oid)
+    return bytes(out)
+
+
+def parse_tree(data) -> list:
+    """Tree object content -> list of TreeEntry (in stored order)."""
+    entries = []
+    mv = memoryview(data)
+    i = 0
+    n = len(mv)
+    while i < n:
+        sp = data.index(b" ", i)
+        mode = int(bytes(mv[i:sp]), 8)
+        nul = data.index(b"\x00", sp)
+        name = bytes(mv[sp + 1 : nul]).decode("utf8")
+        oid = bytes(mv[nul + 1 : nul + 21]).hex()
+        entries.append(TreeEntry(name, mode, oid))
+        i = nul + 21
+    return entries
+
+
+# ---------------------------------------------------------------------------
+# Signatures / commits / tags
+# ---------------------------------------------------------------------------
+
+
+@dataclass(frozen=True)
+class Signature:
+    name: str
+    email: str
+    time: int  # unix seconds
+    offset: int  # minutes east of UTC
+
+    @classmethod
+    def now(cls, name, email, offset=0):
+        return cls(name, email, int(time.time()), offset)
+
+    def format(self):
+        sign = "+" if self.offset >= 0 else "-"
+        off = abs(self.offset)
+        return (
+            f"{self.name} <{self.email}> {self.time} {sign}{off // 60:02d}{off % 60:02d}"
+        )
+
+    _RE = re.compile(r"^(.*) <(.*)> (\d+) ([+-])(\d{2})(\d{2})$")
+
+    @classmethod
+    def parse(cls, text):
+        m = cls._RE.match(text)
+        if not m:
+            raise ObjectFormatError(f"Bad signature: {text!r}")
+        name, email, ts, sign, hh, mm = m.groups()
+        off = int(hh) * 60 + int(mm)
+        if sign == "-":
+            off = -off
+        return cls(name, email, int(ts), off)
+
+
+@dataclass(frozen=True)
+class Commit:
+    tree: str
+    parents: tuple
+    author: Signature
+    committer: Signature
+    message: str
+
+    def serialise(self) -> bytes:
+        lines = [f"tree {self.tree}"]
+        lines += [f"parent {p}" for p in self.parents]
+        lines.append(f"author {self.author.format()}")
+        lines.append(f"committer {self.committer.format()}")
+        return ("\n".join(lines) + "\n\n" + self.message).encode("utf8")
+
+    @classmethod
+    def parse(cls, data: bytes):
+        text = data.decode("utf8")
+        header, _, message = text.partition("\n\n")
+        tree = None
+        parents = []
+        author = committer = None
+        for line in header.split("\n"):
+            key, _, value = line.partition(" ")
+            if key == "tree":
+                tree = value
+            elif key == "parent":
+                parents.append(value)
+            elif key == "author":
+                author = Signature.parse(value)
+            elif key == "committer":
+                committer = Signature.parse(value)
+        if tree is None or author is None or committer is None:
+            raise ObjectFormatError("Malformed commit object")
+        return cls(tree, tuple(parents), author, committer, message)
+
+    @property
+    def message_summary(self):
+        return self.message.split("\n", 1)[0]
+
+
+@dataclass(frozen=True)
+class Tag:
+    target: str
+    target_type: str
+    name: str
+    tagger: Signature
+    message: str
+
+    def serialise(self) -> bytes:
+        lines = [
+            f"object {self.target}",
+            f"type {self.target_type}",
+            f"tag {self.name}",
+        ]
+        if self.tagger is not None:
+            lines.append(f"tagger {self.tagger.format()}")
+        return ("\n".join(lines) + "\n\n" + self.message).encode("utf8")
+
+    @classmethod
+    def parse(cls, data: bytes):
+        text = data.decode("utf8")
+        header, _, message = text.partition("\n\n")
+        fields = {}
+        for line in header.split("\n"):
+            key, _, value = line.partition(" ")
+            fields[key] = value
+        tagger = Signature.parse(fields["tagger"]) if "tagger" in fields else None
+        return cls(fields["object"], fields["type"], fields.get("tag", ""), tagger, message)

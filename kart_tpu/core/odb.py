@@ -1,0 +1,358 @@
+"""Content-addressed object database: git-format loose objects + tri-state reads.
+
+Layout (inside ``<repo>/.kart``): ``objects/aa/bb...`` zlib-deflated
+``"<type> <len>\\0" + content``, plus ``objects/info/alternates`` for
+borrowing objects from another local store (cheap local clones).
+
+Reads are *tri-state* (reference: the libgit2 fork's error subcodes,
+kart/promisor_utils.py:9-21): an object is PRESENT, ABSENT, or PROMISED —
+absent locally but guaranteed fetchable from a promisor remote (spatially
+filtered partial clones leave most feature blobs promised). Callers that can
+tolerate partial data catch :class:`ObjectPromised` and queue a fetch.
+"""
+
+import os
+import zlib
+from enum import Enum
+from functools import lru_cache
+
+from kart_tpu.core.objects import (
+    Commit,
+    ObjectFormatError,
+    Tag,
+    TreeEntry,
+    hash_object,
+    parse_tree,
+)
+
+
+class ObjectStatus(Enum):
+    PRESENT = "present"
+    ABSENT = "absent"
+    PROMISED = "promised"
+
+
+class ObjectMissing(KeyError):
+    """Object not in the store and not promised by any remote."""
+
+    def __init__(self, oid, message=None):
+        super().__init__(message or f"Object not found: {oid}")
+        self.oid = oid
+
+
+class ObjectPromised(ObjectMissing):
+    """Object not present locally, but a promisor remote has it
+    (reference: LibgitSubcode EOBJECTPROMISED)."""
+
+    def __init__(self, oid):
+        super().__init__(oid, f"Object is promised but not present: {oid}")
+
+
+class ObjectDb:
+    """Loose-object store over a directory. Thread-compatible (atomic writes
+    via rename); single-writer semantics like git's."""
+
+    def __init__(self, objects_dir, promisor_check=None):
+        """promisor_check: () -> bool — True when a promisor remote is
+        configured, making absent objects PROMISED instead of errors."""
+        self.objects_dir = objects_dir
+        self._promisor_check = promisor_check or (lambda: False)
+        self._alternates = None
+        self._tree_cache = {}
+        self._tree_cache_cap = 4096
+
+    # -- paths -------------------------------------------------------------
+
+    def _path(self, oid):
+        return os.path.join(self.objects_dir, oid[:2], oid[2:])
+
+    @property
+    def alternates(self):
+        if self._alternates is None:
+            self._alternates = []
+            info = os.path.join(self.objects_dir, "info", "alternates")
+            if os.path.exists(info):
+                with open(info) as f:
+                    for line in f:
+                        line = line.strip()
+                        if line and not line.startswith("#"):
+                            self._alternates.append(line)
+        return self._alternates
+
+    def add_alternate(self, objects_dir):
+        info_dir = os.path.join(self.objects_dir, "info")
+        os.makedirs(info_dir, exist_ok=True)
+        with open(os.path.join(info_dir, "alternates"), "a") as f:
+            f.write(objects_dir + "\n")
+        self._alternates = None
+
+    def _find(self, oid):
+        """-> file path or None, searching alternates too."""
+        p = self._path(oid)
+        if os.path.exists(p):
+            return p
+        for alt in self.alternates:
+            p = os.path.join(alt, oid[:2], oid[2:])
+            if os.path.exists(p):
+                return p
+        return None
+
+    # -- raw io ------------------------------------------------------------
+
+    def contains(self, oid):
+        return self._find(oid) is not None
+
+    def status(self, oid) -> ObjectStatus:
+        if self.contains(oid):
+            return ObjectStatus.PRESENT
+        if self._promisor_check():
+            return ObjectStatus.PROMISED
+        return ObjectStatus.ABSENT
+
+    def read_raw(self, oid):
+        """-> (type_str, content bytes). Raises ObjectMissing/ObjectPromised."""
+        path = self._find(oid)
+        if path is None:
+            if self._promisor_check():
+                raise ObjectPromised(oid)
+            raise ObjectMissing(oid)
+        with open(path, "rb") as f:
+            raw = zlib.decompress(f.read())
+        nul = raw.index(b"\x00")
+        header = raw[:nul].decode("ascii")
+        obj_type, _, size = header.partition(" ")
+        content = raw[nul + 1 :]
+        if len(content) != int(size):
+            raise ObjectFormatError(f"Corrupt object {oid}: size mismatch")
+        return obj_type, content
+
+    def write_raw(self, obj_type, content) -> str:
+        oid = hash_object(obj_type, content)
+        path = self._path(oid)
+        if os.path.exists(path):
+            return oid
+        os.makedirs(os.path.dirname(path), exist_ok=True)
+        tmp = path + f".tmp{os.getpid()}"
+        payload = zlib.compress(b"%s %d\x00" % (obj_type.encode(), len(content)) + content, 1)
+        with open(tmp, "wb") as f:
+            f.write(payload)
+        os.replace(tmp, path)
+        return oid
+
+    def write_many(self, items):
+        """[(type, content)] -> [oid]; skips objects that already exist."""
+        return [self.write_raw(t, c) for t, c in items]
+
+    # -- typed access ------------------------------------------------------
+
+    def read_blob(self, oid) -> bytes:
+        obj_type, content = self.read_raw(oid)
+        if obj_type != "blob":
+            raise ObjectFormatError(f"{oid} is a {obj_type}, expected blob")
+        return content
+
+    def write_blob(self, content) -> str:
+        return self.write_raw("blob", content)
+
+    def read_commit(self, oid) -> Commit:
+        obj_type, content = self.read_raw(oid)
+        if obj_type == "tag":  # peel annotated tags
+            return self.read_commit(Tag.parse(content).target)
+        if obj_type != "commit":
+            raise ObjectFormatError(f"{oid} is a {obj_type}, expected commit")
+        return Commit.parse(content)
+
+    def write_commit(self, commit: Commit) -> str:
+        return self.write_raw("commit", commit.serialise())
+
+    def read_tag(self, oid) -> Tag:
+        obj_type, content = self.read_raw(oid)
+        if obj_type != "tag":
+            raise ObjectFormatError(f"{oid} is a {obj_type}, expected tag")
+        return Tag.parse(content)
+
+    def object_type(self, oid) -> str:
+        return self.read_raw(oid)[0]
+
+    # -- trees -------------------------------------------------------------
+
+    def read_tree_entries(self, oid):
+        cached = self._tree_cache.get(oid)
+        if cached is not None:
+            return cached
+        obj_type, content = self.read_raw(oid)
+        if obj_type != "tree":
+            raise ObjectFormatError(f"{oid} is a {obj_type}, expected tree")
+        entries = parse_tree(content)
+        if len(self._tree_cache) >= self._tree_cache_cap:
+            self._tree_cache.clear()
+        self._tree_cache[oid] = entries
+        return entries
+
+    def write_tree(self, entries) -> str:
+        from kart_tpu.core.objects import serialise_tree
+
+        return self.write_raw("tree", serialise_tree(entries))
+
+    def tree(self, oid) -> "TreeView":
+        return TreeView(self, oid)
+
+    # -- maintenance -------------------------------------------------------
+
+    def iter_oids(self):
+        """All oids physically present in this store (not alternates)."""
+        for prefix in sorted(os.listdir(self.objects_dir)):
+            if len(prefix) != 2:
+                continue
+            d = os.path.join(self.objects_dir, prefix)
+            for name in sorted(os.listdir(d)):
+                if len(name) == 38 and not name.endswith(".tmp"):
+                    yield prefix + name
+
+    def find_oids_with_prefix(self, hex_prefix):
+        """Oids starting with hex_prefix (>= 2 chars) — scans only the one
+        fanout directory, in this store and its alternates."""
+        assert len(hex_prefix) >= 2
+        fan, rest = hex_prefix[:2], hex_prefix[2:]
+        seen = set()
+        for root in [self.objects_dir, *self.alternates]:
+            d = os.path.join(root, fan)
+            if not os.path.isdir(d):
+                continue
+            for name in sorted(os.listdir(d)):
+                if len(name) == 38 and name.startswith(rest) and not name.endswith(".tmp"):
+                    oid = fan + name
+                    if oid not in seen:
+                        seen.add(oid)
+                        yield oid
+
+
+class TreeView:
+    """A tree bound to its object db — iterable like a directory
+    (pygit2.Tree analog). Entries yield TreeViews for subtrees and BlobHandle
+    for blobs."""
+
+    __slots__ = ("odb", "oid", "name")
+
+    def __init__(self, odb, oid, name=""):
+        self.odb = odb
+        self.oid = oid
+        self.name = name
+
+    @property
+    def type_str(self):
+        return "tree"
+
+    @property
+    def id(self):
+        return self.oid
+
+    def entries(self):
+        return self.odb.read_tree_entries(self.oid)
+
+    def __iter__(self):
+        for e in self.entries():
+            yield self._wrap(e)
+
+    def _wrap(self, entry: TreeEntry):
+        if entry.is_tree:
+            return TreeView(self.odb, entry.oid, entry.name)
+        return BlobHandle(self.odb, entry.oid, entry.name)
+
+    def __len__(self):
+        return len(self.entries())
+
+    def __bool__(self):
+        return True
+
+    def __contains__(self, name):
+        try:
+            self.entry(name)
+            return True
+        except KeyError:
+            return False
+
+    def entry(self, name) -> TreeEntry:
+        for e in self.entries():
+            if e.name == name:
+                return e
+        raise KeyError(name)
+
+    def __getitem__(self, path):
+        return self.get(path)
+
+    def __truediv__(self, path):
+        return self.get(path)
+
+    def get(self, path):
+        """Path like 'a/b/c' -> TreeView or BlobHandle. KeyError if absent."""
+        node = self
+        for part in path.split("/"):
+            if not part:
+                continue
+            if not isinstance(node, TreeView):
+                raise KeyError(path)
+            node = node._wrap(node.entry(part))
+        return node
+
+    def get_or_none(self, path):
+        try:
+            return self.get(path)
+        except ObjectMissing:
+            raise
+        except KeyError:
+            return None
+
+    def walk_blobs(self, prefix=""):
+        """Depth-first yield of (path, TreeEntry) for every blob under this
+        tree. The bulk enumeration primitive behind indexing/export."""
+        for e in self.entries():
+            path = f"{prefix}{e.name}"
+            if e.is_tree:
+                yield from TreeView(self.odb, e.oid).walk_blobs(path + "/")
+            else:
+                yield path, e
+
+    def __eq__(self, other):
+        return isinstance(other, TreeView) and self.oid == other.oid
+
+    def __hash__(self):
+        return hash(("tree", self.oid))
+
+    def __repr__(self):
+        return f"TreeView({self.oid[:10]}, {self.name!r})"
+
+
+class BlobHandle:
+    """Lazy blob reference; .data reads through the odb."""
+
+    __slots__ = ("odb", "oid", "name")
+
+    def __init__(self, odb, oid, name=""):
+        self.odb = odb
+        self.oid = oid
+        self.name = name
+
+    @property
+    def type_str(self):
+        return "blob"
+
+    @property
+    def id(self):
+        return self.oid
+
+    @property
+    def data(self) -> bytes:
+        return self.odb.read_blob(self.oid)
+
+    def memoryview(self):
+        return memoryview(self.data)
+
+    def __eq__(self, other):
+        return isinstance(other, BlobHandle) and self.oid == other.oid
+
+    def __hash__(self):
+        return hash(("blob", self.oid))
+
+    def __repr__(self):
+        return f"BlobHandle({self.oid[:10]}, {self.name!r})"

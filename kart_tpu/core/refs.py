@@ -1,0 +1,265 @@
+"""Refs, HEAD, reflogs and git-style config files (reference: pygit2's ref
+API + kart's config keys in kart/repo.py:75-107).
+
+Stored exactly as git does — ``refs/heads/<name>`` files of 40-hex + ``\\n``,
+a ``HEAD`` symref file, ``logs/`` reflogs, an INI-with-subsections ``config``
+— so a kart_tpu repo directory is structurally recognisable to git tooling.
+"""
+
+import os
+import re
+import time
+
+
+class RefError(ValueError):
+    pass
+
+
+class RefStore:
+    def __init__(self, gitdir):
+        self.gitdir = gitdir
+
+    def _ref_path(self, ref):
+        assert not ref.startswith("/") and ".." not in ref, ref
+        return os.path.join(self.gitdir, *ref.split("/"))
+
+    # -- plain refs ----------------------------------------------------------
+
+    def get(self, ref):
+        """ref name -> oid, or None. Follows nothing (see resolve)."""
+        path = self._ref_path(ref)
+        if not os.path.exists(path):
+            return None
+        with open(path) as f:
+            value = f.read().strip()
+        return value or None
+
+    def set(self, ref, oid, log_message=None):
+        old = self.get(ref)
+        path = self._ref_path(ref)
+        os.makedirs(os.path.dirname(path), exist_ok=True)
+        tmp = path + f".lock{os.getpid()}"
+        with open(tmp, "w") as f:
+            f.write(oid + "\n")
+        os.replace(tmp, path)
+        if log_message is not None:
+            self._append_reflog(ref, old, oid, log_message)
+
+    def delete(self, ref):
+        path = self._ref_path(ref)
+        if os.path.exists(path):
+            os.remove(path)
+
+    def exists(self, ref):
+        return os.path.exists(self._ref_path(ref))
+
+    def iter_refs(self, prefix="refs/"):
+        """Yield (ref_name, oid) under the given prefix, sorted."""
+        base = self._ref_path(prefix.rstrip("/"))
+        if not os.path.isdir(base):
+            return
+        for dirpath, dirnames, filenames in sorted(os.walk(base)):
+            dirnames.sort()
+            for fn in sorted(filenames):
+                if fn.endswith((".lock", ".tmp")):
+                    continue
+                full = os.path.join(dirpath, fn)
+                rel = os.path.relpath(full, self.gitdir).replace(os.sep, "/")
+                with open(full) as f:
+                    value = f.read().strip()
+                if value:
+                    yield rel, value
+
+    # -- HEAD ----------------------------------------------------------------
+
+    def head_target(self):
+        """-> ('symbolic', refname) or ('direct', oid) or (None, None)."""
+        path = os.path.join(self.gitdir, "HEAD")
+        if not os.path.exists(path):
+            return None, None
+        with open(path) as f:
+            value = f.read().strip()
+        if value.startswith("ref: "):
+            return "symbolic", value[5:]
+        return ("direct", value) if value else (None, None)
+
+    def set_head(self, target, log_message=None):
+        """target: 'refs/heads/x' (symbolic) or a 40-hex oid (detached)."""
+        old = self.head_resolved()
+        path = os.path.join(self.gitdir, "HEAD")
+        with open(path, "w") as f:
+            if re.fullmatch(r"[0-9a-f]{40}", target):
+                f.write(target + "\n")
+            else:
+                f.write(f"ref: {target}\n")
+        if log_message is not None:
+            new = self.head_resolved()
+            self._append_reflog("HEAD", old, new, log_message)
+
+    def head_resolved(self):
+        """-> oid HEAD points at (through one symref level), or None (unborn)."""
+        kind, target = self.head_target()
+        if kind == "symbolic":
+            return self.get(target)
+        return target
+
+    def head_branch(self):
+        """-> branch ref name when HEAD is symbolic, else None (detached)."""
+        kind, target = self.head_target()
+        return target if kind == "symbolic" else None
+
+    # -- reflog --------------------------------------------------------------
+
+    def _append_reflog(self, ref, old_oid, new_oid, message):
+        log_path = os.path.join(self.gitdir, "logs", *ref.split("/"))
+        os.makedirs(os.path.dirname(log_path), exist_ok=True)
+        zero = "0" * 40
+        ts = int(time.time())
+        line = (
+            f"{old_oid or zero} {new_oid or zero} "
+            f"kart_tpu <kart_tpu@localhost> {ts} +0000\t{message}\n"
+        )
+        with open(log_path, "a") as f:
+            f.write(line)
+
+    def read_reflog(self, ref):
+        log_path = os.path.join(self.gitdir, "logs", *ref.split("/"))
+        if not os.path.exists(log_path):
+            return []
+        entries = []
+        with open(log_path) as f:
+            for line in f:
+                head, _, message = line.rstrip("\n").partition("\t")
+                parts = head.split(" ")
+                entries.append(
+                    {
+                        "old": parts[0],
+                        "new": parts[1],
+                        "message": message,
+                    }
+                )
+        return entries
+
+
+# ---------------------------------------------------------------------------
+# Config — git-config file format (INI with quoted subsections)
+# ---------------------------------------------------------------------------
+
+
+class Config:
+    """Flat key-value view of a git-style config file. Keys look like
+    ``core.bare``, ``remote.origin.url``, ``kart.spatialfilter.geometry``.
+
+    Multi-valued keys (git allows e.g. several ``fetch`` refspecs per remote)
+    are preserved: internally every key maps to a list, ``get`` returns the
+    last value (git's rule) and ``get_all`` the full list. Comments are not
+    preserved across writes.
+    """
+
+    _SECTION_RE = re.compile(r'\[([A-Za-z0-9.-]+)(?:\s+"((?:[^"\\]|\\.)*)")?\]')
+
+    def __init__(self, path):
+        self.path = path
+        self._values = {}  # key -> [value, ...]
+        self._load()
+
+    def _load(self):
+        self._values.clear()
+        if not os.path.exists(self.path):
+            return
+        section = ""
+        with open(self.path) as f:
+            for line in f:
+                line = line.strip()
+                if not line or line.startswith(("#", ";")):
+                    continue
+                m = self._SECTION_RE.fullmatch(line)
+                if m:
+                    name, sub = m.groups()
+                    section = f"{name}.{sub}" if sub is not None else name
+                    continue
+                key, _, value = line.partition("=")
+                key = key.strip().lower()
+                value = value.strip()
+                # strip one level of quoting
+                if len(value) >= 2 and value[0] == '"' and value[-1] == '"':
+                    value = value[1:-1].replace('\\"', '"').replace("\\\\", "\\")
+                self._values.setdefault(
+                    f"{section}.{key}" if section else key, []
+                ).append(value)
+
+    def _save(self):
+        # group keys into sections
+        sections = {}
+        for full_key, values in self._values.items():
+            parts = full_key.split(".")
+            if len(parts) == 2:
+                section, key = parts[0], parts[1]
+                header = f"[{section}]"
+            else:
+                section, key = ".".join(parts[:-1]), parts[-1]
+                name, sub = parts[0], ".".join(parts[1:-1])
+                header = f'[{name} "{sub}"]'
+            for value in values:
+                sections.setdefault(header, []).append((key, value))
+        os.makedirs(os.path.dirname(self.path), exist_ok=True)
+        tmp = self.path + f".lock{os.getpid()}"
+        with open(tmp, "w") as f:
+            for header in sections:
+                f.write(header + "\n")
+                for key, value in sections[header]:
+                    if re.search(r"[#;\s]", value) and not (
+                        value.startswith('"') and value.endswith('"')
+                    ):
+                        value = '"' + value.replace("\\", "\\\\").replace('"', '\\"') + '"'
+                    f.write(f"\t{key} = {value}\n")
+        os.replace(tmp, self.path)
+
+    def __contains__(self, key):
+        return key.lower() in self._values
+
+    def __getitem__(self, key):
+        return self._values[key.lower()][-1]
+
+    def get(self, key, default=None):
+        values = self._values.get(key.lower())
+        return values[-1] if values else default
+
+    def get_all(self, key):
+        return list(self._values.get(key.lower(), []))
+
+    def add_value(self, key, value):
+        """Append an additional value for a multi-valued key."""
+        self._values.setdefault(key.lower(), []).append(str(value))
+        self._save()
+
+    def get_bool(self, key, default=False):
+        value = self.get(key)
+        if value is None:
+            return default
+        return value.lower() in ("1", "true", "yes", "on")
+
+    def get_int(self, key, default=None):
+        value = self.get(key)
+        return int(value) if value is not None else default
+
+    def __setitem__(self, key, value):
+        if isinstance(value, bool):
+            value = "true" if value else "false"
+        self._values[key.lower()] = [str(value)]
+        self._save()
+
+    def __delitem__(self, key):
+        self._values.pop(key.lower(), None)
+        self._save()
+
+    def set_many(self, mapping):
+        for key, value in mapping.items():
+            if isinstance(value, bool):
+                value = "true" if value else "false"
+            self._values[key.lower()] = [str(value)]
+        self._save()
+
+    def keys(self, prefix=""):
+        prefix = prefix.lower()
+        return [k for k in self._values if k.startswith(prefix)]

@@ -1,0 +1,506 @@
+"""The repository object (reference: kart/repo.py).
+
+A kart_tpu repo is a directory with a ``.kart`` gitdir (tidy style; ``.sno``
+is recognised for Sno back-compat, and a bare gitdir works too) holding the
+object store, refs, config and state files. The repo has a two-state machine
+— NORMAL or MERGING — persisted as ``MERGE_HEAD``/``MERGE_INDEX`` files so an
+interrupted merge survives process exit (reference: kart/repo.py:53-72).
+"""
+
+import hashlib
+import os
+import re
+import struct
+
+from kart_tpu.core.odb import ObjectDb, ObjectMissing
+from kart_tpu.core.objects import Commit, Signature, Tag
+from kart_tpu.core.refs import Config, RefStore
+
+DEFAULT_BRANCH = "main"
+DEFAULT_REPO_VERSION = 3
+
+
+class RepoError(ValueError):
+    pass
+
+
+class NotFound(RepoError):
+    pass
+
+
+class InvalidOperation(RepoError):
+    pass
+
+
+class KartRepoState:
+    NORMAL = "normal"
+    MERGING = "merging"
+
+    ALL_STATES = (NORMAL, MERGING)
+
+    @classmethod
+    def bad_state_message(cls, state, allowed_states, command_extra=""):
+        if state == cls.MERGING:
+            return (
+                'A merge is ongoing - see "kart merge --continue" / '
+                '"kart merge --abort" / "kart conflicts" / "kart resolve"'
+            )
+        return f"Repo state {state} does not allow this command"
+
+
+class KartConfigKeys:
+    """kart.* config keys (reference: kart/repo.py:75-107)."""
+
+    KART_REPOSTRUCTURE_VERSION = "kart.repostructure.version"
+    KART_WORKINGCOPY_LOCATION = "kart.workingcopy.location"
+    KART_SPATIALFILTER_GEOMETRY = "kart.spatialfilter.geometry"
+    KART_SPATIALFILTER_CRS = "kart.spatialfilter.crs"
+    KART_SPATIALFILTER_REFERENCE = "kart.spatialfilter.reference"
+    KART_SPATIALFILTER_OBJECTID = "kart.spatialfilter.objectid"
+
+    # legacy sno.* names for back-compat reads
+    SNO_REPOSTRUCTURE_VERSION = "sno.repository.version"
+    SNO_WORKINGCOPY_PATH = "sno.workingcopy.path"
+
+
+# State files living directly in the gitdir
+MERGE_HEAD = "MERGE_HEAD"
+MERGE_INDEX = "MERGE_INDEX"
+MERGE_BRANCH = "MERGE_BRANCH"
+MERGE_MSG = "MERGE_MSG"
+
+_EMPTY = "[EMPTY]"
+
+
+class KartRepo:
+    """A repository. Open an existing one with KartRepo(path), create with
+    KartRepo.init_repository()."""
+
+    def __init__(self, path):
+        path = os.path.abspath(path)
+        self.gitdir, self.workdir = self._locate(path)
+        if self.gitdir is None:
+            raise NotFound(f"Not an existing kart repository: {path!r}")
+        self.refs = RefStore(self.gitdir)
+        self.config = Config(os.path.join(self.gitdir, "config"))
+        self.odb = ObjectDb(
+            os.path.join(self.gitdir, "objects"),
+            promisor_check=self.has_promisor_remote,
+        )
+
+    @staticmethod
+    def _locate(path):
+        """-> (gitdir, workdir-or-None). Searches path and its parents."""
+        probe = path
+        while True:
+            for dot in (".kart", ".sno"):
+                gitdir = os.path.join(probe, dot)
+                if os.path.isdir(os.path.join(gitdir, "objects")):
+                    return gitdir, probe
+            # bare repo: the dir itself is a gitdir
+            if os.path.isdir(os.path.join(probe, "objects")) and os.path.exists(
+                os.path.join(probe, "HEAD")
+            ):
+                return probe, None
+            parent = os.path.dirname(probe)
+            if parent == probe:
+                return None, None
+            probe = parent
+
+    # -- creation ----------------------------------------------------------
+
+    @classmethod
+    def init_repository(cls, path, *, bare=False, initial_branch=DEFAULT_BRANCH):
+        path = os.path.abspath(path)
+        gitdir = path if bare else os.path.join(path, ".kart")
+        if os.path.isdir(os.path.join(gitdir, "objects")):
+            raise InvalidOperation(f"Repository already exists at {path!r}")
+        os.makedirs(os.path.join(gitdir, "objects", "info"), exist_ok=True)
+        os.makedirs(os.path.join(gitdir, "refs", "heads"), exist_ok=True)
+        with open(os.path.join(gitdir, "HEAD"), "w") as f:
+            f.write(f"ref: refs/heads/{initial_branch}\n")
+        config = Config(os.path.join(gitdir, "config"))
+        config.set_many(
+            {
+                "core.repositoryformatversion": "0",
+                "core.bare": bare,
+                KartConfigKeys.KART_REPOSTRUCTURE_VERSION: str(DEFAULT_REPO_VERSION),
+            }
+        )
+        if not bare:
+            cls._write_locked_index(gitdir)
+        return cls(path)
+
+    @staticmethod
+    def _write_locked_index(gitdir):
+        """Write a git index containing a *required* extension named 'kart',
+        so stock git refuses to operate on the worktree rather than trampling
+        kart's working copy (reference: kart/repo.py:110-139)."""
+        body = b"DIRC" + struct.pack(">II", 2, 0)
+        ext_data = b"kart_tpu locked index"
+        body += b"kart" + struct.pack(">I", len(ext_data)) + ext_data
+        body += hashlib.sha1(body).digest()
+        with open(os.path.join(gitdir, "index"), "wb") as f:
+            f.write(body)
+
+    # -- basic properties ----------------------------------------------------
+
+    @property
+    def is_bare(self):
+        return self.workdir is None
+
+    @property
+    def head_branch(self):
+        return self.refs.head_branch()
+
+    @property
+    def head_commit_oid(self):
+        return self.refs.head_resolved()
+
+    @property
+    def head_is_unborn(self):
+        return self.head_commit_oid is None
+
+    @property
+    def head_commit(self):
+        oid = self.head_commit_oid
+        return self.odb.read_commit(oid) if oid else None
+
+    @property
+    def head_tree_oid(self):
+        commit = self.head_commit
+        return commit.tree if commit else None
+
+    @property
+    def version(self):
+        value = self.config.get_int(KartConfigKeys.KART_REPOSTRUCTURE_VERSION)
+        if value is not None:
+            return value
+        value = self.config.get_int(KartConfigKeys.SNO_REPOSTRUCTURE_VERSION)
+        if value is not None:
+            return value
+        return DEFAULT_REPO_VERSION
+
+    @property
+    def state(self):
+        if os.path.exists(os.path.join(self.gitdir, MERGE_HEAD)):
+            return KartRepoState.MERGING
+        return KartRepoState.NORMAL
+
+    def gitdir_file(self, name):
+        return os.path.join(self.gitdir, name)
+
+    def read_gitdir_file(self, name, missing_ok=True):
+        path = self.gitdir_file(name)
+        if not os.path.exists(path):
+            if missing_ok:
+                return None
+            raise NotFound(f"No such state file: {name}")
+        with open(path) as f:
+            return f.read().strip()
+
+    def write_gitdir_file(self, name, content):
+        with open(self.gitdir_file(name), "w") as f:
+            f.write(content if content.endswith("\n") else content + "\n")
+
+    def remove_gitdir_file(self, name):
+        path = self.gitdir_file(name)
+        if os.path.exists(path):
+            os.remove(path)
+
+    # -- remotes / promisor --------------------------------------------------
+
+    def remotes(self):
+        names = set()
+        for key in self.config.keys("remote."):
+            parts = key.split(".")
+            if len(parts) >= 3:
+                names.add(".".join(parts[1:-1]))
+        return sorted(names)
+
+    def remote_url(self, name):
+        return self.config.get(f"remote.{name}.url")
+
+    def has_promisor_remote(self):
+        return any(
+            self.config.get_bool(f"remote.{name}.promisor") for name in self.remotes()
+        )
+
+    def spatial_filter_spec(self):
+        geometry = self.config.get(KartConfigKeys.KART_SPATIALFILTER_GEOMETRY)
+        crs = self.config.get(KartConfigKeys.KART_SPATIALFILTER_CRS)
+        if geometry and crs:
+            return {"geometry": geometry, "crs": crs}
+        return None
+
+    # -- signatures ----------------------------------------------------------
+
+    def signature(self, role="committer"):
+        prefix = "GIT_AUTHOR" if role == "author" else "GIT_COMMITTER"
+        name = (
+            os.environ.get(f"{prefix}_NAME")
+            or self.config.get("user.name")
+            or "Kart TPU"
+        )
+        email = (
+            os.environ.get(f"{prefix}_EMAIL")
+            or self.config.get("user.email")
+            or "kart_tpu@localhost"
+        )
+        date = os.environ.get(f"{prefix}_DATE")
+        if date:
+            m = re.fullmatch(r"(\d+) ([+-])(\d{2})(\d{2})", date.strip())
+            if m:
+                ts, sign, hh, mm = m.groups()
+                off = int(hh) * 60 + int(mm)
+                if sign == "-":
+                    off = -off
+                return Signature(name, email, int(ts), off)
+        return Signature.now(name, email)
+
+    # -- refish resolution ---------------------------------------------------
+
+    def resolve_refish(self, refish):
+        """Accepts: HEAD, branch, tag, full/short oid, with ^/~n suffixes,
+        and '[EMPTY]' -> (oid_or_None, ref_name_or_None)
+        (reference: kart/structure.py:39-85)."""
+        if refish in (_EMPTY, None):
+            return None, None
+        base, ops = _split_rev_operators(refish)
+
+        oid, ref = self._resolve_plain(base)
+        for op, count in ops:
+            if oid is None:
+                raise NotFound(f"Cannot apply {op} to empty revision")
+            commit = self.odb.read_commit(oid)
+            if op == "~":
+                for _ in range(count):
+                    if not commit.parents:
+                        raise NotFound(f"Revision {refish!r} walks past the root commit")
+                    oid = commit.parents[0]
+                    commit = self.odb.read_commit(oid)
+            elif op == "^?":
+                # first-parent-or-empty (kart extension, structure.py:66-77)
+                oid = commit.parents[0] if commit.parents else None
+            else:  # ^n
+                if count == 0:
+                    continue
+                if len(commit.parents) < count:
+                    raise NotFound(f"Revision {refish!r}: no parent #{count}")
+                oid = commit.parents[count - 1]
+            ref = None
+        return oid, ref
+
+    def _resolve_plain(self, name):
+        if name == "HEAD":
+            kind, target = self.refs.head_target()
+            if kind == "symbolic":
+                return self.refs.get(target), target
+            return target, None
+        for candidate in (
+            name,
+            f"refs/heads/{name}",
+            f"refs/tags/{name}",
+            f"refs/remotes/{name}",
+        ):
+            oid = self.refs.get(candidate)
+            if oid is not None:
+                return self._peel_to_commit_oid(oid), candidate
+        if re.fullmatch(r"[0-9a-f]{40}", name) and self.odb.contains(name):
+            return name, None
+        if re.fullmatch(r"[0-9a-f]{4,39}", name):
+            matches = list(self.odb.find_oids_with_prefix(name))
+            if len(matches) == 1:
+                return self._peel_to_commit_oid(matches[0]), None
+            if len(matches) > 1:
+                raise NotFound(f"Ambiguous short id {name!r}")
+        raise NotFound(f"No such commit, branch or tag: {name!r}")
+
+    def _peel_to_commit_oid(self, oid):
+        obj_type = self.odb.object_type(oid)
+        while obj_type == "tag":
+            tag = self.odb.read_tag(oid)
+            oid = tag.target
+            obj_type = self.odb.object_type(oid)
+        return oid
+
+    def resolve_commit(self, refish) -> Commit:
+        oid, _ = self.resolve_refish(refish)
+        if oid is None:
+            raise NotFound(f"{refish!r} resolves to the empty revision")
+        return self.odb.read_commit(oid)
+
+    # -- history walking -----------------------------------------------------
+
+    def walk_commits(self, start_oid, *, first_parent=False):
+        """Yield commit oids from start going backwards, committer-date order
+        (git log default)."""
+        import heapq
+
+        seen = set()
+        heap = []
+
+        def push(oid):
+            if oid not in seen:
+                seen.add(oid)
+                commit = self.odb.read_commit(oid)
+                heapq.heappush(heap, (-commit.committer.time, oid, commit))
+
+        push(start_oid)
+        while heap:
+            _, oid, commit = heapq.heappop(heap)
+            yield oid, commit
+            parents = commit.parents[:1] if first_parent else commit.parents
+            for p in parents:
+                push(p)
+
+    def topo_commits(self, start_oids):
+        """All reachable commits in parents-before-children order."""
+        order = []
+        visited = set()
+        stack = [(oid, False) for oid in start_oids]
+        while stack:
+            oid, processed = stack.pop()
+            if processed:
+                order.append(oid)
+                continue
+            if oid in visited:
+                continue
+            visited.add(oid)
+            stack.append((oid, True))
+            for p in self.odb.read_commit(oid).parents:
+                stack.append((p, False))
+        return order
+
+    def merge_base(self, oid_a, oid_b):
+        """Best common ancestor, or None."""
+        ancestors_a = self._ancestor_set(oid_a)
+        if oid_b in ancestors_a:
+            return oid_b
+        # BFS from b, newest-first, until we hit something reachable from a
+        import heapq
+
+        seen = set()
+        heap = []
+
+        def push(oid):
+            if oid not in seen:
+                seen.add(oid)
+                commit = self.odb.read_commit(oid)
+                heapq.heappush(heap, (-commit.committer.time, oid, commit))
+
+        push(oid_b)
+        while heap:
+            _, oid, commit = heapq.heappop(heap)
+            if oid in ancestors_a:
+                return oid
+            for p in commit.parents:
+                push(p)
+        return None
+
+    def _ancestor_set(self, oid):
+        out = set()
+        stack = [oid]
+        while stack:
+            o = stack.pop()
+            if o in out:
+                continue
+            out.add(o)
+            stack.extend(self.odb.read_commit(o).parents)
+        return out
+
+    def is_ancestor(self, maybe_ancestor, descendant):
+        return maybe_ancestor in self._ancestor_set(descendant)
+
+    # -- writing -------------------------------------------------------------
+
+    def create_commit(
+        self,
+        ref,
+        tree_oid,
+        message,
+        parents,
+        *,
+        author=None,
+        committer=None,
+    ):
+        """-> new commit oid; updates ref (or detached HEAD when ref='HEAD')."""
+        commit = Commit(
+            tree=tree_oid,
+            parents=tuple(parents),
+            author=author or self.signature("author"),
+            committer=committer or self.signature("committer"),
+            message=message if message.endswith("\n") else message + "\n",
+        )
+        oid = self.odb.write_commit(commit)
+        if ref == "HEAD":
+            branch = self.refs.head_branch()
+            if branch:
+                self.refs.set(branch, oid, log_message=f"commit: {commit.message_summary}")
+            else:
+                self.refs.set_head(oid, log_message=f"commit: {commit.message_summary}")
+        elif ref is not None:
+            self.refs.set(ref, oid, log_message=f"commit: {commit.message_summary}")
+        return oid
+
+    def create_tag(self, name, target_oid, message=None, tagger=None):
+        ref = f"refs/tags/{name}"
+        if self.refs.exists(ref):
+            raise InvalidOperation(f"Tag already exists: {name}")
+        if message:
+            tag = Tag(
+                target=target_oid,
+                target_type=self.odb.object_type(target_oid),
+                name=name,
+                tagger=tagger or self.signature(),
+                message=message if message.endswith("\n") else message + "\n",
+            )
+            oid = self.odb.write_raw("tag", tag.serialise())
+            self.refs.set(ref, oid)
+            return oid
+        self.refs.set(ref, target_oid)
+        return target_oid
+
+    # -- structure access (defined in structure.py) --------------------------
+
+    def structure(self, refish="HEAD"):
+        from kart_tpu.core.structure import RepoStructure
+
+        return RepoStructure(self, refish)
+
+    def datasets(self, refish="HEAD"):
+        return self.structure(refish).datasets
+
+    @property
+    def working_copy(self):
+        from kart_tpu.workingcopy import get_working_copy
+
+        return get_working_copy(self)
+
+    def del_config(self, key):
+        del self.config[key]
+
+    def gc(self, *args):
+        """Prune temp files. Loose-object store needs no repack."""
+        for dirpath, _, filenames in os.walk(os.path.join(self.gitdir, "objects")):
+            for fn in filenames:
+                if ".tmp" in fn:
+                    try:
+                        os.remove(os.path.join(dirpath, fn))
+                    except OSError:
+                        pass
+
+
+def _split_rev_operators(refish):
+    """'main~2^1' -> ('main', [('~',2), ('^',1)]). Also handles '^?'."""
+    m = re.match(r"^(.*?)((?:[~^]\??\d*)*)$", refish)
+    base, suffix = m.group(1), m.group(2)
+    ops = []
+    for op_m in re.finditer(r"([~^])(\?|\d*)", suffix):
+        op, arg = op_m.groups()
+        if arg == "?":
+            ops.append(("^?", 0))
+        else:
+            count = int(arg) if arg else 1
+            ops.append((op, count))
+    return base, ops

@@ -382,7 +382,7 @@ def _tm_constants(a, inv_f):
     )
     beta = np.array(
         [
-            n / 2 - 2 * n**2 / 3 - 37 * n**3 / 96 + 1 * n**4 / 360,
+            n / 2 - 2 * n**2 / 3 + 37 * n**3 / 96 - 1 * n**4 / 360,
             1 * n**2 / 48 + 1 * n**3 / 15 - 437 * n**4 / 1440,
             17 * n**3 / 480 - 37 * n**4 / 840,
             4397 * n**4 / 161280,

@@ -534,7 +534,40 @@ _WKT_TOKEN = re.compile(r"\s*([A-Za-z]+|\(|\)|,|[-+0-9.eE]+)")
 def parse_wkt(wkt):
     tokens = _WKT_TOKEN.findall(wkt)
     value, pos = _parse_wkt_geom(tokens, 0)
-    return value
+    return _normalise_wkt_arity(value)
+
+
+def _normalise_wkt_arity(value):
+    """Infer Z/M from coordinate arity when no explicit marker was given
+    ('POINT (1 2 3)' is commonly emitted for 3D by OGR/shapely), then pad or
+    trim every point to the final dimension."""
+    has_z, has_m = value.has_z, value.has_m
+    if not has_z and not has_m:
+        arity = max((len(p) for p in _iter_points(value)), default=2)
+        if arity == 3:
+            has_z = True
+        elif arity >= 4:
+            has_z = has_m = True
+    dim = _coord_dim(has_z, has_m)
+    return _rebuild_with_dim(value, has_z, has_m, dim)
+
+
+def _rebuild_with_dim(value, has_z, has_m, dim):
+    base = value.base_type
+
+    def fix_pt(p):
+        return tuple(p[:dim]) + (0.0,) * (dim - len(p))
+
+    payload = value.payload
+    if base == POINT:
+        new = fix_pt(payload) if payload is not None else None
+    elif base == LINESTRING:
+        new = [fix_pt(p) for p in payload]
+    elif base == POLYGON:
+        new = [[fix_pt(p) for p in ring] for ring in payload]
+    else:
+        new = [_rebuild_with_dim(c, has_z, has_m, dim) for c in payload]
+    return _geom_value(value[0], has_z, has_m, new)
 
 
 def _parse_wkt_geom(tokens, pos):
@@ -560,7 +593,8 @@ def _parse_wkt_geom(tokens, pos):
     dim = _coord_dim(has_z, has_m)
 
     def parse_point_seq(pos):
-        # "( x y [z [m]] , x y ... )"
+        # "( x y [z [m]] , x y ... )" — keeps raw arity; parse_wkt's
+        # normalisation pass infers Z/M and pads afterwards.
         assert tokens[pos] == "(", f"expected ( at {pos}"
         pos += 1
         pts = []
@@ -569,7 +603,7 @@ def _parse_wkt_geom(tokens, pos):
             while pos < len(tokens) and tokens[pos] not in (",", ")"):
                 pt.append(float(tokens[pos]))
                 pos += 1
-            pts.append(tuple(pt[:dim] + [0.0] * (dim - len(pt))))
+            pts.append(tuple(pt))
             if tokens[pos] == ")":
                 return pts, pos + 1
             pos += 1  # skip comma

@@ -31,12 +31,8 @@ from kart_tpu.core.serialise import (
 )
 
 HEX_ALPHABET = "0123456789abcdef"
-# RFC 3548 urlsafe alphabet — also the order used for tree names.
+# RFC 3548 urlsafe alphabet — used for both tree names and b64 filenames.
 B64_ALPHABET = "ABCDEFGHIJKLMNOPQRSTUVWXYZabcdefghijklmnopqrstuvwxyz0123456789-_"
-
-# Standard base64 alphabet (what urlsafe_b64encode emits) — note this differs
-# from B64_ALPHABET ordering; filenames use this, tree names use B64_ALPHABET.
-_STD_B64 = "ABCDEFGHIJKLMNOPQRSTUVWXYZabcdefghijklmnopqrstuvwxyz0123456789-_"
 
 
 class PathEncoderError(ValueError):

@@ -1,0 +1,63 @@
+import subprocess
+
+import pytest
+
+from kart_tpu.core.objects import (
+    Commit,
+    Signature,
+    TreeEntry,
+    MODE_BLOB,
+    MODE_TREE,
+    hash_blob,
+    parse_tree,
+    serialise_tree,
+)
+
+
+def test_blob_hash_matches_git():
+    # known-answer: git hash-object of b"hello\n"
+    assert hash_blob(b"hello\n") == "ce013625030ba8dba906f756967f9e9ca394464a"
+    # empty blob
+    assert hash_blob(b"") == "e69de29bb2d1d6434b8b29ae775ad8c2e48c5391"
+
+
+def test_tree_roundtrip():
+    entries = [
+        TreeEntry("zeta", MODE_BLOB, "ce013625030ba8dba906f756967f9e9ca394464a"),
+        TreeEntry("alpha", MODE_TREE, "4b825dc642cb6eb9a060e54bf8d69288fbee4904"),
+        TreeEntry("beta", MODE_BLOB, "e69de29bb2d1d6434b8b29ae775ad8c2e48c5391"),
+    ]
+    data = serialise_tree(entries)
+    parsed = parse_tree(data)
+    assert [e.name for e in parsed] == ["alpha", "beta", "zeta"]
+    assert parsed[0].is_tree
+
+
+def test_tree_git_sort_order():
+    # git sorts trees as if their name had a trailing slash: "a.b" < "a/" -> "a" tree sorts after "a.b"
+    entries = [
+        TreeEntry("a", MODE_TREE, "4b825dc642cb6eb9a060e54bf8d69288fbee4904"),
+        TreeEntry("a.b", MODE_BLOB, "e69de29bb2d1d6434b8b29ae775ad8c2e48c5391"),
+    ]
+    parsed = parse_tree(serialise_tree(entries))
+    assert [e.name for e in parsed] == ["a.b", "a"]
+
+
+def test_signature_roundtrip():
+    sig = Signature("Test User", "test@example.com", 1700000000, -330)
+    assert Signature.parse(sig.format()) == sig
+    sig2 = Signature("X", "x@y", 1700000000, 765)
+    assert Signature.parse(sig2.format()) == sig2
+
+
+def test_commit_roundtrip():
+    sig = Signature("A", "a@b.c", 1700000000, 0)
+    c = Commit(
+        tree="4b825dc642cb6eb9a060e54bf8d69288fbee4904",
+        parents=("ce013625030ba8dba906f756967f9e9ca394464a",),
+        author=sig,
+        committer=sig,
+        message="hello world\n\nbody\n",
+    )
+    assert Commit.parse(c.serialise()) == c
+    assert c.message_summary == "hello world"

@@ -1,0 +1,191 @@
+import os
+import subprocess
+
+import pytest
+
+from kart_tpu.core.objects import MODE_BLOB
+from kart_tpu.core.repo import KartRepo, KartRepoState, NotFound
+from kart_tpu.core.tree_builder import TreeBuilder
+
+
+@pytest.fixture
+def repo(tmp_path):
+    r = KartRepo.init_repository(tmp_path / "r")
+    r.config.set_many({"user.name": "Tester", "user.email": "t@example.com"})
+    return r
+
+
+def make_commit(repo, files, message, ref="HEAD", parents=None):
+    tb = TreeBuilder(repo.odb, repo.head_tree_oid if parents is None else None)
+    if parents is None:
+        parents = [repo.head_commit_oid] if repo.head_commit_oid else []
+    for path, content in files.items():
+        tb.insert(path, repo.odb.write_blob(content))
+    tree = tb.flush()
+    return repo.create_commit(ref, tree, message, parents)
+
+
+def test_init_and_reopen(tmp_path):
+    r = KartRepo.init_repository(tmp_path / "x")
+    assert r.state == KartRepoState.NORMAL
+    assert r.head_is_unborn
+    assert r.version == 3
+    r2 = KartRepo(tmp_path / "x")
+    assert r2.gitdir == r.gitdir
+    # opening from a subdirectory finds the repo
+    os.makedirs(tmp_path / "x" / "sub")
+    assert KartRepo(tmp_path / "x" / "sub").gitdir == r.gitdir
+
+
+def test_init_refuses_double(tmp_path):
+    KartRepo.init_repository(tmp_path / "x")
+    with pytest.raises(Exception):
+        KartRepo.init_repository(tmp_path / "x")
+
+
+def test_commit_and_resolve(repo):
+    c1 = make_commit(repo, {"a.txt": b"one\n"}, "first")
+    c2 = make_commit(repo, {"b.txt": b"two\n"}, "second")
+    assert repo.head_commit_oid == c2
+    assert repo.resolve_refish("HEAD") == (c2, "refs/heads/main")
+    assert repo.resolve_refish("main")[0] == c2
+    assert repo.resolve_refish("HEAD~1")[0] == c1
+    assert repo.resolve_refish("HEAD^")[0] == c1
+    assert repo.resolve_refish(c1)[0] == c1
+    assert repo.resolve_refish(c1[:8])[0] == c1
+    assert repo.resolve_refish("HEAD^?")[0] == c1
+    assert repo.resolve_refish("[EMPTY]") == (None, None)
+    # ^? on root commit -> empty
+    assert repo.resolve_refish(f"{c1}^?")[0] is None
+    with pytest.raises(NotFound):
+        repo.resolve_refish("nope")
+
+
+def test_walk_and_merge_base(repo):
+    c1 = make_commit(repo, {"a": b"1"}, "c1")
+    c2 = make_commit(repo, {"b": b"2"}, "c2")
+    # branch from c1
+    repo.refs.set("refs/heads/feature", c1)
+    tb = TreeBuilder(repo.odb, repo.odb.read_commit(c1).tree)
+    tb.insert("c", repo.odb.write_blob(b"3"))
+    c3 = repo.create_commit("refs/heads/feature", tb.flush(), "c3", [c1])
+
+    assert repo.merge_base(c2, c3) == c1
+    assert repo.is_ancestor(c1, c2)
+    assert not repo.is_ancestor(c2, c3)
+    oids = [oid for oid, _ in repo.walk_commits(c2)]
+    assert oids == [c2, c1]
+
+
+def test_tags(repo):
+    c1 = make_commit(repo, {"a": b"1"}, "c1")
+    repo.create_tag("v-light", c1)
+    tag_oid = repo.create_tag("v-annot", c1, message="release")
+    assert repo.resolve_refish("v-light")[0] == c1
+    assert repo.resolve_refish("v-annot")[0] == c1  # peeled through tag object
+    assert repo.odb.read_tag(tag_oid).name == "v-annot"
+
+
+def test_git_interop(repo, tmp_path):
+    """Real git can read everything we write."""
+    c1 = make_commit(repo, {"a.txt": b"one\n", "dir/b.txt": b"two\n"}, "first")
+    c2 = make_commit(repo, {"a.txt": b"ONE\n"}, "second")
+    # the locked kart index blocks even read-only git commands unless we point
+    # git at a scratch index (that refusal is itself asserted below)
+    env = {
+        **os.environ,
+        "GIT_DIR": repo.gitdir,
+        "GIT_INDEX_FILE": str(tmp_path / "scratch-index"),
+    }
+
+    out = subprocess.run(
+        ["git", "fsck", "--strict"], env=env, capture_output=True, text=True
+    )
+    assert out.returncode == 0, out.stderr
+
+    log = subprocess.run(
+        ["git", "log", "--format=%H %s"], env=env, capture_output=True, text=True
+    ).stdout.splitlines()
+    assert log == [f"{c2} second", f"{c1} first"]
+
+    show = subprocess.run(
+        ["git", "show", "HEAD~1:dir/b.txt"], env=env, capture_output=True, text=True
+    ).stdout
+    assert show == "two\n"
+
+    # the locked index makes stock git refuse worktree operations
+    locked_env = {**os.environ, "GIT_DIR": repo.gitdir, "GIT_WORK_TREE": repo.workdir}
+    status = subprocess.run(
+        ["git", "status"], env=locked_env, capture_output=True, text=True
+    )
+    assert status.returncode != 0
+    assert "kart" in (status.stderr + status.stdout).lower()
+
+
+def test_tree_builder_nested(repo):
+    odb = repo.odb
+    tb = TreeBuilder(odb)
+    tb.insert("x/y/z.txt", odb.write_blob(b"deep"))
+    tb.insert("top.txt", odb.write_blob(b"top"))
+    t1 = tb.flush()
+    view = odb.tree(t1)
+    assert view["x/y/z.txt"].data == b"deep"
+    assert view["top.txt"].data == b"top"
+
+    # incremental change reuses unchanged subtrees
+    tb2 = TreeBuilder(odb, t1)
+    tb2.insert("x/y/w.txt", odb.write_blob(b"more"))
+    t2 = tb2.flush()
+    v2 = odb.tree(t2)
+    assert v2["x/y/z.txt"].data == b"deep"
+    assert v2["x/y/w.txt"].data == b"more"
+
+    # removal prunes empty parents
+    tb3 = TreeBuilder(odb, t2)
+    tb3.remove("x/y/z.txt")
+    tb3.remove("x/y/w.txt")
+    t3 = tb3.flush()
+    v3 = odb.tree(t3)
+    assert v3.get_or_none("x") is None
+    assert v3["top.txt"].data == b"top"
+
+
+def test_walk_blobs(repo):
+    odb = repo.odb
+    tb = TreeBuilder(odb)
+    tb.insert("a/1", odb.write_blob(b"1"))
+    tb.insert("a/2", odb.write_blob(b"2"))
+    tb.insert("b/3", odb.write_blob(b"3"))
+    t = tb.flush()
+    paths = [p for p, _ in odb.tree(t).walk_blobs()]
+    assert paths == ["a/1", "a/2", "b/3"]
+
+
+def test_config_subsections(repo):
+    repo.config["remote.origin.url"] = "/some/path"
+    repo.config["remote.origin.promisor"] = True
+    repo2 = KartRepo(repo.workdir)
+    assert repo2.remote_url("origin") == "/some/path"
+    assert repo2.has_promisor_remote()
+    assert repo2.remotes() == ["origin"]
+
+
+def test_promised_object(repo):
+    from kart_tpu.core.odb import ObjectMissing, ObjectPromised
+
+    fake_oid = "ab" * 20
+    with pytest.raises(ObjectMissing):
+        repo.odb.read_blob(fake_oid)
+    repo.config["remote.origin.url"] = "/x"
+    repo.config["remote.origin.promisor"] = True
+    repo2 = KartRepo(repo.workdir)
+    with pytest.raises(ObjectPromised):
+        repo2.odb.read_blob(fake_oid)
+
+
+def test_reflog(repo):
+    c1 = make_commit(repo, {"a": b"1"}, "c1")
+    entries = repo.refs.read_reflog("refs/heads/main")
+    assert len(entries) == 1
+    assert entries[0]["new"] == c1
+    assert "c1" in entries[0]["message"]
