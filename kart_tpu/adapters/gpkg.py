@@ -1,0 +1,211 @@
+"""GPKG <-> Datasets V2 schema/type/value mapping
+(reference: kart/sqlalchemy/adapter/gpkg.py).
+
+GPKG is sqlite with registered metadata tables; its type system is a subset of
+Kart's, so some types are *approximated* (numeric/interval/time -> TEXT) and
+restored on read via the roundtrip context. Works directly over the stdlib
+``sqlite3`` module — no SQLAlchemy layer in this rebuild.
+"""
+
+import re
+
+from kart_tpu.geometry import Geometry
+from kart_tpu.models.schema import ColumnSchema, Schema
+
+V2_TYPE_TO_SQL = {
+    "boolean": "BOOLEAN",
+    "integer": {0: "INTEGER", 8: "TINYINT", 16: "SMALLINT", 32: "MEDIUMINT", 64: "INTEGER"},
+    "float": {0: "REAL", 32: "FLOAT", 64: "REAL"},
+    "text": "TEXT",
+    "blob": "BLOB",
+    "date": "DATE",
+    "timestamp": {"UTC": "DATETIME", None: "TEXT"},
+    "time": "TEXT",
+    "numeric": "TEXT",
+    "interval": "TEXT",
+    "geometry": "GEOMETRY",
+}
+
+SQL_TYPE_TO_V2 = {
+    "BOOLEAN": ("boolean", None),
+    "TINYINT": ("integer", 8),
+    "SMALLINT": ("integer", 16),
+    "MEDIUMINT": ("integer", 32),
+    "INT": ("integer", 64),
+    "INTEGER": ("integer", 64),
+    "FLOAT": ("float", 32),
+    "DOUBLE": ("float", 64),
+    "REAL": ("float", 64),
+    "TEXT": ("text", None),
+    "BLOB": ("blob", None),
+    "DATE": ("date", None),
+    "DATETIME": ("timestamp", "UTC"),
+    "GEOMETRY": ("geometry", None),
+}
+
+# Kart types GPKG can't represent exactly, and what they become
+# (reference: adapter/gpkg.py:74-80).
+APPROXIMATED_TYPES = {
+    "interval": "text",
+    "time": "text",
+    "numeric": "text",
+    ("timestamp", None): "text",
+}
+
+GPKG_GEOMETRY_TYPES = {
+    "GEOMETRY",
+    "POINT",
+    "LINESTRING",
+    "POLYGON",
+    "MULTIPOINT",
+    "MULTILINESTRING",
+    "MULTIPOLYGON",
+    "GEOMETRYCOLLECTION",
+}
+
+
+def quote(ident):
+    return '"' + ident.replace('"', '""') + '"'
+
+
+def v2_type_to_sql_type(col: ColumnSchema):
+    mapped = V2_TYPE_TO_SQL[col.data_type]
+    extra = col.extra_type_info
+    if col.data_type == "integer":
+        return mapped[extra.get("size", 0) or 0]
+    if col.data_type == "float":
+        return mapped[extra.get("size", 0) or 0]
+    if col.data_type == "timestamp":
+        return mapped.get(extra.get("timezone"), "TEXT")
+    if col.data_type == "geometry":
+        return extra.get("geometryType", "GEOMETRY").split(" ")[0]
+    if col.data_type in ("text", "blob"):
+        length = extra.get("length")
+        return f"{mapped}({length})" if length else mapped
+    return mapped
+
+
+def v2_schema_to_sql_spec(schema: Schema):
+    """-> column spec string for CREATE TABLE
+    (reference: adapter/gpkg.py:95-110). GPKG needs an int pk; non-conformant
+    pks are demoted to UNIQUE NOT NULL behind an auto pk."""
+    has_int_pk = (
+        len(schema.pk_columns) == 1 and schema.pk_columns[0].data_type == "integer"
+    )
+    cols = []
+    if not has_int_pk:
+        cols.append("auto_int_pk INTEGER PRIMARY KEY AUTOINCREMENT NOT NULL")
+    for col in schema.columns:
+        name = quote(col.name)
+        if col.pk_index is not None and has_int_pk:
+            cols.append(f"{name} INTEGER PRIMARY KEY AUTOINCREMENT NOT NULL")
+        elif col.pk_index is not None:
+            sql_type = v2_type_to_sql_type(col)
+            cols.append(f"{name} {sql_type} UNIQUE NOT NULL CHECK({name}<>'')")
+        else:
+            cols.append(f"{name} {v2_type_to_sql_type(col)}")
+    return ", ".join(cols)
+
+
+_TYPE_WITH_LENGTH = re.compile(r"([A-Z]+)\s*\(\s*(\d+)\s*\)")
+
+
+def sqlite_type_to_v2(sql_type, *, geom_info=None):
+    """'MEDIUMINT' / 'TEXT(40)' / geometry name -> (data_type, extra_type_info)."""
+    sql_type = (sql_type or "").strip().upper()
+    m = _TYPE_WITH_LENGTH.fullmatch(sql_type)
+    length = None
+    if m:
+        sql_type, length = m.group(1), int(m.group(2))
+    if sql_type in GPKG_GEOMETRY_TYPES or (geom_info is not None):
+        extra = {}
+        gname = sql_type if sql_type in GPKG_GEOMETRY_TYPES else "GEOMETRY"
+        if geom_info:
+            gname = geom_info.get("geometry_type_name", gname)
+            z = geom_info.get("z", 0)
+            m_flag = geom_info.get("m", 0)
+            if z:
+                gname += " Z"
+            if m_flag:
+                gname += " M" if not z else "M"
+            gname = gname.replace(" Z M", " ZM")
+            extra["geometryType"] = gname
+            if geom_info.get("crs_identifier"):
+                extra["geometryCRS"] = geom_info["crs_identifier"]
+        else:
+            extra["geometryType"] = gname
+        return "geometry", extra
+    v2 = SQL_TYPE_TO_V2.get(sql_type)
+    if v2 is None:
+        # sqlite is dynamically typed: unknown declarations act like TEXT
+        return "text", ({"length": length} if length else {})
+    data_type, size = v2
+    extra = {}
+    if size is not None:
+        extra["size" if data_type in ("integer", "float") else "timezone"] = size
+    if length is not None and data_type in ("text", "blob"):
+        extra["length"] = length
+    return data_type, extra
+
+
+def value_to_v2(value, col: ColumnSchema):
+    """DB cell -> stored (msgpack-able) value."""
+    if value is None:
+        return None
+    t = col.data_type
+    if t == "geometry":
+        if isinstance(value, Geometry):
+            return value.normalised()
+        return Geometry.of(bytes(value)).normalised()
+    if t == "boolean":
+        return bool(value)
+    if t == "float":
+        return float(value)
+    if t == "timestamp" and isinstance(value, str):
+        # GPKG stores ISO with a space or 'T'; storage format uses 'T'
+        return value.replace(" ", "T")
+    return value
+
+
+def value_from_v2(value, col: ColumnSchema, *, crs_id=0):
+    """Stored value -> DB cell."""
+    if value is None:
+        return None
+    t = col.data_type
+    if t == "geometry":
+        return bytes(Geometry.of(value).with_crs_id(crs_id))
+    if t == "boolean":
+        return int(value)
+    return value
+
+
+class GpkgRoundtripContext:
+    """Schema alignment policy after a GPKG roundtrip: approximated types may
+    legitimately come back different (reference: adapter/base.py + schema.py
+    DefaultRoundtripContext docstring)."""
+
+    @classmethod
+    def try_align_schema_col(cls, old_col_dict, new_col_dict):
+        old_type = old_col_dict["dataType"]
+        new_type = new_col_dict["dataType"]
+        if old_type == new_type:
+            # restore extra info GPKG can't store (length on text came back?)
+            if old_type == "timestamp" and new_col_dict.get("timezone") is None:
+                new_col_dict["timezone"] = old_col_dict.get("timezone")
+            return True
+        key = old_type
+        if old_type == "timestamp":
+            key = ("timestamp", old_col_dict.get("timezone"))
+        if APPROXIMATED_TYPES.get(key) == new_type:
+            # the roundtrip approximated it: restore the original type info
+            new_col_dict["dataType"] = old_type
+            for attr in ("length", "precision", "scale", "timezone"):
+                if attr in old_col_dict:
+                    new_col_dict[attr] = old_col_dict[attr]
+                else:
+                    new_col_dict.pop(attr, None)
+            return True
+        # ints can widen/narrow in sqlite roundtrips
+        if old_type == "integer" and new_type == "integer":
+            return True
+        return False

@@ -1,0 +1,217 @@
+"""RepoStructure: a repository at a particular revision, and the datasets in
+it (reference: kart/structure.py).
+
+Mutations go through :meth:`RepoStructure.commit_diff`: a RepoDiff is applied
+to the revision's tree (conflict-checked, schema-validated) through a single
+batched TreeBuilder flush, producing one new commit — there is no index /
+staging area.
+"""
+
+from kart_tpu.core.odb import TreeView
+from kart_tpu.core.repo import InvalidOperation, NotFound
+from kart_tpu.core.tree_builder import TreeBuilder
+from kart_tpu.models.dataset import Dataset2, Dataset3, dataset_class_for_version
+
+# Directories that can never contain a dataset
+_RESERVED_DIRS = {".kart", ".sno", ".git"}
+
+MAX_DATASET_DEPTH = 5
+
+
+class SchemaViolation(InvalidOperation):
+    pass
+
+
+class PatchApplyError(InvalidOperation):
+    pass
+
+
+class Datasets:
+    """Discovers and indexes the dataset trees in a root tree
+    (reference: kart/structure.py:346-405). Iterable; subscript by path."""
+
+    def __init__(self, repo, tree):
+        self.repo = repo
+        self.tree = tree
+        self.dataset_class = dataset_class_for_version(repo.version)
+        self._cache = None
+
+    def _discover(self):
+        if self._cache is not None:
+            return self._cache
+        found = {}
+        if self.tree is not None:
+            self._walk(self.tree, "", found, MAX_DATASET_DEPTH)
+        self._cache = found
+        return found
+
+    def _walk(self, tree, prefix, found, depth):
+        for cls in (Dataset3, Dataset2):
+            if cls.is_dataset_tree(tree):
+                ds = cls(tree, prefix, self.repo)
+                found[prefix] = ds
+                return
+        if depth <= 0:
+            return
+        for entry in tree.entries():
+            if not entry.is_tree or entry.name in _RESERVED_DIRS:
+                continue
+            sub_prefix = f"{prefix}/{entry.name}" if prefix else entry.name
+            self._walk(TreeView(tree.odb, entry.oid), sub_prefix, found, depth - 1)
+
+    def __iter__(self):
+        return iter(self._discover().values())
+
+    def __len__(self):
+        return len(self._discover())
+
+    def paths(self):
+        return list(self._discover().keys())
+
+    def __contains__(self, ds_path):
+        return ds_path.strip("/") in self._discover()
+
+    def __getitem__(self, ds_path):
+        ds = self.get(ds_path)
+        if ds is None:
+            raise NotFound(f"No dataset at path {ds_path!r}")
+        return ds
+
+    def get(self, ds_path):
+        return self._discover().get(ds_path.strip("/"))
+
+
+class RepoStructure:
+    """repo@revision (reference: kart/structure.py:26)."""
+
+    def __init__(self, repo, refish="HEAD"):
+        self.repo = repo
+        self.refish = refish
+        self.commit_oid, self.ref = repo.resolve_refish(
+            refish if refish is not None else "HEAD"
+        )
+
+    @property
+    def commit(self):
+        return self.repo.odb.read_commit(self.commit_oid) if self.commit_oid else None
+
+    @property
+    def tree(self):
+        commit = self.commit
+        if commit is None:
+            return None
+        return self.repo.odb.tree(commit.tree)
+
+    @property
+    def tree_oid(self):
+        commit = self.commit
+        return commit.tree if commit else None
+
+    @property
+    def datasets(self):
+        return Datasets(self.repo, self.tree)
+
+    def decode_path(self, full_path):
+        """repo-root path -> (ds_path, part, item) where part is 'feature' /
+        'meta' / 'attachment'."""
+        for dirname in (Dataset3.DATASET_DIRNAME, Dataset2.DATASET_DIRNAME):
+            marker = f"/{dirname}/"
+            if marker in full_path:
+                ds_path, _, inner = full_path.partition(marker)
+                if inner.startswith("feature/"):
+                    return ds_path, "feature", inner[len("feature/") :]
+                if inner.startswith("meta/"):
+                    return ds_path, "meta", inner[len("meta/") :]
+                return ds_path, "inner", inner
+        ds_path, _, name = full_path.rpartition("/")
+        return ds_path, "attachment", name
+
+    # -- writing -------------------------------------------------------------
+
+    def create_tree_from_diff(self, repo_diff, *, allow_missing_old=False):
+        """Apply a RepoDiff to this revision's tree -> new tree oid
+        (reference: kart/structure.py:181-245)."""
+        tb = TreeBuilder(self.repo.odb, self.tree_oid)
+        datasets = self.datasets
+        for ds_path, ds_diff in repo_diff.items():
+            ds = datasets.get(ds_path)
+            if ds is None:
+                # new dataset: must have a schema insert in the meta diff
+                meta_diff = ds_diff.get("meta")
+                if not meta_diff or "schema.json" not in meta_diff:
+                    raise PatchApplyError(
+                        f"Diff contains dataset {ds_path!r} which is not in this revision"
+                    )
+                ds = self.datasets.dataset_class(None, ds_path, self.repo)
+            ds.apply_diff(
+                ds_diff, tb, allow_missing_old=allow_missing_old
+            )
+        return tb.flush()
+
+    def commit_diff(
+        self,
+        repo_diff,
+        message,
+        *,
+        ref="HEAD",
+        allow_empty=False,
+        amend=False,
+        author=None,
+        committer=None,
+        validate=True,
+    ):
+        """Apply diff, validate, create commit -> commit oid
+        (reference: kart/structure.py:292-343)."""
+        if validate:
+            self.check_values_match_schema(repo_diff)
+        new_tree = self.create_tree_from_diff(repo_diff)
+        if not allow_empty and not amend and new_tree == self.tree_oid:
+            raise InvalidOperation("No changes to commit", "NO_CHANGES")
+        if amend:
+            commit = self.commit
+            if commit is None:
+                raise InvalidOperation("Cannot amend: no commit at this revision")
+            parents = list(commit.parents)
+            if message is None:
+                message = commit.message
+        else:
+            parents = [self.commit_oid] if self.commit_oid else []
+        return self.repo.create_commit(
+            ref if self.ref is None else (self.ref if ref == "HEAD" else ref),
+            new_tree,
+            message,
+            parents,
+            author=author,
+            committer=committer,
+        )
+
+    def check_values_match_schema(self, repo_diff):
+        """Schema-validate every new feature value in the diff
+        (reference: kart/structure.py:247-290)."""
+        datasets = self.datasets
+        all_violations = {}
+        for ds_path, ds_diff in repo_diff.items():
+            feature_diff = ds_diff.get("feature")
+            if not feature_diff:
+                continue
+            meta_diff = ds_diff.get("meta") or {}
+            if "schema.json" in meta_diff and meta_diff["schema.json"].new is not None:
+                from kart_tpu.models.schema import Schema
+
+                schema = Schema.from_column_dicts(meta_diff["schema.json"].new_value)
+            else:
+                ds = datasets.get(ds_path)
+                if ds is None:
+                    continue
+                schema = ds.schema
+            violations = {}
+            for delta in feature_diff.values():
+                if delta.new is not None:
+                    schema.validate_feature(delta.new_value, violations)
+            if violations:
+                all_violations[ds_path] = violations
+        if all_violations:
+            details = "\n".join(
+                v for ds in all_violations.values() for v in ds.values()
+            )
+            raise SchemaViolation(f"Schema violation:\n{details}")

@@ -11,6 +11,10 @@ from kart_tpu.core.objects import MODE_BLOB, MODE_TREE, TreeEntry, serialise_tre
 
 _DELETED = object()
 
+# Present as a key in a subtree-changes dict: ignore the base tree's entries
+# for this subtree (it was removed before these inserts).
+_CLEARED = object()
+
 
 class TreeBuilder:
     def __init__(self, odb, base_tree_oid=None):
@@ -32,7 +36,9 @@ class TreeBuilder:
         for part in dir_parts:
             child = node.get(part)
             if not isinstance(child, dict):
-                child = {}
+                # descending into a deleted (or leaf-overwritten) entry: the
+                # new subtree must not inherit the base tree's contents
+                child = {_CLEARED: True} if child is not None else {}
                 node[part] = child
             node = child
         return node
@@ -70,6 +76,8 @@ class TreeBuilder:
 
     def _build(self, base_oid, changes):
         """-> new tree oid, or None when the resulting tree is empty."""
+        if changes.pop(_CLEARED, False):
+            base_oid = None
         if base_oid is not None:
             entries = {e.name: e for e in self.odb.read_tree_entries(base_oid)}
         else:

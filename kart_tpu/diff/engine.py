@@ -1,0 +1,166 @@
+"""Diff orchestration (reference: kart/diff_util.py, rich_base_dataset.py:170-300).
+
+Two layers:
+
+* **Tree diff** (host): prune-walk two feature trees, skipping identical
+  subtree oids — O(changed), independent of dataset size. Produces the
+  changed (path, old_oid, new_oid) set.
+* **Classification + values** (vectorized / lazy): changed paths become lazy
+  Deltas; bulk classification of whole datasets (for working-copy compare,
+  merge, estimation) runs as sorted (pk, oid) array joins — see
+  kart_tpu/ops/diff_kernel.py for the device kernels.
+"""
+
+from kart_tpu.core.odb import TreeView
+from kart_tpu.diff.key_filters import RepoKeyFilter
+from kart_tpu.diff.structs import (
+    DatasetDiff,
+    Delta,
+    DeltaDiff,
+    KeyValue,
+    RepoDiff,
+)
+
+
+def tree_diff_entries(odb, tree_oid_a, tree_oid_b, prefix=""):
+    """Yield (path, old_entry_oid, new_entry_oid) for each *blob* that differs
+    between two trees (either side may be None). Subtrees with equal oids are
+    skipped wholesale — the git tree-diff contract the whole design leans on."""
+    if tree_oid_a == tree_oid_b:
+        return
+    entries_a = {e.name: e for e in odb.read_tree_entries(tree_oid_a)} if tree_oid_a else {}
+    entries_b = {e.name: e for e in odb.read_tree_entries(tree_oid_b)} if tree_oid_b else {}
+    for name in sorted(entries_a.keys() | entries_b.keys()):
+        ea, eb = entries_a.get(name), entries_b.get(name)
+        oid_a = ea.oid if ea else None
+        oid_b = eb.oid if eb else None
+        if oid_a == oid_b:
+            continue
+        a_is_tree = ea.is_tree if ea else False
+        b_is_tree = eb.is_tree if eb else False
+        path = f"{prefix}{name}"
+        if a_is_tree or b_is_tree:
+            yield from tree_diff_entries(
+                odb,
+                oid_a if a_is_tree else None,
+                oid_b if b_is_tree else None,
+                path + "/",
+            )
+            # a blob replaced by a tree (or vice versa) also yields the blob side
+            if ea and not a_is_tree:
+                yield path, oid_a, None
+            if eb and not b_is_tree:
+                yield path, None, oid_b
+        else:
+            yield path, oid_a, oid_b
+
+
+def get_feature_diff(base_ds, target_ds, ds_filter=None):
+    """DeltaDiff of features between two versions of a dataset. Lazy values
+    (reference: rich_base_dataset.py:205-300)."""
+    feature_filter = ds_filter["feature"] if ds_filter is not None else None
+    result = DeltaDiff()
+
+    base_tree = base_ds.feature_tree if base_ds else None
+    target_tree = target_ds.feature_tree if target_ds else None
+    base_oid = base_tree.oid if base_tree is not None else None
+    target_oid = target_tree.oid if target_tree is not None else None
+    if base_oid == target_oid:
+        return result
+
+    odb = (base_tree or target_tree).odb
+    for path, old_oid, new_oid in tree_diff_entries(odb, base_oid, target_oid):
+        ds = base_ds if old_oid is not None else target_ds
+        pks = ds.decode_path_to_pks(path)
+        key = pks[0] if len(pks) == 1 else pks
+        if feature_filter is not None and key not in feature_filter:
+            continue
+        old = (
+            KeyValue((key, base_ds.get_feature_promise(pks)))
+            if old_oid is not None
+            else None
+        )
+        new = (
+            KeyValue((key, target_ds.get_feature_promise(pks)))
+            if new_oid is not None
+            else None
+        )
+        result.add_delta(Delta(old, new))
+    return result
+
+
+def get_meta_diff(base_ds, target_ds, ds_filter=None):
+    """DeltaDiff of meta items between two versions of a dataset."""
+    meta_filter = ds_filter["meta"] if ds_filter is not None else None
+    old_items = base_ds.meta_items() if base_ds else {}
+    new_items = target_ds.meta_items() if target_ds else {}
+    result = DeltaDiff()
+    for name in sorted(old_items.keys() | new_items.keys()):
+        if meta_filter is not None and name not in meta_filter:
+            continue
+        old_value = old_items.get(name)
+        new_value = new_items.get(name)
+        if old_value == new_value:
+            continue
+        old = KeyValue((name, old_value)) if old_value is not None else None
+        new = KeyValue((name, new_value)) if new_value is not None else None
+        result.add_delta(Delta(old, new))
+    return result
+
+
+def get_dataset_diff(
+    base_rs, target_rs, ds_path, *, ds_filter=None, include_wc_diff=False, workdir_diff_cache=None
+):
+    """DatasetDiff for one dataset between two revisions (plus the working
+    copy on top when include_wc_diff) (reference: diff_util.py:51-95)."""
+    base_ds = base_rs.datasets.get(ds_path) if base_rs is not None else None
+    target_ds = target_rs.datasets.get(ds_path) if target_rs is not None else None
+
+    diff = DatasetDiff()
+    if base_ds is None and target_ds is None:
+        return diff
+    diff["meta"] = get_meta_diff(base_ds, target_ds, ds_filter)
+    diff["feature"] = get_feature_diff(base_ds, target_ds, ds_filter)
+
+    if include_wc_diff:
+        if target_ds is None:
+            raise ValueError("Cannot diff working copy against a deleted dataset")
+        wc = target_rs.repo.working_copy
+        if wc is not None:
+            wc_diff = wc.diff_dataset_to_working_copy(
+                target_ds, ds_filter=ds_filter, workdir_diff_cache=workdir_diff_cache
+            )
+            diff = DatasetDiff.concatenated(diff, wc_diff)
+    diff.prune()
+    return diff
+
+
+def get_repo_diff(
+    base_rs,
+    target_rs,
+    *,
+    repo_key_filter=None,
+    include_wc_diff=False,
+):
+    """RepoDiff between two revisions (reference: diff_util.py:27-50)."""
+    repo_key_filter = repo_key_filter or RepoKeyFilter.MATCH_ALL_FILTER()
+    base_paths = set(base_rs.datasets.paths()) if base_rs is not None else set()
+    target_paths = set(target_rs.datasets.paths()) if target_rs is not None else set()
+    all_paths = sorted(base_paths | target_paths)
+
+    repo_diff = RepoDiff()
+    for ds_path in all_paths:
+        if ds_path not in repo_key_filter:
+            continue
+        ds_diff = get_dataset_diff(
+            base_rs,
+            target_rs,
+            ds_path,
+            ds_filter=repo_key_filter[ds_path],
+            include_wc_diff=include_wc_diff,
+        )
+        if ds_diff:
+            repo_diff[ds_path] = ds_diff
+    # dataset diffs are already pruned; only drop datasets left empty
+    repo_diff.prune(recurse=False)
+    return repo_diff

@@ -1,0 +1,441 @@
+"""Import sources (reference: kart/import_source.py, ogr_import_source.py,
+sqlalchemy_import_source.py).
+
+No OGR in this stack: GPKG is read directly with stdlib sqlite3 (the format
+the reference's test data uses), GeoJSON/CSV with stdlib parsers. Each source
+exposes schema, meta items, CRS definitions and a feature stream.
+"""
+
+import csv
+import json
+import os
+import sqlite3
+
+from kart_tpu.adapters import gpkg as gpkg_adapter
+from kart_tpu.core.serialise import ensure_text
+from kart_tpu.crs import get_identifier_str
+from kart_tpu.geometry import Geometry, geojson_to_geometry
+from kart_tpu.models.schema import ColumnSchema, Schema
+
+
+class ImportSourceError(ValueError):
+    pass
+
+
+class ImportSource:
+    """A table to import: schema + streamed features + meta."""
+
+    dest_path = None
+
+    def default_dest_path(self):
+        raise NotImplementedError
+
+    @property
+    def schema(self) -> Schema:
+        raise NotImplementedError
+
+    def features(self):
+        raise NotImplementedError
+
+    @property
+    def feature_count(self):
+        return sum(1 for _ in self.features())
+
+    def meta_items(self):
+        """{'title': ..., 'description': ..., 'crs/<id>.wkt': ...}"""
+        return {}
+
+    def crs_definitions(self):
+        """{identifier: wkt}"""
+        return {}
+
+    @classmethod
+    def open(cls, spec, table=None):
+        """Sniff a path/spec -> list of ImportSource (one per table)
+        (reference: import_source.py:26)."""
+        if spec.endswith(".gpkg"):
+            return GPKGImportSource.open_all(spec, table=table)
+        if spec.endswith((".geojson", ".json")):
+            return [GeoJSONImportSource(spec)]
+        if spec.endswith(".csv"):
+            return [CSVImportSource(spec)]
+        raise ImportSourceError(
+            f"Don't know how to import {spec!r} — "
+            f"supported: .gpkg, .geojson, .csv"
+        )
+
+
+class GPKGImportSource(ImportSource):
+    def __init__(self, gpkg_path, table_name, dest_path=None):
+        if not os.path.exists(gpkg_path):
+            raise ImportSourceError(f"No such file: {gpkg_path}")
+        self.gpkg_path = gpkg_path
+        self.table_name = table_name
+        self.dest_path = dest_path or table_name
+        self._schema = None
+        self._geom_col = None
+        self._crs_defs = None
+
+    @classmethod
+    def open_all(cls, gpkg_path, table=None):
+        con = sqlite3.connect(gpkg_path)
+        try:
+            tables = [
+                row[0]
+                for row in con.execute(
+                    "SELECT table_name FROM gpkg_contents "
+                    "WHERE data_type IN ('features', 'attributes') ORDER BY table_name"
+                )
+            ]
+        except sqlite3.OperationalError:
+            raise ImportSourceError(f"{gpkg_path} is not a GeoPackage")
+        finally:
+            con.close()
+        if table is not None:
+            if table not in tables:
+                raise ImportSourceError(
+                    f"Table {table!r} not found in {gpkg_path}; has: {tables}"
+                )
+            tables = [table]
+        return [cls(gpkg_path, t) for t in tables]
+
+    def _connect(self):
+        con = sqlite3.connect(self.gpkg_path)
+        con.row_factory = sqlite3.Row
+        return con
+
+    def _geom_info(self, con):
+        try:
+            row = con.execute(
+                "SELECT column_name, geometry_type_name, srs_id, z, m "
+                "FROM gpkg_geometry_columns WHERE table_name = ?",
+                (self.table_name,),
+            ).fetchone()
+        except sqlite3.OperationalError:
+            return None
+        return dict(row) if row else None
+
+    def _load_schema(self):
+        con = self._connect()
+        try:
+            geom_info = self._geom_info(con)
+            crs_identifier = None
+            crs_defs = {}
+            if geom_info and geom_info["srs_id"] is not None:
+                srs = con.execute(
+                    "SELECT * FROM gpkg_spatial_ref_sys WHERE srs_id = ?",
+                    (geom_info["srs_id"],),
+                ).fetchone()
+                if srs is not None and srs["srs_id"] > 0:
+                    wkt = srs["definition"]
+                    crs_identifier = (
+                        f"{srs['organization'].upper()}:{srs['organization_coordsys_id']}"
+                        if srs["organization"]
+                        else get_identifier_str(wkt)
+                    )
+                    crs_defs[crs_identifier] = wkt
+            cols = []
+            for row in con.execute(f"PRAGMA table_info({gpkg_adapter.quote(self.table_name)})"):
+                name, decl_type = row["name"], row["type"]
+                is_geom = geom_info is not None and name == geom_info["column_name"]
+                data_type, extra = gpkg_adapter.sqlite_type_to_v2(
+                    decl_type,
+                    geom_info={**geom_info, "crs_identifier": crs_identifier}
+                    if is_geom
+                    else None,
+                )
+                # table_info's pk column is 1-based pk ordinal (0 = not pk);
+                # composite pks map to contiguous pk_index values and get the
+                # hash-distributed path encoder automatically.
+                pk_index = row["pk"] - 1 if row["pk"] > 0 else None
+                if pk_index is not None and data_type == "integer":
+                    extra = {**extra, "size": 64}
+                cols.append(
+                    ColumnSchema(
+                        ColumnSchema.deterministic_id(self.gpkg_path, self.table_name, name),
+                        name,
+                        data_type,
+                        pk_index,
+                        extra,
+                    )
+                )
+            self._schema = Schema(cols)
+            self._crs_defs = crs_defs
+            self._geom_col = geom_info["column_name"] if geom_info else None
+        finally:
+            con.close()
+
+    @property
+    def schema(self):
+        if self._schema is None:
+            self._load_schema()
+        return self._schema
+
+    def crs_definitions(self):
+        if self._crs_defs is None:
+            self._load_schema()
+        return self._crs_defs
+
+    def meta_items(self):
+        con = self._connect()
+        try:
+            out = {}
+            row = con.execute(
+                "SELECT identifier, description FROM gpkg_contents WHERE table_name = ?",
+                (self.table_name,),
+            ).fetchone()
+            if row:
+                if row["identifier"]:
+                    out["title"] = row["identifier"]
+                if row["description"]:
+                    out["description"] = row["description"]
+            return out
+        finally:
+            con.close()
+
+    @property
+    def feature_count(self):
+        con = self._connect()
+        try:
+            return con.execute(
+                f"SELECT COUNT(*) FROM {gpkg_adapter.quote(self.table_name)}"
+            ).fetchone()[0]
+        finally:
+            con.close()
+
+    def features(self):
+        schema = self.schema
+        con = self._connect()
+        try:
+            cursor = con.execute(
+                f"SELECT * FROM {gpkg_adapter.quote(self.table_name)}"
+            )
+            cursor.arraysize = 10000
+            while True:
+                rows = cursor.fetchmany()
+                if not rows:
+                    break
+                for row in rows:
+                    yield {
+                        col.name: gpkg_adapter.value_to_v2(row[col.name], col)
+                        for col in schema.columns
+                    }
+        finally:
+            con.close()
+
+    def default_dest_path(self):
+        return self.table_name
+
+
+class GeoJSONImportSource(ImportSource):
+    """A GeoJSON FeatureCollection file. Properties define the schema
+    (sniffed from values); an ``id``/``fid`` property becomes the pk, else one
+    is auto-assigned."""
+
+    def __init__(self, path, dest_path=None, crs="EPSG:4326"):
+        if not os.path.exists(path):
+            raise ImportSourceError(f"No such file: {path}")
+        self.path = path
+        base = os.path.splitext(os.path.basename(path))[0]
+        self.dest_path = dest_path or base
+        self.crs = crs
+        with open(path) as f:
+            doc = json.load(f)
+        if doc.get("type") != "FeatureCollection":
+            raise ImportSourceError(f"{path} is not a GeoJSON FeatureCollection")
+        self._features_json = doc.get("features", [])
+        self._schema = self._sniff_schema()
+
+    def _sniff_schema(self):
+        prop_types = {}
+        has_geom = False
+        pk_name = None
+        for feat in self._features_json:
+            if feat.get("geometry") is not None:
+                has_geom = True
+            for key, value in (feat.get("properties") or {}).items():
+                if value is None:
+                    prop_types.setdefault(key, None)
+                    continue
+                t = {bool: "boolean", int: "integer", float: "float", str: "text"}.get(
+                    type(value), "text"
+                )
+                prev = prop_types.get(key)
+                if prev in (None, "integer") and t == "float":
+                    prop_types[key] = "float"
+                elif prev is None or prev == t:
+                    prop_types[key] = t
+                elif {prev, t} == {"integer", "float"}:
+                    prop_types[key] = "float"
+                else:
+                    prop_types[key] = "text"
+        for candidate in ("id", "fid"):
+            if prop_types.get(candidate) == "integer":
+                pk_name = candidate
+                break
+        cols = []
+        if pk_name is None:
+            pk_name = "auto_pk"
+            cols.append(
+                ColumnSchema(
+                    ColumnSchema.deterministic_id(self.path, "auto_pk"),
+                    "auto_pk",
+                    "integer",
+                    0,
+                    {"size": 64},
+                )
+            )
+        self._pk_name = pk_name
+        for name, t in prop_types.items():
+            cols.append(
+                ColumnSchema(
+                    ColumnSchema.deterministic_id(self.path, name),
+                    name,
+                    t or "text",
+                    0 if name == pk_name else None,
+                    {"size": 64} if name == pk_name else {},
+                )
+            )
+        if has_geom:
+            cols.append(
+                ColumnSchema(
+                    ColumnSchema.deterministic_id(self.path, "__geom__"),
+                    "geom",
+                    "geometry",
+                    None,
+                    {"geometryType": "GEOMETRY", "geometryCRS": self.crs},
+                )
+            )
+        # pk column first
+        cols.sort(key=lambda c: 0 if c.pk_index is not None else 1)
+        return Schema(cols)
+
+    @property
+    def schema(self):
+        return self._schema
+
+    def crs_definitions(self):
+        from kart_tpu.crs import make_crs
+
+        if any(c.data_type == "geometry" for c in self._schema.columns):
+            try:
+                return {self.crs: make_crs(self.crs).wkt}
+            except Exception:
+                return {}
+        return {}
+
+    @property
+    def feature_count(self):
+        return len(self._features_json)
+
+    def features(self):
+        auto_pk = 1
+        for feat in self._features_json:
+            props = feat.get("properties") or {}
+            out = {}
+            for col in self._schema.columns:
+                if col.name == "geom" and col.data_type == "geometry":
+                    geom = feat.get("geometry")
+                    out["geom"] = geojson_to_geometry(geom) if geom else None
+                elif col.name == "auto_pk" and col.name not in props:
+                    out[col.name] = auto_pk
+                else:
+                    value = props.get(col.name)
+                    if col.data_type == "float" and isinstance(value, int):
+                        value = float(value)
+                    out[col.name] = value
+            auto_pk += 1
+            yield out
+
+
+class CSVImportSource(ImportSource):
+    """CSV with a header row; all columns text unless values parse as
+    int/float across the whole file. First column named id/fid (int) is pk."""
+
+    def __init__(self, path, dest_path=None):
+        if not os.path.exists(path):
+            raise ImportSourceError(f"No such file: {path}")
+        self.path = path
+        self.dest_path = dest_path or os.path.splitext(os.path.basename(path))[0]
+        with open(path, newline="") as f:
+            reader = csv.reader(f)
+            self.header = next(reader)
+            self.rows = list(reader)
+        self._schema = self._sniff_schema()
+
+    @staticmethod
+    def _sniff_type(values):
+        saw_float = False
+        for v in values:
+            if v == "":
+                continue
+            try:
+                int(v)
+            except ValueError:
+                try:
+                    float(v)
+                    saw_float = True
+                except ValueError:
+                    return "text"
+        return "float" if saw_float else "integer"
+
+    def _sniff_schema(self):
+        types = {}
+        for i, name in enumerate(self.header):
+            types[name] = self._sniff_type([r[i] for r in self.rows if i < len(r)])
+        pk_name = None
+        for candidate in ("id", "fid", self.header[0]):
+            if types.get(candidate) == "integer":
+                pk_name = candidate
+                break
+        cols = []
+        if pk_name is None:
+            pk_name = "auto_pk"
+            cols.append(
+                ColumnSchema(
+                    ColumnSchema.deterministic_id(self.path, "auto_pk"),
+                    "auto_pk", "integer", 0, {"size": 64},
+                )
+            )
+        self._pk_name = pk_name
+        for name in self.header:
+            t = types[name]
+            cols.append(
+                ColumnSchema(
+                    ColumnSchema.deterministic_id(self.path, name),
+                    name,
+                    t,
+                    0 if name == pk_name else None,
+                    {"size": 64} if name == pk_name else {},
+                )
+            )
+        cols.sort(key=lambda c: 0 if c.pk_index is not None else 1)
+        return Schema(cols)
+
+    @property
+    def schema(self):
+        return self._schema
+
+    @property
+    def feature_count(self):
+        return len(self.rows)
+
+    def features(self):
+        # row values follow the *header* order, not the pk-first schema order
+        cols_by_name = {c.name: c for c in self._schema.columns}
+        for i, row in enumerate(self.rows):
+            out = {}
+            if self._pk_name == "auto_pk":
+                out["auto_pk"] = i + 1
+            for j, name in enumerate(self.header):
+                col = cols_by_name[name]
+                raw = row[j] if j < len(row) else ""
+                if raw == "":
+                    out[name] = None
+                elif col.data_type == "integer":
+                    out[name] = int(raw)
+                elif col.data_type == "float":
+                    out[name] = float(raw)
+                else:
+                    out[name] = raw
+            yield out

@@ -1,0 +1,109 @@
+"""Test fixture builders: tiny GeoPackages made with raw sqlite3 (mirroring
+the reference's tests/data/*.tgz known-answer style, SURVEY.md §4)."""
+
+import sqlite3
+import struct
+
+from kart_tpu.crs import NZTM_WKT, WGS84_WKT
+
+
+def gpkg_point(x, y, srs_id=4326):
+    """Minimal GPKG binary for a 2D point."""
+    header = b"GP\x00\x01" + struct.pack("<i", srs_id)
+    wkb = struct.pack("<BI2d", 1, 1, x, y)
+    return header + wkb
+
+
+def create_points_gpkg(path, n=10, *, table="points", srs_id=4326):
+    """A GPKG with n point features: fid pk, geom, name text, rating real."""
+    con = sqlite3.connect(path)
+    con.executescript(
+        """
+        CREATE TABLE gpkg_contents (
+            table_name TEXT NOT NULL PRIMARY KEY, data_type TEXT NOT NULL,
+            identifier TEXT UNIQUE, description TEXT DEFAULT '',
+            last_change DATETIME, min_x DOUBLE, min_y DOUBLE,
+            max_x DOUBLE, max_y DOUBLE, srs_id INTEGER);
+        CREATE TABLE gpkg_geometry_columns (
+            table_name TEXT NOT NULL, column_name TEXT NOT NULL,
+            geometry_type_name TEXT NOT NULL, srs_id INTEGER NOT NULL,
+            z TINYINT NOT NULL, m TINYINT NOT NULL,
+            CONSTRAINT pk_geom_cols PRIMARY KEY (table_name, column_name));
+        CREATE TABLE gpkg_spatial_ref_sys (
+            srs_name TEXT NOT NULL, srs_id INTEGER NOT NULL PRIMARY KEY,
+            organization TEXT NOT NULL, organization_coordsys_id INTEGER NOT NULL,
+            definition TEXT NOT NULL, description TEXT);
+        """
+    )
+    wkt = WGS84_WKT if srs_id == 4326 else NZTM_WKT
+    con.execute(
+        "INSERT INTO gpkg_spatial_ref_sys VALUES (?, ?, 'EPSG', ?, ?, NULL)",
+        ("WGS 84" if srs_id == 4326 else "NZTM", srs_id, srs_id, wkt),
+    )
+    con.execute(
+        "INSERT INTO gpkg_contents (table_name, data_type, identifier, srs_id) "
+        "VALUES (?, 'features', ?, ?)",
+        (table, f"{table} title", srs_id),
+    )
+    con.execute(
+        "INSERT INTO gpkg_geometry_columns VALUES (?, 'geom', 'POINT', ?, 0, 0)",
+        (table, srs_id),
+    )
+    con.execute(
+        f"CREATE TABLE {table} ("
+        "fid INTEGER PRIMARY KEY AUTOINCREMENT NOT NULL, "
+        "geom POINT, name TEXT, rating REAL)"
+    )
+    for i in range(1, n + 1):
+        con.execute(
+            f"INSERT INTO {table} (fid, geom, name, rating) VALUES (?, ?, ?, ?)",
+            (i, gpkg_point(100.0 + i, -40.0 - i * 0.1, srs_id), f"feature-{i}", i / 2.0),
+        )
+    con.commit()
+    con.close()
+    return path
+
+
+def create_attributes_gpkg(path, n=5, *, table="records"):
+    """A geometry-less (attributes) GPKG table."""
+    con = sqlite3.connect(path)
+    con.executescript(
+        """
+        CREATE TABLE gpkg_contents (
+            table_name TEXT NOT NULL PRIMARY KEY, data_type TEXT NOT NULL,
+            identifier TEXT UNIQUE, description TEXT DEFAULT '',
+            last_change DATETIME, min_x DOUBLE, min_y DOUBLE,
+            max_x DOUBLE, max_y DOUBLE, srs_id INTEGER);
+        """
+    )
+    con.execute(
+        "INSERT INTO gpkg_contents (table_name, data_type, identifier) "
+        "VALUES (?, 'attributes', ?)",
+        (table, table),
+    )
+    con.execute(
+        f"CREATE TABLE {table} ("
+        "id INTEGER PRIMARY KEY NOT NULL, code TEXT, amount MEDIUMINT, flag BOOLEAN)"
+    )
+    for i in range(1, n + 1):
+        con.execute(
+            f"INSERT INTO {table} VALUES (?, ?, ?, ?)",
+            (i, f"C{i:03d}", i * 100, i % 2),
+        )
+    con.commit()
+    con.close()
+    return path
+
+
+def make_imported_repo(tmp_path, *, n=10):
+    """init + import points.gpkg -> (repo, ds_path)."""
+    from kart_tpu.core.repo import KartRepo
+    from kart_tpu.importer import ImportSource
+    from kart_tpu.importer.importer import import_sources
+
+    gpkg = create_points_gpkg(str(tmp_path / "points.gpkg"), n=n)
+    repo = KartRepo.init_repository(tmp_path / "repo")
+    repo.config.set_many({"user.name": "Tester", "user.email": "t@example.com"})
+    sources = ImportSource.open(gpkg)
+    import_sources(repo, sources)
+    return repo, "points"

@@ -1,0 +1,221 @@
+import subprocess
+import os
+
+import pytest
+
+from kart_tpu.diff.engine import get_repo_diff
+from kart_tpu.diff.key_filters import RepoKeyFilter
+from kart_tpu.diff.structs import Delta, DeltaDiff, DatasetDiff, KeyValue, RepoDiff
+from kart_tpu.geometry import Geometry
+
+from helpers import make_imported_repo, create_attributes_gpkg
+
+
+@pytest.fixture
+def points_repo(tmp_path):
+    return make_imported_repo(tmp_path, n=10)
+
+
+def test_import_creates_dataset(points_repo):
+    repo, ds_path = points_repo
+    datasets = repo.datasets()
+    assert datasets.paths() == [ds_path]
+    ds = datasets[ds_path]
+    assert ds.schema.column_names == ["fid", "geom", "name", "rating"]
+    assert ds.feature_count == 10
+    assert ds.get_meta_item("title") == "points title"
+    assert ds.crs_identifiers() == ["EPSG:4326"]
+    assert ds.path_encoder.scheme == "int"
+
+
+def test_imported_feature_values(points_repo):
+    repo, ds_path = points_repo
+    ds = repo.datasets()[ds_path]
+    f = ds.get_feature([3])
+    assert f["fid"] == 3
+    assert f["name"] == "feature-3"
+    assert f["rating"] == 1.5
+    geom = f["geom"]
+    assert isinstance(geom, Geometry)
+    assert geom.crs_id == 0  # normalised for storage
+    assert geom.to_wkt() == "POINT (103 -40.3)"
+
+
+def test_import_attributes_table(tmp_path):
+    from kart_tpu.core.repo import KartRepo
+    from kart_tpu.importer import ImportSource
+    from kart_tpu.importer.importer import import_sources
+
+    gpkg = create_attributes_gpkg(str(tmp_path / "r.gpkg"))
+    repo = KartRepo.init_repository(tmp_path / "repo")
+    repo.config.set_many({"user.name": "T", "user.email": "t@e"})
+    import_sources(repo, ImportSource.open(gpkg))
+    ds = repo.datasets()["records"]
+    assert [c.data_type for c in ds.schema] == ["integer", "text", "integer", "boolean"]
+    f = ds.get_feature([2])
+    assert f == {"id": 2, "code": "C002", "amount": 200, "flag": False}
+
+
+def edit_commit(repo, ds_path, *, inserts=(), updates=(), deletes=()):
+    """Build a feature diff and commit it; -> commit oid."""
+    structure = repo.structure("HEAD")
+    ds = structure.datasets[ds_path]
+    feature_diff = DeltaDiff()
+    for f in inserts:
+        feature_diff.add_delta(Delta.insert(KeyValue((f["fid"], f))))
+    for f in updates:
+        old = ds.get_feature([f["fid"]])
+        feature_diff.add_delta(Delta.update(KeyValue((f["fid"], old)), KeyValue((f["fid"], f))))
+    for pk in deletes:
+        old = ds.get_feature([pk])
+        feature_diff.add_delta(Delta.delete(KeyValue((pk, old))))
+    ds_diff = DatasetDiff()
+    ds_diff["feature"] = feature_diff
+    repo_diff = RepoDiff()
+    repo_diff[ds_path] = ds_diff
+    return structure.commit_diff(repo_diff, "edit features")
+
+
+def test_edit_and_diff(points_repo):
+    repo, ds_path = points_repo
+    c1 = repo.head_commit_oid
+    new_feature = {
+        "fid": 99,
+        "geom": Geometry.from_wkt("POINT (111 -41)"),
+        "name": "new-one",
+        "rating": 9.0,
+    }
+    updated = {
+        "fid": 2,
+        "geom": Geometry.from_wkt("POINT (102 -40.2)"),
+        "name": "renamed-2",
+        "rating": 1.0,
+    }
+    c2 = edit_commit(repo, ds_path, inserts=[new_feature], updates=[updated], deletes=[5, 7])
+
+    diff = get_repo_diff(repo.structure(c1), repo.structure(c2))
+    fd = diff[ds_path]["feature"]
+    assert set(fd.keys()) == {99, 2, 5, 7}
+    assert fd[99].type == "insert"
+    assert fd[99].new_value["name"] == "new-one"
+    assert fd[2].type == "update"
+    assert fd[2].old_value["name"] == "feature-2"
+    assert fd[2].new_value["name"] == "renamed-2"
+    assert fd[5].type == "delete"
+    assert diff.feature_count() == 4
+
+    # inverted direction
+    rdiff = get_repo_diff(repo.structure(c2), repo.structure(c1))
+    assert rdiff[ds_path]["feature"][99].type == "delete"
+
+    # unchanged features decode identically in both revisions
+    ds1 = repo.structure(c1).datasets[ds_path]
+    ds2 = repo.structure(c2).datasets[ds_path]
+    assert ds1.get_feature([1]) == ds2.get_feature([1])
+
+
+def test_diff_with_key_filter(points_repo):
+    repo, ds_path = points_repo
+    c1 = repo.head_commit_oid
+    updated = {
+        "fid": 2,
+        "geom": Geometry.from_wkt("POINT (0 0)"),
+        "name": "x",
+        "rating": None,
+    }
+    c2 = edit_commit(repo, ds_path, updates=[updated], deletes=[3])
+    flt = RepoKeyFilter.build_from_user_patterns([f"{ds_path}:2"])
+    diff = get_repo_diff(repo.structure(c1), repo.structure(c2), repo_key_filter=flt)
+    assert set(diff[ds_path]["feature"].keys()) == {2}
+
+
+def test_commit_diff_conflict_detection(points_repo):
+    from kart_tpu.core.structure import PatchApplyError
+
+    repo, ds_path = points_repo
+    structure = repo.structure("HEAD")
+    ds = structure.datasets[ds_path]
+    # old value doesn't match what's stored -> conflict
+    wrong_old = dict(ds.get_feature([1]), name="not-the-real-value")
+    fd = DeltaDiff()
+    fd.add_delta(Delta.delete(KeyValue((1, wrong_old))))
+    dsd = DatasetDiff()
+    dsd["feature"] = fd
+    rd = RepoDiff()
+    rd[ds_path] = dsd
+    with pytest.raises(PatchApplyError):
+        structure.commit_diff(rd, "should fail")
+
+
+def test_commit_diff_schema_validation(points_repo):
+    from kart_tpu.core.structure import SchemaViolation
+
+    repo, ds_path = points_repo
+    structure = repo.structure("HEAD")
+    bad = {"fid": 50, "geom": None, "name": 12345, "rating": None}  # name not text
+    fd = DeltaDiff()
+    fd.add_delta(Delta.insert(KeyValue((50, bad))))
+    dsd = DatasetDiff()
+    dsd["feature"] = fd
+    rd = RepoDiff()
+    rd[ds_path] = dsd
+    with pytest.raises(SchemaViolation):
+        structure.commit_diff(rd, "bad types")
+
+
+def test_meta_diff(points_repo):
+    repo, ds_path = points_repo
+    c1 = repo.head_commit_oid
+    structure = repo.structure("HEAD")
+    md = DeltaDiff()
+    md.add_delta(
+        Delta.update(
+            KeyValue(("title", "points title")), KeyValue(("title", "Better Title"))
+        )
+    )
+    dsd = DatasetDiff()
+    dsd["meta"] = md
+    rd = RepoDiff()
+    rd[ds_path] = dsd
+    c2 = structure.commit_diff(rd, "retitle")
+    diff = get_repo_diff(repo.structure(c1), repo.structure(c2))
+    assert diff[ds_path]["meta"]["title"].new_value == "Better Title"
+    assert "feature" not in diff[ds_path]
+
+
+def test_dataset_addition_shows_as_insert_diff(points_repo, tmp_path):
+    from kart_tpu.importer import ImportSource
+    from kart_tpu.importer.importer import import_sources
+
+    repo, ds_path = points_repo
+    c1 = repo.head_commit_oid
+    gpkg2 = create_attributes_gpkg(str(tmp_path / "more.gpkg"))
+    c2 = import_sources(repo, ImportSource.open(gpkg2))
+    diff = get_repo_diff(repo.structure(c1), repo.structure(c2))
+    assert set(diff.keys()) == {"records"}
+    assert all(d.type == "insert" for d in diff["records"]["feature"].values())
+    assert "schema.json" in diff["records"]["meta"]
+
+
+def test_import_interop_with_git(points_repo, tmp_path):
+    repo, _ = points_repo
+    env = {
+        **os.environ,
+        "GIT_DIR": repo.gitdir,
+        "GIT_INDEX_FILE": str(tmp_path / "scratch-index"),
+    }
+    out = subprocess.run(
+        ["git", "fsck", "--strict", "--no-progress"],
+        env=env, capture_output=True, text=True,
+    )
+    assert out.returncode == 0, out.stderr
+    ls = subprocess.run(
+        ["git", "ls-tree", "-r", "--name-only", "HEAD"],
+        env=env, capture_output=True, text=True,
+    ).stdout
+    assert "points/.table-dataset/meta/schema.json" in ls
+    assert "points/.table-dataset/meta/path-structure.json" in ls
+    # feature paths live under the 4-level fanout
+    assert any(
+        line.startswith("points/.table-dataset/feature/A/A/A/A/") for line in ls.splitlines()
+    )
