@@ -11,6 +11,8 @@ Two layers:
   kart_tpu/ops/diff_kernel.py for the device kernels.
 """
 
+import numpy as np
+
 from kart_tpu.core.odb import TreeView
 from kart_tpu.diff.key_filters import RepoKeyFilter
 from kart_tpu.diff.structs import (
@@ -86,6 +88,81 @@ def get_feature_diff(base_ds, target_ds, ds_filter=None):
             else None
         )
         result.add_delta(Delta(old, new))
+    return result
+
+
+def get_feature_diff_columnar(base_ds, target_ds, ds_filter=None):
+    """Bulk columnar variant of get_feature_diff: both versions' (pk, oid)
+    arrays are classified in one jitted device join, and only changed rows
+    get (lazy) Deltas. Semantically identical to the tree-diff path; chosen
+    when both sides are materialised anyway (working-copy compare, merge,
+    benchmarks). O(N) device work instead of O(changed) host tree-walk."""
+    from kart_tpu.ops.blocks import FeatureBlock
+    from kart_tpu.ops.diff_kernel import (
+        DELETE,
+        INSERT,
+        UPDATE,
+        changed_indices,
+        classify_blocks,
+    )
+
+    def empty_block():
+        return FeatureBlock.from_arrays(
+            np.zeros(0, dtype=np.int64), np.zeros((0, 5), dtype=np.uint32), []
+        )
+
+    feature_filter = ds_filter["feature"] if ds_filter is not None else None
+    result = DeltaDiff()
+    old_block = FeatureBlock.from_dataset(base_ds) if base_ds is not None else empty_block()
+    new_block = FeatureBlock.from_dataset(target_ds) if target_ds is not None else empty_block()
+    if old_block.has_key_collisions() or new_block.has_key_collisions():
+        # 63-bit hash identity collided (hash-encoded dataset): fall back to
+        # the exact tree-diff path
+        return get_feature_diff(base_ds, target_ds, ds_filter)
+
+    old_class, new_class, _ = classify_blocks(old_block, new_block)
+    old_idx, new_idx = changed_indices(old_class, new_class)
+
+    # Cross-version collision guard (hash-encoded datasets): a deleted pk X
+    # and an inserted pk Y can share a 63-bit key, which the join would
+    # misread as an update of X. Every matched-but-changed (UPDATE) pair must
+    # refer to the same blob filename on both sides; otherwise fall back.
+    hash_keyed = getattr(base_ds or target_ds, "path_encoder", None) is not None and (
+        (base_ds or target_ds).path_encoder.scheme != "int"
+    )
+    if hash_keyed:
+        new_changed_filenames = {
+            new_block.path_for_index(int(i)).rsplit("/", 1)[-1]
+            for i in new_idx
+        }
+        for i in old_idx:
+            if old_class[i] == UPDATE:
+                fn = old_block.path_for_index(int(i)).rsplit("/", 1)[-1]
+                if fn not in new_changed_filenames:
+                    return get_feature_diff(base_ds, target_ds, ds_filter)
+
+    for i in old_idx:
+        path = old_block.path_for_index(int(i))
+        pks = base_ds.decode_path_to_pks(path)
+        key = pks[0] if len(pks) == 1 else pks
+        if feature_filter is not None and key not in feature_filter:
+            continue
+        cls = old_class[i]
+        old_kv = KeyValue((key, base_ds.get_feature_promise(pks)))
+        if cls == DELETE:
+            result.add_delta(Delta.delete(old_kv))
+        else:  # UPDATE — new side added below keyed identically
+            new_kv = KeyValue((key, target_ds.get_feature_promise(pks)))
+            result.add_delta(Delta.update(old_kv, new_kv))
+    for i in new_idx:
+        if new_class[i] != INSERT:
+            continue  # updates already added
+        path = new_block.path_for_index(int(i))
+        pks = target_ds.decode_path_to_pks(path)
+        key = pks[0] if len(pks) == 1 else pks
+        if feature_filter is not None and key not in feature_filter:
+            continue
+        result.add_delta(Delta.insert(KeyValue((key, target_ds.get_feature_promise(pks)))))
     return result
 
 

@@ -384,7 +384,11 @@ def _decode_single_int_filenames(names):
     out = np.zeros(n, dtype=np.int64)
 
     def be_read(rows, start, nbytes):
-        acc = np.zeros(rows.sum(), dtype=np.uint64)
+        # raw is only as wide as the longest filename needs; a size-class mask
+        # that matches nothing must not index beyond that width
+        acc = np.zeros(int(rows.sum()), dtype=np.uint64)
+        if not len(acc):
+            return acc
         for b in range(nbytes):
             acc = (acc << np.uint64(8)) | raw[rows, start + b].astype(np.uint64)
         return acc
@@ -394,7 +398,8 @@ def _decode_single_int_filenames(names):
     m = marker >= 0xE0  # negative fixint
     out[m] = marker[m].astype(np.int64) - 0x100
     m = marker == 0xCC
-    out[m] = raw[m, 2]
+    if m.any():
+        out[m] = raw[m, 2]
     m = marker == 0xCD
     out[m] = be_read(m, 2, 2).astype(np.int64)
     m = marker == 0xCE
@@ -402,13 +407,15 @@ def _decode_single_int_filenames(names):
     m = marker == 0xCF
     out[m] = be_read(m, 2, 8).astype(np.int64)
     m = marker == 0xD0
-    out[m] = raw[m, 2].astype(np.int8)
+    if m.any():
+        out[m] = raw[m, 2].astype(np.int8)
     m = marker == 0xD1
     out[m] = be_read(m, 2, 2).astype(np.uint16).astype(np.int16)
     m = marker == 0xD2
     out[m] = be_read(m, 2, 4).astype(np.uint32).astype(np.int32)
     m = marker == 0xD3
-    out[m] = be_read(m, 2, 8).view(np.int64) if m.any() else out[m]
+    if m.any():
+        out[m] = be_read(m, 2, 8).view(np.int64)
     return out
 
 

@@ -1,0 +1,13 @@
+"""TPU compute kernels: columnar feature blocks, diff classification,
+bbox intersection, envelope codec.
+
+x64 is enabled here: feature identity keys are int64 (pks can exceed 2^31 and
+hash keys use the full 63 bits); without this JAX silently downcasts to int32,
+wrapping the pad sentinel and corrupting every sorted-join. The compute-heavy
+kernels (bbox, envelope) still use explicit f32/int8 — x64 only widens what is
+already 64-bit on the host.
+"""
+
+import jax
+
+jax.config.update("jax_enable_x64", True)

@@ -1,0 +1,157 @@
+"""Bounding-box intersection kernels — the spatial-filter hot path
+(reference: the C++ git object filter, vendor/spatial-filter/spatial_filter.cpp:187-260,
+and the Python fast path, kart/spatial_filter/__init__.py:709-734).
+
+Envelopes are (w, s, e, n) with longitudes cyclic over the anti-meridian:
+``e < w`` means the range wraps (reference spatial_filter.cpp handles the same
+encoding). Intersection of cyclic longitude ranges:
+
+    len1 = (e1 - w1) mod 360 ; len2 = (e2 - w2) mod 360
+    d    = (w2 - w1) mod 360
+    overlap  <=>  d <= len1  or  (360 - d) <= len2
+
+Three implementations with identical semantics:
+* ``bbox_intersects_np``    — numpy reference (host, tests)
+* ``bbox_intersects_jnp``   — jitted XLA (any backend)
+* ``bbox_intersects_pallas``— TPU Pallas kernel, tiled (8, 128) f32 over VMEM
+``bbox_intersects`` picks the best available for the current backend.
+"""
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def _cyclic_overlap_np(w1, e1, w2, e2):
+    len1 = np.mod(e1 - w1, 360.0)
+    len2 = np.mod(e2 - w2, 360.0)
+    d = np.mod(w2 - w1, 360.0)
+    return (d <= len1) | ((360.0 - d) <= len2)
+
+
+def bbox_intersects_np(envelopes, query):
+    """envelopes (N,4) float, query (4,) -> bool (N,). numpy reference."""
+    envelopes = np.asarray(envelopes, dtype=np.float64)
+    w, s, e, n = (envelopes[:, i] for i in range(4))
+    qw, qs, qe, qn = (float(query[i]) for i in range(4))
+    lat_ok = (s <= qn) & (qs <= n)
+    lon_ok = _cyclic_overlap_np(w, e, np.float64(qw), np.float64(qe))
+    return lat_ok & lon_ok
+
+
+@jax.jit
+def bbox_intersects_jnp(w, s, e, n, query):
+    """Columns (N,) f32 + query (4,) -> bool (N,). XLA path."""
+    qw, qs, qe, qn = query[0], query[1], query[2], query[3]
+    lat_ok = (s <= qn) & (qs <= n)
+    len1 = jnp.mod(e - w, 360.0)
+    len2 = jnp.mod(qe - qw, 360.0)
+    d = jnp.mod(qw - w, 360.0)
+    lon_ok = (d <= len1) | ((360.0 - d) <= len2)
+    return lat_ok & lon_ok
+
+
+def _bbox_kernel(query_ref, w_ref, s_ref, e_ref, n_ref, out_ref):
+    qw = query_ref[0]
+    qs = query_ref[1]
+    qe = query_ref[2]
+    qn = query_ref[3]
+    w = w_ref[:, :]
+    s = s_ref[:, :]
+    e = e_ref[:, :]
+    n = n_ref[:, :]
+    lat_ok = (s <= qn) & (qs <= n)
+    len1 = jnp.mod(e - w, 360.0)
+    len2 = jnp.mod(qe - qw, 360.0)
+    d = jnp.mod(qw - w, 360.0)
+    lon_ok = (d <= len1) | ((360.0 - d) <= len2)
+    out_ref[:, :] = (lat_ok & lon_ok).astype(jnp.int8)
+
+
+def bbox_intersects_pallas(w, s, e, n, query):
+    """TPU Pallas path. Inputs (N,) f32 with N a multiple of 1024; reshaped to
+    (N/128, 128) tiles. query (4,) f32 prefetched to SMEM.
+
+    Runs with x64 disabled: the package-level x64 (needed for int64 identity
+    keys) would make grid index maps emit i64, which Mosaic can't legalize —
+    and everything in this kernel is f32/int8 anyway.
+    """
+    with jax.enable_x64(False):
+        return _bbox_pallas_inner(w, s, e, n, query)
+
+
+@jax.jit
+def _bbox_pallas_inner(w, s, e, n, query):
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    n_items = w.shape[0]
+    rows = n_items // 128
+    shape2d = (rows, 128)
+    # pad_envelopes guarantees rows is a multiple of 8 (small inputs) or 512
+    # (large inputs), so the grid always divides exactly — a non-dividing
+    # grid would silently skip the tail rows
+    block_rows = 512 if rows % 512 == 0 else 8
+    assert rows % block_rows == 0, (rows, block_rows)
+    grid = (rows // block_rows,)
+
+    def index_map(i):
+        return (i, 0)
+
+    spec = pl.BlockSpec((block_rows, 128), index_map, memory_space=pltpu.VMEM)
+    out = pl.pallas_call(
+        _bbox_kernel,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec(memory_space=pltpu.SMEM),
+            spec,
+            spec,
+            spec,
+            spec,
+        ],
+        out_specs=pl.BlockSpec((block_rows, 128), index_map, memory_space=pltpu.VMEM),
+        out_shape=jax.ShapeDtypeStruct(shape2d, jnp.int8),
+    )(
+        query,
+        w.reshape(shape2d),
+        s.reshape(shape2d),
+        e.reshape(shape2d),
+        n.reshape(shape2d),
+    )
+    return out.reshape(n_items).astype(jnp.bool_)
+
+
+def pad_envelopes(envelopes, multiple=None):
+    """(N,4) -> (w,s,e,n) float32 columns padded to a multiple (1024 items =
+    8 rows for small inputs, 65536 items = 512 rows for large, keeping the
+    Pallas grid evenly divisible); padded rows get an empty range at latitude
+    91 (matches nothing)."""
+    n = envelopes.shape[0]
+    if multiple is None:
+        multiple = 65536 if n > 65536 else 1024
+    padded_n = ((n + multiple - 1) // multiple) * multiple if n else multiple
+    cols = np.full((4, padded_n), 91.0, dtype=np.float32)
+    if n:
+        cols[:, :n] = np.asarray(envelopes, dtype=np.float32).T
+    return cols[0], cols[1], cols[2], cols[3], n
+
+
+def bbox_intersects(envelopes, query):
+    """Best-available backend dispatch; envelopes (N,4), query (4,) ->
+    bool numpy (N,)."""
+    n = len(envelopes)
+    if n == 0:
+        return np.zeros(0, dtype=bool)
+    w, s, e, nn, count = pad_envelopes(np.asarray(envelopes))
+    q = jnp.asarray(np.asarray(query, dtype=np.float32))
+    if jax.default_backend() == "tpu":
+        mask = bbox_intersects_pallas(
+            jnp.asarray(w), jnp.asarray(s), jnp.asarray(e), jnp.asarray(nn), q
+        )
+    else:
+        mask = bbox_intersects_jnp(
+            jnp.asarray(w), jnp.asarray(s), jnp.asarray(e), jnp.asarray(nn), q
+        )
+    return np.asarray(mask)[:count]

@@ -1,0 +1,124 @@
+"""Columnar feature blocks — the bridge from blob-world to HBM
+(SURVEY.md §7 step 2).
+
+A FeatureBlock is the SoA form of one dataset version's feature identity:
+
+    keys : int64 (N,)   — int pk, or the top 64 bits of the path hash for
+                          hash-encoded datasets (uniformly distributed;
+                          collisions are detected host-side and disambiguated
+                          before device work)
+    oids : uint32 (N,5) — the feature blob's 20-byte content id, packed
+
+sorted by key. Two blocks of the same dataset at different revisions align by
+key, which is exactly the alignment git's tree layout provides for free via
+PK-determined paths (reference: dataset3_paths.py) — re-created here as sorted
+arrays so classification runs as one vectorized merge-join on device instead
+of a per-feature Python loop (reference hot loop #1, rich_base_dataset.py:205).
+
+Blocks are padded to bucketed sizes so jit traces are reused across calls
+(XLA compiles per shape). The pad sentinel key is int64.max, which sorts last
+and never equals a real key.
+"""
+
+import hashlib
+
+import numpy as np
+
+PAD_KEY = np.int64(2**63 - 1)
+
+
+def bucket_size(n, minimum=1024):
+    """Next power-of-two >= n (>= minimum) — bounds the number of distinct
+    shapes XLA ever compiles for."""
+    size = minimum
+    while size < n:
+        size *= 2
+    return size
+
+
+def pack_oid_hex(oids_hex):
+    """list of 40-hex oids -> (N, 5) uint32 array."""
+    if not len(oids_hex):
+        return np.zeros((0, 5), dtype=np.uint32)
+    raw = np.frombuffer(bytes.fromhex("".join(oids_hex)), dtype=np.uint8)
+    return raw.reshape(-1, 5, 4).view(np.uint32).reshape(-1, 5).copy()
+
+
+def unpack_oid_hex(oid_rows):
+    """(N, 5) uint32 -> list of 40-hex oids."""
+    raw = oid_rows.astype("<u4").view(np.uint8).reshape(-1, 20)
+    return [row.tobytes().hex() for row in raw]
+
+
+def hash_keys_for_paths(paths):
+    """Feature paths (hash-encoded datasets) -> int64 identity keys: the first
+    8 bytes (big-endian, sign-cleared) of sha256 of the blob *filename*.
+    Uniform over [0, 2^63): collision probability at 100M keys ~ 5e-4; the
+    caller must check `has_key_collisions` and disambiguate via paths."""
+    n = len(paths)
+    out = np.empty(n, dtype=np.int64)
+    for i, p in enumerate(paths):
+        name = p.rsplit("/", 1)[-1]
+        digest = hashlib.sha256(name.encode()).digest()
+        out[i] = int.from_bytes(digest[:8], "big") >> 1
+    return out
+
+
+class FeatureBlock:
+    """One dataset version as sorted (key, oid) arrays + the path strings
+    (kept host-side for value materialisation of changed rows only)."""
+
+    __slots__ = ("keys", "oids", "paths", "count")
+
+    def __init__(self, keys, oids, paths, count):
+        self.keys = keys
+        self.oids = oids
+        self.paths = paths  # list[str], in the same (sorted) order, len == count
+        self.count = count
+
+    @classmethod
+    def from_dataset(cls, dataset, pad=True):
+        paths, pk_arr, oid_u8 = dataset.feature_index()
+        oid_rows = (
+            oid_u8.reshape(-1, 5, 4).view(np.uint32).reshape(-1, 5)
+            if len(paths)
+            else np.zeros((0, 5), dtype=np.uint32)
+        )
+        if pk_arr is not None:
+            keys = pk_arr.astype(np.int64)
+        else:
+            keys = hash_keys_for_paths(paths)
+        return cls.from_arrays(keys, oid_rows, paths, pad=pad)
+
+    @classmethod
+    def from_arrays(cls, keys, oid_rows, paths, pad=True):
+        n = len(keys)
+        order = np.argsort(keys, kind="stable")
+        keys = keys[order]
+        oid_rows = oid_rows[order]
+        paths = [paths[i] for i in order]
+        if pad:
+            size = bucket_size(max(n, 1))
+            if size > n:
+                keys = np.concatenate([keys, np.full(size - n, PAD_KEY, dtype=np.int64)])
+                oid_rows = np.concatenate(
+                    [oid_rows, np.zeros((size - n, 5), dtype=np.uint32)]
+                )
+        return cls(keys, oid_rows, paths, n)
+
+    @property
+    def padded_size(self):
+        return len(self.keys)
+
+    def has_key_collisions(self):
+        real = self.keys[: self.count]
+        return bool(np.any(real[1:] == real[:-1])) if self.count > 1 else False
+
+    def path_for_index(self, i):
+        return self.paths[i]
+
+    def __len__(self):
+        return self.count
+
+    def __repr__(self):
+        return f"FeatureBlock(count={self.count}, padded={self.padded_size})"

@@ -1,0 +1,145 @@
+"""Vectorized diff classification — reference hot loop #1 as one jitted
+merge-join (SURVEY.md §3.1, rich_base_dataset.py:205-300).
+
+Given two FeatureBlocks (sorted key+oid arrays, padded), classification is a
+pair of ``searchsorted`` joins plus an elementwise oid compare — no Python
+per-feature work, no data-dependent control flow, static shapes: exactly the
+program XLA fuses into a few device loops. The same jitted function runs on
+TPU and CPU with identical results (the tests' bit-compat contract).
+
+Classes: 0 = unchanged, 1 = insert, 2 = update, 3 = delete.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+UNCHANGED = 0
+INSERT = 1
+UPDATE = 2
+DELETE = 3
+
+
+@jax.jit
+def _classify_padded(old_keys, old_oids, new_keys, new_oids, old_count, new_count):
+    """Core join. Padded inputs; counts are *dynamic* scalars so only the
+    padded (bucket) shapes drive compilation — each (old_bucket, new_bucket)
+    pair compiles exactly once."""
+    n_old = old_keys.shape[0]
+    n_new = new_keys.shape[0]
+    old_valid = jnp.arange(n_old) < old_count
+    new_valid = jnp.arange(n_new) < new_count
+
+    # old -> new join
+    idx_in_new = jnp.searchsorted(new_keys, old_keys)
+    idx_in_new_c = jnp.minimum(idx_in_new, n_new - 1)
+    old_found = (new_keys[idx_in_new_c] == old_keys) & (idx_in_new < n_new)
+    old_found &= idx_in_new_c < new_count
+    oid_same = jnp.all(
+        old_oids == new_oids[idx_in_new_c], axis=1
+    )
+    old_class = jnp.where(
+        old_valid,
+        jnp.where(
+            old_found,
+            jnp.where(oid_same, UNCHANGED, UPDATE),
+            DELETE,
+        ),
+        UNCHANGED,
+    ).astype(jnp.int8)
+
+    # new -> old join (only inserts remain to be found)
+    idx_in_old = jnp.searchsorted(old_keys, new_keys)
+    idx_in_old_c = jnp.minimum(idx_in_old, n_old - 1)
+    new_found = (old_keys[idx_in_old_c] == new_keys) & (idx_in_old < n_old)
+    new_found &= idx_in_old_c < old_count
+    new_class = jnp.where(
+        new_valid,
+        jnp.where(new_found, UNCHANGED, INSERT),
+        UNCHANGED,
+    ).astype(jnp.int8)
+    # mark updates on the new side too (same classification, new-row view)
+    new_oid_same = jnp.all(new_oids == old_oids[idx_in_old_c], axis=1)
+    new_class = jnp.where(
+        new_valid & new_found & ~new_oid_same, UPDATE, new_class
+    ).astype(jnp.int8)
+
+    counts = jnp.stack(
+        [
+            jnp.sum(new_class == INSERT),
+            jnp.sum(old_class == UPDATE),
+            jnp.sum(old_class == DELETE),
+        ]
+    )
+    return old_class, new_class, idx_in_new_c, counts
+
+
+def classify_blocks(old_block, new_block):
+    """FeatureBlock x2 -> (old_class np.int8 (n_old,), new_class (n_new,),
+    counts dict). Host wrapper: unpads and returns numpy."""
+    old_class, new_class, _, counts = _classify_padded(
+        jnp.asarray(old_block.keys),
+        jnp.asarray(old_block.oids),
+        jnp.asarray(new_block.keys),
+        jnp.asarray(new_block.oids),
+        old_block.count,
+        new_block.count,
+    )
+    old_class = np.asarray(old_class)[: old_block.count]
+    new_class = np.asarray(new_class)[: new_block.count]
+    counts = np.asarray(counts)
+    return (
+        old_class,
+        new_class,
+        {"inserts": int(counts[0]), "updates": int(counts[1]), "deletes": int(counts[2])},
+    )
+
+
+def classify_blocks_reference(old_block, new_block):
+    """Pure-numpy reference with identical semantics, for bit-compat tests."""
+    old_keys = old_block.keys[: old_block.count]
+    new_keys = new_block.keys[: new_block.count]
+    old_oids = old_block.oids[: old_block.count]
+    new_oids = new_block.oids[: new_block.count]
+
+    idx = np.searchsorted(new_keys, old_keys)
+    idxc = np.minimum(idx, max(len(new_keys) - 1, 0))
+    if len(new_keys):
+        found = (new_keys[idxc] == old_keys) & (idx < len(new_keys))
+        oid_same = np.all(old_oids == new_oids[idxc], axis=1)
+    else:
+        found = np.zeros(len(old_keys), dtype=bool)
+        oid_same = found
+    old_class = np.where(
+        found, np.where(oid_same, UNCHANGED, UPDATE), DELETE
+    ).astype(np.int8)
+
+    idx2 = np.searchsorted(old_keys, new_keys)
+    idx2c = np.minimum(idx2, max(len(old_keys) - 1, 0))
+    if len(old_keys):
+        found2 = (old_keys[idx2c] == new_keys) & (idx2 < len(old_keys))
+        oid_same2 = np.all(new_oids == old_oids[idx2c], axis=1)
+    else:
+        found2 = np.zeros(len(new_keys), dtype=bool)
+        oid_same2 = found2
+    new_class = np.where(
+        found2, np.where(oid_same2, UNCHANGED, UPDATE), INSERT
+    ).astype(np.int8)
+    return old_class, new_class
+
+
+def changed_indices(old_class, new_class):
+    """-> (old_changed_idx, new_changed_idx): row indices whose values need
+    materialising (everything except UNCHANGED)."""
+    return (
+        np.nonzero(old_class != UNCHANGED)[0],
+        np.nonzero(new_class != UNCHANGED)[0],
+    )
+
+
+@jax.jit
+def columnar_equal(old_cols, new_cols, null_mask_old, null_mask_new):
+    """Row equality over aligned columnar attribute data (the working-copy
+    compare, reference hot loop #2 base.py:722): all columns equal and same
+    null pattern. cols: (C, N) arrays (numeric/hash-encoded), masks (C, N)."""
+    return jnp.all((old_cols == new_cols) & (null_mask_old == null_mask_new), axis=0)

@@ -1,14 +1,23 @@
 """Test configuration.
 
-Tests run on a virtual 8-device CPU mesh (multi-chip TPU hardware is not
-available in CI): XLA_FLAGS must be set before jax initialises. The TPU
-kernels are written to be platform-polymorphic, and the CPU path is
-bit-compatible with the device path, so known-answer tests validate both
-(reference test strategy: SURVEY.md §4).
+Tests are backend-agnostic: the same jitted kernels run on whatever backend
+is live (the axon TPU tunnel in the dev container, plain CPU in CI). Tests
+that need a multi-device mesh skip unless >= 8 devices are visible.
+
+To run the mesh tests on a virtual 8-device CPU mesh use:
+
+    PYTHONPATH= JAX_PLATFORMS=cpu \
+        XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+        python -m pytest tests/ -x -q
+
+(PYTHONPATH must be cleared because the container's sitecustomize imports and
+registers the axon TPU backend at interpreter startup, before any env var or
+conftest can redirect jax to CPU.)
 """
 
 import os
 
+# Only effective when jax is not already imported (e.g. plain CI containers).
 os.environ.setdefault("JAX_PLATFORMS", "cpu")
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
@@ -16,15 +25,7 @@ if "xla_force_host_platform_device_count" not in _flags:
         _flags + " --xla_force_host_platform_device_count=8"
     ).strip()
 
-import pathlib
-import shutil
-
 import pytest
-
-
-@pytest.fixture
-def tmp_repo_path(tmp_path):
-    return tmp_path / "repo"
 
 
 @pytest.fixture

@@ -219,3 +219,30 @@ def test_import_interop_with_git(points_repo, tmp_path):
     assert any(
         line.startswith("points/.table-dataset/feature/A/A/A/A/") for line in ls.splitlines()
     )
+
+
+def test_columnar_diff_matches_tree_diff(points_repo):
+    from kart_tpu.diff.engine import get_feature_diff, get_feature_diff_columnar
+
+    repo, ds_path = points_repo
+    c1 = repo.head_commit_oid
+    updated = {
+        "fid": 4,
+        "geom": Geometry.from_wkt("POINT (50 50)"),
+        "name": "moved",
+        "rating": None,
+    }
+    c2 = edit_commit(repo, ds_path, updates=[updated], deletes=[8],
+                     inserts=[{"fid": 77, "geom": None, "name": "n", "rating": 0.5}])
+    ds1 = repo.structure(c1).datasets[ds_path]
+    ds2 = repo.structure(c2).datasets[ds_path]
+
+    tree_diff = get_feature_diff(ds1, ds2)
+    col_diff = get_feature_diff_columnar(ds1, ds2)
+    assert set(tree_diff.keys()) == set(col_diff.keys()) == {4, 8, 77}
+    for k in tree_diff:
+        assert tree_diff[k].type == col_diff[k].type
+        if tree_diff[k].new is not None:
+            assert tree_diff[k].new_value == col_diff[k].new_value
+        if tree_diff[k].old is not None:
+            assert tree_diff[k].old_value == col_diff[k].old_value

@@ -1,0 +1,204 @@
+import numpy as np
+import pytest
+
+from kart_tpu.ops.blocks import FeatureBlock, bucket_size, pack_oid_hex, unpack_oid_hex
+from kart_tpu.ops.bbox import bbox_intersects, bbox_intersects_np
+from kart_tpu.ops.diff_kernel import (
+    DELETE,
+    INSERT,
+    UNCHANGED,
+    UPDATE,
+    changed_indices,
+    classify_blocks,
+    classify_blocks_reference,
+)
+from kart_tpu.ops.envelope_codec import EnvelopeCodec
+
+
+def make_block(pk_oid_pairs):
+    keys = np.array([p for p, _ in pk_oid_pairs], dtype=np.int64)
+    oids = pack_oid_hex([o for _, o in pk_oid_pairs])
+    paths = [f"path/{p}" for p, _ in pk_oid_pairs]
+    return FeatureBlock.from_arrays(keys, oids, paths)
+
+
+OID_A = "aa" * 20
+OID_B = "bb" * 20
+OID_C = "cc" * 20
+
+
+def test_bucket_size():
+    assert bucket_size(0) == 1024
+    assert bucket_size(1024) == 1024
+    assert bucket_size(1025) == 2048
+
+
+def test_pack_unpack_oids():
+    oids = [OID_A, OID_B, "0123456789abcdef0123456789abcdef01234567"]
+    assert unpack_oid_hex(pack_oid_hex(oids)) == oids
+
+
+def test_classify_basic():
+    old = make_block([(1, OID_A), (2, OID_A), (3, OID_A)])
+    new = make_block([(2, OID_B), (3, OID_A), (4, OID_C)])
+    old_class, new_class, counts = classify_blocks(old, new)
+    assert counts == {"inserts": 1, "updates": 1, "deletes": 1}
+    assert old_class.tolist() == [DELETE, UPDATE, UNCHANGED]
+    assert new_class.tolist() == [UPDATE, UNCHANGED, INSERT]
+
+
+def test_classify_empty_sides():
+    empty = make_block([])
+    full = make_block([(1, OID_A), (2, OID_B)])
+    _, new_class, counts = classify_blocks(empty, full)
+    assert counts == {"inserts": 2, "updates": 0, "deletes": 0}
+    old_class, _, counts = classify_blocks(full, empty)
+    assert counts == {"inserts": 0, "updates": 0, "deletes": 2}
+    assert old_class.tolist() == [DELETE, DELETE]
+
+
+def test_classify_jit_matches_reference_random():
+    rng = np.random.default_rng(42)
+    n = 5000
+    pks = rng.choice(np.arange(n * 3, dtype=np.int64), size=n, replace=False)
+    oid_pool = [f"{i:040x}" for i in range(64)]
+    old_pairs = [(int(pk), oid_pool[rng.integers(64)]) for pk in pks]
+    # new version: drop ~10%, modify ~10%, add ~10%
+    new_pairs = []
+    for pk, oid in old_pairs:
+        r = rng.random()
+        if r < 0.1:
+            continue
+        if r < 0.2:
+            new_pairs.append((pk, oid_pool[rng.integers(64)]))
+        else:
+            new_pairs.append((pk, oid))
+    added = rng.choice(np.arange(n * 3, n * 4, dtype=np.int64), size=n // 10, replace=False)
+    for pk in added:
+        new_pairs.append((int(pk), oid_pool[rng.integers(64)]))
+
+    old = make_block(old_pairs)
+    new = make_block(new_pairs)
+    old_class, new_class, counts = classify_blocks(old, new)
+    ref_old, ref_new = classify_blocks_reference(old, new)
+    np.testing.assert_array_equal(old_class, ref_old)
+    np.testing.assert_array_equal(new_class, ref_new)
+
+    # brute-force dict check
+    old_map = dict(zip(old.keys[: old.count].tolist(), map(tuple, old.oids[: old.count])))
+    new_map = dict(zip(new.keys[: new.count].tolist(), map(tuple, new.oids[: new.count])))
+    expected = {
+        "inserts": len(set(new_map) - set(old_map)),
+        "deletes": len(set(old_map) - set(new_map)),
+        "updates": sum(
+            1 for k in set(old_map) & set(new_map) if old_map[k] != new_map[k]
+        ),
+    }
+    assert counts == expected
+
+
+def test_changed_indices():
+    old = make_block([(1, OID_A), (2, OID_A)])
+    new = make_block([(2, OID_B), (3, OID_C)])
+    old_class, new_class, _ = classify_blocks(old, new)
+    oi, ni = changed_indices(old_class, new_class)
+    assert old.keys[oi].tolist() == [1, 2]  # delete + update
+    assert new.keys[ni].tolist() == [2, 3]  # update + insert
+
+
+def test_bbox_basic():
+    envelopes = np.array(
+        [
+            [10, 10, 20, 20],  # inside query
+            [30, 30, 40, 40],  # outside
+            [0, 0, 11, 11],  # overlaps corner
+        ],
+        dtype=np.float64,
+    )
+    query = (5, 5, 25, 25)
+    expected = [True, False, True]
+    assert bbox_intersects_np(envelopes, query).tolist() == expected
+    assert bbox_intersects(envelopes, query).tolist() == expected
+
+
+def test_bbox_antimeridian():
+    # envelope crossing the anti-meridian: w=170, e=-170
+    envelopes = np.array(
+        [
+            [170.0, -10.0, -170.0, 10.0],  # crosses AM
+            [160.0, -10.0, 165.0, 10.0],  # west of it
+        ]
+    )
+    # query near 175E
+    q_east = (174.0, -5.0, 179.0, 5.0)
+    assert bbox_intersects_np(envelopes, q_east).tolist() == [True, False]
+    assert bbox_intersects(envelopes, q_east).tolist() == [True, False]
+    # query near 175W (i.e. -175)
+    q_west = (-179.0, -5.0, -172.0, 5.0)
+    assert bbox_intersects_np(envelopes, q_west).tolist() == [True, False]
+    assert bbox_intersects(envelopes, q_west).tolist() == [True, False]
+    # query itself crossing the AM
+    q_cross = (179.0, -5.0, -179.0, 5.0)
+    assert bbox_intersects_np(envelopes, q_cross).tolist() == [True, False]
+    assert bbox_intersects(envelopes, q_cross).tolist() == [True, False]
+
+
+def test_bbox_jnp_matches_np_random():
+    rng = np.random.default_rng(7)
+    n = 3000
+    w = rng.uniform(-180, 180, n)
+    e = rng.uniform(-180, 180, n)  # some will "wrap"
+    s = rng.uniform(-90, 85, n)
+    nn = s + rng.uniform(0, 5, n)
+    envelopes = np.stack([w, s, e, nn], axis=1)
+    query = (-20.0, -30.0, 40.0, 10.0)
+    ref = bbox_intersects_np(envelopes, query)
+    got = bbox_intersects(envelopes, query)
+    np.testing.assert_array_equal(got, ref)
+
+
+def test_envelope_codec_scalar_roundtrip():
+    codec = EnvelopeCodec()
+    env = (174.5, -41.3, 175.0, -41.0)
+    data = codec.encode(env)
+    assert len(data) == 10
+    w, s, e, n = codec.decode(data)
+    # decoded envelope must CONTAIN the original (floor/ceil outward rounding)
+    assert w <= env[0] and s <= env[1] and e >= env[2] and n >= env[3]
+    assert abs(w - env[0]) < 0.001 and abs(n - env[3]) < 0.001
+
+
+def test_envelope_codec_batch_matches_scalar():
+    codec = EnvelopeCodec()
+    rng = np.random.default_rng(0)
+    w = rng.uniform(-180, 179, 500)
+    e = np.minimum(w + rng.uniform(0, 1, 500), 180)
+    s = rng.uniform(-90, 89, 500)
+    n = np.minimum(s + rng.uniform(0, 1, 500), 90)
+    envs = np.stack([w, s, e, n], axis=1)
+    batch = codec.encode_batch(envs)
+    for i in range(0, 500, 37):
+        assert batch[i].tobytes() == codec.encode(tuple(envs[i]))
+    decoded = codec.decode_batch(batch)
+    for i in range(0, 500, 37):
+        assert tuple(decoded[i]) == pytest.approx(codec.decode(batch[i].tobytes()))
+
+
+def test_envelope_codec_edge_values():
+    codec = EnvelopeCodec()
+    env = (-180.0, -90.0, 180.0, 90.0)
+    assert codec.decode(codec.encode(env)) == pytest.approx(env)
+    batch = codec.encode_batch(np.array([env]))
+    assert batch[0].tobytes() == codec.encode(env)
+
+
+def test_feature_block_from_dataset(tmp_path):
+    from helpers import make_imported_repo
+
+    repo, ds_path = make_imported_repo(tmp_path, n=50)
+    ds = repo.datasets()[ds_path]
+    block = FeatureBlock.from_dataset(ds)
+    assert block.count == 50
+    assert block.padded_size == 1024
+    assert block.keys[:50].tolist() == sorted(range(1, 51))
+    assert not block.has_key_collisions()
