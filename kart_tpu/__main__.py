@@ -1,0 +1,3 @@
+from kart_tpu.cli import entrypoint
+
+entrypoint()

@@ -1,0 +1,102 @@
+"""Diff annotations cache (reference: kart/annotations/).
+
+``.kart/annotations.db`` (sqlite) memoises expensive facts about tree pairs —
+currently feature-change counts — keyed symmetrically so A<>B and B<>A share
+an entry (reference: annotations/__init__.py:16-21). Falls back to an
+in-memory store when the gitdir is read-only (reference: annotations/db.py:84-110).
+"""
+
+import json
+import os
+import sqlite3
+
+_DDL = """
+CREATE TABLE IF NOT EXISTS kart_annotations (
+    id INTEGER PRIMARY KEY AUTOINCREMENT,
+    object_id TEXT NOT NULL,
+    annotation_type TEXT NOT NULL,
+    data TEXT NOT NULL
+);
+CREATE UNIQUE INDEX IF NOT EXISTS kart_annotations_multicol
+    ON kart_annotations (object_id, annotation_type);
+"""
+
+
+class DiffAnnotations:
+    def __init__(self, repo):
+        self.repo = repo
+        self.db_path = os.path.join(repo.gitdir, "annotations.db")
+        self._memory = {}
+        self._readonly = False
+        try:
+            with self._connect() as con:
+                con.executescript(_DDL)
+        except sqlite3.OperationalError:
+            self._readonly = True
+
+    def _connect(self):
+        return sqlite3.connect(self.db_path)
+
+    @staticmethod
+    def _object_id(base_tree, target_tree):
+        # symmetric: the diff A<>B has the same size as B<>A
+        a, b = sorted([base_tree or "", target_tree or ""])
+        return f"{a}...{b}"
+
+    def get(self, base_tree, target_tree, annotation_type="feature-change-counts-exact"):
+        key = (self._object_id(base_tree, target_tree), annotation_type)
+        if key in self._memory:
+            return self._memory[key]
+        if self._readonly:
+            return None
+        with self._connect() as con:
+            row = con.execute(
+                "SELECT data FROM kart_annotations WHERE object_id = ? AND annotation_type = ?",
+                key,
+            ).fetchone()
+        return json.loads(row[0]) if row else None
+
+    def set(self, base_tree, target_tree, data, annotation_type="feature-change-counts-exact"):
+        key = (self._object_id(base_tree, target_tree), annotation_type)
+        self._memory[key] = data
+        if self._readonly:
+            return
+        with self._connect() as con:
+            con.execute(
+                "INSERT OR REPLACE INTO kart_annotations (object_id, annotation_type, data) "
+                "VALUES (?, ?, ?)",
+                (*key, json.dumps(data)),
+            )
+
+    def count_changes(self, base_rs, target_rs):
+        """Cached per-dataset feature-change counts between two revisions."""
+        base_tree = base_rs.tree_oid if base_rs else None
+        target_tree = target_rs.tree_oid if target_rs else None
+        cached = self.get(base_tree, target_tree)
+        if cached is not None:
+            return cached
+        from kart_tpu.diff.engine import get_repo_diff
+
+        diff = get_repo_diff(base_rs, target_rs)
+        counts = {
+            ds_path: len(ds_diff.get("feature", ()))
+            for ds_path, ds_diff in diff.items()
+        }
+        self.set(base_tree, target_tree, counts)
+        return counts
+
+    def build_all(self, all_reachable=False):
+        """Pre-compute annotations for HEAD's history
+        (reference: annotations/cli.py build-annotations)."""
+        repo = self.repo
+        if repo.head_is_unborn:
+            return 0
+        built = 0
+        for oid, commit in repo.walk_commits(repo.head_commit_oid):
+            parent = commit.parents[0] if commit.parents else None
+            base_rs = repo.structure(parent) if parent else None
+            self.count_changes(base_rs, repo.structure(oid))
+            built += 1
+            if not all_reachable and built >= 100:
+                break
+        return built

@@ -1,0 +1,183 @@
+"""Apply JSON patches (reference: kart/apply.py).
+
+A patch is the JSON diff format (``kart.diff/v1+hexwkb``) plus an optional
+``kart.patch/v1`` header carrying the original commit's message/author/base.
+Minimal patches carry ``*`` deltas (no old values); they are resolved against
+the ``base`` commit recorded in the header (reference: apply.py:180-309).
+"""
+
+from kart_tpu.core.repo import InvalidOperation, NotFound
+from kart_tpu.core.structure import PatchApplyError
+from kart_tpu.core.objects import Signature
+from kart_tpu.diff.structs import DatasetDiff, Delta, DeltaDiff, KeyValue, RepoDiff
+from kart_tpu.geometry import Geometry
+from kart_tpu.models.schema import Schema
+
+
+def _feature_from_json(feature_json, schema):
+    out = {}
+    for col in schema.columns:
+        value = feature_json.get(col.name)
+        if value is not None and col.data_type == "geometry":
+            value = Geometry.from_hex_wkb(value)
+        elif value is not None and col.data_type == "blob":
+            value = bytes.fromhex(value)
+        out[col.name] = value
+    return out
+
+
+def _pk_of(feature_json, schema):
+    pks = tuple(feature_json[c.name] for c in schema.pk_columns)
+    return pks[0] if len(pks) == 1 else pks
+
+
+def parse_patch(repo, patch_json):
+    """-> (RepoDiff, header dict)."""
+    try:
+        diff_json = patch_json["kart.diff/v1+hexwkb"]
+    except KeyError:
+        raise PatchApplyError(
+            "Patch is missing the 'kart.diff/v1+hexwkb' key — is this a Kart patch?"
+        )
+    header = patch_json.get("kart.patch/v1", {})
+    base_rs = None
+    if header.get("base"):
+        try:
+            base_rs = repo.structure(header["base"])
+        except NotFound:
+            base_rs = None
+
+    head_rs = repo.structure("HEAD") if not repo.head_is_unborn else None
+    repo_diff = RepoDiff()
+    for ds_path, ds_json in diff_json.items():
+        ds_diff = DatasetDiff()
+        ds = head_rs.datasets.get(ds_path) if head_rs is not None else None
+
+        meta_json = ds_json.get("meta", {})
+        if meta_json:
+            meta_diff = DeltaDiff()
+            for name, change in meta_json.items():
+                if "*" in change:
+                    if ds is None:
+                        raise PatchApplyError(
+                            f"Minimal patch for unknown dataset {ds_path!r}"
+                        )
+                    old_value = ds.meta_items().get(name)
+                    change = {"-": old_value, "+": change["*"]}
+                old = KeyValue((name, change["-"])) if change.get("-") is not None else None
+                new = KeyValue((name, change["+"])) if change.get("+") is not None else None
+                meta_diff.add_delta(Delta(old, new))
+            ds_diff["meta"] = meta_diff
+
+        # figure out the schema for decoding features
+        if "schema.json" in meta_json and meta_json["schema.json"].get("+"):
+            schema = Schema.from_column_dicts(meta_json["schema.json"]["+"])
+        elif ds is not None:
+            schema = ds.schema
+        else:
+            raise PatchApplyError(
+                f"Patch contains features for unknown dataset {ds_path!r} "
+                f"and no schema"
+            )
+        old_schema = ds.schema if ds is not None else schema
+
+        features_json = ds_json.get("feature", [])
+        if features_json:
+            feature_diff = DeltaDiff()
+            for change in features_json:
+                minus = change.get("-")
+                plus = change.get("+")
+                star = change.get("*")
+                if star is not None:
+                    # minimal patch: resolve old value from base
+                    new_feature = _feature_from_json(star, schema)
+                    pk = _pk_of(star, schema)
+                    base_ds = base_rs.datasets.get(ds_path) if base_rs else None
+                    if base_ds is None:
+                        raise PatchApplyError(
+                            "Minimal patch requires its base commit "
+                            f"({header.get('base', 'unknown')}) to be present"
+                        )
+                    old_feature = base_ds.get_feature(
+                        base_ds.schema.sanitise_pks(pk if isinstance(pk, tuple) else [pk])
+                    )
+                    feature_diff.add_delta(
+                        Delta.update(KeyValue((pk, old_feature)), KeyValue((pk, new_feature)))
+                    )
+                    continue
+                old = None
+                new = None
+                if minus is not None:
+                    old_feature = _feature_from_json(minus, old_schema)
+                    old = KeyValue((_pk_of(minus, old_schema), old_feature))
+                if plus is not None:
+                    new_feature = _feature_from_json(plus, schema)
+                    new = KeyValue((_pk_of(plus, schema), new_feature))
+                feature_diff.add_delta(Delta(old, new))
+            ds_diff["feature"] = feature_diff
+        repo_diff[ds_path] = ds_diff
+    return repo_diff, header
+
+
+def apply_patch(repo, patch_json, *, no_commit=False, allow_empty=False):
+    """-> new commit oid (or None with no_commit)."""
+    repo_diff, header = parse_patch(repo, patch_json)
+    head_rs = repo.structure("HEAD")
+    wc = repo.working_copy
+    if wc is not None:
+        wc.assert_db_tree_match(head_rs.tree_oid)
+
+    if no_commit:
+        if wc is None:
+            raise InvalidOperation("--no-commit requires a working copy")
+        with wc.session() as con:
+            for ds_path, ds_diff in repo_diff.items():
+                ds = head_rs.datasets.get(ds_path)
+                if ds is None:
+                    raise PatchApplyError(
+                        f"Cannot apply new-dataset patch to working copy only"
+                    )
+                wc._apply_feature_diff_sql(
+                    con, ds, ds_diff.get("feature", DeltaDiff()),
+                    track_changes_as_dirty=True,
+                )
+        return None
+
+    author = None
+    if header.get("authorName"):
+        import re as _re
+
+        ts = 0
+        offset = 0
+        when = header.get("authorTime")
+        if when:
+            from datetime import datetime, timezone
+
+            try:
+                ts = int(
+                    datetime.strptime(when, "%Y-%m-%dT%H:%M:%SZ")
+                    .replace(tzinfo=timezone.utc)
+                    .timestamp()
+                )
+            except ValueError:
+                ts = 0
+        off_text = header.get("authorTimeOffset")
+        if off_text:
+            m = _re.fullmatch(r"([+-])(\d{2}):?(\d{2})", off_text)
+            if m:
+                offset = int(m.group(2)) * 60 + int(m.group(3))
+                if m.group(1) == "-":
+                    offset = -offset
+        if ts:
+            author = Signature(
+                header["authorName"], header.get("authorEmail", ""), ts, offset
+            )
+    message = header.get("message") or "Apply patch"
+    commit_oid = head_rs.commit_diff(
+        repo_diff, message, allow_empty=allow_empty, author=author
+    )
+    if wc is not None:
+        new_tree = repo.odb.read_commit(commit_oid).tree
+        target = repo.structure(commit_oid)
+        wc.reset(target, force=True)
+    return commit_oid

@@ -1,0 +1,137 @@
+"""The ``kart`` command surface (reference: kart/cli.py + per-command modules).
+
+Run as ``python -m kart_tpu.cli`` (or ``python -m kart_tpu``). Commands are
+grouped in modules and registered lazily so startup stays fast.
+"""
+
+import importlib
+import os
+import sys
+
+import click
+
+import kart_tpu
+
+# command name -> module (lazy loading, reference: cli.py:21-43)
+_COMMANDS = {
+    "init": "kart_tpu.cli.repo_cmds",
+    "import": "kart_tpu.cli.repo_cmds",
+    "commit": "kart_tpu.cli.repo_cmds",
+    "status": "kart_tpu.cli.repo_cmds",
+    "checkout": "kart_tpu.cli.repo_cmds",
+    "switch": "kart_tpu.cli.repo_cmds",
+    "restore": "kart_tpu.cli.repo_cmds",
+    "reset": "kart_tpu.cli.repo_cmds",
+    "create-workingcopy": "kart_tpu.cli.repo_cmds",
+    "diff": "kart_tpu.cli.diff_cmds",
+    "log": "kart_tpu.cli.diff_cmds",
+    "show": "kart_tpu.cli.diff_cmds",
+    "create-patch": "kart_tpu.cli.diff_cmds",
+    "apply": "kart_tpu.cli.diff_cmds",
+    "branch": "kart_tpu.cli.ref_cmds",
+    "tag": "kart_tpu.cli.ref_cmds",
+    "config": "kart_tpu.cli.ref_cmds",
+    "gc": "kart_tpu.cli.ref_cmds",
+    "fsck": "kart_tpu.cli.ref_cmds",
+    "data": "kart_tpu.cli.data_cmds",
+    "meta": "kart_tpu.cli.data_cmds",
+    "merge": "kart_tpu.cli.merge_cmds",
+    "conflicts": "kart_tpu.cli.merge_cmds",
+    "resolve": "kart_tpu.cli.merge_cmds",
+    "clone": "kart_tpu.cli.remote_cmds",
+    "push": "kart_tpu.cli.remote_cmds",
+    "pull": "kart_tpu.cli.remote_cmds",
+    "fetch": "kart_tpu.cli.remote_cmds",
+    "remote": "kart_tpu.cli.remote_cmds",
+    "spatial-filter": "kart_tpu.cli.spatial_cmds",
+    "upgrade": "kart_tpu.cli.upgrade_cmds",
+    "build-annotations": "kart_tpu.cli.data_cmds",
+}
+
+
+class CliError(click.ClickException):
+    exit_code = 2
+
+
+class Context:
+    """Lazily opens the repo for commands that need one
+    (reference: kart/context.py)."""
+
+    def __init__(self):
+        self.repo_path = os.environ.get("KART_REPO", ".")
+        self.user_agent = f"kart_tpu/{kart_tpu.__version__}"
+
+    @property
+    def repo(self):
+        from kart_tpu.core.repo import KartRepo, NotFound
+
+        try:
+            return KartRepo(self.repo_path)
+        except NotFound as e:
+            raise click.UsageError(str(e))
+
+    def require_state(self, *allowed):
+        repo = self.repo
+        if repo.state not in allowed:
+            from kart_tpu.core.repo import KartRepoState
+
+            raise CliError(KartRepoState.bad_state_message(repo.state, allowed))
+        return repo
+
+
+class KartGroup(click.Group):
+    def list_commands(self, ctx):
+        return sorted(set(super().list_commands(ctx)) | set(_COMMANDS))
+
+    def get_command(self, ctx, name):
+        cmd = super().get_command(ctx, name)
+        if cmd is not None:
+            return cmd
+        module_name = _COMMANDS.get(name)
+        if module_name is None:
+            return None
+        try:
+            importlib.import_module(module_name)
+        except ImportError as e:
+            raise CliError(f"Command {name!r} is unavailable: {e}")
+        return super().get_command(ctx, name)
+
+
+@click.group(cls=KartGroup)
+@click.option(
+    "-C",
+    "repo_dir",
+    metavar="PATH",
+    default=None,
+    help="Run as if started in PATH instead of the current directory",
+)
+@click.version_option(version=kart_tpu.__version__, prog_name="kart (kart_tpu)")
+@click.option("-v", "--verbose", count=True, help="Increase verbosity (-v, -vv)")
+@click.pass_context
+def cli(ctx, repo_dir, verbose):
+    """kart_tpu — TPU-native distributed version control for geospatial data."""
+    ctx.obj = Context()
+    if repo_dir:
+        ctx.obj.repo_path = repo_dir
+    if verbose:
+        import logging
+
+        logging.basicConfig(
+            level=logging.DEBUG if verbose > 1 else logging.INFO,
+            format="%(asctime)s %(levelname)s %(name)s %(message)s",
+        )
+
+
+def add_command(name, fn):
+    cli.add_command(fn, name=name)
+
+
+def entrypoint():
+    try:
+        cli(standalone_mode=True)
+    except Exception:
+        raise
+
+
+if __name__ == "__main__":
+    entrypoint()

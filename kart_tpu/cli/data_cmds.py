@@ -1,0 +1,159 @@
+"""data ls / data version / meta get / meta set (reference: kart/data.py,
+kart/meta.py)."""
+
+import json
+
+import click
+
+from kart_tpu.cli import CliError, cli
+from kart_tpu.diff.output import dump_json_output
+
+
+@cli.group()
+def data():
+    """Information about the datasets in the repository."""
+
+
+@data.command("ls")
+@click.option("--output-format", "-o", type=click.Choice(["text", "json"]), default="text")
+@click.option("--with-dataset-types", is_flag=True)
+@click.argument("refish", required=False, default="HEAD")
+@click.pass_obj
+def data_ls(ctx, output_format, with_dataset_types, refish):
+    """List datasets."""
+    repo = ctx.repo
+    if repo.head_is_unborn:
+        paths = []
+        datasets = []
+    else:
+        datasets = list(repo.datasets(refish))
+        paths = [ds.path for ds in datasets]
+    if output_format == "json":
+        if with_dataset_types:
+            value = [
+                {"path": ds.path, "type": "table", "version": ds.VERSION}
+                for ds in datasets
+            ]
+        else:
+            value = paths
+        dump_json_output({"kart.data.ls/v2": value}, "-")
+        return
+    if not paths:
+        click.echo("Empty repository.", err=True)
+        click.echo('  (use "kart import" to add some data)', err=True)
+        return
+    for p in paths:
+        click.echo(p)
+
+
+@data.command("version")
+@click.option("--output-format", "-o", type=click.Choice(["text", "json"]), default="text")
+@click.pass_obj
+def data_version(ctx, output_format):
+    """Show the repository structure version."""
+    repo = ctx.repo
+    version = repo.version
+    if output_format == "json":
+        dump_json_output(
+            {"repostructure.version": version, "localconfig.branding": "kart"}, "-"
+        )
+        return
+    click.echo(f"This Kart repo uses Datasets v{version}")
+
+
+@cli.group()
+def meta():
+    """Read and update metadata for datasets."""
+
+
+@meta.command("get")
+@click.option("--output-format", "-o", type=click.Choice(["text", "json"]), default="text")
+@click.option("--ref", default="HEAD")
+@click.argument("dataset", required=True)
+@click.argument("keys", nargs=-1)
+@click.pass_obj
+def meta_get(ctx, output_format, ref, dataset, keys):
+    """Print meta items for a dataset."""
+    repo = ctx.repo
+    ds = repo.datasets(ref).get(dataset)
+    if ds is None:
+        raise CliError(f"No dataset {dataset!r} at {ref}")
+    items = ds.meta_items()
+    if keys:
+        missing = [k for k in keys if k not in items]
+        if missing:
+            raise CliError(f"Couldn't find items: {', '.join(missing)}")
+        items = {k: items[k] for k in keys}
+    if output_format == "json":
+        dump_json_output({dataset: items}, "-")
+        return
+    for name, value in items.items():
+        click.secho(name, bold=True)
+        if isinstance(value, (dict, list)):
+            click.echo(json.dumps(value, indent=2))
+        else:
+            click.echo(str(value))
+        click.echo()
+
+
+@meta.command("set")
+@click.option("--message", "-m", help="Commit message")
+@click.argument("dataset")
+@click.argument("assignments", nargs=-1, required=True)
+@click.pass_obj
+def meta_set(ctx, message, dataset, assignments):
+    """Commit changes to meta items: kart meta set DATASET key=value ..."""
+    from kart_tpu.diff.structs import (
+        DatasetDiff,
+        Delta,
+        DeltaDiff,
+        KeyValue,
+        RepoDiff,
+    )
+
+    repo = ctx.repo
+    structure = repo.structure("HEAD")
+    ds = structure.datasets.get(dataset)
+    if ds is None:
+        raise CliError(f"No dataset {dataset!r}")
+    items = ds.meta_items()
+    meta_diff = DeltaDiff()
+    for assignment in assignments:
+        if "=" not in assignment:
+            raise CliError(f"Expected key=value, got {assignment!r}")
+        key, _, value = assignment.partition("=")
+        if value.startswith("@"):
+            with open(value[1:]) as f:
+                value = f.read()
+        if key.endswith(".json"):
+            value = json.loads(value)
+        old = items.get(key)
+        meta_diff.add_delta(
+            Delta(
+                KeyValue((key, old)) if old is not None else None,
+                KeyValue((key, value)),
+            )
+        )
+    ds_diff = DatasetDiff()
+    ds_diff["meta"] = meta_diff
+    repo_diff = RepoDiff()
+    repo_diff[dataset] = ds_diff
+    msg = message or f"Update metadata for {dataset}"
+    oid = structure.commit_diff(repo_diff, msg)
+    wc = repo.working_copy
+    if wc is not None:
+        wc.reset(repo.structure(oid), force=True)
+    click.echo(f"Commit {oid[:7]}")
+
+
+@cli.command("build-annotations")
+@click.option("--all-reachable", is_flag=True)
+@click.pass_obj
+def build_annotations(ctx, all_reachable):
+    """Pre-compute diff feature-count annotations for commits."""
+    from kart_tpu.annotations import DiffAnnotations
+
+    repo = ctx.repo
+    annotations = DiffAnnotations(repo)
+    built = annotations.build_all(all_reachable=all_reachable)
+    click.echo(f"Built annotations for {built} commit(s)")

@@ -1,0 +1,255 @@
+"""diff / log / show / create-patch / apply (reference: kart/diff.py, log.py,
+show.py, apply.py)."""
+
+import json
+
+import click
+
+from kart_tpu.cli import CliError, cli
+from kart_tpu.diff.output import dump_json_output
+from kart_tpu.diff.writers import BaseDiffWriter
+
+OUTPUT_FORMATS = [
+    "text",
+    "json",
+    "geojson",
+    "json-lines",
+    "quiet",
+    "feature-count",
+    "html",
+]
+
+
+@cli.command()
+@click.option(
+    "--output-format", "-o", type=click.Choice(OUTPUT_FORMATS), default="text"
+)
+@click.option("--output", "output_path", default="-", help="Output file (- for stdout)")
+@click.option(
+    "--json-style",
+    type=click.Choice(["extracompact", "compact", "pretty"]),
+    default="pretty",
+)
+@click.option("--crs", "target_crs", help="Reproject geometries to this CRS for output")
+@click.option(
+    "--exit-code",
+    is_flag=True,
+    help="Exit 1 when there are differences, 0 otherwise",
+)
+@click.argument("args", nargs=-1)
+@click.pass_obj
+def diff(ctx, output_format, output_path, json_style, target_crs, exit_code, args):
+    """Show changes between commits, or between a commit and the working copy.
+
+    ARGS: an optional commit spec (A, A..B or A...B) followed by optional
+    dataset[:pk] filters.
+    """
+    repo = ctx.repo
+    commit_spec, filters = _split_diff_args(repo, args)
+    writer_class = BaseDiffWriter.get_diff_writer_class(output_format)
+    writer = writer_class(
+        repo,
+        commit_spec,
+        filters,
+        output_path,
+        json_style=json_style,
+        target_crs=target_crs,
+    )
+    has_changes = writer.write_diff()
+    if exit_code or output_format == "quiet":
+        raise SystemExit(1 if has_changes else 0)
+
+
+def _split_diff_args(repo, args):
+    """First arg is a commit spec if it resolves (or contains '..'); the rest
+    are filters."""
+    from kart_tpu.core.repo import NotFound
+
+    args = list(args)
+    if not args:
+        return "HEAD", []
+    first = args[0]
+    if ".." in first:
+        return first, args[1:]
+    try:
+        repo.resolve_refish(first.split("...")[0])
+        return first, args[1:]
+    except NotFound:
+        return "HEAD", args
+
+
+@cli.command()
+@click.option(
+    "--output-format", "-o", type=click.Choice(["text", "json", "json-lines"]), default="text"
+)
+@click.option("--oneline", is_flag=True)
+@click.option("-n", "--max-count", type=int, default=None)
+@click.option("--json-style", type=click.Choice(["extracompact", "compact", "pretty"]), default="pretty")
+@click.argument("refish", required=False, default="HEAD")
+@click.argument("filters", nargs=-1)
+@click.pass_obj
+def log(ctx, output_format, oneline, max_count, json_style, refish, filters):
+    """Show the commit log."""
+    from kart_tpu.core.repo import NotFound
+    from kart_tpu.diff.engine import get_repo_diff
+    from kart_tpu.diff.key_filters import RepoKeyFilter
+
+    repo = ctx.repo
+    try:
+        start, _ = repo.resolve_refish(refish)
+    except NotFound:
+        if refish != "HEAD":
+            raise CliError(f"No such revision: {refish}")
+        start = None
+    if start is None:
+        return
+
+    key_filter = RepoKeyFilter.build_from_user_patterns(filters)
+
+    entries = []
+    count = 0
+    for oid, commit in repo.walk_commits(start):
+        if max_count is not None and count >= max_count:
+            break
+        if not key_filter.match_all:
+            # filter by datasets touched in this commit
+            parent = commit.parents[0] if commit.parents else None
+            diff = get_repo_diff(
+                repo.structure(parent) if parent else None,
+                repo.structure(oid),
+                repo_key_filter=key_filter,
+            )
+            if not diff:
+                continue
+        entries.append((oid, commit))
+        count += 1
+
+    if output_format in ("json", "json-lines"):
+        out = [_commit_json(oid, c) for oid, c in entries]
+        if output_format == "json":
+            dump_json_output(out, "-", json_style=json_style)
+        else:
+            import sys
+
+            for item in out:
+                json.dump(item, sys.stdout, separators=(",", ":"))
+                sys.stdout.write("\n")
+        return
+
+    for oid, commit in entries:
+        if oneline:
+            click.echo(f"{oid[:7]} {commit.message_summary}")
+        else:
+            from datetime import datetime, timedelta, timezone
+
+            tz = timezone(timedelta(minutes=commit.author.offset))
+            when = datetime.fromtimestamp(commit.author.time, timezone.utc).astimezone(tz)
+            click.secho(f"commit {oid}", fg="yellow")
+            click.echo(f"Author: {commit.author.name} <{commit.author.email}>")
+            click.echo(f"Date:   {when.strftime('%a %b %d %H:%M:%S %Y %z')}")
+            click.echo()
+            for line in commit.message.splitlines():
+                click.echo(f"    {line}")
+            click.echo()
+
+
+def _commit_json(oid, commit):
+    from datetime import datetime, timedelta, timezone
+
+    tz = timezone(timedelta(minutes=commit.author.offset))
+    when = datetime.fromtimestamp(commit.author.time, timezone.utc).astimezone(tz)
+    return {
+        "commit": oid,
+        "abbrevCommit": oid[:7],
+        "message": commit.message,
+        "refs": [],
+        "authorName": commit.author.name,
+        "authorEmail": commit.author.email,
+        "authorTime": when.strftime("%Y-%m-%dT%H:%M:%S%z"),
+        "parents": list(commit.parents),
+        "abbrevParents": [p[:7] for p in commit.parents],
+    }
+
+
+class _CommitForShow:
+    def __init__(self, oid, commit):
+        self.oid = oid
+        self.author = commit.author
+        self.message = commit.message
+
+
+@cli.command()
+@click.option(
+    "--output-format", "-o", type=click.Choice(OUTPUT_FORMATS), default="text"
+)
+@click.option("--json-style", type=click.Choice(["extracompact", "compact", "pretty"]), default="pretty")
+@click.option("--crs", "target_crs", help="Reproject geometries for output")
+@click.argument("refish", required=False, default="HEAD")
+@click.argument("filters", nargs=-1)
+@click.pass_obj
+def show(ctx, output_format, json_style, target_crs, refish, filters):
+    """Show the changes introduced by a commit."""
+    repo = ctx.repo
+    oid, _ = repo.resolve_refish(refish)
+    commit = repo.odb.read_commit(oid)
+    writer_class = BaseDiffWriter.get_diff_writer_class(output_format)
+    writer = writer_class(
+        repo,
+        f"{oid}^?...{oid}",
+        filters,
+        "-",
+        json_style=json_style,
+        target_crs=target_crs,
+        commit=_CommitForShow(oid, commit),
+    )
+    writer.write_diff()
+
+
+@cli.command("create-patch")
+@click.option("--json-style", type=click.Choice(["extracompact", "compact", "pretty"]), default="pretty")
+@click.option(
+    "--patch-type",
+    type=click.Choice(["full", "minimal"]),
+    default="full",
+    help="minimal patches omit unchanged old values (needs the base commit to apply)",
+)
+@click.option("--output", "output_path", default="-")
+@click.argument("refish", required=True)
+@click.pass_obj
+def create_patch(ctx, json_style, patch_type, output_path, refish):
+    """Write a JSON patch of the changes introduced by a commit."""
+    from kart_tpu.diff.writers import JsonDiffWriter
+
+    repo = ctx.repo
+    oid, _ = repo.resolve_refish(refish)
+    commit = repo.odb.read_commit(oid)
+    writer = JsonDiffWriter(
+        repo,
+        f"{oid}^?...{oid}",
+        (),
+        output_path,
+        json_style=json_style,
+        commit=_CommitForShow(oid, commit),
+        patch_type=patch_type,
+        include_patch_header=True,
+    )
+    writer.write_diff()
+
+
+@cli.command("apply")
+@click.option("--no-commit", is_flag=True, help="Apply to the working copy only")
+@click.option("--allow-empty", is_flag=True)
+@click.argument("patch_file", type=click.File("r"))
+@click.pass_obj
+def apply_(ctx, no_commit, allow_empty, patch_file):
+    """Apply a JSON patch (as written by create-patch)."""
+    from kart_tpu.apply import apply_patch
+
+    repo = ctx.repo
+    commit_oid = apply_patch(
+        repo, json.load(patch_file), no_commit=no_commit, allow_empty=allow_empty
+    )
+    if commit_oid:
+        click.echo(f"Commit {commit_oid[:7]}")
+    else:
+        click.echo("Applied patch to working copy")

@@ -1,0 +1,174 @@
+"""branch / tag / config / gc / fsck (reference: kart/branch.py plus git
+pass-through commands, kart/fsck.py)."""
+
+import click
+
+from kart_tpu.cli import CliError, cli
+from kart_tpu.core.repo import InvalidOperation
+from kart_tpu.diff.output import dump_json_output
+
+
+@cli.command()
+@click.option("-d", "--delete", "delete_branch", help="Delete this branch")
+@click.option("-f", "--force", is_flag=True)
+@click.option("--output-format", "-o", type=click.Choice(["text", "json"]), default="text")
+@click.argument("name", required=False)
+@click.argument("start_point", required=False, default="HEAD")
+@click.pass_obj
+def branch(ctx, delete_branch, force, output_format, name, start_point):
+    """List, create or delete branches."""
+    repo = ctx.repo
+    if delete_branch:
+        ref = f"refs/heads/{delete_branch}"
+        if not repo.refs.exists(ref):
+            raise CliError(f"No such branch: {delete_branch}")
+        if repo.head_branch == ref:
+            raise InvalidOperation(f"Cannot delete the current branch {delete_branch}")
+        if not force:
+            oid = repo.refs.get(ref)
+            head = repo.head_commit_oid
+            if head and not repo.is_ancestor(oid, head):
+                raise InvalidOperation(
+                    f"Branch {delete_branch} is not fully merged — use -f to delete anyway"
+                )
+        repo.refs.delete(ref)
+        click.echo(f"Deleted branch {delete_branch}")
+        return
+    if name:
+        oid, _ = repo.resolve_refish(start_point)
+        ref = f"refs/heads/{name}"
+        if repo.refs.exists(ref) and not force:
+            raise InvalidOperation(f"Branch already exists: {name}")
+        repo.refs.set(ref, oid, log_message=f"branch: created from {start_point}")
+        return
+    current = repo.head_branch
+    branches = list(repo.refs.iter_refs("refs/heads/"))
+    if output_format == "json":
+        dump_json_output(
+            {
+                "kart.branch/v1": {
+                    "current": current.rsplit("/", 1)[-1] if current else None,
+                    "branches": {
+                        ref[len("refs/heads/"):]: {"commit": oid, "abbrevCommit": oid[:7]}
+                        for ref, oid in branches
+                    },
+                }
+            },
+            "-",
+        )
+        return
+    for ref, oid in branches:
+        short = ref[len("refs/heads/"):]
+        marker = "*" if ref == current else " "
+        click.echo(f"{marker} {short}")
+
+
+@cli.command()
+@click.option("-d", "--delete", "delete_tag", help="Delete this tag")
+@click.option("-m", "--message", help="Create an annotated tag with this message")
+@click.argument("name", required=False)
+@click.argument("target", required=False, default="HEAD")
+@click.pass_obj
+def tag(ctx, delete_tag, message, name, target):
+    """List, create or delete tags."""
+    repo = ctx.repo
+    if delete_tag:
+        ref = f"refs/tags/{delete_tag}"
+        if not repo.refs.exists(ref):
+            raise CliError(f"No such tag: {delete_tag}")
+        repo.refs.delete(ref)
+        click.echo(f"Deleted tag {delete_tag}")
+        return
+    if name:
+        oid, _ = repo.resolve_refish(target)
+        repo.create_tag(name, oid, message=message)
+        return
+    for ref, _ in repo.refs.iter_refs("refs/tags/"):
+        click.echo(ref[len("refs/tags/"):])
+
+
+@cli.command()
+@click.argument("key")
+@click.argument("value", required=False)
+@click.option("--unset", is_flag=True)
+@click.pass_obj
+def config(ctx, key, value, unset):
+    """Get or set repository configuration."""
+    repo = ctx.repo
+    if unset:
+        del repo.config[key]
+        return
+    if value is not None:
+        repo.config[key] = value
+        return
+    current = repo.config.get(key)
+    if current is None:
+        raise SystemExit(1)
+    click.echo(current)
+
+
+@cli.command()
+@click.argument("args", nargs=-1)
+@click.pass_obj
+def gc(ctx, args):
+    """Clean up the object store."""
+    ctx.repo.gc(*args)
+
+
+@cli.command()
+@click.option("--reset-datasets", is_flag=True, hidden=True)
+@click.pass_obj
+def fsck(ctx, reset_datasets):
+    """Verify repository integrity: object store, refs, dataset structure,
+    working copy sync (reference: kart/fsck.py)."""
+    repo = ctx.repo
+    errors = []
+
+    # object store: every object parses and hashes to its name
+    click.echo("Checking object store...")
+    count = 0
+    for oid in repo.odb.iter_oids():
+        try:
+            obj_type, content = repo.odb.read_raw(oid)
+            from kart_tpu.core.objects import hash_object
+
+            if hash_object(obj_type, content) != oid:
+                errors.append(f"Object {oid} content does not match its id")
+        except Exception as e:
+            errors.append(f"Object {oid} is corrupt: {e}")
+        count += 1
+    click.echo(f"  {count} objects")
+
+    # refs point at real commits
+    click.echo("Checking refs...")
+    for ref, oid in repo.refs.iter_refs():
+        if not repo.odb.contains(oid):
+            errors.append(f"Ref {ref} points at missing object {oid}")
+
+    # dataset structure at HEAD
+    if not repo.head_is_unborn:
+        click.echo("Checking datasets...")
+        for ds in repo.datasets():
+            try:
+                ds.schema
+                n = ds.feature_count
+                click.echo(f"  {ds.path}: {n} features")
+            except Exception as e:
+                errors.append(f"Dataset {ds.path} is corrupt: {e}")
+
+    # working copy state
+    wc = repo.working_copy
+    if wc is not None:
+        click.echo("Checking working copy...")
+        tree = wc.get_db_tree()
+        head_tree = repo.head_tree_oid
+        if tree != head_tree:
+            errors.append(
+                f"Working copy tree {tree} does not match HEAD tree {head_tree}"
+            )
+
+    if errors:
+        for e in errors:
+            click.secho(f"error: {e}", fg="red", err=True)
+        raise SystemExit(1)
+    click.echo("No errors found.")

@@ -1,0 +1,319 @@
+"""init / import / commit / status / checkout / switch / restore / reset
+(reference: kart/init.py, commit.py, checkout.py, status.py)."""
+
+import click
+
+from kart_tpu.cli import CliError, cli
+from kart_tpu.core.repo import InvalidOperation, KartRepo, KartRepoState
+from kart_tpu.diff.key_filters import RepoKeyFilter
+from kart_tpu.diff.output import dump_json_output
+from kart_tpu.diff.structs import DeltaDiff
+
+
+def _do_checkout(repo, refish=None, *, force=False):
+    """Reset the working copy to the given revision (creating it if needed)."""
+    from kart_tpu.workingcopy import get_working_copy
+
+    structure = repo.structure(refish or "HEAD")
+    wc = get_working_copy(repo, allow_uncreated=True)
+    if wc is None:
+        return None
+    wc.reset(structure, force=force)
+    return wc
+
+
+@cli.command("init", context_settings={"ignore_unknown_options": True})
+@click.argument("directory", type=click.Path(), required=False, default=".")
+@click.option("--import", "import_from", help="Import from this data source immediately")
+@click.option("--bare", is_flag=True, help="Create a bare repository (no working copy)")
+@click.option(
+    "--workingcopy-location",
+    "--workingcopy-path",
+    "--workingcopy",
+    "wc_location",
+    help="Location of the working copy (e.g. data.gpkg)",
+)
+@click.option("-b", "--initial-branch", default="main", help="Initial branch name")
+@click.option("--message", "-m", help="Commit message for the initial import")
+@click.pass_context
+def init(ctx, directory, import_from, bare, wc_location, initial_branch, message):
+    """Create an empty repository, or import an existing data source."""
+    repo = KartRepo.init_repository(
+        directory, bare=bare, initial_branch=initial_branch
+    )
+    click.echo(f"Initialized empty Kart repository in {repo.gitdir}")
+    if wc_location and not bare:
+        from kart_tpu.core.repo import KartConfigKeys
+
+        repo.config[KartConfigKeys.KART_WORKINGCOPY_LOCATION] = wc_location
+    if import_from:
+        ctx.obj.repo_path = directory
+        ctx.invoke(import_, sources=(import_from,), message=message)
+
+
+@cli.command("import")
+@click.argument("sources", nargs=-1, required=True)
+@click.option("--message", "-m", help="Commit message")
+@click.option("--table", "-t", help="Only import this table from the source")
+@click.option("--dest-path", help="Dataset path to import into")
+@click.option("--replace-existing", is_flag=True, help="Replace existing dataset(s)")
+@click.option("--no-checkout", is_flag=True, help="Don't update the working copy")
+@click.pass_obj
+def import_(ctx, sources, message, table, dest_path, replace_existing, no_checkout):
+    """Import data into the repository as new dataset(s)."""
+    from kart_tpu.importer import ImportSource
+    from kart_tpu.importer.importer import import_sources
+
+    repo = ctx.repo
+    all_sources = []
+    for spec in sources:
+        opened = ImportSource.open(spec, table=table)
+        all_sources.extend(opened)
+    if dest_path:
+        if len(all_sources) != 1:
+            raise CliError("--dest-path requires a single table import")
+        all_sources[0].dest_path = dest_path
+    import_sources(
+        repo,
+        all_sources,
+        message=message,
+        replace_existing=replace_existing,
+        log=lambda m: click.echo(m, err=True),
+    )
+    if not no_checkout and not repo.is_bare:
+        _do_checkout(repo, "HEAD", force=True)
+
+
+@cli.command()
+@click.option("--message", "-m", multiple=True, help="Commit message")
+@click.option(
+    "--allow-empty", is_flag=True, help="Allow a commit with no changes"
+)
+@click.argument("filters", nargs=-1)
+@click.pass_obj
+def commit(ctx, message, allow_empty, filters):
+    """Record changes from the working copy to the repository."""
+    repo = ctx.require_state(KartRepoState.NORMAL)
+    wc = repo.working_copy
+    if wc is None:
+        raise CliError("No working copy — nothing to commit")
+    target_rs = repo.structure("HEAD")
+    wc.assert_db_tree_match(target_rs.tree_oid)
+
+    from kart_tpu.diff.engine import get_repo_diff
+
+    key_filter = RepoKeyFilter.build_from_user_patterns(filters)
+    repo_diff = get_repo_diff(
+        target_rs, target_rs, repo_key_filter=key_filter, include_wc_diff=True
+    )
+    if not repo_diff and not allow_empty:
+        raise CliError("No changes to commit")
+
+    msg = "\n\n".join(message) if message else None
+    if not msg:
+        raise CliError("Use --message/-m to provide a commit message")
+    new_commit = target_rs.commit_diff(repo_diff, msg, allow_empty=allow_empty)
+    wc.soft_reset_after_commit(repo.odb.read_commit(new_commit).tree, key_filter)
+    commit_obj = repo.odb.read_commit(new_commit)
+    branch = repo.head_branch
+    branch_name = branch.rsplit("/", 1)[-1] if branch else "HEAD"
+    click.echo(
+        f"[{branch_name} {new_commit[:7]}] {commit_obj.message_summary}"
+    )
+
+
+@cli.command()
+@click.option(
+    "--output-format", "-o", type=click.Choice(["text", "json"]), default="text"
+)
+@click.pass_obj
+def status(ctx, output_format):
+    """Show the working copy status."""
+    repo = ctx.repo
+    state = repo.state
+    branch = repo.head_branch
+    head = repo.head_commit_oid
+
+    changes = {}
+    wc = repo.working_copy
+    if wc is not None and head is not None:
+        from kart_tpu.diff.engine import get_repo_diff
+
+        target_rs = repo.structure("HEAD")
+        diff = get_repo_diff(
+            target_rs, target_rs, include_wc_diff=True
+        )
+        for ds_path, ds_diff in diff.items():
+            counts = ds_diff.type_counts()
+            changes[ds_path] = counts
+
+    if output_format == "json":
+        output = {
+            "kart.status/v2": {
+                "commit": head,
+                "abbrevCommit": head[:7] if head else None,
+                "branch": branch.rsplit("/", 1)[-1] if branch else None,
+                "upstream": None,
+                "state": state,
+                "spatialFilter": repo.spatial_filter_spec(),
+                "workingCopy": {
+                    "path": str(wc) if wc else None,
+                    "changes": changes or None,
+                }
+                if wc
+                else None,
+            }
+        }
+        dump_json_output(output, "-")
+        return
+
+    if branch:
+        click.echo(f"On branch {branch.rsplit('/', 1)[-1]}")
+    elif head:
+        click.echo(f"HEAD detached at {head[:7]}")
+    if head is None:
+        click.echo("\nNo commits yet")
+        return
+    if state == KartRepoState.MERGING:
+        click.echo('\nRepository is in "merging" state.')
+        click.echo('View conflicts with "kart conflicts" and resolve them with "kart resolve".')
+        return
+    if not changes:
+        click.echo("\nNothing to commit, working copy clean")
+    else:
+        click.echo("\nChanges in working copy:")
+        click.echo('  (use "kart commit" to commit)')
+        click.echo('  (use "kart checkout -- ." to discard changes)\n')
+        for ds_path, counts in changes.items():
+            click.echo(f"  {ds_path}:")
+            for part, part_counts in counts.items():
+                for change, n in part_counts.items():
+                    click.echo(f"    {part}:\n      {n} {change}" if False else f"      {part}: {n} {change}")
+
+
+@cli.command()
+@click.option("-b", "new_branch", help="Create a new branch and switch to it")
+@click.option("--force", "-f", is_flag=True, help="Discard local changes")
+@click.argument("refish", required=False)
+@click.pass_obj
+def checkout(ctx, new_branch, force, refish):
+    """Switch branches or restore working copy files."""
+    repo = ctx.require_state(KartRepoState.NORMAL)
+    if new_branch:
+        start = refish or "HEAD"
+        oid, _ = repo.resolve_refish(start)
+        repo.refs.set(f"refs/heads/{new_branch}", oid, log_message=f"branch: created from {start}")
+        repo.refs.set_head(f"refs/heads/{new_branch}", log_message=f"checkout: moving to {new_branch}")
+        _do_checkout(repo, "HEAD", force=force)
+        click.echo(f"Switched to a new branch '{new_branch}'")
+        return
+    if refish:
+        wc = repo.working_copy
+        if wc is not None and wc.is_dirty() and not force:
+            raise InvalidOperation(
+                "You have uncommitted changes in your working copy. "
+                "Commit or discard first (use --force to discard)."
+            )
+        oid, ref = repo.resolve_refish(refish)
+        if ref and ref.startswith("refs/heads/"):
+            repo.refs.set_head(ref, log_message=f"checkout: moving to {refish}")
+            click.echo(f"Switched to branch '{refish}'")
+        else:
+            repo.refs.set_head(oid, log_message=f"checkout: moving to {oid[:7]}")
+            click.echo(f"HEAD is now detached at {oid[:7]}")
+        _do_checkout(repo, "HEAD", force=True)
+    else:
+        _do_checkout(repo, "HEAD", force=force)
+
+
+@cli.command()
+@click.option("-c", "--create", "create_branch", help="Create and switch to this branch")
+@click.option("--discard-changes", "--force", "-f", "force", is_flag=True)
+@click.argument("branch", required=False)
+@click.pass_context
+def switch(click_ctx, create_branch, force, branch):
+    """Switch branches."""
+    ctx = click_ctx.obj
+    if create_branch:
+        click_ctx.invoke(checkout, new_branch=create_branch, force=force, refish=branch)
+    else:
+        if not branch:
+            raise CliError("Specify a branch to switch to")
+        click_ctx.invoke(checkout, new_branch=None, force=force, refish=branch)
+
+
+@cli.command()
+@click.option("--source", "-s", default="HEAD", help="Revision to restore from")
+@click.argument("filters", nargs=-1)
+@click.pass_obj
+def restore(ctx, source, filters):
+    """Restore working copy features to their committed state."""
+    repo = ctx.repo
+    wc = repo.working_copy
+    if wc is None:
+        raise CliError("No working copy")
+    structure = repo.structure(source)
+    key_filter = RepoKeyFilter.build_from_user_patterns(filters)
+    if key_filter.match_all:
+        wc.reset(structure, force=True)
+    else:
+        # restore only the filtered features: apply the WC->source diff subset
+        from kart_tpu.diff.engine import get_repo_diff
+
+        head_rs = repo.structure("HEAD")
+        diff = get_repo_diff(
+            structure, head_rs, repo_key_filter=key_filter, include_wc_diff=True
+        )
+        with wc.session() as con:
+            for ds_path, ds_diff in diff.items():
+                ds = structure.datasets.get(ds_path)
+                if ds is None:
+                    continue
+                inverted = ~ds_diff.get("feature", DeltaDiff())
+                wc._apply_feature_diff_sql(con, ds, inverted)
+        wc.reset_tracking_table(key_filter)
+    click.echo(f"Restored working copy from {source}")
+
+
+@cli.command()
+@click.option("--discard-changes", "--hard", "discard", is_flag=True)
+@click.argument("refish", required=False, default="HEAD")
+@click.pass_obj
+def reset(ctx, discard, refish):
+    """Move the current branch tip (and working copy) to another revision."""
+    repo = ctx.require_state(KartRepoState.NORMAL)
+    wc = repo.working_copy
+    if wc is not None and wc.is_dirty() and not discard:
+        raise InvalidOperation(
+            "You have uncommitted changes; use --discard-changes to discard them."
+        )
+    oid, _ = repo.resolve_refish(refish)
+    branch = repo.head_branch
+    if branch:
+        repo.refs.set(branch, oid, log_message=f"reset: moving to {refish}")
+    else:
+        repo.refs.set_head(oid, log_message=f"reset: moving to {refish}")
+    _do_checkout(repo, "HEAD", force=True)
+    click.echo(f"HEAD is now at {oid[:7]}")
+
+
+@cli.command("create-workingcopy")
+@click.option("--delete-existing/--no-delete-existing", default=False)
+@click.argument("location", required=False)
+@click.pass_obj
+def create_workingcopy(ctx, delete_existing, location):
+    """(Re)create the working copy from the current HEAD."""
+    from kart_tpu.core.repo import KartConfigKeys
+    from kart_tpu.workingcopy import get_working_copy
+
+    repo = ctx.repo
+    if location:
+        repo.config[KartConfigKeys.KART_WORKINGCOPY_LOCATION] = location
+    wc = get_working_copy(repo, allow_uncreated=True)
+    if wc is None:
+        raise CliError("No working copy location configured")
+    if delete_existing:
+        wc.delete()
+    structure = repo.structure("HEAD")
+    wc.write_full(structure, *structure.datasets)
+    click.echo(f"Created working copy at {wc}")

@@ -90,6 +90,19 @@ class RepoStructure:
         self.commit_oid, self.ref = repo.resolve_refish(
             refish if refish is not None else "HEAD"
         )
+        # a bare tree oid is also a valid revision (e.g. the working copy's
+        # recorded state tree). Only raw-oid revisions can be trees — named
+        # refs always peel to commits — so the type probe (an object read) is
+        # skipped for every named-ref resolution, and any read error is
+        # deferred to the accessors as before.
+        self._bare_tree_oid = None
+        if self.commit_oid is not None and self.ref is None:
+            try:
+                if repo.odb.object_type(self.commit_oid) == "tree":
+                    self._bare_tree_oid = self.commit_oid
+                    self.commit_oid = None
+            except KeyError:
+                pass
 
     @property
     def commit(self):
@@ -97,13 +110,13 @@ class RepoStructure:
 
     @property
     def tree(self):
-        commit = self.commit
-        if commit is None:
-            return None
-        return self.repo.odb.tree(commit.tree)
+        oid = self.tree_oid
+        return self.repo.odb.tree(oid) if oid else None
 
     @property
     def tree_oid(self):
+        if self._bare_tree_oid is not None:
+            return self._bare_tree_oid
         commit = self.commit
         return commit.tree if commit else None
 

@@ -1,0 +1,134 @@
+"""Output helpers: JSON styles, feature serialisation for each output form
+(reference: kart/output_util.py, kart/feature_output.py)."""
+
+import io
+import json
+import sys
+
+from kart_tpu.crs import normalise_wkt
+from kart_tpu.geometry import Geometry
+
+JSON_PARAMS = {
+    "compact": {"separators": (",", ":")},
+    "extracompact": {"separators": (",", ":")},
+    "pretty": {"indent": 2},
+}
+
+
+class ExtendedJsonEncoder(json.JSONEncoder):
+    def default(self, obj):
+        if isinstance(obj, Geometry):
+            return obj.to_hex_wkb()
+        if isinstance(obj, bytes):
+            return obj.hex()
+        return super().default(obj)
+
+
+def resolve_output_path(output_path):
+    """None/'-' -> stdout; str/Path -> opened file; file-like -> itself."""
+    if output_path is None or output_path == "-":
+        return sys.stdout
+    if hasattr(output_path, "write"):
+        return output_path
+    return open(output_path, "w")
+
+
+def dump_json_output(output, output_path, json_style="pretty", encoder=None):
+    fp = resolve_output_path(output_path)
+    params = JSON_PARAMS.get(json_style, JSON_PARAMS["pretty"])
+    enc = (encoder or ExtendedJsonEncoder)(**params)
+    for chunk in enc.iterencode(output):
+        fp.write(chunk)
+    fp.write("\n")
+    if fp is not sys.stdout:
+        fp.flush()
+
+
+def format_wkt_for_output(wkt):
+    return normalise_wkt(wkt).rstrip("\n")
+
+
+def feature_as_text(feature, prefix=""):
+    lines = []
+    for key, value in feature.items():
+        if key.startswith("__"):
+            continue
+        lines.append(feature_field_as_text(feature, key, prefix))
+    return "\n".join(lines)
+
+
+def feature_field_as_text(feature, key, prefix):
+    value = feature[key]
+    if isinstance(value, Geometry):
+        name = value.geometry_type_name.upper()
+        value = f"{name} EMPTY" if value.is_empty else f"{name}(...)"
+    elif isinstance(value, bytes):
+        value = "BLOB(...)"
+    value = "␀" if value is None else value
+    return f"{prefix}{key:>40} = {value}"
+
+
+def feature_as_json(feature, pk_value, geometry_transform=None):
+    """Row -> JSON dict; geometry as hexWKB (reference: feature_output.py:34)."""
+    out = {}
+    for key, value in feature.items():
+        if isinstance(value, Geometry):
+            if geometry_transform is not None:
+                value = reproject_geometry(value, geometry_transform, pk_value)
+            value = value.to_hex_wkb()
+        elif isinstance(value, bytes):
+            value = value.hex()
+        out[key] = value
+    return out
+
+
+def feature_as_geojson(feature, pk_value, change=None, geometry_transform=None):
+    change_id = f"{change}::{pk_value}" if change else str(pk_value)
+    result = {"type": "Feature", "geometry": None, "properties": {}, "id": change_id}
+    for key, value in feature.items():
+        if isinstance(value, Geometry):
+            if geometry_transform is not None:
+                value = reproject_geometry(value, geometry_transform, pk_value)
+            result["geometry"] = value.to_geojson()
+        elif isinstance(value, bytes):
+            result["properties"][key] = value.hex()
+        else:
+            result["properties"][key] = value
+    return result
+
+
+def reproject_geometry(geom, transform, pk_value=None):
+    """Apply a kart_tpu.crs.Transform to every coordinate of a geometry."""
+    import numpy as np
+
+    from kart_tpu.geometry import GeomValue, _build_gpkg, _geom_value
+
+    def walk(value):
+        name, has_z, has_m, payload = value
+        base = value.base_type
+        if base == 1:  # point
+            if payload is None:
+                return value
+            xs, ys = transform.transform(
+                np.array([payload[0]]), np.array([payload[1]])
+            )
+            return _geom_value(name, has_z, has_m, (float(xs[0]), float(ys[0])) + tuple(payload[2:]))
+        if base == 2:  # linestring
+            return _geom_value(name, has_z, has_m, _tx_points(payload))
+        if base == 3:  # polygon
+            return _geom_value(name, has_z, has_m, [_tx_points(r) for r in payload])
+        return _geom_value(name, has_z, has_m, [walk(c) for c in payload])
+
+    def _tx_points(points):
+        if not points:
+            return points
+        xs = np.array([p[0] for p in points])
+        ys = np.array([p[1] for p in points])
+        txs, tys = transform.transform(xs, ys)
+        return [
+            (float(x), float(y)) + tuple(p[2:])
+            for x, y, p in zip(txs, tys, points)
+        ]
+
+    value = geom.to_coords()
+    return _build_gpkg(walk(value), crs_id=0)

@@ -1,0 +1,596 @@
+"""Diff writers: text / json / geojson / json-lines / quiet / feature-count /
+html (reference: kart/base_diff_writer.py + per-format writer modules).
+
+A writer is constructed from a commit spec (``A``, ``A..B``, ``A...B`` or
+nothing = HEAD vs working copy), streams the diff through the chosen format,
+and reports ``has_changes`` for the exit code. Values stay lazy until each
+delta is written.
+"""
+
+import itertools
+import json
+import re
+import sys
+from datetime import datetime, timedelta, timezone
+
+import click
+
+from kart_tpu.core.repo import InvalidOperation, NotFound
+from kart_tpu.crs import Transform
+from kart_tpu.diff.engine import get_dataset_diff, get_repo_diff
+from kart_tpu.diff.key_filters import RepoKeyFilter
+from kart_tpu.diff.output import (
+    dump_json_output,
+    feature_as_geojson,
+    feature_as_json,
+    feature_as_text,
+    feature_field_as_text,
+    format_wkt_for_output,
+    resolve_output_path,
+)
+from kart_tpu.diff.structs import RepoDiff
+from kart_tpu.models.schema import Schema
+
+_NULL = object()
+
+
+class BaseDiffWriter:
+    @classmethod
+    def get_diff_writer_class(cls, output_format):
+        writers = {
+            "text": TextDiffWriter,
+            "json": JsonDiffWriter,
+            "json-lines": JsonLinesDiffWriter,
+            "geojson": GeojsonDiffWriter,
+            "quiet": QuietDiffWriter,
+            "feature-count": FeatureCountDiffWriter,
+            "html": HtmlDiffWriter,
+        }
+        try:
+            return writers[output_format]
+        except KeyError:
+            raise click.UsageError(
+                f"Unknown output format: {output_format!r} (expected one of "
+                f"{', '.join(writers)})"
+            )
+
+    def __init__(
+        self,
+        repo,
+        commit_spec="HEAD",
+        user_key_filters=(),
+        output_path="-",
+        *,
+        json_style="pretty",
+        target_crs=None,
+        diff_estimate_accuracy=None,
+        commit=None,
+        patch_type="full",
+        include_patch_header=False,
+    ):
+        self.repo = repo
+        self.commit_spec = commit_spec
+        self.output_path = output_path
+        self.json_style = json_style
+        self.target_crs = target_crs
+        self.patch_type = patch_type
+        self.include_patch_header = include_patch_header
+        self.commit = commit  # set for `kart show`
+        self.repo_key_filter = RepoKeyFilter.build_from_user_patterns(user_key_filters)
+        self.base_rs, self.target_rs, self.working_copy = self.parse_diff_commit_spec(
+            repo, commit_spec
+        )
+        self.has_changes = False
+        self.spatial_filter_pk_conflicts = {}
+
+    # -- commit spec --------------------------------------------------------
+
+    @classmethod
+    def parse_diff_commit_spec(cls, repo, commit_spec):
+        """'A', 'A..B', 'A...B' or '' -> (base_rs, target_rs, working_copy)
+        (reference: base_diff_writer.py:139-179)."""
+        commit_spec = commit_spec or "HEAD"
+        parts = re.split(r"(\.{2,3})", commit_spec)
+        if len(parts) == 3:
+            base_rs = repo.structure(parts[0] or "HEAD")
+            target_rs = repo.structure(parts[2] or "HEAD")
+            if parts[1] == "..":
+                # A..B means merge-base(A,B) <> B (git log semantics)
+                ancestor = repo.merge_base(base_rs.commit_oid, target_rs.commit_oid)
+                if ancestor is None:
+                    raise InvalidOperation(
+                        "No common ancestor found — try the ... operator"
+                    )
+                base_rs = repo.structure(ancestor)
+            return base_rs, target_rs, None
+        base_rs = repo.structure(parts[0] if parts[0] else "HEAD")
+        target_rs = repo.structure("HEAD")
+        working_copy = repo.working_copy
+        if working_copy is None:
+            raise NotFound(
+                "No working copy — diff between commits requires two revisions "
+                "(eg HEAD^...HEAD)"
+            )
+        working_copy.assert_db_tree_match(target_rs.tree_oid)
+        return base_rs, target_rs, working_copy
+
+    # -- diff access --------------------------------------------------------
+
+    @property
+    def all_ds_paths(self):
+        base_paths = set(self.base_rs.datasets.paths()) if self.base_rs else set()
+        target_paths = set(self.target_rs.datasets.paths()) if self.target_rs else set()
+        paths = base_paths | target_paths
+        if not self.repo_key_filter.match_all:
+            paths &= set(self.repo_key_filter.ds_paths())
+        return sorted(paths)
+
+    def get_repo_diff(self):
+        return get_repo_diff(
+            self.base_rs,
+            self.target_rs,
+            repo_key_filter=self.repo_key_filter,
+            include_wc_diff=self.working_copy is not None,
+        )
+
+    def get_ds_diff(self, ds_path):
+        return get_dataset_diff(
+            self.base_rs,
+            self.target_rs,
+            ds_path,
+            ds_filter=self.repo_key_filter[ds_path],
+            include_wc_diff=self.working_copy is not None,
+        )
+
+    def iter_deltas(self, ds_diff):
+        feature_diff = ds_diff.get("feature")
+        if not feature_diff:
+            return
+        for key, delta in feature_diff.sorted_items():
+            yield key, delta
+
+    def get_geometry_transforms(self, ds_path, ds_diff):
+        """-> (old_transform, new_transform) to the --crs target, or (None,
+        None)."""
+        if self.target_crs is None:
+            return None, None
+
+        def transform_for(rs):
+            ds = rs.datasets.get(ds_path) if rs is not None else None
+            if ds is None:
+                return None
+            ids = ds.crs_identifiers()
+            if not ids:
+                return None
+            src_wkt = ds.get_crs_definition(ids[0])
+            return Transform(src_wkt, self.target_crs)
+
+        return transform_for(self.base_rs), transform_for(self.target_rs)
+
+    # -- common output pieces -----------------------------------------------
+
+    def commit_header_json(self):
+        commit = self.commit
+        oid = getattr(commit, "oid", None)
+        if commit is None:
+            return None
+        author = commit.author
+        tz = timezone(timedelta(minutes=author.offset))
+        when = datetime.fromtimestamp(author.time, timezone.utc).astimezone(tz)
+        return {
+            "commit": oid,
+            "abbrevCommit": oid[:7] if oid else None,
+            "message": commit.message,
+            "authorName": author.name,
+            "authorEmail": author.email,
+            "authorTime": when.strftime("%Y-%m-%dT%H:%M:%SZ")
+            if author.offset == 0
+            else when.isoformat(),
+            "authorTimeOffset": f"{'+' if author.offset >= 0 else '-'}{abs(author.offset) // 60:02d}:{abs(author.offset) % 60:02d}",
+        }
+
+    def write_warnings_footer(self):
+        conflicts = self.spatial_filter_pk_conflicts
+        if conflicts and any(conflicts.values()):
+            click.secho(
+                "Warning: Some primary keys of newly-inserted features in the "
+                "working copy conflict with features outside the spatial filter "
+                "- if committed, they would overwrite those features.",
+                bold=True,
+                err=True,
+            )
+            for ds_path, pks in conflicts.items():
+                if pks:
+                    shown = ", ".join(str(pk) for pk in pks[:50])
+                    more = f", (... {len(pks) - 50} more)" if len(pks) > 50 else ""
+                    click.echo(
+                        f"  In dataset {ds_path} the conflicting primary key values are: {shown}{more}",
+                        err=True,
+                    )
+
+    def write_diff(self):
+        self.write_header()
+        for ds_path in self.all_ds_paths:
+            ds_diff = self.get_ds_diff(ds_path)
+            if ds_diff:
+                self.has_changes = True
+                self.write_ds_diff(ds_path, ds_diff)
+        self.write_warnings_footer()
+        return self.has_changes
+
+    def write_header(self):
+        pass
+
+    def write_ds_diff(self, ds_path, ds_diff):
+        raise NotImplementedError
+
+
+class TextDiffWriter(BaseDiffWriter):
+    """Human-readable (lossy for geometry) (reference: text_diff_writer.py)."""
+
+    def __init__(self, *args, **kwargs):
+        super().__init__(*args, **kwargs)
+        self.fp = resolve_output_path(self.output_path)
+        self.pecho = {"file": self.fp, "color": getattr(self.fp, "isatty", lambda: False)()}
+
+    def write_header(self):
+        commit = self.commit
+        if commit is None:
+            return
+        author = commit.author
+        tz = timezone(timedelta(minutes=author.offset))
+        when = datetime.fromtimestamp(author.time, timezone.utc).astimezone(tz)
+        click.secho(f"commit {getattr(commit, 'oid', '')}", fg="yellow", **self.pecho)
+        click.secho(f"Author: {author.name} <{author.email}>", **self.pecho)
+        click.secho(f"Date:   {when.strftime('%c %z')}", **self.pecho)
+        click.secho(**self.pecho)
+        for line in commit.message.splitlines():
+            click.secho(f"    {line}", **self.pecho)
+        click.secho(**self.pecho)
+
+    def write_ds_diff(self, ds_path, ds_diff):
+        if "meta" in ds_diff:
+            for key, delta in ds_diff["meta"].sorted_items():
+                self.write_meta_delta(ds_path, key, delta)
+        for key, delta in self.iter_deltas(ds_diff):
+            self.write_feature_delta(ds_path, key, delta)
+
+    def write_meta_delta(self, ds_path, key, delta):
+        if delta.old:
+            click.secho(f"--- {ds_path}:meta:{delta.old_key}", bold=True, **self.pecho)
+        if delta.new:
+            click.secho(f"+++ {ds_path}:meta:{delta.new_key}", bold=True, **self.pecho)
+        if key == "schema.json" and delta.old and delta.new:
+            click.echo(
+                self._schema_diff_as_text(
+                    Schema.from_column_dicts(delta.old_value),
+                    Schema.from_column_dicts(delta.new_value),
+                ),
+                **self.pecho,
+            )
+            return
+        if delta.old:
+            click.secho(
+                self._prefix_meta_item(delta.old_value, delta.old_key, "- "),
+                fg="red",
+                **self.pecho,
+            )
+        if delta.new:
+            click.secho(
+                self._prefix_meta_item(delta.new_value, delta.new_key, "+ "),
+                fg="green",
+                **self.pecho,
+            )
+
+    @classmethod
+    def _prefix_meta_item(cls, value, name, prefix):
+        if name.endswith(".wkt"):
+            text = format_wkt_for_output(value)
+        elif name.endswith(".json"):
+            text = json.dumps(value, indent=2)
+        else:
+            text = str(value)
+        return re.sub("^", prefix, text, flags=re.MULTILINE)
+
+    @classmethod
+    def _schema_diff_as_text(cls, old_schema, new_schema):
+        old_by_id = {c.id: c for c in old_schema}
+        new_by_id = {c.id: c for c in new_schema}
+        lines = ["["]
+        for col in old_schema:
+            if col.id not in new_by_id:
+                lines.append(
+                    click.style(
+                        re.sub("^", "-   ", json.dumps(col.to_dict(), indent=2), flags=re.MULTILINE) + ",",
+                        fg="red",
+                    )
+                )
+        for col in new_schema:
+            old_col = old_by_id.get(col.id)
+            text = json.dumps(col.to_dict(), indent=2)
+            if old_col is None:
+                lines.append(
+                    click.style(re.sub("^", "+   ", text, flags=re.MULTILINE) + ",", fg="green")
+                )
+            elif old_col == col:
+                lines.append(re.sub("^", "    ", text, flags=re.MULTILINE) + ",")
+            else:
+                old_text = json.dumps(old_col.to_dict(), indent=2)
+                lines.append(
+                    click.style(re.sub("^", "-   ", old_text, flags=re.MULTILINE) + ",", fg="red")
+                )
+                lines.append(
+                    click.style(re.sub("^", "+   ", text, flags=re.MULTILINE) + ",", fg="green")
+                )
+        lines.append("]")
+        return "\n".join(lines)
+
+    def write_feature_delta(self, ds_path, key, delta):
+        if delta.type == "insert":
+            click.secho(f"+++ {ds_path}:feature:{delta.new_key}", bold=True, **self.pecho)
+            click.secho(feature_as_text(delta.new_value, prefix="+ "), fg="green", **self.pecho)
+            return
+        if delta.type == "delete":
+            click.secho(f"--- {ds_path}:feature:{delta.old_key}", bold=True, **self.pecho)
+            click.secho(feature_as_text(delta.old_value, prefix="- "), fg="red", **self.pecho)
+            return
+        click.secho(
+            f"--- {ds_path}:feature:{delta.old_key}\n+++ {ds_path}:feature:{delta.new_key}",
+            bold=True,
+            **self.pecho,
+        )
+        old_f, new_f = delta.old_value, delta.new_value
+        for k in itertools.chain(
+            old_f.keys(), (k for k in new_f.keys() if k not in old_f)
+        ):
+            if k.startswith("__") or old_f.get(k, _NULL) == new_f.get(k, _NULL):
+                continue
+            if k in old_f:
+                click.secho(feature_field_as_text(old_f, k, "- "), fg="red", **self.pecho)
+            if k in new_f:
+                click.secho(feature_field_as_text(new_f, k, "+ "), fg="green", **self.pecho)
+
+
+class JsonDiffWriter(BaseDiffWriter):
+    """Complete diff as one JSON document: ``kart.diff/v1+hexwkb``
+    (reference: json_diff_writers.py:18)."""
+
+    def write_diff(self):
+        repo_diff = self.get_repo_diff()
+        self.has_changes = bool(repo_diff)
+        output = {}
+        header = self.commit_header_json()
+        if header is not None:
+            output["kart.show/v1"] = header
+        output["kart.diff/v1+hexwkb"] = {
+            ds_path: self.ds_diff_as_json(ds_path, ds_diff)
+            for ds_path, ds_diff in repo_diff.items()
+        }
+        if self.include_patch_header:
+            output["kart.patch/v1"] = self.patch_header()
+        dump_json_output(output, self.output_path, json_style=self.json_style)
+        self.write_warnings_footer()
+        return self.has_changes
+
+    def patch_header(self):
+        header = self.commit_header_json() or {}
+        base = self.base_rs.commit_oid if self.base_rs else None
+        return {
+            "authorEmail": header.get("authorEmail"),
+            "authorName": header.get("authorName"),
+            "authorTime": header.get("authorTime"),
+            "authorTimeOffset": header.get("authorTimeOffset"),
+            "base": base,
+            "message": header.get("message"),
+        }
+
+    def ds_diff_as_json(self, ds_path, ds_diff):
+        result = {}
+        if "meta" in ds_diff:
+            result["meta"] = {
+                key: self.meta_delta_as_json(delta)
+                for key, delta in ds_diff["meta"].sorted_items()
+            }
+        if "feature" in ds_diff:
+            old_tx, new_tx = self.get_geometry_transforms(ds_path, ds_diff)
+            features = []
+            for key, delta in self.iter_deltas(ds_diff):
+                item = {}
+                if delta.old and (self.patch_type == "full" or not delta.new):
+                    item["-"] = feature_as_json(delta.old_value, delta.old_key, old_tx)
+                if delta.new:
+                    out_key = "+"
+                    if delta.old and self.patch_type == "minimal":
+                        out_key = "*"
+                    item[out_key] = feature_as_json(delta.new_value, delta.new_key, new_tx)
+                features.append(item)
+            result["feature"] = features
+        return result
+
+    def meta_delta_as_json(self, delta):
+        out = {}
+        if delta.old is not None:
+            out["-"] = delta.old_value
+        if delta.new is not None:
+            out["+"] = delta.new_value
+        if self.patch_type == "minimal" and "-" in out and "+" in out:
+            out.pop("-")
+            out["*"] = out.pop("+")
+        return out
+
+
+class JsonLinesDiffWriter(BaseDiffWriter):
+    """Streaming: one JSON object per line (reference: json_diff_writers.py:279)."""
+
+    def __init__(self, *args, **kwargs):
+        super().__init__(*args, **kwargs)
+        self.fp = resolve_output_path(self.output_path)
+
+    def _writeln(self, obj):
+        json.dump(obj, self.fp, separators=(",", ":"))
+        self.fp.write("\n")
+
+    def write_header(self):
+        self._writeln(
+            {"type": "version", "version": "kart.diff/v2", "outputFormat": "JSONL+hexwkb"}
+        )
+        header = self.commit_header_json()
+        if header:
+            self._writeln({"type": "commit", "value": header})
+
+    def write_ds_diff(self, ds_path, ds_diff):
+        if "meta" in ds_diff:
+            for key, delta in ds_diff["meta"].sorted_items():
+                obj = {"type": "metaInfo", "dataset": ds_path, "key": key, "change": {}}
+                if delta.old is not None:
+                    obj["change"]["-"] = delta.old_value
+                if delta.new is not None:
+                    obj["change"]["+"] = delta.new_value
+                self._writeln(obj)
+        old_tx, new_tx = self.get_geometry_transforms(ds_path, ds_diff)
+        for key, delta in self.iter_deltas(ds_diff):
+            change = {}
+            if delta.old:
+                change["-"] = feature_as_json(delta.old_value, delta.old_key, old_tx)
+            if delta.new:
+                change["+"] = feature_as_json(delta.new_value, delta.new_key, new_tx)
+            self._writeln({"type": "feature", "dataset": ds_path, "change": change})
+
+
+class GeojsonDiffWriter(BaseDiffWriter):
+    """FeatureCollection per dataset; deltas become features with
+    ids like 'U-::123' (reference: json_diff_writers.py:182)."""
+
+    def write_diff(self):
+        repo_diff = self.get_repo_diff()
+        self.has_changes = bool(repo_diff)
+        ds_paths = [p for p, d in repo_diff.items() if "feature" in d]
+        multi = len(ds_paths) > 1
+        for ds_path in ds_paths:
+            ds_diff = repo_diff[ds_path]
+            collection = {
+                "type": "FeatureCollection",
+                "features": list(self.features_geojson(ds_path, ds_diff)),
+            }
+            out = self.output_path
+            if multi:
+                import os
+
+                if out in (None, "-") or hasattr(out, "write"):
+                    raise click.UsageError(
+                        "Need an --output directory for multi-dataset GeoJSON diffs"
+                    )
+                os.makedirs(out, exist_ok=True)
+                out = os.path.join(out, ds_path.replace("/", "__") + ".geojson")
+            dump_json_output(collection, out, json_style=self.json_style)
+        self.write_warnings_footer()
+        return self.has_changes
+
+    def features_geojson(self, ds_path, ds_diff):
+        old_tx, new_tx = self.get_geometry_transforms(ds_path, ds_diff)
+        for key, delta in self.iter_deltas(ds_diff):
+            if delta.type == "insert":
+                yield feature_as_geojson(delta.new_value, delta.new_key, "I", new_tx)
+            elif delta.type == "delete":
+                yield feature_as_geojson(delta.old_value, delta.old_key, "D", old_tx)
+            else:
+                yield feature_as_geojson(delta.old_value, delta.old_key, "U-", old_tx)
+                yield feature_as_geojson(delta.new_value, delta.new_key, "U+", new_tx)
+
+
+class QuietDiffWriter(BaseDiffWriter):
+    """No output; has_changes drives the exit code."""
+
+    def write_ds_diff(self, ds_path, ds_diff):
+        pass
+
+
+class FeatureCountDiffWriter(BaseDiffWriter):
+    """Prints per-dataset changed-feature counts."""
+
+    def write_diff(self):
+        fp = resolve_output_path(self.output_path)
+        for ds_path in self.all_ds_paths:
+            ds_diff = self.get_ds_diff(ds_path)
+            count = len(ds_diff.get("feature", ()))
+            if count:
+                self.has_changes = True
+                fp.write(f"{ds_path}:\n\t{count} features changed\n")
+        return self.has_changes
+
+
+_HTML_TEMPLATE = """<!DOCTYPE html>
+<html><head><meta charset="utf-8"><title>kart diff</title>
+<style>
+ body {{ font-family: sans-serif; margin: 0; display: flex; height: 100vh; }}
+ #list {{ width: 40%; overflow: auto; padding: 8px; box-sizing: border-box; }}
+ #map {{ flex: 1; background: #eef; }}
+ .I {{ color: #070; }} .D {{ color: #a00; }} .U- {{ color: #850; }} .U\\+ {{ color: #085; }}
+ pre {{ margin: 2px 0; }}
+ svg path, svg circle {{ fill-opacity: .3; stroke-width: 1; }}
+</style></head><body>
+<div id="list"><h3>kart diff</h3></div><svg id="map"></svg>
+<script>
+const DATA = {data};
+const list = document.getElementById('list');
+const svg = document.getElementById('map');
+let minx=1e9,miny=1e9,maxx=-1e9,maxy=-1e9;
+const geoms = [];
+for (const [ds, fc] of Object.entries(DATA)) {{
+  const h = document.createElement('h4'); h.textContent = ds; list.appendChild(h);
+  for (const f of fc.features) {{
+    const change = f.id.split('::')[0];
+    const pre = document.createElement('pre');
+    pre.className = change;
+    pre.textContent = f.id + ' ' + JSON.stringify(f.properties);
+    list.appendChild(pre);
+    if (f.geometry) {{ geoms.push([change, f.geometry]); walk(f.geometry.coordinates); }}
+  }}
+}}
+function walk(c) {{
+  if (typeof c[0] === 'number') {{
+    minx=Math.min(minx,c[0]); maxx=Math.max(maxx,c[0]);
+    miny=Math.min(miny,c[1]); maxy=Math.max(maxy,c[1]);
+  }} else c.forEach(walk);
+}}
+const W=600,H=600, dx=maxx-minx||1, dy=maxy-miny||1;
+svg.setAttribute('viewBox', `0 0 ${{W}} ${{H}}`);
+const X=x=>(x-minx)/dx*(W-20)+10, Y=y=>H-((y-miny)/dy*(H-20)+10);
+const colors={{'I':'#070','D':'#a00','U-':'#850','U+':'#085'}};
+for (const [change, g] of geoms) draw(g, colors[change]||'#333');
+function draw(g, color) {{
+  const el = (name)=>document.createElementNS('http://www.w3.org/2000/svg', name);
+  const add=(node)=>{{node.setAttribute('stroke',color);node.setAttribute('fill',color);svg.appendChild(node);}};
+  const ring=(pts)=>pts.map((p,i)=>`${{i?'L':'M'}}${{X(p[0])}} ${{Y(p[1])}}`).join('');
+  if (g.type==='Point') {{ const c=el('circle'); c.setAttribute('cx',X(g.coordinates[0])); c.setAttribute('cy',Y(g.coordinates[1])); c.setAttribute('r',4); add(c); }}
+  else if (g.type==='LineString') {{ const p=el('path'); p.setAttribute('d',ring(g.coordinates)); p.setAttribute('fill','none'); add(p); }}
+  else if (g.type==='Polygon') {{ const p=el('path'); p.setAttribute('d',g.coordinates.map(ring).join('')+'Z'); add(p); }}
+  else if (g.type.startsWith('Multi')) g.coordinates.forEach(c=>draw({{type:g.type.slice(5),coordinates:c}}, color));
+}}
+</script></body></html>
+"""
+
+
+class HtmlDiffWriter(BaseDiffWriter):
+    """Self-contained HTML diff viewer: embedded GeoJSON + inline SVG map (no
+    network dependencies — the reference embeds a Leaflet page instead)."""
+
+    def write_diff(self):
+        repo_diff = self.get_repo_diff()
+        self.has_changes = bool(repo_diff)
+        all_data = {}
+        for ds_path, ds_diff in repo_diff.items():
+            if "feature" not in ds_diff:
+                continue
+            helper = GeojsonDiffWriter.features_geojson
+            all_data[ds_path] = {
+                "type": "FeatureCollection",
+                "features": list(helper(self, ds_path, ds_diff)),
+            }
+        fp = resolve_output_path(
+            self.output_path if self.output_path not in (None, "-") else "diff.html"
+        )
+        fp.write(_HTML_TEMPLATE.format(data=json.dumps(all_data)))
+        if hasattr(fp, "name"):
+            click.echo(f"Wrote {fp.name}", err=True)
+        return self.has_changes

@@ -4,11 +4,13 @@ and the Python fast path, kart/spatial_filter/__init__.py:709-734).
 
 Envelopes are (w, s, e, n) with longitudes cyclic over the anti-meridian:
 ``e < w`` means the range wraps (reference spatial_filter.cpp handles the same
-encoding). Intersection of cyclic longitude ranges:
+encoding); ``w <= e`` is an ordinary range — including the full-width
+``(-180, 180)`` which must match everything. Intersection of cyclic
+longitude ranges:
 
-    len1 = (e1 - w1) mod 360 ; len2 = (e2 - w2) mod 360
-    d    = (w2 - w1) mod 360
-    overlap  <=>  d <= len1  or  (360 - d) <= len2
+    len = e - w          when w <= e   (ordinary, up to 360)
+          (e - w) mod 360 otherwise    (wrapping)
+    overlap  <=>  (w2 - w1) mod 360 <= len1  or  (w1 - w2) mod 360 <= len2
 
 Three implementations with identical semantics:
 * ``bbox_intersects_np``    — numpy reference (host, tests)
@@ -24,11 +26,14 @@ import jax.numpy as jnp
 import numpy as np
 
 
+def _range_len_np(w, e):
+    return np.where(e >= w, e - w, np.mod(e - w, 360.0))
+
+
 def _cyclic_overlap_np(w1, e1, w2, e2):
-    len1 = np.mod(e1 - w1, 360.0)
-    len2 = np.mod(e2 - w2, 360.0)
-    d = np.mod(w2 - w1, 360.0)
-    return (d <= len1) | ((360.0 - d) <= len2)
+    len1 = _range_len_np(w1, e1)
+    len2 = _range_len_np(w2, e2)
+    return (np.mod(w2 - w1, 360.0) <= len1) | (np.mod(w1 - w2, 360.0) <= len2)
 
 
 def bbox_intersects_np(envelopes, query):
@@ -46,10 +51,9 @@ def bbox_intersects_jnp(w, s, e, n, query):
     """Columns (N,) f32 + query (4,) -> bool (N,). XLA path."""
     qw, qs, qe, qn = query[0], query[1], query[2], query[3]
     lat_ok = (s <= qn) & (qs <= n)
-    len1 = jnp.mod(e - w, 360.0)
-    len2 = jnp.mod(qe - qw, 360.0)
-    d = jnp.mod(qw - w, 360.0)
-    lon_ok = (d <= len1) | ((360.0 - d) <= len2)
+    len1 = jnp.where(e >= w, e - w, jnp.mod(e - w, 360.0))
+    len2 = jnp.where(qe >= qw, qe - qw, jnp.mod(qe - qw, 360.0))
+    lon_ok = (jnp.mod(qw - w, 360.0) <= len1) | (jnp.mod(w - qw, 360.0) <= len2)
     return lat_ok & lon_ok
 
 
@@ -63,10 +67,9 @@ def _bbox_kernel(query_ref, w_ref, s_ref, e_ref, n_ref, out_ref):
     e = e_ref[:, :]
     n = n_ref[:, :]
     lat_ok = (s <= qn) & (qs <= n)
-    len1 = jnp.mod(e - w, 360.0)
-    len2 = jnp.mod(qe - qw, 360.0)
-    d = jnp.mod(qw - w, 360.0)
-    lon_ok = (d <= len1) | ((360.0 - d) <= len2)
+    len1 = jnp.where(e >= w, e - w, jnp.mod(e - w, 360.0))
+    len2 = jnp.where(qe >= qw, qe - qw, jnp.mod(qe - qw, 360.0))
+    lon_ok = (jnp.mod(qw - w, 360.0) <= len1) | (jnp.mod(w - qw, 360.0) <= len2)
     out_ref[:, :] = (lat_ok & lon_ok).astype(jnp.int8)
 
 

@@ -56,8 +56,18 @@ class EnvelopeCodec:
 
     def encode_batch(self, envelopes):
         """(N,4) float64 w,s,e,n -> (N, nbytes) uint8, identical bytes to the
-        scalar path."""
+        scalar path. Raises on out-of-range / NaN values (the scalar path
+        asserts; silent uint64 wraparound would corrupt the shared index)."""
         env = np.asarray(envelopes, dtype=np.float64)
+        lo = np.array([-180.0, -90.0, -180.0, -90.0])
+        hi = np.array([180.0, 90.0, 180.0, 90.0])
+        bad = ~((env >= lo) & (env <= hi))  # NaN compares False on both
+        if bad.any():
+            rows = np.nonzero(bad.any(axis=1))[0][:5]
+            raise ValueError(
+                f"Envelope values out of range at rows {rows.tolist()}: "
+                f"{env[rows].tolist()}"
+            )
         vmax = np.float64(self.value_max)
         w = np.floor((env[:, 0] + 180.0) / 360.0 * vmax).astype(np.uint64)
         s = np.floor((env[:, 1] + 90.0) / 180.0 * vmax).astype(np.uint64)

@@ -1,0 +1,83 @@
+"""Working copies: mutable database mirrors of the ODB state
+(reference: kart/working_copy/).
+
+The GPKG working copy (stdlib sqlite3) is the default; server-DB working
+copies (PostGIS / SQL Server / MySQL) are gated on their drivers being
+installed.
+"""
+
+from enum import Enum, IntFlag
+
+
+class WorkingCopyType(Enum):
+    GPKG = "gpkg"
+    POSTGIS = "postgis"
+    SQL_SERVER = "sqlserver"
+    MYSQL = "mysql"
+
+    @classmethod
+    def from_location(cls, location):
+        location = str(location)
+        if location.startswith("postgresql:"):
+            return cls.POSTGIS
+        if location.startswith("mssql:"):
+            return cls.SQL_SERVER
+        if location.startswith("mysql:"):
+            return cls.MYSQL
+        if location.lower().endswith(".gpkg"):
+            return cls.GPKG
+        from kart_tpu.core.repo import InvalidOperation
+
+        raise InvalidOperation(
+            f"Unrecognised working copy location: {location!r} "
+            f"(expected a .gpkg path or a postgresql://, mssql://, mysql:// URL)"
+        )
+
+
+class WorkingCopyStatus(IntFlag):
+    UNCONNECTABLE = 0x1
+    NON_EXISTENT = 0x2
+    CREATED = 0x4
+    INITIALISED = 0x8
+    HAS_DATA = 0x10
+    DIRTY = 0x20
+
+
+def get_working_copy(repo, allow_uncreated=False):
+    """-> the repo's working copy instance, or None when no location is
+    configured (bare repos) or nothing exists there yet."""
+    from kart_tpu.core.repo import KartConfigKeys
+
+    location = repo.config.get(KartConfigKeys.KART_WORKINGCOPY_LOCATION)
+    if location is None and not repo.is_bare:
+        location = default_location(repo)
+    if location is None:
+        return None
+    wc_type = WorkingCopyType.from_location(location)
+    if wc_type is WorkingCopyType.GPKG:
+        from kart_tpu.workingcopy.gpkg import GpkgWorkingCopy
+
+        wc = GpkgWorkingCopy(repo, location)
+    elif wc_type is WorkingCopyType.POSTGIS:
+        from kart_tpu.workingcopy.postgis import PostgisWorkingCopy
+
+        wc = PostgisWorkingCopy(repo, location)
+    else:
+        from kart_tpu.core.repo import NotFound
+
+        raise NotFound(
+            f"Working copy type {wc_type.value} requires a database driver that "
+            f"is not installed in this environment"
+        )
+    if not allow_uncreated and not (wc.status() & (WorkingCopyStatus.INITIALISED)):
+        return None
+    return wc
+
+
+def default_location(repo):
+    import os
+
+    if repo.workdir is None:
+        return None
+    name = os.path.basename(repo.workdir) or "data"
+    return f"{name}.gpkg"

@@ -1,0 +1,623 @@
+"""GPKG working copy over stdlib sqlite3
+(reference: kart/working_copy/gpkg.py + base.py).
+
+The working copy is a *derived cache* of one commit's datasets, materialised
+as GPKG tables. Change tracking is trigger-based: every user edit records the
+row's pk in ``gpkg_kart_track``; ``gpkg_kart_state`` stores the tree id the
+copy was checked out from, so ``status``/``diff``/``commit`` only ever look at
+tracked rows — never a full table scan (reference: base.py:118-158).
+
+The feature compare (WC row vs dataset row) batches tracked rows and compares
+value tuples; at GPKG scale the tracked set is the user's edit set, which is
+small relative to the dataset, so this stays on the host path — the columnar
+device compare handles the bulk reset/import directions.
+"""
+
+import contextlib
+import os
+import sqlite3
+
+from kart_tpu.adapters import gpkg as adapter
+from kart_tpu.core.repo import InvalidOperation, NotFound
+from kart_tpu.crs import get_identifier_int, get_identifier_str
+from kart_tpu.diff.structs import (
+    WORKING_COPY_EDIT,
+    DatasetDiff,
+    Delta,
+    DeltaDiff,
+    KeyValue,
+)
+from kart_tpu.geometry import Geometry
+from kart_tpu.models.schema import Schema
+from kart_tpu.workingcopy import WorkingCopyStatus
+
+STATE_TABLE = "gpkg_kart_state"
+TRACK_TABLE = "gpkg_kart_track"
+
+_GPKG_BASE_DDL = """
+CREATE TABLE IF NOT EXISTS gpkg_contents (
+    table_name TEXT NOT NULL PRIMARY KEY, data_type TEXT NOT NULL,
+    identifier TEXT UNIQUE, description TEXT DEFAULT '',
+    last_change DATETIME NOT NULL DEFAULT (strftime('%Y-%m-%dT%H:%M:%fZ','now')),
+    min_x DOUBLE, min_y DOUBLE, max_x DOUBLE, max_y DOUBLE, srs_id INTEGER);
+CREATE TABLE IF NOT EXISTS gpkg_geometry_columns (
+    table_name TEXT NOT NULL, column_name TEXT NOT NULL,
+    geometry_type_name TEXT NOT NULL, srs_id INTEGER NOT NULL,
+    z TINYINT NOT NULL, m TINYINT NOT NULL,
+    CONSTRAINT pk_geom_cols PRIMARY KEY (table_name, column_name));
+CREATE TABLE IF NOT EXISTS gpkg_spatial_ref_sys (
+    srs_name TEXT NOT NULL, srs_id INTEGER NOT NULL PRIMARY KEY,
+    organization TEXT NOT NULL, organization_coordsys_id INTEGER NOT NULL,
+    definition TEXT NOT NULL, description TEXT);
+CREATE TABLE IF NOT EXISTS gpkg_kart_state (
+    table_name TEXT NOT NULL, key TEXT NOT NULL, value TEXT NULL,
+    CONSTRAINT _kart_state_pk PRIMARY KEY (table_name, key));
+CREATE TABLE IF NOT EXISTS gpkg_kart_track (
+    table_name TEXT NOT NULL, pk TEXT NULL,
+    CONSTRAINT _kart_track_pk PRIMARY KEY (table_name, pk));
+"""
+
+_DEFAULT_SRS = [
+    ("Undefined cartesian SRS", -1, "NONE", -1, "undefined", None),
+    ("Undefined geographic SRS", 0, "NONE", 0, "undefined", None),
+]
+
+
+class Mismatch(InvalidOperation):
+    def __init__(self, wc_tree, expected_tree):
+        super().__init__(
+            f"Working copy is out of sync with repository: working copy has tree "
+            f"{wc_tree}, repository expects {expected_tree}. "
+            f'Use "kart checkout --force HEAD" to reset the working copy.'
+        )
+        self.wc_tree = wc_tree
+        self.expected_tree = expected_tree
+
+
+class GpkgWorkingCopy:
+    def __init__(self, repo, location):
+        self.repo = repo
+        self.location = str(location)
+        if os.path.isabs(self.location) or repo.workdir is None:
+            self.full_path = self.location
+        else:
+            self.full_path = os.path.join(repo.workdir, self.location)
+
+    @property
+    def clean_location(self):
+        return self.location
+
+    def __str__(self):
+        return self.location
+
+    # -- connection ----------------------------------------------------------
+
+    @contextlib.contextmanager
+    def session(self):
+        con = sqlite3.connect(self.full_path)
+        con.row_factory = sqlite3.Row
+        con.execute("PRAGMA foreign_keys = OFF;")
+        try:
+            con.execute("BEGIN")
+            yield con
+            con.commit()
+        except Exception:
+            con.rollback()
+            raise
+        finally:
+            con.close()
+
+    # -- status / state ------------------------------------------------------
+
+    def status(self):
+        result = 0
+        if not os.path.exists(self.full_path):
+            return WorkingCopyStatus.NON_EXISTENT
+        result |= WorkingCopyStatus.CREATED
+        try:
+            with self.session() as con:
+                has_state = con.execute(
+                    "SELECT count(*) FROM sqlite_master WHERE name = ?",
+                    (STATE_TABLE,),
+                ).fetchone()[0]
+                if has_state:
+                    result |= WorkingCopyStatus.INITIALISED
+                tables = con.execute(
+                    "SELECT count(*) FROM sqlite_master WHERE type='table' "
+                    "AND name NOT LIKE 'gpkg_%' AND name NOT LIKE 'sqlite_%'"
+                ).fetchone()[0]
+                if tables:
+                    result |= WorkingCopyStatus.HAS_DATA
+        except sqlite3.DatabaseError:
+            result |= WorkingCopyStatus.UNCONNECTABLE
+        return result
+
+    def create_and_initialise(self):
+        os.makedirs(os.path.dirname(self.full_path) or ".", exist_ok=True)
+        with self.session() as con:
+            con.executescript(_GPKG_BASE_DDL)
+            for row in _DEFAULT_SRS:
+                con.execute(
+                    "INSERT OR IGNORE INTO gpkg_spatial_ref_sys VALUES (?,?,?,?,?,?)",
+                    row,
+                )
+
+    def delete(self):
+        if os.path.exists(self.full_path):
+            os.remove(self.full_path)
+
+    def get_db_tree(self):
+        with self.session() as con:
+            try:
+                row = con.execute(
+                    f"SELECT value FROM {STATE_TABLE} WHERE table_name = '*' AND key = 'tree'"
+                ).fetchone()
+            except sqlite3.OperationalError:
+                return None
+            return row[0] if row else None
+
+    def assert_db_tree_match(self, expected_tree_oid):
+        wc_tree = self.get_db_tree()
+        expected = expected_tree_oid.oid if hasattr(expected_tree_oid, "oid") else expected_tree_oid
+        if wc_tree != expected:
+            raise Mismatch(wc_tree, expected)
+
+    def _update_state_tree(self, con, tree_oid):
+        con.execute(
+            f"INSERT OR REPLACE INTO {STATE_TABLE} (table_name, key, value) "
+            f"VALUES ('*', 'tree', ?)",
+            (tree_oid,),
+        )
+
+    # -- table naming --------------------------------------------------------
+
+    @staticmethod
+    def _table_name(ds_path):
+        """dataset path -> GPKG table name (slashes become underscores)."""
+        return ds_path.replace("/", "__")
+
+    def _ds_path_for_table(self, table_name, ds_paths):
+        for p in ds_paths:
+            if self._table_name(p) == table_name:
+                return p
+        return None
+
+    # -- checkout (write_full) ----------------------------------------------
+
+    def write_full(self, target_structure, *datasets):
+        """Bulk checkout of datasets into the WC; records the target tree
+        (reference: base.py:899-966)."""
+        if not (self.status() & WorkingCopyStatus.INITIALISED):
+            self.create_and_initialise()
+        with self.session() as con:
+            for ds in datasets:
+                self._write_one_dataset(con, ds)
+            self._update_state_tree(con, target_structure.tree_oid)
+
+    def _write_one_dataset(self, con, ds):
+        table = self._table_name(ds.path)
+        schema = ds.schema
+        crs_id = 0
+        geom_col = schema.first_geometry_column
+        crs_defs = {}
+        for ident in ds.crs_identifiers():
+            crs_defs[ident] = ds.get_crs_definition(ident)
+        if geom_col is not None and crs_defs:
+            first_wkt = next(iter(crs_defs.values()))
+            crs_id = get_identifier_int(first_wkt)
+
+        # register CRS
+        for ident, wkt in crs_defs.items():
+            srs_id = get_identifier_int(wkt)
+            org, _, code = ident.partition(":")
+            con.execute(
+                "INSERT OR REPLACE INTO gpkg_spatial_ref_sys "
+                "(srs_name, srs_id, organization, organization_coordsys_id, definition) "
+                "VALUES (?,?,?,?,?)",
+                (ident, srs_id, org or "NONE", int(code) if code.isdigit() else srs_id, wkt),
+            )
+
+        con.execute(f"DROP TABLE IF EXISTS {adapter.quote(table)}")
+        con.execute(
+            f"CREATE TABLE {adapter.quote(table)} ({adapter.v2_schema_to_sql_spec(schema)})"
+        )
+
+        title = ds.get_meta_item("title") or table
+        description = ds.get_meta_item("description") or ""
+        data_type = "features" if geom_col is not None else "attributes"
+        con.execute(
+            "INSERT OR REPLACE INTO gpkg_contents "
+            "(table_name, data_type, identifier, description, srs_id) VALUES (?,?,?,?,?)",
+            (table, data_type, title, description, crs_id if geom_col is not None else None),
+        )
+        if geom_col is not None:
+            gtype = geom_col.extra_type_info.get("geometryType", "GEOMETRY").split(" ")
+            has_z = 1 if "Z" in gtype[1:] or "ZM" in gtype[1:] else 0
+            has_m = 1 if "M" in gtype[1:] or "ZM" in gtype[1:] else 0
+            con.execute(
+                "INSERT OR REPLACE INTO gpkg_geometry_columns VALUES (?,?,?,?,?,?)",
+                (table, geom_col.name, gtype[0], crs_id, has_z, has_m),
+            )
+
+        # bulk insert in chunks
+        col_names = [c.name for c in schema.columns]
+        placeholders = ",".join("?" for _ in col_names)
+        quoted_cols = ",".join(adapter.quote(c) for c in col_names)
+        insert_sql = (
+            f"INSERT INTO {adapter.quote(table)} ({quoted_cols}) VALUES ({placeholders})"
+        )
+        batch = []
+        for feature in ds.features():
+            batch.append(
+                tuple(
+                    adapter.value_from_v2(feature[c.name], c, crs_id=crs_id)
+                    for c in schema.columns
+                )
+            )
+            if len(batch) >= 10000:
+                con.executemany(insert_sql, batch)
+                batch.clear()
+        if batch:
+            con.executemany(insert_sql, batch)
+
+        # autoincrement sequence: next insert gets an unused pk
+        pk_cols = schema.pk_columns
+        if len(pk_cols) == 1 and pk_cols[0].data_type == "integer":
+            row = con.execute(
+                f"SELECT MAX({adapter.quote(pk_cols[0].name)}) FROM {adapter.quote(table)}"
+            ).fetchone()
+            if row[0] is not None:
+                con.execute(
+                    "INSERT OR REPLACE INTO sqlite_sequence (name, seq) VALUES (?, ?)",
+                    (table, row[0]),
+                )
+
+        self._create_triggers(con, table, schema)
+
+    def _create_triggers(self, con, table, schema):
+        """Edit tracking (reference: gpkg.py:498-554)."""
+        pk = adapter.quote(schema.pk_columns[0].name) if schema.pk_columns else "rowid"
+        qt = adapter.quote(table)
+        prefix = f"trigger_kart_{table}"
+        con.execute(f'DROP TRIGGER IF EXISTS "{prefix}_ins"')
+        con.execute(f'DROP TRIGGER IF EXISTS "{prefix}_upd"')
+        con.execute(f'DROP TRIGGER IF EXISTS "{prefix}_del"')
+        con.execute(
+            f'CREATE TRIGGER "{prefix}_ins" AFTER INSERT ON {qt} BEGIN '
+            f"INSERT OR REPLACE INTO {TRACK_TABLE} (table_name, pk) VALUES ('{table}', NEW.{pk}); END;"
+        )
+        con.execute(
+            f'CREATE TRIGGER "{prefix}_upd" AFTER UPDATE ON {qt} BEGIN '
+            f"INSERT OR REPLACE INTO {TRACK_TABLE} (table_name, pk) VALUES ('{table}', NEW.{pk}); "
+            f"INSERT OR REPLACE INTO {TRACK_TABLE} (table_name, pk) VALUES ('{table}', OLD.{pk}); END;"
+        )
+        con.execute(
+            f'CREATE TRIGGER "{prefix}_del" AFTER DELETE ON {qt} BEGIN '
+            f"INSERT OR REPLACE INTO {TRACK_TABLE} (table_name, pk) VALUES ('{table}', OLD.{pk}); END;"
+        )
+
+    @contextlib.contextmanager
+    def _suspended_triggers(self, con, table):
+        """Disable tracking while kart itself writes (reference: base.py uses
+        a session-level flag; sqlite needs drop/recreate)."""
+        prefix = f"trigger_kart_{table}"
+        for suffix in ("ins", "upd", "del"):
+            con.execute(f'DROP TRIGGER IF EXISTS "{prefix}_{suffix}"')
+        yield
+        # recreated by caller via _create_triggers
+
+    # -- reading the WC ------------------------------------------------------
+
+    def _wc_schema_for_table(self, con, table):
+        """Current table DDL -> V2 schema (ids are fresh; align against the
+        dataset schema before diffing)."""
+        geom_info = None
+        row = con.execute(
+            "SELECT column_name, geometry_type_name, srs_id, z, m "
+            "FROM gpkg_geometry_columns WHERE table_name = ?",
+            (table,),
+        ).fetchone()
+        crs_identifier = None
+        if row:
+            srs = con.execute(
+                "SELECT * FROM gpkg_spatial_ref_sys WHERE srs_id = ?",
+                (row["srs_id"],),
+            ).fetchone()
+            if srs and srs["srs_id"] > 0:
+                crs_identifier = (
+                    f"{srs['organization']}:{srs['organization_coordsys_id']}"
+                    if srs["organization"] and srs["organization"] != "NONE"
+                    else get_identifier_str(srs["definition"])
+                )
+            geom_info = {**dict(row), "crs_identifier": crs_identifier}
+
+        from kart_tpu.models.schema import ColumnSchema
+
+        cols = []
+        for info in con.execute(f"PRAGMA table_info({adapter.quote(table)})"):
+            name = info["name"]
+            is_geom = geom_info is not None and name == geom_info["column_name"]
+            data_type, extra = adapter.sqlite_type_to_v2(
+                info["type"], geom_info=geom_info if is_geom else None
+            )
+            pk_index = info["pk"] - 1 if info["pk"] > 0 else None
+            if pk_index is not None and data_type == "integer":
+                extra = {**extra, "size": 64}
+            cols.append(
+                ColumnSchema(ColumnSchema.new_id(), name, data_type, pk_index, extra)
+            )
+        return Schema(cols)
+
+    def _wc_meta_items(self, con, table, aligned_schema):
+        out = {"schema.json": aligned_schema.to_column_dicts()}
+        row = con.execute(
+            "SELECT identifier, description, srs_id FROM gpkg_contents WHERE table_name = ?",
+            (table,),
+        ).fetchone()
+        if row:
+            if row["identifier"]:
+                out["title"] = row["identifier"]
+            if row["description"]:
+                out["description"] = row["description"]
+        geom = con.execute(
+            "SELECT srs_id FROM gpkg_geometry_columns WHERE table_name = ?", (table,)
+        ).fetchone()
+        if geom is not None:
+            srs = con.execute(
+                "SELECT * FROM gpkg_spatial_ref_sys WHERE srs_id = ?",
+                (geom["srs_id"],),
+            ).fetchone()
+            if srs and srs["srs_id"] > 0:
+                ident = (
+                    f"{srs['organization']}:{srs['organization_coordsys_id']}"
+                    if srs["organization"] and srs["organization"] != "NONE"
+                    else get_identifier_str(srs["definition"])
+                )
+                out[f"crs/{ident}.wkt"] = srs["definition"]
+        return out
+
+    # -- diffing -------------------------------------------------------------
+
+    def diff_dataset_to_working_copy(self, dataset, ds_filter=None, workdir_diff_cache=None):
+        """DatasetDiff dataset -> current WC state. Only tracked rows are
+        examined (reference: base.py:498-768)."""
+        table = self._table_name(dataset.path)
+        result = DatasetDiff()
+        with self.session() as con:
+            exists = con.execute(
+                "SELECT count(*) FROM sqlite_master WHERE name = ?", (table,)
+            ).fetchone()[0]
+            if not exists:
+                return result
+            result["meta"] = self._diff_meta(con, dataset, table)
+            new_schema = dataset.schema
+            if "schema.json" in result["meta"]:
+                new_schema = Schema.from_column_dicts(
+                    result["meta"]["schema.json"].new_value
+                )
+            result["feature"] = self._diff_features(
+                con, dataset, table, new_schema, ds_filter
+            )
+        result.prune()
+        return result
+
+    def _diff_meta(self, con, dataset, table):
+        wc_schema = self._wc_schema_for_table(con, table)
+        aligned = dataset.schema.align_to_self(
+            wc_schema, roundtrip_ctx=adapter.GpkgRoundtripContext
+        )
+        wc_items = self._wc_meta_items(con, table, aligned)
+        ds_items = dataset.meta_items()
+        out = DeltaDiff()
+        for name in sorted(set(ds_items) | set(wc_items)):
+            if name == "metadata.xml":
+                continue  # attachments don't roundtrip through the WC
+            old = ds_items.get(name)
+            new = wc_items.get(name)
+            if old == new:
+                continue
+            out.add_delta(
+                Delta(
+                    KeyValue((name, old)) if old is not None else None,
+                    KeyValue((name, new)) if new is not None else None,
+                    flags=WORKING_COPY_EDIT,
+                )
+            )
+        return out
+
+    def _diff_features(self, con, dataset, table, wc_schema, ds_filter):
+        feature_filter = ds_filter["feature"] if ds_filter is not None else None
+        out = DeltaDiff()
+        pk_col = dataset.schema.pk_columns[0]
+        geom_cols = {c.name for c in wc_schema.columns if c.data_type == "geometry"}
+        tracked = [
+            row["pk"]
+            for row in con.execute(
+                f"SELECT pk FROM {TRACK_TABLE} WHERE table_name = ?", (table,)
+            )
+        ]
+        if not tracked:
+            return out
+        quoted = adapter.quote(pk_col.name)
+        for chunk_start in range(0, len(tracked), 500):
+            chunk = tracked[chunk_start : chunk_start + 500]
+            placeholders = ",".join("?" for _ in chunk)
+            rows = {
+                row[pk_col.name]: row
+                for row in con.execute(
+                    f"SELECT * FROM {adapter.quote(table)} WHERE {quoted} IN ({placeholders})",
+                    chunk,
+                )
+            }
+            for raw_pk in chunk:
+                pk = dataset.schema.sanitise_pks(raw_pk)[0]
+                key = pk
+                if feature_filter is not None and key not in feature_filter:
+                    continue
+                try:
+                    old_feature = dataset.get_feature([pk])
+                except KeyError:
+                    old_feature = None
+                row = rows.get(pk)
+                new_feature = None
+                if row is not None:
+                    new_feature = {
+                        c.name: adapter.value_to_v2(row[c.name], c)
+                        for c in wc_schema.columns
+                        if c.name in row.keys()
+                    }
+                    for g in geom_cols & set(new_feature):
+                        if isinstance(new_feature[g], Geometry):
+                            new_feature[g] = new_feature[g].normalised()
+                if old_feature is None and new_feature is None:
+                    continue
+                if old_feature == new_feature:
+                    continue
+                out.add_delta(
+                    Delta(
+                        KeyValue((key, old_feature)) if old_feature is not None else None,
+                        KeyValue((key, new_feature)) if new_feature is not None else None,
+                        flags=WORKING_COPY_EDIT,
+                    )
+                )
+        return out
+
+    def is_dirty(self):
+        if not (self.status() & WorkingCopyStatus.INITIALISED):
+            return False
+        tree = self.get_db_tree()
+        if tree is None:
+            return False
+        try:
+            rs = self.repo.structure(tree)
+        except NotFound:
+            return False
+        for ds in rs.datasets:
+            if self.diff_dataset_to_working_copy(ds):
+                return True
+        return False
+
+    # -- state updates after commit/checkout ----------------------------------
+
+    def reset_tracking_table(self, repo_key_filter=None):
+        with self.session() as con:
+            if repo_key_filter is None or repo_key_filter.match_all:
+                con.execute(f"DELETE FROM {TRACK_TABLE}")
+            else:
+                for ds_path in repo_key_filter.ds_paths():
+                    ds_filter = repo_key_filter[ds_path]
+                    table = self._table_name(ds_path)
+                    feature_filter = ds_filter["feature"]
+                    if ds_filter.match_all or feature_filter.match_all:
+                        con.execute(
+                            f"DELETE FROM {TRACK_TABLE} WHERE table_name = ?", (table,)
+                        )
+                    else:
+                        for pk in feature_filter.keys:
+                            con.execute(
+                                f"DELETE FROM {TRACK_TABLE} WHERE table_name = ? AND pk = ?",
+                                (table, str(pk)),
+                            )
+
+    def update_state_table_tree(self, tree_oid):
+        with self.session() as con:
+            self._update_state_tree(con, tree_oid)
+
+    # -- reset / checkout ------------------------------------------------------
+
+    def reset(self, target_structure, *, force=False, repo_key_filter=None,
+              track_changes_as_dirty=False):
+        """Move the WC to target revision. Without force, uncommitted tracked
+        changes for unaffected features are preserved; structural changes use
+        drop-and-rewrite (reference: base.py:1099-1338)."""
+        from kart_tpu.diff.engine import get_dataset_diff
+
+        current_tree = self.get_db_tree()
+        if current_tree is None:
+            self.write_full(target_structure, *target_structure.datasets)
+            return
+        if force:
+            self.write_full(target_structure, *target_structure.datasets)
+            with self.session() as con:
+                con.execute(f"DELETE FROM {TRACK_TABLE}")
+            return
+
+        base_rs = self.repo.structure(current_tree)
+        base_paths = set(base_rs.datasets.paths())
+        target_paths = set(target_structure.datasets.paths())
+
+        with self.session() as con:
+            # datasets removed in target
+            for ds_path in sorted(base_paths - target_paths):
+                table = self._table_name(ds_path)
+                con.execute(f"DROP TABLE IF EXISTS {adapter.quote(table)}")
+                con.execute("DELETE FROM gpkg_contents WHERE table_name = ?", (table,))
+                con.execute(
+                    "DELETE FROM gpkg_geometry_columns WHERE table_name = ?", (table,)
+                )
+                con.execute(f"DELETE FROM {TRACK_TABLE} WHERE table_name = ?", (table,))
+            # new datasets
+            for ds_path in sorted(target_paths - base_paths):
+                self._write_one_dataset(con, target_structure.datasets[ds_path])
+            # changed datasets: apply the tree diff as SQL
+            for ds_path in sorted(base_paths & target_paths):
+                base_ds = base_rs.datasets[ds_path]
+                target_ds = target_structure.datasets[ds_path]
+                ds_diff = get_dataset_diff(base_rs, target_structure, ds_path)
+                if not ds_diff:
+                    continue
+                if "meta" in ds_diff and ds_diff["meta"]:
+                    # schema/meta changed: simplest correct behaviour is rewrite
+                    self._write_one_dataset(con, target_ds)
+                    con.execute(
+                        f"DELETE FROM {TRACK_TABLE} WHERE table_name = ?",
+                        (self._table_name(ds_path),),
+                    )
+                    continue
+                self._apply_feature_diff_sql(
+                    con, target_ds, ds_diff.get("feature", {}),
+                    track_changes_as_dirty=track_changes_as_dirty,
+                )
+            self._update_state_tree(con, target_structure.tree_oid)
+
+    def _apply_feature_diff_sql(self, con, dataset, feature_diff, *,
+                                track_changes_as_dirty=False):
+        table = self._table_name(dataset.path)
+        schema = dataset.schema
+        crs_id = 0
+        crs_ids = dataset.crs_identifiers()
+        if schema.first_geometry_column is not None and crs_ids:
+            crs_id = get_identifier_int(dataset.get_crs_definition(crs_ids[0]))
+        pk_col = schema.pk_columns[0]
+        if not track_changes_as_dirty:
+            # suspend triggers so kart's own writes aren't tracked
+            for suffix in ("ins", "upd", "del"):
+                con.execute(f'DROP TRIGGER IF EXISTS "trigger_kart_{table}_{suffix}"')
+        try:
+            col_names = [c.name for c in schema.columns]
+            quoted_cols = ",".join(adapter.quote(c) for c in col_names)
+            placeholders = ",".join("?" for _ in col_names)
+            for delta in feature_diff.values():
+                if delta.new is None:
+                    con.execute(
+                        f"DELETE FROM {adapter.quote(table)} WHERE {adapter.quote(pk_col.name)} = ?",
+                        (delta.old_key,),
+                    )
+                else:
+                    values = tuple(
+                        adapter.value_from_v2(delta.new_value[c.name], c, crs_id=crs_id)
+                        for c in schema.columns
+                    )
+                    con.execute(
+                        f"INSERT OR REPLACE INTO {adapter.quote(table)} "
+                        f"({quoted_cols}) VALUES ({placeholders})",
+                        values,
+                    )
+        finally:
+            if not track_changes_as_dirty:
+                self._create_triggers(con, table, schema)
+
+    def soft_reset_after_commit(self, new_tree_oid, repo_key_filter=None):
+        """After committing WC changes: clear tracking, bump state tree."""
+        self.reset_tracking_table(repo_key_filter)
+        self.update_state_table_tree(new_tree_oid)

