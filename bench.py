@@ -1,0 +1,93 @@
+"""North-star benchmark: features diffed/sec, device vs CPU reference path.
+
+Builds two synthetic revisions of an N-row layer (default 10M, BASELINE.json
+config #2: attribute-only diff), runs the jitted diff-classification kernel
+on the live device, and compares against the pure-numpy reference
+implementation of identical semantics (the measured CPU baseline — the
+reference publishes no absolute numbers, SURVEY.md §6).
+
+Prints exactly one JSON line:
+    {"metric": ..., "value": N, "unit": ..., "vs_baseline": N}
+"""
+
+import json
+import os
+import time
+
+import numpy as np
+
+
+def _build(n, changed_frac=0.01):
+    from kart_tpu.ops.blocks import FeatureBlock, bucket_size, PAD_KEY
+    from kart_tpu.parallel.sharded_diff import synthetic_block
+
+    old = synthetic_block(n, seed=0)
+    new = synthetic_block(n, seed=0)
+    rng = np.random.default_rng(7)
+    n_changed = max(1, int(n * changed_frac))
+    idx = rng.choice(n, size=n_changed, replace=False)
+    new_oids = new.oids.copy()
+    new_oids[idx] = rng.integers(0, 2**32, size=(n_changed, 5), dtype=np.uint32)
+    new.oids = new_oids
+    return old, new, n_changed
+
+
+def main():
+    n = int(os.environ.get("KART_BENCH_ROWS", 10_000_000))
+    reps = int(os.environ.get("KART_BENCH_REPS", 5))
+
+    import jax
+    import jax.numpy as jnp
+
+    from kart_tpu.ops.diff_kernel import (
+        _classify_padded,
+        classify_blocks_reference,
+    )
+
+    old, new, n_changed = _build(n)
+
+    # --- CPU baseline: numpy implementation of identical semantics.
+    # Measured on a slice and scaled (searchsorted is O(n log n); the scale
+    # error is in the baseline's favour).
+    base_n = min(n, 2_000_000)
+    b_old, b_new, _ = _build(base_n)
+    t0 = time.perf_counter()
+    classify_blocks_reference(b_old, b_new)
+    cpu_s = time.perf_counter() - t0
+    cpu_rate = base_n / cpu_s
+
+    # --- device path
+    args = (
+        jnp.asarray(old.keys),
+        jnp.asarray(old.oids),
+        jnp.asarray(new.keys),
+        jnp.asarray(new.oids),
+        old.count,
+        new.count,
+    )
+    out = _classify_padded(*args)  # warmup / compile
+    jax.block_until_ready(out)
+    counts = np.asarray(out[3])
+    assert counts[1] == n_changed, f"bad diff: {counts.tolist()} != {n_changed} updates"
+
+    t0 = time.perf_counter()
+    for _ in range(reps):
+        out = _classify_padded(*args)
+    jax.block_until_ready(out)
+    dev_s = (time.perf_counter() - t0) / reps
+    dev_rate = n / dev_s
+
+    print(
+        json.dumps(
+            {
+                "metric": "features_diffed_per_sec_10M_attr_diff",
+                "value": round(dev_rate),
+                "unit": "features/s",
+                "vs_baseline": round(dev_rate / cpu_rate, 2),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,373 @@
+"""merge / conflicts / resolve (reference: kart/merge.py, kart/conflicts.py,
+kart/resolve.py)."""
+
+import json
+import sys
+
+import click
+
+from kart_tpu.cli import CliError, cli
+from kart_tpu.core.repo import InvalidOperation, KartRepoState, NotFound
+from kart_tpu.diff.output import dump_json_output
+
+
+def _merge_json(result, repo):
+    body = {}
+    if result.already_merged:
+        body["noOp"] = True
+        body["message"] = "Already up to date"
+    elif result.fast_forward:
+        body["fastForward"] = True
+        body["commit"] = result.commit_oid
+    elif result.has_conflicts:
+        conflicts = result.merge_index.conflicts
+        body["conflicts"] = _conflict_summary(conflicts)
+        body["state"] = "merging"
+    else:
+        body["commit"] = result.commit_oid
+        body["merging"] = False
+    if result.dry_run:
+        body["dryRun"] = True
+    return {"kart.merge/v1": body}
+
+
+def _conflict_kind(aot):
+    """ancestor/ours/theirs presence -> 'edit/edit' | 'add/add' |
+    'delete/edit' | 'edit/delete' (reference: kart/merge_util.py conflict
+    labelling)."""
+    if aot.ancestor is None:
+        return "add/add"
+    if aot.ours is None:
+        return "delete/edit"
+    if aot.theirs is None:
+        return "edit/delete"
+    return "edit/edit"
+
+
+def _conflict_summary(conflicts):
+    """label dict -> nested {ds_path: {'featureConflicts': {...}} } summary
+    (reference: conflicts output shape, kart/conflicts.py)."""
+    summary = {}
+    for label, aot in conflicts.items():
+        parts = label.split(":", 2)
+        ds_path = parts[0]
+        kind = parts[1] if len(parts) > 1 else "feature"
+        ds_summary = summary.setdefault(ds_path, {})
+        key = "featureConflicts" if kind == "feature" else "metaConflicts"
+        bucket = ds_summary.setdefault(key, {})
+        how = _conflict_kind(aot)
+        bucket[how] = bucket.get(how, 0) + 1
+    return summary
+
+
+@cli.command("merge")
+@click.argument("refish", required=False)
+@click.option("--message", "-m", help="Commit message for the merge commit")
+@click.option("--dry-run", is_flag=True, help="Show what would be merged, don't do it")
+@click.option("--ff/--no-ff", default=True, help="Allow/forbid fast-forward")
+@click.option("--ff-only", is_flag=True, help="Refuse non-fast-forward merges")
+@click.option("--continue", "continue_", is_flag=True, help="Complete an in-progress merge")
+@click.option("--abort", "abort_", is_flag=True, help="Abort an in-progress merge")
+@click.option(
+    "-o", "--output-format", type=click.Choice(["text", "json"]), default="text"
+)
+@click.pass_context
+def merge(ctx, refish, message, dry_run, ff, ff_only, continue_, abort_, output_format):
+    """Incorporate changes from the named commit into the current branch."""
+    from kart_tpu.merge import (
+        abort_merging_state,
+        complete_merging_state,
+        do_merge,
+    )
+
+    repo = ctx.obj.repo
+    try:
+        if abort_:
+            repo_state = repo.state
+            if repo_state != KartRepoState.MERGING:
+                raise CliError("Repository is not in 'merging' state")
+            abort_merging_state(repo)
+            from kart_tpu.core.structure import RepoStructure
+            from kart_tpu.workingcopy import get_working_copy
+
+            wc = get_working_copy(repo)
+            if wc is not None:
+                wc.reset(RepoStructure(repo, "HEAD"), force=True)
+            click.echo("Merge aborted")
+            return
+        if continue_:
+            commit_oid = complete_merging_state(repo, message=message)
+            if output_format == "json":
+                dump_json_output({"kart.merge/v1": {"commit": commit_oid}}, "-")
+            else:
+                click.echo(f"Merge committed as {commit_oid}")
+            return
+        if not refish:
+            raise CliError("Missing argument: COMMIT")
+        result = do_merge(
+            repo, refish, message=message, dry_run=dry_run, ff=ff, ff_only=ff_only
+        )
+    except (InvalidOperation, NotFound) as e:
+        raise CliError(str(e))
+
+    if output_format == "json":
+        dump_json_output(_merge_json(result, repo), "-")
+        if result.has_conflicts and not result.dry_run:
+            sys.exit(1)
+        return
+
+    if result.already_merged:
+        click.echo("Already up to date")
+    elif result.fast_forward:
+        click.echo(f"Fast-forward to {result.commit_oid}")
+    elif result.has_conflicts:
+        n = len(result.merge_index.conflicts)
+        if result.dry_run:
+            click.echo(f"Merge would result in {n} conflicts (dry run)")
+        else:
+            click.echo(f"Merge resulted in {n} conflicts.")
+            click.echo(
+                'Repository is now in "merging" state. View conflicts with '
+                '"kart conflicts", resolve with "kart resolve", then '
+                '"kart merge --continue" (or "kart merge --abort").'
+            )
+            sys.exit(1)
+    elif result.dry_run:
+        click.echo("Merge is possible with no conflicts (dry run)")
+    else:
+        click.echo(f"Merged and committed as {result.commit_oid}")
+
+
+class _ConflictDecoder:
+    """Decodes conflict entries to output values. Resolves the candidate
+    revisions and per-(revision, dataset) objects once per command, not per
+    entry."""
+
+    def __init__(self, repo):
+        from kart_tpu.core.structure import RepoStructure
+
+        self.repo = repo
+        self.structures = []
+        merge_head = repo.read_gitdir_file("MERGE_HEAD")
+        for refish in ("HEAD", merge_head and merge_head.strip()):
+            if not refish:
+                continue
+            try:
+                self.structures.append(RepoStructure(repo, refish))
+            except Exception:
+                pass
+        self._ds_cache = {}
+
+    def _datasets_for(self, ds_path):
+        if ds_path not in self._ds_cache:
+            found = []
+            for structure in self.structures:
+                ds = structure.datasets.get(ds_path)
+                if ds is not None:
+                    found.append(ds)
+            self._ds_cache[ds_path] = found
+        return self._ds_cache[ds_path]
+
+    def versions_json(self, aot):
+        """AncestorOursTheirs of entries -> {version: feature-or-meta json}."""
+        out = {}
+        for name in ("ancestor", "ours", "theirs"):
+            entry = aot.get(name)
+            if entry is None:
+                continue
+            out[name] = self.entry_value_json(entry)
+        return out
+
+    def entry_value_json(self, entry):
+        if not self.structures:
+            return {"$blob": entry.oid}
+        ds_path, part, item = self.structures[0].decode_path(entry.path)
+        data = self.repo.odb.read_blob(entry.oid)
+        if part == "feature":
+            for ds in self._datasets_for(ds_path):
+                try:
+                    return ds.get_feature(path=item, data=data)
+                except Exception:
+                    continue
+            return {"$blob": entry.oid}
+        # meta item / attachment: the item name determines the encoding
+        # (reference: meta_items.py — *.json are json, everything else text)
+        if item.endswith(".json"):
+            try:
+                return json.loads(data)
+            except Exception:
+                return {"$blob": entry.oid}
+        try:
+            return data.decode("utf-8")
+        except UnicodeDecodeError:
+            return {"$blob": entry.oid}
+
+
+@cli.command("conflicts")
+@click.option(
+    "-o",
+    "--output-format",
+    type=click.Choice(["text", "json", "quiet"]),
+    default="text",
+)
+@click.option(
+    "-s", "--summarise", "--summarize", count=True,
+    help="Summarise rather than list each conflict (-ss for even shorter)",
+)
+@click.pass_context
+def conflicts(ctx, output_format, summarise):
+    """List or summarise the conflicts of an in-progress merge."""
+    repo = ctx.obj.repo
+    if repo.state != KartRepoState.MERGING:
+        raise CliError(
+            "Repository is not in 'merging' state - there are no conflicts"
+        )
+    from kart_tpu.merge.index import MergeIndex
+
+    merge_index = MergeIndex.read_from_repo(repo)
+    unresolved = {
+        label: aot
+        for label, aot in merge_index.conflicts.items()
+        if label not in merge_index.resolves
+    }
+
+    if output_format == "quiet":
+        sys.exit(1 if unresolved else 0)
+
+    decoder = _ConflictDecoder(repo)
+    if output_format == "json":
+        if summarise:
+            body = _conflict_summary(unresolved)
+        else:
+            body = {
+                label: decoder.versions_json(aot)
+                for label, aot in sorted(unresolved.items())
+            }
+        dump_json_output({"kart.conflicts/v1": body}, "-")
+        return
+
+    if not unresolved:
+        click.echo("No conflicts!")
+        return
+    if summarise:
+        for ds_path, summary in sorted(_conflict_summary(unresolved).items()):
+            click.echo(f"{ds_path}:")
+            for kind, buckets in summary.items():
+                for how, n in buckets.items():
+                    click.echo(f"    {kind} {how}: {n}")
+    else:
+        for label in sorted(unresolved):
+            click.echo(f"=== {label} ===")
+            versions = decoder.versions_json(unresolved[label])
+            for name in ("ancestor", "ours", "theirs"):
+                if name in versions:
+                    click.echo(f"--- {name}")
+                    value = versions[name]
+                    if isinstance(value, dict):
+                        for k, v in value.items():
+                            click.echo(f"    {k} = {v!r}")
+                    else:
+                        click.echo(f"    {value!r}")
+            click.echo()
+    click.echo(f"{len(unresolved)} unresolved conflicts")
+    sys.exit(1)
+
+
+@cli.command("resolve")
+@click.argument("label")
+@click.option(
+    "--with",
+    "with_version",
+    type=click.Choice(["ancestor", "ours", "theirs", "delete"]),
+    help="Resolve the conflict with the named version (or delete the feature)",
+)
+@click.option(
+    "--with-file",
+    "with_file",
+    type=click.Path(exists=True),
+    help="Resolve the conflict with feature(s) from a GeoJSON file",
+)
+@click.pass_context
+def resolve(ctx, label, with_version, with_file):
+    """Resolve one conflict of an in-progress merge."""
+    if not with_version and not with_file:
+        raise CliError("Must supply either --with or --with-file")
+    if with_version and with_file:
+        raise CliError("--with and --with-file are mutually exclusive")
+    repo = ctx.obj.repo
+    if repo.state != KartRepoState.MERGING:
+        raise CliError("Repository is not in 'merging' state")
+    from kart_tpu.merge.index import ConflictEntry, MergeIndex
+
+    merge_index = MergeIndex.read_from_repo(repo)
+    if label not in merge_index.conflicts:
+        # allow numeric-free fuzzy help
+        known = ", ".join(sorted(merge_index.conflicts)[:5])
+        raise CliError(f"No such conflict {label!r}. Known conflicts: {known} ...")
+    if label in merge_index.resolves:
+        raise CliError(f"Conflict {label!r} is already resolved")
+
+    aot = merge_index.conflicts[label]
+    if with_file:
+        entries = _entries_from_file(repo, label, aot, with_file)
+    elif with_version == "delete":
+        entries = []
+    else:
+        entry = aot.get(with_version)
+        entries = [entry] if entry is not None else []
+    merge_index.add_resolve(label, entries)
+    merge_index.write_to_repo(repo)
+    remaining = len(merge_index.unresolved_labels)
+    click.echo(
+        f"Resolved 1 conflict. {remaining} conflicts to go."
+        if remaining
+        else 'Resolved 1 conflict. All conflicts resolved - run "kart merge --continue"'
+    )
+
+
+def _entries_from_file(repo, label, aot, path):
+    """GeoJSON file -> resolution entries (reference: kart/resolve.py:22-66)."""
+    from kart_tpu.core.structure import RepoStructure
+    from kart_tpu.merge.index import ConflictEntry
+
+    with open(path) as f:
+        data = json.load(f)
+    if data.get("type") == "FeatureCollection":
+        geo_features = data["features"]
+    elif data.get("type") == "Feature":
+        geo_features = [data]
+    else:
+        raise CliError(f"{path}: not a GeoJSON Feature or FeatureCollection")
+
+    sample = next((e for e in aot if e is not None), None)
+    structure = RepoStructure(repo, "HEAD")
+    ds_path, part, item = structure.decode_path(sample.path)
+    if part != "feature":
+        raise CliError("--with-file can only resolve feature conflicts")
+    merge_head = repo.read_gitdir_file("MERGE_HEAD")
+    ds = None
+    for refish in ("HEAD", merge_head and merge_head.strip()):
+        if not refish:
+            continue
+        ds = RepoStructure(repo, refish).datasets.get(ds_path)
+        if ds is not None:
+            break
+    if ds is None:
+        raise CliError(f"Cannot find dataset {ds_path!r}")
+
+    from kart_tpu.geometry import geojson_to_geometry
+
+    entries = []
+    for geo_feature in geo_features:
+        feature = dict(geo_feature.get("properties") or {})
+        geom_col = ds.geom_column_name
+        if geom_col and geo_feature.get("geometry") is not None:
+            feature[geom_col] = geojson_to_geometry(geo_feature["geometry"])
+        pk_cols = [c.name for c in ds.schema.pk_columns]
+        for pk_col in pk_cols:
+            if pk_col not in feature and geo_feature.get("id") is not None:
+                feature[pk_col] = geo_feature["id"]
+        full_path, blob = ds.encode_feature(feature)
+        oid = repo.odb.write_blob(blob)
+        entries.append(ConflictEntry(full_path, oid))
+    return entries

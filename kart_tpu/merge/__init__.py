@@ -1,0 +1,441 @@
+"""3-way merge engine (reference: kart/merge.py + kart/merge_util.py).
+
+The reference delegates tree merging to libgit2 (`repo.merge_trees`,
+`kart/merge.py:99-100`) and inherits per-feature conflicts from the
+one-feature-one-blob layout. Here the same semantics are computed directly:
+feature sets go through the vectorized 3-way kernel
+(`kart_tpu/ops/merge_kernel.py`) — one jitted classification of the whole
+PK-space union per dataset — and the small residue (meta items, attachments)
+through an identical host-side rule. Clean changes are written to a merged
+tree immediately; conflicts become a MergeIndex and move the repo to the
+MERGING state, exactly like the reference's state machine
+(`kart/repo.py:53-72`).
+"""
+
+import json
+
+import numpy as np
+
+from kart_tpu.core.repo import (
+    MERGE_BRANCH,
+    MERGE_HEAD,
+    MERGE_INDEX,
+    MERGE_MSG,
+    InvalidOperation,
+    KartRepoState,
+)
+from kart_tpu.core.structure import RepoStructure
+from kart_tpu.core.tree_builder import TreeBuilder
+from kart_tpu.merge.index import AncestorOursTheirs, ConflictEntry, MergeIndex
+from kart_tpu.ops.blocks import FeatureBlock, unpack_oid_hex
+from kart_tpu.ops.merge_kernel import (
+    CONFLICT,
+    KEEP_OURS,
+    TAKE_THEIRS,
+    merge_classify,
+)
+
+
+class MergeResult:
+    """Outcome of do_merge."""
+
+    def __init__(
+        self,
+        *,
+        commit_oid=None,
+        fast_forward=False,
+        already_merged=False,
+        merge_index=None,
+        dry_run=False,
+        stats=None,
+        merging=False,
+        merged_tree=None,
+    ):
+        self.commit_oid = commit_oid
+        self.fast_forward = fast_forward
+        self.already_merged = already_merged
+        self.merge_index = merge_index
+        self.dry_run = dry_run
+        self.stats = stats or {}
+        self.merging = merging
+        self.merged_tree = merged_tree
+
+    @property
+    def has_conflicts(self):
+        return self.merge_index is not None and bool(self.merge_index.conflicts)
+
+
+def _dataset_blocks(structures, ds_path):
+    """Per-version FeatureBlock for ds_path (absent dataset -> empty block)."""
+    blocks = []
+    datasets = []
+    for structure in structures:
+        ds = structure.datasets.get(ds_path) if structure.tree is not None else None
+        datasets.append(ds)
+        if ds is None:
+            blocks.append(
+                FeatureBlock.from_arrays(
+                    np.zeros(0, dtype=np.int64), np.zeros((0, 5), np.uint32), []
+                )
+            )
+        else:
+            blocks.append(FeatureBlock.from_dataset(ds))
+    return blocks, datasets
+
+
+def _keys_to_block_rows(block, keys):
+    """union keys (K,) -> row index into block for each key, or -1 when the
+    key is absent. One batched searchsorted, no per-key Python."""
+    real = block.keys[: block.count]
+    idx = np.searchsorted(real, keys)
+    idxc = np.minimum(idx, max(block.count - 1, 0))
+    found = (
+        (real[idxc] == keys) & (idx < block.count)
+        if block.count
+        else np.zeros(len(keys), dtype=bool)
+    )
+    return np.where(found, idxc, -1)
+
+
+def _key_to_block_entry(block, key):
+    """union key -> (rel_path, oid_hex) from a FeatureBlock, or None."""
+    row = int(_keys_to_block_rows(block, np.asarray([key], dtype=np.int64))[0])
+    if row < 0:
+        return None
+    return block.paths[row], unpack_oid_hex(block.oids[row : row + 1])[0]
+
+
+def _feature_label(ds_path, datasets, rel_paths):
+    """Conflict label `<ds>:feature:<pk>` (reference RichConflict labels,
+    kart/merge_util.py:508-540)."""
+    for ds, rel in zip(datasets, rel_paths):
+        if ds is not None and rel is not None:
+            try:
+                pks = ds.decode_path_to_pks(rel)
+                pk_part = ",".join(str(pk) for pk in pks)
+                return f"{ds_path}:feature:{pk_part}"
+            except Exception:
+                continue
+    rel = next((r for r in rel_paths if r), "?")
+    return f"{ds_path}:feature:{rel}"
+
+
+def _merge_dataset_features(ds_path, structures, tree_builder):
+    """Vectorized per-feature 3-way for one dataset. Mutates tree_builder with
+    clean theirs-changes; -> (conflicts dict, stats)."""
+    blocks, datasets = _dataset_blocks(structures, ds_path)
+    a_block, o_block, t_block = blocks
+
+    if any(b.has_key_collisions() for b in blocks):
+        # hash-keyed identity collided (~1e-4 probability at 1e8 features):
+        # host path with identical semantics
+        return _merge_dataset_features_host(ds_path, blocks, datasets, tree_builder)
+
+    union, decision, presence, stats = merge_classify(a_block, o_block, t_block)
+    conflicts = {}
+
+    take_idx = np.nonzero(decision == TAKE_THEIRS)[0]
+    conflict_idx = np.nonzero(decision == CONFLICT)[0]
+
+    inner = None
+    for ds in datasets:
+        if ds is not None:
+            inner = ds.inner_path
+            break
+    if inner is None:
+        return {}, stats
+
+    # apply clean theirs-changes in batch: one searchsorted per side, then a
+    # straight zip over the changed rows only
+    take_keys = union[take_idx]
+    t_rows = _keys_to_block_rows(t_block, take_keys)
+    o_rows = _keys_to_block_rows(o_block, take_keys)
+    present = t_rows >= 0
+    if np.any(present):
+        rows = t_rows[present]
+        oid_hexes = unpack_oid_hex(t_block.oids[rows])
+        for row, oid in zip(rows, oid_hexes):
+            tree_builder.insert(f"{inner}/feature/{t_block.paths[row]}", oid)
+    for row in o_rows[~present]:
+        if row >= 0:
+            tree_builder.remove(f"{inner}/feature/{o_block.paths[row]}")
+
+    for i in conflict_idx:
+        key = union[i]
+        entries = []
+        rels = []
+        for block in blocks:
+            found = _key_to_block_entry(block, key)
+            rels.append(found[0] if found else None)
+            entries.append(
+                ConflictEntry(f"{inner}/feature/{found[0]}", found[1])
+                if found
+                else None
+            )
+        label = _feature_label(ds_path, datasets, rels)
+        conflicts[label] = AncestorOursTheirs(*entries)
+    return conflicts, stats
+
+
+def _merge_dataset_features_host(ds_path, blocks, datasets, tree_builder):
+    """Fallback with dict semantics when hash keys collide."""
+    def index(block):
+        hexes = unpack_oid_hex(block.oids[: block.count])
+        return dict(zip(block.paths, hexes))
+
+    a, o, t = (index(b) for b in blocks)
+    inner = next((ds.inner_path for ds in datasets if ds is not None), None)
+    conflicts = {}
+    stats = {"conflicts": 0, "take_theirs": 0}
+    for rel in sorted(set(a) | set(o) | set(t)):
+        av, ov, tv = a.get(rel), o.get(rel), t.get(rel)
+        if ov == tv or tv == av:
+            continue
+        if ov == av:
+            stats["take_theirs"] += 1
+            if tv is not None:
+                tree_builder.insert(f"{inner}/feature/{rel}", tv)
+            else:
+                tree_builder.remove(f"{inner}/feature/{rel}")
+        else:
+            stats["conflicts"] += 1
+            label = _feature_label(ds_path, datasets, [rel] * 3)
+            conflicts[label] = AncestorOursTheirs(
+                *(
+                    ConflictEntry(f"{inner}/feature/{rel}", v) if v is not None else None
+                    for v in (av, ov, tv)
+                )
+            )
+    return conflicts, stats
+
+
+def _non_feature_items(structure):
+    """{repo_path: oid} for every blob that is not a feature blob (meta items,
+    version blob, attachments). Walks only the dataset inner trees' non-feature
+    subtrees plus everything outside dataset trees — never descends into
+    feature/ (which holds the ~all of the repo's blobs)."""
+    out = {}
+    tree = structure.tree
+    if tree is None:
+        return out
+
+    dataset_dirnames = {".table-dataset", ".sno-dataset"}
+
+    def walk(node, prefix):
+        for entry in node.entries():
+            path = f"{prefix}{entry.name}"
+            if not entry.is_tree:
+                out[path] = entry.oid
+                continue
+            if entry.name in dataset_dirnames:
+                inner = structure.repo.odb.tree(entry.oid)
+                for inner_entry in inner.entries():
+                    if inner_entry.name == "feature":
+                        continue
+                    if inner_entry.is_tree:
+                        walk(
+                            structure.repo.odb.tree(inner_entry.oid),
+                            f"{path}/{inner_entry.name}/",
+                        )
+                    else:
+                        out[f"{path}/{inner_entry.name}"] = inner_entry.oid
+            else:
+                walk(structure.repo.odb.tree(entry.oid), f"{path}/")
+
+    walk(tree, "")
+    return out
+
+
+def _label_for_non_feature(structure_list, path):
+    for structure in structure_list:
+        if structure.tree is None:
+            continue
+        ds_path, part, item = structure.decode_path(path)
+        if part == "meta":
+            return f"{ds_path}:meta:{item}"
+        break
+    return f"<root>:attachment:{path}"
+
+
+def _merge_non_features(structures, tree_builder):
+    a_items, o_items, t_items = (_non_feature_items(s) for s in structures)
+    conflicts = {}
+    for path in sorted(set(a_items) | set(o_items) | set(t_items)):
+        av, ov, tv = a_items.get(path), o_items.get(path), t_items.get(path)
+        if ov == tv or tv == av:
+            continue
+        if ov == av:
+            if tv is not None:
+                tree_builder.insert(path, tv)
+            else:
+                tree_builder.remove(path)
+        else:
+            label = _label_for_non_feature(structures, path)
+            conflicts[label] = AncestorOursTheirs(
+                *(
+                    ConflictEntry(path, v) if v is not None else None
+                    for v in (av, ov, tv)
+                )
+            )
+    return conflicts
+
+
+def merge_trees_vectorized(repo, ancestor_struct, ours_struct, theirs_struct):
+    """-> (merged_tree_oid, conflicts dict, stats). The merged tree contains
+    every clean change; conflicted paths keep their `ours` content until
+    resolved."""
+    structures = (ancestor_struct, ours_struct, theirs_struct)
+    tb = TreeBuilder(repo.odb, ours_struct.tree_oid)
+    all_conflicts = {}
+    total_stats = {"take_theirs": 0, "conflicts": 0}
+
+    ds_paths = set()
+    for structure in structures:
+        if structure.tree is not None:
+            ds_paths.update(structure.datasets.paths())
+    for ds_path in sorted(ds_paths):
+        conflicts, stats = _merge_dataset_features(ds_path, structures, tb)
+        all_conflicts.update(conflicts)
+        for k in total_stats:
+            total_stats[k] += stats.get(k, 0)
+
+    all_conflicts.update(_merge_non_features(structures, tb))
+    merged_tree = tb.flush() if tb else ours_struct.tree_oid
+    return merged_tree, all_conflicts, total_stats
+
+
+def do_merge(repo, theirs_refish, *, message=None, dry_run=False, ff=True, ff_only=False):
+    """Merge `theirs_refish` into HEAD (reference: kart/merge.py:45-158)."""
+    if repo.state != KartRepoState.NORMAL:
+        raise InvalidOperation(
+            KartRepoState.bad_state_message(repo.state, (KartRepoState.NORMAL,))
+        )
+    ours_oid = repo.head_commit_oid
+    if ours_oid is None:
+        raise InvalidOperation("Repository has no commits yet")
+    theirs_oid, theirs_ref = _resolve_commit_and_ref(repo, theirs_refish)
+    if theirs_oid is None:
+        raise InvalidOperation(f"Cannot resolve {theirs_refish!r}")
+
+    ancestor_oid = repo.merge_base(ours_oid, theirs_oid)
+    if ancestor_oid is None:
+        raise InvalidOperation("Commits have no common ancestor")
+
+    if ancestor_oid == theirs_oid:
+        return MergeResult(already_merged=True, commit_oid=ours_oid, dry_run=dry_run)
+    if ancestor_oid == ours_oid and ff:
+        # fast-forward
+        if not dry_run:
+            _update_head_to(repo, theirs_oid)
+        return MergeResult(commit_oid=theirs_oid, fast_forward=True, dry_run=dry_run)
+    if ff_only:
+        raise InvalidOperation(
+            "Can't resolve as a fast-forward merge and --ff-only specified"
+        )
+
+    ancestor_struct = RepoStructure(repo, ancestor_oid)
+    ours_struct = RepoStructure(repo, ours_oid)
+    theirs_struct = RepoStructure(repo, theirs_oid)
+
+    merged_tree, conflicts, stats = merge_trees_vectorized(
+        repo, ancestor_struct, ours_struct, theirs_struct
+    )
+
+    branch_name = _branch_shorthand(repo, theirs_refish, theirs_ref)
+    if message is None:
+        message = f'Merge branch "{branch_name}"' if branch_name else (
+            f"Merge {theirs_oid[:8]}"
+        )
+
+    if conflicts:
+        merge_index = MergeIndex(merged_tree, conflicts)
+        if not dry_run:
+            merge_index.write_to_repo(repo)
+            repo.write_gitdir_file(MERGE_HEAD, theirs_oid)
+            repo.write_gitdir_file(MERGE_MSG, message)
+            if branch_name:
+                repo.write_gitdir_file(MERGE_BRANCH, branch_name)
+        return MergeResult(
+            merge_index=merge_index,
+            dry_run=dry_run,
+            stats=stats,
+            merging=not dry_run,
+            merged_tree=merged_tree,
+        )
+
+    if dry_run:
+        return MergeResult(dry_run=True, stats=stats, merged_tree=merged_tree)
+
+    commit_oid = _create_merge_commit(repo, merged_tree, message, [ours_oid, theirs_oid])
+    _reset_wc(repo)
+    return MergeResult(commit_oid=commit_oid, stats=stats, merged_tree=merged_tree)
+
+
+def complete_merging_state(repo, *, message=None):
+    """`kart merge --continue` (reference: kart/merge.py:183-236)."""
+    if repo.state != KartRepoState.MERGING:
+        raise InvalidOperation("No merge is ongoing")
+    merge_index = MergeIndex.read_from_repo(repo)
+    unresolved = merge_index.unresolved_labels
+    if unresolved:
+        raise InvalidOperation(
+            f"Merge is not yet complete - {len(unresolved)} conflicts "
+            'still need resolving. See "kart conflicts" / "kart resolve"'
+        )
+    theirs_oid = repo.read_gitdir_file(MERGE_HEAD).strip()
+    message = message or repo.read_gitdir_file(MERGE_MSG) or "Merge"
+    final_tree = merge_index.write_resolved_tree(repo.odb)
+    commit_oid = _create_merge_commit(
+        repo, final_tree, message, [repo.head_commit_oid, theirs_oid]
+    )
+    abort_merging_state(repo)
+    _reset_wc(repo)
+    return commit_oid
+
+
+def abort_merging_state(repo):
+    """Delete MERGE_* state files (reference: kart/merge.py:161-180).
+    Robust: removes whatever subset exists."""
+    for name in (MERGE_HEAD, MERGE_INDEX, MERGE_BRANCH, MERGE_MSG):
+        repo.remove_gitdir_file(name)
+
+
+def _resolve_commit_and_ref(repo, refish):
+    oid, ref = repo.resolve_refish(refish)
+    if oid is not None:
+        oid = repo._peel_to_commit_oid(oid)
+    return oid, ref
+
+
+def _branch_shorthand(repo, refish, ref):
+    if ref and ref.startswith("refs/heads/"):
+        return ref[len("refs/heads/") :]
+    if ref and ref.startswith("refs/remotes/"):
+        return ref[len("refs/remotes/") :]
+    if isinstance(refish, str) and not all(
+        c in "0123456789abcdef" for c in refish.lower()
+    ):
+        return refish
+    return None
+
+
+def _update_head_to(repo, commit_oid):
+    branch = repo.head_branch
+    if branch:
+        repo.refs.set(branch, commit_oid, log_message="merge: fast-forward")
+    else:
+        repo.refs.set_head(commit_oid, log_message="merge: fast-forward")
+    _reset_wc(repo)
+
+
+def _create_merge_commit(repo, tree_oid, message, parents):
+    ref = repo.head_branch or "HEAD"
+    return repo.create_commit(ref, tree_oid, message, parents)
+
+
+def _reset_wc(repo):
+    from kart_tpu.workingcopy import get_working_copy
+
+    wc = get_working_copy(repo)
+    if wc is not None:
+        wc.reset(RepoStructure(repo, "HEAD"), force=True)

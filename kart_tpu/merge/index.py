@@ -1,0 +1,163 @@
+"""Persistent merge state — MergeIndex (reference: kart/merge_util.py:68-346).
+
+The reference serialises an entire libgit2 index (entries + `.conflicts/…` +
+`.resolves/…` paths) to the MERGE_INDEX file. Here the clean merge result is
+already a written tree (the kernel emitted it before conflicts were known),
+so the index only needs the *conflicts* — each one a named
+ancestor/ours/theirs triple of (path, oid) entries — and the user's resolves.
+Stored as JSON in `<gitdir>/MERGE_INDEX`.
+"""
+
+import json
+
+from kart_tpu.core.repo import MERGE_INDEX
+
+VERSION_NAMES = ("ancestor", "ours", "theirs")
+
+
+class AncestorOursTheirs:
+    """Named triple (reference: kart/merge_util.py:28-65)."""
+
+    __slots__ = ("ancestor", "ours", "theirs")
+
+    def __init__(self, ancestor=None, ours=None, theirs=None):
+        self.ancestor = ancestor
+        self.ours = ours
+        self.theirs = theirs
+
+    @classmethod
+    def partial(cls, **kwargs):
+        return cls(**kwargs)
+
+    def get(self, name):
+        if name not in VERSION_NAMES:
+            raise KeyError(name)
+        return getattr(self, name)
+
+    def map(self, fn):
+        return AncestorOursTheirs(
+            *(fn(v) if v is not None else None for v in self)
+        )
+
+    def __iter__(self):
+        yield self.ancestor
+        yield self.ours
+        yield self.theirs
+
+    def as_dict(self):
+        return {n: self.get(n) for n in VERSION_NAMES}
+
+    def __repr__(self):
+        return f"AOT(a={self.ancestor!r}, o={self.ours!r}, t={self.theirs!r})"
+
+
+class ConflictEntry:
+    """One version of one conflicted item: a (path, oid) pair."""
+
+    __slots__ = ("path", "oid")
+
+    def __init__(self, path, oid):
+        self.path = path
+        self.oid = oid
+
+    def to_json(self):
+        return {"path": self.path, "oid": self.oid}
+
+    @classmethod
+    def from_json(cls, d):
+        return cls(d["path"], d["oid"]) if d else None
+
+
+class MergeIndex:
+    """Conflicts + resolves for an in-progress merge.
+
+    ``conflicts``: label -> AncestorOursTheirs of ConflictEntry|None.
+    ``resolves``: label -> list[ConflictEntry] (empty list = resolved as
+    delete).
+    ``merged_tree``: oid of the tree with all *clean* changes applied.
+    """
+
+    def __init__(self, merged_tree, conflicts=None, resolves=None):
+        self.merged_tree = merged_tree
+        self.conflicts = conflicts or {}
+        self.resolves = resolves or {}
+
+    # -- persistence ---------------------------------------------------------
+
+    def to_json(self):
+        return {
+            "kart.merge_index/v1": {
+                "mergedTree": self.merged_tree,
+                "conflicts": {
+                    label: {
+                        name: (entry.to_json() if entry else None)
+                        for name, entry in aot.as_dict().items()
+                    }
+                    for label, aot in self.conflicts.items()
+                },
+                "resolves": {
+                    label: [e.to_json() for e in entries]
+                    for label, entries in self.resolves.items()
+                },
+            }
+        }
+
+    @classmethod
+    def from_json(cls, data):
+        body = data["kart.merge_index/v1"]
+        conflicts = {
+            label: AncestorOursTheirs(
+                **{
+                    name: ConflictEntry.from_json(entry)
+                    for name, entry in versions.items()
+                }
+            )
+            for label, versions in body["conflicts"].items()
+        }
+        resolves = {
+            label: [ConflictEntry.from_json(e) for e in entries]
+            for label, entries in body["resolves"].items()
+        }
+        return cls(body["mergedTree"], conflicts, resolves)
+
+    def write_to_repo(self, repo):
+        repo.write_gitdir_file(MERGE_INDEX, json.dumps(self.to_json()))
+
+    @classmethod
+    def read_from_repo(cls, repo):
+        text = repo.read_gitdir_file(MERGE_INDEX)
+        if text is None:
+            from kart_tpu.core.repo import InvalidOperation
+
+            raise InvalidOperation(
+                "Repository is in 'merging' state but MERGE_INDEX is missing - "
+                'run "kart merge --abort" to recover'
+            )
+        return cls.from_json(json.loads(text))
+
+    # -- resolution ----------------------------------------------------------
+
+    @property
+    def unresolved_labels(self):
+        return [l for l in self.conflicts if l not in self.resolves]
+
+    def add_resolve(self, label, entries):
+        if label not in self.conflicts:
+            raise KeyError(label)
+        self.resolves[label] = entries
+
+    def write_resolved_tree(self, odb):
+        """All conflicts resolved -> final tree oid
+        (reference: kart/merge_util.py:294-315)."""
+        assert not self.unresolved_labels
+        from kart_tpu.core.tree_builder import TreeBuilder
+
+        tb = TreeBuilder(odb, self.merged_tree)
+        for label, aot in self.conflicts.items():
+            # clear every version's path, then write the resolution
+            for entry in aot:
+                if entry is not None:
+                    tb.remove(entry.path)
+            for entry in self.resolves.get(label, ()):
+                tb.insert(entry.path, entry.oid)
+        return tb.flush()

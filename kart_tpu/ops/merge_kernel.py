@@ -1,0 +1,137 @@
+"""Vectorized 3-way merge classification (reference: the libgit2 tree merge
+behind `kart/merge.py:99-100` + per-feature conflict semantics of
+`kart/merge_util.py`).
+
+Kart gets per-feature merge "for free" because one feature == one blob at a
+PK-determined path, and libgit2 merges trees path-by-path. Here the same
+semantics run as one jitted kernel over the *union* key array of the
+(ancestor, ours, theirs) FeatureBlocks: three searchsorted joins produce
+per-key (present, oid) triples, then the classic 3-way rule classifies every
+key at once — no per-feature Python, no data-dependent control flow.
+
+Per-key decision for versions a/o/t (absent = not present):
+    o == t           -> KEEP_OURS   (same change both sides, incl. both absent)
+    o == a           -> TAKE_THEIRS (only theirs changed)
+    t == a           -> KEEP_OURS   (only ours changed)
+    otherwise        -> CONFLICT
+
+Codes: 0 = KEEP_OURS, 1 = TAKE_THEIRS, 2 = CONFLICT.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from kart_tpu.ops.blocks import PAD_KEY, bucket_size
+
+KEEP_OURS = 0
+TAKE_THEIRS = 1
+CONFLICT = 2
+
+
+def _join(version_keys, version_oids, version_count, union_keys):
+    """For each union key: (present (bool), oid (5,) uint32 or 0)."""
+    n = version_keys.shape[0]
+    idx = jnp.searchsorted(version_keys, union_keys)
+    idxc = jnp.minimum(idx, n - 1)
+    present = (version_keys[idxc] == union_keys) & (idx < n) & (idxc < version_count)
+    oids = jnp.where(present[:, None], version_oids[idxc], 0)
+    return present, oids
+
+
+@jax.jit
+def _merge_classify_padded(
+    a_keys, a_oids, a_count,
+    o_keys, o_oids, o_count,
+    t_keys, t_oids, t_count,
+    union_keys, union_count,
+):
+    union_valid = jnp.arange(union_keys.shape[0]) < union_count
+    a_pres, a_oid = _join(a_keys, a_oids, a_count, union_keys)
+    o_pres, o_oid = _join(o_keys, o_oids, o_count, union_keys)
+    t_pres, t_oid = _join(t_keys, t_oids, t_count, union_keys)
+
+    def same(p1, oid1, p2, oid2):
+        both_absent = ~p1 & ~p2
+        both_same = p1 & p2 & jnp.all(oid1 == oid2, axis=1)
+        return both_absent | both_same
+
+    o_eq_t = same(o_pres, o_oid, t_pres, t_oid)
+    o_eq_a = same(o_pres, o_oid, a_pres, a_oid)
+    t_eq_a = same(t_pres, t_oid, a_pres, a_oid)
+
+    decision = jnp.where(
+        o_eq_t,
+        KEEP_OURS,
+        jnp.where(
+            o_eq_a,
+            TAKE_THEIRS,
+            jnp.where(t_eq_a, KEEP_OURS, CONFLICT),
+        ),
+    )
+    decision = jnp.where(union_valid, decision, KEEP_OURS).astype(jnp.int8)
+    n_conflicts = jnp.sum(decision == CONFLICT)
+    n_take_theirs = jnp.sum(decision == TAKE_THEIRS)
+    presence = (
+        a_pres.astype(jnp.int8)
+        + 2 * o_pres.astype(jnp.int8)
+        + 4 * t_pres.astype(jnp.int8)
+    )
+    return decision, presence, n_conflicts, n_take_theirs
+
+
+def merge_classify(ancestor_block, ours_block, theirs_block):
+    """FeatureBlock x3 -> (union_keys (U,) int64 np, decision (U,) int8 np,
+    presence (U,) int8 np with bits a=1/o=2/t=4, stats dict).
+
+    Union keys are computed host-side (cheap, sorted inputs) and padded to a
+    bucket so jit shapes are reused.
+    """
+    a_real = ancestor_block.keys[: ancestor_block.count]
+    o_real = ours_block.keys[: ours_block.count]
+    t_real = theirs_block.keys[: theirs_block.count]
+    union = np.union1d(np.union1d(a_real, o_real), t_real).astype(np.int64)
+    u = len(union)
+    size = bucket_size(max(u, 1))
+    union_padded = np.full(size, PAD_KEY, dtype=np.int64)
+    union_padded[:u] = union
+
+    decision, presence, n_conf, n_theirs = _merge_classify_padded(
+        jnp.asarray(ancestor_block.keys), jnp.asarray(ancestor_block.oids),
+        ancestor_block.count,
+        jnp.asarray(ours_block.keys), jnp.asarray(ours_block.oids),
+        ours_block.count,
+        jnp.asarray(theirs_block.keys), jnp.asarray(theirs_block.oids),
+        theirs_block.count,
+        jnp.asarray(union_padded), u,
+    )
+    return (
+        union,
+        np.asarray(decision)[:u],
+        np.asarray(presence)[:u],
+        {"conflicts": int(n_conf), "take_theirs": int(n_theirs)},
+    )
+
+
+def merge_classify_reference(ancestor_block, ours_block, theirs_block):
+    """Pure-numpy implementation of identical semantics (bit-compat tests)."""
+    def index(block):
+        return {
+            int(k): bytes(block.oids[i].tobytes())
+            for i, k in enumerate(block.keys[: block.count])
+        }
+
+    a, o, t = index(ancestor_block), index(ours_block), index(theirs_block)
+    union = sorted(set(a) | set(o) | set(t))
+    decisions = []
+    for k in union:
+        av, ov, tv = a.get(k), o.get(k), t.get(k)
+        if ov == tv:
+            decisions.append(KEEP_OURS)
+        elif ov == av:
+            decisions.append(TAKE_THEIRS)
+        elif tv == av:
+            decisions.append(KEEP_OURS)
+        else:
+            decisions.append(CONFLICT)
+    return np.asarray(union, dtype=np.int64), np.asarray(decisions, dtype=np.int8)

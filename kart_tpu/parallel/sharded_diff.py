@@ -1,0 +1,182 @@
+"""Mesh-sharded diff classification (SURVEY.md §7 step 7).
+
+Blocks are partitioned host-side by ``key % n_shards`` — block-cyclic over
+PK-space, the device analog of kart's PathEncoder modulus sharding
+(`kart/dataset3_paths.py:283-299`). Because the partition function depends
+only on the key, a feature lands on the same shard in every revision, so the
+old↔new merge-join of the diff engine (`kart_tpu/ops/diff_kernel.py`) is
+fully shard-local: zero feature data crosses the interconnect. Only the
+3-scalar insert/update/delete count vector is reduced with ``psum`` over ICI.
+
+The sharded step is expressed with ``shard_map`` over a 1-D ``Mesh`` so the
+same program runs on a real slice or on a virtual CPU mesh (the driver's
+``dryrun_multichip``), and on one device it degenerates to the single-chip
+kernel.
+"""
+
+import functools
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from kart_tpu.ops import blocks as blocks_mod
+from kart_tpu.ops.blocks import PAD_KEY, FeatureBlock, bucket_size
+from kart_tpu.ops.diff_kernel import DELETE, INSERT, UNCHANGED, UPDATE
+from kart_tpu.parallel.mesh import FEATURES_AXIS
+
+try:  # jax >= 0.6 exposes shard_map at top level
+    from jax import shard_map  # type: ignore[attr-defined]
+except ImportError:  # pragma: no cover - version-dependent
+    from jax.experimental.shard_map import shard_map
+
+
+def partition_block(block, n_shards, min_bucket=256):
+    """FeatureBlock -> (keys (S, B) int64, oids (S, B, 5) uint32,
+    counts (S,) int32): PK-modulus partition, each shard sorted + padded to a
+    common power-of-two bucket B.
+
+    Shard order inside a bucket remains key-sorted, so per-shard joins have
+    identical semantics to the single-chip path.
+    """
+    real_keys = block.keys[: block.count]
+    real_oids = block.oids[: block.count]
+    shard_of = (real_keys % n_shards).astype(np.int64)
+    counts = np.bincount(shard_of, minlength=n_shards).astype(np.int32)
+    bucket = bucket_size(max(int(counts.max()) if len(counts) else 1, 1), min_bucket)
+
+    keys = np.full((n_shards, bucket), PAD_KEY, dtype=np.int64)
+    oids = np.zeros((n_shards, bucket, 5), dtype=np.uint32)
+    # real_keys is globally sorted; a stable partition keeps each shard sorted
+    order = np.argsort(shard_of, kind="stable")
+    offsets = np.zeros(n_shards + 1, dtype=np.int64)
+    np.cumsum(counts, out=offsets[1:])
+    sorted_keys = real_keys[order]
+    sorted_oids = real_oids[order]
+    for s in range(n_shards):
+        lo, hi = offsets[s], offsets[s + 1]
+        keys[s, : hi - lo] = sorted_keys[lo:hi]
+        oids[s, : hi - lo] = sorted_oids[lo:hi]
+    return keys, oids, counts
+
+
+def _local_classify(old_keys, old_oids, new_keys, new_oids, old_count, new_count):
+    """Per-shard classify: the same sort-based merge-join as the single-chip
+    flagship kernel, applied to the (B,) shard-local slice (shapes inside
+    shard_map)."""
+    from kart_tpu.ops.diff_kernel import _classify_mergesort_core
+
+    old_class, new_class, _, counts = _classify_mergesort_core(
+        old_keys, old_oids, new_keys, new_oids, old_count, new_count
+    )
+    return old_class, new_class, counts
+
+
+def _sharded_step(old_keys, old_oids, new_keys, new_oids, old_counts, new_counts):
+    """shard_map body: input shapes are the (1, B[, 5]) per-device slices of
+    the stacked (S, B[, 5]) arrays. Counts cross the mesh via psum."""
+    old_class, new_class, counts = _local_classify(
+        old_keys[0],
+        old_oids[0],
+        new_keys[0],
+        new_oids[0],
+        old_counts[0],
+        new_counts[0],
+    )
+    total = jax.lax.psum(counts, FEATURES_AXIS)
+    return old_class[None], new_class[None], total
+
+
+@functools.lru_cache(maxsize=8)
+def make_sharded_classify(mesh):
+    """Build the jitted mesh-sharded classify for ``mesh``. Arguments are the
+    stacked outputs of :func:`partition_block` (leading dim == mesh size).
+    Cached per mesh so repeat calls reuse the compiled executable (Mesh is
+    hashable)."""
+    spec = P(FEATURES_AXIS)
+    repl = P()
+    fn = shard_map(
+        _sharded_step,
+        mesh=mesh,
+        in_specs=(spec, spec, spec, spec, spec, spec),
+        out_specs=(spec, spec, repl),
+    )
+    return jax.jit(fn)
+
+
+def sharded_classify(mesh, old_block, new_block):
+    """FeatureBlock x2 -> per-shard classes + global counts over ``mesh``.
+
+    Returns (old_class (S, B) int8, new_class (S, B) int8,
+    counts {inserts, updates, deletes},
+    layout = (old_part, new_part) for mapping shard rows back to features).
+    """
+    n_shards = mesh.devices.size
+    old_part = partition_block(old_block, n_shards)
+    new_part = partition_block(new_block, n_shards)
+    # shards of a pair must share a bucket size: re-pad the smaller
+    bucket = max(old_part[0].shape[1], new_part[0].shape[1])
+    old_part = _repad(old_part, bucket)
+    new_part = _repad(new_part, bucket)
+
+    fn = make_sharded_classify(mesh)
+    sharding = NamedSharding(mesh, P(FEATURES_AXIS))
+    args = []
+    for arr in (old_part[0], old_part[1], new_part[0], new_part[1]):
+        args.append(jax.device_put(arr, sharding))
+    for arr in (old_part[2], new_part[2]):
+        args.append(jax.device_put(arr, sharding))
+    # arg order: (old_keys, old_oids, new_keys, new_oids, old_counts, new_counts)
+    old_class, new_class, counts = fn(*args)
+    counts = np.asarray(counts)
+    return (
+        np.asarray(old_class),
+        np.asarray(new_class),
+        {
+            "inserts": int(counts[0]),
+            "updates": int(counts[1]),
+            "deletes": int(counts[2]),
+        },
+        (old_part, new_part),
+    )
+
+
+def _repad(part, bucket):
+    keys, oids, counts = part
+    cur = keys.shape[1]
+    if cur >= bucket:
+        return part
+    s = keys.shape[0]
+    keys2 = np.full((s, bucket), PAD_KEY, dtype=np.int64)
+    keys2[:, :cur] = keys
+    oids2 = np.zeros((s, bucket, 5), dtype=np.uint32)
+    oids2[:, :cur] = oids
+    return keys2, oids2, counts
+
+
+def sharded_diff_step(mesh, old_block, new_block):
+    """The "full step" the driver dry-runs: partition, classify on the mesh,
+    reduce counts. Returns the counts dict."""
+    _, _, counts, _ = sharded_classify(mesh, old_block, new_block)
+    return counts
+
+
+def synthetic_block(n, seed=0, change_none=False):
+    """Synthetic FeatureBlock for benchmarks/dryruns: keys 0..n-1 with random
+    oids (deterministic per seed)."""
+    rng = np.random.default_rng(seed)
+    keys = np.arange(n, dtype=np.int64)
+    oids = rng.integers(0, 2**32, size=(n, 5), dtype=np.uint32)
+    paths = None  # benchmarks never materialise values
+    block = FeatureBlock.__new__(FeatureBlock)
+    size = bucket_size(max(n, 1))
+    if size > n:
+        keys = np.concatenate([keys, np.full(size - n, PAD_KEY, dtype=np.int64)])
+        oids = np.concatenate([oids, np.zeros((size - n, 5), dtype=np.uint32)])
+    block.keys = keys
+    block.oids = oids
+    block.paths = paths
+    block.count = n
+    return block

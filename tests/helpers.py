@@ -107,3 +107,27 @@ def make_imported_repo(tmp_path, *, n=10):
     sources = ImportSource.open(gpkg)
     import_sources(repo, sources)
     return repo, "points"
+
+
+def edit_commit(repo, ds_path, *, inserts=(), updates=(), deletes=(), message="edit features", ref="HEAD"):
+    """Build a feature diff and commit it; -> commit oid."""
+    from kart_tpu.diff.structs import Delta, DeltaDiff, DatasetDiff, KeyValue, RepoDiff
+
+    structure = repo.structure(ref)
+    ds = structure.datasets[ds_path]
+    feature_diff = DeltaDiff()
+    for f in inserts:
+        feature_diff.add_delta(Delta.insert(KeyValue((f["fid"], f))))
+    for f in updates:
+        old = ds.get_feature([f["fid"]])
+        feature_diff.add_delta(
+            Delta.update(KeyValue((f["fid"], old)), KeyValue((f["fid"], f)))
+        )
+    for pk in deletes:
+        old = ds.get_feature([pk])
+        feature_diff.add_delta(Delta.delete(KeyValue((pk, old))))
+    ds_diff = DatasetDiff()
+    ds_diff["feature"] = feature_diff
+    repo_diff = RepoDiff()
+    repo_diff[ds_path] = ds_diff
+    return structure.commit_diff(repo_diff, message)

@@ -8,7 +8,7 @@ from kart_tpu.diff.key_filters import RepoKeyFilter
 from kart_tpu.diff.structs import Delta, DeltaDiff, DatasetDiff, KeyValue, RepoDiff
 from kart_tpu.geometry import Geometry
 
-from helpers import make_imported_repo, create_attributes_gpkg
+from helpers import make_imported_repo, create_attributes_gpkg, edit_commit
 
 
 @pytest.fixture
@@ -54,26 +54,6 @@ def test_import_attributes_table(tmp_path):
     assert [c.data_type for c in ds.schema] == ["integer", "text", "integer", "boolean"]
     f = ds.get_feature([2])
     assert f == {"id": 2, "code": "C002", "amount": 200, "flag": False}
-
-
-def edit_commit(repo, ds_path, *, inserts=(), updates=(), deletes=()):
-    """Build a feature diff and commit it; -> commit oid."""
-    structure = repo.structure("HEAD")
-    ds = structure.datasets[ds_path]
-    feature_diff = DeltaDiff()
-    for f in inserts:
-        feature_diff.add_delta(Delta.insert(KeyValue((f["fid"], f))))
-    for f in updates:
-        old = ds.get_feature([f["fid"]])
-        feature_diff.add_delta(Delta.update(KeyValue((f["fid"], old)), KeyValue((f["fid"], f))))
-    for pk in deletes:
-        old = ds.get_feature([pk])
-        feature_diff.add_delta(Delta.delete(KeyValue((pk, old))))
-    ds_diff = DatasetDiff()
-    ds_diff["feature"] = feature_diff
-    repo_diff = RepoDiff()
-    repo_diff[ds_path] = ds_diff
-    return structure.commit_diff(repo_diff, "edit features")
 
 
 def test_edit_and_diff(points_repo):

@@ -1,0 +1,260 @@
+"""3-way merge: kernel bit-compat, fast-forward, clean merge, conflicts,
+resolve, --continue/--abort, state machine (reference: tests/test_merge.py,
+tests/test_conflicts.py, tests/test_resolve.py)."""
+
+import json
+
+import numpy as np
+import pytest
+
+from helpers import edit_commit, make_imported_repo
+from kart_tpu.core.repo import InvalidOperation, KartRepoState
+from kart_tpu.geometry import Geometry
+from kart_tpu.merge import (
+    abort_merging_state,
+    complete_merging_state,
+    do_merge,
+)
+from kart_tpu.merge.index import ConflictEntry, MergeIndex
+from kart_tpu.ops.blocks import FeatureBlock
+from kart_tpu.ops.merge_kernel import (
+    CONFLICT,
+    KEEP_OURS,
+    TAKE_THEIRS,
+    merge_classify,
+    merge_classify_reference,
+)
+
+
+def _block(items):
+    """{key: oid_byte} -> FeatureBlock with synthetic 20-byte oids."""
+    keys = np.asarray(sorted(items), dtype=np.int64)
+    oids = np.zeros((len(keys), 5), dtype=np.uint32)
+    for i, k in enumerate(keys):
+        oids[i, :] = items[k]
+    paths = [f"p{k}" for k in keys]
+    return FeatureBlock.from_arrays(keys, oids, paths)
+
+
+class TestMergeKernel:
+    def test_classic_rules(self):
+        #       key: 1 unchanged, 2 theirs-edit, 3 ours-edit, 4 both-same-edit,
+        #            5 conflict-edit, 6 theirs-delete, 7 ours-insert,
+        #            8 theirs-insert, 9 both-insert-same, 10 both-insert-diff
+        a = _block({1: 1, 2: 2, 3: 3, 4: 4, 5: 5, 6: 6})
+        o = _block({1: 1, 2: 2, 3: 33, 4: 44, 5: 55, 6: 6, 7: 7, 9: 9, 10: 100})
+        # key 6 absent from theirs (theirs-delete)
+        t = _block({1: 1, 2: 22, 3: 3, 4: 44, 5: 555, 8: 8, 9: 9, 10: 101})
+
+        union, decision, presence, stats = merge_classify(a, o, t)
+        by_key = dict(zip(union.tolist(), decision.tolist()))
+        assert by_key[1] == KEEP_OURS
+        assert by_key[2] == TAKE_THEIRS
+        assert by_key[3] == KEEP_OURS
+        assert by_key[4] == KEEP_OURS  # same edit both sides
+        assert by_key[5] == CONFLICT
+        assert by_key[6] == TAKE_THEIRS  # theirs deleted
+        assert by_key[7] == KEEP_OURS  # ours insert
+        assert by_key[8] == TAKE_THEIRS  # theirs insert
+        assert by_key[9] == KEEP_OURS  # same insert
+        assert by_key[10] == CONFLICT  # add/add different
+        assert stats["conflicts"] == 2
+
+    def test_matches_reference(self):
+        rng = np.random.default_rng(3)
+        n = 500
+        base = {int(k): int(v) for k, v in zip(rng.choice(5000, n, replace=False), rng.integers(1, 2**31, n))}
+        ours = dict(base)
+        theirs = dict(base)
+        for k in list(base)[:50]:
+            ours[k] = int(rng.integers(1, 2**31))
+        for k in list(base)[30:80]:
+            theirs[k] = int(rng.integers(1, 2**31))
+        for k in list(base)[100:120]:
+            del ours[k]
+        for k in list(base)[110:130]:
+            del theirs[k]
+        a_b, o_b, t_b = _block(base), _block(ours), _block(theirs)
+        union, decision, _, _ = merge_classify(a_b, o_b, t_b)
+        ref_union, ref_decision = merge_classify_reference(a_b, o_b, t_b)
+        assert np.array_equal(union, ref_union)
+        assert np.array_equal(decision, ref_decision)
+
+
+@pytest.fixture
+def branched_repo(tmp_path):
+    """repo with main (theirs edits) and branch 'ours' checked out."""
+    repo, ds_path = make_imported_repo(tmp_path, n=10)
+    base_oid = repo.head_commit_oid
+    # create branch alt from base
+    repo.refs.set("refs/heads/alt", base_oid)
+    return repo, ds_path, base_oid
+
+
+def _feature(fid, name, rating=1.0, x=100.0, y=-40.0):
+    return {
+        "fid": fid,
+        "geom": Geometry.from_wkt(f"POINT ({x} {y})"),
+        "name": name,
+        "rating": rating,
+    }
+
+
+class TestDoMerge:
+    def test_fast_forward(self, branched_repo):
+        repo, ds_path, base = branched_repo
+        edit_commit(repo, ds_path, inserts=[_feature(50, "new")])
+        head = repo.head_commit_oid
+        # reset HEAD branch back to base, then merge the edit commit
+        branch = repo.head_branch
+        repo.refs.set(branch, base)
+        result = do_merge(repo, head)
+        assert result.fast_forward
+        assert repo.head_commit_oid == head
+
+    def test_already_merged(self, branched_repo):
+        repo, ds_path, base = branched_repo
+        edit_commit(repo, ds_path, inserts=[_feature(50, "new")])
+        result = do_merge(repo, base)
+        assert result.already_merged
+
+    def test_clean_merge(self, branched_repo):
+        repo, ds_path, base = branched_repo
+        # ours: edit fid 2 on main
+        edit_commit(repo, ds_path, updates=[_feature(2, "ours-2", 2.0)])
+        # theirs: edit fid 3 + insert 60 on alt
+        edit_commit(
+            repo,
+            ds_path,
+            updates=[_feature(3, "theirs-3", 3.0)],
+            inserts=[_feature(60, "theirs-60")],
+            ref="refs/heads/alt",
+        )
+        result = do_merge(repo, "alt")
+        assert not result.has_conflicts
+        assert result.commit_oid
+        commit = repo.odb.read_commit(result.commit_oid)
+        assert len(commit.parents) == 2
+        merged = repo.datasets(result.commit_oid)[ds_path]
+        assert merged.get_feature([2])["name"] == "ours-2"
+        assert merged.get_feature([3])["name"] == "theirs-3"
+        assert merged.get_feature([60])["name"] == "theirs-60"
+        assert repo.state == KartRepoState.NORMAL
+
+    def test_conflicting_merge_and_resolve(self, branched_repo):
+        repo, ds_path, base = branched_repo
+        edit_commit(repo, ds_path, updates=[_feature(4, "ours-4")])
+        edit_commit(
+            repo, ds_path, updates=[_feature(4, "theirs-4")], ref="refs/heads/alt"
+        )
+        result = do_merge(repo, "alt")
+        assert result.has_conflicts
+        assert repo.state == KartRepoState.MERGING
+        label = f"{ds_path}:feature:4"
+        assert list(result.merge_index.conflicts) == [label]
+
+        # cannot merge again while merging
+        with pytest.raises(InvalidOperation):
+            do_merge(repo, "alt")
+        # cannot continue while unresolved
+        with pytest.raises(InvalidOperation):
+            complete_merging_state(repo)
+
+        # resolve with theirs
+        merge_index = MergeIndex.read_from_repo(repo)
+        aot = merge_index.conflicts[label]
+        merge_index.add_resolve(label, [aot.theirs])
+        merge_index.write_to_repo(repo)
+
+        commit_oid = complete_merging_state(repo)
+        assert repo.state == KartRepoState.NORMAL
+        merged = repo.datasets(commit_oid)[ds_path]
+        assert merged.get_feature([4])["name"] == "theirs-4"
+        commit = repo.odb.read_commit(commit_oid)
+        assert len(commit.parents) == 2
+
+    def test_resolve_with_delete(self, branched_repo):
+        repo, ds_path, base = branched_repo
+        edit_commit(repo, ds_path, updates=[_feature(4, "ours-4")])
+        edit_commit(
+            repo, ds_path, updates=[_feature(4, "theirs-4")], ref="refs/heads/alt"
+        )
+        do_merge(repo, "alt")
+        label = f"{ds_path}:feature:4"
+        merge_index = MergeIndex.read_from_repo(repo)
+        merge_index.add_resolve(label, [])
+        merge_index.write_to_repo(repo)
+        commit_oid = complete_merging_state(repo)
+        merged = repo.datasets(commit_oid)[ds_path]
+        with pytest.raises(KeyError):
+            merged.get_feature([4])
+        assert merged.feature_count == 9
+
+    def test_abort(self, branched_repo):
+        repo, ds_path, base = branched_repo
+        head_before = None
+        edit_commit(repo, ds_path, updates=[_feature(4, "ours-4")])
+        head_before = repo.head_commit_oid
+        edit_commit(
+            repo, ds_path, updates=[_feature(4, "theirs-4")], ref="refs/heads/alt"
+        )
+        do_merge(repo, "alt")
+        assert repo.state == KartRepoState.MERGING
+        abort_merging_state(repo)
+        assert repo.state == KartRepoState.NORMAL
+        assert repo.head_commit_oid == head_before
+
+    def test_delete_edit_conflict(self, branched_repo):
+        repo, ds_path, base = branched_repo
+        edit_commit(repo, ds_path, deletes=[6])
+        edit_commit(
+            repo, ds_path, updates=[_feature(6, "theirs-6")], ref="refs/heads/alt"
+        )
+        result = do_merge(repo, "alt")
+        assert result.has_conflicts
+        label = f"{ds_path}:feature:6"
+        aot = result.merge_index.conflicts[label]
+        assert aot.ours is None  # deleted in ours
+        assert aot.theirs is not None
+        assert aot.ancestor is not None
+
+    def test_meta_conflict(self, branched_repo):
+        repo, ds_path, base = branched_repo
+        from kart_tpu.diff.structs import (
+            DatasetDiff,
+            Delta,
+            DeltaDiff,
+            KeyValue,
+            RepoDiff,
+        )
+
+        def meta_commit(title, ref):
+            structure = repo.structure(ref)
+            meta_diff = DeltaDiff()
+            meta_diff.add_delta(
+                Delta.update(
+                    KeyValue(("title", "points title")), KeyValue(("title", title))
+                )
+            )
+            ds_diff = DatasetDiff()
+            ds_diff["meta"] = meta_diff
+            repo_diff = RepoDiff()
+            repo_diff[ds_path] = ds_diff
+            return structure.commit_diff(repo_diff, f"retitle {title}")
+
+        meta_commit("ours title", "HEAD")
+        meta_commit("theirs title", "refs/heads/alt")
+        result = do_merge(repo, "alt")
+        assert result.has_conflicts
+        assert f"{ds_path}:meta:title" in result.merge_index.conflicts
+
+    def test_merge_dry_run(self, branched_repo):
+        repo, ds_path, base = branched_repo
+        head_before = repo.head_commit_oid
+        edit_commit(
+            repo, ds_path, updates=[_feature(3, "theirs-3")], ref="refs/heads/alt"
+        )
+        result = do_merge(repo, "alt", dry_run=True)
+        assert result.dry_run
+        assert repo.head_commit_oid == head_before
+        assert repo.state == KartRepoState.NORMAL

@@ -1,0 +1,221 @@
+"""CLI merge/conflicts/resolve flow (reference: tests/test_merge.py CLI
+cases)."""
+
+import json
+import os
+import sqlite3
+
+import pytest
+from click.testing import CliRunner
+
+from helpers import create_points_gpkg
+from kart_tpu.cli import cli
+
+
+@pytest.fixture
+def runner():
+    return CliRunner()
+
+
+@pytest.fixture
+def repo_dir(tmp_path, runner, monkeypatch):
+    gpkg = create_points_gpkg(str(tmp_path / "source.gpkg"), n=10)
+    repo_dir = tmp_path / "repo"
+    r = runner.invoke(cli, ["init", str(repo_dir), "--workingcopy-location", "wc.gpkg"])
+    assert r.exit_code == 0, r.output
+    monkeypatch.chdir(repo_dir)
+    from kart_tpu.core.repo import KartRepo
+
+    KartRepo(str(repo_dir)).config.set_many(
+        {"user.name": "Tester", "user.email": "t@example.com"}
+    )
+    r = runner.invoke(cli, ["import", str(gpkg)])
+    assert r.exit_code == 0, r.output
+    return repo_dir
+
+
+def wc_edit(repo_dir, sql):
+    con = sqlite3.connect(repo_dir / "wc.gpkg")
+    con.executescript(sql)
+    con.commit()
+    con.close()
+
+
+def commit_edit(runner, repo_dir, sql, message):
+    wc_edit(repo_dir, sql)
+    r = runner.invoke(cli, ["commit", "-m", message])
+    assert r.exit_code == 0, r.output
+
+
+def make_conflict(runner, repo_dir):
+    """main and alt both edit fid=3's name differently."""
+    r = runner.invoke(cli, ["branch", "alt"])
+    assert r.exit_code == 0, r.output
+    commit_edit(
+        runner, repo_dir, "UPDATE points SET name='ours-3' WHERE fid=3", "ours edit"
+    )
+    r = runner.invoke(cli, ["switch", "alt"])
+    assert r.exit_code == 0, r.output
+    commit_edit(
+        runner, repo_dir, "UPDATE points SET name='theirs-3' WHERE fid=3", "theirs edit"
+    )
+    r = runner.invoke(cli, ["switch", "main"])
+    assert r.exit_code == 0, r.output
+
+
+def test_merge_fast_forward(repo_dir, runner):
+    r = runner.invoke(cli, ["branch", "alt"])
+    assert r.exit_code == 0, r.output
+    r = runner.invoke(cli, ["switch", "alt"])
+    commit_edit(
+        runner, repo_dir, "UPDATE points SET name='x' WHERE fid=1", "edit on alt"
+    )
+    r = runner.invoke(cli, ["switch", "main"])
+    assert r.exit_code == 0, r.output
+    r = runner.invoke(cli, ["merge", "alt"])
+    assert r.exit_code == 0, r.output
+    assert "Fast-forward" in r.output
+
+
+def test_merge_clean(repo_dir, runner):
+    r = runner.invoke(cli, ["branch", "alt"])
+    commit_edit(
+        runner, repo_dir, "UPDATE points SET name='ours-1' WHERE fid=1", "ours"
+    )
+    r = runner.invoke(cli, ["switch", "alt"])
+    commit_edit(
+        runner, repo_dir, "UPDATE points SET name='theirs-2' WHERE fid=2", "theirs"
+    )
+    r = runner.invoke(cli, ["switch", "main"])
+    r = runner.invoke(cli, ["merge", "alt", "-o", "json"])
+    assert r.exit_code == 0, r.output
+    body = json.loads(r.output)["kart.merge/v1"]
+    assert "commit" in body
+    # both edits present in the working copy
+    con = sqlite3.connect(repo_dir / "wc.gpkg")
+    names = dict(con.execute("SELECT fid, name FROM points WHERE fid IN (1,2)"))
+    con.close()
+    assert names == {1: "ours-1", 2: "theirs-2"}
+
+
+def test_merge_conflict_resolve_continue(repo_dir, runner):
+    make_conflict(runner, repo_dir)
+    r = runner.invoke(cli, ["merge", "alt"])
+    assert r.exit_code == 1
+    assert "conflict" in r.output.lower()
+
+    r = runner.invoke(cli, ["status"])
+    assert r.exit_code == 0
+
+    r = runner.invoke(cli, ["conflicts"])
+    assert r.exit_code == 1
+    assert "points:feature:3" in r.output
+
+    r = runner.invoke(cli, ["conflicts", "-o", "json"])
+    body = json.loads(r.output)["kart.conflicts/v1"]
+    assert "points:feature:3" in body
+    assert body["points:feature:3"]["ours"]["name"] == "ours-3"
+    assert body["points:feature:3"]["theirs"]["name"] == "theirs-3"
+
+    r = runner.invoke(cli, ["resolve", "points:feature:3", "--with", "theirs"])
+    assert r.exit_code == 0, r.output
+    assert "All conflicts resolved" in r.output
+
+    r = runner.invoke(cli, ["conflicts"])
+    assert r.exit_code == 0
+    assert "No conflicts" in r.output
+
+    r = runner.invoke(cli, ["merge", "--continue"])
+    assert r.exit_code == 0, r.output
+
+    con = sqlite3.connect(repo_dir / "wc.gpkg")
+    (name,) = con.execute("SELECT name FROM points WHERE fid=3").fetchone()
+    con.close()
+    assert name == "theirs-3"
+
+
+def test_merge_abort(repo_dir, runner):
+    make_conflict(runner, repo_dir)
+    r = runner.invoke(cli, ["merge", "alt"])
+    assert r.exit_code == 1
+    r = runner.invoke(cli, ["merge", "--abort"])
+    assert r.exit_code == 0, r.output
+    con = sqlite3.connect(repo_dir / "wc.gpkg")
+    (name,) = con.execute("SELECT name FROM points WHERE fid=3").fetchone()
+    con.close()
+    assert name == "ours-3"
+    # merge again works
+    r = runner.invoke(cli, ["merge", "alt", "--dry-run"])
+    assert r.exit_code == 0, r.output
+    assert "1 conflicts (dry run)" in r.output
+
+
+def test_resolve_with_file(repo_dir, runner, tmp_path):
+    make_conflict(runner, repo_dir)
+    runner.invoke(cli, ["merge", "alt"])
+    geojson = {
+        "type": "Feature",
+        "id": 3,
+        "geometry": {"type": "Point", "coordinates": [103.0, -40.3]},
+        "properties": {"fid": 3, "name": "resolved-3", "rating": 1.5},
+    }
+    path = tmp_path / "res.geojson"
+    path.write_text(json.dumps(geojson))
+    r = runner.invoke(
+        cli, ["resolve", "points:feature:3", "--with-file", str(path)]
+    )
+    assert r.exit_code == 0, r.output
+    r = runner.invoke(cli, ["merge", "--continue"])
+    assert r.exit_code == 0, r.output
+    con = sqlite3.connect(repo_dir / "wc.gpkg")
+    (name,) = con.execute("SELECT name FROM points WHERE fid=3").fetchone()
+    con.close()
+    assert name == "resolved-3"
+
+
+def test_merge_no_conflicts_command_outside_merge(repo_dir, runner):
+    r = runner.invoke(cli, ["conflicts"])
+    assert r.exit_code != 0
+    r = runner.invoke(cli, ["merge", "--continue"])
+    assert r.exit_code != 0
+
+
+def test_meta_conflict_renders_text_values(repo_dir, runner):
+    """Meta items (title etc.) are plain text, not msgpack — the conflicts
+    output must show the actual strings."""
+    from kart_tpu.core.repo import KartRepo
+    from kart_tpu.diff.structs import (
+        DatasetDiff,
+        Delta,
+        DeltaDiff,
+        KeyValue,
+        RepoDiff,
+    )
+
+    repo = KartRepo(str(repo_dir))
+
+    def meta_commit(title, ref):
+        structure = repo.structure(ref)
+        meta_diff = DeltaDiff()
+        meta_diff.add_delta(
+            Delta.update(
+                KeyValue(("title", "points title")), KeyValue(("title", title))
+            )
+        )
+        ds_diff = DatasetDiff()
+        ds_diff["meta"] = meta_diff
+        repo_diff = RepoDiff()
+        repo_diff["points"] = ds_diff
+        return structure.commit_diff(repo_diff, f"retitle {title}")
+
+    r = runner.invoke(cli, ["branch", "alt"])
+    assert r.exit_code == 0, r.output
+    meta_commit("ours title", "HEAD")
+    meta_commit("theirs title", "refs/heads/alt")
+    r = runner.invoke(cli, ["merge", "alt"])
+    assert r.exit_code == 1
+    r = runner.invoke(cli, ["conflicts", "-o", "json"])
+    body = json.loads(r.output)["kart.conflicts/v1"]
+    assert body["points:meta:title"]["ours"] == "ours title"
+    assert body["points:meta:title"]["theirs"] == "theirs title"
+    assert body["points:meta:title"]["ancestor"] == "points title"

@@ -30,7 +30,11 @@ OID_C = "cc" * 20
 def test_bucket_size():
     assert bucket_size(0) == 1024
     assert bucket_size(1024) == 1024
-    assert bucket_size(1025) == 2048
+    assert bucket_size(1025) == 1152  # 9 * 2^7: 1/8-step granularity
+    for n in (2048, 4097, 10_000_000):
+        b = bucket_size(n)
+        assert b >= n
+        assert (b - n) / n <= 0.125  # waste cap above the minimum floor
 
 
 def test_pack_unpack_oids():

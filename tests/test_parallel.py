@@ -1,0 +1,90 @@
+"""Mesh-sharded diff: identical counts to the single-chip and numpy paths.
+
+Runs on whatever devices are live; the multi-device cases skip below 8
+devices (use the virtual CPU mesh per tests/conftest.py).
+"""
+
+import numpy as np
+import pytest
+
+import jax
+
+from kart_tpu.ops.blocks import FeatureBlock, pack_oid_hex
+from kart_tpu.ops.diff_kernel import classify_blocks
+from kart_tpu.parallel import make_mesh, partition_block, sharded_classify
+from kart_tpu.parallel.sharded_diff import synthetic_block
+
+
+def _blocks_with_edits(n=1000, n_ins=7, n_upd=11, n_del=5, seed=42):
+    rng = np.random.default_rng(seed)
+    keys = np.sort(rng.choice(10 * n, size=n, replace=False)).astype(np.int64)
+    oids = rng.integers(0, 2**32, size=(n, 5), dtype=np.uint32)
+    paths = [f"f/{k}" for k in keys]
+    old = FeatureBlock.from_arrays(keys.copy(), oids.copy(), list(paths))
+
+    new_keys = keys.copy()
+    new_oids = oids.copy()
+    del_idx = rng.choice(n, size=n_del, replace=False)
+    keep = np.setdiff1d(np.arange(n), del_idx)
+    new_keys = new_keys[keep]
+    new_oids = new_oids[keep]
+    upd_idx = rng.choice(len(new_keys), size=n_upd, replace=False)
+    new_oids[upd_idx] = rng.integers(0, 2**32, size=(n_upd, 5), dtype=np.uint32)
+    ins_keys = np.asarray(
+        sorted(set(range(10 * n, 10 * n + n_ins))), dtype=np.int64
+    )
+    ins_oids = rng.integers(0, 2**32, size=(n_ins, 5), dtype=np.uint32)
+    new_keys = np.concatenate([new_keys, ins_keys])
+    new_oids = np.concatenate([new_oids, ins_oids])
+    new_paths = [f"f/{k}" for k in new_keys]
+    new = FeatureBlock.from_arrays(new_keys, new_oids, new_paths)
+    return old, new, {"inserts": n_ins, "updates": n_upd, "deletes": n_del}
+
+
+def test_partition_block_roundtrip():
+    old, _, _ = _blocks_with_edits()
+    keys, oids, counts = partition_block(old, 4)
+    assert counts.sum() == old.count
+    # every shard holds only keys with its own modulus, still sorted
+    for s in range(4):
+        real = keys[s, : counts[s]]
+        assert np.all(real % 4 == s)
+        assert np.all(np.diff(real) > 0)
+
+
+@pytest.mark.parametrize("n_shards", [1, 2, 4, 8])
+def test_sharded_counts_match_single_chip(n_shards):
+    if jax.device_count() < n_shards:
+        pytest.skip(f"needs {n_shards} devices")
+    old, new, expected = _blocks_with_edits()
+    _, _, single_counts = classify_blocks(old, new)
+    mesh = make_mesh(n_shards)
+    _, _, sharded_counts, _ = sharded_classify(mesh, old, new)
+    assert single_counts == expected
+    assert sharded_counts == expected
+
+
+def test_sharded_classify_classes_cover_all_changes():
+    n_shards = min(jax.device_count(), 8)
+    old, new, expected = _blocks_with_edits(n=4096, n_ins=13, n_upd=29, n_del=17)
+    mesh = make_mesh(n_shards)
+    old_class, new_class, counts, (old_part, new_part) = sharded_classify(
+        mesh, old, new
+    )
+    assert counts == expected
+    from kart_tpu.ops.diff_kernel import DELETE, INSERT, UPDATE
+
+    assert int((new_class == INSERT).sum()) == expected["inserts"]
+    assert int((old_class == UPDATE).sum()) == expected["updates"]
+    assert int((old_class == DELETE).sum()) == expected["deletes"]
+    # classes only ever set on real rows
+    for s in range(n_shards):
+        assert np.all(old_class[s, old_part[2][s] :] == 0)
+        assert np.all(new_class[s, new_part[2][s] :] == 0)
+
+
+def test_synthetic_block_deterministic():
+    a = synthetic_block(100, seed=1)
+    b = synthetic_block(100, seed=1)
+    assert np.array_equal(a.oids, b.oids)
+    assert a.count == 100
