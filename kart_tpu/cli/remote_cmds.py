@@ -1,0 +1,221 @@
+"""clone / fetch / push / pull / remote (reference: kart/clone.py,
+kart/pull.py, and the pass-through push/fetch/remote in kart/cli.py:211-253
+— here they are native commands over kart_tpu.transport)."""
+
+import click
+
+from kart_tpu.cli import CliError, cli
+from kart_tpu.core.repo import KartRepoState
+
+
+@cli.command()
+@click.option("--bare", is_flag=True, help="Clone without a working copy")
+@click.option(
+    "--depth",
+    type=click.INT,
+    default=None,
+    help="Create a shallow clone with history truncated to this many commits",
+)
+@click.option(
+    "--spatial-filter",
+    "spatial_filter_spec",
+    help="Spatial filter: <crs>;<geometry> (or @file). Makes a filtered "
+    "partial clone — features outside the filter stay on the remote and are "
+    "fetched on demand.",
+)
+@click.option(
+    "--workingcopy-location",
+    "--workingcopy",
+    "wc_location",
+    help="Location of the working copy to create",
+)
+@click.option("-b", "--branch", help="Branch to check out instead of the remote HEAD")
+@click.option(
+    "--checkout/--no-checkout",
+    "do_checkout",
+    default=True,
+    help="Whether to create a working copy",
+)
+@click.argument("url")
+@click.argument("directory", required=False)
+def clone(url, directory, bare, depth, spatial_filter_spec, wc_location, branch, do_checkout):
+    """Clone a repository into a new directory."""
+    import os
+
+    from kart_tpu import transport
+    from kart_tpu.transport.remote import RemoteError
+
+    if directory is None:
+        tail = url.rstrip("/").split("/")[-1]
+        directory = tail[:-5] if tail.endswith(".kart") else tail
+        if not directory:
+            raise CliError(f"Cannot derive directory name from {url!r}")
+    if os.path.exists(directory) and os.listdir(directory):
+        raise CliError(f"Destination is not empty: {directory!r}")
+
+    resolved = None
+    if spatial_filter_spec:
+        from kart_tpu.geometry import GeometryError
+        from kart_tpu.spatial_filter import (
+            ResolvedSpatialFilterSpec,
+            SpatialFilterError,
+        )
+
+        try:
+            resolved = ResolvedSpatialFilterSpec.from_spec_string(
+                spatial_filter_spec
+            )
+        except (SpatialFilterError, GeometryError) as e:
+            raise CliError(str(e))
+        if resolved.match_all:
+            resolved = None
+
+    try:
+        repo = transport.clone(
+            url,
+            directory,
+            bare=bare,
+            depth=depth,
+            spatial_filter_spec=resolved,
+            wc_location=wc_location,
+            do_checkout=do_checkout,
+            branch=branch,
+        )
+    except RemoteError as e:
+        raise CliError(str(e))
+    click.echo(f"Cloned into {repo.workdir or repo.gitdir}")
+
+
+@cli.command()
+@click.option("--depth", type=click.INT, default=None, help="Deepen/shallow-fetch limit")
+@click.argument("remote", required=False, default="origin")
+@click.pass_obj
+def fetch(ctx, remote, depth):
+    """Download objects and refs from a remote repository."""
+    from kart_tpu import transport
+    from kart_tpu.transport.remote import RemoteError
+
+    repo = ctx.repo
+    try:
+        updated = transport.fetch(repo, remote, depth=depth)
+    except RemoteError as e:
+        raise CliError(str(e))
+    for ref, oid in sorted(updated.items()):
+        click.echo(f"  {oid[:8]}  {ref}")
+    if not updated:
+        click.echo("Already up to date.")
+
+
+@cli.command()
+@click.option("--force", "-f", is_flag=True, help="Allow non-fast-forward updates")
+@click.option(
+    "-u",
+    "--set-upstream",
+    is_flag=True,
+    help="Set the upstream for the pushed branch",
+)
+@click.argument("remote", required=False, default="origin")
+@click.argument("refspecs", nargs=-1)
+@click.pass_obj
+def push(ctx, remote, refspecs, force, set_upstream):
+    """Update remote refs along with the objects needed to complete them."""
+    from kart_tpu import transport
+    from kart_tpu.transport.remote import RemoteError
+
+    repo = ctx.repo
+    try:
+        updated = transport.push(
+            repo, remote, list(refspecs), force=force, set_upstream=set_upstream
+        )
+    except RemoteError as e:
+        raise CliError(str(e))
+    for ref, oid in sorted(updated.items()):
+        click.echo(f"  {oid[:8] if oid else '(deleted)'}  {ref}")
+
+
+@cli.command()
+@click.option("--ff/--no-ff", default=True, help="Allow/forbid fast-forward merge")
+@click.option("--ff-only", is_flag=True, help="Only update if fast-forward is possible")
+@click.argument("remote", required=False, default="origin")
+@click.argument("branch", required=False)
+@click.pass_context
+def pull(click_ctx, remote, branch, ff, ff_only):
+    """Fetch from a remote and merge into the current branch
+    (reference: kart/pull.py)."""
+    ctx = click_ctx.obj
+    from kart_tpu import transport
+    from kart_tpu.transport.remote import RemoteError
+
+    repo = ctx.require_state(KartRepoState.NORMAL)
+    try:
+        transport.fetch(repo, remote)
+    except RemoteError as e:
+        raise CliError(str(e))
+
+    if branch is None:
+        local = repo.refs.head_branch()
+        if local is None:
+            raise CliError("Cannot pull: HEAD is detached")
+        branch = local[len("refs/heads/") :] if local.startswith("refs/heads/") else local
+    remote_ref = f"refs/remotes/{remote}/{branch}"
+    if repo.refs.get(remote_ref) is None:
+        raise CliError(f"No such remote branch: {remote}/{branch}")
+
+    from kart_tpu.cli.merge_cmds import merge as merge_cmd
+
+    click_ctx.invoke(
+        merge_cmd,
+        refish=remote_ref,
+        message=None,
+        dry_run=False,
+        ff=ff,
+        ff_only=ff_only,
+        continue_=False,
+        abort_=False,
+        output_format="text",
+    )
+
+
+@cli.group()
+def remote():
+    """Manage the set of remote repositories."""
+
+
+@remote.command("add")
+@click.argument("name")
+@click.argument("url")
+@click.pass_obj
+def remote_add(ctx, name, url):
+    """Add a remote."""
+    from kart_tpu.transport.remote import RemoteError, add_remote
+
+    try:
+        add_remote(ctx.repo, name, url)
+    except RemoteError as e:
+        raise CliError(str(e))
+
+
+@remote.command("remove")
+@click.argument("name")
+@click.pass_obj
+def remote_remove(ctx, name):
+    """Remove a remote."""
+    from kart_tpu.transport.remote import RemoteError, remove_remote
+
+    try:
+        remove_remote(ctx.repo, name)
+    except RemoteError as e:
+        raise CliError(str(e))
+
+
+@remote.command("list")
+@click.option("-v", "verbose", is_flag=True, help="Show URLs")
+@click.pass_obj
+def remote_list(ctx, verbose):
+    """List remotes."""
+    repo = ctx.repo
+    for name in repo.remotes():
+        if verbose:
+            click.echo(f"{name}\t{repo.remote_url(name)}")
+        else:
+            click.echo(name)

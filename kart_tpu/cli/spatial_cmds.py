@@ -1,0 +1,77 @@
+"""kart spatial-filter — envelope indexing + filter inspection
+(reference: kart/spatial_filter/index.py CLI, kart/spatial_filter/__init__.py)."""
+
+import json
+
+import click
+
+from kart_tpu.cli import CliError, cli
+from kart_tpu.diff.output import dump_json_output
+
+
+@cli.group("spatial-filter")
+def spatial_filter():
+    """Work with spatial filters and the feature envelope index."""
+
+
+@spatial_filter.command("index")
+@click.option("--clear", is_flag=True, help="Discard the index and rebuild from scratch")
+@click.option("--dry-run", is_flag=True, help="Index but don't save the result")
+@click.pass_obj
+def spatial_filter_index(ctx, clear, dry_run):
+    """Build or update the feature envelope index (enables fast
+    spatially-filtered clones from this repo)."""
+    from kart_tpu.spatial_filter.index import update_spatial_filter_index
+
+    repo = ctx.repo
+    n_features, n_commits = update_spatial_filter_index(
+        repo, clear=clear, dry_run=dry_run
+    )
+    click.echo(f"Indexed {n_features} feature envelopes over {n_commits} new commits")
+
+
+@spatial_filter.command("resolve")
+@click.option(
+    "-o", "--output-format", type=click.Choice(["text", "json"]), default="text"
+)
+@click.argument("spec", required=False)
+@click.pass_obj
+def spatial_filter_resolve(ctx, spec, output_format):
+    """Resolve a spatial filter spec (or this repo's configured filter) and
+    show its geometry, CRS and EPSG:4326 envelope."""
+    from kart_tpu.spatial_filter import (
+        ResolvedSpatialFilterSpec,
+        SpatialFilterError,
+    )
+
+    try:
+        if spec:
+            resolved = ResolvedSpatialFilterSpec.from_spec_string(spec)
+        else:
+            resolved = ResolvedSpatialFilterSpec.from_repo_config(ctx.repo)
+    except SpatialFilterError as e:
+        raise CliError(str(e))
+
+    if resolved.match_all:
+        if output_format == "json":
+            dump_json_output({"kart.spatialfilter/v1": None}, "-")
+        else:
+            click.echo("No spatial filter is configured (all features match)")
+        return
+
+    w, s, e, n = resolved.envelope_wsen_4326
+    if output_format == "json":
+        dump_json_output(
+            {
+                "kart.spatialfilter/v1": {
+                    "crs": resolved.crs_spec,
+                    "geometry": resolved.geometry.to_wkt(),
+                    "envelope4326": {"w": w, "s": s, "e": e, "n": n},
+                }
+            },
+            "-",
+        )
+    else:
+        click.echo(f"CRS: {resolved.crs_spec}")
+        click.echo(f"Geometry: {resolved.geometry.to_wkt()[:120]}")
+        click.echo(f"Envelope (EPSG:4326 w,s,e,n): {w:.7f},{s:.7f},{e:.7f},{n:.7f}")

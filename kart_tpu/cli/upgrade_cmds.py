@@ -1,0 +1,41 @@
+"""kart upgrade (reference: kart/upgrade/__init__.py CLI)."""
+
+import click
+
+from kart_tpu.cli import CliError, cli
+
+
+@cli.command()
+@click.option(
+    "--in-place",
+    is_flag=True,
+    help="Upgrade the repository in place (V2→V3 reuses all feature blobs)",
+)
+@click.argument("source", type=click.Path(exists=True))
+@click.argument("dest", type=click.Path(), required=False)
+def upgrade(source, dest, in_place):
+    """Upgrade a repository to the latest repo structure version (V3).
+
+    SOURCE is the existing repo; DEST is the directory for the upgraded copy
+    (omit with --in-place)."""
+    from kart_tpu.core.repo import KartRepo, RepoError
+    from kart_tpu.upgrade import UpgradeError, upgrade_in_place, upgrade_repo
+
+    def progress(i, total):
+        if i == total or i % 10 == 0:
+            click.echo(f"  upgraded commit {i}/{total}")
+
+    try:
+        if in_place:
+            if dest:
+                raise CliError("--in-place takes no DEST argument")
+            repo = KartRepo(source)
+            commit_map = upgrade_in_place(repo, progress=progress)
+            click.echo(f"Upgraded {len(commit_map)} commits in place to V3")
+        else:
+            if not dest:
+                raise CliError("Missing argument: DEST (or use --in-place)")
+            _, commit_map = upgrade_repo(source, dest, progress=progress)
+            click.echo(f"Upgraded {len(commit_map)} commits into {dest}")
+    except (UpgradeError, RepoError) as e:
+        raise CliError(str(e))

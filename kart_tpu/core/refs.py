@@ -32,6 +32,8 @@ class RefStore:
             return None
         with open(path) as f:
             value = f.read().strip()
+        if value.startswith("ref: "):  # symref file (e.g. refs/remotes/x/HEAD)
+            return self.get(value[5:])
         return value or None
 
     def set(self, ref, oid, log_message=None):
@@ -67,7 +69,7 @@ class RefStore:
                 rel = os.path.relpath(full, self.gitdir).replace(os.sep, "/")
                 with open(full) as f:
                     value = f.read().strip()
-                if value:
+                if value and not value.startswith("ref: "):
                     yield rel, value
 
     # -- HEAD ----------------------------------------------------------------

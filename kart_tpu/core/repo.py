@@ -339,20 +339,28 @@ class KartRepo:
 
         seen = set()
         heap = []
+        counter = 0  # tie-break equal committer times: children first
 
-        def push(oid):
+        def push(oid, *, tolerate_missing):
+            nonlocal counter
             if oid not in seen:
                 seen.add(oid)
-                commit = self.odb.read_commit(oid)
-                heapq.heappush(heap, (-commit.committer.time, oid, commit))
+                try:
+                    commit = self.odb.read_commit(oid)
+                except ObjectMissing:
+                    if tolerate_missing:
+                        return  # shallow-clone boundary: parent not fetched
+                    raise  # a missing *tip* is corruption, not a boundary
+                heapq.heappush(heap, (-commit.committer.time, counter, oid, commit))
+                counter += 1
 
-        push(start_oid)
+        push(start_oid, tolerate_missing=False)
         while heap:
-            _, oid, commit = heapq.heappop(heap)
+            _, _, oid, commit = heapq.heappop(heap)
             yield oid, commit
             parents = commit.parents[:1] if first_parent else commit.parents
             for p in parents:
-                push(p)
+                push(p, tolerate_missing=True)
 
     def topo_commits(self, start_oids):
         """All reachable commits in parents-before-children order."""
@@ -366,9 +374,13 @@ class KartRepo:
                 continue
             if oid in visited:
                 continue
+            try:
+                parents = self.odb.read_commit(oid).parents
+            except ObjectMissing:
+                continue  # shallow-clone boundary
             visited.add(oid)
             stack.append((oid, True))
-            for p in self.odb.read_commit(oid).parents:
+            for p in parents:
                 stack.append((p, False))
         return order
 
@@ -386,7 +398,10 @@ class KartRepo:
         def push(oid):
             if oid not in seen:
                 seen.add(oid)
-                commit = self.odb.read_commit(oid)
+                try:
+                    commit = self.odb.read_commit(oid)
+                except ObjectMissing:
+                    return  # shallow-clone boundary
                 heapq.heappush(heap, (-commit.committer.time, oid, commit))
 
         push(oid_b)
@@ -405,8 +420,12 @@ class KartRepo:
             o = stack.pop()
             if o in out:
                 continue
+            try:
+                parents = self.odb.read_commit(o).parents
+            except ObjectMissing:
+                continue  # shallow-clone boundary
             out.add(o)
-            stack.extend(self.odb.read_commit(o).parents)
+            stack.extend(parents)
         return out
 
     def is_ancestor(self, maybe_ancestor, descendant):

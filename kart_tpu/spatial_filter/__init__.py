@@ -1,0 +1,409 @@
+"""Spatial filtering: work with just the features inside an area of interest.
+
+Client side (reference: kart/spatial_filter/__init__.py): a filter spec —
+``<crs>;<geometry>`` — from CLI / config / file; per-dataset
+:class:`SpatialFilter` objects test feature envelopes against the filter,
+with the filter transformed into each dataset's CRS once up front (reference
+transforms per-dataset the same way, spatial_filter/__init__.py:611-694).
+
+Server side (reference: vendor/spatial-filter/spatial_filter.cpp): during a
+filtered partial clone, :func:`blob_filter_for_spec` vetoes feature blobs
+whose envelope misses the filter, consulting the bit-packed envelope index
+(:mod:`kart_tpu.spatial_filter.index`) when built — with on-the-fly envelope
+decoding as fallback (the reference hard-requires the index; we degrade
+gracefully).  The native fast path lives in the C++ extension
+(:mod:`kart_tpu.native`); the vectorized TPU path is
+:func:`kart_tpu.ops.bbox.bbox_intersects`.
+
+Match results are tri-state (MATCHED / NOT_MATCHED / PROMISED — reference
+MatchResult, spatial_filter/__init__.py:413-432): a feature whose geometry
+is itself a promised blob can't be tested locally.
+"""
+
+import os
+from enum import Enum
+
+import numpy as np
+
+from kart_tpu.core.odb import ObjectPromised
+from kart_tpu.crs import CRS, Transform, make_crs
+from kart_tpu.geometry import MULTIPOLYGON, POLYGON, Geometry
+
+EPSG_4326_WKT = """GEOGCS["WGS 84",DATUM["WGS_1984",SPHEROID["WGS 84",6378137,298.257223563,AUTHORITY["EPSG","7030"]],AUTHORITY["EPSG","6326"]],PRIMEM["Greenwich",0],UNIT["degree",0.0174532925199433],AUTHORITY["EPSG","4326"]]"""
+
+
+class SpatialFilterError(ValueError):
+    pass
+
+
+class MatchResult(Enum):
+    MATCHED = "matched"
+    NOT_MATCHED = "not-matched"
+    PROMISED = "promised"  # can't tell: geometry blob not present locally
+
+
+def _rect_overlaps(env, rect):
+    """(min-x, max-x, min-y, max-y) vs (w, e, s, n) rect, anti-meridian aware
+    on the x axis (reference: bbox_intersects_fast,
+    spatial_filter/__init__.py:709-734)."""
+    x0, x1, y0, y1 = env
+    w, e, s, n = rect
+    if y1 < s or y0 > n:
+        return False
+    if e >= w:  # normal range
+        if x1 >= x0:
+            return x0 <= e and w <= x1
+        # env crosses the anti-meridian
+        return x0 <= e or w <= x1
+    # rect crosses the anti-meridian
+    if x1 >= x0:
+        return x0 <= e or w <= x1
+    return True  # both cross: they share the anti-meridian
+
+
+class ResolvedSpatialFilterSpec:
+    """A parsed, usable filter: CRS + geometry
+    (reference: ResolvedSpatialFilterSpec, spatial_filter/__init__.py)."""
+
+    def __init__(self, crs_spec, geometry, *, match_all=False):
+        self.match_all = match_all
+        if match_all:
+            self.crs_spec = self.geometry = self.crs = None
+            return
+        self.crs_spec = crs_spec
+        self.crs = make_crs(crs_spec)
+        if isinstance(geometry, Geometry):
+            self.geometry = geometry
+        else:
+            self.geometry = Geometry.from_string(
+                geometry,
+                allowed_types=(POLYGON, MULTIPOLYGON),
+            )
+
+    @classmethod
+    def from_spec_string(cls, text):
+        """``<crs>;<geometry>`` where geometry is WKT or hex WKB, or the
+        contents of a file via ``@filename``
+        (reference: spatial_filter/__init__.py:170-270)."""
+        if text in (None, "", "none"):
+            return cls(None, None, match_all=True)
+        if text.startswith("@"):
+            path = text[1:]
+            if not os.path.exists(path):
+                raise SpatialFilterError(f"No such file: {path}")
+            with open(path) as f:
+                text = f.read().strip()
+        crs_spec, sep, geom_text = text.partition(";")
+        if not sep:
+            raise SpatialFilterError(
+                "Spatial filter must be in the form <crs>;<geometry> "
+                "(e.g. 'EPSG:4326;POLYGON((...))')"
+            )
+        return cls(crs_spec.strip(), geom_text.strip())
+
+    @classmethod
+    def from_repo_config(cls, repo):
+        from kart_tpu.core.repo import KartConfigKeys
+
+        geom = repo.config.get(KartConfigKeys.KART_SPATIALFILTER_GEOMETRY)
+        crs = repo.config.get(KartConfigKeys.KART_SPATIALFILTER_CRS)
+        if not geom or not crs:
+            return cls(None, None, match_all=True)
+        return cls(crs, geom)
+
+    # -- envelopes -----------------------------------------------------------
+
+    @property
+    def envelope_native(self):
+        """(min-x, max-x, min-y, max-y) in the filter's own CRS."""
+        return self.geometry.envelope()
+
+    @property
+    def envelope_wsen_4326(self):
+        """(w, s, e, n) in EPSG:4326 — the form the envelope index and the
+        wire filter argument use."""
+        env = self.envelope_native
+        if not self.crs.is_geographic:
+            t = Transform(self.crs, make_crs(EPSG_4326_WKT))
+            env = t.transform_envelope(env)
+        x0, x1, y0, y1 = env
+        return (x0, y0, x1, y1)
+
+    @property
+    def filter_arg(self):
+        """The ``extension:spatial=`` argument: ``w,s,e,n`` in EPSG:4326
+        (reference: kart/repo.py:288-302)."""
+        return ",".join(f"{v:.7f}" for v in self.envelope_wsen_4326)
+
+    def config_items(self):
+        from kart_tpu.core.repo import KartConfigKeys
+
+        return {
+            KartConfigKeys.KART_SPATIALFILTER_GEOMETRY: self.geometry.to_wkt(),
+            KartConfigKeys.KART_SPATIALFILTER_CRS: self.crs_spec,
+        }
+
+    def resolve_for_dataset(self, dataset):
+        """-> SpatialFilter in the dataset's CRS."""
+        if self.match_all:
+            return SpatialFilter.MATCH_ALL
+        return SpatialFilter.for_dataset(self, dataset)
+
+
+class SpatialFilter:
+    """A filter ready to test features of one dataset: the filter envelope,
+    pre-transformed into the dataset's CRS. Envelope-level semantics: a
+    feature matches when its geometry envelope overlaps the filter
+    geometry's envelope (the reference's envelope fast-path,
+    spatial_filter/__init__.py:534-590; its exact OGR residue check is
+    approximated by the polygon-vs-envelope test in match_polygon_exact)."""
+
+    MATCH_ALL = None  # set below
+
+    def __init__(self, rect_wesn=None, geom_column_name=None, polygon_ring=None):
+        self.match_all = rect_wesn is None
+        self.rect = rect_wesn  # (w, e, s, n) in dataset CRS
+        self.geom_column_name = geom_column_name
+        self.polygon_ring = polygon_ring  # Nx2 numpy outer ring, dataset CRS
+
+    @classmethod
+    def for_dataset(cls, spec, dataset):
+        geom_col = dataset.geom_column_name
+        if geom_col is None:
+            return cls.MATCH_ALL  # non-spatial dataset: everything matches
+        x0, x1, y0, y1 = spec.envelope_native
+        ring = _outer_ring_array(spec.geometry)
+        ds_crs_wkt = None
+        try:
+            ids = dataset.crs_identifiers()
+            if ids:
+                ds_crs_wkt = dataset.get_crs_definition(ids[0])
+        except Exception:
+            ds_crs_wkt = None
+        if ds_crs_wkt:
+            ds_crs = CRS(ds_crs_wkt)
+            if ds_crs != spec.crs:
+                try:
+                    t = Transform(spec.crs, ds_crs)
+                    x0, x1, y0, y1 = t.transform_envelope((x0, x1, y0, y1))
+                    if ring is not None:
+                        rx, ry = t.transform(ring[:, 0], ring[:, 1])
+                        ring = np.stack([rx, ry], axis=1)
+                except Exception:
+                    # unknown projection: keep the untransformed envelope and
+                    # fail open rather than dropping features
+                    return cls.MATCH_ALL
+        return cls((x0, x1, y0, y1), geom_col, ring)
+
+    def matches(self, feature):
+        result = self.match_result(feature)
+        if result is MatchResult.PROMISED:
+            raise ObjectPromised("<feature geometry>")
+        return result is MatchResult.MATCHED
+
+    def match_result(self, feature) -> MatchResult:
+        if self.match_all:
+            return MatchResult.MATCHED
+        try:
+            geom = feature.get(self.geom_column_name)
+        except ObjectPromised:
+            return MatchResult.PROMISED
+        return self.match_geometry(geom)
+
+    def match_geometry(self, geom) -> MatchResult:
+        if geom is None:
+            return MatchResult.MATCHED  # NULL geometry always matches (ref.)
+        env = Geometry.of(geom).envelope()
+        if env is None:
+            return MatchResult.MATCHED  # empty geometry
+        w, e, s, n = self.rect
+        if not _rect_overlaps(env, (w, e, s, n)):
+            return MatchResult.NOT_MATCHED
+        if self.polygon_ring is not None and not _polygon_intersects_rect(
+            self.polygon_ring, env
+        ):
+            return MatchResult.NOT_MATCHED
+        return MatchResult.MATCHED
+
+    def matches_envelope(self, env):
+        if self.match_all:
+            return True
+        return _rect_overlaps(env, self.rect)
+
+    def __bool__(self):
+        return not self.match_all
+
+
+SpatialFilter.MATCH_ALL = SpatialFilter()
+
+
+def _outer_ring_array(geometry):
+    """Outer ring(s) of a Polygon/MultiPolygon as one concatenated array is
+    wrong for point-in-polygon — keep just the first polygon's outer ring;
+    multi-polygon filters fall back to envelope semantics for the rest."""
+    from kart_tpu.geometry import parse_wkb
+
+    try:
+        value = parse_wkb(Geometry.of(geometry).to_wkb())
+    except Exception:
+        return None
+    name = value[0]
+    if name == "Polygon" and value.payload:
+        return np.asarray(value.payload[0], dtype=np.float64)[:, :2]
+    if name == "MultiPolygon" and value.payload:
+        first = value.payload[0]
+        if first.payload:
+            return np.asarray(first.payload[0], dtype=np.float64)[:, :2]
+    return None
+
+
+def _polygon_intersects_rect(ring, env):
+    """Exact polygon-vs-rectangle intersection: true when any polygon edge
+    crosses the rect, a polygon vertex is inside the rect, or the rect's
+    corner is inside the polygon. ``ring``: (N,2) closed or open outer ring."""
+    x0, x1, y0, y1 = env
+    xs, ys = ring[:, 0], ring[:, 1]
+    # vertex in rect
+    if np.any((xs >= x0) & (xs <= x1) & (ys >= y0) & (ys <= y1)):
+        return True
+    # rect corner in polygon (winding via ray cast)
+    if _point_in_ring(ring, x0, y0):
+        return True
+    # edge/rect crossing: conservative separating-axis on each edge segment
+    ax, ay = xs, ys
+    bx, by = np.roll(xs, -1), np.roll(ys, -1)
+    return bool(np.any(_segment_hits_rect(ax, ay, bx, by, x0, x1, y0, y1)))
+
+
+def _point_in_ring(ring, px, py):
+    xs, ys = ring[:, 0], ring[:, 1]
+    xj, yj = np.roll(xs, 1), np.roll(ys, 1)
+    crossing = ((ys > py) != (yj > py)) & (
+        px < (xj - xs) * (py - ys) / np.where(yj == ys, np.inf, yj - ys) + xs
+    )
+    return bool(np.sum(crossing) % 2)
+
+
+def _segment_hits_rect(ax, ay, bx, by, x0, x1, y0, y1):
+    """Vectorized Liang–Barsky clip: exact segment-vs-rect intersection."""
+    dx, dy = bx - ax, by - ay
+    t0 = np.zeros_like(ax, dtype=np.float64)
+    t1 = np.ones_like(ax, dtype=np.float64)
+    hit = np.ones_like(ax, dtype=bool)
+    for p, q in (
+        (-dx, ax - x0),
+        (dx, x1 - ax),
+        (-dy, ay - y0),
+        (dy, y1 - ay),
+    ):
+        parallel_out = (p == 0) & (q < 0)
+        with np.errstate(divide="ignore", invalid="ignore"):
+            t = np.where(p != 0, q / np.where(p == 0, 1.0, p), 0.0)
+        t0 = np.where(p < 0, np.maximum(t0, t), t0)
+        t1 = np.where(p > 0, np.minimum(t1, t), t1)
+        hit &= ~parallel_out
+    return hit & (t0 <= t1)
+
+
+# -- server side: blob filter for partial clone -----------------------------
+
+
+def blob_filter_for_spec(src_repo, wsen_arg):
+    """-> callable(path, oid) -> bool for ObjectEnumerator.blob_filter.
+
+    wsen_arg: "w,s,e,n" string or a 4-tuple, EPSG:4326. Feature blobs whose
+    envelope misses the rect are vetoed (= left promised on the client);
+    everything else ships (reference: spatial_filter.cpp:212-260 — also
+    fails open: blobs with no envelope record are shipped)."""
+    if isinstance(wsen_arg, str):
+        parts = [float(p) for p in wsen_arg.split(",")]
+        if len(parts) != 4:
+            raise SpatialFilterError(f"Bad spatial filter rect: {wsen_arg!r}")
+        w, s, e, n = parts
+    else:
+        w, s, e, n = wsen_arg
+
+    from kart_tpu.spatial_filter.index import EnvelopeIndexReader
+
+    reader = EnvelopeIndexReader.open(src_repo)  # None if no index built
+    transforms = _DatasetEnvelopeDecoder(src_repo)
+
+    def blob_filter(path, oid):
+        ds_feature = _split_feature_path(path)
+        if ds_feature is None:
+            return True  # meta / non-feature blob: always ship
+        if reader is not None:
+            env = reader.get(oid)
+            if env is not None:
+                return _rect_overlaps(
+                    (env[0], env[2], env[1], env[3]), (w, e, s, n)
+                )
+        env_4326 = transforms.envelope_4326(ds_feature[0], oid)
+        if env_4326 is None:
+            return True  # no geometry / undecodable: fail open
+        x0, x1, y0, y1 = env_4326
+        return _rect_overlaps((x0, x1, y0, y1), (w, e, s, n))
+
+    return blob_filter
+
+
+def _split_feature_path(path):
+    """'<ds>/.table-dataset/feature/ab/cd' -> (ds_path, rel) or None."""
+    for dirname in (".table-dataset", ".sno-dataset"):
+        marker = f"/{dirname}/feature/"
+        idx = path.find(marker)
+        if idx >= 0:
+            return path[:idx], path[idx + len(marker) :]
+    return None
+
+
+class _DatasetEnvelopeDecoder:
+    """On-the-fly feature envelope decode + transform to EPSG:4326, cached
+    per dataset (fallback when the envelope index isn't built)."""
+
+    def __init__(self, repo):
+        self.repo = repo
+        self._cache = {}
+
+    def _dataset_transform(self, ds_path):
+        if ds_path in self._cache:
+            return self._cache[ds_path]
+        transform = None
+        try:
+            ds = self.repo.datasets("HEAD").get(ds_path)
+            if ds is not None and ds.geom_column_name is not None:
+                ids = ds.crs_identifiers()
+                crs_wkt = ds.get_crs_definition(ids[0]) if ids else None
+                if crs_wkt:
+                    ds_crs = CRS(crs_wkt)
+                    if not ds_crs.is_geographic:
+                        transform = Transform(ds_crs, make_crs(EPSG_4326_WKT))
+                    else:
+                        transform = "identity"
+                else:
+                    transform = "identity"
+        except Exception:
+            transform = None
+        self._cache[ds_path] = transform
+        return transform
+
+    def envelope_4326(self, ds_path, oid):
+        transform = self._dataset_transform(ds_path)
+        if transform is None:
+            return None
+        try:
+            from kart_tpu.core.serialise import msg_unpack
+
+            data = self.repo.odb.read_blob(oid)
+            _, values = msg_unpack(data)
+            geom = next((v for v in values if isinstance(v, Geometry)), None)
+            if geom is None:
+                return None
+            env = geom.envelope()
+            if env is None:
+                return None
+            if transform == "identity":
+                return env
+            return transform.transform_envelope(env)
+        except Exception:
+            return None

@@ -1,0 +1,44 @@
+"""Distributed communication backend: content-addressed object exchange.
+
+The reference's "network stack" is the git smart protocol driven via
+subprocess (kart/cli.py:211-253 pass-through push/fetch, kart/clone.py,
+kart/promisor_utils.py).  Here the same capabilities — clone / fetch / push /
+pull, shallow clone, spatially-filtered partial clone with promisor
+semantics, on-demand promised-blob fetch — are a first-class subsystem built
+on a length-prefixed object packstream (:mod:`kart_tpu.transport.pack`) and a
+want/have reachability negotiation (:mod:`kart_tpu.transport.protocol`).
+
+Remotes are URLs; local filesystem paths (and ``file://``) are fully
+supported (the reference's own test strategy uses local directories as
+remotes, SURVEY.md §4).  Network transports plug in behind the same
+:class:`Transport` interface.
+"""
+
+from kart_tpu.transport.remote import (
+    Remote,
+    RemoteError,
+    add_remote,
+    clone,
+    fetch,
+    fetch_promised_blobs,
+    open_remote,
+    push,
+    remove_remote,
+)
+from kart_tpu.transport.protocol import ObjectEnumerator
+from kart_tpu.transport.pack import read_pack, write_pack
+
+__all__ = [
+    "Remote",
+    "RemoteError",
+    "add_remote",
+    "remove_remote",
+    "clone",
+    "fetch",
+    "push",
+    "fetch_promised_blobs",
+    "open_remote",
+    "ObjectEnumerator",
+    "read_pack",
+    "write_pack",
+]

@@ -1,0 +1,83 @@
+"""kartpack v1 — the wire format for object exchange.
+
+A packstream is a self-delimiting sequence of git-format objects:
+
+    MAGIC ("KARTPACK1\\0")
+    repeated: 1-byte type code | uint32 raw-len | uint32 deflate-len | deflate
+    end record (type code 0) | 32-byte sha256 trailer over everything prior
+
+Unlike git's packfiles there is no delta compression — objects here are
+already small msgpack blobs and zlib handles redundancy well enough; in
+exchange the stream is single-pass writable AND single-pass readable, which
+is what the promisor fetch path wants (reference: `git fetch --stdin`
+pipelining, kart/promisor_utils.py:75-124).
+"""
+
+import hashlib
+import struct
+import zlib
+
+MAGIC = b"KARTPACK1\x00"
+
+_TYPE_TO_CODE = {"commit": 1, "tree": 2, "blob": 3, "tag": 4}
+_CODE_TO_TYPE = {v: k for k, v in _TYPE_TO_CODE.items()}
+_END = 0
+
+
+class PackFormatError(ValueError):
+    pass
+
+
+def write_pack(fileobj, objects):
+    """Stream ``(type_str, content_bytes)`` pairs into fileobj. Returns the
+    number of objects written."""
+    digest = hashlib.sha256()
+
+    def emit(data):
+        digest.update(data)
+        fileobj.write(data)
+
+    emit(MAGIC)
+    count = 0
+    for obj_type, content in objects:
+        code = _TYPE_TO_CODE.get(obj_type)
+        if code is None:
+            raise PackFormatError(f"Unknown object type: {obj_type!r}")
+        deflated = zlib.compress(content, 1)
+        emit(struct.pack(">BII", code, len(content), len(deflated)))
+        emit(deflated)
+        count += 1
+    emit(struct.pack(">BII", _END, 0, 0))
+    fileobj.write(digest.digest())
+    return count
+
+
+def read_pack(fileobj):
+    """Yield ``(type_str, content_bytes)`` from a packstream, verifying the
+    checksum trailer."""
+    digest = hashlib.sha256()
+
+    def pull(n):
+        data = fileobj.read(n)
+        if len(data) != n:
+            raise PackFormatError("Truncated packstream")
+        digest.update(data)
+        return data
+
+    if pull(len(MAGIC)) != MAGIC:
+        raise PackFormatError("Bad packstream magic")
+    while True:
+        code, raw_len, deflate_len = struct.unpack(">BII", pull(9))
+        if code == _END:
+            break
+        obj_type = _CODE_TO_TYPE.get(code)
+        if obj_type is None:
+            raise PackFormatError(f"Bad object type code: {code}")
+        content = zlib.decompress(pull(deflate_len))
+        if len(content) != raw_len:
+            raise PackFormatError("Object length mismatch in packstream")
+        yield obj_type, content
+    expected = digest.digest()
+    trailer = fileobj.read(32)
+    if len(trailer) != 32 or trailer != expected:
+        raise PackFormatError("Packstream checksum mismatch")

@@ -1,0 +1,163 @@
+"""Want/have negotiation: decide which objects to ship.
+
+The sender walks history from the *want* tips, pruning at anything the
+receiver already *has* (the local analog of git's have/want exchange), then
+walks each new commit's tree, pruning whole subtrees the receiver has — the
+same reachability shape `git rev-list --objects A ^B` computes, re-expressed
+over our object store (reference transport: kart/cli.py:211-253).
+
+Two extra axes the reference gets from its forked git:
+
+* **depth** — shallow clone/fetch (`kart clone --depth`, kart/clone.py:72-75):
+  the commit walk is cut N commits below each tip; the cut points are
+  reported as ``shallow_boundary`` for the receiver to record.
+* **blob_filter** — partial clone (`--filter=extension:spatial=…`,
+  vendor/spatial-filter/spatial_filter.cpp:212-260): a callback may veto
+  individual blobs (by path + oid); vetoed blobs are *omitted* and the
+  receiver records the remote as a promisor so later reads raise
+  ObjectPromised instead of hard-failing.
+"""
+
+from kart_tpu.core.odb import ObjectMissing
+
+
+class ObjectEnumerator:
+    """Iterable over the ``(type, content)`` pairs a receiver is missing.
+
+    After iteration, inspect:
+      * ``object_count`` — objects yielded
+      * ``omitted_blob_count`` — blobs vetoed by blob_filter
+      * ``shallow_boundary`` — commit oids shipped without their parents
+      * ``commit_count`` — commits shipped
+    """
+
+    def __init__(
+        self,
+        odb,
+        wants,
+        *,
+        has=None,
+        depth=None,
+        blob_filter=None,
+        sender_shallow=frozenset(),
+    ):
+        self.odb = odb
+        self.wants = list(wants)
+        self.has = has or (lambda oid: False)
+        self.depth = depth
+        self.blob_filter = blob_filter
+        self.sender_shallow = set(sender_shallow)
+
+        self.object_count = 0
+        self.omitted_blob_count = 0
+        self.commit_count = 0
+        self.shallow_boundary = set()
+
+    def __iter__(self):
+        shipped_trees = set()
+        for commit_oid in self._select_commits():
+            obj_type, content = self.odb.read_raw(commit_oid)
+            yield obj_type, content
+            self.object_count += 1
+            self.commit_count += 1
+            tree_oid = self._tree_oid_of(commit_oid)
+            if tree_oid is not None:
+                yield from self._walk_tree(tree_oid, "", shipped_trees)
+
+    # -- commit selection --------------------------------------------------
+
+    def _select_commits(self):
+        """Commit (and tag) oids to ship, newest-first per BFS layer.
+        Tag objects are shipped inline and peeled to their targets."""
+        out = []
+        visited = set()
+        # (oid, depth) — depth counts commits from the tip, tip = 1
+        frontier = []
+        for want in self.wants:
+            peeled = self._peel_want(want, out)
+            if peeled is not None:
+                frontier.append((peeled, 1))
+        while frontier:
+            next_frontier = []
+            for oid, d in frontier:
+                if oid in visited:
+                    continue
+                visited.add(oid)
+                # with an explicit depth, keep walking even through commits
+                # the receiver has — that's how a shallow clone deepens
+                if self.has(oid) and self.depth is None:
+                    continue
+                try:
+                    commit = self.odb.read_commit(oid)
+                except ObjectMissing:
+                    continue  # sender-side shallow/partial boundary
+                if not self.has(oid):
+                    out.append(oid)
+                at_depth_limit = self.depth is not None and d >= self.depth
+                at_sender_boundary = oid in self.sender_shallow
+                if (at_depth_limit or at_sender_boundary) and commit.parents:
+                    self.shallow_boundary.add(oid)
+                    continue
+                for p in commit.parents:
+                    next_frontier.append((p, d + 1))
+            frontier = next_frontier
+        return out
+
+    def _peel_want(self, oid, out):
+        """Resolve a want tip to a commit oid; tag objects along the way are
+        appended to ``out`` for shipping."""
+        while True:
+            if self.has(oid) and self.depth is None:
+                return None  # with depth set, keep walking (deepening fetch)
+            try:
+                obj_type, content = self.odb.read_raw(oid)
+            except ObjectMissing:
+                return None
+            if obj_type == "commit":
+                return oid
+            if obj_type == "tag":
+                from kart_tpu.core.objects import Tag
+
+                out.append(oid)
+                oid = Tag.parse(content).target
+                continue
+            # tree/blob want (unusual): ship nothing here; tree walk covers it
+            return None
+
+    def _tree_oid_of(self, commit_oid):
+        try:
+            return self.odb.read_commit(commit_oid).tree
+        except ObjectMissing:
+            return None
+
+    # -- tree walk ---------------------------------------------------------
+
+    def _walk_tree(self, tree_oid, prefix, shipped):
+        if tree_oid in shipped or self.has(tree_oid):
+            return
+        shipped.add(tree_oid)
+        try:
+            entries = self.odb.read_tree_entries(tree_oid)
+            _, content = self.odb.read_raw(tree_oid)
+        except ObjectMissing:
+            return
+        yield "tree", content
+        self.object_count += 1
+        for e in entries:
+            path = f"{prefix}{e.name}"
+            if e.is_tree:
+                yield from self._walk_tree(e.oid, path + "/", shipped)
+            else:
+                if e.oid in shipped or self.has(e.oid):
+                    continue
+                if self.blob_filter is not None and not self.blob_filter(path, e.oid):
+                    self.omitted_blob_count += 1
+                    continue
+                shipped.add(e.oid)
+                try:
+                    _, blob = self.odb.read_raw(e.oid)
+                except ObjectMissing:
+                    self.omitted_blob_count += 1
+                    continue
+                yield "blob", blob
+                self.object_count += 1

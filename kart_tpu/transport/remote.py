@@ -1,0 +1,445 @@
+"""Remote operations: clone / fetch / push / pull over local-path remotes.
+
+The reference delegates these verbs to its forked git via execvp
+(kart/cli.py:211-253) and layers kart semantics (spatial-filtered partial
+clone, promisor fetch) on top (kart/clone.py, kart/repo.py:269-343,
+kart/promisor_utils.py).  Here they are native: a remote is any URL
+``open_remote`` can turn into an object store + ref store; local directories
+and ``file://`` URLs are the built-in transport (exactly what the
+reference's own tests use as remotes, SURVEY.md §4).
+
+Every transfer — even store-to-store on one machine — is routed through the
+kartpack wire format, so the byte path is the same one a network transport
+would use.
+"""
+
+import os
+import tempfile
+
+from kart_tpu.core.odb import ObjectMissing
+from kart_tpu.core.repo import KartRepo, KartConfigKeys, NotFound
+from kart_tpu.transport.pack import read_pack, write_pack
+from kart_tpu.transport.protocol import ObjectEnumerator
+
+SHALLOW_FILE = "shallow"
+
+
+class RemoteError(ValueError):
+    pass
+
+
+class Remote:
+    """A named remote from repo config (remote.<name>.*)."""
+
+    def __init__(self, repo, name):
+        self.repo = repo
+        self.name = name
+
+    @property
+    def url(self):
+        url = self.repo.config.get(f"remote.{self.name}.url")
+        if url is None:
+            raise RemoteError(f"No such remote: {self.name!r}")
+        return url
+
+    @property
+    def is_promisor(self):
+        return self.repo.config.get_bool(f"remote.{self.name}.promisor")
+
+    @property
+    def partial_clone_filter(self):
+        return self.repo.config.get(f"remote.{self.name}.partialclonefilter")
+
+    def open(self) -> KartRepo:
+        return open_remote(self.url)
+
+
+def open_remote(url) -> KartRepo:
+    """Resolve a remote URL to a repository. Local paths + file:// today;
+    other schemes would add Transport implementations here."""
+    if url.startswith("file://"):
+        url = url[len("file://") :]
+    if "://" in url:
+        raise RemoteError(
+            f"Unsupported remote URL scheme: {url!r} (local paths / file:// only)"
+        )
+    try:
+        repo = KartRepo(url)
+    except NotFound:
+        raise RemoteError(f"Remote repository not found: {url!r}")
+    # the URL must BE the repo, not merely live inside one — KartRepo's
+    # parent-directory search must not silently resolve a bad remote path to
+    # whatever repo happens to enclose it
+    target = os.path.realpath(url)
+    if os.path.realpath(repo.workdir or repo.gitdir) != target:
+        raise RemoteError(f"Remote repository not found: {url!r}")
+    return repo
+
+
+def normalise_url(url):
+    """Local-path URLs are stored absolute, so the remote resolves no matter
+    what directory later commands run from."""
+    if url.startswith("file://") or "://" in url:
+        return url
+    return os.path.abspath(url)
+
+
+def add_remote(repo, name, url):
+    if repo.config.get(f"remote.{name}.url") is not None:
+        raise RemoteError(f"Remote {name!r} already exists")
+    repo.config.set_many(
+        {
+            f"remote.{name}.url": normalise_url(url),
+            f"remote.{name}.fetch": f"+refs/heads/*:refs/remotes/{name}/*",
+        }
+    )
+
+
+def remove_remote(repo, name):
+    import shutil
+
+    if repo.config.get(f"remote.{name}.url") is None:
+        raise RemoteError(f"No such remote: {name!r}")
+    for key in list(repo.config.keys(f"remote.{name}.")):
+        del repo.config[key]
+    # remove the whole tracking-ref directory (iter_refs skips symref files
+    # like refs/remotes/<name>/HEAD, so per-ref deletion would leave it)
+    shutil.rmtree(
+        os.path.join(repo.gitdir, "refs", "remotes", name), ignore_errors=True
+    )
+
+
+# -- shallow bookkeeping ---------------------------------------------------
+
+
+def read_shallow(repo):
+    content = repo.read_gitdir_file(SHALLOW_FILE)
+    if not content:
+        return set()
+    return {line.strip() for line in content.splitlines() if line.strip()}
+
+
+def write_shallow(repo, oids):
+    if oids:
+        repo.write_gitdir_file(SHALLOW_FILE, "".join(o + "\n" for o in sorted(oids)))
+    else:
+        repo.remove_gitdir_file(SHALLOW_FILE)
+
+
+def _update_shallow(repo, new_boundary):
+    """Recompute the shallow file after a transfer: a commit is shallow iff
+    any of its parents is still absent — so a deepening fetch un-shallows
+    commits whose parents just arrived."""
+    candidates = read_shallow(repo) | set(new_boundary)
+    if not candidates:
+        return
+    still_shallow = set()
+    for oid in candidates:
+        try:
+            parents = repo.odb.read_commit(oid).parents
+        except ObjectMissing:
+            continue  # the boundary commit itself is gone; drop the entry
+        if any(not repo.odb.contains(p) for p in parents):
+            still_shallow.add(oid)
+    write_shallow(repo, still_shallow)
+
+
+# -- the wire --------------------------------------------------------------
+
+
+def _transfer(src_odb, dst_odb, wants, *, depth=None, blob_filter=None, sender_shallow=frozenset()):
+    """Ship objects reachable from wants (minus what dst has) src→dst through
+    a kartpack stream. Returns the ObjectEnumerator (for counts/boundary)."""
+    enum = ObjectEnumerator(
+        src_odb,
+        wants,
+        has=dst_odb.contains,
+        depth=depth,
+        blob_filter=blob_filter,
+        sender_shallow=sender_shallow,
+    )
+    with tempfile.SpooledTemporaryFile(max_size=64 * 1024 * 1024) as wire:
+        write_pack(wire, iter(enum))
+        wire.seek(0)
+        for obj_type, content in read_pack(wire):
+            dst_odb.write_raw(obj_type, content)
+    return enum
+
+
+# -- fetch -----------------------------------------------------------------
+
+
+def fetch(repo, remote_name="origin", *, depth=None, blob_filter=None, quiet=True):
+    """Fetch all branches + tags from the remote into refs/remotes/<name>/*.
+    Returns {local_ref: oid} of updated refs."""
+    remote = Remote(repo, remote_name)
+    src = remote.open()
+
+    wants = []
+    branch_tips = {}  # branch name -> oid
+    tag_tips = {}
+    for ref, oid in src.refs.iter_refs("refs/heads/"):
+        branch_tips[ref[len("refs/heads/") :]] = oid
+        wants.append(oid)
+    for ref, oid in src.refs.iter_refs("refs/tags/"):
+        tag_tips[ref[len("refs/tags/") :]] = oid
+        wants.append(oid)
+
+    if blob_filter is None and remote.is_promisor:
+        # re-fetch from a promisor remote keeps filtering (reference:
+        # remote.*.partialclonefilter persists after clone)
+        blob_filter = _configured_blob_filter(repo, remote, src)
+
+    enum = _transfer(
+        src.odb,
+        repo.odb,
+        wants,
+        depth=depth,
+        blob_filter=blob_filter,
+        sender_shallow=read_shallow(src),
+    )
+
+    updated = {}
+    for branch, oid in branch_tips.items():
+        local_ref = f"refs/remotes/{remote_name}/{branch}"
+        if repo.refs.get(local_ref) != oid:
+            repo.refs.set(local_ref, oid, log_message=f"fetch {remote_name}")
+            updated[local_ref] = oid
+    for tag, oid in tag_tips.items():
+        local_ref = f"refs/tags/{tag}"
+        if repo.refs.get(local_ref) is None:
+            repo.refs.set(local_ref, oid, log_message=f"fetch {remote_name}")
+            updated[local_ref] = oid
+
+    _update_shallow(repo, enum.shallow_boundary)
+
+    # remote HEAD symref, so clone knows the default branch
+    kind, target = src.refs.head_target()
+    if kind == "symbolic" and target.startswith("refs/heads/"):
+        head_path = os.path.join(
+            repo.gitdir, "refs", "remotes", remote_name, "HEAD"
+        )
+        os.makedirs(os.path.dirname(head_path), exist_ok=True)
+        with open(head_path, "w") as f:
+            f.write(
+                f"ref: refs/remotes/{remote_name}/{target[len('refs/heads/'):]}\n"
+            )
+    return updated
+
+
+def _configured_blob_filter(repo, remote, src):
+    spec = remote.partial_clone_filter
+    if not spec or not spec.startswith("extension:spatial="):
+        return None
+    from kart_tpu.spatial_filter import blob_filter_for_spec
+
+    return blob_filter_for_spec(src, spec[len("extension:spatial=") :])
+
+
+# -- push ------------------------------------------------------------------
+
+
+def parse_refspec(repo, refspec):
+    """'+src:dst' / 'src:dst' / 'src' / ':dst'(delete) -> (src, dst, force)."""
+    force = refspec.startswith("+")
+    if force:
+        refspec = refspec[1:]
+    src, sep, dst = refspec.partition(":")
+    if not sep:
+        dst = src
+    return src or None, dst or src, force
+
+
+def push(repo, remote_name="origin", refspecs=(), *, force=False, set_upstream=False):
+    """Push refs to the remote. Default: current branch to its same name.
+    Returns {remote_ref: oid}."""
+    remote = Remote(repo, remote_name)
+    dst = remote.open()
+
+    if not refspecs:
+        branch = repo.refs.head_branch()
+        if branch is None:
+            raise RemoteError("Cannot push: HEAD is detached and no refspec given")
+        refspecs = [f"{branch}:{branch}"]
+
+    updated = {}
+    for spec in refspecs:
+        src_name, dst_name, spec_force = parse_refspec(repo, spec)
+        spec_force = spec_force or force
+        dst_ref = (
+            dst_name if dst_name.startswith("refs/") else f"refs/heads/{dst_name}"
+        )
+
+        if src_name is None:  # delete
+            if dst.refs.get(dst_ref) is None:
+                raise RemoteError(f"Remote ref does not exist: {dst_ref}")
+            dst.refs.delete(dst_ref)
+            updated[dst_ref] = None
+            continue
+
+        src_ref = (
+            src_name if src_name.startswith("refs/") else f"refs/heads/{src_name}"
+        )
+        new_oid = repo.refs.get(src_ref)
+        if new_oid is None:
+            try:
+                new_oid = repo.resolve_refish(src_name)[0]
+            except NotFound:
+                new_oid = None
+        if new_oid is None:
+            raise RemoteError(f"Unknown ref to push: {src_name!r}")
+
+        old_oid = dst.refs.get(dst_ref)
+        if old_oid and not spec_force:
+            # fast-forward check: remote tip must be known + an ancestor
+            if not repo.odb.contains(old_oid) or not repo.is_ancestor(
+                old_oid, new_oid
+            ):
+                raise RemoteError(
+                    f"Push to {dst_ref} rejected (non-fast-forward); "
+                    "fetch first or use --force"
+                )
+
+        enum = _transfer(
+            repo.odb, dst.odb, [new_oid], sender_shallow=read_shallow(repo)
+        )
+        # pushing from a shallow clone truncates the remote's history too —
+        # record the boundary there so its walkers know it's deliberate
+        _update_shallow(dst, enum.shallow_boundary)
+        dst.refs.set(dst_ref, new_oid, log_message=f"push from {repo.gitdir}")
+        updated[dst_ref] = new_oid
+
+        # mirror into our remote-tracking ref
+        if dst_ref.startswith("refs/heads/"):
+            track = f"refs/remotes/{remote_name}/{dst_ref[len('refs/heads/'):]}"
+            repo.refs.set(track, new_oid, log_message="update by push")
+            if set_upstream and src_ref.startswith("refs/heads/"):
+                b = src_ref[len("refs/heads/") :]
+                repo.config.set_many(
+                    {
+                        f"branch.{b}.remote": remote_name,
+                        f"branch.{b}.merge": dst_ref,
+                    }
+                )
+    return updated
+
+
+# -- clone -----------------------------------------------------------------
+
+
+def clone(
+    url,
+    directory,
+    *,
+    bare=False,
+    depth=None,
+    spatial_filter_spec=None,
+    wc_location=None,
+    do_checkout=True,
+    branch=None,
+):
+    """Clone a repository. spatial_filter_spec (a ResolvedSpatialFilterSpec
+    or None) makes this a filtered partial clone: non-matching feature blobs
+    stay on the server, the remote becomes a promisor, and later reads of
+    missing features fetch on demand (reference: kart/clone.py:108-153,
+    kart/repo.py:269-343)."""
+    directory = os.path.abspath(directory)
+    repo = KartRepo.init_repository(directory, bare=bare)
+    try:
+        add_remote(repo, "origin", url)
+        src = open_remote(url)
+
+        blob_filter = None
+        if spatial_filter_spec is not None:
+            from kart_tpu.spatial_filter import blob_filter_for_spec
+
+            blob_filter = blob_filter_for_spec(
+                src, spatial_filter_spec.envelope_wsen_4326
+            )
+            repo.config.set_many(
+                {
+                    "remote.origin.promisor": "true",
+                    "remote.origin.partialclonefilter": "extension:spatial="
+                    + spatial_filter_spec.filter_arg,
+                    **spatial_filter_spec.config_items(),
+                }
+            )
+
+        fetch(repo, "origin", depth=depth, blob_filter=blob_filter)
+
+        # pick the branch to check out: requested, remote HEAD, or first
+        if branch is None:
+            kind, target = src.refs.head_target()
+            if kind == "symbolic" and target.startswith("refs/heads/"):
+                branch = target[len("refs/heads/") :]
+        if branch is None:
+            heads = [r for r, _ in repo.refs.iter_refs("refs/remotes/origin/")]
+            branch = heads[0].split("/")[-1] if heads else "main"
+
+        tip = repo.refs.get(f"refs/remotes/origin/{branch}")
+        if tip is not None:
+            repo.refs.set(f"refs/heads/{branch}", tip, log_message="clone")
+            repo.config.set_many(
+                {
+                    f"branch.{branch}.remote": "origin",
+                    f"branch.{branch}.merge": f"refs/heads/{branch}",
+                }
+            )
+        repo.refs.set_head(f"refs/heads/{branch}", log_message="clone")
+
+        if not bare and tip is not None and do_checkout:
+            from kart_tpu.workingcopy import default_location, get_working_copy
+
+            repo.config[
+                KartConfigKeys.KART_WORKINGCOPY_LOCATION
+            ] = wc_location or default_location(repo)
+            wc = get_working_copy(repo, allow_uncreated=True)
+            if wc is not None:
+                wc.create_and_initialise()
+                structure = repo.structure("HEAD")
+                wc.write_full(structure, *structure.datasets)
+        return repo
+    except BaseException:
+        import shutil
+
+        shutil.rmtree(repo.gitdir, ignore_errors=True)
+        raise
+
+
+# -- promisor fetch --------------------------------------------------------
+
+
+def fetch_promised_blobs(repo, oids):
+    """Backfill promised blobs from the promisor remote (reference:
+    FetchPromisedBlobsProcess, kart/promisor_utils.py:75-124). Returns the
+    number fetched."""
+    oids = [o for o in oids if not repo.odb.contains(o)]
+    if not oids:
+        return 0
+    promisor = None
+    for name in repo.remotes():
+        if repo.config.get_bool(f"remote.{name}.promisor"):
+            promisor = Remote(repo, name)
+            break
+    if promisor is None:
+        raise RemoteError("No promisor remote configured")
+    src = promisor.open()
+    fetched = 0
+    with tempfile.SpooledTemporaryFile(max_size=64 * 1024 * 1024) as wire:
+
+        def pull():
+            for oid in oids:
+                try:
+                    yield src.odb.read_raw(oid)
+                except ObjectMissing:
+                    raise RemoteError(
+                        f"Promisor remote {promisor.name!r} is missing promised "
+                        f"object {oid}"
+                    )
+
+        write_pack(wire, pull())
+        wire.seek(0)
+        for obj_type, content in read_pack(wire):
+            repo.odb.write_raw(obj_type, content)
+            fetched += 1
+    return fetched

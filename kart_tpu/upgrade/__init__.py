@@ -1,0 +1,195 @@
+"""Repo format upgrade: rewrite history from older dataset versions to V3
+(reference: kart/upgrade/__init__.py).
+
+Two modes, mirroring the reference:
+
+* **Full rewrite** (`kart upgrade SOURCE DEST`): walk every commit reachable
+  from any ref in topological order (parents first), re-encode each dataset
+  into Datasets V3 layout, and create a mapped commit in a new repo
+  (reference: upgrade/__init__.py:104-199).
+* **In-place V2→V3** (`kart upgrade --in-place`): V2 and V3 feature blobs
+  have identical *content* (same msgpack encoding) — only tree paths and the
+  dataset dirname differ — so the rewrite reuses every feature blob by
+  content-address and only writes new trees/commits
+  (reference: upgrade/__init__.py:69-90, InPlaceUpgradeSourceDataset2).
+"""
+
+import logging
+
+from kart_tpu.core.repo import DEFAULT_BRANCH, KartRepo, InvalidOperation
+from kart_tpu.core.structure import RepoStructure
+from kart_tpu.core.tree_builder import TreeBuilder
+from kart_tpu.models.dataset import Dataset3, dataset_class_for_version
+from kart_tpu.models.paths import PathEncoder, encoder_for_schema
+
+L = logging.getLogger(__name__)
+
+
+class UpgradeError(InvalidOperation):
+    pass
+
+
+def upgrade_repo(source_path, dest_path, *, progress=None):
+    """Rewrite SOURCE (repo version 2) into a brand-new V3 repo at DEST.
+    Returns (dest_repo, commit_map {old_oid: new_oid})."""
+    src = KartRepo(source_path)
+    src_version = src.version
+    if src_version == 3:
+        raise UpgradeError("Repository is already repo structure version 3")
+    dataset_class_for_version(src_version)  # raises for unsupported versions
+
+    dest = KartRepo.init_repository(dest_path, bare=False)
+    dest.config["kart.repostructure.version"] = "3"
+
+    commit_map = _rewrite_history(src, dest, progress=progress)
+    _map_refs(src, dest, commit_map)
+    return dest, commit_map
+
+
+def upgrade_in_place(repo, *, progress=None):
+    """Upgrade a V2 repo to V3 in its own object store. Feature blob content
+    is shared between versions, so only trees + commits are rewritten.
+    Returns the commit map."""
+    if repo.version == 3:
+        raise UpgradeError("Repository is already repo structure version 3")
+    commit_map = _rewrite_history(repo, repo, progress=progress)
+    _map_refs(repo, repo, commit_map, in_place=True)
+    repo.config["kart.repostructure.version"] = "3"
+    return commit_map
+
+
+def _rewrite_history(src, dest, *, progress=None):
+    """Topological walk + per-commit tree re-encode. src and dest may be the
+    same repo (in-place)."""
+    src_class = dataset_class_for_version(src.version)
+    tips = {oid for _, oid in src.refs.iter_refs("refs/")}
+    head = src.refs.head_resolved()
+    if head:
+        tips.add(head)
+    if not tips:
+        raise UpgradeError("Nothing to upgrade: repository has no commits")
+
+    commit_map = {}
+    tree_map = {}  # old tree oid -> new tree oid (dedup across commits)
+    order = src.topo_commits(tips)
+    for i, old_oid in enumerate(order):
+        commit = src.odb.read_commit(old_oid)
+        new_tree = tree_map.get(commit.tree)
+        if new_tree is None:
+            new_tree = _upgrade_tree(src, dest, old_oid, src_class)
+            tree_map[commit.tree] = new_tree
+        new_commit = type(commit)(
+            tree=new_tree,
+            parents=tuple(commit_map[p] for p in commit.parents if p in commit_map),
+            author=commit.author,
+            committer=commit.committer,
+            message=commit.message,
+        )
+        commit_map[old_oid] = dest.odb.write_commit(new_commit)
+        if progress:
+            progress(i + 1, len(order))
+        else:
+            L.info("upgraded commit %d/%d", i + 1, len(order))
+    return commit_map
+
+
+def _upgrade_tree(src, dest, commit_oid, src_class):
+    """Re-encode every dataset of one commit into a V3 tree; non-dataset
+    blobs (attachments) are carried over as-is."""
+    structure = RepoStructure(src, commit_oid)
+    tb = TreeBuilder(dest.odb)
+
+    # carry over non-dataset top-level items (attachments, LICENSE etc.)
+    root = src.odb.tree(src.odb.read_commit(commit_oid).tree)
+    _copy_non_dataset_items(src, dest, root, "", tb, src_class)
+
+    for ds in structure.datasets:
+        _upgrade_dataset(ds, dest, tb)
+
+    # version marker blob, for reference-format parity
+    # (reference: kart/repo_version.py:13-30)
+    tb.insert(".kart.repostructure.version", dest.odb.write_blob(b"3\n"))
+    return tb.flush()
+
+
+def _copy_non_dataset_items(src, dest, tree, prefix, tb, src_class):
+    """Carry over everything except dataset inner trees (which are
+    re-encoded) — attachments at any depth survive the rewrite."""
+    for entry in tree.entries():
+        path = f"{prefix}{entry.name}"
+        if entry.name == src_class.DATASET_DIRNAME or entry.name == ".kart.repostructure.version":
+            continue  # re-encoded separately
+        if entry.is_tree:
+            _copy_non_dataset_items(
+                src, dest, src.odb.tree(entry.oid), path + "/", tb, src_class
+            )
+        else:
+            if src is not dest:
+                dest.odb.write_raw(*src.odb.read_raw(entry.oid))
+            tb.insert(path, entry.oid)
+
+
+def _upgrade_dataset(ds, dest, tb):
+    """One dataset of one commit -> V3 blobs through the tree builder."""
+    schema = ds.schema
+    meta_blobs = Dataset3.new_dataset_meta_blobs(
+        ds.path,
+        schema,
+        title=ds.get_meta_item("title"),
+        description=ds.get_meta_item("description"),
+        crs_defs={
+            ident: ds.get_crs_definition(ident) for ident in ds.crs_identifiers()
+        },
+        path_encoder=encoder_for_schema(schema),
+    )
+    for path, data in meta_blobs:
+        tb.insert(path, dest.odb.write_blob(data))
+
+    v3 = _V3Encoder(ds.path, schema)
+    prefix = f"{v3.inner_path}/{Dataset3.FEATURE_PATH}"
+    enc = v3.path_encoder
+    # feature blob content is version-invariant: reuse the blob oid, only
+    # re-path it (the in-place fast path; for cross-repo the blob is copied)
+    for old_rel, entry in ds.feature_tree.walk_blobs() if ds.feature_tree else ():
+        pk_values = ds.decode_path_to_pks(old_rel)
+        if dest.odb is not ds.tree.odb:
+            dest.odb.write_raw(*ds.tree.odb.read_raw(entry.oid))
+        tb.insert(prefix + enc.encode_pks_to_path(pk_values), entry.oid)
+
+
+class _V3Encoder:
+    """Just enough of a Dataset3 to compute V3 paths for a schema."""
+
+    def __init__(self, path, schema):
+        self.inner_path = f"{path}/{Dataset3.DATASET_DIRNAME}"
+        self.path_encoder = encoder_for_schema(schema)
+
+
+def _map_refs(src, dest, commit_map, *, in_place=False):
+    for ref, oid in list(src.refs.iter_refs("refs/")):
+        if ref.startswith("refs/remotes/"):
+            continue
+        new_oid = commit_map.get(oid)
+        if new_oid is None and src.odb.object_type(oid) == "tag":
+            # annotated tag: rewrite pointing at the mapped commit
+            tag = src.odb.read_tag(oid)
+            target = commit_map.get(tag.target)
+            if target is not None:
+                tag = type(tag)(
+                    target=target,
+                    target_type=tag.target_type,
+                    name=tag.name,
+                    tagger=tag.tagger,
+                    message=tag.message,
+                )
+                new_oid = dest.odb.write_raw("tag", tag.serialise())
+        if new_oid is not None:
+            dest.refs.set(ref, new_oid, log_message="upgrade to V3")
+    # HEAD: keep the same branch name
+    kind, target = src.refs.head_target()
+    if kind == "symbolic":
+        dest.refs.set_head(target, log_message="upgrade to V3")
+    else:
+        mapped = commit_map.get(target)
+        if mapped:
+            dest.refs.set_head(mapped, log_message="upgrade to V3")

@@ -1,0 +1,192 @@
+"""Spatial filter: spec parsing, per-dataset matching, envelope index
+(reference: tests/test_spatial_filter.py + test_spatial_filter_index.py)."""
+
+import pytest
+
+from kart_tpu.spatial_filter import (
+    MatchResult,
+    ResolvedSpatialFilterSpec,
+    SpatialFilter,
+    SpatialFilterError,
+    _rect_overlaps,
+)
+from kart_tpu.spatial_filter.index import (
+    EnvelopeIndexReader,
+    update_spatial_filter_index,
+)
+
+from helpers import edit_commit, make_imported_repo
+
+POLY_100_105 = "POLYGON((100 -42, 105.5 -42, 105.5 -39, 100 -39, 100 -42))"
+
+
+class TestSpecParsing:
+    def test_crs_and_wkt(self):
+        spec = ResolvedSpatialFilterSpec.from_spec_string(
+            f"EPSG:4326;{POLY_100_105}"
+        )
+        assert not spec.match_all
+        w, s, e, n = spec.envelope_wsen_4326
+        assert (w, s, e, n) == (100.0, -42.0, 105.5, -39.0)
+        assert spec.filter_arg.startswith("100.0000000,-42.0000000,")
+
+    def test_from_file(self, tmp_path):
+        f = tmp_path / "filter.txt"
+        f.write_text(f"EPSG:4326;{POLY_100_105}")
+        spec = ResolvedSpatialFilterSpec.from_spec_string(f"@{f}")
+        assert spec.envelope_wsen_4326[0] == 100.0
+
+    def test_none_is_match_all(self):
+        assert ResolvedSpatialFilterSpec.from_spec_string("none").match_all
+        assert ResolvedSpatialFilterSpec.from_spec_string("").match_all
+
+    def test_bad_spec(self):
+        with pytest.raises(SpatialFilterError):
+            ResolvedSpatialFilterSpec.from_spec_string("no-semicolon-here")
+
+    def test_non_polygon_rejected(self):
+        from kart_tpu.geometry import GeometryError
+
+        with pytest.raises(GeometryError):
+            ResolvedSpatialFilterSpec.from_spec_string("EPSG:4326;POINT(1 2)")
+
+    def test_config_items_roundtrip(self):
+        spec = ResolvedSpatialFilterSpec.from_spec_string(
+            f"EPSG:4326;{POLY_100_105}"
+        )
+        items = spec.config_items()
+        assert items["kart.spatialfilter.crs"] == "EPSG:4326"
+        assert "POLYGON" in items["kart.spatialfilter.geometry"]
+
+
+class TestRectOverlaps:
+    def test_basic(self):
+        # env: (min-x, max-x, min-y, max-y); rect: (w, e, s, n)
+        assert _rect_overlaps((0, 10, 0, 10), (5, 15, 5, 15))
+        assert not _rect_overlaps((0, 10, 0, 10), (11, 15, 0, 10))
+        assert not _rect_overlaps((0, 10, 0, 10), (0, 10, 11, 15))
+
+    def test_antimeridian_rect(self):
+        # rect from 170 to -170 crossing the anti-meridian
+        assert _rect_overlaps((175, 176, 0, 1), (170, -170, -5, 5))
+        assert _rect_overlaps((-176, -175, 0, 1), (170, -170, -5, 5))
+        assert not _rect_overlaps((0, 10, 0, 1), (170, -170, -5, 5))
+
+    def test_antimeridian_env(self):
+        assert _rect_overlaps((170, -170, 0, 1), (160, 175, -5, 5))
+        assert _rect_overlaps((170, -170, 0, 1), (-175, -160, -5, 5))
+
+
+class TestDatasetFilter:
+    @pytest.fixture()
+    def repo_ds(self, tmp_path):
+        repo, ds_path = make_imported_repo(tmp_path, n=10)
+        return repo, repo.datasets("HEAD")[ds_path]
+
+    def test_matches_features(self, repo_ds):
+        repo, ds = repo_ds
+        spec = ResolvedSpatialFilterSpec("EPSG:4326", POLY_100_105)
+        sf = spec.resolve_for_dataset(ds)
+        assert sf  # not match-all
+        # points are at x = 100 + fid
+        assert sf.match_result(ds.get_feature([3])) is MatchResult.MATCHED
+        assert sf.match_result(ds.get_feature([9])) is MatchResult.NOT_MATCHED
+
+    def test_null_geometry_matches(self, repo_ds):
+        _, ds = repo_ds
+        spec = ResolvedSpatialFilterSpec("EPSG:4326", POLY_100_105)
+        sf = spec.resolve_for_dataset(ds)
+        feature = dict(ds.get_feature([9]))
+        feature["geom"] = None
+        assert sf.match_result(feature) is MatchResult.MATCHED
+
+    def test_match_all_spec(self, repo_ds):
+        _, ds = repo_ds
+        spec = ResolvedSpatialFilterSpec(None, None, match_all=True)
+        assert spec.resolve_for_dataset(ds) is SpatialFilter.MATCH_ALL
+
+    def test_polygon_exactness(self, repo_ds):
+        """A feature inside the filter's bbox but outside the polygon itself
+        is excluded (the triangle covers the bbox's lower-left half)."""
+        _, ds = repo_ds
+        triangle = "POLYGON((100 -42, 106 -42, 100 -39, 100 -42))"
+        spec = ResolvedSpatialFilterSpec("EPSG:4326", triangle)
+        sf = spec.resolve_for_dataset(ds)
+        # fid=1 at (101, -40.1): inside triangle (left edge region)
+        assert sf.match_result(ds.get_feature([1])) is MatchResult.MATCHED
+        # fid=5 at (105, -40.5): inside bbox, outside the hypotenuse
+        assert sf.match_result(ds.get_feature([5])) is MatchResult.NOT_MATCHED
+
+
+class TestEnvelopeIndex:
+    def test_build_and_lookup(self, tmp_path):
+        repo, ds_path = make_imported_repo(tmp_path, n=10)
+        n_features, n_commits = update_spatial_filter_index(repo)
+        assert n_commits == 1
+        assert n_features == 10
+
+        reader = EnvelopeIndexReader.open(repo)
+        assert reader is not None
+        assert reader.count() == 10
+
+        ds = repo.datasets("HEAD")[ds_path]
+        path = ds.encode_1pk_to_path(4, relative=True)  # 'feature/...'
+        oid = ds.inner_tree.get(path).oid
+        env = reader.get(oid)
+        assert env is not None
+        w, s, e, n = env
+        # point at (104, -40.4); stored envelope contains it with <1e-3 slack
+        assert w <= 104.0 <= e and s <= -40.4 <= n
+        assert e - w < 0.01 and n - s < 0.01
+
+    def test_incremental(self, tmp_path):
+        repo, ds_path = make_imported_repo(tmp_path, n=10)
+        update_spatial_filter_index(repo)
+        edit_commit(
+            repo,
+            ds_path,
+            inserts=[
+                {
+                    "fid": 11,
+                    "geom": None,
+                    "name": "no-geom",
+                    "rating": 0.0,
+                }
+            ],
+            message="insert",
+        )
+        n_features, n_commits = update_spatial_filter_index(repo)
+        assert n_commits == 1  # only the new commit
+        # the new feature has no geometry -> nothing new to index
+        assert n_features == 0
+        # re-run: fully up to date
+        assert update_spatial_filter_index(repo) == (0, 0)
+
+    def test_all_envelopes_batch(self, tmp_path):
+        repo, _ = make_imported_repo(tmp_path, n=10)
+        update_spatial_filter_index(repo)
+        reader = EnvelopeIndexReader.open(repo)
+        oids, wsen = reader.all_envelopes()
+        assert len(oids) == 10
+        assert wsen.shape == (10, 4)
+        # all points are within x 101..110, y -41..-40.1
+        assert wsen[:, 0].min() >= 100.9 and wsen[:, 2].max() <= 110.1
+
+
+def test_cli_spatial_filter_commands(tmp_path, monkeypatch):
+    from click.testing import CliRunner
+
+    from kart_tpu.cli import cli
+
+    repo, _ = make_imported_repo(tmp_path, n=10)
+    monkeypatch.chdir(repo.workdir)
+    runner = CliRunner()
+    r = runner.invoke(cli, ["spatial-filter", "index"])
+    assert r.exit_code == 0, r.output
+    assert "Indexed 10 feature envelopes" in r.output
+
+    r = runner.invoke(
+        cli, ["spatial-filter", "resolve", f"EPSG:4326;{POLY_100_105}"]
+    )
+    assert r.exit_code == 0, r.output
+    assert "100.0000000,-42.0000000,105.5000000,-39.0000000" in r.output

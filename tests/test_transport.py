@@ -1,0 +1,262 @@
+"""Transport: pack format, clone/fetch/push/pull, shallow + filtered partial
+clone, promisor fetch (reference behaviors: kart/clone.py, kart/cli.py:211-253,
+kart/promisor_utils.py; tested against local-directory remotes exactly like
+the reference's own test suite, SURVEY.md §4)."""
+
+import io
+
+import pytest
+
+from kart_tpu import transport
+from kart_tpu.core.odb import ObjectMissing, ObjectPromised
+from kart_tpu.core.repo import KartRepo
+from kart_tpu.transport.pack import PackFormatError, read_pack, write_pack
+from kart_tpu.transport.remote import RemoteError, read_shallow
+
+from helpers import edit_commit, make_imported_repo
+
+
+@pytest.fixture()
+def source_repo(tmp_path):
+    repo, ds_path = make_imported_repo(tmp_path, n=10)
+    edit_commit(
+        repo,
+        ds_path,
+        updates=[{"fid": 1, "geom": None, "name": "renamed", "rating": 9.0}],
+        message="second commit",
+    )
+    return repo, ds_path
+
+
+def test_pack_roundtrip():
+    objects = [
+        ("blob", b"hello"),
+        ("commit", b"tree abc\n\nmsg\n"),
+        ("tree", b""),
+    ]
+    buf = io.BytesIO()
+    assert write_pack(buf, iter(objects)) == 3
+    buf.seek(0)
+    assert list(read_pack(buf)) == objects
+
+
+def test_pack_detects_corruption():
+    buf = io.BytesIO()
+    write_pack(buf, [("blob", b"data")])
+    raw = bytearray(buf.getvalue())
+    raw[len(raw) // 2] ^= 0xFF
+    with pytest.raises((PackFormatError, Exception)):
+        list(read_pack(io.BytesIO(bytes(raw))))
+
+
+def test_clone_full(source_repo, tmp_path):
+    repo, ds_path = source_repo
+    clone = transport.clone(
+        repo.workdir, tmp_path / "clone", do_checkout=False
+    )
+    assert clone.head_commit_oid == repo.head_commit_oid
+    # full object transfer: every feature readable
+    ds = clone.datasets("HEAD")[ds_path]
+    features = list(ds.features())
+    assert len(features) == 10
+    assert clone.refs.get("refs/remotes/origin/main") == repo.head_commit_oid
+    # history came over
+    assert len(list(clone.walk_commits(clone.head_commit_oid))) == 2
+
+
+def test_clone_sets_upstream_config(source_repo, tmp_path):
+    repo, _ = source_repo
+    clone = transport.clone(repo.workdir, tmp_path / "clone", do_checkout=False)
+    assert clone.config.get("branch.main.remote") == "origin"
+    assert clone.config.get("remote.origin.url") == repo.workdir
+
+
+def test_fetch_updates_remote_refs(source_repo, tmp_path):
+    repo, ds_path = source_repo
+    clone = transport.clone(repo.workdir, tmp_path / "clone", do_checkout=False)
+    # source advances
+    new_oid = edit_commit(
+        repo, ds_path, deletes=[2], message="delete feature 2"
+    )
+    updated = transport.fetch(clone, "origin")
+    assert updated.get("refs/remotes/origin/main") == new_oid
+    assert clone.odb.contains(new_oid)
+    # local branch untouched (fetch is not pull)
+    assert clone.head_commit_oid != new_oid
+
+
+def test_push_fast_forward(source_repo, tmp_path):
+    repo, ds_path = source_repo
+    clone = transport.clone(repo.workdir, tmp_path / "clone", do_checkout=False)
+    clone.config.set_many({"user.name": "Cloner", "user.email": "c@example.com"})
+    new_oid = edit_commit(
+        clone, ds_path, deletes=[3], message="delete feature 3"
+    )
+    updated = transport.push(clone, "origin")
+    assert updated == {"refs/heads/main": new_oid}
+    assert repo.refs.get("refs/heads/main") == new_oid
+    assert repo.odb.contains(new_oid)
+
+
+def test_push_non_ff_rejected_then_forced(source_repo, tmp_path):
+    repo, ds_path = source_repo
+    clone = transport.clone(repo.workdir, tmp_path / "clone", do_checkout=False)
+    clone.config.set_many({"user.name": "Cloner", "user.email": "c@example.com"})
+    # diverge both sides
+    edit_commit(repo, ds_path, deletes=[4], message="upstream change")
+    edit_commit(clone, ds_path, deletes=[5], message="local change")
+    with pytest.raises(RemoteError, match="non-fast-forward"):
+        transport.push(clone, "origin")
+    transport.push(clone, "origin", force=True)
+    assert repo.refs.get("refs/heads/main") == clone.head_commit_oid
+
+
+def test_push_delete_refspec(source_repo, tmp_path):
+    repo, _ = source_repo
+    repo.refs.set("refs/heads/topic", repo.head_commit_oid)
+    clone = transport.clone(repo.workdir, tmp_path / "clone", do_checkout=False)
+    transport.push(clone, "origin", [":topic"])
+    assert repo.refs.get("refs/heads/topic") is None
+
+
+def test_shallow_clone(source_repo, tmp_path):
+    repo, ds_path = source_repo
+    clone = transport.clone(
+        repo.workdir, tmp_path / "clone", depth=1, do_checkout=False
+    )
+    tip = clone.head_commit_oid
+    assert tip == repo.head_commit_oid
+    # only the tip commit exists; its parent wasn't fetched
+    tip_commit = clone.odb.read_commit(tip)
+    assert tip_commit.parents  # the parent oid is still recorded...
+    assert not clone.odb.contains(tip_commit.parents[0])  # ...but absent
+    assert tip in read_shallow(clone)
+    # shallow-tolerant walking: log shows just the tip
+    assert len(list(clone.walk_commits(tip))) == 1
+    # the tip's data is complete
+    assert len(list(clone.datasets("HEAD")[ds_path].features())) == 10
+
+
+def test_fetch_deepens_shallow_clone(source_repo, tmp_path):
+    repo, ds_path = source_repo
+    clone = transport.clone(
+        repo.workdir, tmp_path / "clone", depth=1, do_checkout=False
+    )
+    tip = clone.head_commit_oid
+    assert len(list(clone.walk_commits(tip))) == 1
+    transport.fetch(clone, "origin", depth=10)
+    # full history now present and the shallow marker is gone
+    assert len(list(clone.walk_commits(tip))) == 2
+    assert read_shallow(clone) == set()
+
+
+def test_push_from_shallow_clone_marks_remote_shallow(source_repo, tmp_path):
+    repo, ds_path = source_repo
+    clone = transport.clone(
+        repo.workdir, tmp_path / "clone", depth=1, do_checkout=False
+    )
+    empty = KartRepo.init_repository(tmp_path / "target", bare=True)
+    transport.add_remote(clone, "target", str(tmp_path / "target"))
+    transport.push(clone, "target")
+    # the truncation is recorded, not silent
+    assert clone.head_commit_oid in read_shallow(empty)
+
+
+def test_clone_into_nonempty_fails_cleanly(source_repo, tmp_path):
+    repo, _ = source_repo
+    with pytest.raises(RemoteError):
+        transport.clone(str(tmp_path / "missing-remote"), tmp_path / "c2")
+    assert not (tmp_path / "c2" / ".kart").exists()
+
+
+def test_remote_management(source_repo, tmp_path):
+    repo, _ = source_repo
+    other = KartRepo.init_repository(tmp_path / "other")
+    transport.add_remote(other, "up", repo.workdir)
+    assert other.remotes() == ["up"]
+    assert other.remote_url("up") == repo.workdir
+    with pytest.raises(RemoteError):
+        transport.add_remote(other, "up", "elsewhere")
+    transport.remove_remote(other, "up")
+    assert other.remotes() == []
+
+
+class TestSpatialFilteredClone:
+    """Filtered partial clone: features outside the filter stay promised
+    (reference: kart clone --spatial-filter, SURVEY.md §3.5)."""
+
+    @pytest.fixture()
+    def partial_clone(self, source_repo, tmp_path):
+        from kart_tpu.spatial_filter import ResolvedSpatialFilterSpec
+
+        repo, ds_path = source_repo
+        # points are at x=101..110, y=-40.1..-41.0; keep x <= 105.5
+        spec = ResolvedSpatialFilterSpec(
+            "EPSG:4326",
+            "POLYGON((100 -42, 105.5 -42, 105.5 -39, 100 -39, 100 -42))",
+        )
+        clone = transport.clone(
+            repo.workdir,
+            tmp_path / "partial",
+            spatial_filter_spec=spec,
+            do_checkout=False,
+        )
+        return repo, clone, ds_path
+
+    def test_outside_features_are_promised(self, partial_clone):
+        repo, clone, ds_path = partial_clone
+        assert clone.config.get_bool("remote.origin.promisor")
+        ds = clone.datasets("HEAD")[ds_path]
+        # inside-filter feature readable
+        f5 = ds.get_feature([5])
+        assert f5["name"] == "feature-5"
+        # outside-filter feature is promised, not just missing
+        with pytest.raises(ObjectPromised):
+            ds.get_feature([9])
+
+    def test_promised_blob_fetch_on_demand(self, partial_clone):
+        repo, clone, ds_path = partial_clone
+        src_ds = repo.datasets("HEAD")[ds_path]
+        path = src_ds.encode_1pk_to_path(9, relative=True)  # 'feature/...'
+        blob_oid = src_ds.inner_tree.get(path).oid
+
+        fetched = transport.fetch_promised_blobs(clone, [blob_oid])
+        assert fetched == 1
+        ds = clone.datasets("HEAD")[ds_path]
+        assert ds.get_feature([9])["name"] == "feature-9"
+
+    def test_filter_config_written(self, partial_clone):
+        _, clone, _ = partial_clone
+        assert clone.config.get("kart.spatialfilter.crs") == "EPSG:4326"
+        assert "POLYGON" in clone.config.get("kart.spatialfilter.geometry")
+        pcf = clone.config.get("remote.origin.partialclonefilter")
+        assert pcf and pcf.startswith("extension:spatial=")
+
+
+def test_cli_clone_push_pull(source_repo, tmp_path, monkeypatch):
+    from click.testing import CliRunner
+
+    from kart_tpu.cli import cli
+
+    runner = CliRunner()
+    repo, ds_path = source_repo
+    clone_dir = tmp_path / "cliclone"
+    result = runner.invoke(
+        cli, ["clone", "--no-checkout", repo.workdir, str(clone_dir)]
+    )
+    assert result.exit_code == 0, result.output
+
+    monkeypatch.chdir(clone_dir)
+    clone = KartRepo(str(clone_dir))
+    clone.config.set_many({"user.name": "X", "user.email": "x@example.com"})
+    edit_commit(clone, ds_path, deletes=[7], message="cli edit")
+    result = runner.invoke(cli, ["push"])
+    assert result.exit_code == 0, result.output
+    assert repo.refs.get("refs/heads/main") == clone.head_commit_oid
+
+    # advance source, then pull in the clone (fast-forward)
+    new_oid = edit_commit(repo, ds_path, deletes=[8], message="upstream edit")
+    result = runner.invoke(cli, ["pull"])
+    assert result.exit_code == 0, result.output
+    clone = KartRepo(str(clone_dir))
+    assert clone.head_commit_oid == new_oid

@@ -1,0 +1,142 @@
+"""Upgrade: V2 (.sno-dataset, legacy 256^2 paths) -> V3 history rewrite
+(reference: tests/test_upgrade.py over archived old-format repos)."""
+
+import pytest
+
+from kart_tpu.core.repo import KartRepo
+from kart_tpu.core.tree_builder import TreeBuilder
+from kart_tpu.models.dataset import Dataset2, Dataset3
+from kart_tpu.models.paths import PathEncoder
+from kart_tpu.models.schema import Schema
+from kart_tpu.upgrade import UpgradeError, upgrade_in_place, upgrade_repo
+
+V2_COLS = [
+    {
+        "id": "c1",
+        "name": "fid",
+        "dataType": "integer",
+        "primaryKeyIndex": 0,
+        "size": 64,
+    },
+    {"id": "c2", "name": "name", "dataType": "text"},
+    {"id": "c3", "name": "rating", "dataType": "float", "size": 64},
+]
+
+
+def make_v2_repo(tmp_path, n=6):
+    """Build a V2-format repo by hand: .sno-dataset dirname, legacy hex
+    feature paths, two commits."""
+    repo = KartRepo.init_repository(tmp_path / "v2repo")
+    repo.config.set_many(
+        {
+            "user.name": "V2 author",
+            "user.email": "v2@example.com",
+            "kart.repostructure.version": "2",
+        }
+    )
+    schema = Schema.from_column_dicts(V2_COLS)
+    enc = PathEncoder.LEGACY_ENCODER
+
+    tb = TreeBuilder(repo.odb)
+    for path, data in Dataset2.new_dataset_meta_blobs(
+        "mytable", schema, title="My V2 table", path_encoder=enc
+    ):
+        tb.insert(path, repo.odb.write_blob(data))
+    prefix = f"mytable/{Dataset2.DATASET_DIRNAME}/{Dataset2.FEATURE_PATH}"
+    for i in range(1, n + 1):
+        pk_values, blob = schema.encode_feature_blob(
+            {"fid": i, "name": f"row-{i}", "rating": i * 1.5}
+        )
+        tb.insert(prefix + enc.encode_pks_to_path(pk_values), repo.odb.write_blob(blob))
+    from kart_tpu.core.objects import Signature
+
+    # explicit author: the test asserts authorship survives the upgrade, so
+    # don't let ambient GIT_AUTHOR_* env vars leak in
+    sig = Signature.now("V2 author", "v2@example.com")
+    tree1 = tb.flush()
+    c1 = repo.create_commit(
+        "HEAD", tree1, "v2 initial import", [], author=sig, committer=sig
+    )
+
+    tb2 = TreeBuilder(repo.odb, tree1)
+    pk_values, blob = schema.encode_feature_blob(
+        {"fid": n + 1, "name": "added-later", "rating": 0.5}
+    )
+    tb2.insert(
+        prefix + enc.encode_pks_to_path(pk_values), repo.odb.write_blob(blob)
+    )
+    tree2 = tb2.flush()
+    c2 = repo.create_commit(
+        "HEAD", tree2, "v2 second commit", [c1], author=sig, committer=sig
+    )
+    return repo, c1, c2
+
+
+def test_v2_repo_readable_as_v2(tmp_path):
+    repo, _, _ = make_v2_repo(tmp_path)
+    assert repo.version == 2
+    ds = repo.datasets("HEAD")["mytable"]
+    assert isinstance(ds, Dataset2)
+    assert ds.feature_count == 7
+    assert ds.get_feature([3])["name"] == "row-3"
+
+
+def test_upgrade_in_place(tmp_path):
+    repo, c1, c2 = make_v2_repo(tmp_path)
+    old_blob_oids = {
+        e.oid
+        for _, e in repo.datasets("HEAD")["mytable"].feature_tree.walk_blobs()
+    }
+    commit_map = upgrade_in_place(repo)
+    assert len(commit_map) == 2
+
+    repo = KartRepo(repo.workdir)  # reopen: version config changed
+    assert repo.version == 3
+    ds = repo.datasets("HEAD")["mytable"]
+    assert isinstance(ds, Dataset3) and not isinstance(ds, Dataset2)
+    assert ds.feature_count == 7
+    assert ds.get_feature([3]) == {"fid": 3, "name": "row-3", "rating": 4.5}
+
+    # feature blob content is reused by content-address, not re-written
+    new_blob_oids = {e.oid for _, e in ds.feature_tree.walk_blobs()}
+    assert new_blob_oids == old_blob_oids
+
+    # history shape preserved: 2 commits, messages + authorship intact
+    commits = list(repo.walk_commits(repo.head_commit_oid))
+    assert len(commits) == 2
+    assert commits[0][1].message.startswith("v2 second commit")
+    assert commits[0][1].author.name == "V2 author"
+    # first commit is the mapped c1
+    assert commits[1][0] == commit_map[c1]
+
+
+def test_upgrade_to_new_repo(tmp_path):
+    repo, c1, c2 = make_v2_repo(tmp_path)
+    dest, commit_map = upgrade_repo(repo.workdir, tmp_path / "v3repo")
+    assert dest.version == 3
+    ds = dest.datasets("HEAD")["mytable"]
+    assert ds.feature_count == 7
+    assert ds.get_meta_item("title") == "My V2 table"
+    # old repo untouched
+    assert KartRepo(repo.workdir).version == 2
+    assert len(list(dest.walk_commits(dest.head_commit_oid))) == 2
+
+
+def test_upgrade_v3_refuses(tmp_path):
+    from helpers import make_imported_repo
+
+    repo, _ = make_imported_repo(tmp_path)
+    with pytest.raises(UpgradeError, match="already"):
+        upgrade_in_place(repo)
+
+
+def test_upgrade_cli(tmp_path, monkeypatch):
+    from click.testing import CliRunner
+
+    from kart_tpu.cli import cli
+
+    repo, _, _ = make_v2_repo(tmp_path)
+    runner = CliRunner()
+    r = runner.invoke(cli, ["upgrade", "--in-place", repo.workdir])
+    assert r.exit_code == 0, r.output
+    assert "Upgraded 2 commits in place" in r.output
