@@ -36,9 +36,25 @@ OUTPUT_FORMATS = [
     is_flag=True,
     help="Exit 1 when there are differences, 0 otherwise",
 )
+@click.option(
+    "--only-feature-count",
+    type=click.Choice(["veryfast", "fast", "medium", "good", "exact"]),
+    default=None,
+    help="Skip the diff; print an estimated changed-feature count per "
+    "dataset at the given accuracy (sampled subtree estimation)",
+)
 @click.argument("args", nargs=-1)
 @click.pass_obj
-def diff(ctx, output_format, output_path, json_style, target_crs, exit_code, args):
+def diff(
+    ctx,
+    output_format,
+    output_path,
+    json_style,
+    target_crs,
+    exit_code,
+    only_feature_count,
+    args,
+):
     """Show changes between commits, or between a commit and the working copy.
 
     ARGS: an optional commit spec (A, A..B or A...B) followed by optional
@@ -46,6 +62,18 @@ def diff(ctx, output_format, output_path, json_style, target_crs, exit_code, arg
     """
     repo = ctx.repo
     commit_spec, filters = _split_diff_args(repo, args)
+    if only_feature_count:
+        has_changes = _print_estimated_counts(
+            repo,
+            commit_spec,
+            only_feature_count,
+            output_format,
+            output_path,
+            filters,
+        )
+        if exit_code:
+            raise SystemExit(1 if has_changes else 0)
+        return
     writer_class = BaseDiffWriter.get_diff_writer_class(output_format)
     writer = writer_class(
         repo,
@@ -58,6 +86,37 @@ def diff(ctx, output_format, output_path, json_style, target_crs, exit_code, arg
     has_changes = writer.write_diff()
     if exit_code or output_format == "quiet":
         raise SystemExit(1 if has_changes else 0)
+
+
+def _print_estimated_counts(
+    repo, commit_spec, accuracy, output_format, output_path, filters=()
+):
+    """kart diff --only-feature-count (reference: diff.py + diff_estimation.py).
+    Returns True when any counted changes exist (for --exit-code)."""
+    from kart_tpu.diff.estimation import estimate_diff_feature_counts
+
+    base_rs, target_rs, working_copy = BaseDiffWriter.parse_diff_commit_spec(
+        repo, commit_spec
+    )
+    if working_copy is not None:
+        # the WC side has no trees to sample; fall back to counting the diff
+        writer = BaseDiffWriter.get_diff_writer_class("feature-count")(
+            repo, commit_spec, filters, output_path
+        )
+        return writer.write_diff()
+    counts = estimate_diff_feature_counts(
+        repo, base_rs, target_rs, accuracy=accuracy
+    )
+    if filters:
+        wanted = {f.split(":", 1)[0] for f in filters}
+        counts = {ds: c for ds, c in counts.items() if ds in wanted}
+    if output_format == "json":
+        dump_json_output({"kart.diff/v1+feature-count": counts}, output_path)
+    else:
+        for ds_path, count in sorted(counts.items()):
+            click.echo(f"{ds_path}:")
+            click.echo(f"\t{count} features changed")
+    return any(counts.values())
 
 
 def _split_diff_args(repo, args):

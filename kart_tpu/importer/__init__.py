@@ -45,6 +45,11 @@ class ImportSource:
         """{'title': ..., 'description': ..., 'crs/<id>.wkt': ...}"""
         return {}
 
+    def post_import_meta_items(self):
+        """Meta items only known after features() has been consumed
+        (e.g. generated-pks.json)."""
+        return {}
+
     def crs_definitions(self):
         """{identifier: wkt}"""
         return {}
@@ -388,15 +393,10 @@ class CSVImportSource(ImportSource):
             if types.get(candidate) == "integer":
                 pk_name = candidate
                 break
+        # no natural key -> emit a PK-less schema; the importer wraps the
+        # source in PkGeneratingImportSource for *stable* generated PKs
+        # (row-order PKs would reshuffle on every re-import)
         cols = []
-        if pk_name is None:
-            pk_name = "auto_pk"
-            cols.append(
-                ColumnSchema(
-                    ColumnSchema.deterministic_id(self.path, "auto_pk"),
-                    "auto_pk", "integer", 0, {"size": 64},
-                )
-            )
         self._pk_name = pk_name
         for name in self.header:
             t = types[name]
@@ -423,10 +423,8 @@ class CSVImportSource(ImportSource):
     def features(self):
         # row values follow the *header* order, not the pk-first schema order
         cols_by_name = {c.name: c for c in self._schema.columns}
-        for i, row in enumerate(self.rows):
+        for row in self.rows:
             out = {}
-            if self._pk_name == "auto_pk":
-                out["auto_pk"] = i + 1
             for j, name in enumerate(self.header):
                 col = cols_by_name[name]
                 raw = row[j] if j < len(row) else ""

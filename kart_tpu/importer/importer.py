@@ -41,11 +41,16 @@ def import_sources(
         set(structure.datasets.paths()) if structure is not None else set()
     )
 
+    from kart_tpu.importer.pk_generation import PkGeneratingImportSource
+
     tb = TreeBuilder(repo.odb, head_tree)
     ds_paths = []
     total = 0
     t0 = time.monotonic()
     for source in sources:
+        # PK-less sources get stable generated PKs
+        # (reference: kart/pk_generation.py)
+        source = PkGeneratingImportSource.wrap_if_needed(source, repo)
         ds_path = source.dest_path.strip("/")
         if ds_path in existing_paths and not replace_existing:
             raise ImportError_(
@@ -105,6 +110,18 @@ def _import_single_source(repo, tb, source, ds_path, *, log=None):
         count += len(batch)
         if log and count % 100000 == 0:
             log(f"  {ds_path}: {count} features...")
+
+    # meta items that only exist after the feature stream has run (e.g.
+    # generated-pks.json from PK synthesis)
+    late_meta = source.post_import_meta_items()
+    if late_meta:
+        from kart_tpu.core.serialise import json_pack
+
+        inner = f"{ds_path}/{Dataset3.DATASET_DIRNAME}"
+        for name, value in late_meta.items():
+            data = json_pack(value) if not isinstance(value, bytes) else value
+            tb.insert(f"{inner}/{Dataset3.META_PATH}{name}", repo.odb.write_blob(data))
+
     if log:
         log(f"  {ds_path}: {count} features")
     return count

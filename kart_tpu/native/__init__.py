@@ -1,0 +1,146 @@
+"""ctypes binding for the native spatial-filter core (native/spatial_filter.cpp).
+
+The library is optional: :func:`load` returns None when it isn't built and
+every caller falls back to the numpy implementation with identical
+semantics (the same CPU-reference-path discipline the TPU kernels follow).
+Build with ``make -C native`` — :func:`ensure_built` does it on demand when
+a toolchain is available.
+"""
+
+import ctypes
+import logging
+import os
+import subprocess
+
+import numpy as np
+
+L = logging.getLogger(__name__)
+
+_NATIVE_DIR = os.path.join(os.path.dirname(__file__), "..", "..", "native")
+_LIB_NAME = "libkart_sf.so"
+_ABI_VERSION = 1
+
+_lib = None
+_load_attempted = False
+
+
+def _lib_path():
+    override = os.environ.get("KART_TPU_NATIVE_LIB")
+    if override:
+        return override
+    return os.path.abspath(os.path.join(_NATIVE_DIR, _LIB_NAME))
+
+
+def load():
+    """-> configured ctypes.CDLL, or None when unavailable."""
+    global _lib, _load_attempted
+    if _lib is not None or _load_attempted:
+        return _lib
+    _load_attempted = True
+    path = _lib_path()
+    if not os.path.exists(path):
+        return None
+    try:
+        lib = ctypes.CDLL(path)
+        lib.sf_abi_version.restype = ctypes.c_int
+        if lib.sf_abi_version() != _ABI_VERSION:
+            L.warning("native lib %s has wrong ABI version; ignoring", path)
+            return None
+        lib.sf_decode_envelopes.argtypes = [
+            ctypes.c_void_p,
+            ctypes.c_int64,
+            ctypes.c_void_p,
+        ]
+        lib.sf_bbox_intersects.argtypes = [
+            ctypes.c_void_p,
+            ctypes.c_int64,
+            ctypes.c_void_p,
+            ctypes.c_void_p,
+        ]
+        lib.sf_bbox_intersects.restype = ctypes.c_int64
+        lib.sf_filter_packed.argtypes = [
+            ctypes.c_void_p,
+            ctypes.c_int64,
+            ctypes.c_void_p,
+            ctypes.c_void_p,
+        ]
+        lib.sf_filter_packed.restype = ctypes.c_int64
+        _lib = lib
+    except OSError as e:
+        L.warning("could not load native lib %s: %s", path, e)
+    return _lib
+
+
+def ensure_built():
+    """Build the library if a compiler is available; -> loaded lib or None."""
+    global _load_attempted
+    if load() is not None:
+        return _lib
+    makefile_dir = os.path.abspath(_NATIVE_DIR)
+    if not os.path.exists(os.path.join(makefile_dir, "Makefile")):
+        return None
+    try:
+        subprocess.run(
+            ["make", "-C", makefile_dir],
+            check=True,
+            capture_output=True,
+            timeout=120,
+        )
+    except (subprocess.SubprocessError, FileNotFoundError) as e:
+        L.info("native build unavailable: %s", e)
+        return None
+    _load_attempted = False
+    return load()
+
+
+# -- high-level API (native with numpy fallback) ----------------------------
+
+
+def decode_envelopes(packed):
+    """(N, 10) uint8 packed envelopes -> (N, 4) float64 wsen."""
+    packed = np.ascontiguousarray(packed, dtype=np.uint8)
+    n = packed.shape[0]
+    lib = load()
+    if lib is not None:
+        out = np.empty((n, 4), dtype=np.float64)
+        lib.sf_decode_envelopes(
+            packed.ctypes.data, n, out.ctypes.data
+        )
+        return out
+    from kart_tpu.ops.envelope_codec import EnvelopeCodec
+
+    return EnvelopeCodec().decode_batch(packed)
+
+
+def filter_packed(packed, query_wsen):
+    """(N, 10) uint8 packed envelopes + (w,s,e,n) query -> bool (N,).
+    The server-side partial-clone hot path."""
+    packed = np.ascontiguousarray(packed, dtype=np.uint8)
+    n = packed.shape[0]
+    query = np.asarray(query_wsen, dtype=np.float64)
+    lib = load()
+    if lib is not None:
+        out = np.empty(n, dtype=np.uint8)
+        lib.sf_filter_packed(
+            packed.ctypes.data, n, query.ctypes.data, out.ctypes.data
+        )
+        return out.astype(bool)
+    from kart_tpu.ops.bbox import bbox_intersects_np
+
+    return bbox_intersects_np(decode_envelopes(packed), query)
+
+
+def bbox_intersects(envelopes, query_wsen):
+    """(N, 4) float64 wsen + query -> bool (N,), native when available."""
+    envelopes = np.ascontiguousarray(envelopes, dtype=np.float64)
+    query = np.asarray(query_wsen, dtype=np.float64)
+    lib = load()
+    if lib is not None:
+        out = np.empty(envelopes.shape[0], dtype=np.uint8)
+        lib.sf_bbox_intersects(
+            envelopes.ctypes.data, envelopes.shape[0], query.ctypes.data, out.ctypes.data
+        )
+        return out.astype(bool)
+    from kart_tpu.ops.bbox import bbox_intersects_np
+
+    return bbox_intersects_np(envelopes, query)

@@ -143,13 +143,18 @@ def pad_envelopes(envelopes, multiple=None):
 
 def bbox_intersects(envelopes, query):
     """Best-available backend dispatch; envelopes (N,4), query (4,) ->
-    bool numpy (N,)."""
+    bool numpy (N,). Falls back to the numpy reference path when no jax
+    backend can initialise (e.g. a misconfigured accelerator plugin)."""
     n = len(envelopes)
     if n == 0:
         return np.zeros(0, dtype=bool)
+    try:
+        backend = jax.default_backend()
+    except RuntimeError:
+        return bbox_intersects_np(np.asarray(envelopes), query)
     w, s, e, nn, count = pad_envelopes(np.asarray(envelopes))
     q = jnp.asarray(np.asarray(query, dtype=np.float32))
-    if jax.default_backend() == "tpu":
+    if backend == "tpu":
         mask = bbox_intersects_pallas(
             jnp.asarray(w), jnp.asarray(s), jnp.asarray(e), jnp.asarray(nn), q
         )

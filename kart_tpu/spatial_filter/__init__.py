@@ -328,16 +328,29 @@ def blob_filter_for_spec(src_repo, wsen_arg):
     reader = EnvelopeIndexReader.open(src_repo)  # None if no index built
     transforms = _DatasetEnvelopeDecoder(src_repo)
 
+    # batch pre-pass over the whole envelope table: one vectorized
+    # bbox-intersect call (native C++ / numpy) instead of a sqlite lookup
+    # per blob — the TPU-era answer to spatial_filter.cpp's per-OID loop
+    matched_oids = rejected_oids = None
+    if reader is not None:
+        from kart_tpu.native import bbox_intersects
+
+        oids, wsen = reader.all_envelopes()
+        if len(oids):
+            hits = bbox_intersects(wsen, (w, s, e, n))
+            matched_oids = {o for o, h in zip(oids, hits) if h}
+            rejected_oids = {o for o, h in zip(oids, hits) if not h}
+
     def blob_filter(path, oid):
         ds_feature = _split_feature_path(path)
         if ds_feature is None:
             return True  # meta / non-feature blob: always ship
-        if reader is not None:
-            env = reader.get(oid)
-            if env is not None:
-                return _rect_overlaps(
-                    (env[0], env[2], env[1], env[3]), (w, e, s, n)
-                )
+        if matched_oids is not None:
+            if oid in matched_oids:
+                return True
+            if oid in rejected_oids:
+                return False
+            # not indexed: fall through to on-the-fly decode
         env_4326 = transforms.envelope_4326(ds_feature[0], oid)
         if env_4326 is None:
             return True  # no geometry / undecodable: fail open

@@ -1,0 +1,121 @@
+// Native spatial-filter core: packed-envelope decode + cyclic bbox intersect.
+//
+// TPU-era equivalent of the reference's in-process git object-filter
+// extension (vendor/spatial-filter/spatial_filter.cpp): where that code is
+// called once per blob from git's list-objects walk with a sqlite lookup per
+// OID, this library takes the whole envelope table as one contiguous batch
+// and answers "which blobs overlap the filter rect" in a single pass — the
+// shape both the C ABI below and the Pallas kernel (kart_tpu/ops/bbox.py)
+// share.  The bit layout is the reference's EnvelopeEncoder
+// (kart/spatial_filter/index.py:485-548): 4 x 20-bit fixed point, WSEN,
+// big-endian, 10 bytes per envelope.
+//
+// Build: make -C native   (produces libkart_sf.so; loaded via ctypes from
+// kart_tpu/native, with a numpy fallback when absent.)
+
+#include <cstdint>
+#include <cstring>
+
+namespace {
+
+constexpr int kBits = 20;
+constexpr int kBytes = 10;  // 4 * 20 bits
+constexpr uint32_t kValueMax = (1u << kBits) - 1;
+
+inline double decode_value(uint32_t encoded, double lo, double hi) {
+  return static_cast<double>(encoded) / kValueMax * (hi - lo) + lo;
+}
+
+struct Envelope {
+  double w, s, e, n;
+};
+
+inline Envelope decode_envelope(const uint8_t* p) {
+  // 80-bit big-endian integer: w | s | e | n, 20 bits each
+  uint64_t hi = 0, lo = 0;
+  for (int i = 0; i < 5; i++) hi = (hi << 8) | p[i];
+  for (int i = 5; i < 10; i++) lo = (lo << 8) | p[i];
+  // hi = w(20) s(20), lo = e(20) n(20)
+  uint32_t wv = static_cast<uint32_t>(hi >> kBits) & kValueMax;
+  uint32_t sv = static_cast<uint32_t>(hi) & kValueMax;
+  uint32_t ev = static_cast<uint32_t>(lo >> kBits) & kValueMax;
+  uint32_t nv = static_cast<uint32_t>(lo) & kValueMax;
+  return Envelope{decode_value(wv, -180, 180), decode_value(sv, -90, 90),
+                  decode_value(ev, -180, 180), decode_value(nv, -90, 90)};
+}
+
+inline double range_len(double w, double e) {
+  if (e >= w) return e - w;
+  double d = e - w;
+  d = d - 360.0 * static_cast<int64_t>(d / 360.0);  // fmod toward zero
+  if (d < 0) d += 360.0;
+  return d;
+}
+
+inline double mod360(double x) {
+  double d = x - 360.0 * static_cast<int64_t>(x / 360.0);
+  if (d < 0) d += 360.0;
+  return d;
+}
+
+// Anti-meridian-aware longitude-range overlap
+// (reference: spatial_filter.cpp:187-208 "cyclic range overlap").
+inline bool cyclic_overlap(double w1, double e1, double w2, double e2) {
+  double len1 = range_len(w1, e1);
+  double len2 = range_len(w2, e2);
+  return mod360(w2 - w1) <= len1 || mod360(w1 - w2) <= len2;
+}
+
+inline bool intersects(const Envelope& env, const Envelope& q) {
+  if (env.s > q.n || q.s > env.n) return false;
+  return cyclic_overlap(env.w, env.e, q.w, q.e);
+}
+
+}  // namespace
+
+extern "C" {
+
+// ABI version so the Python loader can refuse a stale library.
+int sf_abi_version() { return 1; }
+
+// Decode n packed 10-byte envelopes into (n,4) doubles (w,s,e,n rows).
+void sf_decode_envelopes(const uint8_t* packed, int64_t n, double* out) {
+  for (int64_t i = 0; i < n; i++) {
+    Envelope env = decode_envelope(packed + i * kBytes);
+    out[i * 4 + 0] = env.w;
+    out[i * 4 + 1] = env.s;
+    out[i * 4 + 2] = env.e;
+    out[i * 4 + 3] = env.n;
+  }
+}
+
+// envelopes: (n,4) doubles w,s,e,n. query: 4 doubles. out: n bytes (0/1).
+// Returns the match count.
+int64_t sf_bbox_intersects(const double* envelopes, int64_t n,
+                           const double* query, uint8_t* out) {
+  Envelope q{query[0], query[1], query[2], query[3]};
+  int64_t hits = 0;
+  for (int64_t i = 0; i < n; i++) {
+    const double* e = envelopes + i * 4;
+    bool hit = intersects(Envelope{e[0], e[1], e[2], e[3]}, q);
+    out[i] = hit ? 1 : 0;
+    hits += hit;
+  }
+  return hits;
+}
+
+// The fused server-side hot path: packed envelope table -> match bitmap,
+// no intermediate doubles (one pass, cache-friendly).
+int64_t sf_filter_packed(const uint8_t* packed, int64_t n, const double* query,
+                         uint8_t* out) {
+  Envelope q{query[0], query[1], query[2], query[3]};
+  int64_t hits = 0;
+  for (int64_t i = 0; i < n; i++) {
+    bool hit = intersects(decode_envelope(packed + i * kBytes), q);
+    out[i] = hit ? 1 : 0;
+    hits += hit;
+  }
+  return hits;
+}
+
+}  // extern "C"

@@ -254,3 +254,25 @@ def test_config(repo_dir, runner):
     runner.invoke(cli, ["config", "custom.key", "hello"])
     r = runner.invoke(cli, ["config", "custom.key"])
     assert r.output.strip() == "hello"
+
+
+def test_query_intersects(repo_dir, runner):
+    r = runner.invoke(
+        cli, ["query", "points", "intersects", "100,-45,105.5,-39", "-o", "json"]
+    )
+    assert r.exit_code == 0, r.output
+    out = json.loads(r.output)["kart.query/v1"]
+    # points at x=101..110: fids 1..5 are <= 105.5
+    assert out["pks"] == [1, 2, 3, 4, 5]
+
+
+def test_query_get(repo_dir, runner):
+    r = runner.invoke(cli, ["query", "points", "get", "3"])
+    assert r.exit_code == 0, r.output
+    assert json.loads(r.output)["kart.query/v1"]["name"] == "feature-3"
+
+
+def test_query_bad_bbox(repo_dir, runner):
+    r = runner.invoke(cli, ["query", "points", "intersects", "nope"])
+    assert r.exit_code != 0
+    assert "Bad bbox" in r.output

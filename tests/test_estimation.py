@@ -1,0 +1,104 @@
+"""Sampled diff-count estimation (reference: tests/test_diff_feature_count.py
+over estimator accuracies)."""
+
+import pytest
+
+from kart_tpu.diff.estimation import (
+    ACCURACY_CHOICES,
+    estimate_diff_feature_counts,
+)
+
+from helpers import edit_commit, make_imported_repo
+
+
+@pytest.fixture()
+def repo_with_edits(tmp_path):
+    repo, ds_path = make_imported_repo(tmp_path, n=200)
+    edit_commit(
+        repo,
+        ds_path,
+        inserts=[{"fid": 201, "geom": None, "name": "new", "rating": 0.1}],
+        updates=[
+            {"fid": i, "geom": None, "name": f"edit-{i}", "rating": 0.0}
+            for i in range(1, 11)
+        ],
+        deletes=[190, 191],
+        message="edits",
+    )
+    return repo, ds_path
+
+
+def test_exact_count(repo_with_edits):
+    repo, ds_path = repo_with_edits
+    base = repo.structure("HEAD^")
+    target = repo.structure("HEAD")
+    counts = estimate_diff_feature_counts(
+        repo, base, target, accuracy="exact"
+    )
+    assert counts == {ds_path: 13}  # 1 insert + 10 updates + 2 deletes
+
+
+@pytest.mark.parametrize("accuracy", [a for a in ACCURACY_CHOICES if a != "exact"])
+def test_sampled_counts_are_reasonable(repo_with_edits, accuracy):
+    repo, ds_path = repo_with_edits
+    base = repo.structure("HEAD^")
+    target = repo.structure("HEAD")
+    counts = estimate_diff_feature_counts(
+        repo, base, target, accuracy=accuracy, use_annotations=False
+    )
+    # small diff: every accuracy should land within 3x of truth
+    assert ds_path in counts
+    assert 13 / 3 <= counts[ds_path] <= 13 * 3
+
+
+def test_identical_revisions_count_zero(repo_with_edits):
+    repo, ds_path = repo_with_edits
+    rs = repo.structure("HEAD")
+    assert estimate_diff_feature_counts(repo, rs, rs, accuracy="fast") == {}
+
+
+def test_counts_cached_in_annotations(repo_with_edits):
+    repo, ds_path = repo_with_edits
+    base = repo.structure("HEAD^")
+    target = repo.structure("HEAD")
+    first = estimate_diff_feature_counts(repo, base, target, accuracy="exact")
+    from kart_tpu.annotations import DiffAnnotations
+
+    cached = DiffAnnotations(repo).get(
+        base.tree_oid, target.tree_oid, "feature-change-counts-exact"
+    )
+    assert cached == first
+
+
+def test_whole_dataset_add_and_remove(tmp_path):
+    repo, ds_path = make_imported_repo(tmp_path, n=50)
+    base = repo.structure("[EMPTY]") if False else None
+    target = repo.structure("HEAD")
+    counts = estimate_diff_feature_counts(
+        repo, None, target, accuracy="exact", use_annotations=False
+    )
+    assert counts == {ds_path: 50}
+    counts = estimate_diff_feature_counts(
+        repo, target, None, accuracy="exact", use_annotations=False
+    )
+    assert counts == {ds_path: 50}
+
+
+def test_bad_accuracy_rejected(repo_with_edits):
+    repo, _ = repo_with_edits
+    rs = repo.structure("HEAD")
+    with pytest.raises(ValueError):
+        estimate_diff_feature_counts(repo, rs, rs, accuracy="bogus")
+
+
+def test_cli_only_feature_count(repo_with_edits, monkeypatch):
+    from click.testing import CliRunner
+
+    from kart_tpu.cli import cli
+
+    repo, ds_path = repo_with_edits
+    monkeypatch.chdir(repo.workdir)
+    runner = CliRunner()
+    r = runner.invoke(cli, ["diff", "--only-feature-count", "exact", "HEAD^...HEAD"])
+    assert r.exit_code == 0, r.output
+    assert "13 features changed" in r.output

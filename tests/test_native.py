@@ -1,0 +1,85 @@
+"""Native C++ spatial-filter core vs the numpy reference path: identical
+results on the same inputs (the bit-compatibility discipline of SURVEY.md §4
+applied to the native layer)."""
+
+import numpy as np
+import pytest
+
+from kart_tpu import native
+from kart_tpu.ops.bbox import bbox_intersects_np
+from kart_tpu.ops.envelope_codec import EnvelopeCodec
+
+
+@pytest.fixture(scope="module")
+def native_lib():
+    lib = native.ensure_built()
+    if lib is None:
+        pytest.skip("no C++ toolchain available to build the native library")
+    return lib
+
+
+def _random_envelopes(n, rng):
+    w = rng.uniform(-180, 180, n)
+    e = np.clip(w + rng.uniform(0, 20, n), -180, 180)
+    s = rng.uniform(-90, 89, n)
+    n_ = np.clip(s + rng.uniform(0, 10, n), -90, 90)
+    return np.stack([w, s, e, n_], axis=1)
+
+
+def test_decode_matches_codec(native_lib):
+    rng = np.random.default_rng(42)
+    envs = _random_envelopes(500, rng)
+    codec = EnvelopeCodec()
+    packed = codec.encode_batch(envs)
+
+    native_decoded = native.decode_envelopes(packed)
+    numpy_decoded = codec.decode_batch(packed)
+    np.testing.assert_allclose(native_decoded, numpy_decoded, rtol=0, atol=1e-12)
+
+
+def test_bbox_intersects_matches_numpy(native_lib):
+    rng = np.random.default_rng(7)
+    envs = _random_envelopes(2000, rng)
+    query = (100.0, -45.0, 120.0, -35.0)
+    np.testing.assert_array_equal(
+        native.bbox_intersects(envs, query), bbox_intersects_np(envs, query)
+    )
+
+
+def test_bbox_antimeridian(native_lib):
+    envs = np.array(
+        [
+            [175.0, 0.0, 176.0, 1.0],  # near the anti-meridian, west side
+            [-176.0, 0.0, -175.0, 1.0],  # east side
+            [170.0, 0.0, -170.0, 1.0],  # an envelope crossing it
+            [0.0, 0.0, 10.0, 1.0],  # far away
+        ]
+    )
+    query = (170.0, -5.0, -170.0, 5.0)  # query crossing the anti-meridian
+    expected = bbox_intersects_np(envs, query)
+    np.testing.assert_array_equal(native.bbox_intersects(envs, query), expected)
+    assert list(expected) == [True, True, True, False]
+
+
+def test_filter_packed_fused_path(native_lib):
+    rng = np.random.default_rng(3)
+    envs = _random_envelopes(1000, rng)
+    codec = EnvelopeCodec()
+    packed = codec.encode_batch(envs)
+    query = (-10.0, -10.0, 10.0, 10.0)
+
+    fused = native.filter_packed(packed, query)
+    # reference: decode (with codec quantisation) then intersect
+    expected = bbox_intersects_np(codec.decode_batch(packed), query)
+    np.testing.assert_array_equal(fused, expected)
+
+
+def test_numpy_fallback_when_lib_absent(monkeypatch):
+    monkeypatch.setattr(native, "_lib", None)
+    monkeypatch.setattr(native, "_load_attempted", True)
+    rng = np.random.default_rng(1)
+    envs = _random_envelopes(100, rng)
+    query = (0.0, -50.0, 50.0, 0.0)
+    np.testing.assert_array_equal(
+        native.bbox_intersects(envs, query), bbox_intersects_np(envs, query)
+    )

@@ -1,0 +1,121 @@
+"""Stable PK generation for PK-less sources (reference: kart/pk_generation.py
++ the PK-matching benchmark in tests/test_structure.py:762-784)."""
+
+import numpy as np
+
+from kart_tpu.importer.pk_generation import (
+    PkGeneratingImportSource,
+    assign_pks,
+    GENERATED_PKS_ITEM,
+)
+
+COLS = ["name", "rating"]
+
+
+def _features(*rows):
+    return [dict(zip(COLS, r)) for r in rows]
+
+
+class TestAssignPks:
+    def test_fresh_assignment(self):
+        feats = _features(("a", 1.0), ("b", 2.0), ("c", 3.0))
+        pks, state = assign_pks(feats, COLS, None)
+        assert list(pks) == [1, 2, 3]
+        assert state["next"] == 4
+
+    def test_reimport_identical_is_stable(self):
+        feats = _features(("a", 1.0), ("b", 2.0))
+        _, state = assign_pks(feats, COLS, None)
+        # same content, re-ordered: PKs follow the content
+        pks2, _ = assign_pks(_features(("b", 2.0), ("a", 1.0)), COLS, state)
+        assert list(pks2) == [2, 1]
+
+    def test_edited_feature_keeps_pk_by_similarity(self):
+        feats = _features(("alpha", 1.0), ("beta", 2.0), ("gamma", 3.0))
+        _, state = assign_pks(feats, COLS, None)
+        # 'beta' renamed but rating unchanged: 1/2 columns match -> re-match
+        edited = _features(("alpha", 1.0), ("beta-renamed", 2.0), ("gamma", 3.0))
+        pks2, _ = assign_pks(edited, COLS, state)
+        assert list(pks2) == [1, 2, 3]
+
+    def test_new_feature_gets_new_pk(self):
+        feats = _features(("a", 1.0))
+        _, state = assign_pks(feats, COLS, None)
+        pks2, state2 = assign_pks(
+            _features(("a", 1.0), ("z", 99.0)), COLS, state
+        )
+        assert list(pks2) == [1, 2]
+        assert state2["next"] == 3
+
+    def test_deleted_feature_pk_not_reused(self):
+        feats = _features(("a", 1.0), ("b", 2.0))
+        _, state = assign_pks(feats, COLS, None)
+        # 'b' (totally different content) deleted; new unrelated feature must
+        # NOT inherit pk 2 (no column matches => below threshold)
+        pks2, _ = assign_pks(
+            _features(("a", 1.0), ("completely-new", 77.0)), COLS, state
+        )
+        assert pks2[0] == 1
+        assert pks2[1] == 3
+
+    def test_duplicate_content_rows(self):
+        feats = _features(("dup", 1.0), ("dup", 1.0))
+        pks, _ = assign_pks(feats, COLS, None)
+        assert sorted(pks) == [1, 2]  # both get PKs, no collision
+
+
+class TestCsvImportRoundtrip:
+    def _write_csv(self, path, rows):
+        with open(path, "w") as f:
+            f.write("name,rating\n")
+            for r in rows:
+                f.write(f"{r[0]},{r[1]}\n")
+
+    def test_import_and_stable_reimport(self, tmp_path):
+        from kart_tpu.core.repo import KartRepo
+        from kart_tpu.importer import ImportSource
+        from kart_tpu.importer.importer import import_sources
+
+        csv_path = tmp_path / "records.csv"
+        self._write_csv(csv_path, [("a", 1.5), ("b", 2.5), ("c", 3.5)])
+        repo = KartRepo.init_repository(tmp_path / "repo")
+        repo.config.set_many({"user.name": "T", "user.email": "t@x"})
+        import_sources(repo, ImportSource.open(str(csv_path)))
+
+        ds = repo.datasets("HEAD")["records"]
+        assert ds.schema.pk_columns[0].name == "auto_pk"
+        assert ds.feature_count == 3
+        f1 = ds.get_feature([1])
+        assert f1["name"] == "a"
+        # state persisted in the dataset
+        assert ds.get_meta_item(GENERATED_PKS_ITEM) is not None
+
+        # re-import with one edit: unchanged rows keep PKs
+        self._write_csv(csv_path, [("c", 3.5), ("a", 1.5), ("b", 9.9)])
+        import_sources(
+            repo, ImportSource.open(str(csv_path)), replace_existing=True
+        )
+        ds2 = repo.datasets("HEAD")["records"]
+        assert ds2.get_feature([1])["name"] == "a"
+        assert ds2.get_feature([3])["name"] == "c"
+        # 'b' edited its rating only -> similarity keeps pk 2
+        assert ds2.get_feature([2])["name"] == "b"
+        assert ds2.get_feature([2])["rating"] == 9.9
+
+
+def test_wrap_if_needed_passthrough():
+    class FakeSource:
+        class schema:
+            pk_columns = ("something",)
+
+    src = FakeSource()
+    assert PkGeneratingImportSource.wrap_if_needed(src, None) is src
+
+
+def test_duplicate_content_stable_across_reimports():
+    """Duplicate rows keep their PKs on every re-import (PK lists per hash)."""
+    feats = _features(("dup", 1.0), ("dup", 1.0), ("x", 2.0))
+    pks1, state1 = assign_pks(feats, COLS, None)
+    pks2, state2 = assign_pks(feats, COLS, state1)
+    pks3, _ = assign_pks(feats, COLS, state2)
+    assert list(pks1) == list(pks2) == list(pks3)
