@@ -128,10 +128,25 @@ def add_command(name, fn):
 
 
 def entrypoint():
+    """Translate internal exceptions into clean one-line errors with stable
+    exit codes (reference: kart/cli.py entrypoint + kart/exceptions.py)."""
+    import sys
+
+    from kart_tpu import exceptions
+    from kart_tpu.core.repo import InvalidOperation, NotFound, RepoError
+    from kart_tpu.importer import ImportSourceError
+
     try:
         cli(standalone_mode=True)
-    except Exception:
-        raise
+    except NotFound as e:
+        click.echo(f"Error: {e}", err=True)
+        sys.exit(getattr(e, "exit_code", exceptions.NOT_FOUND))
+    except ImportSourceError as e:
+        click.echo(f"Error: {e}", err=True)
+        sys.exit(exceptions.NO_IMPORT_SOURCE)
+    except (InvalidOperation, RepoError) as e:
+        click.echo(f"Error: {e}", err=True)
+        sys.exit(getattr(e, "exit_code", exceptions.INVALID_OPERATION))
 
 
 if __name__ == "__main__":

@@ -194,7 +194,11 @@ def query(ctx, dataset, operation, argument, output_format):
             feature = ds.get_feature([pk])
         except KeyError:
             raise CliError(f"No feature with primary key {pk!r} in {dataset!r}")
-        dump_json_output({"kart.query/v1": _feature_json(feature)}, "-")
+        if output_format == "text":
+            for name, value in _feature_json(feature).items():
+                click.echo(f"{name:>30} = {value}")
+        else:
+            dump_json_output({"kart.query/v1": _feature_json(feature)}, "-")
         return
 
     # build the envelope table: one walk over the feature tree, reading each
@@ -203,6 +207,11 @@ def query(ctx, dataset, operation, argument, output_format):
     geom_col = ds.geom_column_name
     if geom_col is None:
         raise CliError(f"Dataset {dataset!r} has no geometry column")
+    if ds.feature_tree is None:
+        dump_json_output(
+            {"kart.query/v1": {"count": 0, "features": []}}, "-"
+        )
+        return
     odb = ds.feature_tree.odb
     paths, envelopes = [], []
     for path, entry in ds.feature_tree.walk_blobs():

@@ -6,6 +6,7 @@ import json
 import click
 
 from kart_tpu.cli import CliError, cli
+from kart_tpu.diff.estimation import ACCURACY_CHOICES
 from kart_tpu.diff.output import dump_json_output
 from kart_tpu.diff.writers import BaseDiffWriter
 
@@ -38,7 +39,7 @@ OUTPUT_FORMATS = [
 )
 @click.option(
     "--only-feature-count",
-    type=click.Choice(["veryfast", "fast", "medium", "good", "exact"]),
+    type=click.Choice(ACCURACY_CHOICES),
     default=None,
     help="Skip the diff; print an estimated changed-feature count per "
     "dataset at the given accuracy (sampled subtree estimation)",
@@ -104,18 +105,23 @@ def _print_estimated_counts(
             repo, commit_spec, filters, output_path
         )
         return writer.write_diff()
+    wanted = {f.split(":", 1)[0] for f in filters} if filters else None
     counts = estimate_diff_feature_counts(
-        repo, base_rs, target_rs, accuracy=accuracy
+        repo, base_rs, target_rs, accuracy=accuracy, ds_paths=wanted
     )
-    if filters:
-        wanted = {f.split(":", 1)[0] for f in filters}
-        counts = {ds: c for ds, c in counts.items() if ds in wanted}
     if output_format == "json":
         dump_json_output({"kart.diff/v1+feature-count": counts}, output_path)
     else:
+        lines = []
         for ds_path, count in sorted(counts.items()):
-            click.echo(f"{ds_path}:")
-            click.echo(f"\t{count} features changed")
+            lines.append(f"{ds_path}:")
+            lines.append(f"\t{count} features changed")
+        text = "\n".join(lines)
+        if output_path and output_path != "-":
+            with open(output_path, "w") as f:
+                f.write(text + "\n")
+        elif text:
+            click.echo(text)
     return any(counts.values())
 
 

@@ -27,7 +27,8 @@ ACCURACY_CHOICES = (*ACCURACY_SUBTREE_SAMPLES, "exact")
 
 
 def estimate_diff_feature_counts(
-    repo, base_rs, target_rs, *, accuracy="fast", use_annotations=True
+    repo, base_rs, target_rs, *, accuracy="fast", use_annotations=True,
+    ds_paths=None,
 ):
     """-> {ds_path: estimated changed-feature count} between two revisions.
     Counts are exact whenever that's as cheap (small diffs, equal trees)."""
@@ -54,7 +55,10 @@ def estimate_diff_feature_counts(
     target_paths = set(target_datasets.paths()) if target_rs else set()
 
     counts = {}
-    for ds_path in sorted(base_paths | target_paths):
+    wanted = sorted(base_paths | target_paths)
+    if ds_paths is not None:
+        wanted = [p for p in wanted if p in ds_paths]
+    for ds_path in wanted:
         old_ds = base_datasets.get(ds_path) if base_rs else None
         new_ds = target_datasets.get(ds_path) if target_rs else None
         old_tree = old_ds.feature_tree if old_ds else None

@@ -1,0 +1,54 @@
+"""Exit codes and CLI error translation (reference: kart/exceptions.py).
+
+Every failure a user can hit maps to a stable exit code so scripts can
+distinguish "no repository" from "merge conflict" from "bad argument". The
+CLI entrypoint converts internal exceptions (RepoError hierarchy) into clean
+one-line errors with these codes instead of tracebacks.
+"""
+
+SUCCESS = 0
+SUCCESS_WITH_FLAG = 1
+
+INVALID_ARGUMENT = 2
+
+UNCATEGORIZED_ERROR = 11
+
+INVALID_OPERATION = 20
+MERGE_CONFLICT = 21
+PATCH_DOES_NOT_APPLY = 22
+SCHEMA_VIOLATION = 23
+UNSUPPORTED_VERSION = 24
+CRS_ERROR = 25
+GEOMETRY_ERROR = 26
+SPATIAL_FILTER_PK_CONFLICT = 27
+
+NOT_YET_IMPLEMENTED = 30
+
+NOT_FOUND = 40
+NO_REPOSITORY = 41
+NO_DATA = 42
+NO_BRANCH = 43
+NO_CHANGES = 44
+NO_WORKING_COPY = 45
+NO_USER = 46
+NO_COMMIT = 47
+NO_IMPORT_SOURCE = 48
+NO_TABLE = 49
+NO_CONFLICT = 50
+NO_DRIVER = 51
+NO_SPATIAL_FILTER = 52
+
+CONNECTION_ERROR = 60
+
+SUBPROCESS_ERROR_FLAG = 128
+DEFAULT_SUBPROCESS_ERROR = 129
+
+
+def translate_subprocess_exit_code(code):
+    """Subprocess exit codes get 128 added so they can't be confused with our
+    own codes (reference: exceptions.py:45-52)."""
+    if 0 < code < SUBPROCESS_ERROR_FLAG:
+        return SUBPROCESS_ERROR_FLAG + code
+    if SUBPROCESS_ERROR_FLAG <= code < 2 * SUBPROCESS_ERROR_FLAG:
+        return code
+    return SUBPROCESS_ERROR_FLAG

@@ -533,7 +533,12 @@ _WKT_TOKEN = re.compile(r"\s*([A-Za-z]+|\(|\)|,|[-+0-9.eE]+)")
 
 def parse_wkt(wkt):
     tokens = _WKT_TOKEN.findall(wkt)
-    value, pos = _parse_wkt_geom(tokens, 0)
+    try:
+        value, pos = _parse_wkt_geom(tokens, 0)
+    except GeometryError:
+        raise
+    except (ValueError, IndexError) as e:
+        raise GeometryError(f"Invalid WKT {wkt[:60]!r}: {e}") from e
     return _normalise_wkt_arity(value)
 
 

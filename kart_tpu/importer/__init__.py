@@ -278,18 +278,10 @@ class GeoJSONImportSource(ImportSource):
             if prop_types.get(candidate) == "integer":
                 pk_name = candidate
                 break
+        # no natural key -> emit a PK-less schema; the importer wraps the
+        # source in PkGeneratingImportSource for *stable* generated PKs
+        # (row-order PKs would reshuffle on every re-import)
         cols = []
-        if pk_name is None:
-            pk_name = "auto_pk"
-            cols.append(
-                ColumnSchema(
-                    ColumnSchema.deterministic_id(self.path, "auto_pk"),
-                    "auto_pk",
-                    "integer",
-                    0,
-                    {"size": 64},
-                )
-            )
         self._pk_name = pk_name
         for name, t in prop_types.items():
             cols.append(
@@ -298,7 +290,10 @@ class GeoJSONImportSource(ImportSource):
                     name,
                     t or "text",
                     0 if name == pk_name else None,
-                    {"size": 64} if name == pk_name else {},
+                    # JSON numbers are 64-bit; explicit size also makes the
+                    # schema roundtrip the GPKG WC cleanly (INTEGER/REAL read
+                    # back as 64-bit)
+                    {"size": 64} if (t or "text") in ("integer", "float") else {},
                 )
             )
         if has_geom:
@@ -334,7 +329,6 @@ class GeoJSONImportSource(ImportSource):
         return len(self._features_json)
 
     def features(self):
-        auto_pk = 1
         for feat in self._features_json:
             props = feat.get("properties") or {}
             out = {}
@@ -342,14 +336,11 @@ class GeoJSONImportSource(ImportSource):
                 if col.name == "geom" and col.data_type == "geometry":
                     geom = feat.get("geometry")
                     out["geom"] = geojson_to_geometry(geom) if geom else None
-                elif col.name == "auto_pk" and col.name not in props:
-                    out[col.name] = auto_pk
                 else:
                     value = props.get(col.name)
                     if col.data_type == "float" and isinstance(value, int):
                         value = float(value)
                     out[col.name] = value
-            auto_pk += 1
             yield out
 
 
@@ -406,7 +397,7 @@ class CSVImportSource(ImportSource):
                     name,
                     t,
                     0 if name == pk_name else None,
-                    {"size": 64} if name == pk_name else {},
+                    {"size": 64} if t in ("integer", "float") else {},
                 )
             )
         cols.sort(key=lambda c: 0 if c.pk_index is not None else 1)

@@ -20,6 +20,7 @@ keeps that contract with a vectorized matcher:
 """
 
 import json
+import math
 
 import numpy as np
 
@@ -48,7 +49,14 @@ class PkGeneratingImportSource(ImportSource):
     def wrap_if_needed(cls, source, repo=None):
         if source.schema.pk_columns:
             return source
-        return cls(source, repo)
+        # avoid colliding with a real column called auto_pk
+        existing = {c.name for c in source.schema.columns}
+        pk_name = DEFAULT_PK_NAME
+        n = 2
+        while pk_name in existing:
+            pk_name = f"{DEFAULT_PK_NAME}_{n}"
+            n += 1
+        return cls(source, repo, pk_name=pk_name)
 
     @property
     def schema(self) -> Schema:
@@ -164,14 +172,18 @@ def assign_pks(features, col_names, prev_state):
         (pk, np.asarray(old_hash_rows[h], dtype=np.uint32))
         for h, remaining in available.items()
         for pk in remaining
-        if h in old_hash_rows and pk not in used_pks
+        if h in old_hash_rows
+        and pk not in used_pks
+        # schema changed between imports: rows of a different width can't be
+        # compared column-wise — fall through to fresh PKs for those
+        and len(old_hash_rows[h]) == len(col_names)
     ]
     if unmatched_new and candidates:
         new_matrix = col_matrix[unmatched_new]
         old_matrix = np.stack([row for _, row in candidates])  # (O, C)
         # (O, N) matrix of matching-column counts: one broadcasted compare
         sim = (old_matrix[:, None, :] == new_matrix[None, :, :]).sum(axis=2)
-        threshold = max(1, int(len(col_names) * SIMILARITY_THRESHOLD))
+        threshold = max(1, math.ceil(len(col_names) * SIMILARITY_THRESHOLD))
         order = np.argsort(sim, axis=None)[::-1]  # best pairs first
         taken_old, taken_new = set(), set()
         for flat in order:

@@ -66,7 +66,8 @@ def load():
         ]
         lib.sf_filter_packed.restype = ctypes.c_int64
         _lib = lib
-    except OSError as e:
+    except (OSError, AttributeError) as e:
+        # AttributeError: a stale/foreign .so without the expected symbols
         L.warning("could not load native lib %s: %s", path, e)
     return _lib
 

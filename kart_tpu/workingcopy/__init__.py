@@ -62,13 +62,14 @@ def get_working_copy(repo, allow_uncreated=False):
         from kart_tpu.workingcopy.postgis import PostgisWorkingCopy
 
         wc = PostgisWorkingCopy(repo, location)
-    else:
-        from kart_tpu.core.repo import NotFound
+    elif wc_type is WorkingCopyType.SQL_SERVER:
+        from kart_tpu.workingcopy.sqlserver import SqlServerWorkingCopy
 
-        raise NotFound(
-            f"Working copy type {wc_type.value} requires a database driver that "
-            f"is not installed in this environment"
-        )
+        wc = SqlServerWorkingCopy(repo, location)
+    else:
+        from kart_tpu.workingcopy.mysql import MySqlWorkingCopy
+
+        wc = MySqlWorkingCopy(repo, location)
     if not allow_uncreated and not (wc.status() & (WorkingCopyStatus.INITIALISED)):
         return None
     return wc

@@ -355,7 +355,10 @@ class GpkgWorkingCopy:
             (table,),
         ).fetchone()
         if row:
-            if row["identifier"]:
+            # identifier falls back to the table name on write: reading that
+            # default back is not a user edit (reference: gpkg.py:298-390
+            # title/identifier approximation fixups)
+            if row["identifier"] and row["identifier"] != table:
                 out["title"] = row["identifier"]
             if row["description"]:
                 out["description"] = row["description"]

@@ -267,9 +267,13 @@ def test_query_intersects(repo_dir, runner):
 
 
 def test_query_get(repo_dir, runner):
-    r = runner.invoke(cli, ["query", "points", "get", "3"])
+    r = runner.invoke(cli, ["query", "points", "get", "3", "-o", "json"])
     assert r.exit_code == 0, r.output
     assert json.loads(r.output)["kart.query/v1"]["name"] == "feature-3"
+    # default output format is text
+    r = runner.invoke(cli, ["query", "points", "get", "3"])
+    assert r.exit_code == 0, r.output
+    assert "name" in r.output and "feature-3" in r.output
 
 
 def test_query_bad_bbox(repo_dir, runner):

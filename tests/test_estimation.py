@@ -72,7 +72,6 @@ def test_counts_cached_in_annotations(repo_with_edits):
 
 def test_whole_dataset_add_and_remove(tmp_path):
     repo, ds_path = make_imported_repo(tmp_path, n=50)
-    base = repo.structure("[EMPTY]") if False else None
     target = repo.structure("HEAD")
     counts = estimate_diff_feature_counts(
         repo, None, target, accuracy="exact", use_annotations=False

@@ -1,0 +1,409 @@
+"""Server-database working copies: adapters, URL parsing, SQL generation.
+
+Mirrors the reference's strategy for DB backends (tests/conftest.py:911-1040):
+everything that doesn't need a live server — type mapping both directions,
+CREATE TABLE specs, trigger/procedure DDL, upsert SQL, URL parsing, roundtrip
+schema alignment, driver gating — runs hermetically; live round-trip tests
+would skip unless KART_POSTGRES_URL / KART_SQLSERVER_URL / KART_MYSQL_URL
+point at real servers (none do in this environment).
+"""
+
+import pytest
+
+from kart_tpu.adapters.mysql import MySqlAdapter
+from kart_tpu.adapters.postgis import PostgisAdapter
+from kart_tpu.adapters.sqlserver import MS_GEOMETRY_SUBTYPES, SqlServerAdapter
+from kart_tpu.core.repo import InvalidOperation, NotFound
+from kart_tpu.models.schema import ColumnSchema, Schema
+from kart_tpu.workingcopy import WorkingCopyType
+from kart_tpu.workingcopy.mysql import MySqlWorkingCopy
+from kart_tpu.workingcopy.postgis import PostgisWorkingCopy
+from kart_tpu.workingcopy.sqlserver import SqlServerWorkingCopy
+
+ALL_ADAPTERS = [PostgisAdapter, MySqlAdapter, SqlServerAdapter]
+
+
+def col(name, data_type, pk_index=None, **extra):
+    return ColumnSchema(ColumnSchema.new_id(), name, data_type, pk_index, extra)
+
+
+@pytest.fixture
+def points_schema():
+    return Schema(
+        [
+            col("fid", "integer", pk_index=0, size=64),
+            col("geom", "geometry", geometryType="POINT", geometryCRS="EPSG:4326"),
+            col("name", "text", length=40),
+            col("rating", "float", size=64),
+            col("when", "timestamp", timezone="UTC"),
+        ]
+    )
+
+
+# -- type mapping: V2 -> SQL -------------------------------------------------
+
+
+class TestV2ToSql:
+    def test_postgis_types(self):
+        assert PostgisAdapter.v2_type_to_sql_type(col("c", "integer", size=64)) == "BIGINT"
+        assert PostgisAdapter.v2_type_to_sql_type(col("c", "integer", size=8)) == "SMALLINT"
+        assert PostgisAdapter.v2_type_to_sql_type(col("c", "float", size=32)) == "REAL"
+        assert PostgisAdapter.v2_type_to_sql_type(col("c", "text", length=40)) == "VARCHAR(40)"
+        assert PostgisAdapter.v2_type_to_sql_type(col("c", "text")) == "TEXT"
+        assert (
+            PostgisAdapter.v2_type_to_sql_type(col("c", "numeric", precision=10, scale=2))
+            == "NUMERIC(10,2)"
+        )
+        assert PostgisAdapter.v2_type_to_sql_type(col("c", "interval")) == "INTERVAL"
+        assert (
+            PostgisAdapter.v2_type_to_sql_type(col("c", "timestamp", timezone="UTC"))
+            == "TIMESTAMPTZ"
+        )
+        assert (
+            PostgisAdapter.v2_type_to_sql_type(col("c", "timestamp")) == "TIMESTAMP"
+        )
+        assert (
+            PostgisAdapter.v2_type_to_sql_type(
+                col("c", "geometry", geometryType="POINT"), crs_id=4326
+            )
+            == "GEOMETRY(POINT,4326)"
+        )
+
+    def test_mysql_types(self):
+        assert MySqlAdapter.v2_type_to_sql_type(col("c", "boolean")) == "BIT"
+        assert MySqlAdapter.v2_type_to_sql_type(col("c", "integer", size=8)) == "TINYINT"
+        assert MySqlAdapter.v2_type_to_sql_type(col("c", "text")) == "LONGTEXT"
+        assert MySqlAdapter.v2_type_to_sql_type(col("c", "text", length=100)) == "VARCHAR(100)"
+        assert MySqlAdapter.v2_type_to_sql_type(col("c", "blob", length=64)) == "VARBINARY(64)"
+        assert MySqlAdapter.v2_type_to_sql_type(col("c", "interval")) == "TEXT"
+        assert (
+            MySqlAdapter.v2_type_to_sql_type(col("c", "timestamp", timezone="UTC"))
+            == "TIMESTAMP"
+        )
+        assert MySqlAdapter.v2_type_to_sql_type(col("c", "timestamp")) == "DATETIME"
+        assert (
+            MySqlAdapter.v2_type_to_sql_type(
+                col("c", "geometry", geometryType="POINT"), crs_id=4326
+            )
+            == "POINT SRID 4326"
+        )
+
+    def test_sqlserver_types(self):
+        assert SqlServerAdapter.v2_type_to_sql_type(col("c", "boolean")) == "BIT"
+        assert SqlServerAdapter.v2_type_to_sql_type(col("c", "float", size=64)) == "FLOAT"
+        assert SqlServerAdapter.v2_type_to_sql_type(col("c", "text")) == "NVARCHAR(max)"
+        assert (
+            SqlServerAdapter.v2_type_to_sql_type(col("c", "text", length=40))
+            == "NVARCHAR(40)"
+        )
+        assert SqlServerAdapter.v2_type_to_sql_type(col("c", "blob")) == "VARBINARY(max)"
+        assert (
+            SqlServerAdapter.v2_type_to_sql_type(col("c", "timestamp", timezone="UTC"))
+            == "DATETIMEOFFSET"
+        )
+        assert SqlServerAdapter.v2_type_to_sql_type(col("c", "geometry")) == "GEOMETRY"
+
+
+# -- type mapping: SQL -> V2 -------------------------------------------------
+
+
+class TestSqlToV2:
+    @pytest.mark.parametrize("adapter", ALL_ADAPTERS)
+    def test_roundtrip_core_types(self, adapter):
+        """Every V2 type survives v2->sql->v2 modulo documented approximations."""
+        approximations = {
+            (PostgisAdapter, "integer", 8): ("integer", {"size": 16}),
+            (MySqlAdapter, "interval", None): ("text", {}),
+            (SqlServerAdapter, "interval", None): ("text", {}),
+        }
+        cases = [
+            col("c", "boolean"),
+            col("c", "integer", size=16),
+            col("c", "integer", size=64),
+            col("c", "float", size=32),
+            col("c", "float", size=64),
+            col("c", "text"),
+            col("c", "blob"),
+            col("c", "date"),
+            col("c", "time"),
+            col("c", "timestamp", timezone="UTC"),
+            col("c", "interval"),
+            col("c", "numeric", precision=12, scale=3),
+            col("c", "integer", size=8),
+        ]
+        for c in cases:
+            sql = adapter.v2_type_to_sql_type(c)
+            data_type, extra = adapter.sql_type_to_v2(sql)
+            key = (adapter, c.data_type, c.extra_type_info.get("size"))
+            if key in approximations:
+                expected_type, expected_extra = approximations[key]
+                assert data_type == expected_type
+                continue
+            assert data_type == c.data_type, f"{adapter.__name__}: {sql}"
+            for k, v in c.extra_type_info.items():
+                if k in ("length", "size", "timezone", "precision", "scale"):
+                    assert extra.get(k) == v, f"{adapter.__name__}: {sql} {k}"
+
+    def test_postgis_varchar(self):
+        assert PostgisAdapter.sql_type_to_v2("VARCHAR(40)") == ("text", {"length": 40})
+        assert PostgisAdapter.sql_type_to_v2("DOUBLE PRECISION") == ("float", {"size": 64})
+
+    def test_mysql_geometry(self):
+        assert MySqlAdapter.sql_type_to_v2("POINT") == (
+            "geometry",
+            {"geometryType": "POINT"},
+        )
+        assert MySqlAdapter.sql_type_to_v2("GEOMETRY") == ("geometry", {})
+
+    def test_sqlserver_text_types(self):
+        assert SqlServerAdapter.sql_type_to_v2("NVARCHAR(40)") == ("text", {"length": 40})
+        assert SqlServerAdapter.sql_type_to_v2("NTEXT") == ("text", {})
+
+
+# -- CREATE TABLE specs ------------------------------------------------------
+
+
+class TestSqlSpecs:
+    def test_postgis_spec(self, points_schema):
+        spec = PostgisAdapter.v2_schema_to_sql_spec(points_schema, crs_id=4326)
+        assert '"fid" BIGSERIAL' in spec
+        assert '"geom" GEOMETRY(POINT,4326)' in spec
+        assert '"name" VARCHAR(40)' in spec
+        assert '"when" TIMESTAMPTZ' in spec
+        assert 'PRIMARY KEY ("fid")' in spec
+
+    def test_mysql_spec(self, points_schema):
+        spec = MySqlAdapter.v2_schema_to_sql_spec(points_schema, crs_id=4326)
+        assert "`fid` BIGINT AUTO_INCREMENT" in spec
+        assert "`geom` POINT SRID 4326" in spec
+        assert "PRIMARY KEY (`fid`)" in spec
+
+    def test_sqlserver_spec(self, points_schema):
+        spec = SqlServerAdapter.v2_schema_to_sql_spec(points_schema, crs_id=4326)
+        assert '"fid" BIGINT' in spec
+        assert "IDENTITY" not in spec  # explicit pks are written on checkout
+        assert '"geom" GEOMETRY' in spec
+        assert "STGeometryType() IN ('POINT')" in spec
+        assert "STSrid = 4326" in spec
+        assert 'PRIMARY KEY ("fid")' in spec
+
+    def test_sqlserver_subtype_constraints(self):
+        # SURFACE allows itself + POLYGON + CURVEPOLYGON (reference:
+        # adapter/sqlserver.py:109-123)
+        constraint = SqlServerAdapter.geometry_type_constraint("g", "SURFACE")
+        assert "'SURFACE'" in constraint
+        assert "'POLYGON'" in constraint
+        assert "'CURVEPOLYGON'" in constraint
+        assert MS_GEOMETRY_SUBTYPES["Geometry"] >= {"Point", "Polygon", "MultiPolygon"}
+
+
+# -- tracking DDL ------------------------------------------------------------
+
+
+class TestTrackingSql:
+    def test_postgis_base_ddl(self):
+        stmts = PostgisAdapter.base_ddl("wcschema")
+        joined = "\n".join(stmts)
+        assert 'CREATE SCHEMA IF NOT EXISTS "wcschema"' in joined
+        assert "_kart_state" in joined and "_kart_track" in joined
+        assert "CREATE OR REPLACE FUNCTION" in joined
+        assert "TG_OP = 'DELETE'" in joined
+
+    def test_postgis_trigger(self):
+        sql = PostgisAdapter.create_trigger_sql("wcschema", "points", "fid")
+        assert "AFTER INSERT OR UPDATE OR DELETE" in sql
+        assert "'fid'" in sql
+        assert PostgisAdapter.suspend_trigger_sql("wcschema", "points").startswith(
+            "ALTER TABLE"
+        )
+
+    def test_mysql_triggers_one_per_op(self):
+        stmts = MySqlAdapter.create_trigger_sql("wcdb", "points", "fid")
+        assert len(stmts) == 3
+        assert any("AFTER INSERT" in s for s in stmts)
+        assert any("AFTER UPDATE" in s for s in stmts)
+        assert any("AFTER DELETE" in s for s in stmts)
+        # update tracks both OLD and NEW pk
+        upd = next(s for s in stmts if "AFTER UPDATE" in s)
+        assert "OLD.`fid`" in upd and "NEW.`fid`" in upd
+
+    def test_sqlserver_trigger_merges_inserted_and_deleted(self):
+        sql = SqlServerAdapter.create_trigger_sql("wcschema", "points", "fid")
+        assert "AFTER INSERT, UPDATE, DELETE" in sql
+        assert "FROM inserted" in sql and "FROM deleted" in sql
+        assert "MERGE" in sql
+
+
+# -- upserts -----------------------------------------------------------------
+
+
+class TestUpsertSql:
+    cols = ["fid", "geom", "name"]
+    pks = ["fid"]
+
+    def test_postgis(self):
+        sql = PostgisAdapter.upsert_sql("s", "t", self.cols, self.pks)
+        assert "ON CONFLICT" in sql and "EXCLUDED." in sql
+
+    def test_mysql(self):
+        sql = MySqlAdapter.upsert_sql("s", "t", self.cols, self.pks)
+        assert sql.startswith("REPLACE INTO")
+
+    def test_sqlserver(self):
+        sql = SqlServerAdapter.upsert_sql("s", "t", self.cols, self.pks)
+        assert "MERGE" in sql and "WHEN NOT MATCHED" in sql and "WHEN MATCHED" in sql
+
+
+# -- URL parsing -------------------------------------------------------------
+
+
+class TestUrls:
+    def test_type_sniffing(self):
+        assert WorkingCopyType.from_location("postgresql://h/db/sc") == WorkingCopyType.POSTGIS
+        assert WorkingCopyType.from_location("mssql://h/db/sc") == WorkingCopyType.SQL_SERVER
+        assert WorkingCopyType.from_location("mysql://h/db") == WorkingCopyType.MYSQL
+        assert WorkingCopyType.from_location("foo.gpkg") == WorkingCopyType.GPKG
+
+    def test_postgis_url(self):
+        wc = PostgisWorkingCopy(None, "postgresql://user:pw@host:5433/mydb/myschema")
+        assert wc.host == "host"
+        assert wc.port == 5433
+        assert wc.db_name == "mydb"
+        assert wc.db_schema == "myschema"
+        assert wc.username == "user"
+        assert wc.password == "pw"
+        assert "pw" not in wc.clean_location
+
+    def test_postgis_url_needs_two_path_parts(self):
+        with pytest.raises(InvalidOperation, match="2 part"):
+            PostgisWorkingCopy(None, "postgresql://host/only_db")
+
+    def test_mysql_url_single_part(self):
+        wc = MySqlWorkingCopy(None, "mysql://host/mydb")
+        assert wc.db_name == "mydb"
+        assert wc.db_schema == "mydb"  # schema == database in MySQL
+        with pytest.raises(InvalidOperation, match="1 part"):
+            MySqlWorkingCopy(None, "mysql://host/db/extra")
+
+    def test_sqlserver_url(self):
+        wc = SqlServerWorkingCopy(None, "mssql://host/mydb/dbo")
+        assert (wc.db_name, wc.db_schema) == ("mydb", "dbo")
+
+    def test_wrong_scheme_rejected(self):
+        with pytest.raises(InvalidOperation):
+            PostgisWorkingCopy(None, "mysql://host/db")
+
+
+# -- driver gating -----------------------------------------------------------
+
+
+class TestDriverGating:
+    """No DB drivers are baked into this environment: connecting must raise a
+    clear, actionable NotFound, not ImportError (reference gates the same way:
+    tests skip unless KART_*_URL is set)."""
+
+    @pytest.mark.parametrize(
+        "cls,url",
+        [
+            (PostgisWorkingCopy, "postgresql://h/db/sc"),
+            (MySqlWorkingCopy, "mysql://h/db"),
+            (SqlServerWorkingCopy, "mssql://h/db/sc"),
+        ],
+    )
+    def test_connect_without_driver(self, cls, url):
+        wc = cls(None, url)
+        with pytest.raises(NotFound, match="driver"):
+            wc._connect()
+
+
+# -- roundtrip alignment -----------------------------------------------------
+
+
+class TestRoundtripAlignment:
+    def test_postgis_int8_comes_back_int16(self):
+        old = {"dataType": "integer", "size": 8}
+        new = {"dataType": "integer", "size": 16}
+        assert PostgisAdapter.try_align_schema_col(old, new)
+        assert new["dataType"] == "integer" and new["size"] == 8
+
+    def test_mysql_interval_comes_back_text(self):
+        old = {"dataType": "interval"}
+        new = {"dataType": "text"}
+        assert MySqlAdapter.try_align_schema_col(old, new)
+        assert new["dataType"] == "interval"
+
+    def test_genuine_change_not_aligned(self):
+        old = {"dataType": "integer", "size": 32}
+        new = {"dataType": "text"}
+        assert not SqlServerAdapter.try_align_schema_col(old, new)
+
+
+# -- value conversion --------------------------------------------------------
+
+
+class TestValues:
+    def test_postgis_geometry_roundtrip(self):
+        from kart_tpu.geometry import Geometry
+
+        g = Geometry.from_wkt("POINT(174.5 -41.3)", crs_id=4326)
+        gcol = col("geom", "geometry")
+        hex_ewkb = PostgisAdapter.value_from_v2(g, gcol, crs_id=4326)
+        assert isinstance(hex_ewkb, str)
+        back = PostgisAdapter.value_to_v2(hex_ewkb, gcol)
+        assert back.normalised() == g.with_crs_id(0).normalised()
+
+    def test_mysql_geometry_is_wkb(self):
+        from kart_tpu.geometry import Geometry
+
+        g = Geometry.from_wkt("POINT(1 2)")
+        gcol = col("geom", "geometry")
+        wkb = MySqlAdapter.value_from_v2(g, gcol, crs_id=0)
+        assert isinstance(wkb, bytes)
+        assert MySqlAdapter.value_to_v2(wkb, gcol) == g.normalised()
+
+    def test_mysql_bit_reads_back_as_bool(self):
+        bcol = col("b", "boolean")
+        assert MySqlAdapter.value_to_v2(b"\x01", bcol) is True
+        assert MySqlAdapter.value_to_v2(b"\x00", bcol) is False
+        assert MySqlAdapter.value_from_v2(True, bcol) == 1
+
+    def test_placeholders(self):
+        gcol = col("geom", "geometry")
+        assert PostgisAdapter.insert_placeholder(gcol, 4326) == "%s::geometry"
+        assert "ST_GeomFromWKB" in MySqlAdapter.insert_placeholder(gcol, 4326)
+        assert "STGeomFromWKB(?, 4326)" in SqlServerAdapter.insert_placeholder(gcol, 4326)
+        assert "ST_AsEWKB" in PostgisAdapter.select_expression(gcol)
+        assert ".STAsBinary()" in SqlServerAdapter.select_expression(gcol)
+
+
+# -- live server round-trips (skipped without a server) -----------------------
+
+
+@pytest.mark.parametrize(
+    "env_var,cls",
+    [
+        ("KART_POSTGRES_URL", PostgisWorkingCopy),
+        ("KART_MYSQL_URL", MySqlWorkingCopy),
+        ("KART_SQLSERVER_URL", SqlServerWorkingCopy),
+    ],
+)
+def test_live_roundtrip(env_var, cls, tmp_path):
+    import os
+
+    url = os.environ.get(env_var)
+    if not url:
+        pytest.skip(f"{env_var} not set - no live server available")
+    from kart_tpu.core.repo import KartRepo
+    from tests.helpers import make_points_repo
+
+    repo = make_points_repo(tmp_path / "repo")
+    wc = cls(repo, url)
+    wc.create_and_initialise()
+    try:
+        rs = repo.structure("HEAD")
+        wc.write_full(rs, *rs.datasets)
+        assert wc.get_db_tree() == rs.tree_oid
+        for ds in rs.datasets:
+            assert not wc.diff_dataset_to_working_copy(ds)
+    finally:
+        wc.delete()
