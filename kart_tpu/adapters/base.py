@@ -18,9 +18,17 @@ import re
 from kart_tpu.models.schema import ColumnSchema, Schema
 
 
+# tracking-table names shared by every server-database working copy
+KART_STATE = "_kart_state"
+KART_TRACK = "_kart_track"
+
+
 class BaseAdapter:
     """One subclass per SQL dialect. Subclasses fill in the class attrs and
     override the hooks whose behaviour is dialect-specific."""
+
+    KART_STATE = KART_STATE
+    KART_TRACK = KART_TRACK
 
     # V2 data type -> SQL type. Values are either a string or a dict keyed by
     # the relevant extra_type_info discriminator (integer/float: "size",

@@ -9,12 +9,9 @@ TEXT. text/blob get VARCHAR/VARBINARY(length) when a length fits, else
 LONGTEXT/LONGBLOB.
 """
 
-from kart_tpu.adapters.base import BaseAdapter
+from kart_tpu.adapters.base import KART_STATE, KART_TRACK, BaseAdapter
 from kart_tpu.geometry import Geometry
 from kart_tpu.models.schema import ColumnSchema
-
-KART_STATE = "_kart_state"
-KART_TRACK = "_kart_track"
 
 # Max length usable in VARCHAR/VARBINARY given MySQL's 65535-byte row limit
 # (reference: adapter/mysql.py _MAX_SPECIFIABLE_LENGTH).

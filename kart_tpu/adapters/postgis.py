@@ -7,12 +7,9 @@ re-wrap as GPKG geometry. int8 is approximated as SMALLINT (PostgreSQL has no
 1-byte integer), which the roundtrip context restores.
 """
 
-from kart_tpu.adapters.base import BaseAdapter
+from kart_tpu.adapters.base import KART_STATE, KART_TRACK, BaseAdapter
 from kart_tpu.geometry import Geometry
 from kart_tpu.models.schema import ColumnSchema
-
-KART_STATE = "_kart_state"
-KART_TRACK = "_kart_track"
 
 
 class PostgisAdapter(BaseAdapter):
@@ -191,7 +188,7 @@ class PostgisAdapter(BaseAdapter):
         return f'ALTER TABLE {tbl} DISABLE TRIGGER "_kart_track_trigger"'
 
     @classmethod
-    def resume_trigger_sql(cls, db_schema, table_name):
+    def resume_trigger_sql(cls, db_schema, table_name, pk_name=None):
         tbl = cls.quote_table(table_name, db_schema)
         return f'ALTER TABLE {tbl} ENABLE TRIGGER "_kart_track_trigger"'
 

@@ -7,12 +7,9 @@ the wire as WKB via ``geometry::STGeomFromWKB(?, srid)`` / ``.STAsBinary()``.
 ``interval`` approximates to TEXT (NVARCHAR); geometryType does not roundtrip.
 """
 
-from kart_tpu.adapters.base import BaseAdapter
+from kart_tpu.adapters.base import KART_STATE, KART_TRACK, BaseAdapter
 from kart_tpu.geometry import Geometry
 from kart_tpu.models.schema import ColumnSchema
-
-KART_STATE = "_kart_state"
-KART_TRACK = "_kart_track"
 
 
 def _build_transitive_subtypes(direct, root, acc=None):
@@ -239,7 +236,7 @@ class SqlServerAdapter(BaseAdapter):
         return f"DISABLE TRIGGER {trig} ON {tbl}"
 
     @classmethod
-    def resume_trigger_sql(cls, db_schema, table_name):
+    def resume_trigger_sql(cls, db_schema, table_name, pk_name=None):
         trig = cls.quote(f"_kart_track_{table_name}_trigger")
         tbl = cls.quote_table(table_name, db_schema)
         return f"ENABLE TRIGGER {trig} ON {tbl}"

@@ -207,14 +207,11 @@ def query(ctx, dataset, operation, argument, output_format):
     geom_col = ds.geom_column_name
     if geom_col is None:
         raise CliError(f"Dataset {dataset!r} has no geometry column")
-    if ds.feature_tree is None:
-        dump_json_output(
-            {"kart.query/v1": {"count": 0, "features": []}}, "-"
-        )
-        return
-    odb = ds.feature_tree.odb
+    odb = ds.feature_tree.odb if ds.feature_tree is not None else None
     paths, envelopes = [], []
-    for path, entry in ds.feature_tree.walk_blobs():
+    for path, entry in (
+        ds.feature_tree.walk_blobs() if ds.feature_tree is not None else ()
+    ):
         feature = ds.get_feature(path=path, data=odb.read_blob(entry.oid))
         geom = feature.get(geom_col)
         env = Geometry.of(geom).envelope() if geom is not None else None

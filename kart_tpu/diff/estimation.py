@@ -47,6 +47,9 @@ def estimate_diff_feature_counts(
             base_tree, target_tree, f"feature-change-counts-{accuracy}"
         )
         if cached is not None:
+            # the cache always holds *full* counts; subset for filtered calls
+            if ds_paths is not None:
+                return {p: c for p, c in cached.items() if p in ds_paths}
             return cached
 
     base_datasets = base_rs.datasets if base_rs else {}
@@ -67,7 +70,9 @@ def estimate_diff_feature_counts(
         if count:
             counts[ds_path] = count
 
-    if annotations is not None:
+    # only full runs populate the cache — a filtered subset under the
+    # unfiltered key would poison later unfiltered reads
+    if annotations is not None and ds_paths is None:
         annotations.set(
             base_tree, target_tree, counts, f"feature-change-counts-{accuracy}"
         )

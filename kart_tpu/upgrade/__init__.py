@@ -29,19 +29,40 @@ class UpgradeError(InvalidOperation):
     pass
 
 
+def source_repo_version(repo):
+    """The version to upgrade *from*: explicit config when present, else
+    detected from the HEAD tree (legacy sno repos predate the config key)."""
+    from kart_tpu.core.repo import KartConfigKeys
+    from kart_tpu.upgrade.legacy import detect_tree_version
+
+    value = repo.config.get_int(KartConfigKeys.KART_REPOSTRUCTURE_VERSION)
+    if value is None:
+        value = repo.config.get_int(KartConfigKeys.SNO_REPOSTRUCTURE_VERSION)
+    if value is not None:
+        return value
+    head = repo.refs.head_resolved()
+    if head is not None:
+        tree_oid = repo.odb.read_commit(head).tree
+        detected = detect_tree_version(repo.odb.tree(tree_oid))
+        if detected is not None:
+            return detected
+    return repo.version
+
+
 def upgrade_repo(source_path, dest_path, *, progress=None):
-    """Rewrite SOURCE (repo version 2) into a brand-new V3 repo at DEST.
+    """Rewrite SOURCE (repo version 0/1/2) into a brand-new V3 repo at DEST.
     Returns (dest_repo, commit_map {old_oid: new_oid})."""
     src = KartRepo(source_path)
-    src_version = src.version
+    src_version = source_repo_version(src)
     if src_version == 3:
         raise UpgradeError("Repository is already repo structure version 3")
-    dataset_class_for_version(src_version)  # raises for unsupported versions
+    if src_version not in (0, 1, 2):
+        raise UpgradeError(f"Can't upgrade from repo structure version {src_version}")
 
     dest = KartRepo.init_repository(dest_path, bare=False)
     dest.config["kart.repostructure.version"] = "3"
 
-    commit_map = _rewrite_history(src, dest, progress=progress)
+    commit_map = _rewrite_history(src, dest, src_version, progress=progress)
     _map_refs(src, dest, commit_map)
     return dest, commit_map
 
@@ -50,18 +71,20 @@ def upgrade_in_place(repo, *, progress=None):
     """Upgrade a V2 repo to V3 in its own object store. Feature blob content
     is shared between versions, so only trees + commits are rewritten.
     Returns the commit map."""
-    if repo.version == 3:
+    src_version = source_repo_version(repo)
+    if src_version == 3:
         raise UpgradeError("Repository is already repo structure version 3")
-    commit_map = _rewrite_history(repo, repo, progress=progress)
+    if src_version not in (0, 1, 2):
+        raise UpgradeError(f"Can't upgrade from repo structure version {src_version}")
+    commit_map = _rewrite_history(repo, repo, src_version, progress=progress)
     _map_refs(repo, repo, commit_map, in_place=True)
     repo.config["kart.repostructure.version"] = "3"
     return commit_map
 
 
-def _rewrite_history(src, dest, *, progress=None):
+def _rewrite_history(src, dest, src_version, *, progress=None):
     """Topological walk + per-commit tree re-encode. src and dest may be the
     same repo (in-place)."""
-    src_class = dataset_class_for_version(src.version)
     tips = {oid for _, oid in src.refs.iter_refs("refs/")}
     head = src.refs.head_resolved()
     if head:
@@ -76,7 +99,7 @@ def _rewrite_history(src, dest, *, progress=None):
         commit = src.odb.read_commit(old_oid)
         new_tree = tree_map.get(commit.tree)
         if new_tree is None:
-            new_tree = _upgrade_tree(src, dest, old_oid, src_class)
+            new_tree = _upgrade_tree(src, dest, old_oid, src_version)
             tree_map[commit.tree] = new_tree
         new_commit = type(commit)(
             tree=new_tree,
@@ -93,17 +116,28 @@ def _rewrite_history(src, dest, *, progress=None):
     return commit_map
 
 
-def _upgrade_tree(src, dest, commit_oid, src_class):
+def _datasets_at_commit(src, commit_oid, src_version):
+    """-> {path: dataset reader} for one commit, across all source versions."""
+    if src_version >= 2:
+        structure = RepoStructure(src, commit_oid)
+        return {ds.path: ds for ds in structure.datasets}
+    from kart_tpu.upgrade.legacy import discover_legacy_datasets
+
+    root = src.odb.tree(src.odb.read_commit(commit_oid).tree)
+    return discover_legacy_datasets(src.odb, root, src_version)
+
+
+def _upgrade_tree(src, dest, commit_oid, src_version):
     """Re-encode every dataset of one commit into a V3 tree; non-dataset
     blobs (attachments) are carried over as-is."""
-    structure = RepoStructure(src, commit_oid)
+    datasets = _datasets_at_commit(src, commit_oid, src_version)
     tb = TreeBuilder(dest.odb)
 
     # carry over non-dataset top-level items (attachments, LICENSE etc.)
     root = src.odb.tree(src.odb.read_commit(commit_oid).tree)
-    _copy_non_dataset_items(src, dest, root, "", tb, src_class)
+    _copy_non_dataset_items(src, dest, root, "", tb, src_version, set(datasets))
 
-    for ds in structure.datasets:
+    for ds in datasets.values():
         _upgrade_dataset(ds, dest, tb)
 
     # version marker blob, for reference-format parity
@@ -112,16 +146,33 @@ def _upgrade_tree(src, dest, commit_oid, src_class):
     return tb.flush()
 
 
-def _copy_non_dataset_items(src, dest, tree, prefix, tb, src_class):
-    """Carry over everything except dataset inner trees (which are
-    re-encoded) — attachments at any depth survive the rewrite."""
+def _copy_non_dataset_items(src, dest, tree, prefix, tb, src_version, ds_paths):
+    """Carry over everything except dataset *content* (which is re-encoded) —
+    attachments at any depth survive the rewrite, including attachments
+    sitting beside a dataset's inner tree."""
+    if src_version >= 2:
+        skip_names = {dataset_class_for_version(src_version).DATASET_DIRNAME}
+        in_dataset_skips = skip_names  # dirname is unambiguous at any depth
+    elif src_version == 1:
+        in_dataset_skips = {".sno-table"}
+        skip_names = in_dataset_skips
+    else:  # V0 keeps content in plain meta/ + features/ dirs: only skip
+        # those inside a discovered dataset tree
+        skip_names = set()
+        in_dataset_skips = {"meta", "features"}
+    is_dataset_root = prefix.rstrip("/") in ds_paths
     for entry in tree.entries():
         path = f"{prefix}{entry.name}"
-        if entry.name == src_class.DATASET_DIRNAME or entry.name == ".kart.repostructure.version":
-            continue  # re-encoded separately
+        if entry.name == ".kart.repostructure.version":
+            continue
+        if entry.name in skip_names or (
+            is_dataset_root and entry.name in in_dataset_skips
+        ):
+            continue  # dataset content: re-encoded separately
         if entry.is_tree:
             _copy_non_dataset_items(
-                src, dest, src.odb.tree(entry.oid), path + "/", tb, src_class
+                src, dest, src.odb.tree(entry.oid), path + "/", tb,
+                src_version, ds_paths,
             )
         else:
             if src is not dest:
@@ -148,8 +199,18 @@ def _upgrade_dataset(ds, dest, tb):
     v3 = _V3Encoder(ds.path, schema)
     prefix = f"{v3.inner_path}/{Dataset3.FEATURE_PATH}"
     enc = v3.path_encoder
-    # feature blob content is version-invariant: reuse the blob oid, only
-    # re-path it (the in-place fast path; for cross-repo the blob is copied)
+    if getattr(ds, "VERSION", 2) < 2:
+        # legacy blob content differs from V2/V3: re-encode every feature
+        for feature in ds.features():
+            pk_values, blob = schema.encode_feature_blob(feature)
+            tb.insert(
+                prefix + enc.encode_pks_to_path(pk_values),
+                dest.odb.write_blob(blob),
+            )
+        return
+    # V2 -> V3: feature blob content is version-invariant: reuse the blob
+    # oid, only re-path it (the in-place fast path; for cross-repo the blob
+    # is copied)
     for old_rel, entry in ds.feature_tree.walk_blobs() if ds.feature_tree else ():
         pk_values = ds.decode_path_to_pks(old_rel)
         if dest.odb is not ds.tree.odb:

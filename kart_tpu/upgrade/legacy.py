@@ -1,0 +1,376 @@
+"""Readers for legacy Datasets V0/V1 trees, used only by `kart upgrade`
+(reference: kart/upgrade/upgrade_v0.py, upgrade_v1.py).
+
+Both legacy formats serialised their meta as JSON dumps of the *GPKG* meta
+tables (``sqlite_table_info``, ``gpkg_contents``, ``gpkg_geometry_columns``,
+``gpkg_spatial_ref_sys``) rather than the V2 schema.json model, so upgrading
+starts by re-deriving a V2 schema from those
+(reference: adapter/gpkg.py all_v2_meta_items_from_gpkg_meta_items).
+
+* **V0 layout**: ``<ds>/meta/<gpkg item>`` + one *directory per feature* at
+  ``<ds>/features/<4hex>/<uuid>/`` whose entries are one blob per attribute
+  (geometry raw GPKG bytes, everything else JSON).
+* **V1 layout**: ``<ds>/.sno-table/meta/...`` (+ ``fields/<name>`` = column id,
+  ``primary_key``) and one *msgpack blob per feature* at
+  ``.sno-table/<2hex>/<2hex>/<urlsafe-b64(msgpack(pk))>`` mapping column id ->
+  value (geometry as msgpack ext code 71).
+
+Neither stored normalised geometries — every geometry is re-normalised
+(little-endian + envelope) on read so upgraded repos match V2/V3 content
+addressing.
+"""
+
+import base64
+import functools
+import re
+
+import msgpack
+
+from kart_tpu.adapters import gpkg as gpkg_adapter
+from kart_tpu.core.odb import TreeView
+from kart_tpu.core.serialise import json_unpack
+from kart_tpu.geometry import Geometry
+from kart_tpu.models.schema import ColumnSchema, Schema
+
+GPKG_META_ITEM_NAMES = (
+    "sqlite_table_info",
+    "gpkg_contents",
+    "gpkg_geometry_columns",
+    "gpkg_spatial_ref_sys",
+    "gpkg_metadata",
+    "gpkg_metadata_reference",
+)
+
+
+def crs_identifier(srs_row):
+    org = srs_row.get("organization")
+    code = srs_row.get("organization_coordsys_id")
+    if org and org.upper() != "NONE":
+        return f"{org}:{code}"
+    from kart_tpu.crs import get_identifier_str
+
+    return get_identifier_str(srs_row.get("definition") or "") or f"SRID:{srs_row.get('srs_id')}"
+
+
+def gpkg_meta_items_to_v2(gpkg_meta_items, id_salt):
+    """JSON'd GPKG meta tables -> V2 meta items (title, description,
+    schema.json as a Schema object, crs/<ident>.wkt)."""
+    out = {}
+    contents = gpkg_meta_items.get("gpkg_contents") or {}
+    if contents.get("identifier"):
+        out["title"] = contents["identifier"]
+    if contents.get("description"):
+        out["description"] = contents["description"]
+
+    geom_cols = gpkg_meta_items.get("gpkg_geometry_columns") or {}
+    srs_rows = gpkg_meta_items.get("gpkg_spatial_ref_sys") or []
+    if isinstance(srs_rows, dict):
+        srs_rows = [srs_rows]
+    srs_by_id = {row.get("srs_id"): row for row in srs_rows}
+
+    geom_col_name = geom_cols.get("column_name")
+    geom_info = None
+    if geom_col_name:
+        srs_row = srs_by_id.get(geom_cols.get("srs_id"))
+        geom_info = {
+            "geometry_type_name": geom_cols.get("geometry_type_name", "GEOMETRY"),
+            "z": geom_cols.get("z", 0),
+            "m": geom_cols.get("m", 0),
+            "crs_identifier": crs_identifier(srs_row) if srs_row else None,
+        }
+
+    cols = []
+    for info in gpkg_meta_items.get("sqlite_table_info") or []:
+        name = info["name"]
+        is_geom = name == geom_col_name
+        data_type, extra = gpkg_adapter.sqlite_type_to_v2(
+            info.get("type"), geom_info=geom_info if is_geom else None
+        )
+        pk = info.get("pk") or 0
+        pk_index = pk - 1 if pk > 0 else None
+        if pk_index is not None and data_type == "integer":
+            extra = {**extra, "size": 64}
+        cols.append(
+            ColumnSchema(
+                ColumnSchema.deterministic_id(name, data_type, id_salt),
+                name,
+                data_type,
+                pk_index,
+                extra,
+            )
+        )
+    out["schema.json"] = Schema(cols)
+
+    for row in srs_rows:
+        definition = row.get("definition")
+        if definition and definition.strip().lower() != "undefined":
+            out[f"crs/{crs_identifier(row)}.wkt"] = definition
+    return out
+
+
+class LegacyDataset:
+    """Common surface the upgrade rewriter needs: path/schema/meta/features."""
+
+    VERSION = None
+
+    def __init__(self, tree, path, repo=None):
+        self.tree = tree
+        self.path = path
+        self.repo = repo
+
+    @functools.cached_property
+    def _v2_meta(self):
+        return gpkg_meta_items_to_v2(self._gpkg_meta_items(), self.path)
+
+    @property
+    def schema(self) -> Schema:
+        return self._v2_meta["schema.json"]
+
+    def get_meta_item(self, name):
+        value = self._v2_meta.get(name)
+        if name == "schema.json" and value is not None:
+            return value.to_column_dicts()
+        return value
+
+    def meta_items(self):
+        return {k: self.get_meta_item(k) for k in self._v2_meta}
+
+    def crs_identifiers(self):
+        return [
+            k[len("crs/") : -len(".wkt")]
+            for k in self._v2_meta
+            if k.startswith("crs/")
+        ]
+
+    def get_crs_definition(self, identifier=None):
+        if identifier is None:
+            idents = self.crs_identifiers()
+            identifier = idents[0] if idents else None
+        return self._v2_meta.get(f"crs/{identifier}.wkt")
+
+    @property
+    def geom_column_name(self):
+        col = self.schema.first_geometry_column
+        return col.name if col else None
+
+    def _meta_tree(self):
+        raise NotImplementedError
+
+    def _gpkg_meta_items(self):
+        meta_tree = self._meta_tree()
+        out = {}
+        for name in GPKG_META_ITEM_NAMES:
+            node = meta_tree.get_or_none(name) if meta_tree else None
+            out[name] = json_unpack(node.data) if node is not None else None
+        return out
+
+
+class Dataset0(LegacyDataset):
+    """V0: one directory per feature, one blob per attribute
+    (reference: upgrade_v0.py:11-92)."""
+
+    VERSION = 0
+    FEATURE_DIR = "features"
+
+    _RE_DIR1 = re.compile(r"[0-9a-f]{4}$")
+    _RE_DIR2 = re.compile(r"[0-9a-f\-]{36}$")
+
+    @classmethod
+    def is_dataset_tree(cls, tree):
+        meta = tree.get_or_none("meta")
+        if not isinstance(meta, TreeView):
+            return False
+        version = meta.get_or_none("version")
+        return version is not None and not isinstance(version, TreeView)
+
+    def _meta_tree(self):
+        node = self.tree.get_or_none("meta")
+        return node if isinstance(node, TreeView) else None
+
+    def _iter_feature_dirs(self):
+        features = self.tree.get_or_none(self.FEATURE_DIR)
+        if not isinstance(features, TreeView):
+            return
+        for dir1 in features:
+            if not isinstance(dir1, TreeView) or not self._RE_DIR1.match(dir1.name):
+                continue
+            for dir2 in dir1:
+                if isinstance(dir2, TreeView) and self._RE_DIR2.match(dir2.name):
+                    yield dir2
+
+    def features(self):
+        geom_column = self.geom_column_name
+        columns = self.schema.columns
+        for feature_dir in self._iter_feature_dirs():
+            feature = {}
+            for attr_blob in feature_dir:
+                if isinstance(attr_blob, TreeView):
+                    continue
+                if attr_blob.name == geom_column:
+                    feature[attr_blob.name] = Geometry.of(attr_blob.data).normalised()
+                else:
+                    feature[attr_blob.name] = json_unpack(attr_blob.data)
+            for c in columns:  # attributes with no blob are NULL
+                feature.setdefault(c.name, None)
+            yield feature
+
+    @property
+    def feature_count(self):
+        return sum(1 for _ in self._iter_feature_dirs())
+
+
+class Dataset1(LegacyDataset):
+    """V1: msgpack blob per feature under .sno-table
+    (reference: upgrade_v1.py:18-180)."""
+
+    VERSION = 1
+    DATASET_DIRNAME = ".sno-table"
+    MSGPACK_EXT_GEOM = 71  # 'G'
+
+    _RE_DIR = re.compile(r"[0-9a-f]{2}$")
+
+    @classmethod
+    def is_dataset_tree(cls, tree):
+        inner = tree.get_or_none(cls.DATASET_DIRNAME)
+        return isinstance(inner, TreeView)
+
+    @property
+    def inner_tree(self):
+        return self.tree.get_or_none(self.DATASET_DIRNAME)
+
+    def _meta_tree(self):
+        inner = self.inner_tree
+        node = inner.get_or_none("meta") if inner else None
+        return node if isinstance(node, TreeView) else None
+
+    @functools.cached_property
+    def cid_field_map(self):
+        meta = self._meta_tree()
+        fields = meta.get_or_none("fields") if meta else None
+        cid_map = {}
+        if isinstance(fields, TreeView):
+            for blob in fields:
+                if not isinstance(blob, TreeView):
+                    cid_map[json_unpack(blob.data)] = blob.name
+        return cid_map
+
+    @functools.cached_property
+    def primary_key(self):
+        meta = self._meta_tree()
+        pk_blob = meta.get_or_none("primary_key") if meta else None
+        if pk_blob is not None and not isinstance(pk_blob, TreeView):
+            return json_unpack(pk_blob.data)
+        pk_cols = self.schema.pk_columns
+        return pk_cols[0].name if pk_cols else None
+
+    def _msgpack_ext(self, code, data):
+        if code == self.MSGPACK_EXT_GEOM:
+            return Geometry.of(data)
+        return msgpack.ExtType(code, data)
+
+    @staticmethod
+    def decode_path_to_1pk(leaf_name):
+        return msgpack.unpackb(
+            base64.urlsafe_b64decode(leaf_name), raw=False
+        )
+
+    def _iter_feature_blobs(self):
+        inner = self.inner_tree
+        if inner is None:
+            return
+        for dir1 in inner:
+            if not isinstance(dir1, TreeView) or not self._RE_DIR.match(dir1.name):
+                continue
+            for dir2 in dir1:
+                if not isinstance(dir2, TreeView) or not self._RE_DIR.match(dir2.name):
+                    continue
+                for leaf in dir2:
+                    if not isinstance(leaf, TreeView):
+                        yield leaf
+
+    def features(self):
+        geom_column = self.geom_column_name
+        cid_map = self.cid_field_map
+        pk_name = self.primary_key
+        columns = self.schema.columns
+        for leaf in self._iter_feature_blobs():
+            feature = {pk_name: self.decode_path_to_1pk(leaf.name)}
+            raw = msgpack.unpackb(
+                leaf.data,
+                ext_hook=self._msgpack_ext,
+                raw=False,
+                strict_map_key=False,  # V1 maps are keyed by int column id
+            )
+            for cid, value in sorted(raw.items()):
+                name = cid_map.get(cid)
+                if name is None:
+                    continue
+                if name == geom_column and value is not None:
+                    value = Geometry.of(value).normalised()
+                feature[name] = value
+            for c in columns:  # columns added after this blob was written
+                feature.setdefault(c.name, None)
+            yield feature
+
+    @property
+    def feature_count(self):
+        return sum(1 for _ in self._iter_feature_blobs())
+
+
+LEGACY_DATASET_CLASSES = {0: Dataset0, 1: Dataset1}
+
+
+def discover_legacy_datasets(odb, root_tree, version, prefix="", depth=4):
+    """Walk a commit's root tree for V0/V1 dataset trees -> {path: dataset}.
+    (Legacy repos are flat in practice; depth matches V2/V3 discovery.)"""
+    ds_class = LEGACY_DATASET_CLASSES[version]
+    found = {}
+    _walk_legacy(odb, root_tree, ds_class, prefix, found, depth)
+    return found
+
+
+def _walk_legacy(odb, tree, ds_class, prefix, found, depth):
+    if ds_class.is_dataset_tree(tree):
+        found[prefix] = ds_class(tree, prefix)
+        return
+    if depth <= 0:
+        return
+    for entry in tree.entries():
+        if not entry.is_tree:
+            continue
+        sub = f"{prefix}/{entry.name}" if prefix else entry.name
+        _walk_legacy(odb, TreeView(odb, entry.oid), ds_class, sub, found, depth - 1)
+
+
+def detect_tree_version(tree, depth=5):
+    """Repo-structure version from a commit's root tree, when config has no
+    version (reference: kart/repo_version.py reads the marker blob, falling
+    back to dataset dirnames for V0/V1 which predate the marker)."""
+    if tree is None:
+        return None
+    marker = tree.get_or_none(".kart.repostructure.version")
+    if marker is None:
+        marker = tree.get_or_none(".sno.repository.version")
+    if marker is not None and not isinstance(marker, TreeView):
+        return int(marker.data.decode().strip())
+    return _detect_by_dirname(tree, depth)
+
+
+def _detect_by_dirname(tree, depth):
+    for entry in tree.entries():
+        if entry.name == ".table-dataset":
+            return 3
+        if entry.name == ".sno-dataset":
+            return 2
+        if entry.name == ".sno-table":
+            return 1
+    if Dataset0.is_dataset_tree(tree):
+        return 0
+    if depth <= 0:
+        return None
+    for entry in tree.entries():
+        if entry.is_tree:
+            sub = detect_tree_version(TreeView(tree.odb, entry.oid), depth - 1)
+            if sub is not None:
+                return sub
+    return None

@@ -20,6 +20,7 @@ via vendored psycopg2/pyodbc, skipping tests unless KART_*_URL is set).
 import contextlib
 from urllib.parse import urlsplit, unquote
 
+from kart_tpu.adapters.base import KART_STATE, KART_TRACK
 from kart_tpu.core.repo import InvalidOperation, NotFound
 from kart_tpu.crs import get_identifier_int, get_identifier_str
 from kart_tpu.diff.structs import (
@@ -31,9 +32,6 @@ from kart_tpu.diff.structs import (
 )
 from kart_tpu.models.schema import ColumnSchema, Schema
 from kart_tpu.workingcopy import WorkingCopyStatus
-
-KART_STATE = "_kart_state"
-KART_TRACK = "_kart_track"
 
 
 class Mismatch(InvalidOperation):
@@ -327,12 +325,7 @@ class DatabaseServerWorkingCopy:
         try:
             yield
         finally:
-            try:
-                resume = self.ADAPTER.resume_trigger_sql(
-                    self.db_schema, table, pk_name
-                )
-            except TypeError:
-                resume = self.ADAPTER.resume_trigger_sql(self.db_schema, table)
+            resume = self.ADAPTER.resume_trigger_sql(self.db_schema, table, pk_name)
             if isinstance(resume, str):
                 resume = [resume]
             for stmt in resume:

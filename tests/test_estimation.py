@@ -101,3 +101,22 @@ def test_cli_only_feature_count(repo_with_edits, monkeypatch):
     r = runner.invoke(cli, ["diff", "--only-feature-count", "exact", "HEAD^...HEAD"])
     assert r.exit_code == 0, r.output
     assert "13 features changed" in r.output
+
+
+def test_filtered_counts_dont_poison_annotation_cache(repo_with_edits):
+    """A ds_paths-filtered call must not cache its subset under the
+    unfiltered key; filtered calls subset the cached full dict."""
+    repo, ds_path = repo_with_edits
+    base = repo.structure("HEAD^")
+    target = repo.structure("HEAD")
+    filtered = estimate_diff_feature_counts(
+        repo, base, target, accuracy="exact", ds_paths={"no-such-dataset"}
+    )
+    assert filtered == {}
+    full = estimate_diff_feature_counts(repo, base, target, accuracy="exact")
+    assert full and full.get(ds_path)
+    # cached full result subsets correctly for filtered reads
+    again = estimate_diff_feature_counts(
+        repo, base, target, accuracy="exact", ds_paths={ds_path}
+    )
+    assert again == {ds_path: full[ds_path]}

@@ -140,3 +140,198 @@ def test_upgrade_cli(tmp_path, monkeypatch):
     r = runner.invoke(cli, ["upgrade", "--in-place", repo.workdir])
     assert r.exit_code == 0, r.output
     assert "Upgraded 2 commits in place" in r.output
+
+
+# -- legacy V0 / V1 -----------------------------------------------------------
+
+V1_TABLE_INFO = [
+    {"cid": 0, "name": "fid", "type": "INTEGER", "notnull": 1, "pk": 1},
+    {"cid": 1, "name": "name", "type": "TEXT", "notnull": 0, "pk": 0},
+    {"cid": 2, "name": "geom", "type": "POINT", "notnull": 0, "pk": 0},
+]
+SRS_4326 = {
+    "srs_name": "WGS 84",
+    "srs_id": 4326,
+    "organization": "EPSG",
+    "organization_coordsys_id": 4326,
+    "definition": 'GEOGCS["WGS 84",DATUM["WGS_1984"]]',
+}
+
+
+def make_v1_repo(tmp_path):
+    """Hand-built V1 (.sno-table) repo: msgpack blob per feature, json'd GPKG
+    meta tables, fields/<name> -> column id."""
+    import base64
+
+    import msgpack
+
+    from kart_tpu.core.objects import Signature
+    from kart_tpu.core.serialise import json_pack
+    from kart_tpu.geometry import Geometry
+
+    repo = KartRepo.init_repository(tmp_path / "v1repo")
+    repo.config.set_many(
+        {
+            "user.name": "V1 author",
+            "user.email": "v1@example.com",
+            # real sno-era repos carry the legacy key (or none at all —
+            # tree detection covers that, tested separately)
+            "sno.repository.version": "1",
+            "kart.repostructure.version": "1",
+        }
+    )
+    tb = TreeBuilder(repo.odb)
+    inner = "mytable/.sno-table"
+    meta = {
+        "version": {"version": "1.0"},
+        "primary_key": "fid",
+        "sqlite_table_info": V1_TABLE_INFO,
+        "gpkg_contents": {"identifier": "My V1 table", "description": "old"},
+        "gpkg_geometry_columns": {
+            "table_name": "mytable",
+            "column_name": "geom",
+            "geometry_type_name": "POINT",
+            "srs_id": 4326,
+            "z": 0,
+            "m": 0,
+        },
+        "gpkg_spatial_ref_sys": [SRS_4326],
+    }
+    for name, value in meta.items():
+        tb.insert(f"{inner}/meta/{name}", repo.odb.write_blob(json_pack(value)))
+    for name, cid in (("fid", 0), ("name", 1), ("geom", 2)):
+        tb.insert(
+            f"{inner}/meta/fields/{name}", repo.odb.write_blob(json_pack(cid))
+        )
+    for i in range(1, 4):
+        geom = Geometry.from_wkt(f"POINT({i} {i})")
+        packed = msgpack.packb(
+            {0: i, 1: f"v1-row-{i}", 2: msgpack.ExtType(71, bytes(geom))},
+            use_bin_type=True,
+        )
+        leaf = base64.urlsafe_b64encode(msgpack.packb(i)).decode()
+        tb.insert(
+            f"{inner}/{i:02x}/{i:02x}/{leaf}", repo.odb.write_blob(packed)
+        )
+    sig = Signature.now("V1 author", "v1@example.com")
+    tree = tb.flush()
+    repo.create_commit("HEAD", tree, "v1 import", [], author=sig, committer=sig)
+    return repo
+
+
+def make_v0_repo(tmp_path):
+    """Hand-built V0 repo: directory per feature, blob per attribute."""
+    from kart_tpu.core.objects import Signature
+    from kart_tpu.core.serialise import json_pack
+
+    repo = KartRepo.init_repository(tmp_path / "v0repo")
+    repo.config.set_many(
+        {
+            "user.name": "V0 author",
+            "user.email": "v0@example.com",
+            "kart.repostructure.version": "0",
+        }
+    )
+    tb = TreeBuilder(repo.odb)
+    meta = {
+        "version": {"version": "0.0.1"},
+        "sqlite_table_info": [
+            {"cid": 0, "name": "fid", "type": "INTEGER", "notnull": 1, "pk": 1},
+            {"cid": 1, "name": "name", "type": "TEXT", "notnull": 0, "pk": 0},
+        ],
+        "gpkg_contents": {"identifier": "My V0 table", "description": ""},
+    }
+    for name, value in meta.items():
+        tb.insert(
+            f"oldtable/meta/{name}", repo.odb.write_blob(json_pack(value))
+        )
+    uuids = [
+        "0a0a0a0a-0000-0000-0000-00000000000%d" % i for i in range(1, 4)
+    ]
+    for i, uuid in enumerate(uuids, start=1):
+        base = f"oldtable/features/{i:04x}/{uuid}"
+        tb.insert(f"{base}/fid", repo.odb.write_blob(json_pack(i)))
+        tb.insert(
+            f"{base}/name", repo.odb.write_blob(json_pack(f"v0-row-{i}"))
+        )
+    sig = Signature.now("V0 author", "v0@example.com")
+    tree = tb.flush()
+    repo.create_commit("HEAD", tree, "v0 import", [], author=sig, committer=sig)
+    return repo
+
+
+def test_upgrade_v1_repo(tmp_path):
+    repo = make_v1_repo(tmp_path)
+    dest, commit_map = upgrade_repo(repo.workdir, tmp_path / "from_v1")
+    assert len(commit_map) == 1
+    ds = dest.datasets("HEAD")["mytable"]
+    assert isinstance(ds, Dataset3)
+    assert ds.feature_count == 3
+    f = ds.get_feature([2])
+    assert f["name"] == "v1-row-2"
+    assert f["geom"].envelope() is not None
+    assert ds.get_meta_item("title") == "My V1 table"
+    assert ds.get_meta_item("description") == "old"
+    schema = ds.schema
+    assert [c.name for c in schema.columns] == ["fid", "name", "geom"]
+    assert schema.pk_columns[0].name == "fid"
+    geom_col = schema.first_geometry_column
+    assert geom_col.extra_type_info["geometryType"].startswith("POINT")
+    assert geom_col.extra_type_info["geometryCRS"] == "EPSG:4326"
+    assert "EPSG:4326" in ds.crs_identifiers()
+
+
+def test_upgrade_v0_repo(tmp_path):
+    repo = make_v0_repo(tmp_path)
+    dest, commit_map = upgrade_repo(repo.workdir, tmp_path / "from_v0")
+    assert len(commit_map) == 1
+    ds = dest.datasets("HEAD")["oldtable"]
+    assert ds.feature_count == 3
+    assert ds.get_feature([1])["name"] == "v0-row-1"
+    assert ds.get_meta_item("title") == "My V0 table"
+
+
+def test_detect_tree_version(tmp_path):
+    """Version detection from the tree alone, for repos with no version in
+    config (pre-config sno repos)."""
+    from kart_tpu.upgrade.legacy import detect_tree_version
+
+    v1 = make_v1_repo(tmp_path)
+    tree = v1.odb.tree(v1.odb.read_commit(v1.refs.head_resolved()).tree)
+    assert detect_tree_version(tree) == 1
+
+    v0 = make_v0_repo(tmp_path)
+    tree = v0.odb.tree(v0.odb.read_commit(v0.refs.head_resolved()).tree)
+    assert detect_tree_version(tree) == 0
+
+
+def test_upgrade_v1_preserves_sibling_attachments_and_null_fills(tmp_path):
+    """Attachments beside .sno-table survive; feature blobs missing a column
+    (added mid-history) upgrade with NULL for that column."""
+    import base64
+
+    import msgpack
+
+    repo = make_v1_repo(tmp_path)
+    head = repo.refs.head_resolved()
+    old_tree = repo.odb.read_commit(head).tree
+    tb = TreeBuilder(repo.odb, old_tree)
+    tb.insert("mytable/notes.txt", repo.odb.write_blob(b"attachment survives"))
+    # a feature written before column 1 ("name") existed
+    packed = msgpack.packb({0: 9}, use_bin_type=True)
+    leaf = base64.urlsafe_b64encode(msgpack.packb(9)).decode()
+    tb.insert(
+        f"mytable/.sno-table/09/09/{leaf}", repo.odb.write_blob(packed)
+    )
+    from kart_tpu.core.objects import Signature
+
+    sig = Signature.now("V1 author", "v1@example.com")
+    c2 = repo.create_commit(
+        "HEAD", tb.flush(), "v1 second", [head], author=sig, committer=sig
+    )
+
+    dest, commit_map = upgrade_repo(repo.workdir, tmp_path / "from_v1_att")
+    root = dest.odb.tree(dest.odb.read_commit(commit_map[c2]).tree)
+    assert root.get("mytable/notes.txt").data == b"attachment survives"
+    ds = dest.datasets("HEAD")["mytable"]
+    assert ds.get_feature([9]) == {"fid": 9, "name": None, "geom": None}
