@@ -106,6 +106,9 @@ class PostgisAdapter(BaseAdapter):
                 value = bytes(value)
             if isinstance(value, str):
                 return Geometry.from_hex_ewkb(value).normalised()
+            if isinstance(value, (bytes, bytearray)):
+                # ST_AsEWKB comes back as raw EWKB bytes, not GPKG
+                return Geometry.from_hex_ewkb(bytes(value).hex()).normalised()
             return Geometry.of(value).normalised()
         if t == "blob":
             return bytes(value) if isinstance(value, memoryview) else value

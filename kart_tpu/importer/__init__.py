@@ -58,15 +58,24 @@ class ImportSource:
     def open(cls, spec, table=None):
         """Sniff a path/spec -> list of ImportSource (one per table)
         (reference: import_source.py:26)."""
-        if spec.endswith(".gpkg"):
+        lowered = spec.lower()
+        if lowered.endswith(".gpkg"):
             return GPKGImportSource.open_all(spec, table=table)
-        if spec.endswith((".geojson", ".json")):
+        if lowered.endswith((".geojson", ".json")):
             return [GeoJSONImportSource(spec)]
-        if spec.endswith(".csv"):
+        if lowered.endswith(".csv"):
             return [CSVImportSource(spec)]
+        if lowered.endswith(".shp"):
+            from kart_tpu.importer.shapefile import ShapefileImportSource
+
+            return [ShapefileImportSource(spec)]
+        if spec.startswith(("postgresql://", "postgres://")):
+            from kart_tpu.importer.postgres import PostgresImportSource
+
+            return PostgresImportSource.open_all(spec, table=table)
         raise ImportSourceError(
             f"Don't know how to import {spec!r} — "
-            f"supported: .gpkg, .geojson, .csv"
+            f"supported: .gpkg, .shp, .geojson, .csv, postgresql://"
         )
 
 

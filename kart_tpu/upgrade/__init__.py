@@ -163,8 +163,8 @@ def _copy_non_dataset_items(src, dest, tree, prefix, tb, src_version, ds_paths):
     is_dataset_root = prefix.rstrip("/") in ds_paths
     for entry in tree.entries():
         path = f"{prefix}{entry.name}"
-        if entry.name == ".kart.repostructure.version":
-            continue
+        if entry.name in (".kart.repostructure.version", ".sno.repository.version"):
+            continue  # superseded by the V3 marker written by _upgrade_tree
         if entry.name in skip_names or (
             is_dataset_root and entry.name in in_dataset_skips
         ):

@@ -12,6 +12,70 @@ from kart_tpu.crs import get_identifier_str, normalise_wkt
 from kart_tpu.workingcopy.db_server import DatabaseServerWorkingCopy
 
 
+def read_table_columns(con, db_schema, table):
+    """information_schema + geometry_columns -> (name, sql_type, pk_index,
+    geom_info) per column. Shared by the working copy and the Postgres
+    import source (reference: adapter/postgis.py:146-180 table_info_sql)."""
+    cur = con.cursor()
+    cur.execute(
+        """
+        SELECT C.column_name, C.data_type, C.udt_name,
+               C.character_maximum_length, C.numeric_precision, C.numeric_scale,
+               PK.ordinal_position AS pk_ordinal_position
+        FROM information_schema.columns C
+        LEFT OUTER JOIN (
+            SELECT KCU.table_schema, KCU.table_name, KCU.column_name,
+                   KCU.ordinal_position
+            FROM information_schema.key_column_usage KCU
+            INNER JOIN information_schema.table_constraints TC
+            ON KCU.constraint_schema = TC.constraint_schema
+            AND KCU.constraint_name = TC.constraint_name
+            WHERE TC.constraint_type = 'PRIMARY KEY'
+        ) PK ON PK.table_schema = C.table_schema
+            AND PK.table_name = C.table_name
+            AND PK.column_name = C.column_name
+        WHERE C.table_schema = %s AND C.table_name = %s
+        ORDER BY C.ordinal_position
+        """,
+        (db_schema, table),
+    )
+    col_rows = cur.fetchall()
+    geom_cols = {}
+    cur.execute(
+        "SELECT GC.f_geometry_column, GC.type, GC.srid, SRS.srtext "
+        "FROM geometry_columns GC "
+        "LEFT OUTER JOIN spatial_ref_sys SRS ON GC.srid = SRS.srid "
+        "WHERE GC.f_table_schema = %s AND GC.f_table_name = %s",
+        (db_schema, table),
+    )
+    for (col_name, gtype, srid, srtext) in cur.fetchall():
+        info = {}
+        if gtype and gtype.upper() != "GEOMETRY":
+            info["geometryType"] = gtype.upper()
+        if srtext:
+            info["geometryCRS"] = get_identifier_str(srtext)
+        geom_cols[col_name] = info
+
+    for (name, data_type, udt_name, char_len, num_prec, num_scale,
+         pk_pos) in col_rows:
+        pk_index = pk_pos - 1 if pk_pos is not None else None
+        if name in geom_cols:
+            yield name, "GEOMETRY", pk_index, geom_cols[name]
+            continue
+        sql_type = (data_type or "").upper()
+        if sql_type not in PostgisAdapter.SQL_TYPE_TO_V2:
+            sql_type = (udt_name or "").upper()
+        if sql_type in ("CHARACTER VARYING", "VARCHAR") and char_len:
+            sql_type = f"VARCHAR({char_len})"
+        elif sql_type in ("NUMERIC", "DECIMAL") and num_prec:
+            sql_type = (
+                f"NUMERIC({num_prec},{num_scale})"
+                if num_scale
+                else f"NUMERIC({num_prec})"
+            )
+        yield name, sql_type, pk_index, None
+
+
 class PostgisWorkingCopy(DatabaseServerWorkingCopy):
     URI_SCHEME = "postgresql"
     URI_PATH_PARTS = 2
@@ -66,67 +130,7 @@ class PostgisWorkingCopy(DatabaseServerWorkingCopy):
         return cur.fetchone() is not None
 
     def _table_columns(self, con, table):
-        """-> (name, sql_type, pk_index, geom_info) per column
-        (reference: adapter/postgis.py:146-180 table_info_sql)."""
-        cur = self._execute(
-            con,
-            """
-            SELECT C.column_name, C.data_type, C.udt_name,
-                   C.character_maximum_length, C.numeric_precision, C.numeric_scale,
-                   PK.ordinal_position AS pk_ordinal_position
-            FROM information_schema.columns C
-            LEFT OUTER JOIN (
-                SELECT KCU.table_schema, KCU.table_name, KCU.column_name,
-                       KCU.ordinal_position
-                FROM information_schema.key_column_usage KCU
-                INNER JOIN information_schema.table_constraints TC
-                ON KCU.constraint_schema = TC.constraint_schema
-                AND KCU.constraint_name = TC.constraint_name
-                WHERE TC.constraint_type = 'PRIMARY KEY'
-            ) PK ON PK.table_schema = C.table_schema
-                AND PK.table_name = C.table_name
-                AND PK.column_name = C.column_name
-            WHERE C.table_schema = %s AND C.table_name = %s
-            ORDER BY C.ordinal_position
-            """,
-            (self.db_schema, table),
-        )
-        col_rows = cur.fetchall()
-        geom_cols = {}
-        cur = self._execute(
-            con,
-            "SELECT GC.f_geometry_column, GC.type, GC.srid, SRS.srtext "
-            "FROM geometry_columns GC "
-            "LEFT OUTER JOIN spatial_ref_sys SRS ON GC.srid = SRS.srid "
-            "WHERE GC.f_table_schema = %s AND GC.f_table_name = %s",
-            (self.db_schema, table),
-        )
-        for (col_name, gtype, srid, srtext) in cur.fetchall():
-            info = {}
-            if gtype and gtype.upper() != "GEOMETRY":
-                info["geometryType"] = gtype.upper()
-            if srtext:
-                info["geometryCRS"] = get_identifier_str(srtext)
-            geom_cols[col_name] = info
-
-        for (name, data_type, udt_name, char_len, num_prec, num_scale,
-             pk_pos) in col_rows:
-            pk_index = pk_pos - 1 if pk_pos is not None else None
-            if name in geom_cols:
-                yield name, "GEOMETRY", pk_index, geom_cols[name]
-                continue
-            sql_type = (data_type or "").upper()
-            if sql_type not in self.ADAPTER.SQL_TYPE_TO_V2:
-                sql_type = (udt_name or "").upper()
-            if sql_type in ("CHARACTER VARYING", "VARCHAR") and char_len:
-                sql_type = f"VARCHAR({char_len})"
-            elif sql_type in ("NUMERIC", "DECIMAL") and num_prec:
-                sql_type = (
-                    f"NUMERIC({num_prec},{num_scale})"
-                    if num_scale
-                    else f"NUMERIC({num_prec})"
-                )
-            yield name, sql_type, pk_index, None
+        return read_table_columns(con, self.db_schema, table)
 
     def _extra_meta_items(self, con, table):
         out = {}

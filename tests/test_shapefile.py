@@ -1,0 +1,267 @@
+"""Shapefile import: hand-built .shp/.dbf/.prj fixtures (the format is a
+fixed binary layout, so the fixtures are written byte-by-byte — the same
+known-answer approach the reference uses with archived repos)."""
+
+import struct
+
+import pytest
+
+from kart_tpu.importer import ImportSource, ImportSourceError
+from kart_tpu.importer.shapefile import (
+    DbfReader,
+    ShapefileImportSource,
+    ShpReader,
+)
+
+WGS84_WKT = (
+    'GEOGCS["WGS 84",DATUM["WGS_1984",SPHEROID["WGS 84",6378137,298.257]],'
+    'PRIMEM["Greenwich",0],UNIT["degree",0.0174532925199433,'
+    'AUTHORITY["EPSG","9122"]],AUTHORITY["EPSG","4326"]]'
+)
+
+
+def _shp_header(shape_type, content_length_words):
+    h = struct.pack(">i", 9994) + b"\x00" * 20
+    h += struct.pack(">i", 50 + content_length_words)
+    h += struct.pack("<2i", 1000, shape_type)
+    h += struct.pack("<8d", 0, 0, 10, 10, 0, 0, 0, 0)
+    return h
+
+
+def write_point_shp(path, points):
+    """points: [(x, y)] -> minimal Point shapefile."""
+    records = b""
+    for i, (x, y) in enumerate(points, start=1):
+        content = struct.pack("<i", 1) + struct.pack("<2d", x, y)
+        records += struct.pack(">2i", i, len(content) // 2) + content
+    with open(path, "wb") as f:
+        f.write(_shp_header(1, len(records) // 2) + records)
+
+
+def write_polygon_shp(path, polygons):
+    """polygons: [[ring, ...]] (ring = [(x, y), ...]) -> Polygon shapefile."""
+    records = b""
+    for i, rings in enumerate(polygons, start=1):
+        npoints = sum(len(r) for r in rings)
+        content = struct.pack("<i", 5)
+        content += struct.pack("<4d", 0, 0, 10, 10)
+        content += struct.pack("<2i", len(rings), npoints)
+        start = 0
+        for r in rings:
+            content += struct.pack("<i", start)
+            start += len(r)
+        for r in rings:
+            for x, y in r:
+                content += struct.pack("<2d", x, y)
+        records += struct.pack(">2i", i, len(content) // 2) + content
+    with open(path, "wb") as f:
+        f.write(_shp_header(5, len(records) // 2) + records)
+
+
+def write_dbf(path, fields, rows):
+    """fields: [(name, type_char, length, decimals)]; rows: [dict]."""
+    record_size = 1 + sum(f[2] for f in fields)
+    header_size = 32 + 32 * len(fields) + 1
+    head = struct.pack(
+        "<B3Bihh", 3, 24, 1, 1, len(rows), header_size, record_size
+    )
+    head += b"\x00" * 20
+    for name, type_char, length, decimals in fields:
+        desc = name.encode()[:11].ljust(11, b"\x00")
+        desc += type_char.encode()
+        desc += b"\x00" * 4
+        desc += bytes([length, decimals])
+        desc += b"\x00" * 14
+        head += desc
+    head += b"\x0d"
+    body = b""
+    for row in rows:
+        rec = b" "
+        for name, type_char, length, decimals in fields:
+            v = row.get(name)
+            if v is None:
+                cell = b" " * length
+            elif type_char == "C":
+                cell = str(v).encode()[:length].ljust(length)
+            elif type_char in ("N", "F"):
+                cell = str(v).encode()[:length].rjust(length)
+            elif type_char == "L":
+                cell = (b"T" if v else b"F").ljust(length)
+            elif type_char == "D":
+                cell = v.replace("-", "").encode().ljust(length)
+            else:
+                cell = str(v).encode().ljust(length)[:length]
+            rec += cell
+        body += rec
+    with open(path, "wb") as f:
+        f.write(head + body + b"\x1a")
+
+
+@pytest.fixture
+def points_shapefile(tmp_path):
+    base = tmp_path / "cities"
+    write_point_shp(base.with_suffix(".shp"), [(1.0, 2.0), (3.5, -4.5), (7, 8)])
+    write_dbf(
+        base.with_suffix(".dbf"),
+        [("name", "C", 20, 0), ("pop", "N", 10, 0), ("area", "F", 12, 0),
+         ("capital", "L", 1, 0), ("founded", "D", 8, 0)],
+        [
+            {"name": "alpha", "pop": 1000, "area": 1.5, "capital": True,
+             "founded": "1900-01-02"},
+            {"name": "beta", "pop": 2000, "area": 2.5, "capital": False,
+             "founded": "1950-06-30"},
+            {"name": "gamma", "pop": None, "area": None, "capital": None,
+             "founded": None},
+        ],
+    )
+    base.with_suffix(".prj").write_text(WGS84_WKT)
+    return base.with_suffix(".shp")
+
+
+class TestShpReader:
+    def test_points(self, points_shapefile):
+        shapes = list(ShpReader(str(points_shapefile)))
+        assert [rec_no for rec_no, _ in shapes] == [1, 2, 3]
+        assert shapes[0][1][3] == (1.0, 2.0)
+
+    def test_polygon_with_hole(self, tmp_path):
+        path = tmp_path / "poly.shp"
+        outer = [(0, 0), (0, 10), (10, 10), (10, 0), (0, 0)]  # CW
+        hole = [(2, 2), (4, 2), (4, 4), (2, 4), (2, 2)]  # CCW
+        write_polygon_shp(path, [[outer, hole]])
+        ((rec_no, value),) = list(ShpReader(str(path)))
+        assert value[0] == "MultiPolygon"
+        (poly,) = value[3]
+        assert len(poly[3]) == 2  # outer + 1 hole
+        assert poly[3][0][0] == (0.0, 0.0)
+        assert poly[3][1][0] == (2.0, 2.0)
+
+    def test_two_outer_rings_make_two_polygons(self, tmp_path):
+        path = tmp_path / "multi.shp"
+        ring_a = [(0, 0), (0, 2), (2, 2), (2, 0), (0, 0)]  # CW
+        ring_b = [(5, 5), (5, 7), (7, 7), (7, 5), (5, 5)]  # CW
+        write_polygon_shp(path, [[ring_a, ring_b]])
+        ((_, value),) = list(ShpReader(str(path)))
+        assert len(value[3]) == 2
+
+    def test_not_a_shapefile(self, tmp_path):
+        bad = tmp_path / "bad.shp"
+        bad.write_bytes(b"\x00" * 200)
+        with pytest.raises(ImportSourceError, match="bad magic"):
+            ShpReader(str(bad))
+
+
+class TestDbfReader:
+    def test_types_and_nulls(self, points_shapefile):
+        dbf = DbfReader(str(points_shapefile.with_suffix(".dbf")))
+        assert [f[0] for f in dbf.fields] == [
+            "name", "pop", "area", "capital", "founded",
+        ]
+        rows = list(dbf.records())
+        assert rows[0]["name"] == "alpha"
+        assert rows[0]["pop"] == 1000
+        assert rows[0]["area"] == 1.5
+        assert rows[0]["capital"] is True
+        assert rows[0]["founded"] == "1900-01-02"
+        assert rows[2]["pop"] is None
+        assert rows[2]["capital"] is None
+
+    def test_v2_columns(self, points_shapefile):
+        dbf = DbfReader(str(points_shapefile.with_suffix(".dbf")))
+        cols = dict((n, (t, e)) for n, t, e in dbf.v2_columns())
+        assert cols["name"] == ("text", {"length": 20})
+        assert cols["pop"] == ("integer", {"size": 64})
+        assert cols["capital"] == ("boolean", {})
+        assert cols["founded"] == ("date", {})
+
+
+class TestShapefileImportSource:
+    def test_schema_and_features(self, points_shapefile):
+        src = ShapefileImportSource(str(points_shapefile))
+        schema = src.schema
+        assert schema.pk_columns[0].name == "FID"
+        geom_col = schema.first_geometry_column
+        assert geom_col.name == "geom"
+        assert geom_col.extra_type_info["geometryType"] == "POINT"
+        assert geom_col.extra_type_info["geometryCRS"] == "EPSG:4326"
+        assert src.crs_definitions() == {"EPSG:4326": WGS84_WKT}
+        assert src.feature_count == 3
+        features = list(src.features())
+        assert features[0]["FID"] == 1
+        assert features[0]["name"] == "alpha"
+        env = features[1]["geom"].envelope()
+        assert (env[0], env[2]) == (3.5, -4.5)
+
+    def test_open_dispatch(self, points_shapefile):
+        (src,) = ImportSource.open(str(points_shapefile))
+        assert isinstance(src, ShapefileImportSource)
+        assert src.dest_path == "cities"
+
+    def test_full_import_roundtrip(self, points_shapefile, tmp_path):
+        from kart_tpu.core.repo import KartRepo
+        from kart_tpu.importer.importer import import_sources
+
+        repo = KartRepo.init_repository(tmp_path / "repo")
+        repo.config.set_many({"user.name": "T", "user.email": "t@x"})
+        import_sources(
+            repo, [ShapefileImportSource(str(points_shapefile))],
+            message="import shp",
+        )
+        ds = repo.datasets("HEAD")["cities"]
+        assert ds.feature_count == 3
+        f = ds.get_feature([2])
+        assert f["name"] == "beta"
+        assert f["pop"] == 2000
+        assert f["geom"] is not None
+
+
+def test_postgres_import_gated():
+    from kart_tpu.core.repo import NotFound
+    from kart_tpu.importer.postgres import PostgresImportSource
+
+    conn, db_schema, table = PostgresImportSource.parse_spec(
+        "postgresql://host:5433/db/myschema/mytable"
+    )
+    assert conn[0] == "host" and conn[1] == 5433 and conn[2] == "db"
+    assert (db_schema, table) == ("myschema", "mytable")
+    with pytest.raises(NotFound, match="psycopg2"):
+        PostgresImportSource.open_all("postgresql://host/db")
+
+
+def test_deleted_dbf_rows_tombstone_features(tmp_path):
+    """A '*'-flagged DBF row drops that feature but keeps later rows aligned
+    with their shapes."""
+    base = tmp_path / "del"
+    write_point_shp(base.with_suffix(".shp"), [(1, 1), (2, 2), (3, 3)])
+    write_dbf(
+        base.with_suffix(".dbf"),
+        [("name", "C", 10, 0)],
+        [{"name": "one"}, {"name": "two"}, {"name": "three"}],
+    )
+    # flag record 2 deleted: records start after header; each is 11 bytes
+    data = bytearray(base.with_suffix(".dbf").read_bytes())
+    header_size = struct.unpack("<h", data[8:10])[0]
+    record_size = struct.unpack("<h", data[10:12])[0]
+    data[header_size + record_size] = ord("*")
+    base.with_suffix(".dbf").write_bytes(bytes(data))
+
+    src = ShapefileImportSource(str(base.with_suffix(".shp")))
+    features = list(src.features())
+    assert src.feature_count == 2
+    assert [(f["FID"], f["name"]) for f in features] == [
+        (1, "one"),
+        (3, "three"),
+    ]
+
+
+def test_postgis_raw_ewkb_value_roundtrip():
+    """ST_AsEWKB returns raw EWKB bytes; value_to_v2 must parse them."""
+    from kart_tpu.adapters.postgis import PostgisAdapter
+    from kart_tpu.geometry import Geometry
+    from kart_tpu.models.schema import ColumnSchema
+
+    g = Geometry.from_wkt("POINT(174.5 -41.3)", crs_id=4326)
+    gcol = ColumnSchema(ColumnSchema.new_id(), "geom", "geometry", None, {})
+    raw_ewkb = g.with_crs_id(4326).to_ewkb()
+    assert PostgisAdapter.value_to_v2(memoryview(raw_ewkb), gcol) == \
+        PostgisAdapter.value_to_v2(raw_ewkb.hex().upper(), gcol)
