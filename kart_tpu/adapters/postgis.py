@@ -108,7 +108,7 @@ class PostgisAdapter(BaseAdapter):
                 return Geometry.from_hex_ewkb(value).normalised()
             if isinstance(value, (bytes, bytearray)):
                 # ST_AsEWKB comes back as raw EWKB bytes, not GPKG
-                return Geometry.from_hex_ewkb(bytes(value).hex()).normalised()
+                return Geometry.from_ewkb(bytes(value)).normalised()
             return Geometry.of(value).normalised()
         if t == "blob":
             return bytes(value) if isinstance(value, memoryview) else value

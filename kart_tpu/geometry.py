@@ -194,8 +194,14 @@ class Geometry(bytes):
     def from_hex_ewkb(cls, hex_ewkb):
         if not hex_ewkb:
             return None
-        wkb = binascii.unhexlify(hex_ewkb)
-        coords, srid = _parse_any_wkb(wkb)
+        return cls.from_ewkb(binascii.unhexlify(hex_ewkb))
+
+    @classmethod
+    def from_ewkb(cls, ewkb):
+        """Raw EWKB bytes (SRID embedded or not) -> GPKG Geometry."""
+        if not ewkb:
+            return None
+        coords, srid = _parse_any_wkb(ewkb)
         return _build_gpkg(coords, crs_id=srid or 0)
 
     @classmethod

@@ -86,7 +86,9 @@ class ShpReader:
                 f"{path} is not a shapefile (bad magic {file_code})"
             )
         (self.shape_type,) = struct.unpack("<i", self.data[32:36])
-        if self.shape_type % 10 not in _BASE_TYPE and self.shape_type != SHP_NULL:
+        # only explicitly known types: MultiPatch (31) etc. have different
+        # record layouts and must be rejected, not garbage-parsed
+        if self.shape_type not in _VARIANTS:
             raise ImportSourceError(
                 f"{path}: unsupported shape type {self.shape_type}"
             )
@@ -241,8 +243,9 @@ class DbfReader:
             raise ImportSourceError(f"{path} is not a DBF file (too short)")
         self.encoding = encoding
         self.n_records = struct.unpack("<i", self.data[4:8])[0]
-        self.header_size = struct.unpack("<h", self.data[8:10])[0]
-        self.record_size = struct.unpack("<h", self.data[10:12])[0]
+        # unsigned per the dBase spec: wide tables exceed 32767 bytes/record
+        self.header_size = struct.unpack("<H", self.data[8:10])[0]
+        self.record_size = struct.unpack("<H", self.data[10:12])[0]
         self.fields = []  # (name, type_char, length, decimals)
         pos = 32
         while pos < self.header_size - 1 and self.data[pos] != 0x0D:
