@@ -86,11 +86,14 @@ def main():
         if proc.returncode == 0 and proc.stdout.strip():
             print(proc.stdout.strip().splitlines()[-1])
             return
+        if proc.stderr:
+            print(proc.stderr.strip()[-2000:], file=sys.stderr)
     except subprocess.TimeoutExpired:
         pass
     # accelerator path failed: measure on the CPU XLA backend instead
     env = dict(os.environ)
     env["JAX_PLATFORMS"] = "cpu"
+    env["KART_INSULATE_CPU"] = "1"  # worker deregisters non-CPU factories
     env.pop("PALLAS_AXON_POOL_IPS", None)  # stops PJRT plugin registration
     try:
         proc = subprocess.run(
@@ -100,6 +103,8 @@ def main():
         if proc.returncode == 0 and lines:
             print(lines[-1])
             return
+        if proc.stderr:
+            print(proc.stderr.strip()[-2000:], file=sys.stderr)
     except subprocess.TimeoutExpired:
         pass
     # even the fallback failed: the contract is still one JSON line
@@ -118,6 +123,20 @@ def main():
 def worker():
     n = int(os.environ.get("KART_BENCH_ROWS", 10_000_000))
     reps = int(os.environ.get("KART_BENCH_REPS", 5))
+
+    import sys
+
+    from kart_tpu.runtime import insulate_virtual_cpu, probe_backend
+
+    if os.environ.get("KART_INSULATE_CPU") == "1":
+        insulate_virtual_cpu(1)
+
+    info = probe_backend()
+    if not info["ok"]:
+        # backend unusable (wedged tunnel): exit non-zero so the watchdog
+        # re-runs us on the CPU XLA backend — never print an unlabelled number
+        print(f"backend probe failed: {info['error']}", file=sys.stderr)
+        sys.exit(3)
 
     import jax
 
@@ -161,6 +180,11 @@ def worker():
                 "value": round(dev_rate),
                 "unit": "features/s",
                 "vs_baseline": round(dev_rate / cpu_rate, 2),
+                "backend": info["backend"],
+                "device_kind": info["device_kind"],
+                "n_devices": info["n_devices"],
+                "backend_init_seconds": info["init_seconds"],
+                "cpu_baseline_rate": round(cpu_rate),
             }
         )
     )

@@ -148,10 +148,11 @@ def bbox_intersects(envelopes, query):
     n = len(envelopes)
     if n == 0:
         return np.zeros(0, dtype=bool)
-    try:
-        backend = jax.default_backend()
-    except RuntimeError:
+    from kart_tpu.runtime import default_backend, jax_ready
+
+    if not jax_ready():
         return bbox_intersects_np(np.asarray(envelopes), query)
+    backend = default_backend()
     w, s, e, nn, count = pad_envelopes(np.asarray(envelopes))
     q = jnp.asarray(np.asarray(query, dtype=np.float32))
     if backend == "tpu":

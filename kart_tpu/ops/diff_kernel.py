@@ -153,10 +153,25 @@ def classify_blocks(old_block, new_block):
     """FeatureBlock x2 -> (old_class np.int8 (n_old,), new_class (n_new,),
     counts dict). Host wrapper: unpads and returns numpy. Picks the kernel
     variant suited to the live backend (sort-join on accelerators, binary
-    search on CPU) — identical results either way."""
+    search on CPU) — identical results either way. When no jax backend can
+    initialise (wedged accelerator tunnel) the numpy twin runs instead: the
+    CLI must always complete."""
+    from kart_tpu.runtime import default_backend, jax_ready
+
+    if not jax_ready():
+        old_class, new_class = classify_blocks_reference(old_block, new_block)
+        return (
+            old_class,
+            new_class,
+            {
+                "inserts": int(np.sum(new_class == INSERT)),
+                "updates": int(np.sum(old_class == UPDATE)),
+                "deletes": int(np.sum(old_class == DELETE)),
+            },
+        )
     kernel = (
         _classify_padded_binsearch
-        if jax.default_backend() == "cpu"
+        if default_backend() == "cpu"
         else _classify_padded
     )
     old_class, new_class, _, counts = kernel(

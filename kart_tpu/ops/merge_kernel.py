@@ -92,6 +92,23 @@ def merge_classify(ancestor_block, ours_block, theirs_block):
     t_real = theirs_block.keys[: theirs_block.count]
     union = np.union1d(np.union1d(a_real, o_real), t_real).astype(np.int64)
     u = len(union)
+
+    from kart_tpu.runtime import jax_ready
+
+    if not jax_ready():
+        decision, presence = _merge_classify_np(
+            ancestor_block, ours_block, theirs_block, union
+        )
+        return (
+            union,
+            decision,
+            presence,
+            {
+                "conflicts": int(np.sum(decision == CONFLICT)),
+                "take_theirs": int(np.sum(decision == TAKE_THEIRS)),
+            },
+        )
+
     size = bucket_size(max(u, 1))
     union_padded = np.full(size, PAD_KEY, dtype=np.int64)
     union_padded[:u] = union
@@ -111,6 +128,48 @@ def merge_classify(ancestor_block, ours_block, theirs_block):
         np.asarray(presence)[:u],
         {"conflicts": int(n_conf), "take_theirs": int(n_theirs)},
     )
+
+
+def _join_np(block, union_keys):
+    """Vectorized numpy twin of ``_join`` (unpadded)."""
+    keys = block.keys[: block.count]
+    oids = block.oids[: block.count]
+    if not len(keys):
+        return (
+            np.zeros(len(union_keys), dtype=bool),
+            np.zeros((len(union_keys), 5), dtype=np.uint32),
+        )
+    idx = np.searchsorted(keys, union_keys)
+    idxc = np.minimum(idx, len(keys) - 1)
+    present = (keys[idxc] == union_keys) & (idx < len(keys))
+    out = np.where(present[:, None], oids[idxc], 0).astype(np.uint32)
+    return present, out
+
+
+def _merge_classify_np(ancestor_block, ours_block, theirs_block, union):
+    """Vectorized numpy fallback with identical semantics to the jitted
+    kernel (used when no jax backend is usable)."""
+    a_pres, a_oid = _join_np(ancestor_block, union)
+    o_pres, o_oid = _join_np(ours_block, union)
+    t_pres, t_oid = _join_np(theirs_block, union)
+
+    def same(p1, oid1, p2, oid2):
+        return (~p1 & ~p2) | (p1 & p2 & np.all(oid1 == oid2, axis=1))
+
+    o_eq_t = same(o_pres, o_oid, t_pres, t_oid)
+    o_eq_a = same(o_pres, o_oid, a_pres, a_oid)
+    t_eq_a = same(t_pres, t_oid, a_pres, a_oid)
+    decision = np.where(
+        o_eq_t,
+        KEEP_OURS,
+        np.where(o_eq_a, TAKE_THEIRS, np.where(t_eq_a, KEEP_OURS, CONFLICT)),
+    ).astype(np.int8)
+    presence = (
+        a_pres.astype(np.int8)
+        + 2 * o_pres.astype(np.int8)
+        + 4 * t_pres.astype(np.int8)
+    )
+    return decision, presence
 
 
 def merge_classify_reference(ancestor_block, ours_block, theirs_block):

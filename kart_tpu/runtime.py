@@ -1,0 +1,157 @@
+"""JAX backend lifecycle management: probe, insulate, fall back.
+
+A version-control CLI must never hang because an accelerator is wedged
+(reference: kart works with no GPU at all; our analog is that every jitted
+kernel has a numpy twin with identical semantics). Three hazards this module
+absorbs:
+
+1. **Wedged PJRT init.** A dev-container tunnel can hang ``jax.devices()``
+   forever (observed: >9 min with no return). ``probe_backend`` initialises
+   the backend in a daemon thread with a hard timeout; on timeout the process
+   continues and every op dispatcher uses its numpy reference path.
+2. **Hijacked platform registration.** The container's sitecustomize
+   registers an accelerator PJRT plugin at interpreter startup — before env
+   vars or conftest can redirect jax to CPU, and once registered even
+   ``JAX_PLATFORMS=cpu`` may initialise it. ``insulate_virtual_cpu``
+   deregisters every non-CPU backend factory and forces an n-device virtual
+   CPU host platform (for tests and the driver's multichip dry-run).
+3. **Slow first compile.** Callers that only need a yes/no (``jax_ready``)
+   get a cached answer; the probe runs once per process.
+
+Env knobs:
+    KART_NO_JAX=1             — skip jax entirely, always numpy
+    KART_JAX_INIT_TIMEOUT=<s> — probe timeout (default 75 s; first PJRT init
+                                through a tunnel is slow but not minutes)
+"""
+
+import logging
+import os
+import threading
+import time
+
+L = logging.getLogger("kart_tpu.runtime")
+
+_probe_lock = threading.Lock()
+_probe_result = None  # dict once probed; {"ok": False, ...} on failure
+
+
+def _failure(error, init_seconds=0.0):
+    return {
+        "ok": False,
+        "backend": None,
+        "device_kind": None,
+        "n_devices": 0,
+        "init_seconds": round(init_seconds, 3),
+        "error": error,
+    }
+
+
+def insulate_virtual_cpu(n_devices=8):
+    """Force this process onto an ``n_devices``-device virtual CPU platform,
+    deregistering any hijacked accelerator PJRT factories. Must run before
+    the first jax backend init; safe to call repeatedly."""
+    import re
+
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    flags = os.environ.get("XLA_FLAGS", "")
+    flag = f"--xla_force_host_platform_device_count={n_devices}"
+    if "xla_force_host_platform_device_count" in flags:
+        flags = re.sub(
+            r"--xla_force_host_platform_device_count=\d+", flag, flags
+        )
+    else:
+        flags = (flags + " " + flag).strip()
+    os.environ["XLA_FLAGS"] = flags
+    try:
+        import jax
+        from jax._src import xla_bridge
+
+        jax.config.update("jax_platforms", "cpu")
+        try:
+            jax.config.update("jax_num_cpu_devices", n_devices)
+        except Exception:
+            pass  # older jax: XLA_FLAGS above covers it
+        for plugin in list(xla_bridge._backend_factories):
+            if plugin not in ("cpu", "interpreter"):
+                xla_bridge._backend_factories.pop(plugin, None)
+    except Exception:
+        pass  # jax internals moved: the env vars above still apply
+    global _probe_result
+    with _probe_lock:
+        _probe_result = None  # platform changed: re-probe
+
+
+def probe_backend(timeout=None):
+    """Initialise the jax backend under a watchdog. Returns a provenance dict:
+
+        {"ok": bool, "backend": str|None, "device_kind": str|None,
+         "n_devices": int, "init_seconds": float, "error": str|None}
+
+    Cached after the first call. On timeout the daemon thread is abandoned
+    (it may eventually finish; we never wait for it again)."""
+    global _probe_result
+    with _probe_lock:
+        if _probe_result is not None:
+            return _probe_result
+        if os.environ.get("KART_NO_JAX") == "1":
+            _probe_result = _failure("KART_NO_JAX=1")
+            return _probe_result
+
+        if timeout is None:
+            try:
+                timeout = float(os.environ.get("KART_JAX_INIT_TIMEOUT", 75))
+            except ValueError:
+                L.warning(
+                    "ignoring malformed KART_JAX_INIT_TIMEOUT=%r",
+                    os.environ["KART_JAX_INIT_TIMEOUT"],
+                )
+                timeout = 75.0
+
+        box = {}
+
+        def _init():
+            try:
+                t0 = time.perf_counter()
+                import jax
+
+                devices = jax.devices()
+                box["result"] = {
+                    "ok": True,
+                    "backend": jax.default_backend(),
+                    "device_kind": devices[0].device_kind if devices else None,
+                    "n_devices": len(devices),
+                    "init_seconds": round(time.perf_counter() - t0, 3),
+                    "error": None,
+                }
+            except Exception as e:  # pragma: no cover - env-dependent
+                box["result"] = _failure(
+                    f"{type(e).__name__}: {e}", time.perf_counter() - t0
+                )
+
+        t = threading.Thread(target=_init, daemon=True, name="kart-jax-probe")
+        t.start()
+        t.join(timeout)
+        if "result" in box:
+            _probe_result = box["result"]
+        else:
+            L.warning(
+                "jax backend init did not complete within %.0fs; "
+                "using numpy reference kernels (set KART_JAX_INIT_TIMEOUT "
+                "to wait longer)",
+                timeout,
+            )
+            _probe_result = _failure(
+                f"backend init timed out after {timeout}s", timeout
+            )
+        return _probe_result
+
+
+def jax_ready():
+    """True when a jax backend is initialised and usable. First call may
+    block up to the probe timeout; later calls are instant."""
+    return probe_backend()["ok"]
+
+
+def default_backend():
+    """Backend name ('tpu'/'cpu'/...) or None when unusable."""
+    return probe_backend()["backend"]

@@ -14,26 +14,14 @@ deregistered here, before the first backend init.
 """
 
 import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 if os.environ.get("KART_TESTS_ON_TPU") != "1":
-    os.environ["JAX_PLATFORMS"] = "cpu"
-    _flags = os.environ.get("XLA_FLAGS", "")
-    if "xla_force_host_platform_device_count" not in _flags:
-        os.environ["XLA_FLAGS"] = (
-            _flags + " --xla_force_host_platform_device_count=8"
-        ).strip()
-    try:
-        import jax
-        from jax._src import xla_bridge as _xla_bridge
+    from kart_tpu.runtime import insulate_virtual_cpu
 
-        # jax may already have read JAX_PLATFORMS=<accelerator> from the
-        # container env at import time; override the live config too
-        jax.config.update("jax_platforms", "cpu")
-        for _plugin in list(_xla_bridge._backend_factories):
-            if _plugin not in ("cpu", "interpreter"):
-                _xla_bridge._backend_factories.pop(_plugin, None)
-    except Exception:
-        pass  # jax internals moved: fall back to the env vars above
+    insulate_virtual_cpu(8)
 
 import pytest
 
