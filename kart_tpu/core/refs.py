@@ -18,10 +18,34 @@ class RefError(ValueError):
 class RefStore:
     def __init__(self, gitdir):
         self.gitdir = gitdir
+        self._packed_cache = None  # (mtime, {ref: oid})
 
     def _ref_path(self, ref):
         assert not ref.startswith("/") and ".." not in ref, ref
         return os.path.join(self.gitdir, *ref.split("/"))
+
+    def _packed_refs(self):
+        """{ref: oid} from the ``packed-refs`` file (git writes it on clone
+        and gc; loose ref files always win). '^' peel lines are skipped —
+        tags peel through the odb instead."""
+        path = os.path.join(self.gitdir, "packed-refs")
+        try:
+            mtime = os.stat(path).st_mtime_ns
+        except OSError:
+            return {}
+        if self._packed_cache and self._packed_cache[0] == mtime:
+            return self._packed_cache[1]
+        refs = {}
+        with open(path) as f:
+            for line in f:
+                line = line.strip()
+                if not line or line.startswith(("#", "^")):
+                    continue
+                oid, _, ref = line.partition(" ")
+                if ref:
+                    refs[ref] = oid
+        self._packed_cache = (mtime, refs)
+        return refs
 
     # -- plain refs ----------------------------------------------------------
 
@@ -29,7 +53,7 @@ class RefStore:
         """ref name -> oid, or None. Follows nothing (see resolve)."""
         path = self._ref_path(ref)
         if not os.path.exists(path):
-            return None
+            return self._packed_refs().get(ref)
         with open(path) as f:
             value = f.read().strip()
         if value.startswith("ref: "):  # symref file (e.g. refs/remotes/x/HEAD)
@@ -51,26 +75,58 @@ class RefStore:
         path = self._ref_path(ref)
         if os.path.exists(path):
             os.remove(path)
+        if ref in self._packed_refs():
+            # rewrite packed-refs without this ref, preserving header and
+            # '^' peel lines (which belong to the preceding tag ref)
+            packed_path = os.path.join(self.gitdir, "packed-refs")
+            with open(packed_path) as f:
+                lines = f.readlines()
+            out = []
+            skipping = False
+            for line in lines:
+                stripped = line.strip()
+                if stripped.startswith("^"):
+                    if not skipping:
+                        out.append(line)
+                    continue
+                skipping = False
+                if stripped and not stripped.startswith("#"):
+                    _, _, line_ref = stripped.partition(" ")
+                    if line_ref == ref:
+                        skipping = True
+                        continue
+                out.append(line)
+            tmp = packed_path + f".lock{os.getpid()}"
+            with open(tmp, "w") as f:
+                f.writelines(out)
+            os.replace(tmp, packed_path)
+            self._packed_cache = None
 
     def exists(self, ref):
-        return os.path.exists(self._ref_path(ref))
+        return os.path.exists(self._ref_path(ref)) or ref in self._packed_refs()
 
     def iter_refs(self, prefix="refs/"):
-        """Yield (ref_name, oid) under the given prefix, sorted."""
+        """Yield (ref_name, oid) under the given prefix, sorted; loose refs
+        shadow packed ones of the same name."""
+        combined = {
+            ref: oid
+            for ref, oid in self._packed_refs().items()
+            if ref.startswith(prefix)
+        }
         base = self._ref_path(prefix.rstrip("/"))
-        if not os.path.isdir(base):
-            return
-        for dirpath, dirnames, filenames in sorted(os.walk(base)):
-            dirnames.sort()
-            for fn in sorted(filenames):
-                if fn.endswith((".lock", ".tmp")):
-                    continue
-                full = os.path.join(dirpath, fn)
-                rel = os.path.relpath(full, self.gitdir).replace(os.sep, "/")
-                with open(full) as f:
-                    value = f.read().strip()
-                if value and not value.startswith("ref: "):
-                    yield rel, value
+        if os.path.isdir(base):
+            for dirpath, dirnames, filenames in sorted(os.walk(base)):
+                dirnames.sort()
+                for fn in sorted(filenames):
+                    if fn.endswith((".lock", ".tmp")):
+                        continue
+                    full = os.path.join(dirpath, fn)
+                    rel = os.path.relpath(full, self.gitdir).replace(os.sep, "/")
+                    with open(full) as f:
+                        value = f.read().strip()
+                    if value and not value.startswith("ref: "):
+                        combined[rel] = value
+        yield from sorted(combined.items())
 
     # -- HEAD ----------------------------------------------------------------
 

@@ -2,11 +2,12 @@
 (reference: kart/fast_import.py).
 
 The reference shards features over N ``git fast-import`` subprocesses and
-merges the resulting trees (fast_import.py:286-399). Here the equivalent
-parallelism is *data* parallelism over feature batches: features stream in
-batches, each batch is encoded (vectorized path encoding for int pks) and
-written to the object store, and all tree writes happen in one TreeBuilder
-flush. A process pool handles blob compression for large imports.
+merges the resulting trees (fast_import.py:286-399). Here features stream in
+batches, each batch is encoded (vectorized path encoding for int pks), and
+every object — feature blobs, meta blobs, trees, the commit — is appended to
+a single new packfile (``ObjectDb.bulk_pack``): sequential writes to one
+container file, not a loose file per feature. All tree writes happen in one
+TreeBuilder flush.
 """
 
 import time
@@ -47,22 +48,28 @@ def import_sources(
     ds_paths = []
     total = 0
     t0 = time.monotonic()
-    for source in sources:
-        # PK-less sources get stable generated PKs
-        # (reference: kart/pk_generation.py)
-        source = PkGeneratingImportSource.wrap_if_needed(source, repo)
-        ds_path = source.dest_path.strip("/")
-        if ds_path in existing_paths and not replace_existing:
-            raise ImportError_(
-                f"Dataset {ds_path!r} already exists — use --replace-existing"
-            )
-        if replace_existing:
-            tb.remove(ds_path)
-        count = _import_single_source(repo, tb, source, ds_path, log=log)
-        total += count
-        ds_paths.append(ds_path)
+    with repo.odb.bulk_pack():
+        for source in sources:
+            # PK-less sources get stable generated PKs
+            # (reference: kart/pk_generation.py)
+            source = PkGeneratingImportSource.wrap_if_needed(source, repo)
+            ds_path = source.dest_path.strip("/")
+            if ds_path in existing_paths and not replace_existing:
+                raise ImportError_(
+                    f"Dataset {ds_path!r} already exists — use --replace-existing"
+                )
+            if replace_existing:
+                tb.remove(ds_path)
+            count = _import_single_source(repo, tb, source, ds_path, log=log)
+            total += count
+            ds_paths.append(ds_path)
 
-    new_tree = tb.flush()
+        new_tree = tb.flush()
+
+    # commit + ref update only after the pack is durable (fsync'd) on disk:
+    # a crash mid-import leaves an aborted tmp pack and an untouched HEAD,
+    # never a dangling ref (reference analog: temp refs refs/kart-import/,
+    # fast_import.py:307)
     if message is None:
         message = f"Import {len(ds_paths)} dataset(s): " + ", ".join(ds_paths)
     parents = [repo.head_commit_oid] if repo.head_commit_oid else []
