@@ -91,11 +91,8 @@ class ObjectDb:
             w.abort()
             raise
         self._bulk_writer = None
-        if w._count:
-            w.finish()
+        if w.finish() is not None:
             self.packs.refresh()
-        else:
-            w.abort()
 
     def pack_writer(self, level=1):
         """A PackWriter targeting this store's pack directory. The caller
@@ -281,10 +278,13 @@ class ObjectDb:
         from kart_tpu.core.packs import PackCollection
 
         own_packs = PackCollection([os.path.join(self.objects_dir, "pack")])
-        for sha in own_packs.iter_shas():
-            oid = sha.hex()
-            if oid not in seen:
-                yield oid
+        try:
+            for sha in own_packs.iter_shas():
+                oid = sha.hex()
+                if oid not in seen:
+                    yield oid
+        finally:
+            own_packs.close()
 
     def find_oids_with_prefix(self, hex_prefix):
         """Oids starting with hex_prefix (>= 2 chars) — scans only the one

@@ -317,6 +317,12 @@ class PackCollection:
         return self._packs
 
     def refresh(self):
+        self.close()
+
+    def close(self):
+        if self._packs:
+            for pack in self._packs:
+                pack.close()
         self._packs = None
 
     def read(self, sha):
@@ -421,7 +427,12 @@ class PackWriter:
             os.remove(self._tmp_path)
 
     def finish(self):
-        """Patch the object count, append the pack trailer, write the idx."""
+        """Patch the object count, append the pack trailer, write the idx.
+        An empty writer aborts instead (no zero-object pack files).
+        -> pack path, or None when empty."""
+        if not self._count:
+            self.abort()
+            return None
         f = self._f
         f.flush()
         # re-hash with the correct count patched into the header

@@ -2,12 +2,13 @@
 (reference: kart/fast_import.py).
 
 The reference shards features over N ``git fast-import`` subprocesses and
-merges the resulting trees (fast_import.py:286-399). Here features stream in
-batches, each batch is encoded (vectorized path encoding for int pks), and
-every object — feature blobs, meta blobs, trees, the commit — is appended to
-a single new packfile (``ObjectDb.bulk_pack``): sequential writes to one
-container file, not a loose file per feature. All tree writes happen in one
-TreeBuilder flush.
+merges the resulting trees (fast_import.py:286-399). Here all object writes
+go into packfiles, not per-feature loose files: serial imports append every
+blob/tree into one new pack (``ObjectDb.bulk_pack``); shardable sources
+(int-pk GPKG, see importer/parallel.py) fan out over N worker processes that
+each write their own pack of feature blobs + leaf trees, joined by one
+TreeBuilder spine rewrite. The commit object is written loose *after* the
+packs are fsync'd, so a crash mid-import never leaves a dangling ref.
 """
 
 import time
@@ -96,7 +97,19 @@ def _import_single_source(repo, tb, source, ds_path, *, log=None):
     for path, data in meta_blobs:
         tb.insert(path, repo.odb.write_blob(data))
 
+    from kart_tpu.importer.parallel import (
+        default_workers,
+        run_parallel_import,
+        shardable,
+    )
+
     prefix = f"{ds_path}/{Dataset3.DATASET_DIRNAME}/{Dataset3.FEATURE_PATH}"
+    n_workers = default_workers()
+    if shardable(source, encoder, n_workers):
+        return run_parallel_import(
+            repo, tb, source, ds_path, encoder, prefix, n_workers, log=log
+        )
+
     count = 0
     use_batch_paths = encoder.scheme == "int"
     for batch in chunked(source.features(), BATCH_SIZE):

@@ -1,0 +1,195 @@
+"""Sharded parallel import (reference: kart/fast_import.py:286-399).
+
+The reference fans features out over N ``git fast-import`` subprocesses,
+sharded by feature subtree, then merges the N temp-branch trees. The same
+shape here, without the subprocess protocol: N worker processes each
+
+1. read their own shard of the source table directly (no pickled feature
+   stream through the parent — the parent's read loop was the serial
+   bottleneck),
+2. encode + compress their features and build their *complete leaf trees*,
+3. write everything into their own packfile (concurrency-safe: pack names
+   are content hashes, tmp files are mkstemp'd),
+
+and return ``[(leaf_tree_path, tree_oid)]``. The parent stitches the leaf
+trees into the dataset tree with the ordinary TreeBuilder — the join is one
+tree-spine rewrite, exactly the reference's temp-branch merge.
+
+Sharding key: the feature's *leaf tree index* ``(pk // branches) % max_trees``
+(kart_tpu/models/paths.py) — every feature of a leaf tree lands on the same
+worker, so each leaf tree is built whole. This is only computable in SQL for
+int-pk GPKG sources, which is also the only case where worker-side reads are
+possible; other sources use the serial path.
+
+Leaf trees are flushed streamingly (rows arrive ORDER BY pk, so leaf groups
+are contiguous). pk spans wider than branches**(levels+1) could wrap the
+modulus and revisit a leaf; callers must pre-check `shardable()` which
+verifies the span.
+"""
+
+import multiprocessing
+import os
+import sqlite3
+from concurrent.futures import ProcessPoolExecutor
+
+from kart_tpu.core.objects import MODE_BLOB, MODE_TREE, TreeEntry, serialise_tree
+from kart_tpu.core.packs import PackWriter
+from kart_tpu.models.paths import PathEncoder
+
+MIN_FEATURES_FOR_PARALLEL = 20_000
+
+
+def default_workers():
+    env = os.environ.get("KART_IMPORT_WORKERS")
+    if env:
+        try:
+            return max(1, int(env))
+        except ValueError:
+            pass
+    return os.cpu_count() or 1
+
+
+def shardable(source, encoder, n_workers):
+    """True when this (source, encoder) pair can use the parallel path."""
+    from kart_tpu.importer import GPKGImportSource
+
+    if n_workers < 2 or encoder.scheme != "int":
+        return False
+    if not isinstance(source, GPKGImportSource):
+        return False
+    if source.feature_count < MIN_FEATURES_FOR_PARALLEL:
+        return False
+    pk_cols = [c for c in source.schema.columns if c.pk_index is not None]
+    if len(pk_cols) != 1:
+        return False
+    # modulus wrap check: a pk span wider than branches**(levels+1) can
+    # revisit a leaf tree non-contiguously, breaking streaming flushes
+    con = sqlite3.connect(source.gpkg_path)
+    try:
+        from kart_tpu.adapters.gpkg import quote
+
+        lo, hi = con.execute(
+            f"SELECT MIN({quote(pk_cols[0].name)}), MAX({quote(pk_cols[0].name)}) "
+            f"FROM {quote(source.table_name)}"
+        ).fetchone()
+    finally:
+        con.close()
+    if lo is None or lo < 0:
+        # negative pks: SQLite's '/' truncates toward zero and '%' keeps the
+        # dividend's sign, so the SQL shard predicate would disagree with
+        # PathEncoder's floor-division leaf index — silently dropping or
+        # double-assigning features. Serial path handles them fine.
+        return False
+    return (hi - lo) < encoder.branches ** (encoder.levels + 1)
+
+
+def run_parallel_import(repo, tb, source, ds_path, encoder, prefix, n_workers, log=None):
+    """Fan the source out over n_workers processes; insert the resulting
+    leaf trees under ``prefix`` in ``tb``. ``encoder`` is the one
+    ``shardable()`` validated. -> feature count."""
+    schema_dicts = source.schema.to_column_dicts()
+
+    args = [
+        (
+            os.path.join(repo.gitdir, "objects"),
+            source.gpkg_path,
+            source.table_name,
+            schema_dicts,
+            encoder.to_dict(),
+            shard,
+            n_workers,
+        )
+        for shard in range(n_workers)
+    ]
+    total = 0
+    # spawn, not fork: the parent may have initialised a (multithreaded)
+    # jax backend, and forking a threaded process can deadlock the workers
+    ctx = multiprocessing.get_context("spawn")
+    with ProcessPoolExecutor(max_workers=n_workers, mp_context=ctx) as pool:
+        for count, leaf_entries in pool.map(_import_shard, args):
+            total += count
+            for leaf_path, tree_oid in leaf_entries:
+                tb.insert(prefix + leaf_path, tree_oid, mode=MODE_TREE)
+    repo.odb.packs.refresh()
+    if log:
+        log(f"  {ds_path}: {total} features over {n_workers} workers")
+    return total
+
+
+def _import_shard(packed_args):
+    """Worker: read one shard of the table, build its leaf trees, write one
+    pack. -> (count, [(leaf_tree_path, tree_oid)])."""
+    (
+        objects_dir,
+        gpkg_path,
+        table_name,
+        schema_dicts,
+        encoder_dict,
+        shard,
+        n_shards,
+    ) = packed_args
+
+    from kart_tpu.adapters import gpkg as gpkg_adapter
+    from kart_tpu.models.schema import Schema
+
+    schema = Schema.from_column_dicts(schema_dicts)
+    encoder = PathEncoder.get(**encoder_dict)
+    (pk_col,) = [c for c in schema.columns if c.pk_index is not None]
+    branches = encoder.branches
+    max_trees = encoder.max_trees
+
+    con = sqlite3.connect(gpkg_path)
+    con.row_factory = sqlite3.Row
+    q = gpkg_adapter.quote
+    pk = q(pk_col.name)
+    sql = (
+        f"SELECT * FROM {q(table_name)} "
+        f"WHERE (({pk} / {branches}) % {max_trees}) % {n_shards} = ? "
+        f"ORDER BY {pk}"
+    )
+
+    count = 0
+    leaf_entries = []
+    current_leaf = None  # tree path string
+    current_entries = []
+
+    try:
+        with PackWriter(os.path.join(objects_dir, "pack")) as writer:
+
+            def flush_leaf():
+                nonlocal current_leaf, current_entries
+                if current_leaf is None:
+                    return
+                tree_oid = writer.add(
+                    "tree", serialise_tree(current_entries)
+                )
+                leaf_entries.append((current_leaf, tree_oid))
+                current_entries = []
+                current_leaf = None
+
+            cursor = con.execute(sql, (shard,))
+            cursor.arraysize = 10000
+            while True:
+                rows = cursor.fetchmany()
+                if not rows:
+                    break
+                for row in rows:
+                    feature = {
+                        col.name: gpkg_adapter.value_to_v2(row[col.name], col)
+                        for col in schema.columns
+                    }
+                    pk_values, blob = schema.encode_feature_blob(feature)
+                    full = encoder.encode_pks_to_path(pk_values)
+                    leaf_path, _, filename = full.rpartition("/")
+                    if leaf_path != current_leaf:
+                        flush_leaf()
+                        current_leaf = leaf_path
+                    blob_oid = writer.add("blob", blob)
+                    current_entries.append(
+                        TreeEntry(filename, MODE_BLOB, blob_oid)
+                    )
+                    count += 1
+            flush_leaf()
+    finally:
+        con.close()
+    return count, leaf_entries
