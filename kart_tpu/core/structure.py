@@ -180,6 +180,7 @@ class RepoStructure:
         new_tree = self.create_tree_from_diff(repo_diff)
         if not allow_empty and not amend and new_tree == self.tree_oid:
             raise InvalidOperation("No changes to commit", "NO_CHANGES")
+        self._update_sidecars(repo_diff, new_tree)
         if amend:
             commit = self.commit
             if commit is None:
@@ -197,6 +198,40 @@ class RepoStructure:
             author=author,
             committer=committer,
         )
+
+    def _update_sidecars(self, repo_diff, new_tree):
+        """Roll each changed dataset's columnar sidecar forward to the new
+        feature tree (cache maintenance — never allowed to break a commit)."""
+        try:
+            from kart_tpu.diff import sidecar
+
+            root = self.repo.odb.tree(new_tree)
+            for ds_path, ds_diff in repo_diff.items():
+                feature_diff = ds_diff.get("feature")
+                if not feature_diff:
+                    continue
+                if ds_diff.get("meta"):
+                    # schema may have changed mid-commit: new blobs were
+                    # encoded with the new schema, which the incremental
+                    # update can't see — let the next diff rebuild instead
+                    # of caching wrong oids
+                    continue
+                old_ds = self.datasets.get(ds_path)
+                if old_ds is None:
+                    continue
+                node = root.get_or_none(
+                    f"{ds_path}/{old_ds.DATASET_DIRNAME}/feature"
+                )
+                if node is not None:
+                    sidecar.update_sidecar_for_commit(
+                        self.repo, old_ds, node.oid, feature_diff
+                    )
+        except Exception:
+            import logging
+
+            logging.getLogger(__name__).warning(
+                "columnar sidecar update failed (cache only)", exc_info=True
+            )
 
     def check_values_match_schema(self, repo_diff):
         """Schema-validate every new feature value in the diff

@@ -91,12 +91,24 @@ def get_feature_diff(base_ds, target_ds, ds_filter=None):
     return result
 
 
-def get_feature_diff_columnar(base_ds, target_ds, ds_filter=None):
+def _pks_for_index(block, ds, i):
+    """pk tuple for a block row — direct from the key for int-pk blocks
+    (sidecar blocks recompute paths from pks, so going via the path would
+    round-trip for nothing), via path decode otherwise."""
+    from kart_tpu.diff.sidecar import IntKeyPaths
+
+    if isinstance(block.paths, IntKeyPaths):
+        return (int(block.keys[i]),)
+    return ds.decode_path_to_pks(block.path_for_index(i))
+
+
+def get_feature_diff_columnar(base_ds, target_ds, ds_filter=None, *, blocks=None):
     """Bulk columnar variant of get_feature_diff: both versions' (pk, oid)
     arrays are classified in one jitted device join, and only changed rows
     get (lazy) Deltas. Semantically identical to the tree-diff path; chosen
-    when both sides are materialised anyway (working-copy compare, merge,
-    benchmarks). O(N) device work instead of O(changed) host tree-walk."""
+    when both sides have sidecar indexes (O(1) mmap loads) or are
+    materialised anyway (working-copy compare, merge, benchmarks).
+    ``blocks``: optional pre-loaded (old_block, new_block)."""
     from kart_tpu.ops.blocks import FeatureBlock
     from kart_tpu.ops.diff_kernel import (
         DELETE,
@@ -113,8 +125,13 @@ def get_feature_diff_columnar(base_ds, target_ds, ds_filter=None):
 
     feature_filter = ds_filter["feature"] if ds_filter is not None else None
     result = DeltaDiff()
-    old_block = FeatureBlock.from_dataset(base_ds) if base_ds is not None else empty_block()
-    new_block = FeatureBlock.from_dataset(target_ds) if target_ds is not None else empty_block()
+    if blocks is not None:
+        old_block, new_block = blocks
+    else:
+        old_block = FeatureBlock.from_dataset(base_ds) if base_ds is not None else None
+        new_block = FeatureBlock.from_dataset(target_ds) if target_ds is not None else None
+    old_block = old_block if old_block is not None else empty_block()
+    new_block = new_block if new_block is not None else empty_block()
     if old_block.has_key_collisions() or new_block.has_key_collisions():
         # 63-bit hash identity collided (hash-encoded dataset): fall back to
         # the exact tree-diff path
@@ -142,8 +159,7 @@ def get_feature_diff_columnar(base_ds, target_ds, ds_filter=None):
                     return get_feature_diff(base_ds, target_ds, ds_filter)
 
     for i in old_idx:
-        path = old_block.path_for_index(int(i))
-        pks = base_ds.decode_path_to_pks(path)
+        pks = _pks_for_index(old_block, base_ds, int(i))
         key = pks[0] if len(pks) == 1 else pks
         if feature_filter is not None and key not in feature_filter:
             continue
@@ -157,13 +173,46 @@ def get_feature_diff_columnar(base_ds, target_ds, ds_filter=None):
     for i in new_idx:
         if new_class[i] != INSERT:
             continue  # updates already added
-        path = new_block.path_for_index(int(i))
-        pks = target_ds.decode_path_to_pks(path)
+        pks = _pks_for_index(new_block, target_ds, int(i))
         key = pks[0] if len(pks) == 1 else pks
         if feature_filter is not None and key not in feature_filter:
             continue
         result.add_delta(Delta.insert(KeyValue((key, target_ds.get_feature_promise(pks)))))
     return result
+
+
+def _feature_diff_routed(base_ds, target_ds, ds_filter=None):
+    """Engine selection for the real CLI path: when both revisions have a
+    columnar sidecar (O(1) mmap loads), classification runs as the vectorized
+    (device) join; otherwise the O(changed) host tree-walk. Force with
+    KART_DIFF_ENGINE=columnar|tree."""
+    import os
+
+    from kart_tpu.diff import sidecar
+
+    base_tree = base_ds.feature_tree if base_ds is not None else None
+    target_tree = target_ds.feature_tree if target_ds is not None else None
+    if (base_tree.oid if base_tree else None) == (
+        target_tree.oid if target_tree else None
+    ):
+        # identical trees (the usual `kart status`/WC-diff base): O(1),
+        # never a full-dataset classify
+        return DeltaDiff()
+
+    mode = os.environ.get("KART_DIFF_ENGINE", "auto")
+    if mode != "tree" and base_ds is not None and target_ds is not None:
+        repo = base_ds.repo or target_ds.repo
+        if repo is not None and (
+            mode == "columnar"
+            or (sidecar.has_sidecar(repo, base_ds) and sidecar.has_sidecar(repo, target_ds))
+        ):
+            old_block = sidecar.ensure_block(repo, base_ds)
+            new_block = sidecar.ensure_block(repo, target_ds)
+            if old_block is not None and new_block is not None:
+                return get_feature_diff_columnar(
+                    base_ds, target_ds, ds_filter, blocks=(old_block, new_block)
+                )
+    return get_feature_diff(base_ds, target_ds, ds_filter)
 
 
 def get_meta_diff(base_ds, target_ds, ds_filter=None):
@@ -197,7 +246,7 @@ def get_dataset_diff(
     if base_ds is None and target_ds is None:
         return diff
     diff["meta"] = get_meta_diff(base_ds, target_ds, ds_filter)
-    diff["feature"] = get_feature_diff(base_ds, target_ds, ds_filter)
+    diff["feature"] = _feature_diff_routed(base_ds, target_ds, ds_filter)
 
     if include_wc_diff:
         if target_ds is None:

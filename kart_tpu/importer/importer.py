@@ -22,6 +22,9 @@ from kart_tpu.models.paths import encoder_for_schema
 from kart_tpu.utils import chunked
 
 BATCH_SIZE = 10000
+# below this, the tree-walk diff path is so cheap that a sidecar isn't worth
+# the disk; above it, first-diff latency matters
+SIDECAR_MIN_FEATURES = 10000
 
 
 class ImportError_(RuntimeError):
@@ -45,8 +48,11 @@ def import_sources(
 
     from kart_tpu.importer.pk_generation import PkGeneratingImportSource
 
+    from kart_tpu.diff.sidecar import SidecarCapture
+
     tb = TreeBuilder(repo.odb, head_tree)
     ds_paths = []
+    captures = {}
     total = 0
     t0 = time.monotonic()
     with repo.odb.bulk_pack():
@@ -61,9 +67,13 @@ def import_sources(
                 )
             if replace_existing:
                 tb.remove(ds_path)
-            count = _import_single_source(repo, tb, source, ds_path, log=log)
+            capture = SidecarCapture()
+            count = _import_single_source(
+                repo, tb, source, ds_path, log=log, capture=capture
+            )
             total += count
             ds_paths.append(ds_path)
+            captures[ds_path] = capture
 
         new_tree = tb.flush()
 
@@ -75,6 +85,18 @@ def import_sources(
         message = f"Import {len(ds_paths)} dataset(s): " + ", ".join(ds_paths)
     parents = [repo.head_commit_oid] if repo.head_commit_oid else []
     commit_oid = repo.create_commit("HEAD", new_tree, message, parents)
+
+    # columnar sidecars, straight from the captured import stream — big
+    # datasets get O(1) FeatureBlock loads on their first diff
+    root = repo.odb.tree(new_tree)
+    for ds_path, capture in captures.items():
+        if capture.count < SIDECAR_MIN_FEATURES:
+            continue
+        node = root.get_or_none(
+            f"{ds_path}/{Dataset3.DATASET_DIRNAME}/feature"
+        )
+        if node is not None:
+            capture.save(repo, node.oid)
     if log:
         dt = time.monotonic() - t0
         rate = total / dt if dt > 0 else float("inf")
@@ -82,7 +104,7 @@ def import_sources(
     return commit_oid
 
 
-def _import_single_source(repo, tb, source, ds_path, *, log=None):
+def _import_single_source(repo, tb, source, ds_path, *, log=None, capture=None):
     schema = source.schema
     encoder = encoder_for_schema(schema)
     meta = source.meta_items()
@@ -106,9 +128,11 @@ def _import_single_source(repo, tb, source, ds_path, *, log=None):
     prefix = f"{ds_path}/{Dataset3.DATASET_DIRNAME}/{Dataset3.FEATURE_PATH}"
     n_workers = default_workers()
     if shardable(source, encoder, n_workers):
-        return run_parallel_import(
-            repo, tb, source, ds_path, encoder, prefix, n_workers, log=log
+        count = run_parallel_import(
+            repo, tb, source, ds_path, encoder, prefix, n_workers,
+            log=log, capture=capture,
         )
+        return count
 
     count = 0
     use_batch_paths = encoder.scheme == "int"
@@ -125,8 +149,13 @@ def _import_single_source(repo, tb, source, ds_path, *, log=None):
             rel_paths = [
                 encoder.encode_pks_to_path(pk_values) for pk_values, _ in encoded
             ]
-        for rel, (_, blob) in zip(rel_paths, encoded):
-            tb.insert(prefix + rel, repo.odb.write_blob(blob))
+        oids = [repo.odb.write_raw("blob", blob) for _, blob in encoded]
+        tb.insert_many((prefix + rel for rel in rel_paths), oids)
+        if capture is not None:
+            if use_batch_paths:
+                capture.add_int_batch(pks, oids)
+            else:
+                capture.add_path_batch(rel_paths, oids)
         count += len(batch)
         if log and count % 100000 == 0:
             log(f"  {ds_path}: {count} features...")

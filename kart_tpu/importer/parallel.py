@@ -83,10 +83,13 @@ def shardable(source, encoder, n_workers):
     return (hi - lo) < encoder.branches ** (encoder.levels + 1)
 
 
-def run_parallel_import(repo, tb, source, ds_path, encoder, prefix, n_workers, log=None):
+def run_parallel_import(
+    repo, tb, source, ds_path, encoder, prefix, n_workers, log=None, capture=None
+):
     """Fan the source out over n_workers processes; insert the resulting
     leaf trees under ``prefix`` in ``tb``. ``encoder`` is the one
-    ``shardable()`` validated. -> feature count."""
+    ``shardable()`` validated. ``capture`` (SidecarCapture) receives each
+    worker's (pk, oid) arrays for the columnar sidecar. -> feature count."""
     schema_dicts = source.schema.to_column_dicts()
 
     args = [
@@ -106,10 +109,12 @@ def run_parallel_import(repo, tb, source, ds_path, encoder, prefix, n_workers, l
     # jax backend, and forking a threaded process can deadlock the workers
     ctx = multiprocessing.get_context("spawn")
     with ProcessPoolExecutor(max_workers=n_workers, mp_context=ctx) as pool:
-        for count, leaf_entries in pool.map(_import_shard, args):
+        for count, leaf_entries, pks, oid_bytes in pool.map(_import_shard, args):
             total += count
             for leaf_path, tree_oid in leaf_entries:
                 tb.insert(prefix + leaf_path, tree_oid, mode=MODE_TREE)
+            if capture is not None and count:
+                capture.add_int_raw(pks, oid_bytes)
     repo.odb.packs.refresh()
     if log:
         log(f"  {ds_path}: {total} features over {n_workers} workers")
@@ -150,6 +155,8 @@ def _import_shard(packed_args):
 
     count = 0
     leaf_entries = []
+    pks_out = []
+    oids_out = bytearray()
     current_leaf = None  # tree path string
     current_entries = []
 
@@ -188,8 +195,12 @@ def _import_shard(packed_args):
                     current_entries.append(
                         TreeEntry(filename, MODE_BLOB, blob_oid)
                     )
+                    pks_out.append(pk_values[0])
+                    oids_out += bytes.fromhex(blob_oid)
                     count += 1
             flush_leaf()
     finally:
         con.close()
-    return count, leaf_entries
+    import numpy as np
+
+    return count, leaf_entries, np.asarray(pks_out, dtype=np.int64), bytes(oids_out)
