@@ -170,3 +170,75 @@ class BaseAdapter:
         if data_type in ("integer", "float"):
             return col_dict.get("size")
         return None
+
+
+def sql_string_literal(value):
+    """Embed an arbitrary name in a SQL '...' literal: single quotes double.
+    For DDL (triggers, schema bootstrap) where bind params aren't available —
+    a dataset path or db-schema containing a quote must not break the SQL
+    (or worse, inject)."""
+    return "'" + str(value).replace("'", "''") + "'"
+
+
+def timestamp_to_v2(value, col):
+    """DB timestamp (datetime or string) -> canonical V2 text:
+    ``YYYY-MM-DDThh:mm:ss[.ffffff]`` with tz offsets normalised to ``Z``.
+    UTC-typed columns (extra ``timezone: "UTC"``) always carry the ``Z``
+    (Schema._check_timestamp rejects ``+00:00``-style offsets)."""
+    import datetime as dt
+    import re
+
+    is_utc_col = col.extra_type_info.get("timezone") == "UTC"
+    if isinstance(value, dt.datetime):
+        if value.tzinfo is not None:
+            value = value.astimezone(dt.timezone.utc).replace(tzinfo=None)
+            return value.isoformat() + "Z"
+        return value.isoformat() + ("Z" if is_utc_col else "")
+    s = str(value).replace(" ", "T")
+    m = re.search(r"([+-]\d{2}:?\d{2})$", s)
+    if m:
+        if m.group(1) in ("+00:00", "+0000", "-00:00", "-0000"):
+            s = s[: m.start()] + "Z"
+        else:
+            # non-UTC offset: convert through datetime
+            try:
+                parsed = dt.datetime.fromisoformat(s)
+                s = (
+                    parsed.astimezone(dt.timezone.utc)
+                    .replace(tzinfo=None)
+                    .isoformat()
+                    + "Z"
+                )
+            except ValueError:
+                pass
+    elif is_utc_col and not s.endswith("Z"):
+        s += "Z"
+    return s
+
+
+def interval_to_v2(value):
+    """DB interval (timedelta or string) -> ISO-8601 duration ``PnDTnHnMnS``
+    (the only form Schema._check_interval accepts)."""
+    import datetime as dt
+
+    if not isinstance(value, dt.timedelta):
+        return str(value)
+    days = value.days
+    seconds = value.seconds
+    micros = value.microseconds
+    hours, seconds = divmod(seconds, 3600)
+    minutes, seconds = divmod(seconds, 60)
+    out = "P"
+    if days:
+        out += f"{days}D"
+    if hours or minutes or seconds or micros or out == "P":
+        out += "T"
+        if hours:
+            out += f"{hours}H"
+        if minutes:
+            out += f"{minutes}M"
+        if micros:
+            out += f"{seconds + micros / 1_000_000:g}S"
+        elif seconds or (not hours and not minutes):
+            out += f"{seconds}S"
+    return out

@@ -145,7 +145,9 @@ class MySqlAdapter(BaseAdapter):
         if t == "blob":
             return bytes(value) if isinstance(value, memoryview) else value
         if t == "timestamp":
-            return str(value).replace(" ", "T")
+            from kart_tpu.adapters.base import timestamp_to_v2
+
+            return timestamp_to_v2(value, col)
         if t in ("date", "time"):
             return str(value)
         if t == "numeric":

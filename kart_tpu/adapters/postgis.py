@@ -112,8 +112,16 @@ class PostgisAdapter(BaseAdapter):
             return Geometry.of(value).normalised()
         if t == "blob":
             return bytes(value) if isinstance(value, memoryview) else value
-        if t in ("date", "time", "timestamp", "interval"):
-            return str(value).replace(" ", "T") if t == "timestamp" else str(value)
+        if t == "timestamp":
+            from kart_tpu.adapters.base import timestamp_to_v2
+
+            return timestamp_to_v2(value, col)
+        if t == "interval":
+            from kart_tpu.adapters.base import interval_to_v2
+
+            return interval_to_v2(value)
+        if t in ("date", "time"):
+            return str(value)
         if t == "numeric":
             return str(value)
         return value

@@ -348,18 +348,21 @@ class GpkgWorkingCopy:
             )
         return Schema(cols)
 
-    def _wc_meta_items(self, con, table, aligned_schema):
+    def _wc_meta_items(self, con, table, aligned_schema, dataset_title=None):
         out = {"schema.json": aligned_schema.to_column_dicts()}
         row = con.execute(
             "SELECT identifier, description, srs_id FROM gpkg_contents WHERE table_name = ?",
             (table,),
         ).fetchone()
         if row:
-            # identifier falls back to the table name on write: reading that
-            # default back is not a user edit (reference: gpkg.py:298-390
+            # identifier falls back to the table name on write when the
+            # dataset has no title: reading that default back is not a user
+            # edit — but a dataset title that legitimately *equals* the table
+            # name must still roundtrip (reference: gpkg.py:298-390
             # title/identifier approximation fixups)
-            if row["identifier"] and row["identifier"] != table:
-                out["title"] = row["identifier"]
+            if row["identifier"]:
+                if row["identifier"] != table or dataset_title == table:
+                    out["title"] = row["identifier"]
             if row["description"]:
                 out["description"] = row["description"]
         geom = con.execute(
@@ -409,8 +412,10 @@ class GpkgWorkingCopy:
         aligned = dataset.schema.align_to_self(
             wc_schema, roundtrip_ctx=adapter.GpkgRoundtripContext
         )
-        wc_items = self._wc_meta_items(con, table, aligned)
         ds_items = dataset.meta_items()
+        wc_items = self._wc_meta_items(
+            con, table, aligned, dataset_title=ds_items.get("title")
+        )
         out = DeltaDiff()
         for name in sorted(set(ds_items) | set(wc_items)):
             if name == "metadata.xml":

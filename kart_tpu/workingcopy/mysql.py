@@ -147,18 +147,10 @@ class MySqlWorkingCopy(DatabaseServerWorkingCopy):
         return out
 
     def _post_write_dataset(self, con, ds, table, crs_id):
-        schema = ds.schema
-        geom_col = schema.first_geometry_column
-        if geom_col is not None and crs_id:
-            # spatial indexes require NOT NULL + SRID-constrained columns;
-            # the column was created with "SRID n" so the index is valid
-            try:
-                self._execute(
-                    con,
-                    f"ALTER TABLE {self._table_identifier(table)} "
-                    f"MODIFY {self.ADAPTER.quote(geom_col.name)} GEOMETRY "
-                    f"NOT NULL SRID {int(crs_id)}, "
-                    f"ADD SPATIAL INDEX ({self.ADAPTER.quote(geom_col.name)})",
-                )
-            except Exception:
-                pass  # nullable geometry: skip the index, data is still correct
+        # No spatial index: MySQL requires the geometry column to be made
+        # generic GEOMETRY NOT NULL for one, which discards the typed column
+        # (geometryType would never roundtrip — a fresh checkout would show a
+        # spurious schema edit) and forbids NULL geometries in later edits.
+        # The reference skips it for exactly this reason
+        # (kart/working_copy/mysql.py:126-133).
+        pass

@@ -92,13 +92,18 @@ class PostgisWorkingCopy(DatabaseServerWorkingCopy):
                 "not installed in this environment. Use a GPKG working copy, "
                 "or install psycopg2."
             )
-        return psycopg2.connect(
+        con = psycopg2.connect(
             host=self.host,
             port=self.port or 5432,
             dbname=self.db_name,
             user=self.username,
             password=self.password,
         )
+        # intervals must stringify as ISO-8601 durations — the only form the
+        # V2 schema accepts (reference: sqlalchemy/postgis.py:18)
+        with con.cursor() as cur:
+            cur.execute("SET intervalstyle = 'iso_8601'")
+        return con
 
     def _schema_exists(self, con):
         cur = self._execute(
