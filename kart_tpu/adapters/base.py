@@ -56,6 +56,13 @@ class BaseAdapter:
             return f"{cls.quote(db_schema)}.{cls.quote(table_name)}"
         return cls.quote(table_name)
 
+    @staticmethod
+    def string_literal(value):
+        """A SQL '…' literal: names (table/pk/schema) embedded in trigger DDL
+        string literals must not break out of the literal, so a dataset path
+        containing a quote stays data rather than SQL."""
+        return "'" + str(value).replace("'", "''") + "'"
+
     # -- V2 -> SQL -----------------------------------------------------------
 
     @classmethod

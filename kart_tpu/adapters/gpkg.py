@@ -68,6 +68,12 @@ def quote(ident):
     return '"' + ident.replace('"', '""') + '"'
 
 
+def string_literal(value):
+    """SQL '…' literal with embedded quotes doubled, for names that must be
+    inlined into trigger bodies (sqlite can't bind params inside DDL)."""
+    return "'" + str(value).replace("'", "''") + "'"
+
+
 def v2_type_to_sql_type(col: ColumnSchema):
     mapped = V2_TYPE_TO_SQL[col.data_type]
     extra = col.extra_type_info

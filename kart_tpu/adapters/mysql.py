@@ -193,18 +193,19 @@ class MySqlAdapter(BaseAdapter):
         track = cls.quote_table(KART_TRACK, db_schema)
         tbl = cls.quote_table(table_name, db_schema)
         pk = cls.quote(pk_name)
+        name_lit = cls.string_literal(table_name)
 
         def trig(suffix):
             return cls.quote_table(f"_kart_track_{table_name}_{suffix}", db_schema)
 
         return [
             f"CREATE TRIGGER {trig('ins')} AFTER INSERT ON {tbl} FOR EACH ROW "
-            f"REPLACE INTO {track} (table_name, pk) VALUES ('{table_name}', NEW.{pk})",
+            f"REPLACE INTO {track} (table_name, pk) VALUES ({name_lit}, NEW.{pk})",
             f"CREATE TRIGGER {trig('upd')} AFTER UPDATE ON {tbl} FOR EACH ROW "
             f"REPLACE INTO {track} (table_name, pk) "
-            f"VALUES ('{table_name}', OLD.{pk}), ('{table_name}', NEW.{pk})",
+            f"VALUES ({name_lit}, OLD.{pk}), ({name_lit}, NEW.{pk})",
             f"CREATE TRIGGER {trig('del')} AFTER DELETE ON {tbl} FOR EACH ROW "
-            f"REPLACE INTO {track} (table_name, pk) VALUES ('{table_name}', OLD.{pk})",
+            f"REPLACE INTO {track} (table_name, pk) VALUES ({name_lit}, OLD.{pk})",
         ]
 
     @classmethod

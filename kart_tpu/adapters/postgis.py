@@ -185,7 +185,7 @@ class PostgisAdapter(BaseAdapter):
         return (
             f'CREATE TRIGGER "_kart_track_trigger" '
             f"AFTER INSERT OR UPDATE OR DELETE ON {tbl} "
-            f"FOR EACH ROW EXECUTE PROCEDURE {proc}('{pk_name}')"
+            f"FOR EACH ROW EXECUTE PROCEDURE {proc}({cls.string_literal(pk_name)})"
         )
 
     @classmethod

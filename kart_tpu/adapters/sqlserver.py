@@ -192,14 +192,20 @@ class SqlServerAdapter(BaseAdapter):
     def base_ddl(cls, db_schema):
         state = cls.quote_table(KART_STATE, db_schema)
         track = cls.quote_table(KART_TRACK, db_schema)
+        schema_lit = cls.string_literal(db_schema)
+        state_lit = cls.string_literal(f"{db_schema}.{KART_STATE}")
+        track_lit = cls.string_literal(f"{db_schema}.{KART_TRACK}")
+        # EXEC('…') needs the already-quoted identifier re-escaped for the
+        # inner literal
+        create_schema = cls.string_literal(f"CREATE SCHEMA {cls.quote(db_schema)}")
         return [
-            f"IF SCHEMA_ID('{db_schema}') IS NULL "
-            f"EXEC('CREATE SCHEMA {cls.quote(db_schema)}')",
-            f"IF OBJECT_ID('{db_schema}.{KART_STATE}') IS NULL "
+            f"IF SCHEMA_ID({schema_lit}) IS NULL "
+            f"EXEC({create_schema})",
+            f"IF OBJECT_ID({state_lit}) IS NULL "
             f"CREATE TABLE {state} ("
             f"table_name NVARCHAR(400) NOT NULL, [key] NVARCHAR(400) NOT NULL, "
             f"value NVARCHAR(max), PRIMARY KEY (table_name, [key]))",
-            f"IF OBJECT_ID('{db_schema}.{KART_TRACK}') IS NULL "
+            f"IF OBJECT_ID({track_lit}) IS NULL "
             f"CREATE TABLE {track} ("
             f"table_name NVARCHAR(400) NOT NULL, pk NVARCHAR(400), "
             f"PRIMARY KEY (table_name, pk))",
@@ -213,12 +219,13 @@ class SqlServerAdapter(BaseAdapter):
         tbl = cls.quote_table(table_name, db_schema)
         trig = cls.quote_table(f"_kart_track_{table_name}_trigger", db_schema)
         pk = cls.quote(pk_name)
+        name_lit = cls.string_literal(table_name)
         return (
             f"CREATE TRIGGER {trig} ON {tbl} AFTER INSERT, UPDATE, DELETE AS "
             f"BEGIN "
             f"MERGE {track} TRA USING "
-            f"(SELECT '{table_name}', {pk} FROM inserted "
-            f"UNION SELECT '{table_name}', {pk} FROM deleted) "
+            f"(SELECT {name_lit}, {pk} FROM inserted "
+            f"UNION SELECT {name_lit}, {pk} FROM deleted) "
             f"AS SRC (table_name, pk) "
             f"ON SRC.table_name = TRA.table_name AND SRC.pk = TRA.pk "
             f"WHEN NOT MATCHED THEN INSERT (table_name, pk) "

@@ -167,6 +167,18 @@ class DatabaseServerWorkingCopy:
     def _has_feature_tables(self, con):
         raise NotImplementedError
 
+    def _list_feature_tables(self, con):
+        """All non-kart tables in the WC container (information_schema works
+        for PostGIS/MySQL/SQL Server; the _kart_ filter is done host-side to
+        dodge per-dialect LIKE-escape rules)."""
+        cur = self._execute(
+            con,
+            "SELECT table_name FROM information_schema.tables "
+            f"WHERE table_schema = {self.PARAMSTYLE}",
+            (self.db_schema,),
+        )
+        return [r[0] for r in cur.fetchall() if not r[0].startswith("_kart_")]
+
     def create_and_initialise(self):
         with self.session() as con:
             for stmt in self.ADAPTER.base_ddl(self.db_schema):
@@ -530,6 +542,20 @@ class DatabaseServerWorkingCopy:
 
         current_tree = self.get_db_tree()
         if current_tree is None or force:
+            # tables from datasets absent in the target would otherwise
+            # linger in the schema and still count as WC data
+            target_tables = {
+                self._table_name(p) for p in target_structure.datasets.paths()
+            }
+            with self.session() as con:
+                if self._schema_exists(con):
+                    for table in self._list_feature_tables(con):
+                        if table not in target_tables:
+                            self._execute(
+                                con,
+                                f"DROP TABLE IF EXISTS "
+                                f"{self._table_identifier(table)}",
+                            )
             self.write_full(target_structure, *target_structure.datasets)
             if force:
                 with self.session() as con:

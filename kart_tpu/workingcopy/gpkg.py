@@ -278,31 +278,38 @@ class GpkgWorkingCopy:
         """Edit tracking (reference: gpkg.py:498-554)."""
         pk = adapter.quote(schema.pk_columns[0].name) if schema.pk_columns else "rowid"
         qt = adapter.quote(table)
-        prefix = f"trigger_kart_{table}"
-        con.execute(f'DROP TRIGGER IF EXISTS "{prefix}_ins"')
-        con.execute(f'DROP TRIGGER IF EXISTS "{prefix}_upd"')
-        con.execute(f'DROP TRIGGER IF EXISTS "{prefix}_del"')
+        lit = adapter.string_literal(table)
+        for suffix in ("ins", "upd", "del"):
+            con.execute(
+                f"DROP TRIGGER IF EXISTS "
+                f"{adapter.quote(f'trigger_kart_{table}_{suffix}')}"
+            )
         con.execute(
-            f'CREATE TRIGGER "{prefix}_ins" AFTER INSERT ON {qt} BEGIN '
-            f"INSERT OR REPLACE INTO {TRACK_TABLE} (table_name, pk) VALUES ('{table}', NEW.{pk}); END;"
+            f"CREATE TRIGGER {adapter.quote(f'trigger_kart_{table}_ins')} "
+            f"AFTER INSERT ON {qt} BEGIN "
+            f"INSERT OR REPLACE INTO {TRACK_TABLE} (table_name, pk) VALUES ({lit}, NEW.{pk}); END;"
         )
         con.execute(
-            f'CREATE TRIGGER "{prefix}_upd" AFTER UPDATE ON {qt} BEGIN '
-            f"INSERT OR REPLACE INTO {TRACK_TABLE} (table_name, pk) VALUES ('{table}', NEW.{pk}); "
-            f"INSERT OR REPLACE INTO {TRACK_TABLE} (table_name, pk) VALUES ('{table}', OLD.{pk}); END;"
+            f"CREATE TRIGGER {adapter.quote(f'trigger_kart_{table}_upd')} "
+            f"AFTER UPDATE ON {qt} BEGIN "
+            f"INSERT OR REPLACE INTO {TRACK_TABLE} (table_name, pk) VALUES ({lit}, NEW.{pk}); "
+            f"INSERT OR REPLACE INTO {TRACK_TABLE} (table_name, pk) VALUES ({lit}, OLD.{pk}); END;"
         )
         con.execute(
-            f'CREATE TRIGGER "{prefix}_del" AFTER DELETE ON {qt} BEGIN '
-            f"INSERT OR REPLACE INTO {TRACK_TABLE} (table_name, pk) VALUES ('{table}', OLD.{pk}); END;"
+            f"CREATE TRIGGER {adapter.quote(f'trigger_kart_{table}_del')} "
+            f"AFTER DELETE ON {qt} BEGIN "
+            f"INSERT OR REPLACE INTO {TRACK_TABLE} (table_name, pk) VALUES ({lit}, OLD.{pk}); END;"
         )
 
     @contextlib.contextmanager
     def _suspended_triggers(self, con, table):
         """Disable tracking while kart itself writes (reference: base.py uses
         a session-level flag; sqlite needs drop/recreate)."""
-        prefix = f"trigger_kart_{table}"
         for suffix in ("ins", "upd", "del"):
-            con.execute(f'DROP TRIGGER IF EXISTS "{prefix}_{suffix}"')
+            con.execute(
+                f"DROP TRIGGER IF EXISTS "
+                f"{adapter.quote(f'trigger_kart_{table}_{suffix}')}"
+            )
         yield
         # recreated by caller via _create_triggers
 
@@ -600,7 +607,10 @@ class GpkgWorkingCopy:
         if not track_changes_as_dirty:
             # suspend triggers so kart's own writes aren't tracked
             for suffix in ("ins", "upd", "del"):
-                con.execute(f'DROP TRIGGER IF EXISTS "trigger_kart_{table}_{suffix}"')
+                con.execute(
+                    f"DROP TRIGGER IF EXISTS "
+                    f"{adapter.quote(f'trigger_kart_{table}_{suffix}')}"
+                )
         try:
             col_names = [c.name for c in schema.columns]
             quoted_cols = ",".join(adapter.quote(c) for c in col_names)

@@ -66,7 +66,7 @@ class SqlServerWorkingCopy(DatabaseServerWorkingCopy):
             SELECT @sql = @sql + 'DROP TABLE ' + QUOTENAME(table_schema)
                 + '.' + QUOTENAME(table_name) + ';'
             FROM information_schema.tables
-            WHERE table_schema = '{self.db_schema}';
+            WHERE table_schema = {self.ADAPTER.string_literal(self.db_schema)};
             EXEC sp_executesql @sql;
             DROP SCHEMA IF EXISTS {self.ADAPTER.quote(self.db_schema)};
         """

@@ -407,3 +407,47 @@ def test_live_roundtrip(env_var, cls, tmp_path):
             assert not wc.diff_dataset_to_working_copy(ds)
     finally:
         wc.delete()
+
+
+class TestSqlNameEscaping:
+    """Names containing quotes must stay inside SQL string literals
+    (advisor finding: injection via WC location URL or dataset path)."""
+
+    def test_string_literal_escapes(self):
+        from kart_tpu.adapters.base import BaseAdapter
+
+        assert BaseAdapter.string_literal("a'b") == "'a''b'"
+        assert BaseAdapter.string_literal("plain") == "'plain'"
+
+    def test_mysql_trigger_ddl_quoted_name(self):
+        from kart_tpu.adapters.mysql import MySqlAdapter
+
+        stmts = MySqlAdapter.create_trigger_sql("s", "ta'ble", "fid")
+        for stmt in stmts:
+            assert "'ta''ble'" in stmt
+            assert "'ta'ble'" not in stmt
+
+    def test_sqlserver_trigger_ddl_quoted_name(self):
+        from kart_tpu.adapters.sqlserver import SqlServerAdapter
+
+        stmt = SqlServerAdapter.create_trigger_sql("s", "ta'ble", "fid")
+        assert "'ta''ble'" in stmt
+        assert "SELECT 'ta'ble'" not in stmt
+
+    def test_sqlserver_base_ddl_quoted_schema(self):
+        from kart_tpu.adapters.sqlserver import SqlServerAdapter
+
+        stmts = SqlServerAdapter.base_ddl("sch'ema")
+        joined = "\n".join(stmts)
+        assert "SCHEMA_ID('sch''ema')" in joined
+
+    def test_postgis_trigger_ddl_quoted_pk(self):
+        from kart_tpu.adapters.postgis import PostgisAdapter
+
+        stmt = PostgisAdapter.create_trigger_sql("s", "t", "p'k")
+        assert "('p''k')" in stmt
+
+    def test_gpkg_string_literal(self):
+        from kart_tpu.adapters import gpkg as adapter
+
+        assert adapter.string_literal("ta'ble") == "'ta''ble'"
