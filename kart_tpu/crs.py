@@ -510,10 +510,86 @@ def _webmerc_inverse(crs, x, y):
     return lon, lat
 
 
+def _lcc_setup(crs):
+    """Shared constants for Lambert Conformal Conic (Snyder 1987, §15;
+    EPSG methods 9801 1SP / 9802 2SP). 1SP is the 2SP degenerate case with
+    both standard parallels at latitude_of_origin and k0 applied."""
+    a, inv_f = crs.semi_major, crs.inv_flattening
+    f = 1.0 / inv_f
+    e2 = f * (2 - f)
+    e = math.sqrt(e2)
+
+    def m(phi):
+        return math.cos(phi) / math.sqrt(1 - e2 * math.sin(phi) ** 2)
+
+    def t(phi):
+        return math.tan(math.pi / 4 - phi / 2) / (
+            (1 - e * math.sin(phi)) / (1 + e * math.sin(phi))
+        ) ** (e / 2)
+
+    p = crs.params
+    lat0 = math.radians(p.get("latitude_of_origin", 0.0))
+    lon0 = math.radians(p.get("central_meridian", 0.0))
+    fe = p.get("false_easting", 0.0)
+    fn = p.get("false_northing", 0.0)
+    sp1 = math.radians(p.get("standard_parallel_1", math.degrees(lat0)))
+    sp2 = math.radians(p.get("standard_parallel_2", math.degrees(sp1)))
+    k0 = p.get("scale_factor", 1.0)
+
+    if abs(sp1 - sp2) > 1e-12:
+        n = (math.log(m(sp1)) - math.log(m(sp2))) / (
+            math.log(t(sp1)) - math.log(t(sp2))
+        )
+    else:
+        n = math.sin(sp1)
+    F = m(sp1) / (n * t(sp1) ** n)
+    rho0 = a * k0 * F * t(lat0) ** n
+    return a, e, n, F * k0, rho0, lat0, lon0, fe, fn
+
+
+def _lcc_forward(crs, lon_deg, lat_deg):
+    a, e, n, Fk, rho0, lat0, lon0, fe, fn = _lcc_setup(crs)
+    lon = np.radians(np.asarray(lon_deg, dtype=np.float64))
+    lat = np.radians(
+        np.clip(np.asarray(lat_deg, dtype=np.float64), -89.9999, 89.9999)
+    )
+    t = np.tan(np.pi / 4 - lat / 2) / (
+        (1 - e * np.sin(lat)) / (1 + e * np.sin(lat))
+    ) ** (e / 2)
+    # southern-hemisphere cones have n, F (and so rho) negative — the
+    # standard formulas handle that with no special-casing (Snyder p.107)
+    rho = a * Fk * t**n
+    theta = n * (lon - lon0)
+    x = fe + rho * np.sin(theta)
+    y = fn + rho0 - rho * np.cos(theta)
+    return x, y
+
+
+def _lcc_inverse(crs, x, y):
+    a, e, n, Fk, rho0, lat0, lon0, fe, fn = _lcc_setup(crs)
+    x = np.asarray(x, dtype=np.float64) - fe
+    y = rho0 - (np.asarray(y, dtype=np.float64) - fn)
+    rho = np.sign(n) * np.sqrt(x**2 + y**2)
+    theta = np.arctan2(np.sign(n) * x, np.sign(n) * y)
+    with np.errstate(divide="ignore", invalid="ignore"):
+        tp = (rho / (a * Fk)) ** (1.0 / n)
+    # iterate the conformal-latitude inversion (converges in a few rounds)
+    phi = np.pi / 2 - 2 * np.arctan(tp)
+    for _ in range(8):
+        phi = np.pi / 2 - 2 * np.arctan(
+            tp * ((1 - e * np.sin(phi)) / (1 + e * np.sin(phi))) ** (e / 2)
+        )
+    lon = theta / n + lon0
+    return np.degrees(lon), np.degrees(phi)
+
+
 _PROJ_IMPLS = {
     "transverse_mercator": (_tm_forward, _tm_inverse),
     "mercator_1sp": (_webmerc_forward, _webmerc_inverse),
     "popular_visualisation_pseudo_mercator": (_webmerc_forward, _webmerc_inverse),
+    "lambert_conformal_conic_2sp": (_lcc_forward, _lcc_inverse),
+    "lambert_conformal_conic_1sp": (_lcc_forward, _lcc_inverse),
+    "lambert_conformal_conic": (_lcc_forward, _lcc_inverse),
 }
 
 

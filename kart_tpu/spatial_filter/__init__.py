@@ -20,6 +20,7 @@ MatchResult, spatial_filter/__init__.py:413-432): a feature whose geometry
 is itself a promised blob can't be tested locally.
 """
 
+import logging
 import os
 from enum import Enum
 
@@ -28,6 +29,13 @@ import numpy as np
 from kart_tpu.core.odb import ObjectPromised
 from kart_tpu.crs import CRS, Transform, make_crs
 from kart_tpu.geometry import MULTIPOLYGON, POLYGON, Geometry
+
+L = logging.getLogger("kart_tpu.spatial_filter")
+
+
+def _transform_ring(t, ring):
+    rx, ry = t.transform(ring[:, 0], ring[:, 1])
+    return np.stack([rx, ry], axis=1)
 
 EPSG_4326_WKT = """GEOGCS["WGS 84",DATUM["WGS_1984",SPHEROID["WGS 84",6378137,298.257223563,AUTHORITY["EPSG","7030"]],AUTHORITY["EPSG","6326"]],PRIMEM["Greenwich",0],UNIT["degree",0.0174532925199433],AUTHORITY["EPSG","4326"]]"""
 
@@ -151,20 +159,19 @@ class ResolvedSpatialFilterSpec:
 
 
 class SpatialFilter:
-    """A filter ready to test features of one dataset: the filter envelope,
-    pre-transformed into the dataset's CRS. Envelope-level semantics: a
-    feature matches when its geometry envelope overlaps the filter
-    geometry's envelope (the reference's envelope fast-path,
-    spatial_filter/__init__.py:534-590; its exact OGR residue check is
-    approximated by the polygon-vs-envelope test in match_polygon_exact)."""
+    """A filter ready to test features of one dataset: the filter envelope
+    and full polygon geometry (all parts, all holes), pre-transformed into
+    the dataset's CRS. Matching is the reference's two stages
+    (spatial_filter/__init__.py:534-590): envelope fast-path, then an exact
+    polygon-vs-feature-envelope test for the residue."""
 
     MATCH_ALL = None  # set below
 
-    def __init__(self, rect_wesn=None, geom_column_name=None, polygon_ring=None):
+    def __init__(self, rect_wesn=None, geom_column_name=None, polygon_parts=None):
         self.match_all = rect_wesn is None
         self.rect = rect_wesn  # (w, e, s, n) in dataset CRS
         self.geom_column_name = geom_column_name
-        self.polygon_ring = polygon_ring  # Nx2 numpy outer ring, dataset CRS
+        self.polygon_parts = polygon_parts  # [(outer, [holes]), ...] dataset CRS
 
     @classmethod
     def for_dataset(cls, spec, dataset):
@@ -172,7 +179,7 @@ class SpatialFilter:
         if geom_col is None:
             return cls.MATCH_ALL  # non-spatial dataset: everything matches
         x0, x1, y0, y1 = spec.envelope_native
-        ring = _outer_ring_array(spec.geometry)
+        parts = _polygon_parts(spec.geometry)
         ds_crs_wkt = None
         try:
             ids = dataset.crs_identifiers()
@@ -186,14 +193,26 @@ class SpatialFilter:
                 try:
                     t = Transform(spec.crs, ds_crs)
                     x0, x1, y0, y1 = t.transform_envelope((x0, x1, y0, y1))
-                    if ring is not None:
-                        rx, ry = t.transform(ring[:, 0], ring[:, 1])
-                        ring = np.stack([rx, ry], axis=1)
-                except Exception:
-                    # unknown projection: keep the untransformed envelope and
-                    # fail open rather than dropping features
+                    if parts is not None:
+                        parts = [
+                            (
+                                _transform_ring(t, outer),
+                                [_transform_ring(t, h) for h in holes],
+                            )
+                            for outer, holes in parts
+                        ]
+                except Exception as e:
+                    # unknown projection: fail open rather than dropping
+                    # features — but never silently
+                    L.warning(
+                        "Spatial filter cannot be transformed into the CRS of "
+                        "dataset %r (%s); the filter will not be applied to "
+                        "this dataset.",
+                        dataset.path,
+                        e,
+                    )
                     return cls.MATCH_ALL
-        return cls((x0, x1, y0, y1), geom_col, ring)
+        return cls((x0, x1, y0, y1), geom_col, parts)
 
     def matches(self, feature):
         result = self.match_result(feature)
@@ -219,8 +238,8 @@ class SpatialFilter:
         w, e, s, n = self.rect
         if not _rect_overlaps(env, (w, e, s, n)):
             return MatchResult.NOT_MATCHED
-        if self.polygon_ring is not None and not _polygon_intersects_rect(
-            self.polygon_ring, env
+        if self.polygon_parts is not None and not _polygon_set_intersects_rect(
+            self.polygon_parts, env
         ):
             return MatchResult.NOT_MATCHED
         return MatchResult.MATCHED
@@ -237,10 +256,11 @@ class SpatialFilter:
 SpatialFilter.MATCH_ALL = SpatialFilter()
 
 
-def _outer_ring_array(geometry):
-    """Outer ring(s) of a Polygon/MultiPolygon as one concatenated array is
-    wrong for point-in-polygon — keep just the first polygon's outer ring;
-    multi-polygon filters fall back to envelope semantics for the rest."""
+def _polygon_parts(geometry):
+    """Polygon/MultiPolygon -> list of (outer_ring, [hole_rings]) with each
+    ring an (N,2) float64 array, or None when the geometry isn't a polygon.
+    Every part and every interior ring is kept — the intersection test is
+    exact, not first-outer-ring-only."""
     from kart_tpu.geometry import parse_wkb
 
     try:
@@ -248,31 +268,46 @@ def _outer_ring_array(geometry):
     except Exception:
         return None
     name = value[0]
-    if name == "Polygon" and value.payload:
-        return np.asarray(value.payload[0], dtype=np.float64)[:, :2]
-    if name == "MultiPolygon" and value.payload:
-        first = value.payload[0]
-        if first.payload:
-            return np.asarray(first.payload[0], dtype=np.float64)[:, :2]
-    return None
+    if name == "Polygon":
+        polys = [value]
+    elif name == "MultiPolygon":
+        polys = value.payload or []
+    else:
+        return None
+    parts = []
+    for poly in polys:
+        rings = [
+            np.asarray(ring, dtype=np.float64)[:, :2]
+            for ring in (poly.payload or [])
+            if len(ring) >= 3
+        ]
+        if rings:
+            parts.append((rings[0], rings[1:]))
+    return parts or None
 
 
-def _polygon_intersects_rect(ring, env):
-    """Exact polygon-vs-rectangle intersection: true when any polygon edge
-    crosses the rect, a polygon vertex is inside the rect, or the rect's
-    corner is inside the polygon. ``ring``: (N,2) closed or open outer ring."""
+def _polygon_set_intersects_rect(parts, env):
+    """Exact (multi)polygon-with-holes vs rectangle intersection: any part
+    whose closed region meets the rect. ``parts``: [(outer, [holes]), ...]."""
+    return any(_one_polygon_intersects_rect(outer, holes, env)
+               for outer, holes in parts)
+
+
+def _one_polygon_intersects_rect(outer, holes, env):
+    """A boundary edge of any ring crossing the rect means the rect touches
+    the polygon's closure (points just outside a hole edge are interior).
+    With no boundary crossing, containment is uniform over the rect, so one
+    rect corner decides: inside the outer ring and outside every hole."""
     x0, x1, y0, y1 = env
-    xs, ys = ring[:, 0], ring[:, 1]
-    # vertex in rect
-    if np.any((xs >= x0) & (xs <= x1) & (ys >= y0) & (ys <= y1)):
-        return True
-    # rect corner in polygon (winding via ray cast)
-    if _point_in_ring(ring, x0, y0):
-        return True
-    # edge/rect crossing: conservative separating-axis on each edge segment
-    ax, ay = xs, ys
-    bx, by = np.roll(xs, -1), np.roll(ys, -1)
-    return bool(np.any(_segment_hits_rect(ax, ay, bx, by, x0, x1, y0, y1)))
+    for ring in (outer, *holes):
+        xs, ys = ring[:, 0], ring[:, 1]
+        ax, ay = xs, ys
+        bx, by = np.roll(xs, -1), np.roll(ys, -1)
+        if np.any(_segment_hits_rect(ax, ay, bx, by, x0, x1, y0, y1)):
+            return True
+    if not _point_in_ring(outer, x0, y0):
+        return False
+    return not any(_point_in_ring(hole, x0, y0) for hole in holes)
 
 
 def _point_in_ring(ring, px, py):

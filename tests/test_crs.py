@@ -90,3 +90,61 @@ def test_utm():
     x, y = t.transform(np.array([177.0]), np.array([0.0]))
     assert abs(x[0] - 500000.0) < 1e-3
     assert abs(y[0] - 10000000.0) < 1e-3
+
+
+LCC_2SP_CLARKE = (
+    'PROJCS["test LCC",GEOGCS["NAD27",DATUM["North_American_Datum_1927",'
+    'SPHEROID["Clarke 1866",6378206.4,294.978698213898]],'
+    'PRIMEM["Greenwich",0],UNIT["degree",0.0174532925199433]],'
+    'PROJECTION["Lambert_Conformal_Conic_2SP"],'
+    'PARAMETER["standard_parallel_1",33],PARAMETER["standard_parallel_2",45],'
+    'PARAMETER["latitude_of_origin",23],PARAMETER["central_meridian",-96],'
+    'PARAMETER["false_easting",0],PARAMETER["false_northing",0],UNIT["metre",1]]'
+)
+NAD27_GEO = (
+    'GEOGCS["NAD27",DATUM["North_American_Datum_1927",'
+    'SPHEROID["Clarke 1866",6378206.4,294.978698213898]],'
+    'PRIMEM["Greenwich",0],UNIT["degree",0.0174532925199433]]'
+)
+LAMBERT_93 = (
+    'PROJCS["RGF93 / Lambert-93",GEOGCS["RGF93",'
+    'DATUM["Reseau_Geodesique_Francais_1993",'
+    'SPHEROID["GRS 1980",6378137,298.257222101]],PRIMEM["Greenwich",0],'
+    'UNIT["degree",0.0174532925199433]],'
+    'PROJECTION["Lambert_Conformal_Conic_2SP"],'
+    'PARAMETER["standard_parallel_1",49],PARAMETER["standard_parallel_2",44],'
+    'PARAMETER["latitude_of_origin",46.5],PARAMETER["central_meridian",3],'
+    'PARAMETER["false_easting",700000],PARAMETER["false_northing",6600000],'
+    'UNIT["metre",1],AUTHORITY["EPSG","2154"]]'
+)
+
+
+def test_lcc_2sp_snyder_known_answer():
+    """Snyder (1987) p.296 numerical example for LCC 2SP on Clarke 1866."""
+    t = Transform(NAD27_GEO, LCC_2SP_CLARKE)
+    x, y = t.transform(np.array([-75.0]), np.array([35.0]))
+    assert abs(x[0] - 1894410.9) < 1.0
+    assert abs(y[0] - 1564649.5) < 1.0
+    inv = Transform(LCC_2SP_CLARKE, NAD27_GEO)
+    lon, lat = inv.transform(x, y)
+    assert abs(lon[0] + 75.0) < 1e-7
+    assert abs(lat[0] - 35.0) < 1e-7
+
+
+def test_lcc_lambert93_paris():
+    t = Transform(
+        'GEOGCS["RGF93",DATUM["Reseau_Geodesique_Francais_1993",'
+        'SPHEROID["GRS 1980",6378137,298.257222101]],PRIMEM["Greenwich",0],'
+        'UNIT["degree",0.0174532925199433]]',
+        LAMBERT_93,
+    )
+    x, y = t.transform(np.array([2.3522]), np.array([48.8566]))
+    assert abs(x[0] - 652470) < 100
+    assert abs(y[0] - 6862035) < 100
+
+
+def test_lcc_envelope_roundtrip():
+    t = Transform(LAMBERT_93, "EPSG:4326")
+    env = t.transform_envelope((600000, 800000, 6700000, 6900000))
+    assert 0.5 < env[0] < env[1] < 5.0
+    assert 47.0 < env[2] < env[3] < 50.0

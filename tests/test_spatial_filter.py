@@ -117,6 +117,63 @@ class TestDatasetFilter:
         # fid=5 at (105, -40.5): inside bbox, outside the hypotenuse
         assert sf.match_result(ds.get_feature([5])) is MatchResult.NOT_MATCHED
 
+    def test_polygon_with_hole(self, repo_ds):
+        """A feature inside an interior ring (hole) of the filter polygon
+        does not match; features in the solid annulus do. Points sit at
+        (100+fid, -40-fid/10)."""
+        _, ds = repo_ds
+        holed = (
+            "POLYGON((100 -45, 106 -45, 106 -39, 100 -39, 100 -45),"
+            "(102 -41, 104 -41, 104 -40, 102 -40, 102 -41))"
+        )
+        spec = ResolvedSpatialFilterSpec("EPSG:4326", holed)
+        sf = spec.resolve_for_dataset(ds)
+        # fid=3 at (103, -40.3): inside the hole -> excluded
+        assert sf.match_result(ds.get_feature([3])) is MatchResult.NOT_MATCHED
+        # fid=5 at (105, -40.5): inside outer, outside the hole -> matched
+        assert sf.match_result(ds.get_feature([5])) is MatchResult.MATCHED
+
+    def test_multipolygon_all_parts(self, repo_ds):
+        """Every part of a MultiPolygon filter matches features — not just
+        the first part (the round-1 approximation)."""
+        _, ds = repo_ds
+        multi = (
+            "MULTIPOLYGON(((100.5 -41, 101.5 -41, 101.5 -40, 100.5 -40, 100.5 -41)),"
+            "((104.5 -41, 105.5 -41, 105.5 -40, 104.5 -40, 104.5 -41)))"
+        )
+        spec = ResolvedSpatialFilterSpec("EPSG:4326", multi)
+        sf = spec.resolve_for_dataset(ds)
+        # fid=1 at (101, -40.1): inside part 1
+        assert sf.match_result(ds.get_feature([1])) is MatchResult.MATCHED
+        # fid=5 at (105, -40.5): inside part 2 (second part must count)
+        assert sf.match_result(ds.get_feature([5])) is MatchResult.MATCHED
+        # fid=3 at (103, -40.3): between the parts, inside neither
+        assert sf.match_result(ds.get_feature([3])) is MatchResult.NOT_MATCHED
+
+    def test_unknown_crs_fails_open_with_warning(self, repo_ds, caplog):
+        """A filter that can't be transformed into the dataset CRS must warn
+        and match everything, never silently drop features."""
+        import logging
+
+        _, ds = repo_ds
+        unknown = (
+            'PROJCS["mystery",GEOGCS["WGS 84",DATUM["WGS_1984",'
+            'SPHEROID["WGS 84",6378137,298.257223563]],PRIMEM["Greenwich",0],'
+            'UNIT["degree",0.0174532925199433]],'
+            'PROJECTION["Oblique_Stereographic"],'
+            'PARAMETER["latitude_of_origin",52],PARAMETER["central_meridian",5],'
+            'UNIT["metre",1]]'
+        )
+        spec = ResolvedSpatialFilterSpec(
+            unknown, "POLYGON((0 0, 1000 0, 1000 1000, 0 1000, 0 0))"
+        )
+        with caplog.at_level(logging.WARNING, "kart_tpu.spatial_filter"):
+            sf = spec.resolve_for_dataset(ds)
+        assert sf is SpatialFilter.MATCH_ALL
+        assert any(
+            "cannot be transformed" in rec.message for rec in caplog.records
+        )
+
 
 class TestEnvelopeIndex:
     def test_build_and_lookup(self, tmp_path):
