@@ -235,10 +235,15 @@ def get_meta_diff(base_ds, target_ds, ds_filter=None):
 
 
 def get_dataset_diff(
-    base_rs, target_rs, ds_path, *, ds_filter=None, include_wc_diff=False, workdir_diff_cache=None
+    base_rs, target_rs, ds_path, *, ds_filter=None, include_wc_diff=False,
+    working_copy=None, workdir_diff_cache=None
 ):
     """DatasetDiff for one dataset between two revisions (plus the working
-    copy on top when include_wc_diff) (reference: diff_util.py:51-95)."""
+    copy on top when include_wc_diff) (reference: diff_util.py:51-95).
+
+    working_copy: pass the caller's WC instance so per-diff side channels
+    (spatial-filter pk conflicts) land on the object the caller holds —
+    repo.working_copy constructs a fresh instance per access."""
     base_ds = base_rs.datasets.get(ds_path) if base_rs is not None else None
     target_ds = target_rs.datasets.get(ds_path) if target_rs is not None else None
 
@@ -251,7 +256,7 @@ def get_dataset_diff(
     if include_wc_diff:
         if target_ds is None:
             raise ValueError("Cannot diff working copy against a deleted dataset")
-        wc = target_rs.repo.working_copy
+        wc = working_copy if working_copy is not None else target_rs.repo.working_copy
         if wc is not None:
             wc_diff = wc.diff_dataset_to_working_copy(
                 target_ds, ds_filter=ds_filter, workdir_diff_cache=workdir_diff_cache
@@ -267,6 +272,7 @@ def get_repo_diff(
     *,
     repo_key_filter=None,
     include_wc_diff=False,
+    working_copy=None,
 ):
     """RepoDiff between two revisions (reference: diff_util.py:27-50)."""
     repo_key_filter = repo_key_filter or RepoKeyFilter.MATCH_ALL_FILTER()
@@ -284,6 +290,7 @@ def get_repo_diff(
             ds_path,
             ds_filter=repo_key_filter[ds_path],
             include_wc_diff=include_wc_diff,
+            working_copy=working_copy,
         )
         if ds_diff:
             repo_diff[ds_path] = ds_diff

@@ -9,6 +9,7 @@ delta is written.
 
 import itertools
 import json
+import logging
 import re
 import sys
 from datetime import datetime, timedelta, timezone
@@ -32,6 +33,25 @@ from kart_tpu.diff.structs import RepoDiff
 from kart_tpu.models.schema import Schema
 
 _NULL = object()
+
+
+L = logging.getLogger("kart_tpu.diff")
+
+
+def _promised_value_oids(delta):
+    """Force both sides of a delta; -> oids of any promised blobs. Forcing
+    is free here: every writer that iterates deltas prints the values."""
+    from kart_tpu.core.odb import ObjectPromised
+
+    oids = []
+    for kv in (delta.old, delta.new):
+        if kv is None:
+            continue
+        try:
+            kv.get_lazy_value()
+        except ObjectPromised as e:
+            oids.append(e.oid)
+    return oids
 
 
 class BaseDiffWriter:
@@ -131,6 +151,7 @@ class BaseDiffWriter:
             self.target_rs,
             repo_key_filter=self.repo_key_filter,
             include_wc_diff=self.working_copy is not None,
+            working_copy=self.working_copy,
         )
 
     def get_ds_diff(self, ds_path):
@@ -140,14 +161,38 @@ class BaseDiffWriter:
             ds_path,
             ds_filter=self.repo_key_filter[ds_path],
             include_wc_diff=self.working_copy is not None,
+            working_copy=self.working_copy,
         )
 
     def iter_deltas(self, ds_diff):
+        """Stream (key, delta). On a partial clone, deltas whose values are
+        promised blobs are buffered while the rest stream, then backfilled
+        from the promisor remote in one batch fetch and re-yielded
+        (reference: DeltaFetcher, kart/base_diff_writer.py:467-534)."""
         feature_diff = ds_diff.get("feature")
         if not feature_diff:
             return
+        if not self.repo.has_promisor_remote():
+            yield from feature_diff.sorted_items()
+            return
+        buffered = []
+        missing = []
         for key, delta in feature_diff.sorted_items():
+            oids = _promised_value_oids(delta)
+            if oids:
+                buffered.append((key, delta))
+                missing.extend(oids)
+                continue
             yield key, delta
+        if buffered:
+            from kart_tpu.transport.remote import fetch_promised_blobs
+
+            L.info(
+                "Fetching %d promised objects to complete the diff ...",
+                len(missing),
+            )
+            fetch_promised_blobs(self.repo, missing)
+            yield from buffered
 
     def get_geometry_transforms(self, ds_path, ds_diff):
         """-> (old_transform, new_transform) to the --crs target, or (None,
@@ -190,6 +235,14 @@ class BaseDiffWriter:
         }
 
     def write_warnings_footer(self):
+        # WC diffs record pk collisions with out-of-filter features on the
+        # working-copy instance as they stream; fold them in here so every
+        # writer subclass (text/json/geojson/...) surfaces them
+        if self.working_copy is not None:
+            for ds_path, pks in self.working_copy.spatial_filter_pk_conflicts.items():
+                if pks:
+                    existing = self.spatial_filter_pk_conflicts.setdefault(ds_path, [])
+                    existing.extend(pk for pk in pks if pk not in existing)
         conflicts = self.spatial_filter_pk_conflicts
         if conflicts and any(conflicts.values()):
             click.secho(
@@ -516,6 +569,7 @@ class FeatureCountDiffWriter(BaseDiffWriter):
             if count:
                 self.has_changes = True
                 fp.write(f"{ds_path}:\n\t{count} features changed\n")
+        self.write_warnings_footer()
         return self.has_changes
 
 
@@ -593,4 +647,5 @@ class HtmlDiffWriter(BaseDiffWriter):
         fp.write(_HTML_TEMPLATE.format(data=json.dumps(all_data)))
         if hasattr(fp, "name"):
             click.echo(f"Wrote {fp.name}", err=True)
+        self.write_warnings_footer()
         return self.has_changes

@@ -23,6 +23,7 @@ numpy/JAX columnar blocks (kart_tpu/ops) instead of per-feature Python dicts.
 """
 
 import functools
+import logging
 
 import numpy as np
 
@@ -38,6 +39,8 @@ from kart_tpu.core.serialise import (
 )
 from kart_tpu.models.paths import PathEncoder, encoder_for_schema
 from kart_tpu.models.schema import Legend, Schema
+
+L = logging.getLogger("kart_tpu.dataset")
 
 META_ITEM_NAMES = ("title", "description", "schema.json", "metadata.xml")
 ATTACHMENT_META_ITEMS = ("metadata.xml",)
@@ -291,21 +294,37 @@ class Dataset3:
         """-> zero-arg callable that reads the feature lazily."""
         return functools.partial(self.get_feature, pk_values, path=path)
 
-    def features(self, spatial_filter=None, log_progress=False):
+    def features(self, spatial_filter=None, log_progress=False, skip_promised=False):
         """Stream all features (schema order). Bulk columnar access should
-        prefer feature_index + feature_blob_batch."""
+        prefer feature_index + feature_blob_batch.
+
+        skip_promised: features whose blobs are promised (partial clone) are
+        skipped instead of raising — during checkout of a spatially-filtered
+        clone a promised blob *is* the out-of-filter signal (reference:
+        working copies contain only in-filter features, kart/checkout.py)."""
         feature_tree = self.feature_tree
         if feature_tree is None:
             return
         odb = feature_tree.odb
+        n_promised = 0
         for path, entry in feature_tree.walk_blobs():
             pk_values = self.decode_path_to_pks(path)
-            feature = self.get_feature(pk_values, data=odb.read_blob(entry.oid))
-            if spatial_filter is not None and not spatial_filter.matches(
-                feature, self.geom_column_name
-            ):
+            try:
+                feature = self.get_feature(pk_values, data=odb.read_blob(entry.oid))
+            except ObjectPromised:
+                if skip_promised:
+                    n_promised += 1
+                    continue
+                raise
+            if spatial_filter is not None and not spatial_filter.matches(feature):
                 continue
             yield feature
+        if n_promised:
+            L.debug(
+                "%s: skipped %d promised (out-of-filter) features",
+                self.path,
+                n_promised,
+            )
 
     @property
     def feature_count(self):

@@ -43,6 +43,21 @@ class WorkingCopyStatus(IntFlag):
     DIRTY = 0x20
 
 
+def checkout_features(repo, ds):
+    """Features to materialise in a working copy: the repo's spatial filter
+    applied, promised (out-of-filter) blobs skipped — a filtered clone's WC
+    holds only in-filter features (reference: kart/checkout.py +
+    kart/working_copy/base.py write_full)."""
+    from kart_tpu.spatial_filter import ResolvedSpatialFilterSpec
+
+    spec = ResolvedSpatialFilterSpec.from_repo_config(repo)
+    sf = spec.resolve_for_dataset(ds)
+    return ds.features(
+        spatial_filter=sf if sf else None,
+        skip_promised=repo.has_promisor_remote(),
+    )
+
+
 def get_working_copy(repo, allow_uncreated=False):
     """-> the repo's working copy instance, or None when no location is
     configured (bare repos) or nothing exists there yet."""

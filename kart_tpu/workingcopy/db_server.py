@@ -21,6 +21,7 @@ import contextlib
 from urllib.parse import urlsplit, unquote
 
 from kart_tpu.adapters.base import KART_STATE, KART_TRACK
+from kart_tpu.core.odb import ObjectPromised
 from kart_tpu.core.repo import InvalidOperation, NotFound
 from kart_tpu.crs import get_identifier_int, get_identifier_str
 from kart_tpu.diff.structs import (
@@ -31,7 +32,7 @@ from kart_tpu.diff.structs import (
     KeyValue,
 )
 from kart_tpu.models.schema import ColumnSchema, Schema
-from kart_tpu.workingcopy import WorkingCopyStatus
+from kart_tpu.workingcopy import WorkingCopyStatus, checkout_features
 
 
 class Mismatch(InvalidOperation):
@@ -57,6 +58,8 @@ class DatabaseServerWorkingCopy:
 
     def __init__(self, repo, location):
         self.repo = repo
+        # {ds_path: [pks]} filled during WC diffs on a filtered clone
+        self.spatial_filter_pk_conflicts = {}
         self.location = str(location)
         (
             self.host,
@@ -287,7 +290,7 @@ class DatabaseServerWorkingCopy:
         insert_sql = f"INSERT INTO {tbl} ({quoted_cols}) VALUES ({placeholders})"
         batch = []
         cur = con.cursor()
-        for feature in ds.features():
+        for feature in checkout_features(self.repo, ds):
             batch.append(
                 tuple(
                     self.ADAPTER.value_from_v2(feature[c.name], c, crs_id=crs_id)
@@ -465,6 +468,12 @@ class DatabaseServerWorkingCopy:
                     continue
                 try:
                     old_feature = dataset.get_feature([pk])
+                except ObjectPromised:
+                    # pk collides with an out-of-filter (promised) feature
+                    old_feature = None
+                    self.spatial_filter_pk_conflicts.setdefault(
+                        dataset.path, []
+                    ).append(pk)
                 except KeyError:
                     old_feature = None
                 row = rows.get(pk)
@@ -628,9 +637,21 @@ class DatabaseServerWorkingCopy:
                         (delta.old_key,),
                     )
                 else:
+                    try:
+                        new_value = delta.new_value
+                    except ObjectPromised:
+                        # partial clone: target feature is out-of-filter —
+                        # remove any stale row rather than materialising it
+                        self._execute(
+                            con,
+                            f"DELETE FROM {tbl} WHERE "
+                            f"{self.ADAPTER.quote(pk_col.name)} = {self.PARAMSTYLE}",
+                            (delta.new_key,),
+                        )
+                        continue
                     values = tuple(
                         self.ADAPTER.value_from_v2(
-                            delta.new_value[c.name], c, crs_id=crs_id
+                            new_value[c.name], c, crs_id=crs_id
                         )
                         for c in schema.columns
                     )

@@ -18,6 +18,7 @@ import os
 import sqlite3
 
 from kart_tpu.adapters import gpkg as adapter
+from kart_tpu.core.odb import ObjectPromised
 from kart_tpu.core.repo import InvalidOperation, NotFound
 from kart_tpu.crs import get_identifier_int, get_identifier_str
 from kart_tpu.diff.structs import (
@@ -29,7 +30,7 @@ from kart_tpu.diff.structs import (
 )
 from kart_tpu.geometry import Geometry
 from kart_tpu.models.schema import Schema
-from kart_tpu.workingcopy import WorkingCopyStatus
+from kart_tpu.workingcopy import WorkingCopyStatus, checkout_features
 
 STATE_TABLE = "gpkg_kart_state"
 TRACK_TABLE = "gpkg_kart_track"
@@ -77,6 +78,8 @@ class Mismatch(InvalidOperation):
 class GpkgWorkingCopy:
     def __init__(self, repo, location):
         self.repo = repo
+        # {ds_path: [pks]} filled during WC diffs on a filtered clone
+        self.spatial_filter_pk_conflicts = {}
         self.location = str(location)
         if os.path.isabs(self.location) or repo.workdir is None:
             self.full_path = self.location
@@ -247,7 +250,7 @@ class GpkgWorkingCopy:
             f"INSERT INTO {adapter.quote(table)} ({quoted_cols}) VALUES ({placeholders})"
         )
         batch = []
-        for feature in ds.features():
+        for feature in checkout_features(self.repo, ds):
             batch.append(
                 tuple(
                     adapter.value_from_v2(feature[c.name], c, crs_id=crs_id)
@@ -471,6 +474,14 @@ class GpkgWorkingCopy:
                     continue
                 try:
                     old_feature = dataset.get_feature([pk])
+                except ObjectPromised:
+                    # pk collides with an out-of-filter (promised) feature:
+                    # committing would overwrite it (reference: spatial
+                    # filter PK conflict, kart/commit.py:40-74)
+                    old_feature = None
+                    self.spatial_filter_pk_conflicts.setdefault(
+                        dataset.path, []
+                    ).append(pk)
                 except KeyError:
                     old_feature = None
                 row = rows.get(pk)
@@ -622,8 +633,18 @@ class GpkgWorkingCopy:
                         (delta.old_key,),
                     )
                 else:
+                    try:
+                        new_value = delta.new_value
+                    except ObjectPromised:
+                        # partial clone: the target feature is out-of-filter
+                        # -> it must not be materialised; drop any stale row
+                        con.execute(
+                            f"DELETE FROM {adapter.quote(table)} WHERE {adapter.quote(pk_col.name)} = ?",
+                            (delta.new_key,),
+                        )
+                        continue
                     values = tuple(
-                        adapter.value_from_v2(delta.new_value[c.name], c, crs_id=crs_id)
+                        adapter.value_from_v2(new_value[c.name], c, crs_id=crs_id)
                         for c in schema.columns
                     )
                     con.execute(

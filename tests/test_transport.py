@@ -260,3 +260,113 @@ def test_cli_clone_push_pull(source_repo, tmp_path, monkeypatch):
     assert result.exit_code == 0, result.output
     clone = KartRepo(str(clone_dir))
     assert clone.head_commit_oid == new_oid
+
+
+class TestPromisorBackfill:
+    """Readers on a partial clone must handle promised blobs: checkout
+    skips out-of-filter features, diff backfills values mid-stream
+    (reference: DeltaFetcher, kart/base_diff_writer.py:467-534)."""
+
+    @pytest.fixture()
+    def filtered_wc_clone(self, source_repo, tmp_path):
+        from kart_tpu.spatial_filter import ResolvedSpatialFilterSpec
+
+        repo, ds_path = source_repo
+        # points are at x=101..110; keep x <= 105.5
+        spec = ResolvedSpatialFilterSpec(
+            "EPSG:4326",
+            "POLYGON((100 -42, 105.5 -42, 105.5 -39, 100 -39, 100 -42))",
+        )
+        clone = transport.clone(
+            repo.workdir,
+            tmp_path / "partial-wc",
+            spatial_filter_spec=spec,
+            do_checkout=True,
+        )
+        return repo, clone, ds_path
+
+    def test_checkout_skips_promised_features(self, filtered_wc_clone):
+        """The round-1 crash: write_full died on the first promised blob.
+        Now the WC materialises exactly the in-filter features."""
+        repo, clone, ds_path = filtered_wc_clone
+        wc = clone.working_copy
+        assert wc is not None
+        with wc.session() as con:
+            pks = sorted(
+                row[0]
+                for row in con.execute('SELECT fid FROM "points"').fetchall()
+            )
+        # fid 1 was updated to a NULL geometry in the second commit (NULL
+        # always matches); fids 2..5 are at x=102..105, inside the filter
+        assert pks == [1, 2, 3, 4, 5]
+
+    def test_diff_backfills_promised_values(self, filtered_wc_clone, capsys):
+        """A committed-range diff that touches out-of-filter features must
+        batch-fetch their promised blobs and still print every delta."""
+        import json
+
+        from kart_tpu.diff.writers import BaseDiffWriter
+
+        repo, clone, ds_path = filtered_wc_clone
+        src_ds = repo.datasets("HEAD")[ds_path]
+        path = src_ds.encode_1pk_to_path(9, relative=True)
+        blob_oid = src_ds.inner_tree.get(path).oid
+        assert not clone.odb.contains(blob_oid)  # out-of-filter: promised
+
+        writer_cls = BaseDiffWriter.get_diff_writer_class("json")
+        writer = writer_cls(clone, "[EMPTY]...HEAD", json_style="compact")
+        writer.write_diff()
+        out = capsys.readouterr().out
+        deltas = json.loads(out)["kart.diff/v1+hexwkb"][ds_path]["feature"]
+        inserted_fids = {d["+"]["fid"] for d in deltas if "+" in d}
+        # every feature appears, including the promised ones
+        assert inserted_fids == set(range(1, 11))
+        # and the promised blob is now present locally (backfilled)
+        assert clone.odb.contains(blob_oid)
+
+    def test_reset_handles_promised_targets(self, filtered_wc_clone):
+        """Branch switching in a filtered clone: deltas whose target values
+        are promised are dropped from the WC, not crashed on."""
+        from kart_tpu.workingcopy import get_working_copy
+
+        repo, clone, ds_path = filtered_wc_clone
+        # move the filtered clone's WC back to the first commit and forward
+        # again — both resets cross deltas touching out-of-filter features
+        head = clone.head_commit_oid
+        parent = clone.structure("HEAD^").commit_oid
+        wc = get_working_copy(clone)
+        wc.reset(clone.structure(parent))
+        clone.refs.set("refs/heads/main", parent, log_message="test rewind")
+        with wc.session() as con:
+            pks = sorted(
+                r[0] for r in con.execute('SELECT fid FROM "points"').fetchall()
+            )
+        # the WC must hold only in-filter features of HEAD^
+        assert 5 in pks and 9 not in pks
+        wc.reset(clone.structure(head))
+        clone.refs.set("refs/heads/main", head, log_message="test forward")
+        with wc.session() as con:
+            pks = sorted(
+                r[0] for r in con.execute('SELECT fid FROM "points"').fetchall()
+            )
+        assert pks == [1, 2, 3, 4, 5]
+
+    def test_wc_insert_colliding_with_promised_pk_warns(self, filtered_wc_clone, capsys):
+        """Inserting a WC feature whose pk belongs to an out-of-filter
+        (promised) feature must surface the reference's spatial-filter pk
+        conflict warning (kart/commit.py:40-74), not a silent insert."""
+        from kart_tpu.diff.writers import BaseDiffWriter
+
+        repo, clone, ds_path = filtered_wc_clone
+        wc = clone.working_copy
+        with wc.session() as con:
+            con.execute(
+                'INSERT INTO "points" (fid, name, rating, geom) '
+                "VALUES (9, 'collider', 1.0, NULL)"
+            )
+        writer_cls = BaseDiffWriter.get_diff_writer_class("text")
+        writer = writer_cls(clone, "HEAD")
+        writer.write_diff()
+        err = capsys.readouterr().err
+        assert "outside the spatial filter" in err
+        assert writer.spatial_filter_pk_conflicts.get(ds_path) == [9]
