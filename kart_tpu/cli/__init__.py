@@ -44,6 +44,7 @@ _COMMANDS = {
     "pull": "kart_tpu.cli.remote_cmds",
     "fetch": "kart_tpu.cli.remote_cmds",
     "remote": "kart_tpu.cli.remote_cmds",
+    "serve": "kart_tpu.cli.remote_cmds",
     "spatial-filter": "kart_tpu.cli.spatial_cmds",
     "upgrade": "kart_tpu.cli.upgrade_cmds",
     "build-annotations": "kart_tpu.cli.data_cmds",

@@ -219,3 +219,25 @@ def remote_list(ctx, verbose):
             click.echo(f"{name}\t{repo.remote_url(name)}")
         else:
             click.echo(name)
+
+
+@cli.command()
+@click.option("--host", default="127.0.0.1", show_default=True)
+@click.option("--port", type=click.INT, default=8470, show_default=True)
+@click.pass_obj
+def serve(ctx, host, port):
+    """Serve this repository over HTTP for clone/fetch/push/pull.
+
+    A LAN/localhost collaboration server (no authentication — like git
+    daemon); clients use http://HOST:PORT/ as the remote URL. Supports
+    shallow and spatially-filtered partial clones (the filter runs
+    server-side) and promised-blob backfill.
+    """
+    from kart_tpu.transport.http import serve as http_serve
+
+    repo = ctx.repo
+    click.echo(f"Serving {repo.gitdir} at http://{host}:{port}/ (Ctrl-C to stop)")
+    try:
+        http_serve(repo, host, port)
+    except KeyboardInterrupt:
+        click.echo("Stopped.")

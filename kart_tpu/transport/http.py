@@ -1,0 +1,482 @@
+"""HTTP transport: serve and consume the kartpack wire format over HTTP.
+
+The reference speaks the git smart protocol over https/ssh via its vendored
+git (kart/cli.py:211-253, git upload-pack / receive-pack).  This module is
+the native equivalent over plain HTTP — a deliberately small JSON + kartpack
+API that preserves the same semantics:
+
+* want/have negotiation (client declares its ref tips; the server walks
+  reachability and ships only what's missing),
+* shallow clone/fetch (client shallow set respected; new boundary returned),
+* server-side spatially-filtered partial clone (the filter argument is
+  evaluated on the server against its envelope index — the analog of the
+  reference's ``filter_extension_spatial`` upload-pack plugin,
+  vendor/spatial-filter/spatial_filter.cpp:212-260),
+* promisor backfill (batch blob fetch by oid).
+
+Endpoints (all JSON unless noted):
+
+    GET  <base>/api/v1/refs
+        -> {"heads": {...}, "tags": {...}, "head_branch": ..., "shallow": [...]}
+    POST <base>/api/v1/fetch-pack
+        {"wants": [...], "haves": [...], "have_shallow": [...],
+         "depth": N|null, "filter": "w,s,e,n"|null}
+        -> framed response: 8-byte big-endian header length, JSON header
+           {"shallow_boundary": [...], "object_count": N}, kartpack bytes
+    POST <base>/api/v1/fetch-blobs
+        {"oids": [...]} -> framed response (header + kartpack)
+    POST <base>/api/v1/receive-pack
+        framed request: 8-byte header length, JSON header
+        {"updates": [{"ref", "old", "new", "force"}], "shallow": [...]},
+        kartpack bytes -> {"updated": {...}} (409 on a rejected update)
+
+There is no authentication — this is a LAN/localhost collaboration server,
+like ``git daemon``. Put a reverse proxy in front for anything else.
+"""
+
+import json
+import struct
+import tempfile
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from urllib.parse import urlsplit
+from urllib.request import Request, urlopen
+
+from kart_tpu.core.odb import ObjectMissing
+from kart_tpu.transport.pack import read_pack, write_pack
+from kart_tpu.transport.protocol import ObjectEnumerator
+
+API = "/api/v1"
+_HEADER_LEN = struct.Struct(">Q")
+
+
+class HttpTransportError(ValueError):
+    pass
+
+
+# ---------------------------------------------------------------------------
+# framing: [8-byte header length][JSON header][kartpack bytes]
+# ---------------------------------------------------------------------------
+
+
+def write_framed(fp, header, pack_source):
+    """pack_source: iterable of (type, content) -> frames header + pack into
+    fp. The pack is buffered first so the header can carry enumeration
+    results (shallow boundary, counts)."""
+    with tempfile.SpooledTemporaryFile(max_size=64 * 1024 * 1024) as buf:
+        write_pack(buf, iter(pack_source))
+        raw_header = json.dumps(header).encode()
+        fp.write(_HEADER_LEN.pack(len(raw_header)))
+        fp.write(raw_header)
+        buf.seek(0)
+        while True:
+            chunk = buf.read(1 << 20)
+            if not chunk:
+                break
+            fp.write(chunk)
+
+
+def read_framed(fp):
+    """-> (header dict, file-like positioned at the pack)."""
+    raw = fp.read(_HEADER_LEN.size)
+    if len(raw) != _HEADER_LEN.size:
+        raise HttpTransportError("Truncated framed response")
+    (n,) = _HEADER_LEN.unpack(raw)
+    if n > 1 << 24:
+        raise HttpTransportError("Framed header implausibly large")
+    header = json.loads(fp.read(n).decode())
+    return header, fp
+
+
+# ---------------------------------------------------------------------------
+# negotiation helper: what does the peer (claim to) have?
+# ---------------------------------------------------------------------------
+
+
+def have_closure(odb, haves, have_shallow=()):
+    """Object oids the peer has, given its declared ref tips: every commit
+    reachable from the tips (stopping at the peer's shallow boundary, where
+    its history is known-truncated), plus the full tree closure of each tip
+    commit — tip trees prune the bulk of unchanged subtrees/blobs from a
+    typical tip-to-tip transfer."""
+    have_shallow = set(have_shallow)
+    closure = set()
+    frontier = [o for o in haves if o]
+    tips = list(frontier)
+    while frontier:
+        oid = frontier.pop()
+        if oid in closure:
+            continue
+        try:
+            commit = odb.read_commit(oid)
+        except (ObjectMissing, KeyError, ValueError):
+            continue
+        closure.add(oid)
+        if oid in have_shallow:
+            continue  # peer's history stops here
+        frontier.extend(commit.parents)
+
+    def add_tree(tree_oid):
+        if tree_oid in closure:
+            return
+        closure.add(tree_oid)
+        try:
+            entries = odb.read_tree_entries(tree_oid)
+        except (ObjectMissing, KeyError, ValueError):
+            return
+        for e in entries:
+            if e.is_tree:
+                add_tree(e.oid)
+            else:
+                closure.add(e.oid)
+
+    for tip in tips:
+        try:
+            add_tree(odb.read_commit(tip).tree)
+        except (ObjectMissing, KeyError, ValueError):
+            continue
+    return closure
+
+
+# ---------------------------------------------------------------------------
+# server
+# ---------------------------------------------------------------------------
+
+
+class KartRequestHandler(BaseHTTPRequestHandler):
+    protocol_version = "HTTP/1.1"
+    server_version = "kart-tpu-serve/1"
+
+    @property
+    def repo(self):
+        return self.server.kart_repo
+
+    def log_message(self, fmt, *args):  # route through logging, not stderr
+        import logging
+
+        logging.getLogger("kart_tpu.serve").debug(fmt, *args)
+
+    # -- plumbing -----------------------------------------------------------
+
+    def _json(self, status, payload):
+        raw = json.dumps(payload).encode()
+        self.send_response(status)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(raw)))
+        self.end_headers()
+        self.wfile.write(raw)
+
+    def _framed(self, header, pack_source):
+        # spool to disk past 64MB — never hold a whole pack in RAM per
+        # request (ThreadingHTTPServer multiplies that by concurrent clients)
+        with tempfile.SpooledTemporaryFile(max_size=64 * 1024 * 1024) as buf:
+            write_framed(buf, header, pack_source)
+            length = buf.tell()
+            buf.seek(0)
+            self.send_response(200)
+            self.send_header("Content-Type", "application/x-kartpack")
+            self.send_header("Content-Length", str(length))
+            self.end_headers()
+            while True:
+                chunk = buf.read(1 << 20)
+                if not chunk:
+                    break
+                self.wfile.write(chunk)
+
+    def _read_body(self):
+        n = int(self.headers.get("Content-Length", 0))
+        return self.rfile.read(n)
+
+    def _read_body_spooled(self):
+        n = int(self.headers.get("Content-Length", 0))
+        buf = tempfile.SpooledTemporaryFile(max_size=64 * 1024 * 1024)
+        remaining = n
+        while remaining > 0:
+            chunk = self.rfile.read(min(remaining, 1 << 20))
+            if not chunk:
+                break
+            buf.write(chunk)
+            remaining -= len(chunk)
+        buf.seek(0)
+        return buf
+
+    # -- routes -------------------------------------------------------------
+
+    def do_GET(self):
+        try:
+            if urlsplit(self.path).path.rstrip("/") == f"{API}/refs":
+                return self._handle_refs()
+            self._json(404, {"error": f"No such endpoint: {self.path}"})
+        except Exception as e:
+            self._json(500, {"error": f"{type(e).__name__}: {e}"})
+
+    def do_POST(self):
+        path = urlsplit(self.path).path.rstrip("/")
+        try:
+            if path == f"{API}/fetch-pack":
+                return self._handle_fetch_pack()
+            if path == f"{API}/fetch-blobs":
+                return self._handle_fetch_blobs()
+            if path == f"{API}/receive-pack":
+                return self._handle_receive_pack()
+            self._json(404, {"error": f"No such endpoint: {self.path}"})
+        except Exception as e:  # surface server errors to the client
+            self._json(500, {"error": f"{type(e).__name__}: {e}"})
+
+    def _handle_refs(self):
+        from kart_tpu.transport.remote import read_shallow
+
+        repo = self.repo
+        heads = {
+            ref[len("refs/heads/"):]: oid
+            for ref, oid in repo.refs.iter_refs("refs/heads/")
+        }
+        tags = {
+            ref[len("refs/tags/"):]: oid
+            for ref, oid in repo.refs.iter_refs("refs/tags/")
+        }
+        kind, target = repo.refs.head_target()
+        head_branch = (
+            target[len("refs/heads/"):]
+            if kind == "symbolic" and target.startswith("refs/heads/")
+            else None
+        )
+        self._json(
+            200,
+            {
+                "heads": heads,
+                "tags": tags,
+                "head_branch": head_branch,
+                "shallow": sorted(read_shallow(repo)),
+            },
+        )
+
+    def _handle_fetch_pack(self):
+        from kart_tpu.transport.remote import read_shallow
+
+        req = json.loads(self._read_body().decode() or "{}")
+        repo = self.repo
+        blob_filter = None
+        if req.get("filter"):
+            from kart_tpu.spatial_filter import blob_filter_for_spec
+
+            blob_filter = blob_filter_for_spec(repo, req["filter"])
+        has = None
+        if req.get("haves"):
+            closure = have_closure(
+                repo.odb, req["haves"], req.get("have_shallow", ())
+            )
+            has = closure.__contains__
+        enum = ObjectEnumerator(
+            repo.odb,
+            req.get("wants", []),
+            has=has,
+            depth=req.get("depth"),
+            blob_filter=blob_filter,
+            sender_shallow=read_shallow(repo),
+        )
+        objects = list(enum)  # drain so enum counters/boundary are final
+        self._framed(
+            {
+                "shallow_boundary": sorted(enum.shallow_boundary),
+                "object_count": enum.object_count,
+                "omitted_blob_count": enum.omitted_blob_count,
+            },
+            objects,
+        )
+
+    def _handle_fetch_blobs(self):
+        req = json.loads(self._read_body().decode() or "{}")
+        repo = self.repo
+
+        missing = []
+
+        def pull():
+            for oid in req.get("oids", []):
+                try:
+                    yield repo.odb.read_raw(oid)
+                except ObjectMissing:
+                    missing.append(oid)
+
+        objects = list(pull())
+        self._framed({"missing": missing}, objects)
+
+    def _current_branch_ref(self):
+        kind, target = self.repo.refs.head_target()
+        if kind == "symbolic":
+            return target
+        return None
+
+    def _handle_receive_pack(self):
+        from kart_tpu.transport.remote import _update_shallow
+
+        repo = self.repo
+        with self._read_body_spooled() as body:
+            header, pack_fp = read_framed(body)
+            for obj_type, content in read_pack(pack_fp):
+                repo.odb.write_raw(obj_type, content)
+
+        deny_current = (
+            repo.workdir is not None
+            and (repo.config.get("receive.denyCurrentBranch") or "refuse").lower()
+            not in ("ignore", "false")
+        )
+
+        updated = {}
+        # compare-and-swap must be atomic across handler threads: without
+        # the lock two concurrent pushes both pass the check and one update
+        # is silently lost
+        with self.server.push_lock:
+            for upd in header.get("updates", []):
+                ref, old, new = upd["ref"], upd.get("old"), upd.get("new")
+                if deny_current and ref == self._current_branch_ref():
+                    return self._json(
+                        409,
+                        {
+                            "error": f"Refusing to update checked-out branch "
+                            f"{ref} (the server's working copy would go out "
+                            f"of sync). Serve a bare repo, or set "
+                            f"receive.denyCurrentBranch=ignore there."
+                        },
+                    )
+                current = repo.refs.get(ref)
+                if not upd.get("force") and current != old:
+                    return self._json(
+                        409,
+                        {
+                            "error": f"Ref {ref} moved (expected {old}, is "
+                            f"{current}); fetch first or use --force"
+                        },
+                    )
+                if new is None:
+                    if current is not None:
+                        repo.refs.delete(ref)
+                    updated[ref] = None
+                else:
+                    if not repo.odb.contains(new):
+                        return self._json(
+                            400,
+                            {"error": f"Push incomplete: {new} not received"},
+                        )
+                    repo.refs.set(ref, new, log_message="push (http)")
+                    updated[ref] = new
+            if header.get("shallow"):
+                _update_shallow(repo, header["shallow"])
+        self._json(200, {"updated": updated})
+
+
+def make_server(repo, host="127.0.0.1", port=0):
+    """-> ThreadingHTTPServer serving `repo`; port 0 picks a free port."""
+    server = ThreadingHTTPServer((host, port), KartRequestHandler)
+    server.kart_repo = repo
+    server.push_lock = threading.Lock()
+    return server
+
+
+def serve(repo, host="127.0.0.1", port=8470, *, in_thread=False):
+    """Run the collaboration server (blocking unless in_thread)."""
+    server = make_server(repo, host, port)
+    if in_thread:
+        t = threading.Thread(target=server.serve_forever, daemon=True)
+        t.start()
+        return server
+    try:
+        server.serve_forever()
+    finally:
+        server.server_close()
+
+
+# ---------------------------------------------------------------------------
+# client
+# ---------------------------------------------------------------------------
+
+
+class HttpRemote:
+    """Client for the API above; the HTTP implementation of the transport
+    verbs remote.py's fetch/push/clone are written against."""
+
+    def __init__(self, url):
+        self.base = url.rstrip("/")
+
+    def _get(self, path):
+        try:
+            with urlopen(Request(self.base + path), timeout=60) as resp:
+                return json.loads(resp.read().decode())
+        except OSError as e:
+            raise HttpTransportError(f"Cannot reach remote {self.base!r}: {e}")
+
+    def _post(self, path, data, *, raw=False, length=None):
+        """data: JSON-able object, or (raw=True) bytes / a file-like with an
+        explicit length."""
+        headers = {
+            "Content-Type": "application/x-kartpack" if raw else "application/json"
+        }
+        body = data if raw else json.dumps(data).encode()
+        if length is not None:
+            headers["Content-Length"] = str(length)
+        req = Request(self.base + path, data=body, headers=headers, method="POST")
+        try:
+            return urlopen(req, timeout=600)
+        except OSError as e:
+            detail = ""
+            if hasattr(e, "read"):
+                try:
+                    detail = json.loads(e.read().decode()).get("error", "")
+                except Exception:
+                    pass
+            raise HttpTransportError(
+                f"Remote {self.base!r} error: {detail or e}"
+            )
+
+    # -- verbs --------------------------------------------------------------
+
+    def ls_refs(self):
+        return self._get(f"{API}/refs")
+
+    def fetch_pack(self, dst_repo, wants, *, haves=(), have_shallow=(),
+                   depth=None, filter_spec=None):
+        """-> header dict; objects are written straight into dst_repo."""
+        resp = self._post(
+            f"{API}/fetch-pack",
+            {
+                "wants": list(wants),
+                "haves": list(haves),
+                "have_shallow": sorted(have_shallow),
+                "depth": depth,
+                "filter": filter_spec,
+            },
+        )
+        with resp:
+            header, pack_fp = read_framed(resp)
+            for obj_type, content in read_pack(pack_fp):
+                dst_repo.odb.write_raw(obj_type, content)
+        return header
+
+    def fetch_blobs(self, dst_repo, oids):
+        resp = self._post(f"{API}/fetch-blobs", {"oids": list(oids)})
+        fetched = 0
+        with resp:
+            header, pack_fp = read_framed(resp)
+            for obj_type, content in read_pack(pack_fp):
+                dst_repo.odb.write_raw(obj_type, content)
+                fetched += 1
+        if header.get("missing"):
+            raise HttpTransportError(
+                f"Remote is missing promised objects: {header['missing'][:5]}"
+            )
+        return fetched
+
+    def receive_pack(self, objects, updates, *, shallow=()):
+        """objects: iterable of (type, content); updates: [{ref, old, new,
+        force}]. -> {ref: oid|None} from the server."""
+        with tempfile.SpooledTemporaryFile(max_size=64 * 1024 * 1024) as buf:
+            write_framed(
+                buf, {"updates": updates, "shallow": sorted(shallow)}, objects
+            )
+            length = buf.tell()
+            buf.seek(0)
+            resp = self._post(
+                f"{API}/receive-pack", buf, raw=True, length=length
+            )
+        with resp:
+            return json.loads(resp.read().decode())["updated"]

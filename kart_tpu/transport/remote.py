@@ -54,14 +54,24 @@ class Remote:
         return open_remote(self.url)
 
 
+def is_http_url(url):
+    return url.startswith("http://") or url.startswith("https://")
+
+
 def open_remote(url) -> KartRepo:
-    """Resolve a remote URL to a repository. Local paths + file:// today;
-    other schemes would add Transport implementations here."""
+    """Resolve a *local* remote URL to a repository (local paths + file://).
+    HTTP remotes don't open as repos — the fetch/push/clone verbs route them
+    through kart_tpu.transport.http instead."""
     if url.startswith("file://"):
         url = url[len("file://") :]
+    if is_http_url(url):
+        raise RemoteError(
+            f"HTTP remote {url!r} has no local repository to open"
+        )
     if "://" in url:
         raise RemoteError(
-            f"Unsupported remote URL scheme: {url!r} (local paths / file:// only)"
+            f"Unsupported remote URL scheme: {url!r} "
+            f"(local paths, file:// and http(s):// only)"
         )
     try:
         repo = KartRepo(url)
@@ -169,35 +179,74 @@ def _transfer(src_odb, dst_odb, wants, *, depth=None, blob_filter=None, sender_s
 # -- fetch -----------------------------------------------------------------
 
 
-def fetch(repo, remote_name="origin", *, depth=None, blob_filter=None, quiet=True):
+def fetch(repo, remote_name="origin", *, depth=None, filter_spec=None, quiet=True):
     """Fetch all branches + tags from the remote into refs/remotes/<name>/*.
-    Returns {local_ref: oid} of updated refs."""
+    Returns {local_ref: oid} of updated refs.
+
+    filter_spec: 'w,s,e,n' spatial filter argument evaluated on the sending
+    side (local remotes build the callable here; HTTP remotes evaluate it on
+    the server, like the reference's upload-pack filter extension)."""
     remote = Remote(repo, remote_name)
-    src = remote.open()
 
-    wants = []
-    branch_tips = {}  # branch name -> oid
-    tag_tips = {}
-    for ref, oid in src.refs.iter_refs("refs/heads/"):
-        branch_tips[ref[len("refs/heads/") :]] = oid
-        wants.append(oid)
-    for ref, oid in src.refs.iter_refs("refs/tags/"):
-        tag_tips[ref[len("refs/tags/") :]] = oid
-        wants.append(oid)
-
-    if blob_filter is None and remote.is_promisor:
+    if filter_spec is None and remote.is_promisor:
         # re-fetch from a promisor remote keeps filtering (reference:
         # remote.*.partialclonefilter persists after clone)
-        blob_filter = _configured_blob_filter(repo, remote, src)
+        spec = remote.partial_clone_filter
+        if spec and spec.startswith("extension:spatial="):
+            filter_spec = spec[len("extension:spatial=") :]
 
-    enum = _transfer(
-        src.odb,
-        repo.odb,
-        wants,
-        depth=depth,
-        blob_filter=blob_filter,
-        sender_shallow=read_shallow(src),
-    )
+    if is_http_url(remote.url):
+        from kart_tpu.transport.http import HttpRemote, HttpTransportError
+
+        http = HttpRemote(remote.url)
+        try:
+            info = http.ls_refs()
+            branch_tips = info["heads"]
+            tag_tips = info["tags"]
+            head_branch = info.get("head_branch")
+            wants = list(branch_tips.values()) + list(tag_tips.values())
+            header = http.fetch_pack(
+                repo,
+                wants,
+                haves=[oid for _, oid in repo.refs.iter_refs("refs/")],
+                have_shallow=read_shallow(repo),
+                depth=depth,
+                filter_spec=filter_spec,
+            )
+        except HttpTransportError as e:
+            raise RemoteError(str(e))
+        shallow_boundary = set(header.get("shallow_boundary", ()))
+    else:
+        src = remote.open()
+        branch_tips = {}  # branch name -> oid
+        tag_tips = {}
+        for ref, oid in src.refs.iter_refs("refs/heads/"):
+            branch_tips[ref[len("refs/heads/") :]] = oid
+        for ref, oid in src.refs.iter_refs("refs/tags/"):
+            tag_tips[ref[len("refs/tags/") :]] = oid
+        wants = list(branch_tips.values()) + list(tag_tips.values())
+
+        blob_filter = None
+        if filter_spec is not None:
+            from kart_tpu.spatial_filter import blob_filter_for_spec
+
+            blob_filter = blob_filter_for_spec(src, filter_spec)
+
+        enum = _transfer(
+            src.odb,
+            repo.odb,
+            wants,
+            depth=depth,
+            blob_filter=blob_filter,
+            sender_shallow=read_shallow(src),
+        )
+        shallow_boundary = enum.shallow_boundary
+        kind, target = src.refs.head_target()
+        head_branch = (
+            target[len("refs/heads/") :]
+            if kind == "symbolic" and target.startswith("refs/heads/")
+            else None
+        )
 
     updated = {}
     for branch, oid in branch_tips.items():
@@ -211,29 +260,17 @@ def fetch(repo, remote_name="origin", *, depth=None, blob_filter=None, quiet=Tru
             repo.refs.set(local_ref, oid, log_message=f"fetch {remote_name}")
             updated[local_ref] = oid
 
-    _update_shallow(repo, enum.shallow_boundary)
+    _update_shallow(repo, shallow_boundary)
 
     # remote HEAD symref, so clone knows the default branch
-    kind, target = src.refs.head_target()
-    if kind == "symbolic" and target.startswith("refs/heads/"):
+    if head_branch is not None:
         head_path = os.path.join(
             repo.gitdir, "refs", "remotes", remote_name, "HEAD"
         )
         os.makedirs(os.path.dirname(head_path), exist_ok=True)
         with open(head_path, "w") as f:
-            f.write(
-                f"ref: refs/remotes/{remote_name}/{target[len('refs/heads/'):]}\n"
-            )
+            f.write(f"ref: refs/remotes/{remote_name}/{head_branch}\n")
     return updated
-
-
-def _configured_blob_filter(repo, remote, src):
-    spec = remote.partial_clone_filter
-    if not spec or not spec.startswith("extension:spatial="):
-        return None
-    from kart_tpu.spatial_filter import blob_filter_for_spec
-
-    return blob_filter_for_spec(src, spec[len("extension:spatial=") :])
 
 
 # -- push ------------------------------------------------------------------
@@ -250,17 +287,142 @@ def parse_refspec(repo, refspec):
     return src or None, dst or src, force
 
 
+def _resolve_push_source(repo, src_name):
+    src_ref = src_name if src_name.startswith("refs/") else f"refs/heads/{src_name}"
+    new_oid = repo.refs.get(src_ref)
+    if new_oid is None:
+        try:
+            new_oid = repo.resolve_refish(src_name)[0]
+        except NotFound:
+            new_oid = None
+    if new_oid is None:
+        raise RemoteError(f"Unknown ref to push: {src_name!r}")
+    return src_ref, new_oid
+
+
+def _record_push_tracking(repo, remote_name, src_ref, dst_ref, new_oid, set_upstream):
+    """Mirror a successful push into refs/remotes/<name>/* (+ upstream cfg)."""
+    if not dst_ref.startswith("refs/heads/"):
+        return
+    track = f"refs/remotes/{remote_name}/{dst_ref[len('refs/heads/'):]}"
+    repo.refs.set(track, new_oid, log_message="update by push")
+    if set_upstream and src_ref.startswith("refs/heads/"):
+        b = src_ref[len("refs/heads/") :]
+        repo.config.set_many(
+            {f"branch.{b}.remote": remote_name, f"branch.{b}.merge": dst_ref}
+        )
+
+
+def _push_http(repo, remote_name, url, refspecs, *, force, set_upstream):
+    """Push over HTTP: client-side enumeration against the server's declared
+    tips, compare-and-swap ref updates server-side."""
+    from kart_tpu.transport.http import (
+        HttpRemote,
+        HttpTransportError,
+        have_closure,
+    )
+
+    http = HttpRemote(url)
+    try:
+        info = http.ls_refs()
+    except HttpTransportError as e:
+        raise RemoteError(str(e))
+    server_refs = {f"refs/heads/{b}": o for b, o in info["heads"].items()}
+    server_refs.update({f"refs/tags/{t}": o for t, o in info["tags"].items()})
+    # one reachability walk for all refspecs — the server's tips don't
+    # change between them
+    has_set = None
+
+    updated = {}
+    for spec in refspecs:
+        src_name, dst_name, spec_force = parse_refspec(repo, spec)
+        spec_force = spec_force or force
+        dst_ref = (
+            dst_name if dst_name.startswith("refs/") else f"refs/heads/{dst_name}"
+        )
+        try:
+            if src_name is None:  # delete
+                if dst_ref not in server_refs:
+                    raise RemoteError(f"Remote ref does not exist: {dst_ref}")
+                updated.update(
+                    http.receive_pack(
+                        [],
+                        [
+                            {
+                                "ref": dst_ref,
+                                "old": server_refs[dst_ref],
+                                "new": None,
+                                "force": spec_force,
+                            }
+                        ],
+                    )
+                )
+                continue
+
+            src_ref, new_oid = _resolve_push_source(repo, src_name)
+            old_oid = server_refs.get(dst_ref)
+            if old_oid and not spec_force:
+                if not repo.odb.contains(old_oid) or not repo.is_ancestor(
+                    old_oid, new_oid
+                ):
+                    raise RemoteError(
+                        f"Push to {dst_ref} rejected (non-fast-forward); "
+                        "fetch first or use --force"
+                    )
+            if has_set is None:
+                has_set = have_closure(
+                    repo.odb, list(server_refs.values()), info.get("shallow", ())
+                )
+            enum = ObjectEnumerator(
+                repo.odb,
+                [new_oid],
+                has=has_set.__contains__,
+                sender_shallow=read_shallow(repo),
+            )
+            objects = list(enum)
+            updated.update(
+                http.receive_pack(
+                    objects,
+                    [
+                        {
+                            "ref": dst_ref,
+                            "old": old_oid,
+                            "new": new_oid,
+                            "force": spec_force,
+                        }
+                    ],
+                    shallow=enum.shallow_boundary,
+                )
+            )
+        except HttpTransportError as e:
+            raise RemoteError(str(e))
+        _record_push_tracking(
+            repo, remote_name, src_ref, dst_ref, new_oid, set_upstream
+        )
+    return updated
+
+
 def push(repo, remote_name="origin", refspecs=(), *, force=False, set_upstream=False):
     """Push refs to the remote. Default: current branch to its same name.
     Returns {remote_ref: oid}."""
     remote = Remote(repo, remote_name)
-    dst = remote.open()
 
     if not refspecs:
         branch = repo.refs.head_branch()
         if branch is None:
             raise RemoteError("Cannot push: HEAD is detached and no refspec given")
         refspecs = [f"{branch}:{branch}"]
+
+    if is_http_url(remote.url):
+        return _push_http(
+            repo,
+            remote_name,
+            remote.url,
+            refspecs,
+            force=force,
+            set_upstream=set_upstream,
+        )
+    dst = remote.open()
 
     updated = {}
     for spec in refspecs:
@@ -277,17 +439,7 @@ def push(repo, remote_name="origin", refspecs=(), *, force=False, set_upstream=F
             updated[dst_ref] = None
             continue
 
-        src_ref = (
-            src_name if src_name.startswith("refs/") else f"refs/heads/{src_name}"
-        )
-        new_oid = repo.refs.get(src_ref)
-        if new_oid is None:
-            try:
-                new_oid = repo.resolve_refish(src_name)[0]
-            except NotFound:
-                new_oid = None
-        if new_oid is None:
-            raise RemoteError(f"Unknown ref to push: {src_name!r}")
+        src_ref, new_oid = _resolve_push_source(repo, src_name)
 
         old_oid = dst.refs.get(dst_ref)
         if old_oid and not spec_force:
@@ -309,18 +461,9 @@ def push(repo, remote_name="origin", refspecs=(), *, force=False, set_upstream=F
         dst.refs.set(dst_ref, new_oid, log_message=f"push from {repo.gitdir}")
         updated[dst_ref] = new_oid
 
-        # mirror into our remote-tracking ref
-        if dst_ref.startswith("refs/heads/"):
-            track = f"refs/remotes/{remote_name}/{dst_ref[len('refs/heads/'):]}"
-            repo.refs.set(track, new_oid, log_message="update by push")
-            if set_upstream and src_ref.startswith("refs/heads/"):
-                b = src_ref[len("refs/heads/") :]
-                repo.config.set_many(
-                    {
-                        f"branch.{b}.remote": remote_name,
-                        f"branch.{b}.merge": dst_ref,
-                    }
-                )
+        _record_push_tracking(
+            repo, remote_name, src_ref, dst_ref, new_oid, set_upstream
+        )
     return updated
 
 
@@ -347,31 +490,33 @@ def clone(
     repo = KartRepo.init_repository(directory, bare=bare)
     try:
         add_remote(repo, "origin", url)
-        src = open_remote(url)
 
-        blob_filter = None
+        filter_spec = None
         if spatial_filter_spec is not None:
-            from kart_tpu.spatial_filter import blob_filter_for_spec
-
-            blob_filter = blob_filter_for_spec(
-                src, spatial_filter_spec.envelope_wsen_4326
-            )
+            filter_spec = spatial_filter_spec.filter_arg
             repo.config.set_many(
                 {
                     "remote.origin.promisor": "true",
                     "remote.origin.partialclonefilter": "extension:spatial="
-                    + spatial_filter_spec.filter_arg,
+                    + filter_spec,
                     **spatial_filter_spec.config_items(),
                 }
             )
 
-        fetch(repo, "origin", depth=depth, blob_filter=blob_filter)
+        fetch(repo, "origin", depth=depth, filter_spec=filter_spec)
 
-        # pick the branch to check out: requested, remote HEAD, or first
+        # pick the branch to check out: requested, remote HEAD (the symref
+        # fetch recorded), or first
         if branch is None:
-            kind, target = src.refs.head_target()
-            if kind == "symbolic" and target.startswith("refs/heads/"):
-                branch = target[len("refs/heads/") :]
+            head_file = os.path.join(
+                repo.gitdir, "refs", "remotes", "origin", "HEAD"
+            )
+            if os.path.exists(head_file):
+                with open(head_file) as f:
+                    target = f.read().strip()
+                prefix = "ref: refs/remotes/origin/"
+                if target.startswith(prefix):
+                    branch = target[len(prefix) :]
         if branch is None:
             heads = [r for r, _ in repo.refs.iter_refs("refs/remotes/origin/")]
             branch = heads[0].split("/")[-1] if heads else "main"
@@ -423,6 +568,13 @@ def fetch_promised_blobs(repo, oids):
             break
     if promisor is None:
         raise RemoteError("No promisor remote configured")
+    if is_http_url(promisor.url):
+        from kart_tpu.transport.http import HttpRemote, HttpTransportError
+
+        try:
+            return HttpRemote(promisor.url).fetch_blobs(repo, oids)
+        except HttpTransportError as e:
+            raise RemoteError(str(e))
     src = promisor.open()
     fetched = 0
     with tempfile.SpooledTemporaryFile(max_size=64 * 1024 * 1024) as wire:
