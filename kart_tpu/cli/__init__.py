@@ -33,6 +33,7 @@ _COMMANDS = {
     "config": "kart_tpu.cli.ref_cmds",
     "gc": "kart_tpu.cli.ref_cmds",
     "fsck": "kart_tpu.cli.ref_cmds",
+    "reflog": "kart_tpu.cli.ref_cmds",
     "data": "kart_tpu.cli.data_cmds",
     "query": "kart_tpu.cli.data_cmds",
     "meta": "kart_tpu.cli.data_cmds",

@@ -172,3 +172,34 @@ def fsck(ctx, reset_datasets):
             click.secho(f"error: {e}", fg="red", err=True)
         raise SystemExit(1)
     click.echo("No errors found.")
+
+
+@cli.command()
+@click.argument("ref", required=False, default="HEAD")
+@click.pass_obj
+def reflog(ctx, ref):
+    """Show the log of where REF has pointed (reference: the pass-through
+    `kart reflog`, kart/cli.py:211-305)."""
+    repo = ctx.repo
+    entries = []
+    if ref == "HEAD" or ref.startswith("refs/"):
+        candidates = [ref]
+    else:
+        # short names resolve like git: heads, then tags, then remotes
+        candidates = [
+            f"refs/heads/{ref}",
+            f"refs/tags/{ref}",
+            f"refs/remotes/{ref}",
+        ]
+    for candidate in candidates:
+        entries = repo.refs.read_reflog(candidate)
+        if entries:
+            ref = candidate
+            break
+    if not entries:
+        click.echo(f"No reflog for {ref}")
+        return
+    short = ref if ref == "HEAD" else ref.split("/", 2)[-1]
+    for i, entry in enumerate(reversed(entries)):
+        new = entry.get("new") or "0" * 40
+        click.echo(f"{new[:7]} {short}@{{{i}}}: {entry.get('message', '')}")

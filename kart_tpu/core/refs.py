@@ -70,6 +70,13 @@ class RefStore:
         os.replace(tmp, path)
         if log_message is not None:
             self._append_reflog(ref, old, oid, log_message)
+            # updating the checked-out branch moves HEAD too (git logs both)
+            try:
+                kind, target = self.head_target()
+            except Exception:
+                kind, target = None, None
+            if kind == "symbolic" and target == ref:
+                self._append_reflog("HEAD", old, oid, log_message)
 
     def delete(self, ref):
         path = self._ref_path(ref)

@@ -61,10 +61,13 @@ class HttpTransportError(ValueError):
 
 def write_framed(fp, header, pack_source):
     """pack_source: iterable of (type, content) -> frames header + pack into
-    fp. The pack is buffered first so the header can carry enumeration
-    results (shallow boundary, counts)."""
+    fp. The pack is buffered (spooled) first, and a callable header is only
+    evaluated after that drain — so the header can carry enumeration results
+    (shallow boundary, counts) without materialising the objects in RAM."""
     with tempfile.SpooledTemporaryFile(max_size=64 * 1024 * 1024) as buf:
         write_pack(buf, iter(pack_source))
+        if callable(header):
+            header = header()
         raw_header = json.dumps(header).encode()
         fp.write(_HEADER_LEN.pack(len(raw_header)))
         fp.write(raw_header)
@@ -275,14 +278,15 @@ class KartRequestHandler(BaseHTTPRequestHandler):
             blob_filter=blob_filter,
             sender_shallow=read_shallow(repo),
         )
-        objects = list(enum)  # drain so enum counters/boundary are final
+        # the enumerator streams straight into the spooled pack; the header
+        # callable reads its counters only after the drain
         self._framed(
-            {
+            lambda: {
                 "shallow_boundary": sorted(enum.shallow_boundary),
                 "object_count": enum.object_count,
                 "omitted_blob_count": enum.omitted_blob_count,
             },
-            objects,
+            enum,
         )
 
     def _handle_fetch_blobs(self):
@@ -325,9 +329,11 @@ class KartRequestHandler(BaseHTTPRequestHandler):
         updated = {}
         # compare-and-swap must be atomic across handler threads: without
         # the lock two concurrent pushes both pass the check and one update
-        # is silently lost
+        # is silently lost. All updates are validated before any is applied
+        # so a rejected request leaves no ref moved.
         with self.server.push_lock:
-            for upd in header.get("updates", []):
+            updates = header.get("updates", [])
+            for upd in updates:
                 ref, old, new = upd["ref"], upd.get("old"), upd.get("new")
                 if deny_current and ref == self._current_branch_ref():
                     return self._json(
@@ -348,16 +354,17 @@ class KartRequestHandler(BaseHTTPRequestHandler):
                             f"{current}); fetch first or use --force"
                         },
                     )
+                if new is not None and not repo.odb.contains(new):
+                    return self._json(
+                        400, {"error": f"Push incomplete: {new} not received"}
+                    )
+            for upd in updates:
+                ref, new = upd["ref"], upd.get("new")
                 if new is None:
-                    if current is not None:
+                    if repo.refs.get(ref) is not None:
                         repo.refs.delete(ref)
                     updated[ref] = None
                 else:
-                    if not repo.odb.contains(new):
-                        return self._json(
-                            400,
-                            {"error": f"Push incomplete: {new} not received"},
-                        )
                     repo.refs.set(ref, new, log_message="push (http)")
                     updated[ref] = new
             if header.get("shallow"):
@@ -468,10 +475,17 @@ class HttpRemote:
 
     def receive_pack(self, objects, updates, *, shallow=()):
         """objects: iterable of (type, content); updates: [{ref, old, new,
-        force}]. -> {ref: oid|None} from the server."""
+        force}]; shallow: oids or a callable evaluated after the objects
+        drain (an ObjectEnumerator's boundary is only final then).
+        -> {ref: oid|None} from the server."""
         with tempfile.SpooledTemporaryFile(max_size=64 * 1024 * 1024) as buf:
             write_framed(
-                buf, {"updates": updates, "shallow": sorted(shallow)}, objects
+                buf,
+                lambda: {
+                    "updates": updates,
+                    "shallow": sorted(shallow() if callable(shallow) else shallow),
+                },
+                objects,
             )
             length = buf.tell()
             buf.seek(0)

@@ -379,10 +379,9 @@ def _push_http(repo, remote_name, url, refspecs, *, force, set_upstream):
                 has=has_set.__contains__,
                 sender_shallow=read_shallow(repo),
             )
-            objects = list(enum)
             updated.update(
                 http.receive_pack(
-                    objects,
+                    enum,
                     [
                         {
                             "ref": dst_ref,
@@ -391,7 +390,7 @@ def _push_http(repo, remote_name, url, refspecs, *, force, set_upstream):
                             "force": spec_force,
                         }
                     ],
-                    shallow=enum.shallow_boundary,
+                    shallow=lambda: enum.shallow_boundary,
                 )
             )
         except HttpTransportError as e:

@@ -75,6 +75,38 @@ class Mismatch(InvalidOperation):
         self.expected_tree = expected_tree
 
 
+def _geom_envelope(value):
+    """GPKG blob -> (minx, maxx, miny, maxy) or None for NULL/empty/garbage."""
+    if value is None:
+        return None
+    try:
+        return Geometry.of(bytes(value)).envelope()
+    except Exception:
+        return None
+
+
+def _register_gpkg_functions(con):
+    """The GPKG rtree-extension triggers call ST_IsEmpty/ST_MinX/... —
+    provided by spatialite/GDAL in other clients; here backed by our own
+    envelope parser so the triggers fire correctly on our connections."""
+
+    def st_is_empty(value):
+        return 1 if _geom_envelope(value) is None else 0
+
+    def bound(i):
+        def f(value):
+            env = _geom_envelope(value)
+            return env[i] if env is not None else None
+
+        return f
+
+    con.create_function("ST_IsEmpty", 1, st_is_empty, deterministic=True)
+    con.create_function("ST_MinX", 1, bound(0), deterministic=True)
+    con.create_function("ST_MaxX", 1, bound(1), deterministic=True)
+    con.create_function("ST_MinY", 1, bound(2), deterministic=True)
+    con.create_function("ST_MaxY", 1, bound(3), deterministic=True)
+
+
 class GpkgWorkingCopy:
     def __init__(self, repo, location):
         self.repo = repo
@@ -99,6 +131,7 @@ class GpkgWorkingCopy:
     def session(self):
         con = sqlite3.connect(self.full_path)
         con.row_factory = sqlite3.Row
+        _register_gpkg_functions(con)
         con.execute("PRAGMA foreign_keys = OFF;")
         try:
             con.execute("BEGIN")
@@ -221,6 +254,7 @@ class GpkgWorkingCopy:
             )
 
         con.execute(f"DROP TABLE IF EXISTS {adapter.quote(table)}")
+        self._drop_spatial_index(con, table)
         con.execute(
             f"CREATE TABLE {adapter.quote(table)} ({adapter.v2_schema_to_sql_spec(schema)})"
         )
@@ -275,7 +309,131 @@ class GpkgWorkingCopy:
                     (table, row[0]),
                 )
 
+        if (
+            geom_col is not None
+            and len(pk_cols) == 1
+            and pk_cols[0].data_type == "integer"
+        ):
+            self._create_spatial_index(con, table, geom_col.name, pk_cols[0].name)
+
         self._create_triggers(con, table, schema)
+
+    def _drop_spatial_index(self, con, table):
+        """Drop the rtree index of a previous checkout of this table (DROP
+        TABLE on the base table doesn't cascade to the rtree). The exact
+        index names come from gpkg_extensions/gpkg_geometry_columns — a
+        prefix match would hit another table like '<table>_old'. Dropping
+        the virtual table drops its shadow _node/_rowid/_parent tables."""
+        geom_cols = set()
+        if self._table_exists_in_master(con, "gpkg_extensions"):
+            geom_cols.update(
+                row[0]
+                for row in con.execute(
+                    "SELECT column_name FROM gpkg_extensions "
+                    "WHERE table_name = ? AND extension_name = 'gpkg_rtree_index'",
+                    (table,),
+                ).fetchall()
+                if row[0]
+            )
+        if self._table_exists_in_master(con, "gpkg_geometry_columns"):
+            geom_cols.update(
+                row[0]
+                for row in con.execute(
+                    "SELECT column_name FROM gpkg_geometry_columns "
+                    "WHERE table_name = ?",
+                    (table,),
+                ).fetchall()
+            )
+        for col in geom_cols:
+            name = f"rtree_{table}_{col}"
+            if self._table_exists_in_master(con, name):
+                con.execute(f"DROP TABLE IF EXISTS {adapter.quote(name)}")
+        if self._table_exists_in_master(con, "gpkg_extensions"):
+            con.execute(
+                "DELETE FROM gpkg_extensions WHERE table_name = ? "
+                "AND extension_name = 'gpkg_rtree_index'",
+                (table,),
+            )
+
+    @staticmethod
+    def _table_exists_in_master(con, name):
+        return (
+            con.execute(
+                "SELECT 1 FROM sqlite_master WHERE name = ?", (name,)
+            ).fetchone()
+            is not None
+        )
+
+    def _create_spatial_index(self, con, table, geom_name, pk_name):
+        """GPKG rtree spatial index: the standard gpkg_rtree_index extension
+        (rtree virtual table + sync triggers), so spatial clients get fast
+        bbox queries on the WC (reference: gpkgAddSpatialIndex,
+        kart/working_copy/gpkg.py:432-476)."""
+        rtree = adapter.quote(f"rtree_{table}_{geom_name}")
+        qt = adapter.quote(table)
+        qg = adapter.quote(geom_name)
+        qi = adapter.quote(pk_name)
+
+        con.execute(
+            f"CREATE VIRTUAL TABLE {rtree} USING rtree(id, minx, maxx, miny, maxy)"
+        )
+        con.execute(
+            f"INSERT OR REPLACE INTO {rtree} "
+            f"SELECT {qi}, ST_MinX({qg}), ST_MaxX({qg}), ST_MinY({qg}), ST_MaxY({qg}) "
+            f"FROM {qt} WHERE {qg} NOT NULL AND NOT ST_IsEmpty({qg})"
+        )
+
+        con.execute(
+            """CREATE TABLE IF NOT EXISTS gpkg_extensions (
+                table_name TEXT, column_name TEXT, extension_name TEXT NOT NULL,
+                definition TEXT NOT NULL, scope TEXT NOT NULL,
+                CONSTRAINT ge_tce UNIQUE (table_name, column_name, extension_name))"""
+        )
+        con.execute(
+            "INSERT OR REPLACE INTO gpkg_extensions VALUES "
+            "(?, ?, 'gpkg_rtree_index', "
+            "'http://www.geopackage.org/spec120/#extension_rtree', 'write-only')",
+            (table, geom_name),
+        )
+
+        # the six sync triggers from the GPKG spec (Annex F.3)
+        def trig(suffix):
+            return adapter.quote(f"rtree_{table}_{geom_name}_{suffix}")
+
+        not_empty = f"(NEW.{qg} NOT NULL AND NOT ST_IsEmpty(NEW.{qg}))"
+        is_empty = f"(NEW.{qg} ISNULL OR ST_IsEmpty(NEW.{qg}))"
+        upsert = (
+            f"INSERT OR REPLACE INTO {rtree} VALUES (NEW.{qi}, "
+            f"ST_MinX(NEW.{qg}), ST_MaxX(NEW.{qg}), "
+            f"ST_MinY(NEW.{qg}), ST_MaxY(NEW.{qg}));"
+        )
+        con.execute(
+            f"CREATE TRIGGER {trig('insert')} AFTER INSERT ON {qt} "
+            f"WHEN {not_empty} BEGIN {upsert} END;"
+        )
+        con.execute(
+            f"CREATE TRIGGER {trig('update1')} AFTER UPDATE OF {qg} ON {qt} "
+            f"WHEN OLD.{qi} = NEW.{qi} AND {not_empty} BEGIN {upsert} END;"
+        )
+        con.execute(
+            f"CREATE TRIGGER {trig('update2')} AFTER UPDATE OF {qg} ON {qt} "
+            f"WHEN OLD.{qi} = NEW.{qi} AND {is_empty} "
+            f"BEGIN DELETE FROM {rtree} WHERE id = OLD.{qi}; END;"
+        )
+        con.execute(
+            f"CREATE TRIGGER {trig('update3')} AFTER UPDATE ON {qt} "
+            f"WHEN OLD.{qi} != NEW.{qi} AND {not_empty} "
+            f"BEGIN DELETE FROM {rtree} WHERE id = OLD.{qi}; {upsert} END;"
+        )
+        con.execute(
+            f"CREATE TRIGGER {trig('update4')} AFTER UPDATE ON {qt} "
+            f"WHEN OLD.{qi} != NEW.{qi} AND {is_empty} "
+            f"BEGIN DELETE FROM {rtree} WHERE id IN (OLD.{qi}, NEW.{qi}); END;"
+        )
+        con.execute(
+            f"CREATE TRIGGER {trig('delete')} AFTER DELETE ON {qt} "
+            f"BEGIN DELETE FROM {rtree} WHERE id = OLD.{qi}; END;"
+        )
 
     def _create_triggers(self, con, table, schema):
         """Edit tracking (reference: gpkg.py:498-554)."""
@@ -576,6 +734,7 @@ class GpkgWorkingCopy:
             # datasets removed in target
             for ds_path in sorted(base_paths - target_paths):
                 table = self._table_name(ds_path)
+                self._drop_spatial_index(con, table)
                 con.execute(f"DROP TABLE IF EXISTS {adapter.quote(table)}")
                 con.execute("DELETE FROM gpkg_contents WHERE table_name = ?", (table,))
                 con.execute(

@@ -131,3 +131,16 @@ def edit_commit(repo, ds_path, *, inserts=(), updates=(), deletes=(), message="e
     repo_diff = RepoDiff()
     repo_diff[ds_path] = ds_diff
     return structure.commit_diff(repo_diff, message)
+
+
+def wc_connect(path):
+    """Open a GPKG working copy for raw SQL edits: registers the GPKG
+    envelope functions the rtree-extension triggers call (real editing
+    clients get these from spatialite/GDAL)."""
+    import sqlite3
+
+    from kart_tpu.workingcopy.gpkg import _register_gpkg_functions
+
+    con = sqlite3.connect(str(path))
+    _register_gpkg_functions(con)
+    return con

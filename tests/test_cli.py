@@ -34,7 +34,9 @@ def repo_dir(tmp_path, runner, monkeypatch):
 
 
 def wc_edit(repo_dir, sql):
-    con = sqlite3.connect(repo_dir / "wc.gpkg")
+    from helpers import wc_connect
+
+    con = wc_connect(repo_dir / "wc.gpkg")
     con.executescript(sql)
     con.commit()
     con.close()
@@ -280,3 +282,51 @@ def test_query_bad_bbox(repo_dir, runner):
     r = runner.invoke(cli, ["query", "points", "intersects", "nope"])
     assert r.exit_code != 0
     assert "Bad bbox" in r.output
+
+
+def test_gpkg_wc_spatial_index(repo_dir, runner):
+    """Checkout builds the standard gpkg_rtree_index extension (rtree
+    virtual table + sync triggers), and our own sessions keep it in sync
+    (reference: gpkgAddSpatialIndex, kart/working_copy/gpkg.py:432-476)."""
+    con = sqlite3.connect(repo_dir / "wc.gpkg")
+    # index exists and covers every non-null geometry
+    n = con.execute('SELECT count(*) FROM "rtree_points_geom"').fetchone()[0]
+    assert n == 10
+    ext = con.execute(
+        "SELECT extension_name, scope FROM gpkg_extensions "
+        "WHERE table_name = 'points'"
+    ).fetchone()
+    assert ext == ("gpkg_rtree_index", "write-only")
+    # a bbox query through the rtree finds the right features (x = 101..110)
+    hits = sorted(
+        r[0]
+        for r in con.execute(
+            'SELECT id FROM "rtree_points_geom" WHERE maxx >= 102.5 AND minx <= 104.5'
+        )
+    )
+    assert hits == [3, 4]
+    con.close()
+
+    # commits applied through kart keep the index in sync (our sessions
+    # register the ST_* functions the spec triggers call)
+    wc_edit(repo_dir, "DELETE FROM points WHERE fid = 3;")
+    r = runner.invoke(cli, ["commit", "-m", "delete 3"])
+    assert r.exit_code == 0, r.output
+    con = sqlite3.connect(repo_dir / "wc.gpkg")
+    ids = {r[0] for r in con.execute('SELECT id FROM "rtree_points_geom"')}
+    assert 3 not in ids and len(ids) == 9
+    con.close()
+
+
+def test_reflog(repo_dir, runner):
+    wc_edit(repo_dir, "DELETE FROM points WHERE fid = 1;")
+    r = runner.invoke(cli, ["commit", "-m", "delete 1"])
+    assert r.exit_code == 0, r.output
+    r = runner.invoke(cli, ["reflog", "main"])
+    assert r.exit_code == 0, r.output
+    lines = r.output.strip().splitlines()
+    assert len(lines) >= 2
+    assert "main@{0}" in lines[0] and "delete 1" in lines[0]
+    r = runner.invoke(cli, ["reflog"])
+    assert r.exit_code == 0, r.output
+    assert "HEAD@{0}" in r.output

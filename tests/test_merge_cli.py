@@ -8,7 +8,7 @@ import sqlite3
 import pytest
 from click.testing import CliRunner
 
-from helpers import create_points_gpkg
+from helpers import create_points_gpkg, wc_connect
 from kart_tpu.cli import cli
 
 
@@ -35,7 +35,7 @@ def repo_dir(tmp_path, runner, monkeypatch):
 
 
 def wc_edit(repo_dir, sql):
-    con = sqlite3.connect(repo_dir / "wc.gpkg")
+    con = wc_connect(repo_dir / "wc.gpkg")
     con.executescript(sql)
     con.commit()
     con.close()
@@ -92,7 +92,7 @@ def test_merge_clean(repo_dir, runner):
     body = json.loads(r.output)["kart.merge/v1"]
     assert "commit" in body
     # both edits present in the working copy
-    con = sqlite3.connect(repo_dir / "wc.gpkg")
+    con = wc_connect(repo_dir / "wc.gpkg")
     names = dict(con.execute("SELECT fid, name FROM points WHERE fid IN (1,2)"))
     con.close()
     assert names == {1: "ours-1", 2: "theirs-2"}
@@ -128,7 +128,7 @@ def test_merge_conflict_resolve_continue(repo_dir, runner):
     r = runner.invoke(cli, ["merge", "--continue"])
     assert r.exit_code == 0, r.output
 
-    con = sqlite3.connect(repo_dir / "wc.gpkg")
+    con = wc_connect(repo_dir / "wc.gpkg")
     (name,) = con.execute("SELECT name FROM points WHERE fid=3").fetchone()
     con.close()
     assert name == "theirs-3"
@@ -140,7 +140,7 @@ def test_merge_abort(repo_dir, runner):
     assert r.exit_code == 1
     r = runner.invoke(cli, ["merge", "--abort"])
     assert r.exit_code == 0, r.output
-    con = sqlite3.connect(repo_dir / "wc.gpkg")
+    con = wc_connect(repo_dir / "wc.gpkg")
     (name,) = con.execute("SELECT name FROM points WHERE fid=3").fetchone()
     con.close()
     assert name == "ours-3"
@@ -167,7 +167,7 @@ def test_resolve_with_file(repo_dir, runner, tmp_path):
     assert r.exit_code == 0, r.output
     r = runner.invoke(cli, ["merge", "--continue"])
     assert r.exit_code == 0, r.output
-    con = sqlite3.connect(repo_dir / "wc.gpkg")
+    con = wc_connect(repo_dir / "wc.gpkg")
     (name,) = con.execute("SELECT name FROM points WHERE fid=3").fetchone()
     con.close()
     assert name == "resolved-3"

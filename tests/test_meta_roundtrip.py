@@ -84,8 +84,10 @@ def test_commit_preserves_title_on_feature_edit(tmp_path, monkeypatch):
 
     import glob
 
+    from helpers import wc_connect
+
     wc = glob.glob(f"{repo_dir}/*.gpkg")[0]
-    con = sqlite3.connect(wc)
+    con = wc_connect(wc)
     con.execute("UPDATE points SET name = 'edited' WHERE fid = 2")
     con.commit()
     con.close()
