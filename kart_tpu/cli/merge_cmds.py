@@ -256,18 +256,28 @@ def conflicts(ctx, output_format, summarise):
                 for how, n in buckets.items():
                     click.echo(f"    {kind} {how}: {n}")
     else:
+        from kart_tpu.diff.output import feature_as_text
+
         for label in sorted(unresolved):
             click.echo(f"=== {label} ===")
             versions = decoder.versions_json(unresolved[label])
+            is_feature = ":feature:" in label
             for name in ("ancestor", "ours", "theirs"):
                 if name in versions:
                     click.echo(f"--- {name}")
                     value = versions[name]
-                    if isinstance(value, dict):
-                        for k, v in value.items():
-                            click.echo(f"    {k} = {v!r}")
+                    if (
+                        is_feature
+                        and isinstance(value, dict)
+                        and value.keys() != {"$blob"}
+                    ):
+                        # readable geometry/blob summaries, like diff text
+                        # output (reference prints "POINT(...)" not bytes)
+                        click.echo(feature_as_text(value, prefix="    "))
+                    elif isinstance(value, (dict, list)):
+                        click.echo(json.dumps(value, indent=4))
                     else:
-                        click.echo(f"    {value!r}")
+                        click.echo(f"    {value}")
             click.echo()
     click.echo(f"{len(unresolved)} unresolved conflicts")
     sys.exit(1)

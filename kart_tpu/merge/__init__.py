@@ -97,14 +97,6 @@ def _keys_to_block_rows(block, keys):
     return np.where(found, idxc, -1)
 
 
-def _key_to_block_entry(block, key):
-    """union key -> (rel_path, oid_hex) from a FeatureBlock, or None."""
-    row = int(_keys_to_block_rows(block, np.asarray([key], dtype=np.int64))[0])
-    if row < 0:
-        return None
-    return block.paths[row], unpack_oid_hex(block.oids[row : row + 1])[0]
-
-
 def _feature_label(ds_path, datasets, rel_paths):
     """Conflict label `<ds>:feature:<pk>` (reference RichConflict labels,
     kart/merge_util.py:508-540)."""
@@ -132,7 +124,6 @@ def _merge_dataset_features(ds_path, structures, tree_builder):
         return _merge_dataset_features_host(ds_path, blocks, datasets, tree_builder)
 
     union, decision, presence, stats = merge_classify(a_block, o_block, t_block)
-    conflicts = {}
 
     take_idx = np.nonzero(decision == TAKE_THEIRS)[0]
     conflict_idx = np.nonzero(decision == CONFLICT)[0]
@@ -160,21 +151,89 @@ def _merge_dataset_features(ds_path, structures, tree_builder):
         if row >= 0:
             tree_builder.remove(f"{inner}/feature/{o_block.paths[row]}")
 
-    for i in conflict_idx:
-        key = union[i]
-        entries = []
-        rels = []
-        for block in blocks:
-            found = _key_to_block_entry(block, key)
-            rels.append(found[0] if found else None)
-            entries.append(
-                ConflictEntry(f"{inner}/feature/{found[0]}", found[1])
-                if found
-                else None
-            )
-        label = _feature_label(ds_path, datasets, rels)
-        conflicts[label] = AncestorOursTheirs(*entries)
+    conflicts = materialise_conflicts(
+        ds_path, blocks, datasets, inner, union, conflict_idx
+    )
     return conflicts, stats
+
+
+def materialise_conflicts(ds_path, blocks, datasets, inner, union, conflict_idx):
+    """Conflict rows -> {label: AncestorOursTheirs(ConflictEntry)} with one
+    batched lookup per version (BASELINE config #5 scale: a 1M-conflict
+    merge must not pay per-conflict searchsorted/unpack calls)."""
+    conflicts = {}
+    if not len(conflict_idx):
+        return conflicts
+    conflict_keys = union[conflict_idx]
+    n = len(conflict_keys)
+
+    # numpy work once per version; .tolist() so the object-building loops
+    # below do plain-list indexing, not numpy scalar boxing
+    per_block = []
+    for block in blocks:
+        rows_arr = _keys_to_block_rows(block, conflict_keys)
+        found_arr = rows_arr >= 0
+        oid_hexes = [None] * n
+        if np.any(found_arr):
+            hexes = unpack_oid_hex(block.oids[rows_arr[found_arr]])
+            for slot, h in zip(np.nonzero(found_arr)[0].tolist(), hexes):
+                oid_hexes[slot] = h
+        per_block.append((rows_arr.tolist(), found_arr.tolist(), oid_hexes))
+
+    labels = _conflict_labels_batch(ds_path, datasets, blocks, per_block, n)
+
+    prefix = f"{inner}/feature/"
+    entries_per_block = []
+    for (rows, found, oid_hexes), block in zip(per_block, blocks):
+        paths = block.paths
+        entries_per_block.append(
+            [
+                ConflictEntry(prefix + paths[rows[i]], oid_hexes[i])
+                if found[i]
+                else None
+                for i in range(n)
+            ]
+        )
+    return {
+        labels[i]: AncestorOursTheirs(*entry_row)
+        for i, entry_row in enumerate(zip(*entries_per_block))
+    }
+
+
+def _conflict_labels_batch(ds_path, datasets, blocks, per_block, n):
+    """Labels `<ds>:feature:<pk>` for every conflict. Each conflict's rel
+    path is decoded with the encoder of the version it came from (versions
+    of one dataset can carry different encoders, e.g. after a pk type
+    change); int-pk versions decode all their paths in one vectorized
+    call, others fall back per-path."""
+    labels = [None] * n
+    for v, ((rows, found, _), block) in enumerate(zip(per_block, blocks)):
+        pending = [i for i in range(n) if labels[i] is None and found[i]]
+        if not pending:
+            continue
+        rels = [block.paths[rows[i]] for i in pending]
+        ds = datasets[v]
+        encoder = getattr(ds, "path_encoder", None)
+        done = False
+        if encoder is not None and hasattr(encoder, "decode_paths_batch"):
+            try:
+                pks = encoder.decode_paths_batch(rels)
+                for i, pk in zip(pending, pks):
+                    labels[i] = f"{ds_path}:feature:{pk}"
+                done = True
+            except Exception:
+                pass  # undecodable batch: per-path fallback below
+        if not done:
+            version_datasets = [None] * len(blocks)
+            version_datasets[v] = ds
+            for i, rel in zip(pending, rels):
+                rel_row = [None] * len(blocks)
+                rel_row[v] = rel
+                labels[i] = _feature_label(ds_path, version_datasets, rel_row)
+    for i in range(n):
+        if labels[i] is None:
+            labels[i] = f"{ds_path}:feature:?"
+    return labels
 
 
 def _merge_dataset_features_host(ds_path, blocks, datasets, tree_builder):

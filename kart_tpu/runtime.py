@@ -81,6 +81,23 @@ def insulate_virtual_cpu(n_devices=8):
         _probe_result = None  # platform changed: re-probe
 
 
+def _enable_persistent_cache(jax):
+    """Persistent XLA compilation cache: a fresh `kart diff` process reuses
+    kernels compiled by any earlier invocation instead of paying the
+    ~20-40s TPU compile every time (KART_NO_XLA_CACHE=1 disables)."""
+    if os.environ.get("KART_NO_XLA_CACHE") == "1":
+        return
+    try:
+        cache_dir = os.environ.get("JAX_COMPILATION_CACHE_DIR") or os.path.join(
+            os.path.expanduser("~"), ".cache", "kart_tpu", "xla_cache"
+        )
+        os.makedirs(cache_dir, exist_ok=True)
+        jax.config.update("jax_compilation_cache_dir", cache_dir)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
+    except Exception as e:  # pragma: no cover - version-dependent
+        L.debug("persistent compilation cache unavailable: %s", e)
+
+
 def probe_backend(timeout=None):
     """Initialise the jax backend under a watchdog. Returns a provenance dict:
 
@@ -114,6 +131,7 @@ def probe_backend(timeout=None):
                 t0 = time.perf_counter()
                 import jax
 
+                _enable_persistent_cache(jax)
                 devices = jax.devices()
                 box["result"] = {
                     "ok": True,

@@ -258,3 +258,82 @@ class TestDoMerge:
         assert result.dry_run
         assert repo.head_commit_oid == head_before
         assert repo.state == KartRepoState.NORMAL
+
+
+class TestConflictMaterialisation:
+    """Batched conflict materialisation (BASELINE config #5 path)."""
+
+    def _block(self, keys, oid_salt, paths):
+        from kart_tpu.ops.blocks import FeatureBlock, bucket_size, PAD_KEY
+
+        keys = np.asarray(keys, dtype=np.int64)
+        n = len(keys)
+        rng = np.random.default_rng(0)
+        oids = rng.integers(0, 2**32, size=(n, 5), dtype=np.uint32)
+        oids[:, 0] ^= oid_salt
+        block = FeatureBlock.__new__(FeatureBlock)
+        size = bucket_size(max(n, 1))
+        if size > n:
+            keys = np.concatenate([keys, np.full(size - n, PAD_KEY, np.int64)])
+            oids = np.concatenate([oids, np.zeros((size - n, 5), np.uint32)])
+        block.keys = keys
+        block.oids = oids
+        block.paths = list(paths)
+        block.count = n
+        return block
+
+    def test_labels_decode_with_each_versions_encoder(self):
+        """Every conflict label must decode the rel path with the encoder of
+        the version the path came from — a pk-type change means versions of
+        one dataset can carry different path encodings, and decoding hash
+        paths with the int encoder would collapse labels (and so conflicts)."""
+        from kart_tpu.merge import materialise_conflicts
+        from kart_tpu.models.paths import PathEncoder
+        from kart_tpu.ops.merge_kernel import CONFLICT, merge_classify
+
+        int_enc = PathEncoder.INT_PK_ENCODER
+        keys = np.arange(4, dtype=np.int64)
+        int_paths = int_enc.encode_paths_batch(keys)
+
+        class _IntDs:
+            path_encoder = int_enc
+
+            @staticmethod
+            def decode_path_to_pks(rel):
+                return int_enc.decode_path_to_pks(rel)
+
+        a = self._block(keys, 0, int_paths)
+        o = self._block(keys, 1, int_paths)  # every row changed in ours
+        t = self._block(keys, 2, int_paths)  # ... and differently in theirs
+        union, decision, _, stats = merge_classify(a, o, t)
+        conflict_idx = np.nonzero(decision == CONFLICT)[0]
+        assert len(conflict_idx) == 4
+
+        conflicts = materialise_conflicts(
+            "ds", [a, o, t], [_IntDs(), _IntDs(), _IntDs()], "inner",
+            union, conflict_idx,
+        )
+        # distinct, correctly-decoded labels — one per conflicting pk
+        assert sorted(conflicts) == [f"ds:feature:{k}" for k in range(4)]
+        for label, aot in conflicts.items():
+            assert aot.ancestor is not None
+            assert aot.ours is not None and aot.theirs is not None
+            assert aot.ours.path.startswith("inner/feature/")
+
+    def test_labels_fall_back_per_version_without_encoder(self):
+        """datasets=None versions still label every conflict distinctly."""
+        from kart_tpu.merge import materialise_conflicts
+        from kart_tpu.ops.merge_kernel import CONFLICT, merge_classify
+
+        keys = np.arange(3, dtype=np.int64)
+        paths = [f"aa/k{k}" for k in keys]
+        a = self._block(keys, 0, paths)
+        o = self._block(keys, 1, paths)
+        t = self._block(keys, 2, paths)
+        union, decision, _, _ = merge_classify(a, o, t)
+        conflict_idx = np.nonzero(decision == CONFLICT)[0]
+        conflicts = materialise_conflicts(
+            "ds", [a, o, t], [None, None, None], "inner", union, conflict_idx
+        )
+        assert len(conflicts) == 3
+        assert all(label.startswith("ds:feature:") for label in conflicts)
