@@ -330,3 +330,52 @@ def test_reference_fixture_feature_values(points_fixture, monkeypatch):
     feature = ds.get_feature(1)
     assert feature["fid"] == 1
     assert feature["t50_fid"] == 2426271
+
+
+@needs_fixtures
+@pytest.mark.parametrize(
+    "archive,layer,rowcount,head_sha",
+    [
+        # known-answer constants from /root/reference/tests/conftest.py
+        ("polygons", "nz_waca_adjustments", 228,
+         "3f7166eebd11876a9b473a67ed2f66a200493b69"),
+        ("table", "countiestbl", 3141,
+         "f404fcd4ac2a411ef7bb32070e9ffa663374d875"),
+    ],
+)
+def test_reference_fixture_matrix(
+    tmp_path, monkeypatch, archive, layer, rowcount, head_sha
+):
+    """Every fixture family the reference's conftest promises constants for
+    opens, lists, counts, and reads through our pack + V3 decode stack."""
+    with tarfile.open(os.path.join(REF_FIXTURES, f"{archive}.tgz")) as tf:
+        tf.extractall(str(tmp_path), filter="data")
+    monkeypatch.chdir(str(tmp_path / archive))
+
+    from kart_tpu.core.repo import KartRepo
+
+    repo = KartRepo(".")
+    assert repo.head_commit_oid == head_sha
+    structure = repo.structure("HEAD")
+    (ds,) = list(structure.datasets)
+    assert ds.path == layer
+    assert ds.feature_count == rowcount
+
+
+@needs_fixtures
+def test_reference_fixture_string_pks(tmp_path, monkeypatch):
+    """string-pks uses the msgpack-hash path encoder: every feature path
+    must decode and every feature read back through our stack."""
+    with tarfile.open(os.path.join(REF_FIXTURES, "string-pks.tgz")) as tf:
+        tf.extractall(str(tmp_path), filter="data")
+    monkeypatch.chdir(str(tmp_path / "string-pks"))
+
+    from kart_tpu.core.repo import KartRepo
+
+    repo = KartRepo(".")
+    structure = repo.structure("HEAD")
+    (ds,) = list(structure.datasets)
+    features = list(ds.features())
+    assert len(features) == ds.feature_count > 0
+    pk_col = ds.schema.pk_columns[0]
+    assert all(isinstance(f[pk_col.name], str) for f in features[:10])
