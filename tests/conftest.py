@@ -31,3 +31,23 @@ def cli_runner():
     from click.testing import CliRunner
 
     return CliRunner()
+
+
+# -- reference checkout as a fixture oracle ---------------------------------
+
+REF_DATA = "/root/reference/tests/data"
+
+needs_ref_fixtures = pytest.mark.skipif(
+    not os.path.isdir(REF_DATA), reason="reference fixtures not available"
+)
+
+
+def extract_ref_archive(tmp_path, rel):
+    """Extract REF_DATA/<rel> (a .tgz/.tar of one top-level dir) into
+    tmp_path; -> the extracted repo dir."""
+    import tarfile
+
+    with tarfile.open(os.path.join(REF_DATA, rel)) as tf:
+        tf.extractall(str(tmp_path), filter="data")
+    (only,) = [p for p in os.listdir(tmp_path) if not p.startswith(".")]
+    return str(tmp_path / only)

@@ -269,11 +269,8 @@ def test_packed_refs(tmp_path):
 # ---------------------------------------------------------------------------
 # reference fixtures as oracles
 
-REF_FIXTURES = "/root/reference/tests/data"
-
-needs_fixtures = pytest.mark.skipif(
-    not os.path.isdir(REF_FIXTURES), reason="reference fixtures not available"
-)
+from conftest import REF_DATA as REF_FIXTURES
+from conftest import needs_ref_fixtures as needs_fixtures
 
 
 @pytest.fixture

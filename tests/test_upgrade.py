@@ -335,3 +335,27 @@ def test_upgrade_v1_preserves_sibling_attachments_and_null_fills(tmp_path):
     assert root.get("mytable/notes.txt").data == b"attachment survives"
     ds = dest.datasets("HEAD")["mytable"]
     assert ds.get_feature([9]) == {"fid": 9, "name": None, "geom": None}
+
+
+# -- real reference legacy archives as oracles ------------------------------
+
+from conftest import extract_ref_archive, needs_ref_fixtures
+
+
+@needs_ref_fixtures
+@pytest.mark.parametrize(
+    "rel",
+    ["v0/points0.snow.tgz", "v1/points.tgz", "v2.kart/points.tgz",
+     "v2.sno/points.tgz"],
+)
+def test_upgrade_real_reference_archives(tmp_path, rel):
+    """Every legacy generation the reference ships (v0 'snow', v1, v2 under
+    both kart and sno branding) upgrades from the real packfile archives,
+    deterministically: all four histories converge on the same V3 commits."""
+    src = extract_ref_archive(tmp_path / "src", f"upgrade/{rel}")
+    dest, commit_map = upgrade_repo(src, tmp_path / "upgraded")
+    assert len(commit_map) == 2
+    assert dest.head_commit_oid.startswith("551eec7")
+    ds = dest.datasets("HEAD")["nz_pa_points_topo_150k"]
+    assert ds.feature_count == 2143
+    assert ds.get_feature(1)["t50_fid"] == 2426271
