@@ -6,8 +6,15 @@ hash keys use the full 63 bits); without this JAX silently downcasts to int32,
 wrapping the pad sentinel and corrupting every sorted-join. The compute-heavy
 kernels (bbox, envelope) still use explicit f32/int8 — x64 only widens what is
 already 64-bit on the host.
+
+jax itself is NOT imported here (that costs ~1.8s per process — see
+ops/_lazy.py): the env var covers the not-yet-imported case and the config
+update covers callers that imported jax first (tests, the runtime probe).
 """
 
-import jax
+import os
+import sys
 
-jax.config.update("jax_enable_x64", True)
+os.environ.setdefault("JAX_ENABLE_X64", "True")
+if "jax" in sys.modules:
+    sys.modules["jax"].config.update("jax_enable_x64", True)

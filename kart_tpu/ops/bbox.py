@@ -21,9 +21,11 @@ Three implementations with identical semantics:
 
 from functools import partial
 
-import jax
-import jax.numpy as jnp
+import os
+
 import numpy as np
+
+from kart_tpu.ops._lazy import lazy_jit
 
 
 def _range_len_np(w, e):
@@ -46,9 +48,10 @@ def bbox_intersects_np(envelopes, query):
     return lat_ok & lon_ok
 
 
-@jax.jit
-def bbox_intersects_jnp(w, s, e, n, query):
+def _bbox_intersects_jnp_core(w, s, e, n, query):
     """Columns (N,) f32 + query (4,) -> bool (N,). XLA path."""
+    import jax.numpy as jnp
+
     qw, qs, qe, qn = query[0], query[1], query[2], query[3]
     lat_ok = (s <= qn) & (qs <= n)
     len1 = jnp.where(e >= w, e - w, jnp.mod(e - w, 360.0))
@@ -57,7 +60,12 @@ def bbox_intersects_jnp(w, s, e, n, query):
     return lat_ok & lon_ok
 
 
+bbox_intersects_jnp = lazy_jit(_bbox_intersects_jnp_core)
+
+
 def _bbox_kernel(query_ref, w_ref, s_ref, e_ref, n_ref, out_ref):
+    import jax.numpy as jnp
+
     qw = query_ref[0]
     qs = query_ref[1]
     qe = query_ref[2]
@@ -81,12 +89,15 @@ def bbox_intersects_pallas(w, s, e, n, query):
     keys) would make grid index maps emit i64, which Mosaic can't legalize —
     and everything in this kernel is f32/int8 anyway.
     """
+    import jax
+
     with jax.enable_x64(False):
         return _bbox_pallas_inner(w, s, e, n, query)
 
 
-@jax.jit
-def _bbox_pallas_inner(w, s, e, n, query):
+def _bbox_pallas_inner_core(w, s, e, n, query):
+    import jax
+    import jax.numpy as jnp
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
@@ -126,6 +137,9 @@ def _bbox_pallas_inner(w, s, e, n, query):
     return out.reshape(n_items).astype(jnp.bool_)
 
 
+_bbox_pallas_inner = lazy_jit(_bbox_pallas_inner_core)
+
+
 def pad_envelopes(envelopes, multiple=None):
     """(N,4) -> (w,s,e,n) float32 columns padded to a multiple (1024 items =
     8 rows for small inputs, 65536 items = 512 rows for large, keeping the
@@ -141,26 +155,28 @@ def pad_envelopes(envelopes, multiple=None):
     return cols[0], cols[1], cols[2], cols[3], n
 
 
+from kart_tpu.ops.diff_kernel import _env_int
+
+# below this count the numpy path wins outright and never touches jax
+DEVICE_MIN_ENVELOPES = _env_int("KART_DEVICE_MIN_ENVELOPES", 100_000)
+
+
 def bbox_intersects(envelopes, query):
     """Best-available backend dispatch; envelopes (N,4), query (4,) ->
-    bool numpy (N,). Falls back to the numpy reference path when no jax
-    backend can initialise (e.g. a misconfigured accelerator plugin)."""
+    bool numpy (N,). Small inputs and unusable jax backends take the numpy
+    reference path (e.g. a misconfigured accelerator plugin)."""
     n = len(envelopes)
     if n == 0:
         return np.zeros(0, dtype=bool)
     from kart_tpu.runtime import default_backend, jax_ready
 
-    if not jax_ready():
+    if n < DEVICE_MIN_ENVELOPES or not jax_ready():
         return bbox_intersects_np(np.asarray(envelopes), query)
     backend = default_backend()
     w, s, e, nn, count = pad_envelopes(np.asarray(envelopes))
-    q = jnp.asarray(np.asarray(query, dtype=np.float32))
+    q = np.asarray(query, dtype=np.float32)
     if backend == "tpu":
-        mask = bbox_intersects_pallas(
-            jnp.asarray(w), jnp.asarray(s), jnp.asarray(e), jnp.asarray(nn), q
-        )
+        mask = bbox_intersects_pallas(w, s, e, nn, q)
     else:
-        mask = bbox_intersects_jnp(
-            jnp.asarray(w), jnp.asarray(s), jnp.asarray(e), jnp.asarray(nn), q
-        )
+        mask = bbox_intersects_jnp(w, s, e, nn, q)
     return np.asarray(mask)[:count]

@@ -21,9 +21,11 @@ flow, and static shapes. Two device kernels with identical semantics:
 Classes: 0 = unchanged, 1 = insert, 2 = update, 3 = delete.
 """
 
-import jax
-import jax.numpy as jnp
+import os
+
 import numpy as np
+
+from kart_tpu.ops._lazy import lazy_jit
 
 UNCHANGED = 0
 INSERT = 1
@@ -37,6 +39,8 @@ def _fold_oids(oids):
     exact to within a 2^-64 per-pair collision (far below the sha1 trust
     the reference's own content addressing extends). The multiply/xor-shift
     mix stops structured oid differences from cancelling in the fold."""
+    import jax.numpy as jnp
+
     a = oids.astype(jnp.uint64)
     h = a[:, 0] ^ (a[:, 1] << 32)
     h2 = a[:, 2] ^ (a[:, 3] << 32)
@@ -65,6 +69,9 @@ def _classify_mergesort_core(
     permutation afterwards is a large random HBM access pattern (measured
     ~3x slower end-to-end on TPU v5e at 10M rows).
     """
+    import jax
+    import jax.numpy as jnp
+
     n_old = old_keys.shape[0]
     n_new = new_keys.shape[0]
     total = n_old + n_new
@@ -114,14 +121,15 @@ def _classify_mergesort_core(
     return old_class, new_class, idx_in_new, counts
 
 
-_classify_padded = jax.jit(_classify_mergesort_core)
+_classify_padded = lazy_jit(_classify_mergesort_core)
 
 
-@jax.jit
-def _classify_padded_binsearch(
+def _classify_binsearch_core(
     old_keys, old_oids, new_keys, new_oids, old_count, new_count
 ):
-    """Binary-search join: the CPU-backend variant and bit-compat oracle."""
+    """Binary-search join: the CPU-backend variant."""
+    import jax.numpy as jnp
+
     n_old = old_keys.shape[0]
     n_new = new_keys.shape[0]
     old_valid = jnp.arange(n_old) < old_count
@@ -171,17 +179,38 @@ def _classify_padded_binsearch(
     return old_class, new_class, idx_in_new_c, counts
 
 
+_classify_padded_binsearch = lazy_jit(_classify_binsearch_core)
+
+def _env_int(name, default):
+    """Tolerant env knob: a malformed value must never kill the CLI."""
+    try:
+        return int(os.environ.get(name, default))
+    except ValueError:
+        import logging
+
+        logging.getLogger("kart_tpu.ops").warning(
+            "ignoring malformed %s=%r", name, os.environ[name]
+        )
+        return default
+
+
+# below this row count the numpy twin beats any device round trip (and never
+# touches backend init / compile — a `kart diff` of a small repo must be
+# instant even when the accelerator is wedged or cold)
+DEVICE_MIN_ROWS = _env_int("KART_DEVICE_MIN_ROWS", 200_000)
+
+
 def classify_blocks(old_block, new_block):
     """FeatureBlock x2 -> (old_class np.int8 (n_old,), new_class (n_new,),
     counts dict). Host wrapper: unpads and returns numpy. Picks the kernel
     variant suited to the live backend (sort-join on accelerators, binary
     search on CPU) — identical results up to the sort path's 2^-64 oid-fold
-    collision (see _fold_oids). When no jax backend can
-    initialise (wedged accelerator tunnel) the numpy twin runs instead: the
-    CLI must always complete."""
+    collision (see _fold_oids). Small blocks and wedged/unavailable backends
+    take the numpy twin: the CLI must always complete, and quickly."""
     from kart_tpu.runtime import default_backend, jax_ready
 
-    if not jax_ready():
+    small = max(old_block.count, new_block.count) < DEVICE_MIN_ROWS
+    if small or not jax_ready():
         old_class, new_class = classify_blocks_reference(old_block, new_block)
         return (
             old_class,
@@ -198,10 +227,10 @@ def classify_blocks(old_block, new_block):
         else _classify_padded
     )
     old_class, new_class, _, counts = kernel(
-        jnp.asarray(old_block.keys),
-        jnp.asarray(old_block.oids),
-        jnp.asarray(new_block.keys),
-        jnp.asarray(new_block.oids),
+        old_block.keys,
+        old_block.oids,
+        new_block.keys,
+        new_block.oids,
         old_block.count,
         new_block.count,
     )
@@ -257,9 +286,13 @@ def changed_indices(old_class, new_class):
     )
 
 
-@jax.jit
-def columnar_equal(old_cols, new_cols, null_mask_old, null_mask_new):
+def _columnar_equal_core(old_cols, new_cols, null_mask_old, null_mask_new):
     """Row equality over aligned columnar attribute data (the working-copy
     compare, reference hot loop #2 base.py:722): all columns equal and same
     null pattern. cols: (C, N) arrays (numeric/hash-encoded), masks (C, N)."""
+    import jax.numpy as jnp
+
     return jnp.all((old_cols == new_cols) & (null_mask_old == null_mask_new), axis=0)
+
+
+columnar_equal = lazy_jit(_columnar_equal_core)

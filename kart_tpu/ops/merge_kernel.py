@@ -18,10 +18,9 @@ Per-key decision for versions a/o/t (absent = not present):
 Codes: 0 = KEEP_OURS, 1 = TAKE_THEIRS, 2 = CONFLICT.
 """
 
-import jax
-import jax.numpy as jnp
 import numpy as np
 
+from kart_tpu.ops._lazy import lazy_jit
 from kart_tpu.ops.blocks import PAD_KEY, bucket_size
 
 KEEP_OURS = 0
@@ -31,6 +30,8 @@ CONFLICT = 2
 
 def _join(version_keys, version_oids, version_count, union_keys):
     """For each union key: (present (bool), oid (5,) uint32 or 0)."""
+    import jax.numpy as jnp
+
     n = version_keys.shape[0]
     idx = jnp.searchsorted(version_keys, union_keys)
     idxc = jnp.minimum(idx, n - 1)
@@ -39,13 +40,14 @@ def _join(version_keys, version_oids, version_count, union_keys):
     return present, oids
 
 
-@jax.jit
-def _merge_classify_padded(
+def _merge_classify_padded_core(
     a_keys, a_oids, a_count,
     o_keys, o_oids, o_count,
     t_keys, t_oids, t_count,
     union_keys, union_count,
 ):
+    import jax.numpy as jnp
+
     union_valid = jnp.arange(union_keys.shape[0]) < union_count
     a_pres, a_oid = _join(a_keys, a_oids, a_count, union_keys)
     o_pres, o_oid = _join(o_keys, o_oids, o_count, union_keys)
@@ -80,6 +82,9 @@ def _merge_classify_padded(
     return decision, presence, n_conflicts, n_take_theirs
 
 
+_merge_classify_padded = lazy_jit(_merge_classify_padded_core)
+
+
 def merge_classify(ancestor_block, ours_block, theirs_block):
     """FeatureBlock x3 -> (union_keys (U,) int64 np, decision (U,) int8 np,
     presence (U,) int8 np with bits a=1/o=2/t=4, stats dict).
@@ -93,9 +98,12 @@ def merge_classify(ancestor_block, ours_block, theirs_block):
     union = np.union1d(np.union1d(a_real, o_real), t_real).astype(np.int64)
     u = len(union)
 
+    from kart_tpu.ops.diff_kernel import DEVICE_MIN_ROWS
     from kart_tpu.runtime import jax_ready
 
-    if not jax_ready():
+    # small merges never pay backend init / compile (same policy as
+    # classify_blocks — a 3-feature merge must be instant)
+    if u < DEVICE_MIN_ROWS or not jax_ready():
         decision, presence = _merge_classify_np(
             ancestor_block, ours_block, theirs_block, union
         )
@@ -114,13 +122,10 @@ def merge_classify(ancestor_block, ours_block, theirs_block):
     union_padded[:u] = union
 
     decision, presence, n_conf, n_theirs = _merge_classify_padded(
-        jnp.asarray(ancestor_block.keys), jnp.asarray(ancestor_block.oids),
-        ancestor_block.count,
-        jnp.asarray(ours_block.keys), jnp.asarray(ours_block.oids),
-        ours_block.count,
-        jnp.asarray(theirs_block.keys), jnp.asarray(theirs_block.oids),
-        theirs_block.count,
-        jnp.asarray(union_padded), u,
+        ancestor_block.keys, ancestor_block.oids, ancestor_block.count,
+        ours_block.keys, ours_block.oids, ours_block.count,
+        theirs_block.keys, theirs_block.oids, theirs_block.count,
+        union_padded, u,
     )
     return (
         union,

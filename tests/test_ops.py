@@ -206,3 +206,71 @@ def test_feature_block_from_dataset(tmp_path):
     assert block.padded_size == 1024
     assert block.keys[:50].tolist() == sorted(range(1, 51))
     assert not block.has_key_collisions()
+
+
+def test_jitted_kernels_match_reference_directly():
+    """The size threshold routes small classify_blocks calls to numpy — so
+    drive both jitted variants directly (they must stay bit-compatible with
+    the reference, modulo the sort path's documented 2^-64 oid fold)."""
+    from kart_tpu.ops.diff_kernel import (
+        _classify_padded,
+        _classify_padded_binsearch,
+    )
+
+    rng = np.random.default_rng(7)
+    n = 3000
+    pks = np.sort(rng.choice(np.arange(n * 3, dtype=np.int64), size=n, replace=False))
+    old_pairs = [(int(pk), f"{rng.integers(2**32):040x}") for pk in pks]
+    new_pairs = [
+        (pk, f"{rng.integers(2**32):040x}" if i % 9 == 0 else oid)
+        for i, (pk, oid) in enumerate(old_pairs)
+        if i % 7 != 0
+    ]
+    old = make_block(old_pairs)
+    new = make_block(new_pairs)
+    ref_old, ref_new = classify_blocks_reference(old, new)
+
+    for kernel in (_classify_padded, _classify_padded_binsearch):
+        oc, nc, _, counts = kernel(
+            old.keys, old.oids, new.keys, new.oids, old.count, new.count
+        )
+        np.testing.assert_array_equal(
+            np.asarray(oc)[: old.count], ref_old, err_msg=str(kernel)
+        )
+        np.testing.assert_array_equal(
+            np.asarray(nc)[: new.count], ref_new, err_msg=str(kernel)
+        )
+
+
+def test_bbox_jit_kernel_matches_reference_directly():
+    from kart_tpu.ops.bbox import bbox_intersects_jnp, pad_envelopes
+
+    rng = np.random.default_rng(3)
+    env = np.stack(
+        [
+            rng.uniform(-180, 170, 2000),
+            rng.uniform(-90, 80, 2000),
+            rng.uniform(-180, 180, 2000),
+            rng.uniform(-90, 90, 2000),
+        ],
+        axis=1,
+    )
+    env[:, 2] = np.maximum(env[:, 2], env[:, 0])  # mostly non-wrapping
+    env[:, 3] = np.maximum(env[:, 3], env[:, 1])
+    query = (-20.0, -20.0, 40.0, 30.0)
+    w, s, e, n, count = pad_envelopes(env)
+    got = np.asarray(
+        bbox_intersects_jnp(w, s, e, n, np.asarray(query, dtype=np.float32))
+    )[:count]
+    np.testing.assert_array_equal(got, bbox_intersects_np(env, query))
+
+
+def test_columnar_equal_jit():
+    from kart_tpu.ops.diff_kernel import columnar_equal
+
+    old = np.asarray([[1, 2, 3], [4, 5, 6]], dtype=np.int64)
+    new = np.asarray([[1, 9, 3], [4, 5, 6]], dtype=np.int64)
+    mask_o = np.zeros((2, 3), dtype=bool)
+    mask_n = np.zeros((2, 3), dtype=bool)
+    got = np.asarray(columnar_equal(old, new, mask_o, mask_n))
+    assert got.tolist() == [True, False, True]

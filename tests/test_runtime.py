@@ -74,9 +74,13 @@ def test_merge_classify_fallback_matches_reference(no_jax):
     assert presence[list(union).index(4)] == 1 | 4
 
 
-def test_merge_classify_fallback_matches_device_path(no_jax):
+def test_merge_classify_fallback_matches_device_path(no_jax, monkeypatch):
     """The numpy fallback must agree with the jitted kernel bit-for-bit; run
-    the same inputs through both (jit path via a fresh ready probe)."""
+    the same inputs through both (jit path via a fresh ready probe). The
+    small-input threshold is lowered so the second call genuinely jits."""
+    import kart_tpu.ops.diff_kernel as diff_kernel
+
+    monkeypatch.setattr(diff_kernel, "DEVICE_MIN_ROWS", 0)
     rng = np.random.default_rng(42)
     pks = rng.choice(10_000, size=300, replace=False)
     anc = _block({int(k): _oid(int(k)) for k in pks})
