@@ -206,6 +206,13 @@ class ObjectDb:
         """[(type, content)] -> [oid]; skips objects that already exist."""
         return [self.write_raw(t, c) for t, c in items]
 
+    def write_blobs(self, contents):
+        """list[bytes] -> list[hex oid]. Under bulk_pack the whole batch is
+        hashed+deflated in one native call (the import hot loop)."""
+        if self._bulk_writer is not None:
+            return self._bulk_writer.add_batch("blob", contents)
+        return [self.write_raw("blob", c) for c in contents]
+
     # -- typed access ------------------------------------------------------
 
     def read_blob(self, oid) -> bytes:

@@ -388,15 +388,9 @@ class PackWriter:
         self.pack_path = None
         self.idx_path = None
 
-    def add(self, obj_type, content):
-        """-> hex oid. Dedupes within this pack."""
-        header = b"%s %d\x00" % (obj_type.encode(), len(content))
-        sha = hashlib.sha1(header + content).digest()
-        if sha in self._seen:
-            return sha.hex()
-        offset = self._f.tell()
+    @staticmethod
+    def _record_head(obj_type, size):
         type_code = TYPE_CODES[obj_type]
-        size = len(content)
         byte0 = (type_code << 4) | (size & 0x0F)
         size >>= 4
         head = bytearray()
@@ -405,7 +399,37 @@ class PackWriter:
             byte0 = size & 0x7F
             size >>= 7
         head.append(byte0)
-        record = bytes(head) + zlib.compress(content, self.level)
+        return bytes(head)
+
+    def add(self, obj_type, content):
+        """-> hex oid. Dedupes within this pack."""
+        header = b"%s %d\x00" % (obj_type.encode(), len(content))
+        sha = hashlib.sha1(header + content).digest()
+        if sha in self._seen:  # skip the deflate, not just the write
+            return sha.hex()
+        stream = zlib.compress(content, self.level)
+        return self._append(obj_type, len(content), sha, stream)
+
+    def add_batch(self, obj_type, contents):
+        """-> list of hex oids. One native C++ call hashes and deflates the
+        whole batch (the import/commit data-path hot loop); per-object
+        Python when the native IO core isn't built — identical output."""
+        from kart_tpu import native
+
+        result = native.pack_objects_batch(obj_type, contents, self.level)
+        if result is None:
+            return [self.add(obj_type, c) for c in contents]
+        oids, streams = result
+        return [
+            self._append(obj_type, len(content), bytes(sha), stream)
+            for sha, content, stream in zip(oids, contents, streams)
+        ]
+
+    def _append(self, obj_type, size, sha, stream):
+        if sha in self._seen:
+            return sha.hex()
+        offset = self._f.tell()
+        record = self._record_head(obj_type, size) + stream
         self._f.write(record)
         self._entries.append((sha, crc32(record) & 0xFFFFFFFF, offset))
         self._seen[sha] = True

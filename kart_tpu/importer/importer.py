@@ -149,7 +149,7 @@ def _import_single_source(repo, tb, source, ds_path, *, log=None, capture=None):
             rel_paths = [
                 encoder.encode_pks_to_path(pk_values) for pk_values, _ in encoded
             ]
-        oids = [repo.odb.write_raw("blob", blob) for _, blob in encoded]
+        oids = repo.odb.write_blobs([blob for _, blob in encoded])
         tb.insert_many((prefix + rel for rel in rel_paths), oids)
         if capture is not None:
             if use_batch_paths:
