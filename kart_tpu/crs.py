@@ -341,6 +341,21 @@ class CRS:
                 if sargs and nums:
                     self.params[sargs[0].lower()] = float(nums[0])
 
+        # datum shift to WGS84 (WKT1 TOWGS84): 3- or 7-parameter Helmert,
+        # (dx, dy, dz[, rx, ry, rz, scale_ppm]); None = datum treated as
+        # WGS84-equivalent (the pre-round-2 behavior, within ~1m for modern
+        # datums)
+        self.towgs84 = None
+        tw = self.node.find("TOWGS84")
+        if tw is not None:
+            nums = [float(v) for v in tw.num_args()]
+            if len(nums) >= 3:
+                self.towgs84 = tuple((nums + [0.0] * 7)[:7])
+        datum = self.node.find("DATUM")
+        self.datum_name = (
+            datum.str_args()[0] if datum is not None and datum.str_args() else None
+        )
+
     @property
     def identifier_str(self):
         return get_identifier_str(self.node)
@@ -593,8 +608,83 @@ _PROJ_IMPLS = {
 }
 
 
+# -- datum shifts (7-parameter Helmert via geocentric coordinates) ----------
+
+
+def _geodetic_to_geocentric(a, e2, lon_deg, lat_deg):
+    lon = np.radians(lon_deg)
+    lat = np.radians(lat_deg)
+    sin_lat = np.sin(lat)
+    nu = a / np.sqrt(1 - e2 * sin_lat**2)
+    x = nu * np.cos(lat) * np.cos(lon)
+    y = nu * np.cos(lat) * np.sin(lon)
+    z = nu * (1 - e2) * sin_lat
+    return x, y, z
+
+
+def _geocentric_to_geodetic(a, e2, x, y, z):
+    lon = np.arctan2(y, x)
+    p = np.sqrt(x**2 + y**2)
+    # iterate latitude (converges to sub-mm in a few rounds)
+    lat = np.arctan2(z, p * (1 - e2))
+    for _ in range(6):
+        sin_lat = np.sin(lat)
+        nu = a / np.sqrt(1 - e2 * sin_lat**2)
+        lat = np.arctan2(z + e2 * nu * sin_lat, p)
+    return np.degrees(lon), np.degrees(lat)
+
+
+def _helmert(params, x, y, z, inverse=False):
+    """Position-vector 7-parameter transformation (EPSG 9606): rotations in
+    arc-seconds, scale in ppm. The method is sign-reversible: the inverse
+    applies the negated parameters (error ~ rotation², negligible at
+    arc-second scale)."""
+    if inverse:
+        params = tuple(-v for v in params)
+    dx, dy, dz, rx, ry, rz, s_ppm = params
+    arc = math.pi / (180.0 * 3600.0)
+    rx, ry, rz = rx * arc, ry * arc, rz * arc
+    m = 1.0 + s_ppm * 1e-6
+    nx = dx + m * (x - rz * y + ry * z)
+    ny = dy + m * (rz * x + y - rx * z)
+    nz = dz + m * (-ry * x + rx * y + z)
+    return nx, ny, nz
+
+
+_NULL_SHIFT = (0.0,) * 7
+
+
+def _e2_of(crs):
+    """Ellipsoid eccentricity²; inv_flattening == 0 encodes a sphere."""
+    if not crs.inv_flattening:
+        return 0.0
+    f = 1.0 / crs.inv_flattening
+    return f * (2 - f)
+
+
+def _datum_shift(src, dst, lon, lat):
+    """Geographic coordinates on src datum -> dst datum via WGS84, using the
+    CRSes' TOWGS84 parameters. No-op when the declared shifts are equal
+    (same datum under any name spelling, or both WGS84-equivalent)."""
+    src_tw = src.towgs84 if src.towgs84 != _NULL_SHIFT else None
+    dst_tw = dst.towgs84 if dst.towgs84 != _NULL_SHIFT else None
+    if src_tw == dst_tw:  # includes None == None
+        return lon, lat
+    if src.datum_name is not None and src.datum_name == dst.datum_name:
+        return lon, lat
+    x, y, z = _geodetic_to_geocentric(src.semi_major, _e2_of(src), lon, lat)
+    if src_tw is not None:
+        x, y, z = _helmert(src_tw, x, y, z)
+    if dst_tw is not None:
+        x, y, z = _helmert(dst_tw, x, y, z, inverse=True)
+    return _geocentric_to_geodetic(dst.semi_major, _e2_of(dst), x, y, z)
+
+
 class Transform:
-    """Vectorized coordinate transform between two CRS (datum shifts ignored)."""
+    """Vectorized coordinate transform between two CRS. Datum shifts are
+    applied when either side declares TOWGS84 (7-parameter Helmert, EPSG
+    9606); datums without one are treated as WGS84-equivalent (within ~1m
+    for modern datums — the envelope index pads by a buffer anyway)."""
 
     def __init__(self, src, dst):
         self.src = make_crs(src) if not isinstance(src, CRS) else src
@@ -623,6 +713,7 @@ class Transform:
         dst_impl = self._impl(self.dst)
         if src_impl is not None:
             xs, ys = src_impl[1](self.src, xs, ys)  # -> lon/lat
+        xs, ys = _datum_shift(self.src, self.dst, xs, ys)
         if dst_impl is not None:
             xs, ys = dst_impl[0](self.dst, xs, ys)  # lon/lat -> projected
         return xs, ys

@@ -180,6 +180,10 @@ def _import_shard(packed_args):
                 rows = cursor.fetchmany()
                 if not rows:
                     break
+                # encode the whole fetch batch, then hash+deflate it in one
+                # native call (PackWriter.add_batch); the leaf grouping walk
+                # below runs over precomputed oids
+                encoded = []
                 for row in rows:
                     feature = {
                         col.name: gpkg_adapter.value_to_v2(row[col.name], col)
@@ -188,10 +192,16 @@ def _import_shard(packed_args):
                     pk_values, blob = schema.encode_feature_blob(feature)
                     full = encoder.encode_pks_to_path(pk_values)
                     leaf_path, _, filename = full.rpartition("/")
+                    encoded.append((pk_values, blob, leaf_path, filename))
+                blob_oids = writer.add_batch(
+                    "blob", [blob for _, blob, _, _ in encoded]
+                )
+                for (pk_values, _, leaf_path, filename), blob_oid in zip(
+                    encoded, blob_oids
+                ):
                     if leaf_path != current_leaf:
                         flush_leaf()
                         current_leaf = leaf_path
-                    blob_oid = writer.add("blob", blob)
                     current_entries.append(
                         TreeEntry(filename, MODE_BLOB, blob_oid)
                     )

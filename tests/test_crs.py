@@ -148,3 +148,40 @@ def test_lcc_envelope_roundtrip():
     env = t.transform_envelope((600000, 800000, 6700000, 6900000))
     assert 0.5 < env[0] < env[1] < 5.0
     assert 47.0 < env[2] < env[3] < 50.0
+
+
+OSGB36_GEO = (
+    'GEOGCS["OSGB 1936",DATUM["OSGB_1936",'
+    'SPHEROID["Airy 1830",6377563.396,299.3249646],'
+    'TOWGS84[446.448,-125.157,542.06,0.15,0.247,0.842,-20.489]],'
+    'PRIMEM["Greenwich",0],UNIT["degree",0.0174532925199433],'
+    'AUTHORITY["EPSG","4277"]]'
+)
+WGS84_GEO = (
+    'GEOGCS["WGS 84",DATUM["WGS_1984",'
+    'SPHEROID["WGS 84",6378137,298.257223563]],PRIMEM["Greenwich",0],'
+    'UNIT["degree",0.0174532925199433],AUTHORITY["EPSG","4326"]]'
+)
+
+
+def test_towgs84_datum_shift():
+    """7-parameter Helmert (EPSG 9606) applied between datums: WGS84 ->
+    OSGB36 with the standard TOWGS84 moves a UK point by the published
+    ~100m, matching the OS Net example to single-transformation accuracy."""
+    t = Transform(WGS84_GEO, OSGB36_GEO)
+    lon, lat = t.transform(np.array([1.716073973]), np.array([52.658007833]))
+    assert abs(lon[0] - 1.7179229) < 5e-5   # ~+124m east
+    assert abs(lat[0] - 52.6575687) < 5e-5  # ~-49m south
+    # exact roundtrip (the method is sign-reversible)
+    inv = Transform(OSGB36_GEO, WGS84_GEO)
+    lon2, lat2 = inv.transform(lon, lat)
+    assert abs(lon2[0] - 1.716073973) < 1e-7
+    assert abs(lat2[0] - 52.658007833) < 1e-7
+
+
+def test_no_towgs84_means_wgs84_equivalent():
+    """Datums without a declared shift keep the old behavior: treated as
+    WGS84-equivalent (modern datums are within ~1m)."""
+    t = Transform("EPSG:4167", "EPSG:4326")  # NZGD2000 (no TOWGS84) -> WGS84
+    lon, lat = t.transform(np.array([173.0]), np.array([-41.0]))
+    assert lon[0] == 173.0 and lat[0] == -41.0
