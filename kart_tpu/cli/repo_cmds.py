@@ -84,6 +84,38 @@ def import_(ctx, sources, message, table, dest_path, replace_existing, no_checko
         _do_checkout(repo, "HEAD", force=True)
 
 
+def _commit_message_from_editor(repo_diff):
+    """No -m given: open $EDITOR on a template summarising the pending
+    changes; '#' lines are stripped, an empty result aborts (reference:
+    kart/commit.py:192-260)."""
+    lines = [
+        "",
+        "# Please enter the commit message for your changes.",
+        "# Lines starting with '#' will be ignored, and an empty",
+        "# message aborts the commit.",
+        "#",
+        "# Changes to be committed:",
+        "#",
+    ]
+    for ds_path in sorted(repo_diff):
+        ds_diff = repo_diff[ds_path]
+        n_features = len(ds_diff.get("feature") or ())
+        n_meta = len(ds_diff.get("meta") or ())
+        parts = []
+        if n_meta:
+            parts.append(f"{n_meta} meta item(s)")
+        if n_features:
+            parts.append(f"{n_features} feature(s)")
+        lines.append(f"#   {ds_path}: {', '.join(parts) or 'no changes'}")
+    text = click.edit("\n".join(lines) + "\n")
+    if text is None:
+        return None
+    stripped = "\n".join(
+        line for line in text.splitlines() if not line.startswith("#")
+    ).strip()
+    return stripped or None
+
+
 @cli.command()
 @click.option("--message", "-m", multiple=True, help="Commit message")
 @click.option(
@@ -111,7 +143,9 @@ def commit(ctx, message, allow_empty, filters):
 
     msg = "\n\n".join(message) if message else None
     if not msg:
-        raise CliError("Use --message/-m to provide a commit message")
+        msg = _commit_message_from_editor(repo_diff)
+    if not msg:
+        raise CliError("Aborting commit due to empty commit message")
     new_commit = target_rs.commit_diff(repo_diff, msg, allow_empty=allow_empty)
     wc.soft_reset_after_commit(repo.odb.read_commit(new_commit).tree, key_filter)
     commit_obj = repo.odb.read_commit(new_commit)

@@ -330,3 +330,25 @@ def test_reflog(repo_dir, runner):
     r = runner.invoke(cli, ["reflog"])
     assert r.exit_code == 0, r.output
     assert "HEAD@{0}" in r.output
+
+
+def test_commit_message_from_editor(repo_dir, runner, monkeypatch):
+    """Without -m, the commit message comes from $EDITOR; '#' template lines
+    are stripped and an empty message aborts."""
+    wc_edit(repo_dir, "DELETE FROM points WHERE fid = 7;")
+    editor = repo_dir / "fake-editor.sh"
+    editor.write_text('#!/bin/sh\necho "editor message" > "$1"\n')
+    editor.chmod(0o755)
+    monkeypatch.setenv("EDITOR", str(editor))
+    monkeypatch.setenv("VISUAL", str(editor))
+    r = runner.invoke(cli, ["commit"])
+    assert r.exit_code == 0, r.output
+    r = runner.invoke(cli, ["log"])
+    assert "editor message" in r.output
+
+    # empty message aborts
+    wc_edit(repo_dir, "DELETE FROM points WHERE fid = 8;")
+    editor.write_text('#!/bin/sh\nprintf "# only comments\\n" > "$1"\n')
+    r = runner.invoke(cli, ["commit"])
+    assert r.exit_code != 0
+    assert "empty commit message" in r.output
