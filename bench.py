@@ -181,6 +181,7 @@ def worker():
 
     cli = _cli_diff_bench()
     merge = _merge_bench()
+    bbox = _bbox_bench()
 
     print(
         json.dumps(
@@ -198,6 +199,7 @@ def worker():
                 "vs_reference_loop": round(dev_rate / ref_rate, 1),
                 **cli,
                 **merge,
+                **bbox,
             }
         )
     )
@@ -230,6 +232,85 @@ def _reference_loop_rate(b_old, b_new, slice_n):
             deltas.append((pk, "update", o_oid, n_oid))
     dt = time.perf_counter() - t0
     return slice_n / dt
+
+
+def _bbox_bench():
+    """BASELINE config #4: the spatially-filtered diff's bbox prefilter —
+    one query rectangle against N feature envelopes (Pallas on TPU, XLA
+    elsewhere) vs the numpy reference. Returns {} on any failure."""
+    import sys
+
+    try:
+        rows = int(os.environ.get("KART_BENCH_BBOX_ROWS", 10_000_000))
+        if rows <= 0:
+            return {}
+        import numpy as np
+
+        import jax
+
+        from kart_tpu.ops.bbox import (
+            bbox_intersects_jnp,
+            bbox_intersects_np,
+            bbox_intersects_pallas,
+            pad_envelopes,
+        )
+        from kart_tpu.runtime import default_backend
+
+        rng = np.random.default_rng(0)
+        env = np.stack(
+            [
+                rng.uniform(-180, 179, rows),
+                rng.uniform(-90, 89, rows),
+                rng.uniform(-180, 180, rows),
+                rng.uniform(-90, 90, rows),
+            ],
+            axis=1,
+        )
+        env[:, 2] = np.maximum(env[:, 2], env[:, 0])
+        env[:, 3] = np.maximum(env[:, 3], env[:, 1])
+        query = np.asarray((-20.0, -20.0, 40.0, 30.0), dtype=np.float32)
+
+        t0 = time.perf_counter()
+        ref = bbox_intersects_np(env, query)
+        np_s = time.perf_counter() - t0
+
+        w, s, e, n, count = pad_envelopes(env)
+        kernel = (
+            bbox_intersects_pallas
+            if default_backend() == "tpu"
+            else bbox_intersects_jnp
+        )
+        mask = kernel(w, s, e, n, query)  # compile + warm
+        got = np.asarray(mask)[:count]
+        assert (got == ref).all()
+
+        # end-to-end (host arrays in, host mask out: one partial-clone pass)
+        t0 = time.perf_counter()
+        got = np.asarray(kernel(w, s, e, n, query))
+        e2e_s = time.perf_counter() - t0
+
+        # kernel-only (device-resident envelopes, e.g. a repeatedly-queried
+        # table): excludes the host->HBM transfer the tunnel makes dominant
+        dw, ds_, de, dn = (jax.device_put(a) for a in (w, s, e, n))
+        jax.block_until_ready((dw, ds_, de, dn))
+        np.asarray(kernel(dw, ds_, de, dn, query))  # warm resident shapes
+        t0 = time.perf_counter()
+        for _ in range(3):
+            mask = kernel(dw, ds_, de, dn, query)
+        np.asarray(mask)
+        dev_s = (time.perf_counter() - t0) / 3
+
+        return {
+            "bbox_rows": rows,
+            "bbox_e2e_seconds": round(e2e_s, 4),
+            "bbox_kernel_seconds": round(dev_s, 4),
+            "bbox_envelopes_per_sec": round(rows / dev_s),
+            "bbox_numpy_seconds": round(np_s, 4),
+            "bbox_kernel_vs_numpy": round(np_s / dev_s, 1),
+        }
+    except Exception as e:  # pragma: no cover - bench resilience
+        print(f"bbox bench failed: {type(e).__name__}: {e}", file=sys.stderr)
+        return {}
 
 
 def _merge_bench():
