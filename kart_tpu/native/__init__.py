@@ -31,6 +31,52 @@ def _lib_path():
     return os.path.abspath(os.path.join(_NATIVE_DIR, _LIB_NAME))
 
 
+_autobuild_attempted = False
+
+
+def _run_make():
+    """Compile the native libraries, serialized across processes with a
+    lock file (the Makefile links via temp+rename, so readers never see a
+    half-written .so). Returns True when make reported success."""
+    makefile_dir = os.path.abspath(_NATIVE_DIR)
+    if not os.path.exists(os.path.join(makefile_dir, "Makefile")):
+        return False
+    lock_path = os.path.join(makefile_dir, ".build-lock")
+    try:
+        import fcntl
+
+        with open(lock_path, "w") as lock:
+            fcntl.flock(lock, fcntl.LOCK_EX)
+            result = subprocess.run(
+                ["make", "-C", makefile_dir, "-k"],
+                capture_output=True,
+                timeout=120,
+            )
+        if result.returncode != 0:
+            L.info(
+                "native build failed (rc=%d): %s",
+                result.returncode,
+                result.stderr.decode(errors="replace")[-2000:],
+            )
+            return False
+        return True
+    except Exception as e:  # no toolchain / no fcntl / timeout: stay Python
+        L.info("native build unavailable: %s", e)
+        return False
+
+
+def _autobuild():
+    """One attempt per process to compile the native libraries when a lib
+    file is missing (fresh checkouts): a few seconds of g++ buys the fast
+    paths for the rest of the process and every later one.
+    KART_NO_NATIVE_BUILD=1 disables."""
+    global _autobuild_attempted
+    if _autobuild_attempted or os.environ.get("KART_NO_NATIVE_BUILD") == "1":
+        return
+    _autobuild_attempted = True
+    _run_make()
+
+
 def load():
     """-> configured ctypes.CDLL, or None when unavailable."""
     global _lib, _load_attempted
@@ -38,6 +84,8 @@ def load():
         return _lib
     _load_attempted = True
     path = _lib_path()
+    if not os.path.exists(path) and not os.environ.get("KART_TPU_NATIVE_LIB"):
+        _autobuild()
     if not os.path.exists(path):
         return None
     try:
@@ -79,18 +127,7 @@ def ensure_built():
     global _load_attempted, _io_load_attempted
     if load() is not None and load_io() is not None:
         return _lib
-    makefile_dir = os.path.abspath(_NATIVE_DIR)
-    if os.path.exists(os.path.join(makefile_dir, "Makefile")):
-        try:
-            # -k: build whatever targets can build; load() below picks up
-            # any library that made it to disk
-            subprocess.run(
-                ["make", "-C", makefile_dir, "-k"],
-                capture_output=True,
-                timeout=120,
-            )
-        except (subprocess.SubprocessError, FileNotFoundError) as e:
-            L.info("native build unavailable: %s", e)
+    _run_make()
     _load_attempted = False
     _io_load_attempted = False
     load_io()
@@ -116,6 +153,8 @@ def load_io():
     path = override or os.path.abspath(
         os.path.join(_NATIVE_DIR, _IO_LIB_NAME)
     )
+    if not os.path.exists(path) and not override:
+        _autobuild()
     if not os.path.exists(path):
         return None
     try:
