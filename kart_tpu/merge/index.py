@@ -5,14 +5,25 @@ The reference serialises an entire libgit2 index (entries + `.conflicts/…` +
 already a written tree (the kernel emitted it before conflicts were known),
 so the index only needs the *conflicts* — each one a named
 ancestor/ours/theirs triple of (path, oid) entries — and the user's resolves.
-Stored as JSON in `<gitdir>/MERGE_INDEX`.
+
+Two encodings of `<gitdir>/MERGE_INDEX`, detected by content:
+  * JSON (human-inspectable) below _BINARY_THRESHOLD conflicts;
+  * a columnar binary block ("KMIX1") above it — a 1M-conflict merge
+    (BASELINE config #5) would otherwise write ~350MB of JSON and pay ~10s
+    of parsing on every `kart conflicts`/`kart resolve` invocation.
 """
 
 import json
+import struct
+
+import numpy as np
 
 from kart_tpu.core.repo import MERGE_INDEX
 
 VERSION_NAMES = ("ancestor", "ours", "theirs")
+
+_BINARY_THRESHOLD = 10_000
+_BINARY_MAGIC = b"KMIX1\n"
 
 
 class AncestorOursTheirs:
@@ -120,20 +131,131 @@ class MergeIndex:
         }
         return cls(body["mergedTree"], conflicts, resolves)
 
+    # -- binary encoding (columnar, for large conflict sets) ----------------
+
+    def _to_binary(self):
+        """KMIX1: magic, u32 header length, JSON header {mergedTree,
+        resolves, n}, then per column: u64 byte length + payload. Columns:
+        NUL-joined label bytes, then per version (a/o/t) a present mask,
+        (n,20) oids, and NUL-joined path bytes (empty for absent)."""
+        labels = list(self.conflicts.keys())
+        n = len(labels)
+        header = json.dumps(
+            {
+                "mergedTree": self.merged_tree,
+                "n": n,
+                "resolves": {
+                    label: [e.to_json() for e in entries]
+                    for label, entries in self.resolves.items()
+                },
+            }
+        ).encode()
+
+        blocks = ["\x00".join(labels).encode()]
+        aots = list(self.conflicts.values())
+        for name in VERSION_NAMES:
+            present = np.zeros(n, dtype=np.uint8)
+            oids = np.zeros((n, 20), dtype=np.uint8)
+            paths = []
+            for i, aot in enumerate(aots):
+                entry = aot.get(name)
+                if entry is not None:
+                    present[i] = 1
+                    oids[i] = np.frombuffer(bytes.fromhex(entry.oid), np.uint8)
+                    paths.append(entry.path)
+                else:
+                    paths.append("")
+            blocks += [
+                present.tobytes(),
+                oids.tobytes(),
+                "\x00".join(paths).encode(),
+            ]
+
+        out = [_BINARY_MAGIC, struct.pack("<I", len(header)), header]
+        for block in blocks:
+            out.append(struct.pack("<Q", len(block)))
+            out.append(block)
+        return b"".join(out)
+
+    @classmethod
+    def _from_binary(cls, raw):
+        pos = len(_BINARY_MAGIC)
+        (hlen,) = struct.unpack_from("<I", raw, pos)
+        pos += 4
+        header = json.loads(raw[pos : pos + hlen].decode())
+        pos += hlen
+        n = header["n"]
+
+        def block():
+            nonlocal pos
+            (blen,) = struct.unpack_from("<Q", raw, pos)
+            pos += 8
+            data = raw[pos : pos + blen]
+            pos += blen
+            return data
+
+        def unpack_strs(data_b):
+            return data_b.decode().split("\x00") if n else []
+
+        labels = unpack_strs(block())
+        versions = []
+        for _ in VERSION_NAMES:
+            present = np.frombuffer(block(), dtype=np.uint8)
+            oids = np.frombuffer(block(), dtype=np.uint8).reshape(n, 20)
+            paths = unpack_strs(block())
+            versions.append((present, oids, paths))
+
+        conflicts = {}
+        for i, label in enumerate(labels):
+            entries = []
+            for present, oids, paths in versions:
+                if present[i]:
+                    entries.append(ConflictEntry(paths[i], bytes(oids[i]).hex()))
+                else:
+                    entries.append(None)
+            conflicts[label] = AncestorOursTheirs(*entries)
+        resolves = {
+            label: [ConflictEntry.from_json(e) for e in entries]
+            for label, entries in header["resolves"].items()
+        }
+        return cls(header["mergedTree"], conflicts, resolves)
+
+    # -- repo persistence ----------------------------------------------------
+
     def write_to_repo(self, repo):
-        repo.write_gitdir_file(MERGE_INDEX, json.dumps(self.to_json()))
+        import os
+
+        path = repo.gitdir_file(MERGE_INDEX)
+        if len(self.conflicts) >= _BINARY_THRESHOLD:
+            tmp = path + f".tmp{os.getpid()}"
+            try:
+                with open(tmp, "wb") as f:
+                    f.write(self._to_binary())
+                os.replace(tmp, path)
+            except BaseException:
+                if os.path.exists(tmp):
+                    os.remove(tmp)
+                raise
+        else:
+            repo.write_gitdir_file(MERGE_INDEX, json.dumps(self.to_json()))
 
     @classmethod
     def read_from_repo(cls, repo):
-        text = repo.read_gitdir_file(MERGE_INDEX)
-        if text is None:
+        import os
+
+        path = repo.gitdir_file(MERGE_INDEX)
+        if not os.path.exists(path):
             from kart_tpu.core.repo import InvalidOperation
 
             raise InvalidOperation(
                 "Repository is in 'merging' state but MERGE_INDEX is missing - "
                 'run "kart merge --abort" to recover'
             )
-        return cls.from_json(json.loads(text))
+        with open(path, "rb") as f:
+            raw = f.read()
+        if raw.startswith(_BINARY_MAGIC):
+            return cls._from_binary(raw)
+        return cls.from_json(json.loads(raw.decode()))
 
     # -- resolution ----------------------------------------------------------
 

@@ -124,7 +124,9 @@ def ensure_built():
     """Build the libraries if a compiler is available; -> loaded sf lib or
     None. Each library is independent: a build failure of one (e.g. no zlib
     headers for the IO core) never blocks loading the other."""
-    global _load_attempted, _io_load_attempted
+    global _load_attempted, _io_load_attempted, _autobuild_attempted
+    # suppress load()'s own autobuild below: one make run per ensure_built
+    _autobuild_attempted = True
     if load() is not None and load_io() is not None:
         return _lib
     _run_make()

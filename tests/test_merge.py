@@ -337,3 +337,43 @@ class TestConflictMaterialisation:
         )
         assert len(conflicts) == 3
         assert all(label.startswith("ds:feature:") for label in conflicts)
+
+
+def test_merge_index_binary_roundtrip(tmp_path, monkeypatch):
+    """Above the threshold MERGE_INDEX is written as the columnar binary
+    format; reading detects the encoding and rebuilds identically."""
+    import kart_tpu.merge.index as index_mod
+    from kart_tpu.core.repo import KartRepo
+    from kart_tpu.merge.index import AncestorOursTheirs, ConflictEntry
+
+    monkeypatch.setattr(index_mod, "_BINARY_THRESHOLD", 3)
+    repo = KartRepo.init_repository(tmp_path / "r")
+    conflicts = {}
+    for i in range(5):
+        entry = lambda v: ConflictEntry(f"ds/.table-dataset/feature/aa/k{i}", f"{v:040x}")
+        conflicts[f"ds:feature:{i}"] = AncestorOursTheirs(
+            entry(i), entry(i + 1), None if i == 2 else entry(i + 2)
+        )
+    mi = MergeIndex("c" * 40, conflicts)
+    mi.add_resolve("ds:feature:1", [ConflictEntry("p", "d" * 40)])
+    mi.write_to_repo(repo)
+
+    raw = open(repo.gitdir_file("MERGE_INDEX"), "rb").read()
+    assert raw.startswith(b"KMIX1\n")
+
+    mi2 = MergeIndex.read_from_repo(repo)
+    assert mi2.merged_tree == mi.merged_tree
+    assert sorted(mi2.conflicts) == sorted(mi.conflicts)
+    assert mi2.conflicts["ds:feature:2"].theirs is None
+    got = mi2.conflicts["ds:feature:4"]
+    assert got.ours.path == conflicts["ds:feature:4"].ours.path
+    assert got.ours.oid == conflicts["ds:feature:4"].ours.oid
+    assert mi2.resolves["ds:feature:1"][0].oid == "d" * 40
+
+    # below the threshold stays JSON
+    monkeypatch.setattr(index_mod, "_BINARY_THRESHOLD", 1000)
+    mi.write_to_repo(repo)
+    raw = open(repo.gitdir_file("MERGE_INDEX"), "rb").read()
+    assert raw.lstrip().startswith(b"{")
+    mi3 = MergeIndex.read_from_repo(repo)
+    assert sorted(mi3.conflicts) == sorted(mi.conflicts)
