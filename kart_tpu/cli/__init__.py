@@ -48,6 +48,9 @@ _COMMANDS = {
     "serve": "kart_tpu.cli.remote_cmds",
     "spatial-filter": "kart_tpu.cli.spatial_cmds",
     "upgrade": "kart_tpu.cli.upgrade_cmds",
+    "upgrade-to-kart": "kart_tpu.cli.upgrade_cmds",
+    "upgrade-to-tidy": "kart_tpu.cli.upgrade_cmds",
+    "commit-files": "kart_tpu.cli.data_cmds",
     "build-annotations": "kart_tpu.cli.data_cmds",
 }
 

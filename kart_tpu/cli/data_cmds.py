@@ -6,6 +6,7 @@ import json
 import click
 
 from kart_tpu.cli import CliError, cli
+from kart_tpu.core.repo import KartRepoState
 from kart_tpu.diff.output import dump_json_output
 
 
@@ -269,3 +270,61 @@ def _feature_json(feature):
 
     pk = next(iter(feature.values()), None)
     return feature_as_json(feature, pk)
+
+
+@cli.command("commit-files")
+@click.option("--message", "-m", required=True, help="Commit message")
+@click.option("--ref", default="HEAD", help="Branch/ref to commit to")
+@click.option("--allow-empty", is_flag=True, help="Commit even with no changes")
+@click.option(
+    "--remove-empty-files",
+    is_flag=True,
+    help="KEY= (empty value) removes the file instead of writing it empty",
+)
+@click.argument("items", nargs=-1, required=True)
+@click.pass_obj
+def commit_files(ctx, message, ref, allow_empty, remove_empty_files, items):
+    """Commit arbitrary repository files: kart commit-files -m MSG KEY=VALUE...
+    (VALUE may be @filename; reference: kart/meta.py commit-files)."""
+    from kart_tpu.core.tree_builder import TreeBuilder
+
+    repo = ctx.require_state(KartRepoState.NORMAL)
+    parent_oid, ref_name = repo.resolve_refish(ref)
+    if parent_oid is None:
+        raise CliError(
+            "Using commit-files to create the initial commit is not supported"
+        )
+    # commit to the *resolved* ref (refs/heads/...), or HEAD itself —
+    # passing a bare branch name would write a stray gitdir/<name> file
+    commit_to = "HEAD" if ref == "HEAD" else ref_name
+    if commit_to is None:
+        raise CliError(f"{ref!r} does not name a ref that can be committed to")
+    parent = repo.odb.read_commit(parent_oid)
+
+    tb = TreeBuilder(repo.odb, parent.tree)
+    for item in items:
+        if "=" not in item:
+            raise CliError(f"Expected KEY=VALUE, got {item!r}")
+        key, _, value = item.partition("=")
+        if value.startswith("@"):
+            try:
+                with open(value[1:], "rb") as f:
+                    data = f.read()
+            except OSError as e:
+                raise CliError(f"Cannot read {value[1:]!r}: {e}")
+        else:
+            data = value.encode()
+        if remove_empty_files and not data:
+            tb.remove(key)
+        else:
+            tb.insert(key, repo.odb.write_blob(data))
+    new_tree = tb.flush()
+    if new_tree == parent.tree and not allow_empty:
+        raise CliError("No changes to commit")
+    new_commit = repo.create_commit(commit_to, new_tree, message, [parent_oid])
+    # keep the working copy's recorded tree in sync when HEAD moved
+    if commit_to == "HEAD" or repo.head_branch == commit_to:
+        wc = repo.working_copy
+        if wc is not None:
+            wc.reset(repo.structure(new_commit), force=True)
+    click.echo(f"Committed {new_commit[:7]}")

@@ -39,3 +39,111 @@ def upgrade(source, dest, in_place):
             click.echo(f"Upgraded {len(commit_map)} commits into {dest}")
     except (UpgradeError, RepoError) as e:
         raise CliError(str(e))
+
+
+@cli.command("upgrade-to-kart")
+@click.argument("source", type=click.Path(exists=True, file_okay=False))
+def upgrade_to_kart(source):
+    """Upgrade in-place a Sno-branded repository to Kart branding: the .sno
+    gitdir becomes .kart, sno.* config keys become kart.*, SNO_README.txt
+    becomes KART_README.txt, and the working copy is recreated with
+    kart-named state tables (reference: kart/upgrade upgrade-to-kart).
+    History is untouched."""
+    import os
+
+    from kart_tpu.core.repo import KartConfigKeys, KartRepo, RepoError
+
+    try:
+        repo = KartRepo(source)
+    except RepoError as e:
+        raise CliError(str(e))
+
+    gitdir = repo.gitdir
+    workdir = repo.workdir
+    basename = os.path.basename(gitdir)
+    if basename == ".kart":
+        raise CliError("Repository is already Kart-branded")
+    config = repo.config
+    if basename != ".sno" and config.get(
+        KartConfigKeys.SNO_REPOSTRUCTURE_VERSION
+    ) is None:
+        raise CliError("Repository is already Kart-branded")
+
+    # config keys first (the dir rename invalidates `repo`)
+    renames = {
+        KartConfigKeys.SNO_REPOSTRUCTURE_VERSION:
+            KartConfigKeys.KART_REPOSTRUCTURE_VERSION,
+        KartConfigKeys.SNO_WORKINGCOPY_PATH:
+            KartConfigKeys.KART_WORKINGCOPY_LOCATION,
+    }
+    for old_key, new_key in renames.items():
+        value = config.get(old_key)
+        if value is not None:
+            config[new_key] = value
+            del config[old_key]
+
+    if basename == ".sno":
+        new_gitdir = os.path.join(os.path.dirname(gitdir), ".kart")
+        os.rename(gitdir, new_gitdir)
+        KartRepo._write_locked_index(new_gitdir)
+
+    if workdir is not None:
+        old_readme = os.path.join(workdir, "SNO_README.txt")
+        if os.path.exists(old_readme):
+            os.rename(old_readme, os.path.join(workdir, "KART_README.txt"))
+
+    # recreate the working copy so its state tables use kart names (a
+    # sno-era WC has sno-named tables, which get_working_copy treats as
+    # uninitialised — hence allow_uncreated)
+    from kart_tpu.workingcopy import get_working_copy
+
+    repo = KartRepo(source)
+    wc = get_working_copy(repo, allow_uncreated=True)
+    if wc is not None and repo.head_commit_oid is not None:
+        structure = repo.structure("HEAD")
+        wc.create_and_initialise()
+        wc.write_full(structure, *structure.datasets)
+    click.echo(f"Upgraded {source} to Kart branding")
+
+
+@cli.command("upgrade-to-tidy")
+@click.argument("source", type=click.Path(exists=True, file_okay=False))
+def upgrade_to_tidy(source):
+    """Upgrade in-place a bare-style repository (gitdir contents directly in
+    the repo directory) to tidy-style (a .kart subdirectory), leaving
+    contents and version untouched (reference: kart/upgrade
+    upgrade-to-tidy)."""
+    import os
+
+    from kart_tpu.core.repo import KartRepo, RepoError
+
+    try:
+        repo = KartRepo(source)
+    except RepoError as e:
+        raise CliError(str(e))
+    if repo.workdir is not None:
+        raise CliError("Cannot upgrade in-place - repo is already tidy-style")
+    if repo.config.get_bool("core.bare"):
+        raise CliError(
+            "Repo is a true bare repo (core.bare=true), not bare-style; "
+            "tidy layout needs a working directory"
+        )
+
+    gitdir = repo.gitdir
+    new_gitdir = os.path.join(gitdir, ".kart")
+    os.makedirs(new_gitdir, exist_ok=False)
+    # move only git internals: user files (working-copy .gpkg, READMEs)
+    # stay at the top level, which becomes the workdir
+    internal = {
+        "objects", "refs", "logs", "HEAD", "config", "packed-refs",
+        "index", "shallow", "columnar", "annotations.db",
+        "feature_envelopes.db", "MERGE_HEAD", "MERGE_MSG", "MERGE_BRANCH",
+        "MERGE_INDEX", "info", "description", "hooks",
+    }
+    for name in os.listdir(gitdir):
+        if name in internal:
+            os.rename(os.path.join(gitdir, name), os.path.join(new_gitdir, name))
+    KartRepo._write_locked_index(new_gitdir)
+    repo = KartRepo(source)
+    repo.config["core.bare"] = "false"
+    click.echo(f"Upgraded {source} to tidy-style")

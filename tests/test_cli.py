@@ -352,3 +352,37 @@ def test_commit_message_from_editor(repo_dir, runner, monkeypatch):
     r = runner.invoke(cli, ["commit"])
     assert r.exit_code != 0
     assert "empty commit message" in r.output
+
+
+def test_commit_files(repo_dir, runner, tmp_path):
+    """kart commit-files commits arbitrary repo files (attachments, docs)."""
+    r = runner.invoke(
+        cli, ["commit-files", "-m", "add docs", "points/ABOUT.txt=hello"]
+    )
+    assert r.exit_code == 0, r.output
+    from kart_tpu.core.repo import KartRepo
+
+    repo = KartRepo(str(repo_dir))
+    tree = repo.structure("HEAD").tree
+    assert tree.get("points/ABOUT.txt").data == b"hello"
+
+    # @file values and removal
+    payload = tmp_path / "payload.bin"
+    payload.write_bytes(b"\x00\x01binary")
+    r = runner.invoke(
+        cli, ["commit-files", "-m", "binary", f"points/blob.bin=@{payload}"]
+    )
+    assert r.exit_code == 0, r.output
+    r = runner.invoke(
+        cli,
+        ["commit-files", "-m", "rm", "--remove-empty-files", "points/ABOUT.txt="],
+    )
+    assert r.exit_code == 0, r.output
+    repo = KartRepo(str(repo_dir))
+    tree = repo.structure("HEAD").tree
+    assert tree.get_or_none("points/ABOUT.txt") is None
+    assert tree.get("points/blob.bin").data == b"\x00\x01binary"
+
+    # no-op refuses without --allow-empty
+    r = runner.invoke(cli, ["commit-files", "-m", "noop", "points/blob.bin=@" + str(payload)])
+    assert r.exit_code != 0

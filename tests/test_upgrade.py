@@ -359,3 +359,54 @@ def test_upgrade_real_reference_archives(tmp_path, rel):
     ds = dest.datasets("HEAD")["nz_pa_points_topo_150k"]
     assert ds.feature_count == 2143
     assert ds.get_feature(1)["t50_fid"] == 2426271
+
+
+@needs_ref_fixtures
+def test_upgrade_to_kart_branding(tmp_path, cli_runner):
+    """A real Sno-era repo re-brands in place: .sno -> .kart, config keys
+    renamed, history untouched (reference: kart upgrade-to-kart)."""
+    import os
+
+    src = extract_ref_archive(tmp_path, "upgrade/v2.sno/points.tgz")
+    r = cli_runner.invoke(
+        __import__("kart_tpu.cli", fromlist=["cli"]).cli,
+        ["upgrade-to-kart", src],
+    )
+    assert r.exit_code == 0, r.output
+    assert os.path.isdir(os.path.join(src, ".kart"))
+    assert not os.path.isdir(os.path.join(src, ".sno"))
+    repo = KartRepo(src)
+    assert repo.head_commit_oid.startswith("0c64d82")
+    assert repo.version == 2  # branding only; V2->V3 is `kart upgrade`
+    # idempotence guard
+    r = cli_runner.invoke(
+        __import__("kart_tpu.cli", fromlist=["cli"]).cli,
+        ["upgrade-to-kart", src],
+    )
+    assert r.exit_code != 0
+
+
+def test_upgrade_to_tidy(tmp_path, cli_runner):
+    """A bare-style repo (gitdir contents at top level) becomes tidy-style."""
+    import os
+    import shutil
+
+    from helpers import make_imported_repo
+
+    repo, ds_path = make_imported_repo(tmp_path)
+    bare_dir = tmp_path / "barestyle"
+    shutil.copytree(repo.gitdir, bare_dir)
+    probe = KartRepo(str(bare_dir))
+    probe.config["core.bare"] = "false"
+    assert probe.workdir is None  # bare-style before
+
+    from kart_tpu.cli import cli as cli_group
+
+    r = __import__("click.testing", fromlist=["CliRunner"]).CliRunner().invoke(
+        cli_group, ["upgrade-to-tidy", str(bare_dir)]
+    )
+    assert r.exit_code == 0, r.output
+    assert os.path.isdir(bare_dir / ".kart")
+    tidied = KartRepo(str(bare_dir))
+    assert tidied.workdir is not None
+    assert tidied.datasets("HEAD")[ds_path].feature_count == 10
