@@ -34,6 +34,7 @@ _COMMANDS = {
     "gc": "kart_tpu.cli.ref_cmds",
     "fsck": "kart_tpu.cli.ref_cmds",
     "reflog": "kart_tpu.cli.ref_cmds",
+    "git": "kart_tpu.cli.ref_cmds",
     "data": "kart_tpu.cli.data_cmds",
     "query": "kart_tpu.cli.data_cmds",
     "meta": "kart_tpu.cli.data_cmds",

@@ -143,7 +143,9 @@ def meta_set(ctx, message, dataset, assignments):
     oid = structure.commit_diff(repo_diff, msg)
     wc = repo.working_copy
     if wc is not None:
-        wc.reset(repo.structure(oid), force=True)
+        # non-force: only the dataset whose meta changed is rewritten;
+        # uncommitted edits elsewhere survive
+        wc.reset(repo.structure(oid))
     click.echo(f"Commit {oid[:7]}")
 
 
@@ -294,11 +296,15 @@ def commit_files(ctx, message, ref, allow_empty, remove_empty_files, items):
         raise CliError(
             "Using commit-files to create the initial commit is not supported"
         )
-    # commit to the *resolved* ref (refs/heads/...), or HEAD itself —
-    # passing a bare branch name would write a stray gitdir/<name> file
+    # commit to the *resolved* ref, or HEAD itself — passing a bare branch
+    # name would write a stray gitdir/<name> file; and only branches may
+    # move (resolve_refish also matches tags/remote-tracking refs, which a
+    # commit must never silently repoint)
     commit_to = "HEAD" if ref == "HEAD" else ref_name
-    if commit_to is None:
-        raise CliError(f"{ref!r} does not name a ref that can be committed to")
+    if commit_to is None or (
+        commit_to != "HEAD" and not commit_to.startswith("refs/heads/")
+    ):
+        raise CliError(f"{ref!r} is not a branch that can be committed to")
     parent = repo.odb.read_commit(parent_oid)
 
     tb = TreeBuilder(repo.odb, parent.tree)
@@ -306,6 +312,10 @@ def commit_files(ctx, message, ref, allow_empty, remove_empty_files, items):
         if "=" not in item:
             raise CliError(f"Expected KEY=VALUE, got {item!r}")
         key, _, value = item.partition("=")
+        segments = key.split("/")
+        if not key or any(seg in ("", ".", "..") for seg in segments):
+            # an empty/"."/".." path segment would write a tree git rejects
+            raise CliError(f"Invalid repository path: {key!r}")
         if value.startswith("@"):
             try:
                 with open(value[1:], "rb") as f:
@@ -322,9 +332,11 @@ def commit_files(ctx, message, ref, allow_empty, remove_empty_files, items):
     if new_tree == parent.tree and not allow_empty:
         raise CliError("No changes to commit")
     new_commit = repo.create_commit(commit_to, new_tree, message, [parent_oid])
-    # keep the working copy's recorded tree in sync when HEAD moved
+    # keep the working copy's recorded tree in sync when HEAD moved —
+    # non-force: uncommitted WC edits survive (the commit touched no
+    # dataset features unless the user targeted one deliberately)
     if commit_to == "HEAD" or repo.head_branch == commit_to:
         wc = repo.working_copy
         if wc is not None:
-            wc.reset(repo.structure(new_commit), force=True)
+            wc.reset(repo.structure(new_commit))
     click.echo(f"Committed {new_commit[:7]}")

@@ -203,3 +203,27 @@ def reflog(ctx, ref):
     for i, entry in enumerate(reversed(entries)):
         new = entry.get("new") or "0" * 40
         click.echo(f"{new[:7]} {short}@{{{i}}}: {entry.get('message', '')}")
+
+
+@cli.command("git", context_settings={"ignore_unknown_options": True})
+@click.argument("args", nargs=-1, type=click.UNPROCESSED)
+@click.pass_obj
+def git_passthrough(ctx, args):
+    """Run a git command against this repository (reference: the raw-git
+    passthrough, kart/cli.py:211-305). The object store, refs, and packs
+    are git-compatible; the locked index deliberately stops stock git from
+    touching the working copy."""
+    import os
+    import shutil
+    import subprocess
+    import sys
+
+    git_bin = shutil.which("git")
+    if git_bin is None:
+        raise CliError("git is not installed on this system")
+    repo = ctx.repo
+    env = dict(os.environ, GIT_DIR=repo.gitdir)
+    if repo.workdir is not None:
+        env["GIT_WORK_TREE"] = repo.workdir
+    proc = subprocess.run([git_bin, *args], env=env)
+    sys.exit(proc.returncode)

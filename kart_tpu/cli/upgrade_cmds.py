@@ -139,6 +139,8 @@ def upgrade_to_tidy(source):
         "index", "shallow", "columnar", "annotations.db",
         "feature_envelopes.db", "MERGE_HEAD", "MERGE_MSG", "MERGE_BRANCH",
         "MERGE_INDEX", "info", "description", "hooks",
+        # state files stock git creates (kart git fetch/reset/...)
+        "FETCH_HEAD", "ORIG_HEAD", "COMMIT_EDITMSG", "branches",
     }
     for name in os.listdir(gitdir):
         if name in internal:

@@ -386,3 +386,41 @@ def test_commit_files(repo_dir, runner, tmp_path):
     # no-op refuses without --allow-empty
     r = runner.invoke(cli, ["commit-files", "-m", "noop", "points/blob.bin=@" + str(payload)])
     assert r.exit_code != 0
+
+
+def test_git_passthrough(repo_dir, runner, capfd):
+    """kart git runs system git against the repo — a live interop proof
+    that the object store, refs, and packs are git-compatible. git writes
+    to the real fds, hence capfd."""
+    import shutil
+
+    if shutil.which("git") is None:
+        pytest.skip("no system git")
+    r = runner.invoke(cli, ["git", "rev-parse", "HEAD"])
+    assert r.exit_code == 0
+    from kart_tpu.core.repo import KartRepo
+
+    assert capfd.readouterr().out.strip() == KartRepo(str(repo_dir)).head_commit_oid
+    r = runner.invoke(cli, ["git", "cat-file", "-t", "HEAD"])
+    assert r.exit_code == 0
+    assert capfd.readouterr().out.strip() == "commit"
+
+
+def test_commit_files_preserves_wc_edits_and_validates(repo_dir, runner):
+    """An uncommitted feature edit must survive commit-files (review
+    finding: force-reset wiped it), and malformed keys are rejected before
+    a corrupt tree is written."""
+    wc_edit(repo_dir, "UPDATE points SET name = 'keepme' WHERE fid = 6;")
+    r = runner.invoke(cli, ["commit-files", "-m", "docs", "ABOUT.txt=hi"])
+    assert r.exit_code == 0, r.output
+    r = runner.invoke(cli, ["diff"])
+    assert "keepme" in r.output  # edit survived
+
+    for bad in ("=x", "a//b=x", "../evil=x", "a/.=x"):
+        r = runner.invoke(cli, ["commit-files", "-m", "bad", bad])
+        assert r.exit_code != 0, bad
+
+    # tags must never be silently repointed
+    runner.invoke(cli, ["tag", "vtag"])
+    r = runner.invoke(cli, ["commit-files", "-m", "x", "--ref", "vtag", "a=b"])
+    assert r.exit_code != 0

@@ -367,11 +367,10 @@ def test_upgrade_to_kart_branding(tmp_path, cli_runner):
     renamed, history untouched (reference: kart upgrade-to-kart)."""
     import os
 
+    from kart_tpu.cli import cli
+
     src = extract_ref_archive(tmp_path, "upgrade/v2.sno/points.tgz")
-    r = cli_runner.invoke(
-        __import__("kart_tpu.cli", fromlist=["cli"]).cli,
-        ["upgrade-to-kart", src],
-    )
+    r = cli_runner.invoke(cli, ["upgrade-to-kart", src])
     assert r.exit_code == 0, r.output
     assert os.path.isdir(os.path.join(src, ".kart"))
     assert not os.path.isdir(os.path.join(src, ".sno"))
@@ -379,10 +378,7 @@ def test_upgrade_to_kart_branding(tmp_path, cli_runner):
     assert repo.head_commit_oid.startswith("0c64d82")
     assert repo.version == 2  # branding only; V2->V3 is `kart upgrade`
     # idempotence guard
-    r = cli_runner.invoke(
-        __import__("kart_tpu.cli", fromlist=["cli"]).cli,
-        ["upgrade-to-kart", src],
-    )
+    r = cli_runner.invoke(cli, ["upgrade-to-kart", src])
     assert r.exit_code != 0
 
 
@@ -400,11 +396,9 @@ def test_upgrade_to_tidy(tmp_path, cli_runner):
     probe.config["core.bare"] = "false"
     assert probe.workdir is None  # bare-style before
 
-    from kart_tpu.cli import cli as cli_group
+    from kart_tpu.cli import cli
 
-    r = __import__("click.testing", fromlist=["CliRunner"]).CliRunner().invoke(
-        cli_group, ["upgrade-to-tidy", str(bare_dir)]
-    )
+    r = cli_runner.invoke(cli, ["upgrade-to-tidy", str(bare_dir)])
     assert r.exit_code == 0, r.output
     assert os.path.isdir(bare_dir / ".kart")
     tidied = KartRepo(str(bare_dir))
