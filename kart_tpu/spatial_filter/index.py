@@ -29,7 +29,7 @@ L = logging.getLogger(__name__)
 DB_NAME = "feature_envelopes.db"
 
 _SCHEMA = """
-CREATE TABLE IF NOT EXISTS blobs (
+CREATE TABLE IF NOT EXISTS feature_envelopes (
     blob_id BLOB PRIMARY KEY,
     envelope BLOB NOT NULL
 ) WITHOUT ROWID;
@@ -58,25 +58,32 @@ class EnvelopeIndexReader:
         if not os.path.exists(path):
             return None
         try:
+            # a legacy-named db needs its table renamed before the
+            # read-only connection can query it (no-op otherwise)
+            rw = sqlite3.connect(path)
+            try:
+                _migrate_legacy_table(rw)
+            finally:
+                rw.close()
             return cls(path)
         except sqlite3.Error:
             return None
 
     def get(self, oid):
         row = self.con.execute(
-            "SELECT envelope FROM blobs WHERE blob_id = ?", (bytes.fromhex(oid),)
+            "SELECT envelope FROM feature_envelopes WHERE blob_id = ?", (bytes.fromhex(oid),)
         ).fetchone()
         if row is None:
             return None
         return self.codec.decode(row[0])
 
     def count(self):
-        return self.con.execute("SELECT COUNT(*) FROM blobs").fetchone()[0]
+        return self.con.execute("SELECT COUNT(*) FROM feature_envelopes").fetchone()[0]
 
     def all_envelopes(self):
         """-> (oids list[str], (N,4) float64 wsen array) — feeds the
         vectorized bbox kernel (kart_tpu.ops.bbox)."""
-        rows = self.con.execute("SELECT blob_id, envelope FROM blobs").fetchall()
+        rows = self.con.execute("SELECT blob_id, envelope FROM feature_envelopes").fetchall()
         oids = [r[0].hex() for r in rows]
         if not rows:
             return oids, np.empty((0, 4))
@@ -89,15 +96,33 @@ class EnvelopeIndexReader:
         self.con.close()
 
 
+def _migrate_legacy_table(con):
+    """Early builds named the envelope table 'blobs'; the reference (and now
+    this code) names it 'feature_envelopes'. Rename in place — without this,
+    the 'commits' anchor would claim everything is indexed while the new
+    table sat empty, and a filtered clone (which fails open on missing
+    envelope records) would silently ship every blob."""
+    names = {
+        r[0]
+        for r in con.execute(
+            "SELECT name FROM sqlite_master WHERE type = 'table'"
+        )
+    }
+    if "blobs" in names and "feature_envelopes" not in names:
+        con.execute("ALTER TABLE blobs RENAME TO feature_envelopes")
+        con.commit()
+
+
 def update_spatial_filter_index(repo, *, clear=False, dry_run=False):
     """Index feature envelopes of all commits reachable from any ref.
     Returns (features_indexed, commits_indexed).
     (reference: update_spatial_filter_index, kart/spatial_filter/index.py)"""
     con = sqlite3.connect(db_path(repo))
     try:
+        _migrate_legacy_table(con)
         con.executescript(_SCHEMA)
         if clear:
-            con.execute("DELETE FROM blobs")
+            con.execute("DELETE FROM feature_envelopes")
             con.execute("DELETE FROM commits")
             con.commit()
 
@@ -224,7 +249,7 @@ class _BatchedEnvelopeExtractor:
         )
         packed = self.codec.encode_batch(wsen)
         con.executemany(
-            "INSERT OR REPLACE INTO blobs (blob_id, envelope) VALUES (?, ?)",
+            "INSERT OR REPLACE INTO feature_envelopes (blob_id, envelope) VALUES (?, ?)",
             [
                 (bucket[i][0], packed[i].tobytes())
                 for i in range(len(bucket))
@@ -247,7 +272,7 @@ class _IndexedOidCache:
         if hit is None:
             hit = (
                 self.con.execute(
-                    "SELECT 1 FROM blobs WHERE blob_id = ?", (oid_bytes,)
+                    "SELECT 1 FROM feature_envelopes WHERE blob_id = ?", (oid_bytes,)
                 ).fetchone()
                 is not None
             )

@@ -15,6 +15,7 @@ from kart_tpu.spatial_filter.index import (
     update_spatial_filter_index,
 )
 
+from conftest import extract_ref_archive, needs_ref_fixtures
 from helpers import edit_commit, make_imported_repo
 
 POLY_100_105 = "POLYGON((100 -42, 105.5 -42, 105.5 -39, 100 -39, 100 -42))"
@@ -247,3 +248,72 @@ def test_cli_spatial_filter_commands(tmp_path, monkeypatch):
     )
     assert r.exit_code == 0, r.output
     assert "100.0000000,-42.0000000,105.5000000,-39.0000000" in r.output
+
+
+@needs_ref_fixtures
+def test_reference_built_envelope_index_interop(tmp_path):
+    """The reference's own prebuilt feature_envelopes.db (from its
+    polygons-with-feature-envelopes fixture) opens directly: same table
+    name, same 20-bit envelope codec, and the incremental indexer
+    recognises its commits anchor as up to date."""
+    src = extract_ref_archive(
+        tmp_path, "polygons-with-feature-envelopes.tgz"
+    )
+    from kart_tpu.core.repo import KartRepo
+    from kart_tpu.crs import CRS, Transform
+    from kart_tpu.spatial_filter import EPSG_4326_WKT
+    from kart_tpu.spatial_filter.index import (
+        EnvelopeIndexReader,
+        update_spatial_filter_index,
+    )
+
+    repo = KartRepo(src)
+    reader = EnvelopeIndexReader.open(repo)
+    assert reader is not None
+    oids, wsen = reader.all_envelopes()
+    assert len(oids) == 228
+    idx = dict(zip(oids, wsen))
+
+    (ds,) = list(repo.datasets("HEAD"))
+    crs_wkt = ds.get_crs_definition(ds.crs_identifiers()[0])
+    t = Transform(CRS(crs_wkt), EPSG_4326_WKT)
+    checked = 0
+    for path, entry in ds.feature_tree.walk_blobs():
+        if entry.oid not in idx:
+            continue
+        geom = ds.get_feature(path=path)[ds.geom_column_name]
+        if geom is None:
+            continue
+        x0, x1, y0, y1 = t.transform_envelope(geom.envelope())
+        w, s, e, n = idx[entry.oid]
+        # codec rounds outward (+ curvature buffer): reference envelopes
+        # must contain our recomputed ones
+        assert w <= x0 + 1e-3 and e >= x1 - 1e-3
+        assert s <= y0 + 1e-3 and n >= y1 - 1e-3
+        checked += 1
+        if checked >= 25:
+            break
+    assert checked == 25
+
+    n_feat, n_commits = update_spatial_filter_index(repo)
+    assert (n_feat, n_commits) == (0, 0)  # anchor recognised, no re-index
+
+
+def test_legacy_blobs_table_migrates(tmp_path):
+    """Early builds named the envelope table 'blobs'; opening or updating
+    such an index renames it instead of silently abandoning the data."""
+    import sqlite3
+
+    repo, ds_path = make_imported_repo(tmp_path, n=5)
+    n_feat, _ = update_spatial_filter_index(repo)
+    assert n_feat == 5
+    from kart_tpu.spatial_filter.index import db_path
+
+    con = sqlite3.connect(db_path(repo))
+    con.execute("ALTER TABLE feature_envelopes RENAME TO blobs")
+    con.commit()
+    con.close()
+
+    reader = EnvelopeIndexReader.open(repo)
+    assert reader is not None and reader.count() == 5
+    assert update_spatial_filter_index(repo) == (0, 0)  # still up to date
