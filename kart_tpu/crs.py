@@ -340,6 +340,21 @@ class CRS:
                 nums = p.num_args()
                 if sargs and nums:
                     self.params[sargs[0].lower()] = float(nums[0])
+            # Web-mercator WKT1 exports commonly claim Mercator_1SP but the
+            # method is the *spherical* pseudo-mercator. Recognise it by
+            # authority code, CRS name, or a PROJ4 EXTENSION forcing the
+            # sphere (+b == +a / +nadgrids=@null)
+            if (self.projection or "").lower() == "mercator_1sp":
+                ext = self.node.find("EXTENSION")
+                ext_text = " ".join(ext.str_args()) if ext is not None else ""
+                is_web_mercator = (
+                    str(self.code) in ("3857", "3785", "900913", "102100", "102113")
+                    or "pseudo-mercator" in (self.name or "").lower()
+                    or "+nadgrids=@null" in ext_text
+                    or "+b=6378137" in ext_text
+                )
+                if is_web_mercator:
+                    self.projection = "popular_visualisation_pseudo_mercator"
 
         # datum shift to WGS84 (WKT1 TOWGS84): 3- or 7-parameter Helmert,
         # (dx, dy, dz[, rx, ry, rz, scale_ppm]); None = datum treated as
@@ -512,17 +527,73 @@ def _tm_inverse(crs, x, y):
 
 
 def _webmerc_forward(crs, lon_deg, lat_deg):
+    """Spherical (web) mercator — EPSG 1024, used by 3857."""
     a = crs.semi_major
-    lon = np.radians(np.asarray(lon_deg, dtype=np.float64))
+    lon0 = math.radians(crs.params.get("central_meridian", 0.0))
+    fe = crs.params.get("false_easting", 0.0)
+    fn = crs.params.get("false_northing", 0.0)
+    lon = np.radians(np.asarray(lon_deg, dtype=np.float64)) - lon0
     lat = np.radians(np.clip(np.asarray(lat_deg, dtype=np.float64), -89.9999, 89.9999))
-    return a * lon, a * np.log(np.tan(np.pi / 4 + lat / 2))
+    return fe + a * lon, fn + a * np.log(np.tan(np.pi / 4 + lat / 2))
 
 
 def _webmerc_inverse(crs, x, y):
     a = crs.semi_major
-    lon = np.degrees(np.asarray(x, dtype=np.float64) / a)
-    lat = np.degrees(2 * np.arctan(np.exp(np.asarray(y, dtype=np.float64) / a)) - np.pi / 2)
+    lon0 = crs.params.get("central_meridian", 0.0)
+    fe = crs.params.get("false_easting", 0.0)
+    fn = crs.params.get("false_northing", 0.0)
+    lon = lon0 + np.degrees((np.asarray(x, dtype=np.float64) - fe) / a)
+    lat = np.degrees(
+        2 * np.arctan(np.exp((np.asarray(y, dtype=np.float64) - fn) / a)) - np.pi / 2
+    )
     return lon, lat
+
+
+def _mercator_k0(crs):
+    """1SP: explicit scale factor. 2SP: k0 = m(standard_parallel_1)."""
+    if "standard_parallel_1" in crs.params:
+        sp1 = math.radians(crs.params["standard_parallel_1"])
+        e2 = _e2_of(crs)
+        return math.cos(sp1) / math.sqrt(1 - e2 * math.sin(sp1) ** 2)
+    return crs.params.get("scale_factor", 1.0)
+
+
+def _mercator_forward(crs, lon_deg, lat_deg):
+    """Ellipsoidal Mercator (EPSG 9804 1SP / 9805 2SP) — e.g. EPSG:3832
+    PDC Mercator (central_meridian 150) and EPSG:3994."""
+    a = crs.semi_major
+    e = math.sqrt(_e2_of(crs))
+    k0 = _mercator_k0(crs)
+    lon0 = math.radians(crs.params.get("central_meridian", 0.0))
+    fe = crs.params.get("false_easting", 0.0)
+    fn = crs.params.get("false_northing", 0.0)
+    lon = np.radians(np.asarray(lon_deg, dtype=np.float64)) - lon0
+    lat = np.radians(np.clip(np.asarray(lat_deg, dtype=np.float64), -89.9999, 89.9999))
+    sin_lat = np.sin(lat)
+    x = fe + a * k0 * lon
+    y = fn + a * k0 * np.log(
+        np.tan(np.pi / 4 + lat / 2)
+        * ((1 - e * sin_lat) / (1 + e * sin_lat)) ** (e / 2)
+    )
+    return x, y
+
+
+def _mercator_inverse(crs, x, y):
+    a = crs.semi_major
+    e = math.sqrt(_e2_of(crs))
+    k0 = _mercator_k0(crs)
+    lon0 = crs.params.get("central_meridian", 0.0)
+    fe = crs.params.get("false_easting", 0.0)
+    fn = crs.params.get("false_northing", 0.0)
+    lon = lon0 + np.degrees((np.asarray(x, dtype=np.float64) - fe) / (a * k0))
+    t = np.exp(-(np.asarray(y, dtype=np.float64) - fn) / (a * k0))
+    lat = np.pi / 2 - 2 * np.arctan(t)
+    for _ in range(6):
+        sin_lat = np.sin(lat)
+        lat = np.pi / 2 - 2 * np.arctan(
+            t * ((1 - e * sin_lat) / (1 + e * sin_lat)) ** (e / 2)
+        )
+    return lon, np.degrees(lat)
 
 
 def _lcc_setup(crs):
@@ -600,7 +671,10 @@ def _lcc_inverse(crs, x, y):
 
 _PROJ_IMPLS = {
     "transverse_mercator": (_tm_forward, _tm_inverse),
-    "mercator_1sp": (_webmerc_forward, _webmerc_inverse),
+    "mercator_1sp": (_mercator_forward, _mercator_inverse),
+    "mercator_2sp": (_mercator_forward, _mercator_inverse),
+    "mercator": (_mercator_forward, _mercator_inverse),
+    "mercator_auxiliary_sphere": (_webmerc_forward, _webmerc_inverse),
     "popular_visualisation_pseudo_mercator": (_webmerc_forward, _webmerc_inverse),
     "lambert_conformal_conic_2sp": (_lcc_forward, _lcc_inverse),
     "lambert_conformal_conic_1sp": (_lcc_forward, _lcc_inverse),

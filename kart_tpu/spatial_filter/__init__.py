@@ -452,6 +452,12 @@ class _DatasetEnvelopeDecoder:
                 return None
             if transform == "identity":
                 return env
-            return transform.transform_envelope(env)
+            from kart_tpu.spatial_filter.index import wrap_lon
+
+            x0, x1, y0, y1 = transform.transform_envelope(env)
+            # same anti-meridian semantics as the built index: out-of-range
+            # lons wrap, possibly producing a cyclic (x0 > x1) envelope
+            # that _rect_overlaps evaluates cyclically
+            return (float(wrap_lon(x0)), float(wrap_lon(x1)), y0, y1)
         except Exception:
             return None

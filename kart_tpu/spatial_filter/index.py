@@ -96,6 +96,25 @@ class EnvelopeIndexReader:
         self.con.close()
 
 
+def wrap_lon(v):
+    """Longitudes past the date line wrap rather than clamp: a projected
+    envelope reaching lon 182 becomes part of a *cyclic* envelope (w > e),
+    which the codec stores as-is and every overlap test (host numpy, native
+    C++, device bbox kernel) evaluates cyclically — clamping would silently
+    drop the western span (reference anti-meridian handling,
+    kart/spatial_filter/index.py:639+). Non-finite values clamp to the
+    bounds instead of poisoning the whole batch."""
+    v = np.asarray(v, dtype=np.float64)
+    finite = np.isfinite(v)
+    with np.errstate(invalid="ignore"):
+        wrapped = np.where(
+            finite & ((v > 180.0) | (v < -180.0)),
+            ((v + 180.0) % 360.0) - 180.0,
+            v,
+        )
+        return np.where(finite, wrapped, np.clip(v, -180.0, 180.0))
+
+
 def _migrate_legacy_table(con):
     """Early builds named the envelope table 'blobs'; the reference (and now
     this code) names it 'feature_envelopes'. Rename in place — without this,
@@ -242,10 +261,11 @@ class _BatchedEnvelopeExtractor:
             n = np.maximum(y0, y1)
         else:
             w, e, s, n = envs[:, 0], envs[:, 1], envs[:, 2], envs[:, 3]
-        wsen = np.clip(
-            np.stack([w, s, e, n], axis=1),
-            [-180, -90, -180, -90],
-            [180, 90, 180, 90],
+
+        w = wrap_lon(w)
+        e = wrap_lon(e)
+        wsen = np.stack(
+            [w, np.clip(s, -90, 90), e, np.clip(n, -90, 90)], axis=1
         )
         packed = self.codec.encode_batch(wsen)
         con.executemany(

@@ -185,3 +185,45 @@ def test_no_towgs84_means_wgs84_equivalent():
     t = Transform("EPSG:4167", "EPSG:4326")  # NZGD2000 (no TOWGS84) -> WGS84
     lon, lat = t.transform(np.array([173.0]), np.array([-41.0]))
     assert lon[0] == 173.0 and lat[0] == -41.0
+
+
+def test_mercator_1sp_honours_central_meridian():
+    """EPSG:3832 (PDC Mercator, central_meridian 150): lon 180 maps 30deg
+    east of the projection origin — the round-1 implementation ignored the
+    central meridian, shifting the Pacific by 150 degrees."""
+    wkt_3832 = (
+        'PROJCS["WGS 84 / PDC Mercator",GEOGCS["WGS 84",DATUM["WGS_1984",'
+        'SPHEROID["WGS 84",6378137,298.257223563]],PRIMEM["Greenwich",0],'
+        'UNIT["degree",0.0174532925199433]],PROJECTION["Mercator_1SP"],'
+        'PARAMETER["central_meridian",150],PARAMETER["scale_factor",1],'
+        'PARAMETER["false_easting",0],PARAMETER["false_northing",0],'
+        'UNIT["metre",1],AUTHORITY["EPSG","3832"]]'
+    )
+    t = Transform("EPSG:4326", wkt_3832)
+    x, y = t.transform(np.array([180.0]), np.array([0.0]))
+    assert abs(x[0] - 6378137 * np.radians(30.0)) < 1.0
+    assert abs(y[0]) < 1e-6
+    inv = Transform(wkt_3832, "EPSG:4326")
+    lon, lat = inv.transform(x, y)
+    assert abs(lon[0] - 180.0) < 1e-9 and abs(lat[0]) < 1e-9
+
+
+def test_mercator_ellipsoidal_vs_web_spherical():
+    """Mercator_1SP on WGS84 is ellipsoidal; EPSG:3857 stays spherical
+    despite its WKT claiming Mercator_1SP. At lat 45 they differ by ~30km
+    in northing."""
+    wkt_merc = (
+        'PROJCS["World Mercator",GEOGCS["WGS 84",DATUM["WGS_1984",'
+        'SPHEROID["WGS 84",6378137,298.257223563]],PRIMEM["Greenwich",0],'
+        'UNIT["degree",0.0174532925199433]],PROJECTION["Mercator_1SP"],'
+        'PARAMETER["central_meridian",0],PARAMETER["scale_factor",1],'
+        'PARAMETER["false_easting",0],PARAMETER["false_northing",0],'
+        'UNIT["metre",1],AUTHORITY["EPSG","3395"]]'
+    )
+    t_ell = Transform("EPSG:4326", wkt_merc)
+    t_sph = Transform("EPSG:4326", "EPSG:3857")
+    _, y_ell = t_ell.transform(np.array([0.0]), np.array([45.0]))
+    _, y_sph = t_sph.transform(np.array([0.0]), np.array([45.0]))
+    # EPSG:3395 at lat 45: 5591295.92m (published); 3857: 5621521.49m
+    assert abs(y_ell[0] - 5591295.92) < 1.0
+    assert abs(y_sph[0] - 5621521.49) < 1.0

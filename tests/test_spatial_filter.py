@@ -317,3 +317,33 @@ def test_legacy_blobs_table_migrates(tmp_path):
     reader = EnvelopeIndexReader.open(repo)
     assert reader is not None and reader.count() == 5
     assert update_spatial_filter_index(repo) == (0, 0)  # still up to date
+
+
+@needs_ref_fixtures
+@pytest.mark.parametrize("rel", ["antimeridian-3832.tgz", "antimeridian-3994.tgz"])
+def test_antimeridian_fixture_envelope_index(tmp_path, rel):
+    """The reference's Pacific fixtures (PDC Mercator 3832 / 2SP Mercator
+    3994) index with correct longitudes: features near the date line land
+    at ±180, and envelopes straddling it are stored cyclically (w > e) —
+    not clamped."""
+    import numpy as np
+
+    src = extract_ref_archive(tmp_path, rel)
+    from kart_tpu.core.repo import KartRepo
+
+    repo = KartRepo(src)
+    n_feat, _ = update_spatial_filter_index(repo)
+    assert n_feat == 616
+    reader = EnvelopeIndexReader.open(repo)
+    oids, wsen = reader.all_envelopes()
+    lons = np.concatenate([wsen[:, 0], wsen[:, 2]])
+    assert lons.min() >= -180.0 and lons.max() <= 180.0
+    assert abs(lons).max() > 160.0  # Pacific data, near the date line
+    crossing = wsen[wsen[:, 0] > wsen[:, 2]]
+    assert len(crossing) == 2
+
+    # a query rect crossing the anti-meridian finds the crossing features
+    from kart_tpu.native import bbox_intersects
+
+    hits = bbox_intersects(wsen, (179.0, -60.0, -179.0, -45.0))
+    assert hits.sum() >= 2
