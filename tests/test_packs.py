@@ -376,3 +376,51 @@ def test_reference_fixture_string_pks(tmp_path, monkeypatch):
     assert len(features) == ds.feature_count > 0
     pk_col = ds.schema.pk_columns[0]
     assert all(isinstance(f[pk_col.name], str) for f in features[:10])
+
+
+@needs_fixtures
+def test_reference_fixture_all_types(tmp_path, monkeypatch):
+    """The types fixture exercises every V2/V3 data type through our decode
+    stack; known-answer values from the reference's own test data."""
+    from conftest import extract_ref_archive
+
+    src = extract_ref_archive(tmp_path, "types.tgz")
+    monkeypatch.chdir(src)
+    from kart_tpu.core.repo import KartRepo
+
+    repo = KartRepo(".")
+    (ds,) = list(repo.datasets("HEAD"))
+    assert ds.path == "manytypes"
+    f = next(iter(ds.features()))
+    assert f["int8"] == 0x12
+    assert f["int16"] == 0x1234
+    assert f["int32"] == 0x12345678
+    assert f["int64"] == 0x1234567890ABCDEF
+    assert f["float32"] == 32.03125
+    assert f["float64"] == 64.015625
+    assert f["text"] == "foo" and f["text100"] == "bar"
+    assert f["blob"].startswith(b"\x89PNG")
+    assert f["boolean"] is True
+    assert f["numeric10_5"] == "123.456"
+    assert f["date"] == "2000-01-01"
+    assert f["time"] == "18:19:20"
+    assert f["timestamp"] == "2000-01-01T11:12:13"
+    assert f["timestampUTC"] == "2001-01-01T18:19:20"
+    assert f["interval"] == "P3D"
+
+
+@needs_fixtures
+def test_reference_fixture_custom_crs(tmp_path, monkeypatch):
+    """Custom (non-EPSG) CRS identifiers round-trip through meta items."""
+    from conftest import extract_ref_archive
+
+    src = extract_ref_archive(tmp_path, "custom_crs.tgz")
+    monkeypatch.chdir(src)
+    from kart_tpu.core.repo import KartRepo
+
+    repo = KartRepo(".")
+    (ds,) = list(repo.datasets("HEAD"))
+    ids = ds.crs_identifiers()
+    assert ids == ["koordinates.com:100002"]
+    wkt = ds.get_crs_definition(ids[0])
+    assert "koordinates.com" in wkt or "NZGD2000" in wkt or len(wkt) > 100
