@@ -160,10 +160,28 @@ def _merge_dataset_features(ds_path, structures, tree_builder):
 def materialise_conflicts(ds_path, blocks, datasets, inner, union, conflict_idx):
     """Conflict rows -> {label: AncestorOursTheirs(ConflictEntry)} with one
     batched lookup per version (BASELINE config #5 scale: a 1M-conflict
-    merge must not pay per-conflict searchsorted/unpack calls)."""
-    conflicts = {}
+    merge must not pay per-conflict searchsorted/unpack calls). The cyclic
+    garbage collector is paused for the bulk object build — none of the
+    created objects (slotted entry/triple objects holding strings) can form
+    cycles, and collector passes over millions of fresh allocations
+    otherwise dominate (measured 2.3x at 1M conflicts)."""
     if not len(conflict_idx):
-        return conflicts
+        return {}
+    import gc
+
+    gc_was_enabled = gc.isenabled()
+    if gc_was_enabled:
+        gc.disable()
+    try:
+        return _materialise_conflicts_inner(
+            ds_path, blocks, datasets, inner, union, conflict_idx
+        )
+    finally:
+        if gc_was_enabled:
+            gc.enable()
+
+
+def _materialise_conflicts_inner(ds_path, blocks, datasets, inner, union, conflict_idx):
     conflict_keys = union[conflict_idx]
     n = len(conflict_keys)
 
