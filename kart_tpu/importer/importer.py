@@ -11,6 +11,7 @@ TreeBuilder spine rewrite. The commit object is written loose *after* the
 packs are fsync'd, so a crash mid-import never leaves a dangling ref.
 """
 
+import gc
 import time
 
 import numpy as np
@@ -19,7 +20,7 @@ from kart_tpu.core.structure import RepoStructure
 from kart_tpu.core.tree_builder import TreeBuilder
 from kart_tpu.models.dataset import Dataset3
 from kart_tpu.models.paths import encoder_for_schema
-from kart_tpu.utils import chunked
+from kart_tpu.utils import chunked, paused_gc
 
 BATCH_SIZE = 10000
 # below this, the tree-walk diff path is so cheap that a sidecar isn't worth
@@ -136,29 +137,39 @@ def _import_single_source(repo, tb, source, ds_path, *, log=None, capture=None):
 
     count = 0
     use_batch_paths = encoder.scheme == "int"
-    for batch in chunked(source.features(), BATCH_SIZE):
-        encoded = [schema.encode_feature_blob(f) for f in batch]
-        if use_batch_paths:
-            pks = np.fromiter(
-                (pk_values[0] for pk_values, _ in encoded),
-                dtype=np.int64,
-                count=len(encoded),
-            )
-            rel_paths = encoder.encode_paths_batch(pks)
-        else:
-            rel_paths = [
-                encoder.encode_pks_to_path(pk_values) for pk_values, _ in encoded
-            ]
-        oids = repo.odb.write_blobs([blob for _, blob in encoded])
-        tb.insert_many((prefix + rel for rel in rel_paths), oids)
-        if capture is not None:
+    # the streaming loop allocates short-lived, acyclic objects by the
+    # million: pause the cyclic collector (~8% measured). Source adapters
+    # may create cycles internally, so bound their growth with a manual
+    # collection every ~1M rows rather than trusting full acyclicity.
+    with paused_gc():
+        gc_batch = 0
+        for batch in chunked(source.features(), BATCH_SIZE):
+            gc_batch += 1
+            if gc_batch % 100 == 0:
+                gc.collect()
+            encoded = [schema.encode_feature_blob(f) for f in batch]
             if use_batch_paths:
-                capture.add_int_batch(pks, oids)
+                pks = np.fromiter(
+                    (pk_values[0] for pk_values, _ in encoded),
+                    dtype=np.int64,
+                    count=len(encoded),
+                )
+                rel_paths = encoder.encode_paths_batch(pks)
             else:
-                capture.add_path_batch(rel_paths, oids)
-        count += len(batch)
-        if log and count % 100000 == 0:
-            log(f"  {ds_path}: {count} features...")
+                rel_paths = [
+                    encoder.encode_pks_to_path(pk_values)
+                    for pk_values, _ in encoded
+                ]
+            oids = repo.odb.write_blobs([blob for _, blob in encoded])
+            tb.insert_many((prefix + rel for rel in rel_paths), oids)
+            if capture is not None:
+                if use_batch_paths:
+                    capture.add_int_batch(pks, oids)
+                else:
+                    capture.add_path_batch(rel_paths, oids)
+            count += len(batch)
+            if log and count % 100000 == 0:
+                log(f"  {ds_path}: {count} features...")
 
     # meta items that only exist after the feature stream has run (e.g.
     # generated-pks.json from PK synthesis)

@@ -176,39 +176,48 @@ def _import_shard(packed_args):
 
             cursor = con.execute(sql, (shard,))
             cursor.arraysize = 10000
-            while True:
-                rows = cursor.fetchmany()
-                if not rows:
-                    break
-                # encode the whole fetch batch, then hash+deflate it in one
-                # native call (PackWriter.add_batch); the leaf grouping walk
-                # below runs over precomputed oids
-                encoded = []
-                for row in rows:
-                    feature = {
-                        col.name: gpkg_adapter.value_to_v2(row[col.name], col)
-                        for col in schema.columns
-                    }
-                    pk_values, blob = schema.encode_feature_blob(feature)
-                    full = encoder.encode_pks_to_path(pk_values)
-                    leaf_path, _, filename = full.rpartition("/")
-                    encoded.append((pk_values, blob, leaf_path, filename))
-                blob_oids = writer.add_batch(
-                    "blob", [blob for _, blob, _, _ in encoded]
-                )
-                for (pk_values, _, leaf_path, filename), blob_oid in zip(
-                    encoded, blob_oids
-                ):
-                    if leaf_path != current_leaf:
-                        flush_leaf()
-                        current_leaf = leaf_path
-                    current_entries.append(
-                        TreeEntry(filename, MODE_BLOB, blob_oid)
+            import gc as _gc
+
+            from kart_tpu.utils import paused_gc
+
+            n_batches = 0
+            with paused_gc():
+                while True:
+                    rows = cursor.fetchmany()
+                    if not rows:
+                        break
+                    n_batches += 1
+                    if n_batches % 100 == 0:
+                        _gc.collect()  # bound any adapter-created cycles
+                    # encode the whole fetch batch, then hash+deflate it in one
+                    # native call (PackWriter.add_batch); the leaf grouping walk
+                    # below runs over precomputed oids
+                    encoded = []
+                    for row in rows:
+                        feature = {
+                            col.name: gpkg_adapter.value_to_v2(row[col.name], col)
+                            for col in schema.columns
+                        }
+                        pk_values, blob = schema.encode_feature_blob(feature)
+                        full = encoder.encode_pks_to_path(pk_values)
+                        leaf_path, _, filename = full.rpartition("/")
+                        encoded.append((pk_values, blob, leaf_path, filename))
+                    blob_oids = writer.add_batch(
+                        "blob", [blob for _, blob, _, _ in encoded]
                     )
-                    pks_out.append(pk_values[0])
-                    oids_out += bytes.fromhex(blob_oid)
-                    count += 1
-            flush_leaf()
+                    for (pk_values, _, leaf_path, filename), blob_oid in zip(
+                        encoded, blob_oids
+                    ):
+                        if leaf_path != current_leaf:
+                            flush_leaf()
+                            current_leaf = leaf_path
+                        current_entries.append(
+                            TreeEntry(filename, MODE_BLOB, blob_oid)
+                        )
+                        pks_out.append(pk_values[0])
+                        oids_out += bytes.fromhex(blob_oid)
+                        count += 1
+                flush_leaf()
     finally:
         con.close()
     import numpy as np
