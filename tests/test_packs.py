@@ -424,3 +424,80 @@ def test_reference_fixture_custom_crs(tmp_path, monkeypatch):
     assert ids == ["koordinates.com:100002"]
     wkt = ds.get_crs_definition(ids[0])
     assert "koordinates.com" in wkt or "NZGD2000" in wkt or len(wkt) > 100
+
+
+@needs_fixtures
+def test_reference_fixture_pk_second_column(tmp_path, monkeypatch):
+    """Primary key not in column position 0 (pk-second fixture): decode,
+    path encoding, and read-back all honour pk_index."""
+    from conftest import extract_ref_archive
+
+    src = extract_ref_archive(tmp_path, "pk-second.tgz")
+    monkeypatch.chdir(src)
+    from kart_tpu.core.repo import KartRepo
+
+    repo = KartRepo(".")
+    (ds,) = list(repo.datasets("HEAD"))
+    pk = ds.schema.pk_columns[0]
+    cols = [c.name for c in ds.schema.columns]
+    assert cols.index(pk.name) == 1
+    first = next(iter(ds.features()))
+    again = ds.get_feature([first[pk.name]])
+    assert again == first
+
+
+@needs_fixtures
+def test_import_3d_points_gpkg(tmp_path, monkeypatch, cli_runner):
+    """Z-coordinate geometries import with POINT Z schema and round-trip
+    has_z through the V3 codec (gpkg-3d-points fixture)."""
+    import os
+
+    from conftest import REF_DATA, extract_ref_archive
+
+    gpkg_dir = extract_ref_archive(tmp_path / "x", "gpkg-3d-points.tgz")
+    gpkg = os.path.join(gpkg_dir, "points-3d.gpkg")
+
+    from kart_tpu.cli import cli
+    from kart_tpu.core.repo import KartRepo
+
+    r = cli_runner.invoke(cli, ["init", str(tmp_path / "r")])
+    assert r.exit_code == 0, r.output
+    monkeypatch.chdir(tmp_path / "r")
+    KartRepo(".").config.set_many(
+        {"user.name": "T", "user.email": "t@example.com"}
+    )
+    r = cli_runner.invoke(cli, ["import", gpkg, "--no-checkout"])
+    assert r.exit_code == 0, r.output
+    (ds,) = list(KartRepo(".").datasets("HEAD"))
+    geom_col = ds.schema.first_geometry_column
+    assert geom_col.extra_type_info.get("geometryType") == "POINT Z"
+    f = next(iter(ds.features()))
+    g = f[ds.geom_column_name]
+    assert g.has_z
+    assert g.to_wkt().startswith("POINT Z ")
+
+
+@needs_fixtures
+@pytest.mark.parametrize(
+    "archive,datasets",
+    [
+        ("au-census", 2),
+        ("editing", 1),
+        ("empty-geometry", 2),
+        ("meta-updates", 1),
+    ],
+)
+def test_reference_fixture_fsck_clean(tmp_path, monkeypatch, cli_runner, archive, datasets):
+    """Every remaining reference repo fixture opens and passes a full fsck
+    (object hashes, refs, dataset decode)."""
+    from conftest import extract_ref_archive
+
+    src = extract_ref_archive(tmp_path, f"{archive}.tgz")
+    monkeypatch.chdir(src)
+    from kart_tpu.cli import cli
+    from kart_tpu.core.repo import KartRepo
+
+    assert len(list(KartRepo(".").datasets("HEAD"))) == datasets
+    r = cli_runner.invoke(cli, ["fsck"])
+    assert r.exit_code == 0, r.output
+    assert "No errors found" in r.output
