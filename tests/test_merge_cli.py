@@ -101,14 +101,15 @@ def test_merge_clean(repo_dir, runner):
 def test_merge_conflict_resolve_continue(repo_dir, runner):
     make_conflict(runner, repo_dir)
     r = runner.invoke(cli, ["merge", "alt"])
-    assert r.exit_code == 1
+    # entering merging state is success (reference exit-code semantics)
+    assert r.exit_code == 0
     assert "conflict" in r.output.lower()
 
     r = runner.invoke(cli, ["status"])
     assert r.exit_code == 0
 
     r = runner.invoke(cli, ["conflicts"])
-    assert r.exit_code == 1
+    assert r.exit_code == 0
     assert "points:feature:3" in r.output
 
     r = runner.invoke(cli, ["conflicts", "-o", "json"])
@@ -137,7 +138,7 @@ def test_merge_conflict_resolve_continue(repo_dir, runner):
 def test_merge_abort(repo_dir, runner):
     make_conflict(runner, repo_dir)
     r = runner.invoke(cli, ["merge", "alt"])
-    assert r.exit_code == 1
+    assert r.exit_code == 0
     r = runner.invoke(cli, ["merge", "--abort"])
     assert r.exit_code == 0, r.output
     con = wc_connect(repo_dir / "wc.gpkg")
@@ -213,9 +214,55 @@ def test_meta_conflict_renders_text_values(repo_dir, runner):
     meta_commit("ours title", "HEAD")
     meta_commit("theirs title", "refs/heads/alt")
     r = runner.invoke(cli, ["merge", "alt"])
-    assert r.exit_code == 1
+    assert r.exit_code == 0
     r = runner.invoke(cli, ["conflicts", "-o", "json"])
     body = json.loads(r.output)["kart.conflicts/v1"]
     assert body["points:meta:title"]["ours"] == "ours title"
     assert body["points:meta:title"]["theirs"] == "theirs title"
     assert body["points:meta:title"]["ancestor"] == "points title"
+
+
+@pytest.mark.parametrize(
+    "archive,layer,expected_pks",
+    [
+        ("points", "nz_pa_points_topo_150k", None),
+        ("polygons", "nz_waca_adjustments",
+         [98001, 1452332, 1456853, 1456912]),
+        ("table", "countiestbl", None),
+    ],
+)
+def test_reference_conflicts_scenarios(
+    tmp_path, monkeypatch, archive, layer, expected_pks
+):
+    """The reference's premade 3-way merge scenarios (ancestor/ours/theirs
+    branches): our merge engine finds exactly the conflicts the reference's
+    own tests expect (4 per scenario; polygons' pk set is asserted
+    verbatim), and resolving with --with=ours completes the merge."""
+    from conftest import REF_DATA, extract_ref_archive
+
+    if not os.path.isdir(os.path.join(REF_DATA, "conflicts")):
+        pytest.skip("reference fixtures not available")
+    src = extract_ref_archive(tmp_path, f"conflicts/{archive}.tgz")
+    monkeypatch.chdir(src)
+    runner = CliRunner()
+    r = runner.invoke(cli, ["merge", "theirs_branch"])
+    assert r.exit_code == 0, r.output
+    assert "4 conflicts" in r.output
+
+    r = runner.invoke(cli, ["conflicts", "-o", "json"])
+    assert r.exit_code == 0, r.output
+    body = json.loads(r.output)["kart.conflicts/v1"]
+    labels = sorted(body)
+    assert len(labels) == 4
+    assert all(label.startswith(f"{layer}:feature:") for label in labels)
+    if expected_pks is not None:
+        got = sorted(int(label.rsplit(":", 1)[1]) for label in labels)
+        assert got == sorted(expected_pks)
+
+    for label in labels:
+        r = runner.invoke(cli, ["resolve", label, "--with=ours"])
+        assert r.exit_code == 0, r.output
+    r = runner.invoke(cli, ["merge", "--continue", "-m", "merged"])
+    assert r.exit_code == 0, r.output
+    r = runner.invoke(cli, ["log", "--oneline"])
+    assert "merged" in r.output.splitlines()[0]
