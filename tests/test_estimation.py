@@ -120,3 +120,27 @@ def test_filtered_counts_dont_poison_annotation_cache(repo_with_edits):
         repo, base, target, accuracy="exact", ds_paths={ds_path}
     )
     assert again == {ds_path: full[ds_path]}
+
+
+def test_reference_annotations_db_compatible(tmp_path):
+    """The reference's own annotations.db files (empty and pre-created
+    schema) open and read/write through our DiffAnnotations — the table
+    layout is an interop contract."""
+    import os
+    import shutil
+
+    from conftest import REF_DATA
+    from helpers import make_imported_repo
+    from kart_tpu.annotations import DiffAnnotations
+
+    dbs = os.path.join(REF_DATA, "annotations-dbs")
+    if not os.path.isdir(dbs):
+        pytest.skip("reference fixtures not available")
+    repo, _ = make_imported_repo(tmp_path)
+    for name in ("empty.db", "empty-with-table.db"):
+        shutil.copy(
+            os.path.join(dbs, name), repo.gitdir_file("annotations.db")
+        )
+        ann = DiffAnnotations(repo)
+        ann.set("a...b", "feature-change-counts-veryfast", '{"n": 2}')
+        assert ann.get("a...b", "feature-change-counts-veryfast") == '{"n": 2}'
