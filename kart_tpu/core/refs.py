@@ -15,13 +15,43 @@ class RefError(ValueError):
     pass
 
 
+_BAD_REF_CHARS = re.compile(r"[\x00-\x20\x7f~^:?*\[\\]")
+
+
+def check_ref_format(ref, *, require_refs_prefix=False):
+    """Validate a ref name with git's check_refname_format rules (the subset
+    that matters for filesystem safety + wire hygiene). Raises RefError.
+
+    When ``require_refs_prefix`` is set, only ``refs/...`` names (and not
+    e.g. ``HEAD`` or ``config``) are accepted — receive-pack uses this so a
+    wire-supplied update can never touch arbitrary gitdir files.
+    """
+    if not ref:
+        raise RefError("empty ref name")
+    if require_refs_prefix and not ref.startswith("refs/"):
+        raise RefError(f"ref name must be under refs/: {ref!r}")
+    if ref.startswith("/") or ref.endswith("/") or "//" in ref:
+        raise RefError(f"bad ref name: {ref!r}")
+    if "@{" in ref or ".." in ref or _BAD_REF_CHARS.search(ref):
+        raise RefError(f"bad ref name: {ref!r}")
+    for component in ref.split("/"):
+        if not component or component.startswith(".") or component.endswith("."):
+            raise RefError(f"bad ref name: {ref!r}")
+        if component.endswith(".lock"):
+            raise RefError(f"bad ref name: {ref!r}")
+    return ref
+
+
 class RefStore:
     def __init__(self, gitdir):
         self.gitdir = gitdir
         self._packed_cache = None  # (mtime, {ref: oid})
 
     def _ref_path(self, ref):
-        assert not ref.startswith("/") and ".." not in ref, ref
+        # Sole barrier between externally-supplied ref names and filesystem
+        # writes under gitdir — must survive python -O, so no assert.
+        if ref.startswith("/") or ".." in ref:
+            raise RefError(f"unsafe ref name: {ref!r}")
         return os.path.join(self.gitdir, *ref.split("/"))
 
     def _packed_refs(self):
@@ -61,6 +91,9 @@ class RefStore:
         return value or None
 
     def set(self, ref, oid, log_message=None):
+        # One rule set everywhere: a ref the local repo can create must be a
+        # ref every peer can fetch (transport applies the same check).
+        check_ref_format(ref)
         old = self.get(ref)
         path = self._ref_path(ref)
         os.makedirs(os.path.dirname(path), exist_ok=True)

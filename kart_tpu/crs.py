@@ -600,9 +600,8 @@ def _lcc_setup(crs):
     """Shared constants for Lambert Conformal Conic (Snyder 1987, §15;
     EPSG methods 9801 1SP / 9802 2SP). 1SP is the 2SP degenerate case with
     both standard parallels at latitude_of_origin and k0 applied."""
-    a, inv_f = crs.semi_major, crs.inv_flattening
-    f = 1.0 / inv_f
-    e2 = f * (2 - f)
+    a = crs.semi_major
+    e2 = _e2_of(crs)  # treats inv_flattening == 0 as a sphere (e2 = 0)
     e = math.sqrt(e2)
 
     def m(phi):

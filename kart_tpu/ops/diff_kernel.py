@@ -14,9 +14,10 @@ flow, and static shapes. Two device kernels with identical semantics:
   streaming the folded oid through the sort beats a post-sort random gather
   of (n,5) oid rows ~2x: 2 linear passes over HBM (sort, scatter).
 - ``_classify_padded_binsearch``: a pair of ``searchsorted`` joins — faster
-  on CPU where binary search doesn't serialise. Semantically equal to the
-  sort path up to the 2^-64 per-pair oid-fold collision (see _fold_oids);
-  the numpy reference below compares full 160-bit oids.
+  on CPU where binary search doesn't serialise. Bit-identical to the sort
+  path: both compare full 160-bit oids (the sort path re-verifies its
+  64-bit fold matches via a monotonic partner gather), as does the numpy
+  reference below.
 
 Classes: 0 = unchanged, 1 = insert, 2 = update, 3 = delete.
 """
@@ -110,6 +111,20 @@ def _classify_mergesort_core(
     ).astype(jnp.int32)
     partner_full = jnp.zeros(total, jnp.int32).at[sg].set(partner_sorted)
     idx_in_new = partner_full[:n_old]
+
+    # Exactness restore: a pair the fold called equal is re-checked against
+    # the full 160-bit oids. Both blocks are key-sorted so idx_in_new is
+    # monotonic — this gather streams, unlike the random post-sort gather
+    # the fold exists to avoid. A fold collision therefore surfaces as an
+    # UPDATE instead of a silent diff miss.
+    full_eq = jnp.all(old_oids == new_oids[idx_in_new], axis=1)
+    collide = (
+        (old_class == UNCHANGED) & (jnp.arange(n_old) < old_count) & ~full_eq
+    )
+    old_class = jnp.where(collide, UPDATE, old_class).astype(jnp.int8)
+    new_class = new_class.at[jnp.where(collide, idx_in_new, 0)].max(
+        jnp.where(collide, UPDATE, 0).astype(jnp.int8)
+    )
 
     counts = jnp.stack(
         [
@@ -207,8 +222,9 @@ def classify_blocks(old_block, new_block):
     """FeatureBlock x2 -> (old_class np.int8 (n_old,), new_class (n_new,),
     counts dict). Host wrapper: unpads and returns numpy. Picks the kernel
     variant suited to the live backend (sort-join on accelerators, binary
-    search on CPU) — identical results up to the sort path's 2^-64 oid-fold
-    collision (see _fold_oids). Small blocks and wedged/unavailable backends
+    search on CPU) — bit-identical results (the sort path host-verifies its
+    oid fold against full oids on device). Small blocks and wedged/
+    unavailable backends
     take the numpy twin: the CLI must always complete, and quickly."""
     from kart_tpu.runtime import default_backend, jax_ready
 

@@ -262,6 +262,28 @@ class _BatchedEnvelopeExtractor:
         else:
             w, e, s, n = envs[:, 0], envs[:, 1], envs[:, 2], envs[:, 3]
 
+        # A transformed span >= 180° is ambiguous after endpoint-wise
+        # wrapping (a world-spanning feature in e.g. EPSG:3832 wraps
+        # -30..330 to -30..-30 — a sliver that would silently veto the
+        # feature from filtered clones). The reference gives up on such
+        # envelopes (transform_minmax_envelope returns None) so the blob
+        # ships; match that by skipping the index record — filtered clone
+        # fails open on missing records.
+        with np.errstate(invalid="ignore"):
+            keep = ~((e - w) >= 180.0)
+        # Any non-finite endpoint (reprojection out of domain) also fails
+        # open: wrap_lon/clip leave NaN as NaN and the codec rejects it.
+        keep &= (
+            np.isfinite(w) & np.isfinite(e) & np.isfinite(s) & np.isfinite(n)
+        )
+        if not keep.all():
+            # Subset BEFORE encoding — one bad feature must not abort the
+            # whole bucket (encode_batch raises on any NaN row).
+            (idx,) = np.nonzero(keep)
+            w, e, s, n = w[idx], e[idx], s[idx], n[idx]
+            bucket = [bucket[i] for i in idx]
+        if not bucket:
+            return
         w = wrap_lon(w)
         e = wrap_lon(e)
         wsen = np.stack(

@@ -43,6 +43,7 @@ from urllib.parse import urlsplit
 from urllib.request import Request, urlopen
 
 from kart_tpu.core.odb import ObjectMissing
+from kart_tpu.core.refs import RefError, check_ref_format
 from kart_tpu.transport.pack import read_pack, write_pack
 from kart_tpu.transport.protocol import ObjectEnumerator
 
@@ -335,6 +336,14 @@ class KartRequestHandler(BaseHTTPRequestHandler):
             updates = header.get("updates", [])
             for upd in updates:
                 ref, old, new = upd["ref"], upd.get("old"), upd.get("new")
+                # wire-supplied names must be real refs — git's receive-pack
+                # rejects non-refs/ names via check_refname_format; without
+                # this a push with ref='config' or 'HEAD' would overwrite
+                # arbitrary gitdir files.
+                try:
+                    check_ref_format(ref, require_refs_prefix=True)
+                except RefError as e:
+                    return self._json(400, {"error": str(e)})
                 if deny_current and ref == self._current_branch_ref():
                     return self._json(
                         409,

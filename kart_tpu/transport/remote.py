@@ -14,9 +14,11 @@ would use.
 """
 
 import os
+import sys
 import tempfile
 
 from kart_tpu.core.odb import ObjectMissing
+from kart_tpu.core.refs import RefError, check_ref_format
 from kart_tpu.core.repo import KartRepo, KartConfigKeys, NotFound
 from kart_tpu.transport.pack import read_pack, write_pack
 from kart_tpu.transport.protocol import ObjectEnumerator
@@ -249,16 +251,36 @@ def fetch(repo, remote_name="origin", *, depth=None, filter_spec=None, quiet=Tru
         )
 
     updated = {}
+    skipped = []
     for branch, oid in branch_tips.items():
         local_ref = f"refs/remotes/{remote_name}/{branch}"
+        # Server-supplied names get the same refname-format rules the
+        # receive-pack side enforces — a hostile/buggy server must not be
+        # able to plant 'x.lock'/'..'/control-char names under refs/.
+        try:
+            check_ref_format(local_ref, require_refs_prefix=True)
+        except RefError:
+            skipped.append(branch)
+            continue
         if repo.refs.get(local_ref) != oid:
             repo.refs.set(local_ref, oid, log_message=f"fetch {remote_name}")
             updated[local_ref] = oid
     for tag, oid in tag_tips.items():
         local_ref = f"refs/tags/{tag}"
+        try:
+            check_ref_format(local_ref, require_refs_prefix=True)
+        except RefError:
+            skipped.append(tag)
+            continue
         if repo.refs.get(local_ref) is None:
             repo.refs.set(local_ref, oid, log_message=f"fetch {remote_name}")
             updated[local_ref] = oid
+    if skipped:
+        print(
+            f"warning: ignored {len(skipped)} invalid remote ref name(s): "
+            + ", ".join(repr(s) for s in skipped[:5]),
+            file=sys.stderr,
+        )
 
     _update_shallow(repo, shallow_boundary)
 

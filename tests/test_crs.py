@@ -227,3 +227,31 @@ def test_mercator_ellipsoidal_vs_web_spherical():
     # EPSG:3395 at lat 45: 5591295.92m (published); 3857: 5621521.49m
     assert abs(y_ell[0] - 5591295.92) < 1.0
     assert abs(y_sph[0] - 5621521.49) < 1.0
+
+
+LCC_SPHERE = (
+    'PROJCS["test LCC sphere",GEOGCS["sphere",DATUM["sphere",'
+    'SPHEROID["sphere",6370997,0]],'
+    'PRIMEM["Greenwich",0],UNIT["degree",0.0174532925199433]],'
+    'PROJECTION["Lambert_Conformal_Conic_2SP"],'
+    'PARAMETER["standard_parallel_1",33],PARAMETER["standard_parallel_2",45],'
+    'PARAMETER["latitude_of_origin",23],PARAMETER["central_meridian",-96],'
+    'PARAMETER["false_easting",0],PARAMETER["false_northing",0],UNIT["metre",1]]'
+)
+SPHERE_GEO = (
+    'GEOGCS["sphere",DATUM["sphere",SPHEROID["sphere",6370997,0]],'
+    'PRIMEM["Greenwich",0],UNIT["degree",0.0174532925199433]]'
+)
+
+
+def test_lcc_spherical_ellipsoid_no_crash():
+    """LCC on SPHEROID[...,0] (a sphere) raised ZeroDivisionError before the
+    r2 advisor fix; it must behave like the e=0 degenerate case and
+    round-trip cleanly."""
+    t = Transform(SPHERE_GEO, LCC_SPHERE)
+    x, y = t.transform(np.array([-75.0]), np.array([35.0]))
+    assert np.all(np.isfinite(x)) and np.all(np.isfinite(y))
+    inv = Transform(LCC_SPHERE, SPHERE_GEO)
+    lon, lat = inv.transform(x, y)
+    assert abs(lon[0] + 75.0) < 1e-7
+    assert abs(lat[0] - 35.0) < 1e-7

@@ -230,3 +230,71 @@ def test_push_to_checked_out_branch_refused(tmp_path):
     finally:
         server.shutdown()
         server.server_close()
+
+
+def test_receive_pack_rejects_non_refs_names(served_repo):
+    """git's receive-pack refuses ref names outside refs/ via
+    check_refname_format; without that a push update with ref='config' or
+    'HEAD' would overwrite arbitrary gitdir files (r2 advisor, medium)."""
+    from kart_tpu.transport.http import HttpRemote, HttpTransportError
+
+    repo, ds_path, url = served_repo
+    http = HttpRemote(url)
+    oid = repo.head_commit_oid
+    config_before = open(repo.gitdir_file("config")).read()
+    head_before = open(repo.gitdir_file("HEAD")).read()
+    for bad in (
+        "config",
+        "HEAD",
+        "refs/../config",
+        "refs/heads/x.lock",
+        "refs/heads/.hidden",
+        "refs/heads/a..b",
+        "refs/heads/sp ace",
+        "refs/heads/",
+    ):
+        with pytest.raises(HttpTransportError):
+            http.receive_pack(
+                [], [{"ref": bad, "old": None, "new": oid, "force": True}]
+            )
+    assert open(repo.gitdir_file("config")).read() == config_before
+    assert open(repo.gitdir_file("HEAD")).read() == head_before
+
+
+def test_check_ref_format_unit():
+    from kart_tpu.core.refs import RefError, check_ref_format
+
+    assert check_ref_format("refs/heads/main") == "refs/heads/main"
+    assert check_ref_format("refs/tags/v1.0") == "refs/tags/v1.0"
+    assert check_ref_format("HEAD") == "HEAD"  # fine without the prefix rule
+    with pytest.raises(RefError):
+        check_ref_format("HEAD", require_refs_prefix=True)
+    for bad in (
+        "",
+        "refs//x",
+        "refs/heads/ok/",
+        "/refs/heads/x",
+        "refs/heads/a..b",
+        "refs/heads/x.lock",
+        "refs/heads/.dot",
+        "refs/heads/dot.",
+        "refs/heads/a@{b}",
+        "refs/heads/a^b",
+        "refs/heads/a:b",
+        "refs/heads/tab\tx",
+    ):
+        with pytest.raises(RefError):
+            check_ref_format(bad)
+
+
+def test_refstore_rejects_traversal_without_assert():
+    """The traversal guard must be a real raise (asserts vanish under
+    python -O and this is the sole barrier between wire names and gitdir
+    writes)."""
+    from kart_tpu.core.refs import RefError, RefStore
+
+    store = RefStore("/nonexistent-gitdir")
+    with pytest.raises(RefError):
+        store.get("../../etc/passwd")
+    with pytest.raises(RefError):
+        store.get("/abs")

@@ -274,3 +274,43 @@ def test_columnar_equal_jit():
     mask_n = np.zeros((2, 3), dtype=bool)
     got = np.asarray(columnar_equal(old, new, mask_o, mask_n))
     assert got.tolist() == [True, False, True]
+
+
+def test_sort_kernel_detects_oid_fold_collision():
+    """The sort path streams a 64-bit fold of each oid through the sort, then
+    re-verifies fold-equal pairs against the full 160-bit oids (ADVICE r2:
+    without that, a fold collision silently classified a changed feature as
+    unchanged). Construct a real collision: for any a0, the oid
+    [a0, 0, lo32(a0*C1), hi32(a0*C1), 0] folds to 0 — as does the all-zero
+    oid — so these two *different* oids under one key must classify UPDATE."""
+    from kart_tpu.ops.diff_kernel import _classify_padded, _fold_oids
+
+    C1 = 0x9E3779B97F4A7C15
+    a0 = 0xDEADBEEF
+    m = (a0 * C1) % (1 << 64)
+    oid_a = np.zeros((1, 5), dtype=np.uint32)
+    oid_b = np.array(
+        [[a0, 0, m & 0xFFFFFFFF, m >> 32, 0]], dtype=np.uint32
+    )
+    assert not np.array_equal(oid_a, oid_b)
+
+    import jax.numpy as jnp
+
+    folds_a = np.asarray(_fold_oids(jnp.asarray(oid_a)))
+    folds_b = np.asarray(_fold_oids(jnp.asarray(oid_b)))
+    assert folds_a[0] == folds_b[0] == 0  # genuine fold collision
+
+    pad = 1024
+    keys = np.full(pad, 2**62, dtype=np.int64)
+    keys[0] = 7
+    oids = np.zeros((pad, 5), dtype=np.uint32)
+    old_oids = oids.copy()
+    old_oids[0] = oid_a[0]
+    new_oids = oids.copy()
+    new_oids[0] = oid_b[0]
+    oc, nc, _, counts = _classify_padded(
+        keys, old_oids, keys, new_oids, 1, 1
+    )
+    assert int(np.asarray(oc)[0]) == UPDATE
+    assert int(np.asarray(nc)[0]) == UPDATE
+    assert np.asarray(counts).tolist() == [0, 1, 0]

@@ -347,3 +347,32 @@ def test_antimeridian_fixture_envelope_index(tmp_path, rel):
 
     hits = bbox_intersects(wsen, (179.0, -60.0, -179.0, -45.0))
     assert hits.sum() >= 2
+
+
+def test_world_spanning_envelope_not_indexed():
+    """A transformed envelope whose longitude span reaches >= 180 deg is
+    ambiguous after endpoint-wise wrapping (e.g. EPSG:3832 lon -30..330
+    wraps to a sliver) — the indexer must skip it so the blob fails open on
+    filtered clones, matching the reference's transform_minmax_envelope
+    giving up (reference kart/spatial_filter/index.py:639+)."""
+    import sqlite3
+
+    from kart_tpu.ops.envelope_codec import EnvelopeCodec
+    from kart_tpu.spatial_filter.index import _BatchedEnvelopeExtractor, _SCHEMA
+
+    con = sqlite3.connect(":memory:")
+    con.executescript(_SCHEMA)
+    extractor = _BatchedEnvelopeExtractor.__new__(_BatchedEnvelopeExtractor)
+    extractor.codec = EnvelopeCodec()
+    bucket = [
+        (b"\x01" * 20, (-30.0, 330.0, -10.0, 10.0)),  # world-spanning: skip
+        (b"\x02" * 20, (10.0, 20.0, -10.0, 10.0)),  # normal: keep
+        (b"\x03" * 20, (float("nan"), 20.0, -10.0, 10.0)),  # NaN w: skip
+        (b"\x04" * 20, (10.0, 20.0, -10.0, float("nan"))),  # NaN n: skip
+        (b"\x05" * 20, (float("nan"),) * 4),  # all-NaN: skip
+        (b"\x06" * 20, (150.0, 200.0, -10.0, 10.0)),  # antimeridian: keep
+    ]
+    # One bad row must not abort the whole bucket (codec raises on NaN).
+    extractor._flush_bucket(con, None, bucket)
+    rows = {r[0] for r in con.execute("SELECT blob_id FROM feature_envelopes")}
+    assert rows == {b"\x02" * 20, b"\x06" * 20}

@@ -4,6 +4,7 @@ kart/promisor_utils.py; tested against local-directory remotes exactly like
 the reference's own test suite, SURVEY.md §4)."""
 
 import io
+import os
 
 import pytest
 
@@ -370,3 +371,27 @@ class TestPromisorBackfill:
         err = capsys.readouterr().err
         assert "outside the spatial filter" in err
         assert writer.spatial_filter_pk_conflicts.get(ds_path) == [9]
+
+
+def test_fetch_skips_invalid_remote_ref_names(source_repo, tmp_path, capsys):
+    """A hostile/buggy remote exposing refs git's check_refname_format
+    rejects ('x.lock', '.hidden') must not get those names planted under
+    refs/remotes/ — they are skipped with a warning while good refs still
+    fetch (same rules the receive-pack side enforces)."""
+    repo, ds_path = source_repo
+    clone = transport.clone(repo.workdir, tmp_path / "clone", do_checkout=False)
+    # Plant hostile ref files directly in the remote's gitdir (refs.set
+    # would itself reject some of these shapes).
+    oid = repo.head_commit_oid
+    for bad in ("evil.lock", ".hidden"):
+        with open(os.path.join(repo.gitdir, "refs", "heads", bad), "w") as f:
+            f.write(oid + "\n")
+    new_oid = edit_commit(repo, ds_path, deletes=[2], message="advance")
+    updated = transport.fetch(clone, "origin")
+    assert updated.get("refs/remotes/origin/main") == new_oid
+    assert clone.refs.get("refs/remotes/origin/evil.lock") is None
+    assert clone.refs.get("refs/remotes/origin/.hidden") is None
+    assert not os.path.exists(
+        os.path.join(clone.gitdir, "refs", "remotes", "origin", "evil.lock")
+    )
+    assert "invalid remote ref name" in capsys.readouterr().err
