@@ -137,7 +137,14 @@ def get_feature_diff_columnar(base_ds, target_ds, ds_filter=None, *, blocks=None
         # the exact tree-diff path
         return get_feature_diff(base_ds, target_ds, ds_filter)
 
-    old_class, new_class, _ = classify_blocks(old_block, new_block)
+    from kart_tpu.parallel.sharded_diff import classify_blocks_sharded, should_shard
+
+    if should_shard(max(old_block.count, new_block.count)):
+        # >1 device: shard-local classify over the mesh (block-cyclic
+        # PK partition; only the count vector crosses ICI)
+        old_class, new_class, _ = classify_blocks_sharded(old_block, new_block)
+    else:
+        old_class, new_class, _ = classify_blocks(old_block, new_block)
     old_idx, new_idx = changed_indices(old_class, new_class)
 
     # Cross-version collision guard (hash-encoded datasets): a deleted pk X

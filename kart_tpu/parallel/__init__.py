@@ -13,15 +13,19 @@ via `psum`.
 
 from kart_tpu.parallel.mesh import make_mesh, best_device_count
 from kart_tpu.parallel.sharded_diff import (
+    classify_blocks_sharded,
     partition_block,
     sharded_classify,
     sharded_diff_step,
+    should_shard,
 )
 
 __all__ = [
     "make_mesh",
     "best_device_count",
+    "classify_blocks_sharded",
     "partition_block",
     "sharded_classify",
     "sharded_diff_step",
+    "should_shard",
 ]

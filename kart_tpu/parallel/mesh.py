@@ -10,15 +10,18 @@ would split into ("host", "device")).
 
 import numpy as np
 
-import jax
-from jax.sharding import Mesh
-
 FEATURES_AXIS = "features"
+
+# jax imported inside functions: this module sits on the small-diff CLI path
+# (via parallel.__init__ / sharded_diff routing) which must not pay a jax
+# import when it never touches the mesh.
 
 
 def best_device_count(limit=None):
     """Device count for a new mesh: all visible devices (optionally capped).
     partition_block pads each shard independently, so any shard count works."""
+    import jax
+
     n = jax.device_count()
     if limit is not None:
         n = min(n, limit)
@@ -27,6 +30,9 @@ def best_device_count(limit=None):
 
 def make_mesh(n_devices=None, devices=None):
     """An ``n_devices``-device 1-D mesh over the ``"features"`` axis."""
+    import jax
+    from jax.sharding import Mesh
+
     if devices is None:
         if n_devices is None:
             n_devices = best_device_count()

@@ -18,25 +18,30 @@ import functools
 
 import numpy as np
 
-import jax
-import jax.numpy as jnp
-from jax.sharding import NamedSharding, PartitionSpec as P
-
 from kart_tpu.ops import blocks as blocks_mod
 from kart_tpu.ops.blocks import PAD_KEY, FeatureBlock, bucket_size
 from kart_tpu.ops.diff_kernel import DELETE, INSERT, UNCHANGED, UPDATE
 from kart_tpu.parallel.mesh import FEATURES_AXIS
 
-try:  # jax >= 0.6 exposes shard_map at top level
-    from jax import shard_map  # type: ignore[attr-defined]
-except ImportError:  # pragma: no cover - version-dependent
-    from jax.experimental.shard_map import shard_map
+# jax is imported inside functions only: `kart diff` on a small repo routes
+# through this module's should_shard() and must stay instant (no jax import,
+# no backend probe) when the mesh path can't win anyway.
+
+
+def _shard_map():
+    try:  # jax >= 0.6 exposes shard_map at top level
+        from jax import shard_map  # type: ignore[attr-defined]
+    except ImportError:  # pragma: no cover - version-dependent
+        from jax.experimental.shard_map import shard_map
+    return shard_map
 
 
 def partition_block(block, n_shards, min_bucket=256):
     """FeatureBlock -> (keys (S, B) int64, oids (S, B, 5) uint32,
-    counts (S,) int32): PK-modulus partition, each shard sorted + padded to a
-    common power-of-two bucket B.
+    counts (S,) int32, src (S, B) int64): PK-modulus partition, each shard
+    sorted + padded to a common power-of-two bucket B. ``src`` maps each
+    shard slot back to the original block row (-1 for padding), so per-shard
+    results scatter back to block order.
 
     Shard order inside a bucket remains key-sorted, so per-shard joins have
     identical semantics to the single-chip path.
@@ -49,6 +54,7 @@ def partition_block(block, n_shards, min_bucket=256):
 
     keys = np.full((n_shards, bucket), PAD_KEY, dtype=np.int64)
     oids = np.zeros((n_shards, bucket, 5), dtype=np.uint32)
+    src = np.full((n_shards, bucket), -1, dtype=np.int64)
     # real_keys is globally sorted; a stable partition keeps each shard sorted
     order = np.argsort(shard_of, kind="stable")
     offsets = np.zeros(n_shards + 1, dtype=np.int64)
@@ -59,7 +65,8 @@ def partition_block(block, n_shards, min_bucket=256):
         lo, hi = offsets[s], offsets[s + 1]
         keys[s, : hi - lo] = sorted_keys[lo:hi]
         oids[s, : hi - lo] = sorted_oids[lo:hi]
-    return keys, oids, counts
+        src[s, : hi - lo] = order[lo:hi]
+    return keys, oids, counts, src
 
 
 def _local_classify(old_keys, old_oids, new_keys, new_oids, old_count, new_count):
@@ -77,6 +84,8 @@ def _local_classify(old_keys, old_oids, new_keys, new_oids, old_count, new_count
 def _sharded_step(old_keys, old_oids, new_keys, new_oids, old_counts, new_counts):
     """shard_map body: input shapes are the (1, B[, 5]) per-device slices of
     the stacked (S, B[, 5]) arrays. Counts cross the mesh via psum."""
+    import jax
+
     old_class, new_class, counts = _local_classify(
         old_keys[0],
         old_oids[0],
@@ -95,9 +104,12 @@ def make_sharded_classify(mesh):
     stacked outputs of :func:`partition_block` (leading dim == mesh size).
     Cached per mesh so repeat calls reuse the compiled executable (Mesh is
     hashable)."""
+    import jax
+    from jax.sharding import PartitionSpec as P
+
     spec = P(FEATURES_AXIS)
     repl = P()
-    fn = shard_map(
+    fn = _shard_map()(
         _sharded_step,
         mesh=mesh,
         in_specs=(spec, spec, spec, spec, spec, spec),
@@ -113,6 +125,9 @@ def sharded_classify(mesh, old_block, new_block):
     counts {inserts, updates, deletes},
     layout = (old_part, new_part) for mapping shard rows back to features).
     """
+    import jax
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
     n_shards = mesh.devices.size
     old_part = partition_block(old_block, n_shards)
     new_part = partition_block(new_block, n_shards)
@@ -144,7 +159,7 @@ def sharded_classify(mesh, old_block, new_block):
 
 
 def _repad(part, bucket):
-    keys, oids, counts = part
+    keys, oids, counts, src = part
     cur = keys.shape[1]
     if cur >= bucket:
         return part
@@ -153,7 +168,9 @@ def _repad(part, bucket):
     keys2[:, :cur] = keys
     oids2 = np.zeros((s, bucket, 5), dtype=np.uint32)
     oids2[:, :cur] = oids
-    return keys2, oids2, counts
+    src2 = np.full((s, bucket), -1, dtype=np.int64)
+    src2[:, :cur] = src
+    return keys2, oids2, counts, src2
 
 
 def sharded_diff_step(mesh, old_block, new_block):
@@ -161,6 +178,74 @@ def sharded_diff_step(mesh, old_block, new_block):
     reduce counts. Returns the counts dict."""
     _, _, counts, _ = sharded_classify(mesh, old_block, new_block)
     return counts
+
+
+# observability: how many times the mesh path actually ran this process
+# (dryrun_multichip and tests assert on it — the single-chip path silently
+# taking over would otherwise be invisible)
+STATS = {"sharded_classify_calls": 0}
+
+# below this row count the mesh round trip loses to the single-device kernel
+# (partition + per-shard padding overhead); tied to the device dispatch
+# crossover so the two routing constants move together, own env knob on top.
+# Force with KART_DIFF_SHARDED=1/0.
+def _sharded_min_rows():
+    from kart_tpu.ops.diff_kernel import DEVICE_MIN_ROWS, _env_int
+
+    return _env_int("KART_SHARDED_MIN_ROWS", DEVICE_MIN_ROWS)
+
+
+def should_shard(n_rows):
+    """Routing policy for the production diff path: use the mesh when it
+    exists and the block is big enough to pay for partitioning.
+
+    Ordered cheapest-first: the row-count test runs before any jax import or
+    backend probe, so a small `kart diff` stays instant even with the
+    accelerator wedged or cold (same guarantee as classify_blocks)."""
+    import os
+
+    mode = os.environ.get("KART_DIFF_SHARDED", "auto")
+    if mode == "0":
+        return False
+    if mode != "1" and n_rows < _sharded_min_rows():
+        return False
+    from kart_tpu.runtime import jax_ready
+
+    if not jax_ready():
+        return False
+    import jax
+
+    return jax.device_count() >= 2
+
+
+def _scatter_to_block_order(part_class, src, n_rows):
+    """(S, B) per-shard classes + (S, B) src rows -> (n_rows,) block-order
+    classes (UNCHANGED where padded)."""
+    out = np.zeros(n_rows, dtype=np.int8)
+    valid = src >= 0
+    out[src[valid]] = np.asarray(part_class)[valid]
+    return out
+
+
+def classify_blocks_sharded(old_block, new_block, mesh=None):
+    """Mesh-sharded drop-in for ``ops.diff_kernel.classify_blocks``: same
+    contract — (old_class (n_old,), new_class (n_new,), counts dict) in
+    original block-row order — but the classify runs shard-local on every
+    device of ``mesh`` (default: all devices) with only the count vector
+    crossing the interconnect. This is the production multi-chip diff path
+    (the reference's N-process import fan-out, `kart/fast_import.py:286-399`,
+    re-expressed as SPMD over the feature axis)."""
+    from kart_tpu.parallel.mesh import make_mesh
+
+    if mesh is None:
+        mesh = make_mesh()
+    old_class_p, new_class_p, counts, (old_part, new_part) = sharded_classify(
+        mesh, old_block, new_block
+    )
+    STATS["sharded_classify_calls"] += 1
+    old_class = _scatter_to_block_order(old_class_p, old_part[3], old_block.count)
+    new_class = _scatter_to_block_order(new_class_p, new_part[3], new_block.count)
+    return old_class, new_class, counts
 
 
 def synthetic_block(n, seed=0, change_none=False):

