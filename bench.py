@@ -374,11 +374,33 @@ def _merge_bench():
         materialise_s = time.perf_counter() - t0
         assert len(conflicts) == rows
 
+        # the full persistence cost too: columnar KMIX1 stream-write + read
+        import tempfile
+
+        from kart_tpu.merge.index import MergeIndex
+
+        mi = MergeIndex("0" * 40, conflicts)
+        fd, idx_path = tempfile.mkstemp(prefix="kart-bench-kmix")
+        try:
+            t0 = time.perf_counter()
+            with os.fdopen(fd, "wb") as f:
+                for chunk in mi._binary_chunks():
+                    f.write(chunk)
+            index_write_s = time.perf_counter() - t0
+            t0 = time.perf_counter()
+            with open(idx_path, "rb") as f:
+                MergeIndex._from_binary(f.read())
+            index_read_s = time.perf_counter() - t0
+        finally:
+            os.unlink(idx_path)
+
         total = classify_s + materialise_s
         return {
             "merge_conflict_rows": rows,
             "merge_classify_seconds": round(classify_s, 3),
             "merge_materialise_seconds": round(materialise_s, 3),
+            "merge_index_write_seconds": round(index_write_s, 3),
+            "merge_index_read_seconds": round(index_read_s, 3),
             "merge_conflicts_per_sec": round(rows / total),
         }
     except Exception as e:  # pragma: no cover - bench resilience

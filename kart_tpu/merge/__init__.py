@@ -26,9 +26,17 @@ from kart_tpu.core.repo import (
 )
 from kart_tpu.core.structure import RepoStructure
 from kart_tpu.core.tree_builder import TreeBuilder
-from kart_tpu.merge.index import AncestorOursTheirs, ConflictEntry, MergeIndex
+from kart_tpu.merge.index import (
+    AncestorOursTheirs,
+    ColumnarConflicts,
+    CombinedConflicts,
+    ConflictEntry,
+    EncodedPkPaths,
+    MergeIndex,
+    PkLabels,
+    RowPaths,
+)
 from kart_tpu.ops.blocks import FeatureBlock, unpack_oid_hex
-from kart_tpu.utils import paused_gc
 from kart_tpu.ops.merge_kernel import (
     CONFLICT,
     KEEP_OURS,
@@ -159,56 +167,83 @@ def _merge_dataset_features(ds_path, structures, tree_builder):
 
 
 def materialise_conflicts(ds_path, blocks, datasets, inner, union, conflict_idx):
-    """Conflict rows -> {label: AncestorOursTheirs(ConflictEntry)} with one
-    batched lookup per version (BASELINE config #5 scale: a 1M-conflict
-    merge must not pay per-conflict searchsorted/unpack calls). The cyclic
-    garbage collector is paused for the bulk object build — none of the
-    created objects (slotted entry/triple objects holding strings) can form
-    cycles, and collector passes over millions of fresh allocations
-    otherwise dominate (measured 2.3x at 1M conflicts)."""
+    """Conflict rows -> ColumnarConflicts (a {label: AncestorOursTheirs}
+    mapping stored as numpy columns). BASELINE config #5 scale: a
+    1M-conflict merge builds three (present, oids) column pairs with one
+    searchsorted + one gather each — labels, paths and entry objects stay
+    lazy until something actually reads them (serialisation reads the
+    columns in batch)."""
     if not len(conflict_idx):
         return {}
-    with paused_gc():
-        return _materialise_conflicts_inner(
-            ds_path, blocks, datasets, inner, union, conflict_idx
-        )
-
-
-def _materialise_conflicts_inner(ds_path, blocks, datasets, inner, union, conflict_idx):
     conflict_keys = union[conflict_idx]
     n = len(conflict_keys)
-
-    # numpy work once per version; .tolist() so the object-building loops
-    # below do plain-list indexing, not numpy scalar boxing
-    per_block = []
-    for block in blocks:
-        rows_arr = _keys_to_block_rows(block, conflict_keys)
-        found_arr = rows_arr >= 0
-        oid_hexes = [None] * n
-        if np.any(found_arr):
-            hexes = unpack_oid_hex(block.oids[rows_arr[found_arr]])
-            for slot, h in zip(np.nonzero(found_arr)[0].tolist(), hexes):
-                oid_hexes[slot] = h
-        per_block.append((rows_arr.tolist(), found_arr.tolist(), oid_hexes))
-
-    labels = _conflict_labels_batch(ds_path, datasets, blocks, per_block, n)
-
     prefix = f"{inner}/feature/"
-    entries_per_block = []
-    for (rows, found, oid_hexes), block in zip(per_block, blocks):
-        paths = block.paths
-        entries_per_block.append(
-            [
-                ConflictEntry(prefix + paths[rows[i]], oid_hexes[i])
-                if found[i]
-                else None
-                for i in range(n)
-            ]
-        )
-    return {
-        labels[i]: AncestorOursTheirs(*entry_row)
-        for i, entry_row in enumerate(zip(*entries_per_block))
+
+    versions = []
+    rows_per_block = []
+    pk_path_cols = {}  # encoder id -> shared EncodedPkPaths (encode once)
+    for block, ds in zip(blocks, datasets):
+        rows = _keys_to_block_rows(block, conflict_keys)
+        present = rows >= 0
+        rows_per_block.append(rows)
+        oids_u8 = np.zeros((n, 20), dtype=np.uint8)
+        if np.any(present):
+            sel = np.ascontiguousarray(block.oids[rows[present]])
+            oids_u8[present] = sel.view(np.uint8).reshape(-1, 20)
+        encoder = getattr(ds, "path_encoder", None)
+        if encoder is not None and getattr(encoder, "scheme", None) == "int":
+            # int-pk: the path is a pure function of the pk — versions with
+            # the same encoder share one lazy column
+            paths = pk_path_cols.get(id(encoder))
+            if paths is None:
+                paths = EncodedPkPaths(prefix, encoder, conflict_keys)
+                pk_path_cols[id(encoder)] = paths
+        else:
+            paths = RowPaths(prefix, block.paths, rows)
+        versions.append((present, oids_u8, paths))
+
+    schemes = {
+        getattr(getattr(ds, "path_encoder", None), "scheme", None)
+        for ds in datasets
+        if ds is not None
     }
+    if schemes == {"int"}:
+        # every version int-pk: keys ARE the pks, labels derive from the key
+        # column. Mixed-encoder datasets (pk type change) must decode each
+        # conflict with the encoder of a version that actually holds it.
+        labels = PkLabels(ds_path, conflict_keys)
+    else:
+        labels = _DeferredLabels(ds_path, datasets, blocks, rows_per_block, n)
+    return ColumnarConflicts(labels, versions)
+
+
+class _DeferredLabels:
+    """Label column for hash-keyed datasets: path-decode runs only when the
+    labels are first read (serialisation / conflict listing)."""
+
+    __slots__ = ("ds_path", "datasets", "blocks", "rows_per_block", "n")
+
+    def __init__(self, ds_path, datasets, blocks, rows_per_block, n):
+        self.ds_path = ds_path
+        self.datasets = datasets
+        self.blocks = blocks
+        self.rows_per_block = rows_per_block
+        self.n = n
+
+    def __len__(self):
+        return self.n
+
+    def __getitem__(self, i):
+        return self.batch()[i]  # single lookups are rare; batch is cached upstream
+
+    def batch(self):
+        per_block = [
+            (rows.tolist(), (rows >= 0).tolist(), None)
+            for rows in self.rows_per_block
+        ]
+        return _conflict_labels_batch(
+            self.ds_path, self.datasets, self.blocks, per_block, self.n
+        )
 
 
 def _conflict_labels_batch(ds_path, datasets, blocks, per_block, n):
@@ -356,7 +391,7 @@ def merge_trees_vectorized(repo, ancestor_struct, ours_struct, theirs_struct):
     resolved."""
     structures = (ancestor_struct, ours_struct, theirs_struct)
     tb = TreeBuilder(repo.odb, ours_struct.tree_oid)
-    all_conflicts = {}
+    all_conflicts = CombinedConflicts()
     total_stats = {"take_theirs": 0, "conflicts": 0}
 
     ds_paths = set()
@@ -365,11 +400,11 @@ def merge_trees_vectorized(repo, ancestor_struct, ours_struct, theirs_struct):
             ds_paths.update(structure.datasets.paths())
     for ds_path in sorted(ds_paths):
         conflicts, stats = _merge_dataset_features(ds_path, structures, tb)
-        all_conflicts.update(conflicts)
+        all_conflicts.add(conflicts)
         for k in total_stats:
             total_stats[k] += stats.get(k, 0)
 
-    all_conflicts.update(_merge_non_features(structures, tb))
+    all_conflicts.add(_merge_non_features(structures, tb))
     merged_tree = tb.flush() if tb else ours_struct.tree_oid
     return merged_tree, all_conflicts, total_stats
 

@@ -15,6 +15,7 @@ Two encodings of `<gitdir>/MERGE_INDEX`, detected by content:
 
 import json
 import struct
+from collections.abc import Mapping
 
 import numpy as np
 
@@ -79,6 +80,291 @@ class ConflictEntry:
         return cls(d["path"], d["oid"]) if d else None
 
 
+class EncodedPkPaths:
+    """Lazy path column for int-pk conflicts: the feature path is a pure
+    function of the pk, so nothing is stored — single lookups encode one
+    path, ``batch()`` uses the vectorized whole-column encoder (memoised:
+    ancestor/ours/theirs share one instance, so the column encodes once)."""
+
+    __slots__ = ("prefix", "encoder", "keys", "_batch")
+
+    def __init__(self, prefix, encoder, keys):
+        self.prefix = prefix
+        self.encoder = encoder
+        self.keys = keys
+        self._batch = None
+
+    def __len__(self):
+        return len(self.keys)
+
+    def __getitem__(self, i):
+        if self._batch is not None:
+            return self._batch[i]
+        return self.prefix + self.encoder.encode_pks_to_path((int(self.keys[i]),))
+
+    def batch(self):
+        if self._batch is None:
+            self._batch = [
+                self.prefix + p for p in self.encoder.encode_paths_batch(self.keys)
+            ]
+        return self._batch
+
+    def joined_bytes(self, sep=b"\x00"):
+        """NUL-joined full-path bytes for serialisation, bypassing per-path
+        strings entirely; None when the encoder can't (writer falls back)."""
+        fn = getattr(self.encoder, "encode_paths_joined_bytes", None)
+        if fn is None:
+            return None
+        return fn(self.keys, prefix=self.prefix.encode(), sep=sep)
+
+
+class RowPaths:
+    """Lazy path column backed by a block's path list + per-conflict row
+    indices (hash-keyed datasets, where paths aren't derivable)."""
+
+    __slots__ = ("prefix", "paths", "rows")
+
+    def __init__(self, prefix, paths, rows):
+        self.prefix = prefix
+        self.paths = paths
+        self.rows = rows
+
+    def __len__(self):
+        return len(self.rows)
+
+    def __getitem__(self, i):
+        return self.prefix + self.paths[self.rows[i]]
+
+    def batch(self):
+        paths = self.paths
+        prefix = self.prefix
+        return [prefix + paths[r] if r >= 0 else "" for r in self.rows.tolist()]
+
+
+class PkLabels:
+    """Lazy label column `<ds>:feature:<pk>` from the conflict pk array."""
+
+    __slots__ = ("ds_path", "keys")
+
+    def __init__(self, ds_path, keys):
+        self.ds_path = ds_path
+        self.keys = keys
+
+    def __len__(self):
+        return len(self.keys)
+
+    def __getitem__(self, i):
+        return f"{self.ds_path}:feature:{int(self.keys[i])}"
+
+    def batch(self):
+        head = f"{self.ds_path}:feature:"
+        return [head + str(k) for k in self.keys.tolist()]
+
+
+class JoinedStrs:
+    """Lazy string column over NUL-joined bytes (the KMIX1 on-disk form):
+    reading a 1M-conflict index is O(1) until a column is actually touched."""
+
+    __slots__ = ("raw", "n", "_list")
+
+    def __init__(self, raw, n):
+        self.raw = raw
+        self.n = n
+        self._list = None
+
+    def __len__(self):
+        return self.n
+
+    def __getitem__(self, i):
+        return self.batch()[i]
+
+    def batch(self):
+        if self._list is None:
+            self._list = self.raw.decode().split("\x00") if self.n else []
+        return self._list
+
+    def joined_bytes(self, sep=b"\x00"):
+        """Read->rewrite roundtrip (resolve flow): the on-disk bytes are
+        already the serialised column."""
+        return self.raw if sep == b"\x00" else None
+
+
+def _materialise_col(src):
+    """Path/label column -> list[str]."""
+    if isinstance(src, list):
+        return src
+    return src.batch() if hasattr(src, "batch") else list(src)
+
+
+class ColumnarConflicts(Mapping):
+    """Column-oriented conflict set: numpy presence/oid columns plus lazy
+    label/path columns. Behaves as the {label: AncestorOursTheirs} mapping
+    the rest of the engine expects, but a 1M-conflict merge stores ~60MB of
+    arrays instead of 4M Python objects, and serialisation reads the columns
+    directly (BASELINE config #5; reference: kart/merge_util.py:68-346).
+
+    ``versions``: one (present bool (n,), oids_u8 (n, 20), paths) triple per
+    ancestor/ours/theirs, where paths is a list or a lazy column
+    (:class:`EncodedPkPaths` / :class:`RowPaths`). ``labels`` likewise."""
+
+    __slots__ = ("n", "_labels_src", "versions", "_labels", "_where")
+
+    def __init__(self, labels, versions):
+        self.n = len(labels)
+        self._labels_src = labels
+        self.versions = list(versions)
+        self._labels = labels if isinstance(labels, list) else None
+        self._where = None
+
+    @property
+    def labels(self):
+        if self._labels is None:
+            self._labels = _materialise_col(self._labels_src)
+        return self._labels
+
+    def _label_index(self, label):
+        if self._where is None:
+            self._where = {l: i for i, l in enumerate(self.labels)}
+        return self._where.get(label)
+
+    def _entry(self, v, i):
+        present, oids_u8, paths = self.versions[v]
+        if not present[i]:
+            return None
+        return ConflictEntry(paths[i], bytes(oids_u8[i]).hex())
+
+    def _aot(self, i):
+        return AncestorOursTheirs(*(self._entry(v, i) for v in range(3)))
+
+    # -- Mapping protocol ----------------------------------------------------
+
+    def __len__(self):
+        return self.n
+
+    def __iter__(self):
+        return iter(self.labels)
+
+    def __contains__(self, label):
+        return self._label_index(label) is not None
+
+    def __getitem__(self, label):
+        i = self._label_index(label)
+        if i is None:
+            raise KeyError(label)
+        return self._aot(i)
+
+    def items(self):
+        labels = self.labels
+        return ((labels[i], self._aot(i)) for i in range(self.n))
+
+    def values(self):
+        return (self._aot(i) for i in range(self.n))
+
+    def to_columns(self):
+        """-> (labels, [(present, oids_u8, paths)] x3); labels and paths stay
+        lazy column objects so the serialiser can use their batch/joined-bytes
+        fast paths."""
+        labels = self._labels if self._labels is not None else self._labels_src
+        return labels, list(self.versions)
+
+
+class CombinedConflicts(Mapping):
+    """Ordered chain of conflict mappings (one ColumnarConflicts per dataset
+    + a plain dict for meta/attachment conflicts) presenting as one mapping.
+    Keeps each part columnar so serialisation never flattens to objects."""
+
+    __slots__ = ("parts",)
+
+    def __init__(self, parts=None):
+        self.parts = [p for p in (parts or []) if len(p)]
+
+    def add(self, part):
+        if len(part):
+            self.parts.append(part)
+
+    def __len__(self):
+        return sum(len(p) for p in self.parts)
+
+    def __iter__(self):
+        for p in self.parts:
+            yield from p
+
+    def __contains__(self, label):
+        return any(label in p for p in self.parts)
+
+    def __getitem__(self, label):
+        for p in self.parts:
+            if label in p:
+                return p[label]
+        raise KeyError(label)
+
+    def items(self):
+        for p in self.parts:
+            yield from p.items()
+
+    def values(self):
+        for p in self.parts:
+            yield from p.values()
+
+
+def _conflicts_as_columns(conflicts):
+    """Any conflict mapping -> (labels list, [(present, oids_u8, paths)] x3)
+    columns. The common single-dataset case passes the lazy path columns
+    straight through (the serialiser uses their batch fast paths); multi-part
+    and plain-dict conflict sets are concatenated, looping per item only for
+    dict parts."""
+    parts = (
+        conflicts.parts
+        if isinstance(conflicts, CombinedConflicts)
+        else [conflicts]
+    )
+    if len(parts) == 1 and isinstance(parts[0], ColumnarConflicts):
+        return parts[0].to_columns()
+
+    labels = []
+    cols = [([], [], []) for _ in VERSION_NAMES]  # (present, oids, paths)
+    for part in parts:
+        if isinstance(part, ColumnarConflicts):
+            part_labels, part_versions = part.to_columns()
+            labels.extend(_materialise_col(part_labels))
+            for v, (present, oids_u8, paths) in enumerate(part_versions):
+                cols[v][0].append(np.asarray(present, dtype=np.uint8))
+                cols[v][1].append(oids_u8)
+                cols[v][2].extend(_materialise_col(paths))
+            continue
+        n = len(part)
+        for v_name, col in zip(VERSION_NAMES, cols):
+            present = np.zeros(n, dtype=np.uint8)
+            oids = np.zeros((n, 20), dtype=np.uint8)
+            paths = []
+            for i, aot in enumerate(part.values()):
+                entry = aot.get(v_name)
+                if entry is not None:
+                    present[i] = 1
+                    oids[i] = np.frombuffer(bytes.fromhex(entry.oid), np.uint8)
+                    paths.append(entry.path)
+                else:
+                    paths.append("")
+            col[0].append(present)
+            col[1].append(oids)
+            col[2].extend(paths)
+        labels.extend(part.keys())
+    out = []
+    for present_chunks, oid_chunks, paths in cols:
+        present = (
+            np.concatenate(present_chunks)
+            if present_chunks
+            else np.zeros(0, dtype=np.uint8)
+        )
+        oids = (
+            np.concatenate(oid_chunks)
+            if oid_chunks
+            else np.zeros((0, 20), dtype=np.uint8)
+        )
+        out.append((present, oids, paths))
+    return labels, out
+
+
 class MergeIndex:
     """Conflicts + resolves for an in-progress merge.
 
@@ -133,12 +419,18 @@ class MergeIndex:
 
     # -- binary encoding (columnar, for large conflict sets) ----------------
 
-    def _to_binary(self):
-        """KMIX1: magic, u32 header length, JSON header {mergedTree,
-        resolves, n}, then per column: u64 byte length + payload. Columns:
-        NUL-joined label bytes, then per version (a/o/t) a present mask,
-        (n,20) oids, and NUL-joined path bytes (empty for absent)."""
-        labels = list(self.conflicts.keys())
+    def _binary_chunks(self):
+        """Yield the KMIX1 byte chunks: magic, u32 header length, JSON header
+        {mergedTree, resolves, n}, then per column: u64 byte length +
+        payload. Columns: NUL-joined label bytes, then per version (a/o/t) a
+        present mask, (n,20) oids, and NUL-joined path bytes (empty for
+        absent).
+
+        Columnar conflict sets serialise column-to-column (no per-conflict
+        objects); plain dicts are looped in _conflicts_as_columns. Chunked so
+        write_to_repo streams to disk without joining a second in-memory copy
+        (~174MB at 1M conflicts)."""
+        labels, version_cols = _conflicts_as_columns(self.conflicts)
         n = len(labels)
         header = json.dumps(
             {
@@ -151,31 +443,43 @@ class MergeIndex:
             }
         ).encode()
 
-        blocks = ["\x00".join(labels).encode()]
-        aots = list(self.conflicts.values())
-        for name in VERSION_NAMES:
-            present = np.zeros(n, dtype=np.uint8)
-            oids = np.zeros((n, 20), dtype=np.uint8)
-            paths = []
-            for i, aot in enumerate(aots):
-                entry = aot.get(name)
-                if entry is not None:
-                    present[i] = 1
-                    oids[i] = np.frombuffer(bytes.fromhex(entry.oid), np.uint8)
-                    paths.append(entry.path)
-                else:
-                    paths.append("")
+        label_jb = getattr(labels, "joined_bytes", None)
+        label_bytes = label_jb() if label_jb is not None else None
+        if label_bytes is None:
+            label_bytes = "\x00".join(_materialise_col(labels)).encode()
+        blocks = [label_bytes]
+        joined_cache = {}  # id(path column) -> encoded bytes (versions share columns)
+        for present, oids, paths in version_cols:
+            if np.all(present):
+                path_bytes = joined_cache.get(id(paths))
+                if path_bytes is None:
+                    jb = getattr(paths, "joined_bytes", None)
+                    path_bytes = jb() if jb is not None else None
+                    if path_bytes is None:
+                        path_bytes = "\x00".join(_materialise_col(paths)).encode()
+                    joined_cache[id(paths)] = path_bytes
+            else:
+                # absent rows must serialise with an empty path (padding rows
+                # of lazy columns can carry junk paths; mask them out)
+                lst = _materialise_col(paths)
+                path_bytes = "\x00".join(
+                    p if ok else "" for p, ok in zip(lst, present)
+                ).encode()
             blocks += [
-                present.tobytes(),
-                oids.tobytes(),
-                "\x00".join(paths).encode(),
+                np.ascontiguousarray(present, dtype=np.uint8).tobytes(),
+                np.ascontiguousarray(oids, dtype=np.uint8).tobytes(),
+                path_bytes,
             ]
 
-        out = [_BINARY_MAGIC, struct.pack("<I", len(header)), header]
+        yield _BINARY_MAGIC
+        yield struct.pack("<I", len(header))
+        yield header
         for block in blocks:
-            out.append(struct.pack("<Q", len(block)))
-            out.append(block)
-        return b"".join(out)
+            yield struct.pack("<Q", len(block))
+            yield block
+
+    def _to_binary(self):
+        return b"".join(self._binary_chunks())
 
     @classmethod
     def _from_binary(cls, raw):
@@ -194,26 +498,17 @@ class MergeIndex:
             pos += blen
             return data
 
-        def unpack_strs(data_b):
-            return data_b.decode().split("\x00") if n else []
-
-        labels = unpack_strs(block())
+        labels = JoinedStrs(block(), n)
         versions = []
         for _ in VERSION_NAMES:
             present = np.frombuffer(block(), dtype=np.uint8)
             oids = np.frombuffer(block(), dtype=np.uint8).reshape(n, 20)
-            paths = unpack_strs(block())
+            paths = JoinedStrs(block(), n)
             versions.append((present, oids, paths))
 
-        conflicts = {}
-        for i, label in enumerate(labels):
-            entries = []
-            for present, oids, paths in versions:
-                if present[i]:
-                    entries.append(ConflictEntry(paths[i], bytes(oids[i]).hex()))
-                else:
-                    entries.append(None)
-            conflicts[label] = AncestorOursTheirs(*entries)
+        # stays columnar on read: `kart conflicts`/`kart resolve` on a
+        # 1M-conflict index materialise only the entries they actually touch
+        conflicts = ColumnarConflicts(labels, versions)
         resolves = {
             label: [ConflictEntry.from_json(e) for e in entries]
             for label, entries in header["resolves"].items()
@@ -230,7 +525,8 @@ class MergeIndex:
             tmp = path + f".tmp{os.getpid()}"
             try:
                 with open(tmp, "wb") as f:
-                    f.write(self._to_binary())
+                    for chunk in self._binary_chunks():
+                        f.write(chunk)
                 os.replace(tmp, path)
             except BaseException:
                 if os.path.exists(tmp):

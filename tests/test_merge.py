@@ -320,6 +320,47 @@ class TestConflictMaterialisation:
             assert aot.ours is not None and aot.theirs is not None
             assert aot.ours.path.startswith("inner/feature/")
 
+    def test_labels_mixed_encoders_decode_real_pks(self):
+        """A pk-type change leaves versions with different encoders; a
+        conflict present only in the hash-keyed versions must still be
+        labelled with its decoded pk, not the internal 63-bit hash key."""
+        from kart_tpu.merge import materialise_conflicts
+        from kart_tpu.models.paths import PathEncoder
+        from kart_tpu.ops.blocks import hash_keys_for_paths
+        from kart_tpu.ops.merge_kernel import CONFLICT, merge_classify
+
+        int_enc = PathEncoder.INT_PK_ENCODER
+        hash_enc = PathEncoder.GENERAL_ENCODER
+
+        pks = [101, 202, 303]
+        hash_paths = [hash_enc.encode_pks_to_path((pk,)) for pk in pks]
+        order = np.argsort(hash_keys_for_paths(hash_paths))
+        hash_paths = [hash_paths[i] for i in order]
+        keys = np.sort(hash_keys_for_paths(hash_paths))
+
+        class _IntDs:
+            path_encoder = int_enc
+
+        class _HashDs:
+            path_encoder = hash_enc
+
+            @staticmethod
+            def decode_path_to_pks(rel):
+                return hash_enc.decode_path_to_pks(rel)
+
+        a = self._block(np.zeros(0, dtype=np.int64), 0, [])
+        o = self._block(keys, 1, hash_paths)
+        t = self._block(keys, 2, hash_paths)
+        union, decision, _, _ = merge_classify(a, o, t)
+        conflict_idx = np.nonzero(decision == CONFLICT)[0]
+        assert len(conflict_idx) == 3
+
+        conflicts = materialise_conflicts(
+            "ds", [a, o, t], [_IntDs(), _HashDs(), _HashDs()], "inner",
+            union, conflict_idx,
+        )
+        assert sorted(conflicts) == sorted(f"ds:feature:{pk}" for pk in pks)
+
     def test_labels_fall_back_per_version_without_encoder(self):
         """datasets=None versions still label every conflict distinctly."""
         from kart_tpu.merge import materialise_conflicts
@@ -377,3 +418,80 @@ def test_merge_index_binary_roundtrip(tmp_path, monkeypatch):
     assert raw.lstrip().startswith(b"{")
     mi3 = MergeIndex.read_from_repo(repo)
     assert sorted(mi3.conflicts) == sorted(mi.conflicts)
+
+
+def test_columnar_conflicts_mapping_and_binary():
+    """materialise_conflicts returns a columnar mapping whose entries,
+    iteration order and KMIX1 bytes match the equivalent plain-dict index —
+    including rows absent from some versions (delete/edit conflicts)."""
+    import numpy as np
+
+    from kart_tpu.merge import materialise_conflicts
+    from kart_tpu.merge.index import (
+        AncestorOursTheirs,
+        ColumnarConflicts,
+        ConflictEntry,
+    )
+    from kart_tpu.models.paths import PathEncoder
+    from kart_tpu.ops.blocks import FeatureBlock
+
+    encoder = PathEncoder.INT_PK_ENCODER
+
+    def block(keys_oids):
+        keys = np.array(sorted(keys_oids), dtype=np.int64)
+        oids = np.zeros((len(keys), 5), dtype=np.uint32)
+        for i, k in enumerate(keys):
+            oids[i, 0] = keys_oids[k]
+        paths = [encoder.encode_pks_to_path((int(k),)) for k in keys]
+        return FeatureBlock.from_arrays(keys, oids, paths)
+
+    # pk 1: edit/edit conflict; pk 2: delete(ours)/edit(theirs);
+    # pk 3: edit(ours)/delete(theirs)
+    a = block({1: 10, 2: 20, 3: 30})
+    o = block({1: 11, 3: 31})
+    t = block({1: 12, 2: 22})
+
+    class _Ds:
+        path_encoder = encoder
+
+    union = np.array([1, 2, 3], dtype=np.int64)
+    conflict_idx = np.arange(3)
+    cc = materialise_conflicts(
+        "ds", [a, o, t], [_Ds(), _Ds(), _Ds()], "inner", union, conflict_idx
+    )
+    assert isinstance(cc, ColumnarConflicts)
+    assert len(cc) == 3
+    assert list(cc) == ["ds:feature:1", "ds:feature:2", "ds:feature:3"]
+    assert "ds:feature:2" in cc and "ds:feature:99" not in cc
+
+    aot = cc["ds:feature:2"]
+    assert aot.ours is None  # deleted in ours
+    assert aot.ancestor.oid.startswith("14")  # 20 -> 0x14 first byte LE word
+    assert aot.theirs.path == "inner/feature/" + encoder.encode_pks_to_path((2,))
+
+    # KMIX1 bytes equal a plain-dict build of the same conflicts
+    dict_conflicts = {label: aot for label, aot in cc.items()}
+    raw_columnar = MergeIndex("a" * 40, cc)._to_binary()
+    raw_dict = MergeIndex("a" * 40, dict_conflicts)._to_binary()
+    assert raw_columnar == raw_dict
+
+    mi2 = MergeIndex._from_binary(raw_columnar)
+    assert isinstance(mi2.conflicts, ColumnarConflicts)
+    assert list(mi2.conflicts) == list(cc)
+    got = mi2.conflicts["ds:feature:3"]
+    assert got.theirs is None and got.ours.oid == cc["ds:feature:3"].ours.oid
+    # rewrite of a read-back index is byte-identical (resolve flow)
+    assert MergeIndex("a" * 40, mi2.conflicts)._to_binary() == raw_columnar
+
+
+def test_encode_paths_joined_bytes_matches_batch():
+    import numpy as np
+
+    from kart_tpu.models.paths import PathEncoder
+
+    enc = PathEncoder.INT_PK_ENCODER
+    pks = np.array([0, 1, 127, 128, 255, 65535, 2**31, 2**63 - 1, -1, -129], dtype=np.int64)
+    joined = enc.encode_paths_joined_bytes(pks, prefix=b"pre/", sep=b"\x00")
+    expected = "\x00".join("pre/" + p for p in enc.encode_paths_batch(pks)).encode()
+    assert joined == expected
+    assert enc.encode_paths_joined_bytes(np.zeros(0, dtype=np.int64)) == b""
