@@ -76,13 +76,17 @@ class ObjectDb:
         return self._packs
 
     @contextmanager
-    def bulk_pack(self):
+    def bulk_pack(self, level=1):
         """Redirect all object writes into one new pack for the duration —
         the scale path for import/commit of many objects (one sequential
         container file instead of a loose file + rename per object; VERDICT
         r1 weak #5 measured the loose path at 3.2k features/s, 70% sys time).
-        Objects written inside the context become readable when it exits."""
-        w = self.pack_writer()
+        Objects written inside the context become readable when it exits.
+
+        level: zlib level for the pack records; 0 = stored (tree/oid-heavy
+        payloads are ~incompressible, and deflate of incompressible bytes is
+        ~30MB/s — the synthetic benchmark repos write stored blocks)."""
+        w = self.pack_writer(level=level)
         self._bulk_writer = w
         try:
             yield w

@@ -222,6 +222,55 @@ def _feature_diff_routed(base_ds, target_ds, ds_filter=None):
     return get_feature_diff(base_ds, target_ds, ds_filter)
 
 
+def get_dataset_feature_count_fast(base_rs, target_rs, ds_path):
+    """Exact changed-feature count for one dataset straight from the
+    classify kernel — no Delta/KeyValue objects (`-o feature-count` at
+    north-star scale would otherwise build ~1M deltas only to len() them;
+    reference analog: exact diff estimation, kart/diff_estimation.py:51-76).
+
+    -> int, or None when the count can't be taken from the columnar route
+    with delta-path parity (dataset added/removed, hash-keyed identities,
+    missing sidecars, or the engine forced to the tree walk)."""
+    import os
+
+    from kart_tpu.diff import sidecar
+
+    if os.environ.get("KART_DIFF_ENGINE", "auto") == "tree":
+        return None
+    base_ds = base_rs.datasets.get(ds_path) if base_rs is not None else None
+    target_ds = target_rs.datasets.get(ds_path) if target_rs is not None else None
+    if base_ds is None or target_ds is None:
+        return None  # whole-dataset add/delete: the delta path handles it
+    base_tree = base_ds.feature_tree
+    target_tree = target_ds.feature_tree
+    if (base_tree.oid if base_tree is not None else None) == (
+        target_tree.oid if target_tree is not None else None
+    ):
+        return 0
+    for ds in (base_ds, target_ds):
+        enc = getattr(ds, "path_encoder", None)
+        if enc is None or enc.scheme != "int":
+            return None  # hash-keyed: collision guards need the delta path
+    repo = base_ds.repo or target_ds.repo
+    if repo is None:
+        return None
+    if not (sidecar.has_sidecar(repo, base_ds) and sidecar.has_sidecar(repo, target_ds)):
+        return None
+    old_block = sidecar.load_block(repo, base_ds)
+    new_block = sidecar.load_block(repo, target_ds)
+    if old_block is None or new_block is None:
+        return None
+
+    from kart_tpu.ops.diff_kernel import classify_blocks
+    from kart_tpu.parallel.sharded_diff import classify_blocks_sharded, should_shard
+
+    if should_shard(max(old_block.count, new_block.count)):
+        _, _, counts = classify_blocks_sharded(old_block, new_block)
+    else:
+        _, _, counts = classify_blocks(old_block, new_block)
+    return counts["inserts"] + counts["updates"] + counts["deletes"]
+
+
 def get_meta_diff(base_ds, target_ds, ds_filter=None):
     """DeltaDiff of meta items between two versions of a dataset."""
     meta_filter = ds_filter["meta"] if ds_filter is not None else None

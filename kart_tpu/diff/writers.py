@@ -562,10 +562,20 @@ class FeatureCountDiffWriter(BaseDiffWriter):
     """Prints per-dataset changed-feature counts."""
 
     def write_diff(self):
+        from kart_tpu.diff.engine import get_dataset_feature_count_fast
+
         fp = resolve_output_path(self.output_path)
         for ds_path in self.all_ds_paths:
-            ds_diff = self.get_ds_diff(ds_path)
-            count = len(ds_diff.get("feature", ()))
+            count = None
+            if self.working_copy is None and self.repo_key_filter.match_all:
+                # commit<>commit, unfiltered: the count comes straight from
+                # the classify kernel, skipping delta construction entirely
+                count = get_dataset_feature_count_fast(
+                    self.base_rs, self.target_rs, ds_path
+                )
+            if count is None:
+                ds_diff = self.get_ds_diff(ds_path)
+                count = len(ds_diff.get("feature", ()))
             if count:
                 self.has_changes = True
                 fp.write(f"{ds_path}:\n\t{count} features changed\n")

@@ -245,14 +245,35 @@ def classify_blocks(old_block, new_block):
         if default_backend() == "cpu"
         else _classify_padded
     )
-    old_class, new_class, _, counts = kernel(
-        old_block.keys,
-        old_block.oids,
-        new_block.keys,
-        new_block.oids,
-        old_block.count,
-        new_block.count,
-    )
+    try:
+        old_class, new_class, _, counts = kernel(
+            old_block.keys,
+            old_block.oids,
+            new_block.keys,
+            new_block.oids,
+            old_block.count,
+            new_block.count,
+        )
+    except Exception as e:
+        # device OOM / tunnel failure mid-call: the CLI must still complete
+        # (north-star scale can exceed a single chip's HBM)
+        import logging
+
+        logging.getLogger("kart_tpu.ops").warning(
+            "device classify failed (%s: %s); using host path",
+            type(e).__name__,
+            e,
+        )
+        old_class, new_class = classify_blocks_reference(old_block, new_block)
+        return (
+            old_class,
+            new_class,
+            {
+                "inserts": int(np.sum(new_class == INSERT)),
+                "updates": int(np.sum(old_class == UPDATE)),
+                "deletes": int(np.sum(old_class == DELETE)),
+            },
+        )
     old_class = np.asarray(old_class)[: old_block.count]
     new_class = np.asarray(new_class)[: new_block.count]
     counts = np.asarray(counts)

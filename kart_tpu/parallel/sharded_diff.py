@@ -237,11 +237,26 @@ def classify_blocks_sharded(old_block, new_block, mesh=None):
     re-expressed as SPMD over the feature axis)."""
     from kart_tpu.parallel.mesh import make_mesh
 
-    if mesh is None:
-        mesh = make_mesh()
-    old_class_p, new_class_p, counts, (old_part, new_part) = sharded_classify(
-        mesh, old_block, new_block
-    )
+    try:
+        if mesh is None:
+            mesh = make_mesh()
+        old_class_p, new_class_p, counts, (old_part, new_part) = sharded_classify(
+            mesh, old_block, new_block
+        )
+    except Exception as e:
+        # device OOM / tunnel failure mid-call: fall back to the single-chip
+        # route, which itself degrades to the numpy twin — the CLI must
+        # still complete (same guarantee classify_blocks gives)
+        import logging
+
+        logging.getLogger("kart_tpu.parallel").warning(
+            "mesh-sharded classify failed (%s: %s); using single-chip path",
+            type(e).__name__,
+            e,
+        )
+        from kart_tpu.ops.diff_kernel import classify_blocks
+
+        return classify_blocks(old_block, new_block)
     STATS["sharded_classify_calls"] += 1
     old_class = _scatter_to_block_order(old_class_p, old_part[3], old_block.count)
     new_class = _scatter_to_block_order(new_class_p, new_part[3], new_block.count)

@@ -141,10 +141,20 @@ int64_t io_pack_ptrs(const uint8_t* const* ptrs, const int64_t* lens,
     if (type_len > 32) return -4;
     int64_t pos = 0;
     out_offsets[0] = 0;
+    // one z_stream reused with deflateReset: deflateInit allocates ~256KB of
+    // window/hash state, and paying that per 30-byte feature blob dominated
+    // the batch (bytes produced are identical to per-object compress2 —
+    // same level, default windowBits/memLevel)
+    z_stream zs;
+    std::memset(&zs, 0, sizeof(zs));
+    if (deflateInit(&zs, level) != Z_OK) return -3;
     for (int64_t i = 0; i < n; i++) {
         int hdr = std::snprintf(header, sizeof(header), "%s %lld",
                                 type_name, (long long)lens[i]);
-        if (hdr < 0 || size_t(hdr) >= sizeof(header) - 1) return -4;
+        if (hdr < 0 || size_t(hdr) >= sizeof(header) - 1) {
+            deflateEnd(&zs);
+            return -4;
+        }
         header[hdr] = '\0';  // the NUL is part of the hashed header
         Sha1Ctx ctx;
         sha1_init(&ctx);
@@ -153,14 +163,48 @@ int64_t io_pack_ptrs(const uint8_t* const* ptrs, const int64_t* lens,
         sha1_update(&ctx, ptrs[i], size_t(lens[i]));
         sha1_final(&ctx, oids_out + i * 20);
 
-        uLongf dest_len = uLongf(out_cap - pos);
-        int rc = compress2(out + pos, &dest_len, ptrs[i], uLong(lens[i]),
-                           level);
-        if (rc == Z_BUF_ERROR) return -1;
-        if (rc != Z_OK) return -3;
-        pos += int64_t(dest_len);
+        // stream in bounded chunks: avail_in/avail_out are 32-bit, payloads
+        // and the output buffer can exceed 4 GiB
+        const uint8_t* src = ptrs[i];
+        int64_t remaining = lens[i];
+        const int64_t kChunk = int64_t(0x40000000);  // 1 GiB
+        int rc = Z_OK;
+        Bytef* rec_start = out + pos;
+        zs.next_in = const_cast<Bytef*>(src);
+        zs.avail_in = 0;
+        zs.next_out = rec_start;
+        do {
+            if (zs.avail_in == 0 && remaining > 0) {
+                int64_t take = remaining > kChunk ? kChunk : remaining;
+                zs.next_in = const_cast<Bytef*>(src);
+                zs.avail_in = uInt(take);
+                src += take;
+                remaining -= take;
+            }
+            int64_t room = out_cap - pos - int64_t(zs.next_out - rec_start);
+            if (room <= 0) {
+                deflateEnd(&zs);
+                return -1;
+            }
+            zs.avail_out = uInt(room > kChunk ? kChunk : room);
+            uInt out_before = zs.avail_out;
+            rc = deflate(&zs, remaining ? Z_NO_FLUSH : Z_FINISH);
+            if (rc != Z_OK && rc != Z_STREAM_END && rc != Z_BUF_ERROR) {
+                deflateEnd(&zs);
+                return -3;
+            }
+            if (rc == Z_BUF_ERROR && zs.avail_in == 0 && remaining == 0 &&
+                zs.avail_out == out_before) {
+                // no forward progress possible: corrupt state, don't spin
+                deflateEnd(&zs);
+                return -3;
+            }
+        } while (rc != Z_STREAM_END);
+        pos += int64_t(zs.next_out - rec_start);
         out_offsets[i + 1] = pos;
+        deflateReset(&zs);
     }
+    deflateEnd(&zs);
     return pos;
 }
 
