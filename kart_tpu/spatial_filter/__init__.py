@@ -162,8 +162,8 @@ class SpatialFilter:
     """A filter ready to test features of one dataset: the filter envelope
     and full polygon geometry (all parts, all holes), pre-transformed into
     the dataset's CRS. Matching is the reference's two stages
-    (spatial_filter/__init__.py:534-590): envelope fast-path, then an exact
-    polygon-vs-feature-envelope test for the residue."""
+    (spatial_filter/__init__.py:534-590): envelope fast-path, then GEOS
+    Intersects semantics on the actual feature geometry for the residue."""
 
     MATCH_ALL = None  # set below
 
@@ -172,6 +172,7 @@ class SpatialFilter:
         self.rect = rect_wesn  # (w, e, s, n) in dataset CRS
         self.geom_column_name = geom_column_name
         self.polygon_parts = polygon_parts  # [(outer, [holes]), ...] dataset CRS
+        self._rect_parts = None  # lazy: the rect as a polygon part
 
     @classmethod
     def for_dataset(cls, spec, dataset):
@@ -230,19 +231,51 @@ class SpatialFilter:
         return self.match_geometry(geom)
 
     def match_geometry(self, geom) -> MatchResult:
+        """Staged exactly like the reference (envelope fast-path, then a
+        real-geometry intersection for the residue — GEOS Intersects
+        semantics, kart/spatial_filter/__init__.py:556-590): a feature whose
+        *envelope* clips the filter but whose geometry doesn't must be
+        NOT_MATCHED."""
         if geom is None:
             return MatchResult.MATCHED  # NULL geometry always matches (ref.)
         env = Geometry.of(geom).envelope()
         if env is None:
             return MatchResult.MATCHED  # empty geometry
-        w, e, s, n = self.rect
-        if not _rect_overlaps(env, (w, e, s, n)):
+        if not _rect_overlaps(env, self.rect):
             return MatchResult.NOT_MATCHED
-        if self.polygon_parts is not None and not _polygon_set_intersects_rect(
-            self.polygon_parts, env
-        ):
-            return MatchResult.NOT_MATCHED
-        return MatchResult.MATCHED
+
+        filter_parts = self.polygon_parts
+        if filter_parts is None:
+            # rectangular filter: envelope fully inside => geometry inside
+            x0, x1, y0, y1 = env
+            w, e, s, n = self.rect
+            if w <= x0 and x1 <= e and s <= y0 and y1 <= n:
+                return MatchResult.MATCHED
+            filter_parts = self._rect_as_parts()
+        else:
+            rel = _polygon_set_env_relation(filter_parts, env)
+            if rel == "disjoint":
+                return MatchResult.NOT_MATCHED
+            if rel == "contains":
+                return MatchResult.MATCHED  # whole envelope inside the filter
+        # residue: the filter polygon only partially covers the envelope —
+        # decide on the actual feature geometry
+        feat = _feature_geom_parts(geom)
+        if feat is None:
+            return MatchResult.MATCHED  # unparseable: fail open (ref. does)
+        if _geom_intersects_polygon_set(feat, filter_parts):
+            return MatchResult.MATCHED
+        return MatchResult.NOT_MATCHED
+
+    def _rect_as_parts(self):
+        """The rect filter as a polygon part, for the exact residue test."""
+        if self._rect_parts is None:
+            w, e, s, n = self.rect
+            ring = np.array(
+                [(w, s), (e, s), (e, n), (w, n), (w, s)], dtype=np.float64
+            )
+            self._rect_parts = [(ring, [])]
+        return self._rect_parts
 
     def matches_envelope(self, env):
         if self.match_all:
@@ -286,28 +319,196 @@ def _polygon_parts(geometry):
     return parts or None
 
 
-def _polygon_set_intersects_rect(parts, env):
-    """Exact (multi)polygon-with-holes vs rectangle intersection: any part
-    whose closed region meets the rect. ``parts``: [(outer, [holes]), ...]."""
-    return any(_one_polygon_intersects_rect(outer, holes, env)
-               for outer, holes in parts)
-
-
-def _one_polygon_intersects_rect(outer, holes, env):
-    """A boundary edge of any ring crossing the rect means the rect touches
-    the polygon's closure (points just outside a hole edge are interior).
-    With no boundary crossing, containment is uniform over the rect, so one
-    rect corner decides: inside the outer ring and outside every hole."""
+def _polygon_set_env_relation(parts, env):
+    """Filter polygon set vs feature envelope: "disjoint" (no part meets the
+    rect), "contains" (one part's region covers the whole rect — geometry
+    inside guaranteed), or "partial" (needs the exact residue test)."""
     x0, x1, y0, y1 = env
-    for ring in (outer, *holes):
-        xs, ys = ring[:, 0], ring[:, 1]
-        ax, ay = xs, ys
-        bx, by = np.roll(xs, -1), np.roll(ys, -1)
-        if np.any(_segment_hits_rect(ax, ay, bx, by, x0, x1, y0, y1)):
+    any_hit = False
+    for outer, holes in parts:
+        crossing = False
+        for ring in (outer, *holes):
+            xs, ys = ring[:, 0], ring[:, 1]
+            if np.any(
+                _segment_hits_rect(
+                    xs, ys, np.roll(xs, -1), np.roll(ys, -1), x0, x1, y0, y1
+                )
+            ):
+                crossing = True
+                break
+        if crossing:
+            any_hit = True
+            continue  # boundary passes through the rect: partial by this part
+        if _point_in_ring(outer, x0, y0) and not any(
+            _point_in_ring(hole, x0, y0) for hole in holes
+        ):
+            # no boundary inside the rect + one corner interior => the whole
+            # rect is interior to this part
+            return "contains"
+    if not any_hit:
+        return "disjoint"
+    return "partial"
+
+
+def _point_in_polygon_set(parts, px, py):
+    """GEOS-style containment in a (multi)polygon with holes."""
+    for outer, holes in parts:
+        if _point_in_ring(outer, px, py) and not any(
+            _point_in_ring(h, px, py) for h in holes
+        ):
             return True
-    if not _point_in_ring(outer, x0, y0):
+    return False
+
+
+def _feature_geom_parts(geom):
+    """Feature geometry -> {"points": (p,2) array, "lines": [(n,2)],
+    "polys": [(outer, [holes])]} over every part of any WKB type, or None
+    when unparseable."""
+    from kart_tpu.geometry import parse_wkb
+
+    try:
+        value = parse_wkb(Geometry.of(geom).to_wkb())
+    except Exception:
+        return None
+
+    points, lines, polys = [], [], []
+
+    def walk(v):
+        name, payload = v[0], v.payload
+        if payload is None:
+            return
+        if name == "Point":
+            points.append(payload[:2])
+        elif name == "MultiPoint":
+            for child in payload:
+                walk(child)
+        elif name == "LineString":
+            if len(payload) >= 2:
+                lines.append(np.asarray(payload, dtype=np.float64)[:, :2])
+        elif name == "MultiLineString":
+            for child in payload:
+                walk(child)
+        elif name == "Polygon":
+            rings = [
+                np.asarray(r, dtype=np.float64)[:, :2]
+                for r in payload
+                if len(r) >= 3
+            ]
+            if rings:
+                polys.append((rings[0], rings[1:]))
+        elif name in ("MultiPolygon", "GeometryCollection"):
+            for child in payload:
+                walk(child)
+
+    walk(value)
+    return {
+        "points": np.asarray(points, dtype=np.float64).reshape(-1, 2),
+        "lines": lines,
+        "polys": polys,
+    }
+
+
+def _ring_segments(ring):
+    a = ring
+    b = np.roll(ring, -1, axis=0)
+    return a, b
+
+
+def _segments_cross(a0, a1, b0, b1, chunk=1024):
+    """Any segment of set A touches/crosses any of set B (GEOS Intersects
+    counts touching). a0/a1: (na,2); b0/b1: (nb,2). Pairwise orientation
+    test, chunked over A to bound the (na, nb) broadcast."""
+
+    def cross(ox, oy, ax, ay, bx, by):
+        return (ax - ox) * (by - oy) - (ay - oy) * (bx - ox)
+
+    na = len(a0)
+    for lo in range(0, na, chunk):
+        p0 = a0[lo : lo + chunk][:, None, :]  # (ca,1,2)
+        p1 = a1[lo : lo + chunk][:, None, :]
+        q0 = b0[None, :, :]  # (1,nb,2)
+        q1 = b1[None, :, :]
+        d1 = cross(p0[..., 0], p0[..., 1], p1[..., 0], p1[..., 1], q0[..., 0], q0[..., 1])
+        d2 = cross(p0[..., 0], p0[..., 1], p1[..., 0], p1[..., 1], q1[..., 0], q1[..., 1])
+        d3 = cross(q0[..., 0], q0[..., 1], q1[..., 0], q1[..., 1], p0[..., 0], p0[..., 1])
+        d4 = cross(q0[..., 0], q0[..., 1], q1[..., 0], q1[..., 1], p1[..., 0], p1[..., 1])
+        proper = ((d1 > 0) != (d2 > 0)) & ((d3 > 0) != (d4 > 0))
+        if np.any(proper):
+            return True
+        # touching / collinear-overlap: an endpoint of one lies on the other
+        if np.any(
+            (d1 == 0) & _on_segment(p0, p1, q0)
+            | (d2 == 0) & _on_segment(p0, p1, q1)
+            | (d3 == 0) & _on_segment(q0, q1, p0)
+            | (d4 == 0) & _on_segment(q0, q1, p1)
+        ):
+            return True
+    return False
+
+
+def _on_segment(s0, s1, p):
+    """p collinear with segment (s0, s1): is it within the segment's bbox?"""
+    return (
+        (p[..., 0] >= np.minimum(s0[..., 0], s1[..., 0]))
+        & (p[..., 0] <= np.maximum(s0[..., 0], s1[..., 0]))
+        & (p[..., 1] >= np.minimum(s0[..., 1], s1[..., 1]))
+        & (p[..., 1] <= np.maximum(s0[..., 1], s1[..., 1]))
+    )
+
+
+def _filter_ring_segs(parts):
+    rings = []
+    for outer, holes in parts:
+        rings.append(outer)
+        rings.extend(holes)
+    a = np.concatenate([r for r in rings])
+    b = np.concatenate([np.roll(r, -1, axis=0) for r in rings])
+    return a, b
+
+
+def _geom_intersects_polygon_set(feat, parts):
+    """GEOS Intersects(filter polygon set, feature geometry) over the parsed
+    feature parts (points/lines/polygons)."""
+    pts = feat["points"]
+    for i in range(len(pts)):
+        if _point_in_polygon_set(parts, pts[i, 0], pts[i, 1]):
+            return True
+    if not feat["lines"] and not feat["polys"]:
+        if len(pts):
+            # points only: boundary touch — a point exactly on a filter edge
+            fa, fb = _filter_ring_segs(parts)
+            p = pts[:, None, :]
+            d = (fb[None, :, 0] - fa[None, :, 0]) * (p[..., 1] - fa[None, :, 1]) - (
+                fb[None, :, 1] - fa[None, :, 1]
+            ) * (p[..., 0] - fa[None, :, 0])
+            if np.any((d == 0) & _on_segment(fa[None, :, :], fb[None, :, :], p)):
+                return True
         return False
-    return not any(_point_in_ring(hole, x0, y0) for hole in holes)
+
+    fa, fb = _filter_ring_segs(parts)
+    for line in feat["lines"]:
+        a0, a1 = line[:-1], line[1:]
+        if len(a0) and _segments_cross(a0, a1, fa, fb):
+            return True
+        # no boundary crossing: the line is wholly inside or outside
+        if _point_in_polygon_set(parts, line[0, 0], line[0, 1]):
+            return True
+    for outer, holes in feat["polys"]:
+        for ring in (outer, *holes):
+            r0, r1 = _ring_segments(ring)
+            if _segments_cross(r0, r1, fa, fb):
+                return True
+        # no boundary crossing: disjoint, feature inside filter, or filter
+        # inside feature (possibly inside a feature hole)
+        if _point_in_polygon_set(parts, outer[0, 0], outer[0, 1]):
+            return True
+        for fouter, _fholes in parts:
+            fx, fy = fouter[0, 0], fouter[0, 1]
+            if _point_in_ring(outer, fx, fy) and not any(
+                _point_in_ring(h, fx, fy) for h in holes
+            ):
+                return True
+    return False
 
 
 def _point_in_ring(ring, px, py):
