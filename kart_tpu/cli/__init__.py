@@ -47,6 +47,7 @@ _COMMANDS = {
     "fetch": "kart_tpu.cli.remote_cmds",
     "remote": "kart_tpu.cli.remote_cmds",
     "serve": "kart_tpu.cli.remote_cmds",
+    "serve-stdio": "kart_tpu.cli.remote_cmds",
     "spatial-filter": "kart_tpu.cli.spatial_cmds",
     "upgrade": "kart_tpu.cli.upgrade_cmds",
     "upgrade-to-kart": "kart_tpu.cli.upgrade_cmds",

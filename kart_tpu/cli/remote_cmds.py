@@ -241,3 +241,27 @@ def serve(ctx, host, port):
         http_serve(repo, host, port)
     except KeyboardInterrupt:
         click.echo("Stopped.")
+
+
+@cli.command("serve-stdio")
+@click.argument("path", type=click.Path(exists=True))
+def serve_stdio_cmd(path):
+    """Serve the repository at PATH over stdin/stdout (one connection).
+
+    The server half of ssh remotes: clients spawn
+    ``ssh host kart serve-stdio <path>`` and exchange framed kartpack
+    messages over the pipe. Not for interactive use.
+    """
+    import os
+    import sys
+
+    from kart_tpu.core.repo import KartRepo
+    from kart_tpu.transport.stdio import serve_stdio
+
+    repo = KartRepo(path)
+    # PATH must BE the repo — KartRepo's parent-directory search must not
+    # silently serve whatever repository encloses a wrong path (same guard
+    # as open_remote)
+    if os.path.realpath(repo.workdir or repo.gitdir) != os.path.realpath(path):
+        raise CliError(f"Not a repository: {path!r}")
+    serve_stdio(repo, sys.stdin.buffer, sys.stdout.buffer)

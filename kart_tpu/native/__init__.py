@@ -139,7 +139,7 @@ def ensure_built():
 # -- object-store IO core (native/kart_io.cpp) ------------------------------
 
 _IO_LIB_NAME = "libkart_io.so"
-_IO_ABI_VERSION = 1
+_IO_ABI_VERSION = 2  # v2: io_classify_sorted
 
 _io_lib = None
 _io_load_attempted = False
@@ -163,8 +163,16 @@ def load_io():
         lib = ctypes.CDLL(path)
         lib.io_abi_version.restype = ctypes.c_int
         if lib.io_abi_version() != _IO_ABI_VERSION:
-            L.warning("native IO lib %s has wrong ABI version; ignoring", path)
-            return None
+            # a stale build from an older checkout: rebuild in place (the
+            # Makefile links via temp+rename, so this dlopen picks up the
+            # fresh inode) rather than silently dropping every native path
+            L.warning("native IO lib %s has stale ABI; rebuilding", path)
+            if override or not _run_make():
+                return None
+            lib = ctypes.CDLL(path)
+            lib.io_abi_version.restype = ctypes.c_int
+            if lib.io_abi_version() != _IO_ABI_VERSION:
+                return None
         lib.io_pack_ptrs.restype = ctypes.c_int64
         lib.io_pack_ptrs.argtypes = [
             ctypes.POINTER(ctypes.c_char_p), ctypes.c_void_p,
@@ -172,10 +180,49 @@ def load_io():
             ctypes.c_void_p, ctypes.c_void_p, ctypes.c_int64,
             ctypes.c_void_p,
         ]
+        lib.io_classify_sorted.restype = ctypes.c_int64
+        lib.io_classify_sorted.argtypes = [
+            ctypes.c_void_p, ctypes.c_void_p, ctypes.c_int64,
+            ctypes.c_void_p, ctypes.c_void_p, ctypes.c_int64,
+            ctypes.c_void_p, ctypes.c_void_p, ctypes.c_void_p,
+        ]
         _io_lib = lib
     except (OSError, AttributeError) as e:
         L.warning("could not load native IO lib %s: %s", path, e)
     return _io_lib
+
+
+def classify_sorted(old_keys, old_oids_u8, new_keys, new_oids_u8):
+    """Native merge-join diff classify over key-sorted columns; -> (old_class
+    int8 (n_old,), new_class (n_new,), counts dict) or None when the IO lib
+    isn't available. Bit-identical to the numpy reference twin (tested)."""
+    lib = load_io()
+    if lib is None:
+        return None
+    n_old, n_new = len(old_keys), len(new_keys)
+    old_keys = np.ascontiguousarray(old_keys, dtype=np.int64)
+    new_keys = np.ascontiguousarray(new_keys, dtype=np.int64)
+    old_oids_u8 = np.ascontiguousarray(old_oids_u8, dtype=np.uint8)
+    new_oids_u8 = np.ascontiguousarray(new_oids_u8, dtype=np.uint8)
+    old_class = np.zeros(n_old, dtype=np.int8)
+    new_class = np.zeros(n_new, dtype=np.int8)
+    counts = np.zeros(3, dtype=np.int64)
+    rc = lib.io_classify_sorted(
+        old_keys.ctypes.data, old_oids_u8.ctypes.data, n_old,
+        new_keys.ctypes.data, new_oids_u8.ctypes.data, n_new,
+        old_class.ctypes.data, new_class.ctypes.data, counts.ctypes.data,
+    )
+    if rc != 0:
+        return None
+    return (
+        old_class,
+        new_class,
+        {
+            "inserts": int(counts[0]),
+            "updates": int(counts[1]),
+            "deletes": int(counts[2]),
+        },
+    )
 
 
 def pack_objects_batch(obj_type, contents, level=1):

@@ -43,7 +43,6 @@ from urllib.parse import urlsplit
 from urllib.request import Request, urlopen
 
 from kart_tpu.core.odb import ObjectMissing
-from kart_tpu.core.refs import RefError, check_ref_format
 from kart_tpu.transport.pack import read_pack, write_pack
 from kart_tpu.transport.protocol import ObjectEnumerator
 
@@ -228,92 +227,28 @@ class KartRequestHandler(BaseHTTPRequestHandler):
             self._json(500, {"error": f"{type(e).__name__}: {e}"})
 
     def _handle_refs(self):
-        from kart_tpu.transport.remote import read_shallow
+        from kart_tpu.transport.service import ls_refs_info
 
-        repo = self.repo
-        heads = {
-            ref[len("refs/heads/"):]: oid
-            for ref, oid in repo.refs.iter_refs("refs/heads/")
-        }
-        tags = {
-            ref[len("refs/tags/"):]: oid
-            for ref, oid in repo.refs.iter_refs("refs/tags/")
-        }
-        kind, target = repo.refs.head_target()
-        head_branch = (
-            target[len("refs/heads/"):]
-            if kind == "symbolic" and target.startswith("refs/heads/")
-            else None
-        )
-        self._json(
-            200,
-            {
-                "heads": heads,
-                "tags": tags,
-                "head_branch": head_branch,
-                "shallow": sorted(read_shallow(repo)),
-            },
-        )
+        self._json(200, ls_refs_info(self.repo))
 
     def _handle_fetch_pack(self):
-        from kart_tpu.transport.remote import read_shallow
+        from kart_tpu.transport.service import make_fetch_enum
 
         req = json.loads(self._read_body().decode() or "{}")
-        repo = self.repo
-        blob_filter = None
-        if req.get("filter"):
-            from kart_tpu.spatial_filter import blob_filter_for_spec
-
-            blob_filter = blob_filter_for_spec(repo, req["filter"])
-        has = None
-        if req.get("haves"):
-            closure = have_closure(
-                repo.odb, req["haves"], req.get("have_shallow", ())
-            )
-            has = closure.__contains__
-        enum = ObjectEnumerator(
-            repo.odb,
-            req.get("wants", []),
-            has=has,
-            depth=req.get("depth"),
-            blob_filter=blob_filter,
-            sender_shallow=read_shallow(repo),
-        )
         # the enumerator streams straight into the spooled pack; the header
         # callable reads its counters only after the drain
-        self._framed(
-            lambda: {
-                "shallow_boundary": sorted(enum.shallow_boundary),
-                "object_count": enum.object_count,
-                "omitted_blob_count": enum.omitted_blob_count,
-            },
-            enum,
-        )
+        enum, header = make_fetch_enum(self.repo, req)
+        self._framed(header, enum)
 
     def _handle_fetch_blobs(self):
+        from kart_tpu.transport.service import collect_blobs
+
         req = json.loads(self._read_body().decode() or "{}")
-        repo = self.repo
-
-        missing = []
-
-        def pull():
-            for oid in req.get("oids", []):
-                try:
-                    yield repo.odb.read_raw(oid)
-                except ObjectMissing:
-                    missing.append(oid)
-
-        objects = list(pull())
-        self._framed({"missing": missing}, objects)
-
-    def _current_branch_ref(self):
-        kind, target = self.repo.refs.head_target()
-        if kind == "symbolic":
-            return target
-        return None
+        header, objects = collect_blobs(self.repo, req.get("oids", []))
+        self._framed(header, objects)
 
     def _handle_receive_pack(self):
-        from kart_tpu.transport.remote import _update_shallow
+        from kart_tpu.transport.service import locked_ref_updates
 
         repo = self.repo
         with self._read_body_spooled() as body:
@@ -321,64 +256,15 @@ class KartRequestHandler(BaseHTTPRequestHandler):
             for obj_type, content in read_pack(pack_fp):
                 repo.odb.write_raw(obj_type, content)
 
-        deny_current = (
-            repo.workdir is not None
-            and (repo.config.get("receive.denyCurrentBranch") or "refuse").lower()
-            not in ("ignore", "false")
-        )
-
-        updated = {}
-        # compare-and-swap must be atomic across handler threads: without
-        # the lock two concurrent pushes both pass the check and one update
-        # is silently lost. All updates are validated before any is applied
-        # so a rejected request leaves no ref moved.
+        # compare-and-swap must be atomic across handler threads AND across
+        # processes (an ssh push is a separate serve-stdio process): thread
+        # lock here, gitdir file lock inside locked_ref_updates.
         with self.server.push_lock:
-            updates = header.get("updates", [])
-            for upd in updates:
-                ref, old, new = upd["ref"], upd.get("old"), upd.get("new")
-                # wire-supplied names must be real refs — git's receive-pack
-                # rejects non-refs/ names via check_refname_format; without
-                # this a push with ref='config' or 'HEAD' would overwrite
-                # arbitrary gitdir files.
-                try:
-                    check_ref_format(ref, require_refs_prefix=True)
-                except RefError as e:
-                    return self._json(400, {"error": str(e)})
-                if deny_current and ref == self._current_branch_ref():
-                    return self._json(
-                        409,
-                        {
-                            "error": f"Refusing to update checked-out branch "
-                            f"{ref} (the server's working copy would go out "
-                            f"of sync). Serve a bare repo, or set "
-                            f"receive.denyCurrentBranch=ignore there."
-                        },
-                    )
-                current = repo.refs.get(ref)
-                if not upd.get("force") and current != old:
-                    return self._json(
-                        409,
-                        {
-                            "error": f"Ref {ref} moved (expected {old}, is "
-                            f"{current}); fetch first or use --force"
-                        },
-                    )
-                if new is not None and not repo.odb.contains(new):
-                    return self._json(
-                        400, {"error": f"Push incomplete: {new} not received"}
-                    )
-            for upd in updates:
-                ref, new = upd["ref"], upd.get("new")
-                if new is None:
-                    if repo.refs.get(ref) is not None:
-                        repo.refs.delete(ref)
-                    updated[ref] = None
-                else:
-                    repo.refs.set(ref, new, log_message="push (http)")
-                    updated[ref] = new
-            if header.get("shallow"):
-                _update_shallow(repo, header["shallow"])
-        self._json(200, {"updated": updated})
+            status, payload = locked_ref_updates(repo, header)
+        if status == "ok":
+            self._json(200, {"updated": payload})
+        else:
+            self._json(409 if status == "conflict" else 400, {"error": payload})
 
 
 def make_server(repo, host="127.0.0.1", port=0):

@@ -60,20 +60,38 @@ def is_http_url(url):
     return url.startswith("http://") or url.startswith("https://")
 
 
+def network_remote(url):
+    """The wire client for a network URL — HttpRemote for http(s),
+    StdioRemote for ssh:// / scp-like — or None for local paths. Both
+    clients speak the same verb API (ls_refs / fetch_pack / fetch_blobs /
+    receive_pack), so every caller is transport-agnostic."""
+    if is_http_url(url):
+        from kart_tpu.transport.http import HttpRemote
+
+        return HttpRemote(url)
+    from kart_tpu.transport.stdio import StdioRemote, is_ssh_url
+
+    if is_ssh_url(url):
+        return StdioRemote(url)
+    return None
+
+
 def open_remote(url) -> KartRepo:
     """Resolve a *local* remote URL to a repository (local paths + file://).
-    HTTP remotes don't open as repos — the fetch/push/clone verbs route them
-    through kart_tpu.transport.http instead."""
+    Network remotes don't open as repos — the fetch/push/clone verbs route
+    them through their wire client instead."""
     if url.startswith("file://"):
         url = url[len("file://") :]
-    if is_http_url(url):
+    from kart_tpu.transport.stdio import is_ssh_url
+
+    if is_http_url(url) or is_ssh_url(url):
         raise RemoteError(
-            f"HTTP remote {url!r} has no local repository to open"
+            f"Network remote {url!r} has no local repository to open"
         )
     if "://" in url:
         raise RemoteError(
             f"Unsupported remote URL scheme: {url!r} "
-            f"(local paths, file:// and http(s):// only)"
+            f"(local paths, file://, http(s):// and ssh:// only)"
         )
     try:
         repo = KartRepo(url)
@@ -91,7 +109,9 @@ def open_remote(url) -> KartRepo:
 def normalise_url(url):
     """Local-path URLs are stored absolute, so the remote resolves no matter
     what directory later commands run from."""
-    if url.startswith("file://") or "://" in url:
+    from kart_tpu.transport.stdio import is_ssh_url
+
+    if url.startswith("file://") or "://" in url or is_ssh_url(url):
         return url
     return os.path.abspath(url)
 
@@ -197,17 +217,17 @@ def fetch(repo, remote_name="origin", *, depth=None, filter_spec=None, quiet=Tru
         if spec and spec.startswith("extension:spatial="):
             filter_spec = spec[len("extension:spatial=") :]
 
-    if is_http_url(remote.url):
-        from kart_tpu.transport.http import HttpRemote, HttpTransportError
+    net = network_remote(remote.url)
+    if net is not None:
+        from kart_tpu.transport.http import HttpTransportError
 
-        http = HttpRemote(remote.url)
         try:
-            info = http.ls_refs()
+            info = net.ls_refs()
             branch_tips = info["heads"]
             tag_tips = info["tags"]
             head_branch = info.get("head_branch")
             wants = list(branch_tips.values()) + list(tag_tips.values())
-            header = http.fetch_pack(
+            header = net.fetch_pack(
                 repo,
                 wants,
                 haves=[oid for _, oid in repo.refs.iter_refs("refs/")],
@@ -335,18 +355,14 @@ def _record_push_tracking(repo, remote_name, src_ref, dst_ref, new_oid, set_upst
         )
 
 
-def _push_http(repo, remote_name, url, refspecs, *, force, set_upstream):
-    """Push over HTTP: client-side enumeration against the server's declared
-    tips, compare-and-swap ref updates server-side."""
-    from kart_tpu.transport.http import (
-        HttpRemote,
-        HttpTransportError,
-        have_closure,
-    )
+def _push_network(repo, remote_name, net, refspecs, *, force, set_upstream):
+    """Push over a wire transport (HTTP or ssh/stdio): client-side
+    enumeration against the server's declared tips, compare-and-swap ref
+    updates server-side."""
+    from kart_tpu.transport.http import HttpTransportError, have_closure
 
-    http = HttpRemote(url)
     try:
-        info = http.ls_refs()
+        info = net.ls_refs()
     except HttpTransportError as e:
         raise RemoteError(str(e))
     server_refs = {f"refs/heads/{b}": o for b, o in info["heads"].items()}
@@ -367,7 +383,7 @@ def _push_http(repo, remote_name, url, refspecs, *, force, set_upstream):
                 if dst_ref not in server_refs:
                     raise RemoteError(f"Remote ref does not exist: {dst_ref}")
                 updated.update(
-                    http.receive_pack(
+                    net.receive_pack(
                         [],
                         [
                             {
@@ -402,7 +418,7 @@ def _push_http(repo, remote_name, url, refspecs, *, force, set_upstream):
                 sender_shallow=read_shallow(repo),
             )
             updated.update(
-                http.receive_pack(
+                net.receive_pack(
                     enum,
                     [
                         {
@@ -434,11 +450,12 @@ def push(repo, remote_name="origin", refspecs=(), *, force=False, set_upstream=F
             raise RemoteError("Cannot push: HEAD is detached and no refspec given")
         refspecs = [f"{branch}:{branch}"]
 
-    if is_http_url(remote.url):
-        return _push_http(
+    net = network_remote(remote.url)
+    if net is not None:
+        return _push_network(
             repo,
             remote_name,
-            remote.url,
+            net,
             refspecs,
             force=force,
             set_upstream=set_upstream,
@@ -589,11 +606,12 @@ def fetch_promised_blobs(repo, oids):
             break
     if promisor is None:
         raise RemoteError("No promisor remote configured")
-    if is_http_url(promisor.url):
-        from kart_tpu.transport.http import HttpRemote, HttpTransportError
+    net = network_remote(promisor.url)
+    if net is not None:
+        from kart_tpu.transport.http import HttpTransportError
 
         try:
-            return HttpRemote(promisor.url).fetch_blobs(repo, oids)
+            return net.fetch_blobs(repo, oids)
         except HttpTransportError as e:
             raise RemoteError(str(e))
     src = promisor.open()

@@ -1,0 +1,167 @@
+"""Transport-agnostic server-side operations.
+
+The four verbs every kart_tpu transport speaks — ls-refs, fetch-pack,
+fetch-blobs, receive-pack — implemented once over a repo, shared by the HTTP
+server (:mod:`kart_tpu.transport.http`) and the stdio/ssh server
+(:mod:`kart_tpu.transport.stdio`). The reference gets the same sharing from
+git itself: upload-pack/receive-pack behave identically whether invoked by
+``git daemon``, ssh, or https (kart/cli.py:211-253).
+"""
+
+from kart_tpu.core.odb import ObjectMissing
+from kart_tpu.core.refs import RefError, check_ref_format
+from kart_tpu.transport.protocol import ObjectEnumerator
+
+
+def ls_refs_info(repo):
+    """The advertisement: branch/tag tips, HEAD branch, shallow set."""
+    from kart_tpu.transport.remote import read_shallow
+
+    heads = {
+        ref[len("refs/heads/"):]: oid
+        for ref, oid in repo.refs.iter_refs("refs/heads/")
+    }
+    tags = {
+        ref[len("refs/tags/"):]: oid
+        for ref, oid in repo.refs.iter_refs("refs/tags/")
+    }
+    kind, target = repo.refs.head_target()
+    head_branch = (
+        target[len("refs/heads/"):]
+        if kind == "symbolic" and target.startswith("refs/heads/")
+        else None
+    )
+    return {
+        "heads": heads,
+        "tags": tags,
+        "head_branch": head_branch,
+        "shallow": sorted(read_shallow(repo)),
+    }
+
+
+def make_fetch_enum(repo, req):
+    """fetch-pack request dict -> (ObjectEnumerator, header_fn). The header
+    callable reads the enumerator's counters, so evaluate it only after the
+    pack drain."""
+    from kart_tpu.transport.remote import read_shallow
+    from kart_tpu.transport.http import have_closure
+
+    blob_filter = None
+    if req.get("filter"):
+        from kart_tpu.spatial_filter import blob_filter_for_spec
+
+        blob_filter = blob_filter_for_spec(repo, req["filter"])
+    has = None
+    if req.get("haves"):
+        closure = have_closure(repo.odb, req["haves"], req.get("have_shallow", ()))
+        has = closure.__contains__
+    enum = ObjectEnumerator(
+        repo.odb,
+        req.get("wants", []),
+        has=has,
+        depth=req.get("depth"),
+        blob_filter=blob_filter,
+        sender_shallow=read_shallow(repo),
+    )
+
+    def header():
+        return {
+            "shallow_boundary": sorted(enum.shallow_boundary),
+            "object_count": enum.object_count,
+            "omitted_blob_count": enum.omitted_blob_count,
+        }
+
+    return enum, header
+
+
+def collect_blobs(repo, oids):
+    """fetch-blobs (promisor backfill): -> (header, [(type, content)])."""
+    missing = []
+    objects = []
+    for oid in oids:
+        try:
+            objects.append(repo.odb.read_raw(oid))
+        except ObjectMissing:
+            missing.append(oid)
+    return {"missing": missing}, objects
+
+
+def current_branch_ref(repo):
+    kind, target = repo.refs.head_target()
+    return target if kind == "symbolic" else None
+
+
+def locked_ref_updates(repo, header):
+    """apply_ref_updates under a cross-process gitdir file lock: every ssh
+    push spawns its own serve-stdio process, so an in-process lock can't
+    serialise the compare-and-swap (two concurrent pushes would both pass
+    the CAS check and one would be silently lost). The HTTP server holds
+    this too, so mixed http+ssh pushes against one repo stay safe."""
+    import os
+
+    lock_path = os.path.join(repo.gitdir, ".push-lock")
+    with open(lock_path, "w") as lock:
+        try:
+            import fcntl
+
+            fcntl.flock(lock, fcntl.LOCK_EX)
+        except ImportError:  # non-POSIX: best effort
+            pass
+        return apply_ref_updates(repo, header)
+
+
+def apply_ref_updates(repo, header):
+    """CAS-validate then apply a receive-pack's ref updates (the pack must
+    already be drained into the odb). All updates are validated before any
+    is applied, so a rejected request leaves no ref moved. The caller holds
+    whatever lock serialises concurrent pushes.
+
+    -> ("ok", {ref: oid|None}) | ("conflict", msg) | ("bad", msg)."""
+    from kart_tpu.transport.remote import _update_shallow
+
+    deny_current = (
+        repo.workdir is not None
+        and (repo.config.get("receive.denyCurrentBranch") or "refuse").lower()
+        not in ("ignore", "false")
+    )
+
+    updates = header.get("updates", [])
+    for upd in updates:
+        ref, old, new = upd["ref"], upd.get("old"), upd.get("new")
+        # wire-supplied names must be real refs — git's receive-pack rejects
+        # non-refs/ names via check_refname_format; without this a push with
+        # ref='config' or 'HEAD' would overwrite arbitrary gitdir files.
+        try:
+            check_ref_format(ref, require_refs_prefix=True)
+        except RefError as e:
+            return "bad", str(e)
+        if deny_current and ref == current_branch_ref(repo):
+            return (
+                "conflict",
+                f"Refusing to update checked-out branch {ref} (the server's "
+                f"working copy would go out of sync). Serve a bare repo, or "
+                f"set receive.denyCurrentBranch=ignore there.",
+            )
+        current = repo.refs.get(ref)
+        if not upd.get("force") and current != old:
+            return (
+                "conflict",
+                f"Ref {ref} moved (expected {old}, is {current}); "
+                f"fetch first or use --force",
+            )
+        if new is not None and not repo.odb.contains(new):
+            return "bad", f"Push incomplete: {new} not received"
+
+    updated = {}
+    for upd in updates:
+        ref, new = upd["ref"], upd.get("new")
+        if new is None:
+            if repo.refs.get(ref) is not None:
+                repo.refs.delete(ref)
+            updated[ref] = None
+        else:
+            repo.refs.set(ref, new, log_message="push")
+            updated[ref] = new
+    if header.get("shallow"):
+        _update_shallow(repo, header["shallow"])
+    return "ok", updated

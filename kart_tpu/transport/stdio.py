@@ -1,0 +1,294 @@
+"""SSH / stdio transport: the four transport verbs over a spawned process's
+stdin/stdout.
+
+The reference reaches ssh remotes by exec'ing its vendored git, which spawns
+``ssh host git-upload-pack/receive-pack`` and speaks the smart protocol over
+the pipe (kart/cli.py:211-253). The native equivalent here: the client
+spawns ``ssh [user@]host kart serve-stdio <path>`` (override the ssh binary
+with $KART_SSH, the remote-side kart executable with $KART_SSH_KART) and
+exchanges the same framed messages the HTTP transport uses —
+[8-byte header length][JSON header][kartpack bytes] — one request frame, one
+response frame, any number of exchanges per connection. Promisor fetch,
+shallow clones and server-side spatial filtering all ride the shared
+service layer (:mod:`kart_tpu.transport.service`), so semantics are
+byte-identical to the HTTP server's.
+
+URL forms (git's own):
+
+    ssh://[user@]host[:port]/abs/path
+    [user@]host:path        (scp-like)
+"""
+
+import json
+import os
+import shlex
+import subprocess
+import tempfile
+
+from kart_tpu.transport.http import (
+    _HEADER_LEN,
+    HttpTransportError,
+    read_framed,
+    write_framed,
+)
+from kart_tpu.transport.pack import read_pack
+
+
+class StdioTransportError(HttpTransportError):
+    """Transport failure over the spawned-process pipe. Subclasses the HTTP
+    error so remote.py's error handling covers both wire transports."""
+
+
+def parse_ssh_url(url):
+    """-> (userhost, port|None, path) for an ssh URL, or None.
+
+    A userhost or path beginning with '-' is rejected: it would reach the
+    spawned ssh as an option (the git CVE-2017-1000117 class — e.g.
+    '-oProxyCommand=...' executing locally)."""
+
+    def checked(userhost, port, path):
+        if userhost.startswith("-") or path.startswith("-"):
+            return None
+        return userhost, port, path
+
+    if url.startswith("ssh://"):
+        rest = url[len("ssh://"):]
+        hostpart, slash, path = rest.partition("/")
+        if not slash:
+            return None
+        port = None
+        userhost = hostpart
+        user, at, host = hostpart.rpartition("@")
+        if host.startswith("["):  # bracketed IPv6: [::1] or [::1]:2222
+            addr, bracket, tail = host.partition("]")
+            if not bracket:
+                return None
+            userhost = (user + at if at else "") + addr[1:]
+            if tail.startswith(":"):
+                port = tail[1:]
+            elif tail:
+                return None
+        elif ":" in host:
+            hostonly, _, port = host.rpartition(":")
+            userhost = (user + at if at else "") + hostonly
+        return checked(userhost, port, "/" + path)
+    if "://" in url:
+        return None
+    # scp-like [user@]host:path — no '/' before the colon, and not a
+    # one-letter head (Windows drive)
+    head, sep, path = url.partition(":")
+    if sep and "/" not in head and len(head) > 1 and path:
+        return checked(head, None, path)
+    return None
+
+
+def is_ssh_url(url):
+    return parse_ssh_url(url) is not None
+
+
+class StdioRemote:
+    """Client half: mirrors HttpRemote's verb API over one spawned process.
+    The subprocess starts lazily and is reused across calls (one ssh
+    connection per remote instance, like git)."""
+
+    def __init__(self, url):
+        self.url = url
+        parsed = parse_ssh_url(url)
+        if parsed is None:
+            raise StdioTransportError(f"Not an ssh remote: {url!r}")
+        self.userhost, self.port, self.path = parsed
+        self._proc = None
+
+    # -- process management --------------------------------------------------
+
+    def _command(self):
+        ssh = shlex.split(os.environ.get("KART_SSH", "ssh"))
+        kart = os.environ.get("KART_SSH_KART", "kart")
+        cmd = list(ssh)
+        if self.port:
+            cmd += ["-p", str(self.port)]
+        cmd += [self.userhost, f"{kart} serve-stdio {shlex.quote(self.path)}"]
+        return cmd
+
+    def _ensure(self):
+        if self._proc is not None and self._proc.poll() is None:
+            return self._proc
+        try:
+            self._proc = subprocess.Popen(
+                self._command(),
+                stdin=subprocess.PIPE,
+                stdout=subprocess.PIPE,
+                # stderr passes through: ssh auth prompts/errors stay visible
+            )
+        except OSError as e:
+            raise StdioTransportError(
+                f"Cannot spawn transport for {self.url!r}: {e}"
+            )
+        return self._proc
+
+    def close(self):
+        if self._proc is not None:
+            for fp in (self._proc.stdin, self._proc.stdout):
+                try:
+                    fp.close()
+                except OSError:
+                    pass
+            self._proc.wait(timeout=10)
+            self._proc = None
+
+    def __del__(self):  # best-effort; close() is the real API
+        try:
+            self.close()
+        except Exception:
+            pass
+
+    # -- framing -------------------------------------------------------------
+
+    def _rpc(self, header, objects=()):
+        """Send one framed request; -> (response header, pack fileobj).
+        The caller must fully drain the pack before the next call."""
+        proc = self._ensure()
+        try:
+            write_framed(proc.stdin, header, objects)
+            proc.stdin.flush()
+        except (OSError, ValueError) as e:
+            raise StdioTransportError(
+                f"Transport for {self.url!r} died while sending: {e}"
+            )
+        try:
+            resp, pack_fp = read_framed(proc.stdout)
+        except HttpTransportError:
+            rc = proc.poll()
+            raise StdioTransportError(
+                f"Remote {self.url!r} closed the connection"
+                + (f" (exit code {rc})" if rc is not None else "")
+            )
+        if "error" in resp:
+            # drain the (empty) pack so the pipe stays usable
+            for _ in read_pack(pack_fp):
+                pass
+            raise StdioTransportError(f"Remote {self.url!r} error: {resp['error']}")
+        return resp, pack_fp
+
+    # -- verbs (HttpRemote-compatible) ---------------------------------------
+
+    def ls_refs(self):
+        resp, pack_fp = self._rpc({"op": "refs"})
+        for _ in read_pack(pack_fp):
+            pass
+        return resp
+
+    def fetch_pack(self, dst_repo, wants, *, haves=(), have_shallow=(),
+                   depth=None, filter_spec=None):
+        resp, pack_fp = self._rpc(
+            {
+                "op": "fetch-pack",
+                "wants": list(wants),
+                "haves": list(haves),
+                "have_shallow": sorted(have_shallow),
+                "depth": depth,
+                "filter": filter_spec,
+            }
+        )
+        for obj_type, content in read_pack(pack_fp):
+            dst_repo.odb.write_raw(obj_type, content)
+        return resp
+
+    def fetch_blobs(self, dst_repo, oids):
+        resp, pack_fp = self._rpc({"op": "fetch-blobs", "oids": list(oids)})
+        fetched = 0
+        for obj_type, content in read_pack(pack_fp):
+            dst_repo.odb.write_raw(obj_type, content)
+            fetched += 1
+        if resp.get("missing"):
+            raise StdioTransportError(
+                f"Remote is missing promised objects: {resp['missing'][:5]}"
+            )
+        return fetched
+
+    def receive_pack(self, objects, updates, *, shallow=()):
+        resp, pack_fp = self._rpc(
+            lambda: {
+                "op": "receive-pack",
+                "updates": updates,
+                "shallow": sorted(shallow() if callable(shallow) else shallow),
+            },
+            objects,
+        )
+        for _ in read_pack(pack_fp):
+            pass
+        return resp["updated"]
+
+
+# ---------------------------------------------------------------------------
+# server side: `kart serve-stdio <path>`
+# ---------------------------------------------------------------------------
+
+
+def serve_stdio(repo, in_fp, out_fp):
+    """Serve one connection: read framed requests from ``in_fp`` until EOF,
+    answer each on ``out_fp``. stdout discipline is absolute — anything else
+    the process prints must go to stderr or the frames corrupt."""
+    from kart_tpu.transport.pack import PackFormatError
+    from kart_tpu.transport.service import (
+        collect_blobs,
+        locked_ref_updates,
+        ls_refs_info,
+        make_fetch_enum,
+    )
+
+    while True:
+        raw = in_fp.read(_HEADER_LEN.size)
+        if not raw:
+            return  # clean EOF: client closed the connection
+        if len(raw) != _HEADER_LEN.size:
+            raise StdioTransportError("Truncated request frame")
+        (n,) = _HEADER_LEN.unpack(raw)
+        if n > 1 << 24:
+            raise StdioTransportError("Request header implausibly large")
+        try:
+            header = json.loads(in_fp.read(n).decode())
+        except ValueError as e:
+            # stream position is unknowable now: answer + close
+            write_framed(out_fp, {"error": f"Bad request header: {e}"}, ())
+            out_fp.flush()
+            return
+        op = header.get("op")
+
+        try:
+            if op == "receive-pack":
+                # drain the request pack before replying
+                for obj_type, content in read_pack(in_fp):
+                    repo.odb.write_raw(obj_type, content)
+                status, payload = locked_ref_updates(repo, header)
+                if status == "ok":
+                    write_framed(out_fp, {"updated": payload}, ())
+                else:
+                    write_framed(out_fp, {"error": payload, "status": status}, ())
+            else:
+                # every other op carries an empty request pack
+                for _ in read_pack(in_fp):
+                    pass
+                if op == "refs":
+                    write_framed(out_fp, ls_refs_info(repo), ())
+                elif op == "fetch-pack":
+                    enum, resp_header = make_fetch_enum(repo, header)
+                    write_framed(out_fp, resp_header, enum)
+                elif op == "fetch-blobs":
+                    resp_header, objects = collect_blobs(
+                        repo, header.get("oids", [])
+                    )
+                    write_framed(out_fp, resp_header, objects)
+                else:
+                    write_framed(out_fp, {"error": f"Unknown op {op!r}"}, ())
+        except PackFormatError as e:
+            # a corrupt request pack desyncs the stream: answer + close
+            write_framed(out_fp, {"error": f"Bad request pack: {e}"}, ())
+            out_fp.flush()
+            return
+        except Exception as e:
+            # op-level failure (bad filter spec, missing object, ...): the
+            # request was fully read, so report and keep serving — the HTTP
+            # server's 500 equivalent
+            write_framed(out_fp, {"error": f"{type(e).__name__}: {e}"}, ())
+        out_fp.flush()

@@ -126,7 +126,7 @@ void sha1_final(Sha1Ctx* c, uint8_t out[20]) {
 
 extern "C" {
 
-int io_abi_version() { return 1; }
+int io_abi_version() { return 2; }  // v2: io_classify_sorted
 
 // Zero-copy variant: payloads stay in the caller's buffers (an array of
 // pointers — CPython bytes objects expose theirs directly), and the git
@@ -206,6 +206,56 @@ int64_t io_pack_ptrs(const uint8_t* const* ptrs, const int64_t* lens,
     }
     deflateEnd(&zs);
     return pos;
+}
+
+// Merge-join diff classification over two key-sorted (int64 key, 20-byte
+// oid) columns — the host-engine twin of the device classify kernel
+// (kart_tpu/ops/diff_kernel.py). Sequential scans + memcmp, where numpy's
+// searchsorted pays a cache miss per probe (measured 69s -> ~2s at 100M
+// rows). Classes: 0 unchanged, 1 insert, 2 update, 3 delete; counts out =
+// {inserts, updates, deletes}.
+int64_t io_classify_sorted(const int64_t* old_keys, const uint8_t* old_oids,
+                           int64_t n_old, const int64_t* new_keys,
+                           const uint8_t* new_oids, int64_t n_new,
+                           int8_t* old_class, int8_t* new_class,
+                           int64_t* counts) {
+    int64_t inserts = 0, updates = 0, deletes = 0;
+    int64_t i = 0, j = 0;
+    while (i < n_old && j < n_new) {
+        int64_t ka = old_keys[i], kb = new_keys[j];
+        if (ka == kb) {
+            if (std::memcmp(old_oids + i * 20, new_oids + j * 20, 20) == 0) {
+                old_class[i] = 0;
+                new_class[j] = 0;
+            } else {
+                old_class[i] = 2;
+                new_class[j] = 2;
+                updates++;
+            }
+            i++;
+            j++;
+        } else if (ka < kb) {
+            old_class[i] = 3;
+            deletes++;
+            i++;
+        } else {
+            new_class[j] = 1;
+            inserts++;
+            j++;
+        }
+    }
+    for (; i < n_old; i++) {
+        old_class[i] = 3;
+        deletes++;
+    }
+    for (; j < n_new; j++) {
+        new_class[j] = 1;
+        inserts++;
+    }
+    counts[0] = inserts;
+    counts[1] = updates;
+    counts[2] = deletes;
+    return 0;
 }
 
 }  // extern "C"

@@ -314,3 +314,49 @@ def test_sort_kernel_detects_oid_fold_collision():
     assert int(np.asarray(oc)[0]) == UPDATE
     assert int(np.asarray(nc)[0]) == UPDATE
     assert np.asarray(counts).tolist() == [0, 1, 0]
+
+
+def test_native_classify_matches_reference():
+    """classify_blocks_host (native C++ merge-join) is bit-identical to the
+    numpy reference twin, including empty sides and all-change blocks."""
+    import numpy as np
+
+    from kart_tpu.ops.blocks import FeatureBlock
+    from kart_tpu.ops.diff_kernel import (
+        classify_blocks_host,
+        classify_blocks_reference,
+    )
+
+    rng = np.random.default_rng(11)
+
+    def block(keys, oids_u8):
+        rows = (
+            np.ascontiguousarray(oids_u8).view(np.uint32).reshape(-1, 5)
+            if len(keys)
+            else np.zeros((0, 5), np.uint32)
+        )
+        return FeatureBlock.from_arrays(
+            np.asarray(keys, np.int64), rows, [""] * len(keys)
+        )
+
+    n = 5000
+    keys = np.sort(rng.choice(50_000, n, replace=False)).astype(np.int64)
+    oids = rng.integers(0, 256, (n, 20), dtype=np.uint8)
+    new_keys = np.concatenate([keys[10:], np.array([60_001, 60_002])])
+    new_oids = np.concatenate(
+        [oids[10:], rng.integers(0, 256, (2, 20), dtype=np.uint8)]
+    )
+    new_oids[::50] = rng.integers(0, 256, (len(new_oids[::50]), 20), np.uint8)
+
+    for a, b in [
+        (block(keys, oids), block(new_keys, new_oids)),
+        (block([], np.zeros((0, 20), np.uint8)), block(keys, oids)),
+        (block(keys, oids), block([], np.zeros((0, 20), np.uint8))),
+    ]:
+        ho, hn, hc = classify_blocks_host(a, b)
+        ro, rn = classify_blocks_reference(a, b)
+        assert np.array_equal(ho[: a.count], ro)
+        assert np.array_equal(hn[: b.count], rn)
+        assert hc["inserts"] == int(np.sum(rn == 1))
+        assert hc["updates"] == int(np.sum(ro == 2))
+        assert hc["deletes"] == int(np.sum(ro == 3))

@@ -1,0 +1,263 @@
+"""SSH/stdio transport: clone/push/pull/promisor against a pipe-spawned
+remote process (`kart serve-stdio`), exactly the two-process shape a real
+``ssh host kart serve-stdio`` runs — only the ssh binary is a stub that
+execs the command locally."""
+
+import os
+import stat
+import subprocess
+import sys
+
+import pytest
+
+from helpers import edit_commit, make_imported_repo
+from kart_tpu.transport.stdio import StdioRemote, is_ssh_url, parse_ssh_url
+
+
+def _install_fake_ssh(tmp_path, monkeypatch):
+    """A fake `ssh` that drops the host argument and runs the command
+    locally, plus a `kart` shim on PATH so the spawned command resolves —
+    the full spawn path (argv building, quoting, pipes) stays real."""
+    bindir = tmp_path / "bin"
+    bindir.mkdir(exist_ok=True)
+    kart = bindir / "kart"
+    kart.write_text(
+        "#!/bin/sh\n"
+        f'PYTHONPATH={os.path.dirname(os.path.dirname(os.path.abspath(__file__)))} '
+        f'exec {sys.executable} -m kart_tpu.cli "$@"\n'
+    )
+    kart.chmod(kart.stat().st_mode | stat.S_IEXEC)
+    fake_ssh = bindir / "fake-ssh"
+    fake_ssh.write_text(
+        "#!/bin/sh\n"
+        "# $1 = [user@]host (ignored), rest = the remote command string\n"
+        "shift\n"
+        'exec sh -c "$*"\n'
+    )
+    fake_ssh.chmod(fake_ssh.stat().st_mode | stat.S_IEXEC)
+    monkeypatch.setenv("KART_SSH", str(fake_ssh))
+    monkeypatch.setenv("PATH", f"{bindir}:{os.environ['PATH']}")
+    monkeypatch.setenv("JAX_PLATFORMS", "cpu")
+
+
+def test_url_parsing():
+    assert parse_ssh_url("ssh://alice@host:2222/srv/repo") == (
+        "alice@host",
+        "2222",
+        "/srv/repo",
+    )
+    assert parse_ssh_url("ssh://host/srv/repo") == ("host", None, "/srv/repo")
+    assert parse_ssh_url("alice@host:repos/x") == ("alice@host", None, "repos/x")
+    assert parse_ssh_url("host:/abs/path") == ("host", None, "/abs/path")
+    assert parse_ssh_url("/local/path") is None
+    assert parse_ssh_url("./rel:path") is None
+    assert parse_ssh_url("http://h/x") is None
+    assert parse_ssh_url("c:/windows/style") is None
+    assert is_ssh_url("host:/x") and not is_ssh_url("/x")
+
+
+@pytest.fixture()
+def ssh_remote_repo(tmp_path, monkeypatch):
+    """A served repo + the ssh URL that reaches it through the stub."""
+    _install_fake_ssh(tmp_path, monkeypatch)
+    (tmp_path / "server").mkdir()
+    repo, ds_path = make_imported_repo(tmp_path / "server", n=12)
+    repo.config["receive.denyCurrentBranch"] = "ignore"
+    url = f"testhost:{repo.workdir or repo.gitdir}"
+    return repo, ds_path, url
+
+
+def test_ls_refs_over_pipe(ssh_remote_repo):
+    repo, _, url = ssh_remote_repo
+    client = StdioRemote(url)
+    try:
+        info = client.ls_refs()
+        assert info["heads"]["main"] == repo.head_commit_oid
+        assert info["head_branch"] == "main"
+        # second call reuses the same connection
+        assert client.ls_refs()["heads"] == info["heads"]
+    finally:
+        client.close()
+
+
+def test_clone_pull_push_roundtrip(tmp_path, ssh_remote_repo):
+    server_repo, ds_path, url = ssh_remote_repo
+    from kart_tpu.transport.remote import clone, fetch, push
+
+    local = clone(url, str(tmp_path / "local"), do_checkout=False)
+    assert local.head_commit_oid == server_repo.head_commit_oid
+    assert local.config.get("remote.origin.url") == url
+
+    # server advances; pull sees it
+    edit_commit(
+        server_repo, ds_path,
+        updates=[{"fid": 2, "geom": None, "name": "upstream", "rating": 0.1}],
+    )
+    updated = fetch(local, "origin")
+    assert updated["refs/remotes/origin/main"] == server_repo.head_commit_oid
+
+    # local commit pushes back (on a side branch so CAS + ref creation both
+    # exercise)
+    local.refs.set("refs/heads/feature", local.head_commit_oid, log_message="b")
+    local.refs.set_head("refs/heads/feature", log_message="switch")
+    edit_commit(
+        local, ds_path,
+        updates=[{"fid": 3, "geom": None, "name": "local", "rating": 0.2}],
+    )
+    result = push(local, "origin", ["feature:feature"])
+    assert result["refs/heads/feature"] == local.head_commit_oid
+    assert server_repo.refs.get("refs/heads/feature") == local.head_commit_oid
+
+    # delete over the wire
+    result = push(local, "origin", [":feature"])
+    assert result["refs/heads/feature"] is None
+    assert server_repo.refs.get("refs/heads/feature") is None
+
+
+def test_non_fast_forward_rejected(tmp_path, ssh_remote_repo):
+    server_repo, ds_path, url = ssh_remote_repo
+    from kart_tpu.transport.remote import RemoteError, clone, push
+
+    local = clone(url, str(tmp_path / "local"), do_checkout=False)
+    # server moves ahead; local histories diverge
+    edit_commit(
+        server_repo, ds_path,
+        updates=[{"fid": 4, "geom": None, "name": "srv", "rating": 1.0}],
+    )
+    edit_commit(
+        local, ds_path,
+        updates=[{"fid": 5, "geom": None, "name": "loc", "rating": 2.0}],
+    )
+    with pytest.raises(RemoteError, match="fetch first|non-fast-forward|moved"):
+        push(local, "origin", ["main:main"])
+    # force push wins
+    push(local, "origin", ["main:main"], force=True)
+    assert server_repo.refs.get("refs/heads/main") == local.head_commit_oid
+
+
+def test_spatial_filtered_clone_and_promisor_backfill(tmp_path, ssh_remote_repo):
+    """Filtered partial clone over the pipe: the filter runs on the serving
+    side; later reads of out-of-filter features backfill through the same
+    ssh transport (promisor semantics)."""
+    server_repo, ds_path, url = ssh_remote_repo
+    from kart_tpu.spatial_filter import ResolvedSpatialFilterSpec
+    from kart_tpu.transport.remote import clone
+
+    # points sit at x = 100 + fid; keep only fids <= 4
+    spec = ResolvedSpatialFilterSpec(
+        "EPSG:4326", "POLYGON((100 -45, 104.5 -45, 104.5 -39, 100 -39, 100 -45))"
+    )
+    local = clone(
+        url,
+        str(tmp_path / "filtered"),
+        do_checkout=False,
+        spatial_filter_spec=spec,
+    )
+    assert local.config.get_bool("remote.origin.promisor")
+    ds = local.datasets("HEAD")[ds_path]
+    in_filter = ds.get_feature([2])
+    assert in_filter["name"] == "feature-2"
+
+    from kart_tpu.core.odb import ObjectPromised
+
+    tree = ds.feature_tree
+    blob_oids = [e.oid for _, e in tree.walk_blobs()]
+    missing = [o for o in blob_oids if not local.odb.contains(o)]
+    assert missing, "filtered clone should omit out-of-filter blobs"
+
+    # on-demand backfill over the same ssh transport
+    from kart_tpu.transport.remote import fetch_promised_blobs
+
+    fetched = fetch_promised_blobs(local, missing)
+    assert fetched == len(missing)
+    far = ds.get_feature([11])
+    assert far["name"] == "feature-11"
+
+
+def test_shallow_clone_over_pipe(tmp_path, ssh_remote_repo):
+    server_repo, ds_path, url = ssh_remote_repo
+    for i in range(3):
+        edit_commit(
+            server_repo, ds_path,
+            updates=[{"fid": 1, "geom": None, "name": f"v{i}", "rating": float(i)}],
+        )
+    from kart_tpu.transport.remote import clone, read_shallow
+
+    local = clone(url, str(tmp_path / "shallow"), do_checkout=False, depth=1)
+    assert local.head_commit_oid == server_repo.head_commit_oid
+    assert read_shallow(local) == {server_repo.head_commit_oid}
+
+
+def test_cli_clone_and_push_via_ssh_url(tmp_path, ssh_remote_repo, cli_runner):
+    """The CLI end of it: `kart clone user@host:path` works."""
+    from kart_tpu.cli import cli
+
+    server_repo, ds_path, url = ssh_remote_repo
+    dest = str(tmp_path / "cli-clone")
+    result = cli_runner.invoke(
+        cli, ["clone", url, dest, "--no-checkout"], catch_exceptions=False
+    )
+    assert result.exit_code == 0, result.output
+    result = cli_runner.invoke(
+        cli, ["-C", dest, "log", "--oneline"], catch_exceptions=False
+    )
+    assert result.exit_code == 0, result.output
+    assert "Import 1 dataset" in result.output
+
+
+def test_server_rejects_bad_ref_name(ssh_remote_repo):
+    """The shared receive-pack validation runs on the stdio path too."""
+    from kart_tpu.transport.stdio import StdioRemote, StdioTransportError
+
+    _, _, url = ssh_remote_repo
+    client = StdioRemote(url)
+    try:
+        with pytest.raises(StdioTransportError, match="[Rr]ef"):
+            client.receive_pack(
+                [], [{"ref": "config", "old": None, "new": "0" * 40, "force": True}]
+            )
+    finally:
+        client.close()
+
+
+def test_ssh_url_option_injection_rejected():
+    """Hostnames/paths beginning with '-' must not parse (they would reach
+    ssh as options — the CVE-2017-1000117 class)."""
+    assert parse_ssh_url("-oProxyCommand=payload:x") is None
+    assert parse_ssh_url("ssh://-oProxyCommand=payload/p") is None
+    assert parse_ssh_url("host:-path") is None
+    # IPv6 forms parse correctly
+    assert parse_ssh_url("ssh://[::1]/srv/repo") == ("::1", None, "/srv/repo")
+    assert parse_ssh_url("ssh://u@[::1]:2222/srv/repo") == ("u@::1", "2222", "/srv/repo")
+
+
+def test_server_error_keeps_connection_usable(ssh_remote_repo):
+    """An op-level failure returns an error frame; the next request on the
+    same connection still works (HTTP-500 equivalence)."""
+    from kart_tpu.transport.stdio import StdioRemote, StdioTransportError
+
+    repo, _, url = ssh_remote_repo
+    client = StdioRemote(url)
+    try:
+        with pytest.raises(StdioTransportError, match="error"):
+            client.fetch_pack(repo, [repo.head_commit_oid], filter_spec="not-a-rect")
+        # connection survives
+        assert client.ls_refs()["heads"]["main"] == repo.head_commit_oid
+    finally:
+        client.close()
+
+
+def test_serve_stdio_rejects_enclosed_nonrepo_path(tmp_path, ssh_remote_repo):
+    """Serving a non-repo subdirectory must error, not serve the enclosing
+    repo."""
+    server_repo, _, _ = ssh_remote_repo
+    sub = os.path.join(server_repo.workdir, "subdir")
+    os.makedirs(sub, exist_ok=True)
+    from kart_tpu.transport.stdio import StdioRemote, StdioTransportError
+
+    client = StdioRemote(f"testhost:{sub}")
+    try:
+        with pytest.raises(StdioTransportError):
+            client.ls_refs()
+    finally:
+        client.close()
