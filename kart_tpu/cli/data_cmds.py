@@ -238,7 +238,10 @@ def query(ctx, dataset, operation, argument, output_format):
     except (ValueError, AssertionError):
         raise CliError(f"Bad bbox (expected W,S,E,N): {argument!r}")
     t0 = time.monotonic()
-    mask = bbox_intersects(envelopes, wsen)
+    # keyed by the feature tree: repeat queries in one process (serve /
+    # scripting) reuse the device-resident envelope columns
+    cache_key = ("query", repo.gitdir, ds.feature_tree.oid)
+    mask = bbox_intersects(envelopes, wsen, cache_key=cache_key)
     query_s = time.monotonic() - t0
     hits = [ds.decode_path_to_pks(paths[i]) for i in range(len(paths)) if mask[i]]
     hits = [pk[0] if len(pk) == 1 else list(pk) for pk in hits]

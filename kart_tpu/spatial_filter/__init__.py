@@ -569,11 +569,21 @@ def blob_filter_for_spec(src_repo, wsen_arg):
     # per blob — the TPU-era answer to spatial_filter.cpp's per-OID loop
     matched_oids = rejected_oids = None
     if reader is not None:
-        from kart_tpu.native import bbox_intersects
+        import os as _os
+
+        from kart_tpu.ops.bbox import bbox_intersects
+        from kart_tpu.spatial_filter.index import db_path
 
         oids, wsen = reader.all_envelopes()
         if len(oids):
-            hits = bbox_intersects(wsen, (w, s, e, n))
+            # cache key = (index path, mtime): a long-running server keeps
+            # the envelope columns device-resident across filtered fetches
+            idx_path = db_path(src_repo)
+            try:
+                key = ("envidx", idx_path, _os.stat(idx_path).st_mtime_ns)
+            except OSError:
+                key = None
+            hits = bbox_intersects(wsen, (w, s, e, n), cache_key=key)
             matched_oids = {o for o, h in zip(oids, hits) if h}
             rejected_oids = {o for o, h in zip(oids, hits) if not h}
 

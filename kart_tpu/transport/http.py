@@ -44,7 +44,6 @@ from urllib.request import Request, urlopen
 
 from kart_tpu.core.odb import ObjectMissing
 from kart_tpu.transport.pack import read_pack, write_pack
-from kart_tpu.transport.protocol import ObjectEnumerator
 
 API = "/api/v1"
 _HEADER_LEN = struct.Struct(">Q")
@@ -299,6 +298,10 @@ class HttpRemote:
 
     def __init__(self, url):
         self.base = url.rstrip("/")
+
+    def close(self):
+        """No persistent connection; symmetric with StdioRemote so callers
+        can close any network client unconditionally."""
 
     def _get(self, path):
         try:

@@ -237,6 +237,8 @@ def fetch(repo, remote_name="origin", *, depth=None, filter_spec=None, quiet=Tru
             )
         except HttpTransportError as e:
             raise RemoteError(str(e))
+        finally:
+            net.close()
         shallow_boundary = set(header.get("shallow_boundary", ()))
     else:
         src = remote.open()
@@ -452,14 +454,17 @@ def push(repo, remote_name="origin", refspecs=(), *, force=False, set_upstream=F
 
     net = network_remote(remote.url)
     if net is not None:
-        return _push_network(
-            repo,
-            remote_name,
-            net,
-            refspecs,
-            force=force,
-            set_upstream=set_upstream,
-        )
+        try:
+            return _push_network(
+                repo,
+                remote_name,
+                net,
+                refspecs,
+                force=force,
+                set_upstream=set_upstream,
+            )
+        finally:
+            net.close()
     dst = remote.open()
 
     updated = {}
@@ -614,6 +619,8 @@ def fetch_promised_blobs(repo, oids):
             return net.fetch_blobs(repo, oids)
         except HttpTransportError as e:
             raise RemoteError(str(e))
+        finally:
+            net.close()
     src = promisor.open()
     fetched = 0
     with tempfile.SpooledTemporaryFile(max_size=64 * 1024 * 1024) as wire:

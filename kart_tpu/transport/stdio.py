@@ -23,7 +23,6 @@ import json
 import os
 import shlex
 import subprocess
-import tempfile
 
 from kart_tpu.transport.http import (
     _HEADER_LEN,
@@ -127,14 +126,21 @@ class StdioRemote:
         return self._proc
 
     def close(self):
-        if self._proc is not None:
-            for fp in (self._proc.stdin, self._proc.stdout):
-                try:
-                    fp.close()
-                except OSError:
-                    pass
-            self._proc.wait(timeout=10)
-            self._proc = None
+        proc, self._proc = self._proc, None
+        if proc is None:
+            return
+        for fp in (proc.stdin, proc.stdout):
+            try:
+                fp.close()
+            except OSError:
+                pass
+        try:
+            proc.wait(timeout=10)
+        except subprocess.TimeoutExpired:
+            # a wedged remote must not leak an ssh process or raise out of
+            # callers' cleanup paths
+            proc.kill()
+            proc.wait()
 
     def __del__(self):  # best-effort; close() is the real API
         try:

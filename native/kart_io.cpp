@@ -224,16 +224,28 @@ int64_t io_classify_sorted(const int64_t* old_keys, const uint8_t* old_oids,
     while (i < n_old && j < n_new) {
         int64_t ka = old_keys[i], kb = new_keys[j];
         if (ka == kb) {
-            if (std::memcmp(old_oids + i * 20, new_oids + j * 20, 20) == 0) {
-                old_class[i] = 0;
-                new_class[j] = 0;
-            } else {
-                old_class[i] = 2;
-                new_class[j] = 2;
-                updates++;
+            // runs of equal keys (hash-key collisions — production guards
+            // route those to the tree diff, but semantics must still match
+            // the numpy reference exactly): searchsorted pairs every row
+            // with the FIRST row of the other side's run
+            int64_t i0 = i, j0 = j;
+            while (i < n_old && old_keys[i] == ka) {
+                if (std::memcmp(old_oids + i * 20, new_oids + j0 * 20, 20) ==
+                    0) {
+                    old_class[i] = 0;
+                } else {
+                    old_class[i] = 2;
+                    updates++;
+                }
+                i++;
             }
-            i++;
-            j++;
+            while (j < n_new && new_keys[j] == ka) {
+                new_class[j] =
+                    std::memcmp(new_oids + j * 20, old_oids + i0 * 20, 20) == 0
+                        ? 0
+                        : 2;
+                j++;
+            }
         } else if (ka < kb) {
             old_class[i] = 3;
             deletes++;

@@ -360,3 +360,90 @@ def test_native_classify_matches_reference():
         assert hc["inserts"] == int(np.sum(rn == 1))
         assert hc["updates"] == int(np.sum(ro == 2))
         assert hc["deletes"] == int(np.sum(ro == 3))
+
+
+def test_bbox_resident_cache():
+    """cache_key keeps envelope columns device-resident: identical results,
+    one upload, bounded cache."""
+    import numpy as np
+
+    from kart_tpu.ops import bbox
+
+    rng = np.random.default_rng(3)
+    n = 4096
+    env = np.stack(
+        [
+            rng.uniform(-180, 179, n),
+            rng.uniform(-90, 89, n),
+            rng.uniform(-180, 180, n),
+            rng.uniform(-90, 90, n),
+        ],
+        axis=1,
+    )
+    env[:, 2] = np.maximum(env[:, 2], env[:, 0])
+    env[:, 3] = np.maximum(env[:, 3], env[:, 1])
+    query = (-20.0, -20.0, 40.0, 30.0)
+    ref = bbox.bbox_intersects_np(env, query)
+
+    old_min = bbox.RESIDENT_MIN_ENVELOPES
+    bbox.RESIDENT_MIN_ENVELOPES = 1
+    try:
+        bbox._RESIDENT_CACHE.clear()
+        key = ("test", 1)
+        got = bbox.bbox_intersects(env, query, cache_key=key)
+        assert np.array_equal(got, ref)
+        entry = bbox._RESIDENT_CACHE[key]
+        got2 = bbox.bbox_intersects(env, query, cache_key=key)
+        assert np.array_equal(got2, ref)
+        assert bbox._RESIDENT_CACHE[key] is entry  # no re-upload
+        # a different query against the same cached columns
+        ref2 = bbox.bbox_intersects_np(env, (100.0, 40.0, 120.0, 60.0))
+        got3 = bbox.bbox_intersects(env, (100.0, 40.0, 120.0, 60.0), cache_key=key)
+        assert np.array_equal(got3, ref2)
+        # eviction keeps the cache bounded
+        for i in range(bbox._RESIDENT_CACHE_MAX + 2):
+            bbox.bbox_intersects(env, query, cache_key=("test", 100 + i))
+        assert len(bbox._RESIDENT_CACHE) <= bbox._RESIDENT_CACHE_MAX
+        # a changed envelope set under the same key re-uploads
+        env2 = env[: n // 2]
+        got4 = bbox.bbox_intersects(env2, query, cache_key=key)
+        assert np.array_equal(got4, bbox.bbox_intersects_np(env2, query))
+    finally:
+        bbox.RESIDENT_MIN_ENVELOPES = old_min
+        bbox._RESIDENT_CACHE.clear()
+
+
+def test_native_classify_duplicate_keys_match_reference():
+    """Hash-key collisions produce duplicate sorted keys; the native
+    merge-join must classify them exactly as the numpy searchsorted
+    reference (first-row pairing) so output never depends on whether the
+    native lib is built."""
+    import numpy as np
+
+    from kart_tpu.ops.blocks import FeatureBlock
+    from kart_tpu.ops.diff_kernel import (
+        classify_blocks_host,
+        classify_blocks_reference,
+    )
+
+    rng = np.random.default_rng(5)
+    keys = np.array([1, 5, 5, 5, 9, 12, 12], dtype=np.int64)
+    oids = rng.integers(0, 256, (len(keys), 20), dtype=np.uint8)
+    new_keys = np.array([5, 5, 9, 12, 20], dtype=np.int64)
+    new_oids = rng.integers(0, 256, (len(new_keys), 20), dtype=np.uint8)
+    new_oids[2] = oids[4]  # key 9 unchanged
+    new_oids[0] = oids[1]  # first of the 5-run matches first old 5
+
+    def block(k, o):
+        return FeatureBlock.from_arrays(
+            k, np.ascontiguousarray(o).view(np.uint32).reshape(-1, 5), [""] * len(k)
+        )
+
+    a, b = block(keys, oids), block(new_keys, new_oids)
+    ho, hn, hc = classify_blocks_host(a, b)
+    ro, rn = classify_blocks_reference(a, b)
+    assert np.array_equal(ho[: a.count], ro)
+    assert np.array_equal(hn[: b.count], rn)
+    assert hc["updates"] == int(np.sum(ro == 2))
+    assert hc["inserts"] == int(np.sum(rn == 1))
+    assert hc["deletes"] == int(np.sum(ro == 3))
