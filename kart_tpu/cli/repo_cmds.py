@@ -57,14 +57,35 @@ def init(ctx, directory, import_from, bare, wc_location, initial_branch, message
 @click.option("--table", "-t", help="Only import this table from the source")
 @click.option("--dest-path", help="Dataset path to import into")
 @click.option("--replace-existing", is_flag=True, help="Replace existing dataset(s)")
+@click.option(
+    "--replace-ids",
+    help=(
+        "Replace only features with the given IDs (one per line; use "
+        "@filename.txt to read them from a file). Implies --replace-existing. "
+        "A listed ID missing from the source is deleted from the dataset; an "
+        "empty value replaces no features."
+    ),
+)
 @click.option("--no-checkout", is_flag=True, help="Don't update the working copy")
 @click.pass_obj
-def import_(ctx, sources, message, table, dest_path, replace_existing, no_checkout):
+def import_(
+    ctx, sources, message, table, dest_path, replace_existing, replace_ids,
+    no_checkout,
+):
     """Import data into the repository as new dataset(s)."""
     from kart_tpu.importer import ImportSource
     from kart_tpu.importer.importer import import_sources
 
     repo = ctx.repo
+    ids = None
+    if replace_ids is not None:
+        if replace_ids.startswith("@"):
+            try:
+                with open(replace_ids[1:]) as f:
+                    replace_ids = f.read()
+            except OSError as e:
+                raise CliError(f"Cannot read --replace-ids file: {e}")
+        ids = [line.strip() for line in replace_ids.splitlines() if line.strip()]
     all_sources = []
     for spec in sources:
         opened = ImportSource.open(spec, table=table)
@@ -78,6 +99,7 @@ def import_(ctx, sources, message, table, dest_path, replace_existing, no_checko
         all_sources,
         message=message,
         replace_existing=replace_existing,
+        replace_ids=ids,
         log=lambda m: click.echo(m, err=True),
     )
     if not no_checkout and not repo.is_bare:

@@ -232,14 +232,20 @@ def update_sidecar_for_commit(repo, old_ds, new_feature_tree_oid, feature_diff):
         if delta.new is not None:
             pk_values, blob = schema.encode_feature_blob(delta.new_value)
             added[int(pk_values[0])] = hash_object("blob", blob)
+    return derive_sidecar(repo, block, new_feature_tree_oid, removed, added)
 
-    keys = block.keys[: block.count]
+
+def derive_sidecar(repo, old_block, new_feature_tree_oid, removed, added):
+    """New sidecar from an old int-pk block + the change set — O(changed)
+    array ops, no tree walk. removed: iterable of pks; added: {pk: oid hex}
+    (an added pk overrides a removal)."""
+    keys = old_block.keys[: old_block.count]
     oids_u8 = (
-        np.ascontiguousarray(block.oids[: block.count])
+        np.ascontiguousarray(old_block.oids[: old_block.count])
         .view(np.uint8)
         .reshape(-1, 20)
     )
-    drop = removed | set(added)
+    drop = set(removed) | set(added)
     if drop:
         drop_arr = np.fromiter(drop, dtype=np.int64, count=len(drop))
         mask = ~np.isin(keys, drop_arr)

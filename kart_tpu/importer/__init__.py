@@ -37,6 +37,27 @@ class ImportSource:
     def features(self):
         raise NotImplementedError
 
+    def get_features(self, pks, ignore_missing=False):
+        """Yield the features with the given (single-column) primary keys
+        (reference: import_source.py get_features). Default: one scan of
+        features(); sources with indexed storage override with point reads.
+        Order of the result is not significant."""
+        wanted = set(pks)
+        if not wanted:
+            return
+        pk_col = self.schema.pk_columns[0].name
+        found = set()
+        for feature in self.features():
+            pk = feature.get(pk_col)
+            if pk in wanted:
+                found.add(pk)
+                yield feature
+        if not ignore_missing and found != wanted:
+            missing = sorted(wanted - found, key=str)[:5]
+            raise ImportSourceError(
+                f"Source has no feature(s) with id: {missing}"
+            )
+
     @property
     def feature_count(self):
         return sum(1 for _ in self.features())
@@ -234,6 +255,31 @@ class GPKGImportSource(ImportSource):
                         col.name: gpkg_adapter.value_to_v2(row[col.name], col)
                         for col in schema.columns
                     }
+        finally:
+            con.close()
+
+    def get_features(self, pks, ignore_missing=False):
+        """Point reads by pk (indexed sqlite lookup, not a table scan)."""
+        schema = self.schema
+        pk_col = schema.pk_columns[0].name
+        con = self._connect()
+        try:
+            for pk in pks:
+                row = con.execute(
+                    f"SELECT * FROM {gpkg_adapter.quote(self.table_name)} "
+                    f"WHERE {gpkg_adapter.quote(pk_col)} = ?",
+                    (pk,),
+                ).fetchone()
+                if row is None:
+                    if ignore_missing:
+                        continue
+                    raise ImportSourceError(
+                        f"Source has no feature with id: {pk!r}"
+                    )
+                yield {
+                    col.name: gpkg_adapter.value_to_v2(row[col.name], col)
+                    for col in schema.columns
+                }
         finally:
             con.close()
 

@@ -38,9 +38,18 @@ def import_sources(
     *,
     message=None,
     replace_existing=False,
+    replace_ids=None,
     log=None,
 ):
-    """Import each source as a dataset; -> the new commit oid."""
+    """Import each source as a dataset; -> the new commit oid.
+
+    replace_ids: iterable of pk values — incremental re-import (reference:
+    fast_import.py:462-476): the existing dataset tree is kept, each listed
+    id is deleted and then re-imported when the source still has it (so a
+    listed id absent from the source becomes a delete). Implies
+    replace_existing; an empty list re-imports nothing but still updates
+    meta."""
+    sources = list(sources)
     head_tree = repo.head_tree_oid
     structure = repo.structure("HEAD") if not repo.head_is_unborn else None
     existing_paths = (
@@ -51,6 +60,13 @@ def import_sources(
 
     from kart_tpu.diff.sidecar import SidecarCapture
 
+    if replace_ids is not None:
+        replace_existing = True  # implied, as in the reference CLI
+        if len(sources) != 1:
+            raise ImportError_(
+                "--replace-ids requires a single-table import (the id list "
+                "would be applied to every table)"
+            )
     tb = TreeBuilder(repo.odb, head_tree)
     ds_paths = []
     captures = {}
@@ -66,15 +82,27 @@ def import_sources(
                 raise ImportError_(
                     f"Dataset {ds_path!r} already exists — use --replace-existing"
                 )
-            if replace_existing:
+            if replace_existing and replace_ids is None:
                 tb.remove(ds_path)
-            capture = SidecarCapture()
+            existing_ds = (
+                structure.datasets.get(ds_path) if structure is not None else None
+            )
+            capture = (
+                SidecarCapture() if replace_ids is None else ReplaceIdsCapture()
+            )
             count = _import_single_source(
-                repo, tb, source, ds_path, log=log, capture=capture
+                repo,
+                tb,
+                source,
+                ds_path,
+                log=log,
+                capture=capture,
+                replace_ids=replace_ids,
+                existing_ds=existing_ds,
             )
             total += count
             ds_paths.append(ds_path)
-            captures[ds_path] = capture
+            captures[ds_path] = (capture, existing_ds)
 
         new_tree = tb.flush()
 
@@ -88,16 +116,36 @@ def import_sources(
     commit_oid = repo.create_commit("HEAD", new_tree, message, parents)
 
     # columnar sidecars, straight from the captured import stream — big
-    # datasets get O(1) FeatureBlock loads on their first diff
+    # datasets get O(1) FeatureBlock loads on their first diff. replace-ids
+    # imports derive the new sidecar from the old one + the change set
+    # (O(changed)), so incremental re-imports keep the columnar cache.
+    from kart_tpu.diff import sidecar as sidecar_mod
+
     root = repo.odb.tree(new_tree)
-    for ds_path, capture in captures.items():
-        if capture.count < SIDECAR_MIN_FEATURES:
-            continue
+    for ds_path, (capture, existing_ds) in captures.items():
         node = root.get_or_none(
             f"{ds_path}/{Dataset3.DATASET_DIRNAME}/feature"
         )
-        if node is not None:
-            capture.save(repo, node.oid)
+        if node is None:
+            continue
+        if isinstance(capture, ReplaceIdsCapture):
+            enc = getattr(existing_ds, "path_encoder", None) if existing_ds else None
+            if enc is None or enc.scheme != "int":
+                continue  # hash-keyed: would need per-path bookkeeping
+            old_block = sidecar_mod.load_block(repo, existing_ds)
+            if old_block is None:
+                continue  # no cache to derive from; rebuilt lazily on use
+            sidecar_mod.derive_sidecar(
+                repo,
+                old_block,
+                node.oid,
+                capture.removed_pks,
+                dict(capture.added),
+            )
+            continue
+        if capture.count < SIDECAR_MIN_FEATURES:
+            continue
+        capture.save(repo, node.oid)
     if log:
         dt = time.monotonic() - t0
         rate = total / dt if dt > 0 else float("inf")
@@ -105,7 +153,99 @@ def import_sources(
     return commit_oid
 
 
-def _import_single_source(repo, tb, source, ds_path, *, log=None, capture=None):
+def _sanitise_pk(schema, pk):
+    """CLI-supplied id (a string) -> the pk column's value type."""
+    col = schema.pk_columns[0]
+    if col.data_type == "integer":
+        try:
+            return int(pk)
+        except (TypeError, ValueError):
+            raise ImportError_(f"Invalid integer primary key: {pk!r}")
+    return pk
+
+
+def _check_replace_ids_compatible(existing_ds, schema, encoder):
+    """--replace-ids keeps the existing tree, so the new feature paths must
+    land where the old ones live: the path encoder and pk column must match
+    the existing dataset, or deletes silently miss and unlisted features
+    become unreachable under the rewritten meta."""
+    if existing_ds is None:
+        return
+    old_enc = getattr(existing_ds, "path_encoder", None)
+    if old_enc is not None and old_enc.to_dict() != encoder.to_dict():
+        raise ImportError_(
+            "--replace-ids cannot change the feature path encoding "
+            f"({old_enc.to_dict()} -> {encoder.to_dict()}); re-import the "
+            "whole dataset with --replace-existing instead"
+        )
+    old_pks = existing_ds.schema.pk_columns
+    new_pks = schema.pk_columns
+    if [(c.name, c.data_type) for c in old_pks] != [
+        (c.name, c.data_type) for c in new_pks
+    ]:
+        raise ImportError_(
+            "--replace-ids cannot change the primary key "
+            f"({[(c.name, c.data_type) for c in old_pks]} -> "
+            f"{[(c.name, c.data_type) for c in new_pks]}); re-import the "
+            "whole dataset with --replace-existing instead"
+        )
+
+
+class ReplaceIdsCapture:
+    """What a --replace-ids import changed, for the O(changed) sidecar
+    derivation (the incremental-import workflow must not lose the columnar
+    cache and fall back to full tree walks)."""
+
+    def __init__(self):
+        self.removed_pks = []
+        self.added = []  # (pk int, oid hex)
+
+
+def _import_replace_ids(
+    repo, tb, source, schema, encoder, prefix, replace_ids, *,
+    log=None, existing_ds=None, capture=None,
+):
+    """Incremental re-import: delete every listed id's path, re-import the
+    ones the source still has. Everything unlisted keeps its existing blob
+    and subtree (reference: fast_import.py:462-476 — 'D <path>' per id, then
+    stream source.get_features(ids, ignore_missing=True))."""
+    if len(schema.pk_columns) != 1:
+        raise ImportError_(
+            "--replace-ids requires the dataset to have a single-column "
+            "primary key"
+        )
+    _check_replace_ids_compatible(existing_ds, schema, encoder)
+    pks = [_sanitise_pk(schema, pk) for pk in replace_ids]
+    for pk in pks:
+        tb.remove(prefix + encoder.encode_pks_to_path((pk,)))
+    if capture is not None:
+        capture.removed_pks = pks
+
+    count = 0
+    for batch in chunked(
+        source.get_features(pks, ignore_missing=True), BATCH_SIZE
+    ):
+        encoded = [schema.encode_feature_blob(f) for f in batch]
+        rel_paths = [encoder.encode_pks_to_path(pkv) for pkv, _ in encoded]
+        oids = repo.odb.write_blobs([blob for _, blob in encoded])
+        tb.insert_many((prefix + rel for rel in rel_paths), oids)
+        if capture is not None:
+            capture.added.extend(
+                (pkv[0], oid) for (pkv, _), oid in zip(encoded, oids)
+            )
+        count += len(batch)
+    if log:
+        log(
+            f"  replaced {count} of {len(pks)} listed id(s); "
+            f"{len(pks) - count} deleted"
+        )
+    return count
+
+
+def _import_single_source(
+    repo, tb, source, ds_path, *, log=None, capture=None, replace_ids=None,
+    existing_ds=None,
+):
     schema = source.schema
     encoder = encoder_for_schema(schema)
     meta = source.meta_items()
@@ -127,6 +267,13 @@ def _import_single_source(repo, tb, source, ds_path, *, log=None, capture=None):
     )
 
     prefix = f"{ds_path}/{Dataset3.DATASET_DIRNAME}/{Dataset3.FEATURE_PATH}"
+
+    if replace_ids is not None:
+        return _import_replace_ids(
+            repo, tb, source, schema, encoder, prefix, replace_ids,
+            log=log, existing_ds=existing_ds, capture=capture,
+        )
+
     n_workers = default_workers()
     if shardable(source, encoder, n_workers):
         count = run_parallel_import(

@@ -226,3 +226,135 @@ def test_columnar_diff_matches_tree_diff(points_repo):
             assert tree_diff[k].new_value == col_diff[k].new_value
         if tree_diff[k].old is not None:
             assert tree_diff[k].old_value == col_diff[k].old_value
+
+
+def test_import_replace_ids(tmp_path, points_repo):
+    """--replace-ids re-imports only the listed features: updates land,
+    unlisted edits in the source are ignored, and a listed id missing from
+    the source becomes a delete (reference: fast_import.py:462-476)."""
+    import sqlite3
+
+    repo, ds_path = points_repo
+    gpkg = str(tmp_path / "points.gpkg")  # the fixture's source file
+    head_before = repo.head_commit_oid
+
+    # edit the source: update fids 2 and 3, delete fid 4
+    con = sqlite3.connect(gpkg)
+    con.execute("UPDATE points SET name = 'changed-2' WHERE fid = 2")
+    con.execute("UPDATE points SET name = 'changed-3' WHERE fid = 3")
+    con.execute("DELETE FROM points WHERE fid = 4")
+    con.commit()
+    con.close()
+
+    from kart_tpu.importer import ImportSource
+    from kart_tpu.importer.importer import import_sources
+
+    # replace only 2 and 4: fid 3's source edit must NOT land
+    sources = ImportSource.open(gpkg)
+    import_sources(repo, sources, replace_ids=["2", "4"])
+
+    ds = repo.structure("HEAD").datasets[ds_path]
+    assert ds.get_feature([2])["name"] == "changed-2"
+    assert ds.get_feature([3])["name"] == "feature-3"  # unlisted: untouched
+    with pytest.raises(KeyError):
+        ds.get_feature([4])  # listed + gone from source -> deleted
+    assert ds.get_feature([1])["name"] == "feature-1"
+
+    # exactly the listed changes in the diff
+    diff = get_repo_diff(repo.structure(head_before), repo.structure("HEAD"))
+    feature_diff = diff[ds_path]["feature"]
+    assert sorted(feature_diff.keys()) == [2, 4]
+    assert feature_diff[2].new_value["name"] == "changed-2"
+    assert feature_diff[4].new is None
+
+
+def test_import_replace_ids_cli(tmp_path, points_repo, cli_runner):
+    """The CLI flag incl. @file form."""
+    import sqlite3
+
+    repo, ds_path = points_repo
+    gpkg = str(tmp_path / "points.gpkg")
+    con = sqlite3.connect(gpkg)
+    con.execute("UPDATE points SET rating = 99.0 WHERE fid = 5")
+    con.commit()
+    con.close()
+    ids_file = tmp_path / "ids.txt"
+    ids_file.write_text("5\n")
+
+    from kart_tpu.cli import cli
+
+    repo_path = repo.workdir or repo.gitdir
+    result = cli_runner.invoke(
+        cli,
+        [
+            "-C", str(repo_path), "import", gpkg,
+            f"--replace-ids=@{ids_file}", "--no-checkout",
+        ],
+        catch_exceptions=False,
+    )
+    assert result.exit_code == 0, result.output
+    from kart_tpu.core.repo import KartRepo
+
+    repo = KartRepo(str(repo_path))  # the CLI wrote packs via its own instance
+    ds = repo.structure("HEAD").datasets[ds_path]
+    assert ds.get_feature([5])["rating"] == 99.0
+
+
+def test_import_replace_ids_empty_replaces_nothing(tmp_path, points_repo):
+    import sqlite3
+
+    repo, ds_path = points_repo
+    gpkg = str(tmp_path / "points.gpkg")
+    con = sqlite3.connect(gpkg)
+    con.execute("UPDATE points SET name = 'x' WHERE fid = 1")
+    con.commit()
+    con.close()
+    head_before = repo.head_commit_oid
+
+    from kart_tpu.importer import ImportSource
+    from kart_tpu.importer.importer import import_sources
+
+    import_sources(repo, ImportSource.open(gpkg), replace_ids=[])
+    ds = repo.structure("HEAD").datasets[ds_path]
+    assert ds.get_feature([1])["name"] == "feature-1"
+    diff = get_repo_diff(repo.structure(head_before), repo.structure("HEAD"))
+    assert not diff.get(ds_path, {}).get("feature")
+
+
+def test_replace_ids_derives_sidecar(tmp_path, points_repo):
+    """Incremental re-import keeps the columnar cache: the new feature
+    tree's sidecar is derived O(changed) and matches a from-scratch build."""
+    import sqlite3
+
+    import numpy as np
+
+    from kart_tpu.diff import sidecar
+    from kart_tpu.importer import ImportSource
+    from kart_tpu.importer.importer import import_sources
+
+    repo, ds_path = points_repo
+    ds_old = repo.structure("HEAD").datasets[ds_path]
+    sidecar.ensure_block(repo, ds_old)  # the cache exists before the import
+
+    gpkg = str(tmp_path / "points.gpkg")
+    con = sqlite3.connect(gpkg)
+    con.execute("UPDATE points SET name = 'derived' WHERE fid = 6")
+    con.execute("DELETE FROM points WHERE fid = 7")
+    con.commit()
+    con.close()
+    import_sources(repo, ImportSource.open(gpkg), replace_ids=["6", "7"])
+
+    ds_new = repo.structure("HEAD").datasets[ds_path]
+    assert sidecar.has_sidecar(repo, ds_new)
+    derived = sidecar.load_block(repo, ds_new)
+    # compare against a fresh walk of the new tree
+    import os
+
+    os.remove(sidecar.sidecar_file(repo, ds_new.feature_tree.oid))
+    rebuilt = sidecar.build_sidecar(repo, ds_new)
+    assert np.array_equal(
+        derived.keys[: derived.count], rebuilt.keys[: rebuilt.count]
+    )
+    assert np.array_equal(
+        derived.oids[: derived.count], rebuilt.oids[: rebuilt.count]
+    )
