@@ -12,10 +12,19 @@ veryfast=2 / fast=16 / medium=32 / good=64 sampled subtrees, or ``exact``.
 Results are memoised in the annotations DB, keyed by the tree pair and
 accuracy, exactly like the reference caches them (diff_estimation.py:117-124).
 
-The per-branch exact counts are independent — on a device mesh they shard
-trivially (one branch prefix per device, psum the partial counts), which is
-the ``pmap``'d sampled reduction slot of SURVEY.md §2.3.
+Two engines, chosen per dataset:
+
+* **Tree sampling** (host): exact-count sampled *differing* top branches,
+  extrapolate — O(samples) odb reads, no columnar data needed.
+* **Device-sharded column sampling**: when both revisions carry columnar
+  sidecars, sample ``samples`` of 64 block-cyclic pk-residue classes (the
+  same modulus invariant the PathEncoder / mesh partitioner use), classify
+  just those rows shard-local over the device mesh, psum the count vector,
+  and scale — the SURVEY §2.3 "pmap'd sampled reduction" slot, one
+  partition class per device.
 """
+
+import numpy as np
 
 ACCURACY_SUBTREE_SAMPLES = {
     "veryfast": 2,
@@ -24,6 +33,11 @@ ACCURACY_SUBTREE_SAMPLES = {
     "good": 64,
 }
 ACCURACY_CHOICES = (*ACCURACY_SUBTREE_SAMPLES, "exact")
+
+# the modulus partition count for column sampling; matches the path
+# encoder's top fanout so a "sample" has the same granularity as one
+# sampled tree branch
+SAMPLE_PARTITIONS = 64
 
 
 def estimate_diff_feature_counts(
@@ -64,9 +78,13 @@ def estimate_diff_feature_counts(
     for ds_path in wanted:
         old_ds = base_datasets.get(ds_path) if base_rs else None
         new_ds = target_datasets.get(ds_path) if target_rs else None
-        old_tree = old_ds.feature_tree if old_ds else None
-        new_tree = new_ds.feature_tree if new_ds else None
-        count = _estimate_tree_pair(repo.odb, old_tree, new_tree, accuracy)
+        count = None
+        if accuracy != "exact":
+            count = _estimate_columnar(repo, old_ds, new_ds, accuracy)
+        if count is None:
+            old_tree = old_ds.feature_tree if old_ds else None
+            new_tree = new_ds.feature_tree if new_ds else None
+            count = _estimate_tree_pair(repo.odb, old_tree, new_tree, accuracy)
         if count:
             counts[ds_path] = count
 
@@ -77,6 +95,99 @@ def estimate_diff_feature_counts(
             base_tree, target_tree, counts, f"feature-change-counts-{accuracy}"
         )
     return counts
+
+
+# below this row count the host tree walk beats any columnar dispatch — the
+# sampling machinery only pays off when slicing columns saves real work
+COLUMNAR_ESTIMATE_MIN_ROWS = 100_000
+
+
+def _estimate_columnar(repo, old_ds, new_ds, accuracy):
+    """Column-sampled estimate from the sidecars, or None when they aren't
+    available / worthwhile (caller falls back to the host tree walk)."""
+    if old_ds is None or new_ds is None or repo is None:
+        return None
+    old_tree = old_ds.feature_tree
+    new_tree = new_ds.feature_tree
+    if (old_tree.oid if old_tree is not None else None) == (
+        new_tree.oid if new_tree is not None else None
+    ):
+        return 0  # unchanged dataset: never touch the sidecars
+    for ds in (old_ds, new_ds):
+        enc = getattr(ds, "path_encoder", None)
+        if enc is None or enc.scheme != "int":
+            return None  # hash keys: residues of the hash aren't pk classes
+    from kart_tpu.diff import sidecar
+
+    if not (
+        sidecar.has_sidecar(repo, old_ds) and sidecar.has_sidecar(repo, new_ds)
+    ):
+        return None
+    old_block = sidecar.load_block(repo, old_ds)
+    new_block = sidecar.load_block(repo, new_ds)
+    if old_block is None or new_block is None:
+        return None
+    if max(old_block.count, new_block.count) < COLUMNAR_ESTIMATE_MIN_ROWS:
+        return None
+    return estimate_counts_from_blocks(old_block, new_block, accuracy)
+
+
+def estimate_counts_from_blocks(old_block, new_block, accuracy):
+    """Sampled changed-feature count from two (pk, oid) column blocks.
+
+    Samples ``samples`` of SAMPLE_PARTITIONS partition classes of a *mixed*
+    key hash (a fixed multiply/shift bijection — raw ``pk % 64`` would alias
+    with strided pk allocations like all-even fids, under- or over-counting
+    by a constant factor). On a multi-device mesh each device classifies its
+    own slice of the sample and only the 3-scalar count vector is psum'd
+    (SURVEY §2.3's sampled reduction). Scaling by partitions/samples makes
+    the estimate unbiased: mixed classes are ~equal pseudo-random partitions
+    of pk space, like the path encoder's hash subtrees."""
+    samples = ACCURACY_SUBTREE_SAMPLES[accuracy]
+    k = min(samples, SAMPLE_PARTITIONS)
+
+    def partition_class(keys):
+        # splitmix-style mixer: identical for both sides of the diff, so a
+        # pk lands in the same class in every revision
+        h = keys.astype(np.uint64) * np.uint64(0x9E3779B97F4A7C15)
+        h ^= h >> np.uint64(29)
+        h *= np.uint64(0xBF58476D1CE4E5B9)
+        return (h >> np.uint64(58)) % np.uint64(SAMPLE_PARTITIONS)
+
+    def subsample(block):
+        from kart_tpu.ops.blocks import FeatureBlock, PAD_KEY, bucket_size
+
+        keys = block.keys[: block.count]
+        mask = partition_class(keys) < k
+        sub_keys = keys[mask]
+        sub_oids = block.oids[: block.count][mask]
+        n = len(sub_keys)
+        size = bucket_size(max(n, 1))
+        keys_p = np.full(size, PAD_KEY, dtype=np.int64)
+        keys_p[:n] = sub_keys
+        oids_p = np.zeros((size, 5), dtype=np.uint32)
+        oids_p[:n] = sub_oids
+        sub = FeatureBlock.__new__(FeatureBlock)
+        sub.keys = keys_p
+        sub.oids = oids_p
+        sub.paths = None
+        sub.count = n
+        return sub
+
+    old_sub = subsample(old_block)
+    new_sub = subsample(new_block)
+
+    from kart_tpu.ops.diff_kernel import classify_blocks
+    from kart_tpu.parallel.sharded_diff import classify_blocks_sharded, should_shard
+
+    if should_shard(max(old_sub.count, new_sub.count)):
+        _, _, counts = classify_blocks_sharded(old_sub, new_sub)
+    else:
+        _, _, counts = classify_blocks(old_sub, new_sub)
+    total = counts["inserts"] + counts["updates"] + counts["deletes"]
+    if k == SAMPLE_PARTITIONS:
+        return total  # sampled everything: exact
+    return round(total * SAMPLE_PARTITIONS / k)
 
 
 def _estimate_tree_pair(odb, old_tree, new_tree, accuracy):

@@ -583,7 +583,16 @@ def blob_filter_for_spec(src_repo, wsen_arg):
                 key = ("envidx", idx_path, _os.stat(idx_path).st_mtime_ns)
             except OSError:
                 key = None
-            hits = bbox_intersects(wsen, (w, s, e, n), cache_key=key)
+            # the veto must stay conservative under the device kernel's
+            # float32 rounding: widen the query by more than f32 ulp at
+            # +-360 (2.2e-5 deg) but under the envelope codec's own
+            # outward-rounded granularity (360/2^20 = 3.4e-4 deg) — a
+            # borderline feature ships (fail open) instead of being
+            # wrongly withheld from the clone
+            pad = 1e-4
+            hits = bbox_intersects(
+                wsen, (w - pad, s - pad, e + pad, n + pad), cache_key=key
+            )
             matched_oids = {o for o, h in zip(oids, hits) if h}
             rejected_oids = {o for o, h in zip(oids, hits) if not h}
 

@@ -144,3 +144,68 @@ def test_reference_annotations_db_compatible(tmp_path):
         ann = DiffAnnotations(repo)
         ann.set("a...b", "feature-change-counts-veryfast", '{"n": 2}')
         assert ann.get("a...b", "feature-change-counts-veryfast") == '{"n": 2}'
+
+
+def test_columnar_sampled_estimation_on_mesh():
+    """The device-sharded sampled reduction (SURVEY §2.3): residue-class
+    sampling over columnar blocks estimates within sampling error, is exact
+    at full sampling, and routes through the mesh when forced."""
+    import numpy as np
+
+    from kart_tpu.diff.estimation import estimate_counts_from_blocks
+    from kart_tpu.ops.blocks import FeatureBlock
+
+    rng = np.random.default_rng(9)
+    n = 200_000
+    keys = np.arange(n, dtype=np.int64)
+    oids = rng.integers(0, 2**32, (n, 5), dtype=np.uint32)
+    new_oids = oids.copy()
+    edit = rng.choice(n, size=2000, replace=False)
+    new_oids[edit] = rng.integers(0, 2**32, (len(edit), 5), dtype=np.uint32)
+
+    old = FeatureBlock.from_arrays(keys, oids, [""] * n)
+    new = FeatureBlock.from_arrays(keys, new_oids, [""] * n)
+
+    exact = estimate_counts_from_blocks(old, new, "good")  # 64/64: exact
+    assert exact == 2000
+
+    est = estimate_counts_from_blocks(old, new, "fast")  # 16/64 residues
+    assert abs(est - 2000) / 2000 < 0.25  # sampling error bound (seeded)
+
+    est2 = estimate_counts_from_blocks(old, new, "veryfast")
+    assert 500 < est2 < 8000  # 2/64: loose but same order
+
+
+def test_columnar_estimation_used_by_repo_estimator(tmp_path, monkeypatch):
+    """estimate_diff_feature_counts picks the columnar engine when sidecars
+    exist and the dataset is big enough; the mesh path runs when forced."""
+    import numpy as np
+
+    import jax
+    import pytest
+
+    if jax.device_count() < 2:
+        pytest.skip("needs >= 2 devices")
+
+    from helpers import make_repo_with_edits
+    from kart_tpu.core.repo import KartRepo
+    from kart_tpu.diff import estimation, sidecar
+    from kart_tpu.diff.estimation import estimate_diff_feature_counts
+    from kart_tpu.parallel.sharded_diff import STATS
+
+    repo_path, expected = make_repo_with_edits(tmp_path)
+    repo = KartRepo(repo_path)
+    base_rs = repo.structure("HEAD^")
+    target_rs = repo.structure("HEAD")
+    # make the small fixture eligible for the columnar engine + mesh
+    for rs in (base_rs, target_rs):
+        sidecar.ensure_block(repo, rs.datasets["points"])
+    monkeypatch.setattr(estimation, "COLUMNAR_ESTIMATE_MIN_ROWS", 1)
+    monkeypatch.setenv("KART_DIFF_SHARDED", "1")
+
+    before = STATS["sharded_classify_calls"]
+    counts = estimate_diff_feature_counts(
+        repo, base_rs, target_rs, accuracy="good", use_annotations=False
+    )
+    assert STATS["sharded_classify_calls"] > before  # ran on the mesh
+    assert counts["points"] == sum(expected.values())
