@@ -668,6 +668,230 @@ def _lcc_inverse(crs, x, y):
     return np.degrees(lon), np.degrees(phi)
 
 
+def _q_of(e, e2, sin_lat):
+    """Snyder's authalic q (3-12); works on scalars and arrays."""
+    if e == 0:
+        return 2 * sin_lat
+    return (1 - e2) * (
+        sin_lat / (1 - e2 * sin_lat**2)
+        - (1 / (2 * e)) * np.log((1 - e * sin_lat) / (1 + e * sin_lat))
+    )
+
+
+def _albers_setup(crs):
+    """Albers Equal-Area Conic constants (Snyder 1987 §14; EPSG 9822)."""
+    a = crs.semi_major
+    e2 = _e2_of(crs)
+    e = math.sqrt(e2)
+
+    def m(phi):
+        return math.cos(phi) / math.sqrt(1 - e2 * math.sin(phi) ** 2)
+
+    def q(phi):
+        return float(_q_of(e, e2, math.sin(phi)))
+
+    p = crs.params
+    lat0 = math.radians(p.get("latitude_of_origin", p.get("latitude_of_center", 0.0)))
+    lon0 = math.radians(p.get("central_meridian", p.get("longitude_of_center", 0.0)))
+    fe = p.get("false_easting", 0.0)
+    fn = p.get("false_northing", 0.0)
+    sp1 = math.radians(p.get("standard_parallel_1", math.degrees(lat0)))
+    sp2 = math.radians(p.get("standard_parallel_2", math.degrees(sp1)))
+
+    if abs(sp1 - sp2) > 1e-12:
+        n = (m(sp1) ** 2 - m(sp2) ** 2) / (q(sp2) - q(sp1))
+    else:
+        n = math.sin(sp1)
+    C = m(sp1) ** 2 + n * q(sp1)
+    rho0 = a * math.sqrt(max(C - n * q(lat0), 0.0)) / n
+    return a, e, e2, n, C, rho0, lon0, fe, fn
+
+
+def _albers_forward(crs, lon_deg, lat_deg):
+    a, e, e2, n, C, rho0, lon0, fe, fn = _albers_setup(crs)
+    lon = np.radians(np.asarray(lon_deg, dtype=np.float64))
+    lat = np.radians(np.asarray(lat_deg, dtype=np.float64))
+    q = _q_of(e, e2, np.sin(lat))
+    rho = a * np.sqrt(np.maximum(C - n * q, 0.0)) / n
+    theta = n * (lon - lon0)
+    x = fe + rho * np.sin(theta)
+    y = fn + rho0 - rho * np.cos(theta)
+    return x, y
+
+
+def _albers_inverse(crs, x, y):
+    a, e, e2, n, C, rho0, lon0, fe, fn = _albers_setup(crs)
+    x = np.asarray(x, dtype=np.float64) - fe
+    y = rho0 - (np.asarray(y, dtype=np.float64) - fn)
+    rho = np.sign(n) * np.sqrt(x**2 + y**2)
+    theta = np.arctan2(np.sign(n) * x, np.sign(n) * y)
+    q = (C - (rho * n / a) ** 2) / n
+    if e == 0:
+        phi = np.arcsin(np.clip(q / 2, -1.0, 1.0))
+    else:
+        # iterate Snyder (3-16); q at the pole is qp = q(pi/2)
+        qp = _q_of(e, e2, 1.0)
+        phi = np.arcsin(np.clip(q / 2, -1.0, 1.0))
+        for _ in range(8):
+            s = np.sin(phi)
+            phi = phi + (1 - e2 * s**2) ** 2 / (2 * np.cos(phi)) * (
+                q / (1 - e2)
+                - s / (1 - e2 * s**2)
+                + (1 / (2 * e)) * np.log((1 - e * s) / (1 + e * s))
+            )
+        # exactly-polar q would divide by cos(phi)=0 above; clamp handles it
+        phi = np.where(np.abs(q) >= np.abs(qp) - 1e-12, np.sign(q) * np.pi / 2, phi)
+    lon = lon0 + theta / n
+    return np.degrees(lon), np.degrees(phi)
+
+
+def _polar_stereo_setup(crs):
+    """Polar Stereographic (Snyder 1987 §21; EPSG 9810 variant A via
+    scale_factor at the pole, 9829 variant B via a standard parallel)."""
+    a = crs.semi_major
+    e2 = _e2_of(crs)
+    e = math.sqrt(e2)
+    p = crs.params
+    lat0 = p.get("latitude_of_origin", p.get("standard_parallel_1", 90.0))
+    south = lat0 < 0
+    lon0 = math.radians(p.get("central_meridian", p.get("longitude_of_origin", 0.0)))
+    fe = p.get("false_easting", 0.0)
+    fn = p.get("false_northing", 0.0)
+    k0 = p.get("scale_factor", 1.0)
+
+    def t_of(phi):
+        return math.tan(math.pi / 4 - phi / 2) / (
+            (1 - e * math.sin(phi)) / (1 + e * math.sin(phi))
+        ) ** (e / 2)
+
+    if abs(abs(lat0) - 90.0) > 1e-9:
+        # variant B: the scale is set by the standard parallel
+        phi_f = math.radians(abs(lat0))
+        m_f = math.cos(phi_f) / math.sqrt(1 - e2 * math.sin(phi_f) ** 2)
+        rho_factor = a * m_f / t_of(phi_f)
+    else:
+        rho_factor = (
+            2 * a * k0 / math.sqrt((1 + e) ** (1 + e) * (1 - e) ** (1 - e))
+        )
+    return a, e, south, lon0, fe, fn, rho_factor
+
+
+def _polar_stereo_forward(crs, lon_deg, lat_deg):
+    a, e, south, lon0, fe, fn, rho_factor = _polar_stereo_setup(crs)
+    lon = np.radians(np.asarray(lon_deg, dtype=np.float64))
+    lat = np.radians(np.asarray(lat_deg, dtype=np.float64))
+    if south:
+        lat = -lat
+        lon = -(lon - lon0)
+    else:
+        lon = lon - lon0
+    t = np.tan(np.pi / 4 - lat / 2) / (
+        (1 - e * np.sin(lat)) / (1 + e * np.sin(lat))
+    ) ** (e / 2)
+    rho = rho_factor * t
+    x = rho * np.sin(lon)
+    y = -rho * np.cos(lon)
+    if south:
+        x, y = -x, -y
+    return fe + x, fn + y
+
+
+def _polar_stereo_inverse(crs, x, y):
+    a, e, south, lon0, fe, fn, rho_factor = _polar_stereo_setup(crs)
+    x = np.asarray(x, dtype=np.float64) - fe
+    y = np.asarray(y, dtype=np.float64) - fn
+    if south:
+        x, y = -x, -y
+    rho = np.sqrt(x**2 + y**2)
+    t = rho / rho_factor
+    phi = np.pi / 2 - 2 * np.arctan(t)
+    for _ in range(8):
+        phi = np.pi / 2 - 2 * np.arctan(
+            t * ((1 - e * np.sin(phi)) / (1 + e * np.sin(phi))) ** (e / 2)
+        )
+    lon = np.arctan2(x, -y)
+    if south:
+        phi = -phi
+        lon = lon0 - lon
+    else:
+        lon = lon0 + lon
+    return np.degrees(lon), np.degrees(phi)
+
+
+def _oblique_stereo_setup(crs):
+    """Oblique (double) Stereographic — EPSG 9809, the RD New / Amersfoort
+    method: conformal-sphere projection of the conformal latitude (EPSG
+    Guidance Note 7-2 §3.2.2.1)."""
+    a = crs.semi_major
+    e2 = _e2_of(crs)
+    e = math.sqrt(e2)
+    p = crs.params
+    phi0 = math.radians(p.get("latitude_of_origin", 0.0))
+    lam0 = math.radians(p.get("central_meridian", 0.0))
+    fe = p.get("false_easting", 0.0)
+    fn = p.get("false_northing", 0.0)
+    k0 = p.get("scale_factor", 1.0)
+
+    s0 = math.sin(phi0)
+    rho0 = a * (1 - e2) / (1 - e2 * s0 * s0) ** 1.5
+    nu0 = a / math.sqrt(1 - e2 * s0 * s0)
+    R = math.sqrt(rho0 * nu0)
+    n = math.sqrt(1 + e2 * math.cos(phi0) ** 4 / (1 - e2))
+
+    S1 = (1 + s0) / (1 - s0)
+    S2 = (1 - e * s0) / (1 + e * s0)
+    w1 = (S1 * S2**e) ** n
+    sin_chi00 = (w1 - 1) / (w1 + 1)
+    c = (n + s0) * (1 - sin_chi00) / ((n - s0) * (1 + sin_chi00))
+    w2 = c * w1
+    chi0 = math.asin((w2 - 1) / (w2 + 1))
+    return e, n, c, R, k0, chi0, phi0, lam0, fe, fn
+
+
+def _oblique_stereo_forward(crs, lon_deg, lat_deg):
+    e, n, c, R, k0, chi0, phi0, lam0, fe, fn = _oblique_stereo_setup(crs)
+    lam = np.radians(np.asarray(lon_deg, dtype=np.float64))
+    # exact poles make (1+sin)/(1-sin) blow up; same clamp as mercator/lcc
+    phi = np.radians(
+        np.clip(np.asarray(lat_deg, dtype=np.float64), -89.9999, 89.9999)
+    )
+    s = np.sin(phi)
+    Sa = (1 + s) / (1 - s)
+    Sb = (1 - e * s) / (1 + e * s)
+    w = c * (Sa * Sb**e) ** n
+    chi = np.arcsin((w - 1) / (w + 1))
+    dlam = n * (lam - lam0)
+    B = 1 + np.sin(chi) * np.sin(chi0) + np.cos(chi) * np.cos(chi0) * np.cos(dlam)
+    x = fe + 2 * R * k0 * np.cos(chi) * np.sin(dlam) / B
+    y = fn + 2 * R * k0 * (
+        np.sin(chi) * np.cos(chi0) - np.cos(chi) * np.sin(chi0) * np.cos(dlam)
+    ) / B
+    return x, y
+
+
+def _oblique_stereo_inverse(crs, x, y):
+    e, n, c, R, k0, chi0, phi0, lam0, fe, fn = _oblique_stereo_setup(crs)
+    xp = np.asarray(x, dtype=np.float64) - fe
+    yp = np.asarray(y, dtype=np.float64) - fn
+    g = 2 * R * k0 * math.tan(math.pi / 4 - chi0 / 2)
+    h = 4 * R * k0 * math.tan(chi0) + g
+    i = np.arctan2(xp, h + yp)
+    j = np.arctan2(xp, g - yp) - i
+    chi = chi0 + 2 * np.arctan((yp - xp * np.tan(j / 2)) / (2 * R * k0))
+    dlam = j + 2 * i
+    lam = dlam / n + lam0
+    # isometric latitude of the conformal sphere -> ellipsoidal latitude
+    psi = 0.5 * np.log((1 + np.sin(chi)) / (c * (1 - np.sin(chi)))) / n
+    phi = 2 * np.arctan(np.exp(psi)) - np.pi / 2
+    for _ in range(8):
+        s = np.sin(phi)
+        psi_i = np.log(
+            np.tan(phi / 2 + np.pi / 4) * ((1 - e * s) / (1 + e * s)) ** (e / 2)
+        )
+        phi = phi - (psi_i - psi) * np.cos(phi) * (1 - e**2 * s**2) / (1 - e**2)
+    return np.degrees(lam), np.degrees(phi)
+
+
 _PROJ_IMPLS = {
     "transverse_mercator": (_tm_forward, _tm_inverse),
     "mercator_1sp": (_mercator_forward, _mercator_inverse),
@@ -678,6 +902,15 @@ _PROJ_IMPLS = {
     "lambert_conformal_conic_2sp": (_lcc_forward, _lcc_inverse),
     "lambert_conformal_conic_1sp": (_lcc_forward, _lcc_inverse),
     "lambert_conformal_conic": (_lcc_forward, _lcc_inverse),
+    "albers_conic_equal_area": (_albers_forward, _albers_inverse),
+    "albers": (_albers_forward, _albers_inverse),
+    "polar_stereographic": (_polar_stereo_forward, _polar_stereo_inverse),
+    "polar_stereographic_variant_a": (_polar_stereo_forward, _polar_stereo_inverse),
+    "polar_stereographic_variant_b": (_polar_stereo_forward, _polar_stereo_inverse),
+    "oblique_stereographic": (_oblique_stereo_forward, _oblique_stereo_inverse),
+    "double_stereographic": (_oblique_stereo_forward, _oblique_stereo_inverse),
+    "stereographic_north_pole": (_polar_stereo_forward, _polar_stereo_inverse),
+    "stereographic_south_pole": (_polar_stereo_forward, _polar_stereo_inverse),
 }
 
 
@@ -735,22 +968,58 @@ def _e2_of(crs):
     return f * (2 - f)
 
 
+_WGS84_A = 6378137.0
+_WGS84_E2 = (1.0 / 298.257223563) * (2 - 1.0 / 298.257223563)
+
+
 def _datum_shift(src, dst, lon, lat):
     """Geographic coordinates on src datum -> dst datum via WGS84, using the
     CRSes' TOWGS84 parameters. No-op when the declared shifts are equal
-    (same datum under any name spelling, or both WGS84-equivalent)."""
-    src_tw = src.towgs84 if src.towgs84 != _NULL_SHIFT else None
-    dst_tw = dst.towgs84 if dst.towgs84 != _NULL_SHIFT else None
-    if src_tw == dst_tw:  # includes None == None
-        return lon, lat
+    (same datum under any name spelling, or both WGS84-equivalent).
+
+    NTv2 grids registered via kart_tpu.gridshift (or $KART_NTV2_GRID_DIR)
+    take precedence over Helmert parameters for their datum — PROJ's own
+    priority — and compose with the other side's Helmert (grid src ->
+    WGS84 -> Helmert dst and vice versa). A datum that appears under more
+    than one spelling should be registered under every alias, or the
+    same-datum no-op can't recognise it."""
     if src.datum_name is not None and src.datum_name == dst.datum_name:
         return lon, lat
-    x, y, z = _geodetic_to_geocentric(src.semi_major, _e2_of(src), lon, lat)
-    if src_tw is not None:
+    from kart_tpu import gridshift
+
+    src_grid = gridshift.grid_for_datum(src.datum_name)
+    dst_grid = gridshift.grid_for_datum(dst.datum_name)
+    src_tw = src.towgs84 if src.towgs84 != _NULL_SHIFT else None
+    dst_tw = dst.towgs84 if dst.towgs84 != _NULL_SHIFT else None
+
+    if src_grid is None and dst_grid is None:
+        if src_tw == dst_tw:  # includes None == None
+            return lon, lat
+        x, y, z = _geodetic_to_geocentric(src.semi_major, _e2_of(src), lon, lat)
+        if src_tw is not None:
+            x, y, z = _helmert(src_tw, x, y, z)
+        if dst_tw is not None:
+            x, y, z = _helmert(dst_tw, x, y, z, inverse=True)
+        return _geocentric_to_geodetic(dst.semi_major, _e2_of(dst), x, y, z)
+
+    if src_grid is not None and src_grid is dst_grid:
+        return lon, lat  # same datum registered under both spellings
+
+    # to WGS84
+    if src_grid is not None:
+        lon, lat = src_grid.shift(lon, lat)
+    elif src_tw is not None:
+        x, y, z = _geodetic_to_geocentric(src.semi_major, _e2_of(src), lon, lat)
         x, y, z = _helmert(src_tw, x, y, z)
-    if dst_tw is not None:
+        lon, lat = _geocentric_to_geodetic(_WGS84_A, _WGS84_E2, x, y, z)
+    # from WGS84
+    if dst_grid is not None:
+        lon, lat = dst_grid.shift(lon, lat, inverse=True)
+    elif dst_tw is not None:
+        x, y, z = _geodetic_to_geocentric(_WGS84_A, _WGS84_E2, lon, lat)
         x, y, z = _helmert(dst_tw, x, y, z, inverse=True)
-    return _geocentric_to_geodetic(dst.semi_major, _e2_of(dst), x, y, z)
+        lon, lat = _geocentric_to_geodetic(dst.semi_major, _e2_of(dst), x, y, z)
+    return lon, lat
 
 
 class Transform:

@@ -255,3 +255,348 @@ def test_lcc_spherical_ellipsoid_no_crash():
     lon, lat = inv.transform(x, y)
     assert abs(lon[0] + 75.0) < 1e-7
     assert abs(lat[0] - 35.0) < 1e-7
+
+
+class TestNewProjections:
+    def test_albers_snyder_example(self):
+        """Snyder 1987 numerical example for Albers (Clarke 1866, sp
+        29.5/45.5, origin 23N 96W): (35N, 75W) -> 1885472.7, 1535925.0."""
+        from kart_tpu.crs import CRS, Transform
+
+        wkt = (
+            'PROJCS["Albers test",GEOGCS["NAD27",DATUM["North_American_Datum_1927",'
+            'SPHEROID["Clarke 1866",6378206.4,294.978698213898]],'
+            'PRIMEM["Greenwich",0],UNIT["degree",0.0174532925199433]],'
+            'PROJECTION["Albers_Conic_Equal_Area"],'
+            'PARAMETER["standard_parallel_1",29.5],'
+            'PARAMETER["standard_parallel_2",45.5],'
+            'PARAMETER["latitude_of_origin",23],'
+            'PARAMETER["central_meridian",-96],'
+            'PARAMETER["false_easting",0],PARAMETER["false_northing",0],'
+            'UNIT["metre",1]]'
+        )
+        crs = CRS(wkt)
+        from kart_tpu.crs import _albers_forward, _albers_inverse
+
+        x, y = _albers_forward(crs, -75.0, 35.0)
+        assert abs(float(x) - 1885472.7) < 1.0, float(x)
+        assert abs(float(y) - 1535925.0) < 1.0, float(y)
+        lon, lat = _albers_inverse(crs, x, y)
+        assert abs(float(lon) - -75.0) < 1e-8
+        assert abs(float(lat) - 35.0) < 1e-8
+
+    def test_polar_stereographic_ups_north(self):
+        """EPSG 9810 variant A example (UPS North): (73N, 44E) ->
+        3320416.75, 632668.43 with k0=0.994, FE=FN=2000000."""
+        from kart_tpu.crs import CRS, _polar_stereo_forward, _polar_stereo_inverse
+
+        wkt = (
+            'PROJCS["UPS North",GEOGCS["WGS 84",DATUM["WGS_1984",'
+            'SPHEROID["WGS 84",6378137,298.257223563]],'
+            'PRIMEM["Greenwich",0],UNIT["degree",0.0174532925199433]],'
+            'PROJECTION["Polar_Stereographic"],'
+            'PARAMETER["latitude_of_origin",90],'
+            'PARAMETER["central_meridian",0],'
+            'PARAMETER["scale_factor",0.994],'
+            'PARAMETER["false_easting",2000000],'
+            'PARAMETER["false_northing",2000000],UNIT["metre",1]]'
+        )
+        crs = CRS(wkt)
+        x, y = _polar_stereo_forward(crs, 44.0, 73.0)
+        assert abs(float(x) - 3320416.75) < 0.5, float(x)
+        assert abs(float(y) - 632668.43) < 0.5, float(y)
+        lon, lat = _polar_stereo_inverse(crs, x, y)
+        assert abs(float(lon) - 44.0) < 1e-8
+        assert abs(float(lat) - 73.0) < 1e-8
+
+    def test_polar_stereographic_south_roundtrip(self):
+        """Variant B, south pole (Antarctic-style std parallel -71)."""
+        import numpy as np
+
+        from kart_tpu.crs import CRS, _polar_stereo_forward, _polar_stereo_inverse
+
+        wkt = (
+            'PROJCS["Antarctic",GEOGCS["WGS 84",DATUM["WGS_1984",'
+            'SPHEROID["WGS 84",6378137,298.257223563]],'
+            'PRIMEM["Greenwich",0],UNIT["degree",0.0174532925199433]],'
+            'PROJECTION["Polar_Stereographic"],'
+            'PARAMETER["latitude_of_origin",-71],'
+            'PARAMETER["central_meridian",70],'
+            'PARAMETER["false_easting",6000000],'
+            'PARAMETER["false_northing",6000000],UNIT["metre",1]]'
+        )
+        crs = CRS(wkt)
+        lons = np.array([70.0, 120.0, -60.0, 0.0])
+        lats = np.array([-71.0, -75.0, -80.0, -89.5])
+        x, y = _polar_stereo_forward(crs, lons, lats)
+        lon2, lat2 = _polar_stereo_inverse(crs, x, y)
+        assert np.allclose(lon2, lons, atol=1e-8)
+        assert np.allclose(lat2, lats, atol=1e-8)
+        # the pole maps to the false origin
+        xp, yp = _polar_stereo_forward(crs, 0.0, -90.0)
+        assert abs(float(xp) - 6000000) < 1e-3
+        assert abs(float(yp) - 6000000) < 1e-3
+
+    def test_oblique_stereographic_rd_new(self):
+        """EPSG 9809 example (Amersfoort / RD New): (53N, 6E) ->
+        196105.283, 557057.739."""
+        from kart_tpu.crs import (
+            CRS,
+            _oblique_stereo_forward,
+            _oblique_stereo_inverse,
+        )
+
+        wkt = (
+            'PROJCS["Amersfoort / RD New",GEOGCS["Amersfoort",'
+            'DATUM["Amersfoort",SPHEROID["Bessel 1841",6377397.155,299.1528128]],'
+            'PRIMEM["Greenwich",0],UNIT["degree",0.0174532925199433]],'
+            'PROJECTION["Oblique_Stereographic"],'
+            'PARAMETER["latitude_of_origin",52.1561605555556],'
+            'PARAMETER["central_meridian",5.38763888888889],'
+            'PARAMETER["scale_factor",0.9999079],'
+            'PARAMETER["false_easting",155000],'
+            'PARAMETER["false_northing",463000],UNIT["metre",1]]'
+        )
+        crs = CRS(wkt)
+        x, y = _oblique_stereo_forward(crs, 6.0, 53.0)
+        assert abs(float(x) - 196105.283) < 0.05, float(x)
+        assert abs(float(y) - 557057.739) < 0.05, float(y)
+        lon, lat = _oblique_stereo_inverse(crs, x, y)
+        assert abs(float(lon) - 6.0) < 1e-8
+        assert abs(float(lat) - 53.0) < 1e-8
+
+    def test_albers_roundtrip_grid_and_transform_api(self):
+        import numpy as np
+
+        from kart_tpu.crs import Transform, WGS84_WKT
+
+        wkt = (
+            'PROJCS["conus albers",GEOGCS["WGS 84",DATUM["WGS_1984",'
+            'SPHEROID["WGS 84",6378137,298.257223563]],'
+            'PRIMEM["Greenwich",0],UNIT["degree",0.0174532925199433]],'
+            'PROJECTION["Albers_Conic_Equal_Area"],'
+            'PARAMETER["standard_parallel_1",29.5],'
+            'PARAMETER["standard_parallel_2",45.5],'
+            'PARAMETER["latitude_of_center",23],'
+            'PARAMETER["longitude_of_center",-96],'
+            'PARAMETER["false_easting",0],PARAMETER["false_northing",0],'
+            'UNIT["metre",1]]'
+        )
+        t = Transform(WGS84_WKT, wkt)
+        lons = np.array([-120.0, -96.0, -75.0, -66.0])
+        lats = np.array([49.0, 23.0, 35.0, 18.0])
+        x, y = t.transform(lons, lats)
+        back = Transform(wkt, WGS84_WKT)
+        lon2, lat2 = back.transform(x, y)
+        assert np.allclose(lon2, lons, atol=1e-7)
+        assert np.allclose(lat2, lats, atol=1e-7)
+
+
+class TestNTv2GridShift:
+    @staticmethod
+    def _write_gsb(path, *, lat_shift_sec=1.8, lon_shift_sec=-2.4):
+        """A minimal valid NTv2 file: one subgrid covering lat 40..42N,
+        lon 74..76W (NTv2 longitudes positive west), 0.5-degree cells, with
+        a linear lat-shift field and constant lon shift."""
+        import struct
+
+        import numpy as np
+
+        def rec(name, value, kind):
+            out = name.ljust(8).encode()
+            if kind == "i":
+                return out + struct.pack("<i", value) + b"\x00\x00\x00\x00"
+            if kind == "d":
+                return out + struct.pack("<d", value)
+            return out + value.ljust(8).encode()[:8]
+
+        s_lat, n_lat = 40 * 3600.0, 42 * 3600.0
+        e_long, w_long = 74 * 3600.0, 76 * 3600.0
+        inc = 0.5 * 3600.0
+        n_rows = int((n_lat - s_lat) / inc) + 1
+        n_cols = int((w_long - e_long) / inc) + 1
+        header = b"".join(
+            [
+                rec("NUM_OREC", 11, "i"),
+                rec("NUM_SREC", 11, "i"),
+                rec("NUM_FILE", 1, "i"),
+                rec("GS_TYPE", "SECONDS", "s"),
+                rec("VERSION", "NTv2.0", "s"),
+                rec("SYSTEM_F", "TESTDATM", "s"),
+                rec("SYSTEM_T", "WGS84", "s"),
+                rec("MAJOR_F", 6378137.0, "d"),
+                rec("MINOR_F", 6356752.314, "d"),
+                rec("MAJOR_T", 6378137.0, "d"),
+                rec("MINOR_T", 6356752.314, "d"),
+                rec("SUB_NAME", "TEST", "s"),
+                rec("PARENT", "NONE", "s"),
+                rec("CREATED", "20260101", "s"),
+                rec("UPDATED", "20260101", "s"),
+                rec("S_LAT", s_lat, "d"),
+                rec("N_LAT", n_lat, "d"),
+                rec("E_LONG", e_long, "d"),
+                rec("W_LONG", w_long, "d"),
+                rec("LAT_INC", inc, "d"),
+                rec("LONG_INC", inc, "d"),
+                rec("GS_COUNT", n_rows * n_cols, "i"),
+            ]
+        )
+        nodes = []
+        for r in range(n_rows):
+            for c in range(n_cols):
+                # lat shift varies linearly with row; lon shift constant
+                nodes.append(
+                    struct.pack(
+                        "<4f", lat_shift_sec * r / (n_rows - 1), lon_shift_sec, 0, 0
+                    )
+                )
+        with open(path, "wb") as f:
+            f.write(header + b"".join(nodes))
+        return n_rows, n_cols
+
+    def test_parse_and_bilinear(self, tmp_path):
+        import numpy as np
+
+        from kart_tpu.gridshift import NTv2Grid
+
+        gsb = tmp_path / "test.gsb"
+        self._write_gsb(gsb)
+        grid = NTv2Grid.open(str(gsb))
+        assert grid.system_from == "TESTDATM"
+        (sg,) = grid.subgrids
+        assert (sg.n_rows, sg.n_cols) == (5, 5)
+
+        # at the south edge the lat shift is 0; at the north edge 1.8"
+        lon, lat = grid.shift(np.array([-75.0]), np.array([40.0]))
+        assert abs(lat[0] - 40.0) < 1e-12
+        lon, lat = grid.shift(np.array([-75.0]), np.array([42.0]))
+        assert abs(lat[0] - (42.0 + 1.8 / 3600)) < 1e-9
+        # halfway: half the shift (bilinear)
+        lon, lat = grid.shift(np.array([-75.0]), np.array([41.0]))
+        assert abs(lat[0] - (41.0 + 0.9 / 3600)) < 1e-9
+        # lon shift -2.4" positive-west means +2.4" east-positive
+        assert abs(lon[0] - (-75.0 + 2.4 / 3600)) < 1e-9
+        # outside the grid: fail open, unchanged
+        lon, lat = grid.shift(np.array([10.0]), np.array([0.0]))
+        assert lon[0] == 10.0 and lat[0] == 0.0
+        # inverse round-trips
+        flon, flat = grid.shift(np.array([-75.3]), np.array([41.3]))
+        blon, blat = grid.shift(flon, flat, inverse=True)
+        assert abs(blon[0] - -75.3) < 1e-10 and abs(blat[0] - 41.3) < 1e-10
+
+    def test_transform_uses_registered_grid(self, tmp_path):
+        import numpy as np
+
+        from kart_tpu import gridshift
+        from kart_tpu.crs import Transform, WGS84_WKT
+        from kart_tpu.gridshift import NTv2Grid
+
+        gsb = tmp_path / "test.gsb"
+        self._write_gsb(gsb)
+        src_wkt = WGS84_WKT.replace("WGS_1984", "TESTDATM").replace(
+            'GEOGCS["WGS 84"', 'GEOGCS["Test Datum"'
+        )
+        try:
+            gridshift.clear_grids()
+            gridshift.register_grid("TESTDATM", NTv2Grid.open(str(gsb)))
+            t = Transform(src_wkt, WGS84_WKT)
+            lon, lat = t.transform(np.array([-75.0]), np.array([42.0]))
+            assert abs(lat[0] - (42.0 + 1.8 / 3600)) < 1e-9
+        finally:
+            gridshift.clear_grids()
+
+    def test_env_dir_scan(self, tmp_path, monkeypatch):
+        from kart_tpu import gridshift
+
+        self._write_gsb(tmp_path / "a.gsb")
+        monkeypatch.setenv("KART_NTV2_GRID_DIR", str(tmp_path))
+        try:
+            gridshift.clear_grids()
+            assert gridshift.grid_for_datum("TESTDATM") is not None
+            assert gridshift.grid_for_datum("testdatm") is not None  # normalised
+            assert gridshift.grid_for_datum("other") is None
+        finally:
+            gridshift.clear_grids()
+
+
+class TestDatumShiftComposition:
+    def test_grid_composes_with_helmert_destination(self, tmp_path):
+        """Grid src -> WGS84 must still apply the destination's TOWGS84
+        Helmert: a zero-shift grid + a dx=100m dst Helmert moves the
+        coordinate, not returns it unchanged."""
+        import numpy as np
+
+        from kart_tpu import gridshift
+        from kart_tpu.crs import CRS, Transform, WGS84_WKT, _datum_shift
+        from kart_tpu.gridshift import NTv2Grid
+
+        gsb = tmp_path / "zero.gsb"
+        TestNTv2GridShift._write_gsb(gsb, lat_shift_sec=0.0, lon_shift_sec=0.0)
+        src_wkt = WGS84_WKT.replace("WGS_1984", "GRIDDATUM")
+        dst_wkt = (
+            'GEOGCS["shifted",DATUM["Shifted_Datum",'
+            'SPHEROID["WGS 84",6378137,298.257223563],'
+            'TOWGS84[100,0,0,0,0,0,0]],'
+            'PRIMEM["Greenwich",0],UNIT["degree",0.0174532925199433]]'
+        )
+        try:
+            gridshift.clear_grids()
+            gridshift.register_grid("GRIDDATUM", NTv2Grid.open(str(gsb)))
+            lon, lat = _datum_shift(
+                CRS(src_wkt), CRS(dst_wkt), np.array([-75.0]), np.array([41.0])
+            )
+            # dx=100m at lon -75: the longitude must move by roughly
+            # 100*cos(lon)/(a*cos(lat)) rad — definitely not zero
+            assert abs(lon[0] - -75.0) > 1e-5
+        finally:
+            gridshift.clear_grids()
+
+    def test_same_grid_both_spellings_is_noop(self, tmp_path):
+        import numpy as np
+
+        from kart_tpu import gridshift
+        from kart_tpu.crs import CRS, WGS84_WKT, _datum_shift
+        from kart_tpu.gridshift import NTv2Grid
+
+        gsb = tmp_path / "g.gsb"
+        TestNTv2GridShift._write_gsb(gsb)
+        a_wkt = WGS84_WKT.replace("WGS_1984", "NAD27")
+        b_wkt = WGS84_WKT.replace("WGS_1984", "North_American_Datum_1927")
+        try:
+            gridshift.clear_grids()
+            grid = NTv2Grid.open(str(gsb))
+            gridshift.register_grid("NAD27", grid)
+            gridshift.register_grid("North_American_Datum_1927", grid)
+            lon, lat = _datum_shift(
+                CRS(a_wkt), CRS(b_wkt), np.array([-75.0]), np.array([41.0])
+            )
+            assert lon[0] == -75.0 and lat[0] == 41.0
+        finally:
+            gridshift.clear_grids()
+
+    def test_corrupt_gsb_in_env_dir_is_skipped(self, tmp_path, monkeypatch):
+        from kart_tpu import gridshift
+
+        (tmp_path / "bad.gsb").write_bytes(b"NUM_OREC" + b"\x0b\x00\x00\x00junk")
+        TestNTv2GridShift._write_gsb(tmp_path / "good.gsb")
+        monkeypatch.setenv("KART_NTV2_GRID_DIR", str(tmp_path))
+        try:
+            gridshift.clear_grids()
+            assert gridshift.grid_for_datum("TESTDATM") is not None
+        finally:
+            gridshift.clear_grids()
+
+    def test_minutes_grid_rejected(self, tmp_path):
+        import struct
+
+        import pytest
+
+        from kart_tpu.gridshift import GridShiftError, NTv2Grid
+
+        gsb = tmp_path / "m.gsb"
+        TestNTv2GridShift._write_gsb(gsb)
+        data = bytearray(gsb.read_bytes())
+        data[3 * 16 + 8 : 3 * 16 + 16] = b"MINUTES "
+        gsb.write_bytes(bytes(data))
+        with pytest.raises(GridShiftError, match="SECONDS"):
+            NTv2Grid.open(str(gsb))
