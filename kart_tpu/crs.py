@@ -734,10 +734,9 @@ def _albers_inverse(crs, x, y):
         phi = np.arcsin(np.clip(q / 2, -1.0, 1.0))
         for _ in range(8):
             s = np.sin(phi)
+            # Snyder (3-16): the bracket is (q - q(phi)) / (1 - e2)
             phi = phi + (1 - e2 * s**2) ** 2 / (2 * np.cos(phi)) * (
-                q / (1 - e2)
-                - s / (1 - e2 * s**2)
-                + (1 / (2 * e)) * np.log((1 - e * s) / (1 + e * s))
+                (q - _q_of(e, e2, s)) / (1 - e2)
             )
         # exactly-polar q would divide by cos(phi)=0 above; clamp handles it
         phi = np.where(np.abs(q) >= np.abs(qp) - 1e-12, np.sign(q) * np.pi / 2, phi)

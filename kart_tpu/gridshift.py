@@ -114,9 +114,16 @@ class NTv2Grid:
                     fields[name] = rec_str(off)
             pos += n_srec * 16
             count = fields["GS_COUNT"]
-            nodes = np.frombuffer(
-                data, dtype=endian + "f4", count=count * 4, offset=pos
-            ).reshape(count, 4)
+            try:
+                nodes = np.frombuffer(
+                    data, dtype=endian + "f4", count=count * 4, offset=pos
+                ).reshape(count, 4)
+            except ValueError as err:
+                # truncated node section: keep the module's error contract
+                raise GridShiftError(
+                    f"{path}: truncated node data in subgrid "
+                    f"{fields.get('SUB_NAME', '?')!r}: {err}"
+                )
             pos += count * 16
 
             sg = SubGrid()
@@ -247,9 +254,10 @@ def _scan_env_dir():
             )
             continue
         # registered under the declared source system AND the filename stem,
-        # so alternate datum spellings can be aliased by naming the file
-        register_grid(grid.system_from, grid)
-        register_grid(os.path.splitext(fn)[0], grid)
+        # so alternate datum spellings can be aliased by naming the file;
+        # explicit register_grid() calls made before the lazy scan win
+        _REGISTRY.setdefault(_norm(grid.system_from), grid)
+        _REGISTRY.setdefault(_norm(os.path.splitext(fn)[0]), grid)
 
 
 def grid_for_datum(datum_name):

@@ -1,0 +1,40 @@
+-- column specs (v2 schema -> SQL)
+`fid` BIGINT AUTO_INCREMENT
+`geom` POINT SRID 4326
+`flag` BIT
+`payload` LONGBLOB
+`born` DATE
+`ratio32` FLOAT
+`ratio64` DOUBLE PRECISION
+`tiny` TINYINT
+`small` SMALLINT
+`med` INT
+`amount` NUMERIC(10,2)
+`name` LONGTEXT
+`code` VARCHAR(40)
+`at_time` TIME
+`seen_utc` TIMESTAMP
+`seen_naive` DATETIME
+
+-- base DDL (kart_state / kart_track / trigger support)
+CREATE DATABASE IF NOT EXISTS `kartwc`;
+CREATE TABLE IF NOT EXISTS `kartwc`.`_kart_state` (
+                table_name VARCHAR(255) NOT NULL, `key` VARCHAR(255) NOT NULL,
+                value TEXT, PRIMARY KEY (table_name, `key`));
+CREATE TABLE IF NOT EXISTS `kartwc`.`_kart_track` (
+                table_name VARCHAR(255) NOT NULL, pk VARCHAR(400),
+                PRIMARY KEY (table_name, pk));
+
+-- change-tracking triggers
+CREATE TRIGGER `kartwc`.`_kart_track_wide_table_ins` AFTER INSERT ON `kartwc`.`wide_table` FOR EACH ROW REPLACE INTO `kartwc`.`_kart_track` (table_name, pk) VALUES ('wide_table', NEW.`fid`);
+CREATE TRIGGER `kartwc`.`_kart_track_wide_table_upd` AFTER UPDATE ON `kartwc`.`wide_table` FOR EACH ROW REPLACE INTO `kartwc`.`_kart_track` (table_name, pk) VALUES ('wide_table', OLD.`fid`), ('wide_table', NEW.`fid`);
+CREATE TRIGGER `kartwc`.`_kart_track_wide_table_del` AFTER DELETE ON `kartwc`.`wide_table` FOR EACH ROW REPLACE INTO `kartwc`.`_kart_track` (table_name, pk) VALUES ('wide_table', OLD.`fid`);
+DROP TRIGGER IF EXISTS `kartwc`.`_kart_track_wide_table_ins`;
+DROP TRIGGER IF EXISTS `kartwc`.`_kart_track_wide_table_upd`;
+DROP TRIGGER IF EXISTS `kartwc`.`_kart_track_wide_table_del`;
+
+-- CRS registration
+CREATE SPATIAL REFERENCE SYSTEM IF NOT EXISTS 4326 NAME %s DEFINITION %s;
+
+-- checkout upsert
+REPLACE INTO `kartwc`.`wide_table` (`fid`, `geom`, `flag`, `payload`, `born`, `ratio32`, `ratio64`, `tiny`, `small`, `med`, `amount`, `name`, `code`, `at_time`, `seen_utc`, `seen_naive`) VALUES (%s, ST_GeomFromWKB(%s, 4326, 'axis-order=long-lat'), %s, %s, %s, %s, %s, %s, %s, %s, %s, %s, %s, %s, %s, %s);

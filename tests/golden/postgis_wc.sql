@@ -1,0 +1,54 @@
+-- column specs (v2 schema -> SQL)
+"fid" BIGSERIAL
+"geom" GEOMETRY(POINT,4326)
+"flag" BOOLEAN
+"payload" BYTEA
+"born" DATE
+"ratio32" REAL
+"ratio64" DOUBLE PRECISION
+"tiny" SMALLINT
+"small" SMALLINT
+"med" INTEGER
+"amount" NUMERIC(10,2)
+"name" TEXT
+"code" VARCHAR(40)
+"at_time" TIME
+"seen_utc" TIMESTAMPTZ
+"seen_naive" TIMESTAMP
+
+-- base DDL (kart_state / kart_track / trigger support)
+CREATE SCHEMA IF NOT EXISTS "kartwc";
+CREATE TABLE IF NOT EXISTS "kartwc"."_kart_state" (
+                table_name TEXT NOT NULL, key TEXT NOT NULL, value TEXT,
+                PRIMARY KEY (table_name, key));
+CREATE TABLE IF NOT EXISTS "kartwc"."_kart_track" (
+                table_name TEXT NOT NULL, pk TEXT,
+                PRIMARY KEY (table_name, pk));
+CREATE OR REPLACE FUNCTION "kartwc"."_kart_track_proc"() RETURNS TRIGGER AS $body$
+            DECLARE
+                pk_field text := quote_ident(TG_ARGV[0]);
+                pk_old text; pk_new text;
+            BEGIN
+                IF (TG_OP = 'INSERT' OR TG_OP = 'UPDATE') THEN
+                    EXECUTE 'SELECT $1.' || pk_field USING NEW INTO pk_new;
+                    INSERT INTO "kartwc"."_kart_track" (table_name, pk)
+                    VALUES (TG_TABLE_NAME::TEXT, pk_new) ON CONFLICT DO NOTHING;
+                END IF;
+                IF (TG_OP = 'UPDATE' OR TG_OP = 'DELETE') THEN
+                    EXECUTE 'SELECT $1.' || pk_field USING OLD INTO pk_old;
+                    INSERT INTO "kartwc"."_kart_track" (table_name, pk)
+                    VALUES (TG_TABLE_NAME::TEXT, pk_old) ON CONFLICT DO NOTHING;
+                    IF (TG_OP = 'DELETE') THEN RETURN OLD; END IF;
+                END IF;
+                RETURN NEW;
+            END; $body$ LANGUAGE plpgsql SECURITY DEFINER;
+
+-- change-tracking triggers
+CREATE TRIGGER "_kart_track_trigger" AFTER INSERT OR UPDATE OR DELETE ON "kartwc"."wide_table" FOR EACH ROW EXECUTE PROCEDURE "kartwc"."_kart_track_proc"('fid');
+DROP TRIGGER IF EXISTS "_kart_track_trigger" ON "kartwc"."wide_table";
+
+-- CRS registration
+INSERT INTO public.spatial_ref_sys (srid, auth_name, auth_srid, srtext) VALUES (%s, %s, %s, %s) ON CONFLICT (srid) DO NOTHING;
+
+-- checkout upsert
+INSERT INTO "kartwc"."wide_table" ("fid", "geom", "flag", "payload", "born", "ratio32", "ratio64", "tiny", "small", "med", "amount", "name", "code", "at_time", "seen_utc", "seen_naive") VALUES (%s, %s::geometry, %s, %s, %s, %s, %s, %s, %s, %s, %s, %s, %s, %s, %s, %s) ON CONFLICT ("fid") DO UPDATE SET "geom" = EXCLUDED."geom", "flag" = EXCLUDED."flag", "payload" = EXCLUDED."payload", "born" = EXCLUDED."born", "ratio32" = EXCLUDED."ratio32", "ratio64" = EXCLUDED."ratio64", "tiny" = EXCLUDED."tiny", "small" = EXCLUDED."small", "med" = EXCLUDED."med", "amount" = EXCLUDED."amount", "name" = EXCLUDED."name", "code" = EXCLUDED."code", "at_time" = EXCLUDED."at_time", "seen_utc" = EXCLUDED."seen_utc", "seen_naive" = EXCLUDED."seen_naive";

@@ -1,0 +1,31 @@
+-- column specs (v2 schema -> SQL)
+"fid" BIGINT
+"geom" GEOMETRY CHECK ("geom".STGeometryType() IN ('POINT')) CHECK ("geom".STSrid = 4326)
+"flag" BIT
+"payload" VARBINARY(max)
+"born" DATE
+"ratio32" REAL
+"ratio64" FLOAT
+"tiny" TINYINT
+"small" SMALLINT
+"med" INT
+"amount" NUMERIC(10,2)
+"name" NVARCHAR(max)
+"code" NVARCHAR(40)
+"at_time" TIME
+"seen_utc" DATETIMEOFFSET
+"seen_naive" DATETIME2
+
+-- base DDL (kart_state / kart_track / trigger support)
+IF SCHEMA_ID('kartwc') IS NULL EXEC('CREATE SCHEMA "kartwc"');
+IF OBJECT_ID('kartwc._kart_state') IS NULL CREATE TABLE "kartwc"."_kart_state" (table_name NVARCHAR(400) NOT NULL, [key] NVARCHAR(400) NOT NULL, value NVARCHAR(max), PRIMARY KEY (table_name, [key]));
+IF OBJECT_ID('kartwc._kart_track') IS NULL CREATE TABLE "kartwc"."_kart_track" (table_name NVARCHAR(400) NOT NULL, pk NVARCHAR(400), PRIMARY KEY (table_name, pk));
+
+-- change-tracking triggers
+CREATE TRIGGER "kartwc"."_kart_track_wide_table_trigger" ON "kartwc"."wide_table" AFTER INSERT, UPDATE, DELETE AS BEGIN MERGE "kartwc"."_kart_track" TRA USING (SELECT 'wide_table', "fid" FROM inserted UNION SELECT 'wide_table', "fid" FROM deleted) AS SRC (table_name, pk) ON SRC.table_name = TRA.table_name AND SRC.pk = TRA.pk WHEN NOT MATCHED THEN INSERT (table_name, pk) VALUES (SRC.table_name, SRC.pk); END;
+DROP TRIGGER IF EXISTS "kartwc"."_kart_track_wide_table_trigger";
+
+-- CRS registration
+
+-- checkout upsert
+MERGE "kartwc"."wide_table" TGT USING (SELECT ?, geometry::STGeomFromWKB(?, 4326), ?, ?, ?, ?, ?, ?, ?, ?, ?, ?, ?, ?, ?, ?) AS SRC ("fid", "geom", "flag", "payload", "born", "ratio32", "ratio64", "tiny", "small", "med", "amount", "name", "code", "at_time", "seen_utc", "seen_naive") ON SRC."fid" = TGT."fid" WHEN MATCHED THEN UPDATE SET TGT."geom" = SRC."geom", TGT."flag" = SRC."flag", TGT."payload" = SRC."payload", TGT."born" = SRC."born", TGT."ratio32" = SRC."ratio32", TGT."ratio64" = SRC."ratio64", TGT."tiny" = SRC."tiny", TGT."small" = SRC."small", TGT."med" = SRC."med", TGT."amount" = SRC."amount", TGT."name" = SRC."name", TGT."code" = SRC."code", TGT."at_time" = SRC."at_time", TGT."seen_utc" = SRC."seen_utc", TGT."seen_naive" = SRC."seen_naive" WHEN NOT MATCHED THEN INSERT ("fid", "geom", "flag", "payload", "born", "ratio32", "ratio64", "tiny", "small", "med", "amount", "name", "code", "at_time", "seen_utc", "seen_naive") VALUES (SRC."fid", SRC."geom", SRC."flag", SRC."payload", SRC."born", SRC."ratio32", SRC."ratio64", SRC."tiny", SRC."small", SRC."med", SRC."amount", SRC."name", SRC."code", SRC."at_time", SRC."seen_utc", SRC."seen_naive");;

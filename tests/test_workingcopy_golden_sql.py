@@ -1,0 +1,208 @@
+"""Golden-SQL parity for the server-database working copies.
+
+Live PostGIS / MySQL / SQL Server instances aren't available in this
+environment (those tests skip), so the SQL each dialect emits — create
+table, change-tracking triggers, CRS registration, checkout upsert, state/
+track bookkeeping — is snapshotted against golden files instead, and the
+type mappings are asserted directly against the expectations derived from
+the reference's adapters (kart/sqlalchemy/adapter/{postgis,mysql,
+sqlserver}.py V2_TYPE_TO_SQL_TYPE tables).
+
+Regenerate the goldens after an intentional SQL change with:
+
+    KART_REGEN_GOLDEN=1 python -m pytest tests/test_workingcopy_golden_sql.py
+"""
+
+import os
+
+import pytest
+
+from kart_tpu.adapters.mysql import MySqlAdapter
+from kart_tpu.adapters.postgis import PostgisAdapter
+from kart_tpu.adapters.sqlserver import SqlServerAdapter
+from kart_tpu.models.schema import ColumnSchema, Schema
+
+GOLDEN_DIR = os.path.join(os.path.dirname(__file__), "golden")
+
+
+def _col(name, data_type, pk_index=None, **extra):
+    return ColumnSchema(
+        id=f"00000000-0000-4000-8000-{abs(hash(name)) % 10**12:012d}",
+        name=name,
+        data_type=data_type,
+        pk_index=pk_index,
+        extra_type_info=extra,
+    )
+
+
+# one column per V2 data type / size variant the adapters must map
+WIDE_SCHEMA = Schema(
+    [
+        _col("fid", "integer", pk_index=0, size=64),
+        _col("geom", "geometry", geometryType="POINT", geometryCRS="EPSG:4326"),
+        _col("flag", "boolean"),
+        _col("payload", "blob"),
+        _col("born", "date"),
+        _col("ratio32", "float", size=32),
+        _col("ratio64", "float", size=64),
+        _col("tiny", "integer", size=8),
+        _col("small", "integer", size=16),
+        _col("med", "integer", size=32),
+        _col("amount", "numeric", precision=10, scale=2),
+        _col("name", "text"),
+        _col("code", "text", length=40),
+        _col("at_time", "time"),
+        _col("seen_utc", "timestamp", timezone="UTC"),
+        _col("seen_naive", "timestamp"),
+    ]
+)
+
+ADAPTERS = {
+    "postgis": PostgisAdapter,
+    "mysql": MySqlAdapter,
+    "sqlserver": SqlServerAdapter,
+}
+
+
+def _stmts(value):
+    """Adapters return a statement string or a list of them."""
+    if value is None:
+        return []
+    if isinstance(value, str):
+        return [value]
+    return list(value)
+
+
+def emit_dialect_sql(adapter):
+    """Everything the dialect says to the server for a canonical dataset."""
+    out = []
+    db_schema = "kartwc"
+    table = "wide_table"
+
+    out.append("-- column specs (v2 schema -> SQL)")
+    for col in WIDE_SCHEMA.columns:
+        spec = adapter.v2_column_schema_to_sql_spec(
+            col, has_int_pk=True, crs_id=4326
+        )
+        out.append(f"{spec}")
+
+    out.append("")
+    out.append("-- base DDL (kart_state / kart_track / trigger support)")
+    for stmt in _stmts(adapter.base_ddl(db_schema)):
+        out.append(stmt.strip() + ";")
+
+    out.append("")
+    out.append("-- change-tracking triggers")
+    for stmt in _stmts(adapter.create_trigger_sql(db_schema, table, "fid")):
+        out.append(stmt.strip() + ";")
+    for stmt in _stmts(adapter.drop_trigger_sql(db_schema, table)):
+        out.append(stmt.strip() + ";")
+
+    out.append("")
+    out.append("-- CRS registration")
+    stmt = adapter.register_crs_sql(4326, "EPSG", 4326, "GEOGCS[...]")
+    if stmt:
+        sql = stmt[0] if isinstance(stmt, tuple) else stmt
+        out.append(str(sql).strip() + ";")
+
+    out.append("")
+    out.append("-- checkout upsert")
+    upsert = adapter.upsert_sql(
+        db_schema,
+        table,
+        [c.name for c in WIDE_SCHEMA.columns],
+        ["fid"],
+        crs_id=4326,
+        schema=WIDE_SCHEMA,
+    )
+    out.append(str(upsert).strip() + ";")
+    return "\n".join(out) + "\n"
+
+
+@pytest.mark.parametrize("name", sorted(ADAPTERS))
+def test_golden_sql(name):
+    adapter = ADAPTERS[name]
+    got = emit_dialect_sql(adapter)
+    path = os.path.join(GOLDEN_DIR, f"{name}_wc.sql")
+    if os.environ.get("KART_REGEN_GOLDEN") == "1":
+        os.makedirs(GOLDEN_DIR, exist_ok=True)
+        with open(path, "w") as f:
+            f.write(got)
+        pytest.skip(f"regenerated {path}")
+    assert os.path.exists(path), (
+        f"golden file missing; run KART_REGEN_GOLDEN=1 pytest {__file__}"
+    )
+    with open(path) as f:
+        want = f.read()
+    assert got == want, (
+        f"{name} working-copy SQL changed; diff against {path} and "
+        f"regenerate with KART_REGEN_GOLDEN=1 if intentional"
+    )
+
+
+# -- type-mapping parity with the reference adapters ------------------------
+# expectations transcribed from the reference's V2_TYPE_TO_SQL_TYPE tables
+# (kart/sqlalchemy/adapter/postgis.py:29-47, mysql.py:28-46,
+# sqlserver.py:52-70)
+
+REFERENCE_TYPE_MAP = {
+    "postgis": {
+        "flag": "BOOLEAN",
+        "payload": "BYTEA",
+        "born": "DATE",
+        "ratio32": "REAL",
+        "ratio64": "DOUBLE PRECISION",
+        "tiny": "SMALLINT",  # approximated, like the reference
+        "small": "SMALLINT",
+        "med": "INTEGER",
+        "fid": "BIGINT",
+        "name": "TEXT",
+        "code": "VARCHAR(40)",
+        "at_time": "TIME",
+        "seen_utc": "TIMESTAMPTZ",
+        "seen_naive": "TIMESTAMP",
+        "amount": "NUMERIC(10,2)",
+    },
+    "mysql": {
+        "flag": "BIT",
+        "payload": "LONGBLOB",
+        "born": "DATE",
+        "ratio32": "FLOAT",
+        "ratio64": "DOUBLE PRECISION",
+        "tiny": "TINYINT",
+        "small": "SMALLINT",
+        "med": "INT",
+        "fid": "BIGINT",
+        "name": "LONGTEXT",
+        "at_time": "TIME",
+        "seen_utc": "TIMESTAMP",
+        "seen_naive": "DATETIME",
+        "amount": "NUMERIC(10,2)",
+    },
+    "sqlserver": {
+        "flag": "BIT",
+        "payload": "VARBINARY(max)",
+        "born": "DATE",
+        "ratio32": "REAL",
+        "ratio64": "FLOAT",
+        "tiny": "TINYINT",
+        "small": "SMALLINT",
+        "med": "INT",
+        "fid": "BIGINT",
+        "at_time": "TIME",
+        "seen_utc": "DATETIMEOFFSET",
+        "seen_naive": "DATETIME2",
+        "amount": "NUMERIC(10,2)",
+    },
+}
+
+
+@pytest.mark.parametrize("name", sorted(REFERENCE_TYPE_MAP))
+def test_type_mapping_matches_reference(name):
+    adapter = ADAPTERS[name]
+    cols = {c.name: c for c in WIDE_SCHEMA.columns}
+    for col_name, want in REFERENCE_TYPE_MAP[name].items():
+        got = adapter.v2_type_to_sql_type(cols[col_name])
+        assert got.upper() == want.upper(), (
+            f"{name}.{col_name}: {got!r} != reference {want!r}"
+        )
