@@ -82,6 +82,8 @@ class ImportSource:
         lowered = spec.lower()
         if lowered.endswith(".gpkg"):
             return GPKGImportSource.open_all(spec, table=table)
+        if lowered.endswith((".geojsonl", ".ndjson", ".geojsons")):
+            return [GeoJSONSeqImportSource(spec)]
         if lowered.endswith((".geojson", ".json")):
             return [GeoJSONImportSource(spec)]
         if lowered.endswith(".csv"):
@@ -90,14 +92,62 @@ class ImportSource:
             from kart_tpu.importer.shapefile import ShapefileImportSource
 
             return [ShapefileImportSource(spec)]
+        if lowered.endswith(".zip"):
+            return [_open_zipped_shapefile(spec)]
         if spec.startswith(("postgresql://", "postgres://")):
             from kart_tpu.importer.postgres import PostgresImportSource
 
             return PostgresImportSource.open_all(spec, table=table)
         raise ImportSourceError(
-            f"Don't know how to import {spec!r} — "
-            f"supported: .gpkg, .shp, .geojson, .csv, postgresql://"
+            f"Don't know how to import {spec!r} — supported: .gpkg, .shp, "
+            f".zip (shapefile), .geojson, .geojsonl/.ndjson, .csv, "
+            f"postgresql://"
         )
+
+
+def _open_zipped_shapefile(spec):
+    """A .zip containing a shapefile (the common distribution form OGR's
+    /vsizip/ handles): extract the sidecar set to a temp dir that lives as
+    long as the source object."""
+    import tempfile
+    import zipfile
+
+    from kart_tpu.importer.shapefile import ShapefileImportSource
+
+    try:
+        zf = zipfile.ZipFile(spec)
+    except (OSError, zipfile.BadZipFile) as e:
+        raise ImportSourceError(f"Cannot read {spec!r}: {e}")
+    with zf:
+        shp_names = [
+            n for n in zf.namelist()
+            if n.lower().endswith(".shp") and not n.startswith("__MACOSX")
+        ]
+        if len(shp_names) != 1:
+            raise ImportSourceError(
+                f"{spec!r} must contain exactly one .shp (found {len(shp_names)})"
+            )
+        stem = os.path.splitext(shp_names[0])[0]
+        tmp = tempfile.TemporaryDirectory(prefix="kart-zip-import-")
+        extracted_shp = None
+        for name in zf.namelist():
+            base, ext = os.path.splitext(name)
+            if base != stem or name.endswith("/"):
+                continue
+            # flatten to the temp root; reject path traversal
+            target = os.path.join(tmp.name, os.path.basename(name))
+            with zf.open(name) as src, open(target, "wb") as dst:
+                dst.write(src.read())
+            if ext.lower() == ".shp":
+                extracted_shp = target
+    # schema ids seed from the zip spec + inner name, not the random temp
+    # path — re-opens of the same archive must yield the same column ids
+    source = ShapefileImportSource(
+        extracted_shp, schema_id_seed=f"{spec}!{shp_names[0]}"
+    )
+    source.dest_path = os.path.splitext(os.path.basename(spec))[0]
+    source._tmpdir = tmp  # keep the extraction alive with the source
+    return source
 
 
 class GPKGImportSource(ImportSource):
@@ -299,12 +349,16 @@ class GeoJSONImportSource(ImportSource):
         base = os.path.splitext(os.path.basename(path))[0]
         self.dest_path = dest_path or base
         self.crs = crs
+        self._features_json = self._load_features(path)
+        self._schema = self._sniff_schema()
+
+    @staticmethod
+    def _load_features(path):
         with open(path) as f:
             doc = json.load(f)
         if doc.get("type") != "FeatureCollection":
             raise ImportSourceError(f"{path} is not a GeoJSON FeatureCollection")
-        self._features_json = doc.get("features", [])
-        self._schema = self._sniff_schema()
+        return doc.get("features", [])
 
     def _sniff_schema(self):
         prop_types = {}
@@ -399,9 +453,65 @@ class GeoJSONImportSource(ImportSource):
             yield out
 
 
+class GeoJSONSeqImportSource(GeoJSONImportSource):
+    """Newline-delimited GeoJSON (.geojsonl / .ndjson / GeoJSONSeq, incl.
+    RFC 8142 RS-prefixed records): one Feature object per line (the OGR
+    GeoJSONSeq driver's format; reference imports it via OGR,
+    kart/ogr_import_source.py:30-40)."""
+
+    @staticmethod
+    def _load_features(path):
+        with open(path) as f:
+            text = f.read()
+        if "\x1e" in text:
+            # RFC 8142: RS-delimited records, each of which may span lines
+            # (pretty-printed GeoJSONSeq is valid)
+            records = [
+                (i, chunk) for i, chunk in enumerate(text.split("\x1e"), 0)
+                if chunk.strip()
+            ]
+            label = "record"
+        else:
+            records = [
+                (i, line) for i, line in enumerate(text.splitlines(), 1)
+                if line.strip()
+            ]
+            label = "line"
+
+        features = []
+        for no, chunk in records:
+            try:
+                obj = json.loads(chunk)
+            except ValueError as e:
+                raise ImportSourceError(
+                    f"{path}:{no}: not a GeoJSON Feature {label}: {e}"
+                )
+            if obj.get("type") == "FeatureCollection":
+                features.extend(obj.get("features", []))
+            elif obj.get("type") == "Feature":
+                features.append(obj)
+            else:
+                raise ImportSourceError(
+                    f"{path}:{no}: expected a Feature, got {obj.get('type')!r}"
+                )
+        return features
+
+
 class CSVImportSource(ImportSource):
     """CSV with a header row; all columns text unless values parse as
-    int/float across the whole file. First column named id/fid (int) is pk."""
+    int/float (or WKT geometry) across the whole file. First column named
+    id/fid (int) is pk; a column of WKT values becomes the geometry column
+    (the OGR CSV driver's convention), assumed EPSG:4326."""
+
+    def crs_definitions(self):
+        from kart_tpu.crs import make_crs
+
+        if any(c.data_type == "geometry" for c in self._schema.columns):
+            try:
+                return {"EPSG:4326": make_crs("EPSG:4326").wkt}
+            except Exception:
+                return {}
+        return {}
 
     def __init__(self, path, dest_path=None):
         if not os.path.exists(path):
@@ -414,20 +524,42 @@ class CSVImportSource(ImportSource):
             self.rows = list(reader)
         self._schema = self._sniff_schema()
 
-    @staticmethod
-    def _sniff_type(values):
+    _WKT_PREFIXES = (
+        "POINT", "LINESTRING", "POLYGON", "MULTIPOINT", "MULTILINESTRING",
+        "MULTIPOLYGON", "GEOMETRYCOLLECTION",
+    )
+
+    @classmethod
+    def _sniff_type(cls, values):
         saw_float = False
+        saw_number = False
+        saw_wkt = False
+        wkt_checked = 0
         for v in values:
             if v == "":
                 continue
+            if v.lstrip().upper().startswith(cls._WKT_PREFIXES):
+                if wkt_checked < 100:  # validity sample; features() parses for real
+                    try:
+                        Geometry.from_wkt(v)
+                    except Exception:
+                        return "text"
+                    wkt_checked += 1
+                saw_wkt = True
+                continue
             try:
                 int(v)
+                saw_number = True
             except ValueError:
                 try:
                     float(v)
-                    saw_float = True
+                    saw_number = saw_float = True
                 except ValueError:
                     return "text"
+        if saw_wkt:
+            # any non-WKT value (numeric rows included, wherever they appear)
+            # demotes the column to text — geometry must be all-or-nothing
+            return "text" if saw_number else "geometry"
         return "float" if saw_float else "integer"
 
     def _sniff_schema(self):
@@ -446,13 +578,19 @@ class CSVImportSource(ImportSource):
         self._pk_name = pk_name
         for name in self.header:
             t = types[name]
+            if t == "geometry":
+                extra = {"geometryType": "GEOMETRY", "geometryCRS": "EPSG:4326"}
+            elif t in ("integer", "float"):
+                extra = {"size": 64}
+            else:
+                extra = {}
             cols.append(
                 ColumnSchema(
                     ColumnSchema.deterministic_id(self.path, name),
                     name,
                     t,
                     0 if name == pk_name else None,
-                    {"size": 64} if t in ("integer", "float") else {},
+                    extra,
                 )
             )
         cols.sort(key=lambda c: 0 if c.pk_index is not None else 1)
@@ -480,6 +618,8 @@ class CSVImportSource(ImportSource):
                     out[name] = int(raw)
                 elif col.data_type == "float":
                     out[name] = float(raw)
+                elif col.data_type == "geometry":
+                    out[name] = Geometry.from_wkt(raw)
                 else:
                     out[name] = raw
             yield out

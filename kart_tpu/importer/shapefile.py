@@ -341,10 +341,14 @@ class ShapefileImportSource(ImportSource):
     GEOM_COLUMN = "geom"
     FID_COLUMN = "FID"
 
-    def __init__(self, path, dest_path=None):
+    def __init__(self, path, dest_path=None, schema_id_seed=None):
         if not os.path.exists(path):
             raise ImportSourceError(f"No such file: {path}")
         self.path = path
+        # the seed for stable column ids: callers extracting to a temp dir
+        # (zip import) pass the original spec so re-opens of the same source
+        # produce the same schema ids
+        self.schema_id_seed = schema_id_seed or path
         base, _ = os.path.splitext(path)
         self.dest_path = dest_path or os.path.basename(base)
         self.shp = ShpReader(path)
@@ -377,7 +381,7 @@ class ShapefileImportSource(ImportSource):
     def _build_schema(self):
         cols = [
             ColumnSchema(
-                ColumnSchema.deterministic_id(self.path, self.FID_COLUMN),
+                ColumnSchema.deterministic_id(self.schema_id_seed, self.FID_COLUMN),
                 self.FID_COLUMN,
                 "integer",
                 0,
@@ -390,7 +394,7 @@ class ShapefileImportSource(ImportSource):
             geom_extra["geometryCRS"] = ident
         cols.append(
             ColumnSchema(
-                ColumnSchema.deterministic_id(self.path, self.GEOM_COLUMN),
+                ColumnSchema.deterministic_id(self.schema_id_seed, self.GEOM_COLUMN),
                 self.GEOM_COLUMN,
                 "geometry",
                 None,
@@ -402,7 +406,7 @@ class ShapefileImportSource(ImportSource):
         ):
             cols.append(
                 ColumnSchema(
-                    ColumnSchema.deterministic_id(self.path, name),
+                    ColumnSchema.deterministic_id(self.schema_id_seed, name),
                     name,
                     data_type,
                     None,

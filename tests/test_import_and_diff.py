@@ -358,3 +358,173 @@ def test_replace_ids_derives_sidecar(tmp_path, points_repo):
     assert np.array_equal(
         derived.oids[: derived.count], rebuilt.oids[: rebuilt.count]
     )
+
+
+class TestFormatBreadth:
+    def test_geojsonl_import(self, tmp_path):
+        """Newline-delimited GeoJSON (GeoJSONSeq), incl. RFC 8142 RS
+        prefixes."""
+        import json as json_mod
+
+        from kart_tpu.core.repo import KartRepo
+        from kart_tpu.importer import ImportSource
+        from kart_tpu.importer.importer import import_sources
+
+        lines = []
+        for i in range(1, 6):
+            lines.append(
+                json_mod.dumps(
+                    {
+                        "type": "Feature",
+                        "properties": {"fid": i, "name": f"n{i}"},
+                        "geometry": {"type": "Point", "coordinates": [i, -i]},
+                    }
+                )
+            )
+        path = tmp_path / "feats.geojsonl"
+        path.write_text("\x1e" + "\n\x1e".join(lines) + "\n")
+
+        repo = KartRepo.init_repository(tmp_path / "repo")
+        repo.config.set_many({"user.name": "T", "user.email": "t@e"})
+        (src,) = ImportSource.open(str(path))
+        import_sources(repo, [src])
+        ds = repo.datasets()["feats"]
+        assert ds.feature_count == 5
+        f = ds.get_feature([3])
+        assert f["name"] == "n3"
+        assert f["geom"].to_wkt() == "POINT (3 -3)"
+
+    def test_geojsonl_bad_line_reports_line_number(self, tmp_path):
+        from kart_tpu.importer import ImportSource, ImportSourceError
+
+        path = tmp_path / "bad.ndjson"
+        path.write_text('{"type": "Feature", "properties": {}}\nnot json\n')
+        with pytest.raises(ImportSourceError, match="bad.ndjson:2"):
+            ImportSource.open(str(path))
+
+    def test_csv_with_wkt_geometry(self, tmp_path):
+        """A WKT column becomes the geometry column (OGR CSV convention)."""
+        from kart_tpu.core.repo import KartRepo
+        from kart_tpu.importer import ImportSource
+        from kart_tpu.importer.importer import import_sources
+
+        path = tmp_path / "places.csv"
+        path.write_text(
+            "id,name,wkt\n"
+            '1,alpha,POINT (10 20)\n'
+            '2,beta,"POLYGON ((0 0, 1 0, 1 1, 0 1, 0 0))"\n'
+            "3,empty,\n"
+        )
+        repo = KartRepo.init_repository(tmp_path / "repo")
+        repo.config.set_many({"user.name": "T", "user.email": "t@e"})
+        (src,) = ImportSource.open(str(path))
+        assert [c.data_type for c in src.schema.columns] == [
+            "integer", "text", "geometry",
+        ]
+        import_sources(repo, [src])
+        ds = repo.datasets()["places"]
+        assert ds.get_feature([1])["wkt"].to_wkt() == "POINT (10 20)"
+        assert ds.get_feature([2])["wkt"].to_wkt().startswith("POLYGON")
+        assert ds.get_feature([3])["wkt"] is None
+
+    def test_csv_mixed_wkt_and_text_stays_text(self, tmp_path):
+        from kart_tpu.importer import ImportSource
+
+        path = tmp_path / "m.csv"
+        path.write_text("id,v\n1,POINT (1 2)\n2,hello\n")
+        (src,) = ImportSource.open(str(path))
+        assert [c.data_type for c in src.schema.columns] == ["integer", "text"]
+
+    def test_zipped_shapefile(self, tmp_path):
+        import zipfile
+
+        from test_shapefile import write_dbf, write_point_shp
+
+        from kart_tpu.core.repo import KartRepo
+        from kart_tpu.importer import ImportSource
+        from kart_tpu.importer.importer import import_sources
+
+        shp_dir = tmp_path / "raw"
+        shp_dir.mkdir()
+        write_point_shp(shp_dir / "towns.shp", [(1.0, 2.0), (3.0, 4.0)])
+        write_dbf(
+            shp_dir / "towns.dbf",
+            [("NAME", "C", 10, 0)],
+            [{"NAME": "aa"}, {"NAME": "bb"}],
+        )
+        zip_path = tmp_path / "towns-pack.zip"
+        with zipfile.ZipFile(zip_path, "w") as zf:
+            for fn in ("towns.shp", "towns.dbf"):
+                zf.write(shp_dir / fn, f"data/{fn}")
+
+        repo = KartRepo.init_repository(tmp_path / "repo")
+        repo.config.set_many({"user.name": "T", "user.email": "t@e"})
+        (src,) = ImportSource.open(str(zip_path))
+        assert src.dest_path == "towns-pack"
+        import_sources(repo, [src])
+        ds = repo.datasets()["towns-pack"]
+        assert ds.feature_count == 2
+
+
+def test_csv_mixed_numeric_then_wkt_stays_text(tmp_path):
+    from kart_tpu.importer import ImportSource
+
+    path = tmp_path / "mix.csv"
+    path.write_text("id,v\n1,7\n2,POINT (1 2)\n")
+    (src,) = ImportSource.open(str(path))
+    assert [c.data_type for c in src.schema.columns] == ["integer", "text"]
+    assert list(src.features())[0]["v"] == "7"
+
+
+def test_geojsonl_pretty_printed_rs_records(tmp_path):
+    """RFC 8142 records may span lines when RS-delimited."""
+    import json as json_mod
+
+    from kart_tpu.importer import ImportSource
+
+    recs = []
+    for i in (1, 2):
+        recs.append(
+            json_mod.dumps(
+                {
+                    "type": "Feature",
+                    "properties": {"fid": i},
+                    "geometry": {"type": "Point", "coordinates": [i, i]},
+                },
+                indent=2,
+            )
+        )
+    path = tmp_path / "pretty.geojsons"
+    path.write_text("".join("\x1e" + r + "\n" for r in recs))
+    (src,) = ImportSource.open(str(path))
+    assert src.feature_count == 2
+
+
+def test_zip_shapefile_schema_ids_stable(tmp_path):
+    import zipfile
+
+    from test_shapefile import write_dbf, write_point_shp
+
+    from kart_tpu.importer import ImportSource
+
+    shp_dir = tmp_path / "raw"
+    shp_dir.mkdir()
+    write_point_shp(shp_dir / "t.shp", [(1.0, 2.0)])
+    write_dbf(shp_dir / "t.dbf", [("NAME", "C", 5, 0)], [{"NAME": "x"}])
+    zip_path = tmp_path / "t.zip"
+    with zipfile.ZipFile(zip_path, "w") as zf:
+        zf.write(shp_dir / "t.shp", "t.shp")
+        zf.write(shp_dir / "t.dbf", "t.dbf")
+    (a,) = ImportSource.open(str(zip_path))
+    (b,) = ImportSource.open(str(zip_path))
+    assert [c.id for c in a.schema.columns] == [c.id for c in b.schema.columns]
+
+
+def test_csv_wkt_registers_crs_definition(tmp_path):
+    from kart_tpu.importer import ImportSource
+
+    path = tmp_path / "g.csv"
+    path.write_text("id,wkt\n1,POINT (1 2)\n")
+    (src,) = ImportSource.open(str(path))
+    defs = src.crs_definitions()
+    assert "EPSG:4326" in defs and "WGS" in defs["EPSG:4326"]
