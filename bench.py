@@ -77,36 +77,56 @@ def main():
     import subprocess
     import sys
 
-    timeout_s = int(os.environ.get("KART_BENCH_TIMEOUT", 1500))
+    timeout_s = int(os.environ.get("KART_BENCH_TIMEOUT", 2400))
     cmd = [sys.executable, os.path.abspath(__file__), "--worker"]
-    try:
-        proc = subprocess.run(
-            cmd, timeout=timeout_s, capture_output=True, text=True
-        )
-        if proc.returncode == 0 and proc.stdout.strip():
-            print(proc.stdout.strip().splitlines()[-1])
-            return
-        if proc.stderr:
+
+    def last_json_line(stdout):
+        """Last line of (possibly truncated) worker output that parses as
+        JSON — a worker killed mid-print leaves a fragment after the last
+        complete record."""
+        if not stdout:
+            return None
+        if isinstance(stdout, bytes):
+            stdout = stdout.decode(errors="replace")
+        for line in reversed(stdout.strip().splitlines()):
+            if not line.startswith("{"):
+                continue
+            try:
+                json.loads(line)
+            except ValueError:
+                continue
+            return line
+        return None
+
+    def run_worker(env=None):
+        """-> the worker's last complete JSON record, salvaged from partial
+        output on timeout or crash (the worker prints a full record before
+        the long 100M tail; the probe-failure exit prints no JSON, so any
+        parseable record is a real measurement)."""
+        try:
+            proc = subprocess.run(
+                cmd, timeout=timeout_s, capture_output=True, text=True, env=env
+            )
+        except subprocess.TimeoutExpired as e:
+            return last_json_line(e.stdout)
+        line = last_json_line(proc.stdout)
+        if line is None and proc.stderr:
             print(proc.stderr.strip()[-2000:], file=sys.stderr)
-    except subprocess.TimeoutExpired:
-        pass
+        return line
+
+    line = run_worker()
+    if line:
+        print(line)
+        return
     # accelerator path failed: measure on the CPU XLA backend instead
     env = dict(os.environ)
     env["JAX_PLATFORMS"] = "cpu"
     env["KART_INSULATE_CPU"] = "1"  # worker deregisters non-CPU factories
     env.pop("PALLAS_AXON_POOL_IPS", None)  # stops PJRT plugin registration
-    try:
-        proc = subprocess.run(
-            cmd, timeout=timeout_s, capture_output=True, text=True, env=env
-        )
-        lines = proc.stdout.strip().splitlines()
-        if proc.returncode == 0 and lines:
-            print(lines[-1])
-            return
-        if proc.stderr:
-            print(proc.stderr.strip()[-2000:], file=sys.stderr)
-    except subprocess.TimeoutExpired:
-        pass
+    line = run_worker(env)
+    if line:
+        print(line)
+        return
     # even the fallback failed: the contract is still one JSON line
     print(
         json.dumps(
@@ -182,33 +202,36 @@ def worker():
     cli = _cli_diff_bench()
     merge = _merge_bench()
     bbox = _bbox_bench()
-    big = _cli_diff_100m()
 
-    print(
-        json.dumps(
-            {
-                "metric": "features_diffed_per_sec_10M_attr_diff",
-                "value": round(dev_rate),
-                "unit": "features/s",
-                # BASELINE.json's CPU baseline is the *reference's* measured
-                # per-feature hot loop (SURVEY §6: "must be measured, not
-                # copied"); the numpy vectorized twin is our own far
-                # stricter implementation, reported alongside
-                "vs_baseline": round(dev_rate / ref_rate, 1),
-                "vs_numpy_twin": round(dev_rate / cpu_rate, 2),
-                "backend": info["backend"],
-                "device_kind": info["device_kind"],
-                "n_devices": info["n_devices"],
-                "backend_init_seconds": info["init_seconds"],
-                "numpy_twin_rate": round(cpu_rate),
-                "reference_loop_rate": round(ref_rate),
-                **cli,
-                **merge,
-                **bbox,
-                **big,
-            }
-        )
-    )
+    record = {
+        "metric": "features_diffed_per_sec_10M_attr_diff",
+        "value": round(dev_rate),
+        "unit": "features/s",
+        # BASELINE.json's CPU baseline is the *reference's* measured
+        # per-feature hot loop (SURVEY §6: "must be measured, not
+        # copied"); the numpy vectorized twin is our own far
+        # stricter implementation, reported alongside
+        "vs_baseline": round(dev_rate / ref_rate, 1),
+        "vs_numpy_twin": round(dev_rate / cpu_rate, 2),
+        "backend": info["backend"],
+        "device_kind": info["device_kind"],
+        "n_devices": info["n_devices"],
+        "backend_init_seconds": info["init_seconds"],
+        "numpy_twin_rate": round(cpu_rate),
+        "reference_loop_rate": round(ref_rate),
+        **cli,
+        **merge,
+        **bbox,
+    }
+    # the 100M section is the long tail (synth + multi-minute diffs): print
+    # the record BEFORE it so a watchdog timeout mid-100M still salvages
+    # every other number (main() keeps the last complete line), then print
+    # the augmented record when it completes
+    print(json.dumps(record), flush=True)
+    big = _cli_diff_100m()
+    if big:
+        record.update(big)
+        print(json.dumps(record), flush=True)
 
 
 def _reference_loop_rate(b_old, b_new, slice_n):
