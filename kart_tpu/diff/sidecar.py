@@ -288,6 +288,18 @@ class SidecarCapture:
         self._oid_chunks.append(bytes.fromhex("".join(oid_hexes)))
         self.count += len(rel_paths)
 
+    def int_columns(self):
+        """(pks int64 (n,), oids (n, 20) uint8) for an int-pk capture, or
+        None — the importer's vectorized tree build reads the columns
+        straight from here instead of accumulating a second copy."""
+        if not self._pk_chunks or self._path_chunks:
+            return None
+        pks = np.concatenate(self._pk_chunks)
+        oids_u8 = np.frombuffer(b"".join(self._oid_chunks), dtype=np.uint8).reshape(
+            -1, 20
+        )
+        return pks, oids_u8
+
     def save(self, repo, feature_tree_oid):
         if not self.count:
             return None

@@ -246,6 +246,8 @@ def _import_single_source(
     repo, tb, source, ds_path, *, log=None, capture=None, replace_ids=None,
     existing_ds=None,
 ):
+    from kart_tpu.diff.sidecar import SidecarCapture
+
     schema = source.schema
     encoder = encoder_for_schema(schema)
     meta = source.meta_items()
@@ -284,6 +286,13 @@ def _import_single_source(
 
     count = 0
     use_batch_paths = encoder.scheme == "int"
+    # int-pk fast path: (pks, oid bytes) -> vectorized tree build. When a
+    # SidecarCapture is running it already holds these columns; only
+    # accumulate separately without one (a 100M import must not hold two
+    # 2.8GB copies)
+    collect_local = use_batch_paths and not isinstance(capture, SidecarCapture)
+    pk_chunks = []
+    oid_chunks = []
     # the streaming loop allocates short-lived, acyclic objects by the
     # million: pause the cyclic collector (~8% measured). Source adapters
     # may create cycles internally, so bound their growth with a manual
@@ -295,20 +304,24 @@ def _import_single_source(
             if gc_batch % 100 == 0:
                 gc.collect()
             encoded = [schema.encode_feature_blob(f) for f in batch]
+            oids = repo.odb.write_blobs([blob for _, blob in encoded])
             if use_batch_paths:
                 pks = np.fromiter(
                     (pk_values[0] for pk_values, _ in encoded),
                     dtype=np.int64,
                     count=len(encoded),
                 )
-                rel_paths = encoder.encode_paths_batch(pks)
+                # no per-path TreeBuilder inserts: the whole feature tree is
+                # built in one vectorized pass after the stream
+                if collect_local:
+                    pk_chunks.append(pks)
+                    oid_chunks.append(bytes.fromhex("".join(oids)))
             else:
                 rel_paths = [
                     encoder.encode_pks_to_path(pk_values)
                     for pk_values, _ in encoded
                 ]
-            oids = repo.odb.write_blobs([blob for _, blob in encoded])
-            tb.insert_many((prefix + rel for rel in rel_paths), oids)
+                tb.insert_many((prefix + rel for rel in rel_paths), oids)
             if capture is not None:
                 if use_batch_paths:
                     capture.add_int_batch(pks, oids)
@@ -317,6 +330,36 @@ def _import_single_source(
             count += len(batch)
             if log and count % 100000 == 0:
                 log(f"  {ds_path}: {count} features...")
+
+    if use_batch_paths and count:
+        from kart_tpu.core.feature_tree import build_int_feature_tree
+        from kart_tpu.core.objects import MODE_TREE
+
+        cols = capture.int_columns() if isinstance(capture, SidecarCapture) else None
+        if cols is not None:
+            pks_arr, oids_u8 = cols
+        else:
+            pks_arr = np.concatenate(pk_chunks)
+            oids_u8 = np.frombuffer(b"".join(oid_chunks), dtype=np.uint8).reshape(
+                -1, 20
+            )
+        # duplicate pks in the source: last occurrence wins (git fast-import
+        # semantics, matching the TreeBuilder dict path). One stable sort
+        # both detects and resolves them.
+        if len(pks_arr) > 1:
+            order = np.argsort(pks_arr, kind="stable")
+            sorted_pks = pks_arr[order]
+            is_last = np.append(sorted_pks[1:] != sorted_pks[:-1], True)
+            if not is_last.all():
+                keep = np.sort(order[is_last])
+                pks_arr = pks_arr[keep]
+                oids_u8 = oids_u8[keep]
+        ftree = build_int_feature_tree(repo.odb, pks_arr, oids_u8, encoder)
+        tb.insert(
+            f"{ds_path}/{Dataset3.DATASET_DIRNAME}/feature",
+            ftree,
+            mode=MODE_TREE,
+        )
 
     # meta items that only exist after the feature stream has run (e.g.
     # generated-pks.json from PK synthesis)

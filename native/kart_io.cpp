@@ -144,18 +144,29 @@ int64_t io_pack_ptrs(const uint8_t* const* ptrs, const int64_t* lens,
     // one z_stream reused with deflateReset: deflateInit allocates ~256KB of
     // window/hash state, and paying that per 30-byte feature blob dominated
     // the batch (bytes produced are identical to per-object compress2 —
-    // same level, default windowBits/memLevel)
+    // same level, default windowBits/memLevel). A second stream with a tiny
+    // window (2^9) and memLevel 1 serves payloads under 256B: deflateReset
+    // clears the window+hash state, and resetting ~2KB instead of ~300KB
+    // more than halves the per-blob cost of feature-blob batches (the
+    // zlib header self-describes the window, so readers are unaffected).
     z_stream zs;
     std::memset(&zs, 0, sizeof(zs));
     if (deflateInit(&zs, level) != Z_OK) return -3;
+    z_stream zs_small;
+    std::memset(&zs_small, 0, sizeof(zs_small));
+    bool small_ready =
+        deflateInit2(&zs_small, level, Z_DEFLATED, 9, 1,
+                     Z_DEFAULT_STRATEGY) == Z_OK;
+    int64_t result = -5;
     for (int64_t i = 0; i < n; i++) {
         int hdr = std::snprintf(header, sizeof(header), "%s %lld",
                                 type_name, (long long)lens[i]);
         if (hdr < 0 || size_t(hdr) >= sizeof(header) - 1) {
-            deflateEnd(&zs);
-            return -4;
+            result = -4;
+            goto done;
         }
         header[hdr] = '\0';  // the NUL is part of the hashed header
+        {
         Sha1Ctx ctx;
         sha1_init(&ctx);
         sha1_update(&ctx, reinterpret_cast<const uint8_t*>(header),
@@ -165,47 +176,52 @@ int64_t io_pack_ptrs(const uint8_t* const* ptrs, const int64_t* lens,
 
         // stream in bounded chunks: avail_in/avail_out are 32-bit, payloads
         // and the output buffer can exceed 4 GiB
+        z_stream& z = (small_ready && lens[i] < 256) ? zs_small : zs;
         const uint8_t* src = ptrs[i];
         int64_t remaining = lens[i];
         const int64_t kChunk = int64_t(0x40000000);  // 1 GiB
         int rc = Z_OK;
         Bytef* rec_start = out + pos;
-        zs.next_in = const_cast<Bytef*>(src);
-        zs.avail_in = 0;
-        zs.next_out = rec_start;
+        z.next_in = const_cast<Bytef*>(src);
+        z.avail_in = 0;
+        z.next_out = rec_start;
         do {
-            if (zs.avail_in == 0 && remaining > 0) {
+            if (z.avail_in == 0 && remaining > 0) {
                 int64_t take = remaining > kChunk ? kChunk : remaining;
-                zs.next_in = const_cast<Bytef*>(src);
-                zs.avail_in = uInt(take);
+                z.next_in = const_cast<Bytef*>(src);
+                z.avail_in = uInt(take);
                 src += take;
                 remaining -= take;
             }
-            int64_t room = out_cap - pos - int64_t(zs.next_out - rec_start);
+            int64_t room = out_cap - pos - int64_t(z.next_out - rec_start);
             if (room <= 0) {
-                deflateEnd(&zs);
-                return -1;
+                result = -1;
+                goto done;
             }
-            zs.avail_out = uInt(room > kChunk ? kChunk : room);
-            uInt out_before = zs.avail_out;
-            rc = deflate(&zs, remaining ? Z_NO_FLUSH : Z_FINISH);
+            z.avail_out = uInt(room > kChunk ? kChunk : room);
+            uInt out_before = z.avail_out;
+            rc = deflate(&z, remaining ? Z_NO_FLUSH : Z_FINISH);
             if (rc != Z_OK && rc != Z_STREAM_END && rc != Z_BUF_ERROR) {
-                deflateEnd(&zs);
-                return -3;
+                result = -3;
+                goto done;
             }
-            if (rc == Z_BUF_ERROR && zs.avail_in == 0 && remaining == 0 &&
-                zs.avail_out == out_before) {
+            if (rc == Z_BUF_ERROR && z.avail_in == 0 && remaining == 0 &&
+                z.avail_out == out_before) {
                 // no forward progress possible: corrupt state, don't spin
-                deflateEnd(&zs);
-                return -3;
+                result = -3;
+                goto done;
             }
         } while (rc != Z_STREAM_END);
-        pos += int64_t(zs.next_out - rec_start);
+        pos += int64_t(z.next_out - rec_start);
         out_offsets[i + 1] = pos;
-        deflateReset(&zs);
+        deflateReset(&z);
+        }
     }
+    result = pos;
+done:
     deflateEnd(&zs);
-    return pos;
+    if (small_ready) deflateEnd(&zs_small);
+    return result;
 }
 
 // Merge-join diff classification over two key-sorted (int64 key, 20-byte
