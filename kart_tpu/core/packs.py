@@ -413,7 +413,11 @@ class PackWriter:
     def add_batch(self, obj_type, contents):
         """-> list of hex oids. One native C++ call hashes and deflates the
         whole batch (the import/commit data-path hot loop); per-object
-        Python when the native IO core isn't built — identical output."""
+        Python when the native IO core isn't built. Object ids are identical
+        either way; the *compressed bytes* may differ (the native path uses
+        a small deflate window for tiny payloads), so pack files are
+        self-consistent but not byte-reproducible across environments —
+        the same property git has across zlib versions."""
         from kart_tpu import native
 
         result = native.pack_objects_batch(obj_type, contents, self.level)

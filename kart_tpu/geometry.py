@@ -276,7 +276,19 @@ class Geometry(bytes):
             return env[:4] if only_xy else env
         if self.is_empty:
             return None
-        env = wkb_envelope(memoryview(self)[self.wkb_offset :])
+        off = self.wkb_offset
+        # 2D-point fast path: canonical point storage has no envelope header
+        # (GPKG recommends none for points), and a bulk checkout's rtree
+        # triggers would otherwise run the general recursive parser per row
+        if len(self) >= off + 21:
+            lt = "<" if self[off] == 1 else ">"
+            (wkb_type,) = struct.unpack_from(lt + "I", self, off + 1)
+            if wkb_type == 1 and only_xy:
+                x, y = struct.unpack_from(lt + "2d", self, off + 5)
+                if x != x and y != y:  # all-NaN coords: empty point (matches
+                    return None  # the general parser's emptiness rule)
+                return (x, x, y, y)
+        env = wkb_envelope(memoryview(self)[off:])
         if env is None:
             return None
         return env[:4] if only_xy else env

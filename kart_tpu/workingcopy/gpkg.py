@@ -75,27 +75,42 @@ class Mismatch(InvalidOperation):
         self.expected_tree = expected_tree
 
 
-def _geom_envelope(value):
-    """GPKG blob -> (minx, maxx, miny, maxy) or None for NULL/empty/garbage."""
+def _geom_envelope(value, _memo=None):
+    """GPKG blob -> (minx, maxx, miny, maxy) or None for NULL/empty/garbage.
+
+    _memo: optional per-connection one-slot [blob, envelope] cache — the
+    rtree triggers call ST_MinX/MaxX/MinY/MaxY (+IsEmpty) on the SAME blob
+    for each row, and a bulk checkout fires them a million times. The memo
+    is scoped to one sqlite connection (created in
+    _register_gpkg_functions), so concurrent connections can't cross-read
+    each other's slot."""
     if value is None:
         return None
+    b = bytes(value)
+    if _memo is not None and _memo[0] == b:
+        return _memo[1]
     try:
-        return Geometry.of(bytes(value)).envelope()
+        env = Geometry.of(b).envelope()
     except Exception:
-        return None
+        env = None
+    if _memo is not None:
+        _memo[0] = b
+        _memo[1] = env
+    return env
 
 
 def _register_gpkg_functions(con):
     """The GPKG rtree-extension triggers call ST_IsEmpty/ST_MinX/... —
     provided by spatialite/GDAL in other clients; here backed by our own
     envelope parser so the triggers fire correctly on our connections."""
+    memo = [None, None]  # per-connection: sqlite is serial per connection
 
     def st_is_empty(value):
-        return 1 if _geom_envelope(value) is None else 0
+        return 1 if _geom_envelope(value, memo) is None else 0
 
     def bound(i):
         def f(value):
-            env = _geom_envelope(value)
+            env = _geom_envelope(value, memo)
             return env[i] if env is not None else None
 
         return f
