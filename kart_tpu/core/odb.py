@@ -189,6 +189,26 @@ class ObjectDb:
             raise ObjectFormatError(f"Corrupt object {oid}: size mismatch")
         return obj_type, content
 
+    def read_blobs_batch(self, oids):
+        """[hex oid] -> {oid: content} for blobs resolvable through the
+        native batch pack inflate (one reused z_stream over offset-sorted
+        records). Anything absent from the result — loose objects, delta
+        records, promised/missing, native unavailable — is the caller's job
+        via the per-object :meth:`read_blob` (which raises the right
+        tri-state error)."""
+        shas = {}
+        for o in oids:
+            try:
+                shas[bytes.fromhex(o)] = o
+            except ValueError:
+                continue
+        got = self.packs.read_batch(list(shas))
+        return {
+            shas[s]: content
+            for s, (obj_type, content) in got.items()
+            if obj_type == "blob"
+        }
+
     def write_raw(self, obj_type, content) -> str:
         if self._bulk_writer is not None:
             # duplicate objects across packs are legal (git semantics);

@@ -282,6 +282,38 @@ class Packfile:
         type_code, content = self._record_at(off)
         return TYPE_NAMES[type_code], content
 
+    def read_batch(self, shas):
+        """[20-byte sha] -> {sha: (type_str, content)} via one native batch
+        inflate, offset-sorted for sequential access. Shas this pack doesn't
+        hold, delta records, and native-unavailable all simply stay absent —
+        the caller's per-object path covers them."""
+        from kart_tpu import native
+
+        import numpy as np
+
+        found = [
+            (off, sha)
+            for sha in shas
+            if (off := self.index.offset_of(sha)) is not None
+        ]
+        if not found:
+            return {}
+        found.sort()
+        offsets = np.fromiter((o for o, _ in found), dtype=np.int64, count=len(found))
+        res = native.inflate_pack_batch(self._mm, offsets)
+        if res is None:
+            return {}
+        types, payload, po = res
+        out = {}
+        for i, (_, sha) in enumerate(found):
+            t = int(types[i])
+            if t in TYPE_NAMES:
+                out[sha] = (
+                    TYPE_NAMES[t],
+                    payload[po[i] : po[i + 1]].tobytes(),
+                )
+        return out
+
     def __contains__(self, sha):
         return sha in self.index
 
@@ -332,6 +364,21 @@ class PackCollection:
             if got is not None:
                 return got
         return None
+
+    def read_batch(self, shas):
+        """[20-byte sha] -> {sha: (type_str, content)} across all packs via
+        the native batch inflate; absent/delta shas are simply missing from
+        the result."""
+        out = {}
+        remaining = list(shas)
+        for pack in self.packs:
+            if not remaining:
+                break
+            got = pack.read_batch(remaining)
+            if got:
+                out.update(got)
+                remaining = [s for s in remaining if s not in got]
+        return out
 
     def __contains__(self, sha):
         return any(sha in p for p in self.packs)

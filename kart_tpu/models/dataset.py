@@ -307,18 +307,29 @@ class Dataset3:
             return
         odb = feature_tree.odb
         n_promised = 0
-        for path, entry in feature_tree.walk_blobs():
-            pk_values = self.decode_path_to_pks(path)
-            try:
-                feature = self.get_feature(pk_values, data=odb.read_blob(entry.oid))
-            except ObjectPromised:
-                if skip_promised:
-                    n_promised += 1
+        from kart_tpu.utils import chunked
+
+        for chunk in chunked(feature_tree.walk_blobs(), 10000):
+            # bulk read: one native batch inflate per chunk; the per-object
+            # path covers whatever the batch can't (loose/delta/promised)
+            batch = odb.read_blobs_batch([entry.oid for _, entry in chunk])
+            for path, entry in chunk:
+                pk_values = self.decode_path_to_pks(path)
+                data = batch.get(entry.oid)
+                try:
+                    if data is None:
+                        data = odb.read_blob(entry.oid)
+                    feature = self.get_feature(pk_values, data=data)
+                except ObjectPromised:
+                    if skip_promised:
+                        n_promised += 1
+                        continue
+                    raise
+                if spatial_filter is not None and not spatial_filter.matches(
+                    feature
+                ):
                     continue
-                raise
-            if spatial_filter is not None and not spatial_filter.matches(feature):
-                continue
-            yield feature
+                yield feature
         if n_promised:
             L.debug(
                 "%s: skipped %d promised (out-of-filter) features",

@@ -139,7 +139,7 @@ def ensure_built():
 # -- object-store IO core (native/kart_io.cpp) ------------------------------
 
 _IO_LIB_NAME = "libkart_io.so"
-_IO_ABI_VERSION = 2  # v2: io_classify_sorted
+_IO_ABI_VERSION = 3  # v3: io_inflate_batch
 
 _io_lib = None
 _io_load_attempted = False
@@ -186,6 +186,12 @@ def load_io():
             ctypes.c_void_p, ctypes.c_void_p, ctypes.c_int64,
             ctypes.c_void_p, ctypes.c_void_p, ctypes.c_void_p,
         ]
+        lib.io_inflate_batch.restype = ctypes.c_int64
+        lib.io_inflate_batch.argtypes = [
+            ctypes.c_void_p, ctypes.c_int64, ctypes.c_void_p,
+            ctypes.c_int64, ctypes.c_void_p, ctypes.c_int64,
+            ctypes.c_void_p, ctypes.c_void_p,
+        ]
         _io_lib = lib
     except (OSError, AttributeError) as e:
         L.warning("could not load native IO lib %s: %s", path, e)
@@ -223,6 +229,42 @@ def classify_sorted(old_keys, old_oids_u8, new_keys, new_oids_u8):
             "deletes": int(counts[2]),
         },
     )
+
+
+def inflate_pack_batch(pack_buf, offsets):
+    """Bulk pack reads: mmap/bytes of a whole packfile + record offsets ->
+    (types uint8 (n,), payload uint8 array, payload_offsets int64 (n+1,)),
+    or None when the lib is unavailable / the pack is malformed. Non-delta
+    records inflate with one reused z_stream; delta records come back as
+    type 0 with an empty slot (the caller's per-object path resolves the
+    chain)."""
+    lib = load_io()
+    if lib is None:
+        return None
+    buf = np.frombuffer(pack_buf, dtype=np.uint8)
+    offsets = np.ascontiguousarray(offsets, dtype=np.int64)
+    n = len(offsets)
+    types = np.zeros(n, dtype=np.uint8)
+    total = lib.io_inflate_batch(
+        buf.ctypes.data, len(buf), offsets.ctypes.data, n,
+        None, 0, None, types.ctypes.data,
+    )
+    if total < 0:
+        return None
+    out_offsets = np.zeros(n + 1, dtype=np.int64)
+    if total == 0 and not types.any():
+        # every record is a delta (heavily-repacked git packs): nothing to
+        # inflate, skip the second native pass entirely
+        return types, np.empty(0, dtype=np.uint8), out_offsets
+    out = np.empty(int(total), dtype=np.uint8)
+    rc = lib.io_inflate_batch(
+        buf.ctypes.data, len(buf), offsets.ctypes.data, n,
+        out.ctypes.data, int(total), out_offsets.ctypes.data,
+        types.ctypes.data,
+    )
+    if rc < 0:
+        return None
+    return types, out, out_offsets
 
 
 def pack_objects_batch(obj_type, contents, level=1):

@@ -126,7 +126,7 @@ void sha1_final(Sha1Ctx* c, uint8_t out[20]) {
 
 extern "C" {
 
-int io_abi_version() { return 2; }  // v2: io_classify_sorted
+int io_abi_version() { return 3; }  // v3: io_inflate_batch
 
 // Zero-copy variant: payloads stay in the caller's buffers (an array of
 // pointers — CPython bytes objects expose theirs directly), and the git
@@ -284,6 +284,89 @@ int64_t io_classify_sorted(const int64_t* old_keys, const uint8_t* old_oids,
     counts[1] = updates;
     counts[2] = deletes;
     return 0;
+}
+
+// Batch inflate of non-delta pack records: the bulk READ twin of
+// io_pack_ptrs. Callers hand the mmapped pack plus record offsets (from the
+// .idx); each record's varint header is decoded and its payload inflated
+// with one reused z_stream. Delta records (types 6/7) are skipped with
+// type 0 — the Python side resolves those chains (rare in our own packs,
+// which are written non-delta).
+//
+// Two-phase: pass out=NULL to get the required total payload size (header
+// scan only), then call again with the buffer. types_out[i]: 1..4 commit/
+// tree/blob/tag, 0 = delta/unsupported (skipped, zero length).
+int64_t io_inflate_batch(const uint8_t* pack, int64_t pack_len,
+                         const int64_t* offsets, int64_t n, uint8_t* out,
+                         int64_t out_cap, int64_t* out_offsets,
+                         uint8_t* types_out) {
+    int64_t total = 0;
+    z_stream zs;
+    bool zs_ready = false;
+    if (out != nullptr) {
+        std::memset(&zs, 0, sizeof(zs));
+        if (inflateInit(&zs) != Z_OK) return -3;
+        zs_ready = true;
+        out_offsets[0] = 0;
+    }
+    for (int64_t i = 0; i < n; i++) {
+        int64_t pos = offsets[i];
+        if (pos < 0 || pos >= pack_len) {
+            if (zs_ready) inflateEnd(&zs);
+            return -2;
+        }
+        uint8_t byte = pack[pos++];
+        int type = (byte >> 4) & 7;
+        uint64_t size = byte & 0x0F;
+        int shift = 4;
+        while (byte & 0x80) {
+            if (pos >= pack_len || shift > 60) {
+                if (zs_ready) inflateEnd(&zs);
+                return -2;
+            }
+            byte = pack[pos++];
+            size |= uint64_t(byte & 0x7F) << shift;
+            shift += 7;
+        }
+        bool plain = type >= 1 && type <= 4 &&
+                     size <= uint64_t(0x7FFFFFFF);  // huge: Python fallback
+        if (out == nullptr) {
+            types_out[i] = plain ? uint8_t(type) : 0;
+            if (plain) total += int64_t(size);
+            continue;
+        }
+        types_out[i] = plain ? uint8_t(type) : 0;
+        if (!plain) {
+            out_offsets[i + 1] = total;
+            continue;
+        }
+        if (total + int64_t(size) > out_cap) {
+            inflateEnd(&zs);
+            return -1;
+        }
+        zs.next_in = const_cast<Bytef*>(pack + pos);
+        // the deflate stream ends within the pack; give inflate the rest
+        int64_t avail = pack_len - pos;
+        zs.avail_in = uInt(avail > int64_t(0x7FFFFFFF) ? 0x7FFFFFFF : avail);
+        zs.next_out = out + total;
+        zs.avail_out = uInt(size);
+        int rc = inflate(&zs, Z_FINISH);
+        // Z_FINISH with an exact-size buffer ends in Z_STREAM_END (or
+        // Z_BUF_ERROR when size 0 and stream already ended)
+        if (rc != Z_STREAM_END && !(rc == Z_BUF_ERROR && size == 0)) {
+            inflateEnd(&zs);
+            return -3;
+        }
+        if (zs.total_out != size) {
+            inflateEnd(&zs);
+            return -3;
+        }
+        total += int64_t(size);
+        out_offsets[i + 1] = total;
+        inflateReset(&zs);
+    }
+    if (zs_ready) inflateEnd(&zs);
+    return total;
 }
 
 }  // extern "C"
