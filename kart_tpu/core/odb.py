@@ -12,6 +12,7 @@ tolerate partial data catch :class:`ObjectPromised` and queue a fetch.
 """
 
 import os
+import threading
 import zlib
 from contextlib import contextmanager
 from enum import Enum
@@ -61,6 +62,7 @@ class ObjectDb:
         self._alternates = None
         self._packs = None
         self._bulk_writer = None
+        self._bulk_lock = threading.Lock()
         self._tree_cache = {}
         self._tree_cache_cap = 4096
 
@@ -85,18 +87,24 @@ class ObjectDb:
 
         level: zlib level for the pack records; 0 = stored (tree/oid-heavy
         payloads are ~incompressible, and deflate of incompressible bytes is
-        ~30MB/s — the synthetic benchmark repos write stored blocks)."""
-        w = self.pack_writer(level=level)
-        self._bulk_writer = w
-        try:
-            yield w
-        except BaseException:
+        ~30MB/s — the synthetic benchmark repos write stored blocks).
+
+        Thread-safe by serialisation: there is one _bulk_writer slot, so
+        concurrent bulk writers (e.g. two HTTP pushes on the threading
+        server) block on the lock instead of interleaving objects into each
+        other's packs."""
+        with self._bulk_lock:
+            w = self.pack_writer(level=level)
+            self._bulk_writer = w
+            try:
+                yield w
+            except BaseException:
+                self._bulk_writer = None
+                w.abort()
+                raise
             self._bulk_writer = None
-            w.abort()
-            raise
-        self._bulk_writer = None
-        if w.finish() is not None:
-            self.packs.refresh()
+            if w.finish() is not None:
+                self.packs.refresh()
 
     def pack_writer(self, level=1):
         """A PackWriter targeting this store's pack directory. The caller
@@ -160,7 +168,13 @@ class ObjectDb:
     def contains(self, oid):
         if self._find(oid) is not None:
             return True
-        return bytes.fromhex(oid) in self.packs
+        sha = bytes.fromhex(oid)
+        if sha in self.packs:
+            return True
+        # a pack written since our scan (another repo instance pushed into
+        # us, or a CLI command in this process): one dir-mtime stat decides
+        # whether to rescan, so hot miss loops don't re-list the directory
+        return self.packs.maybe_refresh() and sha in self.packs
 
     def status(self, oid) -> ObjectStatus:
         if self.contains(oid):
@@ -173,7 +187,10 @@ class ObjectDb:
         """-> (type_str, content bytes). Raises ObjectMissing/ObjectPromised."""
         path = self._find(oid)
         if path is None:
-            packed = self.packs.read(bytes.fromhex(oid))
+            sha = bytes.fromhex(oid)
+            packed = self.packs.read(sha)
+            if packed is None and self.packs.maybe_refresh():
+                packed = self.packs.read(sha)  # a pack landed since our scan
             if packed is not None:
                 return packed
             if self._promisor_check():

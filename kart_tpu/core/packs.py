@@ -282,11 +282,16 @@ class Packfile:
         type_code, content = self._record_at(off)
         return TYPE_NAMES[type_code], content
 
+    # per-native-call payload ceiling: bounds the transient inflate buffer
+    # when a batch hits unexpectedly large records
+    BATCH_BYTE_BUDGET = 256 * 1024 * 1024
+
     def read_batch(self, shas):
-        """[20-byte sha] -> {sha: (type_str, content)} via one native batch
-        inflate, offset-sorted for sequential access. Shas this pack doesn't
-        hold, delta records, and native-unavailable all simply stay absent —
-        the caller's per-object path covers them."""
+        """[20-byte sha] -> {sha: (type_str, content)} via native batch
+        inflates, offset-sorted for sequential access, each call bounded by
+        BATCH_BYTE_BUDGET. Shas this pack doesn't hold, delta records, and
+        native-unavailable all simply stay absent — the caller's per-object
+        path covers them."""
         from kart_tpu import native
 
         import numpy as np
@@ -299,19 +304,27 @@ class Packfile:
         if not found:
             return {}
         found.sort()
-        offsets = np.fromiter((o for o, _ in found), dtype=np.int64, count=len(found))
-        res = native.inflate_pack_batch(self._mm, offsets)
-        if res is None:
-            return {}
-        types, payload, po = res
         out = {}
-        for i, (_, sha) in enumerate(found):
-            t = int(types[i])
-            if t in TYPE_NAMES:
-                out[sha] = (
-                    TYPE_NAMES[t],
-                    payload[po[i] : po[i + 1]].tobytes(),
-                )
+        pos = 0
+        while pos < len(found):
+            chunk = found[pos:]
+            offsets = np.fromiter(
+                (o for o, _ in chunk), dtype=np.int64, count=len(chunk)
+            )
+            res = native.inflate_pack_batch(
+                self._mm, offsets, max_total=self.BATCH_BYTE_BUDGET
+            )
+            if res is None:
+                break
+            take, types, payload, po = res
+            for i in range(take):
+                t = int(types[i])
+                if t in TYPE_NAMES:
+                    out[chunk[i][1]] = (
+                        TYPE_NAMES[t],
+                        payload[po[i] : po[i + 1]].tobytes(),
+                    )
+            pos += take
         return out
 
     def __contains__(self, sha):
@@ -331,12 +344,21 @@ class PackCollection:
     def __init__(self, pack_dirs):
         self.pack_dirs = list(pack_dirs)
         self._packs = None
+        self._scan_mtimes = {}
 
     @property
     def packs(self):
         if self._packs is None:
+            import time
+
             self._packs = []
+            self._scan_mtimes = {}
+            self._scan_walltime_ns = time.time_ns()
             for d in self.pack_dirs:
+                try:
+                    self._scan_mtimes[d] = os.stat(d).st_mtime_ns
+                except OSError:
+                    self._scan_mtimes[d] = None
                 if not os.path.isdir(d):
                     continue
                 for name in sorted(os.listdir(d)):
@@ -348,8 +370,42 @@ class PackCollection:
                             )
         return self._packs
 
+    # directory mtimes within this many ns of the scan are treated as
+    # potentially stale (the racy-stat hole: a pack renamed in during the
+    # same mtime granule as the scan would otherwise stay invisible forever
+    # — git's racy-timestamp handling makes the same allowance)
+    _RACY_NS = 2_000_000_000
+
+    def maybe_refresh(self):
+        """Rescan iff a pack directory changed since the last scan (or the
+        scan is inside the racy-mtime window); -> True when a rescan
+        happened. Lookup misses call this so a pack written by ANOTHER repo
+        instance (a push into a local remote, a CLI run in the same process)
+        becomes visible, exactly like git re-scanning objects/pack on a
+        miss — at the cost of one stat per dir."""
+        if self._packs is None:
+            return False
+        scan_wall = getattr(self, "_scan_walltime_ns", 0)
+        for d in self.pack_dirs:
+            try:
+                mtime = os.stat(d).st_mtime_ns
+            except OSError:
+                mtime = None
+            if self._scan_mtimes.get(d) != mtime or (
+                mtime is not None and scan_wall - mtime < self._RACY_NS
+            ):
+                self.refresh()
+                return True
+        return False
+
     def refresh(self):
-        self.close()
+        """Forget the scanned pack list. Old Packfile objects are NOT closed
+        here: concurrent readers (the threading server's other handlers) may
+        hold references mid-read, and closing would invalidate their mmaps;
+        unreferenced ones release their mmaps on GC. Explicit close() remains
+        for shutdown."""
+        self._packs = None
+        self._scan_mtimes = {}
 
     def close(self):
         if self._packs:

@@ -231,13 +231,18 @@ def classify_sorted(old_keys, old_oids_u8, new_keys, new_oids_u8):
     )
 
 
-def inflate_pack_batch(pack_buf, offsets):
+def inflate_pack_batch(pack_buf, offsets, max_total=None):
     """Bulk pack reads: mmap/bytes of a whole packfile + record offsets ->
-    (types uint8 (n,), payload uint8 array, payload_offsets int64 (n+1,)),
-    or None when the lib is unavailable / the pack is malformed. Non-delta
-    records inflate with one reused z_stream; delta records come back as
-    type 0 with an empty slot (the caller's per-object path resolves the
-    chain)."""
+    (n_consumed, types uint8 (n_consumed,), payload uint8 array,
+    payload_offsets int64 (n_consumed+1,)), or None when the lib is
+    unavailable / the pack is malformed. Non-delta records inflate with one
+    reused z_stream; delta records come back as type 0 with an empty slot
+    (the caller's per-object path resolves the chain).
+
+    max_total bounds the payload buffer: only the longest record PREFIX
+    whose inflated payload fits (always at least one record) is consumed —
+    callers loop over the remainder, so a batch of large blobs can't
+    materialise unbounded memory in one native call."""
     lib = load_io()
     if lib is None:
         return None
@@ -245,26 +250,33 @@ def inflate_pack_batch(pack_buf, offsets):
     offsets = np.ascontiguousarray(offsets, dtype=np.int64)
     n = len(offsets)
     types = np.zeros(n, dtype=np.uint8)
+    cum = np.zeros(n + 1, dtype=np.int64)
     total = lib.io_inflate_batch(
         buf.ctypes.data, len(buf), offsets.ctypes.data, n,
-        None, 0, None, types.ctypes.data,
+        None, 0, cum.ctypes.data, types.ctypes.data,
     )
     if total < 0:
         return None
-    out_offsets = np.zeros(n + 1, dtype=np.int64)
+    take = n
+    if max_total is not None and total > max_total:
+        take = max(1, int(np.searchsorted(cum, max_total, side="right")) - 1)
+        total = int(cum[take])
+        offsets = offsets[:take]
+        types = types[:take]
+    out_offsets = np.zeros(take + 1, dtype=np.int64)
     if total == 0 and not types.any():
         # every record is a delta (heavily-repacked git packs): nothing to
         # inflate, skip the second native pass entirely
-        return types, np.empty(0, dtype=np.uint8), out_offsets
+        return take, types, np.empty(0, dtype=np.uint8), out_offsets
     out = np.empty(int(total), dtype=np.uint8)
     rc = lib.io_inflate_batch(
-        buf.ctypes.data, len(buf), offsets.ctypes.data, n,
+        buf.ctypes.data, len(buf), offsets.ctypes.data, take,
         out.ctypes.data, int(total), out_offsets.ctypes.data,
         types.ctypes.data,
     )
     if rc < 0:
         return None
-    return types, out, out_offsets
+    return take, types, out, out_offsets
 
 
 def pack_objects_batch(obj_type, contents, level=1):

@@ -252,8 +252,9 @@ class KartRequestHandler(BaseHTTPRequestHandler):
         repo = self.repo
         with self._read_body_spooled() as body:
             header, pack_fp = read_framed(body)
-            for obj_type, content in read_pack(pack_fp):
-                repo.odb.write_raw(obj_type, content)
+            with repo.odb.bulk_pack():
+                for obj_type, content in read_pack(pack_fp):
+                    repo.odb.write_raw(obj_type, content)
 
         # compare-and-swap must be atomic across handler threads AND across
         # processes (an ssh push is a separate serve-stdio process): thread
@@ -353,8 +354,9 @@ class HttpRemote:
         )
         with resp:
             header, pack_fp = read_framed(resp)
-            for obj_type, content in read_pack(pack_fp):
-                dst_repo.odb.write_raw(obj_type, content)
+            with dst_repo.odb.bulk_pack():
+                for obj_type, content in read_pack(pack_fp):
+                    dst_repo.odb.write_raw(obj_type, content)
         return header
 
     def fetch_blobs(self, dst_repo, oids):
@@ -362,9 +364,10 @@ class HttpRemote:
         fetched = 0
         with resp:
             header, pack_fp = read_framed(resp)
-            for obj_type, content in read_pack(pack_fp):
-                dst_repo.odb.write_raw(obj_type, content)
-                fetched += 1
+            with dst_repo.odb.bulk_pack():
+                for obj_type, content in read_pack(pack_fp):
+                    dst_repo.odb.write_raw(obj_type, content)
+                    fetched += 1
         if header.get("missing"):
             raise HttpTransportError(
                 f"Remote is missing promised objects: {header['missing'][:5]}"

@@ -193,8 +193,11 @@ def _transfer(src_odb, dst_odb, wants, *, depth=None, blob_filter=None, sender_s
     with tempfile.SpooledTemporaryFile(max_size=64 * 1024 * 1024) as wire:
         write_pack(wire, iter(enum))
         wire.seek(0)
-        for obj_type, content in read_pack(wire):
-            dst_odb.write_raw(obj_type, content)
+        # received objects land in one new pack, not a loose file each (a
+        # 1M-feature clone would otherwise create a million files)
+        with dst_odb.bulk_pack():
+            for obj_type, content in read_pack(wire):
+                dst_odb.write_raw(obj_type, content)
     return enum
 
 
@@ -637,7 +640,8 @@ def fetch_promised_blobs(repo, oids):
 
         write_pack(wire, pull())
         wire.seek(0)
-        for obj_type, content in read_pack(wire):
-            repo.odb.write_raw(obj_type, content)
-            fetched += 1
+        with repo.odb.bulk_pack():
+            for obj_type, content in read_pack(wire):
+                repo.odb.write_raw(obj_type, content)
+                fetched += 1
     return fetched

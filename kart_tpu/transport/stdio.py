@@ -196,16 +196,18 @@ class StdioRemote:
                 "filter": filter_spec,
             }
         )
-        for obj_type, content in read_pack(pack_fp):
-            dst_repo.odb.write_raw(obj_type, content)
+        with dst_repo.odb.bulk_pack():
+            for obj_type, content in read_pack(pack_fp):
+                dst_repo.odb.write_raw(obj_type, content)
         return resp
 
     def fetch_blobs(self, dst_repo, oids):
         resp, pack_fp = self._rpc({"op": "fetch-blobs", "oids": list(oids)})
         fetched = 0
-        for obj_type, content in read_pack(pack_fp):
-            dst_repo.odb.write_raw(obj_type, content)
-            fetched += 1
+        with dst_repo.odb.bulk_pack():
+            for obj_type, content in read_pack(pack_fp):
+                dst_repo.odb.write_raw(obj_type, content)
+                fetched += 1
         if resp.get("missing"):
             raise StdioTransportError(
                 f"Remote is missing promised objects: {resp['missing'][:5]}"
@@ -264,8 +266,9 @@ def serve_stdio(repo, in_fp, out_fp):
         try:
             if op == "receive-pack":
                 # drain the request pack before replying
-                for obj_type, content in read_pack(in_fp):
-                    repo.odb.write_raw(obj_type, content)
+                with repo.odb.bulk_pack():
+                    for obj_type, content in read_pack(in_fp):
+                        repo.odb.write_raw(obj_type, content)
                 status, payload = locked_ref_updates(repo, header)
                 if status == "ok":
                     write_framed(out_fp, {"updated": payload}, ())

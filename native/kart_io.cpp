@@ -307,8 +307,8 @@ int64_t io_inflate_batch(const uint8_t* pack, int64_t pack_len,
         std::memset(&zs, 0, sizeof(zs));
         if (inflateInit(&zs) != Z_OK) return -3;
         zs_ready = true;
-        out_offsets[0] = 0;
     }
+    if (out_offsets != nullptr) out_offsets[0] = 0;
     for (int64_t i = 0; i < n; i++) {
         int64_t pos = offsets[i];
         if (pos < 0 || pos >= pack_len) {
@@ -333,6 +333,7 @@ int64_t io_inflate_batch(const uint8_t* pack, int64_t pack_len,
         if (out == nullptr) {
             types_out[i] = plain ? uint8_t(type) : 0;
             if (plain) total += int64_t(size);
+            if (out_offsets != nullptr) out_offsets[i + 1] = total;
             continue;
         }
         types_out[i] = plain ? uint8_t(type) : 0;
