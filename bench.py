@@ -99,31 +99,54 @@ def main():
         return None
 
     def run_worker(env=None):
-        """-> the worker's last complete JSON record, salvaged from partial
-        output on timeout or crash (the worker prints a full record before
-        the long 100M tail; the probe-failure exit prints no JSON, so any
-        parseable record is a real measurement)."""
+        """-> (last complete JSON record or None, returncode or None),
+        salvaging partial output on timeout or crash (the worker prints a
+        full record before the long 100M tail; the probe-failure exit — rc 3
+        — prints no JSON, so any parseable record is a real measurement).
+        returncode None means the subprocess hit the watchdog timeout."""
         try:
             proc = subprocess.run(
                 cmd, timeout=timeout_s, capture_output=True, text=True, env=env
             )
         except subprocess.TimeoutExpired as e:
-            return last_json_line(e.stdout)
+            return last_json_line(e.stdout), None
         line = last_json_line(proc.stdout)
         if line is None and proc.stderr:
             print(proc.stderr.strip()[-2000:], file=sys.stderr)
-        return line
+        return line, proc.returncode
 
-    line = run_worker()
+    # Accelerator attempt 1: a benchmark can afford a far bigger PJRT init
+    # budget than an interactive CLI (r3 post-mortem: the 75 s CLI default
+    # burned the whole round's TPU evidence) — scale it with the bench
+    # timeout unless the operator pinned it explicitly.
+    env = dict(os.environ)
+    if "KART_JAX_INIT_TIMEOUT" not in env:
+        env["KART_JAX_INIT_TIMEOUT"] = str(min(600, max(120, timeout_s // 4)))
+    line, rc = run_worker(env)
     if line:
         print(line)
         return
+    if rc == 3:
+        # Probe failure specifically (rc 3): one retry in a fresh process
+        # after a backoff — a fresh PJRT init can succeed where the first
+        # found the tunnel mid-restart. Short init budget: a still-wedged
+        # tunnel must not eat the CPU fallback's time. A post-init wedge
+        # (rc None: watchdog timeout mid-run) would wedge identically on
+        # retry, so it goes straight to the CPU fallback instead.
+        time.sleep(20)
+        if "KART_JAX_INIT_TIMEOUT" not in os.environ:  # never clobber a pin
+            env["KART_JAX_INIT_TIMEOUT"] = "180"
+        env["KART_JAX_REPROBE"] = "0"
+        line, rc = run_worker(env)
+        if line:
+            print(line)
+            return
     # accelerator path failed: measure on the CPU XLA backend instead
     env = dict(os.environ)
     env["JAX_PLATFORMS"] = "cpu"
     env["KART_INSULATE_CPU"] = "1"  # worker deregisters non-CPU factories
     env.pop("PALLAS_AXON_POOL_IPS", None)  # stops PJRT plugin registration
-    line = run_worker(env)
+    line, rc = run_worker(env)
     if line:
         print(line)
         return
@@ -152,6 +175,14 @@ def worker():
         insulate_virtual_cpu(1)
 
     info = probe_backend()
+    if not info["ok"] and "timed out" in (info.get("error") or ""):
+        # distinguish slow-vs-wedged before giving up: wait once more on the
+        # abandoned init thread (KART_JAX_REPROBE=0 disables — retry attempts
+        # must fail fast)
+        if os.environ.get("KART_JAX_REPROBE") != "0":
+            from kart_tpu.runtime import reprobe
+
+            info = reprobe(120)
     if not info["ok"]:
         # backend unusable (wedged tunnel): exit non-zero so the watchdog
         # re-runs us on the CPU XLA backend — never print an unlabelled number
@@ -589,15 +620,16 @@ def _cli_diff_100m():
             os.environ.pop("KART_DIFF_SHARDED", None)
             diff_kernel.DEVICE_MIN_ROWS = orig_min_rows
 
-        best = min(routed_s, host_s)
+        # the north-star flag is the ROUTED production path, nothing else
+        # (VERDICT r3 weak #2: a forced-host number must never wear this
+        # label); the host-engine time stays recorded for engine comparison
         return {
             "cli_100m_rows": rows,
             "cli_100m_synth_seconds": round(synth_s, 1),
             "cli_100m_diff_cold_seconds": round(routed_cold_s, 2),
             "cli_100m_diff_seconds": round(routed_s, 2),
             "cli_100m_diff_host_engine_seconds": round(host_s, 2),
-            "cli_100m_best_seconds": round(best, 2),
-            "cli_100m_north_star_met": bool(best < 60.0),
+            "cli_100m_north_star_met": bool(routed_s < 60.0),
         }
     except Exception as e:  # pragma: no cover - bench resilience
         print(f"100m bench failed: {type(e).__name__}: {e}", file=sys.stderr)

@@ -217,26 +217,61 @@ def _env_int(name, default):
 # can lower this via the env knob.
 DEVICE_MIN_ROWS = _env_int("KART_DEVICE_MIN_ROWS", 2_000_000)
 
+# above this row count the accelerator path streams the blocks chunk-wise so
+# host->HBM transfer of chunk i+1 overlaps the sort of chunk i (SURVEY §2.3
+# "pipelined lazy diff streaming") instead of paying one monolithic upload
+STREAM_MIN_ROWS = _env_int("KART_STREAM_MIN_ROWS", 16_000_000)
+STREAM_CHUNK_ROWS = _env_int("KART_STREAM_CHUNK_ROWS", 8_000_000)
+
+
+def device_profitable(n_rows):
+    """Cost-model routing for the classify kernels: True when the device
+    round trip is expected to beat the host engine.
+
+    - Below DEVICE_MIN_ROWS the host path wins on any backend (no backend
+      init, no compile, no transfer) — and the check runs before any jax
+      import, so small diffs stay instant even with a wedged accelerator.
+    - On an XLA-**CPU** backend the host engine wins at *every* size: the
+      native C++ merge-join is sequential-scan bound (~1.1 s at 100M rows)
+      where the XLA join lost 13.6x at 100M (measured r3: 65.3 s vs 4.8 s),
+      and even the numpy twin beats XLA-CPU. XLA-CPU exists for correctness
+      twins and virtual-mesh tests, not as a production diff engine.
+    - On a real accelerator, size is the only question.
+
+    KART_DIFF_DEVICE=1/0 forces the answer (tests, experiments)."""
+    mode = os.environ.get("KART_DIFF_DEVICE", "auto")
+    if mode == "0":
+        return False
+    if n_rows < DEVICE_MIN_ROWS and mode != "1":
+        return False
+    from kart_tpu.runtime import default_backend, jax_ready
+
+    if not jax_ready():
+        return False
+    return mode == "1" or default_backend() != "cpu"
+
 
 def classify_blocks(old_block, new_block):
     """FeatureBlock x2 -> (old_class np.int8 (n_old,), new_class (n_new,),
-    counts dict). Host wrapper: unpads and returns numpy. Picks the kernel
-    variant suited to the live backend (sort-join on accelerators, binary
-    search on CPU) — bit-identical results (the sort path host-verifies its
-    oid fold against full oids on device). Small blocks and wedged/
-    unavailable backends
-    take the numpy twin: the CLI must always complete, and quickly."""
-    from kart_tpu.runtime import default_backend, jax_ready
+    counts dict). Host wrapper: unpads and returns numpy. Routing is a cost
+    model (:func:`device_profitable`): the host engine owns small blocks,
+    CPU backends and wedged accelerators; real accelerators get the sort-join
+    kernel — streamed in double-buffered chunks at north-star scale so
+    transfer overlaps compute. Bit-identical results on every route (the
+    sort path device-verifies its oid fold against full oids)."""
+    from kart_tpu.runtime import default_backend
 
-    small = max(old_block.count, new_block.count) < DEVICE_MIN_ROWS
-    if small or not jax_ready():
+    n_rows = max(old_block.count, new_block.count)
+    if not device_profitable(n_rows):
         return classify_blocks_host(old_block, new_block)
-    kernel = (
-        _classify_padded_binsearch
-        if default_backend() == "cpu"
-        else _classify_padded
-    )
     try:
+        if n_rows >= STREAM_MIN_ROWS and default_backend() != "cpu":
+            return classify_blocks_streamed(old_block, new_block)
+        kernel = (
+            _classify_padded_binsearch
+            if default_backend() == "cpu"
+            else _classify_padded
+        )
         old_class, new_class, _, counts = kernel(
             old_block.keys,
             old_block.oids,
@@ -263,6 +298,109 @@ def classify_blocks(old_block, new_block):
         old_class,
         new_class,
         {"inserts": int(counts[0]), "updates": int(counts[1]), "deletes": int(counts[2])},
+    )
+
+
+def classify_blocks_streamed(old_block, new_block, chunk_rows=None):
+    """Double-buffered chunked device classify for blocks too large to ship
+    to HBM as one upload (SURVEY §2.3 "pipelined lazy diff streaming").
+
+    Both blocks are key-sorted, so splitting the *key space* at common
+    boundary values (quantiles of the larger side) partitions the merge-join
+    into independent chunk-local joins: a key falls in the same chunk on both
+    sides, and no old/new pair ever straddles a boundary. Each chunk is
+    padded to one shared bucket size (a single compiled shape), transferred
+    with ``jax.device_put`` — which is asynchronous — and dispatched
+    immediately; with two chunks in flight, chunk i+1's host->HBM copy
+    overlaps chunk i's on-device sort. Results drain back in order.
+
+    Semantics identical to the monolithic kernel (tested); counts are the
+    sum of per-chunk count vectors."""
+    import jax
+
+    from collections import deque
+
+    from kart_tpu.ops.blocks import PAD_KEY, bucket_size as _bucket
+
+    if chunk_rows is None:
+        chunk_rows = max(STREAM_CHUNK_ROWS, 1)
+    n_old, n_new = old_block.count, new_block.count
+    old_keys = old_block.keys[:n_old]
+    new_keys = new_block.keys[:n_new]
+    n_chunks = max(1, -(-max(n_old, n_new) // chunk_rows))
+    # Boundaries must balance the *combined* population: quantiles of one
+    # side alone collapse under key-range skew (e.g. a renumbered-PK
+    # revision whose new keys all exceed the old range would pile every new
+    # row into one chunk). Candidate keys are fine-grained quantiles of both
+    # sides; each target combined-rank picks the nearest candidate.
+    def _quantile_keys(keys, m):
+        if not len(keys) or m <= 0:
+            return keys[:0]
+        return keys[(np.arange(1, m) * len(keys)) // m]
+
+    cand = np.unique(
+        np.concatenate(
+            [_quantile_keys(old_keys, 4 * n_chunks), _quantile_keys(new_keys, 4 * n_chunks)]
+        )
+    )
+    if len(cand):
+        ranks = np.searchsorted(old_keys, cand) + np.searchsorted(new_keys, cand)
+        targets = (np.arange(1, n_chunks) * (n_old + n_new)) // n_chunks
+        picks = np.searchsorted(ranks, targets)
+        bounds = np.unique(cand[np.minimum(picks, len(cand) - 1)])
+    else:
+        bounds = cand
+    old_splits = np.concatenate(
+        ([0], np.searchsorted(old_keys, bounds), [n_old])
+    )
+    new_splits = np.concatenate(
+        ([0], np.searchsorted(new_keys, bounds), [n_new])
+    )
+    n_chunks = len(bounds) + 1
+    max_len = max(
+        int(np.max(np.diff(old_splits))), int(np.max(np.diff(new_splits))), 1
+    )
+    bucket = _bucket(max_len)
+
+    def _padded(keys, oids, lo, hi):
+        k = np.full(bucket, PAD_KEY, dtype=np.int64)
+        o = np.zeros((bucket, 5), dtype=np.uint32)
+        k[: hi - lo] = keys[lo:hi]
+        o[: hi - lo] = oids[lo:hi]
+        return k, o
+
+    old_class = np.empty(n_old, dtype=np.int8)
+    new_class = np.empty(n_new, dtype=np.int8)
+    totals = np.zeros(3, dtype=np.int64)
+    in_flight = deque()
+
+    def _drain():
+        out, (olo, ohi), (nlo, nhi) = in_flight.popleft()
+        oc, nc, _, counts = out
+        old_class[olo:ohi] = np.asarray(oc)[: ohi - olo]
+        new_class[nlo:nhi] = np.asarray(nc)[: nhi - nlo]
+        totals[:] += np.asarray(counts)
+
+    for c in range(n_chunks):
+        olo, ohi = int(old_splits[c]), int(old_splits[c + 1])
+        nlo, nhi = int(new_splits[c]), int(new_splits[c + 1])
+        ok, oo = _padded(old_keys, old_block.oids, olo, ohi)
+        nk, no = _padded(new_keys, new_block.oids, nlo, nhi)
+        dev = [jax.device_put(a) for a in (ok, oo, nk, no)]
+        out = _classify_padded(dev[0], dev[1], dev[2], dev[3], ohi - olo, nhi - nlo)
+        in_flight.append((out, (olo, ohi), (nlo, nhi)))
+        if len(in_flight) > 2:
+            _drain()
+    while in_flight:
+        _drain()
+    return (
+        old_class,
+        new_class,
+        {
+            "inserts": int(totals[0]),
+            "updates": int(totals[1]),
+            "deletes": int(totals[2]),
+        },
     )
 
 

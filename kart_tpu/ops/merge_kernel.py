@@ -98,12 +98,12 @@ def merge_classify(ancestor_block, ours_block, theirs_block):
     union = np.union1d(np.union1d(a_real, o_real), t_real).astype(np.int64)
     u = len(union)
 
-    from kart_tpu.ops.diff_kernel import DEVICE_MIN_ROWS
-    from kart_tpu.runtime import jax_ready
+    from kart_tpu.ops.diff_kernel import device_profitable
 
-    # small merges never pay backend init / compile (same policy as
-    # classify_blocks — a 3-feature merge must be instant)
-    if u < DEVICE_MIN_ROWS or not jax_ready():
+    # same cost model as classify_blocks: small merges never pay backend
+    # init / compile, and XLA-CPU backends route to the host path (where the
+    # native/numpy engines win at every size)
+    if not device_profitable(u):
         decision, presence = _merge_classify_np(
             ancestor_block, ours_block, theirs_block, union
         )

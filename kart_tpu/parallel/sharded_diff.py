@@ -209,9 +209,14 @@ def should_shard(n_rows):
         return False
     if mode != "1" and n_rows < _sharded_min_rows():
         return False
-    from kart_tpu.runtime import jax_ready
+    from kart_tpu.runtime import default_backend, jax_ready
 
     if not jax_ready():
+        return False
+    if mode != "1" and default_backend() == "cpu":
+        # a virtual CPU mesh is a test/dryrun vehicle, not a production
+        # engine: the native host merge-join wins XLA-CPU at every size
+        # (same cost model as ops.diff_kernel.device_profitable)
         return False
     import jax
 

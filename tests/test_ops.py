@@ -447,3 +447,133 @@ def test_native_classify_duplicate_keys_match_reference():
     assert hc["updates"] == int(np.sum(ro == 2))
     assert hc["inserts"] == int(np.sum(rn == 1))
     assert hc["deletes"] == int(np.sum(ro == 3))
+
+
+def test_classify_streamed_matches_reference():
+    """The double-buffered chunked path must be bit-identical to the
+    monolithic kernel / numpy reference, including across chunk boundaries
+    (updates, inserts, deletes in every chunk; uneven side sizes)."""
+    from kart_tpu.ops.diff_kernel import classify_blocks_streamed
+
+    rng = np.random.default_rng(3)
+    n = 5000
+    old_keys = np.sort(rng.choice(20_000, size=n, replace=False)).astype(np.int64)
+    old_oids = rng.integers(0, 2**32, size=(n, 5), dtype=np.uint32)
+    # new side: drop 10%, change 10%, add 500 fresh keys
+    keep = rng.random(n) > 0.1
+    new_keys = old_keys[keep]
+    new_oids = old_oids[keep].copy()
+    change = rng.random(len(new_keys)) < 0.1
+    new_oids[change, 0] ^= 1
+    fresh = np.setdiff1d(
+        rng.choice(40_000, size=1000, replace=False), old_keys
+    )[:500].astype(np.int64)
+    new_keys = np.concatenate([new_keys, fresh])
+    new_oids = np.concatenate(
+        [new_oids, rng.integers(0, 2**32, size=(len(fresh), 5), dtype=np.uint32)]
+    )
+    old = FeatureBlock.from_arrays(old_keys, old_oids, [str(k) for k in old_keys])
+    new = FeatureBlock.from_arrays(new_keys, new_oids, [str(k) for k in new_keys])
+
+    ref_old, ref_new = classify_blocks_reference(old, new)
+    for chunk_rows in (256, 1024, 10_000):  # 20 chunks, 5 chunks, 1 chunk
+        got_old, got_new, counts = classify_blocks_streamed(
+            old, new, chunk_rows=chunk_rows
+        )
+        np.testing.assert_array_equal(got_old, ref_old)
+        np.testing.assert_array_equal(got_new, ref_new)
+        assert counts == {
+            "inserts": int(np.sum(ref_new == INSERT)),
+            "updates": int(np.sum(ref_old == UPDATE)),
+            "deletes": int(np.sum(ref_old == DELETE)),
+        }
+
+
+def test_classify_streamed_one_side_empty():
+    from kart_tpu.ops.diff_kernel import classify_blocks_streamed
+
+    keys = np.arange(2000, dtype=np.int64)
+    oids = np.ones((2000, 5), dtype=np.uint32)
+    full = FeatureBlock.from_arrays(keys, oids, [str(k) for k in keys])
+    empty = FeatureBlock.from_arrays(
+        np.zeros(0, dtype=np.int64), np.zeros((0, 5), dtype=np.uint32), []
+    )
+    _, new_class, counts = classify_blocks_streamed(empty, full, chunk_rows=512)
+    assert counts == {"inserts": 2000, "updates": 0, "deletes": 0}
+    assert (new_class == INSERT).all()
+    old_class, _, counts = classify_blocks_streamed(full, empty, chunk_rows=512)
+    assert counts == {"inserts": 0, "updates": 0, "deletes": 2000}
+    assert (old_class == DELETE).all()
+
+
+def test_device_profitable_cost_model(monkeypatch):
+    """Routing: CPU backends go host at every size (r3 post-mortem: XLA-CPU
+    lost 13.6x to the native engine at 100M rows); small blocks go host on
+    any backend; KART_DIFF_DEVICE forces either way."""
+    import kart_tpu.runtime as runtime
+    from kart_tpu.ops.diff_kernel import device_profitable
+
+    monkeypatch.delenv("KART_DIFF_DEVICE", raising=False)
+    # small: host, decided before any backend probe
+    monkeypatch.setattr(runtime, "_probe_result", None)
+    assert not device_profitable(10)
+    assert runtime._probe_result is None  # no probe happened
+
+    # big + cpu backend: host
+    monkeypatch.setattr(
+        runtime,
+        "_probe_result",
+        {"ok": True, "backend": "cpu", "device_kind": "cpu", "n_devices": 1,
+         "init_seconds": 0.0, "error": None},
+    )
+    assert not device_profitable(10**9)
+    # big + accelerator: device
+    monkeypatch.setattr(
+        runtime,
+        "_probe_result",
+        {"ok": True, "backend": "tpu", "device_kind": "TPU v5", "n_devices": 1,
+         "init_seconds": 0.0, "error": None},
+    )
+    assert device_profitable(10**9)
+    # wedged: host
+    monkeypatch.setattr(
+        runtime,
+        "_probe_result",
+        {"ok": False, "backend": None, "device_kind": None, "n_devices": 0,
+         "init_seconds": 0.0, "error": "simulated"},
+    )
+    assert not device_profitable(10**9)
+    # forced
+    monkeypatch.setenv("KART_DIFF_DEVICE", "0")
+    monkeypatch.setattr(
+        runtime,
+        "_probe_result",
+        {"ok": True, "backend": "tpu", "device_kind": "TPU v5", "n_devices": 1,
+         "init_seconds": 0.0, "error": None},
+    )
+    assert not device_profitable(10**9)
+    monkeypatch.setenv("KART_DIFF_DEVICE", "1")
+    monkeypatch.setattr(
+        runtime,
+        "_probe_result",
+        {"ok": True, "backend": "cpu", "device_kind": "cpu", "n_devices": 1,
+         "init_seconds": 0.0, "error": None},
+    )
+    assert device_profitable(10)
+
+
+def test_classify_streamed_disjoint_key_ranges():
+    """Renumbered-PK shape: all new keys above the old range. Bounds must
+    come from the combined population, so chunks stay balanced instead of
+    one chunk swallowing a whole side."""
+    from kart_tpu.ops.diff_kernel import classify_blocks_streamed
+
+    n = 4000
+    old_keys = np.arange(n, dtype=np.int64)
+    new_keys = np.arange(n, 2 * n, dtype=np.int64)
+    oids = np.ones((n, 5), dtype=np.uint32)
+    old = FeatureBlock.from_arrays(old_keys, oids, [str(k) for k in old_keys])
+    new = FeatureBlock.from_arrays(new_keys, oids.copy(), [str(k) for k in new_keys])
+    old_class, new_class, counts = classify_blocks_streamed(old, new, chunk_rows=500)
+    assert counts == {"inserts": n, "updates": 0, "deletes": n}
+    assert (old_class == DELETE).all() and (new_class == INSERT).all()

@@ -81,6 +81,9 @@ def test_merge_classify_fallback_matches_device_path(no_jax, monkeypatch):
     import kart_tpu.ops.diff_kernel as diff_kernel
 
     monkeypatch.setattr(diff_kernel, "DEVICE_MIN_ROWS", 0)
+    # the cost model routes CPU backends to the host engine; force the
+    # device kernel so this test genuinely jits
+    monkeypatch.setenv("KART_DIFF_DEVICE", "1")
     rng = np.random.default_rng(42)
     pks = rng.choice(10_000, size=300, replace=False)
     anc = _block({int(k): _oid(int(k)) for k in pks})
@@ -126,3 +129,59 @@ def test_insulate_updates_device_count_in_flags(monkeypatch):
     runtime.insulate_virtual_cpu(8)
     assert "--xla_force_host_platform_device_count=8" in os.environ["XLA_FLAGS"]
     assert "=2" not in os.environ["XLA_FLAGS"]
+
+
+def test_reprobe_adopts_slow_init(monkeypatch):
+    """A probe that timed out but whose init thread later finished must be
+    adopted by reprobe() (slow-not-wedged); a still-stuck thread updates the
+    failure record with the total wait."""
+    import threading
+    import time as _time
+
+    # slow: the "init thread" finishes during the extra wait
+    done = threading.Event()
+
+    def fake_init():
+        done.wait()
+
+    t = threading.Thread(target=fake_init, daemon=True)
+    t.start()
+    box = {}
+    monkeypatch.setattr(runtime, "_probe_result", {
+        "ok": False, "backend": None, "device_kind": None, "n_devices": 0,
+        "init_seconds": 1.0, "error": "backend init timed out after 1.0s",
+    })
+    monkeypatch.setattr(runtime, "_probe_thread", t)
+    monkeypatch.setattr(runtime, "_probe_box", box)
+    box["result"] = {
+        "ok": True, "backend": "tpu", "device_kind": "TPU v5",
+        "n_devices": 1, "init_seconds": 3.0, "error": None,
+    }
+    done.set()
+    info = runtime.reprobe(5)
+    assert info["ok"] and info["backend"] == "tpu"
+    assert runtime.probe_backend()["ok"]  # cached as the live result
+
+    # wedged: thread never finishes within the wait
+    stuck = threading.Event()
+    t2 = threading.Thread(target=stuck.wait, daemon=True)
+    t2.start()
+    monkeypatch.setattr(runtime, "_probe_result", {
+        "ok": False, "backend": None, "device_kind": None, "n_devices": 0,
+        "init_seconds": 1.0, "error": "backend init timed out after 1.0s",
+    })
+    monkeypatch.setattr(runtime, "_probe_thread", t2)
+    monkeypatch.setattr(runtime, "_probe_box", {})
+    info = runtime.reprobe(0.05)
+    assert not info["ok"]
+    assert "wedged" in info["error"]
+    stuck.set()
+
+
+def test_reprobe_noop_on_success(monkeypatch):
+    monkeypatch.setattr(runtime, "_probe_result", {
+        "ok": True, "backend": "cpu", "device_kind": "cpu", "n_devices": 1,
+        "init_seconds": 0.1, "error": None,
+    })
+    monkeypatch.setattr(runtime, "_probe_thread", None)
+    assert runtime.reprobe(1)["ok"]
