@@ -385,6 +385,17 @@ class PackCollection:
         miss — at the cost of one stat per dir."""
         if self._packs is None:
             return False
+        import time
+
+        # rate limit: inside the racy window every miss would otherwise
+        # trigger a full rescan (re-open + re-mmap every pack, old mmaps
+        # lingering until GC) — a miss-heavy negotiation right after a push
+        # would pay O(misses x packs). One rescan per interval is enough:
+        # the racy hole only needs *a* rescan after the granule, not one
+        # per miss.
+        now = time.time_ns()
+        if now - getattr(self, "_last_refresh_ns", 0) < 200_000_000:
+            return False
         scan_wall = getattr(self, "_scan_walltime_ns", 0)
         for d in self.pack_dirs:
             try:
@@ -394,6 +405,7 @@ class PackCollection:
             if self._scan_mtimes.get(d) != mtime or (
                 mtime is not None and scan_wall - mtime < self._RACY_NS
             ):
+                self._last_refresh_ns = now
                 self.refresh()
                 return True
         return False

@@ -300,6 +300,14 @@ class SidecarCapture:
         )
         return pks, oids_u8
 
+    def replace_int_columns(self, pks_arr, oids_u8):
+        """Overwrite the captured int-pk columns (importer dedup: the
+        sidecar must match the committed tree when duplicate source pks
+        were resolved last-wins)."""
+        self._pk_chunks = [np.ascontiguousarray(pks_arr, dtype=np.int64)]
+        self._oid_chunks = [np.ascontiguousarray(oids_u8, dtype=np.uint8).tobytes()]
+        self.count = len(pks_arr)
+
     def save(self, repo, feature_tree_oid):
         if not self.count:
             return None

@@ -56,7 +56,27 @@ class NTv2Grid:
     def __init__(self, system_from, system_to, subgrids):
         self.system_from = system_from
         self.system_to = system_to
-        self.subgrids = subgrids
+        # Process coarse->fine so finer (child) subgrids overwrite their
+        # parents in shift(). The format does NOT guarantee parents are
+        # listed first (PROJ resolves the hierarchy via the PARENT field),
+        # so order by hierarchy depth — stable, so sibling file order is
+        # kept. Unknown/cyclic parents are treated as roots.
+        depth_memo = {}
+        by_name = {sg.name: sg for sg in subgrids}
+
+        def depth(sg, seen=()):
+            if sg.name in depth_memo:
+                return depth_memo[sg.name]
+            parent = by_name.get(getattr(sg, "parent", "NONE"))
+            d = (
+                0
+                if parent is None or sg.name in seen
+                else depth(parent, seen + (sg.name,)) + 1
+            )
+            depth_memo[sg.name] = d
+            return d
+
+        self.subgrids = sorted(subgrids, key=depth)
 
     @classmethod
     def open(cls, path):
@@ -150,8 +170,8 @@ class NTv2Grid:
     def shift(self, lon_deg, lat_deg, inverse=False):
         """Apply the grid: source-datum lon/lat (degrees, east-positive) ->
         target datum. Points outside every subgrid pass through unchanged
-        (fail open, like PROJ). ``inverse`` applies target->source with one
-        fixed-point refinement round."""
+        (fail open, like PROJ). ``inverse`` applies target->source with
+        three fixed-point refinement rounds."""
         lon = np.asarray(lon_deg, dtype=np.float64)
         lat = np.asarray(lat_deg, dtype=np.float64)
         if inverse:
@@ -169,8 +189,8 @@ class NTv2Grid:
         done = np.zeros(lat.shape, dtype=bool)
         # NTv2 longitudes are positive WEST
         lon_w = -lon
-        # later (finer, child) subgrids win: iterate parents first, children
-        # overwrite — file order already lists parents before children
+        # later (finer, child) subgrids win: subgrids are hierarchy-ordered
+        # at construction (roots first), so children overwrite parents
         for sg in self.subgrids:
             inside = (
                 (lat >= sg.s_lat / 3600.0)

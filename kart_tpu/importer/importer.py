@@ -354,6 +354,12 @@ def _import_single_source(
                 keep = np.sort(order[is_last])
                 pks_arr = pks_arr[keep]
                 oids_u8 = oids_u8[keep]
+                if isinstance(capture, SidecarCapture):
+                    # the sidecar must mirror the committed tree, not the
+                    # raw stream — a stale duplicate row would later pair
+                    # against the live head in the columnar merge-join and
+                    # surface as a spurious UPDATE
+                    capture.replace_int_columns(pks_arr, oids_u8)
         ftree = build_int_feature_tree(repo.odb, pks_arr, oids_u8, encoder)
         tb.insert(
             f"{ds_path}/{Dataset3.DATASET_DIRNAME}/feature",

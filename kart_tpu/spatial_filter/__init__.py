@@ -473,16 +473,19 @@ def _geom_intersects_polygon_set(feat, parts):
     for i in range(len(pts)):
         if _point_in_polygon_set(parts, pts[i, 0], pts[i, 1]):
             return True
+    if len(pts):
+        # boundary touch — a point exactly on a filter edge counts as
+        # Intersects. Tested for every feature's points, not only
+        # points-only features: a GeometryCollection whose point touches
+        # the boundary matches even when its lines/polys are disjoint.
+        fa, fb = _filter_ring_segs(parts)
+        p = pts[:, None, :]
+        d = (fb[None, :, 0] - fa[None, :, 0]) * (p[..., 1] - fa[None, :, 1]) - (
+            fb[None, :, 1] - fa[None, :, 1]
+        ) * (p[..., 0] - fa[None, :, 0])
+        if np.any((d == 0) & _on_segment(fa[None, :, :], fb[None, :, :], p)):
+            return True
     if not feat["lines"] and not feat["polys"]:
-        if len(pts):
-            # points only: boundary touch — a point exactly on a filter edge
-            fa, fb = _filter_ring_segs(parts)
-            p = pts[:, None, :]
-            d = (fb[None, :, 0] - fa[None, :, 0]) * (p[..., 1] - fa[None, :, 1]) - (
-                fb[None, :, 1] - fa[None, :, 1]
-            ) * (p[..., 0] - fa[None, :, 0])
-            if np.any((d == 0) & _on_segment(fa[None, :, :], fb[None, :, :], p)):
-                return True
         return False
 
     fa, fb = _filter_ring_segs(parts)

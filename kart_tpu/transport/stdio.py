@@ -48,6 +48,10 @@ def parse_ssh_url(url):
     def checked(userhost, port, path):
         if userhost.startswith("-") or path.startswith("-"):
             return None
+        if port is not None and not str(port).isdigit():
+            # the port rides ssh's argv after '-p'; digits-only keeps any
+            # crafted string from reaching ssh as something else entirely
+            return None
         return userhost, port, path
 
     if url.startswith("ssh://"):

@@ -600,3 +600,55 @@ class TestDatumShiftComposition:
         gsb.write_bytes(bytes(data))
         with pytest.raises(GridShiftError, match="SECONDS"):
             NTv2Grid.open(str(gsb))
+
+
+class TestNTv2SubgridOrder:
+    def test_child_listed_before_parent_still_wins(self):
+        """The .gsb format doesn't guarantee parents precede children
+        (ADVICE r3): hierarchy order comes from the PARENT field, so a
+        child listed first must still overwrite its parent's coarse value."""
+        import numpy as np
+
+        from kart_tpu.gridshift import NTv2Grid, SubGrid
+
+        def make_sg(name, parent, s_lat, n_lat, e_long, w_long, shift_sec):
+            sg = SubGrid()
+            sg.name = name
+            sg.parent = parent
+            sg.s_lat, sg.n_lat = s_lat * 3600.0, n_lat * 3600.0
+            sg.e_long, sg.w_long = e_long * 3600.0, w_long * 3600.0
+            sg.lat_inc = sg.lon_inc = 0.5 * 3600.0
+            sg.n_rows = int((sg.n_lat - sg.s_lat) / sg.lat_inc) + 1
+            sg.n_cols = int((sg.w_long - sg.e_long) / sg.lon_inc) + 1
+            sg.lat_shift = np.full((sg.n_rows, sg.n_cols), shift_sec)
+            sg.lon_shift = np.zeros((sg.n_rows, sg.n_cols))
+            return sg
+
+        child = make_sg("FINE", "COARSE", 40.5, 41.0, 74.5, 75.0, 3.6)
+        parent = make_sg("COARSE", "NONE", 40.0, 42.0, 74.0, 76.0, 1.8)
+        # child FIRST in file order — the constructor must reorder
+        grid = NTv2Grid("A", "B", [child, parent])
+        assert [sg.name for sg in grid.subgrids] == ["COARSE", "FINE"]
+        lon, lat = grid.shift(np.array([-74.75]), np.array([40.75]))
+        assert abs(lat[0] - (40.75 + 3.6 / 3600)) < 1e-9  # fine value
+        lon, lat = grid.shift(np.array([-75.5]), np.array([41.5]))
+        assert abs(lat[0] - (41.5 + 1.8 / 3600)) < 1e-9  # coarse elsewhere
+
+    def test_parent_cycle_treated_as_roots(self):
+        import numpy as np
+
+        from kart_tpu.gridshift import NTv2Grid, SubGrid
+
+        a = SubGrid()
+        a.name, a.parent = "A", "B"
+        b = SubGrid()
+        b.name, b.parent = "B", "A"
+        for sg in (a, b):
+            sg.s_lat, sg.n_lat = 0.0, 3600.0
+            sg.e_long, sg.w_long = 0.0, 3600.0
+            sg.lat_inc = sg.lon_inc = 3600.0
+            sg.n_rows = sg.n_cols = 2
+            sg.lat_shift = np.zeros((2, 2))
+            sg.lon_shift = np.zeros((2, 2))
+        grid = NTv2Grid("A", "B", [a, b])  # must not recurse forever
+        assert len(grid.subgrids) == 2

@@ -562,3 +562,22 @@ def test_read_batch_on_reference_pack(tmp_path):
     # every sha still resolves through the fallback
     for sha in shas[:200]:
         assert coll.read(sha) is not None
+
+
+def test_maybe_refresh_rate_limited(tmp_path):
+    """Inside the racy-mtime window every lookup miss used to trigger a
+    full rescan (ADVICE r3); now at most one rescan per interval."""
+    from kart_tpu.core.packs import PackCollection
+
+    d = tmp_path / "pack"
+    d.mkdir()
+    pc = PackCollection([str(d)])
+    assert pc.packs == []  # initial scan (fresh dir: inside racy window)
+    assert pc.maybe_refresh() is True  # racy window: one rescan allowed
+    assert pc.packs == []
+    # immediately after, further misses are rate-limited: no rescan storm
+    assert pc.maybe_refresh() is False
+    assert pc.maybe_refresh() is False
+    # after the rate window passes, the racy rescan is allowed again
+    pc._last_refresh_ns -= 10**9
+    assert pc.maybe_refresh() is True

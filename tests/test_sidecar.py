@@ -225,3 +225,62 @@ def test_schema_change_commit_skips_sidecar_rollforward(
     tree_result = _diff_as_dict(repo, "HEAD^", "HEAD", "tree")
     col_result = _diff_as_dict(repo, "HEAD^", "HEAD", "columnar")
     assert tree_result == col_result
+
+
+def test_duplicate_pk_source_sidecar_matches_tree(tmp_path, tiny_sidecar_threshold):
+    """Duplicate source pks resolve last-wins in the committed tree; the
+    sidecar written from the import capture must mirror that exactly
+    (ADVICE r3: a stale duplicate row would later pair against the live
+    head in the columnar merge-join and emit a spurious UPDATE)."""
+    from kart_tpu.core.repo import KartRepo
+    from kart_tpu.importer import ImportSource
+    from kart_tpu.importer.importer import import_sources
+    from kart_tpu.models.schema import Schema
+    from kart_tpu.ops.blocks import FeatureBlock
+
+    class DupSource(ImportSource):
+        dest_path = "dup"
+
+        @property
+        def schema(self):
+            return Schema.from_column_dicts(
+                [
+                    {
+                        "id": "c1",
+                        "name": "fid",
+                        "dataType": "integer",
+                        "size": 64,
+                        "primaryKeyIndex": 0,
+                    },
+                    {"id": "c2", "name": "name", "dataType": "text"},
+                ]
+            )
+
+        def features(self):
+            for i in range(1, 40):
+                yield {"fid": i, "name": f"first-{i}"}
+            yield {"fid": 5, "name": "winner-5"}
+            yield {"fid": 17, "name": "winner-17"}
+
+        @property
+        def feature_count(self):
+            return 41
+
+    repo = KartRepo.init_repository(tmp_path / "repo")
+    repo.config.set_many({"user.name": "t", "user.email": "t@e"})
+    import_sources(repo, [DupSource()])
+    ds = repo.structure("HEAD").datasets["dup"]
+    assert ds.get_feature([5])["name"] == "winner-5"
+    assert ds.get_feature([17])["name"] == "winner-17"
+
+    tree_block = FeatureBlock.from_dataset(ds, pad=False)
+    assert tree_block.count == 39  # 41 rows, 2 duplicates collapsed
+    side_block = sidecar.load_block(repo, ds)
+    assert side_block is not None
+    assert side_block.count == tree_block.count
+    np.testing.assert_array_equal(
+        side_block.keys[: side_block.count], tree_block.keys[: tree_block.count]
+    )
+    np.testing.assert_array_equal(
+        side_block.oids[: side_block.count], tree_block.oids[: tree_block.count]
+    )

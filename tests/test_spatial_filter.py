@@ -467,3 +467,32 @@ def test_world_spanning_envelope_not_indexed():
     extractor._flush_bucket(con, None, bucket)
     rows = {r[0] for r in con.execute("SELECT blob_id FROM feature_envelopes")}
     assert rows == {b"\x02" * 20, b"\x06" * 20}
+
+
+class TestMixedGeometryBoundaryTouch:
+    def test_collection_point_on_filter_edge_matches(self):
+        """GEOS Intersects counts a boundary touch; a feature whose point
+        lies exactly on the filter edge must match even when the feature
+        also has disjoint lines/polygons (ADVICE r3: the touch test used to
+        run only for points-only features)."""
+        import numpy as np
+
+        from kart_tpu.spatial_filter import _geom_intersects_polygon_set
+
+        square = np.array(
+            [(0.0, 0.0), (10.0, 0.0), (10.0, 10.0), (0.0, 10.0), (0.0, 0.0)]
+        )
+        parts = [(square, [])]
+        feat = {
+            "points": np.array([[5.0, 0.0]]),  # exactly on the bottom edge
+            "lines": [np.array([[20.0, 20.0], [30.0, 30.0]])],  # disjoint
+            "polys": [],
+        }
+        assert _geom_intersects_polygon_set(feat, parts)
+        # and a disjoint point with disjoint lines stays unmatched
+        feat_out = {
+            "points": np.array([[50.0, 50.0]]),
+            "lines": [np.array([[20.0, 20.0], [30.0, 30.0]])],
+            "polys": [],
+        }
+        assert not _geom_intersects_polygon_set(feat_out, parts)

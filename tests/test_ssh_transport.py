@@ -261,3 +261,15 @@ def test_serve_stdio_rejects_enclosed_nonrepo_path(tmp_path, ssh_remote_repo):
             client.ls_refs()
     finally:
         client.close()
+
+
+def test_parse_ssh_url_rejects_non_numeric_port():
+    """The port rides ssh's argv after '-p': digits only (ADVICE r3)."""
+    assert parse_ssh_url("ssh://host:22x/srv/repo") is None
+    assert parse_ssh_url("ssh://host:22 -oProxyCommand=evil/srv/repo") is None
+    assert parse_ssh_url("ssh://[::1]:bad/srv/repo") is None
+    assert parse_ssh_url("ssh://host:2222/srv/repo") == (
+        "host",
+        "2222",
+        "/srv/repo",
+    )
