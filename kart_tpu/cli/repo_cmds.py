@@ -67,10 +67,19 @@ def init(ctx, directory, import_from, bare, wc_location, initial_branch, message
     ),
 )
 @click.option("--no-checkout", is_flag=True, help="Don't update the working copy")
+@click.option(
+    "--crs",
+    "crs_override",
+    help=(
+        "CRS of the source data, e.g. 'EPSG:27700' or full WKT — for "
+        "sources that don't carry one (GeoJSON, CSV, shapefile without "
+        ".prj). EPSG codes resolve via the built-in registry."
+    ),
+)
 @click.pass_obj
 def import_(
     ctx, sources, message, table, dest_path, replace_existing, replace_ids,
-    no_checkout,
+    no_checkout, crs_override,
 ):
     """Import data into the repository as new dataset(s)."""
     from kart_tpu.importer import ImportSource
@@ -86,9 +95,32 @@ def import_(
             except OSError as e:
                 raise CliError(f"Cannot read --replace-ids file: {e}")
         ids = [line.strip() for line in replace_ids.splitlines() if line.strip()]
+    if crs_override:
+        # resolve eagerly so a bad code/WKT fails before any import work,
+        # with the registry-coverage message
+        from kart_tpu.crs import CrsError, make_crs
+
+        try:
+            make_crs(crs_override)
+        except CrsError as e:
+            raise CliError(str(e))
     all_sources = []
     for spec in sources:
         opened = ImportSource.open(spec, table=table)
+        if crs_override:
+            from kart_tpu.crs import make_crs
+
+            for src in opened:
+                if hasattr(src, "crs"):
+                    src.crs = crs_override
+                elif getattr(src, "crs_wkt", "n/a") is None:
+                    # shapefile with no .prj sidecar
+                    src.crs_wkt = make_crs(crs_override).wkt
+                else:
+                    raise CliError(
+                        f"--crs does not apply to {spec!r}: the source "
+                        f"carries its own CRS definition"
+                    )
         all_sources.extend(opened)
     if dest_path:
         if len(all_sources) != 1:

@@ -6,8 +6,12 @@ transforms needed by the spatial-filter / envelope-index hot paths (geographic
 <-> Transverse Mercator / Web Mercator on a WGS84/GRS80 ellipsoid) are
 implemented directly over numpy arrays — which makes batch envelope
 reprojection a single vectorized call instead of a per-feature OSR round trip.
-Datum shifts are not applied (modern datums are within ~1m of WGS84, and the
-envelope index pads by a buffer anyway — see kart_tpu/spatial_filter/index.py).
+Datum shifts ARE applied when the CRS declares them: WKT1 TOWGS84 3/7-param
+Helmert (``Transform`` below) and NTv2 grid shifts (kart_tpu/gridshift.py,
+loaded from $KART_NTV2_GRID_DIR); a CRS with neither is treated as
+WGS84-equivalent (within ~1m for modern datums, and the envelope index pads
+by a buffer anyway — see kart_tpu/spatial_filter/index.py). Bare EPSG codes
+resolve through the built-in parameter registry (kart_tpu/epsg.py).
 """
 
 import math
@@ -271,39 +275,33 @@ _WELL_KNOWN = {
 
 
 def make_crs(user_input):
-    """User input (WKT, 'EPSG:n') -> CRS object (reference: crs_util.py:17-32)."""
+    """User input (WKT, 'EPSG:n') -> CRS object (reference: crs_util.py:17-32).
+
+    Bare EPSG codes resolve first against the curated WKT strings above,
+    then the built-in parameter registry (kart_tpu/epsg.py: common
+    geographic + projected CRSes and whole UTM families, synthesized to
+    WKT1). Codes outside the registry raise a CrsError that lists the
+    coverage — the reference resolves these via OSR/PROJ's database, which
+    this build deliberately doesn't carry."""
     if isinstance(user_input, CRS):
         return user_input
     text = user_input.strip()
     m = re.fullmatch(r"(?i)EPSG:(\d+)", text)
     if m:
         code = int(m.group(1))
-        # UTM zones: EPSG 326xx (N) / 327xx (S)
         if code in _WELL_KNOWN:
             return CRS(_WELL_KNOWN[code])
-        if 32601 <= code <= 32660 or 32701 <= code <= 32760:
-            return CRS(_utm_wkt(code))
+        from kart_tpu import epsg
+
+        wkt = epsg.epsg_wkt(code)
+        if wkt is not None:
+            return CRS(wkt)
         raise CrsError(
-            f"EPSG:{code} is not in the built-in CRS registry; "
-            f"supply the full WKT definition instead"
+            f"EPSG:{code} is not in the built-in CRS registry (this build "
+            f"carries no PROJ database); supply the full WKT definition "
+            f"instead. Built-in coverage — {epsg.registry_summary()}"
         )
     return CRS(text)
-
-
-def _utm_wkt(epsg):
-    zone = epsg % 100
-    south = epsg // 100 == 327
-    cm = -183 + 6 * zone
-    fn = 10000000 if south else 0
-    hemi = "S" if south else "N"
-    return (
-        f'PROJCS["WGS 84 / UTM zone {zone}{hemi}",{WGS84_WKT},'
-        f'PROJECTION["Transverse_Mercator"],'
-        f'PARAMETER["latitude_of_origin",0],PARAMETER["central_meridian",{cm}],'
-        f'PARAMETER["scale_factor",0.9996],PARAMETER["false_easting",500000],'
-        f'PARAMETER["false_northing",{fn}],UNIT["metre",1],'
-        f'AUTHORITY["EPSG","{epsg}"]]'
-    )
 
 
 class CRS:

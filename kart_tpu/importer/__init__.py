@@ -350,7 +350,14 @@ class GeoJSONImportSource(ImportSource):
         self.dest_path = dest_path or base
         self.crs = crs
         self._features_json = self._load_features(path)
-        self._schema = self._sniff_schema()
+        self._schema_cache = None
+
+    @property
+    def _schema(self):
+        # lazy: the CLI may override self.crs after construction (--crs)
+        if self._schema_cache is None:
+            self._schema_cache = self._sniff_schema()
+        return self._schema_cache
 
     @staticmethod
     def _load_features(path):
@@ -508,21 +515,22 @@ class CSVImportSource(ImportSource):
 
         if any(c.data_type == "geometry" for c in self._schema.columns):
             try:
-                return {"EPSG:4326": make_crs("EPSG:4326").wkt}
+                return {self.crs: make_crs(self.crs).wkt}
             except Exception:
                 return {}
         return {}
 
-    def __init__(self, path, dest_path=None):
+    def __init__(self, path, dest_path=None, crs="EPSG:4326"):
         if not os.path.exists(path):
             raise ImportSourceError(f"No such file: {path}")
         self.path = path
+        self.crs = crs
         self.dest_path = dest_path or os.path.splitext(os.path.basename(path))[0]
         with open(path, newline="") as f:
             reader = csv.reader(f)
             self.header = next(reader)
             self.rows = list(reader)
-        self._schema = self._sniff_schema()
+        self._schema_cache = None
 
     _WKT_PREFIXES = (
         "POINT", "LINESTRING", "POLYGON", "MULTIPOINT", "MULTILINESTRING",
@@ -579,7 +587,7 @@ class CSVImportSource(ImportSource):
         for name in self.header:
             t = types[name]
             if t == "geometry":
-                extra = {"geometryType": "GEOMETRY", "geometryCRS": "EPSG:4326"}
+                extra = {"geometryType": "GEOMETRY", "geometryCRS": self.crs}
             elif t in ("integer", "float"):
                 extra = {"size": 64}
             else:
@@ -595,6 +603,14 @@ class CSVImportSource(ImportSource):
             )
         cols.sort(key=lambda c: 0 if c.pk_index is not None else 1)
         return Schema(cols)
+
+    @property
+    def _schema(self):
+        # lazy: the CLI may override self.crs after construction (--crs)
+        # and the geometry column's geometryCRS must reflect that
+        if self._schema_cache is None:
+            self._schema_cache = self._sniff_schema()
+        return self._schema_cache
 
     @property
     def schema(self):

@@ -528,3 +528,71 @@ def test_csv_wkt_registers_crs_definition(tmp_path):
     (src,) = ImportSource.open(str(path))
     defs = src.crs_definitions()
     assert "EPSG:4326" in defs and "WGS" in defs["EPSG:4326"]
+
+
+def test_import_with_epsg_only_crs_cli(tmp_path, cli_runner):
+    """A dataset whose only CRS info is a bare EPSG code (VERDICT r3
+    missing #2): GeoJSON + --crs EPSG:27700 imports through the built-in
+    registry, records full WKT in meta, and diffs cleanly."""
+    import json
+
+    from kart_tpu.cli import cli
+
+    geojson = tmp_path / "sites.geojson"
+    geojson.write_text(
+        json.dumps(
+            {
+                "type": "FeatureCollection",
+                "features": [
+                    {
+                        "type": "Feature",
+                        "properties": {"id": i, "name": f"site-{i}"},
+                        "geometry": {
+                            "type": "Point",
+                            # plausible OSGB eastings/northings
+                            "coordinates": [400000.0 + i * 10, 200000.0 + i * 5],
+                        },
+                    }
+                    for i in range(1, 6)
+                ],
+            }
+        )
+    )
+    repo_path = tmp_path / "repo"
+    r = cli_runner.invoke(cli, ["init", str(repo_path)], catch_exceptions=False)
+    assert r.exit_code == 0, r.output
+    r = cli_runner.invoke(
+        cli,
+        ["-C", str(repo_path), "import", str(geojson), "--crs", "EPSG:27700",
+         "--no-checkout"],
+        catch_exceptions=False,
+    )
+    assert r.exit_code == 0, r.output
+
+    # the dataset's CRS meta item is the synthesized full WKT
+    r = cli_runner.invoke(
+        cli,
+        ["-C", str(repo_path), "meta", "get", "sites", "crs/EPSG:27700.wkt"],
+        catch_exceptions=False,
+    )
+    assert r.exit_code == 0, r.output
+    assert "OSGB" in r.output and "Airy 1830" in r.output
+    assert "TOWGS84" in r.output  # datum shift carried into the repo
+
+    # diff against [EMPTY] exercises the full read path
+    r = cli_runner.invoke(
+        cli,
+        ["-C", str(repo_path), "diff", "[EMPTY]...HEAD", "-o", "json"],
+        catch_exceptions=False,
+    )
+    assert r.exit_code == 0, r.output
+    d = json.loads(r.output)["kart.diff/v1+hexwkb"]
+    assert len(d["sites"]["feature"]) == 5
+
+    # a bad code fails fast with the coverage listing
+    r = cli_runner.invoke(
+        cli,
+        ["-C", str(repo_path), "import", str(geojson), "--crs", "EPSG:99999"],
+    )
+    assert r.exit_code != 0
+    assert "EPSG:99999" in r.output and "full WKT" in r.output
