@@ -546,6 +546,7 @@ def _cli_diff_bench():
         return {
             "cli_diff_rows": rows,
             "cli_import_seconds": round(import_s, 3),
+            "import_features_per_sec": round(rows / import_s),
             "cli_diff_columnar_cold_seconds": round(columnar_cold_s, 3),
             "cli_diff_columnar_seconds": round(columnar_s, 3),
             "cli_diff_tree_seconds": round(tree_s, 3),

@@ -254,6 +254,22 @@ class ObjectDb:
             return self._bulk_writer.add_batch("blob", contents)
         return [self.write_raw("blob", c) for c in contents]
 
+    def write_blobs_raw(self, contents):
+        """list[bytes] -> (n, 20) uint8 oid array — the no-hex variant of
+        write_blobs for columnar consumers (import capture, vectorized tree
+        build), which otherwise round-trip every oid through hex and back.
+        Falls back through write_blobs when raw isn't available."""
+        import numpy as np
+
+        if self._bulk_writer is not None:
+            raw = self._bulk_writer.add_batch_raw("blob", contents)
+            if raw is not None:
+                return raw
+        hexes = self.write_blobs(contents)
+        return np.frombuffer(
+            bytes.fromhex("".join(hexes)), dtype=np.uint8
+        ).reshape(-1, 20)
+
     # -- typed access ------------------------------------------------------
 
     def read_blob(self, oid) -> bytes:

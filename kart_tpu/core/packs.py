@@ -533,16 +533,52 @@ class PackWriter:
         a small deflate window for tiny payloads), so pack files are
         self-consistent but not byte-reproducible across environments —
         the same property git has across zlib versions."""
+        raw = self.add_batch_raw(obj_type, contents)
+        if raw is None:
+            return [self.add(obj_type, c) for c in contents]
+        return [bytes(r).hex() for r in raw]
+
+    def add_batch_raw(self, obj_type, contents):
+        """Like add_batch but returns oids as an (n, 20) uint8 array. The
+        whole batch is hashed, deflated, FRAMED and crc'd in one native call
+        (io_pack_records) and written with one file write per contiguous
+        run — the per-object Python (record head, crc32, stream slice,
+        tell/write/hex) measured ~6us each at import scale, paid a million
+        times per 1M-row import. None when the native core is unavailable
+        (callers fall back to add_batch's hex path)."""
         from kart_tpu import native
 
-        result = native.pack_objects_batch(obj_type, contents, self.level)
+        result = native.pack_records_batch(
+            obj_type, TYPE_CODES[obj_type], contents, self.level
+        )
         if result is None:
-            return [self.add(obj_type, c) for c in contents]
-        oids, streams = result
-        return [
-            self._append(obj_type, len(content), bytes(sha), stream)
-            for sha, content, stream in zip(oids, contents, streams)
-        ]
+            return None
+        oids, crcs, buf, offs = result
+        base = self._f.tell()
+        entries = self._entries
+        seen = self._seen
+        # records of already-seen objects are skipped: write the buffer in
+        # contiguous runs around them, shifting later offsets left
+        seg_start = 0
+        shift = 0
+        n_new = 0
+        mv = memoryview(buf)
+        for i in range(len(contents)):
+            sha = oids[i].tobytes()
+            if sha in seen:
+                lo, hi = int(offs[i]), int(offs[i + 1])
+                if lo > seg_start:
+                    self._f.write(mv[seg_start:lo])
+                shift += hi - lo
+                seg_start = hi
+                continue
+            seen[sha] = True
+            entries.append((sha, int(crcs[i]), base + int(offs[i]) - shift))
+            n_new += 1
+        if len(buf) > seg_start:
+            self._f.write(mv[seg_start:])
+        self._count += n_new
+        return oids
 
     def _append(self, obj_type, size, sha, stream):
         if sha in self._seen:

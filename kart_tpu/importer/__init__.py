@@ -308,6 +308,104 @@ class GPKGImportSource(ImportSource):
         finally:
             con.close()
 
+    def encoded_feature_batches(self, schema):
+        """Fast single-pass import stream: yields ``(pk_list, blob_list)``
+        batches with blobs bit-identical to ``schema.encode_feature_blob``
+        over ``features()`` (tested), or None when this table can't use it
+        (composite/non-int pk).
+
+        The generic path costs ~30us/feature of pure Python before any IO:
+        a name-keyed dict per row, a ``value_to_v2`` dispatch per cell, a
+        second id-keyed dict in ``encode_feature_blob``, and a strict-types
+        msgpack hook call per tuple/geometry. This streams sqlite rows in
+        schema column order and packs each blob incrementally on one reused
+        Packer (geometry goes through the single-pass canonicaliser
+        ``geometry.normalise_gpkg_bytes`` straight into ``pack_ext_type`` —
+        no ExtType objects, no value lists, no per-row tuples).
+        KART_IMPORT_FAST=0 disables."""
+        if os.environ.get("KART_IMPORT_FAST") == "0":
+            return None
+        pk_cols = schema.pk_columns
+        if len(pk_cols) != 1 or pk_cols[0].data_type != "integer":
+            return None
+        return self._encoded_batch_gen(schema)
+
+    # column handling kinds for _encoded_batch_gen's inner loop
+    _K_PLAIN, _K_GEOM, _K_BOOL, _K_FLOAT, _K_TS = range(5)
+
+    def _encoded_batch_gen(self, schema):
+        import msgpack
+
+        from kart_tpu.core.serialise import GEOMETRY_EXT_CODE
+        from kart_tpu.geometry import normalise_gpkg_bytes
+
+        kind_of = {
+            "geometry": self._K_GEOM,
+            "boolean": self._K_BOOL,
+            "float": self._K_FLOAT,
+            "timestamp": self._K_TS,
+        }
+        cols = list(schema.columns)
+        by_id = {c.id: j for j, c in enumerate(cols)}
+        # blob value order is the legend's non-pk column-id order — exactly
+        # what Legend.to_value_tuples produces in encode_feature_blob
+        non_pk = [
+            (by_id[cid], kind_of.get(cols[by_id[cid]].data_type, self._K_PLAIN))
+            for cid in schema.legend.non_pk_columns
+        ]
+        n_vals = len(non_pk)
+        pk_j = by_id[schema.legend.pk_columns[0]]
+        sel = ", ".join(gpkg_adapter.quote(c.name) for c in cols)
+        # autoreset=False: the blob is composed incrementally (array header,
+        # hash, values); with the default autoreset every pack() call would
+        # flush and clear the buffer mid-record
+        packer = msgpack.Packer(use_bin_type=True, autoreset=False)
+        legend_hash = schema.legend_hash
+        # local bindings of the class constants (fast loop lookups with one
+        # source of truth)
+        K_PLAIN, K_GEOM, K_BOOL, K_FLOAT, K_TS = (
+            self._K_PLAIN, self._K_GEOM, self._K_BOOL, self._K_FLOAT, self._K_TS,
+        )
+
+        con = sqlite3.connect(self.gpkg_path)  # tuple rows: index access
+        try:
+            cursor = con.execute(
+                f"SELECT {sel} FROM {gpkg_adapter.quote(self.table_name)}"
+            )
+            cursor.arraysize = 10000
+            while True:
+                rows = cursor.fetchmany()
+                if not rows:
+                    break
+                pks = []
+                blobs = []
+                for row in rows:
+                    packer.pack_array_header(2)
+                    packer.pack(legend_hash)
+                    packer.pack_array_header(n_vals)
+                    for j, kind in non_pk:
+                        v = row[j]
+                        if kind == K_PLAIN or v is None:
+                            packer.pack(v)
+                        elif kind == K_GEOM:
+                            packer.pack_ext_type(
+                                GEOMETRY_EXT_CODE, normalise_gpkg_bytes(v)
+                            )
+                        elif kind == K_FLOAT:
+                            packer.pack(float(v))
+                        elif kind == K_BOOL:
+                            packer.pack(bool(v))
+                        else:
+                            packer.pack(
+                                v.replace(" ", "T") if isinstance(v, str) else v
+                            )
+                    pks.append(row[pk_j])
+                    blobs.append(packer.bytes())
+                    packer.reset()
+                yield pks, blobs
+        finally:
+            con.close()
+
     def get_features(self, pks, ignore_missing=False):
         """Point reads by pk (indexed sqlite lookup, not a table scan)."""
         schema = self.schema

@@ -297,39 +297,65 @@ def _import_single_source(
     # million: pause the cyclic collector (~8% measured). Source adapters
     # may create cycles internally, so bound their growth with a manual
     # collection every ~1M rows rather than trusting full acyclicity.
+    # Fast pre-encoded stream (int-pk GPKG): the source yields whole
+    # (pk_list, blob_list) batches and oids stay columnar end-to-end — no
+    # per-feature dicts, no per-row tuples, no hex round trips (see
+    # GPKGImportSource.encoded_feature_batches).
+    fast_batches = None
+    if use_batch_paths:
+        fast = getattr(source, "encoded_feature_batches", None)
+        if fast is not None:
+            fast_batches = fast(schema)
+
     with paused_gc():
         gc_batch = 0
-        for batch in chunked(source.features(), BATCH_SIZE):
-            gc_batch += 1
-            if gc_batch % 100 == 0:
-                gc.collect()
-            encoded = [schema.encode_feature_blob(f) for f in batch]
-            oids = repo.odb.write_blobs([blob for _, blob in encoded])
-            if use_batch_paths:
-                pks = np.fromiter(
-                    (pk_values[0] for pk_values, _ in encoded),
-                    dtype=np.int64,
-                    count=len(encoded),
-                )
-                # no per-path TreeBuilder inserts: the whole feature tree is
-                # built in one vectorized pass after the stream
+        if fast_batches is not None:
+            for pk_list, blobs in fast_batches:
+                gc_batch += 1
+                if gc_batch % 100 == 0:
+                    gc.collect()
+                oids_u8 = repo.odb.write_blobs_raw(blobs)
+                pks = np.asarray(pk_list, dtype=np.int64)
                 if collect_local:
                     pk_chunks.append(pks)
-                    oid_chunks.append(bytes.fromhex("".join(oids)))
-            else:
-                rel_paths = [
-                    encoder.encode_pks_to_path(pk_values)
-                    for pk_values, _ in encoded
-                ]
-                tb.insert_many((prefix + rel for rel in rel_paths), oids)
-            if capture is not None:
+                    oid_chunks.append(oids_u8.tobytes())
+                if capture is not None:
+                    capture.add_int_raw(pks, oids_u8.tobytes())
+                count += len(pk_list)
+                if log and count % 100000 == 0:
+                    log(f"  {ds_path}: {count} features...")
+        else:
+            for batch in chunked(source.features(), BATCH_SIZE):
+                gc_batch += 1
+                if gc_batch % 100 == 0:
+                    gc.collect()
+                encoded = [schema.encode_feature_blob(f) for f in batch]
+                oids = repo.odb.write_blobs([blob for _, blob in encoded])
                 if use_batch_paths:
-                    capture.add_int_batch(pks, oids)
+                    pks = np.fromiter(
+                        (pk_values[0] for pk_values, _ in encoded),
+                        dtype=np.int64,
+                        count=len(encoded),
+                    )
+                    # no per-path TreeBuilder inserts: the whole feature tree
+                    # is built in one vectorized pass after the stream
+                    if collect_local:
+                        pk_chunks.append(pks)
+                        oid_chunks.append(bytes.fromhex("".join(oids)))
                 else:
-                    capture.add_path_batch(rel_paths, oids)
-            count += len(batch)
-            if log and count % 100000 == 0:
-                log(f"  {ds_path}: {count} features...")
+                    rel_paths = [
+                        encoder.encode_pks_to_path(pk_values)
+                        for pk_values, _ in encoded
+                    ]
+                    tb.insert_many((prefix + rel for rel in rel_paths), oids)
+                if capture is not None:
+                    if use_batch_paths:
+                        capture.add_int_batch(pks, oids)
+                    else:
+                        capture.add_path_batch(rel_paths, oids)
+                count += len(batch)
+                if log and count % 100000 == 0:
+                    log(f"  {ds_path}: {count} features...")
 
     if use_batch_paths and count:
         from kart_tpu.core.feature_tree import build_int_feature_tree

@@ -139,7 +139,7 @@ def ensure_built():
 # -- object-store IO core (native/kart_io.cpp) ------------------------------
 
 _IO_LIB_NAME = "libkart_io.so"
-_IO_ABI_VERSION = 3  # v3: io_inflate_batch
+_IO_ABI_VERSION = 4  # v4: io_pack_ptrs store_max arg (stored-stream fast path)
 
 _io_lib = None
 _io_load_attempted = False
@@ -177,8 +177,17 @@ def load_io():
         lib.io_pack_ptrs.argtypes = [
             ctypes.POINTER(ctypes.c_char_p), ctypes.c_void_p,
             ctypes.c_int64, ctypes.c_char_p, ctypes.c_int,
+            ctypes.c_int64,
             ctypes.c_void_p, ctypes.c_void_p, ctypes.c_int64,
             ctypes.c_void_p,
+        ]
+        lib.io_pack_records.restype = ctypes.c_int64
+        lib.io_pack_records.argtypes = [
+            ctypes.c_char_p, ctypes.c_void_p,
+            ctypes.c_int64, ctypes.c_char_p, ctypes.c_int, ctypes.c_int,
+            ctypes.c_int64,
+            ctypes.c_void_p, ctypes.c_void_p, ctypes.c_void_p,
+            ctypes.c_int64, ctypes.c_void_p,
         ]
         lib.io_classify_sorted.restype = ctypes.c_int64
         lib.io_classify_sorted.argtypes = [
@@ -231,6 +240,43 @@ def classify_sorted(old_keys, old_oids_u8, new_keys, new_oids_u8):
     )
 
 
+def pack_records_batch(obj_type, type_code, contents, level=1):
+    """Batch hash + deflate + pack-record framing: -> (oids (n,20) uint8,
+    crcs (n,) uint32, records np.uint8 buffer, offsets (n+1) int64) —
+    record i is ``records[offsets[i]:offsets[i+1]]``, complete with varint
+    head, ready to append to the pack stream. None when unavailable."""
+    lib = load_io()
+    if lib is None or not contents:
+        return None
+    n = len(contents)
+    try:
+        joined = b"".join(contents)  # one memcpy pass beats a per-element
+        # ctypes pointer-array conversion (~1us each)
+    except TypeError:
+        return None
+    lens = np.fromiter((len(c) for c in contents), dtype=np.int64, count=n)
+    offsets = np.zeros(n + 1, dtype=np.int64)
+    np.cumsum(lens, out=offsets[1:])
+    payload_total = len(joined)
+
+    oids = np.empty((n, 20), dtype=np.uint8)
+    crcs = np.empty(n, dtype=np.uint32)
+    # zlib worst case + stored overhead + 10-byte heads, all inside 80*n
+    cap = payload_total + payload_total // 512 + 80 * n + 1024
+    out = np.empty(cap, dtype=np.uint8)
+    out_offsets = np.empty(n + 1, dtype=np.int64)
+    total = lib.io_pack_records(
+        joined, offsets.ctypes.data, n, obj_type.encode(), int(type_code),
+        int(level), _store_max(),
+        oids.ctypes.data, crcs.ctypes.data, out.ctypes.data, cap,
+        out_offsets.ctypes.data,
+    )
+    if total < 0:
+        L.warning("native pack records failed (%d); falling back", total)
+        return None
+    return oids, crcs, out[:total], out_offsets
+
+
 def inflate_pack_batch(pack_buf, offsets, max_total=None):
     """Bulk pack reads: mmap/bytes of a whole packfile + record offsets ->
     (n_consumed, types uint8 (n_consumed,), payload uint8 array,
@@ -279,11 +325,26 @@ def inflate_pack_batch(pack_buf, offsets, max_total=None):
     return take, types, out, out_offsets
 
 
+def _store_max():
+    """Payloads at or below this many bytes are written as STORED zlib
+    streams (see kart_io.cpp io_pack_ptrs): feature blobs are ~100-150B of
+    msgpack that level-1 deflate barely shrinks but costs ~9us each on this
+    zlib. 0 disables (always deflate)."""
+    try:
+        return int(os.environ.get("KART_PACK_STORE_MAX", 256))
+    except ValueError:
+        return 256
+
+
 def pack_objects_batch(obj_type, contents, level=1):
-    """Batch hash+deflate for pack writing: obj_type str, contents
-    list[bytes] -> (oids (n,20) uint8, deflated list[bytes]) via the C++
-    core, or None when the library isn't available (callers fall back to the
-    per-object Python path with identical results).
+    """Batch hash+deflate WITHOUT record framing: obj_type str, contents
+    list[bytes] -> (oids (n,20) uint8, deflated list[bytes]), or None when
+    the library isn't available.
+
+    Production pack writing goes through :func:`pack_records_batch` (framed
+    records, one write per batch); this unframed variant remains as the
+    reference twin the native tests cross-check stream-level behavior
+    against, and for callers that need streams outside pack framing.
 
     Zero-copy: the C side reads the bytes objects' own buffers through a
     pointer array and composes the git object headers itself."""
@@ -300,12 +361,14 @@ def pack_objects_batch(obj_type, contents, level=1):
     payload_total = int(lens.sum())
 
     oids = np.empty((n, 20), dtype=np.uint8)
-    # zlib worst case ~ src + src/1000 + 12 per stream
+    # zlib worst case ~ src + src/1000 + 12 per stream; stored streams add
+    # 11 + 5 per 64KB block, covered by the same 64*n headroom
     cap = payload_total + payload_total // 512 + 64 * n + 1024
     out = np.empty(cap, dtype=np.uint8)
     out_offsets = np.empty(n + 1, dtype=np.int64)
     total = lib.io_pack_ptrs(
         ptrs, lens.ctypes.data, n, obj_type.encode(), int(level),
+        _store_max(),
         oids.ctypes.data, out.ctypes.data, cap, out_offsets.ctypes.data,
     )
     if total < 0:

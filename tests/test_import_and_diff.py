@@ -596,3 +596,95 @@ def test_import_with_epsg_only_crs_cli(tmp_path, cli_runner):
     )
     assert r.exit_code != 0
     assert "EPSG:99999" in r.output and "full WKT" in r.output
+
+
+def test_fast_import_bit_identical_to_generic(tmp_path, cli_runner):
+    """The pre-encoded GPKG import stream (encoded_feature_batches +
+    stored-stream pack records) must produce the exact same commit tree as
+    the generic per-feature path — blob bytes, oids, feature tree, all of
+    it. Mixed column types incl. NULLs, geometry with srid, bools, floats,
+    timestamps."""
+    import sqlite3
+    import struct
+
+    from kart_tpu.cli import cli
+    from kart_tpu.core.repo import KartRepo
+    from kart_tpu.crs import WGS84_WKT
+
+    gpkg = str(tmp_path / "mixed.gpkg")
+    con = sqlite3.connect(gpkg)
+    con.executescript(
+        """
+        CREATE TABLE gpkg_contents (table_name TEXT NOT NULL PRIMARY KEY,
+          data_type TEXT NOT NULL, identifier TEXT UNIQUE, description TEXT,
+          last_change DATETIME, min_x DOUBLE, min_y DOUBLE, max_x DOUBLE,
+          max_y DOUBLE, srs_id INTEGER);
+        CREATE TABLE gpkg_geometry_columns (table_name TEXT NOT NULL,
+          column_name TEXT NOT NULL, geometry_type_name TEXT NOT NULL,
+          srs_id INTEGER NOT NULL, z TINYINT NOT NULL, m TINYINT NOT NULL);
+        CREATE TABLE gpkg_spatial_ref_sys (srs_name TEXT NOT NULL,
+          srs_id INTEGER NOT NULL PRIMARY KEY, organization TEXT NOT NULL,
+          organization_coordsys_id INTEGER NOT NULL, definition TEXT NOT NULL,
+          description TEXT);
+        CREATE TABLE t (fid INTEGER PRIMARY KEY NOT NULL, geom POINT,
+          name TEXT, value REAL, flag BOOLEAN, ts DATETIME, data BLOB);
+        """
+    )
+    con.execute(
+        "INSERT INTO gpkg_spatial_ref_sys VALUES ('WGS 84',4326,'EPSG',4326,?,NULL)",
+        (WGS84_WKT,),
+    )
+    con.execute(
+        "INSERT INTO gpkg_contents (table_name,data_type,identifier,srs_id)"
+        " VALUES ('t','features','t',4326)"
+    )
+    con.execute(
+        "INSERT INTO gpkg_geometry_columns VALUES ('t','geom','POINT',4326,0,0)"
+    )
+    hdr = b"GP\x00\x01" + struct.pack("<i", 4326)
+
+    def row(i):
+        geom = (
+            None
+            if i % 7 == 0
+            else hdr + struct.pack("<BI2d", 1, 1, i * 0.37, i * 0.11)
+        )
+        return (
+            i,
+            geom,
+            None if i % 5 == 0 else f"name-{i}",
+            None if i % 4 == 0 else i / 3.0,
+            None if i % 6 == 0 else i % 2,
+            "2024-01-02 03:04:05" if i % 3 == 0 else None,
+            bytes([i & 255]) * 5 if i % 2 == 0 else None,
+        )
+
+    con.executemany(
+        "INSERT INTO t VALUES (?,?,?,?,?,?,?)", [row(i) for i in range(1, 300)]
+    )
+    con.commit()
+    con.close()
+
+    trees = {}
+    for mode, env in (("fast", {}), ("slow", {"KART_IMPORT_FAST": "0"})):
+        import os
+
+        repo_path = tmp_path / f"repo-{mode}"
+        for k, v in env.items():
+            os.environ[k] = v
+        try:
+            r = cli_runner.invoke(
+                cli, ["init", str(repo_path)], catch_exceptions=False
+            )
+            assert r.exit_code == 0, r.output
+            r = cli_runner.invoke(
+                cli,
+                ["-C", str(repo_path), "import", gpkg, "--no-checkout"],
+                catch_exceptions=False,
+            )
+            assert r.exit_code == 0, r.output
+        finally:
+            for k in env:
+                os.environ.pop(k, None)
+        trees[mode] = KartRepo(str(repo_path)).structure("HEAD").tree.oid
+    assert trees["fast"] == trees["slow"]
