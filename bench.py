@@ -254,11 +254,15 @@ def worker():
         **merge,
         **bbox,
     }
-    # the 100M section is the long tail (synth + multi-minute diffs): print
-    # the record BEFORE it so a watchdog timeout mid-100M still salvages
-    # every other number (main() keeps the last complete line), then print
-    # the augmented record when it completes
+    # the polygon and 100M sections are the long tail (synth + multi-minute
+    # diffs): print the record BEFORE each so a watchdog timeout mid-section
+    # still salvages every earlier number (main() keeps the last complete
+    # line), then print the augmented record as each completes
     print(json.dumps(record), flush=True)
+    poly = _cli_polygon_diff()
+    if poly:
+        record.update(poly)
+        print(json.dumps(record), flush=True)
     big = _cli_diff_100m()
     if big:
         record.update(big)
@@ -554,6 +558,69 @@ def _cli_diff_bench():
         }
     except Exception as e:  # pragma: no cover - bench resilience
         print(f"cli bench failed: {type(e).__name__}: {e}", file=sys.stderr)
+        return {}
+    finally:
+        if work is not None:
+            shutil.rmtree(work, ignore_errors=True)
+
+
+def _cli_polygon_diff():
+    """BASELINE config #3: 10M-row polygon layer diff with real blobs,
+    measured through `kart diff -o json-lines --output <file>` so the full
+    value-materialisation path is timed — batch pack reads + inflate, path
+    decode, WKB->hex geometry output, JSON writing (the reference's
+    equivalent loop: base_diff_writer.py:279-341). Every changed feature's
+    old AND new value is materialised. KART_BENCH_POLY_ROWS=0 disables."""
+    import shutil
+    import sys
+    import tempfile
+
+    work = None
+    try:
+        rows = int(os.environ.get("KART_BENCH_POLY_ROWS", 10_000_000))
+        if rows <= 0:
+            return {}
+        work = tempfile.mkdtemp(prefix="kart-bench-poly-")
+        from kart_tpu.synth import synth_polygon_repo
+
+        t0 = time.perf_counter()
+        _, info = synth_polygon_repo(
+            os.path.join(work, "repo"), rows, edit_frac=0.01
+        )
+        synth_s = time.perf_counter() - t0
+
+        from click.testing import CliRunner
+
+        from kart_tpu.cli import cli
+
+        sink = os.path.join(work, "out.jsonl")
+        args = [
+            "-C", os.path.join(work, "repo"), "diff", "HEAD^...HEAD",
+            "-o", "json-lines", "--output", sink,
+        ]
+        runner = CliRunner()
+        t0 = time.perf_counter()
+        r = runner.invoke(cli, args)
+        assert r.exit_code == 0, r.output
+        cold_s = time.perf_counter() - t0
+        t0 = time.perf_counter()
+        r = runner.invoke(cli, args)
+        assert r.exit_code == 0, r.output
+        warm_s = time.perf_counter() - t0
+        # updates materialise old + new values
+        n_materialised = 2 * info["n_edits"]
+        with open(sink) as f:
+            n_lines = sum(1 for _ in f)
+        assert n_lines >= info["n_edits"], (n_lines, info)
+        return {
+            "poly_rows": rows,
+            "poly_synth_seconds": round(synth_s, 1),
+            "cli_10m_polygon_diff_cold_seconds": round(cold_s, 2),
+            "cli_10m_polygon_diff_seconds": round(warm_s, 2),
+            "features_materialised_per_sec": round(n_materialised / warm_s),
+        }
+    except Exception as e:  # pragma: no cover - bench resilience
+        print(f"polygon bench failed: {type(e).__name__}: {e}", file=sys.stderr)
         return {}
     finally:
         if work is not None:

@@ -143,18 +143,138 @@ def _split_diff_args(repo, args):
         return "HEAD", args
 
 
+def _parse_log_date(value, option):
+    """Git-ish date input -> unix timestamp: ISO 8601 ('2024-01-02',
+    '2024-01-02T03:04:05+01:00'), a unix epoch ('@1700000000' or bare
+    digits), or relative '<n> <unit>[s] ago' (seconds/minutes/hours/days/
+    weeks/months/years)."""
+    import re as _re
+    import time as _time
+    from datetime import datetime, timezone
+
+    text = value.strip()
+    if text.startswith("@") and text[1:].isdigit():
+        return int(text[1:])
+    if text.isdigit() and len(text) >= 9:  # a bare epoch, not a year
+        return int(text)
+    m = _re.fullmatch(
+        r"(\d+)\s+(second|minute|hour|day|week|month|year)s?\s+ago", text
+    )
+    if m:
+        unit_s = {
+            "second": 1, "minute": 60, "hour": 3600, "day": 86400,
+            "week": 7 * 86400, "month": 30 * 86400, "year": 365 * 86400,
+        }[m.group(2)]
+        return int(_time.time()) - int(m.group(1)) * unit_s
+    try:
+        dt = datetime.fromisoformat(text)
+    except ValueError:
+        raise CliError(
+            f"Cannot parse {option} date {value!r}: use ISO 8601, a unix "
+            f"epoch, or '<n> days ago'"
+        )
+    if dt.tzinfo is None:
+        dt = dt.astimezone()  # git semantics: naive dates are local time
+    return int(dt.timestamp())
+
+
+def _effective_parents(oid, parent_map, displayed):
+    """Parents of ``oid`` remapped to the nearest DISPLAYED ancestors:
+    filtered-out commits (--grep/--since/--skip/path filters) are followed
+    through transparently so the graph never forks a lane for a commit
+    that will never be rendered."""
+    out = []
+    seen = set()
+    stack = list(parent_map.get(oid, ()))
+    while stack:
+        p = stack.pop(0)
+        if p in seen:
+            continue
+        seen.add(p)
+        if p in displayed:
+            if p not in out:
+                out.append(p)
+        else:
+            stack.extend(parent_map.get(p, ()))
+    return out
+
+
+def _graph_rows(entries, parent_map):
+    """Lane-tracking commit graph (git log --graph style): -> list of
+    (prefix_str, oid, commit) rows plus continuation rows
+    ((prefix, None, None)) for lane shuffles. Lanes hold the next expected
+    commit oid; a commit collapses every lane expecting it and forks one
+    lane per (displayed-ancestor) parent."""
+    displayed = {oid for oid, _ in entries}
+    lanes = []
+    rows = []
+    for oid, commit in entries:
+        if oid not in lanes:
+            lanes.append(oid)
+        idx = lanes.index(oid)
+        cells = ["*" if i == idx else "|" for i in range(len(lanes))]
+        rows.append((" ".join(cells), oid, commit))
+        # collapse other lanes that expected this same commit (merge point)
+        dup = [i for i, l in enumerate(lanes) if l == oid and i != idx]
+        for i in reversed(dup):
+            lanes.pop(i)
+        parents = _effective_parents(oid, parent_map, displayed)
+        if not parents:
+            lanes.pop(idx)
+        else:
+            lanes[idx] = parents[0]
+            for extra in parents[1:]:
+                if extra not in lanes:
+                    lanes.insert(idx + 1, extra)
+                    rows.append(
+                        (
+                            " ".join(
+                                "|\\"[min(i - idx, 1)] if idx <= i <= idx + 1 else "|"
+                                for i in range(len(lanes))
+                            ),
+                            None,
+                            None,
+                        )
+                    )
+    return rows
+
+
 @cli.command()
 @click.option(
     "--output-format", "-o", type=click.Choice(["text", "json", "json-lines"]), default="text"
 )
 @click.option("--oneline", is_flag=True)
 @click.option("-n", "--max-count", type=int, default=None)
+@click.option("--skip", type=int, default=None, help="Skip this many commits first")
+@click.option("--since", "--after", "since", help="Only commits after this date")
+@click.option("--until", "--before", "until", help="Only commits before this date")
+@click.option("--author", multiple=True, help="Only commits by this author (regex, repeatable)")
+@click.option("--committer", multiple=True, help="Only commits by this committer (regex, repeatable)")
+@click.option("--grep", multiple=True, help="Only commits whose message matches (regex, repeatable)")
+@click.option("--graph", is_flag=True, help="Draw an ASCII commit graph (text output)")
+@click.option("--first-parent", is_flag=True, help="Follow only first parents at merges")
+@click.option(
+    "--with-dataset-changes",
+    "dataset_changes",
+    is_flag=True,
+    help="List the datasets changed by each commit",
+)
 @click.option("--json-style", type=click.Choice(["extracompact", "compact", "pretty"]), default="pretty")
 @click.argument("refish", required=False, default="HEAD")
 @click.argument("filters", nargs=-1)
 @click.pass_obj
-def log(ctx, output_format, oneline, max_count, json_style, refish, filters):
-    """Show the commit log."""
+def log(
+    ctx, output_format, oneline, max_count, skip, since, until, author,
+    committer, grep, graph, first_parent, dataset_changes, json_style,
+    refish, filters,
+):
+    """Show the commit log.
+
+    FILTERS restrict output to commits touching the given datasets or
+    features ('mylayer', 'mylayer:feature:123'), matching the reference's
+    pathspec behavior (/root/reference/kart/log.py parse_extra_args)."""
+    import re as _re
+
     from kart_tpu.core.repo import NotFound
     from kart_tpu.diff.engine import get_repo_diff
     from kart_tpu.diff.key_filters import RepoKeyFilter
@@ -163,34 +283,86 @@ def log(ctx, output_format, oneline, max_count, json_style, refish, filters):
     try:
         start, _ = repo.resolve_refish(refish)
     except NotFound:
-        if refish != "HEAD":
-            raise CliError(f"No such revision: {refish}")
         start = None
+        if refish != "HEAD":
+            # reference behavior (log.py get_arg_type): an arg that doesn't
+            # resolve as a commit-ish is a path filter — but only when it
+            # actually names a dataset, so a typo'd branch still errors
+            # instead of silently printing an empty history
+            ds_part = refish.split(":", 1)[0]
+            try:
+                start, _ = repo.resolve_refish("HEAD")
+                known = set(repo.structure("HEAD").datasets.paths())
+            except NotFound:
+                known = set()
+            if ds_part not in known:
+                raise CliError(f"No such revision or dataset: {refish}")
+            filters = (refish,) + tuple(filters)
     if start is None:
         return
 
+    since_ts = _parse_log_date(since, "--since") if since else None
+    until_ts = _parse_log_date(until, "--until") if until else None
+    author_res = [_re.compile(a) for a in author]
+    committer_res = [_re.compile(c) for c in committer]
+    grep_res = [_re.compile(g) for g in grep]
+
     key_filter = RepoKeyFilter.build_from_user_patterns(filters)
 
+    def _touched_datasets(oid, commit):
+        parent = commit.parents[0] if commit.parents else None
+        diff = get_repo_diff(
+            repo.structure(parent) if parent else None,
+            repo.structure(oid),
+            repo_key_filter=key_filter,
+        )
+        return sorted(diff.keys()) if diff else []
+
     entries = []
+    parent_map = {}  # every walked commit, for graph lane remapping
     count = 0
-    for oid, commit in repo.walk_commits(start):
+    skipped = 0
+    for oid, commit in repo.walk_commits(start, first_parent=first_parent):
+        parent_map[oid] = (
+            commit.parents[:1] if first_parent else commit.parents
+        )
         if max_count is not None and count >= max_count:
             break
+        when = commit.committer.time
+        if until_ts is not None and when > until_ts:
+            continue
+        if since_ts is not None and when < since_ts:
+            continue
+        sig = f"{commit.author.name} <{commit.author.email}>"
+        if author_res and not any(r.search(sig) for r in author_res):
+            continue
+        csig = f"{commit.committer.name} <{commit.committer.email}>"
+        if committer_res and not any(r.search(csig) for r in committer_res):
+            continue
+        if grep_res and not any(r.search(commit.message) for r in grep_res):
+            continue
+        changed = None
         if not key_filter.match_all:
-            # filter by datasets touched in this commit
-            parent = commit.parents[0] if commit.parents else None
-            diff = get_repo_diff(
-                repo.structure(parent) if parent else None,
-                repo.structure(oid),
-                repo_key_filter=key_filter,
-            )
-            if not diff:
+            changed = _touched_datasets(oid, commit)
+            if not changed:
                 continue
-        entries.append((oid, commit))
+        if skip is not None and skipped < skip:
+            skipped += 1
+            continue
+        if dataset_changes and changed is None:
+            # only for commits actually displayed — a full repo diff per
+            # commit is too expensive to spend on skipped ones
+            changed = _touched_datasets(oid, commit)
+        entries.append((oid, commit, changed))
         count += 1
 
     if output_format in ("json", "json-lines"):
-        out = [_commit_json(oid, c) for oid, c in entries]
+        out = []
+        for oid, c, changed in entries:
+            item = _commit_json(oid, c)
+            if dataset_changes:
+                item["datasetChanges"] = changed
+            out.append(item)
         if output_format == "json":
             dump_json_output(out, "-", json_style=json_style)
         else:
@@ -201,9 +373,23 @@ def log(ctx, output_format, oneline, max_count, json_style, refish, filters):
                 sys.stdout.write("\n")
         return
 
-    for oid, commit in entries:
+    if graph:
+        rows = _graph_rows([(oid, c) for oid, c, _ in entries], parent_map)
+        changed_by_oid = {oid: ch for oid, _, ch in entries}
+        for prefix, oid, commit in rows:
+            if oid is None:
+                click.echo(prefix)
+            else:
+                suffix = ""
+                if dataset_changes and changed_by_oid.get(oid):
+                    suffix = f"  ({', '.join(changed_by_oid[oid])})"
+                click.echo(f"{prefix} {oid[:7]} {commit.message_summary}{suffix}")
+        return
+
+    for oid, commit, changed in entries:
         if oneline:
-            click.echo(f"{oid[:7]} {commit.message_summary}")
+            suffix = f"  ({', '.join(changed)})" if dataset_changes and changed else ""
+            click.echo(f"{oid[:7]} {commit.message_summary}{suffix}")
         else:
             from datetime import datetime, timedelta, timezone
 
@@ -212,6 +398,8 @@ def log(ctx, output_format, oneline, max_count, json_style, refish, filters):
             click.secho(f"commit {oid}", fg="yellow")
             click.echo(f"Author: {commit.author.name} <{commit.author.email}>")
             click.echo(f"Date:   {when.strftime('%a %b %d %H:%M:%S %Y %z')}")
+            if dataset_changes and changed:
+                click.echo(f"Datasets: {', '.join(changed)}")
             click.echo()
             for line in commit.message.splitlines():
                 click.echo(f"    {line}")

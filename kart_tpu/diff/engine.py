@@ -77,13 +77,15 @@ def get_feature_diff(base_ds, target_ds, ds_filter=None):
         key = pks[0] if len(pks) == 1 else pks
         if feature_filter is not None and key not in feature_filter:
             continue
+        # values resolve by the oid the tree diff already produced — no
+        # second path->tree walk at materialisation time
         old = (
-            KeyValue((key, base_ds.get_feature_promise(pks)))
+            KeyValue((key, base_ds.get_feature_promise_from_oid(pks, old_oid)))
             if old_oid is not None
             else None
         )
         new = (
-            KeyValue((key, target_ds.get_feature_promise(pks)))
+            KeyValue((key, target_ds.get_feature_promise_from_oid(pks, new_oid)))
             if new_oid is not None
             else None
         )
@@ -165,17 +167,37 @@ def get_feature_diff_columnar(base_ds, target_ds, ds_filter=None, *, blocks=None
                 if fn not in new_changed_filenames:
                     return get_feature_diff(base_ds, target_ds, ds_filter)
 
+    # values resolve by oid straight from the sidecar columns — no
+    # per-feature path->tree walk at materialisation time (measured ~500us
+    # per feature at 10M-polygon scale, dominated by uncached parse_tree)
+    from kart_tpu.ops.blocks import unpack_oid_hex
+
+    new_row_by_key = {int(new_block.keys[i]): int(i) for i in new_idx}
+
+    def _oid_hex(block, i):
+        return unpack_oid_hex(block.oids[i : i + 1])[0]
+
     for i in old_idx:
         pks = _pks_for_index(old_block, base_ds, int(i))
         key = pks[0] if len(pks) == 1 else pks
         if feature_filter is not None and key not in feature_filter:
             continue
         cls = old_class[i]
-        old_kv = KeyValue((key, base_ds.get_feature_promise(pks)))
+        old_kv = KeyValue(
+            (key, base_ds.get_feature_promise_from_oid(pks, _oid_hex(old_block, i)))
+        )
         if cls == DELETE:
             result.add_delta(Delta.delete(old_kv))
         else:  # UPDATE — new side added below keyed identically
-            new_kv = KeyValue((key, target_ds.get_feature_promise(pks)))
+            j = new_row_by_key.get(int(old_block.keys[i]))
+            new_kv = KeyValue(
+                (
+                    key,
+                    target_ds.get_feature_promise_from_oid(pks, _oid_hex(new_block, j))
+                    if j is not None
+                    else target_ds.get_feature_promise(pks),
+                )
+            )
             result.add_delta(Delta.update(old_kv, new_kv))
     for i in new_idx:
         if new_class[i] != INSERT:
@@ -184,7 +206,13 @@ def get_feature_diff_columnar(base_ds, target_ds, ds_filter=None, *, blocks=None
         key = pks[0] if len(pks) == 1 else pks
         if feature_filter is not None and key not in feature_filter:
             continue
-        result.add_delta(Delta.insert(KeyValue((key, target_ds.get_feature_promise(pks)))))
+        result.add_delta(
+            Delta.insert(
+                KeyValue(
+                    (key, target_ds.get_feature_promise_from_oid(pks, _oid_hex(new_block, int(i))))
+                )
+            )
+        )
     return result
 
 

@@ -294,6 +294,23 @@ class Dataset3:
         """-> zero-arg callable that reads the feature lazily."""
         return functools.partial(self.get_feature, pk_values, path=path)
 
+    def get_feature_from_oid(self, pk_values, oid_hex):
+        """Feature dict resolved straight from its blob oid. The diff
+        engines already know each changed feature's oid (tree-diff entries /
+        sidecar columns), so the per-feature path->tree walk — a parse_tree
+        per directory level, measured ~500us per materialised feature at
+        10M-polygon scale — is skipped entirely. Tri-state semantics are
+        unchanged: a promised blob raises ObjectPromised from the odb read
+        exactly as the path walk would."""
+        tree = self.feature_tree
+        odb = tree.odb if tree is not None else self.repo.odb
+        return self.get_feature(pk_values, data=odb.read_blob(oid_hex))
+
+    def get_feature_promise_from_oid(self, pk_values, oid_hex):
+        """-> zero-arg callable; like get_feature_promise but resolves via
+        the known blob oid instead of the feature path."""
+        return functools.partial(self.get_feature_from_oid, pk_values, oid_hex)
+
     def features(self, spatial_filter=None, log_progress=False, skip_promised=False):
         """Stream all features (schema order). Bulk columnar access should
         prefer feature_index + feature_blob_batch.

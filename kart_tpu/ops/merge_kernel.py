@@ -92,6 +92,28 @@ def merge_classify(ancestor_block, ours_block, theirs_block):
     Union keys are computed host-side (cheap, sorted inputs) and padded to a
     bucket so jit shapes are reused.
     """
+    from kart_tpu.parallel.sharded_diff import should_shard
+
+    n_max = max(ancestor_block.count, ours_block.count, theirs_block.count)
+    if should_shard(n_max):
+        # >1 device: shard-local 3-way classify over the mesh (block-cyclic
+        # PK partition; only the count vector crosses ICI)
+        from kart_tpu.parallel.sharded_merge import sharded_merge_classify
+
+        try:
+            return sharded_merge_classify(
+                ancestor_block, ours_block, theirs_block
+            )
+        except Exception as e:
+            import logging
+
+            logging.getLogger("kart_tpu.parallel").warning(
+                "mesh-sharded merge classify failed (%s: %s); using "
+                "single-chip path",
+                type(e).__name__,
+                e,
+            )
+
     a_real = ancestor_block.keys[: ancestor_block.count]
     o_real = ours_block.keys[: ours_block.count]
     t_real = theirs_block.keys[: theirs_block.count]

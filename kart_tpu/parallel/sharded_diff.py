@@ -183,7 +183,7 @@ def sharded_diff_step(mesh, old_block, new_block):
 # observability: how many times the mesh path actually ran this process
 # (dryrun_multichip and tests assert on it — the single-chip path silently
 # taking over would otherwise be invisible)
-STATS = {"sharded_classify_calls": 0}
+STATS = {"sharded_classify_calls": 0, "sharded_merge_calls": 0}
 
 # below this row count the mesh round trip loses to the single-device kernel
 # (partition + per-shard padding overhead); tied to the device dispatch

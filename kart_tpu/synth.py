@@ -155,3 +155,179 @@ def synth_repo(path, n, *, edit_frac=0.01, seed=0, blobs="promised", ds_path="sy
         "n": n,
         "n_edits": n_edits,
     }
+
+
+# -- polygon layer (BASELINE config #3) -------------------------------------
+
+POLY_SCHEMA = Schema(
+    [
+        ColumnSchema(
+            id="b1b2c3d4-0001-4000-8000-000000000001",
+            name="fid",
+            data_type="integer",
+            pk_index=0,
+            extra_type_info={"size": 64},
+        ),
+        ColumnSchema(
+            id="b1b2c3d4-0002-4000-8000-000000000002",
+            name="geom",
+            data_type="geometry",
+            pk_index=None,
+            extra_type_info={
+                "geometryType": "POLYGON",
+                "geometryCRS": "EPSG:4326",
+            },
+        ),
+        ColumnSchema(
+            id="b1b2c3d4-0003-4000-8000-000000000003",
+            name="rating",
+            data_type="float",
+            pk_index=None,
+            extra_type_info={"size": 64},
+        ),
+    ]
+)
+
+
+def _poly_blob_template():
+    """One real encoded polygon feature blob + the byte offsets of its
+    variable fields. Every synthetic polygon blob has the same fixed layout
+    (5-point ring, one ring, XY envelope), so the 10M-blob build is a
+    columnar fill of a tiled template instead of 10M per-feature encodes.
+    Offsets are derived structurally and asserted against the template, so
+    a format change breaks loudly here rather than corrupting blobs."""
+    import struct
+
+    from kart_tpu.geometry import Geometry
+
+    x0, y0, d = 10.0, 20.0, 0.001
+    ring = [(x0, y0), (x0 + d, y0), (x0 + d, y0 + d), (x0, y0 + d), (x0, y0)]
+    wkb = (
+        struct.pack("<BIII", 1, 3, 1, len(ring))
+        + b"".join(struct.pack("<2d", *p) for p in ring)
+    )
+    _, blob = POLY_SCHEMA.encode_feature_blob(
+        {"fid": 1, "geom": Geometry.from_wkb(wkb), "rating": 1.5}
+    )
+    # msgpack layout: 0x92, str8(40-char legend hash), 0x92,
+    # ext8(type G, 133B geometry), 0xcb + float64 rating
+    geom_off = 1 + 2 + 40 + 1 + 3
+    env_off = geom_off + 8  # GPKG header: magic+ver+flags+srid
+    coords_off = env_off + 32 + 13  # envelope, then wkb head (1+4+4+4)
+    rating_off = coords_off + 80 + 1  # 10 ring doubles, 0xcb marker
+    assert blob[0] == 0x92 and blob[geom_off - 3] == 0xC7
+    assert blob[geom_off : geom_off + 2] == b"GP"
+    assert blob[rating_off - 1] == 0xCB
+    assert len(blob) == rating_off + 8
+    assert struct.unpack_from("<d", blob, env_off)[0] == x0  # minx
+    assert struct.unpack_from("<d", blob, coords_off)[0] == x0
+    assert struct.unpack_from(">d", blob, rating_off)[0] == 1.5
+    return np.frombuffer(blob, dtype=np.uint8), env_off, coords_off, rating_off
+
+
+def _poly_xy(pks):
+    """Deterministic polygon origins spread over the globe."""
+    x0 = (pks % 35900) / 100.0 - 179.5
+    y0 = ((pks // 359) % 16800) / 100.0 - 84.0
+    return x0.astype(np.float64), y0.astype(np.float64)
+
+
+def _write_poly_blobs(odb, pks, rating, chunk=1_000_000):
+    """Vectorized polygon blob build + batch pack write; -> (n, 20) oids."""
+    tmpl, env_off, coords_off, rating_off = _poly_blob_template()
+    d = 0.001
+    out = np.empty((len(pks), 20), dtype=np.uint8)
+
+    def put(mat, off, values, dtype):
+        mat[:, off : off + 8] = (
+            np.ascontiguousarray(values, dtype=dtype)
+            .view(np.uint8)
+            .reshape(len(values), 8)
+        )
+
+    for i in range(0, len(pks), chunk):
+        sl = slice(i, min(i + chunk, len(pks)))
+        x0, y0 = _poly_xy(pks[sl])
+        x1, y1 = x0 + d, y0 + d
+        m = len(x0)
+        mat = np.tile(tmpl, (m, 1))
+        # envelope: minx, maxx, miny, maxy (LE doubles)
+        for k, v in enumerate((x0, x1, y0, y1)):
+            put(mat, env_off + 8 * k, v, "<f8")
+        # ring: (x0,y0) (x1,y0) (x1,y1) (x0,y1) (x0,y0) (LE doubles)
+        ring = (x0, y0, x1, y0, x1, y1, x0, y1, x0, y0)
+        for k, v in enumerate(ring):
+            put(mat, coords_off + 8 * k, v, "<f8")
+        put(mat, rating_off, rating[sl], ">f8")  # msgpack float64 is BE
+        contents = [row.tobytes() for row in mat]
+        out[sl] = odb.write_blobs_raw(contents)
+    return out
+
+
+def synth_polygon_repo(path, n, *, edit_frac=0.01, seed=0, ds_path="polys"):
+    """BASELINE config #3 scaffolding: a repo with one polygon dataset of
+    ``n`` features (real blobs — the value-materialisation path must read,
+    inflate and decode them) and two commits: base + an ``edit_frac``
+    rating rewrite. -> (repo, info dict)."""
+    from kart_tpu.core.repo import KartRepo
+    from kart_tpu.crs import WGS84_WKT
+    from kart_tpu.diff import sidecar
+    from kart_tpu.models.dataset import Dataset3
+
+    repo = KartRepo.init_repository(path)
+    repo.config.set_many(
+        {"user.name": "Synth", "user.email": "synth@example.com"}
+    )
+    odb = repo.odb
+
+    base = 1 << 24
+    pks = np.arange(base, base + n, dtype=np.int64)
+    with odb.bulk_pack(level=0):
+        oids1 = _write_poly_blobs(odb, pks, pks / 2.0)
+
+    n_edits = max(1, int(n * edit_frac)) if edit_frac else 0
+    rng = np.random.default_rng(seed + 1)
+    edit_rows = (
+        np.sort(rng.choice(n, size=n_edits, replace=False))
+        if n_edits
+        else np.zeros(0, np.int64)
+    )
+    oids2 = oids1.copy()
+    if n_edits:
+        with odb.bulk_pack(level=0):
+            oids2[edit_rows] = _write_poly_blobs(
+                odb, pks[edit_rows], pks[edit_rows].astype(np.float64)
+            )
+
+    plan = plan_int_feature_tree(pks)
+    commits = []
+    prev = None
+    for oids_u8, message in ((oids1, "polygon import"), (oids2, "polygon edits")):
+        with odb.bulk_pack(level=0):
+            ftree, leaf_oids = emit_feature_tree(odb, plan, oids_u8, prev=prev)
+            prev = (leaf_oids, edit_rows)
+            tb = TreeBuilder(odb, repo.head_tree_oid if commits else None)
+            for blob_path, data in Dataset3.new_dataset_meta_blobs(
+                ds_path,
+                POLY_SCHEMA,
+                title="synthetic polygon layer",
+                crs_defs={"EPSG:4326": WGS84_WKT},
+                path_encoder=PathEncoder.INT_PK_ENCODER,
+            ):
+                tb.insert(blob_path, odb.write_blob(data))
+            tb.insert(
+                f"{ds_path}/{Dataset3.DATASET_DIRNAME}/feature", ftree, mode=MODE_TREE
+            )
+            root = tb.flush()
+        commit_oid = repo.create_commit(
+            "HEAD", root, message, [commits[-1]] if commits else []
+        )
+        commits.append(commit_oid)
+        sidecar.save_sidecar(repo, ftree, pks, oids_u8)
+
+    return repo, {
+        "base_commit": commits[0],
+        "edit_commit": commits[1],
+        "n": n,
+        "n_edits": n_edits,
+    }

@@ -500,3 +500,144 @@ def test_reference_e2e_flow(tmp_path, runner, monkeypatch):
     assert r.exit_code == 0, r.output
     r = runner.invoke(cli, ["log", "--oneline"])
     assert "merge-1" in r.output.splitlines()[0]
+
+
+class TestLogOptions:
+    """Reference log option surface (/root/reference/kart/log.py): date,
+    author, grep, skip filters, --graph, --with-dataset-changes."""
+
+    @pytest.fixture
+    def multi_commit_repo(self, repo_dir, runner):
+        """repo_dir + two more commits (an edit and a second layer)."""
+        from helpers import edit_commit
+        from kart_tpu.core.repo import KartRepo
+
+        repo = KartRepo(str(repo_dir))
+        edit_commit(repo, "points", updates=[{"fid": 1, "geom": None, "name": "edited-1", "rating": 0.5}],
+                    message="edit point 1")
+        gpkg2 = create_points_gpkg(str(repo_dir.parent / "l2.gpkg"), n=3)
+        import shutil, sqlite3
+
+        con = sqlite3.connect(gpkg2)
+        con.execute("UPDATE gpkg_contents SET table_name='second' WHERE 1")
+        try:
+            con.execute("ALTER TABLE points RENAME TO second")
+            con.execute("UPDATE gpkg_geometry_columns SET table_name='second'")
+            con.commit()
+        finally:
+            con.close()
+        r = runner.invoke(cli, ["import", str(gpkg2), "--no-checkout"])
+        assert r.exit_code == 0, r.output
+        return repo_dir
+
+    def test_skip_and_max_count(self, multi_commit_repo, runner):
+        r = runner.invoke(cli, ["log", "--oneline"])
+        assert r.exit_code == 0, r.output
+        all_lines = r.output.strip().splitlines()
+        assert len(all_lines) == 3
+        r = runner.invoke(cli, ["log", "--oneline", "--skip", "1", "-n", "1"])
+        assert r.exit_code == 0, r.output
+        assert r.output.strip().splitlines() == [all_lines[1]]
+
+    def test_grep_and_author(self, multi_commit_repo, runner):
+        r = runner.invoke(cli, ["log", "--oneline", "--grep", "edit point"])
+        assert r.exit_code == 0, r.output
+        assert len(r.output.strip().splitlines()) == 1
+        r = runner.invoke(cli, ["log", "--oneline", "--author", "Nobody"])
+        assert r.exit_code == 0, r.output
+        assert r.output.strip() == ""
+        r = runner.invoke(cli, ["log", "--oneline", "--author", "Tester"])
+        assert len(r.output.strip().splitlines()) == 3
+
+    def test_since_until(self, multi_commit_repo, runner):
+        r = runner.invoke(cli, ["log", "--oneline", "--since", "2000-01-01"])
+        assert r.exit_code == 0, r.output
+        assert len(r.output.strip().splitlines()) == 3
+        r = runner.invoke(cli, ["log", "--oneline", "--until", "2000-01-01"])
+        assert r.exit_code == 0, r.output
+        assert r.output.strip() == ""
+        r = runner.invoke(cli, ["log", "--oneline", "--since", "1 day ago"])
+        assert len(r.output.strip().splitlines()) == 3
+        r = runner.invoke(cli, ["log", "--oneline", "--since", "not-a-date"])
+        assert r.exit_code != 0
+        assert "Cannot parse" in r.output
+
+    def test_dataset_filter_and_changes(self, multi_commit_repo, runner):
+        # pathspec filter: only commits touching 'second'
+        r = runner.invoke(cli, ["log", "--oneline", "second"])
+        assert r.exit_code == 0, r.output
+        assert len(r.output.strip().splitlines()) == 1
+        # feature-level filter: only commits touching points:feature:1
+        r = runner.invoke(cli, ["log", "--oneline", "points:feature:1"])
+        assert r.exit_code == 0, r.output
+        assert len(r.output.strip().splitlines()) == 2  # import + edit
+        # dataset changes listing
+        r = runner.invoke(
+            cli, ["log", "-o", "json", "--with-dataset-changes", "-n", "1"]
+        )
+        assert r.exit_code == 0, r.output
+        item = json.loads(r.output)[0]
+        assert item["datasetChanges"] == ["second"]
+
+    def test_graph_linear(self, multi_commit_repo, runner):
+        r = runner.invoke(cli, ["log", "--graph"])
+        assert r.exit_code == 0, r.output
+        lines = r.output.strip().splitlines()
+        assert len(lines) == 3
+        assert all(line.startswith("* ") for line in lines)
+
+    def test_graph_merge(self, multi_commit_repo, runner):
+        from kart_tpu.core.repo import KartRepo
+
+        r = runner.invoke(cli, ["branch", "side", "HEAD^"])
+        assert r.exit_code == 0, r.output
+        r = runner.invoke(cli, ["checkout", "side"])
+        assert r.exit_code == 0, r.output
+        from helpers import edit_commit
+
+        edit_commit(KartRepo("."), "points", updates=[{"fid": 2, "geom": None, "name": "side-2", "rating": 0.25}],
+                    message="side edit")
+        r = runner.invoke(cli, ["checkout", "main"])
+        assert r.exit_code == 0, r.output
+        r = runner.invoke(cli, ["merge", "side", "-m", "merge side"])
+        assert r.exit_code == 0, r.output
+        r = runner.invoke(cli, ["log", "--graph"])
+        assert r.exit_code == 0, r.output
+        out = r.output
+        assert "\\" in out  # fork row after the merge commit
+        stars = [l for l in out.splitlines() if "*" in l]
+        assert len(stars) == 5  # import, edit, second, side edit, merge
+        # first-parent walk hides the side branch
+        r = runner.invoke(cli, ["log", "--oneline", "--first-parent"])
+        assert r.exit_code == 0, r.output
+        assert all("side edit" not in l for l in r.output.splitlines())
+
+
+class TestLogGraphFiltered:
+    def test_graph_with_filtered_commits_no_phantom_lanes(self, repo_dir, runner):
+        """Filtered-out commits must not leave dangling lanes (review r4):
+        with a --grep that hides the middle commit, the graph stays one
+        column wide."""
+        from helpers import edit_commit
+        from kart_tpu.core.repo import KartRepo
+
+        repo = KartRepo(str(repo_dir))
+        edit_commit(repo, "points",
+                    updates=[{"fid": 1, "geom": None, "name": "mid", "rating": 0.5}],
+                    message="middle edit")
+        edit_commit(repo, "points",
+                    updates=[{"fid": 2, "geom": None, "name": "top", "rating": 0.5}],
+                    message="top edit")
+        r = runner.invoke(cli, ["log", "--graph", "--grep", "edit|Import|import"])
+        assert r.exit_code == 0, r.output
+        r = runner.invoke(cli, ["log", "--graph", "--grep", "top|mport"])
+        assert r.exit_code == 0, r.output
+        lines = [l for l in r.output.splitlines() if l.strip()]
+        assert len(lines) == 2
+        # single column: no phantom '|' from the hidden middle commit
+        assert all(l.startswith("* ") and " | " not in l for l in lines)
+
+    def test_typod_revision_still_errors(self, repo_dir, runner):
+        r = runner.invoke(cli, ["log", "mybrnch"])
+        assert r.exit_code != 0
+        assert "No such revision or dataset" in r.output

@@ -154,3 +154,82 @@ def test_synthetic_block_deterministic():
     b = synthetic_block(100, seed=1)
     assert np.array_equal(a.oids, b.oids)
     assert a.count == 100
+
+
+def _merge_blocks(n=3000, seed=9):
+    """(ancestor, ours, theirs) with a known mix of edits/conflicts."""
+    from kart_tpu.parallel.sharded_diff import synthetic_block
+
+    anc = synthetic_block(n, seed=seed)
+    ours = synthetic_block(n, seed=seed)
+    ours.oids = ours.oids.copy()
+    theirs = synthetic_block(n, seed=seed)
+    theirs.oids = theirs.oids.copy()
+    rng = np.random.default_rng(seed + 1)
+    both = rng.choice(n, size=n // 10, replace=False)  # conflicts
+    ours_only = rng.choice(n, size=n // 7, replace=False)
+    theirs_only = rng.choice(n, size=n // 5, replace=False)
+    ours.oids[both, 0] ^= 1
+    theirs.oids[both, 0] ^= 2
+    ours.oids[ours_only, 1] ^= 3
+    theirs.oids[theirs_only, 2] ^= 4
+    return anc, ours, theirs
+
+
+def test_sharded_merge_matches_single_chip(monkeypatch):
+    """sharded_merge_classify must reproduce merge_classify exactly: same
+    global union order, decisions, presence bits, stats."""
+    from kart_tpu.ops.merge_kernel import merge_classify
+    from kart_tpu.parallel.sharded_diff import STATS
+    from kart_tpu.parallel.sharded_merge import sharded_merge_classify
+
+    if jax.device_count() < 2:
+        pytest.skip("needs >= 2 devices")
+    anc, ours, theirs = _merge_blocks()
+    monkeypatch.setenv("KART_DIFF_SHARDED", "0")  # single-chip baseline
+    union_s, dec_s, pres_s, stats_s = merge_classify(anc, ours, theirs)
+    before = STATS["sharded_merge_calls"]
+    union_m, dec_m, pres_m, stats_m = sharded_merge_classify(anc, ours, theirs)
+    assert STATS["sharded_merge_calls"] == before + 1
+    np.testing.assert_array_equal(union_m, union_s)
+    np.testing.assert_array_equal(dec_m, dec_s)
+    np.testing.assert_array_equal(pres_m, pres_s)
+    assert stats_m == stats_s
+    assert stats_m["conflicts"] > 0
+
+
+def test_merge_classify_routes_through_mesh(monkeypatch):
+    """KART_DIFF_SHARDED=1 routes merge_classify itself onto the mesh."""
+    from kart_tpu.ops.merge_kernel import merge_classify
+    from kart_tpu.parallel.sharded_diff import STATS
+
+    if jax.device_count() < 2:
+        pytest.skip("needs >= 2 devices")
+    anc, ours, theirs = _merge_blocks(n=1500, seed=4)
+    monkeypatch.setenv("KART_DIFF_SHARDED", "0")
+    expected = merge_classify(anc, ours, theirs)
+    monkeypatch.setenv("KART_DIFF_SHARDED", "1")
+    before = STATS["sharded_merge_calls"]
+    got = merge_classify(anc, ours, theirs)
+    assert STATS["sharded_merge_calls"] == before + 1
+    for a, b in zip(got[:3], expected[:3]):
+        np.testing.assert_array_equal(a, b)
+    assert got[3] == expected[3]
+
+
+def test_estimation_routes_through_mesh(monkeypatch):
+    """Device-sharded estimation rides the mesh when forced, matching the
+    single-chip estimate."""
+    from kart_tpu.diff.estimation import estimate_counts_from_blocks
+    from kart_tpu.parallel.sharded_diff import STATS, synthetic_block
+
+    if jax.device_count() < 2:
+        pytest.skip("needs >= 2 devices")
+    old, new, expected = _blocks_with_edits(n=4096, n_ins=11, n_upd=37, n_del=13)
+    monkeypatch.setenv("KART_DIFF_SHARDED", "0")
+    single = estimate_counts_from_blocks(old, new, "good")
+    monkeypatch.setenv("KART_DIFF_SHARDED", "1")
+    before = STATS["sharded_classify_calls"]
+    sharded = estimate_counts_from_blocks(old, new, "good")
+    assert STATS["sharded_classify_calls"] > before
+    assert sharded == single
