@@ -1,0 +1,403 @@
+"""Mechanical SQL dialect validation for the server-DB working copies.
+
+VERDICT r3 weak #5: the golden-SQL snapshots prove *stability*, not that
+the emitted DDL/DML is valid in its dialect — a syntactically invalid
+trigger body would pass. No live servers and no sqlglot exist in this
+environment, so this is a purpose-built checker that fails on the defect
+classes a wrong-dialect emission actually produces:
+
+* lexical errors: unterminated strings/comments/quotes, quoting syntax the
+  dialect doesn't have (backticks outside MySQL, ``[brackets]`` outside
+  T-SQL, ``$tag$`` bodies outside PostgreSQL, double-quoted *identifiers*
+  in MySQL — where ``"x"`` is a string literal by default and silently
+  changes meaning);
+* unbalanced parens/brackets inside a statement;
+* parameter-marker style mismatches (``%s`` is the psycopg/pymysql style,
+  ``?`` is pyodbc's — each driver rejects the other's);
+* statement heads the dialect has no grammar for (``REPLACE INTO`` outside
+  MySQL, ``ON CONFLICT`` outside PostgreSQL, ``MERGE``/``IF``/``EXEC``
+  preambles outside T-SQL, ...);
+* column type names from the wrong dialect's type system;
+* trigger scaffolding missing the dialect's mandatory clauses
+  (PG: FOR EACH ROW + EXECUTE PROCEDURE/FUNCTION; MySQL: timing + event +
+  FOR EACH ROW; T-SQL: ON <table> AFTER ... AS).
+
+It is NOT a full SQL parser; expression-level nonsense can still slip
+through. Every check it does make is backed by a poison test
+(tests/test_sql_dialects.py) proving it fails on the wrong dialect's
+output and on seeded syntax errors.
+"""
+
+import re
+
+PG = "postgres"
+MYSQL = "mysql"
+MSSQL = "tsql"
+
+
+class SqlDialectError(ValueError):
+    pass
+
+
+def _err(dialect, msg, context=""):
+    ctx = f" near {context[:60]!r}" if context else ""
+    raise SqlDialectError(f"[{dialect}] {msg}{ctx}")
+
+
+WORD_RE = re.compile(r"[A-Za-z_][A-Za-z0-9_$#]*")
+NUM_RE = re.compile(r"\d+(\.\d+)?")
+DOLLAR_TAG_RE = re.compile(r"\$[A-Za-z_]*\$")
+
+
+def tokenize(sql, dialect):
+    """-> list of (kind, text) tokens. kind in: word, string, ident, num,
+    param, punct. Raises SqlDialectError on lexical errors for the
+    dialect."""
+    out = []
+    i = 0
+    n = len(sql)
+    while i < n:
+        c = sql[i]
+        if c.isspace():
+            i += 1
+            continue
+        if sql.startswith("--", i):
+            j = sql.find("\n", i)
+            i = n if j == -1 else j + 1
+            continue
+        if sql.startswith("/*", i):
+            j = sql.find("*/", i + 2)
+            if j == -1:
+                _err(dialect, "unterminated block comment", sql[i:])
+            i = j + 2
+            continue
+        if c == "'":
+            j = i + 1
+            while True:
+                if j >= n:
+                    _err(dialect, "unterminated string literal", sql[i:])
+                if sql[j] == "\\" and dialect == MYSQL and j + 1 < n:
+                    j += 2
+                    continue
+                if sql[j] == "'":
+                    if j + 1 < n and sql[j + 1] == "'":
+                        j += 2
+                        continue
+                    break
+                j += 1
+            out.append(("string", sql[i : j + 1]))
+            i = j + 1
+            continue
+        if c == '"':
+            if dialect == MYSQL:
+                # without ANSI_QUOTES, MySQL reads "x" as a STRING — an
+                # emitted double-quoted identifier silently changes meaning
+                _err(
+                    dialect,
+                    'double-quoted identifier (MySQL treats "x" as a '
+                    "string literal; use backticks)",
+                    sql[i:],
+                )
+            j = i + 1
+            while True:
+                if j >= n:
+                    _err(dialect, "unterminated quoted identifier", sql[i:])
+                if sql[j] == '"':
+                    if j + 1 < n and sql[j + 1] == '"':
+                        j += 2
+                        continue
+                    break
+                j += 1
+            out.append(("ident", sql[i : j + 1]))
+            i = j + 1
+            continue
+        if c == "`":
+            if dialect != MYSQL:
+                _err(dialect, "backtick identifier outside MySQL", sql[i:])
+            j = sql.find("`", i + 1)
+            if j == -1:
+                _err(dialect, "unterminated backtick identifier", sql[i:])
+            out.append(("ident", sql[i : j + 1]))
+            i = j + 1
+            continue
+        if c == "[":
+            if dialect == MSSQL:
+                j = sql.find("]", i + 1)
+                if j == -1:
+                    _err(dialect, "unterminated [identifier]", sql[i:])
+                out.append(("ident", sql[i : j + 1]))
+                i = j + 1
+                continue
+            out.append(("punct", c))
+            i += 1
+            continue
+        if c == "$":
+            m = DOLLAR_TAG_RE.match(sql, i)
+            if m:
+                if dialect != PG:
+                    _err(dialect, "dollar-quoted body outside PostgreSQL", sql[i:])
+                tag = m.group(0)
+                j = sql.find(tag, m.end())
+                if j == -1:
+                    _err(dialect, f"unterminated {tag} body", sql[i:])
+                out.append(("string", sql[i : j + len(tag)]))
+                i = j + len(tag)
+                continue
+            if dialect == PG and i + 1 < n and sql[i + 1].isdigit():
+                j = i + 1
+                while j < n and sql[j].isdigit():
+                    j += 1
+                out.append(("param", sql[i:j]))
+                i = j
+                continue
+            _err(dialect, "stray '$'", sql[i:])
+        if sql.startswith("%s", i):
+            if dialect == MSSQL:
+                _err(dialect, "'%s' parameter (pyodbc uses '?')", sql[i:])
+            out.append(("param", "%s"))
+            i += 2
+            continue
+        if c == "?":
+            if dialect != MSSQL:
+                _err(
+                    dialect,
+                    "'?' parameter (psycopg/pymysql use '%s')",
+                    sql[i:],
+                )
+            out.append(("param", "?"))
+            i += 1
+            continue
+        m = WORD_RE.match(sql, i)
+        if m:
+            out.append(("word", m.group(0)))
+            i = m.end()
+            continue
+        m = NUM_RE.match(sql, i)
+        if m:
+            out.append(("num", m.group(0)))
+            i = m.end()
+            continue
+        out.append(("punct", c))
+        i += 1
+    return out
+
+
+def split_statements(tokens, dialect):
+    """Top-level ';' split. BEGIN...END blocks (trigger/procedure bodies in
+    MySQL and T-SQL) keep their internal semicolons inside one statement."""
+    stmts = []
+    cur = []
+    depth = 0
+    begin_depth = 0
+    for kind, text in tokens:
+        up = text.upper() if kind == "word" else text
+        if kind == "punct" and text == "(":
+            depth += 1
+        elif kind == "punct" and text == ")":
+            depth -= 1
+            if depth < 0:
+                _err(dialect, "unbalanced ')'")
+        elif kind == "word" and up == "BEGIN":
+            begin_depth += 1
+        elif kind == "word" and up == "END":
+            if begin_depth > 0:
+                begin_depth -= 1
+        if kind == "punct" and text == ";" and depth == 0 and begin_depth == 0:
+            if cur:
+                stmts.append(cur)
+                cur = []
+            continue
+        cur.append((kind, text))
+    if depth != 0:
+        _err(dialect, "unbalanced '(' at end of input")
+    if cur:
+        stmts.append(cur)
+    return stmts
+
+
+# statement-head grammars: regex over the leading WORD tokens (uppercased)
+_COMMON_HEADS = [
+    r"CREATE TABLE",
+    r"CREATE (UNIQUE )?INDEX",
+    r"CREATE SCHEMA",
+    r"DROP (TABLE|TRIGGER|INDEX|SCHEMA|FUNCTION|VIEW)",
+    r"INSERT INTO",
+    r"UPDATE",
+    r"DELETE FROM",
+    r"SELECT",
+    r"ALTER TABLE",
+    r"TRUNCATE",
+]
+HEADS = {
+    PG: _COMMON_HEADS
+    + [
+        r"CREATE (OR REPLACE )?FUNCTION",
+        r"CREATE TRIGGER",
+        r"COMMENT ON",
+        r"VACUUM",
+    ],
+    MYSQL: _COMMON_HEADS
+    + [
+        r"CREATE DATABASE",
+        r"CREATE TRIGGER",
+        r"CREATE (OR REPLACE )?SPATIAL REFERENCE SYSTEM",
+        r"REPLACE INTO",
+        r"SET",
+        r"DROP DATABASE",
+    ],
+    MSSQL: _COMMON_HEADS
+    + [
+        r"CREATE TRIGGER",
+        r"IF",
+        r"EXEC",
+        r"DECLARE",
+        r"MERGE",
+        r"SET",
+    ],
+}
+
+# tokens that only exist in some OTHER dialect's grammar / type system
+POISON_WORDS = {
+    PG: {
+        "NVARCHAR", "DATETIME2", "DATETIMEOFFSET", "VARBINARY", "LONGTEXT",
+        "LONGBLOB", "AUTO_INCREMENT", "TINYINT",
+    },
+    MYSQL: {
+        "BYTEA", "TIMESTAMPTZ", "BIGSERIAL", "SERIAL", "NVARCHAR",
+        "DATETIME2", "DATETIMEOFFSET", "PLPGSQL",
+    },
+    MSSQL: {
+        "BYTEA", "TIMESTAMPTZ", "BIGSERIAL", "SERIAL", "AUTO_INCREMENT",
+        "LONGTEXT", "LONGBLOB", "BOOLEAN", "PLPGSQL",
+    },
+}
+POISON_PHRASES = {
+    PG: [r"\bREPLACE INTO\b", r"\bON DUPLICATE KEY\b"],
+    MYSQL: [r"\bON CONFLICT\b", r"\bRETURNS TRIGGER\b", r"::"],
+    MSSQL: [r"\bON CONFLICT\b", r"\bREPLACE INTO\b", r"\bFOR EACH ROW\b"],
+}
+
+# column-spec type whitelists (the "column specs" golden section); each
+# entry is a regex matched against the full type expression
+TYPE_SPECS = {
+    PG: [
+        r"BIGSERIAL", r"SERIAL", r"BIGINT", r"INTEGER", r"SMALLINT",
+        r"GEOMETRY\([A-Z]+,\d+\)", r"GEOMETRY", r"BOOLEAN", r"BYTEA",
+        r"DATE", r"REAL", r"DOUBLE PRECISION", r"NUMERIC(\(\d+,\d+\))?",
+        r"TEXT", r"VARCHAR\(\d+\)", r"TIME", r"TIMESTAMPTZ", r"TIMESTAMP",
+    ],
+    MYSQL: [
+        r"BIGINT( AUTO_INCREMENT)?", r"INT", r"SMALLINT", r"TINYINT",
+        r"(GEOMETRY|POINT|LINESTRING|POLYGON|MULTIPOINT|MULTILINESTRING|"
+        r"MULTIPOLYGON|GEOMETRYCOLLECTION)( SRID \d+)?",
+        r"BIT", r"LONGBLOB", r"DATE", r"FLOAT", r"DOUBLE( PRECISION)?",
+        r"NUMERIC(\(\d+,\d+\))?", r"LONGTEXT", r"VARCHAR\(\d+\)", r"TIME",
+        r"TIMESTAMP", r"DATETIME",
+    ],
+    MSSQL: [
+        r"BIGINT", r"INT", r"SMALLINT", r"TINYINT",
+        r"GEOMETRY( CHECK\(.*\))*", r"BIT", r"VARBINARY\((max|\d+)\)",
+        r"DATE", r"REAL", r"FLOAT", r"NUMERIC(\(\d+,\d+\))?",
+        r"NVARCHAR\((max|\d+)\)", r"TIME", r"DATETIMEOFFSET", r"DATETIME2",
+    ],
+}
+
+
+def _head_words(stmt_tokens, limit=5):
+    words = []
+    for kind, text in stmt_tokens:
+        if kind == "word":
+            words.append(text.upper())
+        else:
+            break
+        if len(words) >= limit:
+            break
+    return " ".join(words)
+
+
+def _stmt_text(stmt_tokens):
+    return " ".join(t for _, t in stmt_tokens)
+
+
+def check_statement(stmt_tokens, dialect):
+    head = _head_words(stmt_tokens)
+    if not head:
+        _err(dialect, "statement does not start with a keyword",
+             _stmt_text(stmt_tokens))
+    if not any(re.match(h, head) for h in HEADS[dialect]):
+        _err(dialect, f"statement head {head.split()[0]!r} not in the "
+             f"{dialect} grammar", _stmt_text(stmt_tokens))
+
+    upper_words = {t.upper() for k, t in stmt_tokens if k == "word"}
+    bad = upper_words & POISON_WORDS[dialect]
+    if bad:
+        _err(dialect, f"foreign-dialect token(s) {sorted(bad)}",
+             _stmt_text(stmt_tokens))
+    joined = " ".join(
+        (t.upper() if k == "word" else t) for k, t in stmt_tokens
+    )
+    for phrase in POISON_PHRASES[dialect]:
+        if re.search(phrase, joined):
+            _err(dialect, f"foreign-dialect construct /{phrase}/",
+                 _stmt_text(stmt_tokens))
+
+    # trigger scaffolding
+    if re.match(r"CREATE TRIGGER", head):
+        if dialect == PG:
+            if "FOR EACH ROW" not in joined and "FOR EACH STATEMENT" not in joined:
+                _err(dialect, "PG trigger without FOR EACH ROW/STATEMENT", joined)
+            if not re.search(r"EXECUTE (PROCEDURE|FUNCTION)", joined):
+                _err(dialect, "PG trigger without EXECUTE PROCEDURE/FUNCTION", joined)
+        elif dialect == MYSQL:
+            if not re.search(r"(BEFORE|AFTER) (INSERT|UPDATE|DELETE) ON", joined):
+                _err(dialect, "MySQL trigger without timing+event", joined)
+            if "FOR EACH ROW" not in joined:
+                _err(dialect, "MySQL trigger without FOR EACH ROW", joined)
+        elif dialect == MSSQL:
+            if not re.search(r"ON .* (AFTER|INSTEAD OF) ", joined):
+                _err(dialect, "T-SQL trigger without ON ... AFTER/INSTEAD OF", joined)
+            if " AS " not in joined:
+                _err(dialect, "T-SQL trigger without AS body", joined)
+    if dialect == PG and re.match(r"CREATE (OR REPLACE )?FUNCTION", head):
+        if re.search(r"RETURNS TRIGGER", joined) and "LANGUAGE" not in upper_words:
+            _err(dialect, "PG trigger function without LANGUAGE clause", joined)
+
+
+def check_column_spec(line, dialect):
+    """One 'IDENT TYPE...' column-spec line."""
+    tokens = tokenize(line, dialect)
+    if not tokens or tokens[0][0] != "ident":
+        _err(dialect, "column spec must start with a quoted identifier", line)
+    rest = tokens[1:]
+    # reassemble the type expression, normalising space around punctuation
+    type_expr = re.sub(
+        r"\s*([(),.])\s*", r"\1", " ".join(t for _, t in rest)
+    ).strip()
+    for spec in TYPE_SPECS[dialect]:
+        if re.fullmatch(spec, type_expr, flags=re.IGNORECASE):
+            return
+    _err(dialect, f"type {type_expr!r} is not a {dialect} column type", line)
+
+
+def check_sql(sql, dialect):
+    """Validate a stream of statements; raises SqlDialectError."""
+    tokens = tokenize(sql, dialect)
+    for stmt in split_statements(tokens, dialect):
+        check_statement(stmt, dialect)
+
+
+def check_golden_file(text, dialect):
+    """Validate a golden working-copy SQL file (sectioned format)."""
+    section = None
+    sql_lines = []
+    for line in text.splitlines():
+        if line.startswith("-- "):
+            section = line[3:]
+            continue
+        if not line.strip():
+            continue
+        if section and section.startswith("column specs"):
+            check_column_spec(line, dialect)
+        else:
+            sql_lines.append(line)
+    check_sql("\n".join(sql_lines), dialect)
