@@ -98,10 +98,18 @@ class ImportSource:
             from kart_tpu.importer.postgres import PostgresImportSource
 
             return PostgresImportSource.open_all(spec, table=table)
+        if spec.startswith("mysql://"):
+            from kart_tpu.importer.mysql import MySqlImportSource
+
+            return MySqlImportSource.open_all(spec, table=table)
+        if spec.startswith(("mssql://", "sqlserver://")):
+            from kart_tpu.importer.sqlserver import SqlServerImportSource
+
+            return SqlServerImportSource.open_all(spec, table=table)
         raise ImportSourceError(
             f"Don't know how to import {spec!r} — supported: .gpkg, .shp, "
             f".zip (shapefile), .geojson, .geojsonl/.ndjson, .csv, "
-            f"postgresql://"
+            f"postgresql://, mysql://, mssql://"
         )
 
 

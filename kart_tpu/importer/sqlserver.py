@@ -1,0 +1,221 @@
+"""SQL Server import source (reference: kart/sqlalchemy_import_source.py —
+there via SQLAlchemy; here plain pyodbc streaming fetchmany batches).
+
+Driver-gated like the server working copies: ``_connect`` raises a clear
+NotFound when pyodbc is missing. Spec format:
+
+    mssql://HOST[:PORT]/DBNAME[/DBSCHEMA[/TABLE]]
+
+With no table, every table in the schema (default ``dbo``) that has a
+primary key is imported. SQL Server stores no CRS definitions (only SRIDs
+on values), so imported geometry columns carry an EPSG identifier without
+a WKT body, same as the working copy (reference: sqlserver adapter notes).
+"""
+
+from urllib.parse import unquote, urlsplit
+
+from kart_tpu.adapters.sqlserver import SqlServerAdapter
+from kart_tpu.core.repo import NotFound
+from kart_tpu.importer import ImportSource, ImportSourceError
+from kart_tpu.models.schema import ColumnSchema, Schema
+
+BATCH_SIZE = 10_000
+
+
+def _connect(host, port, dbname, user, password):
+    try:
+        import pyodbc
+    except ImportError:
+        raise NotFound(
+            "SQL Server imports require the pyodbc driver, which is not "
+            "installed in this environment."
+        )
+    server = f"{host},{port}" if port else host
+    parts = [
+        "DRIVER={ODBC Driver 17 for SQL Server}",
+        f"SERVER={server}",
+        f"DATABASE={dbname}",
+    ]
+    if user:
+        parts.append(f"UID={user}")
+        parts.append(f"PWD={password or ''}")
+    else:
+        parts.append("Trusted_Connection=yes")
+    return pyodbc.connect(";".join(parts))
+
+
+class SqlServerImportSource(ImportSource):
+    def __init__(self, url_parts, db_schema, table_name, dest_path=None):
+        self.url_parts = url_parts  # (host, port, dbname, user, password)
+        self.db_schema = db_schema
+        self.table_name = table_name
+        self.dest_path = dest_path or table_name
+        self._schema = None
+
+    @classmethod
+    def parse_spec(cls, spec):
+        url = urlsplit(spec)
+        parts = [unquote(p) for p in url.path.split("/") if p]
+        if not parts:
+            raise ImportSourceError(
+                "Expecting mssql://HOST[:PORT]/DBNAME[/DBSCHEMA[/TABLE]]"
+            )
+        dbname = parts[0]
+        db_schema = parts[1] if len(parts) > 1 else "dbo"
+        table = parts[2] if len(parts) > 2 else None
+        conn_parts = (
+            url.hostname,
+            url.port,
+            dbname,
+            unquote(url.username) if url.username else None,
+            unquote(url.password) if url.password else None,
+        )
+        return conn_parts, db_schema, table
+
+    @classmethod
+    def open_all(cls, spec, table=None):
+        conn_parts, db_schema, spec_table = cls.parse_spec(spec)
+        table = table or spec_table
+        if table is not None:
+            return [cls(conn_parts, db_schema, table)]
+        con = _connect(*conn_parts)
+        try:
+            cur = con.cursor()
+            cur.execute(
+                """
+                SELECT DISTINCT TC.table_name
+                FROM information_schema.table_constraints TC
+                WHERE TC.constraint_type = 'PRIMARY KEY'
+                AND TC.table_schema = ?
+                ORDER BY TC.table_name
+                """,
+                (db_schema,),
+            )
+            tables = [row[0] for row in cur.fetchall()]
+        finally:
+            con.close()
+        if not tables:
+            raise ImportSourceError(
+                f"No tables with primary keys found in schema {db_schema!r}"
+            )
+        return [cls(conn_parts, db_schema, t) for t in tables]
+
+    # -- schema ---------------------------------------------------------------
+
+    def _load_schema(self):
+        if self._schema is not None:
+            return
+        con = _connect(*self.url_parts)
+        try:
+            cur = con.cursor()
+            cur.execute(
+                """
+                SELECT C.column_name, C.data_type,
+                       C.character_maximum_length, C.numeric_precision,
+                       C.numeric_scale, PK.ordinal_position
+                FROM information_schema.columns C
+                LEFT OUTER JOIN (
+                    SELECT KCU.table_schema, KCU.table_name, KCU.column_name,
+                           KCU.ordinal_position
+                    FROM information_schema.key_column_usage KCU
+                    INNER JOIN information_schema.table_constraints TC
+                    ON KCU.constraint_schema = TC.constraint_schema
+                    AND KCU.constraint_name = TC.constraint_name
+                    WHERE TC.constraint_type = 'PRIMARY KEY'
+                ) PK ON PK.table_schema = C.table_schema
+                    AND PK.table_name = C.table_name
+                    AND PK.column_name = C.column_name
+                WHERE C.table_schema = ? AND C.table_name = ?
+                ORDER BY C.ordinal_position
+                """,
+                (self.db_schema, self.table_name),
+            )
+            cols = []
+            for (name, data_type, char_len, num_prec, num_scale,
+                 pk_pos) in cur.fetchall():
+                pk_index = pk_pos - 1 if pk_pos is not None else None
+                sql_type = (data_type or "").upper()
+                if sql_type in ("GEOMETRY", "GEOGRAPHY"):
+                    data_type_v2, extra = "geometry", {}
+                else:
+                    if (
+                        sql_type in ("NVARCHAR", "VARCHAR", "NCHAR", "CHAR")
+                        and char_len
+                        and char_len > 0
+                    ):
+                        sql_type = f"{sql_type}({char_len})"
+                    elif sql_type in ("NUMERIC", "DECIMAL") and num_prec:
+                        sql_type = (
+                            f"NUMERIC({num_prec},{num_scale})"
+                            if num_scale
+                            else f"NUMERIC({num_prec})"
+                        )
+                    data_type_v2, extra = SqlServerAdapter.sql_type_to_v2(
+                        sql_type
+                    )
+                cols.append(
+                    ColumnSchema(
+                        ColumnSchema.deterministic_id(
+                            self.table_name, name, data_type_v2
+                        ),
+                        name,
+                        data_type_v2,
+                        pk_index,
+                        extra,
+                    )
+                )
+            if not cols:
+                raise ImportSourceError(
+                    f"No such table: {self.db_schema}.{self.table_name}"
+                )
+            self._schema = Schema(cols)
+        finally:
+            con.close()
+
+    @property
+    def schema(self) -> Schema:
+        self._load_schema()
+        return self._schema
+
+    def crs_definitions(self):
+        return {}  # SQL Server stores no CRS definitions, only SRIDs
+
+    # -- features -------------------------------------------------------------
+
+    @property
+    def feature_count(self):
+        con = _connect(*self.url_parts)
+        try:
+            cur = con.cursor()
+            cur.execute(
+                f"SELECT count(*) FROM "
+                f"{SqlServerAdapter.quote_table(self.table_name, self.db_schema)}"
+            )
+            return cur.fetchone()[0]
+        finally:
+            con.close()
+
+    def features(self):
+        schema = self.schema
+        con = _connect(*self.url_parts)
+        try:
+            select_cols = ", ".join(
+                SqlServerAdapter.select_expression(c) for c in schema.columns
+            )
+            cur = con.cursor()
+            cur.execute(
+                f"SELECT {select_cols} FROM "
+                f"{SqlServerAdapter.quote_table(self.table_name, self.db_schema)}"
+            )
+            names = [c.name for c in schema.columns]
+            while True:
+                rows = cur.fetchmany(BATCH_SIZE)
+                if not rows:
+                    break
+                for row in rows:
+                    yield {
+                        name: SqlServerAdapter.value_to_v2(value, col)
+                        for name, value, col in zip(names, row, schema.columns)
+                    }
+        finally:
+            con.close()
