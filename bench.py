@@ -119,9 +119,14 @@ def main():
     # budget than an interactive CLI (r3 post-mortem: the 75 s CLI default
     # burned the whole round's TPU evidence) — scale it with the bench
     # timeout unless the operator pinned it explicitly.
+    # budget: generous enough to catch a slow-not-wedged PJRT init (r2's
+    # real init was 0.092s; a cold tunnel can take minutes), small enough
+    # that a truly wedged tunnel leaves the CPU fallback most of the
+    # driver's patience (attempt1 300s + reprobe 120s + attempt2 180s+20s
+    # backoff ~= 10 min worst case before the fallback starts)
     env = dict(os.environ)
     if "KART_JAX_INIT_TIMEOUT" not in env:
-        env["KART_JAX_INIT_TIMEOUT"] = str(min(600, max(120, timeout_s // 4)))
+        env["KART_JAX_INIT_TIMEOUT"] = str(min(300, max(120, timeout_s // 8)))
     line, rc = run_worker(env)
     if line:
         print(line)
