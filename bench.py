@@ -238,6 +238,7 @@ def worker():
     cli = _cli_diff_bench()
     merge = _merge_bench()
     bbox = _bbox_bench()
+    est = _estimation_bench()
 
     record = {
         "metric": "features_diffed_per_sec_10M_attr_diff",
@@ -258,6 +259,7 @@ def worker():
         **cli,
         **merge,
         **bbox,
+        **est,
     }
     # the polygon and 100M sections are the long tail (synth + multi-minute
     # diffs): print the record BEFORE each so a watchdog timeout mid-section
@@ -567,6 +569,43 @@ def _cli_diff_bench():
     finally:
         if work is not None:
             shutil.rmtree(work, ignore_errors=True)
+
+
+def _estimation_bench():
+    """Sampled diff estimation (SURVEY §2.3 sampled reduction; the r3
+    device-sharded estimation feature): estimate vs exact on a 10M-row
+    block pair, timed. Returns {} on any failure."""
+    import sys
+
+    try:
+        rows = int(os.environ.get("KART_BENCH_EST_ROWS", 10_000_000))
+        if rows <= 0:
+            return {}
+        import numpy as np
+
+        from kart_tpu.diff.estimation import estimate_counts_from_blocks
+        from kart_tpu.parallel.sharded_diff import synthetic_block
+
+        old = synthetic_block(rows, seed=3)
+        new = synthetic_block(rows, seed=3)
+        new.oids = new.oids.copy()
+        idx = np.arange(11, rows, 100)
+        new.oids[idx, 0] ^= 1
+        exact = len(idx)
+
+        estimate_counts_from_blocks(old, new, "medium")  # warm/compile
+        t0 = time.perf_counter()
+        est = estimate_counts_from_blocks(old, new, "medium")
+        est_s = time.perf_counter() - t0
+        err_pct = abs(est - exact) / exact * 100.0
+        return {
+            "estimation_rows": rows,
+            "estimation_seconds": round(est_s, 3),
+            "estimation_error_pct": round(err_pct, 2),
+        }
+    except Exception as e:  # pragma: no cover - bench resilience
+        print(f"estimation bench failed: {type(e).__name__}: {e}", file=sys.stderr)
+        return {}
 
 
 def _cli_polygon_diff():
