@@ -496,3 +496,17 @@ class TestMixedGeometryBoundaryTouch:
             "polys": [],
         }
         assert not _geom_intersects_polygon_set(feat_out, parts)
+
+
+def test_spatial_filter_spec_with_registry_epsg_code():
+    """A filter spec whose CRS is a bare registry EPSG code (not in the
+    curated _WELL_KNOWN WKTs) resolves through kart_tpu/epsg.py: the
+    polygon is given in OSGB eastings/northings and must reproject to a
+    lon/lat envelope near Greenwich."""
+    spec = ResolvedSpatialFilterSpec.from_spec_string(
+        "EPSG:27700;POLYGON((530000 180000, 532000 180000, "
+        "532000 182000, 530000 182000, 530000 180000))"
+    )
+    w, s, e, n = spec.envelope_wsen_4326
+    assert -0.3 < w < e < 0.1  # around Greenwich
+    assert 51.4 < s < n < 51.7
