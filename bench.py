@@ -217,6 +217,15 @@ def worker():
     # above is a far *stricter* baseline than the reference's loop.
     ref_rate = _reference_loop_rate(b_old, b_new, min(base_n, 300_000))
 
+    # --- the production HOST engine (native C++ merge-join): what the cost
+    # model actually routes CPU deployments to — so even a CPU-fallback
+    # record carries the real production-vs-reference win
+    from kart_tpu.ops.diff_kernel import classify_blocks_host
+
+    t0 = time.perf_counter()
+    classify_blocks_host(b_old, b_new)
+    host_rate = base_n / (time.perf_counter() - t0)
+
     # --- device path
     args, n_changed = _device_args(n)
     jax.block_until_ready(args)
@@ -256,6 +265,8 @@ def worker():
         "backend_init_seconds": info["init_seconds"],
         "numpy_twin_rate": round(cpu_rate),
         "reference_loop_rate": round(ref_rate),
+        "host_native_rate": round(host_rate),
+        "host_native_vs_reference": round(host_rate / ref_rate, 1),
         **cli,
         **merge,
         **bbox,
