@@ -10,7 +10,11 @@ the merge of per-shard unions) and results are reassembled into the global
 sorted-union order the single-chip ``merge_classify`` contract promises.
 
 Expressed with ``shard_map`` over the shared 1-D Mesh so the same program
-runs on a real slice or the driver's virtual CPU mesh.
+runs on a real slice or the driver's virtual CPU mesh. (Reference analog:
+the per-feature 3-way rules of kart/merge_util.py applied via libgit2's
+tree merge — here the whole key space classifies at once, SPMD over the
+feature axis, the same fan-out shape as the reference's N-process import,
+kart/fast_import.py:286-399.)
 """
 
 import functools
