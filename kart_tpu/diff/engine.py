@@ -24,12 +24,50 @@ from kart_tpu.diff.structs import (
 )
 
 
+def _native_tree_diff_rows(odb, tree_oid_a, tree_oid_b):
+    """Differing entries of two tree objects via the C merge-walk, or None
+    (lib unavailable / non-tree object) — the Python path below parses
+    every entry of both trees into objects when ~99% are equal at 1%-edit
+    scale (measured ~6s of a 1M-row tree-engine diff)."""
+    from kart_tpu import native
+
+    try:
+        type_a, content_a = odb.read_raw(tree_oid_a)
+        type_b, content_b = odb.read_raw(tree_oid_b)
+    except Exception:
+        return None
+    if type_a != "tree" or type_b != "tree":
+        return None
+    return native.tree_diff_raw(content_a, content_b)
+
+
 def tree_diff_entries(odb, tree_oid_a, tree_oid_b, prefix=""):
     """Yield (path, old_entry_oid, new_entry_oid) for each *blob* that differs
     between two trees (either side may be None). Subtrees with equal oids are
     skipped wholesale — the git tree-diff contract the whole design leans on."""
     if tree_oid_a == tree_oid_b:
         return
+    if tree_oid_a is not None and tree_oid_b is not None:
+        rows = _native_tree_diff_rows(odb, tree_oid_a, tree_oid_b)
+        if rows is not None:
+            for name, oid_a, oid_b, a_is_tree, b_is_tree in sorted(
+                rows, key=lambda r: r[0]
+            ):
+                path = f"{prefix}{name}"
+                if a_is_tree or b_is_tree:
+                    yield from tree_diff_entries(
+                        odb,
+                        oid_a if a_is_tree else None,
+                        oid_b if b_is_tree else None,
+                        path + "/",
+                    )
+                    if oid_a is not None and not a_is_tree:
+                        yield path, oid_a, None
+                    if oid_b is not None and not b_is_tree:
+                        yield path, None, oid_b
+                else:
+                    yield path, oid_a, oid_b
+            return
     entries_a = {e.name: e for e in odb.read_tree_entries(tree_oid_a)} if tree_oid_a else {}
     entries_b = {e.name: e for e in odb.read_tree_entries(tree_oid_b)} if tree_oid_b else {}
     for name in sorted(entries_a.keys() | entries_b.keys()):

@@ -139,7 +139,7 @@ def ensure_built():
 # -- object-store IO core (native/kart_io.cpp) ------------------------------
 
 _IO_LIB_NAME = "libkart_io.so"
-_IO_ABI_VERSION = 4  # v4: io_pack_ptrs store_max arg (stored-stream fast path)
+_IO_ABI_VERSION = 5  # v5: io_tree_diff
 
 _io_lib = None
 _io_load_attempted = False
@@ -195,6 +195,12 @@ def load_io():
             ctypes.c_void_p, ctypes.c_void_p, ctypes.c_int64,
             ctypes.c_void_p, ctypes.c_void_p, ctypes.c_void_p,
         ]
+        lib.io_tree_diff.restype = ctypes.c_int64
+        lib.io_tree_diff.argtypes = [
+            ctypes.c_char_p, ctypes.c_int64,
+            ctypes.c_char_p, ctypes.c_int64,
+            ctypes.c_void_p, ctypes.c_int64,
+        ]
         lib.io_inflate_batch.restype = ctypes.c_int64
         lib.io_inflate_batch.argtypes = [
             ctypes.c_void_p, ctypes.c_int64, ctypes.c_void_p,
@@ -238,6 +244,43 @@ def classify_sorted(old_keys, old_oids_u8, new_keys, new_oids_u8):
             "deletes": int(counts[2]),
         },
     )
+
+
+def tree_diff_raw(a_content, b_content):
+    """Raw git tree payloads -> list of differing entries
+    ``(name, oid_a_hex|None, oid_b_hex|None, a_is_tree, b_is_tree)``, or
+    None when the lib is unavailable / input malformed (callers fall back
+    to the parse-both-trees Python path with identical results — tested).
+    Only the differing entries are materialised: at 1%-edit scale ~99% of
+    a touched tree's entries are equal, and the Python path paid per-entry
+    object + hex costs for all of them."""
+    lib = load_io()
+    if lib is None:
+        return None
+    # worst case: every entry one-sided — each output record (43 + name)
+    # bytes against (27 + name) input bytes, so 2x input covers it
+    cap = 2 * (len(a_content) + len(b_content)) + 64
+    out = np.empty(cap, dtype=np.uint8)
+    total = lib.io_tree_diff(
+        a_content, len(a_content), b_content, len(b_content),
+        out.ctypes.data, cap,
+    )
+    if total < 0:
+        return None
+    result = []
+    buf = out[:total].tobytes()
+    i = 0
+    while i < total:
+        flags = buf[i]
+        name_len = buf[i + 1] | (buf[i + 2] << 8)
+        j = i + 3
+        name = buf[j : j + name_len].decode("utf8")
+        j += name_len
+        oid_a = buf[j : j + 20].hex() if flags & 1 else None
+        oid_b = buf[j + 20 : j + 40].hex() if flags & 2 else None
+        result.append((name, oid_a, oid_b, bool(flags & 4), bool(flags & 8)))
+        i = j + 40
+    return result
 
 
 def pack_records_batch(obj_type, type_code, contents, level=1):

@@ -371,7 +371,7 @@ done:
 
 extern "C" {
 
-int io_abi_version() { return 4; }  // v4: io_pack_ptrs store_max arg
+int io_abi_version() { return 5; }  // v5: io_tree_diff
 
 // Zero-copy variant: payloads stay in the caller's buffers (an array of
 // pointers — CPython bytes objects expose theirs directly), and the git
@@ -423,6 +423,92 @@ int64_t io_pack_records(const uint8_t* base, const int64_t* offsets,
                      store_max, type_code, oids_out, crcs_out, out, out_cap,
                      out_offsets);
 }
+
+// Two-tree structural diff over raw git tree payloads: emits only the
+// entries that DIFFER between the two trees. The Python tree-diff engine
+// previously parsed every touched tree into per-entry objects (hex oids,
+// decoded names) only to find that at 1%-edit scale ~99% of entries are
+// equal — measured ~6s of a 1M-row tree-engine diff. Entries within a git
+// tree are sorted by git's canonical order (names compare as if trees end
+// in '/'), so a single merge-walk suffices.
+//
+// Output records, packed into out: u8 flags (1 = present in A, 2 = present
+// in B, 4 = A is tree, 8 = B is tree), u16 LE name length, name bytes,
+// 20B oid A (zero when absent), 20B oid B (zero when absent).
+// Returns bytes written, -1 if out_cap too small, -2 on malformed input.
+namespace treediff {
+
+struct Entry {
+    const uint8_t* name;
+    size_t name_len;
+    const uint8_t* oid;
+    bool is_tree;
+};
+
+// parse the next entry starting at *i; false at end; throws -2 via ok flag
+inline bool next_entry(const uint8_t* buf, int64_t len, int64_t* i,
+                       Entry* e, bool* ok) {
+    if (*i >= len) return false;
+    int64_t j = *i;
+    // mode (octal digits) up to space
+    int64_t sp = j;
+    while (sp < len && buf[sp] != ' ') sp++;
+    if (sp >= len || sp == j || sp - j > 7) { *ok = false; return false; }
+    bool is_tree = (sp - j == 5) && buf[j] == '4';  // "40000"
+    int64_t nul = sp + 1;
+    while (nul < len && buf[nul] != 0) nul++;
+    if (nul >= len || len - nul < 21) { *ok = false; return false; }
+    e->name = buf + sp + 1;
+    e->name_len = size_t(nul - sp - 1);
+    e->oid = buf + nul + 1;
+    e->is_tree = is_tree;
+    *i = nul + 21;
+    return true;
+}
+
+// git canonical order: names compare as if trees end in '/'
+inline int cmp(const Entry& a, const Entry& b) {
+    size_t n = a.name_len < b.name_len ? a.name_len : b.name_len;
+    int c = std::memcmp(a.name, b.name, n);
+    if (c != 0) return c;
+    // equal prefix: virtual '/' suffix for trees
+    uint8_t ca = a.name_len > n ? a.name[n] : (a.is_tree ? '/' : 0);
+    uint8_t cb = b.name_len > n ? b.name[n] : (b.is_tree ? '/' : 0);
+    if (a.name_len == n && b.name_len == n) {
+        // both exhausted: compare the virtual suffix only
+        ca = a.is_tree ? '/' : 0;
+        cb = b.is_tree ? '/' : 0;
+        return int(ca) - int(cb);
+    }
+    if (a.name_len == n) return int(a.is_tree ? '/' : 0) - int(b.name[n]);
+    if (b.name_len == n) return int(a.name[n]) - int(b.is_tree ? '/' : 0);
+    return 0;
+}
+
+inline int64_t emit(uint8_t* out, int64_t out_cap, int64_t pos,
+                    const Entry* a, const Entry* b) {
+    const Entry* named = a ? a : b;
+    int64_t need = 1 + 2 + int64_t(named->name_len) + 20 + 20;
+    if (out_cap - pos < need) return -1;
+    uint8_t flags = 0;
+    if (a) flags |= 1;
+    if (b) flags |= 2;
+    if (a && a->is_tree) flags |= 4;
+    if (b && b->is_tree) flags |= 8;
+    uint8_t* p = out + pos;
+    *p++ = flags;
+    *p++ = uint8_t(named->name_len & 0xFF);
+    *p++ = uint8_t((named->name_len >> 8) & 0xFF);
+    std::memcpy(p, named->name, named->name_len);
+    p += named->name_len;
+    if (a) std::memcpy(p, a->oid, 20); else std::memset(p, 0, 20);
+    p += 20;
+    if (b) std::memcpy(p, b->oid, 20); else std::memset(p, 0, 20);
+    p += 20;
+    return p - out;
+}
+
+}  // namespace treediff
 
 // Merge-join diff classification over two key-sorted (int64 key, 20-byte
 // oid) columns — the host-engine twin of the device classify kernel
@@ -568,6 +654,44 @@ int64_t io_inflate_batch(const uint8_t* pack, int64_t pack_len,
     }
     if (zs_ready) inflateEnd(&zs);
     return total;
+}
+
+
+int64_t io_tree_diff(const uint8_t* a_buf, int64_t a_len,
+                     const uint8_t* b_buf, int64_t b_len,
+                     uint8_t* out, int64_t out_cap) {
+    using treediff::Entry;
+    Entry ea{}, eb{};
+    bool ok = true;
+    int64_t ia = 0, ib = 0, pos = 0;
+    bool has_a = treediff::next_entry(a_buf, a_len, &ia, &ea, &ok);
+    bool has_b = treediff::next_entry(b_buf, b_len, &ib, &eb, &ok);
+    if (!ok) return -2;
+    while (has_a || has_b) {
+        int c;
+        if (!has_a) c = 1;
+        else if (!has_b) c = -1;
+        else c = treediff::cmp(ea, eb);
+        if (c < 0) {
+            pos = treediff::emit(out, out_cap, pos, &ea, nullptr);
+            if (pos < 0) return -1;
+            has_a = treediff::next_entry(a_buf, a_len, &ia, &ea, &ok);
+        } else if (c > 0) {
+            pos = treediff::emit(out, out_cap, pos, nullptr, &eb);
+            if (pos < 0) return -1;
+            has_b = treediff::next_entry(b_buf, b_len, &ib, &eb, &ok);
+        } else {
+            if (std::memcmp(ea.oid, eb.oid, 20) != 0 ||
+                ea.is_tree != eb.is_tree) {
+                pos = treediff::emit(out, out_cap, pos, &ea, &eb);
+                if (pos < 0) return -1;
+            }
+            has_a = treediff::next_entry(a_buf, a_len, &ia, &ea, &ok);
+            has_b = treediff::next_entry(b_buf, b_len, &ib, &eb, &ok);
+        }
+        if (!ok) return -2;
+    }
+    return pos;
 }
 
 }  // extern "C"
