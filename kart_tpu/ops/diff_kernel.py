@@ -301,6 +301,43 @@ def classify_blocks(old_block, new_block):
     )
 
 
+def stream_chunk_splits(key_arrays, chunk_rows):
+    """Key-space chunking for the streamed device paths: sorted key arrays
+    (one per block side) -> (per-side split-point arrays, n_chunks), where
+    chunk c of side s is rows ``splits[s][c]:splits[s][c+1]``. A key falls
+    in the same chunk on every side, so merge-joins stay chunk-local.
+
+    Boundaries balance the *combined* population: quantiles of one side
+    alone collapse under key-range skew (e.g. a renumbered-PK revision
+    whose new keys all exceed the old range would pile every new row into
+    one chunk). Candidate keys are fine-grained quantiles of each side;
+    each target combined-rank picks the nearest candidate."""
+    chunk_rows = max(int(chunk_rows), 1)
+    n_chunks = max(1, -(-max(len(k) for k in key_arrays) // chunk_rows))
+    total = sum(len(k) for k in key_arrays)
+
+    def _quantile_keys(keys, m):
+        if not len(keys) or m <= 0:
+            return keys[:0]
+        return keys[(np.arange(1, m) * len(keys)) // m]
+
+    cand = np.unique(
+        np.concatenate([_quantile_keys(k, 4 * n_chunks) for k in key_arrays])
+    )
+    if len(cand):
+        ranks = sum(np.searchsorted(k, cand) for k in key_arrays)
+        targets = (np.arange(1, n_chunks) * total) // n_chunks
+        picks = np.searchsorted(ranks, targets)
+        bounds = np.unique(cand[np.minimum(picks, len(cand) - 1)])
+    else:
+        bounds = cand
+    splits = tuple(
+        np.concatenate(([0], np.searchsorted(k, bounds), [len(k)]))
+        for k in key_arrays
+    )
+    return splits, len(bounds) + 1
+
+
 def classify_blocks_streamed(old_block, new_block, chunk_rows=None):
     """Double-buffered chunked device classify for blocks too large to ship
     to HBM as one upload (SURVEY §2.3 "pipelined lazy diff streaming").
@@ -327,36 +364,9 @@ def classify_blocks_streamed(old_block, new_block, chunk_rows=None):
     n_old, n_new = old_block.count, new_block.count
     old_keys = old_block.keys[:n_old]
     new_keys = new_block.keys[:n_new]
-    n_chunks = max(1, -(-max(n_old, n_new) // chunk_rows))
-    # Boundaries must balance the *combined* population: quantiles of one
-    # side alone collapse under key-range skew (e.g. a renumbered-PK
-    # revision whose new keys all exceed the old range would pile every new
-    # row into one chunk). Candidate keys are fine-grained quantiles of both
-    # sides; each target combined-rank picks the nearest candidate.
-    def _quantile_keys(keys, m):
-        if not len(keys) or m <= 0:
-            return keys[:0]
-        return keys[(np.arange(1, m) * len(keys)) // m]
-
-    cand = np.unique(
-        np.concatenate(
-            [_quantile_keys(old_keys, 4 * n_chunks), _quantile_keys(new_keys, 4 * n_chunks)]
-        )
+    (old_splits, new_splits), n_chunks = stream_chunk_splits(
+        (old_keys, new_keys), chunk_rows
     )
-    if len(cand):
-        ranks = np.searchsorted(old_keys, cand) + np.searchsorted(new_keys, cand)
-        targets = (np.arange(1, n_chunks) * (n_old + n_new)) // n_chunks
-        picks = np.searchsorted(ranks, targets)
-        bounds = np.unique(cand[np.minimum(picks, len(cand) - 1)])
-    else:
-        bounds = cand
-    old_splits = np.concatenate(
-        ([0], np.searchsorted(old_keys, bounds), [n_old])
-    )
-    new_splits = np.concatenate(
-        ([0], np.searchsorted(new_keys, bounds), [n_new])
-    )
-    n_chunks = len(bounds) + 1
     max_len = max(
         int(np.max(np.diff(old_splits))), int(np.max(np.diff(new_splits))), 1
     )

@@ -114,13 +114,33 @@ def merge_classify(ancestor_block, ours_block, theirs_block):
                 e,
             )
 
+    from kart_tpu.ops.diff_kernel import STREAM_MIN_ROWS, device_profitable
+
+    if n_max >= STREAM_MIN_ROWS and device_profitable(n_max):
+        from kart_tpu.runtime import default_backend
+
+        if default_backend() != "cpu":
+            # accelerator at north-star scale: chunked double-buffered
+            # upload instead of one monolithic 3-block transfer
+            try:
+                return merge_classify_streamed(
+                    ancestor_block, ours_block, theirs_block
+                )
+            except Exception as e:
+                import logging
+
+                logging.getLogger("kart_tpu.ops").warning(
+                    "streamed merge classify failed (%s: %s); using "
+                    "monolithic path",
+                    type(e).__name__,
+                    e,
+                )
+
     a_real = ancestor_block.keys[: ancestor_block.count]
     o_real = ours_block.keys[: ours_block.count]
     t_real = theirs_block.keys[: theirs_block.count]
     union = np.union1d(np.union1d(a_real, o_real), t_real).astype(np.int64)
     u = len(union)
-
-    from kart_tpu.ops.diff_kernel import device_profitable
 
     # same cost model as classify_blocks: small merges never pay backend
     # init / compile, and XLA-CPU backends route to the host path (where the
@@ -154,6 +174,112 @@ def merge_classify(ancestor_block, ours_block, theirs_block):
         np.asarray(decision)[:u],
         np.asarray(presence)[:u],
         {"conflicts": int(n_conf), "take_theirs": int(n_theirs)},
+    )
+
+
+def merge_classify_streamed(
+    ancestor_block, ours_block, theirs_block, chunk_rows=None
+):
+    """Double-buffered chunked device merge classify — the merge analog of
+    ``diff_kernel.classify_blocks_streamed`` (SURVEY §2.3 pipelined
+    streaming): north-star-scale merges must not ship three whole blocks to
+    HBM as one upload. Key-space chunks keep every 3-way decision
+    chunk-local; per-chunk unions concatenate (in order) into the exact
+    global sorted union, so output is identical to ``merge_classify``
+    (tested). With two chunks in flight, chunk i+1's host->HBM copy
+    overlaps chunk i's joins."""
+    import jax
+
+    from collections import deque
+
+    from kart_tpu.ops.diff_kernel import STREAM_CHUNK_ROWS, stream_chunk_splits
+
+    if chunk_rows is None:
+        chunk_rows = max(STREAM_CHUNK_ROWS, 1)
+    blocks = (ancestor_block, ours_block, theirs_block)
+    reals = tuple(
+        (b.keys[: b.count], b.oids[: b.count]) for b in blocks
+    )
+    splits, n_chunks = stream_chunk_splits(
+        tuple(keys for keys, _ in reals), chunk_rows
+    )
+    # per-chunk unions first: all chunks share one union bucket (one
+    # compiled shape), and their ordered concatenation IS the global union
+    unions = []
+    for c in range(n_chunks):
+        parts = [
+            reals[s][0][splits[s][c] : splits[s][c + 1]] for s in range(3)
+        ]
+        unions.append(
+            np.union1d(np.union1d(parts[0], parts[1]), parts[2]).astype(
+                np.int64
+            )
+        )
+    side_max = max(
+        (
+            int(np.max(np.diff(splits[s])))
+            for s in range(3)
+            if len(splits[s]) > 1
+        ),
+        default=1,
+    )
+    b_bucket = bucket_size(max(side_max, 1))
+    u_bucket = bucket_size(max(max((len(u) for u in unions), default=1), 1))
+
+    def _padded(keys, oids, lo, hi):
+        k = np.full(b_bucket, PAD_KEY, dtype=np.int64)
+        o = np.zeros((b_bucket, 5), dtype=np.uint32)
+        k[: hi - lo] = keys[lo:hi]
+        o[: hi - lo] = oids[lo:hi]
+        return k, o
+
+    out_decision = []
+    out_presence = []
+    totals = np.zeros(2, dtype=np.int64)
+    in_flight = deque()
+
+    def _drain():
+        out, u_count = in_flight.popleft()
+        decision, presence, n_conf, n_theirs = out
+        out_decision.append(np.asarray(decision)[:u_count])
+        out_presence.append(np.asarray(presence)[:u_count])
+        totals[0] += int(n_conf)
+        totals[1] += int(n_theirs)
+
+    for c in range(n_chunks):
+        args = []
+        for s in range(3):
+            lo, hi = int(splits[s][c]), int(splits[s][c + 1])
+            k, o = _padded(reals[s][0], reals[s][1], lo, hi)
+            args.extend((jax.device_put(k), jax.device_put(o), hi - lo))
+        u = unions[c]
+        u_padded = np.full(u_bucket, PAD_KEY, dtype=np.int64)
+        u_padded[: len(u)] = u
+        args.extend((jax.device_put(u_padded), len(u)))
+        out = _merge_classify_padded(*args)
+        in_flight.append((out, len(u)))
+        if len(in_flight) > 2:
+            _drain()
+    while in_flight:
+        _drain()
+    union = (
+        np.concatenate(unions) if unions else np.zeros(0, dtype=np.int64)
+    )
+    decision = (
+        np.concatenate(out_decision)
+        if out_decision
+        else np.zeros(0, dtype=np.int8)
+    )
+    presence = (
+        np.concatenate(out_presence)
+        if out_presence
+        else np.zeros(0, dtype=np.int8)
+    )
+    return (
+        union,
+        decision,
+        presence,
+        {"conflicts": int(totals[0]), "take_theirs": int(totals[1])},
     )
 
 
