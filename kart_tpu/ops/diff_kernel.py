@@ -399,7 +399,7 @@ def classify_blocks_streamed(old_block, new_block, chunk_rows=None):
         dev = [jax.device_put(a) for a in (ok, oo, nk, no)]
         out = _classify_padded(dev[0], dev[1], dev[2], dev[3], ohi - olo, nhi - nlo)
         in_flight.append((out, (olo, ohi), (nlo, nhi)))
-        if len(in_flight) > 2:
+        if len(in_flight) >= 2:
             _drain()
     while in_flight:
         _drain()

@@ -163,12 +163,35 @@ def merge_classify(ancestor_block, ours_block, theirs_block):
     union_padded = np.full(size, PAD_KEY, dtype=np.int64)
     union_padded[:u] = union
 
-    decision, presence, n_conf, n_theirs = _merge_classify_padded(
-        ancestor_block.keys, ancestor_block.oids, ancestor_block.count,
-        ours_block.keys, ours_block.oids, ours_block.count,
-        theirs_block.keys, theirs_block.oids, theirs_block.count,
-        union_padded, u,
-    )
+    try:
+        decision, presence, n_conf, n_theirs = _merge_classify_padded(
+            ancestor_block.keys, ancestor_block.oids, ancestor_block.count,
+            ours_block.keys, ours_block.oids, ours_block.count,
+            theirs_block.keys, theirs_block.oids, theirs_block.count,
+            union_padded, u,
+        )
+    except Exception as e:
+        # device OOM / tunnel failure mid-call: the merge must still
+        # complete (same guarantee classify_blocks gives the diff path)
+        import logging
+
+        logging.getLogger("kart_tpu.ops").warning(
+            "device merge classify failed (%s: %s); using host path",
+            type(e).__name__,
+            e,
+        )
+        decision, presence = _merge_classify_np(
+            ancestor_block, ours_block, theirs_block, union
+        )
+        return (
+            union,
+            decision,
+            presence,
+            {
+                "conflicts": int(np.sum(decision == CONFLICT)),
+                "take_theirs": int(np.sum(decision == TAKE_THEIRS)),
+            },
+        )
     return (
         union,
         np.asarray(decision)[:u],
@@ -258,7 +281,7 @@ def merge_classify_streamed(
         args.extend((jax.device_put(u_padded), len(u)))
         out = _merge_classify_padded(*args)
         in_flight.append((out, len(u)))
-        if len(in_flight) > 2:
+        if len(in_flight) >= 2:
             _drain()
     while in_flight:
         _drain()
