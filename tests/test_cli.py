@@ -641,3 +641,69 @@ class TestLogGraphFiltered:
         r = runner.invoke(cli, ["log", "mybrnch"])
         assert r.exit_code != 0
         assert "No such revision or dataset" in r.output
+
+
+def test_e2e_remote_round_trip(tmp_path, runner, monkeypatch):
+    """The remote leg of the reference's e2e journey (test_e2e.py: remote
+    add -> push -> clone -> edit -> push -> pull), all through the CLI over
+    the local transport with working copies on both ends."""
+    gpkg = create_points_gpkg(str(tmp_path / "source.gpkg"), n=8)
+    origin = tmp_path / "origin"
+    r = runner.invoke(cli, ["init", str(origin), "--workingcopy-location", "wc.gpkg"])
+    assert r.exit_code == 0, r.output
+    monkeypatch.chdir(origin)
+    from kart_tpu.core.repo import KartRepo
+
+    KartRepo(".").config.set_many(
+        {"user.name": "Origin", "user.email": "o@example.com"}
+    )
+    r = runner.invoke(cli, ["import", str(gpkg)])
+    assert r.exit_code == 0, r.output
+
+    # bare hub remote + push
+    hub = tmp_path / "hub"
+    r = runner.invoke(cli, ["init", "--bare", str(hub)])
+    assert r.exit_code == 0, r.output
+    r = runner.invoke(cli, ["remote", "add", "myremote", str(hub)])
+    assert r.exit_code == 0, r.output
+    r = runner.invoke(cli, ["push", "--set-upstream", "myremote", "main"])
+    assert r.exit_code == 0, r.output
+
+    # clone from the hub with a working copy
+    clone_dir = tmp_path / "clone"
+    r = runner.invoke(cli, ["clone", str(hub), str(clone_dir)])
+    assert r.exit_code == 0, r.output
+    monkeypatch.chdir(clone_dir)
+    KartRepo(".").config.set_many(
+        {"user.name": "Cloner", "user.email": "c@example.com"}
+    )
+    r = runner.invoke(cli, ["log", "--oneline"])
+    assert r.exit_code == 0 and len(r.output.strip().splitlines()) == 1
+
+    # edit in the clone's WC, commit, push back to the hub
+    from helpers import wc_connect
+
+    wc = next(clone_dir.glob("*.gpkg"))
+    con = wc_connect(wc)
+    con.execute("UPDATE points SET name = 'from-clone' WHERE fid = 2")
+    con.commit()
+    con.close()
+    r = runner.invoke(cli, ["commit", "-m", "clone edit"])
+    assert r.exit_code == 0, r.output
+    r = runner.invoke(cli, ["push"])
+    assert r.exit_code == 0, r.output
+
+    # original pulls the clone's edit; its WC reflects it
+    monkeypatch.chdir(origin)
+    r = runner.invoke(cli, ["pull", "myremote", "main"])
+    assert r.exit_code == 0, r.output
+    ds = KartRepo(".").structure("HEAD").datasets["points"]
+    assert ds.get_feature([2])["name"] == "from-clone"
+    con = wc_connect(origin / "wc.gpkg")
+    try:
+        (name,) = con.execute(
+            "SELECT name FROM points WHERE fid = 2"
+        ).fetchone()
+    finally:
+        con.close()
+    assert name == "from-clone"
