@@ -889,7 +889,101 @@ def _oblique_stereo_inverse(crs, x, y):
     return np.degrees(lam), np.degrees(phi)
 
 
+def _laea_setup(crs):
+    """Lambert Azimuthal Equal Area, oblique/equatorial aspect (EPSG method
+    9820, Guidance Note 7-2 §3.2.2; Snyder 1987 §24). The polar aspect
+    (|lat0| = 90) has a different formula set and is refused loudly."""
+    a = crs.semi_major
+    e2 = _e2_of(crs)
+    e = math.sqrt(e2)
+    p = crs.params
+    lat0 = math.radians(p.get("latitude_of_origin", p.get("latitude_of_center", 0.0)))
+    lon0 = math.radians(p.get("central_meridian", p.get("longitude_of_center", 0.0)))
+    fe = p.get("false_easting", 0.0)
+    fn = p.get("false_northing", 0.0)
+    if abs(abs(lat0) - math.pi / 2) < 1e-9:
+        raise CrsError(
+            "Polar-aspect Lambert Azimuthal Equal Area is not supported by "
+            "the built-in transform engine"
+        )
+    qp = float(_q_of(e, e2, 1.0))
+    q0 = float(_q_of(e, e2, math.sin(lat0)))
+    beta0 = math.asin(q0 / qp)
+    rq = a * math.sqrt(qp / 2.0)
+    d = (
+        a
+        * (math.cos(lat0) / math.sqrt(1 - e2 * math.sin(lat0) ** 2))
+        / (rq * math.cos(beta0))
+    )
+    return a, e, e2, qp, beta0, rq, d, lon0, fe, fn
+
+
+def _laea_forward(crs, lon_deg, lat_deg):
+    a, e, e2, qp, beta0, rq, d, lon0, fe, fn = _laea_setup(crs)
+    lon = np.radians(np.asarray(lon_deg, dtype=np.float64))
+    lat = np.radians(
+        np.clip(np.asarray(lat_deg, dtype=np.float64), -89.9999, 89.9999)
+    )
+    q = _q_of(e, e2, np.sin(lat))
+    beta = np.arcsin(np.clip(q / qp, -1.0, 1.0))
+    dlon = lon - lon0
+    denom = 1.0 + math.sin(beta0) * np.sin(beta) + math.cos(beta0) * np.cos(
+        beta
+    ) * np.cos(dlon)
+    b = rq * np.sqrt(2.0 / np.maximum(denom, 1e-12))
+    x = fe + (b * d) * np.cos(beta) * np.sin(dlon)
+    y = fn + (b / d) * (
+        math.cos(beta0) * np.sin(beta)
+        - math.sin(beta0) * np.cos(beta) * np.cos(dlon)
+    )
+    return x, y
+
+
+def _laea_inverse(crs, x, y):
+    a, e, e2, qp, beta0, rq, d, lon0, fe, fn = _laea_setup(crs)
+    xs = (np.asarray(x, dtype=np.float64) - fe) / d
+    ys = (np.asarray(y, dtype=np.float64) - fn) * d
+    rho = np.sqrt(xs**2 + ys**2)
+    c = 2.0 * np.arcsin(np.clip(rho / (2.0 * rq), -1.0, 1.0))
+    with np.errstate(divide="ignore", invalid="ignore"):
+        beta_p = np.arcsin(
+            np.clip(
+                np.cos(c) * math.sin(beta0)
+                + np.where(rho == 0, 0.0, ys * np.sin(c) * math.cos(beta0) / rho),
+                -1.0,
+                1.0,
+            )
+        )
+    # EPSG GN7-2: atan2((E-FE) sinC, D rho cosB0 cosC - D^2 (N-FN) sinB0 sinC)
+    # with xs = (E-FE)/D and ys = D (N-FN), both args divide by D:
+    lon = lon0 + np.arctan2(
+        xs * np.sin(c),
+        rho * math.cos(beta0) * np.cos(c)
+        - ys * math.sin(beta0) * np.sin(c),
+    )
+    # authalic -> geodetic latitude series (Snyder 3-18)
+    e4 = e2 * e2
+    e6 = e4 * e2
+    phi = (
+        beta_p
+        + (e2 / 3 + 31 * e4 / 180 + 517 * e6 / 5040) * np.sin(2 * beta_p)
+        + (23 * e4 / 360 + 251 * e6 / 3780) * np.sin(4 * beta_p)
+        + (761 * e6 / 45360) * np.sin(6 * beta_p)
+    )
+    phi = np.where(rho == 0, _lat0_of(crs), phi)
+    lon = np.where(rho == 0, lon0, lon)
+    return np.degrees(lon), np.degrees(phi)
+
+
+def _lat0_of(crs):
+    p = crs.params
+    return math.radians(
+        p.get("latitude_of_origin", p.get("latitude_of_center", 0.0))
+    )
+
+
 _PROJ_IMPLS = {
+    "lambert_azimuthal_equal_area": (_laea_forward, _laea_inverse),
     "transverse_mercator": (_tm_forward, _tm_inverse),
     "mercator_1sp": (_mercator_forward, _mercator_inverse),
     "mercator_2sp": (_mercator_forward, _mercator_inverse),

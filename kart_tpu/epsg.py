@@ -280,6 +280,17 @@ PROJECTED = {
             "false_northing": 2000000,
         },
     ),
+    3035: (
+        "ETRS89-extended / LAEA Europe",
+        4258,
+        "Lambert_Azimuthal_Equal_Area",
+        {
+            "latitude_of_center": 52,
+            "longitude_of_center": 10,
+            "false_easting": 4321000,
+            "false_northing": 3210000,
+        },
+    ),
     2180: (
         "ETRS89 / Poland CS92",
         4258,

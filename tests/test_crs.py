@@ -750,3 +750,67 @@ class TestEpsgRegistry:
         assert "EPSG:5514" in msg
         assert "UTM" in msg  # coverage listing present
         assert "full WKT" in msg
+
+
+class TestLambertAzimuthalEqualArea:
+    """EPSG method 9820 (ETRS89-LAEA Europe is EPSG:3035, the EU standard
+    grid). Validated against the EPSG Guidance Note 7-2 worked example."""
+
+    def test_epsg_worked_example(self):
+        import numpy as np
+
+        from kart_tpu.crs import _PROJ_IMPLS, make_crs
+
+        crs = make_crs("EPSG:3035")
+        fwd, inv = _PROJ_IMPLS["lambert_azimuthal_equal_area"]
+        # GN7-2 §3.2.2: 50N 5E -> E 3962799.45, N 2999718.85
+        x, y = fwd(crs, np.array([5.0]), np.array([50.0]))
+        assert abs(x[0] - 3962799.45) < 0.01
+        assert abs(y[0] - 2999718.85) < 0.01
+        # natural origin maps exactly to the false origin
+        x0, y0 = fwd(crs, np.array([10.0]), np.array([52.0]))
+        assert abs(x0[0] - 4321000.0) < 1e-6
+        assert abs(y0[0] - 3210000.0) < 1e-6
+
+    def test_roundtrip(self):
+        import numpy as np
+
+        from kart_tpu.crs import _PROJ_IMPLS, make_crs
+
+        crs = make_crs("EPSG:3035")
+        fwd, inv = _PROJ_IMPLS["lambert_azimuthal_equal_area"]
+        rng = np.random.default_rng(1)
+        lon = rng.uniform(-10, 35, 500)
+        lat = rng.uniform(34, 71, 500)
+        x, y = fwd(crs, lon, lat)
+        lon2, lat2 = inv(crs, x, y)
+        np.testing.assert_allclose(lon2, lon, atol=1e-8)
+        np.testing.assert_allclose(lat2, lat, atol=1e-7)
+
+    def test_transform_through_registry(self):
+        import numpy as np
+
+        from kart_tpu.crs import Transform
+
+        t = Transform("EPSG:4258", "EPSG:3035")
+        x, y = t.transform(np.array([5.0]), np.array([50.0]))
+        assert abs(x[0] - 3962799.45) < 0.01
+
+    def test_polar_aspect_refused(self):
+        import pytest
+
+        from kart_tpu.crs import CrsError, Transform, make_crs
+
+        wkt = (
+            'PROJCS["polar laea",GEOGCS["WGS 84",DATUM["WGS_1984",'
+            'SPHEROID["WGS 84",6378137,298.257223563]],'
+            'PRIMEM["Greenwich",0],UNIT["degree",0.0174532925199433]],'
+            'PROJECTION["Lambert_Azimuthal_Equal_Area"],'
+            'PARAMETER["latitude_of_center",90],'
+            'PARAMETER["longitude_of_center",0],'
+            'PARAMETER["false_easting",0],PARAMETER["false_northing",0],'
+            'UNIT["metre",1]]'
+        )
+        t = Transform("EPSG:4326", wkt)
+        with pytest.raises(CrsError, match="Polar-aspect"):
+            t.transform([0.0], [80.0])
