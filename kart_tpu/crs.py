@@ -982,8 +982,58 @@ def _lat0_of(crs):
     )
 
 
+def _cea_setup(crs):
+    """Lambert Cylindrical Equal Area (EPSG method 9835; Snyder 1987 §10,
+    ellipsoidal, normal aspect with a standard parallel)."""
+    a = crs.semi_major
+    e2 = _e2_of(crs)
+    e = math.sqrt(e2)
+    p = crs.params
+    lat_ts = math.radians(
+        p.get("standard_parallel_1", p.get("latitude_of_origin", 0.0))
+    )
+    lon0 = math.radians(p.get("central_meridian", p.get("longitude_of_center", 0.0)))
+    fe = p.get("false_easting", 0.0)
+    fn = p.get("false_northing", 0.0)
+    k0 = math.cos(lat_ts) / math.sqrt(1 - e2 * math.sin(lat_ts) ** 2)
+    qp = float(_q_of(e, e2, 1.0))
+    return a, e, e2, qp, k0, lon0, fe, fn
+
+
+def _cea_forward(crs, lon_deg, lat_deg):
+    a, e, e2, qp, k0, lon0, fe, fn = _cea_setup(crs)
+    lon = np.radians(np.asarray(lon_deg, dtype=np.float64))
+    lat = np.radians(
+        np.clip(np.asarray(lat_deg, dtype=np.float64), -89.9999, 89.9999)
+    )
+    q = _q_of(e, e2, np.sin(lat))
+    x = fe + a * k0 * (lon - lon0)
+    y = fn + a * q / (2.0 * k0)
+    return x, y
+
+
+def _cea_inverse(crs, x, y):
+    a, e, e2, qp, k0, lon0, fe, fn = _cea_setup(crs)
+    xs = np.asarray(x, dtype=np.float64) - fe
+    ys = np.asarray(y, dtype=np.float64) - fn
+    lon = lon0 + xs / (a * k0)
+    beta = np.arcsin(np.clip(2.0 * ys * k0 / (a * qp), -1.0, 1.0))
+    e4 = e2 * e2
+    e6 = e4 * e2
+    phi = (
+        beta
+        + (e2 / 3 + 31 * e4 / 180 + 517 * e6 / 5040) * np.sin(2 * beta)
+        + (23 * e4 / 360 + 251 * e6 / 3780) * np.sin(4 * beta)
+        + (761 * e6 / 45360) * np.sin(6 * beta)
+    )
+    return np.degrees(lon), np.degrees(phi)
+
+
 _PROJ_IMPLS = {
     "lambert_azimuthal_equal_area": (_laea_forward, _laea_inverse),
+    "cylindrical_equal_area": (_cea_forward, _cea_inverse),
+    "lambert_cylindrical_equal_area": (_cea_forward, _cea_inverse),
+    "lambert_cylindrical_equal_area_spherical": (_cea_forward, _cea_inverse),
     "transverse_mercator": (_tm_forward, _tm_inverse),
     "mercator_1sp": (_mercator_forward, _mercator_inverse),
     "mercator_2sp": (_mercator_forward, _mercator_inverse),

@@ -280,6 +280,17 @@ PROJECTED = {
             "false_northing": 2000000,
         },
     ),
+    6933: (
+        "WGS 84 / NSIDC EASE-Grid 2.0 Global",
+        4326,
+        "Lambert_Cylindrical_Equal_Area",
+        {
+            "standard_parallel_1": 30,
+            "central_meridian": 0,
+            "false_easting": 0,
+            "false_northing": 0,
+        },
+    ),
     3035: (
         "ETRS89-extended / LAEA Europe",
         4258,

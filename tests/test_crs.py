@@ -814,3 +814,78 @@ class TestLambertAzimuthalEqualArea:
         t = Transform("EPSG:4326", wkt)
         with pytest.raises(CrsError, match="Polar-aspect"):
             t.transform([0.0], [80.0])
+
+
+class TestCylindricalEqualArea:
+    """EPSG method 9835; EPSG:6933 is NSIDC EASE-Grid 2.0 Global."""
+
+    def test_roundtrip_and_known_extent(self):
+        import numpy as np
+
+        from kart_tpu.crs import _PROJ_IMPLS, make_crs
+
+        crs = make_crs("EPSG:6933")
+        fwd, inv = _PROJ_IMPLS["lambert_cylindrical_equal_area"]
+        rng = np.random.default_rng(2)
+        lon = rng.uniform(-179, 179, 500)
+        lat = rng.uniform(-84, 84, 500)
+        x, y = fwd(crs, lon, lat)
+        lon2, lat2 = inv(crs, x, y)
+        np.testing.assert_allclose(lon2, lon, atol=1e-8)
+        np.testing.assert_allclose(lat2, lat, atol=1e-7)
+        # published EASE-Grid 2.0 global extent: x = +/-17367530.45 m at
+        # +/-180 lon (NSIDC grid definition)
+        x180, _ = fwd(crs, np.array([180.0]), np.array([0.0]))
+        assert abs(x180[0] - 17367530.45) < 1.0
+
+
+def _numeric_area_scale(fwd, crs, lon, lat):
+    """|det d(x,y)/d(lon,lat)| / (M N cos(lat)) — 1.0 for an equal-area
+    projection (M, N: meridional / prime-vertical curvature radii)."""
+    import math
+
+    import numpy as np
+
+    from kart_tpu.crs import _e2_of
+
+    h = 1e-6
+    x0, y0 = fwd(crs, lon, lat)
+    x1, y1 = fwd(crs, lon + h, lat)
+    x2, y2 = fwd(crs, lon, lat + h)
+    dxdl = (x1 - x0) / math.radians(h)
+    dydl = (y1 - y0) / math.radians(h)
+    dxdp = (x2 - x0) / math.radians(h)
+    dydp = (y2 - y0) / math.radians(h)
+    det = np.abs(dxdl * dydp - dydl * dxdp)
+    a = crs.semi_major
+    e2 = _e2_of(crs)
+    s = np.sin(np.radians(lat))
+    m = a * (1 - e2) / (1 - e2 * s**2) ** 1.5
+    n = a / np.sqrt(1 - e2 * s**2)
+    return det / (m * n * np.cos(np.radians(lat)))
+
+
+class TestEqualAreaProperty:
+    """Independent validation: every equal-area projection's numeric
+    Jacobian must equal the ellipsoidal area element everywhere."""
+
+    def test_jacobians(self):
+        import numpy as np
+
+        from kart_tpu.crs import _PROJ_IMPLS, make_crs
+
+        cases = [
+            ("EPSG:6933", "lambert_cylindrical_equal_area", (-170, 170, -80, 80)),
+            ("EPSG:3035", "lambert_azimuthal_equal_area", (-8, 30, 36, 68)),
+            ("EPSG:3577", "albers_conic_equal_area", (115, 150, -40, -12)),
+        ]
+        rng = np.random.default_rng(3)
+        for code, method, (w, e, s, n) in cases:
+            crs = make_crs(code)
+            fwd, _ = _PROJ_IMPLS[method]
+            lon = rng.uniform(w, e, 100)
+            lat = rng.uniform(s, n, 100)
+            scale = _numeric_area_scale(fwd, crs, lon, lat)
+            np.testing.assert_allclose(
+                scale, 1.0, rtol=2e-4, err_msg=f"{code} is not equal-area"
+            )
