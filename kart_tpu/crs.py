@@ -1029,8 +1029,113 @@ def _cea_inverse(crs, x, y):
     return np.degrees(lon), np.degrees(phi)
 
 
+def _somerc_setup(crs):
+    """Swiss Oblique Mercator (EPSG method 9814, PROJ ``somerc``): the
+    double projection ellipsoid -> conformal sphere -> oblique equatorial
+    Mercator used by CH1903 / CH1903+ (LV03/LV95). Constants per the
+    swisstopo projection formulae."""
+    a = crs.semi_major
+    e2 = _e2_of(crs)
+    e = math.sqrt(e2)
+    p = crs.params
+    # the Swiss double projection equals Hotine Oblique Mercator
+    # (azimuth-center variant) only for azimuth = rectified angle = 90°
+    # (how CH1903 WKT1 is exported); a general-azimuth HOM (Malaysia RSO,
+    # Alaska zone 1) is a different construction — refuse loudly
+    for angle in ("azimuth", "rectified_grid_angle"):
+        if angle in p and abs(p[angle] - 90.0) > 1e-6:
+            raise CrsError(
+                f"Hotine Oblique Mercator with {angle}={p[angle]} is not "
+                f"supported by the built-in transform engine (only the "
+                f"Swiss azimuth=90 form)"
+            )
+    lat0 = math.radians(p.get("latitude_of_origin", p.get("latitude_of_center", 0.0)))
+    lon0 = math.radians(p.get("central_meridian", p.get("longitude_of_center", 0.0)))
+    k0 = p.get("scale_factor", 1.0)
+    fe = p.get("false_easting", 0.0)
+    fn = p.get("false_northing", 0.0)
+    s0 = math.sin(lat0)
+    alpha = math.sqrt(1 + e2 * math.cos(lat0) ** 4 / (1 - e2))
+    r = a * k0 * math.sqrt(1 - e2) / (1 - e2 * s0 * s0)
+    b0 = math.asin(s0 / alpha)
+    big_k = (
+        math.log(math.tan(math.pi / 4 + b0 / 2))
+        - alpha
+        * (
+            math.log(math.tan(math.pi / 4 + lat0 / 2))
+            - (e / 2) * math.log((1 + e * s0) / (1 - e * s0))
+        )
+    )
+    return e, alpha, r, b0, big_k, lon0, fe, fn
+
+
+def _somerc_forward(crs, lon_deg, lat_deg):
+    e, alpha, r, b0, big_k, lon0, fe, fn = _somerc_setup(crs)
+    lon = np.radians(np.asarray(lon_deg, dtype=np.float64))
+    lat = np.radians(
+        np.clip(np.asarray(lat_deg, dtype=np.float64), -89.9999, 89.9999)
+    )
+    s = np.sin(lat)
+    big_s = (
+        alpha
+        * (
+            np.log(np.tan(np.pi / 4 + lat / 2))
+            - (e / 2) * np.log((1 + e * s) / (1 - e * s))
+        )
+        + big_k
+    )
+    b = 2 * (np.arctan(np.exp(big_s)) - np.pi / 4)
+    ell = alpha * (lon - lon0)
+    b_bar = np.arcsin(
+        np.clip(
+            np.cos(b0) * np.sin(b) - np.sin(b0) * np.cos(b) * np.cos(ell),
+            -1.0,
+            1.0,
+        )
+    )
+    l_bar = np.arctan2(
+        np.cos(b) * np.sin(ell),
+        np.sin(b0) * np.sin(b) + np.cos(b0) * np.cos(b) * np.cos(ell),
+    )
+    y = r * l_bar
+    x = (r / 2) * np.log((1 + np.sin(b_bar)) / (1 - np.sin(b_bar)))
+    return fe + y, fn + x
+
+
+def _somerc_inverse(crs, x, y):
+    e, alpha, r, b0, big_k, lon0, fe, fn = _somerc_setup(crs)
+    yy = np.asarray(x, dtype=np.float64) - fe  # easting axis
+    xx = np.asarray(y, dtype=np.float64) - fn  # northing axis
+    l_bar = yy / r
+    b_bar = 2 * (np.arctan(np.exp(xx / r)) - np.pi / 4)
+    b = np.arcsin(
+        np.clip(
+            np.cos(b0) * np.sin(b_bar) + np.sin(b0) * np.cos(b_bar) * np.cos(l_bar),
+            -1.0,
+            1.0,
+        )
+    )
+    ell = np.arctan2(
+        np.cos(b_bar) * np.sin(l_bar),
+        -np.sin(b0) * np.sin(b_bar) + np.cos(b0) * np.cos(b_bar) * np.cos(l_bar),
+    )
+    lon = lon0 + ell / alpha
+    # sphere -> ellipsoid latitude: fixed-point on the conformal relation
+    lat = b.copy()
+    for _ in range(8):
+        s = np.sin(lat)
+        big_s = (
+            np.log(np.tan(np.pi / 4 + b / 2)) - big_k
+        ) / alpha + e * np.log(np.tan(np.pi / 4 + np.arcsin(e * s) / 2))
+        lat = 2 * np.arctan(np.exp(big_s)) - np.pi / 2
+    return np.degrees(lon), np.degrees(lat)
+
+
 _PROJ_IMPLS = {
     "lambert_azimuthal_equal_area": (_laea_forward, _laea_inverse),
+    "hotine_oblique_mercator_azimuth_center": (_somerc_forward, _somerc_inverse),
+    "swiss_oblique_cylindrical": (_somerc_forward, _somerc_inverse),
+    "swiss_oblique_mercator": (_somerc_forward, _somerc_inverse),
     "cylindrical_equal_area": (_cea_forward, _cea_inverse),
     "lambert_cylindrical_equal_area": (_cea_forward, _cea_inverse),
     "lambert_cylindrical_equal_area_spherical": (_cea_forward, _cea_inverse),

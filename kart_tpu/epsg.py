@@ -280,6 +280,34 @@ PROJECTED = {
             "false_northing": 2000000,
         },
     ),
+    2056: (
+        "CH1903+ / LV95",
+        4150,
+        "Hotine_Oblique_Mercator_Azimuth_Center",
+        {
+            "latitude_of_center": 46.952405555555565,
+            "longitude_of_center": 7.439583333333333,
+            "azimuth": 90,
+            "rectified_grid_angle": 90,
+            "scale_factor": 1,
+            "false_easting": 2600000,
+            "false_northing": 1200000,
+        },
+    ),
+    21781: (
+        "CH1903 / LV03",
+        4149,
+        "Hotine_Oblique_Mercator_Azimuth_Center",
+        {
+            "latitude_of_center": 46.952405555555565,
+            "longitude_of_center": 7.439583333333333,
+            "azimuth": 90,
+            "rectified_grid_angle": 90,
+            "scale_factor": 1,
+            "false_easting": 600000,
+            "false_northing": 200000,
+        },
+    ),
     6933: (
         "WGS 84 / NSIDC EASE-Grid 2.0 Global",
         4326,
@@ -332,6 +360,20 @@ GEOGRAPHIC[4289] = (
     6289,
     7004,
     (565.417, 50.3319, 465.552, -0.398957, 0.343988, -1.8774, 4.0725),
+)
+GEOGRAPHIC[4150] = (
+    "CH1903+",
+    "CH1903+",
+    6150,
+    7004,
+    (674.374, 15.056, 405.346),
+)
+GEOGRAPHIC[4149] = (
+    "CH1903",
+    "CH1903",
+    6149,
+    7004,
+    (674.4, 15.1, 405.3),
 )
 
 # -- UTM families: (low, high) code range ->

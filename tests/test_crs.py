@@ -889,3 +889,107 @@ class TestEqualAreaProperty:
             np.testing.assert_allclose(
                 scale, 1.0, rtol=2e-4, err_msg=f"{code} is not equal-area"
             )
+
+
+class TestSwissObliqueMercator:
+    """EPSG method 9814 / PROJ somerc (CH1903 LV03, CH1903+ LV95).
+    Validated by construction properties: the projection must be CONFORMAL
+    (meridian scale == parallel scale, directions orthogonal) everywhere,
+    have unit scale at the projection centre (k0=1), map Bern's origin to
+    the false origin exactly, and roundtrip to machine precision. Coarse
+    Swiss city anchors guard against gross constant errors."""
+
+    def _scales(self, fwd, crs, lon, lat):
+        import math
+
+        import numpy as np
+
+        from kart_tpu.crs import _e2_of
+
+        h = 1e-6
+        x0, y0 = fwd(crs, lon, lat)
+        x1, y1 = fwd(crs, lon + h, lat)
+        x2, y2 = fwd(crs, lon, lat + h)
+        dl = math.radians(h)
+        a = crs.semi_major
+        e2 = _e2_of(crs)
+        s = np.sin(np.radians(lat))
+        m = a * (1 - e2) / (1 - e2 * s**2) ** 1.5
+        n = a / np.sqrt(1 - e2 * s**2)
+        par = np.hypot(x1 - x0, y1 - y0) / (dl * n * np.cos(np.radians(lat)))
+        mer = np.hypot(x2 - x0, y2 - y0) / (dl * m)
+        dot = (x1 - x0) * (x2 - x0) + (y1 - y0) * (y2 - y0)
+        cosang = dot / (np.hypot(x1 - x0, y1 - y0) * np.hypot(x2 - x0, y2 - y0))
+        return par, mer, cosang
+
+    def test_conformal_and_unit_scale_at_origin(self):
+        import numpy as np
+
+        from kart_tpu.crs import _PROJ_IMPLS, make_crs
+
+        crs = make_crs("EPSG:2056")
+        fwd, _ = _PROJ_IMPLS["hotine_oblique_mercator_azimuth_center"]
+        rng = np.random.default_rng(5)
+        lon = rng.uniform(5.9, 10.5, 200)
+        lat = rng.uniform(45.8, 47.9, 200)
+        par, mer, cosang = self._scales(fwd, crs, lon, lat)
+        np.testing.assert_allclose(par, mer, rtol=1e-6)  # conformal
+        np.testing.assert_allclose(cosang, 0.0, atol=1e-5)  # orthogonal
+        # k0 = 1 at the projection centre
+        par0, mer0, _ = self._scales(
+            fwd, crs, np.array([7.439583333333333]), np.array([46.952405555555565])
+        )
+        np.testing.assert_allclose(par0, 1.0, rtol=1e-6)
+        np.testing.assert_allclose(mer0, 1.0, rtol=1e-6)
+
+    def test_origin_anchors_roundtrip(self):
+        import numpy as np
+
+        from kart_tpu.crs import _PROJ_IMPLS, make_crs
+
+        for code, e0, n0 in ((2056, 2600000, 1200000), (21781, 600000, 200000)):
+            crs = make_crs(f"EPSG:{code}")
+            fwd, inv = _PROJ_IMPLS["hotine_oblique_mercator_azimuth_center"]
+            x, y = fwd(
+                crs, np.array([7.439583333333333]), np.array([46.952405555555565])
+            )
+            assert abs(x[0] - e0) < 1e-6 and abs(y[0] - n0) < 1e-6
+            rng = np.random.default_rng(6)
+            lon = rng.uniform(5.9, 10.5, 300)
+            lat = rng.uniform(45.8, 47.9, 300)
+            X, Y = fwd(crs, lon, lat)
+            lon2, lat2 = inv(crs, X, Y)
+            np.testing.assert_allclose(lon2, lon, atol=1e-10)
+            np.testing.assert_allclose(lat2, lat, atol=1e-10)
+        # coarse anchors: Swiss cities land within ~2km of their LV95 spots
+        crs = make_crs("EPSG:2056")
+        fwd, _ = _PROJ_IMPLS["hotine_oblique_mercator_azimuth_center"]
+        for lon, lat, ee, nn in (
+            (6.14, 46.20, 2500000, 1118000),
+            (8.54, 47.38, 2683000, 1247000),
+        ):
+            x, y = fwd(crs, np.array([lon]), np.array([lat]))
+            assert np.hypot(x[0] - ee, y[0] - nn) < 2500
+
+    def test_general_azimuth_refused(self):
+        import numpy as np
+        import pytest
+
+        from kart_tpu.crs import CrsError, Transform
+
+        wkt = (
+            'PROJCS["rso",GEOGCS["WGS 84",DATUM["WGS_1984",'
+            'SPHEROID["WGS 84",6378137,298.257223563]],'
+            'PRIMEM["Greenwich",0],UNIT["degree",0.0174532925199433]],'
+            'PROJECTION["Hotine_Oblique_Mercator_Azimuth_Center"],'
+            'PARAMETER["latitude_of_center",4],'
+            'PARAMETER["longitude_of_center",102.25],'
+            'PARAMETER["azimuth",323.0257964666666],'
+            'PARAMETER["rectified_grid_angle",323.1301023611111],'
+            'PARAMETER["scale_factor",0.99984],'
+            'PARAMETER["false_easting",804671],'
+            'PARAMETER["false_northing",0],UNIT["metre",1]]'
+        )
+        t = Transform("EPSG:4326", wkt)
+        with pytest.raises(CrsError, match="azimuth"):
+            t.transform(np.array([102.0]), np.array([4.0]))
