@@ -156,6 +156,46 @@ def fsck(ctx, reset_datasets):
             except Exception as e:
                 errors.append(f"Dataset {ds.path} is corrupt: {e}")
 
+    # columnar sidecars mirror their feature trees exactly — a corrupt
+    # sidecar would silently wrong every columnar diff, so fsck rebuilds
+    # the (pk, oid) columns from the tree and compares
+    if not repo.head_is_unborn:
+        click.echo("Checking columnar sidecars...")
+        import numpy as np
+
+        from kart_tpu.diff import sidecar as sidecar_mod
+        from kart_tpu.ops.blocks import FeatureBlock
+
+        for ds in repo.datasets():
+            try:
+                if ds.feature_tree is None or not sidecar_mod.has_sidecar(
+                    repo, ds
+                ):
+                    continue
+                block = sidecar_mod.load_block(repo, ds)
+                tree_block = FeatureBlock.from_dataset(ds, pad=False)
+                ok = (
+                    block is not None
+                    and block.count == tree_block.count
+                    and np.array_equal(
+                        block.keys[: block.count],
+                        tree_block.keys[: tree_block.count],
+                    )
+                    and np.array_equal(
+                        block.oids[: block.count],
+                        tree_block.oids[: tree_block.count],
+                    )
+                )
+                if ok:
+                    click.echo(f"  {ds.path}: sidecar OK ({block.count} rows)")
+                else:
+                    errors.append(
+                        f"Dataset {ds.path}: columnar sidecar does not "
+                        f"match the feature tree"
+                    )
+            except Exception as e:
+                errors.append(f"Dataset {ds.path}: sidecar check failed: {e}")
+
     # working copy state
     wc = repo.working_copy
     if wc is not None:

@@ -707,3 +707,37 @@ def test_e2e_remote_round_trip(tmp_path, runner, monkeypatch):
     finally:
         con.close()
     assert name == "from-clone"
+
+
+def test_fsck_verifies_sidecars(tmp_path, runner, monkeypatch):
+    """fsck must rebuild the sidecar columns from the feature tree and fail
+    loudly on a corrupted sidecar (a silent mismatch would wrong every
+    columnar diff)."""
+    import glob
+
+    import kart_tpu.importer.importer as importer_mod
+
+    monkeypatch.setattr(importer_mod, "SIDECAR_MIN_FEATURES", 5)
+    gpkg = create_points_gpkg(str(tmp_path / "s.gpkg"), n=30)
+    repo_dir = tmp_path / "repo"
+    r = runner.invoke(cli, ["init", str(repo_dir)])
+    assert r.exit_code == 0, r.output
+    monkeypatch.chdir(repo_dir)
+    from kart_tpu.core.repo import KartRepo
+
+    KartRepo(".").config.set_many({"user.name": "t", "user.email": "t@e"})
+    r = runner.invoke(cli, ["import", str(gpkg), "--no-checkout"])
+    assert r.exit_code == 0, r.output
+
+    r = runner.invoke(cli, ["fsck"])
+    assert r.exit_code == 0, r.output
+    assert "sidecar OK (30 rows)" in r.output
+
+    # corrupt one byte of the oid columns
+    (sidecar_file,) = glob.glob(str(repo_dir / ".kart" / "columnar" / "*"))
+    data = bytearray(open(sidecar_file, "rb").read())
+    data[-10] ^= 0xFF
+    open(sidecar_file, "wb").write(bytes(data))
+    r = runner.invoke(cli, ["fsck"])
+    assert r.exit_code != 0
+    assert "sidecar" in r.output
