@@ -259,14 +259,24 @@ def _graph_rows(entries, parent_map):
     is_flag=True,
     help="List the datasets changed by each commit",
 )
+@click.option(
+    "--with-feature-count",
+    "feature_count_accuracy",
+    type=click.Choice(["veryfast", "fast", "medium", "good", "exact"]),
+    default=None,
+    help=(
+        "Add a featureChanges count per dataset to JSON output at the "
+        "given estimation accuracy (reference: log --with-feature-count)"
+    ),
+)
 @click.option("--json-style", type=click.Choice(["extracompact", "compact", "pretty"]), default="pretty")
 @click.argument("refish", required=False, default="HEAD")
 @click.argument("filters", nargs=-1)
 @click.pass_obj
 def log(
     ctx, output_format, oneline, max_count, skip, since, until, author,
-    committer, grep, graph, first_parent, dataset_changes, json_style,
-    refish, filters,
+    committer, grep, graph, first_parent, dataset_changes,
+    feature_count_accuracy, json_style, refish, filters,
 ):
     """Show the commit log.
 
@@ -362,6 +372,18 @@ def log(
             item = _commit_json(oid, c)
             if dataset_changes:
                 item["datasetChanges"] = changed
+            if feature_count_accuracy:
+                from kart_tpu.diff.estimation import (
+                    estimate_diff_feature_counts,
+                )
+
+                parent = c.parents[0] if c.parents else None
+                item["featureChanges"] = estimate_diff_feature_counts(
+                    repo,
+                    repo.structure(parent) if parent else None,
+                    repo.structure(oid),
+                    accuracy=feature_count_accuracy,
+                )
             out.append(item)
         if output_format == "json":
             dump_json_output(out, "-", json_style=json_style)

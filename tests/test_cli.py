@@ -741,3 +741,24 @@ def test_fsck_verifies_sidecars(tmp_path, runner, monkeypatch):
     r = runner.invoke(cli, ["fsck"])
     assert r.exit_code != 0
     assert "sidecar" in r.output
+
+
+def test_log_with_feature_count(repo_dir, runner):
+    """--with-feature-count adds per-dataset changed-feature counts to JSON
+    output (reference: log.py --with-feature-count)."""
+    wc_edit(repo_dir, "UPDATE points SET name = 'x' WHERE fid IN (1, 2, 3);")
+    r = runner.invoke(cli, ["commit", "-m", "three edits"])
+    assert r.exit_code == 0, r.output
+    r = runner.invoke(
+        cli, ["log", "-o", "json", "--with-feature-count", "exact"]
+    )
+    assert r.exit_code == 0, r.output
+    items = json.loads(r.output)
+    assert items[0]["featureChanges"] == {"points": 3}
+    assert items[1]["featureChanges"] == {"points": 10}  # the import
+    # estimation accuracies work too
+    r = runner.invoke(
+        cli, ["log", "-o", "json", "--with-feature-count", "veryfast", "-n", "1"]
+    )
+    assert r.exit_code == 0, r.output
+    assert "featureChanges" in json.loads(r.output)[0]
