@@ -378,11 +378,17 @@ def log(
                 )
 
                 parent = c.parents[0] if c.parents else None
+                # respect the command's dataset filters: counts must cover
+                # the same datasets the rest of the output does
+                ds_paths = (
+                    {f.split(":", 1)[0] for f in filters} if filters else None
+                )
                 item["featureChanges"] = estimate_diff_feature_counts(
                     repo,
                     repo.structure(parent) if parent else None,
                     repo.structure(oid),
                     accuracy=feature_count_accuracy,
+                    ds_paths=ds_paths,
                 )
             out.append(item)
         if output_format == "json":

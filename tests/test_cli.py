@@ -762,3 +762,23 @@ def test_log_with_feature_count(repo_dir, runner):
     )
     assert r.exit_code == 0, r.output
     assert "featureChanges" in json.loads(r.output)[0]
+
+
+def test_log_feature_count_respects_filters(repo_dir, runner):
+    """featureChanges must cover only the filtered datasets (review r4)."""
+    gpkg2 = create_points_gpkg(str(repo_dir.parent / "l2.gpkg"), n=3)
+    con = sqlite3.connect(gpkg2)
+    con.execute("UPDATE gpkg_contents SET table_name='second'")
+    con.execute("ALTER TABLE points RENAME TO second")
+    con.execute("UPDATE gpkg_geometry_columns SET table_name='second'")
+    con.commit()
+    con.close()
+    r = runner.invoke(cli, ["import", str(gpkg2), "--no-checkout"])
+    assert r.exit_code == 0, r.output
+    r = runner.invoke(
+        cli,
+        ["log", "-o", "json", "--with-feature-count", "exact", "points"],
+    )
+    assert r.exit_code == 0, r.output
+    for item in json.loads(r.output):
+        assert set(item["featureChanges"]) <= {"points"}, item
