@@ -226,11 +226,19 @@ def worker():
     classify_blocks_host(b_old, b_new)
     host_rate = base_n / (time.perf_counter() - t0)
 
-    # --- device path
+    # --- device path: the kernel variant production routing would pick for
+    # this backend (sort-join on accelerators, binary-search join on
+    # XLA-CPU — measuring the sort network on CPU benchmarks a variant the
+    # engine never uses there)
+    from kart_tpu.ops.diff_kernel import _classify_padded_binsearch
+
+    kernel = (
+        _classify_padded if info["backend"] != "cpu" else _classify_padded_binsearch
+    )
     args, n_changed = _device_args(n)
     jax.block_until_ready(args)
 
-    out = _classify_padded(*args)  # warmup / compile
+    out = kernel(*args)  # warmup / compile
     jax.block_until_ready(out)
     counts = np.asarray(out[3])
     assert counts[1] == n_changed, (
@@ -239,7 +247,7 @@ def worker():
 
     t0 = time.perf_counter()
     for _ in range(reps):
-        out = _classify_padded(*args)
+        out = kernel(*args)
     jax.block_until_ready(out)
     dev_s = (time.perf_counter() - t0) / reps
     dev_rate = n / dev_s
