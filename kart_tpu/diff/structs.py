@@ -120,9 +120,9 @@ class Delta:
 
     @property
     def key(self):
-        """The key this delta is filed under: new key wins (renames keep the
-        new identity)."""
-        return self.new_key if self.new is not None else self.old_key
+        """The key this delta is filed under: old key wins, so a rename
+        sorts at its ORIGINAL position (reference: diff_structs.py:137-140)."""
+        return self.old_key if self.old is not None else self.new_key
 
     @property
     def old_value(self):

@@ -22,7 +22,6 @@ import functools
 import numpy as np
 
 from kart_tpu.ops.blocks import PAD_KEY, bucket_size
-from kart_tpu.ops.merge_kernel import CONFLICT, TAKE_THEIRS
 from kart_tpu.parallel.mesh import FEATURES_AXIS
 from kart_tpu.parallel.sharded_diff import STATS, _repad, _shard_map, partition_block
 

@@ -43,6 +43,62 @@ class WorkingCopyStatus(IntFlag):
     DIRTY = 0x20
 
 
+MAX_RENAME_SEARCH = 400  # reference: working_copy/base.py find_renames cap
+
+
+def can_find_renames(dataset, meta_diff):
+    """Rename detection is only meaningful while the schema is unchanged
+    (reference: working_copy/base.py:812-827 — type-width updates are
+    tolerated, any other schema edit disables it)."""
+    if meta_diff is None or "schema.json" not in meta_diff:
+        return True
+    delta = meta_diff["schema.json"]
+    if delta.old_value is None or delta.new_value is None:
+        return False
+    from kart_tpu.models.schema import Schema
+
+    old_schema = Schema.from_column_dicts(delta.old_value)
+    new_schema = Schema.from_column_dicts(delta.new_value)
+    counts = dict(old_schema.diff_type_counts(new_schema))
+    counts.pop("type_updates", None)
+    return sum(counts.values()) == 0
+
+
+def find_renames(feature_diff, dataset):
+    """Pair matching insert+delete deltas into pk-rename updates, in place:
+    a feature whose pk changed in the working copy hashes identically
+    without its pk, and the paired delta renders as
+    ``--- ds:feature:old / +++ ds:feature:new`` with only the pk line
+    differing (reference: working_copy/base.py:829-854). At most one
+    insert/delete merges per content hash; bounded by MAX_RENAME_SEARCH
+    insert+delete deltas (content hashing is per-feature Python)."""
+    from kart_tpu.diff.structs import Delta
+
+    candidates = [
+        d for d in feature_diff.values() if d.type in ("insert", "delete")
+    ]
+    if not candidates or len(candidates) > MAX_RENAME_SEARCH:
+        return
+    schema = dataset.schema
+    inserts = {}
+    deletes = {}
+    for delta in candidates:
+        if delta.type == "insert":
+            inserts[schema.hash_feature(delta.new_value, without_pk=True)] = delta
+        else:
+            deletes[schema.hash_feature(delta.old_value, without_pk=True)] = delta
+    for h, delete_delta in deletes.items():
+        insert_delta = inserts.get(h)
+        if insert_delta is None:
+            continue
+        del feature_diff[delete_delta.key]
+        del feature_diff[insert_delta.key]
+        merged = Delta(
+            delete_delta.old, insert_delta.new, flags=delete_delta.flags
+        )
+        feature_diff.add_delta(merged)
+
+
 def checkout_features(repo, ds):
     """Features to materialise in a working copy: the repo's spatial filter
     applied, promised (out-of-filter) blobs skipped — a filtered clone's WC

@@ -587,6 +587,10 @@ class GpkgWorkingCopy:
             result["feature"] = self._diff_features(
                 con, dataset, table, new_schema, ds_filter
             )
+        from kart_tpu.workingcopy import can_find_renames, find_renames
+
+        if can_find_renames(dataset, result["meta"]):
+            find_renames(result["feature"], dataset)
         result.prune()
         return result
 
