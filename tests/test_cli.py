@@ -856,3 +856,17 @@ def test_text_diff_byte_parity_with_reference(tmp_path, runner, monkeypatch):
         "+                               macronated = N",
         "+                                     name = Te Motu-a-kore",
     ]
+
+    # geojson: same scenario, the reference's id scheme and feature set
+    # (test_diff.py:110-175): U-/U+ pairs, D, I, 6 features total
+    r = runner.invoke(cli, ["diff", "--output-format=geojson", "--output=-"])
+    assert r.exit_code == 0, r.output
+    odata = json.loads(r.output)
+    ids = [f["id"] for f in odata["features"]]
+    assert ids == ["U-::1", "U+::9998", "U-::2", "U+::2", "D::3", "I::9999"]
+    by_id = {f["id"]: f for f in odata["features"]}
+    assert by_id["I::9999"]["geometry"]["coordinates"] == [0.0, 0.0]
+    assert by_id["U+::2"]["properties"]["name"] == "test"
+    assert by_id["U+::2"]["properties"]["t50_fid"] is None
+    assert by_id["U-::1"]["properties"]["fid"] == 1
+    assert by_id["U+::9998"]["properties"]["fid"] == 9998
