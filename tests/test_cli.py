@@ -784,10 +784,6 @@ def test_log_feature_count_respects_filters(repo_dir, runner):
         assert set(item["featureChanges"]) <= {"points"}, item
 
 
-@pytest.mark.skipif(
-    not os.path.isdir(os.path.join(os.path.dirname(__file__), "..", "..", "reference", "tests", "data")) and True,
-    reason="never skipped here; guard lives in conftest",
-)
 def test_text_diff_byte_parity_with_reference(tmp_path, runner, monkeypatch):
     """Replicates the reference's test_diff.py text-output scenario on its
     own points fixture — pk rename (paired via find_renames), update with
