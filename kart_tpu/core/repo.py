@@ -499,15 +499,74 @@ class KartRepo:
     def del_config(self, key):
         del self.config[key]
 
+    # git's default gc.auto threshold: below this many loose objects,
+    # `gc --auto` is a no-op
+    GC_AUTO_LOOSE_THRESHOLD = 6700
+
     def gc(self, *args):
-        """Prune temp files. Loose-object store needs no repack."""
-        for dirpath, _, filenames in os.walk(os.path.join(self.gitdir, "objects")):
+        """Pack loose objects into one packfile, then prune temp files —
+        the same effect as the reference's git gc over its ODB. ``--auto``
+        only repacks above git's default loose-object threshold.
+        -> {"packed": n, "pruned": n}."""
+        objects_dir = os.path.join(self.gitdir, "objects")
+        auto = "--auto" in args
+        pruned = 0
+        for dirpath, _, filenames in os.walk(objects_dir):
             for fn in filenames:
                 if ".tmp" in fn:
                     try:
                         os.remove(os.path.join(dirpath, fn))
+                        pruned += 1
                     except OSError:
                         pass
+
+        loose = []
+        for prefix in sorted(os.listdir(objects_dir)):
+            if len(prefix) != 2:
+                continue
+            d = os.path.join(objects_dir, prefix)
+            for name in sorted(os.listdir(d)):
+                if len(name) == 38 and not name.endswith(".tmp"):
+                    loose.append((prefix + name, os.path.join(d, name)))
+        if not loose or (auto and len(loose) < self.GC_AUTO_LOOSE_THRESHOLD):
+            return {"packed": 0, "pruned": pruned}
+
+        from kart_tpu.core.packs import PackWriter
+
+        pack_dir = os.path.join(objects_dir, "pack")
+        with PackWriter(pack_dir) as w:
+            for oid, _path in loose:
+                obj_type, content = self.odb.read_raw(oid)
+                w.add(obj_type, content)
+        # make the new pack visible before the loose copies disappear, and
+        # verify every object is actually served from it
+        self.odb.packs.refresh()
+        from kart_tpu.core.packs import Packfile
+
+        pack = Packfile(w.pack_path, w.idx_path)
+        try:
+            for oid, path in loose:
+                if pack.read(bytes.fromhex(oid)) is None:
+                    raise RuntimeError(
+                        f"gc: object {oid} missing from the new pack"
+                    )
+            for _oid, path in loose:
+                try:
+                    os.remove(path)
+                except OSError:
+                    pass
+        finally:
+            pack.close()
+        # drop now-empty fanout dirs
+        for prefix in os.listdir(objects_dir):
+            if len(prefix) != 2:
+                continue
+            d = os.path.join(objects_dir, prefix)
+            try:
+                os.rmdir(d)
+            except OSError:
+                pass
+        return {"packed": len(loose), "pruned": pruned}
 
 
 def _split_rev_operators(refish):

@@ -232,3 +232,52 @@ def test_git_fsck_on_stored_stream_packs(tmp_path):
         ["git", "ls-tree", "-r", tree.oid], env=env, capture_output=True, text=True
     )
     assert out.returncode == 0 and len(out.stdout.splitlines()) == 200
+
+
+def test_gc_packs_loose_objects(tmp_path):
+    """gc must repack loose objects into a packfile (reference: kart gc
+    delegates to git gc) and everything must stay readable — including to
+    system git."""
+    import subprocess
+
+    from helpers import edit_commit, make_imported_repo
+
+    repo, ds_path = make_imported_repo(tmp_path, n=20)
+    # a few commits create loose trees/commits/blobs alongside import packs
+    for i in range(3):
+        edit_commit(
+            repo, ds_path,
+            updates=[{"fid": 1 + i, "geom": None, "name": f"gc-{i}", "rating": 0.5}],
+            message=f"edit {i}",
+        )
+    objects_dir = os.path.join(repo.gitdir, "objects")
+
+    def loose_count():
+        n = 0
+        for prefix in os.listdir(objects_dir):
+            if len(prefix) == 2:
+                n += len(os.listdir(os.path.join(objects_dir, prefix)))
+        return n
+
+    before = loose_count()
+    assert before > 0
+    # --auto below the threshold: no-op
+    stats = repo.gc("--auto")
+    assert stats["packed"] == 0 and loose_count() == before
+    # full gc repacks everything
+    stats = repo.gc()
+    assert stats["packed"] == before
+    assert loose_count() == 0
+    # all history still readable
+    ds = repo.structure("HEAD").datasets[ds_path]
+    assert ds.get_feature([1])["name"] == "gc-0"
+    assert repo.structure("HEAD~3").datasets[ds_path].get_feature([1])["name"] == "feature-1"
+    env = {
+        **os.environ,
+        "GIT_DIR": repo.gitdir,
+        "GIT_INDEX_FILE": str(tmp_path / "scratch-index"),
+    }
+    out = subprocess.run(
+        ["git", "fsck", "--strict"], env=env, capture_output=True, text=True
+    )
+    assert out.returncode == 0, out.stderr
