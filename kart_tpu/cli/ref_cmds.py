@@ -111,8 +111,16 @@ def config(ctx, key, value, unset):
 @click.argument("args", nargs=-1)
 @click.pass_obj
 def gc(ctx, args):
-    """Clean up the object store."""
-    ctx.repo.gc(*args)
+    """Clean up the object store: pack loose objects, prune temp files.
+    ``--auto`` only repacks above the loose-object threshold."""
+    stats = ctx.repo.gc(*args)
+    if stats and (stats.get("packed") or stats.get("pruned")):
+        click.echo(
+            f"Packed {stats.get('packed', 0)} loose objects; "
+            f"pruned {stats.get('pruned', 0)} temp files."
+        )
+    else:
+        click.echo("Nothing to do.")
 
 
 @cli.command()
