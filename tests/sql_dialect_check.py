@@ -235,9 +235,11 @@ HEADS = {
         r"CREATE TRIGGER",
         r"COMMENT ON",
         r"VACUUM",
+        r"SET",  # session config, e.g. SET intervalstyle = 'iso_8601'
     ],
     MYSQL: _COMMON_HEADS
     + [
+        r"CREATE SPATIAL INDEX",
         r"CREATE DATABASE",
         r"CREATE TRIGGER",
         r"CREATE (OR REPLACE )?SPATIAL REFERENCE SYSTEM",
@@ -247,6 +249,7 @@ HEADS = {
     ],
     MSSQL: _COMMON_HEADS
     + [
+        r"CREATE SPATIAL INDEX",
         r"CREATE TRIGGER",
         r"IF",
         r"EXEC",
