@@ -225,3 +225,72 @@ def test_fake_driver_rejects_wrong_dialect(tmp_path, monkeypatch):
     with pytest.raises(SqlDialectError):
         for sql, _ in driver.statements:
             check_sql(sql.strip().rstrip(";") + ";", MYSQL)
+
+
+def test_postgis_wc_diff_executes(tmp_path, monkeypatch):
+    """The server-DB diff path itself executes: tracked pks stream from the
+    fake _kart_track, WC rows convert through the PG adapter (EWKB in), and
+    diff_dataset_to_working_copy yields exactly the seeded update+insert."""
+    from kart_tpu.crs import WGS84_WKT
+
+    repo, ds_path = make_imported_repo(tmp_path, n=10)
+    driver = FakeServerDriver()
+    monkeypatch.setitem(sys.modules, "psycopg2", driver)
+    repo.config["kart.workingcopy.location"] = (
+        "postgresql://db.example.com/gis/wcschema"
+    )
+    from kart_tpu.workingcopy import get_working_copy
+
+    wc = get_working_copy(repo, allow_uncreated=True)
+    ds = repo.structure("HEAD").datasets[ds_path]
+    old3 = ds.get_feature([3])
+
+    pg_cols = [
+        ("fid", "bigint", "int8", None, 64, 0, 1),
+        ("geom", "USER-DEFINED", "geometry", None, None, None, None),
+        ("name", "text", "text", None, None, None, None),
+        ("rating", "double precision", "float8", None, 53, None, None),
+    ]
+    wc_row_3 = (
+        3,
+        old3["geom"].to_ewkb() if old3["geom"] is not None else None,
+        "edited-on-server",
+        old3["rating"],
+    )
+    wc_row_99 = (99, None, "fresh-row", 0.5)
+
+    base_respond = FakeServerCon.respond
+
+    def respond(self, sql, params):
+        text = " ".join(sql.split()).lower()
+        if "information_schema.tables" in text:
+            return [(1,)]  # the points table exists in the WC
+        if "information_schema.columns c" in text:
+            return pg_cols
+        if text.startswith("select gc.f_geometry_column"):
+            return [("geom", "POINT", 4326, WGS84_WKT)]
+        if text.startswith("select srs.srtext"):
+            return [(WGS84_WKT,)]
+        if "_kart_track" in text and text.startswith("select pk"):
+            return [("3",), ("99",)]
+        if text.startswith("select") and "st_asewkb" in text:
+            return [wc_row_3, wc_row_99]
+        return base_respond(self, sql, params)
+
+    monkeypatch.setattr(FakeServerCon, "respond", respond)
+
+    diff = wc.diff_dataset_to_working_copy(ds)
+    feats = diff["feature"]
+    assert len(feats) == 2
+    upd = feats[3]
+    assert upd.type == "update"
+    assert upd.new_value["name"] == "edited-on-server"
+    assert upd.old_value == old3
+    # geometry supplied as EWKB converted back to identical canonical form
+    assert upd.new_value["geom"] == old3["geom"]
+    ins = feats[99]
+    assert ins.type == "insert"
+    assert ins.new_value["name"] == "fresh-row"
+    # every statement the diff issued validates as PostgreSQL
+    for sql, _ in driver.statements:
+        check_sql(sql.strip().rstrip(";") + ";", PG)
