@@ -294,3 +294,53 @@ def test_postgis_wc_diff_executes(tmp_path, monkeypatch):
     # every statement the diff issued validates as PostgreSQL
     for sql, _ in driver.statements:
         check_sql(sql.strip().rstrip(";") + ";", PG)
+
+
+@pytest.mark.parametrize("name,module,location,dialect", CASES)
+def test_incremental_reset_executes_upserts(
+    tmp_path, monkeypatch, name, module, location, dialect
+):
+    """checkout -> commit -> reset drives the incremental path: the
+    dialect's upsert (ON CONFLICT / REPLACE INTO / MERGE) executes under
+    suspended triggers and the state tree advances."""
+    from helpers import edit_commit
+
+    repo, ds_path = make_imported_repo(tmp_path, n=10)
+    driver = FakeServerDriver()
+    monkeypatch.setitem(sys.modules, module.split(":")[1], driver)
+    repo.config["kart.workingcopy.location"] = location
+    from kart_tpu.workingcopy import get_working_copy
+
+    wc = get_working_copy(repo, allow_uncreated=True)
+    wc.create_and_initialise()
+    head1 = repo.structure("HEAD")
+    wc.write_full(head1, *head1.datasets)
+    assert wc.get_db_tree() == head1.tree_oid
+
+    edit_commit(
+        repo, ds_path,
+        updates=[{"fid": 4, "geom": None, "name": "reset-me", "rating": 2.5}],
+        deletes=[7],
+        message="server reset edit",
+    )
+    head2 = repo.structure("HEAD")
+    driver.statements.clear()
+    driver.many_counts.clear()
+    wc.reset(head2)
+
+    assert wc.get_db_tree() == head2.tree_oid
+    stream = [s for s, _ in driver.statements] + list(driver.many_counts)
+    upserts = [
+        s
+        for s in stream
+        if "ON CONFLICT" in s or "REPLACE INTO" in s or s.lstrip().upper().startswith("MERGE")
+    ]
+    assert upserts, "no upsert statement executed during reset"
+    deletes = [s for s, p in driver.statements if s.lstrip().upper().startswith("DELETE FROM") and p]
+    assert deletes, "no targeted delete executed during reset"
+    # triggers suspended + restored around the apply
+    drops = [s for s, _ in driver.statements if "DROP TRIGGER" in s.upper() or "DISABLE TRIGGER" in s.upper()]
+    assert drops, "triggers were not suspended"
+    # every statement valid in the dialect
+    for s in stream:
+        check_sql(s.strip().rstrip(";") + ";", dialect)
