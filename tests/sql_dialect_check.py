@@ -256,6 +256,12 @@ HEADS = {
         r"DECLARE",
         r"MERGE",
         r"SET",
+        # T-SQL trigger suspension during incremental reset:
+        # DISABLE/ENABLE TRIGGER <name> ON <table>.  These statement heads
+        # exist only in T-SQL (PG spells it ALTER TABLE ... DISABLE TRIGGER;
+        # MySQL has no trigger suspension at all).
+        r"DISABLE TRIGGER",
+        r"ENABLE TRIGGER",
     ],
 }
 
@@ -361,6 +367,10 @@ def check_statement(stmt_tokens, dialect):
                 _err(dialect, "T-SQL trigger without ON ... AFTER/INSTEAD OF", joined)
             if " AS " not in joined:
                 _err(dialect, "T-SQL trigger without AS body", joined)
+    if dialect == MSSQL and re.match(r"(DISABLE|ENABLE) TRIGGER", head):
+        if " ON " not in joined:
+            _err(dialect, "T-SQL DISABLE/ENABLE TRIGGER without ON <table>",
+                 joined)
     if dialect == PG and re.match(r"CREATE (OR REPLACE )?FUNCTION", head):
         if re.search(r"RETURNS TRIGGER", joined) and "LANGUAGE" not in upper_words:
             _err(dialect, "PG trigger function without LANGUAGE clause", joined)

@@ -120,6 +120,21 @@ class TestSeededErrors:
         check_column_spec("`a` POINT SRID 4326", MYSQL)
         check_column_spec('"a" VARBINARY(max)', MSSQL)
 
+    def test_trigger_suspension_is_tsql_only(self):
+        # valid T-SQL (emitted during the sqlserver incremental reset)
+        check_sql('DISABLE TRIGGER "tg" ON "sch" . "t";', MSSQL)
+        check_sql('ENABLE TRIGGER "tg" ON "sch" . "t";', MSSQL)
+        # the bare statement head exists only in T-SQL — PG spells it
+        # ALTER TABLE ... DISABLE TRIGGER, MySQL has no trigger suspension
+        for d in (PG, MYSQL):
+            with pytest.raises(SqlDialectError, match="not in the"):
+                check_sql("DISABLE TRIGGER tg ON t;", d)
+            with pytest.raises(SqlDialectError, match="not in the"):
+                check_sql("ENABLE TRIGGER tg ON t;", d)
+        # and the T-SQL form still requires its ON <table> clause
+        with pytest.raises(SqlDialectError, match="without ON"):
+            check_sql('DISABLE TRIGGER "tg";', MSSQL)
+
     def test_gibberish_statement(self):
         with pytest.raises(SqlDialectError):
             check_sql("FLARB THE WIBBLE;", PG)

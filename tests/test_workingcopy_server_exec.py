@@ -338,9 +338,36 @@ def test_incremental_reset_executes_upserts(
     assert upserts, "no upsert statement executed during reset"
     deletes = [s for s, p in driver.statements if s.lstrip().upper().startswith("DELETE FROM") and p]
     assert deletes, "no targeted delete executed during reset"
-    # triggers suspended + restored around the apply
-    drops = [s for s, _ in driver.statements if "DROP TRIGGER" in s.upper() or "DISABLE TRIGGER" in s.upper()]
-    assert drops, "triggers were not suspended"
+    # triggers suspended + restored around the apply: every suspend has a
+    # matching restore AFTER it in the statement stream (round-trip), and
+    # the upserts execute inside the suspended window
+    uppers = [s.upper() for s, _ in driver.statements]
+    suspend_ix = [
+        i for i, s in enumerate(uppers)
+        if "DROP TRIGGER" in s or "DISABLE TRIGGER" in s
+    ]
+    restore_ix = [
+        i for i, s in enumerate(uppers)
+        if "CREATE TRIGGER" in s or "ENABLE TRIGGER" in s
+    ]
+    assert suspend_ix, "triggers were not suspended"
+    assert restore_ix, "triggers were not restored after the apply"
+    assert len(suspend_ix) == len(restore_ix), (
+        "suspend/restore pair mismatch: "
+        f"{len(suspend_ix)} suspends vs {len(restore_ix)} restores"
+    )
+    assert max(suspend_ix) < min(restore_ix), (
+        "trigger restore executed before suspension completed"
+    )
+    upsert_ix = [
+        i for i, s in enumerate(uppers)
+        if "ON CONFLICT" in s or "REPLACE INTO" in s
+        or s.lstrip().startswith("MERGE")
+    ]
+    assert upsert_ix, "no upsert recorded in the positional stream"
+    assert max(suspend_ix) < min(upsert_ix) and max(upsert_ix) < min(restore_ix), (
+        "upserts must execute inside the trigger-suspended window"
+    )
     # every statement valid in the dialect
     for s in stream:
         check_sql(s.strip().rstrip(";") + ";", dialect)
