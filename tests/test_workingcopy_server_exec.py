@@ -359,10 +359,12 @@ def test_incremental_reset_executes_upserts(
     assert max(suspend_ix) < min(restore_ix), (
         "trigger restore executed before suspension completed"
     )
+    # classify by statement head: a MySQL CREATE TRIGGER restore contains
+    # REPLACE INTO in its body but is not itself an upsert
     upsert_ix = [
         i for i, s in enumerate(uppers)
-        if "ON CONFLICT" in s or "REPLACE INTO" in s
-        or s.lstrip().startswith("MERGE")
+        if (s.lstrip().startswith(("REPLACE INTO", "MERGE")))
+        or (s.lstrip().startswith("INSERT") and "ON CONFLICT" in s)
     ]
     assert upsert_ix, "no upsert recorded in the positional stream"
     assert max(suspend_ix) < min(upsert_ix) and max(upsert_ix) < min(restore_ix), (
