@@ -219,12 +219,17 @@ def worker():
 
     # --- the production HOST engine (native C++ merge-join): what the cost
     # model actually routes CPU deployments to — so even a CPU-fallback
-    # record carries the real production-vs-reference win
+    # record carries the real production-vs-reference win. Measured like
+    # the device path: at full n, warmed, averaged over reps (on a CPU
+    # fallback this rate IS the headline).
     from kart_tpu.ops.diff_kernel import classify_blocks_host
 
+    h_old, h_new, _ = _build_np(n) if n != base_n else (b_old, b_new, None)
+    classify_blocks_host(h_old, h_new)  # warmup: native lib load, first touch
     t0 = time.perf_counter()
-    classify_blocks_host(b_old, b_new)
-    host_rate = base_n / (time.perf_counter() - t0)
+    for _ in range(reps):
+        classify_blocks_host(h_old, h_new)
+    host_rate = n / ((time.perf_counter() - t0) / reps)
 
     # --- device path: the kernel variant production routing would pick for
     # this backend (sort-join on accelerators, binary-search join on
@@ -257,16 +262,23 @@ def worker():
     bbox = _bbox_bench()
     est = _estimation_bench()
 
+    # The headline value is the rate of the engine `classify_blocks` would
+    # actually route to on this backend (VERDICT r4 weak #5): the native
+    # host merge-join on XLA-CPU fallback (device_profitable routes CPU
+    # backends to it at every size), the device kernel on an accelerator.
+    # The unrouted kernel rate stays as a secondary key.
+    routed_rate = host_rate if info["backend"] == "cpu" else dev_rate
     record = {
         "metric": "features_diffed_per_sec_10M_attr_diff",
-        "value": round(dev_rate),
+        "value": round(routed_rate),
         "unit": "features/s",
         # BASELINE.json's CPU baseline is the *reference's* measured
         # per-feature hot loop (SURVEY §6: "must be measured, not
         # copied"); the numpy vectorized twin is our own far
         # stricter implementation, reported alongside
-        "vs_baseline": round(dev_rate / ref_rate, 1),
-        "vs_numpy_twin": round(dev_rate / cpu_rate, 2),
+        "vs_baseline": round(routed_rate / ref_rate, 1),
+        "vs_numpy_twin": round(routed_rate / cpu_rate, 2),
+        "device_kernel_rate": round(dev_rate),
         "backend": info["backend"],
         "device_kind": info["device_kind"],
         "n_devices": info["n_devices"],

@@ -394,16 +394,25 @@ class PackCollection:
         # the racy hole only needs *a* rescan after the granule, not one
         # per miss.
         now = time.time_ns()
-        if now - getattr(self, "_last_refresh_ns", 0) < 200_000_000:
-            return False
+        rate_limited = now - getattr(self, "_last_refresh_ns", 0) < 200_000_000
         scan_wall = getattr(self, "_scan_walltime_ns", 0)
         for d in self.pack_dirs:
             try:
                 mtime = os.stat(d).st_mtime_ns
             except OSError:
                 mtime = None
-            if self._scan_mtimes.get(d) != mtime or (
-                mtime is not None and scan_wall - mtime < self._RACY_NS
+            if self._scan_mtimes.get(d) != mtime:
+                # directory visibly changed since the scan: always rescan —
+                # the rate limit only covers the speculative racy-window
+                # rescan, never a real change (a pack that landed within
+                # 200ms of the previous refresh must still become visible)
+                self._last_refresh_ns = now
+                self.refresh()
+                return True
+            if (
+                mtime is not None
+                and scan_wall - mtime < self._RACY_NS
+                and not rate_limited
             ):
                 self._last_refresh_ns = now
                 self.refresh()

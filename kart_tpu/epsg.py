@@ -34,6 +34,7 @@ ELLIPSOIDS = {
     7022: ("International 1924", 6378388.0, 297.0),
     7024: ("Krassowsky 1940", 6378245.0, 298.3),
     7043: ("WGS 72", 6378135.0, 298.26),
+    7050: ("GRS 1967 Modified", 6378160.0, 298.25),
     1024: ("CGCS2000", 6378137.0, 298.257222101),
 }
 
@@ -70,7 +71,7 @@ GEOGRAPHIC = {
     6668: ("JGD2011", "Japanese_Geodetic_Datum_2011", 1128, 7019, (0, 0, 0)),
     4490: ("China Geodetic Coordinate System 2000", "China_2000", 1043, 1024, None),
     4674: ("SIRGAS 2000", "Sistema_de_Referencia_Geocentrico_para_las_AmericaS_2000", 6674, 7019, (0, 0, 0)),
-    4618: ("SAD69", "South_American_Datum_1969", 6618, 7019, (-57, 1, -41)),
+    4618: ("SAD69", "South_American_Datum_1969", 6618, 7050, (-57, 1, -41)),
     4202: (
         "AGD66",
         "Australian_Geodetic_Datum_1966",

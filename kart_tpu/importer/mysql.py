@@ -98,7 +98,25 @@ class MySqlImportSource(ImportSource):
             return
         con = _connect(*self.url_parts)
         try:
+            # PK column sequence first: information_schema.columns has no key
+            # ordering, so PRIMARY KEY (b, a) would otherwise come out in
+            # table-column order (a, b) — wrong feature paths/keys (the
+            # reference reflects SQLAlchemy's PK-constraint order)
             cur = con.cursor()
+            cur.execute(
+                """
+                SELECT column_name, ordinal_position
+                FROM information_schema.key_column_usage
+                WHERE table_schema = %s AND table_name = %s
+                  AND constraint_name = 'PRIMARY'
+                """,
+                (self.dbname, self.table_name),
+            )
+            pk_order = {}
+            for pk_name, pk_pos in cur.fetchall():
+                if isinstance(pk_name, bytes):
+                    pk_name = pk_name.decode()
+                pk_order[pk_name] = int(pk_pos) - 1
             cur.execute(
                 """
                 SELECT C.column_name, C.data_type,
@@ -112,16 +130,21 @@ class MySqlImportSource(ImportSource):
             )
             cols = []
             crs_defs = {}
-            pk_counter = 0
             for (name, data_type, char_len, num_prec, num_scale, column_key,
                  srs_id) in cur.fetchall():
                 if isinstance(data_type, bytes):
                     data_type = data_type.decode()
+                if isinstance(name, bytes):
+                    name = name.decode()
+                if isinstance(column_key, bytes):
+                    column_key = column_key.decode()
                 sql_type = (data_type or "").upper()
-                pk_index = None
-                if column_key == "PRI":
-                    pk_index = pk_counter
-                    pk_counter += 1
+                pk_index = pk_order.get(name)
+                if pk_index is None and column_key == "PRI":
+                    # key_column_usage gave nothing (odd fake/permission
+                    # setups): fall back to column order
+                    pk_index = len(pk_order)
+                    pk_order[name] = pk_index
                 if sql_type in MySqlAdapter.GEOMETRY_TYPES:
                     extra = {}
                     if sql_type != "GEOMETRY":

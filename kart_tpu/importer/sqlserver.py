@@ -8,8 +8,10 @@ NotFound when pyodbc is missing. Spec format:
 
 With no table, every table in the schema (default ``dbo``) that has a
 primary key is imported. SQL Server stores no CRS definitions (only SRIDs
-on values), so imported geometry columns carry an EPSG identifier without
-a WKT body, same as the working copy (reference: sqlserver adapter notes).
+on values), so the importer samples one value's STSrid per geometry column:
+the column carries ``EPSG:<srid>`` and, when the built-in EPSG registry
+knows the code, a registry-synthesised WKT definition (reference: sqlserver
+adapter notes).
 """
 
 from urllib.parse import unquote, urlsplit
@@ -51,6 +53,7 @@ class SqlServerImportSource(ImportSource):
         self.table_name = table_name
         self.dest_path = dest_path or table_name
         self._schema = None
+        self._crs_defs = {}
 
     @classmethod
     def parse_spec(cls, spec):
@@ -136,7 +139,21 @@ class SqlServerImportSource(ImportSource):
                 pk_index = pk_pos - 1 if pk_pos is not None else None
                 sql_type = (data_type or "").upper()
                 if sql_type in ("GEOMETRY", "GEOGRAPHY"):
+                    # SQL Server stores SRIDs only on values — sample one so
+                    # the imported column keeps its CRS identity (the
+                    # reference records EPSG:<srid> the same way)
                     data_type_v2, extra = "geometry", {}
+                    srid = self._sample_srid(con, name)
+                    if srid:
+                        ident = f"EPSG:{srid}"
+                        extra = {"geometryCRS": ident}
+                        # SQL Server stores no WKT bodies; synthesise one
+                        # from the registry so checkout keeps the CRS
+                        from kart_tpu.epsg import epsg_wkt
+
+                        wkt = epsg_wkt(srid)
+                        if wkt:
+                            self._crs_defs[ident] = wkt
                 else:
                     if (
                         sql_type in ("NVARCHAR", "VARCHAR", "NCHAR", "CHAR")
@@ -172,13 +189,32 @@ class SqlServerImportSource(ImportSource):
         finally:
             con.close()
 
+    def _sample_srid(self, con, col_name):
+        """SRID of the first non-NULL value in a geometry/geography column,
+        or 0/None when the table is empty or the query fails."""
+        q = SqlServerAdapter.quote(col_name)
+        try:
+            cur = con.cursor()
+            cur.execute(
+                f"SELECT TOP 1 {q}.STSrid FROM "
+                f"{SqlServerAdapter.quote_table(self.table_name, self.db_schema)} "
+                f"WHERE {q} IS NOT NULL"
+            )
+            row = cur.fetchone()
+        except Exception:
+            return None
+        return int(row[0]) if row and row[0] else None
+
     @property
     def schema(self) -> Schema:
         self._load_schema()
         return self._schema
 
     def crs_definitions(self):
-        return {}  # SQL Server stores no CRS definitions, only SRIDs
+        # SQL Server stores no CRS definitions, only SRIDs on values — the
+        # definitions here are registry-synthesised from the sampled SRID
+        self._load_schema()
+        return dict(self._crs_defs)
 
     # -- features -------------------------------------------------------------
 

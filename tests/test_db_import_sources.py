@@ -89,6 +89,8 @@ from kart_tpu.crs import WGS84_WKT  # noqa: E402
 MYSQL_RESPONSES = [
     # open_all table listing
     ("column_key = 'pri'", [("roads",)]),
+    # PK column sequence (information_schema.key_column_usage)
+    ("key_column_usage", [("fid", 1)]),
     # schema introspection: name, data_type, char_len, num_prec, num_scale,
     # column_key, srs_id
     (
@@ -116,6 +118,7 @@ MSSQL_RESPONSES = [
             ("rating", "float", None, 53, None, None),
         ],
     ),
+    ("stsrid", [(4326,)]),
     ("count(*)", [(3,)]),
     ("select", ROWS),
 ]
@@ -181,6 +184,30 @@ def test_mysql_spec_with_table_and_port(monkeypatch):
     assert not fake.connect_calls  # explicit table: no listing connection
 
 
+def test_mysql_composite_pk_order(monkeypatch):
+    """PRIMARY KEY (b, a) must yield pk tuple (b, a) even though the table's
+    column order is (a, b) — pk sequence comes from key_column_usage, not
+    column order (ADVICE r4)."""
+    from kart_tpu.importer.mysql import MySqlImportSource
+
+    responses = [
+        ("key_column_usage", [("b", 1), ("a", 2)]),
+        (
+            "from information_schema.columns c",
+            [
+                ("a", "bigint", None, 19, 0, "PRI", None),
+                ("b", "varchar", 10, None, None, "PRI", None),
+                ("v", "double", None, 22, None, "", None),
+            ],
+        ),
+    ]
+    fake = FakeDriverModule(responses)
+    monkeypatch.setitem(sys.modules, "pymysql", fake)
+    (src,) = MySqlImportSource.open_all("mysql://h/gis/pairs")
+    pk_cols = {c.name: c.pk_index for c in src.schema.columns}
+    assert pk_cols == {"b": 0, "a": 1, "v": None}
+
+
 def test_sqlserver_import_full_pipeline(repo, monkeypatch):
     from kart_tpu.importer.importer import import_sources
     from kart_tpu.importer.sqlserver import SqlServerImportSource
@@ -190,7 +217,12 @@ def test_sqlserver_import_full_pipeline(repo, monkeypatch):
     sources = SqlServerImportSource.open_all("mssql://db.example.com/gis")
     assert len(sources) == 1
     import_sources(repo, sources)
-    _assert_imported(repo, crs_expected=False)
+    # registry-synthesised WKT definition from the sampled SRID
+    _assert_imported(repo, crs_expected=True)
+    # the sampled value SRID flowed into the column's CRS identity
+    ds = repo.structure("HEAD").datasets["roads"]
+    geom_col = next(c for c in ds.schema.columns if c.name == "geom")
+    assert geom_col.extra_type_info.get("geometryCRS") == "EPSG:4326"
 
 
 def test_driver_gates():
