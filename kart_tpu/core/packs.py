@@ -105,6 +105,34 @@ class PackIndex:
     def __contains__(self, sha):
         return self._bisect(sha) is not None
 
+    def offsets_of_batch(self, shas):
+        """[20-byte sha] -> np.int64 offsets (-1 where absent), via one
+        vectorized searchsorted over the mmap'd sha table instead of a
+        Python bisect per sha (was ~16us/object at batch-materialise
+        scale). S20 comparison is memcmp over the full width for
+        fixed-size entries — exactly the .idx sort order."""
+        import numpy as np
+
+        arr = getattr(self, "_sha_arr", None)
+        if arr is None:
+            arr = np.frombuffer(
+                self._mm, dtype="S20", count=self.count, offset=self._sha_base
+            )
+            self._sha_arr = arr
+        q = np.frombuffer(b"".join(shas), dtype="S20")
+        pos = np.searchsorted(arr, q)
+        pos_c = np.minimum(pos, self.count - 1)
+        hit = (pos < self.count) & (arr[pos_c] == q)
+        offs = np.frombuffer(
+            self._mm, dtype=">u4", count=self.count, offset=self._off_base
+        )[pos_c].astype(np.int64)
+        out = np.where(hit, offs, -1)
+        # 64-bit offsets (>=2GiB packs) carry the high bit; resolve each
+        big = np.nonzero(hit & (offs & 0x80000000 != 0))[0]
+        for i in big:
+            out[i] = self._offset_at(int(pos[i]))
+        return out
+
     def iter_shas(self):
         for i in range(self.count):
             yield self._sha_at(i)
@@ -296,10 +324,9 @@ class Packfile:
 
         import numpy as np
 
+        offs = self.index.offsets_of_batch(shas)
         found = [
-            (off, sha)
-            for sha in shas
-            if (off := self.index.offset_of(sha)) is not None
+            (int(off), sha) for off, sha in zip(offs, shas) if off >= 0
         ]
         if not found:
             return {}

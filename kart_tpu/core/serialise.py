@@ -48,6 +48,19 @@ def msg_unpack(data):
     return msgpack.unpackb(data, raw=False, ext_hook=_unpack_ext_hook)
 
 
+def _unpack_ext_raw_hook(code, data):
+    if code == GEOMETRY_EXT_CODE:
+        return data
+    return msgpack.ExtType(code, data)
+
+
+def msg_unpack_ext_raw(data):
+    """Like msg_unpack, but geometry ext payloads come back as raw GPKG
+    blob bytes instead of Geometry objects — for fused decode paths that
+    hex/parse the bytes directly without per-value object construction."""
+    return msgpack.unpackb(data, raw=False, ext_hook=_unpack_ext_raw_hook)
+
+
 def json_pack(value) -> bytes:
     return json.dumps(value).encode("utf8")
 

@@ -207,22 +207,24 @@ def get_feature_diff_columnar(base_ds, target_ds, ds_filter=None, *, blocks=None
 
     # values resolve by oid straight from the sidecar columns — no
     # per-feature path->tree walk at materialisation time (measured ~500us
-    # per feature at 10M-polygon scale, dominated by uncached parse_tree)
+    # per feature at 10M-polygon scale, dominated by uncached parse_tree).
+    # Oid hexes are unpacked for all changed rows in two vectorized passes
+    # instead of one 5-word view per row.
     from kart_tpu.ops.blocks import unpack_oid_hex
 
+    old_hex = dict(zip((int(i) for i in old_idx), unpack_oid_hex(old_block.oids[old_idx]))) if len(old_idx) else {}
+    new_hex = dict(zip((int(i) for i in new_idx), unpack_oid_hex(new_block.oids[new_idx]))) if len(new_idx) else {}
     new_row_by_key = {int(new_block.keys[i]): int(i) for i in new_idx}
 
-    def _oid_hex(block, i):
-        return unpack_oid_hex(block.oids[i : i + 1])[0]
-
     for i in old_idx:
-        pks = _pks_for_index(old_block, base_ds, int(i))
+        i = int(i)
+        pks = _pks_for_index(old_block, base_ds, i)
         key = pks[0] if len(pks) == 1 else pks
         if feature_filter is not None and key not in feature_filter:
             continue
         cls = old_class[i]
         old_kv = KeyValue(
-            (key, base_ds.get_feature_promise_from_oid(pks, _oid_hex(old_block, i)))
+            (key, base_ds.get_feature_promise_from_oid(pks, old_hex[i]))
         )
         if cls == DELETE:
             result.add_delta(Delta.delete(old_kv))
@@ -231,23 +233,24 @@ def get_feature_diff_columnar(base_ds, target_ds, ds_filter=None, *, blocks=None
             new_kv = KeyValue(
                 (
                     key,
-                    target_ds.get_feature_promise_from_oid(pks, _oid_hex(new_block, j))
+                    target_ds.get_feature_promise_from_oid(pks, new_hex[j])
                     if j is not None
                     else target_ds.get_feature_promise(pks),
                 )
             )
             result.add_delta(Delta.update(old_kv, new_kv))
     for i in new_idx:
+        i = int(i)
         if new_class[i] != INSERT:
             continue  # updates already added
-        pks = _pks_for_index(new_block, target_ds, int(i))
+        pks = _pks_for_index(new_block, target_ds, i)
         key = pks[0] if len(pks) == 1 else pks
         if feature_filter is not None and key not in feature_filter:
             continue
         result.add_delta(
             Delta.insert(
                 KeyValue(
-                    (key, target_ds.get_feature_promise_from_oid(pks, _oid_hex(new_block, int(i))))
+                    (key, target_ds.get_feature_promise_from_oid(pks, new_hex[i]))
                 )
             )
         )

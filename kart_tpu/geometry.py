@@ -78,6 +78,23 @@ def _iso_type(base, has_z, has_m):
     return base + (1000 if has_z else 0) + (2000 if has_m else 0)
 
 
+def gpkg_hex_wkb(buf):
+    """GPKG geometry blob bytes -> upper-hex little-endian ISO WKB (the JSON
+    diff representation) without constructing a Geometry object — the fused
+    blob->JSON decode path. Falls back to the Geometry slow path for
+    big-endian WKB (needs a rewrite) and anything malformed (raises the
+    proper GeometryError)."""
+    if len(buf) >= 9 and buf[:2] == b"GP" and buf[2] == 0:
+        flags = buf[3]
+        if not flags & EXTENDED_BIT:
+            n = _ENVELOPE_DOUBLES.get((flags & ENVELOPE_BITS) >> 1)
+            if n is not None:
+                off = 8 + n * 8
+                if len(buf) == off or buf[off] == 1:  # empty or LE WKB
+                    return buf[off:].hex().upper()
+    return Geometry.of(buf).to_hex_wkb()
+
+
 class Geometry(bytes):
     """Immutable GPKG-binary geometry value (subclass of bytes)."""
 

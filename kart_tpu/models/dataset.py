@@ -36,6 +36,7 @@ from kart_tpu.core.serialise import (
     json_unpack,
     msg_pack,
     msg_unpack,
+    msg_unpack_ext_raw,
 )
 from kart_tpu.models.paths import PathEncoder, encoder_for_schema
 from kart_tpu.models.schema import Legend, Schema
@@ -52,6 +53,35 @@ class IntegrityError(ValueError):
 
 class NotYetImplemented(RuntimeError):
     pass
+
+
+class FeatureOidPromise:
+    """Zero-arg callable resolving a feature dict from its blob oid.
+
+    Unlike an opaque closure, the oid/dataset are open attributes so delta
+    consumers (diff writers) can batch-prefetch many promises' blob data in
+    one native batch pack inflate (``odb.read_blobs_batch``) and stash it on
+    ``data`` — the per-feature pack bisect + single-shot inflate was ~55us
+    of the ~80us/feature materialisation cost at 10M-polygon scale
+    (reference's equivalent loop: kart/base_diff_writer.py:279-341).
+    Tri-state semantics are unchanged: an unprefetched promised blob raises
+    ObjectPromised from the per-object read exactly as before."""
+
+    __slots__ = ("ds", "pk_values", "oid_hex", "data")
+
+    def __init__(self, ds, pk_values, oid_hex):
+        self.ds = ds
+        self.pk_values = pk_values
+        self.oid_hex = oid_hex
+        self.data = None
+
+    def __call__(self):
+        data = self.data
+        if data is None:
+            data = self.ds._feature_odb().read_blob(self.oid_hex)
+        else:
+            self.data = None  # one-shot: free the blob bytes after decode
+        return self.ds.get_feature(self.pk_values, data=data)
 
 
 class DatasetCapabilityError(RuntimeError):
@@ -294,6 +324,55 @@ class Dataset3:
         """-> zero-arg callable that reads the feature lazily."""
         return functools.partial(self.get_feature, pk_values, path=path)
 
+    def _json_plan(self, legend_hash):
+        """Per-legend decode plan for :meth:`feature_json_from_data`:
+        [(column name, (is_pk, value index) | None, is_geometry)] in schema
+        order — the same column resolution get_feature performs through
+        Legend.to_raw_dict + Schema.feature_from_raw_dict, precomputed."""
+        plans = self.__dict__.setdefault("_json_plans", {})
+        plan = plans.get(legend_hash)
+        if plan is None:
+            legend = self.get_legend(legend_hash)
+            pk_pos = {cid: i for i, cid in enumerate(legend.pk_columns)}
+            nonpk_pos = {cid: i for i, cid in enumerate(legend.non_pk_columns)}
+            plan = []
+            for c in self.schema.columns:
+                if c.id in pk_pos:
+                    src = (True, pk_pos[c.id])
+                elif c.id in nonpk_pos:
+                    src = (False, nonpk_pos[c.id])
+                else:
+                    src = None  # column added since this legend: None value
+                plan.append((c.name, src, c.data_type == "geometry"))
+            plans[legend_hash] = plan
+        return plan
+
+    def feature_json_from_data(self, pk_values, data):
+        """Feature blob bytes -> JSON-ready dict (geometry as upper-hex WKB,
+        bytes as hex), bit-identical to
+        ``feature_as_json(self.get_feature(pk_values, data=data))`` but in
+        one dict build with no Geometry construction — the hot
+        materialisation path of `diff -o json/json-lines` (the reference's
+        per-feature loop: kart/dataset3.py:185-223 + feature_output.py:34)."""
+        from kart_tpu.geometry import gpkg_hex_wkb
+
+        legend_hash, non_pk_values = msg_unpack_ext_raw(data)
+        out = {}
+        for name, src, is_geom in self._json_plan(legend_hash):
+            v = None
+            if src is not None:
+                is_pk, i = src
+                seq = pk_values if is_pk else non_pk_values
+                if i < len(seq):
+                    v = seq[i]
+            if v is not None:
+                if is_geom:
+                    v = gpkg_hex_wkb(v)
+                elif isinstance(v, bytes):
+                    v = v.hex()
+            out[name] = v
+        return out
+
     def get_feature_from_oid(self, pk_values, oid_hex):
         """Feature dict resolved straight from its blob oid. The diff
         engines already know each changed feature's oid (tree-diff entries /
@@ -302,14 +381,27 @@ class Dataset3:
         10M-polygon scale — is skipped entirely. Tri-state semantics are
         unchanged: a promised blob raises ObjectPromised from the odb read
         exactly as the path walk would."""
-        tree = self.feature_tree
-        odb = tree.odb if tree is not None else self.repo.odb
-        return self.get_feature(pk_values, data=odb.read_blob(oid_hex))
+        return self.get_feature(
+            pk_values, data=self._feature_odb().read_blob(oid_hex)
+        )
 
     def get_feature_promise_from_oid(self, pk_values, oid_hex):
         """-> zero-arg callable; like get_feature_promise but resolves via
-        the known blob oid instead of the feature path."""
-        return functools.partial(self.get_feature_from_oid, pk_values, oid_hex)
+        the known blob oid instead of the feature path. The promise carries
+        its oid openly (:class:`FeatureOidPromise`) so delta consumers can
+        batch-prefetch blob data through the native batch pack reader."""
+        return FeatureOidPromise(self, pk_values, oid_hex)
+
+    def _feature_odb(self):
+        """Object store feature blobs resolve from (cached: the tree walk
+        behind :attr:`feature_tree` costs ~13us and the materialisation path
+        used to pay it once per feature)."""
+        odb = self.__dict__.get("_feature_odb_cache")
+        if odb is None:
+            tree = self.feature_tree
+            odb = tree.odb if tree is not None else self.repo.odb
+            self.__dict__["_feature_odb_cache"] = odb
+        return odb
 
     def features(self, spatial_filter=None, log_progress=False, skip_promised=False):
         """Stream all features (schema order). Bulk columnar access should
