@@ -1131,9 +1131,258 @@ def _somerc_inverse(crs, x, y):
     return np.degrees(lon), np.degrees(lat)
 
 
+def _hom_setup(crs, variant_b):
+    """Hotine Oblique Mercator (EPSG method 9812 variant A / 9815 variant
+    B): constants per EPSG Guidance Note 7-2. Variant B references
+    false coordinates to the projection centre (Ec, Nc); variant A to the
+    natural origin (intersection of the aposphere equator and centre
+    line)."""
+    a = crs.semi_major
+    e2 = _e2_of(crs)
+    e = math.sqrt(e2)
+    p = crs.params
+    phic = math.radians(p.get("latitude_of_center", 0.0))
+    lonc = math.radians(p.get("longitude_of_center", 0.0))
+    alphac = math.radians(p.get("azimuth", 90.0))
+    gammac = math.radians(p.get("rectified_grid_angle", p.get("azimuth", 90.0)))
+    kc = p.get("scale_factor", 1.0)
+    fe = p.get("false_easting", 0.0)
+    fn = p.get("false_northing", 0.0)
+    sc = math.sin(phic)
+    big_b = math.sqrt(1 + e2 * math.cos(phic) ** 4 / (1 - e2))
+    big_a = a * big_b * kc * math.sqrt(1 - e2) / (1 - e2 * sc * sc)
+    t0 = math.tan(math.pi / 4 - phic / 2) / (
+        (1 - e * sc) / (1 + e * sc)
+    ) ** (e / 2)
+    big_d = big_b * math.sqrt(1 - e2) / (
+        math.cos(phic) * math.sqrt(1 - e2 * sc * sc)
+    )
+    d2 = max(big_d * big_d, 1.0)
+    sign = 1.0 if phic >= 0 else -1.0
+    big_f = big_d + math.sqrt(d2 - 1) * sign
+    big_h = big_f * t0**big_b
+    big_g = (big_f - 1 / big_f) / 2
+    gamma0 = math.asin(min(1.0, max(-1.0, math.sin(alphac) / big_d)))
+    lon0 = lonc - math.asin(
+        min(1.0, max(-1.0, big_g * math.tan(gamma0)))
+    ) / big_b
+    uc = 0.0
+    if variant_b:
+        if abs(abs(alphac) - math.pi / 2) < 1e-12:
+            uc = big_a * (lonc - lon0)
+        else:
+            uc = (big_a / big_b) * math.atan2(
+                math.sqrt(d2 - 1), math.cos(alphac)
+            ) * sign
+    return e, e2, big_a, big_b, big_h, gamma0, gammac, lon0, uc, fe, fn, sign
+
+
+def _hom_forward(crs, lon_deg, lat_deg, variant_b):
+    e, e2, A, B, H, g0, gc, lon0, uc, fe, fn, sign = _hom_setup(crs, variant_b)
+    lon = np.radians(np.asarray(lon_deg, dtype=np.float64))
+    lat = np.radians(
+        np.clip(np.asarray(lat_deg, dtype=np.float64), -89.9999, 89.9999)
+    )
+    s = np.sin(lat)
+    t = np.tan(np.pi / 4 - lat / 2) / ((1 - e * s) / (1 + e * s)) ** (e / 2)
+    Q = H / t**B
+    S = (Q - 1 / Q) / 2
+    T = (Q + 1 / Q) / 2
+    dlon = B * (lon - lon0)
+    V = np.sin(dlon)
+    U = (-V * np.cos(g0) + S * np.sin(g0)) / T
+    v = A * np.log((1 - U) / (1 + U)) / (2 * B)
+    u = A * np.arctan2(S * np.cos(g0) + V * np.sin(g0), np.cos(dlon)) / B
+    if variant_b:
+        u = u - abs(uc) * sign
+    easting = v * math.cos(gc) + u * math.sin(gc) + fe
+    northing = u * math.cos(gc) - v * math.sin(gc) + fn
+    return easting, northing
+
+
+def _hom_inverse(crs, x, y, variant_b):
+    e, e2, A, B, H, g0, gc, lon0, uc, fe, fn, sign = _hom_setup(crs, variant_b)
+    de = np.asarray(x, dtype=np.float64) - fe
+    dn = np.asarray(y, dtype=np.float64) - fn
+    v = de * math.cos(gc) - dn * math.sin(gc)
+    u = dn * math.cos(gc) + de * math.sin(gc)
+    if variant_b:
+        u = u + abs(uc) * sign
+    Q = np.exp(-B * v / A)
+    S = (Q - 1 / Q) / 2
+    T = (Q + 1 / Q) / 2
+    V = np.sin(B * u / A)
+    U = (V * np.cos(g0) + S * np.sin(g0)) / T
+    t = (H / np.sqrt((1 + U) / (1 - U))) ** (1 / B)
+    chi = np.pi / 2 - 2 * np.arctan(t)
+    e4 = e2 * e2
+    e6 = e4 * e2
+    e8 = e6 * e2
+    lat = (
+        chi
+        + np.sin(2 * chi) * (e2 / 2 + 5 * e4 / 24 + e6 / 12 + 13 * e8 / 360)
+        + np.sin(4 * chi) * (7 * e4 / 48 + 29 * e6 / 240 + 811 * e8 / 11520)
+        + np.sin(6 * chi) * (7 * e6 / 120 + 81 * e8 / 1120)
+        + np.sin(8 * chi) * (4279 * e8 / 161280)
+    )
+    lon = lon0 - np.arctan2(
+        S * np.cos(g0) - V * np.sin(g0), np.cos(B * u / A)
+    ) / B
+    return np.degrees(lon), np.degrees(lat)
+
+
+def _hom_a_forward(crs, lon_deg, lat_deg):
+    return _hom_forward(crs, lon_deg, lat_deg, False)
+
+
+def _hom_a_inverse(crs, x, y):
+    return _hom_inverse(crs, x, y, False)
+
+
+def _is_swiss_case(crs):
+    # azimuth = rectified angle = 90 is the Swiss double-projection special
+    # case with its own proven implementation (swisstopo formulae); any
+    # other combination takes the general EPSG 9815 path
+    p = crs.params
+    return (
+        abs(p.get("azimuth", 90.0) - 90.0) < 1e-9
+        and abs(p.get("rectified_grid_angle", 90.0) - 90.0) < 1e-9
+    )
+
+
+def _hom_b_forward(crs, lon_deg, lat_deg):
+    if _is_swiss_case(crs):
+        return _somerc_forward(crs, lon_deg, lat_deg)
+    return _hom_forward(crs, lon_deg, lat_deg, True)
+
+
+def _hom_b_inverse(crs, x, y):
+    if _is_swiss_case(crs):
+        return _somerc_inverse(crs, x, y)
+    return _hom_inverse(crs, x, y, True)
+
+
+_FERRO_OFFSET_DEG = 17 + 40 / 60  # Ferro meridian: 17°40' west of Greenwich
+
+
+def _krovak_setup(crs):
+    """Krovak oblique conformal conic (EPSG method 9819) — S-JTSK, the
+    Czech/Slovak national projection. Constants per EPSG Guidance Note 7-2.
+
+    The EPSG 'longitude of origin' is 42°30' east of Ferro = 24°50' east of
+    Greenwich; Greenwich-primed WKT1 (GDAL style, EPSG 5514) carries 24.8333
+    and needs no shift. A longitude_of_center above 30° (a Ferro-referenced
+    42.5 carried verbatim) is shifted by the Ferro offset — no real Krovak
+    origin is east of 25°E Greenwich. NOTE: input/output grid coordinates
+    are always in the 'Krovak East North' (EPSG 5514) axis convention
+    (east = -westing, north = -southing); positive-southing/westing data
+    (EPSG 2065 convention) must be negated by the caller."""
+    a = crs.semi_major
+    e2 = _e2_of(crs)
+    e = math.sqrt(e2)
+    p = crs.params
+    phic = math.radians(p.get("latitude_of_center", 49.5))
+    lon0_deg = p.get("longitude_of_center", 24 + 50 / 60)
+    if lon0_deg > 30.0:
+        lon0_deg -= _FERRO_OFFSET_DEG
+    lon0 = math.radians(lon0_deg)
+    alphac = math.radians(p.get("azimuth", 30.28813972222222))
+    phip = math.radians(p.get("pseudo_standard_parallel_1", 78.5))
+    kp = p.get("scale_factor", 0.9999)
+    fe = p.get("false_easting", 0.0)
+    fn = p.get("false_northing", 0.0)
+    sc = math.sin(phic)
+    big_a = a * math.sqrt(1 - e2) / (1 - e2 * sc * sc)
+    big_b = math.sqrt(1 + e2 * math.cos(phic) ** 4 / (1 - e2))
+    gamma0 = math.asin(sc / big_b)
+    t0 = (
+        math.tan(math.pi / 4 + gamma0 / 2)
+        * ((1 + e * sc) / (1 - e * sc)) ** (e * big_b / 2)
+        / math.tan(math.pi / 4 + phic / 2) ** big_b
+    )
+    n = math.sin(phip)
+    r0 = kp * big_a / math.tan(phip)
+    return e, big_b, t0, n, r0, alphac, phip, lon0, fe, fn
+
+
+def _krovak_forward(crs, lon_deg, lat_deg):
+    e, B, t0, n, r0, ac, phip, lon0, fe, fn = _krovak_setup(crs)
+    lon = np.radians(np.asarray(lon_deg, dtype=np.float64))
+    lat = np.radians(
+        np.clip(np.asarray(lat_deg, dtype=np.float64), -89.9999, 89.9999)
+    )
+    s = np.sin(lat)
+    U = 2 * (
+        np.arctan(
+            t0
+            * np.tan(lat / 2 + np.pi / 4) ** B
+            / ((1 + e * s) / (1 - e * s)) ** (e * B / 2)
+        )
+        - np.pi / 4
+    )
+    V = B * (lon0 - lon)
+    T = np.arcsin(
+        np.clip(
+            np.cos(ac) * np.sin(U) + np.sin(ac) * np.cos(U) * np.cos(V),
+            -1.0,
+            1.0,
+        )
+    )
+    D = np.arcsin(np.clip(np.cos(U) * np.sin(V) / np.cos(T), -1.0, 1.0))
+    theta = n * D
+    r = (
+        r0
+        * math.tan(math.pi / 4 + phip / 2) ** n
+        / np.tan(T / 2 + np.pi / 4) ** n
+    )
+    southing = r * np.cos(theta) + fn
+    westing = r * np.sin(theta) + fe
+    # 'Krovak East North' (EPSG 5514) axes: east = -westing, north = -southing
+    return -westing, -southing
+
+
+def _krovak_inverse(crs, x, y):
+    e, B, t0, n, r0, ac, phip, lon0, fe, fn = _krovak_setup(crs)
+    westing = -np.asarray(x, dtype=np.float64) - fe
+    southing = -np.asarray(y, dtype=np.float64) - fn
+    r = np.sqrt(southing**2 + westing**2)
+    theta = np.arctan2(westing, southing)
+    D = theta / n
+    T = 2 * (
+        np.arctan(
+            (r0 / r) ** (1 / n) * math.tan(math.pi / 4 + phip / 2)
+        )
+        - np.pi / 4
+    )
+    U = np.arcsin(
+        np.clip(
+            np.cos(ac) * np.sin(T) - np.sin(ac) * np.cos(T) * np.cos(D),
+            -1.0,
+            1.0,
+        )
+    )
+    V = np.arcsin(np.clip(np.cos(T) * np.sin(D) / np.cos(U), -1.0, 1.0))
+    lon = lon0 - V / B
+    # ellipsoid latitude: fixed-point on the conformal relation
+    lat = U.copy()
+    for _ in range(8):
+        s = np.sin(lat)
+        lat = 2 * (
+            np.arctan(
+                t0 ** (-1 / B)
+                * np.tan(U / 2 + np.pi / 4) ** (1 / B)
+                * ((1 + e * s) / (1 - e * s)) ** (e / 2)
+            )
+            - np.pi / 4
+        )
+    return np.degrees(lon), np.degrees(lat)
+
+
 _PROJ_IMPLS = {
     "lambert_azimuthal_equal_area": (_laea_forward, _laea_inverse),
-    "hotine_oblique_mercator_azimuth_center": (_somerc_forward, _somerc_inverse),
+    "hotine_oblique_mercator": (_hom_a_forward, _hom_a_inverse),
+    "hotine_oblique_mercator_azimuth_center": (_hom_b_forward, _hom_b_inverse),
+    "krovak": (_krovak_forward, _krovak_inverse),
     "swiss_oblique_cylindrical": (_somerc_forward, _somerc_inverse),
     "swiss_oblique_mercator": (_somerc_forward, _somerc_inverse),
     "cylindrical_equal_area": (_cea_forward, _cea_inverse),

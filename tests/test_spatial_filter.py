@@ -252,8 +252,8 @@ class TestDatasetFilter:
             'PROJCS["mystery",GEOGCS["WGS 84",DATUM["WGS_1984",'
             'SPHEROID["WGS 84",6378137,298.257223563]],PRIMEM["Greenwich",0],'
             'UNIT["degree",0.0174532925199433]],'
-            'PROJECTION["Krovak"],'
-            'PARAMETER["latitude_of_origin",49.5],PARAMETER["central_meridian",24.8],'
+            'PROJECTION["New_Zealand_Map_Grid"],'
+            'PARAMETER["latitude_of_origin",-41],PARAMETER["central_meridian",173],'
             'UNIT["metre",1]]'
         )
         spec = ResolvedSpatialFilterSpec(
