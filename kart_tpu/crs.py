@@ -1141,8 +1141,12 @@ def _hom_setup(crs, variant_b):
     e2 = _e2_of(crs)
     e = math.sqrt(e2)
     p = crs.params
-    phic = math.radians(p.get("latitude_of_center", 0.0))
-    lonc = math.radians(p.get("longitude_of_center", 0.0))
+    phic = math.radians(
+        p.get("latitude_of_center", p.get("latitude_of_origin", 0.0))
+    )
+    lonc = math.radians(
+        p.get("longitude_of_center", p.get("central_meridian", 0.0))
+    )
     alphac = math.radians(p.get("azimuth", 90.0))
     gammac = math.radians(p.get("rectified_grid_angle", p.get("azimuth", 90.0)))
     kc = p.get("scale_factor", 1.0)
@@ -1281,8 +1285,12 @@ def _krovak_setup(crs):
     e2 = _e2_of(crs)
     e = math.sqrt(e2)
     p = crs.params
-    phic = math.radians(p.get("latitude_of_center", 49.5))
-    lon0_deg = p.get("longitude_of_center", 24 + 50 / 60)
+    phic = math.radians(
+        p.get("latitude_of_center", p.get("latitude_of_origin", 49.5))
+    )
+    lon0_deg = p.get(
+        "longitude_of_center", p.get("central_meridian", 24 + 50 / 60)
+    )
     if lon0_deg > 30.0:
         lon0_deg -= _FERRO_OFFSET_DEG
     lon0 = math.radians(lon0_deg)

@@ -103,7 +103,7 @@ ELLIPSOIDS[7003] = ("Australian National Spheroid", 6378160.0, 298.25)
 
 # -- individually-listed projected CRSes: EPSG code ->
 #    (name, geographic code, projection method, {parameter: value}) --------
-# Methods are the WKT1 names kart_tpu.crs._PROJECTIONS dispatches on.
+# Methods are the WKT1 names kart_tpu.crs._PROJ_IMPLS dispatches on.
 
 PROJECTED = {
     3857: (

@@ -8,7 +8,8 @@ ancestor/ours/theirs triple of (path, oid) entries — and the user's resolves.
 
 Two encodings of `<gitdir>/MERGE_INDEX`, detected by content:
   * JSON (human-inspectable) below _BINARY_THRESHOLD conflicts;
-  * a columnar binary block ("KMIX1") above it — a 1M-conflict merge
+  * a columnar binary block ("KMIX2"; "KMIX1" still reads) above it — a
+    1M-conflict merge
     (BASELINE config #5) would otherwise write ~350MB of JSON and pay ~10s
     of parsing on every `kart conflicts`/`kart resolve` invocation.
 """
@@ -24,7 +25,18 @@ from kart_tpu.core.repo import MERGE_INDEX
 VERSION_NAMES = ("ancestor", "ours", "theirs")
 
 _BINARY_THRESHOLD = 10_000
-_BINARY_MAGIC = b"KMIX1\n"
+_BINARY_MAGIC_V1 = b"KMIX1\n"
+_BINARY_MAGIC = b"KMIX2\n"
+# KMIX2 path-block dedup: a path block whose u64 length is this sentinel is
+# followed by a u64 version index whose path bytes it shares (the three
+# versions of a tree conflict usually carry identical path columns)
+_PATH_REF_SENTINEL = 0xFFFFFFFFFFFFFFFF
+# KMIX2 derived path block: for int-pk datasets the path column is a pure
+# function of the pks, so the block stores {prefix, encoder spec} + the raw
+# int64 pk array (8 bytes/row) instead of ~35 bytes/row of path strings —
+# the reader rebuilds the same lazy column, nothing materialises until a
+# path is touched
+_PATH_DERIVED_SENTINEL = 0xFFFFFFFFFFFFFFFE
 
 
 class AncestorOursTheirs:
@@ -160,6 +172,15 @@ class PkLabels:
         head = f"{self.ds_path}:feature:"
         return [head + str(k) for k in self.keys.tolist()]
 
+    def joined_bytes(self, sep=b"\x00"):
+        """Serialised column in one pass: the int->str conversion runs as a
+        vectorized numpy astype instead of 1M Python str() calls."""
+        if len(self.keys) == 0:
+            return b""
+        head = f"{self.ds_path}:feature:"
+        strs = self.keys.astype("U21").tolist()
+        return (head + (sep.decode() + head).join(strs)).encode()
+
 
 class JoinedStrs:
     """Lazy string column over NUL-joined bytes (the KMIX1 on-disk form):
@@ -194,6 +215,36 @@ def _materialise_col(src):
     if isinstance(src, list):
         return src
     return src.batch() if hasattr(src, "batch") else list(src)
+
+
+def _derived_path_block(paths):
+    """KMIX2 derived-block payload for an :class:`EncodedPkPaths` column
+    (u32 spec length + JSON {prefix, encoder} + raw little-endian int64
+    pks), or None when the column isn't pk-derivable."""
+    if not isinstance(paths, EncodedPkPaths):
+        return None
+    to_dict = getattr(paths.encoder, "to_dict", None)
+    if to_dict is None:
+        return None
+    spec = json.dumps(
+        {"prefix": paths.prefix, "encoder": to_dict()}
+    ).encode()
+    keys = np.ascontiguousarray(paths.keys, dtype="<i8")
+    return struct.pack("<I", len(spec)) + spec + keys.tobytes()
+
+
+def _paths_from_derived_block(payload, n):
+    """Inverse of :func:`_derived_path_block`."""
+    from kart_tpu.models.paths import PathEncoder
+
+    (slen,) = struct.unpack_from("<I", payload, 0)
+    spec = json.loads(payload[4 : 4 + slen].decode())
+    keys = np.frombuffer(payload[4 + slen :], dtype="<i8")
+    if len(keys) != n:
+        raise ValueError(
+            f"Corrupt derived path block: {len(keys)} pks for {n} conflicts"
+        )
+    return EncodedPkPaths(spec["prefix"], PathEncoder.get(**spec["encoder"]), keys)
 
 
 class ColumnarConflicts(Mapping):
@@ -420,16 +471,20 @@ class MergeIndex:
     # -- binary encoding (columnar, for large conflict sets) ----------------
 
     def _binary_chunks(self):
-        """Yield the KMIX1 byte chunks: magic, u32 header length, JSON header
+        """Yield the KMIX2 byte chunks: magic, u32 header length, JSON header
         {mergedTree, resolves, n}, then per column: u64 byte length +
         payload. Columns: NUL-joined label bytes, then per version (a/o/t) a
-        present mask, (n,20) oids, and NUL-joined path bytes (empty for
-        absent).
+        present mask, (n,20) oids, and a path block. A path block is one of:
+        plain NUL-joined path bytes (empty for absent rows); a
+        _PATH_REF_SENTINEL length + u64 version index sharing an earlier
+        version's block; or a _PATH_DERIVED_SENTINEL length + u64 payload
+        length + payload ({prefix, encoder spec} + raw int64 pks — int-pk
+        paths are recomputed, not stored).
 
         Columnar conflict sets serialise column-to-column (no per-conflict
         objects); plain dicts are looped in _conflicts_as_columns. Chunked so
-        write_to_repo streams to disk without joining a second in-memory copy
-        (~174MB at 1M conflicts)."""
+        write_to_repo streams to disk without joining a second in-memory
+        copy."""
         labels, version_cols = _conflicts_as_columns(self.conflicts)
         n = len(labels)
         header = json.dumps(
@@ -447,17 +502,43 @@ class MergeIndex:
         label_bytes = label_jb() if label_jb is not None else None
         if label_bytes is None:
             label_bytes = "\x00".join(_materialise_col(labels)).encode()
-        blocks = [label_bytes]
-        joined_cache = {}  # id(path column) -> encoded bytes (versions share columns)
-        for present, oids, paths in version_cols:
+
+        yield _BINARY_MAGIC
+        yield struct.pack("<I", len(header))
+        yield header
+        yield struct.pack("<Q", len(label_bytes))
+        yield label_bytes
+        # versions routinely share one path column (a tree conflict keeps the
+        # same feature path in ancestor/ours/theirs) — encode AND write those
+        # bytes once, later versions reference the earlier block (~1/3 the
+        # file at 1M conflicts)
+        written_paths = {}  # id(path column) -> version index written at
+        for v, (present, oids, paths) in enumerate(version_cols):
+            yield struct.pack(
+                "<Q", len(present)
+            )
+            yield np.ascontiguousarray(present, dtype=np.uint8).tobytes()
+            oid_bytes = np.ascontiguousarray(oids, dtype=np.uint8).tobytes()
+            yield struct.pack("<Q", len(oid_bytes))
+            yield oid_bytes
             if np.all(present):
-                path_bytes = joined_cache.get(id(paths))
+                ref = written_paths.get(id(paths))
+                if ref is not None:
+                    yield struct.pack("<QQ", _PATH_REF_SENTINEL, ref)
+                    continue
+                derived = _derived_path_block(paths)
+                if derived is not None:
+                    yield struct.pack(
+                        "<QQ", _PATH_DERIVED_SENTINEL, len(derived)
+                    )
+                    yield derived
+                    written_paths[id(paths)] = v
+                    continue
+                jb = getattr(paths, "joined_bytes", None)
+                path_bytes = jb() if jb is not None else None
                 if path_bytes is None:
-                    jb = getattr(paths, "joined_bytes", None)
-                    path_bytes = jb() if jb is not None else None
-                    if path_bytes is None:
-                        path_bytes = "\x00".join(_materialise_col(paths)).encode()
-                    joined_cache[id(paths)] = path_bytes
+                    path_bytes = "\x00".join(_materialise_col(paths)).encode()
+                written_paths[id(paths)] = v
             else:
                 # absent rows must serialise with an empty path (padding rows
                 # of lazy columns can carry junk paths; mask them out)
@@ -465,25 +546,16 @@ class MergeIndex:
                 path_bytes = "\x00".join(
                     p if ok else "" for p, ok in zip(lst, present)
                 ).encode()
-            blocks += [
-                np.ascontiguousarray(present, dtype=np.uint8).tobytes(),
-                np.ascontiguousarray(oids, dtype=np.uint8).tobytes(),
-                path_bytes,
-            ]
-
-        yield _BINARY_MAGIC
-        yield struct.pack("<I", len(header))
-        yield header
-        for block in blocks:
-            yield struct.pack("<Q", len(block))
-            yield block
+            yield struct.pack("<Q", len(path_bytes))
+            yield path_bytes
 
     def _to_binary(self):
         return b"".join(self._binary_chunks())
 
     @classmethod
     def _from_binary(cls, raw):
-        pos = len(_BINARY_MAGIC)
+        v2 = raw.startswith(_BINARY_MAGIC)
+        pos = len(_BINARY_MAGIC if v2 else _BINARY_MAGIC_V1)
         (hlen,) = struct.unpack_from("<I", raw, pos)
         pos += 4
         header = json.loads(raw[pos : pos + hlen].decode())
@@ -494,6 +566,16 @@ class MergeIndex:
             nonlocal pos
             (blen,) = struct.unpack_from("<Q", raw, pos)
             pos += 8
+            if v2 and blen == _PATH_REF_SENTINEL:
+                (ref,) = struct.unpack_from("<Q", raw, pos)
+                pos += 8
+                return ref  # back-reference to version `ref`'s path block
+            if v2 and blen == _PATH_DERIVED_SENTINEL:
+                (plen,) = struct.unpack_from("<Q", raw, pos)
+                pos += 8
+                payload = raw[pos : pos + plen]
+                pos += plen
+                return ("derived", payload)
             data = raw[pos : pos + blen]
             pos += blen
             return data
@@ -503,7 +585,13 @@ class MergeIndex:
         for _ in VERSION_NAMES:
             present = np.frombuffer(block(), dtype=np.uint8)
             oids = np.frombuffer(block(), dtype=np.uint8).reshape(n, 20)
-            paths = JoinedStrs(block(), n)
+            path_block = block()
+            if isinstance(path_block, int):
+                paths = versions[path_block][2]  # shared column object
+            elif isinstance(path_block, tuple):
+                paths = _paths_from_derived_block(path_block[1], n)
+            else:
+                paths = JoinedStrs(path_block, n)
             versions.append((present, oids, paths))
 
         # stays columnar on read: `kart conflicts`/`kart resolve` on a
@@ -549,7 +637,7 @@ class MergeIndex:
             )
         with open(path, "rb") as f:
             raw = f.read()
-        if raw.startswith(_BINARY_MAGIC):
+        if raw.startswith(_BINARY_MAGIC) or raw.startswith(_BINARY_MAGIC_V1):
             return cls._from_binary(raw)
         return cls.from_json(json.loads(raw.decode()))
 

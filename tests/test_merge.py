@@ -400,7 +400,7 @@ def test_merge_index_binary_roundtrip(tmp_path, monkeypatch):
     mi.write_to_repo(repo)
 
     raw = open(repo.gitdir_file("MERGE_INDEX"), "rb").read()
-    assert raw.startswith(b"KMIX1\n")
+    assert raw.startswith(b"KMIX2\n")
 
     mi2 = MergeIndex.read_from_repo(repo)
     assert mi2.merged_tree == mi.merged_tree
@@ -420,9 +420,41 @@ def test_merge_index_binary_roundtrip(tmp_path, monkeypatch):
     assert sorted(mi3.conflicts) == sorted(mi.conflicts)
 
 
+def test_merge_index_kmix1_backcompat():
+    """A KMIX1 file (pre-dedup format: every version carries its own full
+    path block) still reads — merges left in progress across an upgrade
+    must survive."""
+    import json as _json
+    import struct as _struct
+
+    import numpy as np
+
+    from kart_tpu.merge.index import MergeIndex
+
+    header = _json.dumps(
+        {"mergedTree": "b" * 40, "n": 2, "resolves": {}}
+    ).encode()
+    labels = b"ds:feature:0\x00ds:feature:1"
+    paths = b"ds/.table-dataset/feature/aa/k0\x00ds/.table-dataset/feature/aa/k1"
+    blocks = [labels]
+    for v in range(3):
+        present = bytes([1, 1])
+        oids = np.full((2, 20), v + 1, dtype=np.uint8).tobytes()
+        blocks += [present, oids, paths]
+    raw = b"KMIX1\n" + _struct.pack("<I", len(header)) + header
+    for b in blocks:
+        raw += _struct.pack("<Q", len(b)) + b
+    mi = MergeIndex._from_binary(raw)
+    assert sorted(mi.conflicts) == ["ds:feature:0", "ds:feature:1"]
+    aot = mi.conflicts["ds:feature:1"]
+    assert aot.ancestor.oid == "01" * 20
+    assert aot.theirs.oid == "03" * 20
+    assert aot.ours.path == "ds/.table-dataset/feature/aa/k1"
+
+
 def test_columnar_conflicts_mapping_and_binary():
     """materialise_conflicts returns a columnar mapping whose entries,
-    iteration order and KMIX1 bytes match the equivalent plain-dict index —
+    iteration order and parsed KMIX2 form match the equivalent plain-dict index —
     including rows absent from some versions (delete/edit conflicts)."""
     import numpy as np
 
@@ -469,11 +501,22 @@ def test_columnar_conflicts_mapping_and_binary():
     assert aot.ancestor.oid.startswith("14")  # 20 -> 0x14 first byte LE word
     assert aot.theirs.path == "inner/feature/" + encoder.encode_pks_to_path((2,))
 
-    # KMIX1 bytes equal a plain-dict build of the same conflicts
+    # a plain-dict build of the same conflicts parses back identically
+    # (byte streams may differ: columnar int-pk columns serialise as KMIX2
+    # derived blocks, dict columns as joined path strings)
     dict_conflicts = {label: aot for label, aot in cc.items()}
     raw_columnar = MergeIndex("a" * 40, cc)._to_binary()
     raw_dict = MergeIndex("a" * 40, dict_conflicts)._to_binary()
-    assert raw_columnar == raw_dict
+    parsed_c = MergeIndex._from_binary(raw_columnar)
+    parsed_d = MergeIndex._from_binary(raw_dict)
+    assert list(parsed_c.conflicts) == list(parsed_d.conflicts)
+    for label in parsed_c.conflicts:
+        c_aot, d_aot = parsed_c.conflicts[label], parsed_d.conflicts[label]
+        for name in ("ancestor", "ours", "theirs"):
+            ce, de = c_aot.get(name), d_aot.get(name)
+            assert (ce is None) == (de is None), (label, name)
+            if ce is not None:
+                assert ce.path == de.path and ce.oid == de.oid, (label, name)
 
     mi2 = MergeIndex._from_binary(raw_columnar)
     assert isinstance(mi2.conflicts, ColumnarConflicts)
