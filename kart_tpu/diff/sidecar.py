@@ -9,11 +9,15 @@ resets and clones — a tree oid never changes meaning. Files:
 
     magic   b"KCOL1\\n"
     header  one json line: {"count": N, "keys_are_pks": bool,
-                            "paths_bytes": M}
+                            "paths_bytes": M, "envelope_bytes": E}
     arrays  keys   int64[N]    (little-endian; pk, or filename-hash key)
             oids   uint8[N,20]
             offs   uint32[N+1]  (only when paths stored)
             paths  utf8 bytes   (blob-relative paths, concatenated)
+            envs   float32[N,4] (only when envelope_bytes > 0: per-feature
+                                 wsen EPSG:4326 envelopes — feeds the
+                                 spatially-filtered diff's bbox prefilter
+                                 without touching blobs)
 
 Arrays are stored *sorted by key* so loading skips the sort. Int-pk datasets
 don't store paths at all — the key IS the pk, and feature paths are
@@ -77,10 +81,11 @@ class IntKeyPaths:
         return self.encoder.encode_pks_to_path((int(self.keys[i]),))
 
 
-def save_sidecar(repo, feature_tree_oid, keys, oids_u8, paths=None):
+def save_sidecar(repo, feature_tree_oid, keys, oids_u8, paths=None, envelopes=None):
     """Persist a sidecar. ``keys`` int64 (N,), ``oids_u8`` uint8 (N, 20) —
     *not necessarily sorted*; ``paths`` list[str] aligned with keys, or None
-    for int-pk datasets. Atomic (tmp + rename)."""
+    for int-pk datasets; ``envelopes`` (N, 4) float wsen per feature, or
+    None. Atomic (tmp + rename)."""
     order = np.argsort(keys, kind="stable")
     keys = np.ascontiguousarray(keys[order], dtype="<i8")
     oids_u8 = np.ascontiguousarray(oids_u8[order], dtype=np.uint8)
@@ -96,12 +101,18 @@ def save_sidecar(repo, feature_tree_oid, keys, oids_u8, paths=None):
             np.fromiter((len(e) for e in encoded), dtype=np.int64, count=len(encoded))
         )
         path_blob = b"".join(encoded)
+    env_arr = None
+    if envelopes is not None:
+        env_arr = np.ascontiguousarray(
+            np.asarray(envelopes)[order], dtype="<f4"
+        )
 
     header = json.dumps(
         {
             "count": int(len(keys)),
             "keys_are_pks": paths is None,
             "paths_bytes": len(path_blob),
+            "envelope_bytes": int(env_arr.nbytes) if env_arr is not None else 0,
         }
     ).encode() + b"\n"
 
@@ -115,6 +126,8 @@ def save_sidecar(repo, feature_tree_oid, keys, oids_u8, paths=None):
         if offs is not None:
             f.write(offs.tobytes())
             f.write(path_blob)
+        if env_arr is not None:
+            f.write(env_arr.tobytes())
     os.replace(tmp, target)
     _evict(d)
     return target
@@ -137,9 +150,11 @@ def _evict(d):
             pass
 
 
-def load_block(repo, dataset):
+def load_block(repo, dataset, pad=True):
     """-> padded FeatureBlock from the sidecar, or None when absent/corrupt.
-    Arrays are mmap'd: O(1) regardless of dataset size."""
+    Arrays are mmap'd: O(1) regardless of dataset size. pad=False skips the
+    padded copies (keys/oids stay mmap views) for consumers that re-shape
+    the block anyway (the spatial prefilter)."""
     feature_tree = dataset.feature_tree
     if feature_tree is None:
         return None
@@ -168,9 +183,22 @@ def load_block(repo, dataset):
             pos += 4 * (n + 1)
             data = mm[pos : pos + header["paths_bytes"]]
             paths = LazyPaths(offs, data)
+            pos += header["paths_bytes"]
+        envelopes = None
+        if header.get("envelope_bytes"):
+            envelopes = np.frombuffer(
+                mm, dtype="<f4", count=4 * n, offset=pos
+            ).reshape(n, 4)
     except (IndexError, KeyError, ValueError):
         return None
 
+    if not pad:
+        oid_rows = (
+            oids_u8.reshape(n, 5, 4).view(np.uint32).reshape(n, 5)
+            if n
+            else np.zeros((0, 5), dtype=np.uint32)
+        )
+        return FeatureBlock(keys, oid_rows, paths, n, envelopes=envelopes)
     # pad (copy — the kernel wants aligned padded arrays; the mmap'd
     # originals stay untouched for the path views)
     size = bucket_size(max(n, 1))
@@ -179,7 +207,7 @@ def load_block(repo, dataset):
     oids_p = np.zeros((size, 5), dtype=np.uint32)
     if n:
         oids_p[:n] = oids_u8.reshape(n, 5, 4).view(np.uint32).reshape(n, 5)
-    return FeatureBlock(keys_p, oids_p, paths, n)
+    return FeatureBlock(keys_p, oids_p, paths, n, envelopes=envelopes)
 
 
 def build_sidecar(repo, dataset):
@@ -224,26 +252,67 @@ def update_sidecar_for_commit(repo, old_ds, new_feature_tree_oid, feature_diff):
     from kart_tpu.core.objects import hash_object
 
     schema = old_ds.schema
+    geom_col = next(
+        (c.name for c in schema.columns if c.data_type == "geometry"), None
+    )
     removed = set()
     added = {}
+    added_envs = {} if block.envelopes is not None else None
     for delta in feature_diff.values():
         if delta.old is not None:
             removed.add(int(delta.old_key))
         if delta.new is not None:
             pk_values, blob = schema.encode_feature_blob(delta.new_value)
-            added[int(pk_values[0])] = hash_object("blob", blob)
-    return derive_sidecar(repo, block, new_feature_tree_oid, removed, added)
+            pk = int(pk_values[0])
+            added[pk] = hash_object("blob", blob)
+            if added_envs is not None:
+                added_envs[pk] = _feature_envelope_wsen(
+                    delta.new_value, geom_col
+                )
+    return derive_sidecar(
+        repo, block, new_feature_tree_oid, removed, added, added_envs
+    )
 
 
-def derive_sidecar(repo, old_block, new_feature_tree_oid, removed, added):
+def _feature_envelope_wsen(feature, geom_col):
+    """(w, s, e, n) of one feature's geometry for the envelope column; the
+    full-world envelope for NULL/empty/non-geometry rows (NULL geometry
+    always matches a spatial filter — fail open, reference semantics)."""
+    FULL = (-180.0, -90.0, 180.0, 90.0)
+    if geom_col is None:
+        return FULL
+    geom = feature.get(geom_col) if hasattr(feature, "get") else None
+    if geom is None:
+        return FULL
+    from kart_tpu.geometry import Geometry
+
+    try:
+        env = Geometry.of(geom).envelope()  # (x0, x1, y0, y1)
+    except Exception:
+        return FULL
+    if env is None:
+        return FULL
+    x0, x1, y0, y1 = env
+    return (x0, y0, x1, y1)
+
+
+def derive_sidecar(repo, old_block, new_feature_tree_oid, removed, added,
+                   added_envs=None):
     """New sidecar from an old int-pk block + the change set — O(changed)
     array ops, no tree walk. removed: iterable of pks; added: {pk: oid hex}
-    (an added pk overrides a removal)."""
+    (an added pk overrides a removal); added_envs: {pk: wsen} carried into
+    the envelope column when the old block has one (a derived sidecar must
+    not silently lose the spatial prefilter for later revisions)."""
     keys = old_block.keys[: old_block.count]
     oids_u8 = (
         np.ascontiguousarray(old_block.oids[: old_block.count])
         .view(np.uint8)
         .reshape(-1, 20)
+    )
+    envs = (
+        np.asarray(old_block.envelopes)
+        if old_block.envelopes is not None and added_envs is not None
+        else None
     )
     drop = set(removed) | set(added)
     if drop:
@@ -251,6 +320,8 @@ def derive_sidecar(repo, old_block, new_feature_tree_oid, removed, added):
         mask = ~np.isin(keys, drop_arr)
         keys = keys[mask]
         oids_u8 = oids_u8[mask]
+        if envs is not None:
+            envs = envs[mask]
     if added:
         add_keys = np.fromiter(added.keys(), dtype=np.int64, count=len(added))
         add_oids = np.frombuffer(
@@ -258,7 +329,14 @@ def derive_sidecar(repo, old_block, new_feature_tree_oid, removed, added):
         ).reshape(-1, 20)
         keys = np.concatenate([keys, add_keys])
         oids_u8 = np.concatenate([oids_u8, add_oids])
-    return save_sidecar(repo, new_feature_tree_oid, keys, oids_u8)
+        if envs is not None:
+            add_env = np.array(
+                [added_envs[int(pk)] for pk in add_keys], dtype=np.float32
+            ).reshape(-1, 4)
+            envs = np.concatenate([envs, add_env])
+    return save_sidecar(
+        repo, new_feature_tree_oid, keys, oids_u8, envelopes=envs
+    )
 
 
 class SidecarCapture:

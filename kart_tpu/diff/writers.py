@@ -103,6 +103,16 @@ class BaseDiffWriter:
         )
         self.has_changes = False
         self.spatial_filter_pk_conflicts = {}
+        # the repo's spatial filter (set by a filtered clone / config):
+        # diffs only show matching deltas (reference:
+        # base_diff_writer.py:279-341). Engine prefilters envelope-carrying
+        # sidecar blocks; iter_deltas applies the exact per-value residue.
+        from kart_tpu.spatial_filter import ResolvedSpatialFilterSpec
+
+        self.spatial_filter_spec = ResolvedSpatialFilterSpec.from_repo_config(repo)
+        if self.spatial_filter_spec.match_all:
+            self.spatial_filter_spec = None
+        self._ds_sf_cache = {}
 
     # -- commit spec --------------------------------------------------------
 
@@ -153,6 +163,7 @@ class BaseDiffWriter:
             repo_key_filter=self.repo_key_filter,
             include_wc_diff=self.working_copy is not None,
             working_copy=self.working_copy,
+            spatial_filter_spec=self.spatial_filter_spec,
         )
 
     def get_ds_diff(self, ds_path):
@@ -163,26 +174,77 @@ class BaseDiffWriter:
             ds_filter=self.repo_key_filter[ds_path],
             include_wc_diff=self.working_copy is not None,
             working_copy=self.working_copy,
+            spatial_filter_spec=self.spatial_filter_spec,
         )
+
+    def _ds_spatial_filter(self, ds_path):
+        """Per-dataset SpatialFilter (filter polygon transformed into the
+        dataset's CRS), or None when no filter is active / the dataset is
+        non-spatial."""
+        if self.spatial_filter_spec is None or ds_path is None:
+            return None
+        if ds_path not in self._ds_sf_cache:
+            ds = None
+            for rs in (self.target_rs, self.base_rs):
+                if rs is not None:
+                    ds = rs.datasets.get(ds_path)
+                    if ds is not None:
+                        break
+            sf = (
+                self.spatial_filter_spec.resolve_for_dataset(ds)
+                if ds is not None
+                else None
+            )
+            from kart_tpu.spatial_filter import SpatialFilter
+
+            self._ds_sf_cache[ds_path] = None if sf is SpatialFilter.MATCH_ALL else sf
+        return self._ds_sf_cache[ds_path]
+
+    @staticmethod
+    def _delta_matches_filter(delta, sf):
+        """True when either side of the delta matches the spatial filter
+        (reference semantics: base_diff_writer's matches_delta_values).
+        A side whose value is a promised blob can't be tested — fail open
+        (a filtered clone only promises out-of-filter features, and the
+        engine's envelope prefilter has already screened those out)."""
+        from kart_tpu.core.odb import ObjectMissing, ObjectPromised
+        from kart_tpu.spatial_filter import MatchResult
+
+        for kv in (delta.old, delta.new):
+            if kv is None:
+                continue
+            try:
+                feature = kv.get_lazy_value()
+            except (ObjectPromised, ObjectMissing):
+                return True
+            if sf.match_result(feature) is MatchResult.MATCHED:
+                return True
+        return False
 
     #: rows per batch blob prefetch in iter_deltas: large enough to amortise
     #: the native batch inflate setup, small enough that prefetched blob
     #: bytes for one chunk stay a few MB
     PREFETCH_CHUNK = 8192
 
-    def iter_deltas(self, ds_diff):
+    def iter_deltas(self, ds_diff, ds_path=None):
         """Stream (key, delta). Deltas whose values are oid-promises get
         their blob data prefetched chunk-wise through the native batch pack
         reader (one reused z_stream over offset-sorted records) instead of
-        a per-feature pack bisect + inflate. On a partial clone, deltas
-        whose values are promised blobs are buffered while the rest stream,
-        then backfilled from the promisor remote in one batch fetch and
-        re-yielded (reference: DeltaFetcher, kart/base_diff_writer.py:467-534)."""
+        a per-feature pack bisect + inflate. With an active repo spatial
+        filter (pass ds_path), only matching deltas stream. On a partial
+        clone, deltas whose values are promised blobs are buffered while
+        the rest stream, then backfilled from the promisor remote in one
+        batch fetch and re-yielded (reference: DeltaFetcher,
+        kart/base_diff_writer.py:467-534)."""
         feature_diff = ds_diff.get("feature")
         if not feature_diff:
             return
+        sf = self._ds_spatial_filter(ds_path)
         if not self.repo.has_promisor_remote():
-            yield from self._iter_prefetched(feature_diff.sorted_items())
+            for key, delta in self._iter_prefetched(feature_diff.sorted_items()):
+                if sf is None or self._delta_matches_filter(delta, sf):
+                    self.has_changes = True
+                    yield key, delta
             return
         buffered = []
         missing = []
@@ -192,7 +254,9 @@ class BaseDiffWriter:
                 buffered.append((key, delta))
                 missing.extend(oids)
                 continue
-            yield key, delta
+            if sf is None or self._delta_matches_filter(delta, sf):
+                self.has_changes = True
+                yield key, delta
         if buffered:
             from kart_tpu.transport.remote import fetch_promised_blobs
 
@@ -201,7 +265,10 @@ class BaseDiffWriter:
                 len(missing),
             )
             fetch_promised_blobs(self.repo, missing)
-            yield from buffered
+            for key, delta in buffered:
+                if sf is None or self._delta_matches_filter(delta, sf):
+                    self.has_changes = True
+                    yield key, delta
 
     def _iter_prefetched(self, items):
         """Chunk the (key, delta) stream and batch-read the blob data of
@@ -318,12 +385,23 @@ class BaseDiffWriter:
                         err=True,
                     )
 
+    def _mark_ds_changes(self, ds_diff):
+        """has_changes bookkeeping per dataset. With an active spatial
+        filter, feature changes only count when a delta actually streams
+        (iter_deltas marks that) — the exit code must agree with the
+        output, not with the unfiltered diff."""
+        if self.spatial_filter_spec is None:
+            if ds_diff:
+                self.has_changes = True
+        elif ds_diff.get("meta"):
+            self.has_changes = True
+
     def write_diff(self):
         self.write_header()
         for ds_path in self.all_ds_paths:
             ds_diff = self.get_ds_diff(ds_path)
             if ds_diff:
-                self.has_changes = True
+                self._mark_ds_changes(ds_diff)
                 self.write_ds_diff(ds_path, ds_diff)
         self.write_warnings_footer()
         return self.has_changes
@@ -362,7 +440,7 @@ class TextDiffWriter(BaseDiffWriter):
         if "meta" in ds_diff:
             for key, delta in ds_diff["meta"].sorted_items():
                 self.write_meta_delta(ds_path, key, delta)
-        for key, delta in self.iter_deltas(ds_diff):
+        for key, delta in self.iter_deltas(ds_diff, ds_path):
             self.write_feature_delta(ds_path, key, delta)
 
     def write_meta_delta(self, ds_path, key, delta):
@@ -467,7 +545,11 @@ class JsonDiffWriter(BaseDiffWriter):
 
     def write_diff(self):
         repo_diff = self.get_repo_diff()
-        self.has_changes = bool(repo_diff)
+        if self.spatial_filter_spec is None:
+            self.has_changes = bool(repo_diff)
+        else:
+            for _p, _d in repo_diff.items():
+                self._mark_ds_changes(_d)
         output = {}
         header = self.commit_header_json()
         if header is not None:
@@ -504,7 +586,7 @@ class JsonDiffWriter(BaseDiffWriter):
         if "feature" in ds_diff:
             old_tx, new_tx = self.get_geometry_transforms(ds_path, ds_diff)
             features = []
-            for key, delta in self.iter_deltas(ds_diff):
+            for key, delta in self.iter_deltas(ds_diff, ds_path):
                 item = {}
                 if delta.old and (self.patch_type == "full" or not delta.new):
                     item["-"] = self._feature_json_fast(delta.old, old_tx)
@@ -565,7 +647,7 @@ class JsonLinesDiffWriter(BaseDiffWriter):
                     obj["change"]["+"] = delta.new_value
                 self._writeln(obj)
         old_tx, new_tx = self.get_geometry_transforms(ds_path, ds_diff)
-        for key, delta in self.iter_deltas(ds_diff):
+        for key, delta in self.iter_deltas(ds_diff, ds_path):
             change = {}
             if delta.old:
                 change["-"] = self._feature_json_fast(delta.old, old_tx)
@@ -580,7 +662,11 @@ class GeojsonDiffWriter(BaseDiffWriter):
 
     def write_diff(self):
         repo_diff = self.get_repo_diff()
-        self.has_changes = bool(repo_diff)
+        if self.spatial_filter_spec is None:
+            self.has_changes = bool(repo_diff)
+        else:
+            for _p, _d in repo_diff.items():
+                self._mark_ds_changes(_d)
         ds_paths = [p for p, d in repo_diff.items() if "feature" in d]
         multi = len(ds_paths) > 1
         for ds_path in ds_paths:
@@ -605,7 +691,7 @@ class GeojsonDiffWriter(BaseDiffWriter):
 
     def features_geojson(self, ds_path, ds_diff):
         old_tx, new_tx = self.get_geometry_transforms(ds_path, ds_diff)
-        for key, delta in self.iter_deltas(ds_diff):
+        for key, delta in self.iter_deltas(ds_diff, ds_path):
             if delta.type == "insert":
                 yield feature_as_geojson(delta.new_value, delta.new_key, "I", new_tx)
             elif delta.type == "delete":
@@ -632,14 +718,24 @@ class FeatureCountDiffWriter(BaseDiffWriter):
         for ds_path in self.all_ds_paths:
             count = None
             if self.working_copy is None and self.repo_key_filter.match_all:
-                # commit<>commit, unfiltered: the count comes straight from
-                # the classify kernel, skipping delta construction entirely
+                # commit<>commit, unfiltered key-space: the count comes
+                # straight from the classify kernel, skipping delta
+                # construction entirely; an active spatial filter rides the
+                # same route when envelope sidecar columns exist (the
+                # prefilter is the filter there — blob values are typically
+                # promised at that scale)
                 count = get_dataset_feature_count_fast(
-                    self.base_rs, self.target_rs, ds_path
+                    self.base_rs,
+                    self.target_rs,
+                    ds_path,
+                    spatial_filter_spec=self.spatial_filter_spec,
                 )
             if count is None:
                 ds_diff = self.get_ds_diff(ds_path)
-                count = len(ds_diff.get("feature", ()))
+                if self._ds_spatial_filter(ds_path) is not None:
+                    count = sum(1 for _ in self.iter_deltas(ds_diff, ds_path))
+                else:
+                    count = len(ds_diff.get("feature", ()))
             if count:
                 self.has_changes = True
                 fp.write(f"{ds_path}:\n\t{count} features changed\n")
@@ -705,7 +801,11 @@ class HtmlDiffWriter(BaseDiffWriter):
 
     def write_diff(self):
         repo_diff = self.get_repo_diff()
-        self.has_changes = bool(repo_diff)
+        if self.spatial_filter_spec is None:
+            self.has_changes = bool(repo_diff)
+        else:
+            for _p, _d in repo_diff.items():
+                self._mark_ds_changes(_d)
         all_data = {}
         for ds_path, ds_diff in repo_diff.items():
             if "feature" not in ds_diff:

@@ -113,6 +113,14 @@ def load():
             ctypes.c_void_p,
         ]
         lib.sf_filter_packed.restype = ctypes.c_int64
+        if hasattr(lib, "sf_bbox_intersects_f32"):
+            lib.sf_bbox_intersects_f32.argtypes = [
+                ctypes.c_void_p,
+                ctypes.c_int64,
+                ctypes.c_void_p,
+                ctypes.c_void_p,
+            ]
+            lib.sf_bbox_intersects_f32.restype = ctypes.c_int64
         _lib = lib
     except (OSError, AttributeError) as e:
         # AttributeError: a stale/foreign .so without the expected symbols
@@ -474,3 +482,19 @@ def bbox_intersects(envelopes, query_wsen):
     from kart_tpu.ops.bbox import bbox_intersects_np
 
     return bbox_intersects_np(envelopes, query)
+
+
+def bbox_intersects_f32(envelopes_f32, query_wsen):
+    """(N, 4) float32 wsen (e.g. the sidecar envelope mmap, zero copies) +
+    query -> bool (N,). Falls back to the f64 path when the native lib is
+    missing or predates the f32 entry point."""
+    query = np.asarray(query_wsen, dtype=np.float64)
+    lib = load()
+    if lib is not None and hasattr(lib, "sf_bbox_intersects_f32"):
+        env = np.ascontiguousarray(envelopes_f32, dtype=np.float32)
+        out = np.empty(env.shape[0], dtype=np.uint8)
+        lib.sf_bbox_intersects_f32(
+            env.ctypes.data, env.shape[0], query.ctypes.data, out.ctypes.data
+        )
+        return out.view(bool)  # 0/1 bytes: reinterpret, no copy
+    return bbox_intersects(np.asarray(envelopes_f32, dtype=np.float64), query)

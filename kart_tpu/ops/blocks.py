@@ -71,13 +71,16 @@ class FeatureBlock:
     """One dataset version as sorted (key, oid) arrays + the path strings
     (kept host-side for value materialisation of changed rows only)."""
 
-    __slots__ = ("keys", "oids", "paths", "count")
+    __slots__ = ("keys", "oids", "paths", "count", "envelopes")
 
-    def __init__(self, keys, oids, paths, count):
+    def __init__(self, keys, oids, paths, count, envelopes=None):
         self.keys = keys
         self.oids = oids
         self.paths = paths  # list[str], in the same (sorted) order, len == count
         self.count = count
+        # optional (count, 4) float32 wsen envelope columns (sidecar-backed;
+        # unpadded) — the spatially-filtered diff's prefilter input
+        self.envelopes = envelopes
 
     @classmethod
     def from_dataset(cls, dataset, pad=True):

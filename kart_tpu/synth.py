@@ -56,6 +56,36 @@ SYNTH_SCHEMA = Schema(
 )
 
 
+SYNTH_SPATIAL_SCHEMA = Schema(
+    [
+        ColumnSchema(
+            id="a1b2c3d4-0001-4000-8000-000000000001",
+            name="fid",
+            data_type="integer",
+            pk_index=0,
+            extra_type_info={"size": 64},
+        ),
+        ColumnSchema(
+            id="a1b2c3d4-0004-4000-8000-000000000004",
+            name="geom",
+            data_type="geometry",
+            pk_index=None,
+            extra_type_info={
+                "geometryType": "POINT",
+                "geometryCRS": "EPSG:4326",
+            },
+        ),
+        ColumnSchema(
+            id="a1b2c3d4-0002-4000-8000-000000000002",
+            name="rating",
+            data_type="float",
+            pk_index=None,
+            extra_type_info={"size": 64},
+        ),
+    ]
+)
+
+
 def synth_feature_blob(pk):
     """The (deterministic) feature blob content for pk in 'real' mode."""
     return SYNTH_SCHEMA.encode_feature_blob({"fid": int(pk), "rating": pk / 2.0})[1]
@@ -80,10 +110,32 @@ def _real_oids(odb, pks, batch=1_000_000):
     return out
 
 
-def synth_repo(path, n, *, edit_frac=0.01, seed=0, blobs="promised", ds_path="synth"):
+def synth_envelopes(pks):
+    """Deterministic per-pk wsen EPSG:4326 envelopes (float32 (N,4)): small
+    boxes spread quasi-uniformly over the globe via the golden-ratio
+    low-discrepancy sequence — a w,s,e,n rectangle query therefore selects
+    ~(area fraction) of the features, like a real OSM-nodes layer would."""
+    pks = np.asarray(pks, dtype=np.float64)
+    lon = np.mod(pks * 137.50776405003785, 360.0) - 180.0
+    lat = np.mod(pks * 78.61969413885086, 170.0) - 85.0
+    out = np.empty((len(pks), 4), dtype=np.float32)
+    out[:, 0] = lon
+    out[:, 1] = lat
+    out[:, 2] = lon + 0.001
+    out[:, 3] = lat + 0.001
+    return out
+
+
+def synth_repo(path, n, *, edit_frac=0.01, seed=0, blobs="promised",
+               ds_path="synth", spatial=False):
     """Create a repo at ``path`` with one int-pk dataset of ``n`` features
     and two commits: the base import and an ``edit_frac`` oid-rewrite.
-    -> (repo, dict with commit oids + edit count)."""
+    -> (repo, dict with commit oids + edit count).
+
+    spatial=True adds a geometry column to the schema and writes
+    per-feature envelope columns (:func:`synth_envelopes`) into the
+    sidecars — the spatially-filtered diff's prefilter input (BASELINE
+    config #4; blob values stay promised)."""
     from kart_tpu.core.repo import KartRepo
     from kart_tpu.diff import sidecar
     from kart_tpu.models.dataset import Dataset3
@@ -124,6 +176,17 @@ def synth_repo(path, n, *, edit_frac=0.01, seed=0, blobs="promised", ds_path="sy
         else:
             oids2[edit_rows] = _synth_oids(edit_rows, seed + 2)
 
+    schema = SYNTH_SCHEMA
+    crs_defs = None
+    envelopes = None
+    if spatial:
+        assert blobs == "promised", "spatial synth supports promised blobs only"
+        schema = SYNTH_SPATIAL_SCHEMA
+        from kart_tpu.epsg import epsg_wkt
+
+        crs_defs = {"EPSG:4326": epsg_wkt(4326)}
+        envelopes = synth_envelopes(pks)
+
     plan = plan_int_feature_tree(pks)
     commits = []
     prev = None
@@ -134,8 +197,9 @@ def synth_repo(path, n, *, edit_frac=0.01, seed=0, blobs="promised", ds_path="sy
             tb = TreeBuilder(odb, repo.head_tree_oid if commits else None)
             for blob_path, data in Dataset3.new_dataset_meta_blobs(
                 ds_path,
-                SYNTH_SCHEMA,
+                schema,
                 title="synthetic benchmark layer",
+                crs_defs=crs_defs,
                 path_encoder=PathEncoder.INT_PK_ENCODER,
             ):
                 tb.insert(blob_path, odb.write_blob(data))
@@ -147,7 +211,7 @@ def synth_repo(path, n, *, edit_frac=0.01, seed=0, blobs="promised", ds_path="sy
             "HEAD", root, message, [commits[-1]] if commits else []
         )
         commits.append(commit_oid)
-        sidecar.save_sidecar(repo, ftree, pks, oids_u8)
+        sidecar.save_sidecar(repo, ftree, pks, oids_u8, envelopes=envelopes)
 
     return repo, {
         "base_commit": commits[0],

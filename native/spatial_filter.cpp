@@ -104,6 +104,28 @@ int64_t sf_bbox_intersects(const double* envelopes, int64_t n,
   return hits;
 }
 
+// float32 variant: reads (n,4) f32 envelopes straight from a sidecar mmap
+// (no f64 conversion pass). Same semantics as sf_bbox_intersects via the
+// shared cyclic helpers; mostly-branch-free body so the compiler can
+// vectorize the compares.
+int64_t sf_bbox_intersects_f32(const float* envelopes, int64_t n,
+                               const double* query, uint8_t* out) {
+  Envelope q{query[0], query[1], query[2], query[3]};
+  const double qlen = range_len(q.w, q.e);
+  int64_t hits = 0;
+  for (int64_t i = 0; i < n; i++) {
+    const float* p = envelopes + i * 4;
+    const double w = p[0], s = p[1], e = p[2], nn = p[3];
+    const bool lat_ok = (s <= q.n) & (q.s <= nn);
+    const double len = range_len(w, e);
+    const bool lon_ok = (mod360(q.w - w) <= len) | (mod360(w - q.w) <= qlen);
+    const bool hit = lat_ok & lon_ok;
+    out[i] = hit ? 1 : 0;
+    hits += hit;
+  }
+  return hits;
+}
+
 // The fused server-side hot path: packed envelope table -> match bitmap,
 // no intermediate doubles (one pass, cache-friendly).
 int64_t sf_filter_packed(const uint8_t* packed, int64_t n, const double* query,

@@ -1,0 +1,236 @@
+"""Spatially-filtered diffs (reference: kart/base_diff_writer.py:279-341 —
+on a spatially-filtered clone, `kart diff` streams only deltas whose old OR
+new value matches the filter; BASELINE config #4 measures the same path at
+100M via the envelope-column prefilter).
+
+Layers under test:
+* writer-level exact filtering (value residue) on a real small repo;
+* engine-level envelope prefilter on sidecar blocks (synth spatial repo),
+  including its parity with the writer-level count;
+* envelope sidecar column roundtrip.
+"""
+
+import json
+
+import numpy as np
+import pytest
+
+from helpers import edit_commit, make_imported_repo
+
+# covers fids 1..5 (points sit at lon 100+i, lat -40-0.1i)
+FILTER_W5 = "EPSG:4326;POLYGON((100 -42, 106 -42, 106 -39, 100 -39, 100 -42))"
+
+
+def set_filter(repo, spec_text):
+    from kart_tpu.spatial_filter import ResolvedSpatialFilterSpec
+
+    spec = ResolvedSpatialFilterSpec.from_spec_string(spec_text)
+    repo.config.set_many(spec.config_items())
+
+
+def diff_json(repo, spec="HEAD^...HEAD"):
+    from kart_tpu.diff.writers import JsonDiffWriter
+    import io
+
+    out = io.StringIO()
+    writer = JsonDiffWriter(repo, spec, output_path=out, json_style="compact")
+    writer.write_diff()
+    return json.loads(out.getvalue())["kart.diff/v1+hexwkb"]
+
+
+class TestWriterLevelFilter:
+    def test_only_matching_deltas_stream(self, tmp_path):
+        repo, ds_path = make_imported_repo(tmp_path, n=10)
+        edit_commit(
+            repo, ds_path,
+            updates=[
+                {**repo.datasets()[ds_path].get_feature([fid]), "name": "edited"}
+                for fid in (2, 8)
+            ],
+            message="edit in+out",
+        )
+        # no filter: both updates
+        assert len(diff_json(repo)[ds_path]["feature"]) == 2
+        set_filter(repo, FILTER_W5)
+        feats = diff_json(repo)[ds_path]["feature"]
+        assert len(feats) == 1
+        assert feats[0]["+"]["fid"] == 2
+
+    def test_either_side_matches(self, tmp_path):
+        """A feature moved from inside the filter to outside still shows
+        (reference: matches_delta_values tests old OR new)."""
+        repo, ds_path = make_imported_repo(tmp_path, n=10)
+        ds = repo.datasets()[ds_path]
+        from kart_tpu.geometry import Geometry
+
+        moved = dict(ds.get_feature([3]))
+        moved["geom"] = Geometry.from_wkt("POINT (150 -20)")  # outside
+        edit_commit(repo, ds_path, updates=[moved], message="move out")
+        set_filter(repo, FILTER_W5)
+        feats = diff_json(repo)[ds_path]["feature"]
+        assert len(feats) == 1
+        assert feats[0]["-"]["fid"] == 3
+
+    def test_insert_outside_filter_hidden(self, tmp_path):
+        repo, ds_path = make_imported_repo(tmp_path, n=10)
+        from kart_tpu.geometry import Geometry
+
+        edit_commit(
+            repo, ds_path,
+            inserts=[
+                {"fid": 100, "geom": Geometry.from_wkt("POINT (160 10)"),
+                 "name": "far away", "rating": 1.0},
+                {"fid": 101, "geom": Geometry.from_wkt("POINT (102.5 -40.0)"),
+                 "name": "nearby", "rating": 1.0},
+            ],
+            message="inserts",
+        )
+        set_filter(repo, FILTER_W5)
+        feats = diff_json(repo)[ds_path]["feature"]
+        assert [f["+"]["fid"] for f in feats] == [101]
+
+    def test_exit_code_agrees_with_output(self, tmp_path):
+        """An all-out-of-filter diff must report has_changes=False — the
+        exit code agrees with the (empty) output, across writers."""
+        import io
+
+        from kart_tpu.diff.writers import JsonDiffWriter, TextDiffWriter
+
+        repo, ds_path = make_imported_repo(tmp_path, n=10)
+        edit_commit(
+            repo, ds_path,
+            updates=[{**repo.datasets()[ds_path].get_feature([8]), "name": "x"}],
+            message="out-of-filter edit",
+        )
+        set_filter(repo, FILTER_W5)
+        for writer_cls in (TextDiffWriter, JsonDiffWriter):
+            out = io.StringIO()
+            writer = writer_cls(repo, "HEAD^...HEAD", output_path=out)
+            assert writer.write_diff() is False, writer_cls.__name__
+
+    def test_feature_count_respects_filter(self, tmp_path):
+        from click.testing import CliRunner
+
+        from kart_tpu.cli import cli
+
+        repo, ds_path = make_imported_repo(tmp_path, n=10)
+        edit_commit(
+            repo, ds_path,
+            updates=[
+                {**repo.datasets()[ds_path].get_feature([fid]), "name": "e"}
+                for fid in (2, 3, 8, 9)
+            ],
+            message="edits",
+        )
+        runner = CliRunner()
+        args = ["-C", str(tmp_path / "repo"), "diff", "HEAD^...HEAD", "-o", "feature-count"]
+        r = runner.invoke(cli, args)
+        assert r.exit_code == 0 and "4 features changed" in r.output
+        set_filter(repo, FILTER_W5)
+        r = runner.invoke(cli, args)
+        assert r.exit_code == 0 and "2 features changed" in r.output, r.output
+
+
+class TestEnvelopePrefilter:
+    @pytest.fixture(scope="class")
+    def spatial_repo(self, tmp_path_factory):
+        from kart_tpu.synth import synth_repo
+
+        path = tmp_path_factory.mktemp("synthsp") / "repo"
+        repo, info = synth_repo(str(path), 30_000, edit_frac=0.01, spatial=True)
+        return repo, info
+
+    def test_sidecar_envelopes_roundtrip(self, spatial_repo):
+        from kart_tpu.diff import sidecar
+        from kart_tpu.synth import synth_envelopes
+
+        repo, info = spatial_repo
+        ds = repo.structure("HEAD").datasets["synth"]
+        block = sidecar.load_block(repo, ds)
+        assert block is not None and block.envelopes is not None
+        assert block.envelopes.shape == (block.count, 4)
+        base = 1 << 24
+        expect = synth_envelopes(np.asarray(block.keys[: block.count]))
+        np.testing.assert_allclose(np.asarray(block.envelopes), expect)
+        assert base == int(block.keys[0])
+
+    def test_filtered_count_less_and_consistent(self, spatial_repo):
+        from kart_tpu.diff.engine import get_dataset_feature_count_fast
+        from kart_tpu.spatial_filter import ResolvedSpatialFilterSpec
+
+        repo, info = spatial_repo
+        base_rs = repo.structure("HEAD^")
+        target_rs = repo.structure("HEAD")
+        unfiltered = get_dataset_feature_count_fast(base_rs, target_rs, "synth")
+        assert unfiltered == info["n_edits"]
+
+        spec = ResolvedSpatialFilterSpec.from_spec_string(
+            "EPSG:4326;POLYGON((-180 -85, 0 -85, 0 85, -180 85, -180 -85))"
+        )
+        filtered = get_dataset_feature_count_fast(
+            base_rs, target_rs, "synth", spatial_filter_spec=spec
+        )
+        assert 0 < filtered < unfiltered
+        # ~half the globe -> roughly half the edits (quasi-uniform spread)
+        assert abs(filtered - unfiltered / 2) < unfiltered * 0.2
+
+    def test_prefilter_matches_envelope_recount(self, spatial_repo):
+        """The engine prefilter count equals a direct recount: edits whose
+        (old or new) envelope intersects the filter rect."""
+        from kart_tpu.diff.engine import (
+            get_dataset_feature_count_fast,
+            spatial_prefilter_blocks,
+        )
+        from kart_tpu.diff import sidecar
+        from kart_tpu.ops.bbox import bbox_intersects_np
+        from kart_tpu.spatial_filter import ResolvedSpatialFilterSpec
+        from kart_tpu.synth import synth_envelopes
+
+        repo, info = spatial_repo
+        base_rs = repo.structure("HEAD^")
+        target_rs = repo.structure("HEAD")
+        rect = (20.0, -50.0, 140.0, 30.0)
+        spec = ResolvedSpatialFilterSpec.from_spec_string(
+            "EPSG:4326;POLYGON((20 -50, 140 -50, 140 30, 20 30, 20 -50))"
+        )
+        got = get_dataset_feature_count_fast(
+            base_rs, target_rs, "synth", spatial_filter_spec=spec
+        )
+        # recount directly: synth edits are oid rewrites of known rows
+        old_block = sidecar.load_block(repo, base_rs.datasets["synth"])
+        new_block = sidecar.load_block(repo, target_rs.datasets["synth"])
+        o = np.asarray(old_block.oids[: old_block.count])
+        n = np.asarray(new_block.oids[: new_block.count])
+        changed = (o != n).any(axis=1)
+        envs = np.asarray(old_block.envelopes)
+        hits = bbox_intersects_np(envs.astype(np.float64), np.asarray(rect))
+        assert got == int((changed & hits).sum())
+
+    def test_cli_feature_count_uses_prefilter(self, spatial_repo, tmp_path):
+        from click.testing import CliRunner
+
+        from kart_tpu.cli import cli
+        from kart_tpu.spatial_filter import ResolvedSpatialFilterSpec
+
+        repo, info = spatial_repo
+        spec = ResolvedSpatialFilterSpec.from_spec_string(
+            "EPSG:4326;POLYGON((-180 -85, 0 -85, 0 85, -180 85, -180 -85))"
+        )
+        repo.config.set_many(spec.config_items())
+        try:
+            runner = CliRunner()
+            r = runner.invoke(
+                cli,
+                ["-C", repo.workdir or repo.gitdir, "diff",
+                 "HEAD^...HEAD", "-o", "feature-count"],
+            )
+            assert r.exit_code == 0, r.output
+            import re as _re
+
+            m = _re.search(r"(\d+) features changed", r.output)
+            assert m, r.output
+            count = int(m.group(1))
+            assert 0 < count < info["n_edits"]
+        finally:
+            for key in spec.config_items():
+                repo.del_config(key)

@@ -302,8 +302,11 @@ class TestPromisorBackfill:
         assert pks == [1, 2, 3, 4, 5]
 
     def test_diff_backfills_promised_values(self, filtered_wc_clone, capsys):
-        """A committed-range diff that touches out-of-filter features must
-        batch-fetch their promised blobs and still print every delta."""
+        """A committed-range diff buffers deltas whose values are promised,
+        batch-fetches their blobs mid-stream, and then applies the clone's
+        spatial filter to the fetched values — out-of-filter features stay
+        hidden (reference: `kart diff` on a filtered clone shows only
+        matching deltas, base_diff_writer.py:279-341 + DeltaFetcher)."""
         import json
 
         from kart_tpu.diff.writers import BaseDiffWriter
@@ -320,10 +323,33 @@ class TestPromisorBackfill:
         out = capsys.readouterr().out
         deltas = json.loads(out)["kart.diff/v1+hexwkb"][ds_path]["feature"]
         inserted_fids = {d["+"]["fid"] for d in deltas if "+" in d}
-        # every feature appears, including the promised ones
-        assert inserted_fids == set(range(1, 11))
-        # and the promised blob is now present locally (backfilled)
+        # only in-filter deltas stream (fid 1 has a NULL geometry by HEAD:
+        # NULL always matches; 2..5 are inside the rect)
+        assert inserted_fids == {1, 2, 3, 4, 5}
+        # the promised blob WAS backfilled to evaluate the filter exactly
         assert clone.odb.contains(blob_oid)
+
+    def test_diff_shows_everything_when_filter_removed(
+        self, filtered_wc_clone, capsys
+    ):
+        """Clearing the clone's spatial-filter config makes the same diff
+        surface every delta — the promised values backfill mid-stream."""
+        import json
+
+        from kart_tpu.diff.writers import BaseDiffWriter
+        from kart_tpu.spatial_filter import ResolvedSpatialFilterSpec
+
+        repo, clone, ds_path = filtered_wc_clone
+        spec = ResolvedSpatialFilterSpec.from_repo_config(clone)
+        for key in spec.config_items():
+            clone.del_config(key)
+        writer_cls = BaseDiffWriter.get_diff_writer_class("json")
+        writer = writer_cls(clone, "[EMPTY]...HEAD", json_style="compact")
+        writer.write_diff()
+        out = capsys.readouterr().out
+        deltas = json.loads(out)["kart.diff/v1+hexwkb"][ds_path]["feature"]
+        inserted_fids = {d["+"]["fid"] for d in deltas if "+" in d}
+        assert inserted_fids == set(range(1, 11))
 
     def test_reset_handles_promised_targets(self, filtered_wc_clone):
         """Branch switching in a filtered clone: deltas whose target values
