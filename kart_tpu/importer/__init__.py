@@ -92,6 +92,10 @@ class ImportSource:
             from kart_tpu.importer.shapefile import ShapefileImportSource
 
             return [ShapefileImportSource(spec)]
+        if lowered.endswith(".fgb"):
+            from kart_tpu.importer.flatgeobuf import FlatGeobufImportSource
+
+            return [FlatGeobufImportSource(spec)]
         if lowered.endswith(".zip"):
             return [_open_zipped_shapefile(spec)]
         if spec.startswith(("postgresql://", "postgres://")):
@@ -108,7 +112,7 @@ class ImportSource:
             return SqlServerImportSource.open_all(spec, table=table)
         raise ImportSourceError(
             f"Don't know how to import {spec!r} — supported: .gpkg, .shp, "
-            f".zip (shapefile), .geojson, .geojsonl/.ndjson, .csv, "
+            f".zip (shapefile), .fgb, .geojson, .geojsonl/.ndjson, .csv, "
             f"postgresql://, mysql://, mssql://"
         )
 

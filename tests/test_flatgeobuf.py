@@ -1,0 +1,387 @@
+"""FlatGeobuf import source (VERDICT r4 next #10: the most practical slice
+of the arbitrary-OGR-driver gap, implemented from the open spec).
+
+No GDAL and no flatbuffers runtime exist here, so the tests carry a tiny
+hand-rolled flatbuffers *writer* (forward-offset layout — legal, if not the
+canonical back-to-front encoding) and build real .fgb files with it: magic,
+Header, optional packed R-tree bytes, size-prefixed Features.
+"""
+
+import math
+import struct
+
+import pytest
+
+from kart_tpu.core.repo import KartRepo
+from kart_tpu.importer import ImportSource, ImportSourceError
+
+
+# -- minimal flatbuffers writer ---------------------------------------------
+
+def build_table(buf, fields):
+    """fields: {field_id: ("i", fmt, value) inline scalar |
+    ("o", child_builder_fn) offset}. Appends the table (+vtable) to buf and
+    any offset children after it; -> table position."""
+    nslots = (max(fields) + 1) if fields else 0
+    table_pos = len(buf)
+    buf += b"\x00\x00\x00\x00"  # soffset placeholder
+    slots = {}
+    patches = []
+    for fid in sorted(fields):
+        entry = fields[fid]
+        slot_pos = len(buf)
+        if entry[0] == "i":
+            buf += struct.pack(entry[1], entry[2])
+        else:
+            patches.append((slot_pos, entry[1]))
+            buf += b"\x00\x00\x00\x00"
+        slots[fid] = slot_pos - table_pos
+    table_size = len(buf) - table_pos
+    vt_pos = len(buf)
+    buf += struct.pack("<HH", 4 + 2 * nslots, table_size)
+    for fid in range(nslots):
+        buf += struct.pack("<H", slots.get(fid, 0))
+    struct.pack_into("<i", buf, table_pos, table_pos - vt_pos)
+    for slot_pos, fn in patches:
+        child_pos = fn(buf)
+        struct.pack_into("<I", buf, slot_pos, child_pos - slot_pos)
+    return table_pos
+
+
+def string_(s):
+    def fn(buf):
+        pos = len(buf)
+        raw = s.encode("utf-8")
+        buf += struct.pack("<I", len(raw)) + raw + b"\x00"
+        return pos
+
+    return fn
+
+
+def vector_(fmt, values):
+    def fn(buf):
+        pos = len(buf)
+        buf += struct.pack("<I", len(values))
+        for v in values:
+            buf += struct.pack(fmt, v)
+        return pos
+
+    return fn
+
+
+def bytes_vector_(raw):
+    def fn(buf):
+        pos = len(buf)
+        buf += struct.pack("<I", len(raw)) + bytes(raw)
+        return pos
+
+    return fn
+
+
+def table_(fields):
+    return lambda buf: build_table(buf, fields)
+
+
+def table_vector_(field_dicts):
+    def fn(buf):
+        pos = len(buf)
+        buf += struct.pack("<I", len(field_dicts))
+        slot_positions = []
+        for _ in field_dicts:
+            slot_positions.append(len(buf))
+            buf += b"\x00\x00\x00\x00"
+        for slot_pos, fields in zip(slot_positions, field_dicts):
+            child = build_table(buf, fields)
+            struct.pack_into("<I", buf, slot_pos, child - slot_pos)
+        return pos
+
+    return fn
+
+
+def root_block(fields):
+    """[u32 size][u32 root offset][table...] — a size-prefixed flatbuffer."""
+    inner = bytearray(b"\x00\x00\x00\x00")  # root offset placeholder
+    root = build_table(inner, fields)
+    struct.pack_into("<I", inner, 0, root)
+    return struct.pack("<I", len(inner)) + bytes(inner)
+
+
+def column(name, ctype, primary_key=False):
+    fields = {0: ("o", string_(name)), 1: ("i", "<B", ctype)}
+    if primary_key:
+        fields[9] = ("i", "<B", 1)
+    return fields
+
+
+def props(pairs):
+    """[(col_index, ctype, value)] -> properties blob."""
+    out = bytearray()
+    for ci, ctype, val in pairs:
+        out += struct.pack("<H", ci)
+        fmts = {0: "<b", 1: "<B", 2: "<B", 3: "<h", 4: "<H", 5: "<i",
+                6: "<I", 7: "<q", 8: "<Q", 9: "<f", 10: "<d"}
+        if ctype in fmts:
+            out += struct.pack(fmts[ctype], val)
+        else:
+            raw = val if isinstance(val, bytes) else val.encode("utf-8")
+            out += struct.pack("<I", len(raw)) + raw
+    return bytes(out)
+
+
+def write_fgb(path, *, name="layer", geometry_type=1, columns=(),
+              features=(), crs=None, features_count=None, index_node_size=0,
+              has_z=False):
+    """features: [(geom_fields | None, properties blob)]"""
+    header_fields = {
+        0: ("o", string_(name)),
+        2: ("i", "<B", geometry_type),
+        8: ("i", "<Q", len(features) if features_count is None else features_count),
+        9: ("i", "<H", index_node_size),
+    }
+    if has_z:
+        header_fields[3] = ("i", "<B", 1)
+    if columns:
+        header_fields[7] = ("o", table_vector_(list(columns)))
+    if crs:
+        header_fields[10] = ("o", table_(crs))
+    out = bytearray(b"fgb\x03fgb\x00")
+    out += root_block(header_fields)
+    if index_node_size:
+        from kart_tpu.importer.flatgeobuf import packed_rtree_size
+
+        out += b"\xee" * packed_rtree_size(
+            len(features) if features_count is None else features_count,
+            index_node_size,
+        )
+    for geom_fields, prop_blob in features:
+        ffields = {}
+        if geom_fields is not None:
+            ffields[0] = ("o", table_(geom_fields))
+        if prop_blob:
+            ffields[1] = ("o", bytes_vector_(prop_blob))
+        out += root_block(ffields)
+    with open(path, "wb") as f:
+        f.write(bytes(out))
+    return str(path)
+
+
+def point(x, y):
+    return {1: ("o", vector_("<d", [x, y])), 6: ("i", "<B", 1)}
+
+
+# -- tests ------------------------------------------------------------------
+
+
+@pytest.fixture
+def repo(tmp_path):
+    repo = KartRepo.init_repository(tmp_path / "repo")
+    repo.config.set_many({"user.name": "t", "user.email": "t@e"})
+    return repo
+
+
+def test_schema_and_features(tmp_path):
+    cols = [
+        column("name", 11),
+        column("height", 10),
+        column("storeys", 5),
+        column("listed", 2),
+    ]
+    feats = [
+        (point(174.78, -41.29),
+         props([(0, 11, "te aro"), (1, 10, 12.5), (2, 5, 3), (3, 2, 1)])),
+        (None, props([(0, 11, "no geom")])),
+    ]
+    fgb = write_fgb(tmp_path / "buildings.fgb", name="buildings",
+                    columns=cols, features=feats)
+    (src,) = ImportSource.open(fgb)
+    assert src.dest_path == "buildings"
+    assert [
+        (c.name, c.data_type, c.pk_index) for c in src.schema.columns
+    ] == [
+        ("FID", "integer", 0),
+        ("geom", "geometry", None),
+        ("name", "text", None),
+        ("height", "float", None),
+        ("storeys", "integer", None),
+        ("listed", "boolean", None),
+    ]
+    rows = list(src.features())
+    assert len(rows) == 2 and src.feature_count == 2
+    f1 = rows[0]
+    assert f1["FID"] == 1 and f1["name"] == "te aro"
+    assert f1["height"] == 12.5 and f1["storeys"] == 3 and f1["listed"] is True
+    assert f1["geom"].to_wkt() == "POINT (174.78 -41.29)"
+    assert rows[1]["geom"] is None and rows[1]["height"] is None
+
+
+def test_primary_key_column(tmp_path):
+    cols = [column("code", 7, primary_key=True), column("label", 11)]
+    feats = [
+        (point(1, 2), props([(0, 7, 42), (1, 11, "a")])),
+        (point(3, 4), props([(0, 7, 43), (1, 11, "b")])),
+    ]
+    fgb = write_fgb(tmp_path / "coded.fgb", columns=cols, features=feats)
+    (src,) = ImportSource.open(fgb)
+    pk_cols = {c.name: c.pk_index for c in src.schema.columns}
+    assert pk_cols == {"code": 0, "geom": None, "label": None}
+    rows = list(src.features())
+    assert [r["code"] for r in rows] == [42, 43]
+
+
+def test_index_is_skipped(tmp_path):
+    fgb = write_fgb(
+        tmp_path / "indexed.fgb",
+        columns=[column("n", 5)],
+        features=[(point(10, 20), props([(0, 5, 7)]))],
+        index_node_size=16,
+    )
+    (src,) = ImportSource.open(fgb)
+    (row,) = src.features()
+    assert row["n"] == 7
+    assert row["geom"].to_wkt() == "POINT (10 20)"
+
+
+def test_crs_from_epsg_code(tmp_path):
+    crs = {0: ("o", string_("EPSG")), 1: ("i", "<i", 4326)}
+    fgb = write_fgb(tmp_path / "crs.fgb", features=[(point(0, 0), b"")],
+                    crs=crs)
+    (src,) = ImportSource.open(fgb)
+    defs = src.crs_definitions()
+    assert "EPSG:4326" in defs and 'GEOGCS["WGS 84"' in defs["EPSG:4326"]
+    geom_col = next(c for c in src.schema.columns if c.name == "geom")
+    assert geom_col.extra_type_info["geometryCRS"] == "EPSG:4326"
+
+
+def test_multipolygon_parts(tmp_path):
+    ring1 = [0.0, 0.0, 4.0, 0.0, 4.0, 4.0, 0.0, 0.0]
+    ring2 = [10.0, 10.0, 12.0, 10.0, 12.0, 12.0, 10.0, 10.0]
+    part = lambda ring: {
+        0: ("o", vector_("<I", [len(ring) // 2])),
+        1: ("o", vector_("<d", ring)),
+        6: ("i", "<B", 3),
+    }
+    mp = {6: ("i", "<B", 6), 7: ("o", table_vector_([part(ring1), part(ring2)]))}
+    fgb = write_fgb(tmp_path / "mp.fgb", geometry_type=6,
+                    features=[(mp, b"")])
+    (src,) = ImportSource.open(fgb)
+    (row,) = src.features()
+    wkt = row["geom"].to_wkt()
+    assert wkt.startswith("MULTIPOLYGON (((0 0") and "10 10" in wkt
+
+
+def test_linestring_and_ends(tmp_path):
+    ls = {
+        0: ("o", vector_("<I", [3])),
+        1: ("o", vector_("<d", [0.0, 0.0, 1.0, 1.0, 2.0, 0.0])),
+        6: ("i", "<B", 2),
+    }
+    fgb = write_fgb(tmp_path / "ls.fgb", geometry_type=2,
+                    features=[(ls, b"")])
+    (src,) = ImportSource.open(fgb)
+    (row,) = src.features()
+    assert row["geom"].to_wkt() == "LINESTRING (0 0,1 1,2 0)"
+
+
+def test_full_import(tmp_path, repo):
+    cols = [column("name", 11), column("rating", 10)]
+    feats = [
+        (point(100 + i, -40 - i / 10),
+         props([(0, 11, f"f-{i}"), (1, 10, i / 2.0)]))
+        for i in range(1, 6)
+    ]
+    crs = {0: ("o", string_("EPSG")), 1: ("i", "<i", 4326)}
+    fgb = write_fgb(tmp_path / "pts.fgb", name="pts", columns=cols,
+                    features=feats, crs=crs)
+    from kart_tpu.importer.importer import import_sources
+
+    import_sources(repo, ImportSource.open(fgb))
+    ds = repo.structure("HEAD").datasets["pts"]
+    assert ds.feature_count == 5
+    f3 = ds.get_feature([3])
+    assert f3 == {
+        "FID": 3,
+        "geom": f3["geom"],
+        "name": "f-3",
+        "rating": 1.5,
+    }
+    assert f3["geom"].to_wkt() == "POINT (103 -40.3)"
+    assert ds.crs_identifiers() == ["EPSG:4326"]
+
+
+def test_multipoint_flat_encoding(tmp_path):
+    mp = {1: ("o", vector_("<d", [1.0, 2.0, 3.0, 4.0])), 6: ("i", "<B", 4)}
+    fgb = write_fgb(tmp_path / "mp.fgb", geometry_type=4,
+                    features=[(mp, b"")])
+    (src,) = ImportSource.open(fgb)
+    (row,) = src.features()
+    assert row["geom"].to_wkt() == "MULTIPOINT ((1 2),(3 4))"
+
+
+def test_patch_level_byte_ignored(tmp_path):
+    """GDAL writes patch byte 0x01; only the first 7 magic bytes matter."""
+    fgb = write_fgb(tmp_path / "p.fgb", features=[(point(5, 6), b"")])
+    raw = bytearray(open(fgb, "rb").read())
+    raw[7] = 0x01
+    open(fgb, "wb").write(bytes(raw))
+    (src,) = ImportSource.open(fgb)
+    (row,) = src.features()
+    assert row["geom"].to_wkt() == "POINT (5 6)"
+
+
+def test_unknown_layer_type_keeps_geometry(tmp_path):
+    """geometry_type=Unknown (mixed layers): each feature carries its own
+    type; the geometry must not be silently dropped."""
+    fgb = write_fgb(tmp_path / "mixed.fgb", geometry_type=0,
+                    columns=[column("n", 5)],
+                    features=[(point(7, 8), props([(0, 5, 1)]))])
+    (src,) = ImportSource.open(fgb)
+    assert any(c.data_type == "geometry" for c in src.schema.columns)
+    (row,) = src.features()
+    assert row["geom"].to_wkt() == "POINT (7 8)" and row["n"] == 1
+
+
+def test_fid_attribute_collision(tmp_path):
+    """A source column literally named FID must not clobber the synthesized
+    pk (GDAL round-trips produce such columns)."""
+    fgb = write_fgb(tmp_path / "fid.fgb", columns=[column("FID", 5)],
+                    features=[(point(0, 0), props([(0, 5, 99)]))])
+    (src,) = ImportSource.open(fgb)
+    pk_col = next(c for c in src.schema.columns if c.pk_index == 0)
+    assert pk_col.name == "FID_1"
+    (row,) = src.features()
+    assert row["FID_1"] == 1 and row["FID"] == 99
+
+
+def test_z_and_m_coordinates(tmp_path):
+    pz = {
+        1: ("o", vector_("<d", [1.0, 2.0])),
+        2: ("o", vector_("<d", [9.5])),
+        3: ("o", vector_("<d", [4.25])),
+        6: ("i", "<B", 1),
+    }
+    fgb = write_fgb(tmp_path / "zm.fgb", features=[(pz, b"")], has_z=True)
+    # header has_m isn't set by write_fgb; patch via a second file with both
+    (src,) = ImportSource.open(fgb)
+    (row,) = src.features()
+    assert row["geom"].to_wkt() == "POINT Z (1 2 9.5)"
+
+
+def test_bad_magic(tmp_path):
+    p = tmp_path / "junk.fgb"
+    p.write_bytes(b"not a flatgeobuf")
+    with pytest.raises(ImportSourceError, match="magic"):
+        ImportSource.open(str(p))
+
+
+def test_packed_rtree_size():
+    from kart_tpu.importer.flatgeobuf import packed_rtree_size
+
+    assert packed_rtree_size(0, 16) == 0
+    assert packed_rtree_size(1, 16) == 40  # 1 leaf + no internals... root
+    # matches the reference algorithm: sum of ceil-division levels
+    n, node = 1000, 16
+    total, lv = n, n
+    while lv != 1:
+        lv = math.ceil(lv / node)
+        total += lv
+    assert packed_rtree_size(1000, 16) == total * 40
