@@ -31,33 +31,14 @@ def _merge_json(result, repo):
     return {"kart.merge/v1": body}
 
 
-def _conflict_kind(aot):
-    """ancestor/ours/theirs presence -> 'edit/edit' | 'add/add' |
-    'delete/edit' | 'edit/delete' (reference: kart/merge_util.py conflict
-    labelling)."""
-    if aot.ancestor is None:
-        return "add/add"
-    if aot.ours is None:
-        return "delete/edit"
-    if aot.theirs is None:
-        return "edit/delete"
-    return "edit/edit"
-
-
 def _conflict_summary(conflicts):
-    """label dict -> nested {ds_path: {'featureConflicts': {...}} } summary
-    (reference: conflicts output shape, kart/conflicts.py)."""
-    summary = {}
-    for label, aot in conflicts.items():
-        parts = label.split(":", 2)
-        ds_path = parts[0]
-        kind = parts[1] if len(parts) > 1 else "feature"
-        ds_summary = summary.setdefault(ds_path, {})
-        key = "featureConflicts" if kind == "feature" else "metaConflicts"
-        bucket = ds_summary.setdefault(key, {})
-        how = _conflict_kind(aot)
-        bucket[how] = bucket.get(how, 0) + 1
-    return summary
+    """label dict -> {ds_path: {part: count}} — the reference merge
+    output's conflict summary (list_conflicts(..., summarise=2);
+    kart/merge.py:105-106, e.g. {"layer": {"feature": 4}})."""
+    out = {}
+    for label in conflicts:
+        _set_value_at_path(out, tuple(label.split(":", 2)), _CONFLICT_PLACEHOLDER)
+    return _summarise_tree(out, 2)
 
 
 @cli.command("merge")
@@ -202,20 +183,221 @@ class _ConflictDecoder:
             return {"$blob": entry.oid}
 
 
+_CONFLICT_PLACEHOLDER = object()
+
+
+def _path_part_sort_key(part):
+    """Reference sort: numbers numerically, meta before feature, compound
+    keys last (kart/conflicts.py:_path_part_sort_key)."""
+    if isinstance(part, str) and part.isdigit():
+        part = int(part)
+    if part == "meta":
+        return ("A", part)
+    if part == "feature":
+        return ("B", part)
+    if isinstance(part, str) and "," in part:
+        return ("Z", part)
+    if isinstance(part, int):
+        return ("N", "", part)
+    return ("N", part)
+
+
+def _path_sort_key(path):
+    if isinstance(path, str) and ":" in path:
+        return tuple(_path_part_sort_key(p) for p in path.split(":"))
+    return _path_part_sort_key(path)
+
+
+def _set_value_at_path(root, path, value):
+    cur = root
+    for p in path[:-1]:
+        cur = cur.setdefault(p, {})
+    cur[path[-1]] = value
+
+
+def _summarise_tree(node, summarise):
+    """Nested conflicts dict with placeholder leaves -> names (-s) or
+    counts (-ss) at the version-dict level (reference: summarise_conflicts)."""
+    first = next(iter(node.values())) if node else None
+    if first is _CONFLICT_PLACEHOLDER:
+        if summarise == 1:
+            return sorted(node.keys(), key=_path_sort_key)
+        return len(node)
+    for k, v in node.items():
+        node[k] = _summarise_tree(v, summarise)
+    return node
+
+
+def _filter_conflicts(unresolved, filters):
+    """Label-prefix filtering ('ds', 'ds:feature', 'ds:feature:3')."""
+    if not filters:
+        return unresolved
+    prefixes = [f.rstrip(":") for f in filters]
+    return {
+        label: aot
+        for label, aot in unresolved.items()
+        if any(label == p or label.startswith(p + ":") for p in prefixes)
+    }
+
+
+def _build_conflicts_output(repo, unresolved, output_format, *, summarise=0,
+                            flat=False, target_crs=None):
+    """Unresolved (already-filtered) conflicts -> the reference's output
+    structure for the requested format: nested dicts (or --flat
+    label-keyed), values rendered per format (feature text blocks /
+    json+hexwkb / geojson features)."""
+    from kart_tpu.diff.output import (
+        feature_as_geojson,
+        feature_as_json,
+        feature_as_text,
+    )
+
+    decoder = _ConflictDecoder(repo)
+    if output_format == "geojson":
+        flat, summarise = True, 0
+
+    tx_cache = {}
+
+    def transform_for(ds_path):
+        if target_crs is None:
+            return None
+        if ds_path not in tx_cache:
+            from kart_tpu.diff.output import geometry_transform_for_dataset
+
+            tx = None
+            for ds in decoder._datasets_for(ds_path):
+                # an invalid --crs raises here (same policy as diff --crs)
+                tx = geometry_transform_for_dataset(ds, target_crs)
+                break
+            tx_cache[ds_path] = tx
+        return tx_cache[ds_path]
+
+    def render(value, label, parts):
+        is_feature = len(parts) > 1 and parts[1] == "feature"
+        if is_feature and isinstance(value, dict) and "$blob" not in value:
+            pk = parts[2] if len(parts) > 2 else None
+            if output_format == "text":
+                return feature_as_text(value)
+            if output_format == "geojson":
+                return feature_as_geojson(value, pk, None, transform_for(parts[0]))
+            return feature_as_json(value, pk, transform_for(parts[0]))
+        # meta item / undecodable blob
+        if output_format == "text":
+            return value if isinstance(value, str) else json.dumps(value)
+        return value
+
+    out = {}
+    for label in sorted(unresolved, key=_path_sort_key):
+        parts = tuple(label.split(":", 2))
+        if summarise:
+            if flat:
+                out[label] = _CONFLICT_PLACEHOLDER
+            else:
+                _set_value_at_path(out, parts, _CONFLICT_PLACEHOLDER)
+            continue
+        versions = decoder.versions_json(unresolved[label])
+        leaf = {
+            name: render(value, label, parts)
+            for name, value in versions.items()
+        }
+        if flat:
+            for name, value in leaf.items():
+                out[f"{label}:{name}"] = value
+        else:
+            _set_value_at_path(out, parts, leaf)
+    if summarise:
+        out = _summarise_tree(out, summarise)
+    if output_format == "geojson":
+        features = []
+        for key, feature in out.items():
+            if isinstance(feature, dict) and feature.get("type") == "Feature":
+                feature["id"] = key
+                features.append(feature)
+        return {"type": "FeatureCollection", "features": features}
+    return out
+
+
+def _conflicts_json_as_text(json_obj):
+    """The reference's hierarchical text rendering
+    (kart/conflicts.py:conflicts_json_as_text), byte-compatible: each level
+    indents 4, keys join with ':', version headers coloured."""
+
+    def style_key_text(key_text, level):
+        indent = "    " * level
+        style = {}
+        if key_text.endswith(":ancestor:"):
+            style["fg"] = "red"
+        elif key_text.endswith(":ours:"):
+            style["fg"] = "green"
+        elif key_text.endswith(":theirs:"):
+            style["fg"] = "cyan"
+        return click.style(indent + key_text, **style)
+
+    def value_to_text(value, path, level):
+        if isinstance(value, str):
+            return f"{value}\n"
+        if isinstance(value, int):
+            return f"{value} conflicts\n"
+        if isinstance(value, dict):
+            separator = "\n" if level == 0 else ""
+            return separator.join(
+                item_to_text(k, v, path, level)
+                for k, v in sorted(
+                    value.items(), key=lambda kv: _path_sort_key(kv[0])
+                )
+            )
+        if isinstance(value, list):
+            indent = "    " * level
+            return "".join(f"{indent}{path}{item}\n" for item in value)
+        return f"{value}\n"
+
+    def item_to_text(key, value, path, level):
+        key_text = f"{path}{key}:"
+        styled = style_key_text(key_text, level)
+        value_text = value_to_text(value, key_text, level + 1)
+        if isinstance(value, int):
+            return f"{styled} {value_text}"
+        return f"{styled}\n{value_text}"
+
+    return value_to_text(json_obj, "", 0)
+
+
 @cli.command("conflicts")
 @click.option(
     "-o",
     "--output-format",
-    type=click.Choice(["text", "json", "quiet"]),
+    type=click.Choice(["text", "json", "geojson", "quiet"]),
     default="text",
+)
+@click.option(
+    "--exit-code",
+    is_flag=True,
+    help="Exit with 1 if there are conflicts, 0 if there are none",
+)
+@click.option(
+    "--json-style",
+    type=click.Choice(["extracompact", "compact", "pretty"]),
+    default="pretty",
 )
 @click.option(
     "-s", "--summarise", "--summarize", count=True,
     help="Summarise rather than list each conflict (-ss for even shorter)",
 )
+@click.option(
+    "--flat", is_flag=True, hidden=True,
+    help="All conflicts in a flat list instead of a hierarchy",
+)
+@click.option(
+    "--crs", "target_crs",
+    help="Reproject geometries into the given CRS (EPSG:<code> or WKT)",
+)
+@click.argument("filters", nargs=-1)
 @click.pass_context
-def conflicts(ctx, output_format, summarise):
-    """List or summarise the conflicts of an in-progress merge."""
+def conflicts(ctx, output_format, exit_code, json_style, summarise, flat,
+              target_crs, filters):
+    """List or summarise the conflicts of an in-progress merge
+    (output shape per the reference: kart.conflicts/v1 —
+    {dataset: {"feature": {pk: {version: value}}}}; kart/conflicts.py)."""
     repo = ctx.obj.repo
     if repo.state != KartRepoState.MERGING:
         raise CliError(
@@ -224,63 +406,32 @@ def conflicts(ctx, output_format, summarise):
     from kart_tpu.merge.index import MergeIndex
 
     merge_index = MergeIndex.read_from_repo(repo)
-    unresolved = {
-        label: aot
-        for label, aot in merge_index.conflicts.items()
-        if label not in merge_index.resolves
-    }
+    unresolved = _filter_conflicts(
+        {
+            label: aot
+            for label, aot in merge_index.conflicts.items()
+            if label not in merge_index.resolves
+        },
+        filters,
+    )
 
     if output_format == "quiet":
         sys.exit(1 if unresolved else 0)
 
-    decoder = _ConflictDecoder(repo)
+    body = _build_conflicts_output(
+        repo, unresolved, output_format,
+        summarise=summarise, flat=flat, target_crs=target_crs,
+    )
     if output_format == "json":
-        if summarise:
-            body = _conflict_summary(unresolved)
-        else:
-            body = {
-                label: decoder.versions_json(aot)
-                for label, aot in sorted(unresolved.items())
-            }
-        dump_json_output({"kart.conflicts/v1": body}, "-")
-        return
-
-    if not unresolved:
-        click.echo("No conflicts!")
-        return
-    if summarise:
-        for ds_path, summary in sorted(_conflict_summary(unresolved).items()):
-            click.echo(f"{ds_path}:")
-            for kind, buckets in summary.items():
-                for how, n in buckets.items():
-                    click.echo(f"    {kind} {how}: {n}")
+        dump_json_output({"kart.conflicts/v1": body}, "-", json_style=json_style)
+    elif output_format == "geojson":
+        dump_json_output(body, "-", json_style=json_style)
     else:
-        from kart_tpu.diff.output import feature_as_text
-
-        for label in sorted(unresolved):
-            click.echo(f"=== {label} ===")
-            versions = decoder.versions_json(unresolved[label])
-            is_feature = ":feature:" in label
-            for name in ("ancestor", "ours", "theirs"):
-                if name in versions:
-                    click.echo(f"--- {name}")
-                    value = versions[name]
-                    if (
-                        is_feature
-                        and isinstance(value, dict)
-                        and value.keys() != {"$blob"}
-                    ):
-                        # readable geometry/blob summaries, like diff text
-                        # output (reference prints "POINT(...)" not bytes)
-                        click.echo(feature_as_text(value, prefix="    "))
-                    elif isinstance(value, (dict, list)):
-                        click.echo(json.dumps(value, indent=4))
-                    else:
-                        click.echo(f"    {value}")
-            click.echo()
-    click.echo(f"{len(unresolved)} unresolved conflicts")
-    # listing conflicts is not a failure (reference exit semantics; use
-    # --output-format quiet for an exit-code signal)
+        text = _conflicts_json_as_text(body)
+        if text:
+            click.echo(text)  # echo's newline = the reference's trailing blank
+    if exit_code:
+        sys.exit(1 if unresolved else 0)
 
 
 @cli.command("resolve")

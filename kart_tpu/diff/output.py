@@ -24,6 +24,21 @@ class ExtendedJsonEncoder(json.JSONEncoder):
         return super().default(obj)
 
 
+def geometry_transform_for_dataset(ds, target_crs):
+    """Transform from a dataset's (first) CRS to target_crs, or None when
+    the dataset declares no CRS. Invalid target_crs raises — silently
+    emitting unreprojected output would be worse (shared by the diff
+    writers' and conflicts command's --crs options)."""
+    if target_crs is None or ds is None:
+        return None
+    ids = ds.crs_identifiers()
+    if not ids:
+        return None
+    from kart_tpu.crs import Transform
+
+    return Transform(ds.get_crs_definition(ids[0]), target_crs)
+
+
 def resolve_output_path(output_path):
     """None/'-' -> stdout; str/Path -> opened file; file-like -> itself."""
     if output_path is None or output_path == "-":

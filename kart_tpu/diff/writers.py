@@ -324,15 +324,11 @@ class BaseDiffWriter:
         if self.target_crs is None:
             return None, None
 
+        from kart_tpu.diff.output import geometry_transform_for_dataset
+
         def transform_for(rs):
             ds = rs.datasets.get(ds_path) if rs is not None else None
-            if ds is None:
-                return None
-            ids = ds.crs_identifiers()
-            if not ids:
-                return None
-            src_wkt = ds.get_crs_definition(ids[0])
-            return Transform(src_wkt, self.target_crs)
+            return geometry_transform_for_dataset(ds, self.target_crs)
 
         return transform_for(self.base_rs), transform_for(self.target_rs)
 

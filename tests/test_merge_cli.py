@@ -114,9 +114,10 @@ def test_merge_conflict_resolve_continue(repo_dir, runner):
 
     r = runner.invoke(cli, ["conflicts", "-o", "json"])
     body = json.loads(r.output)["kart.conflicts/v1"]
-    assert "points:feature:3" in body
-    assert body["points:feature:3"]["ours"]["name"] == "ours-3"
-    assert body["points:feature:3"]["theirs"]["name"] == "theirs-3"
+    # reference shape: {dataset: {"feature": {pk: {version: value}}}}
+    versions = body["points"]["feature"]["3"]
+    assert versions["ours"]["name"] == "ours-3"
+    assert versions["theirs"]["name"] == "theirs-3"
 
     r = runner.invoke(cli, ["resolve", "points:feature:3", "--with", "theirs"])
     assert r.exit_code == 0, r.output
@@ -124,7 +125,9 @@ def test_merge_conflict_resolve_continue(repo_dir, runner):
 
     r = runner.invoke(cli, ["conflicts"])
     assert r.exit_code == 0
-    assert "No conflicts" in r.output
+    assert r.output.strip() == ""  # reference: empty hierarchy, no output
+    r = runner.invoke(cli, ["conflicts", "--exit-code"])
+    assert r.exit_code == 0
 
     r = runner.invoke(cli, ["merge", "--continue"])
     assert r.exit_code == 0, r.output
@@ -217,9 +220,10 @@ def test_meta_conflict_renders_text_values(repo_dir, runner):
     assert r.exit_code == 0
     r = runner.invoke(cli, ["conflicts", "-o", "json"])
     body = json.loads(r.output)["kart.conflicts/v1"]
-    assert body["points:meta:title"]["ours"] == "ours title"
-    assert body["points:meta:title"]["theirs"] == "theirs title"
-    assert body["points:meta:title"]["ancestor"] == "points title"
+    versions = body["points"]["meta"]["title"]
+    assert versions["ours"] == "ours title"
+    assert versions["theirs"] == "theirs title"
+    assert versions["ancestor"] == "points title"
 
 
 @pytest.mark.parametrize(
@@ -252,13 +256,18 @@ def test_reference_conflicts_scenarios(
     r = runner.invoke(cli, ["conflicts", "-o", "json"])
     assert r.exit_code == 0, r.output
     body = json.loads(r.output)["kart.conflicts/v1"]
-    labels = sorted(body)
-    assert len(labels) == 4
-    assert all(label.startswith(f"{layer}:feature:") for label in labels)
+    feats = body[layer]["feature"]
+    assert len(feats) == 4
     if expected_pks is not None:
-        got = sorted(int(label.rsplit(":", 1)[1]) for label in labels)
-        assert got == sorted(expected_pks)
+        assert sorted(int(pk) for pk in feats) == sorted(expected_pks)
+    # summaries match the reference's own expected output shapes
+    r = runner.invoke(cli, ["conflicts", "-s", "-o", "json"])
+    sbody = json.loads(r.output)["kart.conflicts/v1"]
+    assert sbody == {layer: {"feature": sorted(feats, key=lambda k: int(k))}}
+    r = runner.invoke(cli, ["conflicts", "-ss", "-o", "json"])
+    assert json.loads(r.output)["kart.conflicts/v1"] == {layer: {"feature": 4}}
 
+    labels = [f"{layer}:feature:{pk}" for pk in feats]
     for label in labels:
         r = runner.invoke(cli, ["resolve", label, "--with=ours"])
         assert r.exit_code == 0, r.output
@@ -266,3 +275,97 @@ def test_reference_conflicts_scenarios(
     assert r.exit_code == 0, r.output
     r = runner.invoke(cli, ["log", "--oneline"])
     assert "merged" in r.output.splitlines()[0]
+
+
+def test_conflicts_output_options(repo_dir, runner):
+    """geojson / --flat / --exit-code / filters / --crs on the conflicts
+    command (reference option surface, kart/conflicts.py:219-262)."""
+    make_conflict(runner, repo_dir)
+    r = runner.invoke(cli, ["merge", "alt"])
+    assert r.exit_code == 0
+
+    r = runner.invoke(cli, ["conflicts", "-o", "geojson"])
+    fc = json.loads(r.output)
+    assert fc["type"] == "FeatureCollection"
+    ids = sorted(f["id"] for f in fc["features"])
+    assert ids == [
+        "points:feature:3:ancestor",
+        "points:feature:3:ours",
+        "points:feature:3:theirs",
+    ]
+    by_id = {f["id"]: f for f in fc["features"]}
+    assert by_id["points:feature:3:ours"]["properties"]["name"] == "ours-3"
+    assert by_id["points:feature:3:ours"]["geometry"]["type"] == "Point"
+
+    r = runner.invoke(cli, ["conflicts", "--flat", "-o", "json"])
+    body = json.loads(r.output)["kart.conflicts/v1"]
+    assert body["points:feature:3:ours"]["name"] == "ours-3"
+
+    r = runner.invoke(cli, ["conflicts", "--exit-code"])
+    assert r.exit_code == 1
+
+    # filters: non-matching filter yields an empty hierarchy
+    r = runner.invoke(cli, ["conflicts", "points:feature:999", "-o", "json"])
+    assert json.loads(r.output)["kart.conflicts/v1"] == {}
+    r = runner.invoke(cli, ["conflicts", "points", "-o", "json"])
+    assert "3" in json.loads(r.output)["kart.conflicts/v1"]["points"]["feature"]
+
+    # --crs reprojects the version geometries (EPSG:3857 metres, not degrees)
+    r = runner.invoke(
+        cli, ["conflicts", "--crs", "EPSG:3857", "-o", "json"]
+    )
+    versions = json.loads(r.output)["kart.conflicts/v1"]["points"]["feature"]["3"]
+    from kart_tpu.geometry import Geometry
+
+    hexwkb = versions["ours"]["geom"]
+    geom = Geometry.from_hex_wkb(hexwkb)
+    x, _y = json.loads(json.dumps(geom.to_geojson()))["coordinates"][:2]
+    assert abs(x) > 1_000_000  # web-mercator metres
+
+
+def test_conflicts_text_full_listing_shape(repo_dir, runner):
+    """Full text listing follows the reference hierarchy: dataset, part,
+    pk, then coloured version blocks with 40-column field lines
+    (reference: tests/test_conflicts.py:test_list_conflicts)."""
+    make_conflict(runner, repo_dir)
+    runner.invoke(cli, ["merge", "alt"])
+    r = runner.invoke(cli, ["conflicts"])
+    lines = r.output.splitlines()
+    assert lines[0] == "points:"
+    assert lines[1] == "    points:feature:"
+    assert lines[2] == "        points:feature:3:"
+    assert lines[3] == "            points:feature:3:ancestor:"
+    assert any(line.endswith("name = ours-3") for line in lines)
+    assert any(line.endswith("name = theirs-3") for line in lines)
+    ours_ix = lines.index("            points:feature:3:ours:")
+    theirs_ix = lines.index("            points:feature:3:theirs:")
+    assert 3 < ours_ix < theirs_ix
+
+
+def test_conflicts_exit_code_respects_filters(repo_dir, runner):
+    """--exit-code / quiet answer 'are there conflicts MATCHING the
+    filter', not 'any conflicts anywhere' (review finding)."""
+    make_conflict(runner, repo_dir)
+    runner.invoke(cli, ["merge", "alt"])
+    r = runner.invoke(cli, ["conflicts", "points:feature:999", "--exit-code"])
+    assert r.exit_code == 0
+    r = runner.invoke(cli, ["conflicts", "points:feature:999", "-o", "quiet"])
+    assert r.exit_code == 0
+    r = runner.invoke(cli, ["conflicts", "points", "--exit-code"])
+    assert r.exit_code == 1
+
+
+def test_conflicts_invalid_crs_errors(repo_dir, runner):
+    make_conflict(runner, repo_dir)
+    runner.invoke(cli, ["merge", "alt"])
+    r = runner.invoke(cli, ["conflicts", "--crs", "EPSG:999999", "-o", "json"])
+    assert r.exit_code != 0
+
+
+def test_conflicts_flat_summarise(repo_dir, runner):
+    make_conflict(runner, repo_dir)
+    runner.invoke(cli, ["merge", "alt"])
+    r = runner.invoke(cli, ["conflicts", "--flat", "-s", "-o", "json"])
+    assert json.loads(r.output)["kart.conflicts/v1"] == ["points:feature:3"]
+    r = runner.invoke(cli, ["conflicts", "--flat", "-ss", "-o", "json"])
+    assert json.loads(r.output)["kart.conflicts/v1"] == 1
