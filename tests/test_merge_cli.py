@@ -369,3 +369,67 @@ def test_conflicts_flat_summarise(repo_dir, runner):
     assert json.loads(r.output)["kart.conflicts/v1"] == ["points:feature:3"]
     r = runner.invoke(cli, ["conflicts", "--flat", "-ss", "-o", "json"])
     assert json.loads(r.output)["kart.conflicts/v1"] == 1
+
+
+def test_resolve_each_way_reference_scenario(tmp_path, monkeypatch):
+    """Mirror of the reference's test_resolve_with_version: on its premade
+    conflicting polygons repo, resolve the 4 conflicts with ancestor / ours
+    / theirs / delete respectively and verify each outcome lands in the
+    merged tree (reference: tests/test_resolve.py:36-110)."""
+    from conftest import REF_DATA, extract_ref_archive
+
+    if not os.path.isdir(os.path.join(REF_DATA, "conflicts")):
+        pytest.skip("reference fixtures not available")
+    src = extract_ref_archive(tmp_path, "conflicts/polygons.tgz")
+    monkeypatch.chdir(src)
+    runner = CliRunner()
+    r = runner.invoke(cli, ["merge", "theirs_branch"])
+    assert r.exit_code == 0, r.output
+
+    # can't complete while conflicts remain
+    r = runner.invoke(cli, ["merge", "--continue"])
+    assert r.exit_code != 0
+
+    from kart_tpu.core.repo import KartRepo
+    from kart_tpu.merge.index import MergeIndex
+
+    repo = KartRepo(str(src))
+    mi = MergeIndex.read_from_repo(repo)
+    labels = sorted(mi.conflicts, key=lambda l: int(l.rsplit(":", 1)[1]))
+    assert len(labels) == 4
+    versions_by_label = {
+        label: {
+            name: getattr(mi.conflicts[label], name)
+            for name in ("ancestor", "ours", "theirs")
+        }
+        for label in labels
+    }
+    # 98001 is add/add (no ancestor): the reference resolves it to
+    # ancestor anyway — "that version doesn't exist" acts as delete
+    # (reference: test_resolve.py "resolved to ancestor, but the ancestor
+    # is None")
+    assert versions_by_label[labels[0]]["ancestor"] is None
+    resolutions = ["ancestor", "ours", "theirs", "delete"]
+    for i, (label, how) in enumerate(zip(labels, resolutions)):
+        r = runner.invoke(cli, ["resolve", label, f"--with={how}"])
+        assert r.exit_code == 0, (label, how, r.output)
+        remaining = MergeIndex.read_from_repo(repo)
+        assert len(remaining.resolves) == i + 1
+
+    r = runner.invoke(cli, ["merge", "--continue", "-m", "merged each way"])
+    assert r.exit_code == 0, r.output
+
+    ds = repo.structure("HEAD").datasets["nz_waca_adjustments"]
+    pks = [int(l.rsplit(":", 1)[1]) for l in labels]
+    # delete resolution: the feature is gone
+    import pytest as _pytest
+
+    from kart_tpu.core.odb import ObjectMissing
+
+    # ancestor-of-add/add and delete resolutions: the features are gone
+    for gone in (pks[0], pks[3]):
+        with _pytest.raises((KeyError, ObjectMissing, LookupError)):
+            ds.get_feature([gone])
+    # ours/theirs resolutions exist
+    for pk in (pks[1], pks[2]):
+        assert ds.get_feature([pk])["id"] == pk
