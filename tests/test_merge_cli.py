@@ -433,3 +433,58 @@ def test_resolve_each_way_reference_scenario(tmp_path, monkeypatch):
     # ours/theirs resolutions exist
     for pk in (pks[1], pks[2]):
         assert ds.get_feature([pk])["id"] == pk
+
+
+def test_resolve_with_file_multiple_features(tmp_path, monkeypatch):
+    """Mirror of the reference's test_resolve_with_file: an add/add
+    conflict resolved with a FeatureCollection carrying BOTH features
+    (theirs re-keyed to a fresh pk) — both land in the merged tree
+    (reference: tests/test_resolve.py:110-170)."""
+    from conftest import REF_DATA, extract_ref_archive
+
+    if not os.path.isdir(os.path.join(REF_DATA, "conflicts")):
+        pytest.skip("reference fixtures not available")
+    src = extract_ref_archive(tmp_path, "conflicts/polygons.tgz")
+    monkeypatch.chdir(src)
+    runner = CliRunner()
+
+    r = runner.invoke(cli, ["diff", "ancestor_branch..ours_branch", "-o", "geojson"])
+    assert r.exit_code == 0, r.output
+    ours_geojson = json.loads(r.output)["features"][0]
+    assert ours_geojson["id"] == "I::98001"
+    r = runner.invoke(cli, ["diff", "ancestor_branch..theirs_branch", "-o", "geojson"])
+    theirs_geojson = json.loads(r.output)["features"][0]
+    assert theirs_geojson["id"] == "I::98001"
+
+    r = runner.invoke(cli, ["merge", "theirs_branch"])
+    assert r.exit_code == 0, r.output
+
+    ours_geojson["id"] = "ours-feature"
+    theirs_geojson["id"] = "theirs-feature"
+    theirs_geojson["properties"]["id"] = 98002  # re-key: no longer conflicting
+    resolution = {"type": "FeatureCollection",
+                  "features": [ours_geojson, theirs_geojson]}
+    path = tmp_path / "resolution.geojson"
+    path.write_text(json.dumps(resolution))
+    r = runner.invoke(
+        cli,
+        ["resolve", "nz_waca_adjustments:feature:98001", "--with-file", str(path)],
+    )
+    assert r.exit_code == 0, r.output
+
+    from kart_tpu.core.repo import KartRepo
+    from kart_tpu.merge.index import MergeIndex
+
+    repo = KartRepo(str(src))
+    mi = MergeIndex.read_from_repo(repo)
+    assert len(mi.resolves["nz_waca_adjustments:feature:98001"]) == 2
+
+    for label in sorted(mi.conflicts):
+        if label not in mi.resolves:
+            r = runner.invoke(cli, ["resolve", label, "--with=ours"])
+            assert r.exit_code == 0, r.output
+    r = runner.invoke(cli, ["merge", "--continue", "-m", "done"])
+    assert r.exit_code == 0, r.output
+    ds = repo.structure("HEAD").datasets["nz_waca_adjustments"]
+    assert ds.get_feature([98001])["id"] == 98001
+    assert ds.get_feature([98002])["id"] == 98002
