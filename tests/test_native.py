@@ -230,3 +230,35 @@ class TestTreeDiffRaw:
 
             pytest.skip("native IO lib unavailable")
         assert native.tree_diff_raw(b"garbage without nul", b"") is None
+
+
+def test_bbox_f32_matches_numpy_reference():
+    """The new f32 sidecar-scan kernel agrees with the numpy reference on
+    random envelopes including antimeridian-wrapping ranges and queries."""
+    import numpy as np
+
+    from kart_tpu.native import bbox_intersects_f32, load
+    from kart_tpu.ops.bbox import bbox_intersects_np
+
+    rng = np.random.default_rng(11)
+    n = 40_000
+    env = np.empty((n, 4), dtype=np.float32)
+    env[:, 0] = rng.uniform(-180, 180, n)  # w
+    env[:, 1] = rng.uniform(-90, 89, n)    # s
+    width = rng.uniform(0, 30, n)
+    env[:, 2] = env[:, 0] + width          # e (some wrap past 180)
+    env[(env[:, 2] > 180), 2] -= 360.0     # wrapping ranges: e < w
+    env[:, 3] = np.minimum(env[:, 1] + rng.uniform(0, 20, n), 90)
+
+    queries = [
+        (-40.0, -20.0, -4.0, -3.0),
+        (170.0, -50.0, -170.0, 10.0),   # query wraps the antimeridian
+        (-180.0, -90.0, 180.0, 90.0),   # whole world
+        (12.25, 47.5, 12.26, 47.51),    # tiny box
+    ]
+    for q in queries:
+        got = bbox_intersects_f32(env, q)
+        want = bbox_intersects_np(env.astype(np.float64), np.asarray(q))
+        np.testing.assert_array_equal(got, want, err_msg=str(q))
+    if load() is None:
+        pytest.skip("native lib absent: exercised the fallback only")
