@@ -506,11 +506,17 @@ def _merge_bench():
         mi = MergeIndex("0" * 40, conflicts)
         fd, idx_path = tempfile.mkstemp(prefix="kart-bench-kmix")
         try:
-            t0 = time.perf_counter()
-            with os.fdopen(fd, "wb") as f:
-                for chunk in mi._binary_chunks():
-                    f.write(chunk)
-            index_write_s = time.perf_counter() - t0
+            # min of 2: serialisation cost, not transient disk-cache noise
+            times = []
+            for attempt in range(2):
+                t0 = time.perf_counter()
+                with (
+                    os.fdopen(fd, "wb") if attempt == 0 else open(idx_path, "wb")
+                ) as f:
+                    for chunk in mi._binary_chunks():
+                        f.write(chunk)
+                times.append(time.perf_counter() - t0)
+            index_write_s = min(times)
             t0 = time.perf_counter()
             with open(idx_path, "rb") as f:
                 MergeIndex._from_binary(f.read())

@@ -37,6 +37,9 @@ _PATH_REF_SENTINEL = 0xFFFFFFFFFFFFFFFF
 # the reader rebuilds the same lazy column, nothing materialises until a
 # path is touched
 _PATH_DERIVED_SENTINEL = 0xFFFFFFFFFFFFFFFE
+# same idea for the label column: "<ds>:feature:<pk>" is derivable from
+# {ds_path} + the pk array
+_LABEL_DERIVED_SENTINEL = 0xFFFFFFFFFFFFFFFD
 
 
 class AncestorOursTheirs:
@@ -498,16 +501,22 @@ class MergeIndex:
             }
         ).encode()
 
-        label_jb = getattr(labels, "joined_bytes", None)
-        label_bytes = label_jb() if label_jb is not None else None
-        if label_bytes is None:
-            label_bytes = "\x00".join(_materialise_col(labels)).encode()
-
         yield _BINARY_MAGIC
         yield struct.pack("<I", len(header))
         yield header
-        yield struct.pack("<Q", len(label_bytes))
-        yield label_bytes
+        if isinstance(labels, PkLabels):
+            spec = json.dumps({"ds_path": labels.ds_path}).encode()
+            keys = np.ascontiguousarray(labels.keys, dtype="<i8")
+            payload = struct.pack("<I", len(spec)) + spec + keys.tobytes()
+            yield struct.pack("<QQ", _LABEL_DERIVED_SENTINEL, len(payload))
+            yield payload
+        else:
+            label_jb = getattr(labels, "joined_bytes", None)
+            label_bytes = label_jb() if label_jb is not None else None
+            if label_bytes is None:
+                label_bytes = "\x00".join(_materialise_col(labels)).encode()
+            yield struct.pack("<Q", len(label_bytes))
+            yield label_bytes
         # versions routinely share one path column (a tree conflict keeps the
         # same feature path in ancestor/ours/theirs) — encode AND write those
         # bytes once, later versions reference the earlier block (~1/3 the
@@ -570,17 +579,29 @@ class MergeIndex:
                 (ref,) = struct.unpack_from("<Q", raw, pos)
                 pos += 8
                 return ref  # back-reference to version `ref`'s path block
-            if v2 and blen == _PATH_DERIVED_SENTINEL:
+            if v2 and blen in (_PATH_DERIVED_SENTINEL, _LABEL_DERIVED_SENTINEL):
                 (plen,) = struct.unpack_from("<Q", raw, pos)
                 pos += 8
                 payload = raw[pos : pos + plen]
                 pos += plen
-                return ("derived", payload)
+                kind = "derived" if blen == _PATH_DERIVED_SENTINEL else "labels"
+                return (kind, payload)
             data = raw[pos : pos + blen]
             pos += blen
             return data
 
-        labels = JoinedStrs(block(), n)
+        label_block = block()
+        if isinstance(label_block, tuple):
+            (slen,) = struct.unpack_from("<I", label_block[1], 0)
+            spec = json.loads(label_block[1][4 : 4 + slen].decode())
+            keys = np.frombuffer(label_block[1][4 + slen :], dtype="<i8")
+            if len(keys) != n:
+                raise ValueError(
+                    f"Corrupt derived label block: {len(keys)} pks for {n}"
+                )
+            labels = PkLabels(spec["ds_path"], keys)
+        else:
+            labels = JoinedStrs(label_block, n)
         versions = []
         for _ in VERSION_NAMES:
             present = np.frombuffer(block(), dtype=np.uint8)
