@@ -710,10 +710,15 @@ def _cli_polygon_diff():
         r = runner.invoke(cli, args)
         assert r.exit_code == 0, r.output
         cold_s = time.perf_counter() - t0
-        t0 = time.perf_counter()
-        r = runner.invoke(cli, args)
-        assert r.exit_code == 0, r.output
-        warm_s = time.perf_counter() - t0
+        # min of 2 warm runs: the section runs late in the bench and a
+        # single warm sample inherits cache pressure from earlier sections
+        warm_times = []
+        for _ in range(2):
+            t0 = time.perf_counter()
+            r = runner.invoke(cli, args)
+            assert r.exit_code == 0, r.output
+            warm_times.append(time.perf_counter() - t0)
+        warm_s = min(warm_times)
         # updates materialise old + new values
         n_materialised = 2 * info["n_edits"]
         with open(sink) as f:
