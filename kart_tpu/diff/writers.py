@@ -701,7 +701,12 @@ class QuietDiffWriter(BaseDiffWriter):
     """No output; has_changes drives the exit code."""
 
     def write_ds_diff(self, ds_path, ds_diff):
-        pass
+        if self._ds_spatial_filter(ds_path) is not None:
+            # the filtered exit code needs a real answer: stream until the
+            # first matching delta flips has_changes (meta changes were
+            # already counted by _mark_ds_changes)
+            if not self.has_changes:
+                next(self.iter_deltas(ds_diff, ds_path), None)
 
 
 class FeatureCountDiffWriter(BaseDiffWriter):

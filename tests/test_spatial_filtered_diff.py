@@ -234,3 +234,28 @@ class TestEnvelopePrefilter:
         finally:
             for key in spec.config_items():
                 repo.del_config(key)
+
+
+def test_quiet_writer_exit_code_with_filter(tmp_path):
+    """-o quiet must answer for the FILTERED diff: in-filter change ->
+    has_changes, out-of-filter-only change -> none."""
+    import io
+
+    from kart_tpu.diff.writers import QuietDiffWriter
+
+    repo, ds_path = make_imported_repo(tmp_path, n=10)
+    edit_commit(
+        repo, ds_path,
+        updates=[{**repo.datasets()[ds_path].get_feature([8]), "name": "x"}],
+        message="out-of-filter",
+    )
+    set_filter(repo, FILTER_W5)
+    w = QuietDiffWriter(repo, "HEAD^...HEAD", output_path=io.StringIO())
+    assert w.write_diff() is False
+    edit_commit(
+        repo, ds_path,
+        updates=[{**repo.datasets()[ds_path].get_feature([2]), "name": "y"}],
+        message="in-filter",
+    )
+    w = QuietDiffWriter(repo, "HEAD^...HEAD", output_path=io.StringIO())
+    assert w.write_diff() is True
