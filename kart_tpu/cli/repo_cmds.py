@@ -68,6 +68,19 @@ def init(ctx, directory, import_from, bare, wc_location, initial_branch, message
 )
 @click.option("--no-checkout", is_flag=True, help="Don't update the working copy")
 @click.option(
+    "--all-tables", "-a", is_flag=True,
+    help="Import all tables from the source (the default when no --table "
+         "is given; accepted for reference-CLI compatibility)",
+)
+@click.option(
+    "--list", "do_list", is_flag=True,
+    help="List the tables present in the source and exit",
+)
+@click.option(
+    "-o", "--output-format", type=click.Choice(["text", "json"]),
+    default="text", help="Output format for --list",
+)
+@click.option(
     "--crs",
     "crs_override",
     help=(
@@ -79,11 +92,33 @@ def init(ctx, directory, import_from, bare, wc_location, initial_branch, message
 @click.pass_obj
 def import_(
     ctx, sources, message, table, dest_path, replace_existing, replace_ids,
-    no_checkout, crs_override,
+    no_checkout, all_tables, do_list, output_format, crs_override,
 ):
     """Import data into the repository as new dataset(s)."""
     from kart_tpu.importer import ImportSource
     from kart_tpu.importer.importer import import_sources
+
+    if do_list:
+        if table or all_tables:
+            raise CliError("--list cannot be combined with --table/--all-tables")
+        body = {}
+        for spec in sources:
+            for src in ImportSource.open(spec):
+                try:
+                    title = src.meta_items().get("title")
+                except Exception:
+                    title = None
+                body[src.dest_path] = title or ""
+        if output_format == "json":
+            from kart_tpu.diff.output import dump_json_output
+
+            dump_json_output({"kart.tables/v1": body}, "-")
+        else:
+            for name, title in sorted(body.items()):
+                click.echo(f"{name} - {title}" if title else name)
+        return
+    if all_tables and table:
+        raise CliError("--all-tables cannot be combined with --table")
 
     repo = ctx.repo
     ids = None

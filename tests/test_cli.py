@@ -866,3 +866,28 @@ def test_text_diff_byte_parity_with_reference(tmp_path, runner, monkeypatch):
     assert by_id["U+::2"]["properties"]["t50_fid"] is None
     assert by_id["U-::1"]["properties"]["fid"] == 1
     assert by_id["U+::9998"]["properties"]["fid"] == 9998
+
+
+def test_import_list_and_all_tables(tmp_path, runner):
+    """`kart import --list` enumerates source tables (text + json shapes);
+    -a/--all-tables is accepted and mutually exclusive with --table
+    (reference: kart/init.py --list/--all-tables options)."""
+    from helpers import create_points_gpkg
+
+    gpkg = create_points_gpkg(str(tmp_path / "pts.gpkg"), n=3)
+    r = runner.invoke(cli, ["init", str(tmp_path / "repo")])
+    assert r.exit_code == 0, r.output
+    args = ["-C", str(tmp_path / "repo")]
+    r = runner.invoke(cli, [*args, "import", "--list", gpkg])
+    assert r.exit_code == 0 and r.output.strip() == "points - points title"
+    r = runner.invoke(cli, [*args, "import", "--list", "-o", "json", gpkg])
+    body = json.loads(r.output)
+    assert body == {"kart.tables/v1": {"points": "points title"}}
+    r = runner.invoke(cli, [*args, "import", "--list", "-t", "points", gpkg])
+    assert r.exit_code != 0
+    r = runner.invoke(cli, [*args, "import", "-a", "-t", "points", gpkg])
+    assert r.exit_code != 0
+    r = runner.invoke(cli, [*args, "import", "-a", gpkg, "--no-checkout"])
+    assert r.exit_code == 0, r.output
+    r = runner.invoke(cli, [*args, "data", "ls"])
+    assert "points" in r.output
