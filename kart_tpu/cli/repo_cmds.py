@@ -271,23 +271,49 @@ def status(ctx, output_format):
             changes[ds_path] = counts
 
     if output_format == "json":
-        output = {
-            "kart.status/v2": {
-                "commit": head,
-                "abbrevCommit": head[:7] if head else None,
-                "branch": branch.rsplit("/", 1)[-1] if branch else None,
-                "upstream": None,
-                "state": state,
-                "spatialFilter": repo.spatial_filter_spec(),
-                "workingCopy": {
-                    "path": str(wc) if wc else None,
-                    "changes": changes or None,
-                }
-                if wc
-                else None,
-            }
+        body = {
+            "commit": head,
+            "abbrevCommit": head[:7] if head else None,
+            "branch": branch.rsplit("/", 1)[-1] if branch else None,
+            "upstream": None,
+            "state": state,
+            "spatialFilter": repo.spatial_filter_spec(),
         }
-        dump_json_output(output, "-")
+        if state == KartRepoState.MERGING:
+            # reference shape: merging context + summarise=2 conflict
+            # counts (kart/status.py:33-39)
+            from kart_tpu.cli.merge_cmds import _conflict_summary
+            from kart_tpu.merge.index import MergeIndex
+
+            mi = MergeIndex.read_from_repo(repo)
+            merge_head = (repo.read_gitdir_file("MERGE_HEAD") or "").strip()
+            merge_branch = (repo.read_gitdir_file("MERGE_BRANCH") or "").strip()
+            body["merging"] = {
+                "ancestor": None,
+                "ours": {
+                    "branch": branch.rsplit("/", 1)[-1] if branch else None,
+                    "commit": head,
+                    "abbrevCommit": head[:7] if head else None,
+                },
+                "theirs": {
+                    "branch": merge_branch or None,
+                    "commit": merge_head or None,
+                    "abbrevCommit": merge_head[:7] if merge_head else None,
+                },
+            }
+            body["conflicts"] = _conflict_summary(
+                {
+                    label: aot
+                    for label, aot in mi.conflicts.items()
+                    if label not in mi.resolves
+                }
+            )
+        else:
+            body["workingCopy"] = (
+                {"path": str(wc), "changes": changes or None} if wc else None
+            )
+        # the reference 0.10.x envelope (scripts parse this key)
+        dump_json_output({"kart.status/v1": body}, "-")
         return
 
     if branch:

@@ -74,7 +74,7 @@ def test_status_clean(repo_dir, runner):
     r = runner.invoke(cli, ["status"])
     assert "Nothing to commit, working copy clean" in r.output
     r = runner.invoke(cli, ["status", "-o", "json"])
-    payload = json.loads(r.output)["kart.status/v2"]
+    payload = json.loads(r.output)["kart.status/v1"]
     assert payload["branch"] == "main"
     assert payload["workingCopy"]["changes"] is None
 

@@ -488,3 +488,19 @@ def test_resolve_with_file_multiple_features(tmp_path, monkeypatch):
     ds = repo.structure("HEAD").datasets["nz_waca_adjustments"]
     assert ds.get_feature([98001])["id"] == 98001
     assert ds.get_feature([98002])["id"] == 98002
+
+
+def test_status_json_during_merge(repo_dir, runner):
+    """`kart status -o json` in merging state carries the reference's
+    merging context + summarise-2 conflict counts under kart.status/v1
+    (reference: kart/status.py:33-44)."""
+    make_conflict(runner, repo_dir)
+    r = runner.invoke(cli, ["merge", "alt"])
+    assert r.exit_code == 0
+    r = runner.invoke(cli, ["status", "-o", "json"])
+    assert r.exit_code == 0, r.output
+    body = json.loads(r.output)["kart.status/v1"]
+    assert body["state"] == "merging"
+    assert body["conflicts"] == {"points": {"feature": 1}}
+    assert body["merging"]["theirs"]["branch"] == "alt"
+    assert body["merging"]["ours"]["branch"] == "main"
