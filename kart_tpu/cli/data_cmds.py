@@ -31,13 +31,15 @@ def data_ls(ctx, output_format, with_dataset_types, refish):
         paths = [ds.path for ds in datasets]
     if output_format == "json":
         if with_dataset_types:
+            # dataset-type annotations arrived with the v2 envelope
             value = [
                 {"path": ds.path, "type": "table", "version": ds.VERSION}
                 for ds in datasets
             ]
+            dump_json_output({"kart.data.ls/v2": value}, "-")
         else:
-            value = paths
-        dump_json_output({"kart.data.ls/v2": value}, "-")
+            # reference 0.10.x shape: a plain path list under v1
+            dump_json_output({"kart.data.ls/v1": paths}, "-")
         return
     if not paths:
         click.echo("Empty repository.", err=True)

@@ -210,9 +210,13 @@ def _commit_message_from_editor(repo_diff):
 @click.option(
     "--allow-empty", is_flag=True, help="Allow a commit with no changes"
 )
+@click.option(
+    "-o", "--output-format", type=click.Choice(["text", "json"]),
+    default="text",
+)
 @click.argument("filters", nargs=-1)
 @click.pass_obj
-def commit(ctx, message, allow_empty, filters):
+def commit(ctx, message, allow_empty, output_format, filters):
     """Record changes from the working copy to the repository."""
     repo = ctx.require_state(KartRepoState.NORMAL)
     wc = repo.working_copy
@@ -240,6 +244,35 @@ def commit(ctx, message, allow_empty, filters):
     commit_obj = repo.odb.read_commit(new_commit)
     branch = repo.head_branch
     branch_name = branch.rsplit("/", 1)[-1] if branch else "HEAD"
+    if output_format == "json":
+        # reference envelope (kart/commit.py:263-281)
+        from datetime import datetime, timedelta, timezone
+
+        author = commit_obj.author
+        when = datetime.fromtimestamp(author.time, timezone.utc)
+        off = commit_obj.committer.offset
+        changes = {
+            ds_path: ds_diff.type_counts()
+            for ds_path, ds_diff in repo_diff.items()
+        }
+        dump_json_output(
+            {
+                "kart.commit/v1": {
+                    "commit": new_commit,
+                    "abbrevCommit": new_commit[:7],
+                    "author": author.email,
+                    "committer": commit_obj.committer.email,
+                    "branch": branch_name,
+                    "message": commit_obj.message,
+                    "changes": changes,
+                    "commitTime": when.strftime("%Y-%m-%dT%H:%M:%SZ"),
+                    "commitTimeOffset": f"{'+' if off >= 0 else '-'}"
+                    f"{abs(off) // 60:02d}:{abs(off) % 60:02d}",
+                }
+            },
+            "-",
+        )
+        return
     click.echo(
         f"[{branch_name} {new_commit[:7]}] {commit_obj.message_summary}"
     )

@@ -53,7 +53,7 @@ def test_data_ls(repo_dir, runner):
     assert r.exit_code == 0
     assert r.output.strip() == "points"
     r = runner.invoke(cli, ["data", "ls", "-o", "json"])
-    assert json.loads(r.output)["kart.data.ls/v2"] == ["points"]
+    assert json.loads(r.output)["kart.data.ls/v1"] == ["points"]
 
 
 def test_data_version(repo_dir, runner):
@@ -891,3 +891,33 @@ def test_import_list_and_all_tables(tmp_path, runner):
     assert r.exit_code == 0, r.output
     r = runner.invoke(cli, [*args, "data", "ls"])
     assert "points" in r.output
+
+
+def test_commit_json_output(tmp_path, runner):
+    """`kart commit -o json` emits the reference kart.commit/v1 envelope
+    (reference: kart/commit.py:263-281)."""
+    import sqlite3
+
+    from helpers import create_points_gpkg
+    from kart_tpu.workingcopy.gpkg import _register_gpkg_functions
+
+    gpkg = create_points_gpkg(str(tmp_path / "pts.gpkg"), n=5)
+    r = runner.invoke(cli, ["init", str(tmp_path / "repo")])
+    assert r.exit_code == 0, r.output
+    args = ["-C", str(tmp_path / "repo")]
+    r = runner.invoke(cli, [*args, "import", gpkg])
+    assert r.exit_code == 0, r.output
+    wc = next(p for p in os.listdir(tmp_path / "repo") if p.endswith(".gpkg"))
+    con = sqlite3.connect(tmp_path / "repo" / wc)
+    _register_gpkg_functions(con)
+    con.execute("UPDATE points SET name='edited' WHERE fid=2")
+    con.commit()
+    con.close()
+    r = runner.invoke(cli, [*args, "commit", "-m", "json commit", "-o", "json"])
+    assert r.exit_code == 0, r.output
+    body = json.loads(r.output)["kart.commit/v1"]
+    assert body["branch"] == "main"
+    assert body["message"].startswith("json commit")
+    assert body["abbrevCommit"] == body["commit"][:7]
+    assert body["changes"]["points"]["feature"] == {"updates": 1}
+    assert body["commitTime"].endswith("Z")
