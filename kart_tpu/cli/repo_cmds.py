@@ -81,6 +81,10 @@ def init(ctx, directory, import_from, bare, wc_location, initial_branch, message
     default="text", help="Output format for --list",
 )
 @click.option(
+    "--primary-key",
+    help="Use this (existing, unique) column as the primary key",
+)
+@click.option(
     "--crs",
     "crs_override",
     help=(
@@ -92,7 +96,8 @@ def init(ctx, directory, import_from, bare, wc_location, initial_branch, message
 @click.pass_obj
 def import_(
     ctx, sources, message, table, dest_path, replace_existing, replace_ids,
-    no_checkout, all_tables, do_list, output_format, crs_override,
+    no_checkout, all_tables, do_list, output_format, primary_key,
+    crs_override,
 ):
     """Import data into the repository as new dataset(s)."""
     from kart_tpu.importer import ImportSource
@@ -157,6 +162,12 @@ def import_(
                         f"carries its own CRS definition"
                     )
         all_sources.extend(opened)
+    if primary_key:
+        # ImportSourceError propagates: the entrypoint maps it to the
+        # documented NO_IMPORT_SOURCE exit code like every other source error
+        all_sources = [
+            src.with_primary_key(primary_key) for src in all_sources
+        ]
     if dest_path:
         if len(all_sources) != 1:
             raise CliError("--dest-path requires a single table import")

@@ -75,6 +75,46 @@ class ImportSource:
         """{identifier: wkt}"""
         return {}
 
+    def with_primary_key(self, pk_name):
+        """This source with ``pk_name`` as the primary key instead of its
+        natural/synthesized one (`kart import --primary-key`; reference:
+        kart/init.py:166-169 + sqlalchemy_import_source.py). The named
+        column must exist; the previous pk column stays as ordinary data."""
+        cols = list(self.schema.columns)
+        if pk_name not in {c.name for c in cols}:
+            raise ImportSourceError(
+                f"--primary-key: no column named {pk_name!r} in "
+                f"{self.dest_path!r} (columns: "
+                f"{', '.join(c.name for c in cols)})"
+            )
+        if [c.name for c in self.schema.pk_columns] == [pk_name]:
+            # already the pk: keep the native source (and its fast paths)
+            return self
+
+        def extra_for(c):
+            extra = dict(c.extra_type_info or {})
+            if c.name == pk_name and c.data_type == "integer":
+                # pk integers are stored as size 64 everywhere (the GPKG
+                # WC roundtrips them as INTEGER PRIMARY KEY) — match the
+                # natural pk-producing paths or checkout shows a permanent
+                # spurious schema diff
+                extra["size"] = 64
+            return extra
+
+        new_cols = [
+            ColumnSchema(
+                c.id,
+                c.name,
+                c.data_type,
+                0 if c.name == pk_name else None,
+                extra_for(c),
+            )
+            for c in cols
+        ]
+        # pk first, like every natural source emits
+        new_cols.sort(key=lambda c: (c.pk_index is None, ))
+        return _PrimaryKeyOverrideSource(self, Schema(new_cols))
+
     @classmethod
     def open(cls, spec, table=None):
         """Sniff a path/spec -> list of ImportSource (one per table)
@@ -115,6 +155,36 @@ class ImportSource:
             f".zip (shapefile), .fgb, .geojson, .geojsonl/.ndjson, .csv, "
             f"postgresql://, mysql://, mssql://"
         )
+
+
+class _PrimaryKeyOverrideSource(ImportSource):
+    """Delegating wrapper produced by :meth:`ImportSource.with_primary_key`:
+    identical feature stream, re-keyed schema."""
+
+    def __init__(self, inner, schema):
+        self.inner = inner
+        self._schema = schema
+        self.dest_path = inner.dest_path
+
+    @property
+    def schema(self) -> Schema:
+        return self._schema
+
+    def features(self):
+        return self.inner.features()
+
+    @property
+    def feature_count(self):
+        return self.inner.feature_count
+
+    def meta_items(self):
+        return self.inner.meta_items()
+
+    def post_import_meta_items(self):
+        return self.inner.post_import_meta_items()
+
+    def crs_definitions(self):
+        return self.inner.crs_definitions()
 
 
 def _open_zipped_shapefile(spec):

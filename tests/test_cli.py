@@ -921,3 +921,34 @@ def test_commit_json_output(tmp_path, runner):
     assert body["abbrevCommit"] == body["commit"][:7]
     assert body["changes"]["points"]["feature"] == {"updates": 1}
     assert body["commitTime"].endswith("Z")
+
+
+def test_import_primary_key_override(tmp_path, runner):
+    """--primary-key re-keys the imported dataset on an existing column
+    (reference: kart/init.py --primary-key)."""
+    from helpers import create_attributes_gpkg
+
+    gpkg = create_attributes_gpkg(str(tmp_path / "r.gpkg"))
+    r = runner.invoke(cli, ["init", str(tmp_path / "repo")])
+    assert r.exit_code == 0, r.output
+    args = ["-C", str(tmp_path / "repo")]
+    r = runner.invoke(
+        cli, [*args, "import", gpkg, "--primary-key", "code", "--no-checkout"]
+    )
+    assert r.exit_code == 0, r.output
+    from kart_tpu.core.repo import KartRepo
+
+    repo = KartRepo(str(tmp_path / "repo"))
+    ds = repo.structure("HEAD").datasets["records"]
+    pk_cols = [c.name for c in ds.schema.pk_columns]
+    assert pk_cols == ["code"]
+    f = ds.get_feature(["C002"])
+    assert f["code"] == "C002" and f["amount"] == 200
+
+    r = runner.invoke(
+        cli, [*args, "import", gpkg, "--primary-key", "nope", "--no-checkout"]
+    )
+    # ImportSourceError propagates so the entrypoint maps it to the
+    # documented NO_IMPORT_SOURCE exit code (CliRunner surfaces it raw)
+    assert r.exit_code != 0
+    assert "no column named" in str(r.exception)
