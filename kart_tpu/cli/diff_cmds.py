@@ -434,19 +434,35 @@ def log(
             click.echo()
 
 
-def _commit_json(oid, commit):
-    from datetime import datetime, timedelta, timezone
+def _iso_utc(ts):
+    from datetime import datetime, timezone
 
-    tz = timezone(timedelta(minutes=commit.author.offset))
-    when = datetime.fromtimestamp(commit.author.time, timezone.utc).astimezone(tz)
+    return datetime.fromtimestamp(ts, timezone.utc).strftime("%Y-%m-%dT%H:%M:%SZ")
+
+
+def _iso_tz(minutes):
+    sign = "+" if minutes >= 0 else "-"
+    return f"{sign}{abs(minutes) // 60:02d}:{abs(minutes) % 60:02d}"
+
+
+def _commit_json(oid, commit):
+    """The reference's commit json shape (kart/log.py:408-445): UTC times
+    with the zone carried separately."""
+    author = commit.author
+    committer = commit.committer
     return {
         "commit": oid,
         "abbrevCommit": oid[:7],
         "message": commit.message,
         "refs": [],
-        "authorName": commit.author.name,
-        "authorEmail": commit.author.email,
-        "authorTime": when.strftime("%Y-%m-%dT%H:%M:%S%z"),
+        "authorName": author.name,
+        "authorEmail": author.email,
+        "authorTime": _iso_utc(author.time),
+        "authorTimeOffset": _iso_tz(author.offset),
+        "committerEmail": committer.email,
+        "committerName": committer.name,
+        "commitTime": _iso_utc(committer.time),
+        "commitTimeOffset": _iso_tz(committer.offset),
         "parents": list(commit.parents),
         "abbrevParents": [p[:7] for p in commit.parents],
     }
