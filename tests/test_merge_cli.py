@@ -266,6 +266,22 @@ def test_reference_conflicts_scenarios(
     assert sbody == {layer: {"feature": sorted(feats, key=lambda k: int(k))}}
     r = runner.invoke(cli, ["conflicts", "-ss", "-o", "json"])
     assert json.loads(r.output)["kart.conflicts/v1"] == {layer: {"feature": 4}}
+    # ... and the -s / -ss TEXT renderings are byte-exact vs the reference's
+    # expected output (tests/test_conflicts.py:test_summarise_conflicts)
+    r = runner.invoke(cli, ["conflicts", "-s"])
+    pks_sorted = sorted(feats, key=lambda k: int(k))
+    assert r.output.splitlines() == [
+        f"{layer}:",
+        f"    {layer}:feature:",
+        *[f"        {layer}:feature:{pk}" for pk in pks_sorted],
+        "",
+    ], r.output
+    r = runner.invoke(cli, ["conflicts", "-ss"])
+    assert r.output.splitlines() == [
+        f"{layer}:",
+        f"    {layer}:feature: 4 conflicts",
+        "",
+    ], r.output
 
     labels = [f"{layer}:feature:{pk}" for pk in feats]
     for label in labels:
