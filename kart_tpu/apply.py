@@ -31,8 +31,10 @@ def _pk_of(feature_json, schema):
     return pks[0] if len(pks) == 1 else pks
 
 
-def parse_patch(repo, patch_json):
-    """-> (RepoDiff, header dict)."""
+def parse_patch(repo, patch_json, ref="HEAD"):
+    """-> (RepoDiff, header dict). ref: revision the patch is parsed
+    against (minimal-patch `*` deltas resolve old values from here when
+    the patch carries no base)."""
     try:
         diff_json = patch_json["kart.diff/v1+hexwkb"]
     except KeyError:
@@ -47,7 +49,7 @@ def parse_patch(repo, patch_json):
         except NotFound:
             base_rs = None
 
-    head_rs = repo.structure("HEAD") if not repo.head_is_unborn else None
+    head_rs = repo.structure(ref) if not repo.head_is_unborn else None
     repo_diff = RepoDiff()
     for ds_path, ds_json in diff_json.items():
         ds_diff = DatasetDiff()
@@ -119,11 +121,23 @@ def parse_patch(repo, patch_json):
     return repo_diff, header
 
 
-def apply_patch(repo, patch_json, *, no_commit=False, allow_empty=False):
-    """-> new commit oid (or None with no_commit)."""
-    repo_diff, header = parse_patch(repo, patch_json)
-    head_rs = repo.structure("HEAD")
-    wc = repo.working_copy
+def apply_patch(repo, patch_json, *, no_commit=False, allow_empty=False,
+                ref="HEAD"):
+    """-> new commit oid (or None with no_commit). ref: which ref the patch
+    commit lands on (reference: kart/apply.py --ref; HEAD also updates the
+    working copy, any other ref leaves it untouched)."""
+    if ref != "HEAD":
+        if no_commit:
+            raise InvalidOperation("--no-commit and --ref are incompatible")
+        if not ref.startswith("refs/"):
+            ref = f"refs/heads/{ref}"
+        if not repo.refs.exists(ref):
+            from kart_tpu.core.repo import NotFound
+
+            raise NotFound(f"No such ref: {ref}")
+    repo_diff, header = parse_patch(repo, patch_json, ref=ref)
+    head_rs = repo.structure(ref)
+    wc = repo.working_copy if ref == "HEAD" else None
     if wc is not None:
         wc.assert_db_tree_match(head_rs.tree_oid)
 
@@ -174,7 +188,7 @@ def apply_patch(repo, patch_json, *, no_commit=False, allow_empty=False):
             )
     message = header.get("message") or "Apply patch"
     commit_oid = head_rs.commit_diff(
-        repo_diff, message, allow_empty=allow_empty, author=author
+        repo_diff, message, allow_empty=allow_empty, author=author, ref=ref
     )
     if wc is not None:
         new_tree = repo.odb.read_commit(commit_oid).tree

@@ -536,15 +536,18 @@ def create_patch(ctx, json_style, patch_type, output_path, refish):
 @cli.command("apply")
 @click.option("--no-commit", is_flag=True, help="Apply to the working copy only")
 @click.option("--allow-empty", is_flag=True)
+@click.option("--ref", default="HEAD",
+              help="Which ref to apply the patch onto (reference: kart/apply.py)")
 @click.argument("patch_file", type=click.File("r"))
 @click.pass_obj
-def apply_(ctx, no_commit, allow_empty, patch_file):
+def apply_(ctx, no_commit, allow_empty, ref, patch_file):
     """Apply a JSON patch (as written by create-patch)."""
     from kart_tpu.apply import apply_patch
 
     repo = ctx.repo
     commit_oid = apply_patch(
-        repo, json.load(patch_file), no_commit=no_commit, allow_empty=allow_empty
+        repo, json.load(patch_file), no_commit=no_commit,
+        allow_empty=allow_empty, ref=ref,
     )
     if commit_oid:
         click.echo(f"Commit {commit_oid[:7]}")

@@ -952,3 +952,58 @@ def test_import_primary_key_override(tmp_path, runner):
     # documented NO_IMPORT_SOURCE exit code (CliRunner surfaces it raw)
     assert r.exit_code != 0
     assert "no column named" in str(r.exception)
+
+
+def test_apply_ref_option(tmp_path, runner):
+    """`kart apply --ref` lands the patch commit on another branch, leaving
+    HEAD and the working copy untouched (reference: kart/apply.py --ref)."""
+    from helpers import create_points_gpkg
+
+    gpkg = create_points_gpkg(str(tmp_path / "pts.gpkg"), n=5)
+    r = runner.invoke(cli, ["init", str(tmp_path / "repo")])
+    assert r.exit_code == 0, r.output
+    args = ["-C", str(tmp_path / "repo")]
+    r = runner.invoke(cli, [*args, "import", gpkg, "--no-checkout"])
+    assert r.exit_code == 0, r.output
+    r = runner.invoke(cli, [*args, "branch", "side"])
+    assert r.exit_code == 0, r.output
+
+    patch = {
+        "kart.diff/v1+hexwkb": {
+            "points": {
+                "feature": [
+                    {"-": None, "+": None}  # placeholder replaced below
+                ]
+            }
+        },
+        "kart.patch/v1": {"message": "patched on side", "base": None},
+    }
+    # a real update delta for fid 2
+    from kart_tpu.core.repo import KartRepo
+
+    repo = KartRepo(str(tmp_path / "repo"))
+    ds = repo.structure("HEAD").datasets["points"]
+    old = ds.get_feature([2])
+    new = dict(old)
+    new["name"] = "patched"
+    to_json = lambda f: {
+        k: (v.to_hex_wkb() if hasattr(v, "to_hex_wkb") else v)
+        for k, v in f.items()
+    }
+    patch["kart.diff/v1+hexwkb"]["points"]["feature"] = [
+        {"-": to_json(old), "+": to_json(new)}
+    ]
+    pfile = tmp_path / "p.json"
+    pfile.write_text(json.dumps(patch))
+    head_before = repo.head_commit_oid
+    r = runner.invoke(cli, [*args, "apply", "--ref", "side", str(pfile)])
+    assert r.exit_code == 0, r.output
+    repo = KartRepo(str(tmp_path / "repo"))
+    assert repo.head_commit_oid == head_before  # HEAD untouched
+    side_ds = repo.structure("refs/heads/side").datasets["points"]
+    assert side_ds.get_feature([2])["name"] == "patched"
+    # --ref + --no-commit refuse
+    r = runner.invoke(
+        cli, [*args, "apply", "--ref", "side", "--no-commit", str(pfile)]
+    )
+    assert r.exit_code != 0
