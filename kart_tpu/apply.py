@@ -131,10 +131,16 @@ def apply_patch(repo, patch_json, *, no_commit=False, allow_empty=False,
             raise InvalidOperation("--no-commit and --ref are incompatible")
         if not ref.startswith("refs/"):
             ref = f"refs/heads/{ref}"
+        if not ref.startswith("refs/heads/"):
+            # only branches may move (a tag/remote ref must never be
+            # silently rewritten; same restriction as the reference)
+            raise InvalidOperation(f"--ref must name a branch, not {ref!r}")
         if not repo.refs.exists(ref):
-            from kart_tpu.core.repo import NotFound
-
             raise NotFound(f"No such ref: {ref}")
+        if ref == repo.refs.head_branch():
+            # the named branch IS the checked-out one: take the HEAD path so
+            # the working copy rolls forward with it instead of desyncing
+            ref = "HEAD"
     repo_diff, header = parse_patch(repo, patch_json, ref=ref)
     head_rs = repo.structure(ref)
     wc = repo.working_copy if ref == "HEAD" else None

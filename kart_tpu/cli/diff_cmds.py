@@ -537,7 +537,7 @@ def create_patch(ctx, json_style, patch_type, output_path, refish):
 @click.option("--no-commit", is_flag=True, help="Apply to the working copy only")
 @click.option("--allow-empty", is_flag=True)
 @click.option("--ref", default="HEAD",
-              help="Which ref to apply the patch onto (reference: kart/apply.py)")
+              help="Which branch to apply the patch onto (default: HEAD)")
 @click.argument("patch_file", type=click.File("r"))
 @click.pass_obj
 def apply_(ctx, no_commit, allow_empty, ref, patch_file):

@@ -1007,3 +1007,55 @@ def test_apply_ref_option(tmp_path, runner):
         cli, [*args, "apply", "--ref", "side", "--no-commit", str(pfile)]
     )
     assert r.exit_code != 0
+
+
+def test_apply_ref_edge_cases(tmp_path, runner):
+    """--ref on the checked-out branch takes the HEAD path (working copy
+    rolls forward); tags are refused."""
+    import sqlite3
+
+    from helpers import create_points_gpkg
+
+    gpkg = create_points_gpkg(str(tmp_path / "pts.gpkg"), n=5)
+    r = runner.invoke(cli, ["init", str(tmp_path / "repo")])
+    args = ["-C", str(tmp_path / "repo")]
+    r = runner.invoke(cli, [*args, "import", gpkg])
+    assert r.exit_code == 0, r.output
+
+    from kart_tpu.core.repo import KartRepo
+
+    repo = KartRepo(str(tmp_path / "repo"))
+    ds = repo.structure("HEAD").datasets["points"]
+    old = ds.get_feature([3])
+    new = dict(old)
+    new["name"] = "via-ref-main"
+    to_json = lambda f: {
+        k: (v.to_hex_wkb() if hasattr(v, "to_hex_wkb") else v)
+        for k, v in f.items()
+    }
+    patch = {
+        "kart.diff/v1+hexwkb": {
+            "points": {"feature": [{"-": to_json(old), "+": to_json(new)}]}
+        },
+        "kart.patch/v1": {"message": "main patch", "base": None},
+    }
+    pfile = tmp_path / "p.json"
+    pfile.write_text(json.dumps(patch))
+
+    r = runner.invoke(cli, [*args, "apply", "--ref", "main", str(pfile)])
+    assert r.exit_code == 0, r.output
+    # HEAD advanced AND the working copy rolled forward with it
+    wc = next(p for p in os.listdir(tmp_path / "repo") if p.endswith(".gpkg"))
+    con = sqlite3.connect(tmp_path / "repo" / wc)
+    (name,) = con.execute("SELECT name FROM points WHERE fid=3").fetchone()
+    con.close()
+    assert name == "via-ref-main"
+    r = runner.invoke(cli, [*args, "status"])
+    assert r.exit_code == 0 and "clean" in r.output.lower()
+
+    r = runner.invoke(cli, [*args, "tag", "v1"])
+    assert r.exit_code == 0, r.output
+    r = runner.invoke(
+        cli, [*args, "apply", "--ref", "refs/tags/v1", str(pfile)]
+    )
+    assert r.exit_code != 0  # tags must never be rewritten
