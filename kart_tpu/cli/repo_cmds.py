@@ -1,6 +1,8 @@
 """init / import / commit / status / checkout / switch / restore / reset
 (reference: kart/init.py, commit.py, checkout.py, status.py)."""
 
+import os
+
 import click
 
 from kart_tpu.cli import CliError, cli
@@ -387,11 +389,53 @@ def status(ctx, output_format):
 @cli.command()
 @click.option("-b", "new_branch", help="Create a new branch and switch to it")
 @click.option("--force", "-f", is_flag=True, help="Discard local changes")
+@click.option(
+    "--spatial-filter",
+    "spatial_filter_text",
+    default=None,
+    help="Change the repo's spatial filter: '<crs>;<geometry>', @file, or "
+         "'none' to clear — the working copy is rebuilt to match "
+         "(reference: kart checkout --spatial-filter)",
+)
 @click.argument("refish", required=False)
 @click.pass_obj
-def checkout(ctx, new_branch, force, refish):
+def checkout(ctx, new_branch, force, refish, spatial_filter_text=None):
     """Switch branches or restore working copy files."""
     repo = ctx.require_state(KartRepoState.NORMAL)
+    if spatial_filter_text is not None:
+        from kart_tpu.core.repo import KartConfigKeys
+        from kart_tpu.spatial_filter import ResolvedSpatialFilterSpec
+
+        spec = ResolvedSpatialFilterSpec.from_spec_string(spatial_filter_text)
+        old_spec = ResolvedSpatialFilterSpec.from_repo_config(repo)
+        if spec.match_all:
+            for key in (
+                KartConfigKeys.KART_SPATIALFILTER_GEOMETRY,
+                KartConfigKeys.KART_SPATIALFILTER_CRS,
+            ):
+                repo.del_config(key)
+        else:
+            repo.config.set_many(spec.config_items())
+        if not (spec.match_all and old_spec.match_all):
+            # the WC must contain exactly the in-filter features: full
+            # rebuild (reference: checkout.py do_switch_spatial_filter)
+            from kart_tpu.workingcopy import get_working_copy
+
+            wc = get_working_copy(repo, allow_uncreated=True)
+            if wc is not None and repo.head_commit_oid is not None:
+                if wc.is_dirty() and not force:
+                    raise InvalidOperation(
+                        "You have uncommitted changes in your working copy. "
+                        "Commit or discard first (use --force to discard)."
+                    )
+                target = repo.structure(refish or "HEAD")
+                full_path = getattr(wc, "full_path", None)
+                if full_path and os.path.exists(full_path):
+                    os.remove(full_path)
+                wc.create_and_initialise()
+                wc.write_full(target, *target.datasets)
+        if refish is None and new_branch is None:
+            return
     if new_branch:
         start = refish or "HEAD"
         oid, _ = repo.resolve_refish(start)

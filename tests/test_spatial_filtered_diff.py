@@ -259,3 +259,44 @@ def test_quiet_writer_exit_code_with_filter(tmp_path):
     )
     w = QuietDiffWriter(repo, "HEAD^...HEAD", output_path=io.StringIO())
     assert w.write_diff() is True
+
+
+def test_checkout_spatial_filter_rebuilds_wc(tmp_path):
+    """`kart checkout --spatial-filter=...` sets the repo filter and
+    rebuilds the working copy with exactly the in-filter features;
+    'none' clears it and restores everything (reference: kart checkout
+    --spatial-filter)."""
+    import sqlite3
+
+    from click.testing import CliRunner
+
+    from kart_tpu.cli import cli
+
+    repo, ds_path = make_imported_repo(tmp_path, n=10)
+    args = ["-C", str(tmp_path / "repo")]
+    runner = CliRunner()
+    # create the WC first
+    r = runner.invoke(cli, [*args, "checkout"])
+    assert r.exit_code == 0, r.output
+    wc_file = next(
+        p for p in (tmp_path / "repo").iterdir() if p.suffix == ".gpkg"
+    )
+
+    def wc_fids():
+        con = sqlite3.connect(wc_file)
+        fids = sorted(r[0] for r in con.execute("SELECT fid FROM points"))
+        con.close()
+        return fids
+
+    assert wc_fids() == list(range(1, 11))
+    # 105.5 avoids fid 6 sitting exactly on the boundary (boundary matches)
+    rect = "EPSG:4326;POLYGON((100 -42, 105.5 -42, 105.5 -39, 100 -39, 100 -42))"
+    r = runner.invoke(cli, [*args, "checkout", "--spatial-filter", rect])
+    assert r.exit_code == 0, r.output
+    assert wc_fids() == [1, 2, 3, 4, 5]
+    # the filter is persisted: diffs honour it too
+    r = runner.invoke(cli, [*args, "status"])
+    assert r.exit_code == 0
+    r = runner.invoke(cli, [*args, "checkout", "--spatial-filter", "none"])
+    assert r.exit_code == 0, r.output
+    assert wc_fids() == list(range(1, 11))
