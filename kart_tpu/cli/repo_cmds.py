@@ -451,7 +451,35 @@ def checkout(ctx, new_branch, force, refish, spatial_filter_text=None):
                 "You have uncommitted changes in your working copy. "
                 "Commit or discard first (use --force to discard)."
             )
-        oid, ref = repo.resolve_refish(refish)
+        try:
+            oid, ref = repo.resolve_refish(refish)
+        except Exception:
+            # guess: a bare name matching exactly one remote branch creates
+            # a local tracking branch (reference: checkout.py --guess)
+            matches = [
+                (r, o)
+                for r, o in repo.refs.iter_refs("refs/remotes/")
+                if r.split("/", 3)[-1] == refish and not r.endswith("/HEAD")
+            ]
+            if len(matches) != 1:
+                raise
+            remote_ref, oid = matches[0]
+            remote_name = remote_ref.split("/")[2]
+            local = f"refs/heads/{refish}"
+            repo.refs.set(
+                local, oid, log_message=f"branch: created from {remote_ref}"
+            )
+            repo.config.set_many({
+                f"branch.{refish}.remote": remote_name,
+                f"branch.{refish}.merge": f"refs/heads/{refish}",
+            })
+            repo.refs.set_head(local, log_message=f"checkout: moving to {refish}")
+            _do_checkout(repo, "HEAD", force=True)
+            click.echo(
+                f"Switched to a new branch '{refish}' tracking "
+                f"'{remote_name}/{refish}'"
+            )
+            return
         if ref and ref.startswith("refs/heads/"):
             repo.refs.set_head(ref, log_message=f"checkout: moving to {refish}")
             click.echo(f"Switched to branch '{refish}'")

@@ -421,3 +421,29 @@ def test_fetch_skips_invalid_remote_ref_names(source_repo, tmp_path, capsys):
         os.path.join(clone.gitdir, "refs", "remotes", "origin", "evil.lock")
     )
     assert "invalid remote ref name" in capsys.readouterr().err
+
+
+def test_checkout_guess_remote_branch(source_repo, tmp_path):
+    """Checking out a bare name that only exists as a remote branch creates
+    a local tracking branch (reference: kart checkout --guess default)."""
+    from click.testing import CliRunner
+
+    from kart_tpu.cli import cli
+
+    repo, ds_path = source_repo
+    # a branch on the source beyond main
+    repo.refs.set(
+        "refs/heads/feature-x", repo.head_commit_oid, "branch: for guess test"
+    )
+    clone = transport.clone(repo.workdir, tmp_path / "guess-clone", do_checkout=False)
+    assert not clone.refs.exists("refs/heads/feature-x")
+    runner = CliRunner()
+    r = runner.invoke(
+        cli, ["-C", str(tmp_path / "guess-clone"), "checkout", "feature-x"]
+    )
+    assert r.exit_code == 0, r.output
+    assert "tracking" in r.output
+    clone2 = KartRepo(str(tmp_path / "guess-clone"))
+    assert clone2.refs.exists("refs/heads/feature-x")
+    assert clone2.head_branch == "refs/heads/feature-x"
+    assert clone2.config.get("branch.feature-x.remote") == "origin"
