@@ -461,7 +461,14 @@ def checkout(ctx, new_branch, force, refish, spatial_filter_text=None):
                 for r, o in repo.refs.iter_refs("refs/remotes/")
                 if r.split("/", 3)[-1] == refish and not r.endswith("/HEAD")
             ]
-            if len(matches) != 1:
+            if len(matches) > 1:
+                remotes = ", ".join(sorted(r.split("/")[2] for r, _ in matches))
+                raise InvalidOperation(
+                    f"{refish!r} matches branches on multiple remotes "
+                    f"({remotes}) — check out the remote-qualified name "
+                    f"explicitly"
+                )
+            if not matches:
                 raise
             remote_ref, oid = matches[0]
             remote_name = remote_ref.split("/")[2]
