@@ -520,3 +520,49 @@ def test_status_json_during_merge(repo_dir, runner):
     assert body["conflicts"] == {"points": {"feature": 1}}
     assert body["merging"]["theirs"]["branch"] == "alt"
     assert body["merging"]["ours"]["branch"] == "main"
+
+
+def test_full_conflicts_listing_byte_exact(tmp_path, monkeypatch):
+    """The filtered full text listing reproduces the reference's own
+    expected output byte-for-byte (tests/test_conflicts.py:
+    test_list_conflicts, points fixture)."""
+    from conftest import REF_DATA, extract_ref_archive
+
+    if not os.path.isdir(os.path.join(REF_DATA, "conflicts")):
+        pytest.skip("reference fixtures not available")
+    src = extract_ref_archive(tmp_path, "conflicts/points.tgz")
+    monkeypatch.chdir(src)
+    runner = CliRunner()
+    r = runner.invoke(cli, ["merge", "theirs_branch"])
+    assert r.exit_code == 0, r.output
+    r = runner.invoke(cli, ["conflicts", "nz_pa_points_topo_150k:feature:3"])
+    assert r.exit_code == 0, r.output
+    L = "nz_pa_points_topo_150k"
+    expected = [
+        f"{L}:",
+        f"    {L}:feature:",
+        f"        {L}:feature:3:",
+        f"            {L}:feature:3:ancestor:",
+        "                                     fid = 3",
+        "                                    geom = POINT(...)",
+        "                                 t50_fid = 2426273",
+        "                              name_ascii = Tauwhare Pa",
+        "                              macronated = N",
+        "                                    name = Tauwhare Pa",
+        f"            {L}:feature:3:ours:",
+        "                                     fid = 3",
+        "                                    geom = POINT(...)",
+        "                                 t50_fid = 2426273",
+        "                              name_ascii = Tauwhare Pa",
+        "                              macronated = N",
+        "                                    name = ours_version",
+        f"            {L}:feature:3:theirs:",
+        "                                     fid = 3",
+        "                                    geom = POINT(...)",
+        "                                 t50_fid = 2426273",
+        "                              name_ascii = Tauwhare Pa",
+        "                              macronated = N",
+        "                                    name = theirs_version",
+        "",
+    ]
+    assert r.output.splitlines() == expected
