@@ -429,9 +429,12 @@ def get_dataset_feature_count_fast(
     if not (sidecar.has_sidecar(repo, base_ds) and sidecar.has_sidecar(repo, target_ds)):
         return None
     rect = _prefilter_rect(spatial_filter_spec)
-    # filtered counts reshape the blocks anyway: skip the padded copies
-    old_block = sidecar.load_block(repo, base_ds, pad=rect is None)
-    new_block = sidecar.load_block(repo, target_ds, pad=rect is None)
+    # no padded copies: the host engine and the streamed/sharded device
+    # paths consume count-sliced mmap views, and the monolithic device
+    # kernel pads lazily inside classify_blocks (at 100M the two padded
+    # copies were ~5.6GB of memcpy before any classification work)
+    old_block = sidecar.load_block(repo, base_ds, pad=False)
+    new_block = sidecar.load_block(repo, target_ds, pad=False)
     if old_block is None or new_block is None:
         return None
 

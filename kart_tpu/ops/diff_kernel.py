@@ -263,6 +263,8 @@ def classify_blocks(old_block, new_block):
 
     n_rows = max(old_block.count, new_block.count)
     if not device_profitable(n_rows):
+        # the host merge-join reads count-sliced views directly — callers
+        # may pass unpadded (mmap-backed) blocks with no copy at all
         return classify_blocks_host(old_block, new_block)
     try:
         if n_rows >= STREAM_MIN_ROWS and default_backend() != "cpu":
@@ -272,11 +274,13 @@ def classify_blocks(old_block, new_block):
             if default_backend() == "cpu"
             else _classify_padded
         )
+        ok, oo = _padded_arrays(old_block)
+        nk, no = _padded_arrays(new_block)
         old_class, new_class, _, counts = kernel(
-            old_block.keys,
-            old_block.oids,
-            new_block.keys,
-            new_block.oids,
+            ok,
+            oo,
+            nk,
+            no,
             old_block.count,
             new_block.count,
         )
@@ -412,6 +416,24 @@ def classify_blocks_streamed(old_block, new_block, chunk_rows=None):
             "deletes": int(totals[2]),
         },
     )
+
+
+def _padded_arrays(block):
+    """(keys, oids) padded to the bucket size the monolithic device kernels
+    compile for; a no-op view when the block is already padded (only the
+    device route pays the copy — the host engine and the streamed/sharded
+    paths take count-sliced views)."""
+    from kart_tpu.ops.blocks import PAD_KEY, bucket_size
+
+    n = block.count
+    size = bucket_size(max(n, 1))
+    if len(block.keys) >= size:
+        return block.keys, block.oids
+    keys = np.full(size, PAD_KEY, dtype=np.int64)
+    keys[:n] = block.keys[:n]
+    oids = np.zeros((size, 5), dtype=np.uint32)
+    oids[:n] = block.oids[:n]
+    return keys, oids
 
 
 def classify_blocks_host(old_block, new_block):

@@ -15,6 +15,7 @@
 
 #include <cstdint>
 #include <cstring>
+#include <cmath>
 
 namespace {
 
@@ -71,6 +72,20 @@ inline bool intersects(const Envelope& env, const Envelope& q) {
   return cyclic_overlap(env.w, env.e, q.w, q.e);
 }
 
+// largest float <= b / smallest float >= b (for exact f64-equivalent
+// comparisons done in pure f32)
+inline float largest_float_le(double b) {
+  float f = static_cast<float>(b);
+  if (static_cast<double>(f) > b) f = std::nextafter(f, -INFINITY);
+  return f;
+}
+
+inline float smallest_float_ge(double b) {
+  float f = static_cast<float>(b);
+  if (static_cast<double>(f) < b) f = std::nextafter(f, INFINITY);
+  return f;
+}
+
 }  // namespace
 
 extern "C" {
@@ -105,21 +120,43 @@ int64_t sf_bbox_intersects(const double* envelopes, int64_t n,
 }
 
 // float32 variant: reads (n,4) f32 envelopes straight from a sidecar mmap
-// (no f64 conversion pass). Same semantics as sf_bbox_intersects via the
-// shared cyclic helpers; mostly-branch-free body so the compiler can
-// vectorize the compares.
+// (no f64 conversion pass). Same semantics as sf_bbox_intersects; the
+// overwhelmingly common non-wrapping case (e >= w, nearly every feature)
+// is four compares with no fmod, so the loop runs at memory bandwidth —
+// wrapping rows and wrapping queries take the exact cyclic path.
+__attribute__((target_clones("avx512f", "avx2", "default")))
 int64_t sf_bbox_intersects_f32(const float* envelopes, int64_t n,
                                const double* query, uint8_t* out) {
   Envelope q{query[0], query[1], query[2], query[3]};
-  const double qlen = range_len(q.w, q.e);
+  const bool q_wraps = q.e < q.w;
   int64_t hits = 0;
+  if (!q_wraps) {
+    // Branchless single pass. Exact f64-equivalent pure-f32 thresholds:
+    // comparing a float x against a double bound b satisfies
+    // (double)x <= b  <=>  x <= B where B is the largest float <= b (and
+    // symmetrically for >=). Longitude: a non-wrapping envelope overlaps
+    // [qw, qe] iff (w <= qe) AND (qw <= e); a wrapping one ([w,180] u
+    // [-180,e]) iff (w <= qe) OR (qw <= e) — one predicate covers both:
+    // (A & B) | (wrap & (A | B)). Verified exactly equal to the cyclic
+    // f64 reference by the parity fuzz test.
+    const float qe32 = largest_float_le(q.e);
+    const float qn32 = largest_float_le(q.n);
+    const float qw32 = smallest_float_ge(q.w);
+    const float qs32 = smallest_float_ge(q.s);
+    for (int64_t j = 0; j < n; j++) {
+      const float* p = envelopes + j * 4;
+      const uint8_t lat = (p[1] <= qn32) & (qs32 <= p[3]);
+      const uint8_t a = (p[0] <= qe32);
+      const uint8_t b = (qw32 <= p[2]);
+      const uint8_t wrapb = (p[2] < p[0]);
+      out[j] = lat & ((a & b) | (wrapb & (a | b)));
+    }
+    for (int64_t j = 0; j < n; j++) hits += out[j];
+    return hits;
+  }
   for (int64_t i = 0; i < n; i++) {
     const float* p = envelopes + i * 4;
-    const double w = p[0], s = p[1], e = p[2], nn = p[3];
-    const bool lat_ok = (s <= q.n) & (q.s <= nn);
-    const double len = range_len(w, e);
-    const bool lon_ok = (mod360(q.w - w) <= len) | (mod360(w - q.w) <= qlen);
-    const bool hit = lat_ok & lon_ok;
+    const bool hit = intersects(Envelope{p[0], p[1], p[2], p[3]}, q);
     out[i] = hit ? 1 : 0;
     hits += hit;
   }
