@@ -426,7 +426,34 @@ def _bbox_bench():
         resident_s = time.perf_counter() - t0
         assert (got == ref).all()
 
+        # the native branchless f32 scan (the sidecar-envelope residue path,
+        # commit 6d59450) and the packed 20-bit reference-format path,
+        # recorded so the headline f32 claim is reproducible (VERDICT r5 #5)
+        from kart_tpu import native as _native
+
+        env32 = env.astype(np.float32)
+        ref32 = bbox_intersects_np(env32.astype(np.float64), query)
+        got32 = _native.bbox_intersects_f32(env32, query)
+        assert (got32 == ref32).all()
+        t0 = time.perf_counter()
+        for _ in range(3):
+            _native.bbox_intersects_f32(env32, query)
+        f32_s = (time.perf_counter() - t0) / 3
+
+        from kart_tpu.ops.envelope_codec import EnvelopeCodec
+
+        packed = EnvelopeCodec().encode_batch(env)
+        _native.filter_packed(packed, query)  # warm (page in)
+        t0 = time.perf_counter()
+        _native.filter_packed(packed, query)
+        packed_s = time.perf_counter() - t0
+
         return {
+            "bbox_f32_seconds": round(f32_s, 4),
+            "bbox_f32_envelopes_per_sec": round(rows / f32_s),
+            "bbox_f32_vs_numpy": round(np_s / f32_s, 1),
+            "bbox_packed_seconds": round(packed_s, 4),
+            "bbox_f32_vs_packed": round(packed_s / f32_s, 1),
             "bbox_rows": rows,
             "bbox_e2e_seconds": round(e2e_s, 4),
             "bbox_kernel_seconds": round(dev_s, 4),
@@ -616,15 +643,77 @@ def _cli_diff_bench():
                 os.environ.pop("KART_DIFF_ENGINE", None)
         finally:
             os.chdir(cwd)
+
+        # import-leg phase breakdown (VERDICT r5 #6, measurement half): one
+        # more import on the *serial* instrumented path — the parallel
+        # fan-out interleaves phases across workers, so the decomposition
+        # is taken where each phase is separable; its own total makes the
+        # denominator explicit
+        phases = {}
+        serial_import_s = None
+        phase_dir = os.path.join(work, "repo-phases")
+        r = runner.invoke(cli, ["init", phase_dir])
+        assert r.exit_code == 0, r.output
+        os.environ["KART_IMPORT_WORKERS"] = "1"
+        os.chdir(phase_dir)
+        try:
+            t0 = time.perf_counter()
+            r = runner.invoke(cli, ["import", gpkg, "--no-checkout"])
+            serial_import_s = time.perf_counter() - t0
+        finally:
+            os.chdir(cwd)
+            os.environ.pop("KART_IMPORT_WORKERS", None)
+        assert r.exit_code == 0, r.output
+        from kart_tpu.importer.importer import LAST_IMPORT_PHASES
+
+        if LAST_IMPORT_PHASES:
+            p = LAST_IMPORT_PHASES
+            phases = {
+                "import_phase_source_read_seconds": round(p["source_read"], 3),
+                "import_phase_encode_seconds": round(p["encode"], 3),
+                "import_phase_hash_deflate_seconds": round(p["hash_deflate"], 3),
+                "import_phase_tree_build_seconds": round(p["tree_build"], 3),
+                "import_serial_seconds": round(serial_import_s, 3),
+            }
+        shutil.rmtree(phase_dir, ignore_errors=True)
+
+        # working-copy checkout / incremental reset (VERDICT r5 #7): GPKG
+        # write_full of the full layer through the CLI, the incremental
+        # reset via the library (the CLI reset forces a full rewrite), and
+        # a same-machine reference-loop comparison
+        os.chdir(repo_dir)
+        try:
+            t0 = time.perf_counter()
+            r = runner.invoke(cli, ["checkout"])
+            assert r.exit_code == 0, r.output
+            wc_checkout_s = time.perf_counter() - t0
+
+            from kart_tpu.core.repo import KartRepo
+
+            repo = KartRepo(".")
+            wc = repo.working_copy
+            t0 = time.perf_counter()
+            wc.reset(repo.structure("HEAD^"))  # incremental: 1% of rows
+            wc_reset_s = time.perf_counter() - t0
+            ref_wc_rate = _reference_checkout_rate(repo)
+        finally:
+            os.chdir(cwd)
+
         return {
             "cli_diff_rows": rows,
             "cli_import_seconds": round(import_s, 3),
             "cli_import_seconds_median": round(import_median_s, 3),
             "import_features_per_sec": round(rows / import_s),
+            **phases,
             "cli_diff_columnar_cold_seconds": round(columnar_cold_s, 3),
             "cli_diff_columnar_seconds": round(columnar_s, 3),
             "cli_diff_tree_seconds": round(tree_s, 3),
             "cli_diff_rows_per_sec": round(rows / columnar_s),
+            "wc_checkout_seconds": round(wc_checkout_s, 2),
+            "wc_checkout_features_per_sec": round(rows / wc_checkout_s),
+            "wc_reset_seconds": round(wc_reset_s, 3),
+            "reference_checkout_rate": round(ref_wc_rate),
+            "wc_checkout_vs_reference": round(rows / wc_checkout_s / ref_wc_rate, 1),
         }
     except Exception as e:  # pragma: no cover - bench resilience
         print(f"cli bench failed: {type(e).__name__}: {e}", file=sys.stderr)
@@ -780,6 +869,56 @@ def _reference_materialise_rate(repo_path, slice_n=4000):
     return n / dt
 
 
+def _reference_checkout_rate(repo, slice_n=50_000):
+    """Features/s of the reference's working-copy checkout loop
+    (kart/working_copy/base.py write_full) re-created over our storage:
+    per feature, a single-object odb read (pack bisect + one-shot inflate,
+    no batch prefetch), a name-keyed dict build, per-cell GPKG value
+    conversion, and executemany batches of 1000 into sqlite. Measured on a
+    slice and reported as a rate (the loop is O(n))."""
+    import sqlite3
+
+    from kart_tpu.adapters import gpkg as gpkg_adapter
+
+    structure = repo.structure("HEAD")
+    ds = structure.datasets[structure.datasets.paths()[0]]
+    schema = ds.schema
+    feature_tree = ds.feature_tree
+    odb = feature_tree.odb
+    entries = []
+    for path, entry in feature_tree.walk_blobs():
+        entries.append((path, entry.oid))
+        if len(entries) >= slice_n:
+            break
+
+    con = sqlite3.connect(":memory:")
+    cols = ",".join(f'"{c.name}"' for c in schema.columns)
+    qs = ",".join("?" for _ in schema.columns)
+    con.execute(
+        "CREATE TABLE t (" + ",".join(f'"{c.name}"' for c in schema.columns) + ")"
+    )
+    insert_sql = f"INSERT INTO t ({cols}) VALUES ({qs})"
+    t0 = time.perf_counter()
+    batch = []
+    for path, oid in entries:
+        data = odb.read_blob(oid)  # single-object read, as the reference
+        feature = ds.get_feature(ds.decode_path_to_pks(path), data=data)
+        batch.append(
+            tuple(
+                gpkg_adapter.value_from_v2(feature[c.name], c, crs_id=4326)
+                for c in schema.columns
+            )
+        )
+        if len(batch) >= 1000:
+            con.executemany(insert_sql, batch)
+            batch.clear()
+    if batch:
+        con.executemany(insert_sql, batch)
+    dt = time.perf_counter() - t0
+    con.close()
+    return len(entries) / dt
+
+
 def _cli_diff_100m():
     """The north-star number (BASELINE.json): end-to-end `kart diff -o
     feature-count` on a 100M-feature layer, < 60 s target. The repo is
@@ -804,9 +943,12 @@ def _cli_diff_100m():
         from kart_tpu.synth import synth_repo
 
         t0 = time.perf_counter()
+        # blobs="changed": the ~1M edited rows carry real blobs in both
+        # revisions — exactly the set the full-output diff materialises —
+        # while the other 99M stay promised (partial-clone state)
         repo, _info = synth_repo(
             os.path.join(work, "repo"), rows, edit_frac=0.01,
-            blobs="promised", spatial=True,
+            blobs="changed", spatial=True,
         )
         synth_s = time.perf_counter() - t0
 
@@ -864,8 +1006,43 @@ def _cli_diff_100m():
         r = runner.invoke(cli, args)
         assert r.exit_code == 0, r.output
         spatial_s = time.perf_counter() - t0
+        spatial_out = r.output
+        # the same filtered diff with block pruning disabled (the r5-style
+        # full envelope scan), proving the pruning wins AND that the output
+        # is identical (the acceptance pair for the block-aggregate change)
+        os.environ["KART_BLOCK_PRUNE"] = "0"
+        try:
+            t0 = time.perf_counter()
+            r = runner.invoke(cli, args)
+            assert r.exit_code == 0, r.output
+            spatial_unpruned_s = time.perf_counter() - t0
+            spatial_unpruned_out = r.output
+        finally:
+            os.environ.pop("KART_BLOCK_PRUNE", None)
         for key in spec.config_items():
             repo.del_config(key)
+
+        # full-output json-lines diff over the ~1M-row changed set: the
+        # fused materialisation pipeline (batch pack-read -> inflate ->
+        # msgpack-decode -> compiled serialise), end to end through the CLI
+        sink = os.path.join(work, "fulldiff.jsonl")
+        full_args = [
+            "-C", os.path.join(work, "repo"), "diff", "HEAD^...HEAD",
+            "-o", "json-lines", "--output", sink,
+        ]
+        t0 = time.perf_counter()
+        r = runner.invoke(cli, full_args)
+        assert r.exit_code == 0, r.output
+        fulldiff_cold_s = time.perf_counter() - t0
+        t0 = time.perf_counter()
+        r = runner.invoke(cli, full_args)
+        assert r.exit_code == 0, r.output
+        fulldiff_s = time.perf_counter() - t0
+        with open(sink) as f:
+            n_lines = sum(1 for _ in f)
+        n_edits = _info["n_edits"]
+        assert n_lines >= n_edits, (n_lines, n_edits)
+        n_materialised = 2 * n_edits  # updates materialise old + new
 
         # the north-star flag is the ROUTED production path, nothing else
         # (VERDICT r3 weak #2: a forced-host number must never wear this
@@ -878,16 +1055,26 @@ def _cli_diff_100m():
             "cli_100m_diff_host_engine_seconds": round(host_s, 2),
             "cli_100m_spatial_diff_cold_seconds": round(spatial_cold_s, 2),
             "cli_100m_spatial_diff_seconds": round(spatial_s, 2),
+            "cli_100m_spatial_unpruned_seconds": round(spatial_unpruned_s, 2),
+            "cli_100m_spatial_output_matches_unpruned": bool(
+                spatial_out == spatial_unpruned_out
+            ),
             # the filtered diff answers a strictly harder question (which
-            # deltas match the filter) — since the unpadded classify got
-            # ~5x faster it can undercut the filter's envelope pass, so
-            # both comparisons are recorded: vs this run's unfiltered scan
-            # and vs the r4-recorded 4.31s unfiltered bar (VERDICT r4 next
-            # #3's done-condition)
+            # deltas match the filter); with block-pruned aggregates the
+            # envelope pass touches only boundary blocks, so it must now
+            # undercut the unfiltered scan (ISSUE 1 acceptance), and the
+            # r4 bar stays recorded for continuity
             "cli_100m_spatial_beats_unfiltered": bool(spatial_s < routed_s),
             "cli_100m_spatial_beats_r4_bar": bool(
                 rows < 100_000_000 or spatial_s < 4.31
             ),
+            "cli_100m_fulldiff_cold_seconds": round(fulldiff_cold_s, 2),
+            "cli_100m_fulldiff_seconds": round(fulldiff_s, 2),
+            "cli_100m_fulldiff_rows_materialised": n_materialised,
+            # the headline materialisation rate, at the 1M-changed scale
+            # (supersedes the 10M-polygon section's smaller-sample number
+            # printed in the interim record)
+            "features_materialised_per_sec": round(n_materialised / fulldiff_s),
             "cli_100m_north_star_met": bool(routed_s < 60.0),
         }
     except Exception as e:  # pragma: no cover - bench resilience

@@ -226,6 +226,14 @@ class ObjectDb:
             if obj_type == "blob"
         }
 
+    def read_blobs_data_ordered(self, shas):
+        """[20-byte sha] -> [blob bytes | None] in request order via the
+        native batch pack inflate with no per-record dict bookkeeping — the
+        fused materialiser's read path. None entries (loose objects, delta
+        records, promised/missing, native unavailable) are the caller's job
+        via the per-object :meth:`read_blob`."""
+        return self.packs.read_blob_data_ordered(shas)
+
     def write_raw(self, obj_type, content) -> str:
         if self._bulk_writer is not None:
             # duplicate objects across packs are legal (git semantics);

@@ -314,6 +314,45 @@ class Packfile:
     # when a batch hits unexpectedly large records
     BATCH_BYTE_BUDGET = 256 * 1024 * 1024
 
+    def read_blob_data_into(self, shas, out, slots):
+        """Ordered bulk blob read with no per-record dict churn: for each
+        ``shas[i]`` this pack holds as a non-delta *blob* record, set
+        ``out[slots[i]]`` to the payload bytes. -> bool np array of filled
+        positions. The fused materialiser's read path: the general
+        :meth:`read_batch` spends ~3us/blob on tuple/dict bookkeeping
+        around a ~0.3us native inflate."""
+        from kart_tpu import native
+
+        import numpy as np
+
+        offs = self.index.offsets_of_batch(shas)
+        filled = np.zeros(len(shas), dtype=bool)
+        f_idx = np.nonzero(offs >= 0)[0]
+        if not len(f_idx):
+            return filled
+        order = np.argsort(offs[f_idx], kind="stable")
+        f_idx = f_idx[order]
+        f_offs = offs[f_idx]
+        f_idx_l = f_idx.tolist()
+        pos = 0
+        while pos < len(f_offs):
+            res = native.inflate_pack_batch(
+                self._mm, f_offs[pos:], max_total=self.BATCH_BYTE_BUDGET
+            )
+            if res is None:
+                break
+            take, types, payload, po = res
+            types_l = types.tolist()
+            po_l = po.tolist()
+            mv = payload
+            for i in range(take):
+                if types_l[i] == OBJ_BLOB:
+                    j = f_idx_l[pos + i]
+                    out[slots[j]] = mv[po_l[i] : po_l[i + 1]].tobytes()
+                    filled[j] = True
+            pos += take
+        return filled
+
     def read_batch(self, shas):
         """[20-byte sha] -> {sha: (type_str, content)} via native batch
         inflates, offset-sorted for sequential access, each call bounded by
@@ -482,6 +521,36 @@ class PackCollection:
             if got:
                 out.update(got)
                 remaining = [s for s in remaining if s not in got]
+        return out
+
+    def read_blob_data_ordered(self, shas):
+        """[20-byte sha] -> [blob payload bytes | None] in request order
+        across all packs (None: absent / delta / non-blob / native
+        unavailable — the caller's per-object path covers them).
+
+        The pack that served the previous call is probed first: a chunked
+        materialisation reads thousands of batches whose blobs all live in
+        one pack, and an index probe that misses still pays a full
+        searchsorted over the miss pack's sha table (~2.5s of pure misses
+        across a 2M-row materialisation at 100M scale without the memo)."""
+        out = [None] * len(shas)
+        slots = list(range(len(shas)))
+        sub = list(shas)
+        packs = list(self.packs)
+        pref = getattr(self, "_blob_pack_pref", None)
+        if pref is not None and pref in packs:
+            packs.remove(pref)
+            packs.insert(0, pref)
+        for pack in packs:
+            if not sub:
+                break
+            filled = pack.read_blob_data_into(sub, out, slots)
+            if filled.any():
+                if pack is not pref and filled.sum() * 2 >= len(filled):
+                    self._blob_pack_pref = pack
+                keep = [i for i, f in enumerate(filled.tolist()) if not f]
+                sub = [sub[i] for i in keep]
+                slots = [slots[i] for i in keep]
         return out
 
     def __contains__(self, sha):

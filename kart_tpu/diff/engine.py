@@ -259,6 +259,27 @@ def get_feature_diff_columnar(base_ds, target_ds, ds_filter=None, *, blocks=None
     return result
 
 
+def _envelope_hits(block, query):
+    """bool (count,) envelope-vs-query intersections for one sidecar block.
+    Blocks with aggregate records take the block-pruned scan (all-out
+    blocks' envelope pages are never read — filter-refine before the fine
+    scan); pre-aggregate sidecars fall back to the full branchless f32
+    residue scan. KART_BLOCK_PRUNE=0 forces the full scan (tests, bench
+    comparison) — results are bit-identical either way (fuzz-tested)."""
+    import os
+
+    if block.count == 0:
+        return np.zeros(0, dtype=bool)
+    if block.env_blocks is not None and os.environ.get("KART_BLOCK_PRUNE", "1") != "0":
+        from kart_tpu.native import bbox_blocks_f32
+
+        agg, flags, block_rows = block.env_blocks
+        return bbox_blocks_f32(block.envelopes, agg, flags, block_rows, query)
+    from kart_tpu.native import bbox_intersects_f32
+
+    return bbox_intersects_f32(block.envelopes, query)
+
+
 def spatial_prefilter_blocks(old_block, new_block, rect_wsen):
     """Envelope prefilter for a sidecar block pair (both sides must carry
     envelope columns, else None): a key survives in BOTH blocks when EITHER
@@ -267,52 +288,62 @@ def spatial_prefilter_blocks(old_block, new_block, rect_wsen):
     whole pair then dropping out-of-filter deltas (the reference's
     delta-level filter, kart/base_diff_writer.py:279-341, evaluated on the
     envelope index instead of materialised values). -> (old_sub, new_sub)
-    unpadded-path FeatureBlocks, or None when envelopes are missing."""
-    from kart_tpu.native import bbox_intersects_f32
-    from kart_tpu.ops.blocks import PAD_KEY, bucket_size
+    unpadded-path FeatureBlocks, or None when envelopes are missing.
 
+    Everything after the (block-pruned) envelope scan works on hit *indices*
+    rather than full-width masks: the cross-side key propagation probes only
+    the hit keys and the compaction gathers only surviving rows, so at 100M
+    rows the key/oid pages of out-of-filter regions are never faulted in."""
     if old_block.envelopes is None or new_block.envelopes is None:
         return None
     o_n, n_n = old_block.count, new_block.count
     query = np.asarray(rect_wsen, dtype=np.float64)
-    # single-pass native f32 scan straight over the sidecar mmaps
-    o_hit = bbox_intersects_f32(old_block.envelopes, query) if o_n else np.zeros(0, bool)
-    n_hit = bbox_intersects_f32(new_block.envelopes, query) if n_n else np.zeros(0, bool)
-    o_keys = np.asarray(old_block.keys[:o_n])
-    n_keys = np.asarray(new_block.keys[:n_n])
-    # propagate hits to the other side's matching keys (both key-sorted).
-    # The overwhelmingly common case — same key population on both sides
-    # (edits, no schema of inserts/deletes) — skips the searchsorted joins.
-    if o_n == n_n and np.array_equal(o_keys, n_keys):
-        o_all = n_all = o_hit | n_hit
-    elif o_n and n_n:
-        pos = np.searchsorted(n_keys, o_keys)
-        pos_c = np.minimum(pos, n_n - 1)
-        shared = (pos < n_n) & (n_keys[pos_c] == o_keys)
-        o_all = o_hit | (shared & n_hit[pos_c])
-        pos2 = np.searchsorted(o_keys, n_keys)
-        pos2_c = np.minimum(pos2, o_n - 1)
-        shared2 = (pos2 < o_n) & (o_keys[pos2_c] == n_keys)
-        n_all = n_hit | (shared2 & o_hit[pos2_c])
+    o_idx = np.flatnonzero(_envelope_hits(old_block, query))
+    n_idx = np.flatnonzero(_envelope_hits(new_block, query))
+    o_keys = old_block.keys[:o_n]
+    n_keys = new_block.keys[:n_n]
+    # propagate hits to the other side's matching keys (both key-sorted):
+    # binary-search the (few) hit keys into the other side, union the
+    # matching row indices in
+    if o_n and n_n:
+        n_hit_keys = np.asarray(n_keys[n_idx])
+        o_hit_keys = np.asarray(o_keys[o_idx])
+        if o_n == n_n and np.array_equal(o_hit_keys, n_hit_keys):
+            # identical hit-key sets on both sides (edits that don't move
+            # geometry — the overwhelmingly common case): each side's rows
+            # matching the other's hit keys ARE its own hit rows (keys are
+            # unique and sorted), so the binary-search probe storm into the
+            # 100M-row key mmaps — scattered page faults at north-star
+            # scale — is skipped entirely
+            o_surv, n_surv = o_idx, n_idx
+        else:
+            pos = np.searchsorted(o_keys, n_hit_keys)
+            pos_c = np.minimum(pos, o_n - 1)
+            shared = (np.asarray(o_keys[pos_c]) == n_hit_keys) & (pos < o_n)
+            o_surv = np.union1d(o_idx, pos_c[shared])
+            pos2 = np.searchsorted(n_keys, o_hit_keys)
+            pos2_c = np.minimum(pos2, n_n - 1)
+            shared2 = (np.asarray(n_keys[pos2_c]) == o_hit_keys) & (pos2 < n_n)
+            n_surv = np.union1d(n_idx, pos2_c[shared2])
     else:
-        o_all, n_all = o_hit, n_hit
+        o_surv, n_surv = o_idx, n_idx
 
-    def compact(block, keys, mask):
-        k = keys[mask]
-        o = np.asarray(block.oids[: len(keys)])[mask]
+    def compact(block, idx):
+        from kart_tpu.ops.blocks import PAD_KEY, FeatureBlock, bucket_size
+
+        k = np.asarray(block.keys[idx])
+        o = np.asarray(block.oids[idx])
         size = bucket_size(max(len(k), 1))
         kp = np.full(size, PAD_KEY, dtype=np.int64)
         kp[: len(k)] = k
         op = np.zeros((size, 5), dtype=np.uint32)
         op[: len(k)] = o
-        from kart_tpu.ops.blocks import FeatureBlock
-
         # envelopes deliberately dropped: nothing downstream of the
         # prefilter reads them (classify uses keys/oids; writers' exact
         # residue reads feature values)
         return FeatureBlock(kp, op, None, len(k))
 
-    return compact(old_block, o_keys, o_all), compact(new_block, n_keys, n_all)
+    return compact(old_block, o_surv), compact(new_block, n_surv)
 
 
 #: query-rect pad for the envelope prefilter: sidecar envelopes are rounded
@@ -369,8 +400,12 @@ def _feature_diff_routed(base_ds, target_ds, ds_filter=None, spatial_filter_spec
             mode == "columnar"
             or (sidecar.has_sidecar(repo, base_ds) and sidecar.has_sidecar(repo, target_ds))
         ):
-            old_block = sidecar.ensure_block(repo, base_ds)
-            new_block = sidecar.ensure_block(repo, target_ds)
+            # unpadded mmap views: the host engine and the streamed/sharded
+            # device paths consume count-sliced views, and the monolithic
+            # device kernel pads lazily inside classify_blocks — at 100M the
+            # two eager padded copies were ~5.6GB of memcpy before any work
+            old_block = sidecar.ensure_block(repo, base_ds, pad=False)
+            new_block = sidecar.ensure_block(repo, target_ds, pad=False)
             if old_block is not None and new_block is not None:
                 rect = _prefilter_rect(spatial_filter_spec)
                 if rect is not None and base_ds.path_encoder.scheme == "int":
@@ -452,6 +487,86 @@ def get_dataset_feature_count_fast(
     else:
         _, _, counts = classify_blocks(old_block, new_block)
     return counts["inserts"] + counts["updates"] + counts["deletes"]
+
+
+def get_feature_diff_rows(base_rs, target_rs, ds_path):
+    """Columnar full-output row plan for one dataset: the classify kernel's
+    changed set as (pk, old row, new row) index arrays over the sidecar
+    blocks, skipping Delta/KeyValue/DeltaDiff construction entirely (~6us
+    of object machinery per delta at 1M-changed scale). The fused
+    json-lines writer streams blob data for these rows through the native
+    batch inflate and serialises in place — the "fused materialisation"
+    pipeline. Row order is sorted-by-pk, identical to the delta path's
+    ``sorted_items``.
+
+    -> {"count": m, "pks" int64 (m,), "old_rows"/"new_rows" int64 (m,)
+    (row index into the block, -1 for the absent side), "old_block"/
+    "new_block", "base_ds"/"target_ds"}, or None when the columnar route
+    can't serve it with delta-path parity (dataset added/removed,
+    hash-keyed identities, missing sidecars, or the engine forced to the
+    tree walk)."""
+    import os
+
+    from kart_tpu.diff import sidecar
+
+    if os.environ.get("KART_DIFF_ENGINE", "auto") == "tree":
+        return None
+    base_ds = base_rs.datasets.get(ds_path) if base_rs is not None else None
+    target_ds = target_rs.datasets.get(ds_path) if target_rs is not None else None
+    if base_ds is None or target_ds is None:
+        return None
+    base_tree = base_ds.feature_tree
+    target_tree = target_ds.feature_tree
+    if (base_tree.oid if base_tree is not None else None) == (
+        target_tree.oid if target_tree is not None else None
+    ):
+        return {"count": 0}
+    for ds in (base_ds, target_ds):
+        enc = getattr(ds, "path_encoder", None)
+        if enc is None or enc.scheme != "int":
+            return None  # hash-keyed: collision guards need the delta path
+    repo = base_ds.repo or target_ds.repo
+    if repo is None:
+        return None
+    if not (sidecar.has_sidecar(repo, base_ds) and sidecar.has_sidecar(repo, target_ds)):
+        return None
+    old_block = sidecar.load_block(repo, base_ds, pad=False)
+    new_block = sidecar.load_block(repo, target_ds, pad=False)
+    if old_block is None or new_block is None:
+        return None
+
+    from kart_tpu.ops.diff_kernel import changed_indices, classify_blocks
+    from kart_tpu.parallel.sharded_diff import classify_blocks_sharded, should_shard
+
+    if should_shard(max(old_block.count, new_block.count)):
+        old_class, new_class, _ = classify_blocks_sharded(old_block, new_block)
+    else:
+        old_class, new_class, _ = classify_blocks(old_block, new_block)
+    old_idx, new_idx = changed_indices(old_class, new_class)
+    okeys = np.asarray(old_block.keys[old_idx])
+    nkeys = np.asarray(new_block.keys[new_idx])
+    pks = np.union1d(okeys, nkeys)
+    m = len(pks)
+
+    def side_rows(side_keys, side_idx):
+        rows = np.full(m, -1, dtype=np.int64)
+        if len(side_keys):
+            pos = np.searchsorted(side_keys, pks)
+            posc = np.minimum(pos, len(side_keys) - 1)
+            has = (pos < len(side_keys)) & (side_keys[posc] == pks)
+            rows[has] = side_idx[posc[has]]
+        return rows
+
+    return {
+        "count": m,
+        "pks": pks,
+        "old_rows": side_rows(okeys, old_idx),
+        "new_rows": side_rows(nkeys, new_idx),
+        "old_block": old_block,
+        "new_block": new_block,
+        "base_ds": base_ds,
+        "target_ds": target_ds,
+    }
 
 
 def get_meta_diff(base_ds, target_ds, ds_filter=None):

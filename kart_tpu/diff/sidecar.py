@@ -9,7 +9,8 @@ resets and clones — a tree oid never changes meaning. Files:
 
     magic   b"KCOL1\\n"
     header  one json line: {"count": N, "keys_are_pks": bool,
-                            "paths_bytes": M, "envelope_bytes": E}
+                            "paths_bytes": M, "envelope_bytes": E,
+                            "agg_block_rows": B}   (B only with aggregates)
     arrays  keys   int64[N]    (little-endian; pk, or filename-hash key)
             oids   uint8[N,20]
             offs   uint32[N+1]  (only when paths stored)
@@ -18,11 +19,26 @@ resets and clones — a tree oid never changes meaning. Files:
                                  wsen EPSG:4326 envelopes — feeds the
                                  spatially-filtered diff's bbox prefilter
                                  without touching blobs)
+            agg    float32[ceil(N/B),4]  (only when "agg_block_rows" in
+                                 header: per-block union wsen of the B-row
+                                 envelope blocks; wrapping members widened
+                                 to full longitude)
+            flags  uint8[ceil(N/B)]      (non-zero = aggregate not tight:
+                                 a wrapping / degenerate member — the block
+                                 may be all-out but never all-in)
 
 Arrays are stored *sorted by key* so loading skips the sort. Int-pk datasets
 don't store paths at all — the key IS the pk, and feature paths are
 recomputable from it; hash-keyed datasets keep paths for pk recovery of
 changed rows.
+
+The block-aggregate records let the spatially-filtered diff classify whole
+blocks as all-in / all-out / boundary against the filter rectangle and
+fine-scan only the boundary blocks (filter-refine, the structure of the
+reference's server-side subtree skip). Readers of pre-aggregate sidecars
+(no "agg_block_rows" header key) fall back to the full envelope scan;
+old readers ignore the trailing aggregate bytes — both directions stay
+compatible.
 
 A small LRU (by mtime) bounds the cache directory size.
 """
@@ -36,6 +52,58 @@ from kart_tpu.ops.blocks import FeatureBlock, bucket_size, PAD_KEY, hash_keys_fo
 
 MAGIC = b"KCOL1\n"
 MAX_CACHED_FILES = 64
+
+#: rows per envelope-aggregate block: small enough that boundary blocks'
+#: fine scans stay cheap (64KB of envelope data), large enough that the
+#: aggregate table is negligible (~0.4MB at 100M rows). 0 disables
+#: aggregate writing (produces the pre-aggregate format).
+AGG_BLOCK_ROWS = 4096
+
+
+def _block_aggregates(env_arr, block_rows, chunk_rows=4_194_304):
+    """(N,4) f32 envelopes -> ((nb,4) f32 union bboxes, (nb,) u8 flags).
+    A wrapping member (e < w) is widened to full longitude in the union and
+    flags its block (the union stays a correct superset, so all-out remains
+    valid, but all-in must not be claimed); degenerate (n < s) and
+    non-finite members flag the block too. A NaN member would poison the
+    min/max into a never-matching union (silent all-out drops of its whole
+    block), and the f32 and f64 scan formulas legitimately disagree on
+    NaN-field rows — so NaN members are widened to the full world: their
+    block is always boundary and the engine's own row scan decides, keeping
+    pruned == unpruned within every engine by construction. +-inf members
+    stay in the union (min/max and the all-out lat compares remain correct
+    through them; the classify guards the lon math behind finiteness).
+    Chunked so the transient copy stays bounded at 100M-row scale."""
+    n = len(env_arr)
+    nb = -(-n // block_rows)
+    agg = np.empty((nb, 4), dtype=np.float32)
+    flags = np.zeros(nb, dtype=np.uint8)
+    chunk_blocks = max(1, chunk_rows // block_rows)
+    for b0 in range(0, nb, chunk_blocks):
+        b1 = min(b0 + chunk_blocks, nb)
+        lo, hi = b0 * block_rows, min(b1 * block_rows, n)
+        m = hi - lo
+        pad = np.empty(((b1 - b0) * block_rows, 4), dtype=np.float32)
+        pad[:m] = env_arr[lo:hi]
+        pad[m:] = (np.inf, np.inf, -np.inf, -np.inf)  # neutral for min/max
+        wraps = pad[:m, 2] < pad[:m, 0]
+        degen = pad[:m, 3] < pad[:m, 1]
+        nonfin = ~np.isfinite(pad[:m]).all(axis=1)
+        if wraps.any():
+            pad[:m, 0] = np.where(wraps, np.float32(-180.0), pad[:m, 0])
+            pad[:m, 2] = np.where(wraps, np.float32(180.0), pad[:m, 2])
+        nans = np.isnan(pad[:m]).any(axis=1)
+        if nans.any():
+            pad[:m][nans] = (-180.0, -90.0, 180.0, 90.0)
+        bad = wraps | degen | nonfin
+        if bad.any():
+            flags[b0 + np.unique(np.nonzero(bad)[0] // block_rows)] = 1
+        r = pad.reshape(b1 - b0, block_rows, 4)
+        agg[b0:b1, 0] = r[:, :, 0].min(axis=1)
+        agg[b0:b1, 1] = r[:, :, 1].min(axis=1)
+        agg[b0:b1, 2] = r[:, :, 2].max(axis=1)
+        agg[b0:b1, 3] = r[:, :, 3].max(axis=1)
+    return agg, flags
 
 
 def _cache_dir(repo):
@@ -102,19 +170,23 @@ def save_sidecar(repo, feature_tree_oid, keys, oids_u8, paths=None, envelopes=No
         )
         path_blob = b"".join(encoded)
     env_arr = None
+    agg = flags = None
     if envelopes is not None:
         env_arr = np.ascontiguousarray(
             np.asarray(envelopes)[order], dtype="<f4"
         )
+        if AGG_BLOCK_ROWS > 0 and len(env_arr):
+            agg, flags = _block_aggregates(env_arr, AGG_BLOCK_ROWS)
 
-    header = json.dumps(
-        {
-            "count": int(len(keys)),
-            "keys_are_pks": paths is None,
-            "paths_bytes": len(path_blob),
-            "envelope_bytes": int(env_arr.nbytes) if env_arr is not None else 0,
-        }
-    ).encode() + b"\n"
+    header_fields = {
+        "count": int(len(keys)),
+        "keys_are_pks": paths is None,
+        "paths_bytes": len(path_blob),
+        "envelope_bytes": int(env_arr.nbytes) if env_arr is not None else 0,
+    }
+    if agg is not None:
+        header_fields["agg_block_rows"] = AGG_BLOCK_ROWS
+    header = json.dumps(header_fields).encode() + b"\n"
 
     target = sidecar_file(repo, feature_tree_oid)
     tmp = target + f".tmp{os.getpid()}"
@@ -128,6 +200,9 @@ def save_sidecar(repo, feature_tree_oid, keys, oids_u8, paths=None, envelopes=No
             f.write(path_blob)
         if env_arr is not None:
             f.write(env_arr.tobytes())
+        if agg is not None:
+            f.write(np.ascontiguousarray(agg, dtype="<f4").tobytes())
+            f.write(flags.tobytes())
     os.replace(tmp, target)
     _evict(d)
     return target
@@ -185,10 +260,21 @@ def load_block(repo, dataset, pad=True):
             paths = LazyPaths(offs, data)
             pos += header["paths_bytes"]
         envelopes = None
+        env_blocks = None
         if header.get("envelope_bytes"):
             envelopes = np.frombuffer(
                 mm, dtype="<f4", count=4 * n, offset=pos
             ).reshape(n, 4)
+            pos += header["envelope_bytes"]
+            block_rows = header.get("agg_block_rows", 0)
+            if block_rows:
+                nb = -(-n // block_rows)
+                agg = np.frombuffer(
+                    mm, dtype="<f4", count=4 * nb, offset=pos
+                ).reshape(nb, 4)
+                pos += 16 * nb
+                flags = np.frombuffer(mm, dtype=np.uint8, count=nb, offset=pos)
+                env_blocks = (agg, flags, block_rows)
     except (IndexError, KeyError, ValueError):
         return None
 
@@ -198,7 +284,9 @@ def load_block(repo, dataset, pad=True):
             if n
             else np.zeros((0, 5), dtype=np.uint32)
         )
-        return FeatureBlock(keys, oid_rows, paths, n, envelopes=envelopes)
+        return FeatureBlock(
+            keys, oid_rows, paths, n, envelopes=envelopes, env_blocks=env_blocks
+        )
     # pad (copy — the kernel wants aligned padded arrays; the mmap'd
     # originals stay untouched for the path views)
     size = bucket_size(max(n, 1))
@@ -207,10 +295,12 @@ def load_block(repo, dataset, pad=True):
     oids_p = np.zeros((size, 5), dtype=np.uint32)
     if n:
         oids_p[:n] = oids_u8.reshape(n, 5, 4).view(np.uint32).reshape(n, 5)
-    return FeatureBlock(keys_p, oids_p, paths, n, envelopes=envelopes)
+    return FeatureBlock(
+        keys_p, oids_p, paths, n, envelopes=envelopes, env_blocks=env_blocks
+    )
 
 
-def build_sidecar(repo, dataset):
+def build_sidecar(repo, dataset, pad=True):
     """Walk the feature tree once and persist its sidecar; -> FeatureBlock
     (the one-time O(N) cost the cache amortises away)."""
     feature_tree = dataset.feature_tree
@@ -222,14 +312,14 @@ def build_sidecar(repo, dataset):
     else:
         keys = hash_keys_for_paths(paths)
         save_sidecar(repo, feature_tree.oid, keys, oid_u8, paths=paths)
-    return load_block(repo, dataset)
+    return load_block(repo, dataset, pad=pad)
 
 
-def ensure_block(repo, dataset):
+def ensure_block(repo, dataset, pad=True):
     """Sidecar-backed FeatureBlock: load, or build-and-load on first use."""
-    block = load_block(repo, dataset)
+    block = load_block(repo, dataset, pad=pad)
     if block is None:
-        block = build_sidecar(repo, dataset)
+        block = build_sidecar(repo, dataset, pad=pad)
     return block
 
 

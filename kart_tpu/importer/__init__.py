@@ -449,6 +449,11 @@ class GPKGImportSource(ImportSource):
             self._K_PLAIN, self._K_GEOM, self._K_BOOL, self._K_FLOAT, self._K_TS,
         )
 
+        # per-phase accumulators for the import phase breakdown (read by
+        # the serial importer; the bench records them)
+        import time as _time
+
+        phases = self.phase_seconds = {"source_read": 0.0, "encode": 0.0}
         con = sqlite3.connect(self.gpkg_path)  # tuple rows: index access
         try:
             cursor = con.execute(
@@ -456,9 +461,12 @@ class GPKGImportSource(ImportSource):
             )
             cursor.arraysize = 10000
             while True:
+                t0 = _time.perf_counter()
                 rows = cursor.fetchmany()
+                phases["source_read"] += _time.perf_counter() - t0
                 if not rows:
                     break
+                t0 = _time.perf_counter()
                 pks = []
                 blobs = []
                 for row in rows:
@@ -484,6 +492,7 @@ class GPKGImportSource(ImportSource):
                     pks.append(row[pk_j])
                     blobs.append(packer.bytes())
                     packer.reset()
+                phases["encode"] += _time.perf_counter() - t0
                 yield pks, blobs
         finally:
             con.close()

@@ -27,9 +27,30 @@ BATCH_SIZE = 10000
 # the disk; above it, first-diff latency matters
 SIDECAR_MIN_FEATURES = 10000
 
+#: per-phase seconds of the most recent import in this process —
+#: {"source_read", "encode", "hash_deflate", "tree_build", "total"}.
+#: Populated by the serial streaming path (the bench's phase-breakdown
+#: record); the parallel fan-out interleaves phases across workers and
+#: reports only the total.
+LAST_IMPORT_PHASES = None
+
 
 class ImportError_(RuntimeError):
     pass
+
+
+def _timed_iter(it, phases, key="source_read"):
+    """Wrap an iterator, accumulating its pull time into ``phases[key]``."""
+    it = iter(it)
+    while True:
+        t0 = time.perf_counter()
+        try:
+            item = next(it)
+        except StopIteration:
+            phases[key] += time.perf_counter() - t0
+            return
+        phases[key] += time.perf_counter() - t0
+        yield item
 
 
 def import_sources(
@@ -71,6 +92,12 @@ def import_sources(
     ds_paths = []
     captures = {}
     total = 0
+    phases = {
+        "source_read": 0.0,
+        "encode": 0.0,
+        "hash_deflate": 0.0,
+        "tree_build": 0.0,
+    }
     t0 = time.monotonic()
     with repo.odb.bulk_pack():
         for source in sources:
@@ -99,12 +126,15 @@ def import_sources(
                 capture=capture,
                 replace_ids=replace_ids,
                 existing_ds=existing_ds,
+                phases=phases,
             )
             total += count
             ds_paths.append(ds_path)
             captures[ds_path] = (capture, existing_ds)
 
+        t_flush = time.perf_counter()
         new_tree = tb.flush()
+        phases["tree_build"] += time.perf_counter() - t_flush
 
     # commit + ref update only after the pack is durable (fsync'd) on disk:
     # a crash mid-import leaves an aborted tmp pack and an untouched HEAD,
@@ -146,8 +176,10 @@ def import_sources(
         if capture.count < SIDECAR_MIN_FEATURES:
             continue
         capture.save(repo, node.oid)
+    dt = time.monotonic() - t0
+    global LAST_IMPORT_PHASES
+    LAST_IMPORT_PHASES = {**phases, "total": dt}
     if log:
-        dt = time.monotonic() - t0
         rate = total / dt if dt > 0 else float("inf")
         log(f"Imported {total} features in {dt:.2f}s ({rate:.0f} features/s)")
     return commit_oid
@@ -244,9 +276,17 @@ def _import_replace_ids(
 
 def _import_single_source(
     repo, tb, source, ds_path, *, log=None, capture=None, replace_ids=None,
-    existing_ds=None,
+    existing_ds=None, phases=None,
 ):
     from kart_tpu.diff.sidecar import SidecarCapture
+
+    if phases is None:
+        phases = {
+            "source_read": 0.0,
+            "encode": 0.0,
+            "hash_deflate": 0.0,
+            "tree_build": 0.0,
+        }
 
     schema = source.schema
     encoder = encoder_for_schema(schema)
@@ -310,11 +350,17 @@ def _import_single_source(
     with paused_gc():
         gc_batch = 0
         if fast_batches is not None:
-            for pk_list, blobs in fast_batches:
+            # phase timing: the generator fuses source read + encode; its
+            # own phase_seconds split (the GPKG source keeps one) is folded
+            # in below — here the generator pull is accounted as encode
+            # and rebalanced from the source's accumulators afterwards
+            for pk_list, blobs in _timed_iter(fast_batches, phases, "encode"):
                 gc_batch += 1
                 if gc_batch % 100 == 0:
                     gc.collect()
+                t_hash = time.perf_counter()
                 oids_u8 = repo.odb.write_blobs_raw(blobs)
+                phases["hash_deflate"] += time.perf_counter() - t_hash
                 pks = np.asarray(pk_list, dtype=np.int64)
                 if collect_local:
                     pk_chunks.append(pks)
@@ -324,13 +370,22 @@ def _import_single_source(
                 count += len(pk_list)
                 if log and count % 100000 == 0:
                     log(f"  {ds_path}: {count} features...")
+            src_phases = getattr(source, "phase_seconds", None)
+            if src_phases:
+                read_s = min(src_phases.get("source_read", 0.0), phases["encode"])
+                phases["source_read"] += read_s
+                phases["encode"] -= read_s
         else:
-            for batch in chunked(source.features(), BATCH_SIZE):
+            for batch in chunked(_timed_iter(source.features(), phases), BATCH_SIZE):
                 gc_batch += 1
                 if gc_batch % 100 == 0:
                     gc.collect()
+                t_enc = time.perf_counter()
                 encoded = [schema.encode_feature_blob(f) for f in batch]
+                phases["encode"] += time.perf_counter() - t_enc
+                t_hash = time.perf_counter()
                 oids = repo.odb.write_blobs([blob for _, blob in encoded])
+                phases["hash_deflate"] += time.perf_counter() - t_hash
                 if use_batch_paths:
                     pks = np.fromiter(
                         (pk_values[0] for pk_values, _ in encoded),
@@ -386,12 +441,14 @@ def _import_single_source(
                     # against the live head in the columnar merge-join and
                     # surface as a spurious UPDATE
                     capture.replace_int_columns(pks_arr, oids_u8)
+        t_tree = time.perf_counter()
         ftree = build_int_feature_tree(repo.odb, pks_arr, oids_u8, encoder)
         tb.insert(
             f"{ds_path}/{Dataset3.DATASET_DIRNAME}/feature",
             ftree,
             mode=MODE_TREE,
         )
+        phases["tree_build"] += time.perf_counter() - t_tree
 
     # meta items that only exist after the feature stream has run (e.g.
     # generated-pks.json from PK synthesis)

@@ -84,6 +84,33 @@ class FeatureOidPromise:
         return self.ds.get_feature(self.pk_values, data=data)
 
 
+def _json_value_str(v, _float_repr=float.__repr__):
+    """One scalar -> its JSON text, byte-identical to the stdlib encoder
+    with ``separators=(",", ":"), ensure_ascii=True``. Exact-type checks:
+    bool is an int subclass and must not take the int branch. (The common
+    int/str/float cases are inlined in feature_json_str_from_data; this
+    covers the rest plus subclass oddities.)"""
+    t = v.__class__
+    if t is int:
+        return str(v)
+    if t is str:
+        from json.encoder import encode_basestring_ascii
+
+        return encode_basestring_ascii(v)
+    if t is float:
+        # json emits float.__repr__ for finite floats, names otherwise
+        if v == v and v not in (float("inf"), float("-inf")):
+            return _float_repr(v)
+        return "NaN" if v != v else ("Infinity" if v > 0 else "-Infinity")
+    if t is bool:
+        return "true" if v else "false"
+    if t is bytes:
+        return '"' + v.hex() + '"'
+    import json as _json
+
+    return _json.dumps(v, separators=(",", ":"), ensure_ascii=True)
+
+
 class DatasetCapabilityError(RuntimeError):
     """Dataset requires capabilities this version doesn't support
     (reference: dataset3.py:109-124)."""
@@ -372,6 +399,96 @@ class Dataset3:
                     v = v.hex()
             out[name] = v
         return out
+
+    def _jsonl_plan(self, legend_hash):
+        """Per-legend *serialise* plan for :meth:`feature_json_str_from_data`:
+        [(json member prefix '"name":' (',' -joined), source, is_geometry)].
+        Same column resolution as :meth:`_json_plan`, with the member names
+        pre-escaped so the hot loop only serialises values."""
+        from json.encoder import encode_basestring_ascii
+
+        plans = self.__dict__.setdefault("_jsonl_plans", {})
+        plan = plans.get(legend_hash)
+        if plan is None:
+            plan = []
+            for i, (name, src, is_geom) in enumerate(self._json_plan(legend_hash)):
+                prefix = ("" if i == 0 else ",") + encode_basestring_ascii(name) + ":"
+                plan.append((prefix, src, is_geom))
+            plans[legend_hash] = plan
+        return plan
+
+    def _jsonl_serializer(self, legend_hash):
+        """Per-legend *compiled* serialiser ``fn(pk_values, non_pk_values)
+        -> json object text``: the column plan unrolled into straight-line
+        code (no plan loop, no per-column tuple unpacks — ~30% of the
+        serialise wall at 1M-changed scale). Every embedded literal goes
+        through repr(), so arbitrary column names stay inert string
+        constants in the generated source."""
+        fns = self.__dict__.setdefault("_jsonl_fns", {})
+        fn = fns.get(legend_hash)
+        if fn is not None:
+            return fn
+        from json.encoder import encode_basestring_ascii
+
+        from kart_tpu.geometry import gpkg_hex_wkb
+
+        lines = [
+            "def _ser(pk, vals, _str=str, _esc=_esc, _fr=_fr, _hex=_hex, _jvs=_jvs):",
+            " np_ = len(pk)",
+            " nv_ = len(vals)",
+        ]
+        parts = []
+        for k, (prefix, src, is_geom) in enumerate(self._jsonl_plan(legend_hash)):
+            if src is None:
+                parts.append(repr(prefix + "null"))
+                continue
+            is_pk, i = src
+            seq, bound = ("pk", "np_") if is_pk else ("vals", "nv_")
+            lines.append(f" v{k} = {seq}[{i}] if {i} < {bound} else None")
+            if is_geom:
+                parts.append(
+                    f"({prefix!r} + ('null' if v{k} is None else"
+                    f" '\"' + _hex(v{k}) + '\"'))"
+                )
+            else:
+                parts.append(
+                    f"({prefix!r} + ('null' if v{k} is None else"
+                    f" _str(v{k}) if v{k}.__class__ is int else"
+                    f" _esc(v{k}) if v{k}.__class__ is str else"
+                    f" _fr(v{k}) if v{k}.__class__ is float"
+                    f" and v{k} == v{k} and -1e400 < v{k} < 1e400 else"
+                    f" _jvs(v{k})))"
+                )
+            # exact-type dispatch mirrors _json_value_str: bool (an int
+            # subclass), non-finite floats and exotic types all defer there
+        body = " + ".join(parts) if parts else "''"
+        lines.append(f" return '{{' + {body} + '}}'")
+        namespace = {
+            "_esc": encode_basestring_ascii,
+            "_fr": float.__repr__,
+            "_hex": gpkg_hex_wkb,
+            "_jvs": _json_value_str,
+        }
+        exec("\n".join(lines), namespace)
+        fn = namespace["_ser"]
+        fns[legend_hash] = fn
+        return fn
+
+    def feature_json_str_from_data(self, pk_values, data):
+        """Feature blob bytes -> the feature's compact-JSON object text,
+        byte-identical to JSON-encoding :meth:`feature_json_from_data`'s
+        dict with ``separators=(",", ":"), ensure_ascii=True`` (tested) —
+        but fused: one msgpack decode feeding the legend's compiled
+        serialiser directly, with no intermediate dict and no generic
+        encoder walk over it. This is the hot tail of full-output `diff -o
+        json-lines` (the per-feature dict round-trip was ~40% of the 49.6k
+        features/s materialisation wall at 10M-polygon scale)."""
+        legend_hash, non_pk_values = msg_unpack_ext_raw(data)
+        fns = self.__dict__.get("_jsonl_fns")
+        fn = fns.get(legend_hash) if fns is not None else None
+        if fn is None:
+            fn = self._jsonl_serializer(legend_hash)
+        return fn(pk_values, non_pk_values)
 
     def get_feature_from_oid(self, pk_values, oid_hex):
         """Feature dict resolved straight from its blob oid. The diff

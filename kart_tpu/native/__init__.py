@@ -18,7 +18,7 @@ L = logging.getLogger(__name__)
 
 _NATIVE_DIR = os.path.join(os.path.dirname(__file__), "..", "..", "native")
 _LIB_NAME = "libkart_sf.so"
-_ABI_VERSION = 1
+_ABI_VERSION = 2  # v2: sf_bbox_blocks_f32
 
 _lib = None
 _load_attempted = False
@@ -77,6 +77,21 @@ def _autobuild():
     _run_make()
 
 
+def _load_rebuilt(path):
+    """CDLL the freshly-rebuilt library at ``path``. dlopen caches handles
+    by *pathname* (glibc compares l_name), so re-CDLLing the original path
+    after a temp+rename rebuild returns the stale in-process mapping — the
+    new inode must be loaded through a one-off pathname. The copy is left
+    for the OS tmp reaper: it cannot be unlinked while mapped."""
+    import shutil
+    import tempfile
+
+    d = tempfile.mkdtemp(prefix="kart-native-")
+    fresh = os.path.join(d, os.path.basename(path))
+    shutil.copy2(path, fresh)
+    return ctypes.CDLL(fresh)
+
+
 def load():
     """-> configured ctypes.CDLL, or None when unavailable."""
     global _lib, _load_attempted
@@ -92,8 +107,15 @@ def load():
         lib = ctypes.CDLL(path)
         lib.sf_abi_version.restype = ctypes.c_int
         if lib.sf_abi_version() != _ABI_VERSION:
-            L.warning("native lib %s has wrong ABI version; ignoring", path)
-            return None
+            # stale build from an older checkout: rebuild, then load the
+            # new inode through a fresh pathname (see _load_rebuilt)
+            L.warning("native lib %s has stale ABI; rebuilding", path)
+            if os.environ.get("KART_TPU_NATIVE_LIB") or not _run_make():
+                return None
+            lib = _load_rebuilt(path)
+            lib.sf_abi_version.restype = ctypes.c_int
+            if lib.sf_abi_version() != _ABI_VERSION:
+                return None
         lib.sf_decode_envelopes.argtypes = [
             ctypes.c_void_p,
             ctypes.c_int64,
@@ -121,6 +143,18 @@ def load():
                 ctypes.c_void_p,
             ]
             lib.sf_bbox_intersects_f32.restype = ctypes.c_int64
+        if hasattr(lib, "sf_bbox_blocks_f32"):
+            lib.sf_bbox_blocks_f32.argtypes = [
+                ctypes.c_void_p,
+                ctypes.c_int64,
+                ctypes.c_void_p,
+                ctypes.c_void_p,
+                ctypes.c_int64,
+                ctypes.c_int64,
+                ctypes.c_void_p,
+                ctypes.c_void_p,
+            ]
+            lib.sf_bbox_blocks_f32.restype = ctypes.c_int64
         _lib = lib
     except (OSError, AttributeError) as e:
         # AttributeError: a stale/foreign .so without the expected symbols
@@ -171,13 +205,13 @@ def load_io():
         lib = ctypes.CDLL(path)
         lib.io_abi_version.restype = ctypes.c_int
         if lib.io_abi_version() != _IO_ABI_VERSION:
-            # a stale build from an older checkout: rebuild in place (the
-            # Makefile links via temp+rename, so this dlopen picks up the
-            # fresh inode) rather than silently dropping every native path
+            # a stale build from an older checkout: rebuild, then load the
+            # new inode through a fresh pathname (see _load_rebuilt —
+            # re-CDLLing the same path returns the stale cached mapping)
             L.warning("native IO lib %s has stale ABI; rebuilding", path)
             if override or not _run_make():
                 return None
-            lib = ctypes.CDLL(path)
+            lib = _load_rebuilt(path)
             lib.io_abi_version.restype = ctypes.c_int
             if lib.io_abi_version() != _IO_ABI_VERSION:
                 return None
@@ -498,3 +532,29 @@ def bbox_intersects_f32(envelopes_f32, query_wsen):
         )
         return out.view(bool)  # 0/1 bytes: reinterpret, no copy
     return bbox_intersects(np.asarray(envelopes_f32, dtype=np.float64), query)
+
+
+def bbox_blocks_f32(envelopes_f32, agg_f32, flags_u8, block_rows, query_wsen):
+    """Block-pruned f32 scan: (N, 4) float32 envelopes + their (nb, 4)
+    float32 block aggregates / nb flag bytes (sidecar block-aggregate
+    records) + query -> bool (N,). All-out blocks are classified from the
+    aggregate alone — their envelope pages are never read. Bit-identical to
+    :func:`bbox_intersects_f32` over the same rows (fuzz-tested); falls back
+    to the numpy block scan, then to the unpruned scan."""
+    query = np.asarray(query_wsen, dtype=np.float64)
+    lib = load()
+    if lib is not None and hasattr(lib, "sf_bbox_blocks_f32"):
+        env = np.ascontiguousarray(envelopes_f32, dtype=np.float32)
+        agg = np.ascontiguousarray(agg_f32, dtype=np.float32)
+        flags = np.ascontiguousarray(flags_u8, dtype=np.uint8)
+        n = env.shape[0]
+        out = np.empty(n, dtype=np.uint8)
+        rc = lib.sf_bbox_blocks_f32(
+            env.ctypes.data, n, agg.ctypes.data, flags.ctypes.data,
+            agg.shape[0], int(block_rows), query.ctypes.data, out.ctypes.data,
+        )
+        if rc >= 0:
+            return out.view(bool)
+    from kart_tpu.ops.bbox import bbox_blocks_np
+
+    return bbox_blocks_np(envelopes_f32, agg_f32, flags_u8, block_rows, query)

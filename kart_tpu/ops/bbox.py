@@ -49,6 +49,65 @@ def bbox_intersects_np(envelopes, query):
     return lat_ok & lon_ok
 
 
+#: block classes for the pruned scan (mirrors classify_block in
+#: native/spatial_filter.cpp)
+BLOCK_ALL_OUT, BLOCK_ALL_IN, BLOCK_BOUNDARY = 0, 1, 2
+
+
+def classify_env_blocks_np(agg, flags, query):
+    """Sidecar block aggregates (nb,4) f32 union bboxes + nb flag bytes +
+    query (4,) -> int8 (nb,) of BLOCK_* classes. numpy twin of the native
+    classify_block: all-out when the union bbox misses the query (no member
+    can intersect), all-in when it is contained in the query and the
+    aggregate is tight (flags == 0), boundary otherwise."""
+    agg = np.asarray(agg, dtype=np.float64)
+    w, s, e, n = (agg[:, i] for i in range(4))
+    qw, qs, qe, qn = (float(query[i]) for i in range(4))
+    # the cyclic lon math is NaN on non-finite bounds (mod(inf) = nan): a
+    # non-finite union (an inf member widened the block) is boundary unless
+    # the latitude compares — well-defined for +-inf — already rule it out
+    lon_finite = np.isfinite(w) & np.isfinite(e)
+    with np.errstate(invalid="ignore"):
+        lon_out = ~_cyclic_overlap_np(w, e, np.float64(qw), np.float64(qe))
+        if qe >= qw:
+            lon_in = (w >= qw) & (e <= qe)
+        else:  # wrapping query: contained in [qw, 180] or [-180, qe]
+            lon_in = (w >= qw) | (e <= qe)
+    out = (n < qs) | (s > qn) | (lon_finite & lon_out)
+    all_in = (
+        ~out
+        & (np.asarray(flags) == 0)
+        & lon_finite
+        & np.isfinite(s)
+        & np.isfinite(n)
+        & (s >= qs)
+        & (n <= qn)
+        & lon_in
+    )
+    cls = np.full(len(agg), BLOCK_BOUNDARY, dtype=np.int8)
+    cls[out] = BLOCK_ALL_OUT
+    cls[all_in] = BLOCK_ALL_IN
+    return cls
+
+
+def bbox_blocks_np(envelopes, agg, flags, block_rows, query):
+    """numpy twin of the native sf_bbox_blocks_f32: classify blocks from
+    their aggregates, fine-scan only boundary blocks. Bit-identical to
+    bbox_intersects_np over the f32 envelopes."""
+    n = len(envelopes)
+    block_rows = int(block_rows)
+    cls = classify_env_blocks_np(agg, flags, query)
+    out = np.zeros(n, dtype=bool)
+    for b in np.nonzero(cls != BLOCK_ALL_OUT)[0]:
+        lo = int(b) * block_rows
+        hi = min(lo + block_rows, n)
+        if cls[b] == BLOCK_ALL_IN:
+            out[lo:hi] = True
+        else:
+            out[lo:hi] = bbox_intersects_np(envelopes[lo:hi], query)
+    return out
+
+
 def _bbox_intersects_jnp_core(w, s, e, n, query):
     """Columns (N,) f32 + query (4,) -> bool (N,). XLA path."""
     import jax.numpy as jnp

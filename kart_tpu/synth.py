@@ -110,19 +110,71 @@ def _real_oids(odb, pks, batch=1_000_000):
     return out
 
 
-def synth_envelopes(pks):
+def synth_envelopes(pks, span=None, base=None):
     """Deterministic per-pk wsen EPSG:4326 envelopes (float32 (N,4)): small
-    boxes spread quasi-uniformly over the globe via the golden-ratio
-    low-discrepancy sequence — a w,s,e,n rectangle query therefore selects
-    ~(area fraction) of the features, like a real OSM-nodes layer would."""
-    pks = np.asarray(pks, dtype=np.float64)
-    lon = np.mod(pks * 137.50776405003785, 360.0) - 180.0
-    lat = np.mod(pks * 78.61969413885086, 170.0) - 85.0
+    boxes laid out like a real OSM-nodes import — consecutive pks sweep
+    longitude within a latitude band, bands stack south-to-north, with a
+    golden-ratio lat jitter inside each band. The layout covers the globe
+    (a w,s,e,n rectangle query still selects ~(area fraction) of the
+    features) while keeping pk-contiguous runs spatially tight, the
+    locality real node-id assignment exhibits and the sidecar's block
+    aggregates exist to exploit. ``span``/``base`` describe the full pk
+    range (default: inferred from ``pks``) — pass both when generating a
+    subset so its rows land exactly where full-set generation puts them."""
+    pks = np.asarray(pks, dtype=np.int64)
+    if not len(pks):
+        return np.empty((0, 4), dtype=np.float32)
+    if base is None:
+        base = int(pks.min())
+    idx = (pks - base).astype(np.float64)
+    if span is None:
+        span = float(idx.max()) + 1.0
+    span = max(float(span), 1.0)
+    n_bands = max(1, int(round((span / 4096.0) ** 0.5)))
+    rows_per_band = span / n_bands
+    band = np.minimum(np.floor(idx / rows_per_band), n_bands - 1)
+    lon = -180.0 + 360.0 * (idx - band * rows_per_band) / rows_per_band
+    band_h = 170.0 / n_bands
+    jitter = (np.mod(idx * 0.6180339887498949, 1.0) - 0.5) * (band_h * 0.9)
+    lat = -85.0 + band_h * (band + 0.5) + jitter
     out = np.empty((len(pks), 4), dtype=np.float32)
     out[:, 0] = lon
     out[:, 1] = lat
     out[:, 2] = lon + 0.001
     out[:, 3] = lat + 0.001
+    return out
+
+
+def _changed_row_oids(odb, sel_pks, ratings, schema, geom_xy=None,
+                      batch=200_000):
+    """Write real feature blobs for a selection of rows; -> (n, 20) oids.
+    geom_xy: optional (lon, lat) column pair for spatial schemas."""
+    import struct
+
+    from kart_tpu.geometry import Geometry
+
+    out = np.empty((len(sel_pks), 20), dtype=np.uint8)
+    for i in range(0, len(sel_pks), batch):
+        sl = slice(i, min(i + batch, len(sel_pks)))
+        contents = []
+        if geom_xy is None:
+            for pk, r in zip(sel_pks[sl].tolist(), ratings[sl].tolist()):
+                contents.append(
+                    schema.encode_feature_blob({"fid": pk, "rating": r})[1]
+                )
+        else:
+            xs, ys = geom_xy
+            for pk, r, x, y in zip(
+                sel_pks[sl].tolist(), ratings[sl].tolist(),
+                xs[sl].tolist(), ys[sl].tolist(),
+            ):
+                geom = Geometry.from_wkb(struct.pack("<BIdd", 1, 1, x, y))
+                contents.append(
+                    schema.encode_feature_blob(
+                        {"fid": pk, "geom": geom, "rating": r}
+                    )[1]
+                )
+        out[sl] = odb.write_blobs_raw(contents)
     return out
 
 
@@ -132,10 +184,15 @@ def synth_repo(path, n, *, edit_frac=0.01, seed=0, blobs="promised",
     and two commits: the base import and an ``edit_frac`` oid-rewrite.
     -> (repo, dict with commit oids + edit count).
 
+    Blob modes: "real" writes every feature blob; "promised" writes none
+    (partial-clone state); "changed" writes real blobs for the edited rows
+    only, in both revisions — exactly the set a full-output diff
+    materialises, at 1/100th of the blob-write cost at 1% edit fraction.
+
     spatial=True adds a geometry column to the schema and writes
     per-feature envelope columns (:func:`synth_envelopes`) into the
     sidecars — the spatially-filtered diff's prefilter input (BASELINE
-    config #4; blob values stay promised)."""
+    config #4)."""
     from kart_tpu.core.repo import KartRepo
     from kart_tpu.diff import sidecar
     from kart_tpu.models.dataset import Dataset3
@@ -148,6 +205,19 @@ def synth_repo(path, n, *, edit_frac=0.01, seed=0, blobs="promised",
 
     base = 1 << 24  # keeps every filename the same width (uint32 msgpack)
     pks = np.arange(base, base + n, dtype=np.int64)
+
+    schema = SYNTH_SCHEMA
+    crs_defs = None
+    envelopes = None
+    if spatial:
+        assert blobs in ("promised", "changed"), (
+            "spatial synth supports promised/changed blobs only"
+        )
+        schema = SYNTH_SPATIAL_SCHEMA
+        from kart_tpu.epsg import epsg_wkt
+
+        crs_defs = {"EPSG:4326": epsg_wkt(4326)}
+        envelopes = synth_envelopes(pks)
 
     if blobs == "real":
         with odb.bulk_pack(level=0):
@@ -173,19 +243,23 @@ def synth_repo(path, n, *, edit_frac=0.01, seed=0, blobs="promised",
             oids2[edit_rows] = np.frombuffer(
                 bytes.fromhex("".join(hexes)), dtype=np.uint8
             ).reshape(-1, 20)
+        elif blobs == "changed":
+            sel = pks[edit_rows]
+            geom_xy = None
+            if envelopes is not None:
+                geom_xy = (
+                    envelopes[edit_rows, 0].astype(np.float64),
+                    envelopes[edit_rows, 1].astype(np.float64),
+                )
+            with odb.bulk_pack(level=0):
+                oids1[edit_rows] = _changed_row_oids(
+                    odb, sel, sel / 2.0, schema, geom_xy
+                )
+                oids2[edit_rows] = _changed_row_oids(
+                    odb, sel, sel.astype(np.float64), schema, geom_xy
+                )
         else:
             oids2[edit_rows] = _synth_oids(edit_rows, seed + 2)
-
-    schema = SYNTH_SCHEMA
-    crs_defs = None
-    envelopes = None
-    if spatial:
-        assert blobs == "promised", "spatial synth supports promised blobs only"
-        schema = SYNTH_SPATIAL_SCHEMA
-        from kart_tpu.epsg import epsg_wkt
-
-        crs_defs = {"EPSG:4326": epsg_wkt(4326)}
-        envelopes = synth_envelopes(pks)
 
     plan = plan_int_feature_tree(pks)
     commits = []

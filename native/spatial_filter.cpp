@@ -72,6 +72,35 @@ inline bool intersects(const Envelope& env, const Envelope& q) {
   return cyclic_overlap(env.w, env.e, q.w, q.e);
 }
 
+// Block classification against the query for the pruned scan (the
+// filter-refine structure of the reference's server-side subtree skip,
+// vendor/spatial-filter/spatial_filter.cpp:212-260, applied to sidecar
+// blocks). agg is the union bbox of the block's member envelopes (wrapping
+// members were widened to full longitude at aggregation time); flags != 0
+// means the aggregate is not tight (wrapping / degenerate member) and
+// all-in must not be claimed.
+//   0 = all-out  (no member can intersect: union bbox misses the query)
+//   1 = all-in   (every member intersects: union bbox contained in query)
+//   2 = boundary (scan the rows)
+inline int classify_block(const float* agg, uint8_t flags, const Envelope& q) {
+  const double bw = agg[0], bs = agg[1], be = agg[2], bn = agg[3];
+  if (bn < q.s || bs > q.n) return 0;  // well-defined for +-inf too
+  // the cyclic lon math would hit NaN/UB on non-finite bounds (inf->int64
+  // cast); a non-finite union (an inf member widened the block) is simply
+  // boundary unless the latitude test above already ruled it out
+  if (std::isfinite(bw) && std::isfinite(be) &&
+      !cyclic_overlap(bw, be, q.w, q.e))
+    return 0;
+  if (flags) return 2;
+  if (!std::isfinite(bs) || !std::isfinite(bn) || bs < q.s || bn > q.n)
+    return 2;
+  const bool lon_in =
+      std::isfinite(bw) && std::isfinite(be) &&
+      ((q.e >= q.w) ? (bw >= q.w && be <= q.e)
+                    : (bw >= q.w || be <= q.e));  // in [qw,180] or [-180,qe]
+  return lon_in ? 1 : 2;
+}
+
 // largest float <= b / smallest float >= b (for exact f64-equivalent
 // comparisons done in pure f32)
 inline float largest_float_le(double b) {
@@ -86,12 +115,51 @@ inline float smallest_float_ge(double b) {
   return f;
 }
 
+// Exact f64-equivalent pure-f32 query thresholds for the branchless scan
+// (see sf_bbox_intersects_f32).
+struct QueryF32 {
+  float qw, qs, qe, qn;
+};
+
+inline QueryF32 make_query_f32(const Envelope& q) {
+  return QueryF32{smallest_float_ge(q.w), smallest_float_ge(q.s),
+                  largest_float_le(q.e), largest_float_le(q.n)};
+}
+
+// The f32 row scan both entry points share: branchless single pass for a
+// non-wrapping query, exact cyclic path otherwise. Returns the hit count.
+inline int64_t scan_rows_f32(const float* envelopes, int64_t n,
+                             const Envelope& q, bool q_wraps,
+                             const QueryF32& qf, uint8_t* out) {
+  int64_t hits = 0;
+  if (!q_wraps) {
+    for (int64_t j = 0; j < n; j++) {
+      const float* p = envelopes + j * 4;
+      const uint8_t lat = (p[1] <= qf.qn) & (qf.qs <= p[3]);
+      const uint8_t a = (p[0] <= qf.qe);
+      const uint8_t b = (qf.qw <= p[2]);
+      const uint8_t wrapb = (p[2] < p[0]);
+      out[j] = lat & ((a & b) | (wrapb & (a | b)));
+    }
+    for (int64_t j = 0; j < n; j++) hits += out[j];
+    return hits;
+  }
+  for (int64_t i = 0; i < n; i++) {
+    const float* p = envelopes + i * 4;
+    const bool hit = intersects(Envelope{p[0], p[1], p[2], p[3]}, q);
+    out[i] = hit ? 1 : 0;
+    hits += hit;
+  }
+  return hits;
+}
+
 }  // namespace
 
 extern "C" {
 
 // ABI version so the Python loader can refuse a stale library.
-int sf_abi_version() { return 1; }
+// v2: sf_bbox_blocks_f32 (block-pruned scan).
+int sf_abi_version() { return 2; }
 
 // Decode n packed 10-byte envelopes into (n,4) doubles (w,s,e,n rows).
 void sf_decode_envelopes(const uint8_t* packed, int64_t n, double* out) {
@@ -127,38 +195,50 @@ int64_t sf_bbox_intersects(const double* envelopes, int64_t n,
 __attribute__((target_clones("avx512f", "avx2", "default")))
 int64_t sf_bbox_intersects_f32(const float* envelopes, int64_t n,
                                const double* query, uint8_t* out) {
+  // Branchless single pass (scan_rows_f32). Exact f64-equivalent pure-f32
+  // thresholds: comparing a float x against a double bound b satisfies
+  // (double)x <= b  <=>  x <= B where B is the largest float <= b (and
+  // symmetrically for >=). Longitude: a non-wrapping envelope overlaps
+  // [qw, qe] iff (w <= qe) AND (qw <= e); a wrapping one ([w,180] u
+  // [-180,e]) iff (w <= qe) OR (qw <= e) — one predicate covers both:
+  // (A & B) | (wrap & (A | B)). Verified exactly equal to the cyclic
+  // f64 reference by the parity fuzz test.
+  Envelope q{query[0], query[1], query[2], query[3]};
+  return scan_rows_f32(envelopes, n, q, q.e < q.w, make_query_f32(q), out);
+}
+
+// Block-pruned variant: classify each block's envelope aggregate against
+// the query first, so the branchless row scan only touches boundary blocks
+// — all-out blocks write zeros without reading a single envelope (their
+// mmap'd pages are never faulted in), all-in blocks write ones. agg is
+// (nb, 4) f32 union bboxes, flags nb bytes (non-zero = all-in disabled),
+// block i covering rows [i*block_rows, min((i+1)*block_rows, n)). Bitwise
+// identical to sf_bbox_intersects_f32 over the same rows (fuzz-tested).
+// Returns the hit count, or -1 on a shape mismatch.
+__attribute__((target_clones("avx512f", "avx2", "default")))
+int64_t sf_bbox_blocks_f32(const float* envelopes, int64_t n,
+                           const float* agg, const uint8_t* flags, int64_t nb,
+                           int64_t block_rows, const double* query,
+                           uint8_t* out) {
+  if (block_rows <= 0 || nb != (n + block_rows - 1) / block_rows) return -1;
   Envelope q{query[0], query[1], query[2], query[3]};
   const bool q_wraps = q.e < q.w;
+  const QueryF32 qf = make_query_f32(q);
   int64_t hits = 0;
-  if (!q_wraps) {
-    // Branchless single pass. Exact f64-equivalent pure-f32 thresholds:
-    // comparing a float x against a double bound b satisfies
-    // (double)x <= b  <=>  x <= B where B is the largest float <= b (and
-    // symmetrically for >=). Longitude: a non-wrapping envelope overlaps
-    // [qw, qe] iff (w <= qe) AND (qw <= e); a wrapping one ([w,180] u
-    // [-180,e]) iff (w <= qe) OR (qw <= e) — one predicate covers both:
-    // (A & B) | (wrap & (A | B)). Verified exactly equal to the cyclic
-    // f64 reference by the parity fuzz test.
-    const float qe32 = largest_float_le(q.e);
-    const float qn32 = largest_float_le(q.n);
-    const float qw32 = smallest_float_ge(q.w);
-    const float qs32 = smallest_float_ge(q.s);
-    for (int64_t j = 0; j < n; j++) {
-      const float* p = envelopes + j * 4;
-      const uint8_t lat = (p[1] <= qn32) & (qs32 <= p[3]);
-      const uint8_t a = (p[0] <= qe32);
-      const uint8_t b = (qw32 <= p[2]);
-      const uint8_t wrapb = (p[2] < p[0]);
-      out[j] = lat & ((a & b) | (wrapb & (a | b)));
+  for (int64_t b = 0; b < nb; b++) {
+    const int64_t lo = b * block_rows;
+    const int64_t len = (lo + block_rows <= n) ? block_rows : n - lo;
+    switch (classify_block(agg + b * 4, flags[b], q)) {
+      case 0:
+        memset(out + lo, 0, len);
+        break;
+      case 1:
+        memset(out + lo, 1, len);
+        hits += len;
+        break;
+      default:
+        hits += scan_rows_f32(envelopes + lo * 4, len, q, q_wraps, qf, out + lo);
     }
-    for (int64_t j = 0; j < n; j++) hits += out[j];
-    return hits;
-  }
-  for (int64_t i = 0; i < n; i++) {
-    const float* p = envelopes + i * 4;
-    const bool hit = intersects(Envelope{p[0], p[1], p[2], p[3]}, q);
-    out[i] = hit ? 1 : 0;
-    hits += hit;
   }
   return hits;
 }

@@ -1,0 +1,62 @@
+"""Bench-schema guard (ISSUE 1 satellite, tier-1): every BENCH_r*.json key
+the ROADMAP/VERDICT record cites must still be emitted by `python bench.py`
+— plus this round's new keys — so headline numbers can't silently drop out
+of the record. Static check: bench.py writes every key as a string literal,
+so a missing literal means the metric was dropped or renamed."""
+
+import glob
+import json
+import os
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+#: keys added by ISSUE 1 (block-pruned spatial diffs + fused
+#: materialisation + satellite measurements)
+NEW_KEYS = [
+    "cli_100m_fulldiff_seconds",
+    "cli_100m_fulldiff_cold_seconds",
+    "cli_100m_fulldiff_rows_materialised",
+    "cli_100m_spatial_unpruned_seconds",
+    "cli_100m_spatial_output_matches_unpruned",
+    "bbox_f32_envelopes_per_sec",
+    "bbox_f32_seconds",
+    "bbox_f32_vs_numpy",
+    "bbox_packed_seconds",
+    "bbox_f32_vs_packed",
+    "wc_checkout_seconds",
+    "wc_checkout_features_per_sec",
+    "wc_reset_seconds",
+    "reference_checkout_rate",
+    "wc_checkout_vs_reference",
+    "import_phase_source_read_seconds",
+    "import_phase_encode_seconds",
+    "import_phase_hash_deflate_seconds",
+    "import_phase_tree_build_seconds",
+    "import_serial_seconds",
+]
+
+
+def test_bench_emits_every_recorded_key():
+    with open(os.path.join(REPO_ROOT, "bench.py")) as f:
+        src = f.read()
+
+    records = sorted(glob.glob(os.path.join(REPO_ROOT, "BENCH_r*.json")))
+    assert records, "no BENCH_r*.json records found"
+    with open(records[-1]) as f:
+        latest = json.load(f)
+    cited = set(latest.get("parsed", {})) | set(NEW_KEYS)
+
+    missing = sorted(k for k in cited if f'"{k}"' not in src)
+    assert not missing, (
+        f"bench.py no longer emits recorded metric keys: {missing} — "
+        "headline numbers must not silently drop out of the record"
+    )
+
+
+def test_new_keys_not_yet_in_old_records_is_ok():
+    """The guard list itself stays valid: every NEW_KEY literal exists in
+    bench.py (catches typos in this test's own list)."""
+    with open(os.path.join(REPO_ROOT, "bench.py")) as f:
+        src = f.read()
+    missing = sorted(k for k in NEW_KEYS if f'"{k}"' not in src)
+    assert not missing, missing

@@ -1,0 +1,275 @@
+"""Block-pruned spatial diffs (ISSUE 1 tentpole): the sidecar's per-block
+envelope aggregates must make the pruned scan bit-identical to the full
+branchless f32 residue scan — including anti-meridian-wrap members,
+wrapping queries, degenerate envelopes, and boundary-straddling blocks —
+and pre-aggregate (old-format) sidecars must keep diffing correctly via
+the full-scan fallback.
+"""
+
+import io
+import json
+
+import numpy as np
+import pytest
+
+from kart_tpu import native
+from kart_tpu.diff.sidecar import _block_aggregates
+from kart_tpu.ops.bbox import bbox_blocks_np, bbox_intersects_np
+
+
+def _random_envelopes(rng, n, *, wrap_frac=0.02, full_frac=0.01,
+                      degen_frac=0.005, nonfinite_frac=0.005):
+    env = np.empty((n, 4), np.float32)
+    env[:, 0] = rng.uniform(-180, 180, n)
+    env[:, 1] = rng.uniform(-90, 89, n)
+    env[:, 2] = env[:, 0] + rng.uniform(0, 3, n).astype(np.float32)
+    env[:, 3] = env[:, 1] + rng.uniform(0, 3, n).astype(np.float32)
+    # anti-meridian wrap: e < w, with both ends in-domain (needs w > -180)
+    wrap = (rng.random(n) < wrap_frac) & (env[:, 0] > -170)
+    env[wrap, 2] = rng.uniform(-180, env[wrap, 0] - 1).astype(np.float32)
+    full = rng.random(n) < full_frac  # NULL-geometry fail-open envelopes
+    env[full] = (-180, -90, 180, 90)
+    degen = rng.random(n) < degen_frac  # inverted lat: matches nothing
+    env[degen, 3] = env[degen, 1] - 1.0
+    # corrupt-geometry envelopes: a NaN field must not poison its block's
+    # aggregate into silent all-out drops of its neighbours
+    kind = rng.integers(0, 4, n)
+    for k, v in enumerate((np.nan, np.inf, -np.inf)):
+        sel = (rng.random(n) < nonfinite_frac) & (kind == k)
+        env[sel, rng.integers(0, 4)] = v
+    return env
+
+
+QUERIES = [
+    (-40.0, -20.0, -4.0, -3.0),  # region (the bench filter)
+    (170.0, -10.0, -170.0, 10.0),  # wrapping query across the anti-meridian
+    (-180.0, -90.0, 180.0, 90.0),  # whole world (every non-degenerate row)
+    (0.0, 0.0, 0.0, 0.0),  # degenerate point query
+    (-180.0001, -90.0, 180.0001, 90.0),  # padded past the lon range
+    (100.0, -42.0, 106.0, -39.0),  # small box
+]
+
+
+@pytest.mark.parametrize("block_rows", [7, 64, 4096])
+def test_pruned_scan_parity_fuzz(block_rows):
+    """Each engine's block-pruned scan is bit-identical to its own unpruned
+    scan for randomized envelope sets and query shapes — the contract the
+    filtered diff relies on (the engine uses one scan implementation
+    consistently). On NaN-free rows both engines also agree with each
+    other; NaN-field rows are where the f32 and f64 formulas legitimately
+    differ, which is why NaN members force their block to boundary."""
+    rng = np.random.default_rng(block_rows)
+    env = _random_envelopes(rng, 20_000)
+    agg, flags = _block_aggregates(env, block_rows)
+    # non-finite fields are where the f32 and f64 formulas legitimately
+    # disagree (pre-existing, full scans included) — the cross-engine
+    # agreement claim only holds for finite rows
+    finite_rows = np.isfinite(env).all(axis=1)
+    for q in QUERIES:
+        q = np.asarray(q, np.float64)
+        with np.errstate(invalid="ignore"):
+            ref_np = bbox_intersects_np(env.astype(np.float64), q)
+            got_np = bbox_blocks_np(env, agg, flags, block_rows, q)
+        assert (got_np == ref_np).all(), q
+        ref_f32 = np.asarray(native.bbox_intersects_f32(env, q))
+        got_native = np.asarray(
+            native.bbox_blocks_f32(env, agg, flags, block_rows, q)
+        )
+        assert (got_native == ref_f32).all(), q
+        # cross-engine agreement wherever no field is NaN
+        assert (got_native[finite_rows] == ref_np[finite_rows]).all(), q
+
+
+def test_aggregates_are_supersets():
+    """Every member envelope's lat range is inside its block aggregate, and
+    unflagged blocks' lon ranges too (the all-in/all-out soundness basis).
+    NaN members are excluded from the union (they can never match a query)
+    but must flag the block; any non-finite member flags it too."""
+    rng = np.random.default_rng(7)
+    env = _random_envelopes(rng, 5_000)
+    block_rows = 32
+    agg, flags = _block_aggregates(env, block_rows)
+    for b in range(len(agg)):
+        lo, hi = b * block_rows, min((b + 1) * block_rows, len(env))
+        sl = env[lo:hi]
+        ok = ~np.isnan(sl).any(axis=1)
+        if ok.any():
+            assert agg[b, 1] <= sl[ok, 1].min()
+            assert agg[b, 3] >= sl[ok, 3].max()
+        wraps = sl[:, 2] < sl[:, 0]
+        degen = sl[:, 3] < sl[:, 1]
+        nonfin = ~np.isfinite(sl).all(axis=1)
+        if wraps.any() or degen.any() or nonfin.any():
+            assert flags[b] == 1
+        else:
+            assert flags[b] == 0
+            assert agg[b, 0] <= sl[:, 0].min()
+            assert agg[b, 2] >= sl[:, 2].max()
+
+
+class TestEndToEnd:
+    """Filtered diffs through the real engine + writers: pruned output must
+    be byte-identical to unpruned, and old-format sidecars must fall back."""
+
+    FILTER = (
+        "EPSG:4326;POLYGON((-60 -40, 30 -40, 30 20, -60 20, -60 -40))"
+    )
+
+    @pytest.fixture(scope="class")
+    def spatial_repo(self, tmp_path_factory):
+        from kart_tpu.diff import sidecar
+        from kart_tpu.synth import synth_repo
+
+        # small aggregate blocks so a 20k-row repo exercises many blocks
+        orig = sidecar.AGG_BLOCK_ROWS
+        sidecar.AGG_BLOCK_ROWS = 256
+        try:
+            path = tmp_path_factory.mktemp("prune") / "repo"
+            repo, info = synth_repo(
+                str(path), 20_000, edit_frac=0.05, spatial=True,
+                blobs="changed",
+            )
+        finally:
+            sidecar.AGG_BLOCK_ROWS = orig
+        return repo, info
+
+    def _set_filter(self, repo):
+        from kart_tpu.spatial_filter import ResolvedSpatialFilterSpec
+
+        spec = ResolvedSpatialFilterSpec.from_spec_string(self.FILTER)
+        repo.config.set_many(spec.config_items())
+        return spec
+
+    def _clear_filter(self, repo, spec):
+        for key in spec.config_items():
+            repo.del_config(key)
+
+    def _jsonl(self, repo):
+        from kart_tpu.diff.writers import JsonLinesDiffWriter
+
+        out = io.StringIO()
+        JsonLinesDiffWriter(repo, "HEAD^...HEAD", output_path=out).write_diff()
+        return out.getvalue()
+
+    def test_sidecar_has_aggregates(self, spatial_repo):
+        from kart_tpu.diff import sidecar
+
+        repo, _ = spatial_repo
+        ds = repo.structure("HEAD").datasets["synth"]
+        block = sidecar.load_block(repo, ds)
+        assert block.env_blocks is not None
+        agg, flags, block_rows = block.env_blocks
+        assert block_rows == 256
+        assert len(agg) == -(-block.count // 256)
+        assert len(flags) == len(agg)
+
+    def test_pruned_output_byte_identical(self, spatial_repo, monkeypatch):
+        repo, info = spatial_repo
+        spec = self._set_filter(repo)
+        try:
+            pruned = self._jsonl(repo)
+            monkeypatch.setenv("KART_BLOCK_PRUNE", "0")
+            unpruned = self._jsonl(repo)
+        finally:
+            self._clear_filter(repo, spec)
+        assert pruned == unpruned
+        # the filter covers (90/360)*(60/170) ~ 9% of the layer: real rows
+        # must stream, but far fewer than the whole changed set
+        n_lines = pruned.count("\n")
+        assert 1 < n_lines - 1 < info["n_edits"]
+
+    def test_filtered_count_matches_unpruned(self, spatial_repo, monkeypatch):
+        from kart_tpu.diff.engine import get_dataset_feature_count_fast
+        from kart_tpu.spatial_filter import ResolvedSpatialFilterSpec
+
+        repo, _ = spatial_repo
+        spec = ResolvedSpatialFilterSpec.from_spec_string(self.FILTER)
+        base_rs = repo.structure("HEAD^")
+        target_rs = repo.structure("HEAD")
+        pruned = get_dataset_feature_count_fast(
+            base_rs, target_rs, "synth", spatial_filter_spec=spec
+        )
+        monkeypatch.setenv("KART_BLOCK_PRUNE", "0")
+        unpruned = get_dataset_feature_count_fast(
+            base_rs, target_rs, "synth", spatial_filter_spec=spec
+        )
+        assert pruned == unpruned
+        assert pruned > 0
+
+    def test_old_format_sidecar_falls_back(self, spatial_repo, tmp_path):
+        """Sidecars written without aggregate records (the pre-ISSUE-1
+        format) still produce correct filtered diffs via the full scan."""
+        import numpy as np
+
+        from kart_tpu.diff import sidecar
+        from kart_tpu.synth import synth_envelopes
+
+        repo, info = spatial_repo
+        spec = self._set_filter(repo)
+        try:
+            with_agg = self._jsonl(repo)
+
+            # rewrite both sidecars in the old format (no aggregates)
+            base = 1 << 24
+            pks = np.arange(base, base + info["n"], dtype=np.int64)
+            envs = synth_envelopes(pks)
+            orig = sidecar.AGG_BLOCK_ROWS
+            sidecar.AGG_BLOCK_ROWS = 0
+            try:
+                for rev in ("HEAD^", "HEAD"):
+                    ds = repo.structure(rev).datasets["synth"]
+                    block = sidecar.load_block(repo, ds, pad=False)
+                    oids_u8 = (
+                        np.ascontiguousarray(block.oids)
+                        .view(np.uint8)
+                        .reshape(-1, 20)
+                    )
+                    sidecar.save_sidecar(
+                        repo, ds.feature_tree.oid, np.asarray(block.keys),
+                        oids_u8, envelopes=envs,
+                    )
+                    reloaded = sidecar.load_block(repo, ds)
+                    assert reloaded.env_blocks is None  # old format
+                    assert reloaded.envelopes is not None
+            finally:
+                sidecar.AGG_BLOCK_ROWS = orig
+            without_agg = self._jsonl(repo)
+        finally:
+            self._clear_filter(repo, spec)
+        assert with_agg == without_agg
+
+        # restore aggregate-carrying sidecars for other tests in the class
+        from kart_tpu.diff.sidecar import save_sidecar
+
+        orig = sidecar.AGG_BLOCK_ROWS
+        sidecar.AGG_BLOCK_ROWS = 256
+        try:
+            for rev in ("HEAD^", "HEAD"):
+                ds = repo.structure(rev).datasets["synth"]
+                block = sidecar.load_block(repo, ds, pad=False)
+                oids_u8 = (
+                    np.ascontiguousarray(block.oids).view(np.uint8).reshape(-1, 20)
+                )
+                save_sidecar(
+                    repo, ds.feature_tree.oid, np.asarray(block.keys),
+                    oids_u8, envelopes=envs,
+                )
+        finally:
+            sidecar.AGG_BLOCK_ROWS = orig
+
+
+def test_bbox_blocks_shape_mismatch_rejected():
+    """The native entry point refuses inconsistent (n, nb, block_rows)."""
+    env = np.zeros((10, 4), np.float32)
+    agg, flags = _block_aggregates(env, 4)
+    lib = native.load()
+    if lib is None or not hasattr(lib, "sf_bbox_blocks_f32"):
+        pytest.skip("native lib unavailable")
+    out = np.empty(10, np.uint8)
+    q = np.zeros(4, np.float64)
+    rc = lib.sf_bbox_blocks_f32(
+        np.ascontiguousarray(env).ctypes.data, 10,
+        np.ascontiguousarray(agg).ctypes.data, flags.ctypes.data,
+        len(agg) + 1, 4, q.ctypes.data, out.ctypes.data,
+    )
+    assert rc == -1
