@@ -268,6 +268,7 @@ def worker():
     merge = _merge_bench()
     bbox = _bbox_bench()
     est = _estimation_bench()
+    resume = _fetch_resume_bench()
 
     # The headline value is the rate of the engine `classify_blocks` would
     # actually route to on this backend (VERDICT r4 weak #5): the native
@@ -303,6 +304,7 @@ def worker():
         **merge,
         **bbox,
         **est,
+        **resume,
     }
     # the polygon and 100M sections are the long tail (synth + multi-minute
     # diffs): print the record BEFORE each so a watchdog timeout mid-section
@@ -465,6 +467,68 @@ def _bbox_bench():
         }
     except Exception as e:  # pragma: no cover - bench resilience
         print(f"bbox bench failed: {type(e).__name__}: {e}", file=sys.stderr)
+        return {}
+
+
+def _fetch_resume_bench():
+    """Fault-tolerant transport: kill an HTTP fetch mid-packstream
+    (KART_FAULTS) and measure the resume — wall-clock of the retried
+    fetch and how few objects it re-ships. The robustness analog of the
+    throughput benchmarks: a dropped 100M-object clone must cost a
+    remainder, not a restart. Returns {} on any failure."""
+    import sys
+    import tempfile
+    import threading
+
+    try:
+        rows = int(os.environ.get("KART_BENCH_FETCH_ROWS", 50_000))
+        if rows <= 0:
+            return {}
+        from kart_tpu.core.repo import KartRepo
+        from kart_tpu.synth import synth_repo
+        from kart_tpu.transport.http import HttpRemote, make_server
+        from kart_tpu.transport.retry import RetryPolicy
+
+        with tempfile.TemporaryDirectory() as td:
+            repo, _ = synth_repo(
+                os.path.join(td, "src"), rows, blobs="real", edit_frac=0.0
+            )
+            server = make_server(repo)
+            threading.Thread(target=server.serve_forever, daemon=True).start()
+            try:
+                url = f"http://127.0.0.1:{server.server_address[1]}/"
+                dst = KartRepo.init_repository(os.path.join(td, "dst"))
+                client = HttpRemote(url, retry=RetryPolicy(attempts=1))
+                info = client.ls_refs()
+                wants = list(info["heads"].values())
+
+                # kill the transfer halfway through the stream
+                os.environ["KART_FAULTS"] = f"transport.read.frame:{rows // 2}"
+                try:
+                    client.fetch_pack(dst, wants)
+                except Exception:
+                    pass
+                finally:
+                    os.environ.pop("KART_FAULTS", None)
+                salvaged = set(dst.odb.iter_oids())
+
+                t0 = time.perf_counter()
+                header = client.fetch_pack(dst, wants, exclude=salvaged)
+                resume_s = time.perf_counter() - t0
+                resent = header["object_count"]
+                total = len(salvaged) + resent
+                assert sum(1 for _ in dst.odb.iter_oids()) == total
+                return {
+                    "fetch_resume_seconds": round(resume_s, 3),
+                    "fetch_resume_objects_total": total,
+                    "fetch_resume_objects_salvaged": len(salvaged),
+                    "fetch_resume_objects_resent": resent,
+                }
+            finally:
+                server.shutdown()
+                server.server_close()
+    except Exception as e:
+        print(f"fetch-resume bench failed: {e}", file=sys.stderr)
         return {}
 
 

@@ -1,6 +1,8 @@
 """branch / tag / config / gc / fsck (reference: kart/branch.py plus git
 pass-through commands, kart/fsck.py)."""
 
+import os
+
 import click
 
 from kart_tpu.cli import CliError, cli
@@ -107,12 +109,15 @@ def config(ctx, key, value, unset):
     click.echo(current)
 
 
-@cli.command()
-@click.argument("args", nargs=-1)
+@cli.command(context_settings={"ignore_unknown_options": True})
+@click.argument("args", nargs=-1, type=click.UNPROCESSED)
 @click.pass_obj
 def gc(ctx, args):
-    """Clean up the object store: pack loose objects, prune temp files.
-    ``--auto`` only repacks above the loose-object threshold."""
+    """Clean up the object store: pack loose objects, sweep crash leftovers
+    (stale ``*.tmp``/``*.lock`` files, abandoned push quarantines).
+    ``--auto`` only repacks above the loose-object threshold; ``--grace=N``
+    sets the leftover age threshold in seconds (default 3600, env
+    KART_GC_GRACE); ``--prune-now`` sweeps leftovers regardless of age."""
     stats = ctx.repo.gc(*args)
     if stats and (stats.get("packed") or stats.get("pruned")):
         click.echo(
@@ -152,6 +157,20 @@ def fsck(ctx, reset_datasets):
     for ref, oid in repo.refs.iter_refs():
         if not repo.odb.contains(oid):
             errors.append(f"Ref {ref} points at missing object {oid}")
+
+    # crash leftovers: stale lock/temp files and abandoned push quarantines
+    # are debris, not corruption — report them (gc sweeps them)
+    click.echo("Checking for stale crash leftovers...")
+    stale = list(repo.find_stale_leftovers())
+    if stale:
+        click.echo(
+            f"  {len(stale)} stale lock/temp leftover(s) from a crashed "
+            f"process — run `kart gc` to sweep:"
+        )
+        for path in stale[:5]:
+            click.echo(f"    {os.path.relpath(path, repo.gitdir)}")
+        if len(stale) > 5:
+            click.echo(f"    ... and {len(stale) - 5} more")
 
     # dataset structure at HEAD
     if not repo.head_is_unborn:

@@ -93,9 +93,15 @@ def clone(url, directory, bare, depth, spatial_filter_spec, wc_location, branch,
 def fetch(ctx, remote, depth):
     """Download objects and refs from a remote repository."""
     from kart_tpu import transport
-    from kart_tpu.transport.remote import RemoteError
+    from kart_tpu.transport.remote import FETCH_RESUME_FILE, RemoteError
 
     repo = ctx.repo
+    if repo.read_gitdir_file(FETCH_RESUME_FILE) is not None:
+        click.echo(
+            "Resuming interrupted transfer (objects already received are "
+            "kept; only the remainder is fetched)...",
+            err=True,
+        )
     try:
         updated = transport.fetch(repo, remote, depth=depth)
     except RemoteError as e:

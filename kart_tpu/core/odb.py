@@ -18,6 +18,7 @@ from contextlib import contextmanager
 from enum import Enum
 from functools import lru_cache
 
+from kart_tpu import faults
 from kart_tpu.core.objects import (
     Commit,
     ObjectFormatError,
@@ -103,6 +104,7 @@ class ObjectDb:
                 w.abort()
                 raise
             self._bulk_writer = None
+            faults.fire("odb.bulk_pack")
             if w.finish() is not None:
                 self.packs.refresh()
 
@@ -235,6 +237,7 @@ class ObjectDb:
         return self.packs.read_blob_data_ordered(shas)
 
     def write_raw(self, obj_type, content) -> str:
+        faults.fire("odb.write_raw")
         if self._bulk_writer is not None:
             # duplicate objects across packs are legal (git semantics);
             # the writer dedupes within its own pack

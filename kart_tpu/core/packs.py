@@ -710,10 +710,18 @@ class PackWriter:
         if os.path.exists(self._tmp_path):
             os.remove(self._tmp_path)
 
+    @property
+    def object_count(self):
+        """Objects added so far (dedupes counted once)."""
+        return self._count
+
     def finish(self):
         """Patch the object count, append the pack trailer, write the idx.
         An empty writer aborts instead (no zero-object pack files).
         -> pack path, or None when empty."""
+        from kart_tpu import faults
+
+        faults.fire("pack.finalise")
         if not self._count:
             self.abort()
             return None
@@ -758,6 +766,10 @@ def write_pack_index(idx_path, entries, pack_sha):
     arrays (a 1M-object import pays ~0.3s here instead of ~3s of per-entry
     Python)."""
     import numpy as np
+
+    from kart_tpu import faults
+
+    faults.fire("idx.write")
 
     n = len(entries)
     shas = np.frombuffer(

@@ -158,7 +158,10 @@ class RefStore:
             for dirpath, dirnames, filenames in sorted(os.walk(base)):
                 dirnames.sort()
                 for fn in sorted(filenames):
-                    if fn.endswith((".lock", ".tmp")):
+                    # skip atomic-write debris, including the pid-suffixed
+                    # names this store writes (`x.lock1234`) — a crashed
+                    # update must not be misread as a ref named x.lock1234
+                    if re.search(r"\.(lock|tmp)\d*$", fn):
                         continue
                     full = os.path.join(dirpath, fn)
                     rel = os.path.relpath(full, self.gitdir).replace(os.sep, "/")

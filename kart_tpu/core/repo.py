@@ -503,22 +503,112 @@ class KartRepo:
     # `gc --auto` is a no-op
     GC_AUTO_LOOSE_THRESHOLD = 6700
 
-    def gc(self, *args):
-        """Pack loose objects into one packfile, then prune temp files —
-        the same effect as the reference's git gc over its ODB. ``--auto``
-        only repacks above git's default loose-object threshold.
-        -> {"packed": n, "pruned": n}."""
-        objects_dir = os.path.join(self.gitdir, "objects")
-        auto = "--auto" in args
-        pruned = 0
-        for dirpath, _, filenames in os.walk(objects_dir):
-            for fn in filenames:
-                if ".tmp" in fn:
+    # crash leftovers younger than this survive a sweep: a *.tmp pack an
+    # import is writing right now, a ref .lock mid-update, a push quarantine
+    # mid-migration all look identical to stale debris except by age
+    STALE_GRACE_SECONDS = 3600.0
+
+    # atomic-write temp names this codebase produces: loose-object
+    # `<name>.tmp<pid>`, idx `<name>.tmp<pid>`, ref/config `<name>.lock<pid>`,
+    # and PackWriter's mkstemp `.tmp-pack-*`
+    _STALE_FILE_RE = re.compile(r"(\.(tmp|lock)\d*$)|(^\.tmp-)")
+
+    def find_stale_leftovers(self, grace_seconds=None):
+        """Crash debris a dead process left behind, older than the grace
+        period: ``*.tmp<pid>`` / ``.tmp-pack-*`` files under ``objects/``,
+        ``*.lock<pid>`` files under ``refs/`` and the gitdir root, and
+        abandoned push quarantine dirs (``objects/quarantine/*``). Yields
+        absolute paths (files and directories)."""
+        import time as _time
+
+        if grace_seconds is None:
+            grace_seconds = self.STALE_GRACE_SECONDS
+        cutoff = _time.time() - grace_seconds
+
+        def old_enough(path):
+            try:
+                return os.lstat(path).st_mtime <= cutoff
+            except OSError:
+                return False  # vanished underneath us
+
+        def newest_mtime(root):
+            """Newest mtime anywhere under root — a quarantine is live as
+            long as the pack *streaming into it* keeps progressing, even if
+            the dir itself was created hours ago."""
+            newest = 0.0
+            for dirpath, _, filenames in os.walk(root):
+                for name in [os.curdir, *filenames]:
                     try:
-                        os.remove(os.path.join(dirpath, fn))
-                        pruned += 1
+                        newest = max(
+                            newest,
+                            os.lstat(os.path.join(dirpath, name)).st_mtime,
+                        )
                     except OSError:
                         pass
+            return newest
+
+        objects_dir = os.path.join(self.gitdir, "objects")
+        quarantine_dir = os.path.join(objects_dir, "quarantine")
+        if os.path.isdir(quarantine_dir):
+            for name in sorted(os.listdir(quarantine_dir)):
+                p = os.path.join(quarantine_dir, name)
+                if os.path.isdir(p) and newest_mtime(p) <= cutoff:
+                    yield p
+        roots = [objects_dir, os.path.join(self.gitdir, "refs")]
+        for root in roots:
+            for dirpath, dirnames, filenames in os.walk(root):
+                if dirpath.startswith(quarantine_dir):
+                    continue  # whole dirs handled above
+                for fn in sorted(filenames):
+                    if self._STALE_FILE_RE.search(fn):
+                        p = os.path.join(dirpath, fn)
+                        if old_enough(p):
+                            yield p
+        # gitdir root: config.lock<pid>, packed-refs.lock<pid>, ...
+        for fn in sorted(os.listdir(self.gitdir)):
+            p = os.path.join(self.gitdir, fn)
+            if os.path.isfile(p) and self._STALE_FILE_RE.search(fn):
+                if old_enough(p):
+                    yield p
+
+    def gc(self, *args, grace_seconds=None):
+        """Pack loose objects into one packfile, then sweep crash leftovers
+        (stale ``*.tmp``/``*.lock`` files and abandoned push quarantines) —
+        the same effect as the reference's git gc over its ODB. ``--auto``
+        only repacks above git's default loose-object threshold;
+        ``--grace=N`` (or env KART_GC_GRACE, seconds) bounds how recent a
+        leftover must be to survive, ``--prune-now`` sweeps regardless of
+        age. -> {"packed": n, "pruned": n}."""
+        import shutil
+
+        objects_dir = os.path.join(self.gitdir, "objects")
+        auto = "--auto" in args
+        if grace_seconds is None:
+            for a in args:
+                if isinstance(a, str) and a.startswith("--grace="):
+                    try:
+                        grace_seconds = float(a[len("--grace="):])
+                    except ValueError:
+                        pass
+            if "--prune-now" in args:
+                grace_seconds = 0.0
+        if grace_seconds is None:
+            env = os.environ.get("KART_GC_GRACE")
+            if env is not None:
+                try:
+                    grace_seconds = float(env)
+                except ValueError:
+                    pass
+        pruned = 0
+        for path in list(self.find_stale_leftovers(grace_seconds)):
+            try:
+                if os.path.isdir(path):
+                    shutil.rmtree(path, ignore_errors=True)
+                else:
+                    os.remove(path)
+                pruned += 1
+            except OSError:
+                pass
 
         loose = []
         for prefix in sorted(os.listdir(objects_dir)):

@@ -1,0 +1,109 @@
+"""Fault injection for crash/disconnect testing (``KART_FAULTS``).
+
+The transport and object-store layers call :func:`hook`/:func:`fire` at the
+points where a real deployment fails — a socket dropping mid-packstream, a
+process dying between a pack and its idx, a disk filling during a bulk
+write. Armed via the environment so the same switch reaches spawned servers
+(``kart serve``, ``ssh … kart serve-stdio``) without any plumbing:
+
+    KART_FAULTS=<point>:<n>[,<point>:<n>...]
+
+fires :class:`InjectedFault` on the *n*-th hit of ``<point>`` in this
+process (``<point>`` alone means the 1st hit). Each armed point fires
+**once** and then disarms, so a retry after the injected failure behaves
+exactly like a retry after a real transient failure — which is what the
+fault-matrix tests assert. Counters are per-process (a spawned server
+parses the spec afresh) and reset whenever the spec string changes.
+
+Registered points:
+
+    transport.read.frame    every record boundary in ``read_pack``
+    transport.write.frame   every record boundary in ``write_pack``
+    odb.write_raw           every ObjectDb.write_raw call
+    odb.bulk_pack           bulk_pack context exit, before the pack finalises
+    pack.finalise           PackWriter.finish entry (pack trailer/rename)
+    idx.write               write_pack_index entry (idx serialise/rename)
+
+Disabled (``KART_FAULTS`` unset) the fast path is a single environ dict
+lookup with no allocation: frame-boundary loops additionally hoist
+``hook(point)`` — which returns ``None`` when the point is unarmed —
+outside the loop, so the per-record cost there is one ``is None`` test;
+one-shot sites (``write_raw``, finalisers) just call :func:`fire`.
+"""
+
+import os
+import threading
+
+ENV_VAR = "KART_FAULTS"
+
+
+class InjectedFault(OSError):
+    """The injected failure. An OSError so every layer that tolerates real
+    I/O failures (retry policies, salvage paths) treats it identically."""
+
+    def __init__(self, point, hit):
+        super().__init__(f"injected fault at {point} (hit {hit})")
+        self.point = point
+        self.hit = hit
+
+
+_lock = threading.Lock()
+_spec_src = None  # the env string the state below was parsed from
+_armed = {}  # point -> fire-on-this-hit (None once fired)
+_hits = {}  # point -> hits so far
+
+
+def _parse(src):
+    armed = {}
+    for part in src.split(","):
+        part = part.strip()
+        if not part:
+            continue
+        point, _, n = part.partition(":")
+        try:
+            armed[point] = max(1, int(n)) if n else 1
+        except ValueError:
+            armed[point] = 1
+    return armed
+
+
+def _refresh():
+    """Re-parse when the env spec changed; counters reset with it."""
+    global _spec_src, _armed, _hits
+    src = os.environ.get(ENV_VAR) or ""
+    if src != _spec_src:
+        _spec_src = src
+        _armed = _parse(src)
+        _hits = {}
+    return _armed
+
+
+def hook(point):
+    """-> a zero-arg callable that counts a hit of ``point`` (raising
+    InjectedFault on the armed hit), or None when the point is unarmed —
+    so hot loops pay nothing when faults are off."""
+    if not os.environ.get(ENV_VAR):  # fast path: one dict lookup, no lock
+        return None
+    with _lock:
+        armed = _refresh()
+        if point not in armed:
+            return None
+
+    def _hit():
+        with _lock:
+            if _refresh().get(point) is None:
+                return  # spec changed / already fired
+            _hits[point] = hit = _hits.get(point, 0) + 1
+            if hit < _armed[point]:
+                return
+            _armed[point] = None  # one-shot: disarm before raising
+        raise InjectedFault(point, hit)
+
+    return _hit
+
+
+def fire(point):
+    """Count a hit of ``point`` (convenience for non-loop call sites)."""
+    h = hook(point)
+    if h is not None:
+        h()

@@ -219,6 +219,66 @@ def reprobe(extra_timeout):
         return _probe_result
 
 
+class Watchdog:
+    """Arm a timer around a blocking operation that cannot be given a
+    timeout directly — a pipe read from a hung ssh, a wedged subprocess
+    handshake. If the guarded work goes ``timeout`` seconds without
+    *progress*, ``on_timeout`` runs (typically killing the process that
+    owns the pipe, so the blocked read returns EOF) and :attr:`fired` is
+    set so the caller can tell a watchdog abort from a real peer failure.
+    The transport analog of the jax init probe above: a wedged peer must
+    never hang the CLI forever.
+
+    Call :meth:`touch` whenever progress happens (a read completed) — the
+    deadline slides forward, making this an *inactivity* bound: a
+    slow-but-flowing multi-gigabyte transfer is never cut off, a stalled
+    one dies within ``timeout`` of its last byte.
+
+    ``timeout`` of None or <= 0 disarms the watchdog entirely."""
+
+    def __init__(self, timeout, on_timeout):
+        self.timeout = timeout
+        self.on_timeout = on_timeout
+        self.fired = False
+        self._timer = None
+        self._closed = False
+        self._last = time.monotonic()
+
+    def touch(self):
+        """Progress marker: slides the inactivity deadline forward (cheap —
+        one clock read; the timer is only re-armed when it next fires)."""
+        self._last = time.monotonic()
+
+    def _fire(self):
+        if self._closed:
+            return
+        remaining = self.timeout - (time.monotonic() - self._last)
+        if remaining > 0:  # progress since arming: re-arm for the rest
+            self._timer = threading.Timer(remaining, self._fire)
+            self._timer.daemon = True
+            self._timer.start()
+            return
+        self.fired = True
+        try:
+            self.on_timeout()
+        except Exception:  # the op it guards surfaces the real failure
+            L.debug("watchdog on_timeout raised", exc_info=True)
+
+    def __enter__(self):
+        if self.timeout is not None and self.timeout > 0:
+            self._last = time.monotonic()
+            self._timer = threading.Timer(self.timeout, self._fire)
+            self._timer.daemon = True
+            self._timer.start()
+        return self
+
+    def __exit__(self, exc_type, exc, tb):
+        self._closed = True
+        if self._timer is not None:
+            self._timer.cancel()
+        return False
+
+
 def jax_ready():
     """True when a jax backend is initialised and usable. First call may
     block up to the probe timeout; later calls are instant."""

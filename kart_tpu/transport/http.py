@@ -35,10 +35,12 @@ like ``git daemon``. Put a reverse proxy in front for anything else.
 """
 
 import json
+import os
 import struct
 import tempfile
 import threading
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from urllib.error import HTTPError
 from urllib.parse import urlsplit
 from urllib.request import Request, urlopen
 
@@ -48,9 +50,50 @@ from kart_tpu.transport.pack import read_pack, write_pack
 API = "/api/v1"
 _HEADER_LEN = struct.Struct(">Q")
 
+#: default per-socket timeout (connect + each recv) for the quick JSON GETs
+#: — a dead server fails fast instead of hanging forever. Every verb flow
+#: starts with ls_refs, so this is the fail-fast gate for the whole fetch/
+#: push/clone. Env KART_HTTP_TIMEOUT overrides both this and the POST
+#: budget below.
+DEFAULT_HTTP_TIMEOUT = 30.0
+
+#: default for the pack-carrying POSTs: the server spools its ENTIRE
+#: response pack (and, for receive-pack, quarantines + migrates + applies
+#: refs) before its first response byte, so the time-to-first-byte scales
+#: with repo size — a 30s budget would abort healthy large transfers, and a
+#: push timed out client-side after the server committed would report a
+#: false failure with refs already moved.
+DEFAULT_HTTP_POST_TIMEOUT = 600.0
+
+#: HTTP statuses that recur only transiently (proxy reload, backend
+#: restart, throttling) — the module recommends a reverse proxy for
+#: production, so these must stay retryable
+_TRANSIENT_HTTP_STATUSES = (429, 502, 503, 504)
+
+
+def http_timeout(default=DEFAULT_HTTP_TIMEOUT):
+    try:
+        return float(os.environ.get("KART_HTTP_TIMEOUT", default))
+    except (TypeError, ValueError):
+        return default
+
 
 class HttpTransportError(ValueError):
-    pass
+    """Transport failure. ``transient`` marks connection-level failures a
+    bounded retry may recover from (vs server-reported op errors, which
+    recur deterministically); ``pre_write`` marks failures that provably
+    happened before any request byte reached the server, the only kind a
+    non-idempotent verb retries."""
+
+    transient = False
+    pre_write = False
+
+    def __init__(self, message, *, transient=None, pre_write=None):
+        super().__init__(message)
+        if transient is not None:
+            self.transient = transient
+        if pre_write is not None:
+            self.pre_write = pre_write
 
 
 # ---------------------------------------------------------------------------
@@ -82,7 +125,7 @@ def read_framed(fp):
     """-> (header dict, file-like positioned at the pack)."""
     raw = fp.read(_HEADER_LEN.size)
     if len(raw) != _HEADER_LEN.size:
-        raise HttpTransportError("Truncated framed response")
+        raise HttpTransportError("Truncated framed response", transient=True)
     (n,) = _HEADER_LEN.unpack(raw)
     if n > 1 << 24:
         raise HttpTransportError("Framed header implausibly large")
@@ -247,20 +290,19 @@ class KartRequestHandler(BaseHTTPRequestHandler):
         self._framed(header, objects)
 
     def _handle_receive_pack(self):
-        from kart_tpu.transport.service import locked_ref_updates
+        from kart_tpu.transport.service import quarantined_receive
 
-        repo = self.repo
+        # the pack drains into a quarantine objects dir and migrates into
+        # the live store only after checksum + ref preconditions pass — a
+        # torn or rejected push leaves the store byte-identical. The CAS is
+        # atomic across handler threads AND across processes (an ssh push
+        # is a separate serve-stdio process): thread lock + gitdir file
+        # lock, both held inside quarantined_receive.
         with self._read_body_spooled() as body:
             header, pack_fp = read_framed(body)
-            with repo.odb.bulk_pack():
-                for obj_type, content in read_pack(pack_fp):
-                    repo.odb.write_raw(obj_type, content)
-
-        # compare-and-swap must be atomic across handler threads AND across
-        # processes (an ssh push is a separate serve-stdio process): thread
-        # lock here, gitdir file lock inside locked_ref_updates.
-        with self.server.push_lock:
-            status, payload = locked_ref_updates(repo, header)
+            status, payload = quarantined_receive(
+                self.repo, header, pack_fp, thread_lock=self.server.push_lock
+            )
         if status == "ok":
             self._json(200, {"updated": payload})
         else:
@@ -295,21 +337,46 @@ def serve(repo, host="127.0.0.1", port=8470, *, in_thread=False):
 
 class HttpRemote:
     """Client for the API above; the HTTP implementation of the transport
-    verbs remote.py's fetch/push/clone are written against."""
+    verbs remote.py's fetch/push/clone are written against.
 
-    def __init__(self, url):
+    Fault tolerance: every verb runs under ``retry`` (a
+    :class:`~kart_tpu.transport.retry.RetryPolicy`). The idempotent verbs
+    (``ls_refs``, ``fetch_pack``, ``fetch_blobs``) retry on any transient
+    failure — and ``fetch_pack`` *resumes*: objects salvaged from a torn
+    stream are excluded from the re-negotiation, so a retry transfers only
+    the missing remainder. ``receive_pack`` retries only when the
+    connection was never established (the server provably saw nothing)."""
+
+    def __init__(self, url, retry=None):
+        from kart_tpu.transport.retry import RetryPolicy
+
         self.base = url.rstrip("/")
+        self.retry = retry if retry is not None else RetryPolicy.from_config()
 
     def close(self):
         """No persistent connection; symmetric with StdioRemote so callers
         can close any network client unconditionally."""
 
+    def reset(self, *_):
+        """No per-connection state to tear down between retries."""
+
     def _get(self, path):
         try:
-            with urlopen(Request(self.base + path), timeout=60) as resp:
+            with urlopen(Request(self.base + path), timeout=http_timeout()) as resp:
                 return json.loads(resp.read().decode())
+        except HTTPError as e:
+            raise HttpTransportError(
+                f"Remote {self.base!r} error: {e}",
+                transient=e.code in _TRANSIENT_HTTP_STATUSES,
+            )
         except OSError as e:
-            raise HttpTransportError(f"Cannot reach remote {self.base!r}: {e}")
+            # connection-level (refused / DNS / socket timeout): transient,
+            # and for GETs necessarily pre-write
+            raise HttpTransportError(
+                f"Cannot reach remote {self.base!r}: {e}",
+                transient=True,
+                pre_write=True,
+            )
 
     def _post(self, path, data, *, raw=False, length=None):
         """data: JSON-able object, or (raw=True) bytes / a file-like with an
@@ -322,63 +389,103 @@ class HttpRemote:
             headers["Content-Length"] = str(length)
         req = Request(self.base + path, data=body, headers=headers, method="POST")
         try:
-            return urlopen(req, timeout=600)
-        except OSError as e:
+            return urlopen(req, timeout=http_timeout(DEFAULT_HTTP_POST_TIMEOUT))
+        except HTTPError as e:
+            # the server answered: usually a deterministic op error, except
+            # the proxy-layer statuses that recur only transiently
             detail = ""
-            if hasattr(e, "read"):
-                try:
-                    detail = json.loads(e.read().decode()).get("error", "")
-                except Exception:
-                    pass
+            try:
+                detail = json.loads(e.read().decode()).get("error", "")
+            except Exception:
+                pass
             raise HttpTransportError(
-                f"Remote {self.base!r} error: {detail or e}"
+                f"Remote {self.base!r} error: {detail or e}",
+                transient=e.code in _TRANSIENT_HTTP_STATUSES,
+            )
+        except OSError as e:
+            reason = getattr(e, "reason", e)
+            raise HttpTransportError(
+                f"Remote {self.base!r} error: {e}",
+                transient=True,
+                # connect refused ⇒ no request byte ever left this process,
+                # so even a non-idempotent verb may safely retry
+                pre_write=isinstance(reason, ConnectionRefusedError),
             )
 
     # -- verbs --------------------------------------------------------------
 
     def ls_refs(self):
-        return self._get(f"{API}/refs")
+        return self.retry.call(
+            lambda: self._get(f"{API}/refs"), label="ls-refs", on_retry=self.reset
+        )
 
     def fetch_pack(self, dst_repo, wants, *, haves=(), have_shallow=(),
-                   depth=None, filter_spec=None):
-        """-> header dict; objects are written straight into dst_repo."""
-        resp = self._post(
-            f"{API}/fetch-pack",
-            {
-                "wants": list(wants),
-                "haves": list(haves),
-                "have_shallow": sorted(have_shallow),
-                "depth": depth,
-                "filter": filter_spec,
-            },
-        )
-        with resp:
-            header, pack_fp = read_framed(resp)
-            with dst_repo.odb.bulk_pack():
-                for obj_type, content in read_pack(pack_fp):
-                    dst_repo.odb.write_raw(obj_type, content)
-        return header
+                   depth=None, filter_spec=None, exclude=None):
+        """-> header dict; objects are written straight into dst_repo.
+
+        Resumable: objects landed before a disconnect are salvaged into a
+        finished pack, and the retry re-negotiates with those oids excluded
+        so the server ships only the remainder. ``exclude`` seeds the
+        exclusion set (a cross-process resume passes the oids salvaged by
+        the earlier, killed process)."""
+        from kart_tpu.transport.retry import drain_pack_salvaging, exclude_arg
+
+        # a set is shared in place, so the caller sees everything salvaged
+        # even when every attempt fails (cross-process resume records it)
+        received = exclude if isinstance(exclude, set) else set(exclude or ())
+
+        def attempt():
+            resp = self._post(
+                f"{API}/fetch-pack",
+                {
+                    "wants": list(wants),
+                    "haves": list(haves),
+                    "have_shallow": sorted(have_shallow),
+                    "depth": depth,
+                    "filter": filter_spec,
+                    "exclude": exclude_arg(received),
+                },
+            )
+            with resp:
+                header, pack_fp = read_framed(resp)
+                drain_pack_salvaging(dst_repo.odb, pack_fp, received)
+            return header
+
+        return self.retry.call(attempt, label="fetch-pack", on_retry=self.reset)
 
     def fetch_blobs(self, dst_repo, oids):
-        resp = self._post(f"{API}/fetch-blobs", {"oids": list(oids)})
-        fetched = 0
-        with resp:
-            header, pack_fp = read_framed(resp)
-            with dst_repo.odb.bulk_pack():
-                for obj_type, content in read_pack(pack_fp):
-                    dst_repo.odb.write_raw(obj_type, content)
-                    fetched += 1
+        from kart_tpu.transport.retry import drain_pack_salvaging
+
+        received = set()
+
+        def attempt():
+            # a retry re-requests only what the torn attempt didn't land
+            want = [o for o in oids if o not in received]
+            if not want:
+                return {}
+            resp = self._post(f"{API}/fetch-blobs", {"oids": want})
+            with resp:
+                header, pack_fp = read_framed(resp)
+                drain_pack_salvaging(dst_repo.odb, pack_fp, received)
+            return header
+
+        header = self.retry.call(attempt, label="fetch-blobs", on_retry=self.reset)
         if header.get("missing"):
             raise HttpTransportError(
                 f"Remote is missing promised objects: {header['missing'][:5]}"
             )
-        return fetched
+        return len(received)
 
     def receive_pack(self, objects, updates, *, shallow=()):
         """objects: iterable of (type, content); updates: [{ref, old, new,
         force}]; shallow: oids or a callable evaluated after the objects
         drain (an ObjectEnumerator's boundary is only final then).
-        -> {ref: oid|None} from the server."""
+        -> {ref: oid|None} from the server.
+
+        Not idempotent: only pre-write failures (connect refused — the
+        server saw no byte of this request) are retried."""
+        from kart_tpu.transport.retry import is_pre_write
+
         with tempfile.SpooledTemporaryFile(max_size=64 * 1024 * 1024) as buf:
             write_framed(
                 buf,
@@ -389,9 +496,16 @@ class HttpRemote:
                 objects,
             )
             length = buf.tell()
-            buf.seek(0)
-            resp = self._post(
-                f"{API}/receive-pack", buf, raw=True, length=length
+
+            def attempt():
+                buf.seek(0)
+                return self._post(
+                    f"{API}/receive-pack", buf, raw=True, length=length
+                )
+
+            resp = self.retry.call(
+                attempt, label="receive-pack", retryable=is_pre_write,
+                on_retry=self.reset,
             )
         with resp:
             return json.loads(resp.read().decode())["updated"]

@@ -17,6 +17,8 @@ import hashlib
 import struct
 import zlib
 
+from kart_tpu import faults
+
 MAGIC = b"KARTPACK1\x00"
 
 _TYPE_TO_CODE = {"commit": 1, "tree": 2, "blob": 3, "tag": 4}
@@ -37,9 +39,12 @@ def write_pack(fileobj, objects):
         digest.update(data)
         fileobj.write(data)
 
+    fault = faults.hook("transport.write.frame")
     emit(MAGIC)
     count = 0
     for obj_type, content in objects:
+        if fault is not None:
+            fault()
         code = _TYPE_TO_CODE.get(obj_type)
         if code is None:
             raise PackFormatError(f"Unknown object type: {obj_type!r}")
@@ -66,7 +71,10 @@ def read_pack(fileobj):
 
     if pull(len(MAGIC)) != MAGIC:
         raise PackFormatError("Bad packstream magic")
+    fault = faults.hook("transport.read.frame")
     while True:
+        if fault is not None:
+            fault()
         code, raw_len, deflate_len = struct.unpack(">BII", pull(9))
         if code == _END:
             break

@@ -16,6 +16,12 @@ Two extra axes the reference gets from its forked git:
   individual blobs (by path + oid); vetoed blobs are *omitted* and the
   receiver records the remote as a promisor so later reads raise
   ObjectPromised instead of hard-failing.
+
+A third axis backs resumable fetch: **exclude** — exact oids the receiver
+already holds, salvaged from a torn earlier transfer. Unlike ``has`` these
+carry *no* closure guarantee (a disconnect delivers commits before their
+trees' blobs), so they suppress shipping object-by-object while the walk
+still descends through them to find the missing remainder.
 """
 
 from kart_tpu.core.odb import ObjectMissing
@@ -40,6 +46,7 @@ class ObjectEnumerator:
         depth=None,
         blob_filter=None,
         sender_shallow=frozenset(),
+        exclude=frozenset(),
     ):
         self.odb = odb
         self.wants = list(wants)
@@ -47,6 +54,7 @@ class ObjectEnumerator:
         self.depth = depth
         self.blob_filter = blob_filter
         self.sender_shallow = set(sender_shallow)
+        self.exclude = frozenset(exclude)
 
         self.object_count = 0
         self.omitted_blob_count = 0
@@ -62,10 +70,14 @@ class ObjectEnumerator:
         shipped_trees = set()
         pending = []
         for commit_oid in self._select_commits():
-            obj_type, content = self.odb.read_raw(commit_oid)
-            yield obj_type, content
-            self.object_count += 1
-            self.commit_count += 1
+            # excluded commits aren't re-shipped, but their trees are still
+            # walked: the receiver salvaged the commit object itself, not
+            # necessarily anything below it
+            if commit_oid not in self.exclude:
+                obj_type, content = self.odb.read_raw(commit_oid)
+                yield obj_type, content
+                self.object_count += 1
+                self.commit_count += 1
             tree_oid = self._tree_oid_of(commit_oid)
             if tree_oid is not None:
                 yield from self._walk_tree(tree_oid, "", shipped_trees, pending)
@@ -148,14 +160,18 @@ class ObjectEnumerator:
             _, content = self.odb.read_raw(tree_oid)
         except ObjectMissing:
             return
-        yield "tree", content
-        self.object_count += 1
+        # an excluded tree still recurses: the receiver may hold the tree
+        # object while its blobs were lost to the disconnect (blobs ship in
+        # deferred batches behind the trees that reference them)
+        if tree_oid not in self.exclude:
+            yield "tree", content
+            self.object_count += 1
         for e in entries:
             path = f"{prefix}{e.name}"
             if e.is_tree:
                 yield from self._walk_tree(e.oid, path + "/", shipped, pending)
             else:
-                if e.oid in shipped or self.has(e.oid):
+                if e.oid in shipped or self.has(e.oid) or e.oid in self.exclude:
                     continue
                 if self.blob_filter is not None and not self.blob_filter(path, e.oid):
                     self.omitted_blob_count += 1

@@ -20,10 +20,16 @@ import tempfile
 from kart_tpu.core.odb import ObjectMissing
 from kart_tpu.core.refs import RefError, check_ref_format
 from kart_tpu.core.repo import KartRepo, KartConfigKeys, NotFound
-from kart_tpu.transport.pack import read_pack, write_pack
+from kart_tpu.transport.pack import PackFormatError, read_pack, write_pack
 from kart_tpu.transport.protocol import ObjectEnumerator
 
 SHALLOW_FILE = "shallow"
+
+#: gitdir marker for an in-flight network fetch — like git's shallow
+#: machinery, its survival past process death is the signal that the local
+#: store may hold a salvaged partial transfer, so the next fetch resumes
+#: (excluding every object already present) instead of starting over.
+FETCH_RESUME_FILE = "FETCH_RESUME"
 
 
 class RemoteError(ValueError):
@@ -60,19 +66,20 @@ def is_http_url(url):
     return url.startswith("http://") or url.startswith("https://")
 
 
-def network_remote(url):
+def network_remote(url, retry=None):
     """The wire client for a network URL — HttpRemote for http(s),
     StdioRemote for ssh:// / scp-like — or None for local paths. Both
     clients speak the same verb API (ls_refs / fetch_pack / fetch_blobs /
-    receive_pack), so every caller is transport-agnostic."""
+    receive_pack), so every caller is transport-agnostic. ``retry``: a
+    RetryPolicy (defaults to env/config resolution inside the client)."""
     if is_http_url(url):
         from kart_tpu.transport.http import HttpRemote
 
-        return HttpRemote(url)
+        return HttpRemote(url, retry=retry)
     from kart_tpu.transport.stdio import StdioRemote, is_ssh_url
 
     if is_ssh_url(url):
-        return StdioRemote(url)
+        return StdioRemote(url, retry=retry)
     return None
 
 
@@ -176,6 +183,51 @@ def _update_shallow(repo, new_boundary):
     write_shallow(repo, still_shallow)
 
 
+def _retry_policy(repo, remote_name):
+    """The retry/backoff policy for this remote (env > remote.<name>.*
+    config > defaults; see kart_tpu.transport.retry)."""
+    from kart_tpu.transport.retry import RetryPolicy
+
+    return RetryPolicy.from_config(repo.config, remote_name)
+
+
+_OID_RE = None
+
+
+def _write_resume_marker(repo, remote_name, salvaged):
+    """Record the in-flight fetch + the oids salvaged so far (bounded) so a
+    later process can resume without rescanning the store."""
+    from kart_tpu.transport.retry import EXCLUDE_CAP
+
+    lines = [remote_name, *sorted(salvaged or ())[:EXCLUDE_CAP]]
+    repo.write_gitdir_file(FETCH_RESUME_FILE, "\n".join(lines))
+
+
+def _read_resume_exclusions(repo):
+    """-> the exclusion seed for this fetch: oids recorded in a surviving
+    FETCH_RESUME marker; if the marker exists but carries none (the
+    process was hard-killed before it could record them), fall back to
+    scanning the local store (bounded — exclusions are an optimisation,
+    missing some merely re-ships a little)."""
+    import itertools
+    import re
+
+    from kart_tpu.transport.retry import EXCLUDE_CAP
+
+    content = repo.read_gitdir_file(FETCH_RESUME_FILE)
+    if content is None:
+        return set()
+    global _OID_RE
+    if _OID_RE is None:
+        _OID_RE = re.compile(r"^[0-9a-f]{40}$")
+    oids = {
+        line for line in content.splitlines()[1:] if _OID_RE.fullmatch(line)
+    }
+    if oids:
+        return oids
+    return set(itertools.islice(repo.odb.iter_oids(), EXCLUDE_CAP))
+
+
 # -- the wire --------------------------------------------------------------
 
 
@@ -220,16 +272,25 @@ def fetch(repo, remote_name="origin", *, depth=None, filter_spec=None, quiet=Tru
         if spec and spec.startswith("extension:spatial="):
             filter_spec = spec[len("extension:spatial=") :]
 
-    net = network_remote(remote.url)
+    net = network_remote(remote.url, retry=_retry_policy(repo, remote_name))
     if net is not None:
         from kart_tpu.transport.http import HttpTransportError
 
+        # A FETCH_RESUME marker surviving from an earlier process means that
+        # fetch died mid-transfer and its salvage is sitting in our store:
+        # seed the exclusion set so the server ships only the remainder
+        # (content addressing makes the salvaged objects exactly as
+        # trustworthy as a completed transfer's). The client mutates the
+        # set in place, so even a failed retry chain leaves us knowing
+        # everything that landed.
+        exclude = _read_resume_exclusions(repo)
         try:
             info = net.ls_refs()
             branch_tips = info["heads"]
             tag_tips = info["tags"]
             head_branch = info.get("head_branch")
             wants = list(branch_tips.values()) + list(tag_tips.values())
+            repo.write_gitdir_file(FETCH_RESUME_FILE, remote_name)
             header = net.fetch_pack(
                 repo,
                 wants,
@@ -237,11 +298,16 @@ def fetch(repo, remote_name="origin", *, depth=None, filter_spec=None, quiet=Tru
                 have_shallow=read_shallow(repo),
                 depth=depth,
                 filter_spec=filter_spec,
+                exclude=exclude,
             )
-        except HttpTransportError as e:
+        except (HttpTransportError, PackFormatError, OSError) as e:
+            # the marker stays — now carrying the salvaged oids, so the
+            # next `kart fetch` resumes without rescanning the store
+            _write_resume_marker(repo, remote_name, exclude)
             raise RemoteError(str(e))
         finally:
             net.close()
+        repo.remove_gitdir_file(FETCH_RESUME_FILE)
         shallow_boundary = set(header.get("shallow_boundary", ()))
     else:
         src = remote.open()
@@ -455,7 +521,7 @@ def push(repo, remote_name="origin", refspecs=(), *, force=False, set_upstream=F
             raise RemoteError("Cannot push: HEAD is detached and no refspec given")
         refspecs = [f"{branch}:{branch}"]
 
-    net = network_remote(remote.url)
+    net = network_remote(remote.url, retry=_retry_policy(repo, remote_name))
     if net is not None:
         try:
             return _push_network(
@@ -590,9 +656,20 @@ def clone(
                 structure = repo.structure("HEAD")
                 wc.write_full(structure, *structure.datasets)
         return repo
-    except BaseException:
+    except BaseException as e:
         import shutil
 
+        # A transfer that died mid-fetch leaves a FETCH_RESUME marker and a
+        # salvaged partial store — keep it: `kart fetch` in the directory
+        # resumes from what arrived instead of recloning from zero. Every
+        # other failure removes the half-made repo as before.
+        if isinstance(e, (RemoteError, OSError)) and (
+            repo.read_gitdir_file(FETCH_RESUME_FILE) is not None
+        ):
+            raise RemoteError(
+                f"{e} — partial clone kept at {directory!r}; run `kart "
+                f"fetch` there to resume the transfer"
+            ) from e
         shutil.rmtree(repo.gitdir, ignore_errors=True)
         raise
 
@@ -614,7 +691,7 @@ def fetch_promised_blobs(repo, oids):
             break
     if promisor is None:
         raise RemoteError("No promisor remote configured")
-    net = network_remote(promisor.url)
+    net = network_remote(promisor.url, retry=_retry_policy(repo, promisor.name))
     if net is not None:
         from kart_tpu.transport.http import HttpTransportError
 

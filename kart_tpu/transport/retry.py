@@ -1,0 +1,179 @@
+"""Fault tolerance for the wire transports: retry with capped exponential
+backoff, transient-error classification, and the salvaging pack drain that
+makes fetch resumable.
+
+The reference inherits all of this from git (curl retries, packfile
+quarantine, ``http.lowSpeedLimit``); our native transports implement the
+same production posture directly:
+
+* **RetryPolicy** — attempts / base-delay / cap, configured per remote
+  (``remote.<name>.retries`` etc.), globally via env, or per client. Only
+  *idempotent* verbs (``ls_refs``, ``fetch_pack``, ``fetch_blobs``) retry
+  automatically; ``receive_pack`` retries only on pre-write failures (the
+  connection was never established, so the server saw nothing).
+* **Transient classification** — connection-level failures (OSError,
+  injected faults, torn packstreams) are retryable; server-reported op
+  errors (bad filter spec, CAS conflict, HTTP status errors) are not.
+  Errors carry an optional ``transient`` attribute that overrides the
+  class-based default, and ``pre_write=True`` marks failures that provably
+  happened before any request byte reached the server.
+* **drain_pack_salvaging** — objects are content-addressed and each pack
+  record is individually length/zlib-checked, so everything received before
+  a disconnect is durable: on a torn stream the partial pack is *finalised*
+  (not discarded) and the error re-raised. A retry then excludes the
+  salvaged oids from the re-negotiation and the server ships only the
+  remainder.
+"""
+
+import logging
+import os
+import time
+
+from kart_tpu.transport.pack import PackFormatError, read_pack
+
+L = logging.getLogger("kart_tpu.transport.retry")
+
+#: largest oid-exclusion list a resuming fetch sends; beyond this the tail
+#: is simply not excluded (exclusions are an optimisation — dropping some
+#: re-transfers a little, never corrupts) so request headers stay bounded
+#: (the stdio server caps request headers at 16MB).
+EXCLUDE_CAP = 100_000
+
+
+def is_transient(exc):
+    """Should a bounded retry be attempted after ``exc``?
+
+    An explicit ``transient`` attribute wins; otherwise OS-level errors and
+    torn packstreams are transient, everything else (server-reported op
+    errors, protocol violations) is not."""
+    t = getattr(exc, "transient", None)
+    if t is not None:
+        return bool(t)
+    return isinstance(exc, (OSError, PackFormatError))
+
+
+def is_pre_write(exc):
+    """True when the failure provably happened before any request byte
+    reached the server (e.g. TCP connect refused, spawn failure) — the only
+    failures a non-idempotent verb may retry."""
+    return bool(getattr(exc, "pre_write", False))
+
+
+def _env_float(name, default):
+    try:
+        return float(os.environ.get(name, default))
+    except (TypeError, ValueError):
+        return default
+
+
+def _env_int(name, default):
+    try:
+        return int(os.environ.get(name, default))
+    except (TypeError, ValueError):
+        return default
+
+
+class RetryPolicy:
+    """Capped exponential backoff: attempt *k* failing transiently sleeps
+    ``min(max_delay, base_delay * 2**(k-1))`` before attempt *k+1*, up to
+    ``attempts`` total attempts. ``sleep`` is injectable for tests."""
+
+    def __init__(self, attempts=3, base_delay=0.2, max_delay=10.0, sleep=time.sleep):
+        self.attempts = max(1, int(attempts))
+        self.base_delay = max(0.0, float(base_delay))
+        self.max_delay = max(0.0, float(max_delay))
+        self.sleep = sleep
+
+    @classmethod
+    def from_config(cls, config=None, remote_name=None):
+        """Resolve the policy for a remote: env (operational override) >
+        ``remote.<name>.*`` config > defaults.
+
+        Config keys: ``remote.<name>.retries``, ``.retrybasedelay``,
+        ``.retrymaxdelay``. Env: ``KART_TRANSPORT_RETRIES``,
+        ``KART_TRANSPORT_RETRY_BASE``, ``KART_TRANSPORT_RETRY_CAP``."""
+        attempts, base, cap = 3, 0.2, 10.0
+        if config is not None and remote_name is not None:
+            prefix = f"remote.{remote_name}."
+            try:
+                attempts = config.get_int(prefix + "retries", attempts)
+                base = float(config.get(prefix + "retrybasedelay", base))
+                cap = float(config.get(prefix + "retrymaxdelay", cap))
+            except (TypeError, ValueError):
+                pass
+        attempts = _env_int("KART_TRANSPORT_RETRIES", attempts)
+        base = _env_float("KART_TRANSPORT_RETRY_BASE", base)
+        cap = _env_float("KART_TRANSPORT_RETRY_CAP", cap)
+        return cls(attempts, base, cap)
+
+    def delay_for(self, attempt):
+        """Backoff before attempt ``attempt + 1`` (1-based attempts)."""
+        return min(self.max_delay, self.base_delay * (2 ** (attempt - 1)))
+
+    def call(self, fn, *, retryable=is_transient, label="", on_retry=None):
+        """Run ``fn()`` with up to ``attempts`` tries. ``retryable(exc)``
+        gates each retry; ``on_retry(exc, attempt)`` runs before the backoff
+        sleep (transports use it to reset a desynced connection)."""
+        for attempt in range(1, self.attempts + 1):
+            try:
+                return fn()
+            except Exception as e:
+                if attempt >= self.attempts or not retryable(e):
+                    raise
+                delay = self.delay_for(attempt)
+                L.warning(
+                    "transport %s failed (%s: %s); retrying %d/%d in %.2fs",
+                    label or "operation",
+                    type(e).__name__,
+                    e,
+                    attempt,
+                    self.attempts - 1,
+                    delay,
+                )
+                if on_retry is not None:
+                    on_retry(e, attempt)
+                if delay > 0:
+                    self.sleep(delay)
+
+
+def drain_pack_salvaging(odb, pack_fp, received=None):
+    """Drain a kartpack stream into ``odb`` as one new pack, *keeping* what
+    arrived if the stream tears.
+
+    Every record is individually zlib- and length-verified by
+    ``read_pack``, and oids are recomputed from content on write, so the
+    objects landed before a disconnect are exactly as trustworthy as a
+    complete transfer's — the stream checksum trailer only guards the
+    record *framing* we already re-derive. On any failure the partial pack
+    is finalised (fsck-clean, immediately readable) and the error
+    re-raised; ``received`` (if given) accumulates the hex oids written so
+    a retry can exclude them from re-negotiation.
+
+    -> number of objects written this drain."""
+    w = odb.pack_writer()
+    count = 0
+    try:
+        for obj_type, content in read_pack(pack_fp):
+            oid = w.add(obj_type, content)
+            count += 1
+            if received is not None:
+                received.add(oid)
+    except BaseException:
+        try:
+            if w.finish() is not None:
+                odb.packs.refresh()
+        except Exception:
+            w.abort()
+        raise
+    if w.finish() is not None:
+        odb.packs.refresh()
+    return count
+
+
+def exclude_arg(received):
+    """The ``exclude`` list a resuming fetch sends: sorted for determinism,
+    capped so request headers stay bounded (see EXCLUDE_CAP)."""
+    if not received:
+        return []
+    out = sorted(received)
+    return out[:EXCLUDE_CAP]

@@ -6,11 +6,25 @@ server (:mod:`kart_tpu.transport.http`) and the stdio/ssh server
 (:mod:`kart_tpu.transport.stdio`). The reference gets the same sharing from
 git itself: upload-pack/receive-pack behave identically whether invoked by
 ``git daemon``, ssh, or https (kart/cli.py:211-253).
+
+Receive-pack is *quarantined* (the analog of git's tmp_objdir): the pushed
+pack drains into a temporary objects dir that borrows the main store via
+alternates, and objects migrate into the live store only after the pack
+checksum and every ref-update precondition pass — a failed, torn or
+rejected push leaves the served store byte-identical.
 """
+
+import os
+import shutil
+import tempfile
+from contextlib import contextmanager, nullcontext
 
 from kart_tpu.core.odb import ObjectMissing
 from kart_tpu.core.refs import RefError, check_ref_format
 from kart_tpu.transport.protocol import ObjectEnumerator
+
+#: subdirectory of <gitdir>/objects holding in-flight push quarantines
+QUARANTINE_SUBDIR = "quarantine"
 
 
 def ls_refs_info(repo):
@@ -62,6 +76,12 @@ def make_fetch_enum(repo, req):
         depth=req.get("depth"),
         blob_filter=blob_filter,
         sender_shallow=read_shallow(repo),
+        # the resume protocol: exact oids the client already holds (salvaged
+        # from a torn earlier transfer). Unlike `haves` these carry no
+        # closure guarantee, so they suppress shipping object-by-object
+        # without pruning the walk — a resumed fetch ships only the missing
+        # remainder.
+        exclude=frozenset(req.get("exclude") or ()),
     )
 
     def header():
@@ -91,14 +111,13 @@ def current_branch_ref(repo):
     return target if kind == "symbolic" else None
 
 
-def locked_ref_updates(repo, header):
-    """apply_ref_updates under a cross-process gitdir file lock: every ssh
-    push spawns its own serve-stdio process, so an in-process lock can't
-    serialise the compare-and-swap (two concurrent pushes would both pass
-    the CAS check and one would be silently lost). The HTTP server holds
-    this too, so mixed http+ssh pushes against one repo stay safe."""
-    import os
-
+@contextmanager
+def push_file_lock(repo):
+    """Cross-process push lock over the gitdir: every ssh push spawns its
+    own serve-stdio process, so an in-process lock can't serialise the
+    compare-and-swap (two concurrent pushes would both pass the CAS check
+    and one would be silently lost). The HTTP server holds its thread lock
+    too, so mixed http+ssh pushes against one repo stay safe."""
     lock_path = os.path.join(repo.gitdir, ".push-lock")
     with open(lock_path, "w") as lock:
         try:
@@ -107,26 +126,124 @@ def locked_ref_updates(repo, header):
             fcntl.flock(lock, fcntl.LOCK_EX)
         except ImportError:  # non-POSIX: best effort
             pass
+        yield
+
+
+def locked_ref_updates(repo, header):
+    """apply_ref_updates under the cross-process push lock (back-compat
+    entry point for callers that drained objects into the live store
+    themselves; the servers use :func:`quarantined_receive`)."""
+    with push_file_lock(repo):
         return apply_ref_updates(repo, header)
 
 
-def apply_ref_updates(repo, header):
-    """CAS-validate then apply a receive-pack's ref updates (the pack must
-    already be drained into the odb). All updates are validated before any
-    is applied, so a rejected request leaves no ref moved. The caller holds
-    whatever lock serialises concurrent pushes.
+class ReceiveQuarantine:
+    """A temporary objects dir under ``<gitdir>/objects/quarantine/``
+    holding a pushed pack until it earns its way into the live store (the
+    analog of git's receive-pack ``tmp_objdir``). The main store is wired
+    in as an alternate, so connectivity/containment checks see quarantined
+    + live objects together while the live store stays untouched."""
 
-    -> ("ok", {ref: oid|None}) | ("conflict", msg) | ("bad", msg)."""
-    from kart_tpu.transport.remote import _update_shallow
+    def __init__(self, repo):
+        from kart_tpu.core.odb import ObjectDb
 
+        self.repo = repo
+        base = os.path.join(repo.gitdir, "objects", QUARANTINE_SUBDIR)
+        os.makedirs(base, exist_ok=True)
+        self.dir = tempfile.mkdtemp(prefix="incoming-", dir=base)
+        self.odb = ObjectDb(self.dir)
+        self.odb.add_alternate(os.path.join(repo.gitdir, "objects"))
+
+    def discard(self):
+        """Drop everything received — the live store is byte-identical to
+        before the push started."""
+        shutil.rmtree(self.dir, ignore_errors=True)
+
+    def migrate(self):
+        """Move the quarantined pack(s) (and any loose strays) into the live
+        store. Only called after the pack checksum and every ref-update
+        precondition passed. Same-filesystem renames; ``.pack`` moves before
+        its ``.idx`` so a concurrent reader never sees an idx without its
+        pack."""
+        objects_dir = self.repo.odb.objects_dir
+        qpack = os.path.join(self.dir, "pack")
+        if os.path.isdir(qpack):
+            dst_pack = os.path.join(objects_dir, "pack")
+            os.makedirs(dst_pack, exist_ok=True)
+            names = sorted(
+                os.listdir(qpack), key=lambda n: (n.endswith(".idx"), n)
+            )
+            for name in names:
+                if name.startswith("."):
+                    continue  # writer temp files never migrate
+                os.replace(
+                    os.path.join(qpack, name), os.path.join(dst_pack, name)
+                )
+        for prefix in os.listdir(self.dir):
+            if len(prefix) != 2:
+                continue
+            src_d = os.path.join(self.dir, prefix)
+            dst_d = os.path.join(objects_dir, prefix)
+            os.makedirs(dst_d, exist_ok=True)
+            for name in os.listdir(src_d):
+                os.replace(
+                    os.path.join(src_d, name), os.path.join(dst_d, name)
+                )
+        self.repo.odb.packs.refresh()
+        self.discard()
+
+
+def quarantined_receive(repo, header, pack_fp, *, thread_lock=None):
+    """The full receive-pack verb: drain the pushed pack into quarantine,
+    validate the ref updates, migrate, apply. A torn pack, a checksum
+    mismatch, or any rejected precondition leaves the live store
+    byte-identical (the quarantine is discarded); objects reach the live
+    store only in the success path, under the push locks.
+
+    -> ("ok", {ref: oid|None}) | ("conflict", msg) | ("bad", msg);
+    transfer-level failures (torn/corrupt pack) raise instead, so each
+    server reports them the same way as any other I/O failure."""
+    from kart_tpu.transport.pack import read_pack
+
+    q = ReceiveQuarantine(repo)
+    try:
+        with q.odb.bulk_pack():
+            for obj_type, content in read_pack(pack_fp):
+                q.odb.write_raw(obj_type, content)
+    except BaseException:
+        q.discard()
+        raise
+    try:
+        with (thread_lock if thread_lock is not None else nullcontext()):
+            with push_file_lock(repo):
+                rejection = validate_ref_updates(
+                    repo, header, contains=q.odb.contains
+                )
+                if rejection is not None:
+                    q.discard()
+                    return rejection
+                q.migrate()
+                return "ok", _apply_validated_updates(repo, header)
+    except BaseException:
+        q.discard()  # no-op after a successful migrate
+        raise
+
+
+def validate_ref_updates(repo, header, *, contains=None):
+    """Check every precondition of a receive-pack's ref updates without
+    moving anything: refname hygiene, checked-out-branch protection, CAS
+    against the current tips, and object connectivity via ``contains``
+    (a quarantine's combined live+incoming check during a push).
+
+    -> None when everything passes, else ("conflict"|"bad", msg)."""
+    contains = contains or repo.odb.contains
     deny_current = (
         repo.workdir is not None
         and (repo.config.get("receive.denyCurrentBranch") or "refuse").lower()
         not in ("ignore", "false")
     )
 
-    updates = header.get("updates", [])
-    for upd in updates:
+    for upd in header.get("updates", []):
         ref, old, new = upd["ref"], upd.get("old"), upd.get("new")
         # wire-supplied names must be real refs — git's receive-pack rejects
         # non-refs/ names via check_refname_format; without this a push with
@@ -149,11 +266,17 @@ def apply_ref_updates(repo, header):
                 f"Ref {ref} moved (expected {old}, is {current}); "
                 f"fetch first or use --force",
             )
-        if new is not None and not repo.odb.contains(new):
+        if new is not None and not contains(new):
             return "bad", f"Push incomplete: {new} not received"
+    return None
+
+
+def _apply_validated_updates(repo, header):
+    """Apply pre-validated ref updates; -> {ref: oid|None}."""
+    from kart_tpu.transport.remote import _update_shallow
 
     updated = {}
-    for upd in updates:
+    for upd in header.get("updates", []):
         ref, new = upd["ref"], upd.get("new")
         if new is None:
             if repo.refs.get(ref) is not None:
@@ -164,4 +287,17 @@ def apply_ref_updates(repo, header):
             updated[ref] = new
     if header.get("shallow"):
         _update_shallow(repo, header["shallow"])
-    return "ok", updated
+    return updated
+
+
+def apply_ref_updates(repo, header):
+    """CAS-validate then apply a receive-pack's ref updates (the pack must
+    already be drained into the odb). All updates are validated before any
+    is applied, so a rejected request leaves no ref moved. The caller holds
+    whatever lock serialises concurrent pushes.
+
+    -> ("ok", {ref: oid|None}) | ("conflict", msg) | ("bad", msg)."""
+    rejection = validate_ref_updates(repo, header)
+    if rejection is not None:
+        return rejection
+    return "ok", _apply_validated_updates(repo, header)

@@ -32,6 +32,19 @@ from kart_tpu.transport.http import (
 )
 from kart_tpu.transport.pack import read_pack
 
+#: how long the client waits for a response frame to *start* before the
+#: hung-ssh watchdog kills the transport process (the server spools its
+#: whole pack before the first response byte, so keep this generous);
+#: env KART_STDIO_TIMEOUT overrides, <= 0 disables.
+DEFAULT_STDIO_TIMEOUT = 600.0
+
+
+def stdio_timeout():
+    try:
+        return float(os.environ.get("KART_STDIO_TIMEOUT", DEFAULT_STDIO_TIMEOUT))
+    except (TypeError, ValueError):
+        return DEFAULT_STDIO_TIMEOUT
+
 
 class StdioTransportError(HttpTransportError):
     """Transport failure over the spawned-process pipe. Subclasses the HTTP
@@ -92,14 +105,25 @@ def is_ssh_url(url):
 class StdioRemote:
     """Client half: mirrors HttpRemote's verb API over one spawned process.
     The subprocess starts lazily and is reused across calls (one ssh
-    connection per remote instance, like git)."""
+    connection per remote instance, like git).
 
-    def __init__(self, url):
+    Fault tolerance mirrors HttpRemote: idempotent verbs retry under
+    ``retry`` (the connection is respawned between attempts — a failed RPC
+    leaves the pipe desynced), ``fetch_pack`` resumes via oid exclusion,
+    ``receive_pack`` retries only on spawn failure (pre-write). A hung ssh
+    (dead relay, wedged server) is bounded by a watchdog that kills the
+    transport process when a response frame doesn't start within
+    $KART_STDIO_TIMEOUT seconds."""
+
+    def __init__(self, url, retry=None):
+        from kart_tpu.transport.retry import RetryPolicy
+
         self.url = url
         parsed = parse_ssh_url(url)
         if parsed is None:
             raise StdioTransportError(f"Not an ssh remote: {url!r}")
         self.userhost, self.port, self.path = parsed
+        self.retry = retry if retry is not None else RetryPolicy.from_config()
         self._proc = None
 
     # -- process management --------------------------------------------------
@@ -125,110 +149,206 @@ class StdioRemote:
             )
         except OSError as e:
             raise StdioTransportError(
-                f"Cannot spawn transport for {self.url!r}: {e}"
+                f"Cannot spawn transport for {self.url!r}: {e}",
+                transient=True,
+                pre_write=True,  # nothing was spawned: no byte reached anyone
             )
         return self._proc
 
-    def close(self):
+    def close(self, timeout=5.0):
+        """Shut the transport process down, bounded: close the pipes, wait
+        up to ``timeout`` for a clean exit, then kill. Never raises from
+        callers' cleanup paths, never leaves a zombie (the post-kill wait
+        reaps), and a second close() is a no-op."""
         proc, self._proc = self._proc, None
         if proc is None:
             return
         for fp in (proc.stdin, proc.stdout):
             try:
-                fp.close()
+                if fp is not None:
+                    fp.close()
             except OSError:
                 pass
         try:
-            proc.wait(timeout=10)
+            proc.wait(timeout=timeout)
         except subprocess.TimeoutExpired:
-            # a wedged remote must not leak an ssh process or raise out of
-            # callers' cleanup paths
+            # a wedged remote must not leak an ssh process or hang us
             proc.kill()
-            proc.wait()
+            try:
+                proc.wait(timeout=5)
+            except subprocess.TimeoutExpired:  # pragma: no cover - kernel lag
+                pass
+
+    def reset(self, *_):
+        """Between retries: a failed RPC leaves the pipe desynced, so drop
+        the process; the next RPC respawns."""
+        self.close(timeout=1.0)
 
     def __del__(self):  # best-effort; close() is the real API
         try:
-            self.close()
+            # interpreter shutdown must not stall behind a wedged ssh —
+            # give it a moment, then kill
+            self.close(timeout=0.5)
         except Exception:
             pass
 
     # -- framing -------------------------------------------------------------
 
-    def _rpc(self, header, objects=()):
-        """Send one framed request; -> (response header, pack fileobj).
-        The caller must fully drain the pack before the next call."""
+    def _watchdog_kill(self):
+        proc = self._proc
+        if proc is not None and proc.poll() is None:
+            proc.kill()
+
+    class _TouchReader:
+        """File wrapper marking watchdog progress on every completed read,
+        so the hung-ssh bound is an *inactivity* timeout over the whole
+        response — header AND pack body — not a cap on transfer time."""
+
+        __slots__ = ("_fp", "_wd")
+
+        def __init__(self, fp, wd):
+            self._fp = fp
+            self._wd = wd
+
+        def read(self, n=-1):
+            data = self._fp.read(n)
+            self._wd.touch()
+            return data
+
+    def _rpc(self, header, objects=(), drain=None):
+        """Send one framed request; -> (response header, drain result).
+        ``drain(pack_fp)`` consumes the response pack *inside* the
+        hung-transport watchdog (re-armed on every read, so a stalled peer
+        dies within the budget of its last byte while a slow-but-flowing
+        transfer runs to completion); by default the (empty) pack is
+        discarded."""
+        from kart_tpu.runtime import Watchdog
+
         proc = self._ensure()
         try:
             write_framed(proc.stdin, header, objects)
             proc.stdin.flush()
         except (OSError, ValueError) as e:
             raise StdioTransportError(
-                f"Transport for {self.url!r} died while sending: {e}"
+                f"Transport for {self.url!r} died while sending: {e}",
+                transient=True,
             )
-        try:
-            resp, pack_fp = read_framed(proc.stdout)
-        except HttpTransportError:
-            rc = proc.poll()
-            raise StdioTransportError(
-                f"Remote {self.url!r} closed the connection"
-                + (f" (exit code {rc})" if rc is not None else "")
-            )
-        if "error" in resp:
-            # drain the (empty) pack so the pipe stays usable
-            for _ in read_pack(pack_fp):
-                pass
-            raise StdioTransportError(f"Remote {self.url!r} error: {resp['error']}")
-        return resp, pack_fp
+        with Watchdog(stdio_timeout(), self._watchdog_kill) as wd:
+            guarded = self._TouchReader(proc.stdout, wd)
+
+            def stalled():
+                return StdioTransportError(
+                    f"Remote {self.url!r} did not respond within "
+                    f"{stdio_timeout():.0f}s (killed; set "
+                    f"KART_STDIO_TIMEOUT to wait longer)",
+                    transient=True,
+                )
+
+            try:
+                resp, pack_fp = read_framed(guarded)
+            except HttpTransportError:
+                if wd.fired:
+                    raise stalled()
+                rc = proc.poll()
+                raise StdioTransportError(
+                    f"Remote {self.url!r} closed the connection"
+                    + (f" (exit code {rc})" if rc is not None else ""),
+                    transient=True,
+                )
+            if "error" in resp:
+                # drain the (empty) pack so the pipe stays usable
+                for _ in read_pack(pack_fp):
+                    pass
+                raise StdioTransportError(
+                    f"Remote {self.url!r} error: {resp['error']}"
+                )
+            try:
+                if drain is None:
+                    for _ in read_pack(pack_fp):
+                        pass
+                    result = None
+                else:
+                    result = drain(pack_fp)
+            except (OSError, ValueError) as e:
+                if wd.fired:
+                    raise stalled() from e
+                raise
+        return resp, result
 
     # -- verbs (HttpRemote-compatible) ---------------------------------------
 
     def ls_refs(self):
-        resp, pack_fp = self._rpc({"op": "refs"})
-        for _ in read_pack(pack_fp):
-            pass
-        return resp
+        return self.retry.call(
+            lambda: self._rpc({"op": "refs"})[0],
+            label="ls-refs",
+            on_retry=self.reset,
+        )
 
     def fetch_pack(self, dst_repo, wants, *, haves=(), have_shallow=(),
-                   depth=None, filter_spec=None):
-        resp, pack_fp = self._rpc(
-            {
-                "op": "fetch-pack",
-                "wants": list(wants),
-                "haves": list(haves),
-                "have_shallow": sorted(have_shallow),
-                "depth": depth,
-                "filter": filter_spec,
-            }
-        )
-        with dst_repo.odb.bulk_pack():
-            for obj_type, content in read_pack(pack_fp):
-                dst_repo.odb.write_raw(obj_type, content)
-        return resp
+                   depth=None, filter_spec=None, exclude=None):
+        from kart_tpu.transport.retry import drain_pack_salvaging, exclude_arg
+
+        received = exclude if isinstance(exclude, set) else set(exclude or ())
+
+        def attempt():
+            resp, _ = self._rpc(
+                {
+                    "op": "fetch-pack",
+                    "wants": list(wants),
+                    "haves": list(haves),
+                    "have_shallow": sorted(have_shallow),
+                    "depth": depth,
+                    "filter": filter_spec,
+                    "exclude": exclude_arg(received),
+                },
+                drain=lambda fp: drain_pack_salvaging(dst_repo.odb, fp, received),
+            )
+            return resp
+
+        return self.retry.call(attempt, label="fetch-pack", on_retry=self.reset)
 
     def fetch_blobs(self, dst_repo, oids):
-        resp, pack_fp = self._rpc({"op": "fetch-blobs", "oids": list(oids)})
-        fetched = 0
-        with dst_repo.odb.bulk_pack():
-            for obj_type, content in read_pack(pack_fp):
-                dst_repo.odb.write_raw(obj_type, content)
-                fetched += 1
+        from kart_tpu.transport.retry import drain_pack_salvaging
+
+        received = set()
+
+        def attempt():
+            want = [o for o in oids if o not in received]
+            if not want:
+                return {}
+            resp, _ = self._rpc(
+                {"op": "fetch-blobs", "oids": want},
+                drain=lambda fp: drain_pack_salvaging(dst_repo.odb, fp, received),
+            )
+            return resp
+
+        resp = self.retry.call(attempt, label="fetch-blobs", on_retry=self.reset)
         if resp.get("missing"):
             raise StdioTransportError(
                 f"Remote is missing promised objects: {resp['missing'][:5]}"
             )
-        return fetched
+        return len(received)
 
     def receive_pack(self, objects, updates, *, shallow=()):
-        resp, pack_fp = self._rpc(
-            lambda: {
-                "op": "receive-pack",
-                "updates": updates,
-                "shallow": sorted(shallow() if callable(shallow) else shallow),
-            },
-            objects,
+        """Not idempotent: only spawn failures (pre-write — no byte reached
+        the server) are retried."""
+        from kart_tpu.transport.retry import is_pre_write
+
+        def attempt():
+            resp, _ = self._rpc(
+                lambda: {
+                    "op": "receive-pack",
+                    "updates": updates,
+                    "shallow": sorted(shallow() if callable(shallow) else shallow),
+                },
+                objects,
+            )
+            return resp
+
+        resp = self.retry.call(
+            attempt, label="receive-pack", retryable=is_pre_write,
+            on_retry=self.reset,
         )
-        for _ in read_pack(pack_fp):
-            pass
         return resp["updated"]
 
 
@@ -244,9 +364,9 @@ def serve_stdio(repo, in_fp, out_fp):
     from kart_tpu.transport.pack import PackFormatError
     from kart_tpu.transport.service import (
         collect_blobs,
-        locked_ref_updates,
         ls_refs_info,
         make_fetch_enum,
+        quarantined_receive,
     )
 
     while True:
@@ -269,11 +389,11 @@ def serve_stdio(repo, in_fp, out_fp):
 
         try:
             if op == "receive-pack":
-                # drain the request pack before replying
-                with repo.odb.bulk_pack():
-                    for obj_type, content in read_pack(in_fp):
-                        repo.odb.write_raw(obj_type, content)
-                status, payload = locked_ref_updates(repo, header)
+                # the request pack drains into quarantine and migrates only
+                # after checksum + ref preconditions pass — a torn push
+                # leaves the store byte-identical (and desyncs the stream,
+                # handled by the PackFormatError close below)
+                status, payload = quarantined_receive(repo, header, in_fp)
                 if status == "ok":
                     write_framed(out_fp, {"updated": payload}, ())
                 else:

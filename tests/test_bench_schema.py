@@ -35,6 +35,16 @@ NEW_KEYS = [
     "import_serial_seconds",
 ]
 
+#: keys added by ISSUE 2 (fault-tolerant transport: the fetch-resume
+#: robustness metric — a killed transfer must cost a remainder, not a
+#: restart)
+NEW_KEYS += [
+    "fetch_resume_seconds",
+    "fetch_resume_objects_total",
+    "fetch_resume_objects_salvaged",
+    "fetch_resume_objects_resent",
+]
+
 
 def test_bench_emits_every_recorded_key():
     with open(os.path.join(REPO_ROOT, "bench.py")) as f:

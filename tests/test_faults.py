@@ -1,0 +1,582 @@
+"""Fault-tolerant transport: the KART_FAULTS injection matrix, retry with
+capped backoff, resumable fetch (remainder-only re-transfer), receive-pack
+quarantine (a torn/rejected push leaves the server store byte-identical),
+hung-transport watchdogs, and stale-crash-leftover sweeping.
+
+The production claims these tests pin down: a transfer killed at *any*
+frame boundary leaves an fsck-clean store and resumes on retry shipping
+only the missing remainder; a push torn mid-pack changes nothing on the
+server; no network verb can hang forever."""
+
+import hashlib
+import io
+import os
+import threading
+import time
+
+import pytest
+
+from kart_tpu import faults, transport
+from kart_tpu.core.objects import hash_object
+from kart_tpu.core.repo import KartRepo
+from kart_tpu.transport.http import HttpRemote, HttpTransportError, make_server
+from kart_tpu.transport.pack import PackFormatError, write_pack
+from kart_tpu.transport.remote import FETCH_RESUME_FILE, RemoteError
+from kart_tpu.transport.retry import (
+    RetryPolicy,
+    drain_pack_salvaging,
+    is_transient,
+)
+
+from helpers import edit_commit, make_imported_repo
+
+pytestmark = pytest.mark.faults
+
+
+# ---------------------------------------------------------------------------
+# helpers
+# ---------------------------------------------------------------------------
+
+
+def fsck_objects(repo):
+    """Every object physically in the store parses and hashes to its name
+    (the object-store half of `kart fsck`). -> object count."""
+    count = 0
+    for oid in repo.odb.iter_oids():
+        obj_type, content = repo.odb.read_raw(oid)
+        assert hash_object(obj_type, content) == oid, f"corrupt object {oid}"
+        count += 1
+    return count
+
+
+def store_snapshot(repo):
+    """{relpath: sha256} of every file under the repo's objects dir —
+    byte-identical means equal snapshots."""
+    objects_dir = repo.odb.objects_dir
+    snap = {}
+    for dirpath, _, filenames in os.walk(objects_dir):
+        for fn in filenames:
+            p = os.path.join(dirpath, fn)
+            with open(p, "rb") as f:
+                snap[os.path.relpath(p, objects_dir)] = hashlib.sha256(
+                    f.read()
+                ).hexdigest()
+    return snap
+
+
+@pytest.fixture()
+def served_repo(tmp_path):
+    """A two-commit points repo served over in-thread localhost HTTP."""
+    repo, ds_path = make_imported_repo(tmp_path, n=6)
+    edit_commit(
+        repo,
+        ds_path,
+        updates=[{"fid": 1, "geom": None, "name": "renamed", "rating": 9.0}],
+        message="second commit",
+    )
+    repo.config["receive.denyCurrentBranch"] = "ignore"
+    server = make_server(repo)
+    threading.Thread(target=server.serve_forever, daemon=True).start()
+    url = f"http://127.0.0.1:{server.server_address[1]}/"
+    yield repo, ds_path, url
+    server.shutdown()
+    server.server_close()
+
+
+@pytest.fixture(autouse=True)
+def _fast_retries(monkeypatch):
+    """Fault tests must not sleep through real backoff."""
+    monkeypatch.setenv("KART_TRANSPORT_RETRY_BASE", "0.01")
+    monkeypatch.setenv("KART_TRANSPORT_RETRY_CAP", "0.05")
+    monkeypatch.delenv("KART_FAULTS", raising=False)
+
+
+# ---------------------------------------------------------------------------
+# faults.py unit
+# ---------------------------------------------------------------------------
+
+
+def test_fault_hook_unarmed_is_none(monkeypatch):
+    monkeypatch.delenv("KART_FAULTS", raising=False)
+    assert faults.hook("transport.read.frame") is None
+
+
+def test_fault_fires_on_nth_hit_then_disarms(monkeypatch):
+    monkeypatch.setenv("KART_FAULTS", "p.x:3")
+    h = faults.hook("p.x")
+    h()
+    h()
+    with pytest.raises(faults.InjectedFault) as exc:
+        h()
+    assert exc.value.point == "p.x" and exc.value.hit == 3
+    # one-shot: a retry after the injected failure sails through
+    for _ in range(10):
+        h()
+    # other points unarmed
+    assert faults.hook("p.other") is None
+
+
+def test_fault_spec_change_resets(monkeypatch):
+    monkeypatch.setenv("KART_FAULTS", "p.y:1")
+    with pytest.raises(faults.InjectedFault):
+        faults.fire("p.y")
+    monkeypatch.setenv("KART_FAULTS", "p.y:2")  # new spec: counters reset
+    faults.fire("p.y")
+    with pytest.raises(faults.InjectedFault):
+        faults.fire("p.y")
+    assert is_transient(faults.InjectedFault("p.y", 2))  # an OSError
+
+
+# ---------------------------------------------------------------------------
+# retry policy unit
+# ---------------------------------------------------------------------------
+
+
+def test_retry_policy_backoff_capped_exponential():
+    sleeps = []
+    p = RetryPolicy(attempts=5, base_delay=1.0, max_delay=3.0, sleep=sleeps.append)
+    calls = []
+
+    def flaky():
+        calls.append(1)
+        if len(calls) < 5:
+            raise ConnectionResetError("boom")
+        return "ok"
+
+    assert p.call(flaky) == "ok"
+    assert sleeps == [1.0, 2.0, 3.0, 3.0]  # doubled, then capped
+
+
+def test_retry_policy_gives_up_and_skips_non_transient():
+    sleeps = []
+    p = RetryPolicy(attempts=3, base_delay=0.5, sleep=sleeps.append)
+    with pytest.raises(ConnectionResetError):
+        p.call(lambda: (_ for _ in ()).throw(ConnectionResetError()))
+    assert len(sleeps) == 2  # attempts-1 backoffs
+
+    sleeps.clear()
+    with pytest.raises(ValueError):  # not transient: no retry at all
+        p.call(lambda: (_ for _ in ()).throw(ValueError("deterministic")))
+    assert sleeps == []
+    # server-reported op errors are explicitly non-transient
+    assert not is_transient(HttpTransportError("op failed"))
+    assert is_transient(HttpTransportError("conn", transient=True))
+
+
+def test_retry_policy_from_config_env_precedence(tmp_path, monkeypatch):
+    repo = KartRepo.init_repository(tmp_path / "r")
+    repo.config.set_many(
+        {"remote.origin.retries": "7", "remote.origin.retrybasedelay": "0.5"}
+    )
+    monkeypatch.delenv("KART_TRANSPORT_RETRY_BASE", raising=False)
+    monkeypatch.delenv("KART_TRANSPORT_RETRY_CAP", raising=False)
+    p = RetryPolicy.from_config(repo.config, "origin")
+    assert p.attempts == 7 and p.base_delay == 0.5
+    monkeypatch.setenv("KART_TRANSPORT_RETRIES", "2")
+    assert RetryPolicy.from_config(repo.config, "origin").attempts == 2
+
+
+# ---------------------------------------------------------------------------
+# torn packstreams (satellite: truncation + corrupted trailer)
+# ---------------------------------------------------------------------------
+
+
+def _pack_bytes(objects):
+    buf = io.BytesIO()
+    write_pack(buf, iter(objects))
+    return buf.getvalue()
+
+
+@pytest.fixture()
+def empty_repo(tmp_path):
+    return KartRepo.init_repository(tmp_path / "dst")
+
+
+OBJECTS = [("blob", b"alpha"), ("blob", b"beta"), ("blob", b"gamma" * 100)]
+
+
+def test_truncated_packstream_salvages_and_resumes(empty_repo):
+    raw = _pack_bytes(OBJECTS)
+    # cut mid-way: some objects land, the rest is gone
+    received = set()
+    with pytest.raises(PackFormatError):
+        drain_pack_salvaging(empty_repo.odb, io.BytesIO(raw[: len(raw) // 2]), received)
+    n_salvaged = fsck_objects(empty_repo)  # fsck-clean whatever landed
+    assert n_salvaged == len(received) < len(OBJECTS)
+    # retry with the full stream succeeds; store complete and clean
+    drain_pack_salvaging(empty_repo.odb, io.BytesIO(raw), received)
+    assert fsck_objects(empty_repo) == len(OBJECTS)
+    for _, content in OBJECTS:
+        assert empty_repo.odb.contains(hash_object("blob", content))
+
+
+def test_corrupt_checksum_trailer_raises_cleanly(empty_repo):
+    raw = bytearray(_pack_bytes(OBJECTS))
+    raw[-1] ^= 0xFF  # flip a trailer byte: framing checksum mismatch
+    with pytest.raises(PackFormatError, match="checksum"):
+        drain_pack_salvaging(empty_repo.odb, io.BytesIO(bytes(raw)), set())
+    # the records themselves were individually verified: all salvaged, clean
+    assert fsck_objects(empty_repo) == len(OBJECTS)
+    drain_pack_salvaging(empty_repo.odb, io.BytesIO(_pack_bytes(OBJECTS)), set())
+    assert fsck_objects(empty_repo) == len(OBJECTS)  # dedupe: no growth
+
+
+def test_truncation_before_any_object_leaves_store_empty(empty_repo):
+    raw = _pack_bytes(OBJECTS)
+    with pytest.raises(PackFormatError):
+        drain_pack_salvaging(empty_repo.odb, io.BytesIO(raw[:4]), set())
+    assert fsck_objects(empty_repo) == 0
+    assert not os.path.isdir(os.path.join(empty_repo.odb.objects_dir, "pack")) or not [
+        n
+        for n in os.listdir(os.path.join(empty_repo.odb.objects_dir, "pack"))
+        if not n.startswith(".")
+    ]
+
+
+# ---------------------------------------------------------------------------
+# the fault matrix: fetch killed at every frame boundary, then resumed
+# ---------------------------------------------------------------------------
+
+
+def test_fetch_killed_at_every_frame_boundary_resumes_remainder_only(
+    served_repo, tmp_path, monkeypatch
+):
+    """The acceptance criterion: for every frame boundary N, a fetch_pack
+    killed there leaves an fsck-clean partial store, and the retry —
+    re-negotiated with the salvaged oids excluded — ships exactly the
+    missing remainder (asserted by object counts)."""
+    repo, ds_path, url = served_repo
+
+    # ground truth: a clean full fetch
+    ref = KartRepo.init_repository(tmp_path / "ref")
+    http = HttpRemote(url, retry=RetryPolicy(attempts=1))
+    info = http.ls_refs()
+    wants = list(info["heads"].values()) + list(info["tags"].values())
+    total = http.fetch_pack(ref, wants)["object_count"]
+    assert total > 5
+
+    for n in range(1, total + 2):  # +1: the END-record boundary
+        dst = KartRepo.init_repository(tmp_path / f"kill{n}")
+        client = HttpRemote(url, retry=RetryPolicy(attempts=1))
+        monkeypatch.setenv("KART_FAULTS", f"transport.read.frame:{n}")
+        with pytest.raises((faults.InjectedFault, PackFormatError)):
+            client.fetch_pack(dst, wants)
+        monkeypatch.delenv("KART_FAULTS")
+        received = fsck_objects(dst)  # salvage is fsck-clean
+        assert received == n - 1  # everything before the killed frame landed
+        # resume: exclude what we already hold; only the remainder ships
+        header = client.fetch_pack(
+            dst, wants, exclude=set(dst.odb.iter_oids())
+        )
+        assert header["object_count"] == total - received
+        assert fsck_objects(dst) == total
+
+
+def test_clone_retries_transparently_through_fault(served_repo, tmp_path, monkeypatch):
+    """End-to-end: with retry enabled (the default), a mid-transfer
+    disconnect is invisible — clone just succeeds, resumed."""
+    repo, ds_path, url = served_repo
+    monkeypatch.setenv("KART_FAULTS", "transport.read.frame:5")
+    clone = transport.clone(url, tmp_path / "clone", do_checkout=False)
+    assert clone.head_commit_oid == repo.head_commit_oid
+    assert len(list(clone.datasets("HEAD")[ds_path].features())) == 6
+    fsck_objects(clone)
+    # transfer completed: the resume marker is gone
+    assert clone.read_gitdir_file(FETCH_RESUME_FILE) is None
+
+
+def test_interrupted_clone_kept_and_resumed_by_fetch(
+    served_repo, tmp_path, monkeypatch
+):
+    """A clone whose transfer dies (with retries exhausted) keeps the
+    partial repo + FETCH_RESUME marker — `kart fetch` resumes it instead of
+    restarting from zero."""
+    repo, ds_path, url = served_repo
+    monkeypatch.setenv("KART_TRANSPORT_RETRIES", "1")  # no auto-retry
+    monkeypatch.setenv("KART_FAULTS", "transport.read.frame:6")
+    directory = tmp_path / "partial"
+    with pytest.raises(RemoteError, match="resume"):
+        transport.clone(url, directory, do_checkout=False)
+    monkeypatch.delenv("KART_FAULTS")
+
+    resumed = KartRepo(str(directory))
+    marker = resumed.read_gitdir_file(FETCH_RESUME_FILE)
+    assert marker is not None
+    salvaged = fsck_objects(resumed)
+    assert salvaged == 5
+    # the marker records remote + the salvaged oids, so resume doesn't
+    # rescan the store
+    lines = marker.splitlines()
+    assert lines[0] == "origin"
+    assert sorted(lines[1:]) == sorted(resumed.odb.iter_oids())
+
+    updated = transport.fetch(resumed, "origin")
+    assert updated.get("refs/remotes/origin/main") == repo.head_commit_oid
+    assert resumed.read_gitdir_file(FETCH_RESUME_FILE) is None
+    assert fsck_objects(resumed) == fsck_objects(repo)
+
+
+# ---------------------------------------------------------------------------
+# receive-pack quarantine
+# ---------------------------------------------------------------------------
+
+
+def quarantine_entries(repo):
+    q = os.path.join(repo.odb.objects_dir, "quarantine")
+    return os.listdir(q) if os.path.isdir(q) else []
+
+
+def test_torn_push_leaves_server_store_byte_identical(
+    served_repo, tmp_path, monkeypatch
+):
+    """The acceptance criterion: a push killed mid-pack changes nothing on
+    the server — no new loose objects, no new packs, no ref movement, no
+    quarantine debris — and succeeds when retried."""
+    repo, ds_path, url = served_repo
+    clone = transport.clone(url, tmp_path / "clone", do_checkout=False)
+    clone.config.set_many({"user.name": "C", "user.email": "c@example.com"})
+    new_oid = edit_commit(clone, ds_path, deletes=[2], message="to push")
+
+    before = store_snapshot(repo)
+    ref_before = repo.refs.get("refs/heads/main")
+    # the server's quarantine drain is the only read_pack in a push flow
+    monkeypatch.setenv("KART_FAULTS", "transport.read.frame:2")
+    with pytest.raises(RemoteError):
+        transport.push(clone, "origin")
+    monkeypatch.delenv("KART_FAULTS")
+
+    assert store_snapshot(repo) == before
+    assert repo.refs.get("refs/heads/main") == ref_before
+    assert quarantine_entries(repo) == []
+    fsck_objects(repo)
+
+    # retried push succeeds and lands exactly the new objects
+    assert transport.push(clone, "origin") == {"refs/heads/main": new_oid}
+    assert repo.refs.get("refs/heads/main") == new_oid
+    assert repo.odb.contains(new_oid)
+    assert quarantine_entries(repo) == []
+
+
+def test_rejected_push_leaves_server_store_byte_identical(served_repo, tmp_path):
+    """A push failing its preconditions (non-fast-forward CAS) discards the
+    quarantine: the server store holds no trace of the rejected objects."""
+    repo, ds_path, url = served_repo
+    clone = transport.clone(url, tmp_path / "clone", do_checkout=False)
+    clone.config.set_many({"user.name": "C", "user.email": "c@example.com"})
+    edit_commit(repo, ds_path, deletes=[4], message="upstream moved")
+    local_oid = edit_commit(clone, ds_path, deletes=[5], message="local change")
+
+    before = store_snapshot(repo)
+    with pytest.raises(RemoteError, match="non-fast-forward"):
+        transport.push(clone, "origin")
+    assert store_snapshot(repo) == before
+    assert not repo.odb.contains(local_oid)
+    assert quarantine_entries(repo) == []
+
+
+# ---------------------------------------------------------------------------
+# timeouts + watchdog + close
+# ---------------------------------------------------------------------------
+
+
+def test_http_timeout_env(monkeypatch):
+    from kart_tpu.transport.http import DEFAULT_HTTP_TIMEOUT, http_timeout
+
+    monkeypatch.delenv("KART_HTTP_TIMEOUT", raising=False)
+    assert http_timeout() == DEFAULT_HTTP_TIMEOUT
+    monkeypatch.setenv("KART_HTTP_TIMEOUT", "2.5")
+    assert http_timeout() == 2.5
+    monkeypatch.setenv("KART_HTTP_TIMEOUT", "junk")
+    assert http_timeout() == DEFAULT_HTTP_TIMEOUT
+
+
+def test_http_dead_server_fails_fast(monkeypatch):
+    """A server that accepts but never answers must fail in ~the socket
+    timeout, not hang forever."""
+    import socket
+
+    srv = socket.socket()
+    srv.bind(("127.0.0.1", 0))
+    srv.listen(1)
+    port = srv.getsockname()[1]
+    monkeypatch.setenv("KART_HTTP_TIMEOUT", "0.5")
+    client = HttpRemote(f"http://127.0.0.1:{port}/", retry=RetryPolicy(attempts=1))
+    t0 = time.monotonic()
+    with pytest.raises(HttpTransportError) as exc:
+        client.ls_refs()
+    assert time.monotonic() - t0 < 10
+    assert exc.value.transient
+    srv.close()
+
+
+def test_receive_pack_retries_only_pre_write(monkeypatch):
+    """Connection refused is pre-write (the server saw nothing): the one
+    failure mode a non-idempotent push RPC retries."""
+    import socket
+
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]  # nothing listens here now
+
+    sleeps = []
+    client = HttpRemote(
+        f"http://127.0.0.1:{port}/",
+        retry=RetryPolicy(attempts=3, base_delay=0.01, sleep=sleeps.append),
+    )
+    with pytest.raises(HttpTransportError):
+        client.receive_pack([], [{"ref": "refs/heads/x", "old": None, "new": None}])
+    assert len(sleeps) == 2  # refused ⇒ pre-write ⇒ retried to exhaustion
+
+
+def _install_sleeper_ssh(tmp_path, monkeypatch):
+    """A fake ssh that never speaks the protocol — a hung tunnel."""
+    script = tmp_path / "hung-ssh"
+    script.write_text("#!/bin/sh\nexec sleep 600\n")
+    script.chmod(0o755)
+    monkeypatch.setenv("KART_SSH", str(script))
+
+
+def test_stdio_watchdog_kills_hung_ssh(tmp_path, monkeypatch):
+    from kart_tpu.transport.stdio import StdioRemote, StdioTransportError
+
+    _install_sleeper_ssh(tmp_path, monkeypatch)
+    monkeypatch.setenv("KART_STDIO_TIMEOUT", "0.5")
+    client = StdioRemote("testhost:/srv/repo", retry=RetryPolicy(attempts=1))
+    t0 = time.monotonic()
+    with pytest.raises(StdioTransportError, match="did not respond"):
+        client.ls_refs()
+    assert time.monotonic() - t0 < 30
+    client.close()
+
+
+def test_stdio_close_is_bounded_and_idempotent(tmp_path, monkeypatch):
+    from kart_tpu.transport.stdio import StdioRemote
+
+    _install_sleeper_ssh(tmp_path, monkeypatch)
+    client = StdioRemote("testhost:/srv/repo")
+    proc = client._ensure()
+    assert proc.poll() is None
+    t0 = time.monotonic()
+    client.close(timeout=0.5)  # sleep ignores the pipe close: must kill
+    assert time.monotonic() - t0 < 10
+    assert proc.poll() is not None  # dead and reaped: no zombie
+    client.close()  # double-close is a no-op
+    client.close(timeout=0.0)
+    # and __del__ after close must not raise either
+    client.__del__()
+
+
+# ---------------------------------------------------------------------------
+# stale crash-leftover sweep (gc + fsck)
+# ---------------------------------------------------------------------------
+
+
+def test_gc_sweeps_stale_crash_leftovers(tmp_path):
+    repo = KartRepo.init_repository(tmp_path / "r")
+    gitdir = repo.gitdir
+    old = time.time() - 7200
+
+    def make(path, mtime=None, directory=False):
+        os.makedirs(os.path.dirname(path), exist_ok=True)
+        if directory:
+            os.makedirs(path, exist_ok=True)
+        else:
+            with open(path, "w") as f:
+                f.write("x")
+        if mtime is not None:
+            os.utime(path, (mtime, mtime))
+        return path
+
+    stale = [
+        make(os.path.join(gitdir, "objects", "ab", "cd" * 19 + ".tmp123"), old),
+        make(os.path.join(gitdir, "objects", "pack", ".tmp-pack-xyz"), old),
+        make(os.path.join(gitdir, "refs", "heads", "main.lock999"), old),
+        make(os.path.join(gitdir, "config.lock123"), old),
+        make(
+            os.path.join(gitdir, "objects", "quarantine", "incoming-dead"),
+            old,
+            directory=True,
+        ),
+    ]
+    fresh = make(os.path.join(gitdir, "refs", "heads", "topic.lock1"))
+    real_ref = make(os.path.join(gitdir, "refs", "heads", "keepme"), old)
+
+    found = set(repo.find_stale_leftovers())
+    assert found == set(stale)
+
+    stats = repo.gc()
+    assert stats["pruned"] == len(stale)
+    for p in stale:
+        assert not os.path.exists(p)
+    assert os.path.exists(fresh)  # inside the grace period: survives
+    assert os.path.exists(real_ref)  # not a temp name: never touched
+
+    # --prune-now ignores the grace period
+    stats = repo.gc("--prune-now")
+    assert stats["pruned"] == 1
+    assert not os.path.exists(fresh)
+
+
+def test_fsck_reports_stale_leftovers(tmp_path, monkeypatch):
+    from click.testing import CliRunner
+
+    from kart_tpu.cli import cli
+
+    repo, _ = make_imported_repo(tmp_path, n=3)
+    old = time.time() - 7200
+    p = os.path.join(repo.gitdir, "refs", "heads", "main.lock999")
+    with open(p, "w") as f:
+        f.write("x")
+    os.utime(p, (old, old))
+
+    monkeypatch.chdir(repo.workdir)
+    r = CliRunner().invoke(cli, ["fsck"])
+    assert r.exit_code == 0, r.output  # debris is a warning, not corruption
+    assert "stale" in r.output and "main.lock999" in r.output
+
+    r = CliRunner().invoke(cli, ["gc"])
+    assert r.exit_code == 0, r.output
+    assert not os.path.exists(p)
+
+
+# ---------------------------------------------------------------------------
+# odb / pack finalisation fault points
+# ---------------------------------------------------------------------------
+
+
+def test_bulk_pack_finalise_fault_leaves_sweepable_debris(tmp_path, monkeypatch):
+    """A crash between pack body and finalisation must leave only temp
+    debris the sweeper recognises — never a half-valid pack the reader
+    would trust."""
+    repo = KartRepo.init_repository(tmp_path / "r")
+    monkeypatch.setenv("KART_FAULTS", "pack.finalise:1")
+    with pytest.raises(faults.InjectedFault):
+        with repo.odb.bulk_pack():
+            repo.odb.write_raw("blob", b"doomed")
+    monkeypatch.delenv("KART_FAULTS")
+    pack_dir = os.path.join(repo.odb.objects_dir, "pack")
+    leftovers = os.listdir(pack_dir)
+    assert all(n.startswith(".tmp-pack-") for n in leftovers)
+    assert fsck_objects(repo) == 0
+    # the sweeper claims exactly that debris
+    assert repo.gc("--prune-now")["pruned"] == len(leftovers)
+    assert os.listdir(pack_dir) == []
+
+
+def test_fetch_blobs_retry_refetches_only_missing(served_repo, tmp_path, monkeypatch):
+    """Promisor backfill is idempotent: after a torn attempt the retry
+    re-requests only the oids that didn't land."""
+    repo, ds_path, url = served_repo
+    clone = transport.clone(url, tmp_path / "clone", do_checkout=False)
+    blob_oids = [
+        e.oid
+        for _, e in repo.datasets("HEAD")[ds_path].feature_tree.walk_blobs()
+    ]
+    assert len(blob_oids) >= 3
+    dst = KartRepo.init_repository(tmp_path / "blobs")
+    client = HttpRemote(url)  # default policy: retries enabled
+    monkeypatch.setenv("KART_FAULTS", "transport.read.frame:2")
+    fetched = client.fetch_blobs(dst, blob_oids)
+    assert fetched == len(set(blob_oids))
+    for oid in blob_oids:
+        assert dst.odb.contains(oid)
