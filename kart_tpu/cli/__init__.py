@@ -54,6 +54,7 @@ _COMMANDS = {
     "upgrade-to-tidy": "kart_tpu.cli.upgrade_cmds",
     "commit-files": "kart_tpu.cli.data_cmds",
     "build-annotations": "kart_tpu.cli.data_cmds",
+    "stats": "kart_tpu.cli.stats_cmds",
 }
 
 
@@ -115,19 +116,44 @@ class KartGroup(click.Group):
 )
 @click.version_option(version=kart_tpu.__version__, prog_name="kart (kart_tpu)")
 @click.option("-v", "--verbose", count=True, help="Increase verbosity (-v, -vv)")
+@click.option(
+    "--trace",
+    "trace_flag",
+    is_flag=True,
+    help="Record a Chrome trace of this command (written on exit; "
+    "KART_TRACE=<path> picks the file)",
+)
 @click.pass_context
-def cli(ctx, repo_dir, verbose):
+def cli(ctx, repo_dir, verbose, trace_flag):
     """kart_tpu — TPU-native distributed version control for geospatial data."""
+    from kart_tpu import telemetry
+
     ctx.obj = Context()
     if repo_dir:
         ctx.obj.repo_path = repo_dir
+    # always configured (not only on -v): one kart_tpu logger, one format,
+    # KART_LOG honoured for level — servers and library re-entry included
+    telemetry.configure_logging(verbose)
+    telemetry.enable_from_env()
+    if trace_flag and not telemetry.tracing_enabled():
+        telemetry.enable(trace=True, trace_path=telemetry.default_trace_path())
     if verbose:
-        import logging
+        telemetry.enable(spans=True)  # feeds the end-of-command summary
+    if ctx.invoked_subcommand:
+        telemetry.incr("cli.commands", cmd=ctx.invoked_subcommand)
 
-        logging.basicConfig(
-            level=logging.DEBUG if verbose > 1 else logging.INFO,
-            format="%(asctime)s %(levelname)s %(name)s %(message)s",
-        )
+    @ctx.call_on_close
+    def _flush_telemetry():
+        from kart_tpu.telemetry import sinks
+
+        if telemetry.tracing_enabled():
+            path = sinks.write_chrome_trace()
+            if path:
+                click.echo(f"Trace written to {path}", err=True)
+        if verbose:
+            summary = sinks.phase_summary_text()
+            if summary:
+                click.echo(summary, err=True)
 
 
 def add_command(name, fn):

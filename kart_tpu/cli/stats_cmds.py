@@ -1,0 +1,99 @@
+"""``kart stats`` — dump telemetry metrics (reference analog: none; this is
+the operational window the reference gets from git's trace2, exposed here
+as a Prometheus-style text exposition, docs/OBSERVABILITY.md §4).
+
+Against a *target* (an http(s):// or ssh:// URL, or a configured remote
+name) it asks the running transport server for its live metric registry —
+request counts per verb, bytes shipped, fetch resumes, receive-pack
+outcomes, retry/watchdog counters. With no target it dumps this process's
+own registry (useful after ``KART_METRICS=1 kart …`` in scripts/tests).
+"""
+
+import json as _json
+
+import click
+
+from kart_tpu.cli import CliError, cli
+
+
+def _resolve_target(ctx, target):
+    """remote name -> its configured URL (needs a repo); URLs pass
+    through."""
+    from kart_tpu.transport.remote import is_http_url
+    from kart_tpu.transport.stdio import is_ssh_url
+
+    if is_http_url(target) or is_ssh_url(target):
+        return target
+    repo = ctx.repo  # raises a UsageError outside a repo
+    url = repo.remote_url(target)
+    if url is None:
+        raise CliError(f"No such remote: {target!r}")
+    return url
+
+
+def fetch_remote_stats(url):
+    """-> the Prometheus text exposition of the server at ``url``."""
+    from kart_tpu.transport.http import API, http_timeout
+    from kart_tpu.transport.remote import is_http_url
+    from kart_tpu.transport.stdio import StdioRemote, is_ssh_url
+
+    if is_http_url(url):
+        from urllib.request import Request, urlopen
+
+        with urlopen(
+            Request(url.rstrip("/") + f"{API}/stats"), timeout=http_timeout()
+        ) as resp:
+            return resp.read().decode()
+    if is_ssh_url(url):
+        remote = StdioRemote(url)
+        try:
+            resp, _ = remote._rpc({"op": "stats"})
+        finally:
+            remote.close()
+        return resp.get("metrics", "")
+    raise CliError(
+        f"Cannot fetch stats from {url!r}: expected an http(s):// or "
+        f"ssh:// URL (or a configured remote name)"
+    )
+
+
+@cli.command()
+@click.option(
+    "--output-format",
+    "-o",
+    type=click.Choice(["text", "json"]),
+    default="text",
+    help="text = Prometheus exposition; json = structured snapshot "
+    "(local registry only)",
+)
+@click.argument("target", required=False)
+@click.pass_obj
+def stats(ctx, output_format, target):
+    """Dump telemetry metrics.
+
+    TARGET: an http(s):// or ssh:// server URL, or a configured remote
+    name — the running server's metrics are fetched and printed. Without
+    TARGET, this process's own metric registry is dumped (enable with
+    KART_METRICS=1).
+    """
+    from kart_tpu import telemetry
+    from kart_tpu.telemetry import sinks
+
+    if target:
+        try:
+            text = fetch_remote_stats(_resolve_target(ctx, target))
+        except OSError as e:
+            raise CliError(f"Cannot reach {target!r}: {e}")
+        click.echo(text.rstrip("\n"))
+        return
+    if output_format == "json":
+        click.echo(_json.dumps(telemetry.snapshot(), indent=2, default=str))
+        return
+    text = sinks.prometheus_text()
+    if text:
+        click.echo(text.rstrip("\n"))
+    else:
+        click.echo(
+            "# no metrics recorded in this process "
+            "(enable with KART_METRICS=1, or pass a server URL)"
+        )

@@ -19,6 +19,7 @@ from enum import Enum
 from functools import lru_cache
 
 from kart_tpu import faults
+from kart_tpu import telemetry as tm
 from kart_tpu.core.objects import (
     Commit,
     ObjectFormatError,
@@ -94,7 +95,7 @@ class ObjectDb:
         concurrent bulk writers (e.g. two HTTP pushes on the threading
         server) block on the lock instead of interleaving objects into each
         other's packs."""
-        with self._bulk_lock:
+        with self._bulk_lock, tm.span("odb.bulk_pack"):
             w = self.pack_writer(level=level)
             self._bulk_writer = w
             try:
@@ -105,6 +106,7 @@ class ObjectDb:
                 raise
             self._bulk_writer = None
             faults.fire("odb.bulk_pack")
+            tm.incr("odb.objects_written", w.object_count)
             if w.finish() is not None:
                 self.packs.refresh()
 
@@ -187,6 +189,7 @@ class ObjectDb:
 
     def read_raw(self, oid):
         """-> (type_str, content bytes). Raises ObjectMissing/ObjectPromised."""
+        tm.incr("odb.objects_read")
         path = self._find(oid)
         if path is None:
             sha = bytes.fromhex(oid)
@@ -221,12 +224,17 @@ class ObjectDb:
                 shas[bytes.fromhex(o)] = o
             except ValueError:
                 continue
-        got = self.packs.read_batch(list(shas))
-        return {
+        with tm.span("odb.read_blobs_batch", requested=len(shas)):
+            got = self.packs.read_batch(list(shas))
+        out = {
             shas[s]: content
             for s, (obj_type, content) in got.items()
             if obj_type == "blob"
         }
+        if tm.metrics_enabled():
+            tm.incr("odb.blobs_read", len(out))
+            tm.incr("odb.bytes_inflated", sum(len(c) for c in out.values()))
+        return out
 
     def read_blobs_data_ordered(self, shas):
         """[20-byte sha] -> [blob bytes | None] in request order via the
@@ -234,7 +242,13 @@ class ObjectDb:
         fused materialiser's read path. None entries (loose objects, delta
         records, promised/missing, native unavailable) are the caller's job
         via the per-object :meth:`read_blob`."""
-        return self.packs.read_blob_data_ordered(shas)
+        with tm.span("odb.read_blobs_ordered", requested=len(shas)):
+            out = self.packs.read_blob_data_ordered(shas)
+        if tm.metrics_enabled():
+            served = [d for d in out if d is not None]
+            tm.incr("odb.blobs_read", len(served))
+            tm.incr("odb.bytes_inflated", sum(len(d) for d in served))
+        return out
 
     def write_raw(self, obj_type, content) -> str:
         faults.fire("odb.write_raw")

@@ -32,6 +32,8 @@ import tempfile
 import zlib
 from binascii import crc32
 
+from kart_tpu import telemetry as tm
+
 OBJ_COMMIT = 1
 OBJ_TREE = 2
 OBJ_BLOB = 3
@@ -276,6 +278,7 @@ class Packfile:
             raise PackFormatError("Delta chain too deep")
         cached = self._cache.get(offset)
         if cached is not None:
+            tm.incr("packs.record_cache_hits")
             return cached
         obj_type, size, pos = _decode_varint_header(self._mm, offset)
         if obj_type == OBJ_OFS_DELTA:
@@ -473,6 +476,7 @@ class PackCollection:
                 # rescan, never a real change (a pack that landed within
                 # 200ms of the previous refresh must still become visible)
                 self._last_refresh_ns = now
+                tm.incr("packs.rescans")
                 self.refresh()
                 return True
             if (
@@ -481,6 +485,7 @@ class PackCollection:
                 and not rate_limited
             ):
                 self._last_refresh_ns = now
+                tm.incr("packs.rescans")
                 self.refresh()
                 return True
         return False
@@ -546,6 +551,10 @@ class PackCollection:
                 break
             filled = pack.read_blob_data_into(sub, out, slots)
             if filled.any():
+                if pack is pref:
+                    # the previous call's pack served again: the open-pack
+                    # memo saved a full miss-probe over every other index
+                    tm.incr("packs.open_cache_hits")
                 if pack is not pref and filled.sum() * 2 >= len(filled):
                     self._blob_pack_pref = pack
                 keep = [i for i, f in enumerate(filled.tolist()) if not f]
@@ -743,6 +752,8 @@ class PackWriter:
         os.fsync(f.fileno())  # the importer updates refs only after this —
         f.close()  # the pack must actually be on disk, not in page cache
 
+        tm.incr("packs.packs_written")
+        tm.incr("packs.objects_packed", self._count)
         name = pack_sha.hex()
         self.pack_path = os.path.join(self.pack_dir, f"pack-{name}.pack")
         self.idx_path = os.path.join(self.pack_dir, f"pack-{name}.idx")

@@ -13,6 +13,7 @@ Two layers:
 
 import numpy as np
 
+from kart_tpu import telemetry as tm
 from kart_tpu.core.odb import TreeView
 from kart_tpu.diff.key_filters import RepoKeyFilter
 from kart_tpu.diff.structs import (
@@ -109,25 +110,29 @@ def get_feature_diff(base_ds, target_ds, ds_filter=None):
         return result
 
     odb = (base_tree or target_tree).odb
-    for path, old_oid, new_oid in tree_diff_entries(odb, base_oid, target_oid):
-        ds = base_ds if old_oid is not None else target_ds
-        pks = ds.decode_path_to_pks(path)
-        key = pks[0] if len(pks) == 1 else pks
-        if feature_filter is not None and key not in feature_filter:
-            continue
-        # values resolve by the oid the tree diff already produced — no
-        # second path->tree walk at materialisation time
-        old = (
-            KeyValue((key, base_ds.get_feature_promise_from_oid(pks, old_oid)))
-            if old_oid is not None
-            else None
-        )
-        new = (
-            KeyValue((key, target_ds.get_feature_promise_from_oid(pks, new_oid)))
-            if new_oid is not None
-            else None
-        )
-        result.add_delta(Delta(old, new))
+    # the span covers walk + (lazy) delta construction: the walk stays a
+    # streamed generator — buffering it just to time it would add an
+    # O(changed) transient at exactly the scale this engine serves
+    with tm.span("diff.tree_walk"):
+        for path, old_oid, new_oid in tree_diff_entries(odb, base_oid, target_oid):
+            ds = base_ds if old_oid is not None else target_ds
+            pks = ds.decode_path_to_pks(path)
+            key = pks[0] if len(pks) == 1 else pks
+            if feature_filter is not None and key not in feature_filter:
+                continue
+            # values resolve by the oid the tree diff already produced — no
+            # second path->tree walk at materialisation time
+            old = (
+                KeyValue((key, base_ds.get_feature_promise_from_oid(pks, old_oid)))
+                if old_oid is not None
+                else None
+            )
+            new = (
+                KeyValue((key, target_ds.get_feature_promise_from_oid(pks, new_oid)))
+                if new_oid is not None
+                else None
+            )
+            result.add_delta(Delta(old, new))
     return result
 
 
@@ -181,13 +186,16 @@ def get_feature_diff_columnar(base_ds, target_ds, ds_filter=None, *, blocks=None
 
     from kart_tpu.parallel.sharded_diff import classify_blocks_sharded, should_shard
 
-    if should_shard(max(old_block.count, new_block.count)):
-        # >1 device: shard-local classify over the mesh (block-cyclic
-        # PK partition; only the count vector crosses ICI)
-        old_class, new_class, _ = classify_blocks_sharded(old_block, new_block)
-    else:
-        old_class, new_class, _ = classify_blocks(old_block, new_block)
-    old_idx, new_idx = changed_indices(old_class, new_class)
+    with tm.span(
+        "diff.classify", rows=max(old_block.count, new_block.count)
+    ):
+        if should_shard(max(old_block.count, new_block.count)):
+            # >1 device: shard-local classify over the mesh (block-cyclic
+            # PK partition; only the count vector crosses ICI)
+            old_class, new_class, _ = classify_blocks_sharded(old_block, new_block)
+        else:
+            old_class, new_class, _ = classify_blocks(old_block, new_block)
+        old_idx, new_idx = changed_indices(old_class, new_class)
 
     # Cross-version collision guard (hash-encoded datasets): a deleted pk X
     # and an inserted pk Y can share a 63-bit key, which the join would
@@ -298,52 +306,53 @@ def spatial_prefilter_blocks(old_block, new_block, rect_wsen):
         return None
     o_n, n_n = old_block.count, new_block.count
     query = np.asarray(rect_wsen, dtype=np.float64)
-    o_idx = np.flatnonzero(_envelope_hits(old_block, query))
-    n_idx = np.flatnonzero(_envelope_hits(new_block, query))
-    o_keys = old_block.keys[:o_n]
-    n_keys = new_block.keys[:n_n]
-    # propagate hits to the other side's matching keys (both key-sorted):
-    # binary-search the (few) hit keys into the other side, union the
-    # matching row indices in
-    if o_n and n_n:
-        n_hit_keys = np.asarray(n_keys[n_idx])
-        o_hit_keys = np.asarray(o_keys[o_idx])
-        if o_n == n_n and np.array_equal(o_hit_keys, n_hit_keys):
-            # identical hit-key sets on both sides (edits that don't move
-            # geometry — the overwhelmingly common case): each side's rows
-            # matching the other's hit keys ARE its own hit rows (keys are
-            # unique and sorted), so the binary-search probe storm into the
-            # 100M-row key mmaps — scattered page faults at north-star
-            # scale — is skipped entirely
-            o_surv, n_surv = o_idx, n_idx
+    with tm.span("diff.prefilter", rows=max(o_n, n_n)):
+        o_idx = np.flatnonzero(_envelope_hits(old_block, query))
+        n_idx = np.flatnonzero(_envelope_hits(new_block, query))
+        o_keys = old_block.keys[:o_n]
+        n_keys = new_block.keys[:n_n]
+        # propagate hits to the other side's matching keys (both key-sorted):
+        # binary-search the (few) hit keys into the other side, union the
+        # matching row indices in
+        if o_n and n_n:
+            n_hit_keys = np.asarray(n_keys[n_idx])
+            o_hit_keys = np.asarray(o_keys[o_idx])
+            if o_n == n_n and np.array_equal(o_hit_keys, n_hit_keys):
+                # identical hit-key sets on both sides (edits that don't move
+                # geometry — the overwhelmingly common case): each side's rows
+                # matching the other's hit keys ARE its own hit rows (keys are
+                # unique and sorted), so the binary-search probe storm into the
+                # 100M-row key mmaps — scattered page faults at north-star
+                # scale — is skipped entirely
+                o_surv, n_surv = o_idx, n_idx
+            else:
+                pos = np.searchsorted(o_keys, n_hit_keys)
+                pos_c = np.minimum(pos, o_n - 1)
+                shared = (np.asarray(o_keys[pos_c]) == n_hit_keys) & (pos < o_n)
+                o_surv = np.union1d(o_idx, pos_c[shared])
+                pos2 = np.searchsorted(n_keys, o_hit_keys)
+                pos2_c = np.minimum(pos2, n_n - 1)
+                shared2 = (np.asarray(n_keys[pos2_c]) == o_hit_keys) & (pos2 < n_n)
+                n_surv = np.union1d(n_idx, pos2_c[shared2])
         else:
-            pos = np.searchsorted(o_keys, n_hit_keys)
-            pos_c = np.minimum(pos, o_n - 1)
-            shared = (np.asarray(o_keys[pos_c]) == n_hit_keys) & (pos < o_n)
-            o_surv = np.union1d(o_idx, pos_c[shared])
-            pos2 = np.searchsorted(n_keys, o_hit_keys)
-            pos2_c = np.minimum(pos2, n_n - 1)
-            shared2 = (np.asarray(n_keys[pos2_c]) == o_hit_keys) & (pos2 < n_n)
-            n_surv = np.union1d(n_idx, pos2_c[shared2])
-    else:
-        o_surv, n_surv = o_idx, n_idx
+            o_surv, n_surv = o_idx, n_idx
 
-    def compact(block, idx):
-        from kart_tpu.ops.blocks import PAD_KEY, FeatureBlock, bucket_size
+        def compact(block, idx):
+            from kart_tpu.ops.blocks import PAD_KEY, FeatureBlock, bucket_size
 
-        k = np.asarray(block.keys[idx])
-        o = np.asarray(block.oids[idx])
-        size = bucket_size(max(len(k), 1))
-        kp = np.full(size, PAD_KEY, dtype=np.int64)
-        kp[: len(k)] = k
-        op = np.zeros((size, 5), dtype=np.uint32)
-        op[: len(k)] = o
-        # envelopes deliberately dropped: nothing downstream of the
-        # prefilter reads them (classify uses keys/oids; writers' exact
-        # residue reads feature values)
-        return FeatureBlock(kp, op, None, len(k))
+            k = np.asarray(block.keys[idx])
+            o = np.asarray(block.oids[idx])
+            size = bucket_size(max(len(k), 1))
+            kp = np.full(size, PAD_KEY, dtype=np.int64)
+            kp[: len(k)] = k
+            op = np.zeros((size, 5), dtype=np.uint32)
+            op[: len(k)] = o
+            # envelopes deliberately dropped: nothing downstream of the
+            # prefilter reads them (classify uses keys/oids; writers' exact
+            # residue reads feature values)
+            return FeatureBlock(kp, op, None, len(k))
 
-    return compact(old_block, o_surv), compact(new_block, n_surv)
+        return compact(old_block, o_surv), compact(new_block, n_surv)
 
 
 #: query-rect pad for the envelope prefilter: sidecar envelopes are rounded
@@ -482,10 +491,13 @@ def get_dataset_feature_count_fast(
     from kart_tpu.ops.diff_kernel import classify_blocks
     from kart_tpu.parallel.sharded_diff import classify_blocks_sharded, should_shard
 
-    if should_shard(max(old_block.count, new_block.count)):
-        _, _, counts = classify_blocks_sharded(old_block, new_block)
-    else:
-        _, _, counts = classify_blocks(old_block, new_block)
+    with tm.span(
+        "diff.classify", rows=max(old_block.count, new_block.count)
+    ):
+        if should_shard(max(old_block.count, new_block.count)):
+            _, _, counts = classify_blocks_sharded(old_block, new_block)
+        else:
+            _, _, counts = classify_blocks(old_block, new_block)
     return counts["inserts"] + counts["updates"] + counts["deletes"]
 
 
@@ -538,11 +550,14 @@ def get_feature_diff_rows(base_rs, target_rs, ds_path):
     from kart_tpu.ops.diff_kernel import changed_indices, classify_blocks
     from kart_tpu.parallel.sharded_diff import classify_blocks_sharded, should_shard
 
-    if should_shard(max(old_block.count, new_block.count)):
-        old_class, new_class, _ = classify_blocks_sharded(old_block, new_block)
-    else:
-        old_class, new_class, _ = classify_blocks(old_block, new_block)
-    old_idx, new_idx = changed_indices(old_class, new_class)
+    with tm.span(
+        "diff.classify", rows=max(old_block.count, new_block.count)
+    ):
+        if should_shard(max(old_block.count, new_block.count)):
+            old_class, new_class, _ = classify_blocks_sharded(old_block, new_block)
+        else:
+            old_class, new_class, _ = classify_blocks(old_block, new_block)
+        old_idx, new_idx = changed_indices(old_class, new_class)
     okeys = np.asarray(old_block.keys[old_idx])
     nkeys = np.asarray(new_block.keys[new_idx])
     pks = np.union1d(okeys, nkeys)

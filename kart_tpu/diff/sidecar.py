@@ -48,6 +48,7 @@ import os
 
 import numpy as np
 
+from kart_tpu import telemetry as tm
 from kart_tpu.ops.blocks import FeatureBlock, bucket_size, PAD_KEY, hash_keys_for_paths
 
 MAGIC = b"KCOL1\n"
@@ -154,6 +155,11 @@ def save_sidecar(repo, feature_tree_oid, keys, oids_u8, paths=None, envelopes=No
     *not necessarily sorted*; ``paths`` list[str] aligned with keys, or None
     for int-pk datasets; ``envelopes`` (N, 4) float wsen per feature, or
     None. Atomic (tmp + rename)."""
+    with tm.span("sidecar.save", rows=int(len(keys))):
+        return _save_sidecar(repo, feature_tree_oid, keys, oids_u8, paths, envelopes)
+
+
+def _save_sidecar(repo, feature_tree_oid, keys, oids_u8, paths, envelopes):
     order = np.argsort(keys, kind="stable")
     keys = np.ascontiguousarray(keys[order], dtype="<i8")
     oids_u8 = np.ascontiguousarray(oids_u8[order], dtype=np.uint8)
@@ -237,7 +243,13 @@ def load_block(repo, dataset, pad=True):
     try:
         mm = np.memmap(path, dtype=np.uint8, mode="r")
     except (OSError, ValueError):
+        tm.incr("sidecar.load_misses")
         return None
+    with tm.span("sidecar.load"):
+        return _load_block_from_mmap(mm, dataset, pad)
+
+
+def _load_block_from_mmap(mm, dataset, pad):
     try:
         if bytes(mm[: len(MAGIC)]) != MAGIC:
             return None
@@ -306,12 +318,13 @@ def build_sidecar(repo, dataset, pad=True):
     feature_tree = dataset.feature_tree
     if feature_tree is None:
         return None
-    paths, pk_arr, oid_u8 = dataset.feature_index()
-    if pk_arr is not None:
-        save_sidecar(repo, feature_tree.oid, pk_arr.astype(np.int64), oid_u8)
-    else:
-        keys = hash_keys_for_paths(paths)
-        save_sidecar(repo, feature_tree.oid, keys, oid_u8, paths=paths)
+    with tm.span("sidecar.build"):
+        paths, pk_arr, oid_u8 = dataset.feature_index()
+        if pk_arr is not None:
+            save_sidecar(repo, feature_tree.oid, pk_arr.astype(np.int64), oid_u8)
+        else:
+            keys = hash_keys_for_paths(paths)
+            save_sidecar(repo, feature_tree.oid, keys, oid_u8, paths=paths)
     return load_block(repo, dataset, pad=pad)
 
 

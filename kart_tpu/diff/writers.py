@@ -16,6 +16,7 @@ from datetime import datetime, timedelta, timezone
 
 import click
 
+from kart_tpu import telemetry as tm
 from kart_tpu.core.repo import InvalidOperation, NotFound
 from kart_tpu.crs import Transform
 from kart_tpu.diff.engine import get_dataset_diff, get_repo_diff
@@ -681,7 +682,11 @@ class JsonLinesDiffWriter(BaseDiffWriter):
         if not m:
             return True
         self.has_changes = True
-        self._materialise_fanout(rows, base_ds, target_ds, self._feature_head(ds_path))
+        with tm.span("serialise.features", dataset=ds_path, rows=int(m)):
+            self._materialise_fanout(
+                rows, base_ds, target_ds, self._feature_head(ds_path)
+            )
+        tm.incr("serialise.features_materialised", int(m))
         return True
 
     def _feature_head(self, ds_path):
@@ -754,10 +759,16 @@ class JsonLinesDiffWriter(BaseDiffWriter):
             lo, hi = bounds[w], bounds[w + 1]
 
             def _run(path=tmp.name, lo=lo, hi=hi):
+                # the child inherited the parent's span buffer: drop it and
+                # record only this worker's spans, dumped to a trace
+                # side-file the exporter merges — the fork fan-out shows up
+                # as its own process lane in the Chrome trace
+                tm.begin_fork_child()
                 with open(path, "w") as f:
                     self._materialise_rows(
                         rows, base_ds, target_ds, head, lo, hi, f
                     )
+                tm.dump_fork_child()
 
             p = ctx.Process(target=_run, daemon=True)
             p.start()
@@ -849,32 +860,33 @@ class JsonLinesDiffWriter(BaseDiffWriter):
                 )
                 if lo + chunk_size < hi_row:
                     fut = pool.submit(read_chunk, lo + chunk_size)
-                lines = []
-                append = lines.append
-                oi = ni = 0
-                for j, pk in enumerate(pk_chunk):
-                    pkv = (pk,)
-                    if o_mask[j]:
-                        data = o_data[oi]
-                        if data is None:
-                            # loose / delta / promised: per-object fallback
-                            data = old_odb.read_blob(o_shas[oi].hex())
-                        oi += 1
-                        body = '"-":' + old_json(pkv, data)
-                        if n_mask[j]:
+                with tm.span("serialise.chunk", rows=len(pk_chunk)):
+                    lines = []
+                    append = lines.append
+                    oi = ni = 0
+                    for j, pk in enumerate(pk_chunk):
+                        pkv = (pk,)
+                        if o_mask[j]:
+                            data = o_data[oi]
+                            if data is None:
+                                # loose / delta / promised: per-object fallback
+                                data = old_odb.read_blob(o_shas[oi].hex())
+                            oi += 1
+                            body = '"-":' + old_json(pkv, data)
+                            if n_mask[j]:
+                                data = n_data[ni]
+                                if data is None:
+                                    data = new_odb.read_blob(n_shas[ni].hex())
+                                ni += 1
+                                body += ',"+":' + new_json(pkv, data)
+                        else:
                             data = n_data[ni]
                             if data is None:
                                 data = new_odb.read_blob(n_shas[ni].hex())
                             ni += 1
-                            body += ',"+":' + new_json(pkv, data)
-                    else:
-                        data = n_data[ni]
-                        if data is None:
-                            data = new_odb.read_blob(n_shas[ni].hex())
-                        ni += 1
-                        body = '"+":' + new_json(pkv, data)
-                    append(head + body + "}}\n")
-                write("".join(lines))
+                            body = '"+":' + new_json(pkv, data)
+                        append(head + body + "}}\n")
+                    write("".join(lines))
 
     def write_ds_diff(self, ds_path, ds_diff):
         import os
@@ -899,15 +911,16 @@ class JsonLinesDiffWriter(BaseDiffWriter):
         head = self._feature_head(ds_path)
         write = self.fp.write
         json_str = self._feature_json_str
-        for key, delta in self.iter_deltas(ds_diff, ds_path):
-            old, new = delta.old, delta.new
-            if old is not None:
-                body = '"-":' + json_str(old, old_tx)
-                if new is not None:
-                    body += ',"+":' + json_str(new, new_tx)
-            else:
-                body = '"+":' + json_str(new, new_tx)
-            write(head + body + "}}\n")
+        with tm.span("serialise.features", dataset=ds_path):
+            for key, delta in self.iter_deltas(ds_diff, ds_path):
+                old, new = delta.old, delta.new
+                if old is not None:
+                    body = '"-":' + json_str(old, old_tx)
+                    if new is not None:
+                        body += ',"+":' + json_str(new, new_tx)
+                else:
+                    body = '"+":' + json_str(new, new_tx)
+                write(head + body + "}}\n")
 
     def _feature_json_str(self, kv, tx):
         """JSON object text for one delta side; the fused blob->text decode

@@ -16,6 +16,7 @@ import time
 
 import numpy as np
 
+from kart_tpu import telemetry as tm
 from kart_tpu.core.structure import RepoStructure
 from kart_tpu.core.tree_builder import TreeBuilder
 from kart_tpu.models.dataset import Dataset3
@@ -27,12 +28,28 @@ BATCH_SIZE = 10000
 # the disk; above it, first-diff latency matters
 SIDECAR_MIN_FEATURES = 10000
 
-#: per-phase seconds of the most recent import in this process —
+#: per-phase *self* seconds of the most recent import in this process —
 #: {"source_read", "encode", "hash_deflate", "tree_build", "total"}.
 #: Populated by the serial streaming path (the bench's phase-breakdown
 #: record); the parallel fan-out interleaves phases across workers and
-#: reports only the total.
+#: reports only the total. Accounting runs on a telemetry span stack
+#: (:class:`kart_tpu.telemetry.Phases`): nested phases book wall-clock into
+#: the innermost phase only, so the recorded self-times can never sum past
+#: the total (the old ``phases[key] +=`` dict pattern double-booked
+#: whenever phases overlapped).
 LAST_IMPORT_PHASES = None
+
+#: the phase keys the bench's ``import_phase_*`` record reads — stable
+#: across the telemetry refactor
+PHASE_KEYS = ("source_read", "encode", "hash_deflate", "tree_build")
+
+
+def _new_phases():
+    p = tm.Phases("importer")
+    for key in PHASE_KEYS:  # every key present even when a path is skipped
+        p.self_s.setdefault(key, 0.0)
+        p.cum_s.setdefault(key, 0.0)
+    return p
 
 
 class ImportError_(RuntimeError):
@@ -40,16 +57,18 @@ class ImportError_(RuntimeError):
 
 
 def _timed_iter(it, phases, key="source_read"):
-    """Wrap an iterator, accumulating its pull time into ``phases[key]``."""
+    """Wrap an iterator, accumulating its pull time into phase ``key``
+    (leaf accounting: two clock reads per pull, no span objects in the
+    per-item loop)."""
     it = iter(it)
     while True:
         t0 = time.perf_counter()
         try:
             item = next(it)
         except StopIteration:
-            phases[key] += time.perf_counter() - t0
+            phases.add(key, time.perf_counter() - t0)
             return
-        phases[key] += time.perf_counter() - t0
+        phases.add(key, time.perf_counter() - t0)
         yield item
 
 
@@ -92,14 +111,9 @@ def import_sources(
     ds_paths = []
     captures = {}
     total = 0
-    phases = {
-        "source_read": 0.0,
-        "encode": 0.0,
-        "hash_deflate": 0.0,
-        "tree_build": 0.0,
-    }
+    phases = _new_phases()
     t0 = time.monotonic()
-    with repo.odb.bulk_pack():
+    with tm.span("importer.import_sources", sources=len(sources)), repo.odb.bulk_pack():
         for source in sources:
             # PK-less sources get stable generated PKs
             # (reference: kart/pk_generation.py)
@@ -132,9 +146,8 @@ def import_sources(
             ds_paths.append(ds_path)
             captures[ds_path] = (capture, existing_ds)
 
-        t_flush = time.perf_counter()
-        new_tree = tb.flush()
-        phases["tree_build"] += time.perf_counter() - t_flush
+        with phases.span("tree_build"):
+            new_tree = tb.flush()
 
     # commit + ref update only after the pack is durable (fsync'd) on disk:
     # a crash mid-import leaves an aborted tmp pack and an untouched HEAD,
@@ -178,7 +191,8 @@ def import_sources(
         capture.save(repo, node.oid)
     dt = time.monotonic() - t0
     global LAST_IMPORT_PHASES
-    LAST_IMPORT_PHASES = {**phases, "total": dt}
+    LAST_IMPORT_PHASES = {**phases.self_seconds(), "total": dt}
+    tm.incr("importer.features_imported", total)
     if log:
         rate = total / dt if dt > 0 else float("inf")
         log(f"Imported {total} features in {dt:.2f}s ({rate:.0f} features/s)")
@@ -281,12 +295,7 @@ def _import_single_source(
     from kart_tpu.diff.sidecar import SidecarCapture
 
     if phases is None:
-        phases = {
-            "source_read": 0.0,
-            "encode": 0.0,
-            "hash_deflate": 0.0,
-            "tree_build": 0.0,
-        }
+        phases = _new_phases()
 
     schema = source.schema
     encoder = encoder_for_schema(schema)
@@ -358,9 +367,8 @@ def _import_single_source(
                 gc_batch += 1
                 if gc_batch % 100 == 0:
                     gc.collect()
-                t_hash = time.perf_counter()
-                oids_u8 = repo.odb.write_blobs_raw(blobs)
-                phases["hash_deflate"] += time.perf_counter() - t_hash
+                with phases.span("hash_deflate"):
+                    oids_u8 = repo.odb.write_blobs_raw(blobs)
                 pks = np.asarray(pk_list, dtype=np.int64)
                 if collect_local:
                     pk_chunks.append(pks)
@@ -372,20 +380,20 @@ def _import_single_source(
                     log(f"  {ds_path}: {count} features...")
             src_phases = getattr(source, "phase_seconds", None)
             if src_phases:
-                read_s = min(src_phases.get("source_read", 0.0), phases["encode"])
-                phases["source_read"] += read_s
-                phases["encode"] -= read_s
+                read_s = min(
+                    src_phases.get("source_read", 0.0),
+                    phases.self_s.get("encode", 0.0),
+                )
+                phases.move("encode", "source_read", read_s)
         else:
             for batch in chunked(_timed_iter(source.features(), phases), BATCH_SIZE):
                 gc_batch += 1
                 if gc_batch % 100 == 0:
                     gc.collect()
-                t_enc = time.perf_counter()
-                encoded = [schema.encode_feature_blob(f) for f in batch]
-                phases["encode"] += time.perf_counter() - t_enc
-                t_hash = time.perf_counter()
-                oids = repo.odb.write_blobs([blob for _, blob in encoded])
-                phases["hash_deflate"] += time.perf_counter() - t_hash
+                with phases.span("encode"):
+                    encoded = [schema.encode_feature_blob(f) for f in batch]
+                with phases.span("hash_deflate"):
+                    oids = repo.odb.write_blobs([blob for _, blob in encoded])
                 if use_batch_paths:
                     pks = np.fromiter(
                         (pk_values[0] for pk_values, _ in encoded),
@@ -441,14 +449,13 @@ def _import_single_source(
                     # against the live head in the columnar merge-join and
                     # surface as a spurious UPDATE
                     capture.replace_int_columns(pks_arr, oids_u8)
-        t_tree = time.perf_counter()
-        ftree = build_int_feature_tree(repo.odb, pks_arr, oids_u8, encoder)
-        tb.insert(
-            f"{ds_path}/{Dataset3.DATASET_DIRNAME}/feature",
-            ftree,
-            mode=MODE_TREE,
-        )
-        phases["tree_build"] += time.perf_counter() - t_tree
+        with phases.span("tree_build"):
+            ftree = build_int_feature_tree(repo.odb, pks_arr, oids_u8, encoder)
+            tb.insert(
+                f"{ds_path}/{Dataset3.DATASET_DIRNAME}/feature",
+                ftree,
+                mode=MODE_TREE,
+            )
 
     # meta items that only exist after the feature stream has run (e.g.
     # generated-pks.json from PK synthesis)

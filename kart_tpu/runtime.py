@@ -153,9 +153,12 @@ def probe_backend(timeout=None):
                     f"{type(e).__name__}: {e}", time.perf_counter() - t0
                 )
 
+        from kart_tpu import telemetry as tm
+
         t = threading.Thread(target=_init, daemon=True, name="kart-jax-probe")
-        t.start()
-        t.join(timeout)
+        with tm.span("runtime.probe_backend", timeout=timeout):
+            t.start()
+            t.join(timeout)
         if "result" in box:
             _probe_result = box["result"]
         else:
@@ -170,6 +173,10 @@ def probe_backend(timeout=None):
             )
             _probe_thread = t
             _probe_box = box
+        tm.gauge_set("runtime.backend_ok", int(_probe_result["ok"]))
+        tm.gauge_set(
+            "runtime.backend_init_seconds", _probe_result["init_seconds"]
+        )
         return _probe_result
 
 
@@ -259,6 +266,9 @@ class Watchdog:
             self._timer.start()
             return
         self.fired = True
+        from kart_tpu import telemetry as tm
+
+        tm.incr("runtime.watchdog_fired")
         try:
             self.on_timeout()
         except Exception:  # the op it guards surfaces the real failure

@@ -1,0 +1,41 @@
+"""Telemetry subsystem: tracing spans, counters/gauges/histograms, Chrome
+trace export, Prometheus-style stats, and unified logging.
+
+Instrumented code imports this package and calls through its attributes::
+
+    from kart_tpu import telemetry as tm
+
+    with tm.span("diff.classify", rows=n):
+        ...
+    tm.incr("transport.retries", verb="fetch-pack")
+
+The attributes are late-bound on purpose: the overhead bench and the
+naming-grammar test swap ``telemetry.span``/``telemetry.incr`` for counting
+stubs without touching any call site. Everything is a near-zero no-op until
+enabled — see :mod:`kart_tpu.telemetry.core` for the enablement ladder
+(``KART_METRICS``, ``KART_TRACE``, ``kart --trace``, ``-v``) and
+docs/OBSERVABILITY.md for the naming scheme and sink formats.
+"""
+
+from kart_tpu.telemetry.core import (  # noqa: F401
+    NAME_RE,
+    SUBSYSTEMS,
+    Phases,
+    all_metric_names,
+    begin_fork_child,
+    default_trace_path,
+    drain_events,
+    dump_fork_child,
+    enable,
+    enable_from_env,
+    gauge_set,
+    incr,
+    metrics_enabled,
+    observe,
+    reset,
+    snapshot,
+    span,
+    trace_path,
+    tracing_enabled,
+)
+from kart_tpu.telemetry.logs import configure_logging  # noqa: F401

@@ -44,6 +44,7 @@ from urllib.error import HTTPError
 from urllib.parse import urlsplit
 from urllib.request import Request, urlopen
 
+from kart_tpu import telemetry as tm
 from kart_tpu.core.odb import ObjectMissing
 from kart_tpu.transport.pack import read_pack, write_pack
 
@@ -217,6 +218,7 @@ class KartRequestHandler(BaseHTTPRequestHandler):
         with tempfile.SpooledTemporaryFile(max_size=64 * 1024 * 1024) as buf:
             write_framed(buf, header, pack_source)
             length = buf.tell()
+            tm.incr("transport.server.bytes_sent", length)
             buf.seek(0)
             self.send_response(200)
             self.send_header("Content-Type", "application/x-kartpack")
@@ -234,6 +236,7 @@ class KartRequestHandler(BaseHTTPRequestHandler):
 
     def _read_body_spooled(self):
         n = int(self.headers.get("Content-Length", 0))
+        tm.incr("transport.server.bytes_received", n)
         buf = tempfile.SpooledTemporaryFile(max_size=64 * 1024 * 1024)
         remaining = n
         while remaining > 0:
@@ -249,8 +252,11 @@ class KartRequestHandler(BaseHTTPRequestHandler):
 
     def do_GET(self):
         try:
-            if urlsplit(self.path).path.rstrip("/") == f"{API}/refs":
+            path = urlsplit(self.path).path.rstrip("/")
+            if path == f"{API}/refs":
                 return self._handle_refs()
+            if path == f"{API}/stats":
+                return self._handle_stats()
             self._json(404, {"error": f"No such endpoint: {self.path}"})
         except Exception as e:
             self._json(500, {"error": f"{type(e).__name__}: {e}"})
@@ -272,6 +278,19 @@ class KartRequestHandler(BaseHTTPRequestHandler):
         from kart_tpu.transport.service import ls_refs_info
 
         self._json(200, ls_refs_info(self.repo))
+
+    def _handle_stats(self):
+        """Prometheus-style text exposition of this server process's metric
+        registry (`kart stats <url>` reads this)."""
+        from kart_tpu.telemetry import sinks
+
+        tm.incr("transport.server.requests", verb="stats")
+        raw = sinks.prometheus_text().encode()
+        self.send_response(200)
+        self.send_header("Content-Type", "text/plain; version=0.0.4")
+        self.send_header("Content-Length", str(len(raw)))
+        self.end_headers()
+        self.wfile.write(raw)
 
     def _handle_fetch_pack(self):
         from kart_tpu.transport.service import make_fetch_enum
@@ -310,7 +329,14 @@ class KartRequestHandler(BaseHTTPRequestHandler):
 
 
 def make_server(repo, host="127.0.0.1", port=0):
-    """-> ThreadingHTTPServer serving `repo`; port 0 picks a free port."""
+    """-> ThreadingHTTPServer serving `repo`; port 0 picks a free port.
+
+    Serving turns metrics on (a server without observable counters is
+    undebuggable in production — the registry feeds ``GET /api/v1/stats``)
+    and configures the shared ``kart_tpu`` logger so a spawned server
+    honours ``KART_LOG`` without the CLI having run."""
+    tm.configure_logging()
+    tm.enable(metrics=True)
     server = ThreadingHTTPServer((host, port), KartRequestHandler)
     server.kart_repo = repo
     server.push_lock = threading.Lock()

@@ -29,6 +29,7 @@ import logging
 import os
 import time
 
+from kart_tpu import telemetry as tm
 from kart_tpu.transport.pack import PackFormatError, read_pack
 
 L = logging.getLogger("kart_tpu.transport.retry")
@@ -121,6 +122,8 @@ class RetryPolicy:
                 if attempt >= self.attempts or not retryable(e):
                     raise
                 delay = self.delay_for(attempt)
+                tm.incr("transport.retries", verb=label or "operation")
+                tm.incr("transport.backoff_seconds", delay)
                 L.warning(
                     "transport %s failed (%s: %s); retrying %d/%d in %.2fs",
                     label or "operation",
@@ -153,18 +156,22 @@ def drain_pack_salvaging(odb, pack_fp, received=None):
     w = odb.pack_writer()
     count = 0
     try:
-        for obj_type, content in read_pack(pack_fp):
-            oid = w.add(obj_type, content)
-            count += 1
-            if received is not None:
-                received.add(oid)
+        with tm.span("transport.pack_drain"):
+            for obj_type, content in read_pack(pack_fp):
+                oid = w.add(obj_type, content)
+                count += 1
+                if received is not None:
+                    received.add(oid)
     except BaseException:
+        tm.incr("transport.salvage_events")
+        tm.incr("transport.objects_salvaged", count)
         try:
             if w.finish() is not None:
                 odb.packs.refresh()
         except Exception:
             w.abort()
         raise
+    tm.incr("transport.objects_received", count)
     if w.finish() is not None:
         odb.packs.refresh()
     return count

@@ -19,6 +19,7 @@ import shutil
 import tempfile
 from contextlib import contextmanager, nullcontext
 
+from kart_tpu import telemetry as tm
 from kart_tpu.core.odb import ObjectMissing
 from kart_tpu.core.refs import RefError, check_ref_format
 from kart_tpu.transport.protocol import ObjectEnumerator
@@ -30,6 +31,8 @@ QUARANTINE_SUBDIR = "quarantine"
 def ls_refs_info(repo):
     """The advertisement: branch/tag tips, HEAD branch, shallow set."""
     from kart_tpu.transport.remote import read_shallow
+
+    tm.incr("transport.server.requests", verb="ls-refs")
 
     heads = {
         ref[len("refs/heads/"):]: oid
@@ -60,6 +63,12 @@ def make_fetch_enum(repo, req):
     from kart_tpu.transport.remote import read_shallow
     from kart_tpu.transport.http import have_closure
 
+    tm.incr("transport.server.requests", verb="fetch-pack")
+    if req.get("exclude"):
+        # a non-empty exclusion list IS the resume protocol: the client is
+        # completing a torn earlier transfer (docs/ROBUSTNESS.md §3)
+        tm.incr("transport.server.fetch_resumes")
+        tm.incr("transport.server.excluded_oids", len(req["exclude"]))
     blob_filter = None
     if req.get("filter"):
         from kart_tpu.spatial_filter import blob_filter_for_spec
@@ -96,6 +105,7 @@ def make_fetch_enum(repo, req):
 
 def collect_blobs(repo, oids):
     """fetch-blobs (promisor backfill): -> (header, [(type, content)])."""
+    tm.incr("transport.server.requests", verb="fetch-blobs")
     missing = []
     objects = []
     for oid in oids:
@@ -205,12 +215,14 @@ def quarantined_receive(repo, header, pack_fp, *, thread_lock=None):
     server reports them the same way as any other I/O failure."""
     from kart_tpu.transport.pack import read_pack
 
+    tm.incr("transport.server.requests", verb="receive-pack")
     q = ReceiveQuarantine(repo)
     try:
-        with q.odb.bulk_pack():
+        with tm.span("transport.receive_drain"), q.odb.bulk_pack():
             for obj_type, content in read_pack(pack_fp):
                 q.odb.write_raw(obj_type, content)
     except BaseException:
+        tm.incr("transport.server.receive_outcomes", outcome="torn")
         q.discard()
         raise
     try:
@@ -220,9 +232,14 @@ def quarantined_receive(repo, header, pack_fp, *, thread_lock=None):
                     repo, header, contains=q.odb.contains
                 )
                 if rejection is not None:
+                    tm.incr(
+                        "transport.server.receive_outcomes",
+                        outcome=rejection[0],
+                    )
                     q.discard()
                     return rejection
                 q.migrate()
+                tm.incr("transport.server.receive_outcomes", outcome="ok")
                 return "ok", _apply_validated_updates(repo, header)
     except BaseException:
         q.discard()  # no-op after a successful migrate

@@ -361,6 +361,7 @@ def serve_stdio(repo, in_fp, out_fp):
     """Serve one connection: read framed requests from ``in_fp`` until EOF,
     answer each on ``out_fp``. stdout discipline is absolute — anything else
     the process prints must go to stderr or the frames corrupt."""
+    from kart_tpu import telemetry
     from kart_tpu.transport.pack import PackFormatError
     from kart_tpu.transport.service import (
         collect_blobs,
@@ -368,6 +369,11 @@ def serve_stdio(repo, in_fp, out_fp):
         make_fetch_enum,
         quarantined_receive,
     )
+
+    # a spawned server honours KART_LOG (stderr only — stdout is frames)
+    # and serves its metric registry via the "stats" op
+    telemetry.configure_logging()
+    telemetry.enable(metrics=True)
 
     while True:
         raw = in_fp.read(_HEADER_LEN.size)
@@ -404,6 +410,14 @@ def serve_stdio(repo, in_fp, out_fp):
                     pass
                 if op == "refs":
                     write_framed(out_fp, ls_refs_info(repo), ())
+                elif op == "stats":
+                    from kart_tpu import telemetry
+                    from kart_tpu.telemetry import sinks
+
+                    telemetry.incr("transport.server.requests", verb="stats")
+                    write_framed(
+                        out_fp, {"metrics": sinks.prometheus_text()}, ()
+                    )
                 elif op == "fetch-pack":
                     enum, resp_header = make_fetch_enum(repo, header)
                     write_framed(out_fp, resp_header, enum)

@@ -20,6 +20,7 @@ via vendored psycopg2/pyodbc, skipping tests unless KART_*_URL is set).
 import contextlib
 from urllib.parse import urlsplit, unquote
 
+from kart_tpu import telemetry as tm
 from kart_tpu.adapters.base import KART_STATE, KART_TRACK
 from kart_tpu.core.odb import ObjectPromised
 from kart_tpu.core.repo import InvalidOperation, NotFound
@@ -130,6 +131,7 @@ class DatabaseServerWorkingCopy:
             con.close()
 
     def _execute(self, con, sql, params=()):
+        tm.incr("wc.statements", backend=self.WORKING_COPY_TYPE_NAME or "db")
         cur = con.cursor()
         cur.execute(sql, params)
         return cur
@@ -242,12 +244,13 @@ class DatabaseServerWorkingCopy:
     # -- checkout (write_full) -----------------------------------------------
 
     def write_full(self, target_structure, *datasets):
-        if not (self.status() & WorkingCopyStatus.INITIALISED):
-            self.create_and_initialise()
-        with self.session() as con:
-            for ds in datasets:
-                self._write_one_dataset(con, ds)
-            self._update_state_tree(con, target_structure.tree_oid)
+        with tm.span("wc.write_full", datasets=len(datasets)):
+            if not (self.status() & WorkingCopyStatus.INITIALISED):
+                self.create_and_initialise()
+            with self.session() as con:
+                for ds in datasets:
+                    self._write_one_dataset(con, ds)
+                self._update_state_tree(con, target_structure.tree_oid)
 
     def _dataset_crs_id(self, ds):
         schema = ds.schema
@@ -289,6 +292,7 @@ class DatabaseServerWorkingCopy:
         )
         insert_sql = f"INSERT INTO {tbl} ({quoted_cols}) VALUES ({placeholders})"
         batch = []
+        rows = 0
         cur = con.cursor()
         for feature in checkout_features(self.repo, ds):
             batch.append(
@@ -299,9 +303,12 @@ class DatabaseServerWorkingCopy:
             )
             if len(batch) >= 10000:
                 cur.executemany(insert_sql, batch)
+                rows += len(batch)
                 batch.clear()
         if batch:
             cur.executemany(insert_sql, batch)
+            rows += len(batch)
+        tm.incr("wc.rows_written", rows)
 
         self._post_write_dataset(con, ds, table, crs_id)
         self._create_triggers(con, table, schema)
@@ -385,7 +392,7 @@ class DatabaseServerWorkingCopy:
                                      workdir_diff_cache=None):
         table = self._table_name(dataset.path)
         result = DatasetDiff()
-        with self.session() as con:
+        with tm.span("wc.diff", dataset=dataset.path), self.session() as con:
             if not self._table_exists(con, table):
                 return result
             result["meta"] = self._diff_meta(con, dataset, table)

@@ -1,0 +1,102 @@
+"""Tests for the diff-annotations cache (kart_tpu/annotations.py) — the
+feature-change-counts memo had zero coverage (ISSUE 3 satellite): get/set
+round-trip, symmetric keying, the cache-hit short-circuit in
+``count_changes``, persistence across instances, the read-only in-memory
+fallback, and ``build_all``."""
+
+import pytest
+
+from helpers import edit_commit, make_imported_repo
+
+
+@pytest.fixture
+def two_commit_repo(tmp_path):
+    repo, ds_path = make_imported_repo(tmp_path, n=12)
+    ds = repo.structure("HEAD").datasets[ds_path]
+    f = dict(ds.get_feature([3]))
+    f["name"] = "edited"
+    edit_commit(repo, ds_path, updates=[f], deletes=[5])
+    return repo, ds_path
+
+
+def test_get_set_roundtrip_and_symmetric_key(two_commit_repo):
+    from kart_tpu.annotations import DiffAnnotations
+
+    repo, _ = two_commit_repo
+    ann = DiffAnnotations(repo)
+    assert ann.get("a" * 40, "b" * 40) is None
+    data = {"points": 7}
+    ann.set("a" * 40, "b" * 40, data)
+    assert ann.get("a" * 40, "b" * 40) == data
+    # A<>B and B<>A share an entry (diff size is symmetric)
+    assert ann.get("b" * 40, "a" * 40) == data
+    # a fresh instance reads it back from sqlite, not instance memory
+    assert DiffAnnotations(repo).get("a" * 40, "b" * 40) == data
+
+
+def test_count_changes_computes_then_short_circuits(two_commit_repo, monkeypatch):
+    from kart_tpu.annotations import DiffAnnotations
+
+    repo, ds_path = two_commit_repo
+    base_rs = repo.structure("HEAD^")
+    target_rs = repo.structure("HEAD")
+
+    ann = DiffAnnotations(repo)
+    counts = ann.count_changes(base_rs, target_rs)
+    assert counts == {ds_path: 2}  # 1 update + 1 delete
+
+    # cache hit short-circuit: the expensive diff must NOT run again —
+    # neither from instance memory nor from a fresh instance reading sqlite
+    import kart_tpu.diff.engine as engine
+
+    def boom(*a, **kw):
+        raise AssertionError("count_changes recomputed a cached diff")
+
+    monkeypatch.setattr(engine, "get_repo_diff", boom)
+    assert ann.count_changes(base_rs, target_rs) == counts
+    assert DiffAnnotations(repo).count_changes(base_rs, target_rs) == counts
+
+
+def test_count_changes_identical_revisions(two_commit_repo):
+    from kart_tpu.annotations import DiffAnnotations
+
+    repo, _ = two_commit_repo
+    head = repo.structure("HEAD")
+    assert DiffAnnotations(repo).count_changes(head, head) == {}
+
+
+def test_build_all_precomputes_history(two_commit_repo):
+    from kart_tpu.annotations import DiffAnnotations
+
+    repo, ds_path = two_commit_repo
+    ann = DiffAnnotations(repo)
+    built = ann.build_all()
+    assert built == 2  # both commits annotated against their parents
+    head = repo.head_commit_oid
+    parent = repo.odb.read_commit(head).parents[0]
+    base_rs = repo.structure(parent)
+    target_rs = repo.structure(head)
+    cached = ann.get(base_rs.tree_oid, target_rs.tree_oid)
+    assert cached == {ds_path: 2}
+    # the root commit's entry exists too (base side is the empty tree)
+    root_rs = repo.structure(parent)
+    assert ann.get(None, root_rs.tree_oid) is not None
+
+
+def test_readonly_gitdir_falls_back_to_memory(two_commit_repo, monkeypatch):
+    import sqlite3
+
+    from kart_tpu.annotations import DiffAnnotations
+
+    repo, _ = two_commit_repo
+
+    class _NoDisk(DiffAnnotations):
+        def _connect(self):
+            raise sqlite3.OperationalError("unable to open database file")
+
+    ann = _NoDisk(repo)
+    assert ann._readonly
+    ann.set("a" * 40, "b" * 40, {"points": 1})
+    assert ann.get("a" * 40, "b" * 40) == {"points": 1}  # memory store
+    # nothing reached disk: a real instance sees no entry
+    assert DiffAnnotations(repo).get("a" * 40, "b" * 40) is None

@@ -45,6 +45,15 @@ NEW_KEYS += [
     "fetch_resume_objects_resent",
 ]
 
+#: keys added by ISSUE 3 (telemetry subsystem: the honesty metric — the
+#: disabled instrumentation's measured cost on the 1M-row diff path)
+NEW_KEYS += [
+    "telemetry_overhead_pct",
+    "telemetry_noop_ns_per_call",
+    "telemetry_calls_per_diff",
+    "telemetry_diff_rows",
+]
+
 
 def test_bench_emits_every_recorded_key():
     with open(os.path.join(REPO_ROOT, "bench.py")) as f:

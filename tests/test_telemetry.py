@@ -1,0 +1,511 @@
+"""Tier-1 tests for the telemetry subsystem (ISSUE 3): span/counter core,
+sinks (Chrome trace, Prometheus exposition, phase summary), unified
+logging, the importer's span-stack phase accounting, the naming-grammar
+guard, the disabled-overhead bound, and the two acceptance flows —
+``kart --trace diff`` writing a multi-subsystem Chrome trace, and
+``kart stats`` against a running transport server after a fault-injected
+(resumed) fetch."""
+
+import io
+import json
+import logging
+import os
+import re
+import time
+
+import pytest
+
+from kart_tpu import telemetry
+from kart_tpu.telemetry import core, sinks
+
+
+@pytest.fixture(autouse=True)
+def clean_registry():
+    """Telemetry state is process-global: every test starts and ends
+    disabled and empty."""
+    telemetry.reset()
+    yield
+    telemetry.reset()
+
+
+# -- core -------------------------------------------------------------------
+
+
+def test_disabled_is_noop():
+    with telemetry.span("diff.classify", rows=5):
+        pass
+    telemetry.incr("odb.objects_read")
+    telemetry.gauge_set("runtime.backend_ok", 1)
+    telemetry.observe("odb.bytes_inflated", 10)
+    snap = telemetry.snapshot()
+    assert snap == {"counters": [], "gauges": [], "histograms": []}
+    assert telemetry.drain_events() == []
+
+
+def test_decorator_applied_while_disabled_late_binds():
+    """A span decorator applied at import time (telemetry disabled) must
+    start recording once telemetry is enabled — enablement is a call-time
+    check, not a decoration-time one."""
+
+    @telemetry.span("diff.decorated_early")
+    def work():
+        return 1
+
+    assert work() == 1  # disabled: plain no-op passthrough
+    assert telemetry.all_metric_names() == []
+    telemetry.enable(trace=True)
+    assert work() == 1
+    assert "diff.decorated_early" in telemetry.all_metric_names()
+    assert any(e["name"] == "diff.decorated_early" for e in telemetry.drain_events())
+
+
+def test_counters_gauges_histograms_and_labels():
+    telemetry.enable(metrics=True)
+    telemetry.incr("transport.retries", verb="fetch-pack")
+    telemetry.incr("transport.retries", 2, verb="fetch-pack")
+    telemetry.incr("transport.retries", verb="ls-refs")
+    telemetry.gauge_set("runtime.backend_ok", 0)
+    telemetry.gauge_set("runtime.backend_ok", 1)
+    for v in (2.0, 5.0, 3.0):
+        telemetry.observe("transport.backoff", v)
+    snap = telemetry.snapshot()
+    counters = {(n, tuple(sorted(l.items()))): v for n, l, v in snap["counters"]}
+    assert counters[("transport.retries", (("verb", "fetch-pack"),))] == 3
+    assert counters[("transport.retries", (("verb", "ls-refs"),))] == 1
+    assert snap["gauges"] == [("runtime.backend_ok", {}, 1)]
+    ((name, _labels, h),) = snap["histograms"]
+    assert name == "transport.backoff"
+    assert h == {"count": 3, "sum": 10.0, "min": 2.0, "max": 5.0}
+
+
+def test_span_aggregation_self_vs_cumulative():
+    telemetry.enable(spans=True)
+    with telemetry.span("diff.outer"):
+        time.sleep(0.02)
+        with telemetry.span("diff.inner"):
+            time.sleep(0.03)
+    snap = telemetry.snapshot()
+    hists = {n: h for n, _l, h in snap["histograms"]}
+    outer, outer_self = hists["diff.outer"], hists["diff.outer.self"]
+    inner = hists["diff.inner"]
+    # cumulative outer covers the inner phase; self outer excludes it — the
+    # two views can't double-book wall-clock
+    assert outer["sum"] >= inner["sum"]
+    assert outer_self["sum"] == pytest.approx(
+        outer["sum"] - inner["sum"], abs=0.01
+    )
+    assert outer_self["sum"] < outer["sum"]
+
+
+def test_span_decorator_form():
+    telemetry.enable(spans=True)
+
+    @telemetry.span("diff.decorated")
+    def work():
+        return 42
+
+    assert work() == 42
+    names = telemetry.all_metric_names()
+    assert "diff.decorated" in names
+
+
+def test_trace_events_and_chrome_export(tmp_path):
+    path = str(tmp_path / "trace.json")
+    telemetry.enable(trace=True, trace_path=path)
+    with telemetry.span("diff.classify", rows=10):
+        pass
+    out = sinks.write_chrome_trace()
+    assert out == path
+    doc = json.load(open(path))
+    events = doc["traceEvents"]
+    spans = [e for e in events if e["ph"] == "X"]
+    metas = [e for e in events if e["ph"] == "M"]
+    assert spans[0]["name"] == "diff.classify"
+    assert spans[0]["cat"] == "diff"
+    assert spans[0]["args"] == {"rows": 10}
+    assert spans[0]["pid"] == os.getpid()
+    assert metas and metas[0]["name"] == "thread_name"
+    # the export drained the buffer: a second write has nothing
+    assert sinks.write_chrome_trace() is None
+
+
+def test_chrome_export_merges_fork_child_sidecars(tmp_path):
+    path = str(tmp_path / "trace.json")
+    telemetry.enable(trace=True, trace_path=path)
+    with telemetry.span("serialise.parent"):
+        pass
+    side = core.child_trace_sidecar_path()
+    with open(side, "w") as f:
+        json.dump(
+            [
+                {
+                    "name": "serialise.chunk",
+                    "cat": "serialise",
+                    "ph": "X",
+                    "ts": 1.0,
+                    "dur": 2.0,
+                    "pid": os.getpid() + 1,
+                    "tid": 1,
+                    "tname": "worker",
+                    "args": {},
+                }
+            ],
+            f,
+        )
+    sinks.write_chrome_trace()
+    doc = json.load(open(path))
+    names = {e["name"] for e in doc["traceEvents"] if e["ph"] == "X"}
+    assert names == {"serialise.parent", "serialise.chunk"}
+    assert not os.path.exists(side)  # merged side-files are removed
+
+
+def test_prometheus_exposition_format():
+    telemetry.enable(metrics=True)
+    telemetry.incr("transport.retries", 2, verb='fetch"pack')
+    telemetry.gauge_set("runtime.backend_ok", 1)
+    telemetry.observe("diff.classify", 0.5)
+    text = sinks.prometheus_text()
+    assert "# TYPE kart_transport_retries_total counter" in text
+    assert 'kart_transport_retries_total{verb="fetch\\"pack"} 2' in text
+    assert "kart_runtime_backend_ok 1" in text
+    assert "kart_diff_classify_count 1" in text
+    assert "kart_diff_classify_sum 0.5" in text
+
+
+def test_phase_summary_only_lists_spans():
+    telemetry.enable(metrics=True)
+    with telemetry.span("diff.classify"):
+        pass
+    telemetry.observe("odb.bytes_inflated", 12345.0)  # not a phase
+    text = sinks.phase_summary_text()
+    assert "diff.classify" in text
+    assert "odb.bytes_inflated" not in text
+
+
+def test_enable_from_env(monkeypatch, tmp_path):
+    monkeypatch.setenv("KART_METRICS", "1")
+    monkeypatch.setenv("KART_TRACE", str(tmp_path / "t.json"))
+    assert telemetry.enable_from_env()
+    assert telemetry.metrics_enabled()
+    assert telemetry.tracing_enabled()
+    assert telemetry.trace_path() == str(tmp_path / "t.json")
+
+
+# -- unified logging (satellite: servers/library get real defaults) ---------
+
+
+def test_configure_logging_idempotent_and_env(monkeypatch):
+    logger = logging.getLogger("kart_tpu")
+    old = (logger.level, list(logger.handlers), logger.propagate)
+    try:
+        logger.handlers = []
+        telemetry.configure_logging()
+        telemetry.configure_logging()  # re-configuring must not stack
+        ours = [h for h in logger.handlers if getattr(h, "_kart_tpu_handler", 0)]
+        assert len(ours) == 1
+        assert logger.level == logging.WARNING
+        # propagation stays on: host apps / pytest caplog still see records
+        assert logger.propagate is True
+
+        monkeypatch.setenv("KART_LOG", "debug")
+        telemetry.configure_logging()  # non-CLI entry points honour KART_LOG
+        assert logger.level == logging.DEBUG
+        telemetry.configure_logging(verbosity=1)  # explicit -v wins
+        assert logger.level == logging.INFO
+    finally:
+        logger.setLevel(old[0])
+        logger.handlers = old[1]
+        logger.propagate = old[2]
+
+
+def test_logging_goes_to_single_kart_logger(monkeypatch):
+    logger = logging.getLogger("kart_tpu")
+    old_handlers = list(logger.handlers)
+    old_level = logger.level
+    try:
+        logger.handlers = []
+        stream = io.StringIO()
+        telemetry.configure_logging(verbosity=1, stream=stream)
+        logging.getLogger("kart_tpu.transport.retry").info("retrying now")
+        text = stream.getvalue()
+        assert "kart_tpu.transport.retry" in text
+        assert "retrying now" in text
+    finally:
+        logger.handlers = old_handlers
+        logger.setLevel(old_level)
+
+
+# -- importer phase accounting (satellite: no double-booked wall-clock) -----
+
+
+def test_phases_nesting_never_double_books():
+    p = telemetry.Phases("importer")
+    with p.span("encode"):
+        time.sleep(0.01)
+        with p.span("hash_deflate"):
+            time.sleep(0.02)
+    p.add("source_read", 0.005)
+    total_wall = 0.035 + 0.005
+    assert sum(p.self_s.values()) <= total_wall * 1.5  # self never inflates
+    # cumulative encode covers the nested hash_deflate; self excludes it
+    assert p.cum_s["encode"] >= p.cum_s["hash_deflate"]
+    assert p.self_s["encode"] == pytest.approx(
+        p.cum_s["encode"] - p.cum_s["hash_deflate"], abs=0.005
+    )
+
+
+def test_import_phase_self_times_sum_to_at_most_total(tmp_path):
+    from helpers import make_imported_repo
+    from kart_tpu.importer import importer as importer_mod
+
+    make_imported_repo(tmp_path, n=50)
+    phases = importer_mod.LAST_IMPORT_PHASES
+    assert phases is not None
+    assert set(phases) == {
+        "source_read",
+        "encode",
+        "hash_deflate",
+        "tree_build",
+        "total",
+    }
+    phase_sum = sum(v for k, v in phases.items() if k != "total")
+    # self-times can never sum past wall-clock (the old dict pattern could
+    # book one second into two phases when they nested)
+    assert phase_sum <= phases["total"] + 1e-6
+    assert all(v >= -1e-9 for v in phases.values())
+
+
+# -- naming grammar (CI satellite a) ----------------------------------------
+
+_CALL_RE = re.compile(
+    r"""(?:tm|telemetry)\.(?:span|incr|gauge_set|observe)\(\s*[fb]?["']([^"']+)["']"""
+)
+
+
+def test_all_instrumented_names_match_grammar():
+    """Static guard: every metric/span name literal in the source obeys the
+    documented grammar (docs/OBSERVABILITY.md): dotted lowercase
+    ``subsystem.metric``, first segment a registered subsystem."""
+    root = os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+    names = set()
+    for dirpath, _dirs, files in os.walk(os.path.join(root, "kart_tpu")):
+        for fn in files:
+            if not fn.endswith(".py"):
+                continue
+            with open(os.path.join(dirpath, fn)) as f:
+                names.update(_CALL_RE.findall(f.read()))
+    with open(os.path.join(root, "bench.py")) as f:
+        names.update(_CALL_RE.findall(f.read()))
+    assert names, "no instrumented names found — the scan regex rotted"
+    bad = sorted(
+        n
+        for n in names
+        if not telemetry.NAME_RE.match(n) or n.split(".", 1)[0] not in telemetry.SUBSYSTEMS
+    )
+    assert not bad, (
+        f"metric/span names violate the naming grammar "
+        f"(<subsystem>.<metric>, lowercase dotted; subsystems: "
+        f"{sorted(telemetry.SUBSYSTEMS)}): {bad}"
+    )
+
+
+# -- overhead bound (CI satellite b) ----------------------------------------
+
+
+def test_disabled_overhead_under_2pct_on_1m_diff():
+    """The no-op cost of the disabled instrumentation on a 1M-row columnar
+    diff stays under 2% of the diff itself. Computed as
+    (calls issued x measured per-call no-op cost) / diff wall-clock —
+    differencing two timed runs would drown the ~100ns-scale cost in noise
+    and flake; this bound is exact and stable."""
+    import numpy as np
+
+    from kart_tpu.diff.engine import get_feature_diff_columnar
+    from kart_tpu.parallel.sharded_diff import synthetic_block
+
+    rows = 1_000_000
+    old = synthetic_block(rows, seed=0)
+    new = synthetic_block(rows, seed=0)
+    new.oids = new.oids.copy()
+    new.oids[7::1000, 0] ^= 1
+
+    class _Ds:
+        path_encoder = None
+        repo = None
+
+        @staticmethod
+        def get_feature_promise_from_oid(pks, oid):
+            return None
+
+    ds = _Ds()
+
+    def workload():
+        return get_feature_diff_columnar(ds, ds, blocks=(old, new))
+
+    workload()  # warm
+    t0 = time.perf_counter()
+    workload()
+    work_s = time.perf_counter() - t0
+
+    calls = [0]
+    real_span, real_incr = telemetry.span, telemetry.incr
+    telemetry.span = lambda *a, **k: (calls.__setitem__(0, calls[0] + 1), real_span(*a, **k))[1]
+    telemetry.incr = lambda *a, **k: (calls.__setitem__(0, calls[0] + 1), real_incr(*a, **k))[1]
+    try:
+        workload()
+    finally:
+        telemetry.span, telemetry.incr = real_span, real_incr
+
+    n_iter = 100_000
+    t0 = time.perf_counter()
+    for _ in range(n_iter):
+        with real_span("bench.noop"):
+            pass
+    span_cost = (time.perf_counter() - t0) / n_iter
+    t0 = time.perf_counter()
+    for _ in range(n_iter):
+        real_incr("bench.noop")
+    incr_cost = (time.perf_counter() - t0) / n_iter
+
+    overhead_pct = calls[0] * max(span_cost, incr_cost) / work_s * 100.0
+    assert overhead_pct < 2.0, (
+        f"disabled telemetry costs {overhead_pct:.3f}% of a {rows}-row diff "
+        f"({calls[0]} calls x {max(span_cost, incr_cost) * 1e9:.0f}ns)"
+    )
+
+
+# -- acceptance: kart --trace diff ------------------------------------------
+
+
+def test_trace_diff_covers_four_subsystems(tmp_path, cli_runner, monkeypatch):
+    """``kart --trace diff`` on a synth repo writes a valid Chrome trace
+    containing spans from >= 4 subsystems (diff engine, odb/packs, sidecar,
+    serialise) — the ISSUE 3 acceptance flow."""
+    from kart_tpu.cli import cli
+    from kart_tpu.synth import synth_repo
+
+    synth_repo(str(tmp_path / "repo"), 12000, edit_frac=0.01, blobs="real")
+    trace_path = str(tmp_path / "trace.json")
+    monkeypatch.setenv("KART_TRACE", trace_path)
+    out_path = str(tmp_path / "out.jsonl")
+    r = cli_runner.invoke(
+        cli,
+        [
+            "-C", str(tmp_path / "repo"), "diff", "HEAD^...HEAD",
+            "-o", "json-lines", "--output", out_path,
+        ],
+    )
+    assert r.exit_code == 0, r.output
+    doc = json.load(open(trace_path))  # valid Chrome trace JSON
+    spans = [e for e in doc["traceEvents"] if e.get("ph") == "X"]
+    cats = {e["cat"] for e in spans}
+    assert {"diff", "sidecar", "serialise"} <= cats
+    assert cats & {"odb", "packs"}
+    assert len(cats) >= 4
+    for e in spans:
+        assert telemetry.NAME_RE.match(e["name"]), e["name"]
+        assert e["name"].split(".", 1)[0] in telemetry.SUBSYSTEMS
+        assert e["dur"] >= 0
+    # and the diff output itself is intact
+    with open(out_path) as f:
+        assert sum(1 for _ in f) > 1
+
+
+# -- acceptance: kart stats vs a fault-injected fetch -----------------------
+
+
+def _metric(text, name, **labels):
+    pat = name
+    if labels:
+        inner = ",".join(f'{k}="{v}"' for k, v in sorted(labels.items()))
+        pat += "{" + inner + "}"
+    for line in text.splitlines():
+        if line.startswith(pat + " "):
+            return float(line.rsplit(" ", 1)[1])
+    return None
+
+
+def test_stats_reports_fault_injected_fetch_resume(tmp_path, cli_runner, monkeypatch):
+    """A fetch torn by KART_FAULTS mid-packstream retries and resumes; the
+    server's ``/api/v1/stats`` (via ``kart stats <url>``) reports matching
+    retry/resume counters — the ISSUE 3 acceptance flow."""
+    import threading
+
+    from kart_tpu.cli import cli
+    from kart_tpu.core.repo import KartRepo
+    from kart_tpu.synth import synth_repo
+    from kart_tpu.transport.http import HttpRemote, make_server
+    from kart_tpu.transport.retry import RetryPolicy
+
+    repo, _ = synth_repo(str(tmp_path / "src"), 4000, blobs="real", edit_frac=0.0)
+    server = make_server(repo)
+    threading.Thread(target=server.serve_forever, daemon=True).start()
+    try:
+        url = f"http://127.0.0.1:{server.server_address[1]}/"
+        dst = KartRepo.init_repository(str(tmp_path / "dst"))
+        client = HttpRemote(url, retry=RetryPolicy(attempts=3, base_delay=0.01))
+        wants = list(client.ls_refs()["heads"].values())
+        monkeypatch.setenv("KART_FAULTS", "transport.read.frame:1000")
+        try:
+            client.fetch_pack(dst, wants)
+        finally:
+            monkeypatch.delenv("KART_FAULTS", raising=False)
+
+        r = cli_runner.invoke(cli, ["stats", url])
+        assert r.exit_code == 0, r.output
+        text = r.output
+        # the torn first attempt retried once...
+        assert _metric(text, "kart_transport_retries_total", verb="fetch-pack") == 1
+        assert _metric(text, "kart_transport_salvage_events_total") == 1
+        # ...and the server saw exactly one resumed fetch-pack (two requests,
+        # the second carrying the salvaged-oid exclusion list)
+        assert (
+            _metric(text, "kart_transport_server_requests_total", verb="fetch-pack")
+            == 2
+        )
+        assert _metric(text, "kart_transport_server_fetch_resumes_total") == 1
+        # salvaged + resumed-remainder account for every object received
+        salvaged = _metric(text, "kart_transport_objects_salvaged_total")
+        received = _metric(text, "kart_transport_objects_received_total")
+        assert salvaged == 999  # the fault fired on frame 1000
+        total = sum(1 for _ in dst.odb.iter_oids())
+        assert salvaged + received == total
+    finally:
+        server.shutdown()
+        server.server_close()
+
+
+def test_stats_over_stdio_op(tmp_path):
+    """The stdio server answers the ``stats`` op with the exposition (the
+    ssh-remote path of ``kart stats``)."""
+    from helpers import make_imported_repo
+    from kart_tpu.transport.http import read_framed, write_framed
+    from kart_tpu.transport.stdio import serve_stdio
+
+    repo, _ = make_imported_repo(tmp_path, n=5)
+    req = io.BytesIO()
+    write_framed(req, {"op": "stats"}, ())
+    req.seek(0)
+    out = io.BytesIO()
+    serve_stdio(repo, req, out)
+    out.seek(0)
+    resp, _fp = read_framed(out)
+    assert "metrics" in resp
+    # the stats request itself is counted, so the exposition is never empty
+    assert (
+        'kart_transport_server_requests_total{verb="stats"} 1'
+        in resp["metrics"]
+    )
+
+
+def test_stats_local_cli(cli_runner):
+    from kart_tpu.cli import cli
+
+    telemetry.enable(metrics=True)
+    telemetry.incr("diff.datasets_diffed", 3)
+    r = cli_runner.invoke(cli, ["stats"])
+    assert r.exit_code == 0, r.output
+    assert "kart_diff_datasets_diffed_total 3" in r.output
+    r = cli_runner.invoke(cli, ["stats", "-o", "json"])
+    assert r.exit_code == 0, r.output
+    assert json.loads(r.output)["counters"]
