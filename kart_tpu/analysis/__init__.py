@@ -1,0 +1,30 @@
+"""`kart lint` — AST-based static analysis enforcing this repo's
+cross-cutting contracts (docs/ANALYSIS.md):
+
+    KTL001  env-var drift        KART_* surface <-> registry <-> docs index
+    KTL002  telemetry grammar    span/metric literals obey subsystem.name
+    KTL003  fault-point coverage hook/fire sites <-> registry <-> kill matrix
+    KTL004  resource lifecycle   with/close/ownership; gc-sweepable tmp files
+    KTL005  thread/fork safety   locked global writes; guarded forks
+    KTL006  exception hygiene    no bare/silent swallows, ^C survives
+    KTL007  bench-key drift      bench.py record keys <-> schema guard
+
+Entry points: ``kart lint [PATHS]`` and ``python -m kart_tpu.analysis``.
+Programmatic: :func:`run_lint` -> :class:`Report`.
+"""
+
+from kart_tpu.analysis.core import (  # noqa: F401
+    Finding,
+    Report,
+    Rule,
+    all_rule_classes,
+    default_targets,
+    repo_root,
+    rule_catalogue,
+    run_lint,
+)
+from kart_tpu.analysis.reporters import (  # noqa: F401
+    JSON_SCHEMA_VERSION,
+    to_json,
+    to_text,
+)

@@ -1,0 +1,42 @@
+"""``python -m kart_tpu.analysis [PATHS...] [--format=json]`` — the
+CI-friendly entry point (no click dependency; exit 0 = clean)."""
+
+import sys
+
+from kart_tpu import analysis
+
+
+def main(argv=None):
+    argv = list(sys.argv[1:] if argv is None else argv)
+    fmt = "text"
+    paths = []
+    it = iter(argv)
+    for arg in it:
+        if arg in ("--format=json", "--json"):
+            fmt = "json"
+        elif arg in ("--format=text",):
+            fmt = "text"
+        elif arg in ("-o", "--format"):  # same spelling as `kart lint -o`
+            fmt = next(it, "text")
+            if fmt not in ("text", "json"):
+                print(f"unknown format {fmt!r}", file=sys.stderr)
+                return 2
+        elif arg == "--rules":
+            for r in analysis.rule_catalogue():
+                print(f"{r['id']}  {r['name']}: {r['description']}")
+            return 0
+        elif arg.startswith("-"):
+            print(f"unknown option {arg!r}", file=sys.stderr)
+            return 2
+        else:
+            paths.append(arg)
+    report = analysis.run_lint(paths or None)
+    if fmt == "json":
+        print(analysis.to_json(report, indent=2))
+    else:
+        print(analysis.to_text(report))
+    return 0 if report.ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

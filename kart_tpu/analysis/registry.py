@@ -1,0 +1,128 @@
+"""Machine-readable registries for the cross-cutting contracts `kart lint`
+enforces (docs/ANALYSIS.md).
+
+These are *declarations*: the rules in :mod:`kart_tpu.analysis.rules` check
+the actual tree against them in both directions — an ``os.environ`` read of
+an undeclared ``KART_*`` name is a finding (KTL001), and so is a declared
+name nothing reads any more. The registries deliberately live in one small
+data-only module so a PR that grows the surface (a new env var, a new fault
+point) touches the declaration, the docs index, and the code in the same
+diff — that co-location is the contract.
+"""
+
+import re
+
+# ---------------------------------------------------------------------------
+# KTL001 — the KART_* environment-variable surface
+# ---------------------------------------------------------------------------
+
+#: scopes: "source" = read somewhere under kart_tpu/ or bench.py (the lint
+#: targets); "tests" = read only by the test suite / conftest. Both must
+#: appear in docs/OBSERVABILITY.md §7; only "source" entries must have a
+#: live read site.
+ENV_VARS = {
+    # telemetry / logging (docs/OBSERVABILITY.md §7 "Telemetry / logging")
+    "KART_TRACE": "source",
+    "KART_METRICS": "source",
+    "KART_LOG": "source",
+    # transport (ROBUSTNESS.md §1-§4)
+    "KART_TRANSPORT_RETRIES": "source",
+    "KART_TRANSPORT_RETRY_BASE": "source",
+    "KART_TRANSPORT_RETRY_CAP": "source",
+    "KART_HTTP_TIMEOUT": "source",
+    "KART_STDIO_TIMEOUT": "source",
+    "KART_SSH": "source",
+    "KART_SSH_KART": "source",
+    # faults / maintenance (ROBUSTNESS.md §5-§6)
+    "KART_FAULTS": "source",
+    "KART_GC_GRACE": "source",
+    # diff engine / kernels
+    "KART_DIFF_ENGINE": "source",
+    "KART_DIFF_DEVICE": "source",
+    "KART_DIFF_SHARDED": "source",
+    "KART_DEVICE_MIN_ROWS": "source",
+    "KART_SHARDED_MIN_ROWS": "source",
+    "KART_STREAM_MIN_ROWS": "source",
+    "KART_STREAM_CHUNK_ROWS": "source",
+    "KART_DEVICE_MIN_ENVELOPES": "source",
+    "KART_RESIDENT_MIN_ENVELOPES": "source",
+    "KART_BLOCK_PRUNE": "source",
+    "KART_FUSED_JSONL": "source",
+    "KART_FUSED_PROCS": "source",
+    # import / store
+    "KART_IMPORT_WORKERS": "source",
+    "KART_IMPORT_FAST": "source",
+    "KART_PACK_STORE_MAX": "source",
+    # runtime / JAX
+    "KART_NO_JAX": "source",
+    "KART_JAX_INIT_TIMEOUT": "source",
+    "KART_JAX_REPROBE": "source",
+    "KART_NO_XLA_CACHE": "source",
+    "KART_INSULATE_CPU": "source",
+    "KART_TESTS_ON_TPU": "tests",
+    # native library
+    "KART_TPU_NATIVE_LIB": "source",
+    "KART_TPU_NATIVE_IO_LIB": "source",
+    "KART_NO_NATIVE_BUILD": "source",
+    # misc
+    "KART_REPO": "source",
+    "KART_NTV2_GRID_DIR": "source",
+}
+
+#: prefix wildcards: any KART_<prefix>* read is declared by one entry here
+#: and one ``KART_<prefix>*`` row in the docs index (bench.py's per-section
+#: knobs would otherwise need a dozen rows nobody reads).
+ENV_PREFIXES = {
+    "KART_BENCH_": "source",
+}
+
+#: where the human-readable index lives; KTL001 round-trips against the
+#: ```KART_*`` names in this section (repo-relative path, section heading).
+ENV_DOC = ("docs/OBSERVABILITY.md", "environment variable index")
+
+
+def env_declared(name):
+    """Is ``name`` declared, directly or via a prefix wildcard?"""
+    return name in ENV_VARS or any(name.startswith(p) for p in ENV_PREFIXES)
+
+
+# ---------------------------------------------------------------------------
+# KTL003 — fault-injection points (kart_tpu/faults.py)
+# ---------------------------------------------------------------------------
+
+#: every ``faults.hook``/``faults.fire`` point in the tree. Each must also
+#: be exercised by the tests/test_faults.py kill matrix — a fault point
+#: nobody injects is untested crash-handling code.
+FAULT_POINTS = frozenset(
+    {
+        "transport.read.frame",
+        "transport.write.frame",
+        "odb.write_raw",
+        "odb.bulk_pack",
+        "pack.finalise",
+        "idx.write",
+    }
+)
+
+#: the kill matrix that must reference every point above.
+FAULT_TESTS = "tests/test_faults.py"
+
+# ---------------------------------------------------------------------------
+# KTL004 — crash-leftover file patterns the gc/fsck sweep covers
+# ---------------------------------------------------------------------------
+
+#: mirror of kart_tpu.core.repo._STALE_FILE_RE — KTL004 asserts the two
+#: stay textually identical (a drift means code writes temp files gc can no
+#: longer recognise). Covers ``<name>.tmp<pid>``, ``<name>.lock<pid>`` and
+#: PackWriter's ``.tmp-pack-*`` mkstemp prefix.
+GC_SWEEP_RE = re.compile(r"(\.(tmp|lock)\d*$)|(^\.tmp-)")
+
+# ---------------------------------------------------------------------------
+# KTL007 — bench record keys and where they must be asserted
+# ---------------------------------------------------------------------------
+
+#: the schema guard every bench.py result key must appear in (either as a
+#: NEW_KEYS literal there or as a key of the newest BENCH_r*.json record the
+#: guard replays).
+BENCH_SCHEMA_TEST = "tests/test_bench_schema.py"
+BENCH_RECORD_GLOB = "BENCH_r*.json"

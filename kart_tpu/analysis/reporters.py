@@ -1,0 +1,50 @@
+"""Finding reporters: human text and a stable JSON schema for external CI.
+
+The JSON document shape (``kart lint --format=json``) is a public,
+versioned contract — tests/test_analysis.py pins it::
+
+    {
+      "version": 1,
+      "ok": true|false,
+      "files_scanned": <int>,
+      "rules": [{"id": "KTL001", "name": "...", "description": "..."}, ...],
+      "findings": [
+        {"rule": "KTL004", "path": "kart_tpu/x.py", "line": 10,
+         "col": 4, "message": "..."},
+        ...
+      ]
+    }
+
+Findings are sorted by (path, line, col, rule); ``version`` only changes
+with a breaking shape change.
+"""
+
+import json
+
+JSON_SCHEMA_VERSION = 1
+
+
+def to_json(report, indent=None):
+    return json.dumps(
+        {
+            "version": JSON_SCHEMA_VERSION,
+            "ok": report.ok,
+            "files_scanned": report.files_scanned,
+            "rules": report.rules,
+            "findings": [f.to_dict() for f in report.findings],
+        },
+        indent=indent,
+    )
+
+
+def to_text(report):
+    lines = []
+    for f in report.findings:
+        lines.append(f"{f.path}:{f.line}:{f.col}: {f.rule} {f.message}")
+    n = len(report.findings)
+    lines.append(
+        f"{'ok' if report.ok else 'FAIL'}: {n} finding(s) across "
+        f"{report.files_scanned} file(s), "
+        f"{len(report.rules)} rules active"
+    )
+    return "\n".join(lines)

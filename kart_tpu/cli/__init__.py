@@ -55,6 +55,7 @@ _COMMANDS = {
     "commit-files": "kart_tpu.cli.data_cmds",
     "build-annotations": "kart_tpu.cli.data_cmds",
     "stats": "kart_tpu.cli.stats_cmds",
+    "lint": "kart_tpu.cli.lint_cmds",
 }
 
 

@@ -1,0 +1,42 @@
+"""``kart lint`` — run the static-analysis suite (docs/ANALYSIS.md).
+
+With no PATHS: the full tree (kart_tpu/ + bench.py) including the
+cross-file registry round-trip checks; with PATHS (files or directories):
+per-file checks only — the fast pre-commit mode. Exit 0 = clean."""
+
+import click
+
+from kart_tpu.cli import cli
+
+
+@cli.command()
+@click.argument("paths", nargs=-1, type=click.Path(exists=True))
+@click.option(
+    "-o",
+    "--format",
+    "fmt",
+    type=click.Choice(["text", "json"]),
+    default="text",
+    help="Output format (json is a stable schema for external CI)",
+)
+@click.option(
+    "--rules",
+    "list_rules",
+    is_flag=True,
+    help="List the rule catalogue and exit",
+)
+def lint(paths, fmt, list_rules):
+    """Check the tree against the repo's cross-cutting contracts."""
+    from kart_tpu import analysis
+
+    if list_rules:
+        for r in analysis.rule_catalogue():
+            click.echo(f"{r['id']}  {r['name']}: {r['description']}")
+        return
+    report = analysis.run_lint(list(paths) or None)
+    if fmt == "json":
+        click.echo(analysis.to_json(report, indent=2))
+    else:
+        click.echo(analysis.to_text(report))
+    if not report.ok:
+        raise SystemExit(1)

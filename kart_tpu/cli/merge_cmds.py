@@ -2,6 +2,7 @@
 kart/resolve.py)."""
 
 import json
+import logging
 import sys
 
 import click
@@ -134,8 +135,12 @@ class _ConflictDecoder:
                 continue
             try:
                 self.structures.append(RepoStructure(repo, refish))
-            except Exception:
-                pass
+            except Exception as e:
+                # a vanished/corrupt side of the merge: conflict labels
+                # fall back to whichever structures did resolve
+                logging.getLogger(__name__).debug(
+                    "skipping unreadable ref %r: %s", refish, e
+                )
         self._ds_cache = {}
 
     def _datasets_for(self, ds_path):

@@ -748,7 +748,7 @@ class JsonLinesDiffWriter(BaseDiffWriter):
             self.fp.flush()
         except (AttributeError, OSError):
             pass
-        ctx = multiprocessing.get_context("fork")
+        ctx = multiprocessing.get_context("fork")  # kart: noqa(KTL005): fork of a maybe-threaded process is tolerated by design — a child inheriting a wedged lock hangs, and the bounded join below terminates it and redoes its range in-process
         bounds = [m * w // n_procs for w in range(n_procs + 1)]
         workers = []
         for w in range(1, n_procs):

@@ -233,7 +233,10 @@ class MySqlImportSource(ImportSource):
                 import pymysql.cursors
 
                 cursor_cls = pymysql.cursors.SSCursor
-            except Exception:
+            except (ImportError, AttributeError):
+                # fake driver (tests) — possibly satisfying the import via
+                # a cached real pymysql but lacking SSCursor: the buffered
+                # cursor below covers both
                 pass
             cur = con.cursor(cursor_cls) if cursor_cls else con.cursor()
             cur.execute(

@@ -13,6 +13,7 @@ MERGING state, exactly like the reference's state machine
 """
 
 import json
+import logging
 
 import numpy as np
 
@@ -267,8 +268,12 @@ def _conflict_labels_batch(ds_path, datasets, blocks, per_block, n):
                 for i, pk in zip(pending, pks):
                     labels[i] = f"{ds_path}:feature:{pk}"
                 done = True
-            except Exception:
-                pass  # undecodable batch: per-path fallback below
+            except Exception as e:
+                # undecodable batch: the per-path loop below re-derives
+                # every label individually
+                logging.getLogger(__name__).debug(
+                    "batch path decode failed for %s: %s", ds_path, e
+                )
         if not done:
             version_datasets = [None] * len(blocks)
             version_datasets[v] = ds

@@ -71,12 +71,12 @@ def insulate_virtual_cpu(n_devices=8):
         jax.config.update("jax_platforms", "cpu")
         try:
             jax.config.update("jax_num_cpu_devices", n_devices)
-        except Exception:
+        except Exception:  # kart: noqa(KTL006): version-compat shim — any jax config shape falls back to the XLA_FLAGS set above
             pass  # older jax: XLA_FLAGS above covers it
         for plugin in list(xla_bridge._backend_factories):
             if plugin not in ("cpu", "interpreter"):
                 xla_bridge._backend_factories.pop(plugin, None)
-    except Exception:
+    except Exception:  # kart: noqa(KTL006): version-compat shim — if jax internals moved, the env vars set above still take effect
         pass  # jax internals moved: the env vars above still apply
     global _probe_result, _probe_thread, _probe_box
     with _probe_lock:

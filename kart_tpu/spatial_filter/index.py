@@ -244,8 +244,12 @@ class _BatchedEnvelopeExtractor:
                 ds_crs = CRS(crs_wkt)
                 if not ds_crs.is_geographic:
                     return Transform(ds_crs, self.crs_4326)
-        except Exception:
-            pass
+        except Exception as e:
+            L.debug(
+                "indexing %s in native axes (CRS unusable: %s)",
+                getattr(ds, "path", ds),
+                e,
+            )
         return None  # identity (already geographic / unknown)
 
     def _flush_bucket(self, con, transform, bucket):

@@ -422,7 +422,9 @@ class HttpRemote:
             detail = ""
             try:
                 detail = json.loads(e.read().decode()).get("error", "")
-            except Exception:
+            except (OSError, ValueError, AttributeError):
+                # non-JSON / unreadable error body: the HTTP status below
+                # is still reported
                 pass
             raise HttpTransportError(
                 f"Remote {self.base!r} error: {detail or e}",

@@ -189,7 +189,7 @@ class StdioRemote:
             # interpreter shutdown must not stall behind a wedged ssh —
             # give it a moment, then kill
             self.close(timeout=0.5)
-        except Exception:
+        except Exception:  # kart: noqa(KTL006): __del__ at interpreter shutdown — modules may already be torn down; close() is the real API and raises normally
             pass
 
     # -- framing -------------------------------------------------------------

@@ -5,6 +5,8 @@ holds the feature tables plus ``_kart_state`` / ``_kart_track``. Connection is
 via pyodbc + the MS ODBC driver when installed (driver-gated).
 """
 
+import logging
+
 from kart_tpu.adapters.sqlserver import SqlServerAdapter
 from kart_tpu.core.repo import NotFound
 from kart_tpu.workingcopy.db_server import DatabaseServerWorkingCopy
@@ -173,5 +175,9 @@ class SqlServerWorkingCopy(DatabaseServerWorkingCopy):
                     f"({self.ADAPTER.quote(geom_col.name)}) "
                     f"WITH (BOUNDING_BOX = (-180, -90, 180, 90))",
                 )
-            except Exception:
-                pass  # index is an optimisation; the data is already correct
+            except Exception as e:
+                # the index is an optimisation; the data is already correct
+                # (common cause: restricted CREATE INDEX permissions)
+                logging.getLogger(__name__).debug(
+                    "spatial index on %s not created: %s", table, e
+                )

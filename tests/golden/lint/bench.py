@@ -1,0 +1,23 @@
+"""Golden KTL007: a bench section emitting a result key the schema guard
+does not pin. (Named bench.py so the rule treats it as a bench module.)"""
+
+import time
+
+
+def _shiny_new_bench():
+    t0 = time.perf_counter()
+    return {
+        "totally_unpinned_metric_seconds": time.perf_counter() - t0,  # finding
+        "telemetry_overhead_pct": 0.0,  # pinned by NEW_KEYS: clean
+    }
+
+
+def _indirect_bench():
+    out = {"another_unpinned_key": 1}  # finding: dict flows to return
+    return out
+
+
+def _not_a_record():
+    config = {"user.email": "x@example.com"}  # never returned: out of scope
+    config.update({"unreturned_key_here": 1})
+    return None

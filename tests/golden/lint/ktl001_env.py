@@ -1,0 +1,11 @@
+"""Golden KTL001: undeclared KART_* env reads (every access shape)."""
+
+import os
+
+A = os.environ.get("KART_NOT_IN_REGISTRY")  # finding: .get read
+B = os.environ["KART_ALSO_MISSING"]  # finding: subscript read
+C = "KART_MISSING_TOO" in os.environ  # finding: membership test
+D = os.getenv("KART_GETENV_MISSING")  # finding: os.getenv
+OK = os.environ.get("KART_TRACE")  # declared: clean
+ALSO_OK = os.environ.get("KART_BENCH_ANYTHING")  # prefix wildcard: clean
+NOT_OURS = os.environ.get("XLA_FLAGS")  # non-KART: out of scope

@@ -1,0 +1,14 @@
+"""Golden KTL002: telemetry naming-grammar violations."""
+
+from kart_tpu import telemetry as tm
+
+
+def instrumented(n):
+    tm.incr("notasubsystem.thing")  # finding: unregistered subsystem
+    tm.gauge_set("BadShape", 1)  # finding: not dotted lowercase
+    tm.observe("diff.UPPER.case", n)  # finding: grammar violation
+    with tm.span("diff.classify", rows=n):  # registered + dotted: clean
+        pass
+    tm.incr(f"diff.rows_{n}")  # literal subsystem prefix: clean
+    tm.incr(f"{n}.retries")  # finding: no literal subsystem prefix
+    tm.observe(f"diff.{n} bad", 1)  # finding: rendered shape ungrammatical

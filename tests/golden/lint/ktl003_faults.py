@@ -1,0 +1,16 @@
+"""Golden KTL003: fault points outside the registry."""
+
+from kart_tpu import faults
+
+
+def risky_write(records):
+    faults.fire("bogus.point")  # finding: not in FAULT_POINTS
+    h = faults.hook("odb.write_raw")  # registered: clean
+    for _ in records:
+        if h is not None:
+            h()
+    faults.fire(compute_name())  # finding: non-literal point name
+
+
+def compute_name():
+    return "dynamic.point"

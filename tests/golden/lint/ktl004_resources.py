@@ -1,0 +1,59 @@
+"""Golden KTL004: leaked resources and unsweepable temp patterns."""
+
+import json
+import os
+import socket
+import subprocess
+import tempfile
+
+
+def leaks(path):
+    data = json.load(open(path))  # finding: consumed inline, never closed
+    f = open(path)  # finding: bound but never closed
+    first = f.readline()
+    proc = subprocess.Popen(["true"])  # finding: never waited/terminated
+    return data, first, proc.pid
+
+
+def fine(path, cmd):
+    with open(path) as f:  # with: clean
+        body = f.read()
+    g = open(path)  # closed in finally: clean
+    try:
+        head = g.readline()
+    finally:
+        g.close()
+    p = subprocess.Popen(cmd)  # terminated: clean
+    p.terminate()
+    s = socket.socket()  # returned (ownership to caller): clean
+    return body, head, s
+
+
+class Owner:
+    def start(self, cmd):
+        self.proc = subprocess.Popen(cmd)  # attribute: owner closes. clean
+
+
+def bad_temp_pattern(target):
+    return target + ".lock-old"  # finding: sweep regex never matches
+
+
+def good_temp_pattern(target):
+    return target + f".tmp{os.getpid()}"  # matches the sweep: clean
+
+
+def bad_mkstemp(pack_dir):
+    return tempfile.mkstemp(dir=pack_dir, prefix=".tmp.partial-")  # finding
+
+
+def leaks_via_use(path):
+    f = open(path)  # finding: using a handle is not transferring it
+    return json.load(f)
+
+
+def bad_whole_path_fstring(path):
+    return f"{path}.tmp-old{os.getpid()}"  # finding: unsweepable suffix
+
+
+def good_whole_path_fstring(path):
+    return f"{path}.lock{os.getpid()}"  # matches the sweep: clean

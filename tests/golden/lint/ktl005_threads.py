@@ -1,0 +1,49 @@
+"""Golden KTL005: unlocked global writes from thread entry points, and
+unguarded forks."""
+
+import multiprocessing
+import os
+import threading
+
+_CACHE = {}
+_RESULTS = []
+_LOCK = threading.Lock()
+
+
+def worker(key, value):
+    _CACHE[key] = value  # finding: unlocked write from a thread target
+    _RESULTS.append(value)  # finding: unlocked append
+
+
+def careful_worker(key, value):
+    with _LOCK:
+        _CACHE[key] = value  # locked: clean
+        _RESULTS.append(value)
+
+
+def shadowing_worker(key, value):
+    _CACHE = {}  # local rebind shadows the module dict: thread-safe, clean
+    _CACHE[key] = value
+    return _CACHE
+
+
+def spawn():
+    threading.Thread(target=worker, daemon=True).start()
+    threading.Thread(target=careful_worker, daemon=True).start()
+    threading.Thread(target=shadowing_worker, daemon=True).start()
+
+
+def fork_unguarded():
+    ctx = multiprocessing.get_context("fork")  # finding: no thread guard
+    return ctx
+
+
+def fork_guarded():
+    if threading.active_count() == 1:
+        ctx = multiprocessing.get_context("fork")  # guarded: clean
+        return ctx
+    return None
+
+
+def fork_direct():
+    return os.fork()  # finding: raw fork, no guard

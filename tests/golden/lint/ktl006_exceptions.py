@@ -1,0 +1,50 @@
+"""Golden KTL006: exception-hygiene violations."""
+
+import logging
+
+L = logging.getLogger(__name__)
+
+
+def bare(fn):
+    try:
+        return fn()
+    except:  # finding: bare except  # noqa: E722
+        return None
+
+
+def eats_ctrl_c(fn):
+    try:
+        return fn()
+    except BaseException:  # finding: swallows KeyboardInterrupt
+        return None
+
+
+def silent(fn):
+    try:
+        return fn()
+    except Exception:  # finding: silent swallow
+        pass
+
+
+def cleanup_and_reraise(fn, undo):
+    try:
+        return fn()
+    except BaseException:  # re-raises: clean
+        undo()
+        raise
+
+
+def narrow_silent(d, k):
+    try:
+        return d[k]
+    except KeyError:  # narrow type: clean
+        pass
+    return None
+
+
+def logged(fn):
+    try:
+        return fn()
+    except Exception as e:  # logged: clean
+        L.debug("swallowed: %s", e)
+        return None

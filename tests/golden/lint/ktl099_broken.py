@@ -1,0 +1,2 @@
+"""Golden KTL099: a target that does not parse."""
+def broken(:

@@ -1,0 +1,22 @@
+"""Golden suppressions: honored with rationale, rejected without."""
+
+
+def suppressed_with_rationale(fn):
+    try:
+        return fn()
+    except Exception:  # kart: noqa(KTL006): golden fixture — demonstrates an honored suppression
+        pass
+
+
+def suppressed_without_rationale(fn):
+    try:
+        return fn()
+    except Exception:  # kart: noqa(KTL006)
+        pass
+
+
+def suppressed_unknown_rule(fn):
+    try:
+        return fn()
+    except Exception:  # kart: noqa(KTL999): there is no rule KTL999
+        pass

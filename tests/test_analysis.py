@@ -1,0 +1,218 @@
+"""Tier-1 tests for the `kart lint` framework itself (ISSUE 4): the golden
+findings corpus (every rule demonstrably fires; suppressions honored), the
+stable JSON reporter schema, single-file mode, the CLI/module entry points,
+and the bidirectional registry round-trips (KTL001/KTL003) proven by
+tampering with the registry and watching the suite object."""
+
+import json
+import os
+
+import pytest
+
+from kart_tpu import analysis
+from kart_tpu.analysis import registry
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+CORPUS = os.path.join(HERE, "golden", "lint")
+
+
+def corpus_report(*names):
+    paths = [os.path.join(CORPUS, n) for n in names] if names else [CORPUS]
+    return analysis.run_lint(paths)
+
+
+# -- golden corpus ----------------------------------------------------------
+
+
+def test_golden_corpus_findings_match_expected_exactly():
+    with open(os.path.join(CORPUS, "expected.json")) as f:
+        expected = {
+            k: sorted(map(tuple, v))
+            for k, v in json.load(f).items()
+            if not k.startswith("_")
+        }
+    report = corpus_report()
+    actual = {}
+    for finding in report.findings:
+        actual.setdefault(os.path.basename(finding.path), []).append(
+            (finding.rule, finding.line)
+        )
+    actual = {k: sorted(v) for k, v in actual.items()}
+    assert actual == expected
+
+
+def test_every_rule_fires_on_the_corpus():
+    """The ISSUE 4 acceptance criterion: >=7 active rules, each with a
+    demonstrable finding (plus KTL000 suppression hygiene and KTL099
+    parse-error)."""
+    report = corpus_report()
+    fired = {f.rule for f in report.findings}
+    declared = {r["id"] for r in report.rules}
+    assert declared <= fired, f"rules that never fire: {declared - fired}"
+    assert len(declared - {"KTL000", "KTL099"}) >= 7
+
+
+def test_suppression_with_rationale_is_honored():
+    report = corpus_report("suppressions.py")
+    by_line = {(f.rule, f.line) for f in report.findings}
+    # line 7: KTL006 suppressed by a rationale-carrying noqa, no KTL000
+    assert not any(line == 7 for _r, line in by_line)
+    # line 14: KTL006 suppressed but flagged for the missing rationale
+    assert ("KTL000", 14) in by_line
+    assert ("KTL006", 14) not in by_line
+    # line 21: unknown rule id — nothing suppressed, noqa itself flagged
+    assert ("KTL000", 21) in by_line
+    assert ("KTL006", 21) in by_line
+
+
+# -- reporters --------------------------------------------------------------
+
+
+def test_json_reporter_schema_is_stable():
+    doc = json.loads(analysis.to_json(corpus_report("ktl006_exceptions.py")))
+    assert doc["version"] == analysis.JSON_SCHEMA_VERSION == 1
+    assert set(doc) == {"version", "ok", "files_scanned", "rules", "findings"}
+    assert doc["ok"] is False
+    assert doc["files_scanned"] == 1
+    for rule in doc["rules"]:
+        assert set(rule) == {"id", "name", "description"}
+    for f in doc["findings"]:
+        assert set(f) == {"rule", "path", "line", "col", "message"}
+        assert isinstance(f["line"], int) and f["line"] >= 1
+    # sorted by (path, line, col, rule): stable for diffing in CI
+    keys = [(f["path"], f["line"], f["col"], f["rule"]) for f in doc["findings"]]
+    assert keys == sorted(keys)
+
+
+def test_text_reporter_mentions_every_finding_location():
+    report = corpus_report("ktl001_env.py")
+    text = analysis.to_text(report)
+    for f in report.findings:
+        assert f"{f.path}:{f.line}:{f.col}: {f.rule}" in text
+    assert "FAIL" in text
+
+
+# -- single-file mode -------------------------------------------------------
+
+
+def test_single_file_mode_scans_only_that_file():
+    report = corpus_report("ktl002_telemetry.py")
+    assert report.files_scanned == 1
+    assert {f.rule for f in report.findings} == {"KTL002"}
+    # cross-file round-trip checks (registry<->docs<->tests) only run on
+    # the full default target set
+    assert not any(
+        f.path.endswith(("registry.py", "OBSERVABILITY.md"))
+        for f in report.findings
+    )
+
+
+# -- entry points -----------------------------------------------------------
+
+
+def test_cli_lint_command_json_and_exit_code(cli_runner):
+    from kart_tpu.cli import cli
+
+    bad = os.path.join(CORPUS, "ktl006_exceptions.py")
+    r = cli_runner.invoke(cli, ["lint", bad, "-o", "json"])
+    assert r.exit_code == 1
+    doc = json.loads(r.output)
+    assert doc["ok"] is False
+    assert any(f["rule"] == "KTL006" for f in doc["findings"])
+
+    r = cli_runner.invoke(cli, ["lint", "--rules"])
+    assert r.exit_code == 0
+    for rule_id in ("KTL000", "KTL001", "KTL007"):
+        assert rule_id in r.output
+
+
+def test_module_entry_point(capsys):
+    from kart_tpu.analysis.__main__ import main
+
+    rc = main([os.path.join(CORPUS, "ktl003_faults.py"), "--format=json"])
+    assert rc == 1
+    doc = json.loads(capsys.readouterr().out)
+    assert [f["rule"] for f in doc["findings"]] == ["KTL003", "KTL003"]
+    assert main(["--bogus-option"]) == 2
+
+
+# -- registry round-trips (the KTL001/KTL003 bidirectional guarantee) -------
+
+
+def test_env_registry_roundtrip_detects_drift_both_ways(monkeypatch):
+    """Adding a declaration nothing reads (and the docs don't index) must
+    produce findings in both directions — proving the full run actually
+    cross-checks code <-> registry <-> docs."""
+    patched = dict(registry.ENV_VARS)
+    patched["KART_FAKE_UNUSED_FLAG"] = "source"
+    monkeypatch.setattr(registry, "ENV_VARS", patched)
+    report = analysis.run_lint()
+    messages = [f.message for f in report.findings if f.rule == "KTL001"]
+    assert any(
+        "KART_FAKE_UNUSED_FLAG" in m and "missing from" in m for m in messages
+    ), messages
+    assert any(
+        "KART_FAKE_UNUSED_FLAG" in m and "no read site" in m for m in messages
+    ), messages
+
+
+def test_missing_kill_matrix_fails_loudly(monkeypatch):
+    """A deleted/renamed tests/test_faults.py must be a finding, not a
+    silently-skipped coverage direction."""
+    monkeypatch.setattr(registry, "FAULT_TESTS", "tests/nope_faults.py")
+    report = analysis.run_lint()
+    assert any(
+        f.rule == "KTL003" and "kill matrix" in f.message and "missing" in f.message
+        for f in report.findings
+    )
+
+
+def test_fault_registry_roundtrip_detects_drift(monkeypatch):
+    monkeypatch.setattr(
+        registry,
+        "FAULT_POINTS",
+        frozenset(registry.FAULT_POINTS | {"fake.untested_point"}),
+    )
+    report = analysis.run_lint()
+    messages = [f.message for f in report.findings if f.rule == "KTL003"]
+    assert any(
+        "fake.untested_point" in m and "no faults.hook" in m for m in messages
+    ), messages
+    assert any(
+        "fake.untested_point" in m and "never injected" in m for m in messages
+    ), messages
+
+
+# -- framework details ------------------------------------------------------
+
+
+def test_unparseable_target_reports_not_crashes(tmp_path):
+    bad = tmp_path / "broken.py"
+    bad.write_text("def broken(:\n")
+    report = analysis.run_lint([str(bad)])
+    assert not report.ok
+    # its own rule id, so CI doesn't triage syntax errors as noqa problems
+    assert report.findings[0].rule == "KTL099"
+    assert "cannot lint" in report.findings[0].message
+
+
+def test_ktl000_cannot_be_suppressed(tmp_path):
+    snippet = tmp_path / "sneaky.py"
+    snippet.write_text(
+        "def f(fn):\n"
+        "    try:\n"
+        "        return fn()\n"
+        "    except Exception:  "
+        "# kart: noqa(KTL006, KTL000): trying to silence the silencer\n"
+        "        pass\n"
+    )
+    report = analysis.run_lint([str(snippet)])
+    assert any(
+        f.rule == "KTL000" and "cannot be suppressed" in f.message
+        for f in report.findings
+    )
+
+
+@pytest.mark.parametrize("name", sorted(registry.ENV_VARS) + ["KART_BENCH_X"])
+def test_env_declared_covers_every_registry_entry(name):
+    assert registry.env_declared(name)

@@ -54,6 +54,16 @@ NEW_KEYS += [
     "telemetry_diff_rows",
 ]
 
+#: keys added by ISSUE 4 (static-analysis suite: `kart lint` full-tree
+#: runtime + active rule/file/finding counts — the lint rule KTL007 checks
+#: the reverse direction, bench keys without a guard entry)
+NEW_KEYS += [
+    "lint_runtime_seconds",
+    "lint_rules_total",
+    "lint_files_scanned",
+    "lint_findings_total",
+]
+
 
 def test_bench_emits_every_recorded_key():
     with open(os.path.join(REPO_ROOT, "bench.py")) as f:

@@ -544,6 +544,118 @@ def test_fsck_reports_stale_leftovers(tmp_path, monkeypatch):
 # ---------------------------------------------------------------------------
 
 
+def test_fetch_with_server_killed_mid_write_frame_resumes(
+    served_repo, tmp_path, monkeypatch
+):
+    """transport.write.frame kill matrix: the *sender* (here the server
+    serialising the fetch pack) dying at a frame boundary surfaces as a
+    server-reported op error — deliberately non-transient, so the client
+    keeps a resumable partial instead of hammering a broken server, and
+    `kart fetch` completes the transfer. The read-side matrix above covers
+    the receiver half."""
+    repo, ds_path, url = served_repo
+    directory = tmp_path / "partial"
+    monkeypatch.setenv("KART_FAULTS", "transport.write.frame:4")
+    with pytest.raises(RemoteError, match="resume"):
+        transport.clone(url, directory, do_checkout=False)
+    monkeypatch.delenv("KART_FAULTS")
+
+    resumed = KartRepo(str(directory))
+    assert resumed.read_gitdir_file(FETCH_RESUME_FILE) is not None
+    salvaged = fsck_objects(resumed)  # whatever landed is fsck-clean
+    total = fsck_objects(repo)
+    assert salvaged < total
+    updated = transport.fetch(resumed, "origin")
+    assert updated.get("refs/remotes/origin/main") == repo.head_commit_oid
+    assert fsck_objects(resumed) == total
+    assert resumed.read_gitdir_file(FETCH_RESUME_FILE) is None
+
+
+def test_push_killed_mid_write_frame_leaves_server_untouched(
+    served_repo, tmp_path, monkeypatch
+):
+    """transport.write.frame on the push side: the client dying while
+    serialising its pack never reaches the wire — the server stays
+    byte-identical and a retried push lands the objects."""
+    repo, ds_path, url = served_repo
+    clone = transport.clone(url, tmp_path / "clone", do_checkout=False)
+    clone.config.set_many({"user.name": "C", "user.email": "c@example.com"})
+    new_oid = edit_commit(clone, ds_path, deletes=[2], message="to push")
+
+    before = store_snapshot(repo)
+    ref_before = repo.refs.get("refs/heads/main")
+    monkeypatch.setenv("KART_TRANSPORT_RETRIES", "1")  # surface the kill
+    monkeypatch.setenv("KART_FAULTS", "transport.write.frame:1")
+    with pytest.raises(Exception):
+        transport.push(clone, "origin")
+    monkeypatch.delenv("KART_FAULTS")
+    monkeypatch.delenv("KART_TRANSPORT_RETRIES")
+
+    assert store_snapshot(repo) == before
+    assert repo.refs.get("refs/heads/main") == ref_before
+    assert quarantine_entries(repo) == []
+    assert transport.push(clone, "origin") == {"refs/heads/main": new_oid}
+    assert repo.refs.get("refs/heads/main") == new_oid
+
+
+def test_idx_write_fault_leaves_no_half_indexed_pack(tmp_path, monkeypatch):
+    """idx.write kill matrix: a crash during idx serialisation (after the
+    pack body renamed into place) must leave the pack invisible to readers
+    — an unindexed pack is never a source of truth — and the same write
+    retried lands cleanly."""
+    repo = KartRepo.init_repository(tmp_path / "r")
+    monkeypatch.setenv("KART_FAULTS", "idx.write:1")
+    with pytest.raises(faults.InjectedFault):
+        with repo.odb.bulk_pack():
+            repo.odb.write_raw("blob", b"doomed")
+    monkeypatch.delenv("KART_FAULTS")
+    assert fsck_objects(repo) == 0  # nothing readable landed
+    # retry after the injected crash: the identical pack bytes rename over
+    # the orphan and this time the idx completes
+    with repo.odb.bulk_pack():
+        oid = repo.odb.write_raw("blob", b"doomed")
+    assert repo.odb.contains(oid)
+    assert fsck_objects(repo) == 1
+
+
+def test_write_raw_fault_leaves_store_unchanged(tmp_path, monkeypatch):
+    """odb.write_raw kill matrix: the injection fires at call entry (a
+    disk-full / crash before anything lands) — the store is untouched, not
+    even debris, and the retried write succeeds."""
+    repo = KartRepo.init_repository(tmp_path / "r")
+    monkeypatch.setenv("KART_FAULTS", "odb.write_raw:1")
+    with pytest.raises(faults.InjectedFault):
+        repo.odb.write_raw("blob", b"precious")
+    monkeypatch.delenv("KART_FAULTS")
+    assert fsck_objects(repo) == 0
+    oid = repo.odb.write_raw("blob", b"precious")
+    assert repo.odb.contains(oid)
+    assert fsck_objects(repo) == 1
+
+
+def test_bulk_pack_exit_fault_leaves_sweepable_debris(tmp_path, monkeypatch):
+    """odb.bulk_pack kill matrix: dying on bulk-context exit — after every
+    object was added but before the pack finalises — leaves only
+    `.tmp-pack-*` debris the sweeper claims; the retried bulk write lands
+    the objects."""
+    repo = KartRepo.init_repository(tmp_path / "r")
+    monkeypatch.setenv("KART_FAULTS", "odb.bulk_pack:1")
+    with pytest.raises(faults.InjectedFault):
+        with repo.odb.bulk_pack():
+            repo.odb.write_raw("blob", b"doomed")
+    monkeypatch.delenv("KART_FAULTS")
+    assert fsck_objects(repo) == 0
+    pack_dir = os.path.join(repo.odb.objects_dir, "pack")
+    leftovers = os.listdir(pack_dir) if os.path.isdir(pack_dir) else []
+    assert all(n.startswith(".tmp-pack-") for n in leftovers)
+    with repo.odb.bulk_pack():
+        oid = repo.odb.write_raw("blob", b"doomed")
+    assert repo.odb.contains(oid)
+    assert fsck_objects(repo) == 1
+    # the sweeper claims exactly the crash debris, nothing else
+    assert repo.gc("--prune-now")["pruned"] == len(leftovers)
+
+
 def test_bulk_pack_finalise_fault_leaves_sweepable_debris(tmp_path, monkeypatch):
     """A crash between pack body and finalisation must leave only temp
     debris the sweeper recognises — never a half-valid pack the reader

@@ -1,0 +1,36 @@
+"""Tier-1 gate (ISSUE 4): `kart lint` is clean at HEAD and stays fast.
+
+This is the enforcement half of the static-analysis suite — the golden
+corpus (tests/test_analysis.py) proves the rules *can* fire; this test
+proves they *don't* on the shipped tree, so every cross-cutting contract
+(env vars, telemetry grammar, fault points, resource lifecycle, thread/fork
+safety, exception hygiene, bench schema) is machine-verified on every run.
+"""
+
+import time
+
+from kart_tpu import analysis
+
+
+def test_lint_clean_at_head():
+    report = analysis.run_lint()
+    assert report.ok, "kart lint found:\n" + analysis.to_text(report)
+    # the full default target set actually ran (not a silently-empty scan)
+    assert report.files_scanned >= 100
+    assert "bench.py" in report.scanned
+    assert "kart_tpu/core/repo.py" in report.scanned
+
+
+def test_rule_catalogue_complete():
+    ids = {r["id"] for r in analysis.rule_catalogue()}
+    # 7 contract rules + KTL000 suppression hygiene + KTL099 parse-error
+    assert ids == {f"KTL00{i}" for i in range(8)} | {"KTL099"}
+
+
+def test_lint_runs_under_five_seconds():
+    """The ISSUE 4 performance bound: whole tree + bench.py in <5s on CPU
+    (measured ~2.2s; bench.py records the exact number as
+    lint_runtime_seconds)."""
+    t0 = time.perf_counter()
+    analysis.run_lint()
+    assert time.perf_counter() - t0 < 5.0

@@ -10,7 +10,6 @@ import io
 import json
 import logging
 import os
-import re
 import time
 
 import pytest
@@ -275,38 +274,38 @@ def test_import_phase_self_times_sum_to_at_most_total(tmp_path):
     assert all(v >= -1e-9 for v in phases.values())
 
 
-# -- naming grammar (CI satellite a) ----------------------------------------
-
-_CALL_RE = re.compile(
-    r"""(?:tm|telemetry)\.(?:span|incr|gauge_set|observe)\(\s*[fb]?["']([^"']+)["']"""
-)
+# -- naming grammar (CI satellite a; enforcement now lives in kart lint) ----
 
 
 def test_all_instrumented_names_match_grammar():
-    """Static guard: every metric/span name literal in the source obeys the
-    documented grammar (docs/OBSERVABILITY.md): dotted lowercase
-    ``subsystem.metric``, first segment a registered subsystem."""
-    root = os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
-    names = set()
-    for dirpath, _dirs, files in os.walk(os.path.join(root, "kart_tpu")):
-        for fn in files:
-            if not fn.endswith(".py"):
+    """The naming-grammar guard is the KTL002 lint rule (ISSUE 4 moved the
+    one-off regex scan into kart_tpu/analysis so `kart lint` and this test
+    share one source of truth). Here: run exactly that rule over the tree
+    and assert it is clean AND that its AST scan still sees the
+    instrumentation (an empty scan means the detection rotted, not that
+    the tree is clean)."""
+    from kart_tpu.analysis.core import FileContext, default_targets, repo_root
+    from kart_tpu.analysis.rules import TelemetryGrammar
+
+    rule = TelemetryGrammar()
+    bad = []
+    for path in default_targets(repo_root()):
+        with open(path) as f:
+            ctx = FileContext(
+                path, os.path.relpath(path, repo_root()), f.read()
+            )
+        for finding in rule.visit_file(ctx):
+            # honor noqa suppressions exactly as `kart lint` does — this
+            # test and the CLI must never disagree about the same line
+            entry = ctx.noqa.get(finding.line)
+            if entry is not None and finding.rule in entry[0]:
                 continue
-            with open(os.path.join(dirpath, fn)) as f:
-                names.update(_CALL_RE.findall(f.read()))
-    with open(os.path.join(root, "bench.py")) as f:
-        names.update(_CALL_RE.findall(f.read()))
-    assert names, "no instrumented names found — the scan regex rotted"
-    bad = sorted(
-        n
-        for n in names
-        if not telemetry.NAME_RE.match(n) or n.split(".", 1)[0] not in telemetry.SUBSYSTEMS
-    )
-    assert not bad, (
-        f"metric/span names violate the naming grammar "
-        f"(<subsystem>.<metric>, lowercase dotted; subsystems: "
-        f"{sorted(telemetry.SUBSYSTEMS)}): {bad}"
-    )
+            bad.append(finding)
+    # the scan still sees the instrumentation: an empty scan means the
+    # detection rotted, not that the tree is clean
+    assert rule.names_seen, "no instrumented names found — the scan rotted"
+    assert len({n for n, _rel, _line in rule.names_seen}) > 20
+    assert not bad, [repr(f) for f in bad]
 
 
 # -- overhead bound (CI satellite b) ----------------------------------------
