@@ -52,6 +52,10 @@ ENV_VARS = {
     # import / store
     "KART_IMPORT_WORKERS": "source",
     "KART_IMPORT_FAST": "source",
+    "KART_IMPORT_PIPELINE": "source",
+    "KART_IMPORT_QUEUE_BATCHES": "source",
+    "KART_IMPORT_NATIVE_READ": "source",
+    "KART_IMPORT_BATCH_ROWS": "source",
     "KART_PACK_STORE_MAX": "source",
     # runtime / JAX
     "KART_NO_JAX": "source",
@@ -101,6 +105,8 @@ FAULT_POINTS = frozenset(
         "odb.bulk_pack",
         "pack.finalise",
         "idx.write",
+        "import.encode",
+        "import.pack_stream",
     }
 )
 

@@ -52,8 +52,14 @@ def plan_int_feature_tree(pks, encoder=None):
     assert encoder.group_length == 1, "upper-level builder assumes 1-char tree names"
     plan = TreePlan()
     plan.encoder = encoder
-    srt = np.argsort(pks, kind="stable")
-    pks = np.ascontiguousarray(np.asarray(pks, dtype=np.int64)[srt])
+    pks = np.asarray(pks, dtype=np.int64)
+    if pks.size > 1 and (pks[1:] > pks[:-1]).all():
+        # already strictly increasing (the importer's ORDER BY pk stream):
+        # skip the argsort, one O(n) check
+        srt = np.arange(pks.size)
+    else:
+        srt = np.argsort(pks, kind="stable")
+    pks = np.ascontiguousarray(pks[srt])
     n = plan.n = len(pks)
 
     fn_bytes, fn_len = _msgpack_single_int_batch(pks)
@@ -119,6 +125,59 @@ def plan_int_feature_tree(pks, encoder=None):
     return plan
 
 
+def _stamp_oids(plan, oids_u8):
+    """Write the (sorted) blob-oid column into the plan's entry matrix."""
+    oids_sorted = np.asarray(oids_u8, dtype=np.uint8)[plan.order]
+    if plan.fixed_width:
+        plan.entry_matrix[:, plan.oid_cols[0]] = oids_sorted
+    else:
+        rows = np.arange(plan.n)
+        plan.entry_matrix[rows[:, None], plan.oid_cols] = oids_sorted
+
+
+def _leaf_payloads(plan, touched):
+    """Serialised leaf-tree payload bytes for the given leaf slots (the
+    entry matrix must already carry the oid column — :func:`_stamp_oids`)."""
+    first_idx, counts = plan.first_idx, plan.counts
+    if plan.fixed_width:
+        buf = plan.entry_matrix  # slice rows directly
+        return [
+            buf[first_idx[t] : first_idx[t] + counts[t]].tobytes()
+            for t in touched.tolist()
+        ]
+    full = plan.entry_matrix[~plan.hole_mask].tobytes()
+    starts = plan.byte_offsets[first_idx]
+    ends = plan.byte_offsets[first_idx + counts]
+    return [full[starts[t] : ends[t]] for t in touched.tolist()]
+
+
+def emit_leaf_trees(writer, plan, oids_u8, pks):
+    """Stamp the blob oids into ``plan`` and write ONLY its leaf tree
+    objects into ``writer`` (a PackWriter); -> [(leaf_tree_path, hex oid)],
+    leaf paths relative to the feature root (e.g. ``"A/B/c/D"``).
+
+    The parallel-import worker half of the Merkle build: each worker ships
+    whole leaf trees in its own pack, the parent stitches them into the
+    dataset spine with the ordinary TreeBuilder (reference analog: the
+    N-way fast-import temp-branch merge, kart/fast_import.py:286-399)."""
+    n = plan.n
+    if n == 0:
+        return []
+    _stamp_oids(plan, oids_u8)
+    touched = np.arange(len(plan.uniq_leaves))
+    payloads = _leaf_payloads(plan, touched)
+    oids = []
+    for i in range(0, len(payloads), _TREE_BATCH):
+        oids.extend(writer.add_batch("tree", payloads[i : i + _TREE_BATCH]))
+    pks_sorted = np.asarray(pks, dtype=np.int64)[plan.order]
+    enc = plan.encoder
+    paths = [
+        enc.encode_pks_to_path((int(pks_sorted[fi]),)).rpartition("/")[0]
+        for fi in plan.first_idx.tolist()
+    ]
+    return list(zip(paths, oids))
+
+
 def _write_level(odb, payloads):
     """Batch-write tree objects; -> list of hex oids."""
     oids = []
@@ -142,12 +201,8 @@ def emit_feature_tree(odb, plan, oids_u8, *, prev=None):
     n = plan.n
     if n == 0:
         return odb.write_tree([]), []
-    oids_sorted = np.asarray(oids_u8, dtype=np.uint8)[plan.order]
+    _stamp_oids(plan, oids_u8)
     rows = np.arange(n)
-    if plan.fixed_width:
-        plan.entry_matrix[:, plan.oid_cols[0]] = oids_sorted
-    else:
-        plan.entry_matrix[rows[:, None], plan.oid_cols] = oids_sorted
 
     uniq, first_idx, counts = plan.uniq_leaves, plan.first_idx, plan.counts
     if prev is not None:
@@ -160,30 +215,25 @@ def emit_feature_tree(odb, plan, oids_u8, *, prev=None):
         touched = np.arange(len(uniq))
         leaf_oids = [None] * len(uniq)
 
-    if plan.fixed_width:
-        width = plan.entry_matrix.shape[1]
-        buf = plan.entry_matrix  # slice rows directly
-        payloads = [
-            buf[first_idx[t] : first_idx[t] + counts[t]].tobytes()
-            for t in touched.tolist()
-        ]
-    else:
-        full = plan.entry_matrix[~plan.hole_mask].tobytes()
-        starts = plan.byte_offsets[first_idx]
-        ends = plan.byte_offsets[first_idx + counts]
-        payloads = [
-            full[starts[t] : ends[t]] for t in touched.tolist()
-        ]
+    payloads = _leaf_payloads(plan, touched)
     new_oids = _write_level(odb, payloads)
     for t, oid in zip(touched.tolist(), new_oids):
         leaf_oids[t] = oid
 
+    root = build_upper_levels(odb, uniq, leaf_oids, plan.encoder)
+    return root, leaf_oids
+
+
+def build_upper_levels(odb, child_ids, child_oids, encoder):
+    """Build and write the spine of upper-level trees over already-written
+    leaf trees; -> feature-tree root hex oid. ``child_ids``: int64 leaf
+    slots (``pk // branches`` space, ascending); ``child_oids``: their hex
+    oids. Shared by :func:`emit_feature_tree` and the import pipeline's
+    streamed leaf build (identical grouping -> identical tree objects)."""
     # upper levels: group child trees by parent prefix, entries
     # "40000 <char>\0" + oid, children sorted by raw char byte
-    encoder = plan.encoder
     alpha = encoder.alphabet
-    child_ids = uniq
-    child_oids = leaf_oids
+    child_ids = np.asarray(child_ids, dtype=np.int64)
     for _level in range(encoder.levels - 1, -1, -1):
         parents = {}
         for cid, coid in zip(child_ids.tolist(), child_oids):
@@ -205,7 +255,7 @@ def emit_feature_tree(odb, plan, oids_u8, *, prev=None):
         child_oids = _write_level(odb, payloads)
         child_ids = parent_ids
     assert len(child_oids) == 1
-    return child_oids[0], leaf_oids
+    return child_oids[0]
 
 
 def build_int_feature_tree(odb, pks, oids_u8, encoder=None):
@@ -221,5 +271,138 @@ def build_int_feature_tree(odb, pks, oids_u8, encoder=None):
         return odb.write_tree([])
     oid, _ = emit_feature_tree(odb, plan, oids_u8)
     return oid
+
+
+class StreamingLeafEmitter:
+    """Incremental leaf-tree construction from the import pipeline's sorted
+    (pk, blob-oid) stream: :meth:`feed` buffers the trailing partial leaf
+    and returns the serialised payloads of every leaf COMPLETED by the
+    batch, so leaf hashing/packing overlaps the feature stream instead of
+    running as a serial tail after it. Payload bytes are produced by the
+    same :func:`plan_int_feature_tree` machinery as the end-of-stream
+    build — a leaf's payload depends only on its own rows, so the streamed
+    build is bit-identical (property-tested).
+
+    Only valid for strictly-increasing, non-negative pks below
+    ``branches ** (levels + 1)`` (no leaf-id wraparound — leaf ids arrive
+    in ascending order or not at all). The first violation flips
+    :attr:`ok` False and the caller falls back to the end-of-stream
+    ``build_int_feature_tree``; leaves already emitted become unreferenced
+    pack objects, which is benign (the root oid is rebuilt from the full
+    column set)."""
+
+    def __init__(self, encoder=None):
+        self.encoder = encoder or PathEncoder.INT_PK_ENCODER
+        self.ok = self.encoder.scheme == "int"
+        self._pk_limit = self.encoder.branches ** (self.encoder.levels + 1)
+        from kart_tpu import native
+
+        self._native = self.ok and native.load_io() is not None
+        self._last_pk = None
+        self._carry_pks = np.empty(0, dtype=np.int64)
+        self._carry_oids = np.empty((0, 20), dtype=np.uint8)
+        #: ascending leaf slots emitted so far (list of int64 arrays)
+        self.leaf_id_chunks = []
+
+    def _check(self, pks):
+        if pks[0] < 0 or pks[-1] >= self._pk_limit:
+            return False
+        if self._last_pk is not None and pks[0] <= self._last_pk:
+            return False
+        return bool((pks[1:] > pks[:-1]).all())
+
+    def _payloads(self, pks, oids_u8):
+        """Complete-leaf payloads for sorted ``pks`` -> (buf uint8,
+        offsets int64 (n_leaves+1,), leaf_ids int64).
+
+        Leaves partition the (leaf, name)-sorted rows contiguously, so the
+        concatenated leaf payloads ARE the (hole-compacted) entry matrix —
+        no per-leaf bytes objects, no join; the same buffer
+        :func:`_leaf_payloads` would produce sliced per leaf (the
+        equivalence property tests pin this).
+
+        When the native IO core is present the whole build (msgpack + b64
+        names, leaf grouping, in-leaf git name sort, entry emit) runs in
+        one GIL-free call (io_leaf_payloads) — it was the import stream's
+        largest remaining Python cost. The emitter's :meth:`_check` already
+        guarantees what the kernel needs (ascending pks within
+        ``branches ** (levels+1)``, so ``pk // branches`` needs no
+        ``max_trees`` wrap); the numpy plan below is the fallback and the
+        equivalence reference."""
+        if self._native:
+            from kart_tpu import native
+
+            out = native.leaf_payloads(
+                pks, oids_u8, self.encoder.branches, self._pk_limit
+            )
+            if out is not None:
+                self.leaf_id_chunks.append(out[2])
+                return out
+            self._native = False  # lib lost mid-run: stay on the plan path
+        plan = plan_int_feature_tree(pks, self.encoder)
+        _stamp_oids(plan, oids_u8)
+        n_leaves = len(plan.uniq_leaves)
+        offsets = np.empty(n_leaves + 1, dtype=np.int64)
+        if plan.fixed_width:
+            buf = plan.entry_matrix.reshape(-1)
+            offsets[0] = 0
+            np.cumsum(
+                plan.counts * plan.entry_matrix.shape[1], out=offsets[1:]
+            )
+        else:
+            buf = plan.entry_matrix[~plan.hole_mask]
+            offsets[:-1] = plan.byte_offsets[plan.first_idx]
+            offsets[-1] = plan.byte_offsets[plan.n]
+        self.leaf_id_chunks.append(plan.uniq_leaves)
+        return buf, offsets, plan.uniq_leaves
+
+    def feed(self, pks, oids_u8):
+        """Consume one sorted stream batch; -> (payload_buf, offsets,
+        leaf_ids) for the leaves the batch completed, or None (nothing
+        completed yet, or the stream turned out not to be streamable —
+        check :attr:`ok`)."""
+        if not self.ok:
+            return None
+        pks = np.asarray(pks, dtype=np.int64)
+        if pks.size == 0:
+            return None
+        if not self._check(pks):
+            self.ok = False
+            return None
+        self._last_pk = int(pks[-1])
+        oids_u8 = np.asarray(oids_u8, dtype=np.uint8).reshape(-1, 20)
+        if self._carry_pks.size:
+            pks = np.concatenate([self._carry_pks, pks])
+            oids_u8 = np.concatenate([self._carry_oids, oids_u8])
+        # rows of the last (possibly still growing) leaf stay buffered
+        leaf = pks // self.encoder.branches
+        cut = int(np.searchsorted(leaf, leaf[-1]))
+        self._carry_pks = pks[cut:]
+        self._carry_oids = oids_u8[cut:]
+        if cut == 0:
+            return None
+        return self._payloads(pks[:cut], oids_u8[:cut])
+
+    def finish(self):
+        """Payloads of the final partial leaf; -> same shape as
+        :meth:`feed` or None."""
+        if not self.ok or not self._carry_pks.size:
+            return None
+        out = self._payloads(self._carry_pks, self._carry_oids)
+        self._carry_pks = np.empty(0, dtype=np.int64)
+        self._carry_oids = np.empty((0, 20), dtype=np.uint8)
+        return out
+
+    def build_root(self, odb, leaf_oids_u8_chunks):
+        """Upper spine over the streamed leaves; -> feature-root hex oid.
+        ``leaf_oids_u8_chunks``: (n,20) uint8 arrays, one per emitted
+        payload batch, in emission order."""
+        child_ids = np.concatenate(self.leaf_id_chunks)
+        hexes = b"".join(
+            c.tobytes() for c in leaf_oids_u8_chunks
+        ).hex()
+        child_oids = [hexes[i : i + 40] for i in range(0, len(hexes), 40)]
+        assert len(child_oids) == len(child_ids)
+        return build_upper_levels(odb, child_ids, child_oids, self.encoder)
 
 

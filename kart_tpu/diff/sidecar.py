@@ -481,6 +481,22 @@ class SidecarCapture:
         )
         return pks, oids_u8
 
+    def mark(self):
+        """Checkpoint the capture state (chunk-list lengths + count) so a
+        restarted import stream (the pipelined importer's native-reader
+        fallback) can :meth:`rewind` the partial feed instead of
+        double-counting features."""
+        return (len(self._pk_chunks), len(self._path_chunks),
+                len(self._oid_chunks), self.count)
+
+    def rewind(self, mark):
+        """Drop everything captured since ``mark``."""
+        n_pk, n_path, n_oid, count = mark
+        del self._pk_chunks[n_pk:]
+        del self._path_chunks[n_path:]
+        del self._oid_chunks[n_oid:]
+        self.count = count
+
     def replace_int_columns(self, pks_arr, oids_u8):
         """Overwrite the captured int-pk columns (importer dedup: the
         sidecar must match the committed tree when duplicate source pks

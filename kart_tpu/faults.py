@@ -23,6 +23,8 @@ Registered points:
     odb.bulk_pack           bulk_pack context exit, before the pack finalises
     pack.finalise           PackWriter.finish entry (pack trailer/rename)
     idx.write               write_pack_index entry (idx serialise/rename)
+    import.encode           every producer batch of the pipelined import
+    import.pack_stream      every pack-write batch of the pipelined import
 
 Disabled (``KART_FAULTS`` unset) the fast path is a single environ dict
 lookup with no allocation: frame-boundary loops additionally hoist

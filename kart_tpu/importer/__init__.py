@@ -8,6 +8,7 @@ exposes schema, meta items, CRS definitions and a feature stream.
 
 import csv
 import json
+import logging
 import os
 import sqlite3
 
@@ -16,6 +17,8 @@ from kart_tpu.core.serialise import ensure_text
 from kart_tpu.crs import get_identifier_str
 from kart_tpu.geometry import Geometry, geojson_to_geometry
 from kart_tpu.models.schema import ColumnSchema, Schema
+
+L = logging.getLogger(__name__)
 
 
 class ImportSourceError(ValueError):
@@ -412,10 +415,52 @@ class GPKGImportSource(ImportSource):
             return None
         return self._encoded_batch_gen(schema)
 
-    # column handling kinds for _encoded_batch_gen's inner loop
+    # column handling kinds for batch_row_encoder's inner loop
     _K_PLAIN, _K_GEOM, _K_BOOL, _K_FLOAT, _K_TS = range(5)
 
-    def _encoded_batch_gen(self, schema):
+    def _select_sql(self, schema, where=""):
+        """The raw-row SELECT both the fused generator and the pipeline
+        read stage run: schema column order, streamed in pk order (free for
+        the rowid-aliased int pks this path requires — and the sorted
+        stream feeds the presorted bulk tree build + sidecar directly)."""
+        sel = ", ".join(gpkg_adapter.quote(c.name) for c in schema.columns)
+        pk = gpkg_adapter.quote(schema.pk_columns[0].name)
+        return (
+            f"SELECT {sel} FROM {gpkg_adapter.quote(self.table_name)}"
+            f"{where} ORDER BY {pk}"
+        )
+
+    def raw_row_batches(self, schema, batch_rows=10000):
+        """Stream raw sqlite row-tuple batches (schema column order, pk
+        order) — the pipeline's *read* stage. Opens its own connection so
+        it can run on the reader thread (sqlite3 objects are not shareable
+        across threads). check_same_thread=False only so an *abandoned*
+        generator (aborted pipeline) can still be closed from another
+        thread — all reads stay on the one thread that drives the
+        generator."""
+        con = sqlite3.connect(
+            self.gpkg_path, check_same_thread=False
+        )  # tuple rows: index access
+        try:
+            cursor = con.execute(self._select_sql(schema))
+            cursor.arraysize = batch_rows
+            while True:
+                rows = cursor.fetchmany()
+                if not rows:
+                    break
+                yield rows
+        finally:
+            con.close()
+
+    def batch_row_encoder(self, schema):
+        """-> ``encode(rows) -> (pk_list, blob_list)`` over raw sqlite row
+        tuples in schema column order — the pipeline's *encode* stage, and
+        the encode half of :meth:`encoded_feature_batches`. Blobs are
+        bit-identical to ``schema.encode_feature_blob`` over ``features()``
+        (tested). One reused Packer: NOT thread-safe, one encoder per
+        thread (geometry goes through the single-pass canonicaliser
+        ``geometry.normalise_gpkg_bytes`` straight into ``pack_ext_type`` —
+        no ExtType objects, no value lists, no per-row tuples)."""
         import msgpack
 
         from kart_tpu.core.serialise import GEOMETRY_EXT_CODE
@@ -437,7 +482,6 @@ class GPKGImportSource(ImportSource):
         ]
         n_vals = len(non_pk)
         pk_j = by_id[schema.legend.pk_columns[0]]
-        sel = ", ".join(gpkg_adapter.quote(c.name) for c in cols)
         # autoreset=False: the blob is composed incrementally (array header,
         # hash, values); with the default autoreset every pack() call would
         # flush and clear the buffer mid-record
@@ -449,53 +493,128 @@ class GPKGImportSource(ImportSource):
             self._K_PLAIN, self._K_GEOM, self._K_BOOL, self._K_FLOAT, self._K_TS,
         )
 
+        def encode(rows):
+            pks = []
+            blobs = []
+            for row in rows:
+                packer.pack_array_header(2)
+                packer.pack(legend_hash)
+                packer.pack_array_header(n_vals)
+                for j, kind in non_pk:
+                    v = row[j]
+                    if kind == K_PLAIN or v is None:
+                        packer.pack(v)
+                    elif kind == K_GEOM:
+                        packer.pack_ext_type(
+                            GEOMETRY_EXT_CODE, normalise_gpkg_bytes(v)
+                        )
+                    elif kind == K_FLOAT:
+                        packer.pack(float(v))
+                    elif kind == K_BOOL:
+                        packer.pack(bool(v))
+                    else:
+                        packer.pack(
+                            v.replace(" ", "T") if isinstance(v, str) else v
+                        )
+                pks.append(row[pk_j])
+                blobs.append(packer.bytes())
+                packer.reset()
+            return pks, blobs
+
+        return encode
+
+    def _encoded_batch_gen(self, schema):
         # per-phase accumulators for the import phase breakdown (read by
-        # the serial importer; the bench records them)
+        # the serial importer; the bench records them), mirrored as
+        # importer.read / importer.encode spans for `kart --trace import`
         import time as _time
 
+        from kart_tpu import telemetry as tm
+
+        encode = self.batch_row_encoder(schema)
         phases = self.phase_seconds = {"source_read": 0.0, "encode": 0.0}
-        con = sqlite3.connect(self.gpkg_path)  # tuple rows: index access
-        try:
-            cursor = con.execute(
-                f"SELECT {sel} FROM {gpkg_adapter.quote(self.table_name)}"
-            )
-            cursor.arraysize = 10000
-            while True:
-                t0 = _time.perf_counter()
-                rows = cursor.fetchmany()
-                phases["source_read"] += _time.perf_counter() - t0
-                if not rows:
-                    break
-                t0 = _time.perf_counter()
-                pks = []
-                blobs = []
-                for row in rows:
-                    packer.pack_array_header(2)
-                    packer.pack(legend_hash)
-                    packer.pack_array_header(n_vals)
-                    for j, kind in non_pk:
-                        v = row[j]
-                        if kind == K_PLAIN or v is None:
-                            packer.pack(v)
-                        elif kind == K_GEOM:
-                            packer.pack_ext_type(
-                                GEOMETRY_EXT_CODE, normalise_gpkg_bytes(v)
-                            )
-                        elif kind == K_FLOAT:
-                            packer.pack(float(v))
-                        elif kind == K_BOOL:
-                            packer.pack(bool(v))
-                        else:
-                            packer.pack(
-                                v.replace(" ", "T") if isinstance(v, str) else v
-                            )
-                    pks.append(row[pk_j])
-                    blobs.append(packer.bytes())
-                    packer.reset()
-                phases["encode"] += _time.perf_counter() - t0
-                yield pks, blobs
-        finally:
-            con.close()
+        batches = self.raw_row_batches(schema)
+        while True:
+            t0 = _time.perf_counter()
+            with tm.span("importer.read"):
+                rows = next(batches, None)
+            phases["source_read"] += _time.perf_counter() - t0
+            if rows is None:
+                break
+            t0 = _time.perf_counter()
+            with tm.span("importer.encode"):
+                out = encode(rows)
+            phases["encode"] += _time.perf_counter() - t0
+            yield out
+
+    def native_encoded_batches(self, schema, batch_rows=10000):
+        """The pipeline's native fused read+encode producer: a generator of
+        ``("enc", pks int64, buf uint8, offsets int64)`` batches where blob
+        i is ``buf[offsets[i]:offsets[i+1]]`` — the SELECT is stepped and
+        every row msgpack-encoded inside ONE GIL-free native call per batch
+        (native/kart_io.cpp io_gpkg_*), bit-identical to
+        :meth:`batch_row_encoder` output (property-tested). None when the
+        native IO lib / sqlite3 runtime is unavailable, the table isn't
+        single-int-pk, or ``KART_IMPORT_NATIVE_READ=0`` /
+        ``KART_IMPORT_FAST=0`` disables it.
+
+        Mid-stream rows the native encoder can't reproduce bit-identically
+        (a geometry needing the full re-encode path, an unexpected storage
+        class) raise :class:`~kart_tpu.native.GpkgReaderFallback` out of the
+        generator; the pipelined importer catches it and restarts the whole
+        run through the Python encoder against fresh collector state
+        (already-written blobs dedupe in the pack writer) — tested."""
+        import time as _time
+
+        from kart_tpu import native
+        from kart_tpu import telemetry as tm
+        from kart_tpu.core.serialise import GEOMETRY_EXT_CODE
+
+        if os.environ.get("KART_IMPORT_NATIVE_READ") == "0":
+            return None
+        if os.environ.get("KART_IMPORT_FAST") == "0":
+            return None
+        pk_cols = schema.pk_columns
+        if len(pk_cols) != 1 or pk_cols[0].data_type != "integer":
+            return None
+        kind_of = {
+            "geometry": 1, "boolean": 2, "float": 3, "timestamp": 4,
+        }
+        cols = list(schema.columns)
+        by_id = {c.id: j for j, c in enumerate(cols)}
+        legend = schema.legend
+        val_cols = [by_id[cid] for cid in legend.non_pk_columns]
+        kinds = [kind_of.get(cols[j].data_type, 0) for j in val_cols]
+        pk_col = by_id[legend.pk_columns[0]]
+        import msgpack
+
+        p = msgpack.Packer(use_bin_type=True, autoreset=False)
+        p.pack_array_header(2)
+        p.pack(schema.legend_hash)
+        p.pack_array_header(len(val_cols))
+        prefix = p.bytes()
+        reader = native.open_gpkg_reader(
+            self.gpkg_path, self._select_sql(schema), val_cols, kinds,
+            pk_col, prefix, GEOMETRY_EXT_CODE,
+        )
+        if reader is None:
+            return None
+
+        def gen():
+            phases = self.phase_seconds = {"source_read": 0.0, "encode": 0.0}
+            try:
+                while True:
+                    t0 = _time.perf_counter()
+                    with tm.span("importer.read"):
+                        out = reader.next_batch(batch_rows)
+                    phases["source_read"] += _time.perf_counter() - t0
+                    if out is None:
+                        return
+                    yield ("enc",) + out
+            finally:
+                reader.close()
+
+        return gen()
 
     def get_features(self, pks, ignore_missing=False):
         """Point reads by pk (indexed sqlite lookup, not a table scan)."""

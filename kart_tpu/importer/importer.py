@@ -12,6 +12,7 @@ packs are fsync'd, so a crash mid-import never leaves a dangling ref.
 """
 
 import gc
+import logging
 import time
 
 import numpy as np
@@ -22,6 +23,8 @@ from kart_tpu.core.tree_builder import TreeBuilder
 from kart_tpu.models.dataset import Dataset3
 from kart_tpu.models.paths import encoder_for_schema
 from kart_tpu.utils import chunked, paused_gc
+
+L = logging.getLogger(__name__)
 
 BATCH_SIZE = 10000
 # below this, the tree-walk diff path is so cheap that a sidecar isn't worth
@@ -42,6 +45,14 @@ LAST_IMPORT_PHASES = None
 #: the phase keys the bench's ``import_phase_*`` record reads — stable
 #: across the telemetry refactor
 PHASE_KEYS = ("source_read", "encode", "hash_deflate", "tree_build")
+
+#: per-stage *busy* seconds of the most recent pipelined import —
+#: {"read", "encode", "hash", "pack", "tree", "wall"}. Unlike LAST_IMPORT_PHASES
+#: (a single-threaded span stack whose self-times sum <= total by
+#: construction), these are measured on four concurrent stage threads, so
+#: their sum EXCEEDING wall is the overlap working; the bench records the
+#: ratio. None when the last import ran serial/parallel.
+LAST_IMPORT_PIPELINE = None
 
 
 def _new_phases():
@@ -112,6 +123,8 @@ def import_sources(
     captures = {}
     total = 0
     phases = _new_phases()
+    global LAST_IMPORT_PIPELINE
+    LAST_IMPORT_PIPELINE = None  # set by _run_import_pipeline when taken
     t0 = time.monotonic()
     with tm.span("importer.import_sources", sources=len(sources)), repo.odb.bulk_pack():
         for source in sources:
@@ -311,11 +324,8 @@ def _import_single_source(
     for path, data in meta_blobs:
         tb.insert(path, repo.odb.write_blob(data))
 
-    from kart_tpu.importer.parallel import (
-        default_workers,
-        run_parallel_import,
-        shardable,
-    )
+    from kart_tpu.importer import parallel as par
+    from kart_tpu.importer import pipeline as pipe
 
     prefix = f"{ds_path}/{Dataset3.DATASET_DIRNAME}/{Dataset3.FEATURE_PATH}"
 
@@ -325,13 +335,37 @@ def _import_single_source(
             log=log, existing_ds=existing_ds, capture=capture,
         )
 
-    n_workers = default_workers()
-    if shardable(source, encoder, n_workers):
-        count = run_parallel_import(
+    # --- path routing: pipelined, parallel fan-out, or serial ------------
+    # A native-read-capable source takes the pipeline: its fused
+    # read+encode stage runs GIL-free at >1M rows/s, which beats the
+    # process fan-out's per-worker interpreter encode on any core count we
+    # can measure (teaching the fan-out workers to use the native reader
+    # per shard is the open item). Fan-out remains the big-box path for
+    # python-encoded sources.
+    mode = pipe.pipeline_mode()
+    n_workers = par.default_workers()
+    if n_workers > 1:
+        # satellite fix: never more workers than the import has work for —
+        # a pool member costs a spawned interpreter + full module import
+        n_workers = par.clamp_workers(n_workers, source.feature_count)
+    native_pipe = mode != "off" and pipe.native_read_capable(source, encoder)
+    if (
+        mode != "force"
+        and not native_pipe
+        and n_workers > 1
+        and par.shardable(source, encoder, n_workers)
+    ):
+        count = par.run_parallel_import(
             repo, tb, source, ds_path, encoder, prefix, n_workers,
             log=log, capture=capture,
         )
         return count
+
+    use_pipeline = mode == "force" or (
+        mode == "auto" and source.feature_count >= pipe.PIPELINE_MIN_FEATURES
+    )
+    if use_pipeline and repo.odb._bulk_writer is None:
+        use_pipeline = False  # the pipeline pack stage needs the bulk writer
 
     count = 0
     use_batch_paths = encoder.scheme == "int"
@@ -351,14 +385,26 @@ def _import_single_source(
     # per-feature dicts, no per-row tuples, no hex round trips (see
     # GPKGImportSource.encoded_feature_batches).
     fast_batches = None
-    if use_batch_paths:
+    if use_batch_paths and not use_pipeline:
         fast = getattr(source, "encoded_feature_batches", None)
         if fast is not None:
             fast_batches = fast(schema)
 
+    stream_root = None
     with paused_gc():
         gc_batch = 0
-        if fast_batches is not None:
+        if use_pipeline:
+            count, stream_root = _run_import_pipeline(
+                repo, tb, source, schema, encoder, prefix,
+                capture=capture,
+                collect_local=collect_local,
+                pk_chunks=pk_chunks,
+                oid_chunks=oid_chunks,
+                use_batch_paths=use_batch_paths,
+                log=log,
+                ds_path=ds_path,
+            )
+        elif fast_batches is not None:
             # phase timing: the generator fuses source read + encode; its
             # own phase_seconds split (the GPKG source keeps one) is folded
             # in below — here the generator pull is accounted as encode
@@ -420,7 +466,19 @@ def _import_single_source(
                 if log and count % 100000 == 0:
                     log(f"  {ds_path}: {count} features...")
 
-    if use_batch_paths and count:
+    if use_batch_paths and count and stream_root is not None:
+        # the pipeline already built (and wrote) the feature tree from the
+        # sorted stream — the strictly-increasing pk guarantee it enforces
+        # also rules out duplicate pks, so no last-wins resolution needed
+        from kart_tpu.core.objects import MODE_TREE
+
+        with phases.span("tree_build"):
+            tb.insert(
+                f"{ds_path}/{Dataset3.DATASET_DIRNAME}/feature",
+                stream_root,
+                mode=MODE_TREE,
+            )
+    elif use_batch_paths and count:
         from kart_tpu.core.feature_tree import build_int_feature_tree
         from kart_tpu.core.objects import MODE_TREE
 
@@ -471,3 +529,284 @@ def _import_single_source(
     if log:
         log(f"  {ds_path}: {count} features")
     return count
+
+
+def _run_import_pipeline(
+    repo, tb, source, schema, encoder, prefix, *,
+    capture, collect_local, pk_chunks, oid_chunks, use_batch_paths,
+    log, ds_path,
+):
+    """Stream one source through the bounded 4-stage pipeline
+    (:mod:`kart_tpu.importer.pipeline`): fused read+encode (ONE native
+    call per batch for GPKG int-pk sources — io_gpkg_*) || native
+    hash+deflate || pack write, with (pk, oid) columns collected on this
+    thread in stream order. The sorted pk stream also drives the leaf-tree
+    build *during* the stream: completed leaves are serialised here
+    (:class:`~kart_tpu.core.feature_tree.StreamingLeafEmitter`) and
+    injected through the hash/pack stages on the pipeline's side channel,
+    so the Merkle build that used to run as a serial tail overlaps the
+    feature stream. Byte-identical to the serial path (same blobs, same
+    leaf payloads, same root oid — property tested); stage busy seconds
+    land in :data:`LAST_IMPORT_PIPELINE`.
+    -> (feature count, stream-built feature-root hex oid or None)."""
+    import time as _time
+
+    from kart_tpu import native
+    from kart_tpu.core.feature_tree import StreamingLeafEmitter
+    from kart_tpu.core.packs import TYPE_CODES
+    from kart_tpu.importer.pipeline import run_pipeline
+
+    writer = repo.odb._bulk_writer
+    level = writer.level
+    blob_code = TYPE_CODES["blob"]
+    tree_code = TYPE_CODES["tree"]
+
+    # --- fused read+encode producer ---------------------------------------
+    # Read and encode share one thread on purpose. For native-capable GPKG
+    # sources both run inside one GIL-free ctypes call per batch
+    # (native_encoded_batches). The Python producers fuse them too: both
+    # are GIL-bound, so a thread split buys no parallelism and costs a GIL
+    # ping-pong per batch (see kart_tpu/importer/pipeline.py); the split is
+    # preserved in *accounting* via phase_seconds and read/encode spans.
+    def _make_producer(allow_native):
+        if use_batch_paths and allow_native:
+            nat = getattr(source, "native_encoded_batches", None)
+            if nat is not None:
+                from kart_tpu.importer.pipeline import batch_rows
+
+                # None when native read is unavailable
+                producer = nat(schema, batch_rows=batch_rows())
+                if producer is not None:
+                    return producer
+        fast = getattr(source, "encoded_feature_batches", None)
+        fb = fast(schema) if (use_batch_paths and fast is not None) else None
+        if fb is not None:
+            return (("py",) + tuple(item) for item in fb)
+        # generic sources: stream features, encode through the compiled
+        # per-legend blob serialiser (models/dataset.py)
+        from kart_tpu.models.dataset import compiled_blob_encoder
+
+        blob_enc = compiled_blob_encoder(schema)
+        enc_path = encoder.encode_pks_to_path
+
+        def _generic_producer():
+            for batch in chunked(source.features(), BATCH_SIZE):
+                with tm.span("importer.encode", rows=len(batch)):
+                    keys = []
+                    blobs = []
+                    for feature in batch:
+                        pk_values, blob = blob_enc(feature)
+                        keys.append(
+                            pk_values[0] if use_batch_paths
+                            else enc_path(pk_values)
+                        )
+                        blobs.append(blob)
+                yield ("py", keys, blobs)
+
+        return _generic_producer()
+
+    # --- streamed leaf-tree build -----------------------------------------
+    # only engaged when the native IO core can hash/deflate the injected
+    # payload batches; without it the end-of-stream build is just as fast
+    # as a Python side channel would be
+    leaf_stream = None
+    if use_batch_paths and native.load_io() is not None:
+        leaf_stream = StreamingLeafEmitter(encoder)
+        if not leaf_stream.ok:
+            leaf_stream = None
+
+    # --- hash + pack stage functions --------------------------------------
+    def hash_fn(item):
+        tag = item[0]
+        if tag == "enc":
+            _, pks, buf, offs = item
+            framed = native.pack_records_base("blob", blob_code, buf, offs, level)
+            if framed is not None:
+                return ("bf", pks, framed)
+            # native lib lost mid-run (never in practice): slice + retry
+            blobs = [
+                buf[offs[i] : offs[i + 1]].tobytes() for i in range(len(pks))
+            ]
+            return (
+                "pyf", pks, blobs,
+                native.pack_records_batch("blob", blob_code, blobs, level),
+            )
+        if tag == "py":
+            _, keys, blobs = item
+            return (
+                "pyf", keys, blobs,
+                native.pack_records_batch("blob", blob_code, blobs, level),
+            )
+        # "tree": an injected leaf-payload batch from the side channel
+        _, buf, offs, leaf_ids = item
+        framed = native.pack_records_base("tree", tree_code, buf, offs, level)
+        return ("tf", leaf_ids, framed, buf, offs)
+
+    def pack_fn(item):
+        tag = item[0]
+        if tag == "bf":
+            _, pks, framed = item
+            return ("f", pks, writer.append_framed(framed))
+        if tag == "pyf":
+            _, keys, blobs, framed = item
+            if framed is not None:
+                return ("f", keys, writer.append_framed(framed))
+            hexes = [writer.add("blob", b) for b in blobs]
+            return (
+                "f", keys,
+                np.frombuffer(
+                    bytes.fromhex("".join(hexes)), dtype=np.uint8
+                ).reshape(-1, 20),
+            )
+        # "tf"
+        _, leaf_ids, framed, buf, offs = item
+        if framed is not None:
+            return ("t", leaf_ids, writer.append_framed(framed))
+        payloads = [
+            buf[offs[i] : offs[i + 1]].tobytes()
+            for i in range(len(leaf_ids))
+        ]
+        hexes = writer.add_batch("tree", payloads)
+        return (
+            "t", leaf_ids,
+            np.frombuffer(
+                bytes.fromhex("".join(hexes)), dtype=np.uint8
+            ).reshape(-1, 20),
+        )
+
+    # --- main-thread collector --------------------------------------------
+    count = 0
+    gc_batch = 0
+    tree_oid_chunks = []  # (n, 20) leaf oids, in leaf emission order
+    tree_busy = 0.0
+
+    def consume(item, inject=None):
+        nonlocal count, gc_batch, tree_busy
+        if item[0] == "t":
+            tree_oid_chunks.append(item[2])
+            return
+        _, keys, oids_u8 = item
+        gc_batch += 1
+        if gc_batch % 100 == 0:
+            gc.collect()  # bound any source-adapter cycles (gc is paused)
+        if use_batch_paths:
+            pks = (
+                keys if isinstance(keys, np.ndarray)
+                else np.asarray(keys, dtype=np.int64)
+            )
+            if collect_local:
+                pk_chunks.append(pks)
+                oid_chunks.append(oids_u8.tobytes())
+            if capture is not None:
+                capture.add_int_raw(pks, oids_u8.tobytes())
+            if leaf_stream is not None and leaf_stream.ok and inject is not None:
+                t0 = _time.perf_counter()
+                with tm.span("importer.tree"):
+                    out = leaf_stream.feed(pks, oids_u8)
+                tree_busy += _time.perf_counter() - t0
+                if out is not None:
+                    buf, offs, leaf_ids = out
+                    inject(("tree", buf, offs, leaf_ids))
+        else:
+            hexes = oids_u8.tobytes().hex()
+            oid_list = [hexes[i : i + 40] for i in range(0, len(hexes), 40)]
+            tb.insert_many((prefix + rel for rel in keys), oid_list)
+            if capture is not None:
+                capture.add_path_batch(keys, oid_list)
+        count += len(keys)
+        if log and count % 100000 < len(keys):
+            log(f"  {ds_path}: {count} features...")
+
+    def on_feat_done(inject):
+        nonlocal tree_busy
+        if leaf_stream is not None and leaf_stream.ok:
+            t0 = _time.perf_counter()
+            out = leaf_stream.finish()
+            tree_busy += _time.perf_counter() - t0
+            if out is not None:
+                buf, offs, leaf_ids = out
+                inject(("tree", buf, offs, leaf_ids))
+
+    # --- drive the pipeline (one native-reader fallback retry) ------------
+    # A row the native fused reader can't reproduce bit-identically raises
+    # GpkgReaderFallback out of the producer mid-stream: reset every
+    # collector the partial run touched and re-stream through the Python
+    # encoder. Blobs already appended dedupe in the pack writer, so the
+    # restart costs only the re-read, never a corrupt repo.
+    cap_mark = capture.mark() if capture is not None else None
+    base_pk_chunks = len(pk_chunks)
+    base_oid_chunks = len(oid_chunks)
+
+    def _reset_collectors():
+        nonlocal count, gc_batch, tree_busy, leaf_stream
+        count = 0
+        gc_batch = 0
+        tree_busy = 0.0
+        tree_oid_chunks.clear()
+        del pk_chunks[base_pk_chunks:]
+        del oid_chunks[base_oid_chunks:]
+        if capture is not None:
+            capture.rewind(cap_mark)
+        if leaf_stream is not None:
+            # leaves already emitted reference the abandoned stream: start a
+            # fresh emitter (stale leaf objects in the pack are benign)
+            leaf_stream = StreamingLeafEmitter(encoder)
+            if not leaf_stream.ok:
+                leaf_stream = None
+
+    t0 = _time.perf_counter()
+    with tm.span("importer.pipeline", source=type(source).__name__):
+        allow_native = True
+        while True:
+            producer = _make_producer(allow_native)
+            try:
+                stage_s = run_pipeline(
+                    producer,
+                    [("hash", hash_fn), ("pack", pack_fn)],
+                    consume,
+                    producer_span=False,
+                    side_stage="hash" if leaf_stream is not None else None,
+                    on_feat_done=(
+                        on_feat_done if leaf_stream is not None else None
+                    ),
+                )
+                break
+            except native.GpkgReaderFallback:
+                if not allow_native:
+                    raise  # the Python encoder never raises this
+                allow_native = False
+                L.warning(
+                    "native GPKG reader met a row it cannot reproduce "
+                    "bit-identically; restarting import stream through "
+                    "the Python encoder"
+                )
+                _reset_collectors()
+    wall = _time.perf_counter() - t0
+
+    # the stream-built feature tree: every leaf the emitter serialised came
+    # back hashed; the upper spine is built here (cheap — branches^-1 of
+    # the leaf count) now the stage threads have quiesced
+    stream_root = None
+    if leaf_stream is not None and leaf_stream.ok and count:
+        n_leaves = sum(len(c) for c in leaf_stream.leaf_id_chunks)
+        n_hashed = sum(len(c) for c in tree_oid_chunks)
+        assert n_leaves == n_hashed, (n_leaves, n_hashed)
+        with tm.span("importer.tree"):
+            stream_root = leaf_stream.build_root(repo.odb, tree_oid_chunks)
+
+    # split the fused producer's busy time back into read/encode when the
+    # source kept its own phase accounting (the fast GPKG generators do)
+    produce_s = stage_s.get("produce", 0.0)
+    src_phases = getattr(source, "phase_seconds", None) or {}
+    read_s = min(src_phases.get("source_read", 0.0), produce_s)
+    global LAST_IMPORT_PIPELINE
+    LAST_IMPORT_PIPELINE = {
+        "read": read_s,
+        "encode": produce_s - read_s,
+        "hash": stage_s.get("hash", 0.0),
+        "pack": stage_s.get("pack", 0.0),
+        "tree": tree_busy,
+        "wall": wall,
+    }
+    tm.incr("importer.pipeline_batches", gc_batch)
+    return count, stream_root

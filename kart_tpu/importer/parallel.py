@@ -4,27 +4,26 @@ The reference fans features out over N ``git fast-import`` subprocesses,
 sharded by feature subtree, then merges the N temp-branch trees. The same
 shape here, without the subprocess protocol: N worker processes each
 
-1. read their own shard of the source table directly (no pickled feature
-   stream through the parent — the parent's read loop was the serial
-   bottleneck),
-2. encode + compress their features and build their *complete leaf trees*,
-3. write everything into their own packfile (concurrency-safe: pack names
-   are content hashes, tmp files are mkstemp'd),
+1. read their own **contiguous pk range** of the source table (an indexed
+   ``BETWEEN`` scan — the old modulus predicate forced every worker through
+   a full table scan, O(rows x workers) read work for the table),
+2. encode their rows through the reused-Packer batch encoder
+   (``GPKGImportSource.batch_row_encoder`` — the same encode stage the
+   serial/pipelined paths run, not the per-row dict path),
+3. hash+deflate+frame each batch in one native call into their own
+   packfile (concurrency-safe: pack names are content hashes, tmp files
+   are mkstemp'd), and build their complete leaf trees vectorized
+   (``feature_tree.emit_leaf_trees``),
 
 and return ``[(leaf_tree_path, tree_oid)]``. The parent stitches the leaf
 trees into the dataset tree with the ordinary TreeBuilder — the join is one
 tree-spine rewrite, exactly the reference's temp-branch merge.
 
-Sharding key: the feature's *leaf tree index* ``(pk // branches) % max_trees``
-(kart_tpu/models/paths.py) — every feature of a leaf tree lands on the same
-worker, so each leaf tree is built whole. This is only computable in SQL for
-int-pk GPKG sources, which is also the only case where worker-side reads are
-possible; other sources use the serial path.
-
-Leaf trees are flushed streamingly (rows arrive ORDER BY pk, so leaf groups
-are contiguous). pk spans wider than branches**(levels+1) could wrap the
-modulus and revisit a leaf; callers must pre-check `shardable()` which
-verifies the span.
+Shard boundaries are count-balanced pk quantiles aligned DOWN to a
+``branches`` multiple, so every leaf tree ``(pk // branches) % max_trees``
+lands whole on one worker. pk spans wider than ``branches**(levels+1)``
+could alias two pk buckets onto one leaf index; ``shardable()`` verifies
+the span (and rejects negative pks — the serial path handles those fine).
 """
 
 import multiprocessing
@@ -32,21 +31,36 @@ import os
 import sqlite3
 from concurrent.futures import ProcessPoolExecutor
 
-from kart_tpu.core.objects import MODE_BLOB, MODE_TREE, TreeEntry, serialise_tree
-from kart_tpu.core.packs import PackWriter
-from kart_tpu.models.paths import PathEncoder
+from kart_tpu.core.objects import MODE_TREE
 
 MIN_FEATURES_FOR_PARALLEL = 20_000
 
 
 def default_workers():
+    """Worker count: ``KART_IMPORT_WORKERS`` when set, else the core count
+    — but only when there are enough real cores for process fan-out to beat
+    the in-process pipeline (a spawned worker pays an interpreter start +
+    full module import). ``os.cpu_count()`` returning None (containers,
+    exotic platforms) or a 1-2 core box both mean: stay in-process."""
     env = os.environ.get("KART_IMPORT_WORKERS")
     if env:
         try:
             return max(1, int(env))
         except ValueError:
             pass
-    return os.cpu_count() or 1
+    cores = os.cpu_count()
+    if cores is None or cores < 4:
+        return 1
+    return cores
+
+
+def clamp_workers(n_workers, feature_count):
+    """Never more workers than the import has work for: tiny imports must
+    not pay pool startup for near-empty shards. One worker per
+    MIN_FEATURES_FOR_PARALLEL features, floor 1."""
+    if feature_count <= 0:
+        return 1
+    return max(1, min(n_workers, feature_count // MIN_FEATURES_FOR_PARALLEL))
 
 
 def shardable(source, encoder, n_workers):
@@ -62,8 +76,6 @@ def shardable(source, encoder, n_workers):
     pk_cols = [c for c in source.schema.columns if c.pk_index is not None]
     if len(pk_cols) != 1:
         return False
-    # modulus wrap check: a pk span wider than branches**(levels+1) can
-    # revisit a leaf tree non-contiguously, breaking streaming flushes
     con = sqlite3.connect(source.gpkg_path)
     try:
         from kart_tpu.adapters.gpkg import quote
@@ -75,12 +87,56 @@ def shardable(source, encoder, n_workers):
     finally:
         con.close()
     if lo is None or lo < 0:
-        # negative pks: SQLite's '/' truncates toward zero and '%' keeps the
-        # dividend's sign, so the SQL shard predicate would disagree with
-        # PathEncoder's floor-division leaf index — silently dropping or
-        # double-assigning features. Serial path handles them fine.
+        # negative pks are a rarity the serial path handles fine; keeping
+        # them off the sharded path keeps the boundary arithmetic trivial
         return False
+    # alias check: a pk span wider than branches**(levels+1) can map two
+    # distinct pk buckets onto one leaf-tree index via the modulus — two
+    # shards would then both "own" that leaf and one would win the stitch
     return (hi - lo) < encoder.branches ** (encoder.levels + 1)
+
+
+def _shard_bounds(source, pk_name, branches, n_shards):
+    """Count-balanced shard boundaries: pk quantiles from the pk index,
+    aligned down to a ``branches`` multiple so leaf trees stay whole.
+    -> sorted unique interior boundaries (possibly fewer than requested
+    when the table is skewed into few distinct buckets)."""
+    from kart_tpu.adapters.gpkg import quote
+
+    con = sqlite3.connect(source.gpkg_path)
+    try:
+        q_pk = quote(pk_name)
+        q_table = quote(source.table_name)
+        (total,) = con.execute(f"SELECT COUNT(*) FROM {q_table}").fetchone()
+        step = total // n_shards
+        if step == 0:
+            return []
+        bounds = set()
+        # each quantile steps OFFSET from the PREVIOUS boundary, not from
+        # row 0 — one O(total) pass over the pk index across all queries
+        # instead of the O(total x n_shards) rank-from-zero walk (the same
+        # asymptotic trap as the old modulus sharding, just on the index)
+        prev = None
+        for _ in range(1, n_shards):
+            if prev is None:
+                row = con.execute(
+                    f"SELECT {q_pk} FROM {q_table} ORDER BY {q_pk} "
+                    f"LIMIT 1 OFFSET ?",
+                    (step,),
+                ).fetchone()
+            else:
+                row = con.execute(
+                    f"SELECT {q_pk} FROM {q_table} WHERE {q_pk} >= ? "
+                    f"ORDER BY {q_pk} LIMIT 1 OFFSET ?",
+                    (prev, step),
+                ).fetchone()
+            if row is None:
+                break
+            prev = row[0]
+            bounds.add(prev - prev % branches)
+    finally:
+        con.close()
+    return sorted(bounds)
 
 
 def run_parallel_import(
@@ -91,6 +147,9 @@ def run_parallel_import(
     ``shardable()`` validated. ``capture`` (SidecarCapture) receives each
     worker's (pk, oid) arrays for the columnar sidecar. -> feature count."""
     schema_dicts = source.schema.to_column_dicts()
+    (pk_col,) = [c for c in source.schema.columns if c.pk_index is not None]
+    bounds = _shard_bounds(source, pk_col.name, encoder.branches, n_workers)
+    edges = [None, *bounds, None]  # [lo, hi) per shard; None = open end
 
     args = [
         (
@@ -99,16 +158,16 @@ def run_parallel_import(
             source.table_name,
             schema_dicts,
             encoder.to_dict(),
-            shard,
-            n_workers,
+            edges[i],
+            edges[i + 1],
         )
-        for shard in range(n_workers)
+        for i in range(len(edges) - 1)
     ]
     total = 0
     # spawn, not fork: the parent may have initialised a (multithreaded)
     # jax backend, and forking a threaded process can deadlock the workers
     ctx = multiprocessing.get_context("spawn")
-    with ProcessPoolExecutor(max_workers=n_workers, mp_context=ctx) as pool:
+    with ProcessPoolExecutor(max_workers=len(args), mp_context=ctx) as pool:
         for count, leaf_entries, pks, oid_bytes in pool.map(_import_shard, args):
             total += count
             for leaf_path, tree_oid in leaf_entries:
@@ -117,68 +176,61 @@ def run_parallel_import(
                 capture.add_int_raw(pks, oid_bytes)
     repo.odb.packs.refresh()
     if log:
-        log(f"  {ds_path}: {total} features over {n_workers} workers")
+        log(f"  {ds_path}: {total} features over {len(args)} workers")
     return total
 
 
 def _import_shard(packed_args):
-    """Worker: read one shard of the table, build its leaf trees, write one
-    pack. -> (count, [(leaf_tree_path, tree_oid)])."""
+    """Worker: read one contiguous pk range of the table, batch-encode it,
+    write one pack of feature blobs + vectorized leaf trees.
+    -> (count, [(leaf_tree_path, tree_oid)], pks int64 array, oid bytes)."""
     (
         objects_dir,
         gpkg_path,
         table_name,
         schema_dicts,
         encoder_dict,
-        shard,
-        n_shards,
+        lo,
+        hi,
     ) = packed_args
 
-    from kart_tpu.adapters import gpkg as gpkg_adapter
+    import numpy as np
+
+    from kart_tpu.adapters.gpkg import quote
+    from kart_tpu.core.feature_tree import emit_leaf_trees, plan_int_feature_tree
+    from kart_tpu.core.packs import PackWriter
+    from kart_tpu.importer import GPKGImportSource
+    from kart_tpu.models.paths import PathEncoder
     from kart_tpu.models.schema import Schema
+    from kart_tpu.utils import paused_gc
 
     schema = Schema.from_column_dicts(schema_dicts)
     encoder = PathEncoder.get(**encoder_dict)
     (pk_col,) = [c for c in schema.columns if c.pk_index is not None]
-    branches = encoder.branches
-    max_trees = encoder.max_trees
 
-    con = sqlite3.connect(gpkg_path)
-    con.row_factory = sqlite3.Row
-    q = gpkg_adapter.quote
-    pk = q(pk_col.name)
-    sql = (
-        f"SELECT * FROM {q(table_name)} "
-        f"WHERE (({pk} / {branches}) % {max_trees}) % {n_shards} = ? "
-        f"ORDER BY {pk}"
-    )
+    src = GPKGImportSource(gpkg_path, table_name)
+    encode = src.batch_row_encoder(schema)
+    where = []
+    params = []
+    if lo is not None:
+        where.append(f"{quote(pk_col.name)} >= ?")
+        params.append(lo)
+    if hi is not None:
+        where.append(f"{quote(pk_col.name)} < ?")
+        params.append(hi)
+    where_sql = (" WHERE " + " AND ".join(where)) if where else ""
+    sql = src._select_sql(schema, where=where_sql)
 
     count = 0
-    leaf_entries = []
     pks_out = []
-    oids_out = bytearray()
-    current_leaf = None  # tree path string
-    current_entries = []
+    oid_parts = []
 
+    con = sqlite3.connect(gpkg_path)  # tuple rows: index access
     try:
         with PackWriter(os.path.join(objects_dir, "pack")) as writer:
-
-            def flush_leaf():
-                nonlocal current_leaf, current_entries
-                if current_leaf is None:
-                    return
-                tree_oid = writer.add(
-                    "tree", serialise_tree(current_entries)
-                )
-                leaf_entries.append((current_leaf, tree_oid))
-                current_entries = []
-                current_leaf = None
-
-            cursor = con.execute(sql, (shard,))
+            cursor = con.execute(sql, params)
             cursor.arraysize = 10000
             import gc as _gc
-
-            from kart_tpu.utils import paused_gc
 
             n_batches = 0
             with paused_gc():
@@ -189,37 +241,32 @@ def _import_shard(packed_args):
                     n_batches += 1
                     if n_batches % 100 == 0:
                         _gc.collect()  # bound any adapter-created cycles
-                    # encode the whole fetch batch, then hash+deflate it in one
-                    # native call (PackWriter.add_batch); the leaf grouping walk
-                    # below runs over precomputed oids
-                    encoded = []
-                    for row in rows:
-                        feature = {
-                            col.name: gpkg_adapter.value_to_v2(row[col.name], col)
-                            for col in schema.columns
-                        }
-                        pk_values, blob = schema.encode_feature_blob(feature)
-                        full = encoder.encode_pks_to_path(pk_values)
-                        leaf_path, _, filename = full.rpartition("/")
-                        encoded.append((pk_values, blob, leaf_path, filename))
-                    blob_oids = writer.add_batch(
-                        "blob", [blob for _, blob, _, _ in encoded]
-                    )
-                    for (pk_values, _, leaf_path, filename), blob_oid in zip(
-                        encoded, blob_oids
-                    ):
-                        if leaf_path != current_leaf:
-                            flush_leaf()
-                            current_leaf = leaf_path
-                        current_entries.append(
-                            TreeEntry(filename, MODE_BLOB, blob_oid)
-                        )
-                        pks_out.append(pk_values[0])
-                        oids_out += bytes.fromhex(blob_oid)
-                        count += 1
-                flush_leaf()
+                    # encode the whole fetch batch, then hash+deflate it in
+                    # one native call; oids stay columnar end-to-end
+                    pks, blobs = encode(rows)
+                    oids_u8 = writer.add_batch_raw("blob", blobs)
+                    if oids_u8 is None:  # native IO core unavailable
+                        hexes = [writer.add("blob", b) for b in blobs]
+                        oids_u8 = np.frombuffer(
+                            bytes.fromhex("".join(hexes)), dtype=np.uint8
+                        ).reshape(-1, 20)
+                    pks_out.append(np.asarray(pks, dtype=np.int64))
+                    oid_parts.append(oids_u8.tobytes())
+                    count += len(pks)
+            if count:
+                pks_arr = np.concatenate(pks_out)
+                oids_arr = np.frombuffer(
+                    b"".join(oid_parts), dtype=np.uint8
+                ).reshape(-1, 20)
+                plan = plan_int_feature_tree(pks_arr, encoder)
+                leaf_entries = emit_leaf_trees(writer, plan, oids_arr, pks_arr)
+            else:
+                pks_arr = np.zeros(0, dtype=np.int64)
+                oids_arr = np.zeros((0, 20), dtype=np.uint8)
+                leaf_entries = []
     finally:
         con.close()
-    import numpy as np
 
-    return count, leaf_entries, np.asarray(pks_out, dtype=np.int64), bytes(oids_out)
+    return count, leaf_entries, pks_arr, (
+        oids_arr.tobytes() if count else b""
+    )

@@ -1,0 +1,363 @@
+"""Bounded multi-stage pipelined import (the `kart import` hot path).
+
+The serial importer ran source read -> columnar batch encode -> native bulk
+SHA-1 + deflate -> pack write strictly in sequence, so the import wall-clock
+was the *sum* of four stages on one core while the box idled. Here the
+stages overlap on threads: the sqlite3/file readers and the native
+hash+deflate (ctypes) calls all release the GIL, so the pure-Python encode
+stage runs concurrently with both neighbours even on CPython — wall-clock
+approaches the *slowest* stage instead of the sum (cf. 3DPipe's pipelined
+spatial-join stages, arxiv 2604.19982).
+
+Stage graph — one thread per stage, order-preserving bounded FIFO queues
+(``KART_IMPORT_QUEUE_BATCHES`` batches each, so a fast reader can never
+balloon memory past queue x batch size):
+
+    [read+encode] --q--> [hash] --q--> [pack] --q--> main
+
+* read+encode  pulls source batches (sqlite fetchmany / feature generator)
+               and runs the compiled msgpack serialiser (one reused
+               Packer, owned by this thread — the serialisers are not
+               thread-safe by design). Read and encode are *fused onto one
+               thread deliberately*: both are GIL-bound Python, so
+               splitting them buys no parallelism and costs a GIL
+               ping-pong per batch (measured: a split read thread's
+               fetchmany stalled ~4x behind the encode thread's loop).
+               They remain separately *accounted* — the stage's internal
+               ``importer.read``/``importer.encode`` spans and phase split
+               survive the fusion.
+* hash         one native call per batch: SHA-1 + deflate + pack-record
+               framing (``native.pack_records_batch``; the ctypes call
+               releases the GIL for the duration, so this genuinely
+               overlaps the encode thread)
+* pack         appends the framed buffer to the streamed bulk pack and
+               books the idx entries (``PackWriter.append_framed``) — the
+               only thread touching writer state while the stream runs
+* main         collects (pk, oid) columns in stream order for the sorted
+               bulk tree build and the columnar sidecar
+
+Equivalence: stages are deterministic and queues preserve order, so the
+pipelined path produces byte-identical objects — and the identical root
+tree oid — to the serial path (property-tested in
+tests/test_pipeline_import.py).
+
+Failure semantics: the first stage error (including an injected
+``KART_FAULTS`` fault) sets the shared stop flag, drains every thread, and
+re-raises on the caller's thread — the enclosing ``odb.bulk_pack`` aborts,
+leaving only sweepable ``.tmp-pack-*`` debris and an untouched HEAD (the
+tests/test_faults.py kill matrix). Fault points: ``import.encode`` fires
+per encode batch, ``import.pack_stream`` per pack-write batch.
+
+Telemetry: each batch runs under a span on its stage thread
+(``importer.read`` / ``importer.encode`` / ``importer.hash`` /
+``importer.pack``), so ``kart --trace import`` shows the overlap as
+parallel lanes; per-stage busy seconds come back to the caller for the
+bench's pipeline record (``LAST_IMPORT_PIPELINE``).
+"""
+
+import os
+import queue
+import threading
+import time
+
+from kart_tpu import faults
+from kart_tpu import telemetry as tm
+
+#: below this many features, thread startup + queue hops outweigh overlap
+PIPELINE_MIN_FEATURES = 16384
+
+_DEFAULT_QUEUE_BATCHES = 4
+
+_DONE = object()
+#: end of the *feature* stream only — used when a side channel is open:
+#: the first stage keeps serving side items until _DONE arrives there
+_FEAT_DONE = object()
+
+
+def pipeline_mode():
+    """``KART_IMPORT_PIPELINE``: unset/``auto`` -> heuristic, ``0`` ->
+    never, ``1``/``force`` -> always (tiny imports too; used by the
+    equivalence tests)."""
+    raw = (os.environ.get("KART_IMPORT_PIPELINE") or "").strip().lower()
+    if raw in ("0", "off", "no"):
+        return "off"
+    if raw in ("1", "force", "always"):
+        return "force"
+    return "auto"
+
+
+def queue_batches():
+    """Bound (in batches) of each inter-stage queue."""
+    raw = os.environ.get("KART_IMPORT_QUEUE_BATCHES")
+    if raw:
+        try:
+            return max(1, int(raw))
+        except ValueError:
+            pass
+    return _DEFAULT_QUEUE_BATCHES
+
+
+#: rows per producer batch. Larger batches amortise the per-batch Python
+#: (queue hops, spans, the leaf-tree plan's fixed cost, the pack writer's
+#: dedupe probe) that serialises on the GIL against the stage threads;
+#: smaller batches bound memory (peak ~ batch bytes x queue depth x
+#: stages). 64k rows x ~150B ~ 10MB a batch — measured ~15% whole-import
+#: win over 10k-row batches at 1M scale, still <150MB bounded.
+_DEFAULT_BATCH_ROWS = 65536
+
+
+def batch_rows():
+    """Rows per pipeline producer batch (``KART_IMPORT_BATCH_ROWS``)."""
+    raw = os.environ.get("KART_IMPORT_BATCH_ROWS")
+    if raw:
+        try:
+            return max(1024, int(raw))
+        except ValueError:
+            pass
+    return _DEFAULT_BATCH_ROWS
+
+
+def native_read_capable(source, encoder):
+    """True when ``source`` can feed the pipeline's GIL-free native fused
+    read+encode stage (io_gpkg_*): single-int-pk table, a source that
+    implements ``native_encoded_batches``, the native IO core loadable, and
+    neither ``KART_IMPORT_NATIVE_READ=0`` nor ``KART_IMPORT_FAST=0`` set.
+    The import router prefers the pipeline over the process fan-out for
+    such sources — one native reader outruns per-worker interpreter
+    encoding on any core count we can measure."""
+    if encoder.scheme != "int":
+        return False
+    if getattr(source, "native_encoded_batches", None) is None:
+        return False
+    if os.environ.get("KART_IMPORT_NATIVE_READ") == "0":
+        return False
+    if os.environ.get("KART_IMPORT_FAST") == "0":
+        return False
+    from kart_tpu import native
+
+    return native.load_io() is not None
+
+
+class _PipelineState:
+    """Shared stop flag + first-error slot for all stage threads."""
+
+    def __init__(self):
+        self.stop = threading.Event()
+        self._err_lock = threading.Lock()
+        self.error = None
+
+    def fail(self, exc):
+        with self._err_lock:
+            if self.error is None:
+                self.error = exc
+        self.stop.set()
+
+
+def _put(q, item, state):
+    """Bounded put that never deadlocks a dying pipeline."""
+    while not state.stop.is_set():
+        try:
+            q.put(item, timeout=0.05)
+            return True
+        except queue.Full:
+            continue
+    return False
+
+
+def _get(q, state):
+    """-> next item, or _DONE when the pipeline is stopping."""
+    while not state.stop.is_set():
+        try:
+            return q.get(timeout=0.05)
+        except queue.Empty:
+            continue
+    return _DONE
+
+
+class _Stage(threading.Thread):
+    """One pipeline stage: apply ``fn`` to every upstream item in order.
+    The read stage (``source`` instead of ``in_q``) drains an iterator,
+    booking each pull as busy time. Writes only thread-local state; the
+    shared ``_PipelineState`` is lock-guarded and the queues are
+    thread-safe by construction."""
+
+    def __init__(
+        self, name, state, fn=None, source=None, in_q=None, out_q=None,
+        span=True, side_q=None, end=_DONE,
+    ):
+        super().__init__(name=f"kart-import-{name}", daemon=True)
+        self.stage_name = name
+        self.state = state
+        self.fn = fn
+        self.source = source
+        self.in_q = in_q
+        self.out_q = out_q
+        # unbounded injection channel (tree-payload batches from the
+        # consuming thread); unbounded on purpose — a bounded put from the
+        # consumer would close a queue cycle and deadlock the pipeline
+        self.side_q = side_q
+        self.end = end  # sentinel the producer emits at source exhaustion
+        self.busy_s = 0.0
+        # span=False when the source generator emits its own finer-grained
+        # spans (the fused read+encode producer) — avoids nested double spans
+        self.span_name = f"importer.{name}" if span else None
+        self.fault_hook = None
+
+    def _timed(self, thunk):
+        t0 = time.perf_counter()
+        if self.span_name is not None:
+            with tm.span(self.span_name):
+                out = thunk()
+        else:
+            out = thunk()
+        self.busy_s += time.perf_counter() - t0
+        return out
+
+    def _run_read(self):
+        """Producer stage: each ``next()`` on the source iterator is the
+        work (for the fused read+encode producer that includes both)."""
+        state = self.state
+        it = iter(self.source)
+        fault = self.fault_hook
+        try:
+            while not state.stop.is_set():
+                try:
+                    item = self._timed(lambda: next(it))
+                except StopIteration:
+                    break
+                if fault is not None:
+                    fault()
+                if not _put(self.out_q, item, state):
+                    return
+            _put(self.out_q, self.end, state)
+        finally:
+            # an aborted pipeline abandons the producer mid-stream: run its
+            # cleanup (source connections etc.) here, on the thread that
+            # drove it, not at GC time on whichever thread collects it
+            close = getattr(it, "close", None)
+            if close is not None:
+                close()
+
+    def _run_apply(self):
+        state = self.state
+        fault = self.fault_hook
+        feat_done = False
+        while True:
+            item = None
+            if self.side_q is not None and not feat_done:
+                # injected work is served ahead of queued feature batches
+                # (their results unblock the consumer that injected them)
+                try:
+                    item = self.side_q.get_nowait()
+                except queue.Empty:
+                    item = None
+            if item is None:
+                item = _get(self.side_q if feat_done else self.in_q, state)
+            if item is _DONE:
+                break
+            if item is _FEAT_DONE:
+                # the feature stream ended but the consumer may still
+                # inject trailing side batches: forward the marker (the
+                # driver answers with _DONE on the side channel) and keep
+                # serving side items until it arrives
+                if not _put(self.out_q, _FEAT_DONE, state):
+                    return
+                if self.side_q is not None:
+                    feat_done = True
+                continue
+            if fault is not None:
+                fault()
+            out = self._timed(lambda: self.fn(item))
+            if not _put(self.out_q, out, state):
+                return
+        _put(self.out_q, _DONE, state)
+
+    def run(self):
+        try:
+            if self.in_q is None:
+                self._run_read()
+            else:
+                self._run_apply()
+        except BaseException as exc:  # kart: noqa(KTL006): first error is re-raised on the caller's thread by run_pipeline, never swallowed
+            self.state.fail(exc)
+
+
+def run_pipeline(read_iter, stages, consume, *, producer_span=True,
+                 side_stage=None, on_feat_done=None):
+    """Drive a bounded pipeline: ``read_iter`` batches flow through each
+    ``(name, fn)`` stage on its own thread; ``consume(result)`` runs on the
+    calling thread in stream order. -> {stage_name: busy_seconds}
+    (``read_iter``'s pull time under the key ``"produce"``).
+
+    ``producer_span=False`` when ``read_iter`` emits its own
+    ``importer.read``/``importer.encode`` spans (the fused producer).
+
+    ``side_stage`` opens an UNBOUNDED injection channel into the named
+    stage: ``consume`` receives an ``inject(item)`` second argument it may
+    call to push extra work (the importer's streamed leaf-tree batches)
+    through that stage and everything after it, without closing a bounded
+    queue cycle. With a side channel the shutdown is two-phase: the
+    producer emits a feature-stream-end marker; once it reaches this
+    driver, ``on_feat_done(inject)`` runs (last chance to inject), then the
+    side channel is closed and the stages drain to a final end sentinel.
+
+    Raises the first stage error (including an injected fault) on this
+    thread, after every stage thread has drained — the caller's cleanup
+    (the bulk-pack abort) then sees a fully quiesced writer.
+    """
+    state = _PipelineState()
+    cap = queue_batches()
+    side_q = queue.Queue() if side_stage is not None else None
+    prev_q = queue.Queue(maxsize=cap)
+    read = _Stage(
+        "produce", state, source=read_iter, out_q=prev_q, span=producer_span,
+        end=_FEAT_DONE if side_q is not None else _DONE,
+    )
+    read.fault_hook = faults.hook("import.encode")
+    threads = [read]
+    for name, fn in stages:
+        out_q = queue.Queue(maxsize=cap)
+        stage = _Stage(
+            name, state, fn=fn, in_q=prev_q, out_q=out_q,
+            side_q=side_q if name == side_stage else None,
+        )
+        if name == "pack":
+            stage.fault_hook = faults.hook("import.pack_stream")
+        threads.append(stage)
+        prev_q = out_q
+    for t in threads:
+        t.start()
+
+    def inject(item):
+        if side_q is None:
+            raise RuntimeError("pipeline has no side channel (side_stage)")
+        side_q.put(item)  # unbounded: never blocks the consuming thread
+
+    takes_inject = side_q is not None
+    try:
+        while True:
+            item = _get(prev_q, state)
+            if item is _DONE:
+                break
+            if item is _FEAT_DONE:
+                # every feature result has been consumed: flush trailing
+                # injections, then close the side channel
+                if on_feat_done is not None:
+                    on_feat_done(inject)
+                side_q.put(_DONE)
+                continue
+            if takes_inject:
+                consume(item, inject)
+            else:
+                consume(item)
+    except BaseException as exc:  # kart: noqa(KTL006): recorded as the pipeline error and re-raised below once the stages have drained
+        state.fail(exc)
+    finally:
+        # reap every stage: the stop flag (set on any error) unblocks their
+        # bounded puts/gets; joins are bounded so a wedged stage cannot hang
+        # the importer forever
+        for t in threads:
+            t.join(timeout=10.0)
+    if state.error is not None:
+        raise state.error
+    return {t.stage_name: t.busy_s for t in threads}

@@ -111,6 +111,73 @@ def _json_value_str(v, _float_repr=float.__repr__):
     return _json.dumps(v, separators=(",", ":"), ensure_ascii=True)
 
 
+def compiled_blob_encoder(schema):
+    """Per-legend *compiled* feature-blob serialiser ``fn(feature_dict) ->
+    (pk_values, blob_bytes)`` — the blob-encode twin of the compiled JSON
+    serialisers below (:meth:`Dataset3._jsonl_serializer`): the column
+    resolution ``Schema.feature_to_raw_dict`` + ``Legend.to_value_tuples``
+    performs per feature is unrolled into straight-line code feeding one
+    reused msgpack Packer, so the import/apply hot loop pays no raw dict, no
+    value-tuple list and no per-feature Packer construction. Bit-identical
+    to ``schema.encode_feature_blob`` (tested): the Packer carries the same
+    ``strict_types``/``use_bin_type``/default-hook configuration as
+    ``core.serialise.msg_pack``, so any value the generic path accepts (or
+    rejects) behaves identically here — geometry just skips the subclass
+    hook dispatch via an inlined ``pack_ext_type``. Every embedded literal
+    goes through repr(), keeping arbitrary column names inert string
+    constants in the generated source.
+
+    NOT thread-safe: the packer buffer is reused across calls, so each
+    thread needs its own encoder (the import pipeline's encode stage owns
+    exactly one)."""
+    import msgpack
+
+    from kart_tpu.core.serialise import GEOMETRY_EXT_CODE, _pack_hook
+    from kart_tpu.geometry import Geometry as _Geom
+
+    cols = {c.id: c for c in schema.columns}
+    legend = schema.legend
+    pk_names = [cols[cid].name for cid in legend.pk_columns]
+    lines = [
+        "def _enc(f, _p=_p, _lh=_lh, _G=_G, _Geom=_Geom, _bytes=bytes):",
+        " _p.pack_array_header(2)",
+        " _p.pack(_lh)",
+        f" _p.pack_array_header({len(legend.non_pk_columns)})",
+    ]
+    for cid in legend.non_pk_columns:
+        c = cols[cid]
+        if c.data_type == "geometry":
+            lines.append(f" v = f[{c.name!r}]")
+            lines.append(" if v is None: _p.pack(None)")
+            # ext-encode only Geometry instances — the generic hook packs a
+            # plain-bytes geometry value as bin, and the blobs must match
+            lines.append(" elif isinstance(v, _Geom): _p.pack_ext_type(_G, _bytes(v))")
+            lines.append(" else: _p.pack(v)")
+        else:
+            lines.append(f" _p.pack(f[{c.name!r}])")
+    pk_expr = ", ".join(f"f[{n!r}]" for n in pk_names)
+    trailing = "," if len(pk_names) == 1 else ""
+    lines.append(f" pk = ({pk_expr}{trailing})")
+    lines.append(" out = _p.bytes()")
+    lines.append(" _p.reset()")
+    lines.append(" return pk, out")
+    namespace = {
+        # autoreset=False: the blob is composed incrementally (array header,
+        # hash, values) — with autoreset every pack() would flush mid-record
+        "_p": msgpack.Packer(
+            use_bin_type=True,
+            strict_types=True,
+            default=_pack_hook,
+            autoreset=False,
+        ),
+        "_lh": schema.legend_hash,
+        "_G": GEOMETRY_EXT_CODE,
+        "_Geom": _Geom,
+    }
+    exec("\n".join(lines), namespace)
+    return namespace["_enc"]
+
+
 class DatasetCapabilityError(RuntimeError):
     """Dataset requires capabilities this version doesn't support
     (reference: dataset3.py:109-124)."""
@@ -661,12 +728,22 @@ class Dataset3:
 
     def import_iter_feature_blobs(self, features, schema=None):
         """Generator of (full_path, blob_bytes) over a feature iterable —
-        the import hot loop (reference: dataset3.py:302-346)."""
+        the import hot loop (reference: dataset3.py:302-346). Encodes
+        through the legend's compiled blob serialiser
+        (:func:`compiled_blob_encoder`, bit-identical to
+        ``schema.encode_feature_blob``)."""
         schema = schema or self.schema
         enc = self.path_encoder
         prefix = f"{self.inner_path}/{self.FEATURE_PATH}"
+        encode = compiled_blob_encoder(schema)
         for feature in features:
-            pk_values, blob = schema.encode_feature_blob(feature)
+            if isinstance(feature, dict):
+                pk_values, blob = encode(feature)
+            else:
+                # schema-ordered sequences (the other shape
+                # feature_to_raw_dict accepts) take the generic path —
+                # the compiled encoder indexes by column name
+                pk_values, blob = schema.encode_feature_blob(feature)
             yield prefix + enc.encode_pks_to_path(pk_values), blob
 
     # -- applying diffs ------------------------------------------------------

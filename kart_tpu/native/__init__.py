@@ -181,7 +181,7 @@ def ensure_built():
 # -- object-store IO core (native/kart_io.cpp) ------------------------------
 
 _IO_LIB_NAME = "libkart_io.so"
-_IO_ABI_VERSION = 5  # v5: io_tree_diff
+_IO_ABI_VERSION = 7  # v7: io_leaf_payloads leaf-tree kernel
 
 _io_lib = None
 _io_load_attempted = False
@@ -248,6 +248,26 @@ def load_io():
             ctypes.c_void_p, ctypes.c_int64, ctypes.c_void_p,
             ctypes.c_int64, ctypes.c_void_p, ctypes.c_int64,
             ctypes.c_void_p, ctypes.c_void_p,
+        ]
+        lib.io_gpkg_open.restype = ctypes.c_void_p
+        lib.io_gpkg_open.argtypes = [
+            ctypes.c_char_p, ctypes.c_char_p, ctypes.c_int,
+            ctypes.c_void_p, ctypes.c_void_p, ctypes.c_int,
+            ctypes.c_void_p, ctypes.c_int64, ctypes.c_int,
+        ]
+        lib.io_gpkg_next.restype = ctypes.c_int64
+        lib.io_gpkg_next.argtypes = [
+            ctypes.c_void_p, ctypes.c_int64, ctypes.c_void_p,
+            ctypes.c_void_p, ctypes.c_int64, ctypes.c_void_p,
+        ]
+        lib.io_gpkg_close.restype = None
+        lib.io_gpkg_close.argtypes = [ctypes.c_void_p]
+        lib.io_leaf_payloads.restype = ctypes.c_int64
+        lib.io_leaf_payloads.argtypes = [
+            ctypes.c_void_p, ctypes.c_void_p, ctypes.c_int64,
+            ctypes.c_int64, ctypes.c_int64, ctypes.c_void_p,
+            ctypes.c_int64, ctypes.c_void_p, ctypes.c_void_p,
+            ctypes.c_void_p,
         ]
         _io_lib = lib
     except (OSError, AttributeError) as e:
@@ -360,6 +380,154 @@ def pack_records_batch(obj_type, type_code, contents, level=1):
         L.warning("native pack records failed (%d); falling back", total)
         return None
     return oids, crcs, out[:total], out_offsets
+
+
+def pack_records_base(obj_type, type_code, base_u8, offsets, level=1):
+    """:func:`pack_records_batch` over payloads that are ALREADY one
+    contiguous buffer + offsets (the native GPKG encoder's output, or a
+    tree-payload batch) — no join, no bytes objects, zero per-payload
+    Python. -> same (oids, crcs, records, out_offsets) tuple, or None."""
+    lib = load_io()
+    if lib is None:
+        return None
+    offsets = np.ascontiguousarray(offsets, dtype=np.int64)
+    n = len(offsets) - 1
+    if n <= 0:
+        return None
+    base_u8 = np.ascontiguousarray(base_u8, dtype=np.uint8)
+    payload_total = int(offsets[n])
+    oids = np.empty((n, 20), dtype=np.uint8)
+    crcs = np.empty(n, dtype=np.uint32)
+    cap = payload_total + payload_total // 512 + 80 * n + 1024
+    out = np.empty(cap, dtype=np.uint8)
+    out_offsets = np.empty(n + 1, dtype=np.int64)
+    total = lib.io_pack_records(
+        base_u8.ctypes.data_as(ctypes.c_char_p), offsets.ctypes.data, n,
+        obj_type.encode(), int(type_code), int(level), _store_max(),
+        oids.ctypes.data, crcs.ctypes.data, out.ctypes.data, cap,
+        out_offsets.ctypes.data,
+    )
+    if total < 0:
+        L.warning("native pack records (base) failed (%d); falling back", total)
+        return None
+    return oids, crcs, out[:total], out_offsets
+
+
+def leaf_payloads(pks, oids_u8, branches, pk_limit):
+    """Native leaf-tree payload build (io_leaf_payloads): strictly ascending
+    non-negative int64 ``pks`` below ``pk_limit`` (``branches**(levels+1)``
+    — above it leaf ids would need the encoder's max_trees wrap) + their
+    (n, 20) blob oids -> (buf uint8, offsets int64 (n_leaves+1,), leaf_ids
+    int64) where leaf k's git tree payload is
+    ``buf[offsets[k]:offsets[k+1]]`` — bit-identical to the numpy plan
+    path (property-tested). None when the lib is unavailable or the pks
+    don't qualify (caller falls back to the Python build)."""
+    lib = load_io()
+    if lib is None:
+        return None
+    pks = np.ascontiguousarray(pks, dtype=np.int64)
+    n = len(pks)
+    if n == 0:
+        return None
+    oids_u8 = np.ascontiguousarray(oids_u8, dtype=np.uint8)
+    # entry <= 7 + 16-char name + NUL + 20-byte oid = 44 bytes
+    cap = n * 44 + 64
+    out = np.empty(cap, dtype=np.uint8)
+    offsets = np.empty(n + 1, dtype=np.int64)
+    leaf_ids = np.empty(n, dtype=np.int64)
+    n_leaves = ctypes.c_int64(0)
+    total = lib.io_leaf_payloads(
+        pks.ctypes.data, oids_u8.ctypes.data, n, int(branches),
+        int(pk_limit), out.ctypes.data, cap, offsets.ctypes.data,
+        leaf_ids.ctypes.data, ctypes.byref(n_leaves),
+    )
+    if total < 0:
+        return None
+    k = n_leaves.value
+    return out[:total], offsets[: k + 1], leaf_ids[:k]
+
+
+class GpkgReaderFallback(Exception):
+    """The native GPKG encoder met a row it cannot produce bit-identically
+    (geometry needing the full re-encode path, unexpected storage class):
+    the caller must re-stream through the Python encoder."""
+
+
+class GpkgNativeReader:
+    """Native fused read+encode over a GPKG table (io_gpkg_*): each
+    :meth:`next_batch` steps the prepared SELECT and returns
+    ``(pks int64 (n,), buf uint8, offsets int64 (n+1,))`` — blob i is
+    ``buf[offsets[i]:offsets[i+1]]``, bit-identical to the Python
+    ``batch_row_encoder`` blobs. The ctypes call releases the GIL for the
+    whole batch. Raises :class:`GpkgReaderFallback` on rows the native
+    encoder can't handle. Use :func:`open_gpkg_reader` (returns None when
+    the native lib or sqlite3 runtime is unavailable)."""
+
+    def __init__(self, handle, lib, est_row_bytes):
+        self._h = handle
+        self._lib = lib
+        # grown on demand (-5): start from the caller's estimate
+        self._row_bytes = max(64, int(est_row_bytes))
+
+    def next_batch(self, max_rows):
+        """-> (pks, buf, offsets) or None at EOF."""
+        if self._h is None:
+            return None
+        lib = self._lib
+        while True:
+            pks = np.empty(max_rows, dtype=np.int64)
+            cap = max_rows * self._row_bytes + 4096
+            buf = np.empty(cap, dtype=np.uint8)
+            offsets = np.empty(max_rows + 1, dtype=np.int64)
+            n = lib.io_gpkg_next(
+                self._h, max_rows, pks.ctypes.data, buf.ctypes.data, cap,
+                offsets.ctypes.data,
+            )
+            if n == -5:  # a single row outgrew the buffer: double and retry
+                self._row_bytes *= 2
+                continue
+            if n == -6:
+                self.close()
+                raise GpkgReaderFallback()
+            if n < 0:
+                self.close()
+                raise OSError(f"native GPKG reader failed (rc={n})")
+            if n == 0:
+                self.close()
+                return None
+            return pks[:n], buf, offsets[: n + 1]
+
+    def close(self):
+        if self._h is not None:
+            self._lib.io_gpkg_close(self._h)
+            self._h = None
+
+    def __del__(self):
+        self.close()
+
+
+def open_gpkg_reader(db_path, sql, val_cols, kinds, pk_col, prefix,
+                     geom_ext_code, est_row_bytes=256):
+    """-> :class:`GpkgNativeReader` or None when the native IO lib (or the
+    sqlite3 runtime it dlopens) is unavailable. ``val_cols``/``kinds``: per
+    blob value (legend non-pk order) the SELECT column index and encode
+    kind (0 plain / 1 geometry / 2 bool / 3 float / 4 timestamp);
+    ``prefix``: the constant msgpack head every feature blob starts with."""
+    lib = load_io()
+    if lib is None:
+        return None
+    val_cols = np.ascontiguousarray(val_cols, dtype=np.int32)
+    kinds_u8 = np.ascontiguousarray(kinds, dtype=np.uint8)
+    n_vals = len(kinds_u8)
+    prefix = bytes(prefix)
+    handle = lib.io_gpkg_open(
+        os.fsencode(db_path), sql.encode(), n_vals,
+        val_cols.ctypes.data, kinds_u8.ctypes.data, int(pk_col),
+        prefix, len(prefix), int(geom_ext_code),
+    )
+    if not handle:
+        return None
+    return GpkgNativeReader(handle, lib, est_row_bytes)
 
 
 def inflate_pack_batch(pack_buf, offsets, max_total=None):

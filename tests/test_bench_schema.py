@@ -64,6 +64,17 @@ NEW_KEYS += [
     "lint_findings_total",
 ]
 
+#: keys added by ISSUE 5 (pipelined import: the measured pipeline-vs-serial
+#: overlap win at 1M rows, and a real 10M import leg so the 100M
+#: extrapolation is no longer a guess)
+NEW_KEYS += [
+    "import_pipeline_seconds",
+    "import_pipeline_speedup",
+    "cli_10m_import_rows",
+    "cli_10m_import_seconds",
+    "import_features_per_sec_10m",
+]
+
 
 def test_bench_emits_every_recorded_key():
     with open(os.path.join(REPO_ROOT, "bench.py")) as f:

@@ -675,6 +675,96 @@ def test_bulk_pack_finalise_fault_leaves_sweepable_debris(tmp_path, monkeypatch)
     assert os.listdir(pack_dir) == []
 
 
+# ---------------------------------------------------------------------------
+# pipelined-import fault points (import.encode / import.pack_stream)
+# ---------------------------------------------------------------------------
+
+
+def _clean_import_tree(tmp_path, gpkg, name):
+    """Root tree of a never-faulted import of ``gpkg`` — the byte-identical
+    ground truth the post-fault re-run must reproduce."""
+    from kart_tpu.importer import ImportSource
+    from kart_tpu.importer.importer import import_sources
+
+    ref = KartRepo.init_repository(tmp_path / name)
+    commit_oid = import_sources(ref, ImportSource.open(gpkg))
+    return ref.odb.read_commit(commit_oid).tree
+
+
+def _assert_no_half_written_pack(repo):
+    """The crash contract: nothing readable landed and the pack dir holds
+    at most sweepable ``.tmp-pack-*`` debris — never a live pack/idx pair
+    a reader would trust."""
+    assert fsck_objects(repo) == 0
+    pack_dir = os.path.join(repo.odb.objects_dir, "pack")
+    leftovers = os.listdir(pack_dir) if os.path.isdir(pack_dir) else []
+    assert all(n.startswith(".tmp-pack-") for n in leftovers)
+    return leftovers
+
+
+@pytest.mark.parametrize(
+    "spec", ["import.encode:1", "import.pack_stream:1"]
+)
+def test_import_pipeline_stage_kill_is_clean_and_rerunnable(
+    tmp_path, monkeypatch, spec
+):
+    """import.encode / import.pack_stream kill matrix: a pipelined import
+    killed in either stage propagates the fault out of every pipeline
+    thread, aborts the bulk pack (no half-written pack/idx, HEAD untouched,
+    only sweepable debris) — and the same import simply re-run lands a
+    tree byte-identical to a never-faulted import."""
+    from kart_tpu.importer import ImportSource
+    from kart_tpu.importer.importer import import_sources
+
+    from helpers import create_points_gpkg
+
+    gpkg = create_points_gpkg(str(tmp_path / "pts.gpkg"), n=120)
+    expected_tree = _clean_import_tree(tmp_path, gpkg, "ref")
+
+    repo = KartRepo.init_repository(tmp_path / "r")
+    monkeypatch.setenv("KART_IMPORT_PIPELINE", "1")  # force on a tiny import
+    monkeypatch.setenv("KART_FAULTS", spec)  # arms import.encode:1 / import.pack_stream:1
+    with pytest.raises(faults.InjectedFault):
+        import_sources(repo, ImportSource.open(gpkg))
+    monkeypatch.delenv("KART_FAULTS")
+
+    assert repo.head_is_unborn  # the ref update never ran
+    leftovers = _assert_no_half_written_pack(repo)
+    # cleanly re-runnable: the retried import succeeds on the same repo and
+    # reproduces the ground-truth tree bit-for-bit
+    commit_oid = import_sources(repo, ImportSource.open(gpkg))
+    assert repo.odb.read_commit(commit_oid).tree == expected_tree
+    # the sweeper claims exactly the crash debris, nothing else
+    assert repo.gc("--prune-now")["pruned"] == len(leftovers)
+
+
+def test_import_pipeline_generic_source_kill_is_clean(tmp_path, monkeypatch):
+    """The same contract on the generic (non-GPKG) pipeline producer: a CSV
+    import killed at the pack stream leaves no readable objects and
+    re-runs cleanly."""
+    from kart_tpu.importer import ImportSource
+    from kart_tpu.importer.importer import import_sources
+
+    csv_path = tmp_path / "rows.csv"
+    csv_path.write_text(
+        "id,name\n" + "".join(f"{i},row-{i}\n" for i in range(1, 90))
+    )
+    expected_tree = _clean_import_tree(tmp_path, str(csv_path), "ref-csv")
+
+    repo = KartRepo.init_repository(tmp_path / "r2")
+    monkeypatch.setenv("KART_IMPORT_PIPELINE", "1")
+    # bare point (no :n) so the spec *string* differs from the GPKG matrix
+    # above — the faults module resets its one-shot state on spec change
+    monkeypatch.setenv("KART_FAULTS", "import.pack_stream")
+    with pytest.raises(faults.InjectedFault):
+        import_sources(repo, ImportSource.open(str(csv_path)))
+    monkeypatch.delenv("KART_FAULTS")
+    assert repo.head_is_unborn
+    _assert_no_half_written_pack(repo)
+    commit_oid = import_sources(repo, ImportSource.open(str(csv_path)))
+    assert repo.odb.read_commit(commit_oid).tree == expected_tree
+
+
 def test_fetch_blobs_retry_refetches_only_missing(served_repo, tmp_path, monkeypatch):
     """Promisor backfill is idempotent: after a torn attempt the retry
     re-requests only the oids that didn't land."""

@@ -262,3 +262,81 @@ def test_bbox_f32_matches_numpy_reference():
         np.testing.assert_array_equal(got, want, err_msg=str(q))
     if load() is None:
         pytest.skip("native lib absent: exercised the fallback only")
+
+
+class TestLeafPayloadKernel:
+    """io_leaf_payloads (the import pipeline's native leaf-tree build) must
+    be bit-identical to the numpy plan path (StreamingLeafEmitter's
+    fallback) across msgpack width boundaries and leaf shapes."""
+
+    def _ref(self, enc, pks, oids):
+        from kart_tpu.core.feature_tree import StreamingLeafEmitter
+
+        em = StreamingLeafEmitter(enc)
+        em._native = False  # force the numpy plan path
+        return em._payloads(np.asarray(pks, np.int64), oids)
+
+    @pytest.mark.parametrize(
+        "name,pks",
+        [
+            ("dense", list(range(5000))),
+            ("fixint_edge", list(range(100, 300))),          # crosses 0x7F
+            ("u8_u16_edge", list(range(200, 70000, 37))),    # 0xFF / 0xFFFF
+            ("single", [0]),
+            ("one_leaf", list(range(64, 128))),
+        ],
+    )
+    def test_matches_python_plan_path(self, name, pks):
+        from kart_tpu import native
+        from kart_tpu.models.paths import PathEncoder
+
+        if native.load_io() is None:
+            pytest.skip("native IO lib unavailable")
+        enc = PathEncoder.INT_PK_ENCODER
+        limit = enc.branches ** (enc.levels + 1)
+        rng = np.random.default_rng(5)
+        pks = np.asarray(pks, dtype=np.int64)
+        oids = rng.integers(0, 256, (len(pks), 20), dtype=np.uint8)
+        nat = native.leaf_payloads(pks, oids, enc.branches, limit)
+        assert nat is not None
+        buf_r, off_r, lid_r = self._ref(enc, pks, oids)
+        np.testing.assert_array_equal(nat[2], lid_r, err_msg=name)
+        np.testing.assert_array_equal(nat[1], off_r, err_msg=name)
+        assert bytes(np.asarray(nat[0])) == bytes(np.asarray(buf_r)), name
+
+    def test_sparse_random_pks_match(self):
+        from kart_tpu import native
+        from kart_tpu.models.paths import PathEncoder
+
+        if native.load_io() is None:
+            pytest.skip("native IO lib unavailable")
+        enc = PathEncoder.INT_PK_ENCODER
+        limit = enc.branches ** (enc.levels + 1)
+        rng = np.random.default_rng(6)
+        pks = np.sort(
+            rng.choice(limit - 1, 4000, replace=False)
+        ).astype(np.int64)
+        oids = rng.integers(0, 256, (len(pks), 20), dtype=np.uint8)
+        nat = native.leaf_payloads(pks, oids, enc.branches, limit)
+        buf_r, off_r, lid_r = self._ref(enc, pks, oids)
+        np.testing.assert_array_equal(nat[2], lid_r)
+        assert bytes(np.asarray(nat[0])) == bytes(np.asarray(buf_r))
+
+    def test_rejects_out_of_contract_pks(self):
+        """Unordered / negative / over-limit pks -> None (the caller falls
+        back to the plan path, which handles them via max_trees wrap)."""
+        from kart_tpu import native
+        from kart_tpu.models.paths import PathEncoder
+
+        if native.load_io() is None:
+            pytest.skip("native IO lib unavailable")
+        enc = PathEncoder.INT_PK_ENCODER
+        limit = enc.branches ** (enc.levels + 1)
+        z = np.zeros((2, 20), np.uint8)
+        br = enc.branches
+        assert native.leaf_payloads(
+            np.array([5, 3], np.int64), z, br, limit) is None
+        assert native.leaf_payloads(
+            np.array([-1, 3], np.int64), z, br, limit) is None
+        assert native.leaf_payloads(
+            np.array([0, limit], np.int64), z, br, limit) is None

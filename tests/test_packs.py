@@ -581,3 +581,105 @@ def test_maybe_refresh_rate_limited(tmp_path):
     # after the rate window passes, the racy rescan is allowed again
     pc._last_refresh_ns -= 10**9
     assert pc.maybe_refresh() is True
+
+
+def test_prepare_pack_index_prefix_ties_and_dups():
+    """The idx sort takes one u64 argsort on the 8-byte sha prefix plus a
+    tie fixup; force shared prefixes (never produced by real SHA-1 at test
+    scale, so synthesised) and full duplicates, and pin the table order
+    against a plain python lexicographic sort."""
+    import numpy as np
+
+    from kart_tpu.core.packs import prepare_pack_index
+
+    rng = np.random.default_rng(7)
+    n = 5000
+    shas = rng.integers(0, 256, (n, 20), dtype=np.uint8)
+    shas[100:300, :8] = shas[100, :8]  # 200 rows share one prefix
+    shas[400:500, :8] = shas[400, :8]  # 100 share another
+    shas[600:605] = shas[600]  # 5 fully identical keys
+    crcs = rng.integers(0, 2**32, n, dtype=np.uint32)
+    offs = (np.arange(n, dtype=np.int64) * 97)
+
+    tables = prepare_pack_index([], [(shas, crcs, offs)])
+
+    fanout = np.frombuffer(tables[:1024], dtype=">u4")
+    out_shas = np.frombuffer(
+        tables[1024 : 1024 + 20 * n], dtype=np.uint8
+    ).reshape(n, 20)
+    out_crcs = np.frombuffer(tables[1024 + 20 * n : 1024 + 24 * n], dtype=">u4")
+    out_offs = np.frombuffer(tables[1024 + 24 * n : 1024 + 28 * n], dtype=">u4")
+
+    keys = [bytes(s) for s in shas]
+    ref_rows = sorted(range(n), key=lambda i: keys[i])
+    np.testing.assert_array_equal(
+        out_shas, np.array([shas[i] for i in ref_rows])
+    )
+    # crc/offset tables follow the same permutation (dup keys: any of the
+    # duplicates' payloads is acceptable at each slot)
+    want = {}
+    for i in range(n):
+        want.setdefault(keys[i], set()).add((int(crcs[i]), int(offs[i])))
+    for j in range(n):
+        assert (int(out_crcs[j]), int(out_offs[j])) in want[bytes(out_shas[j])]
+    counts = np.bincount(shas[:, 0], minlength=256)
+    np.testing.assert_array_equal(fanout, np.cumsum(counts).astype(">u4"))
+
+
+def test_pack_writer_batch_dedupe_across_batches(tmp_path):
+    """The vectorised prefix probe must still catch exact duplicates that
+    arrive in a LATER add_batch_raw call (cross-batch dedupe): the second
+    write of the same content adds no entries and readers resolve every
+    oid."""
+    from kart_tpu import native
+    from kart_tpu.core.packs import PackCollection, PackWriter
+
+    if native.load_io() is None:
+        pytest.skip("native IO lib unavailable")
+    pack_dir = str(tmp_path / "pack")
+    blobs_a = [b"payload-%d" % i for i in range(500)]
+    blobs_b = [b"payload-%d" % i for i in range(250, 750)]  # 250 dupes
+    with PackWriter(pack_dir) as w:
+        first = w.add_batch_raw("blob", blobs_a)
+        assert first is not None
+        second = w.add_batch_raw("blob", blobs_b)
+        assert second is not None
+        assert w.object_count == 750  # not 1000
+    packs = PackCollection([pack_dir])
+    for blob, oid_row in zip(blobs_b, second):
+        got = packs.read(bytes(oid_row))
+        assert got == ("blob", blob)
+
+
+def test_pack_writer_dedupe_run_stack_many_batches(tmp_path):
+    """The prefix accumulator is a geometrically-merged run stack, not one
+    re-merged array: after many clean batches the runs stay strictly
+    size-decreasing (O(log n) of them), duplicates of the OLDEST batch are
+    still caught, and the scalar add() path probes the runs too."""
+    from kart_tpu import native
+    from kart_tpu.core.packs import PackCollection, PackWriter
+
+    if native.load_io() is None:
+        pytest.skip("native IO lib unavailable")
+    pack_dir = str(tmp_path / "pack")
+    batches = [
+        [b"batch%d-row%d" % (b, i) for i in range(64)] for b in range(9)
+    ]
+    with PackWriter(pack_dir) as w:
+        oids = [w.add_batch_raw("blob", blobs) for blobs in batches]
+        assert all(o is not None for o in oids)
+        sizes = [c.size for c in w._seen_pref_chunks]
+        assert sum(sizes) == 9 * 64
+        assert sizes == sorted(sizes, reverse=True)
+        assert len(sizes) <= 3  # binary counter: 9*64 rows -> runs 8,1 (*64)
+        # duplicate the oldest batch (lives deep in the merged run) plus
+        # fresh rows: dedupe must route through the slow path and keep one
+        # copy of everything
+        mixed = batches[0][:32] + [b"fresh-%d" % i for i in range(32)]
+        third = w.add_batch_raw("blob", mixed)
+        # scalar path probes the run stack as well
+        assert w.add("blob", batches[0][0]) == bytes(oids[0][0]).hex()
+        assert w.object_count == 9 * 64 + 32
+    packs = PackCollection([pack_dir])
+    for blob, oid_row in zip(mixed, third):
+        assert packs.read(bytes(oid_row)) == ("blob", blob)

@@ -19,8 +19,14 @@ def small_threshold(monkeypatch):
     monkeypatch.setattr(par, "MIN_FEATURES_FOR_PARALLEL", 10)
 
 
-def _import_tree(tmp_path, name, gpkg, workers, monkeypatch):
+def _import_tree(tmp_path, name, gpkg, workers, monkeypatch, pipeline=None):
     monkeypatch.setenv("KART_IMPORT_WORKERS", str(workers))
+    # Native-read-capable sources route to the in-process pipeline even when
+    # workers are requested; pass pipeline="0" to force the process fan-out.
+    if pipeline is None:
+        monkeypatch.delenv("KART_IMPORT_PIPELINE", raising=False)
+    else:
+        monkeypatch.setenv("KART_IMPORT_PIPELINE", pipeline)
     repo = KartRepo.init_repository(str(tmp_path / name))
     sources = GPKGImportSource.open_all(gpkg)
     commit_oid = import_sources(repo, sources)
@@ -32,7 +38,9 @@ def test_parallel_import_matches_serial(tmp_path, monkeypatch, small_threshold):
     create_points_gpkg(gpkg, n=500)
 
     _, serial_tree = _import_tree(tmp_path, "serial", gpkg, 1, monkeypatch)
-    repo2, par_tree = _import_tree(tmp_path, "par", gpkg, 2, monkeypatch)
+    repo2, par_tree = _import_tree(
+        tmp_path, "par", gpkg, 2, monkeypatch, pipeline="0"
+    )
     assert serial_tree == par_tree
 
     # the parallel repo actually used worker packs (>= 2 packs: workers + bulk)
@@ -58,7 +66,9 @@ def test_parallel_import_sparse_pks(tmp_path, monkeypatch, small_threshold):
     con.close()
 
     _, serial_tree = _import_tree(tmp_path, "serial", gpkg, 1, monkeypatch)
-    _, par_tree = _import_tree(tmp_path, "par", gpkg, 3, monkeypatch)
+    _, par_tree = _import_tree(
+        tmp_path, "par", gpkg, 3, monkeypatch, pipeline="0"
+    )
     assert serial_tree == par_tree
 
 
@@ -107,3 +117,40 @@ def test_shardable_rejects_wrapping_pk_span(tmp_path, monkeypatch, small_thresho
     repo = KartRepo(str(tmp_path / "wide-repo"))
     ds = list(repo.structure("HEAD").datasets)[0]
     assert ds.feature_count == 20
+
+
+def test_shard_bounds_balanced_single_index_pass(tmp_path):
+    """_shard_bounds yields branches-aligned interior boundaries that
+    count-balance the table, and each quantile query walks OFFSET entries
+    from the PREVIOUS boundary (one O(total) pass over the pk index in
+    aggregate, not a rank-from-zero walk per shard)."""
+    import sqlite3
+
+    gpkg = str(tmp_path / "b.gpkg")
+    create_points_gpkg(gpkg, n=1000)
+    source = GPKGImportSource.open_all(gpkg)[0]
+
+    bounds = par._shard_bounds(source, "fid", 64, 4)
+    assert bounds == sorted(set(bounds))
+    assert all(b % 64 == 0 for b in bounds)
+    assert 1 <= len(bounds) <= 3
+    # partition counts: alignment can shift a boundary by < branches rows,
+    # so every shard holds its quantile share give or take one leaf bucket
+    con = sqlite3.connect(gpkg)
+    edges = [None, *bounds, None]
+    sizes = []
+    for lo, hi in zip(edges, edges[1:]):
+        where, params = [], []
+        if lo is not None:
+            where.append("fid >= ?"); params.append(lo)
+        if hi is not None:
+            where.append("fid < ?"); params.append(hi)
+        (n,) = con.execute(
+            "SELECT COUNT(*) FROM points WHERE " + " AND ".join(where), params
+        ).fetchone()
+        sizes.append(n)
+    con.close()
+    assert sum(sizes) == 1000
+    assert all(abs(n - 250) <= 64 for n in sizes)
+    # degenerate: more shards than rows -> no interior boundaries
+    assert par._shard_bounds(source, "fid", 64, 2000) == []
