@@ -1438,10 +1438,353 @@ def _bench_edit_commit(rows):
     structure.commit_diff(repo_diff, "bench edit", validate=False)
 
 
+# --- multichip scaling bench (ISSUE 6) --------------------------------------
+#
+# `python bench.py --multichip` measures the 100M-row classify through the
+# sharded backend's record-batch path at 1/2/4/8 devices and prints one JSON
+# record (MULTICHIP_r*.json). Devices are *worker processes*, one pinned core
+# each: on real multi-chip hosts each worker owns a chip; on a CPU-only
+# container they are virtual devices, so the curve measures honest per-core
+# scaling (the 1-dev leg is pinned to one core too — no hidden intra-op
+# threads inflating the baseline). The mesh is as fast as its stragglers, so
+# the aggregate rate divides total rows by the *slowest* shard's wall time,
+# and all shards start together (a stdin go-barrier after every worker has
+# compiled and generated its slice). The record embeds measured environment
+# ceilings — pure-ALU and memcpy 2-process scaling — so a core-starved or
+# bandwidth-starved container's flat tail reads as what it is.
+
+
+def _multichip_slice(lo, hi):
+    """(old_block, new_block) for global key range [lo, hi) of the synthetic
+    100M pair: keys are the range itself, oids derive from the key (splitmix
+    constant), 1 row in CHANGE_STRIDE gets edited oids — any shard of the
+    key space is generable locally, nothing crosses process boundaries."""
+    from kart_tpu.ops.blocks import FeatureBlock
+
+    keys = np.arange(lo, hi, dtype=np.int64)
+    h = keys.astype(np.uint64) * np.uint64(0x9E3779B97F4A7C15)
+    oids = np.empty((len(keys), 5), dtype=np.uint32)
+    for i in range(5):
+        oids[:, i] = ((h >> np.uint64(i * 12)) & np.uint64(0xFFFFFFFF)).astype(
+            np.uint32
+        )
+    new_oids = oids.copy()
+    changed = (keys % CHANGE_STRIDE) == 7
+    new_oids[changed, 0] ^= 1
+    n = len(keys)
+    return (
+        FeatureBlock(keys, oids, None, n),
+        FeatureBlock(keys.copy(), new_oids, None, n),
+    )
+
+
+def multichip_worker():
+    """One device of the multichip bench: pin to a core, insulate onto a
+    1-device platform, compile + generate, report ready, block on the
+    go-barrier, then classify the whole slice once against the clock.
+
+    argv: --multichip-worker <mode> <lo> <hi> <cpu>; ``mode`` is
+    ``batched`` (the sharded backend's record-batch loader — every shard of
+    the 2/4/8-device legs) or ``mono`` (the monolithic single-device jitted
+    kernel, exactly what ``device_jax`` executes on one chip — the 1-device
+    leg). Prints two JSON lines (ready, result)."""
+    import sys
+
+    args = sys.argv[sys.argv.index("--multichip-worker") + 1 :]
+    mode, lo, hi, cpu = args[0], int(args[1]), int(args[2]), int(args[3])
+    try:
+        os.sched_setaffinity(0, {cpu})
+    except (AttributeError, OSError):
+        pass  # non-Linux: unpinned workers still measure, just noisier
+
+    from kart_tpu.runtime import insulate_virtual_cpu, probe_backend
+
+    insulate_virtual_cpu(1)
+    info = probe_backend()
+    if not info["ok"]:
+        print(json.dumps({"ready": False, "error": info["error"]}), flush=True)
+        sys.exit(3)
+
+    old_block, new_block = _multichip_slice(lo, hi)
+    if mode == "mono":
+        from kart_tpu.ops.diff_kernel import (
+            _classify_padded_binsearch,
+            _padded_arrays,
+        )
+
+        # compile + first-touch at full shape (jit specialises per padded
+        # bucket size, so a tiny warm pair would not pre-pay this compile)
+        def run():
+            ok, oo = _padded_arrays(old_block)
+            nk, no = _padded_arrays(new_block)
+            oc, ncl, _, cnt = _classify_padded_binsearch(
+                ok, oo, nk, no, old_block.count, new_block.count
+            )
+            cnt = np.asarray(cnt)
+            # worker-protocol counts (same shape as the classify counts
+            # dict), not a bench-record section
+            return dict(
+                zip(("inserts", "updates", "deletes"), (int(c) for c in cnt))
+            )
+
+        run()
+    else:
+        from kart_tpu.diff.device_batch import classify_blocks_batched
+        from kart_tpu.parallel.mesh import make_mesh
+
+        mesh = make_mesh(1)
+        # compile with the production batch shape before the clock starts: a
+        # tiny warm pair hits the same (S, B) fixed shapes as the real slice
+        warm_old, warm_new = _multichip_slice(0, 4096)
+        classify_blocks_batched(warm_old, warm_new, mesh=mesh, kernel="binsearch")
+
+        def run():
+            return classify_blocks_batched(
+                old_block, new_block, mesh=mesh, kernel="binsearch"
+            )[2]
+
+    print(
+        json.dumps({"ready": True, "probe_cached": bool(info.get("cached"))}),
+        flush=True,
+    )
+    sys.stdin.readline()  # go-barrier: all shards start together
+    t0 = time.perf_counter()
+    counts = run()
+    elapsed = time.perf_counter() - t0
+    print(json.dumps({"seconds": elapsed, "rows": hi - lo, "counts": counts}), flush=True)
+
+
+def _multichip_leg(n, n_dev, timeout_s, mode="batched"):
+    """-> (rows/s aggregate over the slowest shard, all-probes-cached flag,
+    counts-exact flag) for one device count, or (0, False, False) on any
+    worker failure/timeout."""
+    import subprocess
+    import sys
+
+    import select
+
+    cpus = (
+        sorted(os.sched_getaffinity(0)) if hasattr(os, "sched_getaffinity") else [0]
+    )
+    bounds = [n * i // n_dev for i in range(n_dev + 1)]
+    deadline = time.monotonic() + timeout_s
+    procs = []
+
+    def read_line_bounded(p):
+        """One worker line, or None at the leg deadline — a worker wedged
+        in compile/generate must not hang the bench past its watchdog."""
+        r, _, _ = select.select(
+            [p.stdout], [], [], max(deadline - time.monotonic(), 0)
+        )
+        return p.stdout.readline() if r else None
+
+    try:
+        for s in range(n_dev):
+            p = subprocess.Popen(
+                [
+                    sys.executable,
+                    os.path.abspath(__file__),
+                    "--multichip-worker",
+                    mode,
+                    str(bounds[s]),
+                    str(bounds[s + 1]),
+                    str(cpus[s % len(cpus)]),
+                ],
+                stdin=subprocess.PIPE,
+                stdout=subprocess.PIPE,
+                text=True,
+            )
+            procs.append(p)
+        ready = [json.loads(read_line_bounded(p) or "{}") for p in procs]
+        if not all(r.get("ready") for r in ready):
+            return 0, False, False
+        for p in procs:  # the barrier: every shard compiled + generated
+            p.stdin.write("go\n")
+            p.stdin.flush()
+        results = []
+        for p in procs:
+            p.wait(timeout=max(deadline - time.monotonic(), 1))
+            results.append(json.loads(p.stdout.readline() or "{}"))
+    except (subprocess.TimeoutExpired, ValueError, OSError):
+        return 0, False, False
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+                p.wait()
+            for stream in (p.stdin, p.stdout):
+                if stream:
+                    stream.close()
+    if not all("seconds" in r for r in results):
+        return 0, False, False
+    slowest = max(r["seconds"] for r in results)
+    updates = sum(r["counts"]["updates"] for r in results)
+    others = sum(r["counts"]["inserts"] + r["counts"]["deletes"] for r in results)
+    want_updates = len(range(7, n, CHANGE_STRIDE))
+    counts_exact = updates == want_updates and others == 0
+    cached = all(r.get("probe_cached") for r in ready)
+    return n / slowest, cached, counts_exact
+
+
+def _env_2proc_scaling(task_src, cpus):
+    """Measured environment ceiling: aggregate speedup of running ``task_src``
+    as 2 concurrent pinned processes vs 1 (2.0 = perfect, ~1.0 = the
+    resource is already saturated by one process)."""
+    import subprocess
+    import sys
+
+    def run(cpu_list):
+        procs = []
+        for cpu in cpu_list:
+            p = subprocess.Popen(
+                [sys.executable, "-c", task_src % cpu],
+                stdout=subprocess.PIPE,
+                text=True,
+            )
+            procs.append(p)
+        times = []
+        try:
+            for p in procs:
+                p.wait(timeout=120)
+                times.append(float(p.stdout.read().strip()))
+        finally:
+            for p in procs:
+                if p.poll() is None:
+                    p.kill()
+                    p.wait()
+                if p.stdout:
+                    p.stdout.close()
+        return max(times)
+
+    t1 = run(cpus[:1])
+    t2 = run((cpus * 2)[:2])
+    return round(2 * t1 / t2, 2) if t2 else 0.0
+
+
+_ALU_TASK = """
+import os, time
+try: os.sched_setaffinity(0, {%d})
+except Exception: pass
+import numpy as np
+a = np.arange(2_000_000, dtype=np.uint64)
+t0 = time.perf_counter()
+for _ in range(60):
+    a = a * np.uint64(2654435761) + np.uint64(12345)
+print(time.perf_counter() - t0)
+"""
+
+_MEMCPY_TASK = """
+import os, time
+try: os.sched_setaffinity(0, {%d})
+except Exception: pass
+import numpy as np
+a = np.random.default_rng(0).integers(0, 255, size=200_000_000, dtype=np.uint8)
+b = np.empty_like(a)
+t0 = time.perf_counter()
+for _ in range(10):
+    np.copyto(b, a)
+print(time.perf_counter() - t0)
+"""
+
+
+def multichip_main():
+    """Whole multichip bench: probe-verdict prewarm, the 1/2/4/8-device
+    scaling sweep, environment ceilings. Prints exactly one JSON record."""
+    import subprocess
+    import sys
+    import tempfile
+
+    n = int(os.environ.get("KART_BENCH_MULTICHIP_ROWS", 100_000_000))
+    timeout_s = int(os.environ.get("KART_BENCH_TIMEOUT", 2400))
+
+    cache = tempfile.NamedTemporaryFile(
+        prefix="kart_probe_", suffix=".json", delete=False
+    )
+    cache.close()
+    os.unlink(cache.name)
+    # scrub os.environ itself, not a copy: the leg workers are spawned with
+    # the inherited environment, and the pool var would re-register the
+    # accelerator PJRT plugin inside every worker
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    os.environ["KART_PROBE_CACHE"] = cache.name
+    os.environ.pop("PALLAS_AXON_POOL_IPS", None)
+    env = dict(os.environ)
+    # prewarm: one throwaway process pays the probe so every bench worker
+    # adopts the *persisted* verdict (the "cached choice, not a re-paid
+    # timeout" claim, measured rather than asserted)
+    prewarm = subprocess.run(
+        [
+            sys.executable,
+            "-c",
+            "from kart_tpu.runtime import insulate_virtual_cpu, probe_backend;"
+            "insulate_virtual_cpu(1); import sys;"
+            "sys.exit(0 if probe_backend()['ok'] else 3)",
+        ],
+        env=env,
+        timeout=600,
+    )
+
+    cpus = (
+        sorted(os.sched_getaffinity(0)) if hasattr(os, "sched_getaffinity") else [0]
+    )
+    # the 1-device leg runs what one device actually executes — the
+    # monolithic single-device jitted kernel (device_jax); the multi-device
+    # legs run what a mesh actually executes — the sharded record-batch
+    # loader (sharded_jax). The 1→2 step therefore contains both the
+    # fixed-shape-batching win and the parallel speedup; the batched-1dev
+    # key + the env ceilings below decompose the two honestly.
+    legs = [
+        (1, "mono", "multichip_classify_rows_per_sec_1dev"),
+        (1, "batched", "multichip_classify_rows_per_sec_1dev_batched"),
+        (2, "batched", "multichip_classify_rows_per_sec_2dev"),
+        (4, "batched", "multichip_classify_rows_per_sec_4dev"),
+        (8, "batched", "multichip_classify_rows_per_sec_8dev"),
+    ]
+    record = {
+        "n_devices": 8,
+        "ok": prewarm.returncode == 0,
+        "skipped": False,
+        "multichip_rows": n,
+        "multichip_kernel": "binsearch",
+        "multichip_host_cores": len(cpus),
+        "backend_probe_cached": 0,
+        "multichip_counts_exact": 1,
+    }
+    cached_all, exact_all = True, True
+    rates = {}
+    for n_dev, mode, key in legs:
+        rate, cached, exact = _multichip_leg(n, n_dev, timeout_s, mode)
+        rates[(n_dev, mode)] = rate
+        record[key] = round(rate)
+        cached_all &= cached
+        exact_all &= exact
+        record["backend_probe_cached"] = int(cached_all)
+        record["multichip_counts_exact"] = int(exact_all)
+        record["ok"] = record["ok"] and rate > 0
+        print(json.dumps(record), flush=True)  # salvage partial sweeps
+    if rates.get((1, "mono")):
+        one = rates[(1, "mono")]
+        record["multichip_scaling_1to2"] = round(rates[(2, "batched")] / one, 2)
+        record["multichip_scaling_1to4"] = round(rates[(4, "batched")] / one, 2)
+    record["multichip_env_alu_2proc_scaling"] = _env_2proc_scaling(_ALU_TASK, cpus)
+    record["multichip_env_memcpy_2proc_scaling"] = _env_2proc_scaling(
+        _MEMCPY_TASK, cpus
+    )
+    try:
+        os.unlink(cache.name)
+    except FileNotFoundError:
+        pass  # prewarm died before persisting a verdict; nothing to clean
+    print(json.dumps(record), flush=True)
+
+
 if __name__ == "__main__":
     import sys
 
-    if "--worker" in sys.argv:
+    if "--multichip-worker" in sys.argv:
+        multichip_worker()
+    elif "--multichip" in sys.argv:
+        multichip_main()
+    elif "--worker" in sys.argv:
         worker()
     else:
         main()

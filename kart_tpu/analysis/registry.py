@@ -38,8 +38,10 @@ ENV_VARS = {
     "KART_GC_GRACE": "source",
     # diff engine / kernels
     "KART_DIFF_ENGINE": "source",
+    "KART_DIFF_BACKEND": "source",
     "KART_DIFF_DEVICE": "source",
     "KART_DIFF_SHARDED": "source",
+    "KART_DEVICE_BATCH_ROWS": "source",
     "KART_DEVICE_MIN_ROWS": "source",
     "KART_SHARDED_MIN_ROWS": "source",
     "KART_STREAM_MIN_ROWS": "source",
@@ -62,6 +64,7 @@ ENV_VARS = {
     "KART_JAX_INIT_TIMEOUT": "source",
     "KART_JAX_REPROBE": "source",
     "KART_NO_XLA_CACHE": "source",
+    "KART_PROBE_CACHE": "source",
     "KART_INSULATE_CPU": "source",
     "KART_TESTS_ON_TPU": "tests",
     # native library
@@ -107,6 +110,7 @@ FAULT_POINTS = frozenset(
         "idx.write",
         "import.encode",
         "import.pack_stream",
+        "diff.device_transfer",
     }
 )
 

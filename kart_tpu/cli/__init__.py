@@ -124,14 +124,30 @@ class KartGroup(click.Group):
     help="Record a Chrome trace of this command (written on exit; "
     "KART_TRACE=<path> picks the file)",
 )
+@click.option(
+    "--reprobe",
+    "reprobe_flag",
+    is_flag=True,
+    help="Drop the persisted accelerator-probe verdict and probe afresh "
+    "(equivalent to KART_JAX_REPROBE=1; see docs/DEVICE.md)",
+)
 @click.pass_context
-def cli(ctx, repo_dir, verbose, trace_flag):
+def cli(ctx, repo_dir, verbose, trace_flag, reprobe_flag):
     """kart_tpu — TPU-native distributed version control for geospatial data."""
     from kart_tpu import telemetry
 
     ctx.obj = Context()
     if repo_dir:
         ctx.obj.repo_path = repo_dir
+    if reprobe_flag:
+        from kart_tpu import runtime
+
+        removed = runtime.invalidate_probe_cache()
+        # also re-key every probe this process makes, so the fresh verdict
+        # is a real probe even if some library path already consulted it
+        os.environ["KART_JAX_REPROBE"] = "1"
+        if removed:
+            click.echo(f"Dropped cached backend probe verdict ({removed})", err=True)
     # always configured (not only on -v): one kart_tpu logger, one format,
     # KART_LOG honoured for level — servers and library re-entry included
     telemetry.configure_logging(verbose)

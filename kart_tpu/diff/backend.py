@@ -1,0 +1,380 @@
+"""DiffBackend registry — one seam where the diff engine picks its
+execution layer (ISSUE 6 tentpole).
+
+Three backends with identical observable behaviour (bit-identical classes
+and counts, pinned by tests):
+
+* ``host_native`` — the C++ streaming merge-join (numpy twin beneath it).
+  Owns small blocks, CPU-only deployments and every fallback.
+* ``device_jax`` — the single-device jitted kernels with their own
+  monolithic/streamed routing (``ops.diff_kernel.classify_blocks``).
+* ``sharded_jax`` — the multi-device execution layer: KCOL blocks stream
+  through :mod:`kart_tpu.diff.device_batch` as fixed-shape record batches,
+  classified shard-local with ``shard_map`` over the ``features`` mesh
+  axis; the spatial prefilter and the estimation's sampled count ride the
+  same mesh (pmapped psum — only 3 scalars leave each device).
+
+Selection (:func:`select_backend`) is ``KART_DIFF_BACKEND`` when set
+(``host_native`` / ``device_jax`` / ``sharded_jax``), else the cost-model
+auto route: sharding when the mesh exists and the block pays for it,
+single-device when profitable, host otherwise. The probe verdict these
+decisions consult is the *persisted* one (kart_tpu.runtime), so a CPU
+fallback is a cached choice, not a re-paid timeout.
+
+Every device backend degrades to ``host_native`` on failure mid-call
+(device OOM, wedged tunnel, injected ``diff.device_transfer`` fault): the
+CLI must always complete, and a failed device attempt publishes nothing.
+"""
+
+import functools
+import logging
+import os
+
+import numpy as np
+
+from kart_tpu import telemetry as tm
+
+L = logging.getLogger("kart_tpu.diff.backend")
+
+BACKENDS = {}
+
+
+def _register(cls):
+    BACKENDS[cls.name] = cls()
+    return cls
+
+
+class DiffBackend:
+    """One diff execution layer. Subclasses override the device-capable
+    entry points; the base class is the host contract every backend must
+    degrade to."""
+
+    name = None
+
+    def classify(self, old_block, new_block):
+        """-> (old_class int8 (n_old,), new_class (n_new,), counts dict),
+        block-row order."""
+        raise NotImplementedError
+
+    def counts(self, old_block, new_block):
+        """Count-only classify (`-o feature-count`, estimation): backends
+        that can reduce on device override this to skip materialising
+        classes host-side."""
+        return self.classify(old_block, new_block)[2]
+
+    def sampled_counts(self, old_sub, new_sub):
+        """Counts of an estimation subsample (small blocks, called once)."""
+        return self.counts(old_sub, new_sub)
+
+    def envelope_hits(self, block, query):
+        """bool (count,) envelope-vs-query intersections for one sidecar
+        block — the spatial prefilter's scan. Base: the host path
+        (block-pruned native scan; KART_BLOCK_PRUNE=0 forces the full
+        branchless scan — bit-identical either way, fuzz-tested)."""
+        if block.count == 0:
+            return np.zeros(0, dtype=bool)
+        if (
+            block.env_blocks is not None
+            and os.environ.get("KART_BLOCK_PRUNE", "1") != "0"
+        ):
+            from kart_tpu.native import bbox_blocks_f32
+
+            agg, flags, block_rows = block.env_blocks
+            return bbox_blocks_f32(
+                block.envelopes, agg, flags, block_rows, query
+            )
+        from kart_tpu.native import bbox_intersects_f32
+
+        return bbox_intersects_f32(block.envelopes, query)
+
+
+@_register
+class HostNativeBackend(DiffBackend):
+    name = "host_native"
+
+    def classify(self, old_block, new_block):
+        from kart_tpu.ops.diff_kernel import classify_blocks_host
+
+        return classify_blocks_host(old_block, new_block)
+
+
+@_register
+class DeviceJaxBackend(DiffBackend):
+    """Single-device kernels; classify_blocks keeps its own cost-model
+    routing (monolithic vs streamed vs host) and host fallback."""
+
+    name = "device_jax"
+
+    def classify(self, old_block, new_block):
+        from kart_tpu.ops.diff_kernel import classify_blocks
+
+        return classify_blocks(old_block, new_block)
+
+
+@_register
+class ShardedJaxBackend(DiffBackend):
+    name = "sharded_jax"
+
+    def _fall_back(self, e, what):
+        tm.incr("diff.device.fallbacks", what=what)
+        L.warning(
+            "sharded device %s failed (%s: %s); using host_native",
+            what,
+            type(e).__name__,
+            e,
+        )
+        return BACKENDS["host_native"]
+
+    def classify(self, old_block, new_block):
+        from kart_tpu.diff.device_batch import classify_blocks_batched
+
+        try:
+            result = classify_blocks_batched(old_block, new_block)
+        except Exception as e:
+            # device OOM / wedged tunnel / injected transfer fault: nothing
+            # was published, so the host engine starts from clean state
+            return self._fall_back(e, "classify").classify(old_block, new_block)
+        from kart_tpu.parallel.sharded_diff import STATS
+
+        STATS["sharded_classify_calls"] += 1
+        return result
+
+    def counts(self, old_block, new_block):
+        # count-only rounds: the per-row class arrays stay on the devices,
+        # only the psum'd 3-vector comes home (`-o feature-count` at 100M
+        # would otherwise download + scatter ~200MB it immediately drops)
+        from kart_tpu.diff.device_batch import classify_blocks_batched
+
+        try:
+            _, _, counts = classify_blocks_batched(
+                old_block, new_block, counts_only=True
+            )
+        except Exception as e:
+            return self._fall_back(e, "counts").counts(old_block, new_block)
+        from kart_tpu.parallel.sharded_diff import STATS
+
+        STATS["sharded_classify_calls"] += 1
+        return counts
+
+    def sampled_counts(self, old_sub, new_sub):
+        try:
+            counts = sampled_counts_pmapped(old_sub, new_sub)
+        except Exception as e:
+            return self._fall_back(e, "sampled_counts").counts(old_sub, new_sub)
+        from kart_tpu.parallel.sharded_diff import STATS
+
+        STATS["sharded_classify_calls"] += 1
+        return counts
+
+    def envelope_hits(self, block, query):
+        q = np.asarray(query, dtype=np.float64)
+        if (
+            block.envelopes is None
+            or q[2] < q[0]  # wrapping query rect: host path owns the cyclic math
+            or not _device_envelopes_worthwhile(block.count)
+        ):
+            return super().envelope_hits(block, query)
+        try:
+            return sharded_envelope_hits(block.envelopes, block.count, q)
+        except Exception as e:
+            return self._fall_back(e, "envelope_hits").envelope_hits(block, query)
+
+
+def _device_envelopes_worthwhile(n):
+    from kart_tpu.ops.bbox import DEVICE_MIN_ENVELOPES
+    from kart_tpu.runtime import jax_ready
+
+    return n >= DEVICE_MIN_ENVELOPES and jax_ready()
+
+
+def select_backend(n_rows):
+    """The backend the production diff path runs ``n_rows`` through.
+
+    ``KART_DIFF_BACKEND`` picks by name (unknown names warn and fall back
+    to auto, malformed config must never kill the CLI). Auto is the cost
+    model, cheapest test first — the row-count gates run before any jax
+    import, so a small diff stays instant with a wedged accelerator."""
+    mode = os.environ.get("KART_DIFF_BACKEND", "auto")
+    if mode != "auto":
+        backend = BACKENDS.get(mode)
+        if backend is not None:
+            return backend
+        L.warning(
+            "unknown KART_DIFF_BACKEND=%r (have: %s); using auto routing",
+            mode,
+            ", ".join(sorted(BACKENDS)),
+        )
+    from kart_tpu.ops.diff_kernel import device_profitable
+    from kart_tpu.parallel.sharded_diff import should_shard
+
+    if should_shard(n_rows):
+        return BACKENDS["sharded_jax"]
+    if device_profitable(n_rows):
+        return BACKENDS["device_jax"]
+    return BACKENDS["host_native"]
+
+
+def warm_probe(n_rows):
+    """Kick the async backend probe as soon as a diff *might* route to a
+    device — init overlaps the remaining sidecar loads / prefilter instead
+    of serialising after them. Row-gated so small diffs never pay the
+    background jax import, and env-gated exactly like the routing it warms
+    for: a configuration that disabled every device path (e.g. a known
+    wedged tunnel) must never touch jax at all."""
+    mode = os.environ.get("KART_DIFF_BACKEND", "auto")
+    if mode == "host_native":
+        return
+    if (
+        mode == "auto"
+        and os.environ.get("KART_DIFF_DEVICE") == "0"
+        and os.environ.get("KART_DIFF_SHARDED") == "0"
+    ):
+        return  # auto routing can only ever pick host_native
+    from kart_tpu.ops.diff_kernel import DEVICE_MIN_ROWS
+    from kart_tpu.parallel.sharded_diff import _sharded_min_rows
+
+    if n_rows >= min(DEVICE_MIN_ROWS, _sharded_min_rows()):
+        from kart_tpu.runtime import probe_backend_async
+
+        probe_backend_async()
+
+
+# --- sharded bbox prefilter kernel ------------------------------------------
+
+def _query_f32_thresholds(query_f64):
+    """Exact f64-equivalent f32 thresholds, mirroring the native scan
+    (native/spatial_filter.cpp make_query_f32): comparing a float x against
+    a double bound b satisfies (double)x <= b <=> x <= largest_float_le(b),
+    and symmetrically for >=. Keeps the device scan bit-identical to the
+    host engine's branchless f32 pass."""
+    q = np.asarray(query_f64, dtype=np.float64)
+    f = q.astype(np.float32)
+    back = f.astype(np.float64)
+    ge = np.where(back < q, np.nextafter(f, np.float32(np.inf)), f)
+    le = np.where(back > q, np.nextafter(f, np.float32(-np.inf)), f)
+    # (qw_ge, qs_ge, qe_le, qn_le)
+    return np.asarray([ge[0], ge[1], le[2], le[3]], dtype=np.float32)
+
+
+def _bbox_hits_f32_step(w, s, e, n, q):
+    """Branchless f32 envelope scan (non-wrapping query), the shard-local
+    body: same predicate as native scan_rows_f32."""
+    lat = (s <= q[3]) & (q[1] <= n)
+    a = w <= q[2]
+    b = q[0] <= e
+    wrap = e < w
+    return lat & ((a & b) | (wrap & (a | b)))
+
+
+@functools.lru_cache(maxsize=8)
+def _make_sharded_bbox(mesh):
+    import jax
+
+    from jax.sharding import PartitionSpec as P
+
+    from kart_tpu.diff.device_batch import _shard_map
+    from kart_tpu.parallel.mesh import FEATURES_AXIS
+
+    def _step(w, s, e, n, q):
+        return _bbox_hits_f32_step(w[0], s[0], e[0], n[0], q)[None]
+
+    spec = P(FEATURES_AXIS)
+    fn = _shard_map()(
+        _step, mesh=mesh, in_specs=(spec,) * 4 + (P(),), out_specs=spec
+    )
+    return jax.jit(fn)
+
+
+def sharded_envelope_hits(envelopes, count, query_f64):
+    """(count, 4) f32 envelopes + non-wrapping f64 query rect -> bool
+    (count,) hits, computed shard-local over the feature axis (no
+    cross-device traffic at all — the out spec keeps hits sharded and the
+    host reassembles). Padding rows scan at latitude 91: never a hit."""
+    import jax
+
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from kart_tpu.ops.blocks import bucket_size
+    from kart_tpu.parallel.mesh import FEATURES_AXIS, make_mesh
+
+    mesh = make_mesh()
+    n_shards = int(mesh.devices.size)
+    per = bucket_size(max(-(-count // n_shards), 1))
+    cols = np.full((4, n_shards * per), 91.0, dtype=np.float32)
+    if count:
+        cols[:, :count] = np.asarray(envelopes[:count], dtype=np.float32).T
+    q = _query_f32_thresholds(query_f64)
+    fn = _make_sharded_bbox(mesh)
+    sharding = NamedSharding(mesh, P(FEATURES_AXIS))
+    with tm.span("diff.device.transfer", rows=int(count)):
+        args = [
+            jax.device_put(c.reshape(n_shards, per), sharding) for c in cols
+        ]
+    hits = fn(*args, jax.device_put(q))
+    return np.asarray(hits).reshape(-1)[:count]
+
+
+# --- pmapped sampled-count reduction ----------------------------------------
+
+@functools.lru_cache(maxsize=8)
+def _make_pmapped_counts(n_dev, kernel):
+    import jax
+
+    from kart_tpu.ops.diff_kernel import (
+        _classify_binsearch_core,
+        _classify_mergesort_core,
+    )
+
+    core = (
+        _classify_binsearch_core if kernel == "binsearch" else _classify_mergesort_core
+    )
+
+    def _step(ok, oo, nk, no, oc, nc):
+        _, _, _, counts = core(ok, oo, nk, no, oc, nc)
+        return jax.lax.psum(counts, "devices")
+
+    jax.config.update("jax_enable_x64", True)  # int64 keys / PAD_KEY
+    return jax.pmap(_step, axis_name="devices")
+
+
+def sampled_counts_pmapped(old_block, new_block):
+    """Estimation's sampled count as a pmapped reduction: each device
+    classifies its contiguous key-range slice of the subsample and only the
+    psum'd 3-vector comes home — the SURVEY §2.3 slot, now on the real
+    mesh. -> counts dict, identical to the host classify (the slices are
+    key-aligned, so shard-local joins equal the global join)."""
+    import jax
+
+    from kart_tpu.diff.device_batch import (
+        batch_splits,
+        default_kernel,
+        pack_round,
+    )
+    from kart_tpu.ops.blocks import bucket_size
+    from kart_tpu.runtime import default_backend
+
+    n_dev = jax.local_device_count()
+    n_old, n_new = old_block.count, new_block.count
+    old_keys = np.asarray(old_block.keys[:n_old])
+    new_keys = np.asarray(new_block.keys[:n_new])
+    # capacity that yields <= n_dev key-aligned chunks (grow until it fits;
+    # terminates because one chunk always suffices at max side length)
+    cap = max(-(-max(n_old, n_new, 1) // n_dev), 1)
+    while True:
+        (old_splits, new_splits), n_chunks = batch_splits(
+            (old_keys, new_keys), cap
+        )
+        if n_chunks <= n_dev:
+            break
+        cap *= 2
+    bucket = bucket_size(cap)
+    ok, oo, oc = pack_round(old_keys, old_block.oids, old_splits, 0, n_dev, bucket)
+    nk, no, nc = pack_round(new_keys, new_block.oids, new_splits, 0, n_dev, bucket)
+    fn = _make_pmapped_counts(n_dev, default_kernel(default_backend()))
+    with tm.span("diff.device.classify", rows=int(max(n_old, n_new)), shards=n_dev):
+        counts = np.asarray(fn(ok, oo, nk, no, oc, nc))[0]
+    return {
+        "inserts": int(counts[0]),
+        "updates": int(counts[1]),
+        "deletes": int(counts[2]),
+    }

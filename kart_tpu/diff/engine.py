@@ -184,17 +184,15 @@ def get_feature_diff_columnar(base_ds, target_ds, ds_filter=None, *, blocks=None
         # the exact tree-diff path
         return get_feature_diff(base_ds, target_ds, ds_filter)
 
-    from kart_tpu.parallel.sharded_diff import classify_blocks_sharded, should_shard
+    from kart_tpu.diff.backend import select_backend
 
+    backend = select_backend(max(old_block.count, new_block.count))
     with tm.span(
-        "diff.classify", rows=max(old_block.count, new_block.count)
+        "diff.classify",
+        rows=max(old_block.count, new_block.count),
+        backend=backend.name,
     ):
-        if should_shard(max(old_block.count, new_block.count)):
-            # >1 device: shard-local classify over the mesh (block-cyclic
-            # PK partition; only the count vector crosses ICI)
-            old_class, new_class, _ = classify_blocks_sharded(old_block, new_block)
-        else:
-            old_class, new_class, _ = classify_blocks(old_block, new_block)
+        old_class, new_class, _ = backend.classify(old_block, new_block)
         old_idx, new_idx = changed_indices(old_class, new_class)
 
     # Cross-version collision guard (hash-encoded datasets): a deleted pk X
@@ -268,24 +266,18 @@ def get_feature_diff_columnar(base_ds, target_ds, ds_filter=None, *, blocks=None
 
 
 def _envelope_hits(block, query):
-    """bool (count,) envelope-vs-query intersections for one sidecar block.
-    Blocks with aggregate records take the block-pruned scan (all-out
-    blocks' envelope pages are never read — filter-refine before the fine
-    scan); pre-aggregate sidecars fall back to the full branchless f32
-    residue scan. KART_BLOCK_PRUNE=0 forces the full scan (tests, bench
-    comparison) — results are bit-identical either way (fuzz-tested)."""
-    import os
+    """bool (count,) envelope-vs-query intersections for one sidecar block,
+    routed through the selected diff backend: host blocks take the
+    block-pruned native scan (all-out blocks' envelope pages are never
+    read; KART_BLOCK_PRUNE=0 forces the full scan), big blocks on a live
+    mesh take the shard_map f32 scan — results are bit-identical on every
+    route (fuzz-tested; the device kernel mirrors the native thresholds
+    exactly)."""
+    from kart_tpu.diff.backend import select_backend
 
     if block.count == 0:
         return np.zeros(0, dtype=bool)
-    if block.env_blocks is not None and os.environ.get("KART_BLOCK_PRUNE", "1") != "0":
-        from kart_tpu.native import bbox_blocks_f32
-
-        agg, flags, block_rows = block.env_blocks
-        return bbox_blocks_f32(block.envelopes, agg, flags, block_rows, query)
-    from kart_tpu.native import bbox_intersects_f32
-
-    return bbox_intersects_f32(block.envelopes, query)
+    return select_backend(block.count).envelope_hits(block, query)
 
 
 def spatial_prefilter_blocks(old_block, new_block, rect_wsen):
@@ -414,6 +406,12 @@ def _feature_diff_routed(base_ds, target_ds, ds_filter=None, spatial_filter_spec
             # device kernel pads lazily inside classify_blocks — at 100M the
             # two eager padded copies were ~5.6GB of memcpy before any work
             old_block = sidecar.ensure_block(repo, base_ds, pad=False)
+            if old_block is not None:
+                # big diff plausible: overlap the (async) backend probe
+                # with the second sidecar load and the prefilter
+                from kart_tpu.diff.backend import warm_probe
+
+                warm_probe(old_block.count)
             new_block = sidecar.ensure_block(repo, target_ds, pad=False)
             if old_block is not None and new_block is not None:
                 rect = _prefilter_rect(spatial_filter_spec)
@@ -478,6 +476,10 @@ def get_dataset_feature_count_fast(
     # kernel pads lazily inside classify_blocks (at 100M the two padded
     # copies were ~5.6GB of memcpy before any classification work)
     old_block = sidecar.load_block(repo, base_ds, pad=False)
+    if old_block is not None:
+        from kart_tpu.diff.backend import warm_probe
+
+        warm_probe(old_block.count)
     new_block = sidecar.load_block(repo, target_ds, pad=False)
     if old_block is None or new_block is None:
         return None
@@ -488,16 +490,15 @@ def get_dataset_feature_count_fast(
             return None  # no envelope columns: delta path applies the filter
         old_block, new_block = filtered
 
-    from kart_tpu.ops.diff_kernel import classify_blocks
-    from kart_tpu.parallel.sharded_diff import classify_blocks_sharded, should_shard
+    from kart_tpu.diff.backend import select_backend
 
+    backend = select_backend(max(old_block.count, new_block.count))
     with tm.span(
-        "diff.classify", rows=max(old_block.count, new_block.count)
+        "diff.classify",
+        rows=max(old_block.count, new_block.count),
+        backend=backend.name,
     ):
-        if should_shard(max(old_block.count, new_block.count)):
-            _, _, counts = classify_blocks_sharded(old_block, new_block)
-        else:
-            _, _, counts = classify_blocks(old_block, new_block)
+        counts = backend.counts(old_block, new_block)
     return counts["inserts"] + counts["updates"] + counts["deletes"]
 
 
@@ -547,16 +548,16 @@ def get_feature_diff_rows(base_rs, target_rs, ds_path):
     if old_block is None or new_block is None:
         return None
 
-    from kart_tpu.ops.diff_kernel import changed_indices, classify_blocks
-    from kart_tpu.parallel.sharded_diff import classify_blocks_sharded, should_shard
+    from kart_tpu.diff.backend import select_backend
+    from kart_tpu.ops.diff_kernel import changed_indices
 
+    backend = select_backend(max(old_block.count, new_block.count))
     with tm.span(
-        "diff.classify", rows=max(old_block.count, new_block.count)
+        "diff.classify",
+        rows=max(old_block.count, new_block.count),
+        backend=backend.name,
     ):
-        if should_shard(max(old_block.count, new_block.count)):
-            old_class, new_class, _ = classify_blocks_sharded(old_block, new_block)
-        else:
-            old_class, new_class, _ = classify_blocks(old_block, new_block)
+        old_class, new_class, _ = backend.classify(old_block, new_block)
         old_idx, new_idx = changed_indices(old_class, new_class)
     okeys = np.asarray(old_block.keys[old_idx])
     nkeys = np.asarray(new_block.keys[new_idx])

@@ -177,13 +177,14 @@ def estimate_counts_from_blocks(old_block, new_block, accuracy):
     old_sub = subsample(old_block)
     new_sub = subsample(new_block)
 
-    from kart_tpu.ops.diff_kernel import classify_blocks
-    from kart_tpu.parallel.sharded_diff import classify_blocks_sharded, should_shard
+    # backend seam: on the sharded backend the sampled count runs as a
+    # pmapped psum reduction — each device classifies its key-range slice
+    # of the subsample and only the 3-scalar count vector comes home
+    from kart_tpu.diff.backend import select_backend
 
-    if should_shard(max(old_sub.count, new_sub.count)):
-        _, _, counts = classify_blocks_sharded(old_sub, new_sub)
-    else:
-        _, _, counts = classify_blocks(old_sub, new_sub)
+    counts = select_backend(max(old_sub.count, new_sub.count)).sampled_counts(
+        old_sub, new_sub
+    )
     total = counts["inserts"] + counts["updates"] + counts["deletes"]
     if k == SAMPLE_PARTITIONS:
         return total  # sampled everything: exact

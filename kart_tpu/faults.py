@@ -25,6 +25,8 @@ Registered points:
     idx.write               write_pack_index entry (idx serialise/rename)
     import.encode           every producer batch of the pipelined import
     import.pack_stream      every pack-write batch of the pipelined import
+    diff.device_transfer    every host->device round of the sharded diff
+                            backend's batch loader (fallback: host-native)
 
 Disabled (``KART_FAULTS`` unset) the fast path is a single environ dict
 lookup with no allocation: frame-boundary loops additionally hoist

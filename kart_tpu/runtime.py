@@ -17,13 +17,39 @@ absorbs:
    CPU host platform (for tests and the driver's multichip dry-run).
 3. **Slow first compile.** Callers that only need a yes/no (``jax_ready``)
    get a cached answer; the probe runs once per process.
+4. **Re-paying the probe every process.** A wedged tunnel used to cost every
+   fresh ``kart`` invocation (and every bench worker) the full init timeout
+   before the CPU fallback kicked in — BENCH_r05's headline numbers all ran
+   behind a 180 s probe failure. The verdict is now *persisted* to a
+   per-user cache file keyed by (jax version, platform selection, machine
+   signature, timeout): the first process pays the probe, every later one
+   reads the verdict in microseconds, and ``backend: cpu`` becomes a cached
+   choice. ``kart --reprobe`` / ``KART_JAX_REPROBE=1`` invalidate it.
+5. **Cross-machine XLA AOT poisoning.** The persistent XLA compilation
+   cache is scoped by a machine signature (arch + cpuinfo flags digest):
+   MULTICHIP_r05 logged "Compile machine features … doesn't match … could
+   lead to SIGILL" when an AOT result built on one host was loaded on
+   another sharing the cache directory. Each machine now writes to its own
+   subdirectory, so a cache can never hand a foreign host illegal code.
+
+Init is *lazy and asynchronous*: :func:`probe_backend_async` starts the PJRT
+init thread without blocking (callers kick it off as soon as a large diff is
+plausible, overlapping init with sidecar loads); :func:`probe_backend` joins
+that same thread with whatever budget remains.
 
 Env knobs:
     KART_NO_JAX=1             — skip jax entirely, always numpy
     KART_JAX_INIT_TIMEOUT=<s> — probe timeout (default 75 s; first PJRT init
                                 through a tunnel is slow but not minutes)
+    KART_JAX_REPROBE=1        — ignore + rewrite the persisted probe verdict
+                                (``0`` keeps its historical meaning for the
+                                bench: skip the slow-vs-wedged reprobe wait)
+    KART_PROBE_CACHE=<path|0> — verdict cache file override; 0 disables
+                                persistence (tests default to 0 for
+                                hermeticity)
 """
 
+import json
 import logging
 import os
 import threading
@@ -46,6 +72,117 @@ def _failure(error, init_seconds=0.0):
         "init_seconds": round(init_seconds, 3),
         "error": error,
     }
+
+
+def machine_signature():
+    """Short stable digest of this machine's execution target (arch + CPU
+    feature flags). Scopes every persisted compilation/probe artefact: an
+    XLA:CPU AOT result compiled for one host's AVX-512 feature set SIGILLs
+    a host without them (observed in MULTICHIP_r05), so nothing compiled
+    here may ever be keyed in a way another machine could load."""
+    import hashlib
+    import platform
+
+    bits = [platform.machine() or "unknown-arch"]
+    try:
+        with open("/proc/cpuinfo") as f:
+            for line in f:
+                if line.startswith(("flags", "Features")):
+                    bits.append(" ".join(sorted(line.split(":", 1)[1].split())))
+                    break
+    except OSError:
+        bits.append(platform.processor() or "")
+    return hashlib.sha256("|".join(bits).encode()).hexdigest()[:12]
+
+
+# --- persisted probe verdict -------------------------------------------------
+
+def _probe_cache_path():
+    """Verdict cache file, or None when persistence is disabled."""
+    override = os.environ.get("KART_PROBE_CACHE")
+    if override == "0":
+        return None
+    if override:
+        return override
+    return os.path.join(
+        os.path.expanduser("~"), ".cache", "kart_tpu", "backend_probe.json"
+    )
+
+
+def _probe_cache_key(timeout):
+    """Cache key: anything that can change the verdict re-keys it — jax
+    version (read from package metadata, NOT by importing jax: the import
+    must stay off the cached fast path), the platform selection, the machine
+    signature, and the probe budget (a 75 s timeout failure says nothing
+    about a 300 s budget)."""
+    try:
+        from importlib import metadata
+
+        ver = metadata.version("jax")
+    except Exception:  # kart: noqa(KTL006): metadata backends vary; an unknown version only weakens cache reuse, never correctness
+        ver = "unknown"
+    return "|".join(
+        (
+            f"jax={ver}",
+            f"platforms={os.environ.get('JAX_PLATFORMS', '')}",
+            f"machine={machine_signature()}",
+            f"timeout={timeout:g}",
+        )
+    )
+
+
+def _load_cached_verdict(key):
+    path = _probe_cache_path()
+    if path is None or os.environ.get("KART_JAX_REPROBE") == "1":
+        return None
+    try:
+        with open(path) as f:
+            entry = json.load(f).get(key)
+    except (OSError, ValueError):
+        return None
+    if not isinstance(entry, dict) or "ok" not in entry:
+        return None
+    entry["cached"] = True
+    return entry
+
+
+def _store_verdict(key, verdict):
+    """Merge one verdict into the cache file (atomic tmp+rename; per-user
+    file, so last-writer-wins merge races only lose a redundant probe)."""
+    path = _probe_cache_path()
+    if path is None:
+        return
+    try:
+        os.makedirs(os.path.dirname(path), exist_ok=True)
+        try:
+            with open(path) as f:
+                entries = json.load(f)
+            if not isinstance(entries, dict):
+                entries = {}
+        except (OSError, ValueError):
+            entries = {}
+        entry = {k: v for k, v in verdict.items() if k != "cached"}
+        entry["probed_at"] = time.time()
+        entries[key] = entry
+        tmp = f"{path}.tmp{os.getpid()}"
+        with open(tmp, "w") as f:
+            json.dump(entries, f, indent=1)
+        os.replace(tmp, path)
+    except OSError as e:
+        L.debug("probe verdict not persisted: %s", e)
+
+
+def invalidate_probe_cache():
+    """Drop every persisted verdict (``kart --reprobe``). -> the removed
+    path, or None when nothing was persisted."""
+    path = _probe_cache_path()
+    if path is None:
+        return None
+    try:
+        os.remove(path)
+        return path
+    except OSError:
+        return None
 
 
 def insulate_virtual_cpu(n_devices=8):
@@ -88,13 +225,22 @@ def insulate_virtual_cpu(n_devices=8):
 def _enable_persistent_cache(jax):
     """Persistent XLA compilation cache: a fresh `kart diff` process reuses
     kernels compiled by any earlier invocation instead of paying the
-    ~20-40s TPU compile every time (KART_NO_XLA_CACHE=1 disables)."""
+    ~20-40s TPU compile every time (KART_NO_XLA_CACHE=1 disables).
+
+    The directory is scoped per *machine signature* — XLA:CPU AOT results
+    encode the compile host's CPU feature set, and loading one compiled for
+    a different host is at best a warning storm and at worst SIGILL
+    (MULTICHIP_r05 hit exactly that through a shared cache directory). A
+    user-pinned JAX_COMPILATION_CACHE_DIR is honoured but still gets the
+    per-machine subdirectory, so sharing the *parent* across hosts stays
+    safe."""
     if os.environ.get("KART_NO_XLA_CACHE") == "1":
         return
     try:
-        cache_dir = os.environ.get("JAX_COMPILATION_CACHE_DIR") or os.path.join(
+        base = os.environ.get("JAX_COMPILATION_CACHE_DIR") or os.path.join(
             os.path.expanduser("~"), ".cache", "kart_tpu", "xla_cache"
         )
+        cache_dir = os.path.join(base, f"machine-{machine_signature()}")
         os.makedirs(cache_dir, exist_ok=True)
         jax.config.update("jax_compilation_cache_dir", cache_dir)
         jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
@@ -102,65 +248,126 @@ def _enable_persistent_cache(jax):
         L.debug("persistent compilation cache unavailable: %s", e)
 
 
-def probe_backend(timeout=None):
-    """Initialise the jax backend under a watchdog. Returns a provenance dict:
+def _resolve_timeout(timeout):
+    if timeout is not None:
+        return float(timeout)
+    try:
+        return float(os.environ.get("KART_JAX_INIT_TIMEOUT", 75))
+    except ValueError:
+        L.warning(
+            "ignoring malformed KART_JAX_INIT_TIMEOUT=%r",
+            os.environ["KART_JAX_INIT_TIMEOUT"],
+        )
+        return 75.0
+
+
+def _init_into(box):
+    """The backend init body; runs on the probe daemon thread."""
+    t0 = time.perf_counter()
+    try:
+        import jax
+
+        _enable_persistent_cache(jax)
+        devices = jax.devices()
+        box["result"] = {
+            "ok": True,
+            "backend": jax.default_backend(),
+            "device_kind": devices[0].device_kind if devices else None,
+            "n_devices": len(devices),
+            "init_seconds": round(time.perf_counter() - t0, 3),
+            "error": None,
+        }
+    except Exception as e:  # pragma: no cover - env-dependent
+        box["result"] = _failure(
+            f"{type(e).__name__}: {e}", time.perf_counter() - t0
+        )
+
+
+def _ensure_init_started_locked():
+    """Start the (single) init thread if none is running; caller holds the
+    lock. PJRT init is process-global — a second thread would only block on
+    the first one's lock, so there is never more than one."""
+    global _probe_thread, _probe_box
+    if _probe_thread is None:
+        box = {}
+        t = threading.Thread(
+            target=_init_into, args=(box,), daemon=True, name="kart-jax-probe"
+        )
+        t.start()
+        _probe_thread, _probe_box = t, box
+    return _probe_thread, _probe_box
+
+
+def probe_backend_async():
+    """Kick the backend init in the background and return immediately.
+
+    The lazy-init hook for hot paths: the diff engine calls this the moment
+    a columnar diff looks big enough to want a device, so PJRT init overlaps
+    the sidecar mmap loads instead of serialising after them. A later
+    :func:`probe_backend` joins the same thread with whatever budget
+    remains. No-op once a verdict exists (init after a settled failure
+    would just re-wedge)."""
+    if os.environ.get("KART_NO_JAX") == "1":
+        return
+    with _probe_lock:
+        if _probe_result is not None:
+            return
+        _ensure_init_started_locked()
+
+
+def probe_backend(timeout=None, _ignore_cache=False):
+    """The jax backend verdict. Returns a provenance dict:
 
         {"ok": bool, "backend": str|None, "device_kind": str|None,
-         "n_devices": int, "init_seconds": float, "error": str|None}
+         "n_devices": int, "init_seconds": float, "error": str|None
+         [, "cached": True]}
 
-    Cached after the first call. On timeout the daemon thread is abandoned
-    but kept referenced: :func:`reprobe` can re-join it with a bigger budget
-    (PJRT init is process-global, so a *second* init thread would only block
-    on the first one's lock — waiting longer on the original thread is the
-    only meaningful retry inside one process)."""
+    Resolution order, cheapest first:
+
+    1. the in-process verdict (set once, instant afterwards);
+    2. the *persisted* verdict from the per-user cache file — a fallback
+       decision some earlier process already paid the timeout for costs
+       this one microseconds ("cached": True marks it). A cached-ok
+       verdict additionally kicks the real init off in the background so
+       the backend is warm by the time a kernel wants it;
+    3. a real probe: join the init thread (started here, or earlier by
+       :func:`probe_backend_async`) under the watchdog budget, then
+       persist whatever verdict came out.
+
+    On timeout the daemon thread is abandoned but kept referenced:
+    :func:`reprobe` can re-join it with a bigger budget."""
     global _probe_result, _probe_thread, _probe_box
+    from kart_tpu import telemetry as tm
+
     with _probe_lock:
         if _probe_result is not None:
             return _probe_result
         if os.environ.get("KART_NO_JAX") == "1":
             _probe_result = _failure("KART_NO_JAX=1")
             return _probe_result
+        timeout = _resolve_timeout(timeout)
+        key = _probe_cache_key(timeout)
+        cached = None if _ignore_cache else _load_cached_verdict(key)
+        if cached is not None:
+            _probe_result = cached
+            if cached["ok"]:
+                # warm the real init behind the cached verdict: routing can
+                # decide now, the first kernel finds the backend ready
+                _ensure_init_started_locked()
+            tm.gauge_set("runtime.backend_ok", int(cached["ok"]))
+            tm.gauge_set("runtime.backend_probe_cached", 1)
+            return _probe_result
+        t, box = _ensure_init_started_locked()
 
-        if timeout is None:
-            try:
-                timeout = float(os.environ.get("KART_JAX_INIT_TIMEOUT", 75))
-            except ValueError:
-                L.warning(
-                    "ignoring malformed KART_JAX_INIT_TIMEOUT=%r",
-                    os.environ["KART_JAX_INIT_TIMEOUT"],
-                )
-                timeout = 75.0
-
-        box = {}
-
-        def _init():
-            try:
-                t0 = time.perf_counter()
-                import jax
-
-                _enable_persistent_cache(jax)
-                devices = jax.devices()
-                box["result"] = {
-                    "ok": True,
-                    "backend": jax.default_backend(),
-                    "device_kind": devices[0].device_kind if devices else None,
-                    "n_devices": len(devices),
-                    "init_seconds": round(time.perf_counter() - t0, 3),
-                    "error": None,
-                }
-            except Exception as e:  # pragma: no cover - env-dependent
-                box["result"] = _failure(
-                    f"{type(e).__name__}: {e}", time.perf_counter() - t0
-                )
-
-        from kart_tpu import telemetry as tm
-
-        t = threading.Thread(target=_init, daemon=True, name="kart-jax-probe")
-        with tm.span("runtime.probe_backend", timeout=timeout):
-            t.start()
-            t.join(timeout)
+    with tm.span("runtime.probe_backend", timeout=timeout):
+        t.join(timeout)
+    with _probe_lock:
+        if _probe_result is not None:
+            return _probe_result  # raced: another caller settled it
         if "result" in box:
             _probe_result = box["result"]
+            _probe_thread = None  # thread finished; nothing to re-join
+            _probe_box = None
         else:
             L.warning(
                 "jax backend init did not complete within %.0fs; "
@@ -171,9 +378,9 @@ def probe_backend(timeout=None):
             _probe_result = _failure(
                 f"backend init timed out after {timeout}s", timeout
             )
-            _probe_thread = t
-            _probe_box = box
+        _store_verdict(key, _probe_result)
         tm.gauge_set("runtime.backend_ok", int(_probe_result["ok"]))
+        tm.gauge_set("runtime.backend_probe_cached", 0)
         tm.gauge_set(
             "runtime.backend_init_seconds", _probe_result["init_seconds"]
         )
@@ -186,13 +393,25 @@ def reprobe(extra_timeout):
     than an interactive CLI). Distinguishes *slow* init (the thread finishes
     during the extra wait — adopt its result) from a genuinely *wedged*
     tunnel (still stuck; the failure record is updated with the total wait).
+    A failure verdict adopted from the *persisted cache* has no abandoned
+    thread to re-join: reprobe drops it and runs a real probe with the
+    extra budget instead (the caller is explicitly asking to re-pay).
+
     Returns the current provenance dict; a no-op unless the cached probe
     result is a timeout failure."""
-    global _probe_result
+    global _probe_result, _probe_thread, _probe_box
+    repay_cached = False
     with _probe_lock:
         result, t, box = _probe_result, _probe_thread, _probe_box
+        if result is not None and not result["ok"] and t is None and result.get("cached"):
+            _probe_result = None  # cached fallback: re-pay the real probe
+            result = None
+            # bypass the cache file too: with extra_timeout equal to the
+            # configured timeout the lookup key matches and probe_backend
+            # would instantly re-adopt the very verdict we just dropped
+            repay_cached = True
     if result is None:
-        return probe_backend(extra_timeout)
+        return probe_backend(extra_timeout, _ignore_cache=repay_cached)
     if result["ok"] or t is None:
         return result
     t0 = time.perf_counter()
@@ -223,6 +442,9 @@ def reprobe(extra_timeout):
             _probe_result = _failure(
                 f"backend init wedged (no return after {total:.0f}s)", total
             )
+        # the slow-vs-wedged outcome supersedes the timed-out verdict for
+        # every later process too
+        _store_verdict(_probe_cache_key(_resolve_timeout(None)), _probe_result)
         return _probe_result
 
 
@@ -291,8 +513,55 @@ class Watchdog:
 
 def jax_ready():
     """True when a jax backend is initialised and usable. First call may
-    block up to the probe timeout; later calls are instant."""
-    return probe_backend()["ok"]
+    block up to the probe timeout; later calls are instant.
+
+    This is the gate every device-routing decision runs behind, so it must
+    never say yes on a *promise*: a cached-ok verdict from the persisted
+    probe file proves some earlier process initialised fine, not that this
+    one can — a tunnel that wedged since the verdict was written would
+    otherwise hang the first real ``jax.devices()`` call with no watchdog.
+    A cached ok therefore joins the warm-started init thread under the
+    watchdog budget and adopts its *real* outcome (usually instant: the
+    init overlapped the sidecar loads). A stale ok — init now failing or
+    wedged — flips the answer to False and rewrites the persisted verdict,
+    so the cache self-heals for every later process too."""
+    global _probe_result, _probe_thread, _probe_box
+    info = probe_backend()
+    if not info["ok"]:
+        return False
+    if not info.get("cached"):
+        return True  # the real in-process init completed
+    with _probe_lock:
+        t, box = _probe_thread, _probe_box
+    if t is None:
+        return _probe_result["ok"]  # already confirmed (or healed)
+    timeout = _resolve_timeout(None)
+    t.join(timeout)
+    with _probe_lock:
+        if _probe_thread is not t:
+            return _probe_result is not None and _probe_result["ok"]
+        if box is not None and "result" in box:
+            result = box["result"]
+            _probe_thread = None
+            _probe_box = None
+        else:
+            L.warning(
+                "jax backend init wedged behind a cached-ok verdict "
+                "(no return after %.0fs); using the host path and "
+                "rewriting the persisted verdict",
+                timeout,
+            )
+            result = _failure(
+                f"backend init wedged behind cached verdict after {timeout}s",
+                timeout,
+            )
+            # thread stays referenced: reprobe() can re-join with a bigger
+            # budget, same as a plain timed-out probe
+        _probe_result = result
+        if not result["ok"]:
+            # the persisted ok was stale: heal the cache file
+            _store_verdict(_probe_cache_key(timeout), result)
+        return result["ok"]
 
 
 def default_backend():

@@ -18,6 +18,11 @@ import sys
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
+# hermeticity: a probe verdict persisted by some earlier CLI/bench run must
+# not leak into (or out of) the suite; cache-behaviour tests opt back in by
+# pointing KART_PROBE_CACHE at a tmp file
+os.environ.setdefault("KART_PROBE_CACHE", "0")
+
 if os.environ.get("KART_TESTS_ON_TPU") != "1":
     from kart_tpu.runtime import insulate_virtual_cpu
 

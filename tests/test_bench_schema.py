@@ -75,6 +75,33 @@ NEW_KEYS += [
     "import_features_per_sec_10m",
 ]
 
+#: keys added by ISSUE 6 (sharded multi-device diff backend: the
+#: `bench.py --multichip` scaling sweep — 1-dev = the monolithic
+#: single-device kernel, 2/4/8-dev = the sharded record-batch path — plus
+#: the probe-verdict-cache honesty flag and the measured environment
+#: ceilings that contextualise a core-starved container's curve). These
+#: land in MULTICHIP_r*.json rather than BENCH_r*.json, but the same
+#: drop-out guard applies.
+NEW_KEYS += [
+    "multichip_rows",
+    "multichip_classify_rows_per_sec_1dev",
+    "multichip_classify_rows_per_sec_1dev_batched",
+    "multichip_classify_rows_per_sec_2dev",
+    "multichip_classify_rows_per_sec_4dev",
+    "multichip_classify_rows_per_sec_8dev",
+    "multichip_scaling_1to2",
+    "multichip_scaling_1to4",
+    "multichip_counts_exact",
+    "multichip_host_cores",
+    "multichip_kernel",
+    "multichip_env_alu_2proc_scaling",
+    "multichip_env_memcpy_2proc_scaling",
+    "backend_probe_cached",
+    # MULTICHIP record continuity fields (the driver's r01-r05 schema)
+    "ok",
+    "skipped",
+]
+
 
 def test_bench_emits_every_recorded_key():
     with open(os.path.join(REPO_ROOT, "bench.py")) as f:

@@ -782,3 +782,133 @@ def test_fetch_blobs_retry_refetches_only_missing(served_repo, tmp_path, monkeyp
     assert fetched == len(set(blob_oids))
     for oid in blob_oids:
         assert dst.odb.contains(oid)
+
+
+# ---------------------------------------------------------------------------
+# sharded diff backend: host->device transfer faults (ISSUE 6)
+# ---------------------------------------------------------------------------
+
+
+def _edited_block_pair(n=3000, seed=13):
+    """(old, new) FeatureBlocks with an insert/update/delete mix — the
+    classify input shape of the device backend, no repo needed."""
+    import numpy as np
+
+    from kart_tpu.ops.blocks import FeatureBlock
+
+    rng = np.random.default_rng(seed)
+    keys = np.sort(rng.choice(20 * n, size=n, replace=False)).astype(np.int64)
+    oids = rng.integers(0, 2**32, size=(n, 5), dtype=np.uint32)
+    old = FeatureBlock(keys.copy(), oids.copy(), None, n)
+    keep = np.setdiff1d(np.arange(n), rng.choice(n, size=37, replace=False))
+    nk, no = keys[keep], oids[keep].copy()
+    no[::29] = rng.integers(0, 2**32, size=(len(no[::29]), 5), dtype=np.uint32)
+    ins_k = np.arange(30 * n, 30 * n + 23, dtype=np.int64)
+    ins_o = rng.integers(0, 2**32, size=(23, 5), dtype=np.uint32)
+    new = FeatureBlock(
+        np.concatenate([nk, ins_k]), np.concatenate([no, ins_o]), None, n - 37 + 23
+    )
+    return old, new
+
+
+def test_device_transfer_fault_falls_back_bit_identical(monkeypatch):
+    """A crash mid host->device transfer must not kill the diff: the
+    sharded backend abandons the device attempt and the host-native
+    fallback result is bit-identical to an uninjected run."""
+    import numpy as np
+
+    from kart_tpu.diff.backend import BACKENDS
+    from kart_tpu.ops.diff_kernel import classify_blocks_host
+
+    old, new = _edited_block_pair()
+    want_old, want_new, want_counts = classify_blocks_host(old, new)
+    # bare point (no :n): the spec *string* must differ from the per-round
+    # matrix below — one-shot state only resets when the spec changes
+    monkeypatch.setenv("KART_FAULTS", "diff.device_transfer")
+    got_old, got_new, got_counts = BACKENDS["sharded_jax"].classify(old, new)
+    monkeypatch.delenv("KART_FAULTS")
+    assert got_counts == want_counts
+    np.testing.assert_array_equal(got_old, want_old)
+    np.testing.assert_array_equal(got_new, want_new)
+
+
+def test_device_transfer_killed_at_every_round_leaves_no_partial_state(
+    monkeypatch,
+):
+    """Kill matrix over transfer rounds: for every round N of a multi-round
+    batched classify, an injected crash at round N's host->device transfer
+    raises out of the device attempt with nothing published, and the very
+    next (uninjected) call over the same blocks is bit-identical to
+    host-native — no partial state survives the crash."""
+    import jax
+    import numpy as np
+
+    from kart_tpu.diff.device_batch import batch_splits, classify_blocks_batched
+    from kart_tpu.ops.diff_kernel import classify_blocks_host
+    from kart_tpu.parallel.mesh import make_mesh
+
+    if jax.device_count() < 2:
+        pytest.skip("needs >= 2 devices")
+    old, new = _edited_block_pair()
+    want = classify_blocks_host(old, new)
+    n_shards, batch_rows = 2, 256
+    _, n_chunks = batch_splits(
+        (old.keys[: old.count], new.keys[: new.count]), batch_rows
+    )
+    n_rounds = -(-n_chunks // n_shards)
+    assert n_rounds >= 3, "fixture too small to exercise mid-stream rounds"
+    mesh = make_mesh(n_shards)
+    for r in range(1, n_rounds + 1):
+        monkeypatch.setenv("KART_FAULTS", f"diff.device_transfer:{r}")
+        with pytest.raises(faults.InjectedFault):
+            classify_blocks_batched(old, new, mesh=mesh, batch_rows=batch_rows)
+        monkeypatch.delenv("KART_FAULTS")
+        got = classify_blocks_batched(old, new, mesh=mesh, batch_rows=batch_rows)
+        assert got[2] == want[2]
+        np.testing.assert_array_equal(got[0], want[0])
+        np.testing.assert_array_equal(got[1], want[1])
+
+
+def test_cli_diff_survives_device_transfer_fault(tmp_path, monkeypatch):
+    """End-to-end: a real `kart diff` forced onto the sharded backend with
+    the transfer fault armed completes via the host-native fallback and its
+    output is byte-identical to an unfaulted host run."""
+    import jax
+
+    from click.testing import CliRunner
+
+    from kart_tpu.cli import cli
+
+    if jax.device_count() < 2:
+        pytest.skip("needs >= 2 devices")
+    from helpers import make_repo_with_edits
+
+    repo_path, _ = make_repo_with_edits(tmp_path)
+    monkeypatch.setenv("KART_DIFF_ENGINE", "columnar")
+    monkeypatch.setenv("KART_DIFF_BACKEND", "host_native")
+    host = CliRunner().invoke(
+        cli, ["-C", repo_path, "diff", "HEAD^...HEAD", "-o", "json"],
+        catch_exceptions=False,
+    )
+    assert host.exit_code == 0, host.output
+
+    monkeypatch.setenv("KART_DIFF_BACKEND", "sharded_jax")
+    monkeypatch.setenv("KART_FAULTS", "diff.device_transfer:1")
+    faulted = CliRunner().invoke(
+        cli, ["-C", repo_path, "diff", "HEAD^...HEAD", "-o", "json"],
+        catch_exceptions=False,
+    )
+    monkeypatch.delenv("KART_FAULTS")
+    assert faulted.exit_code == 0, faulted.output
+
+    def diff_payload(output):
+        """The pretty-printed JSON document, shorn of any fallback-warning
+        log lines the test runner's stream capture interleaves."""
+        import json as _json
+
+        lines = output.splitlines()
+        lo = lines.index("{")
+        hi = len(lines) - 1 - lines[::-1].index("}")
+        return _json.loads("\n".join(lines[lo : hi + 1]))
+
+    assert diff_payload(faulted.output) == diff_payload(host.output)
