@@ -18,6 +18,7 @@ Prints exactly one JSON line:
 import itertools
 import json
 import os
+import re
 import time
 
 import numpy as np
@@ -1777,10 +1778,442 @@ def multichip_main():
     print(json.dumps(record), flush=True)
 
 
+# ---------------------------------------------------------------------------
+# --serve-storm: N concurrent clients vs one kart serve (ISSUE 7)
+# ---------------------------------------------------------------------------
+
+
+def _storm_env(extra=None):
+    """Environment for spawned servers/workers: this repo importable, no
+    inherited fault arming, no accelerator plugin registration."""
+    env = dict(os.environ)
+    pkg_root = os.path.dirname(os.path.abspath(__file__))
+    env["PYTHONPATH"] = pkg_root + os.pathsep + env.get("PYTHONPATH", "")
+    env.pop("KART_FAULTS", None)
+    env["JAX_PLATFORMS"] = "cpu"
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    if extra:
+        env.update(extra)
+    return env
+
+
+def _free_port():
+    import socket
+
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def _spawn_serve(workdir, port, extra_env=None):
+    """-> a `kart serve` subprocess accepting on 127.0.0.1:port."""
+    import socket
+    import subprocess
+    import sys
+
+    def _prioritise():
+        # under a storm the single server process contends with N client
+        # processes for the same cores; fair scheduling would starve it to
+        # 1/(N+1) of a core and make *it* the bottleneck. Prioritising the
+        # serving process is standard deployment practice; best-effort.
+        try:
+            os.nice(-10)
+        except OSError as e:
+            print(f"serve nice failed: {e}", file=sys.stderr)
+
+    proc = subprocess.Popen(
+        [
+            sys.executable, "-m", "kart_tpu.cli", "serve",
+            "--host", "127.0.0.1", "--port", str(port),
+        ],
+        cwd=workdir,
+        env=_storm_env(extra_env),
+        stdout=subprocess.DEVNULL,
+        stderr=subprocess.DEVNULL,
+        preexec_fn=_prioritise,
+    )
+    deadline = time.monotonic() + 60
+    while True:
+        try:
+            with socket.create_connection(("127.0.0.1", port), timeout=1):
+                return proc
+        except OSError:
+            if proc.poll() is not None or time.monotonic() > deadline:
+                proc.kill()
+                proc.wait()
+                raise RuntimeError("kart serve did not start for the storm bench")
+            time.sleep(0.1)
+
+
+def _spawn_storm_workers(url, base, n_workers, n_requests, mode):
+    import subprocess
+    import sys
+
+    procs = []
+    try:
+        for i in range(n_workers):
+            p = subprocess.Popen(
+                [
+                    sys.executable, os.path.abspath(__file__),
+                    "--serve-storm-worker", url,
+                    os.path.join(base, f"w{i}"), str(n_requests), mode,
+                ],
+                env=_storm_env(),
+                stdin=subprocess.PIPE,
+                stdout=subprocess.PIPE,
+                stderr=subprocess.PIPE,
+                text=True,
+            )
+            procs.append(p)
+    except BaseException:
+        for p in procs:
+            p.kill()
+            p.wait()
+        raise
+    return procs
+
+
+def _storm_go_barrier(procs, timeout=300):
+    """Wait for every worker's ``{"ready": ...}`` line (imports done,
+    client constructed), then broadcast "go" — the measurement window must
+    cover concurrent *transfers*, not 32 interpreters booting on a small
+    machine. -> the go wall-clock, or None if any worker died first."""
+    import select
+
+    deadline = time.monotonic() + timeout
+    for p in procs:
+        r, _, _ = select.select(
+            [p.stdout], [], [], max(deadline - time.monotonic(), 0)
+        )
+        line = p.stdout.readline() if r else None
+        if not line or not json.loads(line).get("ready"):
+            return None
+    go = time.time()
+    for p in procs:
+        p.stdin.write("go\n")
+        p.stdin.flush()
+    return go
+
+
+def _collect_workers(procs, timeout_each=600):
+    """-> one parsed result dict (or None) per worker."""
+    import subprocess
+    import sys
+
+    out = []
+    for p in procs:
+        try:
+            stdout, stderr = p.communicate(timeout=timeout_each)
+        except subprocess.TimeoutExpired:
+            p.kill()
+            stdout, stderr = p.communicate()
+        line = None
+        for ln in reversed((stdout or "").strip().splitlines()):
+            if ln.startswith("{"):
+                line = ln
+                break
+        if line is None:
+            print(
+                f"storm worker died: {(stderr or '')[-500:]}", file=sys.stderr
+            )
+            out.append(None)
+            continue
+        out.append(json.loads(line))
+    return out
+
+
+def serve_storm_worker():
+    """One storm client process. Modes: ``fetch`` = n sequential full
+    fetches into fresh stores (a clone's transfer path, timed per request);
+    ``resilient`` = one clone that must complete even if the server dies
+    mid-transfer — retries `kart fetch` (the ROBUSTNESS.md §3 resume lanes)
+    until the store is whole. Protocol: print ``{"ready": true}`` once
+    imports are paid, block until the driver's "go" line, then run."""
+    import sys
+
+    i = sys.argv.index("--serve-storm-worker")
+    url, base, n_requests, mode = sys.argv[i + 1 : i + 5]
+    n_requests = int(n_requests)
+
+    from kart_tpu.core.repo import KartRepo
+
+    os.makedirs(base, exist_ok=True)
+    if hasattr(os, "sched_setaffinity") and os.environ.get(
+        "KART_BENCH_STORM_PIN", "1"
+    ) != "0":
+        # round-robin core pinning (worker index is the dir suffix): 32
+        # CPU-bound drains migrating freely across a 2-core host churn
+        # caches; pinning halves the migration thrash
+        try:
+            cpus = sorted(os.sched_getaffinity(0))
+            idx = int(re.sub(r"\D", "", os.path.basename(base)) or 0)
+            os.sched_setaffinity(0, {cpus[idx % len(cpus)]})
+        except (OSError, ValueError) as e:
+            print(f"storm worker pin failed: {e}", file=sys.stderr)
+    print(json.dumps({"ready": True}), flush=True)
+    sys.stdin.readline()  # the storm barrier: all clients hit at once
+
+    if mode == "fetch":
+        from kart_tpu.transport.http import HttpRemote
+        from kart_tpu.transport.retry import RetryPolicy
+
+        # patient policy: when the server sheds under the storm
+        # (429 + Retry-After), a real client waits its turn — the paced
+        # queue is the designed behaviour, not a failure
+        policy = RetryPolicy(attempts=60, base_delay=0.05, max_delay=0.5)
+        durations = []
+        ok = True
+        start = time.time()
+        for i in range(n_requests):
+            t0 = time.perf_counter()
+            try:
+                client = HttpRemote(url, retry=policy)
+                dst = KartRepo.init_repository(os.path.join(base, f"r{i}"))
+                wants = list(client.ls_refs()["heads"].values())
+                client.fetch_pack(dst, wants)
+            except Exception as e:
+                print(f"storm request failed: {e}", file=sys.stderr)
+                ok = False
+                break
+            durations.append(time.perf_counter() - t0)
+        print(
+            json.dumps(
+                {
+                    "ok": ok,
+                    "durations": durations,
+                    "start": start,
+                    "end": time.time(),
+                }
+            ),
+            flush=True,
+        )
+        return
+
+    from kart_tpu import transport
+    from kart_tpu.transport.remote import add_remote
+
+    repo = KartRepo.init_repository(os.path.join(base, "clone"))
+    add_remote(repo, "origin", url)
+    deadline = time.time() + float(
+        os.environ.get("KART_BENCH_STORM_FAULT_DEADLINE", 180)
+    )
+    attempts, done = 0, False
+    while time.time() < deadline and not done:
+        attempts += 1
+        try:
+            transport.fetch(repo, "origin")
+            done = repo.refs.get("refs/remotes/origin/main") is not None
+        except Exception as e:
+            # the server being killed mid-storm IS the scenario: keep
+            # resuming until it comes back (salvage + exclusion resume)
+            print(f"fetch attempt {attempts}: {e}", file=sys.stderr)
+            time.sleep(0.5)
+    print(
+        json.dumps(
+            {"ok": done, "attempts": attempts, "start": 0, "end": time.time()}
+        ),
+        flush=True,
+    )
+
+
+def _prom_value(text, name):
+    for line in text.splitlines():
+        if line.startswith(name + " "):
+            return float(line.rsplit(" ", 1)[1])
+    return 0.0
+
+
+def serve_storm_main():
+    """The concurrent-serving bench: aggregate clone throughput of N
+    simultaneous clients vs a serial cache-disabled baseline (the
+    pre-ISSUE-7 behaviour: one full ObjectEnumerator walk per request),
+    p99 request latency, the enum-cache hit rate, and a
+    kill-the-server-mid-storm fault leg where every client must complete
+    by resuming. Prints one JSON record (twice: before and after the
+    fault leg, so a watchdog kill still salvages the throughput half)."""
+    import math
+    import sys
+    import tempfile
+    from urllib.request import urlopen
+
+    rows = int(os.environ.get("KART_BENCH_STORM_ROWS", 20_000))
+    clients = int(os.environ.get("KART_BENCH_STORM_CLIENTS", 32))
+    per_client = int(os.environ.get("KART_BENCH_STORM_REQUESTS", 2))
+    serial_reqs = int(os.environ.get("KART_BENCH_STORM_SERIAL", 4))
+    fault_clients = int(os.environ.get("KART_BENCH_STORM_FAULT_CLIENTS", 8))
+
+    from kart_tpu.synth import synth_repo
+
+    # a RAM-backed working set when available: the bench measures the
+    # server's concurrency, and 32 colocated client drains fsync'ing packs
+    # through a slow container filesystem (9p on the dev boxes) would
+    # serialise on the mount instead of exercising the server
+    shm = "/dev/shm" if os.path.isdir("/dev/shm") else None
+    with tempfile.TemporaryDirectory(dir=shm) as td:
+        src, _ = synth_repo(
+            os.path.join(td, "src"), rows, blobs="real", edit_frac=0.0
+        )
+        workdir = src.workdir or src.gitdir
+
+        record = {
+            "metric": "serve_storm",
+            "serve_storm_rows": rows,
+            "serve_storm_clients": clients,
+            "serve_storm_requests_total": clients * per_client,
+            "ok": True,
+        }
+
+        # -- serial baseline: 1 client x sequential requests, cache OFF
+        port = _free_port()
+        server = _spawn_serve(workdir, port, {"KART_SERVE_ENUM_CACHE": "0"})
+        try:
+            url = f"http://127.0.0.1:{port}/"
+            procs = _spawn_storm_workers(
+                url, os.path.join(td, "serial"), 1, serial_reqs, "fetch"
+            )
+            _storm_go_barrier(procs)
+            serial_results = _collect_workers(procs)
+        finally:
+            server.kill()
+            server.wait()
+        r0 = serial_results[0]
+        if not r0 or not r0["ok"] or not r0["durations"]:
+            record["ok"] = False
+            print(json.dumps(record), flush=True)
+            return
+        serial_req_s = sum(r0["durations"]) / len(r0["durations"])
+        serial_rate = rows / serial_req_s
+        record["serve_storm_serial_features_per_sec"] = round(serial_rate)
+
+        # -- the storm: N concurrent clients, cache ON. An inflight cap is
+        # available (KART_BENCH_STORM_INFLIGHT > 0 arms the shedder on the
+        # storm server; the patient worker policy rides the 429s) but is
+        # off by default: on a small colocated host the shed/retry round
+        # trips cost more than the scheduler thrash they avoid — the cap
+        # exists for measuring the shed path itself, not for throughput
+        inflight_cap = os.environ.get("KART_BENCH_STORM_INFLIGHT", "0")
+        port = _free_port()
+        server = _spawn_serve(
+            workdir,
+            port,
+            {
+                "KART_SERVE_MAX_INFLIGHT": inflight_cap,
+                "KART_SERVE_RETRY_AFTER": "0",
+            },
+        )
+        try:
+            url = f"http://127.0.0.1:{port}/"
+            procs = _spawn_storm_workers(
+                url, os.path.join(td, "storm"), clients, per_client, "fetch"
+            )
+            go = _storm_go_barrier(procs)
+            storm_results = _collect_workers(procs)
+            with urlopen(url + "api/v1/stats", timeout=10) as resp:
+                stats_text = resp.read().decode()
+        finally:
+            server.kill()
+            server.wait()
+        good = [r for r in storm_results if r and r["ok"]]
+        record["ok"] = record["ok"] and go is not None and len(good) == clients
+        durations = sorted(d for r in good for d in r["durations"])
+        if not durations or go is None:
+            record["ok"] = False
+            print(json.dumps(record), flush=True)
+            return
+        window = max(r["end"] for r in good) - go
+        agg_rate = rows * len(durations) / max(window, 1e-9)
+        record["serve_storm_agg_features_per_sec"] = round(agg_rate)
+        record["serve_storm_speedup_vs_serial"] = round(
+            agg_rate / serial_rate, 2
+        )
+        p99_idx = min(
+            len(durations) - 1, math.ceil(0.99 * len(durations)) - 1
+        )
+        record["serve_storm_p99_request_seconds"] = round(
+            durations[p99_idx], 3
+        )
+        hits = _prom_value(stats_text, "kart_server_enum_cache_hits_total")
+        misses = _prom_value(stats_text, "kart_server_enum_cache_misses_total")
+        record["serve_enum_cache_hit_rate"] = round(
+            hits / (hits + misses) if hits + misses else 0.0, 4
+        )
+        print(json.dumps(record), flush=True)
+
+        # -- ceiling-context leg: the same 64 requests from as many
+        # colocated clients as the host can actually run (the bench puts
+        # every client on the server's own cores; on a 2-core container 32
+        # CPU-bound drains measure scheduler thrash, not the server —
+        # MULTICHIP r06's env-ceiling precedent). Same server config.
+        ceil_clients = int(
+            os.environ.get("KART_BENCH_STORM_CEILING_CLIENTS", 8)
+        )
+        ceil_reqs = max(1, (clients * per_client) // max(1, ceil_clients))
+        port = _free_port()
+        server = _spawn_serve(
+            workdir, port, {"KART_SERVE_MAX_INFLIGHT": inflight_cap}
+        )
+        try:
+            url = f"http://127.0.0.1:{port}/"
+            procs = _spawn_storm_workers(
+                url, os.path.join(td, "ceil"), ceil_clients, ceil_reqs,
+                "fetch",
+            )
+            go = _storm_go_barrier(procs)
+            ceil_results = _collect_workers(procs)
+        finally:
+            server.kill()
+            server.wait()
+        cgood = [r for r in ceil_results if r and r["ok"]]
+        cdur = [d for r in cgood for d in r["durations"]]
+        record["serve_storm_ceiling_clients"] = ceil_clients
+        if cdur and go is not None and len(cgood) == ceil_clients:
+            cagg = rows * len(cdur) / max(
+                max(r["end"] for r in cgood) - go, 1e-9
+            )
+            record["serve_storm_ceiling_agg_features_per_sec"] = round(cagg)
+            record["serve_storm_ceiling_speedup_vs_serial"] = round(
+                cagg / serial_rate, 2
+            )
+        print(json.dumps(record), flush=True)
+
+        # -- fault leg: SIGKILL the server mid-storm, restart it; every
+        # client must complete via the resume lanes (zero failed clients)
+        port = _free_port()
+        server = _spawn_serve(workdir, port)
+        ok_clients = 0
+        try:
+            url = f"http://127.0.0.1:{port}/"
+            procs = _spawn_storm_workers(
+                url, os.path.join(td, "fault"), fault_clients, 1, "resilient"
+            )
+            go = _storm_go_barrier(procs)
+            if go is None:
+                raise RuntimeError("fault-leg workers failed to start")
+            pause = max(0.3, serial_req_s * 0.5)  # mid-transfer
+            time.sleep(pause)
+            server.kill()
+            server.wait()
+            time.sleep(1.0)
+            server = _spawn_serve(workdir, port)
+            fault_results = _collect_workers(procs)
+            ok_clients = sum(1 for r in fault_results if r and r["ok"])
+        finally:
+            server.kill()
+            server.wait()
+        record["serve_storm_fault_clients"] = fault_clients
+        record["serve_storm_fault_clients_ok"] = ok_clients
+        record["ok"] = record["ok"] and ok_clients == fault_clients
+        print(json.dumps(record), flush=True)
+
+
 if __name__ == "__main__":
     import sys
 
-    if "--multichip-worker" in sys.argv:
+    if "--serve-storm-worker" in sys.argv:
+        serve_storm_worker()
+    elif "--serve-storm" in sys.argv:
+        serve_storm_main()
+    elif "--multichip-worker" in sys.argv:
         multichip_worker()
     elif "--multichip" in sys.argv:
         multichip_main()

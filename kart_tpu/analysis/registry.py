@@ -33,6 +33,10 @@ ENV_VARS = {
     "KART_STDIO_TIMEOUT": "source",
     "KART_SSH": "source",
     "KART_SSH_KART": "source",
+    # serving (docs/SERVING.md)
+    "KART_SERVE_ENUM_CACHE": "source",
+    "KART_SERVE_MAX_INFLIGHT": "source",
+    "KART_SERVE_RETRY_AFTER": "source",
     # faults / maintenance (ROBUSTNESS.md §5-§6)
     "KART_FAULTS": "source",
     "KART_GC_GRACE": "source",
@@ -111,6 +115,8 @@ FAULT_POINTS = frozenset(
         "import.encode",
         "import.pack_stream",
         "diff.device_transfer",
+        "server.enum_cache",
+        "server.shed",
     }
 )
 

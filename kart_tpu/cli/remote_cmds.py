@@ -230,17 +230,41 @@ def remote_list(ctx, verbose):
 @cli.command()
 @click.option("--host", default="127.0.0.1", show_default=True)
 @click.option("--port", type=click.INT, default=8470, show_default=True)
+@click.option(
+    "--max-inflight",
+    type=click.INT,
+    default=None,
+    help="Load-shed ceiling on concurrent requests (429 + Retry-After "
+    "beyond it); 0 = unlimited. Overrides KART_SERVE_MAX_INFLIGHT.",
+)
+@click.option(
+    "--enum-cache-bytes",
+    type=click.INT,
+    default=None,
+    help="Pack-enumeration cache byte budget; 0 disables. Overrides "
+    "KART_SERVE_ENUM_CACHE (docs/SERVING.md).",
+)
 @click.pass_obj
-def serve(ctx, host, port):
+def serve(ctx, host, port, max_inflight, enum_cache_bytes):
     """Serve this repository over HTTP for clone/fetch/push/pull.
 
     A LAN/localhost collaboration server (no authentication — like git
     daemon); clients use http://HOST:PORT/ as the remote URL. Supports
     shallow and spatially-filtered partial clones (the filter runs
-    server-side) and promised-blob backfill.
+    server-side), promised-blob backfill, a shared pack-enumeration cache
+    with byte-range resume, and load shedding under client storms
+    (docs/SERVING.md).
     """
+    import os
+
     from kart_tpu.transport.http import serve as http_serve
 
+    # the env vars are the single configuration surface the serving layer
+    # reads; the CLI options just populate them for this process
+    if max_inflight is not None:
+        os.environ["KART_SERVE_MAX_INFLIGHT"] = str(max_inflight)
+    if enum_cache_bytes is not None:
+        os.environ["KART_SERVE_ENUM_CACHE"] = str(enum_cache_bytes)
     repo = ctx.repo
     click.echo(f"Serving {repo.gitdir} at http://{host}:{port}/ (Ctrl-C to stop)")
     try:

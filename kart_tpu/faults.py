@@ -27,6 +27,12 @@ Registered points:
     import.pack_stream      every pack-write batch of the pipelined import
     diff.device_transfer    every host->device round of the sharded diff
                             backend's batch loader (fallback: host-native)
+    server.enum_cache       the pack-enumeration cache: entry publish, and
+                            every chunk of a cached stream being served (a
+                            mid-cached-stream kill / poisoned-fill probe)
+    server.shed             the serve admission check — an armed hit sheds
+                            the request (429 + Retry-After) regardless of
+                            actual load
 
 Disabled (``KART_FAULTS`` unset) the fast path is a single environ dict
 lookup with no allocation: frame-boundary loops additionally hoist

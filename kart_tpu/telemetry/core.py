@@ -52,6 +52,7 @@ SUBSYSTEMS = frozenset(
         "packs",     # packfile machinery
         "serialise", # output materialisation/serialisation
         "transport", # wire transports, retry/resume, servers
+        "server",    # concurrent-serving machinery (enum cache, shedding)
         "importer",  # bulk import phases
         "runtime",   # backend probe, watchdogs
         "wc",        # working copies

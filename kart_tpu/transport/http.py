@@ -22,7 +22,13 @@ Endpoints (all JSON unless noted):
         {"wants": [...], "haves": [...], "have_shallow": [...],
          "depth": N|null, "filter": "w,s,e,n"|null}
         -> framed response: 8-byte big-endian header length, JSON header
-           {"shallow_boundary": [...], "object_count": N}, kartpack bytes
+           {"shallow_boundary": [...], "object_count": N}, kartpack bytes.
+        Responses carry a strong ETag; a retry may send
+        ``Range: bytes=N-`` + ``If-Range: <etag>`` with the *identical*
+        body to resume a torn stream mid-pack (206; docs/SERVING.md §3).
+        Enumerations are cached + single-flighted per request key
+        (docs/SERVING.md §2), and the server sheds load with
+        429 + Retry-After past ``KART_SERVE_MAX_INFLIGHT``.
     POST <base>/api/v1/fetch-blobs
         {"oids": [...]} -> framed response (header + kartpack)
     POST <base>/api/v1/receive-pack
@@ -36,6 +42,7 @@ like ``git daemon``. Put a reverse proxy in front for anything else.
 
 import json
 import os
+import re
 import struct
 import tempfile
 import threading
@@ -44,6 +51,7 @@ from urllib.error import HTTPError
 from urllib.parse import urlsplit
 from urllib.request import Request, urlopen
 
+from kart_tpu import faults
 from kart_tpu import telemetry as tm
 from kart_tpu.core.odb import ObjectMissing
 from kart_tpu.transport.pack import read_pack, write_pack
@@ -84,17 +92,39 @@ class HttpTransportError(ValueError):
     bounded retry may recover from (vs server-reported op errors, which
     recur deterministically); ``pre_write`` marks failures that provably
     happened before any request byte reached the server, the only kind a
-    non-idempotent verb retries."""
+    non-idempotent verb retries. ``retry_after`` carries a server-sent
+    ``Retry-After`` (seconds) — the load-shedding 429 path — which the
+    retry policy honours as a backoff floor. ``shed`` marks an HTTP 429:
+    by its semantics the server refused the request *before processing
+    it*, so even a non-idempotent verb (push) may safely retry — the
+    paced-queue behaviour load shedding is designed for."""
 
     transient = False
     pre_write = False
+    retry_after = None
+    shed = False
 
-    def __init__(self, message, *, transient=None, pre_write=None):
+    def __init__(self, message, *, transient=None, pre_write=None,
+                 retry_after=None, shed=None):
         super().__init__(message)
         if transient is not None:
             self.transient = transient
         if pre_write is not None:
             self.pre_write = pre_write
+        if retry_after is not None:
+            self.retry_after = retry_after
+        if shed is not None:
+            self.shed = shed
+
+
+def _retry_after_of(http_error):
+    """Seconds from an HTTPError's Retry-After header (seconds form only;
+    an HTTP-date or garbage is ignored), or None."""
+    try:
+        value = float(http_error.headers.get("Retry-After", ""))
+    except (AttributeError, TypeError, ValueError):
+        return None
+    return value if value >= 0 else None
 
 
 # ---------------------------------------------------------------------------
@@ -248,29 +278,88 @@ class KartRequestHandler(BaseHTTPRequestHandler):
         buf.seek(0)
         return buf
 
+    # -- admission: inflight gauge + load shedding --------------------------
+
+    def _admit(self):
+        """Count this request in; shed with 429 + Retry-After when the
+        inflight ceiling (``KART_SERVE_MAX_INFLIGHT``; 0/unset = unlimited)
+        is breached — the client RetryPolicy treats 429 as transient and
+        honours Retry-After as its backoff floor, so a storm decays into a
+        paced queue instead of a pile-up. -> False when shed (the caller
+        must return without handling)."""
+        from kart_tpu.transport.retry import _env_int
+
+        server = self.server
+        with server.inflight_lock:
+            server.inflight += 1
+            n = server.inflight
+        tm.gauge_set("server.inflight", n)
+        limit = _env_int("KART_SERVE_MAX_INFLIGHT", 0)
+        shed = limit > 0 and n > limit
+        if not shed:
+            try:
+                # the injectable storm: shed this request regardless of load
+                faults.fire("server.shed")
+            except faults.InjectedFault:
+                shed = True
+        if not shed:
+            return True
+        self._leave()
+        tm.incr("server.shed")  # exposition: kart_server_shed_total
+        retry_after = _env_int("KART_SERVE_RETRY_AFTER", 1)
+        raw = json.dumps(
+            {"error": f"Server over capacity ({limit} inflight); retry"}
+        ).encode()
+        self.send_response(429)
+        self.send_header("Retry-After", str(max(0, retry_after)))
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(raw)))
+        self.end_headers()
+        self.wfile.write(raw)
+        return False
+
+    def _leave(self):
+        server = self.server
+        with server.inflight_lock:
+            server.inflight -= 1
+            n = server.inflight
+        tm.gauge_set("server.inflight", n)
+
     # -- routes -------------------------------------------------------------
 
     def do_GET(self):
         try:
             path = urlsplit(self.path).path.rstrip("/")
-            if path == f"{API}/refs":
-                return self._handle_refs()
             if path == f"{API}/stats":
+                # never shed the stats endpoint: observability of a server
+                # in overload is the whole point of having it
                 return self._handle_stats()
-            self._json(404, {"error": f"No such endpoint: {self.path}"})
+            if not self._admit():
+                return
+            try:
+                if path == f"{API}/refs":
+                    return self._handle_refs()
+                self._json(404, {"error": f"No such endpoint: {self.path}"})
+            finally:
+                self._leave()
         except Exception as e:
             self._json(500, {"error": f"{type(e).__name__}: {e}"})
 
     def do_POST(self):
         path = urlsplit(self.path).path.rstrip("/")
         try:
-            if path == f"{API}/fetch-pack":
-                return self._handle_fetch_pack()
-            if path == f"{API}/fetch-blobs":
-                return self._handle_fetch_blobs()
-            if path == f"{API}/receive-pack":
-                return self._handle_receive_pack()
-            self._json(404, {"error": f"No such endpoint: {self.path}"})
+            if not self._admit():
+                return
+            try:
+                if path == f"{API}/fetch-pack":
+                    return self._handle_fetch_pack()
+                if path == f"{API}/fetch-blobs":
+                    return self._handle_fetch_blobs()
+                if path == f"{API}/receive-pack":
+                    return self._handle_receive_pack()
+                self._json(404, {"error": f"No such endpoint: {self.path}"})
+            finally:
+                self._leave()
         except Exception as e:  # surface server errors to the client
             self._json(500, {"error": f"{type(e).__name__}: {e}"})
 
@@ -292,14 +381,71 @@ class KartRequestHandler(BaseHTTPRequestHandler):
         self.end_headers()
         self.wfile.write(raw)
 
+    def _range_offset(self, etag, length):
+        """The validated resume offset of a ``Range: bytes=N-`` request
+        (0 = serve the full response). If-Range must present the exact
+        strong validator we handed out — the etag embeds the ref-tips
+        fingerprint, so a ref update between attempts forces a clean full
+        response instead of splicing bytes from two different packs."""
+        rng = self.headers.get("Range")
+        if not rng or self.headers.get("If-Range") != etag:
+            return 0
+        m = re.match(r"bytes=(\d+)-$", rng.strip())
+        if not m:
+            return 0
+        offset = int(m.group(1))
+        return offset if 0 < offset < length else 0
+
     def _handle_fetch_pack(self):
-        from kart_tpu.transport.service import make_fetch_enum
+        from contextlib import closing
+
+        from kart_tpu.transport.service import materialise_plan, serve_fetch_pack
 
         req = json.loads(self._read_body().decode() or "{}")
-        # the enumerator streams straight into the spooled pack; the header
-        # callable reads its counters only after the drain
-        enum, header = make_fetch_enum(self.repo, req)
-        self._framed(header, enum)
+        # cache-fronted enumeration: a hit (or a single-flight wait on a
+        # concurrent identical request) skips the ObjectEnumerator walk;
+        # a fresh walk spools, publishes, then streams
+        plan = serve_fetch_pack(self.repo, req)
+        fp, length = materialise_plan(plan)
+        with closing(fp):
+            offset = self._range_offset(plan.etag, length)
+            if offset:
+                tm.incr("server.range_resumes")
+                # a validated byte-range request IS a resumed fetch, same
+                # as a non-empty oid-exclusion list on the wire field —
+                # but count each resumed request once (a range retry of an
+                # exclusion-seeded body was already counted)
+                if not req.get("exclude"):
+                    tm.incr("transport.server.fetch_resumes")
+                fp.seek(offset)
+                self.send_response(206)
+                self.send_header(
+                    "Content-Range", f"bytes {offset}-{length - 1}/{length}"
+                )
+            else:
+                self.send_response(200)
+            self.send_header("Content-Type", "application/x-kartpack")
+            self.send_header("ETag", plan.etag)
+            self.send_header("Accept-Ranges", "bytes")
+            self.send_header("Content-Length", str(length - offset))
+            self.end_headers()
+            tm.incr("transport.server.bytes_sent", length - offset)
+            fault = faults.hook("server.enum_cache") if plan.cached else None
+            while True:
+                try:
+                    if fault is not None:
+                        fault()
+                    chunk = fp.read(1 << 20)
+                except faults.InjectedFault:
+                    # the injected mid-cached-stream kill: truncate the
+                    # response like a dying server would (no trailing 500
+                    # junk that would pad out Content-Length) — the client
+                    # salvages and resumes (tests/test_faults.py)
+                    self.close_connection = True
+                    return
+                if not chunk:
+                    break
+                self.wfile.write(chunk)
 
     def _handle_fetch_blobs(self):
         from kart_tpu.transport.service import collect_blobs
@@ -339,7 +485,13 @@ def make_server(repo, host="127.0.0.1", port=0):
     tm.enable(metrics=True)
     server = ThreadingHTTPServer((host, port), KartRequestHandler)
     server.kart_repo = repo
+    # narrow write lock: held only around ref validation + quarantine
+    # migrate inside quarantined_receive — concurrent pushes drain their
+    # (per-push) quarantines in parallel and serialise only at the CAS
     server.push_lock = threading.Lock()
+    # admission control: live request gauge feeding the load shedder
+    server.inflight = 0
+    server.inflight_lock = threading.Lock()
     return server
 
 
@@ -359,6 +511,36 @@ def serve(repo, host="127.0.0.1", port=8470, *, in_thread=False):
 # ---------------------------------------------------------------------------
 # client
 # ---------------------------------------------------------------------------
+
+
+class _CountingReader:
+    """File wrapper tracking the response bytes consumed so far — used to
+    measure the framed-header prefix exactly (``read_framed`` reads exact
+    sizes, no read-ahead), which anchors the ``Range: bytes=N-`` resume
+    offsets the drain derives from its own record accounting."""
+
+    __slots__ = ("_fp", "count")
+
+    def __init__(self, fp, start=0):
+        self._fp = fp
+        self.count = start
+
+    def read(self, n=-1):
+        data = self._fp.read(n)
+        self.count += len(data)
+        return data
+
+
+def _pack_body_source(resp):
+    """-> file-like over the rest of ``resp``'s body (the pack stream): a
+    large C-level read-ahead buffer under the per-record parser (cuts the
+    Python stream-layer cost ~2.5x), while still *streaming* — consuming
+    at drain speed keeps the socket's backpressure, which under a client
+    storm is what staggers concurrent drains instead of letting every
+    client buffer its whole pack and then fight for the same cores."""
+    import io
+
+    return io.BufferedReader(resp, buffer_size=1 << 20)
 
 
 class HttpRemote:
@@ -394,6 +576,8 @@ class HttpRemote:
             raise HttpTransportError(
                 f"Remote {self.base!r} error: {e}",
                 transient=e.code in _TRANSIENT_HTTP_STATUSES,
+                retry_after=_retry_after_of(e),
+                shed=e.code == 429,
             )
         except OSError as e:
             # connection-level (refused / DNS / socket timeout): transient,
@@ -404,16 +588,21 @@ class HttpRemote:
                 pre_write=True,
             )
 
-    def _post(self, path, data, *, raw=False, length=None):
+    def _post(self, path, data, *, raw=False, length=None, headers=None):
         """data: JSON-able object, or (raw=True) bytes / a file-like with an
-        explicit length."""
-        headers = {
+        explicit length. ``headers``: extra request headers (the byte-range
+        resume path sends Range/If-Range)."""
+        all_headers = {
             "Content-Type": "application/x-kartpack" if raw else "application/json"
         }
+        if headers:
+            all_headers.update(headers)
         body = data if raw else json.dumps(data).encode()
         if length is not None:
-            headers["Content-Length"] = str(length)
-        req = Request(self.base + path, data=body, headers=headers, method="POST")
+            all_headers["Content-Length"] = str(length)
+        req = Request(
+            self.base + path, data=body, headers=all_headers, method="POST"
+        )
         try:
             return urlopen(req, timeout=http_timeout(DEFAULT_HTTP_POST_TIMEOUT))
         except HTTPError as e:
@@ -429,6 +618,8 @@ class HttpRemote:
             raise HttpTransportError(
                 f"Remote {self.base!r} error: {detail or e}",
                 transient=e.code in _TRANSIENT_HTTP_STATUSES,
+                retry_after=_retry_after_of(e),
+                shed=e.code == 429,
             )
         except OSError as e:
             reason = getattr(e, "reason", e)
@@ -451,32 +642,78 @@ class HttpRemote:
                    depth=None, filter_spec=None, exclude=None):
         """-> header dict; objects are written straight into dst_repo.
 
-        Resumable: objects landed before a disconnect are salvaged into a
-        finished pack, and the retry re-negotiates with those oids excluded
-        so the server ships only the remainder. ``exclude`` seeds the
-        exclusion set (a cross-process resume passes the oids salvaged by
-        the earlier, killed process)."""
+        Resumable, twice over. In-process retries resume *mid-pack* by byte
+        range: every attempt tracks the absolute offset of the last
+        complete record it consumed, and the retry re-sends the identical
+        request with ``Range: bytes=N-`` + the server's strong validator
+        (``If-Range``), so the server — whose enumeration is deterministic
+        per key, cache or no cache — ships only the unseen tail. If the
+        validator no longer matches (a ref moved, the entry was evicted)
+        the server answers 200 with a fresh full response, and the salvaged
+        objects still suppress re-writing. Cross-process resume stays
+        oid-exclusion based: ``exclude`` seeds the exclusion set (the oids
+        salvaged by the earlier, killed process), and the set is shared in
+        place so the caller sees everything salvaged even when every
+        attempt fails."""
         from kart_tpu.transport.retry import drain_pack_salvaging, exclude_arg
 
-        # a set is shared in place, so the caller sees everything salvaged
-        # even when every attempt fails (cross-process resume records it)
         received = exclude if isinstance(exclude, set) else set(exclude or ())
+        # byte-range resume state across retry attempts: the validator, the
+        # exact body that produced it (byte-identical key on the server),
+        # the response header already read, and the committed byte offset
+        state = {"etag": None, "body": None, "header": None, "offset": 0}
 
         def attempt():
-            resp = self._post(
-                f"{API}/fetch-pack",
-                {
+            resp = None
+            if state["etag"] and state["offset"] > 0:
+                resp = self._post(
+                    f"{API}/fetch-pack",
+                    state["body"],
+                    headers={
+                        "Range": f"bytes={state['offset']}-",
+                        "If-Range": state["etag"],
+                    },
+                )
+                if getattr(resp, "status", 200) == 206:
+                    tm.incr("transport.range_resumes")
+                    with resp:
+                        base = state["offset"]
+                        drain_pack_salvaging(
+                            dst_repo.odb,
+                            # read-ahead is safe: the response body IS the
+                            # pack remainder, bounded by Content-Length
+                            _pack_body_source(resp),
+                            received,
+                            mid_stream=True,
+                            commit=lambda off: state.update(offset=base + off),
+                        )
+                    return state["header"]
+                # validator mismatch: the server sent a fresh full response
+                # — fall through and consume it as one
+            if resp is None:
+                body = {
                     "wants": list(wants),
                     "haves": list(haves),
                     "have_shallow": sorted(have_shallow),
                     "depth": depth,
                     "filter": filter_spec,
                     "exclude": exclude_arg(received),
-                },
-            )
+                }
+                resp = self._post(f"{API}/fetch-pack", body)
+                state["body"] = body
             with resp:
-                header, pack_fp = read_framed(resp)
-                drain_pack_salvaging(dst_repo.odb, pack_fp, received)
+                counting = _CountingReader(resp)
+                header, _ = read_framed(counting)
+                prefix = counting.count  # 8-byte length + JSON header
+                state.update(
+                    etag=resp.headers.get("ETag"), header=header, offset=0
+                )
+                drain_pack_salvaging(
+                    dst_repo.odb,
+                    _pack_body_source(resp),
+                    received,
+                    commit=lambda off: state.update(offset=prefix + off),
+                )
             return header
 
         return self.retry.call(attempt, label="fetch-pack", on_retry=self.reset)
@@ -511,8 +748,13 @@ class HttpRemote:
         -> {ref: oid|None} from the server.
 
         Not idempotent: only pre-write failures (connect refused — the
-        server saw no byte of this request) are retried."""
+        server saw no byte of this request) and load-shedding 429s (the
+        server refused the request before processing anything) are
+        retried — a shed push joins the paced queue like any fetch."""
         from kart_tpu.transport.retry import is_pre_write
+
+        def retryable(exc):
+            return is_pre_write(exc) or getattr(exc, "shed", False)
 
         with tempfile.SpooledTemporaryFile(max_size=64 * 1024 * 1024) as buf:
             write_framed(
@@ -532,7 +774,7 @@ class HttpRemote:
                 )
 
             resp = self.retry.call(
-                attempt, label="receive-pack", retryable=is_pre_write,
+                attempt, label="receive-pack", retryable=retryable,
                 on_retry=self.reset,
             )
         with resp:

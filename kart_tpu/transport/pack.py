@@ -57,9 +57,22 @@ def write_pack(fileobj, objects):
     return count
 
 
-def read_pack(fileobj):
+def read_pack(fileobj, *, mid_stream=False, consumed=None):
     """Yield ``(type_str, content_bytes)`` from a packstream, verifying the
-    checksum trailer."""
+    checksum trailer.
+
+    ``mid_stream=True`` consumes a stream that begins at a *record
+    boundary* rather than at the magic (a byte-range resume of a torn
+    transfer, docs/SERVING.md §3): the magic check is skipped and the
+    trailer is read but not verified — its digest covers bytes the earlier,
+    torn attempt consumed. Integrity holds regardless: every record is
+    individually zlib- and length-verified, and receivers recompute oids
+    from content.
+
+    ``consumed``: an optional one-element list updated (before each yield)
+    with the exact stream bytes consumed through that record — the resume
+    offset a ``Range: bytes=N-`` retry needs, tracked here so callers can
+    put a read-ahead buffer *under* this reader without miscounting."""
     digest = hashlib.sha256()
 
     def pull(n):
@@ -69,8 +82,13 @@ def read_pack(fileobj):
         digest.update(data)
         return data
 
-    if pull(len(MAGIC)) != MAGIC:
-        raise PackFormatError("Bad packstream magic")
+    if consumed is not None:
+        consumed[0] = 0
+    if not mid_stream:
+        if pull(len(MAGIC)) != MAGIC:
+            raise PackFormatError("Bad packstream magic")
+        if consumed is not None:
+            consumed[0] = len(MAGIC)
     fault = faults.hook("transport.read.frame")
     while True:
         if fault is not None:
@@ -84,8 +102,12 @@ def read_pack(fileobj):
         content = zlib.decompress(pull(deflate_len))
         if len(content) != raw_len:
             raise PackFormatError("Object length mismatch in packstream")
+        if consumed is not None:
+            consumed[0] += 9 + deflate_len
         yield obj_type, content
     expected = digest.digest()
     trailer = fileobj.read(32)
-    if len(trailer) != 32 or trailer != expected:
+    if len(trailer) != 32:
+        raise PackFormatError("Packstream checksum mismatch")
+    if not mid_stream and trailer != expected:
         raise PackFormatError("Packstream checksum mismatch")

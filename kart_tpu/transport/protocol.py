@@ -35,6 +35,11 @@ class ObjectEnumerator:
       * ``omitted_blob_count`` — blobs vetoed by blob_filter
       * ``shallow_boundary`` — commit oids shipped without their parents
       * ``commit_count`` — commits shipped
+      * ``emitted`` — with ``record_emitted=True``, the ordered
+        ``(type, oid)`` pairs yielded: the walk-free replay script the
+        server's pack-enumeration cache memoizes (docs/SERVING.md §2) —
+        re-reading those oids in that order reproduces the pack
+        byte-identically without re-walking reachability.
     """
 
     def __init__(
@@ -47,6 +52,7 @@ class ObjectEnumerator:
         blob_filter=None,
         sender_shallow=frozenset(),
         exclude=frozenset(),
+        record_emitted=False,
     ):
         self.odb = odb
         self.wants = list(wants)
@@ -60,6 +66,7 @@ class ObjectEnumerator:
         self.omitted_blob_count = 0
         self.commit_count = 0
         self.shallow_boundary = set()
+        self.emitted = [] if record_emitted else None
 
     # blobs are read through the native batch inflate in chunks of this many
     # (kartpack has no deltas and receivers write objects independently, so
@@ -75,6 +82,8 @@ class ObjectEnumerator:
             # necessarily anything below it
             if commit_oid not in self.exclude:
                 obj_type, content = self.odb.read_raw(commit_oid)
+                if self.emitted is not None:
+                    self.emitted.append((obj_type, commit_oid))
                 yield obj_type, content
                 self.object_count += 1
                 self.commit_count += 1
@@ -164,6 +173,8 @@ class ObjectEnumerator:
         # object while its blobs were lost to the disconnect (blobs ship in
         # deferred batches behind the trees that reference them)
         if tree_oid not in self.exclude:
+            if self.emitted is not None:
+                self.emitted.append(("tree", tree_oid))
             yield "tree", content
             self.object_count += 1
         for e in entries:
@@ -202,6 +213,8 @@ class ObjectEnumerator:
                     except ObjectMissing:
                         self.omitted_blob_count += 1
                         continue
+                if self.emitted is not None:
+                    self.emitted.append(("blob", oid))
                 yield "blob", blob
                 self.object_count += 1
         pending.clear()

@@ -17,6 +17,7 @@ import os
 import sys
 import tempfile
 
+from kart_tpu import telemetry as tm
 from kart_tpu.core.odb import ObjectMissing
 from kart_tpu.core.refs import RefError, check_ref_format
 from kart_tpu.core.repo import KartRepo, KartConfigKeys, NotFound
@@ -282,8 +283,13 @@ def fetch(repo, remote_name="origin", *, depth=None, filter_spec=None, quiet=Tru
         # (content addressing makes the salvaged objects exactly as
         # trustworthy as a completed transfer's). The client mutates the
         # set in place, so even a failed retry chain leaves us knowing
-        # everything that landed.
+        # everything that landed. This is the *cross-process* resume lane;
+        # within one process the HTTP client's retry loop additionally
+        # resumes mid-pack by byte range, sending the offset it already
+        # holds (docs/SERVING.md §3).
         exclude = _read_resume_exclusions(repo)
+        if exclude:
+            tm.incr("transport.resume_seeded_oids", len(exclude))
         try:
             info = net.ls_refs()
             branch_tips = info["heads"]

@@ -40,6 +40,12 @@ L = logging.getLogger("kart_tpu.transport.retry")
 #: (the stdio server caps request headers at 16MB).
 EXCLUDE_CAP = 100_000
 
+#: ceiling on how far a server-sent Retry-After may stretch one backoff
+#: sleep: the header is honoured as a *floor* on the computed exponential
+#: delay (a shedding server knows its own recovery horizon better than our
+#: guess), but a hostile/buggy header must not park a client for an hour.
+RETRY_AFTER_CAP = 60.0
+
 
 def is_transient(exc):
     """Should a bounded retry be attempted after ``exc``?
@@ -122,6 +128,19 @@ class RetryPolicy:
                 if attempt >= self.attempts or not retryable(e):
                     raise
                 delay = self.delay_for(attempt)
+                # a server-sent Retry-After (the 429/503 shedding path) is
+                # the backoff floor — capped, and never *lowering* a larger
+                # exponential delay
+                retry_after = getattr(e, "retry_after", None)
+                try:
+                    retry_after = float(retry_after)
+                except (TypeError, ValueError):
+                    retry_after = None
+                if retry_after is not None and retry_after > 0:
+                    floored = max(delay, min(retry_after, RETRY_AFTER_CAP))
+                    if floored > delay:
+                        tm.incr("transport.retry_after_honoured")
+                    delay = floored
                 tm.incr("transport.retries", verb=label or "operation")
                 tm.incr("transport.backoff_seconds", delay)
                 L.warning(
@@ -139,7 +158,8 @@ class RetryPolicy:
                     self.sleep(delay)
 
 
-def drain_pack_salvaging(odb, pack_fp, received=None):
+def drain_pack_salvaging(odb, pack_fp, received=None, *, mid_stream=False,
+                         commit=None):
     """Drain a kartpack stream into ``odb`` as one new pack, *keeping* what
     arrived if the stream tears.
 
@@ -152,17 +172,63 @@ def drain_pack_salvaging(odb, pack_fp, received=None):
     re-raised; ``received`` (if given) accumulates the hex oids written so
     a retry can exclude them from re-negotiation.
 
+    ``mid_stream=True`` consumes a byte-range-resumed stream (starts at a
+    record boundary, not the magic); ``commit(pack_bytes)`` (if given) is
+    called each time a run of records has landed in the writer, with the
+    exact pack-stream bytes consumed through the last *written* record —
+    the range-resume path derives its next ``Range:`` offset from it, so a
+    resume can never skip a record that was read but still buffered when
+    the stream tore.
+
+    Records are written in same-type runs through the writer's batched
+    path (one native hash+deflate+frame call per run) — at clone scale the
+    per-object Python of ``PackWriter.add`` dominated the whole drain.
+    Runs are bounded (count and bytes) so a tear forfeits at most one
+    run's worth of already-verified records.
+
     -> number of objects written this drain."""
     w = odb.pack_writer()
     count = 0
+    run_type = None
+    run = []  # contents of the current same-type run
+    run_bytes = 0
+    consumed = [0]   # stream offset after the last record *read*
+    run_end = 0      # stream offset after the last record in `run`
+
+    def flush():
+        nonlocal count, run, run_bytes
+        if not run:
+            return
+        oids = w.add_batch(run_type, run)
+        count += len(run)
+        if received is not None:
+            received.update(oids)
+        run = []
+        run_bytes = 0
+        if commit is not None:
+            commit(run_end)
+
     try:
         with tm.span("transport.pack_drain"):
-            for obj_type, content in read_pack(pack_fp):
-                oid = w.add(obj_type, content)
-                count += 1
-                if received is not None:
-                    received.add(oid)
+            for obj_type, content in read_pack(
+                pack_fp, mid_stream=mid_stream, consumed=consumed
+            ):
+                if (
+                    obj_type != run_type
+                    or len(run) >= _DRAIN_RUN_OBJECTS
+                    or run_bytes >= _DRAIN_RUN_BYTES
+                ):
+                    flush()
+                    run_type = obj_type
+                run.append(content)
+                run_bytes += len(content)
+                run_end = consumed[0]
+            flush()
     except BaseException:
+        try:
+            flush()  # the tail run is fully verified — salvage it too
+        except Exception:
+            L.warning("drain salvage: tail run write failed; kept %d", count)
         tm.incr("transport.salvage_events")
         tm.incr("transport.objects_salvaged", count)
         try:
@@ -175,6 +241,13 @@ def drain_pack_salvaging(odb, pack_fp, received=None):
     if w.finish() is not None:
         odb.packs.refresh()
     return count
+
+
+#: drain run bounds: big enough that the native batch call amortises the
+#: per-call overhead, small enough that a tear forfeits little and huge
+#: blobs can't balloon the buffered run
+_DRAIN_RUN_OBJECTS = 4096
+_DRAIN_RUN_BYTES = 8 << 20
 
 
 def exclude_arg(received):

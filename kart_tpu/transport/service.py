@@ -14,11 +14,18 @@ checksum and every ref-update precondition pass — a failed, torn or
 rejected push leaves the served store byte-identical.
 """
 
+import hashlib
+import io
+import json
 import os
 import shutil
 import tempfile
+import threading
+import time
+from collections import OrderedDict
 from contextlib import contextmanager, nullcontext
 
+from kart_tpu import faults
 from kart_tpu import telemetry as tm
 from kart_tpu.core.odb import ObjectMissing
 from kart_tpu.core.refs import RefError, check_ref_format
@@ -26,6 +33,15 @@ from kart_tpu.transport.protocol import ObjectEnumerator
 
 #: subdirectory of <gitdir>/objects holding in-flight push quarantines
 QUARANTINE_SUBDIR = "quarantine"
+
+#: default byte budget for the per-repo pack-enumeration cache
+#: (``KART_SERVE_ENUM_CACHE`` overrides; ``0`` disables caching entirely)
+DEFAULT_ENUM_CACHE_BYTES = 256 * 1024 * 1024
+
+#: how long a request waits on another request's in-flight walk for the
+#: same cache key before giving up and walking independently (a wedged
+#: filler must not wedge every client behind it)
+SINGLEFLIGHT_TIMEOUT = 600.0
 
 
 def ls_refs_info(repo):
@@ -56,19 +72,17 @@ def ls_refs_info(repo):
     }
 
 
-def make_fetch_enum(repo, req):
+def make_fetch_enum(repo, req, *, count_request=True, record_emitted=False):
     """fetch-pack request dict -> (ObjectEnumerator, header_fn). The header
     callable reads the enumerator's counters, so evaluate it only after the
-    pack drain."""
+    pack drain. ``count_request=False`` skips the request counters (the
+    enum-cache front end :func:`serve_fetch_pack` counts them itself so a
+    cache hit still shows up as a request)."""
     from kart_tpu.transport.remote import read_shallow
     from kart_tpu.transport.http import have_closure
 
-    tm.incr("transport.server.requests", verb="fetch-pack")
-    if req.get("exclude"):
-        # a non-empty exclusion list IS the resume protocol: the client is
-        # completing a torn earlier transfer (docs/ROBUSTNESS.md §3)
-        tm.incr("transport.server.fetch_resumes")
-        tm.incr("transport.server.excluded_oids", len(req["exclude"]))
+    if count_request:
+        _count_fetch_request(req)
     blob_filter = None
     if req.get("filter"):
         from kart_tpu.spatial_filter import blob_filter_for_spec
@@ -91,6 +105,7 @@ def make_fetch_enum(repo, req):
         # without pruning the walk — a resumed fetch ships only the missing
         # remainder.
         exclude=frozenset(req.get("exclude") or ()),
+        record_emitted=record_emitted,
     )
 
     def header():
@@ -101,6 +116,15 @@ def make_fetch_enum(repo, req):
         }
 
     return enum, header
+
+
+def _count_fetch_request(req):
+    tm.incr("transport.server.requests", verb="fetch-pack")
+    if req.get("exclude"):
+        # a non-empty exclusion list IS the resume protocol: the client is
+        # completing a torn earlier transfer (docs/ROBUSTNESS.md §3)
+        tm.incr("transport.server.fetch_resumes")
+        tm.incr("transport.server.excluded_oids", len(req["exclude"]))
 
 
 def collect_blobs(repo, oids):
@@ -114,6 +138,372 @@ def collect_blobs(repo, oids):
         except ObjectMissing:
             missing.append(oid)
     return {"missing": missing}, objects
+
+
+# ---------------------------------------------------------------------------
+# pack-enumeration cache (docs/SERVING.md §2)
+#
+# The expensive half of serving a fetch is the reachability walk + tree
+# recursion, and under concurrent clones of a hot repo every client used to
+# re-pay it. The cache memoizes, per (wants, haves, shallow, depth, filter,
+# excludes, ref-tips fingerprint) key: the final response header, a size
+# estimate, and either the complete framed response bytes (small packs — a
+# hit is a memcpy) or the ordered (type, oid) list the walk emitted (big
+# packs — a hit replays object reads in order, no walk). Concurrent
+# requests for an in-flight key block on the first walk (single-flight)
+# instead of duplicating it. Ref updates invalidate: the fingerprint is
+# part of the key, and apply_ref_updates additionally drops every entry so
+# stale keys don't linger in the LRU.
+# ---------------------------------------------------------------------------
+
+
+class _CacheEntry:
+    __slots__ = ("header", "data", "emitted", "nbytes", "etag")
+
+    def __init__(self, header, data, emitted, etag):
+        self.header = header
+        self.data = data          # complete framed response bytes, or None
+        self.emitted = emitted    # ordered (type, oid) replay list, or None
+        self.etag = etag
+        if data is not None:
+            self.nbytes = len(data)
+        else:
+            # oid-list replay entry, charged at measured CPython cost:
+            # ~89B hex-oid str + 56B tuple + interned type ref + list slot
+            self.nbytes = 160 * len(emitted) + 1024
+
+
+class _FillToken:
+    """The right to publish one cache entry: handed to the single request
+    that runs the walk for a key; every other request for that key waits on
+    ``event`` until publish/abandon."""
+
+    __slots__ = ("cache", "key", "event")
+
+    def __init__(self, cache, key, event):
+        self.cache = cache
+        self.key = key
+        self.event = event
+
+    def publish(self, header, *, data=None, emitted=None):
+        self.cache._publish(self, header, data, emitted)
+
+    def abandon(self):
+        self.cache._abandon(self)
+
+
+class PackEnumCache:
+    """LRU-by-byte-budget memo of fetch-pack enumerations with
+    single-flight fill (one instance per served repo)."""
+
+    def __init__(self, budget_bytes):
+        self.budget = budget_bytes
+        # a single entry may use at most budget/8 bytes as raw framed
+        # bytes; larger packs store the oid replay list instead, so one
+        # huge clone can't evict every hot entry
+        self.bytes_cap = max(1, budget_bytes // 8)
+        self._lock = threading.Lock()
+        self._entries = OrderedDict()  # key -> _CacheEntry
+        self._inflight = {}            # key -> threading.Event
+        self._total = 0
+
+    # -- lookup / single-flight --------------------------------------------
+
+    def lookup_or_begin(self, key, timeout=SINGLEFLIGHT_TIMEOUT):
+        """-> ("hit", entry) | ("fill", token) | ("fill", None).
+
+        A miss returns a fill token (the caller runs the walk and must
+        publish or abandon). While another request holds the token for the
+        same key, callers block here; a publish turns them into hits. A
+        filler wedged past ``timeout`` stops gating: waiters proceed with
+        their own uncached walk (token None — nothing to publish)."""
+        deadline = time.monotonic() + timeout
+        waited = False
+        while True:
+            with self._lock:
+                entry = self._entries.get(key)
+                if entry is not None:
+                    self._entries.move_to_end(key)
+                    tm.incr("server.enum_cache.hits")
+                    return "hit", entry
+                event = self._inflight.get(key)
+                if event is None:
+                    self._inflight[key] = event = threading.Event()
+                    tm.incr("server.enum_cache.misses")
+                    return "fill", _FillToken(self, key, event)
+            if not waited:
+                waited = True
+                tm.incr("server.enum_cache.singleflight_waits")
+            remaining = deadline - time.monotonic()
+            if remaining <= 0:
+                tm.incr("server.enum_cache.misses")
+                return "fill", None
+            event.wait(min(remaining, 60.0))
+
+    # -- fill side ----------------------------------------------------------
+
+    def _publish(self, token, header, data, emitted):
+        # the injectable failure of the cache-fill frame: a fault here must
+        # poison nothing — the entry is never inserted (tests/test_faults.py)
+        try:
+            faults.fire("server.enum_cache")
+        except BaseException:
+            self._abandon(token)
+            raise
+        entry = _CacheEntry(header, data, emitted, _etag_for(token.key))
+        with self._lock:
+            self._inflight.pop(token.key, None)
+            self._entries[token.key] = entry
+            self._entries.move_to_end(token.key)
+            self._total += entry.nbytes
+            while self._total > self.budget and len(self._entries) > 1:
+                _, evicted = self._entries.popitem(last=False)
+                self._total -= evicted.nbytes
+                tm.incr("server.enum_cache.evictions")
+            tm.gauge_set("server.enum_cache.bytes", self._total)
+        token.event.set()
+
+    def _abandon(self, token):
+        with self._lock:
+            self._inflight.pop(token.key, None)
+        token.event.set()
+
+    # -- invalidation -------------------------------------------------------
+
+    def evict(self, key):
+        """Drop one entry (a replay that hit missing objects is poisoned —
+        evicted, never served again)."""
+        with self._lock:
+            entry = self._entries.pop(key, None)
+            if entry is not None:
+                self._total -= entry.nbytes
+                tm.incr("server.enum_cache.evictions")
+                tm.gauge_set("server.enum_cache.bytes", self._total)
+
+    def invalidate(self):
+        """Drop everything (a ref update changed what any key may serve)."""
+        with self._lock:
+            n = len(self._entries)
+            self._entries.clear()
+            self._total = 0
+            if n:
+                tm.incr("server.enum_cache.evictions", n)
+            tm.gauge_set("server.enum_cache.bytes", 0)
+
+
+#: gitdir -> PackEnumCache for every repo this process serves (bounded: a
+#: long-lived test process churning tmp repos must not accrete caches)
+_ENUM_CACHES = OrderedDict()
+_ENUM_CACHES_MAX = 64
+_enum_caches_lock = threading.Lock()
+
+
+def enum_cache_for(repo):
+    """The (process-wide) enumeration cache serving ``repo``, or None when
+    disabled via ``KART_SERVE_ENUM_CACHE=0``."""
+    from kart_tpu.transport.retry import _env_int
+
+    budget = _env_int("KART_SERVE_ENUM_CACHE", DEFAULT_ENUM_CACHE_BYTES)
+    if budget <= 0:
+        return None
+    key = os.path.realpath(repo.gitdir)
+    with _enum_caches_lock:
+        cache = _ENUM_CACHES.get(key)
+        if cache is None or cache.budget != budget:
+            cache = _ENUM_CACHES[key] = PackEnumCache(budget)
+        _ENUM_CACHES.move_to_end(key)
+        while len(_ENUM_CACHES) > _ENUM_CACHES_MAX:
+            _ENUM_CACHES.popitem(last=False)
+    return cache
+
+
+def refs_fingerprint(repo):
+    """Digest of every (ref, oid) pair: part of each cache key, so a ref
+    update — even by another process (an ssh push landing while the HTTP
+    server runs) — changes every key rather than serving a stale walk."""
+    h = hashlib.sha256()
+    for ref, oid in sorted(repo.refs.iter_refs("refs/")):
+        h.update(f"{ref}\0{oid}\n".encode())
+    return h.hexdigest()
+
+
+def _enum_cache_key(repo, req):
+    payload = json.dumps(
+        {
+            # wants stay ordered: the walk order (and so the pack bytes)
+            # follows them; everything set-like is canonicalised
+            "wants": list(req.get("wants") or ()),
+            "haves": sorted(req.get("haves") or ()),
+            "have_shallow": sorted(req.get("have_shallow") or ()),
+            "depth": req.get("depth"),
+            "filter": req.get("filter"),
+            "exclude": sorted(req.get("exclude") or ()),
+            "refs": refs_fingerprint(repo),
+        },
+        sort_keys=True,
+    )
+    return hashlib.sha256(payload.encode()).hexdigest()
+
+
+def _etag_for(key):
+    """The strong validator for byte-range resume (If-Range): same key ⇒
+    byte-identical response, and the key embeds the ref fingerprint."""
+    return f'"{key[:32]}"'
+
+
+class FetchPlan:
+    """How to answer one fetch-pack request, produced by
+    :func:`serve_fetch_pack`:
+
+    * ``data`` set — a cache hit on stored framed bytes; send as-is.
+    * otherwise — drain ``source`` through ``write_framed`` (``header`` is
+      the deferred header callable), then ``publish()`` the spool /
+      ``abandon()`` on failure. ``cached`` marks whether ``source`` is a
+      cache replay (no walk ran).
+
+    ``etag`` is the strong validator the transports hand out for
+    byte-range resume; identical for hit, replay and fresh walks of the
+    same key."""
+
+    __slots__ = ("header", "data", "source", "etag", "cached", "_token", "_enum")
+
+    def __init__(self, header, data, source, etag, cached, token=None, enum=None):
+        self.header = header
+        self.data = data
+        self.source = source
+        self.etag = etag
+        self.cached = cached
+        self._token = token
+        self._enum = enum
+
+    def publish(self, spool, length):
+        """Memoize a freshly-spooled walk: small responses as their framed
+        bytes, big ones as the ordered oid list (``spool`` is left at EOF;
+        the caller rewinds)."""
+        if self._token is None:
+            return
+        header = self.header() if callable(self.header) else self.header
+        cache = self._token.cache
+        if length <= cache.bytes_cap:
+            spool.seek(0)
+            self._token.publish(header, data=spool.read(length))
+        elif self._enum is not None and self._enum.emitted is not None:
+            self._token.publish(header, emitted=list(self._enum.emitted))
+        else:
+            self._token.abandon()
+
+    def abandon(self):
+        if self._token is not None:
+            self._token.abandon()
+
+
+def iter_recorded(odb, emitted):
+    """Replay an enumeration from its recorded ``(type, oid)`` list:
+    byte-identical object stream, zero walk. Blob runs go through the
+    batched pack reader exactly like the original walk's flush."""
+    i, n = 0, len(emitted)
+    while i < n:
+        obj_type, oid = emitted[i]
+        if obj_type != "blob":
+            yield obj_type, odb.read_raw(oid)[1]
+            i += 1
+            continue
+        j = i
+        while j < n and emitted[j][0] == "blob":
+            j += 1
+        run = [oid for _, oid in emitted[i:j]]
+        SLICE = 1000
+        for k in range(0, len(run), SLICE):
+            chunk = run[k : k + SLICE]
+            batch = odb.read_blobs_batch(chunk)
+            for o in chunk:
+                blob = batch.get(o)
+                if blob is None:
+                    _, blob = odb.read_raw(o)
+                yield "blob", blob
+        i = j
+
+
+def _replay_source(cache, key, odb, emitted):
+    """iter_recorded, with poisoned-entry hygiene: an entry whose objects
+    have vanished (gc raced the cache) is evicted and the error surfaces —
+    the next request re-walks instead of re-hitting the corpse."""
+    try:
+        yield from iter_recorded(odb, emitted)
+    except Exception:
+        cache.evict(key)
+        raise
+
+
+def serve_fetch_pack(repo, req, *, use_cache=True):
+    """The cache-fronted fetch-pack verb: -> :class:`FetchPlan`.
+
+    First request for a key runs (and records) the walk; concurrent
+    requests for the same key block on it and hit; later requests hit
+    the memo. With the cache disabled (``KART_SERVE_ENUM_CACHE=0``, or
+    ``use_cache=False`` for single-connection servers where a memo could
+    never be re-hit) the plan is a plain fresh walk — still carrying the
+    deterministic etag, so byte-range resume works regardless."""
+    _count_fetch_request(req)
+    # an exclusion-bearing request is a one-shot resume: its key embeds the
+    # exact oids that happened to land before a tear, so no second request
+    # can ever hit it — memoizing would only evict hot repeatable entries.
+    # The etag/deterministic-replay contract holds regardless.
+    if req.get("exclude"):
+        use_cache = False
+    cache = enum_cache_for(repo) if use_cache else None
+    key = _enum_cache_key(repo, req)
+    etag = _etag_for(key)
+    if cache is None:
+        enum, header = make_fetch_enum(repo, req, count_request=False)
+        return FetchPlan(header, None, enum, etag, False)
+    mode, got = cache.lookup_or_begin(key)
+    if mode == "hit":
+        if got.data is not None:
+            return FetchPlan(got.header, got.data, None, got.etag, True)
+        return FetchPlan(
+            got.header,
+            None,
+            _replay_source(cache, key, repo.odb, got.emitted),
+            got.etag,
+            True,
+        )
+    try:
+        enum, header = make_fetch_enum(
+            repo, req, count_request=False, record_emitted=True
+        )
+    except BaseException:
+        # a pre-walk failure (malformed filter spec, unreadable shallow
+        # file) must release the fill token, or every later request for
+        # this key would block on an event nobody will ever set
+        if got is not None:
+            got.abandon()
+        raise
+    return FetchPlan(header, None, enum, etag, False, token=got, enum=enum)
+
+
+def materialise_plan(plan):
+    """-> (file-like at position 0, total length) of the complete framed
+    response for ``plan``; fresh walks are spooled, published into the
+    cache, and rewound. The caller owns (and must close) the handle."""
+    from kart_tpu.transport.http import write_framed
+
+    if plan.data is not None:
+        with tm.span("server.enum_replay"):
+            return io.BytesIO(plan.data), len(plan.data)
+    span = "server.enum_replay" if plan.cached else "server.enum_walk"
+    buf = tempfile.SpooledTemporaryFile(max_size=64 * 1024 * 1024)
+    try:
+        with tm.span(span):
+            write_framed(buf, plan.header, plan.source)
+        length = buf.tell()
+        plan.publish(buf, length)
+    except BaseException:
+        plan.abandon()
+        buf.close()
+        raise
+    buf.seek(0)
+    return buf, length
 
 
 def current_branch_ref(repo):
@@ -304,6 +694,13 @@ def _apply_validated_updates(repo, header):
             updated[ref] = new
     if header.get("shallow"):
         _update_shallow(repo, header["shallow"])
+    # a ref moved: enumeration keys embed the ref fingerprint so new
+    # requests re-key anyway, but drop the stale entries now rather than
+    # letting them squat in the LRU until evicted
+    with _enum_caches_lock:
+        cache = _ENUM_CACHES.get(os.path.realpath(repo.gitdir))
+    if cache is not None:
+        cache.invalidate()
     return updated
 
 

@@ -366,8 +366,8 @@ def serve_stdio(repo, in_fp, out_fp):
     from kart_tpu.transport.service import (
         collect_blobs,
         ls_refs_info,
-        make_fetch_enum,
         quarantined_receive,
+        serve_fetch_pack,
     )
 
     # a spawned server honours KART_LOG (stderr only — stdout is frames)
@@ -419,8 +419,14 @@ def serve_stdio(repo, in_fp, out_fp):
                         out_fp, {"metrics": sinks.prometheus_text()}, ()
                     )
                 elif op == "fetch-pack":
-                    enum, resp_header = make_fetch_enum(repo, header)
-                    write_framed(out_fp, resp_header, enum)
+                    # same code path and counters as the HTTP server, but
+                    # uncached: a serve-stdio process serves exactly one
+                    # connection and a client retry respawns it, so a memo
+                    # could never be re-hit. The plan streams straight to
+                    # the pipe (no materialise spool — stdio has no
+                    # byte-range to serve from an offset)
+                    plan = serve_fetch_pack(repo, header, use_cache=False)
+                    write_framed(out_fp, plan.header, plan.source)
                 elif op == "fetch-blobs":
                     resp_header, objects = collect_blobs(
                         repo, header.get("oids", [])

@@ -102,6 +102,29 @@ NEW_KEYS += [
     "skipped",
 ]
 
+#: keys added by ISSUE 7 (`bench.py --serve-storm`: aggregate concurrent
+#: clone throughput vs the serial cache-disabled baseline, tail latency,
+#: the enum-cache hit rate scraped from /api/v1/stats, and the
+#: kill-the-server-mid-storm leg where every client must resume to
+#: completion). Recorded in BENCH_r07.json.
+NEW_KEYS += [
+    "serve_storm_rows",
+    "serve_storm_clients",
+    "serve_storm_requests_total",
+    "serve_storm_agg_features_per_sec",
+    "serve_storm_serial_features_per_sec",
+    "serve_storm_speedup_vs_serial",
+    "serve_storm_p99_request_seconds",
+    "serve_enum_cache_hit_rate",
+    "serve_storm_fault_clients",
+    "serve_storm_fault_clients_ok",
+    # the env-ceiling context leg (same total requests, as many colocated
+    # clients as the host's cores can actually run concurrently)
+    "serve_storm_ceiling_clients",
+    "serve_storm_ceiling_agg_features_per_sec",
+    "serve_storm_ceiling_speedup_vs_serial",
+]
+
 
 def test_bench_emits_every_recorded_key():
     with open(os.path.join(REPO_ROOT, "bench.py")) as f:
@@ -127,3 +150,68 @@ def test_new_keys_not_yet_in_old_records_is_ok():
         src = f.read()
     missing = sorted(k for k in NEW_KEYS if f'"{k}"' not in src)
     assert not missing, missing
+
+#: keys measured by the r01-r06 era full `python bench.py` runs, pinned
+#: via the then-latest BENCH record until BENCH_r07 (a storm-only record)
+#: became the latest — pinned explicitly now so the guard no longer
+#: depends on WHICH record is newest
+NEW_KEYS += [
+    "backend",
+    "backend_init_seconds",
+    "backend_probe_attempts_utc",
+    "backend_probe_error",
+    "bbox_e2e_seconds",
+    "bbox_envelopes_per_sec",
+    "bbox_kernel_seconds",
+    "bbox_kernel_vs_numpy",
+    "bbox_numpy_seconds",
+    "bbox_resident_beats_numpy",
+    "bbox_resident_repeat_seconds",
+    "bbox_rows",
+    "cli_100m_diff_cold_seconds",
+    "cli_100m_diff_host_engine_seconds",
+    "cli_100m_diff_seconds",
+    "cli_100m_north_star_met",
+    "cli_100m_rows",
+    "cli_100m_spatial_beats_r4_bar",
+    "cli_100m_spatial_beats_unfiltered",
+    "cli_100m_spatial_diff_cold_seconds",
+    "cli_100m_spatial_diff_seconds",
+    "cli_100m_synth_seconds",
+    "cli_10m_polygon_diff_cold_seconds",
+    "cli_10m_polygon_diff_seconds",
+    "cli_diff_columnar_cold_seconds",
+    "cli_diff_columnar_seconds",
+    "cli_diff_rows",
+    "cli_diff_rows_per_sec",
+    "cli_diff_tree_seconds",
+    "cli_import_seconds",
+    "cli_import_seconds_median",
+    "device_kernel_rate",
+    "device_kind",
+    "estimation_error_pct",
+    "estimation_rows",
+    "estimation_seconds",
+    "features_materialised_per_sec",
+    "host_native_rate",
+    "host_native_vs_reference",
+    "import_features_per_sec",
+    "materialise_vs_reference",
+    "merge_classify_seconds",
+    "merge_conflict_rows",
+    "merge_conflicts_per_sec",
+    "merge_index_read_seconds",
+    "merge_index_write_seconds",
+    "merge_materialise_seconds",
+    "metric",
+    "n_devices",
+    "numpy_twin_rate",
+    "poly_rows",
+    "poly_synth_seconds",
+    "reference_loop_rate",
+    "reference_materialise_rate",
+    "unit",
+    "value",
+    "vs_baseline",
+    "vs_numpy_twin",
+]

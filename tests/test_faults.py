@@ -912,3 +912,125 @@ def test_cli_diff_survives_device_transfer_fault(tmp_path, monkeypatch):
         return _json.loads("\n".join(lines[lo : hi + 1]))
 
     assert diff_payload(faulted.output) == diff_payload(host.output)
+
+
+# ---------------------------------------------------------------------------
+# concurrent object server: enum-cache + shed fault points (ISSUE 7)
+# ---------------------------------------------------------------------------
+
+
+def test_poisoned_enum_cache_fill_is_never_served(
+    served_repo, tmp_path, monkeypatch
+):
+    """A fault at the cache-publish frame poisons nothing: the entry is
+    never inserted, the failing request surfaces its error, and the next
+    identical request re-walks cleanly instead of hitting a corpse."""
+    from kart_tpu import telemetry
+
+    repo, _, url = served_repo
+    telemetry.reset(disable=False)  # fresh counters; keep metrics enabled
+    client = HttpRemote(url, retry=RetryPolicy(attempts=1))
+    wants = list(client.ls_refs()["heads"].values())
+
+    monkeypatch.setenv("KART_FAULTS", "server.enum_cache:1")  # publish frame
+    dst1 = KartRepo.init_repository(tmp_path / "dst1")
+    with pytest.raises(HttpTransportError, match="InjectedFault"):
+        client.fetch_pack(dst1, wants)
+    monkeypatch.delenv("KART_FAULTS")
+
+    dst2 = KartRepo.init_repository(tmp_path / "dst2")
+    header = client.fetch_pack(dst2, wants)
+    assert fsck_objects(dst2) == header["object_count"]
+
+    def count(name):
+        for n, l, v in telemetry.snapshot()["counters"]:
+            if n == name and not l:
+                return v
+        return 0
+
+    # both requests were misses (the poisoned fill published nothing);
+    # nothing was ever served from a poisoned entry
+    assert count("server.enum_cache.misses") == 2
+    assert count("server.enum_cache.hits") == 0
+
+
+def test_server_killed_mid_cached_stream_client_resumes_via_kart_fetch(
+    tmp_path, monkeypatch
+):
+    """ISSUE 7 kill matrix: a server dying while streaming a *cached* pack
+    (KART_FAULTS=server.enum_cache mid-chunk truncates the response like a
+    process kill) leaves the interrupted clone resumable — the kept partial
+    repo completes via `kart fetch`, shipping only the remainder."""
+    from kart_tpu.synth import synth_repo
+
+    src, _ = synth_repo(
+        str(tmp_path / "src"), 30_000, blobs="real", edit_frac=0.0
+    )
+    server = make_server(src)
+    threading.Thread(target=server.serve_forever, daemon=True).start()
+    url = f"http://127.0.0.1:{server.server_address[1]}/"
+    try:
+        # warm the cache with one full clone
+        warm = transport.clone(url, tmp_path / "warm", do_checkout=False)
+        assert warm.head_commit_oid == src.head_commit_oid
+
+        # the next clone is served from the cache and torn after the first
+        # 1MB chunk; a single-attempt policy makes the tear fatal in-process
+        monkeypatch.setenv("KART_TRANSPORT_RETRIES", "1")
+        monkeypatch.setenv("KART_FAULTS", "server.enum_cache:2")
+        with pytest.raises(RemoteError, match="partial clone kept"):
+            transport.clone(url, tmp_path / "torn", do_checkout=False)
+        monkeypatch.delenv("KART_FAULTS")
+        monkeypatch.delenv("KART_TRANSPORT_RETRIES")
+
+        torn = KartRepo(str(tmp_path / "torn"))
+        salvaged = sum(1 for _ in torn.odb.iter_oids())
+        assert salvaged > 0, "nothing salvaged from the torn cached stream"
+        assert torn.read_gitdir_file(FETCH_RESUME_FILE) is not None
+
+        # `kart fetch` resumes: remainder only, store completes fsck-clean
+        transport.fetch(torn, "origin")
+        assert torn.read_gitdir_file(FETCH_RESUME_FILE) is None
+        total = fsck_objects(torn)
+        assert total == fsck_objects(warm)
+        assert salvaged < total  # the resume shipped a remainder, not a restart
+        tip = src.head_commit_oid
+        assert torn.refs.get("refs/remotes/origin/main") == tip
+    finally:
+        server.shutdown()
+        server.server_close()
+
+
+def test_shed_fault_is_retried_honouring_retry_after(
+    served_repo, monkeypatch
+):
+    """An armed KART_FAULTS=server.shed sheds one request with 429 +
+    Retry-After; the client policy retries after (at least) the advertised
+    floor and the verb completes transparently."""
+    repo, _, url = served_repo
+    monkeypatch.setenv("KART_SERVE_RETRY_AFTER", "3")
+    monkeypatch.setenv("KART_FAULTS", "server.shed:1")
+    sleeps = []
+    client = HttpRemote(
+        url, retry=RetryPolicy(attempts=2, base_delay=0.01, sleep=sleeps.append)
+    )
+    info = client.ls_refs()  # first attempt shed, second succeeds
+    monkeypatch.delenv("KART_FAULTS")
+    assert info["heads"]
+    assert sleeps == [3.0]  # the server's Retry-After floored the backoff
+
+
+def test_shed_push_is_retried_transparently(served_repo, tmp_path, monkeypatch):
+    """A shedding 429 provably precedes any server-side processing, so even
+    the non-idempotent receive-pack retries it: a push caught by the load
+    shedder joins the paced queue instead of hard-failing."""
+    repo, ds_path, url = served_repo
+    clone = transport.clone(url, tmp_path / "clone", do_checkout=False)
+    clone.config.set_many({"user.name": "C", "user.email": "c@x"})
+    oid = edit_commit(clone, ds_path, deletes=[5], message="shed push")
+    # hit 1 is the push's ls-refs admission; hit 2 sheds the receive-pack
+    monkeypatch.setenv("KART_FAULTS", "server.shed:2")
+    updated = transport.push(clone, "origin")
+    monkeypatch.delenv("KART_FAULTS")
+    assert updated == {"refs/heads/main": oid}
+    assert repo.refs.get("refs/heads/main") == oid

@@ -1,0 +1,495 @@
+"""High-concurrency object server (ISSUE 7): the shared pack-enumeration
+cache (keyed, single-flighted, LRU-bounded, ref-update invalidated),
+byte-range resume of torn fetch-pack streams, load shedding with
+Retry-After, and the narrowed push lock under concurrent pushes."""
+
+import json
+import os
+import threading
+import time
+
+import pytest
+
+from kart_tpu import telemetry
+from kart_tpu import transport
+from kart_tpu.core.repo import KartRepo
+from kart_tpu.transport.http import HttpRemote, HttpTransportError, make_server
+from kart_tpu.transport.protocol import ObjectEnumerator
+from kart_tpu.transport.remote import RemoteError
+from kart_tpu.transport.retry import RETRY_AFTER_CAP, RetryPolicy
+
+from helpers import edit_commit, make_imported_repo
+
+
+@pytest.fixture(autouse=True)
+def _fresh_metrics():
+    """Each test reads counters from a clean registry (make_server enables
+    metrics process-globally)."""
+    telemetry.reset()
+    yield
+    telemetry.reset()
+
+
+@pytest.fixture(autouse=True)
+def _fast_retries(monkeypatch):
+    monkeypatch.setenv("KART_TRANSPORT_RETRY_BASE", "0.01")
+    monkeypatch.setenv("KART_TRANSPORT_RETRY_CAP", "0.05")
+    monkeypatch.delenv("KART_FAULTS", raising=False)
+    monkeypatch.delenv("KART_SERVE_ENUM_CACHE", raising=False)
+    monkeypatch.delenv("KART_SERVE_MAX_INFLIGHT", raising=False)
+
+
+@pytest.fixture()
+def served_repo(tmp_path):
+    repo, ds_path = make_imported_repo(tmp_path, n=12)
+    repo.config["receive.denyCurrentBranch"] = "ignore"
+    server = make_server(repo)
+    threading.Thread(target=server.serve_forever, daemon=True).start()
+    url = f"http://127.0.0.1:{server.server_address[1]}/"
+    yield repo, ds_path, url
+    server.shutdown()
+    server.server_close()
+
+
+def counter(name, **labels):
+    for n, l, v in telemetry.snapshot()["counters"]:
+        if n == name and l == labels:
+            return v
+    return 0
+
+
+def fresh_dst(tmp_path, name):
+    return KartRepo.init_repository(str(tmp_path / name))
+
+
+# ---------------------------------------------------------------------------
+# enum cache: single-flight, hits, invalidation, LRU
+# ---------------------------------------------------------------------------
+
+
+def test_second_concurrent_clone_same_key_runs_zero_extra_walks(
+    served_repo, tmp_path, monkeypatch
+):
+    """ISSUE 7 acceptance: a second concurrent clone of the same
+    (refs, filter) key performs ZERO additional ObjectEnumerator walks —
+    it single-flights on the first walk and serves from the memo, asserted
+    via both a walk counter and the cache's own counters."""
+    from kart_tpu.transport import service
+
+    repo, _, url = served_repo
+    walks = []
+    orig_iter = ObjectEnumerator.__iter__
+
+    def counting_iter(enum):
+        walks.append(1)
+        time.sleep(0.6)  # hold the walk open so the peer provably overlaps
+        return orig_iter(enum)
+
+    monkeypatch.setattr(ObjectEnumerator, "__iter__", counting_iter)
+
+    client = HttpRemote(url)
+    wants = list(client.ls_refs()["heads"].values())
+    dsts = [fresh_dst(tmp_path, "c1"), fresh_dst(tmp_path, "c2")]
+    headers, errors = [None, None], []
+
+    def fetch(i):
+        try:
+            c = HttpRemote(url)
+            headers[i] = c.fetch_pack(dsts[i], wants)
+        except Exception as e:  # kart: noqa(KTL006): re-raised below via the errors list — a bare thread would swallow the failure entirely
+            errors.append(e)
+
+    t1 = threading.Thread(target=fetch, args=(0,))
+    t2 = threading.Thread(target=fetch, args=(1,))
+    t1.start()
+    time.sleep(0.15)  # t1 is inside its (slowed) walk when t2 arrives
+    t2.start()
+    t1.join()
+    t2.join()
+    assert not errors
+    assert len(walks) == 1, "second concurrent clone re-ran the walk"
+    assert counter("server.enum_cache.misses") == 1
+    assert counter("server.enum_cache.singleflight_waits") == 1
+    assert counter("server.enum_cache.hits") == 1
+    # both clients received the complete, identical object set
+    oids1 = sorted(dsts[0].odb.iter_oids())
+    oids2 = sorted(dsts[1].odb.iter_oids())
+    assert oids1 == oids2 and len(oids1) == headers[0]["object_count"]
+    assert headers[0] == headers[1]
+
+
+def test_sequential_repeat_fetch_hits_cache(served_repo, tmp_path, monkeypatch):
+    repo, _, url = served_repo
+    walks = []
+    orig_iter = ObjectEnumerator.__iter__
+    monkeypatch.setattr(
+        ObjectEnumerator,
+        "__iter__",
+        lambda e: (walks.append(1), orig_iter(e))[1],
+    )
+    client = HttpRemote(url)
+    wants = list(client.ls_refs()["heads"].values())
+    a, b = fresh_dst(tmp_path, "a"), fresh_dst(tmp_path, "b")
+    h1 = client.fetch_pack(a, wants)
+    h2 = client.fetch_pack(b, wants)
+    assert h1 == h2
+    assert len(walks) == 1
+    assert counter("server.enum_cache.hits") == 1
+    assert counter("server.enum_cache.misses") == 1
+    # the cached replay is byte-identical: both stores hold the same oids
+    assert sorted(a.odb.iter_oids()) == sorted(b.odb.iter_oids())
+
+
+def test_cache_disabled_by_env_still_serves(served_repo, tmp_path, monkeypatch):
+    monkeypatch.setenv("KART_SERVE_ENUM_CACHE", "0")
+    repo, _, url = served_repo
+    client = HttpRemote(url)
+    wants = list(client.ls_refs()["heads"].values())
+    client.fetch_pack(fresh_dst(tmp_path, "a"), wants)
+    client.fetch_pack(fresh_dst(tmp_path, "b"), wants)
+    assert counter("server.enum_cache.hits") == 0
+    assert counter("server.enum_cache.misses") == 0
+
+
+def test_bad_filter_request_releases_the_fill_token(served_repo, tmp_path):
+    """A pre-walk failure (malformed filter spec) must abandon the
+    single-flight token: a repeated identical request fails fast instead
+    of blocking on an event nobody will ever set."""
+    repo, _, url = served_repo
+    client = HttpRemote(url, retry=RetryPolicy(attempts=1))
+    wants = list(client.ls_refs()["heads"].values())
+    for attempt in range(2):
+        t0 = time.monotonic()
+        with pytest.raises(HttpTransportError):
+            client.fetch_pack(
+                fresh_dst(tmp_path, f"bad{attempt}"),
+                wants,
+                filter_spec="not-a-bbox",
+            )
+        assert time.monotonic() - t0 < 10, (
+            "second identical bad request blocked on a leaked fill token"
+        )
+    # and the key is not poisoned for the cache bookkeeping either
+    assert counter("server.enum_cache.hits") == 0
+
+
+def test_ref_update_invalidates_cache(served_repo, tmp_path):
+    """A push both re-keys (ref fingerprint) and drops stale entries — a
+    client fetching after the push sees the new commit, never a stale
+    memoized walk."""
+    repo, ds_path, url = served_repo
+    client = HttpRemote(url)
+    wants = list(client.ls_refs()["heads"].values())
+    client.fetch_pack(fresh_dst(tmp_path, "warm"), wants)
+    assert counter("server.enum_cache.misses") == 1
+
+    # push a new commit from a clone
+    clone = transport.clone(url, tmp_path / "clone", do_checkout=False)
+    clone.config.set_many({"user.name": "C", "user.email": "c@example.com"})
+    new_oid = edit_commit(clone, ds_path, deletes=[2], message="edit")
+    transport.push(clone, "origin")
+    evictions = counter("server.enum_cache.evictions")
+    assert evictions >= 1  # apply_ref_updates dropped the stale entries
+
+    dst = fresh_dst(tmp_path, "after")
+    new_wants = list(client.ls_refs()["heads"].values())
+    assert new_wants == [new_oid]
+    client.fetch_pack(dst, new_wants)
+    assert dst.odb.contains(new_oid)
+
+
+def test_lru_byte_budget_evicts(served_repo, tmp_path, monkeypatch):
+    """KART_SERVE_ENUM_CACHE bounds the memo: a budget smaller than two
+    entries evicts the older one (counted)."""
+    monkeypatch.setenv("KART_SERVE_ENUM_CACHE", "2048")
+    repo, _, url = served_repo
+    client = HttpRemote(url)
+    info = client.ls_refs()
+    wants = list(info["heads"].values())
+    # two different keys: a full fetch and a filtered variant (haves differ)
+    client.fetch_pack(fresh_dst(tmp_path, "a"), wants)
+    client.fetch_pack(fresh_dst(tmp_path, "b"), wants, haves=wants)
+    assert counter("server.enum_cache.misses") == 2
+    assert counter("server.enum_cache.evictions") >= 1
+
+
+def test_oid_list_replay_tier_byte_identical(served_repo, tmp_path, monkeypatch):
+    """Entries too big for the raw-bytes tier memoize the ordered oid list
+    instead; the replay (no walk) produces the identical object set."""
+    monkeypatch.setenv("KART_SERVE_ENUM_CACHE", "4096")  # bytes cap = 512
+    repo, _, url = served_repo
+    walks = []
+    orig_iter = ObjectEnumerator.__iter__
+    monkeypatch.setattr(
+        ObjectEnumerator,
+        "__iter__",
+        lambda e: (walks.append(1), orig_iter(e))[1],
+    )
+    client = HttpRemote(url)
+    wants = list(client.ls_refs()["heads"].values())
+    a, b = fresh_dst(tmp_path, "a"), fresh_dst(tmp_path, "b")
+    client.fetch_pack(a, wants)
+    client.fetch_pack(b, wants)
+    assert len(walks) == 1  # second serve replayed the recorded oid list
+    assert counter("server.enum_cache.hits") == 1
+    assert sorted(a.odb.iter_oids()) == sorted(b.odb.iter_oids())
+
+
+# ---------------------------------------------------------------------------
+# byte-range resume
+# ---------------------------------------------------------------------------
+
+
+def test_torn_fetch_resumes_mid_pack_by_byte_range(
+    served_repo, tmp_path, monkeypatch
+):
+    """A client-side tear mid-packstream retries with Range/If-Range and
+    the server answers 206 from the same deterministic enumeration — the
+    stream continues at the exact record boundary, no restart."""
+    repo, _, url = served_repo
+    client = HttpRemote(url, retry=RetryPolicy(attempts=3, base_delay=0.01))
+    wants = list(client.ls_refs()["heads"].values())
+    dst = fresh_dst(tmp_path, "dst")
+    monkeypatch.setenv("KART_FAULTS", "transport.read.frame:9")
+    try:
+        header = client.fetch_pack(dst, wants)
+    finally:
+        monkeypatch.delenv("KART_FAULTS", raising=False)
+    assert counter("server.range_resumes") == 1
+    assert counter("transport.range_resumes") == 1
+    got = sum(1 for _ in dst.odb.iter_oids())
+    assert got == header["object_count"]
+
+
+def test_range_request_with_stale_validator_gets_full_response(
+    served_repo, tmp_path
+):
+    """If-Range with a wrong etag must never splice two enumerations: the
+    server falls back to a 200 full response."""
+    import urllib.request
+
+    repo, _, url = served_repo
+    client = HttpRemote(url)
+    wants = list(client.ls_refs()["heads"].values())
+    body = json.dumps(
+        {"wants": wants, "haves": [], "have_shallow": [], "depth": None,
+         "filter": None, "exclude": []}
+    ).encode()
+    req = urllib.request.Request(
+        url.rstrip("/") + "/api/v1/fetch-pack",
+        data=body,
+        headers={
+            "Content-Type": "application/json",
+            "Range": "bytes=64-",
+            "If-Range": '"not-the-right-etag"',
+        },
+        method="POST",
+    )
+    with urllib.request.urlopen(req, timeout=30) as resp:
+        assert resp.status == 200
+        assert resp.headers.get("ETag")
+        data = resp.read()
+    # a full framed response: starts with the 8-byte header length
+    n = int.from_bytes(data[:8], "big")
+    assert json.loads(data[8 : 8 + n])["object_count"] > 0
+
+
+# ---------------------------------------------------------------------------
+# load shedding
+# ---------------------------------------------------------------------------
+
+
+def test_inflight_ceiling_sheds_with_retry_after(served_repo, tmp_path, monkeypatch):
+    """With KART_SERVE_MAX_INFLIGHT=1, a request arriving while another is
+    being served gets 429 + Retry-After (and the client error carries it)."""
+    monkeypatch.setenv("KART_SERVE_MAX_INFLIGHT", "1")
+    monkeypatch.setenv("KART_SERVE_RETRY_AFTER", "7")
+    repo, _, url = served_repo
+    release = threading.Event()
+    entered = threading.Event()
+    orig_iter = ObjectEnumerator.__iter__
+
+    def slow_iter(enum):
+        entered.set()
+        release.wait(10)
+        return orig_iter(enum)
+
+    monkeypatch.setattr(ObjectEnumerator, "__iter__", slow_iter)
+    client = HttpRemote(url, retry=RetryPolicy(attempts=1))
+    wants = list(client.ls_refs()["heads"].values())
+
+    t = threading.Thread(
+        target=lambda: HttpRemote(url, retry=RetryPolicy(attempts=1)).fetch_pack(
+            fresh_dst(tmp_path, "slow"), wants
+        ),
+    )
+    t.start()
+    try:
+        assert entered.wait(10)
+        with pytest.raises(HttpTransportError) as exc:
+            client.ls_refs()
+        assert exc.value.transient  # 429 is retryable
+        assert exc.value.retry_after == 7.0
+        assert counter("server.shed") == 1
+        # observability of an overloaded server is the point: the stats
+        # endpoint bypasses admission control and still answers
+        from kart_tpu.cli.stats_cmds import fetch_remote_stats
+
+        assert "kart_server_shed_total 1" in fetch_remote_stats(url)
+    finally:
+        release.set()
+        t.join()
+
+
+def test_retry_after_floors_backoff():
+    """RetryPolicy honours a server-sent Retry-After as the backoff floor,
+    capped, and never *lowers* a larger exponential delay."""
+    def run(retry_after, base=0.01, attempts=2):
+        sleeps = []
+        policy = RetryPolicy(attempts=attempts, base_delay=base, sleep=sleeps.append)
+        calls = []
+
+        def fn():
+            calls.append(1)
+            if len(calls) < attempts:
+                raise HttpTransportError(
+                    "shed", transient=True, retry_after=retry_after
+                )
+            return "ok"
+
+        assert policy.call(fn) == "ok"
+        return sleeps
+
+    # floor: the header wins over a tiny exponential delay
+    assert run(5.0) == [5.0]
+    # cap: a hostile header can't park the client beyond RETRY_AFTER_CAP
+    assert run(10_000.0) == [RETRY_AFTER_CAP]
+    # a larger computed backoff is kept (the header is a floor, not a cap)
+    sleeps = run(0.001, base=2.0)
+    assert sleeps == [2.0]
+    # absent/garbage headers change nothing
+    assert run(None) == [0.01]
+
+
+def test_retry_after_header_parsed_seconds_form_only(served_repo, monkeypatch):
+    from kart_tpu.transport.http import _retry_after_of
+
+    class _E:
+        def __init__(self, headers):
+            self.headers = headers
+
+    assert _retry_after_of(_E({"Retry-After": "3"})) == 3.0
+    assert _retry_after_of(_E({"Retry-After": "2.5"})) == 2.5
+    assert _retry_after_of(_E({"Retry-After": "Wed, 21 Oct 2015"})) is None
+    assert _retry_after_of(_E({})) is None
+    assert _retry_after_of(_E({"Retry-After": "-1"})) is None
+
+
+# ---------------------------------------------------------------------------
+# narrowed push lock: concurrent pushes
+# ---------------------------------------------------------------------------
+
+
+def _snapshot_store(repo):
+    import hashlib
+
+    objects_dir = repo.odb.objects_dir
+    snap = {}
+    for root, dirs, files in os.walk(objects_dir):
+        if "quarantine" in root:
+            continue
+        for name in files:
+            p = os.path.join(root, name)
+            with open(p, "rb") as f:
+                snap[os.path.relpath(p, objects_dir)] = hashlib.sha256(
+                    f.read()
+                ).hexdigest()
+    return snap
+
+
+def test_concurrent_pushes_to_different_branches_both_land(
+    served_repo, tmp_path
+):
+    """The push lock covers only ref validation + migrate: two pushes to
+    *different* branches drain their quarantines concurrently and both
+    land."""
+    repo, ds_path, url = served_repo
+    results, errors = {}, []
+
+    def push_branch(i):
+        try:
+            clone = transport.clone(
+                url, tmp_path / f"clone{i}", do_checkout=False
+            )
+            clone.config.set_many(
+                {"user.name": f"C{i}", "user.email": f"c{i}@example.com"}
+            )
+            oid = edit_commit(
+                clone, ds_path, deletes=[i + 1], message=f"edit {i}"
+            )
+            results[i] = (oid, transport.push(clone, "origin", [f"main:b{i}"]))
+        except Exception as e:  # kart: noqa(KTL006): re-raised below via the errors list — a bare thread would swallow the failure entirely
+            errors.append(e)
+
+    threads = [
+        threading.Thread(target=push_branch, args=(i,)) for i in range(2)
+    ]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert not errors
+    for i in range(2):
+        oid, updated = results[i]
+        assert updated == {f"refs/heads/b{i}": oid}
+        assert repo.refs.get(f"refs/heads/b{i}") == oid
+        assert repo.odb.contains(oid)
+
+
+def test_contended_same_ref_push_cas_exactly_one_winner(served_repo, tmp_path):
+    repo, ds_path, url = served_repo
+    outcomes = []
+
+    def push_main(i):
+        try:
+            clone = transport.clone(
+                url, tmp_path / f"w{i}", do_checkout=False
+            )
+            clone.config.set_many(
+                {"user.name": f"W{i}", "user.email": f"w{i}@example.com"}
+            )
+            edit_commit(clone, ds_path, deletes=[i + 3], message=f"race {i}")
+            transport.push(clone, "origin")
+            outcomes.append("ok")
+        except RemoteError:
+            outcomes.append("conflict")
+
+    threads = [threading.Thread(target=push_main, args=(i,)) for i in range(2)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert sorted(outcomes) == ["conflict", "ok"]
+
+
+def test_rejected_stale_push_leaves_store_byte_identical(served_repo, tmp_path):
+    """CAS reject after a contending push landed: the loser's quarantine is
+    discarded and the served store is byte-identical to the winner-only
+    state."""
+    repo, ds_path, url = served_repo
+    # both clones start from the same tip
+    c1 = transport.clone(url, tmp_path / "c1", do_checkout=False)
+    c2 = transport.clone(url, tmp_path / "c2", do_checkout=False)
+    for i, c in enumerate((c1, c2)):
+        c.config.set_many(
+            {"user.name": f"P{i}", "user.email": f"p{i}@example.com"}
+        )
+    edit_commit(c1, ds_path, deletes=[5], message="winner")
+    edit_commit(c2, ds_path, deletes=[6], message="loser")
+    transport.push(c1, "origin")
+    before = _snapshot_store(repo)
+    tip_before = repo.refs.get("refs/heads/main")
+    with pytest.raises(RemoteError, match="non-fast-forward|moved"):
+        transport.push(c2, "origin")
+    assert _snapshot_store(repo) == before
+    assert repo.refs.get("refs/heads/main") == tip_before

@@ -457,7 +457,7 @@ def test_stats_reports_fault_injected_fetch_resume(tmp_path, cli_runner, monkeyp
         assert _metric(text, "kart_transport_retries_total", verb="fetch-pack") == 1
         assert _metric(text, "kart_transport_salvage_events_total") == 1
         # ...and the server saw exactly one resumed fetch-pack (two requests,
-        # the second carrying the salvaged-oid exclusion list)
+        # the second a byte-range resume of the torn stream)
         assert (
             _metric(text, "kart_transport_server_requests_total", verb="fetch-pack")
             == 2
