@@ -2206,10 +2206,363 @@ def serve_storm_main():
         print(json.dumps(record), flush=True)
 
 
+# ---------------------------------------------------------------------------
+# --merge-storm: K writers hammering one branch (ISSUE 9, docs/SERVING.md §6)
+# ---------------------------------------------------------------------------
+
+
+def _storm_edit_commit(repo, ds_path, *, deletes=(), updates=(), message="edit"):
+    """Build + commit a tiny feature diff (the shared helper in
+    kart_tpu.synth; tests/helpers.edit_commit rides the same one)."""
+    from kart_tpu.synth import commit_feature_edits
+
+    return commit_feature_edits(
+        repo, ds_path, deletes=deletes, updates=updates, message=message
+    )
+
+
+def merge_storm_worker():
+    """One storm writer process. argv after the flag:
+    ``url base n_commits mode fid_base``. Modes:
+
+    * ``disjoint`` — each commit deletes its own feature; every push must
+      land (the server rebases CAS losers), counting wire attempts so the
+      driver can compute retry amplification, and collecting each push's
+      server-reported merge-queue wait.
+    * ``overlap`` — one commit updating feature 1 (every writer collides):
+      exactly one writer lands, the rest must be rejected terminally after
+      exactly one attempt.
+    * ``resilient`` — disjoint edits pushed through transport.push with
+      patient outer retries: the server being SIGKILLed mid-storm is the
+      scenario; the writer must land once it returns.
+    """
+    import sys
+
+    i = sys.argv.index("--merge-storm-worker")
+    url, base, n_commits, mode, fid_base = sys.argv[i + 1 : i + 6]
+    n_commits, fid_base = int(n_commits), int(fid_base)
+
+    from kart_tpu import transport
+    from kart_tpu.transport.http import (
+        HttpRemote,
+        HttpTransportError,
+        have_closure,
+    )
+    from kart_tpu.transport.protocol import ObjectEnumerator
+    from kart_tpu.transport.retry import RetryPolicy
+
+    os.makedirs(base, exist_ok=True)
+    if hasattr(os, "sched_setaffinity") and os.environ.get(
+        "KART_BENCH_STORM_PIN", "1"
+    ) != "0":
+        try:
+            cpus = sorted(os.sched_getaffinity(0))
+            idx = int(re.sub(r"\D", "", os.path.basename(base)) or 0)
+            os.sched_setaffinity(0, {cpus[idx % len(cpus)]})
+        except (OSError, ValueError) as e:
+            print(f"storm worker pin failed: {e}", file=sys.stderr)
+
+    repo = transport.clone(url, os.path.join(base, "clone"), do_checkout=False)
+    repo.config.set_many(
+        {"user.name": os.path.basename(base), "user.email": "w@storm"}
+    )
+    ds_path = "synth"
+    # synth pks are hashed ints, not 1..n: ``fid_base`` indexes the sorted
+    # pk list (identical in every clone of one leg, so index ranges stay
+    # disjoint across writers)
+    pks = sorted(
+        f["fid"] for f in repo.datasets("HEAD")[ds_path].features()
+    )
+    print(json.dumps({"ready": True}), flush=True)
+    sys.stdin.readline()  # the storm barrier
+
+    out = {
+        "ok": True, "landed": 0, "attempts": 0, "conflicts": 0,
+        "cas_failures": 0, "queue_waits": [], "push_seconds": [],
+        "start": time.time(),
+    }
+
+    def push_once(client, new_oid, prev_oid):
+        """One wire push attempt with the freshly observed tip as CAS base;
+        -> the server's full receive payload."""
+        info = client.ls_refs()
+        old = info["heads"].get("main")
+        # the server provably holds our previously-landed commit: its
+        # closure (not the unknown server merge commits) prunes the pack
+        has = have_closure(repo.odb, [prev_oid] if prev_oid else [], ())
+        enum = ObjectEnumerator(repo.odb, [new_oid], has=has.__contains__)
+        return client.receive_pack(
+            enum,
+            [{"ref": "refs/heads/main", "old": old, "new": new_oid,
+              "force": False}],
+        )
+
+    if mode == "resilient":
+        deadline = time.time() + float(
+            os.environ.get("KART_BENCH_STORM_FAULT_DEADLINE", 180)
+        )
+        oid = _storm_edit_commit(
+            repo, ds_path, deletes=[pks[fid_base]],
+            message=f"resilient {fid_base}",
+        )
+        done = False
+        while time.time() < deadline and not done:
+            out["attempts"] += 1
+            try:
+                transport.push(repo, "origin")
+                done = True
+            except Exception as e:
+                # the killed/restarting server IS the scenario: keep trying
+                print(f"push attempt failed: {e}", file=sys.stderr)
+                time.sleep(0.5)
+        out["ok"] = done
+        out["landed"] = int(done)
+        out["end"] = time.time()
+        print(json.dumps(out), flush=True)
+        return
+
+    client = HttpRemote(url, retry=RetryPolicy(attempts=1))
+    prev = None
+    for j in range(n_commits):
+        if mode == "overlap":
+            # every writer rewrites the SAME feature with its own value
+            new_oid = _storm_edit_commit(
+                repo, ds_path,
+                updates=[{"fid": pks[0], "rating": 1000.0 + fid_base}],
+                message=f"overlap {fid_base}",
+            )
+        else:
+            new_oid = _storm_edit_commit(
+                repo, ds_path, deletes=[pks[fid_base + j]],
+                message=f"disjoint {fid_base + j}",
+            )
+        landed = False
+        t0 = time.perf_counter()
+        for _ in range(60):
+            out["attempts"] += 1
+            try:
+                result = push_once(client, new_oid, prev)
+                landed = True
+                rebase = result.get("rebase") or {}
+                out["queue_waits"].append(
+                    float(rebase.get("queue_wait_seconds") or 0.0)
+                )
+                break
+            except HttpTransportError as e:
+                if getattr(e, "terminal", False) and getattr(
+                    e, "conflict_report", None
+                ):
+                    out["conflicts"] += 1
+                    break  # terminal: exactly this one attempt, no re-push
+                if getattr(e, "shed", False):
+                    time.sleep(min(float(e.retry_after or 0.1), 2.0))
+                    continue
+                if "moved" in str(e) or "fast-forward" in str(e):
+                    # the failure the merge service exists to remove
+                    out["cas_failures"] += 1
+                    continue
+                print(f"push failed: {e}", file=sys.stderr)
+                out["ok"] = False
+                break
+        out["push_seconds"].append(time.perf_counter() - t0)
+        if landed:
+            out["landed"] += 1
+            prev = new_oid
+        elif mode != "overlap":
+            out["ok"] = False
+            break
+    out["end"] = time.time()
+    print(json.dumps(out), flush=True)
+
+
+def merge_storm_main():
+    """The contended-writer bench (docs/SERVING.md §6): K writer processes
+    hammering one branch through `kart serve`. Legs: disjoint-feature
+    commits (all must land, zero client-visible CAS failures, retry
+    amplification ~1), an overlapping-feature leg (conflicts rejected
+    terminally after exactly one attempt), and a SIGKILL-the-server
+    mid-storm leg (every writer lands once it returns). Prints the record
+    after each leg so a watchdog kill salvages the finished legs."""
+    import math
+    import subprocess
+    import sys
+    import tempfile
+    from urllib.request import urlopen
+
+    writers = int(os.environ.get("KART_BENCH_MERGE_WRITERS", 8))
+    per_writer = int(os.environ.get("KART_BENCH_MERGE_COMMITS", 3))
+    rows = int(os.environ.get("KART_BENCH_MERGE_ROWS", 3000))
+    fault_writers = int(os.environ.get("KART_BENCH_MERGE_FAULT_WRITERS", 6))
+
+    from kart_tpu.synth import synth_repo
+
+    shm = "/dev/shm" if os.path.isdir("/dev/shm") else None
+    with tempfile.TemporaryDirectory(dir=shm) as td:
+        src, _ = synth_repo(
+            os.path.join(td, "src"), rows, blobs="real", edit_frac=0.0
+        )
+        src.config["receive.denyCurrentBranch"] = "ignore"
+        workdir = src.workdir or src.gitdir
+
+        record = {
+            "metric": "merge_storm",
+            "merge_storm_writers": writers,
+            "merge_storm_commits_total": writers * per_writer,
+            "ok": True,
+        }
+
+        def spawn_writers(url, leg, n, n_commits, mode, fid0, fid_stride):
+            # each leg owns a disjoint fid range of the shared source repo:
+            # a writer deleting a feature another leg already removed would
+            # fail locally, not exercise the server
+            procs = []
+            try:
+                for i in range(n):
+                    p = subprocess.Popen(
+                        [
+                            sys.executable, os.path.abspath(__file__),
+                            "--merge-storm-worker", url,
+                            os.path.join(td, leg, f"w{i}"),
+                            str(n_commits), mode, str(fid0 + i * fid_stride),
+                        ],
+                        env=_storm_env(),
+                        stdin=subprocess.PIPE,
+                        stdout=subprocess.PIPE,
+                        stderr=subprocess.PIPE,
+                        text=True,
+                    )
+                    procs.append(p)
+            except BaseException:
+                for p in procs:
+                    p.kill()
+                    p.wait()
+                raise
+            return procs
+
+        # -- disjoint leg: all land, zero client-visible CAS failures
+        port = _free_port()
+        server = _spawn_serve(workdir, port)
+        try:
+            url = f"http://127.0.0.1:{port}/"
+            procs = spawn_writers(
+                url, "disjoint", writers, per_writer, "disjoint", 2, per_writer
+            )
+            go = _storm_go_barrier(procs)
+            results = _collect_workers(procs)
+            with urlopen(url + "api/v1/stats", timeout=10) as resp:
+                stats_text = resp.read().decode()
+        finally:
+            server.kill()
+            server.wait()
+        good = [r for r in results if r and r["ok"]]
+        landed = sum(r["landed"] for r in good)
+        attempts = sum(r["attempts"] for r in good)
+        cas = sum(r["cas_failures"] for r in good)
+        window = (
+            max((r["end"] for r in good), default=0) - go if go else 0.0
+        )
+        record["merge_storm_commits_landed"] = landed
+        record["merge_storm_client_attempts"] = attempts
+        record["merge_storm_cas_failures_client_visible"] = cas
+        record["merge_storm_commits_per_sec"] = round(
+            landed / max(window, 1e-9), 2
+        )
+        record["merge_storm_retry_amplification"] = round(
+            attempts / max(landed, 1), 3
+        )
+        waits = sorted(w for r in good for w in r["queue_waits"])
+        p99 = waits[min(len(waits) - 1, math.ceil(0.99 * len(waits)) - 1)] if waits else 0.0
+        record["merge_storm_queue_p99_wait_seconds"] = round(p99, 4)
+        qsum = _prom_value(stats_text, "kart_server_merge_queue_wait_seconds_sum")
+        qcount = _prom_value(
+            stats_text, "kart_server_merge_queue_wait_seconds_count"
+        )
+        record["merge_storm_queue_mean_wait_seconds"] = round(
+            qsum / qcount if qcount else 0.0, 4
+        )
+        record["merge_storm_rebases_landed"] = int(
+            _prom_value(stats_text, "kart_server_rebase_landed_total")
+        )
+        record["ok"] = (
+            record["ok"]
+            and go is not None
+            and len(good) == writers
+            and landed == writers * per_writer
+            and cas == 0
+            and record["merge_storm_retry_amplification"] < 1.5
+        )
+        print(json.dumps(record), flush=True)
+
+        # -- overlap leg: everyone edits feature 1; exactly one lands, the
+        # rest are rejected terminally after exactly one attempt each
+        port = _free_port()
+        server = _spawn_serve(workdir, port)
+        try:
+            url = f"http://127.0.0.1:{port}/"
+            procs = spawn_writers(url, "overlap", writers, 1, "overlap", 200, 1)
+            go = _storm_go_barrier(procs)
+            results = _collect_workers(procs)
+        finally:
+            server.kill()
+            server.wait()
+        good = [r for r in results if r]
+        landed = sum(r["landed"] for r in good)
+        rejections = sum(r["conflicts"] for r in good)
+        # a conflicted writer's whole budget must be one wire attempt
+        reject_attempts = sum(
+            r["attempts"] for r in good if r["conflicts"]
+        )
+        record["rebase_conflict_writers"] = writers
+        record["rebase_conflict_rejections"] = rejections
+        record["rebase_conflict_attempts_per_reject"] = round(
+            reject_attempts / max(rejections, 1), 3
+        )
+        record["ok"] = (
+            record["ok"]
+            and landed == 1
+            and rejections == writers - 1
+            and record["rebase_conflict_attempts_per_reject"] == 1.0
+        )
+        print(json.dumps(record), flush=True)
+
+        # -- fault leg: SIGKILL the server while contended rebases are in
+        # flight, restart it; every writer must land via retries, and the
+        # abandoned quarantine debris stays sweepable (never served)
+        port = _free_port()
+        server = _spawn_serve(workdir, port)
+        ok_writers = 0
+        try:
+            url = f"http://127.0.0.1:{port}/"
+            procs = spawn_writers(
+                url, "fault", fault_writers, 1, "resilient", 400, 1
+            )
+            go = _storm_go_barrier(procs)
+            if go is None:
+                raise RuntimeError("fault-leg writers failed to start")
+            time.sleep(float(os.environ.get("KART_BENCH_MERGE_KILL_AFTER", 0.8)))
+            server.kill()
+            server.wait()
+            time.sleep(1.0)
+            server = _spawn_serve(workdir, port)
+            results = _collect_workers(procs)
+            ok_writers = sum(1 for r in results if r and r["ok"])
+        finally:
+            server.kill()
+            server.wait()
+        record["merge_storm_fault_writers"] = fault_writers
+        record["merge_storm_fault_writers_ok"] = ok_writers
+        record["ok"] = record["ok"] and ok_writers == fault_writers
+        print(json.dumps(record), flush=True)
+
+
 if __name__ == "__main__":
     import sys
 
-    if "--serve-storm-worker" in sys.argv:
+    if "--merge-storm-worker" in sys.argv:
+        merge_storm_worker()
+    elif "--merge-storm" in sys.argv:
+        merge_storm_main()
+    elif "--serve-storm-worker" in sys.argv:
         serve_storm_worker()
     elif "--serve-storm" in sys.argv:
         serve_storm_main()

@@ -37,6 +37,8 @@ ENV_VARS = {
     "KART_SERVE_ENUM_CACHE": "source",
     "KART_SERVE_MAX_INFLIGHT": "source",
     "KART_SERVE_RETRY_AFTER": "source",
+    "KART_SERVE_REBASE_ATTEMPTS": "source",
+    "KART_SERVE_MERGE_QUEUE": "source",
     # faults / maintenance (ROBUSTNESS.md §5-§6)
     "KART_FAULTS": "source",
     "KART_GC_GRACE": "source",
@@ -117,6 +119,8 @@ FAULT_POINTS = frozenset(
         "diff.device_transfer",
         "server.enum_cache",
         "server.shed",
+        "server.rebase",
+        "server.ref_cas",
     }
 )
 

@@ -13,6 +13,10 @@ from kart_tpu.diff.output import dump_json_output
 
 
 def _merge_json(result, repo):
+    if result.has_conflicts and result.dry_run and not result.already_merged:
+        # the document the server's structured conflict rejection also
+        # carries — one builder, so the two can never drift
+        return merge_conflict_report(result.merge_index.conflicts)
     body = {}
     if result.already_merged:
         body["noOp"] = True
@@ -35,11 +39,44 @@ def _merge_json(result, repo):
 def _conflict_summary(conflicts):
     """label dict -> {ds_path: {part: count}} — the reference merge
     output's conflict summary (list_conflicts(..., summarise=2);
-    kart/merge.py:105-106, e.g. {"layer": {"feature": 4}})."""
+    kart/merge.py:105-106, e.g. {"layer": {"feature": 4}}).
+
+    Columnar conflict sets short-circuit through ``summary_counts()``: a
+    1M-conflict server-side rebase rejection summarises from the key
+    column without materialising a million label strings (same output,
+    parity-tested)."""
+    counts = getattr(conflicts, "summary_counts", None)
+    if counts is not None:
+        out = {}
+        for parts, n in sorted(counts().items()):
+            _set_value_at_path(out, parts, n)
+        return out
     out = {}
     for label in conflicts:
         _set_value_at_path(out, tuple(label.split(":", 2)), _CONFLICT_PLACEHOLDER)
     return _summarise_tree(out, 2)
+
+
+def merge_conflict_report(conflicts):
+    """The exact ``kart merge <theirs> --dry-run -o json`` document for a
+    conflicted merge — the single source of truth shared by the local CLI
+    and the server's structured conflict rejection (docs/SERVING.md §6),
+    so the report a rejected push carries is byte-identical JSON to what
+    the losing client would compute locally."""
+    return {
+        "kart.merge/v1": {
+            "conflicts": _conflict_summary(conflicts),
+            "state": "merging",
+            "dryRun": True,
+        }
+    }
+
+
+def conflict_report_as_text(summary):
+    """Render a conflict summary tree as the hierarchical text a local
+    ``kart conflicts -ss`` prints (shared renderer for the push-rejection
+    report)."""
+    return _conflicts_json_as_text(summary)
 
 
 @cli.command("merge")

@@ -17,6 +17,14 @@ class RefError(ValueError):
 
 _BAD_REF_CHARS = re.compile(r"[\x00-\x20\x7f~^:?*\[\\]")
 
+#: components shaped like atomic-write crash debris (``x.lock<pid>``,
+#: ``x.tmp<pid>`` — the same shapes ``repo._STALE_FILE_RE`` sweeps and
+#: ``iter_refs`` skips). A ref *named* like debris would be silently
+#: invisible to listing and deleted by the next ``kart gc``; refuse it at
+#: creation instead — a server-constructed rebase ref must never be able to
+#: collide with this namespace either.
+_DEBRIS_SHAPED = re.compile(r"\.(tmp|lock)\d*$")
+
 
 def check_ref_format(ref, *, require_refs_prefix=False):
     """Validate a ref name with git's check_refname_format rules (the subset
@@ -39,6 +47,11 @@ def check_ref_format(ref, *, require_refs_prefix=False):
             raise RefError(f"bad ref name: {ref!r}")
         if component.endswith(".lock"):
             raise RefError(f"bad ref name: {ref!r}")
+        if _DEBRIS_SHAPED.search(component):
+            raise RefError(
+                f"bad ref name: {ref!r} (component looks like crash debris "
+                f"the gc sweep would claim)"
+            )
     return ref
 
 
@@ -144,6 +157,24 @@ class RefStore:
 
     def exists(self, ref):
         return os.path.exists(self._ref_path(ref)) or ref in self._packed_refs()
+
+    def df_conflict(self, ref):
+        """The existing ref ``ref`` collides with at a directory/file
+        boundary (``refs/heads/a`` vs ``refs/heads/a/b``), or None. The
+        loose store cannot hold both a file and a directory of one name —
+        O(path depth) stats plus one subtree peek, never a full-ref scan
+        (receive-pack runs this under the push locks)."""
+        parts = ref.split("/")
+        packed = self._packed_refs()
+        for i in range(2, len(parts)):
+            prefix = "/".join(parts[:i])
+            # a *file* (or packed ref) at an ancestor component blocks us;
+            # a plain directory there is the normal namespace nesting
+            if os.path.isfile(self._ref_path(prefix)) or prefix in packed:
+                return prefix
+        for nested, _ in self.iter_refs(ref + "/"):
+            return nested
+        return None
 
     def iter_refs(self, prefix="refs/"):
         """Yield (ref_name, oid) under the given prefix, sorted; loose refs

@@ -33,6 +33,14 @@ Registered points:
     server.shed             the serve admission check — an armed hit sheds
                             the request (429 + Retry-After) regardless of
                             actual load
+    server.rebase           every frame of a server-side rebase of a
+                            CAS-losing push: 1 = ancestry/classifier run,
+                            2 = merge-commit write, 3 = quarantine temp-ref
+                            write (a kill leaves the live store
+                            byte-identical — the quarantine is discarded)
+    server.ref_cas          the locked landing frames of a receive-pack:
+                            1 = the CAS (re-)validation, 2 = just before
+                            quarantine migrate
 
 Disabled (``KART_FAULTS`` unset) the fast path is a single environ dict
 lookup with no allocation: frame-boundary loops additionally hoist

@@ -321,6 +321,20 @@ class ColumnarConflicts(Mapping):
         labels = self._labels if self._labels is not None else self._labels_src
         return labels, list(self.versions)
 
+    def summary_counts(self):
+        """``{(ds_path, part): count}`` — the ``-ss`` conflict summary as
+        raw counts. A :class:`PkLabels` column (the common int-pk dataset)
+        answers from its shape alone — no label strings materialise, so a
+        1M-conflict rejection report costs O(1), not a million f-strings."""
+        src = self._labels_src if self._labels is None else self._labels
+        if isinstance(src, PkLabels):
+            return {(src.ds_path, "feature"): self.n} if self.n else {}
+        counts = {}
+        for label in self.labels:
+            key = tuple(label.split(":", 2)[:2])
+            counts[key] = counts.get(key, 0) + 1
+        return counts
+
 
 class CombinedConflicts(Mapping):
     """Ordered chain of conflict mappings (one ColumnarConflicts per dataset
@@ -359,6 +373,21 @@ class CombinedConflicts(Mapping):
     def values(self):
         for p in self.parts:
             yield from p.values()
+
+    def summary_counts(self):
+        """Aggregate ``{(ds_path, part): count}`` over every part, using
+        each columnar part's fast path and a label loop for plain dicts."""
+        counts = {}
+        for p in self.parts:
+            sub = getattr(p, "summary_counts", None)
+            if sub is not None:
+                for key, n in sub().items():
+                    counts[key] = counts.get(key, 0) + n
+                continue
+            for label in p:
+                key = tuple(label.split(":", 2)[:2])
+                counts[key] = counts.get(key, 0) + 1
+        return counts
 
 
 def _conflicts_as_columns(conflicts):

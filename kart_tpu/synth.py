@@ -178,6 +178,41 @@ def _changed_row_oids(odb, sel_pks, ratings, schema, geom_xy=None,
     return out
 
 
+def commit_feature_edits(repo, ds_path, *, inserts=(), updates=(), deletes=(),
+                         message="edit features", ref="HEAD"):
+    """Build and commit a small feature diff against ``ref``; -> commit
+    oid. The one fixture-edit helper behind both the test suite
+    (tests/helpers.edit_commit) and bench.py's merge-storm writers — the
+    diff-construction idiom lives here so the two can't drift."""
+    from kart_tpu.diff.structs import (
+        DatasetDiff,
+        Delta,
+        DeltaDiff,
+        KeyValue,
+        RepoDiff,
+    )
+
+    structure = repo.structure(ref)
+    ds = structure.datasets[ds_path]
+    pk_col = ds.schema.pk_columns[0].name
+    feature_diff = DeltaDiff()
+    for f in inserts:
+        feature_diff.add_delta(Delta.insert(KeyValue((f[pk_col], f))))
+    for f in updates:
+        old = ds.get_feature([f[pk_col]])
+        feature_diff.add_delta(
+            Delta.update(KeyValue((f[pk_col], old)), KeyValue((f[pk_col], f)))
+        )
+    for pk in deletes:
+        old = ds.get_feature([pk])
+        feature_diff.add_delta(Delta.delete(KeyValue((pk, old))))
+    ds_diff = DatasetDiff()
+    ds_diff["feature"] = feature_diff
+    repo_diff = RepoDiff()
+    repo_diff[ds_path] = ds_diff
+    return structure.commit_diff(repo_diff, message)
+
+
 def synth_repo(path, n, *, edit_frac=0.01, seed=0, blobs="promised",
                ds_path="synth", spatial=False):
     """Create a repo at ``path`` with one int-pk dataset of ``n`` features

@@ -95,17 +95,24 @@ class HttpTransportError(ValueError):
     non-idempotent verb retries. ``retry_after`` carries a server-sent
     ``Retry-After`` (seconds) — the load-shedding 429 path — which the
     retry policy honours as a backoff floor. ``shed`` marks an HTTP 429:
-    by its semantics the server refused the request *before processing
-    it*, so even a non-idempotent verb (push) may safely retry — the
-    paced-queue behaviour load shedding is designed for."""
+    by its semantics the server refused the request *before applying
+    anything*, so even a non-idempotent verb (push) may safely retry — the
+    paced-queue behaviour load shedding (and the contended-push busy lane)
+    is designed for. ``terminal`` marks an application-level final verdict
+    the retry policy never overrides, and ``conflict_report`` carries the
+    structured three-way conflict document of a rejected contended push
+    (docs/SERVING.md §6) for the client to render like a local merge."""
 
     transient = False
     pre_write = False
     retry_after = None
     shed = False
+    terminal = False
+    conflict_report = None
 
     def __init__(self, message, *, transient=None, pre_write=None,
-                 retry_after=None, shed=None):
+                 retry_after=None, shed=None, terminal=None,
+                 conflict_report=None):
         super().__init__(message)
         if transient is not None:
             self.transient = transient
@@ -115,6 +122,10 @@ class HttpTransportError(ValueError):
             self.retry_after = retry_after
         if shed is not None:
             self.shed = shed
+        if terminal is not None:
+            self.terminal = terminal
+        if conflict_report is not None:
+            self.conflict_report = conflict_report
 
 
 def _retry_after_of(http_error):
@@ -234,11 +245,13 @@ class KartRequestHandler(BaseHTTPRequestHandler):
 
     # -- plumbing -----------------------------------------------------------
 
-    def _json(self, status, payload):
+    def _json(self, status, payload, headers=None):
         raw = json.dumps(payload).encode()
         self.send_response(status)
         self.send_header("Content-Type", "application/json")
         self.send_header("Content-Length", str(len(raw)))
+        for name, value in (headers or {}).items():
+            self.send_header(name, value)
         self.end_headers()
         self.wfile.write(raw)
 
@@ -455,23 +468,36 @@ class KartRequestHandler(BaseHTTPRequestHandler):
         self._framed(header, objects)
 
     def _handle_receive_pack(self):
+        from kart_tpu.transport.protocol import rejection_wire_fields
         from kart_tpu.transport.service import quarantined_receive
 
         # the pack drains into a quarantine objects dir and migrates into
         # the live store only after checksum + ref preconditions pass — a
-        # torn or rejected push leaves the store byte-identical. The CAS is
+        # torn or rejected push leaves the store byte-identical; a push
+        # that lost its CAS to a contending writer is auto-rebased against
+        # the new tip before re-validating (docs/SERVING.md §6). The CAS is
         # atomic across handler threads AND across processes (an ssh push
         # is a separate serve-stdio process): thread lock + gitdir file
         # lock, both held inside quarantined_receive.
         with self._read_body_spooled() as body:
             header, pack_fp = read_framed(body)
-            status, payload = quarantined_receive(
+            result = quarantined_receive(
                 self.repo, header, pack_fp, thread_lock=self.server.push_lock
             )
-        if status == "ok":
-            self._json(200, {"updated": payload})
-        else:
-            self._json(409 if status == "conflict" else 400, {"error": payload})
+        if result[0] == "ok":
+            self._json(200, result[1])
+            return
+        # a structured rejection: conflict -> 409 (terminal ones carry the
+        # report), busy (merge queue full / CAS budget exhausted) -> the
+        # same paced 429 + Retry-After lane the load shedder uses
+        status = {"conflict": 409, "busy": 429}.get(result[0], 400)
+        payload = {"error": result[1]}
+        payload.update(rejection_wire_fields(result))
+        headers = None
+        retry_after = payload.get("retry_after")
+        if status == 429 and retry_after is not None:
+            headers = {"Retry-After": str(max(0, int(retry_after)))}
+        self._json(status, payload, headers)
 
 
 def make_server(repo, host="127.0.0.1", port=0):
@@ -608,18 +634,29 @@ class HttpRemote:
         except HTTPError as e:
             # the server answered: usually a deterministic op error, except
             # the proxy-layer statuses that recur only transiently
-            detail = ""
+            from kart_tpu.transport.protocol import error_attrs_from_wire
+
+            body = None
             try:
-                detail = json.loads(e.read().decode()).get("error", "")
+                body = json.loads(e.read().decode())
             except (OSError, ValueError, AttributeError):
                 # non-JSON / unreadable error body: the HTTP status below
                 # is still reported
                 pass
+            detail = body.get("error", "") if isinstance(body, dict) else ""
+            attrs = {
+                "transient": e.code in _TRANSIENT_HTTP_STATUSES,
+                "retry_after": _retry_after_of(e),
+                "shed": e.code == 429,
+            }
+            # structured-rejection fields from the body (terminal verdicts,
+            # the conflict report, busy pacing) — the header/status values
+            # above win where both are present
+            for name, value in error_attrs_from_wire(body).items():
+                if attrs.get(name) in (None, False):
+                    attrs[name] = value
             raise HttpTransportError(
-                f"Remote {self.base!r} error: {detail or e}",
-                transient=e.code in _TRANSIENT_HTTP_STATUSES,
-                retry_after=_retry_after_of(e),
-                shed=e.code == 429,
+                f"Remote {self.base!r} error: {detail or e}", **attrs
             )
         except OSError as e:
             reason = getattr(e, "reason", e)
@@ -745,12 +782,16 @@ class HttpRemote:
         """objects: iterable of (type, content); updates: [{ref, old, new,
         force}]; shallow: oids or a callable evaluated after the objects
         drain (an ObjectEnumerator's boundary is only final then).
-        -> {ref: oid|None} from the server.
+        -> the server's full receive payload: ``{"updated": {ref:
+        oid|None}, "rebase": {...}}`` (``rebase`` reports whether the
+        server auto-rebased a contended push, its CAS attempt count and
+        merge-queue wait; docs/SERVING.md §6).
 
         Not idempotent: only pre-write failures (connect refused — the
-        server saw no byte of this request) and load-shedding 429s (the
-        server refused the request before processing anything) are
-        retried — a shed push joins the paced queue like any fetch."""
+        server saw no byte of this request) and paced 429s — load shedding
+        or the contended-push busy lane, both of which provably applied
+        nothing — are retried. A structured conflict rejection is
+        ``terminal``: surfaced once, never blindly re-pushed."""
         from kart_tpu.transport.retry import is_pre_write
 
         def retryable(exc):
@@ -778,4 +819,4 @@ class HttpRemote:
                 on_retry=self.reset,
             )
         with resp:
-            return json.loads(resp.read().decode())["updated"]
+            return json.loads(resp.read().decode())

@@ -22,9 +22,91 @@ already holds, salvaged from a torn earlier transfer. Unlike ``has`` these
 carry *no* closure guarantee (a disconnect delivers commits before their
 trees' blobs), so they suppress shipping object-by-object while the walk
 still descends through them to find the missing remainder.
+
+This module also defines the **structured rejection frame** both servers
+speak when a receive-pack is refused (docs/SERVING.md §6): a
+:class:`Rejection` stays tuple-compatible with the PR 2 ``(kind, message)``
+API while carrying machine-readable extras — a ``conflict_report`` the
+client renders exactly like a local ``kart merge`` conflict, a ``terminal``
+flag the retry policy obeys (no blind re-push of commits that will conflict
+again), and the ``retry_after``/``shed`` pacing fields of the 429 lane.
 """
 
 from kart_tpu.core.odb import ObjectMissing
+
+#: wire fields a structured rejection may carry beyond "error" — one list
+#: so the HTTP JSON body and the stdio response frame can never drift
+REJECTION_WIRE_FIELDS = (
+    "code", "ref", "terminal", "conflict_report", "retry_after", "shed"
+)
+
+
+class Rejection(tuple):
+    """A ``(kind, message)`` receive-pack rejection with structured extras.
+
+    ``kind``: ``"conflict"`` (precondition failed against current state),
+    ``"bad"`` (malformed/incomplete request), or ``"busy"`` (back-pressure:
+    merge queue overflow / CAS re-validation budget exhausted — retryable
+    with pacing, the 429 lane). Tuple compatibility keeps every PR 2 caller
+    (``status, msg = rejection``) working unchanged.
+
+    Extras: ``code`` — machine-readable cause (``cas_stale`` /
+    ``merge_conflict`` / ``non_ff`` / ``denied`` / ``df_conflict`` /
+    ``queue_full`` / ``cas_busy``); ``ref`` — the ref that tripped it;
+    ``terminal`` — a deterministic application-level verdict no retry
+    policy may override; ``conflict_report`` — the structured three-way
+    conflict document (byte-identical JSON to a local
+    ``kart merge <tip> --dry-run -o json``); ``retry_after``/``shed`` —
+    pacing for the busy lane."""
+
+    def __new__(cls, kind, message, *, code=None, ref=None, terminal=False,
+                conflict_report=None, retry_after=None, shed=False):
+        self = super().__new__(cls, (kind, message))
+        self.kind = kind
+        self.message = message
+        self.code = code
+        self.ref = ref
+        self.terminal = bool(terminal)
+        self.conflict_report = conflict_report
+        self.retry_after = retry_after
+        self.shed = bool(shed)
+        return self
+
+
+def rejection_wire_fields(rejection):
+    """The extra response fields ``rejection`` puts on the wire (beyond the
+    kind/message every server already sends) — shared by the HTTP error
+    body and the stdio error frame so the two transports report a conflict
+    identically. Plain ``(kind, msg)`` tuples contribute nothing."""
+    out = {}
+    for name in REJECTION_WIRE_FIELDS:
+        value = getattr(rejection, name, None)
+        # identity checks: retry_after=0 ("retry immediately") must ride
+        # the wire — `0 in (None, False)` would be True and drop it
+        if value is None or value is False:
+            continue
+        out[name] = value
+    return out
+
+
+def error_attrs_from_wire(body):
+    """Inverse of :func:`rejection_wire_fields` on the client: the keyword
+    attrs a transport error should carry for a structured rejection body
+    (``terminal``/``conflict_report``/``retry_after``/``shed``). Works on
+    any dict-shaped error payload; unknown/absent fields contribute
+    nothing."""
+    if not isinstance(body, dict):
+        return {}
+    out = {}
+    if body.get("terminal"):
+        out["terminal"] = True
+    if body.get("conflict_report") is not None:
+        out["conflict_report"] = body["conflict_report"]
+    if body.get("retry_after") is not None:
+        out["retry_after"] = body["retry_after"]
+    if body.get("shed"):
+        out["shed"] = True
+    return out
 
 
 class ObjectEnumerator:

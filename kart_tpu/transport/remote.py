@@ -432,10 +432,46 @@ def _record_push_tracking(repo, remote_name, src_ref, dst_ref, new_oid, set_upst
         )
 
 
+def render_push_conflict(report):
+    """The client-side rendering of a server's structured conflict report:
+    the same hierarchical text a local ``kart merge`` prints for the same
+    two commits (one renderer — docs/SERVING.md §6)."""
+    from kart_tpu.cli.merge_cmds import conflict_report_as_text
+
+    ref = report.get("ref", "the remote branch")
+    lines = [
+        f"Push to {ref} rejected: merging your commit "
+        f"{report.get('ours', '?')[:8]} with the remote tip "
+        f"{report.get('theirs', '?')[:8]} results in "
+        f"{report.get('conflicts_total', '?')} conflicts:",
+    ]
+    summary = (report.get("merge") or {}).get("kart.merge/v1", {}).get(
+        "conflicts"
+    )
+    if summary:
+        import click
+
+        # unstyle: the renderer colours version headers for terminals, but
+        # this text travels inside an exception message
+        lines.append(
+            click.unstyle(conflict_report_as_text(summary).rstrip("\n"))
+        )
+    lines.append(
+        "Fetch, merge and resolve locally (`kart fetch` + `kart merge`), "
+        "then push the result. Re-pushing unchanged commits will conflict "
+        "again."
+    )
+    return "\n".join(lines)
+
+
 def _push_network(repo, remote_name, net, refspecs, *, force, set_upstream):
     """Push over a wire transport (HTTP or ssh/stdio): client-side
     enumeration against the server's declared tips, compare-and-swap ref
-    updates server-side."""
+    updates server-side. A CAS lost to a contending writer — or a tip that
+    had already moved past us when we looked — is resolved by the
+    *server's* auto-rebase (docs/SERVING.md §6): clean merges land without
+    any client round-trip, real conflicts come back as one terminal
+    structured report rendered like a local ``kart merge`` conflict."""
     from kart_tpu.transport.http import HttpTransportError, have_closure
 
     try:
@@ -459,34 +495,47 @@ def _push_network(repo, remote_name, net, refspecs, *, force, set_upstream):
             if src_name is None:  # delete
                 if dst_ref not in server_refs:
                     raise RemoteError(f"Remote ref does not exist: {dst_ref}")
-                updated.update(
-                    net.receive_pack(
-                        [],
-                        [
-                            {
-                                "ref": dst_ref,
-                                "old": server_refs[dst_ref],
-                                "new": None,
-                                "force": spec_force,
-                            }
-                        ],
-                    )
+                result = net.receive_pack(
+                    [],
+                    [
+                        {
+                            "ref": dst_ref,
+                            "old": server_refs[dst_ref],
+                            "new": None,
+                            "force": spec_force,
+                        }
+                    ],
                 )
+                updated.update(result.get("updated", result))
                 continue
 
             src_ref, new_oid = _resolve_push_source(repo, src_name)
             old_oid = server_refs.get(dst_ref)
-            if old_oid and not spec_force:
-                if not repo.odb.contains(old_oid) or not repo.is_ancestor(
-                    old_oid, new_oid
-                ):
-                    raise RemoteError(
-                        f"Push to {dst_ref} rejected (non-fast-forward); "
-                        "fetch first or use --force"
-                    )
+            # No client-side fast-forward veto any more: a diverged or
+            # stale push is sent with the observed tip as its CAS base and
+            # the server merges or rejects with a structured report — the
+            # client can't see contention that happens after this look
+            # anyway, and pre-rejecting here is what forced the manual
+            # pull/merge/re-push cycle the merge service removes.
             if has_set is None:
+                # the server also provably holds everything our remote-
+                # tracking refs name (we fetched it from there, or pushed
+                # it there): without these, a diverged push against a tip
+                # we never fetched finds none of the advertised oids in our
+                # odb, computes an EMPTY closure, and re-uploads the whole
+                # history. A server that has since rewound and gc'd those
+                # objects rejects deterministically with "Push incomplete"
+                # — far rarer than contention itself.
+                known = [
+                    oid
+                    for _, oid in repo.refs.iter_refs(
+                        f"refs/remotes/{remote_name}/"
+                    )
+                ]
                 has_set = have_closure(
-                    repo.odb, list(server_refs.values()), info.get("shallow", ())
+                    repo.odb,
+                    list(server_refs.values()) + known,
+                    info.get("shallow", ()),
                 )
             enum = ObjectEnumerator(
                 repo.odb,
@@ -494,24 +543,38 @@ def _push_network(repo, remote_name, net, refspecs, *, force, set_upstream):
                 has=has_set.__contains__,
                 sender_shallow=read_shallow(repo),
             )
-            updated.update(
-                net.receive_pack(
-                    enum,
-                    [
-                        {
-                            "ref": dst_ref,
-                            "old": old_oid,
-                            "new": new_oid,
-                            "force": spec_force,
-                        }
-                    ],
-                    shallow=lambda: enum.shallow_boundary,
-                )
+            result = net.receive_pack(
+                enum,
+                [
+                    {
+                        "ref": dst_ref,
+                        "old": old_oid,
+                        "new": new_oid,
+                        "force": spec_force,
+                    }
+                ],
+                shallow=lambda: enum.shallow_boundary,
             )
+            landed = result.get("updated", result)
+            updated.update(landed)
+            rebase = result.get("rebase") or {}
+            if rebase.get("rebased"):
+                tm.incr("transport.push_rebased")
         except HttpTransportError as e:
+            if getattr(e, "conflict_report", None):
+                raise RemoteError(render_push_conflict(e.conflict_report))
             raise RemoteError(str(e))
+        # track the oid the server actually landed (a rebased push lands a
+        # server-made merge commit, not our local tip) — but never a commit
+        # this store doesn't hold: a dangling tracking ref would crash every
+        # reader that resolves it. Falling back to our own commit leaves the
+        # ref merely behind (it IS an ancestor of the true tip); the next
+        # fetch fast-forwards it.
+        track_oid = landed.get(dst_ref, new_oid)
+        if track_oid is not None and not repo.odb.contains(track_oid):
+            track_oid = new_oid
         _record_push_tracking(
-            repo, remote_name, src_ref, dst_ref, new_oid, set_upstream
+            repo, remote_name, src_ref, dst_ref, track_oid, set_upstream
         )
     return updated
 

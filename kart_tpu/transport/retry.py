@@ -16,7 +16,10 @@ same production posture directly:
   errors (bad filter spec, CAS conflict, HTTP status errors) are not.
   Errors carry an optional ``transient`` attribute that overrides the
   class-based default, and ``pre_write=True`` marks failures that provably
-  happened before any request byte reached the server.
+  happened before any request byte reached the server. ``terminal=True``
+  marks an application-level final verdict (a structured merge-conflict
+  rejection) that no retryable predicate may override — see
+  :func:`is_terminal`.
 * **drain_pack_salvaging** — objects are content-addressed and each pack
   record is individually length/zlib-checked, so everything received before
   a disconnect is durable: on a torn stream the partial pack is *finalised*
@@ -64,6 +67,17 @@ def is_pre_write(exc):
     reached the server (e.g. TCP connect refused, spawn failure) — the only
     failures a non-idempotent verb may retry."""
     return bool(getattr(exc, "pre_write", False))
+
+
+def is_terminal(exc):
+    """True for an application-level *final* verdict — the server examined
+    the request and rejected it deterministically (the structured
+    merge-conflict report of a contended push: a human must resolve it).
+    Terminal errors are never retried, whatever the per-verb ``retryable``
+    predicate says: a blind re-push of the same commits is guaranteed to
+    conflict again, and that retry amplification is exactly the failure
+    mode the server-side rebase exists to remove (docs/SERVING.md §6)."""
+    return bool(getattr(exc, "terminal", False))
 
 
 def _env_float(name, default):
@@ -125,7 +139,11 @@ class RetryPolicy:
             try:
                 return fn()
             except Exception as e:
-                if attempt >= self.attempts or not retryable(e):
+                # a terminal verdict outranks every retryable classification
+                # — "conflicts, human required" must surface exactly once,
+                # while "CAS lost, server still rebasing" stays in the
+                # paced-retry lane below
+                if attempt >= self.attempts or is_terminal(e) or not retryable(e):
                     raise
                 delay = self.delay_for(attempt)
                 # a server-sent Retry-After (the 429/503 shedding path) is

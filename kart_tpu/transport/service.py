@@ -12,6 +12,15 @@ pack drains into a temporary objects dir that borrows the main store via
 alternates, and objects migrate into the live store only after the pack
 checksum and every ref-update precondition pass — a failed, torn or
 rejected push leaves the served store byte-identical.
+
+Contended pushes are *auto-rebased server-side* (docs/SERVING.md §6): a
+receive-pack that passes its checksum but loses the ref CAS — a contending
+writer moved the tip first — is three-way merged against the new tip by the
+merge-index classifier, still inside the quarantine, and re-validated under
+the push locks; real conflicts reject with a structured report the client
+renders exactly like a local ``kart merge`` conflict (and never blindly
+retries). K contending writers are serialised through a per-ref FIFO merge
+queue instead of convoying on the push lock.
 """
 
 import hashlib
@@ -29,10 +38,27 @@ from kart_tpu import faults
 from kart_tpu import telemetry as tm
 from kart_tpu.core.odb import ObjectMissing
 from kart_tpu.core.refs import RefError, check_ref_format
-from kart_tpu.transport.protocol import ObjectEnumerator
+from kart_tpu.core.repo import KartRepo
+from kart_tpu.transport.protocol import ObjectEnumerator, Rejection
 
 #: subdirectory of <gitdir>/objects holding in-flight push quarantines
 QUARANTINE_SUBDIR = "quarantine"
+
+#: how many times a contended push's CAS is re-validated (each failed
+#: re-check costing one server-side rebase onto the newest tip) before the
+#: server gives up and sheds the push back to the paced-retry lane
+#: (``KART_SERVE_REBASE_ATTEMPTS`` overrides)
+DEFAULT_REBASE_ATTEMPTS = 3
+
+#: per-ref merge-queue depth bound: more than this many writers waiting on
+#: one ref sheds the newcomer with 429 + Retry-After instead of growing the
+#: line without bound (``KART_SERVE_MERGE_QUEUE`` overrides; 0 = unbounded)
+DEFAULT_MERGE_QUEUE_DEPTH = 32
+
+#: a writer queued behind a wedged merge-queue holder stops waiting after
+#: this long and sheds as busy — the line must never wedge harder than the
+#: push it is ordering
+MERGE_QUEUE_TIMEOUT = 600.0
 
 #: default byte budget for the per-repo pack-enumeration cache
 #: (``KART_SERVE_ENUM_CACHE`` overrides; ``0`` disables caching entirely)
@@ -506,6 +532,322 @@ def materialise_plan(plan):
     return buf, length
 
 
+# ---------------------------------------------------------------------------
+# the per-ref merge queue (docs/SERVING.md §6)
+#
+# K writers racing one branch used to convoy on the push lock: every CAS
+# loser re-validated at a random position and could lose again, unbounded.
+# The queue turns the race into an ordered line per ref — each writer waits
+# its turn, rebases exactly once onto its predecessor's tip, and lands.
+# Depth and wait are measured; overflow sheds into the 429 + Retry-After
+# lane the client RetryPolicy already paces itself against.
+# ---------------------------------------------------------------------------
+
+
+class MergeQueueFull(Exception):
+    """The per-ref line is at its depth bound — shed, don't queue."""
+
+
+class MergeQueue:
+    """FIFO ticket line per contended ref (one instance per served repo).
+
+    ``slot(ref)`` is a context manager: entering takes the next ticket and
+    blocks until every earlier ticket for the same ref released; the body
+    runs the CAS/rebase/migrate sequence with no same-ref writer racing it
+    in this process (cross-process safety stays with ``push_file_lock`` —
+    the queue is the *ordering* layer, not the correctness layer). Yields
+    the seconds spent waiting."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._lines = {}  # ref -> {"cond", "next", "serving", "cancelled"}
+
+    def _depth_locked(self):
+        return sum(l["next"] - l["serving"] for l in self._lines.values())
+
+    @contextmanager
+    def slot(self, ref, *, depth_limit=None, timeout=MERGE_QUEUE_TIMEOUT):
+        from kart_tpu.transport.retry import _env_int
+
+        if depth_limit is None:
+            depth_limit = _env_int(
+                "KART_SERVE_MERGE_QUEUE", DEFAULT_MERGE_QUEUE_DEPTH
+            )
+        with self._lock:
+            line = self._lines.get(ref)
+            if line is None:
+                line = self._lines[ref] = {
+                    "cond": threading.Condition(self._lock),
+                    "next": 0,
+                    "serving": 0,
+                    "cancelled": set(),
+                }
+            queued = line["next"] - line["serving"]
+            if depth_limit > 0 and queued >= depth_limit:
+                tm.incr("server.merge_queue.shed")
+                raise MergeQueueFull(
+                    f"Merge queue for {ref} is full "
+                    f"({queued} writers waiting); retry"
+                )
+            ticket = line["next"]
+            line["next"] += 1
+            tm.gauge_set("server.merge_queue.depth", self._depth_locked())
+            t0 = time.monotonic()
+            deadline = t0 + timeout
+            waited = line["serving"] != ticket
+            if waited:
+                tm.incr("server.merge_queue.waits")
+            while line["serving"] != ticket:
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    # a wedged predecessor must not wedge the whole line:
+                    # cancel this ticket (release skips it) and shed
+                    line["cancelled"].add(ticket)
+                    tm.gauge_set(
+                        "server.merge_queue.depth", self._depth_locked()
+                    )
+                    tm.incr("server.merge_queue.shed")
+                    raise MergeQueueFull(
+                        f"Merge queue for {ref} stalled for {timeout:.0f}s; retry"
+                    )
+                line["cond"].wait(min(remaining, 60.0))
+            wait_s = time.monotonic() - t0
+            if waited:
+                tm.observe("server.merge_queue.wait_seconds", wait_s)
+        try:
+            yield wait_s
+        finally:
+            with self._lock:
+                line["serving"] += 1
+                while line["serving"] in line["cancelled"]:
+                    line["cancelled"].discard(line["serving"])
+                    line["serving"] += 1
+                if line["serving"] >= line["next"]:
+                    self._lines.pop(ref, None)
+                else:
+                    line["cond"].notify_all()
+                tm.gauge_set("server.merge_queue.depth", self._depth_locked())
+
+
+#: gitdir -> MergeQueue, mirroring _ENUM_CACHES' bounds. Eviction of a
+#: still-waiting queue only de-links it from *new* pushes (waiters keep the
+#: instance alive via their slot closure; push_file_lock keeps two queues
+#: for one repo correct, merely unordered) — and only past 64 served repos.
+_MERGE_QUEUES = OrderedDict()
+_merge_queues_lock = threading.Lock()
+
+
+def merge_queue_for(repo):
+    key = os.path.realpath(repo.gitdir)
+    with _merge_queues_lock:
+        queue = _MERGE_QUEUES.get(key)
+        if queue is None:
+            queue = _MERGE_QUEUES[key] = MergeQueue()
+        _MERGE_QUEUES.move_to_end(key)
+        while len(_MERGE_QUEUES) > _ENUM_CACHES_MAX:
+            _MERGE_QUEUES.popitem(last=False)
+    return queue
+
+
+# ---------------------------------------------------------------------------
+# server-side rebase of a CAS-losing push (docs/SERVING.md §6)
+# ---------------------------------------------------------------------------
+
+
+class _QuarantineRepoView:
+    """Just enough of the KartRepo surface for a server-side three-way
+    merge: every object read and write routes through the quarantine's odb
+    (live store wired in as an alternate), so the incoming — not yet
+    migrated — commits are visible, and everything the rebase produces
+    (merged trees, the merge commit) lands in the quarantine and migrates,
+    or is discarded, together with the push itself."""
+
+    def __init__(self, repo, odb):
+        self._repo = repo
+        self.odb = odb
+        self.refs = repo.refs
+        self.config = repo.config
+        self.workdir = repo.workdir
+        self.gitdir = repo.gitdir
+
+    @property
+    def version(self):
+        return self._repo.version
+
+    def signature(self, role="committer"):
+        return self._repo.signature(role)
+
+    # history helpers re-bound onto this view so revision resolution and
+    # ancestry/merge-base walks read through the quarantine odb, not only
+    # the live store
+    resolve_refish = KartRepo.resolve_refish
+    _resolve_plain = KartRepo._resolve_plain
+    _peel_to_commit_oid = KartRepo._peel_to_commit_oid
+    merge_base = KartRepo.merge_base
+    _ancestor_set = KartRepo._ancestor_set
+    is_ancestor = KartRepo.is_ancestor
+
+
+def _rebaseable_update(header):
+    """The single branch update a lost CAS may auto-rebase: exactly one
+    update, non-force, creating/moving (not deleting) a ``refs/heads/``
+    ref. Multi-ref transactions and force/delete updates keep the plain
+    reject-on-stale behaviour — a human asked for something atomic or
+    destructive; the server must not reinterpret it."""
+    updates = header.get("updates", [])
+    if len(updates) != 1:
+        return None
+    upd = updates[0]
+    if upd.get("force") or not upd.get("new"):
+        return None
+    if not upd["ref"].startswith("refs/heads/"):
+        return None
+    return upd
+
+
+#: clock-skew slack for the containment walk's commit-time pruning: a
+#: commit this much older than the target may still (with skewed clocks)
+#: have the target below it, so it is still descended
+_CONTAINS_TIME_SLACK = 86_400
+
+
+def _commit_contains(view, tip_oid, target_oid):
+    """Is ``target_oid`` an ancestor of (or equal to) ``tip_oid``? A DFS
+    from the tip that stops at the target and prunes commits meaningfully
+    older than it — O(commits since the target) on real pushes, never the
+    O(entire history) ancestor-set walk. Pruning errs safe: a skew-induced
+    false negative merely sends the push through the rebase path, whose
+    own ff/noop detection lands it identically."""
+    if tip_oid == target_oid:
+        return True
+    try:
+        target_time = view.odb.read_commit(target_oid).committer.time
+    except (ObjectMissing, KeyError, ValueError):
+        return False
+    floor = target_time - _CONTAINS_TIME_SLACK
+    seen = set()
+    stack = [tip_oid]
+    while stack:
+        oid = stack.pop()
+        if oid == target_oid:
+            return True
+        if oid in seen:
+            continue
+        seen.add(oid)
+        try:
+            commit = view.odb.read_commit(oid)
+        except (ObjectMissing, KeyError, ValueError):
+            continue  # shallow/partial boundary
+        if commit.committer.time >= floor:
+            stack.extend(commit.parents)
+    return False
+
+
+def _ff_precheck(view, repo, header):
+    """-> ``({ref: observed tip}, first non-ff update or None)``.
+
+    The server-side half of the fast-forward rule the client used to
+    enforce alone: the CAS cannot see divergence that predates the
+    advertisement the client pushed against (old matches, yet the incoming
+    commit doesn't contain the tip). The ancestry walks run OUTSIDE the
+    push locks — the caller re-verifies every observed tip under the locks
+    and loops if one moved meanwhile."""
+    observed = {}
+    stale = None
+    for upd in header.get("updates", []):
+        new = upd.get("new")
+        if not new or upd.get("force") or not upd["ref"].startswith("refs/heads/"):
+            continue
+        current = repo.refs.get(upd["ref"])
+        observed[upd["ref"]] = current
+        if (
+            stale is None
+            and current is not None
+            and current != new
+            and not _commit_contains(view, new, current)
+        ):
+            stale = upd
+    return observed, stale
+
+
+def _rebase_onto(repo, q, upd, current_tip):
+    """Three-way merge of the incoming commit against the tip that beat it,
+    computed entirely inside the quarantine.
+
+    -> ``("ff"|"noop"|"merge", oid)`` — the oid the contended ref should
+    land at; ``("conflict", report)`` — real conflicts, with the structured
+    report document; ``None`` — not auto-mergeable (unrelated histories).
+
+    Every frame is an injectable crash (``KART_FAULTS=server.rebase:<n>``):
+    1 = the ancestry/classifier run, 2 = the merge-commit write, 3 = the
+    quarantine-side temp-ref write. A kill at any of them propagates out,
+    the quarantine is discarded, and the live store stays byte-identical
+    (tests/test_faults.py kill matrix)."""
+    from kart_tpu.core.objects import Commit
+    from kart_tpu.core.structure import RepoStructure
+    from kart_tpu.merge import merge_trees_vectorized
+
+    ref, incoming = upd["ref"], upd["new"]
+    view = _QuarantineRepoView(repo, q.odb)
+    faults.fire("server.rebase")  # frame 1: ancestry + classifier run
+    if current_tip is None:
+        # the contended branch vanished between CAS checks: recreate it at
+        # the incoming commit — a plain fast-forward of the create case
+        return "ff", incoming
+    # EXACT ancestry here, not the time-pruned precheck walk: this is the
+    # backstop that turns a precheck false negative (clock skew) back into
+    # the identical ff/noop landing instead of a spurious merge commit
+    if view.is_ancestor(current_tip, incoming):
+        return "ff", incoming  # incoming already contains the tip
+    if view.is_ancestor(incoming, current_tip):
+        return "noop", current_tip  # nothing new to land
+    ancestor = view.merge_base(current_tip, incoming)
+    if ancestor is None:
+        return None  # unrelated histories: humans decide
+    with tm.span("server.rebase", ref=ref):
+        merged_tree, conflicts, stats = merge_trees_vectorized(
+            view,
+            RepoStructure(view, ancestor),
+            # ours = the incoming commit, theirs = the tip that beat it:
+            # the exact orientation the losing client would get from a
+            # local `kart merge <tip>`, so the conflict report below is
+            # byte-identical to that dry run (one source of truth —
+            # tests/test_merge_service.py parity test)
+            RepoStructure(view, incoming),
+            RepoStructure(view, current_tip),
+        )
+    if conflicts:
+        from kart_tpu.cli.merge_cmds import merge_conflict_report
+
+        tm.incr("server.rebase.conflicts")
+        return "conflict", {
+            "ref": ref,
+            "ancestor": ancestor,
+            "ours": incoming,
+            "theirs": current_tip,
+            "conflicts_total": len(conflicts),
+            # the exact `kart merge <theirs> --dry-run -o json` document
+            "merge": merge_conflict_report(conflicts),
+        }
+    faults.fire("server.rebase")  # frame 2: the merge-commit write
+    sig = view.signature()
+    short = ref[len("refs/heads/"):] if ref.startswith("refs/heads/") else ref
+    commit = Commit(
+        tree=merged_tree,
+        parents=(current_tip, incoming),
+        author=sig,
+        committer=sig,
+        message=(
+            f"Merge {incoming[:8]} into {short} "
+            f"(server-side rebase onto {current_tip[:8]})\n"
+        ),
+    )
+    merged_oid = q.odb.write_commit(commit)
+    faults.fire("server.rebase")  # frame 3: quarantine temp-ref write
+    q.write_temp_ref(ref, merged_oid)
+    return "merge", merged_oid
+
+
 def current_branch_ref(repo):
     kind, target = repo.refs.head_target()
     return target if kind == "symbolic" else None
@@ -559,6 +901,17 @@ class ReceiveQuarantine:
         before the push started."""
         shutil.rmtree(self.dir, ignore_errors=True)
 
+    def write_temp_ref(self, ref, oid):
+        """Record an in-flight server-side rebase result on a quarantine-
+        side temp ref (``<quarantine>/refs/<mangled-name>``): visible to
+        crash forensics, swept with the quarantine, never under the live
+        ``refs/`` tree — so a rejected or crashed rebase leaves zero ref
+        debris for gc to misread."""
+        refs_dir = os.path.join(self.dir, "refs")
+        os.makedirs(refs_dir, exist_ok=True)
+        with open(os.path.join(refs_dir, ref.replace("/", "+")), "w") as f:
+            f.write(oid + "\n")
+
     def migrate(self):
         """Move the quarantined pack(s) (and any loose strays) into the live
         store. Only called after the pack checksum and every ref-update
@@ -595,14 +948,20 @@ class ReceiveQuarantine:
 
 def quarantined_receive(repo, header, pack_fp, *, thread_lock=None):
     """The full receive-pack verb: drain the pushed pack into quarantine,
-    validate the ref updates, migrate, apply. A torn pack, a checksum
-    mismatch, or any rejected precondition leaves the live store
-    byte-identical (the quarantine is discarded); objects reach the live
-    store only in the success path, under the push locks.
+    validate the ref updates, migrate, apply — and, when the CAS was lost
+    to a contending writer, auto-rebase the incoming commit onto the new
+    tip before re-validating (docs/SERVING.md §6). A torn pack, a checksum
+    mismatch, any rejected precondition, or a crash at any rebase frame
+    leaves the live store byte-identical (the quarantine is discarded);
+    objects reach the live store only in the success path, under the push
+    locks.
 
-    -> ("ok", {ref: oid|None}) | ("conflict", msg) | ("bad", msg);
-    transfer-level failures (torn/corrupt pack) raise instead, so each
-    server reports them the same way as any other I/O failure."""
+    -> ``("ok", {"updated": {ref: oid|None}, "rebase": {...}})`` |
+    ``(kind, rejection)`` where ``rejection`` is a
+    :class:`~kart_tpu.transport.protocol.Rejection` (tuple-compatible with
+    the old ``(kind, msg)``; ``kind`` gains ``"busy"`` for the paced-retry
+    lane). Transfer-level failures (torn/corrupt pack) raise instead, so
+    each server reports them the same way as any other I/O failure."""
     from kart_tpu.transport.pack import read_pack
 
     tm.incr("transport.server.requests", verb="receive-pack")
@@ -616,33 +975,188 @@ def quarantined_receive(repo, header, pack_fp, *, thread_lock=None):
         q.discard()
         raise
     try:
-        with (thread_lock if thread_lock is not None else nullcontext()):
-            with push_file_lock(repo):
-                rejection = validate_ref_updates(
-                    repo, header, contains=q.odb.contains
-                )
-                if rejection is not None:
-                    tm.incr(
-                        "transport.server.receive_outcomes",
-                        outcome=rejection[0],
-                    )
-                    q.discard()
-                    return rejection
-                q.migrate()
-                tm.incr("transport.server.receive_outcomes", outcome="ok")
-                return "ok", _apply_validated_updates(repo, header)
+        return _land_quarantined(repo, q, header, thread_lock)
     except BaseException:
         q.discard()  # no-op after a successful migrate
         raise
 
 
+def _land_quarantined(repo, q, header, thread_lock):
+    """Validate + (rebase-as-needed) + migrate + apply a drained quarantine.
+
+    The CAS re-validation loop is bounded by ``KART_SERVE_REBASE_ATTEMPTS``
+    and — for the single-branch-update pushes that can rebase — ordered
+    through the per-ref merge queue, so K contending writers form a line
+    and each rebases exactly once onto its predecessor's tip."""
+    from kart_tpu.transport.retry import _env_int
+
+    upd = _rebaseable_update(header)
+    attempts_cap = max(
+        1, _env_int("KART_SERVE_REBASE_ATTEMPTS", DEFAULT_REBASE_ATTEMPTS)
+    )
+    retry_after = max(0, _env_int("KART_SERVE_RETRY_AFTER", 1))
+    info = {"rebased": 0, "cas_attempts": 0, "queue_wait_seconds": 0.0}
+
+    def reject(rejection):
+        tm.incr("transport.server.receive_outcomes", outcome=rejection[0])
+        q.discard()
+        return rejection
+
+    try:
+        slot = (
+            merge_queue_for(repo).slot(upd["ref"])
+            if upd is not None
+            else nullcontext(0.0)
+        )
+        with slot as waited:
+            info["queue_wait_seconds"] = round(waited or 0.0, 6)
+            view = _QuarantineRepoView(repo, q.odb)
+            for attempt in range(1, attempts_cap + 1):
+                info["cas_attempts"] = attempt
+                # the (potentially deep) fast-forward ancestry walk runs
+                # before the locks; the observed tips are re-verified under
+                # them, and movement in between just restarts the loop
+                observed, stale = _ff_precheck(view, repo, header)
+                with (thread_lock if thread_lock is not None else nullcontext()):
+                    with push_file_lock(repo):
+                        # injectable frame 1: the CAS (re-)check under both
+                        # push locks
+                        faults.fire("server.ref_cas")
+                        rejection = validate_ref_updates(
+                            repo, header, contains=q.odb.contains
+                        )
+                        if rejection is None:
+                            for ref, tip in observed.items():
+                                if repo.refs.get(ref) != tip:
+                                    # a writer landed between the precheck
+                                    # and the locks: the ff verdict is
+                                    # stale, go around again
+                                    rejection = Rejection(
+                                        "conflict",
+                                        f"Ref {ref} moved during validation",
+                                        code="cas_stale",
+                                        ref=ref,
+                                    )
+                                    break
+                        if rejection is None and stale is not None:
+                            # old matched but history diverged before the
+                            # advertisement: same contended-write situation
+                            # as a lost CAS
+                            rejection = Rejection(
+                                "conflict",
+                                f"Ref {stale['ref']} update is not a "
+                                f"fast-forward; fetch first or use --force",
+                                code="cas_stale" if stale is upd else "non_ff",
+                                ref=stale["ref"],
+                                terminal=stale is not upd,
+                            )
+                        if rejection is None:
+                            # injectable frame 2: quarantine migrate into
+                            # the live store
+                            faults.fire("server.ref_cas")
+                            q.migrate()
+                            tm.incr(
+                                "transport.server.receive_outcomes",
+                                outcome="ok",
+                            )
+                            if info["rebased"]:
+                                tm.incr("server.rebase.landed")
+                            updated = _apply_validated_updates(repo, header)
+                            return "ok", {"updated": updated, "rebase": info}
+                        current = (
+                            repo.refs.get(upd["ref"]) if upd is not None else None
+                        )
+                if upd is None or getattr(rejection, "code", None) != "cas_stale":
+                    return reject(rejection)
+                if attempt >= attempts_cap:
+                    break
+                # CAS lost to a contending writer: rebase outside the locks
+                # (the classifier run must not extend the critical section)
+                tm.incr("server.rebase.attempts")
+                outcome = _rebase_onto(repo, q, upd, current)
+                if outcome is None:
+                    return reject(
+                        Rejection(
+                            "conflict",
+                            f"Push to {upd['ref']} rejected (non-fast-forward: "
+                            f"no common ancestor with the current tip); fetch "
+                            f"first or use --force",
+                            code="non_ff",
+                            ref=upd["ref"],
+                            terminal=True,
+                        )
+                    )
+                kind, value = outcome
+                if kind == "conflict":
+                    return reject(
+                        Rejection(
+                            "conflict",
+                            f"Push to {upd['ref']} rejected: merging the "
+                            f"incoming commit with the current tip conflicts "
+                            f"({value['conflicts_total']} conflicts); pull and "
+                            f"resolve locally, then push the merge",
+                            code="merge_conflict",
+                            ref=upd["ref"],
+                            conflict_report=value,
+                            terminal=True,
+                        )
+                    )
+                info["rebased"] = 1
+                info["mode"] = kind  # "merge" | "ff" | "noop"
+                upd["old"], upd["new"] = current, value
+            tm.incr("server.rebase.exhausted")
+            return reject(
+                Rejection(
+                    "busy",
+                    f"Ref {upd['ref']} kept moving through {attempts_cap} CAS "
+                    f"attempts; retry shortly",
+                    code="cas_busy",
+                    ref=upd["ref"],
+                    retry_after=retry_after,
+                    shed=True,
+                )
+            )
+    except MergeQueueFull as e:
+        return reject(
+            Rejection(
+                "busy",
+                str(e),
+                code="queue_full",
+                retry_after=retry_after,
+                shed=True,
+            )
+        )
+
+
+def _df_collision(repo, ref):
+    """A ref name colliding with an existing ref at a directory/file
+    boundary (``refs/heads/a`` vs ``refs/heads/a/b``) can never be created
+    — the loose-ref store would need ``a`` to be both a file and a
+    directory, and ``refs.set`` would die half-way with debris. A
+    server-constructed rebased ref must trip this cleanly, not crash.
+    -> message, or None. O(path depth), not O(refs): this runs under the
+    push locks."""
+    existing = repo.refs.df_conflict(ref)
+    if existing is not None:
+        return (
+            f"Ref {ref} conflicts with existing ref {existing} "
+            f"(directory/file collision); delete it first"
+        )
+    return None
+
+
 def validate_ref_updates(repo, header, *, contains=None):
     """Check every precondition of a receive-pack's ref updates without
-    moving anything: refname hygiene, checked-out-branch protection, CAS
-    against the current tips, and object connectivity via ``contains``
-    (a quarantine's combined live+incoming check during a push).
+    moving anything: refname hygiene (including names shaped like crash
+    debris and directory/file collisions with existing refs),
+    checked-out-branch protection, CAS against the current tips, and
+    object connectivity via ``contains`` (a quarantine's combined
+    live+incoming check during a push).
 
-    -> None when everything passes, else ("conflict"|"bad", msg)."""
+    -> None when everything passes, else a
+    :class:`~kart_tpu.transport.protocol.Rejection` — tuple-compatible
+    ``("conflict"|"bad", msg)`` carrying a machine-readable ``code`` the
+    rebase loop keys on (only ``cas_stale`` is recoverable)."""
     contains = contains or repo.odb.contains
     deny_current = (
         repo.workdir is not None
@@ -658,23 +1172,39 @@ def validate_ref_updates(repo, header, *, contains=None):
         try:
             check_ref_format(ref, require_refs_prefix=True)
         except RefError as e:
-            return "bad", str(e)
+            return Rejection("bad", str(e), code="bad_ref", ref=ref,
+                             terminal=True)
         if deny_current and ref == current_branch_ref(repo):
-            return (
+            return Rejection(
                 "conflict",
                 f"Refusing to update checked-out branch {ref} (the server's "
                 f"working copy would go out of sync). Serve a bare repo, or "
                 f"set receive.denyCurrentBranch=ignore there.",
+                code="denied",
+                ref=ref,
+                terminal=True,
             )
+        if new is not None:
+            collision = _df_collision(repo, ref)
+            if collision is not None:
+                return Rejection(
+                    "conflict", collision, code="df_conflict", ref=ref,
+                    terminal=True,
+                )
         current = repo.refs.get(ref)
         if not upd.get("force") and current != old:
-            return (
+            return Rejection(
                 "conflict",
                 f"Ref {ref} moved (expected {old}, is {current}); "
                 f"fetch first or use --force",
+                code="cas_stale",
+                ref=ref,
             )
         if new is not None and not contains(new):
-            return "bad", f"Push incomplete: {new} not received"
+            return Rejection(
+                "bad", f"Push incomplete: {new} not received",
+                code="incomplete", ref=ref,
+            )
     return None
 
 

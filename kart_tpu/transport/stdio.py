@@ -259,8 +259,14 @@ class StdioRemote:
                 # drain the (empty) pack so the pipe stays usable
                 for _ in read_pack(pack_fp):
                     pass
+                from kart_tpu.transport.protocol import error_attrs_from_wire
+
+                # structured-rejection fields (terminal verdicts, the
+                # conflict report, busy pacing) ride the error frame so the
+                # ssh transport reports a contended push exactly like HTTP
                 raise StdioTransportError(
-                    f"Remote {self.url!r} error: {resp['error']}"
+                    f"Remote {self.url!r} error: {resp['error']}",
+                    **error_attrs_from_wire(resp),
                 )
             try:
                 if drain is None:
@@ -331,8 +337,15 @@ class StdioRemote:
 
     def receive_pack(self, objects, updates, *, shallow=()):
         """Not idempotent: only spawn failures (pre-write — no byte reached
-        the server) are retried."""
+        the server) and the server's paced busy rejections (merge queue
+        full / CAS budget exhausted — provably applied nothing) are
+        retried; a structured conflict rejection is terminal. -> the full
+        receive payload ``{"updated": ..., "rebase": ...}``, like
+        HttpRemote."""
         from kart_tpu.transport.retry import is_pre_write
+
+        def retryable(exc):
+            return is_pre_write(exc) or getattr(exc, "shed", False)
 
         def attempt():
             resp, _ = self._rpc(
@@ -345,11 +358,10 @@ class StdioRemote:
             )
             return resp
 
-        resp = self.retry.call(
-            attempt, label="receive-pack", retryable=is_pre_write,
+        return self.retry.call(
+            attempt, label="receive-pack", retryable=retryable,
             on_retry=self.reset,
         )
-        return resp["updated"]
 
 
 # ---------------------------------------------------------------------------
@@ -396,14 +408,20 @@ def serve_stdio(repo, in_fp, out_fp):
         try:
             if op == "receive-pack":
                 # the request pack drains into quarantine and migrates only
-                # after checksum + ref preconditions pass — a torn push
-                # leaves the store byte-identical (and desyncs the stream,
-                # handled by the PackFormatError close below)
-                status, payload = quarantined_receive(repo, header, in_fp)
-                if status == "ok":
-                    write_framed(out_fp, {"updated": payload}, ())
+                # after checksum + ref preconditions pass (a torn push
+                # leaves the store byte-identical and desyncs the stream,
+                # handled by the PackFormatError close below); a CAS lost
+                # to a contending writer is auto-rebased server-side, and
+                # a structured rejection's extras ride the error frame
+                from kart_tpu.transport.protocol import rejection_wire_fields
+
+                result = quarantined_receive(repo, header, in_fp)
+                if result[0] == "ok":
+                    write_framed(out_fp, result[1], ())
                 else:
-                    write_framed(out_fp, {"error": payload, "status": status}, ())
+                    frame = {"error": result[1], "status": result[0]}
+                    frame.update(rejection_wire_fields(result))
+                    write_framed(out_fp, frame, ())
             else:
                 # every other op carries an empty request pack
                 for _ in read_pack(in_fp):

@@ -110,27 +110,14 @@ def make_imported_repo(tmp_path, *, n=10):
 
 
 def edit_commit(repo, ds_path, *, inserts=(), updates=(), deletes=(), message="edit features", ref="HEAD"):
-    """Build a feature diff and commit it; -> commit oid."""
-    from kart_tpu.diff.structs import Delta, DeltaDiff, DatasetDiff, KeyValue, RepoDiff
+    """Build a feature diff and commit it; -> commit oid (shared helper in
+    kart_tpu.synth — bench.py's storm workers use the same one)."""
+    from kart_tpu.synth import commit_feature_edits
 
-    structure = repo.structure(ref)
-    ds = structure.datasets[ds_path]
-    feature_diff = DeltaDiff()
-    for f in inserts:
-        feature_diff.add_delta(Delta.insert(KeyValue((f["fid"], f))))
-    for f in updates:
-        old = ds.get_feature([f["fid"]])
-        feature_diff.add_delta(
-            Delta.update(KeyValue((f["fid"], old)), KeyValue((f["fid"], f)))
-        )
-    for pk in deletes:
-        old = ds.get_feature([pk])
-        feature_diff.add_delta(Delta.delete(KeyValue((pk, old))))
-    ds_diff = DatasetDiff()
-    ds_diff["feature"] = feature_diff
-    repo_diff = RepoDiff()
-    repo_diff[ds_path] = ds_diff
-    return structure.commit_diff(repo_diff, message)
+    return commit_feature_edits(
+        repo, ds_path, inserts=inserts, updates=updates, deletes=deletes,
+        message=message, ref=ref,
+    )
 
 
 def make_repo_with_edits(tmp_path, *, n=40):

@@ -126,6 +126,31 @@ NEW_KEYS += [
 ]
 
 
+#: keys added by ISSUE 9 (`bench.py --merge-storm`: K contending writers on
+#: one branch through the server-side auto-rebase + merge queue — commits
+#: landed/s, retry amplification (client wire attempts / commits landed),
+#: client-visible CAS failures (must be 0), queue waits, the
+#: overlapping-feature conflict leg (terminal after exactly one attempt),
+#: and the SIGKILL-the-server-mid-storm leg). Recorded in BENCH_r09.json.
+NEW_KEYS += [
+    "merge_storm_writers",
+    "merge_storm_commits_total",
+    "merge_storm_commits_landed",
+    "merge_storm_commits_per_sec",
+    "merge_storm_client_attempts",
+    "merge_storm_retry_amplification",
+    "merge_storm_cas_failures_client_visible",
+    "merge_storm_queue_p99_wait_seconds",
+    "merge_storm_queue_mean_wait_seconds",
+    "merge_storm_rebases_landed",
+    "rebase_conflict_writers",
+    "rebase_conflict_rejections",
+    "rebase_conflict_attempts_per_reject",
+    "merge_storm_fault_writers",
+    "merge_storm_fault_writers_ok",
+]
+
+
 def test_bench_emits_every_recorded_key():
     with open(os.path.join(REPO_ROOT, "bench.py")) as f:
         src = f.read()

@@ -358,19 +358,159 @@ def test_torn_push_leaves_server_store_byte_identical(
 
 
 def test_rejected_push_leaves_server_store_byte_identical(served_repo, tmp_path):
-    """A push failing its preconditions (non-fast-forward CAS) discards the
-    quarantine: the server store holds no trace of the rejected objects."""
+    """A push failing its preconditions (the contended rebase hits real
+    conflicts) discards the quarantine: the server store holds no trace of
+    the rejected objects — not even the classifier's scratch trees or the
+    quarantine temp ref."""
     repo, ds_path, url = served_repo
     clone = transport.clone(url, tmp_path / "clone", do_checkout=False)
     clone.config.set_many({"user.name": "C", "user.email": "c@example.com"})
-    edit_commit(repo, ds_path, deletes=[4], message="upstream moved")
-    local_oid = edit_commit(clone, ds_path, deletes=[5], message="local change")
+    edit_commit(
+        repo, ds_path,
+        updates=[{"fid": 4, "geom": None, "name": "srv", "rating": 1.0}],
+        message="upstream moved",
+    )
+    local_oid = edit_commit(
+        clone, ds_path,
+        updates=[{"fid": 4, "geom": None, "name": "loc", "rating": 2.0}],
+        message="local change",
+    )
 
     before = store_snapshot(repo)
-    with pytest.raises(RemoteError, match="non-fast-forward"):
+    with pytest.raises(RemoteError, match="conflict"):
         transport.push(clone, "origin")
     assert store_snapshot(repo) == before
     assert not repo.odb.contains(local_oid)
+    assert quarantine_entries(repo) == []
+
+
+# ---------------------------------------------------------------------------
+# contended-push rebase kill matrix (ISSUE 9: server.rebase / server.ref_cas)
+# ---------------------------------------------------------------------------
+
+
+def _contended_push_setup(served_repo, tmp_path, name):
+    """A clone whose push will lose the CAS: the server tip moves (disjoint
+    edit) after the clone, so landing the push requires the server-side
+    rebase. -> (clone, its local commit oid, the moved server tip)."""
+    repo, ds_path, url = served_repo
+    clone = transport.clone(url, tmp_path / name, do_checkout=False)
+    clone.config.set_many({"user.name": "C", "user.email": "c@example.com"})
+    local_oid = edit_commit(clone, ds_path, deletes=[5], message="contender")
+    moved_tip = edit_commit(repo, ds_path, deletes=[4], message="tip moved")
+    return clone, local_oid, moved_tip
+
+
+@pytest.mark.parametrize("frame", [1, 2, 3])
+def test_rebase_killed_at_every_frame_leaves_store_byte_identical(
+    served_repo, tmp_path, monkeypatch, frame
+):
+    """ISSUE 9 acceptance: a crash at ANY frame of the server-side rebase —
+    1 = ancestry/classifier run, 2 = merge-commit write, 3 = quarantine
+    temp-ref write — discards the quarantine: live store byte-identical,
+    refs unmoved, zero quarantine debris; the client simply re-pushes and
+    the (now unarmed) rebase lands both edits."""
+    repo, ds_path, url = served_repo
+    clone, local_oid, moved_tip = _contended_push_setup(
+        served_repo, tmp_path, f"kill{frame}"
+    )
+    before = store_snapshot(repo)
+    monkeypatch.setenv("KART_TRANSPORT_RETRIES", "1")
+    monkeypatch.setenv("KART_FAULTS", f"server.rebase:{frame}")
+    with pytest.raises(RemoteError, match="InjectedFault"):
+        transport.push(clone, "origin")
+    monkeypatch.delenv("KART_FAULTS")
+    monkeypatch.delenv("KART_TRANSPORT_RETRIES")
+
+    assert store_snapshot(repo) == before
+    assert repo.refs.get("refs/heads/main") == moved_tip
+    assert quarantine_entries(repo) == []
+    fsck_objects(repo)
+
+    # resumable: the identical re-push now rebases and lands
+    updated = transport.push(clone, "origin")
+    tip = repo.refs.get("refs/heads/main")
+    assert updated == {"refs/heads/main": tip}
+    assert repo.odb.read_commit(tip).parents == (moved_tip, local_oid)
+    assert quarantine_entries(repo) == []
+
+
+@pytest.mark.parametrize("frame", [1, 2])
+def test_ref_cas_killed_at_every_frame_leaves_store_byte_identical(
+    served_repo, tmp_path, monkeypatch, frame
+):
+    """server.ref_cas kill matrix: a crash at the locked landing frames —
+    1 = the CAS (re-)validation, 2 = just before quarantine migrate —
+    leaves the store byte-identical and the push lock released (the
+    re-push must not deadlock), and the retried push lands."""
+    repo, ds_path, url = served_repo
+    clone = transport.clone(url, tmp_path / f"cas{frame}", do_checkout=False)
+    clone.config.set_many({"user.name": "C", "user.email": "c@example.com"})
+    new_oid = edit_commit(clone, ds_path, deletes=[5], message="to land")
+
+    before = store_snapshot(repo)
+    ref_before = repo.refs.get("refs/heads/main")
+    monkeypatch.setenv("KART_TRANSPORT_RETRIES", "1")
+    monkeypatch.setenv("KART_FAULTS", f"server.ref_cas:{frame}")
+    with pytest.raises(RemoteError, match="InjectedFault"):
+        transport.push(clone, "origin")
+    monkeypatch.delenv("KART_FAULTS")
+    monkeypatch.delenv("KART_TRANSPORT_RETRIES")
+
+    assert store_snapshot(repo) == before
+    assert repo.refs.get("refs/heads/main") == ref_before
+    assert quarantine_entries(repo) == []
+    fsck_objects(repo)
+
+    assert transport.push(clone, "origin") == {"refs/heads/main": new_oid}
+    assert repo.refs.get("refs/heads/main") == new_oid
+    assert quarantine_entries(repo) == []
+
+
+def test_rebase_kill_then_conflicting_rebase_still_terminal(
+    served_repo, tmp_path, monkeypatch
+):
+    """Sequence the crash with a real conflict: after an injected rebase
+    kill, a *conflicting* re-push is rejected terminally (exactly one
+    attempt — the retry policy must not re-push a terminal verdict) with
+    the store still byte-identical."""
+    repo, ds_path, url = served_repo
+    clone = transport.clone(url, tmp_path / "seq", do_checkout=False)
+    clone.config.set_many({"user.name": "C", "user.email": "c@example.com"})
+    edit_commit(
+        clone, ds_path,
+        updates=[{"fid": 3, "geom": None, "name": "loc", "rating": 2.0}],
+        message="contender",
+    )
+    edit_commit(
+        repo, ds_path,
+        updates=[{"fid": 3, "geom": None, "name": "srv", "rating": 1.0}],
+        message="tip moved",
+    )
+    monkeypatch.setenv("KART_FAULTS", "server.rebase:1")
+    with pytest.raises(RemoteError, match="InjectedFault"):
+        transport.push(clone, "origin")
+    monkeypatch.delenv("KART_FAULTS")
+    before = store_snapshot(repo)
+    sleeps = []
+    from kart_tpu.transport.remote import network_remote
+
+    # count retry sleeps through a custom policy: terminal ⇒ zero retries
+    policy = RetryPolicy(attempts=5, base_delay=0.01, sleep=sleeps.append)
+    with pytest.raises(RemoteError, match="conflict"):
+        clone_url = clone.config.get("remote.origin.url")
+        net = network_remote(clone_url, retry=policy)
+        try:
+            from kart_tpu.transport.remote import _push_network
+
+            _push_network(
+                clone, "origin", net, ["main:main"],
+                force=False, set_upstream=False,
+            )
+        finally:
+            net.close()
+    assert sleeps == []  # terminal: surfaced once, never blindly re-pushed
+    assert store_snapshot(repo) == before
     assert quarantine_entries(repo) == []
 
 

@@ -69,15 +69,44 @@ class TestHttpCloneFetchPush:
         assert repo.refs.get("refs/heads/main") == new_oid
         assert repo.odb.contains(new_oid)
 
-    def test_push_non_ff_rejected_then_forced(self, served_repo, tmp_path):
+    def test_push_diverged_clean_is_auto_rebased(self, served_repo, tmp_path):
+        """A diverged push with *disjoint* edits no longer bounces: the
+        server three-way merges it against the moved tip and lands a merge
+        commit carrying both sides (docs/SERVING.md §6)."""
         repo, ds_path, url = served_repo
         clone = transport.clone(url, tmp_path / "clone", do_checkout=False)
         clone.config.set_many(
             {"user.name": "Cloner", "user.email": "c@example.com"}
         )
-        edit_commit(repo, ds_path, deletes=[4], message="upstream change")
-        edit_commit(clone, ds_path, deletes=[5], message="local change")
-        with pytest.raises(RemoteError, match="non-fast-forward"):
+        upstream = edit_commit(repo, ds_path, deletes=[4], message="upstream change")
+        local = edit_commit(clone, ds_path, deletes=[5], message="local change")
+        updated = transport.push(clone, "origin")
+        tip = repo.refs.get("refs/heads/main")
+        assert updated == {"refs/heads/main": tip}
+        assert repo.odb.read_commit(tip).parents == (upstream, local)
+        fids = {f["fid"] for f in repo.datasets("HEAD")[ds_path].features()}
+        assert 4 not in fids and 5 not in fids  # both edits present
+
+    def test_push_conflicting_rejected_then_forced(self, served_repo, tmp_path):
+        """A diverged push whose edits *conflict* is rejected with the
+        structured report (rendered like a local merge conflict); --force
+        still overrides."""
+        repo, ds_path, url = served_repo
+        clone = transport.clone(url, tmp_path / "clone", do_checkout=False)
+        clone.config.set_many(
+            {"user.name": "Cloner", "user.email": "c@example.com"}
+        )
+        edit_commit(
+            repo, ds_path,
+            updates=[{"fid": 4, "geom": None, "name": "srv", "rating": 1.0}],
+            message="upstream change",
+        )
+        edit_commit(
+            clone, ds_path,
+            updates=[{"fid": 4, "geom": None, "name": "loc", "rating": 2.0}],
+            message="local change",
+        )
+        with pytest.raises(RemoteError, match="conflict"):
             transport.push(clone, "origin")
         transport.push(clone, "origin", force=True)
         assert repo.refs.get("refs/heads/main") == clone.head_commit_oid

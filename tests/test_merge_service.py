@@ -1,0 +1,640 @@
+"""Contended-ref write service (ISSUE 9): server-side auto-rebase of
+CAS-losing pushes, the per-ref FIFO merge queue, structured terminal
+conflict rejection (byte-identical to a local `kart merge --dry-run -o
+json`), the RetryPolicy terminal/paced split, and the refname hygiene a
+server-constructed ref could trip."""
+
+import json
+import os
+import threading
+
+import pytest
+
+from kart_tpu import telemetry, transport
+from kart_tpu.core.repo import KartRepo
+from kart_tpu.transport import service
+from kart_tpu.transport.http import HttpRemote, HttpTransportError, make_server
+from kart_tpu.transport.protocol import (
+    ObjectEnumerator,
+    Rejection,
+    error_attrs_from_wire,
+    rejection_wire_fields,
+)
+from kart_tpu.transport.remote import RemoteError
+from kart_tpu.transport.retry import RetryPolicy, is_terminal
+
+from helpers import edit_commit, make_imported_repo
+
+
+@pytest.fixture(autouse=True)
+def _fresh_metrics():
+    telemetry.reset()
+    yield
+    telemetry.reset()
+
+
+@pytest.fixture(autouse=True)
+def _fast_retries(monkeypatch):
+    monkeypatch.setenv("KART_TRANSPORT_RETRY_BASE", "0.01")
+    monkeypatch.setenv("KART_TRANSPORT_RETRY_CAP", "0.05")
+    monkeypatch.delenv("KART_FAULTS", raising=False)
+    monkeypatch.delenv("KART_SERVE_REBASE_ATTEMPTS", raising=False)
+    monkeypatch.delenv("KART_SERVE_MERGE_QUEUE", raising=False)
+
+
+@pytest.fixture()
+def served_repo(tmp_path):
+    repo, ds_path = make_imported_repo(tmp_path, n=16)
+    repo.config["receive.denyCurrentBranch"] = "ignore"
+    server = make_server(repo)
+    threading.Thread(target=server.serve_forever, daemon=True).start()
+    url = f"http://127.0.0.1:{server.server_address[1]}/"
+    yield repo, ds_path, url
+    server.shutdown()
+    server.server_close()
+
+
+def counter(name, **labels):
+    for n, l, v in telemetry.snapshot()["counters"]:
+        if n == name and l == labels:
+            return v
+    return 0
+
+
+def make_clone(url, tmp_path, name):
+    clone = transport.clone(url, tmp_path / name, do_checkout=False)
+    clone.config.set_many(
+        {"user.name": name, "user.email": f"{name}@example.com"}
+    )
+    return clone
+
+
+def raw_receive(url, repo, new_oid, *, old_oid, ref="refs/heads/main",
+                retry=None):
+    """Drive receive-pack directly (bypassing transport.push) so tests can
+    pick the CAS base and read the full response payload."""
+    from kart_tpu.transport.http import have_closure
+    from kart_tpu.transport.remote import read_shallow
+
+    client = HttpRemote(url, retry=retry or RetryPolicy(attempts=1))
+    info = client.ls_refs()
+    server_refs = {f"refs/heads/{b}": o for b, o in info["heads"].items()}
+    has = have_closure(
+        repo.odb, list(server_refs.values()), info.get("shallow", ())
+    )
+    enum = ObjectEnumerator(
+        repo.odb, [new_oid], has=has.__contains__,
+        sender_shallow=read_shallow(repo),
+    )
+    return client.receive_pack(
+        enum,
+        [{"ref": ref, "old": old_oid, "new": new_oid, "force": False}],
+        shallow=lambda: enum.shallow_boundary,
+    )
+
+
+# ---------------------------------------------------------------------------
+# the tier-1 merge-storm smoke: K=4 in-process writers, one branch
+# ---------------------------------------------------------------------------
+
+
+def test_four_writer_storm_all_land_zero_client_failures(served_repo, tmp_path):
+    """ISSUE 9 acceptance (tier-1 scale): K=4 writers hammering one branch
+    with disjoint-feature commits all land with zero client-visible CAS
+    failures — the losers are rebased server-side and ordered through the
+    merge queue — and every edit is reachable from the final tip."""
+    repo, ds_path, url = served_repo
+    K = 4
+    outcomes, oids, errors = [], {}, []
+
+    def writer(i):
+        try:
+            clone = make_clone(url, tmp_path, f"w{i}")
+            oids[i] = edit_commit(
+                clone, ds_path, deletes=[i + 1], message=f"writer {i}"
+            )
+            transport.push(clone, "origin")
+            outcomes.append("ok")
+        except Exception as e:  # kart: noqa(KTL006): re-raised below via the errors list — a bare thread would swallow the failure entirely
+            errors.append(e)
+
+    threads = [threading.Thread(target=writer, args=(i,)) for i in range(K)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert not errors, errors
+    assert outcomes == ["ok"] * K
+    tip = repo.refs.get("refs/heads/main")
+    for oid in oids.values():
+        assert repo.is_ancestor(oid, tip)
+    fids = {f["fid"] for f in repo.datasets("HEAD")[ds_path].features()}
+    assert fids.isdisjoint({1, 2, 3, 4})  # all four deletes landed
+    # at least K-1 pushes went through the rebase path, none conflicted
+    assert counter("server.rebase.landed") >= 1
+    assert counter("server.rebase.conflicts") == 0
+    assert counter("server.rebase.exhausted") == 0
+
+
+# ---------------------------------------------------------------------------
+# rebase outcome modes: merge / fast-forward / noop
+# ---------------------------------------------------------------------------
+
+
+def test_stale_cas_fast_forwards_when_incoming_contains_tip(
+    served_repo, tmp_path
+):
+    """A push whose CAS base is stale but whose commit already *contains*
+    the current tip fast-forwards — no merge commit is created."""
+    repo, ds_path, url = served_repo
+    base = repo.refs.get("refs/heads/main")
+    clone = make_clone(url, tmp_path, "ff")
+    c1 = edit_commit(clone, ds_path, deletes=[1], message="c1")
+    transport.push(clone, "origin")  # tip is now c1
+    c2 = edit_commit(clone, ds_path, deletes=[2], message="c2")
+    # push c2 claiming the ORIGINAL base as CAS base: stale, but c2 ⊇ tip
+    result = raw_receive(url, clone, c2, old_oid=base)
+    assert result["updated"] == {"refs/heads/main": c2}
+    assert result["rebase"]["rebased"] == 1
+    assert result["rebase"]["mode"] == "ff"
+    assert repo.refs.get("refs/heads/main") == c2
+
+
+def test_stale_cas_noop_when_incoming_already_merged(served_repo, tmp_path):
+    """Re-pushing a commit the tip already contains lands as a no-op: the
+    ref stays at the current tip, nothing is created."""
+    repo, ds_path, url = served_repo
+    clone = make_clone(url, tmp_path, "noop")
+    c1 = edit_commit(clone, ds_path, deletes=[1], message="c1")
+    transport.push(clone, "origin")
+    c2 = edit_commit(clone, ds_path, deletes=[2], message="c2")
+    transport.push(clone, "origin")  # tip is c2 (contains c1)
+    result = raw_receive(url, clone, c1, old_oid="0" * 40)
+    assert result["updated"] == {"refs/heads/main": c2}
+    assert result["rebase"]["mode"] == "noop"
+    assert repo.refs.get("refs/heads/main") == c2
+
+
+def test_rebased_merge_commit_shape_and_store_integrity(served_repo, tmp_path):
+    """The landed merge commit: first parent = the tip that won, second =
+    the incoming commit; tree carries both edits; every object (including
+    the server-made commit) migrated from quarantine into the live store."""
+    repo, ds_path, url = served_repo
+    w1 = make_clone(url, tmp_path, "w1")
+    w2 = make_clone(url, tmp_path, "w2")
+    o1 = edit_commit(w1, ds_path, deletes=[1], message="w1")
+    o2 = edit_commit(w2, ds_path, deletes=[2], message="w2")
+    transport.push(w1, "origin")
+    updated = transport.push(w2, "origin")
+    tip = repo.refs.get("refs/heads/main")
+    assert updated == {"refs/heads/main": tip}
+    merge = repo.odb.read_commit(tip)
+    assert merge.parents == (o1, o2)
+    assert "server-side rebase" in merge.message
+    fids = {f["fid"] for f in repo.datasets("HEAD")[ds_path].features()}
+    assert 1 not in fids and 2 not in fids
+    # the clone's tracking ref must stay RESOLVABLE: the server-made merge
+    # commit was never downloaded, so tracking falls back to our own commit
+    # (an ancestor of the true tip — behind, never dangling)
+    track = w2.refs.get("refs/remotes/origin/main")
+    assert track == o2
+    assert w2.odb.contains(track)
+    # a later fetch fast-forwards tracking to the real tip
+    transport.fetch(w2, "origin")
+    assert w2.refs.get("refs/remotes/origin/main") == tip
+    assert w2.odb.contains(tip)
+    assert service.merge_queue_for(repo) is service.merge_queue_for(repo)
+
+
+# ---------------------------------------------------------------------------
+# structured conflict rejection + parity with local `kart merge --dry-run`
+# ---------------------------------------------------------------------------
+
+
+def _conflicting_pair(served_repo, tmp_path):
+    repo, ds_path, url = served_repo
+    w1 = make_clone(url, tmp_path, "winner")
+    w2 = make_clone(url, tmp_path, "loser")
+    edit_commit(
+        w1, ds_path,
+        updates=[{"fid": 5, "geom": None, "name": "winner", "rating": 1.0}],
+        message="winner",
+    )
+    loser_oid = edit_commit(
+        w2, ds_path,
+        updates=[{"fid": 5, "geom": None, "name": "loser", "rating": 2.0}],
+        message="loser",
+    )
+    transport.push(w1, "origin")
+    return repo, ds_path, url, w2, loser_oid
+
+
+def test_conflict_rejection_is_terminal_single_attempt(served_repo, tmp_path):
+    """Overlapping-feature contention rejects with the structured report
+    after exactly ONE wire attempt — the terminal flag must defeat even a
+    generous retry policy (the ISSUE 9 retry-amplification bug)."""
+    repo, ds_path, url, loser, loser_oid = _conflicting_pair(
+        served_repo, tmp_path
+    )
+    base = loser.refs.get("refs/remotes/origin/main")
+    sleeps = []
+    policy = RetryPolicy(attempts=5, base_delay=0.01, sleep=sleeps.append)
+    with pytest.raises(HttpTransportError) as exc:
+        raw_receive(url, loser, loser_oid, old_oid=base, retry=policy)
+    e = exc.value
+    assert e.terminal and is_terminal(e)
+    assert not e.transient
+    assert sleeps == []  # exactly one attempt, zero backoff sleeps
+    report = e.conflict_report
+    assert report["ref"] == "refs/heads/main"
+    assert report["ours"] == loser_oid
+    assert report["theirs"] == repo.refs.get("refs/heads/main")
+    assert report["conflicts_total"] == 1
+    body = report["merge"]["kart.merge/v1"]
+    assert body["conflicts"] == {ds_path: {"feature": 1}}
+    assert body["state"] == "merging" and body["dryRun"] is True
+
+
+def test_conflict_report_parity_with_local_merge_dry_run(served_repo, tmp_path):
+    """Satellite: the server's structured report must be byte-identical
+    JSON to what the losing client computes locally with
+    `kart merge <tip> --dry-run -o json` over the same two commits — one
+    source of truth for the summary."""
+    from click.testing import CliRunner
+
+    from kart_tpu.cli import cli
+
+    repo, ds_path, url, loser, loser_oid = _conflicting_pair(
+        served_repo, tmp_path
+    )
+    base = loser.refs.get("refs/remotes/origin/main")
+    with pytest.raises(HttpTransportError) as exc:
+        raw_receive(url, loser, loser_oid, old_oid=base)
+    report = exc.value.conflict_report
+
+    # the losing client's local view of the same merge
+    transport.fetch(loser, "origin")
+    tip = report["theirs"]
+    r = CliRunner().invoke(
+        cli,
+        ["-C", loser.gitdir, "merge", tip, "--dry-run", "-o", "json"],
+        catch_exceptions=False,
+    )
+    assert r.exit_code == 0, r.output
+    local_doc = json.loads(r.output)
+    assert json.dumps(report["merge"], sort_keys=False) == json.dumps(
+        local_doc, sort_keys=False
+    )
+
+
+def test_conflict_rendered_like_local_merge(served_repo, tmp_path):
+    """transport.push surfaces the report as the same hierarchical text a
+    local merge conflict prints (dataset + part + count)."""
+    repo, ds_path, url, loser, _ = _conflicting_pair(served_repo, tmp_path)
+    with pytest.raises(RemoteError) as exc:
+        transport.push(loser, "origin")
+    text = str(exc.value)
+    assert f"{ds_path}:" in text
+    assert "feature:" in text and "1 conflicts" in text
+    assert "kart merge" in text  # tells the human the local recourse
+    assert "\x1b[" not in text  # unstyled: this is an exception message
+
+
+# ---------------------------------------------------------------------------
+# the busy lane: bounded CAS attempts + merge-queue overflow shed
+# ---------------------------------------------------------------------------
+
+
+def test_cas_budget_exhausted_is_paced_retryable_not_terminal(
+    served_repo, tmp_path, monkeypatch
+):
+    """KART_SERVE_REBASE_ATTEMPTS=1 turns any stale CAS into the busy
+    rejection: 429 + Retry-After, shed (so even receive-pack retries it,
+    paced), never terminal."""
+    monkeypatch.setenv("KART_SERVE_REBASE_ATTEMPTS", "1")
+    monkeypatch.setenv("KART_SERVE_RETRY_AFTER", "2")
+    repo, ds_path, url = served_repo
+    clone = make_clone(url, tmp_path, "busy")
+    c1 = edit_commit(clone, ds_path, deletes=[1], message="c1")
+    sleeps = []
+    policy = RetryPolicy(attempts=2, base_delay=0.01, sleep=sleeps.append)
+    with pytest.raises(HttpTransportError) as exc:
+        raw_receive(url, clone, c1, old_oid="f" * 40, retry=policy)
+    e = exc.value
+    assert e.shed and e.transient and not e.terminal
+    assert e.retry_after == 2.0
+    assert sleeps == [2.0]  # retried once, floored by the server's pacing
+    assert counter("server.rebase.exhausted") == 2
+    # nothing landed, nothing left behind
+    assert repo.refs.get("refs/heads/main") != c1
+    quarantine = os.path.join(repo.odb.objects_dir, "quarantine")
+    assert not os.path.isdir(quarantine) or os.listdir(quarantine) == []
+
+
+def test_merge_queue_overflow_sheds_with_retry_after(
+    served_repo, tmp_path, monkeypatch
+):
+    """KART_SERVE_MERGE_QUEUE bounds the per-ref line: with the only slot
+    held, a push is shed busy (429 + Retry-After) instead of queueing; once
+    the slot frees, the identical push lands."""
+    monkeypatch.setenv("KART_SERVE_MERGE_QUEUE", "1")
+    repo, ds_path, url = served_repo
+    clone = make_clone(url, tmp_path, "q")
+    c1 = edit_commit(clone, ds_path, deletes=[1], message="c1")
+    queue = service.merge_queue_for(repo)
+    slot = queue.slot("refs/heads/main")
+    slot.__enter__()  # occupy the line like an in-flight contended push
+    try:
+        with pytest.raises(HttpTransportError) as exc:
+            raw_receive(url, clone, c1, old_oid=None)
+        assert exc.value.shed and not exc.value.terminal
+        assert counter("server.merge_queue.shed") == 1
+    finally:
+        slot.__exit__(None, None, None)
+    base = repo.refs.get("refs/heads/main")
+    result = raw_receive(url, clone, c1, old_oid=base)
+    assert result["updated"] == {"refs/heads/main": c1}
+
+
+def test_merge_queue_orders_waiters_fifo():
+    """Unit: tickets are served strictly in arrival order, the depth gauge
+    drains, and a released line is reclaimed."""
+    queue = service.MergeQueue()
+    order = []
+    first = queue.slot("refs/heads/x")
+    first.__enter__()
+    threads = []
+
+    def waiter(i):
+        with queue.slot("refs/heads/x"):
+            order.append(i)
+
+    for i in range(3):
+        t = threading.Thread(target=waiter, args=(i,))
+        t.start()
+        threads.append(t)
+        # let each enqueue before the next (arrival order = ticket order)
+        import time as _time
+
+        deadline = _time.monotonic() + 5
+        while len(queue._lines["refs/heads/x"]) and (
+            queue._lines["refs/heads/x"]["next"] < i + 2
+        ):
+            if _time.monotonic() > deadline:  # pragma: no cover - wedge guard
+                raise AssertionError("waiter never enqueued")
+            _time.sleep(0.005)
+    first.__exit__(None, None, None)
+    for t in threads:
+        t.join(10)
+    assert order == [0, 1, 2]
+    assert queue._lines == {}  # line reclaimed once drained
+
+
+# ---------------------------------------------------------------------------
+# RetryPolicy terminal/paced split (per-verb units)
+# ---------------------------------------------------------------------------
+
+
+def test_retry_policy_terminal_beats_any_retryable_predicate():
+    sleeps = []
+    policy = RetryPolicy(attempts=5, base_delay=0.01, sleep=sleeps.append)
+    calls = []
+
+    def fn():
+        calls.append(1)
+        raise HttpTransportError(
+            "conflicts, human required", transient=True, shed=True,
+            terminal=True, conflict_report={"ref": "refs/heads/main"},
+        )
+
+    with pytest.raises(HttpTransportError) as exc:
+        policy.call(fn, retryable=lambda e: True)
+    assert len(calls) == 1 and sleeps == []
+    assert exc.value.conflict_report["ref"] == "refs/heads/main"
+
+
+def test_retry_policy_busy_is_paced_for_push_verbs():
+    """The receive-pack retryable predicate (pre-write or shed) retries a
+    busy rejection, honouring its Retry-After floor."""
+    from kart_tpu.transport.retry import is_pre_write
+
+    def retryable(exc):
+        return is_pre_write(exc) or getattr(exc, "shed", False)
+
+    sleeps = []
+    policy = RetryPolicy(attempts=3, base_delay=0.01, sleep=sleeps.append)
+    calls = []
+
+    def fn():
+        calls.append(1)
+        if len(calls) < 3:
+            raise HttpTransportError(
+                "busy: CAS kept moving", transient=True, shed=True,
+                retry_after=1.5,
+            )
+        return "landed"
+
+    assert policy.call(fn, retryable=retryable) == "landed"
+    assert sleeps == [1.5, 1.5]
+
+
+def test_diverged_push_ships_only_new_objects(served_repo, tmp_path, monkeypatch):
+    """With the client-side veto gone, a diverged push against a tip we
+    never fetched must still ship only the NEW objects: the haves closure
+    is seeded from our remote-tracking refs (the server provably holds
+    them), not just from advertised tips our odb may lack."""
+    repo, ds_path, url = served_repo
+    w1 = make_clone(url, tmp_path, "ww1")
+    w2 = make_clone(url, tmp_path, "ww2")
+    edit_commit(w1, ds_path, deletes=[1], message="w1")
+    transport.push(w1, "origin")  # tip is now unknown to w2
+    edit_commit(w2, ds_path, deletes=[2], message="w2")
+    total_objects = sum(1 for _ in w2.odb.iter_oids())
+    sent = {}
+    orig = HttpRemote.receive_pack
+
+    def spy(self, objects, updates, **kw):
+        result = orig(self, objects, updates, **kw)
+        sent["count"] = getattr(objects, "object_count", None)
+        return result
+
+    monkeypatch.setattr(HttpRemote, "receive_pack", spy)
+    transport.push(w2, "origin")  # lands via server rebase
+    assert sent["count"] is not None
+    # one commit + the handful of rewritten trees — never the whole repo
+    assert sent["count"] < total_objects / 2, (
+        f"diverged push re-uploaded {sent['count']}/{total_objects} objects"
+    )
+
+
+def test_retry_after_zero_rides_the_wire():
+    """KART_SERVE_RETRY_AFTER=0 ('retry immediately') is a real value, not
+    an absence: the wire fields and client attrs must carry it."""
+    busy = Rejection("busy", "q", code="cas_busy", retry_after=0, shed=True)
+    wire = rejection_wire_fields(busy)
+    assert wire["retry_after"] == 0 and wire["shed"] is True
+    attrs = error_attrs_from_wire({"error": "q", **wire})
+    assert attrs["retry_after"] == 0 and attrs["shed"] is True
+
+
+def test_rejection_wire_round_trip():
+    """protocol.Rejection -> wire fields -> client error attrs survives the
+    trip for both transports' error shapes."""
+    rej = Rejection(
+        "conflict", "merging would conflict", code="merge_conflict",
+        ref="refs/heads/main", terminal=True,
+        conflict_report={"conflicts_total": 3},
+    )
+    kind, msg = rej  # tuple compatibility
+    assert (kind, msg) == ("conflict", "merging would conflict")
+    wire = rejection_wire_fields(rej)
+    assert wire["terminal"] is True
+    assert wire["code"] == "merge_conflict"
+    attrs = error_attrs_from_wire({"error": msg, **wire})
+    assert attrs == {
+        "terminal": True, "conflict_report": {"conflicts_total": 3},
+    }
+    busy = Rejection(
+        "busy", "queue full", code="queue_full", retry_after=3, shed=True
+    )
+    attrs = error_attrs_from_wire({"error": "queue full",
+                                   **rejection_wire_fields(busy)})
+    assert attrs == {"retry_after": 3, "shed": True}
+    assert error_attrs_from_wire(None) == {}
+    assert error_attrs_from_wire({"error": "plain"}) == {}
+
+
+# ---------------------------------------------------------------------------
+# refname hygiene a server-constructed ref could trip (satellite)
+# ---------------------------------------------------------------------------
+
+
+def test_validate_rejects_nested_prefix_df_collisions(tmp_path):
+    repo, _ = make_imported_repo(tmp_path, n=3)
+    repo.config["receive.denyCurrentBranch"] = "ignore"
+    tip = repo.refs.get("refs/heads/main")
+    repo.refs.set("refs/heads/a", tip)
+    # file blocks directory: refs/heads/a exists, push refs/heads/a/b
+    rej = service.validate_ref_updates(
+        repo,
+        {"updates": [{"ref": "refs/heads/a/b", "old": None, "new": tip}]},
+    )
+    assert rej is not None and rej.code == "df_conflict" and rej.terminal
+    # directory blocks file: refs/heads/x/y exists, push refs/heads/x
+    repo.refs.set("refs/heads/x/y", tip)
+    rej = service.validate_ref_updates(
+        repo,
+        {"updates": [{"ref": "refs/heads/x", "old": None, "new": tip}]},
+    )
+    assert rej is not None and rej.code == "df_conflict"
+    # deleting never D/F-conflicts; a plain update of the existing ref is fine
+    assert service.validate_ref_updates(
+        repo, {"updates": [{"ref": "refs/heads/a", "old": tip, "new": tip}]}
+    ) is None
+
+
+def test_validate_rejects_lock_debris_shaped_names(tmp_path):
+    """A ref named like atomic-write crash debris (x.lock<pid>/x.tmp<pid>)
+    would be invisible to iter_refs and swept by gc — refused at the wire
+    (and by refs.set itself)."""
+    from kart_tpu.core.refs import RefError, check_ref_format
+
+    repo, _ = make_imported_repo(tmp_path, n=3)
+    repo.config["receive.denyCurrentBranch"] = "ignore"
+    tip = repo.refs.get("refs/heads/main")
+    for bad in (
+        "refs/heads/main.lock123",
+        "refs/heads/topic.tmp42",
+        "refs/heads/nested/x.lock7",
+        "refs/heads/feature.tmp",
+    ):
+        rej = service.validate_ref_updates(
+            repo, {"updates": [{"ref": bad, "old": None, "new": tip}]}
+        )
+        assert rej is not None and rej[0] == "bad", bad
+        with pytest.raises(RefError):
+            check_ref_format(bad, require_refs_prefix=True)
+    # near-misses stay legal
+    check_ref_format("refs/heads/v1.0-tmp", require_refs_prefix=True)
+    check_ref_format("refs/heads/lock123", require_refs_prefix=True)
+
+
+def test_checked_out_branch_protected_under_concurrent_rebase(tmp_path):
+    """deny_current outranks the rebase path: a stale push to the served
+    repo's checked-out branch is refused terminally — the server must not
+    'helpfully' rebase onto a branch whose working copy would desync."""
+    import time
+
+    repo, ds_path = make_imported_repo(tmp_path, n=6)
+    # non-bare, denyCurrentBranch left at the refuse default
+    server = make_server(repo)
+    threading.Thread(target=server.serve_forever, daemon=True).start()
+    url = f"http://127.0.0.1:{server.server_address[1]}/"
+    try:
+        telemetry.reset(disable=False)
+        clone = transport.clone(url, tmp_path / "clone", do_checkout=False)
+        clone.config.set_many({"user.name": "C", "user.email": "c@x"})
+        c1 = edit_commit(clone, ds_path, deletes=[1], message="c1")
+        with pytest.raises(HttpTransportError) as exc:
+            # stale CAS base: without the deny guard this would rebase
+            raw_receive(url, clone, c1, old_oid="0" * 40)
+        assert exc.value.terminal
+        assert "checked-out branch" in str(exc.value)
+        assert counter("server.rebase.attempts") == 0
+        time.sleep(0)  # (scheduling fairness; keeps flake detectors honest)
+    finally:
+        server.shutdown()
+        server.server_close()
+
+
+# ---------------------------------------------------------------------------
+# columnar conflict-summary fast path (satellite: merge/index.py)
+# ---------------------------------------------------------------------------
+
+
+def test_summary_counts_fast_path_matches_label_loop(tmp_path):
+    """ColumnarConflicts.summary_counts (the PkLabels O(1) lane) and the
+    generic label loop must summarise identically — the server report and
+    `kart merge` output both ride _conflict_summary."""
+    from kart_tpu.cli.merge_cmds import _conflict_summary
+    from kart_tpu.merge import do_merge
+
+    repo, ds_path = make_imported_repo(tmp_path, n=8)
+    tip = repo.refs.get("refs/heads/main")
+    edit_commit(
+        repo, ds_path,
+        updates=[
+            {"fid": 2, "geom": None, "name": "ours-2", "rating": 1.0},
+            {"fid": 3, "geom": None, "name": "ours-3", "rating": 1.0},
+        ],
+        message="ours",
+    )
+    repo.refs.set("refs/heads/theirs", tip)
+    edit_commit(
+        repo, ds_path,
+        updates=[
+            {"fid": 2, "geom": None, "name": "theirs-2", "rating": 2.0},
+            {"fid": 3, "geom": None, "name": "theirs-3", "rating": 2.0},
+        ],
+        message="theirs",
+        ref="refs/heads/theirs",
+    )
+    result = do_merge(repo, "refs/heads/theirs", dry_run=True)
+    conflicts = result.merge_index.conflicts
+    fast = _conflict_summary(conflicts)
+    # the generic fallback: strip the fast path and recompute
+    slow = {}
+    from kart_tpu.cli.merge_cmds import (
+        _CONFLICT_PLACEHOLDER,
+        _set_value_at_path,
+        _summarise_tree,
+    )
+
+    for label in conflicts:
+        _set_value_at_path(
+            slow, tuple(label.split(":", 2)), _CONFLICT_PLACEHOLDER
+        )
+    slow = _summarise_tree(slow, 2)
+    assert fast == slow == {ds_path: {"feature": 2}}
+    counts = conflicts.summary_counts()
+    assert counts == {(ds_path, "feature"): 2}

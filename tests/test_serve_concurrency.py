@@ -446,9 +446,13 @@ def test_concurrent_pushes_to_different_branches_both_land(
         assert repo.odb.contains(oid)
 
 
-def test_contended_same_ref_push_cas_exactly_one_winner(served_repo, tmp_path):
+def test_contended_same_ref_push_both_land_via_rebase(served_repo, tmp_path):
+    """ISSUE 9: the CAS loser no longer bounces — the server rebases it
+    onto the winner's tip inside the quarantine and lands it. Zero
+    client-visible CAS failures; both edits reachable from the final
+    tip."""
     repo, ds_path, url = served_repo
-    outcomes = []
+    outcomes, oids = [], {}
 
     def push_main(i):
         try:
@@ -458,7 +462,9 @@ def test_contended_same_ref_push_cas_exactly_one_winner(served_repo, tmp_path):
             clone.config.set_many(
                 {"user.name": f"W{i}", "user.email": f"w{i}@example.com"}
             )
-            edit_commit(clone, ds_path, deletes=[i + 3], message=f"race {i}")
+            oids[i] = edit_commit(
+                clone, ds_path, deletes=[i + 3], message=f"race {i}"
+            )
             transport.push(clone, "origin")
             outcomes.append("ok")
         except RemoteError:
@@ -469,27 +475,47 @@ def test_contended_same_ref_push_cas_exactly_one_winner(served_repo, tmp_path):
         t.start()
     for t in threads:
         t.join()
-    assert sorted(outcomes) == ["conflict", "ok"]
+    assert outcomes == ["ok", "ok"]
+    tip = repo.refs.get("refs/heads/main")
+    for oid in oids.values():
+        assert repo.is_ancestor(oid, tip)
+    # both deletes are present in the merged tip
+    fids = {f["fid"] for f in repo.datasets("HEAD")[ds_path].features()}
+    assert 3 not in fids and 4 not in fids
 
 
-def test_rejected_stale_push_leaves_store_byte_identical(served_repo, tmp_path):
-    """CAS reject after a contending push landed: the loser's quarantine is
-    discarded and the served store is byte-identical to the winner-only
-    state."""
+def test_rejected_conflicting_push_leaves_store_byte_identical(
+    served_repo, tmp_path
+):
+    """A contended push whose rebase hits *real* conflicts is rejected with
+    the structured report: the loser's quarantine (including the merge
+    classifier's scratch trees and temp refs) is discarded and the served
+    store is byte-identical to the winner-only state — zero debris for gc
+    to sweep."""
     repo, ds_path, url = served_repo
-    # both clones start from the same tip
+    # both clones start from the same tip and edit the SAME feature
     c1 = transport.clone(url, tmp_path / "c1", do_checkout=False)
     c2 = transport.clone(url, tmp_path / "c2", do_checkout=False)
     for i, c in enumerate((c1, c2)):
         c.config.set_many(
             {"user.name": f"P{i}", "user.email": f"p{i}@example.com"}
         )
-    edit_commit(c1, ds_path, deletes=[5], message="winner")
-    edit_commit(c2, ds_path, deletes=[6], message="loser")
+    edit_commit(
+        c1, ds_path,
+        updates=[{"fid": 5, "geom": None, "name": "winner", "rating": 1.0}],
+        message="winner",
+    )
+    edit_commit(
+        c2, ds_path,
+        updates=[{"fid": 5, "geom": None, "name": "loser", "rating": 2.0}],
+        message="loser",
+    )
     transport.push(c1, "origin")
     before = _snapshot_store(repo)
     tip_before = repo.refs.get("refs/heads/main")
-    with pytest.raises(RemoteError, match="non-fast-forward|moved"):
+    with pytest.raises(RemoteError, match="conflict"):
         transport.push(c2, "origin")
     assert _snapshot_store(repo) == before
     assert repo.refs.get("refs/heads/main") == tip_before
+    quarantine = os.path.join(repo.odb.objects_dir, "quarantine")
+    assert not os.path.isdir(quarantine) or os.listdir(quarantine) == []

@@ -114,21 +114,40 @@ def test_clone_pull_push_roundtrip(tmp_path, ssh_remote_repo):
     assert server_repo.refs.get("refs/heads/feature") is None
 
 
-def test_non_fast_forward_rejected(tmp_path, ssh_remote_repo):
+def test_diverged_push_rebased_or_rejected_over_ssh(tmp_path, ssh_remote_repo):
+    """The contended-write contract over the stdio/ssh transport: disjoint
+    divergence auto-rebases server-side; a real conflict comes back as one
+    terminal structured rejection (same wire semantics as HTTP,
+    docs/SERVING.md §6); --force still overrides."""
     server_repo, ds_path, url = ssh_remote_repo
     from kart_tpu.transport.remote import RemoteError, clone, push
 
     local = clone(url, str(tmp_path / "local"), do_checkout=False)
-    # server moves ahead; local histories diverge
-    edit_commit(
+    # server moves ahead; local histories diverge on DIFFERENT features:
+    # the server merges instead of bouncing the push
+    upstream = edit_commit(
         server_repo, ds_path,
         updates=[{"fid": 4, "geom": None, "name": "srv", "rating": 1.0}],
     )
-    edit_commit(
+    local_oid = edit_commit(
         local, ds_path,
         updates=[{"fid": 5, "geom": None, "name": "loc", "rating": 2.0}],
     )
-    with pytest.raises(RemoteError, match="fetch first|non-fast-forward|moved"):
+    updated = push(local, "origin", ["main:main"])
+    tip = server_repo.refs.get("refs/heads/main")
+    assert updated == {"refs/heads/main": tip}
+    assert server_repo.odb.read_commit(tip).parents == (upstream, local_oid)
+
+    # now diverge on the SAME feature: a genuine conflict, terminal report
+    edit_commit(
+        server_repo, ds_path,
+        updates=[{"fid": 7, "geom": None, "name": "srv7", "rating": 1.0}],
+    )
+    edit_commit(
+        local, ds_path,
+        updates=[{"fid": 7, "geom": None, "name": "loc7", "rating": 2.0}],
+    )
+    with pytest.raises(RemoteError, match="conflict"):
         push(local, "origin", ["main:main"])
     # force push wins
     push(local, "origin", ["main:main"], force=True)
