@@ -39,6 +39,10 @@ ENV_VARS = {
     "KART_SERVE_RETRY_AFTER": "source",
     "KART_SERVE_REBASE_ATTEMPTS": "source",
     "KART_SERVE_MERGE_QUEUE": "source",
+    "KART_SERVE_TILES": "source",
+    # tiles (docs/TILES.md)
+    "KART_TILE_CACHE": "source",
+    "KART_TILE_MAX_FEATURES": "source",
     # faults / maintenance (ROBUSTNESS.md §5-§6)
     "KART_FAULTS": "source",
     "KART_GC_GRACE": "source",
@@ -121,6 +125,8 @@ FAULT_POINTS = frozenset(
         "server.shed",
         "server.rebase",
         "server.ref_cas",
+        "tiles.encode",
+        "tiles.cache",
     }
 )
 

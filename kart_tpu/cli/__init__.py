@@ -56,6 +56,7 @@ _COMMANDS = {
     "build-annotations": "kart_tpu.cli.data_cmds",
     "stats": "kart_tpu.cli.stats_cmds",
     "lint": "kart_tpu.cli.lint_cmds",
+    "export": "kart_tpu.cli.tile_cmds",
 }
 
 

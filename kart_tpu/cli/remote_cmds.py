@@ -244,16 +244,34 @@ def remote_list(ctx, verbose):
     help="Pack-enumeration cache byte budget; 0 disables. Overrides "
     "KART_SERVE_ENUM_CACHE (docs/SERVING.md).",
 )
+@click.option(
+    "--tiles/--no-tiles",
+    "tiles_enabled",
+    default=None,
+    help="Enable/disable the vector-tile endpoint "
+    "GET /api/v1/tiles/<ref>/<dataset>/<z>/<x>/<y> (docs/TILES.md). "
+    "Overrides KART_SERVE_TILES; enabled by default.",
+)
+@click.option(
+    "--tile-cache-bytes",
+    type=click.INT,
+    default=None,
+    help="Tile cache byte budget; 0 disables. Overrides KART_TILE_CACHE "
+    "(docs/TILES.md).",
+)
 @click.pass_obj
-def serve(ctx, host, port, max_inflight, enum_cache_bytes):
-    """Serve this repository over HTTP for clone/fetch/push/pull.
+def serve(ctx, host, port, max_inflight, enum_cache_bytes, tiles_enabled,
+          tile_cache_bytes):
+    """Serve this repository over HTTP for clone/fetch/push/pull — and
+    vector tiles of any commit, straight off the columnar store.
 
     A LAN/localhost collaboration server (no authentication — like git
     daemon); clients use http://HOST:PORT/ as the remote URL. Supports
     shallow and spatially-filtered partial clones (the filter runs
     server-side), promised-blob backfill, a shared pack-enumeration cache
-    with byte-range resume, and load shedding under client storms
-    (docs/SERVING.md).
+    with byte-range resume, load shedding under client storms
+    (docs/SERVING.md), and block-pruned commit-addressed tile serving
+    (docs/TILES.md).
     """
     import os
 
@@ -265,6 +283,10 @@ def serve(ctx, host, port, max_inflight, enum_cache_bytes):
         os.environ["KART_SERVE_MAX_INFLIGHT"] = str(max_inflight)
     if enum_cache_bytes is not None:
         os.environ["KART_SERVE_ENUM_CACHE"] = str(enum_cache_bytes)
+    if tiles_enabled is not None:
+        os.environ["KART_SERVE_TILES"] = "1" if tiles_enabled else "0"
+    if tile_cache_bytes is not None:
+        os.environ["KART_TILE_CACHE"] = str(tile_cache_bytes)
     repo = ctx.repo
     click.echo(f"Serving {repo.gitdir} at http://{host}:{port}/ (Ctrl-C to stop)")
     try:

@@ -1,0 +1,101 @@
+"""`kart export` — batch tile export off the columnar store
+(docs/TILES.md §5).
+
+``kart export tiles <refish>`` walks a zoom pyramid over one dataset at
+any commit and writes every non-empty tile payload to disk — the offline
+twin of the ``GET /api/v1/tiles/...`` endpoint (same wire format, same
+pruning, byte-identical payloads for the same commit)."""
+
+import click
+
+from kart_tpu.cli import CliError, cli
+
+
+@cli.group()
+def export():
+    """Export repository data into derived read-serving artifacts."""
+
+
+@export.command("tiles")
+@click.argument("refish", default="HEAD")
+@click.option(
+    "--dataset",
+    "ds_path",
+    default=None,
+    help="Dataset to export (default: the repo's only dataset).",
+)
+@click.option(
+    "--zoom",
+    "zoom_spec",
+    default="0-4",
+    show_default=True,
+    help="Zoom level or range (Z or Z0-Z1).",
+)
+@click.option(
+    "--output",
+    "-o",
+    "out_dir",
+    type=click.Path(file_okay=False),
+    default=None,
+    help="Output directory (default: ./tiles-<short-oid>). Tiles land as "
+    "<output>/<z>/<x>/<y>.ktile.",
+)
+@click.option(
+    "--layers",
+    default=None,
+    help="Comma-separated layers to include: bin,geojson (default both). "
+    "The geojson layer needs feature blobs locally; a partial clone "
+    "exports --layers bin.",
+)
+@click.option(
+    "--max-features",
+    type=click.INT,
+    default=None,
+    help="Per-tile feature ceiling; over-full tiles are skipped (counted). "
+    "Overrides KART_TILE_MAX_FEATURES; 0 = unlimited.",
+)
+@click.pass_obj
+def export_tiles(ctx, refish, ds_path, zoom_spec, out_dir, layers,
+                 max_features):
+    """Export a zoom pyramid of vector tiles for REFISH (any commit).
+
+    No working copy and no GDAL involved: tiles are built straight from
+    the commit's KCOL sidecar columns, block-pruned by the per-block
+    union-bbox aggregates, and are byte-identical to what `kart serve`
+    answers for the same commit (docs/TILES.md).
+    """
+    import os
+
+    from kart_tpu import tiles
+    from kart_tpu.tiles.grid import TileAddressError, parse_zoom_spec
+    from kart_tpu.tiles.pyramid import export_pyramid
+
+    repo = ctx.repo
+    try:
+        zooms = parse_zoom_spec(zoom_spec)
+        commit_oid = tiles.resolve_tile_commit(repo, refish)
+        if ds_path is None:
+            paths = repo.structure(refish).datasets.paths()
+            if len(paths) != 1:
+                raise CliError(
+                    f"Repo has {len(paths)} datasets; pick one with --dataset "
+                    f"({', '.join(paths) or 'none'})"
+                )
+            ds_path = paths[0]
+        source = tiles.source_for(repo, commit_oid, ds_path)
+        out_dir = out_dir or os.path.join(".", f"tiles-{commit_oid[:12]}")
+        stats = export_pyramid(
+            source, zooms, out_dir,
+            layers=tiles.normalise_layers(layers),
+            max_features=max_features,
+        )
+    except (tiles.TileAddressError, tiles.TileEncodeError,
+            tiles.TileSourceError, TileAddressError) as e:
+        raise CliError(str(e))
+    click.echo(
+        f"Exported {stats['tiles_written']} tiles "
+        f"({stats['features_out']} features, {stats['bytes_out']} bytes) "
+        f"of {ds_path}@{commit_oid[:12]} to {out_dir} "
+        f"[z{zooms[0]}-z{zooms[-1]}; {stats['tiles_empty']} empty, "
+        f"{stats['tiles_too_large']} over the feature ceiling]"
+    )

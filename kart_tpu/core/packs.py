@@ -418,27 +418,36 @@ class PackCollection:
 
     @property
     def packs(self):
-        if self._packs is None:
+        # atomic publish: the scan builds LOCAL state and assigns it in one
+        # step at the end. Assigning self._packs = [] up front and appending
+        # let a concurrent reader (the threading server's other handlers —
+        # e.g. 16 cold tile requests hitting a freshly-started server) see a
+        # partially-populated list and report reachable objects as missing.
+        # Two racing scanners just duplicate the work; last assignment wins
+        # with a complete, equivalent list.
+        packs = self._packs
+        if packs is None:
             import time
 
-            self._packs = []
-            self._scan_mtimes = {}
-            self._scan_walltime_ns = time.time_ns()
+            packs = []
+            mtimes = {}
+            walltime_ns = time.time_ns()
             for d in self.pack_dirs:
                 try:
-                    self._scan_mtimes[d] = os.stat(d).st_mtime_ns
+                    mtimes[d] = os.stat(d).st_mtime_ns
                 except OSError:
-                    self._scan_mtimes[d] = None
+                    mtimes[d] = None
                 if not os.path.isdir(d):
                     continue
                 for name in sorted(os.listdir(d)):
                     if name.endswith(".pack"):
                         idx = os.path.join(d, name[:-5] + ".idx")
                         if os.path.exists(idx):
-                            self._packs.append(
-                                Packfile(os.path.join(d, name), idx)
-                            )
-        return self._packs
+                            packs.append(Packfile(os.path.join(d, name), idx))
+            self._scan_mtimes = mtimes
+            self._scan_walltime_ns = walltime_ns
+            self._packs = packs
+        return packs
 
     # directory mtimes within this many ns of the scan are treated as
     # potentially stale (the racy-stat hole: a pack renamed in during the

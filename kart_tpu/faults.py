@@ -41,6 +41,14 @@ Registered points:
     server.ref_cas          the locked landing frames of a receive-pack:
                             1 = the CAS (re-)validation, 2 = just before
                             quarantine migrate
+    tiles.encode            the tile payload build (kart_tpu/tiles/encode):
+                            1 = after the block-pruned row selection,
+                            2 = layers built, before payload assembly —
+                            a crash at either frame publishes nothing
+    tiles.cache             the tile cache's entry-publish frame: a fault
+                            here must poison nothing (the fresh payload is
+                            never inserted; a poisoned tile is never
+                            served)
 
 Disabled (``KART_FAULTS`` unset) the fast path is a single environ dict
 lookup with no allocation: frame-boundary loops additionally hoist

@@ -53,6 +53,7 @@ SUBSYSTEMS = frozenset(
         "serialise", # output materialisation/serialisation
         "transport", # wire transports, retry/resume, servers
         "server",    # concurrent-serving machinery (enum cache, shedding)
+        "tiles",     # tile read-serving (pruning, cache, encode, export)
         "importer",  # bulk import phases
         "runtime",   # backend probe, watchdogs
         "wc",        # working copies

@@ -1,0 +1,139 @@
+"""Tile read-serving straight off the columnar store (ISSUE 10; docs/TILES.md).
+
+``kart serve`` can answer ``GET /api/v1/tiles/<ref>/<dataset>/<z>/<x>/<y>``
+for **any commit** without a working copy and without GDAL: the ref is
+pinned to a commit oid at request time, the tile's bbox classifies the
+KCOL sidecar's per-block union-bbox aggregates (PR 1) so only boundary/in
+blocks are faulted, the surviving rows clip/quantize as one vectorized
+numpy pass over the envelope columns, and payloads are memoized in a
+commit-addressed byte-budgeted LRU with single-flight fill (PR 7's cache
+discipline). This module is the orchestrator; the machinery lives in:
+
+* :mod:`kart_tpu.tiles.grid`    — WebMercator XYZ tile↔bbox math
+* :mod:`kart_tpu.tiles.source`  — commit-pinned block reader + pruning
+* :mod:`kart_tpu.tiles.clip`    — vectorized clip/quantize
+* :mod:`kart_tpu.tiles.encode`  — payload writer (geojson-lines + binary)
+* :mod:`kart_tpu.tiles.cache`   — per-(commit, dataset, z/x/y) LRU
+* :mod:`kart_tpu.tiles.pyramid` — batch export walker (`kart export tiles`)
+"""
+
+from kart_tpu import telemetry as tm
+from kart_tpu.tiles.cache import etag_for, tile_cache_for, tile_key
+from kart_tpu.tiles.encode import (
+    DEFAULT_MAX_FEATURES,
+    TileEncodeError,
+    TileTooLarge,
+    decode_bin_layer,
+    encode_tile,
+    normalise_layers,
+    parse_payload,
+)
+from kart_tpu.tiles.grid import (
+    DEFAULT_BUFFER,
+    DEFAULT_EXTENT,
+    TileAddressError,
+    tile_bounds_wsen,
+    validate_tile,
+)
+from kart_tpu.tiles.source import (
+    TileDataUnavailable,
+    TileSource,
+    TileSourceError,
+    source_for,
+)
+
+__all__ = [
+    "DEFAULT_BUFFER",
+    "DEFAULT_EXTENT",
+    "DEFAULT_MAX_FEATURES",
+    "TileAddressError",
+    "TileDataUnavailable",
+    "TileEncodeError",
+    "TileSource",
+    "TileSourceError",
+    "TileTooLarge",
+    "decode_bin_layer",
+    "encode_tile",
+    "normalise_layers",
+    "parse_payload",
+    "resolve_tile_commit",
+    "serve_tile",
+    "source_for",
+    "tile_etag",
+    "tile_bounds_wsen",
+    "validate_tile",
+]
+
+
+def resolve_tile_commit(repo, ref):
+    """Pin a requested ref/refish to a commit oid — the cache-key
+    immutability step: everything after this point is keyed by the oid, so
+    a ref update can only change what *new* requests resolve to."""
+    from kart_tpu.core.repo import NotFound
+
+    try:
+        oid, _ref = repo.resolve_refish(ref)
+    except NotFound as e:
+        raise TileSourceError(str(e))
+    if oid is None:
+        raise TileSourceError(f"Ref {ref!r} resolves to the empty revision")
+    return oid
+
+
+def tile_etag(repo, ref, ds_path, z, x, y, *, layers=None,
+              extent=DEFAULT_EXTENT, buffer=DEFAULT_BUFFER):
+    """The strong validator for a tile request, computed WITHOUT building
+    anything — address validation + ref→commit pinning only. Commit-
+    addressed keys never go stale, so a client presenting this validator
+    (If-None-Match) can be answered 304 before any source is constructed
+    or payload encoded."""
+    z, x, y = validate_tile(z, x, y)
+    layers = normalise_layers(layers)
+    commit_oid = resolve_tile_commit(repo, ref)
+    return etag_for(
+        tile_key(commit_oid, ds_path, z, x, y, layers, extent, buffer)
+    ), commit_oid
+
+
+def serve_tile(repo, ref, ds_path, z, x, y, *, layers=None,
+               extent=DEFAULT_EXTENT, buffer=DEFAULT_BUFFER,
+               max_features=None, commit_oid=None):
+    """The full tile-serving verb: resolve, cache-front, encode-on-miss.
+
+    -> (payload bytes, etag str, cached bool). A cache hit returns the
+    memoized bytes without constructing a source — no sidecar load, no
+    envelope page fault, no ODB blob read. Byte-identical across
+    hit/miss/process by construction (the payload is deterministic in the
+    key; tests/test_tiles.py pins it). ``commit_oid`` pins the revision
+    when the caller already resolved the ref (:func:`tile_etag`)."""
+    z, x, y = validate_tile(z, x, y)
+    layers = normalise_layers(layers)
+    if commit_oid is None:
+        commit_oid = resolve_tile_commit(repo, ref)
+    key = tile_key(commit_oid, ds_path, z, x, y, layers, extent, buffer)
+    etag = etag_for(key)
+
+    cache = tile_cache_for(repo)
+    token = None
+    if cache is not None:
+        mode, got = cache.lookup_or_begin(key)
+        if mode == "hit":
+            tm.incr("tiles.served")
+            tm.incr("tiles.bytes_out", len(got))
+            return got, etag, True
+        token = got  # fill token, or None (wedged-filler bypass)
+    try:
+        source = source_for(repo, commit_oid, ds_path)
+        payload, _stats = encode_tile(
+            source, z, x, y, layers=layers, extent=extent, buffer=buffer,
+            max_features=max_features,
+        )
+    except BaseException:
+        if token is not None:
+            token.abandon()
+        raise
+    if token is not None:
+        token.publish(payload)
+    tm.incr("tiles.served")
+    tm.incr("tiles.bytes_out", len(payload))
+    return payload, etag, False

@@ -1,0 +1,77 @@
+"""Vectorized clip + quantize of envelope rows into tile-local integer
+coordinates.
+
+This is the per-feature half of a tile request, and it must stay columnar:
+the input is the (already block-pruned) row selection over the sidecar's
+f32 envelope columns, and everything below is whole-array numpy — no
+per-feature Python objects, no geometry decoding. Two stages:
+
+1. **Exact refine** — the coarse scan ran against a *padded* query
+   rectangle (f32 columns vs f64 tile bounds must never wrongly prune), so
+   the boundary rows it admitted are re-tested against the exact tile
+   bounds here. Envelope precision is the contract: a feature whose
+   envelope clips the tile is in the tile (the same deliberate fail-open
+   bound as the filtered feature-count fast path,
+   kart_tpu/diff/engine.py:get_dataset_feature_count_fast).
+2. **Quantize** — surviving envelopes are projected to WebMercator and
+   scaled into tile-local integer coordinates (``extent`` units per tile
+   side, the MVT convention), clipped to ``[-buffer, extent + buffer]``.
+   y grows southwards, matching the tile grid.
+
+Anti-meridian-wrapping envelopes (e < w) can't express a contiguous x
+range in one tile's coordinate space; they quantize to the full buffered
+tile width (a correct superset — the renderer clips).
+"""
+
+import numpy as np
+
+from kart_tpu.ops.bbox import bbox_intersects_np
+from kart_tpu.tiles.grid import (
+    DEFAULT_BUFFER,
+    DEFAULT_EXTENT,
+    merc_xy_cols,
+    tile_cover_wsen,
+    validate_tile,
+)
+
+
+def clip_quantize(envelopes, rows, z, x, y, *, extent=DEFAULT_EXTENT,
+                  buffer=DEFAULT_BUFFER):
+    """-> (kept_rows int64 (M,), boxes int32 (M, 4)).
+
+    ``envelopes``: the source's (count, 4) f32 wsen columns;
+    ``rows``: candidate row indices from the block-pruned scan.
+    ``boxes`` are (x0, y0, x1, y1) tile-local integer envelope boxes of
+    the kept rows (y0 = north edge), clipped to the buffered tile square.
+    """
+    z, x, y = validate_tile(z, x, y)
+    rows = np.asarray(rows, dtype=np.int64)
+    if not len(rows):
+        return rows, np.zeros((0, 4), dtype=np.int32)
+    env = np.asarray(envelopes[rows], dtype=np.float64)
+
+    # exact refine against the unpadded membership rectangle (edge rows
+    # extend to the poles so clamped-latitude features are never dropped)
+    bounds = np.asarray(tile_cover_wsen(z, x, y), dtype=np.float64)
+    keep = bbox_intersects_np(env, bounds)
+    rows = rows[keep]
+    if not len(rows):
+        return rows, np.zeros((0, 4), dtype=np.int32)
+    env = env[keep]
+
+    w, s, e, n = env[:, 0], env[:, 1], env[:, 2], env[:, 3]
+    scale = float(1 << z) * extent
+    mx0, my0 = merc_xy_cols(w, n)  # north edge -> smaller mercator y
+    mx1, my1 = merc_xy_cols(e, s)
+    boxes = np.empty((len(rows), 4), dtype=np.float64)
+    boxes[:, 0] = mx0 * scale - x * extent
+    boxes[:, 1] = my0 * scale - y * extent
+    boxes[:, 2] = mx1 * scale - x * extent
+    boxes[:, 3] = my1 * scale - y * extent
+    out = np.rint(np.clip(boxes, -buffer, extent + buffer)).astype(np.int32)
+
+    wraps = e < w
+    if wraps.any():
+        out[wraps, 0] = -buffer
+        out[wraps, 2] = extent + buffer
+    return rows, out

@@ -1,0 +1,208 @@
+"""Tile payload encoding (wire format: docs/TILES.md §4).
+
+A tile payload is one self-describing byte string — deterministic for a
+given (commit, dataset, z/x/y, layers, extent, buffer) key, which is what
+makes the commit-addressed cache and the byte-identity acceptance tests
+possible:
+
+    [8-byte big-endian header length][JSON header][layer bytes...]
+
+The JSON header is canonical (sorted keys, compact separators) and carries
+the tile address, the pinned commit, the exact bbox, and each layer's byte
+length; layers follow in *name-sorted* order. Two layers:
+
+* ``bin`` — the columnar layer, built entirely from sidecar columns (no
+  blob reads): ``KTB1`` magic, uint32-LE row count, int64-LE identity keys
+  (the pk for int-pk datasets), int32-LE (M, 4) quantized tile-local
+  envelope boxes from :mod:`kart_tpu.tiles.clip`.
+* ``geojson`` — newline-delimited JSON feature objects, serialised through
+  the dataset's per-legend *compiled* serialisers
+  (``Dataset3.feature_json_str_from_data`` — the PR 1 fused-diff writers'
+  hot path, reused verbatim so a tile feature is byte-identical to the
+  same feature in a ``diff -o json-lines`` document). Requires the feature
+  blobs to be locally present.
+
+Rows are emitted in ascending identity-key order (the sidecar's native
+order), so payload bytes never depend on scan order.
+"""
+
+import json
+import struct
+
+import numpy as np
+
+from kart_tpu import faults
+from kart_tpu import telemetry as tm
+from kart_tpu.tiles.clip import clip_quantize
+from kart_tpu.tiles.grid import (
+    DEFAULT_BUFFER,
+    DEFAULT_EXTENT,
+    tile_bounds_wsen,
+    tile_query_wsen,
+    validate_tile,
+)
+
+_HEADER_LEN = struct.Struct(">Q")
+
+#: the binary layer's magic
+BIN_MAGIC = b"KTB1"
+
+#: payload format version (header "v")
+PAYLOAD_VERSION = 1
+
+#: layer names this encoder knows how to build
+KNOWN_LAYERS = ("bin", "geojson")
+
+#: default ceiling on features per tile (``KART_TILE_MAX_FEATURES``
+#: overrides; 0 = unlimited). A tile over the ceiling is a client error —
+#: zoom in — not a server OOM.
+DEFAULT_MAX_FEATURES = 65_536
+
+
+class TileEncodeError(ValueError):
+    pass
+
+
+class TileTooLarge(TileEncodeError):
+    """More features in the tile than the configured ceiling."""
+
+    def __init__(self, count, limit, tile):
+        z, x, y = tile
+        super().__init__(
+            f"Tile {z}/{x}/{y} holds {count} features "
+            f"(limit {limit}); request a deeper zoom"
+        )
+        self.count = count
+        self.limit = limit
+
+
+def normalise_layers(layers):
+    """Request layer spec (iterable or comma string) -> sorted tuple of
+    known layer names; raises on unknown names."""
+    if layers is None:
+        return KNOWN_LAYERS
+    if isinstance(layers, str):
+        layers = [p.strip() for p in layers.split(",") if p.strip()]
+    out = sorted(set(layers))
+    for name in out:
+        if name not in KNOWN_LAYERS:
+            raise TileEncodeError(
+                f"Unknown tile layer {name!r} (known: {', '.join(KNOWN_LAYERS)})"
+            )
+    if not out:
+        raise TileEncodeError("At least one tile layer must be requested")
+    return tuple(out)
+
+
+def max_features_limit():
+    from kart_tpu.transport.retry import _env_int
+
+    return _env_int("KART_TILE_MAX_FEATURES", DEFAULT_MAX_FEATURES)
+
+
+def encode_tile(source, z, x, y, *, layers=None, extent=DEFAULT_EXTENT,
+                buffer=DEFAULT_BUFFER, max_features=None):
+    """Build one tile's complete payload bytes from a
+    :class:`~kart_tpu.tiles.source.TileSource`.
+
+    -> (payload bytes, stats dict) where stats carries the pruning counters
+    from the row selection plus ``count`` (features in the tile).
+
+    Injectable crash frames (``KART_FAULTS=tiles.encode:<n>``): 1 = after
+    the block-pruned row selection, 2 = after the layers are built, before
+    payload assembly. A kill at either frame propagates out with nothing
+    published anywhere (the cache publish never runs —
+    tests/test_faults.py)."""
+    z, x, y = validate_tile(z, x, y)
+    layers = normalise_layers(layers)
+    if max_features is None:
+        max_features = max_features_limit()
+
+    with tm.span("tiles.encode", tile=f"{z}/{x}/{y}"):
+        rows, stats = source.rows_for_bbox(tile_query_wsen(z, x, y))
+        faults.fire("tiles.encode")  # frame 1: selection done
+        rows, boxes = clip_quantize(
+            source.envelopes(), rows, z, x, y, extent=extent, buffer=buffer
+        )
+        count = len(rows)
+        if max_features and count > max_features:
+            raise TileTooLarge(count, max_features, (z, x, y))
+
+        built = {}
+        if "bin" in layers:
+            keys = np.ascontiguousarray(
+                source.block.keys[rows], dtype="<i8"
+            )
+            built["bin"] = b"".join(
+                (
+                    BIN_MAGIC,
+                    struct.pack("<I", count),
+                    keys.tobytes(),
+                    np.ascontiguousarray(boxes, dtype="<i4").tobytes(),
+                )
+            )
+        if "geojson" in layers:
+            ds = source.dataset
+            pks = source.pks_for_rows(rows)
+            blobs = source.feature_blobs(rows)
+            lines = [
+                ds.feature_json_str_from_data(pk, data)
+                for pk, data in zip(pks, blobs)
+            ]
+            built["geojson"] = (
+                ("\n".join(lines) + "\n").encode() if lines else b""
+            )
+        faults.fire("tiles.encode")  # frame 2: layers built, not assembled
+
+        header = {
+            "v": PAYLOAD_VERSION,
+            "commit": source.commit_oid,
+            "dataset": source.ds_path,
+            "tile": [z, x, y],
+            "bbox": list(tile_bounds_wsen(z, x, y)),
+            "extent": extent,
+            "buffer": buffer,
+            "count": count,
+            "layers": {name: len(built[name]) for name in layers},
+        }
+        raw_header = json.dumps(
+            header, sort_keys=True, separators=(",", ":")
+        ).encode()
+        payload = b"".join(
+            [_HEADER_LEN.pack(len(raw_header)), raw_header]
+            + [built[name] for name in layers]
+        )
+    tm.incr("tiles.features_out", count)
+    stats = dict(stats, count=count)
+    return payload, stats
+
+
+def parse_payload(data):
+    """Payload bytes -> (header dict, {layer name: layer bytes}) — the
+    client/test-side decoder."""
+    (n,) = _HEADER_LEN.unpack_from(data, 0)
+    pos = _HEADER_LEN.size
+    header = json.loads(data[pos : pos + n].decode())
+    pos += n
+    layer_bytes = {}
+    for name in sorted(header["layers"]):
+        size = header["layers"][name]
+        layer_bytes[name] = data[pos : pos + size]
+        pos += size
+    if pos != len(data):
+        raise TileEncodeError(
+            f"Tile payload length mismatch ({pos} headered vs {len(data)} actual)"
+        )
+    return header, layer_bytes
+
+
+def decode_bin_layer(data):
+    """``bin`` layer bytes -> (int64 keys (M,), int32 boxes (M, 4))."""
+    if data[:4] != BIN_MAGIC:
+        raise TileEncodeError("Bad binary tile layer magic")
+    (count,) = struct.unpack_from("<I", data, 4)
+    pos = 8
+    keys = np.frombuffer(data, dtype="<i8", count=count, offset=pos)
+    pos += 8 * count
+    boxes = np.frombuffer(data, dtype="<i4", count=4 * count, offset=pos)
+    return keys, boxes.reshape(count, 4)

@@ -1,0 +1,170 @@
+"""WebMercator XYZ tile grid math (EPSG:3857 slippy-map tiles over
+EPSG:4326 data; reference scheme: the MapLibre/OSM ``z/x/y`` addressing,
+arxiv 2508.10791 §2).
+
+Everything here is pure geometry — no repo access — and vectorized where a
+column is involved, because the clip/quantize stage (kart_tpu/tiles/clip.py)
+runs it over every surviving envelope row of a tile request.
+
+Conventions:
+
+* ``z`` ∈ [0, MAX_ZOOM]; ``x``, ``y`` ∈ [0, 2**z).
+* y grows **southwards** (slippy-map convention): tile (z, 0, 0) is the
+  north-west corner of the world.
+* Tile bounds are expressed as ``(w, s, e, n)`` EPSG:4326 degrees — the
+  exact shape the sidecar envelope columns and the block-aggregate
+  classifier (:mod:`kart_tpu.ops.bbox`) consume.
+* Latitudes are clamped to ±:data:`MERC_MAX_LAT` (the square WebMercator
+  world); data beyond the clamp lands in the edge tiles (fail open — a
+  polar feature is served by the top/bottom row rather than dropped).
+"""
+
+import math
+
+import numpy as np
+
+#: the WebMercator latitude clamp: atan(sinh(pi)) in degrees
+MERC_MAX_LAT = 85.05112877980659
+
+#: sanity bound on the tile address space (2**30 tiles per axis is already
+#: far below centimetre resolution; deeper is a malformed request)
+MAX_ZOOM = 30
+
+#: default integer coordinate extent of one tile (the MVT convention)
+DEFAULT_EXTENT = 4096
+
+#: default clip buffer around a tile, in extent units (MVT convention:
+#: geometry is kept up to this far outside the tile so renderers can draw
+#: strokes across tile seams)
+DEFAULT_BUFFER = 64
+
+
+class TileAddressError(ValueError):
+    """Malformed z/x/y address."""
+
+
+def validate_tile(z, x, y):
+    """-> (z, x, y) as ints, or raise :class:`TileAddressError`."""
+    try:
+        z, x, y = int(z), int(x), int(y)
+    except (TypeError, ValueError):
+        raise TileAddressError(f"Tile address must be integers: {z}/{x}/{y}")
+    if not (0 <= z <= MAX_ZOOM):
+        raise TileAddressError(f"Zoom {z} out of range 0..{MAX_ZOOM}")
+    n = 1 << z
+    if not (0 <= x < n and 0 <= y < n):
+        raise TileAddressError(
+            f"Tile {z}/{x}/{y} out of range (0..{n - 1} at zoom {z})"
+        )
+    return z, x, y
+
+
+def _lat_to_merc_y(lat_deg):
+    """Latitude degrees -> normalized mercator y in [0, 1] (0 = north)."""
+    lat = max(-MERC_MAX_LAT, min(MERC_MAX_LAT, lat_deg))
+    s = math.sin(math.radians(lat))
+    return 0.5 - math.log((1.0 + s) / (1.0 - s)) / (4.0 * math.pi)
+
+
+def _merc_y_to_lat(y):
+    """Normalized mercator y in [0, 1] -> latitude degrees."""
+    return math.degrees(math.atan(math.sinh(math.pi * (1.0 - 2.0 * y))))
+
+
+def tile_bounds_wsen(z, x, y):
+    """-> (w, s, e, n) EPSG:4326 degree bounds of tile ``z/x/y`` (the
+    north and south edges are the mercator row edges; w/e are exact)."""
+    z, x, y = validate_tile(z, x, y)
+    n_tiles = 1 << z
+    w = x / n_tiles * 360.0 - 180.0
+    e = (x + 1) / n_tiles * 360.0 - 180.0
+    n = _merc_y_to_lat(y / n_tiles)
+    s = _merc_y_to_lat((y + 1) / n_tiles)
+    return (w, s, e, n)
+
+
+def tile_cover_wsen(z, x, y):
+    """The tile's *membership* rectangle: :func:`tile_bounds_wsen`, with
+    the top/bottom edge rows extended to the poles. This is what decides
+    whether a feature belongs in a tile — the documented clamp policy
+    (polar features are *served by* the edge rows, not dropped) has to
+    hold in the selection math, not just in the quantizer: testing a
+    lat-88 envelope against the row-0 bounds (n = 85.05…) would silently
+    exclude it from every tile at every zoom."""
+    z, x, y = validate_tile(z, x, y)
+    w, s, e, n = tile_bounds_wsen(z, x, y)
+    if y == 0:
+        n = 90.0
+    if y == (1 << z) - 1:
+        s = -90.0
+    return (w, s, e, n)
+
+
+#: query-rect pad for the tile→block prefilter: sidecar envelopes are f32
+#: and the tile bounds f64, so a borderline feature must be *admitted* by
+#: the coarse scan (the exact refine in clip.py decides it) rather than
+#: wrongly pruned — the same conservativeness policy constant as the
+#: spatially-filtered diff's prefilter (kart_tpu/diff/engine.py)
+QUERY_PAD = 1e-4
+
+
+def tile_query_wsen(z, x, y, pad=QUERY_PAD):
+    """The padded (w, s, e, n) rectangle a tile's block-pruned envelope
+    scan uses: strictly a superset of :func:`tile_cover_wsen` (edge rows
+    reach the poles), clamped to legal latitudes. Longitudes may poke past
+    ±180 — the cyclic overlap math in :mod:`kart_tpu.ops.bbox` treats the
+    range by width, so a sub-degree overhang never wraps into a false
+    full-world match."""
+    w, s, e, n = tile_cover_wsen(z, x, y)
+    return (
+        w - pad,
+        max(s - pad, -90.0),
+        e + pad,
+        min(n + pad, 90.0),
+    )
+
+
+def merc_xy_cols(lon, lat):
+    """Vectorized EPSG:4326 columns -> normalized mercator (x, y) in
+    [0, 1] (y = 0 at the north clamp). float64 in, float64 out."""
+    lon = np.asarray(lon, dtype=np.float64)
+    lat = np.clip(np.asarray(lat, dtype=np.float64), -MERC_MAX_LAT, MERC_MAX_LAT)
+    x = (lon + 180.0) / 360.0
+    s = np.sin(np.radians(lat))
+    y = 0.5 - np.log((1.0 + s) / (1.0 - s)) / (4.0 * np.pi)
+    return x, y
+
+
+def tile_range_for_bbox(z, wsen):
+    """-> (x0, y0, x1, y1) inclusive tile-index ranges covering an EPSG:4326
+    ``(w, s, e, n)`` bbox at zoom ``z`` (the pyramid walker's enumeration).
+    A wrapping bbox (e < w) or any non-finite bound covers the full row."""
+    z = validate_tile(z, 0, 0)[0]
+    n_tiles = 1 << z
+    w, s, e, n = (float(v) for v in wsen)
+    if not all(map(math.isfinite, (w, s, e, n))) or e < w:
+        x0, x1 = 0, n_tiles - 1
+    else:
+        x0 = int(min(max((w + 180.0) / 360.0, 0.0), 1.0 - 1e-12) * n_tiles)
+        x1 = int(min(max((e + 180.0) / 360.0, 0.0), 1.0 - 1e-12) * n_tiles)
+    y_top = _lat_to_merc_y(n)
+    y_bot = _lat_to_merc_y(s)
+    y0 = int(min(max(y_top, 0.0), 1.0 - 1e-12) * n_tiles)
+    y1 = int(min(max(y_bot, 0.0), 1.0 - 1e-12) * n_tiles)
+    return x0, y0, x1, y1
+
+
+def parse_zoom_spec(spec):
+    """``"4"`` or ``"0-5"`` -> sorted list of zoom levels."""
+    text = str(spec).strip()
+    lo, sep, hi = text.partition("-")
+    try:
+        z0 = int(lo)
+        z1 = int(hi) if sep else z0
+    except ValueError:
+        raise TileAddressError(f"Bad zoom spec {spec!r} (use Z or Z0-Z1)")
+    if z1 < z0:
+        z0, z1 = z1, z0
+    validate_tile(z0, 0, 0)
+    validate_tile(z1, 0, 0)
+    return list(range(z0, z1 + 1))

@@ -1,0 +1,105 @@
+"""Batch tile export: walk a zoom pyramid over a dataset's extent and
+write every non-empty tile payload to disk (`kart export tiles`).
+
+The walker enumerates only tiles whose address range covers the dataset's
+overall envelope (derived from the sidecar columns — no feature reads) and
+prunes per-tile exactly like the serving path, so exporting a sparse
+dataset at a deep zoom visits the data's tiles, not 4**z of them. Tiles
+land as ``<out>/<z>/<x>/<y>.ktile`` (the complete framed payload,
+byte-identical to what ``GET /api/v1/tiles/...`` serves for the same
+commit — one wire format, docs/TILES.md §4).
+"""
+
+import os
+
+import numpy as np
+
+from kart_tpu import telemetry as tm
+from kart_tpu.tiles.encode import TileTooLarge, encode_tile
+from kart_tpu.tiles.grid import (
+    DEFAULT_BUFFER,
+    DEFAULT_EXTENT,
+    tile_range_for_bbox,
+)
+
+
+def dataset_bbox_wsen(source):
+    """The dataset's overall (w, s, e, n) envelope from the columnar
+    envelope data — block aggregates when present (nb rows instead of N),
+    else the envelope columns. Wrapping/non-finite members widen to the
+    full world (they belong to every column of tiles)."""
+    blocks = source.env_blocks()
+    if blocks is not None:
+        env = np.asarray(blocks[0], dtype=np.float64)
+    else:
+        env = np.asarray(source.envelopes(), dtype=np.float64)
+    if not len(env):
+        return (-180.0, -90.0, 180.0, 90.0)
+    bad = ~np.isfinite(env).all(axis=1) | (env[:, 2] < env[:, 0])
+    if bad.any():
+        w, e = -180.0, 180.0
+    else:
+        w, e = float(env[:, 0].min()), float(env[:, 2].max())
+    lat = env[np.isfinite(env[:, 1]) & np.isfinite(env[:, 3])]
+    if len(lat):
+        s, n = float(lat[:, 1].min()), float(lat[:, 3].max())
+    else:
+        s, n = -90.0, 90.0
+    return (
+        max(w, -180.0), max(s, -90.0), min(e, 180.0), min(n, 90.0),
+    )
+
+
+def export_pyramid(source, zooms, out_dir, *, layers=None,
+                   extent=DEFAULT_EXTENT, buffer=DEFAULT_BUFFER,
+                   max_features=None, progress=None):
+    """Export every non-empty tile of ``source`` at the given zoom levels.
+
+    -> stats dict: ``tiles_written`` / ``tiles_empty`` /
+    ``tiles_too_large`` (skipped with a record, not fatal — a pyramid
+    export must not die at z0 where everything is one tile) /
+    ``features_out`` / ``bytes_out``. ``progress`` (optional callable)
+    receives (z, x, y, status) per visited tile."""
+    bbox = dataset_bbox_wsen(source)
+    stats = {
+        "tiles_written": 0,
+        "tiles_empty": 0,
+        "tiles_too_large": 0,
+        "features_out": 0,
+        "bytes_out": 0,
+    }
+    with tm.span("tiles.export", dataset=source.ds_path):
+        for z in zooms:
+            x0, y0, x1, y1 = tile_range_for_bbox(z, bbox)
+            for x in range(x0, x1 + 1):
+                z_dir = None
+                for y in range(y0, y1 + 1):
+                    try:
+                        payload, t_stats = encode_tile(
+                            source, z, x, y, layers=layers, extent=extent,
+                            buffer=buffer, max_features=max_features,
+                        )
+                    except TileTooLarge:
+                        stats["tiles_too_large"] += 1
+                        if progress is not None:
+                            progress(z, x, y, "too_large")
+                        continue
+                    if t_stats["count"] == 0:
+                        stats["tiles_empty"] += 1
+                        if progress is not None:
+                            progress(z, x, y, "empty")
+                        continue
+                    if z_dir is None:
+                        z_dir = os.path.join(out_dir, str(z), str(x))
+                        os.makedirs(z_dir, exist_ok=True)
+                    path = os.path.join(z_dir, f"{y}.ktile")
+                    tmp = path + f".tmp{os.getpid()}"
+                    with open(tmp, "wb") as f:
+                        f.write(payload)
+                    os.replace(tmp, path)
+                    stats["tiles_written"] += 1
+                    stats["features_out"] += t_stats["count"]
+                    stats["bytes_out"] += len(payload)
+                    if progress is not None:
+                        progress(z, x, y, "written")
+    return stats

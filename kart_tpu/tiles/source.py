@@ -1,0 +1,302 @@
+"""Commit-pinned tile data source: one dataset version's columnar identity
+(sidecar FeatureBlock) plus the block-pruned row selection a tile request
+runs against it.
+
+The whole point of serving tiles from a version-control store is that a
+revision is immutable: a :class:`TileSource` is keyed by *commit oid* (not
+ref), so everything it derives — the mmap'd sidecar block, the fallback
+envelope columns, the per-block aggregates — is valid forever and shared by
+every tile of that revision. A small process-wide LRU
+(:func:`source_for`) keeps the hot revisions' sources alive across
+requests; ref updates never invalidate it (a commit never changes meaning),
+they only stop *new* requests from resolving to the old commit.
+
+Row selection is columnar end-to-end (ISSUE 10 tentpole): the tile's
+padded query rectangle classifies the sidecar's per-block union-bbox
+aggregates all-out / all-in / boundary via the PR 1 classifier
+(:func:`kart_tpu.ops.bbox.classify_env_blocks_np`), only the surviving
+blocks' envelope pages are faulted in for the fine scan, and all-out
+blocks are never touched — the "second life" of the block aggregates.
+"""
+
+import os
+import threading
+import time
+from collections import OrderedDict
+
+import numpy as np
+
+from kart_tpu import telemetry as tm
+from kart_tpu.ops.bbox import (
+    BLOCK_ALL_IN,
+    BLOCK_ALL_OUT,
+    bbox_intersects_np,
+    classify_env_blocks_np,
+)
+
+
+class TileSourceError(ValueError):
+    """The (commit, dataset) pair can't serve tiles (missing dataset,
+    no geometry column, unreadable identity)."""
+
+
+class TileDataUnavailable(TileSourceError):
+    """Feature values are needed (geojson layer) but the blobs are
+    promised/absent — a partial clone serving beyond its data."""
+
+
+class TileSource:
+    """One (commit oid, dataset path) pair, ready to answer tile queries.
+
+    ``block`` is the unpadded sidecar FeatureBlock (mmap'd keys/oids, and —
+    when the sidecar carries them — envelope columns + block aggregates).
+    Datasets without sidecar envelopes get in-memory fallback columns built
+    once from the feature blobs (small imported repos); datasets without a
+    geometry column are rejected — a tile of non-spatial rows is
+    meaningless."""
+
+    def __init__(self, repo, commit_oid, ds_path):
+        from kart_tpu.core.structure import RepoStructure
+        from kart_tpu.diff import sidecar
+
+        self.repo = repo
+        self.commit_oid = commit_oid
+        self.ds_path = ds_path
+        structure = RepoStructure(repo, commit_oid)
+        ds = structure.datasets.get(ds_path)
+        if ds is None:
+            raise TileSourceError(
+                f"No dataset {ds_path!r} at commit {commit_oid[:12]}"
+            )
+        if ds.geom_column_name is None:
+            raise TileSourceError(
+                f"Dataset {ds_path!r} has no geometry column; tiles need one"
+            )
+        self.dataset = ds
+        with tm.span("tiles.source_load", dataset=ds_path):
+            block = sidecar.ensure_block(repo, ds, pad=False)
+        if block is None:
+            raise TileSourceError(
+                f"Dataset {ds_path!r} at {commit_oid[:12]} has no feature "
+                f"identity (empty feature tree?)"
+            )
+        self.block = block
+        self._lock = threading.Lock()
+        self._fallback_envs = None
+        self._fallback_aggs = None
+
+    # -- envelope columns ----------------------------------------------------
+
+    def envelopes(self):
+        """(count, 4) f32 wsen envelope columns (sidecar mmap, or the
+        cached fallback build)."""
+        if self.block.envelopes is not None:
+            return self.block.envelopes
+        with self._lock:
+            if self._fallback_envs is None:
+                with tm.span("tiles.envelope_fallback", rows=self.block.count):
+                    self._fallback_envs = self._build_fallback_envelopes()
+            return self._fallback_envs
+
+    def _build_fallback_envelopes(self, chunk=100_000):
+        """(count, 4) f32 wsen columns for a dataset whose sidecar predates
+        envelope capture — one O(N) pass over the real feature blobs in the
+        block's own row order (so row i's envelope is row i's feature by
+        construction), cached for the life of the revision. Rows whose
+        envelope can't be derived (NULL geometry, undecodable) get the full
+        world: they appear in every tile rather than vanishing (fail open,
+        the spatial-filter module's policy)."""
+        from kart_tpu.diff.sidecar import _feature_envelope_wsen
+
+        ds = self.dataset
+        geom_col = ds.geom_column_name
+        n = self.block.count
+        out = np.empty((n, 4), dtype=np.float32)
+        for lo in range(0, n, chunk):
+            rows = np.arange(lo, min(lo + chunk, n), dtype=np.int64)
+            data = self.feature_blobs(rows)
+            for i, (pks, blob) in enumerate(zip(self.pks_for_rows(rows), data)):
+                feature = ds.get_feature(pks, data=blob)
+                out[lo + i] = _feature_envelope_wsen(feature, geom_col)
+        return out
+
+    def env_blocks(self):
+        """(agg (nb,4) f32, flags (nb,) u8, block_rows) aggregates, or
+        None (pre-aggregate sidecar with mmap'd envelopes — full scan)."""
+        if self.block.envelopes is not None:
+            return self.block.env_blocks
+        from kart_tpu.diff.sidecar import AGG_BLOCK_ROWS, _block_aggregates
+
+        envs = self.envelopes()
+        with self._lock:
+            if self._fallback_aggs is None and len(envs):
+                agg, flags = _block_aggregates(envs, AGG_BLOCK_ROWS)
+                self._fallback_aggs = (agg, flags, AGG_BLOCK_ROWS)
+            return self._fallback_aggs
+
+    # -- the block-pruned row selection --------------------------------------
+
+    def rows_for_bbox(self, query_wsen):
+        """-> (ascending int64 row indices whose envelope intersects the
+        query rectangle, stats dict). Only boundary blocks' envelope pages
+        are scanned; all-out blocks are pruned without faulting a page;
+        all-in blocks contribute every row without a scan.
+
+        stats: ``blocks_total`` / ``blocks_pruned`` / ``blocks_read``
+        (boundary + all-in — the blocks whose data participates) and
+        ``rows_scanned`` (fine-scanned envelope rows). Mirrored into the
+        ``tiles.*`` counters."""
+        n = self.block.count
+        query = np.asarray(query_wsen, dtype=np.float64)
+        stats = {
+            "blocks_total": 0,
+            "blocks_pruned": 0,
+            "blocks_read": 0,
+            "rows_scanned": 0,
+        }
+        if n == 0:
+            return np.zeros(0, dtype=np.int64), stats
+        envs = self.envelopes()
+        blocks = self.env_blocks()
+        with tm.span("tiles.prune", rows=n):
+            if blocks is None:
+                # pre-aggregate sidecar: one full envelope scan
+                stats["blocks_total"] = stats["blocks_read"] = 1
+                stats["rows_scanned"] = n
+                idx = np.flatnonzero(bbox_intersects_np(envs, query))
+            else:
+                agg, flags, block_rows = blocks
+                cls = classify_env_blocks_np(agg, flags, query)
+                nb = len(cls)
+                stats["blocks_total"] = nb
+                pruned = int(np.count_nonzero(cls == BLOCK_ALL_OUT))
+                stats["blocks_pruned"] = pruned
+                stats["blocks_read"] = nb - pruned
+                parts = []
+                for b in np.nonzero(cls != BLOCK_ALL_OUT)[0]:
+                    lo = int(b) * block_rows
+                    hi = min(lo + block_rows, n)
+                    if cls[b] == BLOCK_ALL_IN:
+                        parts.append(np.arange(lo, hi, dtype=np.int64))
+                    else:
+                        stats["rows_scanned"] += hi - lo
+                        hit = bbox_intersects_np(envs[lo:hi], query)
+                        parts.append(np.flatnonzero(hit).astype(np.int64) + lo)
+                idx = (
+                    np.concatenate(parts)
+                    if parts
+                    else np.zeros(0, dtype=np.int64)
+                )
+        tm.incr("tiles.blocks_pruned", stats["blocks_pruned"])
+        tm.incr("tiles.blocks_read", stats["blocks_read"])
+        return idx, stats
+
+    # -- values --------------------------------------------------------------
+
+    def pks_for_rows(self, rows):
+        """-> list of pk tuples for the given row indices (int-pk keys are
+        the pks; hash-keyed datasets decode from the stored paths)."""
+        ds = self.dataset
+        keys = self.block.keys
+        if ds.path_encoder.scheme == "int":
+            return [(int(keys[i]),) for i in rows]
+        return [
+            ds.decode_path_to_pks(self.block.paths[int(i)]) for i in rows
+        ]
+
+    def feature_blobs(self, rows):
+        """Feature blob bytes for the given rows, in order — the ordered
+        native batch pack read with per-object fallback. Raises
+        :class:`TileDataUnavailable` when a blob is promised/absent (the
+        geojson layer needs values a partial clone doesn't hold)."""
+        from kart_tpu.core.odb import ObjectMissing, ObjectPromised
+        from kart_tpu.ops.blocks import unpack_oid_bytes, unpack_oid_hex
+
+        odb = self.dataset._feature_odb()
+        oid_rows = np.asarray(self.block.oids[rows])
+        shas = unpack_oid_bytes(oid_rows)
+        with tm.span("tiles.blob_read", rows=len(shas)):
+            data = odb.read_blobs_data_ordered(shas)
+            missing = [i for i, d in enumerate(data) if d is None]
+            if missing:
+                hexes = unpack_oid_hex(oid_rows[missing])
+                for i, oid_hex in zip(missing, hexes):
+                    try:
+                        data[i] = odb.read_blob(oid_hex)
+                    except (ObjectPromised, ObjectMissing):
+                        raise TileDataUnavailable(
+                            f"Feature blob {oid_hex} of {self.ds_path!r} is "
+                            f"not present locally (partial clone?); serve the "
+                            f"binary layer only, or backfill first"
+                        )
+        return data
+
+
+# ---------------------------------------------------------------------------
+# the per-process source cache: (gitdir, commit, dataset) -> TileSource.
+# Commit-keyed entries are immutable-by-construction; the LRU exists only to
+# bound memory (fallback envelope columns can be large).
+# ---------------------------------------------------------------------------
+
+_SOURCES = OrderedDict()
+_SOURCES_MAX = 8
+_SOURCES_INFLIGHT = {}  # key -> threading.Event (a build in progress)
+_sources_lock = threading.Lock()
+
+#: a wedged source build must not gate waiters forever (mirrors the
+#: payload caches' single-flight bypass)
+_SOURCE_BUILD_TIMEOUT = 600.0
+
+
+def source_for(repo, commit_oid, ds_path):
+    """The cached :class:`TileSource` for (repo, commit, dataset), with
+    single-flight construction: N concurrent cold requests for different
+    tiles of one commit run ONE sidecar/envelope build — without this, a
+    fresh server under a tile storm would pay the O(N) ``ensure_block``
+    (and, on the envelope-less fallback path, the O(N) blob scan) once
+    per thread and discard all but one result."""
+    key = (os.path.realpath(repo.gitdir), commit_oid, ds_path)
+    deadline = time.monotonic() + _SOURCE_BUILD_TIMEOUT
+    own_event = None  # the fill token, held only by the thread that builds
+    while own_event is None:
+        with _sources_lock:
+            src = _SOURCES.get(key)
+            if src is not None:
+                _SOURCES.move_to_end(key)
+                return src
+            event = _SOURCES_INFLIGHT.get(key)
+            if event is None:
+                _SOURCES_INFLIGHT[key] = own_event = threading.Event()
+                break  # this thread builds
+        remaining = deadline - time.monotonic()
+        if remaining <= 0:
+            break  # wedged builder: build independently, don't gate others
+        event.wait(min(remaining, 60.0))
+        # woken (or timed out a slice): re-check — on a failed build the
+        # entry is absent and the first re-checker becomes the new builder
+    try:
+        src = TileSource(repo, commit_oid, ds_path)
+        with _sources_lock:
+            _SOURCES[key] = src
+            _SOURCES.move_to_end(key)
+            while len(_SOURCES) > _SOURCES_MAX:
+                _SOURCES.popitem(last=False)
+        return src
+    finally:
+        if own_event is not None:
+            with _sources_lock:
+                if _SOURCES_INFLIGHT.get(key) is own_event:
+                    _SOURCES_INFLIGHT.pop(key, None)
+            own_event.set()
+
+
+def drop_sources(gitdir=None):
+    """Drop cached sources (tests; the ref-update hook drops tile *caches*
+    but sources stay — a commit's identity never changes)."""
+    with _sources_lock:
+        if gitdir is None:
+            _SOURCES.clear()
+        else:
+            real = os.path.realpath(gitdir)
+            for key in [k for k in _SOURCES if k[0] == real]:
+                _SOURCES.pop(key, None)

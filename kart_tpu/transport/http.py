@@ -18,6 +18,17 @@ Endpoints (all JSON unless noted):
 
     GET  <base>/api/v1/refs
         -> {"heads": {...}, "tags": {...}, "head_branch": ..., "shallow": [...]}
+    GET  <base>/api/v1/tiles/<ref>/<dataset>/<z>/<x>/<y>[?layers=bin,geojson]
+        -> one framed tile payload (docs/TILES.md): vector tile of the
+        named ref's commit, served straight off the columnar sidecar —
+        block-pruned, commit-addressed-cached, strong ETag (the ref is
+        pinned to its commit oid at request time, so the validator never
+        needs revalidation). ``<ref>`` is URL-encoded (refs/heads/main →
+        refs%2Fheads%2Fmain); bare branch/tag names and commit oids work
+        unescaped. Tile requests ARE load-shed (429 + Retry-After past
+        the inflight ceiling) — unlike /api/v1/stats, a tile is ordinary
+        work. ``KART_SERVE_TILES=0`` (or ``kart serve --no-tiles``)
+        disables the endpoint (404).
     POST <base>/api/v1/fetch-pack
         {"wants": [...], "haves": [...], "have_shallow": [...],
          "depth": N|null, "filter": "w,s,e,n"|null}
@@ -352,6 +363,8 @@ class KartRequestHandler(BaseHTTPRequestHandler):
             try:
                 if path == f"{API}/refs":
                     return self._handle_refs()
+                if path.startswith(f"{API}/tiles/"):
+                    return self._handle_tile(path)
                 self._json(404, {"error": f"No such endpoint: {self.path}"})
             finally:
                 self._leave()
@@ -380,6 +393,89 @@ class KartRequestHandler(BaseHTTPRequestHandler):
         from kart_tpu.transport.service import ls_refs_info
 
         self._json(200, ls_refs_info(self.repo))
+
+    @staticmethod
+    def _if_none_match_hits(header_value, etag):
+        """RFC 9110 If-None-Match: a comma-separated validator list, each
+        optionally weak-prefixed (``W/``), or ``*``. A browser/proxy that
+        coalesced several stored responses sends the list form — exact
+        string equality would silently kill the 304 fast path for it."""
+        if not header_value:
+            return False
+        if header_value.strip() == "*":
+            return True
+        for part in header_value.split(","):
+            candidate = part.strip()
+            if candidate.startswith("W/"):
+                candidate = candidate[2:]
+            if candidate == etag:
+                return True
+        return False
+
+    def _handle_tile(self, path):
+        """``GET /api/v1/tiles/<ref>/<dataset>/<z>/<x>/<y>``: serve one
+        vector tile of the named revision straight off the columnar store
+        (kart_tpu/tiles; docs/TILES.md). Dataset paths may contain slashes;
+        the last three segments are always z/x/y and the first is the
+        (URL-encoded) ref."""
+        from urllib.parse import parse_qs, unquote
+
+        from kart_tpu import tiles
+
+        if os.environ.get("KART_SERVE_TILES", "1") in ("0", "false"):
+            return self._json(
+                404, {"error": "Tile serving is disabled on this server"}
+            )
+        tm.incr("transport.server.requests", verb="tiles")
+        parts = [unquote(p) for p in path[len(f"{API}/tiles/"):].split("/")]
+        if len(parts) < 5 or not all(parts):
+            return self._json(
+                400,
+                {"error": "Tile address must be <ref>/<dataset>/<z>/<x>/<y>"},
+            )
+        ref, ds_path = parts[0], "/".join(parts[1:-3])
+        z, x, y = parts[-3:]
+        params = parse_qs(urlsplit(self.path).query)
+        layers = params.get("layers", [None])[0]
+        try:
+            # the validator derives from the request key alone (commit oid
+            # + address + layers): a revalidating client is answered 304
+            # before any source is built or payload encoded — even on a
+            # cold cache, a conditional GET is near-free
+            etag, commit_oid = tiles.tile_etag(
+                self.repo, ref, ds_path, z, x, y, layers=layers
+            )
+            if self._if_none_match_hits(self.headers.get("If-None-Match"), etag):
+                # commit-addressed: a matching validator can never be stale
+                self.send_response(304)
+                self.send_header("ETag", etag)
+                self.send_header("Content-Length", "0")
+                self.end_headers()
+                return
+            payload, etag, _cached = tiles.serve_tile(
+                self.repo, ref, ds_path, z, x, y, layers=layers,
+                commit_oid=commit_oid,
+            )
+        except tiles.TileTooLarge as e:
+            return self._json(
+                413, {"error": str(e), "count": e.count, "limit": e.limit}
+            )
+        except tiles.TileDataUnavailable as e:
+            return self._json(422, {"error": str(e)})
+        except tiles.TileSourceError as e:
+            return self._json(404, {"error": str(e)})
+        except (tiles.TileAddressError, tiles.TileEncodeError) as e:
+            return self._json(400, {"error": str(e)})
+        tm.incr("transport.server.bytes_sent", len(payload))
+        self.send_response(200)
+        self.send_header("Content-Type", "application/x-kart-tile")
+        self.send_header("ETag", etag)
+        # the payload is immutable for its key (the commit oid is in it):
+        # downstream HTTP caches may keep it as long as they like
+        self.send_header("Cache-Control", "public, max-age=31536000, immutable")
+        self.send_header("Content-Length", str(len(payload)))
+        self.end_headers()
+        self.wfile.write(payload)
 
     def _handle_stats(self):
         """Prometheus-style text exposition of this server process's metric

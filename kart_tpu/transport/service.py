@@ -39,6 +39,7 @@ from kart_tpu import telemetry as tm
 from kart_tpu.core.odb import ObjectMissing
 from kart_tpu.core.refs import RefError, check_ref_format
 from kart_tpu.core.repo import KartRepo
+from kart_tpu.core.singleflight import SingleFlightLRU
 from kart_tpu.transport.protocol import ObjectEnumerator, Rejection
 
 #: subdirectory of <gitdir>/objects holding in-flight push quarantines
@@ -199,122 +200,47 @@ class _CacheEntry:
             self.nbytes = 160 * len(emitted) + 1024
 
 
-class _FillToken:
-    """The right to publish one cache entry: handed to the single request
-    that runs the walk for a key; every other request for that key waits on
-    ``event`` until publish/abandon."""
-
-    __slots__ = ("cache", "key", "event")
-
-    def __init__(self, cache, key, event):
-        self.cache = cache
-        self.key = key
-        self.event = event
-
-    def publish(self, header, *, data=None, emitted=None):
-        self.cache._publish(self, header, data, emitted)
-
-    def abandon(self):
-        self.cache._abandon(self)
-
-
-class PackEnumCache:
+class PackEnumCache(SingleFlightLRU):
     """LRU-by-byte-budget memo of fetch-pack enumerations with
-    single-flight fill (one instance per served repo)."""
+    single-flight fill (one instance per served repo). The concurrency
+    machinery — single-flight tokens, the wedged-filler bypass, the
+    poison-barrier publish, LRU eviction — is the shared
+    :class:`~kart_tpu.core.singleflight.SingleFlightLRU` (the tile cache
+    runs the same core); this class contributes the entry shape
+    (:class:`_CacheEntry`), the telemetry names and the fault point.
+
+    A fill publishes a complete ``_CacheEntry``; a filler wedged past
+    ``SINGLEFLIGHT_TIMEOUT`` stops gating (waiters walk uncached)."""
+
+    SINGLEFLIGHT_TIMEOUT = SINGLEFLIGHT_TIMEOUT
 
     def __init__(self, budget_bytes):
-        self.budget = budget_bytes
+        super().__init__(budget_bytes)
         # a single entry may use at most budget/8 bytes as raw framed
         # bytes; larger packs store the oid replay list instead, so one
         # huge clone can't evict every hot entry
         self.bytes_cap = max(1, budget_bytes // 8)
-        self._lock = threading.Lock()
-        self._entries = OrderedDict()  # key -> _CacheEntry
-        self._inflight = {}            # key -> threading.Event
-        self._total = 0
 
-    # -- lookup / single-flight --------------------------------------------
+    def entry_nbytes(self, entry):
+        return entry.nbytes
 
-    def lookup_or_begin(self, key, timeout=SINGLEFLIGHT_TIMEOUT):
-        """-> ("hit", entry) | ("fill", token) | ("fill", None).
-
-        A miss returns a fill token (the caller runs the walk and must
-        publish or abandon). While another request holds the token for the
-        same key, callers block here; a publish turns them into hits. A
-        filler wedged past ``timeout`` stops gating: waiters proceed with
-        their own uncached walk (token None — nothing to publish)."""
-        deadline = time.monotonic() + timeout
-        waited = False
-        while True:
-            with self._lock:
-                entry = self._entries.get(key)
-                if entry is not None:
-                    self._entries.move_to_end(key)
-                    tm.incr("server.enum_cache.hits")
-                    return "hit", entry
-                event = self._inflight.get(key)
-                if event is None:
-                    self._inflight[key] = event = threading.Event()
-                    tm.incr("server.enum_cache.misses")
-                    return "fill", _FillToken(self, key, event)
-            if not waited:
-                waited = True
-                tm.incr("server.enum_cache.singleflight_waits")
-            remaining = deadline - time.monotonic()
-            if remaining <= 0:
-                tm.incr("server.enum_cache.misses")
-                return "fill", None
-            event.wait(min(remaining, 60.0))
-
-    # -- fill side ----------------------------------------------------------
-
-    def _publish(self, token, header, data, emitted):
+    def publish_fault(self):
         # the injectable failure of the cache-fill frame: a fault here must
         # poison nothing — the entry is never inserted (tests/test_faults.py)
-        try:
-            faults.fire("server.enum_cache")
-        except BaseException:
-            self._abandon(token)
-            raise
-        entry = _CacheEntry(header, data, emitted, _etag_for(token.key))
-        with self._lock:
-            self._inflight.pop(token.key, None)
-            self._entries[token.key] = entry
-            self._entries.move_to_end(token.key)
-            self._total += entry.nbytes
-            while self._total > self.budget and len(self._entries) > 1:
-                _, evicted = self._entries.popitem(last=False)
-                self._total -= evicted.nbytes
-                tm.incr("server.enum_cache.evictions")
-            tm.gauge_set("server.enum_cache.bytes", self._total)
-        token.event.set()
+        faults.fire("server.enum_cache")
 
-    def _abandon(self, token):
-        with self._lock:
-            self._inflight.pop(token.key, None)
-        token.event.set()
+    def count(self, event, n=1):
+        if event == "hits":
+            tm.incr("server.enum_cache.hits", n)
+        elif event == "misses":
+            tm.incr("server.enum_cache.misses", n)
+        elif event == "singleflight_waits":
+            tm.incr("server.enum_cache.singleflight_waits", n)
+        elif event == "evictions":
+            tm.incr("server.enum_cache.evictions", n)
 
-    # -- invalidation -------------------------------------------------------
-
-    def evict(self, key):
-        """Drop one entry (a replay that hit missing objects is poisoned —
-        evicted, never served again)."""
-        with self._lock:
-            entry = self._entries.pop(key, None)
-            if entry is not None:
-                self._total -= entry.nbytes
-                tm.incr("server.enum_cache.evictions")
-                tm.gauge_set("server.enum_cache.bytes", self._total)
-
-    def invalidate(self):
-        """Drop everything (a ref update changed what any key may serve)."""
-        with self._lock:
-            n = len(self._entries)
-            self._entries.clear()
-            self._total = 0
-            if n:
-                tm.incr("server.enum_cache.evictions", n)
-            tm.gauge_set("server.enum_cache.bytes", 0)
+    def gauge(self, total):
+        tm.gauge_set("server.enum_cache.bytes", total)
 
 
 #: gitdir -> PackEnumCache for every repo this process serves (bounded: a
@@ -410,11 +336,16 @@ class FetchPlan:
             return
         header = self.header() if callable(self.header) else self.header
         cache = self._token.cache
+        etag = _etag_for(self._token.key)
         if length <= cache.bytes_cap:
             spool.seek(0)
-            self._token.publish(header, data=spool.read(length))
+            self._token.publish(
+                _CacheEntry(header, spool.read(length), None, etag)
+            )
         elif self._enum is not None and self._enum.emitted is not None:
-            self._token.publish(header, emitted=list(self._enum.emitted))
+            self._token.publish(
+                _CacheEntry(header, None, list(self._enum.emitted), etag)
+            )
         else:
             self._token.abandon()
 
@@ -1231,6 +1162,16 @@ def _apply_validated_updates(repo, header):
         cache = _ENUM_CACHES.get(os.path.realpath(repo.gitdir))
     if cache is not None:
         cache.invalidate()
+    # tile-cache keys are commit-pinned and can never go stale, but tiles
+    # of a commit a ref just moved away from are probably dead weight —
+    # the explicit drop hook releases their budget now (docs/TILES.md §3).
+    # sys.modules guard: a process that never imported the tiles machinery
+    # cannot hold tile caches, and a push must not pay the package import
+    import sys
+
+    tiles_cache = sys.modules.get("kart_tpu.tiles.cache")
+    if tiles_cache is not None:
+        tiles_cache.invalidate_tile_caches(repo.gitdir)
     return updated
 
 

@@ -151,6 +151,34 @@ NEW_KEYS += [
 ]
 
 
+#: keys added by ISSUE 10 (`bench.py --tiles`: tile read-serving off the
+#: columnar store — tiles/s cold (fresh cache, block-pruned selection +
+#: vectorized clip/quantize) and cached (commit-addressed memo, zero ODB
+#: touches), the pruning evidence (blocks read per tile must be ≪ the
+#: dataset's block count), byte-identity cold vs cached, and the
+#: concurrent-client tile storm against a real `kart serve` process).
+#: Recorded in BENCH_r10.json.
+NEW_KEYS += [
+    "tile_rows",
+    "tile_zoom",
+    "tile_count",
+    "tile_synth_seconds",
+    "tiles_per_sec_cold",
+    "tiles_per_sec_cached",
+    "tile_payload_identical",
+    "tile_cache_hit_rate",
+    "tile_blocks_total",
+    "tile_blocks_read_mean",
+    "tile_blocks_pruned_pct",
+    "tile_features_mean",
+    "tile_storm_clients",
+    "tile_storm_requests_total",
+    "tile_storm_ok_requests",
+    "tile_storm_agg_tiles_per_sec",
+    "tile_storm_p99_request_seconds",
+]
+
+
 def test_bench_emits_every_recorded_key():
     with open(os.path.join(REPO_ROOT, "bench.py")) as f:
         src = f.read()

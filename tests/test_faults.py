@@ -1174,3 +1174,91 @@ def test_shed_push_is_retried_transparently(served_repo, tmp_path, monkeypatch):
     monkeypatch.delenv("KART_FAULTS")
     assert updated == {"refs/heads/main": oid}
     assert repo.refs.get("refs/heads/main") == oid
+
+
+# ---------------------------------------------------------------------------
+# tile serving: encode + cache fault points (ISSUE 10)
+# ---------------------------------------------------------------------------
+
+
+def _get_tile(url, path):
+    """GET <url><path> -> (status, body bytes)."""
+    import urllib.error
+    import urllib.request
+
+    try:
+        with urllib.request.urlopen(url.rstrip("/") + path, timeout=30) as r:
+            return r.status, r.read()
+    except urllib.error.HTTPError as e:
+        return e.code, e.read()
+
+
+@pytest.mark.parametrize("frame", [1, 2])
+def test_tile_encode_killed_at_every_frame_publishes_nothing(
+    served_repo, monkeypatch, frame
+):
+    """ISSUE 10 kill matrix: a crash at either tiles.encode frame (1 = the
+    block-pruned row selection done, 2 = layers built, payload not yet
+    assembled) surfaces as an error with nothing published — the cache
+    holds no entry, and the retried request serves the exact payload a
+    never-faulted server would."""
+    from kart_tpu import telemetry
+    from kart_tpu.tiles.cache import tile_cache_for
+
+    repo, ds_path, url = served_repo
+    telemetry.reset(disable=False)
+    tile = f"/api/v1/tiles/HEAD/{ds_path}/1/0/0"
+
+    monkeypatch.setenv("KART_FAULTS", f"tiles.encode:{frame}")
+    status, body = _get_tile(url, tile)
+    monkeypatch.delenv("KART_FAULTS")
+    assert status == 500
+    assert b"InjectedFault" in body
+    assert tile_cache_for(repo).stats()["entries"] == 0
+
+    status, payload = _get_tile(url, tile)
+    assert status == 200
+    # byte-identical to a clean single-process encode of the same key
+    from kart_tpu import tiles
+
+    clean, _etag, _ = tiles.serve_tile(repo, "HEAD", ds_path, 1, 0, 0)
+    assert payload == clean
+
+
+def test_poisoned_tile_cache_fill_is_never_served(served_repo, monkeypatch):
+    """A fault at the tile cache's publish frame poisons nothing: the
+    entry is never inserted, the failing request surfaces its error, and
+    the next identical request re-encodes cleanly — a poisoned tile is
+    never served (ISSUE 10 satellite)."""
+    from kart_tpu import telemetry, tiles
+    from kart_tpu.tiles.cache import tile_cache_for
+
+    repo, ds_path, url = served_repo
+    telemetry.reset(disable=False)
+    tile = f"/api/v1/tiles/HEAD/{ds_path}/0/0/0"
+
+    monkeypatch.setenv("KART_FAULTS", "tiles.cache:1")
+    status, body = _get_tile(url, tile)
+    monkeypatch.delenv("KART_FAULTS")
+    assert status == 500
+    assert b"InjectedFault" in body
+    assert tile_cache_for(repo).stats() == {"entries": 0, "bytes": 0}
+
+    status, payload = _get_tile(url, tile)
+    assert status == 200
+    header, layers = tiles.parse_payload(payload)
+    assert header["count"] > 0
+
+    def count(name):
+        for n, l, v in telemetry.snapshot()["counters"]:
+            if n == name and not l:
+                return v
+        return 0
+
+    # both requests were misses; nothing was served from a poisoned entry
+    assert count("tiles.cache.misses") == 2
+    assert count("tiles.cache.hits") == 0
+    # and now the clean entry is cached: a third request hits
+    status, again = _get_tile(url, tile)
+    assert status == 200 and again == payload
+    assert count("tiles.cache.hits") == 1

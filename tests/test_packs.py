@@ -683,3 +683,40 @@ def test_pack_writer_dedupe_run_stack_many_batches(tmp_path):
     packs = PackCollection([pack_dir])
     for blob, oid_row in zip(mixed, third):
         assert packs.read(bytes(oid_row)) == ("blob", blob)
+
+
+def test_first_pack_scan_publishes_atomically_to_concurrent_readers(tmp_path, monkeypatch):
+    """Regression (ISSUE 10 storm): the first lazy pack scan used to assign
+    an empty list and append packs one by one — a concurrent reader (the
+    threading server's other handlers; 16 cold tile requests on a fresh
+    server) could observe the partial list and report reachable objects as
+    missing. The scan must publish a complete list atomically."""
+    import threading
+    import time as _time
+
+    from kart_tpu.core import packs as packs_mod
+
+    pack_dir = str(tmp_path / "pack")
+    with PackWriter(pack_dir) as w:
+        oid = w.add("blob", b"present")
+    pc = PackCollection([pack_dir])
+
+    # make the scanner's Packfile construction slow enough that the reader
+    # thread provably runs while the scan is mid-flight
+    real_init = packs_mod.Packfile.__init__
+    scanning = threading.Event()
+
+    def slow_init(self, *args, **kwargs):
+        scanning.set()
+        _time.sleep(0.3)
+        real_init(self, *args, **kwargs)
+
+    monkeypatch.setattr(packs_mod.Packfile, "__init__", slow_init)
+    scanner = threading.Thread(target=lambda: pc.packs)
+    scanner.start()
+    assert scanning.wait(5)
+    # mid-scan read: must run (or wait on) a complete scan, never see a
+    # partially-populated list
+    got = pc.read(bytes.fromhex(oid))
+    scanner.join()
+    assert got == ("blob", b"present")

@@ -1,0 +1,540 @@
+"""Tile read-serving off the columnar store (ISSUE 10): grid math, the
+block-pruned row selection, clip/quantize, payload determinism (cold vs
+cached vs across processes), the commit-addressed cache + drop hook, the
+parity contract against the spatial-filtered reference path, and the
+endpoint's shed semantics (tiles ARE shed; /api/v1/stats is not)."""
+
+import json
+import os
+import subprocess
+import sys
+import threading
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+from kart_tpu import telemetry, tiles
+from kart_tpu.core.repo import KartRepo
+from kart_tpu.tiles.grid import (
+    MERC_MAX_LAT,
+    TileAddressError,
+    parse_zoom_spec,
+    tile_bounds_wsen,
+    tile_query_wsen,
+    tile_range_for_bbox,
+    validate_tile,
+)
+from kart_tpu.transport.http import make_server
+
+from helpers import edit_commit, make_imported_repo
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(autouse=True)
+def _fresh_metrics():
+    telemetry.reset()
+    yield
+    telemetry.reset()
+
+
+@pytest.fixture(autouse=True)
+def _clean_env(monkeypatch):
+    for var in (
+        "KART_FAULTS",
+        "KART_TILE_CACHE",
+        "KART_TILE_MAX_FEATURES",
+        "KART_SERVE_TILES",
+        "KART_SERVE_MAX_INFLIGHT",
+    ):
+        monkeypatch.delenv(var, raising=False)
+
+
+@pytest.fixture()
+def served_points(tmp_path):
+    """An imported points repo (real blobs, real point geometry) served
+    over in-thread localhost HTTP."""
+    repo, ds_path = make_imported_repo(tmp_path, n=40)
+    repo.config["receive.denyCurrentBranch"] = "ignore"
+    server = make_server(repo)
+    threading.Thread(target=server.serve_forever, daemon=True).start()
+    url = f"http://127.0.0.1:{server.server_address[1]}"
+    yield repo, ds_path, url
+    server.shutdown()
+    server.server_close()
+
+
+@pytest.fixture()
+def synth_spatial(tmp_path):
+    """A 200k-row spatial synth repo: envelope sidecar columns + block
+    aggregates present, feature blobs promised (the partial-clone /
+    bench-scale state — the columnar bin layer must serve without them)."""
+    from kart_tpu.synth import synth_repo
+
+    repo, info = synth_repo(
+        str(tmp_path / "synth"), 200_000, spatial=True, blobs="promised"
+    )
+    return repo, info
+
+
+def http_get(url, headers=None):
+    req = urllib.request.Request(url, headers=headers or {})
+    try:
+        with urllib.request.urlopen(req, timeout=30) as r:
+            return r.status, dict(r.headers), r.read()
+    except urllib.error.HTTPError as e:
+        return e.code, dict(e.headers), e.read()
+
+
+def counter(name, **labels):
+    for n, l, v in telemetry.snapshot()["counters"]:
+        if n == name and l == labels:
+            return v
+    return 0
+
+
+# ---------------------------------------------------------------------------
+# grid math
+# ---------------------------------------------------------------------------
+
+
+def test_tile_bounds_world_and_quadrants():
+    assert tile_bounds_wsen(0, 0, 0) == pytest.approx(
+        (-180.0, -MERC_MAX_LAT, 180.0, MERC_MAX_LAT)
+    )
+    w, s, e, n = tile_bounds_wsen(1, 1, 1)  # south-east quadrant
+    assert (w, e) == (0.0, 180.0)
+    assert n == 0.0 and s == pytest.approx(-MERC_MAX_LAT)
+
+
+def test_tile_bounds_adjacent_tiles_share_edges():
+    *_, e0, _ = tile_bounds_wsen(3, 2, 3)
+    w1, *_ = tile_bounds_wsen(3, 3, 3)
+    assert e0 == w1
+    _, s_up, _, _ = tile_bounds_wsen(3, 2, 3)
+    _, _, _, n_down = tile_bounds_wsen(3, 2, 4)
+    assert s_up == n_down
+
+
+def test_tile_query_pads_but_stays_legal():
+    w, s, e, n = tile_query_wsen(0, 0, 0)
+    assert w < -180.0 and e > 180.0  # lon pad pokes past (handled cyclically)
+    assert s >= -90.0 and n <= 90.0
+
+
+def test_validate_tile_rejects_bad_addresses():
+    for bad in [(-1, 0, 0), (2, 4, 0), (2, 0, -1), (31, 0, 0), ("z", 0, 0)]:
+        with pytest.raises(TileAddressError):
+            validate_tile(*bad)
+
+
+def test_parse_zoom_spec():
+    assert parse_zoom_spec("3") == [3]
+    assert parse_zoom_spec("2-5") == [2, 3, 4, 5]
+    assert parse_zoom_spec("5-2") == [2, 3, 4, 5]
+    with pytest.raises(TileAddressError):
+        parse_zoom_spec("x")
+
+
+def test_polar_features_served_by_edge_tile_rows():
+    """Regression (review finding): the documented latitude-clamp policy —
+    features polewards of ±85.05° are *served by* the top/bottom tile rows,
+    never dropped — must hold in the selection math. The membership
+    rectangle of an edge row extends to the pole."""
+    from kart_tpu.ops.bbox import bbox_intersects_np
+    from kart_tpu.tiles.clip import clip_quantize
+    from kart_tpu.tiles.grid import tile_cover_wsen
+
+    polar = np.array([[10.0, 88.0, 10.001, 88.001]], dtype=np.float32)
+    # z2 row 0 covers lon 0..90 at x=2: the lat-88 feature must be in it
+    for z, x, y, want in [(2, 2, 0, True), (2, 2, 1, False), (0, 0, 0, True)]:
+        query = np.asarray(tile_query_wsen(z, x, y))
+        hit = bool(bbox_intersects_np(polar, query)[0])
+        if hit:
+            rows, boxes = clip_quantize(polar, np.array([0]), z, x, y)
+            hit = len(rows) == 1
+            if hit:
+                # quantizes onto the tile's top edge (clamped), inside the
+                # buffered square
+                assert -64 <= boxes[0][1] <= 4096 + 64
+        assert hit == want, (z, x, y)
+    # the south pole symmetrically
+    south = np.array([[10.0, -89.0, 10.001, -88.9]], dtype=np.float32)
+    q = np.asarray(tile_query_wsen(1, 1, 1))
+    assert bool(bbox_intersects_np(south, q)[0])
+    w, s, e, n = tile_cover_wsen(1, 1, 1)
+    assert s == -90.0 and n == 0.0
+
+
+def test_tile_range_for_bbox_covers_and_clamps():
+    x0, y0, x1, y1 = tile_range_for_bbox(2, (-10.0, -10.0, 10.0, 10.0))
+    assert (x0, x1) == (1, 2)
+    assert y0 <= 2 <= y1
+    # wrapping/non-finite lon -> full row
+    assert tile_range_for_bbox(1, (170.0, 0.0, -170.0, 10.0))[::2] == (0, 1)
+
+
+# ---------------------------------------------------------------------------
+# the serving path: determinism, cache, pruning
+# ---------------------------------------------------------------------------
+
+
+def test_tile_payload_cold_vs_cached_byte_identical(served_points):
+    repo, ds_path, url = served_points
+    t = f"{url}/api/v1/tiles/HEAD/{ds_path}/2/3/2"
+    s1, h1, cold = http_get(t)
+    s2, h2, cached = http_get(t)
+    assert s1 == s2 == 200
+    assert cold == cached
+    assert h1["ETag"] == h2["ETag"]
+    header, layers = tiles.parse_payload(cold)
+    assert header["count"] > 0
+    assert set(layers) == {"bin", "geojson"}
+    assert counter("tiles.cache.hits") == 1
+    assert counter("tiles.cache.misses") == 1
+
+
+def test_cached_tile_serves_without_touching_the_odb(served_points):
+    """ISSUE 10 acceptance: a cache hit returns memoized bytes — no blob
+    read, no sidecar/envelope page fault (asserted on the counters)."""
+    repo, ds_path, url = served_points
+    t = f"{url}/api/v1/tiles/HEAD/{ds_path}/1/1/1"
+    status, _, cold = http_get(t)
+    assert status == 200
+    blobs_before = counter("odb.blobs_read")
+    blocks_before = counter("tiles.blocks_read")
+    status, _, cached = http_get(t)
+    assert status == 200 and cached == cold
+    assert counter("odb.blobs_read") == blobs_before
+    assert counter("tiles.blocks_read") == blocks_before
+    assert counter("tiles.cache.hits") == 1
+
+
+def test_tile_stable_across_two_server_processes(served_points, tmp_path):
+    """The payload for one (commit, dataset, z/x/y, layers) key is
+    byte-identical between an in-process server and a separate `kart
+    export tiles` process (one wire format, no process-local state)."""
+    repo, ds_path, url = served_points
+    status, _, served = http_get(f"{url}/api/v1/tiles/HEAD/{ds_path}/2/3/2")
+    assert status == 200
+
+    out = tmp_path / "pyramid"
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    env["PYTHONPATH"] = REPO_ROOT + os.pathsep + env.get("PYTHONPATH", "")
+    proc = subprocess.run(
+        [
+            sys.executable, "-m", "kart_tpu.cli",
+            "-C", str(repo.workdir or repo.gitdir),
+            "export", "tiles", "HEAD", "--dataset", ds_path,
+            "--zoom", "2", "-o", str(out),
+        ],
+        env=env, capture_output=True, text=True, timeout=120,
+    )
+    assert proc.returncode == 0, proc.stderr
+    with open(out / "2" / "3" / "2.ktile", "rb") as f:
+        exported = f.read()
+    assert exported == served
+
+
+def test_tile_etag_conditional_get(served_points):
+    repo, ds_path, url = served_points
+    t = f"{url}/api/v1/tiles/HEAD/{ds_path}/0/0/0"
+    status, headers, _ = http_get(t)
+    assert status == 200
+    etag = headers["ETag"]
+    status, headers2, body = http_get(t, headers={"If-None-Match": etag})
+    assert status == 304 and body == b""
+    assert headers2["ETag"] == etag
+    # RFC 9110 forms a revalidating proxy/browser may send (review
+    # finding): validator lists, weak prefixes, and *
+    for value in (f'"zzz", {etag}', f"W/{etag}", "*"):
+        assert http_get(t, headers={"If-None-Match": value})[0] == 304, value
+    assert http_get(t, headers={"If-None-Match": '"zzz"'})[0] == 200
+    # a NEVER-ENCODED tile answers 304 from the key alone (no source
+    # build): compute the validator client-side
+    cold_etag, _ = tiles.tile_etag(repo, "HEAD", ds_path, 3, 6, 4)
+    blobs_before = counter("odb.blobs_read")
+    status, _, body = http_get(
+        f"{url}/api/v1/tiles/HEAD/{ds_path}/3/6/4",
+        headers={"If-None-Match": cold_etag},
+    )
+    assert status == 304 and body == b""
+    assert counter("odb.blobs_read") == blobs_before
+    assert counter("tiles.cache.misses") == 1  # only the initial 0/0/0 GET
+
+
+def test_concurrent_cold_requests_build_one_source(served_points, monkeypatch):
+    """Review finding: concurrent cold requests for DIFFERENT tiles of one
+    commit must construct ONE TileSource (the O(N) sidecar/envelope build
+    is per revision, not per request) — source_for single-flights."""
+    import time as _time
+
+    from kart_tpu.tiles import source as source_mod
+
+    repo, ds_path, url = served_points
+    source_mod.drop_sources()
+    builds = []
+    real_init = source_mod.TileSource.__init__
+
+    def counting_init(self, *args, **kwargs):
+        builds.append(threading.get_ident())
+        _time.sleep(0.2)  # hold the build open so the others provably race
+        real_init(self, *args, **kwargs)
+
+    monkeypatch.setattr(source_mod.TileSource, "__init__", counting_init)
+    results = []
+
+    def get(z, x, y):
+        results.append(
+            http_get(f"{url}/api/v1/tiles/HEAD/{ds_path}/{z}/{x}/{y}")[0]
+        )
+
+    threads = [
+        threading.Thread(target=get, args=a)
+        for a in [(1, 1, 1), (2, 3, 2), (0, 0, 0)]
+    ]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert results == [200, 200, 200]
+    assert len(builds) == 1, f"{len(builds)} TileSource builds for one commit"
+
+
+def test_tile_of_pinned_commit_survives_ref_update(served_points):
+    """Keys are commit-addressed: after HEAD moves, the old commit's tile
+    is still servable by oid and is byte-identical; HEAD's tile changes."""
+    repo, ds_path, url = served_points
+    old_oid = repo.head_commit_oid
+    _, _, old_head = http_get(f"{url}/api/v1/tiles/HEAD/{ds_path}/0/0/0")
+    edit_commit(repo, ds_path, deletes=[1], message="move HEAD")
+    _, _, by_oid = http_get(f"{url}/api/v1/tiles/{old_oid}/{ds_path}/0/0/0")
+    assert by_oid == old_head
+    _, _, new_head = http_get(f"{url}/api/v1/tiles/HEAD/{ds_path}/0/0/0")
+    h_old, _ = tiles.parse_payload(old_head)
+    h_new, _ = tiles.parse_payload(new_head)
+    assert h_new["commit"] != h_old["commit"]
+    assert h_new["count"] == h_old["count"] - 1
+
+
+def test_ref_update_drop_hook_releases_tile_cache(served_points):
+    """The explicit drop hook next to apply_ref_updates: a ref update
+    empties the tile cache (memory hygiene — keys can't go stale, but
+    tiles of abandoned commits are dead weight)."""
+    from kart_tpu.tiles.cache import tile_cache_for
+    from kart_tpu.transport.service import apply_ref_updates
+
+    repo, ds_path, url = served_points
+    status, _, _ = http_get(f"{url}/api/v1/tiles/HEAD/{ds_path}/0/0/0")
+    assert status == 200
+    assert tile_cache_for(repo).stats()["entries"] == 1
+    head = repo.head_commit_oid
+    result = apply_ref_updates(
+        repo,
+        {"updates": [{"ref": "refs/heads/tmp", "old": None, "new": head}]},
+    )
+    assert result[0] == "ok"
+    assert tile_cache_for(repo).stats() == {"entries": 0, "bytes": 0}
+
+
+def test_concurrent_same_tile_single_flights(served_points, monkeypatch):
+    """Two concurrent requests for one cold tile run ONE encode: the
+    second blocks on the first's fill and hits."""
+    import time as _time
+
+    repo, ds_path, url = served_points
+    real_encode = tiles.encode_tile
+    started = threading.Event()
+
+    def slow_encode(*args, **kwargs):
+        started.set()
+        _time.sleep(0.3)
+        return real_encode(*args, **kwargs)
+
+    monkeypatch.setattr("kart_tpu.tiles.encode_tile", slow_encode)
+    results = []
+
+    def get():
+        results.append(http_get(f"{url}/api/v1/tiles/HEAD/{ds_path}/1/1/1"))
+
+    t1 = threading.Thread(target=get)
+    t1.start()
+    started.wait(5)
+    t2 = threading.Thread(target=get)
+    t2.start()
+    t1.join()
+    t2.join()
+    assert [s for s, _, _ in results] == [200, 200]
+    assert results[0][2] == results[1][2]
+    assert counter("tiles.cache.misses") == 1
+    assert counter("tiles.cache.hits") == 1
+    assert counter("tiles.cache.singleflight_waits") == 1
+
+
+def test_block_pruning_faults_only_boundary_and_in_blocks(synth_spatial):
+    """ISSUE 10 acceptance (small-scale twin of the bench assertion): a
+    tile over the 200k-row synth layer classifies the sidecar's ~49
+    envelope blocks and reads only the boundary/in survivors — and the
+    pruned selection is row-identical to the unpruned full scan."""
+    from kart_tpu.ops.bbox import bbox_intersects_np
+
+    repo, info = synth_spatial
+    src = tiles.source_for(
+        repo, tiles.resolve_tile_commit(repo, "HEAD"), "synth"
+    )
+    query = tile_query_wsen(4, 3, 5)
+    rows, stats = src.rows_for_bbox(query)
+    assert stats["blocks_total"] == -(-200_000 // 4096)
+    assert stats["blocks_read"] < stats["blocks_total"] // 2
+    assert stats["blocks_pruned"] + stats["blocks_read"] == stats["blocks_total"]
+    # parity: pruned == unpruned full scan
+    full = np.flatnonzero(
+        bbox_intersects_np(np.asarray(src.envelopes()), np.asarray(query))
+    )
+    assert np.array_equal(rows, full)
+
+
+def test_bin_layer_serves_from_promised_blobs(synth_spatial):
+    """The columnar layer needs zero blob reads — it serves a partial
+    clone (promised blobs); the geojson layer correctly refuses."""
+    repo, info = synth_spatial
+    payload, _, _ = tiles.serve_tile(repo, "HEAD", "synth", 3, 4, 3,
+                                     layers="bin")
+    header, layers = tiles.parse_payload(payload)
+    assert header["count"] > 0
+    keys, boxes = tiles.decode_bin_layer(layers["bin"])
+    assert len(keys) == header["count"] == len(boxes)
+    assert list(keys) == sorted(keys)  # ascending identity order
+    assert boxes.dtype == np.int32
+    with pytest.raises(tiles.TileDataUnavailable):
+        tiles.serve_tile(repo, "HEAD", "synth", 3, 4, 3, layers="geojson")
+
+
+def test_non_spatial_dataset_rejected(tmp_path):
+    from kart_tpu.synth import synth_repo
+
+    repo, _ = synth_repo(str(tmp_path / "r"), 100, spatial=False)
+    with pytest.raises(tiles.TileSourceError, match="geometry"):
+        tiles.serve_tile(repo, "HEAD", "synth", 0, 0, 0, layers="bin")
+
+
+def test_unknown_dataset_and_bad_address_reported(served_points):
+    repo, ds_path, url = served_points
+    assert http_get(f"{url}/api/v1/tiles/HEAD/nope/0/0/0")[0] == 404
+    assert http_get(f"{url}/api/v1/tiles/HEAD/{ds_path}/1/5/0")[0] == 400
+    assert http_get(f"{url}/api/v1/tiles/HEAD/{ds_path}/0/0")[0] == 400
+    status, _, body = http_get(
+        f"{url}/api/v1/tiles/HEAD/{ds_path}/0/0/0?layers=nope"
+    )
+    assert status == 400 and b"Unknown tile layer" in body
+
+
+def test_max_features_ceiling_413(served_points, monkeypatch):
+    monkeypatch.setenv("KART_TILE_MAX_FEATURES", "5")
+    repo, ds_path, url = served_points
+    status, _, body = http_get(f"{url}/api/v1/tiles/HEAD/{ds_path}/0/0/0")
+    assert status == 413
+    payload = json.loads(body)
+    assert payload["limit"] == 5 and payload["count"] > 5
+
+
+def test_tiles_endpoint_disabled_by_env(served_points, monkeypatch):
+    monkeypatch.setenv("KART_SERVE_TILES", "0")
+    repo, ds_path, url = served_points
+    status, _, body = http_get(f"{url}/api/v1/tiles/HEAD/{ds_path}/0/0/0")
+    assert status == 404 and b"disabled" in body
+
+
+# ---------------------------------------------------------------------------
+# shed semantics (ISSUE 10 satellite): tiles ARE shed, stats is not
+# ---------------------------------------------------------------------------
+
+
+def test_shed_tile_request_carries_retry_after(served_points, monkeypatch):
+    """Regression: /api/v1/stats gained never-shed status in PR 7 — the
+    tiles endpoint has the opposite, explicit semantics: a shed tile
+    request is a 429 WITH Retry-After."""
+    repo, ds_path, url = served_points
+    monkeypatch.setenv("KART_SERVE_RETRY_AFTER", "7")
+    monkeypatch.setenv("KART_FAULTS", "server.shed:1")
+    status, headers, _ = http_get(f"{url}/api/v1/tiles/HEAD/{ds_path}/0/0/0")
+    assert status == 429
+    assert headers["Retry-After"] == "7"
+    # stats stays never-shed even with the shed fault re-armed
+    monkeypatch.setenv("KART_FAULTS", "server.shed:1")
+    status, _, _ = http_get(f"{url}/api/v1/stats")
+    assert status == 200
+
+
+# ---------------------------------------------------------------------------
+# parity: the tile's features == the spatial-filtered reference path
+# ---------------------------------------------------------------------------
+
+
+def _reference_pks(repo, ds_path, z, x, y):
+    """The reference feature set for a tile: a spatial-filtered
+    diff-against-empty at the same commit, clipped to the tile bbox —
+    every delta the full-fidelity path emits inside the rectangle."""
+    from kart_tpu.diff.engine import get_dataset_diff
+    from kart_tpu.spatial_filter import ResolvedSpatialFilterSpec
+
+    w, s, e, n = tile_bounds_wsen(z, x, y)
+    spec = ResolvedSpatialFilterSpec.from_spec_string(
+        f"EPSG:4326;POLYGON(({w} {s},{e} {s},{e} {n},{w} {n},{w} {s}))"
+    )
+    rs = repo.structure("HEAD")
+    ds = rs.datasets[ds_path]
+    sf = spec.resolve_for_dataset(ds)
+    diff = get_dataset_diff(None, rs, ds_path)
+    return {
+        delta.new_key
+        for delta in diff["feature"].values()
+        if sf.matches(delta.new_value)
+    }
+
+
+@pytest.mark.parametrize("tile", [(0, 0, 0), (2, 3, 2), (5, 24, 19), (5, 25, 19)])
+def test_tile_features_match_spatial_filtered_reference(served_points, tile):
+    """ISSUE 10 satellite: every feature a tile emits matches the
+    reference path (point data, so envelope precision == exact
+    precision), in both layers, and the geojson lines parse to the
+    committed feature values."""
+    repo, ds_path, url = served_points
+    z, x, y = tile
+    status, _, payload = http_get(
+        f"{url}/api/v1/tiles/HEAD/{ds_path}/{z}/{x}/{y}"
+    )
+    assert status == 200
+    header, layers = tiles.parse_payload(payload)
+    keys, _boxes = tiles.decode_bin_layer(layers["bin"])
+    expected = _reference_pks(repo, ds_path, z, x, y)
+    assert set(int(k) for k in keys) == expected
+
+    lines = layers["geojson"].decode().splitlines()
+    assert len(lines) == header["count"] == len(keys)
+    ds = repo.structure("HEAD").datasets[ds_path]
+    for key, line in zip(keys, lines):
+        feature = json.loads(line)
+        assert feature["fid"] == int(key)
+        committed = ds.get_feature([int(key)])
+        assert feature["name"] == committed["name"]
+        assert feature["rating"] == committed["rating"]
+
+
+def test_pyramid_export_writes_every_nonempty_tile(served_points, tmp_path):
+    from kart_tpu.tiles.pyramid import export_pyramid
+
+    repo, ds_path, url = served_points
+    src = tiles.source_for(
+        repo, tiles.resolve_tile_commit(repo, "HEAD"), ds_path
+    )
+    stats = export_pyramid(src, [0, 1, 2], str(tmp_path / "out"))
+    # all 40 points live in one lon/lat cluster: exactly one tile per zoom
+    assert stats["tiles_written"] == 3
+    assert stats["features_out"] == 40 * 3
+    for z, x, y in [(0, 0, 0), (2, 3, 2)]:
+        with open(tmp_path / "out" / str(z) / str(x) / f"{y}.ktile", "rb") as f:
+            header, _ = tiles.parse_payload(f.read())
+        assert header["count"] == 40
