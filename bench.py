@@ -654,6 +654,12 @@ def _lint_bench():
             "lint_rules_total": len(report.rules),
             "lint_files_scanned": report.files_scanned,
             "lint_findings_total": len(report.findings),
+            # ISSUE 11: the slowest single rule's wall-clock — keeps the
+            # <5s bound attributable now that the rule count has doubled
+            # (the interprocedural KTL010 family is the expected leader)
+            "lint_rule_seconds_max": round(
+                max(report.rule_seconds.values(), default=0.0), 3
+            ),
         }
     except Exception as e:
         print(f"lint bench failed: {e}", file=sys.stderr)

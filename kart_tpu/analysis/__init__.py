@@ -8,9 +8,17 @@ cross-cutting contracts (docs/ANALYSIS.md):
     KTL005  thread/fork safety   locked global writes; guarded forks
     KTL006  exception hygiene    no bare/silent swallows, ^C survives
     KTL007  bench-key drift      bench.py record keys <-> schema guard
+    KTL010  lock-order inversion interprocedural lock graph stays acyclic
+    KTL011  blocking under lock  no subprocess/socket/fsync/sleep held
+    KTL012  atomic publication   shared state assigned once, never filled
+    KTL013  fill-token lifecycle single-flight tokens abandon on every path
+    KTL014  cache coverage       byte-budgeted caches <-> CACHES registry
+    KTL020  device trace purity  no host effects inside jit/shard_map
+    KTL021  device fallback seam jax only behind select_backend & friends
 
-Entry points: ``kart lint [PATHS]`` and ``python -m kart_tpu.analysis``.
-Programmatic: :func:`run_lint` -> :class:`Report`.
+Entry points: ``kart lint [PATHS] [--changed [REF]] [-o text|json|sarif]``
+and ``python -m kart_tpu.analysis``. Programmatic: :func:`run_lint` ->
+:class:`Report`.
 """
 
 from kart_tpu.analysis.core import (  # noqa: F401
@@ -18,6 +26,7 @@ from kart_tpu.analysis.core import (  # noqa: F401
     Report,
     Rule,
     all_rule_classes,
+    changed_targets,
     default_targets,
     repo_root,
     rule_catalogue,
@@ -26,5 +35,6 @@ from kart_tpu.analysis.core import (  # noqa: F401
 from kart_tpu.analysis.reporters import (  # noqa: F401
     JSON_SCHEMA_VERSION,
     to_json,
+    to_sarif,
     to_text,
 )

@@ -1,26 +1,46 @@
-"""``python -m kart_tpu.analysis [PATHS...] [--format=json]`` — the
-CI-friendly entry point (no click dependency; exit 0 = clean)."""
+"""``python -m kart_tpu.analysis [PATHS...] [--format=json|sarif]
+[--changed [REF]]`` — the CI-friendly entry point (no click dependency;
+exit 0 = clean)."""
 
 import sys
 
 from kart_tpu import analysis
+
+_FORMATS = ("text", "json", "sarif")
 
 
 def main(argv=None):
     argv = list(sys.argv[1:] if argv is None else argv)
     fmt = "text"
     paths = []
-    it = iter(argv)
-    for arg in it:
-        if arg in ("--format=json", "--json"):
-            fmt = "json"
-        elif arg in ("--format=text",):
-            fmt = "text"
-        elif arg in ("-o", "--format"):  # same spelling as `kart lint -o`
-            fmt = next(it, "text")
-            if fmt not in ("text", "json"):
+    changed_ref = None
+    i = 0
+    while i < len(argv):
+        arg = argv[i]
+        if arg.startswith("--format="):
+            fmt = arg.split("=", 1)[1]
+            if fmt not in _FORMATS:
                 print(f"unknown format {fmt!r}", file=sys.stderr)
                 return 2
+        elif arg == "--json":
+            fmt = "json"
+        elif arg in ("-o", "--format"):  # same spelling as `kart lint -o`
+            i += 1
+            fmt = argv[i] if i < len(argv) else "text"
+            if fmt not in _FORMATS:
+                print(f"unknown format {fmt!r}", file=sys.stderr)
+                return 2
+        elif arg == "--changed":
+            # `--changed REF` and bare `--changed` (= HEAD), matching the
+            # click CLI; PATHS are mutually exclusive with --changed, so
+            # consuming the next non-option token as the ref is unambiguous
+            if i + 1 < len(argv) and not argv[i + 1].startswith("-"):
+                i += 1
+                changed_ref = argv[i]
+            else:
+                changed_ref = "HEAD"
+        elif arg.startswith("--changed="):
+            changed_ref = arg.split("=", 1)[1] or "HEAD"
         elif arg == "--rules":
             for r in analysis.rule_catalogue():
                 print(f"{r['id']}  {r['name']}: {r['description']}")
@@ -30,9 +50,26 @@ def main(argv=None):
             return 2
         else:
             paths.append(arg)
-    report = analysis.run_lint(paths or None)
+        i += 1
+    if changed_ref is not None:
+        if paths:
+            print("--changed and PATHS are mutually exclusive", file=sys.stderr)
+            return 2
+        try:
+            targets = analysis.changed_targets(ref=changed_ref)
+        except ValueError as e:
+            print(str(e), file=sys.stderr)
+            return 2
+        report = analysis.run_lint(targets)
+        if not targets and fmt == "text":
+            print(f"ok: no lint targets changed vs {changed_ref}")
+            return 0
+    else:
+        report = analysis.run_lint(paths or None)
     if fmt == "json":
         print(analysis.to_json(report, indent=2))
+    elif fmt == "sarif":
+        print(analysis.to_sarif(report, indent=2))
     else:
         print(analysis.to_text(report))
     return 0 if report.ok else 1

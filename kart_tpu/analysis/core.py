@@ -170,7 +170,11 @@ def register(cls):
 
 
 def all_rule_classes():
-    from kart_tpu.analysis import rules as _rules  # noqa: F401 - registers
+    # importing registers (KTL001-007, then the ISSUE 11 concurrency and
+    # device families — catalogue order is registration order)
+    from kart_tpu.analysis import rules  # noqa: F401
+    from kart_tpu.analysis import rules_concurrency  # noqa: F401
+    from kart_tpu.analysis import rules_device  # noqa: F401
 
     return list(_RULE_CLASSES)
 
@@ -241,11 +245,15 @@ def _expand(paths, root):
 
 
 class Report:
-    def __init__(self, findings, scanned, rules):
+    def __init__(self, findings, scanned, rules, rule_seconds=None):
         self.findings = sorted(findings, key=Finding.sort_key)
         self.scanned = list(scanned)  # repo-relative paths actually parsed
         self.files_scanned = len(self.scanned)
         self.rules = rules  # catalogue dicts
+        # per-rule wall-clock (visit_file sums + finalize), so the <5s
+        # tier-1 bound stays attributable as the rule count grows; shared
+        # lazy model builds bill to whichever rule touches them first
+        self.rule_seconds = dict(rule_seconds or {})
 
     @property
     def ok(self):
@@ -279,14 +287,21 @@ def run_lint(paths=None, root=None):
         PARSE_RULE_ID,
     }
 
+    import time
+
     raw = []
+    rule_seconds = {rule.id: 0.0 for rule in rules}
     for ctx in contexts:
         for rule in rules:
+            t0 = time.perf_counter()
             raw.extend(rule.visit_file(ctx))
+            rule_seconds[rule.id] += time.perf_counter() - t0
     if full:
         project = Project(root, contexts, full)
         for rule in rules:
+            t0 = time.perf_counter()
             raw.extend(rule.finalize(project))
+            rule_seconds[rule.id] += time.perf_counter() - t0
 
     # suppression pass: a finding on a line whose noqa lists its rule id
     # is dropped; a missing rationale doesn't resurrect it but does raise
@@ -331,7 +346,45 @@ def run_lint(paths=None, root=None):
                     )
                 )
 
-    return Report(findings, (c.rel for c in contexts), rule_catalogue())
+    return Report(
+        findings, (c.rel for c in contexts), rule_catalogue(), rule_seconds
+    )
+
+
+def changed_targets(root=None, ref="HEAD"):
+    """Lint targets touched vs a git ref (`kart lint --changed`): changed
+    or untracked .py files that belong to the default target set. -> list
+    of absolute paths (may be empty: nothing relevant changed)."""
+    import subprocess
+
+    root = root or repo_root()
+    cmd = ["git", "-C", root, "diff", "--name-only", "-z", ref, "--"]
+    diff_proc = subprocess.run(cmd, capture_output=True, text=True)
+    if diff_proc.returncode != 0:
+        # a bad ref must be a named error, not a traceback (and never a
+        # silently-empty "nothing changed" scan)
+        raise ValueError(
+            f"cannot diff against {ref!r}: "
+            + (diff_proc.stderr.strip() or "git diff failed")
+        )
+    diff = diff_proc.stdout
+    untracked = subprocess.run(
+        ["git", "-C", root, "ls-files", "--others", "--exclude-standard", "-z"],
+        capture_output=True,
+        text=True,
+        check=True,
+    ).stdout
+    names = {n for n in (diff + untracked).split("\0") if n}
+    out = []
+    for rel in sorted(names):
+        if not rel.endswith(".py"):
+            continue
+        if not (rel.startswith("kart_tpu/") or rel == "bench.py"):
+            continue
+        path = os.path.join(root, rel)
+        if os.path.exists(path):  # deleted files have nothing to lint
+            out.append(path)
+    return out
 
 
 # -- shared AST helpers used by the rules -----------------------------------

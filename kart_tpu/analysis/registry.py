@@ -144,6 +144,177 @@ FAULT_TESTS = "tests/test_faults.py"
 GC_SWEEP_RE = re.compile(r"(\.(tmp|lock)\d*$)|(^\.tmp-)")
 
 # ---------------------------------------------------------------------------
+# KTL011 — deliberate blocking-under-lock sections
+# ---------------------------------------------------------------------------
+
+#: functions whose lock-held region *intentionally* contains blocking work
+#: (coarse serialisation locks): "rel::qualname" -> rationale. KTL011 skips
+#: findings inside these bodies but still requires the entry to name a live
+#: function — a stale entry is itself a finding. Prefer a narrower lock
+#: over a new entry here.
+BLOCKING_ALLOW = {
+    "kart_tpu/core/odb.py::ObjectDb.bulk_pack": (
+        "the bulk-pack lock IS the serialisation: one _bulk_writer slot, so "
+        "concurrent pushes must block for the whole pack write (fdatasync "
+        "and flusher join included) instead of interleaving objects into "
+        "each other's packs"
+    ),
+    "kart_tpu/transport/service.py::_land_quarantined": (
+        "the push critical section deliberately holds the thread+file push "
+        "locks across quarantine migrate and ref CAS — releasing mid-way is "
+        "exactly the torn-push window PR 2/PR 8 closed"
+    ),
+    "kart_tpu/transport/service.py::locked_ref_updates": (
+        "the back-compat push entry point: ref validation + apply must run "
+        "as one unit under the cross-process push lock, same section the "
+        "quarantine path holds (docs/SERVING.md §6)"
+    ),
+    "kart_tpu/tiles/source.py::TileSource.envelopes": (
+        "the envelope-fallback build intentionally runs its O(N) blob scan "
+        "under the per-source lock: concurrent envelope callers for one "
+        "commit must block on the one build rather than each paying it "
+        "(docs/TILES.md §2); tile requests for other commits use other "
+        "TileSource instances and other locks"
+    ),
+}
+
+# ---------------------------------------------------------------------------
+# KTL014 — the byte-budgeted cache surface and its invalidation contract
+# ---------------------------------------------------------------------------
+
+#: every byte-budgeted cache in the serving path. Keys are the telemetry-
+#: style cache names; each entry declares where the cache lives, the
+#: LRU-shaped module global registering instances, the key-builder whose
+#: source must reference a commit-/ref-pinning token (commit-addressed
+#: keys are the invalidation-by-construction half of the contract), and
+#: the drop hook `_apply_validated_updates` must call on a ref update —
+#: or, when no drop is needed, a written rationale. KTL014 cross-checks
+#: all of this in both directions (code <-> registry), like KTL001/KTL003.
+CACHES = {
+    "server.enum_cache": {
+        "module": "kart_tpu/transport/service.py",
+        "cls": "PackEnumCache",
+        "registry_global": "_ENUM_CACHES",
+        "key_fn": "_enum_cache_key",
+        "key_tokens": ("refs_fingerprint",),
+        "ref_drop": "invalidate",
+    },
+    "tiles.cache": {
+        "module": "kart_tpu/tiles/cache.py",
+        "cls": "TileCache",
+        "registry_global": "_TILE_CACHES",
+        "key_fn": "tile_key",
+        "key_tokens": ("commit_oid",),
+        "ref_drop": "invalidate_tile_caches",
+    },
+    "tiles.source": {
+        "module": "kart_tpu/tiles/source.py",
+        "cls": None,  # plain commit-keyed LRU, not a SingleFlightLRU
+        "registry_global": "_SOURCES",
+        "key_fn": "source_for",
+        "key_tokens": ("commit_oid",),
+        "ref_drop": None,
+        "ref_drop_rationale": (
+            "source keys pin (gitdir, commit oid, dataset) and a commit's "
+            "blocks never change, so a ref move cannot stale them; the LRU "
+            "bound alone reclaims memory (docs/TILES.md §3)"
+        ),
+    },
+}
+
+#: where every ref update funnels; the declared ``ref_drop`` hooks above
+#: must be invoked inside this function's body.
+REF_UPDATE_HOOK = ("kart_tpu/transport/service.py", "_apply_validated_updates")
+
+#: LRU-shaped module globals (OrderedDict + popitem eviction) that are NOT
+#: commit-addressed data caches and therefore owe no invalidation drop:
+#: "rel::NAME" -> rationale. A stale entry is a finding.
+CACHE_EXEMPT_GLOBALS = {
+    "kart_tpu/transport/service.py::_MERGE_QUEUES": (
+        "a registry of per-ref FIFO queues, not cached data: correctness "
+        "lives with push_file_lock; eviction only unlinks idle queues"
+    ),
+}
+
+# ---------------------------------------------------------------------------
+# KTL020/KTL021 — the device execution surface
+# ---------------------------------------------------------------------------
+
+#: the only files allowed to import jax (always lazily, inside functions —
+#: KTL021 flags module-top-level jax imports even here: `import jax` costs
+#: ~1.8s and the CLI's small-repo paths must never pay it). bench.py
+#: deliberately drives devices directly for the --multichip sweep.
+DEVICE_MODULES = frozenset(
+    {
+        "kart_tpu/diff/backend.py",
+        "kart_tpu/diff/device_batch.py",
+        "kart_tpu/ops/_lazy.py",
+        "kart_tpu/ops/bbox.py",
+        "kart_tpu/ops/diff_kernel.py",
+        "kart_tpu/ops/merge_kernel.py",
+        "kart_tpu/parallel/__init__.py",
+        "kart_tpu/parallel/mesh.py",
+        "kart_tpu/parallel/sharded_diff.py",
+        "kart_tpu/parallel/sharded_merge.py",
+        "kart_tpu/runtime.py",
+        "bench.py",
+    }
+)
+
+#: the fallback seam: the only names non-device modules may import from a
+#: device module. Every entry either routes through an internal cost model
+#: with a host fallback, is a host-only helper (numpy twins, constants),
+#: or is device-independent plumbing. KTL021 checks both directions: an
+#: import outside this list is a finding, and so is a listed name its
+#: module no longer defines.
+DEVICE_SEAMS = {
+    "kart_tpu/diff/backend.py": frozenset(
+        {"select_backend", "warm_probe"}
+    ),
+    "kart_tpu/ops/bbox.py": frozenset(
+        {
+            # bbox_intersects guards with jax_ready() and falls back to the
+            # native/numpy host scan; *_np names are the host twins
+            "bbox_intersects",
+            "bbox_intersects_np",
+            "bbox_blocks_np",
+            "classify_env_blocks_np",
+            "BLOCK_ALL_IN",
+            "BLOCK_ALL_OUT",
+        }
+    ),
+    "kart_tpu/ops/diff_kernel.py": frozenset(
+        {
+            # classify_blocks owns cost-model routing + host fallback;
+            # changed_indices is pure numpy; the rest are class constants
+            "classify_blocks",
+            "changed_indices",
+            "DELETE",
+            "INSERT",
+            "UPDATE",
+        }
+    ),
+    "kart_tpu/ops/merge_kernel.py": frozenset(
+        {
+            # merge_classify: sharded -> streamed -> monolithic -> host
+            # fallback ladder inside the function
+            "merge_classify",
+            "CONFLICT",
+            "KEEP_OURS",
+            "TAKE_THEIRS",
+        }
+    ),
+    "kart_tpu/runtime.py": frozenset(
+        {
+            # Watchdog is device-independent timeout machinery; the probe
+            # invalidation hook backs `kart --reprobe`
+            "Watchdog",
+            "invalidate_probe_cache",
+        }
+    ),
+}
+
+# ---------------------------------------------------------------------------
 # KTL007 — bench record keys and where they must be asserted
 # ---------------------------------------------------------------------------
 

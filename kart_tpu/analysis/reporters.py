@@ -1,10 +1,11 @@
-"""Finding reporters: human text and a stable JSON schema for external CI.
+"""Finding reporters: human text, a stable JSON schema, and SARIF 2.1.0
+for standard CI viewers.
 
-The JSON document shape (``kart lint --format=json``) is a public,
-versioned contract — tests/test_analysis.py pins it::
+The JSON document shape (``kart lint -o json``) is a public, versioned
+contract — tests/test_analysis.py pins it::
 
     {
-      "version": 1,
+      "version": 2,
       "ok": true|false,
       "files_scanned": <int>,
       "rules": [{"id": "KTL001", "name": "...", "description": "..."}, ...],
@@ -12,16 +13,34 @@ versioned contract — tests/test_analysis.py pins it::
         {"rule": "KTL004", "path": "kart_tpu/x.py", "line": 10,
          "col": 4, "message": "..."},
         ...
-      ]
+      ],
+      "timings": {"total_seconds": <float>,
+                  "rules": {"KTL001": <float>, ...}}
     }
 
 Findings are sorted by (path, line, col, rule); ``version`` only changes
-with a breaking shape change.
+with a breaking shape change (v1 -> v2 added ``timings``, ISSUE 11 — the
+per-rule wall-clock that keeps the <5s tier-1 bound attributable).
+
+The SARIF document (``kart lint -o sarif``) targets the 2.1.0 schema so
+findings annotate PRs in any SARIF-aware CI viewer; its shape is pinned by
+the golden file tests/golden/lint/expected.sarif.json.
 """
 
 import json
 
-JSON_SCHEMA_VERSION = 1
+JSON_SCHEMA_VERSION = 2
+
+SARIF_SCHEMA = "https://json.schemastore.org/sarif-2.1.0.json"
+SARIF_VERSION = "2.1.0"
+
+
+def _timings(report):
+    rules = {k: round(v, 4) for k, v in sorted(report.rule_seconds.items())}
+    return {
+        "total_seconds": round(sum(report.rule_seconds.values()), 4),
+        "rules": rules,
+    }
 
 
 def to_json(report, indent=None):
@@ -32,6 +51,7 @@ def to_json(report, indent=None):
             "files_scanned": report.files_scanned,
             "rules": report.rules,
             "findings": [f.to_dict() for f in report.findings],
+            "timings": _timings(report),
         },
         indent=indent,
     )
@@ -42,9 +62,70 @@ def to_text(report):
     for f in report.findings:
         lines.append(f"{f.path}:{f.line}:{f.col}: {f.rule} {f.message}")
     n = len(report.findings)
-    lines.append(
+    summary = (
         f"{'ok' if report.ok else 'FAIL'}: {n} finding(s) across "
         f"{report.files_scanned} file(s), "
         f"{len(report.rules)} rules active"
     )
+    if report.rule_seconds:
+        slowest = max(report.rule_seconds.items(), key=lambda kv: kv[1])
+        summary += (
+            f" ({sum(report.rule_seconds.values()):.2f}s; slowest rule "
+            f"{slowest[0]} {slowest[1]:.2f}s)"
+        )
+    lines.append(summary)
     return "\n".join(lines)
+
+
+def to_sarif(report, indent=None):
+    """SARIF 2.1.0 (one run, one driver). Paths are repo-relative URIs
+    under the SRCROOT base; columns are 1-indexed per the spec."""
+    rules = [
+        {
+            "id": r["id"],
+            "name": r["name"],
+            "shortDescription": {"text": r["description"]},
+        }
+        for r in report.rules
+    ]
+    results = [
+        {
+            "ruleId": f.rule,
+            "level": "error",
+            "message": {"text": f.message},
+            "locations": [
+                {
+                    "physicalLocation": {
+                        "artifactLocation": {
+                            "uri": f.path,
+                            "uriBaseId": "SRCROOT",
+                        },
+                        "region": {
+                            "startLine": f.line,
+                            "startColumn": f.col + 1,
+                        },
+                    }
+                }
+            ],
+        }
+        for f in report.findings
+    ]
+    doc = {
+        "$schema": SARIF_SCHEMA,
+        "version": SARIF_VERSION,
+        "runs": [
+            {
+                "tool": {
+                    "driver": {
+                        "name": "kart-lint",
+                        "informationUri": "docs/ANALYSIS.md",
+                        "rules": rules,
+                    }
+                },
+                "originalUriBaseIds": {"SRCROOT": {"uri": "file:///./"}},
+                "results": results,
+                "columnKind": "unicodeCodePoints",
+            }
+        ],
+    }
+    return json.dumps(doc, indent=indent)

@@ -9,7 +9,7 @@ import json
 import os
 import re
 
-from kart_tpu.analysis import registry
+from kart_tpu.analysis import interproc, registry
 from kart_tpu.analysis.core import (
     Rule,
     dotted_name,
@@ -669,13 +669,9 @@ def _own_scope_walk(fn):
             stack.extend(ast.iter_child_nodes(node))
 
 
-_SUBMITTERS = frozenset(
-    {"submit", "map", "imap", "imap_unordered", "apply_async", "starmap"}
-)
-_MUTATORS = frozenset(
-    {"append", "add", "update", "setdefault", "extend", "clear", "pop",
-     "insert", "popitem", "discard", "remove"}
-)
+# the thread-entry / mutation / lock-ish notions are shared with the
+# KTL010-KTL012 interprocedural family — one definition each, in
+# kart_tpu.analysis.interproc
 
 
 @register
@@ -692,7 +688,9 @@ class ThreadForkSafety(Rule):
     def visit_file(self, ctx):
         findings = []
         mutables = self._module_mutables(ctx.tree)
-        entry_names = self._entry_point_names(ctx.tree)
+        entry_names = interproc.thread_entry_functions(
+            interproc.file_summary(ctx)
+        )
         defs = {}
         for node in ctx.nodes:
             if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
@@ -724,52 +722,12 @@ class ThreadForkSafety(Rule):
                         out.add(t.id)
         return out
 
-    def _entry_point_names(self, tree):
-        """Function names handed to Thread/Process targets, executor
-        submits, pool maps, or worker initializers."""
-        names = set()
-        for node in ast.walk(tree):
-            if not isinstance(node, ast.Call):
-                continue
-            fn = (dotted_name(node.func) or "").rsplit(".", 1)[-1]
-            if fn in ("Thread", "Process", "Timer"):
-                for kw in node.keywords:
-                    if kw.arg == "target" and isinstance(kw.value, ast.Name):
-                        names.add(kw.value.id)
-            if (
-                isinstance(node.func, ast.Attribute)
-                and node.func.attr in _SUBMITTERS
-                and node.args
-                and isinstance(node.args[0], ast.Name)
-            ):
-                names.add(node.args[0].id)
-            for kw in node.keywords:
-                if kw.arg == "initializer" and isinstance(
-                    kw.value, ast.Name
-                ):
-                    names.add(kw.value.id)
-        return names
-
-    _LOCKISH = re.compile(r"^(r?lock|.*_lock|lock_.*|.*mutex.*|.*semaphore.*)$")
-
     def _locked(self, ctx, node):
         """Is ``node`` lexically under a ``with <something lock-ish>``?
-        Lock-ish = an identifier *named* like a lock (lock, _lock,
-        probe_lock, RLock(), a mutex/semaphore) — not any word merely
-        containing the letters (``blocker``, ``clock``)."""
-        parents = ctx.parents
-        cur = parents.get(node)
-        while cur is not None:
-            if isinstance(cur, ast.With):
-                for item in cur.items:
-                    idents = re.findall(
-                        r"[A-Za-z_][A-Za-z0-9_]*",
-                        unparse(item.context_expr),
-                    )
-                    if any(self._LOCKISH.match(i.lower()) for i in idents):
-                        return True
-            cur = parents.get(cur)
-        return False
+        (The shared interproc notion: an identifier *named* like a lock —
+        lock, _lock, probe_lock, a mutex/semaphore — not any word merely
+        containing the letters, like ``blocker`` or ``clock``.)"""
+        return interproc.under_lockish_with(ctx, node)
 
     def _check_entry(self, ctx, fn, mutables):
         findings = []
@@ -817,7 +775,7 @@ class ThreadForkSafety(Rule):
             elif (
                 isinstance(node, ast.Call)
                 and isinstance(node.func, ast.Attribute)
-                and node.func.attr in _MUTATORS
+                and node.func.attr in interproc.MUTATORS
                 and isinstance(node.func.value, ast.Name)
                 and node.func.value.id in mutables
             ):

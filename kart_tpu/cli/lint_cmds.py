@@ -2,7 +2,9 @@
 
 With no PATHS: the full tree (kart_tpu/ + bench.py) including the
 cross-file registry round-trip checks; with PATHS (files or directories):
-per-file checks only — the fast pre-commit mode. Exit 0 = clean."""
+per-file checks only — the fast pre-commit mode. ``--changed [REF]`` lints
+only files touched vs a git ref (default HEAD) — the diff-driven CI entry
+point. Exit 0 = clean."""
 
 import click
 
@@ -15,9 +17,18 @@ from kart_tpu.cli import cli
     "-o",
     "--format",
     "fmt",
-    type=click.Choice(["text", "json"]),
+    type=click.Choice(["text", "json", "sarif"]),
     default="text",
-    help="Output format (json is a stable schema for external CI)",
+    help="Output format (json and sarif are stable schemas for external CI)",
+)
+@click.option(
+    "--changed",
+    "changed_ref",
+    is_flag=False,
+    flag_value="HEAD",
+    metavar="[REF]",
+    help="Lint only files touched vs REF (default HEAD): the pre-commit/"
+    "CI diff mode. Mutually exclusive with PATHS.",
 )
 @click.option(
     "--rules",
@@ -25,7 +36,7 @@ from kart_tpu.cli import cli
     is_flag=True,
     help="List the rule catalogue and exit",
 )
-def lint(paths, fmt, list_rules):
+def lint(paths, fmt, changed_ref, list_rules):
     """Check the tree against the repo's cross-cutting contracts."""
     from kart_tpu import analysis
 
@@ -33,9 +44,25 @@ def lint(paths, fmt, list_rules):
         for r in analysis.rule_catalogue():
             click.echo(f"{r['id']}  {r['name']}: {r['description']}")
         return
-    report = analysis.run_lint(list(paths) or None)
+    if changed_ref is not None:
+        if paths:
+            raise click.UsageError("--changed and PATHS are mutually exclusive")
+        try:
+            targets = analysis.changed_targets(ref=changed_ref)
+        except ValueError as e:
+            raise click.UsageError(str(e))
+        # an empty target set still reports through the requested format
+        # (CI pipelines parse the json/sarif document on docs-only diffs)
+        report = analysis.run_lint(targets)
+        if not targets and fmt == "text":
+            click.echo(f"ok: no lint targets changed vs {changed_ref}")
+            return
+    else:
+        report = analysis.run_lint(list(paths) or None)
     if fmt == "json":
         click.echo(analysis.to_json(report, indent=2))
+    elif fmt == "sarif":
+        click.echo(analysis.to_sarif(report, indent=2))
     else:
         click.echo(analysis.to_text(report))
     if not report.ok:

@@ -138,16 +138,22 @@ class ObjectDb:
 
     @property
     def alternates(self):
-        if self._alternates is None:
-            self._alternates = []
+        # atomic publish (KTL012, the PR 9 PackCollection.packs race class):
+        # build the list locally and assign once — a concurrent reader on
+        # another server thread must never see a partially-parsed file and
+        # conclude an alternate (and every object behind it) doesn't exist
+        alternates = self._alternates
+        if alternates is None:
+            alternates = []
             info = os.path.join(self.objects_dir, "info", "alternates")
             if os.path.exists(info):
                 with open(info) as f:
                     for line in f:
                         line = line.strip()
                         if line and not line.startswith("#"):
-                            self._alternates.append(line)
-        return self._alternates
+                            alternates.append(line)
+            self._alternates = alternates
+        return alternates
 
     def add_alternate(self, objects_dir):
         info_dir = os.path.join(self.objects_dir, "info")

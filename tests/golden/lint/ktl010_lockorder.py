@@ -1,0 +1,43 @@
+"""Golden KTL010: lock-order inversion, direct and interprocedural."""
+
+import threading
+
+_A = threading.Lock()
+_B = threading.Lock()
+_C = threading.Lock()
+_D = threading.Lock()
+
+
+def ab_path():
+    with _A:
+        with _B:  # edge A->B: half of the inversion below
+            return 1
+
+
+def ba_path():
+    with _B:
+        with _A:  # the B->A edge closing the cycle (reported once, at the
+            return 2  # first edge's witness line above)
+
+
+def _helper_taking_c():
+    with _C:
+        return 3
+
+
+def via_call():
+    with _A:
+        return _helper_taking_c()  # edge A->C via the call graph: clean
+        # (no C->A edge exists, so no cycle)
+
+
+def reentrant():
+    with _C:
+        with _C:  # finding: re-acquiring a non-reentrant module lock
+            return 4
+
+
+def reentrant_suppressed():
+    with _D:
+        with _D:  # kart: noqa(KTL010): golden fixture — demonstrates suppressing the self-deadlock finding
+            return 5

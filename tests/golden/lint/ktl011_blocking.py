@@ -1,0 +1,45 @@
+"""Golden KTL011: blocking primitives while holding a lock."""
+
+import os
+import subprocess
+import threading
+import time
+
+_LOCK = threading.Lock()
+
+
+def sleeps_under_lock():
+    with _LOCK:
+        time.sleep(1.0)  # finding: sleep while every other caller waits
+
+
+def syncs_under_lock(fd):
+    with _LOCK:
+        os.fdatasync(fd)  # finding: disk sync under the lock
+
+
+def spawns_under_lock():
+    with _LOCK:
+        return subprocess.run(["true"])  # finding: subprocess under lock
+
+
+def _does_transfer(device_put, batch):
+    return device_put(batch)  # the sharded path's host->device upload
+
+
+def transfers_via_call(device_put, batch):
+    with _LOCK:
+        return _does_transfer(device_put, batch)  # finding: reaches
+        # device_put through the call graph
+
+
+def careful(fd):
+    with _LOCK:
+        value = 41 + 1  # pure compute under the lock: clean
+    os.fdatasync(fd)  # blocking outside the lock: clean
+    return value
+
+
+def suppressed_pause():
+    with _LOCK:
+        time.sleep(0.01)  # kart: noqa(KTL011): golden fixture — demonstrates a suppressed deliberate pause

@@ -1,0 +1,44 @@
+"""Golden KTL012: incremental publication of shared state (the shipped
+PR 9 PackCollection.packs shape)."""
+
+import threading
+
+
+class ScanRegistry:
+    """Read by concurrent server threads while another rescans."""
+
+    def __init__(self):
+        self._items = None
+        self._lock = threading.Lock()
+
+    @property
+    def items(self):
+        if self._items is None:
+            self._items = []  # finding: published empty, then filled
+            for name in ("a", "b", "c"):
+                self._items.append(name)
+        return self._items
+
+    def rebuild_atomically(self):
+        items = []  # build-local-then-assign-once: clean
+        for name in ("a", "b", "c"):
+            items.append(name)
+        self._items = items
+
+    def rebuild_locked(self):
+        with self._lock:
+            self._items = {}  # mutation under the lock: clean
+            self._items["a"] = 1
+
+
+    @property
+    def items_suppressed(self):
+        if self._items is None:
+            self._items = []  # kart: noqa(KTL012): golden fixture — demonstrates a suppressed publication race
+            for name in ("a", "b"):
+                self._items.append(name)
+        return self._items
+
+
+def reader_thread(reg):
+    return threading.Thread(target=reg.rebuild_atomically)

@@ -1,0 +1,42 @@
+"""Golden KTL013: fill-token lifecycle (the shipped PR 7 wedge shape)."""
+
+
+def wedges_on_failure(cache, key, build):
+    mode, got = cache.lookup_or_begin(key)
+    if mode == "hit":
+        return got
+    payload = build(key)  # finding: a raise here leaks the live token —
+    # every later request for this key blocks on an event nobody sets
+    got.publish(payload)
+    return payload
+
+
+def abandons_on_failure(cache, key, build):
+    mode, got = cache.lookup_or_begin(key)
+    if mode == "hit":
+        return got
+    try:
+        payload = build(key)
+    except BaseException:
+        if got is not None:
+            got.abandon()
+        raise
+    if got is not None:
+        got.publish(payload)
+    return payload
+
+
+def transfers_ownership(cache, key, plan_cls):
+    mode, got = cache.lookup_or_begin(key)
+    if mode == "hit":
+        return plan_cls(got, token=None)
+    return plan_cls(None, token=got)  # ownership moves to the plan: clean
+
+
+def wedge_suppressed(cache, key, build):
+    mode, got = cache.lookup_or_begin(key)
+    if mode == "hit":
+        return got
+    payload = build(key)  # kart: noqa(KTL013): golden fixture — demonstrates a suppressed wedge
+    got.publish(payload)
+    return payload

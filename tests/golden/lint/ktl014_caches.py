@@ -1,0 +1,35 @@
+"""Golden KTL014: byte-budgeted caches outside the CACHES registry."""
+
+import threading
+from collections import OrderedDict
+
+from kart_tpu.core.singleflight import SingleFlightLRU
+
+
+class EdgeCache(SingleFlightLRU):  # finding: not declared in CACHES
+    def count(self, event, n=1):
+        pass
+
+
+class TileCache(SingleFlightLRU):  # declared (by the tiles entry): clean
+    pass
+
+
+class QuietCache(SingleFlightLRU):  # kart: noqa(KTL014): golden fixture — demonstrates a suppressed undeclared cache
+    pass
+
+
+_EDGE_ENTRIES = OrderedDict()  # finding: LRU-shaped (popitem-evicted
+# below) but neither declared in CACHES nor exempted
+_EDGE_MAX = 4
+_edge_lock = threading.Lock()
+
+
+def remember(key, value):
+    with _edge_lock:
+        _EDGE_ENTRIES[key] = value
+        while len(_EDGE_ENTRIES) > _EDGE_MAX:
+            _EDGE_ENTRIES.popitem(last=False)
+
+
+_PLAIN_BUFFER = OrderedDict()  # never evicted: not LRU-shaped, clean

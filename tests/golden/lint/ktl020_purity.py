@@ -1,0 +1,47 @@
+"""Golden KTL020: host side effects inside traced functions."""
+
+import os
+
+import numpy as np
+
+from kart_tpu import telemetry as tm
+
+
+def lazy_jit(fn):
+    """Stand-in tracer (same name the real kernels use) so the fixture
+    needs no jax import and trips no other rule."""
+    return fn
+
+
+def _impure_step(xs, ys):
+    tm.incr("diff.device.batches")  # finding: telemetry inside the trace
+    if os.environ.get("KART_TRACE"):  # finding: env read inside the trace
+        pass
+    total = xs + ys
+    if xs > 0:  # finding: data-dependent branch on a traced argument
+        total = total * 2
+    return np.asarray(total)  # finding: host numpy sync inside the trace
+
+
+impure_kernel = lazy_jit(_impure_step)
+
+
+def _pure_step(xs, ys):
+    lo = np.int32(0)  # dtype constant folds into the program: clean
+    return (xs + ys) * 2 + lo
+
+
+pure_kernel = lazy_jit(_pure_step)
+
+
+def host_wrapper(xs):
+    tm.incr("diff.device.batches")  # host side of the dispatch: clean
+    return pure_kernel(xs, xs)
+
+
+def _suppressed_step(xs):
+    tm.incr("diff.device.batches")  # kart: noqa(KTL020): golden fixture — demonstrates a suppressed trace impurity
+    return xs
+
+
+suppressed_kernel = lazy_jit(_suppressed_step)

@@ -1,0 +1,23 @@
+"""Golden KTL021: jax reached outside the fallback seam."""
+
+import jax  # finding: jax import outside registry.DEVICE_MODULES
+
+from kart_tpu.diff.backend import select_backend  # seam name: clean
+from kart_tpu.diff.device_batch import (
+    classify_blocks_batched,  # finding: device internals, not a seam name
+)
+
+
+def hits_device_directly(batch):
+    return jax.device_put(batch)
+
+
+def routes_properly(old_block, new_block, n_rows):
+    backend = select_backend(n_rows)
+    return backend.classify(old_block, new_block)
+
+
+def suppressed_probe():
+    import jax.numpy as jnp  # kart: noqa(KTL021): golden fixture — demonstrates a suppressed direct jax use
+
+    return jnp.zeros(1)

@@ -70,8 +70,10 @@ def test_suppression_with_rationale_is_honored():
 
 def test_json_reporter_schema_is_stable():
     doc = json.loads(analysis.to_json(corpus_report("ktl006_exceptions.py")))
-    assert doc["version"] == analysis.JSON_SCHEMA_VERSION == 1
-    assert set(doc) == {"version", "ok", "files_scanned", "rules", "findings"}
+    assert doc["version"] == analysis.JSON_SCHEMA_VERSION == 2
+    assert set(doc) == {
+        "version", "ok", "files_scanned", "rules", "findings", "timings",
+    }
     assert doc["ok"] is False
     assert doc["files_scanned"] == 1
     for rule in doc["rules"]:
@@ -82,6 +84,32 @@ def test_json_reporter_schema_is_stable():
     # sorted by (path, line, col, rule): stable for diffing in CI
     keys = [(f["path"], f["line"], f["col"], f["rule"]) for f in doc["findings"]]
     assert keys == sorted(keys)
+    # per-rule timings (v2): every active rule is billed, totals add up
+    assert set(doc["timings"]) == {"total_seconds", "rules"}
+    rule_ids = {r["id"] for r in doc["rules"]} - {"KTL000", "KTL099"}
+    assert set(doc["timings"]["rules"]) == rule_ids
+    assert doc["timings"]["total_seconds"] == pytest.approx(
+        sum(doc["timings"]["rules"].values()), abs=0.01
+    )
+
+
+def test_sarif_reporter_matches_golden_file():
+    """The SARIF 2.1.0 document shape is pinned by a golden file so CI
+    viewers can rely on it; regenerate deliberately when rules change."""
+    doc = json.loads(analysis.to_sarif(corpus_report("ktl006_exceptions.py")))
+    with open(os.path.join(CORPUS, "expected.sarif.json")) as f:
+        golden = json.load(f)
+    assert doc == golden
+    run = doc["runs"][0]
+    assert doc["version"] == "2.1.0"
+    assert run["tool"]["driver"]["name"] == "kart-lint"
+    for result in run["results"]:
+        loc = result["locations"][0]["physicalLocation"]
+        assert loc["artifactLocation"]["uri"].endswith(
+            "ktl006_exceptions.py"
+        )
+        assert loc["region"]["startLine"] >= 1
+        assert loc["region"]["startColumn"] >= 1  # SARIF is 1-indexed
 
 
 def test_text_reporter_mentions_every_finding_location():
@@ -181,6 +209,278 @@ def test_fault_registry_roundtrip_detects_drift(monkeypatch):
     assert any(
         "fake.untested_point" in m and "never injected" in m for m in messages
     ), messages
+
+
+# -- KTL014 CACHES round-trips (all directions, like KTL001/KTL003) ---------
+
+
+def test_caches_registry_roundtrip_undeclared_cache_fires(tmp_path):
+    """Code -> registry: a SingleFlightLRU subclass (or LRU-shaped global)
+    the registry doesn't know is a finding (per-file, so pre-commit mode
+    catches it too) — proven by the golden corpus; here we prove the
+    *declared* names stay clean."""
+    report = corpus_report("ktl014_caches.py")
+    by_line = {(f.rule, f.line) for f in report.findings}
+    assert ("KTL014", 9) in by_line  # EdgeCache undeclared
+    assert ("KTL014", 22) in by_line  # _EDGE_ENTRIES undeclared
+    # TileCache (declared via the tiles entry) and _PLAIN_BUFFER (not
+    # LRU-shaped) stay clean
+    assert len([x for x in by_line if x[0] == "KTL014"]) == 2
+
+
+def test_caches_registry_roundtrip_missing_declaration_target(monkeypatch):
+    """Registry -> code: an entry pointing at nothing must produce
+    findings for every broken leg (module, class, global, key fn)."""
+    patched = dict(registry.CACHES)
+    patched["edge.fake"] = {
+        "module": "kart_tpu/transport/service.py",
+        "cls": "NoSuchCache",
+        "registry_global": "_NO_SUCH_GLOBAL",
+        "key_fn": "_no_such_key_fn",
+        "key_tokens": ("commit_oid",),
+        "ref_drop": "no_such_drop",
+    }
+    monkeypatch.setattr(registry, "CACHES", patched)
+    messages = [
+        f.message
+        for f in analysis.run_lint().findings
+        if f.rule == "KTL014"
+    ]
+    assert any("NoSuchCache" in m for m in messages), messages
+    assert any("_NO_SUCH_GLOBAL" in m for m in messages), messages
+    assert any("_no_such_key_fn" in m for m in messages), messages
+
+
+def test_caches_registry_roundtrip_key_token_drift(monkeypatch):
+    """The commit-pinning leg: a key builder that stops referencing its
+    declared token is a finding (invalidation-by-construction broken)."""
+    patched = {
+        k: dict(v, key_tokens=("no_such_token",)) if k == "tiles.cache" else v
+        for k, v in registry.CACHES.items()
+    }
+    monkeypatch.setattr(registry, "CACHES", patched)
+    findings = [
+        f for f in analysis.run_lint().findings if f.rule == "KTL014"
+    ]
+    assert any(
+        "no_such_token" in f.message and f.path == "kart_tpu/tiles/cache.py"
+        for f in findings
+    ), findings
+
+
+def test_caches_registry_roundtrip_ref_drop_must_be_called(monkeypatch):
+    """The invalidation leg: declaring a drop hook nothing calls from
+    _apply_validated_updates is a finding."""
+    patched = {
+        k: dict(v, ref_drop="no_such_drop") if k == "server.enum_cache" else v
+        for k, v in registry.CACHES.items()
+    }
+    monkeypatch.setattr(registry, "CACHES", patched)
+    findings = [
+        f for f in analysis.run_lint().findings if f.rule == "KTL014"
+    ]
+    assert any(
+        "no_such_drop" in f.message and "never" in f.message
+        for f in findings
+    ), findings
+
+
+def test_caches_registry_roundtrip_rationale_required(monkeypatch):
+    """A cache with neither drop hook nor rationale is a finding."""
+    patched = {
+        k: {
+            kk: vv
+            for kk, vv in v.items()
+            if kk != "ref_drop_rationale"
+        }
+        if k == "tiles.source"
+        else v
+        for k, v in registry.CACHES.items()
+    }
+    monkeypatch.setattr(registry, "CACHES", patched)
+    findings = [
+        f for f in analysis.run_lint().findings if f.rule == "KTL014"
+    ]
+    assert any(
+        "tiles.source" in f.message and "rationale" in f.message
+        for f in findings
+    ), findings
+
+
+def test_blocking_allowlist_stale_entry_fires(monkeypatch):
+    """KTL011's allowlist round-trip: an entry naming no live function is
+    itself a finding."""
+    patched = dict(registry.BLOCKING_ALLOW)
+    patched["kart_tpu/core/odb.py::NoSuch.fn"] = "stale entry rationale"
+    monkeypatch.setattr(registry, "BLOCKING_ALLOW", patched)
+    findings = [
+        f for f in analysis.run_lint().findings if f.rule == "KTL011"
+    ]
+    assert any("NoSuch.fn" in f.message for f in findings), findings
+
+
+def test_device_seams_stale_name_fires(monkeypatch):
+    """KTL021's seam round-trip: a declared seam name its module no longer
+    defines is a finding."""
+    patched = dict(registry.DEVICE_SEAMS)
+    patched["kart_tpu/diff/backend.py"] = frozenset(
+        patched["kart_tpu/diff/backend.py"] | {"no_such_seam"}
+    )
+    monkeypatch.setattr(registry, "DEVICE_SEAMS", patched)
+    findings = [
+        f for f in analysis.run_lint().findings if f.rule == "KTL021"
+    ]
+    assert any("no_such_seam" in f.message for f in findings), findings
+
+
+# -- KTL010/KTL012 precision regressions ------------------------------------
+
+
+def test_ktl010_rlock_reacquire_is_not_a_deadlock(tmp_path):
+    """Re-acquiring an RLock through self is the one thing RLock exists
+    for — it must not be reported as a self-deadlock."""
+    snippet = tmp_path / "rlock_ok.py"
+    snippet.write_text(
+        "import threading\n"
+        "class Safe:\n"
+        "    def __init__(self):\n"
+        "        self._lock = threading.RLock()\n"
+        "    def outer(self):\n"
+        "        with self._lock:\n"
+        "            return self.inner()\n"
+        "    def inner(self):\n"
+        "        with self._lock:\n"
+        "            return 1\n"
+    )
+    report = analysis.run_lint([str(snippet)])
+    assert not [
+        f for f in report.findings if f.rule == "KTL010"
+    ], analysis.to_text(report)
+    # the same shape on a plain Lock IS the instant deadlock
+    bad = tmp_path / "lock_bad.py"
+    bad.write_text(snippet.read_text().replace("RLock", "Lock"))
+    report = analysis.run_lint([str(bad)])
+    assert [f for f in report.findings if f.rule == "KTL010"]
+
+
+def test_ktl012_nested_def_reports_once(tmp_path):
+    """A nested def is its own scope: the init+mutate pattern inside it
+    must report exactly once, not once per enclosing function."""
+    snippet = tmp_path / "nested_pub.py"
+    snippet.write_text(
+        "import threading\n"
+        "class Reg:\n"
+        "    def outer(self):\n"
+        "        def inner():\n"
+        "            self._items = []\n"
+        "            self._items.append(1)\n"
+        "        return inner\n"
+    )
+    report = analysis.run_lint([str(snippet)])
+    hits = [f for f in report.findings if f.rule == "KTL012"]
+    assert len(hits) == 1, analysis.to_text(report)
+
+
+# -- KTL013 exception-edge corner cases (review regressions) ----------------
+
+
+def test_ktl013_risky_statement_inside_with_block_fires(tmp_path):
+    """A publish deep inside a `with` block must not hide the risky
+    statement executed before it — the token is live while it runs."""
+    snippet = tmp_path / "with_wedge.py"
+    snippet.write_text(
+        "def fill(cache, key, build):\n"
+        "    mode, got = cache.lookup_or_begin(key)\n"
+        "    if mode == 'hit':\n"
+        "        return got\n"
+        "    with cache.lock:\n"
+        "        entry = build(key)\n"
+        "        got.publish(entry)\n"
+        "    return entry\n"
+    )
+    report = analysis.run_lint([str(snippet)])
+    hits = [f for f in report.findings if f.rule == "KTL013"]
+    assert hits and hits[0].line == 6, analysis.to_text(report)
+
+
+def test_ktl013_try_enclosed_acquire_is_protected(tmp_path):
+    """The acquire-inside-try idiom (one broad handler abandoning for the
+    whole fill) is correct and must NOT be flagged."""
+    snippet = tmp_path / "try_fill.py"
+    snippet.write_text(
+        "def fill(cache, key, build):\n"
+        "    got = None\n"
+        "    try:\n"
+        "        mode, got = cache.lookup_or_begin(key)\n"
+        "        if mode == 'hit':\n"
+        "            return got\n"
+        "        entry = build(key)\n"
+        "        got.publish(entry)\n"
+        "        return entry\n"
+        "    except BaseException:\n"
+        "        if got is not None:\n"
+        "            got.abandon()\n"
+        "        raise\n"
+    )
+    report = analysis.run_lint([str(snippet)])
+    assert not [
+        f for f in report.findings if f.rule == "KTL013"
+    ], analysis.to_text(report)
+
+
+# -- --changed mode ----------------------------------------------------------
+
+
+def test_changed_targets_against_a_git_ref(tmp_path):
+    import subprocess
+
+    def git(*args):
+        subprocess.run(
+            ["git", "-C", str(tmp_path), *args],
+            check=True,
+            capture_output=True,
+            env={
+                **os.environ,
+                "GIT_AUTHOR_NAME": "t",
+                "GIT_AUTHOR_EMAIL": "t@example.com",
+                "GIT_COMMITTER_NAME": "t",
+                "GIT_COMMITTER_EMAIL": "t@example.com",
+            },
+        )
+
+    pkg = tmp_path / "kart_tpu"
+    pkg.mkdir()
+    (pkg / "clean.py").write_text("X = 1\n")
+    (pkg / "other.py").write_text("Y = 2\n")
+    (tmp_path / "notes.md").write_text("not a lint target\n")
+    git("init", "-q")
+    git("add", "-A")
+    git("commit", "-qm", "seed")
+    # modify one target, add an untracked one, touch a non-target
+    (pkg / "clean.py").write_text("import os\nX = os.environ.get('KART_NOT_DECLARED')\n")
+    (pkg / "fresh.py").write_text("Z = 3\n")
+    (tmp_path / "notes.md").write_text("changed but still not a target\n")
+
+    targets = analysis.changed_targets(root=str(tmp_path), ref="HEAD")
+    rels = sorted(os.path.relpath(t, str(tmp_path)) for t in targets)
+    assert rels == ["kart_tpu/clean.py", "kart_tpu/fresh.py"]
+
+    report = analysis.run_lint(targets)
+    assert any(
+        f.rule == "KTL001" and "KART_NOT_DECLARED" in f.message
+        for f in report.findings
+    )
+    # unchanged files were not scanned: diff-driven CI stays fast
+    assert report.files_scanned == 2
+
+
+def test_changed_mode_cli_with_no_changes(tmp_path, cli_runner):
+    """`kart lint --changed` against the repo's own HEAD exercises the CLI
+    wiring; with a bogus ref it must fail loudly, not scan nothing."""
+    from kart_tpu.cli import cli
+
+    r = cli_runner.invoke(cli, ["lint", "--changed", "HEAD", "--", "bench.py"])
+    assert r.exit_code != 0  # --changed and PATHS are mutually exclusive
 
 
 # -- framework details ------------------------------------------------------

@@ -179,6 +179,14 @@ NEW_KEYS += [
 ]
 
 
+#: keys added by ISSUE 11 (concurrency & device-purity analyzer: the
+#: per-rule timing headline — the slowest rule's wall-clock, recorded so
+#: the <5s full-tree bound stays attributable as the rule count grows)
+NEW_KEYS += [
+    "lint_rule_seconds_max",
+]
+
+
 def test_bench_emits_every_recorded_key():
     with open(os.path.join(REPO_ROOT, "bench.py")) as f:
         src = f.read()

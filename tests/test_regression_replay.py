@@ -1,0 +1,109 @@
+"""Regression replay (ISSUE 11 satellite): the analyzer provably catches
+both shipped concurrency bugs. Each test takes the REAL current source,
+surgically reverts the shipped fix (anchored on the fixed code — if the
+fix is refactored these anchors fail loudly rather than silently testing
+nothing), lints the reverted copy, and asserts the rule fires:
+
+* PR 9: ``PackCollection.packs`` published a partially-built pack list to
+  concurrent readers (16 cold tile requests on a fresh server saw
+  reachable objects as missing) -> KTL012.
+* PR 7: a pre-walk failure in ``serve_fetch_pack`` left the single-flight
+  fill token live, wedging every later request for the key behind a 600s
+  timeout -> KTL013.
+"""
+
+import os
+
+import pytest
+
+from kart_tpu import analysis
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _read(rel):
+    with open(os.path.join(REPO_ROOT, rel), encoding="utf-8") as f:
+        return f.read()
+
+
+def _surgically(source, replacements):
+    """Apply (old, new) pairs, asserting each anchor exists exactly once —
+    drift in the fixed code must fail this test visibly."""
+    for old, new in replacements:
+        assert source.count(old) == 1, (
+            f"revert anchor not found (or ambiguous) — the fixed code "
+            f"changed shape; update the replay surgery:\n{old!r}"
+        )
+        source = source.replace(old, new)
+    return source
+
+
+def _lint_source(tmp_path, name, source):
+    path = tmp_path / name
+    path.write_text(source)
+    return analysis.run_lint([str(path)])
+
+
+def test_reverted_pr9_pack_scan_publication_fires_ktl012(tmp_path):
+    fixed = _read("kart_tpu/core/packs.py")
+    reverted = _surgically(
+        fixed,
+        [
+            (
+                "        packs = self._packs\n        if packs is None:",
+                "        if self._packs is None:",
+            ),
+            ("            packs = []\n", "            self._packs = []\n"),
+            (
+                "packs.append(Packfile(os.path.join(d, name), idx))",
+                "self._packs.append(Packfile(os.path.join(d, name), idx))",
+            ),
+            (
+                "            self._packs = packs\n        return packs\n",
+                "        return self._packs\n",
+            ),
+        ],
+    )
+    report = _lint_source(tmp_path, "packs.py", reverted)
+    hits = [f for f in report.findings if f.rule == "KTL012"]
+    assert hits, "the reverted PR 9 pack-scan race must fire KTL012"
+    assert any("_packs" in f.message for f in hits), hits
+
+
+def test_reverted_pr7_fill_token_abandon_fires_ktl013(tmp_path):
+    fixed = _read("kart_tpu/transport/service.py")
+    fixed_block = (
+        "    try:\n"
+        "        enum, header = make_fetch_enum(\n"
+        "            repo, req, count_request=False, record_emitted=True\n"
+        "        )\n"
+        "    except BaseException:\n"
+    )
+    assert fixed_block in fixed, (
+        "the PR 7 fill-token fix changed shape; update the replay surgery"
+    )
+    # drop the whole try/except: the pre-fix code called make_fetch_enum
+    # bare, so any pre-walk failure leaked the live token
+    start = fixed.index(fixed_block)
+    end = fixed.index("    return FetchPlan(", start)
+    reverted = (
+        fixed[:start]
+        + "    enum, header = make_fetch_enum(\n"
+        "        repo, req, count_request=False, record_emitted=True\n"
+        "    )\n"
+        + fixed[end:]
+    )
+    report = _lint_source(tmp_path, "service.py", reverted)
+    hits = [f for f in report.findings if f.rule == "KTL013"]
+    assert hits, "the reverted PR 7 fill-token wedge must fire KTL013"
+    assert any("got" in f.message for f in hits), hits
+
+
+@pytest.mark.parametrize(
+    "rel", ["kart_tpu/core/packs.py", "kart_tpu/transport/service.py"]
+)
+def test_fixed_sources_stay_clean_of_the_replayed_rules(rel):
+    report = analysis.run_lint([os.path.join(REPO_ROOT, rel)])
+    assert not [
+        f for f in report.findings if f.rule in ("KTL012", "KTL013")
+    ], analysis.to_text(report)
