@@ -2029,6 +2029,26 @@ def _prom_value(text, name):
     return 0.0
 
 
+def _server_verb_hist(stats_doc, name, verb):
+    """The labelled histogram dict (count/sum/p50/p99/buckets) from a
+    ``/api/v1/stats?format=json`` document, or None."""
+    for n, labels, h in stats_doc.get("snapshot", {}).get("histograms", ()):
+        if n == name and labels.get("verb") == verb:
+            return h
+    return None
+
+
+def _latency_bucket_index(value):
+    """Index of ``value`` on the telemetry bucket ladder — the agreement
+    check between server-estimated and client-measured percentiles is
+    'same bucket ± 1' (the documented quantile error bound)."""
+    from bisect import bisect_left
+
+    from kart_tpu.telemetry.core import BUCKET_BOUNDS
+
+    return bisect_left(BUCKET_BOUNDS, value)
+
+
 def serve_storm_main():
     """The concurrent-serving bench: aggregate clone throughput of N
     simultaneous clients vs a serial cache-disabled baseline (the
@@ -2079,6 +2099,8 @@ def serve_storm_main():
             )
             _storm_go_barrier(procs)
             serial_results = _collect_workers(procs)
+            with urlopen(url + "api/v1/stats?format=json", timeout=10) as resp:
+                serial_stats_doc = json.loads(resp.read().decode())
         finally:
             server.kill()
             server.wait()
@@ -2090,6 +2112,29 @@ def serve_storm_main():
         serial_req_s = sum(r0["durations"]) / len(r0["durations"])
         serial_rate = rows / serial_req_s
         record["serve_storm_serial_features_per_sec"] = round(serial_rate)
+        # the coupled-regime agreement check: one uncached client, so each
+        # request is dominated by the server's own walk+spool+stream — the
+        # server-estimated p99 must land within one log bucket of the
+        # client-measured one (the documented quantile error bound)
+        serial_hist = _server_verb_hist(
+            serial_stats_doc, "server.request_seconds", "fetch-pack"
+        )
+        if serial_hist is not None:
+            client_p99 = sorted(r0["durations"])[
+                min(
+                    len(r0["durations"]) - 1,
+                    math.ceil(0.99 * len(r0["durations"])) - 1,
+                )
+            ]
+            record["serve_serial_server_p99_seconds"] = round(
+                serial_hist["p99"], 3
+            )
+            serial_distance = abs(
+                _latency_bucket_index(serial_hist["p99"])
+                - _latency_bucket_index(client_p99)
+            )
+            record["serve_serial_p99_bucket_distance"] = serial_distance
+            record["serve_serial_server_p99_agrees"] = serial_distance <= 1
 
         # -- the storm: N concurrent clients, cache ON. An inflight cap is
         # available (KART_BENCH_STORM_INFLIGHT > 0 arms the shedder on the
@@ -2116,6 +2161,10 @@ def serve_storm_main():
             storm_results = _collect_workers(procs)
             with urlopen(url + "api/v1/stats", timeout=10) as resp:
                 stats_text = resp.read().decode()
+            # the server's own view: per-verb bucketed latency histograms
+            # with quantile estimates (docs/OBSERVABILITY.md §9)
+            with urlopen(url + "api/v1/stats?format=json", timeout=10) as resp:
+                stats_doc = json.loads(resp.read().decode())
         finally:
             server.kill()
             server.wait()
@@ -2138,6 +2187,30 @@ def serve_storm_main():
         record["serve_storm_p99_request_seconds"] = round(
             durations[p99_idx], 3
         )
+        # server-reported percentiles from the bucketed fetch-pack request
+        # histogram — the server's tail is no longer a number only bench.py
+        # can compute. The storm-leg distance is informational on a small
+        # colocated host: with the enum cache on, a hit is a memcpy into
+        # kernel socket buffers and the client's wall-clock adds N-process
+        # scheduler queueing the server never sees (both numbers are true;
+        # the coupled-regime agreement bound is asserted on the serial leg
+        # above, and in tier-1 with the cache off)
+        server_hist = _server_verb_hist(
+            stats_doc, "server.request_seconds", "fetch-pack"
+        )
+        if server_hist is not None:
+            record["serve_storm_server_p50_seconds"] = round(
+                server_hist["p50"], 3
+            )
+            record["serve_storm_server_p99_seconds"] = round(
+                server_hist["p99"], 3
+            )
+            distance = abs(
+                _latency_bucket_index(server_hist["p99"])
+                - _latency_bucket_index(durations[p99_idx])
+            )
+            record["serve_storm_server_p99_bucket_distance"] = distance
+            record["serve_storm_server_p99_agrees"] = distance <= 1
         hits = _prom_value(stats_text, "kart_server_enum_cache_hits_total")
         misses = _prom_value(stats_text, "kart_server_enum_cache_misses_total")
         record["serve_enum_cache_hit_rate"] = round(

@@ -25,6 +25,10 @@ ENV_VARS = {
     "KART_TRACE": "source",
     "KART_METRICS": "source",
     "KART_LOG": "source",
+    # request-scoped observability (docs/OBSERVABILITY.md §8-§11)
+    "KART_SLOW_REQUEST_SECONDS": "source",
+    "KART_ACCESS_LOG": "source",
+    "KART_STATS_WINDOWS": "source",
     # transport (ROBUSTNESS.md §1-§4)
     "KART_TRANSPORT_RETRIES": "source",
     "KART_TRANSPORT_RETRY_BASE": "source",

@@ -55,6 +55,7 @@ _COMMANDS = {
     "commit-files": "kart_tpu.cli.data_cmds",
     "build-annotations": "kart_tpu.cli.data_cmds",
     "stats": "kart_tpu.cli.stats_cmds",
+    "top": "kart_tpu.cli.top_cmds",
     "lint": "kart_tpu.cli.lint_cmds",
     "export": "kart_tpu.cli.tile_cmds",
 }
@@ -157,6 +158,10 @@ def cli(ctx, repo_dir, verbose, trace_flag, reprobe_flag):
         telemetry.enable(trace=True, trace_path=telemetry.default_trace_path())
     if verbose:
         telemetry.enable(spans=True)  # feeds the end-of-command summary
+    # one command = one trace: every transport verb this command issues
+    # inherits this root context's trace id, and the wire carries it to
+    # the servers (docs/OBSERVABILITY.md §8)
+    telemetry.set_root_request(verb=ctx.invoked_subcommand)
     if ctx.invoked_subcommand:
         telemetry.incr("cli.commands", cmd=ctx.invoked_subcommand)
 
@@ -165,9 +170,15 @@ def cli(ctx, repo_dir, verbose, trace_flag, reprobe_flag):
         from kart_tpu.telemetry import sinks
 
         if telemetry.tracing_enabled():
+            dropped = telemetry.events_dropped_count()
             path = sinks.write_chrome_trace()
             if path:
-                click.echo(f"Trace written to {path}", err=True)
+                note = (
+                    f" ({dropped} span events dropped at the buffer cap)"
+                    if dropped
+                    else ""
+                )
+                click.echo(f"Trace written to {path}{note}", err=True)
         if verbose:
             summary = sinks.phase_summary_text()
             if summary:

@@ -1,0 +1,194 @@
+"""``kart top`` — a live view of a running transport server
+(docs/OBSERVABILITY.md §11).
+
+Polls the server's structured stats document
+(``GET /api/v1/stats?format=json`` over HTTP, the ``stats`` op with
+``format: "json"`` over ssh) and renders request rates over the configured
+windows, per-verb latency percentiles from the server's own bucketed
+histograms, inflight/queue depth, shed and cache counters, and the newest
+slow-request exemplars — the operational picture of a storm from the
+server's side, live.
+"""
+
+import json as _json
+import time
+
+import click
+
+from kart_tpu.cli import CliError, cli
+from kart_tpu.cli.stats_cmds import _resolve_target
+
+
+def fetch_stats_json(url):
+    """-> the parsed stats document of the server at ``url``."""
+    from kart_tpu.transport.http import API, http_timeout
+    from kart_tpu.transport.remote import is_http_url
+    from kart_tpu.transport.stdio import StdioRemote, is_ssh_url
+
+    if is_http_url(url):
+        from urllib.request import Request, urlopen
+
+        with urlopen(
+            Request(url.rstrip("/") + f"{API}/stats?format=json"),
+            timeout=http_timeout(),
+        ) as resp:
+            return _json.loads(resp.read().decode())
+    if is_ssh_url(url):
+        remote = StdioRemote(url)
+        try:
+            resp, _ = remote._rpc({"op": "stats", "format": "json"})
+        finally:
+            remote.close()
+        return resp.get("stats", {})
+    raise CliError(
+        f"Cannot fetch stats from {url!r}: expected an http(s):// or "
+        f"ssh:// URL (or a configured remote name)"
+    )
+
+
+def _hist_by_verb(snapshot, name):
+    """{verb: hist dict} for a labelled histogram family."""
+    out = {}
+    for n, labels, h in snapshot.get("histograms", ()):
+        if n == name and "verb" in labels:
+            out[labels["verb"]] = h
+    return out
+
+
+def _rate_of(rates_window, name, verb=None):
+    total = 0.0
+    hit = False
+    for n, labels, rate in rates_window:
+        if n != name:
+            continue
+        if verb is not None and labels.get("verb") != verb:
+            continue
+        total += rate
+        hit = True
+    return total if hit else 0.0
+
+
+def _counter_total(snapshot, name):
+    return sum(v for n, _l, v in snapshot.get("counters", ()) if n == name)
+
+
+def _gauge(snapshot, name):
+    for n, _l, v in snapshot.get("gauges", ()):
+        if n == name:
+            return v
+    return 0
+
+
+def render_top(payload, url):
+    """One text frame of the live view."""
+    snap = payload.get("snapshot", {})
+    rates = payload.get("rates", {})
+    windows = sorted(rates, key=lambda w: float(w.rstrip("s")))
+    hists = _hist_by_verb(snap, "server.request_seconds")
+
+    lines = [
+        f"kart top — {url}",
+        f"inflight {payload.get('inflight', _gauge(snap, 'server.inflight'))}"
+        f"  queue depth {_gauge(snap, 'server.merge_queue.depth')}"
+        f"  shed {_counter_total(snap, 'server.shed'):.0f}"
+        f"  slow {_counter_total(snap, 'server.slow_requests'):.0f}"
+        f"  trace drops {payload.get('events_dropped', 0)}",
+        "",
+    ]
+    rate_heads = "".join(f"  req/s({w})" for w in windows)
+    lines.append(
+        f"{'verb':<14}{rate_heads}  {'count':>7}  {'p50':>8}  {'p90':>8}  "
+        f"{'p99':>8}  {'max':>8}"
+    )
+    verbs = sorted(
+        set(hists)
+        | {
+            labels.get("verb")
+            for n, labels, _v in snap.get("counters", ())
+            if n == "transport.server.requests" and labels.get("verb")
+        }
+    )
+    for verb in verbs:
+        h = hists.get(verb)
+        cells = "".join(
+            f"  {_rate_of(rates.get(w, ()), 'transport.server.requests', verb):>10.2f}"
+            for w in windows
+        )
+        if h:
+            lines.append(
+                f"{verb:<14}{cells}  {h['count']:>7d}  {h['p50']:>8.3f}  "
+                f"{h['p90']:>8.3f}  {h['p99']:>8.3f}  {h['max']:>8.3f}"
+            )
+        else:
+            lines.append(f"{verb:<14}{cells}  {0:>7}  {'-':>8}  {'-':>8}  {'-':>8}  {'-':>8}")
+    tiles_rates = "".join(
+        f"  {_rate_of(rates.get(w, ()), 'tiles.served'):>10.2f}" for w in windows
+    )
+    if any(n == "tiles.served" for n, _l, _v in snap.get("counters", ())):
+        lines.append(f"{'tiles/s':<14}{tiles_rates}")
+    exemplars = payload.get("exemplars") or []
+    if exemplars:
+        lines.append("")
+        lines.append(f"slow requests (last {len(exemplars)}):")
+        for ex in exemplars[-3:]:
+            spans = sorted(
+                ex.get("spans", ()), key=lambda s: -s.get("dur", 0)
+            )
+            frames = ", ".join(
+                f"{s['name']} {s['dur']:.3f}s" for s in spans[:3]
+            )
+            lines.append(
+                f"  {ex.get('verb', '?'):<13} {ex.get('seconds', 0):>8.3f}s"
+                f"  id={ex.get('request_id', '-')}"
+                + (f"  [{frames}]" if frames else "")
+            )
+    return "\n".join(lines)
+
+
+@cli.command()
+@click.option(
+    "--interval",
+    "-i",
+    type=click.FLOAT,
+    default=2.0,
+    show_default=True,
+    help="Refresh interval (seconds)",
+)
+@click.option(
+    "--once", is_flag=True, help="Print one frame and exit (scripts/tests)"
+)
+@click.argument("target")
+@click.pass_obj
+def top(ctx, target, interval, once):
+    """Live server dashboard: request rates, latency percentiles, queue
+    depth, shed/cache counters and slow-request exemplars.
+
+    TARGET: an http(s):// or ssh:// server URL, or a configured remote
+    name. Rates and percentiles are the *server's own* (bucketed
+    histograms + windowed counter samples) — not client-side estimates.
+
+    The meaningful target is a long-lived `kart serve` (HTTP) process. An
+    ssh target works but reports the just-spawned single-connection
+    serve-stdio process — real client traffic accumulates in *other*
+    processes, so expect an empty view (useful only to verify wiring).
+    """
+    url = _resolve_target(ctx, target)
+    while True:
+        try:
+            payload = fetch_stats_json(url)
+        except OSError as e:
+            raise CliError(f"Cannot reach {target!r}: {e}")
+        except ValueError as e:
+            # a pre-JSON server or a proxy error page answered the stats
+            # query with non-JSON: name the problem, don't stack-trace
+            raise CliError(
+                f"{target!r} did not return the JSON stats document "
+                f"(old server version, or a proxy in the way?): {e}"
+            )
+        frame = render_top(payload, url)
+        if once:
+            click.echo(frame)
+            return
+        click.clear()
+        click.echo(frame)
+        time.sleep(max(0.2, interval))

@@ -18,24 +18,48 @@ docs/OBSERVABILITY.md for the naming scheme and sink formats.
 """
 
 from kart_tpu.telemetry.core import (  # noqa: F401
+    BUCKET_BOUNDS,
     NAME_RE,
     SUBSYSTEMS,
     Phases,
     all_metric_names,
     begin_fork_child,
+    counters_snapshot,
     default_trace_path,
     drain_events,
     dump_fork_child,
     enable,
     enable_from_env,
+    events_dropped_count,
     gauge_set,
     incr,
     metrics_enabled,
     observe,
-    reset,
     snapshot,
     span,
     trace_path,
     tracing_enabled,
 )
+from kart_tpu.telemetry.core import reset as _core_reset
+from kart_tpu.telemetry import access as _access
+from kart_tpu.telemetry.context import (  # noqa: F401
+    TRACEPARENT_HEADER,
+    annotate,
+    current_traceparent,
+    parse_traceparent,
+    request_scope,
+    set_root_request,
+)
+from kart_tpu.telemetry.context import current as current_request  # noqa: F401
 from kart_tpu.telemetry.logs import configure_logging  # noqa: F401
+
+
+def reset(*, disable=True):
+    """Clear all recorded telemetry state — metric registry, trace buffer,
+    slow-request exemplars, rate samples, and any lingering root request
+    context (tests; fork children)."""
+    from kart_tpu.telemetry import context as _context
+
+    _core_reset(disable=disable)
+    _access.reset()
+    _context.clear_context()

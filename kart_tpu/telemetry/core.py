@@ -32,11 +32,17 @@ matching :data:`NAME_RE`, with the first segment drawn from
 """
 
 import json
+import logging
 import os
 import threading
 import time
+from bisect import bisect_left
 
 import re
+
+from kart_tpu.telemetry import context as _rctx
+
+L = logging.getLogger("kart_tpu.telemetry.core")
 
 #: allowed metric/span name shape: dotted lowercase snake segments
 NAME_RE = re.compile(r"^[a-z][a-z0-9_]*(\.[a-z][a-z0-9_]*)+$")
@@ -58,8 +64,21 @@ SUBSYSTEMS = frozenset(
         "runtime",   # backend probe, watchdogs
         "wc",        # working copies
         "bench",     # benchmark-internal probes
+        "telemetry", # the instrumentation's own health (dropped events)
     }
 )
+
+#: fixed log-spaced histogram bucket boundaries (seconds; every histogram
+#: in the tree observes seconds): a 1-2.5-5 ladder from 1ms to 100s, 16
+#: buckets + overflow. Quantile estimates interpolate inside the bucket
+#: containing the target rank, so the worst-case error is one bucket
+#: (≤2.5x at the ladder's widest step) — documented with the error bound
+#: in docs/OBSERVABILITY.md §9 and asserted by the accuracy test.
+BUCKET_BOUNDS = (
+    0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5,
+    1.0, 2.5, 5.0, 10.0, 25.0, 50.0, 100.0,
+)
+_NBUCKETS = len(BUCKET_BOUNDS) + 1  # + the +Inf overflow bucket
 
 # fast-path flags: one module-global bool test on the disabled path.
 # _METRICS_ON gates counters/gauges/histograms; _SPANS_ON gates span
@@ -71,11 +90,15 @@ _TRACE_ON = False
 _lock = threading.Lock()
 _counters = {}  # (name, labels_tuple) -> number
 _gauges = {}    # (name, labels_tuple) -> number
-_hists = {}     # (name, labels_tuple) -> [count, total, min, max]
+_hists = {}     # (name, labels_tuple) -> [count, total, min, max, buckets]
 _events = []    # finished span event dicts (trace mode)
 _EVENT_CAP = 500_000  # runaway guard: a capped trace is still loadable
+_events_dropped = 0   # spans past the cap (surfaced in the export summary)
+_drop_warned = False  # one warning log per process, not one per drop
 _trace_path = None
-_trace_epoch = None  # perf_counter origin for event timestamps
+_trace_epoch = None       # perf_counter origin for event timestamps
+_trace_epoch_unix = None  # wall-clock taken at the same instant — the
+                          # cross-process anchor trace merges re-base on
 
 _tls = threading.local()  # .stack: [child-duration accumulators]
 
@@ -101,6 +124,7 @@ def enable(*, metrics=None, spans=None, trace=None, trace_path=None):
     implies span aggregation; metrics implies span aggregation too (span
     histograms feed the stats exposition)."""
     global _METRICS_ON, _SPANS_ON, _TRACE_ON, _trace_path, _trace_epoch
+    global _trace_epoch_unix
     with _lock:
         if metrics is not None:
             _METRICS_ON = bool(metrics)
@@ -108,6 +132,7 @@ def enable(*, metrics=None, spans=None, trace=None, trace_path=None):
             _TRACE_ON = bool(trace)
             if _TRACE_ON and _trace_epoch is None:
                 _trace_epoch = time.perf_counter()
+                _trace_epoch_unix = time.time()
         if trace_path is not None:
             _trace_path = trace_path
         if spans is not None:
@@ -136,15 +161,19 @@ def reset(*, disable=True):
     """Clear all recorded state (tests; fork children clear inherited
     buffers). ``disable=False`` keeps the enablement flags."""
     global _METRICS_ON, _SPANS_ON, _TRACE_ON, _trace_path, _trace_epoch
+    global _trace_epoch_unix, _events_dropped, _drop_warned
     with _lock:
         _counters.clear()
         _gauges.clear()
         _hists.clear()
         _events.clear()
+        _events_dropped = 0
+        _drop_warned = False
         if disable:
             _METRICS_ON = _SPANS_ON = _TRACE_ON = False
             _trace_path = None
             _trace_epoch = None
+            _trace_epoch_unix = None
 
 
 def _key(name, labels):
@@ -170,25 +199,55 @@ def gauge_set(name, value, **labels):
 
 
 def observe(name, value, **labels):
-    """Record one histogram observation. No-op unless metrics are enabled."""
+    """Record one histogram observation (count/sum/min/max + the fixed
+    log-spaced :data:`BUCKET_BOUNDS` buckets feeding the p50/p90/p99
+    estimates). No-op unless metrics are enabled."""
     if not _METRICS_ON:
         return
-    _observe_locked_outer(name, value, labels)
-
-
-def _observe_locked_outer(name, value, labels):
     k = _key(name, labels)
     with _lock:
-        h = _hists.get(k)
-        if h is None:
-            _hists[k] = [1, value, value, value]
-        else:
-            h[0] += 1
-            h[1] += value
-            if value < h[2]:
-                h[2] = value
-            if value > h[3]:
-                h[3] = value
+        _hist_observe_locked(k, value)
+
+
+def _hist_observe_locked(k, value):
+    """One histogram observation; the caller holds ``_lock``."""
+    h = _hists.get(k)
+    if h is None:
+        buckets = [0] * _NBUCKETS
+        buckets[bisect_left(BUCKET_BOUNDS, value)] = 1
+        _hists[k] = [1, value, value, value, buckets]
+        return
+    h[0] += 1
+    h[1] += value
+    if value < h[2]:
+        h[2] = value
+    if value > h[3]:
+        h[3] = value
+    h[4][bisect_left(BUCKET_BOUNDS, value)] += 1
+
+
+def _quantile_locked(h, q):
+    """Estimate quantile ``q`` from a histogram's buckets: find the bucket
+    holding the target rank, interpolate linearly inside it, clamp to the
+    observed [min, max]. Error ≤ one bucket of the log ladder."""
+    count = h[0]
+    if count == 0:
+        return 0.0
+    target = q * count
+    cum = 0
+    for i, n in enumerate(h[4]):
+        if n == 0:
+            continue
+        cum += n
+        if cum >= target:
+            lo = BUCKET_BOUNDS[i - 1] if i > 0 else 0.0
+            hi = BUCKET_BOUNDS[i] if i < len(BUCKET_BOUNDS) else h[3]
+            if hi < lo:  # overflow bucket with max below the last bound
+                hi = lo
+            frac = (target - (cum - n)) / n
+            est = lo + (hi - lo) * frac
+            return min(max(est, h[2]), h[3])
+    return h[3]
 
 
 class _Span:
@@ -219,6 +278,7 @@ class _Span:
         return self
 
     def __exit__(self, exc_type, exc, tb):
+        global _events_dropped, _drop_warned
         if self._t0 is None:  # entered while disabled
             return False
         t0, self._t0 = self._t0, None  # handle reusable after exit
@@ -229,47 +289,54 @@ class _Span:
             stack[-1]._child += dur
         self_s = dur - self._child
         self._child = 0.0
+        # request-context stamping: one contextvar read per span exit —
+        # trace events and per-request exemplar trees carry the originating
+        # request/trace ids (docs/OBSERVABILITY.md §8)
+        ctx = _rctx.current()
+        if ctx is not None and ctx.recording:
+            ctx.record_span(self.name, t0, dur, self.attrs)
+        warn_drop = False
         with _lock:
             # span aggregation: cumulative seconds histogram under the span
             # name, self-time under <name>.self (nested phases never
             # double-book wall-clock in the self view)
-            k = (self.name, ())
-            h = _hists.get(k)
-            if h is None:
-                _hists[k] = [1, dur, dur, dur]
-            else:
-                h[0] += 1
-                h[1] += dur
-                if dur < h[2]:
-                    h[2] = dur
-                if dur > h[3]:
-                    h[3] = dur
-            ks = (self.name + ".self", ())
-            hs = _hists.get(ks)
-            if hs is None:
-                _hists[ks] = [1, self_s, self_s, self_s]
-            else:
-                hs[0] += 1
-                hs[1] += self_s
-                if self_s < hs[2]:
-                    hs[2] = self_s
-                if self_s > hs[3]:
-                    hs[3] = self_s
-            if _TRACE_ON and len(_events) < _EVENT_CAP:
-                t = threading.current_thread()
-                _events.append(
-                    {
-                        "name": self.name,
-                        "cat": self.name.split(".", 1)[0],
-                        "ph": "X",
-                        "ts": (t0 - _trace_epoch) * 1e6,
-                        "dur": dur * 1e6,
-                        "pid": os.getpid(),
-                        "tid": t.ident or 0,
-                        "tname": t.name,
-                        "args": self.attrs or {},
-                    }
-                )
+            _hist_observe_locked((self.name, ()), dur)
+            _hist_observe_locked((self.name + ".self", ()), self_s)
+            if _TRACE_ON:
+                if len(_events) < _EVENT_CAP:
+                    t = threading.current_thread()
+                    args = dict(self.attrs) if self.attrs else {}
+                    if ctx is not None:
+                        args["request_id"] = ctx.request_id
+                        args["trace_id"] = ctx.trace_id
+                    _events.append(
+                        {
+                            "name": self.name,
+                            "cat": self.name.split(".", 1)[0],
+                            "ph": "X",
+                            "ts": (t0 - _trace_epoch) * 1e6,
+                            "dur": dur * 1e6,
+                            "pid": os.getpid(),
+                            "tid": t.ident or 0,
+                            "tname": t.name,
+                            "args": args,
+                        }
+                    )
+                else:
+                    # saturation must not be silent: count the drop, log
+                    # once, and let the export summary surface the total
+                    _events_dropped += 1
+                    if _METRICS_ON:
+                        dk = ("telemetry.events_dropped", ())
+                        _counters[dk] = _counters.get(dk, 0) + 1
+                    if not _drop_warned:
+                        _drop_warned = warn_drop = True
+        if warn_drop:
+            L.warning(
+                "trace event buffer full (%d events): further spans are "
+                "dropped from the trace (aggregation continues)",
+                _EVENT_CAP,
+            )
         return False
 
     def __call__(self, fn):
@@ -296,17 +363,63 @@ def span(name, **attrs):
 # -- snapshots / export hooks ----------------------------------------------
 
 
+def _hist_snapshot_locked(h):
+    cum = []
+    running = 0
+    for bound, n in zip(BUCKET_BOUNDS, h[4]):
+        running += n
+        cum.append([bound, running])
+    cum.append(["+Inf", h[0]])
+    return {
+        "count": h[0],
+        "sum": h[1],
+        "min": h[2],
+        "max": h[3],
+        "p50": _quantile_locked(h, 0.50),
+        "p90": _quantile_locked(h, 0.90),
+        "p99": _quantile_locked(h, 0.99),
+        "buckets": cum,
+    }
+
+
 def snapshot():
     """-> {"counters": [...], "gauges": [...], "histograms": [...]} with
-    entries (name, labels_dict, value | {count,sum,min,max})."""
+    entries (name, labels_dict, value | {count,sum,min,max,p50,p90,p99,
+    buckets}). Histogram ``buckets`` are cumulative ``[le, count]`` pairs
+    over :data:`BUCKET_BOUNDS` (last ``le`` is ``"+Inf"``); the quantiles
+    are bucket-interpolated estimates (error ≤ one log bucket)."""
     with _lock:
         counters = [(n, dict(l), v) for (n, l), v in sorted(_counters.items())]
         gauges = [(n, dict(l), v) for (n, l), v in sorted(_gauges.items())]
         hists = [
-            (n, dict(l), {"count": h[0], "sum": h[1], "min": h[2], "max": h[3]})
+            (n, dict(l), _hist_snapshot_locked(h))
             for (n, l), h in sorted(_hists.items())
         ]
     return {"counters": counters, "gauges": gauges, "histograms": hists}
+
+
+def counters_snapshot():
+    """Shallow copy of the raw counter registry
+    ``{(name, labels_tuple): value}`` — the rate-window sampler's input
+    (cheap: tens of entries, no formatting)."""
+    with _lock:
+        return dict(_counters)
+
+
+def events_dropped_count():
+    """Span events dropped at the :data:`_EVENT_CAP` buffer bound since the
+    last reset — surfaced by the trace export summary."""
+    with _lock:
+        return _events_dropped
+
+
+def trace_epoch_unix():
+    """Wall-clock (``time.time()``) taken at the instant tracing was
+    enabled — the ``ts=0`` anchor of this process's trace, exported so
+    :func:`~kart_tpu.telemetry.sinks.merge_chrome_traces` can re-base
+    traces from processes that enabled tracing at different times."""
+    with _lock:
+        return _trace_epoch_unix
 
 
 def all_metric_names():
@@ -355,11 +468,14 @@ def dump_fork_child():
     events = drain_events()
     if not events:
         return
+    path = child_trace_sidecar_path()
     try:
-        with open(child_trace_sidecar_path(), "w") as f:
+        with open(path, "w") as f:
             json.dump(events, f)
-    except OSError:
-        pass  # trace side-files are best-effort
+    except OSError as e:
+        # best-effort stays best-effort (a worker must never die for its
+        # trace), but the loss is no longer silent
+        L.warning("trace side-file %s not written: %s", path, e)
 
 
 # -- explicit phase accounting ---------------------------------------------

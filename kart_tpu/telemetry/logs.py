@@ -22,7 +22,23 @@ import logging
 import os
 import sys
 
-LOG_FORMAT = "%(asctime)s %(levelname)s %(name)s %(message)s"
+from kart_tpu.telemetry import context as _rctx
+
+#: ``rid`` is the active request id (``-`` outside a request scope) — every
+#: log line a server emits while handling a request is correlatable with
+#: that request's access-log record and trace spans
+LOG_FORMAT = "%(asctime)s %(levelname)s %(name)s rid=%(rid)s %(message)s"
+
+
+class _RequestIdFilter(logging.Filter):
+    """Stamp the active request context's id onto every record our handler
+    formats (filters run per-handler, so records reaching host/root
+    handlers are untouched)."""
+
+    def filter(self, record):
+        ctx = _rctx.current()
+        record.rid = ctx.request_id if ctx is not None else "-"
+        return True
 
 _LEVELS = {
     "debug": logging.DEBUG,
@@ -68,6 +84,7 @@ def configure_logging(verbosity=0, stream=None):
         handler = logging.StreamHandler(stream or sys.stderr)
         handler._kart_tpu_handler = True
         handler.setFormatter(logging.Formatter(LOG_FORMAT))
+        handler.addFilter(_RequestIdFilter())
         logger.addHandler(handler)
     elif stream is not None:
         handler.setStream(stream)

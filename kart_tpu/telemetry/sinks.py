@@ -20,27 +20,34 @@ Formats (documented in docs/OBSERVABILITY.md):
 
 import glob
 import json
+import logging
 import os
 
 from kart_tpu.telemetry import core
 
+L = logging.getLogger("kart_tpu.telemetry.sinks")
+
 
 def write_chrome_trace(path=None):
     """Write every recorded span event (plus any fork-worker side-files) as
-    Chrome trace-event JSON. -> the path written, or None when there was
-    nothing to write."""
+    Chrome trace-event JSON. Events dropped at the buffer cap are surfaced
+    as a ``kart_events_dropped`` metadata event so a truncated trace says
+    so. -> the path written, or None when there was nothing to write."""
     path = path or core.trace_path() or core.default_trace_path()
+    dropped = core.events_dropped_count()
     events = core.drain_events()
     for side in sorted(glob.glob(f"{path}.child-*")):
         try:
             with open(side) as f:
                 events.extend(json.load(f))
-        except (OSError, ValueError):
-            pass
+        except (OSError, ValueError) as e:
+            # the merge stays best-effort (a bad side-file must not kill
+            # the parent's trace) but the skip is no longer silent
+            L.warning("trace side-file %s unreadable; skipped: %s", side, e)
         try:
             os.unlink(side)
-        except OSError:
-            pass
+        except OSError as e:
+            L.warning("merged trace side-file %s not removed: %s", side, e)
     if not events:
         return None
     # name the lanes: one metadata event per (pid, tid) observed
@@ -60,9 +67,67 @@ def write_chrome_trace(path=None):
     for e in events:
         e.pop("tname", None)
         trace_events.append(e)
+    if dropped:
+        trace_events.append(
+            {
+                "name": "kart_events_dropped",
+                "ph": "M",
+                "pid": os.getpid(),
+                "tid": 0,
+                "args": {"dropped": dropped},
+            }
+        )
+    epoch_unix = core.trace_epoch_unix()
+    if epoch_unix is not None:
+        # the wall-clock instant this process's ts=0 corresponds to: the
+        # cross-process anchor merge_chrome_traces re-bases on (two
+        # processes enable tracing at different times; without this their
+        # lanes land nowhere near each other in the merged timeline)
+        trace_events.append(
+            {
+                "name": "kart_trace_epoch",
+                "ph": "M",
+                "pid": os.getpid(),
+                "tid": 0,
+                "args": {"unix": epoch_unix},
+            }
+        )
     with open(path, "w") as f:
         json.dump({"traceEvents": trace_events, "displayTimeUnit": "ms"}, f)
     return path
+
+
+def merge_chrome_traces(out_path, paths):
+    """Merge several Chrome trace files (e.g. a client's ``kart --trace``
+    output and the server's ``KART_TRACE`` file) into one timeline: pids
+    keep the processes in separate lanes and the ``request_id``/
+    ``trace_id`` span args (docs/OBSERVABILITY.md §8) correlate them.
+    Timestamps are re-based onto one clock via each file's
+    ``kart_trace_epoch`` anchor (every file's ts is an offset from its own
+    process's enable instant); files without an anchor merge verbatim.
+    -> the number of events written."""
+    docs = []
+    for p in paths:
+        with open(p) as f:
+            events = json.load(f).get("traceEvents", [])
+        epoch = None
+        for e in events:
+            if e.get("name") == "kart_trace_epoch":
+                epoch = e.get("args", {}).get("unix")
+                break
+        docs.append((epoch, events))
+    anchored = [epoch for epoch, _ in docs if epoch is not None]
+    base = min(anchored) if anchored else None
+    merged = []
+    for epoch, events in docs:
+        shift_us = (epoch - base) * 1e6 if epoch is not None else 0.0
+        for e in events:
+            if shift_us and "ts" in e:
+                e = {**e, "ts": e["ts"] + shift_us}
+            merged.append(e)
+    with open(out_path, "w") as f:
+        json.dump({"traceEvents": merged, "displayTimeUnit": "ms"}, f)
+    return len(merged)
 
 
 def _prom_name(name):
@@ -107,7 +172,11 @@ def prometheus_text(snapshot=None):
         lines.append(f"{pname}{_prom_labels(labels)} {_fmt(value)}")
     for name, labels, h in snap["histograms"]:
         pname = _prom_name(name)
-        head(pname, "summary")
+        head(pname, "histogram")
+        for le, cum in h.get("buckets", ()):
+            ble = dict(labels)
+            ble["le"] = le if isinstance(le, str) else f"{le:g}"
+            lines.append(f"{pname}_bucket{_prom_labels(ble)} {_fmt(cum)}")
         lines.append(f"{pname}_count{_prom_labels(labels)} {_fmt(h['count'])}")
         lines.append(f"{pname}_sum{_prom_labels(labels)} {_fmt(h['sum'])}")
     return "\n".join(lines) + ("\n" if lines else "")

@@ -17,6 +17,8 @@ discipline). This module is the orchestrator; the machinery lives in:
 * :mod:`kart_tpu.tiles.pyramid` — batch export walker (`kart export tiles`)
 """
 
+import time
+
 from kart_tpu import telemetry as tm
 from kart_tpu.tiles.cache import etag_for, tile_cache_for, tile_key
 from kart_tpu.tiles.encode import (
@@ -118,11 +120,18 @@ def serve_tile(repo, ref, ds_path, z, x, y, *, layers=None,
     if cache is not None:
         mode, got = cache.lookup_or_begin(key)
         if mode == "hit":
+            tm.annotate(tile_cache="hit")
             tm.incr("tiles.served")
             tm.incr("tiles.bytes_out", len(got))
             return got, etag, True
         token = got  # fill token, or None (wedged-filler bypass)
     try:
+        # annotate/observe only when a cache actually exists: a server
+        # with KART_TILE_CACHE=0 must not report a 100% miss rate on a
+        # cache it doesn't have (the encode cost shows as tiles.encode)
+        if cache is not None:
+            tm.annotate(tile_cache="miss")
+        t_fill = time.perf_counter()
         source = source_for(repo, commit_oid, ds_path)
         payload, _stats = encode_tile(
             source, z, x, y, layers=layers, extent=extent, buffer=buffer,
@@ -134,6 +143,10 @@ def serve_tile(repo, ref, ds_path, z, x, y, *, layers=None,
         raise
     if token is not None:
         token.publish(payload)
+    if cache is not None:
+        # cold-fill latency as a bucketed histogram: the cache's miss cost
+        # is quantile-reportable (p50/p99) next to the request latency
+        tm.observe("tiles.cache.fill_seconds", time.perf_counter() - t_fill)
     tm.incr("tiles.served")
     tm.incr("tiles.bytes_out", len(payload))
     return payload, etag, False

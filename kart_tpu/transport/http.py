@@ -57,6 +57,7 @@ import re
 import struct
 import tempfile
 import threading
+import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from urllib.error import HTTPError
 from urllib.parse import urlsplit
@@ -65,6 +66,8 @@ from urllib.request import Request, urlopen
 from kart_tpu import faults
 from kart_tpu import telemetry as tm
 from kart_tpu.core.odb import ObjectMissing
+from kart_tpu.telemetry import access as rq_access
+from kart_tpu.telemetry import context as rq_context
 from kart_tpu.transport.pack import read_pack, write_pack
 
 API = "/api/v1"
@@ -256,6 +259,23 @@ class KartRequestHandler(BaseHTTPRequestHandler):
 
     # -- plumbing -----------------------------------------------------------
 
+    def send_response(self, code, message=None):
+        # status capture for the access log + trace-context echo: every
+        # response carries the request's traceparent back to the client
+        self._kart_status = code
+        super().send_response(code, message)
+        traceparent = rq_context.current_traceparent()
+        if traceparent:
+            self.send_header(rq_context.TRACEPARENT_HEADER, traceparent)
+
+    def send_header(self, keyword, value):
+        if keyword.lower() == "content-length":
+            try:
+                self._kart_bytes_out = int(value)
+            except (TypeError, ValueError):
+                pass
+        super().send_header(keyword, value)
+
     def _json(self, status, payload, headers=None):
         raw = json.dumps(payload).encode()
         self.send_response(status)
@@ -330,6 +350,7 @@ class KartRequestHandler(BaseHTTPRequestHandler):
             return True
         self._leave()
         tm.incr("server.shed")  # exposition: kart_server_shed_total
+        tm.annotate(shed=True)  # access-log: this request was refused
         retry_after = _env_int("KART_SERVE_RETRY_AFTER", 1)
         raw = json.dumps(
             {"error": f"Server over capacity ({limit} inflight); retry"}
@@ -351,9 +372,78 @@ class KartRequestHandler(BaseHTTPRequestHandler):
 
     # -- routes -------------------------------------------------------------
 
+    #: route -> access-log verb (matches the transport.server.requests
+    #: verb labels, so rates and latency histograms join up)
+    _VERBS = {
+        f"{API}/stats": "stats",
+        f"{API}/refs": "ls-refs",
+        f"{API}/fetch-pack": "fetch-pack",
+        f"{API}/fetch-blobs": "fetch-blobs",
+        f"{API}/receive-pack": "receive-pack",
+    }
+
+    def _verb_for(self, path):
+        if path.startswith(f"{API}/tiles/"):
+            return "tiles"
+        return self._VERBS.get(path, "other")
+
     def do_GET(self):
+        self._dispatch("GET")
+
+    def do_POST(self):
+        self._dispatch("POST")
+
+    def _dispatch(self, method):
+        """Every request runs inside a request scope (trace context adopted
+        from the client's ``traceparent`` header, or minted here), under a
+        ``transport.request`` span, and books one access-log record +
+        latency observation on the way out — whatever the handler did."""
         try:
             path = urlsplit(self.path).path.rstrip("/")
+        except ValueError:
+            # a malformed request line (e.g. a broken IPv6 literal) must
+            # still get an answer and an access-log record, not a dead
+            # handler thread
+            path = None
+        verb = self._verb_for(path) if path is not None else "other"
+        self._kart_status = None
+        self._kart_bytes_out = 0
+        t0 = time.perf_counter()
+        with rq_context.request_scope(
+            verb=verb,
+            traceparent=self.headers.get(rq_context.TRACEPARENT_HEADER),
+            record=rq_access.slow_threshold() is not None,
+            # a request without a traceparent mints a fresh trace (handler
+            # threads start context-free anyway; this pins the contract)
+            inherit=False,
+        ) as ctx:
+            try:
+                with tm.span("transport.request", verb=verb):
+                    if path is None:
+                        self._json(
+                            400,
+                            {"error": f"Malformed request path: {self.path!r}"},
+                        )
+                    else:
+                        self._route(method, path)
+            except Exception as e:  # surface server errors to the client
+                self._json(500, {"error": f"{type(e).__name__}: {e}"})
+            finally:
+                try:
+                    bytes_in = int(self.headers.get("Content-Length") or 0)
+                except (TypeError, ValueError):
+                    bytes_in = 0  # a bogus header must not kill the record
+                rq_access.record_request(
+                    verb=verb,
+                    status=self._kart_status,
+                    bytes_in=bytes_in,
+                    bytes_out=self._kart_bytes_out,
+                    seconds=time.perf_counter() - t0,
+                    ctx=ctx,
+                )
+
+    def _route(self, method, path):
+        if method == "GET":
             if path == f"{API}/stats":
                 # never shed the stats endpoint: observability of a server
                 # in overload is the whole point of having it
@@ -368,12 +458,7 @@ class KartRequestHandler(BaseHTTPRequestHandler):
                 self._json(404, {"error": f"No such endpoint: {self.path}"})
             finally:
                 self._leave()
-        except Exception as e:
-            self._json(500, {"error": f"{type(e).__name__}: {e}"})
-
-    def do_POST(self):
-        path = urlsplit(self.path).path.rstrip("/")
-        try:
+        else:
             if not self._admit():
                 return
             try:
@@ -386,8 +471,6 @@ class KartRequestHandler(BaseHTTPRequestHandler):
                 self._json(404, {"error": f"No such endpoint: {self.path}"})
             finally:
                 self._leave()
-        except Exception as e:  # surface server errors to the client
-            self._json(500, {"error": f"{type(e).__name__}: {e}"})
 
     def _handle_refs(self):
         from kart_tpu.transport.service import ls_refs_info
@@ -435,6 +518,7 @@ class KartRequestHandler(BaseHTTPRequestHandler):
             )
         ref, ds_path = parts[0], "/".join(parts[1:-3])
         z, x, y = parts[-3:]
+        tm.annotate(ref=ref, dataset=ds_path, tile=f"{z}/{x}/{y}")
         params = parse_qs(urlsplit(self.path).query)
         layers = params.get("layers", [None])[0]
         try:
@@ -447,6 +531,7 @@ class KartRequestHandler(BaseHTTPRequestHandler):
             )
             if self._if_none_match_hits(self.headers.get("If-None-Match"), etag):
                 # commit-addressed: a matching validator can never be stale
+                tm.annotate(revalidated=True)
                 self.send_response(304)
                 self.send_header("ETag", etag)
                 self.send_header("Content-Length", "0")
@@ -479,10 +564,23 @@ class KartRequestHandler(BaseHTTPRequestHandler):
 
     def _handle_stats(self):
         """Prometheus-style text exposition of this server process's metric
-        registry (`kart stats <url>` reads this)."""
+        registry (`kart stats <url>` reads this). ``?format=json`` returns
+        the structured stats document instead — bucketed histograms with
+        quantile estimates, windowed rates, the slow-request exemplar ring
+        and live inflight/queue depth (what ``kart top`` renders)."""
+        from urllib.parse import parse_qs
+
         from kart_tpu.telemetry import sinks
 
         tm.incr("transport.server.requests", verb="stats")
+        params = parse_qs(urlsplit(self.path).query)
+        if params.get("format", [""])[0] == "json":
+            return self._json(
+                200,
+                rq_access.stats_payload(
+                    extra={"inflight": self.server.inflight}
+                ),
+            )
         raw = sinks.prometheus_text().encode()
         self.send_response(200)
         self.send_header("Content-Type", "text/plain; version=0.0.4")
@@ -520,6 +618,7 @@ class KartRequestHandler(BaseHTTPRequestHandler):
             offset = self._range_offset(plan.etag, length)
             if offset:
                 tm.incr("server.range_resumes")
+                tm.annotate(range_resume=True)
                 # a validated byte-range request IS a resumed fetch, same
                 # as a non-empty oid-exclusion list on the wire field —
                 # but count each resumed request once (a range retry of an
@@ -636,10 +735,12 @@ def serve(repo, host="127.0.0.1", port=8470, *, in_thread=False):
 
 
 class _CountingReader:
-    """File wrapper tracking the response bytes consumed so far — used to
-    measure the framed-header prefix exactly (``read_framed`` reads exact
-    sizes, no read-ahead), which anchors the ``Range: bytes=N-`` resume
-    offsets the drain derives from its own record accounting."""
+    """Byte-counting file pass-through. Two users: the fetch client
+    measures the framed-header prefix exactly (``read_framed`` reads exact
+    sizes, no read-ahead) to anchor ``Range: bytes=N-`` resume offsets;
+    the stdio server wraps both pipe ends so per-op deltas feed the
+    access-log bytes_in/bytes_out fields (write/flush pass through with
+    the same accounting)."""
 
     __slots__ = ("_fp", "count")
 
@@ -651,6 +752,13 @@ class _CountingReader:
         data = self._fp.read(n)
         self.count += len(data)
         return data
+
+    def write(self, data):
+        self.count += len(data)
+        return self._fp.write(data)
+
+    def flush(self):
+        self._fp.flush()
 
 
 def _pack_body_source(resp):
@@ -690,9 +798,20 @@ class HttpRemote:
     def reset(self, *_):
         """No per-connection state to tear down between retries."""
 
+    @staticmethod
+    def _trace_headers():
+        """The cross-process trace-context header for the active request
+        scope (docs/OBSERVABILITY.md §8): the server adopts the id, so its
+        spans and access-log lines name *this* logical request."""
+        traceparent = rq_context.current_traceparent()
+        if traceparent is None:
+            return {}
+        return {rq_context.TRACEPARENT_HEADER: traceparent}
+
     def _get(self, path):
         try:
-            with urlopen(Request(self.base + path), timeout=http_timeout()) as resp:
+            req = Request(self.base + path, headers=self._trace_headers())
+            with urlopen(req, timeout=http_timeout()) as resp:
                 return json.loads(resp.read().decode())
         except HTTPError as e:
             raise HttpTransportError(
@@ -717,6 +836,7 @@ class HttpRemote:
         all_headers = {
             "Content-Type": "application/x-kartpack" if raw else "application/json"
         }
+        all_headers.update(self._trace_headers())
         if headers:
             all_headers.update(headers)
         body = data if raw else json.dumps(data).encode()
@@ -767,9 +887,14 @@ class HttpRemote:
     # -- verbs --------------------------------------------------------------
 
     def ls_refs(self):
-        return self.retry.call(
-            lambda: self._get(f"{API}/refs"), label="ls-refs", on_retry=self.reset
-        )
+        # one request scope per verb call: every retry attempt carries the
+        # same request id on the wire, so the server's access log shows one
+        # logical request with N attempts, not N anonymous requests
+        with rq_context.request_scope(verb="ls-refs"):
+            return self.retry.call(
+                lambda: self._get(f"{API}/refs"), label="ls-refs",
+                on_retry=self.reset,
+            )
 
     def fetch_pack(self, dst_repo, wants, *, haves=(), have_shallow=(),
                    depth=None, filter_spec=None, exclude=None):
@@ -849,7 +974,10 @@ class HttpRemote:
                 )
             return header
 
-        return self.retry.call(attempt, label="fetch-pack", on_retry=self.reset)
+        with rq_context.request_scope(verb="fetch-pack"):
+            return self.retry.call(
+                attempt, label="fetch-pack", on_retry=self.reset
+            )
 
     def fetch_blobs(self, dst_repo, oids):
         from kart_tpu.transport.retry import drain_pack_salvaging
@@ -867,7 +995,10 @@ class HttpRemote:
                 drain_pack_salvaging(dst_repo.odb, pack_fp, received)
             return header
 
-        header = self.retry.call(attempt, label="fetch-blobs", on_retry=self.reset)
+        with rq_context.request_scope(verb="fetch-blobs"):
+            header = self.retry.call(
+                attempt, label="fetch-blobs", on_retry=self.reset
+            )
         if header.get("missing"):
             raise HttpTransportError(
                 f"Remote is missing promised objects: {header['missing'][:5]}"
@@ -910,9 +1041,10 @@ class HttpRemote:
                     f"{API}/receive-pack", buf, raw=True, length=length
                 )
 
-            resp = self.retry.call(
-                attempt, label="receive-pack", retryable=retryable,
-                on_retry=self.reset,
-            )
+            with rq_context.request_scope(verb="receive-pack"):
+                resp = self.retry.call(
+                    attempt, label="receive-pack", retryable=retryable,
+                    on_retry=self.reset,
+                )
         with resp:
             return json.loads(resp.read().decode())

@@ -290,22 +290,28 @@ def fetch(repo, remote_name="origin", *, depth=None, filter_spec=None, quiet=Tru
         exclude = _read_resume_exclusions(repo)
         if exclude:
             tm.incr("transport.resume_seeded_oids", len(exclude))
+        # one fetch = one trace: the verb calls below (ls-refs, fetch-pack
+        # and each retry attempt inside them) inherit this scope's trace
+        # id, so the whole retry ladder correlates with the server's
+        # access-log/span records (docs/OBSERVABILITY.md §8) even when no
+        # CLI root context exists (library use, bench workers)
         try:
-            info = net.ls_refs()
-            branch_tips = info["heads"]
-            tag_tips = info["tags"]
-            head_branch = info.get("head_branch")
-            wants = list(branch_tips.values()) + list(tag_tips.values())
-            repo.write_gitdir_file(FETCH_RESUME_FILE, remote_name)
-            header = net.fetch_pack(
-                repo,
-                wants,
-                haves=[oid for _, oid in repo.refs.iter_refs("refs/")],
-                have_shallow=read_shallow(repo),
-                depth=depth,
-                filter_spec=filter_spec,
-                exclude=exclude,
-            )
+            with tm.request_scope(verb="fetch", remote=remote_name):
+                info = net.ls_refs()
+                branch_tips = info["heads"]
+                tag_tips = info["tags"]
+                head_branch = info.get("head_branch")
+                wants = list(branch_tips.values()) + list(tag_tips.values())
+                repo.write_gitdir_file(FETCH_RESUME_FILE, remote_name)
+                header = net.fetch_pack(
+                    repo,
+                    wants,
+                    haves=[oid for _, oid in repo.refs.iter_refs("refs/")],
+                    have_shallow=read_shallow(repo),
+                    depth=depth,
+                    filter_spec=filter_spec,
+                    exclude=exclude,
+                )
         except (HttpTransportError, PackFormatError, OSError) as e:
             # the marker stays — now carrying the salvaged oids, so the
             # next `kart fetch` resumes without rescanning the store
@@ -472,6 +478,16 @@ def _push_network(repo, remote_name, net, refspecs, *, force, set_upstream):
     *server's* auto-rebase (docs/SERVING.md §6): clean merges land without
     any client round-trip, real conflicts come back as one terminal
     structured report rendered like a local ``kart merge`` conflict."""
+    # one push = one trace (see the matching scope in fetch())
+    with tm.request_scope(verb="push", remote=remote_name):
+        return _push_network_traced(
+            repo, remote_name, net, refspecs, force=force,
+            set_upstream=set_upstream,
+        )
+
+
+def _push_network_traced(repo, remote_name, net, refspecs, *, force,
+                         set_upstream):
     from kart_tpu.transport.http import HttpTransportError, have_closure
 
     try:

@@ -161,6 +161,10 @@ class RetryPolicy:
                     delay = floored
                 tm.incr("transport.retries", verb=label or "operation")
                 tm.incr("transport.backoff_seconds", delay)
+                # the retry ladder joins the request's trace: all attempts
+                # run inside one verb scope (one request id on the wire)
+                # and the warning below carries it as rid= — the server's
+                # access log shows one logical request with N attempts
                 L.warning(
                     "transport %s failed (%s: %s); retrying %d/%d in %.2fs",
                     label or "operation",

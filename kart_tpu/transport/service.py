@@ -416,6 +416,8 @@ def serve_fetch_pack(repo, req, *, use_cache=True):
         return FetchPlan(header, None, enum, etag, False)
     mode, got = cache.lookup_or_begin(key)
     if mode == "hit":
+        # the cache decision joins this request's access-log record
+        tm.annotate(enum_cache="hit")
         if got.data is not None:
             return FetchPlan(got.header, got.data, None, got.etag, True)
         return FetchPlan(
@@ -426,6 +428,7 @@ def serve_fetch_pack(repo, req, *, use_cache=True):
             True,
         )
     try:
+        tm.annotate(enum_cache="miss")
         enum, header = make_fetch_enum(
             repo, req, count_request=False, record_emitted=True
         )
@@ -930,6 +933,10 @@ def _land_quarantined(repo, q, header, thread_lock):
 
     def reject(rejection):
         tm.incr("transport.server.receive_outcomes", outcome=rejection[0])
+        tm.annotate(
+            rejected=getattr(rejection, "code", None) or rejection[0],
+            ref=getattr(rejection, "ref", None),
+        )
         q.discard()
         return rejection
 
@@ -941,6 +948,11 @@ def _land_quarantined(repo, q, header, thread_lock):
         )
         with slot as waited:
             info["queue_wait_seconds"] = round(waited or 0.0, 6)
+            if upd is not None:
+                tm.annotate(
+                    ref=upd["ref"],
+                    queue_wait_seconds=info["queue_wait_seconds"] or None,
+                )
             view = _QuarantineRepoView(repo, q.odb)
             for attempt in range(1, attempts_cap + 1):
                 info["cas_attempts"] = attempt
@@ -992,6 +1004,10 @@ def _land_quarantined(repo, q, header, thread_lock):
                             )
                             if info["rebased"]:
                                 tm.incr("server.rebase.landed")
+                                tm.annotate(
+                                    rebased=True,
+                                    rebase_mode=info.get("mode"),
+                                )
                             updated = _apply_validated_updates(repo, header)
                             return "ok", {"updated": updated, "rebase": info}
                         current = (

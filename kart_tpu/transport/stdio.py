@@ -23,9 +23,13 @@ import json
 import os
 import shlex
 import subprocess
+import time
 
+from kart_tpu.telemetry import access as rq_access
+from kart_tpu.telemetry import context as rq_context
 from kart_tpu.transport.http import (
     _HEADER_LEN,
+    _CountingReader,
     HttpTransportError,
     read_framed,
     write_framed,
@@ -224,6 +228,20 @@ class StdioRemote:
         discarded."""
         from kart_tpu.runtime import Watchdog
 
+        # trace-context wire field (docs/OBSERVABILITY.md §8): the server
+        # adopts this request's id for its spans/access-log lines
+        traceparent = rq_context.current_traceparent()
+        if traceparent is not None:
+            if callable(header):
+                inner = header
+                header = lambda: {  # noqa: E731 - deferred header, same shape
+                    **inner(),
+                    rq_context.TRACEPARENT_HEADER: traceparent,
+                }
+            else:
+                header = {
+                    **header, rq_context.TRACEPARENT_HEADER: traceparent
+                }
         proc = self._ensure()
         try:
             write_framed(proc.stdin, header, objects)
@@ -284,11 +302,14 @@ class StdioRemote:
     # -- verbs (HttpRemote-compatible) ---------------------------------------
 
     def ls_refs(self):
-        return self.retry.call(
-            lambda: self._rpc({"op": "refs"})[0],
-            label="ls-refs",
-            on_retry=self.reset,
-        )
+        # one request scope per verb call (retry attempts share the id on
+        # the wire — the server logs one logical request, N attempts)
+        with rq_context.request_scope(verb="ls-refs"):
+            return self.retry.call(
+                lambda: self._rpc({"op": "refs"})[0],
+                label="ls-refs",
+                on_retry=self.reset,
+            )
 
     def fetch_pack(self, dst_repo, wants, *, haves=(), have_shallow=(),
                    depth=None, filter_spec=None, exclude=None):
@@ -311,7 +332,10 @@ class StdioRemote:
             )
             return resp
 
-        return self.retry.call(attempt, label="fetch-pack", on_retry=self.reset)
+        with rq_context.request_scope(verb="fetch-pack"):
+            return self.retry.call(
+                attempt, label="fetch-pack", on_retry=self.reset
+            )
 
     def fetch_blobs(self, dst_repo, oids):
         from kart_tpu.transport.retry import drain_pack_salvaging
@@ -328,7 +352,10 @@ class StdioRemote:
             )
             return resp
 
-        resp = self.retry.call(attempt, label="fetch-blobs", on_retry=self.reset)
+        with rq_context.request_scope(verb="fetch-blobs"):
+            resp = self.retry.call(
+                attempt, label="fetch-blobs", on_retry=self.reset
+            )
         if resp.get("missing"):
             raise StdioTransportError(
                 f"Remote is missing promised objects: {resp['missing'][:5]}"
@@ -358,10 +385,11 @@ class StdioRemote:
             )
             return resp
 
-        return self.retry.call(
-            attempt, label="receive-pack", retryable=retryable,
-            on_retry=self.reset,
-        )
+        with rq_context.request_scope(verb="receive-pack"):
+            return self.retry.call(
+                attempt, label="receive-pack", retryable=retryable,
+                on_retry=self.reset,
+            )
 
 
 # ---------------------------------------------------------------------------
@@ -369,11 +397,27 @@ class StdioRemote:
 # ---------------------------------------------------------------------------
 
 
+#: known stdio ops -> the HTTP server's verb labels (one name per verb
+#: across transports); anything else books as "other"
+_STDIO_VERBS = {
+    "refs": "ls-refs",
+    "stats": "stats",
+    "fetch-pack": "fetch-pack",
+    "fetch-blobs": "fetch-blobs",
+    "receive-pack": "receive-pack",
+}
+
+
 def serve_stdio(repo, in_fp, out_fp):
     """Serve one connection: read framed requests from ``in_fp`` until EOF,
     answer each on ``out_fp``. stdout discipline is absolute — anything else
-    the process prints must go to stderr or the frames corrupt."""
-    from kart_tpu import telemetry
+    the process prints must go to stderr or the frames corrupt.
+
+    Every op runs inside a request scope adopted from the frame's
+    ``traceparent`` field (echoed back on the response frame), under a
+    ``transport.request`` span, and books one access-log record — the
+    stdio server reports requests exactly like the HTTP server."""
+    from kart_tpu import telemetry as tm
     from kart_tpu.transport.pack import PackFormatError
     from kart_tpu.transport.service import (
         collect_blobs,
@@ -384,11 +428,13 @@ def serve_stdio(repo, in_fp, out_fp):
 
     # a spawned server honours KART_LOG (stderr only — stdout is frames)
     # and serves its metric registry via the "stats" op
-    telemetry.configure_logging()
-    telemetry.enable(metrics=True)
+    tm.configure_logging()
+    tm.enable(metrics=True)
+    in_c = _CountingReader(in_fp)
+    out_c = _CountingReader(out_fp)
 
     while True:
-        raw = in_fp.read(_HEADER_LEN.size)
+        raw = in_c.read(_HEADER_LEN.size)
         if not raw:
             return  # clean EOF: client closed the connection
         if len(raw) != _HEADER_LEN.size:
@@ -397,69 +443,124 @@ def serve_stdio(repo, in_fp, out_fp):
         if n > 1 << 24:
             raise StdioTransportError("Request header implausibly large")
         try:
-            header = json.loads(in_fp.read(n).decode())
+            header = json.loads(in_c.read(n).decode())
         except ValueError as e:
             # stream position is unknowable now: answer + close
-            write_framed(out_fp, {"error": f"Bad request header: {e}"}, ())
-            out_fp.flush()
+            write_framed(out_c, {"error": f"Bad request header: {e}"}, ())
+            out_c.flush()
             return
         op = header.get("op")
+        # access-log/histogram verb labels: known ops map to the HTTP
+        # server's names (the "refs" op is the ls-refs verb); anything
+        # else is "other" — a client-chosen junk op must not mint
+        # unbounded metric label values or write itself into the access
+        # log (the HTTP side gets the same from _verb_for)
+        verb = _STDIO_VERBS.get(op, "other")
 
-        try:
-            if op == "receive-pack":
-                # the request pack drains into quarantine and migrates only
-                # after checksum + ref preconditions pass (a torn push
-                # leaves the store byte-identical and desyncs the stream,
-                # handled by the PackFormatError close below); a CAS lost
-                # to a contending writer is auto-rebased server-side, and
-                # a structured rejection's extras ride the error frame
-                from kart_tpu.transport.protocol import rejection_wire_fields
+        t0 = time.perf_counter()
+        in0, out0 = in_c.count, out_c.count
+        status = "ok"
+        keep_serving = True
+        with rq_context.request_scope(
+            verb=verb,
+            traceparent=header.get(rq_context.TRACEPARENT_HEADER),
+            record=rq_access.slow_threshold() is not None,
+            # a frame without a traceparent mints a fresh trace — it must
+            # not inherit this process's own CLI root context
+            inherit=False,
+        ) as ctx:
+            # the response frame echoes the context back to the client —
+            # both directions of the wire carry the same request id
+            echo = {rq_context.TRACEPARENT_HEADER: ctx.traceparent()}
 
-                result = quarantined_receive(repo, header, in_fp)
-                if result[0] == "ok":
-                    write_framed(out_fp, result[1], ())
-                else:
-                    frame = {"error": result[1], "status": result[0]}
-                    frame.update(rejection_wire_fields(result))
-                    write_framed(out_fp, frame, ())
-            else:
-                # every other op carries an empty request pack
-                for _ in read_pack(in_fp):
-                    pass
-                if op == "refs":
-                    write_framed(out_fp, ls_refs_info(repo), ())
-                elif op == "stats":
-                    from kart_tpu import telemetry
-                    from kart_tpu.telemetry import sinks
-
-                    telemetry.incr("transport.server.requests", verb="stats")
+            def respond(frame_header, objects=()):
+                if callable(frame_header):
+                    inner = frame_header
                     write_framed(
-                        out_fp, {"metrics": sinks.prometheus_text()}, ()
+                        out_c, lambda: {**inner(), **echo}, objects
                     )
-                elif op == "fetch-pack":
-                    # same code path and counters as the HTTP server, but
-                    # uncached: a serve-stdio process serves exactly one
-                    # connection and a client retry respawns it, so a memo
-                    # could never be re-hit. The plan streams straight to
-                    # the pipe (no materialise spool — stdio has no
-                    # byte-range to serve from an offset)
-                    plan = serve_fetch_pack(repo, header, use_cache=False)
-                    write_framed(out_fp, plan.header, plan.source)
-                elif op == "fetch-blobs":
-                    resp_header, objects = collect_blobs(
-                        repo, header.get("oids", [])
-                    )
-                    write_framed(out_fp, resp_header, objects)
                 else:
-                    write_framed(out_fp, {"error": f"Unknown op {op!r}"}, ())
-        except PackFormatError as e:
-            # a corrupt request pack desyncs the stream: answer + close
-            write_framed(out_fp, {"error": f"Bad request pack: {e}"}, ())
-            out_fp.flush()
+                    write_framed(out_c, {**frame_header, **echo}, objects)
+
+            try:
+                with tm.span("transport.request", verb=verb):
+                    if op == "receive-pack":
+                        # the request pack drains into quarantine and
+                        # migrates only after checksum + ref preconditions
+                        # pass (a torn push leaves the store byte-identical
+                        # and desyncs the stream, handled by the
+                        # PackFormatError close below); a CAS lost to a
+                        # contending writer is auto-rebased server-side,
+                        # and a structured rejection's extras ride the
+                        # error frame
+                        from kart_tpu.transport.protocol import (
+                            rejection_wire_fields,
+                        )
+
+                        result = quarantined_receive(repo, header, in_c)
+                        if result[0] == "ok":
+                            respond(result[1])
+                        else:
+                            status = result[0]
+                            frame = {"error": result[1], "status": result[0]}
+                            frame.update(rejection_wire_fields(result))
+                            respond(frame)
+                    else:
+                        # every other op carries an empty request pack
+                        for _ in read_pack(in_c):
+                            pass
+                        if op == "refs":
+                            respond(ls_refs_info(repo))
+                        elif op == "stats":
+                            from kart_tpu.telemetry import sinks
+
+                            tm.incr(
+                                "transport.server.requests", verb="stats"
+                            )
+                            if header.get("format") == "json":
+                                respond({"stats": rq_access.stats_payload()})
+                            else:
+                                respond({"metrics": sinks.prometheus_text()})
+                        elif op == "fetch-pack":
+                            # same code path and counters as the HTTP
+                            # server, but uncached: a serve-stdio process
+                            # serves exactly one connection and a client
+                            # retry respawns it, so a memo could never be
+                            # re-hit. The plan streams straight to the pipe
+                            # (no materialise spool — stdio has no
+                            # byte-range to serve from an offset)
+                            plan = serve_fetch_pack(
+                                repo, header, use_cache=False
+                            )
+                            respond(plan.header, plan.source)
+                        elif op == "fetch-blobs":
+                            resp_header, objects = collect_blobs(
+                                repo, header.get("oids", [])
+                            )
+                            respond(resp_header, objects)
+                        else:
+                            status = "error"
+                            respond({"error": f"Unknown op {op!r}"})
+            except PackFormatError as e:
+                # a corrupt request pack desyncs the stream: answer + close
+                status = "error"
+                keep_serving = False
+                respond({"error": f"Bad request pack: {e}"})
+            except Exception as e:
+                # op-level failure (bad filter spec, missing object, ...):
+                # the request was fully read, so report and keep serving —
+                # the HTTP server's 500 equivalent
+                status = "error"
+                respond({"error": f"{type(e).__name__}: {e}"})
+            finally:
+                rq_access.record_request(
+                    verb=verb,
+                    status=status,
+                    bytes_in=in_c.count - in0,
+                    bytes_out=out_c.count - out0,
+                    seconds=time.perf_counter() - t0,
+                    ctx=ctx,
+                )
+        out_c.flush()
+        if not keep_serving:
             return
-        except Exception as e:
-            # op-level failure (bad filter spec, missing object, ...): the
-            # request was fully read, so report and keep serving — the HTTP
-            # server's 500 equivalent
-            write_framed(out_fp, {"error": f"{type(e).__name__}: {e}"}, ())
-        out_fp.flush()

@@ -187,6 +187,26 @@ NEW_KEYS += [
 ]
 
 
+#: keys added by ISSUE 12 (request-scoped observability: the storm bench
+#: now also reads the *server-reported* per-verb latency quantiles from
+#: the new bucketed histograms and checks they agree with the
+#: client-measured percentiles within the documented one-bucket error
+#: bound — the server's tail latency is a first-class number, not a
+#: client-side recomputation)
+NEW_KEYS += [
+    "serve_storm_server_p50_seconds",
+    "serve_storm_server_p99_seconds",
+    "serve_storm_server_p99_bucket_distance",
+    "serve_storm_server_p99_agrees",
+    # the coupled-regime agreement leg (serial, uncached: each request is
+    # dominated by the server's own walk, so server-estimated and
+    # client-measured p99 must land within one log bucket)
+    "serve_serial_server_p99_seconds",
+    "serve_serial_p99_bucket_distance",
+    "serve_serial_server_p99_agrees",
+]
+
+
 def test_bench_emits_every_recorded_key():
     with open(os.path.join(REPO_ROOT, "bench.py")) as f:
         src = f.read()

@@ -74,6 +74,7 @@ def test_reverted_pr7_fill_token_abandon_fires_ktl013(tmp_path):
     fixed = _read("kart_tpu/transport/service.py")
     fixed_block = (
         "    try:\n"
+        '        tm.annotate(enum_cache="miss")\n'
         "        enum, header = make_fetch_enum(\n"
         "            repo, req, count_request=False, record_emitted=True\n"
         "        )\n"

@@ -1,0 +1,692 @@
+"""Tier-1 tests for ISSUE 12 — request-scoped observability: cross-process
+trace propagation (traceparent header/frame field, retry attempts sharing
+one request id, HTTP/stdio parity), bucketed latency histograms with
+quantile estimates, slow-request exemplars, the JSON-lines access log,
+windowed rates, ``kart top``, the mergeable client+server Chrome traces,
+and the trace-buffer saturation counter."""
+
+import io
+import json
+import os
+import stat
+import sys
+import threading
+import time
+
+import pytest
+
+from helpers import make_imported_repo
+from kart_tpu import telemetry
+from kart_tpu.telemetry import access, context, core, sinks
+
+
+@pytest.fixture(autouse=True)
+def clean_registry():
+    telemetry.reset()
+    yield
+    telemetry.reset()
+
+
+# -- trace context ----------------------------------------------------------
+
+
+def test_traceparent_roundtrip():
+    with telemetry.request_scope(verb="fetch-pack") as ctx:
+        wire = ctx.traceparent()
+        assert context.parse_traceparent(wire) == (
+            ctx.trace_id,
+            ctx.request_id,
+        )
+    # malformed values never break request handling
+    for bad in (None, "", "garbage", "00-xyz-abc-01", 42, "00-" + "a" * 31):
+        assert context.parse_traceparent(bad) is None
+
+
+def test_verb_scopes_inherit_the_root_trace_id():
+    root = telemetry.set_root_request(verb="clone")
+    with telemetry.request_scope(verb="ls-refs") as a:
+        assert a.trace_id == root.trace_id
+        assert a.request_id != root.request_id
+        assert a.parent_id == root.request_id
+    with telemetry.request_scope(verb="fetch-pack") as b:
+        assert b.trace_id == root.trace_id
+        assert b.request_id != a.request_id
+
+
+def test_server_scope_adopts_wire_ids():
+    with telemetry.request_scope(verb="fetch-pack") as client_ctx:
+        wire = client_ctx.traceparent()
+    with telemetry.request_scope(verb="fetch-pack", traceparent=wire) as srv:
+        # the server's telemetry is labelled with the ORIGINATING ids
+        assert srv.trace_id == client_ctx.trace_id
+        assert srv.request_id == client_ctx.request_id
+
+
+def test_server_scope_without_traceparent_mints_fresh_trace():
+    """A request arriving WITHOUT a traceparent (legacy client) must mint
+    a fresh trace — never fold unrelated clients into the serving
+    process's own root context (the servers pass inherit=False)."""
+    root = telemetry.set_root_request(verb="serve")
+    with telemetry.request_scope(
+        verb="fetch-pack", traceparent=None, inherit=False
+    ) as a:
+        pass
+    with telemetry.request_scope(
+        verb="fetch-pack", traceparent=None, inherit=False
+    ) as b:
+        pass
+    assert a.trace_id != root.trace_id
+    assert b.trace_id != root.trace_id
+    assert a.trace_id != b.trace_id  # two clients never share a trace
+    assert a.parent_id is None
+
+
+def test_annotate_reaches_the_access_record():
+    with telemetry.request_scope(verb="x") as ctx:
+        telemetry.annotate(shed=True, enum_cache="hit", nothing=None)
+        record = access.record_request(verb="x", status=429, seconds=0.01)
+    assert record["shed"] is True
+    assert record["enum_cache"] == "hit"
+    assert "nothing" not in record
+    assert record["request_id"] == ctx.request_id
+
+
+def test_span_exit_records_into_request_tree():
+    telemetry.enable(metrics=True)
+    with telemetry.request_scope(verb="x", record=True) as ctx:
+        with telemetry.span("server.enum_walk"):
+            with telemetry.span("odb.read_blobs_batch"):
+                pass
+    names = [e["name"] for e in ctx.span_tree()]
+    assert names == ["odb.read_blobs_batch", "server.enum_walk"]
+    assert all(e["dur"] >= 0 and e["start"] >= 0 for e in ctx.span_tree())
+    # unrecorded scopes stay empty (no per-span cost when not armed)
+    with telemetry.request_scope(verb="y") as ctx2:
+        with telemetry.span("server.enum_walk"):
+            pass
+    assert ctx2.span_tree() == []
+
+
+def test_request_tree_is_bounded(monkeypatch):
+    telemetry.enable(metrics=True)
+    monkeypatch.setattr(context, "REQUEST_EVENT_CAP", 3)
+    with telemetry.request_scope(verb="x", record=True) as ctx:
+        for _ in range(10):
+            with telemetry.span("diff.classify"):
+                pass
+    assert len(ctx.events) == 3
+    assert ctx.events_dropped == 7
+
+
+# -- bucketed histograms + quantiles ----------------------------------------
+
+
+def _bucket_of(value):
+    from bisect import bisect_left
+
+    return bisect_left(core.BUCKET_BOUNDS, value)
+
+
+def test_quantile_estimates_within_bucket_error():
+    """Estimates against exact percentiles of a known sample: the estimate
+    must land in the same log bucket as the exact value (the documented
+    error bound)."""
+    import random
+
+    import numpy as np
+
+    telemetry.enable(metrics=True)
+    rng = random.Random(42)
+    values = [rng.lognormvariate(-3.0, 1.5) for _ in range(5000)]
+    for v in values:
+        telemetry.observe("server.request_seconds", v, verb="fetch-pack")
+    ((_, _, h),) = telemetry.snapshot()["histograms"]
+    for q, est in ((50, h["p50"]), (90, h["p90"]), (99, h["p99"])):
+        exact = float(np.percentile(values, q))
+        assert _bucket_of(est) == _bucket_of(exact), (q, est, exact)
+        assert h["min"] <= est <= h["max"]
+    # buckets are cumulative and end at +Inf == count
+    assert h["buckets"][-1] == ["+Inf", len(values)]
+    counts = [c for _le, c in h["buckets"]]
+    assert counts == sorted(counts)
+
+
+def test_quantiles_exact_for_single_observation():
+    telemetry.enable(metrics=True)
+    telemetry.observe("server.request_seconds", 0.3, verb="x")
+    ((_, _, h),) = telemetry.snapshot()["histograms"]
+    # clamped to the observed range: a single sample reports itself
+    assert h["p50"] == h["p99"] == pytest.approx(0.3)
+
+
+def test_prometheus_histogram_exposition():
+    telemetry.enable(metrics=True)
+    for v in (0.003, 0.003, 0.7):
+        telemetry.observe("server.request_seconds", v, verb="fetch-pack")
+    text = sinks.prometheus_text()
+    assert "# TYPE kart_server_request_seconds histogram" in text
+    assert (
+        'kart_server_request_seconds_bucket{le="0.005",verb="fetch-pack"} 2'
+        in text
+    )
+    assert (
+        'kart_server_request_seconds_bucket{le="+Inf",verb="fetch-pack"} 3'
+        in text
+    )
+    assert 'kart_server_request_seconds_count{verb="fetch-pack"} 3' in text
+
+
+def test_span_aggregates_carry_buckets_too():
+    telemetry.enable(metrics=True)
+    with telemetry.span("server.enum_walk"):
+        time.sleep(0.002)
+    hists = {n: h for n, _l, h in telemetry.snapshot()["histograms"]}
+    assert hists["server.enum_walk"]["buckets"][-1][1] == 1
+    assert hists["server.enum_walk"]["p99"] > 0
+
+
+# -- trace-buffer saturation (satellite) ------------------------------------
+
+
+def test_event_buffer_saturation_is_counted(monkeypatch, caplog, tmp_path):
+    monkeypatch.setattr(core, "_EVENT_CAP", 4)
+    path = str(tmp_path / "trace.json")
+    telemetry.enable(metrics=True, trace=True, trace_path=path)
+    with caplog.at_level("WARNING", logger="kart_tpu.telemetry.core"):
+        for _ in range(10):
+            with telemetry.span("diff.classify"):
+                pass
+    assert telemetry.events_dropped_count() == 6
+    counters = dict(telemetry.counters_snapshot())
+    assert counters[("telemetry.events_dropped", ())] == 6
+    warnings = [r for r in caplog.records if "dropped" in r.getMessage()]
+    assert len(warnings) == 1  # one warning, not one per drop
+    # the export summary surfaces the drop count as a metadata event
+    assert sinks.write_chrome_trace() == path
+    doc = json.load(open(path))
+    metas = [
+        e for e in doc["traceEvents"] if e["name"] == "kart_events_dropped"
+    ]
+    assert metas and metas[0]["args"]["dropped"] == 6
+
+
+def test_fork_child_dump_failure_warns(tmp_path, caplog):
+    telemetry.enable(
+        trace=True, trace_path=str(tmp_path / "no-such-dir" / "t.json")
+    )
+    with telemetry.span("diff.classify"):
+        pass
+    with caplog.at_level("WARNING", logger="kart_tpu.telemetry.core"):
+        telemetry.dump_fork_child()
+    assert any(
+        "side-file" in r.getMessage() and "not written" in r.getMessage()
+        for r in caplog.records
+    )
+
+
+def test_sidecar_merge_failure_warns(tmp_path, caplog):
+    path = str(tmp_path / "trace.json")
+    telemetry.enable(trace=True, trace_path=path)
+    with telemetry.span("diff.classify"):
+        pass
+    side = f"{path}.child-999"
+    with open(side, "w") as f:
+        f.write("not json")
+    with caplog.at_level("WARNING", logger="kart_tpu.telemetry.sinks"):
+        assert sinks.write_chrome_trace() == path
+    assert any("unreadable" in r.getMessage() for r in caplog.records)
+    assert not os.path.exists(side)
+
+
+# -- access log / windows helpers -------------------------------------------
+
+
+def test_env_parsing(monkeypatch):
+    monkeypatch.delenv("KART_SLOW_REQUEST_SECONDS", raising=False)
+    assert access.slow_threshold() is None
+    monkeypatch.setenv("KART_SLOW_REQUEST_SECONDS", "0")
+    assert access.slow_threshold() is None
+    monkeypatch.setenv("KART_SLOW_REQUEST_SECONDS", "garbage")
+    assert access.slow_threshold() is None
+    monkeypatch.setenv("KART_SLOW_REQUEST_SECONDS", "2.5")
+    assert access.slow_threshold() == 2.5
+    monkeypatch.setenv("KART_STATS_WINDOWS", "5, 30,junk,")
+    assert access.stats_windows() == (5.0, 30.0)
+    monkeypatch.delenv("KART_STATS_WINDOWS", raising=False)
+    assert access.stats_windows() == access.DEFAULT_WINDOWS
+
+
+def test_window_rates_decay_when_idle(monkeypatch):
+    telemetry.enable(metrics=True)
+    monkeypatch.setattr(access, "_SAMPLE_MIN_INTERVAL", 0.0)
+    t = [1000.0]
+    telemetry.incr("transport.server.requests", verb="fetch-pack")
+    access._maybe_sample(t[0])
+    telemetry.incr("transport.server.requests", verb="fetch-pack")
+    rates = access.window_rates(now=t[0] + 2.0)
+    entry = [
+        r
+        for r in rates["10s"]
+        if r[0] == "transport.server.requests"
+    ]
+    assert entry and entry[0][2] == pytest.approx(0.5)  # 1 req / 2s
+    # nothing new: the rate decays toward zero as time passes
+    rates = access.window_rates(now=t[0] + 8.0)
+    entry = [r for r in rates["10s"] if r[0] == "transport.server.requests"]
+    assert entry and entry[0][2] == pytest.approx(1 / 8.0)
+
+
+# -- HTTP end-to-end ---------------------------------------------------------
+
+
+def _start_http_server(repo):
+    from kart_tpu.transport.http import make_server
+
+    server = make_server(repo)
+    threading.Thread(target=server.serve_forever, daemon=True).start()
+    return server, f"http://127.0.0.1:{server.server_address[1]}/"
+
+
+def test_http_propagation_retry_ladder_and_access_log(
+    tmp_path, monkeypatch
+):
+    """A torn-and-resumed HTTP fetch: both server-side attempts of the one
+    logical fetch-pack share the client's request id, every access-log
+    line carries the root trace id, and the annotations name the cache
+    decision — the ISSUE 12 propagation acceptance, HTTP side."""
+    from kart_tpu.core.repo import KartRepo
+    from kart_tpu.transport.http import HttpRemote
+    from kart_tpu.transport.retry import RetryPolicy
+
+    log_path = str(tmp_path / "access.jsonl")
+    monkeypatch.setenv("KART_ACCESS_LOG", log_path)
+    repo, _ = make_imported_repo(tmp_path, n=600)
+    server, url = _start_http_server(repo)
+    try:
+        dst = KartRepo.init_repository(str(tmp_path / "dst"))
+        client = HttpRemote(url, retry=RetryPolicy(attempts=3, base_delay=0.01))
+        root = telemetry.set_root_request(verb="clone")
+        wants = list(client.ls_refs()["heads"].values())
+        monkeypatch.setenv("KART_FAULTS", "transport.read.frame:200")
+        try:
+            client.fetch_pack(dst, wants)
+        finally:
+            monkeypatch.delenv("KART_FAULTS", raising=False)
+    finally:
+        server.shutdown()
+        server.server_close()
+
+    records = [json.loads(line) for line in open(log_path)]
+    by_verb = {}
+    for r in records:
+        by_verb.setdefault(r["verb"], []).append(r)
+    # the torn fetch-pack retried: two wire requests, ONE request id
+    fp = by_verb["fetch-pack"]
+    assert len(fp) == 2
+    assert len({r["request_id"] for r in fp}) == 1
+    assert fp[1].get("range_resume") is True
+    assert fp[0]["enum_cache"] == "miss"
+    # every line joins the client's one trace
+    assert {r["trace_id"] for r in records} == {root.trace_id}
+    # ls-refs has its own request id, same trace
+    assert by_verb["ls-refs"][0]["request_id"] != fp[0]["request_id"]
+    for r in records:
+        assert r["status"] in (200, 206)
+        assert r["seconds"] >= 0
+        assert r["bytes_out"] > 0
+
+
+def test_slow_request_exemplar_names_the_slow_frame(tmp_path, monkeypatch):
+    """An (injected-threshold) slow request is captured as an exemplar
+    whose span tree names the frame that cost the time, served via the
+    stats endpoint."""
+    from urllib.request import urlopen
+
+    from kart_tpu.core.repo import KartRepo
+    from kart_tpu.transport.http import HttpRemote
+
+    monkeypatch.setenv("KART_SLOW_REQUEST_SECONDS", "0.000001")
+    repo, _ = make_imported_repo(tmp_path, n=50)
+    server, url = _start_http_server(repo)
+    try:
+        dst = KartRepo.init_repository(str(tmp_path / "dst"))
+        client = HttpRemote(url)
+        client.fetch_pack(dst, list(client.ls_refs()["heads"].values()))
+        with urlopen(url + "api/v1/stats?format=json", timeout=10) as resp:
+            payload = json.loads(resp.read().decode())
+    finally:
+        server.shutdown()
+        server.server_close()
+
+    exemplars = [e for e in payload["exemplars"] if e["verb"] == "fetch-pack"]
+    assert exemplars
+    ex = exemplars[0]
+    assert ex["slow"] is True
+    assert ex["request_id"]
+    names = {s["name"] for s in ex["spans"]}
+    # the tree names the walk that cost the time, under the request anchor
+    assert "transport.request" in names
+    assert "server.enum_walk" in names
+    # counted as a metric too
+    counters = {
+        (n, labels.get("verb")): v
+        for n, labels, v in payload["snapshot"]["counters"]
+    }
+    assert counters.get(("server.slow_requests", "fetch-pack"), 0) >= 1
+    # the JSON stats document carries the live inflight gauge
+    assert "inflight" in payload
+
+
+def test_storm_server_percentiles_agree_with_clients(tmp_path, monkeypatch):
+    """16 concurrent clients: the server-side per-verb p50/p99 from the
+    bucketed histograms agree with the client-observed percentiles within
+    the one-bucket error bound — the ISSUE 12 storm acceptance, sized for
+    tier-1. The enum cache is disabled so every request pays the full
+    walk+spool+stream server-side (a cache-hit memcpy decouples the
+    server's handler time from the client's drain via socket buffering —
+    the bench's big-pack storm keeps the cache on instead)."""
+    import math
+    from urllib.request import urlopen
+
+    from kart_tpu.core.repo import KartRepo
+    from kart_tpu.transport.http import HttpRemote
+
+    monkeypatch.setenv("KART_SERVE_ENUM_CACHE", "0")
+    repo, _ = make_imported_repo(tmp_path, n=1500)
+    server, url = _start_http_server(repo)
+    durations = []
+    dur_lock = threading.Lock()
+    errors = []
+
+    def client_run(i):
+        try:
+            client = HttpRemote(url)
+            dst = KartRepo.init_repository(str(tmp_path / f"c{i}"))
+            wants = list(client.ls_refs()["heads"].values())
+            t0 = time.perf_counter()
+            client.fetch_pack(dst, wants)
+            with dur_lock:
+                durations.append(time.perf_counter() - t0)
+        except Exception as e:  # surfaced below: the storm must be clean
+            errors.append(e)
+
+    try:
+        threads = [
+            threading.Thread(target=client_run, args=(i,)) for i in range(16)
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        with urlopen(url + "api/v1/stats?format=json", timeout=10) as resp:
+            payload = json.loads(resp.read().decode())
+    finally:
+        server.shutdown()
+        server.server_close()
+
+    assert not errors, errors
+    assert len(durations) == 16
+    hist = None
+    for n, labels, h in payload["snapshot"]["histograms"]:
+        if n == "server.request_seconds" and labels.get("verb") == "fetch-pack":
+            hist = h
+    assert hist is not None and hist["count"] == 16
+    ordered = sorted(durations)
+    for q, est in ((0.50, hist["p50"]), (0.99, hist["p99"])):
+        idx = min(len(ordered) - 1, math.ceil(q * len(ordered)) - 1)
+        client_q = ordered[idx]
+        # agreement within one log bucket (the documented error bound)
+        assert abs(_bucket_of(est) - _bucket_of(client_q)) <= 1, (
+            q,
+            est,
+            client_q,
+        )
+
+
+def test_kart_top_renders_live_view(tmp_path, cli_runner):
+    from kart_tpu.cli import cli
+    from kart_tpu.core.repo import KartRepo
+    from kart_tpu.transport.http import HttpRemote
+
+    repo, _ = make_imported_repo(tmp_path, n=50)
+    server, url = _start_http_server(repo)
+    try:
+        client = HttpRemote(url)
+        dst = KartRepo.init_repository(str(tmp_path / "dst"))
+        client.fetch_pack(dst, list(client.ls_refs()["heads"].values()))
+        r = cli_runner.invoke(cli, ["top", "--once", url])
+    finally:
+        server.shutdown()
+        server.server_close()
+    assert r.exit_code == 0, r.output
+    assert "fetch-pack" in r.output
+    assert "p99" in r.output
+    assert "inflight" in r.output
+    assert "req/s(10s)" in r.output
+
+
+# -- stdio parity ------------------------------------------------------------
+
+
+def _install_fake_ssh(tmp_path, monkeypatch, extra_env=""):
+    """The test_ssh_transport stub: a fake `ssh` executing the remote
+    command locally (optionally exporting extra env for the server side
+    only), plus a `kart` shim on PATH."""
+    bindir = tmp_path / "bin"
+    bindir.mkdir(exist_ok=True)
+    kart = bindir / "kart"
+    kart.write_text(
+        "#!/bin/sh\n"
+        f"PYTHONPATH={os.path.dirname(os.path.dirname(os.path.abspath(__file__)))} "
+        f'exec {sys.executable} -m kart_tpu.cli "$@"\n'
+    )
+    kart.chmod(kart.stat().st_mode | stat.S_IEXEC)
+    fake_ssh = bindir / "fake-ssh"
+    fake_ssh.write_text(
+        "#!/bin/sh\n"
+        "shift\n"
+        f'{extra_env}exec sh -c "$*"\n'
+    )
+    fake_ssh.chmod(fake_ssh.stat().st_mode | stat.S_IEXEC)
+    monkeypatch.setenv("KART_SSH", str(fake_ssh))
+    monkeypatch.setenv("PATH", f"{bindir}:{os.environ['PATH']}")
+    monkeypatch.setenv("JAX_PLATFORMS", "cpu")
+
+
+def test_stdio_propagation_parity(tmp_path, monkeypatch):
+    """The stdio transport carries the same request id end-to-end as HTTP:
+    the spawned server's access-log records adopt the client's ids, retry
+    attempts share one id, and responses echo the traceparent."""
+    from kart_tpu.transport.stdio import StdioRemote
+    from kart_tpu.transport.retry import RetryPolicy
+    from kart_tpu.core.repo import KartRepo
+
+    _install_fake_ssh(tmp_path, monkeypatch)
+    log_path = str(tmp_path / "access.jsonl")
+    monkeypatch.setenv("KART_ACCESS_LOG", log_path)
+    (tmp_path / "server").mkdir()
+    repo, _ = make_imported_repo(tmp_path / "server", n=600)
+    url = f"testhost:{repo.workdir or repo.gitdir}"
+
+    root = telemetry.set_root_request(verb="clone")
+    client = StdioRemote(url, retry=RetryPolicy(attempts=3, base_delay=0.01))
+    try:
+        dst = KartRepo.init_repository(str(tmp_path / "dst"))
+        wants = list(client.ls_refs()["heads"].values())
+        # tear the client-side drain mid-stream: the retry respawns the
+        # server process and must present the SAME request id (the fresh
+        # server process never reaches this many frame reads itself).
+        # 201, not 200: the faults module re-arms on spec *change*, and an
+        # earlier test in this file already fired :200 in this process
+        monkeypatch.setenv("KART_FAULTS", "transport.read.frame:201")
+        try:
+            client.fetch_pack(dst, wants)
+        finally:
+            monkeypatch.delenv("KART_FAULTS", raising=False)
+    finally:
+        client.close()
+
+    deadline = time.monotonic() + 10
+    records = []
+    while time.monotonic() < deadline:
+        if os.path.exists(log_path):
+            records = [json.loads(line) for line in open(log_path)]
+            if len([r for r in records if r["verb"] == "fetch-pack"]) >= 2:
+                break
+        time.sleep(0.1)
+    fp = [r for r in records if r["verb"] == "fetch-pack"]
+    assert len(fp) == 2  # two attempts (two server processes)...
+    assert len({r["request_id"] for r in fp}) == 1  # ...one logical request
+    assert {r["trace_id"] for r in records} == {root.trace_id}
+    ls = [r for r in records if r["verb"] == "ls-refs"]
+    assert ls and ls[0]["request_id"] != fp[0]["request_id"]
+    for r in records:
+        assert r["status"] == "ok"
+        assert r["bytes_out"] > 0
+
+
+def test_stdio_response_echoes_traceparent_and_stats_json(tmp_path):
+    from kart_tpu.transport.http import read_framed, write_framed
+    from kart_tpu.transport.stdio import serve_stdio
+
+    repo, _ = make_imported_repo(tmp_path, n=5)
+    with telemetry.request_scope(verb="stats") as ctx:
+        req = io.BytesIO()
+        # two ops on one connection: the refs op books its request record
+        # BEFORE the stats op reads the registry
+        write_framed(req, {"op": "refs"}, ())
+        write_framed(
+            req,
+            {
+                "op": "stats",
+                "format": "json",
+                "traceparent": ctx.traceparent(),
+            },
+            (),
+        )
+        req.seek(0)
+        out = io.BytesIO()
+        serve_stdio(repo, req, out)
+        out.seek(0)
+        _refs_resp, fp = read_framed(out)
+        from kart_tpu.transport.pack import read_pack
+
+        for _ in read_pack(fp):
+            pass
+        resp, _fp = read_framed(out)
+    assert resp["traceparent"] == ctx.traceparent()
+    snap = resp["stats"]["snapshot"]
+    hist_verbs = {
+        labels.get("verb")
+        for n, labels, _h in snap["histograms"]
+        if n == "server.request_seconds"
+    }
+    assert "ls-refs" in hist_verbs
+    assert "rates" in resp["stats"]
+
+
+# -- mergeable client + server Chrome traces ---------------------------------
+
+
+def test_merge_rebases_timestamps_onto_one_clock(tmp_path):
+    """Each trace's ts values are offsets from its own process's enable
+    instant; the merge re-bases them via the kart_trace_epoch anchors, so
+    a server enabled an hour before the client still lines up."""
+
+    def write_trace(path, epoch_unix, ts):
+        json.dump(
+            {
+                "traceEvents": [
+                    {"name": "transport.request", "ph": "X", "ts": ts,
+                     "dur": 5.0, "pid": 1 if epoch_unix < 2000 else 2,
+                     "tid": 1, "args": {}},
+                    {"name": "kart_trace_epoch", "ph": "M", "pid": 9,
+                     "tid": 0, "args": {"unix": epoch_unix}},
+                ]
+            },
+            open(path, "w"),
+        )
+
+    early = str(tmp_path / "server.json")   # enabled at unix t=1000
+    late = str(tmp_path / "client.json")    # enabled at unix t=4600
+    write_trace(early, 1000.0, ts=3_600_000_000.0)  # event 3600s in
+    write_trace(late, 4600.0, ts=0.0)               # event at its t=0
+    out = str(tmp_path / "merged.json")
+    sinks.merge_chrome_traces(out, [early, late])
+    doc = json.load(open(out))
+    spans = {
+        e["pid"]: e["ts"]
+        for e in doc["traceEvents"]
+        if e.get("ph") == "X"
+    }
+    # both events happened at the same wall-clock instant: after
+    # re-basing they carry the same merged timestamp
+    assert spans[1] == pytest.approx(spans[2])
+
+
+def test_client_and_server_traces_merge_on_request_ids(
+    tmp_path, monkeypatch, cli_runner
+):
+    """``kart --trace clone`` (client, in-process CLI) against a spawned
+    serve-stdio with ``KART_TRACE`` (server subprocess): the two Chrome
+    traces share trace/request ids and merge into one timeline."""
+    from kart_tpu.cli import cli
+
+    server_trace = str(tmp_path / "server-trace.json")
+    _install_fake_ssh(
+        tmp_path, monkeypatch, extra_env=f"KART_TRACE={server_trace} "
+    )
+    client_trace = str(tmp_path / "client-trace.json")
+    monkeypatch.setenv("KART_TRACE", client_trace)
+    (tmp_path / "server").mkdir()
+    repo, _ = make_imported_repo(tmp_path / "server", n=40)
+    url = f"testhost:{repo.workdir or repo.gitdir}"
+
+    r = cli_runner.invoke(
+        cli, ["clone", "--bare", url, str(tmp_path / "clone")]
+    )
+    assert r.exit_code == 0, r.output
+
+    deadline = time.monotonic() + 15
+    while time.monotonic() < deadline and not os.path.exists(server_trace):
+        time.sleep(0.1)
+    client_doc = json.load(open(client_trace))
+    server_doc = json.load(open(server_trace))
+
+    def ids(doc, key):
+        return {
+            e["args"][key]
+            for e in doc["traceEvents"]
+            if e.get("ph") == "X" and key in e.get("args", {})
+        }
+
+    client_pids = {e["pid"] for e in client_doc["traceEvents"]}
+    server_pids = {e["pid"] for e in server_doc["traceEvents"]}
+    assert client_pids.isdisjoint(server_pids)  # separate lanes
+    # the join: one shared trace id, overlapping request ids
+    assert ids(client_doc, "trace_id") == ids(server_doc, "trace_id")
+    assert len(ids(client_doc, "trace_id")) == 1
+    shared_requests = ids(client_doc, "request_id") & ids(
+        server_doc, "request_id"
+    )
+    assert shared_requests  # the verbs' ids appear on both sides
+    # the server's per-request anchor spans carry originating ids; the
+    # fetch-pack one (the verb with client-side spans) joins the client
+    # trace. (The refs op's id is minted client-side too, but the client
+    # records no spans during ls-refs, so only the server trace shows it.)
+    anchors = [
+        e
+        for e in server_doc["traceEvents"]
+        if e.get("name") == "transport.request"
+    ]
+    assert anchors
+    anchor_ids = {a["args"]["request_id"] for a in anchors}
+    assert anchor_ids & ids(client_doc, "request_id")
+
+    merged = str(tmp_path / "merged.json")
+    n = sinks.merge_chrome_traces(merged, [client_trace, server_trace])
+    doc = json.load(open(merged))
+    assert len(doc["traceEvents"]) == n
+    assert {e["pid"] for e in doc["traceEvents"]} >= (
+        client_pids | server_pids
+    )

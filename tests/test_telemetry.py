@@ -74,7 +74,13 @@ def test_counters_gauges_histograms_and_labels():
     assert snap["gauges"] == [("runtime.backend_ok", {}, 1)]
     ((name, _labels, h),) = snap["histograms"]
     assert name == "transport.backoff"
-    assert h == {"count": 3, "sum": 10.0, "min": 2.0, "max": 5.0}
+    assert (h["count"], h["sum"], h["min"], h["max"]) == (3, 10.0, 2.0, 5.0)
+    # bucketed: cumulative [le, count] pairs ending at +Inf == count, and
+    # quantile estimates clamped to the observed range
+    assert h["buckets"][-1] == ["+Inf", 3]
+    assert sum(1 for _le, c in h["buckets"] if c) >= 1
+    assert 2.0 <= h["p50"] <= 5.0
+    assert 2.0 <= h["p99"] <= 5.0
 
 
 def test_span_aggregation_self_vs_cumulative():
