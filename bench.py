@@ -2895,6 +2895,482 @@ def subprocess_popen_tile_worker(url, oid, n_requests, zoom, seed):
     )
 
 
+# ---------------------------------------------------------------------------
+# bench.py --fleet: M replicas × N clients (ISSUE 13, docs/FLEET.md §6)
+# ---------------------------------------------------------------------------
+
+
+def fleet_tile_worker():
+    """One fleet tile client: GET n tiles from ONE replica over a
+    keep-alive HTTP/1.1 connection (a map client holds its connection; a
+    fresh TCP handshake per cached-tile memcpy would measure the kernel,
+    not the fleet). argv after the flag: ``url oid ds n_requests zoom
+    seed``. Protocol as the other storm workers: ready / go / one JSON
+    result line."""
+    import http.client
+    import sys
+    from urllib.parse import urlsplit
+
+    i = sys.argv.index("--fleet-tile-worker")
+    url, oid, ds_path, n_requests, zoom, seed = sys.argv[i + 1 : i + 7]
+    n_requests, zoom, seed = int(n_requests), int(zoom), int(seed)
+    import random
+
+    sample = _tile_sample(
+        zoom, int(os.environ.get("KART_BENCH_FLEET_TILE_COUNT", 48)), 7
+    )
+    rng = random.Random(seed)
+    picks = [sample[rng.randrange(len(sample))] for _ in range(n_requests)]
+    netloc = urlsplit(url).netloc
+
+    print(json.dumps({"ready": True}), flush=True)
+    sys.stdin.readline()
+
+    conn = http.client.HTTPConnection(netloc, timeout=60)
+    durations = []
+    ok_requests = 0
+    errors = []
+    start = time.time()
+    for z, x, y in picks:
+        path = f"/api/v1/tiles/{oid}/{ds_path}/{z}/{x}/{y}?layers=bin"
+        t0 = time.perf_counter()
+        for _attempt in range(60):
+            try:
+                conn.request("GET", path)
+                resp = conn.getresponse()
+                body = resp.read()
+                if resp.status == 200:
+                    ok_requests += 1
+                    break
+                if resp.status == 429:
+                    try:
+                        pause = float(resp.headers.get("Retry-After", "1"))
+                    except (TypeError, ValueError):
+                        pause = 1.0
+                    time.sleep(min(pause, 2.0))
+                    continue
+                errors.append(f"{z}/{x}/{y}: HTTP {resp.status} {body[:120]!r}")
+                break
+            except OSError:
+                # connection churn: reconnect and retry, like a map client
+                conn.close()
+                conn = http.client.HTTPConnection(netloc, timeout=60)
+                time.sleep(0.1)
+        else:
+            errors.append(f"{z}/{x}/{y}: retries exhausted")
+        durations.append(time.perf_counter() - t0)
+    conn.close()
+    print(
+        json.dumps(
+            {
+                "ok": ok_requests == len(picks),
+                "ok_requests": ok_requests,
+                "errors": errors[:5],
+                "durations": durations,
+                "start": start,
+                "end": time.time(),
+            }
+        ),
+        flush=True,
+    )
+
+
+def _fleet_refs(url, timeout=10):
+    from urllib.request import urlopen
+
+    with urlopen(f"{url}api/v1/refs", timeout=timeout) as resp:
+        return json.loads(resp.read().decode())
+
+
+def _fleet_stats_json(url, timeout=10):
+    from urllib.request import urlopen
+
+    with urlopen(f"{url}api/v1/stats?format=json", timeout=timeout) as resp:
+        return json.loads(resp.read().decode())
+
+
+def _fleet_counter(stats_doc, name):
+    return sum(
+        v
+        for n, _labels, v in stats_doc.get("snapshot", {}).get("counters", ())
+        if n == name
+    )
+
+
+def _fleet_store_digest(path):
+    """refs + object-store content digest of the repo at ``path`` —
+    byte-identical convergence means equal tuples (oid = content address,
+    so the sorted oid set pins every object byte)."""
+    import hashlib
+
+    from kart_tpu.core.repo import KartRepo
+
+    repo = KartRepo(path)
+    refs = dict(repo.refs.iter_refs("refs/"))
+    h = hashlib.sha256()
+    for oid in sorted(repo.odb.iter_oids()):
+        h.update(oid.encode())
+    return refs, h.hexdigest()
+
+
+def fleet_main():
+    """`bench.py --fleet` (docs/FLEET.md §6): a primary + M pull-replicas
+    serving N clients. Legs: (1) aggregate cached tiles/s across the
+    replica fleet (vs the single-node BENCH_r10 cached number) with the
+    peer-cache hit rate; (2) aggregate clone throughput fanned across
+    replicas; (3) replication lag — push-ack to replica-visible — p99;
+    (4) the failover drill: SIGKILL the primary mid-write-storm, restart
+    it, and prove zero acked commits were lost and both replicas converge
+    byte-identical (refs + odb digests equal). Prints the record after
+    each leg so a watchdog kill salvages the finished ones."""
+    import shutil
+    import subprocess
+    import sys
+    import tempfile
+
+    rows = int(os.environ.get("KART_BENCH_FLEET_ROWS", 100_000))
+    n_replicas = int(os.environ.get("KART_BENCH_FLEET_REPLICAS", 2))
+    n_tiles = int(os.environ.get("KART_BENCH_FLEET_TILE_COUNT", 48))
+    zoom = int(os.environ.get("KART_BENCH_FLEET_ZOOM", 5))
+    tile_clients = int(os.environ.get("KART_BENCH_FLEET_TILE_CLIENTS", 3))
+    tile_reqs = int(os.environ.get("KART_BENCH_FLEET_TILE_REQUESTS", 500))
+    clone_clients = int(os.environ.get("KART_BENCH_FLEET_CLONE_CLIENTS", 4))
+    clone_reqs = int(os.environ.get("KART_BENCH_FLEET_CLONE_REQUESTS", 2))
+    lag_pushes = int(os.environ.get("KART_BENCH_FLEET_LAG_PUSHES", 8))
+    failover_commits = int(
+        os.environ.get("KART_BENCH_FLEET_FAILOVER_COMMITS", 10)
+    )
+    poll_s = os.environ.get("KART_BENCH_FLEET_POLL_SECONDS", "0.3")
+
+    from kart_tpu import transport
+    from kart_tpu.synth import synth_repo
+
+    shm = "/dev/shm" if os.path.isdir("/dev/shm") else None
+    with tempfile.TemporaryDirectory(dir=shm) as td:
+        t0 = time.perf_counter()
+        src, info = synth_repo(
+            os.path.join(td, "primary"), rows, spatial=True, blobs="changed",
+            edit_frac=0.01,
+        )
+        synth_s = time.perf_counter() - t0
+        src.config["receive.denyCurrentBranch"] = "ignore"
+        workdir = src.workdir or src.gitdir
+        tile_oid = info["edit_commit"]
+
+        record = {
+            "metric": "fleet",
+            "fleet_rows": rows,
+            "fleet_replicas": n_replicas,
+            "fleet_synth_seconds": round(synth_s, 2),
+            "ok": True,
+        }
+
+        primary_port = _free_port()
+        primary_url = f"http://127.0.0.1:{primary_port}/"
+        serve_env = {"KART_TILE_MAX_FEATURES": "0"}
+        primary = _spawn_serve(workdir, primary_port, serve_env)
+        replica_urls = []
+        replica_dirs = []
+        replica_procs = []
+        try:
+            # -- spin up the replica fleet (env-configured, like any
+            # -- production replica: KART_REPLICA_OF + the peer tier)
+            from kart_tpu.core.repo import KartRepo
+
+            t0 = time.perf_counter()
+            for i in range(n_replicas):
+                rdir = os.path.join(td, f"replica{i}")
+                KartRepo.init_repository(rdir)
+                port = _free_port()
+                replica_procs.append(
+                    _spawn_serve(
+                        rdir, port,
+                        {
+                            **serve_env,
+                            "KART_REPLICA_OF": primary_url,
+                            "KART_PEER_CACHE": "primary",
+                            "KART_REPLICA_POLL_SECONDS": poll_s,
+                        },
+                    )
+                )
+                replica_urls.append(f"http://127.0.0.1:{port}/")
+                replica_dirs.append(rdir)
+            want = _fleet_refs(primary_url)["heads"]
+            deadline = time.monotonic() + 120
+            for url in replica_urls:
+                while _fleet_refs(url)["heads"] != want:
+                    if time.monotonic() > deadline:
+                        raise RuntimeError(f"replica {url} never converged")
+                    time.sleep(0.1)
+            record["fleet_initial_sync_seconds"] = round(
+                time.perf_counter() - t0, 2
+            )
+
+            # -- leg 1: aggregate cached tiles/s across the fleet.
+            # Warm: the primary encodes each sample tile once; each
+            # replica then peer-fills it once — after this, every request
+            # anywhere in the fleet is a cache memcpy, the steady state a
+            # hot map layer serves from.
+            from urllib.request import urlopen
+
+            sample = _tile_sample(zoom, n_tiles, 7)
+            for base in [primary_url] + replica_urls:
+                for z, x, y in sample:
+                    with urlopen(
+                        f"{base}api/v1/tiles/{tile_oid}/synth/{z}/{x}/{y}"
+                        f"?layers=bin",
+                        timeout=120,
+                    ) as resp:
+                        resp.read()
+            procs = []
+            for i in range(n_replicas * tile_clients):
+                url = replica_urls[i % n_replicas]
+                p = subprocess.Popen(
+                    [
+                        sys.executable, os.path.abspath(__file__),
+                        "--fleet-tile-worker", url, tile_oid, "synth",
+                        str(tile_reqs), str(zoom), str(200 + i),
+                    ],
+                    env=_storm_env(),
+                    stdin=subprocess.PIPE,
+                    stdout=subprocess.PIPE,
+                    stderr=subprocess.PIPE,
+                    text=True,
+                )
+                procs.append(p)  # _collect_workers reaps every worker
+            go = _storm_go_barrier(procs)
+            results = _collect_workers(procs)
+            good = [r for r in results if r]
+            ok_requests = sum(r.get("ok_requests", 0) for r in good)
+            durations = sorted(d for r in good for d in r["durations"])
+            record["fleet_tile_clients"] = n_replicas * tile_clients
+            record["fleet_tile_requests_total"] = (
+                n_replicas * tile_clients * tile_reqs
+            )
+            record["fleet_tile_ok_requests"] = ok_requests
+            if go is not None and good:
+                wall = max(r["end"] for r in good) - go
+                record["fleet_agg_tiles_per_sec"] = round(
+                    ok_requests / max(wall, 1e-9), 2
+                )
+                record["fleet_tile_p99_request_seconds"] = round(
+                    durations[
+                        min(len(durations) - 1, int(0.99 * len(durations)))
+                    ],
+                    4,
+                )
+            else:
+                record["ok"] = False
+                record["fleet_agg_tiles_per_sec"] = 0
+                record["fleet_tile_p99_request_seconds"] = 0
+            hits = misses = 0
+            for url in replica_urls:
+                doc = _fleet_stats_json(url)
+                hits += _fleet_counter(doc, "fleet.peer_cache.hits")
+                misses += _fleet_counter(doc, "fleet.peer_cache.misses")
+            record["fleet_peer_cache_hit_rate"] = round(
+                hits / max(1, hits + misses), 4
+            )
+            # the acceptance bar: a 2-replica fleet must beat the
+            # single-node cached number (BENCH_r10 tiles_per_sec_cached)
+            single_node = None
+            r10 = os.path.join(
+                os.path.dirname(os.path.abspath(__file__)), "BENCH_r10.json"
+            )
+            if os.path.exists(r10):
+                with open(r10) as f:
+                    single_node = json.load(f).get("parsed", {}).get(
+                        "tiles_per_sec_cached"
+                    )
+            if single_node:
+                record["fleet_tiles_vs_single_node_cached"] = round(
+                    record["fleet_agg_tiles_per_sec"] / single_node, 2
+                )
+                record["fleet_tiles_beats_single_node"] = (
+                    record["fleet_agg_tiles_per_sec"] > single_node
+                )
+            record["ok"] = record["ok"] and ok_requests == (
+                n_replicas * tile_clients * tile_reqs
+            )
+            print(json.dumps(record), flush=True)
+
+            # -- leg 2: aggregate clone throughput fanned across replicas
+            # (serve_storm's fetch worker, pointed at the fleet; the repo
+            # is the columnar partial-clone state, so "features" ride as
+            # sidecar columns, not per-feature blobs)
+            procs = []
+            for i in range(clone_clients):
+                url = replica_urls[i % n_replicas]
+                p = subprocess.Popen(
+                    [
+                        sys.executable, os.path.abspath(__file__),
+                        "--serve-storm-worker", url,
+                        os.path.join(td, "clones", f"w{i}"), str(clone_reqs),
+                        "fetch",
+                    ],
+                    env=_storm_env(),
+                    stdin=subprocess.PIPE,
+                    stdout=subprocess.PIPE,
+                    stderr=subprocess.PIPE,
+                    text=True,
+                )
+                procs.append(p)
+            go = _storm_go_barrier(procs)
+            results = _collect_workers(procs)
+            good = [r for r in results if r and r.get("ok")]
+            fetches = sum(len(r["durations"]) for r in good)
+            record["fleet_clone_clients"] = clone_clients
+            record["fleet_clone_ok"] = len(good) == clone_clients
+            if go is not None and good:
+                wall = max(r["end"] for r in good) - go
+                record["fleet_agg_clone_features_per_sec"] = round(
+                    rows * fetches / max(wall, 1e-9)
+                )
+            else:
+                record["ok"] = False
+                record["fleet_agg_clone_features_per_sec"] = 0
+            record["ok"] = record["ok"] and record["fleet_clone_ok"]
+            print(json.dumps(record), flush=True)
+
+            # -- leg 3: replication lag, push-ack -> replica-visible.
+            # Pushes go through replica 0 (the proxy kicks its sync loop);
+            # replica 1 rides the poll — the honest spread of a real fleet.
+            pusher = transport.clone(
+                replica_urls[0], os.path.join(td, "pusher"),
+                do_checkout=False,
+            )
+            pusher.config.set_many(
+                {"user.name": "bench", "user.email": "bench@fleet"}
+            )
+            # only the synth edit rows carry real blobs in "changed" mode,
+            # and a delete reads the old feature — mirror synth_repo's
+            # edit-row selection (seed=0 ⇒ edit rng seed 1, pks offset by
+            # the 1<<24 base) to pick deletable features
+            rng = np.random.default_rng(1)
+            edit_rows = rng.choice(
+                rows, size=info["n_edits"], replace=False
+            )
+            pks = sorted((1 << 24) + int(r) for r in edit_rows)
+            assert len(pks) >= lag_pushes + failover_commits
+            from kart_tpu.synth import commit_feature_edits
+
+            lag_samples = []
+            for k in range(lag_pushes):
+                oid = commit_feature_edits(
+                    pusher, "synth", deletes=[pks[k]],
+                    message=f"lag probe {k}",
+                )
+                transport.push(pusher, "origin")
+                t_ack = time.monotonic()
+                waiting = set(replica_urls)
+                while waiting:
+                    for url in sorted(waiting):
+                        if _fleet_refs(url)["heads"].get("main") == oid:
+                            lag_samples.append(time.monotonic() - t_ack)
+                            waiting.discard(url)
+                    if time.monotonic() - t_ack > 30:
+                        record["ok"] = False
+                        break
+                    if waiting:
+                        time.sleep(0.02)
+            lag_samples.sort()
+            record["fleet_lag_pushes"] = lag_pushes
+            if lag_samples:
+                record["fleet_replication_lag_p99_seconds"] = round(
+                    lag_samples[
+                        min(len(lag_samples) - 1,
+                            int(0.99 * len(lag_samples)))
+                    ],
+                    4,
+                )
+                record["fleet_replication_lag_mean_seconds"] = round(
+                    sum(lag_samples) / len(lag_samples), 4
+                )
+            else:
+                record["ok"] = False
+                record["fleet_replication_lag_p99_seconds"] = 0
+                record["fleet_replication_lag_mean_seconds"] = 0
+            print(json.dumps(record), flush=True)
+
+            # -- leg 4: the failover drill. Writes keep flowing through a
+            # replica proxy; the primary is SIGKILLed mid-storm and
+            # restarted; every ACKED commit must survive on the primary
+            # and reach every replica, and the replicas must converge
+            # byte-identical.
+            acked = []
+            restarted = False
+            for k in range(failover_commits):
+                oid = commit_feature_edits(
+                    pusher, "synth", deletes=[pks[lag_pushes + k]],
+                    message=f"failover {k}",
+                )
+                if k == failover_commits // 2:
+                    primary.kill()
+                    primary.wait()
+                deadline = time.monotonic() + 120
+                while True:
+                    try:
+                        transport.push(pusher, "origin")
+                        acked.append(oid)
+                        break
+                    except Exception as e:
+                        if time.monotonic() > deadline:
+                            record["ok"] = False
+                            print(
+                                f"failover push never landed: {e}",
+                                file=sys.stderr,
+                            )
+                            break
+                        if primary.poll() is not None and not restarted:
+                            # the operator's restart: same store, same port
+                            primary = _spawn_serve(
+                                workdir, primary_port, serve_env
+                            )
+                            restarted = True
+                        time.sleep(0.2)
+            record["fleet_failover_commits_acked"] = len(acked)
+            record["fleet_failover_restarted"] = restarted
+            # wait for the whole fleet to converge on the final tip
+            tip = _fleet_refs(primary_url)["heads"]["main"]
+            deadline = time.monotonic() + 60
+            for url in replica_urls:
+                while _fleet_refs(url)["heads"].get("main") != tip:
+                    if time.monotonic() > deadline:
+                        record["ok"] = False
+                        break
+                    time.sleep(0.1)
+            # zero lost landed commits: every acked oid is on disk on the
+            # primary AND every replica
+            lost = 0
+            stores = [workdir] + replica_dirs
+            opened = [KartRepo(p) for p in stores]
+            for oid in acked:
+                if not all(r.odb.contains(oid) for r in opened):
+                    lost += 1
+            record["fleet_failover_lost_commits"] = lost
+            digests = [_fleet_store_digest(p) for p in replica_dirs]
+            record["fleet_replicas_converged_identical"] = all(
+                d == digests[0] for d in digests[1:]
+            ) and digests[0][0] == dict(
+                KartRepo(workdir).refs.iter_refs("refs/")
+            )
+            record["ok"] = (
+                record["ok"]
+                and lost == 0
+                and len(acked) == failover_commits
+                and record["fleet_replicas_converged_identical"]
+            )
+            print(json.dumps(record), flush=True)
+        finally:
+            for p in [primary] + replica_procs:
+                try:
+                    p.kill()
+                    p.wait()
+                except OSError:
+                    pass
+        shutil.rmtree(os.path.join(td, "clones"), ignore_errors=True)
+
+
 if __name__ == "__main__":
     import sys
 
@@ -2902,6 +3378,10 @@ if __name__ == "__main__":
         tiles_storm_worker()
     elif "--tiles" in sys.argv:
         tiles_main()
+    elif "--fleet-tile-worker" in sys.argv:
+        fleet_tile_worker()
+    elif "--fleet" in sys.argv:
+        fleet_main()
     elif "--merge-storm-worker" in sys.argv:
         merge_storm_worker()
     elif "--merge-storm" in sys.argv:

@@ -47,6 +47,11 @@ ENV_VARS = {
     # tiles (docs/TILES.md)
     "KART_TILE_CACHE": "source",
     "KART_TILE_MAX_FEATURES": "source",
+    # fleet (docs/FLEET.md)
+    "KART_REPLICA_OF": "source",
+    "KART_REPLICA_POLL_SECONDS": "source",
+    "KART_REPLICA_MAX_LAG": "source",
+    "KART_PEER_CACHE": "source",
     # faults / maintenance (ROBUSTNESS.md §5-§6)
     "KART_FAULTS": "source",
     "KART_GC_GRACE": "source",
@@ -131,6 +136,8 @@ FAULT_POINTS = frozenset(
         "server.ref_cas",
         "tiles.encode",
         "tiles.cache",
+        "fleet.sync",
+        "fleet.proxy",
     }
 )
 
@@ -222,6 +229,25 @@ CACHES = {
             "source keys pin (gitdir, commit oid, dataset) and a commit's "
             "blocks never change, so a ref move cannot stale them; the LRU "
             "bound alone reclaims memory (docs/TILES.md §3)"
+        ),
+    },
+    "fleet.peer_cache": {
+        "module": "kart_tpu/fleet/peercache.py",
+        "cls": "PeerCache",
+        "registry_global": "_PEER_CACHES",
+        "key_fn": "peer_key",
+        "key_tokens": ("commit_pinned_key",),
+        "ref_drop": None,
+        "ref_drop_rationale": (
+            "entries are keyed by the origin cache's own commit-addressed "
+            "key (tile keys embed the commit oid, fetch-pack keys the exact "
+            "refs fingerprint) and a fetch is only accepted when the peer's "
+            "strong validator equals the locally computed one — a ref move "
+            "changes what new requests compute, never what an existing key "
+            "means; the LRU bound alone reclaims memory (docs/FLEET.md §4). "
+            "Replicas also never run _apply_validated_updates (writes are "
+            "proxied; refs advance via the sync loop), so the hook could "
+            "not fire there anyway"
         ),
     },
 }

@@ -1,0 +1,114 @@
+"""``kart fleet`` — operate a serving fleet (docs/FLEET.md).
+
+``kart fleet status <member...>`` polls each member's structured stats
+document (the same ``/api/v1/stats?format=json`` ``kart top`` reads) and
+renders the fleet operator's one-screen staleness view: role, replication
+lag, sync cycles/errors, proxied writes, read-your-writes decisions and
+peer-cache effectiveness — per member, without any new server surface.
+"""
+
+import click
+
+from kart_tpu.cli import CliError, cli
+from kart_tpu.cli.stats_cmds import _resolve_target
+from kart_tpu.cli.top_cmds import fetch_stats_json
+
+
+def _counter(snapshot, name):
+    return sum(v for n, _l, v in snapshot.get("counters", ()) if n == name)
+
+
+def member_status(payload):
+    """Flatten one member's stats document into the status row fields."""
+    snap = payload.get("snapshot", {})
+    fleet = payload.get("fleet") or {}
+    hits = _counter(snap, "fleet.peer_cache.hits")
+    misses = _counter(snap, "fleet.peer_cache.misses")
+    lookups = hits + misses
+    return {
+        "role": fleet.get("role", "primary"),
+        "primary": fleet.get("primary"),
+        "lag_seconds": fleet.get("lag_seconds"),
+        "last_sync_utc": fleet.get("last_sync_utc"),
+        "sync_cycles": fleet.get("sync_cycles", 0),
+        "sync_errors": fleet.get("sync_errors", 0),
+        "last_error": fleet.get("last_error"),
+        "proxied_writes": fleet.get("proxied_writes", 0),
+        "ryw_stalls": fleet.get("ryw_stalls", 0),
+        "ryw_pins": fleet.get("ryw_pins", 0),
+        "peer_hit_rate": (hits / lookups) if lookups else None,
+        "inflight": payload.get("inflight", 0),
+        "tiles_served": _counter(snap, "tiles.served"),
+        "requests": _counter(snap, "transport.server.requests"),
+    }
+
+
+def render_status(rows):
+    """The fleet status table: one line per member."""
+    lines = [
+        f"{'member':<36}{'role':<9}{'lag':>7}{'syncs':>7}{'errs':>6}"
+        f"{'proxied':>9}{'ryw s/p':>9}{'peer hit':>10}{'reqs':>8}"
+        f"{'tiles':>8}"
+    ]
+    for url, status in rows:
+        if status is None:
+            lines.append(f"{url:<36}{'(unreachable)'}")
+            continue
+        lag = status["lag_seconds"]
+        peer = status["peer_hit_rate"]
+        ryw = f"{status['ryw_stalls']}/{status['ryw_pins']}"
+        lines.append(
+            f"{url:<36}{status['role']:<9}"
+            f"{(f'{lag:.1f}s' if lag is not None else '-'):>7}"
+            f"{status['sync_cycles']:>7}{status['sync_errors']:>6}"
+            f"{status['proxied_writes']:>9}"
+            f"{ryw:>9}"
+            f"{(f'{peer:.0%}' if peer is not None else '-'):>10}"
+            f"{status['requests']:>8.0f}{status['tiles_served']:>8.0f}"
+        )
+        if status["last_error"]:
+            lines.append(f"{'':<36}  last sync error: {status['last_error']}")
+    return "\n".join(lines)
+
+
+@cli.group()
+def fleet():
+    """Operate a scale-out serving fleet (docs/FLEET.md)."""
+
+
+@fleet.command("status")
+@click.argument("targets", nargs=-1, required=True)
+@click.option("-o", "output_format", type=click.Choice(["text", "json"]),
+              default="text", show_default=True)
+@click.pass_obj
+def fleet_status(ctx, targets, output_format):
+    """Show replication lag, proxied writes and peer-cache effectiveness
+    for every fleet member named (http(s):// URLs or configured remotes).
+
+    The primary appears as role ``primary`` with no lag; each replica
+    reports how far its view trails (seconds since its last successful
+    sync cycle), its proxied-write count and read-your-writes decisions
+    (stalled locally vs pinned to the primary).
+    """
+    import json as _json
+
+    rows = []
+    for target in targets:
+        url = _resolve_target(ctx, target)
+        try:
+            payload = fetch_stats_json(url)
+        except (OSError, ValueError) as e:
+            click.echo(f"warning: {target!r}: {e}", err=True)
+            rows.append((url, None))
+            continue
+        rows.append((url, member_status(payload)))
+    if output_format == "json":
+        click.echo(
+            _json.dumps(
+                {url: status for url, status in rows}, indent=2, default=str
+            )
+        )
+        return
+    if all(status is None for _url, status in rows):
+        raise CliError("No fleet member was reachable")
+    click.echo(render_status(rows))

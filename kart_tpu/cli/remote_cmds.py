@@ -259,9 +259,47 @@ def remote_list(ctx, verbose):
     help="Tile cache byte budget; 0 disables. Overrides KART_TILE_CACHE "
     "(docs/TILES.md).",
 )
+@click.option(
+    "--replica-of",
+    "replica_of",
+    metavar="URL",
+    default=None,
+    help="Run as a read replica of the primary at URL: a background sync "
+    "loop pulls new commits through the resumable fetch lane, reads are "
+    "answered locally, pushes are transparently proxied to the primary "
+    "(docs/FLEET.md). Overrides KART_REPLICA_OF.",
+)
+@click.option(
+    "--replica-poll",
+    "replica_poll",
+    type=click.FLOAT,
+    default=None,
+    help="Seconds between replica sync cycles (a proxied write syncs "
+    "immediately regardless). Overrides KART_REPLICA_POLL_SECONDS.",
+)
+@click.option(
+    "--replica-max-lag",
+    "replica_max_lag",
+    type=click.FLOAT,
+    default=None,
+    help="Seconds a read pinned by X-Kart-Min-Commit may stall waiting "
+    "for replication before being proxied to the primary. Overrides "
+    "KART_REPLICA_MAX_LAG.",
+)
+@click.option(
+    "--peer-cache",
+    "peer_cache",
+    metavar="URLS",
+    default=None,
+    help="Comma-separated fleet peer URLs ('primary' = the --replica-of "
+    "URL) to fetch commit-addressed payloads from before computing them "
+    "locally — one cold tile/walk per fleet, not per replica "
+    "(docs/FLEET.md §4). Overrides KART_PEER_CACHE.",
+)
 @click.pass_obj
 def serve(ctx, host, port, max_inflight, enum_cache_bytes, tiles_enabled,
-          tile_cache_bytes):
+          tile_cache_bytes, replica_of, replica_poll, replica_max_lag,
+          peer_cache):
     """Serve this repository over HTTP for clone/fetch/push/pull — and
     vector tiles of any commit, straight off the columnar store.
 
@@ -270,8 +308,10 @@ def serve(ctx, host, port, max_inflight, enum_cache_bytes, tiles_enabled,
     shallow and spatially-filtered partial clones (the filter runs
     server-side), promised-blob backfill, a shared pack-enumeration cache
     with byte-range resume, load shedding under client storms
-    (docs/SERVING.md), and block-pruned commit-addressed tile serving
-    (docs/TILES.md).
+    (docs/SERVING.md), block-pruned commit-addressed tile serving
+    (docs/TILES.md), and scale-out fleets: ``--replica-of`` makes this
+    server a pull-replicated read replica that proxies writes to its
+    primary (docs/FLEET.md).
     """
     import os
 
@@ -287,8 +327,24 @@ def serve(ctx, host, port, max_inflight, enum_cache_bytes, tiles_enabled,
         os.environ["KART_SERVE_TILES"] = "1" if tiles_enabled else "0"
     if tile_cache_bytes is not None:
         os.environ["KART_TILE_CACHE"] = str(tile_cache_bytes)
+    if replica_of is not None:
+        os.environ["KART_REPLICA_OF"] = replica_of
+    if replica_poll is not None:
+        os.environ["KART_REPLICA_POLL_SECONDS"] = str(replica_poll)
+    if replica_max_lag is not None:
+        os.environ["KART_REPLICA_MAX_LAG"] = str(replica_max_lag)
+    if peer_cache is not None:
+        os.environ["KART_PEER_CACHE"] = peer_cache
     repo = ctx.repo
-    click.echo(f"Serving {repo.gitdir} at http://{host}:{port}/ (Ctrl-C to stop)")
+    role = (
+        f" (replica of {os.environ['KART_REPLICA_OF']})"
+        if os.environ.get("KART_REPLICA_OF")
+        else ""
+    )
+    click.echo(
+        f"Serving {repo.gitdir} at http://{host}:{port}/{role} "
+        f"(Ctrl-C to stop)"
+    )
     try:
         http_serve(repo, host, port)
     except KeyboardInterrupt:

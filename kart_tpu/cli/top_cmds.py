@@ -93,8 +93,27 @@ def render_top(payload, url):
         f"  shed {_counter_total(snap, 'server.shed'):.0f}"
         f"  slow {_counter_total(snap, 'server.slow_requests'):.0f}"
         f"  trace drops {payload.get('events_dropped', 0)}",
-        "",
     ]
+    fleet = payload.get("fleet")
+    if fleet:
+        # the fleet operator's staleness line (docs/FLEET.md §3): how far
+        # this replica's view trails, and where its writes/reads went
+        lag = fleet.get("lag_seconds")
+        hits = _counter_total(snap, "fleet.peer_cache.hits")
+        misses = _counter_total(snap, "fleet.peer_cache.misses")
+        peer = (
+            f"  peer cache {hits / (hits + misses):.0%} hit"
+            if hits + misses
+            else ""
+        )
+        lines.append(
+            f"{fleet.get('role', '?')} of {fleet.get('primary') or '-'}"
+            f"  lag {f'{lag:.1f}s' if lag is not None else '-'}"
+            f"  proxied writes {fleet.get('proxied_writes', 0)}"
+            f"  ryw stalls/pins {fleet.get('ryw_stalls', 0)}"
+            f"/{fleet.get('ryw_pins', 0)}{peer}"
+        )
+    lines.append("")
     rate_heads = "".join(f"  req/s({w})" for w in windows)
     lines.append(
         f"{'verb':<14}{rate_heads}  {'count':>7}  {'p50':>8}  {'p90':>8}  "

@@ -83,6 +83,20 @@ class SingleFlightLRU:
 
     # -- lookup / single-flight --------------------------------------------
 
+    def peek(self, key):
+        """A plain hit-or-None read: counts/refreshes the hit like
+        ``lookup_or_begin`` but never takes a fill token, so concurrent
+        hot-key readers stay a lock-hold apart instead of serialising
+        through token hand-offs. Misses count nothing — the caller is
+        expected to follow up with ``lookup_or_begin`` (which books the
+        miss) or not to fill at all."""
+        with self._lock:
+            entry = self._entries.get(key)
+            if entry is not None:
+                self._entries.move_to_end(key)
+                self.count("hits")
+            return entry
+
     def lookup_or_begin(self, key, timeout=None):
         """-> ("hit", entry) | ("fill", FillToken) | ("fill", None)."""
         if timeout is None:

@@ -49,6 +49,17 @@ Registered points:
                             here must poison nothing (the fresh payload is
                             never inserted; a poisoned tile is never
                             served)
+    fleet.sync              every frame of a replica's sync cycle:
+                            1 = the pack-migrate boundary (pulled objects
+                            durable, no ref moved), 2+ = before each
+                            individual ref advance — a killed cycle re-runs
+                            and the replica converges byte-identical
+    fleet.proxy             the write relay of a replica: 1 = before any
+                            byte reaches the primary (pre-write — a retry
+                            lands exactly once), 2 = after the primary
+                            answered, before the response relays (the push
+                            landed; the client's retry is absorbed
+                            idempotently)
 
 Disabled (``KART_FAULTS`` unset) the fast path is a single environ dict
 lookup with no allocation: frame-boundary loops additionally hoist

@@ -1,0 +1,174 @@
+"""Scale-out serving fleet (ISSUE 13; docs/FLEET.md).
+
+Turns ``kart serve`` into a replicated read fleet:
+
+* **replication** — ``kart serve --replica-of <url>`` runs a background
+  :class:`~kart_tpu.fleet.sync.ReplicaSync` loop that polls the primary's
+  refs and pulls new objects through the existing resumable fetch lane
+  (oid exclusion ships only the delta per cycle; a killed replica resumes
+  via the FETCH_RESUME marker), advancing local refs only after the pulled
+  pack has migrated — a reader of the replica never sees a ref pointing at
+  missing objects.
+* **routing** — replicas answer every read verb (ls-refs, fetch-pack,
+  fetch-blobs, tiles, stats) from local state and transparently proxy
+  receive-pack to the primary (:mod:`kart_tpu.fleet.router`), preserving
+  the traceparent and the rebase/rejection wire payloads byte-for-byte.
+  Read-your-writes: a just-pushed client is pinned via the
+  ``X-Kart-Min-Commit`` request header — the replica stalls the read until
+  its tips contain the pushed commit, bounded by ``KART_REPLICA_MAX_LAG``,
+  past which the read is proxied to the primary instead.
+* **peer cache tier** — before paying a cold enum walk or tile encode, a
+  replica may fetch the commit-addressed immutable payload from a fleet
+  peer (:mod:`kart_tpu.fleet.peercache`; strong ETag = cache key), so one
+  cold tile is computed once per fleet, not once per replica.
+
+Configuration is environment-only (like the rest of the serving layer), so
+spawned servers need no plumbing: ``KART_REPLICA_OF``,
+``KART_REPLICA_POLL_SECONDS``, ``KART_PEER_CACHE``,
+``KART_REPLICA_MAX_LAG`` (docs/OBSERVABILITY.md §7).
+"""
+
+import os
+import threading
+import time
+
+from kart_tpu.fleet.sync import ReplicaSync
+
+#: seconds a read carrying ``X-Kart-Min-Commit`` may stall waiting for the
+#: sync loop before the replica gives up and proxies the read to the
+#: primary (``KART_REPLICA_MAX_LAG`` overrides)
+DEFAULT_MAX_LAG_SECONDS = 10.0
+
+#: the request header a read-your-writes client sends: the replica must
+#: not answer from a view older than this commit
+MIN_COMMIT_HEADER = "X-Kart-Min-Commit"
+
+#: response header marking a write that was transparently proxied to the
+#: primary — the client pins its next reads on the landed commit
+PROXIED_HEADER = "X-Kart-Replica-Proxied"
+
+
+def max_lag_seconds(environ=os.environ):
+    try:
+        value = float(environ.get("KART_REPLICA_MAX_LAG", ""))
+    except (TypeError, ValueError):
+        return DEFAULT_MAX_LAG_SECONDS
+    return value if value >= 0 else DEFAULT_MAX_LAG_SECONDS
+
+
+def peer_urls(environ=os.environ, primary_url=None):
+    """Peer base URLs from ``KART_PEER_CACHE`` (comma-separated http(s)
+    URLs; the literal ``primary`` names the replica's primary). Unset /
+    empty / ``0`` disables the peer tier."""
+    raw = (environ.get("KART_PEER_CACHE") or "").strip()
+    if not raw or raw == "0":
+        return ()
+    urls = []
+    for part in raw.split(","):
+        part = part.strip().rstrip("/")
+        if not part:
+            continue
+        if part == "primary":
+            if primary_url:
+                urls.append(primary_url.rstrip("/"))
+            continue
+        urls.append(part)
+    return tuple(dict.fromkeys(urls))  # de-dup, order-preserving
+
+
+class FleetNode:
+    """The per-process fleet runtime a serving process carries: the
+    replica sync loop (when ``primary_url`` is set) and the peer list for
+    the commit-addressed payload cache. A plain primary has no FleetNode
+    (``node_from_env`` returns None)."""
+
+    def __init__(self, repo, primary_url=None, peers=(), poll_seconds=None):
+        self.repo = repo
+        self.primary_url = primary_url.rstrip("/") if primary_url else None
+        self.peers = tuple(peers)
+        self.sync = (
+            ReplicaSync(repo, self.primary_url, poll_seconds=poll_seconds)
+            if self.primary_url
+            else None
+        )
+        self._lock = threading.Lock()
+        self._proxied_writes = 0
+        self._ryw_stalls = 0
+        self._ryw_pins = 0
+        self._peer_cache = None
+
+    def peer_cache(self):
+        """This node's peer payload memo, resolved once — the serving hot
+        path must not re-run the registry's realpath/lock dance per
+        request (measured ~135us under a tile storm)."""
+        cache = self._peer_cache
+        if cache is None:
+            from kart_tpu.fleet import peercache
+
+            cache = self._peer_cache = peercache.peer_cache_for(self.repo)
+        return cache
+
+    @property
+    def is_replica(self):
+        return self.sync is not None
+
+    def start(self):
+        if self.sync is not None:
+            self.sync.start()
+        return self
+
+    def stop(self):
+        if self.sync is not None:
+            self.sync.stop()
+
+    # -- routing bookkeeping (handler threads; counted here so the stats
+    # -- document can report them without scanning the metric registry) ----
+
+    def note_proxied_write(self):
+        with self._lock:
+            self._proxied_writes += 1
+
+    def note_ryw(self, *, pinned):
+        with self._lock:
+            if pinned:
+                self._ryw_pins += 1
+            else:
+                self._ryw_stalls += 1
+
+    def status_dict(self):
+        """The ``fleet`` block of ``/api/v1/stats?format=json`` — what
+        ``kart fleet status`` and ``kart top`` render."""
+        with self._lock:
+            out = {
+                "role": "replica" if self.is_replica else "peer",
+                "primary": self.primary_url,
+                "peers": list(self.peers),
+                "proxied_writes": self._proxied_writes,
+                "ryw_stalls": self._ryw_stalls,
+                "ryw_pins": self._ryw_pins,
+            }
+        if self.sync is not None:
+            s = self.sync.status()
+            out.update(
+                sync_cycles=s["cycles"],
+                sync_errors=s["errors"],
+                last_sync_utc=s["last_sync_utc"],
+                lag_seconds=(
+                    round(time.time() - s["last_sync_ok"], 3)
+                    if s["last_sync_ok"]
+                    else None
+                ),
+                last_error=s["last_error"],
+            )
+        return out
+
+
+def node_from_env(repo, environ=os.environ):
+    """Build the FleetNode a serving process should run, from the
+    environment alone — or None when neither a primary nor peers are
+    configured (a plain single-node server)."""
+    primary = (environ.get("KART_REPLICA_OF") or "").strip() or None
+    peers = peer_urls(environ, primary_url=primary)
+    if primary is None and not peers:
+        return None
+    return FleetNode(repo, primary_url=primary, peers=peers)

@@ -1,0 +1,278 @@
+"""The commit-addressed peer cache tier (docs/FLEET.md §4).
+
+PR 9 proved tile and pack responses are **commit-addressed and
+immutable**: the strong ETag each server hands out is a pure function of
+the request key (commit oid / refs fingerprint + address + format
+version), so any holder of bytes with a matching validator holds *the*
+bytes. That is exactly the property that makes edge caching trivial — a
+replica about to pay a cold tile encode or enumeration walk may instead
+ask a fleet peer (usually the primary, which has already served and
+memoized the payload) and verify the answer by ETag equality alone.
+
+:class:`PeerCache` memoizes what those fetches return — one byte-budgeted
+single-flight LRU per served repo (the shared
+:class:`~kart_tpu.core.singleflight.SingleFlightLRU` machinery), keyed by
+the origin cache's own commit-pinned key. Entries can never go stale: a
+tile key embeds the commit oid, a fetch-pack key embeds the exact refs
+fingerprint, and the fetch itself only accepts a payload whose validator
+matches the key the replica computed locally. Peers that fail are backed
+off (:data:`PEER_BACKOFF_SECONDS`) so a dead primary costs one probe per
+window, not one per request.
+"""
+
+import logging
+import os
+import threading
+import time
+from collections import OrderedDict
+from urllib.error import HTTPError
+from urllib.request import Request, urlopen
+
+from kart_tpu import telemetry as tm
+from kart_tpu.core.singleflight import SingleFlightLRU
+
+L = logging.getLogger("kart_tpu.fleet.peercache")
+
+#: default byte budget of the per-repo peer payload memo
+DEFAULT_PEER_CACHE_BYTES = 64 * 1024 * 1024
+
+#: a peer that failed (connection refused, timeout, HTTP 5xx) is skipped
+#: for this long before the next probe
+PEER_BACKOFF_SECONDS = 15.0
+
+#: per-request budget for a peer fetch: a peer answering slower than the
+#: local compute would is not a cache — fail over to local work quickly
+PEER_FETCH_TIMEOUT = 10.0
+
+#: request header marking a peer-cache fill: a server answering one must
+#: NOT consult its own peer tier — mutually-peered replicas would
+#: otherwise recurse on every cold key, each stalling behind its own
+#: single-flight token until the fetch timeout
+PEER_FILL_HEADER = "X-Kart-Peer-Fill"
+
+
+def peer_key(kind, commit_pinned_key):
+    """The memo key of one peer-fetched payload: the payload kind plus the
+    origin cache's own key — ``commit_pinned_key`` is the tile cache's
+    commit-oid-addressed key or the enum cache's refs-fingerprint-addressed
+    key, so entries inherit invalidation-by-construction from the cache
+    they mirror (a ref move changes what *new* requests compute, never what
+    an existing key means)."""
+    return (kind, commit_pinned_key)
+
+
+class PeerCache(SingleFlightLRU):
+    """Byte-budgeted memo of peer-fetched commit-addressed payloads with
+    single-flight fill (one instance per served repo): N concurrent cold
+    requests for one payload make ONE peer round-trip; the entries are the
+    raw payload bytes, charged at their length. The machinery — tokens,
+    wedged-filler bypass, poison-barrier publish, LRU eviction — is the
+    shared :class:`~kart_tpu.core.singleflight.SingleFlightLRU` core."""
+
+    #: a peer fetch is bounded by PEER_FETCH_TIMEOUT, so a wedged filler
+    #: should release its waiters on the same scale, not the walk-scale
+    #: default
+    SINGLEFLIGHT_TIMEOUT = 60.0
+
+    def count(self, event, n=1):
+        if event == "hits":
+            tm.incr("fleet.peer_cache.hits", n)
+        elif event == "misses":
+            tm.incr("fleet.peer_cache.misses", n)
+        elif event == "singleflight_waits":
+            tm.incr("fleet.peer_cache.singleflight_waits", n)
+        elif event == "evictions":
+            tm.incr("fleet.peer_cache.evictions", n)
+
+    def gauge(self, total):
+        tm.gauge_set("fleet.peer_cache.bytes", total)
+
+
+#: gitdir -> PeerCache for every repo this process serves (bounded, like
+#: the enum/tile cache registries)
+_PEER_CACHES = OrderedDict()
+_PEER_CACHES_MAX = 64
+_peer_caches_lock = threading.Lock()
+
+
+def peer_cache_for(repo):
+    """The process-wide peer payload memo serving ``repo``."""
+    key = os.path.realpath(repo.gitdir)
+    with _peer_caches_lock:
+        cache = _PEER_CACHES.get(key)
+        if cache is None:
+            cache = _PEER_CACHES[key] = PeerCache(DEFAULT_PEER_CACHE_BYTES)
+        _PEER_CACHES.move_to_end(key)
+        while len(_PEER_CACHES) > _PEER_CACHES_MAX:
+            _PEER_CACHES.popitem(last=False)
+    return cache
+
+
+#: peer base URL -> monotonic timestamp of the last failure (module-wide:
+#: a dead peer is dead for every repo this process serves)
+_peer_down = {}
+_peer_down_lock = threading.Lock()
+
+
+def _peer_available(url):
+    with _peer_down_lock:
+        failed_at = _peer_down.get(url)
+    return (
+        failed_at is None
+        or time.monotonic() - failed_at >= PEER_BACKOFF_SECONDS
+    )
+
+
+def _mark_peer_down(url):
+    with _peer_down_lock:
+        _peer_down[url] = time.monotonic()
+
+
+def _mark_peer_up(url):
+    with _peer_down_lock:
+        _peer_down.pop(url, None)
+
+
+def _trace_headers():
+    from kart_tpu.telemetry import context as rq_context
+
+    traceparent = rq_context.current_traceparent()
+    if traceparent is None:
+        return {}
+    return {rq_context.TRACEPARENT_HEADER: traceparent}
+
+
+def _fetch_validated(url, etag, *, data=None, content_type=None):
+    """One peer request; -> payload bytes iff the peer's response carries
+    exactly the strong validator we computed locally (commit-addressed:
+    same key ⇒ byte-identical payload), else None. Any transport failure
+    backs the peer off and returns None — the peer tier is an
+    optimisation; local compute is always correct."""
+    headers = _trace_headers()
+    headers[PEER_FILL_HEADER] = "1"
+    if content_type:
+        headers["Content-Type"] = content_type
+    try:
+        req = Request(url, data=data, headers=headers)
+        with urlopen(req, timeout=PEER_FETCH_TIMEOUT) as resp:
+            if resp.headers.get("ETag") != etag:
+                # a peer on a different commit/refs view: its payload is
+                # the answer to a *different* question — never splice it
+                tm.incr("fleet.peer_cache.validator_mismatches")
+                return None
+            payload = resp.read()
+    except HTTPError as e:
+        # the peer answered: it just can't serve this payload (tile too
+        # large, dataset absent, shed). Deterministic per key — don't
+        # back the peer off, just compute locally.
+        tm.incr("fleet.peer_cache.fetch_failures")
+        L.debug("peer %s cannot serve payload: %s", url, e)
+        return None
+    except OSError as e:
+        tm.incr("fleet.peer_cache.fetch_failures")
+        _mark_peer_down(url.split("/api/", 1)[0])
+        L.debug("peer %s unreachable: %s", url, e)
+        return None
+    _mark_peer_up(url.split("/api/", 1)[0])
+    tm.incr("fleet.peer_cache.fetches")
+    tm.incr("fleet.peer_cache.bytes_fetched", len(payload))
+    return payload
+
+
+def peek_tile_payload(cache, key):
+    """The serving hot path: the memoized peer-fetched payload for one
+    tile key, or None — a single lock-hold read (no fill token), so N
+    concurrent requests for one hot tile stay concurrent. ``cache`` is
+    the node's resolved :class:`PeerCache` (FleetNode.peer_cache())."""
+    return cache.peek(peer_key("tile", key))
+
+
+def _filled(repo, memo_key, fetch):
+    """The shared single-flight shape of a peer fill: memo hit, else one
+    caller runs ``fetch()`` and publishes; a failed fetch abandons (the
+    caller falls back to local compute)."""
+    cache = peer_cache_for(repo)
+    mode, got = cache.lookup_or_begin(memo_key)
+    if mode == "hit":
+        return got
+    token = got  # a FillToken, or None (wedged-filler bypass)
+    try:
+        payload = fetch()
+    except BaseException:
+        if token is not None:
+            token.abandon()
+        raise
+    if payload is None:
+        if token is not None:
+            token.abandon()
+        return None
+    if token is not None:
+        token.publish(payload)
+    return payload
+
+
+def tile_peer_fill(repo, peers, commit_oid, ds_path, z, x, y, layers):
+    """-> the ``peer_fill(key, etag)`` hook :func:`kart_tpu.tiles.serve_tile`
+    calls on a local tile-cache miss: fetch the commit-addressed tile from
+    the first answering peer (``GET /api/v1/tiles/<commit>/...`` — the
+    commit oid IS the ref, so the peer resolves it identically), validated
+    by ETag equality. Returns bytes, or None → the caller encodes locally."""
+    from urllib.parse import quote
+
+    def fill(key, etag):
+        def fetch():
+            with tm.span("fleet.peer_fetch", kind="tile"):
+                for peer in peers:
+                    if not _peer_available(peer):
+                        continue
+                    url = (
+                        f"{peer}/api/v1/tiles/{commit_oid}/"
+                        f"{quote(ds_path, safe='')}/{z}/{x}/{y}"
+                        f"?layers={quote(','.join(layers))}"
+                    )
+                    payload = _fetch_validated(url, etag)
+                    if payload is not None:
+                        return payload
+            return None
+
+        return _filled(repo, peer_key("tile", key), fetch)
+
+    return fill
+
+
+def fetch_pack_from_peers(repo, peers, req, etag):
+    """Fetch a complete framed fetch-pack response from a peer instead of
+    walking locally: POST the byte-identical request body; accept the
+    response only when its ETag equals the one this replica computed
+    (the key embeds the refs fingerprint — equal validators prove the
+    peer's advertisement, and therefore its enumeration, is identical).
+    -> framed response bytes, or None → the caller walks locally."""
+    import json
+
+    body = json.dumps(
+        {
+            "wants": list(req.get("wants") or ()),
+            "haves": list(req.get("haves") or ()),
+            "have_shallow": sorted(req.get("have_shallow") or ()),
+            "depth": req.get("depth"),
+            "filter": req.get("filter"),
+            "exclude": sorted(req.get("exclude") or ()),
+        }
+    ).encode()
+
+    def fetch():
+        with tm.span("fleet.peer_fetch", kind="fetch_pack"):
+            for peer in peers:
+                if not _peer_available(peer):
+                    continue
+                payload = _fetch_validated(
+                    f"{peer}/api/v1/fetch-pack",
+                    etag,
+                    data=body,
+                    content_type="application/json",
+                )
+                if payload is not None:
+                    return payload
+        return None
+
+    return _filled(repo, peer_key("fetch", etag), fetch)

@@ -1,0 +1,183 @@
+"""Replica request routing (docs/FLEET.md §3).
+
+Replicas answer every read verb from local state; the one write verb —
+receive-pack — is **transparently proxied to the primary** at the byte
+level: the framed request body is relayed unmodified (same traceparent
+header, so the primary's access log and spans join the client's trace),
+and the primary's response — status, Retry-After pacing, and the entire
+JSON payload including the PR 8 rebase/rejection wire fields and conflict
+report — is relayed back byte-for-byte. The client cannot tell it from a
+direct primary push, except for the ``X-Kart-Replica-Proxied`` marker
+header it uses to pin its next reads (read-your-writes).
+
+Crash frames (``KART_FAULTS=fleet.proxy:<n>``, tests/test_faults.py):
+frame 1 fires before any request byte reaches the primary (a kill here is
+pre-write — the primary is untouched and the client's retry lands the
+push exactly once); frame 2 fires after the primary answered, before the
+response is relayed (the push HAS landed — the client sees a torn
+response, and its explicit retry is absorbed idempotently by the
+primary's CAS/rebase path: same commit, same ref, lands once).
+
+Reads a stalled read-your-writes client gives up waiting for are *pinned*
+to the primary the same way: the GET is relayed with its query string and
+conditional headers intact, so commit-addressed caching semantics (ETag,
+304, immutable) survive the hop.
+"""
+
+import logging
+from urllib.error import HTTPError
+from urllib.request import Request, urlopen
+
+from kart_tpu import faults
+from kart_tpu import telemetry as tm
+
+L = logging.getLogger("kart_tpu.fleet.router")
+
+#: response headers a proxied answer relays to the client (hop-by-hop
+#: headers like Content-Length are re-derived by the sending side)
+RELAY_HEADERS = ("Content-Type", "ETag", "Cache-Control", "Retry-After")
+
+
+class ProxyUpstreamError(Exception):
+    """The primary could not be reached (connection-level, not an HTTP
+    error response). The replica answers 502 — a transient status the
+    client RetryPolicy already paces itself against."""
+
+
+def _relay_headers(resp_headers):
+    return {
+        name: resp_headers[name]
+        for name in RELAY_HEADERS
+        if resp_headers.get(name) is not None
+    }
+
+
+def _relay(req, timeout):
+    """Send ``req`` upstream; -> (status, headers dict, body bytes) for
+    both success and HTTP-error answers (an HTTPError IS the primary's
+    response — a 409 conflict report must relay like a 200)."""
+    try:
+        with urlopen(req, timeout=timeout) as resp:
+            return (
+                getattr(resp, "status", 200),
+                _relay_headers(resp.headers),
+                resp.read(),
+            )
+    except HTTPError as e:
+        with e:
+            return e.code, _relay_headers(e.headers), e.read()
+    except OSError as e:
+        raise ProxyUpstreamError(
+            f"Primary is unreachable: {e}"
+        ) from e
+
+
+def proxy_receive_pack(node, body_fp, length, *, traceparent=None):
+    """Relay one receive-pack body to the primary byte-for-byte.
+
+    -> (status, headers dict, payload bytes). Raises
+    :class:`ProxyUpstreamError` when the primary cannot be reached (the
+    caller answers 502)."""
+    from kart_tpu.transport.http import API, DEFAULT_HTTP_POST_TIMEOUT, http_timeout
+    from kart_tpu.telemetry import context as rq_context
+
+    headers = {
+        "Content-Type": "application/x-kartpack",
+        "Content-Length": str(length),
+    }
+    if traceparent is None:
+        traceparent = rq_context.current_traceparent()
+    if traceparent:
+        headers[rq_context.TRACEPARENT_HEADER] = traceparent
+    # frame 1: nothing has been sent — a kill here leaves the primary
+    # byte-identical and the client free to retry (lands exactly once)
+    faults.fire("fleet.proxy")
+    req = Request(
+        f"{node.primary_url}{API}/receive-pack",
+        data=body_fp,
+        headers=headers,
+        method="POST",
+    )
+    with tm.span("fleet.proxy_write"):
+        status, resp_headers, payload = _relay(
+            req, http_timeout(DEFAULT_HTTP_POST_TIMEOUT)
+        )
+    # frame 2: the primary has answered (and, on 200, LANDED the push) —
+    # a kill here tears the relay after the commit is durable upstream
+    faults.fire("fleet.proxy")
+    tm.incr("fleet.proxied_writes")
+    node.note_proxied_write()
+    if status == 200 and node.sync is not None:
+        # the landed commit will be wanted immediately (read-your-writes):
+        # don't wait out the poll interval
+        node.sync.kick()
+    return status, resp_headers, payload
+
+
+def proxy_get(node, path_and_query, *, request_headers=None):
+    """Pin one read to the primary: relay a GET (path + query string)
+    with its conditional headers, -> (status, headers dict, body bytes).
+    Raises :class:`ProxyUpstreamError` when the primary is unreachable."""
+    from kart_tpu.transport.http import http_timeout
+    from kart_tpu.telemetry import context as rq_context
+
+    headers = {}
+    for name in ("If-None-Match", "Range", "If-Range"):
+        value = (request_headers or {}).get(name)
+        if value is not None:
+            headers[name] = value
+    traceparent = rq_context.current_traceparent()
+    if traceparent:
+        headers[rq_context.TRACEPARENT_HEADER] = traceparent
+    req = Request(f"{node.primary_url}{path_and_query}", headers=headers)
+    with tm.span("fleet.proxy_read"):
+        status, resp_headers, payload = _relay(req, http_timeout())
+    tm.incr("fleet.proxied_reads")
+    return status, resp_headers, payload
+
+
+def proxy_post(node, path_and_query, body_fp, length, *, content_type=None):
+    """Pin one POST-shaped read (fetch-pack / fetch-blobs) to the
+    primary: relay the request body unmodified, -> (status, headers dict,
+    body bytes). The POST data-fetch verbs are reads in this protocol —
+    a pinned client past the lag bound must get them answered upstream
+    exactly like a pinned ls-refs, body included (a GET relay would hit
+    a route the primary doesn't serve). Raises
+    :class:`ProxyUpstreamError` when the primary is unreachable."""
+    from kart_tpu.transport.http import DEFAULT_HTTP_POST_TIMEOUT, http_timeout
+    from kart_tpu.telemetry import context as rq_context
+
+    headers = {
+        "Content-Type": content_type or "application/json",
+        "Content-Length": str(length),
+    }
+    traceparent = rq_context.current_traceparent()
+    if traceparent:
+        headers[rq_context.TRACEPARENT_HEADER] = traceparent
+    req = Request(
+        f"{node.primary_url}{path_and_query}", data=body_fp, headers=headers,
+        method="POST",
+    )
+    with tm.span("fleet.proxy_read"):
+        status, resp_headers, payload = _relay(
+            req, http_timeout(DEFAULT_HTTP_POST_TIMEOUT)
+        )
+    tm.incr("fleet.proxied_reads")
+    return status, resp_headers, payload
+
+
+def landed_head_oids(doc):
+    """The branch-tip oids a successful receive payload landed (the
+    ``refs/heads/*`` entries of its ``updated`` map) — what a
+    read-your-writes pin may wait on. Heads only:
+    ``ReplicaSync.tips_contain`` walks branch tips, so pinning a tag or
+    other non-head oid would make the pin permanently unsatisfiable and
+    stall every later read for the full lag bound."""
+    updated = doc.get("updated") if isinstance(doc, dict) else None
+    if not isinstance(updated, dict):
+        return []
+    return [
+        oid
+        for ref, oid in updated.items()
+        if oid and isinstance(ref, str) and ref.startswith("refs/heads/")
+    ]

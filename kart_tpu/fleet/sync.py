@@ -1,0 +1,289 @@
+"""Pull-replication: the replica's background sync loop (docs/FLEET.md §2).
+
+A replica is just a client that never stops fetching. Each cycle reads the
+primary's advertisement (``ls_refs``), pulls whatever tips it is missing
+through the **same resumable fetch lane** every ``kart fetch`` uses —
+oid exclusion ships only the delta, ``drain_pack_salvaging`` keeps torn
+transfers, the FETCH_RESUME gitdir marker lets a SIGKILLed replica resume
+the remainder on restart — and only *then* advances its local refs to the
+advertised tips. Objects land in a finalised pack before any ref names
+them, so a concurrent reader of the replica can never see a ref pointing
+at missing objects; each individual ref advance is the same atomic
+``refs.set`` a push performs.
+
+Crash frames (``KART_FAULTS=fleet.sync:<n>``, tests/test_faults.py):
+frame 1 fires after the pulled pack has migrated but before any ref moves
+(the pack-migrate boundary); frames 2+ fire before each individual ref
+advance (a kill mid-advance leaves some refs new, some old — every one of
+them consistent). A killed cycle is simply re-run: the next cycle's
+exclusion-based fetch ships nothing already landed and the ref loop is
+idempotent, so the replica converges byte-identical (kill-matrix tested).
+"""
+
+import logging
+import os
+import threading
+import time
+
+from kart_tpu import faults
+from kart_tpu import telemetry as tm
+from kart_tpu.core.refs import RefError, check_ref_format
+
+L = logging.getLogger("kart_tpu.fleet.sync")
+
+#: default seconds between sync cycles (``KART_REPLICA_POLL_SECONDS``
+#: overrides; a proxied write kicks the loop immediately regardless)
+DEFAULT_POLL_SECONDS = 2.0
+
+#: marker line recorded in the FETCH_RESUME file while a replica pull is
+#: in flight (the remote-name slot of the marker format)
+RESUME_REMOTE_NAME = "(replica)"
+
+
+def poll_seconds(environ=os.environ):
+    try:
+        value = float(environ.get("KART_REPLICA_POLL_SECONDS", ""))
+    except (TypeError, ValueError):
+        return DEFAULT_POLL_SECONDS
+    return value if value > 0 else DEFAULT_POLL_SECONDS
+
+
+class ReplicaSync:
+    """The replica's pull loop against one primary URL.
+
+    ``sync_once()`` is the whole protocol (callable directly — the tests
+    and the read-your-writes stall drive it synchronously); ``start()``
+    runs it on a daemon thread every ``poll_seconds``, waking early when
+    :meth:`kick`-ed (the router kicks after every proxied write, so
+    read-your-writes stalls are bounded by one round-trip, not a poll)."""
+
+    def __init__(self, repo, primary_url, poll_seconds=None):
+        self.repo = repo
+        self.primary_url = primary_url
+        self._poll = poll_seconds
+        self._wake = threading.Event()
+        self._stop = threading.Event()
+        self._advanced = threading.Event()  # pulsed after each ref advance
+        self._lock = threading.Lock()
+        self._thread = None
+        self._net = None
+        self._cycles = 0
+        self._errors = 0
+        self._last_sync_ok = None  # wall clock of the last successful cycle
+        self._last_error = None
+
+    # -- lifecycle -----------------------------------------------------------
+
+    def start(self):
+        with self._lock:
+            if self._thread is not None and self._thread.is_alive():
+                return self
+            self._stop.clear()
+            self._thread = threading.Thread(
+                target=self._run, name="kart-replica-sync", daemon=True
+            )
+            self._thread.start()
+        return self
+
+    def stop(self, timeout=5.0):
+        self._stop.set()
+        self._wake.set()
+        with self._lock:
+            thread, self._thread = self._thread, None
+            net, self._net = self._net, None
+        if thread is not None:
+            thread.join(timeout)
+        if net is not None:
+            net.close()
+
+    def kick(self):
+        """Wake the loop now (a write just landed on the primary)."""
+        self._wake.set()
+
+    def _run(self):
+        interval = self._poll if self._poll is not None else poll_seconds()
+        while not self._stop.is_set():
+            try:
+                self.sync_once()
+            except Exception as e:
+                with self._lock:
+                    self._errors += 1
+                    self._last_error = f"{type(e).__name__}: {e}"
+                tm.incr("fleet.sync_errors")
+                L.warning(
+                    "replica sync against %s failed: %s", self.primary_url, e
+                )
+            self._wake.wait(interval)
+            self._wake.clear()
+
+    # -- the protocol --------------------------------------------------------
+
+    def _client(self):
+        from kart_tpu.transport.remote import network_remote
+
+        with self._lock:
+            if self._net is None:
+                self._net = network_remote(self.primary_url)
+                if self._net is None:
+                    raise ValueError(
+                        f"Replica primary must be a network URL "
+                        f"(http(s):// or ssh://), got {self.primary_url!r}"
+                    )
+            return self._net
+
+    def sync_once(self):
+        """One replication cycle; -> ``{"objects", "advanced", "deleted",
+        "in_sync"}`` (what the cycle shipped/moved — the tests and the -v
+        log read it). Raises on transport failure; the caller (the loop,
+        or a read-your-writes stall) just retries next cycle — the
+        exclusion lane guarantees a failed cycle's landed objects are
+        never re-shipped."""
+        from kart_tpu.transport.remote import (
+            FETCH_RESUME_FILE,
+            _read_resume_exclusions,
+            _write_resume_marker,
+            read_shallow,
+        )
+
+        t0 = time.perf_counter()
+        repo = self.repo
+        net = self._client()
+        with tm.span("fleet.sync_cycle"):
+            info = net.ls_refs()
+            desired = {
+                f"refs/heads/{b}": oid for b, oid in info["heads"].items()
+            }
+            desired.update(
+                {f"refs/tags/{t}": oid for t, oid in info["tags"].items()}
+            )
+            # a hostile/buggy primary must not plant invalid ref names here
+            # any more than a fetch may (same rule as remote.fetch)
+            for ref in [r for r in desired if not self._valid_ref(r)]:
+                L.warning("replica sync: ignoring invalid ref name %r", ref)
+                desired.pop(ref)
+            shipped = 0
+            missing = [
+                oid
+                for oid in dict.fromkeys(desired.values())
+                if not repo.odb.contains(oid)
+            ]
+            if missing:
+                # the resumable fetch lane IS the replication protocol: a
+                # surviving FETCH_RESUME marker seeds the exclusion set so
+                # a killed replica's next cycle ships only the remainder
+                exclude = _read_resume_exclusions(repo)
+                repo.write_gitdir_file(FETCH_RESUME_FILE, RESUME_REMOTE_NAME)
+                try:
+                    header = net.fetch_pack(
+                        repo,
+                        missing,
+                        haves=[oid for _, oid in repo.refs.iter_refs("refs/")],
+                        have_shallow=read_shallow(repo),
+                        exclude=exclude,
+                    )
+                except BaseException:
+                    # marker stays, now carrying the salvaged oids — the
+                    # next cycle (or a restarted replica) resumes from them
+                    _write_resume_marker(repo, RESUME_REMOTE_NAME, exclude)
+                    raise
+                repo.remove_gitdir_file(FETCH_RESUME_FILE)
+                shipped = header.get("object_count", 0)
+                tm.incr("fleet.sync_objects", shipped)
+            # frame 1: the pulled pack is migrated (bulk_pack finalised
+            # inside the drain), no ref has moved yet
+            faults.fire("fleet.sync")
+            advanced = 0
+            for ref, oid in sorted(desired.items()):
+                if repo.refs.get(ref) == oid:
+                    continue
+                if not repo.odb.contains(oid):
+                    # the tip moved between ls_refs and our pull landing:
+                    # leave this ref; the next cycle fetches the newer tip.
+                    # Advancing would break the refs-never-dangle invariant.
+                    continue
+                # frames 2+: before each individual ref advance
+                faults.fire("fleet.sync")
+                repo.refs.set(ref, oid, log_message="replica sync")
+                advanced += 1
+            deleted = 0
+            for prefix in ("refs/heads/", "refs/tags/"):
+                for ref, _oid in list(repo.refs.iter_refs(prefix)):
+                    if ref not in desired:
+                        repo.refs.delete(ref)
+                        deleted += 1
+            if advanced or deleted:
+                tm.incr("fleet.refs_advanced", advanced + deleted)
+                self._advanced.set()
+                self._advanced.clear()
+        elapsed = time.perf_counter() - t0
+        with self._lock:
+            self._cycles += 1
+            self._last_sync_ok = time.time()
+            self._last_error = None
+        tm.incr("fleet.sync_cycles")
+        tm.observe("fleet.sync_seconds", elapsed)
+        # staleness bound after this cycle: everything the primary
+        # advertised at cycle start is now visible, so the replica trails
+        # by at most the cycle's own duration (plus the poll interval
+        # until the next cycle — the stats document reports that half
+        # live, as now - last_sync_ok)
+        tm.gauge_set("fleet.lag_seconds", round(elapsed, 6))
+        return {
+            "objects": shipped,
+            "advanced": advanced,
+            "deleted": deleted,
+            "in_sync": not missing and not advanced,
+        }
+
+    @staticmethod
+    def _valid_ref(ref):
+        try:
+            check_ref_format(ref, require_refs_prefix=True)
+        except RefError:
+            return False
+        return True
+
+    # -- read-your-writes ----------------------------------------------------
+
+    def tips_contain(self, oid):
+        """Is ``oid`` contained in (an ancestor of, or equal to) any local
+        branch tip? The read-your-writes predicate: a client that pushed
+        ``oid`` through this replica sees it in every read once this holds."""
+        from kart_tpu.transport.service import _commit_contains
+
+        if not self.repo.odb.contains(oid):
+            return False
+        for _ref, tip in self.repo.refs.iter_refs("refs/heads/"):
+            if _commit_contains(self.repo, tip, oid):
+                return True
+        return False
+
+    def wait_for_commit(self, oid, timeout):
+        """Stall until :meth:`tips_contain` holds, kicking the sync loop;
+        -> True when it does, False at the deadline (the router then pins
+        the read to the primary instead)."""
+        deadline = time.monotonic() + max(0.0, timeout)
+        while True:
+            if self.tips_contain(oid):
+                return True
+            remaining = deadline - time.monotonic()
+            if remaining <= 0:
+                return False
+            self.kick()
+            self._advanced.wait(min(remaining, 0.1))
+
+    def status(self):
+        with self._lock:
+            return {
+                "cycles": self._cycles,
+                "errors": self._errors,
+                "last_sync_ok": self._last_sync_ok,
+                "last_sync_utc": (
+                    time.strftime(
+                        "%Y-%m-%dT%H:%M:%SZ", time.gmtime(self._last_sync_ok)
+                    )
+                    if self._last_sync_ok
+                    else None
+                ),
+                "last_error": self._last_error,
+            }

@@ -60,6 +60,7 @@ SUBSYSTEMS = frozenset(
         "transport", # wire transports, retry/resume, servers
         "server",    # concurrent-serving machinery (enum cache, shedding)
         "tiles",     # tile read-serving (pruning, cache, encode, export)
+        "fleet",     # replication sync, write proxying, peer cache tier
         "importer",  # bulk import phases
         "runtime",   # backend probe, watchdogs
         "wc",        # working copies

@@ -17,6 +17,7 @@ discipline). This module is the orchestrator; the machinery lives in:
 * :mod:`kart_tpu.tiles.pyramid` — batch export walker (`kart export tiles`)
 """
 
+import threading
 import time
 
 from kart_tpu import telemetry as tm
@@ -56,6 +57,7 @@ __all__ = [
     "TileTooLarge",
     "decode_bin_layer",
     "encode_tile",
+    "etag_for",
     "normalise_layers",
     "parse_payload",
     "resolve_tile_commit",
@@ -63,16 +65,57 @@ __all__ = [
     "source_for",
     "tile_etag",
     "tile_bounds_wsen",
+    "tile_key",
+    "tile_request_key",
     "validate_tile",
 ]
+
+
+_FULL_OID_RE = None
+
+#: (gitdir, oid) pairs proven to name commit objects — immutable facts
+#: (content addressing: an oid can never change type), so a bounded memo
+#: is safe forever; it exists because the serving hot path would otherwise
+#: re-read and re-inflate the same commit object thousands of times a
+#: second under a tile storm
+_VERIFIED_COMMITS = set()
+_VERIFIED_COMMITS_MAX = 4096
+_verified_commits_lock = threading.Lock()
 
 
 def resolve_tile_commit(repo, ref):
     """Pin a requested ref/refish to a commit oid — the cache-key
     immutability step: everything after this point is keyed by the oid, so
-    a ref update can only change what *new* requests resolve to."""
+    a ref update can only change what *new* requests resolve to.
+
+    Full 40-hex commit oids short-circuit the revision grammar: tile
+    traffic is commit-addressed by design (clients learn the oid from the
+    first response's key and hammer it thousands of times a second), and
+    the general resolver stats half a dozen ref candidates before trying
+    the odb — measurable at fleet request rates."""
+    import re
+
     from kart_tpu.core.repo import NotFound
 
+    from kart_tpu.core.odb import ObjectMissing
+
+    global _FULL_OID_RE
+    if _FULL_OID_RE is None:
+        _FULL_OID_RE = re.compile(r"[0-9a-f]{40}")
+    if _FULL_OID_RE.fullmatch(ref):
+        memo_key = (repo.gitdir, ref)
+        with _verified_commits_lock:
+            if memo_key in _VERIFIED_COMMITS:
+                return ref
+        try:
+            if repo.odb.object_type(ref) == "commit":
+                with _verified_commits_lock:
+                    if len(_VERIFIED_COMMITS) >= _VERIFIED_COMMITS_MAX:
+                        _VERIFIED_COMMITS.clear()
+                    _VERIFIED_COMMITS.add(memo_key)
+                return ref
+        except ObjectMissing:
+            pass  # not an object here: fall through to the ref grammar
     try:
         oid, _ref = repo.resolve_refish(ref)
     except NotFound as e:
@@ -82,24 +125,37 @@ def resolve_tile_commit(repo, ref):
     return oid
 
 
-def tile_etag(repo, ref, ds_path, z, x, y, *, layers=None,
-              extent=DEFAULT_EXTENT, buffer=DEFAULT_BUFFER):
-    """The strong validator for a tile request, computed WITHOUT building
-    anything — address validation + ref→commit pinning only. Commit-
-    addressed keys never go stale, so a client presenting this validator
-    (If-None-Match) can be answered 304 before any source is constructed
-    or payload encoded."""
+def tile_request_key(repo, ref, ds_path, z, x, y, *, layers=None,
+                     extent=DEFAULT_EXTENT, buffer=DEFAULT_BUFFER):
+    """One tile request resolved to its cache identity, computed WITHOUT
+    building anything — address validation + ref→commit pinning only:
+    -> ``(key, etag, commit_oid, (z, x, y), layers)``. The single recipe
+    behind the served validator, the cache key and the peer-cache lookup
+    (the HTTP handler and :func:`tile_etag` both call this — the key
+    ingredients must never fork)."""
     z, x, y = validate_tile(z, x, y)
     layers = normalise_layers(layers)
     commit_oid = resolve_tile_commit(repo, ref)
-    return etag_for(
-        tile_key(commit_oid, ds_path, z, x, y, layers, extent, buffer)
-    ), commit_oid
+    key = tile_key(commit_oid, ds_path, z, x, y, layers, extent, buffer)
+    return key, etag_for(key), commit_oid, (z, x, y), layers
+
+
+def tile_etag(repo, ref, ds_path, z, x, y, *, layers=None,
+              extent=DEFAULT_EXTENT, buffer=DEFAULT_BUFFER):
+    """The strong validator for a tile request. Commit-addressed keys
+    never go stale, so a client presenting this validator (If-None-Match)
+    can be answered 304 before any source is constructed or payload
+    encoded."""
+    _key, etag, commit_oid, _zxy, _layers = tile_request_key(
+        repo, ref, ds_path, z, x, y, layers=layers, extent=extent,
+        buffer=buffer,
+    )
+    return etag, commit_oid
 
 
 def serve_tile(repo, ref, ds_path, z, x, y, *, layers=None,
                extent=DEFAULT_EXTENT, buffer=DEFAULT_BUFFER,
-               max_features=None, commit_oid=None):
+               max_features=None, commit_oid=None, peer_fill=None):
     """The full tile-serving verb: resolve, cache-front, encode-on-miss.
 
     -> (payload bytes, etag str, cached bool). A cache hit returns the
@@ -107,13 +163,31 @@ def serve_tile(repo, ref, ds_path, z, x, y, *, layers=None,
     envelope page fault, no ODB blob read. Byte-identical across
     hit/miss/process by construction (the payload is deterministic in the
     key; tests/test_tiles.py pins it). ``commit_oid`` pins the revision
-    when the caller already resolved the ref (:func:`tile_etag`)."""
+    when the caller already resolved the ref (:func:`tile_etag`).
+
+    ``peer_fill``: the fleet peer-cache hook (docs/FLEET.md §4) —
+    ``peer_fill(key, etag)`` may return the commit-addressed payload
+    fetched from a fleet peer. It is consulted FIRST, before the local
+    tile cache: peer-cache hits are plain concurrent reads, whereas a
+    local-cache miss hands out a single-flight fill token — routing hot
+    peer-held tiles through that token would serialise same-tile
+    requests that a memcpy could answer in parallel. Peer-fetched bytes
+    live in the peer cache; the local cache holds only locally-encoded
+    payloads (the peer-down fallback)."""
     z, x, y = validate_tile(z, x, y)
     layers = normalise_layers(layers)
     if commit_oid is None:
         commit_oid = resolve_tile_commit(repo, ref)
     key = tile_key(commit_oid, ds_path, z, x, y, layers, extent, buffer)
     etag = etag_for(key)
+
+    if peer_fill is not None:
+        fetched = peer_fill(key, etag)
+        if fetched is not None:
+            tm.annotate(tile_cache="peer")
+            tm.incr("tiles.served")
+            tm.incr("tiles.bytes_out", len(fetched))
+            return fetched, etag, True
 
     cache = tile_cache_for(repo)
     token = None

@@ -247,6 +247,11 @@ def have_closure(odb, haves, have_shallow=()):
 class KartRequestHandler(BaseHTTPRequestHandler):
     protocol_version = "HTTP/1.1"
     server_version = "kart-tpu-serve/1"
+    # buffered response writes: headers + a small body leave as ONE
+    # sendall instead of two (BaseHTTPRequestHandler defaults to an
+    # unbuffered wfile); large pack/tile streams still flush per chunk
+    # past the buffer, and handle_one_request flushes at request end
+    wbufsize = 64 * 1024
 
     @property
     def repo(self):
@@ -451,6 +456,8 @@ class KartRequestHandler(BaseHTTPRequestHandler):
             if not self._admit():
                 return
             try:
+                if self._replica_gate():
+                    return  # read pinned to the primary; already answered
                 if path == f"{API}/refs":
                     return self._handle_refs()
                 if path.startswith(f"{API}/tiles/"):
@@ -462,15 +469,102 @@ class KartRequestHandler(BaseHTTPRequestHandler):
             if not self._admit():
                 return
             try:
+                if path == f"{API}/receive-pack":
+                    return self._handle_receive_pack()
+                if self._replica_gate():
+                    return  # read pinned to the primary; already answered
                 if path == f"{API}/fetch-pack":
                     return self._handle_fetch_pack()
                 if path == f"{API}/fetch-blobs":
                     return self._handle_fetch_blobs()
-                if path == f"{API}/receive-pack":
-                    return self._handle_receive_pack()
                 self._json(404, {"error": f"No such endpoint: {self.path}"})
             finally:
                 self._leave()
+
+    # -- fleet routing (docs/FLEET.md §3) -----------------------------------
+
+    def _fleet(self):
+        return getattr(self.server, "fleet", None)
+
+    def _is_peer_fill(self):
+        """Is this request another replica's peer-cache fill? Such a
+        request must be answered from local state — consulting our own
+        peer tier would recurse between mutually-peered replicas."""
+        from kart_tpu.fleet.peercache import PEER_FILL_HEADER
+
+        return bool(self.headers.get(PEER_FILL_HEADER))
+
+    def _replica_gate(self):
+        """Read-your-writes on a replica: a request carrying
+        ``X-Kart-Min-Commit`` must not be answered from a view older than
+        that commit. Stall (bounded by ``KART_REPLICA_MAX_LAG``) while the
+        sync loop catches up; past the bound, pin the read to the primary
+        instead. -> True when the request was answered here (pinned)."""
+        fleet = self._fleet()
+        if fleet is None or not fleet.is_replica:
+            return False
+        from kart_tpu import fleet as fleet_mod
+
+        min_commit = self.headers.get(fleet_mod.MIN_COMMIT_HEADER)
+        if not min_commit:
+            return False
+        min_commit = min_commit.strip()
+        if not re.fullmatch(r"[0-9a-f]{40}", min_commit):
+            # a malformed pin must not stall every read for the lag bound
+            return False
+        if fleet.sync.tips_contain(min_commit):
+            return False  # already visible: serve locally, no stall
+        if fleet.sync.wait_for_commit(min_commit, fleet_mod.max_lag_seconds()):
+            tm.incr("fleet.ryw_stalls")
+            tm.annotate(ryw="stalled")
+            fleet.note_ryw(pinned=False)
+            return False
+        # the replica cannot catch up inside the lag bound (primary down,
+        # transfer still draining): answer from the primary itself rather
+        # than serve a view the client has proven is stale
+        tm.incr("fleet.ryw_pins")
+        tm.annotate(ryw="pinned")
+        fleet.note_ryw(pinned=True)
+        from kart_tpu.fleet import router
+
+        try:
+            if self.command == "POST":
+                # the POST data-fetch verbs (fetch-pack/fetch-blobs) are
+                # reads too: relay them body-and-all — a GET relay would
+                # hit a route the primary doesn't serve
+                with self._read_body_spooled() as body:
+                    length = body.seek(0, 2)
+                    body.seek(0)
+                    status, headers, payload = router.proxy_post(
+                        fleet, self.path, body, length,
+                        content_type=self.headers.get("Content-Type"),
+                    )
+            else:
+                status, headers, payload = router.proxy_get(
+                    fleet, self.path, request_headers=self.headers
+                )
+        except router.ProxyUpstreamError as e:
+            self._json(
+                502, {"error": f"Replica is behind and its primary is "
+                               f"unreachable: {e}"}
+            )
+            return True
+        self._respond_relayed(status, headers, payload)
+        return True
+
+    def _respond_relayed(self, status, headers, payload, extra=None):
+        """Answer with a response relayed from the primary, byte-for-byte
+        (status, selected headers, entire payload)."""
+        self.send_response(status)
+        for name, value in headers.items():
+            self.send_header(name, value)
+        for name, value in (extra or {}).items():
+            self.send_header(name, value)
+        self.send_header("Content-Length", str(len(payload)))
+        self.end_headers()
+        if payload:
+            self.wfile.write(payload)
+        tm.incr("transport.server.bytes_sent", len(payload))
 
     def _handle_refs(self):
         from kart_tpu.transport.service import ls_refs_info
@@ -519,15 +613,17 @@ class KartRequestHandler(BaseHTTPRequestHandler):
         ref, ds_path = parts[0], "/".join(parts[1:-3])
         z, x, y = parts[-3:]
         tm.annotate(ref=ref, dataset=ds_path, tile=f"{z}/{x}/{y}")
-        params = parse_qs(urlsplit(self.path).query)
-        layers = params.get("layers", [None])[0]
+        query = urlsplit(self.path).query
+        layers = parse_qs(query).get("layers", [None])[0] if query else None
         try:
             # the validator derives from the request key alone (commit oid
             # + address + layers): a revalidating client is answered 304
             # before any source is built or payload encoded — even on a
             # cold cache, a conditional GET is near-free
-            etag, commit_oid = tiles.tile_etag(
-                self.repo, ref, ds_path, z, x, y, layers=layers
+            key, etag, commit_oid, (zi, xi, yi), norm_layers = (
+                tiles.tile_request_key(
+                    self.repo, ref, ds_path, z, x, y, layers=layers
+                )
             )
             if self._if_none_match_hits(self.headers.get("If-None-Match"), etag):
                 # commit-addressed: a matching validator can never be stale
@@ -537,9 +633,28 @@ class KartRequestHandler(BaseHTTPRequestHandler):
                 self.send_header("Content-Length", "0")
                 self.end_headers()
                 return
+            peer_fill = None
+            fleet = self._fleet()
+            if fleet is not None and fleet.peers and not self._is_peer_fill():
+                from kart_tpu.fleet import peercache
+
+                # the peer cache tier (docs/FLEET.md §4): hot peer-held
+                # tiles answer from one lock-hold read; cold tiles are
+                # fetched from a fleet peer — validated by ETag equality —
+                # before this process pays the block-pruned encode
+                payload = peercache.peek_tile_payload(fleet.peer_cache(), key)
+                if payload is not None:
+                    tm.annotate(tile_cache="peer")
+                    tm.incr("tiles.served")
+                    tm.incr("tiles.bytes_out", len(payload))
+                    return self._send_tile(payload, etag)
+                peer_fill = peercache.tile_peer_fill(
+                    self.repo, fleet.peers, commit_oid, ds_path, zi, xi, yi,
+                    norm_layers,
+                )
             payload, etag, _cached = tiles.serve_tile(
-                self.repo, ref, ds_path, z, x, y, layers=layers,
-                commit_oid=commit_oid,
+                self.repo, ref, ds_path, zi, xi, yi, layers=norm_layers,
+                commit_oid=commit_oid, peer_fill=peer_fill,
             )
         except tiles.TileTooLarge as e:
             return self._json(
@@ -551,6 +666,9 @@ class KartRequestHandler(BaseHTTPRequestHandler):
             return self._json(404, {"error": str(e)})
         except (tiles.TileAddressError, tiles.TileEncodeError) as e:
             return self._json(400, {"error": str(e)})
+        self._send_tile(payload, etag)
+
+    def _send_tile(self, payload, etag):
         tm.incr("transport.server.bytes_sent", len(payload))
         self.send_response(200)
         self.send_header("Content-Type", "application/x-kart-tile")
@@ -575,12 +693,13 @@ class KartRequestHandler(BaseHTTPRequestHandler):
         tm.incr("transport.server.requests", verb="stats")
         params = parse_qs(urlsplit(self.path).query)
         if params.get("format", [""])[0] == "json":
-            return self._json(
-                200,
-                rq_access.stats_payload(
-                    extra={"inflight": self.server.inflight}
-                ),
-            )
+            extra = {"inflight": self.server.inflight}
+            fleet = self._fleet()
+            if fleet is not None:
+                # the fleet operator's staleness view: replication lag,
+                # proxied writes, read-your-writes decisions per replica
+                extra["fleet"] = fleet.status_dict()
+            return self._json(200, rq_access.stats_payload(extra=extra))
         raw = sinks.prometheus_text().encode()
         self.send_response(200)
         self.send_header("Content-Type", "text/plain; version=0.0.4")
@@ -613,6 +732,7 @@ class KartRequestHandler(BaseHTTPRequestHandler):
         # concurrent identical request) skips the ObjectEnumerator walk;
         # a fresh walk spools, publishes, then streams
         plan = serve_fetch_pack(self.repo, req)
+        plan = self._peer_filled_plan(req, plan)
         fp, length = materialise_plan(plan)
         with closing(fp):
             offset = self._range_offset(plan.etag, length)
@@ -655,6 +775,40 @@ class KartRequestHandler(BaseHTTPRequestHandler):
                     break
                 self.wfile.write(chunk)
 
+    def _peer_filled_plan(self, req, plan):
+        """The peer cache tier for enumerations (docs/FLEET.md §4): a plan
+        about to pay a fresh walk may instead fetch the complete framed
+        response from a fleet peer — accepted only when the peer's strong
+        validator equals ours (same key ⇒ byte-identical response). One
+        cold walk per fleet, not one per replica. Exclusion-bearing
+        one-shot resume requests stay local (their keys can never re-hit
+        — peer-caching them would only evict hot entries)."""
+        fleet = self._fleet()
+        if (
+            fleet is None
+            or not fleet.peers
+            or plan.cached
+            or plan.data is not None
+            or req.get("exclude")
+            # a fill from another replica must be answered from local
+            # state — mutually-peered replicas would otherwise recurse
+            or self._is_peer_fill()
+        ):
+            return plan
+        from kart_tpu.fleet import peercache
+        from kart_tpu.transport.service import FetchPlan
+
+        peer_bytes = peercache.fetch_pack_from_peers(
+            self.repo, fleet.peers, req, plan.etag
+        )
+        if peer_bytes is None:
+            return plan
+        # release the enum-cache fill token: the payload lives in the peer
+        # cache, and waiters on this key will hit it there
+        plan.abandon()
+        tm.annotate(enum_cache="peer")
+        return FetchPlan(None, peer_bytes, None, plan.etag, True)
+
     def _handle_fetch_blobs(self):
         from kart_tpu.transport.service import collect_blobs
 
@@ -662,9 +816,53 @@ class KartRequestHandler(BaseHTTPRequestHandler):
         header, objects = collect_blobs(self.repo, req.get("oids", []))
         self._framed(header, objects)
 
+    def _handle_proxy_receive_pack(self):
+        """A replica never lands writes itself: the framed push body is
+        relayed to the primary byte-for-byte (same traceparent, so the
+        primary's trace joins the client's), and the primary's response —
+        including the structured rebase/rejection payload — is relayed
+        back unmodified, plus the ``X-Kart-Replica-Proxied`` marker the
+        client pins its next reads on (docs/FLEET.md §3)."""
+        from kart_tpu import fleet as fleet_mod
+        from kart_tpu.fleet import router
+        from kart_tpu.transport.remote import is_http_url
+
+        fleet = self._fleet()
+        tm.incr("transport.server.requests", verb="receive-pack")
+        if not is_http_url(fleet.primary_url):
+            # replication pulls work over any transport, but the byte-level
+            # write relay needs an HTTP primary (docs/FLEET.md §3)
+            return self._json(
+                501,
+                {"error": f"This replica cannot proxy pushes (primary "
+                          f"{fleet.primary_url!r} is not http(s)); push to "
+                          f"the primary directly"},
+            )
+        with self._read_body_spooled() as body:
+            length = body.seek(0, 2)
+            body.seek(0)
+            try:
+                status, headers, payload = router.proxy_receive_pack(
+                    fleet, body, length
+                )
+            except router.ProxyUpstreamError as e:
+                # 502 is in the client's transient set: the push retries
+                # against a recovered primary, nothing half-applied
+                return self._json(
+                    502, {"error": f"Replica cannot reach its primary: {e}"}
+                )
+        tm.annotate(proxied=True)
+        self._respond_relayed(
+            status, headers, payload, extra={fleet_mod.PROXIED_HEADER: "1"}
+        )
+
     def _handle_receive_pack(self):
         from kart_tpu.transport.protocol import rejection_wire_fields
         from kart_tpu.transport.service import quarantined_receive
+
+        fleet = self._fleet()
+        if fleet is not None and fleet.is_replica:
+            return self._handle_proxy_receive_pack()
 
         # the pack drains into a quarantine objects dir and migrates into
         # the live store only after checksum + ref preconditions pass — a
@@ -695,17 +893,20 @@ class KartRequestHandler(BaseHTTPRequestHandler):
         self._json(status, payload, headers)
 
 
-def make_server(repo, host="127.0.0.1", port=0):
+def make_server(repo, host="127.0.0.1", port=0, *, fleet=None):
     """-> ThreadingHTTPServer serving `repo`; port 0 picks a free port.
 
     Serving turns metrics on (a server without observable counters is
     undebuggable in production — the registry feeds ``GET /api/v1/stats``)
     and configures the shared ``kart_tpu`` logger so a spawned server
-    honours ``KART_LOG`` without the CLI having run."""
+    honours ``KART_LOG`` without the CLI having run. ``fleet``: a
+    :class:`kart_tpu.fleet.FleetNode` making this server a replica and/or
+    peer-cache member (docs/FLEET.md); the caller starts/stops it."""
     tm.configure_logging()
     tm.enable(metrics=True)
     server = ThreadingHTTPServer((host, port), KartRequestHandler)
     server.kart_repo = repo
+    server.fleet = fleet
     # narrow write lock: held only around ref validation + quarantine
     # migrate inside quarantined_receive — concurrent pushes drain their
     # (per-push) quarantines in parallel and serialise only at the CAS
@@ -717,8 +918,19 @@ def make_server(repo, host="127.0.0.1", port=0):
 
 
 def serve(repo, host="127.0.0.1", port=8470, *, in_thread=False):
-    """Run the collaboration server (blocking unless in_thread)."""
-    server = make_server(repo, host, port)
+    """Run the collaboration server (blocking unless in_thread).
+
+    Fleet membership is environment-configured (``KART_REPLICA_OF``,
+    ``KART_PEER_CACHE`` — docs/FLEET.md): a replica starts its background
+    sync loop here and stops it with the server. With ``in_thread=True``
+    the caller owns shutdown: stop the loop via ``server.fleet.stop()``
+    alongside ``server.shutdown()``."""
+    from kart_tpu import fleet as fleet_mod
+
+    node = fleet_mod.node_from_env(repo)
+    server = make_server(repo, host, port, fleet=node)
+    if node is not None:
+        node.start()
     if in_thread:
         t = threading.Thread(target=server.serve_forever, daemon=True)
         t.start()
@@ -726,6 +938,8 @@ def serve(repo, host="127.0.0.1", port=8470, *, in_thread=False):
     try:
         server.serve_forever()
     finally:
+        if node is not None:
+            node.stop()
         server.server_close()
 
 
@@ -790,6 +1004,11 @@ class HttpRemote:
 
         self.base = url.rstrip("/")
         self.retry = retry if retry is not None else RetryPolicy.from_config()
+        # read-your-writes pin (docs/FLEET.md §3): set after a push that a
+        # replica proxied to its primary; subsequent reads through this
+        # client carry it so the replica stalls (or pins to the primary)
+        # until its view contains the pushed commit
+        self._min_commit = None
 
     def close(self):
         """No persistent connection; symmetric with StdioRemote so callers
@@ -809,8 +1028,13 @@ class HttpRemote:
         return {rq_context.TRACEPARENT_HEADER: traceparent}
 
     def _get(self, path):
+        headers = self._trace_headers()
+        if self._min_commit is not None:
+            from kart_tpu import fleet as fleet_mod
+
+            headers[fleet_mod.MIN_COMMIT_HEADER] = self._min_commit
         try:
-            req = Request(self.base + path, headers=self._trace_headers())
+            req = Request(self.base + path, headers=headers)
             with urlopen(req, timeout=http_timeout()) as resp:
                 return json.loads(resp.read().decode())
         except HTTPError as e:
@@ -837,6 +1061,14 @@ class HttpRemote:
             "Content-Type": "application/x-kartpack" if raw else "application/json"
         }
         all_headers.update(self._trace_headers())
+        if self._min_commit is not None:
+            from kart_tpu import fleet as fleet_mod
+
+            # the POST data-fetch verbs must carry the read-your-writes
+            # pin too: a pinned ls-refs advertising the new tip followed
+            # by an ungated fetch-pack from the stale store would fail on
+            # exactly the objects the pin exists to guarantee
+            all_headers[fleet_mod.MIN_COMMIT_HEADER] = self._min_commit
         if headers:
             all_headers.update(headers)
         body = data if raw else json.dumps(data).encode()
@@ -1046,5 +1278,19 @@ class HttpRemote:
                     attempt, label="receive-pack", retryable=retryable,
                     on_retry=self.reset,
                 )
+        from kart_tpu import fleet as fleet_mod
+
         with resp:
-            return json.loads(resp.read().decode())
+            proxied = resp.headers.get(fleet_mod.PROXIED_HEADER)
+            payload = json.loads(resp.read().decode())
+        if proxied:
+            from kart_tpu.fleet import router as fleet_router
+
+            # the server was a replica relaying to its primary: pin this
+            # client's next reads on the landed branch tip
+            # (read-your-writes; heads only — a tag oid would never
+            # satisfy the replica's tip-containment check)
+            landed = fleet_router.landed_head_oids(payload)
+            if landed:
+                self._min_commit = landed[-1]
+        return payload

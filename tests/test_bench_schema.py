@@ -207,6 +207,39 @@ NEW_KEYS += [
 ]
 
 
+#: keys added by ISSUE 13 (`bench.py --fleet`: a primary + M pull-replicas
+#: serving N clients — aggregate cached tiles/s across the replica fleet
+#: vs the single-node BENCH_r10 cached number, peer-cache hit rate,
+#: aggregate clone throughput fanned across replicas, replication lag
+#: (push-ack → replica-visible) p99, and the failover drill: the primary
+#: SIGKILLed mid-write-storm must lose zero acked commits and the
+#: replicas must converge byte-identical). Recorded in BENCH_r13.json.
+NEW_KEYS += [
+    "fleet_rows",
+    "fleet_replicas",
+    "fleet_synth_seconds",
+    "fleet_initial_sync_seconds",
+    "fleet_tile_clients",
+    "fleet_tile_requests_total",
+    "fleet_tile_ok_requests",
+    "fleet_agg_tiles_per_sec",
+    "fleet_tile_p99_request_seconds",
+    "fleet_peer_cache_hit_rate",
+    "fleet_tiles_vs_single_node_cached",
+    "fleet_tiles_beats_single_node",
+    "fleet_clone_clients",
+    "fleet_clone_ok",
+    "fleet_agg_clone_features_per_sec",
+    "fleet_lag_pushes",
+    "fleet_replication_lag_p99_seconds",
+    "fleet_replication_lag_mean_seconds",
+    "fleet_failover_commits_acked",
+    "fleet_failover_restarted",
+    "fleet_failover_lost_commits",
+    "fleet_replicas_converged_identical",
+]
+
+
 def test_bench_emits_every_recorded_key():
     with open(os.path.join(REPO_ROOT, "bench.py")) as f:
         src = f.read()

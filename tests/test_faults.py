@@ -1262,3 +1262,160 @@ def test_poisoned_tile_cache_fill_is_never_served(served_repo, monkeypatch):
     status, again = _get_tile(url, tile)
     assert status == 200 and again == payload
     assert count("tiles.cache.hits") == 1
+
+
+# ---------------------------------------------------------------------------
+# fleet: the replica sync + write-proxy kill matrices (ISSUE 13)
+# ---------------------------------------------------------------------------
+
+
+def _fleet_pair(served_repo, tmp_path):
+    """A replica (already synced once) of the served primary, plus its own
+    in-thread server — the fleet kill-matrix fixture."""
+    from kart_tpu import fleet as fleet_mod
+    from kart_tpu.core.repo import KartRepo
+    from kart_tpu.transport.http import make_server
+
+    repo, ds_path, url = served_repo
+    replica = KartRepo.init_repository(str(tmp_path / "replica"))
+    node = fleet_mod.FleetNode(replica, primary_url=url)
+    node.sync.sync_once()
+    server = make_server(replica, fleet=node)
+    threading.Thread(target=server.serve_forever, daemon=True).start()
+    rurl = f"http://127.0.0.1:{server.server_address[1]}"
+    return repo, ds_path, replica, node, server, rurl
+
+
+def _refs_and_digest(repo):
+    refs = dict(repo.refs.iter_refs("refs/"))
+    h = hashlib.sha256()
+    for oid in sorted(repo.odb.iter_oids()):
+        h.update(oid.encode())
+    return refs, h.hexdigest()
+
+
+@pytest.mark.parametrize("frame", [1, 2, 3])
+def test_replica_sync_killed_at_every_frame_converges(
+    served_repo, tmp_path, monkeypatch, frame
+):
+    """A replica killed at any fleet.sync frame — the pack-migrate
+    boundary (1) or before each ref advance (2+) — restarts, re-runs the
+    cycle, and converges byte-identical to the primary; every
+    intermediate state is consistent (no ref ever names a missing
+    object)."""
+    from helpers import edit_commit as _edit
+
+    repo, ds_path, replica, node, server, rurl = _fleet_pair(
+        served_repo, tmp_path
+    )
+    try:
+        # two refs move this round, so frame 3 (the second ref advance)
+        # exists: a mid-advance kill leaves one ref new, one old
+        _edit(
+            repo, ds_path,
+            updates=[{"fid": 2, "geom": None, "name": "k", "rating": 1.0}],
+            message="kill-matrix commit",
+        )
+        repo.refs.set(
+            "refs/heads/dev", repo.refs.get("refs/heads/main"),
+            log_message="branch",
+        )
+        monkeypatch.setenv("KART_FAULTS", f"fleet.sync:{frame}")
+        with pytest.raises(faults.InjectedFault):
+            node.sync.sync_once()
+        monkeypatch.delenv("KART_FAULTS")
+        # the torn state is consistent: every local ref resolves
+        for ref, oid in replica.refs.iter_refs("refs/"):
+            assert replica.odb.contains(oid), f"{ref} dangles after kill"
+        # the restarted cycle converges byte-identical
+        node.sync.sync_once()
+        assert _refs_and_digest(replica) == _refs_and_digest(repo)
+        fsck_objects(replica)
+    finally:
+        server.shutdown()
+        server.server_close()
+
+
+def test_proxy_killed_before_upstream_leaves_primary_identical(
+    served_repo, tmp_path, monkeypatch
+):
+    """fleet.proxy frame 1 fires before any request byte reaches the
+    primary: the primary's store and refs are byte-identical after the
+    kill, and the client's retry lands the push exactly once."""
+    from helpers import edit_commit as _edit
+
+    from kart_tpu import transport
+    from kart_tpu.transport.remote import RemoteError
+
+    repo, ds_path, replica, node, server, rurl = _fleet_pair(
+        served_repo, tmp_path
+    )
+    try:
+        clone = transport.clone(rurl, str(tmp_path / "c"), do_checkout=False)
+        clone.config.set_many(
+            {"user.name": "w", "user.email": "w@example.com"}
+        )
+        new_oid = _edit(
+            clone, ds_path,
+            updates=[{"fid": 1, "geom": None, "name": "p", "rating": 1.0}],
+            message="proxied",
+        )
+        before_snap = store_snapshot(repo)
+        before_refs = dict(repo.refs.iter_refs("refs/"))
+        monkeypatch.setenv("KART_FAULTS", "fleet.proxy:1")
+        with pytest.raises(RemoteError):
+            transport.push(clone, "origin")
+        monkeypatch.delenv("KART_FAULTS")
+        assert store_snapshot(repo) == before_snap
+        assert dict(repo.refs.iter_refs("refs/")) == before_refs
+        # the retry lands once
+        updated = transport.push(clone, "origin")
+        assert updated["refs/heads/main"] == new_oid
+        assert repo.refs.get("refs/heads/main") == new_oid
+    finally:
+        server.shutdown()
+        server.server_close()
+
+
+def test_proxy_killed_mid_relay_push_landed_retry_idempotent(
+    served_repo, tmp_path, monkeypatch
+):
+    """fleet.proxy frame 2 fires after the primary answered: the push IS
+    landed upstream; the client sees a torn response and its explicit
+    retry is absorbed idempotently (same commit, same ref — exactly one
+    new commit on the primary, no duplicate)."""
+    from helpers import edit_commit as _edit
+
+    from kart_tpu import transport
+    from kart_tpu.transport.remote import RemoteError
+
+    repo, ds_path, replica, node, server, rurl = _fleet_pair(
+        served_repo, tmp_path
+    )
+    try:
+        clone = transport.clone(rurl, str(tmp_path / "c"), do_checkout=False)
+        clone.config.set_many(
+            {"user.name": "w", "user.email": "w@example.com"}
+        )
+        new_oid = _edit(
+            clone, ds_path,
+            updates=[{"fid": 1, "geom": None, "name": "m", "rating": 2.0}],
+            message="mid-relay",
+        )
+        monkeypatch.setenv("KART_FAULTS", "fleet.proxy:2")
+        with pytest.raises(RemoteError):
+            transport.push(clone, "origin")
+        monkeypatch.delenv("KART_FAULTS")
+        # the push landed upstream despite the torn relay
+        assert repo.refs.get("refs/heads/main") == new_oid
+        count_before = sum(1 for _ in repo.odb.iter_oids())
+        # the client's retry is absorbed: no duplicate commit, no new
+        # objects, ref unchanged
+        updated = transport.push(clone, "origin")
+        assert updated["refs/heads/main"] == new_oid
+        assert repo.refs.get("refs/heads/main") == new_oid
+        assert sum(1 for _ in repo.odb.iter_oids()) == count_before
+        fsck_objects(repo)
+    finally:
+        server.shutdown()
+        server.server_close()

@@ -1,0 +1,727 @@
+"""Scale-out serving fleet (ISSUE 13; docs/FLEET.md): pull-replication
+convergence (byte-identical refs + object stores under random push
+interleavings), read-your-writes routing through a replica, byte-for-byte
+proxied pushes (rebase/rejection parity with a direct primary push, one
+trace end-to-end), and the commit-addressed peer cache tier."""
+
+import hashlib
+import json
+import os
+import threading
+import time
+import urllib.request
+from urllib.parse import quote
+
+import pytest
+
+from kart_tpu import fleet as fleet_mod
+from kart_tpu import telemetry, transport
+from kart_tpu.core.repo import KartRepo
+from kart_tpu.fleet import peercache
+from kart_tpu.transport.http import HttpRemote, HttpTransportError, make_server
+from kart_tpu.transport.protocol import ObjectEnumerator
+
+from helpers import edit_commit, make_imported_repo
+
+
+@pytest.fixture(autouse=True)
+def _fresh_state(monkeypatch):
+    telemetry.reset()
+    for var in (
+        "KART_FAULTS",
+        "KART_REPLICA_OF",
+        "KART_REPLICA_POLL_SECONDS",
+        "KART_REPLICA_MAX_LAG",
+        "KART_PEER_CACHE",
+        "KART_TILE_CACHE",
+        "KART_SERVE_ENUM_CACHE",
+    ):
+        monkeypatch.delenv(var, raising=False)
+    monkeypatch.setenv("KART_TRANSPORT_RETRY_BASE", "0.01")
+    monkeypatch.setenv("KART_TRANSPORT_RETRY_CAP", "0.05")
+    with peercache._peer_caches_lock:
+        peercache._PEER_CACHES.clear()
+    with peercache._peer_down_lock:
+        peercache._peer_down.clear()
+    yield
+    telemetry.reset()
+
+
+def serve_in_thread(repo, fleet=None):
+    server = make_server(repo, fleet=fleet)
+    threading.Thread(target=server.serve_forever, daemon=True).start()
+    return server, f"http://127.0.0.1:{server.server_address[1]}"
+
+
+@pytest.fixture()
+def primary(tmp_path):
+    (tmp_path / "primary").mkdir()
+    repo, ds_path = make_imported_repo(tmp_path / "primary", n=12)
+    repo.config["receive.denyCurrentBranch"] = "ignore"
+    server, url = serve_in_thread(repo)
+    yield repo, ds_path, url
+    server.shutdown()
+    server.server_close()
+
+
+def make_replica(tmp_path, primary_url, name="replica", peers=(), sync=True):
+    repo = KartRepo.init_repository(str(tmp_path / name))
+    node = fleet_mod.FleetNode(repo, primary_url=primary_url, peers=peers)
+    if sync:
+        node.sync.sync_once()
+    server, url = serve_in_thread(repo, fleet=node)
+    return repo, node, server, url
+
+
+def refs_of(repo):
+    return dict(repo.refs.iter_refs("refs/"))
+
+
+def odb_digest(repo):
+    """Content digest of the object store: equal digests = byte-identical
+    stores (oid = content address, so the sorted oid set pins every byte)."""
+    h = hashlib.sha256()
+    for oid in sorted(repo.odb.iter_oids()):
+        h.update(oid.encode())
+    return h.hexdigest()
+
+
+def counter(name, **labels):
+    for n, l, v in telemetry.snapshot()["counters"]:
+        if n == name and l == labels:
+            return v
+    return 0
+
+
+def raw_push(url, repo, new_oid, *, old_oid, ref="refs/heads/main",
+             client=None):
+    """Drive receive-pack directly so tests pick the CAS base and keep the
+    client instance (the read-your-writes pin lives on it)."""
+    from kart_tpu.transport.http import have_closure
+    from kart_tpu.transport.remote import read_shallow
+    from kart_tpu.transport.retry import RetryPolicy
+
+    client = client or HttpRemote(url, retry=RetryPolicy(attempts=1))
+    info = client.ls_refs()
+    server_refs = {f"refs/heads/{b}": o for b, o in info["heads"].items()}
+    has = have_closure(
+        repo.odb, list(server_refs.values()), info.get("shallow", ())
+    )
+    enum = ObjectEnumerator(
+        repo.odb, [new_oid], has=has.__contains__,
+        sender_shallow=read_shallow(repo),
+    )
+    return client.receive_pack(
+        enum,
+        [{"ref": ref, "old": old_oid, "new": new_oid, "force": False}],
+        shallow=lambda: enum.shallow_boundary,
+    )
+
+
+# ---------------------------------------------------------------------------
+# replication: the sync loop over the exclusion lane
+# ---------------------------------------------------------------------------
+
+
+def test_sync_mirrors_refs_and_objects(primary, tmp_path):
+    repo, ds_path, url = primary
+    replica = KartRepo.init_repository(str(tmp_path / "r"))
+    node = fleet_mod.FleetNode(replica, primary_url=url)
+    first = node.sync.sync_once()
+    assert first["objects"] > 0 and first["advanced"] == 1
+    assert refs_of(replica) == refs_of(repo)
+    assert odb_digest(replica) == odb_digest(repo)
+    # the second cycle is a no-op: oid-exclusion/haves mean zero re-ship
+    second = node.sync.sync_once()
+    assert second == {
+        "objects": 0, "advanced": 0, "deleted": 0, "in_sync": True
+    }
+
+
+def test_sync_ships_only_the_delta(primary, tmp_path):
+    repo, ds_path, url = primary
+    replica = KartRepo.init_repository(str(tmp_path / "r"))
+    node = fleet_mod.FleetNode(replica, primary_url=url)
+    initial = node.sync.sync_once()
+    edit_commit(
+        repo, ds_path,
+        updates=[{"fid": 1, "geom": None, "name": "delta", "rating": 1.0}],
+        message="one more commit",
+    )
+    delta = node.sync.sync_once()
+    # one commit, its changed tree spine and the one changed blob — a
+    # strict fraction of the full store, not a re-clone
+    assert 0 < delta["objects"] < initial["objects"]
+    assert refs_of(replica) == refs_of(repo)
+
+
+def test_sync_deletes_vanished_branches(primary, tmp_path):
+    repo, ds_path, url = primary
+    tip = repo.refs.get("refs/heads/main")
+    repo.refs.set("refs/heads/dev", tip, log_message="test")
+    replica = KartRepo.init_repository(str(tmp_path / "r"))
+    node = fleet_mod.FleetNode(replica, primary_url=url)
+    node.sync.sync_once()
+    assert replica.refs.get("refs/heads/dev") == tip
+    repo.refs.delete("refs/heads/dev")
+    result = node.sync.sync_once()
+    assert result["deleted"] == 1
+    assert replica.refs.get("refs/heads/dev") is None
+    assert refs_of(replica) == refs_of(repo)
+
+
+def test_convergence_under_random_interleavings(primary, tmp_path):
+    """The replication convergence property: random pushes landing on the
+    primary, two replicas syncing at arbitrary interleaved moments — after
+    a final cycle each, both replicas' refs and object stores are
+    byte-identical to each other and to the primary."""
+    import random
+
+    rng = random.Random(13)
+    repo, ds_path, url = primary
+    r1 = KartRepo.init_repository(str(tmp_path / "r1"))
+    r2 = KartRepo.init_repository(str(tmp_path / "r2"))
+    n1 = fleet_mod.FleetNode(r1, primary_url=url)
+    n2 = fleet_mod.FleetNode(r2, primary_url=url)
+    nodes = [n1, n2]
+    fid = 1
+    for _round in range(8):
+        action = rng.random()
+        if action < 0.6:
+            fid += 1
+            edit_commit(
+                repo, ds_path,
+                updates=[{
+                    "fid": (fid % 12) + 1, "geom": None,
+                    "name": f"round-{_round}", "rating": float(_round),
+                }],
+                message=f"storm commit {_round}",
+            )
+        elif action < 0.8:
+            repo.refs.set(
+                f"refs/heads/b{_round}",
+                repo.refs.get("refs/heads/main"),
+                log_message="branch",
+            )
+        # a random subset of replicas syncs mid-storm, in random order
+        for node in rng.sample(nodes, rng.randint(0, 2)):
+            node.sync.sync_once()
+    for node in nodes:
+        node.sync.sync_once()
+    assert refs_of(r1) == refs_of(r2) == refs_of(repo)
+    assert odb_digest(r1) == odb_digest(r2) == odb_digest(repo)
+
+
+def test_replica_serves_reads_with_primary_down(primary, tmp_path):
+    repo, ds_path, url = primary
+    replica, node, server, rurl = make_replica(tmp_path, url)
+    try:
+        # reads are answered from local state: no primary round-trip, so
+        # they keep working when the primary is unreachable
+        node.sync.stop()
+        dead = fleet_mod.FleetNode(replica, primary_url="http://127.0.0.1:9")
+        server.fleet = dead
+        client = HttpRemote(rurl)
+        info = client.ls_refs()
+        assert info["heads"]["main"] == repo.refs.get("refs/heads/main")
+        dst = KartRepo.init_repository(str(tmp_path / "c"))
+        header = client.fetch_pack(dst, list(info["heads"].values()))
+        assert header["object_count"] > 0
+    finally:
+        server.shutdown()
+        server.server_close()
+
+
+# ---------------------------------------------------------------------------
+# routing: proxied writes + read-your-writes
+# ---------------------------------------------------------------------------
+
+
+def test_push_through_replica_lands_on_primary(primary, tmp_path):
+    repo, ds_path, url = primary
+    replica, node, server, rurl = make_replica(tmp_path, url)
+    node.start()
+    try:
+        clone = transport.clone(rurl, str(tmp_path / "c"), do_checkout=False)
+        clone.config.set_many(
+            {"user.name": "w", "user.email": "w@example.com"}
+        )
+        new_oid = edit_commit(
+            clone, ds_path,
+            updates=[{"fid": 3, "geom": None, "name": "via-replica",
+                      "rating": 9.0}],
+            message="proxied push",
+        )
+        updated = transport.push(clone, "origin")
+        assert updated["refs/heads/main"] == new_oid
+        # the write landed on the PRIMARY (the replica never lands writes)
+        assert repo.refs.get("refs/heads/main") == new_oid
+        assert node.status_dict()["proxied_writes"] == 1
+        # the proxied write kicked the sync loop: the replica converges
+        # without waiting out a poll interval
+        deadline = time.monotonic() + 10
+        while time.monotonic() < deadline:
+            if replica.refs.get("refs/heads/main") == new_oid:
+                break
+            time.sleep(0.05)
+        assert replica.refs.get("refs/heads/main") == new_oid
+    finally:
+        node.stop()
+        server.shutdown()
+        server.server_close()
+
+
+def test_read_your_writes_through_same_replica(primary, tmp_path):
+    """The regression the RYW machinery exists for: push through a
+    replica, immediately read the new tip through the same replica — the
+    read must see the pushed commit, never the replica's stale view."""
+    repo, ds_path, url = primary
+    replica, node, server, rurl = make_replica(tmp_path, url)
+    node.start()
+    try:
+        clone = transport.clone(rurl, str(tmp_path / "c"), do_checkout=False)
+        clone.config.set_many(
+            {"user.name": "w", "user.email": "w@example.com"}
+        )
+        new_oid = edit_commit(
+            clone, ds_path,
+            updates=[{"fid": 5, "geom": None, "name": "ryw", "rating": 1.0}],
+            message="ryw",
+        )
+        client = HttpRemote(rurl)
+        old = client.ls_refs()["heads"]["main"]
+        result = raw_push(rurl, clone, new_oid, old_oid=old, client=client)
+        assert result["updated"]["refs/heads/main"] == new_oid
+        assert client._min_commit == new_oid  # the pin was taken
+        # immediately: the same client's read stalls until the replica's
+        # tips contain the pushed commit, then answers locally
+        info = client.ls_refs()
+        assert info["heads"]["main"] == new_oid
+        assert node.status_dict()["ryw_stalls"] >= 1
+    finally:
+        node.stop()
+        server.shutdown()
+        server.server_close()
+
+
+def test_ryw_pins_to_primary_past_lag_bound(primary, tmp_path, monkeypatch):
+    """A replica that cannot catch up inside KART_REPLICA_MAX_LAG answers
+    the pinned read from the primary itself (never a stale view)."""
+    monkeypatch.setenv("KART_REPLICA_MAX_LAG", "0.2")
+    repo, ds_path, url = primary
+    # sync thread deliberately NOT started: the replica can never catch up
+    replica, node, server, rurl = make_replica(tmp_path, url)
+    try:
+        clone = transport.clone(rurl, str(tmp_path / "c"), do_checkout=False)
+        clone.config.set_many(
+            {"user.name": "w", "user.email": "w@example.com"}
+        )
+        new_oid = edit_commit(
+            clone, ds_path,
+            updates=[{"fid": 6, "geom": None, "name": "pin", "rating": 2.0}],
+            message="pin",
+        )
+        client = HttpRemote(rurl)
+        old = client.ls_refs()["heads"]["main"]
+        raw_push(rurl, clone, new_oid, old_oid=old, client=client)
+        info = client.ls_refs()  # proxied to the primary
+        assert info["heads"]["main"] == new_oid
+        assert node.status_dict()["ryw_pins"] >= 1
+        # the replica itself is still behind — the pin, not luck, answered
+        assert replica.refs.get("refs/heads/main") != new_oid
+    finally:
+        server.shutdown()
+        server.server_close()
+
+
+def test_malformed_min_commit_header_is_ignored(primary, tmp_path):
+    repo, ds_path, url = primary
+    replica, node, server, rurl = make_replica(tmp_path, url)
+    try:
+        req = urllib.request.Request(
+            f"{rurl}/api/v1/refs",
+            headers={fleet_mod.MIN_COMMIT_HEADER: "not-a-commit"},
+        )
+        t0 = time.monotonic()
+        with urllib.request.urlopen(req, timeout=10) as resp:
+            assert resp.status == 200
+        assert time.monotonic() - t0 < 5.0  # no lag-bound stall
+    finally:
+        server.shutdown()
+        server.server_close()
+
+
+# ---------------------------------------------------------------------------
+# proxied-push parity: same payloads, same trace as a direct primary push
+# ---------------------------------------------------------------------------
+
+
+def _conflicting_loser(repo, ds_path, url, tmp_path):
+    """Two clones race one feature; the winner lands directly on the
+    primary — returns the loser clone + its conflicting commit."""
+    winner = transport.clone(url, str(tmp_path / "winner"), do_checkout=False)
+    winner.config.set_many({"user.name": "w", "user.email": "w@example.com"})
+    loser = transport.clone(url, str(tmp_path / "loser"), do_checkout=False)
+    loser.config.set_many({"user.name": "l", "user.email": "l@example.com"})
+    edit_commit(
+        winner, ds_path,
+        updates=[{"fid": 7, "geom": None, "name": "winner", "rating": 1.0}],
+        message="winner",
+    )
+    loser_oid = edit_commit(
+        loser, ds_path,
+        updates=[{"fid": 7, "geom": None, "name": "loser", "rating": 2.0}],
+        message="loser",
+    )
+    transport.push(winner, "origin")
+    return loser, loser_oid
+
+
+def test_proxied_push_conflict_report_byte_identical(
+    primary, tmp_path, monkeypatch
+):
+    """A rejected contended push through a replica carries the PR 8
+    structured report byte-for-byte identical to a direct primary push on
+    BOTH transports — the proxy relays the primary's response body
+    unmodified, and the report document itself is transport-independent."""
+    from kart_tpu.transport.stdio import StdioRemote, StdioTransportError
+    from test_ssh_transport import _install_fake_ssh
+
+    repo, ds_path, url = primary
+    replica, node, server, rurl = make_replica(tmp_path, url)
+    try:
+        loser, loser_oid = _conflicting_loser(repo, ds_path, url, tmp_path)
+        base = loser.refs.get("refs/remotes/origin/main")
+        with pytest.raises(HttpTransportError) as direct:
+            raw_push(url, loser, loser_oid, old_oid=base)
+        with pytest.raises(HttpTransportError) as proxied:
+            raw_push(rurl, loser, loser_oid, old_oid=base)
+        assert direct.value.terminal and proxied.value.terminal
+        assert json.dumps(direct.value.conflict_report, sort_keys=True) == \
+            json.dumps(proxied.value.conflict_report, sort_keys=True)
+        assert str(direct.value).replace(url, "") == \
+            str(proxied.value).replace(rurl, "")
+        # the stdio transport's direct push reports the identical document
+        _install_fake_ssh(tmp_path, monkeypatch)
+        ssh_client = StdioRemote(f"testhost:{repo.workdir or repo.gitdir}")
+        try:
+            with pytest.raises(StdioTransportError) as ssh_direct:
+                raw_push(None, loser, loser_oid, old_oid=base,
+                         client=ssh_client)
+        finally:
+            ssh_client.close()
+        assert ssh_direct.value.terminal
+        assert json.dumps(
+            ssh_direct.value.conflict_report, sort_keys=True
+        ) == json.dumps(proxied.value.conflict_report, sort_keys=True)
+    finally:
+        server.shutdown()
+        server.server_close()
+
+
+def test_proxied_push_rebase_payload_identical(primary, tmp_path):
+    """A clean contended push auto-rebases on the primary; the proxied
+    response carries the identical rebase payload."""
+    repo, ds_path, url = primary
+    replica, node, server, rurl = make_replica(tmp_path, url)
+    try:
+        winner = transport.clone(
+            url, str(tmp_path / "w2"), do_checkout=False
+        )
+        winner.config.set_many(
+            {"user.name": "w", "user.email": "w@example.com"}
+        )
+        loser = transport.clone(rurl, str(tmp_path / "l2"), do_checkout=False)
+        loser.config.set_many(
+            {"user.name": "l", "user.email": "l@example.com"}
+        )
+        edit_commit(
+            winner, ds_path,
+            updates=[{"fid": 1, "geom": None, "name": "w", "rating": 1.0}],
+            message="winner",
+        )
+        loser_oid = edit_commit(
+            loser, ds_path,
+            updates=[{"fid": 12, "geom": None, "name": "l", "rating": 2.0}],
+            message="loser disjoint",
+        )
+        transport.push(winner, "origin")
+        base = loser.refs.get("refs/remotes/origin/main")
+        result = raw_push(rurl, loser, loser_oid, old_oid=base)
+        assert result["rebase"]["rebased"] == 1
+        assert result["rebase"]["mode"] == "merge"
+        landed = result["updated"]["refs/heads/main"]
+        assert repo.refs.get("refs/heads/main") == landed
+    finally:
+        server.shutdown()
+        server.server_close()
+
+
+def test_proxied_push_carries_one_trace_end_to_end(
+    primary, tmp_path, monkeypatch
+):
+    """The traceparent survives the hop: the client's trace id appears on
+    BOTH the replica's and the primary's access records for one proxied
+    push — the PR 11 cross-process join holds through the relay."""
+    from kart_tpu.telemetry import context as rq_context
+
+    log_path = str(tmp_path / "access.jsonl")
+    monkeypatch.setenv("KART_ACCESS_LOG", log_path)
+    repo, ds_path, url = primary
+    replica, node, server, rurl = make_replica(tmp_path, url)
+    try:
+        clone = transport.clone(rurl, str(tmp_path / "c"), do_checkout=False)
+        clone.config.set_many(
+            {"user.name": "w", "user.email": "w@example.com"}
+        )
+        new_oid = edit_commit(
+            clone, ds_path,
+            updates=[{"fid": 2, "geom": None, "name": "t", "rating": 3.0}],
+            message="traced",
+        )
+        with rq_context.request_scope(verb="push") as ctx:
+            old = HttpRemote(rurl).ls_refs()["heads"]["main"]
+            raw_push(rurl, clone, new_oid, old_oid=old)
+            trace_id = ctx.trace_id
+        with open(log_path) as f:
+            records = [json.loads(line) for line in f if line.strip()]
+        receives = [
+            r for r in records
+            if r["verb"] == "receive-pack" and r.get("trace_id") == trace_id
+        ]
+        # one logical push, two servers touched (replica relay + primary
+        # landing), one trace joining them
+        assert len(receives) == 2
+    finally:
+        server.shutdown()
+        server.server_close()
+
+
+# ---------------------------------------------------------------------------
+# the peer cache tier
+# ---------------------------------------------------------------------------
+
+
+def test_tile_peer_fill_byte_identical(primary, tmp_path):
+    repo, ds_path, url = primary
+    replica, node, server, rurl = make_replica(
+        tmp_path, url, peers=(url,)
+    )
+    try:
+        tile_path = f"/api/v1/tiles/main/{quote(ds_path, safe='')}/0/0/0"
+        direct = urllib.request.urlopen(url + tile_path, timeout=10)
+        direct_body = direct.read()
+        fetches0 = counter("fleet.peer_cache.fetches")
+        via = urllib.request.urlopen(rurl + tile_path, timeout=10)
+        via_body = via.read()
+        assert via_body == direct_body
+        assert via.headers["ETag"] == direct.headers["ETag"]
+        # the replica fetched from its peer instead of encoding locally
+        assert counter("fleet.peer_cache.fetches") == fetches0 + 1
+        # second request: a peer-cache memo hit, no second peer round-trip
+        hits0 = counter("fleet.peer_cache.hits")
+        again = urllib.request.urlopen(rurl + tile_path, timeout=10).read()
+        assert again == direct_body
+        assert counter("fleet.peer_cache.hits") == hits0 + 1
+        assert counter("fleet.peer_cache.fetches") == fetches0 + 1
+    finally:
+        server.shutdown()
+        server.server_close()
+
+
+def test_fetch_pack_peer_fill_serves_complete_clone(primary, tmp_path):
+    repo, ds_path, url = primary
+    replica, node, server, rurl = make_replica(
+        tmp_path, url, peers=(url,)
+    )
+    try:
+        client = HttpRemote(rurl)
+        wants = list(client.ls_refs()["heads"].values())
+        dst = KartRepo.init_repository(str(tmp_path / "c"))
+        fetches0 = counter("fleet.peer_cache.fetches")
+        header = client.fetch_pack(dst, wants)
+        assert counter("fleet.peer_cache.fetches") == fetches0 + 1
+        # every object landed — the peer-relayed framed response is whole
+        assert header["object_count"] == sum(1 for _ in dst.odb.iter_oids())
+        assert odb_digest(dst) == odb_digest(replica)
+    finally:
+        server.shutdown()
+        server.server_close()
+
+
+def test_peer_failure_falls_back_to_local_compute(primary, tmp_path):
+    """A dead peer costs one failed probe, then local compute answers —
+    the peer tier is an optimisation, never a dependency."""
+    repo, ds_path, url = primary
+    replica, node, server, rurl = make_replica(
+        tmp_path, url, peers=("http://127.0.0.1:9",)
+    )
+    try:
+        tile_path = f"/api/v1/tiles/main/{quote(ds_path, safe='')}/0/0/0"
+        failures0 = counter("fleet.peer_cache.fetch_failures")
+        body = urllib.request.urlopen(rurl + tile_path, timeout=30).read()
+        assert body == urllib.request.urlopen(url + tile_path).read()
+        assert counter("fleet.peer_cache.fetch_failures") == failures0 + 1
+    finally:
+        server.shutdown()
+        server.server_close()
+
+
+def test_ryw_pinned_fetch_relays_post_verbs(primary, tmp_path, monkeypatch):
+    """Regression: the pin must ride the POST data-fetch verbs too, and a
+    pinned fetch-pack past the lag bound must be relayed body-and-all —
+    an ungated (or GET-relayed) fetch from the stale store would miss
+    exactly the objects the pin guarantees."""
+    monkeypatch.setenv("KART_REPLICA_MAX_LAG", "0.2")
+    repo, ds_path, url = primary
+    # sync thread deliberately NOT started: the replica stays stale
+    replica, node, server, rurl = make_replica(tmp_path, url)
+    try:
+        clone = transport.clone(rurl, str(tmp_path / "c"), do_checkout=False)
+        clone.config.set_many(
+            {"user.name": "w", "user.email": "w@example.com"}
+        )
+        new_oid = edit_commit(
+            clone, ds_path,
+            updates=[{"fid": 8, "geom": None, "name": "pf", "rating": 4.0}],
+            message="pinned fetch",
+        )
+        client = HttpRemote(rurl)
+        old = client.ls_refs()["heads"]["main"]
+        raw_push(rurl, clone, new_oid, old_oid=old, client=client)
+        # the same pinned client clones from scratch: ls-refs AND
+        # fetch-pack both answer from the primary, so the new commit and
+        # its whole closure arrive despite the stale replica
+        dst = KartRepo.init_repository(str(tmp_path / "fresh"))
+        wants = list(client.ls_refs()["heads"].values())
+        assert new_oid in wants
+        client.fetch_pack(dst, wants)
+        assert dst.odb.contains(new_oid)
+        assert not replica.odb.contains(new_oid)  # the pin, not the sync
+    finally:
+        server.shutdown()
+        server.server_close()
+
+
+def test_mutually_peered_replicas_do_not_recurse(primary, tmp_path):
+    """Regression: replicas listing each other as peers must not loop — a
+    fill request carries X-Kart-Peer-Fill and is answered from local
+    state, so a cold tile costs one hop, not a recursion that wedges
+    behind the asker's own single-flight token until the fetch timeout."""
+    repo, ds_path, url = primary
+    ra_repo, ra_node, ra_server, ra_url = make_replica(
+        tmp_path, url, name="ra"
+    )
+    try:
+        rb_repo, rb_node, rb_server, rb_url = make_replica(
+            tmp_path, url, name="rb", peers=(ra_url,)
+        )
+        try:
+            ra_node.peers = (rb_url,)  # now they peer each other
+            tile_path = f"/api/v1/tiles/main/{quote(ds_path, safe='')}/0/0/0"
+            direct = urllib.request.urlopen(url + tile_path, timeout=10).read()
+            t0 = time.monotonic()
+            via_a = urllib.request.urlopen(
+                ra_url + tile_path, timeout=30
+            ).read()
+            elapsed = time.monotonic() - t0
+            assert via_a == direct
+            # well under PEER_FETCH_TIMEOUT: B answered A's fill locally
+            # instead of recursing back into A
+            assert elapsed < peercache.PEER_FETCH_TIMEOUT / 2, elapsed
+        finally:
+            rb_server.shutdown()
+            rb_server.server_close()
+    finally:
+        ra_server.shutdown()
+        ra_server.server_close()
+
+
+def test_pin_ignores_non_head_refs():
+    """Regression: only refs/heads/* oids may pin — a tag oid can never
+    satisfy the replica's branch-tip containment and would stall every
+    later read for the full lag bound."""
+    from kart_tpu.fleet import router
+
+    doc = {
+        "updated": {
+            "refs/tags/v1": "a" * 40,
+            "refs/heads/main": "b" * 40,
+            "refs/heads/gone": None,
+        }
+    }
+    assert router.landed_head_oids(doc) == ["b" * 40]
+    assert router.landed_head_oids({"updated": {"refs/tags/v1": "a" * 40}}) == []
+    assert router.landed_head_oids({}) == []
+    assert router.landed_head_oids(None) == []
+
+
+# ---------------------------------------------------------------------------
+# configuration + operator surfaces
+# ---------------------------------------------------------------------------
+
+
+def test_node_from_env(primary, tmp_path, monkeypatch):
+    repo, _ds, url = primary
+    r = KartRepo.init_repository(str(tmp_path / "r"))
+    assert fleet_mod.node_from_env(r) is None
+    monkeypatch.setenv("KART_REPLICA_OF", url)
+    monkeypatch.setenv("KART_PEER_CACHE", "primary")
+    node = fleet_mod.node_from_env(r)
+    assert node.is_replica and node.primary_url == url
+    assert node.peers == (url,)
+    monkeypatch.setenv("KART_PEER_CACHE", "0")
+    assert fleet_mod.node_from_env(r).peers == ()
+    monkeypatch.delenv("KART_REPLICA_OF")
+    monkeypatch.setenv(
+        "KART_PEER_CACHE", f"{url}/, {url}"
+    )
+    peers_only = fleet_mod.node_from_env(r)
+    assert not peers_only.is_replica
+    assert peers_only.peers == (url,)  # normalised + de-duplicated
+
+
+def test_stats_payload_and_fleet_status_cli(primary, tmp_path):
+    from click.testing import CliRunner
+
+    from kart_tpu.cli import cli
+    from kart_tpu.cli.fleet_cmds import member_status
+
+    repo, ds_path, url = primary
+    replica, node, server, rurl = make_replica(tmp_path, url)
+    try:
+        doc = json.loads(
+            urllib.request.urlopen(
+                f"{rurl}/api/v1/stats?format=json", timeout=10
+            ).read()
+        )
+        fleet_block = doc["fleet"]
+        assert fleet_block["role"] == "replica"
+        assert fleet_block["primary"] == url
+        assert fleet_block["sync_cycles"] >= 1
+        assert fleet_block["lag_seconds"] is not None
+        status = member_status(doc)
+        assert status["role"] == "replica"
+
+        r = CliRunner().invoke(
+            cli, ["fleet", "status", url, rurl], catch_exceptions=False
+        )
+        assert r.exit_code == 0, r.output
+        assert "replica" in r.output and "primary" in r.output
+        r = CliRunner().invoke(
+            cli, ["fleet", "status", "-o", "json", rurl],
+            catch_exceptions=False,
+        )
+        assert r.exit_code == 0, r.output
+        parsed = json.loads(r.output)
+        assert parsed[rurl]["role"] == "replica"
+
+        # kart top renders the replication-lag line
+        r = CliRunner().invoke(
+            cli, ["top", "--once", rurl], catch_exceptions=False
+        )
+        assert r.exit_code == 0, r.output
+        assert "replica of" in r.output and "lag" in r.output
+    finally:
+        server.shutdown()
+        server.server_close()
