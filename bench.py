@@ -3371,6 +3371,385 @@ def fleet_main():
         shutil.rmtree(os.path.join(td, "clones"), ignore_errors=True)
 
 
+# ---------------------------------------------------------------------------
+# bench.py --live: K watchers × continuous pushes (ISSUE 14, docs/EVENTS.md §8)
+# ---------------------------------------------------------------------------
+
+
+def live_watch_worker():
+    """One live-update watcher: subscribe to the primary's event feed and
+    long-poll until ``n_events`` distinct events arrived (or the
+    deadline). argv after the flag: ``url n_events``. Protocol as the
+    other storm workers: ready / go / one JSON result line — the result
+    maps each received sequence to its receive wall-clock, which the
+    parent joins against its push-ack clocks for the invalidation fan-out
+    latency."""
+    import sys
+    from urllib.request import urlopen
+
+    i = sys.argv.index("--live-watch-worker")
+    url, n_events = sys.argv[i + 1], int(sys.argv[i + 2])
+
+    # the subscribe handshake (also creates the server-side emitter
+    # before any push lands)
+    with urlopen(f"{url}api/v1/events", timeout=60) as resp:
+        since = json.loads(resp.read().decode())["head"]
+
+    print(json.dumps({"ready": True}), flush=True)
+    sys.stdin.readline()
+
+    received = {}  # seq -> {"t": wall clock, "new": oid}
+    deadline = time.time() + 300
+    errors = []
+    while len(received) < n_events and time.time() < deadline:
+        try:
+            with urlopen(
+                f"{url}api/v1/events?since={since}&timeout=20", timeout=60
+            ) as resp:
+                doc = json.loads(resp.read().decode())
+        except OSError as e:
+            errors.append(str(e))
+            time.sleep(0.2)
+            continue
+        now = time.time()
+        for event in doc.get("events", ()):
+            received.setdefault(
+                int(event["seq"]), {"t": now, "new": event.get("new")}
+            )
+        since = max(since, int(doc.get("head", since)))
+    print(
+        json.dumps(
+            {
+                "ok": len(received) >= n_events,
+                "received": {str(k): v for k, v in received.items()},
+                "errors": errors[:5],
+            }
+        ),
+        flush=True,
+    )
+
+
+def _live_event_exact(repo, event, margin=1):
+    """Re-prove one event's dirty-tile exactness at bench scale: encode
+    every candidate ``bin``-layer tile (the event bbox range ± margin,
+    per zoom) at both commits and compare content — the computed set must
+    equal the differing set, both directions. -> bool (None when the
+    event carries no enumerated tiles to verify)."""
+    import sys
+
+    from kart_tpu import tiles
+    from kart_tpu.tiles.encode import encode_tile, parse_payload
+    from kart_tpu.tiles.grid import tile_range_for_bbox
+
+    def content(oid, ds_path, z, x, y):
+        source = tiles.source_for(repo, oid, ds_path)
+        payload, _stats = encode_tile(
+            source, z, x, y, layers=("bin",), max_features=0
+        )
+        header, layers = parse_payload(payload)
+        header.pop("commit")
+        return header, layers
+
+    old_oid, new_oid = event.get("old"), event.get("new")
+    dirty = event.get("dirty") or {}
+    if not old_oid or not new_oid or not dirty:
+        return None
+    for ds_path, entry in dirty.items():
+        if entry.get("tiles") is None or entry.get("bbox") is None:
+            return None  # truncated / non-spatial: nothing exact to check
+        for z in entry["zooms"]:
+            n = 1 << z
+            x0, y0, x1, y1 = tile_range_for_bbox(z, entry["bbox"])
+            x0, y0 = max(0, x0 - margin), max(0, y0 - margin)
+            x1, y1 = min(n - 1, x1 + margin), min(n - 1, y1 + margin)
+            want = set()
+            for x in range(x0, x1 + 1):
+                for y in range(y0, y1 + 1):
+                    if content(old_oid, ds_path, z, x, y) != content(
+                        new_oid, ds_path, z, x, y
+                    ):
+                        want.add((x, y))
+            got = {tuple(t) for t in entry["tiles"].get(str(z), [])}
+            if got != want:
+                print(
+                    f"dirty-tile mismatch {ds_path} z{z}: cdc {sorted(got)}"
+                    f" vs re-encode {sorted(want)}",
+                    file=sys.stderr,
+                )
+                return False
+    return True
+
+
+def live_main():
+    """`bench.py --live` (docs/EVENTS.md §8): K watchers hold long-polls
+    against a serving primary while a pusher lands a stream of edit
+    commits and one *subscribed* replica (poll interval cranked to 30s so
+    the event stream, not the poll, drives it) syncs alongside. Legs:
+    (1) invalidation fan-out latency push-ack → watcher-delivery, p99
+    across K × pushes; (2) dirty-tile exactness re-proven per event vs a
+    full re-encode; (3) post-announce requests for dirty tiles hit the
+    pre-warmed cache; (4) the subscribed replica's replication lag p99 vs
+    the polled BENCH_r13 number."""
+    import shutil
+    import subprocess
+    import sys
+    import tempfile
+    from urllib.request import urlopen
+
+    # r13's fleet scale, so the replica-lag comparison against its polled
+    # number is apples-to-apples (same rows ⇒ same per-cycle sync cost;
+    # the delta under test is event-kick vs poll-period)
+    rows = int(os.environ.get("KART_BENCH_LIVE_ROWS", 100_000))
+    n_watchers = int(os.environ.get("KART_BENCH_LIVE_WATCHERS", 6))
+    n_pushes = int(os.environ.get("KART_BENCH_LIVE_PUSHES", 12))
+    exact_events = int(os.environ.get("KART_BENCH_LIVE_EXACT_EVENTS", 4))
+
+    from kart_tpu import transport
+    from kart_tpu.core.repo import KartRepo
+    from kart_tpu.synth import commit_feature_edits, synth_repo
+
+    shm = "/dev/shm" if os.path.isdir("/dev/shm") else None
+    with tempfile.TemporaryDirectory(dir=shm) as td:
+        t0 = time.perf_counter()
+        src, info = synth_repo(
+            os.path.join(td, "primary"), rows, spatial=True,
+            blobs="changed", edit_frac=0.01,
+        )
+        synth_s = time.perf_counter() - t0
+        src.config["receive.denyCurrentBranch"] = "ignore"
+        workdir = src.workdir or src.gitdir
+
+        record = {
+            "metric": "live",
+            "live_rows": rows,
+            "live_watchers": n_watchers,
+            "live_pushes": n_pushes,
+            "live_synth_seconds": round(synth_s, 2),
+            "ok": True,
+        }
+
+        serve_env = {"KART_TILE_MAX_FEATURES": "0"}
+        primary_port = _free_port()
+        primary_url = f"http://127.0.0.1:{primary_port}/"
+        primary = _spawn_serve(workdir, primary_port, serve_env)
+        replica_dir = os.path.join(td, "replica")
+        KartRepo.init_repository(replica_dir)
+        replica_port = _free_port()
+        replica_url = f"http://127.0.0.1:{replica_port}/"
+        replica = _spawn_serve(
+            replica_dir, replica_port,
+            {
+                **serve_env,
+                "KART_REPLICA_OF": primary_url,
+                # the poll must NOT be the thing that syncs: the event
+                # subscription is under test
+                "KART_REPLICA_POLL_SECONDS": "30",
+            },
+        )
+        try:
+            want = _fleet_refs(primary_url)["heads"]
+            deadline = time.monotonic() + 180
+            while _fleet_refs(replica_url)["heads"] != want:
+                if time.monotonic() > deadline:
+                    raise RuntimeError("replica never caught up initially")
+                time.sleep(0.1)
+
+            # -- watchers: subscribe, then go
+            procs = []
+            for i in range(n_watchers):
+                p = subprocess.Popen(
+                    [
+                        sys.executable, os.path.abspath(__file__),
+                        "--live-watch-worker", primary_url, str(n_pushes),
+                    ],
+                    env=_storm_env(),
+                    stdin=subprocess.PIPE,
+                    stdout=subprocess.PIPE,
+                    stderr=subprocess.PIPE,
+                    text=True,
+                )
+                procs.append(p)
+            go = _storm_go_barrier(procs)
+            if go is None:
+                raise RuntimeError("a watcher died before go")
+
+            # -- the pusher: continuous single-commit pushes (deletes of
+            # -- real-blob edit rows, synth_repo's deletable set)
+            pusher = transport.clone(
+                primary_url, os.path.join(td, "pusher"), do_checkout=False
+            )
+            pusher.config.set_many(
+                {"user.name": "bench", "user.email": "bench@live"}
+            )
+            rng = np.random.default_rng(1)
+            edit_rows = rng.choice(rows, size=info["n_edits"], replace=False)
+            pks = sorted((1 << 24) + int(r) for r in edit_rows)
+            assert len(pks) >= n_pushes
+
+            acks = {}  # commit oid -> push-ack wall clock
+            replica_lag = []
+            head_seen = 0
+            warm_requests = warm_hits = cold_encodes = 0
+            for k in range(n_pushes):
+                oid = commit_feature_edits(
+                    pusher, "synth", deletes=[pks[k]],
+                    message=f"live push {k}",
+                )
+                transport.push(pusher, "origin")
+                acks[oid] = t_ack = time.time()
+                # replica leg: event-kicked sync, 30s poll never fires
+                mono0 = time.monotonic()
+                while _fleet_refs(replica_url)["heads"].get("main") != oid:
+                    if time.monotonic() - mono0 > 25:
+                        record["ok"] = False
+                        print(
+                            f"replica missed push {k} inside 25s",
+                            file=sys.stderr,
+                        )
+                        break
+                    time.sleep(0.01)
+                else:
+                    replica_lag.append(time.time() - t_ack)
+                # warm leg, the viewer protocol: on receipt of each
+                # invalidation, re-fetch exactly its dirty tiles — they
+                # must come from the pre-warmed cache (warm-then-announce
+                # means the event's visibility implies its tiles are in;
+                # stats deltas bracket the batch so only THESE requests
+                # are counted)
+                doc = json.loads(
+                    urlopen(
+                        f"{primary_url}api/v1/events"
+                        f"?since={head_seen}&timeout=10",
+                        timeout=30,
+                    ).read().decode()
+                )
+                head_seen = max(head_seen, int(doc.get("head", head_seen)))
+                pre = _fleet_stats_json(primary_url)
+                batch = 0
+                for event in doc.get("events", ()):
+                    for ds_path, entry in (event.get("dirty") or {}).items():
+                        for z_str, addrs in (entry.get("tiles") or {}).items():
+                            for x, y in addrs:
+                                with urlopen(
+                                    f"{primary_url}api/v1/tiles/"
+                                    f"{event['new']}/{ds_path}/"
+                                    f"{z_str}/{x}/{y}?layers=bin",
+                                    timeout=60,
+                                ) as resp:
+                                    resp.read()
+                                batch += 1
+                post = _fleet_stats_json(primary_url)
+                warm_requests += batch
+                warm_hits += _fleet_counter(
+                    post, "tiles.cache.hits"
+                ) - _fleet_counter(pre, "tiles.cache.hits")
+                cold_encodes += _fleet_counter(
+                    post, "tiles.cache.misses"
+                ) - _fleet_counter(pre, "tiles.cache.misses")
+
+            results = _collect_workers(procs)
+            good = [r for r in results if r and r.get("ok")]
+            record["live_watchers_served"] = len(good)
+            record["ok"] = record["ok"] and len(good) == n_watchers
+
+            # -- leg 1: invalidation fan-out latency (push-ack -> watcher)
+            events_doc = json.loads(
+                urlopen(
+                    f"{primary_url}api/v1/events?since=0&timeout=0",
+                    timeout=30,
+                ).read().decode()
+            )
+            events = events_doc.get("events", [])
+            record["live_events_total"] = events_doc.get("head", 0)
+            fanout = []
+            for r in good:
+                for _seq, hit in r["received"].items():
+                    t_ack = acks.get(hit.get("new"))
+                    if t_ack is not None:
+                        fanout.append(max(0.0, hit["t"] - t_ack))
+            fanout.sort()
+            if fanout:
+                record["live_invalidation_p99_seconds"] = round(
+                    fanout[min(len(fanout) - 1, int(0.99 * len(fanout)))], 4
+                )
+                record["live_invalidation_mean_seconds"] = round(
+                    sum(fanout) / len(fanout), 4
+                )
+            else:
+                record["ok"] = False
+                record["live_invalidation_p99_seconds"] = 0
+                record["live_invalidation_mean_seconds"] = 0
+            print(json.dumps(record), flush=True)
+
+            # -- leg 2: warm hit rate (accumulated per push above — the
+            # warmer's own fills are misses by definition and happened
+            # before each event's announcement, outside the brackets)
+            record["live_warm_requests"] = warm_requests
+            record["live_warm_hit_rate"] = round(
+                warm_hits / max(1, warm_requests), 4
+            )
+            record["live_warm_cold_encodes"] = cold_encodes
+            print(json.dumps(record), flush=True)
+
+            # -- leg 3: dirty-tile exactness vs a full re-encode, on the
+            # primary's own store (sampled events; every zoom)
+            bench_repo = KartRepo(workdir)
+            verdicts = [
+                _live_event_exact(bench_repo, event)
+                for event in events[:exact_events]
+            ]
+            checked = [v for v in verdicts if v is not None]
+            record["live_dirty_tiles_exact_events"] = len(checked)
+            record["live_dirty_tiles_exact"] = bool(checked) and all(checked)
+            record["ok"] = record["ok"] and record["live_dirty_tiles_exact"]
+            print(json.dumps(record), flush=True)
+
+            # -- leg 4: subscribed-replica lag vs the polled BENCH_r13
+            replica_lag.sort()
+            if replica_lag:
+                record["live_replica_lag_p99_seconds"] = round(
+                    replica_lag[
+                        min(len(replica_lag) - 1,
+                            int(0.99 * len(replica_lag)))
+                    ],
+                    4,
+                )
+                record["live_replica_lag_mean_seconds"] = round(
+                    sum(replica_lag) / len(replica_lag), 4
+                )
+            else:
+                record["ok"] = False
+                record["live_replica_lag_p99_seconds"] = 0
+                record["live_replica_lag_mean_seconds"] = 0
+            r13 = os.path.join(
+                os.path.dirname(os.path.abspath(__file__)), "BENCH_r13.json"
+            )
+            polled = None
+            if os.path.exists(r13):
+                with open(r13) as f:
+                    polled = json.load(f).get("parsed", {}).get(
+                        "fleet_replication_lag_p99_seconds"
+                    )
+            if polled:
+                record["live_replica_lag_vs_polled_p99"] = round(
+                    record["live_replica_lag_p99_seconds"] / polled, 3
+                )
+                record["live_replica_lag_beats_polled"] = (
+                    0
+                    < record["live_replica_lag_p99_seconds"]
+                    < polled
+                )
+            print(json.dumps(record), flush=True)
+        finally:
+            for p in (primary, replica):
+                try:
+                    p.kill()
+                    p.wait()
+                except OSError:
+                    pass
+        shutil.rmtree(os.path.join(td, "pusher"), ignore_errors=True)
+
+
 if __name__ == "__main__":
     import sys
 
@@ -3378,6 +3757,10 @@ if __name__ == "__main__":
         tiles_storm_worker()
     elif "--tiles" in sys.argv:
         tiles_main()
+    elif "--live-watch-worker" in sys.argv:
+        live_watch_worker()
+    elif "--live" in sys.argv:
+        live_main()
     elif "--fleet-tile-worker" in sys.argv:
         fleet_tile_worker()
     elif "--fleet" in sys.argv:

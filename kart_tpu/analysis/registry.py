@@ -52,6 +52,11 @@ ENV_VARS = {
     "KART_REPLICA_POLL_SECONDS": "source",
     "KART_REPLICA_MAX_LAG": "source",
     "KART_PEER_CACHE": "source",
+    # live-update events (docs/EVENTS.md)
+    "KART_SERVE_EVENTS": "source",
+    "KART_EVENTS_LOG_SIZE": "source",
+    "KART_EVENTS_WARM_BUDGET": "source",
+    "KART_WATCH_TIMEOUT": "source",
     # faults / maintenance (ROBUSTNESS.md §5-§6)
     "KART_FAULTS": "source",
     "KART_GC_GRACE": "source",
@@ -138,6 +143,8 @@ FAULT_POINTS = frozenset(
         "tiles.cache",
         "fleet.sync",
         "fleet.proxy",
+        "events.emit",
+        "events.warm",
     }
 )
 
@@ -256,6 +263,13 @@ CACHES = {
 #: must be invoked inside this function's body.
 REF_UPDATE_HOOK = ("kart_tpu/transport/service.py", "_apply_validated_updates")
 
+#: the live-update emission hook (docs/EVENTS.md §3): the same ref-update
+#: funnel must call this function so a landed push books its CDC event —
+#: KTL014 checks the call the same way it checks the cache drop hooks (a
+#: push that silently skipped emission would strand every subscriber on
+#: its poll fallback).
+EVENT_EMIT_HOOK = "notify_ref_updates"
+
 #: LRU-shaped module globals (OrderedDict + popitem eviction) that are NOT
 #: commit-addressed data caches and therefore owe no invalidation drop:
 #: "rel::NAME" -> rationale. A stale entry is a finding.
@@ -263,6 +277,12 @@ CACHE_EXEMPT_GLOBALS = {
     "kart_tpu/transport/service.py::_MERGE_QUEUES": (
         "a registry of per-ref FIFO queues, not cached data: correctness "
         "lives with push_file_lock; eviction only unlinks idle queues"
+    ),
+    "kart_tpu/events/__init__.py::_EMITTERS": (
+        "a registry of per-repo event emitters, not cached data: the "
+        "announced history and tips live in the on-disk event log, and a "
+        "re-created emitter reconciles from it byte-for-byte; eviction "
+        "only parks an idle worker (docs/EVENTS.md §3)"
     ),
 }
 

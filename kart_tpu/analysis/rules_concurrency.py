@@ -987,6 +987,31 @@ class CacheInvalidationCoverage(Rule):
                     "update registry.REF_UPDATE_HOOK if it moved",
                 )
             )
+        if hook_fn is not None:
+            # the live-update emission hook rides the same funnel as the
+            # cache drops (registry.EVENT_EMIT_HOOK): a ref update that
+            # skipped booking would strand subscribers on poll fallback
+            emit_hook = getattr(registry, "EVENT_EMIT_HOOK", None)
+            if emit_hook:
+                called = any(
+                    isinstance(n, ast.Call)
+                    and (dotted_name(n.func) or "").rsplit(".", 1)[-1]
+                    == emit_hook
+                    for n in ast.walk(hook_fn.node)
+                )
+                if not called:
+                    findings.append(
+                        Finding(
+                            self.id,
+                            hook_fn.rel,
+                            hook_fn.node.lineno,
+                            0,
+                            f"event emission hook {emit_hook!r} is never "
+                            f"called from {registry.REF_UPDATE_HOOK[1]} — "
+                            "a landed push would announce nothing "
+                            "(docs/EVENTS.md §3)",
+                        )
+                    )
         for cache_name, entry in sorted(registry.CACHES.items()):
             findings.extend(
                 self._check_entry(model, reg_rel, cache_name, entry, hook_fn)

@@ -56,6 +56,7 @@ _COMMANDS = {
     "build-annotations": "kart_tpu.cli.data_cmds",
     "stats": "kart_tpu.cli.stats_cmds",
     "top": "kart_tpu.cli.top_cmds",
+    "watch": "kart_tpu.cli.watch_cmds",
     "fleet": "kart_tpu.cli.fleet_cmds",
     "lint": "kart_tpu.cli.lint_cmds",
     "export": "kart_tpu.cli.tile_cmds",

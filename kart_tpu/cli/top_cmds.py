@@ -113,6 +113,22 @@ def render_top(payload, url):
             f"  ryw stalls/pins {fleet.get('ryw_stalls', 0)}"
             f"/{fleet.get('ryw_pins', 0)}{peer}"
         )
+    events = payload.get("events")
+    if events:
+        # the live-update path at a glance (docs/EVENTS.md §7): who is
+        # listening, how far the log has advanced, how fast the last
+        # announcement fanned out, and whether the warmer is keeping up
+        fanout = events.get("last_fanout_seconds")
+        warm = events.get("last_warm") or {}
+        lines.append(
+            f"events  watchers {events.get('watchers', 0)}"
+            f"  head seq {events.get('head_seq', 0)}"
+            f"  warm queue {events.get('queue_depth', 0)}"
+            f"  last fanout "
+            f"{f'{fanout * 1000:.0f}ms' if fanout is not None else '-'}"
+            f"  last warm {warm.get('tiles', 0)} tiles"
+            f"/{warm.get('errors', 0)} err"
+        )
     lines.append("")
     rate_heads = "".join(f"  req/s({w})" for w in windows)
     lines.append(

@@ -1,0 +1,114 @@
+"""``kart watch`` — stream a server's live-update events as JSON lines
+(docs/EVENTS.md §5).
+
+Subscribes to the target's event feed (``GET /api/v1/events`` long-poll
+over HTTP; the ``events`` op over ssh) and prints one JSON line per
+announced ref transition: sequence number, ref, old/new tips, and the
+exact per-dataset dirty-tile summary the CDC computed — everything a map
+viewer needs to invalidate precisely and re-fetch only what changed.
+Resume is by sequence: ``--since`` replays from a known position, and a
+dropped connection reconnects where it left off.
+"""
+
+import json as _json
+import sys
+import time
+
+import click
+
+from kart_tpu.cli import CliError, cli
+from kart_tpu.cli.stats_cmds import _resolve_target
+
+
+def _emit(event, dataset):
+    if dataset is not None:
+        dirty = event.get("dirty")
+        if isinstance(dirty, dict) and dataset not in dirty:
+            return False
+    click.echo(_json.dumps(event, sort_keys=True))
+    sys.stdout.flush()
+    return True
+
+
+@cli.command()
+@click.argument("target")
+@click.option("--dataset", default=None,
+              help="Only print events touching this dataset path.")
+@click.option("--since", type=int, default=None,
+              help="Replay from this event sequence number "
+                   "(default: transitions from now on).")
+@click.option("-n", "--count", type=int, default=0,
+              help="Exit after printing this many events (0 = forever).")
+@click.option("--timeout", type=float, default=None,
+              help="Exit 0 after this many seconds without an event "
+                   "(default $KART_WATCH_TIMEOUT; 0 = watch forever).")
+@click.pass_obj
+def watch(ctx, target, dataset, since, count, timeout):
+    """Stream live-update events from a server as JSON lines.
+
+    TARGET is an http(s):// or ssh:// URL, or a configured remote name.
+    Each line is one announced ref transition with its exact dirty-tile
+    summary (docs/EVENTS.md): viewers invalidate those tiles, re-fetch
+    them commit-addressed, and are current — no re-polling every tile.
+    """
+    from kart_tpu.events.stream import (
+        EventStreamUnsupported,
+        iter_events,
+        watch_timeout,
+    )
+    from kart_tpu.transport.http import HttpTransportError
+    from kart_tpu.transport.remote import is_http_url
+    from kart_tpu.transport.stdio import StdioRemote, is_ssh_url
+
+    url = _resolve_target(ctx, target)
+    if timeout is None:
+        timeout = watch_timeout()
+    printed = 0
+    try:
+        if is_http_url(url):
+            stream = iter_events(
+                url, since=since, idle_timeout=timeout or None
+            )
+            for event in stream:
+                if _emit(event, dataset):
+                    printed += 1
+                if count and printed >= count:
+                    return
+        elif is_ssh_url(url):
+            # each ssh exchange is one bounded poll (the stdio server
+            # holds no long streams); resume state is the same sequence
+            remote = StdioRemote(url)
+            try:
+                if since is None:
+                    since = int(remote.events().get("head", 0))
+                idle_since = time.monotonic()
+                while True:
+                    doc = remote.events(since, timeout=5.0)
+                    for event in doc.get("events", ()):
+                        if _emit(event, dataset):
+                            printed += 1
+                        idle_since = time.monotonic()
+                        if count and printed >= count:
+                            return
+                    since = max(since, int(doc.get("head", since)))
+                    if timeout and time.monotonic() - idle_since > timeout:
+                        return
+            finally:
+                remote.close()
+        else:
+            raise CliError(
+                f"Cannot watch {url!r}: expected an http(s):// or ssh:// "
+                f"URL (or a configured remote name)"
+            )
+    except EventStreamUnsupported as e:
+        raise CliError(
+            f"{e} — the server predates live-update events or runs with "
+            f"KART_SERVE_EVENTS=0"
+        )
+    except OSError as e:
+        raise CliError(f"Event stream lost: {e}")
+    except HttpTransportError as e:
+        # the stdio path's error frames (incl. a KART_SERVE_EVENTS=0
+        # server answering the events op with an error) arrive as
+        # transport errors, not HTTP statuses — same friendly exit
+        raise CliError(f"Event stream failed: {e}")

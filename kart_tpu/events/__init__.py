@@ -1,0 +1,472 @@
+"""Live-update events: changed-block CDC from push to subscriber
+(ISSUE 14; docs/EVENTS.md).
+
+The push path (PR 8) lands writes and the tile/fleet paths (PR 9/12)
+serve reads; this package connects them. When a ref update lands, the
+per-repo :class:`EventEmitter`:
+
+1. **books** a sequence number for the transition inside the push's
+   critical section (cheap: one counter bump — the CDC never runs under
+   the push locks), and the push response carries it (``event_seq``) so a
+   read-your-writes client can wait on a *sequence* instead of a tip
+   containment walk;
+2. computes the **exact dirty-tile set** old-tip → new-tip from sidecar
+   columns alone (:mod:`kart_tpu.events.cdc` — no blob reads);
+3. **pre-warms** the commit-addressed tile cache for those tiles
+   (:mod:`kart_tpu.events.warm`) while the old tip keeps serving — tile
+   keys pin commits, so nothing is dropped and nothing goes stale;
+4. only then **announces**: appends the event to the persistent bounded
+   log (:mod:`kart_tpu.events.log`) and wakes every long-poll watcher
+   (``GET /api/v1/events?since=<seq>``, the stdio ``events`` op, and the
+   fleet's :class:`~kart_tpu.fleet.sync.ReplicaSync` subscription).
+
+Crash discipline mirrors the caches: booking state is in-memory only, the
+log append is the single announce frame, and a crash anywhere between CAS
+and announce leaves the tip un-announced — the reconcile pass (run at
+emitter construction and on every watcher poll slice) compares the
+announced tips against the actual refs and replays any missed emission,
+which also makes cross-process pushes (an ssh ``serve-stdio`` landing next
+to the HTTP server) visible to watchers within one poll slice.
+
+``KART_SERVE_EVENTS=0`` disables the whole subsystem; only serving
+processes ever construct an emitter (a plain ``kart push`` target books
+nothing and pays no import).
+"""
+
+import logging
+import os
+import threading
+import time
+from collections import OrderedDict, deque
+
+from kart_tpu import telemetry as tm
+from kart_tpu.events.cdc import dirty_tiles
+from kart_tpu.events.log import EventLog
+from kart_tpu.events.warm import warm_dirty_tiles
+
+L = logging.getLogger("kart_tpu.events")
+
+#: how long a long-poll events request waits for news before answering
+#: empty (the client immediately re-polls; bounded so shed-lane slots and
+#: dead sockets turn over)
+LONG_POLL_SECONDS = 25.0
+
+#: the wait loop's re-check slice: cross-process announcements and
+#: reconcile-detected pushes become visible within one slice
+POLL_SLICE_SECONDS = 1.0
+
+
+def events_enabled(environ=os.environ):
+    """Is the live-update subsystem on (``KART_SERVE_EVENTS``; default
+    yes, like tile serving)?"""
+    return environ.get("KART_SERVE_EVENTS", "1") not in ("0", "false")
+
+
+class EventEmitter:
+    """One served repo's live-update pipeline: booking → CDC → warm →
+    announce → fan-out, with a single background worker draining bookings
+    in FIFO order (announcements therefore happen in booking order)."""
+
+    def __init__(self, repo):
+        self.repo = repo
+        self.log = EventLog(repo.gitdir)
+        self._cond = threading.Condition()
+        self._queue = deque()
+        self._pending_refs = {}  # ref -> queued/in-flight booking count
+        self._booked_tips = self.log.tips()
+        self._next_seq = self.log.head() + 1
+        self._watchers = 0
+        self._last_fanout = None
+        self._last_warm = None
+        self._stopped = False
+        self._worker = None
+        if not self.log.head() and not self._booked_tips:
+            # first boot over a repo with history: adopt the current tips
+            # silently — subscribers care about transitions from now on,
+            # not a synthetic replay of every preexisting branch
+            current = self._current_tips()
+            if current:
+                self._booked_tips = dict(current)
+                self.log.adopt_tips(current)
+        else:
+            # restart: any tip that moved while no emitter was running
+            # (crash between CAS and announce, or a push landed by a
+            # process without an emitter) is a missed emission — replay it
+            self.reconcile()
+
+    # -- lifecycle -----------------------------------------------------------
+
+    def _ensure_worker_locked(self):
+        if self._worker is None or not self._worker.is_alive():
+            self._worker = threading.Thread(
+                target=self._run, name="kart-events-worker", daemon=True
+            )
+            self._worker.start()
+
+    def stop(self, timeout=5.0, *, drain=True):
+        """Stop the worker. ``drain=False`` additionally discards queued
+        bookings — the path for an emitter that lost the registry race or
+        was evicted: its pending replays belong to the surviving
+        instance, and announcing them here would duplicate sequences."""
+        with self._cond:
+            self._stopped = True
+            if not drain:
+                self._queue.clear()
+                self._pending_refs.clear()
+            worker = self._worker
+            self._cond.notify_all()
+        if worker is not None:
+            worker.join(timeout)
+
+    # -- booking (the push-side hook) ----------------------------------------
+
+    def _current_tips(self):
+        return dict(self.repo.refs.iter_refs("refs/"))
+
+    def book_many(self, changes):
+        """Book one event per ref transition; -> the highest booked
+        sequence (what the push response reports), or None for an empty
+        change list. Runs inside the push critical section, so it must
+        stay a counter bump + queue append — the CDC/warm/announce all
+        happen on the worker thread."""
+        last = None
+        with self._cond:
+            for ref, old, new in changes:
+                if old == new:
+                    continue
+                last = self._book_locked(ref, old, new)
+        return last
+
+    def _book_locked(self, ref, old, new, replay=False):
+        seq = self._next_seq
+        self._next_seq += 1
+        self._queue.append(
+            {
+                "seq": seq,
+                "ref": ref,
+                "old": old,
+                "new": new,
+                "cas_ts": time.time(),
+                "replay": replay,
+            }
+        )
+        if new:
+            self._booked_tips[ref] = new
+        else:
+            self._booked_tips.pop(ref, None)
+        self._pending_refs[ref] = self._pending_refs.get(ref, 0) + 1
+        tm.gauge_set("events.queue_depth", len(self._queue))
+        if not self._stopped:
+            self._ensure_worker_locked()
+        self._cond.notify_all()
+        return seq
+
+    def reconcile(self):
+        """Book transitions for every ref whose current value differs from
+        the booked tips — the missed-emission replay (server restart after
+        a crash, cross-process pushes). -> bookings made.
+
+        The on-disk log is re-read first and its announced state folded
+        into the booking state (refs without a pending booking adopt the
+        disk tips; the sequence counter jumps past the disk head), so a
+        second emitter on the same gitdir — an ssh ``serve-stdio`` events
+        op next to the HTTP server — converges on the other's
+        announcements instead of double-booking them with colliding
+        sequences. Truly simultaneous reconciles in two processes can
+        still both book (announcement is not cross-process atomic); the
+        flocked append keeps the log intact and a duplicated invalidation
+        is idempotent for every subscriber."""
+        self.log.refresh_from_disk()
+        booked = 0
+        with self._cond:
+            disk_head = self.log.head()
+            if disk_head >= self._next_seq:
+                self._next_seq = disk_head + 1
+            announced = self.log.tips()
+            for ref in set(self._booked_tips) | set(announced):
+                if not self._pending_refs.get(ref):
+                    # no in-flight booking of ours: the announced state —
+                    # possibly another process's — is the truth
+                    if ref in announced:
+                        self._booked_tips[ref] = announced[ref]
+                    else:
+                        self._booked_tips.pop(ref, None)
+            current = self._current_tips()
+            for ref, oid in sorted(current.items()):
+                if self._booked_tips.get(ref) != oid:
+                    self._book_locked(
+                        ref, self._booked_tips.get(ref), oid, replay=True
+                    )
+                    booked += 1
+            for ref in sorted(
+                r for r in self._booked_tips if r not in current
+            ):
+                self._book_locked(
+                    ref, self._booked_tips[ref], None, replay=True
+                )
+                booked += 1
+        if booked:
+            tm.incr("events.replays", booked)
+        return booked
+
+    # -- the worker: CDC → warm → announce -----------------------------------
+
+    def _run(self):
+        while True:
+            with self._cond:
+                while not self._queue and not self._stopped:
+                    self._cond.wait(60.0)
+                if self._stopped and not self._queue:
+                    return
+                booking = self._queue.popleft()
+                tm.gauge_set("events.queue_depth", len(self._queue))
+            self._process(booking)
+
+    def _process(self, booking):
+        ref, old, new = booking["ref"], booking["old"], booking["new"]
+        try:
+            summary = (
+                dirty_tiles(self.repo, old, new) if new is not None else None
+            )
+        except Exception as e:
+            self._emission_failed(booking, "cdc", e)
+            return
+        warm_stats = None
+        if new is not None:
+            try:
+                warm_stats = warm_dirty_tiles(self.repo, new, summary)
+            except Exception as e:
+                # warming is best-effort: the announcement must not be
+                # lost to a warm crash (tests/test_faults.py events.warm)
+                warm_stats = {"tiles": 0, "already_hot": 0, "errors": 1,
+                              "seconds": 0.0}
+                tm.incr("events.warm_errors")
+                L.warning("tile warm for %s failed: %s", ref, e)
+        event = {
+            "seq": booking["seq"],
+            "ref": ref,
+            "old": old,
+            "new": new,
+            "cas_ts": round(booking["cas_ts"], 6),
+            "ts": round(time.time(), 6),
+            "dirty": summary,
+            "warm": warm_stats,
+        }
+        if booking.get("replay"):
+            event["replay"] = True
+        try:
+            self.log.append_event(event)
+        except Exception as e:
+            self._emission_failed(booking, "announce", e)
+            return
+        with self._cond:
+            self._unpend_locked(ref)
+            self._last_warm = warm_stats
+            self._cond.notify_all()
+        tm.observe(
+            "events.announce_seconds", max(0.0, event["ts"] - booking["cas_ts"])
+        )
+
+    def _unpend_locked(self, ref):
+        n = self._pending_refs.get(ref, 0) - 1
+        if n > 0:
+            self._pending_refs[ref] = n
+        else:
+            self._pending_refs.pop(ref, None)
+
+    def _emission_failed(self, booking, frame, exc):
+        ref = booking["ref"]
+        tm.incr("events.emit_errors")
+        L.warning(
+            "event emission (%s) for %s seq %d failed: %s — the tip stays "
+            "un-announced; reconcile will replay it",
+            frame, ref, booking["seq"], exc,
+        )
+        with self._cond:
+            self._unpend_locked(ref)
+            if not self._pending_refs.get(ref):
+                # no later booking supersedes this ref: reset the booked
+                # tip to what was actually announced, so the reconcile
+                # pass (next watcher poll, or the restarted server's
+                # constructor) sees the gap and re-books it
+                announced = self.log.tips().get(ref)
+                if announced is None:
+                    self._booked_tips.pop(ref, None)
+                else:
+                    self._booked_tips[ref] = announced
+            self._cond.notify_all()
+
+    # -- the subscription surface --------------------------------------------
+
+    def events_since(self, since):
+        """-> (events, head, reset) — the non-blocking read."""
+        return self.log.since(since)
+
+    def wait_events(self, since, timeout=LONG_POLL_SECONDS):
+        """Long-poll: block until events with seq > ``since`` exist (or
+        the timeout passes); -> (events, head, reset). Each poll slice
+        re-reads the log file and reconciles against the refs, so
+        announcements from other processes and pushes landed without an
+        emitter both surface within one slice."""
+        deadline = time.monotonic() + max(0.0, timeout)
+        t_enter = time.time()
+        while True:
+            self.reconcile()  # re-reads the disk log first
+            events, head, reset = self.log.since(since)
+            if events or reset is not None:
+                now = time.time()
+                for event in events:
+                    if event.get("ts", 0) >= t_enter and "cas_ts" in event:
+                        # fresh fan-out: ref-CAS to watcher delivery
+                        latency = max(0.0, now - event["cas_ts"])
+                        tm.observe("events.fanout_seconds", latency)
+                        self._last_fanout = latency
+                return events, head, reset
+            remaining = deadline - time.monotonic()
+            if remaining <= 0:
+                return [], head, None
+            with self._cond:
+                self._cond.wait(min(POLL_SLICE_SECONDS, remaining))
+
+    class _Watching:
+        def __init__(self, emitter):
+            self._emitter = emitter
+
+        def __enter__(self):
+            with self._emitter._cond:
+                self._emitter._watchers += 1
+                tm.gauge_set("events.watchers", self._emitter._watchers)
+            return self
+
+        def __exit__(self, *exc):
+            with self._emitter._cond:
+                self._emitter._watchers -= 1
+                tm.gauge_set("events.watchers", self._emitter._watchers)
+            return False
+
+    def watching(self):
+        """Context manager counting a connected watcher (the
+        ``events.watchers`` gauge + the stats document)."""
+        return EventEmitter._Watching(self)
+
+    # -- serving-side integration --------------------------------------------
+
+    def tile_pin(self, ref):
+        """The warm-then-announce read side: while a booking for ``ref``
+        is pending (CDC/warm in flight), branch-name tile requests resolve
+        to the *announced* tip — the old commit keeps serving, hot, until
+        the warmer finishes and the announcement advances the tip.
+        -> the announced commit oid to pin to, or None (no pin: resolve
+        normally)."""
+        with self._cond:
+            if not self._pending_refs:
+                return None
+            candidates = (ref, f"refs/heads/{ref}", f"refs/tags/{ref}")
+            pending = next(
+                (c for c in candidates if self._pending_refs.get(c)), None
+            )
+        if pending is None:
+            return None
+        return self.log.tips().get(pending)
+
+    def status_dict(self):
+        """The ``events`` block of ``/api/v1/stats?format=json`` (what
+        ``kart top`` renders)."""
+        with self._cond:
+            watchers = self._watchers
+            queue_depth = len(self._queue)
+            pending = sum(self._pending_refs.values())
+            last_fanout = self._last_fanout
+            last_warm = self._last_warm
+        return {
+            "watchers": watchers,
+            "head_seq": self.log.head(),
+            "oldest_seq": self.log.oldest(),
+            "queue_depth": queue_depth,
+            "pending_refs": pending,
+            "last_fanout_seconds": (
+                round(last_fanout, 6) if last_fanout is not None else None
+            ),
+            "last_warm": last_warm,
+        }
+
+
+# ---------------------------------------------------------------------------
+# the per-process emitter registry (bounded, like the cache registries;
+# an evicted emitter's worker drains and parks — correctness lives in the
+# on-disk log + reconcile, never in which instance happened to be cached)
+# ---------------------------------------------------------------------------
+
+_EMITTERS = OrderedDict()
+_EMITTERS_MAX = 64
+_emitters_lock = threading.Lock()
+
+
+def emitter_for(repo):
+    """Get-or-create the emitter serving ``repo`` (serving processes
+    only: ``make_server`` and the stdio ``events`` op call this; a plain
+    push path never creates one)."""
+    key = os.path.realpath(repo.gitdir)
+    with _emitters_lock:
+        emitter = _EMITTERS.get(key)
+        if emitter is not None:
+            _EMITTERS.move_to_end(key)
+            return emitter
+    # construction replays the log + reconciles — do it outside the
+    # registry lock, then publish (two racing creators: one instance wins,
+    # the loser's constructor was idempotent reads + booked replays that
+    # the winner's reconcile would also have made)
+    built = EventEmitter(repo)
+    evicted = []
+    with _emitters_lock:
+        emitter = _EMITTERS.get(key)
+        if emitter is None:
+            emitter = _EMITTERS[key] = built
+        _EMITTERS.move_to_end(key)
+        while len(_EMITTERS) > _EMITTERS_MAX:
+            evicted.append(_EMITTERS.popitem(last=False)[1])
+    if emitter is not built:
+        # the registry race's loser: its booked replays belong to the
+        # winner (whose own reconcile makes them), so discard, not drain
+        built.stop(timeout=0.5, drain=False)
+    for old in evicted:
+        # an evicted emitter must not keep a worker thread (and the repo
+        # it pins) alive forever; its on-disk log state survives and a
+        # re-created emitter reconciles from it
+        old.stop(timeout=0.5)
+    return emitter
+
+
+def active_emitter(gitdir):
+    """The already-created emitter for ``gitdir``, or None — the push-side
+    hook must never *create* one (a non-serving process books nothing)."""
+    with _emitters_lock:
+        return _EMITTERS.get(os.path.realpath(gitdir))
+
+
+def notify_ref_updates(repo, changes):
+    """The ref-update hook (:data:`kart_tpu.analysis.registry.EVENT_EMIT_HOOK`),
+    called from ``_apply_validated_updates``: book one event per landed
+    transition. ``changes``: ``[(ref, old_oid|None, new_oid|None)]``.
+    -> the highest booked sequence, or None (events off / not serving)."""
+    if not changes or not events_enabled():
+        return None
+    emitter = active_emitter(repo.gitdir)
+    if emitter is None:
+        return None
+    return emitter.book_many(changes)
+
+
+def drop_emitters(gitdir=None):
+    """Tests: forget cached emitters (state persists in the log files)."""
+    with _emitters_lock:
+        if gitdir is None:
+            doomed = list(_EMITTERS.values())
+            _EMITTERS.clear()
+        else:
+            real = os.path.realpath(gitdir)
+            doomed = [
+                _EMITTERS.pop(k) for k in list(_EMITTERS) if k == real
+            ]
+    for emitter in doomed:
+        emitter.stop(timeout=0.5)

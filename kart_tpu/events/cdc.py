@@ -1,0 +1,491 @@
+"""Changed-block CDC: which tiles changed between two commits, computed
+from sidecar columns alone (docs/EVENTS.md §2).
+
+The whole pipeline is a re-use of machinery earlier PRs already proved at
+100M-row scale, composed into a new question:
+
+1. **Row delta** — both tips' sorted (key, oid) sidecar columns feed the
+   diff engine's block classifier
+   (:func:`kart_tpu.ops.diff_kernel.classify_blocks`, the 160M rows/s
+   merge-join): a row is *changed* when its key was inserted, deleted, or
+   kept with a different oid. No feature blob is ever read — the oid IS
+   the value identity (content addressing).
+2. **Changed envelopes** — the changed rows' wsen rectangles come from the
+   same sidecar envelope columns (PR 1) the tile encoder selects rows by.
+3. **Tile cover** — each changed envelope maps through the WebMercator
+   cover math of :mod:`kart_tpu.tiles.grid` to the tile addresses whose
+   *membership rectangle* it intersects, per zoom.
+
+Exactness (the acceptance property, tests/test_events.py): for any layer
+set that includes ``geojson``, the dirty set equals — superset-free AND
+subset-free — the set of tiles whose payload **content** differs between
+the two commits (payload headers embed the commit oid by design, so
+"content" means the layer bytes + feature count). The argument:
+
+* tile membership is purely envelope-based (`clip.py`'s exact refine runs
+  against the envelope columns, not decoded geometry), so a tile's row set
+  is a deterministic function of (keys, envelopes);
+* a changed oid means a changed blob means a changed geojson line (the
+  compiled serialisers are deterministic), and a changed envelope implies
+  a changed geometry implies a changed oid;
+* therefore a tile's payload differs **iff** some row whose envelope
+  intersects the tile was inserted/deleted/oid-changed — exactly the set
+  computed here. (``bin``-only payloads can coincide across an
+  attribute-only change — for those the set is a documented superset.)
+
+The cover math mirrors :func:`kart_tpu.ops.bbox.bbox_intersects_np`'s
+closed/cyclic semantics exactly, including touching edges, the
+anti-meridian seam, the polar extension of edge rows, and degenerate
+(n < s) rectangles — the exactness property is only as good as this
+correspondence, and the property test hammers it with random edits.
+"""
+
+import numpy as np
+
+from kart_tpu import faults
+from kart_tpu import telemetry as tm
+from kart_tpu.tiles.grid import merc_xy_cols
+
+#: zoom levels an event's dirty-tile set enumerates (deeper zooms are
+#: derivable client-side: a z+1 tile is dirty only if its z parent is).
+DEFAULT_EVENT_ZOOMS = tuple(range(0, 9))
+
+#: ceiling on enumerated dirty tiles per dataset per event: past this the
+#: event carries per-zoom counts + the changed-region bbox only
+#: (``truncated``) — an invalidation message must stay a message, not a
+#: payload.
+MAX_EVENT_TILES = 4096
+
+
+def _normalise_lon(w, e):
+    """Longitude columns -> (w', e', wraps) matching the cyclic range
+    semantics of :mod:`kart_tpu.ops.bbox`: values folded into [-180, 180],
+    ``wraps`` marking ranges that cross the anti-meridian (including
+    out-of-range inputs whose folded ends swap). Full-width (>= 360°)
+    ranges come back as (-180, 180, False)."""
+    full = (e - w) >= 360.0
+    wf = np.mod(w + 180.0, 360.0) - 180.0
+    ef = np.mod(e + 180.0, 360.0) - 180.0
+    # the fold maps +180 to -180; keep an exact east bound at the seam
+    ef = np.where((ef == -180.0) & (e != w), 180.0, ef)
+    wraps = (ef < wf) & ~full
+    w2 = np.where(full, -180.0, wf)
+    e2 = np.where(full, 180.0, ef)
+    return w2, e2, wraps
+
+
+def _merc_rows(lat):
+    """Vectorized latitude degrees -> normalized mercator y (0 = north
+    clamp), ±inf clipped to the poles first (matching the closed lat
+    compare, where an infinite bound matches everything on its side)."""
+    return merc_xy_cols(np.zeros_like(lat), np.clip(lat, -90.0, 90.0))[1]
+
+
+def tile_cover_ranges(z, envelopes):
+    """(M, 4) f64 wsen envelopes -> list of inclusive tile ranges
+    ``(x0, x1, y0, y1)`` arrays, one entry per contiguous x-range (a
+    wrapping envelope contributes two; seam-touching envelopes gain the
+    opposite edge column). A range with ``y0 > y1`` (degenerate rect no
+    tile row spans) selects nothing. The ranges reproduce — closed edges,
+    poles, seam — which tiles' cover rectangles
+    (:func:`kart_tpu.tiles.grid.tile_cover_wsen`) each envelope
+    intersects under :func:`~kart_tpu.ops.bbox.bbox_intersects_np`."""
+    env = np.asarray(envelopes, dtype=np.float64).reshape(-1, 4)
+    n = 1 << z
+    w, s, e, nl = env[:, 0], env[:, 1], env[:, 2], env[:, 3]
+    # rows the engine's own scans place in no tile: NaN anywhere kills the
+    # closed compares; a non-finite longitude NaN-poisons the cyclic math
+    keep = (
+        np.isfinite(w) & np.isfinite(e) & ~np.isnan(s) & ~np.isnan(nl)
+    )
+    if not keep.all():
+        env = env[keep]
+        w, s, e, nl = env[:, 0], env[:, 1], env[:, 2], env[:, 3]
+    if not len(env):
+        return []
+    w, e, wraps = _normalise_lon(w, e)
+
+    # closed-edge tile ranges: tile x covers [x/n*360-180, (x+1)/n*360-180],
+    # so x intersects [w, e] iff ceil(fx_w)-1 <= x <= floor(fx_e)
+    fx_w = (w + 180.0) / 360.0 * n
+    fx_e = (e + 180.0) / 360.0 * n
+    x0 = np.ceil(fx_w).astype(np.int64) - 1
+    x1 = np.floor(fx_e).astype(np.int64)
+    # mercator rows, same closed algebra (monotonic decreasing in lat).
+    # The clip of the fractional row into [0, n] is the polar extension
+    # of the edge rows: a latitude at/beyond the WebMercator clamp maps
+    # to y ≈ ±1e-17 in floating point, and without the clip a -1e-17
+    # would floor to row -1 and silently drop a polar feature's tiles —
+    # the exact bug class tile_cover_wsen exists to prevent
+    fy_n = np.clip(_merc_rows(nl) * n, 0.0, float(n))
+    fy_s = np.clip(_merc_rows(s) * n, 0.0, float(n))
+    y0 = np.maximum(np.ceil(fy_n).astype(np.int64) - 1, 0)
+    y0 = np.minimum(y0, n - 1)
+    y1 = np.minimum(np.floor(fy_s).astype(np.int64), n - 1)
+    # NOTE: y1 may end < y0 for degenerate (n < s) rects — that's the
+    # correct empty selection, so no clamp of y1 up to 0
+
+    ranges = []
+    plain = ~wraps
+    if plain.any():
+        ranges.append(
+            (
+                np.clip(x0[plain], 0, n - 1),
+                np.clip(x1[plain], 0, n - 1),
+                y0[plain],
+                y1[plain],
+            )
+        )
+        # the anti-meridian seam: 180 and -180 are the same meridian, so
+        # an envelope touching one edge touches the tile column at the
+        # other (bbox_intersects_np's mod-360 math; measure-zero for real
+        # data, but exactness is exactness)
+        seam_e = plain & (e == 180.0) & (w > -180.0)
+        if seam_e.any():
+            zeros = np.zeros(int(seam_e.sum()), dtype=np.int64)
+            ranges.append((zeros, zeros, y0[seam_e], y1[seam_e]))
+        seam_w = plain & (w == -180.0) & (e < 180.0)
+        if seam_w.any():
+            last = np.full(int(seam_w.sum()), n - 1, dtype=np.int64)
+            ranges.append((last, last, y0[seam_w], y1[seam_w]))
+    if wraps.any():
+        # wrapping range [w, 180] ∪ [-180, e]: two contiguous x-ranges
+        xw = np.clip(x0[wraps], 0, n - 1)
+        xe = np.clip(x1[wraps], 0, n - 1)
+        hi = np.full(len(xw), n - 1, dtype=np.int64)
+        lo = np.zeros(len(xe), dtype=np.int64)
+        ranges.append((xw, hi, y0[wraps], y1[wraps]))
+        ranges.append((lo, xe, y0[wraps], y1[wraps]))
+    return ranges
+
+
+def tiles_for_envelopes(z, envelopes, cap=None):
+    """-> (sorted unique (k, 2) int64 ``[x, y]`` tile addresses at zoom
+    ``z`` whose cover intersects any envelope, unique count, capped
+    bool). ``capped=True`` means the enumeration stopped early — the
+    address list is INCOMPLETE and the caller must treat the result as
+    truncated regardless of the unique count (overlapping envelopes can
+    dedup below the cap while un-enumerated ranges remain; publishing
+    such a list as exact would silently drop invalidations)."""
+    n = 1 << z
+    packed = []
+    total = 0
+    capped = False
+    for x0, x1, y0, y1 in tile_cover_ranges(z, envelopes):
+        nx = x1 - x0 + 1
+        ny = y1 - y0 + 1
+        valid = (nx > 0) & (ny > 0)
+        if not valid.any():
+            continue
+        x0, nx, y0, ny = x0[valid], nx[valid], y0[valid], ny[valid]
+        sizes = nx * ny
+        for i in range(len(x0)):
+            xs = np.arange(x0[i], x0[i] + nx[i], dtype=np.int64)
+            ys = np.arange(y0[i], y0[i] + ny[i], dtype=np.int64)
+            packed.append(
+                (xs[:, None] * n + ys[None, :]).ravel()
+            )
+            total += int(sizes[i])
+            if cap is not None and total > cap:
+                capped = True
+                break
+        if capped:
+            break
+    if not packed:
+        return np.zeros((0, 2), dtype=np.int64), 0, False
+    uniq = np.unique(np.concatenate(packed))
+    out = np.empty((len(uniq), 2), dtype=np.int64)
+    out[:, 0] = uniq // n
+    out[:, 1] = uniq % n
+    return out, len(uniq), capped
+
+
+def _source_or_none(repo, commit_oid, ds_path):
+    from kart_tpu.tiles.source import TileSourceError, source_for
+
+    if commit_oid is None:
+        return None
+    try:
+        return source_for(repo, commit_oid, ds_path)
+    except TileSourceError:
+        return None
+
+
+# ---------------------------------------------------------------------------
+# O(changed) sidecar derivation for freshly-pushed tips
+#
+# A pushed commit arrives with no sidecar on the server (sidecars are a
+# local cache, packs don't ship them), and letting ensure_block rebuild it
+# is an O(N) feature-tree walk — at 100M rows that walk, not the CDC,
+# would dominate the push→announce latency. The tree-level delta between
+# the two feature trees is O(changed × depth) (unchanged subtrees share
+# oids and are skipped whole), and the PR 1 derive_sidecar turns the old
+# block + that delta into the new sidecar with O(changed) array ops. The
+# only blob reads are the added/changed features' own blobs — they carry
+# the new envelopes and exist nowhere else; everything untouched rides
+# over from the old sidecar.
+# ---------------------------------------------------------------------------
+
+
+def _tree_delta(odb, old_tree_oid, new_tree_oid):
+    """-> (removed {path: oid}, added {path: oid}) of blob leaves between
+    two feature trees, walking only subtrees whose oids differ."""
+    from kart_tpu.core.odb import ObjectMissing
+
+    removed, added = {}, {}
+    stack = [(old_tree_oid, new_tree_oid, "")]
+    while stack:
+        old_oid, new_oid, prefix = stack.pop()
+        if old_oid == new_oid:
+            continue
+        try:
+            old_entries = (
+                {e.name: e for e in odb.read_tree_entries(old_oid)}
+                if old_oid
+                else {}
+            )
+            new_entries = (
+                {e.name: e for e in odb.read_tree_entries(new_oid)}
+                if new_oid
+                else {}
+            )
+        except (ObjectMissing, KeyError, ValueError):
+            raise _DeltaUnavailable()
+        for name in set(old_entries) | set(new_entries):
+            o, n = old_entries.get(name), new_entries.get(name)
+            path = f"{prefix}{name}"
+            o_tree = o is not None and o.is_tree
+            n_tree = n is not None and n.is_tree
+            if o_tree or n_tree:
+                stack.append(
+                    (
+                        o.oid if o_tree else None,
+                        n.oid if n_tree else None,
+                        f"{path}/",
+                    )
+                )
+                if o is not None and not o_tree:
+                    removed[path] = o.oid
+                if n is not None and not n_tree:
+                    added[path] = n.oid
+                continue
+            if o is not None and n is not None and o.oid == n.oid:
+                continue
+            if o is not None:
+                removed[path] = o.oid
+            if n is not None:
+                added[path] = n.oid
+    return removed, added
+
+
+class _DeltaUnavailable(Exception):
+    """The tree delta can't be computed (shallow/partial history) — fall
+    back to the full sidecar build."""
+
+
+def ensure_derived_sidecar(repo, old_ds, new_ds):
+    """Make sure ``new_ds``'s feature tree has a sidecar, deriving it
+    O(changed) from ``old_ds``'s when possible (int-pk dataset, old
+    sidecar with envelope columns present). -> True when a sidecar exists
+    afterwards without an O(N) walk having run here (the fallback build
+    is left to the tile source's ensure_block)."""
+    from kart_tpu.diff import sidecar
+
+    if new_ds is None or new_ds.feature_tree is None:
+        return False
+    if sidecar.has_sidecar(repo, new_ds):
+        return True
+    if (
+        old_ds is None
+        or old_ds.feature_tree is None
+        or old_ds.path_encoder.scheme != "int"
+    ):
+        return False
+    old_block = sidecar.load_block(repo, old_ds, pad=False)
+    if old_block is None:
+        return False
+    try:
+        removed_paths, added_paths = _tree_delta(
+            repo.odb, old_ds.feature_tree.oid, new_ds.feature_tree.oid
+        )
+    except _DeltaUnavailable:
+        return False
+    with tm.span("events.derive_sidecar", changed=len(added_paths)):
+        decode = new_ds.decode_path_to_pks
+        removed = {int(decode(p)[0]) for p in removed_paths}
+        added = {}
+        added_envs = {} if old_block.envelopes is not None else None
+        geom_col = new_ds.geom_column_name
+        if added_envs is not None and added_paths:
+            paths = sorted(added_paths)
+            oids = [added_paths[p] for p in paths]
+            blobs = repo.odb.read_blobs_data_ordered(
+                [bytes.fromhex(o) for o in oids]
+            )
+            for path, oid, blob in zip(paths, oids, blobs):
+                pk = int(decode(path)[0])
+                added[pk] = oid
+                if blob is None:
+                    blob = repo.odb.read_blob(oid)
+                feature = new_ds.get_feature(
+                    (pk,), data=blob
+                )
+                added_envs[pk] = sidecar._feature_envelope_wsen(
+                    feature, geom_col
+                )
+        else:
+            added = {
+                int(decode(p)[0]): oid for p, oid in added_paths.items()
+            }
+        sidecar.derive_sidecar(
+            repo, old_block, new_ds.feature_tree.oid, removed, added,
+            added_envs,
+        )
+    return True
+
+
+def changed_envelopes(old_source, new_source):
+    """-> ((M, 4) f64 changed-row envelopes drawn from both tips, counts
+    dict) via the diff engine's sorted merge-join over the two sidecar
+    (key, oid) columns. ``None`` on either side means the dataset
+    appeared/vanished — every row of the other side is changed."""
+    from kart_tpu.ops.diff_kernel import changed_indices, classify_blocks
+
+    if old_source is None and new_source is None:
+        return np.zeros((0, 4), dtype=np.float64), {}
+    if old_source is None or new_source is None:
+        src = new_source if old_source is None else old_source
+        envs = np.asarray(src.envelopes(), dtype=np.float64)
+        kind = "inserts" if old_source is None else "deletes"
+        return envs, {kind: src.block.count}
+    old_block, new_block = old_source.block, new_source.block
+    with tm.span("events.cdc_classify",
+                 rows=max(old_block.count, new_block.count)):
+        old_class, new_class, counts = classify_blocks(old_block, new_block)
+        old_idx, new_idx = changed_indices(old_class, new_class)
+    parts = []
+    if len(old_idx):
+        parts.append(np.asarray(old_source.envelopes(), dtype=np.float64)[old_idx])
+    if len(new_idx):
+        parts.append(np.asarray(new_source.envelopes(), dtype=np.float64)[new_idx])
+    envs = (
+        np.concatenate(parts)
+        if parts
+        else np.zeros((0, 4), dtype=np.float64)
+    )
+    return envs, {
+        k: int(v)
+        for k, v in counts.items()
+        if k in ("inserts", "deletes", "updates") and v
+    }
+
+
+def _bbox_of(envelopes):
+    """Union wsen of the changed envelopes (finite members only; wrapping
+    members widen to full longitude) — the coarse invalidation rectangle a
+    truncated event still carries."""
+    env = np.asarray(envelopes, dtype=np.float64).reshape(-1, 4)
+    finite = np.isfinite(env).all(axis=1)
+    env = env[finite]
+    if not len(env):
+        return None
+    wraps = env[:, 2] < env[:, 0]
+    w = -180.0 if wraps.any() else float(env[:, 0].min())
+    e = 180.0 if wraps.any() else float(env[:, 2].max())
+    return [w, float(env[:, 1].min()), e, float(env[:, 3].max())]
+
+
+def dirty_tiles(repo, old_oid, new_oid, *, zooms=DEFAULT_EVENT_ZOOMS,
+                max_tiles=MAX_EVENT_TILES):
+    """The CDC verb: -> the per-dataset dirty-tile summary dict between
+    two commits of ``repo`` (either side ``None`` for ref create/delete).
+
+        {ds_path: {"changed": {"inserts": i, "deletes": d, "updates": u},
+                   "zooms": [z0, z1, ...],
+                   "tiles": {"z": [[x, y], ...], ...} | None,
+                   "tile_count": total unique tiles across zooms,
+                   "bbox": [w, s, e, n] | None,
+                   "truncated": bool}}
+
+    ``tiles`` is ``None`` for non-spatial / un-diffable datasets (the
+    subscriber invalidates the whole dataset) and for truncated events
+    (invalidate by ``bbox``). Datasets whose feature trees are identical
+    are omitted entirely. Fires the ``events.emit`` frame-1 fault before
+    any computation (the injectable CDC crash)."""
+    faults.fire("events.emit")  # frame 1: the CDC computation
+    summary = {}
+    old_sets = _datasets_at(repo, old_oid)
+    new_sets = _datasets_at(repo, new_oid)
+    paths = set(old_sets.paths() if old_sets else ()) | set(
+        new_sets.paths() if new_sets else ()
+    )
+    with tm.span("events.cdc", datasets=len(paths)):
+        for ds_path in sorted(paths):
+            old_ds = old_sets.get(ds_path) if old_sets else None
+            new_ds = new_sets.get(ds_path) if new_sets else None
+            old_tree = _tree_oid(old_ds)
+            new_tree = _tree_oid(new_ds)
+            if old_tree == new_tree:
+                continue  # identical content: clean by construction
+            if new_ds is not None:
+                # a freshly-pushed tip has no sidecar: derive it
+                # O(changed) from the old tip's instead of letting the
+                # tile source pay the O(N) feature-tree rebuild
+                ensure_derived_sidecar(repo, old_ds, new_ds)
+            old_src = _source_or_none(repo, old_oid, ds_path)
+            new_src = _source_or_none(repo, new_oid, ds_path)
+            if old_src is None and new_src is None:
+                # non-spatial (or unreadable) on both sides: no tile space
+                # to be exact in — the subscriber invalidates the dataset
+                summary[ds_path] = {
+                    "changed": None, "zooms": list(zooms), "tiles": None,
+                    "tile_count": None, "bbox": None, "truncated": False,
+                }
+                continue
+            envs, counts = changed_envelopes(old_src, new_src)
+            entry = {
+                "changed": counts,
+                "zooms": list(zooms),
+                "bbox": _bbox_of(envs),
+                "truncated": False,
+            }
+            tiles = {}
+            total = 0
+            capped = False
+            for z in zooms:
+                addrs, k, capped = tiles_for_envelopes(
+                    z, envs, cap=max_tiles
+                )
+                tiles[str(z)] = addrs.tolist()
+                total += k
+                if capped or total > max_tiles:
+                    break
+            if capped or total > max_tiles:
+                entry["tiles"] = None
+                entry["truncated"] = True
+                entry["tile_count"] = None
+            else:
+                entry["tiles"] = tiles
+                entry["tile_count"] = total
+                tm.incr("events.dirty_tiles", total)
+            summary[ds_path] = entry
+    return summary
+
+
+def _datasets_at(repo, commit_oid):
+    from kart_tpu.core.structure import RepoStructure
+
+    if commit_oid is None:
+        return None
+    try:
+        return RepoStructure(repo, commit_oid).datasets
+    except (KeyError, ValueError):
+        return None
+
+
+def _tree_oid(ds):
+    """Feature-tree oid of a dataset, or None — the O(1) "did anything
+    change" probe run before any sidecar is loaded."""
+    if ds is None or ds.feature_tree is None:
+        return None
+    return ds.feature_tree.oid

@@ -1,0 +1,290 @@
+"""The per-repo live-update event log (docs/EVENTS.md §3).
+
+An ordered, bounded, *persistent* record of announced ref transitions:
+one JSON line per event under ``<gitdir>/events/log.jsonl``, monotonic
+``seq`` numbers, plus a ``tips.json`` checkpoint of the ref tips the log
+has announced so far. Three properties the protocol leans on:
+
+* **resume-by-sequence** — ``since(seq)`` returns exactly the announced
+  events with a larger sequence number, so a disconnected watcher replays
+  what it missed; a watcher older than the retention window is told to
+  reset (``oldest``) instead of being silently fed a gap.
+* **crash atomicity** — an event is announced by a single buffered
+  ``write()`` of its line + flush; a torn trailing line (the classic
+  kill-mid-append) is detected and ignored on load, so the tip it carried
+  is simply *not announced* and the emitter's reconcile pass re-emits it
+  (tests/test_faults.py: the ``events.emit`` frame-2 kill).
+* **derived tips** — the announced-tips map is the checkpoint plus a
+  replay of every logged event after it, so the checkpoint write (a
+  separate atomic replace) can lag or be lost without the log lying about
+  what was announced.
+
+Writers (append + rotation, as one unit) serialise across processes on
+an ``fcntl`` lock file (``.events-lock``, the ``.push-lock`` idiom) so a
+second process landing a push against the same gitdir (an ssh
+``serve-stdio`` push next to the HTTP server) can neither interleave
+half-lines nor have its append erased by a concurrent rotation; sequence
+coordination across processes stays with the emitter's reconcile pass,
+which re-reads the file before trusting its in-memory head.
+"""
+
+import json
+import logging
+import os
+import threading
+from collections import deque
+from contextlib import contextmanager
+
+from kart_tpu import faults
+from kart_tpu import telemetry as tm
+
+L = logging.getLogger("kart_tpu.events.log")
+
+#: default number of events retained (``KART_EVENTS_LOG_SIZE`` overrides);
+#: the on-disk file is rewritten down to this size when it doubles it
+DEFAULT_LOG_SIZE = 1024
+
+LOG_SUBDIR = "events"
+LOG_FILE = "log.jsonl"
+TIPS_FILE = "tips.json"
+
+
+def log_size(environ=os.environ):
+    try:
+        value = int(environ.get("KART_EVENTS_LOG_SIZE", ""))
+    except (TypeError, ValueError):
+        return DEFAULT_LOG_SIZE
+    return value if value > 0 else DEFAULT_LOG_SIZE
+
+
+def _parse_lines(raw):
+    """Log file bytes -> list of event dicts; a torn trailing line (no
+    newline, or unparseable) is dropped — that event was never fully
+    announced."""
+    events = []
+    lines = raw.split(b"\n")
+    # a complete file ends with a newline: the final split element is
+    # empty; anything else is the torn tail of a killed append
+    for line in lines[:-1]:
+        if not line.strip():
+            continue
+        try:
+            event = json.loads(line.decode())
+        except (ValueError, UnicodeDecodeError):
+            L.warning("events log: dropping corrupt line (%d bytes)", len(line))
+            continue
+        if isinstance(event, dict) and isinstance(event.get("seq"), int):
+            events.append(event)
+    return events
+
+
+class EventLog:
+    """One repo's announced-event history, memory-fronted and disk-backed.
+
+    ``append`` is the announce frame: the event becomes visible to
+    ``since``/``head`` only once its line is durably in the file (and the
+    ``events.emit`` frame-2 fault fires *before* the write, so an injected
+    crash announces nothing)."""
+
+    def __init__(self, gitdir, max_events=None):
+        self.gitdir = gitdir
+        self.dir = os.path.join(gitdir, LOG_SUBDIR)
+        self.path = os.path.join(self.dir, LOG_FILE)
+        self.tips_path = os.path.join(self.dir, TIPS_FILE)
+        self.max_events = max_events if max_events else log_size()
+        self._lock = threading.Lock()
+        events, tips = self._load()
+        self._events = deque(events, maxlen=self.max_events)
+        self._tips = tips
+        self._seen_size = self._file_size()
+
+    def _file_size(self):
+        try:
+            return os.path.getsize(self.path)
+        except OSError:
+            return 0
+
+    # -- load ----------------------------------------------------------------
+
+    def _load(self):
+        try:
+            with open(self.path, "rb") as f:
+                events = _parse_lines(f.read())
+        except OSError:
+            events = []
+        checkpoint_seq, tips = 0, {}
+        try:
+            with open(self.tips_path) as f:
+                doc = json.load(f)
+            checkpoint_seq = int(doc.get("seq", 0))
+            tips = dict(doc.get("tips", {}))
+        except (OSError, ValueError, TypeError):
+            # no checkpoint (first boot) or corrupt: rebuild from the log
+            # alone — worst case the emitter replays a little history
+            checkpoint_seq, tips = 0, {}
+        for event in events:
+            if event["seq"] <= checkpoint_seq:
+                continue
+            ref = event.get("ref")
+            if not ref:
+                continue
+            if event.get("new"):
+                tips[ref] = event["new"]
+            else:
+                tips.pop(ref, None)
+        return events[-self.max_events:], tips
+
+    # -- reads ---------------------------------------------------------------
+
+    def head(self):
+        with self._lock:
+            return self._events[-1]["seq"] if self._events else 0
+
+    def oldest(self):
+        with self._lock:
+            return self._events[0]["seq"] if self._events else 0
+
+    def tips(self):
+        with self._lock:
+            return dict(self._tips)
+
+    def since(self, seq):
+        """-> (events with ``seq`` strictly greater, head, reset_marker).
+        ``reset_marker`` is the oldest retained sequence when the caller's
+        position predates the retention window (it missed events it can
+        never replay — re-sync from scratch), else None."""
+        with self._lock:
+            head = self._events[-1]["seq"] if self._events else 0
+            oldest = self._events[0]["seq"] if self._events else 0
+            reset = oldest - 1 if (self._events and seq < oldest - 1) else None
+            out = [e for e in self._events if e["seq"] > seq]
+            return out, head, reset
+
+    # -- the announce frame --------------------------------------------------
+
+    def append_event(self, event):
+        """Announce one event: write its line, absorb it into memory +
+        tips, rotate the file when it has doubled the retention bound.
+        The append AND the rotation run under one cross-process write
+        lock (``.events-lock``, the ``.push-lock`` idiom) — a rotation
+        that merely flocked the data file could read, lose the lock, and
+        ``os.replace`` over a line another process appended in between,
+        silently erasing an announced event."""
+        line = (json.dumps(event, sort_keys=True) + "\n").encode()
+        # frame 2: the log append — an injected crash here announces
+        # nothing (the line is never written; the emitter's reconcile
+        # replays the emission on restart). Fired OUTSIDE the log lock:
+        # nothing that can raise or block belongs inside it.
+        faults.fire("events.emit")
+        with self._lock:
+            os.makedirs(self.dir, exist_ok=True)
+            with self._write_lock():
+                with open(self.path, "ab") as f:
+                    f.write(line)
+                    f.flush()
+                self._events.append(event)
+                ref = event.get("ref")
+                if ref:
+                    if event.get("new"):
+                        self._tips[ref] = event["new"]
+                    else:
+                        self._tips.pop(ref, None)
+                self._write_tips_locked(event["seq"])
+                self._maybe_rotate_locked()
+            self._seen_size = self._file_size()
+        tm.gauge_set("events.log_head", event["seq"])
+        tm.incr("events.emitted")
+
+    def adopt_tips(self, tips):
+        """First-boot adoption: checkpoint the current refs at sequence 0
+        without emitting events — subscribers care about transitions from
+        now on, not a synthetic replay of preexisting branches."""
+        with self._lock:
+            self._tips = dict(tips)
+            os.makedirs(self.dir, exist_ok=True)
+            self._write_tips_locked(0)
+
+    @contextmanager
+    def _write_lock(self):
+        """The cross-process writer lock (an ssh ``serve-stdio`` push's
+        emitter next to the HTTP server's): held for append + rotation as
+        one unit. Best-effort on non-POSIX, like ``push_file_lock``."""
+        with open(os.path.join(self.dir, ".events-lock"), "w") as lock:
+            try:
+                import fcntl
+
+                fcntl.flock(lock, fcntl.LOCK_EX)
+            except ImportError:
+                pass
+            yield
+
+    def _write_tips_locked(self, seq):
+        tmp = self.tips_path + f".tmp{os.getpid()}"
+        try:
+            with open(tmp, "w") as f:
+                json.dump({"seq": seq, "tips": self._tips}, f)
+            os.replace(tmp, self.tips_path)
+        except OSError as e:
+            # the checkpoint is an optimisation (tips replay from the log);
+            # a full disk here must not fail the announce itself
+            L.warning("events log: tips checkpoint failed: %s", e)
+
+    def _maybe_rotate_locked(self):
+        """Rewrite the file down to the retention bound. Caller holds
+        both the instance lock and the cross-process write lock — the
+        read-modify-replace is atomic against every other writer."""
+        try:
+            if os.path.getsize(self.path) < 256 * (2 * self.max_events):
+                # cheap size gate: lines are a few hundred bytes; only
+                # stat + compare on the common path
+                return
+            with open(self.path, "rb") as f:
+                events = _parse_lines(f.read())
+            if len(events) <= 2 * self.max_events:
+                return
+            keep = events[-self.max_events:]
+            tmp = self.path + f".tmp{os.getpid()}"
+            with open(tmp, "wb") as f:
+                for event in keep:
+                    f.write((json.dumps(event, sort_keys=True) + "\n").encode())
+            os.replace(tmp, self.path)
+        except OSError as e:
+            L.warning("events log: rotation failed: %s", e)
+
+    def refresh_from_disk(self):
+        """Re-read the file (another process may have appended — the ssh
+        push case); -> the new head. Memory state is rebuilt from disk so
+        cross-process announcements become visible to this server's
+        watchers on the next poll slice."""
+        with self._lock:
+            size = self._file_size()
+            if size == self._seen_size:
+                # nobody appended since we last looked: skip the re-read
+                # (this runs once per watcher poll slice)
+                return self._events[-1]["seq"] if self._events else 0
+            self._seen_size = size
+            disk_head = 0
+            try:
+                with open(self.path, "rb") as f:
+                    raw = f.read()
+            except OSError:
+                return self._events[-1]["seq"] if self._events else 0
+            events = _parse_lines(raw)
+            if events:
+                disk_head = events[-1]["seq"]
+            mem_head = self._events[-1]["seq"] if self._events else 0
+            if disk_head > mem_head:
+                self._events = deque(
+                    events[-self.max_events:], maxlen=self.max_events
+                )
+                for event in events:
+                    if event["seq"] <= mem_head:
+                        continue
+                    ref = event.get("ref")
+                    if not ref:
+                        continue
+                    if event.get("new"):
+                        self._tips[ref] = event["new"]
+                    else:
+                        self._tips.pop(ref, None)
+            return max(disk_head, mem_head)

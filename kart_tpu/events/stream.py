@@ -1,0 +1,117 @@
+"""Client side of the event subscription surface (docs/EVENTS.md §5).
+
+One small long-poll client over ``GET /api/v1/events``: ``kart watch``
+streams its JSON lines from it, and the fleet's
+:class:`~kart_tpu.fleet.sync.ReplicaSync` subscription uses it to learn
+about pushes in fan-out latency instead of a poll period. Resume is by
+sequence number: every response carries ``head``, the next request sends
+``since=<head>``, and a reconnect after any failure replays exactly the
+missed events (the server log is bounded — a ``reset`` marker means the
+watcher slept past the retention window and must re-sync from scratch).
+"""
+
+import json
+import os
+import time
+from urllib.error import HTTPError
+from urllib.parse import urlencode
+from urllib.request import Request, urlopen
+
+#: seconds the client asks the server to hold a long poll open; kept
+#: under the server's own LONG_POLL_SECONDS ceiling
+DEFAULT_POLL_SECONDS = 20.0
+
+#: default overall silence budget for `kart watch` (``KART_WATCH_TIMEOUT``;
+#: 0 = watch forever)
+DEFAULT_WATCH_TIMEOUT = 0.0
+
+
+class EventStreamUnsupported(Exception):
+    """The server has no events endpoint (an old primary, or
+    ``KART_SERVE_EVENTS=0``) — callers fall back to polling."""
+
+
+def watch_timeout(environ=os.environ):
+    try:
+        value = float(environ.get("KART_WATCH_TIMEOUT", ""))
+    except (TypeError, ValueError):
+        return DEFAULT_WATCH_TIMEOUT
+    return value if value >= 0 else DEFAULT_WATCH_TIMEOUT
+
+
+def fetch_events(base_url, since=None, *, poll_seconds=0.0, timeout=None):
+    """One ``GET /api/v1/events`` round-trip; -> the response document
+    (``{"events": [...], "head": N, ...}``). ``since=None`` asks for the
+    current head without waiting (the subscribe handshake).
+    Raises :class:`EventStreamUnsupported` on 404/501, and lets other
+    transport failures propagate (callers pace their own retries)."""
+    from kart_tpu.transport.http import API, http_timeout
+
+    params = {}
+    if since is not None:
+        params["since"] = str(int(since))
+    if poll_seconds:
+        params["timeout"] = f"{poll_seconds:.3f}"
+    query = f"?{urlencode(params)}" if params else ""
+    url = f"{base_url.rstrip('/')}{API}/events{query}"
+    if timeout is None:
+        # the socket budget must outlive the server-held poll window
+        timeout = max(http_timeout(), poll_seconds + 10.0)
+    try:
+        with urlopen(Request(url), timeout=timeout) as resp:
+            return json.loads(resp.read().decode())
+    except HTTPError as e:
+        with e:
+            detail = e.read()[:200]
+        if e.code in (404, 501):
+            raise EventStreamUnsupported(
+                f"{base_url} has no events endpoint (HTTP {e.code})"
+            )
+        raise OSError(f"events poll failed: HTTP {e.code} {detail!r}")
+
+
+def iter_events(base_url, *, since=None, poll_seconds=DEFAULT_POLL_SECONDS,
+                idle_timeout=None, retry_seconds=1.0, max_retries=30):
+    """Yield event dicts from ``base_url`` forever (or until
+    ``idle_timeout`` seconds pass with no event; 0/None = forever).
+
+    The subscribe handshake: with ``since=None`` the first request learns
+    the current head and only *transitions from now on* stream. Transient
+    transport failures reconnect with the same sequence position (paced by
+    ``retry_seconds``); :class:`EventStreamUnsupported` propagates
+    immediately so callers can fall back to polling."""
+    if since is None:
+        since = int(fetch_events(base_url).get("head", 0))
+    failures = 0
+    last_event = time.monotonic()
+    while True:
+        wait = poll_seconds
+        if idle_timeout:
+            remaining = idle_timeout - (time.monotonic() - last_event)
+            if remaining <= 0:
+                return
+            # never hold a poll past the idle budget — the caller asked
+            # to give up after that much silence
+            wait = max(0.0, min(poll_seconds, remaining))
+        try:
+            doc = fetch_events(base_url, since, poll_seconds=wait)
+        except EventStreamUnsupported:
+            raise
+        except OSError:
+            failures += 1
+            if failures > max_retries:
+                raise
+            time.sleep(retry_seconds)
+            continue
+        failures = 0
+        for event in doc.get("events", ()):
+            last_event = time.monotonic()
+            yield event
+        # a reset marker (slept past the retention window) needs no
+        # special handling here: the replayed events start at the oldest
+        # retained sequence and head advances past it — the caller sees
+        # the seq gap in the yielded events (a replica re-syncs refs
+        # from the advertisement regardless)
+        since = max(since, int(doc.get("head", since)))
+        if idle_timeout and time.monotonic() - last_event > idle_timeout:
+            return

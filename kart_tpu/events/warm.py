@@ -1,0 +1,118 @@
+"""Pre-warm the commit-addressed tile cache for an event's dirty tiles
+(docs/EVENTS.md §4).
+
+The warm-then-announce protocol: after a push lands, the server keeps
+serving the *old* tip's tiles (they are commit-addressed and immutable, so
+nothing needs to be dropped) while this module re-encodes the dirty tiles
+of the *new* tip into the tile cache — and only then is the event
+announced and the new tip fanned out to subscribers. A viewer that
+switches commits on the announcement therefore finds every invalidated
+tile already hot: zero cold-tile storms on hot layers.
+
+Warming is strictly best-effort and budget-bounded
+(``KART_EVENTS_WARM_BUDGET`` tiles per event): an oversized dirty set
+warms shallow zooms first (the tiles most viewers are looking at), and a
+failed warm — missing blobs on a partial store, an over-ceiling tile, an
+injected ``events.warm`` fault — is counted and skipped, never allowed to
+block or lose the announcement itself.
+"""
+
+import logging
+import os
+import time
+
+from kart_tpu import faults
+from kart_tpu import telemetry as tm
+
+L = logging.getLogger("kart_tpu.events.warm")
+
+#: default tiles re-encoded per event (``KART_EVENTS_WARM_BUDGET``
+#: overrides; 0 disables warming entirely)
+DEFAULT_WARM_BUDGET = 256
+
+
+#: the layer set warmed per dirty tile: the columnar ``bin`` layer — the
+#: blob-free hot path every map client of the store requests (BENCH_r10's
+#: serving numbers are bin-layer numbers), servable even on partial
+#: stores. The ``geojson`` layer stays lazily encoded on first request
+#: (it needs every feature blob in the tile, which a just-pushed partial
+#: store may not hold).
+WARM_LAYERS = ("bin",)
+
+
+def warm_budget(environ=os.environ):
+    try:
+        value = int(environ.get("KART_EVENTS_WARM_BUDGET", ""))
+    except (TypeError, ValueError):
+        return DEFAULT_WARM_BUDGET
+    return value if value >= 0 else DEFAULT_WARM_BUDGET
+
+
+def iter_warm_tiles(summary, budget):
+    """Yield ``(ds_path, z, x, y)`` warm targets from a CDC summary,
+    shallow zooms first across datasets, bounded by ``budget``. Truncated
+    / non-spatial entries contribute nothing (there is no exact tile list
+    to warm — those subscribers re-fetch lazily)."""
+    if budget <= 0:
+        return
+    emitted = 0
+    by_zoom = []
+    for ds_path, entry in sorted((summary or {}).items()):
+        tiles = entry.get("tiles")
+        if not tiles:
+            continue
+        for z_str, addrs in tiles.items():
+            by_zoom.append((int(z_str), ds_path, addrs))
+    by_zoom.sort(key=lambda t: t[0])
+    for z, ds_path, addrs in by_zoom:
+        for x, y in addrs:
+            yield ds_path, z, int(x), int(y)
+            emitted += 1
+            if emitted >= budget:
+                return
+
+
+def warm_dirty_tiles(repo, new_oid, summary, *, budget=None):
+    """Encode the dirty tiles of ``new_oid`` into the tile cache.
+
+    -> stats dict ``{"tiles", "already_hot", "errors", "seconds"}``
+    (``tiles`` = fresh fills; ``already_hot`` = cache hits — another
+    request got there first). The ``events.warm`` fault point fires once
+    per warm pass, before any tile is encoded: an injected crash abandons
+    the remaining warm but must not poison the cache or lose the
+    announcement (the caller catches and announces anyway —
+    tests/test_faults.py)."""
+    from kart_tpu import tiles
+
+    stats = {"tiles": 0, "already_hot": 0, "errors": 0, "seconds": 0.0}
+    if new_oid is None or not summary:
+        return stats
+    budget = warm_budget() if budget is None else budget
+    t0 = time.perf_counter()
+    with tm.span("events.warm", commit=new_oid[:12]):
+        faults.fire("events.warm")
+        for ds_path, z, x, y in iter_warm_tiles(summary, budget):
+            try:
+                _payload, _etag, cached = tiles.serve_tile(
+                    repo, new_oid, ds_path, z, x, y, commit_oid=new_oid,
+                    layers=WARM_LAYERS,
+                )
+            except (tiles.TileSourceError, tiles.TileEncodeError) as e:
+                # an unwarmable tile (over the feature ceiling, blobs not
+                # local) falls back to a lazy cold encode on first request
+                stats["errors"] += 1
+                L.warning(
+                    "tile warm %s %d/%d/%d at %s failed: %s",
+                    ds_path, z, x, y, new_oid[:12], e,
+                )
+                continue
+            if cached:
+                stats["already_hot"] += 1
+            else:
+                stats["tiles"] += 1
+    stats["seconds"] = round(time.perf_counter() - t0, 6)
+    tm.incr("events.warm_tiles", stats["tiles"])
+    if stats["errors"]:
+        tm.incr("events.warm_errors", stats["errors"])
+    tm.observe("events.warm_seconds", stats["seconds"])
+    return stats

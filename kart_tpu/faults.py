@@ -60,6 +60,16 @@ Registered points:
                             answered, before the response relays (the push
                             landed; the client's retry is absorbed
                             idempotently)
+    events.emit             the live-update emission frames: 1 = the CDC
+                            computation, 2 = the event-log append (the
+                            announce). A crash at either leaves refs/store
+                            byte-identical and the tip un-announced; the
+                            emitter's reconcile pass replays the missed
+                            emission (docs/EVENTS.md §3)
+    events.warm             the dirty-tile pre-warm pass, before any tile
+                            encodes: a crash abandons warming but must
+                            not poison the tile cache or lose the
+                            announcement (warm is best-effort)
 
 Disabled (``KART_FAULTS`` unset) the fast path is a single environ dict
 lookup with no allocation: frame-boundary loops additionally hoist

@@ -47,6 +47,14 @@ MIN_COMMIT_HEADER = "X-Kart-Min-Commit"
 #: primary — the client pins its next reads on the landed commit
 PROXIED_HEADER = "X-Kart-Replica-Proxied"
 
+#: the sequence-number twin of ``X-Kart-Min-Commit`` (docs/EVENTS.md §6):
+#: a proxied push's response payload books its live-update event sequence
+#: (``event_seq``), and subsequent reads carry it here — a subscribed
+#: replica satisfies the pin the moment its sync has applied that event,
+#: a containment walk never runs. Replicas without a live subscription
+#: ignore it and fall back to the commit pin.
+MIN_SEQ_HEADER = "X-Kart-Min-Event"
+
 
 def max_lag_seconds(environ=os.environ):
     try:
@@ -152,6 +160,8 @@ class FleetNode:
             out.update(
                 sync_cycles=s["cycles"],
                 sync_errors=s["errors"],
+                events_subscribed=s["subscribed"],
+                applied_event_seq=s["applied_seq"],
                 last_sync_utc=s["last_sync_utc"],
                 lag_seconds=(
                     round(time.time() - s["last_sync_ok"], 3)

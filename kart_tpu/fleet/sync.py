@@ -24,6 +24,7 @@ import logging
 import os
 import threading
 import time
+from collections import deque
 
 from kart_tpu import faults
 from kart_tpu import telemetry as tm
@@ -71,6 +72,14 @@ class ReplicaSync:
         self._errors = 0
         self._last_sync_ok = None  # wall clock of the last successful cycle
         self._last_error = None
+        # -- the event-stream subscription (docs/EVENTS.md §6): pushes on
+        # -- the primary wake the loop in fan-out latency instead of a
+        # -- poll period; old primaries 404 and we fall back to polling
+        self._sub_thread = None
+        self._sub_active = False
+        self._sub_baseline = None  # (head seq at handshake, monotonic ts)
+        self._pending_events = deque()  # (seq, ref, new_oid) awaiting sync
+        self._applied_seq = 0
 
     # -- lifecycle -----------------------------------------------------------
 
@@ -83,6 +92,16 @@ class ReplicaSync:
                 target=self._run, name="kart-replica-sync", daemon=True
             )
             self._thread.start()
+            if self._sub_thread is None or not self._sub_thread.is_alive():
+                from kart_tpu.transport.remote import is_http_url
+
+                if is_http_url(self.primary_url):
+                    self._sub_thread = threading.Thread(
+                        target=self._subscribe_run,
+                        name="kart-replica-events",
+                        daemon=True,
+                    )
+                    self._sub_thread.start()
         return self
 
     def stop(self, timeout=5.0):
@@ -116,6 +135,123 @@ class ReplicaSync:
             self._wake.wait(interval)
             self._wake.clear()
 
+    # -- the event-stream subscription ---------------------------------------
+
+    def _subscribe_run(self):
+        """Long-poll the primary's ``/api/v1/events``: every announced
+        push kicks the sync loop immediately, cutting replication lag from
+        the poll period to the fan-out latency. An old primary without the
+        endpoint drops us back to pure polling (the loop above keeps
+        running either way); repeated transport failures do the same —
+        the subscription is an accelerator, never a dependency."""
+        from kart_tpu.events.stream import (
+            EventStreamUnsupported,
+            fetch_events,
+            iter_events,
+        )
+
+        try:
+            head = int(fetch_events(self.primary_url).get("head", 0))
+            with self._lock:
+                self._sub_active = True
+                self._sub_baseline = (head, time.monotonic())
+            for event in iter_events(
+                self.primary_url, since=head, poll_seconds=15.0
+            ):
+                if self._stop.is_set():
+                    return
+                seq = int(event.get("seq", 0))
+                with self._lock:
+                    self._pending_events.append(
+                        (seq, event.get("ref"), event.get("new"))
+                    )
+                tm.incr("fleet.event_kicks")
+                self.kick()
+        except EventStreamUnsupported as e:
+            L.info("replica events subscription unavailable (%s); polling", e)
+        except Exception as e:
+            L.warning(
+                "replica events subscription against %s dropped: %s",
+                self.primary_url, e,
+            )
+        finally:
+            with self._lock:
+                self._sub_active = False
+
+    def subscribed(self):
+        """Is the event subscription live (the sequence pin's
+        precondition)?"""
+        with self._lock:
+            return self._sub_active
+
+    def applied_seq(self):
+        """The highest primary event sequence this replica has provably
+        applied (refs advanced at least that far)."""
+        with self._lock:
+            return self._applied_seq
+
+    def _mark_applied(self, cycle_started):
+        """After a successful sync cycle: advance ``applied_seq`` over the
+        received events whose transitions are now locally visible, in
+        order (a not-yet-visible event stops the scan — sequences are a
+        watermark, not a set)."""
+        from kart_tpu.transport.service import _commit_contains
+
+        with self._lock:
+            pending = list(self._pending_events)
+            baseline = self._sub_baseline
+        applied = 0
+        high = 0
+        for seq, ref, new in pending:
+            if not ref:
+                applied += 1
+                high = seq
+                continue
+            tip = self.repo.refs.get(ref)
+            if new is None:
+                visible = tip is None
+            else:
+                visible = tip is not None and _commit_contains(
+                    self.repo, tip, new
+                )
+            if not visible:
+                break
+            applied += 1
+            high = seq
+        with self._lock:
+            for _ in range(applied):
+                self._pending_events.popleft()
+            if high:
+                self._applied_seq = max(self._applied_seq, high)
+            if (
+                baseline is not None
+                and cycle_started > baseline[1]
+                and baseline[0] > self._applied_seq
+            ):
+                # every event announced before the handshake had its refs
+                # landed before this cycle's advertisement was read — the
+                # cycle completing proves the baseline head is applied
+                self._applied_seq = baseline[0]
+        if applied or baseline is not None:
+            self._advanced.set()
+            self._advanced.clear()
+
+    def wait_for_seq(self, seq, timeout):
+        """Stall until ``applied_seq`` reaches ``seq``, kicking the sync
+        loop; -> True when it does, False at the deadline (the router pins
+        the read to the primary instead). The sequence twin of
+        :meth:`wait_for_commit`: one integer compare per wake instead of
+        an ancestry walk."""
+        deadline = time.monotonic() + max(0.0, timeout)
+        while True:
+            if self.applied_seq() >= seq:
+                return True
+            remaining = deadline - time.monotonic()
+            if remaining <= 0:
+                return False
+            self.kick()
+            self._advanced.wait(min(remaining, 0.1))
+
     # -- the protocol --------------------------------------------------------
 
     def _client(self):
@@ -146,6 +282,7 @@ class ReplicaSync:
         )
 
         t0 = time.perf_counter()
+        t_start = time.monotonic()
         repo = self.repo
         net = self._client()
         with tm.span("fleet.sync_cycle"):
@@ -220,6 +357,9 @@ class ReplicaSync:
             self._cycles += 1
             self._last_sync_ok = time.time()
             self._last_error = None
+        # the sequence watermark for read-your-writes pins: events whose
+        # transitions this cycle made visible are now applied
+        self._mark_applied(t_start)
         tm.incr("fleet.sync_cycles")
         tm.observe("fleet.sync_seconds", elapsed)
         # staleness bound after this cycle: everything the primary
@@ -277,6 +417,8 @@ class ReplicaSync:
             return {
                 "cycles": self._cycles,
                 "errors": self._errors,
+                "subscribed": self._sub_active,
+                "applied_seq": self._applied_seq,
                 "last_sync_ok": self._last_sync_ok,
                 "last_sync_utc": (
                     time.strftime(

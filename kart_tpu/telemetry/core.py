@@ -61,6 +61,7 @@ SUBSYSTEMS = frozenset(
         "server",    # concurrent-serving machinery (enum cache, shedding)
         "tiles",     # tile read-serving (pruning, cache, encode, export)
         "fleet",     # replication sync, write proxying, peer cache tier
+        "events",    # live-update CDC, event log, warm-then-announce
         "importer",  # bulk import phases
         "runtime",   # backend probe, watchdogs
         "wc",        # working copies

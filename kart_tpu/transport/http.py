@@ -18,6 +18,12 @@ Endpoints (all JSON unless noted):
 
     GET  <base>/api/v1/refs
         -> {"heads": {...}, "tags": {...}, "head_branch": ..., "shallow": [...]}
+    GET  <base>/api/v1/events[?since=N][&timeout=S][&stream=sse]
+        -> the live-update subscription surface (docs/EVENTS.md §5):
+        long-poll (or SSE) for announced ref transitions with their exact
+        per-dataset dirty-tile summaries, resume-by-sequence. Without
+        ``since`` it is the subscribe handshake (current head, no wait).
+        Behind the shed lane; ``KART_SERVE_EVENTS=0`` disables (404).
     GET  <base>/api/v1/tiles/<ref>/<dataset>/<z>/<x>/<y>[?layers=bin,geojson]
         -> one framed tile payload (docs/TILES.md): vector tile of the
         named ref's commit, served straight off the columnar sidecar —
@@ -55,6 +61,7 @@ import json
 import os
 import re
 import struct
+import sys
 import tempfile
 import threading
 import time
@@ -382,6 +389,7 @@ class KartRequestHandler(BaseHTTPRequestHandler):
     _VERBS = {
         f"{API}/stats": "stats",
         f"{API}/refs": "ls-refs",
+        f"{API}/events": "events",
         f"{API}/fetch-pack": "fetch-pack",
         f"{API}/fetch-blobs": "fetch-blobs",
         f"{API}/receive-pack": "receive-pack",
@@ -460,6 +468,8 @@ class KartRequestHandler(BaseHTTPRequestHandler):
                     return  # read pinned to the primary; already answered
                 if path == f"{API}/refs":
                     return self._handle_refs()
+                if path == f"{API}/events":
+                    return self._handle_events()
                 if path.startswith(f"{API}/tiles/"):
                     return self._handle_tile(path)
                 self._json(404, {"error": f"No such endpoint: {self.path}"})
@@ -505,6 +515,20 @@ class KartRequestHandler(BaseHTTPRequestHandler):
             return False
         from kart_tpu import fleet as fleet_mod
 
+        # the sequence pin (docs/EVENTS.md §6) outranks the commit pin
+        # when the event subscription is live: satisfying it is one
+        # integer compare against the applied watermark, no ancestry walk
+        min_seq = (self.headers.get(fleet_mod.MIN_SEQ_HEADER) or "").strip()
+        if min_seq.isdigit() and fleet.sync.subscribed():
+            seq = int(min_seq)
+            if fleet.sync.applied_seq() >= seq:
+                return False  # already applied: serve locally, no stall
+            if fleet.sync.wait_for_seq(seq, fleet_mod.max_lag_seconds()):
+                tm.incr("fleet.ryw_stalls")
+                tm.annotate(ryw="stalled")
+                fleet.note_ryw(pinned=False)
+                return False
+            return self._pin_to_primary(fleet)
         min_commit = self.headers.get(fleet_mod.MIN_COMMIT_HEADER)
         if not min_commit:
             return False
@@ -519,9 +543,13 @@ class KartRequestHandler(BaseHTTPRequestHandler):
             tm.annotate(ryw="stalled")
             fleet.note_ryw(pinned=False)
             return False
-        # the replica cannot catch up inside the lag bound (primary down,
-        # transfer still draining): answer from the primary itself rather
-        # than serve a view the client has proven is stale
+        return self._pin_to_primary(fleet)
+
+    def _pin_to_primary(self, fleet):
+        """The replica cannot catch up inside the lag bound (primary
+        down, transfer still draining): answer from the primary itself
+        rather than serve a view the client has proven is stale.
+        -> True (the request was answered here)."""
         tm.incr("fleet.ryw_pins")
         tm.annotate(ryw="pinned")
         fleet.note_ryw(pinned=True)
@@ -571,6 +599,99 @@ class KartRequestHandler(BaseHTTPRequestHandler):
 
         self._json(200, ls_refs_info(self.repo))
 
+    #: ceiling on one SSE session — turns the inflight slot over so a
+    #: forgotten browser tab can't hold admission forever; the client
+    #: reconnects with its last seen sequence and misses nothing
+    SSE_SESSION_SECONDS = 3600.0
+
+    def _handle_events(self):
+        """``GET /api/v1/events?since=<seq>``: the live-update
+        subscription surface (docs/EVENTS.md §5). Long-poll by default —
+        the response returns as soon as events with a larger sequence are
+        announced, or empty after ``timeout`` seconds; ``stream=sse`` (or
+        ``Accept: text/event-stream``) switches to a server-sent-events
+        stream. Without ``since`` the request is the subscribe handshake:
+        it answers the current head immediately. Behind the shed lane —
+        an invalidation feed is ordinary work, unlike /api/v1/stats."""
+        from urllib.parse import parse_qs
+
+        from kart_tpu import events as events_mod
+
+        if not events_mod.events_enabled():
+            return self._json(
+                404, {"error": "Event serving is disabled on this server"}
+            )
+        tm.incr("transport.server.requests", verb="events")
+        params = parse_qs(urlsplit(self.path).query)
+        emitter = events_mod.emitter_for(self.repo)
+        raw_since = params.get("since", [None])[0]
+        if raw_since is None:
+            # the subscribe handshake: current head, no wait (reconcile
+            # first so a push landed by another process is in the head)
+            emitter.reconcile()
+            return self._json(200, {"events": [], "head": emitter.log.head()})
+        try:
+            since = int(raw_since)
+        except ValueError:
+            return self._json(
+                400, {"error": f"Bad since={raw_since!r} (sequence number)"}
+            )
+        try:
+            timeout = float(params.get("timeout", ["nan"])[0])
+        except ValueError:
+            timeout = events_mod.LONG_POLL_SECONDS
+        if not (0 <= timeout <= events_mod.LONG_POLL_SECONDS):
+            timeout = events_mod.LONG_POLL_SECONDS
+        sse = (
+            params.get("stream", [""])[0] == "sse"
+            or "text/event-stream" in (self.headers.get("Accept") or "")
+        )
+        if sse:
+            return self._events_sse(emitter, since)
+        with emitter.watching():
+            events, head, reset = emitter.wait_events(since, timeout)
+        doc = {"events": events, "head": head, "since": since}
+        if reset is not None:
+            doc["reset"] = reset
+        self._json(200, doc)
+
+    def _events_sse(self, emitter, since):
+        """The SSE variant: one frame per event (``id:`` = sequence, so a
+        reconnecting EventSource resumes by Last-Event-ID semantics on the
+        client side), comment keep-alives while idle."""
+        import logging
+
+        self.send_response(200)
+        self.send_header("Content-Type", "text/event-stream")
+        self.send_header("Cache-Control", "no-store")
+        self.end_headers()
+        # no Content-Length: the stream ends when the connection closes
+        self.close_connection = True
+        deadline = time.monotonic() + self.SSE_SESSION_SECONDS
+        try:
+            with emitter.watching():
+                while time.monotonic() < deadline:
+                    events, head, reset = emitter.wait_events(since, 15.0)
+                    if reset is not None:
+                        self.wfile.write(
+                            f"event: reset\ndata: {reset}\n\n".encode()
+                        )
+                    for event in events:
+                        raw = json.dumps(event, sort_keys=True)
+                        self.wfile.write(
+                            f"id: {event['seq']}\ndata: {raw}\n\n".encode()
+                        )
+                        self._kart_bytes_out += len(raw)
+                    since = max(since, head)
+                    if not events:
+                        self.wfile.write(b": keep-alive\n\n")
+                    self.wfile.flush()
+        except OSError as e:
+            # the normal end of an SSE session: the watcher went away
+            logging.getLogger("kart_tpu.serve").debug(
+                "SSE watcher disconnected: %s", e
+            )
+
     @staticmethod
     def _if_none_match_hits(header_value, etag):
         """RFC 9110 If-None-Match: a comma-separated validator list, each
@@ -613,6 +734,20 @@ class KartRequestHandler(BaseHTTPRequestHandler):
         ref, ds_path = parts[0], "/".join(parts[1:-3])
         z, x, y = parts[-3:]
         tm.annotate(ref=ref, dataset=ds_path, tile=f"{z}/{x}/{y}")
+        # warm-then-announce (docs/EVENTS.md §4): while a push's dirty
+        # tiles are still warming, branch-name requests stay pinned to the
+        # announced (old) tip — the hot tiles keep serving; commit-oid
+        # requests are unaffected (commit-addressed by construction).
+        # sys.modules guard: only a process already running the events
+        # machinery can have a pin to honour
+        events_mod = sys.modules.get("kart_tpu.events")
+        if events_mod is not None and events_mod.events_enabled():
+            emitter = events_mod.active_emitter(self.repo.gitdir)
+            if emitter is not None:
+                pinned = emitter.tile_pin(ref)
+                if pinned is not None:
+                    tm.annotate(tile_pin=True)
+                    ref = pinned
         query = urlsplit(self.path).query
         layers = parse_qs(query).get("layers", [None])[0] if query else None
         try:
@@ -699,6 +834,14 @@ class KartRequestHandler(BaseHTTPRequestHandler):
                 # the fleet operator's staleness view: replication lag,
                 # proxied writes, read-your-writes decisions per replica
                 extra["fleet"] = fleet.status_dict()
+            # the live-update operator view (docs/EVENTS.md §7): connected
+            # watchers, log head, last fan-out latency, warm queue depth —
+            # present once any watcher/push has touched the events path
+            events_mod = sys.modules.get("kart_tpu.events")
+            if events_mod is not None and events_mod.events_enabled():
+                emitter = events_mod.active_emitter(self.repo.gitdir)
+                if emitter is not None:
+                    extra["events"] = emitter.status_dict()
             return self._json(200, rq_access.stats_payload(extra=extra))
         raw = sinks.prometheus_text().encode()
         self.send_response(200)
@@ -1009,6 +1152,10 @@ class HttpRemote:
         # client carry it so the replica stalls (or pins to the primary)
         # until its view contains the pushed commit
         self._min_commit = None
+        # the sequence twin (docs/EVENTS.md §6): the push's booked
+        # live-update event sequence — a subscribed replica satisfies the
+        # pin with an integer compare instead of an ancestry walk
+        self._min_seq = None
 
     def close(self):
         """No persistent connection; symmetric with StdioRemote so callers
@@ -1027,12 +1174,23 @@ class HttpRemote:
             return {}
         return {rq_context.TRACEPARENT_HEADER: traceparent}
 
+    def _pin_headers(self):
+        """The read-your-writes pin headers (commit containment + event
+        sequence) every read after a proxied push carries."""
+        if self._min_commit is None and self._min_seq is None:
+            return {}
+        from kart_tpu import fleet as fleet_mod
+
+        headers = {}
+        if self._min_commit is not None:
+            headers[fleet_mod.MIN_COMMIT_HEADER] = self._min_commit
+        if self._min_seq is not None:
+            headers[fleet_mod.MIN_SEQ_HEADER] = str(self._min_seq)
+        return headers
+
     def _get(self, path):
         headers = self._trace_headers()
-        if self._min_commit is not None:
-            from kart_tpu import fleet as fleet_mod
-
-            headers[fleet_mod.MIN_COMMIT_HEADER] = self._min_commit
+        headers.update(self._pin_headers())
         try:
             req = Request(self.base + path, headers=headers)
             with urlopen(req, timeout=http_timeout()) as resp:
@@ -1061,14 +1219,11 @@ class HttpRemote:
             "Content-Type": "application/x-kartpack" if raw else "application/json"
         }
         all_headers.update(self._trace_headers())
-        if self._min_commit is not None:
-            from kart_tpu import fleet as fleet_mod
-
-            # the POST data-fetch verbs must carry the read-your-writes
-            # pin too: a pinned ls-refs advertising the new tip followed
-            # by an ungated fetch-pack from the stale store would fail on
-            # exactly the objects the pin exists to guarantee
-            all_headers[fleet_mod.MIN_COMMIT_HEADER] = self._min_commit
+        # the POST data-fetch verbs must carry the read-your-writes pins
+        # too: a pinned ls-refs advertising the new tip followed by an
+        # ungated fetch-pack from the stale store would fail on exactly
+        # the objects the pin exists to guarantee
+        all_headers.update(self._pin_headers())
         if headers:
             all_headers.update(headers)
         body = data if raw else json.dumps(data).encode()
@@ -1293,4 +1448,10 @@ class HttpRemote:
             landed = fleet_router.landed_head_oids(payload)
             if landed:
                 self._min_commit = landed[-1]
+            seq = payload.get("event_seq")
+            if isinstance(seq, int) and seq > 0:
+                # the sequence pin: set alongside the commit pin so a
+                # subscribed replica takes the integer fast path and an
+                # old replica still honours the containment pin
+                self._min_seq = max(self._min_seq or 0, seq)
         return payload

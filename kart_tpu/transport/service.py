@@ -1008,8 +1008,16 @@ def _land_quarantined(repo, q, header, thread_lock):
                                     rebased=True,
                                     rebase_mode=info.get("mode"),
                                 )
-                            updated = _apply_validated_updates(repo, header)
-                            return "ok", {"updated": updated, "rebase": info}
+                            out = {}
+                            updated = _apply_validated_updates(
+                                repo, header, out
+                            )
+                            payload = {"updated": updated, "rebase": info}
+                            # the booked live-update sequence (absent on
+                            # non-serving processes / events off): a
+                            # read-your-writes client pins on it
+                            payload.update(out)
+                            return "ok", payload
                         current = (
                             repo.refs.get(upd["ref"]) if upd is not None else None
                         )
@@ -1155,20 +1163,29 @@ def validate_ref_updates(repo, header, *, contains=None):
     return None
 
 
-def _apply_validated_updates(repo, header):
-    """Apply pre-validated ref updates; -> {ref: oid|None}."""
+def _apply_validated_updates(repo, header, out=None):
+    """Apply pre-validated ref updates; -> {ref: oid|None}. ``out`` (a
+    dict) receives ``event_seq`` when the live-update subsystem booked an
+    event for the transition (docs/EVENTS.md §3) — the receive payload
+    carries it so read-your-writes clients can pin on a sequence."""
+    import sys
+
     from kart_tpu.transport.remote import _update_shallow
 
     updated = {}
+    changes = []
     for upd in header.get("updates", []):
         ref, new = upd["ref"], upd.get("new")
+        prev = repo.refs.get(ref)
         if new is None:
-            if repo.refs.get(ref) is not None:
+            if prev is not None:
                 repo.refs.delete(ref)
             updated[ref] = None
         else:
             repo.refs.set(ref, new, log_message="push")
             updated[ref] = new
+        if prev != new:
+            changes.append((ref, prev, new))
     if header.get("shallow"):
         _update_shallow(repo, header["shallow"])
     # a ref moved: enumeration keys embed the ref fingerprint so new
@@ -1178,15 +1195,31 @@ def _apply_validated_updates(repo, header):
         cache = _ENUM_CACHES.get(os.path.realpath(repo.gitdir))
     if cache is not None:
         cache.invalidate()
+    # live-update events (docs/EVENTS.md): book the CDC emission for this
+    # transition. sys.modules guard like the tile drop below — only a
+    # serving process ever constructs an emitter, and a plain push target
+    # must not pay the package import
+    events_mod = sys.modules.get("kart_tpu.events")
+    emitter_active = (
+        events_mod is not None
+        and events_mod.events_enabled()
+        and events_mod.active_emitter(repo.gitdir) is not None
+    )
+    if emitter_active:
+        seq = events_mod.notify_ref_updates(repo, changes)
+        if seq is not None and out is not None:
+            out["event_seq"] = seq
     # tile-cache keys are commit-pinned and can never go stale, but tiles
     # of a commit a ref just moved away from are probably dead weight —
     # the explicit drop hook releases their budget now (docs/TILES.md §3).
+    # EXCEPT under an active event emitter: the warm-then-announce
+    # protocol (docs/EVENTS.md §4) keeps serving the old tip's tiles until
+    # the new tip's dirty tiles are pre-warmed, so dropping them here
+    # would be the exact cold-tile storm the warmer exists to prevent.
     # sys.modules guard: a process that never imported the tiles machinery
     # cannot hold tile caches, and a push must not pay the package import
-    import sys
-
     tiles_cache = sys.modules.get("kart_tpu.tiles.cache")
-    if tiles_cache is not None:
+    if tiles_cache is not None and not emitter_active:
         tiles_cache.invalidate_tile_caches(repo.gitdir)
     return updated
 

@@ -311,6 +311,24 @@ class StdioRemote:
                 on_retry=self.reset,
             )
 
+    def events(self, since=None, *, timeout=5.0):
+        """One live-update events poll (the ``events`` op;
+        docs/EVENTS.md §5): -> the response document (``events``/``head``
+        and optional ``reset``). ``since=None`` is the subscribe
+        handshake (current head, no wait)."""
+        frame = {"op": "events", "timeout": timeout}
+        if since is not None:
+            frame["since"] = int(since)
+        with rq_context.request_scope(verb="events"):
+            resp = self.retry.call(
+                lambda: self._rpc(frame)[0],
+                label="events",
+                on_retry=self.reset,
+            )
+        if resp.get("error"):
+            raise StdioTransportError(resp["error"])
+        return resp
+
     def fetch_pack(self, dst_repo, wants, *, haves=(), have_shallow=(),
                    depth=None, filter_spec=None, exclude=None):
         from kart_tpu.transport.retry import drain_pack_salvaging, exclude_arg
@@ -402,6 +420,7 @@ class StdioRemote:
 _STDIO_VERBS = {
     "refs": "ls-refs",
     "stats": "stats",
+    "events": "events",
     "fetch-pack": "fetch-pack",
     "fetch-blobs": "fetch-blobs",
     "receive-pack": "receive-pack",
@@ -518,9 +537,69 @@ def serve_stdio(repo, in_fp, out_fp):
                                 "transport.server.requests", verb="stats"
                             )
                             if header.get("format") == "json":
-                                respond({"stats": rq_access.stats_payload()})
+                                import sys as _sys
+
+                                extra = {}
+                                events_mod = _sys.modules.get(
+                                    "kart_tpu.events"
+                                )
+                                if (
+                                    events_mod is not None
+                                    and events_mod.events_enabled()
+                                ):
+                                    emitter = events_mod.active_emitter(
+                                        repo.gitdir
+                                    )
+                                    if emitter is not None:
+                                        extra["events"] = (
+                                            emitter.status_dict()
+                                        )
+                                respond(
+                                    {
+                                        "stats": rq_access.stats_payload(
+                                            extra=extra
+                                        )
+                                    }
+                                )
                             else:
                                 respond({"metrics": sinks.prometheus_text()})
+                        elif op == "events":
+                            # the stdio twin of GET /api/v1/events
+                            # (docs/EVENTS.md §5): resume-by-sequence with
+                            # a bounded wait — each ssh exchange is one
+                            # poll; true long-holding streams are the HTTP
+                            # transport's job
+                            from kart_tpu import events as events_mod
+
+                            tm.incr(
+                                "transport.server.requests", verb="events"
+                            )
+                            if not events_mod.events_enabled():
+                                status = "error"
+                                respond({"error": "Event serving is "
+                                                  "disabled on this server"})
+                            else:
+                                emitter = events_mod.emitter_for(repo)
+                                since = header.get("since")
+                                if since is None:
+                                    emitter.reconcile()
+                                    respond({"events": [],
+                                             "head": emitter.log.head()})
+                                else:
+                                    try:
+                                        wait_s = min(
+                                            float(header.get("timeout", 5.0)),
+                                            events_mod.LONG_POLL_SECONDS,
+                                        )
+                                    except (TypeError, ValueError):
+                                        wait_s = 5.0
+                                    evs, head, reset = emitter.wait_events(
+                                        int(since), max(0.0, wait_s)
+                                    )
+                                    frame = {"events": evs, "head": head}
+                                    if reset is not None:
+                                        frame["reset"] = reset
+                                    respond(frame)
                         elif op == "fetch-pack":
                             # same code path and counters as the HTTP
                             # server, but uncached: a serve-stdio process

@@ -239,6 +239,27 @@ NEW_KEYS += [
     "fleet_replicas_converged_identical",
 ]
 
+#: ISSUE 14 — bench.py --live (live-update events; BENCH_r14)
+NEW_KEYS += [
+    "live_rows",
+    "live_watchers",
+    "live_pushes",
+    "live_synth_seconds",
+    "live_watchers_served",
+    "live_events_total",
+    "live_invalidation_p99_seconds",
+    "live_invalidation_mean_seconds",
+    "live_warm_requests",
+    "live_warm_hit_rate",
+    "live_warm_cold_encodes",
+    "live_dirty_tiles_exact_events",
+    "live_dirty_tiles_exact",
+    "live_replica_lag_p99_seconds",
+    "live_replica_lag_mean_seconds",
+    "live_replica_lag_vs_polled_p99",
+    "live_replica_lag_beats_polled",
+]
+
 
 def test_bench_emits_every_recorded_key():
     with open(os.path.join(REPO_ROOT, "bench.py")) as f:

@@ -1,0 +1,871 @@
+"""Live-update events (ISSUE 14; docs/EVENTS.md): CDC dirty-tile
+exactness against full re-encodes, event-log resume-by-sequence, the
+warm-then-announce protocol, long-poll/SSE serving, missed-emission
+replay across a server restart (including a real SIGKILL), and the fleet
+subscription legs (event-kicked replication lag, read-your-writes by
+sequence)."""
+
+import json
+import os
+import random
+import signal
+import socket
+import subprocess
+import sys
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+from kart_tpu import events as events_mod
+from kart_tpu import telemetry, tiles
+from kart_tpu.core.repo import KartRepo
+from kart_tpu.events import cdc
+from kart_tpu.events.log import EventLog
+from kart_tpu.tiles.encode import encode_tile, parse_payload
+from kart_tpu.tiles.grid import tile_range_for_bbox
+from kart_tpu.transport.http import HttpRemote, make_server
+
+from helpers import edit_commit, gpkg_point, make_imported_repo
+from kart_tpu.geometry import Geometry
+
+
+def gpoint(x, y):
+    return Geometry(gpkg_point(x, y))
+
+
+@pytest.fixture(autouse=True)
+def _fresh_state(monkeypatch):
+    telemetry.reset()
+    for var in (
+        "KART_FAULTS",
+        "KART_SERVE_EVENTS",
+        "KART_EVENTS_LOG_SIZE",
+        "KART_EVENTS_WARM_BUDGET",
+        "KART_WATCH_TIMEOUT",
+        "KART_TILE_CACHE",
+        "KART_REPLICA_OF",
+        "KART_PEER_CACHE",
+    ):
+        monkeypatch.delenv(var, raising=False)
+    monkeypatch.setenv("KART_TRANSPORT_RETRY_BASE", "0.01")
+    monkeypatch.setenv("KART_TRANSPORT_RETRY_CAP", "0.05")
+    yield
+    events_mod.drop_emitters()
+    telemetry.reset()
+
+
+def wait_for(pred, timeout=30.0, interval=0.02, what="condition"):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        value = pred()
+        if value:
+            return value
+        time.sleep(interval)
+    raise AssertionError(f"timed out waiting for {what}")
+
+
+def serve_in_thread(repo, fleet=None):
+    server = make_server(repo, fleet=fleet)
+    threading.Thread(target=server.serve_forever, daemon=True).start()
+    return server, f"http://127.0.0.1:{server.server_address[1]}"
+
+
+def get_json(url, timeout=40):
+    with urllib.request.urlopen(url, timeout=timeout) as resp:
+        return json.loads(resp.read().decode())
+
+
+def gauge(name):
+    for n, _labels, v in telemetry.snapshot()["gauges"]:
+        if n == name:
+            return v
+    return None
+
+
+# ---------------------------------------------------------------------------
+# CDC exactness: the dirty-tile set equals the payload-content diff
+# ---------------------------------------------------------------------------
+
+
+def payload_content(repo, commit_oid, ds_path, z, x, y):
+    """(header minus the pinned commit, layer bytes) — "content" for the
+    exactness property (the header embeds the commit oid by design)."""
+    source = tiles.source_for(repo, commit_oid, ds_path)
+    payload, _stats = encode_tile(source, z, x, y, max_features=0)
+    header, layers = parse_payload(payload)
+    header.pop("commit")
+    return header, layers
+
+
+def brute_force_dirty(repo, old_oid, new_oid, ds_path, zooms, pad_tiles=1):
+    """The ground truth: re-encode every candidate tile at both commits
+    and compare content. Candidates per zoom are the (±pad_tiles-margined)
+    range covering the union bbox of every envelope at either commit —
+    tiles outside hold no feature at either commit, so their content is
+    identical by construction."""
+    envs = np.concatenate(
+        [
+            np.asarray(tiles.source_for(repo, oid, ds_path).envelopes(),
+                       dtype=np.float64)
+            for oid in (old_oid, new_oid)
+        ]
+    )
+    finite = envs[np.isfinite(envs).all(axis=1)]
+    full_world = len(finite) < len(envs)
+    bbox = (
+        (-180.0, -90.0, 180.0, 90.0)
+        if full_world or not len(finite)
+        else (
+            float(finite[:, 0].min()), float(finite[:, 1].min()),
+            float(finite[:, 2].max()), float(finite[:, 3].max()),
+        )
+    )
+    dirty = {z: set() for z in zooms}
+    for z in zooms:
+        n = 1 << z
+        x0, y0, x1, y1 = tile_range_for_bbox(z, bbox)
+        x0, y0 = max(0, x0 - pad_tiles), max(0, y0 - pad_tiles)
+        x1, y1 = min(n - 1, x1 + pad_tiles), min(n - 1, y1 + pad_tiles)
+        for x in range(x0, x1 + 1):
+            for y in range(y0, y1 + 1):
+                if payload_content(
+                    repo, old_oid, ds_path, z, x, y
+                ) != payload_content(repo, new_oid, ds_path, z, x, y):
+                    dirty[z].add((x, y))
+    return dirty
+
+
+def cdc_dirty_sets(repo, old_oid, new_oid, ds_path, zooms):
+    summary = cdc.dirty_tiles(repo, old_oid, new_oid, zooms=zooms)
+    entry = summary.get(ds_path)
+    if entry is None:
+        return {z: set() for z in zooms}
+    assert entry["truncated"] is False
+    return {
+        z: {tuple(t) for t in entry["tiles"].get(str(z), [])} for z in zooms
+    }
+
+
+def random_edits(rng, live_fids, next_fid, region):
+    """A random mixed edit: inserts (new points), geometry moves,
+    attribute-only updates (same envelope, changed blob — the geojson
+    exactness case), deletes."""
+    w, s, e, n = region
+
+    def point():
+        return gpoint(rng.uniform(w, e), rng.uniform(s, n))
+
+    committed = list(live_fids)  # fids that exist at the current tip
+    inserts = []
+    for _ in range(rng.randrange(0, 3)):
+        inserts.append(
+            {"fid": next_fid, "geom": point(),
+             "name": f"new{next_fid}", "rating": rng.random()}
+        )
+        live_fids.append(next_fid)
+        next_fid += 1
+    updates = []
+    for fid in rng.sample(committed, min(len(committed), rng.randrange(1, 4))):
+        if rng.random() < 0.4:
+            # attribute-only: envelope identical, oid changes
+            updates.append(
+                {"fid": fid, "geom": None, "name": f"attr{fid}",
+                 "rating": rng.random()}
+            )
+        else:
+            updates.append(
+                {"fid": fid, "geom": point(), "name": f"moved{fid}",
+                 "rating": rng.random()}
+            )
+    deletes = []
+    candidates = [f for f in committed if not any(
+        u["fid"] == f for u in updates)]
+    for fid in rng.sample(candidates, min(len(candidates),
+                                          rng.randrange(0, 2))):
+        deletes.append(fid)
+        live_fids.remove(fid)
+    return inserts, updates, deletes, next_fid
+
+
+def test_cdc_dirty_tiles_exact_random_edits(tmp_path):
+    """The acceptance property: for random edit commits, the CDC set ==
+    the set of tiles whose payload content actually differs — checked in
+    BOTH directions (superset-free and subset-free) against a full
+    re-encode of every candidate tile."""
+    repo, ds_path = make_imported_repo(tmp_path, n=40)
+    rng = random.Random(1234)
+    zooms = tuple(range(0, 6))
+    live_fids = list(range(1, 41))
+    next_fid = 1000
+    region = (100.0, -46.0, 141.0, -34.0)  # the fixture's point spread
+
+    tip = repo.refs.get("refs/heads/main")
+    for round_no in range(4):
+        inserts, updates, deletes, next_fid = random_edits(
+            rng, live_fids, next_fid, region
+        )
+        new_tip = edit_commit(
+            repo, ds_path, inserts=inserts, updates=updates,
+            deletes=deletes, message=f"random edit {round_no}",
+        )
+        got = cdc_dirty_sets(repo, tip, new_tip, ds_path, zooms)
+        want = brute_force_dirty(repo, tip, new_tip, ds_path, zooms)
+        assert got == want, f"round {round_no}: CDC != re-encode diff"
+        assert any(want.values())  # the rounds actually dirty something
+        tip = new_tip
+
+
+def test_cdc_exact_on_null_geometry_polar_and_antimeridian(tmp_path):
+    """The fail-open/edge geometry cases: a NULL-geometry feature
+    (full-world envelope — in every tile), a polar point (served by the
+    clamped edge row), an anti-meridian-hugging point."""
+    repo, ds_path = make_imported_repo(tmp_path, n=6)
+    zooms = tuple(range(0, 4))
+    tip = repo.refs.get("refs/heads/main")
+
+    steps = [
+        # insert a NULL-geometry row: every tile's geojson layer changes
+        dict(inserts=[{"fid": 900, "geom": None, "name": "null", "rating": 0.1}]),
+        # polar + antimeridian inserts
+        dict(inserts=[
+            {"fid": 901, "geom": gpoint(12.0, 88.5), "name": "polar",
+             "rating": 0.2},
+            {"fid": 902, "geom": gpoint(179.999, -30.0), "name": "am",
+             "rating": 0.3},
+        ]),
+        # touch the NULL-geometry row's attributes only
+        dict(updates=[{"fid": 900, "geom": None, "name": "null2",
+                       "rating": 0.4}]),
+        # delete the polar row
+        dict(deletes=[901]),
+    ]
+    for i, step in enumerate(steps):
+        new_tip = edit_commit(repo, ds_path, message=f"edge {i}", **step)
+        got = cdc_dirty_sets(repo, tip, new_tip, ds_path, zooms)
+        want = brute_force_dirty(repo, tip, new_tip, ds_path, zooms)
+        assert got == want, f"step {i}: CDC != re-encode diff"
+        tip = new_tip
+
+
+def test_cdc_skips_identical_datasets_and_counts_changes(tmp_path):
+    repo, ds_path = make_imported_repo(tmp_path, n=8)
+    tip = repo.refs.get("refs/heads/main")
+    assert cdc.dirty_tiles(repo, tip, tip) == {}
+    new_tip = edit_commit(
+        repo, ds_path,
+        inserts=[{"fid": 500, "geom": gpoint(170.0, -40.0),
+                  "name": "a", "rating": 1.0}],
+        deletes=[1],
+        message="one in one out",
+    )
+    summary = cdc.dirty_tiles(repo, tip, new_tip)
+    entry = summary[ds_path]
+    assert entry["changed"] == {"inserts": 1, "deletes": 1}
+    assert entry["tile_count"] > 0 and entry["bbox"] is not None
+
+
+def test_cdc_derives_pushed_tip_sidecar_o_changed(tmp_path):
+    """A pushed tip arrives with no sidecar: the CDC must derive it from
+    the old tip's via the tree delta (no O(N) rebuild) and produce the
+    same exact dirty set — and the derived file then serves the tile
+    source too."""
+    from kart_tpu.diff import sidecar
+    from kart_tpu.tiles.source import drop_sources
+
+    repo, ds_path = make_imported_repo(tmp_path, n=30)
+    tip = repo.refs.get("refs/heads/main")
+    new_tip = edit_commit(
+        repo, ds_path,
+        inserts=[{"fid": 700, "geom": gpoint(105.0, -38.0),
+                  "name": "pushed", "rating": 1.0}],
+        updates=[{"fid": 3, "geom": gpoint(130.0, -44.0),
+                  "name": "moved", "rating": 2.0}],
+        deletes=[7],
+        message="simulated push",
+    )
+    zooms = tuple(range(0, 5))
+    want = brute_force_dirty(repo, tip, new_tip, ds_path, zooms)
+    # simulate the server-side state after a push: the new tree's sidecar
+    # does not exist locally (commit_diff derived one — delete it)
+    new_ds = repo.structure(new_tip).datasets[ds_path]
+    path = sidecar.sidecar_file(repo, new_ds.feature_tree.oid)
+    if os.path.exists(path):
+        os.remove(path)
+    drop_sources(repo.gitdir)
+    got = cdc_dirty_sets(repo, tip, new_tip, ds_path, zooms)
+    assert got == want
+    # the derivation ran (the sidecar exists again, content-addressed),
+    # and it carried the envelope columns when the old one had them
+    assert os.path.exists(path)
+    derived = sidecar.load_block(repo, new_ds, pad=False)
+    old_ds = repo.structure(tip).datasets[ds_path]
+    old_block = sidecar.load_block(repo, old_ds, pad=False)
+    assert derived.count == old_block.count  # +1 insert -1 delete
+    assert (derived.envelopes is not None) == (
+        old_block.envelopes is not None
+    )
+
+
+def test_tiles_for_envelopes_cap_reports_incomplete_enumeration():
+    """The cap must mark the result incomplete even when dedup collapses
+    the enumerated tiles below it — otherwise a dirty set missing
+    un-enumerated ranges would publish as exact and a subscriber would
+    keep serving a stale tile forever."""
+    z = 8
+    # 5000 identical tiny envelopes (all one tile) + one far-away one
+    # that the capped enumeration never reaches
+    same = np.tile(np.array([[10.0, 10.0, 10.01, 10.01]]), (5000, 1))
+    far = np.array([[120.0, -40.0, 120.01, -39.99]])
+    envs = np.concatenate([same, far])
+    addrs, count, capped = cdc.tiles_for_envelopes(z, envs, cap=4096)
+    assert capped is True  # enumeration stopped early: incomplete
+    # and uncapped, both regions are present
+    addrs2, count2, capped2 = cdc.tiles_for_envelopes(z, envs)
+    assert capped2 is False and count2 >= 2
+
+
+def test_tile_cover_ranges_matches_bbox_intersects_brute():
+    """The cover math vs the reference predicate, over adversarial
+    envelopes: exact tile-edge touches, the anti-meridian seam, wraps,
+    degenerate and polar rects."""
+    from kart_tpu.ops.bbox import bbox_intersects_np
+    from kart_tpu.tiles.grid import tile_cover_wsen
+
+    envs = np.array(
+        [
+            (-180.0, -10.0, -170.0, 10.0),   # west seam touch
+            (170.0, -10.0, 180.0, 10.0),     # east seam touch
+            (0.0, 0.0, 45.0, 45.0),          # exact tile-edge corners
+            (-45.0, -45.0, 0.0, 0.0),
+            (175.0, -5.0, -175.0, 5.0),      # wrapping
+            (10.0, 20.0, 20.0, 10.0),        # degenerate (n < s)
+            (3.0, 86.0, 4.0, 89.0),          # beyond the mercator clamp
+            (-3.0, -89.0, 3.0, -86.0),
+            (7.5, 7.5, 7.5, 7.5),            # point
+        ],
+        dtype=np.float64,
+    )
+    for z in (0, 1, 2, 3, 4):
+        n = 1 << z
+        addrs, _count, _capped = cdc.tiles_for_envelopes(z, envs)
+        got = {tuple(t) for t in addrs.tolist()}
+        want = set()
+        for x in range(n):
+            for y in range(n):
+                cover = tile_cover_wsen(z, x, y)
+                if bbox_intersects_np(envs, np.asarray(cover)).any():
+                    want.add((x, y))
+        assert got == want, f"zoom {z}"
+
+
+# ---------------------------------------------------------------------------
+# the event log: sequences, resume, torn lines, rotation
+# ---------------------------------------------------------------------------
+
+
+def _event(seq, ref="refs/heads/main", new="b" * 40, old="a" * 40):
+    return {"seq": seq, "ref": ref, "old": old, "new": new,
+            "ts": 0.0, "cas_ts": 0.0, "dirty": None, "warm": None}
+
+
+def test_event_log_append_since_and_reload(tmp_path):
+    gitdir = str(tmp_path)
+    log = EventLog(gitdir, max_events=100)
+    assert log.head() == 0 and log.since(0) == ([], 0, None)
+    for seq in (1, 2, 3):
+        log.append_event(_event(seq, new=f"{seq:040x}"))
+    events, head, reset = log.since(1)
+    assert head == 3 and reset is None
+    assert [e["seq"] for e in events] == [2, 3]
+    assert log.tips() == {"refs/heads/main": f"{3:040x}"}
+    # a fresh instance (a restarted server) reloads identically
+    log2 = EventLog(gitdir, max_events=100)
+    assert log2.head() == 3
+    assert log2.tips() == log.tips()
+
+
+def test_event_log_ignores_torn_trailing_line(tmp_path):
+    log = EventLog(str(tmp_path), max_events=100)
+    log.append_event(_event(1))
+    log.append_event(_event(2, new="c" * 40))
+    # a kill mid-append leaves a torn tail: that event was NOT announced
+    with open(log.path, "ab") as f:
+        f.write(b'{"seq": 3, "ref": "refs/heads/main", "new"')
+    log2 = EventLog(str(tmp_path), max_events=100)
+    assert log2.head() == 2
+    assert log2.tips() == {"refs/heads/main": "c" * 40}
+
+
+def test_event_log_retention_reset_marker_and_rotation(tmp_path):
+    log = EventLog(str(tmp_path), max_events=5)
+    for seq in range(1, 21):
+        log.append_event(_event(seq, new=f"{seq:040x}"))
+    events, head, reset = log.since(2)
+    assert head == 20
+    assert reset == log.oldest() - 1 and reset is not None
+    assert [e["seq"] for e in events] == list(
+        range(log.oldest(), 21)
+    )
+    # the file itself was rotated down (bounded on disk, not just memory)
+    with open(log.path, "rb") as f:
+        lines = [l for l in f.read().split(b"\n") if l.strip()]
+    # rotation keeps the file bounded (≈2x the retention target between
+    # rewrites), never the full 20-event history
+    assert len(lines) < 16
+    # deep-past resume on a fresh instance reports the same reset
+    log2 = EventLog(str(tmp_path), max_events=5)
+    _events2, head2, reset2 = log2.since(0)
+    assert head2 == 20 and reset2 is not None
+
+
+def test_emitter_books_announces_and_reconciles(tmp_path):
+    repo, ds_path = make_imported_repo(tmp_path, n=6)
+    emitter = events_mod.emitter_for(repo)
+    # first boot adopts the existing tip silently
+    assert emitter.log.head() == 0
+    assert emitter.reconcile() == 0
+    oid = edit_commit(
+        repo, ds_path,
+        updates=[{"fid": 1, "geom": None, "name": "x", "rating": 1.0}],
+        message="e1",
+    )
+    assert emitter.reconcile() == 1
+    wait_for(lambda: emitter.log.head() == 1, what="announce")
+    events, head, _reset = emitter.events_since(0)
+    assert events[0]["new"] == oid and events[0]["replay"] is True
+    assert events[0]["dirty"][ds_path]["changed"] == {"updates": 1}
+    # a restarted emitter over the same gitdir sees the announced state
+    events_mod.drop_emitters(repo.gitdir)
+    emitter2 = events_mod.emitter_for(repo)
+    assert emitter2.log.head() == 1
+    assert emitter2.reconcile() == 0
+
+
+# ---------------------------------------------------------------------------
+# the HTTP surface: long-poll, resume, SSE, stats block
+# ---------------------------------------------------------------------------
+
+
+def test_long_poll_fanout_resume_and_stats(tmp_path):
+    repo, ds_path = make_imported_repo(tmp_path, n=8)
+    server, url = serve_in_thread(repo)
+    try:
+        doc = get_json(f"{url}/api/v1/events")
+        assert doc == {"events": [], "head": 0}
+        results = {}
+
+        def watcher():
+            results["doc"] = get_json(f"{url}/api/v1/events?since=0&timeout=20")
+
+        t = threading.Thread(target=watcher, daemon=True)
+        t.start()
+        time.sleep(0.3)  # the watcher is parked in its long poll
+        oid = edit_commit(
+            repo, ds_path,
+            updates=[{"fid": 2, "geom": None, "name": "y", "rating": 2.0}],
+            message="push-equivalent",
+        )
+        t.join(timeout=30)
+        assert not t.is_alive()
+        doc = results["doc"]
+        assert doc["head"] == 1 and doc["events"][0]["new"] == oid
+        assert doc["events"][0]["warm"] is not None
+        # resume-by-sequence: since=1 blocks (nothing new), since=0 replays
+        replay = get_json(f"{url}/api/v1/events?since=0&timeout=0")
+        assert [e["seq"] for e in replay["events"]] == [1]
+        empty = get_json(f"{url}/api/v1/events?since=1&timeout=0.2")
+        assert empty["events"] == [] and empty["head"] == 1
+        # the stats document gained the events block
+        stats = get_json(f"{url}/api/v1/stats?format=json")
+        ev = stats["events"]
+        assert ev["head_seq"] == 1
+        assert ev["watchers"] == 0
+        assert ev["last_fanout_seconds"] is not None
+    finally:
+        server.shutdown()
+        server.server_close()
+
+
+def test_sse_stream_delivers_frames(tmp_path):
+    repo, ds_path = make_imported_repo(tmp_path, n=6)
+    server, url = serve_in_thread(repo)
+    try:
+        get_json(f"{url}/api/v1/events")  # create the emitter
+        oid = edit_commit(
+            repo, ds_path,
+            updates=[{"fid": 1, "geom": None, "name": "s", "rating": 3.0}],
+            message="sse",
+        )
+        req = urllib.request.Request(
+            f"{url}/api/v1/events?since=0&stream=sse"
+        )
+        with urllib.request.urlopen(req, timeout=30) as resp:
+            assert resp.headers["Content-Type"] == "text/event-stream"
+            frame = b""
+            while b"\n\n" not in frame:
+                frame += resp.read(1)
+        text = frame.decode()
+        assert text.startswith("id: 1\n")
+        event = json.loads(text.split("data: ", 1)[1].split("\n")[0])
+        assert event["new"] == oid
+    finally:
+        server.shutdown()
+        server.server_close()
+
+
+def test_events_endpoint_disabled(tmp_path, monkeypatch):
+    monkeypatch.setenv("KART_SERVE_EVENTS", "0")
+    repo, _ds = make_imported_repo(tmp_path, n=4)
+    server, url = serve_in_thread(repo)
+    try:
+        with pytest.raises(urllib.error.HTTPError) as exc:
+            get_json(f"{url}/api/v1/events")
+        assert exc.value.code == 404
+    finally:
+        server.shutdown()
+        server.server_close()
+
+
+def test_warm_then_announce_pins_branch_tiles_to_old_tip(
+    tmp_path, monkeypatch
+):
+    """While the warmer runs, branch-name tile requests serve the OLD
+    commit (hot); after the announcement they serve the new tip — and the
+    dirty tile is already warm in the cache."""
+    repo, ds_path = make_imported_repo(tmp_path, n=8)
+    old_tip = repo.refs.get("refs/heads/main")
+    server, url = serve_in_thread(repo)
+    try:
+        get_json(f"{url}/api/v1/events")  # create the emitter
+        release = threading.Event()
+        real_warm = events_mod.warm_dirty_tiles
+
+        def slow_warm(repo_, new_oid, summary, **kw):
+            release.wait(20.0)
+            return real_warm(repo_, new_oid, summary, **kw)
+
+        monkeypatch.setattr(events_mod, "warm_dirty_tiles", slow_warm)
+        new_tip = edit_commit(
+            repo, ds_path,
+            updates=[{"fid": 1, "geom": gpoint(170.0, -40.0),
+                      "name": "moved", "rating": 5.0}],
+            message="warmed push",
+        )
+        emitter = events_mod.active_emitter(repo.gitdir)
+        assert emitter.reconcile() == 1
+        # mid-warm: the branch-name tile answers from the announced tip
+        with urllib.request.urlopen(
+            f"{url}/api/v1/tiles/main/{ds_path}/0/0/0", timeout=30
+        ) as resp:
+            header, _ = parse_payload(resp.read())
+        assert header["commit"] == old_tip
+        release.set()
+        wait_for(lambda: emitter.log.head() == 1, what="announce")
+        with urllib.request.urlopen(
+            f"{url}/api/v1/tiles/main/{ds_path}/0/0/0", timeout=30
+        ) as resp:
+            header, _ = parse_payload(resp.read())
+        assert header["commit"] == new_tip
+        events, _h, _r = emitter.events_since(0)
+        assert events[0]["warm"]["tiles"] > 0  # the dirty set was warmed
+    finally:
+        release.set()
+        server.shutdown()
+        server.server_close()
+
+
+# ---------------------------------------------------------------------------
+# restart / SIGKILL: missed-emission replay + resume-by-sequence
+# ---------------------------------------------------------------------------
+
+
+def test_restarted_server_replays_missed_emission(tmp_path):
+    repo, ds_path = make_imported_repo(tmp_path, n=6)
+    server, url = serve_in_thread(repo)
+    try:
+        get_json(f"{url}/api/v1/events")
+        edit_commit(
+            repo, ds_path,
+            updates=[{"fid": 1, "geom": None, "name": "a", "rating": 1.0}],
+            message="seen",
+        )
+        doc = wait_for(
+            lambda: get_json(f"{url}/api/v1/events?since=0&timeout=5"),
+            what="first event",
+        )
+        assert doc["head"] == 1
+    finally:
+        server.shutdown()
+        server.server_close()
+    # the server "dies"; a push lands while nothing is running
+    events_mod.drop_emitters(repo.gitdir)
+    missed = edit_commit(
+        repo, ds_path,
+        updates=[{"fid": 2, "geom": None, "name": "b", "rating": 2.0}],
+        message="missed while down",
+    )
+    server, url = serve_in_thread(repo)
+    try:
+        doc = get_json(f"{url}/api/v1/events?since=1&timeout=20")
+        assert [e["seq"] for e in doc["events"]] == [2]
+        assert doc["events"][0]["new"] == missed
+        assert doc["events"][0]["replay"] is True
+    finally:
+        server.shutdown()
+        server.server_close()
+
+
+def _free_port():
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def test_long_poll_resume_across_server_sigkill(tmp_path):
+    """The literal acceptance leg: a real `kart serve` subprocess is
+    SIGKILLed mid-watch; a push lands while it is down; the restarted
+    server replays the missed event to a watcher resuming by sequence."""
+    repo, ds_path = make_imported_repo(tmp_path, n=6)
+    workdir = repo.workdir or repo.gitdir
+    port = _free_port()
+    url = f"http://127.0.0.1:{port}"
+    env = {
+        **os.environ,
+        "KART_REPO": str(workdir),
+        "JAX_PLATFORMS": "cpu",
+        "PYTHONPATH": os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+    }
+
+    def spawn():
+        proc = subprocess.Popen(
+            [sys.executable, "-m", "kart_tpu.cli", "serve",
+             "--host", "127.0.0.1", "--port", str(port)],
+            env=env, stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+        )
+        wait_for(
+            lambda: _ping(f"{url}/api/v1/refs"), timeout=60, what="server up"
+        )
+        return proc
+
+    def _ping(u):
+        try:
+            with urllib.request.urlopen(u, timeout=2):
+                return True
+        except OSError:
+            return False
+
+    proc = spawn()
+    try:
+        assert get_json(f"{url}/api/v1/events")["head"] == 0
+        first = edit_commit(
+            repo, ds_path,
+            updates=[{"fid": 1, "geom": None, "name": "k", "rating": 1.0}],
+            message="before kill",
+        )
+        doc = get_json(f"{url}/api/v1/events?since=0&timeout=20")
+        assert doc["events"][0]["new"] == first
+        seen = doc["head"]
+        proc.send_signal(signal.SIGKILL)
+        proc.wait()
+        missed = edit_commit(
+            repo, ds_path,
+            updates=[{"fid": 2, "geom": None, "name": "m", "rating": 2.0}],
+            message="while dead",
+        )
+        proc = spawn()
+        doc = get_json(f"{url}/api/v1/events?since={seen}&timeout=20")
+        assert [e["new"] for e in doc["events"]] == [missed]
+        assert doc["events"][0]["seq"] == seen + 1
+    finally:
+        proc.kill()
+        proc.wait()
+
+
+# ---------------------------------------------------------------------------
+# fleet: subscription beats the poll period; read-your-writes by sequence
+# ---------------------------------------------------------------------------
+
+
+def _raw_push(url, repo, new_oid, *, old_oid, client):
+    from kart_tpu.transport.http import have_closure
+    from kart_tpu.transport.protocol import ObjectEnumerator
+    from kart_tpu.transport.remote import read_shallow
+
+    info = client.ls_refs()
+    server_refs = [o for o in info["heads"].values()]
+    has = have_closure(repo.odb, server_refs, info.get("shallow", ()))
+    enum = ObjectEnumerator(
+        repo.odb, [new_oid], has=has.__contains__,
+        sender_shallow=read_shallow(repo),
+    )
+    return client.receive_pack(
+        enum,
+        [{"ref": "refs/heads/main", "old": old_oid, "new": new_oid,
+          "force": False}],
+        shallow=lambda: enum.shallow_boundary,
+    )
+
+
+def test_subscribed_replica_lag_beats_poll_interval(tmp_path):
+    """The fleet leg: with a 30s poll interval, a subscribed replica
+    still converges in fan-out latency — the event stream, not the poll,
+    drives replication."""
+    from kart_tpu import fleet as fleet_mod
+
+    (tmp_path / "p").mkdir()
+    repo, ds_path = make_imported_repo(tmp_path / "p", n=8)
+    repo.config["receive.denyCurrentBranch"] = "ignore"
+    server, url = serve_in_thread(repo)
+    replica = KartRepo.init_repository(str(tmp_path / "r"))
+    node = fleet_mod.FleetNode(replica, primary_url=url, poll_seconds=30.0)
+    try:
+        node.sync.sync_once()
+        node.start()
+        wait_for(node.sync.subscribed, what="subscription")
+        oid = edit_commit(
+            repo, ds_path,
+            updates=[{"fid": 1, "geom": None, "name": "lag", "rating": 1.0}],
+            message="lag probe",
+        )
+        t0 = time.monotonic()
+        wait_for(
+            lambda: replica.refs.get("refs/heads/main") == oid,
+            timeout=20, what="replica convergence",
+        )
+        lag = time.monotonic() - t0
+        assert lag < 15.0  # decisively under the 30s poll interval
+        wait_for(lambda: node.sync.applied_seq() >= 1, what="applied seq")
+    finally:
+        node.stop()
+        server.shutdown()
+        server.server_close()
+
+
+def test_read_your_writes_by_sequence_through_replica(tmp_path):
+    """A proxied push books its event sequence; the client pins reads on
+    it and the subscribed replica satisfies the pin without an ancestry
+    walk."""
+    from kart_tpu import fleet as fleet_mod
+    from kart_tpu.transport.retry import RetryPolicy
+
+    (tmp_path / "p").mkdir()
+    repo, ds_path = make_imported_repo(tmp_path / "p", n=8)
+    repo.config["receive.denyCurrentBranch"] = "ignore"
+    p_server, p_url = serve_in_thread(repo)
+    replica = KartRepo.init_repository(str(tmp_path / "r"))
+    node = fleet_mod.FleetNode(replica, primary_url=p_url, poll_seconds=30.0)
+    node.sync.sync_once()
+    r_server, r_url = serve_in_thread(replica, fleet=node)
+    try:
+        node.start()
+        wait_for(node.sync.subscribed, what="subscription")
+        from kart_tpu import transport
+
+        pusher = transport.clone(
+            r_url, str(tmp_path / "c"), do_checkout=False
+        )
+        pusher.config.set_many(
+            {"user.name": "t", "user.email": "t@t"}
+        )
+        old = pusher.refs.get("refs/heads/main")
+        oid = edit_commit(
+            pusher, ds_path,
+            updates=[{"fid": 3, "geom": None, "name": "ryw", "rating": 9.0}],
+            message="proxied",
+        )
+        client = HttpRemote(r_url, retry=RetryPolicy(attempts=2))
+        payload = _raw_push(r_url, pusher, oid, old_oid=old, client=client)
+        assert isinstance(payload.get("event_seq"), int)
+        assert client._min_seq == payload["event_seq"]
+        # the pinned read answers with the pushed tip (stall, not stale)
+        info = client.ls_refs()
+        assert info["heads"]["main"] == oid
+        assert node.sync.applied_seq() >= payload["event_seq"]
+    finally:
+        node.stop()
+        r_server.shutdown()
+        r_server.server_close()
+        p_server.shutdown()
+        p_server.server_close()
+
+
+# ---------------------------------------------------------------------------
+# CLI: kart watch / kart top
+# ---------------------------------------------------------------------------
+
+
+def test_kart_watch_streams_json_lines(tmp_path, cli_runner):
+    from kart_tpu.cli import cli
+
+    repo, ds_path = make_imported_repo(tmp_path, n=6)
+    server, url = serve_in_thread(repo)
+    try:
+        get_json(f"{url}/api/v1/events")
+        oid = edit_commit(
+            repo, ds_path,
+            updates=[{"fid": 1, "geom": None, "name": "w", "rating": 1.0}],
+            message="watched",
+        )
+        emitter = events_mod.active_emitter(repo.gitdir)
+        emitter.reconcile()
+        wait_for(lambda: emitter.log.head() == 1, what="announce")
+        result = cli_runner.invoke(
+            cli, ["watch", url, "--since", "0", "-n", "1"]
+        )
+        assert result.exit_code == 0, result.output
+        event = json.loads(result.output.strip().splitlines()[-1])
+        assert event["new"] == oid and event["seq"] == 1
+        # dataset filter: a non-matching filter prints nothing and times out
+        result = cli_runner.invoke(
+            cli, ["watch", url, "--since", "0", "--dataset", "nope",
+                  "--timeout", "0.5"]
+        )
+        assert result.exit_code == 0
+        assert result.output.strip() == ""
+    finally:
+        server.shutdown()
+        server.server_close()
+
+
+def test_kart_top_renders_events_block(tmp_path, cli_runner):
+    from kart_tpu.cli import cli
+
+    repo, ds_path = make_imported_repo(tmp_path, n=6)
+    server, url = serve_in_thread(repo)
+    try:
+        get_json(f"{url}/api/v1/events")  # emitter exists -> stats block
+        result = cli_runner.invoke(cli, ["top", url, "--once"])
+        assert result.exit_code == 0, result.output
+        assert "events  watchers" in result.output
+        assert "head seq" in result.output
+    finally:
+        server.shutdown()
+        server.server_close()
+
+
+def test_stdio_events_op(tmp_path, monkeypatch):
+    from test_ssh_transport import _install_fake_ssh
+
+    from kart_tpu.transport.stdio import StdioRemote
+
+    _install_fake_ssh(tmp_path, monkeypatch)
+    repo, ds_path = make_imported_repo(tmp_path, n=5)
+    remote = StdioRemote(f"ssh://localhost{repo.workdir or repo.gitdir}")
+    try:
+        # the handshake adopts the current tip (first boot, head 0); the
+        # edit lands afterwards, so the next poll reconciles + announces
+        assert remote.events()["head"] == 0
+        oid = edit_commit(
+            repo, ds_path,
+            updates=[{"fid": 1, "geom": None, "name": "ssh", "rating": 1.0}],
+            message="over ssh",
+        )
+        doc = remote.events(0, timeout=15.0)
+        assert doc["head"] == 1
+        assert doc["events"][-1]["new"] == oid
+    finally:
+        remote.close()

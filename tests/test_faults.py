@@ -1419,3 +1419,108 @@ def test_proxy_killed_mid_relay_push_landed_retry_idempotent(
     finally:
         server.shutdown()
         server.server_close()
+
+
+# ---------------------------------------------------------------------------
+# events.emit / events.warm — the live-update emission frames
+# (docs/EVENTS.md §3–§4)
+# ---------------------------------------------------------------------------
+
+
+def _wait(pred, timeout=30.0, what="condition"):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if pred():
+            return
+        time.sleep(0.02)
+    raise AssertionError(f"timed out waiting for {what}")
+
+
+@pytest.mark.parametrize("frame", [1, 2])
+def test_event_emission_killed_at_every_frame_replays(
+    tmp_path, monkeypatch, frame
+):
+    """``KART_FAULTS=events.emit:<n>`` — frame 1 kills the CDC
+    computation, frame 2 the event-log append (the announce). At either
+    frame: refs and object store stay byte-identical, the tip is NOT
+    announced (fully announced or not at all), and a restarted emitter
+    over the same gitdir replays the missed emission."""
+    from kart_tpu import events as events_mod
+
+    repo, ds_path = make_imported_repo(tmp_path, n=6)
+    emitter = events_mod.emitter_for(repo)  # adopts the current tip
+    assert emitter.log.head() == 0
+    oid = edit_commit(
+        repo, ds_path,
+        updates=[{"fid": 1, "geom": None, "name": "k", "rating": 1.0}],
+        message="emission kill",
+    )
+    snap = store_snapshot(repo)
+    refs_before = dict(repo.refs.iter_refs("refs/"))
+    monkeypatch.setenv("KART_FAULTS", f"events.emit:{frame}")
+    assert emitter.reconcile() == 1
+    # the emission fails on the worker thread: wait for the booking to
+    # drain, then assert nothing was announced and nothing was written
+    _wait(
+        lambda: emitter.status_dict()["pending_refs"] == 0
+        and emitter.status_dict()["queue_depth"] == 0,
+        what="emission failure to drain",
+    )
+    assert emitter.log.head() == 0, "a killed emission must announce nothing"
+    assert store_snapshot(repo) == snap
+    assert dict(repo.refs.iter_refs("refs/")) == refs_before
+    monkeypatch.delenv("KART_FAULTS")
+    # the restarted server replays the missed emission from the on-disk
+    # announced-tips state
+    events_mod.drop_emitters(repo.gitdir)
+    emitter2 = events_mod.emitter_for(repo)
+    _wait(lambda: emitter2.log.head() == 1, what="replayed announcement")
+    events, _head, _reset = emitter2.events_since(0)
+    assert events[0]["new"] == oid and events[0]["replay"] is True
+    fsck_objects(repo)
+
+
+def test_event_warm_kill_keeps_announcement_and_clean_cache(
+    tmp_path, monkeypatch
+):
+    """``KART_FAULTS=events.warm:1`` — the pre-warm pass dies before any
+    tile encodes. Warming is best-effort: the event is STILL announced
+    (with the error counted), the store/refs untouched, and the dirty
+    tile served afterwards is byte-identical to a clean encode — nothing
+    was poisoned into the tile cache."""
+    from helpers import gpkg_point
+
+    from kart_tpu import events as events_mod
+    from kart_tpu import tiles
+    from kart_tpu.geometry import Geometry
+
+    repo, ds_path = make_imported_repo(tmp_path, n=6)
+    emitter = events_mod.emitter_for(repo)
+    oid = edit_commit(
+        repo, ds_path,
+        updates=[{"fid": 1, "geom": Geometry(gpkg_point(120.0, -40.0)),
+                  "name": "warmkill", "rating": 2.0}],
+        message="warm kill",
+    )
+    snap = store_snapshot(repo)
+    monkeypatch.setenv("KART_FAULTS", "events.warm:1")
+    assert emitter.reconcile() == 1
+    _wait(lambda: emitter.log.head() == 1, what="announcement despite kill")
+    events, _head, _reset = emitter.events_since(0)
+    assert events[0]["new"] == oid
+    assert events[0]["warm"]["errors"] >= 1
+    assert events[0]["warm"]["tiles"] == 0
+    monkeypatch.delenv("KART_FAULTS")
+    assert store_snapshot(repo) == snap
+    # nothing poisoned: the served tile equals a from-scratch encode
+    payload, _etag, _cached = tiles.serve_tile(
+        repo, oid, ds_path, 0, 0, 0, commit_oid=oid
+    )
+    from kart_tpu.tiles.encode import encode_tile
+
+    fresh, _stats = encode_tile(
+        tiles.source_for(repo, oid, ds_path), 0, 0, 0
+    )
+    assert payload == fresh
+    events_mod.drop_emitters(repo.gitdir)
+    fsck_objects(repo)
