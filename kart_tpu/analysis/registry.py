@@ -47,6 +47,9 @@ ENV_VARS = {
     # tiles (docs/TILES.md)
     "KART_TILE_CACHE": "source",
     "KART_TILE_MAX_FEATURES": "source",
+    "KART_TILE_ENCODING": "source",
+    "KART_EXPORT_WORKERS": "source",
+    "KART_EXPORT_BATCH_TILES": "source",
     # fleet (docs/FLEET.md)
     "KART_REPLICA_OF": "source",
     "KART_REPLICA_POLL_SECONDS": "source",
@@ -141,6 +144,8 @@ FAULT_POINTS = frozenset(
         "server.ref_cas",
         "tiles.encode",
         "tiles.cache",
+        "tiles.streams",
+        "tiles.export",
         "fleet.sync",
         "fleet.proxy",
         "events.emit",
@@ -319,7 +324,14 @@ DEVICE_MODULES = frozenset(
 #: module no longer defines.
 DEVICE_SEAMS = {
     "kart_tpu/diff/backend.py": frozenset(
-        {"select_backend", "warm_probe"}
+        {
+            # project_envelopes is the pyramid exporter's batch seam: host
+            # numpy by default, shard_map when the probe says devices are
+            # live, host fallback mid-call — the first non-diff workload
+            "select_backend",
+            "warm_probe",
+            "project_envelopes",
+        }
     ),
     "kart_tpu/ops/bbox.py": frozenset(
         {

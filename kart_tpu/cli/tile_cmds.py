@@ -43,9 +43,10 @@ def export():
 @click.option(
     "--layers",
     default=None,
-    help="Comma-separated layers to include: bin,geojson (default both). "
-    "The geojson layer needs feature blobs locally; a partial clone "
-    "exports --layers bin.",
+    help="Comma-separated layers to include: bin,geojson,ktb2,mvt,props "
+    "(default: the server's negotiated default — bin,geojson, or "
+    "KART_TILE_ENCODING). geojson/props need feature blobs locally; a "
+    "partial clone exports --layers bin, ktb2 or mvt.",
 )
 @click.option(
     "--max-features",
@@ -54,9 +55,24 @@ def export():
     help="Per-tile feature ceiling; over-full tiles are skipped (counted). "
     "Overrides KART_TILE_MAX_FEATURES; 0 = unlimited.",
 )
+@click.option(
+    "--workers",
+    type=click.INT,
+    default=None,
+    help="Parallel encode workers (default: KART_EXPORT_WORKERS, else the "
+    "core count on a >=4-core box). 1 = serial in-process, which routes "
+    "encode batches through the device mesh when one is live.",
+)
+@click.option(
+    "--strict",
+    is_flag=True,
+    help="Fail (non-zero exit, listing the skipped tiles) if any tile "
+    "exceeded the feature ceiling — by default skips are only counted, "
+    "which can leave a silently incomplete pyramid.",
+)
 @click.pass_obj
 def export_tiles(ctx, refish, ds_path, zoom_spec, out_dir, layers,
-                 max_features):
+                 max_features, workers, strict):
     """Export a zoom pyramid of vector tiles for REFISH (any commit).
 
     No working copy and no GDAL involved: tiles are built straight from
@@ -88,14 +104,33 @@ def export_tiles(ctx, refish, ds_path, zoom_spec, out_dir, layers,
             source, zooms, out_dir,
             layers=tiles.normalise_layers(layers),
             max_features=max_features,
+            workers=workers,
         )
     except (tiles.TileAddressError, tiles.TileEncodeError,
             tiles.TileSourceError, TileAddressError) as e:
         raise CliError(str(e))
+    skipped = stats["tiles_skipped"]
+    if skipped and strict:
+        shown = ", ".join(f"{z}/{x}/{y}" for z, x, y in skipped[:20])
+        more = f" (+{len(skipped) - 20} more)" if len(skipped) > 20 else ""
+        raise CliError(
+            f"--strict: {len(skipped)} tiles exceeded the feature ceiling "
+            f"and were skipped — the pyramid is incomplete: {shown}{more}. "
+            f"Raise --max-features / KART_TILE_MAX_FEATURES or export "
+            f"deeper zooms."
+        )
     click.echo(
         f"Exported {stats['tiles_written']} tiles "
         f"({stats['features_out']} features, {stats['bytes_out']} bytes) "
         f"of {ds_path}@{commit_oid[:12]} to {out_dir} "
         f"[z{zooms[0]}-z{zooms[-1]}; {stats['tiles_empty']} empty, "
-        f"{stats['tiles_too_large']} over the feature ceiling]"
+        f"{stats['tiles_too_large']} over the feature ceiling; "
+        f"{stats['export_workers']} workers]"
     )
+    if skipped:
+        click.echo(
+            f"warning: {len(skipped)} tiles skipped over the feature "
+            f"ceiling — the pyramid is incomplete (use --strict to fail, "
+            f"--max-features 0 to lift)",
+            err=True,
+        )

@@ -66,6 +66,22 @@ class DiffBackend:
         """Counts of an estimation subsample (small blocks, called once)."""
         return self.counts(old_sub, new_sub)
 
+    def merc_envelopes(self, env):
+        """(M, 4) f64 wsen envelope degrees -> (mx0, my0, mx1, my1) f64
+        normalized-mercator columns (x from lon, y from lat with the
+        north edge first — the tile quantizer's input shape). The first
+        *non-diff* workload behind this seam (ISSUE 15): whole-pyramid
+        tile export projects its encode batches here. Base: the host
+        numpy transform (`tiles.grid.merc_xy_cols` — the serving path's
+        exact ops, so host batches are bit-identical to per-tile
+        serving)."""
+        from kart_tpu.tiles.grid import merc_xy_cols
+
+        e = np.asarray(env, dtype=np.float64)
+        mx0, my0 = merc_xy_cols(e[:, 0], e[:, 3])
+        mx1, my1 = merc_xy_cols(e[:, 2], e[:, 1])
+        return mx0, my0, mx1, my1
+
     def envelope_hits(self, block, query):
         """bool (count,) envelope-vs-query intersections for one sidecar
         block — the spatial prefilter's scan. Base: the host path
@@ -178,6 +194,15 @@ class ShardedJaxBackend(DiffBackend):
             return sharded_envelope_hits(block.envelopes, block.count, q)
         except Exception as e:
             return self._fall_back(e, "envelope_hits").envelope_hits(block, query)
+
+    def merc_envelopes(self, env):
+        e = np.asarray(env, dtype=np.float64)
+        if not _device_envelopes_worthwhile(len(e)):
+            return super().merc_envelopes(e)
+        try:
+            return sharded_merc_envelopes(e)
+        except Exception as exc:
+            return self._fall_back(exc, "merc_envelopes").merc_envelopes(e)
 
 
 def _device_envelopes_worthwhile(n):
@@ -312,6 +337,101 @@ def sharded_envelope_hits(envelopes, count, query_f64):
         ]
     hits = fn(*args, jax.device_put(q))
     return np.asarray(hits).reshape(-1)[:count]
+
+
+# --- sharded mercator projection (the tile exporter's batch workload) -------
+
+def project_envelopes(env, allow_device=True):
+    """(M, 4) f64 wsen degrees -> (mx0, my0, mx1, my1) normalized-mercator
+    f64 columns, routed through the backend registry — the pyramid
+    exporter's per-batch entry point (the first non-diff workload on the
+    PR 6 seam). ``allow_device=False`` pins the host transform (pool
+    workers: a forked child must never touch a device runtime).
+
+    Byte-determinism note (docs/TILES.md §6): device transcendentals are
+    *not* bit-identical to numpy's, so the tile quantizer treats device
+    output as a fast approximation and re-runs the host ops on any row
+    whose quantized value lands within a safety margin of a rounding
+    boundary (:func:`kart_tpu.tiles.clip.quantize_from_merc`) — the
+    exported integers are provably the host integers either way."""
+    from kart_tpu.parallel.sharded_diff import should_shard
+
+    e = np.asarray(env, dtype=np.float64)
+    backend = BACKENDS["host_native"]
+    if (
+        allow_device
+        and os.environ.get("KART_DIFF_DEVICE") != "0"
+        and os.environ.get("KART_DIFF_BACKEND", "auto")
+        in ("auto", "sharded_jax")
+        # should_shard is the classify path's full readiness ladder: env
+        # gates, row floor, jax_ready() (the watchdogged probe — a wedged
+        # tunnel can't hang the first device_put), and the refusal to
+        # treat a 1-device virtual CPU mesh as a production engine
+        and should_shard(len(e))
+    ):
+        backend = BACKENDS["sharded_jax"]
+    return backend.merc_envelopes(e)
+
+
+@functools.lru_cache(maxsize=8)
+def _make_sharded_merc(mesh):
+    import jax
+
+    from jax.sharding import PartitionSpec as P
+
+    from kart_tpu.diff.device_batch import _shard_map
+    from kart_tpu.parallel.mesh import FEATURES_AXIS
+
+    import jax.numpy as jnp
+
+    from kart_tpu.tiles.grid import MERC_MAX_LAT
+
+    def _merc(lon, lat):
+        lat = jnp.clip(lat, -MERC_MAX_LAT, MERC_MAX_LAT)
+        x = (lon + 180.0) / 360.0
+        s = jnp.sin(jnp.radians(lat))
+        y = 0.5 - jnp.log((1.0 + s) / (1.0 - s)) / (4.0 * jnp.pi)
+        return x, y
+
+    def _step(w, s, e, n):
+        mx0, my0 = _merc(w[0], n[0])
+        mx1, my1 = _merc(e[0], s[0])
+        return mx0[None], my0[None], mx1[None], my1[None]
+
+    jax.config.update("jax_enable_x64", True)  # f64 degrees in, f64 merc out
+    spec = P(FEATURES_AXIS)
+    fn = _shard_map()(
+        _step, mesh=mesh, in_specs=(spec,) * 4, out_specs=(spec,) * 4
+    )
+    return jax.jit(fn)
+
+
+def sharded_merc_envelopes(env):
+    """(M, 4) f64 degrees -> 4 merc columns, computed shard-local over the
+    feature axis (pure elementwise — zero cross-device traffic; padding
+    rows project to garbage and are sliced off)."""
+    import jax
+
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from kart_tpu.ops.blocks import bucket_size
+    from kart_tpu.parallel.mesh import FEATURES_AXIS, make_mesh
+
+    count = len(env)
+    mesh = make_mesh()
+    n_shards = int(mesh.devices.size)
+    per = bucket_size(max(-(-count // n_shards), 1))
+    cols = np.zeros((4, n_shards * per), dtype=np.float64)
+    if count:
+        cols[:, :count] = np.asarray(env, dtype=np.float64).T
+    fn = _make_sharded_merc(mesh)
+    sharding = NamedSharding(mesh, P(FEATURES_AXIS))
+    with tm.span("diff.device.project", rows=int(count)):
+        args = [
+            jax.device_put(c.reshape(n_shards, per), sharding) for c in cols
+        ]
+        out = fn(*args)
+    return tuple(np.asarray(o).reshape(-1)[:count] for o in out)
 
 
 # --- pmapped sampled-count reduction ----------------------------------------

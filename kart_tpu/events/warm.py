@@ -31,13 +31,25 @@ L = logging.getLogger("kart_tpu.events.warm")
 DEFAULT_WARM_BUDGET = 256
 
 
-#: the layer set warmed per dirty tile: the columnar ``bin`` layer — the
-#: blob-free hot path every map client of the store requests (BENCH_r10's
-#: serving numbers are bin-layer numbers), servable even on partial
-#: stores. The ``geojson`` layer stays lazily encoded on first request
-#: (it needs every feature blob in the tile, which a just-pushed partial
-#: store may not hold).
+#: the blob-free fallback layer set (see :func:`warm_layers`)
 WARM_LAYERS = ("bin",)
+
+
+def warm_layers():
+    """The layer set warmed per dirty tile: the server's *negotiated
+    default* (``KART_TILE_ENCODING``-aware — warming cache keys nobody's
+    default request computes would make every warm fill a miss), filtered
+    to the blob-free layers. ``geojson``/``props`` stay lazily encoded on
+    first request (they need every feature blob in the tile, which a
+    just-pushed partial store may not hold); when the default is entirely
+    blob-needing, warm the columnar ``bin`` layer (BENCH_r10's serving
+    hot path)."""
+    from kart_tpu.tiles.encode import default_layers
+
+    blob_free = tuple(
+        name for name in default_layers() if name not in ("geojson", "props")
+    )
+    return blob_free or WARM_LAYERS
 
 
 def warm_budget(environ=os.environ):
@@ -89,13 +101,14 @@ def warm_dirty_tiles(repo, new_oid, summary, *, budget=None):
         return stats
     budget = warm_budget() if budget is None else budget
     t0 = time.perf_counter()
+    layers = warm_layers()
     with tm.span("events.warm", commit=new_oid[:12]):
         faults.fire("events.warm")
         for ds_path, z, x, y in iter_warm_tiles(summary, budget):
             try:
                 _payload, _etag, cached = tiles.serve_tile(
                     repo, new_oid, ds_path, z, x, y, commit_oid=new_oid,
-                    layers=WARM_LAYERS,
+                    layers=layers,
                 )
             except (tiles.TileSourceError, tiles.TileEncodeError) as e:
                 # an unwarmable tile (over the feature ceiling, blobs not

@@ -49,6 +49,16 @@ Registered points:
                             here must poison nothing (the fresh payload is
                             never inserted; a poisoned tile is never
                             served)
+    tiles.streams           the KTB2/props stream codec (tiles/encode):
+                            each encode_ktb2/props_layer entry (an armed
+                            encode publishes nothing — the cache never
+                            sees the payload) and each decode entry (the
+                            client-side crash probe)
+    tiles.export            every batch boundary of the ordered pyramid-
+                            export writer: a kill leaves every previously
+                            written tile complete and nothing of the
+                            doomed batch; the re-run overwrites
+                            byte-identically
     fleet.sync              every frame of a replica's sync cycle:
                             1 = the pack-migrate boundary (pulled objects
                             durable, no ref moved), 2+ = before each

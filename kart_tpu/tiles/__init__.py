@@ -23,10 +23,16 @@ import time
 from kart_tpu import telemetry as tm
 from kart_tpu.tiles.cache import etag_for, tile_cache_for, tile_key
 from kart_tpu.tiles.encode import (
+    DEFAULT_LAYERS,
     DEFAULT_MAX_FEATURES,
+    KNOWN_LAYERS,
     TileEncodeError,
     TileTooLarge,
     decode_bin_layer,
+    decode_ktb2_layer,
+    decode_mvt_layer,
+    decode_props_layer,
+    default_layers,
     encode_tile,
     normalise_layers,
     parse_payload,
@@ -48,7 +54,9 @@ from kart_tpu.tiles.source import (
 __all__ = [
     "DEFAULT_BUFFER",
     "DEFAULT_EXTENT",
+    "DEFAULT_LAYERS",
     "DEFAULT_MAX_FEATURES",
+    "KNOWN_LAYERS",
     "TileAddressError",
     "TileDataUnavailable",
     "TileEncodeError",
@@ -56,6 +64,10 @@ __all__ = [
     "TileSourceError",
     "TileTooLarge",
     "decode_bin_layer",
+    "decode_ktb2_layer",
+    "decode_mvt_layer",
+    "decode_props_layer",
+    "default_layers",
     "encode_tile",
     "etag_for",
     "normalise_layers",

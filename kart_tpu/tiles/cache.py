@@ -56,10 +56,14 @@ def tile_key(commit_oid, ds_path, z, x, y, layers, extent, buffer):
     return hashlib.sha256(payload.encode()).hexdigest()
 
 
-def etag_for(key):
+def etag_for(key, raw=False):
     """Strong validator: same key ⇒ byte-identical payload (the key pins
-    the commit, so it never needs revalidation)."""
-    return f'"{key[:32]}"'
+    the commit, so it never needs revalidation). ``raw`` marks the
+    *unframed* representation (a bare MVT body negotiated via ``Accept``
+    / ``?format=mvt`` — docs/TILES.md §5): different bytes on the wire
+    must mean a different strong validator, even though both derive from
+    one cache key."""
+    return f'"{key[:32]}-raw"' if raw else f'"{key[:32]}"'
 
 
 class TileCache(SingleFlightLRU):

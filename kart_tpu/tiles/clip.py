@@ -21,6 +21,15 @@ per-feature Python objects, no geometry decoding. Two stages:
 Anti-meridian-wrapping envelopes (e < w) can't express a contiguous x
 range in one tile's coordinate space; they quantize to the full buffered
 tile width (a correct superset — the renderer clips).
+
+The mercator projection may come precomputed (the pyramid exporter batches
+it through the DiffBackend seam — possibly on devices). Device
+transcendentals differ from numpy's by ulps, so
+:func:`quantize_from_merc` re-runs the host ops on any row whose quantized
+float lands within a safety margin of a rounding (or rint-tie) boundary —
+the emitted integers are **provably the host-path integers** for any merc
+input within the margin of the host values. Serving and export therefore
+stay byte-identical regardless of which backend projected the batch.
 """
 
 import numpy as np
@@ -35,6 +44,78 @@ from kart_tpu.tiles.grid import (
 )
 
 
+def refine_rows(envelopes, rows, z, x, y):
+    """The exact-refine stage alone: candidate ``rows`` -> (kept rows
+    int64 (M,), their f64 wsen envelopes (M, 4)) against the tile's
+    membership rectangle (edge rows extend to the poles — clamped-latitude
+    features are never dropped)."""
+    z, x, y = validate_tile(z, x, y)
+    rows = np.asarray(rows, dtype=np.int64)
+    if not len(rows):
+        return rows, np.zeros((0, 4), dtype=np.float64)
+    env = np.asarray(envelopes[rows], dtype=np.float64)
+    bounds = np.asarray(tile_cover_wsen(z, x, y), dtype=np.float64)
+    keep = bbox_intersects_np(env, bounds)
+    return rows[keep], env[keep]
+
+
+def _host_merc(env):
+    """The host (numpy) mercator columns — the bit-exactness master every
+    other projection is patched against."""
+    mx0, my0 = merc_xy_cols(env[:, 0], env[:, 3])  # north edge -> smaller y
+    mx1, my1 = merc_xy_cols(env[:, 2], env[:, 1])
+    return mx0, my0, mx1, my1
+
+
+def _float_boxes(merc, z, x, y, extent, buffer):
+    mx0, my0, mx1, my1 = merc
+    scale = float(1 << z) * extent
+    boxes = np.empty((len(mx0), 4), dtype=np.float64)
+    boxes[:, 0] = mx0 * scale - x * extent
+    boxes[:, 1] = my0 * scale - y * extent
+    boxes[:, 2] = mx1 * scale - x * extent
+    boxes[:, 3] = my1 * scale - y * extent
+    return np.clip(boxes, -buffer, extent + buffer)
+
+
+def quantize_from_merc(env, merc, z, x, y, *, extent=DEFAULT_EXTENT,
+                       buffer=DEFAULT_BUFFER):
+    """Refined envelopes + their mercator columns -> int32 (M, 4) boxes.
+
+    ``merc`` may be host-computed (then this IS the serving path's math)
+    or device-computed through the backend seam. Rows whose clipped float
+    lies within ``margin`` of a rounding boundary are re-projected with
+    the host ops before rint — since the device/host difference is
+    orders of magnitude below the margin, every row either rounds
+    identically on both paths or is recomputed on the host one, so the
+    integer output equals the pure-host output bit for bit."""
+    z, x, y = validate_tile(z, x, y)
+    if not len(env):
+        return np.zeros((0, 4), dtype=np.int32)
+    clipped = _float_boxes(merc, z, x, y, extent, buffer)
+    # safety margin: merc values are O(1) with a few-ulp backend error;
+    # scaling multiplies the absolute error by `scale`. 1e-13 relative is
+    # ~450x a double ulp — far above any sane transcendental's error —
+    # and the 0.05 cap keeps deep zooms honest: by z≈28 the uncapped
+    # margin would flag most rows as suspect and re-project nearly the
+    # whole batch on the host (the cap still exceeds the ~0.02 worst-case
+    # scaled ulp error at MAX_ZOOM=30, so determinism holds).
+    scale = float(1 << z) * extent
+    margin = min(scale * 1e-13 + 1e-9, 0.05)
+    frac = clipped - np.floor(clipped)
+    suspect = (np.abs(frac - 0.5) < margin).any(axis=1)
+    out = np.rint(clipped).astype(np.int32)
+    if suspect.any():
+        redo = _float_boxes(_host_merc(env[suspect]), z, x, y, extent, buffer)
+        out[suspect] = np.rint(redo).astype(np.int32)
+
+    wraps = env[:, 2] < env[:, 0]
+    if wraps.any():
+        out[wraps, 0] = -buffer
+        out[wraps, 2] = extent + buffer
+    return out
+
+
 def clip_quantize(envelopes, rows, z, x, y, *, extent=DEFAULT_EXTENT,
                   buffer=DEFAULT_BUFFER):
     """-> (kept_rows int64 (M,), boxes int32 (M, 4)).
@@ -45,33 +126,10 @@ def clip_quantize(envelopes, rows, z, x, y, *, extent=DEFAULT_EXTENT,
     the kept rows (y0 = north edge), clipped to the buffered tile square.
     """
     z, x, y = validate_tile(z, x, y)
-    rows = np.asarray(rows, dtype=np.int64)
+    rows, env = refine_rows(envelopes, rows, z, x, y)
     if not len(rows):
         return rows, np.zeros((0, 4), dtype=np.int32)
-    env = np.asarray(envelopes[rows], dtype=np.float64)
-
-    # exact refine against the unpadded membership rectangle (edge rows
-    # extend to the poles so clamped-latitude features are never dropped)
-    bounds = np.asarray(tile_cover_wsen(z, x, y), dtype=np.float64)
-    keep = bbox_intersects_np(env, bounds)
-    rows = rows[keep]
-    if not len(rows):
-        return rows, np.zeros((0, 4), dtype=np.int32)
-    env = env[keep]
-
-    w, s, e, n = env[:, 0], env[:, 1], env[:, 2], env[:, 3]
-    scale = float(1 << z) * extent
-    mx0, my0 = merc_xy_cols(w, n)  # north edge -> smaller mercator y
-    mx1, my1 = merc_xy_cols(e, s)
-    boxes = np.empty((len(rows), 4), dtype=np.float64)
-    boxes[:, 0] = mx0 * scale - x * extent
-    boxes[:, 1] = my0 * scale - y * extent
-    boxes[:, 2] = mx1 * scale - x * extent
-    boxes[:, 3] = my1 * scale - y * extent
-    out = np.rint(np.clip(boxes, -buffer, extent + buffer)).astype(np.int32)
-
-    wraps = e < w
-    if wraps.any():
-        out[wraps, 0] = -buffer
-        out[wraps, 2] = extent + buffer
-    return rows, out
+    boxes = quantize_from_merc(
+        env, _host_merc(env), z, x, y, extent=extent, buffer=buffer
+    )
+    return rows, boxes

@@ -9,31 +9,46 @@ possible:
 
 The JSON header is canonical (sorted keys, compact separators) and carries
 the tile address, the pinned commit, the exact bbox, and each layer's byte
-length; layers follow in *name-sorted* order. Two layers:
+length; layers follow in *name-sorted* order. The layer registry
+(ISSUE 15):
 
-* ``bin`` — the columnar layer, built entirely from sidecar columns (no
-  blob reads): ``KTB1`` magic, uint32-LE row count, int64-LE identity keys
-  (the pk for int-pk datasets), int32-LE (M, 4) quantized tile-local
-  envelope boxes from :mod:`kart_tpu.tiles.clip`.
-* ``geojson`` — newline-delimited JSON feature objects, serialised through
-  the dataset's per-legend *compiled* serialisers
-  (``Dataset3.feature_json_str_from_data`` — the PR 1 fused-diff writers'
-  hot path, reused verbatim so a tile feature is byte-identical to the
-  same feature in a ``diff -o json-lines`` document). Requires the feature
-  blobs to be locally present.
+* ``bin`` — the KTB1 columnar layer, built entirely from sidecar columns
+  (no blob reads): ``KTB1`` magic, uint32-LE row count, int64-LE identity
+  keys, int32-LE (M, 4) quantized tile-local envelope boxes. Kept
+  bit-for-bit as shipped by PR 9 (old clients keep decoding).
+* ``ktb2`` — the compressed columnar layer (:mod:`kart_tpu.tiles.streams`):
+  the same keys/boxes as ``bin``, but each column is one delta/RLE/
+  bit-packed stream picked by an exact cost probe — typically 3-6x smaller
+  than KTB1 and still zero blob reads.
+* ``mvt`` — real Mapbox Vector Tile protobuf (spec 2.1) from the same
+  clipped/quantized arrays: envelope boxes as polygons (degenerate boxes
+  as points/linestrings), identity keys as feature ids, no blob reads —
+  the off-the-shelf MapLibre adoption story.
+* ``geojson`` — newline-delimited JSON feature objects through the
+  dataset's per-legend *compiled* serialisers
+  (``Dataset3.feature_json_str_from_data``), byte-identical to ``diff -o
+  json-lines``. Needs feature blobs locally.
+* ``props`` — the KTB2 properties stream: the same compiled-serialiser
+  feature JSON, dictionary-coded (unique rows stored once + an index
+  stream). Needs blobs; pairs with ``ktb2`` for a full-fidelity
+  compressed tile.
 
 Rows are emitted in ascending identity-key order (the sidecar's native
-order), so payload bytes never depend on scan order.
+order), so payload bytes never depend on scan order. ``PAYLOAD_VERSION``
+is part of every cache key/ETag (tiles/cache.py) — this encoder changing
+means every validator changes, the PR 9 immutable-cache rule.
 """
 
 import json
+import logging
+import os
 import struct
 
 import numpy as np
 
 from kart_tpu import faults
 from kart_tpu import telemetry as tm
-from kart_tpu.tiles.clip import clip_quantize
+from kart_tpu.tiles.clip import clip_quantize, quantize_from_merc, refine_rows
 from kart_tpu.tiles.grid import (
     DEFAULT_BUFFER,
     DEFAULT_EXTENT,
@@ -41,26 +56,43 @@ from kart_tpu.tiles.grid import (
     tile_query_wsen,
     validate_tile,
 )
+from kart_tpu.tiles.streams import (
+    TileEncodeError,
+    decode_bytes_stream,
+    decode_stream,
+    encode_bytes_stream,
+    encode_stream,
+    varint_decode,
+    varint_encode,
+    varint_lengths,
+    zigzag,
+)
+
+L = logging.getLogger("kart_tpu.tiles.encode")
 
 _HEADER_LEN = struct.Struct(">Q")
 
-#: the binary layer's magic
+#: layer magics
 BIN_MAGIC = b"KTB1"
+KTB2_MAGIC = b"KTB2"
+PROPS_MAGIC = b"KTP1"
 
-#: payload format version (header "v")
-PAYLOAD_VERSION = 1
+#: payload format version (header "v"); folded into every cache key/ETag —
+#: v2 added the ktb2/mvt/props layers
+PAYLOAD_VERSION = 2
 
 #: layer names this encoder knows how to build
-KNOWN_LAYERS = ("bin", "geojson")
+KNOWN_LAYERS = ("bin", "geojson", "ktb2", "mvt", "props")
+
+#: what a request without ``?layers=`` gets (``KART_TILE_ENCODING``
+#: overrides the server-side default; the chosen set is part of the cache
+#: key, so differently-configured servers never collide)
+DEFAULT_LAYERS = ("bin", "geojson")
 
 #: default ceiling on features per tile (``KART_TILE_MAX_FEATURES``
 #: overrides; 0 = unlimited). A tile over the ceiling is a client error —
 #: zoom in — not a server OOM.
 DEFAULT_MAX_FEATURES = 65_536
-
-
-class TileEncodeError(ValueError):
-    pass
 
 
 class TileTooLarge(TileEncodeError):
@@ -76,11 +108,28 @@ class TileTooLarge(TileEncodeError):
         self.limit = limit
 
 
+def default_layers():
+    """The layer set a request without ``?layers=`` negotiates to:
+    ``KART_TILE_ENCODING`` (comma layer list, e.g. ``ktb2`` for a
+    wire-lean fleet) when set and valid, else :data:`DEFAULT_LAYERS`.
+    Malformed operator config logs one warning and falls back — it must
+    never turn every tile request into an error."""
+    spec = os.environ.get("KART_TILE_ENCODING")
+    if not spec:
+        return DEFAULT_LAYERS
+    try:
+        return normalise_layers(spec)
+    except TileEncodeError as e:
+        L.warning("ignoring bad KART_TILE_ENCODING=%r: %s", spec, e)
+        return DEFAULT_LAYERS
+
+
 def normalise_layers(layers):
     """Request layer spec (iterable or comma string) -> sorted tuple of
-    known layer names; raises on unknown names."""
+    known layer names; raises on unknown names. ``None`` means the
+    negotiated server default (:func:`default_layers`)."""
     if layers is None:
-        return KNOWN_LAYERS
+        return default_layers()
     if isinstance(layers, str):
         layers = [p.strip() for p in layers.split(",") if p.strip()]
     out = sorted(set(layers))
@@ -98,6 +147,421 @@ def max_features_limit():
     from kart_tpu.transport.retry import _env_int
 
     return _env_int("KART_TILE_MAX_FEATURES", DEFAULT_MAX_FEATURES)
+
+
+# ---------------------------------------------------------------------------
+# layer builders (pure functions of the selected/quantized arrays)
+# ---------------------------------------------------------------------------
+
+
+def encode_bin_layer(keys, boxes):
+    """KTB1: the PR 9 raw columnar layer, byte-for-bit unchanged."""
+    return b"".join(
+        (
+            BIN_MAGIC,
+            struct.pack("<I", len(keys)),
+            np.ascontiguousarray(keys, dtype="<i8").tobytes(),
+            np.ascontiguousarray(boxes, dtype="<i4").tobytes(),
+        )
+    )
+
+
+def decode_bin_layer(data):
+    """``bin`` layer bytes -> (int64 keys (M,), int32 boxes (M, 4)).
+
+    Bounds-checked (ISSUE 15 satellite): a count that disagrees with the
+    actual byte length — truncated payload, or an oversized count that
+    would make ``np.frombuffer`` short-read — raises
+    :class:`TileEncodeError`, never returns partial columns."""
+    if len(data) < 8 or data[:4] != BIN_MAGIC:
+        raise TileEncodeError("Bad binary tile layer magic")
+    (count,) = struct.unpack_from("<I", data, 4)
+    expected = 8 + count * (8 + 16)
+    if len(data) != expected:
+        raise TileEncodeError(
+            f"KTB1 layer holds {len(data)} bytes; count {count} "
+            f"requires exactly {expected}"
+        )
+    keys = np.frombuffer(data, dtype="<i8", count=count, offset=8)
+    boxes = np.frombuffer(data, dtype="<i4", count=4 * count, offset=8 + 8 * count)
+    return keys, boxes.reshape(count, 4)
+
+
+def encode_ktb2_layer(keys, boxes):
+    """KTB2: the compressed columnar sibling — one cost-probed stream per
+    column (sorted keys delta-code; box columns FOR/RLE-code), recorded
+    choices in each stream header so decode is one dispatch per column.
+
+    Injectable crash frame (``KART_FAULTS=tiles.streams``): fires before
+    any stream is built — an armed encode publishes nothing anywhere
+    (the cache publish never runs)."""
+    faults.fire("tiles.streams")
+    count = len(keys)
+    boxes = np.ascontiguousarray(boxes, dtype=np.int64).reshape(count, 4)
+    parts = [KTB2_MAGIC, struct.pack("<BI", 0, count)]
+    parts.append(encode_stream(np.asarray(keys, dtype=np.int64), "i8"))
+    for col in range(4):
+        parts.append(encode_stream(boxes[:, col], "i4"))
+    return b"".join(parts)
+
+
+#: decode-side ceiling on a compressed layer's claimed row count. RLE/FOR
+#: legitimately expand far beyond their payload bytes (that is the point),
+#: so unlike KTB1 the count cannot be cross-checked against the byte
+#: length — without a ceiling a ~30-byte crafted payload could demand a
+#: multi-GB allocation. 2**27 rows (≈4 GB transient) is far above any real
+#: tile (the 100M bench's whole dataset fits) while bounding the bomb.
+MAX_DECODE_ROWS = 1 << 27
+
+
+def decode_ktb2_layer(data, max_count=MAX_DECODE_ROWS):
+    """``ktb2`` layer bytes -> (int64 keys (M,), int32 boxes (M, 4)) —
+    :func:`decode_bin_layer`'s sibling: one encoding dispatch per stream,
+    every decode path whole-array numpy, bounds-checked end to end.
+    ``max_count`` guards against decompression bombs (see
+    :data:`MAX_DECODE_ROWS`); pass a larger value deliberately if you
+    really hold a bigger tile."""
+    faults.fire("tiles.streams")
+    if len(data) < 9 or data[:4] != KTB2_MAGIC:
+        raise TileEncodeError("Bad KTB2 tile layer magic")
+    flags, count = struct.unpack_from("<BI", data, 4)
+    if flags != 0:
+        raise TileEncodeError(f"Unknown KTB2 flags 0x{flags:02x}")
+    if max_count and count > max_count:
+        raise TileEncodeError(
+            f"KTB2 layer claims {count} rows (> {max_count} ceiling; pass "
+            f"max_count to decode a genuinely larger tile)"
+        )
+    pos = 9
+    keys, pos = decode_stream(data, count, "i8", pos)
+    boxes = np.empty((count, 4), dtype=np.int32)
+    for col in range(4):
+        boxes[:, col], pos = decode_stream(data, count, "i4", pos)
+    if pos != len(data):
+        raise TileEncodeError(
+            f"KTB2 layer length mismatch ({pos} decoded vs {len(data)} actual)"
+        )
+    return keys.astype("<i8"), boxes
+
+
+def encode_props_layer(lines):
+    """``props``: the dictionary-coded properties stream — the same
+    compiled-serialiser feature JSON strings as the geojson layer, unique
+    rows stored once plus an index stream (rows align with the bin/ktb2
+    key column)."""
+    faults.fire("tiles.streams")
+    return b"".join(
+        (
+            PROPS_MAGIC,
+            struct.pack("<I", len(lines)),
+            encode_bytes_stream(lines),
+        )
+    )
+
+
+def decode_props_layer(data, max_count=MAX_DECODE_ROWS):
+    """``props`` layer bytes -> list of feature-JSON byte strings, row
+    order (aligned with the bin/ktb2 keys). ``max_count`` as in
+    :func:`decode_ktb2_layer`."""
+    faults.fire("tiles.streams")
+    if len(data) < 8 or data[:4] != PROPS_MAGIC:
+        raise TileEncodeError("Bad props tile layer magic")
+    (count,) = struct.unpack_from("<I", data, 4)
+    if max_count and count > max_count:
+        raise TileEncodeError(
+            f"Props layer claims {count} rows (> {max_count} ceiling)"
+        )
+    lines, pos = decode_bytes_stream(data, count, 8)
+    if pos != len(data):
+        raise TileEncodeError(
+            f"Props layer length mismatch ({pos} decoded vs {len(data)} actual)"
+        )
+    return lines
+
+
+# ---------------------------------------------------------------------------
+# MVT (Mapbox Vector Tile 2.1) — hand-rolled protobuf, no dependency
+# ---------------------------------------------------------------------------
+
+#: MVT geom types
+MVT_POINT, MVT_LINESTRING, MVT_POLYGON = 1, 2, 3
+
+
+def _uvarint(v):
+    """Scalar LEB128 (message framing — per-feature lengths)."""
+    out = bytearray()
+    v = int(v)
+    while True:
+        b = v & 0x7F
+        v >>= 7
+        if v:
+            out.append(b | 0x80)
+        else:
+            out.append(b)
+            return bytes(out)
+
+
+def _pb_bytes(field, data):
+    return _uvarint((field << 3) | 2) + _uvarint(len(data)) + data
+
+
+def _pb_varint(field, value):
+    return _uvarint(field << 3) + _uvarint(value)
+
+
+def _mvt_geometries(boxes):
+    """(M, 4) int boxes -> (geom type uint8 (M,), list of M geometry
+    command byte strings). The command words/params for each geometry
+    class are computed columnar and varint-encoded in ONE vectorized pass
+    per class; each feature's bytes are then a slice of that buffer.
+
+    Polygons wind (x0,y0)→(x1,y0)→(x1,y1)→(x0,y1): positive area under
+    the surveyor's formula in tile coordinates (y down) — the MVT 2.1
+    exterior-ring rule. Degenerate boxes emit points (zero extent) or
+    linestrings (zero width xor height) — a zero-area polygon is invalid
+    MVT."""
+    b = np.asarray(boxes, dtype=np.int64).reshape(-1, 4)
+    m = len(b)
+    x0, y0, x1, y1 = b[:, 0], b[:, 1], b[:, 2], b[:, 3]
+    is_pt = (x0 == x1) & (y0 == y1)
+    is_ln = ~is_pt & ((x0 == x1) | (y0 == y1))
+    is_pg = ~is_pt & ~is_ln
+    types = np.where(is_pt, MVT_POINT, np.where(is_ln, MVT_LINESTRING,
+                                                MVT_POLYGON)).astype(np.uint8)
+    geoms = [b""] * m
+    zz = zigzag  # int64 zigzag == u32 zigzag for int32-range params
+
+    def _fill(mask, mat):
+        idx = np.flatnonzero(mask)
+        if not len(idx):
+            return
+        flat = mat.reshape(-1).astype(np.uint64)
+        buf = varint_encode(flat)
+        per = varint_lengths(flat).reshape(len(idx), -1).sum(axis=1)
+        offs = np.concatenate(([0], np.cumsum(per)))
+        for j, i in enumerate(idx):
+            geoms[i] = buf[offs[j] : offs[j + 1]]
+
+    if is_pt.any():
+        k = int(is_pt.sum())
+        mat = np.empty((k, 3), dtype=np.uint64)
+        mat[:, 0] = 9  # MoveTo, count 1
+        mat[:, 1] = zz(x0[is_pt])
+        mat[:, 2] = zz(y0[is_pt])
+        _fill(is_pt, mat)
+    if is_ln.any():
+        k = int(is_ln.sum())
+        mat = np.empty((k, 6), dtype=np.uint64)
+        mat[:, 0] = 9
+        mat[:, 1] = zz(x0[is_ln])
+        mat[:, 2] = zz(y0[is_ln])
+        mat[:, 3] = (1 << 3) | 2  # LineTo, count 1
+        mat[:, 4] = zz(x1[is_ln] - x0[is_ln])
+        mat[:, 5] = zz(y1[is_ln] - y0[is_ln])
+        _fill(is_ln, mat)
+    if is_pg.any():
+        k = int(is_pg.sum())
+        mat = np.empty((k, 11), dtype=np.uint64)
+        mat[:, 0] = 9
+        mat[:, 1] = zz(x0[is_pg])
+        mat[:, 2] = zz(y0[is_pg])
+        mat[:, 3] = (3 << 3) | 2  # LineTo, count 3
+        mat[:, 4] = zz(x1[is_pg] - x0[is_pg])
+        mat[:, 5] = zz(np.zeros(k, np.int64))
+        mat[:, 6] = zz(np.zeros(k, np.int64))
+        mat[:, 7] = zz(y1[is_pg] - y0[is_pg])
+        mat[:, 8] = zz(x0[is_pg] - x1[is_pg])
+        mat[:, 9] = zz(np.zeros(k, np.int64))
+        mat[:, 10] = 15  # ClosePath
+        _fill(is_pg, mat)
+    return types, geoms
+
+
+def encode_mvt_layer(layer_name, keys, boxes, extent=DEFAULT_EXTENT):
+    """Real MVT protobuf from the clipped/quantized arrays: one Tile
+    message holding one Layer named after the dataset, every feature's
+    envelope box as its geometry and its identity key as the feature id
+    (negative hash-keys ride as their two's-complement uint64). No blob
+    reads — this layer serves partial clones, like ``bin``/``ktb2``."""
+    keys = np.asarray(keys, dtype=np.int64)
+    types, geoms = _mvt_geometries(boxes)
+    id_codes = keys.astype(np.uint64)  # two's complement for negatives
+    id_buf = varint_encode(id_codes)
+    id_lens = varint_lengths(id_codes)
+    id_offs = np.concatenate(([0], np.cumsum(id_lens)))
+    features = []
+    for i in range(len(keys)):
+        body = b"".join(
+            (
+                b"\x08",  # field 1 (id), varint
+                id_buf[id_offs[i] : id_offs[i + 1]],
+                _pb_varint(3, int(types[i])),  # field 3 (type)
+                _pb_bytes(4, geoms[i]),  # field 4 (geometry, packed)
+            )
+        )
+        features.append(_pb_bytes(2, body))
+    layer_body = b"".join(
+        (
+            _pb_bytes(1, layer_name.encode()),
+            b"".join(features),
+            _pb_varint(5, extent),
+            _pb_varint(15, 2),  # version
+        )
+    )
+    return _pb_bytes(3, layer_body)
+
+
+def decode_mvt_layer(data):
+    """Minimal MVT reader (client/test side): -> dict with ``name``,
+    ``extent``, ``version`` and ``features`` — each feature a dict of
+    ``id``, ``type`` and decoded ``geometry`` (absolute coordinate pairs
+    per command run). Bounds-checked like every other decoder here."""
+    def read_uvarint(buf, pos):
+        # scalar on purpose: the vectorized varint_decode scans the whole
+        # remaining buffer per call, which would make this walker O(n^2)
+        # over a large feature list
+        out = shift = 0
+        while True:
+            if pos >= len(buf):
+                raise TileEncodeError("Truncated MVT varint")
+            b = buf[pos]
+            pos += 1
+            out |= (b & 0x7F) << shift
+            if not (b & 0x80):
+                return out, pos
+            shift += 7
+            if shift > 63:
+                raise TileEncodeError("MVT varint longer than 10 bytes")
+
+    def walk(buf):
+        fields = []
+        pos = 0
+        while pos < len(buf):
+            key, pos = read_uvarint(buf, pos)
+            field, wire = key >> 3, key & 7
+            if wire == 0:
+                val, pos = read_uvarint(buf, pos)
+                fields.append((field, val))
+            elif wire == 2:
+                ln, pos = read_uvarint(buf, pos)
+                if pos + ln > len(buf):
+                    raise TileEncodeError("Truncated MVT submessage")
+                fields.append((field, buf[pos : pos + ln]))
+                pos += ln
+            else:
+                raise TileEncodeError(f"Unsupported MVT wire type {wire}")
+        return fields
+
+    def geometry(buf):
+        vals, _pos = varint_decode(buf, _count_varints(buf))
+        out, i, cur = [], 0, (0, 0)
+        while i < len(vals):
+            word = int(vals[i])
+            i += 1
+            cmd, n = word & 7, word >> 3
+            if cmd == 7:
+                out.append(("close",))
+                continue
+            pts = []
+            for _ in range(n):
+                dx = int(_unzz(vals[i]))
+                dy = int(_unzz(vals[i + 1]))
+                i += 2
+                cur = (cur[0] + dx, cur[1] + dy)
+                pts.append(cur)
+            out.append(("move" if cmd == 1 else "line", pts))
+        return out
+
+    def _unzz(u):
+        u = int(u)
+        return (u >> 1) ^ -(u & 1)
+
+    def _count_varints(buf):
+        return int(np.count_nonzero(np.frombuffer(buf, np.uint8) < 0x80))
+
+    layers = [v for f, v in walk(data) if f == 3]
+    if len(layers) != 1:
+        raise TileEncodeError(f"MVT tile holds {len(layers)} layers, not 1")
+    out = {"features": []}
+    for field, value in walk(layers[0]):
+        if field == 1:
+            out["name"] = value.decode()
+        elif field == 5:
+            out["extent"] = value
+        elif field == 15:
+            out["version"] = value
+        elif field == 2:
+            feat = {}
+            for ff, fv in walk(value):
+                if ff == 1:
+                    feat["id"] = np.uint64(fv).astype(np.int64).item()
+                elif ff == 3:
+                    feat["type"] = fv
+                elif ff == 4:
+                    feat["geometry"] = geometry(fv)
+            out["features"].append(feat)
+    return out
+
+
+# ---------------------------------------------------------------------------
+# the tile encoder
+# ---------------------------------------------------------------------------
+
+
+def build_layers(source, layers, rows, boxes, extent=DEFAULT_EXTENT):
+    """The selected/quantized arrays -> {layer name: layer bytes} — shared
+    by the serving encoder and the batch pyramid exporter (one set of
+    builders, so export files are byte-identical to served payloads)."""
+    built = {}
+    count = len(rows)
+    keys = None
+    if any(name in layers for name in ("bin", "ktb2", "mvt")):
+        keys = np.ascontiguousarray(source.block.keys[rows], dtype="<i8")
+    lines = None
+    if any(name in layers for name in ("geojson", "props")):
+        ds = source.dataset
+        pks = source.pks_for_rows(rows)
+        blobs = source.feature_blobs(rows)
+        lines = [
+            ds.feature_json_str_from_data(pk, data)
+            for pk, data in zip(pks, blobs)
+        ]
+    if "bin" in layers:
+        built["bin"] = encode_bin_layer(keys, boxes)
+    if "ktb2" in layers:
+        built["ktb2"] = encode_ktb2_layer(keys, boxes)
+    if "mvt" in layers:
+        built["mvt"] = encode_mvt_layer(source.ds_path, keys, boxes, extent)
+    if "geojson" in layers:
+        built["geojson"] = (
+            ("\n".join(lines) + "\n").encode() if lines else b""
+        )
+    if "props" in layers:
+        built["props"] = encode_props_layer([l.encode() for l in lines])
+    return built
+
+
+def assemble_payload(source, z, x, y, layers, built, count, *,
+                     extent=DEFAULT_EXTENT, buffer=DEFAULT_BUFFER):
+    """Layer bytes -> the framed deterministic payload."""
+    header = {
+        "v": PAYLOAD_VERSION,
+        "commit": source.commit_oid,
+        "dataset": source.ds_path,
+        "tile": [z, x, y],
+        "bbox": list(tile_bounds_wsen(z, x, y)),
+        "extent": extent,
+        "buffer": buffer,
+        "count": count,
+        "layers": {name: len(built[name]) for name in layers},
+    }
+    raw_header = json.dumps(
+        header, sort_keys=True, separators=(",", ":")
+    ).encode()
+    return b"".join(
+        [_HEADER_LEN.pack(len(raw_header)), raw_header]
+        + [built[name] for name in layers]
+    )
 
 
 def encode_tile(source, z, x, y, *, layers=None, extent=DEFAULT_EXTENT,
@@ -128,65 +592,106 @@ def encode_tile(source, z, x, y, *, layers=None, extent=DEFAULT_EXTENT,
         if max_features and count > max_features:
             raise TileTooLarge(count, max_features, (z, x, y))
 
-        built = {}
-        if "bin" in layers:
-            keys = np.ascontiguousarray(
-                source.block.keys[rows], dtype="<i8"
-            )
-            built["bin"] = b"".join(
-                (
-                    BIN_MAGIC,
-                    struct.pack("<I", count),
-                    keys.tobytes(),
-                    np.ascontiguousarray(boxes, dtype="<i4").tobytes(),
-                )
-            )
-        if "geojson" in layers:
-            ds = source.dataset
-            pks = source.pks_for_rows(rows)
-            blobs = source.feature_blobs(rows)
-            lines = [
-                ds.feature_json_str_from_data(pk, data)
-                for pk, data in zip(pks, blobs)
-            ]
-            built["geojson"] = (
-                ("\n".join(lines) + "\n").encode() if lines else b""
-            )
+        built = build_layers(source, layers, rows, boxes, extent)
         faults.fire("tiles.encode")  # frame 2: layers built, not assembled
-
-        header = {
-            "v": PAYLOAD_VERSION,
-            "commit": source.commit_oid,
-            "dataset": source.ds_path,
-            "tile": [z, x, y],
-            "bbox": list(tile_bounds_wsen(z, x, y)),
-            "extent": extent,
-            "buffer": buffer,
-            "count": count,
-            "layers": {name: len(built[name]) for name in layers},
-        }
-        raw_header = json.dumps(
-            header, sort_keys=True, separators=(",", ":")
-        ).encode()
-        payload = b"".join(
-            [_HEADER_LEN.pack(len(raw_header)), raw_header]
-            + [built[name] for name in layers]
+        payload = assemble_payload(
+            source, z, x, y, layers, built, count, extent=extent,
+            buffer=buffer,
         )
     tm.incr("tiles.features_out", count)
     stats = dict(stats, count=count)
     return payload, stats
 
 
+def encode_tile_batch(source, addresses, *, layers=None,
+                      extent=DEFAULT_EXTENT, buffer=DEFAULT_BUFFER,
+                      max_features=None, allow_device=True):
+    """The pyramid exporter's batch encoder: encode a batch of tiles with
+    ONE mercator projection for the whole batch, routed through the
+    DiffBackend seam (``diff.backend.project_envelopes`` — host numpy, or
+    ``shard_map`` over the device mesh when the probe says devices are
+    live). Selection/refine stay per-tile host work; the fp-heavy
+    projection is the part that batches.
+
+    -> list aligned with ``addresses``: ``("ok", payload, count)`` |
+    ``("empty", None, 0)`` | ``("too_large", None, count)``. Payload bytes
+    are **identical** to :func:`encode_tile` for every tile — host batches
+    share the serving ops; device batches are boundary-patched
+    (:func:`kart_tpu.tiles.clip.quantize_from_merc`)."""
+    from kart_tpu.diff.backend import project_envelopes
+
+    layers = normalise_layers(layers)
+    if max_features is None:
+        max_features = max_features_limit()
+    envelopes = source.envelopes()
+
+    selected = []  # (z, x, y, rows, env) per non-empty candidate tile
+    for z, x, y in addresses:
+        rows, _stats = source.rows_for_bbox(tile_query_wsen(z, x, y))
+        rows, env = refine_rows(envelopes, rows, z, x, y)
+        selected.append((z, x, y, rows, env))
+
+    env_cat = np.concatenate(
+        [env for *_addr, _rows, env in selected]
+    ) if selected else np.zeros((0, 4), np.float64)
+    merc_cat = project_envelopes(env_cat, allow_device=allow_device)
+
+    out = []
+    pos = 0
+    for z, x, y, rows, env in selected:
+        count = len(rows)
+        merc = tuple(col[pos : pos + count] for col in merc_cat)
+        pos += count
+        if count == 0:
+            out.append(("empty", None, 0))
+            continue
+        if max_features and count > max_features:
+            out.append(("too_large", None, count))
+            continue
+        boxes = quantize_from_merc(
+            env, merc, z, x, y, extent=extent, buffer=buffer
+        )
+        built = build_layers(source, layers, rows, boxes, extent)
+        payload = assemble_payload(
+            source, z, x, y, layers, built, count, extent=extent,
+            buffer=buffer,
+        )
+        tm.incr("tiles.features_out", count)
+        out.append(("ok", payload, count))
+    return out
+
+
 def parse_payload(data):
     """Payload bytes -> (header dict, {layer name: layer bytes}) — the
-    client/test-side decoder."""
+    client/test-side decoder. Bounds-checked (ISSUE 15 satellite): a
+    clipped or padded payload raises :class:`TileEncodeError` at the first
+    inconsistency — no layer is ever silently short-read."""
+    if len(data) < _HEADER_LEN.size:
+        raise TileEncodeError("Tile payload shorter than its length prefix")
     (n,) = _HEADER_LEN.unpack_from(data, 0)
     pos = _HEADER_LEN.size
-    header = json.loads(data[pos : pos + n].decode())
+    if n > len(data) - pos:
+        raise TileEncodeError(
+            f"Tile header declares {n} bytes; {len(data) - pos} present"
+        )
+    try:
+        header = json.loads(data[pos : pos + n].decode())
+    except (ValueError, UnicodeDecodeError) as e:
+        raise TileEncodeError(f"Malformed tile header: {e}")
     pos += n
+    sizes = header.get("layers")
+    if not isinstance(sizes, dict) or not all(
+        isinstance(v, int) and v >= 0 for v in sizes.values()
+    ):
+        raise TileEncodeError("Malformed tile header: bad layers table")
     layer_bytes = {}
-    for name in sorted(header["layers"]):
-        size = header["layers"][name]
+    for name in sorted(sizes):
+        size = sizes[name]
+        if pos + size > len(data):
+            raise TileEncodeError(
+                f"Tile layer {name!r} declares {size} bytes; "
+                f"{len(data) - pos} remain"
+            )
         layer_bytes[name] = data[pos : pos + size]
         pos += size
     if pos != len(data):
@@ -194,15 +699,3 @@ def parse_payload(data):
             f"Tile payload length mismatch ({pos} headered vs {len(data)} actual)"
         )
     return header, layer_bytes
-
-
-def decode_bin_layer(data):
-    """``bin`` layer bytes -> (int64 keys (M,), int32 boxes (M, 4))."""
-    if data[:4] != BIN_MAGIC:
-        raise TileEncodeError("Bad binary tile layer magic")
-    (count,) = struct.unpack_from("<I", data, 4)
-    pos = 8
-    keys = np.frombuffer(data, dtype="<i8", count=count, offset=pos)
-    pos += 8 * count
-    boxes = np.frombuffer(data, dtype="<i4", count=4 * count, offset=pos)
-    return keys, boxes.reshape(count, 4)

@@ -1,26 +1,75 @@
 """Batch tile export: walk a zoom pyramid over a dataset's extent and
 write every non-empty tile payload to disk (`kart export tiles`).
 
-The walker enumerates only tiles whose address range covers the dataset's
-overall envelope (derived from the sidecar columns — no feature reads) and
-prunes per-tile exactly like the serving path, so exporting a sparse
-dataset at a deep zoom visits the data's tiles, not 4**z of them. Tiles
-land as ``<out>/<z>/<x>/<y>.ktile`` (the complete framed payload,
-byte-identical to what ``GET /api/v1/tiles/...`` serves for the same
-commit — one wire format, docs/TILES.md §4).
+Rebuilt as a **parallel encoder** (ISSUE 15): the tile cover is enumerated
+once (only addresses over the dataset's sidecar-derived envelope — a
+sparse dataset visits its tiles, not ``4**z``), chunked into batches, and
+the batches are encoded
+
+* by a pool of forked worker processes (``KART_EXPORT_WORKERS``, default:
+  the core count on a ≥4-core box) each holding its own mmap'd
+  :class:`~kart_tpu.tiles.source.TileSource` — the default, or
+* in-process with each batch's mercator projection routed through the
+  DiffBackend seam (``diff.backend.project_envelopes`` — ``shard_map``
+  over the device mesh when the probe says devices are live; the first
+  non-diff device workload).
+
+Either way the results flow through a **bounded, ordered writer** (the
+PR 5 pipeline discipline): batches are consumed strictly in enumeration
+order, each file lands tmp+rename, and the payload bytes are
+byte-identical to the serving path for the same commit — so an export is
+deterministic for a given (commit, layers, zooms) regardless of worker
+count or backend, and a killed export leaves a clean deterministic prefix
+(the ``tiles.export`` fault point arms every batch boundary;
+tests/test_faults.py). Tiles land as ``<out>/<z>/<x>/<y>.ktile`` (the
+complete framed payload — one wire format, docs/TILES.md §4).
+
+Tiles over the feature ceiling are skipped-and-recorded (``tiles_skipped``
+in the stats); ``kart export tiles --strict`` turns a non-empty skip list
+into a hard failure (a silently incomplete pyramid is the satellite bug
+this closes).
 """
 
 import os
+from collections import deque
 
 import numpy as np
 
+from kart_tpu import faults
 from kart_tpu import telemetry as tm
-from kart_tpu.tiles.encode import TileTooLarge, encode_tile
+from kart_tpu.tiles.encode import encode_tile_batch
 from kart_tpu.tiles.grid import (
     DEFAULT_BUFFER,
     DEFAULT_EXTENT,
     tile_range_for_bbox,
 )
+
+#: tiles per encode batch (``KART_EXPORT_BATCH_TILES`` overrides): large
+#: enough to amortise a device round, small enough that the ordered
+#: writer's window stays bounded
+DEFAULT_BATCH_TILES = 64
+
+
+def export_workers():
+    """Worker count for the pool path: ``KART_EXPORT_WORKERS`` when set
+    (1 = serial in-process, the device-seam route), else the core count on
+    a ≥4-core box (mirrors the importer's fan-out heuristic — a 1-2 core
+    box gains nothing from pool startup)."""
+    from kart_tpu.transport.retry import _env_int
+
+    configured = _env_int("KART_EXPORT_WORKERS", 0)
+    if configured > 0:
+        return configured
+    cores = os.cpu_count()
+    if cores is None or cores < 4:
+        return 1
+    return cores
+
+
+def export_batch_tiles():
+    from kart_tpu.transport.retry import _env_int
+
+    return max(1, _env_int("KART_EXPORT_BATCH_TILES", DEFAULT_BATCH_TILES))
 
 
 def dataset_bbox_wsen(source):
@@ -50,56 +99,170 @@ def dataset_bbox_wsen(source):
     )
 
 
+def tile_cover(source, zooms):
+    """Enumerate the export's tile addresses ONCE: every (z, x, y) whose
+    address range covers the dataset envelope, in deterministic
+    z-then-x-then-y order (the ordered writer's sequence). A lazy
+    generator — a deep-zoom cover over a wide extent is 4**z addresses
+    and must stream through the batcher, never materialise."""
+    bbox = dataset_bbox_wsen(source)
+    for z in zooms:
+        x0, y0, x1, y1 = tile_range_for_bbox(z, bbox)
+        for x in range(x0, x1 + 1):
+            for y in range(y0, y1 + 1):
+                yield (z, x, y)
+
+
+def cover_size(source, zooms):
+    """How many addresses :func:`tile_cover` will yield (arithmetic on the
+    ranges — nothing is enumerated)."""
+    bbox = dataset_bbox_wsen(source)
+    total = 0
+    for z in zooms:
+        x0, y0, x1, y1 = tile_range_for_bbox(z, bbox)
+        total += (x1 - x0 + 1) * (y1 - y0 + 1)
+    return total
+
+
+def _batched(iterable, size):
+    batch = []
+    for item in iterable:
+        batch.append(item)
+        if len(batch) >= size:
+            yield batch
+            batch = []
+    if batch:
+        yield batch
+
+
+# ---------------------------------------------------------------------------
+# the fork-pool workers (host path): each process opens the repo itself and
+# builds its own mmap'd TileSource — nothing unpicklable crosses the pipe
+# ---------------------------------------------------------------------------
+
+_WORKER = {}
+
+
+def _pool_init(repo_path, commit_oid, ds_path):
+    from kart_tpu.core.repo import KartRepo
+    from kart_tpu.tiles.source import source_for
+
+    repo = KartRepo(repo_path)
+    _WORKER["source"] = source_for(repo, commit_oid, ds_path)  # kart: noqa(KTL005): pool initializer runs once in a freshly-forked single-threaded worker process before any task executes — there is no concurrent reader to race
+
+
+def _pool_encode(args):
+    addresses, layers, extent, buffer, max_features = args
+    return encode_tile_batch(
+        _WORKER["source"], addresses, layers=layers, extent=extent,
+        buffer=buffer, max_features=max_features, allow_device=False,
+    )
+
+
 def export_pyramid(source, zooms, out_dir, *, layers=None,
                    extent=DEFAULT_EXTENT, buffer=DEFAULT_BUFFER,
-                   max_features=None, progress=None):
+                   max_features=None, progress=None, workers=None,
+                   batch_tiles=None):
     """Export every non-empty tile of ``source`` at the given zoom levels.
 
     -> stats dict: ``tiles_written`` / ``tiles_empty`` /
     ``tiles_too_large`` (skipped with a record, not fatal — a pyramid
     export must not die at z0 where everything is one tile) /
-    ``features_out`` / ``bytes_out``. ``progress`` (optional callable)
-    receives (z, x, y, status) per visited tile."""
-    bbox = dataset_bbox_wsen(source)
+    ``tiles_skipped`` (the skipped addresses, for ``--strict``) /
+    ``features_out`` / ``bytes_out`` / ``export_workers``. ``progress``
+    (optional callable) receives (z, x, y, status) per visited tile.
+
+    Injectable crash frame (``KART_FAULTS=tiles.export:<n>``): the n-th
+    batch boundary of the ordered writer — a kill leaves every
+    previously-written tile complete and nothing of the doomed batch
+    (each file is tmp+rename; the re-run overwrites deterministically)."""
+    if workers is None:
+        workers = export_workers()
+    batch = batch_tiles if batch_tiles is not None else export_batch_tiles()
+    total = cover_size(source, zooms)
+    batches = _batched(tile_cover(source, zooms), batch)  # lazy: O(batch) memory
+    # the pool pays an interpreter fork + sidecar mmap per worker: only
+    # sidecar-backed sources (cheap child rebuild) with enough batches to
+    # spread qualify; fallback-envelope sources would re-run their O(N)
+    # blob scan per child
+    use_pool = (
+        workers > 1
+        and total > batch
+        and source.block.envelopes is not None
+    )
     stats = {
         "tiles_written": 0,
         "tiles_empty": 0,
         "tiles_too_large": 0,
+        "tiles_skipped": [],
         "features_out": 0,
         "bytes_out": 0,
+        "export_workers": workers if use_pool else 1,
     }
-    with tm.span("tiles.export", dataset=source.ds_path):
-        for z in zooms:
-            x0, y0, x1, y1 = tile_range_for_bbox(z, bbox)
-            for x in range(x0, x1 + 1):
-                z_dir = None
-                for y in range(y0, y1 + 1):
-                    try:
-                        payload, t_stats = encode_tile(
-                            source, z, x, y, layers=layers, extent=extent,
-                            buffer=buffer, max_features=max_features,
+
+    def _consume(batch_addresses, results):
+        """The ordered writer: one batch's results -> files + stats, in
+        enumeration order."""
+        faults.fire("tiles.export")  # batch boundary
+        for (z, x, y), (status, payload, count) in zip(
+            batch_addresses, results
+        ):
+            if status == "empty":
+                stats["tiles_empty"] += 1
+            elif status == "too_large":
+                stats["tiles_too_large"] += 1
+                stats["tiles_skipped"].append((z, x, y))
+            else:
+                z_dir = os.path.join(out_dir, str(z), str(x))
+                os.makedirs(z_dir, exist_ok=True)
+                path = os.path.join(z_dir, f"{y}.ktile")
+                tmp = path + f".tmp{os.getpid()}"
+                with open(tmp, "wb") as f:
+                    f.write(payload)
+                os.replace(tmp, path)
+                stats["tiles_written"] += 1
+                stats["features_out"] += count
+                stats["bytes_out"] += len(payload)
+            if progress is not None:
+                progress(z, x, y, status if status != "ok" else "written")
+
+    with tm.span("tiles.export", dataset=source.ds_path, tiles=total):
+        tm.gauge_set("tiles.export_workers", stats["export_workers"])
+        if use_pool:
+            from concurrent.futures import ProcessPoolExecutor
+
+            repo_path = source.repo.workdir or source.repo.gitdir
+            with ProcessPoolExecutor(
+                max_workers=workers,
+                initializer=_pool_init,
+                initargs=(repo_path, source.commit_oid, source.ds_path),
+            ) as pool:
+                # bounded submission window + strictly-ordered consumption
+                # (the PR 5 ordered-queue discipline, futures edition)
+                window = deque()
+                for b in batches:
+                    window.append(
+                        (
+                            b,
+                            pool.submit(
+                                _pool_encode,
+                                (b, layers, extent, buffer, max_features),
+                            ),
                         )
-                    except TileTooLarge:
-                        stats["tiles_too_large"] += 1
-                        if progress is not None:
-                            progress(z, x, y, "too_large")
-                        continue
-                    if t_stats["count"] == 0:
-                        stats["tiles_empty"] += 1
-                        if progress is not None:
-                            progress(z, x, y, "empty")
-                        continue
-                    if z_dir is None:
-                        z_dir = os.path.join(out_dir, str(z), str(x))
-                        os.makedirs(z_dir, exist_ok=True)
-                    path = os.path.join(z_dir, f"{y}.ktile")
-                    tmp = path + f".tmp{os.getpid()}"
-                    with open(tmp, "wb") as f:
-                        f.write(payload)
-                    os.replace(tmp, path)
-                    stats["tiles_written"] += 1
-                    stats["features_out"] += t_stats["count"]
-                    stats["bytes_out"] += len(payload)
-                    if progress is not None:
-                        progress(z, x, y, "written")
+                    )
+                    if len(window) >= workers * 2:
+                        done_batch, fut = window.popleft()
+                        _consume(done_batch, fut.result())
+                while window:
+                    done_batch, fut = window.popleft()
+                    _consume(done_batch, fut.result())
+        else:
+            for b in batches:
+                _consume(
+                    b,
+                    encode_tile_batch(
+                        source, b, layers=layers, extent=extent,
+                        buffer=buffer, max_features=max_features,
+                    ),
+                )
     return stats

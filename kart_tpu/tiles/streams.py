@@ -1,0 +1,445 @@
+"""Columnar integer-stream codecs for the KTB2 tile layer
+(docs/TILES.md §4; the MapLibre Tile paper's lightweight compression
+ladder, arxiv 2508.10791 §3).
+
+One tile column (sorted identity keys, a quantized box coordinate) is one
+*stream*: a 5-byte header — encoding id + payload byte length — followed
+by the encoded payload. The encoder picks the cheapest encoding per column
+by an exact cost probe (sizes are computed without encoding, all
+vectorized), so a constant column costs ~7 bytes, a sorted dense key
+column costs ~1 byte/row, and an adversarial column degrades to the raw
+fixed-width bytes it would have cost anyway. The choice is recorded in the
+header, so the decoder dispatches **once per stream** and every decode
+path below is whole-array numpy — no per-value Python loop, no per-value
+branching (the paper's vectorization argument, §5).
+
+Encodings (all little-endian; varints are LEB128, zigzag maps signed to
+unsigned):
+
+====  =========  ==========================================================
+id    name       payload
+====  =========  ==========================================================
+0     raw        ``count`` fixed-width values (the column's wire dtype)
+1     rle        varint run count, then per run: varint length,
+                 zigzag-varint value — the constant/piecewise-constant
+                 fast path (quantized boxes of gridded data)
+2     for        zigzag-varint base (column min), u8 bit width ``w``,
+                 ``ceil(count*w/8)`` bytes of big-endian-within-value
+                 bit-packed ``value - base`` (frame of reference;
+                 ``w == 0`` is the all-constant degenerate)
+3     dvarint    zigzag-varint first value, then ``count-1`` zigzag
+                 varint deltas (sorted keys: deltas are small)
+4     dfor       zigzag-varint first value, then FOR over the deltas:
+                 zigzag-varint delta base, u8 width, packed delta bits
+====  =========  ==========================================================
+
+Decode is bounds-checked end to end: a truncated or oversized payload
+raises :class:`TileEncodeError` — ``np.frombuffer`` is never allowed to
+short-read (ISSUE 15 satellite; the fuzz test clips payloads at every
+prefix). Injectable crash frames (``KART_FAULTS=tiles.streams:<n>``) fire
+at stream-set encode entry (frame semantics per call site: encode before
+any bytes are built, decode before any bytes are trusted).
+"""
+
+import struct
+
+import numpy as np
+
+from kart_tpu import telemetry as tm
+
+
+class TileEncodeError(ValueError):
+    """Malformed, truncated or oversized tile payload/stream bytes."""
+
+
+#: encoding ids (stream header byte)
+RAW, RLE, FOR, DVARINT, DFOR = 0, 1, 2, 3, 4
+
+ENCODING_NAMES = {RAW: "raw", RLE: "rle", FOR: "for", DVARINT: "dvarint",
+                  DFOR: "dfor"}
+
+_STREAM_HEADER = struct.Struct("<BI")  # encoding id, payload byte length
+
+
+# ---------------------------------------------------------------------------
+# zigzag + varint primitives (vectorized)
+# ---------------------------------------------------------------------------
+
+
+def zigzag(values):
+    """int64 column -> uint64 zigzag codes (small magnitudes stay small)."""
+    v = np.asarray(values, dtype=np.int64)
+    return ((v << 1) ^ (v >> 63)).astype(np.uint64)
+
+
+def unzigzag(codes):
+    """uint64 zigzag codes -> int64 column."""
+    u = np.asarray(codes, dtype=np.uint64)
+    return ((u >> 1).astype(np.int64)) ^ -(u & 1).astype(np.int64)
+
+
+def varint_lengths(codes):
+    """Exact LEB128 byte length per uint64 code — the cost probe's
+    workhorse (no bytes are built)."""
+    u = np.asarray(codes, dtype=np.uint64)
+    n = np.ones(len(u), dtype=np.int64)
+    for k in range(1, 10):
+        n += (u >= np.uint64(1) << np.uint64(7 * k)).astype(np.int64)
+    return n
+
+
+def varint_encode(codes):
+    """uint64 codes -> LEB128 bytes, fully vectorized (one pass per byte
+    slot, 10 slots max for 64-bit)."""
+    u = np.asarray(codes, dtype=np.uint64)
+    if not len(u):
+        return b""
+    lens = varint_lengths(u)
+    offsets = np.concatenate(([0], np.cumsum(lens)[:-1]))
+    out = np.zeros(int(lens.sum()), dtype=np.uint8)
+    for j in range(10):
+        mask = lens > j
+        if not mask.any():
+            break
+        chunk = ((u[mask] >> np.uint64(7 * j)) & np.uint64(0x7F)).astype(
+            np.uint8
+        )
+        cont = (lens[mask] - 1 > j).astype(np.uint8) << 7
+        out[offsets[mask] + j] = chunk | cont
+    return out.tobytes()
+
+
+def varint_decode(data, count, pos=0):
+    """-> (uint64 codes (count,), next pos). Bounds-checked: fewer than
+    ``count`` complete varints in ``data[pos:]`` raises. Vectorized via
+    terminator positions + ``np.add.reduceat``."""
+    buf = np.frombuffer(data, dtype=np.uint8)
+    if count == 0:
+        return np.zeros(0, dtype=np.uint64), pos
+    ends = np.flatnonzero(buf[pos:] < 0x80)
+    if len(ends) < count:
+        raise TileEncodeError(
+            f"Truncated varint stream: {len(ends)} complete values of "
+            f"{count} expected"
+        )
+    ends = ends[:count] + pos  # inclusive terminator positions
+    starts = np.concatenate(([pos], ends[:-1] + 1))
+    if np.any(ends - starts >= 10):
+        raise TileEncodeError("Varint value longer than 10 bytes")
+    idx_in_group = np.arange(pos, ends[-1] + 1) - np.repeat(
+        starts, ends - starts + 1
+    )
+    window = (buf[pos : ends[-1] + 1] & 0x7F).astype(np.uint64) << (
+        np.uint64(7) * idx_in_group.astype(np.uint64)
+    )
+    codes = np.add.reduceat(window, starts - pos)
+    return codes, int(ends[-1]) + 1
+
+
+# ---------------------------------------------------------------------------
+# bit packing (frame-of-reference payloads)
+# ---------------------------------------------------------------------------
+
+
+def bit_width(umax):
+    """Bits needed for the largest offset in a FOR frame (0 for an
+    all-constant column)."""
+    return int(umax).bit_length()
+
+
+def bitpack(offsets, width):
+    """uint64 offsets (< 2**width) -> packed bytes, big-endian within each
+    value (``np.packbits`` order)."""
+    if width == 0 or not len(offsets):
+        return b""
+    u = np.asarray(offsets, dtype=np.uint64)
+    shifts = np.arange(width - 1, -1, -1, dtype=np.uint64)
+    bits = ((u[:, None] >> shifts[None, :]) & np.uint64(1)).astype(np.uint8)
+    return np.packbits(bits.reshape(-1)).tobytes()
+
+
+def bitunpack(data, count, width, pos=0):
+    """packed bytes -> uint64 offsets (count,); bounds-checked."""
+    if width == 0 or count == 0:
+        return np.zeros(count, dtype=np.uint64)
+    nbytes = (count * width + 7) // 8
+    if pos + nbytes > len(data):
+        raise TileEncodeError(
+            f"Truncated bit-packed stream: {len(data) - pos} bytes of "
+            f"{nbytes} expected"
+        )
+    buf = np.frombuffer(data, dtype=np.uint8, count=nbytes, offset=pos)
+    bits = np.unpackbits(buf, count=count * width).reshape(count, width)
+    weights = (np.uint64(1) << np.arange(width - 1, -1, -1, dtype=np.uint64))
+    return (bits.astype(np.uint64) * weights[None, :]).sum(
+        axis=1, dtype=np.uint64
+    )
+
+
+# ---------------------------------------------------------------------------
+# run-length helpers
+# ---------------------------------------------------------------------------
+
+
+def _runs(values):
+    """-> (run start indices, run values, run lengths) of a column."""
+    v = np.asarray(values)
+    if not len(v):
+        return (np.zeros(0, np.int64),) * 3
+    starts = np.concatenate(([0], np.flatnonzero(v[1:] != v[:-1]) + 1))
+    lengths = np.diff(np.concatenate((starts, [len(v)])))
+    return starts, v[starts], lengths
+
+
+# ---------------------------------------------------------------------------
+# the per-column encoder: exact cost probe -> cheapest encoding
+# ---------------------------------------------------------------------------
+
+_DTYPES = {"i4": np.dtype("<i4"), "i8": np.dtype("<i8")}
+
+
+def _probe_sizes(v, itemsize):
+    """Exact encoded payload size per candidate encoding, computed without
+    building any bytes (all O(n) vectorized)."""
+    n = len(v)
+    sizes = {RAW: n * itemsize}
+    if n == 0:
+        return sizes
+    # rle
+    _starts, run_vals, run_lens = _runs(v)
+    sizes[RLE] = int(
+        varint_lengths(np.asarray([len(run_vals)], np.uint64))[0]
+        + varint_lengths(run_lens.astype(np.uint64)).sum()
+        + varint_lengths(zigzag(run_vals)).sum()
+    )
+    # for
+    lo, hi = int(v.min()), int(v.max())
+    w = bit_width(np.uint64(hi - lo))
+    sizes[FOR] = int(
+        varint_lengths(zigzag(np.asarray([lo], np.int64)))[0]
+        + 1
+        + (n * w + 7) // 8
+    )
+    # delta family
+    first_len = int(varint_lengths(zigzag(v[:1]))[0])
+    if n > 1:
+        deltas = v[1:] - v[:-1]
+        sizes[DVARINT] = first_len + int(varint_lengths(zigzag(deltas)).sum())
+        dlo, dhi = int(deltas.min()), int(deltas.max())
+        dw = bit_width(np.uint64(dhi - dlo))
+        sizes[DFOR] = (
+            first_len
+            + int(varint_lengths(zigzag(np.asarray([dlo], np.int64)))[0])
+            + 1
+            + ((n - 1) * dw + 7) // 8
+        )
+    else:
+        sizes[DVARINT] = first_len
+    return sizes
+
+
+def encode_stream(values, dtype="i8", force=None):
+    """One int column -> stream bytes (header + cheapest payload).
+
+    ``dtype``: the column's raw wire dtype ("i4" | "i8") — only the RAW
+    encoding and the decode-side output dtype depend on it. ``force`` pins
+    an encoding id (tests exercise every ladder branch)."""
+    wire = _DTYPES[dtype]
+    v = np.ascontiguousarray(values, dtype=np.int64)
+    sizes = _probe_sizes(v, wire.itemsize)
+    enc = force if force is not None else min(sizes, key=lambda k: (sizes[k], k))
+
+    if enc == RAW:
+        payload = np.ascontiguousarray(v, dtype=wire).tobytes()
+    elif enc == RLE:
+        _starts, run_vals, run_lens = _runs(v)
+        payload = (
+            varint_encode(np.asarray([len(run_vals)], np.uint64))
+            + varint_encode(run_lens.astype(np.uint64))
+            + varint_encode(zigzag(run_vals))
+        )
+    elif enc == FOR:
+        lo = int(v.min()) if len(v) else 0
+        w = bit_width(np.uint64(int(v.max()) - lo)) if len(v) else 0
+        payload = (
+            varint_encode(zigzag(np.asarray([lo], np.int64)))
+            + struct.pack("<B", w)
+            + bitpack((v - lo).astype(np.uint64), w)
+        )
+    elif enc == DVARINT:
+        if len(v):
+            codes = zigzag(np.concatenate((v[:1], v[1:] - v[:-1])))
+        else:
+            codes = np.zeros(0, np.uint64)
+        payload = varint_encode(codes)
+    elif enc == DFOR:
+        if len(v) < 2:
+            # degenerate: dfor needs a delta frame; encode as dvarint shape
+            return encode_stream(v, dtype, force=DVARINT)
+        deltas = v[1:] - v[:-1]
+        dlo = int(deltas.min())
+        dw = bit_width(np.uint64(int(deltas.max()) - dlo))
+        payload = (
+            varint_encode(zigzag(v[:1]))
+            + varint_encode(zigzag(np.asarray([dlo], np.int64)))
+            + struct.pack("<B", dw)
+            + bitpack((deltas - dlo).astype(np.uint64), dw)
+        )
+    else:
+        raise TileEncodeError(f"Unknown stream encoding id {enc}")
+    tm.incr("tiles.streams_encoded")
+    return _STREAM_HEADER.pack(enc, len(payload)) + payload
+
+
+def decode_stream(data, count, dtype="i8", pos=0):
+    """Stream bytes at ``pos`` -> (values (count,) of ``dtype``, next pos).
+    One dispatch on the recorded encoding; every branch below it is
+    whole-array numpy. Bounds-checked throughout."""
+    wire = _DTYPES[dtype]
+    if pos + _STREAM_HEADER.size > len(data):
+        raise TileEncodeError("Truncated stream header")
+    enc, nbytes = _STREAM_HEADER.unpack_from(data, pos)
+    pos += _STREAM_HEADER.size
+    end = pos + nbytes
+    if end > len(data):
+        raise TileEncodeError(
+            f"Truncated stream payload: {len(data) - pos} bytes of "
+            f"{nbytes} declared"
+        )
+    body = data[pos:end]
+
+    # every branch reports the bytes it actually consumed: a payload padded
+    # inside its declared length must raise, not decode — two distinct byte
+    # strings decoding to one logical column would break the canonical-
+    # bytes assumption the ETag/cache design leans on
+    consumed = None
+    if enc == RAW:
+        if nbytes != count * wire.itemsize:
+            raise TileEncodeError(
+                f"Raw stream holds {nbytes} bytes for {count} "
+                f"{wire.itemsize}-byte values"
+            )
+        out = np.frombuffer(body, dtype=wire, count=count).astype(np.int64)
+        consumed = nbytes
+    elif enc == RLE:
+        head, p = varint_decode(body, 1)
+        n_runs = int(head[0])
+        run_lens, p = varint_decode(body, n_runs, p)
+        run_vals, p = varint_decode(body, n_runs, p)
+        lens = run_lens.astype(np.int64)
+        if int(lens.sum()) != count or (n_runs and int(lens.min()) <= 0):
+            raise TileEncodeError(
+                f"RLE runs sum to {int(lens.sum())}, column holds {count}"
+            )
+        out = np.repeat(unzigzag(run_vals), lens)
+        consumed = p
+    elif enc == FOR:
+        base, p = varint_decode(body, 1)
+        if p + 1 > len(body):
+            raise TileEncodeError("Truncated FOR stream width byte")
+        w = body[p]
+        p += 1
+        if w > 64:
+            raise TileEncodeError(f"FOR bit width {w} > 64")
+        offs = bitunpack(body, count, w, p)
+        out = unzigzag(base)[0] + offs.astype(np.int64)
+        consumed = p + (count * w + 7) // 8
+    elif enc in (DVARINT, DFOR):
+        if count == 0:
+            out = np.zeros(0, np.int64)
+            consumed = 0
+        elif enc == DVARINT:
+            codes, p = varint_decode(body, count)
+            out = np.cumsum(unzigzag(codes))
+            consumed = p
+        else:
+            first, p = varint_decode(body, 1)
+            dbase, p = varint_decode(body, 1, p)
+            if p + 1 > len(body):
+                raise TileEncodeError("Truncated DFOR stream width byte")
+            w = body[p]
+            p += 1
+            if w > 64:
+                raise TileEncodeError(f"DFOR bit width {w} > 64")
+            offs = bitunpack(body, count - 1, w, p)
+            deltas = unzigzag(dbase)[0] + offs.astype(np.int64)
+            out = np.cumsum(
+                np.concatenate((unzigzag(first), deltas))
+            )
+            consumed = p + ((count - 1) * w + 7) // 8
+    else:
+        raise TileEncodeError(f"Unknown stream encoding id {enc}")
+    if consumed != nbytes:
+        raise TileEncodeError(
+            f"Stream payload declares {nbytes} bytes but its "
+            f"{ENCODING_NAMES[enc]} encoding consumed {consumed}"
+        )
+    if len(out) != count:
+        raise TileEncodeError(
+            f"Stream decoded {len(out)} values, column holds {count}"
+        )
+    if dtype == "i4":
+        lo, hi = np.iinfo(np.int32).min, np.iinfo(np.int32).max
+        if len(out) and (int(out.min()) < lo or int(out.max()) > hi):
+            raise TileEncodeError("int32 stream value out of range")
+    return out.astype(wire), end
+
+
+# ---------------------------------------------------------------------------
+# the dictionary-coded byte-string stream (KTB2 properties)
+# ---------------------------------------------------------------------------
+
+
+def encode_bytes_stream(items):
+    """List of byte strings -> dictionary-coded stream: unique strings are
+    stored once (first-occurrence order, deterministic) and the column is
+    an index stream into them. When every row is unique the dictionary *is*
+    the column, so the overhead is one index stream of a sorted range —
+    which the FOR/dvarint ladder collapses to ~nothing.
+
+    Layout: varint n_unique, int-stream of unique byte lengths, the
+    concatenated unique bytes, int-stream of row indices."""
+    index = {}
+    idx_col = np.empty(len(items), dtype=np.int64)
+    uniques = []
+    for i, item in enumerate(items):
+        j = index.get(item)
+        if j is None:
+            j = index[item] = len(uniques)
+            uniques.append(item)
+        idx_col[i] = j
+    lens = np.asarray([len(u) for u in uniques], dtype=np.int64)
+    return b"".join(
+        (
+            varint_encode(np.asarray([len(uniques)], np.uint64)),
+            encode_stream(lens, "i8"),
+            b"".join(uniques),
+            encode_stream(idx_col, "i8"),
+        )
+    )
+
+
+def decode_bytes_stream(data, count, pos=0):
+    """-> (list of ``count`` byte strings, next pos); bounds-checked."""
+    head, pos = varint_decode(data, 1, pos)
+    n_unique = int(head[0])
+    if n_unique > max(count, 0):
+        raise TileEncodeError(
+            f"Dictionary holds {n_unique} uniques for {count} rows"
+        )
+    lens, pos = decode_stream(data, n_unique, "i8", pos)
+    if len(lens) and int(lens.min()) < 0:
+        raise TileEncodeError("Negative dictionary string length")
+    total = int(lens.sum())
+    if pos + total > len(data):
+        raise TileEncodeError(
+            f"Truncated dictionary blob: {len(data) - pos} bytes of {total}"
+        )
+    uniques = []
+    for n in lens:
+        uniques.append(bytes(data[pos : pos + int(n)]))
+        pos += int(n)
+    idx, pos = decode_stream(data, count, "i8", pos)
+    if len(idx) and (int(idx.min()) < 0 or int(idx.max()) >= n_unique):
+        raise TileEncodeError("Dictionary index out of range")
+    return [uniques[int(i)] for i in idx], pos

@@ -24,17 +24,24 @@ Endpoints (all JSON unless noted):
         per-dataset dirty-tile summaries, resume-by-sequence. Without
         ``since`` it is the subscribe handshake (current head, no wait).
         Behind the shed lane; ``KART_SERVE_EVENTS=0`` disables (404).
-    GET  <base>/api/v1/tiles/<ref>/<dataset>/<z>/<x>/<y>[?layers=bin,geojson]
+    GET  <base>/api/v1/tiles/<ref>/<dataset>/<z>/<x>/<y>[?layers=...][&format=mvt]
         -> one framed tile payload (docs/TILES.md): vector tile of the
         named ref's commit, served straight off the columnar sidecar —
         block-pruned, commit-addressed-cached, strong ETag (the ref is
         pinned to its commit oid at request time, so the validator never
         needs revalidation). ``<ref>`` is URL-encoded (refs/heads/main →
         refs%2Fheads%2Fmain); bare branch/tag names and commit oids work
-        unescaped. Tile requests ARE load-shed (429 + Retry-After past
-        the inflight ceiling) — unlike /api/v1/stats, a tile is ordinary
-        work. ``KART_SERVE_TILES=0`` (or ``kart serve --no-tiles``)
-        disables the endpoint (404).
+        unescaped. Layer negotiation (docs/TILES.md §5): ``?layers=``
+        picks from bin/geojson/ktb2/mvt/props; absent, the server default
+        (``KART_TILE_ENCODING``) applies; ``?format=mvt`` — or an
+        ``Accept: application/vnd.mapbox-vector-tile`` header — serves
+        the **bare MVT protobuf body** (no kart framing, its own strong
+        ETag) so off-the-shelf MapLibre clients can point a tile URL
+        template here. Responses carry ``Vary: Accept``. Tile requests
+        ARE load-shed (429 + Retry-After past the inflight ceiling) —
+        unlike /api/v1/stats, a tile is ordinary work.
+        ``KART_SERVE_TILES=0`` (or ``kart serve --no-tiles``) disables
+        the endpoint (404).
     POST <base>/api/v1/fetch-pack
         {"wants": [...], "haves": [...], "have_shallow": [...],
          "depth": N|null, "filter": "w,s,e,n"|null}
@@ -78,6 +85,10 @@ from kart_tpu.telemetry import context as rq_context
 from kart_tpu.transport.pack import read_pack, write_pack
 
 API = "/api/v1"
+
+#: the Mapbox Vector Tile media type: requesting it (Accept header or
+#: ``?format=mvt``) negotiates the bare protobuf representation of a tile
+_MVT_MIME = "application/vnd.mapbox-vector-tile"
 _HEADER_LEN = struct.Struct(">Q")
 
 #: default per-socket timeout (connect + each recv) for the quick JSON GETs
@@ -749,7 +760,24 @@ class KartRequestHandler(BaseHTTPRequestHandler):
                     tm.annotate(tile_pin=True)
                     ref = pinned
         query = urlsplit(self.path).query
-        layers = parse_qs(query).get("layers", [None])[0] if query else None
+        params = parse_qs(query) if query else {}
+        layers = params.get("layers", [None])[0]
+        fmt = params.get("format", [None])[0]
+        # content negotiation (docs/TILES.md §5): ?format=mvt — or, with
+        # no explicit layer spec, an MVT Accept header — means the client
+        # wants the bare protobuf body an off-the-shelf MapLibre renderer
+        # can consume; everything else gets the framed multi-layer payload
+        raw_mvt = False
+        if fmt is not None:
+            if fmt != "mvt":
+                return self._json(
+                    400, {"error": f"Unknown tile format {fmt!r} (try mvt)"}
+                )
+            raw_mvt = True
+            if layers is None:
+                layers = "mvt"
+        elif layers is None and self._accepts_mvt(self.headers.get("Accept")):
+            layers, raw_mvt = "mvt", True
         try:
             # the validator derives from the request key alone (commit oid
             # + address + layers): a revalidating client is answered 304
@@ -760,11 +788,22 @@ class KartRequestHandler(BaseHTTPRequestHandler):
                     self.repo, ref, ds_path, z, x, y, layers=layers
                 )
             )
+            if raw_mvt:
+                if norm_layers != ("mvt",):
+                    return self._json(
+                        400,
+                        {"error": "format=mvt serves exactly one layer: "
+                                  "mvt (drop layers= or set layers=mvt)"},
+                    )
+                # different representation bytes => different strong
+                # validator, even though one cache key backs both
+                etag = tiles.etag_for(key, raw=True)
             if self._if_none_match_hits(self.headers.get("If-None-Match"), etag):
                 # commit-addressed: a matching validator can never be stale
                 tm.annotate(revalidated=True)
                 self.send_response(304)
                 self.send_header("ETag", etag)
+                self.send_header("Vary", "Accept")
                 self.send_header("Content-Length", "0")
                 self.end_headers()
                 return
@@ -782,15 +821,17 @@ class KartRequestHandler(BaseHTTPRequestHandler):
                     tm.annotate(tile_cache="peer")
                     tm.incr("tiles.served")
                     tm.incr("tiles.bytes_out", len(payload))
-                    return self._send_tile(payload, etag)
+                    return self._send_tile(payload, etag, raw_mvt=raw_mvt)
                 peer_fill = peercache.tile_peer_fill(
                     self.repo, fleet.peers, commit_oid, ds_path, zi, xi, yi,
                     norm_layers,
                 )
-            payload, etag, _cached = tiles.serve_tile(
+            payload, framed_etag, _cached = tiles.serve_tile(
                 self.repo, ref, ds_path, zi, xi, yi, layers=norm_layers,
                 commit_oid=commit_oid, peer_fill=peer_fill,
             )
+            if not raw_mvt:
+                etag = framed_etag
         except tiles.TileTooLarge as e:
             return self._json(
                 413, {"error": str(e), "count": e.count, "limit": e.limit}
@@ -801,16 +842,55 @@ class KartRequestHandler(BaseHTTPRequestHandler):
             return self._json(404, {"error": str(e)})
         except (tiles.TileAddressError, tiles.TileEncodeError) as e:
             return self._json(400, {"error": str(e)})
-        self._send_tile(payload, etag)
+        self._send_tile(payload, etag, raw_mvt=raw_mvt)
 
-    def _send_tile(self, payload, etag):
+    @staticmethod
+    def _accepts_mvt(accept):
+        """Does the Accept header positively request the MVT media type?
+        RFC 9110 list form with q-values: a client sending
+        ``application/vnd.mapbox-vector-tile;q=0`` is *refusing* the type
+        — a substring test would hand it the bare protobuf anyway."""
+        if not accept:
+            return False
+        for part in accept.split(","):
+            media, _, params = part.partition(";")
+            if media.strip().lower() != _MVT_MIME:
+                continue
+            q = 1.0
+            for param in params.split(";"):
+                name, _, value = param.partition("=")
+                if name.strip().lower() == "q":
+                    try:
+                        q = float(value.strip())
+                    except ValueError:
+                        q = 1.0
+            return q > 0.0
+        return False
+
+    def _send_tile(self, payload, etag, raw_mvt=False):
+        if raw_mvt:
+            # unwrap the framed payload: the bare MVT body is what an
+            # off-the-shelf renderer consumes (the frame — and the cache
+            # entry behind it — still carries the layer). Note
+            # tiles.bytes_out deliberately counts the FRAMED bytes (the
+            # cache-entry size, consistent across representations); wire
+            # egress is transport.server.bytes_sent below.
+            from kart_tpu import tiles
+
+            _header, layer_bytes = tiles.parse_payload(payload)
+            payload = layer_bytes["mvt"]
         tm.incr("transport.server.bytes_sent", len(payload))
         self.send_response(200)
-        self.send_header("Content-Type", "application/x-kart-tile")
+        self.send_header(
+            "Content-Type", _MVT_MIME if raw_mvt else "application/x-kart-tile"
+        )
         self.send_header("ETag", etag)
         # the payload is immutable for its key (the commit oid is in it):
         # downstream HTTP caches may keep it as long as they like
         self.send_header("Cache-Control", "public, max-age=31536000, immutable")
+        # the Accept header can negotiate the representation (bare MVT vs
+        # framed): shared caches must key on it
+        self.send_header("Vary", "Accept")
         self.send_header("Content-Length", str(len(payload)))
         self.end_headers()
         self.wfile.write(payload)

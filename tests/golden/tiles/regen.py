@@ -1,0 +1,122 @@
+"""Regenerate the golden tile-payload fixtures (ISSUE 15 satellite).
+
+Run from the repo root after a DELIBERATE wire-format change:
+
+    python tests/golden/tiles/regen.py
+
+Two kinds of pin (tests/test_tiles.py::TestGoldenPayloads):
+
+* ``ktb1_v1.ktile`` — a complete **v1-era framed payload** built here by
+  hand (explicit ``"v": 1`` header + the KTB1 layer bytes): the
+  backward-compat fixture. It is NOT regenerated through the current
+  encoder — current code must keep *decoding* it forever; only touch this
+  block when the decode contract itself changes (and say so in
+  docs/TILES.md §4.3).
+* ``ktb2_layer.bin`` / ``mvt_layer.bin`` / ``props_layer.bin`` — the
+  current encoders over the fixed arrays below: the byte-stability
+  fixtures. A refactor that changes these bytes must bump
+  ``PAYLOAD_VERSION`` (every cache key/ETag must change — the PR 9
+  immutable-cache rule) and regenerate.
+
+``expected.json`` records the decoded truth the tests compare against.
+The input arrays are chosen to hit every interesting shape: sorted dense
+keys, a negative (hash-scheme) key, point/line/polygon degenerate boxes,
+and the clip extremes.
+"""
+
+import json
+import os
+import struct
+import sys
+
+import numpy as np
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+sys.path.insert(0, os.path.join(HERE, "..", "..", ".."))
+
+from kart_tpu.tiles.encode import (  # noqa: E402
+    encode_bin_layer,
+    encode_ktb2_layer,
+    encode_mvt_layer,
+    encode_props_layer,
+)
+
+COMMIT = "0123456789abcdef0123456789abcdef01234567"
+DATASET = "golden"
+TILE = [3, 2, 1]
+BBOX = [-90.0, 40.97989806962013, -45.0, 66.51326044311186]
+EXTENT, BUFFER = 4096, 64
+
+
+def fixed_arrays():
+    keys = np.array(
+        [-(1 << 40), 1 << 24, (1 << 24) + 1, (1 << 24) + 7, (1 << 24) + 512],
+        dtype=np.int64,
+    )
+    boxes = np.array(
+        [
+            [100, 100, 100, 100],  # point
+            [200, 300, 200, 900],  # vertical line
+            [-64, -64, 4160, 4160],  # full buffered square
+            [0, 0, 4096, 4096],  # exact tile square
+            [17, 23, 1025, 2047],  # ordinary polygon
+        ],
+        dtype=np.int32,
+    )
+    props = [
+        b'{"fid":1,"name":"a"}',
+        b'{"fid":2,"name":"b"}',
+        b'{"fid":1,"name":"a"}',
+        b"",
+        b'{"fid":5,"name":"e"}',
+    ]
+    return keys, boxes, props
+
+
+def v1_payload(keys, boxes):
+    """A byte-exact PR 9-era (v1) framed payload: canonical JSON header +
+    the KTB1 layer — what a v1 server wrote to disk/wire."""
+    bin_layer = encode_bin_layer(keys, boxes)
+    header = {
+        "v": 1,
+        "commit": COMMIT,
+        "dataset": DATASET,
+        "tile": TILE,
+        "bbox": BBOX,
+        "extent": EXTENT,
+        "buffer": BUFFER,
+        "count": len(keys),
+        "layers": {"bin": len(bin_layer)},
+    }
+    raw = json.dumps(header, sort_keys=True, separators=(",", ":")).encode()
+    return struct.pack(">Q", len(raw)) + raw + bin_layer
+
+
+def main():
+    keys, boxes, props = fixed_arrays()
+    out = {
+        "ktb1_v1.ktile": v1_payload(keys, boxes),
+        "ktb2_layer.bin": encode_ktb2_layer(keys, boxes),
+        "mvt_layer.bin": encode_mvt_layer(DATASET, keys, boxes, EXTENT),
+        "props_layer.bin": encode_props_layer(props),
+    }
+    for name, data in out.items():
+        with open(os.path.join(HERE, name), "wb") as f:
+            f.write(data)
+        print(f"wrote {name} ({len(data)} bytes)")
+    expected = {
+        "commit": COMMIT,
+        "dataset": DATASET,
+        "tile": TILE,
+        "keys": [int(k) for k in keys],
+        "boxes": [[int(v) for v in row] for row in boxes],
+        "props": [p.decode() for p in props],
+        "mvt_types": [1, 2, 3, 3, 3],
+    }
+    with open(os.path.join(HERE, "expected.json"), "w") as f:
+        json.dump(expected, f, indent=1, sort_keys=True)
+    print("wrote expected.json")
+
+
+if __name__ == "__main__":
+    main()

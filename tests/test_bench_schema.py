@@ -260,6 +260,25 @@ NEW_KEYS += [
     "live_replica_lag_beats_polled",
 ]
 
+#: ISSUE 15 — the KTB2/MVT encoding ladder and the parallel pyramid
+#: export (bench.py --tiles extensions)
+NEW_KEYS += [
+    "tile_bytes_per_feature_ktb1",
+    "tile_bytes_per_feature_ktb2",
+    "tile_bytes_per_feature_mvt",
+    "tiles_per_sec_ktb2_cold",
+    "tile_ktb2_vs_ktb1",
+    "tile_ktb2_meets_2x",
+    "pyramid_export_zoom",
+    "pyramid_export_tiles",
+    "pyramid_export_seconds_1w",
+    "pyramid_export_seconds_nw",
+    "pyramid_export_workers",
+    "pyramid_export_speedup",
+    "pyramid_export_identical",
+    "pyramid_export_env_ceiling",
+]
+
 
 def test_bench_emits_every_recorded_key():
     with open(os.path.join(REPO_ROOT, "bench.py")) as f:

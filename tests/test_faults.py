@@ -1264,6 +1264,106 @@ def test_poisoned_tile_cache_fill_is_never_served(served_repo, monkeypatch):
     assert count("tiles.cache.hits") == 1
 
 
+def test_ktb2_stream_encode_fault_publishes_nothing(served_repo, monkeypatch):
+    """ISSUE 15 kill matrix: a crash in the KTB2 stream codec
+    (tiles.streams frame, fired at encode_ktb2_layer entry) surfaces as an
+    error with nothing published — no cache entry, and the retried request
+    serves the exact payload a never-faulted server would."""
+    from kart_tpu import tiles
+    from kart_tpu.tiles.cache import tile_cache_for
+
+    repo, ds_path, url = served_repo
+    tile = f"/api/v1/tiles/HEAD/{ds_path}/0/0/0?layers=ktb2"
+
+    monkeypatch.setenv("KART_FAULTS", "tiles.streams:1")
+    status, body = _get_tile(url, tile)
+    monkeypatch.delenv("KART_FAULTS")
+    assert status == 500
+    assert b"InjectedFault" in body
+    assert tile_cache_for(repo).stats()["entries"] == 0
+
+    status, payload = _get_tile(url, tile)
+    assert status == 200
+    clean, _etag, _ = tiles.serve_tile(
+        repo, "HEAD", ds_path, 0, 0, 0, layers="ktb2"
+    )
+    assert payload == clean
+
+
+def test_ktb2_stream_decode_fault_is_clean(monkeypatch):
+    """The decode frame of tiles.streams: an armed client-side decode
+    raises InjectedFault (an OSError like every injected failure) without
+    corrupting state — a second decode of the same bytes succeeds."""
+    import numpy as np
+
+    from kart_tpu.faults import InjectedFault
+    from kart_tpu.tiles.encode import decode_ktb2_layer, encode_ktb2_layer
+
+    keys = np.arange(100, dtype=np.int64)
+    boxes = np.zeros((100, 4), dtype=np.int32)
+    # hit 2: the encode entry consumes hit 1, the decode entry fires (a
+    # distinct spec string from the encode test — re-arming an identical
+    # spec does not reset a fired counter, by design)
+    monkeypatch.setenv("KART_FAULTS", "tiles.streams:2")
+    data = encode_ktb2_layer(keys, boxes)
+    with pytest.raises(InjectedFault):
+        decode_ktb2_layer(data)
+    got_keys, got_boxes = decode_ktb2_layer(data)  # disarmed: clean decode
+    assert np.array_equal(got_keys, keys)
+    assert np.array_equal(got_boxes, boxes)
+
+
+@pytest.mark.parametrize("frame", [1, 2])
+def test_pyramid_export_killed_at_batch_boundary(tmp_path, monkeypatch, frame):
+    """ISSUE 15 kill matrix: a crash at any tiles.export batch boundary
+    leaves every previously-written tile complete (each parses and
+    decodes), no temp debris the gc sweep wouldn't claim, and the re-run
+    overwrites to a pyramid byte-identical to a never-faulted export."""
+    import hashlib
+
+    from kart_tpu import tiles
+    from kart_tpu.faults import InjectedFault
+    from kart_tpu.tiles.pyramid import export_pyramid
+
+    repo, ds_path = make_imported_repo(tmp_path, n=12)
+    src = tiles.source_for(
+        repo, tiles.resolve_tile_commit(repo, "HEAD"), ds_path
+    )
+
+    def digest(out):
+        h = hashlib.sha256()
+        for dirpath, dirnames, filenames in sorted(os.walk(out)):
+            dirnames.sort()
+            for name in sorted(filenames):
+                p = os.path.join(dirpath, name)
+                h.update(os.path.relpath(p, out).encode())
+                with open(p, "rb") as f:
+                    h.update(f.read())
+        return h.hexdigest()
+
+    clean_dir = str(tmp_path / "clean")
+    export_pyramid(src, [0, 1, 2], clean_dir, layers=("ktb2",),
+                   workers=1, batch_tiles=1)
+
+    out = str(tmp_path / "faulted")
+    monkeypatch.setenv("KART_FAULTS", f"tiles.export:{frame}")
+    with pytest.raises(InjectedFault):
+        export_pyramid(src, [0, 1, 2], out, layers=("ktb2",),
+                       workers=1, batch_tiles=1)
+    monkeypatch.delenv("KART_FAULTS")
+    # every file present is a complete, decodable payload; no temp debris
+    for dirpath, _dirs, filenames in os.walk(out):
+        for name in filenames:
+            assert name.endswith(".ktile"), name
+            with open(os.path.join(dirpath, name), "rb") as f:
+                header, layers = tiles.parse_payload(f.read())
+            tiles.decode_ktb2_layer(layers["ktb2"])
+    # the re-run completes and lands byte-identical to the clean export
+    export_pyramid(src, [0, 1, 2], out, layers=("ktb2",),
+                   workers=1, batch_tiles=1)
+    assert digest(out) == digest(clean_dir)
+
+
 # ---------------------------------------------------------------------------
 # fleet: the replica sync + write-proxy kill matrices (ISSUE 13)
 # ---------------------------------------------------------------------------

@@ -538,3 +538,565 @@ def test_pyramid_export_writes_every_nonempty_tile(served_points, tmp_path):
         with open(tmp_path / "out" / str(z) / str(x) / f"{y}.ktile", "rb") as f:
             header, _ = tiles.parse_payload(f.read())
         assert header["count"] == 40
+
+
+# ---------------------------------------------------------------------------
+# ISSUE 15: the KTB2/MVT/props layers, stream parity, negotiation, goldens,
+# bounds checks, and the parallel pyramid export
+# ---------------------------------------------------------------------------
+
+GOLDEN_DIR = os.path.join(REPO_ROOT, "tests", "golden", "tiles")
+
+
+class FakeSource:
+    """The minimal TileSource surface the blob-free layers need — lets the
+    parity tests drive encode_tile over hand-crafted envelope shapes
+    (anti-meridian wraps, polar clamps, degenerate boxes) no import can
+    easily produce."""
+
+    def __init__(self, envelopes, keys=None):
+        from types import SimpleNamespace
+
+        self._env = np.asarray(envelopes, dtype=np.float32).reshape(-1, 4)
+        if keys is None:
+            keys = (1 << 24) + np.arange(len(self._env), dtype=np.int64)
+        self.block = SimpleNamespace(keys=np.asarray(keys, dtype=np.int64))
+        self.commit_oid = "ab" * 20
+        self.ds_path = "fake"
+
+    def envelopes(self):
+        return self._env
+
+    def rows_for_bbox(self, query):
+        from kart_tpu.ops.bbox import bbox_intersects_np
+
+        hits = bbox_intersects_np(self._env, np.asarray(query, np.float64))
+        return np.flatnonzero(hits).astype(np.int64), {}
+
+
+def _decode_all(payload):
+    """One payload -> {layer: decoded} for every columnar layer present."""
+    header, layers = tiles.parse_payload(payload)
+    out = {"header": header}
+    if "bin" in layers:
+        out["bin"] = tiles.decode_bin_layer(layers["bin"])
+    if "ktb2" in layers:
+        out["ktb2"] = tiles.decode_ktb2_layer(layers["ktb2"])
+    if "mvt" in layers:
+        out["mvt"] = tiles.decode_mvt_layer(layers["mvt"])
+    if "props" in layers:
+        out["props"] = tiles.decode_props_layer(layers["props"])
+    return out
+
+
+@pytest.mark.parametrize(
+    "tile,desc",
+    [
+        ((0, 0, 0), "world"),
+        ((3, 0, 3), "west edge (anti-meridian seam)"),
+        ((3, 7, 3), "east edge (anti-meridian seam)"),
+        ((2, 1, 0), "polar top row"),
+        ((2, 1, 3), "polar bottom row"),
+        ((4, 9, 7), "empty interior"),
+    ],
+)
+def test_ktb2_mvt_parity_weird_geometry(tile, desc):
+    """ISSUE 15 satellite: KTB2 decode == KTB1 decode (and MVT ids/types
+    agree) across anti-meridian-wrapping, polar-clamped, degenerate and
+    empty tiles."""
+    env = np.array(
+        [
+            [170.0, -10.0, -170.0, 10.0],   # anti-meridian wrap (e < w)
+            [10.0, 88.0, 10.001, 88.001],   # beyond the north clamp
+            [10.0, -89.0, 10.5, -88.5],     # beyond the south clamp
+            [20.0, 5.0, 20.0, 5.0],         # degenerate point envelope
+            [-170.0, -5.0, -169.0, 5.0],    # ordinary box, west side
+            [175.0, 30.0, 179.0, 31.0],     # ordinary box, east side
+        ],
+        dtype=np.float32,
+    )
+    src = FakeSource(env)
+    z, x, y = tile
+    payload, stats = tiles.encode_tile(
+        src, z, x, y, layers="bin,ktb2,mvt", max_features=0
+    )
+    got = _decode_all(payload)
+    k1, b1 = got["bin"]
+    k2, b2 = got["ktb2"]
+    assert np.array_equal(k1, k2), desc
+    assert np.array_equal(b1, b2), desc
+    assert got["header"]["count"] == len(k1) == stats["count"]
+    mvt_ids = [f["id"] for f in got["mvt"]["features"]]
+    assert mvt_ids == [int(k) for k in k1], desc
+    # the wrap row, when present, spans the full buffered width
+    wrap_rows = np.flatnonzero(np.isin(k1, src.block.keys[[0]]))
+    for r in wrap_rows:
+        assert b1[r][0] == -64 and b1[r][2] == 4096 + 64
+
+
+def test_encoding_ladder_branches_round_trip_in_tiles():
+    """Tiles whose columns drive each stream encoding (constant -> RLE/FOR,
+    sorted dense keys -> delta family) still decode identically to KTB1."""
+    from kart_tpu.tiles.streams import ENCODING_NAMES
+
+    n = 500
+    # a vertical stack of identical-x envelopes: constant box columns
+    env = np.tile(np.array([[10.0, 10.0, 10.5, 10.5]], np.float32), (n, 1))
+    src = FakeSource(env)
+    payload, _ = tiles.encode_tile(src, 0, 0, 0, layers="bin,ktb2",
+                                   max_features=0)
+    got = _decode_all(payload)
+    assert np.array_equal(got["bin"][0], got["ktb2"][0])
+    assert np.array_equal(got["bin"][1], got["ktb2"][1])
+    _header, layers = tiles.parse_payload(payload)
+    # the chosen encodings are recorded in the stream headers: the keys
+    # stream is delta-coded, the constant box columns collapse
+    ktb2 = layers["ktb2"]
+    key_stream_enc = ktb2[9]
+    assert ENCODING_NAMES[key_stream_enc] in ("dvarint", "dfor", "for")
+    assert len(ktb2) < len(layers["bin"]) / 4
+
+
+def test_props_layer_matches_geojson(served_points):
+    """props is the dictionary-coded form of exactly the geojson lines
+    (same compiled serialisers, row-aligned with the bin keys)."""
+    repo, ds_path, url = served_points
+    status, _, payload = http_get(
+        f"{url}/api/v1/tiles/HEAD/{ds_path}/0/0/0?layers=bin,geojson,props"
+    )
+    assert status == 200
+    got = _decode_all(payload)
+    geojson_lines = [
+        l.encode() for l in
+        tiles.parse_payload(payload)[1]["geojson"].decode().splitlines()
+    ]
+    assert got["props"] == geojson_lines
+    assert len(got["props"]) == len(got["bin"][0])
+
+
+def test_ktb2_served_payload_cold_cached_two_processes(served_points, tmp_path):
+    """ISSUE 15 acceptance: KTB2/MVT payloads byte-identical cold vs
+    cached and across two processes (in-thread server vs `kart export
+    tiles` subprocess), decoding to exactly the KTB1 feature set."""
+    repo, ds_path, url = served_points
+    t = f"{url}/api/v1/tiles/HEAD/{ds_path}/2/3/2?layers=ktb2,mvt"
+    s1, h1, cold = http_get(t)
+    s2, h2, cached = http_get(t)
+    assert s1 == s2 == 200 and cold == cached
+    assert h1["ETag"] == h2["ETag"]
+
+    out = tmp_path / "pyramid"
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    env["PYTHONPATH"] = REPO_ROOT + os.pathsep + env.get("PYTHONPATH", "")
+    proc = subprocess.run(
+        [
+            sys.executable, "-m", "kart_tpu.cli",
+            "-C", str(repo.workdir or repo.gitdir),
+            "export", "tiles", "HEAD", "--dataset", ds_path,
+            "--zoom", "2", "-o", str(out), "--layers", "ktb2,mvt",
+        ],
+        env=env, capture_output=True, text=True, timeout=120,
+    )
+    assert proc.returncode == 0, proc.stderr
+    with open(out / "2" / "3" / "2.ktile", "rb") as f:
+        exported = f.read()
+    assert exported == cold
+    # and the compressed columns decode to the KTB1 feature set
+    sbin, _, bin_payload = http_get(
+        f"{url}/api/v1/tiles/HEAD/{ds_path}/2/3/2?layers=bin"
+    )
+    assert sbin == 200
+    k1, b1 = _decode_all(bin_payload)["bin"]
+    k2, b2 = _decode_all(cold)["ktb2"]
+    assert np.array_equal(k1, k2) and np.array_equal(b1, b2)
+
+
+# -- negotiation -------------------------------------------------------------
+
+
+def test_layer_negotiation_etags_differ(served_points):
+    repo, ds_path, url = served_points
+    t = f"{url}/api/v1/tiles/HEAD/{ds_path}/1/1/1"
+    _, h_default, _ = http_get(t)
+    _, h_ktb2, _ = http_get(t + "?layers=ktb2")
+    assert h_default["ETag"] != h_ktb2["ETag"]
+    assert h_ktb2["Vary"] == "Accept"
+
+
+def test_accept_header_negotiates_raw_mvt(served_points):
+    repo, ds_path, url = served_points
+    t = f"{url}/api/v1/tiles/HEAD/{ds_path}/1/1/1"
+    mime = "application/vnd.mapbox-vector-tile"
+    status, headers, body = http_get(t, headers={"Accept": mime})
+    assert status == 200
+    assert headers["Content-Type"] == mime
+    assert headers["ETag"].endswith('-raw"')
+    # the body IS bare MVT protobuf: our reader decodes it directly
+    doc = tiles.decode_mvt_layer(body)
+    assert doc["name"] == ds_path and doc["version"] == 2
+    assert len(doc["features"]) > 0
+    # the raw validator revalidates (304), and differs from the framed one
+    status, h2, b2 = http_get(
+        t, headers={"Accept": mime, "If-None-Match": headers["ETag"]}
+    )
+    assert status == 304 and b2 == b""
+    _, framed_headers, framed = http_get(t + "?layers=mvt")
+    assert framed_headers["ETag"] != headers["ETag"]
+    # one cache entry backs both: the framed payload embeds the raw body
+    assert tiles.parse_payload(framed)[1]["mvt"] == body
+
+
+def test_format_mvt_param_serves_raw(served_points):
+    repo, ds_path, url = served_points
+    status, headers, body = http_get(
+        f"{url}/api/v1/tiles/HEAD/{ds_path}/1/1/1?format=mvt"
+    )
+    assert status == 200
+    assert headers["Content-Type"] == "application/vnd.mapbox-vector-tile"
+    assert tiles.decode_mvt_layer(body)["name"] == ds_path
+    # format=mvt with a contradictory layer set is a 400, as is junk format
+    s, _, b = http_get(
+        f"{url}/api/v1/tiles/HEAD/{ds_path}/1/1/1?format=mvt&layers=bin"
+    )
+    assert s == 400
+    s, _, _ = http_get(f"{url}/api/v1/tiles/HEAD/{ds_path}/1/1/1?format=png")
+    assert s == 400
+
+
+def test_kart_tile_encoding_env_sets_default_layers(served_points, monkeypatch):
+    repo, ds_path, url = served_points
+    monkeypatch.setenv("KART_TILE_ENCODING", "ktb2")
+    t = f"{url}/api/v1/tiles/HEAD/{ds_path}/0/0/0"
+    status, _, payload = http_get(t)
+    assert status == 200
+    header, layers = tiles.parse_payload(payload)
+    assert set(layers) == {"ktb2"}
+    # malformed config falls back to the stock default, never 500s
+    monkeypatch.setenv("KART_TILE_ENCODING", "nope,bad")
+    status, _, payload = http_get(t)
+    assert status == 200
+    assert set(tiles.parse_payload(payload)[1]) == {"bin", "geojson"}
+
+
+# -- bounds checks (fuzz) ----------------------------------------------------
+
+
+def test_parse_payload_prefix_fuzz(served_points):
+    """ISSUE 15 satellite: every strict prefix of a real payload raises
+    TileEncodeError from parse_payload or the layer decoders — a
+    truncated count must never silently short-read via np.frombuffer."""
+    repo, ds_path, url = served_points
+    _, _, payload = http_get(
+        f"{url}/api/v1/tiles/HEAD/{ds_path}/0/0/0?layers=bin,ktb2"
+    )
+    for cut in range(len(payload)):
+        clipped = payload[:cut]
+        try:
+            header, layers = tiles.parse_payload(clipped)
+            # frame parsed => some layer must fail to decode
+            for name, decoder in (
+                ("bin", tiles.decode_bin_layer),
+                ("ktb2", tiles.decode_ktb2_layer),
+            ):
+                decoder(layers[name])
+        except tiles.TileEncodeError:
+            continue
+        raise AssertionError(f"prefix {cut} of {len(payload)} decoded silently")
+    # oversized count in the bin layer: same error, not a short read
+    header, layers = tiles.parse_payload(payload)
+    bin_layer = bytearray(layers["bin"])
+    import struct as _struct
+
+    _struct.pack_into("<I", bin_layer, 4, header["count"] + 1000)
+    with pytest.raises(tiles.TileEncodeError):
+        tiles.decode_bin_layer(bytes(bin_layer))
+
+
+# -- golden fixtures ---------------------------------------------------------
+
+
+class TestGoldenPayloads:
+    """tests/golden/tiles (regenerate: python tests/golden/tiles/regen.py).
+    ktb1_v1.ktile pins DECODE backward-compat for v1-era payloads; the
+    layer fixtures pin current-encoder BYTE stability across refactors —
+    bytes changing means PAYLOAD_VERSION must bump (TILES.md §4.3)."""
+
+    @pytest.fixture(autouse=True)
+    def _expected(self):
+        with open(os.path.join(GOLDEN_DIR, "expected.json")) as f:
+            self.expected = json.load(f)
+
+    def _read(self, name):
+        with open(os.path.join(GOLDEN_DIR, name), "rb") as f:
+            return f.read()
+
+    def test_v1_payload_still_decodes(self):
+        header, layers = tiles.parse_payload(self._read("ktb1_v1.ktile"))
+        assert header["v"] == 1
+        assert header["commit"] == self.expected["commit"]
+        keys, boxes = tiles.decode_bin_layer(layers["bin"])
+        assert [int(k) for k in keys] == self.expected["keys"]
+        assert boxes.tolist() == self.expected["boxes"]
+
+    def test_ktb2_bytes_stable(self):
+        from kart_tpu.tiles.encode import encode_ktb2_layer
+
+        golden = self._read("ktb2_layer.bin")
+        keys = np.asarray(self.expected["keys"], np.int64)
+        boxes = np.asarray(self.expected["boxes"], np.int32)
+        assert encode_ktb2_layer(keys, boxes) == golden
+        got_keys, got_boxes = tiles.decode_ktb2_layer(golden)
+        assert [int(k) for k in got_keys] == self.expected["keys"]
+        assert got_boxes.tolist() == self.expected["boxes"]
+
+    def test_mvt_bytes_stable(self):
+        from kart_tpu.tiles.encode import encode_mvt_layer
+
+        golden = self._read("mvt_layer.bin")
+        keys = np.asarray(self.expected["keys"], np.int64)
+        boxes = np.asarray(self.expected["boxes"], np.int32)
+        assert encode_mvt_layer(
+            self.expected["dataset"], keys, boxes
+        ) == golden
+        doc = tiles.decode_mvt_layer(golden)
+        assert [f["id"] for f in doc["features"]] == self.expected["keys"]
+        assert [f["type"] for f in doc["features"]] == self.expected["mvt_types"]
+
+    def test_props_bytes_stable(self):
+        from kart_tpu.tiles.encode import encode_props_layer
+
+        golden = self._read("props_layer.bin")
+        props = [p.encode() for p in self.expected["props"]]
+        assert encode_props_layer(props) == golden
+        assert tiles.decode_props_layer(golden) == props
+
+
+# -- the parallel pyramid export ---------------------------------------------
+
+
+def _pyramid_digest(out_dir):
+    import hashlib
+
+    h = hashlib.sha256()
+    for dirpath, dirnames, filenames in sorted(os.walk(out_dir)):
+        dirnames.sort()
+        for name in sorted(filenames):
+            p = os.path.join(dirpath, name)
+            h.update(os.path.relpath(p, out_dir).encode())
+            with open(p, "rb") as f:
+                h.update(f.read())
+    return h.hexdigest()
+
+
+def test_batch_encoder_matches_serving_encoder(synth_spatial):
+    """encode_tile_batch (the exporter's path) is byte-identical to
+    encode_tile (the serving path) for every tile of the cover."""
+    from kart_tpu.tiles.encode import encode_tile, encode_tile_batch
+    from kart_tpu.tiles.pyramid import tile_cover
+
+    repo, info = synth_spatial
+    src = tiles.source_for(
+        repo, tiles.resolve_tile_commit(repo, "HEAD"), "synth"
+    )
+    addrs = list(tile_cover(src, [0, 2, 4]))
+    results = encode_tile_batch(
+        src, addrs, layers="bin,ktb2,mvt", max_features=0
+    )
+    checked = 0
+    for (z, x, y), (status, payload, _count) in zip(addrs, results):
+        single, stats = encode_tile(
+            src, z, x, y, layers="bin,ktb2,mvt", max_features=0
+        )
+        if status == "ok":
+            assert payload == single, (z, x, y)
+            checked += 1
+        else:
+            assert status == "empty" and stats["count"] == 0
+    assert checked > 10
+
+
+def test_pool_export_matches_serial_and_honours_workers(synth_spatial, tmp_path):
+    repo, info = synth_spatial
+    src = tiles.source_for(
+        repo, tiles.resolve_tile_commit(repo, "HEAD"), "synth"
+    )
+    from kart_tpu.tiles.pyramid import export_pyramid
+
+    s1 = export_pyramid(src, [0, 1, 2, 3], str(tmp_path / "w1"),
+                        layers=("ktb2",), workers=1)
+    s2 = export_pyramid(src, [0, 1, 2, 3], str(tmp_path / "w2"),
+                        layers=("ktb2",), workers=2)
+    assert s1["export_workers"] == 1 and s2["export_workers"] == 2
+    assert s1["tiles_written"] == s2["tiles_written"] > 0
+    assert _pyramid_digest(str(tmp_path / "w1")) == _pyramid_digest(
+        str(tmp_path / "w2")
+    )
+
+
+def test_device_seam_projection_is_byte_deterministic():
+    """The device-mesh projection path (shard_map over the feature axis)
+    quantizes bit-identically to the host path — the verify-and-patch
+    contract in clip.quantize_from_merc, exercised on the 8-device
+    virtual CPU platform."""
+    from kart_tpu.diff.backend import BACKENDS, sharded_merc_envelopes
+    from kart_tpu.runtime import jax_ready
+    from kart_tpu.tiles.clip import quantize_from_merc
+
+    if not jax_ready():
+        pytest.skip("no jax backend in this environment")
+    rng = np.random.RandomState(11)
+    env = np.column_stack(
+        [
+            rng.uniform(-180, 180, 50_000),
+            rng.uniform(-88, 88, 50_000),
+            rng.uniform(-180, 180, 50_000),
+            rng.uniform(-88, 88, 50_000),
+        ]
+    )
+    host = BACKENDS["host_native"].merc_envelopes(env)
+    dev = sharded_merc_envelopes(env)
+    for z in (0, 4, 11, 18):
+        x = y = (1 << z) // 2
+        bh = quantize_from_merc(env, host, z, x, y)
+        bd = quantize_from_merc(env, dev, z, x, y)
+        assert np.array_equal(bh, bd), f"zoom {z}"
+
+
+def test_export_strict_fails_on_skipped_tiles(served_points, tmp_path):
+    """ISSUE 15 satellite: a tiles_too_large skip leaves an incomplete
+    pyramid — --strict exits non-zero naming the tiles; the default path
+    exits 0 with a one-line warning."""
+    repo, ds_path, url = served_points
+    env = dict(os.environ, JAX_PLATFORMS="cpu", KART_TILE_MAX_FEATURES="5")
+    env["PYTHONPATH"] = REPO_ROOT + os.pathsep + env.get("PYTHONPATH", "")
+    base = [
+        sys.executable, "-m", "kart_tpu.cli",
+        "-C", str(repo.workdir or repo.gitdir),
+        "export", "tiles", "HEAD", "--dataset", ds_path, "--zoom", "0",
+        "--layers", "bin",
+    ]
+    proc = subprocess.run(
+        base + ["-o", str(tmp_path / "default")],
+        env=env, capture_output=True, text=True, timeout=120,
+    )
+    assert proc.returncode == 0, proc.stderr
+    assert "warning:" in proc.stderr and "skipped" in proc.stderr
+
+    proc = subprocess.run(
+        base + ["-o", str(tmp_path / "strict"), "--strict"],
+        env=env, capture_output=True, text=True, timeout=120,
+    )
+    assert proc.returncode != 0
+    assert "0/0/0" in proc.stderr and "incomplete" in proc.stderr
+
+
+def test_export_stats_record_skipped_tiles(served_points, tmp_path):
+    from kart_tpu.tiles.pyramid import export_pyramid
+
+    repo, ds_path, url = served_points
+    src = tiles.source_for(
+        repo, tiles.resolve_tile_commit(repo, "HEAD"), ds_path
+    )
+    stats = export_pyramid(
+        src, [0, 1], str(tmp_path / "out"), layers=("bin",), max_features=5
+    )
+    assert stats["tiles_too_large"] == 2  # the one populated tile per zoom
+    assert sorted(stats["tiles_skipped"]) == [(0, 0, 0), (1, 1, 1)]
+
+
+def test_ktb2_decode_bomb_guard():
+    """Review regression: a few-byte crafted KTB2 layer claiming billions
+    of RLE-expanded rows is rejected by the decode ceiling instead of
+    allocating gigabytes (KTB1 cross-checks count against byte length;
+    compressed layers need the explicit bound)."""
+    import struct as _struct
+
+    from kart_tpu.tiles.encode import KTB2_MAGIC, MAX_DECODE_ROWS
+    from kart_tpu.tiles.streams import RLE, _STREAM_HEADER, varint_encode
+
+    huge = MAX_DECODE_ROWS + 1
+    run = (
+        varint_encode(np.asarray([1], np.uint64))       # one run
+        + varint_encode(np.asarray([huge], np.uint64))  # of `huge` length
+        + varint_encode(np.asarray([0], np.uint64))     # value 0 (zigzag)
+    )
+    stream = _STREAM_HEADER.pack(RLE, len(run)) + run
+    crafted = KTB2_MAGIC + _struct.pack("<BI", 0, huge) + stream * 5
+    assert len(crafted) < 100  # a few dozen bytes claiming ~4 GB of rows
+    with pytest.raises(tiles.TileEncodeError, match="ceiling"):
+        tiles.decode_ktb2_layer(crafted)
+    # a deliberate larger ceiling still decodes honest payloads
+    keys = np.arange(10, dtype=np.int64)
+    boxes = np.zeros((10, 4), np.int32)
+    from kart_tpu.tiles.encode import encode_ktb2_layer
+
+    k, b = tiles.decode_ktb2_layer(encode_ktb2_layer(keys, boxes))
+    assert np.array_equal(k, keys)
+
+
+def test_warm_layers_follow_negotiated_default(monkeypatch):
+    """Review regression: the warm-then-announce pass must warm the cache
+    keys default requests actually compute — a KART_TILE_ENCODING=ktb2
+    fleet warming only ("bin",) would make every warm fill a dead key."""
+    from kart_tpu.events.warm import warm_layers
+
+    monkeypatch.delenv("KART_TILE_ENCODING", raising=False)
+    assert warm_layers() == ("bin",)  # stock default minus geojson
+    monkeypatch.setenv("KART_TILE_ENCODING", "ktb2")
+    assert warm_layers() == ("ktb2",)
+    monkeypatch.setenv("KART_TILE_ENCODING", "ktb2,props")
+    assert warm_layers() == ("ktb2",)  # blob-needing layers stay lazy
+    monkeypatch.setenv("KART_TILE_ENCODING", "geojson")
+    assert warm_layers() == ("bin",)  # all-blob default: fall back
+
+
+def test_accept_q_zero_refuses_raw_mvt(served_points):
+    """Review regression: a client that explicitly refuses MVT
+    (``;q=0``) must get the framed default, not the bare protobuf; a
+    positive q (any case) still negotiates raw."""
+    repo, ds_path, url = served_points
+    t = f"{url}/api/v1/tiles/HEAD/{ds_path}/1/1/1"
+    mime = "application/vnd.mapbox-vector-tile"
+    status, headers, body = http_get(
+        t, headers={"Accept": f"{mime};q=0, application/x-kart-tile"}
+    )
+    assert status == 200
+    assert headers["Content-Type"] == "application/x-kart-tile"
+    tiles.parse_payload(body)  # framed, parses
+    status, headers, body = http_get(
+        t, headers={"Accept": f"{mime.upper()}; q=0.8, */*;q=0.1"}
+    )
+    assert status == 200
+    assert headers["Content-Type"] == mime
+    assert tiles.decode_mvt_layer(body)["name"] == ds_path
+
+
+def test_project_envelopes_respects_mesh_readiness(monkeypatch):
+    """Review regression: the export projection seam consults the classify
+    path's full readiness ladder (should_shard) — on a CPU-default box the
+    shard_map route must NOT engage, and the host transform serves."""
+    from kart_tpu.diff import backend as B
+
+    calls = []
+    real = B.ShardedJaxBackend.merc_envelopes
+
+    def spying(self, env):
+        calls.append(len(env))
+        return real(self, env)
+
+    monkeypatch.setattr(B.ShardedJaxBackend, "merc_envelopes", spying)
+    monkeypatch.setattr(
+        "kart_tpu.parallel.sharded_diff.should_shard", lambda n: False
+    )
+    env = np.random.RandomState(0).uniform(-80, 80, (2000, 4))
+    host = B.BACKENDS["host_native"].merc_envelopes(env)
+    got = B.project_envelopes(env)
+    assert not calls  # the sharded route never engaged
+    for h, g in zip(host, got):
+        assert np.array_equal(h, g)
+    # and when the ladder says yes, the sharded backend is consulted
+    monkeypatch.setattr(
+        "kart_tpu.parallel.sharded_diff.should_shard", lambda n: True
+    )
+    B.project_envelopes(env)
+    assert calls == [2000]
