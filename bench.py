@@ -2877,8 +2877,6 @@ def tiles_main():
         # worker counts, speedup reported next to the measured 2-process
         # env ceiling (a ~1.5x-ceiling container can't show 2x — cf.
         # MULTICHIP_r06 / BENCH_r07 precedent)
-        import hashlib as _hashlib
-
         export_zooms = [
             int(v)
             for v in os.environ.get("KART_BENCH_EXPORT_ZOOMS", "7").split("-")
@@ -2896,16 +2894,7 @@ def tiles_main():
             )
             return time.perf_counter() - t0, stats
 
-        def _tree_digest(out):
-            h = _hashlib.sha256()
-            for dirpath, dirnames, filenames in sorted(os.walk(out)):
-                dirnames.sort()
-                for name in sorted(filenames):
-                    p = os.path.join(dirpath, name)
-                    h.update(os.path.relpath(p, out).encode())
-                    with open(p, "rb") as f:
-                        h.update(f.read())
-            return h.hexdigest()
+        from kart_tpu.tiles.pyramid import tree_digest as _tree_digest
 
         s1, stats1 = _export(1, os.path.join(td, "pyr1"))
         sn, statsn = _export(n_workers, os.path.join(td, "pyrN"))

@@ -453,15 +453,29 @@ def decode_mvt_layer(data):
         return fields
 
     def geometry(buf):
-        vals, _pos = varint_decode(buf, _count_varints(buf))
+        vals, end = varint_decode(buf, _count_varints(buf))
+        if end != len(buf):
+            # dangling continuation bytes past the last complete varint
+            raise TileEncodeError("Truncated MVT geometry")
         out, i, cur = [], 0, (0, 0)
         while i < len(vals):
             word = int(vals[i])
             i += 1
             cmd, n = word & 7, word >> 3
             if cmd == 7:
+                # spec 4.3.3.3: ClosePath carries a command count of 1
+                if n != 1:
+                    raise TileEncodeError(
+                        f"Malformed MVT geometry command {cmd} count {n}"
+                    )
                 out.append(("close",))
                 continue
+            if cmd not in (1, 2) or n == 0:
+                raise TileEncodeError(
+                    f"Malformed MVT geometry command {cmd} count {n}"
+                )
+            if i + 2 * n > len(vals):
+                raise TileEncodeError("Truncated MVT geometry")
             pts = []
             for _ in range(n):
                 dx = int(_unzz(vals[i]))
@@ -494,6 +508,17 @@ def decode_mvt_layer(data):
             feat = {}
             for ff, fv in walk(value):
                 if ff == 1:
+                    # read_uvarint admits 10-byte varints up to 2**70-1;
+                    # np.uint64() would raise OverflowError past 2**64.
+                    # walk() hands back bytes for a length-delimited field
+                    if not isinstance(fv, int):
+                        raise TileEncodeError(
+                            "MVT feature id has non-varint wire type"
+                        )
+                    if fv >> 64:
+                        raise TileEncodeError(
+                            f"MVT feature id {fv} exceeds uint64"
+                        )
                     feat["id"] = np.uint64(fv).astype(np.int64).item()
                 elif ff == 3:
                     feat["type"] = fv
@@ -625,29 +650,38 @@ def encode_tile_batch(source, addresses, *, layers=None,
         max_features = max_features_limit()
     envelopes = source.envelopes()
 
-    selected = []  # (z, x, y, rows, env) per non-empty candidate tile
+    selected = []  # (z, x, y, rows, env, status) per tile, address-aligned
     for z, x, y in addresses:
         rows, _stats = source.rows_for_bbox(tile_query_wsen(z, x, y))
         rows, env = refine_rows(envelopes, rows, z, x, y)
-        selected.append((z, x, y, rows, env))
+        if len(rows) == 0:
+            status = "empty"
+        elif max_features and len(rows) > max_features:
+            # over-ceiling tiles are by definition the batch's largest
+            # row sets: drop them before the projection, not after
+            status = "too_large"
+        else:
+            status = "ok"
+        selected.append((z, x, y, rows, env, status))
 
-    env_cat = np.concatenate(
-        [env for *_addr, _rows, env in selected]
-    ) if selected else np.zeros((0, 4), np.float64)
+    ok_envs = [env for *_a, env, status in selected if status == "ok"]
+    env_cat = (
+        np.concatenate(ok_envs) if ok_envs else np.zeros((0, 4), np.float64)
+    )
     merc_cat = project_envelopes(env_cat, allow_device=allow_device)
 
     out = []
     pos = 0
-    for z, x, y, rows, env in selected:
+    for z, x, y, rows, env, status in selected:
         count = len(rows)
-        merc = tuple(col[pos : pos + count] for col in merc_cat)
-        pos += count
-        if count == 0:
+        if status == "empty":
             out.append(("empty", None, 0))
             continue
-        if max_features and count > max_features:
+        if status == "too_large":
             out.append(("too_large", None, count))
             continue
+        merc = tuple(col[pos : pos + count] for col in merc_cat)
+        pos += count
         boxes = quantize_from_merc(
             env, merc, z, x, y, extent=extent, buffer=buffer
         )

@@ -124,6 +124,23 @@ def cover_size(source, zooms):
     return total
 
 
+def tree_digest(out_dir):
+    """sha256 over an exported pyramid's sorted relpaths + file bytes —
+    the one definition of "byte-identical pyramid" that bench.py and the
+    determinism tests compare against."""
+    import hashlib
+
+    h = hashlib.sha256()
+    for dirpath, dirnames, filenames in sorted(os.walk(out_dir)):
+        dirnames.sort()
+        for name in sorted(filenames):
+            p = os.path.join(dirpath, name)
+            h.update(os.path.relpath(p, out_dir).encode())
+            with open(p, "rb") as f:
+                h.update(f.read())
+    return h.hexdigest()
+
+
 def _batched(iterable, size):
     batch = []
     for item in iterable:

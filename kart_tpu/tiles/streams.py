@@ -126,6 +126,17 @@ def varint_decode(data, count, pos=0):
     starts = np.concatenate(([pos], ends[:-1] + 1))
     if np.any(ends - starts >= 10):
         raise TileEncodeError("Varint value longer than 10 bytes")
+    # a 10-byte varint's terminator carries bits 63..69: any bit above 63
+    # (terminator > 1) would wrap modulo 2**64 in the shift below and
+    # silently decode a non-canonical byte string to a wrong value
+    tenth = buf[ends[ends - starts == 9]]
+    if len(tenth) and int(tenth.max()) > 1:
+        raise TileEncodeError("Varint value exceeds uint64")
+    # a multi-byte varint terminated by 0x00 is zero-padding: the same
+    # value has a shorter canonical encoding, so accepting it lets two
+    # distinct byte strings decode to one logical column (ETag split)
+    if np.any((ends > starts) & (buf[ends] == 0)):
+        raise TileEncodeError("Non-canonical zero-padded varint")
     idx_in_group = np.arange(pos, ends[-1] + 1) - np.repeat(
         starts, ends - starts + 1
     )
@@ -169,6 +180,11 @@ def bitunpack(data, count, width, pos=0):
             f"{nbytes} expected"
         )
     buf = np.frombuffer(data, dtype=np.uint8, count=nbytes, offset=pos)
+    # the final byte's unused low bits must be zero: nonzero padding is a
+    # distinct byte string decoding to the same column (ETag split)
+    pad = nbytes * 8 - count * width
+    if pad and buf[-1] & ((1 << pad) - 1):
+        raise TileEncodeError("Nonzero padding bits in bit-packed stream")
     bits = np.unpackbits(buf, count=count * width).reshape(count, width)
     weights = (np.uint64(1) << np.arange(width - 1, -1, -1, dtype=np.uint64))
     return (bits.astype(np.uint64) * weights[None, :]).sum(
@@ -327,11 +343,26 @@ def decode_stream(data, count, dtype="i8", pos=0):
         run_lens, p = varint_decode(body, n_runs, p)
         run_vals, p = varint_decode(body, n_runs, p)
         lens = run_lens.astype(np.int64)
-        if int(lens.sum()) != count or (n_runs and int(lens.min()) <= 0):
+        # per-run cap before the wrapping-prone sum: crafted lengths like
+        # four runs of 2**62 overflow an int64 total back to `count` and
+        # would send np.repeat off on a ~2**64-element expansion
+        if n_runs and (int(lens.min()) <= 0 or int(lens.max()) > count):
             raise TileEncodeError(
-                f"RLE runs sum to {int(lens.sum())}, column holds {count}"
+                f"RLE run length outside [1, {count}]"
             )
-        out = np.repeat(unzigzag(run_vals), lens)
+        total = sum(int(x) for x in lens)
+        if total != count:
+            raise TileEncodeError(
+                f"RLE runs sum to {total}, column holds {count}"
+            )
+        vals = unzigzag(run_vals)
+        # the encoder merges adjacent equal values into one run: a split
+        # run is a distinct byte string decoding to the same column
+        if n_runs > 1 and np.any(vals[1:] == vals[:-1]):
+            raise TileEncodeError(
+                "Non-canonical RLE: adjacent runs share a value"
+            )
+        out = np.repeat(vals, lens)
         consumed = p
     elif enc == FOR:
         base, p = varint_decode(body, 1)
@@ -430,7 +461,9 @@ def decode_bytes_stream(data, count, pos=0):
     lens, pos = decode_stream(data, n_unique, "i8", pos)
     if len(lens) and int(lens.min()) < 0:
         raise TileEncodeError("Negative dictionary string length")
-    total = int(lens.sum())
+    # non-wrapping total, same as the RLE run-length guard: crafted
+    # lengths summing past 2**64 must not slip under the truncation check
+    total = sum(int(x) for x in lens)
     if pos + total > len(data):
         raise TileEncodeError(
             f"Truncated dictionary blob: {len(data) - pos} bytes of {total}"

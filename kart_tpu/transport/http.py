@@ -80,6 +80,7 @@ from urllib.request import Request, urlopen
 from kart_tpu import faults
 from kart_tpu import telemetry as tm
 from kart_tpu.core.odb import ObjectMissing
+from kart_tpu.core.singleflight import SingleFlightLRU
 from kart_tpu.telemetry import access as rq_access
 from kart_tpu.telemetry import context as rq_context
 from kart_tpu.transport.pack import read_pack, write_pack
@@ -90,6 +91,34 @@ API = "/api/v1"
 #: ``?format=mvt``) negotiates the bare protobuf representation of a tile
 _MVT_MIME = "application/vnd.mapbox-vector-tile"
 _HEADER_LEN = struct.Struct(">Q")
+
+#: raw-MVT unwrap memo: strong validator -> bare protobuf body. Payloads
+#: are immutable per ETag (the commit oid is in the key), so a hit skips
+#: the per-request frame reparse on the hot MapLibre path. Byte-budgeted
+#: LRU with single-flight fill — the same discipline as the TileCache,
+#: which holds the framed representation of these bytes.
+_RAW_MVT_MEMO_BUDGET = 16 << 20
+_RAW_MVT_MEMO = SingleFlightLRU(_RAW_MVT_MEMO_BUDGET)
+
+
+def _raw_mvt_body(payload, etag):
+    """The framed tile payload's bare ``mvt`` layer bytes, memoized by its
+    (immutable) strong validator."""
+    status, got = _RAW_MVT_MEMO.lookup_or_begin(etag)
+    if status == "hit":
+        return got
+    from kart_tpu import tiles
+
+    try:
+        _header, layer_bytes = tiles.parse_payload(payload)
+        body = layer_bytes["mvt"]
+    except BaseException:
+        if got is not None:
+            got.abandon()
+        raise
+    if got is not None:
+        got.publish(body)
+    return body
 
 #: default per-socket timeout (connect + each recv) for the quick JSON GETs
 #: — a dead server fails fast instead of hanging forever. Every verb flow
@@ -871,14 +900,14 @@ class KartRequestHandler(BaseHTTPRequestHandler):
         if raw_mvt:
             # unwrap the framed payload: the bare MVT body is what an
             # off-the-shelf renderer consumes (the frame — and the cache
-            # entry behind it — still carries the layer). Note
+            # entry behind it — still carries the layer). The unwrap
+            # (json header decode + slice) is memoized by strong validator
+            # — payloads are immutable per ETag — so cache-hit raw-MVT
+            # requests skip the reparse on the hot MapLibre path. Note
             # tiles.bytes_out deliberately counts the FRAMED bytes (the
             # cache-entry size, consistent across representations); wire
             # egress is transport.server.bytes_sent below.
-            from kart_tpu import tiles
-
-            _header, layer_bytes = tiles.parse_payload(payload)
-            payload = layer_bytes["mvt"]
+            payload = _raw_mvt_body(payload, etag)
         tm.incr("transport.server.bytes_sent", len(payload))
         self.send_response(200)
         self.send_header(

@@ -1319,27 +1319,14 @@ def test_pyramid_export_killed_at_batch_boundary(tmp_path, monkeypatch, frame):
     leaves every previously-written tile complete (each parses and
     decodes), no temp debris the gc sweep wouldn't claim, and the re-run
     overwrites to a pyramid byte-identical to a never-faulted export."""
-    import hashlib
-
     from kart_tpu import tiles
     from kart_tpu.faults import InjectedFault
-    from kart_tpu.tiles.pyramid import export_pyramid
+    from kart_tpu.tiles.pyramid import export_pyramid, tree_digest as digest
 
     repo, ds_path = make_imported_repo(tmp_path, n=12)
     src = tiles.source_for(
         repo, tiles.resolve_tile_commit(repo, "HEAD"), ds_path
     )
-
-    def digest(out):
-        h = hashlib.sha256()
-        for dirpath, dirnames, filenames in sorted(os.walk(out)):
-            dirnames.sort()
-            for name in sorted(filenames):
-                p = os.path.join(dirpath, name)
-                h.update(os.path.relpath(p, out).encode())
-                with open(p, "rb") as f:
-                    h.update(f.read())
-        return h.hexdigest()
 
     clean_dir = str(tmp_path / "clean")
     export_pyramid(src, [0, 1, 2], clean_dir, layers=("ktb2",),

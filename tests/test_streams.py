@@ -178,6 +178,65 @@ def test_malformed_streams_raise():
         S.decode_stream(too_big, 1, "i4")
 
 
+def test_varint_value_over_uint64_raises():
+    """Review regression: a 10-byte varint encoding a value >= 2**64
+    (e.g. LEB128 for 2**70-1) must raise, not wrap modulo 2**64 and
+    decode a non-canonical byte string to a wrong value."""
+    crafted = b"\xff" * 9 + b"\x7f"
+    with pytest.raises(S.TileEncodeError, match="exceeds uint64"):
+        S.varint_decode(crafted, 1)
+    # the full uint64 range itself still round-trips
+    top = np.array([(1 << 64) - 1, 1 << 63], np.uint64)
+    out, _pos = S.varint_decode(S.varint_encode(top), 2)
+    assert np.array_equal(out, top)
+    # and a crafted DVARINT stream built on such a varint raises cleanly
+    body = crafted
+    data = S._STREAM_HEADER.pack(S.DVARINT, len(body)) + body
+    with pytest.raises(S.TileEncodeError):
+        S.decode_stream(data, 1, "i8")
+
+
+def test_varint_zero_padded_encoding_raises():
+    """Review regression: a zero-padded varint (0x81 0x00 for the value 1,
+    canonically 0x01) must raise — accepting it lets two distinct byte
+    strings decode to one logical column, splitting the ETag space."""
+    with pytest.raises(S.TileEncodeError, match="zero-padded"):
+        S.varint_decode(b"\x81\x00", 1)
+    data = S._STREAM_HEADER.pack(S.DVARINT, 2) + b"\x81\x00"
+    with pytest.raises(S.TileEncodeError):
+        S.decode_stream(data, 1, "i8")
+    # a bare single-byte zero is canonical and still decodes
+    out, pos = S.varint_decode(b"\x00", 1)
+    assert out[0] == 0 and pos == 1
+
+
+def test_rle_run_length_overflow_bomb_raises():
+    """Review regression: crafted RLE run lengths (four runs of 2**62)
+    overflow a wrapping int64 sum back to ``count``, slipping past the
+    total-rows guard and sending np.repeat off on a ~2**64-element
+    expansion (a hard crash from a ~40-byte payload). Each run length
+    must be bounded by ``count`` and the total computed without wrap."""
+    lens = np.array([1 << 62, 1 << 62, 1 << 62, (1 << 62) + 4], np.uint64)
+    body = (
+        S.varint_encode(np.asarray([4], np.uint64))  # n_runs
+        + S.varint_encode(lens)
+        + S.varint_encode(S.zigzag(np.zeros(4, np.int64)))  # run values
+    )
+    crafted = S._STREAM_HEADER.pack(S.RLE, len(body)) + body
+    with pytest.raises(S.TileEncodeError):
+        S.decode_stream(crafted, 4, "i8")
+    # a single run length over count (no overflow needed) also raises
+    lens = np.array([2, 3], np.uint64)  # 2 + 3 != 4 and 3 <= 4: sum guard
+    body = (
+        S.varint_encode(np.asarray([2], np.uint64))
+        + S.varint_encode(lens)
+        + S.varint_encode(S.zigzag(np.zeros(2, np.int64)))
+    )
+    crafted = S._STREAM_HEADER.pack(S.RLE, len(body)) + body
+    with pytest.raises(S.TileEncodeError):
+        S.decode_stream(crafted, 4, "i8")
+
+
 def test_bytes_stream_round_trip_and_dictionary_wins():
     rows = [b'{"name":"a"}', b'{"name":"b"}'] * 200 + [b"", b"unique"]
     data = S.encode_bytes_stream(rows)
@@ -212,6 +271,50 @@ def test_bytes_stream_empty_dictionary_with_rows_raises():
     )
     with pytest.raises(S.TileEncodeError):
         S.decode_bytes_stream(crafted, 3)
+
+
+def test_bytes_stream_dictionary_length_overflow_raises():
+    """Review regression: dictionary string lengths summing past 2**64
+    wrap an int64 total under the truncation guard — the RLE overflow
+    class in the props-dictionary decoder."""
+    lens = np.full(3, (2**64 + 5) // 3 + 1, np.int64)  # valid positive i64s
+    assert int(np.sum(lens)) < 100  # the wrap this test pins
+    crafted = (
+        S.varint_encode(np.asarray([3], np.uint64))  # n_unique = 3
+        + S.encode_stream(lens, "i8")
+        + b"xxxxx"  # "blob" the wrapped total pretends to cover
+        + S.encode_stream(np.zeros(3, np.int64), "i8")
+    )
+    with pytest.raises(S.TileEncodeError, match="Truncated dictionary blob"):
+        S.decode_bytes_stream(crafted, 3)
+
+
+def test_nonzero_pad_bits_raise():
+    """Review regression: nonzero trailing pad bits in a FOR/DFOR
+    bit-packed payload are a distinct byte string decoding to the same
+    column — canonicality requires they raise."""
+    v = np.asarray([3, 1, 5], np.int64)  # FOR: base 1, width 2, 6 bits
+    data = bytearray(S.encode_stream(v, "i8", force=S.FOR))
+    assert not data[-1] & 0x03  # the two pad bits are zero as encoded
+    out, _pos = S.decode_stream(bytes(data), 3, "i8")
+    assert np.array_equal(out, v)
+    data[-1] |= 0x01  # flip an unused low pad bit
+    with pytest.raises(S.TileEncodeError, match="padding bits"):
+        S.decode_stream(bytes(data), 3, "i8")
+
+
+def test_split_rle_runs_raise():
+    """Review regression: adjacent RLE runs holding the same value are a
+    non-canonical split of one run and must raise."""
+    zz0 = S.zigzag(np.asarray([7, 7], np.int64))
+    body = (
+        S.varint_encode(np.asarray([2], np.uint64))  # n_runs
+        + S.varint_encode(np.asarray([3, 2], np.uint64))  # lens sum to 5
+        + S.varint_encode(zz0)  # both runs carry the value 7
+    )
+    crafted = S._STREAM_HEADER.pack(S.RLE, len(body)) + body
+    with pytest.raises(S.TileEncodeError, match="adjacent runs"):
+        S.decode_stream(crafted, 5, "i8")
 
 
 def test_padded_stream_payload_raises():

@@ -657,6 +657,61 @@ def test_encoding_ladder_branches_round_trip_in_tiles():
     assert len(ktb2) < len(layers["bin"]) / 4
 
 
+def test_mvt_truncated_geometry_raises_tile_encode_error():
+    """Review regression: a command word claiming more points than the
+    geometry buffer holds must raise TileEncodeError (the decoder's
+    bounds-checked contract), not a bare IndexError."""
+    def uvarint(n):
+        out = b""
+        while True:
+            b, n = n & 0x7F, n >> 7
+            if n:
+                out += bytes([b | 0x80])
+            else:
+                return out + bytes([b])
+
+    def field(num, payload):
+        return uvarint((num << 3) | 2) + uvarint(len(payload)) + payload
+
+    # MoveTo with a claimed count of 3 points, but only one (dx, dy) pair
+    geom = uvarint((3 << 3) | 1) + uvarint(2) + uvarint(2)
+    feature = field(4, geom)
+    layer = field(1, b"t") + field(2, feature)
+    tile = field(3, layer)
+    with pytest.raises(tiles.TileEncodeError, match="Truncated MVT geometry"):
+        tiles.decode_mvt_layer(tile)
+
+    # a 10-byte feature-id varint >= 2**64 must also raise TileEncodeError,
+    # not leak numpy's OverflowError
+    feature = uvarint(1 << 3) + b"\xff" * 9 + b"\x7f"
+    tile = field(3, field(1, b"t") + field(2, feature))
+    with pytest.raises(tiles.TileEncodeError, match="exceeds uint64"):
+        tiles.decode_mvt_layer(tile)
+
+    # a geometry ending mid-varint (dangling continuation byte after a
+    # valid point command) must raise, not silently drop the tail
+    geom = uvarint((1 << 3) | 1) + uvarint(2) + uvarint(2) + b"\x80"
+    tile = field(3, field(1, b"t") + field(2, field(4, geom)))
+    with pytest.raises(tiles.TileEncodeError, match="Truncated MVT geometry"):
+        tiles.decode_mvt_layer(tile)
+
+    # invalid command ids (here 4), zero-count move/line words, and
+    # ClosePath with count != 1 must raise, not decode to silently
+    # wrong geometry
+    for bad_word in ((1 << 3) | 4, (0 << 3) | 1, (2 << 3) | 7, (0 << 3) | 7):
+        geom = uvarint(bad_word) + uvarint(2) + uvarint(2)
+        tile = field(3, field(1, b"t") + field(2, field(4, geom)))
+        with pytest.raises(tiles.TileEncodeError, match="Malformed MVT"):
+            tiles.decode_mvt_layer(tile)
+
+    # a feature id delivered length-delimited (wire type 2) must raise
+    # TileEncodeError, not leak a TypeError from the uint64 guard
+    feature = field(1, b"xx")
+    tile = field(3, field(1, b"t") + field(2, feature))
+    with pytest.raises(tiles.TileEncodeError, match="non-varint wire type"):
+        tiles.decode_mvt_layer(tile)
+
+
 def test_props_layer_matches_geojson(served_points):
     """props is the dictionary-coded form of exactly the geojson lines
     (same compiled serialisers, row-aligned with the bin keys)."""
@@ -875,17 +930,9 @@ class TestGoldenPayloads:
 
 
 def _pyramid_digest(out_dir):
-    import hashlib
+    from kart_tpu.tiles.pyramid import tree_digest
 
-    h = hashlib.sha256()
-    for dirpath, dirnames, filenames in sorted(os.walk(out_dir)):
-        dirnames.sort()
-        for name in sorted(filenames):
-            p = os.path.join(dirpath, name)
-            h.update(os.path.relpath(p, out_dir).encode())
-            with open(p, "rb") as f:
-                h.update(f.read())
-    return h.hexdigest()
+    return tree_digest(out_dir)
 
 
 def test_batch_encoder_matches_serving_encoder(synth_spatial):
