@@ -3836,6 +3836,252 @@ def live_main():
         shutil.rmtree(os.path.join(td, "pusher"), ignore_errors=True)
 
 
+def query_main():
+    """`bench.py --query` (docs/QUERY.md §6): the ISSUE 16 query engine.
+    Legs: (1) predicate-pushdown scan — a selective bbox over a spatial
+    synth repo with block pruning on vs forced off (KART_BLOCK_PRUNE=0),
+    identical counts required, prune fraction recorded against the >=95%
+    bar; (2) the headline spatial join at 100M probe x 1M build envelope
+    rows, host_native vs the sharded device backend, exact per-count
+    cross-validation; (3) the same join scattered across 2 replicas of a
+    shared store vs a single node. Prints the record after each leg so a
+    watchdog kill salvages the finished ones."""
+    import tempfile
+    import threading
+    from urllib.request import urlopen
+
+    import numpy as np
+
+    scan_rows = int(os.environ.get("KART_BENCH_QUERY_SCAN_ROWS", 10_000_000))
+    probe_rows = int(os.environ.get("KART_BENCH_QUERY_ROWS", 100_000_000))
+    build_rows = int(
+        os.environ.get("KART_BENCH_QUERY_BUILD_ROWS", 1_000_000)
+    )
+    scatter_rows = int(
+        os.environ.get("KART_BENCH_QUERY_SCATTER_ROWS", 4_000_000)
+    )
+
+    from kart_tpu.query import run_query
+    from kart_tpu.synth import synth_envelopes, synth_repo
+    from kart_tpu.transport.http import make_server
+
+    record = {
+        "metric": "query",
+        "query_scan_rows": scan_rows,
+        "query_join_probe_rows": probe_rows,
+        "query_join_build_rows": build_rows,
+        "query_scatter_rows": scatter_rows,
+        "ok": True,
+    }
+
+    def _clear_query_caches():
+        from kart_tpu.query import cache as qcache
+
+        with qcache._query_caches_lock:
+            qcache._QUERY_CACHES.clear()
+
+    shm = "/dev/shm" if os.path.isdir("/dev/shm") else None
+    pk0 = 1 << 24
+
+    # -- leg 1: the pushdown scan, pruned vs unpruned ---------------------
+    with tempfile.TemporaryDirectory(dir=shm) as td:
+        t0 = time.perf_counter()
+        repo, info = synth_repo(
+            os.path.join(td, "scan"), scan_rows, spatial=True,
+            blobs="promised",
+        )
+        record["query_scan_synth_seconds"] = round(
+            time.perf_counter() - t0, 2
+        )
+        base = info["base_commit"]
+        from kart_tpu.diff import sidecar
+
+        block = sidecar.ensure_block(
+            repo, repo.datasets(base)["synth"], pad=False
+        )
+        env = np.asarray(block.envelopes[: 1 << 16], dtype=np.float64)
+        w = float(env[:, 0].min())
+        # ~1% of the longitude span: selective enough that a pruned scan
+        # should skip >=95% of blocks outright
+        bbox = (
+            f"{w},{float(env[:, 1].min())},"
+            f"{w + (float(env[:, 2].max()) - w) * 0.01},"
+            f"{float(env[:, 3].max())}"
+        )
+        del block, env
+
+        run_query(repo, base, "synth", bbox=bbox)  # warm: mmap page-in
+        t0 = time.perf_counter()
+        pruned = run_query(repo, base, "synth", bbox=bbox)
+        pruned_s = time.perf_counter() - t0
+        os.environ["KART_BLOCK_PRUNE"] = "0"
+        try:
+            run_query(repo, base, "synth", bbox=bbox)  # warm full-scan pages
+            t0 = time.perf_counter()
+            unpruned = run_query(repo, base, "synth", bbox=bbox)
+            unpruned_s = time.perf_counter() - t0
+        finally:
+            del os.environ["KART_BLOCK_PRUNE"]
+        stats = pruned["stats"]
+        record["query_scan_seconds"] = round(pruned_s, 4)
+        record["query_scan_rows_per_sec"] = round(scan_rows / pruned_s)
+        record["query_scan_unpruned_seconds"] = round(unpruned_s, 4)
+        record["query_scan_rows_per_sec_unpruned"] = round(
+            scan_rows / unpruned_s
+        )
+        record["query_scan_matches"] = pruned["count"]
+        record["query_scan_pruned_matches_unpruned"] = (
+            pruned["count"] == unpruned["count"]
+        )
+        prune_frac = stats["blocks_pruned"] / max(stats["blocks"], 1)
+        record["query_scan_block_prune_fraction"] = round(prune_frac, 4)
+        record["query_scan_prune_meets_95pct"] = prune_frac >= 0.95
+        record["query_scan_prune_speedup"] = round(unpruned_s / pruned_s, 2)
+        print(json.dumps(record), flush=True)
+
+    # -- leg 2: the headline join kernel, host vs device ------------------
+    # Envelope columns straight from the synth generator: the join never
+    # touches blobs, so this measures exactly what the repo-level path
+    # measures minus one mmap — at 100M x 1M only pruning makes any
+    # backend feasible, which is the point of the staged kernel.
+    from kart_tpu.diff.sidecar import AGG_BLOCK_ROWS, _block_aggregates
+    from kart_tpu.query.join import join_counts_for_range
+
+    probe_env = synth_envelopes(np.arange(pk0, pk0 + probe_rows))
+    build_env = synth_envelopes(np.arange(pk0, pk0 + build_rows))
+
+    class _Probe:
+        envelopes = probe_env
+        env_blocks = (*_block_aggregates(probe_env, AGG_BLOCK_ROWS),
+                      AGG_BLOCK_ROWS)
+        count = probe_rows
+
+    cand_pairs = probe_rows * build_rows
+    t0 = time.perf_counter()
+    host_counts, host_total = join_counts_for_range(
+        build_env, _Probe, 0, probe_rows, allow_device=False
+    )
+    host_s = time.perf_counter() - t0
+    record["query_join_pairs"] = int(host_total)
+    record["query_join_host_seconds"] = round(host_s, 3)
+    record["query_join_pairs_per_sec_100m_x_1m_host"] = round(
+        cand_pairs / host_s
+    )
+
+    os.environ["KART_DIFF_SHARDED"] = "1"
+    try:
+        t0 = time.perf_counter()
+        dev_counts, dev_total = join_counts_for_range(
+            build_env, _Probe, 0, probe_rows, allow_device=True,
+            route_rows=probe_rows,
+        )
+        dev_s = time.perf_counter() - t0
+    finally:
+        del os.environ["KART_DIFF_SHARDED"]
+    record["query_join_device_seconds"] = round(dev_s, 3)
+    record["query_join_pairs_per_sec_100m_x_1m"] = round(cand_pairs / dev_s)
+    record["query_join_device_vs_host"] = round(host_s / dev_s, 2)
+    record["query_join_device_matches_host"] = bool(
+        np.array_equal(host_counts, dev_counts) and host_total == dev_total
+    )
+    del probe_env, build_env, host_counts, dev_counts, _Probe
+    print(json.dumps(record), flush=True)
+
+    # -- leg 3: the 2-replica scatter vs a single node --------------------
+    # Shared-store fleet shape: one peer `kart serve` process answers the
+    # upper probe half as a commit-addressed partial while this process's
+    # node computes the lower half — wall clock vs the same join on one
+    # node, exact counts required.
+    with tempfile.TemporaryDirectory(dir=shm) as td:
+        t0 = time.perf_counter()
+        repo, info = synth_repo(
+            os.path.join(td, "scatter"), scatter_rows, spatial=True,
+            blobs="changed",
+        )
+        record["query_scatter_synth_seconds"] = round(
+            time.perf_counter() - t0, 2
+        )
+        base, edit = info["base_commit"], info["edit_commit"]
+        workdir = repo.workdir or repo.gitdir
+
+        from kart_tpu import fleet as fleet_mod
+
+        peer_port = _free_port()
+        peer = _spawn_serve(workdir, peer_port)
+        single_server = make_server(repo)
+        threading.Thread(
+            target=single_server.serve_forever, daemon=True
+        ).start()
+        node = fleet_mod.FleetNode(
+            repo, primary_url=None,
+            peers=(f"http://127.0.0.1:{peer_port}/",),
+        )
+        scatter_server = make_server(repo, fleet=node)
+        threading.Thread(
+            target=scatter_server.serve_forever, daemon=True
+        ).start()
+        try:
+            path = (
+                f"/api/v1/query?ref={base}&dataset=synth"
+                f"&intersects={edit}:synth"
+            )
+            deadline = time.monotonic() + 60
+            while True:  # wait for the peer process to accept
+                try:
+                    with urlopen(
+                        f"http://127.0.0.1:{peer_port}/api/v1/stats",
+                        timeout=5,
+                    ):
+                        break
+                except OSError:
+                    if time.monotonic() > deadline:
+                        raise
+                    time.sleep(0.2)
+
+            single_url = (
+                f"http://127.0.0.1:{single_server.server_address[1]}"
+            )
+            t0 = time.perf_counter()
+            with urlopen(single_url + path, timeout=3600) as resp:
+                single_doc = json.loads(resp.read())
+            single_s = time.perf_counter() - t0
+
+            _clear_query_caches()  # the single-node doc must not be reused
+            scatter_url = (
+                f"http://127.0.0.1:{scatter_server.server_address[1]}"
+            )
+            t0 = time.perf_counter()
+            with urlopen(scatter_url + path, timeout=3600) as resp:
+                scatter_doc = json.loads(resp.read())
+            scatter_s = time.perf_counter() - t0
+
+            sc_pairs = scatter_rows * scatter_rows
+            record["query_join_single_node_seconds"] = round(single_s, 3)
+            record["query_join_scatter2_seconds"] = round(scatter_s, 3)
+            record["query_join_pairs_per_sec_100m_x_1m_scatter2"] = round(
+                sc_pairs / scatter_s
+            )
+            record["query_scatter_speedup"] = round(single_s / scatter_s, 2)
+            record["query_scatter_matches_single"] = (
+                scatter_doc["pairs"] == single_doc["pairs"]
+                and scatter_doc["count"] == single_doc["count"]
+            )
+            record["query_scatter_parts"] = scatter_doc["stats"].get(
+                "scatter_parts", 0
+            )
+        finally:
+            single_server.shutdown()
+            single_server.server_close()
+            scatter_server.shutdown()
+            scatter_server.server_close()
+            try:
+                peer.kill()
+                peer.wait()
+            except OSError:
+                pass
+    print(json.dumps(record), flush=True)
+
+
 if __name__ == "__main__":
     import sys
 
@@ -3859,6 +4105,8 @@ if __name__ == "__main__":
         serve_storm_worker()
     elif "--serve-storm" in sys.argv:
         serve_storm_main()
+    elif "--query" in sys.argv:
+        query_main()
     elif "--multichip-worker" in sys.argv:
         multichip_worker()
     elif "--multichip" in sys.argv:

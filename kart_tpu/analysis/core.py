@@ -82,6 +82,8 @@ class FileContext:
         # COMMENT tokens, not raw lines — prose *inside a string* that
         # documents the noqa syntax must neither suppress nor trip KTL000.
         self.noqa = {}
+        if "noqa" not in source:
+            return  # no comment can match: skip the tokenize pass
         try:
             tokens = tokenize.generate_tokens(io.StringIO(source).readline)
             for tok in tokens:

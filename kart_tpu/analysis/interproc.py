@@ -138,12 +138,12 @@ class FileSummary:
 
     def _build(self):
         ctx = self.ctx
-        self._collect_imports(ctx.tree)
+        self._collect_imports(ctx.nodes)
         self._collect_defs(ctx.tree, prefix="", cls=None)
         self._collect_locks()
 
-    def _collect_imports(self, tree):
-        for node in ast.walk(tree):
+    def _collect_imports(self, nodes):
+        for node in nodes:
             if isinstance(node, ast.Import):
                 for alias in node.names:
                     local = alias.asname or alias.name.split(".", 1)[0]

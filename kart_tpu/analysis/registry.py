@@ -98,6 +98,11 @@ ENV_VARS = {
     "KART_TPU_NATIVE_LIB": "source",
     "KART_TPU_NATIVE_IO_LIB": "source",
     "KART_NO_NATIVE_BUILD": "source",
+    # query (docs/QUERY.md)
+    "KART_QUERY_BATCH_ROWS": "source",
+    "KART_QUERY_PAGE_SIZE": "source",
+    "KART_QUERY_SCATTER": "source",
+    "KART_QUERY_CACHE": "source",
     # misc
     "KART_REPO": "source",
     "KART_NTV2_GRID_DIR": "source",
@@ -150,6 +155,8 @@ FAULT_POINTS = frozenset(
         "fleet.proxy",
         "events.emit",
         "events.warm",
+        "query.scan",
+        "query.join",
     }
 )
 
@@ -243,6 +250,14 @@ CACHES = {
             "bound alone reclaims memory (docs/TILES.md §3)"
         ),
     },
+    "query.cache": {
+        "module": "kart_tpu/query/cache.py",
+        "cls": "QueryCache",
+        "registry_global": "_QUERY_CACHES",
+        "key_fn": "query_request_key",
+        "key_tokens": ("commit_oid",),
+        "ref_drop": "invalidate_query_caches",
+    },
     "fleet.peer_cache": {
         "module": "kart_tpu/fleet/peercache.py",
         "cls": "PeerCache",
@@ -331,6 +346,9 @@ DEVICE_SEAMS = {
             "select_backend",
             "warm_probe",
             "project_envelopes",
+            # join_bbox_counts is the query engine's spatial-join batch
+            # seam: same gating ladder as project_envelopes
+            "join_bbox_counts",
         }
     ),
     "kart_tpu/ops/bbox.py": frozenset(

@@ -417,7 +417,7 @@ class ResourceLifecycle(Rule):
 
     def _check_openers(self, ctx):
         findings = []
-        for scope in self._scopes(ctx.tree):
+        for scope in self._scopes(ctx):
             names = None  # computed only if this scope opens anything
             for node in self._scope_walk(scope):
                 if not isinstance(node, ast.Call):
@@ -440,9 +440,9 @@ class ResourceLifecycle(Rule):
                     )
         return findings
 
-    def _scopes(self, tree):
-        yield tree
-        for node in ast.walk(tree):
+    def _scopes(self, ctx):
+        yield ctx.tree
+        for node in ctx.nodes:
             if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
                 yield node
 

@@ -36,7 +36,7 @@ _COMMANDS = {
     "reflog": "kart_tpu.cli.ref_cmds",
     "git": "kart_tpu.cli.ref_cmds",
     "data": "kart_tpu.cli.data_cmds",
-    "query": "kart_tpu.cli.data_cmds",
+    "query": "kart_tpu.cli.query_cmds",
     "meta": "kart_tpu.cli.data_cmds",
     "merge": "kart_tpu.cli.merge_cmds",
     "conflicts": "kart_tpu.cli.merge_cmds",

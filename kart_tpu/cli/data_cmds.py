@@ -164,121 +164,6 @@ def build_annotations(ctx, all_reachable):
     click.echo(f"Built annotations for {built} commit(s)")
 
 
-@cli.command(hidden=True)
-@click.argument("dataset")
-@click.argument(
-    "operation", type=click.Choice(["index", "get", "intersects"])
-)
-@click.argument("argument", required=False)
-@click.option(
-    "-o", "--output-format", type=click.Choice(["text", "json"]), default="text"
-)
-@click.pass_obj
-def query(ctx, dataset, operation, argument, output_format):
-    """Experimental spatial queries over a dataset (reference: kart/query.py
-    rtree PoC — here the index is the envelope table and the query runs as a
-    vectorized bbox kernel on the device, kart_tpu/ops/bbox.py)."""
-    import time
-
-    from kart_tpu.geometry import Geometry
-    from kart_tpu.ops.bbox import bbox_intersects
-
-    repo = ctx.repo
-    ds = repo.datasets("HEAD").get(dataset)
-    if ds is None:
-        raise CliError(f"No such dataset: {dataset!r}")
-
-    if operation == "get":
-        if argument is None:
-            raise CliError("query get requires a primary key argument")
-        try:
-            pk = int(argument)
-        except ValueError:
-            pk = argument
-        try:
-            feature = ds.get_feature([pk])
-        except KeyError:
-            raise CliError(f"No feature with primary key {pk!r} in {dataset!r}")
-        if output_format == "text":
-            for name, value in _feature_json(feature).items():
-                click.echo(f"{name:>30} = {value}")
-        else:
-            dump_json_output({"kart.query/v1": _feature_json(feature)}, "-")
-        return
-
-    # build the envelope table: one walk over the feature tree, reading each
-    # blob by the oid already in hand (no per-feature path re-resolution)
-    t0 = time.monotonic()
-    geom_col = ds.geom_column_name
-    if geom_col is None:
-        raise CliError(f"Dataset {dataset!r} has no geometry column")
-    odb = ds.feature_tree.odb if ds.feature_tree is not None else None
-    paths, envelopes = [], []
-    for path, entry in (
-        ds.feature_tree.walk_blobs() if ds.feature_tree is not None else ()
-    ):
-        feature = ds.get_feature(path=path, data=odb.read_blob(entry.oid))
-        geom = feature.get(geom_col)
-        env = Geometry.of(geom).envelope() if geom is not None else None
-        if env is not None:
-            paths.append(path)
-            envelopes.append((env[0], env[2], env[1], env[3]))  # wsen
-    build_s = time.monotonic() - t0
-
-    if operation == "index":
-        click.echo(
-            f"Indexed {len(envelopes)} feature envelopes in {build_s:.3f}s"
-        )
-        return
-
-    # intersects W,S,E,N
-    if argument is None:
-        raise CliError("query intersects requires a W,S,E,N argument")
-    try:
-        wsen = [float(p) for p in argument.split(",")]
-        assert len(wsen) == 4
-    except (ValueError, AssertionError):
-        raise CliError(f"Bad bbox (expected W,S,E,N): {argument!r}")
-    t0 = time.monotonic()
-    # keyed by the feature tree: repeat queries in one process (serve /
-    # scripting) reuse the device-resident envelope columns
-    cache_key = ("query", repo.gitdir, ds.feature_tree.oid)
-    mask = bbox_intersects(envelopes, wsen, cache_key=cache_key)
-    query_s = time.monotonic() - t0
-    hits = [ds.decode_path_to_pks(paths[i]) for i in range(len(paths)) if mask[i]]
-    hits = [pk[0] if len(pk) == 1 else list(pk) for pk in hits]
-    # numeric sort for homogeneous PKs; stable repr sort otherwise
-    try:
-        hits.sort()
-    except TypeError:
-        hits.sort(key=str)
-    if output_format == "json":
-        dump_json_output(
-            {
-                "kart.query/v1": {
-                    "count": len(hits),
-                    "pks": hits,
-                    "index_build_s": round(build_s, 4),
-                    "query_s": round(query_s, 4),
-                }
-            },
-            "-",
-        )
-    else:
-        for pk in hits:
-            click.echo(str(pk))
-        click.echo(
-            f"({len(hits)} features; index {build_s:.3f}s, query {query_s:.4f}s)"
-        )
-
-
-def _feature_json(feature):
-    from kart_tpu.diff.output import feature_as_json
-
-    pk = next(iter(feature.values()), None)
-    return feature_as_json(feature, pk)
-
-
 @cli.command("commit-files")
 @click.option("--message", "-m", required=True, help="Commit message")
 @click.option("--ref", default="HEAD", help="Branch/ref to commit to")

@@ -1,0 +1,104 @@
+"""``kart query`` — predicate-pushdown scans and the cross-commit spatial
+join (ISSUE 16; docs/QUERY.md). The CLI face of :func:`kart_tpu.query.run_query`."""
+
+import click
+
+from kart_tpu.cli import CliError, cli
+from kart_tpu.diff.output import dump_json_output
+
+
+def _parse_intersects(text):
+    """``<refish>:<dataset>`` (or ``<refish>/<dataset>`` when the refish has
+    no slash of its own) -> (refish, ds_path)."""
+    if ":" in text:
+        refish, _, ds_path = text.partition(":")
+    elif "/" in text:
+        refish, _, ds_path = text.partition("/")
+    else:
+        raise CliError(
+            f"--intersects wants <refish>:<dataset>, got {text!r}"
+        )
+    if not refish or not ds_path:
+        raise CliError(
+            f"--intersects wants <refish>:<dataset>, got {text!r}"
+        )
+    return refish, ds_path
+
+
+@cli.command("query")
+@click.argument("refish")
+@click.argument("dataset")
+@click.option(
+    "--where",
+    default=None,
+    metavar="PREDICATE",
+    help="Attribute predicate: AND-joined comparisons, IN lists and "
+    "IS [NOT] NULL tests (docs/QUERY.md §2)",
+)
+@click.option(
+    "--bbox",
+    default=None,
+    metavar="W,S,E,N",
+    help="Spatial predicate (E < W wraps the anti-meridian)",
+)
+@click.option(
+    "--intersects",
+    default=None,
+    metavar="REFISH:DATASET",
+    help="Spatial join: report DATASET rows whose bbox overlaps any row "
+    "of the named side (two datasets, or two commits of one dataset)",
+)
+@click.option(
+    "--count-by",
+    default=None,
+    metavar="COLUMN",
+    help="Group the count by one column instead of materialising rows",
+)
+@click.option(
+    "-o",
+    "--output-format",
+    type=click.Choice(["count", "json", "bbox"]),
+    default="count",
+)
+@click.option("--page", type=int, default=None, help="Page of -o json rows")
+@click.option(
+    "--page-size", type=int, default=None,
+    help="Rows per -o json page (KART_QUERY_PAGE_SIZE)",
+)
+@click.option(
+    "--host",
+    "host_only",
+    is_flag=True,
+    help="Pin the join kernel to the host backend (skip device routing)",
+)
+@click.pass_obj
+def query(ctx, refish, dataset, where, bbox, intersects, count_by,
+          output_format, page, page_size, host_only):
+    """Query one commit: filtered scans, aggregates and spatial joins.
+
+    REFISH names the commit (branch, tag, oid, HEAD); DATASET is the
+    dataset path at that commit. Results are a pure function of the
+    resolved commit oid(s) and the normalized request — the same document
+    ``GET /api/v1/query`` serves and caches.
+    """
+    from kart_tpu.query import QueryError, run_query
+
+    repo = ctx.repo
+    join = _parse_intersects(intersects) if intersects is not None else None
+    try:
+        result = run_query(
+            repo,
+            refish,
+            dataset,
+            where=where,
+            bbox=bbox,
+            intersects=join,
+            output=output_format,
+            count_by=count_by,
+            page=page,
+            page_size=page_size,
+            allow_device=not host_only,
+        )
+    except QueryError as e:
+        raise CliError(str(e))
+    dump_json_output({"kart.query/v2": result}, "-")

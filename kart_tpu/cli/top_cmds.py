@@ -129,6 +129,20 @@ def render_top(payload, url):
             f"  last warm {warm.get('tiles', 0)} tiles"
             f"/{warm.get('errors', 0)} err"
         )
+    query = payload.get("query")
+    if query:
+        # the query engine at a glance (docs/QUERY.md §7): how much work
+        # ran, how much the pushdown pruned away, and whether the scatter
+        # and cache tiers are earning their keep
+        lines.append(
+            f"query  scans {query.get('scans', 0)}"
+            f"  joins {query.get('joins', 0)}"
+            f"  blocks pruned {query.get('blocks_pruned', 0)}"
+            f"  pairs {query.get('pairs_emitted', 0)}"
+            f"  scatter parts {query.get('scatter_parts', 0)}"
+            f"  cache {query.get('cache_hits', 0)}h"
+            f"/{query.get('cache_misses', 0)}m"
+        )
     lines.append("")
     rate_heads = "".join(f"  req/s({w})" for w in windows)
     lines.append(

@@ -103,6 +103,15 @@ class DiffBackend:
 
         return bbox_intersects_f32(block.envelopes, query)
 
+    def join_counts(self, build_env, probe_env):
+        """Spatial-join batch kernel (ISSUE 16): (T, 4) f32 build-tile
+        envelopes x (B, 4) f32 probe-batch envelopes -> (per-probe match
+        counts int64 (B,), total pairs int). The overlap predicate is
+        comparison-only f32 (no arithmetic), so every backend is
+        bit-identical by construction; NaN (padding / NULL-geometry) rows
+        never match on either side. Base: the chunked numpy broadcast."""
+        return _host_join_counts(build_env, probe_env)
+
 
 @_register
 class HostNativeBackend(DiffBackend):
@@ -203,6 +212,15 @@ class ShardedJaxBackend(DiffBackend):
             return sharded_merc_envelopes(e)
         except Exception as exc:
             return self._fall_back(exc, "merc_envelopes").merc_envelopes(e)
+
+    def join_counts(self, build_env, probe_env):
+        try:
+            return sharded_join_counts(build_env, probe_env)
+        except Exception as e:
+            # device OOM / wedged tunnel mid-batch: nothing was published
+            # (the query layer accumulates only returned batches), so the
+            # host twin recomputes this batch from clean state
+            return self._fall_back(e, "join").join_counts(build_env, probe_env)
 
 
 def _device_envelopes_worthwhile(n):
@@ -432,6 +450,137 @@ def sharded_merc_envelopes(env):
         ]
         out = fn(*args)
     return tuple(np.asarray(o).reshape(-1)[:count] for o in out)
+
+
+# --- spatial-join batch kernel (the query engine's workload, ISSUE 16) ------
+
+def _join_overlap_np(pw, ps, pe, pn, bw, bs, be, bn):
+    """Pairwise bbox-overlap matrix, probe rows (column vectors (B, 1))
+    against build rows ((T,)): comparison-only f32 — no arithmetic, so the
+    numpy and XLA twins are bit-identical and NaN rows (padding,
+    NULL-geometry) never match. Cyclic longitude: ``e < w`` wraps; two
+    wrapping ranges always overlap (both contain the anti-meridian), one
+    wrapping range overlaps iff either ordinary endpoint test passes."""
+    lat = (bs <= pn) & (ps <= bn)
+    a = bw <= pe
+    b = pw <= be
+    bwrap = be < bw
+    pwrap = pe < pw
+    both = bwrap & pwrap
+    one = bwrap ^ pwrap
+    return lat & ((a & b) | both | (one & (a | b)))
+
+
+def _host_join_counts(build_env, probe_env, chunk=8192):
+    """Chunked numpy broadcast-probe: (T, 4) x (B, 4) f32 -> per-probe
+    int64 counts + total. Probe sub-chunks bound the (chunk, T) bool
+    intermediates (~32 MB at the 4096-row tile width)."""
+    b = np.asarray(build_env, dtype=np.float32)
+    p = np.asarray(probe_env, dtype=np.float32)
+    counts = np.zeros(len(p), dtype=np.int64)
+    if len(b) and len(p):
+        bw, bs, be, bn = b[:, 0], b[:, 1], b[:, 2], b[:, 3]
+        for lo in range(0, len(p), chunk):
+            sub = p[lo : lo + chunk]
+            hit = _join_overlap_np(
+                sub[:, 0:1], sub[:, 1:2], sub[:, 2:3], sub[:, 3:4],
+                bw[None, :], bs[None, :], be[None, :], bn[None, :],
+            )
+            counts[lo : lo + len(sub)] = np.count_nonzero(hit, axis=1)
+    return counts, int(counts.sum())
+
+
+@functools.lru_cache(maxsize=8)
+def _make_sharded_join(mesh):
+    import jax
+
+    from jax.sharding import PartitionSpec as P
+
+    import jax.numpy as jnp
+
+    from kart_tpu.diff.device_batch import _shard_map
+    from kart_tpu.parallel.mesh import FEATURES_AXIS
+
+    def _step(pw, ps, pe, pn, bw, bs, be, bn):
+        # probe cols (1, B) per-device slices; build cols (T,) replicated.
+        # Same comparison-only predicate as the numpy twin: bit-identical.
+        hit = _join_overlap_np(
+            pw[0][:, None], ps[0][:, None], pe[0][:, None], pn[0][:, None],
+            bw[None, :], bs[None, :], be[None, :], bn[None, :],
+        )
+        counts = jnp.sum(hit, axis=1, dtype=jnp.int32)
+        total = jax.lax.psum(jnp.sum(counts, dtype=jnp.int64), FEATURES_AXIS)
+        return counts[None], total
+
+    jax.config.update("jax_enable_x64", True)  # int64 pair totals
+    spec = P(FEATURES_AXIS)
+    fn = _shard_map()(
+        _step,
+        mesh=mesh,
+        in_specs=(spec,) * 4 + (P(),) * 4,
+        out_specs=(spec, P()),
+    )
+    return jax.jit(fn)
+
+
+def sharded_join_counts(build_env, probe_env):
+    """(T, 4) x (B, 4) f32 -> (per-probe counts int64 (B,), psum'd total):
+    probe columns sharded over the feature axis, the build tile replicated
+    on every device, the (B_shard, T) overlap matrix reduced on-device —
+    per-probe counts come home sharded, the pair total crosses the mesh as
+    one psum'd scalar. Padding rows are NaN on both sides: never a match,
+    so padded results equal unpadded ones exactly."""
+    import jax
+
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from kart_tpu.diff.device_batch import pack_env_round
+    from kart_tpu.ops.blocks import bucket_size
+    from kart_tpu.parallel.mesh import FEATURES_AXIS, make_mesh
+
+    mesh = make_mesh()
+    n_shards = int(mesh.devices.size)
+    m = len(probe_env)
+    per = bucket_size(max(-(-m // n_shards), 1), minimum=256)
+    pcols = pack_env_round(probe_env, 0, m, n_shards, per)
+    t = len(build_env)
+    tcap = bucket_size(max(t, 1), minimum=256)
+    bcols = np.full((4, tcap), np.nan, dtype=np.float32)
+    if t:
+        bcols[:, :t] = np.asarray(build_env, dtype=np.float32).T
+    fn = _make_sharded_join(mesh)
+    sharding = NamedSharding(mesh, P(FEATURES_AXIS))
+    with tm.span("diff.device.transfer", rows=int(m)):
+        args = [jax.device_put(c, sharding) for c in pcols]
+        args += [jax.device_put(c) for c in bcols]
+    counts, total = fn(*args)
+    return (
+        np.asarray(counts).reshape(-1)[:m].astype(np.int64),
+        int(total),
+    )
+
+
+def join_bbox_counts(build_env, probe_env, allow_device=True, route_rows=None):
+    """The query engine's per-batch entry point on this seam (docs/QUERY.md
+    §4): build-tile x probe-batch envelope overlap counts, routed exactly
+    like :func:`project_envelopes` — same env gates, same readiness ladder,
+    same host fallback. ``route_rows`` lets the caller gate on the *whole*
+    probe side rather than one batch (the join streams many fixed-size
+    batches through one routing decision)."""
+    from kart_tpu.parallel.sharded_diff import should_shard
+
+    b = np.asarray(build_env, dtype=np.float32)
+    p = np.asarray(probe_env, dtype=np.float32)
+    backend = BACKENDS["host_native"]
+    if (
+        allow_device
+        and os.environ.get("KART_DIFF_DEVICE") != "0"
+        and os.environ.get("KART_DIFF_BACKEND", "auto")
+        in ("auto", "sharded_jax")
+        and should_shard(len(p) if route_rows is None else int(route_rows))
+    ):
+        backend = BACKENDS["sharded_jax"]
+    return backend.join_counts(b, p)
 
 
 # --- pmapped sampled-count reduction ----------------------------------------

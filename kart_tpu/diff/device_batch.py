@@ -146,6 +146,24 @@ def roundtrip_arrays(keys, oids, batch_rows, n_shards=1):
     return out_keys, out_oids
 
 
+def pack_env_round(env, lo, hi, n_shards, per, fill=np.nan):
+    """Envelope rows ``[lo:hi)`` of a (N, 4) f32 column -> 4 fixed-shape
+    (S, per) f32 shard batches (w, s, e, n), the spatial join's probe-side
+    record batch (ISSUE 16; same deal-contiguous layout as
+    :func:`pack_round`, so ``result.reshape(-1)[:hi-lo]`` restores row
+    order). Padding rows are NaN: the comparison-only overlap predicate
+    can never match them, so padded batches count exactly like unpadded
+    ones — the validity-count column the classify batches need is
+    unnecessary here."""
+    m = hi - lo
+    if m > n_shards * per:
+        raise ValueError(f"batch of {m} rows exceeds {n_shards}x{per} slots")
+    cols = np.full((4, n_shards * per), fill, dtype=np.float32)
+    if m:
+        cols[:, :m] = np.asarray(env[lo:hi], dtype=np.float32).T
+    return [c.reshape(n_shards, per) for c in cols]
+
+
 def _shard_map():
     try:  # jax >= 0.6 exposes shard_map at top level
         from jax import shard_map  # type: ignore[attr-defined]

@@ -80,6 +80,15 @@ Registered points:
                             encodes: a crash abandons warming but must
                             not poison the tile cache or lose the
                             announcement (warm is best-effort)
+    query.scan              the pushdown scan (kart_tpu/query/scan.py):
+                            1 = scan entry (before any stage runs), 2+ =
+                            each blob-decode batch — an armed scan dies
+                            publishing nothing (no query/peer/HTTP cache
+                            entry) and the retried scan is byte-identical
+    query.join              the spatial join (kart_tpu/query/join.py):
+                            1 = join entry, 2+ = each build-side tile —
+                            same publish-nothing / byte-identical-retry
+                            contract as query.scan
 
 Disabled (``KART_FAULTS`` unset) the fast path is a single environ dict
 lookup with no allocation: frame-boundary loops additionally hoist

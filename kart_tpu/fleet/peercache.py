@@ -240,6 +240,28 @@ def tile_peer_fill(repo, peers, commit_oid, ds_path, z, x, y, layers):
     return fill
 
 
+def query_from_peers(repo, peers, path_and_query, etag):
+    """Fetch a commit-addressed query result (usually a scatter partial —
+    ISSUE 16, docs/QUERY.md §6) from the first answering peer instead of
+    scanning/joining locally: GET the exact request path; accept the
+    response only when its ETag equals the one this node computed (the key
+    embeds the commit oid(s) and the normalized request, so equal
+    validators prove byte-identical results). -> result document bytes,
+    or None → the caller computes locally."""
+
+    def fetch():
+        with tm.span("fleet.peer_fetch", kind="query"):
+            for peer in peers:
+                if not _peer_available(peer):
+                    continue
+                payload = _fetch_validated(f"{peer}{path_and_query}", etag)
+                if payload is not None:
+                    return payload
+        return None
+
+    return _filled(repo, peer_key("query", etag), fetch)
+
+
 def fetch_pack_from_peers(repo, peers, req, etag):
     """Fetch a complete framed fetch-pack response from a peer instead of
     walking locally: POST the byte-identical request body; accept the

@@ -1,0 +1,139 @@
+"""Commit-addressed query engine — "what is", not "what changed"
+(ISSUE 16 tentpole; docs/QUERY.md).
+
+Every other engine in the repo answers a delta question (diff, CDC,
+tiles-of-a-commit); this package answers value questions over one commit,
+as a staged filter-then-refine pipeline over the same columnar state the
+diff engine reads (3DPipe's pipelined GPU join, arxiv 2604.19982, and the
+multi-core evaluation playbook of arxiv 1403.0802):
+
+* :mod:`kart_tpu.query.scan` — predicate-pushdown scans: a ``--where`` /
+  ``--bbox`` predicate compiles into a per-block prune pass over the PR 1
+  sidecar aggregates (all-out blocks never page-fault, all-in blocks skip
+  the row filter), then a vectorized row filter over the KCOL columns;
+  blob-backed attribute predicates stream through the compiled per-legend
+  row plan in ordered batches; ``count`` / ``count by`` / bbox-union
+  aggregates never materialise rows.
+* :mod:`kart_tpu.query.join` — the headline kernel: a spatial join between
+  two datasets or two *commits* of one dataset (the time-travel join), as
+  staged broadcast-probe over the :class:`~kart_tpu.diff.backend.DiffBackend`
+  join seam — ``host_native`` numpy and the features-mesh ``shard_map``
+  kernel are bit-identical by construction (comparison-only predicate).
+* :mod:`kart_tpu.query.cache` — the commit-addressed single-flight result
+  cache behind ``GET /api/v1/query`` (strong ETag == cache key), which is
+  what makes scatter partials peer-cacheable across the PR 12 fleet.
+
+Because a query is (commit oid, normalized predicate) → deterministic
+bytes, results are immutable: cacheable forever, scatterable by probe
+block range, and a retried query is byte-identical.
+"""
+
+import threading
+
+
+class QueryError(Exception):
+    """Malformed query: unknown column, type-mismatched literal, grammar
+    error, missing envelope/sidecar support. Maps to exit 2 in the CLI and
+    HTTP 400 on the serving lane."""
+
+
+#: process-wide query telemetry: the ``query`` block of
+#: ``/api/v1/stats?format=json`` and ``kart top``. Plain counters mirrored
+#: next to the ``tm`` metrics so the stats document doesn't scan the
+#: metric registry (same pattern as FleetNode's bookkeeping).
+STATS = {
+    "scans": 0,
+    "joins": 0,
+    "blocks_pruned": 0,
+    "rows_scanned": 0,
+    "pairs_emitted": 0,
+    "scatter_requests": 0,
+    "scatter_parts": 0,
+    "cache_hits": 0,
+    "cache_misses": 0,
+}
+_STATS_LOCK = threading.Lock()
+
+
+def _bump(name, n=1):
+    with _STATS_LOCK:
+        STATS[name] += int(n)
+
+
+def status_dict():
+    """The ``query`` block of the stats document (transport/http.py,
+    transport/stdio.py); what ``kart top`` renders."""
+    with _STATS_LOCK:
+        return dict(STATS)
+
+
+def resolve_query_commit(repo, refish):
+    """refish -> full commit oid, commit-pinning the query (the cache key /
+    ETag recipe hashes the oid, never the refish — a moved branch is a new
+    key, same rule as the tile lane's ``resolve_tile_commit``)."""
+    try:
+        oid, _ = repo.resolve_refish(refish)
+    except Exception as e:
+        raise QueryError(f"cannot resolve {refish!r}: {e}") from None
+    if oid is None:
+        raise QueryError(f"cannot resolve {refish!r} to a commit")
+    return str(oid)
+
+
+def load_query_dataset(repo, commit_oid, ds_path):
+    """(commit, dataset path) -> Dataset3, or a clean QueryError."""
+    try:
+        datasets = repo.datasets(commit_oid)
+        ds = datasets[ds_path]
+    except KeyError:
+        raise QueryError(
+            f"no dataset {ds_path!r} at {commit_oid[:12]}"
+        ) from None
+    except Exception as e:
+        raise QueryError(f"cannot load {ds_path!r}: {e}") from None
+    return ds
+
+
+def run_query(repo, refish, ds_path, *, where=None, bbox=None,
+              intersects=None, output="count", count_by=None, page=None,
+              page_size=None, part=None, allow_device=True):
+    """One entry point behind every surface (CLI, HTTP, scatter partials):
+    route to the scan or the spatial join and return the JSON-ready result
+    document. ``intersects`` is ``(refish2, ds_path2)`` — when set the
+    query is the spatial join and ``where``/``count_by`` must be None."""
+    if intersects is not None:
+        if where or count_by:
+            raise QueryError("--intersects cannot be combined with --where")
+        from kart_tpu.query.join import run_join
+
+        return run_join(
+            repo,
+            refish,
+            ds_path,
+            intersects[0],
+            intersects[1],
+            bbox=bbox,
+            output=output,
+            page=page,
+            page_size=page_size,
+            part=part,
+            allow_device=allow_device,
+        )
+    if part is not None:
+        raise QueryError("block-range partials apply to join queries only")
+    from kart_tpu.query.scan import run_scan
+
+    return run_scan(
+        repo,
+        refish,
+        ds_path,
+        where=where,
+        bbox=bbox,
+        output=output,
+        count_by=count_by,
+        page=page,
+        page_size=page_size,
+    )
+
+
+__all__ = ["QueryError", "STATS", "run_query", "status_dict"]

@@ -1,0 +1,278 @@
+"""Device-parallel cross-commit spatial join (ISSUE 16 tentpole, part 2;
+docs/QUERY.md §4).
+
+A join between two datasets — or two *commits* of one dataset (the
+time-travel join no non-versioned geo system can express) — runs as staged
+broadcast-probe over envelope columns, never touching a feature blob:
+
+1. **build staging** — the ``--intersects`` side's envelopes are tiled
+   into 4096-row device-resident chunks; each tile's conservative union
+   bbox comes from the same aggregate builder the sidecar uses
+   (wrap/NaN members widen, so a tile bbox is always a superset of its
+   members);
+2. **probe pruning** — per tile, the probe side's sidecar block aggregates
+   are classified against the tile bbox: all-out probe blocks are skipped
+   without faulting a single envelope page (a disjoint union bbox proves
+   no member pair can overlap);
+3. **broadcast-probe** — surviving probe row ranges stream as fixed-shape
+   record batches (``KART_QUERY_BATCH_ROWS``, via the PR 6 ``device_batch``
+   packer) through the :func:`~kart_tpu.diff.backend.join_bbox_counts`
+   backend seam: bbox-overlap matrix per (build-tile x probe-batch),
+   reduced on-device to per-probe match counts plus a psum'd pair total.
+   ``host_native`` and ``sharded_jax`` are bit-identical (comparison-only
+   f32 predicate; NaN / NULL-geometry rows never match).
+
+``part=(lo, hi)`` computes probe rows ``[lo:hi)`` only — the fleet-scatter
+unit: partials are commit-addressed, so peers cache and serve them like
+any other immutable payload, and the merge is plain ordered addition.
+
+The ``query.join`` fault point fires per build tile; an armed join dies
+before anything is published and the retried join is byte-identical.
+"""
+
+import numpy as np
+
+from kart_tpu import faults
+from kart_tpu import telemetry as tm
+from kart_tpu.query import (
+    QueryError,
+    _bump,
+    load_query_dataset,
+    resolve_query_commit,
+)
+from kart_tpu.query.scan import (
+    _load_block,
+    _pks_for_index,
+    batch_rows,
+    page_size_default,
+    parse_bbox,
+    MAX_PAGE_SIZE,
+)
+
+#: build-side tile rows — aligned with the sidecar aggregate granularity so
+#: one probe-block classification covers exactly one tile test
+TILE_ROWS = 4096
+
+
+def _envelopes_or_raise(block, what):
+    if block.envelopes is None:
+        raise QueryError(
+            f"--intersects needs envelope columns on the {what} side"
+            " (no geometry in the sidecar)"
+        )
+    return block.envelopes
+
+
+def _probe_aggregates(block):
+    """(agg (nb,4) f32, flags (nb,) u8, block_rows) for the probe side —
+    the sidecar's mmap'd aggregates when present (pruning faults no
+    envelope page), else computed once from the envelope column."""
+    if block.env_blocks is not None:
+        return block.env_blocks
+    from kart_tpu.diff.sidecar import AGG_BLOCK_ROWS, _block_aggregates
+
+    agg, flags = _block_aggregates(
+        np.asarray(block.envelopes, dtype=np.float32), AGG_BLOCK_ROWS
+    )
+    return agg, flags, AGG_BLOCK_ROWS
+
+
+def _alive_ranges(cls, block_rows, lo, hi):
+    """Surviving (non-all-out) probe blocks clipped to ``[lo, hi)`` ->
+    [(row_lo, row_hi)] with consecutive alive blocks merged into runs."""
+    from kart_tpu.ops.bbox import BLOCK_ALL_OUT
+
+    b0 = lo // block_rows
+    b1 = -(-hi // block_rows)
+    ranges = []
+    run_start = None
+    for b in range(b0, b1):
+        alive = cls[b] != BLOCK_ALL_OUT
+        if alive and run_start is None:
+            run_start = b
+        elif not alive and run_start is not None:
+            ranges.append((run_start, b))
+            run_start = None
+    if run_start is not None:
+        ranges.append((run_start, b1))
+    return [
+        (max(rb0 * block_rows, lo), min(rb1 * block_rows, hi))
+        for rb0, rb1 in ranges
+    ]
+
+
+def join_counts_for_range(build_env, probe_block, lo, hi, *,
+                          allow_device=True, route_rows=None, stats=None,
+                          join_hook=None):
+    """Per-probe match counts for probe rows ``[lo:hi)`` against the whole
+    build side: -> (counts int64 (hi-lo,), pair total). The staged loop —
+    tile, prune, stream batches through the backend seam."""
+    from kart_tpu.diff.backend import join_bbox_counts
+    from kart_tpu.diff.sidecar import _block_aggregates
+    from kart_tpu.ops.bbox import BLOCK_ALL_OUT, classify_env_blocks_np
+
+    probe_env = _envelopes_or_raise(probe_block, "probe")
+    counts = np.zeros(max(hi - lo, 0), dtype=np.int64)
+    total = 0
+    if stats is None:
+        stats = {}
+    stats.setdefault("tiles", 0)
+    stats.setdefault("blocks_pruned", 0)
+    stats.setdefault("block_tests", 0)
+    stats.setdefault("batches", 0)
+    if not len(build_env) or hi <= lo:
+        return counts, total
+
+    build_env = np.ascontiguousarray(build_env, dtype=np.float32)
+    tile_agg, _tile_flags = _block_aggregates(build_env, TILE_ROWS)
+    probe_agg, probe_flags, block_rows = _probe_aggregates(probe_block)
+    batch = batch_rows()
+    if route_rows is None:
+        route_rows = hi - lo
+
+    n_tiles = len(tile_agg)
+    stats["tiles"] += n_tiles
+    for t in range(n_tiles):
+        if join_hook is not None:
+            join_hook()
+        tile_env = build_env[t * TILE_ROWS : (t + 1) * TILE_ROWS]
+        tile_query = tile_agg[t].astype(np.float64)
+        cls = classify_env_blocks_np(probe_agg, probe_flags, tile_query)
+        b0 = lo // block_rows
+        b1 = -(-hi // block_rows)
+        stats["block_tests"] += b1 - b0
+        stats["blocks_pruned"] += int(
+            np.count_nonzero(cls[b0:b1] == BLOCK_ALL_OUT)
+        )
+        for r_lo, r_hi in _alive_ranges(cls, block_rows, lo, hi):
+            for c_lo in range(r_lo, r_hi, batch):
+                c_hi = min(c_lo + batch, r_hi)
+                c, c_total = join_bbox_counts(
+                    tile_env,
+                    probe_env[c_lo:c_hi],
+                    allow_device=allow_device,
+                    route_rows=route_rows,
+                )
+                counts[c_lo - lo : c_hi - lo] += c
+                total += c_total
+                stats["batches"] += 1
+    return counts, total
+
+
+def run_join(repo, refish, ds_path, refish2, ds_path2, *, bbox=None,
+             output="count", page=None, page_size=None, part=None,
+             allow_device=True):
+    """The spatial join behind ``kart query --intersects`` and the
+    ``/api/v1/query`` join lane: -> JSON-ready result document. The probe
+    side is ``(refish, ds_path)`` (its rows are what the join reports);
+    the build side is the ``--intersects`` operand — put the smaller
+    dataset there."""
+    if output not in ("count", "json"):
+        raise QueryError(f"unknown join output {output!r} (count, json)")
+    commit1 = resolve_query_commit(repo, refish)
+    commit2 = resolve_query_commit(repo, refish2)
+    probe_ds = load_query_dataset(repo, commit1, ds_path)
+    build_ds = load_query_dataset(repo, commit2, ds_path2)
+    probe_block = _load_block(repo, probe_ds, ds_path)
+    build_block = _load_block(repo, build_ds, ds_path2)
+    _envelopes_or_raise(probe_block, "probe")
+    build_env = np.asarray(
+        _envelopes_or_raise(build_block, "build"), dtype=np.float32
+    )
+    query = parse_bbox(bbox) if bbox is not None else None
+
+    n_probe = probe_block.count
+    lo, hi = 0, n_probe
+    if part is not None:
+        lo, hi = int(part[0]), int(part[1])
+        if not (0 <= lo <= hi <= n_probe):
+            raise QueryError(
+                f"part {lo}:{hi} outside probe rows 0:{n_probe}"
+            )
+
+    join_hook = faults.hook("query.join")
+    stats = {
+        "build_rows": int(build_block.count),
+        "probe_rows": int(n_probe),
+        "tiles": 0,
+        "blocks_pruned": 0,
+        "block_tests": 0,
+        "batches": 0,
+    }
+    with tm.span("query.join", build=int(build_block.count), probe=int(n_probe)):
+        if join_hook is not None:
+            join_hook()
+        probe_mask = None
+        if query is not None:
+            from kart_tpu.diff.backend import select_backend
+
+            # --bbox restricts BOTH sides: the build side by gather, the
+            # probe side by zeroing excluded rows' counts after the fact
+            # (exactly brute-force-over-restricted-sets semantics)
+            b_hits = select_backend(build_block.count).envelope_hits(
+                build_block, query
+            )
+            build_env = np.ascontiguousarray(build_env[np.flatnonzero(b_hits)])
+            probe_mask = select_backend(probe_block.count).envelope_hits(
+                probe_block, query
+            )[lo:hi]
+        counts, total = join_counts_for_range(
+            build_env,
+            probe_block,
+            lo,
+            hi,
+            allow_device=allow_device,
+            route_rows=n_probe,
+            stats=stats,
+            join_hook=join_hook,
+        )
+        if probe_mask is not None:
+            counts[~np.asarray(probe_mask)] = 0
+            total = int(counts.sum())
+        if total != int(counts.sum()):  # psum total vs per-row reassembly
+            raise RuntimeError(
+                f"join pair total mismatch: psum {total} != {int(counts.sum())}"
+            )
+
+        result = {
+            "kind": "join",
+            "commit": commit1,
+            "dataset": ds_path,
+            "commit2": commit2,
+            "dataset2": ds_path2,
+            "bbox": [float(v) for v in query] if query is not None else None,
+            "part": [lo, hi] if part is not None else None,
+            "pairs": int(total),
+            "count": int(np.count_nonzero(counts)),
+            "stats": stats,
+        }
+        if output == "json":
+            ps = min(
+                int(page_size) if page_size else page_size_default(),
+                MAX_PAGE_SIZE,
+            )
+            ps = max(ps, 1)
+            pg = max(int(page or 0), 0)
+            nz = np.flatnonzero(counts)
+            sel = nz[pg * ps : (pg + 1) * ps]
+            matches = []
+            for i in sel.tolist():
+                pks = _pks_for_index(probe_block, probe_ds, lo + i)
+                matches.append(
+                    {
+                        "pk": pks[0] if len(pks) == 1 else list(pks),
+                        "matches": int(counts[i]),
+                    }
+                )
+            result["matches"] = matches
+            result["page"] = pg
+            result["page_size"] = ps
+            result["next_page"] = pg + 1 if (pg + 1) * ps < len(nz) else None
+
+    tm.incr("query.joins")
+    tm.incr("query.pairs_emitted", int(total))
+    tm.incr("query.blocks_pruned", stats["blocks_pruned"])
+    _bump("joins")
+    _bump("pairs_emitted", int(total))
+    _bump("blocks_pruned", stats["blocks_pruned"])
+    return result

@@ -1,0 +1,560 @@
+"""Predicate-pushdown scans over one commit (ISSUE 16 tentpole, part 1;
+docs/QUERY.md §2-3).
+
+A ``--where`` / ``--bbox`` predicate runs as three stages, each strictly
+cheaper than the next and each shrinking the candidate set before the next
+one pays anything:
+
+1. **block prune** — the bbox filter routes through the diff engine's
+   backend seam (``select_backend(n).envelope_hits``): the PR 1 sidecar
+   block aggregates classify whole 4096-row blocks all-out / all-in /
+   boundary, so all-out blocks' envelope pages are never faulted in and
+   all-in blocks skip the row compare (KART_BLOCK_PRUNE=0 forces the full
+   scan — bit-identical either way, same guarantee the diff prefilter
+   pins);
+2. **columnar row filter** — predicates on the int primary key evaluate
+   vectorized over the KCOL key column (mmap'd, no blob touched);
+3. **blob-backed row filter** — remaining attribute predicates stream the
+   candidate rows' feature blobs in ordered batches
+   (``KART_QUERY_BATCH_ROWS``) through the batch pack inflate and the
+   compiled per-legend row plan — only survivors of stages 1-2 are ever
+   decoded.
+
+Aggregate forms (``count``, ``count by <col>``, bbox-union) complete
+without materialising result rows; ``-o json`` decodes only the requested
+page.
+
+The ``query.scan`` fault point fires before any stage publishes anything:
+an armed scan dies with clean caches and the retry is byte-identical.
+"""
+
+import re
+
+import numpy as np
+
+from kart_tpu import faults
+from kart_tpu import telemetry as tm
+from kart_tpu.transport.retry import _env_int
+from kart_tpu.query import (
+    QueryError,
+    _bump,
+    load_query_dataset,
+    resolve_query_commit,
+)
+
+#: rows of candidate feature blobs per ordered decode batch (stage 3); also
+#: the probe-side batch granularity of the spatial join
+DEFAULT_BATCH_ROWS = 65536
+
+#: default (and soft cap reference) for the JSON result page
+DEFAULT_PAGE_SIZE = 1000
+
+#: hard ceiling a client-supplied page_size is clamped to
+MAX_PAGE_SIZE = 100_000
+
+
+def batch_rows():
+    return max(_env_int("KART_QUERY_BATCH_ROWS", DEFAULT_BATCH_ROWS), 1)
+
+
+def page_size_default():
+    return max(_env_int("KART_QUERY_PAGE_SIZE", DEFAULT_PAGE_SIZE), 1)
+
+
+# --- predicate grammar -------------------------------------------------------
+
+_TOKEN_RE = re.compile(
+    r"""\s*(?:
+      (?P<op><=|>=|<>|!=|==|=|<|>)
+    | (?P<lpar>\() | (?P<rpar>\)) | (?P<comma>,)
+    | (?P<str>'(?:[^']|'')*')
+    | (?P<num>[+-]?(?:\d+\.\d*|\.\d+|\d+)(?:[eE][+-]?\d+)?)
+    | (?P<word>[A-Za-z_][A-Za-z0-9_]*)
+    )""",
+    re.VERBOSE,
+)
+
+_OP_ALIASES = {"==": "=", "<>": "!="}
+
+
+def _tokenize(text):
+    tokens, pos = [], 0
+    while pos < len(text):
+        m = _TOKEN_RE.match(text, pos)
+        if m is None or m.end() == m.start():
+            rest = text[pos:].strip()
+            if not rest:
+                break
+            raise QueryError(f"cannot parse --where near {rest[:30]!r}")
+        pos = m.end()
+        kind = m.lastgroup
+        tok = m.group(kind)
+        if kind == "str":
+            tok = tok[1:-1].replace("''", "'")
+        elif kind == "num":
+            tok = float(tok) if re.search(r"[.eE]", m.group(kind)) else int(tok)
+        elif kind == "op":
+            tok = _OP_ALIASES.get(tok, tok)
+        tokens.append((kind, tok))
+    return tokens
+
+
+class Predicate:
+    """One compiled clause of an AND-joined ``--where``: a typed comparison,
+    an IN set, or an IS [NOT] NULL test on a schema column."""
+
+    __slots__ = ("col", "kind", "op", "value", "values", "on_pk")
+
+    def __init__(self, col, kind, op=None, value=None, values=None,
+                 on_pk=False):
+        self.col = col
+        self.kind = kind  # "cmp" | "in" | "isnull" | "notnull"
+        self.op = op
+        self.value = value
+        self.values = values
+        self.on_pk = on_pk  # evaluates vectorized over the KCOL key column
+
+    def matches(self, v):
+        if self.kind == "isnull":
+            return v is None
+        if self.kind == "notnull":
+            return v is not None
+        if v is None:
+            return False  # SQL-ish: NULL compares to nothing
+        if self.kind == "in":
+            return v in self.values
+        op = self.op
+        if op == "=":
+            return v == self.value
+        if op == "!=":
+            return v != self.value
+        if op == "<":
+            return v < self.value
+        if op == "<=":
+            return v <= self.value
+        if op == ">":
+            return v > self.value
+        return v >= self.value
+
+    def matches_keys(self, keys):
+        """Vectorized twin of :meth:`matches` over the int64 pk column."""
+        if self.kind == "isnull":
+            return np.zeros(len(keys), dtype=bool)
+        if self.kind == "notnull":
+            return np.ones(len(keys), dtype=bool)
+        if self.kind == "in":
+            return np.isin(keys, np.asarray(sorted(self.values), dtype=np.int64))
+        ops = {
+            "=": np.equal, "!=": np.not_equal,
+            "<": np.less, "<=": np.less_equal,
+            ">": np.greater, ">=": np.greater_equal,
+        }
+        return ops[self.op](keys, np.int64(self.value))
+
+
+def _typed_literal(col, tok_kind, tok, *, where):
+    dt = col.data_type
+    if dt in ("integer",):
+        if tok_kind != "num" or isinstance(tok, float):
+            raise QueryError(
+                f"--where: column {col.name!r} is integer, got {tok!r}"
+            )
+        return int(tok)
+    if dt in ("float", "numeric"):
+        if tok_kind != "num":
+            raise QueryError(
+                f"--where: column {col.name!r} is {dt}, got {tok!r}"
+            )
+        return float(tok)
+    if dt == "boolean":
+        if tok_kind == "word" and str(tok).lower() in ("true", "false"):
+            return str(tok).lower() == "true"
+        raise QueryError(
+            f"--where: column {col.name!r} is boolean, use true/false"
+        )
+    if dt == "geometry":
+        raise QueryError(
+            f"--where: column {col.name!r} is geometry — use --bbox"
+        )
+    if tok_kind != "str":
+        raise QueryError(
+            f"--where: column {col.name!r} ({dt}) needs a 'quoted' literal,"
+            f" got {tok!r}"
+        )
+    return str(tok)
+
+
+def compile_where(where, schema):
+    """``--where`` text + dataset schema -> [Predicate] (AND-joined).
+    Raises QueryError on grammar errors, unknown columns and
+    type-mismatched literals."""
+    if not where or not where.strip():
+        return []
+    cols = {c.name: c for c in schema.columns}
+    pk_names = {
+        c.name
+        for c in schema.pk_columns
+        if c.data_type == "integer" and len(schema.pk_columns) == 1
+    }
+    toks = _tokenize(where)
+    preds, i = [], 0
+
+    def _need(kind, what):
+        nonlocal i
+        if i >= len(toks) or toks[i][0] != kind:
+            got = toks[i][1] if i < len(toks) else "end of input"
+            raise QueryError(f"--where: expected {what}, got {got!r}")
+        tok = toks[i][1]
+        i += 1
+        return tok
+
+    while i < len(toks):
+        name = _need("word", "a column name")
+        col = cols.get(name)
+        if col is None:
+            raise QueryError(
+                f"--where: no column {name!r} (have: {', '.join(cols)})"
+            )
+        if i < len(toks) and toks[i][0] == "word" and str(toks[i][1]).upper() in (
+            "IS",
+            "IN",
+        ):
+            kw = str(toks[i][1]).upper()
+            i += 1
+            if kw == "IS":
+                negate = False
+                if i < len(toks) and str(toks[i][1]).upper() == "NOT":
+                    negate, i = True, i + 1
+                if i >= len(toks) or str(toks[i][1]).upper() != "NULL":
+                    raise QueryError("--where: expected NULL after IS")
+                i += 1
+                preds.append(
+                    Predicate(
+                        name,
+                        "notnull" if negate else "isnull",
+                        on_pk=name in pk_names,
+                    )
+                )
+            else:  # IN ( lit, lit, ... )
+                _need("lpar", "'(' after IN")
+                values = set()
+                while True:
+                    if i >= len(toks) or toks[i][0] not in ("num", "str", "word"):
+                        raise QueryError("--where: expected a literal in IN (...)")
+                    values.add(
+                        _typed_literal(col, toks[i][0], toks[i][1], where=where)
+                    )
+                    i += 1
+                    if i < len(toks) and toks[i][0] == "comma":
+                        i += 1
+                        continue
+                    break
+                _need("rpar", "')' closing IN")
+                preds.append(
+                    Predicate(name, "in", values=values, on_pk=name in pk_names)
+                )
+        else:
+            op = _need("op", "a comparison operator")
+            if i >= len(toks) or toks[i][0] not in ("num", "str", "word"):
+                raise QueryError(f"--where: expected a literal after {op}")
+            value = _typed_literal(col, toks[i][0], toks[i][1], where=where)
+            i += 1
+            preds.append(
+                Predicate(name, "cmp", op=op, value=value, on_pk=name in pk_names)
+            )
+        if i < len(toks):
+            kw = toks[i]
+            if kw[0] != "word" or str(kw[1]).upper() != "AND":
+                raise QueryError(
+                    f"--where: expected AND between clauses, got {kw[1]!r}"
+                )
+            i += 1
+            if i >= len(toks):
+                raise QueryError("--where: dangling AND")
+    return preds
+
+
+def parse_bbox(text):
+    """``W,S,E,N`` -> (4,) f64. E < W is a legal anti-meridian wrap."""
+    try:
+        parts = [float(p) for p in str(text).split(",")]
+    except ValueError:
+        raise QueryError(f"--bbox: expected W,S,E,N numbers, got {text!r}") from None
+    if len(parts) != 4:
+        raise QueryError(f"--bbox: expected 4 values, got {len(parts)}")
+    w, s, e, n = parts
+    if s > n:
+        raise QueryError(f"--bbox: S ({s}) > N ({n})")
+    if not all(np.isfinite(parts)):
+        raise QueryError("--bbox: values must be finite")
+    return np.asarray(parts, dtype=np.float64)
+
+
+# --- the scan ----------------------------------------------------------------
+
+def _load_block(repo, ds, ds_path):
+    from kart_tpu.diff import sidecar
+
+    block = sidecar.ensure_block(repo, ds, pad=False)
+    if block is None:
+        raise QueryError(f"cannot build a columnar index for {ds_path!r}")
+    if (
+        block.envelopes is None
+        and ds.geom_column_name is not None
+        and block.count
+    ):
+        block = _with_fallback_envelopes(ds, block)
+    return block
+
+
+def _with_fallback_envelopes(ds, block):
+    """Envelope columns for a dataset whose sidecar predates envelope
+    capture: one O(N) pass over the feature blobs in block row order, same
+    policy as the tile lane's fallback (NULL/undecodable geometry gets the
+    full world — fail open)."""
+    from kart_tpu.diff.sidecar import (
+        AGG_BLOCK_ROWS,
+        _block_aggregates,
+        _feature_envelope_wsen,
+    )
+    from kart_tpu.ops.blocks import FeatureBlock, unpack_oid_bytes
+
+    odb = ds._feature_odb()
+    geom_col = ds.geom_column_name
+    n = block.count
+    envs = np.empty((n, 4), dtype=np.float32)
+    rows = batch_rows()
+    with tm.span("query.envelope_fallback", rows=int(n)):
+        for lo in range(0, n, rows):
+            hi = min(lo + rows, n)
+            shas = unpack_oid_bytes(np.asarray(block.oids[lo:hi]))
+            datas = odb.read_blobs_data_ordered(shas)
+            for i, data in enumerate(datas):
+                if data is None:
+                    raise QueryError(
+                        "feature blob missing (promised/partial clone) —"
+                        " cannot derive envelopes for a spatial predicate"
+                    )
+                pks = _pks_for_index(block, ds, lo + i)
+                feature = ds.get_feature(pks, data=data)
+                envs[lo + i] = _feature_envelope_wsen(feature, geom_col)
+    agg, flags = _block_aggregates(envs, AGG_BLOCK_ROWS)
+    return FeatureBlock(
+        block.keys,
+        block.oids,
+        block.paths,
+        n,
+        envelopes=envs,
+        env_blocks=(agg, flags, AGG_BLOCK_ROWS),
+    )
+
+
+def _pks_for_index(block, ds, i):
+    from kart_tpu.diff.sidecar import IntKeyPaths
+
+    if block.paths is None or isinstance(block.paths, IntKeyPaths):
+        return (int(block.keys[i]),)
+    return ds.decode_path_to_pks(block.path_for_index(i))
+
+
+def _prune_stats(block, query, stats):
+    """Block-prune accounting for the stats document / telemetry — only
+    when the pruned scan actually ran (env_blocks present and
+    KART_BLOCK_PRUNE not forced off)."""
+    import os
+
+    from kart_tpu.ops.bbox import (
+        BLOCK_ALL_IN,
+        BLOCK_ALL_OUT,
+        classify_env_blocks_np,
+    )
+
+    if block.env_blocks is None or os.environ.get("KART_BLOCK_PRUNE", "1") == "0":
+        return
+    agg, flags, _block_rows = block.env_blocks
+    cls = classify_env_blocks_np(agg, flags, query)
+    stats["blocks"] = int(len(cls))
+    stats["blocks_pruned"] = int(np.count_nonzero(cls == BLOCK_ALL_OUT))
+    stats["blocks_all_in"] = int(np.count_nonzero(cls == BLOCK_ALL_IN))
+
+
+def _bbox_indices(block, query, stats):
+    from kart_tpu.diff.backend import select_backend
+
+    if block.envelopes is None:
+        raise QueryError(
+            "--bbox needs an envelope column (no geometry in this dataset's"
+            " sidecar)"
+        )
+    hits = select_backend(block.count).envelope_hits(block, query)
+    _prune_stats(block, query, stats)
+    return np.flatnonzero(hits).astype(np.int64)
+
+
+def _feature_values(ds, block, idx, scan_hook, stats):
+    """Ordered batches of (row index, JSON-ready feature dict) for the
+    candidate rows — the stage-3 blob route. Raises QueryError on a
+    promised/missing blob (a partial clone can't answer value predicates)."""
+    from kart_tpu.ops.blocks import unpack_oid_bytes
+
+    odb = ds._feature_odb()
+    rows = batch_rows()
+    for lo in range(0, len(idx), rows):
+        if scan_hook is not None:
+            scan_hook()
+        sel = idx[lo : lo + rows]
+        shas = unpack_oid_bytes(np.asarray(block.oids[sel]))
+        datas = odb.read_blobs_data_ordered(shas)
+        out = []
+        for j, data in zip(sel.tolist(), datas):
+            if data is None:
+                raise QueryError(
+                    "feature blob missing (promised/partial clone) — value"
+                    " predicates need local blobs"
+                )
+            pks = _pks_for_index(block, ds, j)
+            out.append((j, ds.feature_json_from_data(pks, data)))
+        stats["rows_decoded"] += len(out)
+        yield out
+
+
+def _filter_rows(ds, block, idx, preds, scan_hook, stats):
+    """Stages 2+3: vectorized pk predicates, then the blob-backed rest."""
+    pk_preds = [p for p in preds if p.on_pk]
+    blob_preds = [p for p in preds if not p.on_pk]
+    if pk_preds and len(idx):
+        keys = np.asarray(block.keys[idx])
+        mask = np.ones(len(idx), dtype=bool)
+        for p in pk_preds:
+            mask &= p.matches_keys(keys)
+        idx = idx[mask]
+    if blob_preds and len(idx):
+        keep = []
+        for batch in _feature_values(ds, block, idx, scan_hook, stats):
+            for j, feature in batch:
+                if all(p.matches(feature.get(p.col)) for p in blob_preds):
+                    keep.append(j)
+        idx = np.asarray(keep, dtype=np.int64)
+    return idx
+
+
+def _bbox_union(block, idx):
+    """Union wsen of the selected rows' envelopes: wrapped members (e < w)
+    widen the union to full longitude (a correct superset — same policy as
+    the sidecar aggregates); NaN (NULL-geometry) members are skipped."""
+    if block.envelopes is None:
+        raise QueryError("bbox aggregate needs an envelope column")
+    env = np.asarray(block.envelopes[idx], dtype=np.float64)
+    finite = np.isfinite(env).all(axis=1)
+    env = env[finite]
+    if not len(env):
+        return None
+    w = float(np.min(env[:, 0]))
+    s = float(np.min(env[:, 1]))
+    e = float(np.max(env[:, 2]))
+    n = float(np.max(env[:, 3]))
+    if np.any(env[:, 2] < env[:, 0]):  # any wrapped member: full longitude
+        w, e = -180.0, 180.0
+    return [w, s, e, n]
+
+
+def _count_by(ds, block, idx, col_name, scan_hook, stats):
+    """``count by <col>`` -> {rendered value: count}, deterministic order
+    (sorted by rendered key). The single-int-pk column groups vectorized
+    over the key column; anything else rides the blob route."""
+    cols = {c.name: c for c in ds.schema.columns}
+    col = cols.get(col_name)
+    if col is None:
+        raise QueryError(f"count by: no column {col_name!r}")
+    if col.data_type == "geometry":
+        raise QueryError("count by: grouping on geometry is not supported")
+    pk_cols = ds.schema.pk_columns
+    if (
+        len(pk_cols) == 1
+        and pk_cols[0].name == col_name
+        and col.data_type == "integer"
+    ):
+        values, counts = np.unique(np.asarray(block.keys[idx]), return_counts=True)
+        groups = {str(int(v)): int(c) for v, c in zip(values, counts)}
+    else:
+        groups = {}
+        for batch in _feature_values(ds, block, idx, scan_hook, stats):
+            for _j, feature in batch:
+                v = feature.get(col_name)
+                key = "null" if v is None else str(v)
+                groups[key] = groups.get(key, 0) + 1
+    return dict(sorted(groups.items()))
+
+
+def run_scan(repo, refish, ds_path, *, where=None, bbox=None, output="count",
+             count_by=None, page=None, page_size=None):
+    """The pushdown scan behind ``kart query`` and ``GET /api/v1/query``:
+    -> JSON-ready result document (deterministic for a given commit +
+    normalized predicate — the property the ETag/cache lane relies on)."""
+    if output not in ("count", "json", "bbox"):
+        raise QueryError(f"unknown output {output!r} (count, json, bbox)")
+    commit_oid = resolve_query_commit(repo, refish)
+    ds = load_query_dataset(repo, commit_oid, ds_path)
+    preds = compile_where(where, ds.schema)
+    query = parse_bbox(bbox) if bbox is not None else None
+    block = _load_block(repo, ds, ds_path)
+    n = block.count
+
+    scan_hook = faults.hook("query.scan")
+    stats = {
+        "rows": int(n),
+        "blocks": 0,
+        "blocks_pruned": 0,
+        "blocks_all_in": 0,
+        "rows_scanned": 0,
+        "rows_decoded": 0,
+    }
+    with tm.span("query.scan", rows=int(n)):
+        if scan_hook is not None:
+            scan_hook()
+        if query is not None:
+            idx = _bbox_indices(block, query, stats)
+        else:
+            idx = np.arange(n, dtype=np.int64)
+        stats["rows_scanned"] = int(len(idx))
+        if preds:
+            idx = _filter_rows(ds, block, idx, preds, scan_hook, stats)
+
+        result = {
+            "kind": "scan",
+            "commit": commit_oid,
+            "dataset": ds_path,
+            "where": where or None,
+            "bbox": [float(v) for v in query] if query is not None else None,
+            "count": int(len(idx)),
+            "stats": stats,
+        }
+        if count_by is not None:
+            result["groups"] = _count_by(
+                ds, block, idx, count_by, scan_hook, stats
+            )
+        elif output == "bbox":
+            result["bbox_union"] = _bbox_union(block, idx)
+        elif output == "json":
+            ps = min(
+                int(page_size) if page_size else page_size_default(),
+                MAX_PAGE_SIZE,
+            )
+            ps = max(ps, 1)
+            pg = max(int(page or 0), 0)
+            sel = idx[pg * ps : (pg + 1) * ps]
+            features = []
+            for batch in _feature_values(ds, block, sel, scan_hook, stats):
+                features.extend(f for _j, f in batch)
+            result["features"] = features
+            result["page"] = pg
+            result["page_size"] = ps
+            result["next_page"] = pg + 1 if (pg + 1) * ps < len(idx) else None
+
+    tm.incr("query.scans")
+    tm.incr("query.blocks_pruned", stats["blocks_pruned"])
+    tm.incr("query.rows_scanned", stats["rows_scanned"])
+    _bump("scans")
+    _bump("blocks_pruned", stats["blocks_pruned"])
+    _bump("rows_scanned", stats["rows_scanned"])
+    return result

@@ -62,6 +62,7 @@ SUBSYSTEMS = frozenset(
         "tiles",     # tile read-serving (pruning, cache, encode, export)
         "fleet",     # replication sync, write proxying, peer cache tier
         "events",    # live-update CDC, event log, warm-then-announce
+        "query",     # predicate-pushdown scans and spatial joins
         "importer",  # bulk import phases
         "runtime",   # backend probe, watchdogs
         "wc",        # working copies

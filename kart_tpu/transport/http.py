@@ -430,6 +430,7 @@ class KartRequestHandler(BaseHTTPRequestHandler):
         f"{API}/stats": "stats",
         f"{API}/refs": "ls-refs",
         f"{API}/events": "events",
+        f"{API}/query": "query",
         f"{API}/fetch-pack": "fetch-pack",
         f"{API}/fetch-blobs": "fetch-blobs",
         f"{API}/receive-pack": "receive-pack",
@@ -510,6 +511,8 @@ class KartRequestHandler(BaseHTTPRequestHandler):
                     return self._handle_refs()
                 if path == f"{API}/events":
                     return self._handle_events()
+                if path == f"{API}/query":
+                    return self._handle_query()
                 if path.startswith(f"{API}/tiles/"):
                     return self._handle_tile(path)
                 self._json(404, {"error": f"No such endpoint: {self.path}"})
@@ -924,6 +927,218 @@ class KartRequestHandler(BaseHTTPRequestHandler):
         self.end_headers()
         self.wfile.write(payload)
 
+    def _handle_query(self):
+        """``GET /api/v1/query``: the serving face of the query engine
+        (docs/QUERY.md §5) — predicate-pushdown scans and spatial joins over
+        one commit. Results are commit-addressed (the strong ETag derives
+        from the resolved oid(s) + the normalized request), so a matching
+        validator can never be stale, responses cache forever, and join
+        ``count`` queries scatter their probe side across fleet peers as
+        block-aligned ``part=lo:hi`` partials (docs/QUERY.md §6)."""
+        from urllib.parse import parse_qs
+
+        from kart_tpu import query as query_mod
+        from kart_tpu.query import cache as qcache
+
+        tm.incr("transport.server.requests", verb="query")
+        params = parse_qs(urlsplit(self.path).query)
+
+        def one(name, default=None):
+            return params.get(name, [default])[0]
+
+        ref, ds_path = one("ref"), one("dataset")
+        if not ref or not ds_path:
+            return self._json(
+                400, {"error": "query needs ref= and dataset= parameters"}
+            )
+        where, bbox = one("where"), one("bbox")
+        raw_intersects = one("intersects")
+        output = one("output", "count")
+        count_by = one("count_by")
+        raw_part = one("part")
+        try:
+            page = int(one("page")) if one("page") is not None else None
+            page_size = (
+                int(one("page_size")) if one("page_size") is not None else None
+            )
+        except ValueError:
+            return self._json(
+                400, {"error": "page/page_size must be integers"}
+            )
+        try:
+            commit1 = query_mod.resolve_query_commit(self.repo, ref)
+            intersects = commit2 = ds_path2 = None
+            if raw_intersects:
+                refish2, sep, ds2 = raw_intersects.partition(":")
+                if not sep or not refish2 or not ds2:
+                    raise query_mod.QueryError(
+                        f"intersects wants <refish>:<dataset>,"
+                        f" got {raw_intersects!r}"
+                    )
+                commit2 = query_mod.resolve_query_commit(self.repo, refish2)
+                ds_path2 = ds2
+                intersects = (commit2, ds_path2)
+            part = part_str = None
+            if raw_part:
+                m = re.fullmatch(r"(\d+):(\d+)", raw_part)
+                if m is None:
+                    raise query_mod.QueryError(
+                        f"part wants <lo>:<hi> row numbers, got {raw_part!r}"
+                    )
+                part = (int(m.group(1)), int(m.group(2)))
+                part_str = f"{part[0]}:{part[1]}"
+        except query_mod.QueryError as e:
+            return self._json(400, {"error": str(e)})
+        tm.annotate(ref=ref, dataset=ds_path)
+
+        # the validator derives from the request key alone: a revalidating
+        # client is answered 304 before any scan or join runs
+        key = qcache.query_request_key(
+            commit1, ds_path, where=where, bbox=bbox, commit_oid2=commit2,
+            ds_path2=ds_path2, output=output, count_by=count_by, page=page,
+            page_size=page_size, part=part_str,
+        )
+        etag = qcache.etag_for(key)
+        if self._if_none_match_hits(self.headers.get("If-None-Match"), etag):
+            tm.annotate(revalidated=True)
+            self.send_response(304)
+            self.send_header("ETag", etag)
+            self.send_header("Content-Length", "0")
+            self.end_headers()
+            return
+
+        fleet = self._fleet()
+        scatter_ok = (
+            intersects is not None
+            and output == "count"
+            and part is None
+            and fleet is not None
+            and bool(fleet.peers)
+            and not self._is_peer_fill()
+            and os.environ.get("KART_QUERY_SCATTER", "1") != "0"
+        )
+
+        def compute():
+            doc = None
+            if scatter_ok:
+                doc = self._scattered_join(
+                    query_mod, qcache, fleet, commit1, ds_path, commit2,
+                    ds_path2, bbox,
+                )
+            if doc is None:
+                doc = query_mod.run_query(
+                    self.repo, commit1, ds_path, where=where, bbox=bbox,
+                    intersects=intersects, output=output, count_by=count_by,
+                    page=page, page_size=page_size, part=part,
+                )
+            return json.dumps(doc, sort_keys=True).encode()
+
+        try:
+            payload = qcache.query_filled(
+                qcache.query_cache_for(self.repo), key, compute
+            )
+        except query_mod.QueryError as e:
+            return self._json(400, {"error": str(e)})
+        tm.incr("transport.server.bytes_sent", len(payload))
+        self.send_response(200)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("ETag", etag)
+        # immutable for its key (the commit oids are in it): downstream
+        # HTTP caches may keep it as long as they like
+        self.send_header("Cache-Control", "public, max-age=31536000, immutable")
+        self.send_header("Content-Length", str(len(payload)))
+        self.end_headers()
+        self.wfile.write(payload)
+
+    def _scattered_join(self, query_mod, qcache, fleet, commit1, ds_path,
+                        commit2, ds_path2, bbox):
+        """The fleet scatter of a join ``count`` query (docs/QUERY.md §6):
+        split the probe side into block-aligned row ranges, fetch parts
+        1..N-1 from peers as commit-addressed ``part=lo:hi`` partials
+        (ETag-validated, peer-cached) *while* part 0 computes here — the
+        overlap is the speedup — then compute any failed part locally and
+        merge by ordered addition. -> merged result doc, or None when the
+        probe side is too small to split."""
+        from urllib.parse import quote
+
+        from kart_tpu.diff import sidecar
+        from kart_tpu.fleet import peercache
+        from kart_tpu.query import _bump
+
+        ds = query_mod.load_query_dataset(self.repo, commit1, ds_path)
+        block = sidecar.ensure_block(self.repo, ds, pad=False)
+        n = int(block.count) if block is not None else 0
+        n_parts = len(fleet.peers) + 1
+        per = -(-max(n, 1) // n_parts)
+        per = max(
+            -(-per // sidecar.AGG_BLOCK_ROWS) * sidecar.AGG_BLOCK_ROWS,
+            sidecar.AGG_BLOCK_ROWS,
+        )
+        parts = [(lo, min(lo + per, n)) for lo in range(0, n, per)]
+        if len(parts) < 2:
+            return None
+        tm.incr("query.scatter_requests")
+        tm.incr("query.scatter_parts", len(parts))
+        _bump("scatter_requests")
+        _bump("scatter_parts", len(parts))
+        def _local(lo, hi):
+            return query_mod.run_query(
+                self.repo, commit1, ds_path, bbox=bbox,
+                intersects=(commit2, ds_path2), output="count",
+                part=(lo, hi),
+            )
+
+        def _from_peer(lo, hi):
+            part_str = f"{lo}:{hi}"
+            pkey = qcache.query_request_key(
+                commit1, ds_path, bbox=bbox, commit_oid2=commit2,
+                ds_path2=ds_path2, output="count", part=part_str,
+            )
+            path_and_query = (
+                f"{API}/query?ref={commit1}"
+                f"&dataset={quote(ds_path, safe='')}"
+                f"&intersects={commit2}:{quote(ds_path2, safe='')}"
+                f"&output=count&part={part_str}"
+            )
+            if bbox:
+                path_and_query += f"&bbox={quote(bbox, safe='')}"
+            return peercache.query_from_peers(
+                self.repo, fleet.peers, path_and_query,
+                qcache.etag_for(pkey),
+            )
+
+        # peer parts in flight first, so the remote computes overlap the
+        # local part-0 compute — the overlap IS the scatter speedup
+        payloads = [None] * len(parts)
+        threads = []
+        for i, (lo, hi) in enumerate(parts[1:], start=1):
+            def _fetch(i=i, lo=lo, hi=hi):
+                try:
+                    payloads[i] = _from_peer(lo, hi)
+                except Exception:
+                    payloads[i] = None  # degraded, not failed: compute here
+            t = threading.Thread(target=_fetch, daemon=True)
+            t.start()
+            threads.append(t)
+        docs = [_local(*parts[0])]
+        for t in threads:
+            t.join()
+        for i, (lo, hi) in enumerate(parts[1:], start=1):
+            if payloads[i] is None:
+                docs.append(_local(lo, hi))
+            else:
+                docs.append(json.loads(payloads[i]))
+        merged = dict(docs[0])
+        merged["part"] = None
+        merged["pairs"] = sum(d["pairs"] for d in docs)
+        merged["count"] = sum(d["count"] for d in docs)
+        stats = dict(docs[0]["stats"])
+        for name in ("tiles", "blocks_pruned", "block_tests", "batches"):
+            stats[name] = sum(d["stats"][name] for d in docs)
+        stats["scatter_parts"] = len(parts)
+        merged["stats"] = stats
+        return merged
+
     def _handle_stats(self):
         """Prometheus-style text exposition of this server process's metric
         registry (`kart stats <url>` reads this). ``?format=json`` returns
@@ -951,6 +1166,12 @@ class KartRequestHandler(BaseHTTPRequestHandler):
                 emitter = events_mod.active_emitter(self.repo.gitdir)
                 if emitter is not None:
                     extra["events"] = emitter.status_dict()
+            # the query-engine operator view (docs/QUERY.md §7): scans,
+            # joins, pruning and scatter counters — present once any
+            # query has run in this process
+            query_mod = sys.modules.get("kart_tpu.query")
+            if query_mod is not None:
+                extra["query"] = query_mod.status_dict()
             return self._json(200, rq_access.stats_payload(extra=extra))
         raw = sinks.prometheus_text().encode()
         self.send_response(200)

@@ -1221,6 +1221,11 @@ def _apply_validated_updates(repo, header, out=None):
     tiles_cache = sys.modules.get("kart_tpu.tiles.cache")
     if tiles_cache is not None and not emitter_active:
         tiles_cache.invalidate_tile_caches(repo.gitdir)
+    # query-result keys are commit-pinned too: same reasoning, same drop
+    # (no warm-then-announce exemption — there is no query warmer)
+    query_cache = sys.modules.get("kart_tpu.query.cache")
+    if query_cache is not None:
+        query_cache.invalidate_query_caches(repo.gitdir)
     return updated
 
 

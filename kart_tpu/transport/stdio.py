@@ -554,6 +554,13 @@ def serve_stdio(repo, in_fp, out_fp):
                                         extra["events"] = (
                                             emitter.status_dict()
                                         )
+                                query_mod = _sys.modules.get(
+                                    "kart_tpu.query"
+                                )
+                                if query_mod is not None:
+                                    extra["query"] = (
+                                        query_mod.status_dict()
+                                    )
                                 respond(
                                     {
                                         "stats": rq_access.stats_payload(

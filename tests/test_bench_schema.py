@@ -279,6 +279,39 @@ NEW_KEYS += [
     "pyramid_export_env_ceiling",
 ]
 
+#: keys added by ISSUE 16 (predicate-pushdown scans + the device-parallel
+#: cross-commit spatial join + the 2-replica fleet scatter)
+NEW_KEYS += [
+    "query_scan_rows",
+    "query_scan_synth_seconds",
+    "query_scan_seconds",
+    "query_scan_rows_per_sec",
+    "query_scan_unpruned_seconds",
+    "query_scan_rows_per_sec_unpruned",
+    "query_scan_matches",
+    "query_scan_pruned_matches_unpruned",
+    "query_scan_block_prune_fraction",
+    "query_scan_prune_meets_95pct",
+    "query_scan_prune_speedup",
+    "query_join_probe_rows",
+    "query_join_build_rows",
+    "query_join_pairs",
+    "query_join_host_seconds",
+    "query_join_pairs_per_sec_100m_x_1m_host",
+    "query_join_device_seconds",
+    "query_join_pairs_per_sec_100m_x_1m",
+    "query_join_device_vs_host",
+    "query_join_device_matches_host",
+    "query_scatter_rows",
+    "query_scatter_synth_seconds",
+    "query_join_single_node_seconds",
+    "query_join_scatter2_seconds",
+    "query_join_pairs_per_sec_100m_x_1m_scatter2",
+    "query_scatter_speedup",
+    "query_scatter_matches_single",
+    "query_scatter_parts",
+]
+
 
 def test_bench_emits_every_recorded_key():
     with open(os.path.join(REPO_ROOT, "bench.py")) as f:

@@ -258,30 +258,44 @@ def test_config(repo_dir, runner):
     assert r.output.strip() == "hello"
 
 
-def test_query_intersects(repo_dir, runner):
+def test_query_bbox(repo_dir, runner):
     r = runner.invoke(
-        cli, ["query", "points", "intersects", "100,-45,105.5,-39", "-o", "json"]
+        cli,
+        ["query", "HEAD", "points", "--bbox", "100,-45,105.5,-39", "-o", "json"],
     )
     assert r.exit_code == 0, r.output
-    out = json.loads(r.output)["kart.query/v1"]
+    out = json.loads(r.output)["kart.query/v2"]
     # points at x=101..110: fids 1..5 are <= 105.5
-    assert out["pks"] == [1, 2, 3, 4, 5]
+    assert out["count"] == 5
+    assert [f["fid"] for f in out["features"]] == [1, 2, 3, 4, 5]
 
 
-def test_query_get(repo_dir, runner):
-    r = runner.invoke(cli, ["query", "points", "get", "3", "-o", "json"])
+def test_query_where(repo_dir, runner):
+    r = runner.invoke(
+        cli, ["query", "HEAD", "points", "--where", "fid = 3", "-o", "json"]
+    )
     assert r.exit_code == 0, r.output
-    assert json.loads(r.output)["kart.query/v1"]["name"] == "feature-3"
-    # default output format is text
-    r = runner.invoke(cli, ["query", "points", "get", "3"])
+    out = json.loads(r.output)["kart.query/v2"]
+    assert out["count"] == 1
+    assert out["features"][0]["name"] == "feature-3"
+    # default output is the count document
+    r = runner.invoke(cli, ["query", "HEAD", "points", "--where", "fid > 7"])
     assert r.exit_code == 0, r.output
-    assert "name" in r.output and "feature-3" in r.output
+    assert json.loads(r.output)["kart.query/v2"]["count"] == 3
 
 
 def test_query_bad_bbox(repo_dir, runner):
-    r = runner.invoke(cli, ["query", "points", "intersects", "nope"])
+    r = runner.invoke(cli, ["query", "HEAD", "points", "--bbox", "nope"])
     assert r.exit_code != 0
-    assert "Bad bbox" in r.output
+    assert "W,S,E,N" in r.output
+
+
+def test_query_bad_where(repo_dir, runner):
+    r = runner.invoke(
+        cli, ["query", "HEAD", "points", "--where", "nosuch = 1"]
+    )
+    assert r.exit_code != 0
+    assert "no column" in r.output
 
 
 def test_gpkg_wc_spatial_index(repo_dir, runner):

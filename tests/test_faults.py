@@ -1611,3 +1611,117 @@ def test_event_warm_kill_keeps_announcement_and_clean_cache(
     assert payload == fresh
     events_mod.drop_emitters(repo.gitdir)
     fsck_objects(repo)
+
+
+# ---------------------------------------------------------------------------
+# ISSUE 16: the query-lane kill matrix (query.scan / query.join)
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture()
+def served_query_repo(tmp_path):
+    """A blobs-real synth repo served over HTTP: the scan's blob-decode
+    batches (query.scan frame 2+) need readable feature blobs."""
+    from kart_tpu import telemetry
+    from kart_tpu.query import cache as qcache
+    from kart_tpu.synth import synth_repo
+
+    repo, info = synth_repo(str(tmp_path / "q"), 400, blobs="real")
+    with qcache._query_caches_lock:
+        qcache._QUERY_CACHES.clear()
+    telemetry.reset(disable=False)
+    server = make_server(repo)
+    threading.Thread(target=server.serve_forever, daemon=True).start()
+    url = f"http://127.0.0.1:{server.server_address[1]}"
+    yield repo, info, url
+    server.shutdown()
+    server.server_close()
+    telemetry.reset()
+
+
+@pytest.mark.parametrize("frame", [1, 2])
+def test_query_scan_killed_at_every_frame_publishes_nothing(
+    served_query_repo, monkeypatch, frame
+):
+    """ISSUE 16 kill matrix: a crash at either query.scan frame (1 = scan
+    entry, 2 = the first blob-decode batch) surfaces as a 500 with nothing
+    published — the result cache holds no entry — and the retried query
+    serves the exact bytes a never-faulted server would."""
+    import json as _json
+    from urllib.parse import quote
+
+    from kart_tpu.query import run_query
+    from kart_tpu.query.cache import query_cache_for
+
+    repo, info, url = served_query_repo
+    base = info["base_commit"]
+    where = "rating >= 42"
+    path = (
+        f"/api/v1/query?ref={base}&dataset=synth"
+        f"&where={quote(where, safe='')}&output=json"
+    )
+
+    monkeypatch.setenv("KART_FAULTS", f"query.scan:{frame}")
+    status, body = _get_tile(url, path)
+    monkeypatch.delenv("KART_FAULTS")
+    assert status == 500
+    assert b"InjectedFault" in body
+    assert query_cache_for(repo).stats() == {"entries": 0, "bytes": 0}
+
+    status, payload = _get_tile(url, path)
+    assert status == 200
+    clean = run_query(repo, base, "synth", where=where, output="json")
+    assert payload == _json.dumps(clean, sort_keys=True).encode()
+
+
+@pytest.fixture()
+def served_join_repo(tmp_path):
+    """A spatial synth repo served over HTTP for the join kill matrix."""
+    from kart_tpu import telemetry
+    from kart_tpu.query import cache as qcache
+    from kart_tpu.synth import synth_repo
+
+    repo, info = synth_repo(
+        str(tmp_path / "j"), 5000, spatial=True, blobs="changed"
+    )
+    with qcache._query_caches_lock:
+        qcache._QUERY_CACHES.clear()
+    telemetry.reset(disable=False)
+    server = make_server(repo)
+    threading.Thread(target=server.serve_forever, daemon=True).start()
+    url = f"http://127.0.0.1:{server.server_address[1]}"
+    yield repo, info, url
+    server.shutdown()
+    server.server_close()
+    telemetry.reset()
+
+
+@pytest.mark.parametrize("frame", [1, 2])
+def test_query_join_killed_at_every_frame_publishes_nothing(
+    served_join_repo, monkeypatch, frame
+):
+    """ISSUE 16 kill matrix: a crash at either query.join frame (1 = join
+    entry, 2 = the first build-side tile) publishes nothing — no result
+    cache entry, nothing a peer could have cached — and the retried join
+    is byte-identical to a clean single-process run."""
+    import json as _json
+
+    from kart_tpu.query import run_query
+    from kart_tpu.query.cache import query_cache_for
+
+    repo, info, url = served_join_repo
+    base, edit = info["base_commit"], info["edit_commit"]
+    path = f"/api/v1/query?ref={base}&dataset=synth&intersects={edit}:synth"
+
+    monkeypatch.setenv("KART_FAULTS", f"query.join:{frame}")
+    status, body = _get_tile(url, path)
+    monkeypatch.delenv("KART_FAULTS")
+    assert status == 500
+    assert b"InjectedFault" in body
+    assert query_cache_for(repo).stats() == {"entries": 0, "bytes": 0}
+
+    status, payload = _get_tile(url, path)
+    assert status == 200
+    clean = run_query(repo, base, "synth", intersects=(edit, "synth"))
+    assert payload == _json.dumps(clean, sort_keys=True).encode()
+    assert _json.loads(payload)["pairs"] == clean["pairs"]

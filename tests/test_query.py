@@ -1,0 +1,849 @@
+"""kart query (ISSUE 16): predicate-pushdown scans, the device-parallel
+cross-commit spatial join, the commit-addressed result cache, and the
+fleet scatter.
+
+The parity claims these tests pin down: a bbox scan equals the brute-force
+numpy envelope test; a spatial join equals the O(n*m) per-row reference
+(including anti-meridian wraps, polar boxes and NULL-geometry NaN rows);
+``sharded_jax`` join counts are bit-identical to ``host_native`` on the
+8-device virtual mesh; block-range partials sum exactly to the full join;
+and a scattered two-node query merges to the same document a single node
+computes."""
+
+import json
+import threading
+import urllib.error
+import urllib.request
+from urllib.parse import quote
+
+import numpy as np
+import pytest
+
+from kart_tpu import telemetry
+from kart_tpu.diff import sidecar
+from kart_tpu.models.schema import ColumnSchema, Schema
+from kart_tpu.ops.bbox import bbox_intersects_np
+from kart_tpu.query import QueryError, run_query
+from kart_tpu.query.scan import compile_where, parse_bbox
+from kart_tpu.synth import synth_repo
+from kart_tpu.transport.http import make_server
+
+pytestmark = pytest.mark.query
+
+PK0 = 1 << 24  # synth pk base
+
+
+@pytest.fixture(scope="module")
+def spatial(tmp_path_factory):
+    """A two-commit spatial synth repo: 9000 rows (3 sidecar blocks),
+    envelope columns present, feature blobs only for the edited rows."""
+    repo, info = synth_repo(
+        str(tmp_path_factory.mktemp("query") / "spatial"),
+        9000,
+        spatial=True,
+        blobs="changed",
+    )
+    return repo, info
+
+
+@pytest.fixture(scope="module")
+def attr(tmp_path_factory):
+    """A two-commit non-spatial synth repo with every blob real — the
+    stage-3 (blob-backed value predicate) route needs readable blobs."""
+    repo, info = synth_repo(
+        str(tmp_path_factory.mktemp("query") / "attr"), 300, blobs="real"
+    )
+    return repo, info
+
+
+def envelopes_of(repo, commit, ds_path="synth"):
+    ds = repo.datasets(commit)[ds_path]
+    block = sidecar.ensure_block(repo, ds, pad=False)
+    return np.asarray(block.envelopes, dtype=np.float64), np.asarray(
+        block.keys
+    )
+
+
+def selective_bbox(env, frac=0.1):
+    """A W,S,E,N string covering roughly the first ``frac`` of the
+    longitude span — selective enough to prune whole blocks."""
+    w = float(env[:, 0].min())
+    e = w + (float(env[:, 2].max()) - w) * frac
+    return f"{w},{float(env[:, 1].min())},{e},{float(env[:, 3].max())}"
+
+
+def get_json(url, path):
+    """GET -> (status, parsed body or raw bytes, headers)."""
+    try:
+        with urllib.request.urlopen(url.rstrip("/") + path, timeout=30) as r:
+            return r.status, r.read(), dict(r.headers)
+    except urllib.error.HTTPError as e:
+        return e.code, e.read(), dict(e.headers)
+
+
+# ---------------------------------------------------------------------------
+# the predicate grammar
+# ---------------------------------------------------------------------------
+
+
+def _text_schema():
+    return Schema(
+        [
+            ColumnSchema(
+                id="a1b2c3d4-0001-4000-8000-000000000001",
+                name="fid",
+                data_type="integer",
+                pk_index=0,
+                extra_type_info={"size": 64},
+            ),
+            ColumnSchema(
+                id="a1b2c3d4-0005-4000-8000-000000000005",
+                name="name",
+                data_type="text",
+                pk_index=None,
+            ),
+        ]
+    )
+
+
+class TestGrammar:
+    def test_parse_bbox_accepts_antimeridian_wrap(self):
+        box = parse_bbox("170,-50,-170,-40")
+        assert list(box) == [170.0, -50.0, -170.0, -40.0]
+
+    @pytest.mark.parametrize(
+        "text", ["nope", "1,2,3", "1,2,3,4,5", "0,10,0,-10", "0,0,0,inf"]
+    )
+    def test_parse_bbox_rejects(self, text):
+        with pytest.raises(QueryError):
+            parse_bbox(text)
+
+    def test_compile_where_typed_forms(self, spatial):
+        repo, info = spatial
+        schema = repo.datasets(info["base_commit"])["synth"].schema
+        preds = compile_where(
+            "fid >= 5 AND rating < 2.5 AND rating IS NOT NULL", schema
+        )
+        assert [p.kind for p in preds] == ["cmp", "cmp", "notnull"]
+        assert [p.on_pk for p in preds] == [True, False, False]
+        assert preds[0].value == 5 and isinstance(preds[0].value, int)
+        assert preds[1].value == 2.5
+
+        (p,) = compile_where("fid IN (1, 2, 3)", schema)
+        assert p.kind == "in" and p.values == {1, 2, 3} and p.on_pk
+
+    @pytest.mark.parametrize(
+        "where",
+        [
+            "nosuch = 1",  # unknown column
+            "fid = 1.5",  # float literal for an integer column
+            "fid = 'x'",  # string literal for an integer column
+            "geom = 1",  # geometry column: --bbox territory
+            "fid = 1 rating = 2",  # missing AND
+            "fid = 1 AND",  # dangling AND
+            "rating >",  # missing literal
+            "fid IN (1",  # unclosed IN
+            "rating IS 3",  # IS without NULL
+        ],
+    )
+    def test_compile_where_rejects(self, spatial, where):
+        repo, info = spatial
+        schema = repo.datasets(info["base_commit"])["synth"].schema
+        with pytest.raises(QueryError):
+            compile_where(where, schema)
+
+    def test_text_literals_need_quotes(self):
+        schema = _text_schema()
+        (p,) = compile_where("name = 'it''s'", schema)
+        assert p.value == "it's"
+        with pytest.raises(QueryError):
+            compile_where("name = bare", schema)
+
+
+# ---------------------------------------------------------------------------
+# the pushdown scan
+# ---------------------------------------------------------------------------
+
+
+class TestScan:
+    def test_bbox_count_matches_bruteforce(self, spatial):
+        repo, info = spatial
+        base = info["base_commit"]
+        env, _keys = envelopes_of(repo, base)
+        bbox = selective_bbox(env)
+        expected = int(
+            np.count_nonzero(bbox_intersects_np(env, parse_bbox(bbox)))
+        )
+        assert 0 < expected < len(env)
+        doc = run_query(repo, base, "synth", bbox=bbox)
+        assert doc["count"] == expected
+        assert doc["kind"] == "scan" and doc["commit"] == base
+
+    def test_selective_bbox_prunes_blocks(self, spatial, monkeypatch):
+        repo, info = spatial
+        base = info["base_commit"]
+        env, _keys = envelopes_of(repo, base)
+        bbox = selective_bbox(env, frac=0.05)
+        doc = run_query(repo, base, "synth", bbox=bbox)
+        assert doc["stats"]["blocks"] == 3  # 9000 rows / 4096-row blocks
+        assert doc["stats"]["blocks_pruned"] >= 1
+        # prune forced off: bit-identical result, no blocks skipped
+        monkeypatch.setenv("KART_BLOCK_PRUNE", "0")
+        unpruned = run_query(repo, base, "synth", bbox=bbox)
+        assert unpruned["count"] == doc["count"]
+        assert unpruned["stats"]["blocks_pruned"] == 0
+
+    def test_pk_predicates_vectorized(self, spatial):
+        repo, info = spatial
+        base = info["base_commit"]
+        doc = run_query(repo, base, "synth", where=f"fid < {PK0 + 100}")
+        assert doc["count"] == 100
+        doc = run_query(
+            repo,
+            base,
+            "synth",
+            where=f"fid IN ({PK0}, {PK0 + 7}, {PK0 + 9000})",
+        )
+        assert doc["count"] == 2  # PK0+9000 is past the end
+
+    def test_bbox_and_pk_combined(self, spatial):
+        repo, info = spatial
+        base = info["base_commit"]
+        env, keys = envelopes_of(repo, base)
+        bbox = selective_bbox(env)
+        hits = bbox_intersects_np(env, parse_bbox(bbox))
+        cut = PK0 + 4000
+        expected = int(np.count_nonzero(hits & (keys < cut)))
+        doc = run_query(
+            repo, base, "synth", where=f"fid < {cut}", bbox=bbox
+        )
+        assert doc["count"] == expected
+
+    def test_blob_backed_value_predicates(self, attr):
+        repo, info = attr
+        base, n = info["base_commit"], info["n"]
+        # base-commit rating is pk/2.0 for every row
+        cut = (PK0 + 40) / 2.0
+        doc = run_query(repo, base, "synth", where=f"rating < {cut}")
+        assert doc["count"] == 40
+        assert doc["stats"]["rows_decoded"] == n  # no pk prefilter: all decode
+        doc = run_query(repo, base, "synth", where="rating IS NOT NULL")
+        assert doc["count"] == n
+        # the pk stage shrinks what the blob stage decodes
+        doc = run_query(
+            repo,
+            base,
+            "synth",
+            where=f"fid < {PK0 + 50} AND rating >= {PK0 / 2.0}",
+        )
+        assert doc["count"] == 50 and doc["stats"]["rows_decoded"] == 50
+
+    def test_json_output_pages(self, attr):
+        repo, info = attr
+        base = info["base_commit"]
+        where = f"fid < {PK0 + 10}"
+        seen = []
+        page = 0
+        while page is not None:
+            doc = run_query(
+                repo,
+                base,
+                "synth",
+                where=where,
+                output="json",
+                page=page,
+                page_size=4,
+            )
+            assert doc["page_size"] == 4
+            seen.extend(f["fid"] for f in doc["features"])
+            page = doc["next_page"]
+        assert seen == list(range(PK0, PK0 + 10))
+        # and every feature carries its real attribute values
+        doc = run_query(
+            repo, base, "synth", where=f"fid = {PK0 + 4}", output="json"
+        )
+        assert doc["features"] == [
+            {"fid": PK0 + 4, "rating": (PK0 + 4) / 2.0}
+        ]
+
+    def test_count_by_pk_groups(self, spatial):
+        repo, info = spatial
+        base = info["base_commit"]
+        doc = run_query(
+            repo, base, "synth", where=f"fid < {PK0 + 3}", count_by="fid"
+        )
+        assert doc["groups"] == {
+            str(PK0): 1,
+            str(PK0 + 1): 1,
+            str(PK0 + 2): 1,
+        }
+
+    def test_bbox_union_covers_selection(self, spatial):
+        repo, info = spatial
+        base = info["base_commit"]
+        env, _keys = envelopes_of(repo, base)
+        bbox = selective_bbox(env)
+        doc = run_query(repo, base, "synth", bbox=bbox, output="bbox")
+        w, s, e, n = doc["bbox_union"]
+        sel = env[bbox_intersects_np(env, parse_bbox(bbox))]
+        assert w <= sel[:, 0].min() and e >= sel[:, 2].max()
+        assert s <= sel[:, 1].min() and n >= sel[:, 3].max()
+
+    def test_scan_is_deterministic_bytes(self, spatial):
+        repo, info = spatial
+        base = info["base_commit"]
+        env, _keys = envelopes_of(repo, base)
+        bbox = selective_bbox(env)
+        a = run_query(repo, base, "synth", bbox=bbox, output="count")
+        b = run_query(repo, base, "synth", bbox=bbox, output="count")
+        assert json.dumps(a, sort_keys=True) == json.dumps(b, sort_keys=True)
+
+    def test_scan_surface_errors(self, spatial):
+        repo, info = spatial
+        base = info["base_commit"]
+        with pytest.raises(QueryError):  # partials are a join-only concept
+            run_query(repo, base, "synth", part=(0, 10))
+        with pytest.raises(QueryError):  # join and --where don't combine
+            run_query(
+                repo,
+                base,
+                "synth",
+                where="fid = 1",
+                intersects=(base, "synth"),
+            )
+        with pytest.raises(QueryError):
+            run_query(repo, base, "synth", output="nosuch")
+        with pytest.raises(QueryError):
+            run_query(repo, "no-such-ref", "synth")
+        with pytest.raises(QueryError):
+            run_query(repo, base, "no-such-dataset")
+
+
+# ---------------------------------------------------------------------------
+# the spatial join
+# ---------------------------------------------------------------------------
+
+
+def brute_join(build_env, probe_env):
+    """The O(n*m) reference: per probe row, the numpy envelope test against
+    every build row (an implementation independent of the join kernel)."""
+    counts = np.zeros(len(probe_env), dtype=np.int64)
+    for i in range(len(probe_env)):
+        q = probe_env[i].astype(np.float64)
+        if not np.isfinite(q).all():
+            continue  # NULL geometry: matches nothing
+        counts[i] = np.count_nonzero(bbox_intersects_np(build_env, q))
+    return counts
+
+
+class _ProbeStub:
+    """The minimal probe-block shape join_counts_for_range needs — lets the
+    wrap/polar/NaN matrix run on hand-built envelope columns."""
+
+    def __init__(self, env):
+        self.envelopes = np.asarray(env, dtype=np.float32)
+        self.env_blocks = None
+        self.count = len(env)
+
+
+class TestJoin:
+    def test_time_travel_join_matches_bruteforce(self, spatial):
+        repo, info = spatial
+        base, edit = info["base_commit"], info["edit_commit"]
+        build_env, _ = envelopes_of(repo, edit)
+        probe_env, _ = envelopes_of(repo, base)
+        ref = brute_join(build_env, probe_env)
+        doc = run_query(
+            repo, base, "synth", intersects=(edit, "synth"), allow_device=False
+        )
+        assert doc["pairs"] == int(ref.sum())
+        assert doc["count"] == int(np.count_nonzero(ref))
+        assert doc["stats"]["build_rows"] == len(build_env)
+        assert doc["stats"]["probe_rows"] == len(probe_env)
+        assert doc["stats"]["tiles"] >= 2  # 9000 build rows / 4096-row tiles
+
+    def test_join_parts_sum_to_whole(self, spatial):
+        repo, info = spatial
+        base, edit = info["base_commit"], info["edit_commit"]
+        full = run_query(repo, base, "synth", intersects=(edit, "synth"))
+        parts = [
+            run_query(
+                repo, base, "synth", intersects=(edit, "synth"), part=p
+            )
+            for p in ((0, 4096), (4096, 9000))
+        ]
+        assert sum(p["pairs"] for p in parts) == full["pairs"]
+        assert sum(p["count"] for p in parts) == full["count"]
+        # a partial still reports the *full* probe side in its stats
+        assert all(p["stats"]["probe_rows"] == 9000 for p in parts)
+        with pytest.raises(QueryError):  # out-of-range partial
+            run_query(
+                repo, base, "synth", intersects=(edit, "synth"), part=(0, 9001)
+            )
+
+    def test_join_bbox_restricts_both_sides(self, spatial):
+        repo, info = spatial
+        base, edit = info["base_commit"], info["edit_commit"]
+        build_env, _ = envelopes_of(repo, edit)
+        probe_env, _ = envelopes_of(repo, base)
+        bbox = selective_bbox(probe_env, frac=0.2)
+        q = parse_bbox(bbox)
+        b_sel = build_env[bbox_intersects_np(build_env, q)]
+        p_hits = bbox_intersects_np(probe_env, q)
+        ref = brute_join(b_sel, probe_env)
+        ref[~p_hits] = 0
+        doc = run_query(
+            repo, base, "synth", intersects=(edit, "synth"), bbox=bbox
+        )
+        assert doc["pairs"] == int(ref.sum())
+        assert doc["count"] == int(np.count_nonzero(ref))
+
+    def test_join_json_reports_match_counts(self, spatial):
+        repo, info = spatial
+        base, edit = info["base_commit"], info["edit_commit"]
+        build_env, _ = envelopes_of(repo, edit)
+        probe_env, keys = envelopes_of(repo, base)
+        ref = brute_join(build_env, probe_env)
+        doc = run_query(
+            repo,
+            base,
+            "synth",
+            intersects=(edit, "synth"),
+            output="json",
+            page_size=50,
+        )
+        assert doc["page"] == 0 and len(doc["matches"]) == 50
+        nz = np.flatnonzero(ref)
+        for got, i in zip(doc["matches"], nz[:50].tolist()):
+            assert got["pk"] == int(keys[i])
+            assert got["matches"] == int(ref[i])
+        assert doc["next_page"] == (1 if len(nz) > 50 else None)
+
+    def test_wrap_polar_and_nan_rows(self):
+        """The crafted matrix: anti-meridian wraps on either side, polar
+        boxes, and NaN (NULL-geometry) rows on either side — the staged
+        join equals the brute-force reference on all of them."""
+        from kart_tpu.query.join import join_counts_for_range
+
+        rng = np.random.default_rng(7)
+        def mk(n):
+            w = rng.uniform(-179, 178, n)
+            s = rng.uniform(-89, 88, n)
+            env = np.stack(
+                [w, s, w + rng.uniform(0.1, 2, n), s + rng.uniform(0.1, 2, n)],
+                axis=1,
+            ).astype(np.float32)
+            env[:: n // 5] = [[170.0, -10.0, -170.0, 10.0]]  # wrapped
+            env[1 :: n // 5] = [[-60.0, 85.0, 60.0, 90.0]]  # polar
+            env[2 :: n // 5] = [[np.nan] * 4]  # NULL geometry
+            return env
+
+        build, probe = mk(600), mk(500)
+        ref = brute_join(build, probe)
+        counts, total = join_counts_for_range(
+            build, _ProbeStub(probe), 0, len(probe), allow_device=False
+        )
+        assert np.array_equal(counts, ref)
+        assert total == int(ref.sum())
+        # wrapped probe against wrapped build always overlaps in longitude
+        assert counts[0] > 0
+        # NaN rows never match, in either role
+        assert counts[2] == 0
+
+    def test_sharded_join_bit_identical_to_host(self):
+        from kart_tpu.diff.backend import (
+            _host_join_counts,
+            sharded_join_counts,
+        )
+
+        rng = np.random.default_rng(11)
+        w = rng.uniform(-179, 178, 3000)
+        s = rng.uniform(-89, 88, 3000)
+        probe = np.stack([w, s, w + 1, s + 1], axis=1).astype(np.float32)
+        probe[::97] = [[175.0, -5.0, -175.0, 5.0]]
+        probe[::131] = [[np.nan] * 4]
+        build = probe[:700][::-1].copy()
+        hc, ht = _host_join_counts(build, probe)
+        sc, st = sharded_join_counts(build, probe)
+        assert np.array_equal(hc, sc)  # bit-identical on the 8-device mesh
+        assert ht == st
+
+    def test_device_route_matches_host(self, spatial, monkeypatch):
+        repo, info = spatial
+        base, edit = info["base_commit"], info["edit_commit"]
+        host = run_query(
+            repo, base, "synth", intersects=(edit, "synth"), allow_device=False
+        )
+        monkeypatch.setenv("KART_DIFF_SHARDED", "1")
+        dev = run_query(repo, base, "synth", intersects=(edit, "synth"))
+        assert (dev["pairs"], dev["count"]) == (host["pairs"], host["count"])
+
+    def test_pack_env_round_roundtrip(self):
+        from kart_tpu.diff.device_batch import pack_env_round
+
+        env = np.arange(40, dtype=np.float32).reshape(10, 4)
+        lo, hi = 2, 9
+        cols = pack_env_round(env, lo, hi, n_shards=4, per=2)
+        assert len(cols) == 4 and cols[0].shape == (4, 2)
+        for c, col in enumerate(cols):
+            flat = col.reshape(-1)
+            assert np.array_equal(flat[: hi - lo], env[lo:hi, c])
+            assert np.isnan(flat[hi - lo :]).all()  # padding never matches
+        with pytest.raises(ValueError):
+            pack_env_round(env, 0, 10, n_shards=2, per=2)
+
+
+# ---------------------------------------------------------------------------
+# GET /api/v1/query: the cached, ETagged serving lane
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture()
+def served_spatial(spatial):
+    repo, info = spatial
+    from kart_tpu.query import cache as qcache
+
+    with qcache._query_caches_lock:
+        qcache._QUERY_CACHES.clear()
+    telemetry.reset(disable=False)
+    server = make_server(repo)
+    threading.Thread(target=server.serve_forever, daemon=True).start()
+    url = f"http://127.0.0.1:{server.server_address[1]}"
+    yield repo, info, url
+    server.shutdown()
+    server.server_close()
+    telemetry.reset()
+
+
+def _counter(name, **labels):
+    for n, l, v in telemetry.snapshot()["counters"]:
+        if n == name and l == labels:
+            return v
+    return 0
+
+
+class TestHttpQuery:
+    def test_scan_etag_revalidation_and_cache(self, served_spatial):
+        repo, info, url = served_spatial
+        base = info["base_commit"]
+        env, _ = envelopes_of(repo, base)
+        bbox = quote(selective_bbox(env), safe="")
+        path = f"/api/v1/query?ref={base}&dataset=synth&bbox={bbox}"
+        status, body, headers = get_json(url, path)
+        assert status == 200
+        etag = headers["ETag"]
+        assert etag.startswith('"') and "immutable" in headers["Cache-Control"]
+        doc = json.loads(body)
+        assert doc["kind"] == "scan" and doc["count"] > 0
+
+        req = urllib.request.Request(
+            url + path, headers={"If-None-Match": etag}
+        )
+        with pytest.raises(urllib.error.HTTPError) as exc:
+            urllib.request.urlopen(req, timeout=30)
+        assert exc.value.code == 304
+
+        # an unconditional repeat serves the cached bytes
+        status, again, headers2 = get_json(url, path)
+        assert status == 200 and again == body and headers2["ETag"] == etag
+        assert _counter("query.cache.hits") >= 1
+
+    def test_join_and_partials_over_http(self, served_spatial):
+        repo, info, url = served_spatial
+        base, edit = info["base_commit"], info["edit_commit"]
+        local = run_query(repo, base, "synth", intersects=(edit, "synth"))
+        path = (
+            f"/api/v1/query?ref={base}&dataset=synth&intersects={edit}:synth"
+        )
+        status, body, _ = get_json(url, path)
+        assert status == 200
+        doc = json.loads(body)
+        assert doc["pairs"] == local["pairs"]
+
+        totals = []
+        for part in ("0:4096", "4096:9000"):
+            status, body, headers = get_json(url, f"{path}&part={part}")
+            assert status == 200
+            pdoc = json.loads(body)
+            assert pdoc["part"] == [int(p) for p in part.split(":")]
+            assert headers["ETag"]  # partials are peer-cacheable payloads
+            totals.append(pdoc["pairs"])
+        assert sum(totals) == local["pairs"]
+
+    def test_join_json_pagination_over_http(self, served_spatial):
+        repo, info, url = served_spatial
+        base, edit = info["base_commit"], info["edit_commit"]
+        path = (
+            f"/api/v1/query?ref={base}&dataset=synth&intersects={edit}:synth"
+            f"&output=json&page_size=10"
+        )
+        status, body, _ = get_json(url, path + "&page=0")
+        assert status == 200
+        p0 = json.loads(body)
+        assert len(p0["matches"]) == 10 and p0["next_page"] == 1
+        status, body, _ = get_json(url, path + "&page=1")
+        p1 = json.loads(body)
+        assert p1["page"] == 1
+        assert p0["matches"][-1]["pk"] < p1["matches"][0]["pk"]
+
+    @pytest.mark.parametrize(
+        "path",
+        [
+            "/api/v1/query?dataset=synth",  # no ref
+            "/api/v1/query?ref=HEAD",  # no dataset
+            "/api/v1/query?ref=HEAD&dataset=synth&where=nosuch%20%3D%201",
+            "/api/v1/query?ref=HEAD&dataset=synth&bbox=nope",
+            "/api/v1/query?ref=HEAD&dataset=synth&part=xx",
+            "/api/v1/query?ref=HEAD&dataset=nosuch",
+            "/api/v1/query?ref=HEAD&dataset=synth&page=abc",
+        ],
+    )
+    def test_bad_requests_are_400(self, served_spatial, path):
+        _repo, _info, url = served_spatial
+        status, body, _ = get_json(url, path)
+        assert status == 400
+        assert "error" in json.loads(body)
+
+    def test_stats_document_gains_query_block(self, served_spatial):
+        repo, info, url = served_spatial
+        base = info["base_commit"]
+        get_json(
+            url, f"/api/v1/query?ref={base}&dataset=synth&where=fid%20%3C%20{PK0 + 5}"
+        )
+        status, body, _ = get_json(url, "/api/v1/stats?format=json")
+        assert status == 200
+        payload = json.loads(body)
+        q = payload["query"]
+        assert q["scans"] >= 1 and "pairs_emitted" in q
+
+    def test_top_renders_query_line(self):
+        from kart_tpu.cli.top_cmds import render_top
+
+        frame = render_top(
+            {
+                "snapshot": {},
+                "rates": {},
+                "query": {
+                    "scans": 3,
+                    "joins": 1,
+                    "blocks_pruned": 5,
+                    "pairs_emitted": 42,
+                    "scatter_parts": 2,
+                    "cache_hits": 1,
+                    "cache_misses": 2,
+                },
+            },
+            "http://x",
+        )
+        assert "query  scans 3" in frame
+        assert "pairs 42" in frame and "cache 1h/2m" in frame
+
+
+# ---------------------------------------------------------------------------
+# the fleet scatter
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture()
+def _scatter_state(monkeypatch):
+    from kart_tpu.fleet import peercache
+    from kart_tpu.query import cache as qcache
+
+    telemetry.reset(disable=False)
+    for var in ("KART_FAULTS", "KART_PEER_CACHE", "KART_QUERY_SCATTER"):
+        monkeypatch.delenv(var, raising=False)
+    monkeypatch.setenv("KART_TRANSPORT_RETRY_BASE", "0.01")
+    monkeypatch.setenv("KART_TRANSPORT_RETRY_CAP", "0.05")
+    with peercache._peer_caches_lock:
+        peercache._PEER_CACHES.clear()
+    with peercache._peer_down_lock:
+        peercache._peer_down.clear()
+    with qcache._query_caches_lock:
+        qcache._QUERY_CACHES.clear()
+    yield
+    telemetry.reset()
+
+
+def _serve(repo, fleet=None):
+    server = make_server(repo, fleet=fleet)
+    threading.Thread(target=server.serve_forever, daemon=True).start()
+    return server, f"http://127.0.0.1:{server.server_address[1]}"
+
+
+@pytest.fixture()
+def scatter_pair(tmp_path, _scatter_state):
+    """Two nodes over one shared store (the shared-storage fleet shape):
+    node A scatters probe ranges, node B answers partials."""
+    from kart_tpu import fleet as fleet_mod
+
+    repo, info = synth_repo(
+        str(tmp_path / "r"), 9000, spatial=True, blobs="changed"
+    )
+    server_b, url_b = _serve(repo)
+    node = fleet_mod.FleetNode(repo, primary_url=None, peers=(url_b,))
+    server_a, url_a = _serve(repo, fleet=node)
+    yield repo, info, url_a, url_b
+    for s in (server_a, server_b):
+        s.shutdown()
+        s.server_close()
+
+
+class TestScatter:
+    def test_scattered_join_merges_exact(self, scatter_pair):
+        repo, info, url_a, _url_b = scatter_pair
+        base, edit = info["base_commit"], info["edit_commit"]
+        local = run_query(repo, base, "synth", intersects=(edit, "synth"))
+        path = (
+            f"/api/v1/query?ref={base}&dataset=synth&intersects={edit}:synth"
+        )
+        status, body, headers = get_json(url_a, path)
+        assert status == 200
+        doc = json.loads(body)
+        assert doc["stats"]["scatter_parts"] == 2
+        assert doc["pairs"] == local["pairs"]
+        assert doc["count"] == local["count"]
+        assert doc["part"] is None  # the merged doc is the full answer
+        # part 1 really crossed the wire to the peer
+        assert _counter("fleet.peer_cache.fetches") >= 1
+        assert _counter("query.scatter_requests") == 1
+        assert _counter("query.scatter_parts") == 2
+
+        # the merged doc was published under the full key: a repeat is a
+        # local cache hit serving the identical bytes, no new scatter
+        status, again, _ = get_json(url_a, path)
+        assert status == 200 and again == body
+        assert _counter("query.scatter_requests") == 1
+
+    def test_scatter_with_bbox_merges_exact(self, scatter_pair):
+        repo, info, url_a, _url_b = scatter_pair
+        base, edit = info["base_commit"], info["edit_commit"]
+        env, _ = envelopes_of(repo, base)
+        bbox = selective_bbox(env, frac=0.3)
+        local = run_query(
+            repo, base, "synth", intersects=(edit, "synth"), bbox=bbox
+        )
+        path = (
+            f"/api/v1/query?ref={base}&dataset=synth&intersects={edit}:synth"
+            f"&bbox={quote(bbox, safe='')}"
+        )
+        status, body, _ = get_json(url_a, path)
+        assert status == 200
+        doc = json.loads(body)
+        assert doc["stats"]["scatter_parts"] == 2
+        assert (doc["pairs"], doc["count"]) == (local["pairs"], local["count"])
+
+    def test_scatter_disabled_by_env(self, scatter_pair, monkeypatch):
+        repo, info, url_a, _url_b = scatter_pair
+        base, edit = info["base_commit"], info["edit_commit"]
+        monkeypatch.setenv("KART_QUERY_SCATTER", "0")
+        path = (
+            f"/api/v1/query?ref={base}&dataset=synth&intersects={edit}:synth"
+        )
+        status, body, _ = get_json(url_a, path)
+        assert status == 200
+        doc = json.loads(body)
+        assert "scatter_parts" not in doc["stats"]
+        assert _counter("query.scatter_requests") == 0
+
+    def test_dead_peer_part_computed_locally(self, tmp_path, _scatter_state):
+        from kart_tpu import fleet as fleet_mod
+
+        repo, info = synth_repo(
+            str(tmp_path / "r"), 9000, spatial=True, blobs="changed"
+        )
+        node = fleet_mod.FleetNode(
+            repo, primary_url=None, peers=("http://127.0.0.1:9/",)
+        )
+        server, url = _serve(repo, fleet=node)
+        try:
+            base, edit = info["base_commit"], info["edit_commit"]
+            local = run_query(repo, base, "synth", intersects=(edit, "synth"))
+            status, body, _ = get_json(
+                url,
+                f"/api/v1/query?ref={base}&dataset=synth"
+                f"&intersects={edit}:synth",
+            )
+            assert status == 200
+            doc = json.loads(body)
+            # the scatter degraded, the answer didn't
+            assert doc["stats"]["scatter_parts"] == 2
+            assert doc["pairs"] == local["pairs"]
+        finally:
+            server.shutdown()
+            server.server_close()
+
+
+# ---------------------------------------------------------------------------
+# the result cache
+# ---------------------------------------------------------------------------
+
+
+class TestQueryCache:
+    def test_key_covers_every_result_shaping_field(self):
+        from kart_tpu.query.cache import etag_for, query_request_key
+
+        base = query_request_key("c1" * 20, "ds")
+        variants = [
+            query_request_key("c2" * 20, "ds"),
+            query_request_key("c1" * 20, "other"),
+            query_request_key("c1" * 20, "ds", where="fid = 1"),
+            query_request_key("c1" * 20, "ds", bbox="0,0,1,1"),
+            query_request_key("c1" * 20, "ds", commit_oid2="c2" * 20),
+            query_request_key("c1" * 20, "ds", ds_path2="ds2"),
+            query_request_key("c1" * 20, "ds", output="json"),
+            query_request_key("c1" * 20, "ds", count_by="fid"),
+            query_request_key("c1" * 20, "ds", page=1),
+            query_request_key("c1" * 20, "ds", page_size=10),
+            query_request_key("c1" * 20, "ds", part="0:10"),
+        ]
+        assert len({base, *variants}) == len(variants) + 1
+        assert etag_for(base) == f'"{base[:32]}"'
+
+    def test_fill_publish_hit_and_crash_abandon(self):
+        from kart_tpu.query.cache import QueryCache, query_filled
+
+        cache = QueryCache(1 << 20)
+        calls = []
+
+        def compute():
+            calls.append(1)
+            return b"doc"
+
+        assert query_filled(cache, "k", compute) == b"doc"
+        assert query_filled(cache, "k", compute) == b"doc"
+        assert len(calls) == 1  # second call was a memo hit
+
+        def boom():
+            raise RuntimeError("mid-fill crash")
+
+        with pytest.raises(RuntimeError):
+            query_filled(cache, "k2", boom)
+        assert cache.stats()["entries"] == 1  # nothing published for k2
+        assert query_filled(cache, "k2", compute) == b"doc"  # clean retry
+
+    def test_filled_without_cache_computes(self):
+        from kart_tpu.query.cache import query_filled
+
+        assert query_filled(None, "k", lambda: b"x") == b"x"
+
+    def test_budget_env_and_invalidation(self, tmp_path, monkeypatch):
+        from kart_tpu.core.repo import KartRepo
+        from kart_tpu.query.cache import (
+            invalidate_query_caches,
+            query_cache_for,
+            query_filled,
+        )
+
+        repo = KartRepo.init_repository(str(tmp_path / "r"))
+        monkeypatch.setenv("KART_QUERY_CACHE", "0")
+        assert query_cache_for(repo) is None
+        monkeypatch.setenv("KART_QUERY_CACHE", str(1 << 20))
+        cache = query_cache_for(repo)
+        assert cache is not None and cache.budget == 1 << 20
+        assert query_cache_for(repo) is cache  # stable while budget holds
+
+        query_filled(cache, "k", lambda: b"doc")
+        assert cache.stats()["entries"] == 1
+        # the ref-update drop hook (transport.service) releases the budget
+        invalidate_query_caches(repo.gitdir)
+        assert cache.stats() == {"entries": 0, "bytes": 0}

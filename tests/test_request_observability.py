@@ -378,19 +378,24 @@ def test_slow_request_exemplar_names_the_slow_frame(tmp_path, monkeypatch):
 
 
 def test_storm_server_percentiles_agree_with_clients(tmp_path, monkeypatch):
-    """16 concurrent clients: the server-side per-verb p50/p99 from the
+    """Concurrent clients: the server-side per-verb p50/p99 from the
     bucketed histograms agree with the client-observed percentiles within
     the one-bucket error bound — the ISSUE 12 storm acceptance, sized for
     tier-1. The enum cache is disabled so every request pays the full
     walk+spool+stream server-side (a cache-hit memcpy decouples the
     server's handler time from the client's drain via socket buffering —
-    the bench's big-pack storm keeps the cache on instead)."""
+    the bench's big-pack storm keeps the cache on instead). The storm is
+    sized to the host: the agreement bound is about measurement, not
+    capacity, and 16 client threads contending for one core queue on
+    *client-side* unpack work the server never sees (observed p99 gap
+    5x on a 1-core box), so clients scale with cores up to the full 16."""
     import math
     from urllib.request import urlopen
 
     from kart_tpu.core.repo import KartRepo
     from kart_tpu.transport.http import HttpRemote
 
+    n_clients = min(16, max(4, 2 * (os.cpu_count() or 1)))
     monkeypatch.setenv("KART_SERVE_ENUM_CACHE", "0")
     repo, _ = make_imported_repo(tmp_path, n=1500)
     server, url = _start_http_server(repo)
@@ -412,7 +417,8 @@ def test_storm_server_percentiles_agree_with_clients(tmp_path, monkeypatch):
 
     try:
         threads = [
-            threading.Thread(target=client_run, args=(i,)) for i in range(16)
+            threading.Thread(target=client_run, args=(i,))
+            for i in range(n_clients)
         ]
         for t in threads:
             t.start()
@@ -425,12 +431,12 @@ def test_storm_server_percentiles_agree_with_clients(tmp_path, monkeypatch):
         server.server_close()
 
     assert not errors, errors
-    assert len(durations) == 16
+    assert len(durations) == n_clients
     hist = None
     for n, labels, h in payload["snapshot"]["histograms"]:
         if n == "server.request_seconds" and labels.get("verb") == "fetch-pack":
             hist = h
-    assert hist is not None and hist["count"] == 16
+    assert hist is not None and hist["count"] == n_clients
     ordered = sorted(durations)
     for q, est in ((0.50, hist["p50"]), (0.99, hist["p99"])):
         idx = min(len(ordered) - 1, math.ceil(q * len(ordered)) - 1)
