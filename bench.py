@@ -645,6 +645,7 @@ def _lint_bench():
 
     try:
         from kart_tpu import analysis
+        from kart_tpu.analysis import dataflow
 
         t0 = time.perf_counter()
         report = analysis.run_lint()
@@ -659,6 +660,13 @@ def _lint_bench():
             # (the interprocedural KTL010 family is the expected leader)
             "lint_rule_seconds_max": round(
                 max(report.rule_seconds.values(), default=0.0), 3
+            ),
+            # ISSUE 19: taint-engine coverage — how many function bodies
+            # the KTL030-034 dataflow pass analyzed (seeded sources plus
+            # memoized callee passes); a drop means the wire surface
+            # silently shrank
+            "lint_taint_functions_analyzed": (
+                dataflow.last_run_functions_analyzed()
             ),
         }
     except Exception as e:

@@ -15,10 +15,15 @@ cross-cutting contracts (docs/ANALYSIS.md):
     KTL014  cache coverage       byte-budgeted caches <-> CACHES registry
     KTL020  device trace purity  no host effects inside jit/shard_map
     KTL021  device fallback seam jax only behind select_backend & friends
+    KTL030  tainted alloc        wire lengths capped before allocation sinks
+    KTL031  tainted wrapping sum wire lengths never totalled in int64
+    KTL032  tainted struct/slice remaining-length precheck before unpack
+    KTL033  consume-exact        versioned wire decoders reject trailing junk
+    KTL034  tainted name to fs   ref/path names validated before the fs
 
-Entry points: ``kart lint [PATHS] [--changed [REF]] [-o text|json|sarif]``
-and ``python -m kart_tpu.analysis``. Programmatic: :func:`run_lint` ->
-:class:`Report`.
+Entry points: ``kart lint [PATHS] [--changed [REF]] [-o text|json|sarif]
+[--rules] [--install-hook]`` and ``python -m kart_tpu.analysis``.
+Programmatic: :func:`run_lint` -> :class:`Report`.
 """
 
 from kart_tpu.analysis.core import (  # noqa: F401

@@ -43,7 +43,10 @@ def main(argv=None):
             changed_ref = arg.split("=", 1)[1] or "HEAD"
         elif arg == "--rules":
             for r in analysis.rule_catalogue():
-                print(f"{r['id']}  {r['name']}: {r['description']}")
+                print(
+                    f"{r['id']}  [{r['family']}] "
+                    f"{r['name']}: {r['description']}"
+                )
             return 0
         elif arg.startswith("-"):
             print(f"unknown option {arg!r}", file=sys.stderr)

@@ -172,17 +172,38 @@ def register(cls):
 
 
 def all_rule_classes():
-    # importing registers (KTL001-007, then the ISSUE 11 concurrency and
-    # device families — catalogue order is registration order)
+    # importing registers (KTL001-007 contract, KTL01x concurrency,
+    # KTL02x device, KTL03x taint)
     from kart_tpu.analysis import rules  # noqa: F401
     from kart_tpu.analysis import rules_concurrency  # noqa: F401
     from kart_tpu.analysis import rules_device  # noqa: F401
+    from kart_tpu.analysis import rules_taint  # noqa: F401
 
     return list(_RULE_CLASSES)
 
 
+def rule_family(rule_id):
+    """Rule family from the id's numeric band: KTL00x contract, KTL01x
+    concurrency, KTL02x device, KTL03x taint; KTL000/KTL099 framework."""
+    n = int(rule_id[3:])
+    if n in (0, 99):
+        return "framework"
+    if n < 10:
+        return "contract"
+    if n < 20:
+        return "concurrency"
+    if n < 30:
+        return "device"
+    if n < 40:
+        return "taint"
+    return "other"
+
+
 def rule_catalogue():
-    """[{id, name, description}] for every registered rule plus KTL000."""
+    """[{id, name, description, family}] for every registered rule plus
+    KTL000/KTL099, in numeric KTL order (registration order interleaves
+    families, which made ``--rules`` unreadable once four families
+    existed)."""
     cat = [
         {
             "id": SUPPRESSION_RULE_ID,
@@ -205,6 +226,9 @@ def rule_catalogue():
         cat.append(
             {"id": cls.id, "name": cls.name, "description": cls.description}
         )
+    for entry in cat:
+        entry["family"] = rule_family(entry["id"])
+    cat.sort(key=lambda e: int(e["id"][3:]))
     return cat
 
 

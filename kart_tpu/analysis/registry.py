@@ -395,6 +395,147 @@ DEVICE_SEAMS = {
 }
 
 # ---------------------------------------------------------------------------
+# KTL030-034 — the untrusted-input (taint) surface
+# ---------------------------------------------------------------------------
+
+#: every function whose inputs are attacker-controlled wire bytes or
+#: wire-derived values. The dataflow engine (analysis/dataflow.py) seeds
+#: taint from these declarations and tracks it to the KTL030-034 sinks.
+#: Keys are "repo-relative-path::qualname"; each entry declares where the
+#: taint enters:
+#:
+#:   "params"        parameter names carrying untrusted bytes/values
+#:   "attrs"         dotted ``self.X`` attributes that are untrusted
+#:                   (request handlers: headers / path / body stream)
+#:   "calls"         call names whose *results* are untrusted (peer
+#:                   responses fetched inside the function)
+#:   "kind"          the wire surface it belongs to (docs/ANALYSIS.md §5)
+#:   "error"         the declared escape type: the only exception a
+#:                   crafted payload may raise out of the function (None =
+#:                   the parser is tolerant and must not raise at all)
+#:   "fuzz"          True = the decoder has a pure bytes->value shape and
+#:                   must be covered by the registry-driven prefix-fuzz
+#:                   harness (tests/test_wire_fuzz.py) — a new entry with
+#:                   fuzz=True fails that test until it gets an adapter
+#:   "consume_exact" True = KTL033: a registered versioned wire decoder
+#:                   that must consume its payload exactly or raise (the
+#:                   canonical-bytes/ETag-aliasing contract, PR 14)
+#:
+#: KTL030's finalize round-trips this table against the tree: an entry
+#: naming no live function, or a param/attr its signature doesn't have,
+#: is itself a finding (tamper-tested like KTL001/KTL003/KTL014).
+TAINT_SOURCES = {
+    # tile/stream payload bytes (docs/TILES.md §4-§5)
+    "kart_tpu/tiles/streams.py::varint_decode": {
+        "kind": "tile-payload", "params": ("data",),
+        "error": "TileEncodeError", "fuzz": True,
+    },
+    "kart_tpu/tiles/streams.py::bitunpack": {
+        "kind": "tile-payload", "params": ("data",),
+        "error": "TileEncodeError",
+    },
+    "kart_tpu/tiles/streams.py::decode_stream": {
+        "kind": "tile-payload", "params": ("data",),
+        "error": "TileEncodeError", "fuzz": True, "consume_exact": True,
+    },
+    "kart_tpu/tiles/streams.py::decode_bytes_stream": {
+        "kind": "tile-payload", "params": ("data",),
+        "error": "TileEncodeError", "fuzz": True,
+    },
+    "kart_tpu/tiles/encode.py::decode_bin_layer": {
+        "kind": "tile-payload", "params": ("data",),
+        "error": "TileEncodeError", "fuzz": True,
+    },
+    "kart_tpu/tiles/encode.py::decode_ktb2_layer": {
+        "kind": "tile-payload", "params": ("data",),
+        "error": "TileEncodeError", "fuzz": True,
+    },
+    "kart_tpu/tiles/encode.py::decode_props_layer": {
+        "kind": "tile-payload", "params": ("data",),
+        "error": "TileEncodeError", "fuzz": True,
+    },
+    "kart_tpu/tiles/encode.py::decode_mvt_layer": {
+        "kind": "tile-payload", "params": ("data",),
+        "error": "TileEncodeError", "fuzz": True,
+    },
+    "kart_tpu/tiles/encode.py::parse_payload": {
+        "kind": "tile-payload", "params": ("data",),
+        "error": "TileEncodeError", "fuzz": True, "consume_exact": True,
+    },
+    # pack-stream reads (ROBUSTNESS.md §2)
+    "kart_tpu/transport/pack.py::read_pack": {
+        "kind": "pack-stream", "params": ("fileobj",),
+        "error": "PackFormatError", "fuzz": True,
+    },
+    # HTTP request bodies / query params / headers (docs/SERVING.md)
+    "kart_tpu/transport/http.py::read_framed": {
+        "kind": "http-body", "params": ("fp",),
+        "error": "HttpTransportError", "fuzz": True,
+    },
+    "kart_tpu/transport/http.py::KartRequestHandler._read_body": {
+        "kind": "http-body", "attrs": ("self.headers", "self.rfile"),
+        "error": None,
+    },
+    "kart_tpu/transport/http.py::KartRequestHandler._read_body_spooled": {
+        "kind": "http-body", "attrs": ("self.headers", "self.rfile"),
+        "error": None,
+    },
+    "kart_tpu/transport/http.py::KartRequestHandler._handle_tile": {
+        "kind": "http-query", "params": ("path",),
+        "attrs": ("self.headers",), "error": None,
+    },
+    "kart_tpu/transport/http.py::KartRequestHandler._handle_query": {
+        "kind": "http-query", "attrs": ("self.path", "self.headers"),
+        "error": None,
+    },
+    "kart_tpu/transport/protocol.py::error_attrs_from_wire": {
+        "kind": "http-body", "params": ("body",), "error": None,
+    },
+    # stdio frame fields (ROBUSTNESS.md §1)
+    "kart_tpu/transport/stdio.py::serve_stdio": {
+        "kind": "stdio-frame", "params": ("in_fp",),
+        "error": "StdioTransportError",
+    },
+    # event-log lines (docs/EVENTS.md §2: torn/corrupt lines are dropped,
+    # never raised)
+    "kart_tpu/events/log.py::_parse_lines": {
+        "kind": "event-log", "params": ("raw",), "error": None, "fuzz": True,
+    },
+    # peer-cache fill responses (docs/FLEET.md §4)
+    "kart_tpu/fleet/peercache.py::_fetch_validated": {
+        "kind": "peer-fill", "calls": ("urlopen",), "error": None,
+    },
+    # query params arriving over HTTP (docs/QUERY.md §5)
+    "kart_tpu/query/scan.py::parse_bbox": {
+        "kind": "http-query", "params": ("text",),
+        "error": "QueryError", "fuzz": True,
+    },
+}
+
+#: the sanitizer surface the taint engine recognises beyond inline
+#: bounds-check-then-raise guards. "ceilings" are the declared constants
+#: tainted sizes must be compared against (a ceiling nothing references
+#: any more is a finding); "validators" are functions whose call marks the
+#: argument validated (they raise on anything malformed — a declared
+#: validator nothing calls is a finding). Both legs are round-tripped by
+#: KTL030/KTL034's finalize, tamper-tested like KTL001/KTL003.
+SANITIZERS = {
+    "ceilings": {
+        "kart_tpu/tiles/encode.py::MAX_DECODE_ROWS": (
+            "decompression-bomb ceiling: every decoded row/feature count "
+            "a payload declares is capped here before allocation"
+        ),
+    },
+    "validators": {
+        "kart_tpu/core/refs.py::check_ref_format": (
+            "git check_refname_format subset: rejects control bytes, "
+            "traversal and lock/debris-shaped names before a ref touches "
+            "the filesystem"
+        ),
+    },
+}
+
+# ---------------------------------------------------------------------------
 # KTL007 — bench record keys and where they must be asserted
 # ---------------------------------------------------------------------------
 

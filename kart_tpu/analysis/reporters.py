@@ -5,10 +5,11 @@ The JSON document shape (``kart lint -o json``) is a public, versioned
 contract — tests/test_analysis.py pins it::
 
     {
-      "version": 2,
+      "version": 3,
       "ok": true|false,
       "files_scanned": <int>,
-      "rules": [{"id": "KTL001", "name": "...", "description": "..."}, ...],
+      "rules": [{"id": "KTL001", "name": "...", "description": "...",
+                 "family": "contract"}, ...],
       "findings": [
         {"rule": "KTL004", "path": "kart_tpu/x.py", "line": 10,
          "col": 4, "message": "..."},
@@ -18,9 +19,10 @@ contract — tests/test_analysis.py pins it::
                   "rules": {"KTL001": <float>, ...}}
     }
 
-Findings are sorted by (path, line, col, rule); ``version`` only changes
-with a breaking shape change (v1 -> v2 added ``timings``, ISSUE 11 — the
-per-rule wall-clock that keeps the <5s tier-1 bound attributable).
+Findings are sorted by (path, line, col, rule); rules by numeric KTL id.
+``version`` only changes with a breaking shape change (v1 -> v2 added
+``timings``, ISSUE 11 — the per-rule wall-clock that keeps the <5s tier-1
+bound attributable; v2 -> v3 added the per-rule ``family`` band, ISSUE 19).
 
 The SARIF document (``kart lint -o sarif``) targets the 2.1.0 schema so
 findings annotate PRs in any SARIF-aware CI viewer; its shape is pinned by
@@ -29,7 +31,7 @@ the golden file tests/golden/lint/expected.sarif.json.
 
 import json
 
-JSON_SCHEMA_VERSION = 2
+JSON_SCHEMA_VERSION = 3
 
 SARIF_SCHEMA = "https://json.schemastore.org/sarif-2.1.0.json"
 SARIF_VERSION = "2.1.0"
@@ -85,6 +87,7 @@ def to_sarif(report, indent=None):
             "id": r["id"],
             "name": r["name"],
             "shortDescription": {"text": r["description"]},
+            "properties": {"family": r["family"]},
         }
         for r in report.rules
     ]

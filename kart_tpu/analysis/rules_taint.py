@@ -1,0 +1,267 @@
+"""KTL030-series: wire-taint rules (docs/ANALYSIS.md §5).
+
+Each rule is one shipped-and-fixed crafted-payload bug shape from the
+PR 14/15 review rounds, mechanized: the dataflow engine
+(:mod:`kart_tpu.analysis.dataflow`) runs once per file over the shared
+parse and tags events with the rule that owns them; the rules here just
+claim their events and add the registry round-trip checks, so the whole
+family costs one pass.
+
+KTL030  tainted length reaches an allocation sink uncapped (RLE bomb)
+KTL031  tainted lengths aggregated in a wrapping dtype (int64 lens.sum())
+KTL032  tainted bytes/offsets hit struct/slice without a length precheck
+KTL033  versioned wire decoders must consume-exactly-or-raise
+KTL034  tainted ref/path names reach the filesystem unvalidated
+"""
+
+import ast
+
+from kart_tpu.analysis import dataflow, interproc, registry
+from kart_tpu.analysis.core import (
+    Finding,
+    Rule,
+    dotted_name,
+    register,
+)
+
+_REGISTRY_REL = "kart_tpu/analysis/registry.py"
+
+
+def _registry_finding(project, rule, key, message):
+    """A finding anchored at the registry line declaring ``key``."""
+    line = 1
+    ctx = project.context_for(_REGISTRY_REL)
+    if ctx is not None:
+        for node in ctx.nodes:
+            if isinstance(node, ast.Constant) and node.value == key:
+                line = node.lineno
+                break
+    return Finding(rule, _REGISTRY_REL, line, 0, message)
+
+
+class _TaintRule(Rule):
+    """Shared claim-my-events plumbing for the KTL03x dataflow rules."""
+
+    def visit_file(self, ctx):
+        return [
+            ctx.finding(self.id, node, msg)
+            for rule, node, msg in dataflow.file_taint(ctx)["events"]
+            if rule == self.id
+        ]
+
+    def finalize(self, project):
+        return [
+            Finding(self.id, rel, node.lineno, node.col_offset, msg)
+            for rule, rel, node, msg in dataflow.project_taint(project)
+            if rule == self.id
+        ]
+
+
+@register
+class TaintAllocationRule(_TaintRule):
+    id = "KTL030"
+    name = "tainted-alloc"
+    description = (
+        "a wire-derived length reaches an allocation-shaped sink "
+        "(np.repeat/zeros/frombuffer count, bytes(n), b*n, range(n)) "
+        "without a ceiling check on every path — the RLE-bomb shape; "
+        "also round-trips registry.TAINT_SOURCES and declared ceilings "
+        "against the tree"
+    )
+
+    def __init__(self):
+        # one instance lives per run: this is the family's run boundary
+        dataflow.reset_stats()
+
+    def finalize(self, project):
+        out = super().finalize(project)
+        model = interproc.project_model(project)
+        for key, entry in sorted(registry.TAINT_SOURCES.items()):
+            problems = []
+            info = model.functions.get(key)
+            if info is None:
+                problems.append("names no live function")
+            else:
+                a = info.node.args
+                sig = {
+                    p.arg
+                    for p in (
+                        list(getattr(a, "posonlyargs", []))
+                        + list(a.args)
+                        + list(a.kwonlyargs)
+                    )
+                }
+                for p in entry.get("params", ()):
+                    if p not in sig:
+                        problems.append(
+                            f"param `{p}` is not in its signature"
+                        )
+                for attr in entry.get("attrs", ()):
+                    if not attr.startswith("self."):
+                        problems.append(
+                            f"attr `{attr}` must be `self.`-rooted"
+                        )
+                    elif info.cls is None:
+                        problems.append(
+                            f"attr `{attr}` declared on a non-method"
+                        )
+                if not (
+                    entry.get("params")
+                    or entry.get("attrs")
+                    or entry.get("calls")
+                ):
+                    problems.append(
+                        "declares no params/attrs/calls — it can never fire"
+                    )
+            for why in problems:
+                out.append(
+                    _registry_finding(
+                        project, self.id, key,
+                        f"stale TAINT_SOURCES entry `{key}`: {why} — "
+                        "fix the declaration or delete it",
+                    )
+                )
+        for key in sorted(registry.SANITIZERS["ceilings"]):
+            rel, name = key.split("::", 1)
+            ctx = project.context_for(rel)
+            defined = False
+            if ctx is not None:
+                for stmt in ctx.tree.body:
+                    if isinstance(stmt, ast.Assign) and any(
+                        getattr(t, "id", None) == name
+                        for t in stmt.targets
+                    ):
+                        defined = True
+                    elif isinstance(stmt, ast.AnnAssign) and (
+                        getattr(stmt.target, "id", None) == name
+                    ):
+                        defined = True
+            if not defined:
+                out.append(
+                    _registry_finding(
+                        project, self.id, key,
+                        f"stale ceiling `{key}`: no module-level "
+                        "definition in the declared file",
+                    )
+                )
+                continue
+            fired = any(
+                isinstance(node, ast.Name)
+                and node.id == name
+                and isinstance(node.ctx, ast.Load)
+                for c in project.contexts
+                for node in c.nodes
+            )
+            if not fired:
+                out.append(
+                    _registry_finding(
+                        project, self.id, key,
+                        f"declared ceiling `{key}` is never referenced — "
+                        "a sanitizer nothing fires",
+                    )
+                )
+        return out
+
+
+@register
+class TaintWrappingSumRule(_TaintRule):
+    id = "KTL031"
+    name = "tainted-wrapping-sum"
+    description = (
+        "wire-derived lengths aggregated in a wrapping dtype "
+        "(numpy .sum()/.prod() is int64) before a size decision — the "
+        "dict-length overflow shape; use a non-wrapping Python sum or "
+        "bound the elements first"
+    )
+
+
+@register
+class TaintStructAccessRule(_TaintRule):
+    id = "KTL032"
+    name = "tainted-struct-access"
+    description = (
+        "wire bytes reach struct.unpack / a slice or shift with a "
+        "wire-derived bound without a remaining-length precheck — the "
+        "truncated-varint shape: malformed input must raise the format's "
+        "declared error, not struct.error or silent truncation"
+    )
+
+
+@register
+class ConsumeExactRule(Rule):
+    id = "KTL033"
+    name = "decoder-consume-exact"
+    description = (
+        "a decoder registered for a versioned wire format (TAINT_SOURCES "
+        "`consume_exact`) must consume its payload exactly or raise a "
+        "consumed-vs-declared mismatch — trailing garbage aliases ETags "
+        "and breaks canonical bytes"
+    )
+
+    def visit_file(self, ctx):
+        out = []
+        exact = {
+            qual
+            for qual, entry in dataflow.sources_for(ctx).items()
+            if entry["consume_exact"]
+        }
+        if not exact:
+            return out
+        for f in interproc.file_summary(ctx).functions:
+            tail = f.qual.split("::", 1)[1]
+            if tail in exact and not dataflow.consume_exact_ok(
+                ctx, f.node
+            ):
+                out.append(
+                    ctx.finding(
+                        self.id, f.node,
+                        f"wire decoder `{f.name}` is declared "
+                        "consume-exact but never raises on a "
+                        "consumed-vs-declared length mismatch",
+                    )
+                )
+        return out
+
+
+@register
+class TaintPathRule(_TaintRule):
+    id = "KTL034"
+    name = "tainted-name-to-fs"
+    description = (
+        "a wire-derived ref/path/dataset name reaches a filesystem or "
+        "ref-store operation without a declared validator "
+        "(check_ref_format & friends); also round-trips "
+        "registry.SANITIZERS validators against the tree"
+    )
+
+    def finalize(self, project):
+        out = super().finalize(project)
+        model = interproc.project_model(project)
+        for key in sorted(registry.SANITIZERS["validators"]):
+            info = model.functions.get(key)
+            if info is None:
+                out.append(
+                    _registry_finding(
+                        project, self.id, key,
+                        f"stale SANITIZERS validator `{key}`: names no "
+                        "live function",
+                    )
+                )
+                continue
+            name = key.rsplit(".", 1)[-1].split("::")[-1]
+            called = any(
+                isinstance(node, ast.Call)
+                and (dotted_name(node.func) or "").rsplit(".", 1)[-1]
+                == name
+                for c in project.contexts
+                for node in c.nodes
+            )
+            if not called:
+                out.append(
+                    _registry_finding(
+                        project, self.id, key,
+                        f"declared validator `{key}` is never called — "
+                        "a sanitizer nothing fires",
+                    )
+                )
+        return out

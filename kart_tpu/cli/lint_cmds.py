@@ -4,7 +4,11 @@ With no PATHS: the full tree (kart_tpu/ + bench.py) including the
 cross-file registry round-trip checks; with PATHS (files or directories):
 per-file checks only — the fast pre-commit mode. ``--changed [REF]`` lints
 only files touched vs a git ref (default HEAD) — the diff-driven CI entry
-point. Exit 0 = clean."""
+point. ``--install-hook`` writes the fail-closed pre-commit hook. Exit
+0 = clean."""
+
+import os
+import stat
 
 import click
 
@@ -34,15 +38,28 @@ from kart_tpu.cli import cli
     "--rules",
     "list_rules",
     is_flag=True,
-    help="List the rule catalogue and exit",
+    help="List the rule catalogue (numeric KTL order, with family) and exit",
 )
-def lint(paths, fmt, changed_ref, list_rules):
+@click.option(
+    "--install-hook",
+    "install_hook",
+    is_flag=True,
+    help="Write the fail-closed pre-commit hook (`kart lint --changed`) "
+    "into .git/hooks/pre-commit and exit; refuses to clobber a hook it "
+    "did not write",
+)
+def lint(paths, fmt, changed_ref, list_rules, install_hook):
     """Check the tree against the repo's cross-cutting contracts."""
     from kart_tpu import analysis
 
     if list_rules:
         for r in analysis.rule_catalogue():
-            click.echo(f"{r['id']}  {r['name']}: {r['description']}")
+            click.echo(
+                f"{r['id']}  [{r['family']}] {r['name']}: {r['description']}"
+            )
+        return
+    if install_hook:
+        click.echo(_install_pre_commit_hook(analysis.repo_root()))
         return
     if changed_ref is not None:
         if paths:
@@ -67,3 +84,43 @@ def lint(paths, fmt, changed_ref, list_rules):
         click.echo(analysis.to_text(report))
     if not report.ok:
         raise SystemExit(1)
+
+
+#: the marker is the clobber contract: a hook carrying it was written by
+#: us and may be rewritten in place; anything else is the user's and is
+#: never touched.
+HOOK_MARKER = "installed by `kart lint --install-hook`"
+
+HOOK_SCRIPT = f"""#!/bin/sh
+# pre-commit hook {HOOK_MARKER} (docs/ANALYSIS.md).
+# Lints the files this commit touches. Any finding — or the linter
+# failing to run at all — blocks the commit: fail closed.
+exec python -m kart_tpu.analysis --changed HEAD
+"""
+
+
+def _install_pre_commit_hook(root):
+    hooks_dir = os.path.join(root, ".git", "hooks")
+    if not os.path.isdir(os.path.join(root, ".git")):
+        raise click.ClickException(f"{root} is not a git repository")
+    path = os.path.join(hooks_dir, "pre-commit")
+    if os.path.exists(path):
+        with open(path, encoding="utf-8") as f:
+            existing = f.read()
+        if HOOK_MARKER not in existing:
+            raise click.ClickException(
+                f"{path} exists and was not written by `kart lint "
+                "--install-hook` — refusing to clobber it; chain "
+                "`python -m kart_tpu.analysis --changed HEAD` from your "
+                "hook instead"
+            )
+        if existing == HOOK_SCRIPT:
+            return f"pre-commit hook already current: {path}"
+    os.makedirs(hooks_dir, exist_ok=True)
+    with open(path, "w", encoding="utf-8") as f:
+        f.write(HOOK_SCRIPT)
+    st = os.stat(path)
+    os.chmod(
+        path, st.st_mode | stat.S_IXUSR | stat.S_IXGRP | stat.S_IXOTH
+    )
+    return f"pre-commit hook installed: {path}"

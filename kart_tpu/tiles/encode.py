@@ -493,20 +493,33 @@ def decode_mvt_layer(data):
     def _count_varints(buf):
         return int(np.count_nonzero(np.frombuffer(buf, np.uint8) < 0x80))
 
+    def _msg(value, what):
+        # wire-type confusion guard: a crafted key byte can flip a
+        # length-delimited field to varint, handing an int to code that
+        # expects bytes — that must be the declared error, not TypeError
+        if not isinstance(value, (bytes, bytearray, memoryview)):
+            raise TileEncodeError(f"MVT {what} has non-message wire type")
+        return value
+
     layers = [v for f, v in walk(data) if f == 3]
     if len(layers) != 1:
         raise TileEncodeError(f"MVT tile holds {len(layers)} layers, not 1")
     out = {"features": []}
-    for field, value in walk(layers[0]):
+    for field, value in walk(_msg(layers[0], "layer")):
         if field == 1:
-            out["name"] = value.decode()
+            try:
+                out["name"] = _msg(value, "layer name").decode()
+            except UnicodeDecodeError:
+                raise TileEncodeError(
+                    "MVT layer name is not valid UTF-8"
+                ) from None
         elif field == 5:
             out["extent"] = value
         elif field == 15:
             out["version"] = value
         elif field == 2:
             feat = {}
-            for ff, fv in walk(value):
+            for ff, fv in walk(_msg(value, "feature")):
                 if ff == 1:
                     # read_uvarint admits 10-byte varints up to 2**70-1;
                     # np.uint64() would raise OverflowError past 2**64.
@@ -523,7 +536,7 @@ def decode_mvt_layer(data):
                 elif ff == 3:
                     feat["type"] = fv
                 elif ff == 4:
-                    feat["geometry"] = geometry(fv)
+                    feat["geometry"] = geometry(_msg(fv, "geometry"))
             out["features"].append(feat)
     return out
 

@@ -470,7 +470,9 @@ def decode_bytes_stream(data, count, pos=0):
         )
     uniques = []
     for n in lens:
-        uniques.append(bytes(data[pos : pos + int(n)]))
+        uniques.append(
+            bytes(data[pos : pos + int(n)])  # kart: noqa(KTL032): each n >= 0 (min precheck above) so n <= total, and pos + total <= len(data) was just enforced
+        )
         pos += int(n)
     idx, pos = decode_stream(data, count, "i8", pos)
     if len(idx) and (int(idx.min()) < 0 or int(idx.max()) >= n_unique):

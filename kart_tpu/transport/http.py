@@ -232,7 +232,17 @@ def read_framed(fp):
     (n,) = _HEADER_LEN.unpack(raw)
     if n > 1 << 24:
         raise HttpTransportError("Framed header implausibly large")
-    header = json.loads(fp.read(n).decode())
+    body = fp.read(n)
+    if len(body) != n:
+        raise HttpTransportError("Truncated framed header", transient=True)
+    try:
+        header = json.loads(body.decode())
+    except (ValueError, UnicodeDecodeError):
+        # the declared escape for crafted bytes is HttpTransportError;
+        # json/unicode errors leaking here broke the wire-fuzz contract
+        raise HttpTransportError("Malformed framed header") from None
+    if not isinstance(header, dict):
+        raise HttpTransportError("Malformed framed header")
     return header, fp
 
 

@@ -99,7 +99,15 @@ def read_pack(fileobj, *, mid_stream=False, consumed=None):
         obj_type = _CODE_TO_TYPE.get(code)
         if obj_type is None:
             raise PackFormatError(f"Bad object type code: {code}")
-        content = zlib.decompress(pull(deflate_len))
+        deflated = pull(deflate_len)
+        try:
+            content = zlib.decompress(deflated)
+        except zlib.error:
+            # the declared escape for crafted bytes is PackFormatError;
+            # zlib.error leaking here broke the wire-fuzz contract
+            raise PackFormatError(
+                "Corrupt deflate stream in packstream"
+            ) from None
         if len(content) != raw_len:
             raise PackFormatError("Object length mismatch in packstream")
         if consumed is not None:

@@ -1,0 +1,30 @@
+"""Golden KTL030: wire-derived lengths reaching allocation sinks."""
+
+import numpy as np
+
+MAX_RUNS = 1 << 16
+
+
+def decode_runs(data):
+    """taint-source: data"""
+    n = int(data[0])
+    return np.zeros(n)  # finding: uncapped wire length allocates
+
+
+def decode_runs_capped(data):
+    """taint-source: data"""
+    n = int(data[0])
+    if n > MAX_RUNS:
+        raise ValueError("run count exceeds the decode ceiling")
+    return np.zeros(n)  # capped on every path: clean
+
+
+def decode_runs_waived(data):
+    """taint-source: data"""
+    n = int(data[0])
+    return np.zeros(n)  # kart: noqa(KTL030): golden fixture — demonstrates a rationale-suppressed uncapped allocation
+
+
+def host_sized(count):
+    n = int(count)  # not a declared source: clean
+    return np.zeros(n)

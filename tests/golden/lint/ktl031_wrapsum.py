@@ -1,0 +1,21 @@
+"""Golden KTL031: wire lengths aggregated in a wrapping dtype."""
+
+import numpy as np
+
+
+def total_wrapping(data):
+    """taint-source: data"""
+    lens = np.frombuffer(data, dtype=np.uint32)
+    return int(lens.sum())  # finding: int64 total wraps past 2**63
+
+
+def total_nonwrapping(data):
+    """taint-source: data"""
+    lens = np.frombuffer(data, dtype=np.uint32)
+    return sum(int(x) for x in lens)  # arbitrary-precision ints: clean
+
+
+def total_waived(data):
+    """taint-source: data"""
+    lens = np.frombuffer(data, dtype=np.uint32)
+    return int(lens.sum())  # kart: noqa(KTL031): golden fixture — demonstrates a rationale-suppressed wrapping total

@@ -1,0 +1,29 @@
+"""Golden KTL032: wire bytes hit struct/slice without a length precheck."""
+
+import struct
+
+
+def header_unchecked(data):
+    """taint-source: data"""
+    (count,) = struct.unpack_from("<I", data, 0)  # finding: may raise struct.error
+    return count
+
+
+def header_checked(data):
+    """taint-source: data"""
+    if len(data) < 4:
+        raise ValueError("truncated header")
+    (count,) = struct.unpack_from("<I", data, 0)  # precheck above: clean
+    return count
+
+
+def window_unchecked(data):
+    """taint-source: data"""
+    off = int(data[0])
+    return data[off : off + 2]  # finding: tainted slice bound, silent truncation
+
+
+def header_waived(data):
+    """taint-source: data"""
+    (count,) = struct.unpack_from("<I", data, 0)  # kart: noqa(KTL032): golden fixture — demonstrates a rationale-suppressed unchecked unpack
+    return count

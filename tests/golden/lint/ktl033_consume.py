@@ -1,0 +1,23 @@
+"""Golden KTL033: versioned wire decoders must consume exactly or raise."""
+
+
+def frame_sloppy(data):
+    """taint-consume-exact
+
+    Finding: tolerates trailing garbage, so two distinct payloads decode
+    to the same value and alias each other's ETags.
+    """
+    return data[:4]
+
+
+def frame_exact(data):
+    """taint-consume-exact"""
+    end = 4
+    if end != len(data):
+        raise ValueError("trailing bytes after frame")
+    return data[:end]
+
+
+def frame_waived(data):  # kart: noqa(KTL033): golden fixture — demonstrates a suppressed tolerant decoder
+    """taint-consume-exact"""
+    return data

@@ -1,0 +1,21 @@
+"""Golden KTL034: wire-derived names reaching the filesystem."""
+
+import os
+
+from kart_tpu.core.refs import check_ref_format
+
+
+def delete_ref_unvalidated(name):
+    """taint-source: name"""
+    os.remove(name)  # finding: traversal-shaped names reach the fs
+
+
+def delete_ref_validated(name):
+    """taint-source: name"""
+    check_ref_format(name)
+    os.remove(name)  # validated above: clean
+
+
+def delete_ref_waived(name):
+    """taint-source: name"""
+    os.remove(name)  # kart: noqa(KTL034): golden fixture — demonstrates a rationale-suppressed unvalidated ref delete

@@ -70,14 +70,20 @@ def test_suppression_with_rationale_is_honored():
 
 def test_json_reporter_schema_is_stable():
     doc = json.loads(analysis.to_json(corpus_report("ktl006_exceptions.py")))
-    assert doc["version"] == analysis.JSON_SCHEMA_VERSION == 2
+    assert doc["version"] == analysis.JSON_SCHEMA_VERSION == 3
     assert set(doc) == {
         "version", "ok", "files_scanned", "rules", "findings", "timings",
     }
     assert doc["ok"] is False
     assert doc["files_scanned"] == 1
     for rule in doc["rules"]:
-        assert set(rule) == {"id", "name", "description"}
+        assert set(rule) == {"id", "name", "description", "family"}
+        assert rule["family"] in {
+            "framework", "contract", "concurrency", "device", "taint",
+        }
+    # rules are listed in numeric KTL order (v3: stable for --rules and CI)
+    ids = [r["id"] for r in doc["rules"]]
+    assert ids == sorted(ids, key=lambda i: int(i[3:]))
     for f in doc["findings"]:
         assert set(f) == {"rule", "path", "line", "col", "message"}
         assert isinstance(f["line"], int) and f["line"] >= 1
@@ -150,8 +156,12 @@ def test_cli_lint_command_json_and_exit_code(cli_runner):
 
     r = cli_runner.invoke(cli, ["lint", "--rules"])
     assert r.exit_code == 0
-    for rule_id in ("KTL000", "KTL001", "KTL007"):
+    for rule_id in ("KTL000", "KTL001", "KTL007", "KTL030"):
         assert rule_id in r.output
+    # the catalogue prints in numeric order with the family band
+    assert "[taint]" in r.output
+    assert r.output.index("KTL007") < r.output.index("KTL010")
+    assert r.output.index("KTL021") < r.output.index("KTL030")
 
 
 def test_module_entry_point(capsys):
@@ -331,6 +341,132 @@ def test_device_seams_stale_name_fires(monkeypatch):
         f for f in analysis.run_lint().findings if f.rule == "KTL021"
     ]
     assert any("no_such_seam" in f.message for f in findings), findings
+
+
+# -- KTL030/KTL034 taint registry round-trips (tamper-tested like KTL001) ----
+
+
+def test_taint_sources_roundtrip_stale_entry_fires(monkeypatch):
+    """Registry -> code: a TAINT_SOURCES entry naming no live decoder is
+    itself a finding — the taint surface cannot silently rot."""
+    patched = dict(registry.TAINT_SOURCES)
+    patched["kart_tpu/tiles/streams.py::no_such_decoder"] = {
+        "kind": "tile-payload", "params": ("data",), "error": None,
+    }
+    monkeypatch.setattr(registry, "TAINT_SOURCES", patched)
+    messages = [
+        f.message for f in analysis.run_lint().findings if f.rule == "KTL030"
+    ]
+    assert any(
+        "no_such_decoder" in m and "no live function" in m for m in messages
+    ), messages
+
+
+def test_taint_sources_roundtrip_param_drift_fires(monkeypatch):
+    """A declared taint param its function's signature no longer has is a
+    finding (the rename-breaks-the-declaration direction)."""
+    patched = {
+        k: dict(v, params=("renamed_away",))
+        if k == "kart_tpu/tiles/streams.py::varint_decode"
+        else v
+        for k, v in registry.TAINT_SOURCES.items()
+    }
+    monkeypatch.setattr(registry, "TAINT_SOURCES", patched)
+    messages = [
+        f.message for f in analysis.run_lint().findings if f.rule == "KTL030"
+    ]
+    assert any(
+        "renamed_away" in m and "not in its signature" in m for m in messages
+    ), messages
+
+
+def test_sanitizer_ceiling_roundtrip_fires_both_legs(monkeypatch):
+    """A ceiling that doesn't exist, and one that exists but nothing
+    compares against, are both findings — a sanitizer nothing fires is
+    not a sanitizer."""
+    patched = {
+        "ceilings": {
+            **registry.SANITIZERS["ceilings"],
+            "kart_tpu/tiles/encode.py::NO_SUCH_CEILING": "gone",
+            # defined at module level in registry.py but only ever read as
+            # `registry.SANITIZERS` (an attribute, not a bare name), so the
+            # never-referenced leg fires on it
+            "kart_tpu/analysis/registry.py::SANITIZERS": "unreferenced",
+        },
+        "validators": dict(registry.SANITIZERS["validators"]),
+    }
+    monkeypatch.setattr(registry, "SANITIZERS", patched)
+    messages = [
+        f.message for f in analysis.run_lint().findings if f.rule == "KTL030"
+    ]
+    assert any(
+        "NO_SUCH_CEILING" in m and "no module-level definition" in m
+        for m in messages
+    ), messages
+    assert any(
+        "SANITIZERS" in m and "never referenced" in m for m in messages
+    ), messages
+
+
+def test_sanitizer_validator_roundtrip_fires_both_legs(monkeypatch):
+    """A validator naming no live function, and a live one nothing calls,
+    are both findings (KTL034's finalize)."""
+    patched = {
+        "ceilings": dict(registry.SANITIZERS["ceilings"]),
+        "validators": {
+            **registry.SANITIZERS["validators"],
+            "kart_tpu/core/refs.py::no_such_validator": "gone",
+            # the click command function is live but dispatched by the CLI
+            # framework — never called by bare name in the lint targets
+            "kart_tpu/cli/lint_cmds.py::lint": "never called directly",
+        },
+    }
+    monkeypatch.setattr(registry, "SANITIZERS", patched)
+    messages = [
+        f.message for f in analysis.run_lint().findings if f.rule == "KTL034"
+    ]
+    assert any(
+        "no_such_validator" in m and "no live function" in m
+        for m in messages
+    ), messages
+    assert any(
+        "lint_cmds.py::lint" in m and "never called" in m for m in messages
+    ), messages
+
+
+# -- `kart lint --install-hook` ----------------------------------------------
+
+
+def test_install_hook_writes_fail_closed_pre_commit(tmp_path, monkeypatch, cli_runner):
+    from kart_tpu.cli import cli
+    from kart_tpu.cli import lint_cmds
+
+    (tmp_path / ".git").mkdir()
+    monkeypatch.setattr(analysis, "repo_root", lambda: str(tmp_path))
+    r = cli_runner.invoke(cli, ["lint", "--install-hook"])
+    assert r.exit_code == 0, r.output
+    hook = tmp_path / ".git" / "hooks" / "pre-commit"
+    assert hook.exists()
+    assert os.access(str(hook), os.X_OK)
+    text = hook.read_text()
+    assert "--changed" in text and lint_cmds.HOOK_MARKER in text
+    # idempotent re-run: recognised as ours, reported as current
+    r = cli_runner.invoke(cli, ["lint", "--install-hook"])
+    assert r.exit_code == 0
+    assert "already current" in r.output
+
+
+def test_install_hook_refuses_to_clobber_foreign_hook(tmp_path, monkeypatch, cli_runner):
+    from kart_tpu.cli import cli
+
+    hooks = tmp_path / ".git" / "hooks"
+    hooks.mkdir(parents=True)
+    (hooks / "pre-commit").write_text("#!/bin/sh\necho my own hook\n")
+    monkeypatch.setattr(analysis, "repo_root", lambda: str(tmp_path))
+    r = cli_runner.invoke(cli, ["lint", "--install-hook"])
+    assert r.exit_code != 0
+    assert "refusing to clobber" in r.output
+    assert (hooks / "pre-commit").read_text() == "#!/bin/sh\necho my own hook\n"
 
 
 # -- KTL010/KTL012 precision regressions ------------------------------------

@@ -187,6 +187,14 @@ NEW_KEYS += [
 ]
 
 
+#: keys added by ISSUE 19 (wire-taint dataflow analyzer: the KTL030-034
+#: engine's coverage headline — function bodies analyzed in the taint
+#: pass; a drop means the declared wire surface silently shrank)
+NEW_KEYS += [
+    "lint_taint_functions_analyzed",
+]
+
+
 #: keys added by ISSUE 12 (request-scoped observability: the storm bench
 #: now also reads the *server-reported* per-verb latency quantiles from
 #: the new bucketed histograms and checks they agree with the

@@ -24,11 +24,13 @@ def test_lint_clean_at_head():
 def test_rule_catalogue_complete():
     ids = {r["id"] for r in analysis.rule_catalogue()}
     # 7 contract rules (ISSUE 4) + 5 concurrency rules + 2 device rules
-    # (ISSUE 11) + KTL000 suppression hygiene + KTL099 parse-error
+    # (ISSUE 11) + 5 taint rules (ISSUE 19) + KTL000 suppression hygiene
+    # + KTL099 parse-error
     assert ids == (
         {f"KTL00{i}" for i in range(8)}
         | {"KTL010", "KTL011", "KTL012", "KTL013", "KTL014"}
         | {"KTL020", "KTL021"}
+        | {"KTL030", "KTL031", "KTL032", "KTL033", "KTL034"}
         | {"KTL099"}
     )
 

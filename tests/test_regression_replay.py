@@ -100,11 +100,98 @@ def test_reverted_pr7_fill_token_abandon_fires_ktl013(tmp_path):
     assert any("got" in f.message for f in hits), hits
 
 
+def test_reverted_pr15_rle_run_cap_fires_ktl030(tmp_path):
+    """PR 15 round 2: RLE run lengths were repeated into an output array
+    before any cap — four crafted runs of 2**62 sent ``np.repeat`` off on
+    a ~2**64-element expansion (the int64 total wrapped back to ``count``
+    so the sum check passed). Reverting the per-run cap must fire the
+    tainted-alloc rule on the ``np.repeat`` sink."""
+    fixed = _read("kart_tpu/tiles/streams.py")
+    reverted = _surgically(
+        fixed,
+        [
+            (
+                "        # per-run cap before the wrapping-prone sum: "
+                "crafted lengths like\n"
+                "        # four runs of 2**62 overflow an int64 total "
+                "back to `count` and\n"
+                "        # would send np.repeat off on a ~2**64-element "
+                "expansion\n"
+                "        if n_runs and (int(lens.min()) <= 0 or "
+                "int(lens.max()) > count):\n"
+                "            raise TileEncodeError(\n"
+                '                f"RLE run length outside [1, {count}]"\n'
+                "            )\n",
+                "",
+            ),
+        ],
+    )
+    report = _lint_source(tmp_path, "streams.py", reverted)
+    hits = [f for f in report.findings if f.rule == "KTL030"]
+    assert hits, "the reverted PR 15 RLE run cap must fire KTL030"
+    assert any("np.repeat" in f.message for f in hits), hits
+
+
+def test_reverted_pr15_wrapping_dict_sum_fires_ktl031(tmp_path):
+    """PR 15 round 3: the dictionary-stream string lengths were totalled
+    with ``lens.sum()`` — an int64 that wraps, so crafted lengths summing
+    past 2**64 slipped under the truncation check. Reverting the
+    non-wrapping Python sum must fire the wrapping-aggregation rule."""
+    fixed = _read("kart_tpu/tiles/streams.py")
+    reverted = _surgically(
+        fixed,
+        [
+            (
+                "    # non-wrapping total, same as the RLE run-length "
+                "guard: crafted\n"
+                "    # lengths summing past 2**64 must not slip under "
+                "the truncation check\n"
+                "    total = sum(int(x) for x in lens)\n",
+                "    total = int(lens.sum())\n",
+            ),
+        ],
+    )
+    report = _lint_source(tmp_path, "streams.py", reverted)
+    hits = [f for f in report.findings if f.rule == "KTL031"]
+    assert hits, "the reverted PR 15 wrapping dict sum must fire KTL031"
+    assert any(".sum()" in f.message for f in hits), hits
+
+
+def test_reverted_pr14_varint_length_bound_fires_ktl032(tmp_path):
+    """PR 14 round 4: without the 10-byte bound a crafted varint longer
+    than 10 bytes shifts past bit 63 — the uint64 shift wraps and the
+    stream silently decodes to wrong values. Reverting the bound must
+    fire the struct-access rule on the unchecked shift/slice."""
+    fixed = _read("kart_tpu/tiles/streams.py")
+    reverted = _surgically(
+        fixed,
+        [
+            (
+                "    if np.any(ends - starts >= 10):\n"
+                '        raise TileEncodeError'
+                '("Varint value longer than 10 bytes")\n',
+                "",
+            ),
+        ],
+    )
+    report = _lint_source(tmp_path, "streams.py", reverted)
+    hits = [f for f in report.findings if f.rule == "KTL032"]
+    assert hits, "the reverted PR 14 varint length bound must fire KTL032"
+
+
 @pytest.mark.parametrize(
-    "rel", ["kart_tpu/core/packs.py", "kart_tpu/transport/service.py"]
+    "rel",
+    [
+        "kart_tpu/core/packs.py",
+        "kart_tpu/transport/service.py",
+        "kart_tpu/tiles/streams.py",
+        "kart_tpu/tiles/encode.py",
+    ],
 )
 def test_fixed_sources_stay_clean_of_the_replayed_rules(rel):
     report = analysis.run_lint([os.path.join(REPO_ROOT, rel)])
     assert not [
-        f for f in report.findings if f.rule in ("KTL012", "KTL013")
+        f
+        for f in report.findings
+        if f.rule in ("KTL012", "KTL013", "KTL030", "KTL031", "KTL032")
     ], analysis.to_text(report)
