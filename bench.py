@@ -2742,7 +2742,7 @@ def tiles_main():
     hot path), the block-pruning evidence (a cold tile must fault only
     boundary/in blocks), byte-identity cold vs cached, and a
     concurrent-client tile storm against a real `kart serve` process.
-    Recorded in BENCH_r10.json (docs/TILES.md §6). Prints the in-process
+    Recorded in BENCH_r10.json (docs/TILES.md §7). Prints the in-process
     record before the storm so a watchdog kill still salvages the
     throughput half."""
     import sys
@@ -2866,11 +2866,26 @@ def tiles_main():
                 repo, oid, "synth", z, x, y, layers="mvt"
             )
             layer_bytes["mvt"] += len(_parse_payload(payload)[1]["mvt"])
+        # geom: real ring geometry off the sidecar vertex column, per-zoom
+        # simplified (docs/TILES.md §6) — box features, so bytes/feature
+        # should land near mvt's (same shapes, real command encoding)
+        layer_bytes["geom"] = 0
+        t0 = time.perf_counter()
+        for z, x, y in sample:
+            payload, _, _ = tiles.serve_tile(
+                repo, oid, "synth", z, x, y, layers="geom"
+            )
+            layer_bytes["geom"] += len(_parse_payload(payload)[1]["geom"])
+        geom_s = time.perf_counter() - t0
         ft = max(1, features_total)
         record["tile_bytes_per_feature_ktb1"] = round(layer_bytes["bin"] / ft, 2)
         record["tile_bytes_per_feature_ktb2"] = round(layer_bytes["ktb2"] / ft, 2)
         record["tile_bytes_per_feature_mvt"] = round(layer_bytes["mvt"] / ft, 2)
+        record["tile_bytes_per_feature_geom"] = round(
+            layer_bytes["geom"] / ft, 2
+        )
         record["tiles_per_sec_ktb2_cold"] = round(n_tiles / ktb2_s, 2)
+        record["tiles_per_sec_geom_cold"] = round(n_tiles / geom_s, 2)
         record["tile_ktb2_vs_ktb1"] = round(
             layer_bytes["bin"] / max(1, layer_bytes["ktb2"]), 2
         )
@@ -3945,6 +3960,23 @@ def query_main():
         record["query_scan_block_prune_fraction"] = round(prune_frac, 4)
         record["query_scan_prune_meets_95pct"] = prune_frac >= 0.95
         record["query_scan_prune_speedup"] = round(unpruned_s / pruned_s, 2)
+
+        # exact vs approx (docs/QUERY.md §4b): the pruned leg above ran
+        # the default exact-refine semantics; re-run with --approx to
+        # price the refine stage. Synth geometry IS its envelope (box
+        # polygons), so the counts must agree exactly.
+        run_query(repo, base, "synth", bbox=bbox, approx=True)  # warm
+        t0 = time.perf_counter()
+        approx = run_query(repo, base, "synth", bbox=bbox, approx=True)
+        approx_s = time.perf_counter() - t0
+        record["query_scan_approx_seconds"] = round(approx_s, 4)
+        record["query_scan_refine_pairs"] = stats["pairs_refined"]
+        record["query_scan_refine_overhead"] = round(
+            pruned_s / max(approx_s, 1e-9), 2
+        )
+        record["query_scan_exact_matches_approx"] = (
+            pruned["count"] == approx["count"]
+        )
         print(json.dumps(record), flush=True)
 
     # -- leg 2: the headline join kernel, host vs device ------------------
@@ -3993,6 +4025,81 @@ def query_main():
         np.array_equal(host_counts, dev_counts) and host_total == dev_total
     )
     del probe_env, build_env, host_counts, dev_counts, _Probe
+    print(json.dumps(record), flush=True)
+
+    # -- leg 2b: the exact-refine kernel, bbox-only vs host vs device -----
+    # Candidate pairs of quantized box polygons through the refine seam
+    # (docs/DEVICE.md §6): the envelope overlap every pair already passed
+    # is the baseline the exact predicates are priced against; host and
+    # device verdicts must be bit-identical.
+    from kart_tpu.diff.backend import refine_intersects
+    from kart_tpu.geom import VertexColumn, refine_pairs_host
+
+    refine_pairs = int(
+        os.environ.get("KART_BENCH_REFINE_PAIRS", 2_000_000)
+    )
+    refine_feats = 1 << 14
+
+    def _box_col(seed):
+        rng = np.random.default_rng(seed)
+        cx = rng.integers(-170, 170, refine_feats) * 100_000
+        cy = rng.integers(-80, 80, refine_feats) * 100_000
+        w = rng.integers(1_000, 200_000, refine_feats)
+        h = rng.integers(1_000, 200_000, refine_feats)
+        x = np.stack([cx - w, cx + w, cx + w, cx - w], 1).ravel()
+        y = np.stack([cy - h, cy - h, cy + h, cy + h], 1).ravel()
+        n = refine_feats
+        col = VertexColumn(
+            np.full(n, 3, np.uint8),
+            np.arange(n + 1, dtype=np.int64),
+            np.arange(n + 1, dtype=np.int64) * 4,
+            x.astype(np.int32),
+            y.astype(np.int32),
+        )
+        env = np.stack([cx - w, cy - h, cx + w, cy + h], 1)
+        return col, env
+
+    (col_a, box_a), (col_b, box_b) = _box_col(1), _box_col(2)
+    rng = np.random.default_rng(3)
+    ia = rng.integers(0, refine_feats, refine_pairs).astype(np.int64)
+    ib = rng.integers(0, refine_feats, refine_pairs).astype(np.int64)
+    t0 = time.perf_counter()
+    ea, eb = box_a[ia], box_b[ib]
+    bbox_hits = ~(
+        (ea[:, 2] < eb[:, 0]) | (eb[:, 2] < ea[:, 0])
+        | (ea[:, 3] < eb[:, 1]) | (eb[:, 3] < ea[:, 1])
+    )
+    bbox_s = time.perf_counter() - t0
+    record["query_refine_pairs"] = refine_pairs
+    record["query_refine_pairs_per_sec_bbox_only"] = round(
+        refine_pairs / bbox_s
+    )
+
+    t0 = time.perf_counter()
+    host_v = refine_pairs_host(col_a, ia, col_b, ib)
+    host_s = time.perf_counter() - t0
+    record["query_refine_matches"] = int(np.count_nonzero(host_v))
+    record["query_refine_pairs_per_sec_host"] = round(refine_pairs / host_s)
+    record["query_refine_exact_vs_bbox_cost"] = round(host_s / bbox_s, 1)
+
+    os.environ["KART_DIFF_SHARDED"] = "1"
+    try:
+        refine_intersects(  # warm: compile the fixed-shape kernel
+            col_a, ia[:4096], col_b, ib[:4096], route_rows=refine_pairs
+        )
+        t0 = time.perf_counter()
+        dev_v = refine_intersects(
+            col_a, ia, col_b, ib, route_rows=refine_pairs
+        )
+        dev_s = time.perf_counter() - t0
+    finally:
+        del os.environ["KART_DIFF_SHARDED"]
+    record["query_refine_pairs_per_sec_device"] = round(refine_pairs / dev_s)
+    record["query_refine_device_vs_host"] = round(host_s / dev_s, 2)
+    record["query_refine_device_matches_host"] = bool(
+        np.array_equal(host_v, dev_v)
+    )
+    del col_a, col_b, ia, ib, host_v, dev_v, box_a, box_b
     print(json.dumps(record), flush=True)
 
     # -- leg 3: the 2-replica scatter vs a single node --------------------

@@ -103,6 +103,10 @@ ENV_VARS = {
     "KART_QUERY_PAGE_SIZE": "source",
     "KART_QUERY_SCATTER": "source",
     "KART_QUERY_CACHE": "source",
+    # geometry / exact refine (docs/QUERY.md §4b, docs/TILES.md §6)
+    "KART_GEOM_REFINE": "source",
+    "KART_GEOM_BATCH_ROWS": "source",
+    "KART_GEOM_SIMPLIFY": "source",
     # misc
     "KART_REPO": "source",
     "KART_NTV2_GRID_DIR": "source",
@@ -157,6 +161,8 @@ FAULT_POINTS = frozenset(
         "events.warm",
         "query.scan",
         "query.join",
+        "query.refine",
+        "geom.extract",
     }
 )
 
@@ -205,6 +211,12 @@ BLOCKING_ALLOW = {
         "commit must block on the one build rather than each paying it "
         "(docs/TILES.md §2); tile requests for other commits use other "
         "TileSource instances and other locks"
+    ),
+    "kart_tpu/tiles/source.py::TileSource.vertices": (
+        "the vertex-fallback build is the envelope fallback's sibling: one "
+        "O(N) blob extraction per revision under the per-source lock, so "
+        "concurrent geom-layer requests block on the one build instead of "
+        "each paying it (docs/TILES.md §6)"
     ),
 }
 
@@ -298,6 +310,12 @@ CACHE_EXEMPT_GLOBALS = {
         "a registry of per-ref FIFO queues, not cached data: correctness "
         "lives with push_file_lock; eviction only unlinks idle queues"
     ),
+    "kart_tpu/ops/blocks.py::_VERTEX_MEMO": (
+        "content-addressed, not commit/ref-addressed: the key is the sha1 "
+        "of the decoded section's own bytes, so two different byte strings "
+        "can never share an entry and no ref move can stale one — the LRU "
+        "bound alone reclaims memory (docs/FORMAT.md §3.4)"
+    ),
     "kart_tpu/events/__init__.py::_EMITTERS": (
         "a registry of per-repo event emitters, not cached data: the "
         "announced history and tips live in the on-disk event log, and a "
@@ -349,6 +367,23 @@ DEVICE_SEAMS = {
             # join_bbox_counts is the query engine's spatial-join batch
             # seam: same gating ladder as project_envelopes
             "join_bbox_counts",
+            # refine_intersects is the exact-refine seam (ISSUE 20): host
+            # numpy predicates by default, shard_map when the row count
+            # clears the sharding floor, host fallback mid-call
+            "refine_intersects",
+            # the host overlap predicate the join counts with — the refine
+            # stage recomputes it to recover the exact pair set the counts
+            # hold (pure numpy, no device dependency)
+            "_join_overlap_np",
+        }
+    ),
+    "kart_tpu/diff/device_batch.py": frozenset(
+        {
+            # the pair packer is pure numpy (gathers from the cached
+            # segment table into padded slabs) — host refine evaluates
+            # the very same slabs the device kernel consumes, which is
+            # half of the bit-identity argument (docs/DEVICE.md §6)
+            "pack_geom_pairs",
         }
     ),
     "kart_tpu/ops/bbox.py": frozenset(
@@ -461,6 +496,11 @@ TAINT_SOURCES = {
     "kart_tpu/tiles/encode.py::parse_payload": {
         "kind": "tile-payload", "params": ("data",),
         "error": "TileEncodeError", "fuzz": True, "consume_exact": True,
+    },
+    # sidecar geometry section bytes (docs/FORMAT.md §3.4)
+    "kart_tpu/geom.py::decode_vertex_column": {
+        "kind": "tile-payload", "params": ("data",),
+        "error": "TileEncodeError", "fuzz": True,
     },
     # pack-stream reads (ROBUSTNESS.md §2)
     "kart_tpu/transport/pack.py::read_pack": {

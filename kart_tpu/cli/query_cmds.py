@@ -71,9 +71,15 @@ def _parse_intersects(text):
     is_flag=True,
     help="Pin the join kernel to the host backend (skip device routing)",
 )
+@click.option(
+    "--approx",
+    is_flag=True,
+    help="Stop spatial verdicts at the envelope filter (skip the "
+    "exact-refine stage; docs/QUERY.md §4b)",
+)
 @click.pass_obj
 def query(ctx, refish, dataset, where, bbox, intersects, count_by,
-          output_format, page, page_size, host_only):
+          output_format, page, page_size, host_only, approx):
     """Query one commit: filtered scans, aggregates and spatial joins.
 
     REFISH names the commit (branch, tag, oid, HEAD); DATASET is the
@@ -98,6 +104,7 @@ def query(ctx, refish, dataset, where, bbox, intersects, count_by,
             page=page,
             page_size=page_size,
             allow_device=not host_only,
+            approx=approx,
         )
     except QueryError as e:
         raise CliError(str(e))

@@ -112,6 +112,16 @@ class DiffBackend:
         never match on either side. Base: the chunked numpy broadcast."""
         return _host_join_counts(build_env, probe_env)
 
+    def refine_pairs(self, col_a, ia, col_b, ib):
+        """Exact-refine batch kernel (ISSUE 20): candidate pair index
+        arrays over two vertex columns -> bool (P,) exact intersection
+        verdicts. Predicates are exact int64 arithmetic on quantized
+        coordinates (kart_tpu.geom), so every backend is bit-identical by
+        construction. Base: the memoized numpy twin."""
+        from kart_tpu.geom import refine_pairs_host
+
+        return refine_pairs_host(col_a, ia, col_b, ib)
+
 
 @_register
 class HostNativeBackend(DiffBackend):
@@ -221,6 +231,16 @@ class ShardedJaxBackend(DiffBackend):
             # (the query layer accumulates only returned batches), so the
             # host twin recomputes this batch from clean state
             return self._fall_back(e, "join").join_counts(build_env, probe_env)
+
+    def refine_pairs(self, col_a, ia, col_b, ib):
+        try:
+            return sharded_refine_pairs(col_a, ia, col_b, ib)
+        except Exception as e:
+            # nothing published mid-batch (the refine stage only applies
+            # returned verdict arrays), so the host twin restarts clean
+            return self._fall_back(e, "refine").refine_pairs(
+                col_a, ia, col_b, ib
+            )
 
 
 def _device_envelopes_worthwhile(n):
@@ -366,7 +386,7 @@ def project_envelopes(env, allow_device=True):
     PR 6 seam). ``allow_device=False`` pins the host transform (pool
     workers: a forked child must never touch a device runtime).
 
-    Byte-determinism note (docs/TILES.md §6): device transcendentals are
+    Byte-determinism note (docs/TILES.md §5.1): device transcendentals are
     *not* bit-identical to numpy's, so the tile quantizer treats device
     output as a fast approximation and re-runs the host ops on any row
     whose quantized value lands within a safety margin of a rounding
@@ -581,6 +601,136 @@ def join_bbox_counts(build_env, probe_env, allow_device=True, route_rows=None):
     ):
         backend = BACKENDS["sharded_jax"]
     return backend.join_counts(b, p)
+
+
+# --- exact-refine batch kernel (the query engine's refine stage, ISSUE 20) --
+
+@functools.lru_cache(maxsize=8)
+def _make_sharded_refine(mesh):
+    import jax
+
+    from jax.sharding import PartitionSpec as P
+
+    import jax.numpy as jnp
+
+    from kart_tpu.diff.device_batch import _shard_map
+    from kart_tpu.geom import ray_crossings, seg_pairs_intersect
+    from kart_tpu.parallel.mesh import FEATURES_AXIS
+
+    def _step(ax0, ay0, ax1, ay1, an, bx0, by0, bx1, by1, bn, ap, bp):
+        # (1, Pp, S) int32 segment slabs per device. Cast to int64 — the
+        # exactness contract (kart_tpu.geom): |coord| < 2^25, so every
+        # product below fits 52 bits and equals the numpy twin bit for
+        # bit. The predicate functions themselves are the *same* operator-
+        # only expressions the host evaluates — shared source, not twins.
+        a = [v[0].astype(jnp.int64) for v in (ax0, ay0, ax1, ay1)]
+        b = [v[0].astype(jnp.int64) for v in (bx0, by0, bx1, by1)]
+        am = jnp.arange(a[0].shape[1])[None, :] < an[0][:, None]
+        bm = jnp.arange(b[0].shape[1])[None, :] < bn[0][:, None]
+        pm = am[:, :, None] & bm[:, None, :]
+        col = [v[:, :, None] for v in a]  # A segments down the matrix
+        row = [v[:, None, :] for v in b]  # B segments across
+        seg_any = (seg_pairs_intersect(*col, *row) & pm).any(axis=(1, 2))
+        # A starts vs B rings: even-odd parity per vertex, any inside
+        cnt_ab = (ray_crossings(col[0], col[1], *row) & pm).sum(axis=2)
+        a_in_b = (((cnt_ab & 1) == 1) & am).any(axis=1)
+        # B starts vs A rings (transposed orientation, same masks)
+        cnt_ba = (
+            ray_crossings(row[0], row[1], *col)
+            & pm
+        ).sum(axis=1)
+        b_in_a = (((cnt_ba & 1) == 1) & bm).any(axis=1)
+        verdict = seg_any | (bp[0] & a_in_b) | (ap[0] & b_in_a)
+        return verdict[None]
+
+    jax.config.update("jax_enable_x64", True)  # exact int64 predicates
+    spec = P(FEATURES_AXIS)
+    fn = _shard_map()(
+        _step, mesh=mesh, in_specs=(spec,) * 12, out_specs=spec
+    )
+    return jax.jit(fn)
+
+
+def sharded_refine_pairs(col_a, ia, col_b, ib):
+    """Candidate pairs -> bool (P,) exact verdicts, pairs sharded over the
+    feature axis, each device reducing its own (Pp, SA, SB) predicate slab
+    — only the verdict bits come home. Rounds are capped by
+    ``KART_GEOM_BATCH_ROWS`` and shrunk further when a round's slab would
+    exceed the element budget (one huge polygon must not OOM the mesh).
+    Padding pair rows carry zero segment counts: their masks are empty, so
+    the verdict is False and they slice off exactly."""
+    import jax
+
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from kart_tpu.diff.device_batch import pack_geom_pairs
+    from kart_tpu.geom import geom_batch_rows
+    from kart_tpu.ops.blocks import bucket_size
+    from kart_tpu.parallel.mesh import FEATURES_AXIS, make_mesh
+
+    ia = np.asarray(ia, dtype=np.int64)
+    ib = np.asarray(ib, dtype=np.int64)
+    total = len(ia)
+    out = np.zeros(total, dtype=bool)
+    if not total:
+        return out
+    mesh = make_mesh()
+    n_shards = int(mesh.devices.size)
+    fn = _make_sharded_refine(mesh)
+    sharding = NamedSharding(mesh, P(FEATURES_AXIS))
+    batch = geom_batch_rows()
+    for lo in range(0, total, batch):
+        hi = min(lo + batch, total)
+        pack = pack_geom_pairs(col_a, ia[lo:hi], col_b, ib[lo:hi])
+        sa = pack["a"][0].shape[1]
+        sb = pack["b"][0].shape[1]
+        # keep each device's (Pp, SA, SB) slab under ~2^24 elements
+        rows = max(min(hi - lo, (1 << 24) * n_shards // max(sa * sb, 1)), 1)
+        for r0 in range(0, hi - lo, rows):
+            r1 = min(r0 + rows, hi - lo)
+            m = r1 - r0
+            per = bucket_size(max(-(-m // n_shards), 1), minimum=64)
+            def _pad(arr, fill=0):
+                cols = arr.shape[1:]
+                padded = np.zeros((n_shards * per,) + cols, dtype=arr.dtype)
+                padded[:m] = arr[r0:r1]
+                return padded.reshape((n_shards, per) + cols)
+            with tm.span("diff.device.transfer", rows=int(m)):
+                args = [
+                    jax.device_put(_pad(c), sharding)
+                    for c in pack["a"] + [pack["a_n"]] + pack["b"] + [pack["b_n"]]
+                ]
+                args += [
+                    jax.device_put(_pad(pack[k]), sharding)
+                    for k in ("a_poly", "b_poly")
+                ]
+            verdict = fn(*args)
+            out[lo + r0 : lo + r1] = np.asarray(verdict).reshape(-1)[:m]
+    return out
+
+
+def refine_intersects(col_a, ia, col_b, ib, allow_device=True, route_rows=None):
+    """The query engine's exact-refine entry point on this seam
+    (docs/QUERY.md §4b): candidate pair indices over two vertex columns ->
+    bool exact-intersection verdicts, routed exactly like
+    :func:`join_bbox_counts` — same env gates, same readiness ladder, same
+    host fallback. ``route_rows`` gates on the whole candidate set when
+    the caller streams many batches through one routing decision. Callers
+    only hand over pairs whose both sides have usable geometry (kind != 0);
+    everything else keeps its envelope verdict — the fail-open rule that
+    makes exact matches a structural subset of bbox matches."""
+    from kart_tpu.parallel.sharded_diff import should_shard
+
+    backend = BACKENDS["host_native"]
+    if (
+        allow_device
+        and os.environ.get("KART_DIFF_DEVICE") != "0"
+        and os.environ.get("KART_DIFF_BACKEND", "auto")
+        in ("auto", "sharded_jax")
+        and should_shard(len(ia) if route_rows is None else int(route_rows))
+    ):
+        backend = BACKENDS["sharded_jax"]
+    return backend.refine_pairs(col_a, ia, col_b, ib)
 
 
 # --- pmapped sampled-count reduction ----------------------------------------

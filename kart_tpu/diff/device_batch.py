@@ -164,6 +164,54 @@ def pack_env_round(env, lo, hi, n_shards, per, fill=np.nan):
     return [c.reshape(n_shards, per) for c in cols]
 
 
+def pack_geom_pairs(col_a, ia, col_b, ib):
+    """Candidate pairs over two vertex columns -> padded fixed-shape
+    segment batches for the exact-refine kernel (ISSUE 20).
+
+    -> dict with ``a``/``b``: 4 int32 (P, S) segment-endpoint arrays
+    (x0, y0, x1, y1; zero-padded) + ``a_n``/``b_n`` int32 (P,) valid
+    segment counts + ``a_poly``/``b_poly`` bool (P,). S is the bucketed
+    max segment count per side (bounds the distinct shapes XLA compiles,
+    same reasoning as :func:`kart_tpu.ops.blocks.bucket_size` everywhere
+    else). Segment endpoints come from the column's cached
+    :meth:`~kart_tpu.geom.VertexColumn.segment_table`, so the fill is
+    pure gathers + one fancy-indexed scatter per coordinate — no
+    per-feature Python work at all. Padding slots are zeros and masked
+    out by the counts, so padded batches refine exactly like unpadded
+    ones."""
+    from kart_tpu.geom import KIND_POLY, _gather_ranges
+    from kart_tpu.ops.blocks import bucket_size
+
+    ia = np.asarray(ia, dtype=np.int64)
+    ib = np.asarray(ib, dtype=np.int64)
+    p = len(ia)
+
+    def _side(col, idx):
+        x0, y0, x1, y1, offs = col.segment_table()
+        lo, hi = offs[idx], offs[idx + 1]
+        counts = (hi - lo).astype(np.int32)
+        cap = bucket_size(int(counts.max(initial=1)), minimum=8)
+        cols = [np.zeros((p, cap), dtype=np.int32) for _ in range(4)]
+        src, per_pair = _gather_ranges(lo, hi)
+        if len(src):
+            rows = np.repeat(np.arange(p), per_pair)
+            slots = src - np.repeat(lo, per_pair)
+            for slab, flat in zip(cols, (x0, y0, x1, y1)):
+                slab[rows, slots] = flat[src]
+        return cols, counts
+
+    a_cols, a_n = _side(col_a, ia)
+    b_cols, b_n = _side(col_b, ib)
+    return {
+        "a": a_cols,
+        "a_n": a_n,
+        "a_poly": np.asarray(col_a.kinds[ia] == KIND_POLY),
+        "b": b_cols,
+        "b_n": b_n,
+        "b_poly": np.asarray(col_b.kinds[ib] == KIND_POLY),
+    }
+
+
 def _shard_map():
     try:  # jax >= 0.6 exposes shard_map at top level
         from jax import shard_map  # type: ignore[attr-defined]

@@ -26,6 +26,10 @@ resets and clones — a tree oid never changes meaning. Files:
             flags  uint8[ceil(N/B)]      (non-zero = aggregate not tight:
                                  a wrapping / degenerate member — the block
                                  may be all-out but never all-in)
+            geom   bytes        (only when "geom_bytes" in header: the
+                                 ragged vertex column of kart_tpu.geom —
+                                 quantized real geometry for the exact
+                                 query refine stage, docs/FORMAT.md §3.4)
 
 Arrays are stored *sorted by key* so loading skips the sort. Int-pk datasets
 don't store paths at all — the key IS the pk, and feature paths are
@@ -38,7 +42,10 @@ fine-scan only the boundary blocks (filter-refine, the structure of the
 reference's server-side subtree skip). Readers of pre-aggregate sidecars
 (no "agg_block_rows" header key) fall back to the full envelope scan;
 old readers ignore the trailing aggregate bytes — both directions stay
-compatible.
+compatible. The geometry section rides the same sentinel scheme: a new
+trailing section gated by a new header key ("geom_bytes"), so old readers
+skip it and new readers of old files fall back to blob-read extraction
+(docs/FORMAT.md §3.4).
 
 A small LRU (by mtime) bounds the cache directory size.
 """
@@ -150,16 +157,21 @@ class IntKeyPaths:
         return self.encoder.encode_pks_to_path((int(self.keys[i]),))
 
 
-def save_sidecar(repo, feature_tree_oid, keys, oids_u8, paths=None, envelopes=None):
+def save_sidecar(repo, feature_tree_oid, keys, oids_u8, paths=None, envelopes=None,
+                 vertices=None):
     """Persist a sidecar. ``keys`` int64 (N,), ``oids_u8`` uint8 (N, 20) —
     *not necessarily sorted*; ``paths`` list[str] aligned with keys, or None
     for int-pk datasets; ``envelopes`` (N, 4) float wsen per feature, or
+    None; ``vertices`` a kart_tpu.geom.VertexColumn aligned with keys, or
     None. Atomic (tmp + rename)."""
     with tm.span("sidecar.save", rows=int(len(keys))):
-        return _save_sidecar(repo, feature_tree_oid, keys, oids_u8, paths, envelopes)
+        return _save_sidecar(
+            repo, feature_tree_oid, keys, oids_u8, paths, envelopes, vertices
+        )
 
 
-def _save_sidecar(repo, feature_tree_oid, keys, oids_u8, paths, envelopes):
+def _save_sidecar(repo, feature_tree_oid, keys, oids_u8, paths, envelopes,
+                  vertices=None):
     order = np.argsort(keys, kind="stable")
     keys = np.ascontiguousarray(keys[order], dtype="<i8")
     oids_u8 = np.ascontiguousarray(oids_u8[order], dtype=np.uint8)
@@ -183,6 +195,11 @@ def _save_sidecar(repo, feature_tree_oid, keys, oids_u8, paths, envelopes):
         )
         if AGG_BLOCK_ROWS > 0 and len(env_arr):
             agg, flags = _block_aggregates(env_arr, AGG_BLOCK_ROWS)
+    geom_blob = b""
+    if vertices is not None and len(vertices) == len(keys):
+        from kart_tpu.geom import encode_vertex_column
+
+        geom_blob = encode_vertex_column(vertices.take(order))
 
     header_fields = {
         "count": int(len(keys)),
@@ -192,6 +209,8 @@ def _save_sidecar(repo, feature_tree_oid, keys, oids_u8, paths, envelopes):
     }
     if agg is not None:
         header_fields["agg_block_rows"] = AGG_BLOCK_ROWS
+    if geom_blob:
+        header_fields["geom_bytes"] = len(geom_blob)
     header = json.dumps(header_fields).encode() + b"\n"
 
     target = sidecar_file(repo, feature_tree_oid)
@@ -209,6 +228,8 @@ def _save_sidecar(repo, feature_tree_oid, keys, oids_u8, paths, envelopes):
         if agg is not None:
             f.write(np.ascontiguousarray(agg, dtype="<f4").tobytes())
             f.write(flags.tobytes())
+        if geom_blob:
+            f.write(geom_blob)
     os.replace(tmp, target)
     _evict(d)
     return target
@@ -287,6 +308,16 @@ def _load_block_from_mmap(mm, dataset, pad):
                 pos += 16 * nb
                 flags = np.frombuffer(mm, dtype=np.uint8, count=nb, offset=pos)
                 env_blocks = (agg, flags, block_rows)
+                pos += nb
+        geom_raw = None
+        gb = header.get("geom_bytes", 0)
+        if gb:
+            if pos + gb > len(mm):
+                return None
+            # undecoded view — FeatureBlock.vertex_column() decodes on
+            # first use (diff loads never pay for geometry they don't read)
+            geom_raw = mm[pos : pos + gb]
+            pos += gb
     except (IndexError, KeyError, ValueError):
         return None
 
@@ -297,7 +328,8 @@ def _load_block_from_mmap(mm, dataset, pad):
             else np.zeros((0, 5), dtype=np.uint32)
         )
         return FeatureBlock(
-            keys, oid_rows, paths, n, envelopes=envelopes, env_blocks=env_blocks
+            keys, oid_rows, paths, n, envelopes=envelopes, env_blocks=env_blocks,
+            geom_raw=geom_raw,
         )
     # pad (copy — the kernel wants aligned padded arrays; the mmap'd
     # originals stay untouched for the path views)
@@ -308,7 +340,8 @@ def _load_block_from_mmap(mm, dataset, pad):
     if n:
         oids_p[:n] = oids_u8.reshape(n, 5, 4).view(np.uint32).reshape(n, 5)
     return FeatureBlock(
-        keys_p, oids_p, paths, n, envelopes=envelopes, env_blocks=env_blocks
+        keys_p, oids_p, paths, n, envelopes=envelopes, env_blocks=env_blocks,
+        geom_raw=geom_raw,
     )
 
 
@@ -361,6 +394,7 @@ def update_sidecar_for_commit(repo, old_ds, new_feature_tree_oid, feature_diff):
     removed = set()
     added = {}
     added_envs = {} if block.envelopes is not None else None
+    added_geoms = {} if block.vertex_column() is not None else None
     for delta in feature_diff.values():
         if delta.old is not None:
             removed.add(int(delta.old_key))
@@ -372,8 +406,16 @@ def update_sidecar_for_commit(repo, old_ds, new_feature_tree_oid, feature_diff):
                 added_envs[pk] = _feature_envelope_wsen(
                     delta.new_value, geom_col
                 )
+            if added_geoms is not None:
+                value = (
+                    delta.new_value.get(geom_col)
+                    if geom_col is not None and hasattr(delta.new_value, "get")
+                    else None
+                )
+                added_geoms[pk] = bytes(value) if value else None
     return derive_sidecar(
-        repo, block, new_feature_tree_oid, removed, added, added_envs
+        repo, block, new_feature_tree_oid, removed, added, added_envs,
+        added_geoms,
     )
 
 
@@ -400,12 +442,15 @@ def _feature_envelope_wsen(feature, geom_col):
 
 
 def derive_sidecar(repo, old_block, new_feature_tree_oid, removed, added,
-                   added_envs=None):
+                   added_envs=None, added_geoms=None):
     """New sidecar from an old int-pk block + the change set — O(changed)
     array ops, no tree walk. removed: iterable of pks; added: {pk: oid hex}
     (an added pk overrides a removal); added_envs: {pk: wsen} carried into
     the envelope column when the old block has one (a derived sidecar must
-    not silently lose the spatial prefilter for later revisions)."""
+    not silently lose the spatial prefilter for later revisions);
+    added_geoms: {pk: GPKG blob or None} carried into the vertex column the
+    same way — kept rows are row-sliced (O(changed) gathers, no re-extract),
+    only added rows pay WKB extraction."""
     keys = old_block.keys[: old_block.count]
     oids_u8 = (
         np.ascontiguousarray(old_block.oids[: old_block.count])
@@ -417,6 +462,9 @@ def derive_sidecar(repo, old_block, new_feature_tree_oid, removed, added,
         if old_block.envelopes is not None and added_envs is not None
         else None
     )
+    verts = (
+        old_block.vertex_column() if added_geoms is not None else None
+    )
     drop = set(removed) | set(added)
     if drop:
         drop_arr = np.fromiter(drop, dtype=np.int64, count=len(drop))
@@ -425,6 +473,8 @@ def derive_sidecar(repo, old_block, new_feature_tree_oid, removed, added,
         oids_u8 = oids_u8[mask]
         if envs is not None:
             envs = envs[mask]
+        if verts is not None:
+            verts = verts.take(np.flatnonzero(mask))
     if added:
         add_keys = np.fromiter(added.keys(), dtype=np.int64, count=len(added))
         add_oids = np.frombuffer(
@@ -437,8 +487,16 @@ def derive_sidecar(repo, old_block, new_feature_tree_oid, removed, added,
                 [added_envs[int(pk)] for pk in add_keys], dtype=np.float32
             ).reshape(-1, 4)
             envs = np.concatenate([envs, add_env])
+        if verts is not None:
+            from kart_tpu.geom import VertexColumn, vertex_column_from_blobs
+
+            add_verts = vertex_column_from_blobs(
+                added_geoms.get(int(pk)) for pk in add_keys
+            )
+            verts = VertexColumn.concat([verts, add_verts])
     return save_sidecar(
-        repo, new_feature_tree_oid, keys, oids_u8, envelopes=envs
+        repo, new_feature_tree_oid, keys, oids_u8, envelopes=envs,
+        vertices=verts,
     )
 
 

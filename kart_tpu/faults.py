@@ -89,6 +89,16 @@ Registered points:
                             1 = join entry, 2+ = each build-side tile —
                             same publish-nothing / byte-identical-retry
                             contract as query.scan
+    query.refine            the exact-refine stage of a scan or join
+                            (ISSUE 20): each refine batch, before any
+                            verdict lands — an armed refine dies
+                            publishing nothing (no query/peer/HTTP cache
+                            entry) and the retried query is byte-identical
+    geom.extract            vertex extraction from feature blobs
+                            (kart_tpu/geom.py::vertex_column_from_blobs):
+                            fires before any rows are built, so an armed
+                            extraction (import sidecar build, query/tile
+                            blob fallback) publishes nothing
 
 Disabled (``KART_FAULTS`` unset) the fast path is a single environ dict
 lookup with no allocation: frame-boundary loops additionally hoist

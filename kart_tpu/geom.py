@@ -1,0 +1,645 @@
+"""Ragged vertex columns + exact intersection predicates (ISSUE 20).
+
+Every query and serving surface used to stop at envelopes; this module
+lifts the real geometry into the columnar world. A :class:`VertexColumn`
+is the ragged per-feature shape store — per feature a range of rings, per
+ring a range of vertices — extracted once from GPKG-WKB blobs
+(:mod:`kart_tpu.geometry`) and persisted in the KCOL sidecar as a
+``geom_bytes`` section (docs/FORMAT.md §3.4), encoded with the KTB2
+stream ladder (:mod:`kart_tpu.tiles.streams`: delta/varint coords, RLE
+kinds).
+
+Quantization — the exactness contract
+-------------------------------------
+
+Coordinates are stored as int32 in units of 1e-5 degree
+(``COORD_SCALE``), ~1.1 m at the equator. The payoff is that every hot
+predicate below is **exact integer arithmetic**: |coord| <= 1.8e7 < 2^25,
+so a coordinate difference fits 26 bits and any product of two
+differences fits 52 bits — no rounding anywhere, in int64 on the host
+*or* on a device. The sharded refine kernel
+(:func:`kart_tpu.diff.backend.refine_intersects`) evaluates the same
+formulas in jnp int64 and is bit-identical to the numpy twin by
+construction — not by fused-multiply-add luck (docs/DEVICE.md §6).
+
+Fail-open policy
+----------------
+
+Extraction never fails a feature into a wrong verdict: NULL geometry,
+undecodable WKB, non-finite or out-of-world coordinates, and
+GeometryCollections all become ``kind 0`` rows (no rings). The query
+refine stage leaves kind-0 rows at their envelope verdict, which keeps
+the monotonicity invariant (exact matches are a subset of bbox matches)
+structural rather than hoped-for.
+
+Kinds: 0 = none, 1 = point set, 2 = polyline set, 3 = polygon (rings,
+even-odd). Multi* parts flatten into extra rings; a point ring holds one
+vertex.
+"""
+
+import os
+
+import numpy as np
+
+from kart_tpu import faults
+from kart_tpu import telemetry as tm
+from kart_tpu.geometry import (
+    LINESTRING,
+    MULTILINESTRING,
+    MULTIPOINT,
+    MULTIPOLYGON,
+    POINT,
+    POLYGON,
+    Geometry,
+    parse_wkb,
+)
+from kart_tpu.tiles.streams import TileEncodeError, decode_stream, encode_stream
+
+#: int32 vertex units per degree (1e-5 deg ~ 1.1 m). 180 * COORD_SCALE =
+#: 1.8e7 < 2^25, which is what makes every predicate product exact.
+COORD_SCALE = 100_000
+
+WORLD_X = 180 * COORD_SCALE
+WORLD_Y = 90 * COORD_SCALE
+
+KIND_NONE, KIND_POINT, KIND_LINE, KIND_POLY = 0, 1, 2, 3
+
+#: wire version byte of an encoded vertex column (docs/FORMAT.md §3.4)
+GEOM_WIRE_VERSION = 1
+
+#: default candidate pairs per refine round (host chunk / device batch)
+DEFAULT_GEOM_BATCH_ROWS = 4096
+
+
+def geom_batch_rows():
+    """Candidate pairs per exact-refine round (``KART_GEOM_BATCH_ROWS``,
+    docs/OBSERVABILITY.md §7): bounds the (pairs x segA x segB) predicate
+    slab on either execution layer. Malformed values fall back to the
+    default — tuning knobs must never kill a query."""
+    try:
+        return max(
+            int(os.environ.get("KART_GEOM_BATCH_ROWS",
+                               str(DEFAULT_GEOM_BATCH_ROWS))),
+            1,
+        )
+    except ValueError:
+        return DEFAULT_GEOM_BATCH_ROWS
+
+def geom_refine_enabled():
+    """``KART_GEOM_REFINE`` (docs/OBSERVABILITY.md §7): the process-wide
+    exact-refine switch. Default on — spatial queries answer with real
+    geometry wherever a vertex column exists; ``0`` pins every query to
+    the envelope-only (``--approx``) semantics."""
+    return os.environ.get("KART_GEOM_REFINE", "1") != "0"
+
+
+_BASE_KIND = {
+    POINT: KIND_POINT,
+    MULTIPOINT: KIND_POINT,
+    LINESTRING: KIND_LINE,
+    MULTILINESTRING: KIND_LINE,
+    POLYGON: KIND_POLY,
+    MULTIPOLYGON: KIND_POLY,
+}
+
+
+def _gather_ranges(lo, hi):
+    """Concatenated ``arange(lo[i], hi[i])`` without a Python loop
+    -> (indices int64 (sum(hi-lo),), counts int64 (len(lo),))."""
+    lo = np.asarray(lo, dtype=np.int64)
+    hi = np.asarray(hi, dtype=np.int64)
+    counts = hi - lo
+    total = int(counts.sum())
+    if total == 0:
+        return np.zeros(0, dtype=np.int64), counts
+    offs = np.concatenate(([0], np.cumsum(counts)[:-1]))
+    idx = np.arange(total, dtype=np.int64)
+    return idx - np.repeat(offs - lo, counts), counts
+
+
+class VertexColumn:
+    """Ragged per-feature vertex store, block-row order.
+
+    ``feat_offsets`` int64 (N+1,) — ring index range of feature i is
+    ``[feat_offsets[i], feat_offsets[i+1])``; ``ring_offsets`` int64
+    (R+1,) — vertex index range per ring; ``x``/``y`` int32 (V,)
+    quantized lon/lat; ``kinds`` uint8 (N,). Kind-0 rows own zero rings.
+    """
+
+    __slots__ = ("kinds", "feat_offsets", "ring_offsets", "x", "y",
+                 "_seg_table")
+
+    def __init__(self, kinds, feat_offsets, ring_offsets, x, y):
+        self.kinds = np.ascontiguousarray(kinds, dtype=np.uint8)
+        self.feat_offsets = np.ascontiguousarray(feat_offsets, dtype=np.int64)
+        self.ring_offsets = np.ascontiguousarray(ring_offsets, dtype=np.int64)
+        self.x = np.ascontiguousarray(x, dtype=np.int32)
+        self.y = np.ascontiguousarray(y, dtype=np.int32)
+        self._seg_table = None
+
+    def __len__(self):
+        return len(self.kinds)
+
+    @classmethod
+    def empty(cls, n):
+        """n all-kind-0 rows (a sidecar with no usable geometry)."""
+        return cls(
+            np.zeros(n, np.uint8),
+            np.zeros(n + 1, np.int64),
+            np.zeros(1, np.int64),
+            np.zeros(0, np.int32),
+            np.zeros(0, np.int32),
+        )
+
+    @property
+    def n_rings(self):
+        return len(self.ring_offsets) - 1
+
+    @property
+    def n_vertices(self):
+        return len(self.x)
+
+    def usable(self):
+        """bool (N,): rows the refine stage may trust (kind != 0)."""
+        return self.kinds != KIND_NONE
+
+    def take(self, indices):
+        """Row-gather -> new VertexColumn (sidecar sort order, derive's
+        kept-row slice). Fully vectorized."""
+        idx = np.asarray(indices, dtype=np.int64)
+        ring_idx, ring_counts = _gather_ranges(
+            self.feat_offsets[idx], self.feat_offsets[idx + 1]
+        )
+        vert_idx, vert_counts = _gather_ranges(
+            self.ring_offsets[ring_idx], self.ring_offsets[ring_idx + 1]
+        )
+        return VertexColumn(
+            self.kinds[idx],
+            np.concatenate(([0], np.cumsum(ring_counts))),
+            np.concatenate(([0], np.cumsum(vert_counts))),
+            self.x[vert_idx],
+            self.y[vert_idx],
+        )
+
+    @classmethod
+    def concat(cls, cols):
+        """Row-concatenate (derive: kept rows + freshly extracted adds)."""
+        cols = list(cols)
+        kinds = np.concatenate([c.kinds for c in cols])
+        ring_counts = np.concatenate(
+            [np.diff(c.feat_offsets) for c in cols]
+        )
+        vert_counts = np.concatenate(
+            [np.diff(c.ring_offsets) for c in cols]
+        )
+        return cls(
+            kinds,
+            np.concatenate(([0], np.cumsum(ring_counts))),
+            np.concatenate(([0], np.cumsum(vert_counts))),
+            np.concatenate([c.x for c in cols]),
+            np.concatenate([c.y for c in cols]),
+        )
+
+    def rings(self, i):
+        """Feature i -> list of (x int32 (k,), y (k,)) vertex rings."""
+        out = []
+        for r in range(int(self.feat_offsets[i]), int(self.feat_offsets[i + 1])):
+            v0, v1 = int(self.ring_offsets[r]), int(self.ring_offsets[r + 1])
+            out.append((self.x[v0:v1], self.y[v0:v1]))
+        return out
+
+    def segments(self, i):
+        """Feature i -> (x0, y0, x1, y1) int64 segment endpoint arrays.
+
+        A k-vertex ring yields its k-1 consecutive segments; polygon
+        rings always get the closing edge (zero-length when the WKB ring
+        already repeats its first vertex — harmless: a zero-length
+        segment behaves as an on-boundary point in every predicate). A
+        1-vertex ring (a point) is one zero-length segment, which is how
+        point rows ride the same segment tests."""
+        poly = self.kinds[i] == KIND_POLY
+        x0s, y0s, x1s, y1s = [], [], [], []
+        for xs, ys in self.rings(i):
+            if len(xs) == 1:
+                x0s.append(xs)
+                y0s.append(ys)
+                x1s.append(xs)
+                y1s.append(ys)
+                continue
+            if poly:
+                x0s.append(xs)
+                y0s.append(ys)
+                x1s.append(np.roll(xs, -1))
+                y1s.append(np.roll(ys, -1))
+            else:
+                x0s.append(xs[:-1])
+                y0s.append(ys[:-1])
+                x1s.append(xs[1:])
+                y1s.append(ys[1:])
+        if not x0s:
+            z = np.zeros(0, np.int64)
+            return z, z, z, z
+        return tuple(
+            np.concatenate(parts).astype(np.int64)
+            for parts in (x0s, y0s, x1s, y1s)
+        )
+
+    def segment_table(self):
+        """Whole-column flat segment endpoints, built once and cached.
+
+        Returns ``(x0, y0, x1, y1, offs)``: int32 (S,) endpoint arrays
+        holding every feature's segments contiguously in exactly
+        :meth:`segments` order, plus ``offs`` int64 (N+1,) so feature
+        i's segments are the slice ``[offs[i], offs[i+1])``. The pair
+        packer gathers from this instead of calling ``segments(i)`` per
+        feature — at join scale that loop (one Python frame + np.roll
+        per ring) dominated the whole refine stage."""
+        if self._seg_table is not None:
+            return self._seg_table
+        n_feat = len(self.kinds)
+        ring_counts = np.diff(self.feat_offsets)
+        k = np.diff(self.ring_offsets)  # vertices per ring
+        ring_feat = np.repeat(np.arange(n_feat, dtype=np.int64), ring_counts)
+        poly_ring = self.kinds[ring_feat] == KIND_POLY
+        # segments per ring: 1-vertex ring -> one zero-length segment;
+        # polygon ring -> k (closing edge); line ring -> k-1
+        segc = np.where(
+            k == 1, 1, np.where(poly_ring, k, np.maximum(k - 1, 0))
+        ).astype(np.int64)
+        start, _ = _gather_ranges(
+            self.ring_offsets[:-1], self.ring_offsets[:-1] + segc
+        )
+        ring_of = np.repeat(np.arange(len(k), dtype=np.int64), segc)
+        base = self.ring_offsets[:-1][ring_of]
+        local = start - base
+        kk = k[ring_of]
+        end_local = np.where(
+            kk <= 1, local,
+            np.where(poly_ring[ring_of], (local + 1) % np.maximum(kk, 1),
+                     local + 1),
+        )
+        end = base + end_local
+        per_ring_offs = np.concatenate(([0], np.cumsum(segc)))
+        offs = per_ring_offs[self.feat_offsets]
+        self._seg_table = (
+            self.x[start], self.y[start], self.x[end], self.y[end], offs
+        )
+        return self._seg_table
+
+
+# ---------------------------------------------------------------------------
+# extraction: GPKG blobs -> VertexColumn (import / derive / fallback path)
+# ---------------------------------------------------------------------------
+
+
+def _value_rings(value):
+    """GeomValue -> (kind, list of point-lists) or (0, []) when the shape
+    has no columnar form (GeometryCollection, empties)."""
+    base = value.base_type
+    kind = _BASE_KIND.get(base)
+    if kind is None:
+        return KIND_NONE, []
+    payload = value.payload
+    if base == POINT:
+        rings = [] if payload is None else [[payload]]
+    elif base == MULTIPOINT:
+        rings = [[c.payload] for c in payload if c.payload is not None]
+    elif base == LINESTRING:
+        rings = [payload] if payload else []
+    elif base == MULTILINESTRING:
+        rings = [c.payload for c in payload if c.payload]
+    elif base == POLYGON:
+        rings = [r for r in payload if r]
+    else:  # MULTIPOLYGON
+        rings = [r for c in payload for r in c.payload if r]
+    if not rings:
+        return KIND_NONE, []
+    return kind, rings
+
+
+def _quantize_rings(rings):
+    """point-lists -> (x int32 chunks, y chunks, vertex counts) or None
+    when any coordinate is non-finite or outside the world range (the
+    whole feature fails open to kind 0)."""
+    xs, ys, counts = [], [], []
+    for ring in rings:
+        pts = np.asarray([(p[0], p[1]) for p in ring], dtype=np.float64)
+        if not np.isfinite(pts).all():
+            return None
+        q = np.rint(pts * COORD_SCALE)
+        if (
+            np.abs(q[:, 0]).max(initial=0) > WORLD_X
+            or np.abs(q[:, 1]).max(initial=0) > WORLD_Y
+        ):
+            return None
+        xs.append(q[:, 0].astype(np.int32))
+        ys.append(q[:, 1].astype(np.int32))
+        counts.append(len(ring))
+    return xs, ys, counts
+
+
+def vertex_column_from_blobs(blobs):
+    """Iterable of GPKG geometry blobs (or None) -> VertexColumn, one row
+    per blob in order. The import/derive extraction entry point —
+    ``KART_FAULTS=geom.extract:<n>`` fires here, before any rows are
+    built, so an armed extraction publishes nothing."""
+    hook = faults.hook("geom.extract")
+    if hook is not None:
+        hook()
+    kinds, ring_counts, vert_counts = [], [], []
+    x_chunks, y_chunks = [], []
+    n_failed = 0
+    for blob in blobs:
+        kind = KIND_NONE
+        rings = []
+        if blob:
+            try:
+                g = Geometry.of(bytes(blob))
+                if g is not None and not g.is_empty:
+                    kind, rings = _value_rings(parse_wkb(g.to_wkb()))
+            except Exception:
+                n_failed += 1
+                kind, rings = KIND_NONE, []
+        if kind != KIND_NONE:
+            q = _quantize_rings(rings)
+            if q is None:
+                kind, rings = KIND_NONE, []
+            else:
+                xs, ys, counts = q
+                x_chunks.extend(xs)
+                y_chunks.extend(ys)
+                vert_counts.extend(counts)
+        kinds.append(kind)
+        ring_counts.append(len(rings) if kind != KIND_NONE else 0)
+    if n_failed:
+        tm.incr("geom.extract_failed", rows=n_failed)
+    return VertexColumn(
+        np.asarray(kinds, np.uint8),
+        np.concatenate(([0], np.cumsum(np.asarray(ring_counts, np.int64)))),
+        np.concatenate(([0], np.cumsum(np.asarray(vert_counts, np.int64)))),
+        np.concatenate(x_chunks) if x_chunks else np.zeros(0, np.int32),
+        np.concatenate(y_chunks) if y_chunks else np.zeros(0, np.int32),
+    )
+
+
+# ---------------------------------------------------------------------------
+# wire codec: the sidecar's `geom_bytes` section (docs/FORMAT.md §3.4)
+# ---------------------------------------------------------------------------
+
+
+def encode_vertex_column(col):
+    """VertexColumn -> section bytes: a version byte, then five KTB2
+    streams — kinds, rings-per-feature, vertices-per-ring, x, y. Counts
+    (not offsets) go on the wire so monotonicity is by construction;
+    coords delta-code well because ring vertices are spatially local."""
+    ring_counts = np.diff(col.feat_offsets)
+    vert_counts = np.diff(col.ring_offsets)
+    return b"".join(
+        (
+            bytes([GEOM_WIRE_VERSION]),
+            encode_stream(col.kinds.astype(np.int64), "i8"),
+            encode_stream(ring_counts, "i8"),
+            encode_stream(vert_counts, "i8"),
+            encode_stream(col.x.astype(np.int64), "i4"),
+            encode_stream(col.y.astype(np.int64), "i4"),
+        )
+    )
+
+
+def decode_vertex_column(data, count, pos=0):
+    """Section bytes at ``pos`` -> (VertexColumn of ``count`` rows, next
+    pos). Taint boundary (registry.TAINT_SOURCES, fuzzed): only
+    :class:`TileEncodeError` may escape. Ceilings: kinds in [0, 3] with
+    kind 0 <=> zero rings, ring/vertex counts positive where required and
+    totalling <= MAX_DECODE_ROWS (summed in Python — no int64 wrap),
+    coords inside the world range, every stream canonical/consume-exact
+    (:func:`kart_tpu.tiles.streams.decode_stream`)."""
+    from kart_tpu.tiles.encode import MAX_DECODE_ROWS
+
+    if count < 0 or count > MAX_DECODE_ROWS:
+        raise TileEncodeError(f"Vertex column row count {count} out of range")
+    if pos + 1 > len(data):
+        raise TileEncodeError("Truncated vertex column: no version byte")
+    version = data[pos]
+    if version != GEOM_WIRE_VERSION:
+        raise TileEncodeError(f"Unknown vertex column version {version}")
+    pos += 1
+    kinds, pos = decode_stream(data, count, "i8", pos)
+    if len(kinds) and (int(kinds.min()) < 0 or int(kinds.max()) > KIND_POLY):
+        raise TileEncodeError("Vertex column kind outside [0, 3]")
+    ring_counts, pos = decode_stream(data, count, "i8", pos)
+    if np.any((kinds == KIND_NONE) != (ring_counts == 0)):
+        raise TileEncodeError("Vertex column kind/ring-count mismatch")
+    if len(ring_counts) and int(ring_counts.min()) < 0:
+        raise TileEncodeError("Negative ring count")
+    n_rings = sum(int(c) for c in ring_counts)  # non-wrapping total
+    if n_rings > MAX_DECODE_ROWS:
+        raise TileEncodeError(
+            f"Vertex column holds {n_rings} rings (cap {MAX_DECODE_ROWS})"
+        )
+    vert_counts, pos = decode_stream(data, n_rings, "i8", pos)
+    if len(vert_counts) and int(vert_counts.min()) < 1:
+        raise TileEncodeError("Vertex ring with fewer than 1 vertex")
+    n_verts = sum(int(c) for c in vert_counts)
+    if n_verts > MAX_DECODE_ROWS:
+        raise TileEncodeError(
+            f"Vertex column holds {n_verts} vertices (cap {MAX_DECODE_ROWS})"
+        )
+    x, pos = decode_stream(data, n_verts, "i4", pos)
+    y, pos = decode_stream(data, n_verts, "i4", pos)
+    if len(x) and (
+        int(np.abs(x.astype(np.int64)).max()) > WORLD_X
+        or int(np.abs(y.astype(np.int64)).max()) > WORLD_Y
+    ):
+        raise TileEncodeError("Vertex coordinate outside world range")
+    return (
+        VertexColumn(
+            kinds.astype(np.uint8),
+            np.concatenate(([0], np.cumsum(ring_counts))),
+            np.concatenate(([0], np.cumsum(vert_counts))),
+            x,
+            y,
+        ),
+        pos,
+    )
+
+
+# ---------------------------------------------------------------------------
+# exact predicates — operator-only int64 formulas, shared by the numpy
+# host path and the jnp device kernel (docs/DEVICE.md §6)
+# ---------------------------------------------------------------------------
+
+
+def seg_pairs_intersect(ax0, ay0, ax1, ay1, bx0, by0, bx1, by1):
+    """Elementwise/broadcast inclusive segment-intersection predicate,
+    int64 in -> bool out. Straddle test + collinear/endpoint touch, all
+    exact (products fit 52 bits). A zero-length segment degrades to a
+    point: point-on-segment and point==point fall out of the touch term.
+    Operator-only on purpose — numpy and jnp evaluate the identical
+    expression tree, so host and device verdicts are bit-identical."""
+    d1 = (bx1 - bx0) * (ay0 - by0) - (by1 - by0) * (ax0 - bx0)
+    d2 = (bx1 - bx0) * (ay1 - by0) - (by1 - by0) * (ax1 - bx0)
+    d3 = (ax1 - ax0) * (by0 - ay0) - (ay1 - ay0) * (bx0 - ax0)
+    d4 = (ax1 - ax0) * (by1 - ay0) - (ay1 - ay0) * (bx1 - ax0)
+    straddle = (((d1 > 0) & (d2 < 0)) | ((d1 < 0) & (d2 > 0))) & (
+        ((d3 > 0) & (d4 < 0)) | ((d3 < 0) & (d4 > 0))
+    )
+    # collinear touch: d == 0 puts the point on the carrier line; the
+    # products (sx0-px)(sx1-px) <= 0 pin it inside the segment's span
+    t1 = (d1 == 0) & ((bx0 - ax0) * (bx1 - ax0) <= 0) & (
+        (by0 - ay0) * (by1 - ay0) <= 0
+    )
+    t2 = (d2 == 0) & ((bx0 - ax1) * (bx1 - ax1) <= 0) & (
+        (by0 - ay1) * (by1 - ay1) <= 0
+    )
+    t3 = (d3 == 0) & ((ax0 - bx0) * (ax1 - bx0) <= 0) & (
+        (ay0 - by0) * (ay1 - by0) <= 0
+    )
+    t4 = (d4 == 0) & ((ax0 - bx1) * (ax1 - bx1) <= 0) & (
+        (ay0 - by1) * (ay1 - by1) <= 0
+    )
+    return straddle | t1 | t2 | t3 | t4
+
+
+def ray_crossings(px, py, sx0, sy0, sx1, sy1):
+    """Elementwise/broadcast upward-ray crossing indicator for the
+    even-odd rule, int64 in -> bool out. Half-open vertex rule
+    ``(sy0 <= py) != (sy1 <= py)`` counts each boundary vertex once;
+    the left-of test is the exact integer cross product. Callers reduce
+    (sum over segments, parity per point). Operator-only — see
+    :func:`seg_pairs_intersect`."""
+    upward = (sy0 <= py) != (sy1 <= py)
+    cr = (sx1 - sx0) * (py - sy0) - (sy1 - sy0) * (px - sx0)
+    left = ((sy1 > sy0) & (cr > 0)) | ((sy1 < sy0) & (cr < 0))
+    return upward & left
+
+
+def points_in_rings(px, py, sx0, sy0, sx1, sy1):
+    """(V,) int64 points vs (S,) int64 ring segments -> bool (V,)
+    even-odd containment (host reduction of :func:`ray_crossings`).
+    Summing crossings over *all* rings of a feature is the even-odd rule
+    with holes and disjoint parts handled for free."""
+    if not len(sx0) or not len(px):
+        return np.zeros(len(px), dtype=bool)
+    hits = ray_crossings(
+        px[:, None], py[:, None], sx0[None, :], sy0[None, :],
+        sx1[None, :], sy1[None, :],
+    )
+    return (hits.sum(axis=1) & 1).astype(bool)
+
+
+def pair_intersects(segs_a, a_poly, segs_b, b_poly):
+    """One exact pair verdict from pre-built segment arrays: any segment
+    contact, else any A vertex inside polygon B, else any B vertex inside
+    polygon A. Vertex tests use segment start points — ring closure makes
+    starts cover every polygon vertex, and a part wholly inside the other
+    side always has its start inside (anything else crosses a boundary
+    and is caught by the segment term)."""
+    ax0, ay0, ax1, ay1 = segs_a
+    bx0, by0, bx1, by1 = segs_b
+    if not len(ax0) or not len(bx0):
+        return False
+    hit = seg_pairs_intersect(
+        ax0[:, None], ay0[:, None], ax1[:, None], ay1[:, None],
+        bx0[None, :], by0[None, :], bx1[None, :], by1[None, :],
+    )
+    if hit.any():
+        return True
+    if b_poly and points_in_rings(ax0, ay0, bx0, by0, bx1, by1).any():
+        return True
+    if a_poly and points_in_rings(bx0, by0, ax0, ay0, ax1, ay1).any():
+        return True
+    return False
+
+
+def boxes_vertex_column(env):
+    """(N, 4) wsen degree envelopes -> VertexColumn of one 5-point box
+    polygon per row, vectorized (no per-row WKB walk). Non-finite or
+    wrapping (e < w) rows become kind 0 — fail open, same policy as blob
+    extraction. Coordinates clip to the world range first, which keeps
+    the quantized values in int32 and is lossless for any feature that
+    can exist. The synthetic layers' vertex source
+    (:func:`kart_tpu.synth.synth_repo`) and the scan refine's
+    query-rectangle builder."""
+    env = np.asarray(env, dtype=np.float64)
+    n = len(env)
+    if not n:
+        return VertexColumn.empty(0)
+    ok = np.isfinite(env).all(axis=1) & (env[:, 2] >= env[:, 0])
+    qw = np.rint(np.clip(env[:, 0], -180.0, 180.0) * COORD_SCALE).astype(np.int64)
+    qs = np.rint(np.clip(env[:, 1], -90.0, 90.0) * COORD_SCALE).astype(np.int64)
+    qe = np.rint(np.clip(env[:, 2], -180.0, 180.0) * COORD_SCALE).astype(np.int64)
+    qn = np.rint(np.clip(env[:, 3], -90.0, 90.0) * COORD_SCALE).astype(np.int64)
+    idx = np.flatnonzero(ok)
+    x = np.stack([qw, qe, qe, qw, qw], axis=1)[idx].ravel().astype(np.int32)
+    y = np.stack([qs, qs, qn, qn, qs], axis=1)[idx].ravel().astype(np.int32)
+    kinds = np.where(ok, KIND_POLY, KIND_NONE).astype(np.uint8)
+    return VertexColumn(
+        kinds,
+        np.concatenate(([0], np.cumsum(ok.astype(np.int64)))),
+        np.arange(len(idx) + 1, dtype=np.int64) * 5,
+        x,
+        y,
+    )
+
+
+def bbox_vertex_column(query):
+    """``--bbox`` wsen rectangle -> 1-row polygon VertexColumn for the
+    refine kernels, or None for an anti-meridian wrap (e < w) — the
+    cyclic test stays with the envelope stage (fail open: wrapped-query
+    scans keep bbox semantics)."""
+    col = boxes_vertex_column(np.asarray(query, dtype=np.float64)[None, :])
+    return col if col.kinds[0] != KIND_NONE else None
+
+
+def refine_pairs_host(col_a, ia, col_b, ib):
+    """Host exact-refine: candidate pair index arrays -> bool verdicts.
+    Evaluates the same padded (P, SA, SB) predicate slabs the sharded
+    kernel reduces (:func:`kart_tpu.diff.device_batch.pack_geom_pairs`)
+    — one numpy broadcast per chunk instead of a Python loop per pair —
+    with chunk rows shrunk under the same element budget so one huge
+    polygon can't blow host memory. Bit-identical to the device kernel
+    by shared source: both reduce the identical operator-only
+    expressions over the identical padded slabs."""
+    from kart_tpu.diff.device_batch import pack_geom_pairs
+
+    ia = np.asarray(ia, dtype=np.int64)
+    ib = np.asarray(ib, dtype=np.int64)
+    total = len(ia)
+    out = np.zeros(total, dtype=bool)
+    if not total:
+        return out
+    batch = geom_batch_rows()
+    for lo in range(0, total, batch):
+        hi = min(lo + batch, total)
+        pack = pack_geom_pairs(col_a, ia[lo:hi], col_b, ib[lo:hi])
+        sa = pack["a"][0].shape[1]
+        sb = pack["b"][0].shape[1]
+        rows = max(min(hi - lo, (1 << 24) // max(sa * sb, 1)), 1)
+        for r0 in range(0, hi - lo, rows):
+            r1 = min(r0 + rows, hi - lo)
+            sl = slice(r0, r1)
+            a = [c[sl].astype(np.int64) for c in pack["a"]]
+            b = [c[sl].astype(np.int64) for c in pack["b"]]
+            am = np.arange(sa)[None, :] < pack["a_n"][sl][:, None]
+            bm = np.arange(sb)[None, :] < pack["b_n"][sl][:, None]
+            pm = am[:, :, None] & bm[:, None, :]
+            down = [v[:, :, None] for v in a]  # A segments down the matrix
+            across = [v[:, None, :] for v in b]  # B segments across
+            seg_any = (seg_pairs_intersect(*down, *across) & pm).any(
+                axis=(1, 2)
+            )
+            cnt_ab = (ray_crossings(down[0], down[1], *across) & pm).sum(
+                axis=2
+            )
+            a_in_b = (((cnt_ab & 1) == 1) & am).any(axis=1)
+            cnt_ba = (ray_crossings(across[0], across[1], *down) & pm).sum(
+                axis=1
+            )
+            b_in_a = (((cnt_ba & 1) == 1) & bm).any(axis=1)
+            out[lo + r0 : lo + r1] = (
+                seg_any
+                | (pack["b_poly"][sl] & a_in_b)
+                | (pack["a_poly"][sl] & b_in_a)
+            )
+    return out

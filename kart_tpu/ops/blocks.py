@@ -21,10 +21,23 @@ and never equals a real key.
 """
 
 import hashlib
+import threading
+from collections import OrderedDict
 
 import numpy as np
 
 PAD_KEY = np.int64(2**63 - 1)
+
+#: decoded vertex columns keyed by (sha1 of the raw section bytes, row
+#: count) — the sidecar is content-addressed, so repeated loads of one
+#: file hand back identical bytes and a digest key can never go stale
+#: (docs/FORMAT.md §3.4); the bound reclaims memory. Hashing the section
+#: costs milliseconds where the KTB2 decode costs hundreds — without the
+#: memo every exact spatial query re-pays the full-column decode, because
+#: the scan loads a fresh FeatureBlock per request.
+_VERTEX_MEMO = OrderedDict()
+_vertex_memo_lock = threading.Lock()
+_VERTEX_MEMO_ENTRIES = 8
 
 
 def bucket_size(n, minimum=1024):
@@ -83,10 +96,11 @@ class FeatureBlock:
     """One dataset version as sorted (key, oid) arrays + the path strings
     (kept host-side for value materialisation of changed rows only)."""
 
-    __slots__ = ("keys", "oids", "paths", "count", "envelopes", "env_blocks")
+    __slots__ = ("keys", "oids", "paths", "count", "envelopes", "env_blocks",
+                 "geom_raw", "_vertices")
 
     def __init__(self, keys, oids, paths, count, envelopes=None,
-                 env_blocks=None):
+                 env_blocks=None, geom_raw=None, vertices=None):
         self.keys = keys
         self.oids = oids
         self.paths = paths  # list[str], in the same (sorted) order, len == count
@@ -98,6 +112,44 @@ class FeatureBlock:
         # records over the envelope column — the block-pruned prefilter's
         # input; None for pre-aggregate sidecars (full scan fallback)
         self.env_blocks = env_blocks
+        # optional encoded vertex-column section bytes (sidecar "geom_bytes",
+        # docs/FORMAT.md §3.4), decoded on first vertex_column() call —
+        # diff loads must not pay the decode they never use
+        self.geom_raw = geom_raw
+        self._vertices = vertices
+
+    def vertex_column(self):
+        """Lazily decoded :class:`kart_tpu.geom.VertexColumn` for the
+        block's ``count`` rows, or None when the sidecar has no geometry
+        section. Fail open: a corrupt section decodes to None once (the
+        refine stage then keeps envelope verdicts) rather than failing
+        the whole block load."""
+        if self._vertices is None and self.geom_raw is not None:
+            raw, self.geom_raw = self.geom_raw, None
+            from kart_tpu.geom import decode_vertex_column
+
+            # bytes() copy: the stream codecs index scalars out of the
+            # buffer and an mmap view would hand them np.uint8s
+            data = bytes(raw)
+            memo_key = (hashlib.sha1(data).digest(), self.count)
+            with _vertex_memo_lock:
+                hit = _VERTEX_MEMO.get(memo_key)
+                if hit is not None:
+                    _VERTEX_MEMO.move_to_end(memo_key)
+            if hit is not None:
+                self._vertices = hit
+                return hit
+            try:
+                self._vertices, _ = decode_vertex_column(data, self.count)
+            except Exception:
+                self._vertices = None
+            if self._vertices is not None:
+                with _vertex_memo_lock:
+                    _VERTEX_MEMO[memo_key] = self._vertices
+                    _VERTEX_MEMO.move_to_end(memo_key)
+                    while len(_VERTEX_MEMO) > _VERTEX_MEMO_ENTRIES:
+                        _VERTEX_MEMO.popitem(last=False)
+        return self._vertices
 
     @classmethod
     def from_dataset(cls, dataset, pad=True):

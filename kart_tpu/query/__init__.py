@@ -47,6 +47,8 @@ STATS = {
     "blocks_pruned": 0,
     "rows_scanned": 0,
     "pairs_emitted": 0,
+    "pairs_refined": 0,
+    "refine_dropped": 0,
     "scatter_requests": 0,
     "scatter_parts": 0,
     "cache_hits": 0,
@@ -96,11 +98,13 @@ def load_query_dataset(repo, commit_oid, ds_path):
 
 def run_query(repo, refish, ds_path, *, where=None, bbox=None,
               intersects=None, output="count", count_by=None, page=None,
-              page_size=None, part=None, allow_device=True):
+              page_size=None, part=None, allow_device=True, approx=False):
     """One entry point behind every surface (CLI, HTTP, scatter partials):
     route to the scan or the spatial join and return the JSON-ready result
     document. ``intersects`` is ``(refish2, ds_path2)`` — when set the
-    query is the spatial join and ``where``/``count_by`` must be None."""
+    query is the spatial join and ``where``/``count_by`` must be None.
+    ``approx=True`` stops spatial verdicts at the envelope filter
+    (docs/QUERY.md §4b); default is the exact-refine semantics."""
     if intersects is not None:
         if where or count_by:
             raise QueryError("--intersects cannot be combined with --where")
@@ -118,6 +122,7 @@ def run_query(repo, refish, ds_path, *, where=None, bbox=None,
             page_size=page_size,
             part=part,
             allow_device=allow_device,
+            approx=approx,
         )
     if part is not None:
         raise QueryError("block-range partials apply to join queries only")
@@ -133,6 +138,7 @@ def run_query(repo, refish, ds_path, *, where=None, bbox=None,
         count_by=count_by,
         page=page,
         page_size=page_size,
+        approx=approx,
     )
 
 

@@ -26,8 +26,11 @@ from kart_tpu.query import _bump
 
 #: result-document format version — part of every key: a payload change
 #: MUST change every key, or clients would revalidate old-format bytes
-#: into keeping them forever (same rule as the tile lane)
-QUERY_PAYLOAD_VERSION = 1
+#: into keeping them forever (same rule as the tile lane).
+#: v2: exact-refine semantics (ISSUE 20) — documents carry ``exact`` and
+#: refine stats, and default spatial verdicts changed from envelope-only
+#: to exact, so v1 bytes must never revalidate.
+QUERY_PAYLOAD_VERSION = 2
 
 #: default byte budget (``KART_QUERY_CACHE`` overrides; 0 disables)
 DEFAULT_QUERY_CACHE_BYTES = 64 * 1024 * 1024
@@ -35,11 +38,14 @@ DEFAULT_QUERY_CACHE_BYTES = 64 * 1024 * 1024
 
 def query_request_key(commit_oid, ds_path, *, where=None, bbox=None,
                       commit_oid2=None, ds_path2=None, output="count",
-                      count_by=None, page=None, page_size=None, part=None):
+                      count_by=None, page=None, page_size=None, part=None,
+                      approx=False):
     """The cache key / strong validator digest of one query request: a
     sha256 over the format version, the pinned commit oid(s) and the
     normalized request — every field that changes the result bytes is in
-    the digest, nothing else is."""
+    the digest, nothing else is. ``approx`` must be the *effective* mode
+    (request flag OR ``KART_GEOM_REFINE=0``): exact and envelope-only
+    answers are different bytes and must never share a validator."""
     payload = "\0".join(
         (
             f"v{QUERY_PAYLOAD_VERSION}",
@@ -54,6 +60,7 @@ def query_request_key(commit_oid, ds_path, *, where=None, bbox=None,
             str(page if page is not None else ""),
             str(page_size if page_size is not None else ""),
             part or "",
+            "approx" if approx else "",
         )
     )
     return hashlib.sha256(payload.encode()).hexdigest()

@@ -101,12 +101,84 @@ def _alive_ranges(cls, block_rows, lo, hi):
     ]
 
 
+def _make_refine_ctx(col_build, build_feat, build_env, col_probe,
+                     probe_env, *, hook=None):
+    """The exact-refine stage's bundled state, threaded through
+    :func:`join_counts_for_range` (docs/QUERY.md §4b). ``build_feat`` maps
+    build_env row position -> vertex-column feature index (they diverge
+    when ``--bbox`` gathers the build side). Usability masks are the
+    fail-open rule made structural: only pairs whose BOTH sides have real,
+    non-anti-meridian geometry are refined; every other pair keeps its
+    envelope verdict, so exact matches are a subset of bbox matches by
+    construction."""
+    build_feat = np.asarray(build_feat, dtype=np.int64)
+    build_env = np.asarray(build_env, dtype=np.float32)
+    probe_env = np.asarray(probe_env, dtype=np.float32)
+    return {
+        "col_build": col_build,
+        "col_probe": col_probe,
+        "build_feat": build_feat,
+        "build_ok": col_build.usable()[build_feat]
+        & ~(build_env[:, 2] < build_env[:, 0]),
+        "probe_ok": col_probe.usable()
+        & ~(probe_env[:, 2] < probe_env[:, 0]),
+        "hook": hook,
+    }
+
+
+def _refine_chunk(refine, tile_env, probe_env, t, c_lo, counts, lo, total,
+                  *, allow_device, route_rows, stats):
+    """Exact-refine one (build tile x probe chunk): recover the bbox pair
+    matrix with the host overlap predicate (the same comparison-only
+    formula every join backend evaluates, so the pair set is exactly what
+    the counts already hold), refine the both-usable pairs through the
+    backend seam, and subtract the non-survivors. Returns the adjusted
+    pair total."""
+    from kart_tpu.diff.backend import _join_overlap_np, refine_intersects
+
+    pe = np.asarray(probe_env, dtype=np.float32)
+    ov = _join_overlap_np(
+        pe[:, 0:1], pe[:, 1:2], pe[:, 2:3], pe[:, 3:4],
+        tile_env[:, 0], tile_env[:, 1], tile_env[:, 2], tile_env[:, 3],
+    )
+    pi, ti = np.nonzero(ov)
+    if not len(pi):
+        return total
+    env_row = t * TILE_ROWS + ti
+    probe_row = c_lo + pi
+    u = refine["probe_ok"][probe_row] & refine["build_ok"][env_row]
+    if not np.any(u):
+        return total
+    if refine["hook"] is not None:
+        refine["hook"]()
+    bi = refine["build_feat"][env_row[u]]
+    pj = probe_row[u].astype(np.int64)
+    verdict = refine_intersects(
+        refine["col_build"],
+        bi,
+        refine["col_probe"],
+        pj,
+        allow_device=allow_device,
+        route_rows=route_rows,
+    )
+    stats["pairs_refined"] += int(len(pj))
+    dropped = ~verdict
+    n_drop = int(np.count_nonzero(dropped))
+    if n_drop:
+        np.subtract.at(counts, pj[dropped] - lo, 1)
+        total -= n_drop
+        stats["refine_dropped"] += n_drop
+    return total
+
+
 def join_counts_for_range(build_env, probe_block, lo, hi, *,
                           allow_device=True, route_rows=None, stats=None,
-                          join_hook=None):
+                          join_hook=None, refine=None):
     """Per-probe match counts for probe rows ``[lo:hi)`` against the whole
     build side: -> (counts int64 (hi-lo,), pair total). The staged loop —
-    tile, prune, stream batches through the backend seam."""
+    tile, prune, stream batches through the backend seam; with a
+    ``refine`` context (:func:`_make_refine_ctx`) each batch's surviving
+    bbox pairs are exact-refined in place before the next batch streams."""
     from kart_tpu.diff.backend import join_bbox_counts
     from kart_tpu.diff.sidecar import _block_aggregates
     from kart_tpu.ops.bbox import BLOCK_ALL_OUT, classify_env_blocks_np
@@ -120,6 +192,8 @@ def join_counts_for_range(build_env, probe_block, lo, hi, *,
     stats.setdefault("blocks_pruned", 0)
     stats.setdefault("block_tests", 0)
     stats.setdefault("batches", 0)
+    stats.setdefault("pairs_refined", 0)
+    stats.setdefault("refine_dropped", 0)
     if not len(build_env) or hi <= lo:
         return counts, total
 
@@ -156,17 +230,37 @@ def join_counts_for_range(build_env, probe_block, lo, hi, *,
                 counts[c_lo - lo : c_hi - lo] += c
                 total += c_total
                 stats["batches"] += 1
+                if refine is not None and c_total:
+                    total = _refine_chunk(
+                        refine,
+                        tile_env,
+                        probe_env[c_lo:c_hi],
+                        t,
+                        c_lo,
+                        counts,
+                        lo,
+                        total,
+                        allow_device=allow_device,
+                        route_rows=route_rows,
+                        stats=stats,
+                    )
     return counts, total
 
 
 def run_join(repo, refish, ds_path, refish2, ds_path2, *, bbox=None,
              output="count", page=None, page_size=None, part=None,
-             allow_device=True):
+             allow_device=True, approx=False):
     """The spatial join behind ``kart query --intersects`` and the
     ``/api/v1/query`` join lane: -> JSON-ready result document. The probe
     side is ``(refish, ds_path)`` (its rows are what the join reports);
     the build side is the ``--intersects`` operand — put the smaller
-    dataset there."""
+    dataset there. ``approx=True`` (or ``KART_GEOM_REFINE=0``) stops at
+    envelope verdicts — the pre-ISSUE-20 semantics; otherwise bbox pairs
+    are exact-refined against the real geometry wherever both sides carry
+    vertex columns."""
+    from kart_tpu.geom import geom_refine_enabled
+    from kart_tpu.query.scan import vertices_for_block
+
     if output not in ("count", "json"):
         raise QueryError(f"unknown join output {output!r} (count, json)")
     commit1 = resolve_query_commit(repo, refish)
@@ -179,7 +273,14 @@ def run_join(repo, refish, ds_path, refish2, ds_path2, *, bbox=None,
     build_env = np.asarray(
         _envelopes_or_raise(build_block, "build"), dtype=np.float32
     )
+    build_feat = np.arange(build_block.count, dtype=np.int64)
     query = parse_bbox(bbox) if bbox is not None else None
+
+    col_probe = col_build = None
+    if not approx and geom_refine_enabled():
+        col_probe = vertices_for_block(probe_ds, probe_block)
+        col_build = vertices_for_block(build_ds, build_block)
+    exact = col_probe is not None and col_build is not None
 
     n_probe = probe_block.count
     lo, hi = 0, n_probe
@@ -191,6 +292,7 @@ def run_join(repo, refish, ds_path, refish2, ds_path2, *, bbox=None,
             )
 
     join_hook = faults.hook("query.join")
+    refine_hook = faults.hook("query.refine")
     stats = {
         "build_rows": int(build_block.count),
         "probe_rows": int(n_probe),
@@ -198,6 +300,8 @@ def run_join(repo, refish, ds_path, refish2, ds_path2, *, bbox=None,
         "blocks_pruned": 0,
         "block_tests": 0,
         "batches": 0,
+        "pairs_refined": 0,
+        "refine_dropped": 0,
     }
     with tm.span("query.join", build=int(build_block.count), probe=int(n_probe)):
         if join_hook is not None:
@@ -212,10 +316,21 @@ def run_join(repo, refish, ds_path, refish2, ds_path2, *, bbox=None,
             b_hits = select_backend(build_block.count).envelope_hits(
                 build_block, query
             )
-            build_env = np.ascontiguousarray(build_env[np.flatnonzero(b_hits)])
+            build_feat = np.flatnonzero(b_hits).astype(np.int64)
+            build_env = np.ascontiguousarray(build_env[build_feat])
             probe_mask = select_backend(probe_block.count).envelope_hits(
                 probe_block, query
             )[lo:hi]
+        refine = None
+        if exact:
+            refine = _make_refine_ctx(
+                col_build,
+                build_feat,
+                build_env,
+                col_probe,
+                np.asarray(probe_block.envelopes, dtype=np.float32),
+                hook=refine_hook,
+            )
         counts, total = join_counts_for_range(
             build_env,
             probe_block,
@@ -225,6 +340,7 @@ def run_join(repo, refish, ds_path, refish2, ds_path2, *, bbox=None,
             route_rows=n_probe,
             stats=stats,
             join_hook=join_hook,
+            refine=refine,
         )
         if probe_mask is not None:
             counts[~np.asarray(probe_mask)] = 0
@@ -242,6 +358,7 @@ def run_join(repo, refish, ds_path, refish2, ds_path2, *, bbox=None,
             "dataset2": ds_path2,
             "bbox": [float(v) for v in query] if query is not None else None,
             "part": [lo, hi] if part is not None else None,
+            "exact": exact,
             "pairs": int(total),
             "count": int(np.count_nonzero(counts)),
             "stats": stats,
@@ -272,7 +389,10 @@ def run_join(repo, refish, ds_path, refish2, ds_path2, *, bbox=None,
     tm.incr("query.joins")
     tm.incr("query.pairs_emitted", int(total))
     tm.incr("query.blocks_pruned", stats["blocks_pruned"])
+    tm.incr("query.pairs_refined", stats["pairs_refined"])
     _bump("joins")
     _bump("pairs_emitted", int(total))
     _bump("blocks_pruned", stats["blocks_pruned"])
+    _bump("pairs_refined", stats["pairs_refined"])
+    _bump("refine_dropped", stats["refine_dropped"])
     return result

@@ -349,6 +349,51 @@ def _with_fallback_envelopes(ds, block):
     )
 
 
+def _with_fallback_vertices(ds, block):
+    """Vertex column for a dataset whose sidecar predates geometry capture
+    (the docs/FORMAT.md §3.4 version sentinel): one O(N) blob pass
+    extracting the geometry column in block row order. Fail open per row —
+    a promised/missing blob or undecodable geometry becomes kind 0 and
+    keeps its envelope verdict — so partial clones degrade to envelope
+    semantics instead of erroring."""
+    from kart_tpu.geom import vertex_column_from_blobs
+    from kart_tpu.ops.blocks import unpack_oid_bytes
+
+    odb = ds._feature_odb()
+    geom_col = ds.geom_column_name
+    n = block.count
+    rows = batch_rows()
+    blobs = []
+    with tm.span("query.vertex_fallback", rows=int(n)):
+        for lo in range(0, n, rows):
+            hi = min(lo + rows, n)
+            shas = unpack_oid_bytes(np.asarray(block.oids[lo:hi]))
+            datas = odb.read_blobs_data_ordered(shas)
+            for i, data in enumerate(datas):
+                if data is None:
+                    blobs.append(None)
+                    continue
+                pks = _pks_for_index(block, ds, lo + i)
+                g = ds.get_feature(pks, data=data).get(geom_col)
+                blobs.append(bytes(g) if g is not None else None)
+    col = vertex_column_from_blobs(blobs)
+    block._vertices = col  # memoize like the sidecar-backed route
+    return col
+
+
+def vertices_for_block(ds, block):
+    """The refine stage's geometry source: the sidecar's lazily decoded
+    vertex column when the KCOL carries one, else the blob-read fallback;
+    None when the dataset has no geometry column at all (refine is a
+    no-op and every verdict stays at its envelope value)."""
+    col = block.vertex_column()
+    if col is not None:
+        return col
+    if ds.geom_column_name is None or not block.count:
+        return None
+    return _with_fallback_vertices(ds, block)
+
+
 def _pks_for_index(block, ds, i):
     from kart_tpu.diff.sidecar import IntKeyPaths
 
@@ -389,6 +434,43 @@ def _bbox_indices(block, query, stats):
     hits = select_backend(block.count).envelope_hits(block, query)
     _prune_stats(block, query, stats)
     return np.flatnonzero(hits).astype(np.int64)
+
+
+def _refine_bbox_indices(ds, block, idx, query, refine_hook, stats):
+    """Stage 1b (docs/QUERY.md §2): exact-refine the envelope candidates
+    against the query rectangle's real geometry through the
+    :func:`~kart_tpu.diff.backend.refine_intersects` seam. Fail open —
+    kind-0 rows, anti-meridian features and wrapping query rectangles keep
+    their envelope verdicts — so the survivors are always a subset of the
+    envelope hits (the monotonicity invariant the property tests pin)."""
+    from kart_tpu.diff.backend import refine_intersects
+    from kart_tpu.geom import bbox_vertex_column
+
+    qcol = bbox_vertex_column(query)
+    if qcol is None or not len(idx):
+        return idx
+    col = vertices_for_block(ds, block)
+    if col is None:
+        return idx
+    env = np.asarray(block.envelopes)[idx]
+    usable = col.usable()[idx] & ~(env[:, 2] < env[:, 0])
+    cand = np.flatnonzero(usable)
+    if not len(cand):
+        return idx
+    if refine_hook is not None:
+        refine_hook()
+    verdict = refine_intersects(
+        col,
+        idx[cand],
+        qcol,
+        np.zeros(len(cand), dtype=np.int64),
+        route_rows=len(cand),
+    )
+    keep = np.ones(len(idx), dtype=bool)
+    keep[cand] = verdict
+    stats["pairs_refined"] += int(len(cand))
+    stats["refine_dropped"] += int(np.count_nonzero(~verdict))
+    return idx[keep]
 
 
 def _feature_values(ds, block, idx, scan_hook, stats):
@@ -487,10 +569,15 @@ def _count_by(ds, block, idx, col_name, scan_hook, stats):
 
 
 def run_scan(repo, refish, ds_path, *, where=None, bbox=None, output="count",
-             count_by=None, page=None, page_size=None):
+             count_by=None, page=None, page_size=None, approx=False):
     """The pushdown scan behind ``kart query`` and ``GET /api/v1/query``:
     -> JSON-ready result document (deterministic for a given commit +
-    normalized predicate — the property the ETag/cache lane relies on)."""
+    normalized predicate — the property the ETag/cache lane relies on).
+    ``approx=True`` (or ``KART_GEOM_REFINE=0``) skips the exact-refine
+    stage: verdicts stop at the envelope filter, the pre-ISSUE-20
+    semantics."""
+    from kart_tpu.geom import geom_refine_enabled
+
     if output not in ("count", "json", "bbox"):
         raise QueryError(f"unknown output {output!r} (count, json, bbox)")
     commit_oid = resolve_query_commit(repo, refish)
@@ -499,8 +586,10 @@ def run_scan(repo, refish, ds_path, *, where=None, bbox=None, output="count",
     query = parse_bbox(bbox) if bbox is not None else None
     block = _load_block(repo, ds, ds_path)
     n = block.count
+    exact = query is not None and not approx and geom_refine_enabled()
 
     scan_hook = faults.hook("query.scan")
+    refine_hook = faults.hook("query.refine")
     stats = {
         "rows": int(n),
         "blocks": 0,
@@ -508,12 +597,18 @@ def run_scan(repo, refish, ds_path, *, where=None, bbox=None, output="count",
         "blocks_all_in": 0,
         "rows_scanned": 0,
         "rows_decoded": 0,
+        "pairs_refined": 0,
+        "refine_dropped": 0,
     }
     with tm.span("query.scan", rows=int(n)):
         if scan_hook is not None:
             scan_hook()
         if query is not None:
             idx = _bbox_indices(block, query, stats)
+            if exact:
+                idx = _refine_bbox_indices(
+                    ds, block, idx, query, refine_hook, stats
+                )
         else:
             idx = np.arange(n, dtype=np.int64)
         stats["rows_scanned"] = int(len(idx))
@@ -526,6 +621,7 @@ def run_scan(repo, refish, ds_path, *, where=None, bbox=None, output="count",
             "dataset": ds_path,
             "where": where or None,
             "bbox": [float(v) for v in query] if query is not None else None,
+            "exact": exact,
             "count": int(len(idx)),
             "stats": stats,
         }
@@ -554,7 +650,10 @@ def run_scan(repo, refish, ds_path, *, where=None, bbox=None, output="count",
     tm.incr("query.scans")
     tm.incr("query.blocks_pruned", stats["blocks_pruned"])
     tm.incr("query.rows_scanned", stats["rows_scanned"])
+    tm.incr("query.pairs_refined", stats["pairs_refined"])
     _bump("scans")
     _bump("blocks_pruned", stats["blocks_pruned"])
     _bump("rows_scanned", stats["rows_scanned"])
+    _bump("pairs_refined", stats["pairs_refined"])
+    _bump("refine_dropped", stats["refine_dropped"])
     return result

@@ -244,15 +244,21 @@ def synth_repo(path, n, *, edit_frac=0.01, seed=0, blobs="promised",
     schema = SYNTH_SCHEMA
     crs_defs = None
     envelopes = None
+    vertices = None
     if spatial:
         assert blobs in ("promised", "changed"), (
             "spatial synth supports promised/changed blobs only"
         )
         schema = SYNTH_SPATIAL_SCHEMA
         from kart_tpu.epsg import epsg_wkt
+        from kart_tpu.geom import boxes_vertex_column
 
         crs_defs = {"EPSG:4326": epsg_wkt(4326)}
         envelopes = synth_envelopes(pks)
+        # real vertex columns without a blob walk: each synthetic feature's
+        # geometry IS its envelope box, so the exact-refine lane has actual
+        # polygons to chew on at bench scale (docs/FORMAT.md §3.4)
+        vertices = boxes_vertex_column(envelopes)
 
     if blobs == "real":
         with odb.bulk_pack(level=0):
@@ -320,7 +326,9 @@ def synth_repo(path, n, *, edit_frac=0.01, seed=0, blobs="promised",
             "HEAD", root, message, [commits[-1]] if commits else []
         )
         commits.append(commit_oid)
-        sidecar.save_sidecar(repo, ftree, pks, oids_u8, envelopes=envelopes)
+        sidecar.save_sidecar(
+            repo, ftree, pks, oids_u8, envelopes=envelopes, vertices=vertices
+        )
 
     return repo, {
         "base_commit": commits[0],

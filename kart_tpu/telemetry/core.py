@@ -63,6 +63,7 @@ SUBSYSTEMS = frozenset(
         "fleet",     # replication sync, write proxying, peer cache tier
         "events",    # live-update CDC, event log, warm-then-announce
         "query",     # predicate-pushdown scans and spatial joins
+        "geom",      # vertex extraction / exact-refine geometry
         "importer",  # bulk import phases
         "runtime",   # backend probe, watchdogs
         "wc",        # working copies

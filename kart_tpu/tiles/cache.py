@@ -39,7 +39,12 @@ def tile_key(commit_oid, ds_path, z, x, y, layers, extent, buffer):
     payload format version is part of the key: the HTTP layer marks
     payloads immutable and answers 304 from this digest alone, so a future
     encoder change MUST change every key — otherwise clients holding
-    old-format bytes would revalidate into keeping them forever."""
+    old-format bytes would revalidate into keeping them forever. The
+    ``geom`` layer's simplification tolerance folds in the same way —
+    it changes payload bytes, so two servers tuned differently via
+    ``KART_GEOM_SIMPLIFY`` must never share a validator (keys without
+    the geom layer ignore it: their bytes don't depend on it)."""
+    from kart_tpu.tiles.clip import simplify_tolerance
     from kart_tpu.tiles.encode import PAYLOAD_VERSION
 
     payload = "\0".join(
@@ -51,6 +56,7 @@ def tile_key(commit_oid, ds_path, z, x, y, layers, extent, buffer):
             ",".join(layers),
             str(extent),
             str(buffer),
+            repr(simplify_tolerance()) if "geom" in layers else "",
         )
     )
     return hashlib.sha256(payload.encode()).hexdigest()

@@ -32,6 +32,8 @@ input within the margin of the host values. Serving and export therefore
 stay byte-identical regardless of which backend projected the batch.
 """
 
+import os
+
 import numpy as np
 
 from kart_tpu.ops.bbox import bbox_intersects_np
@@ -42,6 +44,94 @@ from kart_tpu.tiles.grid import (
     tile_cover_wsen,
     validate_tile,
 )
+
+#: default simplification tolerance of the ``geom`` layer, in tile units
+#: (extent 4096 => 1 unit is ~1/4 of a rendered pixel). Tile units make
+#: the policy zoom-aware for free: one unit is half the planet wide at
+#: z0 and centimetres at z20, so low zooms simplify aggressively and
+#: deep zooms keep full detail — no per-zoom table needed.
+DEFAULT_SIMPLIFY = 1.0
+
+
+def simplify_tolerance():
+    """``KART_GEOM_SIMPLIFY`` (docs/OBSERVABILITY.md §7): the ``geom``
+    layer's Douglas-Peucker tolerance in tile units; 0 disables
+    simplification. Malformed values fall back to the default — a tuning
+    knob must never turn every tile into an error. The value folds into
+    the tile cache key (it changes payload bytes)."""
+    raw = os.environ.get("KART_GEOM_SIMPLIFY")
+    if raw is None:
+        return DEFAULT_SIMPLIFY
+    try:
+        return max(float(raw), 0.0)
+    except ValueError:
+        return DEFAULT_SIMPLIFY
+
+
+def project_vertices(qx, qy, z, x, y, *, extent=DEFAULT_EXTENT,
+                     buffer=DEFAULT_BUFFER):
+    """Quantized int32 lon/lat vertex columns (1e-5° units, the
+    :mod:`kart_tpu.geom` wire grid) -> tile-local int32 coordinate pair.
+
+    One vectorized pass over every vertex of the tile's kept rows — the
+    same mercator ops and y-grows-south convention as the envelope boxes
+    (:func:`_float_boxes`), clipped to the buffered tile square.
+    Clamping is per-vertex: a ring that leaves the tile is flattened
+    along the buffer edge rather than cut, which preserves ring closure
+    and vertex count (the buffer absorbs the distortion — renderers clip
+    at the tile edge anyway)."""
+    from kart_tpu.geom import COORD_SCALE
+
+    z, x, y = validate_tile(z, x, y)
+    lon = np.asarray(qx, dtype=np.float64) / COORD_SCALE
+    lat = np.asarray(qy, dtype=np.float64) / COORD_SCALE
+    mx, my = merc_xy_cols(lon, lat)
+    scale = float(1 << z) * extent
+    tx = np.clip(mx * scale - x * extent, -buffer, extent + buffer)
+    ty = np.clip(my * scale - y * extent, -buffer, extent + buffer)
+    return (np.rint(tx).astype(np.int32), np.rint(ty).astype(np.int32))
+
+
+def simplify_ring(xs, ys, tol):
+    """Douglas-Peucker keep-mask over one ring/line in tile-integer
+    coordinates. Iterative (explicit stack — sidecar rings are
+    attacker-sized, recursion depth must not be), endpoints always kept,
+    so a closed ring stays closed and a line keeps its ends. ``tol`` is
+    the max perpendicular deviation in tile units; 0 keeps everything.
+    Rings are simplified independently and vertices only ever *drop*
+    (never move), which is the layer's topology guarantee — see
+    docs/TILES.md §6."""
+    n = len(xs)
+    keep = np.zeros(n, dtype=bool)
+    if not n:
+        return keep
+    keep[0] = keep[-1] = True
+    if tol <= 0 or n <= 2:
+        keep[:] = True
+        return keep
+    xs = np.asarray(xs, dtype=np.float64)
+    ys = np.asarray(ys, dtype=np.float64)
+    stack = [(0, n - 1)]
+    while stack:
+        i0, i1 = stack.pop()
+        if i1 - i0 < 2:
+            continue
+        sx, sy = xs[i0 + 1:i1], ys[i0 + 1:i1]
+        dx, dy = xs[i1] - xs[i0], ys[i1] - ys[i0]
+        seg = float(np.hypot(dx, dy))
+        if seg == 0.0:
+            # degenerate chord (closed ring): fall back to distance from
+            # the coincident endpoints so loops don't collapse to a point
+            d = np.hypot(sx - xs[i0], sy - ys[i0])
+        else:
+            d = np.abs(dx * (sy - ys[i0]) - dy * (sx - xs[i0])) / seg
+        k = int(np.argmax(d))
+        if d[k] > tol:
+            m = i0 + 1 + k
+            keep[m] = True
+            stack.append((i0, m))
+            stack.append((m, i1))
+    return keep
 
 
 def refine_rows(envelopes, rows, z, x, y):

@@ -24,6 +24,15 @@ length; layers follow in *name-sorted* order. The layer registry
   clipped/quantized arrays: envelope boxes as polygons (degenerate boxes
   as points/linestrings), identity keys as feature ids, no blob reads —
   the off-the-shelf MapLibre adoption story.
+* ``geom`` — real-geometry MVT (ISSUE 20): the same protobuf framing as
+  ``mvt``, but each feature carries its *actual* rings from the sidecar
+  vertex column (:mod:`kart_tpu.geom`), projected per-vertex to tile
+  coordinates and Douglas-Peucker-simplified per zoom
+  (``KART_GEOM_SIMPLIFY``, tile units). Rows without usable geometry —
+  kind 0, or every ring degenerate at this zoom — fall back to their
+  envelope box, so the layer's coverage equals ``mvt``'s exactly. No
+  blob reads when the sidecar carries geometry; the blob fallback is a
+  once-per-revision build (:meth:`TileSource.vertices`).
 * ``geojson`` — newline-delimited JSON feature objects through the
   dataset's per-legend *compiled* serialisers
   (``Dataset3.feature_json_str_from_data``), byte-identical to ``diff -o
@@ -78,11 +87,11 @@ KTB2_MAGIC = b"KTB2"
 PROPS_MAGIC = b"KTP1"
 
 #: payload format version (header "v"); folded into every cache key/ETag —
-#: v2 added the ktb2/mvt/props layers
-PAYLOAD_VERSION = 2
+#: v2 added the ktb2/mvt/props layers; v3 the real-geometry ``geom`` layer
+PAYLOAD_VERSION = 3
 
 #: layer names this encoder knows how to build
-KNOWN_LAYERS = ("bin", "geojson", "ktb2", "mvt", "props")
+KNOWN_LAYERS = ("bin", "geojson", "geom", "ktb2", "mvt", "props")
 
 #: what a request without ``?layers=`` gets (``KART_TILE_ENCODING``
 #: overrides the server-side default; the chosen set is part of the cache
@@ -377,14 +386,13 @@ def _mvt_geometries(boxes):
     return types, geoms
 
 
-def encode_mvt_layer(layer_name, keys, boxes, extent=DEFAULT_EXTENT):
-    """Real MVT protobuf from the clipped/quantized arrays: one Tile
-    message holding one Layer named after the dataset, every feature's
-    envelope box as its geometry and its identity key as the feature id
-    (negative hash-keys ride as their two's-complement uint64). No blob
-    reads — this layer serves partial clones, like ``bin``/``ktb2``."""
+def _mvt_layer_bytes(layer_name, keys, types, geoms, extent):
+    """(per-feature geom types + command byte strings) -> one complete
+    MVT Tile message holding one Layer — the framing shared by the
+    envelope (``mvt``) and real-geometry (``geom``) layers. Identity keys
+    become feature ids (negative hash-keys ride as their two's-complement
+    uint64)."""
     keys = np.asarray(keys, dtype=np.int64)
-    types, geoms = _mvt_geometries(boxes)
     id_codes = keys.astype(np.uint64)  # two's complement for negatives
     id_buf = varint_encode(id_codes)
     id_lens = varint_lengths(id_codes)
@@ -409,6 +417,152 @@ def encode_mvt_layer(layer_name, keys, boxes, extent=DEFAULT_EXTENT):
         )
     )
     return _pb_bytes(3, layer_body)
+
+
+def encode_mvt_layer(layer_name, keys, boxes, extent=DEFAULT_EXTENT):
+    """Real MVT protobuf from the clipped/quantized arrays: one Tile
+    message holding one Layer named after the dataset, every feature's
+    envelope box as its geometry and its identity key as the feature id.
+    No blob reads — this layer serves partial clones, like
+    ``bin``/``ktb2``."""
+    types, geoms = _mvt_geometries(boxes)
+    return _mvt_layer_bytes(layer_name, keys, types, geoms, extent)
+
+
+def _clean_part(xs, ys, mvt_type, tol):
+    """One projected ring/line in tile ints -> the part the command
+    stream will carry, or None when it degenerates at this zoom.
+
+    Polygon rings drop their explicit WKB closing vertex (ClosePath
+    re-closes), consecutive duplicate vertices (quantization collisions)
+    collapse, then Douglas-Peucker runs at ``tol``. Survivor floors:
+    point 1, line 2 distinct, polygon 3 distinct with nonzero doubled
+    area — a zero-area ring is invalid MVT."""
+    if (
+        mvt_type == MVT_POLYGON
+        and len(xs) > 1
+        and xs[0] == xs[-1]
+        and ys[0] == ys[-1]
+    ):
+        xs, ys = xs[:-1], ys[:-1]
+    if len(xs) > 1:
+        same = (xs[1:] == xs[:-1]) & (ys[1:] == ys[:-1])
+        if same.any():
+            keep = np.concatenate(([True], ~same))
+            xs, ys = xs[keep], ys[keep]
+    if mvt_type != MVT_POINT and tol > 0 and len(xs) > 2:
+        from kart_tpu.tiles.clip import simplify_ring
+
+        keep = simplify_ring(xs, ys, tol)
+        xs, ys = xs[keep], ys[keep]
+    if mvt_type == MVT_POINT:
+        return (xs, ys) if len(xs) else None
+    if mvt_type == MVT_LINESTRING:
+        return (xs, ys) if len(xs) >= 2 else None
+    if len(xs) < 3:
+        return None
+    x = xs.astype(np.int64)
+    y = ys.astype(np.int64)
+    if int((x * np.roll(y, -1) - np.roll(x, -1) * y).sum()) == 0:
+        return None
+    return xs, ys
+
+
+def _geom_commands(parts, mvt_type):
+    """Cleaned tile-int parts -> one MVT geometry command byte string
+    with the running cursor threaded across parts (the spec's relative
+    encoding). Points collapse into ONE MoveTo run; lines are
+    MoveTo+LineTo per part; polygon rings add ClosePath."""
+    zz = zigzag
+    words = []
+    if mvt_type == MVT_POINT:
+        xs = np.concatenate([p[0] for p in parts]).astype(np.int64)
+        ys = np.concatenate([p[1] for p in parts]).astype(np.int64)
+        run = np.empty(1 + 2 * len(xs), dtype=np.uint64)
+        run[0] = (len(xs) << 3) | 1
+        run[1::2] = zz(np.diff(xs, prepend=0))
+        run[2::2] = zz(np.diff(ys, prepend=0))
+        words.append(run)
+    else:
+        cx = cy = 0
+        for xs, ys in parts:
+            xs = xs.astype(np.int64)
+            ys = ys.astype(np.int64)
+            dx = np.diff(xs, prepend=cx)
+            dy = np.diff(ys, prepend=cy)
+            n = len(xs)
+            run = np.empty(4 + 2 * (n - 1), dtype=np.uint64)
+            run[0] = 9  # MoveTo, count 1
+            run[1] = zz(dx[:1])[0]
+            run[2] = zz(dy[:1])[0]
+            run[3] = ((n - 1) << 3) | 2  # LineTo, count n-1
+            run[4::2] = zz(dx[1:])
+            run[5::2] = zz(dy[1:])
+            words.append(run)
+            if mvt_type == MVT_POLYGON:
+                words.append(np.array([15], dtype=np.uint64))  # ClosePath
+            cx, cy = int(xs[-1]), int(ys[-1])
+    return bytes(varint_encode(np.concatenate(words)))
+
+
+def encode_geom_layer(layer_name, keys, col, rows, boxes, z, x, y,
+                      extent=DEFAULT_EXTENT, buffer=DEFAULT_BUFFER):
+    """The real-geometry MVT layer (docs/TILES.md §6): each selected
+    row's actual rings from the :class:`~kart_tpu.geom.VertexColumn`,
+    projected to tile coordinates in ONE vectorized pass over every
+    vertex of the tile, simplified per zoom
+    (:func:`~kart_tpu.tiles.clip.simplify_ring`), emitted as
+    MoveTo/LineTo/ClosePath command streams. Kind values are the MVT
+    geometry types by construction (1 point / 2 line / 3 polygon).
+
+    Rows without usable geometry — kind 0, or every part degenerate
+    after quantization+simplification — fall back to their quantized
+    envelope box (the ``mvt`` layer's exact shapes), so every selected
+    row appears in the layer. Deterministic: host mercator ops only, so
+    serving and batch export stay byte-identical."""
+    from kart_tpu.geom import _gather_ranges
+    from kart_tpu.tiles.clip import project_vertices, simplify_tolerance
+
+    rows = np.asarray(rows, dtype=np.int64)
+    m = len(rows)
+    tol = simplify_tolerance()
+    kinds = col.kinds[rows] if m else np.zeros(0, np.uint8)
+    ring_idx, ring_counts = _gather_ranges(
+        col.feat_offsets[rows], col.feat_offsets[rows + 1]
+    )
+    vert_idx, vert_counts = _gather_ranges(
+        col.ring_offsets[ring_idx], col.ring_offsets[ring_idx + 1]
+    )
+    tx, ty = project_vertices(
+        col.x[vert_idx], col.y[vert_idx], z, x, y,
+        extent=extent, buffer=buffer,
+    )
+    ring_offs = np.concatenate(([0], np.cumsum(vert_counts)))
+    feat_rings = np.concatenate(([0], np.cumsum(ring_counts)))
+    types = np.zeros(m, dtype=np.uint8)
+    geoms = [b""] * m
+    fallback = []
+    for j in range(m):
+        mvt_type = int(kinds[j])
+        parts = []
+        if mvt_type:
+            for r in range(int(feat_rings[j]), int(feat_rings[j + 1])):
+                v0, v1 = int(ring_offs[r]), int(ring_offs[r + 1])
+                part = _clean_part(tx[v0:v1], ty[v0:v1], mvt_type, tol)
+                if part is not None:
+                    parts.append(part)
+        if not parts:
+            fallback.append(j)
+            continue
+        types[j] = mvt_type
+        geoms[j] = _geom_commands(parts, mvt_type)
+    if fallback:
+        fb = np.asarray(fallback, dtype=np.int64)
+        fb_types, fb_geoms = _mvt_geometries(np.asarray(boxes)[fb])
+        for t, g, j in zip(fb_types, fb_geoms, fb):
+            types[j] = t
+            geoms[j] = g
+    return _mvt_layer_bytes(layer_name, keys, types, geoms, extent)
 
 
 def decode_mvt_layer(data):
@@ -546,14 +700,17 @@ def decode_mvt_layer(data):
 # ---------------------------------------------------------------------------
 
 
-def build_layers(source, layers, rows, boxes, extent=DEFAULT_EXTENT):
+def build_layers(source, layers, rows, boxes, extent=DEFAULT_EXTENT, *,
+                 tile=None, buffer=DEFAULT_BUFFER):
     """The selected/quantized arrays -> {layer name: layer bytes} — shared
     by the serving encoder and the batch pyramid exporter (one set of
-    builders, so export files are byte-identical to served payloads)."""
+    builders, so export files are byte-identical to served payloads).
+    ``tile`` is the (z, x, y) address — required by the ``geom`` layer,
+    whose per-vertex projection is tile-local."""
     built = {}
     count = len(rows)
     keys = None
-    if any(name in layers for name in ("bin", "ktb2", "mvt")):
+    if any(name in layers for name in ("bin", "ktb2", "mvt", "geom")):
         keys = np.ascontiguousarray(source.block.keys[rows], dtype="<i8")
     lines = None
     if any(name in layers for name in ("geojson", "props")):
@@ -570,6 +727,14 @@ def build_layers(source, layers, rows, boxes, extent=DEFAULT_EXTENT):
         built["ktb2"] = encode_ktb2_layer(keys, boxes)
     if "mvt" in layers:
         built["mvt"] = encode_mvt_layer(source.ds_path, keys, boxes, extent)
+    if "geom" in layers:
+        if tile is None:
+            raise TileEncodeError("geom layer needs a tile address")
+        z, x, y = tile
+        built["geom"] = encode_geom_layer(
+            source.ds_path, keys, source.vertices(), rows, boxes, z, x, y,
+            extent=extent, buffer=buffer,
+        )
     if "geojson" in layers:
         built["geojson"] = (
             ("\n".join(lines) + "\n").encode() if lines else b""
@@ -630,7 +795,10 @@ def encode_tile(source, z, x, y, *, layers=None, extent=DEFAULT_EXTENT,
         if max_features and count > max_features:
             raise TileTooLarge(count, max_features, (z, x, y))
 
-        built = build_layers(source, layers, rows, boxes, extent)
+        built = build_layers(
+            source, layers, rows, boxes, extent, tile=(z, x, y),
+            buffer=buffer,
+        )
         faults.fire("tiles.encode")  # frame 2: layers built, not assembled
         payload = assemble_payload(
             source, z, x, y, layers, built, count, extent=extent,
@@ -698,7 +866,10 @@ def encode_tile_batch(source, addresses, *, layers=None,
         boxes = quantize_from_merc(
             env, merc, z, x, y, extent=extent, buffer=buffer
         )
-        built = build_layers(source, layers, rows, boxes, extent)
+        built = build_layers(
+            source, layers, rows, boxes, extent, tile=(z, x, y),
+            buffer=buffer,
+        )
         payload = assemble_payload(
             source, z, x, y, layers, built, count, extent=extent,
             buffer=buffer,

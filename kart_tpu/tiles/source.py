@@ -84,6 +84,7 @@ class TileSource:
         self._lock = threading.Lock()
         self._fallback_envs = None
         self._fallback_aggs = None
+        self._fallback_verts = None
 
     # -- envelope columns ----------------------------------------------------
 
@@ -119,6 +120,49 @@ class TileSource:
                 feature = ds.get_feature(pks, data=blob)
                 out[lo + i] = _feature_envelope_wsen(feature, geom_col)
         return out
+
+    def vertices(self):
+        """The revision's :class:`kart_tpu.geom.VertexColumn` (real ring
+        geometry for the ``geom`` layer, ISSUE 20): the sidecar's decoded
+        geometry section when it carries one, else a fallback column built
+        once from the feature blobs — same shape as the envelope fallback.
+        Rows whose geometry can't be extracted are kind 0 (the layer falls
+        back to their envelope box), and a partial clone that can't read
+        blobs at all yields an all-kind-0 column rather than failing the
+        tile: geometry detail degrades, coverage never does."""
+        col = self.block.vertex_column()
+        if col is not None:
+            return col
+        with self._lock:
+            if self._fallback_verts is None:
+                with tm.span("tiles.vertex_fallback", rows=self.block.count):
+                    self._fallback_verts = self._build_fallback_vertices()
+            return self._fallback_verts
+
+    def _build_fallback_vertices(self, chunk=100_000):
+        from kart_tpu.geom import (
+            VertexColumn,
+            vertex_column_from_blobs,
+        )
+
+        ds = self.dataset
+        geom_col = ds.geom_column_name
+        n = self.block.count
+        parts = []
+        for lo in range(0, n, chunk):
+            rows = np.arange(lo, min(lo + chunk, n), dtype=np.int64)
+            try:
+                data = self.feature_blobs(rows)
+            except TileDataUnavailable:
+                return VertexColumn.empty(n)
+            blobs = []
+            for pks, blob in zip(self.pks_for_rows(rows), data):
+                value = ds.get_feature(pks, data=blob).get(geom_col)
+                blobs.append(bytes(value) if value is not None else None)
+            parts.append(vertex_column_from_blobs(blobs))
+        if not parts:
+            return VertexColumn.empty(0)
+        return parts[0] if len(parts) == 1 else VertexColumn.concat(parts)
 
     def env_blocks(self):
         """(agg (nb,4) f32, flags (nb,) u8, block_rows) aggregates, or

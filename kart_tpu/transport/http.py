@@ -966,6 +966,14 @@ class KartRequestHandler(BaseHTTPRequestHandler):
         output = one("output", "count")
         count_by = one("count_by")
         raw_part = one("part")
+        # fold the *effective* mode into the key: a server pinned to
+        # envelope semantics (KART_GEOM_REFINE=0) serves different bytes
+        # and must never share a validator with an exact answer
+        from kart_tpu.geom import geom_refine_enabled
+
+        approx = (
+            one("approx") in ("1", "true") or not geom_refine_enabled()
+        )
         try:
             page = int(one("page")) if one("page") is not None else None
             page_size = (
@@ -1006,7 +1014,7 @@ class KartRequestHandler(BaseHTTPRequestHandler):
         key = qcache.query_request_key(
             commit1, ds_path, where=where, bbox=bbox, commit_oid2=commit2,
             ds_path2=ds_path2, output=output, count_by=count_by, page=page,
-            page_size=page_size, part=part_str,
+            page_size=page_size, part=part_str, approx=approx,
         )
         etag = qcache.etag_for(key)
         if self._if_none_match_hits(self.headers.get("If-None-Match"), etag):
@@ -1033,13 +1041,13 @@ class KartRequestHandler(BaseHTTPRequestHandler):
             if scatter_ok:
                 doc = self._scattered_join(
                     query_mod, qcache, fleet, commit1, ds_path, commit2,
-                    ds_path2, bbox,
+                    ds_path2, bbox, approx,
                 )
             if doc is None:
                 doc = query_mod.run_query(
                     self.repo, commit1, ds_path, where=where, bbox=bbox,
                     intersects=intersects, output=output, count_by=count_by,
-                    page=page, page_size=page_size, part=part,
+                    page=page, page_size=page_size, part=part, approx=approx,
                 )
             return json.dumps(doc, sort_keys=True).encode()
 
@@ -1061,7 +1069,7 @@ class KartRequestHandler(BaseHTTPRequestHandler):
         self.wfile.write(payload)
 
     def _scattered_join(self, query_mod, qcache, fleet, commit1, ds_path,
-                        commit2, ds_path2, bbox):
+                        commit2, ds_path2, bbox, approx):
         """The fleet scatter of a join ``count`` query (docs/QUERY.md §6):
         split the probe side into block-aligned row ranges, fetch parts
         1..N-1 from peers as commit-addressed ``part=lo:hi`` partials
@@ -1095,14 +1103,18 @@ class KartRequestHandler(BaseHTTPRequestHandler):
             return query_mod.run_query(
                 self.repo, commit1, ds_path, bbox=bbox,
                 intersects=(commit2, ds_path2), output="count",
-                part=(lo, hi),
+                part=(lo, hi), approx=approx,
             )
 
         def _from_peer(lo, hi):
             part_str = f"{lo}:{hi}"
+            # the approx mode folds into the part key AND the part URL
+            # consistently — a peer must never serve an exact partial
+            # into an approx merge or vice versa
             pkey = qcache.query_request_key(
                 commit1, ds_path, bbox=bbox, commit_oid2=commit2,
                 ds_path2=ds_path2, output="count", part=part_str,
+                approx=approx,
             )
             path_and_query = (
                 f"{API}/query?ref={commit1}"
@@ -1112,6 +1124,8 @@ class KartRequestHandler(BaseHTTPRequestHandler):
             )
             if bbox:
                 path_and_query += f"&bbox={quote(bbox, safe='')}"
+            if approx:
+                path_and_query += "&approx=1"
             return peercache.query_from_peers(
                 self.repo, fleet.peers, path_and_query,
                 qcache.etag_for(pkey),
@@ -1143,8 +1157,11 @@ class KartRequestHandler(BaseHTTPRequestHandler):
         merged["pairs"] = sum(d["pairs"] for d in docs)
         merged["count"] = sum(d["count"] for d in docs)
         stats = dict(docs[0]["stats"])
-        for name in ("tiles", "blocks_pruned", "block_tests", "batches"):
-            stats[name] = sum(d["stats"][name] for d in docs)
+        for name in (
+            "tiles", "blocks_pruned", "block_tests", "batches",
+            "pairs_refined", "refine_dropped",
+        ):
+            stats[name] = sum(d["stats"].get(name, 0) for d in docs)
         stats["scatter_parts"] = len(parts)
         merged["stats"] = stats
         return merged

@@ -320,6 +320,27 @@ NEW_KEYS += [
     "query_scatter_parts",
 ]
 
+#: keys added by ISSUE 20 (exact geometry end-to-end: the refine stage's
+#: price on the pushdown scan, the refine kernel bbox-only vs host vs
+#: device with bit-identity asserted, and the `geom` tile layer's
+#: bytes/feature + cold encode rate next to the r15 encoding ladder)
+NEW_KEYS += [
+    "query_scan_approx_seconds",
+    "query_scan_refine_pairs",
+    "query_scan_refine_overhead",
+    "query_scan_exact_matches_approx",
+    "query_refine_pairs",
+    "query_refine_matches",
+    "query_refine_pairs_per_sec_bbox_only",
+    "query_refine_pairs_per_sec_host",
+    "query_refine_pairs_per_sec_device",
+    "query_refine_exact_vs_bbox_cost",
+    "query_refine_device_vs_host",
+    "query_refine_device_matches_host",
+    "tile_bytes_per_feature_geom",
+    "tiles_per_sec_geom_cold",
+]
+
 
 def test_bench_emits_every_recorded_key():
     with open(os.path.join(REPO_ROOT, "bench.py")) as f:

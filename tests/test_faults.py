@@ -1725,3 +1725,90 @@ def test_query_join_killed_at_every_frame_publishes_nothing(
     clean = run_query(repo, base, "synth", intersects=(edit, "synth"))
     assert payload == _json.dumps(clean, sort_keys=True).encode()
     assert _json.loads(payload)["pairs"] == clean["pairs"]
+
+
+def test_query_refine_killed_publishes_nothing(served_join_repo, monkeypatch):
+    """ISSUE 20 kill matrix: a crash in the exact-refine stage
+    (query.refine, fired before any refine verdict lands) surfaces as a
+    500 with nothing published — the result cache holds no entry — and
+    the retried query serves the exact bytes a never-faulted server
+    would."""
+    import json as _json
+
+    from kart_tpu.query import run_query
+    from kart_tpu.query.cache import query_cache_for
+
+    repo, info, url = served_join_repo
+    base = info["base_commit"]
+    path = (
+        f"/api/v1/query?ref={base}&dataset=synth&bbox=-180,-90,180,90"
+    )
+
+    monkeypatch.setenv("KART_FAULTS", "query.refine:1")
+    status, body = _get_tile(url, path)
+    monkeypatch.delenv("KART_FAULTS")
+    assert status == 500
+    assert b"InjectedFault" in body
+    assert query_cache_for(repo).stats() == {"entries": 0, "bytes": 0}
+
+    status, payload = _get_tile(url, path)
+    assert status == 200
+    clean = run_query(repo, base, "synth", bbox="-180,-90,180,90")
+    assert clean["exact"] is True and clean["stats"]["pairs_refined"] > 0
+    assert payload == _json.dumps(clean, sort_keys=True).encode()
+
+
+@pytest.fixture()
+def served_polygon_repo(tmp_path):
+    """A real-blob polygon repo (sidecar carries no geometry section, so
+    the geom tile layer runs the blob-fallback vertex extraction) served
+    over HTTP."""
+    from kart_tpu.synth import synth_polygon_repo
+    from kart_tpu.tiles.cache import _TILE_CACHES, _tile_caches_lock
+    from kart_tpu.tiles.source import drop_sources
+
+    repo, info = synth_polygon_repo(str(tmp_path / "p"), 120, seed=5)
+    with _tile_caches_lock:
+        _TILE_CACHES.clear()
+    drop_sources()
+    server = make_server(repo)
+    threading.Thread(target=server.serve_forever, daemon=True).start()
+    url = f"http://127.0.0.1:{server.server_address[1]}"
+    yield repo, info, url
+    server.shutdown()
+    server.server_close()
+    drop_sources()
+
+
+def test_geom_extract_killed_publishes_nothing(
+    served_polygon_repo, monkeypatch
+):
+    """ISSUE 20 kill matrix: a crash in the vertex extraction
+    (geom.extract, fired before any rows are built — here via the geom
+    tile layer's blob-fallback build) surfaces as a 500 with nothing
+    published: no tile cache entry, no memoized partial vertex column.
+    The retried request re-runs the extraction and serves the exact
+    payload a never-faulted server would."""
+    from kart_tpu import tiles
+    from kart_tpu.tiles.cache import tile_cache_for
+    from kart_tpu.tiles.encode import decode_mvt_layer
+
+    repo, info, url = served_polygon_repo
+    tile = "/api/v1/tiles/HEAD/polys/0/0/0?layers=geom"
+
+    monkeypatch.setenv("KART_FAULTS", "geom.extract:1")
+    status, body = _get_tile(url, tile)
+    monkeypatch.delenv("KART_FAULTS")
+    assert status == 500
+    assert b"InjectedFault" in body
+    assert tile_cache_for(repo).stats() == {"entries": 0, "bytes": 0}
+
+    status, payload = _get_tile(url, tile)
+    assert status == 200
+    clean, _etag, _ = tiles.serve_tile(
+        repo, "HEAD", "polys", 0, 0, 0, layers="geom"
+    )
+    assert payload == clean
+    header, layer_bytes = tiles.parse_payload(payload)
+    assert header["count"] > 0
+    assert len(decode_mvt_layer(layer_bytes["geom"])["features"]) > 0

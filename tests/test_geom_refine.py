@@ -1,0 +1,309 @@
+"""Exact-refine property tests (ISSUE 20).
+
+Three contracts:
+
+1. **Brute-force equivalence** — the vectorized host refine
+   (:func:`kart_tpu.geom.refine_pairs_host`) agrees with an independent
+   scalar pure-Python implementation of the same semantics (inclusive
+   segment contact, even-odd containment with the half-open vertex rule)
+   on an edge-case matrix: polar rings, near-anti-meridian spans,
+   touching corners, collinear overlap, point-on-edge, holes, NaN/empty
+   extraction fallbacks, plus a randomized all-pairs sweep.
+2. **Host/sharded bit-identity** — the 8-device virtual-mesh kernel
+   returns the identical verdict array (predicates are operator-only
+   shared source, so this is by construction — the test guards the
+   padding/batching plumbing around them).
+3. **Monotonicity** — exact verdicts only ever *drop* envelope-stage
+   candidates (exact ⊆ bbox), end-to-end through the scan.
+"""
+
+import numpy as np
+import pytest
+
+from kart_tpu.geom import (
+    COORD_SCALE,
+    KIND_NONE,
+    KIND_POLY,
+    VertexColumn,
+    bbox_vertex_column,
+    refine_pairs_host,
+    vertex_column_from_blobs,
+)
+from kart_tpu.geometry import Geometry
+
+
+def _col_from_wkt(wkts):
+    """WKT list (or None) -> VertexColumn via the real GPKG blob path."""
+    blobs = [
+        bytes(Geometry.from_wkt(w)) if w is not None else None for w in wkts
+    ]
+    return vertex_column_from_blobs(blobs)
+
+
+# ---------------------------------------------------------------------------
+# the independent scalar reference
+# ---------------------------------------------------------------------------
+
+
+def _orient(ax, ay, bx, by, cx, cy):
+    return (bx - ax) * (cy - ay) - (by - ay) * (cx - ax)
+
+
+def _seg_contact(a, b):
+    ax0, ay0, ax1, ay1 = a
+    bx0, by0, bx1, by1 = b
+    d1 = _orient(bx0, by0, bx1, by1, ax0, ay0)
+    d2 = _orient(bx0, by0, bx1, by1, ax1, ay1)
+    d3 = _orient(ax0, ay0, ax1, ay1, bx0, by0)
+    d4 = _orient(ax0, ay0, ax1, ay1, bx1, by1)
+    if ((d1 > 0 and d2 < 0) or (d1 < 0 and d2 > 0)) and (
+        (d3 > 0 and d4 < 0) or (d3 < 0 and d4 > 0)
+    ):
+        return True
+
+    def on(px, py, sx0, sy0, sx1, sy1):
+        return (
+            _orient(sx0, sy0, sx1, sy1, px, py) == 0
+            and min(sx0, sx1) <= px <= max(sx0, sx1)
+            and min(sy0, sy1) <= py <= max(sy0, sy1)
+        )
+
+    return (
+        on(ax0, ay0, bx0, by0, bx1, by1)
+        or on(ax1, ay1, bx0, by0, bx1, by1)
+        or on(bx0, by0, ax0, ay0, ax1, ay1)
+        or on(bx1, by1, ax0, ay0, ax1, ay1)
+    )
+
+
+def _point_in(px, py, segs):
+    """Even-odd with the half-open upward rule, exact integer math."""
+    inside = False
+    for sx0, sy0, sx1, sy1 in segs:
+        if (sy0 <= py) != (sy1 <= py):
+            cr = (sx1 - sx0) * (py - sy0) - (sy1 - sy0) * (px - sx0)
+            if (sy1 > sy0 and cr > 0) or (sy1 < sy0 and cr < 0):
+                inside = not inside
+    return inside
+
+
+def _scalar_segs(col, i):
+    x0, y0, x1, y1 = col.segments(i)
+    return list(
+        zip(
+            (int(v) for v in x0),
+            (int(v) for v in y0),
+            (int(v) for v in x1),
+            (int(v) for v in y1),
+        )
+    )
+
+
+def _brute_pair(col_a, i, col_b, j):
+    sa = _scalar_segs(col_a, i)
+    sb = _scalar_segs(col_b, j)
+    if not sa or not sb:
+        return False
+    for a in sa:
+        for b in sb:
+            if _seg_contact(a, b):
+                return True
+    if col_b.kinds[j] == KIND_POLY and any(
+        _point_in(a[0], a[1], sb) for a in sa
+    ):
+        return True
+    if col_a.kinds[i] == KIND_POLY and any(
+        _point_in(b[0], b[1], sa) for b in sb
+    ):
+        return True
+    return False
+
+
+def _all_pairs(col_a, col_b):
+    ia, ib = np.meshgrid(
+        np.arange(len(col_a)), np.arange(len(col_b)), indexing="ij"
+    )
+    return ia.ravel().astype(np.int64), ib.ravel().astype(np.int64)
+
+
+# ---------------------------------------------------------------------------
+# 1. brute-force equivalence
+# ---------------------------------------------------------------------------
+
+#: the edge-case matrix: deliberate touching/collinear/degenerate shapes,
+#: polar latitudes, and spans hugging (not crossing) the anti-meridian
+EDGE_WKTS_A = [
+    "POLYGON ((0 0, 10 0, 10 10, 0 10, 0 0))",
+    # hole: a point inside the hole must NOT intersect
+    "POLYGON ((20 20, 40 20, 40 40, 20 40, 20 20),"
+    " (25 25, 35 25, 35 35, 25 35, 25 25))",
+    "LINESTRING (0 0, 10 10)",
+    "POINT (5 5)",
+    "POINT (10 0)",  # exactly on a corner of the first polygon
+    "MULTIPOINT (1 1, 9 9)",
+    "LINESTRING (-179.99 70, -179.5 75)",  # near the anti-meridian
+    "POLYGON ((-180 85, 180 85, 180 90, -180 90, -180 85))",  # polar cap
+    "LINESTRING (0 5, 0 5)",  # degenerate zero-length line
+    None,  # extraction failure -> kind 0
+]
+EDGE_WKTS_B = [
+    "POLYGON ((5 5, 15 5, 15 15, 5 15, 5 5))",  # overlaps A0
+    "POINT (30 30)",  # inside A1's hole
+    "POINT (26 21)",  # inside A1's shell, outside its hole
+    "LINESTRING (10 0, 20 -10)",  # touches A0 at its corner only
+    "LINESTRING (2 2, 8 8)",  # collinear sub-segment of A2
+    "POLYGON ((100 -90, 101 -90, 101 -89, 100 -89, 100 -90))",  # south pole
+    "POLYGON ((-180 60, -179 60, -179 80, -180 80, -180 60))",
+    "POINT (0 90)",  # the north pole itself
+    "MULTILINESTRING ((50 50, 60 60), (0 5, 1 5))",
+    "POLYGON EMPTY",  # empty -> kind 0
+]
+
+
+def test_refine_matches_bruteforce_on_edge_matrix():
+    col_a = _col_from_wkt(EDGE_WKTS_A)
+    col_b = _col_from_wkt(EDGE_WKTS_B)
+    assert col_a.kinds[-1] == KIND_NONE and col_b.kinds[-1] == KIND_NONE
+    ia, ib = _all_pairs(col_a, col_b)
+    got = refine_pairs_host(col_a, ia, col_b, ib)
+    want = np.asarray(
+        [_brute_pair(col_a, int(i), col_b, int(j)) for i, j in zip(ia, ib)]
+    )
+    assert np.array_equal(got, want)
+    # spot-check the semantics the matrix encodes
+    verdict = {(int(i), int(j)): bool(v) for i, j, v in zip(ia, ib, got)}
+    assert verdict[(0, 0)] is True  # overlapping boxes
+    assert verdict[(1, 1)] is False  # point inside the hole
+    assert verdict[(1, 2)] is True  # point in shell, outside hole
+    assert verdict[(0, 3)] is True  # corner touch counts (inclusive)
+    assert verdict[(2, 4)] is True  # collinear overlap counts
+    assert verdict[(9, 0)] is False  # kind-0 row never intersects
+
+
+def test_refine_matches_bruteforce_randomized():
+    rng = np.random.default_rng(2020)
+
+    def wkt_box(cx, cy, w, h):
+        x0, y0, x1, y1 = cx - w, cy - h, cx + w, cy + h
+        return (
+            f"POLYGON (({x0} {y0}, {x1} {y0}, {x1} {y1}, "
+            f"{x0} {y1}, {x0} {y0}))"
+        )
+
+    wkts_a, wkts_b = [], []
+    for out in (wkts_a, wkts_b):
+        for _ in range(12):
+            cx, cy = rng.uniform(-5, 5, 2)
+            shape = rng.integers(0, 3)
+            if shape == 0:
+                out.append(wkt_box(cx, cy, *rng.uniform(0.5, 4, 2)))
+            elif shape == 1:
+                dx, dy = rng.uniform(-4, 4, 2)
+                out.append(
+                    f"LINESTRING ({cx} {cy}, {cx + dx} {cy + dy})"
+                )
+            else:
+                out.append(f"POINT ({cx} {cy})")
+    col_a = _col_from_wkt(wkts_a)
+    col_b = _col_from_wkt(wkts_b)
+    ia, ib = _all_pairs(col_a, col_b)
+    got = refine_pairs_host(col_a, ia, col_b, ib)
+    want = np.asarray(
+        [_brute_pair(col_a, int(i), col_b, int(j)) for i, j in zip(ia, ib)]
+    )
+    assert np.array_equal(got, want)
+    assert got.any() and not got.all()  # the sweep exercises both verdicts
+
+
+# ---------------------------------------------------------------------------
+# 2. host/sharded bit-identity on the virtual mesh
+# ---------------------------------------------------------------------------
+
+
+def test_sharded_refine_bit_identical_to_host(monkeypatch):
+    import jax
+
+    if jax.device_count() < 2:
+        pytest.skip("needs a multi-device mesh")
+    from kart_tpu.diff.backend import refine_intersects, sharded_refine_pairs
+
+    col_a = _col_from_wkt(EDGE_WKTS_A)
+    col_b = _col_from_wkt(EDGE_WKTS_B)
+    rng = np.random.default_rng(7)
+    ia = rng.integers(0, len(col_a), 500).astype(np.int64)
+    ib = rng.integers(0, len(col_b), 500).astype(np.int64)
+    host = refine_pairs_host(col_a, ia, col_b, ib)
+
+    monkeypatch.setenv("KART_GEOM_BATCH_ROWS", "64")  # force multi-batch
+    sharded = sharded_refine_pairs(col_a, ia, col_b, ib)
+    assert sharded.dtype == bool and np.array_equal(sharded, host)
+
+    # and through the routing seam, forced onto the mesh
+    monkeypatch.setenv("KART_DIFF_SHARDED", "1")
+    routed = refine_intersects(col_a, ia, col_b, ib)
+    assert np.array_equal(routed, host)
+
+
+# ---------------------------------------------------------------------------
+# 3. monotonicity: exact ⊆ bbox, end-to-end through the scan stage
+# ---------------------------------------------------------------------------
+
+
+def test_scan_refine_only_drops_candidates(monkeypatch):
+    """A diagonal line whose envelope clips the query rectangle but whose
+    geometry misses it is dropped by refine and kept by --approx; every
+    exact survivor is an envelope-stage candidate."""
+    from kart_tpu.query.scan import _refine_bbox_indices
+
+    # envelope of each diagonal is the unit box around it
+    diags = [
+        "LINESTRING (0 0, 10 10)",  # envelope hits (0,8)-(2,10); line misses
+        "LINESTRING (0 10, 10 0)",  # passes through the corner box
+        "POINT (1 9)",  # inside the box
+    ]
+    col = _col_from_wkt(diags)
+    env = np.asarray(
+        [[0, 0, 10, 10], [0, 0, 10, 10], [1, 9, 1, 9]], dtype=np.float32
+    )
+
+    class _Block:
+        envelopes = env
+
+        def vertex_column(self):
+            return col
+
+    block = _Block()
+
+    class _DS:
+        pass
+
+    idx = np.arange(3, dtype=np.int64)
+    stats = {"pairs_refined": 0, "refine_dropped": 0}
+    kept = _refine_bbox_indices(
+        _DS(), block, idx, (0.0, 8.0, 2.0, 10.0), None, stats
+    )
+    assert set(kept.tolist()) <= set(idx.tolist())  # monotone: only drops
+    assert kept.tolist() == [1, 2]  # diagonal 0's bbox hit is refined away
+    assert stats["pairs_refined"] == 3 and stats["refine_dropped"] == 1
+
+    # the query rectangle itself round-trips through the box builder
+    qcol = bbox_vertex_column((0.0, 8.0, 2.0, 10.0))
+    assert qcol is not None and qcol.kinds[0] == KIND_POLY
+    assert bbox_vertex_column((170.0, 0.0, -170.0, 10.0)) is None  # wrap
+
+
+def test_exact_counts_never_exceed_approx(tmp_path):
+    """End-to-end monotonicity on a real repo: for a grid of query
+    rectangles, the exact scan count never exceeds the approx count
+    (and on box-geometry synth data they are equal)."""
+    from kart_tpu.query import run_query
+    from kart_tpu.synth import synth_repo
+
+    repo, info = synth_repo(str(tmp_path / "m"), 1500, spatial=True, seed=11)
+    base = info["base_commit"]
+    for bbox in ("0,0,30,30", "-10,-10,0.5,0.5", "100,-50,120,-30"):
+        exact = run_query(repo, base, "synth", bbox=bbox)
+        approx = run_query(repo, base, "synth", bbox=bbox, approx=True)
+        assert exact["exact"] is True and approx["exact"] is False
+        assert exact["count"] <= approx["count"]
+        assert exact["count"] == approx["count"]  # geometry IS the envelope

@@ -49,12 +49,22 @@ def _golden_payload():
 def _adapters():
     """{registry key: (golden bytes, decoder callable)} — built lazily so
     collecting this module never imports the wire stack."""
+    from kart_tpu import geom
     from kart_tpu.tiles import encode, streams
     from kart_tpu.transport import http, pack
     from kart_tpu.events import log as events_log
     from kart_tpu.query import scan
 
     keys, boxes = _tile_fixture()
+
+    vcol = geom.VertexColumn(
+        np.asarray([geom.KIND_POLY, geom.KIND_NONE, geom.KIND_LINE], np.uint8),
+        np.asarray([0, 1, 1, 2], np.int64),
+        np.asarray([0, 4, 6], np.int64),
+        np.asarray([0, 500, 500, 0, -200, 300], np.int32),
+        np.asarray([0, 0, 500, 500, -100, 250], np.int32),
+    )
+    vcol_golden = geom.encode_vertex_column(vcol)
 
     codes = np.arange(20, dtype=np.uint64) * 7 + 3
     varint_golden = streams.varint_encode(codes)
@@ -117,6 +127,10 @@ def _adapters():
         "kart_tpu/tiles/encode.py::parse_payload": (
             _golden_payload(),
             encode.parse_payload,
+        ),
+        "kart_tpu/geom.py::decode_vertex_column": (
+            vcol_golden,
+            lambda data: geom.decode_vertex_column(data, 3),
         ),
         "kart_tpu/transport/pack.py::read_pack": (
             pack_golden,
